@@ -1,0 +1,40 @@
+"""surrealdb_tpu — a TPU-native multi-model database framework.
+
+A from-scratch implementation of SurrealDB's capabilities (document + graph +
+relational + full-text + vector + live queries, SurrealQL-compatible) whose
+performance-critical paths — vector similarity search and multi-hop graph
+traversal — run as batched JAX/XLA programs on TPU-resident data, sharded over
+a `jax.sharding.Mesh` (reference architecture: /root/reference, see SURVEY.md).
+
+Quick start::
+
+    from surrealdb_tpu import Datastore
+    ds = Datastore("memory")
+    res = ds.execute("CREATE person:tobie SET name = 'Tobie'", ns="t", db="t")
+"""
+
+__version__ = "0.1.0"
+
+from surrealdb_tpu.kvs.ds import Datastore  # noqa: E402,F401
+from surrealdb_tpu.val import (  # noqa: E402,F401
+    NONE,
+    Duration,
+    Datetime,
+    RecordId,
+    Table,
+    Uuid,
+    Range,
+    Geometry,
+)
+
+__all__ = [
+    "Datastore",
+    "NONE",
+    "Duration",
+    "Datetime",
+    "RecordId",
+    "Table",
+    "Uuid",
+    "Range",
+    "Geometry",
+]

@@ -1,0 +1,69 @@
+"""Changefeeds (reference: core/src/cf/) — mutation log under `#` keys,
+read back by SHOW CHANGES FOR TABLE ... SINCE."""
+
+from __future__ import annotations
+
+from surrealdb_tpu import key as K
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.val import NONE, Datetime
+
+
+def read_changes(stmt, ctx):
+    ns, db = ctx.need_ns_db()
+    since = None
+    from surrealdb_tpu.exec.eval import evaluate
+
+    v = evaluate(stmt.since, ctx)
+    if isinstance(v, int):
+        since_vs = v
+    elif isinstance(v, Datetime):
+        since_vs = (v.epoch_ns() // 1_000_000) << 20
+    else:
+        raise SdbError("SHOW CHANGES SINCE requires a versionstamp or datetime")
+    limit = stmt.limit
+    if limit is not None:
+        from surrealdb_tpu.exec.eval import evaluate as _e
+
+        limit = int(_e(limit, ctx)) if not isinstance(limit, int) else limit
+    beg = K.changefeed_from(ns, db, since_vs)
+    _pre, end = K.prefix_range(K.changefeed_prefix(ns, db))
+    out = []
+    current_vs = None
+    current = None
+    for k, entry in ctx.txn.scan_vals(beg, end):
+        if stmt.table is not None:
+            if entry["rid"].tb != stmt.table:
+                continue
+        vs = int.from_bytes(k[len(K.changefeed_prefix(ns, db)) : len(K.changefeed_prefix(ns, db)) + 8], "big")
+        if vs != current_vs:
+            if current is not None:
+                out.append(current)
+                if limit is not None and len(out) >= limit:
+                    return out
+            current_vs = vs
+            current = {"versionstamp": vs, "changes": []}
+        rid = entry["rid"]
+        if entry["action"] == "DELETE":
+            current["changes"].append({"delete_only": {"id": rid}})
+        else:
+            after = entry["after"]
+            change = {"update": after}
+            if entry.get("before") not in (NONE, None):
+                change["current"] = after
+            current["changes"].append(change)
+    if current is not None:
+        out.append(current)
+    if limit is not None:
+        out = out[:limit]
+    return out
+
+
+def gc_changefeeds(ds, ctx, retention_ns: int):
+    """Drop changefeed entries older than the retention window."""
+    ns, db = ctx.need_ns_db()
+    import time
+
+    cutoff = ((int(time.time() * 1000) - retention_ns // 1_000_000) << 20)
+    beg = K.changefeed_prefix(ns, db)
+    end = K.changefeed_from(ns, db, cutoff)
+    ctx.txn.delete_range(beg, end)

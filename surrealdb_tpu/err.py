@@ -1,0 +1,37 @@
+"""Error types (reference: core/src/err/)."""
+
+
+class SdbError(Exception):
+    """Base error; message is what the RPC surface returns."""
+
+
+class ParseError(SdbError):
+    def __init__(self, msg, line=None, col=None):
+        if line is not None:
+            msg = f"Parse error: {msg} at line {line}, column {col}"
+        super().__init__(msg)
+        self.line = line
+        self.col = col
+
+
+class TypeError_(SdbError):
+    pass
+
+
+class ThrownError(SdbError):
+    """User `THROW` statement."""
+
+
+class BreakException(Exception):
+    """Control flow: BREAK inside FOR/WHILE."""
+
+
+class ContinueException(Exception):
+    """Control flow: CONTINUE inside FOR."""
+
+
+class ReturnException(Exception):
+    """Control flow: RETURN inside a block/function."""
+
+    def __init__(self, value):
+        self.value = value

@@ -1,0 +1,413 @@
+"""Kind coercion & casting (reference: expr/kind.rs + val coercion).
+
+`coerce` implements TYPE-clause semantics (DEFINE FIELD TYPE / LET $x: kind);
+`cast` implements `<kind> value` expressions (more lenient conversions).
+"""
+
+from __future__ import annotations
+
+import math
+from decimal import Decimal
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.expr.ast import Kind
+from surrealdb_tpu.val import (
+    NONE,
+    Datetime,
+    Duration,
+    File,
+    Geometry,
+    Range,
+    RecordId,
+    Regex,
+    Table,
+    Uuid,
+    render,
+    value_eq,
+)
+
+
+def kind_name(kind: Kind) -> str:
+    if kind.name == "either":
+        return " | ".join(kind_name(k) for k in kind.inner)
+    if kind.name == "record" and kind.inner:
+        return f"record<{' | '.join(kind.inner)}>"
+    if kind.inner:
+        return f"{kind.name}<{', '.join(kind_name(k) if isinstance(k, Kind) else str(k) for k in kind.inner)}>"
+    return kind.name
+
+
+def _type_name(v) -> str:
+    if v is NONE:
+        return "none"
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, int):
+        return "int"
+    if isinstance(v, float):
+        return "float"
+    if isinstance(v, Decimal):
+        return "decimal"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, Duration):
+        return "duration"
+    if isinstance(v, Datetime):
+        return "datetime"
+    if isinstance(v, Uuid):
+        return "uuid"
+    if isinstance(v, list):
+        return "array"
+    if isinstance(v, dict):
+        return "object"
+    if isinstance(v, Geometry):
+        return "geometry"
+    if isinstance(v, (bytes, bytearray)):
+        return "bytes"
+    if isinstance(v, RecordId):
+        return "record"
+    if isinstance(v, Range):
+        return "range"
+    if isinstance(v, Regex):
+        return "regex"
+    if isinstance(v, File):
+        return "file"
+    if isinstance(v, Table):
+        return "string"
+    return type(v).__name__
+
+
+def coerce_err(v, kind: Kind):
+    return SdbError(
+        f"Expected a {kind_name(kind)} but found {render(v)}"
+    )
+
+
+def coerce(v, kind: Kind):
+    """Coerce a value to a kind; raises SdbError on mismatch."""
+    n = kind.name
+    if n == "any":
+        return v
+    if n == "option":
+        if v is NONE or v is None:
+            return NONE if v is NONE else v
+        return coerce(v, kind.inner[0]) if kind.inner else v
+    if n == "either":
+        for k in kind.inner:
+            try:
+                return coerce(v, k)
+            except SdbError:
+                continue
+        raise coerce_err(v, kind)
+    if n == "literal":
+        lit = kind.literal
+        from surrealdb_tpu.exec.static_eval import static_value_maybe
+
+        litv = static_value_maybe(lit)
+        if value_eq(v, litv):
+            return v
+        raise coerce_err(v, kind)
+    if n == "null":
+        if v is None:
+            return v
+        raise coerce_err(v, kind)
+    if n == "none":
+        if v is NONE:
+            return v
+        raise coerce_err(v, kind)
+    if n == "bool":
+        if isinstance(v, bool):
+            return v
+        raise coerce_err(v, kind)
+    if n == "int":
+        if isinstance(v, bool):
+            raise coerce_err(v, kind)
+        if isinstance(v, int):
+            return v
+        if isinstance(v, float) and v.is_integer():
+            return int(v)
+        if isinstance(v, Decimal) and v == v.to_integral_value():
+            return int(v)
+        raise coerce_err(v, kind)
+    if n == "float":
+        if isinstance(v, bool):
+            raise coerce_err(v, kind)
+        if isinstance(v, float):
+            return v
+        if isinstance(v, (int, Decimal)):
+            return float(v)
+        raise coerce_err(v, kind)
+    if n == "decimal":
+        if isinstance(v, bool):
+            raise coerce_err(v, kind)
+        if isinstance(v, Decimal):
+            return v
+        if isinstance(v, int):
+            return Decimal(v)
+        if isinstance(v, float):
+            return Decimal(str(v))
+        raise coerce_err(v, kind)
+    if n == "number":
+        if isinstance(v, bool):
+            raise coerce_err(v, kind)
+        if isinstance(v, (int, float, Decimal)):
+            return v
+        raise coerce_err(v, kind)
+    if n == "string":
+        if isinstance(v, str):
+            return v
+        if isinstance(v, Table):
+            return v.name
+        raise coerce_err(v, kind)
+    if n == "duration":
+        if isinstance(v, Duration):
+            return v
+        raise coerce_err(v, kind)
+    if n == "datetime":
+        if isinstance(v, Datetime):
+            return v
+        if isinstance(v, str):
+            try:
+                return Datetime.parse(v)
+            except ValueError:
+                pass
+        raise coerce_err(v, kind)
+    if n == "uuid":
+        if isinstance(v, Uuid):
+            return v
+        if isinstance(v, str):
+            try:
+                return Uuid(v)
+            except ValueError:
+                pass
+        raise coerce_err(v, kind)
+    if n == "array":
+        if not isinstance(v, list):
+            raise coerce_err(v, kind)
+        if kind.inner:
+            v = [coerce(x, kind.inner[0]) for x in v]
+        if kind.size is not None and len(v) > kind.size:
+            raise coerce_err(v, kind)
+        return v
+    if n == "set":
+        if not isinstance(v, list):
+            raise coerce_err(v, kind)
+        out = []
+        for x in v:
+            if kind.inner:
+                x = coerce(x, kind.inner[0])
+            if not any(value_eq(x, y) for y in out):
+                out.append(x)
+        if kind.size is not None and len(out) > kind.size:
+            raise coerce_err(v, kind)
+        return out
+    if n == "object":
+        if isinstance(v, dict):
+            return v
+        raise coerce_err(v, kind)
+    if n == "record":
+        if isinstance(v, RecordId):
+            if kind.inner and v.tb not in kind.inner:
+                raise coerce_err(v, kind)
+            return v
+        raise coerce_err(v, kind)
+    if n == "geometry":
+        if isinstance(v, Geometry):
+            if kind.inner and v.kind.lower() not in [
+                x.lower() for x in kind.inner
+            ] and not (
+                "collection" in kind.inner
+                and v.kind == "GeometryCollection"
+            ):
+                raise coerce_err(v, kind)
+            return v
+        if isinstance(v, dict) and "type" in v and (
+            "coordinates" in v or "geometries" in v
+        ):
+            g = object_to_geometry(v)
+            if g is not None:
+                return coerce(g, kind)
+        raise coerce_err(v, kind)
+    if n == "point":
+        if isinstance(v, Geometry) and v.kind == "Point":
+            return v
+        raise coerce_err(v, kind)
+    if n == "bytes":
+        if isinstance(v, (bytes, bytearray)):
+            return bytes(v)
+        raise coerce_err(v, kind)
+    if n == "regex":
+        if isinstance(v, Regex):
+            return v
+        raise coerce_err(v, kind)
+    if n == "range":
+        if isinstance(v, Range):
+            return v
+        raise coerce_err(v, kind)
+    if n == "function":
+        from surrealdb_tpu.val import Closure
+
+        if isinstance(v, Closure):
+            return v
+        raise coerce_err(v, kind)
+    if n == "file":
+        if isinstance(v, File):
+            return v
+        raise coerce_err(v, kind)
+    if n == "table":
+        if isinstance(v, Table):
+            return v
+        if isinstance(v, str):
+            return Table(v)
+        raise coerce_err(v, kind)
+    if n == "references":
+        # computed references fields — value is filled by the executor
+        return v if isinstance(v, list) else []
+    raise SdbError(f"unknown kind {n!r}")
+
+
+def object_to_geometry(v: dict):
+    t = v.get("type")
+    if t == "GeometryCollection":
+        geoms = v.get("geometries")
+        if isinstance(geoms, list):
+            inner = [
+                g if isinstance(g, Geometry) else object_to_geometry(g)
+                for g in geoms
+            ]
+            if all(inner):
+                return Geometry(t, inner)
+        return None
+    coords = v.get("coordinates")
+    if t in ("Point", "LineString", "Polygon", "MultiPoint",
+             "MultiLineString", "MultiPolygon") and coords is not None:
+        return Geometry(t, _tupled(coords))
+    return None
+
+
+def _tupled(c):
+    if isinstance(c, list):
+        return tuple(_tupled(x) for x in c)
+    return float(c) if isinstance(c, (int, float, Decimal)) else c
+
+
+def cast(v, kind: Kind):
+    """`<kind> value` — lenient conversion (reference expr/cast.rs)."""
+    n = kind.name
+    try:
+        return coerce(v, kind)
+    except SdbError:
+        pass
+    if n == "int":
+        if isinstance(v, str):
+            try:
+                return int(v)
+            except ValueError:
+                try:
+                    f = float(v)
+                    return int(f)
+                except ValueError:
+                    pass
+        if isinstance(v, (float, Decimal)):
+            if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+                raise SdbError(f"Cannot convert {render(v)} to an int")
+            return int(v)
+        if isinstance(v, bool):
+            return 1 if v else 0
+        if isinstance(v, Datetime):
+            return v.epoch_ns() // 1_000_000_000
+    elif n == "float":
+        if isinstance(v, str):
+            try:
+                return float(v)
+            except ValueError:
+                pass
+        if isinstance(v, (int, Decimal)):
+            return float(v)
+        if isinstance(v, bool):
+            return 1.0 if v else 0.0
+    elif n == "decimal":
+        if isinstance(v, str):
+            try:
+                return Decimal(v)
+            except Exception:
+                pass
+        if isinstance(v, (int, float)):
+            return Decimal(str(v))
+        if isinstance(v, bool):
+            return Decimal(1 if v else 0)
+    elif n == "number":
+        if isinstance(v, str):
+            try:
+                return int(v)
+            except ValueError:
+                try:
+                    return float(v)
+                except ValueError:
+                    pass
+    elif n == "string":
+        if isinstance(v, (bytes, bytearray)):
+            return bytes(v).decode("utf-8", "replace")
+        if v is not NONE and v is not None:
+            from surrealdb_tpu.exec.operators import to_string
+
+            return to_string(v)
+    elif n == "bool":
+        if isinstance(v, str):
+            if v.lower() == "true":
+                return True
+            if v.lower() == "false":
+                return False
+    elif n == "datetime":
+        if isinstance(v, str):
+            return Datetime.parse(v)
+        if isinstance(v, int):
+            import datetime as _dt
+
+            return Datetime(_dt.datetime.fromtimestamp(v, _dt.timezone.utc))
+    elif n == "duration":
+        if isinstance(v, str):
+            return Duration.parse(v)
+    elif n == "uuid":
+        if isinstance(v, str):
+            return Uuid(v)
+    elif n == "record":
+        if isinstance(v, str):
+            from surrealdb_tpu.syn.parser import parse_record_literal
+            from surrealdb_tpu.exec.static_eval import static_value
+
+            return static_value(parse_record_literal(v))
+    elif n == "array":
+        if isinstance(v, list):
+            return [cast(x, kind.inner[0]) for x in v] if kind.inner else v
+        if isinstance(v, Range):
+            try:
+                return list(v.iter_ints())
+            except TypeError:
+                pass
+        return [v]
+    elif n == "set":
+        base = v if isinstance(v, list) else [v]
+        out = []
+        for x in base:
+            if kind.inner:
+                x = cast(x, kind.inner[0])
+            if not any(value_eq(x, y) for y in out):
+                out.append(x)
+        return out
+    elif n == "bytes":
+        if isinstance(v, str):
+            return v.encode("utf-8")
+    elif n == "regex":
+        if isinstance(v, str):
+            return Regex(v)
+    elif n == "geometry" or n == "point":
+        if isinstance(v, dict):
+            g = object_to_geometry(v)
+            if g is not None:
+                return g
+    raise SdbError(
+        f"Expected a {kind_name(kind)} but cannot convert {render(v)} into a {kind_name(kind)}"
+    )

@@ -1,0 +1,85 @@
+"""Execution context (reference: core/src/ctx/ + dbs/options.rs).
+
+A lightweight chain: each scope (statement, document, closure) gets a child
+context sharing the datastore/transaction handles, with its own variable
+bindings and current-document pointer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from surrealdb_tpu.err import SdbError
+
+
+class Ctx:
+    __slots__ = (
+        "ds", "session", "txn", "vars", "doc", "doc_id", "parent_doc",
+        "executor", "ns", "db", "knn", "record_cache", "deadline", "depth",
+        "perms_enabled", "version", "_cond_consumed", "_cf_seq",
+    )
+
+    def __init__(self, ds, session, txn, executor=None):
+        self.ds = ds
+        self.session = session
+        self.txn = txn
+        self.executor = executor
+        self.vars: dict[str, Any] = {}
+        self.doc = None  # current document value ($this)
+        self.doc_id = None  # RecordId of current document
+        self.parent_doc = None
+        self.ns = session.ns
+        self.db = session.db
+        self.knn: Optional[dict] = None  # record-key -> distance (KnnContext)
+        self.record_cache: dict = {}
+        self.deadline: Optional[float] = None
+        self.depth = 0
+        self.perms_enabled = False  # row-level permissions active
+        self.version = None  # VERSION clause timestamp
+        self._cond_consumed = False  # planner handled the WHERE clause
+        self._cf_seq = 0
+
+    def child(self) -> "Ctx":
+        c = Ctx.__new__(Ctx)
+        c.ds = self.ds
+        c.session = self.session
+        c.txn = self.txn
+        c.executor = self.executor
+        c.vars = dict(self.vars)
+        c.doc = self.doc
+        c.doc_id = self.doc_id
+        c.parent_doc = self.parent_doc
+        c.ns = self.ns
+        c.db = self.db
+        c.knn = self.knn
+        c.record_cache = self.record_cache
+        c.deadline = self.deadline
+        c.depth = self.depth + 1
+        c.perms_enabled = self.perms_enabled
+        c.version = self.version
+        c._cond_consumed = False
+        c._cf_seq = 0
+        if c.depth > 32:
+            raise SdbError("Max computation depth exceeded")
+        return c
+
+    def with_doc(self, doc, doc_id=None) -> "Ctx":
+        c = self.child()
+        c.parent_doc = self.doc
+        c.doc = doc
+        c.doc_id = doc_id
+        c.vars["parent"] = self.doc
+        c.vars["this"] = doc
+        return c
+
+    def check_deadline(self):
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            raise SdbError("The query was not executed because it exceeded the timeout")
+
+    def need_ns_db(self):
+        if not self.ns or not self.db:
+            raise SdbError(
+                "Specify a namespace and database to use"
+            )
+        return self.ns, self.db

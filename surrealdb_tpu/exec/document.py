@@ -1,0 +1,919 @@
+"""Document write pipeline.
+
+Stage order mirrors the reference (doc/mod.rs:12-37): process → alter →
+field(schema) → check(perms) → store → edges → index → changefeeds → event →
+lives → table(views) → pluck(output). One function per statement kind drives
+the shared pipeline.
+"""
+
+from __future__ import annotations
+
+from surrealdb_tpu import key as K
+from surrealdb_tpu.catalog import TableDef
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.exec.coerce import coerce
+from surrealdb_tpu.exec.context import Ctx
+from surrealdb_tpu.exec.eval import evaluate, fetch_record, generate_record_key, walk
+from surrealdb_tpu.expr.ast import (
+    ContentData,
+    Idiom,
+    MergeData,
+    OutputClause,
+    PatchData,
+    PAll,
+    PField,
+    ReplaceData,
+    SetData,
+    UnsetData,
+)
+from surrealdb_tpu.kvs.api import deserialize, serialize
+from surrealdb_tpu.val import (
+    NONE,
+    Range,
+    RecordId,
+    Table,
+    copy_value,
+    is_truthy,
+    render,
+    value_eq,
+)
+
+# ---------------------------------------------------------------------------
+# data clause application
+# ---------------------------------------------------------------------------
+
+
+def apply_data(doc: dict, data, ctx: Ctx, rid=None):
+    """Apply SET/UNSET/CONTENT/MERGE/REPLACE/PATCH to a doc (mutates copy)."""
+    if data is None:
+        return doc
+    if isinstance(data, (ContentData, ReplaceData)):
+        v = evaluate(data.expr, ctx)
+        if not isinstance(v, dict):
+            raise SdbError(f"Cannot use {render(v)} as CONTENT data")
+        out = copy_value(v)
+        out.pop("id", None)
+        if "id" in doc:
+            out["id"] = doc["id"]
+        return out
+    if isinstance(data, MergeData):
+        v = evaluate(data.expr, ctx)
+        if not isinstance(v, dict):
+            raise SdbError(f"Cannot use {render(v)} as MERGE data")
+        out = copy_value(doc)
+        _deep_merge(out, copy_value(v))
+        if "id" in doc:
+            out["id"] = doc["id"]
+        return out
+    if isinstance(data, PatchData):
+        from surrealdb_tpu.utils.patch import apply_patch
+
+        ops = evaluate(data.expr, ctx)
+        out = apply_patch(doc, ops)
+        if "id" in doc:
+            out["id"] = doc["id"]
+        return out
+    if isinstance(data, SetData):
+        out = copy_value(doc)
+        c = ctx.with_doc(out, rid)
+        for target, op, expr in data.items:
+            v = evaluate(expr, c)
+            path = _idiom_path(target)
+            if path == ["id"] and "id" in out:
+                if not value_eq(v, out["id"]):
+                    raise SdbError("Can not change the id of a record")
+                continue
+            if op == "=":
+                _set_path_value(out, path, v, ctx)
+            elif op == "+=":
+                cur = _get_path_value(out, path)
+                _set_path_value(out, path, _add_assign(cur, v), ctx)
+            elif op == "-=":
+                cur = _get_path_value(out, path)
+                _set_path_value(out, path, _sub_assign(cur, v), ctx)
+            elif op == "+?=":
+                cur = _get_path_value(out, path)
+                if isinstance(cur, list):
+                    if not any(value_eq(x, v) for x in cur):
+                        _set_path_value(out, path, cur + [v], ctx)
+                elif cur is NONE or cur is None:
+                    _set_path_value(out, path, [v], ctx)
+            elif op == "*=":
+                from surrealdb_tpu.exec.operators import mul
+
+                cur = _get_path_value(out, path)
+                _set_path_value(out, path, mul(cur, v), ctx)
+        return out
+    if isinstance(data, UnsetData):
+        out = copy_value(doc)
+        for f in data.fields:
+            path = _idiom_path(f)
+            _del_path_value(out, path)
+        return out
+    raise SdbError(f"unhandled data clause {data!r}")
+
+
+def _add_assign(cur, v):
+    if cur is NONE or cur is None:
+        if isinstance(v, list):
+            return v
+        return [v] if False else v
+    if isinstance(cur, list):
+        return cur + (v if isinstance(v, list) else [v])
+    from surrealdb_tpu.exec.operators import add
+
+    return add(cur, v)
+
+
+def _sub_assign(cur, v):
+    if cur is NONE or cur is None:
+        from surrealdb_tpu.exec.operators import neg
+
+        try:
+            return neg(v)
+        except SdbError:
+            return NONE
+    from surrealdb_tpu.exec.operators import sub
+
+    return sub(cur, v)
+
+
+def _deep_merge(dst: dict, src: dict):
+    for k, v in src.items():
+        if v is NONE:
+            dst.pop(k, None)
+        elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+
+
+def _idiom_path(target):
+    if isinstance(target, Idiom):
+        path = []
+        for p in target.parts:
+            if isinstance(p, PField):
+                path.append(p.name)
+            elif isinstance(p, PAll):
+                path.append("*")
+            elif hasattr(p, "expr"):
+                from surrealdb_tpu.expr.ast import PIndex
+
+                if isinstance(p, PIndex):
+                    path.append(("idx", p.expr))
+                else:
+                    raise SdbError("Unsupported assignment target")
+            else:
+                raise SdbError("Unsupported assignment target")
+        return path
+    raise SdbError("Unsupported assignment target")
+
+
+def _set_path_value(doc, path, v, ctx):
+    cur = doc
+    for i, seg in enumerate(path[:-1]):
+        if seg == "*":
+            if isinstance(cur, list):
+                for item in cur:
+                    _set_path_value(item, path[i + 1 :], v, ctx)
+            return
+        if isinstance(seg, tuple):
+            idx = int(evaluate(seg[1], ctx))
+            if isinstance(cur, list) and -len(cur) <= idx < len(cur):
+                cur = cur[idx]
+                continue
+            return
+        nxt = cur.get(seg) if isinstance(cur, dict) else None
+        if not isinstance(nxt, (dict, list)):
+            nxt = {}
+            if isinstance(cur, dict):
+                cur[seg] = nxt
+            else:
+                return
+        cur = nxt
+    last = path[-1]
+    if last == "*":
+        if isinstance(cur, list):
+            for i in range(len(cur)):
+                cur[i] = v
+        return
+    if isinstance(last, tuple):
+        idx = int(evaluate(last[1], ctx))
+        if isinstance(cur, list) and -len(cur) <= idx < len(cur):
+            cur[idx] = v
+        return
+    if isinstance(cur, dict):
+        cur[last] = v
+    elif isinstance(cur, list):
+        for item in cur:
+            if isinstance(item, dict):
+                item[last] = v
+
+
+def _get_path_value(doc, path):
+    cur = doc
+    for seg in path:
+        if seg == "*":
+            return cur
+        if isinstance(seg, tuple):
+            return NONE
+        if isinstance(cur, dict):
+            cur = cur.get(seg, NONE)
+        elif isinstance(cur, list):
+            cur = [x.get(seg, NONE) if isinstance(x, dict) else NONE for x in cur]
+        else:
+            return NONE
+    return cur
+
+
+def _del_path_value(doc, path):
+    cur = doc
+    for seg in path[:-1]:
+        if isinstance(cur, dict):
+            cur = cur.get(seg)
+        else:
+            return
+    if isinstance(cur, dict) and isinstance(path[-1], str):
+        cur.pop(path[-1], None)
+
+
+# ---------------------------------------------------------------------------
+# table / schema helpers
+# ---------------------------------------------------------------------------
+
+
+def get_table(tb: str, ctx: Ctx, create=True) -> TableDef:
+    ns, db = ctx.need_ns_db()
+    tdef = ctx.txn.get_val(K.tb_def(ns, db, tb))
+    if tdef is None:
+        if not create:
+            raise SdbError(f"The table '{tb}' does not exist")
+        if ctx.ds.strict:
+            raise SdbError(f"The table '{tb}' does not exist")
+        from surrealdb_tpu.exec.statements import _ensure_ns_db
+
+        _ensure_ns_db(ctx)
+        tdef = TableDef(name=tb)
+        ctx.txn.set_val(K.tb_def(ns, db, tb), tdef)
+    return tdef
+
+
+def get_fields(tb: str, ctx: Ctx):
+    ns, db = ctx.need_ns_db()
+    out = [d for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.fd_prefix(ns, db, tb)))]
+    out.sort(key=lambda f: len(f.name))
+    return out
+
+
+def get_indexes(tb: str, ctx: Ctx):
+    ns, db = ctx.need_ns_db()
+    return [d for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ix_prefix(ns, db, tb)))]
+
+
+def get_events(tb: str, ctx: Ctx):
+    ns, db = ctx.need_ns_db()
+    return [d for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ev_prefix(ns, db, tb)))]
+
+
+def apply_fields(
+    tb: str, tdef: TableDef, before, after: dict, ctx: Ctx, rid, is_create: bool
+):
+    """Field-definition stage: defaults, VALUE, TYPE coercion, ASSERT,
+    READONLY, schemafull pruning (reference doc/field.rs + doc/alter.rs)."""
+    fields = get_fields(tb, ctx)
+    defined_top = set()
+    for fd in fields:
+        path = [p.name if isinstance(p, PField) else "*" for p in fd.name]
+        if path:
+            defined_top.add(path[0])
+        for tgt_doc, old_doc in _field_targets(after, before, path[:-1]):
+            last = path[-1]
+            if last == "*":
+                continue
+            if not isinstance(tgt_doc, dict):
+                continue
+            cur = tgt_doc.get(last, NONE)
+            old = (
+                old_doc.get(last, NONE)
+                if isinstance(old_doc, dict)
+                else NONE
+            )
+            c = ctx.with_doc(after, rid)
+            c.vars["input"] = cur
+            c.vars["value"] = cur
+            c.vars["before"] = old
+            c.vars["after"] = cur
+            # DEFAULT
+            if cur is NONE and fd.default is not None and (
+                is_create or fd.default_always
+            ):
+                cur = evaluate(fd.default, c)
+                c.vars["value"] = cur
+                c.vars["after"] = cur
+            # VALUE (always evaluated when set)
+            if fd.value is not None:
+                cur = evaluate(fd.value, c)
+                c.vars["value"] = cur
+                c.vars["after"] = cur
+            # READONLY
+            if fd.readonly and not is_create:
+                if cur is not NONE and old is not NONE and not value_eq(cur, old):
+                    raise SdbError(
+                        f"Found changed value for field `{fd.name_str}`, with record `{rid.render()}`, but field is readonly"
+                    )
+                if old is not NONE:
+                    cur = old
+            # TYPE coercion
+            if fd.kind is not None:
+                try:
+                    cur = coerce(cur, fd.kind)
+                except SdbError as e:
+                    raise SdbError(
+                        f"Couldn't coerce value for field `{fd.name_str}` of `{rid.render()}`: {e}"
+                    )
+            # ASSERT
+            if fd.assert_ is not None and cur is not NONE:
+                c.vars["value"] = cur
+                if not is_truthy(evaluate(fd.assert_, c)):
+                    raise SdbError(
+                        f"Found {render(cur)} for field `{fd.name_str}`, with record `{rid.render()}`, but field must conform to: {'ASSERT'}"
+                    )
+            if cur is NONE:
+                tgt_doc.pop(last, None)
+            else:
+                tgt_doc[last] = cur
+    # SCHEMAFULL pruning
+    if tdef.full:
+        flex_roots = {
+            (f.name[0].name if f.name and isinstance(f.name[0], PField) else "")
+            for f in fields
+            if f.flex
+        }
+        keep = defined_top | {"id", "in", "out"}
+        for k in list(after.keys()):
+            if k not in keep and k not in flex_roots:
+                after.pop(k)
+    return after
+
+
+def _field_targets(after, before, parent_path):
+    """Yield (container, old_container) pairs for a field's parent path,
+    expanding `*` over arrays."""
+    pairs = [(after, before)]
+    for seg in parent_path:
+        nxt = []
+        for doc, old in pairs:
+            if seg == "*":
+                if isinstance(doc, list):
+                    for i, item in enumerate(doc):
+                        olditem = (
+                            old[i]
+                            if isinstance(old, list) and i < len(old)
+                            else NONE
+                        )
+                        nxt.append((item, olditem))
+            else:
+                if isinstance(doc, dict):
+                    sub = doc.get(seg)
+                    if sub is None or sub is NONE:
+                        continue
+                    oldsub = old.get(seg, NONE) if isinstance(old, dict) else NONE
+                    nxt.append((sub, oldsub))
+        pairs = nxt
+    return pairs
+
+
+# ---------------------------------------------------------------------------
+# index maintenance
+# ---------------------------------------------------------------------------
+
+
+def _index_values(idef, doc, ctx, rid):
+    c = ctx.with_doc(doc, rid)
+    vals = [evaluate(col, c) for col in idef.cols]
+    return vals
+
+
+def _index_rows(vals):
+    """Expand array columns into one row per element (flattening)."""
+    rows = [[]]
+    for v in vals:
+        if isinstance(v, list):
+            new_rows = []
+            for r in rows:
+                for x in v:
+                    new_rows.append(r + [x])
+            rows = new_rows if v else [r + [NONE] for r in rows]
+        else:
+            rows = [r + [v] for r in rows]
+    return rows
+
+
+def index_update(rid: RecordId, before, after, ctx: Ctx):
+    """Remove old entries / add new for every index on the table
+    (reference idx/index.rs IndexOperation)."""
+    ns, db = ctx.need_ns_db()
+    for idef in get_indexes(rid.tb, ctx):
+        if idef.hnsw is not None:
+            from surrealdb_tpu.idx.vector import vector_index_update
+
+            vector_index_update(idef, rid, before, after, ctx)
+            continue
+        if idef.fulltext is not None:
+            from surrealdb_tpu.idx.fulltext import fulltext_index_update
+
+            fulltext_index_update(idef, rid, before, after, ctx)
+            continue
+        old_rows = (
+            _index_rows(_index_values(idef, before, ctx, rid))
+            if isinstance(before, dict)
+            else []
+        )
+        new_rows = (
+            _index_rows(_index_values(idef, after, ctx, rid))
+            if isinstance(after, dict)
+            else []
+        )
+        if idef.count:
+            key = K.ix_state(ns, db, rid.tb, idef.name, b"ct")
+            cur = ctx.txn.get_val(key) or 0
+            delta = (1 if isinstance(after, dict) else 0) - (
+                1 if isinstance(before, dict) else 0
+            )
+            ctx.txn.set_val(key, cur + delta)
+            continue
+        if idef.unique:
+            for row in old_rows:
+                if all(x is NONE or x is None for x in row):
+                    continue
+                k = K.index_unique(ns, db, rid.tb, idef.name, row)
+                existing = ctx.txn.get_val(k)
+                if existing is not None and value_eq(existing, rid):
+                    ctx.txn.delete(k)
+            for row in new_rows:
+                if all(x is NONE or x is None for x in row):
+                    continue  # NONE values are not indexed in unique indexes
+                k = K.index_unique(ns, db, rid.tb, idef.name, row)
+                existing = ctx.txn.get_val(k)
+                if existing is not None and not value_eq(existing, rid):
+                    vals = row[0] if len(row) == 1 else row
+                    raise SdbError(
+                        f"Database index `{idef.name}` already contains "
+                        f"{render(vals)}, with record `{existing.render()}`"
+                    )
+                ctx.txn.set_val(k, rid)
+        else:
+            for row in old_rows:
+                ctx.txn.delete(K.index(ns, db, rid.tb, idef.name, row, rid.id))
+            for row in new_rows:
+                ctx.txn.set(
+                    K.index(ns, db, rid.tb, idef.name, row, rid.id), b"\x00"
+                )
+
+
+def build_index(idef, ctx: Ctx):
+    """Index an existing table's records (DEFINE INDEX on populated table)."""
+    ns, db = ctx.need_ns_db()
+    beg, end = K.prefix_range(K.record_prefix(ns, db, idef.tb))
+    for k, raw in list(ctx.txn.scan(beg, end)):
+        _ns, _db, _tb, idv = K.decode_record_id(k)
+        rid = RecordId(idef.tb, idv)
+        doc = deserialize(raw)
+        one = type(
+            "IDef", (), {}
+        )  # reuse index_update for a single index by temporary filtering
+        # inline: perform same logic for just this idef
+        _single_index_add(idef, rid, doc, ctx)
+
+
+def _single_index_add(idef, rid, doc, ctx):
+    ns, db = ctx.need_ns_db()
+    if idef.hnsw is not None:
+        from surrealdb_tpu.idx.vector import vector_index_update
+
+        vector_index_update(idef, rid, NONE, doc, ctx)
+        return
+    if idef.fulltext is not None:
+        from surrealdb_tpu.idx.fulltext import fulltext_index_update
+
+        fulltext_index_update(idef, rid, NONE, doc, ctx)
+        return
+    if idef.count:
+        key = K.ix_state(ns, db, rid.tb, idef.name, b"ct")
+        cur = ctx.txn.get_val(key) or 0
+        ctx.txn.set_val(key, cur + 1)
+        return
+    rows = _index_rows(_index_values(idef, doc, ctx, rid))
+    if idef.unique:
+        for row in rows:
+            if all(x is NONE or x is None for x in row):
+                continue
+            k = K.index_unique(ns, db, rid.tb, idef.name, row)
+            existing = ctx.txn.get_val(k)
+            if existing is not None and not value_eq(existing, rid):
+                vals = row[0] if len(row) == 1 else row
+                raise SdbError(
+                    f"Database index `{idef.name}` already contains "
+                    f"{render(vals)}, with record `{existing.render()}`"
+                )
+            ctx.txn.set_val(k, rid)
+    else:
+        for row in rows:
+            ctx.txn.set(K.index(ns, db, rid.tb, idef.name, row, rid.id), b"\x00")
+
+
+# ---------------------------------------------------------------------------
+# events / changefeeds / live queries / views
+# ---------------------------------------------------------------------------
+
+
+def run_events(rid, before, after, action, ctx: Ctx):
+    events = get_events(rid.tb, ctx)
+    if not events:
+        return
+    from surrealdb_tpu.exec.statements import eval_statement
+
+    for ev in events:
+        c = ctx.with_doc(after if isinstance(after, dict) else before, rid)
+        c.vars["event"] = action
+        c.vars["before"] = before if before is not NONE else NONE
+        c.vars["after"] = after if after is not NONE else NONE
+        c.vars["value"] = after if isinstance(after, dict) else before
+        if ev.when is not None and not is_truthy(evaluate(ev.when, c)):
+            continue
+        for stmt in ev.then:
+            eval_statement(stmt, c)
+
+
+def write_changefeed(rid, before, after, action, ctx: Ctx):
+    ns, db = ctx.need_ns_db()
+    tdef = ctx.txn.get_val(K.tb_def(ns, db, rid.tb))
+    dbdef = ctx.txn.get_val(K.db_def(ns, db))
+    enabled = (tdef is not None and tdef.changefeed is not None) or (
+        dbdef is not None and dbdef.changefeed is not None
+    )
+    if not enabled:
+        return
+    vs = ctx.ds.next_versionstamp()
+    seq = ctx._cf_seq
+    ctx._cf_seq = seq + 1
+    entry = {
+        "action": action,
+        "rid": rid,
+        "before": before if (tdef and tdef.changefeed_original) else NONE,
+        "after": after,
+    }
+    ctx.txn.set_val(K.changefeed(ns, db, vs, rid.tb, seq), entry)
+
+
+def notify_lives(rid, before, after, action, ctx: Ctx):
+    """Live-query matching (doc/lives.rs:29 process_table_lives)."""
+    ns, db = ctx.need_ns_db()
+    subs = [
+        s
+        for s in ctx.ds.live_queries.values()
+        if s.ns == ns and s.db == db and s.tb == rid.tb
+    ]
+    if not subs:
+        return
+    from surrealdb_tpu.kvs.ds import Notification
+
+    doc = after if action != "DELETE" else before
+    for sub in subs:
+        c = ctx.with_doc(doc, rid)
+        c.vars.update(sub.session_vars)
+        c.vars["before"] = before
+        c.vars["after"] = after
+        c.vars["event"] = action
+        if sub.cond is not None and not is_truthy(evaluate(sub.cond, c)):
+            continue
+        if sub.expr == "diff":
+            from surrealdb_tpu.utils.patch import diff
+
+            payload = diff(
+                before if isinstance(before, dict) else {},
+                after if isinstance(after, dict) else {},
+            )
+        elif isinstance(sub.expr, list):
+            if len(sub.expr) == 1 and sub.expr[0][0] == "*":
+                payload = copy_value(doc)
+            else:
+                from surrealdb_tpu.exec.statements import expr_name
+
+                payload = {}
+                for expr, alias in sub.expr:
+                    if expr == "*":
+                        if isinstance(doc, dict):
+                            payload.update(copy_value(doc))
+                        continue
+                    payload[alias or expr_name(expr)] = evaluate(expr, c)
+        else:
+            payload = copy_value(doc)
+        ctx.ds.notify(Notification(sub.id, action, rid, payload))
+
+
+def update_views(rid, ctx: Ctx):
+    """Refresh materialized views that source from this table."""
+    ns, db = ctx.need_ns_db()
+    for _k, tdef in ctx.txn.scan_vals(*K.prefix_range(K.tb_prefix(ns, db))):
+        if tdef.view is None:
+            continue
+        sel = tdef.view
+        froms = []
+        for w in getattr(sel, "what", []):
+            if isinstance(w, Idiom) and len(w.parts) == 1 and isinstance(
+                w.parts[0], PField
+            ):
+                froms.append(w.parts[0].name)
+        if rid.tb in froms:
+            rebuild_view(tdef, ctx)
+
+
+def rebuild_view(tdef: TableDef, ctx: Ctx):
+    from surrealdb_tpu.exec.statements import _s_select
+
+    ns, db = ctx.need_ns_db()
+    # clear existing view rows
+    ctx.txn.delete_range(*K.prefix_range(K.record_prefix(ns, db, tdef.name)))
+    rows = _s_select(tdef.view, ctx.child())
+    if not isinstance(rows, list):
+        rows = [rows]
+    group = getattr(tdef.view, "group", None)
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            continue
+        if group:
+            from surrealdb_tpu.exec.statements import expr_name
+
+            gvals = []
+            for g in group:
+                name = expr_name(g)
+                gvals.append(row.get(name, NONE))
+            rid = RecordId(tdef.name, gvals if len(gvals) != 1 else [gvals[0]])
+        elif isinstance(row.get("id"), RecordId):
+            rid = RecordId(tdef.name, row["id"].id)
+        else:
+            rid = RecordId(tdef.name, i)
+        nd = copy_value(row)
+        nd["id"] = rid
+        ctx.txn.set(K.record(ns, db, tdef.name, rid.id), serialize(nd))
+
+
+# ---------------------------------------------------------------------------
+# output shaping
+# ---------------------------------------------------------------------------
+
+
+def shape_output(output: OutputClause, before, after, rid, ctx: Ctx):
+    if output is None or output.kind == "after":
+        return copy_value(after) if after is not NONE else NONE
+    k = output.kind
+    if k == "none":
+        return NONE
+    if k == "null":
+        return None
+    if k == "before":
+        return copy_value(before) if before is not NONE else NONE
+    if k == "diff":
+        from surrealdb_tpu.utils.patch import diff
+
+        return diff(
+            before if isinstance(before, dict) else {},
+            after if isinstance(after, dict) else {},
+        )
+    if k in ("fields", "value"):
+        from surrealdb_tpu.exec.statements import expr_name
+
+        doc = after if after is not NONE else before
+        c = ctx.with_doc(doc, rid)
+        c.vars["before"] = before
+        c.vars["after"] = after
+        if k == "value":
+            return evaluate(output.fields[0][0], c)
+        out = {}
+        for expr, alias in output.fields:
+            if expr == "*":
+                if isinstance(doc, dict):
+                    out.update(copy_value(doc))
+                continue
+            out[alias or expr_name(expr)] = evaluate(expr, c)
+        return out
+    return copy_value(after)
+
+
+# ---------------------------------------------------------------------------
+# the pipeline driver
+# ---------------------------------------------------------------------------
+
+
+def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
+    """Shared store stages: schema, perms, write, edges, indexes, cf, events,
+    lives, views, output."""
+    ns, db = ctx.need_ns_db()
+    tdef = get_table(rid.tb, ctx)
+    is_create = action == "CREATE"
+    # relation-table checks
+    if tdef.kind == "relation" and edge is None and is_create and (
+        not isinstance(after.get("in"), RecordId)
+        or not isinstance(after.get("out"), RecordId)
+    ):
+        raise SdbError(
+            f"Found record: `{rid.render()}` which is a relation, but you are attempting to create a normal record"
+        )
+    if tdef.kind == "normal" and edge is not None:
+        raise SdbError(
+            f"Unable to write edge data to table `{rid.tb}` as it is not a relation table"
+        )
+    # permissions
+    if not ctx.session.is_owner and ctx.session.auth_level not in ("editor",):
+        from surrealdb_tpu.exec.statements import check_table_permission
+
+        act = "create" if is_create else "update"
+        if not check_table_permission(rid.tb, act, ctx, after, rid):
+            raise SdbError(
+                f"Not enough permissions to perform this action on table '{rid.tb}'"
+            )
+    # field schema
+    after = apply_fields(rid.tb, tdef, before, after, ctx, rid, is_create)
+    after["id"] = rid
+    # edges stage (RELATE): enforce + write `~` keys + in/out fields
+    if edge is not None:
+        l, r = edge
+        if tdef.enforced:
+            if fetch_record(ctx, l) is NONE:
+                raise SdbError(f"The record '{l.render()}' does not exist")
+            if fetch_record(ctx, r) is NONE:
+                raise SdbError(f"The record '{r.render()}' does not exist")
+        after["in"] = l
+        after["out"] = r
+        # the four graph keys (reference doc/edges.rs:14)
+        ctx.txn.set(K.graph(ns, db, l.tb, l.id, K.DIR_OUT, rid.tb, rid.id), b"")
+        ctx.txn.set(K.graph(ns, db, rid.tb, rid.id, K.DIR_IN, l.tb, l.id), b"")
+        ctx.txn.set(K.graph(ns, db, rid.tb, rid.id, K.DIR_OUT, r.tb, r.id), b"")
+        ctx.txn.set(K.graph(ns, db, r.tb, r.id, K.DIR_IN, rid.tb, rid.id), b"")
+    # store (drop tables discard writes but still run the rest)
+    if not tdef.drop:
+        ctx.txn.set(K.record(ns, db, rid.tb, rid.id), serialize(after))
+        ctx.record_cache[(rid.tb, K.enc_value(rid.id))] = after
+    # indexes
+    index_update(rid, before, after, ctx)
+    # changefeed
+    write_changefeed(rid, before, after, action, ctx)
+    # events
+    run_events(rid, before, after, action, ctx)
+    # live queries
+    notify_lives(rid, before, after, action, ctx)
+    # views
+    update_views(rid, ctx)
+    return shape_output(output, before, after, rid, ctx)
+
+
+def create_one(target, data, output, ctx: Ctx, upsert=False):
+    """CREATE one target (table name / record id)."""
+    if isinstance(target, Table):
+        rid = RecordId(target.name, generate_record_key())
+    elif isinstance(target, RecordId):
+        if isinstance(target.id, Range):
+            raise SdbError(f"Cannot CREATE a record range")
+        rid = target
+    elif isinstance(target, str):
+        rid = RecordId(target, generate_record_key())
+    else:
+        raise SdbError(f"Cannot CREATE {render(target)}")
+    # data may override the id (CREATE person SET id = person:x)
+    doc = apply_data({"id": rid}, data, ctx, rid)
+    nid = doc.get("id")
+    if isinstance(nid, RecordId):
+        if nid.tb != rid.tb or not value_eq(nid.id, rid.id):
+            if isinstance(target, Table) or isinstance(target, str):
+                rid = nid if nid.tb else RecordId(rid.tb, nid.id)
+            else:
+                raise SdbError("Can not change the id of a record")
+    elif nid is not None and nid is not NONE:
+        rid = RecordId(rid.tb, nid)
+    doc["id"] = rid
+    existing = fetch_record(ctx, rid)
+    if existing is not NONE:
+        raise SdbError(
+            f"Database record `{rid.render()}` already exists"
+        )
+    return _store_record(rid, NONE, doc, ctx, "CREATE", output)
+
+
+def insert_one(into, doc, ignore, update, output, ctx: Ctx):
+    rid = doc.get("id")
+    if isinstance(rid, RecordId):
+        if into and rid.tb != into:
+            rid = RecordId(into, rid.id)
+    elif rid is not None and rid is not NONE:
+        if into is None:
+            raise SdbError("INSERT statement requires a table")
+        rid = RecordId(into, rid)
+    else:
+        if into is None:
+            raise SdbError("INSERT statement requires a table")
+        rid = RecordId(into, generate_record_key())
+    doc = copy_value(doc)
+    doc["id"] = rid
+    existing = fetch_record(ctx, rid)
+    if existing is not NONE:
+        if ignore:
+            return NONE
+        if update is not None:
+            from surrealdb_tpu.expr.ast import SetData
+
+            c = ctx.with_doc(existing, rid)
+            c.vars["input"] = doc
+            newdoc = apply_data(existing, SetData(update), c, rid)
+            return _store_record(rid, existing, newdoc, ctx, "UPDATE", output)
+        raise SdbError(f"Database record `{rid.render()}` already exists")
+    return _store_record(rid, NONE, doc, ctx, "CREATE", output)
+
+
+def relate_insert_one(into, doc, ignore, output, ctx: Ctx):
+    l = doc.get("in")
+    r = doc.get("out")
+    if not isinstance(l, RecordId) or not isinstance(r, RecordId):
+        raise SdbError("INSERT RELATION requires `in` and `out` record ids")
+    rid = doc.get("id")
+    if isinstance(rid, RecordId):
+        pass
+    elif rid is not None and rid is not NONE and into:
+        rid = RecordId(into, rid)
+    else:
+        if into is None:
+            raise SdbError("INSERT RELATION requires a table")
+        rid = RecordId(into, generate_record_key())
+    doc = copy_value(doc)
+    doc["id"] = rid
+    existing = fetch_record(ctx, rid)
+    if existing is not NONE:
+        if ignore:
+            return NONE
+        raise SdbError(f"Database record `{rid.render()}` already exists")
+    return _store_record(rid, NONE, doc, ctx, "CREATE", output, edge=(l, r))
+
+
+def update_one(rid: RecordId, before: dict, data, output, ctx: Ctx):
+    c = ctx.with_doc(before, rid)
+    after = apply_data(before, data, c, rid)
+    after["id"] = rid
+    return _store_record(rid, before, after, ctx, "UPDATE", output)
+
+
+def delete_one(rid: RecordId, before, output, ctx: Ctx):
+    ns, db = ctx.need_ns_db()
+    if not ctx.session.is_owner and ctx.session.auth_level not in ("editor",):
+        from surrealdb_tpu.exec.statements import check_table_permission
+
+        if not check_table_permission(rid.tb, "delete", ctx, before, rid):
+            raise SdbError(
+                f"Not enough permissions to perform this action on table '{rid.tb}'"
+            )
+    ctx.txn.delete(K.record(ns, db, rid.tb, rid.id))
+    ctx.record_cache.pop((rid.tb, K.enc_value(rid.id)), None)
+    # purge graph edges; cascade delete edge records hanging off this node
+    from surrealdb_tpu.graph import purge_edges
+
+    edges = purge_edges(rid, ctx)
+    is_edge = isinstance(before, dict) and isinstance(
+        before.get("in"), RecordId
+    ) and isinstance(before.get("out"), RecordId)
+    if not is_edge:
+        for erid in edges:
+            edoc = fetch_record(ctx, erid)
+            if isinstance(edoc, dict) and isinstance(edoc.get("in"), RecordId):
+                delete_one(erid, edoc, OutputClause("none"), ctx)
+    index_update(rid, before, NONE, ctx)
+    write_changefeed(rid, before, NONE, "DELETE", ctx)
+    run_events(rid, before, NONE, "DELETE", ctx)
+    notify_lives(rid, before, NONE, "DELETE", ctx)
+    update_views(rid, ctx)
+    if output is None:
+        return NONE
+    return shape_output(output, before, NONE, rid, ctx)
+
+
+def relate_one(kind, fr: RecordId, to: RecordId, data, output, ctx: Ctx, uniq=False):
+    if isinstance(kind, Table):
+        tb = kind.name
+        rid = RecordId(tb, generate_record_key())
+    elif isinstance(kind, RecordId):
+        rid = kind
+        tb = kind.tb
+    elif isinstance(kind, str):
+        tb = kind
+        rid = RecordId(tb, generate_record_key())
+    else:
+        raise SdbError(f"Cannot use {render(kind)} as a RELATE target")
+    doc = apply_data({"id": rid}, data, ctx, rid)
+    nid = doc.get("id")
+    if isinstance(nid, RecordId) and (nid.tb != rid.tb or not value_eq(nid.id, rid.id)):
+        rid = nid
+    doc["id"] = rid
+    existing = fetch_record(ctx, rid)
+    before = existing if existing is not NONE else NONE
+    return _store_record(
+        rid, before, doc, ctx, "CREATE" if before is NONE else "UPDATE",
+        output, edge=(fr, to)
+    )

@@ -1,0 +1,703 @@
+"""Expression evaluation + idiom walking.
+
+Reference semantics: core/src/expr/ (every node's compute()), expr/part.rs
+(idiom part application), expr/lookup.rs (graph steps). Single-value scalar
+path; the batched/TPU paths live in idx/ and graph/ and are entered from the
+planner, not from here.
+"""
+
+from __future__ import annotations
+
+import random as _random
+
+from surrealdb_tpu import key as K
+from surrealdb_tpu.catalog import ParamDef
+from surrealdb_tpu.err import ReturnException, SdbError
+from surrealdb_tpu.exec.coerce import cast, coerce
+from surrealdb_tpu.exec.context import Ctx
+from surrealdb_tpu.exec.operators import binary_op, neg
+from surrealdb_tpu.expr.ast import *  # noqa: F401,F403
+from surrealdb_tpu.val import (
+    NONE,
+    Closure,
+    Geometry,
+    Range,
+    RecordId,
+    Regex,
+    Table,
+    Uuid,
+    copy_value,
+    is_truthy,
+    value_eq,
+)
+
+_ID_CHARS = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+
+def generate_record_key(kind: str = "__gen_rand__"):
+    if kind == "__gen_uuid__":
+        return Uuid.new_v7()
+    if kind == "__gen_ulid__":
+        import os
+        import time
+
+        # Crockford base32 ULID
+        t = int(time.time() * 1000)
+        rand = int.from_bytes(os.urandom(10), "big")
+        alph = "0123456789ABCDEFGHJKMNPQRSTVWXYZ"
+        out = []
+        for shift in range(45, -5, -5):
+            out.append(alph[(t >> shift) & 31])
+        for shift in range(75, -5, -5):
+            out.append(alph[(rand >> shift) & 31])
+        return "".join(out)
+    return "".join(_random.choices(_ID_CHARS, k=20))
+
+
+def fetch_record(ctx: Ctx, rid: RecordId):
+    """Fetch a record document (NONE if missing); caches within a statement."""
+    ck = (rid.tb, K.enc_value(rid.id))
+    hit = ctx.record_cache.get(ck)
+    if hit is not None:
+        return hit
+    ns, db = ctx.need_ns_db()
+    raw = ctx.txn.get(K.record(ns, db, rid.tb, rid.id))
+    if raw is None:
+        doc = NONE
+    else:
+        from surrealdb_tpu.kvs.api import deserialize
+
+        doc = deserialize(raw)
+    ctx.record_cache[ck] = doc
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# evaluate
+# ---------------------------------------------------------------------------
+
+
+def evaluate(node, ctx: Ctx):
+    t = type(node)
+    fn = _DISPATCH.get(t)
+    if fn is None:
+        # statements in expression position
+        from surrealdb_tpu.exec import statements as st
+
+        return st.eval_statement(node, ctx)
+    return fn(node, ctx)
+
+
+def _e_literal(n, ctx):
+    v = n.value
+    if type(v) is list or type(v) is dict:
+        return copy_value(v)
+    return v
+
+
+def _e_param(n, ctx):
+    name = n.name
+    if name in ctx.vars:
+        return ctx.vars[name]
+    if name == "this":
+        return ctx.doc if ctx.doc is not None else NONE
+    if name == "parent":
+        return ctx.parent_doc if ctx.parent_doc is not None else NONE
+    if name == "session":
+        return _session_value(ctx)
+    if name == "auth":
+        return ctx.session.rid if ctx.session.rid is not None else NONE
+    if name == "token":
+        return ctx.vars.get("token", NONE)
+    if name == "access":
+        return ctx.session.ac if ctx.session.ac is not None else NONE
+    # DEFINE PARAM lookup
+    if ctx.ns and ctx.db:
+        pd = ctx.txn.get_val(K.pa_def(ctx.ns, ctx.db, name))
+        if isinstance(pd, ParamDef):
+            return pd.value
+    return NONE
+
+
+def _session_value(ctx):
+    s = ctx.session
+    return {
+        "ac": s.ac if s.ac else None,
+        "db": s.db,
+        "exp": None,
+        "id": None,
+        "ip": None,
+        "ns": s.ns,
+        "or": None,
+        "rd": s.rid if s.rid else None,
+        "tk": None,
+    }
+
+
+def _e_array(n, ctx):
+    return [evaluate(x, ctx) for x in n.items]
+
+
+def _e_object(n, ctx):
+    return {k: evaluate(v, ctx) for k, v in n.items}
+
+
+def _e_recordid(n, ctx):
+    idexpr = n.id
+    if isinstance(idexpr, RangeExpr):
+        rng = _e_range(idexpr, ctx)
+        return RecordId(n.tb, rng)
+    v = evaluate(idexpr, ctx) if idexpr is not None else None
+    if isinstance(v, str) and v.startswith("__gen_") and v.endswith("__"):
+        v = generate_record_key(v)
+    if isinstance(v, (float,)):
+        if v.is_integer():
+            v = int(v)
+    if isinstance(v, RecordId):
+        v = v.id
+    return RecordId(n.tb, v)
+
+
+def _e_range(n, ctx):
+    beg = evaluate(n.beg, ctx) if n.beg is not None else NONE
+    end = evaluate(n.end, ctx) if n.end is not None else NONE
+    return Range(beg, end, n.beg_incl, n.end_incl)
+
+
+def _e_binary(n, ctx):
+    op = n.op
+    if op == "&&":
+        lhs = evaluate(n.lhs, ctx)
+        if not is_truthy(lhs):
+            return lhs if isinstance(lhs, bool) else False
+        rhs = evaluate(n.rhs, ctx)
+        return rhs if isinstance(rhs, bool) else is_truthy(rhs) and rhs or rhs
+    if op == "||":
+        lhs = evaluate(n.lhs, ctx)
+        if is_truthy(lhs):
+            return lhs
+        return evaluate(n.rhs, ctx)
+    if op == "??":
+        lhs = evaluate(n.lhs, ctx)
+        if lhs is not NONE and lhs is not None:
+            return lhs
+        return evaluate(n.rhs, ctx)
+    if op == "?:":
+        lhs = evaluate(n.lhs, ctx)
+        if is_truthy(lhs):
+            return lhs
+        return evaluate(n.rhs, ctx)
+    if op == "@@":
+        return _eval_matches(n, ctx)
+    lhs = evaluate(n.lhs, ctx)
+    rhs = evaluate(n.rhs, ctx)
+    return binary_op(op, lhs, rhs)
+
+
+def _eval_matches(n, ctx):
+    """text @@ query — full-text match via the index (fnc/search path)."""
+    from surrealdb_tpu.idx.fulltext import matches_operator
+
+    return matches_operator(n, ctx)
+
+
+def _e_prefix(n, ctx):
+    v = evaluate(n.expr, ctx)
+    if n.op == "-":
+        return neg(v)
+    if n.op == "+":
+        return v
+    if n.op == "!":
+        return not is_truthy(v)
+    raise SdbError(f"unknown prefix {n.op}")
+
+
+def _e_knn(n, ctx):
+    """Bare <|k|> evaluation: check the planner-filled KnnContext."""
+    if ctx.knn is not None and ctx.doc_id is not None:
+        from surrealdb_tpu.val import hashable
+
+        return hashable(ctx.doc_id) in ctx.knn
+    # no index context: brute compare is meaningless per-row; treat as false
+    return False
+
+
+def _e_cast(n, ctx):
+    return cast(evaluate(n.expr, ctx), n.kind)
+
+
+def _e_constant(n, ctx):
+    import math as m
+
+    from surrealdb_tpu.val import Datetime, Duration
+
+    name = n.name
+    table = {
+        "math::pi": m.pi, "math::e": m.e, "math::tau": m.tau,
+        "math::inf": m.inf, "math::neg_inf": -m.inf, "math::nan": m.nan,
+        "math::frac_1_pi": 1 / m.pi, "math::frac_1_sqrt_2": 1 / m.sqrt(2),
+        "math::frac_2_pi": 2 / m.pi, "math::frac_2_sqrt_pi": 2 / m.sqrt(m.pi),
+        "math::frac_pi_2": m.pi / 2, "math::frac_pi_3": m.pi / 3,
+        "math::frac_pi_4": m.pi / 4, "math::frac_pi_6": m.pi / 6,
+        "math::frac_pi_8": m.pi / 8, "math::ln_10": m.log(10),
+        "math::ln_2": m.log(2), "math::log10_2": m.log10(2),
+        "math::log10_e": m.log10(m.e), "math::log2_10": m.log2(10),
+        "math::log2_e": m.log2(m.e), "math::sqrt_2": m.sqrt(2),
+    }
+    if name in table:
+        return table[name]
+    if name == "time::epoch":
+        import datetime as _dt
+
+        return Datetime(_dt.datetime.fromtimestamp(0, _dt.timezone.utc))
+    if name == "time::minimum":
+        return Datetime.parse("-262143-01-01T00:00:00Z") if False else Datetime.parse("1000-01-01T00:00:00")
+    if name == "time::maximum":
+        return Datetime.parse("9999-12-31T23:59:59")
+    if name == "duration::max":
+        from surrealdb_tpu.val import Duration as D
+
+        return D((1 << 63) - 1)
+    # unknown bare path — treat as an idiom over the current doc? error.
+    raise SdbError(f"unknown constant or function {name!r}")
+
+
+def _e_function(n, ctx):
+    from surrealdb_tpu.fnc import call_function
+
+    return call_function(n, ctx)
+
+
+def _e_closure(n, ctx):
+    return Closure(n.params, n.body, n.returns)
+
+
+def call_closure(clo: Closure, args: list, ctx: Ctx):
+    c = ctx.child()
+    for i, (pname, pkind) in enumerate(clo.params):
+        v = args[i] if i < len(args) else NONE
+        if pkind is not None:
+            v = coerce(v, pkind)
+        c.vars[pname] = v
+    try:
+        out = evaluate(clo.body, c)
+    except ReturnException as r:
+        out = r.value
+    if clo.returns is not None:
+        out = coerce(out, clo.returns)
+    return out
+
+
+def _e_subquery(n, ctx):
+    from surrealdb_tpu.exec import statements as st
+
+    return st.eval_statement(n.stmt, ctx.child())
+
+
+def _e_block(n, ctx):
+    from surrealdb_tpu.exec import statements as st
+
+    c = ctx.child()
+    out = NONE
+    for s in n.stmts:
+        out = st.eval_statement(s, c)
+    return out
+
+
+def _e_ifelse(n, ctx):
+    from surrealdb_tpu.exec import statements as st
+
+    for cond, body in n.branches:
+        if is_truthy(evaluate(cond, ctx)):
+            return st.eval_statement(body, ctx)
+    if n.otherwise is not None:
+        return st.eval_statement(n.otherwise, ctx)
+    return NONE
+
+
+def _e_regex(n, ctx):
+    return Regex(n.pattern)
+
+
+def _e_mock(n, ctx):
+    out = []
+    if n.end is None:
+        for _ in range(n.beg):
+            out.append(RecordId(n.tb, generate_record_key()))
+    else:
+        for i in range(n.beg, n.end + 1):
+            out.append(RecordId(n.tb, i))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Idiom walking
+# ---------------------------------------------------------------------------
+
+
+def _e_idiom(n, ctx):
+    parts = n.parts
+    if not parts:
+        return NONE
+    first = parts[0]
+    if isinstance(first, tuple) and first[0] == "start":
+        val = evaluate(first[1], ctx)
+        rest = parts[1:]
+    elif isinstance(first, PGraph):
+        # graph step from the current record
+        val = ctx.doc_id if ctx.doc_id is not None else _doc_id_of(ctx)
+        if val is None:
+            return NONE
+        rest = parts
+    elif isinstance(first, PField):
+        name = first.name
+        if name == "@":
+            val = ctx.doc_id if ctx.doc_id is not None else ctx.doc
+            rest = parts[1:]
+        else:
+            doc = ctx.doc
+            if doc is None:
+                return NONE
+            val = _get_field(doc, name, ctx)
+            rest = parts[1:]
+    elif isinstance(first, PAll):
+        val = ctx.doc
+        rest = parts[1:]
+    else:
+        val = ctx.doc
+        rest = parts
+    return walk(val, rest, ctx)
+
+
+def _doc_id_of(ctx):
+    doc = ctx.doc
+    if isinstance(doc, dict):
+        rid = doc.get("id")
+        if isinstance(rid, RecordId):
+            return rid
+    return None
+
+
+def _get_field(doc, name, ctx):
+    if isinstance(doc, dict):
+        return doc.get(name, NONE)
+    if isinstance(doc, RecordId):
+        sub = fetch_record(ctx, doc)
+        if isinstance(sub, dict):
+            return sub.get(name, NONE)
+        return NONE
+    if isinstance(doc, Geometry):
+        obj = doc.to_object()
+        return obj.get(name, NONE)
+    if isinstance(doc, list):
+        return [_get_field(x, name, ctx) for x in doc]
+    if isinstance(doc, Range):
+        if name == "begin" or name == "beg":
+            return doc.beg
+        if name == "end":
+            return doc.end
+    return NONE
+
+
+def walk(val, parts, ctx: Ctx, depth=0):
+    for i, part in enumerate(parts):
+        t = type(part)
+        if t is PField:
+            val = _apply_field(val, part.name, ctx)
+        elif t is PAll:
+            if isinstance(val, dict):
+                val = list(val.values())
+            elif isinstance(val, list):
+                val = [
+                    walk(x, parts[i + 1 :], ctx, depth + 1) for x in val
+                ]
+                return val
+            elif isinstance(val, RecordId):
+                val = fetch_record(ctx, val)
+                if val is NONE:
+                    return NONE
+                continue
+            elif val is NONE or val is None:
+                return NONE
+        elif t is PIndex:
+            idx = evaluate(part.expr, ctx)
+            val = _apply_index(val, idx, ctx)
+        elif t is PLast:
+            if isinstance(val, list):
+                val = val[-1] if val else NONE
+            else:
+                val = NONE
+        elif t is PWhere:
+            if isinstance(val, list):
+                out = []
+                for x in val:
+                    item = x
+                    if isinstance(x, RecordId):
+                        item = fetch_record(ctx, x)
+                    c = ctx.with_doc(item, x if isinstance(x, RecordId) else None)
+                    if is_truthy(evaluate(part.cond, c)):
+                        out.append(x)
+                val = out
+            elif isinstance(val, (dict, RecordId)):
+                item = val
+                if isinstance(val, RecordId):
+                    item = fetch_record(ctx, val)
+                c = ctx.with_doc(item, val if isinstance(val, RecordId) else None)
+                if not is_truthy(evaluate(part.cond, c)):
+                    val = NONE
+            else:
+                val = NONE
+        elif t is PMethod:
+            val = _apply_method(val, part, ctx)
+        elif t is PGraph:
+            val = _apply_graph(val, part, ctx)
+            # graph results are lists; subsequent field parts map over them
+        elif t is PFlatten:
+            if isinstance(val, list):
+                out = []
+                for x in val:
+                    if isinstance(x, list):
+                        out.extend(x)
+                    else:
+                        out.append(x)
+                val = out
+        elif t is PDestructure:
+            val = _apply_destructure(val, part, ctx)
+        elif t is POptional:
+            if val is NONE or val is None:
+                return NONE
+        elif t is PRecurse:
+            val = _apply_recurse(val, part, parts[i + 1 :], ctx)
+            return val
+        else:
+            raise SdbError(f"unhandled idiom part {part!r}")
+    return val
+
+
+def _apply_field(val, name, ctx):
+    if isinstance(val, dict):
+        return val.get(name, NONE)
+    if isinstance(val, list):
+        return [_apply_field(x, name, ctx) for x in val]
+    if isinstance(val, RecordId):
+        doc = fetch_record(ctx, val)
+        if isinstance(doc, dict):
+            if name == "id":
+                return doc.get("id", val)
+            return doc.get(name, NONE)
+        if name == "id":
+            return val
+        return NONE
+    if isinstance(val, Geometry):
+        if name == "type":
+            return val.kind
+        if name == "coordinates":
+            from surrealdb_tpu.val import _coords_list
+
+            return _coords_list(val.coords)
+        return NONE
+    if isinstance(val, Range):
+        if name in ("begin", "beg"):
+            return val.beg
+        if name == "end":
+            return val.end
+        return NONE
+    return NONE
+
+
+def _apply_index(val, idx, ctx):
+    if isinstance(val, list):
+        if isinstance(idx, bool):
+            return NONE
+        if isinstance(idx, (int, float)):
+            i = int(idx)
+            if -len(val) <= i < len(val):
+                return val[i]
+            return NONE
+        if isinstance(idx, Range):
+            try:
+                beg = idx.beg if isinstance(idx.beg, int) else 0
+                end = idx.end if isinstance(idx.end, int) else len(val)
+                if not idx.beg_incl:
+                    beg += 1
+                if idx.end_incl:
+                    end += 1
+                return val[beg:end]
+            except TypeError:
+                return NONE
+        return NONE
+    if isinstance(val, dict):
+        if isinstance(idx, str):
+            return val.get(idx, NONE)
+        if isinstance(idx, (int, float)) and not isinstance(idx, bool):
+            return val.get(str(int(idx)), NONE)
+        return NONE
+    if isinstance(val, RecordId):
+        doc = fetch_record(ctx, val)
+        return _apply_index(doc, idx, ctx) if doc is not NONE else NONE
+    if isinstance(val, str):
+        if isinstance(idx, (int, float)) and not isinstance(idx, bool):
+            i = int(idx)
+            if -len(val) <= i < len(val):
+                return val[i]
+        return NONE
+    return NONE
+
+
+def _apply_method(val, part, ctx):
+    from surrealdb_tpu.fnc import method_call
+
+    # field holding a closure?
+    if isinstance(val, dict):
+        f = val.get(part.name)
+        if isinstance(f, Closure):
+            args = [evaluate(a, ctx) for a in part.args]
+            return call_closure(f, args, ctx)
+    if isinstance(val, RecordId):
+        doc = fetch_record(ctx, val)
+        if isinstance(doc, dict):
+            f = doc.get(part.name)
+            if isinstance(f, Closure):
+                args = [evaluate(a, ctx) for a in part.args]
+                return call_closure(f, args, ctx)
+    args = [evaluate(a, ctx) for a in part.args]
+    return method_call(val, part.name, args, ctx)
+
+
+def _apply_graph(val, g: PGraph, ctx: Ctx):
+    """One graph hop: scan `~` keys of each source record (SURVEY §3.4)."""
+    rids = _collect_rids(val, ctx)
+    if not rids:
+        return []
+    from surrealdb_tpu.graph import traverse_hop
+
+    results = traverse_hop(rids, g, ctx)
+    if g.expr is not None:
+        # ->(SELECT ... ) projection step
+        from surrealdb_tpu.exec import statements as st
+
+        sub = g.expr
+        out = []
+        for rid in results:
+            doc = fetch_record(ctx, rid)
+            c = ctx.with_doc(doc, rid)
+            out.append(doc)
+        return results
+    return results
+
+
+def _collect_rids(val, ctx):
+    out = []
+    if isinstance(val, RecordId):
+        out.append(val)
+    elif isinstance(val, dict):
+        rid = val.get("id")
+        if isinstance(rid, RecordId):
+            out.append(rid)
+    elif isinstance(val, list):
+        for x in val:
+            out.extend(_collect_rids(x, ctx))
+    return out
+
+
+def _apply_destructure(val, part: PDestructure, ctx):
+    if isinstance(val, list):
+        return [_apply_destructure(x, part, ctx) for x in val]
+    if isinstance(val, RecordId):
+        val = fetch_record(ctx, val)
+    if not isinstance(val, dict):
+        return NONE
+    out = {}
+    for name, sub in part.fields:
+        if sub is None:
+            out[name] = val.get(name, NONE)
+        else:
+            c = ctx.with_doc(val, None)
+            out[name] = evaluate(sub, c)
+    return out
+
+
+def _apply_recurse(val, part: PRecurse, tail, ctx):
+    """Bounded recursion `.{min..max}(parts)` over graph-ish steps."""
+    rmin = part.min if part.min is not None else 1
+    rmax = part.max if part.max is not None else 16
+    rmax = min(rmax, 256)
+    parts = part.parts if part.parts else tail
+    if not parts:
+        return NONE
+    current = val
+    collected = []
+    seen = set()
+    from surrealdb_tpu.val import hashable
+
+    depth = 0
+    result_at_depth = NONE
+    while depth < rmax:
+        nxt = walk(current, parts, ctx)
+        depth += 1
+        if isinstance(nxt, list):
+            flat = []
+            for x in nxt:
+                if isinstance(x, list):
+                    flat.extend(x)
+                else:
+                    flat.append(x)
+            uniq = []
+            for x in flat:
+                if x is NONE or x is None:
+                    continue
+                h = hashable(x)
+                if h not in seen:
+                    seen.add(h)
+                    uniq.append(x)
+            nxt = uniq
+            if not nxt:
+                if depth <= rmin:
+                    return NONE if part.max == part.min else collected
+                break
+        elif nxt is NONE or nxt is None:
+            if depth < rmin:
+                return NONE
+            break
+        current = nxt
+        result_at_depth = nxt
+        if depth >= rmin:
+            if isinstance(nxt, list):
+                collected.extend(nxt)
+            else:
+                collected.append(nxt)
+    if part.min is not None and part.max == part.min:
+        # fixed depth: return the frontier at that depth
+        return result_at_depth
+    if part.max is None and part.min == 1 and part.instruction is None:
+        return collected
+    if part.instruction is None:
+        return collected
+    return collected
+
+
+# ---------------------------------------------------------------------------
+# dispatch table
+# ---------------------------------------------------------------------------
+
+_DISPATCH = {
+    Literal: _e_literal,
+    Param: _e_param,
+    ArrayExpr: _e_array,
+    ObjectExpr: _e_object,
+    RecordIdLit: _e_recordid,
+    RangeExpr: _e_range,
+    Binary: _e_binary,
+    Prefix: _e_prefix,
+    Knn: _e_knn,
+    FunctionCall: _e_function,
+    Cast: _e_cast,
+    Constant: _e_constant,
+    ClosureExpr: _e_closure,
+    Subquery: _e_subquery,
+    BlockExpr: _e_block,
+    IfElse: _e_ifelse,
+    RegexLit: _e_regex,
+    Mock: _e_mock,
+    Idiom: _e_idiom,
+}

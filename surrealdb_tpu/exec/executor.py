@@ -1,0 +1,136 @@
+"""Statement loop + transaction management (reference: dbs/executor.rs).
+
+Each statement outside BEGIN/COMMIT runs in its own transaction; inside an
+explicit transaction all statements share one, and a failure poisons the
+remainder until COMMIT/CANCEL (reference Executor behaviour)."""
+
+from __future__ import annotations
+
+import time
+
+from surrealdb_tpu.err import (
+    BreakException,
+    ContinueException,
+    ReturnException,
+    SdbError,
+    ThrownError,
+)
+from surrealdb_tpu.exec.context import Ctx
+from surrealdb_tpu.exec.statements import eval_statement
+from surrealdb_tpu.expr.ast import (
+    BeginStmt,
+    CancelStmt,
+    CommitStmt,
+    LetStmt,
+    OptionStmt,
+    UseStmt,
+)
+from surrealdb_tpu.kvs.ds import QueryResult
+from surrealdb_tpu.val import NONE
+
+
+class Executor:
+    def __init__(self, ds, session):
+        self.ds = ds
+        self.session = session
+
+    def execute(self, stmts: list, vars: dict) -> list[QueryResult]:
+        results: list[QueryResult] = []
+        txn = None  # explicit transaction, if open
+        failed = False  # explicit txn poisoned
+        buffered: list[int] = []  # result idxs inside current explicit txn
+        shared_vars = dict(self.session.variables)
+        shared_vars.update(vars)
+        for stmt in stmts:
+            t0 = time.perf_counter_ns()
+            if isinstance(stmt, BeginStmt):
+                if txn is None:
+                    txn = self.ds.transaction(write=True)
+                    failed = False
+                    buffered = []
+                continue
+            if isinstance(stmt, CommitStmt):
+                if txn is not None:
+                    if failed:
+                        txn.cancel()
+                        for i in buffered:
+                            if results[i].error is None:
+                                results[i] = QueryResult(
+                                    error="The query was not executed due to a failed transaction"
+                                )
+                    else:
+                        txn.commit()
+                    txn = None
+                continue
+            if isinstance(stmt, CancelStmt):
+                if txn is not None:
+                    txn.cancel()
+                    for i in buffered:
+                        results[i] = QueryResult(
+                            error="The query was not executed due to a cancelled transaction"
+                        )
+                    txn = None
+                continue
+            if txn is not None and failed:
+                results.append(
+                    QueryResult(
+                        error="The query was not executed due to a failed transaction"
+                    )
+                )
+                buffered.append(len(results) - 1)
+                continue
+            own_txn = txn is None
+            cur = txn or self.ds.transaction(write=True)
+            ctx = Ctx(self.ds, self.session, cur, executor=self)
+            ctx.vars.update(shared_vars)
+            try:
+                cur.new_save_point()
+                out = eval_statement(stmt, ctx)
+                cur.release_last_save_point()
+                # persist session-level vars (LET/USE at top level)
+                if isinstance(stmt, (LetStmt,)):
+                    shared_vars = dict(ctx.vars)
+                    self.session.variables[stmt.name] = ctx.vars.get(stmt.name)
+                elif isinstance(stmt, UseStmt):
+                    pass  # session mutated in place
+                if own_txn:
+                    cur.commit()
+                results.append(
+                    QueryResult(result=out, time_ns=time.perf_counter_ns() - t0)
+                )
+                if not own_txn:
+                    buffered.append(len(results) - 1)
+            except ReturnException as r:
+                if own_txn:
+                    cur.commit()
+                results.append(
+                    QueryResult(result=r.value, time_ns=time.perf_counter_ns() - t0)
+                )
+                if not own_txn:
+                    buffered.append(len(results) - 1)
+            except (BreakException, ContinueException):
+                msg = "Break statement has been reached in an invalid position"
+                if own_txn:
+                    cur.cancel()
+                results.append(QueryResult(error=msg))
+            except (SdbError, ThrownError) as e:
+                if own_txn:
+                    cur.cancel()
+                else:
+                    cur.rollback_to_save_point()
+                    failed = True
+                results.append(QueryResult(error=str(e)))
+                if not own_txn:
+                    buffered.append(len(results) - 1)
+            except RecursionError:
+                if own_txn:
+                    cur.cancel()
+                results.append(QueryResult(error="Max computation depth exceeded"))
+        if txn is not None:
+            # unterminated explicit transaction: cancel
+            txn.cancel()
+            for i in buffered:
+                results[i] = QueryResult(
+                    error="The query was not executed due to a cancelled transaction"
+                )
+        return results

@@ -1,0 +1,466 @@
+"""Binary / unary operator semantics (reference: expr/operator.rs + val ops)."""
+
+from __future__ import annotations
+
+import math
+from decimal import Decimal
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.val import (
+    NONE,
+    Datetime,
+    Duration,
+    Geometry,
+    Range,
+    RecordId,
+    Regex,
+    Table,
+    Uuid,
+    is_truthy,
+    render,
+    value_cmp,
+    value_eq,
+)
+
+_NUM = (int, float, Decimal)
+
+
+def to_string(v) -> str:
+    """String conversion used by <string> cast and string concat."""
+    if isinstance(v, str):
+        return v
+    if v is NONE:
+        return "NONE"
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if math.isinf(v):
+            return "Infinity" if v > 0 else "-Infinity"
+        if v == int(v) and abs(v) < 1e15:
+            return f"{int(v)}"
+        return repr(v)
+    if isinstance(v, Decimal):
+        return str(v)
+    if isinstance(v, Duration):
+        return v.render()
+    if isinstance(v, Datetime):
+        return v.render()
+    if isinstance(v, Uuid):
+        return str(v.u)
+    if isinstance(v, RecordId):
+        return v.render()
+    if isinstance(v, Table):
+        return v.name
+    return render(v)
+
+
+def _num2(a, b):
+    """Promote a pair of numbers: int+int->int, any decimal->decimal, else float."""
+    if isinstance(a, bool) or isinstance(b, bool):
+        raise SdbError("cannot perform arithmetic on booleans")
+    if isinstance(a, Decimal) or isinstance(b, Decimal):
+        return (
+            a if isinstance(a, Decimal) else Decimal(str(a)),
+            b if isinstance(b, Decimal) else Decimal(str(b)),
+        )
+    return a, b
+
+
+def add(a, b):
+    if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
+        a, b = _num2(a, b)
+        return a + b
+    if isinstance(a, str) and isinstance(b, str):
+        return a + b
+    if isinstance(a, Datetime) and isinstance(b, Duration):
+        import datetime as _dt
+
+        total = a.epoch_ns() + b.ns
+        secs, frac = divmod(total, 1_000_000_000)
+        return Datetime(_dt.datetime.fromtimestamp(secs, _dt.timezone.utc), frac)
+    if isinstance(a, Duration) and isinstance(b, Datetime):
+        return add(b, a)
+    if isinstance(a, Duration) and isinstance(b, Duration):
+        return a + b
+    if isinstance(a, list) and isinstance(b, list):
+        return a + b
+    if isinstance(a, list):
+        return a + [b]
+    if isinstance(b, list):
+        return [a] + b
+    if isinstance(a, dict) and isinstance(b, dict):
+        out = dict(a)
+        out.update(b)
+        return out
+    raise SdbError(f"Cannot add {render(a)} and {render(b)}")
+
+
+def sub(a, b):
+    if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
+        a, b = _num2(a, b)
+        return a - b
+    if isinstance(a, Datetime) and isinstance(b, Duration):
+        import datetime as _dt
+
+        total = a.epoch_ns() - b.ns
+        secs, frac = divmod(total, 1_000_000_000)
+        return Datetime(_dt.datetime.fromtimestamp(secs, _dt.timezone.utc), frac)
+    if isinstance(a, Datetime) and isinstance(b, Datetime):
+        return Duration(abs(a.epoch_ns() - b.epoch_ns()))
+    if isinstance(a, Duration) and isinstance(b, Duration):
+        return a - b
+    if isinstance(a, list) and isinstance(b, list):
+        return [x for x in a if not any(value_eq(x, y) for y in b)]
+    if isinstance(a, list):
+        return [x for x in a if not value_eq(x, b)]
+    raise SdbError(f"Cannot subtract {render(b)} from {render(a)}")
+
+
+def mul(a, b):
+    if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
+        a, b = _num2(a, b)
+        return a * b
+    raise SdbError(f"Cannot multiply {render(a)} and {render(b)}")
+
+
+def div(a, b):
+    if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
+        a, b = _num2(a, b)
+        try:
+            if isinstance(a, int) and isinstance(b, int):
+                if b == 0:
+                    return NONE
+                if a % b == 0:
+                    return a // b
+                return a / b
+            if isinstance(a, Decimal):
+                if b == 0:
+                    return NONE
+                return a / b
+            if b == 0:
+                if a == 0:
+                    return float("nan")
+                return float("inf") if a > 0 else float("-inf")
+            return a / b
+        except (ZeroDivisionError, ArithmeticError):
+            return NONE
+    raise SdbError(f"Cannot divide {render(a)} by {render(b)}")
+
+
+def rem(a, b):
+    if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
+        a, b = _num2(a, b)
+        try:
+            if b == 0:
+                return NONE
+            return math.fmod(a, b) if isinstance(a, float) or isinstance(b, float) else a % b
+        except (ZeroDivisionError, ArithmeticError):
+            return NONE
+    raise SdbError(f"Cannot modulo {render(a)} by {render(b)}")
+
+
+def pow_(a, b):
+    if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
+        a, b = _num2(a, b)
+        try:
+            r = a ** b
+            if isinstance(r, complex):
+                return float("nan")
+            return r
+        except (OverflowError, ArithmeticError):
+            return float("inf")
+    raise SdbError(f"Cannot raise {render(a)} to {render(b)}")
+
+
+def neg(a):
+    if isinstance(a, _NUM) and not isinstance(a, bool):
+        return -a
+    if isinstance(a, Duration):
+        return a
+    raise SdbError(f"Cannot negate {render(a)}")
+
+
+# -- equality / fuzzy matching ----------------------------------------------
+
+
+def exact_eq(a, b) -> bool:
+    return value_eq(a, b)
+
+
+def fuzzy_match(a, b) -> bool:
+    """~ operator: fuzzy string match (reference uses a fuzzy matcher)."""
+    if isinstance(a, str) and isinstance(b, str):
+        return _fuzzy(b.lower(), a.lower())
+    if isinstance(a, Regex) and isinstance(b, str):
+        return a.rx.search(b) is not None
+    if isinstance(b, Regex) and isinstance(a, str):
+        return b.rx.search(a) is not None
+    return value_eq(a, b)
+
+
+def _fuzzy(needle: str, hay: str) -> bool:
+    i = 0
+    for c in hay:
+        if i < len(needle) and needle[i] == c:
+            i += 1
+    return i == len(needle)
+
+
+def equal(a, b) -> bool:
+    if isinstance(a, Regex) and isinstance(b, str):
+        return a.rx.search(b) is not None
+    if isinstance(b, Regex) and isinstance(a, str):
+        return b.rx.search(a) is not None
+    return value_eq(a, b)
+
+
+def all_equal(a, b) -> bool:  # *=
+    if isinstance(a, list):
+        return all(equal(x, b) for x in a)
+    return equal(a, b)
+
+
+def any_equal(a, b) -> bool:  # ?=
+    if isinstance(a, list):
+        return any(equal(x, b) for x in a)
+    return equal(a, b)
+
+
+def contains(a, b) -> bool:
+    if isinstance(a, list):
+        return any(value_eq(x, b) for x in a)
+    if isinstance(a, str):
+        return isinstance(b, str) and b in a
+    if isinstance(a, dict):
+        return isinstance(b, str) and b in a
+    if isinstance(a, Range):
+        c1 = value_cmp(a.beg, b) if a.beg is not NONE else -1
+        c2 = value_cmp(b, a.end) if a.end is not NONE else -1
+        lo = c1 < 0 or (c1 == 0 and a.beg_incl)
+        hi = c2 < 0 or (c2 == 0 and a.end_incl)
+        return lo and hi
+    if isinstance(a, Geometry) and isinstance(b, Geometry):
+        return geo_contains(a, b)
+    return False
+
+
+def contains_all(a, b) -> bool:
+    if isinstance(a, (list, str, dict, Range)) and isinstance(b, list):
+        return all(contains(a, x) for x in b)
+    if isinstance(a, Geometry) and isinstance(b, list):
+        return all(isinstance(x, Geometry) and geo_contains(a, x) for x in b)
+    return False
+
+
+def contains_any(a, b) -> bool:
+    if isinstance(a, (list, str, dict, Range)) and isinstance(b, list):
+        return any(contains(a, x) for x in b)
+    if isinstance(a, Geometry) and isinstance(b, list):
+        return any(isinstance(x, Geometry) and geo_contains(a, x) for x in b)
+    return False
+
+
+def contains_none(a, b) -> bool:
+    if isinstance(a, (list, str, dict, Range)) and isinstance(b, list):
+        return not any(contains(a, x) for x in b)
+    return True
+
+
+def inside(a, b) -> bool:
+    if isinstance(b, Geometry) and isinstance(a, Geometry):
+        return geo_contains(b, a)
+    return contains(b, a)
+
+
+def all_inside(a, b) -> bool:
+    if isinstance(a, list):
+        return all(inside(x, b) for x in a)
+    return inside(a, b)
+
+
+def any_inside(a, b) -> bool:
+    if isinstance(a, list):
+        return any(inside(x, b) for x in a)
+    return inside(a, b)
+
+
+def none_inside(a, b) -> bool:
+    if isinstance(a, list):
+        return not any(inside(x, b) for x in a)
+    return not inside(a, b)
+
+
+def outside(a, b) -> bool:
+    if isinstance(a, Geometry) and isinstance(b, Geometry):
+        return not geo_intersects(a, b)
+    return not inside(a, b)
+
+
+def intersects(a, b) -> bool:
+    if isinstance(a, Geometry) and isinstance(b, Geometry):
+        return geo_intersects(a, b)
+    return False
+
+
+# -- geometry predicates (pure-python; small shapes) -------------------------
+
+
+def _points_of(g: Geometry):
+    k = g.kind
+    c = g.coords
+    if k == "Point":
+        return [c]
+    if k in ("LineString", "MultiPoint"):
+        return list(c)
+    if k in ("Polygon", "MultiLineString"):
+        return [p for ring in c for p in ring]
+    if k == "MultiPolygon":
+        return [p for poly in c for ring in poly for p in ring]
+    if k == "GeometryCollection":
+        return [p for g2 in c for p in _points_of(g2)]
+    return []
+
+
+def _point_in_ring(pt, ring) -> bool:
+    x, y = float(pt[0]), float(pt[1])
+    inside_flag = False
+    n = len(ring)
+    j = n - 1
+    for i in range(n):
+        xi, yi = float(ring[i][0]), float(ring[i][1])
+        xj, yj = float(ring[j][0]), float(ring[j][1])
+        if (yi > y) != (yj > y) and x < (xj - xi) * (y - yi) / (yj - yi) + xi:
+            inside_flag = not inside_flag
+        j = i
+    return inside_flag
+
+
+def _point_in_polygon(pt, poly) -> bool:
+    if not poly:
+        return False
+    if not _point_in_ring(pt, poly[0]):
+        return False
+    for hole in poly[1:]:
+        if _point_in_ring(pt, hole):
+            return False
+    return True
+
+
+def geo_contains(a: Geometry, b: Geometry) -> bool:
+    pts = _points_of(b)
+    if not pts:
+        return False
+    if a.kind == "Polygon":
+        return all(_point_in_polygon(p, a.coords) for p in pts)
+    if a.kind == "MultiPolygon":
+        return all(
+            any(_point_in_polygon(p, poly) for poly in a.coords) for p in pts
+        )
+    if a.kind == "Point":
+        return b.kind == "Point" and tuple(map(float, a.coords)) == tuple(
+            map(float, b.coords)
+        )
+    return False
+
+
+def geo_intersects(a: Geometry, b: Geometry) -> bool:
+    apolys = a.kind in ("Polygon", "MultiPolygon")
+    bpolys = b.kind in ("Polygon", "MultiPolygon")
+    if apolys:
+        polys = [a.coords] if a.kind == "Polygon" else list(a.coords)
+        if any(
+            any(_point_in_polygon(p, poly) for poly in polys)
+            for p in _points_of(b)
+        ):
+            return True
+    if bpolys:
+        polys = [b.coords] if b.kind == "Polygon" else list(b.coords)
+        if any(
+            any(_point_in_polygon(p, poly) for poly in polys)
+            for p in _points_of(a)
+        ):
+            return True
+    if not apolys and not bpolys:
+        pa = {tuple(map(float, p)) for p in _points_of(a)}
+        pb = {tuple(map(float, p)) for p in _points_of(b)}
+        return bool(pa & pb)
+    return False
+
+
+# -- dispatch ----------------------------------------------------------------
+
+
+def binary_op(op: str, a, b):
+    if op == "=" or op == "==":
+        if op == "==":
+            return exact_eq(a, b)
+        return equal(a, b)
+    if op == "!=":
+        return not equal(a, b)
+    if op == "?=":
+        return any_equal(a, b)
+    if op == "*=":
+        return all_equal(a, b)
+    if op == "~":
+        return fuzzy_match(b, a) if isinstance(b, (str, Regex)) else fuzzy_match(a, b)
+    if op == "!~":
+        return not binary_op("~", a, b)
+    if op == "?~":
+        if isinstance(a, list):
+            return any(binary_op("~", x, b) for x in a)
+        return binary_op("~", a, b)
+    if op == "*~":
+        if isinstance(a, list):
+            return all(binary_op("~", x, b) for x in a)
+        return binary_op("~", a, b)
+    if op == "<":
+        return value_cmp(a, b) < 0
+    if op == "<=":
+        return value_cmp(a, b) <= 0
+    if op == ">":
+        return value_cmp(a, b) > 0
+    if op == ">=":
+        return value_cmp(a, b) >= 0
+    if op == "+":
+        return add(a, b)
+    if op == "-":
+        return sub(a, b)
+    if op == "*":
+        return mul(a, b)
+    if op == "/":
+        return div(a, b)
+    if op == "%":
+        return rem(a, b)
+    if op == "**":
+        return pow_(a, b)
+    if op == "∋":
+        return contains(a, b)
+    if op == "∌":
+        return not contains(a, b)
+    if op == "⊇":
+        return contains_all(a, b)
+    if op == "containsany":
+        return contains_any(a, b)
+    if op == "containsnone":
+        return contains_none(a, b)
+    if op == "∈":
+        return inside(a, b)
+    if op == "∉":
+        return not inside(a, b)
+    if op == "⊆":
+        return all_inside(a, b)
+    if op == "anyinside":
+        return any_inside(a, b)
+    if op == "noneinside":
+        return none_inside(a, b)
+    if op == "outside":
+        return outside(a, b)
+    if op == "intersects":
+        return intersects(a, b)
+    raise SdbError(f"unknown operator {op!r}")

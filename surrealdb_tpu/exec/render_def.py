@@ -1,0 +1,241 @@
+"""Canonical SQL text for catalog definitions (INFO FOR output).
+
+Reference renders definitions back to their DEFINE statements; we do the
+same so INFO output is usable as an import script (kvs/export.rs)."""
+
+from __future__ import annotations
+
+from surrealdb_tpu.val import Duration, escape_ident
+
+
+def _expr_sql(node) -> str:
+    """Best-effort canonical text of an expression AST."""
+    from surrealdb_tpu.expr.ast import (
+        ArrayExpr,
+        Binary,
+        BlockExpr,
+        Cast,
+        Constant,
+        FunctionCall,
+        Idiom,
+        Knn,
+        Literal,
+        ObjectExpr,
+        Param,
+        PField,
+        Prefix,
+        RangeExpr,
+        RecordIdLit,
+        SelectStmt,
+        Subquery,
+    )
+    from surrealdb_tpu.val import render
+
+    if node is None:
+        return ""
+    if isinstance(node, Literal):
+        return render(node.value)
+    if isinstance(node, Param):
+        return f"${node.name}"
+    if isinstance(node, Binary):
+        op = {"&&": "AND", "||": "OR"}.get(node.op, node.op)
+        return f"{_expr_sql(node.lhs)} {op} {_expr_sql(node.rhs)}"
+    if isinstance(node, Prefix):
+        return f"{node.op}{_expr_sql(node.expr)}"
+    if isinstance(node, FunctionCall):
+        args = ", ".join(_expr_sql(a) for a in node.args)
+        return f"{node.name}({args})"
+    if isinstance(node, Idiom):
+        from surrealdb_tpu.exec.statements import expr_name
+
+        return expr_name(node)
+    if isinstance(node, ArrayExpr):
+        return "[" + ", ".join(_expr_sql(x) for x in node.items) + "]"
+    if isinstance(node, ObjectExpr):
+        inner = ", ".join(f"{k}: {_expr_sql(v)}" for k, v in node.items)
+        return "{ " + inner + " }"
+    if isinstance(node, RecordIdLit):
+        return f"{node.tb}:{_expr_sql(node.id)}"
+    if isinstance(node, Subquery):
+        return f"({_expr_sql(node.stmt)})"
+    if isinstance(node, BlockExpr):
+        return "{ " + "; ".join(_expr_sql(s) for s in node.stmts) + " }"
+    if isinstance(node, Constant):
+        return node.name
+    if isinstance(node, Cast):
+        return f"<{node.kind.name}> {_expr_sql(node.expr)}"
+    if isinstance(node, SelectStmt):
+        fields = ", ".join(
+            "*" if e == "*" else (_expr_sql(e) + (f" AS {a}" if a else ""))
+            for e, a in node.exprs
+        )
+        whats = ", ".join(_expr_sql(w) for w in node.what)
+        out = f"SELECT {fields} FROM {whats}"
+        if node.cond is not None:
+            out += f" WHERE {_expr_sql(node.cond)}"
+        if node.group is not None:
+            if node.group:
+                out += " GROUP BY " + ", ".join(_expr_sql(g) for g in node.group)
+            else:
+                out += " GROUP ALL"
+        return out
+    return str(node)
+
+
+def _kind_sql(kind) -> str:
+    from surrealdb_tpu.exec.coerce import kind_name
+
+    return kind_name(kind)
+
+
+def _perm_sql(p) -> str:
+    if p is True:
+        return "FULL"
+    if p is False or p is None:
+        return "NONE"
+    return f"WHERE {_expr_sql(p)}"
+
+
+def _perms_sql(perms) -> str:
+    if perms is None:
+        return "NONE"
+    parts = []
+    for action in ("select", "create", "update", "delete"):
+        parts.append(f"FOR {action} {_perm_sql(perms.get(action, False))}")
+    return ", ".join(parts)
+
+
+def render_ns(d) -> str:
+    return f"DEFINE NAMESPACE {escape_ident(d.name)}"
+
+
+def render_db(d) -> str:
+    out = f"DEFINE DATABASE {escape_ident(d.name)}"
+    if d.changefeed:
+        out += f" CHANGEFEED {Duration(d.changefeed).render()}"
+    return out
+
+
+def render_table(d) -> str:
+    out = f"DEFINE TABLE {escape_ident(d.name)}"
+    if d.drop:
+        out += " DROP"
+    out += " SCHEMAFULL" if d.full else " SCHEMALESS"
+    if d.kind == "relation":
+        out += " TYPE RELATION"
+        if d.relation_from:
+            out += " IN " + " | ".join(d.relation_from)
+        if d.relation_to:
+            out += " OUT " + " | ".join(d.relation_to)
+        if d.enforced:
+            out += " ENFORCED"
+    elif d.kind == "any":
+        out += " TYPE ANY"
+    else:
+        out += " TYPE NORMAL"
+    if d.view is not None:
+        out += f" AS {_expr_sql(d.view)}"
+    if d.changefeed:
+        out += f" CHANGEFEED {Duration(d.changefeed).render()}"
+    out += f" PERMISSIONS {_perms_sql(d.permissions)}"
+    return out
+
+
+def render_field(d, tb) -> str:
+    out = f"DEFINE FIELD {d.name_str} ON {escape_ident(tb)}"
+    if d.flex:
+        out += " FLEXIBLE"
+    if d.kind is not None:
+        out += f" TYPE {_kind_sql(d.kind)}"
+    if d.default is not None:
+        out += " DEFAULT"
+        if d.default_always:
+            out += " ALWAYS"
+        out += f" {_expr_sql(d.default)}"
+    if d.readonly:
+        out += " READONLY"
+    if d.value is not None:
+        out += f" VALUE {_expr_sql(d.value)}"
+    if d.assert_ is not None:
+        out += f" ASSERT {_expr_sql(d.assert_)}"
+    out += f" PERMISSIONS {_perms_sql(d.permissions) if d.permissions is not None else 'FULL'}"
+    return out
+
+
+def render_index(d) -> str:
+    out = f"DEFINE INDEX {escape_ident(d.name)} ON {escape_ident(d.tb)}"
+    if d.cols_str:
+        out += " FIELDS " + ", ".join(d.cols_str)
+    if d.unique:
+        out += " UNIQUE"
+    if d.count:
+        out += " COUNT"
+    if d.fulltext is not None:
+        ft = d.fulltext
+        out += f" FULLTEXT ANALYZER {ft.get('analyzer')}"
+        k1, b = ft.get("bm25", (1.2, 0.75))
+        out += f" BM25({k1},{b})"
+        if ft.get("highlights"):
+            out += " HIGHLIGHTS"
+    if d.hnsw is not None:
+        h = d.hnsw
+        dist = h.get("distance", "euclidean")
+        dist_s = (
+            f"MINKOWSKI {dist[1]}" if isinstance(dist, tuple) else dist.upper()
+        )
+        out += (
+            f" HNSW DIMENSION {h.get('dimension')} DIST {dist_s}"
+            f" TYPE {h.get('vector_type', 'f64').upper()}"
+            f" EFC {h.get('ef_construction', 150)} M {h.get('m', 12)}"
+        )
+    return out
+
+
+def render_event(d, tb) -> str:
+    then = ", ".join(_expr_sql(t) for t in d.then)
+    return (
+        f"DEFINE EVENT {escape_ident(d.name)} ON {escape_ident(tb)} "
+        f"WHEN {_expr_sql(d.when) if d.when is not None else 'true'} THEN ({then})"
+    )
+
+
+def render_param(d) -> str:
+    from surrealdb_tpu.val import render as vr
+
+    return f"DEFINE PARAM ${d.name} VALUE {vr(d.value)} PERMISSIONS {_perm_sql(d.permissions)}"
+
+
+def render_function(d) -> str:
+    args = ", ".join(f"${n}: {_kind_sql(k)}" for n, k in d.args)
+    return f"DEFINE FUNCTION fn::{d.name}({args}) {_expr_sql(d.block)}"
+
+
+def render_analyzer(d) -> str:
+    out = f"DEFINE ANALYZER {escape_ident(d.name)}"
+    if d.tokenizers:
+        out += " TOKENIZERS " + ",".join(t.upper() for t in d.tokenizers)
+    if d.filters:
+        fs = []
+        for f in d.filters:
+            if len(f) == 1:
+                fs.append(f[0].upper())
+            else:
+                fs.append(f"{f[0].upper()}({','.join(str(x) for x in f[1:])})")
+        out += " FILTERS " + ",".join(fs)
+    return out
+
+
+def render_user(d) -> str:
+    roles = ", ".join(r.upper() for r in d.roles)
+    return (
+        f"DEFINE USER {escape_ident(d.name)} ON {d.base.upper()} "
+        f"PASSHASH '{d.passhash}' ROLES {roles}"
+    )
+
+
+def render_access(d) -> str:
+    return f"DEFINE ACCESS {escape_ident(d.name)} ON {d.base.upper()} TYPE {d.kind.upper()}"
+
+
+def render_sequence(d) -> str:
+    return f"DEFINE SEQUENCE {escape_ident(d.name)} BATCH {d.batch} START {d.start}"

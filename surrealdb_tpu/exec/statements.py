@@ -1,0 +1,1559 @@
+"""Statement execution (reference: core/src/dbs/executor.rs + exec/planner.rs
+SELECT pipeline Scan→Filter→Split→Aggregate→Sort→Limit; write statements run
+the document pipeline in exec/document.py)."""
+
+from __future__ import annotations
+
+import random as _random
+import time
+
+from surrealdb_tpu import key as K
+from surrealdb_tpu.catalog import (
+    AccessDef,
+    AnalyzerDef,
+    DatabaseDef,
+    EventDef,
+    FieldDef,
+    FunctionDef,
+    IndexDef,
+    NamespaceDef,
+    ParamDef,
+    SequenceDef,
+    SubscriptionDef,
+    TableDef,
+    UserDef,
+)
+from surrealdb_tpu.err import (
+    BreakException,
+    ContinueException,
+    ReturnException,
+    SdbError,
+    ThrownError,
+)
+from surrealdb_tpu.exec.coerce import coerce
+from surrealdb_tpu.exec.context import Ctx
+from surrealdb_tpu.exec.eval import evaluate, fetch_record, walk
+from surrealdb_tpu.expr.ast import *  # noqa: F401,F403
+from surrealdb_tpu.val import (
+    NONE,
+    Range,
+    RecordId,
+    Table,
+    Uuid,
+    copy_value,
+    is_truthy,
+    render,
+    sort_key,
+    value_cmp,
+)
+
+# ---------------------------------------------------------------------------
+# statement dispatch (expression position)
+# ---------------------------------------------------------------------------
+
+
+def eval_statement(node, ctx: Ctx):
+    t = type(node)
+    fn = _STMTS.get(t)
+    if fn is not None:
+        return fn(node, ctx)
+    return evaluate(node, ctx)
+
+
+# ---------------------------------------------------------------------------
+# simple statements
+# ---------------------------------------------------------------------------
+
+
+def _s_let(n: LetStmt, ctx):
+    v = evaluate(n.what, ctx)
+    if n.kind is not None:
+        v = coerce(v, n.kind)
+    ctx.vars[n.name] = v
+    return NONE
+
+
+def _s_return(n: ReturnStmt, ctx):
+    v = evaluate(n.what, ctx)
+    if n.fetch:
+        v = apply_fetch(v, n.fetch, ctx)
+    raise ReturnException(v)
+
+
+def _s_if(n: IfStmt, ctx):
+    for cond, body in n.branches:
+        if is_truthy(evaluate(cond, ctx)):
+            return eval_statement(body, ctx)
+    if n.otherwise is not None:
+        return eval_statement(n.otherwise, ctx)
+    return NONE
+
+
+def _s_for(n: ForStmt, ctx):
+    rng = evaluate(n.range, ctx)
+    if isinstance(rng, Range):
+        try:
+            items = list(rng.iter_ints())
+        except TypeError:
+            raise SdbError("FOR range must have integer bounds")
+    elif isinstance(rng, list):
+        items = rng
+    elif isinstance(rng, dict):
+        items = list(rng.values())
+    else:
+        raise SdbError(f"Cannot iterate over {render(rng)} in a FOR loop")
+    for item in items:
+        c = ctx.child()
+        c.vars[n.param] = item
+        try:
+            eval_statement(n.body, c)
+        except BreakException:
+            break
+        except ContinueException:
+            continue
+    return NONE
+
+
+def _s_break(n, ctx):
+    raise BreakException()
+
+
+def _s_continue(n, ctx):
+    raise ContinueException()
+
+
+def _s_throw(n: ThrowStmt, ctx):
+    from surrealdb_tpu.exec.operators import to_string
+
+    raise ThrownError(f"An error occurred: {to_string(evaluate(n.what, ctx))}")
+
+
+def _s_sleep(n: SleepStmt, ctx):
+    from surrealdb_tpu.val import Duration
+
+    d = evaluate(n.duration, ctx)
+    if isinstance(d, Duration):
+        time.sleep(min(d.to_seconds(), 30))
+    return NONE
+
+
+def _s_use(n: UseStmt, ctx):
+    if n.ns:
+        ctx.session.ns = n.ns
+        ctx.ns = n.ns
+    if n.db:
+        ctx.session.db = n.db
+        ctx.db = n.db
+    return NONE
+
+
+def _s_option(n, ctx):
+    return NONE
+
+
+# ---------------------------------------------------------------------------
+# target resolution — what a FROM/UPDATE/DELETE target yields
+# ---------------------------------------------------------------------------
+
+
+class Source:
+    """One input row: a record (rid + doc) or a plain value."""
+
+    __slots__ = ("rid", "doc", "value")
+
+    def __init__(self, rid=None, doc=None, value=NONE):
+        self.rid = rid
+        self.doc = doc
+        self.value = value
+
+
+def _target_value(expr, ctx):
+    """Evaluate a FROM target; bare idents become Tables."""
+    if isinstance(expr, Idiom) and len(expr.parts) == 1 and isinstance(
+        expr.parts[0], PField
+    ):
+        return Table(expr.parts[0].name)
+    v = evaluate(expr, ctx)
+    return v
+
+
+def iterate_targets(what: list, ctx: Ctx, cond=None, stmt=None):
+    """Yield Source objects for each target (reference dbs/iterator.rs
+    Iterable collection)."""
+    for expr in what:
+        v = _target_value(expr, ctx)
+        yield from _iterate_value(v, ctx, cond, stmt)
+
+
+def _iterate_value(v, ctx, cond=None, stmt=None):
+    ns, db = ctx.need_ns_db()
+    if isinstance(v, Table):
+        yield from _scan_table(v.name, ctx, cond, stmt)
+    elif isinstance(v, RecordId):
+        if isinstance(v.id, Range):
+            yield from _scan_record_range(v, ctx)
+        else:
+            doc = fetch_record(ctx, v)
+            yield Source(rid=v, doc=doc if doc is not NONE else NONE)
+    elif isinstance(v, list):
+        for x in v:
+            yield from _iterate_value(x, ctx, cond, stmt)
+    elif isinstance(v, dict):
+        rid = v.get("id")
+        if isinstance(rid, RecordId):
+            doc = fetch_record(ctx, rid)
+            yield Source(rid=rid, doc=doc)
+        else:
+            yield Source(value=v)
+    elif v is NONE or v is None:
+        return
+    else:
+        yield Source(value=v)
+
+
+def _scan_table(tb: str, ctx, cond=None, stmt=None):
+    """Table scan — consults the index planner first (idx/planner.rs)."""
+    from surrealdb_tpu.idx.planner import plan_scan
+
+    plan = plan_scan(tb, cond, ctx, stmt)
+    if plan is not None:
+        yield from plan
+        return
+    ns, db = ctx.need_ns_db()
+    from surrealdb_tpu.kvs.api import deserialize
+
+    beg, end = K.prefix_range(K.record_prefix(ns, db, tb))
+    for k, raw in ctx.txn.scan(beg, end):
+        _ns, _db, _tb, idv = K.decode_record_id(k)
+        yield Source(rid=RecordId(tb, idv), doc=deserialize(raw))
+
+
+def _scan_record_range(v: RecordId, ctx):
+    ns, db = ctx.need_ns_db()
+    rng: Range = v.id
+    from surrealdb_tpu.kvs.api import deserialize
+
+    if rng.beg is NONE:
+        beg = K.record_prefix(ns, db, v.tb)
+    else:
+        beg = K.record(ns, db, v.tb, rng.beg)
+        if not rng.beg_incl:
+            beg += b"\x00"
+    if rng.end is NONE:
+        _, end = K.prefix_range(K.record_prefix(ns, db, v.tb))
+    else:
+        end = K.record(ns, db, v.tb, rng.end)
+        if rng.end_incl:
+            end += b"\xff"
+    for k, raw in ctx.txn.scan(beg, end):
+        _ns, _db, _tb, idv = K.decode_record_id(k)
+        yield Source(rid=RecordId(v.tb, idv), doc=deserialize(raw))
+
+
+# ---------------------------------------------------------------------------
+# permissions
+# ---------------------------------------------------------------------------
+
+
+def check_table_permission(tb: str, action: str, ctx: Ctx, doc=None, rid=None):
+    """Row-level permission check (doc/check + scan operators). Returns
+    truthy if the action is allowed for the session on this doc."""
+    if ctx.session.is_owner or ctx.session.auth_level in ("editor",):
+        return True
+    ns, db = ctx.need_ns_db()
+    tdef = ctx.txn.get_val(K.tb_def(ns, db, tb))
+    if tdef is None or tdef.permissions is None:
+        return ctx.session.auth_level == "viewer" and action == "select"
+    p = tdef.permissions.get(action, False)
+    if p is True or p is False:
+        return p
+    c = ctx.with_doc(doc, rid)
+    return is_truthy(evaluate(p, c))
+
+
+# ---------------------------------------------------------------------------
+# SELECT
+# ---------------------------------------------------------------------------
+
+_AGGREGATES = {
+    "count", "math::sum", "math::mean", "math::min", "math::max",
+    "math::stddev", "math::variance", "math::median", "math::mode",
+    "math::product", "math::spread", "math::interquartile", "math::midhinge",
+    "math::trimean", "math::bottom", "math::top", "math::percentile",
+    "math::nearestrank", "time::min", "time::max", "array::group",
+    "array::distinct", "array::flatten", "array::concat", "array::first",
+    "array::last", "array::len", "array::max", "array::min", "array::sort",
+}
+
+
+def _is_aggregate(expr) -> bool:
+    if isinstance(expr, FunctionCall):
+        if expr.name.lower() in _AGGREGATES:
+            return True
+        return any(_is_aggregate(a) for a in expr.args)
+    if isinstance(expr, Binary):
+        return _is_aggregate(expr.lhs) or _is_aggregate(expr.rhs)
+    if isinstance(expr, Prefix):
+        return _is_aggregate(expr.expr)
+    return False
+
+
+def expr_name(expr) -> str:
+    """Canonical output field name for an unaliased projection."""
+    if isinstance(expr, Idiom):
+        out = []
+        for p in expr.parts:
+            if isinstance(p, tuple):
+                out.append(expr_name(p[1]))
+            elif isinstance(p, PField):
+                if out:
+                    out.append("." + p.name)
+                else:
+                    out.append(p.name)
+            elif isinstance(p, PAll):
+                out.append("[*]" if out else "*")
+            elif isinstance(p, PIndex):
+                out.append(f"[{expr_name(p.expr)}]")
+            elif isinstance(p, PLast):
+                out.append("[$]")
+            elif isinstance(p, PGraph):
+                arrow = {"out": "->", "in": "<-", "both": "<->"}[p.dir]
+                names = ", ".join(w[0] for w in p.what) if p.what else "?"
+                if len(p.what) == 1:
+                    out.append(f"{arrow}{names}")
+                else:
+                    out.append(f"{arrow}({names})")
+            elif isinstance(p, PWhere):
+                out.append("[WHERE]")
+            elif isinstance(p, PMethod):
+                out.append(f".{p.name}()")
+            elif isinstance(p, PFlatten):
+                out.append("…")
+            else:
+                out.append("")
+        return "".join(out)
+    if isinstance(expr, FunctionCall):
+        return expr.name
+    if isinstance(expr, Literal):
+        return render(expr.value)
+    if isinstance(expr, Param):
+        return f"${expr.name}"
+    if isinstance(expr, Binary):
+        return f"{expr_name(expr.lhs)} {expr.op} {expr_name(expr.rhs)}"
+    if isinstance(expr, Cast):
+        return expr_name(expr.expr)
+    if isinstance(expr, Subquery):
+        return "subquery"
+    if isinstance(expr, RecordIdLit):
+        return expr.tb
+    if isinstance(expr, Knn):
+        return expr_name(expr.lhs)
+    return "field"
+
+
+def _s_select(n: SelectStmt, ctx: Ctx):
+    ctx.check_deadline()
+    c = ctx.child()
+    if n.timeout is not None:
+        from surrealdb_tpu.val import Duration
+
+        d = evaluate(n.timeout, ctx)
+        if isinstance(d, Duration):
+            c.deadline = time.monotonic() + d.to_seconds()
+    if n.explain:
+        return _explain_select(n, c)
+    # VERSION clause
+    if n.version is not None:
+        c.version = evaluate(n.version, ctx)
+    rows = []
+    perms = not c.session.is_owner
+    knn_ctx_holder = {}
+    for src in iterate_targets(n.what, c, n.cond, n):
+        c.check_deadline()
+        if src.rid is not None and src.doc is NONE and not isinstance(
+            _target_of(n, c), list
+        ):
+            # direct record fetch that doesn't exist -> no row
+            continue
+        if perms and src.rid is not None:
+            if not check_table_permission(src.rid.tb, "select", c, src.doc, src.rid):
+                continue
+        rows.append(src)
+    # WHERE (if planner didn't consume it, re-filter — planner marks via attr)
+    if n.cond is not None and not getattr(c, "_cond_consumed", False):
+        kept = []
+        for src in rows:
+            doc = src.doc if src.rid is not None else src.value
+            cc = c.with_doc(doc, src.rid)
+            cc.knn = c.knn
+            if is_truthy(evaluate(n.cond, cc)):
+                kept.append(src)
+        rows = kept
+    # SPLIT
+    for sp in n.split:
+        rows = _apply_split(rows, sp, c)
+    # GROUP BY
+    if n.group is not None:
+        out_rows = _apply_group(rows, n, c)
+    else:
+        out_rows = [_project(src, n, c) for src in rows]
+    # ORDER BY
+    if n.order:
+        if n.order == "rand":
+            _random.shuffle(out_rows)
+        else:
+            out_rows = _apply_order(out_rows, n.order, c)
+    # START / LIMIT
+    if n.start is not None:
+        s = int(evaluate(n.start, c))
+        out_rows = out_rows[s:]
+    if n.limit is not None:
+        l = int(evaluate(n.limit, c))
+        out_rows = out_rows[:l]
+    # FETCH
+    if n.fetch:
+        out_rows = [apply_fetch(r, n.fetch, c) for r in out_rows]
+    # OMIT
+    if n.omit:
+        for r in out_rows:
+            if isinstance(r, dict):
+                for om in n.omit:
+                    _omit_path(r, om)
+    if n.only:
+        if len(out_rows) == 1:
+            return out_rows[0]
+        if len(out_rows) == 0:
+            return NONE
+        raise SdbError(
+            "Expected a single result output when using the ONLY keyword"
+        )
+    return out_rows
+
+
+def _target_of(n, ctx):
+    return None
+
+
+def _omit_path(doc, om):
+    if isinstance(om, Idiom):
+        names = [p.name for p in om.parts if isinstance(p, PField)]
+        cur = doc
+        for nm in names[:-1]:
+            cur = cur.get(nm) if isinstance(cur, dict) else None
+            if not isinstance(cur, dict):
+                return
+        if isinstance(cur, dict) and names:
+            cur.pop(names[-1], None)
+
+
+def _project(src: Source, n: SelectStmt, ctx: Ctx):
+    doc = src.doc if src.rid is not None else src.value
+    c = ctx.with_doc(doc, src.rid)
+    c.knn = ctx.knn
+    if n.value is not None:
+        return evaluate(n.value, c)
+    out = {}
+    star = False
+    for expr, alias in n.exprs:
+        if expr == "*":
+            star = True
+            if isinstance(doc, dict):
+                for k, v in doc.items():
+                    out[k] = copy_value(v)
+            elif doc is not NONE and doc is not None and not isinstance(doc, dict):
+                # SELECT * FROM scalar -> the scalar itself
+                if len(n.exprs) == 1:
+                    return copy_value(doc)
+            continue
+        v = evaluate(expr, c)
+        name = alias if alias else expr_name(expr)
+        _set_out_field(out, name, v)
+    if not n.exprs and not star:
+        return copy_value(doc)
+    return out
+
+
+def _set_out_field(out: dict, name: str, v):
+    # alias paths like a.b create nested objects
+    if "." in name and not name.startswith("("):
+        segs = name.split(".")
+        cur = out
+        for s in segs[:-1]:
+            nxt = cur.get(s)
+            if not isinstance(nxt, dict):
+                nxt = {}
+                cur[s] = nxt
+            cur = nxt
+        cur[segs[-1]] = v
+    else:
+        out[name] = v
+
+
+def _apply_split(rows, sp, ctx):
+    out = []
+    name = expr_name(sp) if isinstance(sp, Idiom) else None
+    for src in rows:
+        doc = src.doc if src.rid is not None else src.value
+        c = ctx.with_doc(doc, src.rid)
+        v = evaluate(sp, c)
+        if isinstance(v, list):
+            for item in v:
+                nd = copy_value(doc) if isinstance(doc, dict) else {}
+                if name:
+                    _set_path(nd, name.split("."), item)
+                out.append(Source(rid=src.rid, doc=nd, value=nd))
+        else:
+            out.append(src)
+    return out
+
+
+def _set_path(doc, segs, v):
+    cur = doc
+    for s in segs[:-1]:
+        nxt = cur.get(s)
+        if not isinstance(nxt, dict):
+            nxt = {}
+            cur[s] = nxt
+        cur = nxt
+    cur[segs[-1]] = v
+
+
+def _apply_group(rows, n: SelectStmt, ctx):
+    from surrealdb_tpu.val import hashable
+
+    groups: dict = {}
+    order = []
+    gb = n.group
+    for src in rows:
+        doc = src.doc if src.rid is not None else src.value
+        c = ctx.with_doc(doc, src.rid)
+        key = tuple(hashable(evaluate(g, c)) for g in gb) if gb else ()
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(src)
+    out = []
+    for key in order:
+        members = groups[key]
+        first = members[0]
+        fdoc = first.doc if first.rid is not None else first.value
+        fc = ctx.with_doc(fdoc, first.rid)
+        if n.value is not None:
+            if _is_aggregate(n.value):
+                out.append(_eval_aggregate(n.value, members, ctx))
+            else:
+                out.append(evaluate(n.value, fc))
+            continue
+        row = {}
+        for expr, alias in n.exprs:
+            if expr == "*":
+                if isinstance(fdoc, dict):
+                    row.update(copy_value(fdoc))
+                continue
+            name = alias if alias else expr_name(expr)
+            if _is_aggregate(expr):
+                v = _eval_aggregate(expr, members, ctx)
+            else:
+                v = evaluate(expr, fc)
+            _set_out_field(row, name, v)
+        out.append(row)
+    return out
+
+
+def _eval_aggregate(expr, members, ctx):
+    """Evaluate an aggregate expression over a group of source rows."""
+    if isinstance(expr, FunctionCall) and expr.name.lower() in _AGGREGATES:
+        fname = expr.name.lower()
+        from surrealdb_tpu.fnc import FUNCS
+
+        if fname == "count" and not expr.args:
+            return len(members)
+        # collect per-row values of the first argument
+        vals = []
+        for src in members:
+            doc = src.doc if src.rid is not None else src.value
+            c = ctx.with_doc(doc, src.rid)
+            vals.append(evaluate(expr.args[0], c) if expr.args else NONE)
+        if fname == "count":
+            return sum(1 for v in vals if is_truthy(v))
+        extra = []
+        for a in expr.args[1:]:
+            extra.append(evaluate(a, ctx))
+        if fname == "array::group":
+            flat = []
+            for v in vals:
+                if isinstance(v, list):
+                    flat.extend(v)
+                else:
+                    flat.append(v)
+            return FUNCS["array::distinct"]([flat], ctx)
+        if fname in ("array::concat", "array::flatten"):
+            flat = []
+            for v in vals:
+                if isinstance(v, list):
+                    flat.extend(v)
+                else:
+                    flat.append(v)
+            return flat
+        if fname == "array::first":
+            return vals[0] if vals else NONE
+        if fname == "array::last":
+            return vals[-1] if vals else NONE
+        if fname == "array::len":
+            return len(vals)
+        return FUNCS[fname]([vals] + extra, ctx)
+    if isinstance(expr, Binary):
+        return _binary_aggregate(expr, members, ctx)
+    if isinstance(expr, Prefix):
+        from surrealdb_tpu.exec.operators import neg
+
+        v = _eval_aggregate(expr.expr, members, ctx)
+        if expr.op == "-":
+            return neg(v)
+        return v
+    if isinstance(expr, FunctionCall):
+        from surrealdb_tpu.fnc import FUNCS
+
+        args = [_eval_aggregate(a, members, ctx) for a in expr.args]
+        fn = FUNCS.get(expr.name.lower())
+        if fn is None:
+            raise SdbError(f"The function '{expr.name}' does not exist")
+        return fn(args, ctx)
+    # non-aggregate: evaluate on first member
+    first = members[0]
+    doc = first.doc if first.rid is not None else first.value
+    return evaluate(expr, ctx.with_doc(doc, first.rid))
+
+
+def _binary_aggregate(expr, members, ctx):
+    from surrealdb_tpu.exec.operators import binary_op
+
+    lhs = _eval_aggregate(expr.lhs, members, ctx)
+    rhs = _eval_aggregate(expr.rhs, members, ctx)
+    return binary_op(expr.op, lhs, rhs)
+
+
+def _apply_order(rows, order, ctx):
+    class OK:
+        __slots__ = ("keys",)
+
+        def __init__(self, keys):
+            self.keys = keys
+
+        def __lt__(self, other):
+            for (v, d, collate, numeric), (w, _, _, _) in zip(self.keys, other.keys):
+                c = _order_cmp(v, w, collate, numeric)
+                if c:
+                    return (c < 0) if d == "asc" else (c > 0)
+            return False
+
+    def _order_cmp(v, w, collate, numeric):
+        if numeric and isinstance(v, str) and isinstance(w, str):
+            import re
+
+            def splitnum(s):
+                return [
+                    int(p) if p.isdigit() else p
+                    for p in re.split(r"(\d+)", s)
+                    if p
+                ]
+
+            a, b = splitnum(v), splitnum(w)
+            for x, y in zip(a, b):
+                if type(x) is not type(y):
+                    x, y = str(x), str(y)
+                if x != y:
+                    return -1 if x < y else 1
+            return (len(a) > len(b)) - (len(a) < len(b))
+        if collate and isinstance(v, str) and isinstance(w, str):
+            a, b = v.casefold(), w.casefold()
+            return (a > b) - (a < b)
+        return value_cmp(v, w)
+
+    keyed = []
+    for r in rows:
+        c = ctx.with_doc(r, None)
+        keys = []
+        for item in order:
+            expr, d, collate, numeric = item
+            keys.append((evaluate(expr, c), d, collate, numeric))
+        keyed.append((OK(keys), r))
+    keyed.sort(key=lambda kr: kr[0])
+    return [r for _k, r in keyed]
+
+
+def apply_fetch(v, fetch_paths, ctx):
+    """FETCH: inline record links at given paths."""
+    for p in fetch_paths:
+        v = _fetch_path(v, _path_parts(p), ctx)
+    return v
+
+
+def _path_parts(p):
+    if isinstance(p, Idiom):
+        return [x for x in p.parts]
+    return []
+
+
+def _fetch_path(v, parts, ctx):
+    if not parts:
+        return _fetch_value(v, ctx)
+    if isinstance(v, list):
+        return [_fetch_path(x, parts, ctx) for x in v]
+    part = parts[0]
+    if isinstance(part, PField) and isinstance(v, dict):
+        name = part.name
+        if name in v:
+            nv = dict(v)
+            nv[name] = _fetch_path(v[name], parts[1:], ctx)
+            return nv
+        return v
+    if isinstance(part, PAll):
+        return _fetch_path(v, parts[1:], ctx)
+    if isinstance(v, RecordId):
+        doc = fetch_record(ctx, v)
+        if doc is NONE:
+            return v
+        return _fetch_path(doc, parts, ctx)
+    return v
+
+
+def _fetch_value(v, ctx):
+    if isinstance(v, RecordId):
+        doc = fetch_record(ctx, v)
+        return copy_value(doc) if doc is not NONE else v
+    if isinstance(v, list):
+        return [_fetch_value(x, ctx) for x in v]
+    return v
+
+
+def _explain_select(n: SelectStmt, ctx):
+    """EXPLAIN — report the plan the iterator would use (dbs/plan.rs)."""
+    from surrealdb_tpu.idx.planner import explain_plan
+
+    out = []
+    for expr in n.what:
+        v = _target_value(expr, ctx)
+        if isinstance(v, Table):
+            out.append(explain_plan(v.name, n.cond, ctx, n))
+        else:
+            out.append(
+                {
+                    "detail": {"type": "Value"},
+                    "operation": "Iterate Value",
+                }
+            )
+    out.append({"detail": {"type": "Memory"}, "operation": "Collector"})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# write statements -> document pipeline
+# ---------------------------------------------------------------------------
+
+
+def _only_wrap(results, only):
+    if not only:
+        return results
+    if len(results) == 1:
+        return results[0]
+    if len(results) == 0:
+        return NONE
+    raise SdbError("Expected a single result output when using the ONLY keyword")
+
+
+def _s_create(n: CreateStmt, ctx: Ctx):
+    from surrealdb_tpu.exec.document import create_one
+
+    results = []
+    for expr in n.what:
+        v = _target_value(expr, ctx)
+        targets = v if isinstance(v, list) else [v]
+        for t in targets:
+            results.append(create_one(t, n.data, n.output, ctx))
+    results = [r for r in results if r is not NONE or n.output is not None]
+    if n.output is not None and n.output.kind == "none":
+        return _only_wrap([], n.only) if n.only else []
+    return _only_wrap(results, n.only)
+
+
+def _s_insert(n: InsertStmt, ctx: Ctx):
+    from surrealdb_tpu.exec.document import insert_one, relate_insert_one
+
+    into = None
+    if n.into is not None:
+        v = _target_value(n.into, ctx)
+        if isinstance(v, Table):
+            into = v.name
+        elif isinstance(v, str):
+            into = v
+        elif isinstance(v, RecordId):
+            into = v.tb
+    results = []
+    if isinstance(n.data, InsertRows):
+        names = [expr_name(f) for f in n.data.fields]
+        for row in n.data.rows:
+            doc = {}
+            for name, ex in zip(names, row):
+                _set_path(doc, name.split("."), evaluate(ex, ctx))
+            results.append(
+                insert_one(into, doc, n.ignore, n.update, n.output, ctx)
+            )
+    else:
+        data = evaluate(n.data, ctx)
+        items = data if isinstance(data, list) else [data]
+        for item in items:
+            if not isinstance(item, dict):
+                raise SdbError(f"Cannot INSERT {render(item)}")
+            if n.relation:
+                results.append(
+                    relate_insert_one(into, item, n.ignore, n.output, ctx)
+                )
+            else:
+                results.append(
+                    insert_one(into, item, n.ignore, n.update, n.output, ctx)
+                )
+    results = [r for r in results if r is not NONE]
+    if n.output is not None and n.output.kind == "none":
+        return []
+    return results
+
+
+def _s_update(n: UpdateStmt, ctx: Ctx):
+    from surrealdb_tpu.exec.document import update_one
+
+    results = []
+    for src in iterate_targets(n.what, ctx, None, None):
+        if src.rid is None:
+            raise SdbError(f"Cannot UPDATE {render(src.value)}")
+        if src.doc is NONE:
+            continue  # UPDATE only touches existing records
+        if n.cond is not None:
+            c = ctx.with_doc(src.doc, src.rid)
+            if not is_truthy(evaluate(n.cond, c)):
+                continue
+        results.append(update_one(src.rid, src.doc, n.data, n.output, ctx))
+    results = [r for r in results if r is not NONE or n.output is None]
+    if n.output is not None and n.output.kind == "none":
+        return _only_wrap([], False) if not n.only else NONE
+    return _only_wrap(results, n.only)
+
+
+def _s_upsert(n: UpsertStmt, ctx: Ctx):
+    from surrealdb_tpu.exec.document import create_one, update_one
+
+    results = []
+    for expr in n.what:
+        v = _target_value(expr, ctx)
+        targets = v if isinstance(v, list) else [v]
+        for t in targets:
+            if isinstance(t, RecordId) and not isinstance(t.id, Range):
+                doc = fetch_record(ctx, t)
+                if doc is NONE:
+                    if n.cond is not None:
+                        c = ctx.with_doc({}, t)
+                        if not is_truthy(evaluate(n.cond, c)):
+                            continue
+                    results.append(create_one(t, n.data, n.output, ctx, upsert=True))
+                else:
+                    if n.cond is not None:
+                        c = ctx.with_doc(doc, t)
+                        if not is_truthy(evaluate(n.cond, c)):
+                            continue
+                    results.append(update_one(t, doc, n.data, n.output, ctx))
+            elif isinstance(t, Table):
+                # UPSERT table: update matching, create if none matched
+                matched = False
+                for src in _scan_table(t.name, ctx):
+                    if n.cond is not None:
+                        c = ctx.with_doc(src.doc, src.rid)
+                        if not is_truthy(evaluate(n.cond, c)):
+                            continue
+                    matched = True
+                    results.append(
+                        update_one(src.rid, src.doc, n.data, n.output, ctx)
+                    )
+                if not matched and n.cond is None:
+                    results.append(
+                        create_one(t, n.data, n.output, ctx, upsert=True)
+                    )
+            else:
+                yield_src = list(_iterate_value(t, ctx))
+                for src in yield_src:
+                    if src.rid is None:
+                        raise SdbError(f"Cannot UPSERT {render(src.value)}")
+                    if src.doc is NONE:
+                        results.append(
+                            create_one(src.rid, n.data, n.output, ctx, upsert=True)
+                        )
+                    else:
+                        results.append(
+                            update_one(src.rid, src.doc, n.data, n.output, ctx)
+                        )
+    results = [r for r in results if r is not NONE or n.output is None]
+    if n.output is not None and n.output.kind == "none":
+        return []
+    return _only_wrap(results, n.only)
+
+
+def _s_delete(n: DeleteStmt, ctx: Ctx):
+    from surrealdb_tpu.exec.document import delete_one
+
+    results = []
+    for src in iterate_targets(n.what, ctx, None, None):
+        if src.rid is None:
+            raise SdbError(f"Cannot DELETE {render(src.value)}")
+        if src.doc is NONE:
+            continue
+        if n.cond is not None:
+            c = ctx.with_doc(src.doc, src.rid)
+            if not is_truthy(evaluate(n.cond, c)):
+                continue
+        r = delete_one(src.rid, src.doc, n.output, ctx)
+        if n.output is not None and n.output.kind != "none":
+            results.append(r)
+    return _only_wrap(results, n.only) if n.only else results
+
+
+def _s_relate(n: RelateStmt, ctx: Ctx):
+    from surrealdb_tpu.exec.document import relate_one
+
+    kind_v = _target_value(n.kind, ctx)
+    froms = evaluate(n.from_, ctx) if not isinstance(n.from_, Idiom) or not (
+        len(n.from_.parts) == 1 and isinstance(n.from_.parts[0], PField)
+    ) else _target_value(n.from_, ctx)
+    tos = evaluate(n.to, ctx) if not isinstance(n.to, Idiom) or not (
+        len(n.to.parts) == 1 and isinstance(n.to.parts[0], PField)
+    ) else _target_value(n.to, ctx)
+    froms = froms if isinstance(froms, list) else [froms]
+    tos = tos if isinstance(tos, list) else [tos]
+    results = []
+    for f in froms:
+        for t in tos:
+            fr = _as_rid(f)
+            to = _as_rid(t)
+            results.append(relate_one(kind_v, fr, to, n.data, n.output, ctx, n.uniq))
+    results = [r for r in results if r is not NONE]
+    if n.output is not None and n.output.kind == "none":
+        return []
+    return _only_wrap(results, n.only)
+
+
+def _as_rid(v):
+    if isinstance(v, RecordId):
+        return v
+    if isinstance(v, dict) and isinstance(v.get("id"), RecordId):
+        return v["id"]
+    raise SdbError(f"Cannot use {render(v)} as a record id in RELATE")
+
+
+# ---------------------------------------------------------------------------
+# DEFINE / REMOVE / INFO / etc.
+# ---------------------------------------------------------------------------
+
+
+def _ensure_ns_db(ctx: Ctx):
+    """Auto-create namespace/database definitions on first use."""
+    ns, db = ctx.need_ns_db()
+    if ctx.txn.get(K.ns_def(ns)) is None:
+        ctx.txn.set_val(K.ns_def(ns), NamespaceDef(ns))
+    if ctx.txn.get(K.db_def(ns, db)) is None:
+        ctx.txn.set_val(K.db_def(ns, db), DatabaseDef(db))
+
+
+def _exists_guard(ctx, key, name, kind, if_not_exists, overwrite):
+    if ctx.txn.get(key) is not None:
+        if if_not_exists:
+            return True  # skip silently
+        if not overwrite:
+            raise SdbError(
+                f"The {kind} '{name}' already exists"
+            )
+    return False
+
+
+def _s_define_ns(n: DefineNamespace, ctx):
+    if _exists_guard(ctx, K.ns_def(n.name), n.name, "namespace",
+                     n.if_not_exists, n.overwrite):
+        return NONE
+    ctx.txn.set_val(K.ns_def(n.name), NamespaceDef(n.name, n.comment))
+    return NONE
+
+
+def _s_define_db(n: DefineDatabase, ctx):
+    ns = ctx.session.ns
+    if not ns:
+        raise SdbError("Specify a namespace to use")
+    if ctx.txn.get(K.ns_def(ns)) is None:
+        ctx.txn.set_val(K.ns_def(ns), NamespaceDef(ns))
+    if _exists_guard(ctx, K.db_def(ns, n.name), n.name, "database",
+                     n.if_not_exists, n.overwrite):
+        return NONE
+    cf = None
+    if n.changefeed is not None:
+        from surrealdb_tpu.val import Duration
+
+        d = evaluate(n.changefeed, ctx)
+        cf = d.ns if isinstance(d, Duration) else int(d)
+    ctx.txn.set_val(K.db_def(ns, n.name), DatabaseDef(n.name, n.comment, cf))
+    return NONE
+
+
+def _s_define_table(n: DefineTable, ctx):
+    _ensure_ns_db(ctx)
+    ns, db = ctx.need_ns_db()
+    if _exists_guard(ctx, K.tb_def(ns, db, n.name), n.name, "table",
+                     n.if_not_exists, n.overwrite):
+        return NONE
+    cf = None
+    if n.changefeed is not None:
+        from surrealdb_tpu.val import Duration
+
+        d = evaluate(n.changefeed, ctx)
+        cf = d.ns if isinstance(d, Duration) else int(d)
+    tdef = TableDef(
+        name=n.name,
+        drop=n.drop,
+        full=n.full,
+        kind=n.kind if n.kind != "normal" or n.view is None else "normal",
+        relation_from=n.relation_from,
+        relation_to=n.relation_to,
+        enforced=n.enforced,
+        view=n.view,
+        permissions=n.permissions,
+        changefeed=cf,
+        comment=n.comment,
+    )
+    ctx.txn.set_val(K.tb_def(ns, db, n.name), tdef)
+    if n.view is not None:
+        _materialize_view(tdef, ctx)
+    return NONE
+
+
+def _materialize_view(tdef: TableDef, ctx):
+    """Populate a `DEFINE TABLE ... AS SELECT` view immediately (the
+    reference recomputes incrementally in doc/table.rs; we rebuild)."""
+    from surrealdb_tpu.exec.document import rebuild_view
+
+    rebuild_view(tdef, ctx)
+
+
+def _s_define_field(n: DefineField, ctx):
+    _ensure_ns_db(ctx)
+    ns, db = ctx.need_ns_db()
+    if ctx.txn.get(K.tb_def(ns, db, n.tb)) is None:
+        ctx.txn.set_val(K.tb_def(ns, db, n.tb), TableDef(name=n.tb))
+    name_str = _field_name_str(n.name)
+    kdef = K.fd_def(ns, db, n.tb, name_str)
+    if _exists_guard(ctx, kdef, name_str, "field", n.if_not_exists, n.overwrite):
+        return NONE
+    fd = FieldDef(
+        name=n.name,
+        name_str=name_str,
+        flex=n.flex,
+        kind=n.kind,
+        readonly=n.readonly,
+        value=n.value,
+        assert_=n.assert_,
+        default=n.default,
+        default_always=n.default_always,
+        computed=n.computed,
+        permissions=n.permissions,
+        reference=n.reference,
+        comment=n.comment,
+    )
+    ctx.txn.set_val(kdef, fd)
+    return NONE
+
+
+def _field_name_str(parts) -> str:
+    out = []
+    for p in parts:
+        if isinstance(p, PField):
+            out.append(("." if out else "") + p.name)
+        elif isinstance(p, PAll):
+            out.append("[*]")
+    return "".join(out)
+
+
+def _s_define_index(n: DefineIndex, ctx):
+    _ensure_ns_db(ctx)
+    ns, db = ctx.need_ns_db()
+    if ctx.txn.get(K.tb_def(ns, db, n.tb)) is None:
+        ctx.txn.set_val(K.tb_def(ns, db, n.tb), TableDef(name=n.tb))
+    kdef = K.ix_def(ns, db, n.tb, n.name)
+    if _exists_guard(ctx, kdef, n.name, "index", n.if_not_exists, n.overwrite):
+        return NONE
+    if n.overwrite and ctx.txn.get(kdef) is not None:
+        _remove_index_data(ns, db, n.tb, n.name, ctx)
+    idef = IndexDef(
+        name=n.name,
+        tb=n.tb,
+        cols=n.cols,
+        cols_str=[expr_name(c) for c in n.cols],
+        unique=n.unique,
+        hnsw=n.hnsw,
+        fulltext=n.fulltext,
+        count=n.count,
+        comment=n.comment,
+    )
+    ctx.txn.set_val(kdef, idef)
+    # build over existing records (reference kvs/index.rs builds async;
+    # we build inline — same observable result)
+    from surrealdb_tpu.exec.document import build_index
+
+    build_index(idef, ctx)
+    return NONE
+
+
+def _remove_index_data(ns, db, tb, ix, ctx):
+    ctx.txn.delete_range(*K.prefix_range(K.index_prefix(ns, db, tb, ix)))
+    ctx.txn.delete_range(*K.prefix_range(K.index_unique_prefix(ns, db, tb, ix)))
+    ctx.txn.delete_range(*K.prefix_range(K.ix_state(ns, db, tb, ix, b"")))
+    ctx.ds.vector_indexes.pop((ns, db, tb, ix), None)
+    ctx.ds.ft_indexes.pop((ns, db, tb, ix), None)
+
+
+def _s_define_event(n: DefineEvent, ctx):
+    _ensure_ns_db(ctx)
+    ns, db = ctx.need_ns_db()
+    kdef = K.ev_def(ns, db, n.tb, n.name)
+    if _exists_guard(ctx, kdef, n.name, "event", n.if_not_exists, n.overwrite):
+        return NONE
+    ctx.txn.set_val(kdef, EventDef(n.name, n.when, n.then, n.comment))
+    return NONE
+
+
+def _s_define_param(n: DefineParam, ctx):
+    _ensure_ns_db(ctx)
+    ns, db = ctx.need_ns_db()
+    kdef = K.pa_def(ns, db, n.name)
+    if _exists_guard(ctx, kdef, n.name, "param", n.if_not_exists, n.overwrite):
+        return NONE
+    v = evaluate(n.value, ctx)
+    ctx.txn.set_val(kdef, ParamDef(n.name, v, n.permissions, n.comment))
+    return NONE
+
+
+def _s_define_function(n: DefineFunction, ctx):
+    _ensure_ns_db(ctx)
+    ns, db = ctx.need_ns_db()
+    kdef = K.fc_def(ns, db, n.name)
+    if _exists_guard(ctx, kdef, n.name, "function", n.if_not_exists, n.overwrite):
+        return NONE
+    ctx.txn.set_val(
+        kdef,
+        FunctionDef(n.name, n.args, n.block, n.returns, n.permissions, n.comment),
+    )
+    return NONE
+
+
+def _s_define_analyzer(n: DefineAnalyzer, ctx):
+    _ensure_ns_db(ctx)
+    ns, db = ctx.need_ns_db()
+    kdef = K.az_def(ns, db, n.name)
+    if _exists_guard(ctx, kdef, n.name, "analyzer", n.if_not_exists, n.overwrite):
+        return NONE
+    ctx.txn.set_val(
+        kdef, AnalyzerDef(n.name, n.tokenizers, n.filters, n.function, n.comment)
+    )
+    return NONE
+
+
+def _s_define_user(n: DefineUser, ctx):
+    from surrealdb_tpu.fnc.misc_fns import password_hash
+
+    base = n.base
+    ns = ctx.session.ns if base in ("ns", "db") else None
+    db = ctx.session.db if base == "db" else None
+    kdef = K.us_def(base, ns, db, n.name)
+    if _exists_guard(ctx, kdef, n.name, "user", n.if_not_exists, n.overwrite):
+        return NONE
+    ph = n.passhash or (password_hash(n.password) if n.password else "")
+    ctx.txn.set_val(
+        kdef, UserDef(n.name, base, ph, n.roles, n.duration, n.comment)
+    )
+    return NONE
+
+
+def _s_define_access(n: DefineAccess, ctx):
+    base = n.base
+    ns = ctx.session.ns if base in ("ns", "db") else None
+    db = ctx.session.db if base == "db" else None
+    kdef = K.ac_def(base, ns, db, n.name)
+    if _exists_guard(ctx, kdef, n.name, "access", n.if_not_exists, n.overwrite):
+        return NONE
+    ctx.txn.set_val(
+        kdef, AccessDef(n.name, base, n.kind, n.config, n.duration, n.comment)
+    )
+    return NONE
+
+
+def _s_define_sequence(n: DefineSequence, ctx):
+    _ensure_ns_db(ctx)
+    ns, db = ctx.need_ns_db()
+    kdef = K.seq_state(ns, db, n.name)
+    if ctx.txn.get(kdef) is not None:
+        if n.if_not_exists:
+            return NONE
+        if not n.overwrite:
+            raise SdbError(f"The sequence '{n.name}' already exists")
+    sd = SequenceDef(n.name, n.batch, n.start)
+    ctx.txn.set_val(kdef, (sd, n.start))
+    return NONE
+
+
+def _s_define_config(n: DefineConfig, ctx):
+    return NONE
+
+
+def _s_remove(n: RemoveStmt, ctx: Ctx):
+    ns = ctx.session.ns
+    db = ctx.session.db
+    kind = n.kind
+
+    def _guard(key, label):
+        if ctx.txn.get(key) is None:
+            if n.if_exists:
+                return True
+            raise SdbError(f"The {kind} '{label}' does not exist")
+        return False
+
+    if kind == "namespace":
+        key = K.ns_def(n.name)
+        if _guard(key, n.name):
+            return NONE
+        ctx.txn.delete(key)
+        ctx.txn.delete_range(*K.prefix_range(K.db_prefix(n.name)))
+        ctx.txn.delete_range(*K.prefix_range(b"/*" + K.enc_str(n.name)))
+        return NONE
+    if kind == "database":
+        key = K.db_def(ns, n.name)
+        if _guard(key, n.name):
+            return NONE
+        ctx.txn.delete(key)
+        ctx.txn.delete_range(*K.prefix_range(K.tb_prefix(ns, n.name)))
+        ctx.txn.delete_range(
+            *K.prefix_range(b"/*" + K.enc_str(ns) + b"*" + K.enc_str(n.name))
+        )
+        return NONE
+    if kind == "table":
+        key = K.tb_def(ns, db, n.name)
+        if _guard(key, n.name):
+            return NONE
+        ctx.txn.delete(key)
+        for kk in (K.fd_prefix, K.ix_prefix, K.ev_prefix, K.lq_prefix):
+            ctx.txn.delete_range(*K.prefix_range(kk(ns, db, n.name)))
+        base = K._tb(ns, db, n.name)
+        ctx.txn.delete_range(*K.prefix_range(base))
+        for ixkey in list(ctx.ds.vector_indexes):
+            if ixkey[:3] == (ns, db, n.name):
+                ctx.ds.vector_indexes.pop(ixkey, None)
+        for ixkey in list(ctx.ds.ft_indexes):
+            if ixkey[:3] == (ns, db, n.name):
+                ctx.ds.ft_indexes.pop(ixkey, None)
+        return NONE
+    if kind == "field":
+        name_str = _field_name_str(n.name) if isinstance(n.name, list) else n.name
+        key = K.fd_def(ns, db, n.tb, name_str)
+        if _guard(key, name_str):
+            return NONE
+        ctx.txn.delete(key)
+        return NONE
+    if kind == "index":
+        key = K.ix_def(ns, db, n.tb, n.name)
+        if _guard(key, n.name):
+            return NONE
+        ctx.txn.delete(key)
+        _remove_index_data(ns, db, n.tb, n.name, ctx)
+        return NONE
+    if kind == "event":
+        key = K.ev_def(ns, db, n.tb, n.name)
+        if _guard(key, n.name):
+            return NONE
+        ctx.txn.delete(key)
+        return NONE
+    if kind == "param":
+        key = K.pa_def(ns, db, n.name)
+        if _guard(key, n.name):
+            return NONE
+        ctx.txn.delete(key)
+        return NONE
+    if kind == "function":
+        key = K.fc_def(ns, db, n.name)
+        if _guard(key, n.name):
+            return NONE
+        ctx.txn.delete(key)
+        return NONE
+    if kind == "analyzer":
+        key = K.az_def(ns, db, n.name)
+        if _guard(key, n.name):
+            return NONE
+        ctx.txn.delete(key)
+        return NONE
+    if kind == "user":
+        base = n.base or "root"
+        key = K.us_def(base, ns if base in ("ns", "db") else None,
+                       db if base == "db" else None, n.name)
+        if _guard(key, n.name):
+            return NONE
+        ctx.txn.delete(key)
+        return NONE
+    if kind == "access":
+        base = n.base or "db"
+        key = K.ac_def(base, ns if base in ("ns", "db") else None,
+                       db if base == "db" else None, n.name)
+        if _guard(key, n.name):
+            return NONE
+        ctx.txn.delete(key)
+        return NONE
+    if kind == "sequence":
+        key = K.seq_state(ns, db, n.name)
+        if _guard(key, n.name):
+            return NONE
+        ctx.txn.delete(key)
+        return NONE
+    raise SdbError(f"unknown REMOVE kind {kind}")
+
+
+def _s_alter(n: AlterTable, ctx: Ctx):
+    ns, db = ctx.need_ns_db()
+    key = K.tb_def(ns, db, n.name)
+    tdef = ctx.txn.get_val(key)
+    if tdef is None:
+        if n.if_exists:
+            return NONE
+        raise SdbError(f"The table '{n.name}' does not exist")
+    if n.full is not None:
+        tdef.full = n.full
+    if n.drop is not None:
+        tdef.drop = n.drop
+    if n.kind is not None:
+        tdef.kind = n.kind
+    if n.relation_from is not None:
+        tdef.relation_from = n.relation_from
+    if n.relation_to is not None:
+        tdef.relation_to = n.relation_to
+    if n.permissions is not None:
+        tdef.permissions = n.permissions
+    if n.comment is not None:
+        tdef.comment = n.comment
+    ctx.txn.set_val(key, tdef)
+    return NONE
+
+
+def _s_rebuild(n: RebuildIndex, ctx: Ctx):
+    ns, db = ctx.need_ns_db()
+    idef = ctx.txn.get_val(K.ix_def(ns, db, n.tb, n.name))
+    if idef is None:
+        if n.if_exists:
+            return NONE
+        raise SdbError(f"The index '{n.name}' does not exist")
+    _remove_index_data(ns, db, n.tb, n.name, ctx)
+    from surrealdb_tpu.exec.document import build_index
+
+    build_index(idef, ctx)
+    return NONE
+
+
+# ---------------------------------------------------------------------------
+# INFO
+# ---------------------------------------------------------------------------
+
+
+def _s_info(n: InfoStmt, ctx: Ctx):
+    from surrealdb_tpu.exec.render_def import (
+        render_access,
+        render_analyzer,
+        render_db,
+        render_event,
+        render_field,
+        render_function,
+        render_index,
+        render_ns,
+        render_param,
+        render_sequence,
+        render_table,
+        render_user,
+    )
+
+    if n.level == "root":
+        out = {"accesses": {}, "namespaces": {}, "nodes": {}, "system": {},
+               "users": {}}
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ns_prefix())):
+            out["namespaces"][d.name] = render_ns(d)
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.us_prefix("root"))):
+            out["users"][d.name] = render_user(d)
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ac_prefix("root"))):
+            out["accesses"][d.name] = render_access(d)
+        return out
+    if n.level == "ns":
+        ns = ctx.session.ns
+        out = {"accesses": {}, "databases": {}, "users": {}}
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.db_prefix(ns))):
+            out["databases"][d.name] = render_db(d)
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.us_prefix("ns", ns))):
+            out["users"][d.name] = render_user(d)
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ac_prefix("ns", ns))):
+            out["accesses"][d.name] = render_access(d)
+        return out
+    if n.level == "db":
+        ns, db = ctx.need_ns_db()
+        out = {
+            "accesses": {}, "analyzers": {}, "apis": {}, "buckets": {},
+            "configs": {}, "functions": {}, "models": {}, "params": {},
+            "sequences": {}, "tables": {}, "users": {},
+        }
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.tb_prefix(ns, db))):
+            out["tables"][d.name] = render_table(d)
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.pa_prefix(ns, db))):
+            out["params"][d.name] = render_param(d)
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.fc_prefix(ns, db))):
+            out["functions"][d.name] = render_function(d)
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.az_prefix(ns, db))):
+            out["analyzers"][d.name] = render_analyzer(d)
+        for _k, d in ctx.txn.scan_vals(
+            *K.prefix_range(K.us_prefix("db", ns, db))
+        ):
+            out["users"][d.name] = render_user(d)
+        for _k, d in ctx.txn.scan_vals(
+            *K.prefix_range(K.ac_prefix("db", ns, db))
+        ):
+            out["accesses"][d.name] = render_access(d)
+        for _k, st in ctx.txn.scan_vals(
+            *K.prefix_range(b"/!sq" + K.enc_str(ns) + K.enc_str(db))
+        ):
+            sd = st[0]
+            out["sequences"][sd.name] = render_sequence(sd)
+        return out
+    if n.level == "table":
+        ns, db = ctx.need_ns_db()
+        tb = n.target
+        if ctx.txn.get(K.tb_def(ns, db, tb)) is None:
+            raise SdbError(f"The table '{tb}' does not exist")
+        out = {"events": {}, "fields": {}, "indexes": {}, "lives": {},
+               "tables": {}}
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.fd_prefix(ns, db, tb))):
+            out["fields"][d.name_str] = render_field(d, tb)
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ix_prefix(ns, db, tb))):
+            out["indexes"][d.name] = render_index(d)
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ev_prefix(ns, db, tb))):
+            out["events"][d.name] = render_event(d, tb)
+        return out
+    if n.level == "index":
+        ns, db = ctx.need_ns_db()
+        idef = ctx.txn.get_val(K.ix_def(ns, db, n.target2, n.target))
+        if idef is None:
+            raise SdbError(f"The index '{n.target}' does not exist")
+        return {"building": {"status": "built"}}
+    if n.level == "user":
+        base = "root"
+        key = None
+        for b in ("db", "ns", "root"):
+            key_try = K.us_def(
+                b,
+                ctx.session.ns if b in ("ns", "db") else None,
+                ctx.session.db if b == "db" else None,
+                n.target,
+            )
+            if ctx.txn.get(key_try) is not None:
+                key = key_try
+                break
+        if key is None:
+            raise SdbError(f"The root user '{n.target}' does not exist")
+        from surrealdb_tpu.exec.render_def import render_user
+
+        return render_user(ctx.txn.get_val(key))
+    raise SdbError(f"unknown INFO level {n.level}")
+
+
+# ---------------------------------------------------------------------------
+# LIVE / KILL / SHOW
+# ---------------------------------------------------------------------------
+
+
+def _s_live(n: LiveStmt, ctx: Ctx):
+    ns, db = ctx.need_ns_db()
+    what = _target_value(n.what, ctx)
+    if not isinstance(what, Table):
+        raise SdbError("LIVE SELECT requires a table")
+    lid = Uuid.new_v4()
+    sub = SubscriptionDef(
+        id=str(lid.u),
+        ns=ns,
+        db=db,
+        tb=what.name,
+        expr=n.expr,
+        cond=n.cond,
+        fetch=n.fetch,
+        session_vars=dict(ctx.vars),
+        auth_level=ctx.session.auth_level,
+        rid=ctx.session.rid,
+    )
+    ctx.txn.set_val(K.lq_def(ns, db, what.name, str(lid.u)), sub)
+    ctx.ds.live_queries[str(lid.u)] = sub
+    return lid
+
+
+def _s_kill(n: KillStmt, ctx: Ctx):
+    v = evaluate(n.id, ctx)
+    if isinstance(v, str):
+        lid = v
+    elif isinstance(v, Uuid):
+        lid = str(v.u)
+    else:
+        raise SdbError("KILL requires a live query uuid")
+    sub = ctx.ds.live_queries.pop(lid, None)
+    if sub is None:
+        raise SdbError(
+            f"Can not execute KILL statement using id '{render(v)}'"
+        )
+    ctx.txn.delete(K.lq_def(sub.ns, sub.db, sub.tb, lid))
+    return NONE
+
+
+def _s_show(n: ShowStmt, ctx: Ctx):
+    from surrealdb_tpu.cf import read_changes
+
+    return read_changes(n, ctx)
+
+
+_STMTS = {
+    LetStmt: _s_let,
+    ReturnStmt: _s_return,
+    IfStmt: _s_if,
+    ForStmt: _s_for,
+    BreakStmt: _s_break,
+    ContinueStmt: _s_continue,
+    ThrowStmt: _s_throw,
+    SleepStmt: _s_sleep,
+    UseStmt: _s_use,
+    OptionStmt: _s_option,
+    SelectStmt: _s_select,
+    CreateStmt: _s_create,
+    InsertStmt: _s_insert,
+    UpdateStmt: _s_update,
+    UpsertStmt: _s_upsert,
+    DeleteStmt: _s_delete,
+    RelateStmt: _s_relate,
+    DefineNamespace: _s_define_ns,
+    DefineDatabase: _s_define_db,
+    DefineTable: _s_define_table,
+    DefineField: _s_define_field,
+    DefineIndex: _s_define_index,
+    DefineEvent: _s_define_event,
+    DefineParam: _s_define_param,
+    DefineFunction: _s_define_function,
+    DefineAnalyzer: _s_define_analyzer,
+    DefineUser: _s_define_user,
+    DefineAccess: _s_define_access,
+    DefineSequence: _s_define_sequence,
+    DefineConfig: _s_define_config,
+    RemoveStmt: _s_remove,
+    AlterTable: _s_alter,
+    RebuildIndex: _s_rebuild,
+    InfoStmt: _s_info,
+    LiveStmt: _s_live,
+    KillStmt: _s_kill,
+    ShowStmt: _s_show,
+}

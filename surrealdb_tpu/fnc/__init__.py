@@ -1,0 +1,289 @@
+"""Builtin function library (reference: core/src/fnc/, 14.9k LoC).
+
+Registry maps "family::name" -> callable(args, ctx). The vector:: family's
+batched forms live in surrealdb_tpu.ops (JAX); the scalar forms here are the
+per-row fallback the executor uses outside index scans.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import random as _random
+import secrets
+from decimal import Decimal
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.val import (
+    NONE,
+    Closure,
+    Datetime,
+    Duration,
+    Geometry,
+    Range,
+    RecordId,
+    Regex,
+    Table,
+    Uuid,
+    is_truthy,
+    render,
+    sort_key,
+    value_cmp,
+    value_eq,
+)
+
+FUNCS: dict = {}
+_NUM = (int, float, Decimal)
+
+
+def register(name):
+    def deco(fn):
+        FUNCS[name] = fn
+        return fn
+
+    return deco
+
+
+def _num(v, fname):
+    if isinstance(v, bool) or not isinstance(v, _NUM):
+        raise SdbError(f"Incorrect arguments for function {fname}(). Expected a number, got {render(v)}")
+    return v
+
+
+def _arr(v, fname):
+    if not isinstance(v, list):
+        raise SdbError(f"Incorrect arguments for function {fname}(). Expected an array, got {render(v)}")
+    return v
+
+
+def _str(v, fname):
+    if not isinstance(v, str):
+        raise SdbError(f"Incorrect arguments for function {fname}(). Expected a string, got {render(v)}")
+    return v
+
+
+def _f(v):
+    return float(v)
+
+
+# ---------------------------------------------------------------------------
+# dispatch entry points
+# ---------------------------------------------------------------------------
+
+
+def call_function(node, ctx):
+    """Evaluate a FunctionCall AST node."""
+    from surrealdb_tpu.exec.eval import evaluate
+
+    name = node.name.lower()
+    if name.startswith("fn::"):
+        return call_custom(node.name[4:], [evaluate(a, ctx) for a in node.args], ctx)
+    if name.startswith("ml::"):
+        raise SdbError("ML model execution requires the surrealml sidecar (not configured)")
+    if name == "__future__":
+        # futures evaluate lazily; this build evaluates at read time
+        return evaluate(node.args[0], ctx)
+    if name == "__point__":
+        a = evaluate(node.args[0], ctx)
+        b = evaluate(node.args[1], ctx)
+        return Geometry("Point", (float(a), float(b)))
+    fn = FUNCS.get(name)
+    if fn is None:
+        raise SdbError(f"The function '{node.name}' does not exist")
+    # closure-taking functions get raw AST access via ctx
+    args = [evaluate(a, ctx) for a in node.args]
+    return fn(args, ctx)
+
+
+def call_custom(name, args, ctx):
+    """fn::name(...) — user-defined function from the catalog."""
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.catalog import FunctionDef
+    from surrealdb_tpu.exec.coerce import coerce
+    from surrealdb_tpu.exec.eval import evaluate
+    from surrealdb_tpu.err import ReturnException
+
+    ns, db = ctx.need_ns_db()
+    fd = ctx.txn.get_val(K.fc_def(ns, db, name))
+    if not isinstance(fd, FunctionDef):
+        raise SdbError(f"The function 'fn::{name}' does not exist")
+    c = ctx.child()
+    for i, (pname, pkind) in enumerate(fd.args):
+        v = args[i] if i < len(args) else NONE
+        if pkind is not None:
+            v = coerce(v, pkind)
+        c.vars[pname] = v
+    try:
+        out = evaluate(fd.block, c)
+    except ReturnException as r:
+        out = r.value
+    if fd.returns is not None:
+        out = coerce(out, fd.returns)
+    return out
+
+
+_METHOD_FAMILIES = [
+    (list, "array"),
+    (str, "string"),
+    (dict, "object"),
+    (RecordId, "record"),
+    ((bytes, bytearray), "bytes"),
+    (Duration, "duration"),
+    (Datetime, "time"),
+    (Geometry, "geo"),
+    ((int, float, Decimal), "math"),
+    (Uuid, "string"),
+    (Range, "range"),
+    (Closure, "function"),
+]
+
+
+def method_call(val, name, args, ctx):
+    """value.method(args) — resolve to family::method(val, ...)."""
+    name = name.lower()
+    candidates = []
+    for typ, fam in _METHOD_FAMILIES:
+        if isinstance(val, typ):
+            candidates.append(f"{fam}::{name}")
+            break
+    candidates += [f"type::{name}", f"value::{name}", name]
+    # .is_string() style -> type::is::string
+    if name.startswith("is_"):
+        candidates.insert(0, f"type::is::{name[3:]}")
+    if name.startswith("to_"):
+        candidates.insert(0, f"type::{name[3:]}")
+    for cand in candidates:
+        fn = FUNCS.get(cand)
+        if fn is not None:
+            return fn([val] + args, ctx)
+    # chained custom function: .fn::foo()
+    raise SdbError(f"The method '{name}' does not exist for {render(val)}")
+
+
+# ---------------------------------------------------------------------------
+# count / not / sleep / rand
+# ---------------------------------------------------------------------------
+
+
+@register("count")
+def _count(args, ctx):
+    if not args:
+        return 1
+    v = args[0]
+    if isinstance(v, list):
+        return len(v)
+    return 1 if is_truthy(v) else 0
+
+
+@register("not")
+def _not(args, ctx):
+    return not is_truthy(args[0])
+
+
+@register("sleep")
+def _sleep(args, ctx):
+    import time as _t
+
+    d = args[0]
+    if isinstance(d, Duration):
+        _t.sleep(min(d.to_seconds(), 30))
+    return NONE
+
+
+@register("rand")
+def _rand(args, ctx):
+    return _random.random()
+
+
+@register("rand::bool")
+def _rand_bool(args, ctx):
+    return _random.random() < 0.5
+
+
+@register("rand::enum")
+def _rand_enum(args, ctx):
+    if len(args) == 1 and isinstance(args[0], list):
+        return _random.choice(args[0]) if args[0] else NONE
+    return _random.choice(args) if args else NONE
+
+
+@register("rand::float")
+def _rand_float(args, ctx):
+    if len(args) == 2:
+        return _random.uniform(_f(args[0]), _f(args[1]))
+    return _random.random()
+
+
+@register("rand::guid")
+def _rand_guid(args, ctx):
+    n = args[0] if args else 20
+    return "".join(_random.choices("0123456789abcdefghijklmnopqrstuvwxyz", k=int(n)))
+
+
+@register("rand::int")
+def _rand_int(args, ctx):
+    if len(args) == 2:
+        return _random.randint(int(args[0]), int(args[1]))
+    return _random.randint(-(2**63), 2**63 - 1)
+
+
+@register("rand::string")
+def _rand_string(args, ctx):
+    import string as _s
+
+    chars = _s.ascii_letters + _s.digits
+    if len(args) == 2:
+        n = _random.randint(int(args[0]), int(args[1]))
+    elif len(args) == 1:
+        n = int(args[0])
+    else:
+        n = 32
+    return "".join(_random.choices(chars, k=n))
+
+
+@register("rand::time")
+def _rand_time(args, ctx):
+    import datetime as _dt
+
+    if len(args) == 2 and isinstance(args[0], Datetime):
+        lo, hi = args[0].epoch_ns() // 10**9, args[1].epoch_ns() // 10**9
+    elif len(args) == 2:
+        lo, hi = int(args[0]), int(args[1])
+    else:
+        lo, hi = 0, 2**31 - 1
+    s = _random.randint(lo, hi)
+    return Datetime(_dt.datetime.fromtimestamp(s, _dt.timezone.utc))
+
+
+@register("rand::uuid")
+def _rand_uuid(args, ctx):
+    return Uuid.new_v4()
+
+
+@register("rand::uuid::v4")
+def _rand_uuid4(args, ctx):
+    return Uuid.new_v4()
+
+
+@register("rand::uuid::v7")
+def _rand_uuid7(args, ctx):
+    return Uuid.new_v7()
+
+
+@register("rand::ulid")
+def _rand_ulid(args, ctx):
+    from surrealdb_tpu.exec.eval import generate_record_key
+
+    return generate_record_key("__gen_ulid__")
+
+
+# family modules register themselves on import
+from surrealdb_tpu.fnc import (  # noqa: E402,F401
+    array_fns,
+    misc_fns,
+    math_fns,
+    string_fns,
+    time_fns,
+    type_fns,
+    vector_fns,
+)

@@ -1,0 +1,535 @@
+"""array:: and set:: functions (reference: core/src/fnc/array.rs)."""
+
+from __future__ import annotations
+
+import random as _random
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.fnc import _arr, _num, register
+from surrealdb_tpu.val import (
+    NONE,
+    Closure,
+    is_truthy,
+    sort_key,
+    value_cmp,
+    value_eq,
+)
+
+
+def _call(clo, args, ctx):
+    from surrealdb_tpu.exec.eval import call_closure
+
+    if not isinstance(clo, Closure):
+        raise SdbError("Expected a closure argument")
+    return call_closure(clo, args, ctx)
+
+
+def _dedup(items):
+    out = []
+    for x in items:
+        if not any(value_eq(x, y) for y in out):
+            out.append(x)
+    return out
+
+
+@register("array::add")
+def _add(args, ctx):
+    a = _arr(args[0], "array::add")[:]
+    v = args[1]
+    vs = v if isinstance(v, list) else [v]
+    for x in vs:
+        if not any(value_eq(x, y) for y in a):
+            a.append(x)
+    return a
+
+
+@register("array::all")
+def _all(args, ctx):
+    a = _arr(args[0], "array::all")
+    if len(args) > 1:
+        if isinstance(args[1], Closure):
+            return all(is_truthy(_call(args[1], [x], ctx)) for x in a)
+        return all(value_eq(x, args[1]) for x in a)
+    return all(is_truthy(x) for x in a)
+
+
+@register("array::any")
+def _any(args, ctx):
+    a = _arr(args[0], "array::any")
+    if len(args) > 1:
+        if isinstance(args[1], Closure):
+            return any(is_truthy(_call(args[1], [x], ctx)) for x in a)
+        return any(value_eq(x, args[1]) for x in a)
+    return any(is_truthy(x) for x in a)
+
+
+@register("array::append")
+def _append(args, ctx):
+    return _arr(args[0], "array::append")[:] + [args[1]]
+
+
+@register("array::at")
+def _at(args, ctx):
+    a = _arr(args[0], "array::at")
+    i = int(_num(args[1], "array::at"))
+    if -len(a) <= i < len(a):
+        return a[i]
+    return NONE
+
+
+@register("array::boolean_and")
+def _band(args, ctx):
+    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    n = max(len(a), len(b))
+    ga = a + [NONE] * (n - len(a))
+    gb = b + [NONE] * (n - len(b))
+    return [is_truthy(x) and is_truthy(y) for x, y in zip(ga, gb)]
+
+
+@register("array::boolean_or")
+def _bor(args, ctx):
+    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    n = max(len(a), len(b))
+    ga = a + [NONE] * (n - len(a))
+    gb = b + [NONE] * (n - len(b))
+    return [is_truthy(x) or is_truthy(y) for x, y in zip(ga, gb)]
+
+
+@register("array::boolean_xor")
+def _bxor(args, ctx):
+    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    n = max(len(a), len(b))
+    ga = a + [NONE] * (n - len(a))
+    gb = b + [NONE] * (n - len(b))
+    return [is_truthy(x) != is_truthy(y) for x, y in zip(ga, gb)]
+
+
+@register("array::boolean_not")
+def _bnot(args, ctx):
+    return [not is_truthy(x) for x in _arr(args[0], "f")]
+
+
+@register("array::clump")
+def _clump(args, ctx):
+    a = _arr(args[0], "array::clump")
+    n = int(_num(args[1], "array::clump"))
+    if n < 1:
+        raise SdbError("Incorrect arguments for function array::clump(). The second argument must be an integer greater than 0")
+    return [a[i : i + n] for i in range(0, len(a), n)]
+
+
+@register("array::combine")
+def _combine(args, ctx):
+    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    return [[x, y] for x in a for y in b]
+
+
+@register("array::complement")
+def _complement(args, ctx):
+    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    return [x for x in a if not any(value_eq(x, y) for y in b)]
+
+
+@register("array::concat")
+def _concat(args, ctx):
+    out = []
+    for a in args:
+        out.extend(_arr(a, "array::concat"))
+    return out
+
+
+@register("array::difference")
+def _difference(args, ctx):
+    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    out = [x for x in a if not any(value_eq(x, y) for y in b)]
+    out += [y for y in b if not any(value_eq(y, x) for x in a)]
+    return out
+
+
+@register("array::distinct")
+def _distinct(args, ctx):
+    return _dedup(_arr(args[0], "array::distinct"))
+
+
+@register("array::fill")
+def _fill(args, ctx):
+    a = _arr(args[0], "array::fill")[:]
+    v = args[1]
+    beg = int(args[2]) if len(args) > 2 else 0
+    end = int(args[3]) if len(args) > 3 else len(a)
+    for i in range(max(beg, 0), min(end, len(a))):
+        a[i] = v
+    return a
+
+
+@register("array::filter")
+def _filter(args, ctx):
+    a = _arr(args[0], "array::filter")
+    p = args[1]
+    if isinstance(p, Closure):
+        return [x for x in a if is_truthy(_call(p, [x], ctx))]
+    return [x for x in a if value_eq(x, p)]
+
+
+@register("array::filter_index")
+def _filter_index(args, ctx):
+    a = _arr(args[0], "array::filter_index")
+    p = args[1]
+    if isinstance(p, Closure):
+        return [i for i, x in enumerate(a) if is_truthy(_call(p, [x], ctx))]
+    return [i for i, x in enumerate(a) if value_eq(x, p)]
+
+
+@register("array::find")
+def _find(args, ctx):
+    a = _arr(args[0], "array::find")
+    p = args[1]
+    if isinstance(p, Closure):
+        for x in a:
+            if is_truthy(_call(p, [x], ctx)):
+                return x
+        return NONE
+    for x in a:
+        if value_eq(x, p):
+            return x
+    return NONE
+
+
+@register("array::find_index")
+def _find_index(args, ctx):
+    a = _arr(args[0], "array::find_index")
+    p = args[1]
+    for i, x in enumerate(a):
+        if isinstance(p, Closure):
+            if is_truthy(_call(p, [x], ctx)):
+                return i
+        elif value_eq(x, p):
+            return i
+    return NONE
+
+
+@register("array::first")
+def _first(args, ctx):
+    a = _arr(args[0], "array::first")
+    return a[0] if a else NONE
+
+
+@register("array::flatten")
+def _flatten(args, ctx):
+    out = []
+    for x in _arr(args[0], "array::flatten"):
+        if isinstance(x, list):
+            out.extend(x)
+        else:
+            out.append(x)
+    return out
+
+
+@register("array::fold")
+def _fold(args, ctx):
+    a = _arr(args[0], "array::fold")
+    acc = args[1]
+    clo = args[2]
+    for i, x in enumerate(a):
+        acc = _call(clo, [acc, x, i], ctx)
+    return acc
+
+
+@register("array::group")
+def _group(args, ctx):
+    out = []
+    for x in _arr(args[0], "array::group"):
+        items = x if isinstance(x, list) else [x]
+        for y in items:
+            if not any(value_eq(y, z) for z in out):
+                out.append(y)
+    return out
+
+
+@register("array::insert")
+def _insert(args, ctx):
+    a = _arr(args[0], "array::insert")[:]
+    v = args[1]
+    i = int(args[2]) if len(args) > 2 else len(a)
+    if i < 0:
+        i += len(a) + 1
+    a.insert(i, v)
+    return a
+
+
+@register("array::intersect")
+def _intersect(args, ctx):
+    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    return [x for x in _dedup(a) if any(value_eq(x, y) for y in b)]
+
+
+@register("array::is_empty")
+def _is_empty(args, ctx):
+    return len(_arr(args[0], "array::is_empty")) == 0
+
+
+@register("array::join")
+def _join(args, ctx):
+    from surrealdb_tpu.exec.operators import to_string
+
+    sep = args[1] if len(args) > 1 else ""
+    return sep.join(to_string(x) for x in _arr(args[0], "array::join"))
+
+
+@register("array::last")
+def _last(args, ctx):
+    a = _arr(args[0], "array::last")
+    return a[-1] if a else NONE
+
+
+@register("array::len")
+def _len(args, ctx):
+    return len(_arr(args[0], "array::len"))
+
+
+@register("array::logical_and")
+def _land(args, ctx):
+    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    n = max(len(a), len(b))
+    out = []
+    for i in range(n):
+        x = a[i] if i < len(a) else NONE
+        y = b[i] if i < len(b) else NONE
+        out.append(y if is_truthy(x) else x)
+    return out
+
+
+@register("array::logical_or")
+def _lor(args, ctx):
+    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    n = max(len(a), len(b))
+    out = []
+    for i in range(n):
+        x = a[i] if i < len(a) else NONE
+        y = b[i] if i < len(b) else NONE
+        out.append(x if is_truthy(x) else y)
+    return out
+
+
+@register("array::logical_xor")
+def _lxor(args, ctx):
+    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    n = max(len(a), len(b))
+    out = []
+    for i in range(n):
+        x = a[i] if i < len(a) else NONE
+        y = b[i] if i < len(b) else NONE
+        tx, ty = is_truthy(x), is_truthy(y)
+        if tx and not ty:
+            out.append(x)
+        elif ty and not tx:
+            out.append(y)
+        else:
+            out.append(False)
+    return out
+
+
+@register("array::map")
+def _map(args, ctx):
+    a = _arr(args[0], "array::map")
+    clo = args[1]
+    return [_call(clo, [x, i], ctx) for i, x in enumerate(a)]
+
+
+@register("array::matches")
+def _matches(args, ctx):
+    a = _arr(args[0], "array::matches")
+    return [value_eq(x, args[1]) for x in a]
+
+
+@register("array::max")
+def _max(args, ctx):
+    a = _arr(args[0], "array::max")
+    return max(a, key=sort_key) if a else NONE
+
+
+@register("array::min")
+def _min(args, ctx):
+    a = _arr(args[0], "array::min")
+    return min(a, key=sort_key) if a else NONE
+
+
+@register("array::pop")
+def _pop(args, ctx):
+    a = _arr(args[0], "array::pop")
+    return a[-1] if a else NONE
+
+
+@register("array::prepend")
+def _prepend(args, ctx):
+    return [args[1]] + _arr(args[0], "array::prepend")
+
+
+@register("array::push")
+def _push(args, ctx):
+    return _arr(args[0], "array::push")[:] + [args[1]]
+
+
+@register("array::range")
+def _range(args, ctx):
+    beg = int(_num(args[0], "array::range"))
+    n = int(_num(args[1], "array::range"))
+    if n < 0:
+        raise SdbError("Incorrect arguments for function array::range(). The second argument must be a non-negative integer")
+    return list(range(beg, beg + n))
+
+
+@register("array::reduce")
+def _reduce(args, ctx):
+    a = _arr(args[0], "array::reduce")
+    clo = args[1]
+    if not a:
+        return NONE
+    acc = a[0]
+    for i, x in enumerate(a[1:]):
+        acc = _call(clo, [acc, x, i + 1], ctx)
+    return acc
+
+
+@register("array::remove")
+def _remove(args, ctx):
+    a = _arr(args[0], "array::remove")[:]
+    i = int(_num(args[1], "array::remove"))
+    if -len(a) <= i < len(a):
+        a.pop(i)
+    return a
+
+
+@register("array::repeat")
+def _repeat(args, ctx):
+    n = int(_num(args[1], "array::repeat"))
+    return [args[0]] * n
+
+
+@register("array::reverse")
+def _reverse(args, ctx):
+    return list(reversed(_arr(args[0], "array::reverse")))
+
+
+@register("array::shuffle")
+def _shuffle(args, ctx):
+    a = _arr(args[0], "array::shuffle")[:]
+    _random.shuffle(a)
+    return a
+
+
+@register("array::slice")
+def _slice(args, ctx):
+    a = _arr(args[0], "array::slice")
+    beg = int(args[1]) if len(args) > 1 else 0
+    n = int(args[2]) if len(args) > 2 else None
+    if beg < 0:
+        beg += len(a)
+    if n is None:
+        return a[beg:]
+    if n < 0:
+        return a[beg : len(a) + n]
+    return a[beg : beg + n]
+
+
+@register("array::sort")
+def _sort(args, ctx):
+    a = _arr(args[0], "array::sort")[:]
+    asc = True
+    if len(args) > 1:
+        v = args[1]
+        if v is False or (isinstance(v, str) and v.lower() == "desc"):
+            asc = False
+    a.sort(key=sort_key, reverse=not asc)
+    return a
+
+
+@register("array::sort::asc")
+def _sort_asc(args, ctx):
+    return _sort([args[0]], ctx)
+
+
+@register("array::sort::desc")
+def _sort_desc(args, ctx):
+    return _sort([args[0], False], ctx)
+
+
+@register("array::sort_natural")
+def _sort_natural(args, ctx):
+    return _sort(args, ctx)
+
+
+@register("array::sort_lexical")
+def _sort_lexical(args, ctx):
+    return _sort(args, ctx)
+
+
+@register("array::sort_natural_lexical")
+def _sort_nl(args, ctx):
+    return _sort(args, ctx)
+
+
+@register("array::swap")
+def _swap(args, ctx):
+    a = _arr(args[0], "array::swap")[:]
+    i, j = int(args[1]), int(args[2])
+    n = len(a)
+    if i < 0:
+        i += n
+    if j < 0:
+        j += n
+    if not (0 <= i < n and 0 <= j < n):
+        raise SdbError(f"Incorrect arguments for function array::swap(). Argument 1 is out of range")
+    a[i], a[j] = a[j], a[i]
+    return a
+
+
+@register("array::transpose")
+def _transpose(args, ctx):
+    a = _arr(args[0], "array::transpose")
+    if not a:
+        return []
+    n = max(len(x) if isinstance(x, list) else 1 for x in a)
+    out = []
+    for i in range(n):
+        row = []
+        for x in a:
+            if isinstance(x, list):
+                if i < len(x):
+                    row.append(x[i])
+            elif i == 0:
+                row.append(x)
+        out.append(row)
+    return out
+
+
+@register("array::union")
+def _union(args, ctx):
+    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    return _dedup(a + b)
+
+
+@register("array::windows")
+def _windows(args, ctx):
+    a = _arr(args[0], "array::windows")
+    n = int(_num(args[1], "array::windows"))
+    if n < 1:
+        raise SdbError("Incorrect arguments for function array::windows(). The second argument must be an integer greater than 0")
+    return [a[i : i + n] for i in range(0, len(a) - n + 1)]
+
+
+# set:: aliases (sets are deduplicated arrays)
+for _name in ("add", "complement", "difference", "intersect", "union"):
+    FUNCS_ALIAS = f"set::{_name}"
+
+from surrealdb_tpu.fnc import FUNCS as _F  # noqa: E402
+
+_F["set::add"] = _F["array::add"]
+_F["set::complement"] = _F["array::complement"]
+_F["set::difference"] = _F["array::difference"]
+_F["set::intersect"] = _F["array::intersect"]
+_F["set::union"] = _F["array::union"]
+_F["set::len"] = _F["array::len"]
+_F["set::contains"] = lambda args, ctx: any(
+    value_eq(x, args[1]) for x in _arr(args[0], "set::contains")
+)

@@ -1,0 +1,286 @@
+"""math:: functions incl. stats (reference: core/src/fnc/math.rs + util/math)."""
+
+from __future__ import annotations
+
+import math
+from decimal import Decimal
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.fnc import _arr, _num, register
+from surrealdb_tpu.val import NONE, sort_key
+
+
+def _nums(a, fname):
+    out = []
+    for x in _arr(a, fname):
+        if isinstance(x, bool) or not isinstance(x, (int, float, Decimal)):
+            continue
+        out.append(float(x))
+    return out
+
+
+def _unary(name, fn):
+    @register(f"math::{name}")
+    def _f(args, ctx, fn=fn, name=name):
+        v = _num(args[0], f"math::{name}")
+        try:
+            return fn(v)
+        except (ValueError, OverflowError):
+            return float("nan")
+
+
+_unary("abs", lambda v: abs(v))
+_unary("acos", lambda v: math.acos(v))
+_unary("acot", lambda v: math.atan(1 / v) if v != 0 else math.pi / 2)
+_unary("asin", lambda v: math.asin(v))
+_unary("atan", lambda v: math.atan(v))
+_unary("cos", lambda v: math.cos(v))
+_unary("cot", lambda v: 1 / math.tan(v))
+_unary("deg2rad", lambda v: math.radians(v))
+_unary("ln", lambda v: math.log(v))
+_unary("log10", lambda v: math.log10(v))
+_unary("log2", lambda v: math.log2(v))
+_unary("rad2deg", lambda v: math.degrees(v))
+_unary("sign", lambda v: (v > 0) - (v < 0))
+_unary("sin", lambda v: math.sin(v))
+_unary("sqrt", lambda v: math.sqrt(v))
+_unary("tan", lambda v: math.tan(v))
+
+
+@register("math::ceil")
+def _ceil(args, ctx):
+    v = _num(args[0], "math::ceil")
+    if isinstance(v, int):
+        return v
+    if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+        return v
+    return math.ceil(v)
+
+
+@register("math::floor")
+def _floor(args, ctx):
+    v = _num(args[0], "math::floor")
+    if isinstance(v, int):
+        return v
+    if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+        return v
+    return math.floor(v)
+
+
+@register("math::round")
+def _round(args, ctx):
+    v = _num(args[0], "math::round")
+    if isinstance(v, int):
+        return v
+    if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+        return v
+    # half-away-from-zero like Rust's round()
+    return int(math.floor(v + 0.5)) if v >= 0 else int(math.ceil(v - 0.5))
+
+
+@register("math::fixed")
+def _fixed(args, ctx):
+    v = _num(args[0], "math::fixed")
+    p = int(_num(args[1], "math::fixed"))
+    if p <= 0:
+        raise SdbError("Incorrect arguments for function math::fixed(). The second argument must be an integer greater than 0")
+    if isinstance(v, int):
+        return v
+    return round(float(v), p)
+
+
+@register("math::clamp")
+def _clamp(args, ctx):
+    v = _num(args[0], "math::clamp")
+    lo = _num(args[1], "math::clamp")
+    hi = _num(args[2], "math::clamp")
+    return max(lo, min(hi, v))
+
+
+@register("math::lerp")
+def _lerp(args, ctx):
+    a = float(_num(args[0], "math::lerp"))
+    b = float(_num(args[1], "math::lerp"))
+    t = float(_num(args[2], "math::lerp"))
+    return a + (b - a) * t
+
+
+@register("math::lerpangle")
+def _lerpangle(args, ctx):
+    a = float(_num(args[0], "math::lerpangle"))
+    b = float(_num(args[1], "math::lerpangle"))
+    t = float(_num(args[2], "math::lerpangle"))
+    d = (b - a) % 360.0
+    if d > 180.0:
+        d -= 360.0
+    return a + d * t
+
+
+@register("math::log")
+def _log(args, ctx):
+    v = float(_num(args[0], "math::log"))
+    base = float(_num(args[1], "math::log"))
+    try:
+        return math.log(v, base)
+    except (ValueError, ZeroDivisionError):
+        return float("nan")
+
+
+@register("math::pow")
+def _pow(args, ctx):
+    from surrealdb_tpu.exec.operators import pow_
+
+    return pow_(args[0], args[1])
+
+
+@register("math::max")
+def _mmax(args, ctx):
+    a = _arr(args[0], "math::max")
+    return max(a, key=sort_key) if a else NONE
+
+
+@register("math::min")
+def _mmin(args, ctx):
+    a = _arr(args[0], "math::min")
+    return min(a, key=sort_key) if a else NONE
+
+
+@register("math::sum")
+def _sum(args, ctx):
+    total = 0
+    for x in _arr(args[0], "math::sum"):
+        if isinstance(x, bool) or not isinstance(x, (int, float, Decimal)):
+            continue
+        if isinstance(x, Decimal) and not isinstance(total, Decimal):
+            total = Decimal(str(total))
+        total = total + x
+    return total
+
+
+@register("math::product")
+def _product(args, ctx):
+    total = 1
+    for x in _arr(args[0], "math::product"):
+        if isinstance(x, bool) or not isinstance(x, (int, float, Decimal)):
+            continue
+        total = total * x
+    return total
+
+
+@register("math::mean")
+def _mean(args, ctx):
+    ns = _nums(args[0], "math::mean")
+    if not ns:
+        return float("nan")
+    return sum(ns) / len(ns)
+
+
+@register("math::median")
+def _median(args, ctx):
+    ns = sorted(_nums(args[0], "math::median"))
+    if not ns:
+        return float("nan")
+    n = len(ns)
+    if n % 2:
+        return ns[n // 2]
+    return (ns[n // 2 - 1] + ns[n // 2]) / 2
+
+
+@register("math::mode")
+def _mode(args, ctx):
+    ns = _nums(args[0], "math::mode")
+    if not ns:
+        return float("nan")
+    from collections import Counter
+
+    c = Counter(ns)
+    best = max(c.items(), key=lambda kv: (kv[1], kv[0]))
+    v = best[0]
+    return int(v) if v == int(v) else v
+
+
+@register("math::variance")
+def _variance(args, ctx):
+    ns = _nums(args[0], "math::variance")
+    if len(ns) < 2:
+        return float("nan")
+    m = sum(ns) / len(ns)
+    return sum((x - m) ** 2 for x in ns) / (len(ns) - 1)
+
+
+@register("math::stddev")
+def _stddev(args, ctx):
+    v = _variance(args, ctx)
+    return math.sqrt(v) if not math.isnan(v) else v
+
+
+@register("math::spread")
+def _spread(args, ctx):
+    ns = _nums(args[0], "math::spread")
+    if not ns:
+        return float("nan")
+    return max(ns) - min(ns)
+
+
+@register("math::percentile")
+def _percentile(args, ctx):
+    ns = sorted(_nums(args[0], "math::percentile"))
+    p = float(_num(args[1], "math::percentile"))
+    if not ns:
+        return float("nan")
+    if len(ns) == 1:
+        return ns[0]
+    rank = (p / 100.0) * (len(ns) - 1)
+    lo = int(math.floor(rank))
+    hi = int(math.ceil(rank))
+    if lo == hi:
+        return ns[lo]
+    return ns[lo] + (ns[hi] - ns[lo]) * (rank - lo)
+
+
+@register("math::nearestrank")
+def _nearestrank(args, ctx):
+    ns = sorted(_nums(args[0], "math::nearestrank"))
+    p = float(_num(args[1], "math::nearestrank"))
+    if not ns:
+        return float("nan")
+    rank = int(math.ceil((p / 100.0) * len(ns)))
+    rank = max(1, min(rank, len(ns)))
+    return ns[rank - 1]
+
+
+@register("math::interquartile")
+def _interquartile(args, ctx):
+    return _percentile([args[0], 75], ctx) - _percentile([args[0], 25], ctx)
+
+
+@register("math::midhinge")
+def _midhinge(args, ctx):
+    return (_percentile([args[0], 75], ctx) + _percentile([args[0], 25], ctx)) / 2
+
+
+@register("math::trimean")
+def _trimean(args, ctx):
+    return (
+        _percentile([args[0], 25], ctx)
+        + 2 * _percentile([args[0], 50], ctx)
+        + _percentile([args[0], 75], ctx)
+    ) / 4
+
+
+@register("math::top")
+def _top(args, ctx):
+    a = _arr(args[0], "math::top")
+    n = int(_num(args[1], "math::top"))
+    if n < 1:
+        raise SdbError("Incorrect arguments for function math::top(). The second argument must be an integer greater than 0")
+    return sorted(a, key=sort_key)[-n:]
+
+
+@register("math::bottom")
+def _bottom(args, ctx):
+    a = _arr(args[0], "math::bottom")
+    n = int(_num(args[1], "math::bottom"))
+    if n < 1:
+        raise SdbError("Incorrect arguments for function math::bottom(). The second argument must be an integer greater than 0")
+    return sorted(a, key=sort_key)[:n][::-1]

@@ -1,0 +1,554 @@
+"""crypto::, parse::, encoding::, geo::, bytes::, session::, sequence::,
+value::, search::, http::, api:: families (reference: core/src/fnc/)."""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import math
+import secrets
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.fnc import _arr, _num, _str, register
+from surrealdb_tpu.val import NONE, Geometry, RecordId, render
+
+
+# -- crypto -------------------------------------------------------------------
+
+
+@register("crypto::md5")
+def _md5(args, ctx):
+    return hashlib.md5(_str(args[0], "crypto::md5").encode()).hexdigest()
+
+
+@register("crypto::sha1")
+def _sha1(args, ctx):
+    return hashlib.sha1(_str(args[0], "crypto::sha1").encode()).hexdigest()
+
+
+@register("crypto::sha256")
+def _sha256(args, ctx):
+    return hashlib.sha256(_str(args[0], "crypto::sha256").encode()).hexdigest()
+
+
+@register("crypto::sha512")
+def _sha512(args, ctx):
+    return hashlib.sha512(_str(args[0], "crypto::sha512").encode()).hexdigest()
+
+
+@register("crypto::blake3")
+def _blake3(args, ctx):
+    # stdlib has no blake3; blake2b is the closest available construction
+    return hashlib.blake2b(_str(args[0], "crypto::blake3").encode()).hexdigest()
+
+
+# password hashing: pbkdf2 and scrypt are real; argon2/bcrypt use a
+# pbkdf2-backed phc format (no native argon2/bcrypt libs in this image)
+
+
+def _pbkdf2_hash(pw: str, rounds=600_000) -> str:
+    salt = secrets.token_bytes(16)
+    dk = hashlib.pbkdf2_hmac("sha256", pw.encode(), salt, rounds)
+    return f"$pbkdf2-sha256$i={rounds}${salt.hex()}${dk.hex()}"
+
+
+def _pbkdf2_compare(h: str, pw: str) -> bool:
+    try:
+        _, alg, iters, salt, dk = h.split("$")
+        rounds = int(iters.split("=")[1])
+        got = hashlib.pbkdf2_hmac("sha256", pw.encode(), bytes.fromhex(salt), rounds)
+        return _hmac.compare_digest(got.hex(), dk)
+    except (ValueError, IndexError):
+        return False
+
+
+def _scrypt_hash(pw: str) -> str:
+    salt = secrets.token_bytes(16)
+    dk = hashlib.scrypt(pw.encode(), salt=salt, n=2**14, r=8, p=1)
+    return f"$scrypt$n=16384,r=8,p=1${salt.hex()}${dk.hex()}"
+
+
+def _scrypt_compare(h: str, pw: str) -> bool:
+    try:
+        parts = h.split("$")
+        salt, dk = parts[3], parts[4]
+        got = hashlib.scrypt(pw.encode(), salt=bytes.fromhex(salt), n=2**14, r=8, p=1)
+        return _hmac.compare_digest(got.hex(), dk)
+    except (ValueError, IndexError):
+        return False
+
+
+@register("crypto::pbkdf2::generate")
+def _pbkdf2_gen(args, ctx):
+    return _pbkdf2_hash(_str(args[0], "f"))
+
+
+@register("crypto::pbkdf2::compare")
+def _pbkdf2_cmp(args, ctx):
+    return _pbkdf2_compare(_str(args[0], "f"), _str(args[1], "f"))
+
+
+@register("crypto::scrypt::generate")
+def _scrypt_gen(args, ctx):
+    return _scrypt_hash(_str(args[0], "f"))
+
+
+@register("crypto::scrypt::compare")
+def _scrypt_cmp(args, ctx):
+    return _scrypt_compare(_str(args[0], "f"), _str(args[1], "f"))
+
+
+@register("crypto::argon2::generate")
+def _argon2_gen(args, ctx):
+    return _pbkdf2_hash(_str(args[0], "f"))
+
+
+@register("crypto::argon2::compare")
+def _argon2_cmp(args, ctx):
+    return _pbkdf2_compare(_str(args[0], "f"), _str(args[1], "f"))
+
+
+@register("crypto::bcrypt::generate")
+def _bcrypt_gen(args, ctx):
+    return _pbkdf2_hash(_str(args[0], "f"))
+
+
+@register("crypto::bcrypt::compare")
+def _bcrypt_cmp(args, ctx):
+    return _pbkdf2_compare(_str(args[0], "f"), _str(args[1], "f"))
+
+
+def password_hash(pw: str) -> str:
+    return _pbkdf2_hash(pw, rounds=100_000)
+
+
+def password_compare(h: str, pw: str) -> bool:
+    if h.startswith("$pbkdf2"):
+        return _pbkdf2_compare(h, pw)
+    if h.startswith("$scrypt"):
+        return _scrypt_compare(h, pw)
+    return False
+
+
+# -- parse --------------------------------------------------------------------
+
+
+@register("parse::email::host")
+def _email_host(args, ctx):
+    s = _str(args[0], "f")
+    return s.rsplit("@", 1)[1] if "@" in s else NONE
+
+
+@register("parse::email::user")
+def _email_user(args, ctx):
+    s = _str(args[0], "f")
+    return s.rsplit("@", 1)[0] if "@" in s else NONE
+
+
+def _url(args):
+    from urllib.parse import urlparse
+
+    return urlparse(args[0])
+
+
+@register("parse::url::domain")
+def _url_domain(args, ctx):
+    h = _url(args).hostname
+    return h if h else NONE
+
+
+@register("parse::url::host")
+def _url_host(args, ctx):
+    h = _url(args).hostname
+    return h if h else NONE
+
+
+@register("parse::url::fragment")
+def _url_fragment(args, ctx):
+    f = _url(args).fragment
+    return f if f else NONE
+
+
+@register("parse::url::path")
+def _url_path(args, ctx):
+    return _url(args).path or NONE
+
+
+@register("parse::url::port")
+def _url_port(args, ctx):
+    p = _url(args).port
+    return p if p is not None else NONE
+
+
+@register("parse::url::query")
+def _url_query(args, ctx):
+    q = _url(args).query
+    return q if q else NONE
+
+
+@register("parse::url::scheme")
+def _url_scheme(args, ctx):
+    s = _url(args).scheme
+    return s if s else NONE
+
+
+# -- encoding -----------------------------------------------------------------
+
+
+@register("encoding::base64::encode")
+def _b64_encode(args, ctx):
+    import base64
+
+    v = args[0]
+    data = v if isinstance(v, (bytes, bytearray)) else _str(v, "f").encode()
+    return base64.b64encode(bytes(data)).decode().rstrip("=")
+
+
+@register("encoding::base64::decode")
+def _b64_decode(args, ctx):
+    import base64
+
+    s = _str(args[0], "f")
+    pad = "=" * (-len(s) % 4)
+    return base64.b64decode(s + pad)
+
+
+@register("string::base64_encode")
+def _b64e2(args, ctx):
+    return _b64_encode(args, ctx)
+
+
+# -- bytes --------------------------------------------------------------------
+
+
+@register("bytes::len")
+def _bytes_len(args, ctx):
+    v = args[0]
+    if not isinstance(v, (bytes, bytearray)):
+        raise SdbError("Incorrect arguments for function bytes::len(). Expected bytes")
+    return len(v)
+
+
+# -- geo ----------------------------------------------------------------------
+
+_EARTH_R = 6371008.8  # meters (mean earth radius)
+
+
+def _pt(v, fname):
+    if isinstance(v, Geometry) and v.kind == "Point":
+        return float(v.coords[0]), float(v.coords[1])
+    raise SdbError(f"Incorrect arguments for function {fname}(). Expected a point")
+
+
+@register("geo::distance")
+def _geo_distance(args, ctx):
+    (lon1, lat1) = _pt(args[0], "geo::distance")
+    (lon2, lat2) = _pt(args[1], "geo::distance")
+    p1, p2 = math.radians(lat1), math.radians(lat2)
+    dp = math.radians(lat2 - lat1)
+    dl = math.radians(lon2 - lon1)
+    a = math.sin(dp / 2) ** 2 + math.cos(p1) * math.cos(p2) * math.sin(dl / 2) ** 2
+    return _EARTH_R * 2 * math.atan2(math.sqrt(a), math.sqrt(1 - a))
+
+
+@register("geo::bearing")
+def _geo_bearing(args, ctx):
+    (lon1, lat1) = _pt(args[0], "geo::bearing")
+    (lon2, lat2) = _pt(args[1], "geo::bearing")
+    p1, p2 = math.radians(lat1), math.radians(lat2)
+    dl = math.radians(lon2 - lon1)
+    x = math.sin(dl) * math.cos(p2)
+    y = math.cos(p1) * math.sin(p2) - math.sin(p1) * math.cos(p2) * math.cos(dl)
+    return (math.degrees(math.atan2(x, y)) + 360) % 360
+
+
+@register("geo::centroid")
+def _geo_centroid(args, ctx):
+    from surrealdb_tpu.exec.operators import _points_of
+
+    v = args[0]
+    if not isinstance(v, Geometry):
+        raise SdbError("Incorrect arguments for function geo::centroid(). Expected a geometry")
+    pts = _points_of(v)
+    if not pts:
+        return NONE
+    xs = sum(float(p[0]) for p in pts) / len(pts)
+    ys = sum(float(p[1]) for p in pts) / len(pts)
+    return Geometry("Point", (xs, ys))
+
+
+@register("geo::area")
+def _geo_area(args, ctx):
+    v = args[0]
+    if not isinstance(v, Geometry):
+        raise SdbError("Incorrect arguments for function geo::area(). Expected a geometry")
+
+    def ring_area(ring):
+        # spherical excess approximation via planar shoelace on lat/lon scaled
+        n = len(ring)
+        s = 0.0
+        for i in range(n):
+            x1, y1 = float(ring[i][0]), float(ring[i][1])
+            x2, y2 = float(ring[(i + 1) % n][0]), float(ring[(i + 1) % n][1])
+            s += math.radians(x2 - x1) * (
+                2 + math.sin(math.radians(y1)) + math.sin(math.radians(y2))
+            )
+        return abs(s) * _EARTH_R * _EARTH_R / 2
+
+    if v.kind == "Polygon":
+        area = ring_area(v.coords[0]) if v.coords else 0.0
+        for hole in v.coords[1:]:
+            area -= ring_area(hole)
+        return area
+    if v.kind == "MultiPolygon":
+        return sum(
+            _geo_area([Geometry("Polygon", p)], ctx) for p in v.coords
+        )
+    return 0.0
+
+
+_GH32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+
+
+@register("geo::hash::encode")
+def _geohash_encode(args, ctx):
+    lon, lat = _pt(args[0], "geo::hash::encode")
+    precision = int(args[1]) if len(args) > 1 else 12
+    lat_r, lon_r = [-90.0, 90.0], [-180.0, 180.0]
+    bits, bit, ch = 0, 0, 0
+    even = True
+    out = []
+    while len(out) < precision:
+        if even:
+            mid = (lon_r[0] + lon_r[1]) / 2
+            if lon > mid:
+                ch |= 1 << (4 - bit)
+                lon_r[0] = mid
+            else:
+                lon_r[1] = mid
+        else:
+            mid = (lat_r[0] + lat_r[1]) / 2
+            if lat > mid:
+                ch |= 1 << (4 - bit)
+                lat_r[0] = mid
+            else:
+                lat_r[1] = mid
+        even = not even
+        if bit < 4:
+            bit += 1
+        else:
+            out.append(_GH32[ch])
+            bit, ch = 0, 0
+    return "".join(out)
+
+
+@register("geo::hash::decode")
+def _geohash_decode(args, ctx):
+    s = _str(args[0], "geo::hash::decode")
+    lat_r, lon_r = [-90.0, 90.0], [-180.0, 180.0]
+    even = True
+    for c in s:
+        cd = _GH32.index(c)
+        for mask in (16, 8, 4, 2, 1):
+            r = lon_r if even else lat_r
+            mid = (r[0] + r[1]) / 2
+            if cd & mask:
+                r[0] = mid
+            else:
+                r[1] = mid
+            even = not even
+    return Geometry("Point", ((lon_r[0] + lon_r[1]) / 2, (lat_r[0] + lat_r[1]) / 2))
+
+
+@register("geo::is::valid")
+def _geo_valid(args, ctx):
+    v = args[0]
+    if not isinstance(v, Geometry):
+        return False
+    from surrealdb_tpu.exec.operators import _points_of
+
+    return all(
+        -180 <= float(p[0]) <= 180 and -90 <= float(p[1]) <= 90
+        for p in _points_of(v)
+    )
+
+
+# -- session ------------------------------------------------------------------
+
+
+@register("session::ac")
+def _s_ac(args, ctx):
+    return ctx.session.ac if ctx.session.ac else NONE
+
+
+@register("session::db")
+def _s_db(args, ctx):
+    return ctx.session.db if ctx.session.db else NONE
+
+
+@register("session::ns")
+def _s_ns(args, ctx):
+    return ctx.session.ns if ctx.session.ns else NONE
+
+
+@register("session::id")
+def _s_id(args, ctx):
+    return NONE
+
+
+@register("session::ip")
+def _s_ip(args, ctx):
+    return NONE
+
+
+@register("session::origin")
+def _s_origin(args, ctx):
+    return NONE
+
+
+@register("session::rd")
+def _s_rd(args, ctx):
+    return ctx.session.rid if ctx.session.rid else NONE
+
+
+@register("session::token")
+def _s_token(args, ctx):
+    return ctx.vars.get("token", NONE)
+
+
+# -- sequence -----------------------------------------------------------------
+
+
+@register("sequence::nextval")
+def _nextval(args, ctx):
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.catalog import SequenceDef
+
+    name = _str(args[0], "sequence::nextval")
+    ns, db = ctx.need_ns_db()
+    kdef = K.seq_state(ns, db, name)
+    st = ctx.txn.get_val(kdef)
+    if st is None:
+        raise SdbError(f"The sequence '{name}' does not exist")
+    sd, current = st
+    ctx.txn.set_val(kdef, (sd, current + 1))
+    return current
+
+
+# -- value / search / http stubs ---------------------------------------------
+
+
+@register("value::diff")
+def _vdiff(args, ctx):
+    from surrealdb_tpu.utils.patch import diff
+
+    return diff(args[0], args[1])
+
+
+@register("value::patch")
+def _vpatch(args, ctx):
+    from surrealdb_tpu.utils.patch import apply_patch
+
+    return apply_patch(args[0], args[1])
+
+
+@register("search::score")
+def _search_score(args, ctx):
+    from surrealdb_tpu.idx.fulltext import search_score
+
+    return search_score(int(args[0]) if args else 0, ctx)
+
+
+@register("search::highlight")
+def _search_highlight(args, ctx):
+    from surrealdb_tpu.idx.fulltext import search_highlight
+
+    return search_highlight(args, ctx)
+
+
+@register("search::offsets")
+def _search_offsets(args, ctx):
+    from surrealdb_tpu.idx.fulltext import search_offsets
+
+    return search_offsets(args, ctx)
+
+
+@register("search::analyze")
+def _search_analyze(args, ctx):
+    from surrealdb_tpu.idx.fulltext import analyze_text
+
+    az = _str(args[0], "search::analyze")
+    return analyze_text(az, _str(args[1], "search::analyze"), ctx)
+
+
+@register("search::rrf")
+def _search_rrf(args, ctx):
+    """Reciprocal-rank fusion of result arrays (hybrid search)."""
+    lists = args[0]
+    k = int(args[1]) if len(args) > 1 else 60
+    limit = int(args[2]) if len(args) > 2 else None
+    from surrealdb_tpu.val import hashable
+
+    scores: dict = {}
+    vals: dict = {}
+    for lst in lists:
+        for rank, item in enumerate(lst):
+            h = hashable(item)
+            scores[h] = scores.get(h, 0.0) + 1.0 / (k + rank + 1)
+            vals[h] = item
+    out = sorted(scores.items(), key=lambda kv: -kv[1])
+    res = [vals[h] for h, _s in out]
+    return res[:limit] if limit else res
+
+
+@register("search::linear")
+def _search_linear(args, ctx):
+    lists = args[0]
+    weights = args[1] if len(args) > 1 else [1.0] * len(lists)
+    limit = int(args[2]) if len(args) > 2 else None
+    from surrealdb_tpu.val import hashable
+
+    scores: dict = {}
+    vals: dict = {}
+    for w, lst in zip(weights, lists):
+        n = len(lst)
+        for rank, item in enumerate(lst):
+            h = hashable(item)
+            scores[h] = scores.get(h, 0.0) + float(w) * (n - rank) / max(n, 1)
+            vals[h] = item
+    out = sorted(scores.items(), key=lambda kv: -kv[1])
+    res = [vals[h] for h, _s in out]
+    return res[:limit] if limit else res
+
+
+def _http_denied(args, ctx):
+    raise SdbError("Access to network target denied")
+
+
+for _m in ("head", "get", "put", "post", "patch", "delete"):
+    register(f"http::{_m}")(_http_denied)
+
+
+@register("api::invoke")
+def _api_invoke(args, ctx):
+    raise SdbError("DEFINE API invocation requires the server surface")
+
+
+@register("file::bucket")
+def _file_bucket(args, ctx):
+    from surrealdb_tpu.val import File
+
+    v = args[0]
+    if isinstance(v, File):
+        return v.bucket
+    raise SdbError("Incorrect arguments for function file::bucket(). Expected a file")
+
+
+@register("file::key")
+def _file_key(args, ctx):
+    from surrealdb_tpu.val import File
+
+    v = args[0]
+    if isinstance(v, File):
+        return v.key
+    raise SdbError("Incorrect arguments for function file::key(). Expected a file")

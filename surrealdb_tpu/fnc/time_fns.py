@@ -1,0 +1,260 @@
+"""time:: and duration:: functions (reference: core/src/fnc/time.rs)."""
+
+from __future__ import annotations
+
+import datetime as _dt
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.fnc import _arr, _num, register
+from surrealdb_tpu.val import NONE, Datetime, Duration, sort_key
+
+
+def _dtm(v, fname) -> Datetime:
+    if not isinstance(v, Datetime):
+        raise SdbError(f"Incorrect arguments for function {fname}(). Expected a datetime")
+    return v
+
+
+@register("time::now")
+def _now(args, ctx):
+    return Datetime.now()
+
+
+@register("time::day")
+def _day(args, ctx):
+    d = _dtm(args[0], "time::day") if args else Datetime.now()
+    return d.dt.day
+
+
+@register("time::hour")
+def _hour(args, ctx):
+    d = _dtm(args[0], "time::hour") if args else Datetime.now()
+    return d.dt.hour
+
+@register("time::minute")
+def _minute(args, ctx):
+    d = _dtm(args[0], "time::minute") if args else Datetime.now()
+    return d.dt.minute
+
+
+@register("time::second")
+def _second(args, ctx):
+    d = _dtm(args[0], "time::second") if args else Datetime.now()
+    return d.dt.second
+
+
+@register("time::month")
+def _month(args, ctx):
+    d = _dtm(args[0], "time::month") if args else Datetime.now()
+    return d.dt.month
+
+
+@register("time::year")
+def _year(args, ctx):
+    d = _dtm(args[0], "time::year") if args else Datetime.now()
+    return d.dt.year
+
+
+@register("time::wday")
+def _wday(args, ctx):
+    d = _dtm(args[0], "time::wday") if args else Datetime.now()
+    return d.dt.isoweekday()
+
+
+@register("time::week")
+def _week(args, ctx):
+    d = _dtm(args[0], "time::week") if args else Datetime.now()
+    return d.dt.isocalendar()[1]
+
+
+@register("time::yday")
+def _yday(args, ctx):
+    d = _dtm(args[0], "time::yday") if args else Datetime.now()
+    return d.dt.timetuple().tm_yday
+
+
+@register("time::unix")
+def _unix(args, ctx):
+    d = _dtm(args[0], "time::unix") if args else Datetime.now()
+    return d.epoch_ns() // 1_000_000_000
+
+
+@register("time::micros")
+def _micros(args, ctx):
+    d = _dtm(args[0], "time::micros") if args else Datetime.now()
+    return d.epoch_ns() // 1_000
+
+
+@register("time::millis")
+def _millis(args, ctx):
+    d = _dtm(args[0], "time::millis") if args else Datetime.now()
+    return d.epoch_ns() // 1_000_000
+
+
+@register("time::nano")
+def _nano(args, ctx):
+    d = _dtm(args[0], "time::nano") if args else Datetime.now()
+    return d.epoch_ns()
+
+
+@register("time::timezone")
+def _timezone(args, ctx):
+    return "UTC"
+
+
+@register("time::max")
+def _tmax(args, ctx):
+    a = _arr(args[0], "time::max")
+    return max(a, key=sort_key) if a else NONE
+
+
+@register("time::min")
+def _tmin(args, ctx):
+    a = _arr(args[0], "time::min")
+    return min(a, key=sort_key) if a else NONE
+
+
+def _floor_to(d: Datetime, dur: Duration) -> Datetime:
+    if dur.ns <= 0:
+        raise SdbError("Incorrect arguments for function time::floor(). Expected a positive duration")
+    ns = d.epoch_ns()
+    f = (ns // dur.ns) * dur.ns
+    secs, frac = divmod(f, 1_000_000_000)
+    return Datetime(_dt.datetime.fromtimestamp(secs, _dt.timezone.utc), frac)
+
+
+@register("time::floor")
+def _floor(args, ctx):
+    return _floor_to(_dtm(args[0], "time::floor"), args[1])
+
+
+@register("time::ceil")
+def _ceil(args, ctx):
+    d = _dtm(args[0], "time::ceil")
+    dur = args[1]
+    f = _floor_to(d, dur)
+    if f.epoch_ns() == d.epoch_ns():
+        return f
+    secs, frac = divmod(f.epoch_ns() + dur.ns, 1_000_000_000)
+    return Datetime(_dt.datetime.fromtimestamp(secs, _dt.timezone.utc), frac)
+
+
+@register("time::round")
+def _round(args, ctx):
+    d = _dtm(args[0], "time::round")
+    dur = args[1]
+    f = _floor_to(d, dur)
+    if d.epoch_ns() - f.epoch_ns() >= dur.ns / 2:
+        secs, frac = divmod(f.epoch_ns() + dur.ns, 1_000_000_000)
+        return Datetime(_dt.datetime.fromtimestamp(secs, _dt.timezone.utc), frac)
+    return f
+
+
+@register("time::group")
+def _group(args, ctx):
+    d = _dtm(args[0], "time::group")
+    unit = args[1]
+    units = {
+        "year": Duration.UNITS["y"], "month": None, "day": Duration.UNITS["d"],
+        "hour": Duration.UNITS["h"], "minute": Duration.UNITS["m"],
+        "second": Duration.UNITS["s"], "week": Duration.UNITS["w"],
+    }
+    if unit not in units:
+        raise SdbError("Incorrect arguments for function time::group(). Expected a unit")
+    if unit == "year":
+        return Datetime(_dt.datetime(d.dt.year, 1, 1, tzinfo=_dt.timezone.utc))
+    if unit == "month":
+        return Datetime(_dt.datetime(d.dt.year, d.dt.month, 1, tzinfo=_dt.timezone.utc))
+    return _floor_to(d, Duration(units[unit]))
+
+
+@register("time::format")
+def _format(args, ctx):
+    d = _dtm(args[0], "time::format")
+    fmt = args[1]
+    return d.dt.strftime(fmt)
+
+
+@register("time::is::leap_year")
+def _leap(args, ctx):
+    d = _dtm(args[0], "time::is::leap_year") if args else Datetime.now()
+    y = d.dt.year
+    return y % 4 == 0 and (y % 100 != 0 or y % 400 == 0)
+
+
+def _from_epoch(v, scale):
+    ns = int(v) * scale
+    secs, frac = divmod(ns, 1_000_000_000)
+    return Datetime(_dt.datetime.fromtimestamp(secs, _dt.timezone.utc), frac)
+
+
+@register("time::from::nanos")
+def _from_nanos(args, ctx):
+    return _from_epoch(args[0], 1)
+
+
+@register("time::from::micros")
+def _from_micros(args, ctx):
+    return _from_epoch(args[0], 1_000)
+
+
+@register("time::from::millis")
+def _from_millis(args, ctx):
+    return _from_epoch(args[0], 1_000_000)
+
+
+@register("time::from::secs")
+def _from_secs(args, ctx):
+    return _from_epoch(args[0], 1_000_000_000)
+
+
+@register("time::from::unix")
+def _from_unix(args, ctx):
+    return _from_epoch(args[0], 1_000_000_000)
+
+
+@register("time::from::ulid")
+def _from_ulid(args, ctx):
+    s = args[0]
+    alph = "0123456789ABCDEFGHJKMNPQRSTVWXYZ"
+    t = 0
+    for c in s[:10]:
+        t = t * 32 + alph.index(c)
+    return _from_epoch(t, 1_000_000)
+
+
+@register("time::from::uuid")
+def _from_uuid(args, ctx):
+    u = args[0]
+    b = u.u.bytes
+    if (b[6] >> 4) == 7:
+        ms = int.from_bytes(b[:6], "big")
+        return _from_epoch(ms, 1_000_000)
+    raise SdbError("Incorrect arguments for function time::from::uuid(). Expected a version 7 UUID")
+
+
+# -- duration:: ----------------------------------------------------------------
+
+
+def _dur(v, fname) -> Duration:
+    if not isinstance(v, Duration):
+        raise SdbError(f"Incorrect arguments for function {fname}(). Expected a duration")
+    return v
+
+
+for _name, _unit in (
+    ("nanos", 1), ("micros", 1_000), ("millis", 1_000_000),
+    ("secs", 1_000_000_000), ("mins", 60 * 1_000_000_000),
+    ("hours", 3600 * 1_000_000_000), ("days", 86400 * 1_000_000_000),
+    ("weeks", 7 * 86400 * 1_000_000_000), ("years", 365 * 86400 * 1_000_000_000),
+):
+    def _mk(unit, name):
+        @register(f"duration::{name}")
+        def _g(args, ctx):
+            return _dur(args[0], f"duration::{name}").ns // unit
+
+        @register(f"duration::from::{name}")
+        def _h(args, ctx):
+            return Duration(int(args[0]) * unit)
+
+    _mk(_unit, _name)

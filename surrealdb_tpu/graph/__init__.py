@@ -1,0 +1,112 @@
+"""Graph traversal engine.
+
+Host path: per-source `~`-key range scans (reference: dbs/processor.rs
+collect_lookup, key/graph/mod.rs:124). TPU path: CSR adjacency blocks in HBM,
+hop = gather + segmented reduce (surrealdb_tpu.graph.csr), engaged for large
+frontiers — SURVEY.md §3.4's fan-out×depth hot loop.
+"""
+
+from __future__ import annotations
+
+from surrealdb_tpu import key as K
+from surrealdb_tpu.expr.ast import PGraph
+from surrealdb_tpu.val import NONE, RecordId, is_truthy
+
+# frontier size at which multi-hop expansion moves to the CSR/TPU engine
+TPU_FRONTIER_THRESHOLD = 512
+
+
+def traverse_hop(rids: list, g: PGraph, ctx) -> list:
+    """One graph hop from a set of source records; returns destination ids."""
+    ns, db = ctx.need_ns_db()
+    want = [w[0] for w in g.what] if g.what else None
+    dirs = []
+    if g.dir in ("out", "both"):
+        dirs.append(K.DIR_OUT)
+    if g.dir in ("in", "both"):
+        dirs.append(K.DIR_IN)
+    out = []
+    seen = set()
+    for rid in rids:
+        for d in dirs:
+            if want:
+                # per-table prefix scans ride the key order
+                for ft in want:
+                    pre = K.graph_ft_prefix(ns, db, rid.tb, rid.id, d, ft)
+                    beg, end = K.prefix_range(pre)
+                    for k in ctx.txn.keys(beg, end):
+                        _ns, _db, _tb, _id, _d, ftb, fk = K.decode_graph(k)
+                        dest = RecordId(ftb, fk)
+                        out.append(dest)
+            else:
+                pre = K.graph_dir_prefix(ns, db, rid.tb, rid.id, d)
+                beg, end = K.prefix_range(pre)
+                for k in ctx.txn.keys(beg, end):
+                    _ns, _db, _tb, _id, _d, ftb, fk = K.decode_graph(k)
+                    out.append(RecordId(ftb, fk))
+    if g.cond is not None:
+        from surrealdb_tpu.exec.eval import evaluate, fetch_record
+
+        filtered = []
+        for dest in out:
+            doc = fetch_record(ctx, dest)
+            c = ctx.with_doc(doc, dest)
+            if is_truthy(evaluate(g.cond, c)):
+                filtered.append(dest)
+        out = filtered
+    return out
+
+
+def purge_edges(rid: RecordId, ctx):
+    """On record delete: remove its `~` keys, counterpart keys, and any edge
+    records attached to it (reference: doc/purge.rs semantics)."""
+    ns, db = ctx.need_ns_db()
+    pre = K.graph_node_prefix(ns, db, rid.tb, rid.id)
+    beg, end = K.prefix_range(pre)
+    edges = []
+    for k in list(ctx.txn.keys(beg, end)):
+        _ns, _db, _tb, _id, d, ft, fk = K.decode_graph(k)
+        ctx.txn.delete(k)
+        # counterpart key on the destination
+        other_dir = K.DIR_IN if d == K.DIR_OUT else K.DIR_OUT
+        ctx.txn.delete(K.graph(ns, db, ft, fk, other_dir, rid.tb, rid.id))
+        edges.append(RecordId(ft, fk))
+    return edges
+
+
+def find_references(rid: RecordId, ctx, tb=None, ff=None) -> list:
+    """record::refs — scan tables for record-link references (brute)."""
+    from surrealdb_tpu.kvs.api import deserialize
+    from surrealdb_tpu.val import Table
+
+    ns, db = ctx.need_ns_db()
+    tables = []
+    if tb is not None:
+        tables = [tb.name if isinstance(tb, Table) else tb]
+    else:
+        for _k, tdef in ctx.txn.scan_vals(*K.prefix_range(K.tb_prefix(ns, db))):
+            tables.append(tdef.name)
+    out = []
+
+    def _references(v):
+        if isinstance(v, RecordId):
+            return v.tb == rid.tb and K.enc_value(v.id) == K.enc_value(rid.id)
+        if isinstance(v, list):
+            return any(_references(x) for x in v)
+        return False
+
+    for t in tables:
+        beg, end = K.prefix_range(K.record_prefix(ns, db, t))
+        for k, raw in ctx.txn.scan(beg, end):
+            doc = deserialize(raw)
+            if not isinstance(doc, dict):
+                continue
+            if ff is not None:
+                if _references(doc.get(ff, NONE)):
+                    out.append(doc.get("id"))
+            else:
+                if any(
+                    _references(v) for kk, v in doc.items() if kk != "id"
+                ):
+                    out.append(doc.get("id"))
+    return out

@@ -1,0 +1,3 @@
+"""Index & search layer (reference: core/src/idx/ — SURVEY.md §2.7, the
+north-star target). Vector ANN runs on TPU (idx/vector.py), full-text BM25 is
+host-side postings (idx/fulltext.py), plan selection in idx/planner.py."""

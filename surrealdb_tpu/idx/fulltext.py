@@ -1,0 +1,372 @@
+"""Full-text search: analyzers + BM25 postings (reference: core/src/idx/ft/
+fulltext.rs Bm25Params/Scorer, analyzer/ tokenizers+filters).
+
+Postings live in KV under index-state keys: per-term doc maps with term
+frequencies and offsets; doc lengths and corpus stats alongside. BM25 at
+query time; hybrid rerank composes with the vector engine via search::rrf.
+"""
+
+from __future__ import annotations
+
+import math
+import re as _re
+
+from surrealdb_tpu import key as K
+from surrealdb_tpu.catalog import AnalyzerDef
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.val import NONE, RecordId, hashable, is_truthy
+
+# ---------------------------------------------------------------------------
+# analyzers
+# ---------------------------------------------------------------------------
+
+_CAMEL_RX = _re.compile(r"(?<=[a-z0-9])(?=[A-Z])")
+
+
+def _tokenize(text: str, tokenizers: list) -> list[tuple[str, int, int]]:
+    """Returns (token, start, end) triples."""
+    if not tokenizers:
+        tokenizers = ["blank"]
+    spans = [(text, 0)]
+    for tk in tokenizers:
+        out = []
+        for s, base in spans:
+            if tk == "blank":
+                for m in _re.finditer(r"\S+", s):
+                    out.append((m.group(), base + m.start()))
+            elif tk == "punct":
+                for m in _re.finditer(r"[^\s\W]+|\w+", s):
+                    out.append((m.group(), base + m.start()))
+            elif tk == "class":
+                for m in _re.finditer(r"[a-zA-Z]+|\d+|[^\w\s]+", s):
+                    out.append((m.group(), base + m.start()))
+            elif tk == "camel":
+                pos = 0
+                for part in _CAMEL_RX.split(s):
+                    idx = s.find(part, pos)
+                    out.append((part, base + idx))
+                    pos = idx + len(part)
+            else:
+                out.append((s, base))
+        spans = [(t, p) for t, p in out]
+    return [(t, p, p + len(t)) for t, p in spans]
+
+
+_STOP_SUFFIXES = [
+    "ational", "tional", "iveness", "fulness", "ousness", "ization", "ement",
+    "ments", "ment", "ings", "ing", "edly", "ed", "ies", "ly", "es", "s",
+]
+
+
+def _stem(word: str) -> str:
+    """Lightweight english stemmer (snowball-lite)."""
+    if len(word) <= 3:
+        return word
+    for suf in _STOP_SUFFIXES:
+        if word.endswith(suf) and len(word) - len(suf) >= 3:
+            return word[: -len(suf)]
+    return word
+
+
+def _apply_filters(tokens, filters):
+    out = tokens
+    for f in filters:
+        name = f[0]
+        nxt = []
+        if name == "lowercase":
+            nxt = [(t.lower(), a, b) for t, a, b in out]
+        elif name == "uppercase":
+            nxt = [(t.upper(), a, b) for t, a, b in out]
+        elif name == "ascii":
+            import unicodedata
+
+            nxt = [
+                (
+                    unicodedata.normalize("NFKD", t)
+                    .encode("ascii", "ignore")
+                    .decode(),
+                    a,
+                    b,
+                )
+                for t, a, b in out
+            ]
+        elif name == "snowball":
+            nxt = [(_stem(t.lower()), a, b) for t, a, b in out]
+        elif name == "edgengram":
+            lo, hi = int(f[1]), int(f[2])
+            for t, a, b in out:
+                for n in range(lo, min(hi, len(t)) + 1):
+                    nxt.append((t[:n], a, b))
+        elif name == "ngram":
+            lo, hi = int(f[1]), int(f[2])
+            for t, a, b in out:
+                for n in range(lo, hi + 1):
+                    for i in range(0, max(len(t) - n + 1, 0)):
+                        nxt.append((t[i : i + n], a, b))
+        else:
+            nxt = out
+        out = nxt
+    return out
+
+
+def get_analyzer(name, ctx) -> AnalyzerDef:
+    if name is None:
+        return AnalyzerDef("like", ["blank"], [("lowercase",)])
+    ns, db = ctx.need_ns_db()
+    az = ctx.txn.get_val(K.az_def(ns, db, name))
+    if az is None:
+        raise SdbError(f"The analyzer '{name}' does not exist")
+    return az
+
+
+def analyze(az: AnalyzerDef, text: str):
+    return _apply_filters(_tokenize(text, az.tokenizers), az.filters)
+
+
+def analyze_text(az_name, text, ctx):
+    az = get_analyzer(az_name, ctx)
+    return [t for t, _a, _b in analyze(az, text)]
+
+
+# ---------------------------------------------------------------------------
+# index maintenance
+# ---------------------------------------------------------------------------
+
+
+def _doc_terms(idef, doc, ctx, rid):
+    from surrealdb_tpu.exec.eval import evaluate
+
+    az = get_analyzer(idef.fulltext.get("analyzer"), ctx)
+    c = ctx.with_doc(doc, rid)
+    terms: dict = {}
+    length = 0
+    for col in idef.cols:
+        v = evaluate(col, c)
+        texts = []
+        if isinstance(v, str):
+            texts = [v]
+        elif isinstance(v, list):
+            texts = [x for x in v if isinstance(x, str)]
+        for text in texts:
+            for t, a, b in analyze(az, text):
+                if not t:
+                    continue
+                length += 1
+                tf, offs = terms.get(t, (0, []))
+                terms[t] = (tf + 1, offs + [(a, b)])
+    return terms, length
+
+
+def _post_key(ns, db, tb, ix, term):
+    return K.ix_state(ns, db, tb, ix, b"bf", K.enc_str(term))
+
+
+def _len_key(ns, db, tb, ix, rid_id):
+    return K.ix_state(ns, db, tb, ix, b"bl", K.enc_value(rid_id))
+
+
+def _stats_key(ns, db, tb, ix):
+    return K.ix_state(ns, db, tb, ix, b"bs")
+
+
+def fulltext_index_update(idef, rid: RecordId, before, after, ctx):
+    ns, db = ctx.need_ns_db()
+    tb = rid.tb
+    ix = idef.name
+    ridk = K.enc_value(rid.id)
+    old_terms = {}
+    if isinstance(before, dict):
+        old_terms, old_len = _doc_terms(idef, before, ctx, rid)
+    new_terms, new_len = ({}, 0)
+    if isinstance(after, dict):
+        new_terms, new_len = _doc_terms(idef, after, ctx, rid)
+    stats = ctx.txn.get_val(_stats_key(ns, db, tb, ix)) or {
+        "docs": 0,
+        "total_len": 0,
+    }
+    had = ctx.txn.get_val(_len_key(ns, db, tb, ix, rid.id))
+    if had is not None:
+        stats["docs"] -= 1
+        stats["total_len"] -= had
+        ctx.txn.delete(_len_key(ns, db, tb, ix, rid.id))
+    for t in old_terms:
+        pk = _post_key(ns, db, tb, ix, t)
+        post = ctx.txn.get_val(pk) or {}
+        post.pop(ridk, None)
+        if post:
+            ctx.txn.set_val(pk, post)
+        else:
+            ctx.txn.delete(pk)
+    if new_terms:
+        for t, (tf, offs) in new_terms.items():
+            pk = _post_key(ns, db, tb, ix, t)
+            post = ctx.txn.get_val(pk) or {}
+            post[ridk] = (tf, offs, rid.id)
+            ctx.txn.set_val(pk, post)
+        ctx.txn.set_val(_len_key(ns, db, tb, ix, rid.id), new_len)
+        stats["docs"] += 1
+        stats["total_len"] += new_len
+    ctx.txn.set_val(_stats_key(ns, db, tb, ix), stats)
+
+
+# ---------------------------------------------------------------------------
+# search (BM25)
+# ---------------------------------------------------------------------------
+
+
+def ft_search(idef, query: str, ctx):
+    """Returns ordered [(rid, score)] plus per-term match offsets."""
+    ns, db = ctx.need_ns_db()
+    tb, ix = idef.tb, idef.name
+    az = get_analyzer(idef.fulltext.get("analyzer"), ctx)
+    terms = [t for t, _a, _b in analyze(az, query) if t]
+    if not terms:
+        return [], {}
+    k1, b = idef.fulltext.get("bm25", (1.2, 0.75))
+    stats = ctx.txn.get_val(_stats_key(ns, db, tb, ix)) or {
+        "docs": 0,
+        "total_len": 0,
+    }
+    n_docs = max(stats["docs"], 1)
+    avg_len = stats["total_len"] / n_docs if n_docs else 1.0
+    scores: dict = {}
+    rids: dict = {}
+    offsets: dict = {}
+    matched_all: dict = {}
+    for t in dict.fromkeys(terms):
+        post = ctx.txn.get_val(_post_key(ns, db, tb, ix, t)) or {}
+        df = len(post)
+        if df == 0:
+            continue
+        idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+        for ridk, (tf, offs, rid_id) in post.items():
+            dl = ctx.txn.get_val(_len_key(ns, db, tb, ix, rid_id)) or 1
+            denom = tf + k1 * (1 - b + b * dl / max(avg_len, 1e-9))
+            s = idf * tf * (k1 + 1) / max(denom, 1e-9)
+            scores[ridk] = scores.get(ridk, 0.0) + s
+            rids[ridk] = RecordId(tb, rid_id)
+            offsets.setdefault(ridk, []).extend(offs)
+            matched_all.setdefault(ridk, set()).add(t)
+    want = set(dict.fromkeys(terms))
+    # AND semantics: docs must match every query term (reference MATCHES)
+    hits = [
+        (rids[rk], sc)
+        for rk, sc in scores.items()
+        if matched_all.get(rk) == want
+    ]
+    if not hits:
+        # fall back to OR ranking when no doc has all terms? reference
+        # returns only full matches — keep strict AND.
+        pass
+    hits.sort(key=lambda p: -p[1])
+    return hits, offsets
+
+
+def plan_matches(tb, cond, mt, indexes, ctx, stmt):
+    """Planner entry for `field @@ query` — index scan + score context."""
+    from surrealdb_tpu.exec.eval import evaluate, fetch_record
+    from surrealdb_tpu.exec.statements import Source
+    from surrealdb_tpu.idx.planner import _field_path, _remove_node
+    from surrealdb_tpu.val import is_truthy
+
+    path = _field_path(mt.lhs)
+    idef = None
+    for d in indexes:
+        if d.fulltext is not None and d.cols_str and (
+            path is None or d.cols_str[0] == path
+        ):
+            idef = d
+            break
+    if idef is None:
+        raise SdbError(
+            "Unable to perform the MATCHES operator without a full-text index"
+        )
+    q = evaluate(mt.rhs, ctx)
+    hits, offsets = ft_search(idef, str(q), ctx)
+    rest = _remove_node(cond, mt)
+    ctx.vars["__ft_scores__"] = {hashable(r): s for r, s in hits}
+    ctx.vars["__ft_offsets__"] = offsets
+    ctx.vars["__ft_index__"] = idef
+    ctx.vars["__ft_query__"] = str(q)
+    ctx._cond_consumed = rest is None
+
+    def gen():
+        for rid, _score in hits:
+            doc = fetch_record(ctx, rid)
+            if doc is NONE:
+                continue
+            if rest is not None:
+                c = ctx.with_doc(doc, rid)
+                if not is_truthy(evaluate(rest, c)):
+                    continue
+            yield Source(rid=rid, doc=doc)
+
+    # mark consumed either way: rest applied inside the generator
+    ctx._cond_consumed = True
+    return gen()
+
+
+def matches_operator(n, ctx):
+    """Row-wise @@ evaluation (post-planner membership, or ad-hoc)."""
+    scores = ctx.vars.get("__ft_scores__")
+    if scores is not None and ctx.doc_id is not None:
+        return hashable(ctx.doc_id) in scores
+    # ad-hoc: analyze both sides with the default analyzer
+    from surrealdb_tpu.exec.eval import evaluate
+
+    lhs = evaluate(n.lhs, ctx)
+    rhs = evaluate(n.rhs, ctx)
+    if not isinstance(lhs, str) or not isinstance(rhs, str):
+        return False
+    az = AnalyzerDef("like", ["blank"], [("lowercase",)])
+    doc_terms = {t for t, _a, _b in analyze(az, lhs)}
+    q_terms = {t for t, _a, _b in analyze(az, rhs)}
+    return bool(q_terms) and q_terms <= doc_terms
+
+
+def search_score(ref, ctx):
+    scores = ctx.vars.get("__ft_scores__")
+    if scores is None or ctx.doc_id is None:
+        return NONE
+    return scores.get(hashable(ctx.doc_id), NONE)
+
+
+def search_highlight(args, ctx):
+    """search::highlight(open, close, ref) — wrap matched terms."""
+    if len(args) < 3:
+        raise SdbError("Incorrect arguments for function search::highlight()")
+    open_t, close_t = str(args[0]), str(args[1])
+    idef = ctx.vars.get("__ft_index__")
+    offsets = ctx.vars.get("__ft_offsets__")
+    if idef is None or ctx.doc_id is None or ctx.doc is None:
+        return NONE
+    from surrealdb_tpu import key as K2
+    from surrealdb_tpu.exec.eval import evaluate
+
+    ridk = K2.enc_value(ctx.doc_id.id)
+    offs = sorted(set((a, b) for a, b in (offsets or {}).get(ridk, [])))
+    c = ctx.with_doc(ctx.doc, ctx.doc_id)
+    text = evaluate(idef.cols[0], c)
+    if not isinstance(text, str):
+        return text
+    out = []
+    last = 0
+    for a, b in offs:
+        if a < last or b > len(text):
+            continue
+        out.append(text[last:a])
+        out.append(open_t + text[a:b] + close_t)
+        last = b
+    out.append(text[last:])
+    return "".join(out)
+
+
+def search_offsets(args, ctx):
+    offsets = ctx.vars.get("__ft_offsets__")
+    if offsets is None or ctx.doc_id is None:
+        return NONE
+    from surrealdb_tpu import key as K2
+
+    ridk = K2.enc_value(ctx.doc_id.id)
+    offs = sorted(set((a, b) for a, b in (offsets or {}).get(ridk, [])))
+    return {"0": [{"e": b, "s": a} for a, b in offs]}

@@ -1,0 +1,403 @@
+"""Index access-path selection (reference: idx/planner/{mod,tree,plan}.rs +
+exec/index/access_path.rs).
+
+`plan_scan` inspects the WHERE tree for: a KNN operator (vector index /
+brute-force top-k), a MATCHES operator (full-text), or indexable predicates
+(= / IN / range on indexed columns). Returns a Source generator or None for
+a full table scan. Distances are published through ctx.knn (the KnnContext,
+exec/function/index.rs:289) for `vector::distance::knn()` projections.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from surrealdb_tpu import key as K
+from surrealdb_tpu.expr.ast import (
+    Binary,
+    Idiom,
+    Knn,
+    Literal,
+    Param,
+    PField,
+    RangeExpr,
+)
+from surrealdb_tpu.val import NONE, Range, RecordId, hashable, value_eq
+
+from surrealdb_tpu.err import SdbError
+
+
+def _field_path(expr):
+    if isinstance(expr, Idiom) and expr.parts and all(
+        isinstance(p, PField) for p in expr.parts
+    ):
+        return ".".join(p.name for p in expr.parts)
+    return None
+
+
+def _split_ands(cond, out):
+    if isinstance(cond, Binary) and cond.op == "&&":
+        _split_ands(cond.lhs, out)
+        _split_ands(cond.rhs, out)
+    else:
+        out.append(cond)
+
+
+def _find_knn(cond):
+    if isinstance(cond, Knn):
+        return cond
+    if isinstance(cond, Binary) and cond.op == "&&":
+        return _find_knn(cond.lhs) or _find_knn(cond.rhs)
+    return None
+
+
+def _find_matches(cond):
+    if isinstance(cond, Binary) and cond.op == "@@":
+        return cond
+    if isinstance(cond, Binary) and cond.op == "&&":
+        return _find_matches(cond.lhs) or _find_matches(cond.rhs)
+    return None
+
+
+def _remove_node(cond, node):
+    """Drop `node` from an AND-tree; returns remaining cond or None."""
+    if cond is node:
+        return None
+    if isinstance(cond, Binary) and cond.op == "&&":
+        l = _remove_node(cond.lhs, node)
+        r = _remove_node(cond.rhs, node)
+        if l is None:
+            return r
+        if r is None:
+            return l
+        return Binary("&&", l, r)
+    return cond
+
+
+def get_indexes_for(tb, ctx):
+    ns, db = ctx.need_ns_db()
+    return [
+        d for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ix_prefix(ns, db, tb)))
+    ]
+
+
+def plan_scan(tb: str, cond, ctx, stmt):
+    """Return a Source generator when an index path applies, else None."""
+    if cond is None:
+        return None
+    from surrealdb_tpu.exec.eval import evaluate
+    from surrealdb_tpu.exec.statements import Source
+
+    with_index = getattr(stmt, "with_index", None) if stmt is not None else None
+    if with_index == []:  # WITH NOINDEX
+        return None
+    indexes = get_indexes_for(tb, ctx)
+    if with_index:
+        indexes = [i for i in indexes if i.name in with_index]
+
+    # ---- KNN --------------------------------------------------------------
+    knn = _find_knn(cond)
+    if knn is not None:
+        return _plan_knn(tb, cond, knn, indexes, ctx, stmt)
+
+    # ---- MATCHES ----------------------------------------------------------
+    mt = _find_matches(cond)
+    if mt is not None:
+        from surrealdb_tpu.idx.fulltext import plan_matches
+
+        return plan_matches(tb, cond, mt, indexes, ctx, stmt)
+
+    # ---- equality / range on an indexed column ----------------------------
+    preds = []
+    _split_ands(cond, preds)
+    for pred in preds:
+        if not isinstance(pred, Binary):
+            continue
+        path = op = valexpr = None
+        if pred.op in ("=", "==", "∈", "<", "<=", ">", ">="):
+            lp = _field_path(pred.lhs)
+            rp = _field_path(pred.rhs)
+            if lp is not None and rp is None:
+                path, op, valexpr = lp, pred.op, pred.rhs
+            elif rp is not None and lp is None:
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+                path, op, valexpr = rp, flip.get(pred.op, pred.op), pred.lhs
+        if path is None or path == "id":
+            continue
+        for idef in indexes:
+            if idef.hnsw is not None or idef.fulltext is not None or idef.count:
+                continue
+            if not idef.cols_str or idef.cols_str[0] != path:
+                continue
+            if len(idef.cols_str) > 1 and op != "=":
+                continue
+            v = evaluate(valexpr, ctx)
+            return _index_lookup(tb, idef, op, v, cond, ctx)
+    return None
+
+
+def _plan_knn(tb, cond, knn: Knn, indexes, ctx, stmt):
+    from surrealdb_tpu.exec.eval import evaluate, fetch_record
+    from surrealdb_tpu.exec.statements import Source
+
+    path = _field_path(knn.lhs)
+    qv = evaluate(knn.rhs, ctx)
+    rest = _remove_node(cond, knn)
+    results = None
+    if knn.dist is None and path is not None:
+        # indexed ANN (ef given or not — we search the index either way)
+        for idef in indexes:
+            if idef.hnsw is not None and idef.cols_str and idef.cols_str[0] == path:
+                from surrealdb_tpu.idx.vector import get_vector_index
+
+                eng = get_vector_index(idef, ctx)
+                results = eng.knn(
+                    qv, knn.k, ctx,
+                    ef=knn.ef,
+                    cond=rest,
+                    cond_ctx=ctx if rest is not None else None,
+                )
+                break
+        if results is None and knn.ef is not None:
+            raise SdbError(
+                f"There was no suitable index found for the provided KNN expression"
+            )
+    if results is None:
+        # brute-force top-k over the table scan (KnnTopK operator,
+        # exec/operators/knn_topk.rs)
+        results = _brute_knn(tb, knn, qv, rest, ctx)
+        rest_after = rest
+    else:
+        rest_after = None  # index path already applied the residual cond
+    ctx.knn = {}
+
+    def gen():
+        from surrealdb_tpu.exec.eval import fetch_record
+
+        for rid, dist in results:
+            ctx.knn[hashable(rid)] = dist
+            doc = fetch_record(ctx, rid)
+            if doc is NONE:
+                continue
+            yield Source(rid=rid, doc=doc)
+
+    ctx._cond_consumed = True
+    if rest_after is not None:
+        # brute path: still need residual filter; leave it to re-filter
+        ctx._cond_consumed = True
+
+        def gen2():
+            from surrealdb_tpu.exec.eval import evaluate as ev, fetch_record
+            from surrealdb_tpu.val import is_truthy
+
+            for rid, dist in results:
+                ctx.knn[hashable(rid)] = dist
+                doc = fetch_record(ctx, rid)
+                if doc is NONE:
+                    continue
+                yield Source(rid=rid, doc=doc)
+
+        return gen2()
+    return gen()
+
+
+def _brute_knn(tb, knn: Knn, qv, rest, ctx):
+    """Exact top-k over the table: batched on device for big tables
+    (replaces KnnTopK's bounded max-heap with jax top_k)."""
+    from surrealdb_tpu.exec.eval import evaluate
+    from surrealdb_tpu.exec.statements import _scan_table
+    from surrealdb_tpu.ops.distance import normalize_metric
+    from surrealdb_tpu.val import is_truthy
+
+    metric, p = normalize_metric(knn.dist or "euclidean")
+    path_expr = knn.lhs
+    rows = []
+    vecs = []
+    dim = None
+    for src in _scan_table(tb, ctx, None, None):
+        c = ctx.with_doc(src.doc, src.rid)
+        if rest is not None and not is_truthy(evaluate(rest, c)):
+            continue
+        v = evaluate(path_expr, c)
+        if not isinstance(v, list):
+            continue
+        try:
+            arr = np.asarray(v, dtype=np.float32)
+        except (TypeError, ValueError):
+            continue
+        if arr.ndim != 1:
+            continue
+        if dim is None:
+            dim = arr.shape[0]
+        if arr.shape[0] != dim:
+            continue
+        rows.append(src.rid)
+        vecs.append(arr)
+    if not rows:
+        return []
+    xs = np.stack(vecs)
+    q = np.asarray(qv, dtype=np.float32)
+    n = len(rows)
+    if n >= 4096:
+        from surrealdb_tpu.ops.topk import knn_search
+        import jax.numpy as jnp
+
+        d, i = knn_search(jnp.asarray(xs), jnp.asarray(q[None, :]),
+                          min(knn.k, n), metric, p)
+        d = np.asarray(d[0])
+        i = np.asarray(i[0])
+        return [(rows[int(ii)], float(dd)) for dd, ii in zip(d, i) if ii >= 0]
+    # host path
+    from surrealdb_tpu.idx.vector import TpuVectorIndex
+
+    tmp = TpuVectorIndex.__new__(TpuVectorIndex)
+    tmp.vecs = xs
+    tmp.metric = metric
+    tmp.mink_p = p
+    d = tmp._host_distances(q)
+    k = min(knn.k, n)
+    idx = np.argpartition(d, k - 1)[:k]
+    idx = idx[np.argsort(d[idx], kind="stable")]
+    return [(rows[int(ii)], float(d[ii])) for ii in idx]
+
+
+def _index_lookup(tb, idef, op, v, cond, ctx):
+    from surrealdb_tpu.exec.eval import fetch_record
+    from surrealdb_tpu.exec.statements import Source
+    from surrealdb_tpu.kvs.api import deserialize
+
+    ns, db = ctx.need_ns_db()
+
+    def _fetch(rid):
+        doc = fetch_record(ctx, rid)
+        if doc is NONE:
+            return None
+        return Source(rid=rid, doc=doc)
+
+    def gen():
+        if idef.unique:
+            if op in ("=", "=="):
+                rid = ctx.txn.get_val(K.index_unique(ns, db, tb, idef.name, [v]))
+                if rid is not None:
+                    s = _fetch(rid)
+                    if s:
+                        yield s
+            elif op == "∈" and isinstance(v, list):
+                for x in v:
+                    rid = ctx.txn.get_val(
+                        K.index_unique(ns, db, tb, idef.name, [x])
+                    )
+                    if rid is not None:
+                        s = _fetch(rid)
+                        if s:
+                            yield s
+            else:
+                # range over unique index entries
+                yield from _range_scan_unique()
+            return
+        if op in ("=", "=="):
+            pre = K.index_prefix(ns, db, tb, idef.name) + K.enc_value([v])
+            for k in ctx.txn.keys(*K.prefix_range(pre)):
+                _fields, idv = K.decode_index(k, ns, db, tb, idef.name)
+                s = _fetch(RecordId(tb, idv))
+                if s:
+                    yield s
+        elif op == "∈" and isinstance(v, list):
+            for x in v:
+                pre = K.index_prefix(ns, db, tb, idef.name) + K.enc_value([x])
+                for k in ctx.txn.keys(*K.prefix_range(pre)):
+                    _fields, idv = K.decode_index(k, ns, db, tb, idef.name)
+                    s = _fetch(RecordId(tb, idv))
+                    if s:
+                        yield s
+        else:
+            yield from _range_scan()
+
+    def _range_bounds(make_key, tag_open, tag_close):
+        base = make_key
+        if op in (">", ">="):
+            beg = base + K.enc_value([v])
+            if op == ">":
+                beg += b"\xff"
+            end = base + b"\xff\xff\xff\xff\xff\xff\xff\xff"
+        else:
+            beg = base
+            end = base + K.enc_value([v])
+            if op == "<=":
+                end += b"\xff"
+        return beg, end
+
+    def _range_scan():
+        base = K.index_prefix(ns, db, tb, idef.name)
+        beg, end = _range_bounds(base, None, None)
+        for k in ctx.txn.keys(beg, end):
+            _fields, idv = K.decode_index(k, ns, db, tb, idef.name)
+            s = _fetch(RecordId(tb, idv))
+            if s:
+                yield s
+
+    def _range_scan_unique():
+        base = K.index_unique_prefix(ns, db, tb, idef.name)
+        beg, end = _range_bounds(base, None, None)
+        for k, rid in ctx.txn.scan_vals(beg, end):
+            s = _fetch(rid)
+            if s:
+                yield s
+
+    return gen()
+
+
+def explain_plan(tb, cond, ctx, stmt):
+    """EXPLAIN output (reference dbs/plan.rs Explanation)."""
+    if cond is not None:
+        knn = _find_knn(cond)
+        indexes = get_indexes_for(tb, ctx)
+        if knn is not None:
+            path = _field_path(knn.lhs)
+            for idef in indexes:
+                if idef.hnsw is not None and idef.cols_str and \
+                        idef.cols_str[0] == path and knn.dist is None:
+                    return {
+                        "detail": {
+                            "plan": {
+                                "index": idef.name,
+                                "operator": f"<|{knn.k},{knn.ef or 40}|>",
+                            },
+                            "table": tb,
+                        },
+                        "operation": "Iterate Index",
+                    }
+            return {
+                "detail": {"table": tb},
+                "operation": "Iterate Table",
+            }
+        mt = _find_matches(cond)
+        if mt is not None:
+            for idef in indexes:
+                if idef.fulltext is not None:
+                    return {
+                        "detail": {
+                            "plan": {"index": idef.name, "operator": "@@"},
+                            "table": tb,
+                        },
+                        "operation": "Iterate Index",
+                    }
+        preds = []
+        _split_ands(cond, preds)
+        for pred in preds:
+            if isinstance(pred, Binary) and pred.op in ("=", "==", "∈"):
+                path = _field_path(pred.lhs) or _field_path(pred.rhs)
+                for idef in indexes:
+                    if idef.cols_str and idef.cols_str[0] == path and \
+                            idef.hnsw is None and idef.fulltext is None:
+                        return {
+                            "detail": {
+                                "plan": {
+                                    "index": idef.name,
+                                    "operator": pred.op,
+                                },
+                                "table": tb,
+                            },
+                            "operation": "Iterate Index",
+                        }
+    return {"detail": {"table": tb}, "operation": "Iterate Table"}

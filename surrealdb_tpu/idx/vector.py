@@ -1,0 +1,276 @@
+"""TPU-resident vector index.
+
+Replaces the reference's HNSW graph walk (idx/trees/hnsw/, hot loop
+layer.rs:184-223: per-neighbor async KV fetch + scalar distance) with a
+device-resident flat store: batched distance (`einsum` on the MXU) +
+`jax.lax.top_k`, blockwise for big stores, mesh-sharded for multi-chip
+(SURVEY.md §7 step 4). Exact search ⇒ recall@10 = 1.0 ≥ the 0.95 target.
+
+Consistency model mirrors hnsw/index.rs's two-phase design: the KV `he` keys
+(rid→vector) written inside the caller's transaction are the source of
+truth; the device block cache is an overlay rebuilt/extended when a search
+observes a newer KV version — "device blocks are a cache rebuilt from KV"
+(SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from surrealdb_tpu import key as K
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.val import NONE, RecordId, is_truthy
+
+# device-search threshold: below this, numpy on host beats dispatch overhead
+DEVICE_MIN_ROWS = 2048
+# blockwise scan threshold (rows) to bound [B, N] materialization
+BLOCK_ROWS = 262144
+
+
+def _as_vector(v, dim, what):
+    if not isinstance(v, (list, tuple)):
+        raise SdbError(f"Incorrect vector value for {what}")
+    try:
+        arr = np.asarray(v, dtype=np.float32)
+    except (TypeError, ValueError):
+        raise SdbError(f"Incorrect vector value for {what}")
+    if arr.ndim != 1 or arr.shape[0] != dim:
+        raise SdbError(
+            f"Incorrect vector dimension ({arr.shape[0] if arr.ndim == 1 else '?'}). Expected a vector of {dim} dimension."
+        )
+    return arr
+
+
+def vector_index_update(idef, rid: RecordId, before, after, ctx):
+    """Write-side maintenance: persist rid→vector under `he` state keys
+    (reference hnsw/elements.rs) inside the caller's transaction."""
+    ns, db = ctx.need_ns_db()
+    dim = idef.hnsw["dimension"]
+    col = idef.cols[0]
+    from surrealdb_tpu.exec.eval import evaluate
+
+    key = K.ix_state(ns, db, rid.tb, idef.name, b"he", K.enc_value(rid.id))
+    vkey = K.ix_state(ns, db, rid.tb, idef.name, b"vn")
+    old_vec = None
+    new_vec = None
+    if isinstance(before, dict):
+        v = evaluate(col, ctx.with_doc(before, rid))
+        if v is not NONE and v is not None:
+            old_vec = v
+    if isinstance(after, dict):
+        v = evaluate(col, ctx.with_doc(after, rid))
+        if v is not NONE and v is not None:
+            new_vec = _as_vector(v, dim, f"index {idef.name}")
+    if new_vec is not None:
+        ctx.txn.set_val(key, new_vec.tobytes())
+    elif old_vec is not None:
+        ctx.txn.delete(key)
+    else:
+        return
+    ver = ctx.txn.get_val(vkey) or 0
+    ctx.txn.set_val(vkey, ver + 1)
+
+
+class TpuVectorIndex:
+    """Per-(ns,db,tb,ix) device block cache + search engine."""
+
+    def __init__(self, ns, db, tb, ix, params: dict):
+        self.key = (ns, db, tb, ix)
+        self.params = params
+        self.dim = params["dimension"]
+        from surrealdb_tpu.ops.distance import normalize_metric
+
+        self.metric, self.mink_p = normalize_metric(
+            params.get("distance", "euclidean")
+        )
+        self.lock = threading.RLock()
+        self.version = -1
+        self.rids: list = []  # row -> RecordId
+        self.vecs = np.zeros((0, self.dim), dtype=np.float32)
+        self.device_vecs = None  # jax array (lazy)
+        self.device_valid = None
+        self.mesh = None
+
+    # -- cache sync ---------------------------------------------------------
+    def sync(self, ctx):
+        ns, db, tb, ix = self.key
+        vkey = K.ix_state(ns, db, tb, ix, b"vn")
+        ver = ctx.txn.get_val(vkey) or 0
+        if ver == self.version:
+            return
+        with self.lock:
+            if ver == self.version:
+                return
+            pre = K.ix_state(ns, db, tb, ix, b"he")
+            beg, end = K.prefix_range(pre)
+            rids = []
+            rows = []
+            plen = len(pre)
+            for k, raw in ctx.txn.scan(beg, end):
+                idv, _pos = K.dec_value(k, plen)
+                rids.append(RecordId(tb, idv))
+                from surrealdb_tpu.kvs.api import deserialize
+
+                rows.append(np.frombuffer(deserialize(raw), dtype=np.float32))
+            self.rids = rids
+            self.vecs = (
+                np.stack(rows) if rows else np.zeros((0, self.dim), np.float32)
+            )
+            self.device_vecs = None
+            self.device_valid = None
+            self.version = ver
+
+    def _ensure_device(self):
+        if self.device_vecs is not None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        n = len(self.rids)
+        valid = np.ones((n,), dtype=bool)
+        if jax.device_count() > 1:
+            from surrealdb_tpu.parallel.mesh import default_mesh, shard_rows
+
+            self.mesh = default_mesh()
+            self.device_vecs, pad = shard_rows(self.mesh, self.vecs)
+            if pad:
+                valid = np.pad(valid, (0, pad))
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.device_valid = jax.device_put(
+                valid, NamedSharding(self.mesh, P("data"))
+            )
+        else:
+            self.device_vecs = jnp.asarray(self.vecs)
+            self.device_valid = jnp.asarray(valid)
+
+    # -- search -------------------------------------------------------------
+    def knn(self, q, k: int, ctx, ef=None, cond=None, cond_ctx=None):
+        """Top-k nearest records. `cond`: optional per-record predicate —
+        handled by oversample + host truthiness check + refill
+        (SURVEY.md hard-parts: cond-filtered KNN)."""
+        self.sync(ctx)
+        n = len(self.rids)
+        if n == 0:
+            return []
+        qv = _as_vector(q, self.dim, "knn query")
+        if cond is None:
+            pairs = self._raw_knn(qv, min(k, n))
+            return pairs[:k]
+        # predicate pushdown: oversample and refill
+        want = k
+        fetch = min(max(4 * k, 64), n)
+        checked: set = set()
+        out = []
+        while True:
+            pairs = self._raw_knn(qv, min(fetch, n))
+            for rid, dist in pairs:
+                hkey = K.enc_value(rid.id)
+                if hkey in checked:
+                    continue
+                checked.add(hkey)
+                if self._check_cond(rid, cond, cond_ctx):
+                    out.append((rid, dist))
+                    if len(out) >= want:
+                        return out
+            if fetch >= n:
+                return out
+            fetch = min(fetch * 4, n)
+
+    def _check_cond(self, rid, cond, ctx):
+        from surrealdb_tpu.exec.eval import evaluate, fetch_record
+
+        doc = fetch_record(ctx, rid)
+        if doc is NONE:
+            return False
+        c = ctx.with_doc(doc, rid)
+        return is_truthy(evaluate(cond, c))
+
+    def _raw_knn(self, qv: np.ndarray, k: int):
+        n = len(self.rids)
+        if n < DEVICE_MIN_ROWS:
+            d = self._host_distances(qv)
+            idx = np.argpartition(d, min(k, n) - 1)[:k]
+            idx = idx[np.argsort(d[idx], kind="stable")]
+            return [(self.rids[i], float(d[i])) for i in idx]
+        self._ensure_device()
+        import jax.numpy as jnp
+
+        qs = jnp.asarray(qv[None, :])
+        if self.mesh is not None:
+            from surrealdb_tpu.parallel.mesh import sharded_knn
+
+            dists, ids = sharded_knn(
+                self.mesh, self.device_vecs, qs, self.device_valid, k,
+                self.metric, self.mink_p,
+            )
+        elif n > BLOCK_ROWS:
+            from surrealdb_tpu.ops.topk import knn_search_blocked
+
+            dists, ids = knn_search_blocked(
+                self.device_vecs, qs, k, self.metric, self.mink_p,
+                self.device_valid,
+            )
+        else:
+            from surrealdb_tpu.ops.topk import knn_search
+
+            dists, ids = knn_search(
+                self.device_vecs, qs, k, self.metric, self.mink_p,
+                self.device_valid,
+            )
+        dists = np.asarray(dists[0])
+        ids = np.asarray(ids[0])
+        out = []
+        for d, i in zip(dists, ids):
+            if i < 0 or not np.isfinite(d) or i >= n:
+                continue
+            out.append((self.rids[int(i)], float(d)))
+        return out
+
+    def _host_distances(self, qv):
+        xs = self.vecs
+        m = self.metric
+        if m == "euclidean":
+            return np.linalg.norm(xs - qv[None, :], axis=1)
+        if m == "cosine":
+            xn = xs / np.maximum(
+                np.linalg.norm(xs, axis=1, keepdims=True), 1e-30
+            )
+            qn = qv / max(np.linalg.norm(qv), 1e-30)
+            return 1.0 - xn @ qn
+        if m == "manhattan":
+            return np.abs(xs - qv[None, :]).sum(axis=1)
+        if m == "chebyshev":
+            return np.abs(xs - qv[None, :]).max(axis=1) if xs.size else np.zeros(0)
+        if m == "hamming":
+            return (xs != qv[None, :]).sum(axis=1).astype(np.float64)
+        if m == "minkowski":
+            return np.power(
+                np.power(np.abs(xs - qv[None, :]), self.mink_p).sum(axis=1),
+                1.0 / self.mink_p,
+            )
+        if m == "pearson":
+            xc = xs - xs.mean(axis=1, keepdims=True)
+            qc = qv - qv.mean()
+            xn = xc / np.maximum(np.linalg.norm(xc, axis=1, keepdims=True), 1e-30)
+            qn = qc / max(np.linalg.norm(qc), 1e-30)
+            return 1.0 - xn @ qn
+        if m == "jaccard":
+            mn = np.minimum(xs, qv[None, :]).sum(axis=1)
+            mx = np.maximum(xs, qv[None, :]).sum(axis=1)
+            return 1.0 - mn / np.maximum(mx, 1e-30)
+        if m == "dot":
+            return -(xs @ qv)
+        raise SdbError(f"unsupported metric {m}")
+
+
+def get_vector_index(idef, ctx) -> TpuVectorIndex:
+    ns, db = ctx.need_ns_db()
+    key = (ns, db, idef.tb, idef.name)
+    eng = ctx.ds.vector_indexes.get(key)
+    if eng is None:
+        eng = TpuVectorIndex(ns, db, idef.tb, idef.name, idef.hnsw)
+        ctx.ds.vector_indexes[key] = eng
+    return eng

@@ -1,0 +1,12 @@
+"""Key-value storage layer.
+
+Backend-neutral transaction contract mirroring the reference's `Transactable`
+trait (/root/reference/surrealdb/core/src/kvs/api.rs:78-491): get/set/put/del/
+exists/scan(fwd+rev)/count over an ordered `bytes -> bytes` keyspace, plus
+savepoints. Engines plug in underneath (mem now; the contract keeps room for a
+RocksDB-style native engine and a distributed engine, as in the reference's
+mem/rocksdb/tikv matrix).
+"""
+
+from surrealdb_tpu.kvs.api import Backend, BackendTx, Transaction  # noqa: F401
+from surrealdb_tpu.kvs.ds import Datastore  # noqa: F401

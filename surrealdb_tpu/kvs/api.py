@@ -1,0 +1,164 @@
+"""Transaction contract (reference: core/src/kvs/api.rs `Transactable`)."""
+
+from __future__ import annotations
+
+import pickle
+from typing import Iterator, Optional
+
+from surrealdb_tpu.err import SdbError
+
+
+class BackendTx:
+    """A single transaction against an ordered keyspace."""
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def set(self, key: bytes, val: bytes) -> None:
+        raise NotImplementedError
+
+    def put(self, key: bytes, val: bytes) -> None:
+        """Set only if the key does not exist (api.rs put)."""
+        if self.get(key) is not None:
+            raise SdbError(f"key already exists")
+        self.set(key, val)
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def exists(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def scan(
+        self,
+        beg: bytes,
+        end: bytes,
+        limit: Optional[int] = None,
+        reverse: bool = False,
+    ) -> Iterator[tuple[bytes, bytes]]:
+        """Iterate (key, value) for beg <= key < end in key order."""
+        raise NotImplementedError
+
+    def keys(self, beg, end, limit=None, reverse=False):
+        for k, _v in self.scan(beg, end, limit, reverse):
+            yield k
+
+    def count(self, beg: bytes, end: bytes) -> int:
+        return sum(1 for _ in self.scan(beg, end))
+
+    def delete_range(self, beg: bytes, end: bytes) -> None:
+        for k in list(self.keys(beg, end)):
+            self.delete(k)
+
+    # savepoints (api.rs:462-468) — statement-level rollback
+    def new_save_point(self) -> None:
+        raise NotImplementedError
+
+    def rollback_to_save_point(self) -> None:
+        raise NotImplementedError
+
+    def release_last_save_point(self) -> None:
+        raise NotImplementedError
+
+    def commit(self) -> None:
+        raise NotImplementedError
+
+    def cancel(self) -> None:
+        raise NotImplementedError
+
+
+class Backend:
+    """A storage engine: a factory of transactions over one keyspace."""
+
+    def transaction(self, write: bool) -> BackendTx:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Value (de)serialization for stored records & catalog structs.
+# ---------------------------------------------------------------------------
+
+
+def serialize(v) -> bytes:
+    return pickle.dumps(v, protocol=5)
+
+
+def deserialize(b: bytes):
+    return pickle.loads(b)
+
+
+class Transaction:
+    """Caching transaction wrapper (reference: kvs/tx.rs).
+
+    Adds record/catalog (de)serialization and version-stamp allocation on top
+    of a raw `BackendTx`.
+    """
+
+    def __init__(self, btx: BackendTx, write: bool):
+        self.btx = btx
+        self.write = write
+        self.closed = False
+
+    # raw ops -------------------------------------------------------------
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self.btx.get(key)
+
+    def set(self, key: bytes, val: bytes) -> None:
+        self.btx.set(key, val)
+
+    def put(self, key: bytes, val: bytes) -> None:
+        self.btx.put(key, val)
+
+    def delete(self, key: bytes) -> None:
+        self.btx.delete(key)
+
+    def exists(self, key: bytes) -> bool:
+        return self.btx.exists(key)
+
+    def scan(self, beg, end, limit=None, reverse=False):
+        return self.btx.scan(beg, end, limit, reverse)
+
+    def keys(self, beg, end, limit=None, reverse=False):
+        return self.btx.keys(beg, end, limit, reverse)
+
+    def count(self, beg, end):
+        return self.btx.count(beg, end)
+
+    def delete_range(self, beg, end):
+        return self.btx.delete_range(beg, end)
+
+    # typed ops ------------------------------------------------------------
+    def get_val(self, key: bytes):
+        raw = self.btx.get(key)
+        return None if raw is None else deserialize(raw)
+
+    def set_val(self, key: bytes, v) -> None:
+        self.btx.set(key, serialize(v))
+
+    def scan_vals(self, beg, end, limit=None, reverse=False):
+        for k, raw in self.btx.scan(beg, end, limit, reverse):
+            yield k, deserialize(raw)
+
+    # savepoints -----------------------------------------------------------
+    def new_save_point(self):
+        self.btx.new_save_point()
+
+    def rollback_to_save_point(self):
+        self.btx.rollback_to_save_point()
+
+    def release_last_save_point(self):
+        self.btx.release_last_save_point()
+
+    # lifecycle ------------------------------------------------------------
+    def commit(self):
+        if not self.closed:
+            self.btx.commit()
+            self.closed = True
+
+    def cancel(self):
+        if not self.closed:
+            self.btx.cancel()
+            self.closed = True

@@ -1,0 +1,162 @@
+"""Datastore facade (reference: core/src/kvs/ds.rs `Datastore`).
+
+Owns the storage backend, the catalog/index caches, the live-query broker,
+and the TPU engine handles; `execute()` parses SurrealQL and runs the
+statement loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.kvs.api import Transaction
+
+
+class Session:
+    """Per-connection session (reference: dbs/session.rs)."""
+
+    def __init__(self, ns=None, db=None, auth_level="owner", rid=None, ac=None):
+        self.ns = ns
+        self.db = db
+        self.auth_level = auth_level  # owner | editor | viewer | record | none
+        self.rid = rid  # record-auth identity (RecordId)
+        self.ac = ac  # access method name
+        self.variables: dict[str, Any] = {}
+
+    @property
+    def is_owner(self):
+        return self.auth_level == "owner"
+
+
+class QueryResult:
+    """One statement's outcome."""
+
+    __slots__ = ("result", "error", "time_ns")
+
+    def __init__(self, result=None, error: Optional[str] = None, time_ns: int = 0):
+        self.result = result
+        self.error = error
+        self.time_ns = time_ns
+
+    @property
+    def ok(self):
+        return self.error is None
+
+    def unwrap(self):
+        if self.error is not None:
+            raise SdbError(self.error)
+        return self.result
+
+    def __repr__(self):
+        if self.error is not None:
+            return f"QueryResult(error={self.error!r})"
+        return f"QueryResult({self.result!r})"
+
+
+class Notification:
+    """A live-query notification (CREATE/UPDATE/DELETE action on a record)."""
+
+    __slots__ = ("live_id", "action", "record", "result")
+
+    def __init__(self, live_id, action, record, result):
+        self.live_id = live_id
+        self.action = action  # CREATE | UPDATE | DELETE
+        self.record = record  # RecordId
+        self.result = result  # value payload
+
+    def __repr__(self):
+        return f"Notification({self.action} {self.record} -> {self.result!r})"
+
+
+class Datastore:
+    def __init__(self, path: str = "memory", strict: bool = False):
+        self.path = path
+        self.strict = strict
+        if path in ("memory", "mem://", "mem"):
+            from surrealdb_tpu.kvs.mem import MemBackend
+
+            self.backend = MemBackend()
+        elif path.startswith("file://") or path.startswith("skv://"):
+            from surrealdb_tpu.kvs.file import FileBackend
+
+            self.backend = FileBackend(path.split("://", 1)[1])
+        else:
+            raise SdbError(f"unknown datastore path: {path!r}")
+        # cross-transaction caches / engines
+        self.lock = threading.RLock()
+        self.vector_indexes: dict = {}  # (ns,db,tb,ix) -> TpuVectorIndex
+        self.ft_indexes: dict = {}  # (ns,db,tb,ix) -> FullTextIndex
+        self.live_queries: dict = {}  # uuid-str -> LiveQuery
+        self.notifications: list[Notification] = []  # in-proc delivery queue
+        self.notification_handlers: list = []  # callables(Notification)
+        self.sequences: dict = {}
+        self.changefeed_vs = 0  # monotonically increasing versionstamp
+        self.graph_engine = None  # lazily built TPU graph engine cache
+
+    # -- transactions -------------------------------------------------------
+    def transaction(self, write: bool = True) -> Transaction:
+        return Transaction(self.backend.transaction(write), write)
+
+    # -- execution ----------------------------------------------------------
+    def execute(
+        self,
+        sql: str,
+        ns: Optional[str] = None,
+        db: Optional[str] = None,
+        vars: Optional[dict] = None,
+        session: Optional[Session] = None,
+    ) -> list[QueryResult]:
+        """Parse and run a SurrealQL query; one QueryResult per statement."""
+        from surrealdb_tpu.exec.executor import Executor
+        from surrealdb_tpu.syn import parse
+
+        from surrealdb_tpu.err import ParseError
+
+        sess = session or Session(ns=ns, db=db)
+        if ns is not None:
+            sess.ns = ns
+        if db is not None:
+            sess.db = db
+        try:
+            stmts = parse(sql)
+        except ParseError as e:
+            # a parse error fails the whole query (reference behaviour)
+            return [QueryResult(error=str(e))]
+        ex = Executor(self, sess)
+        return ex.execute(stmts, vars or {})
+
+    def query(self, sql: str, ns="test", db="test", vars=None):
+        """Convenience: execute and unwrap every statement's result."""
+        return [r.unwrap() for r in self.execute(sql, ns=ns, db=db, vars=vars)]
+
+    def query_one(self, sql: str, ns="test", db="test", vars=None):
+        out = self.query(sql, ns=ns, db=db, vars=vars)
+        return out[-1] if out else None
+
+    # -- notifications ------------------------------------------------------
+    def notify(self, notification: Notification):
+        with self.lock:
+            self.notifications.append(notification)
+            handlers = list(self.notification_handlers)
+        for h in handlers:
+            try:
+                h(notification)
+            except Exception:
+                pass
+
+    def drain_notifications(self) -> list[Notification]:
+        with self.lock:
+            out = self.notifications
+            self.notifications = []
+        return out
+
+    def next_versionstamp(self) -> int:
+        with self.lock:
+            self.changefeed_vs += 1
+            return (int(time.time() * 1000) << 20) | (self.changefeed_vs & 0xFFFFF)
+
+    def close(self):
+        self.backend.close()

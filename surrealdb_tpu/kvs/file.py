@@ -1,0 +1,86 @@
+"""File-backed storage engine: append-only WAL + snapshot compaction.
+
+Fills the reference's rocksdb/surrealkv role (persistent embedded engine) in
+a dependency-free way: commits append pickled write-batches to a log; open
+replays snapshot + log into the in-memory sorted map; `compact()` rewrites
+the snapshot. Durability = fsync per commit.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+
+from sortedcontainers import SortedDict
+
+from surrealdb_tpu.kvs.api import Backend
+from surrealdb_tpu.kvs.mem import MemTx
+
+
+class FileBackend(Backend):
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.snap_path = os.path.join(path, "snapshot.bin")
+        self.wal_path = os.path.join(path, "wal.bin")
+        self.data: SortedDict = SortedDict()
+        self.lock = threading.RLock()
+        self._load()
+        self.wal = open(self.wal_path, "ab")
+
+    def _load(self):
+        if os.path.exists(self.snap_path):
+            with open(self.snap_path, "rb") as f:
+                self.data = SortedDict(pickle.load(f))
+        if os.path.exists(self.wal_path):
+            with open(self.wal_path, "rb") as f:
+                while True:
+                    try:
+                        batch = pickle.load(f)
+                    except EOFError:
+                        break
+                    except Exception:
+                        break  # torn tail write
+                    for k, v in batch.items():
+                        if v is None:
+                            self.data.pop(k, None)
+                        else:
+                            self.data[k] = v
+
+    def transaction(self, write: bool):
+        return FileTx(self, write)
+
+    def compact(self):
+        with self.lock:
+            tmp = self.snap_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(dict(self.data), f, protocol=5)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.snap_path)
+            self.wal.close()
+            open(self.wal_path, "wb").close()
+            self.wal = open(self.wal_path, "ab")
+
+    def close(self):
+        self.compact()
+        self.wal.close()
+
+
+class FileTx(MemTx):
+    def commit(self):
+        self._check()
+        self.done = True
+        if not self.writes:
+            return
+        store: FileBackend = self.store
+        with store.lock:
+            pickle.dump(self.writes, store.wal, protocol=5)
+            store.wal.flush()
+            os.fsync(store.wal.fileno())
+            for k, v in self.writes.items():
+                if v is None:
+                    store.data.pop(k, None)
+                else:
+                    store.data[k] = v

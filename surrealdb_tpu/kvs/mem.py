@@ -1,0 +1,114 @@
+"""In-memory storage engine (reference: core/src/kvs/mem/).
+
+A sorted keyspace with buffered-writeset transactions: reads hit the shared
+map through the transaction's overlay; writes stay in the overlay until
+commit, which applies atomically under the store lock. Savepoints snapshot
+the overlay (cheap dict copy), giving statement-level rollback like the
+reference's api.rs savepoint API.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from sortedcontainers import SortedDict
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.kvs.api import Backend, BackendTx
+
+
+class MemTx(BackendTx):
+    def __init__(self, store: "MemBackend", write: bool):
+        self.store = store
+        self.write = write
+        self.writes: dict[bytes, Optional[bytes]] = {}  # None = tombstone
+        self.savepoints: list[dict] = []
+        self.done = False
+
+    def _check(self):
+        if self.done:
+            raise SdbError("transaction is finished")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        self._check()
+        if key in self.writes:
+            return self.writes[key]
+        return self.store.data.get(key)
+
+    def set(self, key: bytes, val: bytes) -> None:
+        self._check()
+        if not self.write:
+            raise SdbError("transaction is read-only")
+        self.writes[key] = bytes(val)
+
+    def delete(self, key: bytes) -> None:
+        self._check()
+        if not self.write:
+            raise SdbError("transaction is read-only")
+        self.writes[key] = None
+
+    def scan(self, beg, end, limit=None, reverse=False):
+        self._check()
+        data = self.store.data
+        # snapshot the committed keys in range, then merge the overlay
+        with self.store.lock:
+            base_keys = list(data.irange(beg, end, inclusive=(True, False)))
+        if self.writes:
+            in_range = [
+                k for k in self.writes if beg <= k < end and k not in data
+            ]
+            if in_range:
+                base_keys = sorted(set(base_keys) | set(in_range))
+        if reverse:
+            base_keys = list(reversed(base_keys))
+        n = 0
+        for k in base_keys:
+            if k in self.writes:
+                v = self.writes[k]
+                if v is None:
+                    continue
+            else:
+                v = data.get(k)
+                if v is None:
+                    continue
+            yield k, v
+            n += 1
+            if limit is not None and n >= limit:
+                return
+
+    def new_save_point(self):
+        self.savepoints.append(dict(self.writes))
+
+    def rollback_to_save_point(self):
+        if self.savepoints:
+            self.writes = self.savepoints.pop()
+
+    def release_last_save_point(self):
+        if self.savepoints:
+            self.savepoints.pop()
+
+    def commit(self):
+        self._check()
+        self.done = True
+        if not self.writes:
+            return
+        with self.store.lock:
+            for k, v in self.writes.items():
+                if v is None:
+                    self.store.data.pop(k, None)
+                else:
+                    self.store.data[k] = v
+
+    def cancel(self):
+        self.done = True
+        self.writes.clear()
+
+
+class MemBackend(Backend):
+    def __init__(self):
+        self.data: SortedDict = SortedDict()
+        self.lock = threading.RLock()
+
+    def transaction(self, write: bool) -> MemTx:
+        return MemTx(self, write)

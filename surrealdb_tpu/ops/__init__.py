@@ -1,0 +1,5 @@
+"""JAX/XLA kernels — the TPU compute substrate.
+
+distance: batched distance matrices (MXU einsums where possible)
+topk:     jax.lax.top_k wrappers + blockwise/sharded variants
+"""

@@ -1,0 +1,72 @@
+"""Top-k selection kernels (replaces the reference's DoublePriorityQueue,
+idx/trees/knn.rs:15, with `jax.lax.top_k` over batched distances)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("k",))
+def top_k_smallest(dists, k: int):
+    """dists: [B, N] -> (values [B,k], indices [B,k]) of the k smallest."""
+    neg, idx = jax.lax.top_k(-dists, k)
+    return -neg, idx
+
+
+@partial(jax.jit, static_argnames=("k", "metric"))
+def knn_search(xs, qs, k: int, metric: str = "euclidean", p: float = 3.0,
+               valid=None):
+    """Fused distance + top-k. `valid`: optional [N] bool mask (tombstones /
+    predicate pushdown); invalid rows get +inf distance."""
+    from surrealdb_tpu.ops.distance import distance_matrix
+
+    d = distance_matrix(xs, qs, metric, p)
+    if valid is not None:
+        d = jnp.where(valid[None, :], d, jnp.inf)
+    return top_k_smallest(d, k)
+
+
+@partial(jax.jit, static_argnames=("k", "metric", "block"))
+def knn_search_blocked(xs, qs, k: int, metric: str = "euclidean",
+                       p: float = 3.0, valid=None, block: int = 65536):
+    """Blockwise scan for stores too large to materialize [B, N] at once:
+    lax.scan over row blocks keeping a running top-k (HBM-bandwidth bound,
+    peak memory [B, block])."""
+    from surrealdb_tpu.ops.distance import distance_matrix
+
+    n, dim = xs.shape
+    b = qs.shape[0]
+    nblocks = max((n + block - 1) // block, 1)
+    pad = nblocks * block - n
+    xs_p = jnp.pad(xs, ((0, pad), (0, 0)))
+    if valid is None:
+        valid = jnp.ones((n,), dtype=bool)
+    valid_p = jnp.pad(valid, (0, pad))
+    xs_b = xs_p.reshape(nblocks, block, dim)
+    valid_b = valid_p.reshape(nblocks, block)
+
+    init = (
+        jnp.full((b, k), jnp.inf, dtype=jnp.float32),
+        jnp.full((b, k), -1, dtype=jnp.int32),
+    )
+
+    def step(carry, inp):
+        best_d, best_i = carry
+        blk, vmask, base = inp
+        d = distance_matrix(blk, qs, metric, p)
+        d = jnp.where(vmask[None, :], d, jnp.inf)
+        cand_d, cand_i = jax.lax.top_k(-d, min(k, block))
+        cand_d = -cand_d
+        cand_i = cand_i + base
+        merged_d = jnp.concatenate([best_d, cand_d], axis=1)
+        merged_i = jnp.concatenate([best_i, cand_i], axis=1)
+        nd, sel = jax.lax.top_k(-merged_d, k)
+        ni = jnp.take_along_axis(merged_i, sel, axis=1)
+        return (-nd, ni), None
+
+    bases = jnp.arange(nblocks, dtype=jnp.int32) * block
+    (fd, fi), _ = jax.lax.scan(step, init, (xs_b, valid_b, bases))
+    return fd, fi

@@ -1,0 +1,13 @@
+"""Device-mesh parallelism.
+
+The reference scales out by running stateless query nodes over a distributed
+KV (SURVEY.md §2.13); the TPU build scales the vector/graph hot paths by
+sharding device-resident blocks over a `jax.sharding.Mesh` and letting XLA
+insert ICI collectives (per-shard top-k + cross-shard merge — the same
+shape as the scaling-book's sharded-softmax/top-k recipe)."""
+
+from surrealdb_tpu.parallel.mesh import (  # noqa: F401
+    default_mesh,
+    shard_rows,
+    sharded_knn,
+)

@@ -1,0 +1,60 @@
+"""Sharded KNN over a device mesh.
+
+Vectors live row-sharded across devices ("data" axis). A query broadcast to
+every device computes local distances + a local top-k; `jax.lax.top_k` over
+the all-gathered candidates merges shards. Under jit with sharded inputs XLA
+lowers the merge to ICI collectives (all_gather of k·shards candidates, not
+the full distance row) — this is the `psum`/gather merge called for in
+SURVEY.md §7 step 4.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def default_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def shard_rows(mesh: Mesh, arr):
+    """Place a [N, D] array row-sharded over the mesh (pads N to shards)."""
+    n_shards = mesh.devices.size
+    n = arr.shape[0]
+    pad = (-n) % n_shards
+    if pad:
+        arr = np.pad(arr, ((0, pad), (0, 0)))
+    sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+    return jax.device_put(arr, sharding), pad
+
+
+@partial(jax.jit, static_argnames=("k", "metric"))
+def _sharded_knn_impl(xs, qs, valid, k: int, metric: str, p: float):
+    from surrealdb_tpu.ops.distance import distance_matrix
+
+    d = distance_matrix(xs, qs, metric, p)
+    d = jnp.where(valid[None, :], d, jnp.inf)
+    nd, ni = jax.lax.top_k(-d, k)
+    return -nd, ni
+
+
+def sharded_knn(mesh: Mesh, xs_sharded, qs, valid, k: int,
+                metric: str = "euclidean", p: float = 3.0):
+    """Run fused distance+top-k on row-sharded vectors. XLA partitions the
+    einsum over the data axis and inserts the cross-shard top-k merge."""
+    qs_rep = jax.device_put(qs, NamedSharding(mesh, P(None, None)))
+    out_shard = NamedSharding(mesh, P(None, None))
+    fn = jax.jit(
+        _sharded_knn_impl.__wrapped__,
+        static_argnames=("k", "metric"),
+        out_shardings=(out_shard, out_shard),
+    )
+    return fn(xs_sharded, qs_rep, valid, k, metric, p)

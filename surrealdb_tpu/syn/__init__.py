@@ -1,0 +1,21 @@
+"""SurrealQL frontend: lexer + recursive-descent parser.
+
+Reference: /root/reference/surrealdb/core/src/syn/ (hand-written lexer +
+parser). This build parses directly into the computation tree
+(surrealdb_tpu.expr.ast) — no separate sql:: AST layer, since there is a
+single execution engine.
+"""
+
+from surrealdb_tpu.syn.parser import Parser
+
+
+def parse(text: str):
+    """Parse a SurrealQL query into a list of statements."""
+    return Parser(text).parse_query()
+
+
+def parse_value(text: str):
+    """Parse a single SurrealQL value literal (for test harnesses / RPC)."""
+    from surrealdb_tpu.syn.parser import parse_value_literal
+
+    return parse_value_literal(text)

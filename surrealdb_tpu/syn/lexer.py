@@ -1,0 +1,357 @@
+"""SurrealQL lexer (reference: core/src/syn/lexer/)."""
+
+from __future__ import annotations
+
+from decimal import Decimal
+
+from surrealdb_tpu.err import ParseError
+from surrealdb_tpu.val import Duration
+
+# token kinds
+IDENT = "IDENT"
+PARAM = "PARAM"
+INT = "INT"
+FLOAT = "FLOAT"
+DECIMAL = "DECIMAL"
+DURATION = "DURATION"
+STRING = "STRING"
+DATETIME_STR = "DATETIME"
+UUID_STR = "UUID"
+RECORD_STR = "RECORD"
+BYTES_LIT = "BYTES"
+FILE_STR = "FILE"
+REGEX = "REGEX"
+OP = "OP"
+EOF = "EOF"
+
+_PUNCT3 = ("..=", "...", "?:=")
+_PUNCT2 = (
+    "<|", "|>", "::", "->", "<-", "..", ">=", "<=", "==", "!=", "?=", "*=",
+    "!~", "?~", "*~", "&&", "||", "??", "?:", "**", "+=", "-=", "+?=", "@@",
+    "?.",
+)
+_PUNCT1 = "+-*/%<>=!?()[]{},;:.|&@~$×÷∋∌⊇⊆∈∉⟨`…"
+
+_DUR_UNITS = ("ns", "us", "µs", "ms", "s", "m", "h", "d", "w", "y")
+
+# tokens after which a `/` means division, not a regex start
+_OPERAND_END = {IDENT, INT, FLOAT, DECIMAL, DURATION, STRING, DATETIME_STR,
+                UUID_STR, RECORD_STR, BYTES_LIT, PARAM}
+
+
+class Token:
+    __slots__ = ("kind", "text", "value", "pos", "line", "col", "ws_before")
+
+    def __init__(self, kind, text, value, pos, line, col, ws_before):
+        self.kind = kind
+        self.text = text
+        self.value = value
+        self.pos = pos
+        self.line = line
+        self.col = col
+        self.ws_before = ws_before
+
+    def __repr__(self):
+        return f"Token({self.kind},{self.text!r})"
+
+
+def _is_ident_start(c: str) -> bool:
+    return c.isalpha() or c == "_"
+
+
+def _is_ident(c: str) -> bool:
+    return c.isalnum() or c == "_"
+
+
+def tokenize(src: str) -> list[Token]:
+    toks: list[Token] = []
+    i, n = 0, len(src)
+    line, col = 1, 1
+    ws = False
+
+    def err(msg):
+        raise ParseError(msg, line, col)
+
+    def push(kind, text, value, start):
+        nonlocal ws
+        toks.append(Token(kind, text, value, start, line, col, ws))
+        ws = False
+
+    while i < n:
+        c = src[i]
+        # whitespace
+        if c in " \t\r\n":
+            if c == "\n":
+                line += 1
+                col = 1
+            else:
+                col += 1
+            i += 1
+            ws = True
+            continue
+        # comments
+        if src.startswith("--", i) or src.startswith("//", i) or c == "#":
+            while i < n and src[i] != "\n":
+                i += 1
+            ws = True
+            continue
+        if src.startswith("/*", i):
+            j = src.find("*/", i + 2)
+            if j < 0:
+                err("unterminated block comment")
+            for ch in src[i : j + 2]:
+                if ch == "\n":
+                    line += 1
+                    col = 1
+                else:
+                    col += 1
+            i = j + 2
+            ws = True
+            continue
+        start = i
+        # params
+        if c == "$" and i + 1 < n and (_is_ident_start(src[i + 1])):
+            j = i + 1
+            while j < n and _is_ident(src[j]):
+                j += 1
+            push(PARAM, src[start:j], src[start + 1 : j], start)
+            col += j - i
+            i = j
+            continue
+        # backtick / angle-bracket quoted identifiers
+        if c == "`":
+            j = i + 1
+            buf = []
+            while j < n and src[j] != "`":
+                if src[j] == "\\" and j + 1 < n:
+                    buf.append(src[j + 1])
+                    j += 2
+                else:
+                    buf.append(src[j])
+                    j += 1
+            if j >= n:
+                err("unterminated ` identifier")
+            push(IDENT, src[start : j + 1], "".join(buf), start)
+            col += j + 1 - i
+            i = j + 1
+            continue
+        if c == "⟨":
+            j = i + 1
+            buf = []
+            while j < n and src[j] != "⟩":
+                if src[j] == "\\" and j + 1 < n:
+                    buf.append(src[j + 1])
+                    j += 2
+                else:
+                    buf.append(src[j])
+                    j += 1
+            if j >= n:
+                err("unterminated ⟨ identifier")
+            push(IDENT, src[start : j + 1], "".join(buf), start)
+            col += j + 1 - i
+            i = j + 1
+            continue
+        # prefixed strings: s' d' u' r' b" f"
+        if c in "sdurbf" and i + 1 < n and src[i + 1] in "'\"":
+            quote = src[i + 1]
+            s, j = _lex_string(src, i + 1, quote, err)
+            kindmap = {
+                "s": STRING,
+                "d": DATETIME_STR,
+                "u": UUID_STR,
+                "r": RECORD_STR,
+                "b": BYTES_LIT,
+                "f": FILE_STR,
+            }
+            kind = kindmap[c]
+            val = s
+            if kind == BYTES_LIT:
+                try:
+                    val = bytes.fromhex(s)
+                except ValueError:
+                    err(f"invalid bytes literal {s!r}")
+            push(kind, src[start:j], val, start)
+            col += j - i
+            i = j
+            continue
+        # plain strings
+        if c in "'\"":
+            s, j = _lex_string(src, i, c, err)
+            push(STRING, src[start:j], s, start)
+            col += j - i
+            i = j
+            continue
+        # numbers / durations
+        if c.isdigit():
+            tok, j = _lex_number(src, i, err)
+            toks.append(
+                Token(tok[0], src[start:j], tok[1], start, line, col, ws)
+            )
+            ws = False
+            col += j - i
+            i = j
+            continue
+        # identifiers / keywords
+        if _is_ident_start(c):
+            j = i
+            while j < n and _is_ident(src[j]):
+                j += 1
+            push(IDENT, src[start:j], src[start:j], start)
+            col += j - i
+            i = j
+            continue
+        # regex literal (only where an operand is expected)
+        if c == "/":
+            prev = toks[-1] if toks else None
+            operand_pos = prev is None or not (
+                prev.kind in _OPERAND_END
+                or (prev.kind == OP and prev.text in (")", "]", "}"))
+            )
+            if operand_pos:
+                j = i + 1
+                buf = []
+                while j < n and src[j] != "/":
+                    if src[j] == "\\" and j + 1 < n and src[j + 1] == "/":
+                        buf.append("/")
+                        j += 2
+                    elif src[j] == "\\":
+                        buf.append(src[j])
+                        buf.append(src[j + 1])
+                        j += 2
+                    else:
+                        buf.append(src[j])
+                        j += 1
+                if j >= n:
+                    err("unterminated regex")
+                push(REGEX, src[start : j + 1], "".join(buf), start)
+                col += j + 1 - i
+                i = j + 1
+                continue
+        # punctuation
+        matched = None
+        for p in _PUNCT3:
+            if src.startswith(p, i):
+                matched = p
+                break
+        if matched is None:
+            for p in _PUNCT2:
+                if src.startswith(p, i):
+                    # `<-` could be `<->`
+                    if p == "<-" and src.startswith("<->", i):
+                        matched = "<->"
+                    else:
+                        matched = p
+                    break
+        if matched is None and c in _PUNCT1:
+            matched = c
+        if matched is None:
+            err(f"unexpected character {c!r}")
+        push(OP, matched, matched, start)
+        col += len(matched)
+        i += len(matched)
+        continue
+
+    toks.append(Token(EOF, "", None, n, line, col, ws))
+    return toks
+
+
+def _lex_string(src, i, quote, err):
+    """Lex a quoted string starting at src[i]==quote; return (value, end)."""
+    j = i + 1
+    n = len(src)
+    buf = []
+    while j < n:
+        ch = src[j]
+        if ch == "\\" and j + 1 < n:
+            e = src[j + 1]
+            if e == "n":
+                buf.append("\n")
+            elif e == "t":
+                buf.append("\t")
+            elif e == "r":
+                buf.append("\r")
+            elif e == "b":
+                buf.append("\b")
+            elif e == "f":
+                buf.append("\f")
+            elif e == "0":
+                buf.append("\0")
+            elif e == "u":
+                # \u{XXXX} or \uXXXX
+                if j + 2 < n and src[j + 2] == "{":
+                    k = src.find("}", j + 3)
+                    if k < 0:
+                        err("bad unicode escape")
+                    buf.append(chr(int(src[j + 3 : k], 16)))
+                    j = k + 1
+                    continue
+                buf.append(chr(int(src[j + 2 : j + 6], 16)))
+                j += 6
+                continue
+            else:
+                buf.append(e)
+            j += 2
+            continue
+        if ch == quote:
+            return "".join(buf), j + 1
+        buf.append(ch)
+        j += 1
+    err("unterminated string")
+
+
+def _lex_number(src, i, err):
+    n = len(src)
+    j = i
+    while j < n and (src[j].isdigit() or src[j] == "_"):
+        j += 1
+    is_float = False
+
+    def _unit_ok(k, u):
+        """Unit match at k is terminal: next char must not extend an ident
+        (digits are fine — they start the next duration segment)."""
+        e = k + len(u)
+        return not (e < n and (src[e].isalpha() or src[e] == "_"))
+
+    # duration? digits followed by a unit
+    for u in ("ns", "us", "µs", "ms", "y", "w", "d", "h", "m", "s"):
+        if src.startswith(u, j) and _unit_ok(j, u):
+            # consume chained segments: 1h30m20s
+            total = int(src[i:j].replace("_", "")) * Duration.UNITS[u]
+            j += len(u)
+            while j < n and src[j].isdigit():
+                k = j
+                while k < n and src[k].isdigit():
+                    k += 1
+                got = False
+                for u2 in ("ns", "us", "µs", "ms", "y", "w", "d", "h", "m", "s"):
+                    if src.startswith(u2, k) and _unit_ok(k, u2):
+                        total += int(src[j:k]) * Duration.UNITS[u2]
+                        j = k + len(u2)
+                        got = True
+                        break
+                if not got:
+                    break
+            return (DURATION, Duration(total)), j
+    if j < n and src[j] == "." and j + 1 < n and src[j + 1].isdigit():
+        is_float = True
+        j += 1
+        while j < n and (src[j].isdigit() or src[j] == "_"):
+            j += 1
+    if j < n and src[j] in "eE" and (
+        (j + 1 < n and src[j + 1].isdigit())
+        or (j + 2 < n and src[j + 1] in "+-" and src[j + 2].isdigit())
+    ):
+        is_float = True
+        j += 1
+        if src[j] in "+-":
+            j += 1
+        while j < n and src[j].isdigit():
+            j += 1
+    text = src[i:j].replace("_", "")
+    if src.startswith("dec", j) and not (j + 3 < n and _is_ident(src[j + 3])):
+        return (DECIMAL, Decimal(text)), j + 3
+    if j < n and src[j] == "f" and not (j + 1 < n and _is_ident(src[j + 1])):
+        return (FLOAT, float(text)), j + 1
+    if is_float:
+        return (FLOAT, float(text)), j
+    return (INT, int(text)), j

@@ -1,0 +1,45 @@
+"""Test config: force an 8-device virtual CPU mesh so sharding paths are
+exercised without TPU hardware (the driver dry-runs multichip the same way).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# the baked axon sitecustomize pins JAX_PLATFORMS=axon before conftest runs;
+# override via config so tests use the 8-device virtual CPU mesh
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def ds():
+    from surrealdb_tpu import Datastore
+
+    d = Datastore("memory")
+    yield d
+    d.close()
+
+
+@pytest.fixture()
+def q(ds):
+    def run(sql, **vars):
+        return ds.query(sql, ns="test", db="test", vars=vars or None)
+
+    return run
+
+
+@pytest.fixture()
+def q1(ds):
+    def run(sql, **vars):
+        return ds.query_one(sql, ns="test", db="test", vars=vars or None)
+
+    return run
