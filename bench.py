@@ -1,0 +1,104 @@
+"""Benchmark: KNN QPS + recall@10 vs CPU baseline (BASELINE.md config 2-ish).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Default: 1M×768 cosine, k=10, exact device search (flat store — the engine
+behind `DEFINE INDEX ... HNSW` here), batch 8 queries. `--quick` runs
+100k×128 for smoke. vs_baseline = TPU QPS / single-host numpy brute QPS on
+identical data (the reference ships no absolute numbers — BASELINE.md — so
+the CPU brute scan stands in as the conservative host baseline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--n", type=int, default=None)
+    ap.add_argument("--dim", type=int, default=None)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--k", type=int, default=10)
+    args = ap.parse_args()
+
+    n = args.n or (100_000 if args.quick else 1_000_000)
+    dim = args.dim or (128 if args.quick else 768)
+    k = args.k
+    batch = args.batch
+
+    import jax
+    import jax.numpy as jnp
+
+    from surrealdb_tpu.ops.topk import knn_search
+
+    rng = np.random.default_rng(7)
+    xs = rng.normal(size=(n, dim)).astype(np.float32)
+    n_queries = 256
+    qs_all = rng.normal(size=(n_queries, dim)).astype(np.float32)
+
+    dev = jax.devices()[0]
+    xs_d = jax.device_put(xs, dev)
+
+    # warm up + compile
+    q0 = jax.device_put(qs_all[:batch], dev)
+    d, i = knn_search(xs_d, q0, k, "cosine")
+    jax.block_until_ready((d, i))
+
+    # measure TPU QPS
+    iters = max(n_queries // batch, 1)
+    t0 = time.perf_counter()
+    outs = []
+    for it in range(iters):
+        q = jax.device_put(qs_all[it * batch : (it + 1) * batch], dev)
+        d, i = knn_search(xs_d, q, k, "cosine")
+        outs.append((d, i))
+    jax.block_until_ready(outs[-1])
+    dt = time.perf_counter() - t0
+    tpu_qps = (iters * batch) / dt
+
+    # recall@10 vs exact numpy ground truth on a query subsample
+    sample = min(16, n_queries)
+    xn = xs / np.linalg.norm(xs, axis=1, keepdims=True)
+    got_idx = np.concatenate(
+        [np.asarray(i) for (_d, i) in outs], axis=0
+    )[:sample]
+    recalls = []
+    for b in range(sample):
+        qn = qs_all[b] / np.linalg.norm(qs_all[b])
+        ref = np.argsort(1.0 - xn @ qn)[:k]
+        recalls.append(len(set(ref.tolist()) & set(got_idx[b].tolist())) / k)
+    recall = float(np.mean(recalls))
+
+    # CPU baseline: single-host numpy brute scan (vectorized), same data
+    cpu_iters = 3
+    t0 = time.perf_counter()
+    for b in range(cpu_iters):
+        qn = qs_all[b] / np.linalg.norm(qs_all[b])
+        dcpu = 1.0 - xn @ qn
+        np.argpartition(dcpu, k)[:k]
+    cpu_dt = time.perf_counter() - t0
+    cpu_qps = cpu_iters / cpu_dt
+
+    label = f"knn_qps_{n // 1000}k_{dim}d_cosine_b{batch}"
+    result = {
+        "metric": label,
+        "value": round(tpu_qps, 2),
+        "unit": "qps",
+        "vs_baseline": round(tpu_qps / cpu_qps, 2),
+        "recall_at_10": round(recall, 4),
+        "cpu_baseline_qps": round(cpu_qps, 2),
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

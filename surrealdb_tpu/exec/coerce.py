@@ -32,8 +32,30 @@ def kind_name(kind: Kind) -> str:
         return " | ".join(kind_name(k) for k in kind.inner)
     if kind.name == "record" and kind.inner:
         return f"record<{' | '.join(kind.inner)}>"
+    if kind.name == "literal":
+        from surrealdb_tpu.exec.static_eval import static_value_maybe
+        from surrealdb_tpu.val import render
+
+        try:
+            return render(static_value_maybe(kind.literal))
+        except Exception:
+            return "literal"
     if kind.inner:
-        return f"{kind.name}<{', '.join(kind_name(k) if isinstance(k, Kind) else str(k) for k in kind.inner)}>"
+        # array<any> / set<any> normalize to the bare container kind
+        if (
+            kind.name in ("array", "set")
+            and len(kind.inner) == 1
+            and isinstance(kind.inner[0], Kind)
+            and kind.inner[0].name == "any"
+            and kind.size is None
+        ):
+            return kind.name
+        inner = ", ".join(
+            kind_name(k) if isinstance(k, Kind) else str(k) for k in kind.inner
+        )
+        if kind.size is not None:
+            inner += f", {kind.size}"
+        return f"{kind.name}<{inner}>"
     return kind.name
 
 
@@ -80,8 +102,9 @@ def _type_name(v) -> str:
 
 
 def coerce_err(v, kind: Kind):
+    # reference format: val/value/convert/coerce.rs CoerceError::InvalidKind
     return SdbError(
-        f"Expected a {kind_name(kind)} but found {render(v)}"
+        f"Expected `{kind_name(kind)}` but found `{render(v)}`"
     )
 
 
@@ -258,10 +281,14 @@ def coerce(v, kind: Kind):
         raise coerce_err(v, kind)
     if n == "table":
         if isinstance(v, Table):
-            return v
-        if isinstance(v, str):
-            return Table(v)
-        raise coerce_err(v, kind)
+            t = v
+        elif isinstance(v, str):
+            t = Table(v)
+        else:
+            raise coerce_err(v, kind)
+        if kind.inner and t.name not in kind.inner:
+            raise coerce_err(v, kind)
+        return t
     if n == "references":
         # computed references fields — value is filled by the executor
         return v if isinstance(v, list) else []

@@ -32,11 +32,22 @@ from surrealdb_tpu.val import (
     Range,
     RecordId,
     Table,
+    Uuid,
     copy_value,
     is_truthy,
     render,
     value_eq,
 )
+
+class _Skip:
+    """Sentinel: a row skipped by INSERT IGNORE (distinct from a NONE
+    result, which RETURN NONE/BEFORE legitimately produce)."""
+
+    def __repr__(self):
+        return "SKIP"
+
+
+SKIP = _Skip()
 
 # ---------------------------------------------------------------------------
 # data clause application
@@ -52,8 +63,7 @@ def apply_data(doc: dict, data, ctx: Ctx, rid=None):
         if not isinstance(v, dict):
             raise SdbError(f"Cannot use {render(v)} as CONTENT data")
         out = copy_value(v)
-        out.pop("id", None)
-        if "id" in doc:
+        if "id" not in out and "id" in doc:
             out["id"] = doc["id"]
         return out
     if isinstance(data, MergeData):
@@ -79,10 +89,6 @@ def apply_data(doc: dict, data, ctx: Ctx, rid=None):
         for target, op, expr in data.items:
             v = evaluate(expr, c)
             path = _idiom_path(target)
-            if path == ["id"] and "id" in out:
-                if not value_eq(v, out["id"]):
-                    raise SdbError("Can not change the id of a record")
-                continue
             if op == "=":
                 _set_path_value(out, path, v, ctx)
             elif op == "+=":
@@ -329,31 +335,91 @@ def apply_fields(
                     cur = coerce(cur, fd.kind)
                 except SdbError as e:
                     raise SdbError(
-                        f"Couldn't coerce value for field `{fd.name_str}` of `{rid.render()}`: {e}"
+                        f"Couldn't coerce value for field `{fd.name_str}` of `{rid.render() if rid else '?'}`: {e}"
                     )
             # ASSERT
             if fd.assert_ is not None and cur is not NONE:
                 c.vars["value"] = cur
                 if not is_truthy(evaluate(fd.assert_, c)):
+                    from surrealdb_tpu.exec.render_def import _expr_sql
+
                     raise SdbError(
-                        f"Found {render(cur)} for field `{fd.name_str}`, with record `{rid.render()}`, but field must conform to: {'ASSERT'}"
+                        f"Found {render(cur)} for field `{fd.name_str}`, with record `{rid.render()}`, but field must conform to: {_expr_sql(fd.assert_)}"
                     )
             if cur is NONE:
                 tgt_doc.pop(last, None)
             else:
                 tgt_doc[last] = cur
-    # SCHEMAFULL pruning
+    # COMPUTED fields are read-time only: strip any stored/copied snapshots
+    # (reference doc/field.rs clears computed fields before store; pluck
+    # recomputes them for output)
+    for fd in fields:
+        if fd.computed is not None and fd.name_str in after:
+            after.pop(fd.name_str, None)
+    # SCHEMAFULL strictness: unknown fields error (doc/field.rs)
     if tdef.full:
-        flex_roots = {
-            (f.name[0].name if f.name and isinstance(f.name[0], PField) else "")
-            for f in fields
-            if f.flex
-        }
-        keep = defined_top | {"id", "in", "out"}
-        for k in list(after.keys()):
-            if k not in keep and k not in flex_roots:
-                after.pop(k)
+        defined_paths = set()
+        flex_paths = set()
+        for f in fields:
+            p = tuple(
+                q.name if isinstance(q, PField) else "*" for q in f.name
+            )
+            defined_paths.add(p)
+            if f.flex or (f.kind is not None and f.kind.name == "any"):
+                flex_paths.add(p)
+        _check_schemafull(after, (), defined_paths, flex_paths, fields, tb, rid)
     return after
+
+
+def _field_kind_at(fields, path):
+    for f in fields:
+        p = tuple(q.name if isinstance(q, PField) else "*" for q in f.name)
+        if p == path:
+            return f.kind
+    return None
+
+
+def _check_schemafull(doc, prefix, defined, flex, fields, tb, rid):
+    """Error on any document path not covered by a field definition, unless
+    under a FLEXIBLE (or literal-typed) ancestor."""
+    if not isinstance(doc, dict):
+        return
+    for k in list(doc.keys()):
+        if not prefix and k in ("id", "in", "out"):
+            continue
+        path = prefix + (k,)
+        if _covered(path, flex):
+            continue
+        if path not in defined and not _has_descendant(path, defined):
+            # literal object kinds cover their keys implicitly
+            parent_kind = _field_kind_at(fields, prefix) if prefix else None
+            if parent_kind is not None and parent_kind.name == "literal":
+                continue
+            dotted = ".".join(path)
+            raise SdbError(
+                f"Found field '{dotted}', but no such field exists for table '{tb}'"
+            )
+        v = doc[k]
+        if isinstance(v, dict):
+            _check_schemafull(v, path, defined, flex, fields, tb, rid)
+        elif isinstance(v, list):
+            for item in v:
+                if isinstance(item, dict):
+                    _check_schemafull(
+                        item, path + ("*",), defined, flex, fields, tb, rid
+                    )
+
+
+def _covered(path, flex_paths):
+    """Is some prefix of `path` a flexible field?"""
+    for i in range(1, len(path) + 1):
+        if path[:i] in flex_paths:
+            return True
+    return False
+
+
+def _has_descendant(path, defined):
+    return any(p[: len(path)] == path and len(p) > len(path) for p in defined)
 
 
 def _field_targets(after, before, parent_path):
@@ -394,18 +460,39 @@ def _index_values(idef, doc, ctx, rid):
     return vals
 
 
-def _index_rows(vals):
-    """Expand array columns into one row per element (flattening)."""
-    rows = [[]]
-    for v in vals:
-        if isinstance(v, list):
-            new_rows = []
-            for r in rows:
-                for x in v:
-                    new_rows.append(r + [x])
-            rows = new_rows if v else [r + [NONE] for r in rows]
+def _index_rows(vals, idef=None):
+    """Index-entry combinator (reference idx/index.rs Indexable/Combinator):
+    array columns unnest per-element UNLESS the column idiom ends with `…`
+    (Flatten) — those index the whole (flattened) array as one value. The
+    walk advances only one column iterator per step (staircase, not a cross
+    product)."""
+    from surrealdb_tpu.expr.ast import Idiom, PFlatten
+
+    cols = []
+    for i, v in enumerate(vals):
+        flat = False
+        if idef is not None and i < len(idef.cols):
+            col = idef.cols[i]
+            if isinstance(col, Idiom) and col.parts and isinstance(
+                col.parts[-1], PFlatten
+            ):
+                flat = True
+        if not flat and isinstance(v, list):
+            cols.append(v if v else [NONE])
         else:
-            rows = [r + [v] for r in rows]
+            cols.append([v])
+    rows = []
+    pos = [0] * len(cols)
+    has_next = True
+    while has_next:
+        row = []
+        has_next = False
+        for i, values in enumerate(cols):
+            row.append(values[pos[i]])
+            if not has_next and pos[i] + 1 < len(values):
+                pos[i] += 1
+                has_next = True
+        rows.append(row)
     return rows
 
 
@@ -425,12 +512,12 @@ def index_update(rid: RecordId, before, after, ctx: Ctx):
             fulltext_index_update(idef, rid, before, after, ctx)
             continue
         old_rows = (
-            _index_rows(_index_values(idef, before, ctx, rid))
+            _index_rows(_index_values(idef, before, ctx, rid), idef)
             if isinstance(before, dict)
             else []
         )
         new_rows = (
-            _index_rows(_index_values(idef, after, ctx, rid))
+            _index_rows(_index_values(idef, after, ctx, rid), idef)
             if isinstance(after, dict)
             else []
         )
@@ -503,7 +590,7 @@ def _single_index_add(idef, rid, doc, ctx):
         cur = ctx.txn.get_val(key) or 0
         ctx.txn.set_val(key, cur + 1)
         return
-    rows = _index_rows(_index_values(idef, doc, ctx, rid))
+    rows = _index_rows(_index_values(idef, doc, ctx, rid), idef)
     if idef.unique:
         for row in rows:
             if all(x is NONE or x is None for x in row):
@@ -665,6 +752,10 @@ def rebuild_view(tdef: TableDef, ctx: Ctx):
 
 
 def shape_output(output: OutputClause, before, after, rid, ctx: Ctx):
+    from surrealdb_tpu.exec.eval import apply_computed_fields
+
+    if isinstance(after, dict) and rid is not None:
+        after = apply_computed_fields(rid.tb, after, rid, ctx)
     if output is None or output.kind == "after":
         return copy_value(after) if after is not NONE else NONE
     k = output.kind
@@ -677,10 +768,8 @@ def shape_output(output: OutputClause, before, after, rid, ctx: Ctx):
     if k == "diff":
         from surrealdb_tpu.utils.patch import diff
 
-        return diff(
-            before if isinstance(before, dict) else {},
-            after if isinstance(after, dict) else {},
-        )
+        # NONE→doc diffs as a root replace (reference val diff semantics)
+        return diff(before, after)
     if k in ("fields", "value"):
         from surrealdb_tpu.exec.statements import expr_name
 
@@ -768,29 +857,86 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
     return shape_output(output, before, after, rid, ctx)
 
 
+def record_id_key(v, what="the Record ID"):
+    """Validate+normalize a user-provided id value into a record key
+    (reference: expr id coercion — '' / ranges are invalid)."""
+    if isinstance(v, RecordId):
+        if isinstance(v.id, Range):
+            raise SdbError(
+                f"Found {v.render()} for {what} but this is not a valid id"
+            )
+        v = v.id
+    if isinstance(v, Range):
+        raise SdbError(
+            f"Found {render(v)} for {what} but this is not a valid id"
+        )
+    if isinstance(v, str):
+        if v == "":
+            raise SdbError(
+                f"Found '' for {what} but this is not a valid id"
+            )
+        return v
+    if isinstance(v, bool):
+        raise SdbError(
+            f"Found {render(v)} for {what} but this is not a valid id"
+        )
+    if isinstance(v, float):
+        if v.is_integer():
+            return int(v)
+        raise SdbError(
+            f"Found {render(v)} for {what} but this is not a valid id"
+        )
+    if isinstance(v, int):
+        return v if -(1 << 63) <= v < (1 << 63) else str(v)
+    if isinstance(v, (Uuid, list, dict)):
+        return v
+    raise SdbError(
+        f"Found {render(v)} for {what} but this is not a valid id"
+    )
+
+
+def _id_matches(nid, rid: RecordId) -> bool:
+    """Does a user-supplied id value match the target record? A bare key
+    equal to the record's key also matches (reference doc/check.rs
+    `r.key == v`)."""
+    if isinstance(nid, RecordId):
+        return nid.tb == rid.tb and value_eq(nid.id, rid.id)
+    try:
+        return value_eq(record_id_key(nid, "the `id` field"), rid.id)
+    except SdbError:
+        return False
+
+
 def create_one(target, data, output, ctx: Ctx, upsert=False):
     """CREATE one target (table name / record id)."""
+    explicit = None
     if isinstance(target, Table):
-        rid = RecordId(target.name, generate_record_key())
+        tb = target.name
     elif isinstance(target, RecordId):
         if isinstance(target.id, Range):
-            raise SdbError(f"Cannot CREATE a record range")
-        rid = target
+            raise SdbError(
+                f"Found {target.render()} for the Record ID but this is not a valid id"
+            )
+        tb = target.tb
+        explicit = target
     elif isinstance(target, str):
-        rid = RecordId(target, generate_record_key())
+        tb = target
     else:
         raise SdbError(f"Cannot CREATE {render(target)}")
-    # data may override the id (CREATE person SET id = person:x)
-    doc = apply_data({"id": rid}, data, ctx, rid)
-    nid = doc.get("id")
-    if isinstance(nid, RecordId):
-        if nid.tb != rid.tb or not value_eq(nid.id, rid.id):
-            if isinstance(target, Table) or isinstance(target, str):
-                rid = nid if nid.tb else RecordId(rid.tb, nid.id)
-            else:
-                raise SdbError("Can not change the id of a record")
-    elif nid is not None and nid is not NONE:
-        rid = RecordId(rid.tb, nid)
+    seed = {"id": explicit} if explicit is not None else {}
+    doc = apply_data(seed, data, ctx, explicit)
+    nid = doc.get("id", NONE)
+    if explicit is not None:
+        if nid is not NONE and not _id_matches(nid, explicit):
+            raise SdbError(
+                f"Found {render(nid)} for the `id` field, but a specific record has been specified"
+            )
+        rid = explicit
+    else:
+        if nid is not NONE and nid is not None:
+            rid = RecordId(tb, record_id_key(nid))
+        else:
+            rid = RecordId(tb, generate_record_key())
     doc["id"] = rid
     existing = fetch_record(ctx, rid)
     if existing is not NONE:
@@ -800,6 +946,23 @@ def create_one(target, data, output, ctx: Ctx, upsert=False):
     return _store_record(rid, NONE, doc, ctx, "CREATE", output)
 
 
+def _find_unique_conflict(tb, doc, rid, ctx):
+    """Pre-check unique indexes for a conflicting record (INSERT IGNORE /
+    ON DUPLICATE KEY UPDATE resolution)."""
+    ns, db = ctx.need_ns_db()
+    for idef in get_indexes(tb, ctx):
+        if not idef.unique or idef.hnsw or idef.fulltext:
+            continue
+        rows = _index_rows(_index_values(idef, doc, ctx, rid), idef)
+        for row in rows:
+            if all(x is NONE or x is None for x in row):
+                continue
+            existing = ctx.txn.get_val(K.index_unique(ns, db, tb, idef.name, row))
+            if existing is not None and not value_eq(existing, rid):
+                return existing
+    return None
+
+
 def insert_one(into, doc, ignore, update, output, ctx: Ctx):
     rid = doc.get("id")
     if isinstance(rid, RecordId):
@@ -807,49 +970,68 @@ def insert_one(into, doc, ignore, update, output, ctx: Ctx):
             rid = RecordId(into, rid.id)
     elif rid is not None and rid is not NONE:
         if into is None:
-            raise SdbError("INSERT statement requires a table")
-        rid = RecordId(into, rid)
+            raise SdbError(
+                "Cannot execute INSERT statement where property 'id' is: NONE"
+            )
+        rid = RecordId(into, record_id_key(rid, "the `id` field"))
     else:
         if into is None:
-            raise SdbError("INSERT statement requires a table")
+            raise SdbError(
+                "Cannot execute INSERT statement where property 'id' is: NONE"
+            )
         rid = RecordId(into, generate_record_key())
     doc = copy_value(doc)
     doc["id"] = rid
     existing = fetch_record(ctx, rid)
-    if existing is not NONE:
+    dup_rid = rid if existing is not NONE else None
+    if dup_rid is None and (ignore or update is not None):
+        dup_rid = _find_unique_conflict(rid.tb, doc, rid, ctx)
+        if dup_rid is not None:
+            existing = fetch_record(ctx, dup_rid)
+    if dup_rid is not None and existing is not NONE:
         if ignore:
-            return NONE
+            return SKIP  # IGNORE wins even when ON DUPLICATE KEY is present
         if update is not None:
             from surrealdb_tpu.expr.ast import SetData
 
-            c = ctx.with_doc(existing, rid)
+            c = ctx.with_doc(existing, dup_rid)
             c.vars["input"] = doc
-            newdoc = apply_data(existing, SetData(update), c, rid)
-            return _store_record(rid, existing, newdoc, ctx, "UPDATE", output)
+            newdoc = apply_data(existing, SetData(update), c, dup_rid)
+            return _store_record(
+                dup_rid, existing, newdoc, ctx, "UPDATE", output
+            )
         raise SdbError(f"Database record `{rid.render()}` already exists")
     return _store_record(rid, NONE, doc, ctx, "CREATE", output)
 
 
 def relate_insert_one(into, doc, ignore, output, ctx: Ctx):
-    l = doc.get("in")
-    r = doc.get("out")
-    if not isinstance(l, RecordId) or not isinstance(r, RecordId):
-        raise SdbError("INSERT RELATION requires `in` and `out` record ids")
     rid = doc.get("id")
     if isinstance(rid, RecordId):
         pass
     elif rid is not None and rid is not NONE and into:
-        rid = RecordId(into, rid)
+        rid = RecordId(into, record_id_key(rid, "the `id` field"))
     else:
         if into is None:
-            raise SdbError("INSERT RELATION requires a table")
+            raise SdbError(
+                "Cannot execute INSERT statement where property 'id' is: NONE"
+            )
         rid = RecordId(into, generate_record_key())
+    l = doc.get("in", NONE)
+    r = doc.get("out", NONE)
+    if not isinstance(l, RecordId):
+        raise SdbError(
+            f"Cannot execute INSERT statement where property 'in' is: {render(l)}"
+        )
+    if not isinstance(r, RecordId):
+        raise SdbError(
+            f"Cannot execute INSERT statement where property 'out' is: {render(r)}"
+        )
     doc = copy_value(doc)
     doc["id"] = rid
     existing = fetch_record(ctx, rid)
     if existing is not NONE:
         if ignore:
-            return NONE
+            return SKIP
         raise SdbError(f"Database record `{rid.render()}` already exists")
     return _store_record(rid, NONE, doc, ctx, "CREATE", output, edge=(l, r))
 
@@ -857,6 +1039,11 @@ def relate_insert_one(into, doc, ignore, output, ctx: Ctx):
 def update_one(rid: RecordId, before: dict, data, output, ctx: Ctx):
     c = ctx.with_doc(before, rid)
     after = apply_data(before, data, c, rid)
+    nid = after.get("id", NONE)
+    if nid is not NONE and not _id_matches(nid, rid):
+        raise SdbError(
+            f"Found {render(nid)} for the `id` field, but a specific record has been specified"
+        )
     after["id"] = rid
     return _store_record(rid, before, after, ctx, "UPDATE", output)
 

@@ -55,7 +55,8 @@ def generate_record_key(kind: str = "__gen_rand__"):
 
 
 def fetch_record(ctx: Ctx, rid: RecordId):
-    """Fetch a record document (NONE if missing); caches within a statement."""
+    """Fetch a record document (NONE if missing); caches within a statement.
+    Computed fields are evaluated on read (reference doc/compute.rs)."""
     ck = (rid.tb, K.enc_value(rid.id))
     hit = ctx.record_cache.get(ck)
     if hit is not None:
@@ -68,7 +69,43 @@ def fetch_record(ctx: Ctx, rid: RecordId):
         from surrealdb_tpu.kvs.api import deserialize
 
         doc = deserialize(raw)
+        ctx.record_cache[ck] = doc  # pre-cache raw: breaks compute cycles
+        doc = apply_computed_fields(rid.tb, doc, rid, ctx)
     ctx.record_cache[ck] = doc
+    return doc
+
+
+def computed_fields_of(tb: str, ctx: Ctx):
+    """Computed field definitions for a table (cached per statement)."""
+    ck = ("__computed__", tb)
+    hit = ctx.record_cache.get(ck)
+    if hit is not None:
+        return hit
+    ns, db = ctx.need_ns_db()
+    out = []
+    for _k, fd in ctx.txn.scan_vals(*K.prefix_range(K.fd_prefix(ns, db, tb))):
+        if fd.computed is not None:
+            out.append(fd)
+    ctx.record_cache[ck] = out
+    return out
+
+
+def apply_computed_fields(tb: str, doc, rid, ctx: Ctx):
+    """Evaluate COMPUTED fields into the document on read."""
+    if not isinstance(doc, dict):
+        return doc
+    fds = computed_fields_of(tb, ctx)
+    if not fds:
+        return doc
+    doc = dict(doc)
+    for fd in fds:
+        c = ctx.with_doc(doc, rid)
+        try:
+            doc[fd.name_str] = evaluate(fd.computed, c)
+        except SdbError:
+            # a failing computed expression reads as NULL (reference
+            # computed-future semantics); internal errors still propagate
+            doc[fd.name_str] = None
     return doc
 
 

@@ -48,6 +48,13 @@ class Executor:
                     txn = self.ds.transaction(write=True)
                     failed = False
                     buffered = []
+                    results.append(QueryResult(result=NONE))
+                else:
+                    results.append(
+                        QueryResult(
+                            error="Cannot BEGIN a transaction within a transaction"
+                        )
+                    )
                 continue
             if isinstance(stmt, CommitStmt):
                 if txn is not None:
@@ -58,9 +65,21 @@ class Executor:
                                 results[i] = QueryResult(
                                     error="The query was not executed due to a failed transaction"
                                 )
+                        results.append(
+                            QueryResult(
+                                error="The query was not executed due to a failed transaction"
+                            )
+                        )
                     else:
                         txn.commit()
+                        results.append(QueryResult(result=NONE))
                     txn = None
+                else:
+                    results.append(
+                        QueryResult(
+                            error="Cannot COMMIT without starting a transaction"
+                        )
+                    )
                 continue
             if isinstance(stmt, CancelStmt):
                 if txn is not None:
@@ -70,6 +89,13 @@ class Executor:
                             error="The query was not executed due to a cancelled transaction"
                         )
                     txn = None
+                    results.append(QueryResult(result=NONE))
+                else:
+                    results.append(
+                        QueryResult(
+                            error="Cannot CANCEL without starting a transaction"
+                        )
+                    )
                 continue
             if txn is not None and failed:
                 results.append(

@@ -1,31 +1,36 @@
-"""Canonical SQL text for catalog definitions (INFO FOR output).
+"""Canonical SQL text + INFO STRUCTURE forms for catalog definitions.
 
-Reference renders definitions back to their DEFINE statements; we do the
-same so INFO output is usable as an import script (kvs/export.rs)."""
+Formats match the reference's ToSql/InfoStructure impls exactly
+(sql/statements/define/*.rs fmt_sql, catalog/*.rs InfoStructure) so INFO FOR
+output is byte-compatible and usable as an import script."""
 
 from __future__ import annotations
 
-from surrealdb_tpu.val import Duration, escape_ident
+from surrealdb_tpu.val import NONE, Duration, escape_ident
 
 
 def _expr_sql(node) -> str:
-    """Best-effort canonical text of an expression AST."""
+    """Canonical text of an expression AST (reference CoverStmts rendering)."""
     from surrealdb_tpu.expr.ast import (
         ArrayExpr,
         Binary,
         BlockExpr,
         Cast,
+        ClosureExpr,
         Constant,
         FunctionCall,
         Idiom,
+        IfElse,
         Knn,
         Literal,
+        Mock,
         ObjectExpr,
         Param,
         PField,
         Prefix,
         RangeExpr,
         RecordIdLit,
+        RegexLit,
         SelectStmt,
         Subquery,
     )
@@ -38,10 +43,22 @@ def _expr_sql(node) -> str:
     if isinstance(node, Param):
         return f"${node.name}"
     if isinstance(node, Binary):
-        op = {"&&": "AND", "||": "OR"}.get(node.op, node.op)
+        op = {"&&": "AND", "||": "OR", "∈": "INSIDE", "∉": "NOT INSIDE",
+              "∋": "CONTAINS", "∌": "CONTAINSNOT", "⊇": "CONTAINSALL",
+              "⊆": "ALLINSIDE"}.get(node.op, node.op)
         return f"{_expr_sql(node.lhs)} {op} {_expr_sql(node.rhs)}"
     if isinstance(node, Prefix):
         return f"{node.op}{_expr_sql(node.expr)}"
+    if isinstance(node, RegexLit):
+        return f"/{node.pattern}/"
+    if isinstance(node, Knn):
+        if node.ef is not None:
+            return f"{_expr_sql(node.lhs)} <|{node.k},{node.ef}|> {_expr_sql(node.rhs)}"
+        if node.dist is not None:
+            d = node.dist
+            ds = f"MINKOWSKI {d[1]}" if isinstance(d, tuple) else d.upper()
+            return f"{_expr_sql(node.lhs)} <|{node.k},{ds}|> {_expr_sql(node.rhs)}"
+        return f"{_expr_sql(node.lhs)} <|{node.k}|> {_expr_sql(node.rhs)}"
     if isinstance(node, FunctionCall):
         args = ", ".join(_expr_sql(a) for a in node.args)
         return f"{node.name}({args})"
@@ -52,34 +69,168 @@ def _expr_sql(node) -> str:
     if isinstance(node, ArrayExpr):
         return "[" + ", ".join(_expr_sql(x) for x in node.items) + "]"
     if isinstance(node, ObjectExpr):
-        inner = ", ".join(f"{k}: {_expr_sql(v)}" for k, v in node.items)
+        if not node.items:
+            return "{  }"
+        inner = ", ".join(f"{escape_ident(k)}: {_expr_sql(v)}" for k, v in node.items)
         return "{ " + inner + " }"
     if isinstance(node, RecordIdLit):
-        return f"{node.tb}:{_expr_sql(node.id)}"
+        from surrealdb_tpu.val import render_record_id_key
+
+        idv = node.id
+        if isinstance(idv, Literal):
+            return f"{escape_ident(node.tb)}:{render_record_id_key(idv.value)}"
+        return f"{escape_ident(node.tb)}:{_expr_sql(idv)}"
+    if isinstance(node, RangeExpr):
+        beg = _expr_sql(node.beg) if node.beg is not None else ""
+        end = _expr_sql(node.end) if node.end is not None else ""
+        op = "..=" if node.end_incl else ".."
+        if not node.beg_incl:
+            beg += ">"
+        return f"{beg}{op}{end}"
     if isinstance(node, Subquery):
         return f"({_expr_sql(node.stmt)})"
     if isinstance(node, BlockExpr):
-        return "{ " + "; ".join(_expr_sql(s) for s in node.stmts) + " }"
+        if len(node.stmts) == 1:
+            return "{ " + _expr_sql(node.stmts[0]) + " }"
+        return "{ " + "; ".join(_expr_sql(s) for s in node.stmts) + "; }"
     if isinstance(node, Constant):
         return node.name
     if isinstance(node, Cast):
-        return f"<{node.kind.name}> {_expr_sql(node.expr)}"
+        from surrealdb_tpu.exec.coerce import kind_name
+
+        return f"<{kind_name(node.kind)}> {_expr_sql(node.expr)}"
+    if isinstance(node, ClosureExpr):
+        from surrealdb_tpu.exec.coerce import kind_name
+
+        ps = ", ".join(
+            f"${n}" + (f": {kind_name(k)}" if k is not None else "")
+            for n, k in node.params
+        )
+        ret = f" -> {kind_name(node.returns)}" if node.returns else ""
+        return f"|{ps}|{ret} {_expr_sql(node.body)}"
+    if isinstance(node, IfElse):
+        out = []
+        for i, (cond, body) in enumerate(node.branches):
+            kw = "IF" if i == 0 else "ELSE IF"
+            out.append(f"{kw} {_expr_sql(cond)} {_expr_sql(body)}")
+        if node.otherwise is not None:
+            out.append(f"ELSE {_expr_sql(node.otherwise)}")
+        return " ".join(out)
+    if isinstance(node, Mock):
+        if node.end is not None:
+            return f"|{node.tb}:{node.beg}..{node.end}|"
+        return f"|{node.tb}:{node.beg}|"
     if isinstance(node, SelectStmt):
+        return _select_sql(node)
+    # statements in expression position
+    from surrealdb_tpu.expr.ast import (
+        CreateStmt,
+        DeleteStmt,
+        LetStmt,
+        RelateStmt,
+        ReturnStmt,
+        UpdateStmt,
+        UpsertStmt,
+    )
+
+    if isinstance(node, ReturnStmt):
+        return f"RETURN {_expr_sql(node.what)}"
+    if isinstance(node, LetStmt):
+        return f"LET ${node.name} = {_expr_sql(node.what)}"
+    if isinstance(node, CreateStmt):
+        return "CREATE " + ", ".join(_expr_sql(w) for w in node.what) + _data_sql(node.data)
+    if isinstance(node, (UpdateStmt, UpsertStmt)):
+        kw = "UPDATE" if isinstance(node, UpdateStmt) else "UPSERT"
+        out = f"{kw} " + ", ".join(_expr_sql(w) for w in node.what) + _data_sql(node.data)
+        if node.cond is not None:
+            out += f" WHERE {_expr_sql(node.cond)}"
+        return out
+    if isinstance(node, DeleteStmt):
+        out = "DELETE " + ", ".join(_expr_sql(w) for w in node.what)
+        if node.cond is not None:
+            out += f" WHERE {_expr_sql(node.cond)}"
+        return out
+    if isinstance(node, RelateStmt):
+        return (
+            f"RELATE {_expr_sql(node.from_)} -> {_expr_sql(node.kind)} -> "
+            f"{_expr_sql(node.to)}" + _data_sql(node.data)
+        )
+    return str(node)
+
+
+def _data_sql(data) -> str:
+    from surrealdb_tpu.expr.ast import (
+        ContentData,
+        MergeData,
+        PatchData,
+        ReplaceData,
+        SetData,
+        UnsetData,
+    )
+
+    if data is None:
+        return ""
+    if isinstance(data, SetData):
+        items = ", ".join(
+            f"{_expr_sql(t)} {op} {_expr_sql(e)}" for t, op, e in data.items
+        )
+        return f" SET {items}"
+    if isinstance(data, ContentData):
+        return f" CONTENT {_expr_sql(data.expr)}"
+    if isinstance(data, ReplaceData):
+        return f" REPLACE {_expr_sql(data.expr)}"
+    if isinstance(data, MergeData):
+        return f" MERGE {_expr_sql(data.expr)}"
+    if isinstance(data, PatchData):
+        return f" PATCH {_expr_sql(data.expr)}"
+    if isinstance(data, UnsetData):
+        return " UNSET " + ", ".join(_expr_sql(f) for f in data.fields)
+    return ""
+
+
+def _select_sql(node) -> str:
+    from surrealdb_tpu.exec.statements import expr_name
+
+    if node.value is not None:
+        fields = f"VALUE {_expr_sql(node.value)}"
+    else:
         fields = ", ".join(
             "*" if e == "*" else (_expr_sql(e) + (f" AS {a}" if a else ""))
             for e, a in node.exprs
         )
-        whats = ", ".join(_expr_sql(w) for w in node.what)
-        out = f"SELECT {fields} FROM {whats}"
-        if node.cond is not None:
-            out += f" WHERE {_expr_sql(node.cond)}"
-        if node.group is not None:
-            if node.group:
-                out += " GROUP BY " + ", ".join(_expr_sql(g) for g in node.group)
-            else:
-                out += " GROUP ALL"
-        return out
-    return str(node)
+    whats = ", ".join(_expr_sql(w) for w in node.what)
+    out = f"SELECT {fields} FROM {whats}"
+    if node.cond is not None:
+        out += f" WHERE {_expr_sql(node.cond)}"
+    if node.split:
+        out += " SPLIT " + ", ".join(_expr_sql(s) for s in node.split)
+    if node.group is not None:
+        if node.group:
+            out += " GROUP BY " + ", ".join(_expr_sql(g) for g in node.group)
+        else:
+            out += " GROUP ALL"
+    if node.order:
+        if node.order == "rand":
+            out += " ORDER BY RAND()"
+        else:
+            items = []
+            for expr, d, collate, numeric in node.order:
+                s = _expr_sql(expr)
+                if collate:
+                    s += " COLLATE"
+                if numeric:
+                    s += " NUMERIC"
+                if d == "desc":
+                    s += " DESC"
+                items.append(s)
+            out += " ORDER BY " + ", ".join(items)
+    if node.limit is not None:
+        out += f" LIMIT {_expr_sql(node.limit)}"
+    if node.start is not None:
+        out += f" START {_expr_sql(node.start)}"
+    if node.fetch:
+        out += " FETCH " + ", ".join(_expr_sql(f) for f in node.fetch)
+    return out
 
 
 def _kind_sql(kind) -> str:
@@ -88,65 +239,175 @@ def _kind_sql(kind) -> str:
     return kind_name(kind)
 
 
-def _perm_sql(p) -> str:
-    if p is True:
-        return "FULL"
-    if p is False or p is None:
-        return "NONE"
-    return f"WHERE {_expr_sql(p)}"
+# ---------------------------------------------------------------------------
+# permissions
+# ---------------------------------------------------------------------------
+
+_ACTIONS = ("select", "create", "update", "delete")
 
 
-def _perms_sql(perms) -> str:
+def _perm_of(perms, action, default):
     if perms is None:
-        return "NONE"
+        return default
+    return perms.get(action, default)
+
+
+def _perms_sql(perms, default=False, field=False) -> str:
+    """Reference sql/permission.rs fmt_sql: NONE / FULL / grouped FOR."""
+    actions = _ACTIONS[:3] if field else _ACTIONS
+    vals = {a: _perm_of(perms, a, default) for a in _ACTIONS}
+    considered = [vals[a] for a in actions]
+    if field:
+        # fields don't track delete
+        pass
+    if all(v is False for v in considered) and (field or vals["delete"] is False):
+        return "PERMISSIONS NONE"
+    if all(v is True for v in considered) and (field or vals["delete"] is True):
+        return "PERMISSIONS FULL"
+    # group kinds by identical permission, order select, create, update, delete
+    lines = []
+    order = ["select", "create", "update"] + ([] if field else ["delete"])
+    for a in order:
+        v = vals[a]
+        if a == "delete" and v is True:
+            continue  # delete Full skipped (catalog fields don't track it)
+        placed = False
+        for entry in lines:
+            if _perm_eq(entry[1], v):
+                entry[0].append(a)
+                placed = True
+                break
+        if not placed:
+            lines.append(([a], v))
     parts = []
-    for action in ("select", "create", "update", "delete"):
-        parts.append(f"FOR {action} {_perm_sql(perms.get(action, False))}")
-    return ", ".join(parts)
+    for kinds, v in lines:
+        ks = ", ".join(kinds)
+        if v is True:
+            parts.append(f"FOR {ks} FULL")
+        elif v is False:
+            parts.append(f"FOR {ks} NONE")
+        else:
+            parts.append(f"FOR {ks} WHERE {_expr_sql(v)}")
+    return "PERMISSIONS " + ", ".join(parts)
+
+
+def _perm_eq(a, b):
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    # WHERE permissions group when structurally equal (reference compares
+    # the Permission values, not identities)
+    return _expr_sql(a) == _expr_sql(b)
+
+
+def _perm_structure(v):
+    if v is True:
+        return True
+    if v is False:
+        return False
+    return _expr_sql(v)
+
+
+def perms_structure(perms, default=False, field=False):
+    actions = _ACTIONS[:3] if field else _ACTIONS
+    return {
+        a: _perm_structure(_perm_of(perms, a, default)) for a in actions
+    }
+
+
+# ---------------------------------------------------------------------------
+# canonical DEFINE statements
+# ---------------------------------------------------------------------------
 
 
 def render_ns(d) -> str:
-    return f"DEFINE NAMESPACE {escape_ident(d.name)}"
+    out = f"DEFINE NAMESPACE {escape_ident(d.name)}"
+    if d.comment:
+        out += f" COMMENT {_str_sql(d.comment)}"
+    return out
+
+
+def _str_sql(s) -> str:
+    from surrealdb_tpu.val import escape_string
+
+    return escape_string(s)
 
 
 def render_db(d) -> str:
     out = f"DEFINE DATABASE {escape_ident(d.name)}"
+    if d.comment:
+        out += f" COMMENT {_str_sql(d.comment)}"
     if d.changefeed:
         out += f" CHANGEFEED {Duration(d.changefeed).render()}"
     return out
 
 
 def render_table(d) -> str:
-    out = f"DEFINE TABLE {escape_ident(d.name)}"
+    out = f"DEFINE TABLE {escape_ident(d.name)} TYPE"
+    if d.kind == "any":
+        out += " ANY"
+    elif d.kind == "relation":
+        out += " RELATION"
+        if d.relation_from:
+            out += " IN " + " | ".join(escape_ident(x) for x in d.relation_from)
+        if d.relation_to:
+            out += " OUT " + " | ".join(escape_ident(x) for x in d.relation_to)
+        if d.enforced:
+            out += " ENFORCED"
+    else:
+        out += " NORMAL"
     if d.drop:
         out += " DROP"
     out += " SCHEMAFULL" if d.full else " SCHEMALESS"
-    if d.kind == "relation":
-        out += " TYPE RELATION"
-        if d.relation_from:
-            out += " IN " + " | ".join(d.relation_from)
-        if d.relation_to:
-            out += " OUT " + " | ".join(d.relation_to)
-        if d.enforced:
-            out += " ENFORCED"
-    elif d.kind == "any":
-        out += " TYPE ANY"
-    else:
-        out += " TYPE NORMAL"
+    if d.comment:
+        out += f" COMMENT {_str_sql(d.comment)}"
     if d.view is not None:
         out += f" AS {_expr_sql(d.view)}"
     if d.changefeed:
         out += f" CHANGEFEED {Duration(d.changefeed).render()}"
-    out += f" PERMISSIONS {_perms_sql(d.permissions)}"
+        if d.changefeed_original:
+            out += " INCLUDE ORIGINAL"
+    out += " " + _perms_sql(d.permissions, default=False)
     return out
+
+
+def table_structure(d) -> dict:
+    out = {
+        "name": d.name,
+        "drop": d.drop,
+        "schemafull": d.full,
+        "kind": _table_kind_structure(d),
+        "permissions": perms_structure(d.permissions, default=False),
+    }
+    if d.view is not None:
+        out["view"] = _expr_sql(d.view)
+    if d.changefeed:
+        out["changefeed"] = {
+            "expiry": Duration(d.changefeed).render(),
+            "original": d.changefeed_original,
+        }
+    if d.comment:
+        out["comment"] = d.comment
+    return out
+
+
+def _table_kind_structure(d):
+    if d.kind == "relation":
+        out = {"kind": "RELATION"}
+        if d.relation_from:
+            out["in"] = d.relation_from
+        if d.relation_to:
+            out["out"] = d.relation_to
+        out["enforced"] = d.enforced
+        return out
+    return {"kind": d.kind.upper()}
 
 
 def render_field(d, tb) -> str:
     out = f"DEFINE FIELD {d.name_str} ON {escape_ident(tb)}"
-    if d.flex:
-        out += " FLEXIBLE"
     if d.kind is not None:
         out += f" TYPE {_kind_sql(d.kind)}"
+        if d.flex:
+            out += " FLEXIBLE"
     if d.default is not None:
         out += " DEFAULT"
         if d.default_always:
@@ -158,7 +419,33 @@ def render_field(d, tb) -> str:
         out += f" VALUE {_expr_sql(d.value)}"
     if d.assert_ is not None:
         out += f" ASSERT {_expr_sql(d.assert_)}"
-    out += f" PERMISSIONS {_perms_sql(d.permissions) if d.permissions is not None else 'FULL'}"
+    if d.computed is not None:
+        out += f" COMPUTED {_expr_sql(d.computed)}"
+    if d.comment:
+        out += f" COMMENT {_str_sql(d.comment)}"
+    out += " " + _perms_sql(d.permissions, default=True, field=True)
+    return out
+
+
+def field_structure(d, tb) -> dict:
+    out = {"name": d.name_str, "table": tb}
+    if d.kind is not None:
+        out["kind"] = _kind_sql(d.kind)
+    if d.flex:
+        out["flexible"] = True
+    if d.value is not None:
+        out["value"] = _expr_sql(d.value)
+    if d.assert_ is not None:
+        out["assert"] = _expr_sql(d.assert_)
+    if d.computed is not None:
+        out["computed"] = _expr_sql(d.computed)
+    if d.default is not None:
+        out["default_always"] = d.default_always
+        out["default"] = _expr_sql(d.default)
+    out["readonly"] = d.readonly
+    out["permissions"] = perms_structure(d.permissions, default=True, field=True)
+    if d.comment:
+        out["comment"] = d.comment
     return out
 
 
@@ -191,27 +478,77 @@ def render_index(d) -> str:
     return out
 
 
+def index_structure(d) -> dict:
+    out = {"name": d.name, "what": d.tb, "cols": list(d.cols_str)}
+    if d.unique:
+        out["index"] = "UNIQUE"
+    elif d.count:
+        out["index"] = "COUNT"
+    elif d.fulltext is not None:
+        out["index"] = "FULLTEXT"
+    elif d.hnsw is not None:
+        out["index"] = "HNSW"
+    else:
+        out["index"] = "IDX"
+    return out
+
+
 def render_event(d, tb) -> str:
     then = ", ".join(_expr_sql(t) for t in d.then)
-    return (
+    out = (
         f"DEFINE EVENT {escape_ident(d.name)} ON {escape_ident(tb)} "
-        f"WHEN {_expr_sql(d.when) if d.when is not None else 'true'} THEN ({then})"
+        f"WHEN {_expr_sql(d.when) if d.when is not None else 'true'} THEN {then}"
     )
+    if d.comment:
+        out += f" COMMENT {_str_sql(d.comment)}"
+    return out
+
+
+def event_structure(d, tb) -> dict:
+    return {
+        "name": d.name,
+        "what": tb,
+        "when": _expr_sql(d.when) if d.when is not None else "true",
+        "then": [_expr_sql(t) for t in d.then],
+    }
 
 
 def render_param(d) -> str:
     from surrealdb_tpu.val import render as vr
 
-    return f"DEFINE PARAM ${d.name} VALUE {vr(d.value)} PERMISSIONS {_perm_sql(d.permissions)}"
+    out = f"DEFINE PARAM ${d.name} VALUE {vr(d.value)}"
+    p = d.permissions
+    if p is True or p is None:
+        out += " PERMISSIONS FULL"
+    elif p is False:
+        out += " PERMISSIONS NONE"
+    else:
+        out += f" PERMISSIONS WHERE {_expr_sql(p)}"
+    return out
 
 
 def render_function(d) -> str:
-    args = ", ".join(f"${n}: {_kind_sql(k)}" for n, k in d.args)
-    return f"DEFINE FUNCTION fn::{d.name}({args}) {_expr_sql(d.block)}"
+    from surrealdb_tpu.exec.coerce import kind_name
+
+    args = ", ".join(f"${n}: {kind_name(k)}" for n, k in d.args)
+    out = f"DEFINE FUNCTION fn::{d.name}({args})"
+    if d.returns is not None:
+        out += f" -> {kind_name(d.returns)}"
+    out += f" {_expr_sql(d.block)}"
+    p = d.permissions
+    if p is True or p is None:
+        out += " PERMISSIONS FULL"
+    elif p is False:
+        out += " PERMISSIONS NONE"
+    else:
+        out += f" PERMISSIONS WHERE {_expr_sql(p)}"
+    return out
 
 
 def render_analyzer(d) -> str:
     out = f"DEFINE ANALYZER {escape_ident(d.name)}"
+    if d.function:
+        out += f" FUNCTION fn::{d.function}"
     if d.tokenizers:
         out += " TOKENIZERS " + ",".join(t.upper() for t in d.tokenizers)
     if d.filters:
@@ -222,19 +559,34 @@ def render_analyzer(d) -> str:
             else:
                 fs.append(f"{f[0].upper()}({','.join(str(x) for x in f[1:])})")
         out += " FILTERS " + ",".join(fs)
+    if d.comment:
+        out += f" COMMENT {_str_sql(d.comment)}"
     return out
 
 
 def render_user(d) -> str:
     roles = ", ".join(r.upper() for r in d.roles)
-    return (
-        f"DEFINE USER {escape_ident(d.name)} ON {d.base.upper()} "
-        f"PASSHASH '{d.passhash}' ROLES {roles}"
+    base = {"root": "ROOT", "ns": "NAMESPACE", "db": "DATABASE"}.get(
+        d.base, d.base.upper()
     )
+    out = (
+        f"DEFINE USER {escape_ident(d.name)} ON {base} "
+        f"PASSHASH {_str_sql(d.passhash)} ROLES {roles}"
+    )
+    dur = d.duration or {}
+    tok = dur.get("token", Duration.parse("1h"))
+    ses = dur.get("session")
+    tok_s = tok.render() if isinstance(tok, Duration) else (tok or "NONE")
+    ses_s = ses.render() if isinstance(ses, Duration) else (ses or "NONE")
+    out += f" DURATION FOR TOKEN {tok_s}, FOR SESSION {ses_s}"
+    return out
 
 
 def render_access(d) -> str:
-    return f"DEFINE ACCESS {escape_ident(d.name)} ON {d.base.upper()} TYPE {d.kind.upper()}"
+    base = {"root": "ROOT", "ns": "NAMESPACE", "db": "DATABASE"}.get(
+        d.base, d.base.upper()
+    )
+    return f"DEFINE ACCESS {escape_ident(d.name)} ON {base} TYPE {d.kind.upper()}"
 
 
 def render_sequence(d) -> str:

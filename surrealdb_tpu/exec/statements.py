@@ -68,7 +68,12 @@ def eval_statement(node, ctx: Ctx):
 def _s_let(n: LetStmt, ctx):
     v = evaluate(n.what, ctx)
     if n.kind is not None:
-        v = coerce(v, n.kind)
+        try:
+            v = coerce(v, n.kind)
+        except SdbError as e:
+            raise SdbError(
+                f"Tried to set `${n.name}`, but couldn't coerce value: {e}"
+            )
     ctx.vars[n.name] = v
     return NONE
 
@@ -144,7 +149,10 @@ def _s_use(n: UseStmt, ctx):
     if n.db:
         ctx.session.db = n.db
         ctx.db = n.db
-    return NONE
+    return {
+        "database": ctx.session.db,
+        "namespace": ctx.session.ns,
+    }
 
 
 def _s_option(n, ctx):
@@ -213,6 +221,7 @@ def _iterate_value(v, ctx, cond=None, stmt=None):
 
 def _scan_table(tb: str, ctx, cond=None, stmt=None):
     """Table scan — consults the index planner first (idx/planner.rs)."""
+    from surrealdb_tpu.exec.eval import apply_computed_fields, computed_fields_of
     from surrealdb_tpu.idx.planner import plan_scan
 
     plan = plan_scan(tb, cond, ctx, stmt)
@@ -222,10 +231,15 @@ def _scan_table(tb: str, ctx, cond=None, stmt=None):
     ns, db = ctx.need_ns_db()
     from surrealdb_tpu.kvs.api import deserialize
 
+    has_computed = bool(computed_fields_of(tb, ctx))
     beg, end = K.prefix_range(K.record_prefix(ns, db, tb))
     for k, raw in ctx.txn.scan(beg, end):
         _ns, _db, _tb, idv = K.decode_record_id(k)
-        yield Source(rid=RecordId(tb, idv), doc=deserialize(raw))
+        rid = RecordId(tb, idv)
+        doc = deserialize(raw)
+        if has_computed:
+            doc = apply_computed_fields(tb, doc, rid, ctx)
+        yield Source(rid=rid, doc=doc)
 
 
 def _scan_record_range(v: RecordId, ctx):
@@ -813,7 +827,9 @@ def _s_insert(n: InsertStmt, ctx: Ctx):
                 results.append(
                     insert_one(into, item, n.ignore, n.update, n.output, ctx)
                 )
-    results = [r for r in results if r is not NONE]
+    from surrealdb_tpu.exec.document import SKIP
+
+    results = [r for r in results if r is not SKIP]
     if n.output is not None and n.output.kind == "none":
         return []
     return results
@@ -1401,8 +1417,8 @@ def _s_info(n: InfoStmt, ctx: Ctx):
         ns, db = ctx.need_ns_db()
         out = {
             "accesses": {}, "analyzers": {}, "apis": {}, "buckets": {},
-            "configs": {}, "functions": {}, "models": {}, "params": {},
-            "sequences": {}, "tables": {}, "users": {},
+            "configs": {}, "functions": {}, "models": {}, "modules": {},
+            "params": {}, "sequences": {}, "tables": {}, "users": {},
         }
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.tb_prefix(ns, db))):
             out["tables"][d.name] = render_table(d)
@@ -1427,10 +1443,32 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             out["sequences"][sd.name] = render_sequence(sd)
         return out
     if n.level == "table":
+        from surrealdb_tpu.exec.render_def import (
+            event_structure,
+            field_structure,
+            index_structure,
+        )
+
         ns, db = ctx.need_ns_db()
         tb = n.target
         if ctx.txn.get(K.tb_def(ns, db, tb)) is None:
             raise SdbError(f"The table '{tb}' does not exist")
+        if n.structure:
+            out = {"events": [], "fields": [], "indexes": [], "lives": [],
+                   "tables": []}
+            for _k, d in ctx.txn.scan_vals(
+                *K.prefix_range(K.fd_prefix(ns, db, tb))
+            ):
+                out["fields"].append(field_structure(d, tb))
+            for _k, d in ctx.txn.scan_vals(
+                *K.prefix_range(K.ix_prefix(ns, db, tb))
+            ):
+                out["indexes"].append(index_structure(d))
+            for _k, d in ctx.txn.scan_vals(
+                *K.prefix_range(K.ev_prefix(ns, db, tb))
+            ):
+                out["events"].append(event_structure(d, tb))
+            return out
         out = {"events": {}, "fields": {}, "indexes": {}, "lives": {},
                "tables": {}}
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.fd_prefix(ns, db, tb))):

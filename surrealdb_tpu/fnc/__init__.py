@@ -118,7 +118,12 @@ def call_custom(name, args, ctx):
     except ReturnException as r:
         out = r.value
     if fd.returns is not None:
-        out = coerce(out, fd.returns)
+        try:
+            out = coerce(out, fd.returns)
+        except SdbError as e:
+            raise SdbError(
+                f"Couldn't coerce return value from function `fn::{name}`: {e}"
+            )
     return out
 
 

@@ -89,22 +89,29 @@ def _thing(args, ctx):
 
 @register("type::record")
 def _record(args, ctx):
+    """type::record(value) parses; type::record(tb, key) builds
+    (reference fnc/type.rs:139)."""
     v = args[0]
+    if len(args) > 1:
+        tb = v.name if isinstance(v, Table) else v
+        if not isinstance(tb, str) or not tb:
+            raise SdbError("Incorrect arguments for function type::record()")
+        key = args[1]
+        if isinstance(key, RecordId):
+            key = key.id
+        elif isinstance(key, float):
+            key = str(key) if not key.is_integer() else int(key)
+        from surrealdb_tpu.exec.document import record_id_key
+
+        return RecordId(tb, record_id_key(key))
     if isinstance(v, RecordId):
-        rid = v
-    elif isinstance(v, str):
+        return v
+    if isinstance(v, str):
         from surrealdb_tpu.exec.static_eval import static_value
         from surrealdb_tpu.syn.parser import parse_record_literal
 
-        rid = static_value(parse_record_literal(v))
-    else:
-        raise SdbError("Incorrect arguments for function type::record()")
-    if len(args) > 1:
-        want = args[1]
-        tbname = want.name if isinstance(want, Table) else want
-        if rid.tb != tbname:
-            raise SdbError(f"Expected a record<{tbname}> but found {rid.render()}")
-    return rid
+        return static_value(parse_record_literal(v))
+    raise SdbError("Incorrect arguments for function type::record()")
 
 
 @register("type::range")

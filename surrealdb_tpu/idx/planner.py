@@ -28,10 +28,14 @@ from surrealdb_tpu.err import SdbError
 
 
 def _field_path(expr):
+    from surrealdb_tpu.expr.ast import PAll, PFlatten
+
     if isinstance(expr, Idiom) and expr.parts and all(
-        isinstance(p, PField) for p in expr.parts
-    ):
-        return ".".join(p.name for p in expr.parts)
+        isinstance(p, (PField, PAll, PFlatten)) for p in expr.parts
+    ) and isinstance(expr.parts[0], PField):
+        from surrealdb_tpu.exec.statements import expr_name
+
+        return expr_name(expr)
     return None
 
 
@@ -114,14 +118,23 @@ def plan_scan(tb: str, cond, ctx, stmt):
         if not isinstance(pred, Binary):
             continue
         path = op = valexpr = None
-        if pred.op in ("=", "==", "∈", "<", "<=", ">", ">="):
+        if pred.op in ("=", "==", "∈", "<", "<=", ">", ">=", "∋", "⊇",
+                       "containsany"):
             lp = _field_path(pred.lhs)
             rp = _field_path(pred.rhs)
             if lp is not None and rp is None:
-                path, op, valexpr = lp, pred.op, pred.rhs
+                # field CONTAINS v  -> per-element entries, equality lookup
+                op = {"∋": "="}.get(pred.op, pred.op)
+                if pred.op in ("⊇", "containsany"):
+                    op = "∈"  # lookup each element of the rhs array
+                path, valexpr = lp, pred.rhs
             elif rp is not None and lp is None:
-                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
-                path, op, valexpr = rp, flip.get(pred.op, pred.op), pred.lhs
+                if pred.op == "∈":
+                    # v INSIDE field -> same as field CONTAINS v
+                    path, op, valexpr = rp, "=", pred.lhs
+                else:
+                    flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+                    path, op, valexpr = rp, flip.get(pred.op, pred.op), pred.lhs
         if path is None or path == "id":
             continue
         for idef in indexes:
@@ -267,8 +280,13 @@ def _index_lookup(tb, idef, op, v, cond, ctx):
     from surrealdb_tpu.kvs.api import deserialize
 
     ns, db = ctx.need_ns_db()
+    seen = set()
 
     def _fetch(rid):
+        h = hashable(rid)
+        if h in seen:
+            return None
+        seen.add(h)
         doc = fetch_record(ctx, rid)
         if doc is NONE:
             return None
@@ -385,16 +403,33 @@ def explain_plan(tb, cond, ctx, stmt):
         preds = []
         _split_ands(cond, preds)
         for pred in preds:
-            if isinstance(pred, Binary) and pred.op in ("=", "==", "∈"):
-                path = _field_path(pred.lhs) or _field_path(pred.rhs)
+            if isinstance(pred, Binary) and pred.op in (
+                "=", "==", "∈", "∋", "<", "<=", ">", ">="
+            ):
+                lp = _field_path(pred.lhs)
+                rp = _field_path(pred.rhs)
+                path = lp or rp
+                valexpr = pred.rhs if lp else pred.lhs
+                op = pred.op
+                if op in ("∋",) or (op == "∈" and rp is not None):
+                    op = "="
+                elif op == "∈":
+                    op = "union"
                 for idef in indexes:
                     if idef.cols_str and idef.cols_str[0] == path and \
                             idef.hnsw is None and idef.fulltext is None:
+                        from surrealdb_tpu.exec.eval import evaluate
+
+                        try:
+                            val = evaluate(valexpr, ctx)
+                        except Exception:
+                            val = None
                         return {
                             "detail": {
                                 "plan": {
                                     "index": idef.name,
-                                    "operator": pred.op,
+                                    "operator": op,
+                                    "value": val,
                                 },
                                 "table": tb,
                             },

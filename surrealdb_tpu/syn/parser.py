@@ -10,7 +10,7 @@ from __future__ import annotations
 from surrealdb_tpu.err import ParseError
 from surrealdb_tpu.expr.ast import *  # noqa: F401,F403
 from surrealdb_tpu.syn import lexer as L
-from surrealdb_tpu.val import NONE, Datetime, Duration, File, Uuid
+from surrealdb_tpu.val import NONE, Datetime, Duration, File, Table, Uuid
 
 _STMT_KEYWORDS = {
     "select", "create", "update", "upsert", "delete", "insert", "relate",
@@ -324,7 +324,12 @@ class Parser:
             elif self.eat_kw("tempfiles"):
                 s.tempfiles = True
             elif self.eat_kw("explain"):
-                s.explain = "full" if self.eat_kw("full") else True
+                if self.eat_kw("full"):
+                    s.explain = "full"
+                elif self.eat_kw("analyze"):
+                    s.explain = "analyze"
+                else:
+                    s.explain = True
             else:
                 break
         return s
@@ -480,11 +485,22 @@ class Parser:
 
     def _stmt_insert(self):
         self.next()
-        ignore = self.eat_kw("ignore")
-        relation = self.eat_kw("relation")
+        ignore = relation = False
+        while True:
+            if not ignore and self.eat_kw("ignore"):
+                ignore = True
+            elif not relation and self.eat_kw("relation"):
+                relation = True
+            else:
+                break
         into = None
         if self.eat_kw("into"):
-            into = self.parse_expr()
+            t = self.peek()
+            if t.kind == L.IDENT:
+                self.next()
+                into = Literal(Table(t.value))
+            else:
+                into = self.parse_expr()
         if self.at_op("("):
             # INSERT INTO t (a, b) VALUES (1, 2), (3, 4)
             self.next()
@@ -740,7 +756,7 @@ class Parser:
         while True:
             if self.eat_kw("drop"):
                 d.drop = True
-            elif self.eat_kw("schemafull"):
+            elif self.eat_kw("schemafull", "schemaful"):
                 d.full = True
             elif self.eat_kw("schemaless"):
                 d.full = False
@@ -793,7 +809,10 @@ class Parser:
         tb = self.ident_or_str()
         d = DefineField(name, tb, ine, ow)
         while True:
-            if self.eat_kw("flexible", "flexi", "flex"):
+            if self.at_kw("flexible", "flexi", "flex"):
+                if d.kind is None:
+                    raise self.err("FLEXIBLE must be specified after TYPE")
+                self.next()
                 d.flex = True
             elif self.eat_kw("type"):
                 d.kind = self.parse_kind()
@@ -971,11 +990,13 @@ class Parser:
 
     def _define_function(self):
         ine, ow = self._def_flags()
-        # fn::name::sub(...)
+        # fn::name::sub(...) — catalog name excludes the fn:: prefix
         self.eat_op("::")
         parts = [self.ident()]
         while self.eat_op("::"):
             parts.append(self.ident())
+        if parts and parts[0] == "fn":
+            parts = parts[1:]
         name = "::".join(parts)
         self.expect_op("(")
         args = []
@@ -1218,6 +1239,8 @@ class Parser:
             parts = [self.ident()]
             while self.eat_op("::"):
                 parts.append(self.ident())
+            if parts and parts[0] == "fn":
+                parts = parts[1:]
             name = "::".join(parts)
         elif kind == "param":
             t = self.next()
@@ -1255,7 +1278,7 @@ class Parser:
         while True:
             if self.eat_kw("drop"):
                 d.drop = True
-            elif self.eat_kw("schemafull"):
+            elif self.eat_kw("schemafull", "schemaful"):
                 d.full = True
             elif self.eat_kw("schemaless"):
                 d.full = False
@@ -1329,6 +1352,12 @@ class Parser:
             k.inner = [self.ident().lower()]
             while self.eat_op("|"):
                 k.inner.append(self.ident().lower())
+            self._expect_gt()
+        elif name == "table" and self.at_op("<"):
+            self.next()
+            k.inner = [self.ident()]
+            while self.eat_op("|"):
+                k.inner.append(self.ident())
             self._expect_gt()
         elif name == "references" and self.eat_op("<"):
             k.inner = [self.ident()]
@@ -1890,6 +1919,12 @@ class Parser:
         if low == "if":
             self.i -= 1
             return self._parse_if()
+        # statements in expression position: RETURN CREATE ..., LET $x = SELECT ...
+        if low in ("select", "create", "update", "upsert", "delete", "insert",
+                   "relate", "define", "remove", "rebuild", "info", "live",
+                   "kill", "alter", "show") and self._stmt_follows(low):
+            self.i -= 1
+            return Subquery(self.parse_stmt())
         # function path  foo::bar(...)
         if self.at_op("::"):
             parts = [name]
@@ -1936,6 +1971,25 @@ class Parser:
                 return self._parse_record_id(name)
         return Idiom([PField(name)])
 
+    def _stmt_follows(self, kw: str) -> bool:
+        """Heuristic: after a statement keyword in expression position, does
+        statement-shaped content follow (vs. a field named 'create' etc.)?"""
+        t = self.peek()
+        if t.kind == L.EOF:
+            return False
+        if t.kind == L.OP:
+            # `select,` / `select)` / `select.` etc. are idiom usage
+            return t.text in ("*",) if kw == "select" else False
+        if t.kind == L.IDENT:
+            low = t.value.lower()
+            # clause keywords that would follow an idiom, not start a target
+            if low in ("from", "where", "group", "order", "limit", "start",
+                       "as", "and", "or", "is", "in", "contains", "then",
+                       "else", "end"):
+                return False
+            return True
+        return t.kind in (L.PARAM, L.RECORD_STR, L.INT, L.STRING)
+
     def _parse_record_id(self, tb: str):
         """Parse the key after `tb:`."""
         t = self.peek()
@@ -1944,10 +1998,18 @@ class Parser:
             self.next()
             neg = True
             t = self.peek()
-        if t.kind == L.INT:
-            self.next()
-            key = -t.value if neg else t.value
-            idexpr = Literal(key)
+        if t.kind in (L.INT, L.DURATION) or (
+            t.kind == L.IDENT and self._key_adjacent(t)
+        ):
+            merged = self._merge_key_tokens(neg)
+            if merged is not None:
+                idexpr = Literal(merged)
+            else:
+                self.next()
+                key = -t.value if neg else t.value
+                if not (-(1 << 63) <= key < (1 << 63)):
+                    key = str(key)  # beyond i64: string key
+                idexpr = Literal(key)
         elif t.kind == L.IDENT:
             low = t.value.lower()
             if low in ("rand", "ulid", "uuid") and \
@@ -1990,6 +2052,42 @@ class Parser:
             return RecordIdLit(tb, RangeExpr(idexpr, end, beg_incl, incl))
         return RecordIdLit(tb, idexpr)
 
+    def _key_adjacent(self, t) -> bool:
+        """Is the next token glued to this one (no whitespace)?"""
+        nxt = self.toks[self.i + 1] if self.i + 1 < len(self.toks) else None
+        return (
+            nxt is not None
+            and nxt.kind in (L.INT, L.IDENT, L.DURATION)
+            and nxt.pos == t.pos + len(t.text)
+        )
+
+    def _merge_key_tokens(self, neg=False):
+        """Merge glued INT/IDENT/DURATION tokens into one alnum record key
+        (ulids like 01JDSK…, keys like 54d6j987… that mis-lex as durations).
+        Returns the string key, or None when the key is a plain INT."""
+        t = self.peek()
+        parts = [t.text]
+        kinds = [t.kind]
+        j = self.i + 1
+        end = t.pos + len(t.text)
+        while j < len(self.toks):
+            nxt = self.toks[j]
+            if nxt.kind in (L.INT, L.IDENT, L.DURATION) and nxt.pos == end:
+                parts.append(nxt.text)
+                kinds.append(nxt.kind)
+                end = nxt.pos + len(nxt.text)
+                j += 1
+            else:
+                break
+        if len(parts) == 1 and t.kind == L.INT:
+            return None  # plain integer key
+        self.i = j
+        if len(parts) == 1 and t.kind == L.IDENT:
+            return t.value
+        if neg:
+            raise self.err("invalid record id key")
+        return "".join(parts)
+
     def _record_key_expr(self):
         t = self.peek()
         neg = False
@@ -1997,7 +2095,12 @@ class Parser:
             self.next()
             neg = True
             t = self.peek()
-        if t.kind == L.INT:
+        if t.kind in (L.INT, L.DURATION) or (
+            t.kind == L.IDENT and self._key_adjacent(t)
+        ):
+            merged = self._merge_key_tokens(neg)
+            if merged is not None:
+                return Literal(merged)
             self.next()
             return Literal(-t.value if neg else t.value)
         if t.kind == L.IDENT:

@@ -669,18 +669,20 @@ _DIGITS_RX = _re.compile(r"^[0-9]+$")
 def escape_ident(s: str) -> str:
     if _IDENT_RX.match(s):
         return s
-    return "⟨" + s.replace("⟩", "\\⟩") + "⟩"
+    return "`" + s.replace("\\", "\\\\").replace("`", "\\`") + "`"
 
 
 def render_record_id_key(id) -> str:
     if isinstance(id, bool):
-        return "⟨true⟩" if id else "⟨false⟩"
+        return "`true`" if id else "`false`"
     if isinstance(id, int):
         return str(id)
     if isinstance(id, str):
         if _IDENT_RX.match(id) and not _DIGITS_RX.match(id):
             return id
-        return "⟨" + id.replace("⟩", "\\⟩") + "⟩"
+        if _re.match(r"^[A-Za-z0-9_]+$", id) and not _DIGITS_RX.match(id):
+            return id  # alnum keys (ulids) render bare
+        return "`" + id.replace("\\", "\\\\").replace("`", "\\`") + "`"
     if isinstance(id, Uuid):
         return f"u'{id.u}'"
     if isinstance(id, (list, dict, Range)):

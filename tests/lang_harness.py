@@ -1,0 +1,177 @@
+"""Golden-file conformance harness for the reference's language tests
+(ports /root/reference/language-tests/src — SURVEY.md §4 calls this "the
+correctness oracle to port first").
+
+Each .surql file embeds TOML in `/** */` / `//!` comments: [env] (ns/db,
+imports, planner strategy), [test] (run flag, expected [[test.results]] as
+SurrealQL value strings or error flags/messages)."""
+
+from __future__ import annotations
+
+import os
+import re
+import tomllib
+from dataclasses import dataclass, field
+
+TESTS_ROOT = "/root/reference/language-tests/tests"
+
+_BLOCK_RX = re.compile(r"/\*\*(.*?)\*/", re.S)
+_LINE_RX = re.compile(r"^//!(.*)$", re.M)
+
+
+@dataclass
+class LangTest:
+    path: str
+    sql: str
+    config: dict
+    results: list = field(default_factory=list)
+    run: bool = True
+    ns: str | None = "test"
+    db: str | None = "test"
+    imports: list = field(default_factory=list)
+    wip: bool = False
+
+
+def parse_test_file(path: str) -> LangTest:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    toml_src = ""
+    m = _BLOCK_RX.search(text)
+    if m:
+        toml_src += m.group(1)
+    for lm in _LINE_RX.finditer(text):
+        toml_src += lm.group(1) + "\n"
+    config = tomllib.loads(toml_src) if toml_src.strip() else {}
+    test = config.get("test", {})
+    env = config.get("env", {})
+    t = LangTest(path=path, sql=text, config=config)
+    t.run = test.get("run", True)
+    t.wip = test.get("wip", False)
+    results = test.get("results", [])
+    if isinstance(results, dict):
+        results = [results]
+    t.results = results
+    ns = env.get("namespace", "test")
+    db = env.get("database", "test")
+    t.ns = None if ns is False else (ns if isinstance(ns, str) else "test")
+    t.db = None if db is False else (db if isinstance(db, str) else "test")
+    t.imports = env.get("imports", [])
+    return t
+
+
+def _exact_eq(a, b, skip_rid_keys=False) -> bool:
+    """Type-exact value equality (1 != 1f, unlike value_eq)."""
+    from decimal import Decimal
+
+    from surrealdb_tpu.val import RecordId, type_rank, value_eq
+
+    if type_rank(a) != type_rank(b):
+        return False
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    num = (int, float, Decimal)
+    if isinstance(a, num) and isinstance(b, num):
+        if type(a) is not type(b):
+            return False
+        if isinstance(a, float):
+            import math
+
+            if math.isnan(a) and math.isnan(b):
+                return True
+            return abs(a - b) < 1e-9 or a == b
+        return a == b
+    if isinstance(a, RecordId) and skip_rid_keys:
+        return a.tb == b.tb
+    if isinstance(a, list):
+        return len(a) == len(b) and all(
+            _exact_eq(x, y, skip_rid_keys) for x, y in zip(a, b)
+        )
+    if isinstance(a, dict):
+        return set(a) == set(b) and all(
+            _exact_eq(a[k], b[k], skip_rid_keys) for k in a
+        )
+    return value_eq(a, b)
+
+
+def run_lang_test(t: LangTest, ds=None):
+    """Execute a test file; returns (ok: bool, detail: str)."""
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu.syn import parse_value
+
+    if ds is None:
+        ds = Datastore("memory")
+    for imp in t.imports:
+        ipath = os.path.join(os.path.dirname(t.path), imp)
+        if not os.path.exists(ipath):
+            ipath = os.path.join(TESTS_ROOT, imp)
+        it = parse_test_file(ipath)
+        ds.execute(it.sql, ns=t.ns, db=t.db)
+    res = ds.execute(t.sql, ns=t.ns, db=t.db)
+    if not t.results:
+        return True, "no expectations"
+    if len(res) != len(t.results):
+        return False, (
+            f"statement count mismatch: got {len(res)} results, "
+            f"expected {len(t.results)}"
+        )
+    for i, (got, want) in enumerate(zip(res, t.results)):
+        if isinstance(want, str):
+            want = {"value": want}
+        if "error" in want:
+            err = want["error"]
+            if got.error is None:
+                return False, f"stmt {i}: expected error, got {got.result!r}"
+            if isinstance(err, str) and err.strip() != str(got.error).strip():
+                return False, (
+                    f"stmt {i}: error mismatch:\n  want: {err}\n  got:  {got.error}"
+                )
+            continue
+        if "parsing-error" in want:
+            if got.error is None or "Parse error" not in str(got.error):
+                return False, f"stmt {i}: expected parsing error, got {got!r}"
+            continue
+        if "match" in want:
+            # regex match against the rendered result
+            from surrealdb_tpu.val import render
+
+            if got.error is not None:
+                return False, f"stmt {i}: error: {got.error}"
+            rendered = render(got.result)
+            if not re.search(want["match"], rendered):
+                return False, (
+                    f"stmt {i}: match failed:\n  pattern: {want['match']}\n"
+                    f"  got: {rendered}"
+                )
+            continue
+        if "skip" in want and want["skip"]:
+            continue
+        if "value" in want:
+            if got.error is not None:
+                return False, f"stmt {i}: unexpected error: {got.error}"
+            try:
+                expected = parse_value(want["value"])
+            except Exception as e:
+                return False, f"stmt {i}: cannot parse expectation: {e}"
+            skip_rid = bool(want.get("skip-record-id-key"))
+            if not _exact_eq(got.result, expected, skip_rid):
+                from surrealdb_tpu.val import render
+
+                return False, (
+                    f"stmt {i}: value mismatch:\n  want: {want['value']}\n"
+                    f"  got:  {render(got.result)}"
+                )
+            continue
+    return True, "ok"
+
+
+def discover(subdir="language", filt=None):
+    root = os.path.join(TESTS_ROOT, subdir)
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in sorted(files):
+            if fn.endswith(".surql"):
+                p = os.path.join(dirpath, fn)
+                if filt and filt not in p:
+                    continue
+                out.append(p)
+    return out

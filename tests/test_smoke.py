@@ -89,9 +89,13 @@ def test_transactions(ds):
 def test_define_field_schema(q):
     q("DEFINE TABLE u SCHEMAFULL; DEFINE FIELD name ON u TYPE string;"
       "DEFINE FIELD age ON u TYPE option<int>")
-    out = q("CREATE u:1 SET name = 'x', junk = true")[0]
+    out = q("CREATE u:1 SET name = 'x'")[0]
     assert out[0]["name"] == "x"
-    assert "junk" not in out[0]
+    try:
+        q("CREATE u:3 SET name = 'y', junk = true")
+        assert False, "expected unknown-field error"
+    except Exception as e:
+        assert "no such field" in str(e)
     try:
         q("CREATE u:2 SET name = 42")
         assert False, "expected type error"

@@ -1,0 +1,82 @@
+"""Run the reference's language-test suite against surrealdb_tpu and report
+conformance stats. Usage:
+
+    python tools/lang_conformance.py [filter] [--subdir language] [-v]
+    python tools/lang_conformance.py --failures 20   # show first N failures
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("filter", nargs="?", default=None)
+    ap.add_argument("--subdir", default="language")
+    ap.add_argument("--failures", type=int, default=0)
+    ap.add_argument("-v", action="store_true")
+    args = ap.parse_args()
+
+    from lang_harness import discover, parse_test_file, run_lang_test
+
+    files = discover(args.subdir, args.filter)
+    passed = failed = errored = skipped = 0
+    fail_list = []
+    by_dir: dict = {}
+    for path in files:
+        rel = os.path.relpath(
+            path, "/root/reference/language-tests/tests"
+        )
+        d = os.path.dirname(rel).split(os.sep)
+        dkey = "/".join(d[:3])
+        st = by_dir.setdefault(dkey, [0, 0])
+        try:
+            t = parse_test_file(path)
+        except Exception as e:
+            skipped += 1
+            continue
+        if not t.run or t.wip:
+            skipped += 1
+            continue
+        try:
+            ok, detail = run_lang_test(t)
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:
+            ok, detail = False, f"harness exception: {e.__class__.__name__}: {e}"
+            errored += 1
+        if ok:
+            passed += 1
+            st[0] += 1
+        else:
+            failed += 1
+            st[1] += 1
+            fail_list.append((rel, detail))
+            if args.v:
+                print(f"FAIL {rel}\n  {detail}")
+    total = passed + failed
+    print(f"\n== conformance: {passed}/{total} "
+          f"({100.0 * passed / max(total, 1):.1f}%) "
+          f"[skipped {skipped}, harness errors {errored}]")
+    worst = sorted(by_dir.items(), key=lambda kv: -kv[1][1])[:15]
+    for d, (p, f) in worst:
+        if f:
+            print(f"  {d}: {p} pass / {f} fail")
+    if args.failures:
+        print("\n== first failures ==")
+        for rel, detail in fail_list[: args.failures]:
+            print(f"-- {rel}\n   {detail.splitlines()[0][:200]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
