@@ -215,14 +215,17 @@ def coerce(v, kind: Kind):
             raise coerce_err(v, kind)
         return v
     if n == "set":
-        if not isinstance(v, list):
+        from surrealdb_tpu.val import SSet
+
+        if isinstance(v, SSet):
+            items = v.items
+        elif isinstance(v, list):
+            items = v
+        else:
             raise coerce_err(v, kind)
-        out = []
-        for x in v:
-            if kind.inner:
-                x = coerce(x, kind.inner[0])
-            if not any(value_eq(x, y) for y in out):
-                out.append(x)
+        if kind.inner:
+            items = [coerce(x, kind.inner[0]) for x in items]
+        out = SSet(items)
         if kind.size is not None and len(out) > kind.size:
             raise coerce_err(v, kind)
         return out
@@ -416,14 +419,22 @@ def cast(v, kind: Kind):
                 pass
         return [v]
     elif n == "set":
-        base = v if isinstance(v, list) else [v]
-        out = []
-        for x in base:
-            if kind.inner:
-                x = cast(x, kind.inner[0])
-            if not any(value_eq(x, y) for y in out):
-                out.append(x)
-        return out
+        from surrealdb_tpu.val import SSet
+
+        if isinstance(v, SSet):
+            base = v.items
+        elif isinstance(v, list):
+            base = v
+        elif isinstance(v, Range):
+            try:
+                base = list(v.iter_ints())
+            except TypeError:
+                base = [v]
+        else:
+            base = [v]
+        if kind.inner:
+            base = [cast(x, kind.inner[0]) for x in base]
+        return SSet(base)
     elif n == "bytes":
         if isinstance(v, str):
             return v.encode("utf-8")

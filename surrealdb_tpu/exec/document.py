@@ -477,6 +477,10 @@ def _index_rows(vals, idef=None):
                 col.parts[-1], PFlatten
             ):
                 flat = True
+        from surrealdb_tpu.val import SSet
+
+        if isinstance(v, SSet):
+            v = list(v)
         if not flat and isinstance(v, list):
             cols.append(v if v else [NONE])
         else:

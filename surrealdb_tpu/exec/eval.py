@@ -179,6 +179,12 @@ def _e_object(n, ctx):
     return {k: evaluate(v, ctx) for k, v in n.items}
 
 
+def _e_set(n, ctx):
+    from surrealdb_tpu.val import SSet
+
+    return SSet([evaluate(x, ctx) for x in n.items])
+
+
 def _e_recordid(n, ctx):
     idexpr = n.id
     if isinstance(idexpr, RangeExpr):
@@ -722,6 +728,7 @@ _DISPATCH = {
     Param: _e_param,
     ArrayExpr: _e_array,
     ObjectExpr: _e_object,
+    SetExpr: _e_set,
     RecordIdLit: _e_recordid,
     RangeExpr: _e_range,
     Binary: _e_binary,

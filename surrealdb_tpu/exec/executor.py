@@ -152,6 +152,17 @@ class Executor:
                 if own_txn:
                     cur.cancel()
                 results.append(QueryResult(error="Max computation depth exceeded"))
+            except Exception as e:  # internal error — surface, don't crash
+                if own_txn:
+                    cur.cancel()
+                else:
+                    cur.rollback_to_save_point()
+                    failed = True
+                results.append(
+                    QueryResult(error=f"Internal error: {e.__class__.__name__}: {e}")
+                )
+                if not own_txn:
+                    buffered.append(len(results) - 1)
         if txn is not None:
             # unterminated explicit transaction: cancel
             txn.cancel()

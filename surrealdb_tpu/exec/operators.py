@@ -73,6 +73,13 @@ def _num2(a, b):
 
 
 def add(a, b):
+    from surrealdb_tpu.val import SSet
+
+    if isinstance(a, SSet):
+        extra = list(b) if isinstance(b, (SSet, list)) else [b]
+        return SSet(a.items + extra)
+    if isinstance(b, SSet) and isinstance(a, list):
+        return a + b.items
     if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
         a, b = _num2(a, b)
         return a + b
@@ -119,6 +126,13 @@ def sub(a, b):
         return [x for x in a if not any(value_eq(x, y) for y in b)]
     if isinstance(a, list):
         return [x for x in a if not value_eq(x, b)]
+    from surrealdb_tpu.val import SSet
+
+    if isinstance(a, SSet):
+        rem = list(b) if isinstance(b, (SSet, list)) else [b]
+        return SSet(
+            [x for x in a.items if not any(value_eq(x, y) for y in rem)]
+        )
     raise SdbError(f"Cannot subtract {render(b)} from {render(a)}")
 
 
@@ -233,6 +247,10 @@ def any_equal(a, b) -> bool:  # ?=
 
 
 def contains(a, b) -> bool:
+    from surrealdb_tpu.val import SSet
+
+    if isinstance(a, SSet):
+        a = a.items
     if isinstance(a, list):
         return any(value_eq(x, b) for x in a)
     if isinstance(a, str):

@@ -28,6 +28,7 @@ def _expr_sql(node) -> str:
         Param,
         PField,
         Prefix,
+        SetExpr,
         RangeExpr,
         RecordIdLit,
         RegexLit,
@@ -73,6 +74,10 @@ def _expr_sql(node) -> str:
             return "{  }"
         inner = ", ".join(f"{escape_ident(k)}: {_expr_sql(v)}" for k, v in node.items)
         return "{ " + inner + " }"
+    if isinstance(node, SetExpr):
+        if not node.items:
+            return "{,}"
+        return "{" + ", ".join(_expr_sql(x) for x in node.items) + "}"
     if isinstance(node, RecordIdLit):
         from surrealdb_tpu.val import render_record_id_key
 

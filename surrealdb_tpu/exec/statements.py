@@ -207,12 +207,9 @@ def _iterate_value(v, ctx, cond=None, stmt=None):
         for x in v:
             yield from _iterate_value(x, ctx, cond, stmt)
     elif isinstance(v, dict):
-        rid = v.get("id")
-        if isinstance(rid, RecordId):
-            doc = fetch_record(ctx, rid)
-            yield Source(rid=rid, doc=doc)
-        else:
-            yield Source(value=v)
+        # objects are used as-is in SELECT; write statements resolve the id
+        # themselves (reference prepare_computed: SELECT check happens first)
+        yield Source(value=v)
     elif v is NONE or v is None:
         return
     else:
@@ -381,12 +378,9 @@ def _s_select(n: SelectStmt, ctx: Ctx):
         c.version = evaluate(n.version, ctx)
     rows = []
     perms = not c.session.is_owner
-    knn_ctx_holder = {}
     for src in iterate_targets(n.what, c, n.cond, n):
         c.check_deadline()
-        if src.rid is not None and src.doc is NONE and not isinstance(
-            _target_of(n, c), list
-        ):
+        if src.rid is not None and src.doc is NONE:
             # direct record fetch that doesn't exist -> no row
             continue
         if perms and src.rid is not None:
@@ -406,34 +400,61 @@ def _s_select(n: SelectStmt, ctx: Ctx):
     # SPLIT
     for sp in n.split:
         rows = _apply_split(rows, sp, c)
+    # OMIT applies to the records before grouping/projection
+    if n.omit:
+        for src in rows:
+            doc = src.doc if src.rid is not None else src.value
+            if isinstance(doc, dict):
+                doc = copy_value(doc)
+                for om in n.omit:
+                    _omit_path(doc, om, c)
+                if src.rid is not None:
+                    src.doc = doc
+                else:
+                    src.value = doc
+    # alias map: ORDER BY / GROUP BY may reference projection aliases
+    aliases = {}
+    for expr, alias in n.exprs:
+        if expr == "*":
+            continue
+        aliases[alias or expr_name(expr)] = expr
     # GROUP BY
     if n.group is not None:
-        out_rows = _apply_group(rows, n, c)
-    else:
-        out_rows = [_project(src, n, c) for src in rows]
-    # ORDER BY
-    if n.order:
-        if n.order == "rand":
-            _random.shuffle(out_rows)
-        else:
+        out_rows = _apply_group(rows, n, c, aliases)
+        if n.order and n.order != "rand":
             out_rows = _apply_order(out_rows, n.order, c)
-    # START / LIMIT
-    if n.start is not None:
-        s = int(evaluate(n.start, c))
-        out_rows = out_rows[s:]
-    if n.limit is not None:
-        l = int(evaluate(n.limit, c))
-        out_rows = out_rows[:l]
+        elif n.order == "rand":
+            _random.shuffle(out_rows)
+        if n.start is not None:
+            out_rows = out_rows[int(evaluate(n.start, c)) :]
+        if n.limit is not None:
+            out_rows = out_rows[: int(evaluate(n.limit, c))]
+    else:
+        # ORDER BY on the underlying rows (aliases resolve to their exprs)
+        if n.order == "rand":
+            _random.shuffle(rows)
+        elif n.order:
+            rows = _apply_order_sources(rows, n.order, c, aliases)
+        if n.start is not None:
+            rows = rows[int(evaluate(n.start, c)) :]
+        if n.limit is not None:
+            rows = rows[: int(evaluate(n.limit, c))]
+        out_rows = [_project(src, n, c) for src in rows]
     # FETCH
     if n.fetch:
         out_rows = [apply_fetch(r, n.fetch, c) for r in out_rows]
-    # OMIT
-    if n.omit:
-        for r in out_rows:
-            if isinstance(r, dict):
-                for om in n.omit:
-                    _omit_path(r, om)
     if n.only:
+        # target-level check: FROM ONLY NONE / [] / [a, b] error outright;
+        # zero ROWS from a valid single target return NONE (reference
+        # select.rs empty-array case)
+        if len(n.what) == 1:
+            tv = _target_value(n.what[0], c)
+            if tv is NONE or tv is None or (
+                isinstance(tv, list) and len(tv) != 1
+            ):
+                raise SdbError(
+                    "Expected a single result output when using the ONLY keyword"
+                )
         if len(out_rows) == 1:
             return out_rows[0]
         if len(out_rows) == 0:
@@ -448,16 +469,45 @@ def _target_of(n, ctx):
     return None
 
 
-def _omit_path(doc, om):
-    if isinstance(om, Idiom):
-        names = [p.name for p in om.parts if isinstance(p, PField)]
-        cur = doc
-        for nm in names[:-1]:
-            cur = cur.get(nm) if isinstance(cur, dict) else None
-            if not isinstance(cur, dict):
-                return
-        if isinstance(cur, dict) and names:
-            cur.pop(names[-1], None)
+def _omit_path(doc, om, ctx=None):
+    """Remove an OMIT path; `.{a, b}` destructure suffixes expand to the
+    listed subpaths (reference idiom omit semantics)."""
+    if not isinstance(om, Idiom):
+        return
+    _omit_parts(doc, om.parts)
+
+
+def _omit_parts(doc, parts):
+    if not parts:
+        return
+    part = parts[0]
+    if isinstance(part, PField):
+        if isinstance(doc, list):
+            for item in doc:
+                _omit_parts(item, parts)
+            return
+        if not isinstance(doc, dict):
+            return
+        if len(parts) == 1:
+            doc.pop(part.name, None)
+        else:
+            _omit_parts(doc.get(part.name), parts[1:])
+    elif isinstance(part, PDestructure):
+        for name, sub in part.fields:
+            if sub is None:
+                _omit_parts(doc, [PField(name)])
+            elif isinstance(sub, Idiom):
+                subparts = [
+                    p for p in sub.parts if not isinstance(p, tuple)
+                ]
+                _omit_parts(doc, [PField(name)] + subparts)
+    elif isinstance(part, PAll):
+        if isinstance(doc, dict):
+            for v in doc.values():
+                _omit_parts(v, parts[1:])
+        elif isinstance(doc, list):
+            for item in doc:
+                _omit_parts(item, parts[1:])
 
 
 def _project(src: Source, n: SelectStmt, ctx: Ctx):
@@ -532,20 +582,25 @@ def _set_path(doc, segs, v):
     cur[segs[-1]] = v
 
 
-def _apply_group(rows, n: SelectStmt, ctx):
+def _apply_group(rows, n: SelectStmt, ctx, aliases=None):
     from surrealdb_tpu.val import hashable
 
     groups: dict = {}
     order = []
-    gb = n.group
+    gb = [_resolve_alias(g, aliases) for g in (n.group or [])]
+    keyvals: dict = {}
     for src in rows:
         doc = src.doc if src.rid is not None else src.value
         c = ctx.with_doc(doc, src.rid)
-        key = tuple(hashable(evaluate(g, c)) for g in gb) if gb else ()
+        vals = [evaluate(g, c) for g in gb] if gb else []
+        key = tuple(hashable(v) for v in vals)
         if key not in groups:
             groups[key] = []
+            keyvals[key] = vals
             order.append(key)
         groups[key].append(src)
+    # groups emit in key order (the reference collects into an ordered map)
+    order.sort(key=lambda k: tuple(sort_key(v) for v in keyvals[k]))
     out = []
     for key in order:
         members = groups[key]
@@ -647,43 +702,81 @@ def _binary_aggregate(expr, members, ctx):
     return binary_op(expr.op, lhs, rhs)
 
 
+def _resolve_alias(expr, aliases):
+    """A bare-field ORDER/GROUP item naming a projection alias resolves to
+    the aliased expression."""
+    if not aliases:
+        return expr
+    if isinstance(expr, Idiom) and len(expr.parts) == 1 and isinstance(
+        expr.parts[0], PField
+    ):
+        name = expr.parts[0].name
+        if name in aliases and aliases[name] is not expr:
+            return aliases[name]
+    return expr
+
+
+def _apply_order_sources(rows, order, ctx, aliases=None):
+    """ORDER BY over source rows (pre-projection): aliases resolve to their
+    expressions, everything else evaluates against the source doc."""
+    items = [
+        (_resolve_alias(expr, aliases), d, collate, numeric)
+        for expr, d, collate, numeric in order
+    ]
+    keyed = []
+    for src in rows:
+        doc = src.doc if src.rid is not None else src.value
+        cc = ctx.with_doc(doc, src.rid)
+        cc.knn = ctx.knn
+        keys = []
+        for expr, d, collate, numeric in items:
+            keys.append((evaluate(expr, cc), d, collate, numeric))
+        keyed.append((_OrderKey(keys), src))
+    keyed.sort(key=lambda kr: kr[0])
+    return [r for _k, r in keyed]
+
+
+def _order_cmp(v, w, collate, numeric):
+    if numeric and isinstance(v, str) and isinstance(w, str):
+        import re
+
+        def splitnum(s):
+            return [
+                int(p) if p.isdigit() else p
+                for p in re.split(r"(\d+)", s)
+                if p
+            ]
+
+        a, b = splitnum(v), splitnum(w)
+        for x, y in zip(a, b):
+            if type(x) is not type(y):
+                x, y = str(x), str(y)
+            if x != y:
+                return -1 if x < y else 1
+        return (len(a) > len(b)) - (len(a) < len(b))
+    if collate and isinstance(v, str) and isinstance(w, str):
+        a, b = v.casefold(), w.casefold()
+        return (a > b) - (a < b)
+    return value_cmp(v, w)
+
+
+class _OrderKey:
+    __slots__ = ("keys",)
+
+    def __init__(self, keys):
+        self.keys = keys
+
+    def __lt__(self, other):
+        for (v, d, collate, numeric), (w, _, _, _) in zip(
+            self.keys, other.keys
+        ):
+            c = _order_cmp(v, w, collate, numeric)
+            if c:
+                return (c < 0) if d == "asc" else (c > 0)
+        return False
+
+
 def _apply_order(rows, order, ctx):
-    class OK:
-        __slots__ = ("keys",)
-
-        def __init__(self, keys):
-            self.keys = keys
-
-        def __lt__(self, other):
-            for (v, d, collate, numeric), (w, _, _, _) in zip(self.keys, other.keys):
-                c = _order_cmp(v, w, collate, numeric)
-                if c:
-                    return (c < 0) if d == "asc" else (c > 0)
-            return False
-
-    def _order_cmp(v, w, collate, numeric):
-        if numeric and isinstance(v, str) and isinstance(w, str):
-            import re
-
-            def splitnum(s):
-                return [
-                    int(p) if p.isdigit() else p
-                    for p in re.split(r"(\d+)", s)
-                    if p
-                ]
-
-            a, b = splitnum(v), splitnum(w)
-            for x, y in zip(a, b):
-                if type(x) is not type(y):
-                    x, y = str(x), str(y)
-                if x != y:
-                    return -1 if x < y else 1
-            return (len(a) > len(b)) - (len(a) < len(b))
-        if collate and isinstance(v, str) and isinstance(w, str):
-            a, b = v.casefold(), w.casefold()
-            return (a > b) - (a < b)
-        return value_cmp(v, w)
-
     keyed = []
     for r in rows:
         c = ctx.with_doc(r, None)
@@ -691,7 +784,7 @@ def _apply_order(rows, order, ctx):
         for item in order:
             expr, d, collate, numeric = item
             keys.append((evaluate(expr, c), d, collate, numeric))
-        keyed.append((OK(keys), r))
+        keyed.append((_OrderKey(keys), r))
     keyed.sort(key=lambda kr: kr[0])
     return [r for _k, r in keyed]
 
@@ -742,7 +835,65 @@ def _fetch_value(v, ctx):
 
 
 def _explain_select(n: SelectStmt, ctx):
-    """EXPLAIN — report the plan the iterator would use (dbs/plan.rs)."""
+    """EXPLAIN — report the plan the iterator would use (dbs/plan.rs).
+    EXPLAIN FULL also executes and reports fetch counts."""
+    from surrealdb_tpu.idx.planner import explain_plan
+
+    out = []
+    for expr in n.what:
+        v = _target_value(expr, ctx)
+        if isinstance(v, Table):
+            out.append(explain_plan(v.name, n.cond, ctx, n))
+            if n.with_index == []:
+                out.append(
+                    {
+                        "detail": {"reason": "WITH NOINDEX"},
+                        "operation": "Fallback",
+                    }
+                )
+        else:
+            out.append(
+                {
+                    "detail": {"type": "Value"},
+                    "operation": "Iterate Value",
+                }
+            )
+    out.append(_collector_detail(n))
+    if n.explain == "full":
+        out.append(
+            {
+                "detail": {"type": "KeysAndValues"},
+                "operation": "RecordStrategy",
+            }
+        )
+        if n.start is not None or n.limit is not None:
+            detail = {}
+            if n.limit is not None:
+                detail["CancelOnLimit"] = int(evaluate(n.limit, ctx))
+            if n.start is not None:
+                detail["SkipStart"] = int(evaluate(n.start, ctx))
+            out.append(
+                {"detail": detail, "operation": "StartLimitStrategy"}
+            )
+        count = 0
+        for expr in n.what:
+            v = _target_value(expr, ctx)
+            for _src in _iterate_value(v, ctx, n.cond, n):
+                count += 1
+        if n.start is not None:
+            count = max(count - int(evaluate(n.start, ctx)), 0)
+        if n.limit is not None:
+            count = min(count, int(evaluate(n.limit, ctx)))
+        out.append({"detail": {"count": count}, "operation": "Fetch"})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# write statements -> document pipeline
+# ---------------------------------------------------------------------------
+
+
+def _explain_write(n, ctx):
     from surrealdb_tpu.idx.planner import explain_plan
 
     out = []
@@ -751,19 +902,50 @@ def _explain_select(n: SelectStmt, ctx):
         if isinstance(v, Table):
             out.append(explain_plan(v.name, n.cond, ctx, n))
         else:
-            out.append(
-                {
-                    "detail": {"type": "Value"},
-                    "operation": "Iterate Value",
-                }
-            )
+            out.append({"detail": {"type": "Value"}, "operation": "Iterate Value"})
     out.append({"detail": {"type": "Memory"}, "operation": "Collector"})
     return out
 
 
-# ---------------------------------------------------------------------------
-# write statements -> document pipeline
-# ---------------------------------------------------------------------------
+def _collector_detail(n: SelectStmt):
+    """Collector explain entry; GROUP queries report their aggregations."""
+    if n.group is None:
+        return {"detail": {"type": "Memory"}, "operation": "Collector"}
+    aggs = {}
+    sel = {}
+    group_exprs = {}
+    agg_exprs = {}
+    i = 0
+    _AGG_NAMES = {
+        "count": "Count", "math::sum": "Sum", "math::mean": "Mean",
+        "math::min": "Min", "math::max": "Max", "time::min": "Min",
+        "time::max": "Max", "math::stddev": "StdDev",
+        "math::variance": "Variance",
+    }
+    for expr, alias in n.exprs:
+        if expr == "*":
+            continue
+        name = alias or expr_name(expr)
+        if isinstance(expr, FunctionCall) and expr.name.lower() in _AGG_NAMES:
+            key = f"_a{i}"
+            i += 1
+            aggs[key] = _AGG_NAMES[expr.name.lower()]
+            if expr.args:
+                agg_exprs[key] = expr_name(expr.args[0])
+            sel[name] = key
+        else:
+            group_exprs[name] = expr_name(expr)
+            sel[name] = name
+    return {
+        "detail": {
+            "Aggregate expressions": agg_exprs,
+            "Aggregations": aggs,
+            "Group expressions": group_exprs,
+            "Select expression": sel,
+            "type": "Group",
+        },
+        "operation": "Collector",
+    }
 
 
 def _only_wrap(results, only):
@@ -835,11 +1017,23 @@ def _s_insert(n: InsertStmt, ctx: Ctx):
     return results
 
 
+def _resolve_write_source(src, ctx):
+    """Writes resolve object values carrying a record id to that record."""
+    if src.rid is None and isinstance(src.value, dict):
+        rid = src.value.get("id")
+        if isinstance(rid, RecordId):
+            return Source(rid=rid, doc=fetch_record(ctx, rid))
+    return src
+
+
 def _s_update(n: UpdateStmt, ctx: Ctx):
     from surrealdb_tpu.exec.document import update_one
 
+    if n.explain:
+        return _explain_write(n, ctx)
     results = []
     for src in iterate_targets(n.what, ctx, None, None):
+        src = _resolve_write_source(src, ctx)
         if src.rid is None:
             raise SdbError(f"Cannot UPDATE {render(src.value)}")
         if src.doc is NONE:
@@ -858,6 +1052,8 @@ def _s_update(n: UpdateStmt, ctx: Ctx):
 def _s_upsert(n: UpsertStmt, ctx: Ctx):
     from surrealdb_tpu.exec.document import create_one, update_one
 
+    if n.explain:
+        return _explain_write(n, ctx)
     results = []
     for expr in n.what:
         v = _target_value(expr, ctx)
@@ -896,6 +1092,7 @@ def _s_upsert(n: UpsertStmt, ctx: Ctx):
             else:
                 yield_src = list(_iterate_value(t, ctx))
                 for src in yield_src:
+                    src = _resolve_write_source(src, ctx)
                     if src.rid is None:
                         raise SdbError(f"Cannot UPSERT {render(src.value)}")
                     if src.doc is NONE:
@@ -915,8 +1112,11 @@ def _s_upsert(n: UpsertStmt, ctx: Ctx):
 def _s_delete(n: DeleteStmt, ctx: Ctx):
     from surrealdb_tpu.exec.document import delete_one
 
+    if n.explain:
+        return _explain_write(n, ctx)
     results = []
     for src in iterate_targets(n.what, ctx, None, None):
+        src = _resolve_write_source(src, ctx)
         if src.rid is None:
             raise SdbError(f"Cannot DELETE {render(src.value)}")
         if src.doc is NONE:

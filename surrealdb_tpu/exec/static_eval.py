@@ -8,6 +8,7 @@ from __future__ import annotations
 from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.expr.ast import (
     ArrayExpr,
+    SetExpr,
     Binary,
     Idiom,
     Literal,
@@ -28,6 +29,10 @@ def static_value(node):
         return [static_value(x) for x in node.items]
     if isinstance(node, ObjectExpr):
         return {k: static_value(v) for k, v in node.items}
+    if isinstance(node, SetExpr):
+        from surrealdb_tpu.val import SSet
+
+        return SSet([static_value(x) for x in node.items])
     if isinstance(node, RecordIdLit):
         idv = node.id
         if isinstance(idv, RangeExpr):

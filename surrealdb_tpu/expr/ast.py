@@ -41,6 +41,11 @@ class ObjectExpr(Node):
 
 
 @dataclass
+class SetExpr(Node):
+    items: list
+
+
+@dataclass
 class RecordIdLit(Node):
     tb: str
     id: Any  # expr | "id-gen:rand"/"id-gen:ulid"/"id-gen:uuid" marker
@@ -361,6 +366,7 @@ class UpdateStmt(Node):
     only: bool = False
     timeout: Optional[Node] = None
     parallel: bool = False
+    explain: Any = None
 
 
 @dataclass
@@ -372,6 +378,7 @@ class UpsertStmt(Node):
     only: bool = False
     timeout: Optional[Node] = None
     parallel: bool = False
+    explain: Any = None
 
 
 @dataclass
@@ -382,6 +389,7 @@ class DeleteStmt(Node):
     only: bool = False
     timeout: Optional[Node] = None
     parallel: bool = False
+    explain: Any = None
 
 
 @dataclass

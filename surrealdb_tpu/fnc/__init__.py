@@ -33,32 +33,78 @@ from surrealdb_tpu.val import (
 )
 
 FUNCS: dict = {}
+ARITY: dict = {}  # name -> (lo, hi|None) or (lo1, lo2) exact alternatives
 _NUM = (int, float, Decimal)
 
 
-def register(name):
+class ArgError(Exception):
+    """Wrong-typed argument; formatted with the function name by the
+    dispatcher (reference fnc/args.rs: 'Argument {idx} was the wrong
+    type. Expected `{kind}` but found `{value}`')."""
+
+    def __init__(self, idx, kind, value):
+        self.idx = idx
+        self.kind = kind
+        self.value = value
+
+
+def register(name, arity=None):
     def deco(fn):
         FUNCS[name] = fn
+        if arity is not None:
+            ARITY[name] = arity
         return fn
 
     return deco
 
 
-def _num(v, fname):
+def _arity_msg(spec) -> str:
+    lo, hi = spec
+    if hi is None:
+        return f"Expected {lo} or more arguments"
+    if lo == hi:
+        if lo == 0:
+            return "Expected no arguments"
+        if lo == 1:
+            return "Expected 1 argument"
+        return f"Expected {lo} arguments"
+    return f"Expected {lo} to {hi} arguments"
+
+
+def check_args(name: str, args: list):
+    spec = ARITY.get(name)
+    if spec is None:
+        return
+    lo, hi = spec
+    if len(args) < lo or (hi is not None and len(args) > hi):
+        raise SdbError(
+            f"Incorrect arguments for function {name}(). {_arity_msg(spec)}"
+        )
+
+
+def _num(v, fname=None, idx=1):
     if isinstance(v, bool) or not isinstance(v, _NUM):
-        raise SdbError(f"Incorrect arguments for function {fname}(). Expected a number, got {render(v)}")
+        raise ArgError(idx, "number", v)
     return v
 
 
-def _arr(v, fname):
+def _int(v, fname=None, idx=1):
+    if isinstance(v, bool) or not isinstance(v, int):
+        if isinstance(v, float) and v.is_integer():
+            return int(v)
+        raise ArgError(idx, "int", v)
+    return v
+
+
+def _arr(v, fname=None, idx=1):
     if not isinstance(v, list):
-        raise SdbError(f"Incorrect arguments for function {fname}(). Expected an array, got {render(v)}")
+        raise ArgError(idx, "array", v)
     return v
 
 
-def _str(v, fname):
+def _str(v, fname=None, idx=1):
     if not isinstance(v, str):
-        raise SdbError(f"Incorrect arguments for function {fname}(). Expected a string, got {render(v)}")
+        raise ArgError(idx, "string", v)
     return v
 
 
@@ -90,9 +136,30 @@ def call_function(node, ctx):
     fn = FUNCS.get(name)
     if fn is None:
         raise SdbError(f"The function '{node.name}' does not exist")
-    # closure-taking functions get raw AST access via ctx
     args = [evaluate(a, ctx) for a in node.args]
-    return fn(args, ctx)
+    return invoke(name, fn, args, ctx)
+
+
+def invoke(name, fn, args, ctx):
+    check_args(name, args)
+    try:
+        return fn(args, ctx)
+    except ArgError as e:
+        from surrealdb_tpu.val import render as _render
+
+        raise SdbError(
+            f"Incorrect arguments for function {name}(). Argument {e.idx} "
+            f"was the wrong type. Expected `{e.kind}` but found `{_render(e.value)}`"
+        )
+    except IndexError:
+        spec = ARITY.get(name)
+        if spec is not None:
+            raise SdbError(
+                f"Incorrect arguments for function {name}(). {_arity_msg(spec)}"
+            )
+        raise SdbError(
+            f"Incorrect arguments for function {name}(). Not enough arguments"
+        )
 
 
 def call_custom(name, args, ctx):
@@ -127,7 +194,10 @@ def call_custom(name, args, ctx):
     return out
 
 
+from surrealdb_tpu.val import SSet as _SSet  # noqa: E402
+
 _METHOD_FAMILIES = [
+    (_SSet, "set"),
     (list, "array"),
     (str, "string"),
     (dict, "object"),
@@ -160,7 +230,22 @@ def method_call(val, name, args, ctx):
     for cand in candidates:
         fn = FUNCS.get(cand)
         if fn is not None:
-            return fn([val] + args, ctx)
+            return invoke(cand, fn, [val] + args, ctx)
+    # ranges materialize to arrays for array methods: (0..10).map(...)
+    if isinstance(val, Range):
+        try:
+            items = list(val.iter_ints())
+        except TypeError:
+            items = None
+        if items is not None:
+            fn = FUNCS.get(f"array::{name}")
+            if fn is not None:
+                return invoke(f"array::{name}", fn, [items] + args, ctx)
+    if isinstance(val, _SSet):
+        fn = FUNCS.get(f"array::{name}")
+        if fn is not None:
+            out = invoke(f"array::{name}", fn, [list(val)] + args, ctx)
+            return _SSet(out) if isinstance(out, list) else out
     # chained custom function: .fn::foo()
     raise SdbError(f"The method '{name}' does not exist for {render(val)}")
 
@@ -275,6 +360,37 @@ def _rand_uuid7(args, ctx):
     return Uuid.new_v7()
 
 
+@register("rand::duration", arity=(0, 2))
+def _rand_duration(args, ctx):
+    from surrealdb_tpu.val import Duration as _D
+
+    if len(args) == 2:
+        for i, a in enumerate(args):
+            if not isinstance(a, _D):
+                raise ArgError(i + 1, "duration", a)
+        lo, hi = args[0].ns, args[1].ns
+    else:
+        lo, hi = 0, 10**12
+    return _D(_random.randint(min(lo, hi), max(lo, hi)))
+
+
+@register("rand::id", arity=(0, 2))
+def _rand_id(args, ctx):
+    """rand::id() / rand::id(len) / rand::id(lo, hi) (reference fnc/rand.rs:85)."""
+    if len(args) == 2:
+        lo, hi = _int(args[0], idx=1), _int(args[1], idx=2)
+        if lo > hi:
+            lo, hi = hi, lo
+        n = _random.randint(lo, min(hi, 64))
+    elif len(args) == 1:
+        n = min(_int(args[0], idx=1), 64)
+    else:
+        n = 20
+    return "".join(
+        _random.choices("0123456789abcdefghijklmnopqrstuvwxyz", k=max(n, 0))
+    )
+
+
 @register("rand::ulid")
 def _rand_ulid(args, ctx):
     from surrealdb_tpu.exec.eval import generate_record_key
@@ -292,3 +408,111 @@ from surrealdb_tpu.fnc import (  # noqa: E402,F401
     type_fns,
     vector_fns,
 )
+
+# type::is_X(...) function-call aliases for the type::is::X predicates
+for _pname in list(FUNCS):
+    if _pname.startswith("type::is::"):
+        FUNCS[f"type::is_{_pname[10:]}"] = FUNCS[_pname]
+
+# arity table (reference fnc signatures; (lo, hi) with hi=None = unbounded)
+ARITY.update({
+    "count": (0, 1), "not": (1, 1), "sleep": (1, 1), "rand": (0, 0),
+    # array
+    "array::add": (2, 2), "array::all": (1, 2), "array::any": (1, 2),
+    "array::append": (2, 2), "array::at": (2, 2),
+    "array::boolean_and": (2, 2), "array::boolean_or": (2, 2),
+    "array::boolean_xor": (2, 2), "array::boolean_not": (1, 1),
+    "array::clump": (2, 2), "array::combine": (2, 2),
+    "array::complement": (2, 2), "array::concat": (1, None),
+    "array::difference": (2, 2), "array::distinct": (1, 1),
+    "array::fill": (2, 4), "array::filter": (2, 2),
+    "array::filter_index": (2, 2), "array::find": (2, 2),
+    "array::find_index": (2, 2), "array::first": (1, 1),
+    "array::flatten": (1, 1), "array::fold": (3, 3), "array::group": (1, 1),
+    "array::insert": (2, 3), "array::intersect": (2, 2),
+    "array::is_empty": (1, 1), "array::join": (2, 2), "array::last": (1, 1),
+    "array::len": (1, 1), "array::logical_and": (2, 2),
+    "array::logical_or": (2, 2), "array::logical_xor": (2, 2),
+    "array::map": (2, 2), "array::matches": (2, 2), "array::max": (1, 1),
+    "array::min": (1, 1), "array::pop": (1, 1), "array::prepend": (2, 2),
+    "array::push": (2, 2), "array::range": (2, 2), "array::reduce": (2, 2),
+    "array::remove": (2, 2), "array::repeat": (2, 2),
+    "array::reverse": (1, 1), "array::shuffle": (1, 1),
+    "array::slice": (1, 3), "array::sort": (1, 2),
+    "array::sort::asc": (1, 1), "array::sort::desc": (1, 1),
+    "array::swap": (3, 3), "array::transpose": (1, 1),
+    "array::union": (2, 2), "array::windows": (2, 2),
+    # set
+    "set::add": (2, 2), "set::complement": (2, 2), "set::contains": (2, 2),
+    "set::difference": (2, 2), "set::intersect": (2, 2), "set::len": (1, 1),
+    "set::union": (2, 2),
+    # string
+    "string::contains": (2, 2), "string::ends_with": (2, 2),
+    "string::len": (1, 1), "string::lowercase": (1, 1),
+    "string::matches": (2, 2), "string::repeat": (2, 2),
+    "string::replace": (3, 3), "string::reverse": (1, 1),
+    "string::slice": (1, 3), "string::slug": (1, 1),
+    "string::split": (2, 2), "string::starts_with": (2, 2),
+    "string::trim": (1, 1), "string::uppercase": (1, 1),
+    "string::words": (1, 1),
+    "string::distance::hamming": (2, 2),
+    "string::distance::levenshtein": (2, 2),
+    "string::distance::damerau_levenshtein": (2, 2),
+    "string::similarity::fuzzy": (2, 2), "string::similarity::jaro": (2, 2),
+    "string::similarity::jaro_winkler": (2, 2),
+    "string::similarity::smithwaterman": (2, 2),
+    # math
+    "math::abs": (1, 1), "math::acos": (1, 1), "math::asin": (1, 1),
+    "math::atan": (1, 1), "math::ceil": (1, 1), "math::cos": (1, 1),
+    "math::fixed": (2, 2), "math::floor": (1, 1), "math::ln": (1, 1),
+    "math::log": (2, 2), "math::log10": (1, 1), "math::log2": (1, 1),
+    "math::max": (1, 1), "math::mean": (1, 1), "math::median": (1, 1),
+    "math::min": (1, 1), "math::mode": (1, 1), "math::pow": (2, 2),
+    "math::product": (1, 1), "math::round": (1, 1), "math::sign": (1, 1),
+    "math::sin": (1, 1), "math::sqrt": (1, 1), "math::stddev": (1, 1),
+    "math::sum": (1, 1), "math::tan": (1, 1), "math::variance": (1, 1),
+    "math::spread": (1, 1), "math::percentile": (2, 2),
+    "math::nearestrank": (2, 2), "math::top": (2, 2), "math::bottom": (2, 2),
+    "math::interquartile": (1, 1), "math::midhinge": (1, 1),
+    "math::trimean": (1, 1), "math::clamp": (3, 3), "math::lerp": (3, 3),
+    "math::lerpangle": (3, 3), "math::deg2rad": (1, 1),
+    "math::rad2deg": (1, 1),
+    # time / duration
+    "time::now": (0, 0), "time::floor": (2, 2), "time::ceil": (2, 2),
+    "time::round": (2, 2), "time::group": (2, 2), "time::format": (2, 2),
+    # type
+    "type::bool": (1, 1), "type::datetime": (1, 1), "type::decimal": (1, 1),
+    "type::duration": (1, 1), "type::float": (1, 1), "type::int": (1, 1),
+    "type::number": (1, 1), "type::string": (1, 1), "type::table": (1, 1),
+    "type::thing": (1, 2), "type::record": (1, 2), "type::uuid": (1, 1),
+    "type::point": (1, 2), "type::field": (1, 1), "type::fields": (1, 1),
+    "type::range": (1, 1), "type::array": (1, 1), "type::bytes": (1, 1),
+    # vector
+    "vector::add": (2, 2), "vector::subtract": (2, 2),
+    "vector::multiply": (2, 2), "vector::divide": (2, 2),
+    "vector::cross": (2, 2), "vector::dot": (2, 2), "vector::scale": (2, 2),
+    "vector::magnitude": (1, 1), "vector::normalize": (1, 1),
+    "vector::project": (2, 2), "vector::angle": (2, 2),
+    "vector::distance::euclidean": (2, 2),
+    "vector::distance::manhattan": (2, 2),
+    "vector::distance::chebyshev": (2, 2),
+    "vector::distance::hamming": (2, 2),
+    "vector::distance::minkowski": (3, 3),
+    "vector::distance::knn": (0, 1),
+    "vector::similarity::cosine": (2, 2),
+    "vector::similarity::jaccard": (2, 2),
+    "vector::similarity::pearson": (2, 2),
+    "vector::similarity::spearman": (2, 2),
+    # crypto / parse / encoding
+    "crypto::md5": (1, 1), "crypto::sha1": (1, 1), "crypto::sha256": (1, 1),
+    "crypto::sha512": (1, 1),
+    "parse::email::host": (1, 1), "parse::email::user": (1, 1),
+    "encoding::base64::encode": (1, 1), "encoding::base64::decode": (1, 1),
+    # rand
+    "rand::bool": (0, 0), "rand::float": (0, 2), "rand::guid": (0, 2),
+    "rand::int": (0, 2), "rand::string": (0, 2), "rand::time": (0, 2),
+    "rand::uuid": (0, 1), "rand::ulid": (0, 1), "rand::enum": (1, None),
+    # record
+    "record::exists": (1, 1), "record::id": (1, 1), "record::tb": (1, 1),
+    "record::table": (1, 1), "record::refs": (1, 3),
+})

@@ -34,7 +34,7 @@ def _dedup(items):
 
 @register("array::add")
 def _add(args, ctx):
-    a = _arr(args[0], "array::add")[:]
+    a = _arr(args[0], "array::add", 1)[:]
     v = args[1]
     vs = v if isinstance(v, list) else [v]
     for x in vs:
@@ -45,7 +45,7 @@ def _add(args, ctx):
 
 @register("array::all")
 def _all(args, ctx):
-    a = _arr(args[0], "array::all")
+    a = _arr(args[0], "array::all", 1)
     if len(args) > 1:
         if isinstance(args[1], Closure):
             return all(is_truthy(_call(args[1], [x], ctx)) for x in a)
@@ -55,7 +55,7 @@ def _all(args, ctx):
 
 @register("array::any")
 def _any(args, ctx):
-    a = _arr(args[0], "array::any")
+    a = _arr(args[0], "array::any", 1)
     if len(args) > 1:
         if isinstance(args[1], Closure):
             return any(is_truthy(_call(args[1], [x], ctx)) for x in a)
@@ -65,13 +65,13 @@ def _any(args, ctx):
 
 @register("array::append")
 def _append(args, ctx):
-    return _arr(args[0], "array::append")[:] + [args[1]]
+    return _arr(args[0], "array::append", 1)[:] + [args[1]]
 
 
 @register("array::at")
 def _at(args, ctx):
-    a = _arr(args[0], "array::at")
-    i = int(_num(args[1], "array::at"))
+    a = _arr(args[0], "array::at", 1)
+    i = int(_num(args[1], "array::at", 2))
     if -len(a) <= i < len(a):
         return a[i]
     return NONE
@@ -79,7 +79,7 @@ def _at(args, ctx):
 
 @register("array::boolean_and")
 def _band(args, ctx):
-    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    a, b = _arr(args[0], "f", 1), _arr(args[1], "f", 2)
     n = max(len(a), len(b))
     ga = a + [NONE] * (n - len(a))
     gb = b + [NONE] * (n - len(b))
@@ -88,7 +88,7 @@ def _band(args, ctx):
 
 @register("array::boolean_or")
 def _bor(args, ctx):
-    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    a, b = _arr(args[0], "f", 1), _arr(args[1], "f", 2)
     n = max(len(a), len(b))
     ga = a + [NONE] * (n - len(a))
     gb = b + [NONE] * (n - len(b))
@@ -97,7 +97,7 @@ def _bor(args, ctx):
 
 @register("array::boolean_xor")
 def _bxor(args, ctx):
-    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    a, b = _arr(args[0], "f", 1), _arr(args[1], "f", 2)
     n = max(len(a), len(b))
     ga = a + [NONE] * (n - len(a))
     gb = b + [NONE] * (n - len(b))
@@ -106,13 +106,13 @@ def _bxor(args, ctx):
 
 @register("array::boolean_not")
 def _bnot(args, ctx):
-    return [not is_truthy(x) for x in _arr(args[0], "f")]
+    return [not is_truthy(x) for x in _arr(args[0], "f", 1)]
 
 
 @register("array::clump")
 def _clump(args, ctx):
-    a = _arr(args[0], "array::clump")
-    n = int(_num(args[1], "array::clump"))
+    a = _arr(args[0], "array::clump", 1)
+    n = int(_num(args[1], "array::clump", 2))
     if n < 1:
         raise SdbError("Incorrect arguments for function array::clump(). The second argument must be an integer greater than 0")
     return [a[i : i + n] for i in range(0, len(a), n)]
@@ -120,13 +120,13 @@ def _clump(args, ctx):
 
 @register("array::combine")
 def _combine(args, ctx):
-    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    a, b = _arr(args[0], "f", 1), _arr(args[1], "f", 2)
     return [[x, y] for x in a for y in b]
 
 
 @register("array::complement")
 def _complement(args, ctx):
-    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    a, b = _arr(args[0], "f", 1), _arr(args[1], "f", 2)
     return [x for x in a if not any(value_eq(x, y) for y in b)]
 
 
@@ -140,7 +140,7 @@ def _concat(args, ctx):
 
 @register("array::difference")
 def _difference(args, ctx):
-    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    a, b = _arr(args[0], "f", 1), _arr(args[1], "f", 2)
     out = [x for x in a if not any(value_eq(x, y) for y in b)]
     out += [y for y in b if not any(value_eq(y, x) for x in a)]
     return out
@@ -148,12 +148,12 @@ def _difference(args, ctx):
 
 @register("array::distinct")
 def _distinct(args, ctx):
-    return _dedup(_arr(args[0], "array::distinct"))
+    return _dedup(_arr(args[0], "array::distinct", 1))
 
 
 @register("array::fill")
 def _fill(args, ctx):
-    a = _arr(args[0], "array::fill")[:]
+    a = _arr(args[0], "array::fill", 1)[:]
     v = args[1]
     beg = int(args[2]) if len(args) > 2 else 0
     end = int(args[3]) if len(args) > 3 else len(a)
@@ -164,7 +164,7 @@ def _fill(args, ctx):
 
 @register("array::filter")
 def _filter(args, ctx):
-    a = _arr(args[0], "array::filter")
+    a = _arr(args[0], "array::filter", 1)
     p = args[1]
     if isinstance(p, Closure):
         return [x for x in a if is_truthy(_call(p, [x], ctx))]
@@ -173,7 +173,7 @@ def _filter(args, ctx):
 
 @register("array::filter_index")
 def _filter_index(args, ctx):
-    a = _arr(args[0], "array::filter_index")
+    a = _arr(args[0], "array::filter_index", 1)
     p = args[1]
     if isinstance(p, Closure):
         return [i for i, x in enumerate(a) if is_truthy(_call(p, [x], ctx))]
@@ -182,7 +182,7 @@ def _filter_index(args, ctx):
 
 @register("array::find")
 def _find(args, ctx):
-    a = _arr(args[0], "array::find")
+    a = _arr(args[0], "array::find", 1)
     p = args[1]
     if isinstance(p, Closure):
         for x in a:
@@ -197,7 +197,7 @@ def _find(args, ctx):
 
 @register("array::find_index")
 def _find_index(args, ctx):
-    a = _arr(args[0], "array::find_index")
+    a = _arr(args[0], "array::find_index", 1)
     p = args[1]
     for i, x in enumerate(a):
         if isinstance(p, Closure):
@@ -210,14 +210,14 @@ def _find_index(args, ctx):
 
 @register("array::first")
 def _first(args, ctx):
-    a = _arr(args[0], "array::first")
+    a = _arr(args[0], "array::first", 1)
     return a[0] if a else NONE
 
 
 @register("array::flatten")
 def _flatten(args, ctx):
     out = []
-    for x in _arr(args[0], "array::flatten"):
+    for x in _arr(args[0], "array::flatten", 1):
         if isinstance(x, list):
             out.extend(x)
         else:
@@ -227,7 +227,7 @@ def _flatten(args, ctx):
 
 @register("array::fold")
 def _fold(args, ctx):
-    a = _arr(args[0], "array::fold")
+    a = _arr(args[0], "array::fold", 1)
     acc = args[1]
     clo = args[2]
     for i, x in enumerate(a):
@@ -238,7 +238,7 @@ def _fold(args, ctx):
 @register("array::group")
 def _group(args, ctx):
     out = []
-    for x in _arr(args[0], "array::group"):
+    for x in _arr(args[0], "array::group", 1):
         items = x if isinstance(x, list) else [x]
         for y in items:
             if not any(value_eq(y, z) for z in out):
@@ -248,7 +248,7 @@ def _group(args, ctx):
 
 @register("array::insert")
 def _insert(args, ctx):
-    a = _arr(args[0], "array::insert")[:]
+    a = _arr(args[0], "array::insert", 1)[:]
     v = args[1]
     i = int(args[2]) if len(args) > 2 else len(a)
     if i < 0:
@@ -259,13 +259,13 @@ def _insert(args, ctx):
 
 @register("array::intersect")
 def _intersect(args, ctx):
-    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    a, b = _arr(args[0], "f", 1), _arr(args[1], "f", 2)
     return [x for x in _dedup(a) if any(value_eq(x, y) for y in b)]
 
 
 @register("array::is_empty")
 def _is_empty(args, ctx):
-    return len(_arr(args[0], "array::is_empty")) == 0
+    return len(_arr(args[0], "array::is_empty", 1)) == 0
 
 
 @register("array::join")
@@ -273,23 +273,23 @@ def _join(args, ctx):
     from surrealdb_tpu.exec.operators import to_string
 
     sep = args[1] if len(args) > 1 else ""
-    return sep.join(to_string(x) for x in _arr(args[0], "array::join"))
+    return sep.join(to_string(x) for x in _arr(args[0], "array::join", 1))
 
 
 @register("array::last")
 def _last(args, ctx):
-    a = _arr(args[0], "array::last")
+    a = _arr(args[0], "array::last", 1)
     return a[-1] if a else NONE
 
 
 @register("array::len")
 def _len(args, ctx):
-    return len(_arr(args[0], "array::len"))
+    return len(_arr(args[0], "array::len", 1))
 
 
 @register("array::logical_and")
 def _land(args, ctx):
-    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    a, b = _arr(args[0], "f", 1), _arr(args[1], "f", 2)
     n = max(len(a), len(b))
     out = []
     for i in range(n):
@@ -301,7 +301,7 @@ def _land(args, ctx):
 
 @register("array::logical_or")
 def _lor(args, ctx):
-    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    a, b = _arr(args[0], "f", 1), _arr(args[1], "f", 2)
     n = max(len(a), len(b))
     out = []
     for i in range(n):
@@ -313,7 +313,7 @@ def _lor(args, ctx):
 
 @register("array::logical_xor")
 def _lxor(args, ctx):
-    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    a, b = _arr(args[0], "f", 1), _arr(args[1], "f", 2)
     n = max(len(a), len(b))
     out = []
     for i in range(n):
@@ -331,49 +331,49 @@ def _lxor(args, ctx):
 
 @register("array::map")
 def _map(args, ctx):
-    a = _arr(args[0], "array::map")
+    a = _arr(args[0], "array::map", 1)
     clo = args[1]
     return [_call(clo, [x, i], ctx) for i, x in enumerate(a)]
 
 
 @register("array::matches")
 def _matches(args, ctx):
-    a = _arr(args[0], "array::matches")
+    a = _arr(args[0], "array::matches", 1)
     return [value_eq(x, args[1]) for x in a]
 
 
 @register("array::max")
 def _max(args, ctx):
-    a = _arr(args[0], "array::max")
+    a = _arr(args[0], "array::max", 1)
     return max(a, key=sort_key) if a else NONE
 
 
 @register("array::min")
 def _min(args, ctx):
-    a = _arr(args[0], "array::min")
+    a = _arr(args[0], "array::min", 1)
     return min(a, key=sort_key) if a else NONE
 
 
 @register("array::pop")
 def _pop(args, ctx):
-    a = _arr(args[0], "array::pop")
+    a = _arr(args[0], "array::pop", 1)
     return a[-1] if a else NONE
 
 
 @register("array::prepend")
 def _prepend(args, ctx):
-    return [args[1]] + _arr(args[0], "array::prepend")
+    return [args[1]] + _arr(args[0], "array::prepend", 1)
 
 
 @register("array::push")
 def _push(args, ctx):
-    return _arr(args[0], "array::push")[:] + [args[1]]
+    return _arr(args[0], "array::push", 1)[:] + [args[1]]
 
 
 @register("array::range")
 def _range(args, ctx):
-    beg = int(_num(args[0], "array::range"))
-    n = int(_num(args[1], "array::range"))
+    beg = int(_num(args[0], "array::range", 1))
+    n = int(_num(args[1], "array::range", 2))
     if n < 0:
         raise SdbError("Incorrect arguments for function array::range(). The second argument must be a non-negative integer")
     return list(range(beg, beg + n))
@@ -381,7 +381,7 @@ def _range(args, ctx):
 
 @register("array::reduce")
 def _reduce(args, ctx):
-    a = _arr(args[0], "array::reduce")
+    a = _arr(args[0], "array::reduce", 1)
     clo = args[1]
     if not a:
         return NONE
@@ -393,8 +393,8 @@ def _reduce(args, ctx):
 
 @register("array::remove")
 def _remove(args, ctx):
-    a = _arr(args[0], "array::remove")[:]
-    i = int(_num(args[1], "array::remove"))
+    a = _arr(args[0], "array::remove", 1)[:]
+    i = int(_num(args[1], "array::remove", 2))
     if -len(a) <= i < len(a):
         a.pop(i)
     return a
@@ -402,25 +402,25 @@ def _remove(args, ctx):
 
 @register("array::repeat")
 def _repeat(args, ctx):
-    n = int(_num(args[1], "array::repeat"))
+    n = int(_num(args[1], "array::repeat", 2))
     return [args[0]] * n
 
 
 @register("array::reverse")
 def _reverse(args, ctx):
-    return list(reversed(_arr(args[0], "array::reverse")))
+    return list(reversed(_arr(args[0], "array::reverse", 1)))
 
 
 @register("array::shuffle")
 def _shuffle(args, ctx):
-    a = _arr(args[0], "array::shuffle")[:]
+    a = _arr(args[0], "array::shuffle", 1)[:]
     _random.shuffle(a)
     return a
 
 
 @register("array::slice")
 def _slice(args, ctx):
-    a = _arr(args[0], "array::slice")
+    a = _arr(args[0], "array::slice", 1)
     beg = int(args[1]) if len(args) > 1 else 0
     n = int(args[2]) if len(args) > 2 else None
     if beg < 0:
@@ -434,7 +434,7 @@ def _slice(args, ctx):
 
 @register("array::sort")
 def _sort(args, ctx):
-    a = _arr(args[0], "array::sort")[:]
+    a = _arr(args[0], "array::sort", 1)[:]
     asc = True
     if len(args) > 1:
         v = args[1]
@@ -471,7 +471,7 @@ def _sort_nl(args, ctx):
 
 @register("array::swap")
 def _swap(args, ctx):
-    a = _arr(args[0], "array::swap")[:]
+    a = _arr(args[0], "array::swap", 1)[:]
     i, j = int(args[1]), int(args[2])
     n = len(a)
     if i < 0:
@@ -486,7 +486,7 @@ def _swap(args, ctx):
 
 @register("array::transpose")
 def _transpose(args, ctx):
-    a = _arr(args[0], "array::transpose")
+    a = _arr(args[0], "array::transpose", 1)
     if not a:
         return []
     n = max(len(x) if isinstance(x, list) else 1 for x in a)
@@ -505,31 +505,112 @@ def _transpose(args, ctx):
 
 @register("array::union")
 def _union(args, ctx):
-    a, b = _arr(args[0], "f"), _arr(args[1], "f")
+    a, b = _arr(args[0], "f", 1), _arr(args[1], "f", 2)
     return _dedup(a + b)
 
 
 @register("array::windows")
 def _windows(args, ctx):
-    a = _arr(args[0], "array::windows")
-    n = int(_num(args[1], "array::windows"))
+    a = _arr(args[0], "array::windows", 1)
+    n = int(_num(args[1], "array::windows", 2))
     if n < 1:
         raise SdbError("Incorrect arguments for function array::windows(). The second argument must be an integer greater than 0")
     return [a[i : i + n] for i in range(0, len(a) - n + 1)]
 
 
-# set:: aliases (sets are deduplicated arrays)
-for _name in ("add", "complement", "difference", "intersect", "union"):
-    FUNCS_ALIAS = f"set::{_name}"
+# ---------------------------------------------------------------------------
+# set:: family — SSet in, SSet out where the reference returns a set
+# (reference fnc/set.rs over val/set.rs BTreeSet)
+# ---------------------------------------------------------------------------
 
-from surrealdb_tpu.fnc import FUNCS as _F  # noqa: E402
+from surrealdb_tpu.fnc import ARITY, FUNCS as _F, ArgError  # noqa: E402
+from surrealdb_tpu.val import SSet  # noqa: E402
 
-_F["set::add"] = _F["array::add"]
-_F["set::complement"] = _F["array::complement"]
-_F["set::difference"] = _F["array::difference"]
-_F["set::intersect"] = _F["array::intersect"]
-_F["set::union"] = _F["array::union"]
-_F["set::len"] = _F["array::len"]
-_F["set::contains"] = lambda args, ctx: any(
-    value_eq(x, args[1]) for x in _arr(args[0], "set::contains")
-)
+
+def _set(v, idx=1):
+    if not isinstance(v, SSet):
+        raise ArgError(idx, "set", v)
+    return v
+
+
+def _set_wrap(arr_name, returns_set=True, set_args=(1,)):
+    inner = _F[arr_name]
+
+    def fn(args, ctx):
+        conv = list(args)
+        for i in set_args:
+            if i <= len(conv):
+                conv[i - 1] = list(_set(conv[i - 1], i))
+        # second set/array arguments are accepted as arrays too
+        for i, v in enumerate(conv):
+            if isinstance(v, SSet) and (i + 1) not in set_args:
+                conv[i] = list(v)
+        out = inner(conv, ctx)
+        if returns_set and isinstance(out, list):
+            return SSet(out)
+        return out
+
+    return fn
+
+
+_SET_FNS = {
+    # name -> (array impl, returns_set)
+    "add": ("array::add", True), "all": ("array::all", False),
+    "any": ("array::any", False), "at": ("array::at", False),
+    "complement": ("array::complement", True),
+    "difference": ("array::difference", True),
+    "filter": ("array::filter", True), "find": ("array::find", False),
+    "first": ("array::first", False), "flatten": ("array::flatten", True),
+    "fold": ("array::fold", False), "intersect": ("array::intersect", True),
+    "is_empty": ("array::is_empty", False), "join": ("array::join", False),
+    "last": ("array::last", False), "len": ("array::len", False),
+    "map": ("array::map", True), "max": ("array::max", False),
+    "min": ("array::min", False), "reduce": ("array::reduce", False),
+    "remove": ("array::remove", True), "slice": ("array::slice", True),
+    "union": ("array::union", True),
+}
+
+for _n, (_impl, _ret) in _SET_FNS.items():
+    _F[f"set::{_n}"] = _set_wrap(_impl, _ret)
+    if _impl in ARITY:
+        ARITY[f"set::{_n}"] = ARITY[_impl]
+
+
+def _set_contains(args, ctx):
+    return args[1] in _set(args[0], 1)
+
+
+_F["set::contains"] = _set_contains
+
+
+def _set_insert(args, ctx):
+    s = _set(args[0], 1)
+    return SSet(s.items + [args[1]])
+
+
+_F["set::insert"] = _set_insert
+
+
+def _set_remove(args, ctx):
+    """set::remove removes by VALUE (reference fnc/set.rs), unlike
+    array::remove's index semantics."""
+    s = _set(args[0], 1)
+    v = args[1]
+    return SSet([x for x in s.items if not value_eq(x, v)])
+
+
+_F["set::remove"] = _set_remove
+
+
+def _set_flatten(args, ctx):
+    s = _set(args[0], 1)
+    out = []
+    for x in s:
+        if isinstance(x, (SSet, list)):
+            out.extend(list(x))
+        else:
+            out.append(x)
+    return SSet(out)
+
+
+_F["set::flatten"] = _set_flatten

@@ -29,7 +29,13 @@ def _unary(name, fn):
             return float("nan")
 
 
-_unary("abs", lambda v: abs(v))
+def _abs_checked(v):
+    if isinstance(v, int) and v == -(1 << 63):
+        raise SdbError("Cannot calculate the absolute value of this number")
+    return abs(v)
+
+
+_unary("abs", _abs_checked)
 _unary("acos", lambda v: math.acos(v))
 _unary("acot", lambda v: math.atan(1 / v) if v != 0 else math.pi / 2)
 _unary("asin", lambda v: math.asin(v))
@@ -49,41 +55,42 @@ _unary("tan", lambda v: math.tan(v))
 
 @register("math::ceil")
 def _ceil(args, ctx):
-    v = _num(args[0], "math::ceil")
+    v = _num(args[0], "math::ceil", 1)
     if isinstance(v, int):
         return v
     if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
         return v
-    return math.ceil(v)
+    return float(math.ceil(v)) if isinstance(v, float) else math.ceil(v)
 
 
 @register("math::floor")
 def _floor(args, ctx):
-    v = _num(args[0], "math::floor")
+    v = _num(args[0], "math::floor", 1)
     if isinstance(v, int):
         return v
     if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
         return v
-    return math.floor(v)
+    return float(math.floor(v)) if isinstance(v, float) else math.floor(v)
 
 
 @register("math::round")
 def _round(args, ctx):
-    v = _num(args[0], "math::round")
+    v = _num(args[0], "math::round", 1)
     if isinstance(v, int):
         return v
     if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
         return v
-    # half-away-from-zero like Rust's round()
-    return int(math.floor(v + 0.5)) if v >= 0 else int(math.ceil(v - 0.5))
+    # half-away-from-zero like Rust's round(); floats stay floats
+    r = math.floor(v + 0.5) if v >= 0 else math.ceil(v - 0.5)
+    return float(r) if isinstance(v, float) else r
 
 
 @register("math::fixed")
 def _fixed(args, ctx):
-    v = _num(args[0], "math::fixed")
-    p = int(_num(args[1], "math::fixed"))
+    v = _num(args[0], "math::fixed", 1)
+    p = int(_num(args[1], "math::fixed", 2))
     if p <= 0:
-        raise SdbError("Incorrect arguments for function math::fixed(). The second argument must be an integer greater than 0")
+        raise SdbError("Incorrect arguments for function math::fixed(). The second argument must be an integer greater than 0.")
     if isinstance(v, int):
         return v
     return round(float(v), p)
@@ -91,25 +98,28 @@ def _fixed(args, ctx):
 
 @register("math::clamp")
 def _clamp(args, ctx):
-    v = _num(args[0], "math::clamp")
-    lo = _num(args[1], "math::clamp")
-    hi = _num(args[2], "math::clamp")
-    return max(lo, min(hi, v))
+    v = _num(args[0], "math::clamp", 1)
+    lo = _num(args[1], "math::clamp", 2)
+    hi = _num(args[2], "math::clamp", 3)
+    out = max(lo, min(hi, v))
+    if isinstance(v, float) and not isinstance(out, float):
+        return float(out)
+    return out
 
 
 @register("math::lerp")
 def _lerp(args, ctx):
-    a = float(_num(args[0], "math::lerp"))
-    b = float(_num(args[1], "math::lerp"))
-    t = float(_num(args[2], "math::lerp"))
+    a = float(_num(args[0], "math::lerp", 1))
+    b = float(_num(args[1], "math::lerp", 2))
+    t = float(_num(args[2], "math::lerp", 3))
     return a + (b - a) * t
 
 
 @register("math::lerpangle")
 def _lerpangle(args, ctx):
-    a = float(_num(args[0], "math::lerpangle"))
-    b = float(_num(args[1], "math::lerpangle"))
-    t = float(_num(args[2], "math::lerpangle"))
+    a = float(_num(args[0], "math::lerpangle", 1))
+    b = float(_num(args[1], "math::lerpangle", 2))
+    t = float(_num(args[2], "math::lerpangle", 3))
     d = (b - a) % 360.0
     if d > 180.0:
         d -= 360.0
@@ -118,8 +128,8 @@ def _lerpangle(args, ctx):
 
 @register("math::log")
 def _log(args, ctx):
-    v = float(_num(args[0], "math::log"))
-    base = float(_num(args[1], "math::log"))
+    v = float(_num(args[0], "math::log", 1))
+    base = float(_num(args[1], "math::log", 2))
     try:
         return math.log(v, base)
     except (ValueError, ZeroDivisionError):
@@ -135,20 +145,20 @@ def _pow(args, ctx):
 
 @register("math::max")
 def _mmax(args, ctx):
-    a = _arr(args[0], "math::max")
+    a = _arr(args[0], "math::max", 1)
     return max(a, key=sort_key) if a else NONE
 
 
 @register("math::min")
 def _mmin(args, ctx):
-    a = _arr(args[0], "math::min")
+    a = _arr(args[0], "math::min", 1)
     return min(a, key=sort_key) if a else NONE
 
 
 @register("math::sum")
 def _sum(args, ctx):
     total = 0
-    for x in _arr(args[0], "math::sum"):
+    for x in _arr(args[0], "math::sum", 1):
         if isinstance(x, bool) or not isinstance(x, (int, float, Decimal)):
             continue
         if isinstance(x, Decimal) and not isinstance(total, Decimal):
@@ -160,7 +170,7 @@ def _sum(args, ctx):
 @register("math::product")
 def _product(args, ctx):
     total = 1
-    for x in _arr(args[0], "math::product"):
+    for x in _arr(args[0], "math::product", 1):
         if isinstance(x, bool) or not isinstance(x, (int, float, Decimal)):
             continue
         total = total * x
@@ -225,7 +235,7 @@ def _spread(args, ctx):
 @register("math::percentile")
 def _percentile(args, ctx):
     ns = sorted(_nums(args[0], "math::percentile"))
-    p = float(_num(args[1], "math::percentile"))
+    p = float(_num(args[1], "math::percentile", 2))
     if not ns:
         return float("nan")
     if len(ns) == 1:
@@ -241,7 +251,7 @@ def _percentile(args, ctx):
 @register("math::nearestrank")
 def _nearestrank(args, ctx):
     ns = sorted(_nums(args[0], "math::nearestrank"))
-    p = float(_num(args[1], "math::nearestrank"))
+    p = float(_num(args[1], "math::nearestrank", 2))
     if not ns:
         return float("nan")
     rank = int(math.ceil((p / 100.0) * len(ns)))
@@ -270,17 +280,17 @@ def _trimean(args, ctx):
 
 @register("math::top")
 def _top(args, ctx):
-    a = _arr(args[0], "math::top")
-    n = int(_num(args[1], "math::top"))
+    a = _arr(args[0], "math::top", 1)
+    n = int(_num(args[1], "math::top", 2))
     if n < 1:
-        raise SdbError("Incorrect arguments for function math::top(). The second argument must be an integer greater than 0")
+        raise SdbError("Incorrect arguments for function math::top(). The second argument must be an integer greater than 0.")
     return sorted(a, key=sort_key)[-n:]
 
 
 @register("math::bottom")
 def _bottom(args, ctx):
-    a = _arr(args[0], "math::bottom")
-    n = int(_num(args[1], "math::bottom"))
+    a = _arr(args[0], "math::bottom", 1)
+    n = int(_num(args[1], "math::bottom", 2))
     if n < 1:
-        raise SdbError("Incorrect arguments for function math::bottom(). The second argument must be an integer greater than 0")
+        raise SdbError("Incorrect arguments for function math::bottom(). The second argument must be an integer greater than 0.")
     return sorted(a, key=sort_key)[:n][::-1]

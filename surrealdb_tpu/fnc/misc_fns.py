@@ -18,28 +18,28 @@ from surrealdb_tpu.val import NONE, Geometry, RecordId, render
 
 @register("crypto::md5")
 def _md5(args, ctx):
-    return hashlib.md5(_str(args[0], "crypto::md5").encode()).hexdigest()
+    return hashlib.md5(_str(args[0], "crypto::md5", 1).encode()).hexdigest()
 
 
 @register("crypto::sha1")
 def _sha1(args, ctx):
-    return hashlib.sha1(_str(args[0], "crypto::sha1").encode()).hexdigest()
+    return hashlib.sha1(_str(args[0], "crypto::sha1", 1).encode()).hexdigest()
 
 
 @register("crypto::sha256")
 def _sha256(args, ctx):
-    return hashlib.sha256(_str(args[0], "crypto::sha256").encode()).hexdigest()
+    return hashlib.sha256(_str(args[0], "crypto::sha256", 1).encode()).hexdigest()
 
 
 @register("crypto::sha512")
 def _sha512(args, ctx):
-    return hashlib.sha512(_str(args[0], "crypto::sha512").encode()).hexdigest()
+    return hashlib.sha512(_str(args[0], "crypto::sha512", 1).encode()).hexdigest()
 
 
 @register("crypto::blake3")
 def _blake3(args, ctx):
     # stdlib has no blake3; blake2b is the closest available construction
-    return hashlib.blake2b(_str(args[0], "crypto::blake3").encode()).hexdigest()
+    return hashlib.blake2b(_str(args[0], "crypto::blake3", 1).encode()).hexdigest()
 
 
 # password hashing: pbkdf2 and scrypt are real; argon2/bcrypt use a
@@ -80,42 +80,42 @@ def _scrypt_compare(h: str, pw: str) -> bool:
 
 @register("crypto::pbkdf2::generate")
 def _pbkdf2_gen(args, ctx):
-    return _pbkdf2_hash(_str(args[0], "f"))
+    return _pbkdf2_hash(_str(args[0], "f", 1))
 
 
 @register("crypto::pbkdf2::compare")
 def _pbkdf2_cmp(args, ctx):
-    return _pbkdf2_compare(_str(args[0], "f"), _str(args[1], "f"))
+    return _pbkdf2_compare(_str(args[0], "f", 1), _str(args[1], "f", 2))
 
 
 @register("crypto::scrypt::generate")
 def _scrypt_gen(args, ctx):
-    return _scrypt_hash(_str(args[0], "f"))
+    return _scrypt_hash(_str(args[0], "f", 1))
 
 
 @register("crypto::scrypt::compare")
 def _scrypt_cmp(args, ctx):
-    return _scrypt_compare(_str(args[0], "f"), _str(args[1], "f"))
+    return _scrypt_compare(_str(args[0], "f", 1), _str(args[1], "f", 2))
 
 
 @register("crypto::argon2::generate")
 def _argon2_gen(args, ctx):
-    return _pbkdf2_hash(_str(args[0], "f"))
+    return _pbkdf2_hash(_str(args[0], "f", 1))
 
 
 @register("crypto::argon2::compare")
 def _argon2_cmp(args, ctx):
-    return _pbkdf2_compare(_str(args[0], "f"), _str(args[1], "f"))
+    return _pbkdf2_compare(_str(args[0], "f", 1), _str(args[1], "f", 2))
 
 
 @register("crypto::bcrypt::generate")
 def _bcrypt_gen(args, ctx):
-    return _pbkdf2_hash(_str(args[0], "f"))
+    return _pbkdf2_hash(_str(args[0], "f", 1))
 
 
 @register("crypto::bcrypt::compare")
 def _bcrypt_cmp(args, ctx):
-    return _pbkdf2_compare(_str(args[0], "f"), _str(args[1], "f"))
+    return _pbkdf2_compare(_str(args[0], "f", 1), _str(args[1], "f", 2))
 
 
 def password_hash(pw: str) -> str:
@@ -135,13 +135,13 @@ def password_compare(h: str, pw: str) -> bool:
 
 @register("parse::email::host")
 def _email_host(args, ctx):
-    s = _str(args[0], "f")
+    s = _str(args[0], "f", 1)
     return s.rsplit("@", 1)[1] if "@" in s else NONE
 
 
 @register("parse::email::user")
 def _email_user(args, ctx):
-    s = _str(args[0], "f")
+    s = _str(args[0], "f", 1)
     return s.rsplit("@", 1)[0] if "@" in s else NONE
 
 
@@ -208,7 +208,7 @@ def _b64_encode(args, ctx):
 def _b64_decode(args, ctx):
     import base64
 
-    s = _str(args[0], "f")
+    s = _str(args[0], "f", 1)
     pad = "=" * (-len(s) % 4)
     return base64.b64decode(s + pad)
 
@@ -344,7 +344,7 @@ def _geohash_encode(args, ctx):
 
 @register("geo::hash::decode")
 def _geohash_decode(args, ctx):
-    s = _str(args[0], "geo::hash::decode")
+    s = _str(args[0], "geo::hash::decode", 1)
     lat_r, lon_r = [-90.0, 90.0], [-180.0, 180.0]
     even = True
     for c in s:
@@ -424,7 +424,7 @@ def _nextval(args, ctx):
     from surrealdb_tpu import key as K
     from surrealdb_tpu.catalog import SequenceDef
 
-    name = _str(args[0], "sequence::nextval")
+    name = _str(args[0], "sequence::nextval", 1)
     ns, db = ctx.need_ns_db()
     kdef = K.seq_state(ns, db, name)
     st = ctx.txn.get_val(kdef)
@@ -477,8 +477,8 @@ def _search_offsets(args, ctx):
 def _search_analyze(args, ctx):
     from surrealdb_tpu.idx.fulltext import analyze_text
 
-    az = _str(args[0], "search::analyze")
-    return analyze_text(az, _str(args[1], "search::analyze"), ctx)
+    az = _str(args[0], "search::analyze", 1)
+    return analyze_text(az, _str(args[1], "search::analyze", 2), ctx)
 
 
 @register("search::rrf")

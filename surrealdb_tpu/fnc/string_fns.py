@@ -18,12 +18,12 @@ def _concat(args, ctx):
 
 @register("string::contains")
 def _contains(args, ctx):
-    return _str(args[1], "string::contains") in _str(args[0], "string::contains")
+    return _str(args[1], "string::contains", 2) in _str(args[0], "string::contains", 1)
 
 
 @register("string::ends_with")
 def _ends(args, ctx):
-    return _str(args[0], "f").endswith(_str(args[1], "f"))
+    return _str(args[0], "f", 1).endswith(_str(args[1], "f", 2))
 
 
 FUNCS_endsWith = _ends
@@ -31,35 +31,35 @@ FUNCS_endsWith = _ends
 
 @register("string::starts_with")
 def _starts(args, ctx):
-    return _str(args[0], "f").startswith(_str(args[1], "f"))
+    return _str(args[0], "f", 1).startswith(_str(args[1], "f", 2))
 
 
 @register("string::join")
 def _join(args, ctx):
     from surrealdb_tpu.exec.operators import to_string
 
-    sep = _str(args[0], "string::join")
+    sep = _str(args[0], "string::join", 1)
     return sep.join(to_string(a) for a in args[1:])
 
 
 @register("string::len")
 def _len(args, ctx):
-    return len(_str(args[0], "string::len"))
+    return len(_str(args[0], "string::len", 1))
 
 
 @register("string::lowercase")
 def _lower(args, ctx):
-    return _str(args[0], "string::lowercase").lower()
+    return _str(args[0], "string::lowercase", 1).lower()
 
 
 @register("string::uppercase")
 def _upper(args, ctx):
-    return _str(args[0], "string::uppercase").upper()
+    return _str(args[0], "string::uppercase", 1).upper()
 
 
 @register("string::matches")
 def _matches(args, ctx):
-    s = _str(args[0], "string::matches")
+    s = _str(args[0], "string::matches", 1)
     p = args[1]
     if isinstance(p, Regex):
         return p.rx.search(s) is not None
@@ -68,14 +68,14 @@ def _matches(args, ctx):
 
 @register("string::repeat")
 def _repeat(args, ctx):
-    return _str(args[0], "string::repeat") * int(_num(args[1], "string::repeat"))
+    return _str(args[0], "string::repeat", 1) * int(_num(args[1], "string::repeat", 2))
 
 
 @register("string::replace")
 def _replace(args, ctx):
-    s = _str(args[0], "string::replace")
+    s = _str(args[0], "string::replace", 1)
     old = args[1]
-    new = _str(args[2], "string::replace") if len(args) > 2 else ""
+    new = _str(args[2], "string::replace", 3) if len(args) > 2 else ""
     if isinstance(old, Regex):
         return old.rx.sub(new, s)
     return s.replace(_str(old, "string::replace"), new)
@@ -83,12 +83,12 @@ def _replace(args, ctx):
 
 @register("string::reverse")
 def _reverse(args, ctx):
-    return _str(args[0], "string::reverse")[::-1]
+    return _str(args[0], "string::reverse", 1)[::-1]
 
 
 @register("string::slice")
 def _slice(args, ctx):
-    s = _str(args[0], "string::slice")
+    s = _str(args[0], "string::slice", 1)
     beg = int(args[1]) if len(args) > 1 else 0
     n = int(args[2]) if len(args) > 2 else None
     if beg < 0:
@@ -102,15 +102,15 @@ def _slice(args, ctx):
 
 @register("string::slug")
 def _slug(args, ctx):
-    s = _str(args[0], "string::slug").lower()
+    s = _str(args[0], "string::slug", 1).lower()
     s = _re.sub(r"[^a-z0-9]+", "-", s)
     return s.strip("-")
 
 
 @register("string::split")
 def _split(args, ctx):
-    s = _str(args[0], "string::split")
-    sep = _str(args[1], "string::split")
+    s = _str(args[0], "string::split", 1)
+    sep = _str(args[1], "string::split", 2)
     if sep == "":
         return list(s)
     return s.split(sep)
@@ -118,25 +118,25 @@ def _split(args, ctx):
 
 @register("string::trim")
 def _trim(args, ctx):
-    return _str(args[0], "string::trim").strip()
+    return _str(args[0], "string::trim", 1).strip()
 
 
 @register("string::words")
 def _words(args, ctx):
-    return _str(args[0], "string::words").split()
+    return _str(args[0], "string::words", 1).split()
 
 
 @register("string::html::encode")
 def _html_encode(args, ctx):
     import html
 
-    return html.escape(_str(args[0], "f"))
+    return html.escape(_str(args[0], "f", 1))
 
 
 @register("string::html::sanitize")
 def _html_sanitize(args, ctx):
     return _re.sub(r"<[^>]*script[^>]*>.*?</[^>]*script[^>]*>", "",
-                   _str(args[0], "f"), flags=_re.S | _re.I)
+                   _str(args[0], "f", 1), flags=_re.S | _re.I)
 
 
 # -- is:: ---------------------------------------------------------------------
@@ -275,12 +275,12 @@ def _levenshtein(a, b):
 
 @register("string::distance::levenshtein")
 def _lev(args, ctx):
-    return _levenshtein(_str(args[0], "f"), _str(args[1], "f"))
+    return _levenshtein(_str(args[0], "f", 1), _str(args[1], "f", 2))
 
 
 @register("string::distance::damerau_levenshtein")
 def _dlev(args, ctx):
-    a, b = _str(args[0], "f"), _str(args[1], "f")
+    a, b = _str(args[0], "f", 1), _str(args[1], "f", 2)
     da = {}
     maxdist = len(a) + len(b)
     d = [[maxdist] * (len(b) + 2) for _ in range(len(a) + 2)]
@@ -312,7 +312,7 @@ def _dlev(args, ctx):
 
 @register("string::distance::hamming")
 def _hamming(args, ctx):
-    a, b = _str(args[0], "f"), _str(args[1], "f")
+    a, b = _str(args[0], "f", 1), _str(args[1], "f", 2)
     if len(a) != len(b):
         raise SdbError("Incorrect arguments for function string::distance::hamming(). Strings must be of equal length")
     return sum(x != y for x, y in zip(a, b))
@@ -354,12 +354,12 @@ def _jaro(a, b):
 
 @register("string::similarity::jaro")
 def _jaro_fn(args, ctx):
-    return _jaro(_str(args[0], "f"), _str(args[1], "f"))
+    return _jaro(_str(args[0], "f", 1), _str(args[1], "f", 2))
 
 
 @register("string::similarity::jaro_winkler")
 def _jw(args, ctx):
-    a, b = _str(args[0], "f"), _str(args[1], "f")
+    a, b = _str(args[0], "f", 1), _str(args[1], "f", 2)
     j = _jaro(a, b)
     prefix = 0
     for x, y in zip(a, b):
@@ -372,7 +372,7 @@ def _jw(args, ctx):
 
 @register("string::similarity::fuzzy")
 def _fuzzy_sim(args, ctx):
-    a, b = _str(args[0], "f"), _str(args[1], "f")
+    a, b = _str(args[0], "f", 1), _str(args[1], "f", 2)
     # fuzzy match score similar to the reference's fuzzy matcher: 0 if no
     # subsequence match, else a positive score
     from surrealdb_tpu.exec.operators import _fuzzy
@@ -384,7 +384,7 @@ def _fuzzy_sim(args, ctx):
 
 @register("string::similarity::smithwaterman")
 def _sw(args, ctx):
-    a, b = _str(args[0], "f"), _str(args[1], "f")
+    a, b = _str(args[0], "f", 1), _str(args[1], "f", 2)
     prev = [0] * (len(b) + 1)
     best = 0
     for ca in a:
@@ -414,8 +414,8 @@ def _parse_semver(s):
 
 @register("string::semver::compare")
 def _semver_cmp(args, ctx):
-    a = _parse_semver(_str(args[0], "f"))
-    b = _parse_semver(_str(args[1], "f"))
+    a = _parse_semver(_str(args[0], "f", 1))
+    b = _parse_semver(_str(args[1], "f", 2))
     ka = (int(a[1]), int(a[2]), int(a[3]))
     kb = (int(b[1]), int(b[2]), int(b[3]))
     if ka != kb:
@@ -432,52 +432,52 @@ def _semver_cmp(args, ctx):
 
 @register("string::semver::major")
 def _semver_major(args, ctx):
-    return int(_parse_semver(_str(args[0], "f"))[1])
+    return int(_parse_semver(_str(args[0], "f", 1))[1])
 
 
 @register("string::semver::minor")
 def _semver_minor(args, ctx):
-    return int(_parse_semver(_str(args[0], "f"))[2])
+    return int(_parse_semver(_str(args[0], "f", 1))[2])
 
 
 @register("string::semver::patch")
 def _semver_patch(args, ctx):
-    return int(_parse_semver(_str(args[0], "f"))[3])
+    return int(_parse_semver(_str(args[0], "f", 1))[3])
 
 
 @register("string::semver::inc::major")
 def _semver_inc_major(args, ctx):
-    m = _parse_semver(_str(args[0], "f"))
+    m = _parse_semver(_str(args[0], "f", 1))
     return f"{int(m[1]) + 1}.0.0"
 
 
 @register("string::semver::inc::minor")
 def _semver_inc_minor(args, ctx):
-    m = _parse_semver(_str(args[0], "f"))
+    m = _parse_semver(_str(args[0], "f", 1))
     return f"{m[1]}.{int(m[2]) + 1}.0"
 
 
 @register("string::semver::inc::patch")
 def _semver_inc_patch(args, ctx):
-    m = _parse_semver(_str(args[0], "f"))
+    m = _parse_semver(_str(args[0], "f", 1))
     return f"{m[1]}.{m[2]}.{int(m[3]) + 1}"
 
 
 @register("string::semver::set::major")
 def _semver_set_major(args, ctx):
-    m = _parse_semver(_str(args[0], "f"))
+    m = _parse_semver(_str(args[0], "f", 1))
     return f"{int(args[1])}.{m[2]}.{m[3]}"
 
 
 @register("string::semver::set::minor")
 def _semver_set_minor(args, ctx):
-    m = _parse_semver(_str(args[0], "f"))
+    m = _parse_semver(_str(args[0], "f", 1))
     return f"{m[1]}.{int(args[1])}.{m[3]}"
 
 
 @register("string::semver::set::patch")
 def _semver_set_patch(args, ctx):
-    m = _parse_semver(_str(args[0], "f"))
+    m = _parse_semver(_str(args[0], "f", 1))
     return f"{m[1]}.{m[2]}.{int(args[1])}"
 
 

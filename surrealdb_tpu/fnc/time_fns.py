@@ -104,13 +104,13 @@ def _timezone(args, ctx):
 
 @register("time::max")
 def _tmax(args, ctx):
-    a = _arr(args[0], "time::max")
+    a = _arr(args[0], "time::max", 1)
     return max(a, key=sort_key) if a else NONE
 
 
 @register("time::min")
 def _tmin(args, ctx):
-    a = _arr(args[0], "time::min")
+    a = _arr(args[0], "time::min", 1)
     return min(a, key=sort_key) if a else NONE
 
 

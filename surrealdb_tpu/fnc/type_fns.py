@@ -9,6 +9,7 @@ from surrealdb_tpu.expr.ast import Kind
 from surrealdb_tpu.fnc import _arr, _str, register
 from surrealdb_tpu.val import (
     NONE,
+    SSet,
     Datetime,
     Duration,
     File,
@@ -130,19 +131,19 @@ def _field(args, ctx):
     from surrealdb_tpu.exec.eval import evaluate
     from surrealdb_tpu.syn.parser import Parser
 
-    path = _str(args[0], "type::field")
+    path = _str(args[0], "type::field", 1)
     node = Parser(path).parse_expr()
     return evaluate(node, ctx)
 
 
 @register("type::fields")
 def _fields(args, ctx):
-    return [_field([p], ctx) for p in _arr(args[0], "type::fields")]
+    return [_field([p], ctx) for p in _arr(args[0], "type::fields", 1)]
 
 
 @register("type::file")
 def _file(args, ctx):
-    return File(_str(args[0], "f"), _str(args[1], "f") if len(args) > 1 else "")
+    return File(_str(args[0], "f", 1), _str(args[1], "f", 2) if len(args) > 1 else "")
 
 
 # -- predicates ---------------------------------------------------------------
@@ -171,6 +172,7 @@ _PRED = {
     "string": lambda v: isinstance(v, str),
     "uuid": lambda v: isinstance(v, Uuid),
     "range": lambda v: isinstance(v, Range),
+    "set": lambda v: isinstance(v, SSet),
 }
 
 for _name, _fn in _PRED.items():
@@ -219,7 +221,7 @@ def _entries(args, ctx):
 @register("object::from_entries")
 def _from_entries(args, ctx):
     out = {}
-    for it in _arr(args[0], "object::from_entries"):
+    for it in _arr(args[0], "object::from_entries", 1):
         if isinstance(it, list) and len(it) == 2:
             out[str(it[0])] = it[1]
     return out

@@ -85,6 +85,79 @@ def get_indexes_for(tb, ctx):
     ]
 
 
+
+def _classify_preds(cond):
+    """WHERE-tree analysis shared by plan_scan and explain_plan: returns
+    (eqs, ins, rngs) keyed by field path."""
+    preds = []
+    _split_ands(cond, preds)
+    eqs: dict = {}
+    ins: dict = {}
+    rngs: dict = {}
+    for pred in preds:
+        if not isinstance(pred, Binary):
+            continue
+        if pred.op not in ("=", "==", "∈", "<", "<=", ">", ">=", "∋", "⊇",
+                           "containsany"):
+            continue
+        lp = _field_path(pred.lhs)
+        rp = _field_path(pred.rhs)
+        path = op = valexpr = None
+        if lp is not None and rp is None:
+            op = pred.op
+            if op == "∋":
+                op = "="  # per-element entries, equality lookup
+            elif op in ("⊇", "containsany", "∈"):
+                op = "in"
+            path, valexpr = lp, pred.rhs
+        elif rp is not None and lp is None:
+            if pred.op == "∈":
+                path, op, valexpr = rp, "=", pred.lhs
+            else:
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+                path, op, valexpr = rp, flip.get(pred.op, pred.op), pred.lhs
+        if path is None or path == "id":
+            continue
+        if op in ("=", "=="):
+            eqs.setdefault(path, valexpr)
+        elif op == "in":
+            ins.setdefault(path, valexpr)
+        else:
+            rngs.setdefault(path, []).append((op, valexpr))
+    return eqs, ins, rngs
+
+
+def _choose_index(indexes, eqs, ins, rngs):
+    """Pick the index matching the longest run of leading columns; returns
+    (idef, nmatch, tail) or None."""
+    best = None
+    for idef in indexes:
+        if idef.hnsw is not None or idef.fulltext is not None or idef.count:
+            continue
+        cols = idef.cols_str
+        if not cols:
+            continue
+        nmatch = 0
+        tail = None  # ('range', [(op, vx)]) | ('in', vx)
+        for i, col in enumerate(cols):
+            if col in eqs:
+                nmatch += 1
+                continue
+            if i == nmatch and col in rngs:
+                tail = ("range", rngs[col])
+            elif i == nmatch and col in ins:
+                tail = ("in", ins[col])
+            break
+        if nmatch == 0 and tail is None:
+            continue
+        score = nmatch * 2 + (1 if tail else 0)
+        if best is None or score > best[0]:
+            best = (score, idef, nmatch, tail)
+    if best is None:
+        return None
+    return best[1], best[2], best[3]
+
+
 def plan_scan(tb: str, cond, ctx, stmt):
     """Return a Source generator when an index path applies, else None."""
     if cond is None:
@@ -111,42 +184,101 @@ def plan_scan(tb: str, cond, ctx, stmt):
 
         return plan_matches(tb, cond, mt, indexes, ctx, stmt)
 
-    # ---- equality / range on an indexed column ----------------------------
-    preds = []
-    _split_ands(cond, preds)
-    for pred in preds:
-        if not isinstance(pred, Binary):
-            continue
-        path = op = valexpr = None
-        if pred.op in ("=", "==", "∈", "<", "<=", ">", ">=", "∋", "⊇",
-                       "containsany"):
-            lp = _field_path(pred.lhs)
-            rp = _field_path(pred.rhs)
-            if lp is not None and rp is None:
-                # field CONTAINS v  -> per-element entries, equality lookup
-                op = {"∋": "="}.get(pred.op, pred.op)
-                if pred.op in ("⊇", "containsany"):
-                    op = "∈"  # lookup each element of the rhs array
-                path, valexpr = lp, pred.rhs
-            elif rp is not None and lp is None:
-                if pred.op == "∈":
-                    # v INSIDE field -> same as field CONTAINS v
-                    path, op, valexpr = rp, "=", pred.lhs
-                else:
-                    flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
-                    path, op, valexpr = rp, flip.get(pred.op, pred.op), pred.lhs
-        if path is None or path == "id":
-            continue
-        for idef in indexes:
-            if idef.hnsw is not None or idef.fulltext is not None or idef.count:
-                continue
-            if not idef.cols_str or idef.cols_str[0] != path:
-                continue
-            if len(idef.cols_str) > 1 and op != "=":
-                continue
-            v = evaluate(valexpr, ctx)
-            return _index_lookup(tb, idef, op, v, cond, ctx)
-    return None
+    # ---- equality / range / contains on indexed columns --------------------
+    eqs, ins, rngs = _classify_preds(cond)
+    if not eqs and not rngs and not ins:
+        return None
+    chosen = _choose_index(indexes, eqs, ins, rngs)
+    if chosen is None:
+        return None
+    idef, nmatch, tail = chosen
+    eq_vals = [evaluate(eqs[c], ctx) for c in idef.cols_str[:nmatch]]
+    return _index_scan(tb, idef, eq_vals, tail, ctx)
+
+
+def _index_scan(tb, idef, eq_vals, tail, ctx):
+    """Scan an index: equality prefix on leading columns, then an optional
+    range / IN-list on the next column."""
+    from surrealdb_tpu.exec.eval import evaluate, fetch_record
+    from surrealdb_tpu.exec.statements import Source
+
+    ns, db = ctx.need_ns_db()
+    seen = set()
+    unique = idef.unique
+    base = (
+        K.index_unique_prefix(ns, db, tb, idef.name)
+        if unique
+        else K.index_prefix(ns, db, tb, idef.name)
+    )
+
+    def _fetch(rid):
+        h = hashable(rid)
+        if h in seen:
+            return None
+        seen.add(h)
+        doc = fetch_record(ctx, rid)
+        if doc is NONE:
+            return None
+        return Source(rid=rid, doc=doc)
+
+    def _emit_range(beg, end):
+        if unique:
+            for _k, rid in ctx.txn.scan_vals(beg, end):
+                s = _fetch(rid)
+                if s:
+                    yield s
+        else:
+            ncols = len(idef.cols_str)
+            for k in ctx.txn.keys(beg, end):
+                _fields, idv = K.decode_index(k, ns, db, tb, idef.name, ncols)
+                s = _fetch(RecordId(tb, idv))
+                if s:
+                    yield s
+
+    def gen():
+        prefix = base + K.index_fields_enc(eq_vals)
+        if tail is None:
+            if len(eq_vals) == len(idef.cols_str) and unique:
+                rid = ctx.txn.get_val(
+                    K.index_unique(ns, db, tb, idef.name, eq_vals)
+                )
+                if rid is not None:
+                    s = _fetch(rid)
+                    if s:
+                        yield s
+                return
+            yield from _emit_range(*K.prefix_range(prefix))
+            return
+        kind, payload = tail
+        if kind == "in":
+            vals = evaluate(payload, ctx)
+            if not isinstance(vals, list):
+                vals = [vals]
+            for v in vals:
+                pre = prefix + K.enc_value(v)
+                yield from _emit_range(*K.prefix_range(pre))
+            return
+        # range bounds on the next column
+        lo = hi = None
+        lo_incl = hi_incl = True
+        for op, vx in payload:
+            v = evaluate(vx, ctx)
+            if op in (">", ">="):
+                lo, lo_incl = v, op == ">="
+            else:
+                hi, hi_incl = v, op == "<="
+        beg, end = K.prefix_range(prefix)
+        if lo is not None:
+            beg = prefix + K.enc_value(lo)
+            if not lo_incl:
+                beg += b"\xff"
+        if hi is not None:
+            end = prefix + K.enc_value(hi)
+            if hi_incl:
+                end += b"\xff"
+        yield from _emit_range(beg, end)
+
+    return gen()
 
 
 def _plan_knn(tb, cond, knn: Knn, indexes, ctx, stmt):
@@ -274,102 +406,16 @@ def _brute_knn(tb, knn: Knn, qv, rest, ctx):
     return [(rows[int(ii)], float(d[ii])) for ii in idx]
 
 
-def _index_lookup(tb, idef, op, v, cond, ctx):
-    from surrealdb_tpu.exec.eval import fetch_record
-    from surrealdb_tpu.exec.statements import Source
-    from surrealdb_tpu.kvs.api import deserialize
-
-    ns, db = ctx.need_ns_db()
-    seen = set()
-
-    def _fetch(rid):
-        h = hashable(rid)
-        if h in seen:
-            return None
-        seen.add(h)
-        doc = fetch_record(ctx, rid)
-        if doc is NONE:
-            return None
-        return Source(rid=rid, doc=doc)
-
-    def gen():
-        if idef.unique:
-            if op in ("=", "=="):
-                rid = ctx.txn.get_val(K.index_unique(ns, db, tb, idef.name, [v]))
-                if rid is not None:
-                    s = _fetch(rid)
-                    if s:
-                        yield s
-            elif op == "∈" and isinstance(v, list):
-                for x in v:
-                    rid = ctx.txn.get_val(
-                        K.index_unique(ns, db, tb, idef.name, [x])
-                    )
-                    if rid is not None:
-                        s = _fetch(rid)
-                        if s:
-                            yield s
-            else:
-                # range over unique index entries
-                yield from _range_scan_unique()
-            return
-        if op in ("=", "=="):
-            pre = K.index_prefix(ns, db, tb, idef.name) + K.enc_value([v])
-            for k in ctx.txn.keys(*K.prefix_range(pre)):
-                _fields, idv = K.decode_index(k, ns, db, tb, idef.name)
-                s = _fetch(RecordId(tb, idv))
-                if s:
-                    yield s
-        elif op == "∈" and isinstance(v, list):
-            for x in v:
-                pre = K.index_prefix(ns, db, tb, idef.name) + K.enc_value([x])
-                for k in ctx.txn.keys(*K.prefix_range(pre)):
-                    _fields, idv = K.decode_index(k, ns, db, tb, idef.name)
-                    s = _fetch(RecordId(tb, idv))
-                    if s:
-                        yield s
-        else:
-            yield from _range_scan()
-
-    def _range_bounds(make_key, tag_open, tag_close):
-        base = make_key
-        if op in (">", ">="):
-            beg = base + K.enc_value([v])
-            if op == ">":
-                beg += b"\xff"
-            end = base + b"\xff\xff\xff\xff\xff\xff\xff\xff"
-        else:
-            beg = base
-            end = base + K.enc_value([v])
-            if op == "<=":
-                end += b"\xff"
-        return beg, end
-
-    def _range_scan():
-        base = K.index_prefix(ns, db, tb, idef.name)
-        beg, end = _range_bounds(base, None, None)
-        for k in ctx.txn.keys(beg, end):
-            _fields, idv = K.decode_index(k, ns, db, tb, idef.name)
-            s = _fetch(RecordId(tb, idv))
-            if s:
-                yield s
-
-    def _range_scan_unique():
-        base = K.index_unique_prefix(ns, db, tb, idef.name)
-        beg, end = _range_bounds(base, None, None)
-        for k, rid in ctx.txn.scan_vals(beg, end):
-            s = _fetch(rid)
-            if s:
-                yield s
-
-    return gen()
-
-
 def explain_plan(tb, cond, ctx, stmt):
     """EXPLAIN output (reference dbs/plan.rs Explanation)."""
+    with_index = getattr(stmt, "with_index", None) if stmt is not None else None
+    if with_index == []:
+        cond = None  # WITH NOINDEX: always a table scan
     if cond is not None:
         knn = _find_knn(cond)
         indexes = get_indexes_for(tb, ctx)
+        if with_index:
+            indexes = [i for i in indexes if i.name in with_index]
         if knn is not None:
             path = _field_path(knn.lhs)
             for idef in indexes:
@@ -386,7 +432,7 @@ def explain_plan(tb, cond, ctx, stmt):
                         "operation": "Iterate Index",
                     }
             return {
-                "detail": {"table": tb},
+                "detail": {"direction": "forward", "table": tb},
                 "operation": "Iterate Table",
             }
         mt = _find_matches(cond)
@@ -400,39 +446,38 @@ def explain_plan(tb, cond, ctx, stmt):
                         },
                         "operation": "Iterate Index",
                     }
-        preds = []
-        _split_ands(cond, preds)
-        for pred in preds:
-            if isinstance(pred, Binary) and pred.op in (
-                "=", "==", "∈", "∋", "<", "<=", ">", ">="
-            ):
-                lp = _field_path(pred.lhs)
-                rp = _field_path(pred.rhs)
-                path = lp or rp
-                valexpr = pred.rhs if lp else pred.lhs
-                op = pred.op
-                if op in ("∋",) or (op == "∈" and rp is not None):
-                    op = "="
-                elif op == "∈":
-                    op = "union"
-                for idef in indexes:
-                    if idef.cols_str and idef.cols_str[0] == path and \
-                            idef.hnsw is None and idef.fulltext is None:
-                        from surrealdb_tpu.exec.eval import evaluate
+        from surrealdb_tpu.exec.eval import evaluate
 
-                        try:
-                            val = evaluate(valexpr, ctx)
-                        except Exception:
-                            val = None
-                        return {
-                            "detail": {
-                                "plan": {
-                                    "index": idef.name,
-                                    "operator": op,
-                                    "value": val,
-                                },
-                                "table": tb,
-                            },
-                            "operation": "Iterate Index",
-                        }
-    return {"detail": {"table": tb}, "operation": "Iterate Table"}
+        eqs, ins, rngs = _classify_preds(cond)
+        best = None
+        chosen = _choose_index(indexes, eqs, ins, rngs)
+        if chosen is not None:
+            idef, nmatch, tail = chosen
+            vals = [evaluate(eqs[c], ctx) for c in idef.cols_str[:nmatch]]
+            op = "="
+            if tail is not None and tail[0] == "in":
+                op = "union"
+                vals = vals + [evaluate(tail[1], ctx)]
+            elif tail is not None:
+                op = {">": "MoreThan", ">=": "MoreThanOrEqual",
+                      "<": "LessThan", "<=": "LessThanOrEqual"}.get(
+                          tail[1][0][0], "range")
+                vals = vals + [evaluate(tail[1][0][1], ctx)]
+            value = vals[0] if len(vals) == 1 else vals
+            if op == "union" and len(vals) == 1:
+                value = vals[0]
+            return {
+                "detail": {
+                    "plan": {
+                        "index": idef.name,
+                        "operator": op,
+                        "value": value,
+                    },
+                    "table": tb,
+                },
+                "operation": "Iterate Index",
+            }
+    return {
+        "detail": {"direction": "forward", "table": tb},
+        "operation": "Iterate Table",
+    }

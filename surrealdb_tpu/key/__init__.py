@@ -33,6 +33,7 @@ from surrealdb_tpu.val import (
     Geometry,
     RecordId,
     Range,
+    SSet,
     Table,
     Uuid,
 )
@@ -132,12 +133,13 @@ TAG_DURATION = 0x07
 TAG_DATETIME = 0x08
 TAG_UUID = 0x09
 TAG_ARRAY = 0x0A
-TAG_OBJECT = 0x0B
-TAG_GEOMETRY = 0x0C
-TAG_BYTES = 0x0D
-TAG_TABLE = 0x0E
-TAG_RECORDID = 0x0F
-TAG_RANGE = 0x10
+TAG_SET = 0x0B
+TAG_OBJECT = 0x0C
+TAG_GEOMETRY = 0x0D
+TAG_BYTES = 0x0E
+TAG_TABLE = 0x0F
+TAG_RECORDID = 0x10
+TAG_RANGE = 0x11
 TAG_END = 0x00  # array/object terminator (sorts before any element)
 
 
@@ -169,6 +171,12 @@ def enc_value(v) -> bytes:
         return (
             bytes([TAG_ARRAY])
             + b"".join(enc_value(x) for x in v)
+            + bytes([TAG_END])
+        )
+    if isinstance(v, SSet):
+        return (
+            bytes([TAG_SET])
+            + b"".join(enc_value(x) for x in v.items)
             + bytes([TAG_END])
         )
     if isinstance(v, dict):
@@ -234,6 +242,12 @@ def dec_value(buf: bytes, pos: int = 0):
             v, pos = dec_value(buf, pos)
             out.append(v)
         return out, pos + 1
+    if tag == TAG_SET:
+        out = []
+        while buf[pos] != TAG_END:
+            v, pos = dec_value(buf, pos)
+            out.append(v)
+        return SSet(out), pos + 1
     if tag == TAG_OBJECT:
         out = {}
         while buf[pos] != TAG_END:
@@ -346,9 +360,16 @@ def decode_graph(key: bytes):
 # --- index entries ---------------------------------------------------------
 
 
+def index_fields_enc(fields: list) -> bytes:
+    """Concatenated per-column encodings — prefixes of this encoding are
+    valid scan prefixes, which is what makes composite-index lookups
+    (equality on leading columns + range on the next) plain range scans."""
+    return b"".join(enc_value(f) for f in fields)
+
+
 def index(ns, db, tb, ix: str, fields: list, id=None) -> bytes:
     """Non-unique index entry: fields then record id (id=None for prefix)."""
-    k = _tb(ns, db, tb) + b"+" + enc_str(ix) + enc_value(fields)
+    k = _tb(ns, db, tb) + b"+" + enc_str(ix) + index_fields_enc(fields)
     if id is not None:
         k += enc_value(id)
     return k
@@ -356,7 +377,7 @@ def index(ns, db, tb, ix: str, fields: list, id=None) -> bytes:
 
 def index_unique(ns, db, tb, ix: str, fields: list) -> bytes:
     """Unique index entry key (value holds the record id)."""
-    return _tb(ns, db, tb) + b"!u" + enc_str(ix) + enc_value(fields)
+    return _tb(ns, db, tb) + b"!u" + enc_str(ix) + index_fields_enc(fields)
 
 
 def index_prefix(ns, db, tb, ix: str) -> bytes:
@@ -367,10 +388,14 @@ def index_unique_prefix(ns, db, tb, ix: str) -> bytes:
     return _tb(ns, db, tb) + b"!u" + enc_str(ix)
 
 
-def decode_index(key: bytes, ns, db, tb, ix):
+def decode_index(key: bytes, ns, db, tb, ix, ncols: int = 1):
     """Decode (fields, id) from a non-unique index entry key."""
     pre = index_prefix(ns, db, tb, ix)
-    fields, pos = dec_value(key, len(pre))
+    pos = len(pre)
+    fields = []
+    for _ in range(ncols):
+        f, pos = dec_value(key, pos)
+        fields.append(f)
     idv, pos = dec_value(key, pos)
     return fields, idv
 

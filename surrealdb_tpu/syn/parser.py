@@ -16,7 +16,7 @@ _STMT_KEYWORDS = {
     "select", "create", "update", "upsert", "delete", "insert", "relate",
     "define", "remove", "info", "let", "return", "if", "for", "use", "live",
     "kill", "show", "rebuild", "alter", "option", "sleep", "begin", "commit",
-    "cancel", "break", "continue", "throw", "access",
+    "cancel", "break", "continue", "throw", "access", "explain",
 }
 
 _CONSTANTS = {
@@ -256,6 +256,20 @@ class Parser:
         return BlockExpr(stmts)
 
     # -- SELECT ---------------------------------------------------------------
+    def _stmt_explain(self):
+        """EXPLAIN [FULL|ANALYZE] SELECT ... — statement-prefix form."""
+        self.next()
+        mode = True
+        if self.eat_kw("full"):
+            mode = "full"
+        elif self.eat_kw("analyze"):
+            mode = "analyze"
+        if not self.at_kw("select"):
+            raise self.err("expected SELECT after EXPLAIN")
+        sel = self._stmt_select()
+        sel.explain = mode
+        return sel
+
     def _stmt_select(self):
         self.next()
         s = SelectStmt(exprs=[], what=[])
@@ -445,6 +459,8 @@ class Parser:
                 stmt.parallel = True
             elif hasattr(stmt, "version") and self.eat_kw("version"):
                 stmt.version = self.parse_expr()
+            elif hasattr(stmt, "explain") and self.eat_kw("explain"):
+                stmt.explain = "full" if self.eat_kw("full") else True
             else:
                 break
 
@@ -478,6 +494,7 @@ class Parser:
     def _stmt_delete(self):
         self.next()
         only = self.eat_kw("only")
+        self.eat_kw("from")
         what = self._targets()
         s = DeleteStmt(what, only=only)
         self._tail_clauses(s)
@@ -1308,9 +1325,9 @@ class Parser:
         return d
 
     # -- kinds ---------------------------------------------------------------
-    def parse_kind(self) -> Kind:
+    def parse_kind(self, no_union: bool = False) -> Kind:
         kinds = [self._single_kind()]
-        while self.eat_op("|"):
+        while not no_union and self.eat_op("|"):
             kinds.append(self._single_kind())
         if len(kinds) == 1:
             return kinds[0]
@@ -1545,7 +1562,13 @@ class Parser:
             if kind.name == "future":
                 body = self._parse_block()
                 return FunctionCall("__future__", [BlockExpr(body.stmts)])
-            return Cast(kind, self._parse_unary())
+            operand = self._parse_unary()
+            # a trailing range glues into the cast operand: <array> 0..1000
+            if self.at_op("..", "..="):
+                incl = self.next().text == "..="
+                end = self._parse_additive() if self._at_expr_start() else None
+                operand = RangeExpr(operand, end, True, incl)
+            return Cast(kind, operand)
         return self._parse_postfix(self._parse_primary())
 
     # -- postfix idiom parts ---------------------------------------------------
@@ -1802,7 +1825,7 @@ class Parser:
         if t.kind == L.IDENT and t.value.lower() in (
             "select", "create", "update", "upsert", "delete", "insert",
             "relate", "define", "remove", "if", "return", "live", "info",
-            "let", "rebuild", "alter", "show",
+            "let", "rebuild", "alter", "show", "explain",
         ):
             stmt = self.parse_stmt()
             self.expect_op(")")
@@ -1818,13 +1841,19 @@ class Parser:
         return Subquery(e) if _is_stmt(e) else e
 
     def _parse_object_or_block_expr(self):
-        # decide: object literal vs block
+        # decide: object literal vs set literal vs block
         j = self.i + 1
         t1 = self.toks[j] if j < len(self.toks) else None
         if t1 is not None and t1.kind == L.OP and t1.text == "}":
             self.next()
             self.next()
             return ObjectExpr([])
+        if t1 is not None and t1.kind == L.OP and t1.text == ",":
+            # `{,}` — the empty set literal
+            self.next()
+            self.next()
+            self.expect_op("}")
+            return SetExpr([])
         if t1 is not None and t1.kind in (L.IDENT, L.STRING, L.INT):
             t2 = self.toks[j + 1] if j + 1 < len(self.toks) else None
             if t2 is not None and t2.kind == L.OP and t2.text == ":":
@@ -1832,6 +1861,23 @@ class Parser:
                 # object key is followed by ':' then expr; a record literal in
                 # block position is rare — prefer object.
                 return self._parse_object()
+        # try a set literal: `{ expr, ... }` (single expr without a trailing
+        # comma is a block); rewind to block parsing on failure
+        save = self.i
+        try:
+            self.next()  # '{'
+            first = self.parse_expr()
+            if self.at_op(","):
+                items = [first]
+                while self.eat_op(","):
+                    if self.at_op("}"):
+                        break
+                    items.append(self.parse_expr())
+                self.expect_op("}")
+                return SetExpr(items)
+        except ParseError:
+            pass
+        self.i = save
         return Subquery(self._parse_block())
 
     def _parse_object(self):
@@ -1880,7 +1926,9 @@ class Parser:
             kind = None
             if self.at_op(":"):
                 self.next()
-                kind = self.parse_kind()
+                # `|` terminates the param list, so kinds can't take unions
+                # here (parenthesised kinds would, if needed)
+                kind = self.parse_kind(no_union=True)
             params.append((t.value, kind))
             if not self.eat_op(","):
                 break
@@ -1922,7 +1970,7 @@ class Parser:
         # statements in expression position: RETURN CREATE ..., LET $x = SELECT ...
         if low in ("select", "create", "update", "upsert", "delete", "insert",
                    "relate", "define", "remove", "rebuild", "info", "live",
-                   "kill", "alter", "show") and self._stmt_follows(low):
+                   "kill", "alter", "show", "explain") and self._stmt_follows(low):
             self.i -= 1
             return Subquery(self.parse_stmt())
         # function path  foo::bar(...)

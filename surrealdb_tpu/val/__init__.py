@@ -341,6 +341,59 @@ class Range:
         return range(beg, end)
 
 
+class SSet:
+    """A set value: unique elements in sorted order (reference val/set.rs
+    wraps a BTreeSet). Renders `{1, 2, 3}`; empty renders `{,}`."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items=None):
+        out = []
+        for x in items or []:
+            lo, hi = 0, len(out)
+            # binary insert by value order, skipping duplicates
+            while lo < hi:
+                mid = (lo + hi) // 2
+                c = value_cmp(out[mid], x)
+                if c < 0:
+                    lo = mid + 1
+                elif c > 0:
+                    hi = mid
+                else:
+                    lo = -1
+                    break
+            if lo >= 0:
+                out.insert(lo, x)
+        self.items = out
+
+    def __iter__(self):
+        return iter(self.items)
+
+    def __len__(self):
+        return len(self.items)
+
+    def __contains__(self, v):
+        return any(value_eq(x, v) for x in self.items)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, SSet)
+            and len(self.items) == len(other.items)
+            and all(value_eq(a, b) for a, b in zip(self.items, other.items))
+        )
+
+    def __hash__(self):
+        return hash(("SSet", tuple(_hashable(x) for x in self.items)))
+
+    def __repr__(self):
+        return f"SSet({self.items!r})"
+
+    def render(self) -> str:
+        if not self.items:
+            return "{,}"
+        return "{" + ", ".join(render(x) for x in self.items) + "}"
+
+
 class Geometry:
     """GeoJSON-style geometry. kind in {Point, LineString, Polygon, MultiPoint,
     MultiLineString, MultiPolygon, GeometryCollection}; coords nested tuples."""
@@ -472,25 +525,27 @@ def type_rank(v) -> int:
         return 7
     if isinstance(v, list):
         return 8
-    if isinstance(v, dict):
+    if isinstance(v, SSet):
         return 9
-    if isinstance(v, Geometry):
+    if isinstance(v, dict):
         return 10
-    if isinstance(v, (bytes, bytearray)):
+    if isinstance(v, Geometry):
         return 11
-    if isinstance(v, Table):
+    if isinstance(v, (bytes, bytearray)):
         return 12
-    if isinstance(v, RecordId):
+    if isinstance(v, Table):
         return 13
-    if isinstance(v, File):
+    if isinstance(v, RecordId):
         return 14
-    if isinstance(v, Regex):
+    if isinstance(v, File):
         return 15
-    if isinstance(v, Range):
+    if isinstance(v, Regex):
         return 16
-    if isinstance(v, Closure):
+    if isinstance(v, Range):
         return 17
-    return 18
+    if isinstance(v, Closure):
+        return 18
+    return 19
 
 
 def _num_cmp(a, b) -> int:
@@ -537,6 +592,12 @@ def value_cmp(a, b) -> int:
                 return c
         return (len(a) > len(b)) - (len(a) < len(b))
     if ra == 9:
+        for x, y in zip(a.items, b.items):
+            c = value_cmp(x, y)
+            if c:
+                return c
+        return (len(a) > len(b)) - (len(a) < len(b))
+    if ra == 10:
         ka, kb = sorted(a.keys()), sorted(b.keys())
         for x, y in zip(ka, kb):
             if x != y:
@@ -545,23 +606,23 @@ def value_cmp(a, b) -> int:
             if c:
                 return c
         return (len(ka) > len(kb)) - (len(ka) < len(kb))
-    if ra == 10:
+    if ra == 11:
         sa, sb = a.render(), b.render()
         return (sa > sb) - (sa < sb)
-    if ra == 11:
-        return (bytes(a) > bytes(b)) - (bytes(a) < bytes(b))
     if ra == 12:
-        return (a.name > b.name) - (a.name < b.name)
+        return (bytes(a) > bytes(b)) - (bytes(a) < bytes(b))
     if ra == 13:
+        return (a.name > b.name) - (a.name < b.name)
+    if ra == 14:
         if a.tb != b.tb:
             return -1 if a.tb < b.tb else 1
         return record_id_key_cmp(a.id, b.id)
-    if ra == 14:
+    if ra == 15:
         ka, kb = (a.bucket, a.key), (b.bucket, b.key)
         return (ka > kb) - (ka < kb)
-    if ra == 15:
-        return (a.pattern > b.pattern) - (a.pattern < b.pattern)
     if ra == 16:
+        return (a.pattern > b.pattern) - (a.pattern < b.pattern)
+    if ra == 17:
         c = value_cmp(a.beg, b.beg)
         if c:
             return c
@@ -623,6 +684,8 @@ def sort_key(v) -> "_SortKey":
 def _hashable(v):
     if isinstance(v, list):
         return tuple(_hashable(x) for x in v)
+    if isinstance(v, SSet):
+        return ("SSet", tuple(_hashable(x) for x in v.items))
     if isinstance(v, dict):
         return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
     if isinstance(v, (bytearray,)):
@@ -649,7 +712,7 @@ def is_truthy(v) -> bool:
         return v != 0
     if isinstance(v, str):
         return len(v) > 0
-    if isinstance(v, (list, dict)):
+    if isinstance(v, (list, dict, SSet)):
         return len(v) > 0
     if isinstance(v, Duration):
         return v.ns != 0
@@ -729,6 +792,8 @@ def render(v, pretty: bool = False, _depth: int = 0) -> str:
     if isinstance(v, list):
         inner = ", ".join(render(x, pretty, _depth + 1) for x in v)
         return f"[{inner}]"
+    if isinstance(v, SSet):
+        return v.render()
     if isinstance(v, dict):
         if not v:
             return "{  }"
@@ -773,6 +838,8 @@ def to_json(v):
         return str(v.u)
     if isinstance(v, list):
         return [to_json(x) for x in v]
+    if isinstance(v, SSet):
+        return [to_json(x) for x in v.items]
     if isinstance(v, dict):
         return {k: to_json(x) for k, x in v.items()}
     if isinstance(v, Geometry):
@@ -796,6 +863,10 @@ def copy_value(v):
     """Deep copy of a value (records are mutated in the doc pipeline)."""
     if isinstance(v, list):
         return [copy_value(x) for x in v]
+    if isinstance(v, SSet):
+        s = SSet.__new__(SSet)
+        s.items = [copy_value(x) for x in v.items]
+        return s
     if isinstance(v, dict):
         return {k: copy_value(x) for k, x in v.items()}
     return v

@@ -131,16 +131,25 @@ def run_lang_test(t: LangTest, ds=None):
                 return False, f"stmt {i}: expected parsing error, got {got!r}"
             continue
         if "match" in want:
-            # regex match against the rendered result
-            from surrealdb_tpu.val import render
+            # a SurrealQL expression evaluated with $result bound
+            from surrealdb_tpu.val import is_truthy, render
 
             if got.error is not None:
                 return False, f"stmt {i}: error: {got.error}"
-            rendered = render(got.result)
-            if not re.search(want["match"], rendered):
+            try:
+                mres = ds.execute(
+                    f"RETURN {want['match']}",
+                    ns=t.ns,
+                    db=t.db,
+                    vars={"result": got.result},
+                )[0]
+                ok_match = mres.ok and is_truthy(mres.result)
+            except Exception as e:
+                return False, f"stmt {i}: match eval error: {e}"
+            if not ok_match:
                 return False, (
-                    f"stmt {i}: match failed:\n  pattern: {want['match']}\n"
-                    f"  got: {rendered}"
+                    f"stmt {i}: match failed:\n  expr: {want['match']}\n"
+                    f"  got: {render(got.result)}"
                 )
             continue
         if "skip" in want and want["skip"]:
