@@ -562,6 +562,132 @@ def index_update(rid: RecordId, before, after, ctx: Ctx):
                 )
 
 
+def _ref_targets(fd, doc, ctx, rid):
+    """RecordIds held by a REFERENCE field (arrays/sets flatten)."""
+    if not isinstance(doc, dict):
+        return []
+    c = ctx.with_doc(doc, rid)
+    from surrealdb_tpu.exec.eval import walk
+
+    v = walk(doc, [p for p in fd.name], c)
+    out = []
+
+    def _collect(x):
+        if isinstance(x, RecordId):
+            out.append(x)
+        elif isinstance(x, (list,)):
+            for y in x:
+                _collect(y)
+        else:
+            from surrealdb_tpu.val import SSet
+
+            if isinstance(x, SSet):
+                for y in x.items:
+                    _collect(y)
+
+    _collect(v)
+    return out
+
+
+def refs_update(rid: RecordId, before, after, ctx: Ctx):
+    """Maintain `&` reference keys for REFERENCE-marked fields."""
+    ns, db = ctx.need_ns_db()
+    for fd in get_fields(rid.tb, ctx):
+        if fd.reference is None:
+            continue
+        old = _ref_targets(fd, before, ctx, rid) if isinstance(before, dict) else []
+        new = _ref_targets(fd, after, ctx, rid) if isinstance(after, dict) else []
+        oldk = {(t.tb, K.enc_value(t.id)): t for t in old}
+        newk = {(t.tb, K.enc_value(t.id)): t for t in new}
+        for hk, t in oldk.items():
+            if hk not in newk:
+                ctx.txn.delete(
+                    K.ref(ns, db, t.tb, t.id, rid.tb, fd.name_str, rid.id)
+                )
+        for hk, t in newk.items():
+            if hk not in oldk:
+                ctx.txn.set(
+                    K.ref(ns, db, t.tb, t.id, rid.tb, fd.name_str, rid.id),
+                    b"",
+                )
+
+
+def apply_ref_on_delete(rid: RecordId, ctx: Ctx):
+    """When deleting a referenced record, apply each referencing field's
+    ON DELETE action (reference doc reference semantics). Ref keys are
+    dropped before any recursive delete so cyclic cascades terminate."""
+    ns, db = ctx.need_ns_db()
+    deleting = ctx.record_cache.setdefault("__deleting__", set())
+    me = (rid.tb, K.enc_value(rid.id))
+    if me in deleting:
+        return
+    deleting.add(me)
+    beg, end = K.prefix_range(K.ref_prefix(ns, db, rid.tb, rid.id))
+    entries = []
+    for k in list(ctx.txn.keys(beg, end)):
+        _n, _d, _t, _i, ft, ff, fk = K.decode_ref(k)
+        fdef = next(
+            (
+                fd
+                for fd in get_fields(ft, ctx)
+                if fd.reference is not None and fd.name_str == ff
+            ),
+            None,
+        )
+        entries.append((ft, ff, RecordId(ft, fk), k, fdef))
+    # REJECT wins before any mutation happens
+    for ft, ff, fk, k, fdef in entries:
+        action = (fdef.reference or {}).get("on_delete", "ignore") if fdef else "ignore"
+        if action == "reject":
+            raise SdbError(
+                f"Cannot delete `{rid.render()}` as it is referenced by "
+                f"`{fk.render()}` with an ON DELETE REJECT clause"
+            )
+    for ft, ff, fk, k, fdef in entries:
+        ctx.txn.delete(k)  # drop the ref key first: breaks cascade cycles
+        if fdef is None:
+            continue
+        action = (fdef.reference or {}).get("on_delete", "ignore")
+        fk_key = (fk.tb, K.enc_value(fk.id))
+        if fk_key in deleting:
+            continue
+        ctx.record_cache.pop(fk_key, None)
+        doc = fetch_record(ctx, fk)
+        if doc is NONE:
+            continue
+        if action == "cascade":
+            delete_one(fk, doc, OutputClause("none"), ctx)
+        elif action == "unset":
+            from surrealdb_tpu.val import SSet
+
+            cur = doc.get(ff, NONE)
+            nd = copy_value(doc)
+
+            def _not_me(x):
+                return not (
+                    isinstance(x, RecordId)
+                    and x.tb == rid.tb
+                    and value_eq(x.id, rid.id)
+                )
+
+            if isinstance(cur, list):
+                nd[ff] = [x for x in cur if _not_me(x)]
+            elif isinstance(cur, SSet):
+                nd[ff] = SSet([x for x in cur.items if _not_me(x)])
+            else:
+                nd.pop(ff, None)
+            _store_record(fk, doc, nd, ctx, "UPDATE", OutputClause("none"))
+        elif action == "then":
+            from surrealdb_tpu.exec.statements import eval_statement
+
+            c = ctx.with_doc(doc, fk)
+            c.vars["reference"] = rid
+            c.vars["this"] = fk
+            then = (fdef.reference or {}).get("then")
+            if then is not None:
+                eval_statement(then, c)
+
+
 def build_index(idef, ctx: Ctx):
     """Index an existing table's records (DEFINE INDEX on populated table)."""
     ns, db = ctx.need_ns_db()
@@ -850,6 +976,8 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
         ctx.record_cache[(rid.tb, K.enc_value(rid.id))] = after
     # indexes
     index_update(rid, before, after, ctx)
+    # record references (REFERENCE fields)
+    refs_update(rid, before, after, ctx)
     # changefeed
     write_changefeed(rid, before, after, action, ctx)
     # events
@@ -1061,6 +1189,8 @@ def delete_one(rid: RecordId, before, output, ctx: Ctx):
             raise SdbError(
                 f"Not enough permissions to perform this action on table '{rid.tb}'"
             )
+    # referenced-record ON DELETE actions run before the record vanishes
+    apply_ref_on_delete(rid, ctx)
     ctx.txn.delete(K.record(ns, db, rid.tb, rid.id))
     ctx.record_cache.pop((rid.tb, K.enc_value(rid.id)), None)
     # purge graph edges; cascade delete edge records hanging off this node
@@ -1076,6 +1206,7 @@ def delete_one(rid: RecordId, before, output, ctx: Ctx):
             if isinstance(edoc, dict) and isinstance(edoc.get("in"), RecordId):
                 delete_one(erid, edoc, OutputClause("none"), ctx)
     index_update(rid, before, NONE, ctx)
+    refs_update(rid, before, NONE, ctx)
     write_changefeed(rid, before, NONE, "DELETE", ctx)
     run_events(rid, before, NONE, "DELETE", ctx)
     notify_lives(rid, before, NONE, "DELETE", ctx)

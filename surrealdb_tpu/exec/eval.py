@@ -608,24 +608,48 @@ def _apply_method(val, part, ctx):
 
 
 def _apply_graph(val, g: PGraph, ctx: Ctx):
-    """One graph hop: scan `~` keys of each source record (SURVEY §3.4)."""
+    """One graph hop: scan `~` (or `&` reference) keys of each source record
+    (SURVEY §3.4); `->(SELECT ...)` lookups run the select over the hop's
+    destinations."""
     rids = _collect_rids(val, ctx)
     if not rids:
         return []
     from surrealdb_tpu.graph import traverse_hop
 
-    results = traverse_hop(rids, g, ctx)
     if g.expr is not None:
-        # ->(SELECT ... ) projection step
+        # ->(SELECT ... [FIELD f] [clauses]) — the select's FROM names the
+        # destination tables; FIELD restricts reference lookups
         from surrealdb_tpu.exec import statements as st
 
-        sub = g.expr
-        out = []
-        for rid in results:
+        sel = g.expr
+        tables = []
+        for w in getattr(sel, "what", []):
+            if isinstance(w, RecordIdLit):
+                tables.append((w.tb, w))
+                continue
+            tv = st._target_value(w, ctx)
+            if isinstance(tv, Table):
+                tables.append((tv.name, None))
+            elif isinstance(tv, str):
+                tables.append((tv, None))
+            elif isinstance(tv, RecordId):
+                from surrealdb_tpu.expr.ast import Literal as _Lit
+
+                tables.append((tv.tb, _Lit(tv)))
+            else:
+                raise SdbError(
+                    f"Cannot use {render(tv)} as a lookup target"
+                )
+        sub_g = PGraph(g.dir, tables, None)
+        dests = traverse_hop(rids, sub_g, ctx, ref_field=sel.ref_field)
+        sources = []
+        for rid in dests:
             doc = fetch_record(ctx, rid)
-            c = ctx.with_doc(doc, rid)
-            out.append(doc)
-        return results
+            if doc is NONE:
+                continue
+            sources.append(st.Source(rid=rid, doc=doc))
+        return st.select_over_sources(sel, sources, ctx)
+    results = traverse_hop(rids, g, ctx)
     return results
 
 

@@ -328,7 +328,7 @@ def expr_name(expr) -> str:
             elif isinstance(p, PLast):
                 out.append("[$]")
             elif isinstance(p, PGraph):
-                arrow = {"out": "->", "in": "<-", "both": "<->"}[p.dir]
+                arrow = {"out": "->", "in": "<-", "both": "<->", "ref": "<~"}[p.dir]
                 names = ", ".join(w[0] for w in p.what) if p.what else "?"
                 if len(p.what) == 1:
                     out.append(f"{arrow}{names}")
@@ -387,6 +387,26 @@ def _s_select(n: SelectStmt, ctx: Ctx):
             if not check_table_permission(src.rid.tb, "select", c, src.doc, src.rid):
                 continue
         rows.append(src)
+    return _select_pipeline(n, rows, c)
+
+
+def select_over_sources(n: SelectStmt, sources, ctx: Ctx):
+    """Run a SELECT over pre-resolved sources (graph/reference lookup
+    subqueries: `->(SELECT ...)` / `<~(SELECT ...)`)."""
+    c = ctx.child()
+    c._cond_consumed = False
+    rows = list(sources)
+    if not c.session.is_owner:
+        rows = [
+            src
+            for src in rows
+            if src.rid is None
+            or check_table_permission(src.rid.tb, "select", c, src.doc, src.rid)
+        ]
+    return _select_pipeline(n, rows, c)
+
+
+def _select_pipeline(n: SelectStmt, rows, c):
     # WHERE (if planner didn't consume it, re-filter — planner marks via attr)
     if n.cond is not None and not getattr(c, "_cond_consumed", False):
         kept = []
@@ -530,11 +550,67 @@ def _project(src: Source, n: SelectStmt, ctx: Ctx):
                     return copy_value(doc)
             continue
         v = evaluate(expr, c)
-        name = alias if alias else expr_name(expr)
-        _set_out_field(out, name, v)
+        if alias:
+            _set_out_field(out, alias, v)
+        else:
+            segs = _idiom_segments(expr)
+            if segs is not None:
+                _set_nested_out(out, segs, v)
+            else:
+                _set_out_field(out, expr_name(expr), v)
     if not n.exprs and not star:
         return copy_value(doc)
     return out
+
+
+def _idiom_segments(expr):
+    """Nesting segments for an unaliased idiom projection (reference
+    Value::set pluck semantics): field and graph parts nest; any other
+    trailing part attaches at the last segment. None = not an idiom."""
+    if not isinstance(expr, Idiom):
+        return None
+    segs = []
+    for p in expr.parts:
+        if isinstance(p, PField):
+            segs.append(p.name)
+        elif isinstance(p, PGraph):
+            arrow = {"out": "->", "in": "<-", "both": "<->", "ref": "<~"}[p.dir]
+            names = ", ".join(w[0] for w in p.what) if p.what else "?"
+            if len(p.what) == 1:
+                segs.append(f"{arrow}{names}")
+            else:
+                segs.append(f"{arrow}({names})")
+        else:
+            break
+    if not segs:
+        return None
+    return segs
+
+
+def _set_nested_out(out, segs: list, v):
+    """Set a value at a nested path; arrays distribute over their elements
+    (the computed value replaces whatever the deeper levels held)."""
+    cur = out
+    for i, s in enumerate(segs[:-1]):
+        if isinstance(cur, list):
+            for item in cur:
+                if isinstance(item, dict):
+                    _set_nested_out(item, segs[i:], v)
+            return
+        if not isinstance(cur, dict):
+            return
+        nxt = cur.get(s)
+        if not isinstance(nxt, (dict, list)):
+            nxt = {}
+            cur[s] = nxt
+        cur = nxt
+    if isinstance(cur, list):
+        for item in cur:
+            if isinstance(item, dict):
+                item[segs[-1]] = copy_value(v)
+        return
+    if isinstance(cur, dict):
+        cur[segs[-1]] = v
 
 
 def _set_out_field(out: dict, name: str, v):

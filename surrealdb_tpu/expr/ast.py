@@ -344,6 +344,7 @@ class SelectStmt(Node):
     parallel: bool = False
     tempfiles: bool = False
     explain: Optional[bool] = None  # True=EXPLAIN, 'full'=EXPLAIN FULL
+    ref_field: Optional[str] = None  # FIELD clause inside <~(SELECT ...)
 
 
 @dataclass

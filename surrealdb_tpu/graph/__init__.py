@@ -16,10 +16,64 @@ from surrealdb_tpu.val import NONE, RecordId, is_truthy
 TPU_FRONTIER_THRESHOLD = 512
 
 
-def traverse_hop(rids: list, g: PGraph, ctx) -> list:
+def _key_filter(what, ctx):
+    """Per-table key filters from lookup ranges: tb -> predicate(fk)."""
+    from surrealdb_tpu.exec.eval import evaluate
+    from surrealdb_tpu.exec.operators import contains
+    from surrealdb_tpu.val import Range as _Range, value_eq
+
+    filt = {}
+    for w in what or []:
+        if len(w) > 1 and w[1] is not None:
+            ridlit = evaluate(w[1], ctx)
+            key = ridlit.id if hasattr(ridlit, "id") else ridlit
+
+            def pred(fk, key=key):
+                if isinstance(key, _Range):
+                    return contains(key, fk)
+                return value_eq(fk, key)
+
+            filt[w[0]] = pred
+    return filt
+
+
+def traverse_hop(rids: list, g: PGraph, ctx, ref_field=None) -> list:
     """One graph hop from a set of source records; returns destination ids."""
     ns, db = ctx.need_ns_db()
     want = [w[0] for w in g.what] if g.what else None
+    kfilt = _key_filter(g.what, ctx)
+    if g.dir == "ref":
+        out = []
+        for rid in rids:
+            if want:
+                for ft in want:
+                    beg, end = K.prefix_range(
+                        K.ref_ft_prefix(ns, db, rid.tb, rid.id, ft)
+                    )
+                    for k in ctx.txn.keys(beg, end):
+                        _n, _d, _t, _i, ftb, ff, fk = K.decode_ref(k)
+                        if ref_field is not None and ff != ref_field:
+                            continue
+                        if ft in kfilt and not kfilt[ft](fk):
+                            continue
+                        out.append(RecordId(ftb, fk))
+            else:
+                beg, end = K.prefix_range(K.ref_prefix(ns, db, rid.tb, rid.id))
+                for k in ctx.txn.keys(beg, end):
+                    _n, _d, _t, _i, ftb, ff, fk = K.decode_ref(k)
+                    if ref_field is not None and ff != ref_field:
+                        continue
+                    out.append(RecordId(ftb, fk))
+        # dedupe (a record may reference via several fields)
+        seen = set()
+        uniq = []
+        for r in out:
+            h = (r.tb, K.enc_value(r.id))
+            if h not in seen:
+                seen.add(h)
+                uniq.append(r)
+        out = uniq
+        return _cond_filter(out, g, ctx)
     dirs = []
     if g.dir in ("out", "both"):
         dirs.append(K.DIR_OUT)
@@ -36,6 +90,8 @@ def traverse_hop(rids: list, g: PGraph, ctx) -> list:
                     beg, end = K.prefix_range(pre)
                     for k in ctx.txn.keys(beg, end):
                         _ns, _db, _tb, _id, _d, ftb, fk = K.decode_graph(k)
+                        if ft in kfilt and not kfilt[ft](fk):
+                            continue
                         dest = RecordId(ftb, fk)
                         out.append(dest)
             else:
@@ -44,17 +100,22 @@ def traverse_hop(rids: list, g: PGraph, ctx) -> list:
                 for k in ctx.txn.keys(beg, end):
                     _ns, _db, _tb, _id, _d, ftb, fk = K.decode_graph(k)
                     out.append(RecordId(ftb, fk))
-    if g.cond is not None:
-        from surrealdb_tpu.exec.eval import evaluate, fetch_record
+    return _cond_filter(out, g, ctx)
 
-        filtered = []
-        for dest in out:
-            doc = fetch_record(ctx, dest)
-            c = ctx.with_doc(doc, dest)
-            if is_truthy(evaluate(g.cond, c)):
-                filtered.append(dest)
-        out = filtered
-    return out
+
+def _cond_filter(out, g, ctx):
+    """Shared WHERE-on-hop filter for edge and reference traversals."""
+    if g.cond is None:
+        return out
+    from surrealdb_tpu.exec.eval import evaluate, fetch_record
+
+    filtered = []
+    for dest in out:
+        doc = fetch_record(ctx, dest)
+        c = ctx.with_doc(doc, dest)
+        if is_truthy(evaluate(g.cond, c)):
+            filtered.append(dest)
+    return filtered
 
 
 def purge_edges(rid: RecordId, ctx):

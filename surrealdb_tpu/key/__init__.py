@@ -357,6 +357,44 @@ def decode_graph(key: bytes):
     return ns, db, tb, idv, direction, ft, fk
 
 
+# --- record references (`&` keys: target -> referencing field) -------------
+
+
+def ref(ns, db, tb, id, ft: str, ff: str, fk) -> bytes:
+    """Reference key: record (tb,id) is referenced by (ft,fk) via field ff."""
+    return (
+        _tb(ns, db, tb)
+        + b"&"
+        + enc_value(id)
+        + enc_str(ft)
+        + enc_str(ff)
+        + enc_value(fk)
+    )
+
+
+def ref_prefix(ns, db, tb, id) -> bytes:
+    return _tb(ns, db, tb) + b"&" + enc_value(id)
+
+
+def ref_ft_prefix(ns, db, tb, id, ft: str) -> bytes:
+    return ref_prefix(ns, db, tb, id) + enc_str(ft)
+
+
+def decode_ref(key: bytes):
+    pos = 2
+    ns, pos = dec_str(key, pos)
+    pos += 1
+    db, pos = dec_str(key, pos)
+    pos += 1
+    tb, pos = dec_str(key, pos)
+    pos += 1  # '&'
+    idv, pos = dec_value(key, pos)
+    ft, pos = dec_str(key, pos)
+    ff, pos = dec_str(key, pos)
+    fk, pos = dec_value(key, pos)
+    return ns, db, tb, idv, ft, ff, fk
+
+
 # --- index entries ---------------------------------------------------------
 
 

@@ -26,7 +26,7 @@ EOF = "EOF"
 
 _PUNCT3 = ("..=", "...", "?:=")
 _PUNCT2 = (
-    "<|", "|>", "::", "->", "<-", "..", ">=", "<=", "==", "!=", "?=", "*=",
+    "<|", "|>", "::", "->", "<~", "<-", "..", ">=", "<=", "==", "!=", "?=", "*=",
     "!~", "?~", "*~", "&&", "||", "??", "?:", "**", "+=", "-=", "+?=", "@@",
     "?.",
 )

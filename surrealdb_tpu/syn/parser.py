@@ -329,6 +329,8 @@ class Parser:
                 s.start = self.parse_expr()
             elif self.eat_kw("fetch"):
                 s.fetch = self._idiom_list()
+            elif self.eat_kw("field"):
+                s.ref_field = self.ident()
             elif self.eat_kw("version"):
                 s.version = self.parse_expr()
             elif self.eat_kw("timeout"):
@@ -1585,6 +1587,9 @@ class Parser:
                 if self.at_op("{"):
                     parts.append(self._parse_destructure_or_recurse())
                     continue
+                if self.at_op("->", "<-", "<->", "<~") and not self.no_graph:
+                    parts.append(self._parse_graph_part(self.next().text))
+                    continue
                 if self.at_op("@"):
                     self.next()
                     parts.append(PField("@"))
@@ -1631,7 +1636,7 @@ class Parser:
                 self.next()
                 parts.append(PFlatten())
                 continue
-            if self.at_op("->", "<-", "<->") and not self.no_graph:
+            if self.at_op("->", "<-", "<->", "<~") and not self.no_graph:
                 parts.append(self._parse_graph_part(self.next().text))
                 continue
             break
@@ -1696,7 +1701,7 @@ class Parser:
         return PDestructure(fields)
 
     def _parse_graph_part(self, arrow):
-        direction = {"->": "out", "<-": "in", "<->": "both"}[arrow]
+        direction = {"->": "out", "<-": "in", "<->": "both", "<~": "ref"}[arrow]
         what = []
         cond = alias = None
         expr = None
@@ -1715,7 +1720,12 @@ class Parser:
                 if self.at_op("?"):
                     self.next()
                 else:
-                    what.append((self.ident_or_str(), None))
+                    name = self.ident_or_str()
+                    rng = None
+                    if self.at_op(":") and not self.peek().ws_before:
+                        self.next()
+                        rng = self._parse_record_id(name)
+                    what.append((name, rng))
                 if not self.eat_op(","):
                     break
             while True:
@@ -1727,7 +1737,12 @@ class Parser:
                     break
             self.expect_op(")")
         else:
-            what.append((self.ident_or_str(), None))
+            name = self.ident_or_str()
+            rng = None
+            if self.at_op(":") and not self.peek().ws_before:
+                self.next()
+                rng = self._parse_record_id(name)
+            what.append((name, rng))
         return PGraph(direction, what, cond, alias, expr)
 
     # -- primary ----------------------------------------------------------------
@@ -1779,7 +1794,7 @@ class Parser:
             if t.text == "*":
                 self.next()
                 return Idiom([PAll()])
-            if t.text in ("->", "<-", "<->"):
+            if t.text in ("->", "<-", "<->", "<~"):
                 arrow = self.next().text
                 return Idiom([self._parse_graph_part(arrow)])
             if t.text == "|":

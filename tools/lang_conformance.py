@@ -62,7 +62,8 @@ def main():
             st[1] += 1
             fail_list.append((rel, detail))
             if args.v:
-                print(f"FAIL {rel}\n  {detail}")
+                d = detail if len(detail) < 600 else detail[:600] + "…"
+                print(f"FAIL {rel}\n  {d}")
     total = passed + failed
     print(f"\n== conformance: {passed}/{total} "
           f"({100.0 * passed / max(total, 1):.1f}%) "
