@@ -32,6 +32,11 @@ def kind_name(kind: Kind) -> str:
         return " | ".join(kind_name(k) for k in kind.inner)
     if kind.name == "record" and kind.inner:
         return f"record<{' | '.join(kind.inner)}>"
+    if kind.name in ("table", "geometry") and kind.inner:
+        return f"{kind.name}<{'|'.join(str(x) for x in kind.inner)}>"
+    if kind.name == "object_literal":
+        inner = ", ".join(f"{k}: {kind_name(kk)}" for k, kk in kind.inner)
+        return "{ " + inner + " }"
     if kind.name == "literal":
         from surrealdb_tpu.exec.static_eval import static_value_maybe
         from surrealdb_tpu.val import render
@@ -233,6 +238,19 @@ def coerce(v, kind: Kind):
         if isinstance(v, dict):
             return v
         raise coerce_err(v, kind)
+    if n == "object_literal":
+        if not isinstance(v, dict):
+            raise coerce_err(v, kind)
+        declared = dict(kind.inner)
+        out = {}
+        for k in v:
+            if k not in declared:
+                raise coerce_err(v, kind)
+        for k, kk in declared.items():
+            sub = coerce(v.get(k, NONE), kk)
+            if sub is not NONE:
+                out[k] = sub
+        return out
     if n == "record":
         if isinstance(v, RecordId):
             if kind.inner and v.tb not in kind.inner:
@@ -323,6 +341,13 @@ def _tupled(c):
     return float(c) if isinstance(c, (int, float, Decimal)) else c
 
 
+def cast_err(v, kind: Kind):
+    # reference format: "Could not cast into `k` using input `v`"
+    return SdbError(
+        f"Could not cast into `{kind_name(kind)}` using input `{render(v)}`"
+    )
+
+
 def cast(v, kind: Kind):
     """`<kind> value` — lenient conversion (reference expr/cast.rs)."""
     n = kind.name
@@ -392,7 +417,10 @@ def cast(v, kind: Kind):
                 return False
     elif n == "datetime":
         if isinstance(v, str):
-            return Datetime.parse(v)
+            try:
+                return Datetime.parse(v)
+            except ValueError:
+                raise cast_err(v, kind)
         if isinstance(v, int):
             import datetime as _dt
 
@@ -402,7 +430,10 @@ def cast(v, kind: Kind):
             return Duration.parse(v)
     elif n == "uuid":
         if isinstance(v, str):
-            return Uuid(v)
+            try:
+                return Uuid(v)
+            except ValueError:
+                raise cast_err(v, kind)
     elif n == "record":
         if isinstance(v, str):
             from surrealdb_tpu.syn.parser import parse_record_literal
@@ -410,8 +441,13 @@ def cast(v, kind: Kind):
 
             return static_value(parse_record_literal(v))
     elif n == "array":
+        from surrealdb_tpu.val import SSet as _SSet
+
         if isinstance(v, list):
             return [cast(x, kind.inner[0]) for x in v] if kind.inner else v
+        if isinstance(v, _SSet):
+            items = list(v.items)
+            return [cast(x, kind.inner[0]) for x in items] if kind.inner else items
         if isinstance(v, Range):
             try:
                 return list(v.iter_ints())
@@ -446,6 +482,9 @@ def cast(v, kind: Kind):
             g = object_to_geometry(v)
             if g is not None:
                 return g
-    raise SdbError(
-        f"Expected a {kind_name(kind)} but cannot convert {render(v)} into a {kind_name(kind)}"
-    )
+        if isinstance(v, (list, tuple)) and len(v) == 2 and all(
+            isinstance(x, (int, float, Decimal)) and not isinstance(x, bool)
+            for x in v
+        ):
+            return Geometry("Point", (float(v[0]), float(v[1])))
+    raise cast_err(v, kind)

@@ -230,14 +230,12 @@ def _e_binary(n, ctx):
         if is_truthy(lhs):
             return lhs
         return evaluate(n.rhs, ctx)
-    if op == "@@":
-        return _eval_matches(n, ctx)
     lhs = evaluate(n.lhs, ctx)
     rhs = evaluate(n.rhs, ctx)
     return binary_op(op, lhs, rhs)
 
 
-def _eval_matches(n, ctx):
+def _e_matches(n, ctx):
     """text @@ query — full-text match via the index (fnc/search path)."""
     from surrealdb_tpu.idx.fulltext import matches_operator
 
@@ -451,6 +449,11 @@ def walk(val, parts, ctx: Ctx, depth=0):
             if isinstance(val, dict):
                 val = list(val.values())
             elif isinstance(val, list):
+                if i + 1 == len(parts):
+                    return [
+                        fetch_record(ctx, x) if isinstance(x, RecordId) else x
+                        for x in val
+                    ]
                 val = [
                     walk(x, parts[i + 1 :], ctx, depth + 1) for x in val
                 ]
@@ -508,10 +511,12 @@ def walk(val, parts, ctx: Ctx, depth=0):
             val = _apply_destructure(val, part, ctx)
         elif t is POptional:
             if val is NONE or val is None:
-                return NONE
+                return val
         elif t is PRecurse:
-            val = _apply_recurse(val, part, parts[i + 1 :], ctx)
-            return val
+            if part.parts:
+                val = _apply_recurse(val, part, [], ctx)
+                continue
+            return _apply_recurse(val, part, parts[i + 1 :], ctx)
         else:
             raise SdbError(f"unhandled idiom part {part!r}")
     return val
@@ -590,6 +595,11 @@ def _apply_index(val, idx, ctx):
 def _apply_method(val, part, ctx):
     from surrealdb_tpu.fnc import method_call
 
+    if part.name == "__call__":
+        args = [evaluate(a, ctx) for a in part.args]
+        if isinstance(val, Closure):
+            return call_closure(val, args, ctx)
+        raise SdbError(f"{type(val).__name__} is not a function")
     # field holding a closure?
     if isinstance(val, dict):
         f = val.get(part.name)
@@ -685,62 +695,122 @@ def _apply_destructure(val, part: PDestructure, ctx):
 
 
 def _apply_recurse(val, part: PRecurse, tail, ctx):
-    """Bounded recursion `.{min..max}(parts)` over graph-ish steps."""
+    """Bounded recursion `.{min..max[+instr]}(step)` (reference
+    exec/operators/recursion.rs). BFS over the step parts with a visited
+    set; instructions: collect / path / shortest=target / inclusive."""
+    from surrealdb_tpu.val import hashable
+
     rmin = part.min if part.min is not None else 1
-    rmax = part.max if part.max is not None else 16
+    rmax = part.max if part.max is not None else 256
     rmax = min(rmax, 256)
     parts = part.parts if part.parts else tail
     if not parts:
         return NONE
-    current = val
-    collected = []
-    seen = set()
-    from surrealdb_tpu.val import hashable
+    names = []
+    target = None
+    if isinstance(part.instruction, dict):
+        names = part.instruction.get("names", [])
+        texpr = part.instruction.get("target")
+        target = evaluate(texpr, ctx) if texpr is not None else None
+    elif isinstance(part.instruction, str):
+        names = [part.instruction]
+    inclusive = "inclusive" in names
+    mode = next(
+        (n for n in names if n in ("collect", "path", "shortest")), None
+    )
 
-    depth = 0
-    result_at_depth = NONE
-    while depth < rmax:
-        nxt = walk(current, parts, ctx)
-        depth += 1
-        if isinstance(nxt, list):
+    def step(node):
+        out = walk(node, parts, ctx)
+        if out is NONE or out is None:
+            return [], False
+        if isinstance(out, list):
             flat = []
-            for x in nxt:
+            for x in out:
                 if isinstance(x, list):
                     flat.extend(x)
                 else:
                     flat.append(x)
-            uniq = []
-            for x in flat:
-                if x is NONE or x is None:
+            return [x for x in flat if x is not NONE and x is not None], True
+        return [out], False
+
+    start_items = val if isinstance(val, list) else [val]
+    start_items = [x for x in start_items if x is not NONE and x is not None]
+    visited = {hashable(x) for x in start_items}
+    frontier = list(start_items)
+    parent: dict = {}
+    collected: list = []
+    paths: dict = {hashable(x): [x] for x in start_items}
+    depth = 0
+    was_list = isinstance(val, list)
+    last_nonempty = frontier
+    last_depth = 0
+
+    while depth < rmax and frontier:
+        nxt = []
+        for node in frontier:
+            children, islist = step(node)
+            was_list = was_list or islist
+            for ch in children:
+                h = hashable(ch)
+                if h in visited:
                     continue
-                h = hashable(x)
-                if h not in seen:
-                    seen.add(h)
-                    uniq.append(x)
-            nxt = uniq
-            if not nxt:
-                if depth <= rmin:
-                    return NONE if part.max == part.min else collected
-                break
-        elif nxt is NONE or nxt is None:
-            if depth < rmin:
-                return NONE
-            break
-        current = nxt
-        result_at_depth = nxt
-        if depth >= rmin:
-            if isinstance(nxt, list):
-                collected.extend(nxt)
-            else:
-                collected.append(nxt)
+                visited.add(h)
+                parent[h] = node
+                nxt.append(ch)
+                if mode == "shortest" and target is not None and value_eq(
+                    ch, target
+                ):
+                    # rebuild the path start→target
+                    path = [ch]
+                    cur = node
+                    while cur is not None:
+                        path.append(cur)
+                        cur = parent.get(hashable(cur))
+                    path.reverse()
+                    if not inclusive:
+                        path = path[1:]
+                    return path
+        depth += 1
+        if mode in ("collect", "path") and depth >= rmin:
+            collected.extend(nxt)
+        frontier = nxt
+        if nxt:
+            last_nonempty = nxt
+            last_depth = depth
+
+    if mode == "shortest":
+        return NONE
+    if mode == "collect":
+        out = list(collected)
+        if inclusive:
+            out = start_items + out
+        return out
+    if mode == "path":
+        def path_to(x):
+            p = []
+            cur = x
+            while cur is not None:
+                p.append(cur)
+                cur = parent.get(hashable(cur))
+            p.reverse()
+            if not inclusive and len(p) > 1:
+                p = p[1:]
+            return p
+
+        return [path_to(x) for x in collected]
+    # default: the frontier at the final depth; must reach min depth
     if part.min is not None and part.max == part.min:
-        # fixed depth: return the frontier at that depth
-        return result_at_depth
-    if part.max is None and part.min == 1 and part.instruction is None:
-        return collected
-    if part.instruction is None:
-        return collected
-    return collected
+        # exact depth: the frontier after exactly that many steps
+        out = frontier if depth == rmax else []
+        if not was_list:
+            return out[0] if out else NONE
+        return out
+    if last_depth < rmin:
+        return [] if was_list else NONE
+    out = last_nonempty if last_depth >= 1 else []
+    if not was_list:
+        return out[0] if out else NONE
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -758,6 +828,7 @@ _DISPATCH = {
     Binary: _e_binary,
     Prefix: _e_prefix,
     Knn: _e_knn,
+    Matches: _e_matches,
     FunctionCall: _e_function,
     Cast: _e_cast,
     Constant: _e_constant,

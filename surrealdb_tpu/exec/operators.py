@@ -173,7 +173,11 @@ def rem(a, b):
         try:
             if b == 0:
                 return NONE
-            return math.fmod(a, b) if isinstance(a, float) or isinstance(b, float) else a % b
+            if isinstance(a, int) and isinstance(b, int):
+                # exact truncated remainder (Rust %): sign of the dividend
+                r = abs(a) % abs(b)
+                return -r if a < 0 else r
+            return math.fmod(a, b)
         except (ZeroDivisionError, ArithmeticError):
             return NONE
     raise SdbError(f"Cannot modulo {render(a)} by {render(b)}")
@@ -235,12 +239,20 @@ def equal(a, b) -> bool:
 
 
 def all_equal(a, b) -> bool:  # *=
+    from surrealdb_tpu.val import SSet
+
+    if isinstance(a, SSet):
+        a = a.items
     if isinstance(a, list):
         return all(equal(x, b) for x in a)
     return equal(a, b)
 
 
 def any_equal(a, b) -> bool:  # ?=
+    from surrealdb_tpu.val import SSet
+
+    if isinstance(a, SSet):
+        a = a.items
     if isinstance(a, list):
         return any(equal(x, b) for x in a)
     return equal(a, b)

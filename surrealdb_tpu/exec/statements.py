@@ -986,7 +986,8 @@ def _explain_write(n, ctx):
 def _collector_detail(n: SelectStmt):
     """Collector explain entry; GROUP queries report their aggregations."""
     if n.group is None:
-        return {"detail": {"type": "Memory"}, "operation": "Collector"}
+        ctype = "MemoryOrdered" if n.order else "Memory"
+        return {"detail": {"type": ctype}, "operation": "Collector"}
     aggs = {}
     sel = {}
     group_exprs = {}
@@ -1832,6 +1833,10 @@ def _s_show(n: ShowStmt, ctx: Ctx):
     return read_changes(n, ctx)
 
 
+def _s_access(n, ctx):
+    return NONE
+
+
 _STMTS = {
     LetStmt: _s_let,
     ReturnStmt: _s_return,
@@ -1870,4 +1875,5 @@ _STMTS = {
     LiveStmt: _s_live,
     KillStmt: _s_kill,
     ShowStmt: _s_show,
+    AccessStmt: _s_access,
 }

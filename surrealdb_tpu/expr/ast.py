@@ -73,6 +73,16 @@ class Prefix(Node):
 
 
 @dataclass
+class Matches(Node):
+    """lhs @[ref][,AND|OR]@ rhs — full-text match with options."""
+
+    lhs: Node
+    rhs: Node
+    ref: Optional[int] = None
+    boolean: str = "AND"
+
+
+@dataclass
 class Knn(Node):
     """lhs <|k[,ef|DIST]|> rhs  (sql/operator.rs:206 NearestNeighbor)."""
 

@@ -38,8 +38,32 @@ def _tokenize(text: str, tokenizers: list) -> list[tuple[str, int, int]]:
                 for m in _re.finditer(r"[^\s\W]+|\w+", s):
                     out.append((m.group(), base + m.start()))
             elif tk == "class":
-                for m in _re.finditer(r"[a-zA-Z]+|\d+|[^\w\s]+", s):
-                    out.append((m.group(), base + m.start()))
+                # split on unicode character-class changes (letter/digit/other)
+                cur = []
+                cstart = 0
+
+                def _cls(ch):
+                    if ch.isalpha():
+                        return "a"
+                    if ch.isdigit():
+                        return "d"
+                    if ch.isspace():
+                        return "s"
+                    return "p"
+
+                prev = None
+                for ci, ch in enumerate(s):
+                    c = _cls(ch)
+                    if c != prev and cur:
+                        if prev != "s":
+                            out.append(("".join(cur), base + cstart))
+                        cur = []
+                    if c != prev:
+                        cstart = ci
+                    prev = c
+                    cur.append(ch)
+                if cur and prev != "s":
+                    out.append(("".join(cur), base + cstart))
             elif tk == "camel":
                 pos = 0
                 for part in _CAMEL_RX.split(s):
@@ -49,7 +73,7 @@ def _tokenize(text: str, tokenizers: list) -> list[tuple[str, int, int]]:
             else:
                 out.append((s, base))
         spans = [(t, p) for t, p in out]
-    return [(t, p, p + len(t)) for t, p in spans]
+    return [(t, p, p + len(t), p, p + len(t)) for t, p in spans]
 
 
 _STOP_SUFFIXES = [
@@ -74,9 +98,9 @@ def _apply_filters(tokens, filters):
         name = f[0]
         nxt = []
         if name == "lowercase":
-            nxt = [(t.lower(), a, b) for t, a, b in out]
+            nxt = [(t.lower(), a, b, oa, ob) for t, a, b, oa, ob in out]
         elif name == "uppercase":
-            nxt = [(t.upper(), a, b) for t, a, b in out]
+            nxt = [(t.upper(), a, b, oa, ob) for t, a, b, oa, ob in out]
         elif name == "ascii":
             import unicodedata
 
@@ -87,22 +111,24 @@ def _apply_filters(tokens, filters):
                     .decode(),
                     a,
                     b,
+                    oa,
+                    ob,
                 )
-                for t, a, b in out
+                for t, a, b, oa, ob in out
             ]
         elif name == "snowball":
-            nxt = [(_stem(t.lower()), a, b) for t, a, b in out]
+            nxt = [(_stem(t.lower()), a, b, oa, ob) for t, a, b, oa, ob in out]
         elif name == "edgengram":
             lo, hi = int(f[1]), int(f[2])
-            for t, a, b in out:
+            for t, a, b, oa, ob in out:
                 for n in range(lo, min(hi, len(t)) + 1):
-                    nxt.append((t[:n], a, b))
+                    nxt.append((t[:n], a, a + n, oa, ob))
         elif name == "ngram":
             lo, hi = int(f[1]), int(f[2])
-            for t, a, b in out:
+            for t, a, b, oa, ob in out:
                 for n in range(lo, hi + 1):
                     for i in range(0, max(len(t) - n + 1, 0)):
-                        nxt.append((t[i : i + n], a, b))
+                        nxt.append((t[i : i + n], a + i, a + i + n, oa, ob))
         else:
             nxt = out
         out = nxt
@@ -125,7 +151,7 @@ def analyze(az: AnalyzerDef, text: str):
 
 def analyze_text(az_name, text, ctx):
     az = get_analyzer(az_name, ctx)
-    return [t for t, _a, _b in analyze(az, text)]
+    return [tok[0] for tok in analyze(az, text)]
 
 
 # ---------------------------------------------------------------------------
@@ -147,13 +173,13 @@ def _doc_terms(idef, doc, ctx, rid):
             texts = [v]
         elif isinstance(v, list):
             texts = [x for x in v if isinstance(x, str)]
-        for text in texts:
-            for t, a, b in analyze(az, text):
+        for vi, text in enumerate(texts):
+            for t, a, b, oa, ob in analyze(az, text):
                 if not t:
                     continue
                 length += 1
                 tf, offs = terms.get(t, (0, []))
-                terms[t] = (tf + 1, offs + [(a, b)])
+                terms[t] = (tf + 1, offs + [(vi, a, b, oa, ob)])
     return terms, length
 
 
@@ -214,15 +240,18 @@ def fulltext_index_update(idef, rid: RecordId, before, after, ctx):
 # ---------------------------------------------------------------------------
 
 
-def ft_search(idef, query: str, ctx):
+def ft_search(idef, query: str, ctx, boolean: str = "AND"):
     """Returns ordered [(rid, score)] plus per-term match offsets."""
     ns, db = ctx.need_ns_db()
     tb, ix = idef.tb, idef.name
     az = get_analyzer(idef.fulltext.get("analyzer"), ctx)
-    terms = [t for t, _a, _b in analyze(az, query) if t]
+    terms = [tok[0] for tok in analyze(az, query) if tok[0]]
     if not terms:
         return [], {}
+    import numpy as _np
+
     k1, b = idef.fulltext.get("bm25", (1.2, 0.75))
+    k1, b = float(_np.float32(k1)), float(_np.float32(b))
     stats = ctx.txn.get_val(_stats_key(ns, db, tb, ix)) or {
         "docs": 0,
         "total_len": 0,
@@ -238,60 +267,89 @@ def ft_search(idef, query: str, ctx):
         df = len(post)
         if df == 0:
             continue
-        idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+        # reference scorer (ft/fulltext.rs compute_bm25_score): clamped idf,
+        # lower-bounded tf' = 1 + ln(tf)
+        idf = max(math.log((n_docs - df + 0.5) / (df + 0.5)), 0.0)
         for ridk, (tf, offs, rid_id) in post.items():
-            dl = ctx.txn.get_val(_len_key(ns, db, tb, ix, rid_id)) or 1
-            denom = tf + k1 * (1 - b + b * dl / max(avg_len, 1e-9))
-            s = idf * tf * (k1 + 1) / max(denom, 1e-9)
+            dl = ctx.txn.get_val(_len_key(ns, db, tb, ix, rid_id)) or 0
+            if idf == 0.0 or tf <= 0:
+                s = 0.0
+            else:
+                tf_prime = 1.0 + math.log(tf)
+                length_norm = (1 - b) + (b / max(avg_len, 1e-9)) * dl
+                s = idf * (k1 + 1) * tf_prime / (tf_prime + k1 * length_norm)
             scores[ridk] = scores.get(ridk, 0.0) + s
             rids[ridk] = RecordId(tb, rid_id)
             offsets.setdefault(ridk, []).extend(offs)
             matched_all.setdefault(ridk, set()).add(t)
     want = set(dict.fromkeys(terms))
-    # AND semantics: docs must match every query term (reference MATCHES)
-    hits = [
-        (rids[rk], sc)
-        for rk, sc in scores.items()
-        if matched_all.get(rk) == want
-    ]
-    if not hits:
-        # fall back to OR ranking when no doc has all terms? reference
-        # returns only full matches — keep strict AND.
-        pass
+    if boolean == "OR":
+        hits = [(rids[rk], sc) for rk, sc in scores.items()]
+    else:
+        # AND semantics: docs must match every query term (reference MATCHES)
+        hits = [
+            (rids[rk], sc)
+            for rk, sc in scores.items()
+            if matched_all.get(rk) == want
+        ]
     hits.sort(key=lambda p: -p[1])
     return hits, offsets
 
 
-def plan_matches(tb, cond, mt, indexes, ctx, stmt):
-    """Planner entry for `field @@ query` — index scan + score context."""
+def plan_matches(tb, cond, mts, indexes, ctx, stmt):
+    """Planner entry for one or more `field @ref@ query` predicates: each
+    resolves to a full-text index; results intersect (AND across
+    predicates); per-ref score/offset contexts feed search::score etc."""
     from surrealdb_tpu.exec.eval import evaluate, fetch_record
     from surrealdb_tpu.exec.statements import Source
     from surrealdb_tpu.idx.planner import _field_path, _remove_node
     from surrealdb_tpu.val import is_truthy
 
-    path = _field_path(mt.lhs)
-    idef = None
-    for d in indexes:
-        if d.fulltext is not None and d.cols_str and (
-            path is None or d.cols_str[0] == path
-        ):
-            idef = d
-            break
-    if idef is None:
-        raise SdbError(
-            "Unable to perform the MATCHES operator without a full-text index"
-        )
-    q = evaluate(mt.rhs, ctx)
-    hits, offsets = ft_search(idef, str(q), ctx)
-    rest = _remove_node(cond, mt)
-    ctx.vars["__ft_scores__"] = {hashable(r): s for r, s in hits}
-    ctx.vars["__ft_offsets__"] = offsets
-    ctx.vars["__ft_index__"] = idef
-    ctx.vars["__ft_query__"] = str(q)
-    ctx._cond_consumed = rest is None
+    # rebind a fresh dict: children share vars-dict values by reference, so
+    # mutating in place would leak subquery match contexts into the parent
+    ft_ctx = dict(ctx.vars.get("__ft__") or {})
+    ctx.vars["__ft__"] = ft_ctx
+    common = None
+    rid_objs = {}
+    rest = cond
+    for mt in mts:
+        path = _field_path(mt.lhs)
+        idef = None
+        for d in indexes:
+            if d.fulltext is not None and d.cols_str and (
+                path is None or d.cols_str[0] == path
+            ):
+                idef = d
+                break
+        if idef is None:
+            raise SdbError(
+                "Unable to perform the MATCHES operator without a full-text index"
+            )
+        q = evaluate(mt.rhs, ctx)
+        hits, offsets = ft_search(idef, str(q), ctx, boolean=mt.boolean)
+        ref = mt.ref if mt.ref is not None else 0
+        ft_ctx[ref] = {
+            "scores": {hashable(r): s for r, s in hits},
+            "offsets": offsets,
+            "idef": idef,
+            "query": str(q),
+        }
+        keys = {hashable(r) for r, _s in hits}
+        for r, _s in hits:
+            rid_objs.setdefault(hashable(r), r)
+        common = keys if common is None else (common & keys)
+        rest = _remove_node(rest, mt)
+    ordered = []
+    seen = set()
+    for ref in sorted(ft_ctx):
+        entry = ft_ctx[ref]
+        for h in entry["scores"]:
+            if h in common and h not in seen:
+                seen.add(h)
+                ordered.append(rid_objs[h])
 
     def gen():
-        for rid, _score in hits:
+        for rid in ordered:
             doc = fetch_record(ctx, rid)
             if doc is NONE:
                 continue
@@ -301,16 +359,16 @@ def plan_matches(tb, cond, mt, indexes, ctx, stmt):
                     continue
             yield Source(rid=rid, doc=doc)
 
-    # mark consumed either way: rest applied inside the generator
     ctx._cond_consumed = True
     return gen()
 
 
 def matches_operator(n, ctx):
-    """Row-wise @@ evaluation (post-planner membership, or ad-hoc)."""
-    scores = ctx.vars.get("__ft_scores__")
-    if scores is not None and ctx.doc_id is not None:
-        return hashable(ctx.doc_id) in scores
+    """Row-wise matches evaluation (post-planner membership, or ad-hoc)."""
+    ft_ctx = ctx.vars.get("__ft__")
+    ref = n.ref if n.ref is not None else 0
+    if ft_ctx is not None and ref in ft_ctx and ctx.doc_id is not None:
+        return hashable(ctx.doc_id) in ft_ctx[ref]["scores"]
     # ad-hoc: analyze both sides with the default analyzer
     from surrealdb_tpu.exec.eval import evaluate
 
@@ -319,54 +377,107 @@ def matches_operator(n, ctx):
     if not isinstance(lhs, str) or not isinstance(rhs, str):
         return False
     az = AnalyzerDef("like", ["blank"], [("lowercase",)])
-    doc_terms = {t for t, _a, _b in analyze(az, lhs)}
-    q_terms = {t for t, _a, _b in analyze(az, rhs)}
-    return bool(q_terms) and q_terms <= doc_terms
+    doc_terms = {tok[0] for tok in analyze(az, lhs)}
+    q_terms = {tok[0] for tok in analyze(az, rhs)}
+    if not q_terms:
+        return False
+    if getattr(n, "boolean", "AND") == "OR":
+        return bool(q_terms & doc_terms)
+    return q_terms <= doc_terms
+
+
+def _ft_entry(ctx, ref):
+    ft_ctx = ctx.vars.get("__ft__")
+    if ft_ctx is None:
+        return None
+    return ft_ctx.get(ref if ref is not None else 0)
 
 
 def search_score(ref, ctx):
-    scores = ctx.vars.get("__ft_scores__")
-    if scores is None or ctx.doc_id is None:
+    entry = _ft_entry(ctx, ref or 0)
+    if entry is None or ctx.doc_id is None:
         return NONE
-    return scores.get(hashable(ctx.doc_id), NONE)
+    return entry["scores"].get(hashable(ctx.doc_id), NONE)
 
 
 def search_highlight(args, ctx):
-    """search::highlight(open, close, ref) — wrap matched terms."""
+    """search::highlight(open, close, ref[, partial]) — wrap matched spans;
+    partial=true marks the matched grams, default marks whole tokens."""
     if len(args) < 3:
         raise SdbError("Incorrect arguments for function search::highlight()")
     open_t, close_t = str(args[0]), str(args[1])
-    idef = ctx.vars.get("__ft_index__")
-    offsets = ctx.vars.get("__ft_offsets__")
-    if idef is None or ctx.doc_id is None or ctx.doc is None:
+    ref = int(args[2]) if not isinstance(args[2], bool) else 0
+    partial = bool(args[3]) if len(args) > 3 else False
+    entry = _ft_entry(ctx, ref)
+    if entry is None or ctx.doc_id is None or ctx.doc is None:
         return NONE
     from surrealdb_tpu import key as K2
     from surrealdb_tpu.exec.eval import evaluate
 
+    idef = entry["idef"]
     ridk = K2.enc_value(ctx.doc_id.id)
-    offs = sorted(set((a, b) for a, b in (offsets or {}).get(ridk, [])))
+    spans = _spans_by_value(entry, ridk, partial)
     c = ctx.with_doc(ctx.doc, ctx.doc_id)
     text = evaluate(idef.cols[0], c)
-    if not isinstance(text, str):
-        return text
-    out = []
-    last = 0
-    for a, b in offs:
-        if a < last or b > len(text):
-            continue
-        out.append(text[last:a])
-        out.append(open_t + text[a:b] + close_t)
-        last = b
-    out.append(text[last:])
-    return "".join(out)
+
+    def mark(t, vi):
+        if not isinstance(t, str):
+            return t
+        out = []
+        last = 0
+        for a, b in spans.get(vi, []):
+            if a < last or b > len(t):
+                continue
+            out.append(t[last:a])
+            out.append(open_t + t[a:b] + close_t)
+            last = b
+        out.append(t[last:])
+        return "".join(out)
+
+    if isinstance(text, list):
+        return [mark(t, vi) for vi, t in enumerate(text)]
+    return mark(text, 0)
+
+
+def _spans_by_value(entry, ridk, partial):
+    """vi -> merged sorted spans for this record's matches."""
+    by_vi: dict = {}
+    for off in (entry["offsets"] or {}).get(ridk, []):
+        if len(off) == 5:
+            vi, a, b, oa, ob = off
+        else:  # legacy 2-tuple
+            vi, (a, b, oa, ob) = 0, (*off, *off)
+        span = (a, b) if partial else (oa, ob)
+        by_vi.setdefault(vi, set()).add(span)
+    out = {}
+    for vi, spans in by_vi.items():
+        merged = []
+        for a, b in sorted(spans):
+            if merged and a <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(b, merged[-1][1]))
+            else:
+                merged.append((a, b))
+        out[vi] = merged
+    return out
 
 
 def search_offsets(args, ctx):
-    offsets = ctx.vars.get("__ft_offsets__")
-    if offsets is None or ctx.doc_id is None:
+    """search::offsets(ref[, partial]) -> { "<value idx>": [{s, e}] }."""
+    ref = 0
+    if args and not isinstance(args[0], bool):
+        try:
+            ref = int(args[0])
+        except (TypeError, ValueError):
+            ref = 0
+    partial = bool(args[1]) if len(args) > 1 else False
+    entry = _ft_entry(ctx, ref)
+    if entry is None or ctx.doc_id is None:
         return NONE
     from surrealdb_tpu import key as K2
 
     ridk = K2.enc_value(ctx.doc_id.id)
-    offs = sorted(set((a, b) for a, b in (offsets or {}).get(ridk, [])))
-    return {"0": [{"e": b, "s": a} for a, b in offs]}
+    spans = _spans_by_value(entry, ridk, partial)
+    return {
+        str(vi): [{"e": b, "s": a} for a, b in merged]
+        for vi, merged in sorted(spans.items())
+    }

@@ -56,11 +56,20 @@ def _find_knn(cond):
 
 
 def _find_matches(cond):
-    if isinstance(cond, Binary) and cond.op == "@@":
-        return cond
-    if isinstance(cond, Binary) and cond.op == "&&":
-        return _find_matches(cond.lhs) or _find_matches(cond.rhs)
-    return None
+    """All Matches nodes in the AND-tree."""
+    from surrealdb_tpu.expr.ast import Matches
+
+    out = []
+
+    def rec(c):
+        if isinstance(c, Matches):
+            out.append(c)
+        elif isinstance(c, Binary) and c.op == "&&":
+            rec(c.lhs)
+            rec(c.rhs)
+
+    rec(cond)
+    return out
 
 
 def _remove_node(cond, node):
@@ -178,11 +187,11 @@ def plan_scan(tb: str, cond, ctx, stmt):
         return _plan_knn(tb, cond, knn, indexes, ctx, stmt)
 
     # ---- MATCHES ----------------------------------------------------------
-    mt = _find_matches(cond)
-    if mt is not None:
+    mts = _find_matches(cond)
+    if mts:
         from surrealdb_tpu.idx.fulltext import plan_matches
 
-        return plan_matches(tb, cond, mt, indexes, ctx, stmt)
+        return plan_matches(tb, cond, mts, indexes, ctx, stmt)
 
     # ---- equality / range / contains on indexed columns --------------------
     eqs, ins, rngs = _classify_preds(cond)
@@ -435,13 +444,28 @@ def explain_plan(tb, cond, ctx, stmt):
                 "detail": {"direction": "forward", "table": tb},
                 "operation": "Iterate Table",
             }
-        mt = _find_matches(cond)
-        if mt is not None:
+        mts = _find_matches(cond)
+        if mts:
+            from surrealdb_tpu.exec.eval import evaluate
+
+            mt = mts[0]
+            path = _field_path(mt.lhs)
             for idef in indexes:
-                if idef.fulltext is not None:
+                if idef.fulltext is not None and (
+                    path is None or (idef.cols_str and idef.cols_str[0] == path)
+                ):
+                    op = f"@{mt.ref}@" if mt.ref is not None else "@@"
+                    try:
+                        val = evaluate(mt.rhs, ctx)
+                    except Exception:
+                        val = None
                     return {
                         "detail": {
-                            "plan": {"index": idef.name, "operator": "@@"},
+                            "plan": {
+                                "index": idef.name,
+                                "operator": op,
+                                "value": val,
+                            },
                             "table": tb,
                         },
                         "operation": "Iterate Index",

@@ -28,7 +28,6 @@ _PUNCT3 = ("..=", "...", "?:=")
 _PUNCT2 = (
     "<|", "|>", "::", "->", "<~", "<-", "..", ">=", "<=", "==", "!=", "?=", "*=",
     "!~", "?~", "*~", "&&", "||", "??", "?:", "**", "+=", "-=", "+?=", "@@",
-    "?.",
 )
 _PUNCT1 = "+-*/%<>=!?()[]{},;:.|&@~$×÷∋∌⊇⊆∈∉⟨`…"
 

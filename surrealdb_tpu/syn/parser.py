@@ -696,8 +696,10 @@ class Parser:
             ine, ow = self._def_flags()
             d = DefineDatabase(self.ident_or_str(), ine, ow)
             while True:
-                if self.eat_kw("comment"):
-                    d.comment = self.ident_or_str()
+                if self.eat_kw("strict"):
+                    pass
+                elif self.eat_kw("comment"):
+                    d.comment = self._comment_value()
                 elif self.eat_kw("changefeed"):
                     d.changefeed = self.parse_expr()
                     self.eat_kw("include") and self.expect_kw("original")
@@ -744,14 +746,31 @@ class Parser:
             d = DefineSequence(name, if_not_exists=ine, overwrite=ow)
             while True:
                 if self.eat_kw("batch"):
-                    d.batch = self.next().value
+                    d.batch = self._signed_int()
                 elif self.eat_kw("start"):
-                    d.start = self.next().value
+                    d.start = self._signed_int()
                 elif self.eat_kw("timeout"):
                     d.timeout = self.parse_expr()
                 else:
                     break
             return d
+        if self.eat_kw("api"):
+            return self._parse_define_api()
+        if self.eat_kw("bucket"):
+            ine, ow = self._def_flags()
+            name = self.ident_or_str()
+            while True:
+                if self.eat_kw("backend"):
+                    self.ident_or_str()
+                elif self.eat_kw("readonly"):
+                    pass
+                elif self.eat_kw("comment"):
+                    self._comment_value()
+                elif self.eat_kw("permissions"):
+                    self._parse_permissions_value()
+                else:
+                    break
+            return DefineConfig("BUCKET", {"name": name}, ine, ow)
         if self.eat_kw("config"):
             ine, ow = self._def_flags()
             what = self.ident().upper()
@@ -873,6 +892,47 @@ class Parser:
                 ref["then"] = self.parse_expr()
         return ref
 
+    def _parse_define_api(self):
+        ine, ow = self._def_flags()
+        path = self.ident_or_str()
+        actions = []
+        while True:
+            if self.eat_kw("for"):
+                methods = [self.ident().lower()]
+                while self.eat_op(","):
+                    methods.append(self.ident().lower())
+                body = None
+                if self.eat_kw("then"):
+                    body = self.parse_expr()
+                actions.append({"methods": methods, "then": body})
+            elif self.eat_kw("then"):
+                actions.append({"methods": ["any"], "then": self.parse_expr()})
+            elif self.eat_kw("middleware"):
+                # swallow middleware spec: name(args) [, name(args)]*
+                while True:
+                    self.ident()
+                    while self.eat_op("::"):
+                        self.ident()
+                    if self.at_op("("):
+                        depth = 0
+                        while True:
+                            t = self.next()
+                            if t.kind == L.OP and t.text == "(":
+                                depth += 1
+                            elif t.kind == L.OP and t.text == ")":
+                                depth -= 1
+                                if depth == 0:
+                                    break
+                    if not self.eat_op(","):
+                        break
+            elif self.eat_kw("permissions"):
+                self._parse_permissions_value()
+            elif self.eat_kw("comment"):
+                self._comment_value()
+            else:
+                break
+        return DefineConfig("API", {"path": path, "actions": actions}, ine, ow)
+
     def _field_name_parts(self):
         """Field name as idiom parts: a.b.c, a[*], a.*"""
         parts = [PField(self.ident_or_str())]
@@ -889,6 +949,9 @@ class Parser:
                 if self.at_op("*"):
                     self.next()
                     parts.append(PAll())
+                    self.expect_op("]")
+                elif self.peek().kind == L.INT:
+                    parts.append(PIndex(Literal(self.next().value)))
                     self.expect_op("]")
                 else:
                     raise self.err("expected [*] in field name")
@@ -916,10 +979,16 @@ class Parser:
                     if self.eat_kw("analyzer"):
                         ft["analyzer"] = self.ident()
                     elif self.eat_kw("bm25"):
-                        if self.peek().kind in (L.FLOAT, L.INT):
+                        if self.at_op("("):
+                            self.next()
                             k1 = float(self.next().value)
-                            if self.eat_op(","):
-                                pass
+                            self.eat_op(",")
+                            b = float(self.next().value)
+                            self.expect_op(")")
+                            ft["bm25"] = (k1, b)
+                        elif self.peek().kind in (L.FLOAT, L.INT):
+                            k1 = float(self.next().value)
+                            self.eat_op(",")
                             b = float(self.next().value)
                             ft["bm25"] = (k1, b)
                     elif self.eat_kw("highlights"):
@@ -988,7 +1057,13 @@ class Parser:
         then = []
         comment = None
         while True:
-            if self.eat_kw("when"):
+            if self.eat_kw("async"):
+                pass
+            elif self.eat_kw("retry"):
+                self.next()
+            elif self.eat_kw("maxdepth"):
+                self.next()
+            elif self.eat_kw("when"):
                 when = self.parse_expr()
             elif self.eat_kw("then"):
                 if self.at_op("("):
@@ -1058,8 +1133,12 @@ class Parser:
                 while self.eat_op(","):
                     d.filters.append(self._parse_filter())
             elif self.eat_kw("function"):
-                self.eat_op("::")
-                d.function = self.ident()
+                parts = [self.ident()]
+                while self.eat_op("::"):
+                    parts.append(self.ident())
+                if parts and parts[0] == "fn":
+                    parts = parts[1:]
+                d.function = "::".join(parts)
             elif self.eat_kw("comment"):
                 d.comment = self.ident_or_str()
             else:
@@ -1096,7 +1175,8 @@ class Parser:
         elif self.eat_kw("namespace", "ns"):
             base = "ns"
         else:
-            self.expect_kw("database")
+            if not self.eat_kw("database", "db"):
+                raise self.err("expected DATABASE")
             base = "db"
         d = DefineUser(name, base, if_not_exists=ine, overwrite=ow)
         while True:
@@ -1213,7 +1293,8 @@ class Parser:
         while self.eat_kw("for"):
             kinds = [self.ident().lower()]
             while self.eat_op(","):
-                kinds.append(self.ident().lower())
+                if not self.at_kw("for"):
+                    kinds.append(self.ident().lower())
             if self.eat_kw("none"):
                 val = False
             elif self.eat_kw("full"):
@@ -1223,6 +1304,7 @@ class Parser:
                 val = self.parse_expr()
             for k in kinds:
                 perms[k] = val
+            self.eat_op(",")
         return perms
 
     def _parse_permissions_value(self):
@@ -1287,8 +1369,25 @@ class Parser:
 
     def _stmt_alter(self):
         self.next()
+        if self.eat_kw("sequence"):
+            if_exists = False
+            if self.eat_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            name = self.ident()
+            timeout = None
+            while True:
+                if self.eat_kw("timeout"):
+                    timeout = self.parse_expr()
+                elif self.eat_kw("batch"):
+                    self._signed_int()
+                elif self.eat_kw("start"):
+                    self._signed_int()
+                else:
+                    break
+            return AccessStmt(name, None, "alter_sequence", if_exists)
         if not self.eat_kw("table"):
-            raise self.err("only ALTER TABLE is supported")
+            raise self.err("only ALTER TABLE and ALTER SEQUENCE are supported")
         if_exists = False
         if self.eat_kw("if"):
             self.expect_kw("exists")
@@ -1326,6 +1425,19 @@ class Parser:
                 break
         return d
 
+    def _signed_int(self):
+        neg = self.eat_op("-")
+        v = self.next().value
+        return -v if neg else v
+
+    def _comment_value(self):
+        t = self.peek()
+        if t.kind in (L.IDENT, L.STRING) and not self.at_kw("none"):
+            if t.kind == L.STRING:
+                self.next()
+                return t.value
+        return self.parse_expr()
+
     # -- kinds ---------------------------------------------------------------
     def parse_kind(self, no_union: bool = False) -> Kind:
         kinds = [self._single_kind()]
@@ -1342,8 +1454,23 @@ class Parser:
             self.next()
             return Kind("literal", literal=t.value)
         if t.kind == L.OP and t.text == "{":
-            obj = self._parse_object_or_block()
-            return Kind("literal", literal=obj)
+            # object kind: { key: kind, ... }
+            self.next()
+            fields = []
+            while not self.at_op("}"):
+                kt = self.peek()
+                if kt.kind in (L.IDENT, L.STRING):
+                    key = self.next().value
+                elif kt.kind == L.INT:
+                    key = str(self.next().value)
+                else:
+                    raise self.err("expected object key in kind")
+                self.expect_op(":")
+                fields.append((key, self.parse_kind()))
+                if not self.eat_op(","):
+                    break
+            self.expect_op("}")
+            return Kind("object_literal", inner=fields)
         if t.kind == L.OP and t.text == "[":
             arr = self._parse_array()
             return Kind("literal", literal=arr)
@@ -1438,17 +1565,34 @@ class Parser:
                 # is always a comparison.
                 self.next()
                 op = t.text
+                if op == "@@":
+                    lhs = Matches(lhs, self._parse_range())
+                    continue
                 rhs = self._parse_range()
                 lhs = Binary(op, lhs, rhs)
                 continue
             if t.kind == L.OP and t.text == "@":
-                # match-ref operator @N@
-                if self.peek(1).kind == L.INT and self.peek(2).text == "@":
-                    self.next()
-                    ref = self.next().value
-                    self.next()
-                    lhs = Binary("@@", lhs, self._parse_range())
+                # matches with options: @N@ / @AND@ / @OR@ / @N,AND@
+                save = self.i
+                self.next()
+                ref = None
+                boolean = "AND"
+                ok = True
+                while not self.at_op("@"):
+                    tt = self.peek()
+                    if tt.kind == L.INT:
+                        ref = self.next().value
+                    elif tt.kind == L.IDENT and tt.value.upper() in ("AND", "OR"):
+                        boolean = self.next().value.upper()
+                    elif self.eat_op(","):
+                        continue
+                    else:
+                        ok = False
+                        break
+                if ok and self.eat_op("@"):
+                    lhs = Matches(lhs, self._parse_range(), ref, boolean)
                     continue
+                self.i = save
                 break
             if t.kind == L.IDENT:
                 kw = t.value.lower()
@@ -1463,6 +1607,10 @@ class Parser:
                     self.next()
                     self.next()
                     lhs = Binary("!=", lhs, self._parse_range())
+                    continue
+                if kw == "matches":
+                    self.next()
+                    lhs = Matches(lhs, self._parse_range())
                     continue
                 if kw in self._REL_KWS and kw != "knn":
                     # guard: `in` inside FOR handled elsewhere
@@ -1566,10 +1714,15 @@ class Parser:
                 return FunctionCall("__future__", [BlockExpr(body.stmts)])
             operand = self._parse_unary()
             # a trailing range glues into the cast operand: <array> 0..1000
+            beg_incl = True
+            if self.at_op(">") and self.peek(1).kind == L.OP and \
+                    self.peek(1).text in ("..", "..="):
+                self.next()
+                beg_incl = False
             if self.at_op("..", "..="):
                 incl = self.next().text == "..="
                 end = self._parse_additive() if self._at_expr_start() else None
-                operand = RangeExpr(operand, end, True, incl)
+                operand = RangeExpr(operand, end, beg_incl, incl)
             return Cast(kind, operand)
         return self._parse_postfix(self._parse_primary())
 
@@ -1583,6 +1736,10 @@ class Parser:
                 if self.at_op("*"):
                     self.next()
                     parts.append(PAll())
+                    continue
+                if self.at_op("?"):
+                    self.next()
+                    parts.append(POptional())
                     continue
                 if self.at_op("{"):
                     parts.append(self._parse_destructure_or_recurse())
@@ -1607,7 +1764,9 @@ class Parser:
                 else:
                     parts.append(PField(name))
                 continue
-            if self.at_op("?."):
+            if self.at_op("?") and self.peek(1).kind == L.OP and \
+                    self.peek(1).text == ".":
+                self.next()
                 self.next()
                 parts.append(POptional())
                 continue
@@ -1632,6 +1791,16 @@ class Parser:
                     parts.append(PIndex(self.parse_expr()))
                     self.expect_op("]")
                 continue
+            if self.at_op("(") and not self.peek().ws_before:
+                self.next()
+                args = []
+                while not self.at_op(")"):
+                    args.append(self.parse_expr())
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+                parts.append(PMethod("__call__", args))
+                continue
             if self.at_op("…", "..."):
                 self.next()
                 parts.append(PFlatten())
@@ -1653,7 +1822,7 @@ class Parser:
         t = self.peek()
         # recursion bounds: INT / INT..INT / ..INT / .. / INT.. (+instruction)
         if (t.kind == L.INT and self.peek(1).kind == L.OP and
-                self.peek(1).text in ("..", "..=", "}", ",")) or \
+                self.peek(1).text in ("..", "..=", "}", ",", "+")) or \
            (t.kind == L.OP and t.text in ("..", "..=")):
             rmin, rmax = 1, None
             if t.kind == L.INT:
@@ -1667,10 +1836,16 @@ class Parser:
                     if not incl:
                         pass
             instruction = None
-            if self.eat_op(","):
-                instruction = self.ident().lower()
+            names = []
+            target = None
+            while self.eat_op(",") or self.eat_op("+"):
+                nm = self.ident().lower()
+                names.append(nm)
                 if self.eat_op("="):
-                    instruction = (instruction, self.parse_expr())
+                    # restricted: `a:5+inclusive` must not parse as addition
+                    target = self._parse_unary()
+            if names:
+                instruction = {"names": names, "target": target}
             self.expect_op("}")
             # optional (path) group
             inner_parts = []
@@ -1684,10 +1859,15 @@ class Parser:
         # destructure
         fields = []
         while not self.at_op("}"):
-            name = self.ident()
+            name = self.ident_or_str()
             if self.at_op(":"):
                 self.next()
-                sub = self._parse_postfix(self._parse_primary())
+                if self.at_op("{"):
+                    # nested destructure on this field
+                    inner = self._parse_destructure_or_recurse()
+                    sub = Idiom([("start", Idiom([PField(name)])), inner])
+                else:
+                    sub = self.parse_expr()
                 fields.append((name, sub))
             elif self.at_op("."):
                 # a.* or nested chain
@@ -1814,7 +1994,10 @@ class Parser:
                 return RangeExpr(None, None)
             if t.text == "@":
                 self.next()
-                return Idiom([PField("@")])
+                parts = [PField("@")]
+                if self.at_op("{"):
+                    parts.append(self._parse_destructure_or_recurse())
+                return Idiom(parts)
         if k == L.IDENT:
             return self._parse_ident_expr()
         raise self.err("expected expression")
