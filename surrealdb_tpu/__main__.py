@@ -1,0 +1,148 @@
+"""CLI (reference: surrealdb/server/src/cli/ — start, sql REPL, import/
+export, isready, validate, version).
+
+    python -m surrealdb_tpu start [--bind 127.0.0.1:8000] [--path memory]
+    python -m surrealdb_tpu sql [--path memory] [--ns t --db t]
+    python -m surrealdb_tpu export --ns t --db t [--path ...] out.surql
+    python -m surrealdb_tpu import --ns t --db t [--path ...] in.surql
+    python -m surrealdb_tpu validate file.surql
+    python -m surrealdb_tpu isready [--conn http://127.0.0.1:8000]
+    python -m surrealdb_tpu version
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="surrealdb-tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_start = sub.add_parser("start", help="start the server")
+    p_start.add_argument("--bind", default="127.0.0.1:8000")
+    p_start.add_argument("--path", default="memory")
+    p_start.add_argument("--user", default=None)
+    p_start.add_argument("--pass", dest="passwd", default=None)
+
+    p_sql = sub.add_parser("sql", help="interactive REPL")
+    p_sql.add_argument("--path", default="memory")
+    p_sql.add_argument("--ns", default="test")
+    p_sql.add_argument("--db", default="test")
+
+    p_exp = sub.add_parser("export")
+    p_exp.add_argument("--path", default="memory")
+    p_exp.add_argument("--ns", required=True)
+    p_exp.add_argument("--db", required=True)
+    p_exp.add_argument("file", nargs="?", default="-")
+
+    p_imp = sub.add_parser("import")
+    p_imp.add_argument("--path", default="memory")
+    p_imp.add_argument("--ns", required=True)
+    p_imp.add_argument("--db", required=True)
+    p_imp.add_argument("file")
+
+    p_val = sub.add_parser("validate")
+    p_val.add_argument("files", nargs="+")
+
+    p_rdy = sub.add_parser("isready")
+    p_rdy.add_argument("--conn", default="http://127.0.0.1:8000")
+
+    sub.add_parser("version")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "version":
+        import surrealdb_tpu
+
+        print(f"surrealdb-tpu {surrealdb_tpu.__version__}")
+        return 0
+
+    if args.cmd == "validate":
+        from surrealdb_tpu.syn import parse
+
+        rc = 0
+        for f in args.files:
+            try:
+                parse(open(f, encoding="utf-8").read())
+                print(f"{f}: OK")
+            except Exception as e:
+                print(f"{f}: {e}")
+                rc = 1
+        return rc
+
+    if args.cmd == "isready":
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(args.conn + "/health", timeout=5) as r:
+                if r.status == 200:
+                    print("OK")
+                    return 0
+        except Exception:
+            pass
+        print("Not ready")
+        return 1
+
+    from surrealdb_tpu import Datastore
+
+    if args.cmd == "start":
+        from surrealdb_tpu.server import serve
+
+        host, _, port = args.bind.partition(":")
+        ds = Datastore(args.path)
+        if args.user and args.passwd:
+            ds.execute(
+                f"DEFINE USER {args.user} ON ROOT PASSWORD '{args.passwd}' ROLES OWNER"
+            )
+        serve(ds, host or "127.0.0.1", int(port or 8000))
+        return 0
+
+    if args.cmd == "sql":
+        from surrealdb_tpu.val import render
+
+        ds = Datastore(args.path)
+        ns, db = args.ns, args.db
+        print(f"surrealdb-tpu sql — ns={ns} db={db} (Ctrl-D to exit)")
+        while True:
+            try:
+                line = input(f"{ns}/{db}> ")
+            except (EOFError, KeyboardInterrupt):
+                print()
+                break
+            if not line.strip():
+                continue
+            for r in ds.execute(line, ns=ns, db=db):
+                if r.error:
+                    print(f"ERR: {r.error}")
+                else:
+                    print(render(r.result))
+        return 0
+
+    if args.cmd == "export":
+        from surrealdb_tpu.kvs.export import export_sql
+
+        ds = Datastore(args.path)
+        text = export_sql(ds, args.ns, args.db)
+        if args.file == "-":
+            print(text)
+        else:
+            open(args.file, "w", encoding="utf-8").write(text)
+        return 0
+
+    if args.cmd == "import":
+        ds = Datastore(args.path)
+        text = open(args.file, encoding="utf-8").read()
+        res = ds.execute(text, ns=args.ns, db=args.db)
+        errs = [r.error for r in res if r.error]
+        for e in errs:
+            print(f"ERR: {e}", file=sys.stderr)
+        print(f"imported {len(res) - len(errs)}/{len(res)} statements")
+        return 1 if errs else 0
+
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -739,7 +739,6 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
     frontier = list(start_items)
     parent: dict = {}
     collected: list = []
-    paths: dict = {hashable(x): [x] for x in start_items}
     depth = 0
     was_list = isinstance(val, list)
     last_nonempty = frontier

@@ -1834,7 +1834,14 @@ def _s_show(n: ShowStmt, ctx: Ctx):
 
 
 def _s_access(n, ctx):
-    return NONE
+    if n.op == "alter_sequence":
+        ns, db = ctx.need_ns_db()
+        if ctx.txn.get(K.seq_state(ns, db, n.name)) is None and not n.subject:
+            raise SdbError(f"The sequence '{n.name}' does not exist")
+        return NONE
+    raise SdbError(
+        "Access grant management (ACCESS GRANT/SHOW/REVOKE/PURGE) is not supported yet"
+    )
 
 
 _STMTS = {

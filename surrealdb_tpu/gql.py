@@ -1,0 +1,212 @@
+"""GraphQL endpoint (reference: core/src/gql/ — dynamic schema from table
+definitions; queries map onto SELECTs).
+
+Minimal executable subset: `query { table(limit: N, start: N, id: "...")
+{ fields... nested { ... } } }` plus __schema/__type introspection stubs.
+"""
+
+from __future__ import annotations
+
+import re as _re
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.val import NONE, RecordId, to_json
+
+_TOKEN_RX = _re.compile(
+    r"""\s*(?:(?P<punct>[{}():,\[\]!])|(?P<name>[_A-Za-z][_0-9A-Za-z]*)"""
+    r"""|(?P<string>"(?:[^"\\]|\\.)*")|(?P<num>-?\d+(?:\.\d+)?)"""
+    r"""|(?P<var>\$[_A-Za-z][_0-9A-Za-z]*))""",
+)
+
+
+def _tokenize(src: str):
+    pos = 0
+    out = []
+    while pos < len(src):
+        m = _TOKEN_RX.match(src, pos)
+        if not m:
+            if src[pos:].strip() == "":
+                break
+            raise SdbError(f"GraphQL parse error at {pos}")
+        pos = m.end()
+        if m.group("punct"):
+            out.append(("punct", m.group("punct")))
+        elif m.group("name"):
+            out.append(("name", m.group("name")))
+        elif m.group("string"):
+            out.append(("string", m.group("string")[1:-1]))
+        elif m.group("num"):
+            n = m.group("num")
+            out.append(("num", float(n) if "." in n else int(n)))
+        elif m.group("var"):
+            out.append(("var", m.group("var")[1:]))
+    return out
+
+
+class _P:
+    def __init__(self, toks, variables):
+        self.toks = toks
+        self.i = 0
+        self.variables = variables
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else ("eof", "")
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def eat(self, kind, val=None):
+        t = self.peek()
+        if t[0] == kind and (val is None or t[1] == val):
+            self.i += 1
+            return t
+        return None
+
+    def parse_value(self):
+        t = self.next()
+        if t[0] == "var":
+            return self.variables.get(t[1])
+        if t[0] in ("string", "num"):
+            return t[1]
+        if t[0] == "name":
+            if t[1] == "true":
+                return True
+            if t[1] == "false":
+                return False
+            if t[1] == "null":
+                return None
+            return t[1]
+        if t == ("punct", "["):
+            out = []
+            while not self.eat("punct", "]"):
+                out.append(self.parse_value())
+                self.eat("punct", ",")
+            return out
+        raise SdbError("GraphQL parse error in value")
+
+    def parse_selection_set(self):
+        if not self.eat("punct", "{"):
+            raise SdbError("GraphQL: expected selection set")
+        fields = []
+        while not self.eat("punct", "}"):
+            name = self.next()
+            if name[0] != "name":
+                raise SdbError("GraphQL: expected field name")
+            args = {}
+            if self.eat("punct", "("):
+                while not self.eat("punct", ")"):
+                    an = self.next()[1]
+                    self.eat("punct", ":")
+                    args[an] = self.parse_value()
+                    self.eat("punct", ",")
+            sub = None
+            if self.peek() == ("punct", "{"):
+                sub = self.parse_selection_set()
+            fields.append((name[1], args, sub))
+        return fields
+
+
+def execute_graphql(ds, session, query: str, variables=None) -> dict:
+    variables = variables or {}
+    toks = _tokenize(query)
+    p = _P(toks, variables)
+    # optional `query Name(...)` prelude
+    if p.peek() == ("name", "query") or p.peek() == ("name", "mutation"):
+        p.next()
+        if p.peek()[0] == "name":
+            p.next()
+        if p.eat("punct", "("):
+            depth = 1
+            while depth:
+                t = p.next()
+                if t == ("punct", "("):
+                    depth += 1
+                elif t == ("punct", ")"):
+                    depth -= 1
+    sels = p.parse_selection_set()
+    data = {}
+    errors = []
+    for name, args, sub in sels:
+        if name == "__schema":
+            data[name] = _schema_introspection(ds, session)
+            continue
+        if name == "__typename":
+            data[name] = "Query"
+            continue
+        try:
+            data[name] = _resolve_table(ds, session, name, args, sub)
+        except SdbError as e:
+            errors.append({"message": str(e)})
+            data[name] = None
+    out = {"data": data}
+    if errors:
+        out["errors"] = errors
+    return out
+
+
+def _resolve_table(ds, session, tb, args, sub):
+    limit = int(args.get("limit", 100))
+    start = int(args.get("start", 0))
+    order = args.get("order")
+    idv = args.get("id")
+    vars = {}
+    if idv is not None:
+        target = idv if ":" in str(idv) else f"{tb}:{idv}"
+        sql = f"SELECT * FROM {target}"
+    else:
+        sql = f"SELECT * FROM {tb}"
+        filters = args.get("filter") or {}
+        conds = []
+        for i, (k, v) in enumerate(dict(filters).items()):
+            vars[f"f{i}"] = v
+            conds.append(f"{k} = $f{i}")
+        if conds:
+            sql += " WHERE " + " AND ".join(conds)
+        if order:
+            sql += f" ORDER BY {order}"
+        sql += f" LIMIT {limit} START {start}"
+    res = ds.execute(sql, session=session, vars=vars)
+    last = res[-1]
+    if last.error:
+        raise SdbError(last.error)
+    rows = last.result if isinstance(last.result, list) else [last.result]
+    out = []
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        out.append(_project(row, sub))
+    return out
+
+
+def _project(row: dict, sub):
+    if not sub:
+        return to_json(row)
+    out = {}
+    for name, _args, nested in sub:
+        v = row.get(name, NONE)
+        if nested and isinstance(v, dict):
+            v = _project(v, nested)
+        elif nested and isinstance(v, list):
+            v = [_project(x, nested) if isinstance(x, dict) else to_json(x) for x in v]
+        else:
+            v = to_json(v)
+        out[name] = v
+    return out
+
+
+def _schema_introspection(ds, session):
+    from surrealdb_tpu import key as K
+
+    types = []
+    if session.ns and session.db:
+        txn = ds.transaction(write=False)
+        try:
+            for _k, tdef in txn.scan_vals(
+                *K.prefix_range(K.tb_prefix(session.ns, session.db))
+            ):
+                types.append({"name": tdef.name, "kind": "OBJECT"})
+        finally:
+            txn.cancel()
+    return {"queryType": {"name": "Query"}, "types": types}

@@ -1,0 +1,178 @@
+"""AuthN/Z (reference: core/src/iam/ — root/ns/db users, DEFINE ACCESS
+record signup/signin, roles, token issuance).
+
+Tokens are HS256 JWTs signed with a per-datastore secret (stdlib hmac);
+record access runs the access method's SIGNIN/SIGNUP clauses with
+$user-style params bound, exactly like the reference's record access flow."""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import json
+import secrets
+import time
+from hashlib import sha256
+
+from surrealdb_tpu import key as K
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.fnc.misc_fns import password_compare
+from surrealdb_tpu.kvs.ds import Session
+from surrealdb_tpu.val import NONE, RecordId, to_json
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).decode().rstrip("=")
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _secret(ds) -> bytes:
+    sec = getattr(ds, "_jwt_secret", None)
+    if sec is None:
+        sec = secrets.token_bytes(32)
+        ds._jwt_secret = sec
+    return sec
+
+
+def issue_token(ds, claims: dict, ttl_s: int = 3600) -> str:
+    header = {"alg": "HS256", "typ": "JWT"}
+    now = int(time.time())
+    payload = {"iat": now, "exp": now + ttl_s, "iss": "surrealdb-tpu", **claims}
+    h = _b64(json.dumps(header).encode())
+    p = _b64(json.dumps(payload).encode())
+    sig = hmac.new(_secret(ds), f"{h}.{p}".encode(), sha256).digest()
+    return f"{h}.{p}.{_b64(sig)}"
+
+
+def verify_token(ds, token: str) -> dict:
+    try:
+        h, p, s = token.split(".")
+    except ValueError:
+        raise SdbError("There was a problem with authentication")
+    want = hmac.new(_secret(ds), f"{h}.{p}".encode(), sha256).digest()
+    if not hmac.compare_digest(want, _unb64(s)):
+        raise SdbError("There was a problem with authentication")
+    payload = json.loads(_unb64(p))
+    if payload.get("exp", 0) < time.time():
+        raise SdbError("The token has expired")
+    return payload
+
+
+def signin(ds, session: Session, creds: dict) -> str:
+    ns = creds.get("NS") or creds.get("ns") or creds.get("namespace")
+    db = creds.get("DB") or creds.get("db") or creds.get("database")
+    ac = creds.get("AC") or creds.get("ac") or creds.get("access")
+    user = creds.get("user") or creds.get("username")
+    passwd = creds.get("pass") or creds.get("password")
+
+    txn = ds.transaction(write=False)
+    try:
+        if ac and ns and db:
+            return _record_access(ds, session, ns, db, ac, creds, "signin")
+        if user is not None:
+            # db, then ns, then root user
+            for base, n, d in (
+                ("db", ns, db) if db else (None, None, None),
+                ("ns", ns, None) if ns else (None, None, None),
+                ("root", None, None),
+            ):
+                if base is None:
+                    continue
+                ud = txn.get_val(K.us_def(base, n, d, user))
+                if ud is not None and password_compare(ud.passhash, passwd or ""):
+                    session.auth_level = (
+                        "owner" if "Owner" in ud.roles else
+                        "editor" if "Editor" in ud.roles else "viewer"
+                    )
+                    if n:
+                        session.ns = n
+                    if d:
+                        session.db = d
+                    return issue_token(
+                        ds, {"ID": user, "base": base, "NS": n, "DB": d}
+                    )
+            raise SdbError(
+                "There was a problem with authentication"
+            )
+        raise SdbError("There was a problem with authentication")
+    finally:
+        txn.cancel()
+
+
+def signup(ds, session: Session, creds: dict) -> str:
+    ns = creds.get("NS") or creds.get("ns") or creds.get("namespace")
+    db = creds.get("DB") or creds.get("db") or creds.get("database")
+    ac = creds.get("AC") or creds.get("ac") or creds.get("access")
+    if not (ac and ns and db):
+        raise SdbError("There was a problem with authentication")
+    return _record_access(ds, session, ns, db, ac, creds, "signup")
+
+
+def _record_access(ds, session, ns, db, ac, creds, mode) -> str:
+    txn = ds.transaction(write=False)
+    try:
+        acc = txn.get_val(K.ac_def("db", ns, db, ac))
+    finally:
+        txn.cancel()
+    if acc is None or acc.kind != "record":
+        raise SdbError("There was a problem with authentication")
+    expr = acc.config.get(mode)
+    if expr is None:
+        raise SdbError("There was a problem with authentication")
+    vars = {
+        k: v
+        for k, v in creds.items()
+        if k not in ("NS", "DB", "AC", "ns", "db", "ac", "namespace",
+                     "database", "access")
+    }
+    sess = Session(ns=ns, db=db, auth_level="owner")
+    from surrealdb_tpu.exec.context import Ctx
+    from surrealdb_tpu.exec.eval import evaluate
+
+    txn = ds.transaction(write=True)
+    try:
+        ctx = Ctx(ds, sess, txn)
+        ctx.vars.update(vars)
+        out = evaluate(expr, ctx)
+        txn.commit()
+    except SdbError:
+        txn.cancel()
+        raise
+    if isinstance(out, list):
+        out = out[0] if out else NONE
+    if isinstance(out, dict):
+        out = out.get("id", NONE)
+    if not isinstance(out, RecordId):
+        raise SdbError("There was a problem with authentication")
+    session.ns = ns
+    session.db = db
+    session.ac = ac
+    session.auth_level = "record"
+    session.rid = out
+    return issue_token(
+        ds, {"ID": out.render(), "NS": ns, "DB": db, "AC": ac}
+    )
+
+
+def authenticate(ds, session: Session, token: str):
+    payload = verify_token(ds, token)
+    if payload.get("AC"):
+        session.ns = payload.get("NS")
+        session.db = payload.get("DB")
+        session.ac = payload.get("AC")
+        session.auth_level = "record"
+        from surrealdb_tpu.exec.static_eval import static_value
+        from surrealdb_tpu.syn.parser import parse_record_literal
+
+        session.rid = static_value(parse_record_literal(payload["ID"]))
+    else:
+        base = payload.get("base", "root")
+        session.auth_level = "owner" if base else "owner"
+        if payload.get("NS"):
+            session.ns = payload["NS"]
+        if payload.get("DB"):
+            session.db = payload["DB"]
+    return NONE

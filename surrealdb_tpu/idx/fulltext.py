@@ -309,6 +309,7 @@ def plan_matches(tb, cond, mts, indexes, ctx, stmt):
     # mutating in place would leak subquery match contexts into the parent
     ft_ctx = dict(ctx.vars.get("__ft__") or {})
     ctx.vars["__ft__"] = ft_ctx
+    seen_refs = set()
     common = None
     rid_objs = {}
     rest = cond
@@ -328,6 +329,9 @@ def plan_matches(tb, cond, mts, indexes, ctx, stmt):
         q = evaluate(mt.rhs, ctx)
         hits, offsets = ft_search(idef, str(q), ctx, boolean=mt.boolean)
         ref = mt.ref if mt.ref is not None else 0
+        if ref in seen_refs:
+            raise SdbError(f"Duplicated Match reference: {ref}")
+        seen_refs.add(ref)
         ft_ctx[ref] = {
             "scores": {hashable(r): s for r, s in hits},
             "offsets": offsets,
@@ -406,7 +410,10 @@ def search_highlight(args, ctx):
     if len(args) < 3:
         raise SdbError("Incorrect arguments for function search::highlight()")
     open_t, close_t = str(args[0]), str(args[1])
-    ref = int(args[2]) if not isinstance(args[2], bool) else 0
+    try:
+        ref = int(args[2]) if not isinstance(args[2], bool) else 0
+    except (TypeError, ValueError):
+        raise SdbError("Incorrect arguments for function search::highlight()")
     partial = bool(args[3]) if len(args) > 3 else False
     entry = _ft_entry(ctx, ref)
     if entry is None or ctx.doc_id is None or ctx.doc is None:

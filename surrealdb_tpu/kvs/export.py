@@ -1,0 +1,108 @@
+"""SQL-text export / import (reference: core/src/kvs/export.rs, /export and
+/import routes, `surreal export|import`).
+
+Export emits a re-runnable SurrealQL script: OPTION header, DEFINE statements
+from the catalog (canonical render_def text), then INSERT statements per
+table in record order."""
+
+from __future__ import annotations
+
+from surrealdb_tpu import key as K
+from surrealdb_tpu.exec.render_def import (
+    render_access,
+    render_analyzer,
+    render_event,
+    render_field,
+    render_function,
+    render_index,
+    render_param,
+    render_sequence,
+    render_table,
+    render_user,
+)
+from surrealdb_tpu.val import render
+
+
+def export_sql(ds, ns: str, db: str) -> str:
+    txn = ds.transaction(write=False)
+    try:
+        out = [
+            "-- ------------------------------",
+            "-- OPTION",
+            "-- ------------------------------",
+            "",
+            "OPTION IMPORT;",
+            "",
+        ]
+
+        def section(title):
+            out.extend([
+                "-- ------------------------------",
+                f"-- {title}",
+                "-- ------------------------------",
+                "",
+            ])
+
+        params = list(txn.scan_vals(*K.prefix_range(K.pa_prefix(ns, db))))
+        if params:
+            section("PARAMS")
+            for _k, d in params:
+                out.append(render_param(d) + ";")
+            out.append("")
+        funcs = list(txn.scan_vals(*K.prefix_range(K.fc_prefix(ns, db))))
+        if funcs:
+            section("FUNCTIONS")
+            for _k, d in funcs:
+                out.append(render_function(d) + ";")
+            out.append("")
+        azs = list(txn.scan_vals(*K.prefix_range(K.az_prefix(ns, db))))
+        if azs:
+            section("ANALYZERS")
+            for _k, d in azs:
+                out.append(render_analyzer(d) + ";")
+            out.append("")
+        accesses = list(txn.scan_vals(*K.prefix_range(K.ac_prefix("db", ns, db))))
+        if accesses:
+            section("ACCESSES")
+            for _k, d in accesses:
+                out.append(render_access(d) + ";")
+            out.append("")
+        users = list(txn.scan_vals(*K.prefix_range(K.us_prefix("db", ns, db))))
+        if users:
+            section("USERS")
+            for _k, d in users:
+                out.append(render_user(d) + ";")
+            out.append("")
+        tables = [d for _k, d in txn.scan_vals(*K.prefix_range(K.tb_prefix(ns, db)))]
+        for tdef in tables:
+            tb = tdef.name
+            section(f"TABLE: {tb}")
+            out.append(render_table(tdef) + ";")
+            for _k, d in txn.scan_vals(*K.prefix_range(K.fd_prefix(ns, db, tb))):
+                out.append(render_field(d, tb) + ";")
+            for _k, d in txn.scan_vals(*K.prefix_range(K.ix_prefix(ns, db, tb))):
+                out.append(render_index(d) + ";")
+            for _k, d in txn.scan_vals(*K.prefix_range(K.ev_prefix(ns, db, tb))):
+                out.append(render_event(d, tb) + ";")
+            out.append("")
+            section(f"TABLE DATA: {tb}")
+            rows = []
+            for _k, doc in txn.scan_vals(
+                *K.prefix_range(K.record_prefix(ns, db, tb))
+            ):
+                rows.append(render(doc))
+            if rows:
+                # batched INSERTs (reference batches records per statement)
+                batch = 64
+                for i in range(0, len(rows), batch):
+                    chunk = ",\n\t".join(rows[i : i + batch])
+                    out.append(f"INSERT [\n\t{chunk}\n];")
+            out.append("")
+        return "\n".join(out)
+    finally:
+        txn.cancel()
+
+
+def import_sql(ds, ns: str, db: str, text: str):
+    """Run an exported script; returns per-statement results."""
+    return ds.execute(text, ns=ns, db=db)

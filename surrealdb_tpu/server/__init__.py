@@ -1,0 +1,362 @@
+"""HTTP + WebSocket server surface (reference: surrealdb/server/ — axum
+router server/src/ntw/mod.rs:130 and the WebSocket session actor
+server/src/rpc/websocket.rs).
+
+Stdlib-only: ThreadingHTTPServer for routes, hand-rolled RFC6455 WebSocket
+upgrade on /rpc with live-query notification push."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.kvs.ds import Datastore, Session
+from surrealdb_tpu.rpc import RpcError, RpcSession
+from surrealdb_tpu.val import to_json
+
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class SurrealHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    ds: Datastore = None  # set by make_server
+    server_obj = None
+
+    def log_message(self, fmt, *args):
+        pass
+
+    # -- helpers ------------------------------------------------------------
+    def _json(self, code: int, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _text(self, code: int, text: str, ctype="text/plain"):
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _session(self) -> Session:
+        s = Session(
+            ns=self.headers.get("surreal-ns") or self.headers.get("NS"),
+            db=self.headers.get("surreal-db") or self.headers.get("DB"),
+        )
+        auth = self.headers.get("Authorization") or ""
+        if auth.startswith("Bearer "):
+            from surrealdb_tpu.iam import authenticate
+
+            try:
+                authenticate(self.ds, s, auth[7:])
+            except SdbError:
+                s.auth_level = "none"
+        return s
+
+    def _run_sql(self, sql: str, sess: Session, vars=None):
+        res = self.ds.execute(sql, session=sess, vars=vars or {})
+        return [
+            {
+                "status": "OK" if r.ok else "ERR",
+                "result": to_json(r.result) if r.ok else r.error,
+                "time": f"{r.time_ns / 1e6:.3f}ms",
+            }
+            for r in res
+        ]
+
+    # -- routes -------------------------------------------------------------
+    def do_GET(self):
+        path = urlparse(self.path).path
+        if path in ("/status", "/health"):
+            self._text(200, "")
+            return
+        if path == "/version":
+            import surrealdb_tpu
+
+            self._text(200, f"surrealdb-tpu-{surrealdb_tpu.__version__}")
+            return
+        if path == "/export":
+            sess = self._session()
+            from surrealdb_tpu.kvs.export import export_sql
+
+            if not sess.ns or not sess.db:
+                self._json(400, {"error": "Specify ns and db headers"})
+                return
+            self._text(200, export_sql(self.ds, sess.ns, sess.db),
+                       "application/octet-stream")
+            return
+        if path == "/rpc":
+            self._ws_upgrade()
+            return
+        if path.startswith("/key/"):
+            self._key_route("GET")
+            return
+        self._json(404, {"error": "Not found"})
+
+    def do_POST(self):
+        path = urlparse(self.path).path
+        if path == "/sql":
+            sess = self._session()
+            sql = self._body().decode()
+            try:
+                self._json(200, self._run_sql(sql, sess))
+            except SdbError as e:
+                self._json(400, {"error": str(e)})
+            return
+        if path == "/import":
+            sess = self._session()
+            sql = self._body().decode()
+            self._json(200, self._run_sql(sql, sess))
+            return
+        if path == "/signin":
+            from surrealdb_tpu.iam import signin
+
+            try:
+                creds = json.loads(self._body() or b"{}")
+                token = signin(self.ds, self._session(), creds)
+                self._json(200, {"code": 200, "details": "Authentication succeeded", "token": token})
+            except SdbError as e:
+                self._json(401, {"code": 401, "details": str(e)})
+            return
+        if path == "/signup":
+            from surrealdb_tpu.iam import signup
+
+            try:
+                creds = json.loads(self._body() or b"{}")
+                token = signup(self.ds, self._session(), creds)
+                self._json(200, {"code": 200, "details": "Authentication succeeded", "token": token})
+            except SdbError as e:
+                self._json(401, {"code": 401, "details": str(e)})
+            return
+        if path == "/rpc":
+            # HTTP one-shot RPC
+            try:
+                req = json.loads(self._body() or b"{}")
+                rs = RpcSession(self.ds)
+                rs.session = self._session()
+                out = rs.handle(req.get("method", ""), req.get("params") or [])
+                self._json(200, {"id": req.get("id"), "result": to_json(out)})
+            except RpcError as e:
+                self._json(200, {"id": req.get("id"),
+                                 "error": {"code": e.code, "message": str(e)}})
+            except SdbError as e:
+                self._json(200, {"id": req.get("id"),
+                                 "error": {"code": -32000, "message": str(e)}})
+            return
+        if path.startswith("/key/"):
+            self._key_route("POST")
+            return
+        if path == "/graphql":
+            from surrealdb_tpu.gql import execute_graphql
+
+            sess = self._session()
+            try:
+                req = json.loads(self._body() or b"{}")
+                out = execute_graphql(
+                    self.ds, sess, req.get("query", ""),
+                    req.get("variables") or {},
+                )
+                self._json(200, to_json(out))
+            except SdbError as e:
+                self._json(200, {"errors": [{"message": str(e)}]})
+            return
+        self._json(404, {"error": "Not found"})
+
+    def do_PUT(self):
+        if urlparse(self.path).path.startswith("/key/"):
+            self._key_route("PUT")
+            return
+        self._json(404, {"error": "Not found"})
+
+    def do_PATCH(self):
+        if urlparse(self.path).path.startswith("/key/"):
+            self._key_route("PATCH")
+            return
+        self._json(404, {"error": "Not found"})
+
+    def do_DELETE(self):
+        if urlparse(self.path).path.startswith("/key/"):
+            self._key_route("DELETE")
+            return
+        self._json(404, {"error": "Not found"})
+
+    def _key_route(self, method: str):
+        """REST CRUD: /key/:table[/:id] (reference ntw key routes)."""
+        parts = urlparse(self.path).path.split("/")[2:]
+        qs = parse_qs(urlparse(self.path).query)
+        sess = self._session()
+        tb = parts[0] if parts else None
+        rid = parts[1] if len(parts) > 1 else None
+        if not tb:
+            self._json(400, {"error": "Missing table"})
+            return
+        target = f"{tb}:{rid}" if rid else tb
+        vars = {}
+        body = self._body()
+        data = None
+        if body:
+            try:
+                data = json.loads(body)
+            except ValueError:
+                self._json(400, {"error": "Invalid JSON body"})
+                return
+        if method == "GET":
+            limit = qs.get("limit", ["100"])[0]
+            start = qs.get("start", ["0"])[0]
+            sql = f"SELECT * FROM {target} LIMIT {int(limit)} START {int(start)}"
+        elif method == "POST":
+            vars["data"] = data or {}
+            sql = f"CREATE {target} CONTENT $data"
+        elif method == "PUT":
+            vars["data"] = data or {}
+            sql = f"UPDATE {target} CONTENT $data"
+        elif method == "PATCH":
+            vars["data"] = data or {}
+            sql = f"UPDATE {target} MERGE $data"
+        else:
+            sql = f"DELETE {target} RETURN BEFORE"
+        self._json(200, self._run_sql(sql, sess, vars))
+
+    # -- websocket ----------------------------------------------------------
+    def _ws_upgrade(self):
+        key = self.headers.get("Sec-WebSocket-Key")
+        if not key or "websocket" not in (
+            self.headers.get("Upgrade") or ""
+        ).lower():
+            self._json(426, {"error": "WebSocket upgrade required"})
+            return
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_MAGIC).encode()).digest()
+        ).decode()
+        self.send_response(101, "Switching Protocols")
+        self.send_header("Upgrade", "websocket")
+        self.send_header("Connection", "Upgrade")
+        self.send_header("Sec-WebSocket-Accept", accept)
+        self.end_headers()
+        self.close_connection = True
+        self._ws_serve()
+
+    def _ws_send(self, payload: str):
+        data = payload.encode()
+        header = b"\x81"  # FIN + text
+        n = len(data)
+        if n < 126:
+            header += struct.pack("!B", n)
+        elif n < (1 << 16):
+            header += struct.pack("!BH", 126, n)
+        else:
+            header += struct.pack("!BQ", 127, n)
+        with self._ws_lock:
+            self.connection.sendall(header + data)
+
+    def _ws_recv(self):
+        """Read one frame; returns (opcode, payload) or None on close."""
+        hdr = self.rfile.read(2)
+        if len(hdr) < 2:
+            return None
+        b1, b2 = hdr
+        opcode = b1 & 0x0F
+        masked = b2 & 0x80
+        n = b2 & 0x7F
+        if n == 126:
+            n = struct.unpack("!H", self.rfile.read(2))[0]
+        elif n == 127:
+            n = struct.unpack("!Q", self.rfile.read(8))[0]
+        mask = self.rfile.read(4) if masked else b"\x00" * 4
+        data = bytearray(self.rfile.read(n))
+        if masked:
+            for i in range(len(data)):
+                data[i] ^= mask[i % 4]
+        return opcode, bytes(data)
+
+    def _ws_serve(self):
+        rs = RpcSession(self.ds)
+        self._ws_lock = threading.Lock()
+
+        # live-query notification forwarding
+        def on_notify(notification):
+            if notification.live_id in rs.live_ids:
+                try:
+                    self._ws_send(json.dumps({
+                        "result": {
+                            "id": notification.live_id,
+                            "action": notification.action,
+                            "record": to_json(notification.record),
+                            "result": to_json(notification.result),
+                        }
+                    }))
+                except OSError:
+                    pass
+
+        self.ds.notification_handlers.append(on_notify)
+        try:
+            while True:
+                frame = self._ws_recv()
+                if frame is None:
+                    break
+                opcode, data = frame
+                if opcode == 0x8:  # close
+                    break
+                if opcode == 0x9:  # ping -> pong
+                    with self._ws_lock:
+                        self.connection.sendall(
+                            b"\x8a" + struct.pack("!B", len(data)) + data
+                        )
+                    continue
+                if opcode not in (0x1, 0x2):
+                    continue
+                try:
+                    req = json.loads(data.decode())
+                except ValueError:
+                    self._ws_send(json.dumps({
+                        "error": {"code": -32700, "message": "Parse error"}
+                    }))
+                    continue
+                rid = req.get("id")
+                try:
+                    out = rs.handle(
+                        req.get("method", ""), req.get("params") or []
+                    )
+                    self._ws_send(json.dumps(
+                        {"id": rid, "result": to_json(out)}
+                    ))
+                except RpcError as e:
+                    self._ws_send(json.dumps({
+                        "id": rid,
+                        "error": {"code": e.code, "message": str(e)},
+                    }))
+                except SdbError as e:
+                    self._ws_send(json.dumps({
+                        "id": rid,
+                        "error": {"code": -32000, "message": str(e)},
+                    }))
+        finally:
+            try:
+                self.ds.notification_handlers.remove(on_notify)
+            except ValueError:
+                pass
+
+
+def make_server(ds: Datastore, host="127.0.0.1", port=8000) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (SurrealHandler,), {"ds": ds})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve(ds: Datastore, host="127.0.0.1", port=8000):
+    srv = make_server(ds, host, port)
+    print(f"surrealdb-tpu listening on http://{host}:{port}")
+    srv.serve_forever()

@@ -917,6 +917,8 @@ class Parser:
                         depth = 0
                         while True:
                             t = self.next()
+                            if t.kind == L.EOF:
+                                raise self.err("unterminated middleware arguments")
                             if t.kind == L.OP and t.text == "(":
                                 depth += 1
                             elif t.kind == L.OP and t.text == ")":
@@ -1292,9 +1294,17 @@ class Parser:
         perms = {}
         while self.eat_kw("for"):
             kinds = [self.ident().lower()]
+            stop = False
             while self.eat_op(","):
-                if not self.at_kw("for"):
-                    kinds.append(self.ident().lower())
+                if self.at_kw("for"):
+                    stop = True
+                    break
+                kinds.append(self.ident().lower())
+            if stop:
+                # `FOR select, FOR ...`: value defaults empty -> keep parsing
+                for k in kinds:
+                    perms.setdefault(k, False)
+                continue
             if self.eat_kw("none"):
                 val = False
             elif self.eat_kw("full"):
@@ -1766,8 +1776,7 @@ class Parser:
                 continue
             if self.at_op("?") and self.peek(1).kind == L.OP and \
                     self.peek(1).text == ".":
-                self.next()
-                self.next()
+                self.next()  # the `.` branch parses the following field
                 parts.append(POptional())
                 continue
             if self.at_op("["):
