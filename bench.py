@@ -19,6 +19,52 @@ import time
 import numpy as np
 
 
+def bench_graph(n_nodes: int, n_edges: int, hops: int = 3):
+    """3-hop frontier expansion: device CSR scan vs host adjacency walk
+    (BASELINE.md config 4: 3-hop over a RELATE graph)."""
+    import jax
+    import jax.numpy as jnp
+
+    from surrealdb_tpu.graph.csr import _multi_hop_impl
+
+    rng = np.random.default_rng(11)
+    rows = rng.integers(0, n_nodes, size=n_edges).astype(np.int32)
+    cols = rng.integers(0, n_nodes, size=n_edges).astype(np.int32)
+    start = np.zeros(n_nodes, dtype=bool)
+    start_nodes = rng.integers(0, n_nodes, size=16)
+    start[start_nodes] = True
+
+    fn = jax.jit(_multi_hop_impl, static_argnums=(3, 4, 5))
+    rows_d, cols_d = jax.device_put(rows), jax.device_put(cols)
+    out = fn(rows_d, cols_d, jnp.asarray(start), n_nodes, hops, False)
+    _ = np.asarray(out)  # warm: compile + materialize
+    iters = 8
+    t0 = time.perf_counter()
+    for _i in range(iters):
+        out = fn(rows_d, cols_d, jnp.asarray(start), n_nodes, hops, False)
+        got = np.asarray(out)
+    dev_ms = (time.perf_counter() - t0) / iters * 1000
+
+    # host baseline: scipy-free sparse expansion with numpy
+    t0 = time.perf_counter()
+    f = start
+    for _h in range(hops):
+        contrib = f[rows]
+        nf = np.zeros(n_nodes, dtype=bool)
+        np.logical_or.at(nf, cols, contrib)
+        f = nf
+    host_ms = (time.perf_counter() - t0) * 1000
+    assert (got == f).all(), "device/host 3-hop mismatch"
+    return {
+        "metric": f"graph_3hop_{n_nodes // 1000}k_nodes_{n_edges // 1000}k_edges",
+        "value": round(dev_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(host_ms / max(dev_ms, 1e-9), 2),
+        "host_ms": round(host_ms, 3),
+        "frontier": int(got.sum()),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -26,7 +72,15 @@ def main():
     ap.add_argument("--dim", type=int, default=None)
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--graph", action="store_true",
+                    help="run the 3-hop graph bench instead of KNN")
     args = ap.parse_args()
+
+    if args.graph:
+        n_nodes = 100_000 if args.quick else 1_000_000
+        n_edges = 1_000_000 if args.quick else 10_000_000
+        print(json.dumps(bench_graph(n_nodes, n_edges)))
+        return 0
 
     n = args.n or (100_000 if args.quick else 1_000_000)
     dim = args.dim or (128 if args.quick else 768)

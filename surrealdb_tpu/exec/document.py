@@ -974,6 +974,8 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
     if not tdef.drop:
         ctx.txn.set(K.record(ns, db, rid.tb, rid.id), serialize(after))
         ctx.record_cache[(rid.tb, K.enc_value(rid.id))] = after
+    gk = (ns, db, rid.tb)
+    ctx.ds.graph_versions[gk] = ctx.ds.graph_versions.get(gk, 0) + 1
     # indexes
     index_update(rid, before, after, ctx)
     # record references (REFERENCE fields)
@@ -1193,6 +1195,8 @@ def delete_one(rid: RecordId, before, output, ctx: Ctx):
     apply_ref_on_delete(rid, ctx)
     ctx.txn.delete(K.record(ns, db, rid.tb, rid.id))
     ctx.record_cache.pop((rid.tb, K.enc_value(rid.id)), None)
+    gk = (ns, db, rid.tb)
+    ctx.ds.graph_versions[gk] = ctx.ds.graph_versions.get(gk, 0) + 1
     # purge graph edges; cascade delete edge records hanging off this node
     from surrealdb_tpu.graph import purge_edges
 

@@ -441,7 +441,10 @@ def _get_field(doc, name, ctx):
 
 
 def walk(val, parts, ctx: Ctx, depth=0):
-    for i, part in enumerate(parts):
+    i = -1
+    while i + 1 < len(parts):
+        i += 1
+        part = parts[i]
         t = type(part)
         if t is PField:
             val = _apply_field(val, part.name, ctx)
@@ -617,6 +620,65 @@ def _apply_method(val, part, ctx):
     return method_call(val, part.name, args, ctx)
 
 
+def _csr_pair_pattern(g1, g2):
+    """Is (g1, g2) a plain `->edge->node` pair eligible for the CSR device
+    hop? Returns (edge_tb, node_tb, dir) or None."""
+    from surrealdb_tpu.expr.ast import PGraph as _PG
+
+    if not isinstance(g1, _PG) or not isinstance(g2, _PG):
+        return None
+    for g in (g1, g2):
+        if (
+            g.cond is not None
+            or g.expr is not None
+            or g.dir not in ("out", "in")
+            or len(g.what) != 1
+            or g.what[0][1] is not None
+        ):
+            return None
+    if g1.dir != g2.dir:
+        return None
+    return g1.what[0][0], g2.what[0][0], g1.dir
+
+
+def _csr_pair_hop(val, g1, g2, ctx):
+    """Device fast path for `->edge->node` pairs over big frontiers inside
+    recursion (where set semantics apply): the two `~`-key scans become one
+    CSR gather+scatter hop on the TPU (SURVEY §3.4 / §7 step 5). Returns
+    None when the pattern or scale doesn't apply. NOTE: results are
+    deduplicated — only used where dedup is already the semantics."""
+    from surrealdb_tpu.expr.ast import PGraph as _PG
+
+    if not isinstance(g2, _PG):
+        return None
+    for g in (g1, g2):
+        if (
+            g.cond is not None
+            or g.expr is not None
+            or g.dir not in ("out", "in")
+            or len(g.what) != 1
+            or g.what[0][1] is not None
+        ):
+            return None
+    if g1.dir != g2.dir:
+        return None
+    rids = _collect_rids(val, ctx)
+    from surrealdb_tpu.graph import TPU_FRONTIER_THRESHOLD
+
+    if len(rids) < TPU_FRONTIER_THRESHOLD:
+        return None
+    edge_tb = g1.what[0][0]
+    node_tb = g2.what[0][0]
+    src_tbs = {r.tb for r in rids}
+    if src_tbs != {node_tb}:
+        return None
+    from surrealdb_tpu.graph.csr import get_csr
+
+    csr = get_csr(ctx.ds, ctx, node_tb, edge_tb, g1.dir)
+    keys = csr.multi_hop([r.id for r in rids], 1)
+    return [RecordId(node_tb, k) for k in keys]
+
+
 def _apply_graph(val, g: PGraph, ctx: Ctx):
     """One graph hop: scan `~` (or `&` reference) keys of each source record
     (SURVEY §3.4); `->(SELECT ...)` lookups run the select over the hop's
@@ -719,6 +781,10 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
         (n for n in names if n in ("collect", "path", "shortest")), None
     )
 
+    csr_pat = (
+        _csr_pair_pattern(parts[0], parts[1]) if len(parts) == 2 else None
+    )
+
     def step(node):
         out = walk(node, parts, ctx)
         if out is NONE or out is None:
@@ -746,6 +812,37 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
 
     while depth < rmax and frontier:
         nxt = []
+        from surrealdb_tpu.graph import TPU_FRONTIER_THRESHOLD
+
+        if (
+            csr_pat is not None
+            and mode != "shortest"
+            and len(frontier) >= TPU_FRONTIER_THRESHOLD
+            and all(isinstance(x, RecordId) for x in frontier)
+            and {x.tb for x in frontier} == {csr_pat[1]}
+        ):
+            # device hop: dedup matches the visited-set semantics here
+            from surrealdb_tpu.graph.csr import get_csr
+
+            edge_tb, node_tb, gdir = csr_pat
+            csr = get_csr(ctx.ds, ctx, node_tb, edge_tb, gdir)
+            keys = csr.multi_hop([x.id for x in frontier], 1)
+            was_list = True
+            for kk in keys:
+                ch = RecordId(node_tb, kk)
+                h = hashable(ch)
+                if h in visited:
+                    continue
+                visited.add(h)
+                nxt.append(ch)
+            depth += 1
+            if mode in ("collect", "path") and depth >= rmin:
+                collected.extend(nxt)
+            frontier = nxt
+            if nxt:
+                last_nonempty = nxt
+                last_depth = depth
+            continue
         for node in frontier:
             children, islist = step(node)
             was_list = was_list or islist

@@ -258,16 +258,17 @@ def _perm_of(perms, action, default):
 
 
 def _perms_sql(perms, default=False, field=False) -> str:
-    """Reference sql/permission.rs fmt_sql: NONE / FULL / grouped FOR."""
+    """Reference sql/permission.rs fmt_sql: NONE / FULL / grouped FOR.
+    Fields don't track delete (implicitly Full), so all-NONE field perms
+    never collapse to the bare NONE form."""
     actions = _ACTIONS[:3] if field else _ACTIONS
     vals = {a: _perm_of(perms, a, default) for a in _ACTIONS}
     considered = [vals[a] for a in actions]
     if field:
-        # fields don't track delete
-        pass
-    if all(v is False for v in considered) and (field or vals["delete"] is False):
+        vals["delete"] = True
+    if all(v is False for v in considered) and vals["delete"] is False:
         return "PERMISSIONS NONE"
-    if all(v is True for v in considered) and (field or vals["delete"] is True):
+    if all(v is True for v in considered) and vals["delete"] is True:
         return "PERMISSIONS FULL"
     # group kinds by identical permission, order select, create, update, delete
     lines = []
@@ -426,6 +427,12 @@ def render_field(d, tb) -> str:
         out += f" ASSERT {_expr_sql(d.assert_)}"
     if d.computed is not None:
         out += f" COMPUTED {_expr_sql(d.computed)}"
+    if d.reference is not None:
+        out += " REFERENCE ON DELETE " + d.reference.get(
+            "on_delete", "ignore"
+        ).upper()
+        if d.reference.get("on_delete") == "then":
+            out += f" {_expr_sql(d.reference.get('then'))}"
     if d.comment:
         out += f" COMMENT {_str_sql(d.comment)}"
     out += " " + _perms_sql(d.permissions, default=True, field=True)
@@ -480,11 +487,13 @@ def render_index(d) -> str:
             f" TYPE {h.get('vector_type', 'f64').upper()}"
             f" EFC {h.get('ef_construction', 150)} M {h.get('m', 12)}"
         )
+    if d.comment:
+        out += f" COMMENT {_str_sql(d.comment)}"
     return out
 
 
 def index_structure(d) -> dict:
-    out = {"name": d.name, "what": d.tb, "cols": list(d.cols_str)}
+    out = {"name": d.name, "table": d.tb, "cols": list(d.cols_str)}
     if d.unique:
         out["index"] = "UNIQUE"
     elif d.count:

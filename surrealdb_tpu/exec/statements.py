@@ -1421,7 +1421,7 @@ def _s_define_param(n: DefineParam, ctx):
     _ensure_ns_db(ctx)
     ns, db = ctx.need_ns_db()
     kdef = K.pa_def(ns, db, n.name)
-    if _exists_guard(ctx, kdef, n.name, "param", n.if_not_exists, n.overwrite):
+    if _exists_guard(ctx, kdef, f"${n.name}", "param", n.if_not_exists, n.overwrite):
         return NONE
     v = evaluate(n.value, ctx)
     ctx.txn.set_val(kdef, ParamDef(n.name, v, n.permissions, n.comment))
@@ -1460,7 +1460,9 @@ def _s_define_user(n: DefineUser, ctx):
     ns = ctx.session.ns if base in ("ns", "db") else None
     db = ctx.session.db if base == "db" else None
     kdef = K.us_def(base, ns, db, n.name)
-    if _exists_guard(ctx, kdef, n.name, "user", n.if_not_exists, n.overwrite):
+    ulabel = {"root": "root user", "ns": "namespace user",
+              "db": "database user"}[base]
+    if _exists_guard(ctx, kdef, n.name, ulabel, n.if_not_exists, n.overwrite):
         return NONE
     ph = n.passhash or (password_hash(n.password) if n.password else "")
     ctx.txn.set_val(
@@ -1545,6 +1547,12 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
         for ixkey in list(ctx.ds.ft_indexes):
             if ixkey[:3] == (ns, db, n.name):
                 ctx.ds.ft_indexes.pop(ixkey, None)
+        gk = (ns, db, n.name)
+        ctx.ds.graph_versions[gk] = ctx.ds.graph_versions.get(gk, 0) + 1
+        if ctx.ds.graph_engine:
+            for ck in list(ctx.ds.graph_engine):
+                if ck[2] == n.name or ck[3] == n.name:
+                    ctx.ds.graph_engine.pop(ck, None)
         return NONE
     if kind == "field":
         name_str = _field_name_str(n.name) if isinstance(n.name, list) else n.name
@@ -1568,8 +1576,10 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
         return NONE
     if kind == "param":
         key = K.pa_def(ns, db, n.name)
-        if _guard(key, n.name):
-            return NONE
+        if ctx.txn.get(key) is None:
+            if n.if_exists:
+                return NONE
+            raise SdbError(f"The param '${n.name}' does not exist")
         ctx.txn.delete(key)
         return NONE
     if kind == "function":
@@ -1586,10 +1596,14 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
         return NONE
     if kind == "user":
         base = n.base or "root"
+        ulabel = {"root": "root user", "ns": "namespace user",
+                  "db": "database user"}[base]
         key = K.us_def(base, ns if base in ("ns", "db") else None,
                        db if base == "db" else None, n.name)
-        if _guard(key, n.name):
-            return NONE
+        if ctx.txn.get(key) is None:
+            if n.if_exists:
+                return NONE
+            raise SdbError(f"The {ulabel} '{n.name}' does not exist")
         ctx.txn.delete(key)
         return NONE
     if kind == "access":
@@ -1606,6 +1620,11 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
             return NONE
         ctx.txn.delete(key)
         return NONE
+    if kind in ("config", "api", "bucket", "module"):
+        # no stored definitions yet: IF EXISTS passes, bare form errors
+        if n.if_exists:
+            return NONE
+        raise SdbError(f"The {kind} '{n.name}' does not exist")
     raise SdbError(f"unknown REMOVE kind {kind}")
 
 
@@ -1630,8 +1649,115 @@ def _s_alter(n: AlterTable, ctx: Ctx):
     if n.permissions is not None:
         tdef.permissions = n.permissions
     if n.comment is not None:
-        tdef.comment = n.comment
+        tdef.comment = None if n.comment == "__drop__" else n.comment
+    if n.changefeed is not None:
+        if n.changefeed == "__drop__":
+            tdef.changefeed = None
+        else:
+            from surrealdb_tpu.val import Duration
+
+            d = evaluate(n.changefeed, ctx)
+            tdef.changefeed = d.ns if isinstance(d, Duration) else int(d)
     ctx.txn.set_val(key, tdef)
+    return NONE
+
+
+def _s_alter_other(n: AlterStmt, ctx: Ctx):
+    """ALTER for non-table definitions: load, apply clause edits, store."""
+    ns = ctx.session.ns
+    db = ctx.session.db
+    kind = n.kind
+    labels = {
+        "field": "field", "index": "index", "event": "event",
+        "param": "param", "function": "function", "analyzer": "analyzer",
+        "user": "user", "access": "access", "sequence": "sequence",
+        "api": "api", "bucket": "bucket", "config": "config",
+    }
+    if kind == "database":
+        if n.name is not None and ctx.txn.get(K.db_def(ns, n.name)) is None:
+            if n.if_exists:
+                return NONE
+            raise SdbError(f"The database '{n.name}' does not exist")
+        return NONE  # COMPACT is a maintenance hint; mem engine is compacted
+    if kind in ("system", "config", "api", "bucket", "model", "module"):
+        # settings / side-car definitions: accept silently when the target
+        # concept has no stored definition yet
+        if kind in ("api", "bucket") and not n.if_exists:
+            # we don't store these defs yet; nonexistent targets error
+            raise SdbError(
+                f"The {kind} '{n.name}' does not exist"
+            )
+        return NONE
+    keymap = {
+        "field": lambda: K.fd_def(ns, db, n.tb, n.name if isinstance(n.name, str) else _field_name_str(n.name)),
+        "index": lambda: K.ix_def(ns, db, n.tb, n.name),
+        "event": lambda: K.ev_def(ns, db, n.tb, n.name),
+        "param": lambda: K.pa_def(ns, db, n.name),
+        "function": lambda: K.fc_def(ns, db, n.name),
+        "analyzer": lambda: K.az_def(ns, db, n.name),
+        "user": lambda: K.us_def(
+            n.base or "root",
+            ns if (n.base or "root") in ("ns", "db") else None,
+            db if (n.base or "root") == "db" else None,
+            n.name,
+        ),
+        "access": lambda: K.ac_def(
+            n.base or "db",
+            ns if (n.base or "db") in ("ns", "db") else None,
+            db if (n.base or "db") == "db" else None,
+            n.name,
+        ),
+        "sequence": lambda: K.seq_state(ns, db, n.name),
+    }
+    key = keymap[kind]()
+    stored = ctx.txn.get_val(key)
+    if stored is None:
+        if n.if_exists:
+            return NONE
+        raise SdbError(
+            f"The {labels.get(kind, kind)} '{n.name}' does not exist"
+        )
+    d = stored[0] if kind == "sequence" else stored
+    for clause, value in n.changes:
+        if value == "__drop__":
+            if clause == "comment":
+                d.comment = None
+            elif clause in ("value", "default", "when"):
+                setattr(d, "default" if clause == "default" else clause, None)
+            elif clause == "assert":
+                d.assert_ = None
+            elif clause == "type":
+                d.kind = None
+            elif clause == "readonly":
+                d.readonly = False
+            elif clause == "flexible":
+                d.flex = False
+            elif clause in ("tokenizers", "filters", "roles"):
+                setattr(d, clause, [])
+            elif clause == "duration":
+                d.duration = None
+            elif clause == "reference":
+                d.reference = None
+            continue
+        if clause == "password":
+            from surrealdb_tpu.fnc.misc_fns import password_hash
+
+            d.passhash = password_hash(value)
+            continue
+        if clause == "value" and kind == "param":
+            d.value = evaluate(value, ctx)
+            continue
+        if hasattr(d, clause):
+            v = value
+            if clause in ("comment",) and not isinstance(v, (str, type(None))):
+                v = evaluate(v, ctx)
+                if v is NONE:
+                    v = None
+            setattr(d, clause, v)
+    if kind == "sequence":
+        ctx.txn.set_val(key, (d, stored[1]))
+    else:
+        ctx.txn.set_val(key, d)
     return NONE
 
 
@@ -1877,6 +2003,7 @@ _STMTS = {
     DefineConfig: _s_define_config,
     RemoveStmt: _s_remove,
     AlterTable: _s_alter,
+    AlterStmt: _s_alter_other,
     RebuildIndex: _s_rebuild,
     InfoStmt: _s_info,
     LiveStmt: _s_live,

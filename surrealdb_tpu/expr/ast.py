@@ -650,6 +650,20 @@ class AlterTable(Node):
 
 
 @dataclass
+class AlterStmt(Node):
+    """Generalized ALTER for non-table targets: a list of clause edits
+    applied to the stored definition."""
+
+    kind: str  # field|index|event|param|function|analyzer|user|access|api|
+    # bucket|config|system|sequence
+    name: Any
+    tb: Optional[str] = None
+    base: Optional[str] = None
+    if_exists: bool = False
+    changes: list = field(default_factory=list)  # [(clause, value|"__drop__")]
+
+
+@dataclass
 class InfoStmt(Node):
     level: str  # root|ns|db|table|user|index
     target: Optional[str] = None

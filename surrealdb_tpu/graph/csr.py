@@ -1,0 +1,179 @@
+"""TPU graph engine: CSR adjacency blocks + device frontier expansion.
+
+Replaces the reference's per-source-record KV range scans (SURVEY.md §3.4:
+"Hot loop: per-source-record KV range scan per hop — fan-out × depth") for
+large frontiers: node→node adjacency through an edge table is packed once
+into CSR arrays resident on device; a hop is two gathers + a scatter-or
+(`frontier[rows] → scatter_add over indices`), a multi-hop is a lax.scan —
+no host↔device traffic until the final frontier readback.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from surrealdb_tpu import key as K
+from surrealdb_tpu.val import RecordId
+
+
+class CsrGraph:
+    """node→node adjacency for one (node_tb, edge_tb, direction) pattern."""
+
+    def __init__(self, ns, db, node_tb, edge_tb, direction):
+        self.key = (ns, db, node_tb, edge_tb, direction)
+        self.version = -1
+        self.node_ids: list = []  # idx -> record key (node_tb ids)
+        self.node_index: dict = {}  # enc(id) -> idx
+        self.rows = np.zeros(0, np.int32)  # [E] source node idx per edge
+        self.cols = np.zeros(0, np.int32)  # [E] dest node idx per edge
+        self.edge_ids: list = []  # [E] edge record keys (for edge output)
+        self.device = None
+        self.lock = threading.RLock()
+
+    def build(self, ctx):
+        """Scan the edge table's records (in/out fields) into CSR arrays.
+        Reads a FRESH transaction (committed state only) so a cancelled
+        writer can never leave phantom edges in this shared cache; a
+        transaction's own uncommitted RELATEs become visible to the CSR
+        path after commit (mirrors the reference's async index pendings)."""
+        ns, db, node_tb, edge_tb, direction = self.key
+        from surrealdb_tpu.kvs.api import deserialize
+
+        ds = ctx.ds
+        txn = ds.transaction(write=False)
+        ctx = type(ctx)(ds, ctx.session, txn)
+
+        node_ids: list = []
+        node_index: dict = {}
+
+        def idx_of(idv):
+            h = K.enc_value(idv)
+            i = node_index.get(h)
+            if i is None:
+                i = len(node_ids)
+                node_index[h] = i
+                node_ids.append(idv)
+            return i
+
+        rows, cols, eids = [], [], []
+        beg, end = K.prefix_range(K.record_prefix(ns, db, edge_tb))
+        for _k, raw in ctx.txn.scan(beg, end):
+            doc = deserialize(raw)
+            if not isinstance(doc, dict):
+                continue
+            l = doc.get("in")
+            r = doc.get("out")
+            if not (isinstance(l, RecordId) and isinstance(r, RecordId)):
+                continue
+            if l.tb != node_tb or r.tb != node_tb:
+                continue
+            if direction in ("out", "both"):
+                rows.append(idx_of(l.id))
+                cols.append(idx_of(r.id))
+                eids.append(doc.get("id"))
+            if direction in ("in", "both"):
+                rows.append(idx_of(r.id))
+                cols.append(idx_of(l.id))
+                eids.append(doc.get("id"))
+        txn.cancel()
+        self.node_ids = node_ids
+        self.node_index = node_index
+        self.rows = np.asarray(rows, np.int32)
+        self.cols = np.asarray(cols, np.int32)
+        self.edge_ids = eids
+        self.device = None
+
+    def _ensure_device(self):
+        if self.device is None:
+            import jax.numpy as jnp
+
+            self.device = (
+                jnp.asarray(self.rows),
+                jnp.asarray(self.cols),
+            )
+        return self.device
+
+    def n_nodes(self) -> int:
+        return len(self.node_ids)
+
+    def multi_hop(self, start_keys: list, hops: int, collect_mode="frontier"):
+        """Expand `hops` steps from the start nodes on device.
+
+        collect_mode 'frontier': nodes reachable in exactly `hops` steps
+        (frontier semantics, revisits allowed through the visited mask);
+        'union': all nodes reached in 1..hops steps.
+        Returns a list of node keys."""
+        n = self.n_nodes()
+        if n == 0 or not len(self.rows):
+            return []
+        start = np.zeros(n, dtype=bool)
+        found_any = False
+        for idv in start_keys:
+            i = self.node_index.get(K.enc_value(idv))
+            if i is not None:
+                start[i] = True
+                found_any = True
+        if not found_any:
+            return []
+        import jax
+        import jax.numpy as jnp
+
+        rows_d, cols_d = self._ensure_device()
+        out = _multi_hop_jit(
+            rows_d, cols_d, jnp.asarray(start), n, hops,
+            collect_mode == "union",
+        )
+        mask = np.asarray(out)
+        return [self.node_ids[i] for i in np.nonzero(mask)[0]]
+
+
+def _multi_hop_impl(rows, cols, start, n_nodes, hops, union):
+    import jax
+    import jax.numpy as jnp
+
+    def hop(frontier, _):
+        contrib = frontier[rows].astype(jnp.int32)
+        nxt = jnp.zeros(n_nodes, jnp.int32).at[cols].add(contrib) > 0
+        return nxt, nxt
+
+    frontier, layers = jax.lax.scan(hop, start, None, length=hops)
+    if union:
+        return layers.any(axis=0)
+    return frontier
+
+
+_jit_cache: dict = {}
+
+
+def _multi_hop_jit(rows, cols, start, n_nodes, hops, union):
+    import jax
+
+    ck = (n_nodes, hops, union, rows.shape[0])
+    fn = _jit_cache.get(ck)
+    if fn is None:
+        fn = jax.jit(
+            _multi_hop_impl, static_argnums=(3, 4, 5)
+        )
+        _jit_cache[ck] = fn
+    return fn(rows, cols, start, n_nodes, hops, union)
+
+
+def get_csr(ds, ctx, node_tb, edge_tb, direction) -> CsrGraph:
+    """Datastore-cached CSR; rebuilt when the edge table changes (tracked
+    via a bump counter on writes — device blocks are a cache over KV)."""
+    ns, db = ctx.need_ns_db()
+    if ds.graph_engine is None:
+        ds.graph_engine = {}
+    key = (ns, db, node_tb, edge_tb, direction)
+    g = ds.graph_engine.get(key)
+    if g is None:
+        g = CsrGraph(ns, db, node_tb, edge_tb, direction)
+        ds.graph_engine[key] = g
+    ver = ds.graph_versions.get((ns, db, edge_tb), 0)
+    with g.lock:
+        if g.version != ver:
+            g.build(ctx)
+            g.version = ver
+    return g

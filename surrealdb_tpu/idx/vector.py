@@ -63,14 +63,28 @@ def vector_index_update(idef, rid: RecordId, before, after, ctx):
         v = evaluate(col, ctx.with_doc(after, rid))
         if v is not NONE and v is not None:
             new_vec = _as_vector(v, dim, f"index {idef.name}")
+    if new_vec is None and old_vec is None:
+        return
+    # version allocation is process-atomic (ds.lock): concurrent writers
+    # can't collide on a log slot; a cancelled txn burns a version, which
+    # sync() detects as a log gap and resolves with a rebuild
+    with ctx.ds.lock:
+        counters = getattr(ctx.ds, "_ix_versions", None)
+        if counters is None:
+            counters = {}
+            ctx.ds._ix_versions = counters
+        ckey = (ns, db, rid.tb, idef.name)
+        stored = ctx.txn.get_val(vkey) or 0
+        ver = max(counters.get(ckey, 0), stored) + 1
+        counters[ckey] = ver
+    log_key = K.ix_state(ns, db, rid.tb, idef.name, b"hl", K.enc_u64(ver))
     if new_vec is not None:
         ctx.txn.set_val(key, new_vec.tobytes())
-    elif old_vec is not None:
-        ctx.txn.delete(key)
+        ctx.txn.set_val(log_key, ("set", rid.id, new_vec.tobytes()))
     else:
-        return
-    ver = ctx.txn.get_val(vkey) or 0
-    ctx.txn.set_val(vkey, ver + 1)
+        ctx.txn.delete(key)
+        ctx.txn.set_val(log_key, ("del", rid.id, None))
+    ctx.txn.set_val(vkey, ver)
 
 
 class TpuVectorIndex:
@@ -88,13 +102,19 @@ class TpuVectorIndex:
         self.lock = threading.RLock()
         self.version = -1
         self.rids: list = []  # row -> RecordId
+        self.row_index: dict = {}  # enc(id) -> row
         self.vecs = np.zeros((0, self.dim), dtype=np.float32)
+        self.valid = np.zeros(0, dtype=bool)  # tombstone mask
         self.device_vecs = None  # jax array (lazy)
         self.device_valid = None
         self.mesh = None
 
     # -- cache sync ---------------------------------------------------------
     def sync(self, ctx):
+        """Bring the device block cache up to the KV truth: small gaps apply
+        the op log incrementally (append + tombstone); big gaps or heavy
+        fragmentation trigger a full repack (the reference's two-phase
+        pending/compaction design, hnsw/index.rs)."""
         ns, db, tb, ix = self.key
         vkey = K.ix_state(ns, db, tb, ix, b"vn")
         ver = ctx.txn.get_val(vkey) or 0
@@ -103,24 +123,88 @@ class TpuVectorIndex:
         with self.lock:
             if ver == self.version:
                 return
-            pre = K.ix_state(ns, db, tb, ix, b"he")
-            beg, end = K.prefix_range(pre)
-            rids = []
-            rows = []
-            plen = len(pre)
-            for k, raw in ctx.txn.scan(beg, end):
-                idv, _pos = K.dec_value(k, plen)
-                rids.append(RecordId(tb, idv))
-                from surrealdb_tpu.kvs.api import deserialize
-
-                rows.append(np.frombuffer(deserialize(raw), dtype=np.float32))
-            self.rids = rids
-            self.vecs = (
-                np.stack(rows) if rows else np.zeros((0, self.dim), np.float32)
-            )
-            self.device_vecs = None
-            self.device_valid = None
+            gap = ver - self.version
+            n = len(self.rids)
+            if self.version >= 0 and 0 < gap <= max(4096, n // 4):
+                if self._apply_log(ctx, self.version, ver):
+                    self.version = ver
+                    frag = (
+                        1.0 - (self.valid.sum() / max(len(self.valid), 1))
+                        if len(self.valid)
+                        else 0.0
+                    )
+                    if frag <= 0.25:
+                        return
+            self._rebuild(ctx)
             self.version = ver
+
+    def _apply_log(self, ctx, from_ver, to_ver) -> bool:
+        ns, db, tb, ix = self.key
+        beg = K.ix_state(ns, db, tb, ix, b"hl", K.enc_u64(from_ver + 1))
+        end = K.ix_state(ns, db, tb, ix, b"hl", K.enc_u64(to_ver)) + b"\x00"
+        entries = list(ctx.txn.scan_vals(beg, end))
+        if len(entries) != to_ver - from_ver:
+            return False  # log incomplete (e.g. trimmed) — rebuild instead
+        add_rows = []
+        add_rids = []
+        for _k, (op, idv, raw) in entries:
+            h = K.enc_value(idv)
+            row = self.row_index.get(h)
+            if op == "del":
+                if row is not None and row < len(self.valid):
+                    self.valid[row] = False
+                continue
+            vec = np.frombuffer(raw, dtype=np.float32)
+            if row is not None and row < len(self.vecs):
+                self.vecs[row] = vec
+                self.valid[row] = True
+            else:
+                self.row_index[h] = len(self.rids) + len(add_rids)
+                add_rids.append(RecordId(tb, idv))
+                add_rows.append(vec)
+        if add_rows:
+            self.vecs = (
+                np.vstack([self.vecs, np.stack(add_rows)])
+                if len(self.vecs)
+                else np.stack(add_rows)
+            )
+            self.valid = np.concatenate(
+                [self.valid, np.ones(len(add_rows), bool)]
+            )
+            self.rids.extend(add_rids)
+        self.device_vecs = None
+        self.device_valid = None
+        return True
+
+    def _rebuild(self, ctx):
+        ns, db, tb, ix = self.key
+        pre = K.ix_state(ns, db, tb, ix, b"he")
+        beg, end = K.prefix_range(pre)
+        rids = []
+        rows = []
+        index = {}
+        plen = len(pre)
+        from surrealdb_tpu.kvs.api import deserialize
+
+        for k, raw in ctx.txn.scan(beg, end):
+            idv, _pos = K.dec_value(k, plen)
+            index[K.enc_value(idv)] = len(rids)
+            rids.append(RecordId(tb, idv))
+            rows.append(np.frombuffer(deserialize(raw), dtype=np.float32))
+        self.rids = rids
+        self.row_index = index
+        self.vecs = (
+            np.stack(rows) if rows else np.zeros((0, self.dim), np.float32)
+        )
+        self.valid = np.ones(len(rids), dtype=bool)
+        self.device_vecs = None
+        self.device_valid = None
+        # trim the consumed op log when we can write (bounds log growth)
+        if getattr(ctx.txn, "write", False):
+            ver = ctx.txn.get_val(K.ix_state(ns, db, tb, ix, b"vn")) or 0
+            beg = K.ix_state(ns, db, tb, ix, b"hl", K.enc_u64(0))
+            end = K.ix_state(ns, db, tb, ix, b"hl", K.enc_u64(ver)) + b"\x00"
+            ctx.txn.delete_range(beg, end)
 
     def _ensure_device(self):
         if self.device_vecs is not None:
@@ -129,7 +213,7 @@ class TpuVectorIndex:
         import jax.numpy as jnp
 
         n = len(self.rids)
-        valid = np.ones((n,), dtype=bool)
+        valid = self.valid.copy()
         if jax.device_count() > 1:
             from surrealdb_tpu.parallel.mesh import default_mesh, shard_rows
 
@@ -152,7 +236,7 @@ class TpuVectorIndex:
         handled by oversample + host truthiness check + refill
         (SURVEY.md hard-parts: cond-filtered KNN)."""
         self.sync(ctx)
-        n = len(self.rids)
+        n = int(self.valid.sum())
         if n == 0:
             return []
         qv = _as_vector(q, self.dim, "knn query")
@@ -192,9 +276,15 @@ class TpuVectorIndex:
         n = len(self.rids)
         if n < DEVICE_MIN_ROWS:
             d = self._host_distances(qv)
-            idx = np.argpartition(d, min(k, n) - 1)[:k]
+            d = np.where(self.valid, d, np.inf)
+            k_eff = min(k, n)
+            idx = np.argpartition(d, k_eff - 1)[:k_eff]
             idx = idx[np.argsort(d[idx], kind="stable")]
-            return [(self.rids[i], float(d[i])) for i in idx]
+            return [
+                (self.rids[i], float(d[i]))
+                for i in idx
+                if np.isfinite(d[i])
+            ]
         self._ensure_device()
         import jax.numpy as jnp
 

@@ -94,7 +94,8 @@ class Datastore:
         self.notification_handlers: list = []  # callables(Notification)
         self.sequences: dict = {}
         self.changefeed_vs = 0  # monotonically increasing versionstamp
-        self.graph_engine = None  # lazily built TPU graph engine cache
+        self.graph_engine = None  # (ns,db,node_tb,edge_tb,dir) -> CsrGraph
+        self.graph_versions = {}  # (ns,db,tb) -> write counter
 
     # -- transactions -------------------------------------------------------
     def transaction(self, write: bool = True) -> Transaction:

@@ -1219,7 +1219,8 @@ class Parser:
         elif self.eat_kw("namespace", "ns"):
             base = "ns"
         else:
-            self.expect_kw("database")
+            if not self.eat_kw("database", "db"):
+                raise self.err("expected DATABASE")
             base = "db"
         self.expect_kw("type")
         cfg = {}
@@ -1335,7 +1336,8 @@ class Parser:
             "field": "field", "index": "index", "event": "event",
             "param": "param", "function": "function", "fn": "function",
             "analyzer": "analyzer", "user": "user", "access": "access",
-            "sequence": "sequence",
+            "sequence": "sequence", "config": "config", "api": "api",
+            "bucket": "bucket", "module": "module",
         }
         t = self.peek()
         if t.kind != L.IDENT or t.value.lower() not in kinds:
@@ -1353,6 +1355,9 @@ class Parser:
             if parts and parts[0] == "fn":
                 parts = parts[1:]
             name = "::".join(parts)
+            if self.at_op("("):  # optional trailing () in REMOVE FUNCTION
+                self.next()
+                self.expect_op(")")
         elif kind == "param":
             t = self.next()
             name = t.value
@@ -1371,7 +1376,8 @@ class Parser:
             elif self.eat_kw("namespace", "ns"):
                 s.base = "ns"
             else:
-                self.expect_kw("database")
+                if not self.eat_kw("database", "db"):
+                    raise self.err("expected DATABASE")
                 s.base = "db"
         if kind == "table" and self.eat_kw("expunge"):
             s.expunge = True
@@ -1385,27 +1391,66 @@ class Parser:
                 self.expect_kw("exists")
                 if_exists = True
             name = self.ident()
-            timeout = None
+            changes = []
             while True:
                 if self.eat_kw("timeout"):
-                    timeout = self.parse_expr()
+                    changes.append(("timeout", self.parse_expr()))
                 elif self.eat_kw("batch"):
-                    self._signed_int()
+                    changes.append(("batch", self._signed_int()))
                 elif self.eat_kw("start"):
-                    self._signed_int()
+                    changes.append(("start", self._signed_int()))
                 else:
                     break
-            return AccessStmt(name, None, "alter_sequence", if_exists)
+            return AlterStmt("sequence", name, None, None, if_exists, changes)
+        kinds = {
+            "field": "field", "index": "index", "event": "event",
+            "param": "param", "function": "function", "fn": "function",
+            "analyzer": "analyzer", "user": "user", "access": "access",
+            "api": "api", "bucket": "bucket", "config": "config",
+            "system": "system", "model": "model", "module": "module",
+        }
+        t = self.peek()
+        if t.kind == L.IDENT and t.value.lower() in kinds:
+            return self._alter_other(kinds[self.next().value.lower()])
+        if self.eat_kw("namespace", "ns", "database", "db"):
+            # ALTER NAMESPACE [x] COMPACT / ALTER DATABASE [x] maintenance
+            if_exists = False
+            if self.eat_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            name = None
+            if not self.at_kw("compact", "comment") and \
+                    self.peek().kind == L.IDENT:
+                name = self.ident_or_str()
+            changes = []
+            while True:
+                if self.eat_kw("compact"):
+                    changes.append(("compact", True))
+                elif self.eat_kw("comment"):
+                    changes.append(("comment", self._comment_value()))
+                else:
+                    break
+            return AlterStmt("database", name, None, None, if_exists, changes)
         if not self.eat_kw("table"):
-            raise self.err("only ALTER TABLE and ALTER SEQUENCE are supported")
+            raise self.err("unknown ALTER target")
         if_exists = False
         if self.eat_kw("if"):
             self.expect_kw("exists")
             if_exists = True
         d = AlterTable(self.ident_or_str(), if_exists)
         while True:
-            if self.eat_kw("drop"):
+            if self.at_kw("drop") and self.peek(1).kind == L.IDENT and \
+                    self.peek(1).value.lower() in ("comment", "changefeed"):
+                self.next()
+                which = self.next().value.lower()
+                if which == "comment":
+                    d.comment = "__drop__"
+                else:
+                    d.changefeed = "__drop__"
+            elif self.eat_kw("drop"):
                 d.drop = True
+            elif self.eat_kw("compact"):
+                pass
             elif self.eat_kw("schemafull", "schemaful"):
                 d.full = True
             elif self.eat_kw("schemaless"):
@@ -1447,6 +1492,187 @@ class Parser:
                 self.next()
                 return t.value
         return self.parse_expr()
+
+    def _alter_other(self, kind: str):
+        """ALTER <kind> [IF EXISTS] name [ON tb|base] clause-edits."""
+        if_exists = False
+        if self.eat_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        if kind == "system":
+            # ALTER SYSTEM COMPACT / ALTER SYSTEM <setting> <value>
+            changes = []
+            while self.peek().kind != L.EOF and not self.at_op(";"):
+                tname = self.next()
+                if self.at_op("="):
+                    self.next()
+                    changes.append((tname.value, self.parse_expr()))
+                else:
+                    changes.append((str(tname.value).lower(), True))
+            return AlterStmt("system", "system", None, None, if_exists, changes)
+        if kind == "config":
+            what = self.ident().upper()
+            depth = 0
+            while self.peek().kind != L.EOF:
+                if self.at_op(";") and depth == 0:
+                    break
+                t2 = self.next()
+                if t2.kind == L.OP and t2.text in "([{":
+                    depth += 1
+                if t2.kind == L.OP and t2.text in ")]}":
+                    depth -= 1
+            return AlterStmt("config", what, None, None, if_exists, [])
+        if kind == "param":
+            tp = self.peek()
+            if tp.kind == L.PARAM:
+                self.next()
+                name = tp.value
+            else:
+                name = self.ident_or_str()
+        elif kind == "function":
+            self.eat_op("::")
+            parts = [self.ident()]
+            while self.eat_op("::"):
+                parts.append(self.ident())
+            if parts and parts[0] == "fn":
+                parts = parts[1:]
+            name = "::".join(parts)
+        elif kind == "field":
+            from surrealdb_tpu.exec.statements import _field_name_str
+
+            name = _field_name_str(self._field_name_parts())
+        else:
+            name = self.ident_or_str()
+        tb = base = None
+        if kind in ("field", "index", "event") :
+            self.expect_kw("on")
+            self.eat_kw("table")
+            tb = self.ident_or_str()
+        elif kind in ("user", "access") and self.eat_kw("on"):
+            if self.eat_kw("root"):
+                base = "root"
+            elif self.eat_kw("namespace", "ns"):
+                base = "ns"
+            elif self.eat_kw("database", "db"):
+                base = "db"
+        changes = []
+        while True:
+            if self.eat_kw("drop"):
+                clause = self.ident().lower()
+                changes.append((clause, "__drop__"))
+            elif self.eat_kw("comment"):
+                changes.append(("comment", self._comment_value()))
+            elif kind == "field" and self.eat_kw("type"):
+                changes.append(("kind", self.parse_kind()))
+                if self.eat_kw("flexible"):
+                    changes.append(("flex", True))
+            elif kind == "field" and self.eat_kw("value"):
+                changes.append(("value", self.parse_expr()))
+            elif kind == "field" and self.eat_kw("assert"):
+                changes.append(("assert_", self.parse_expr()))
+            elif kind == "field" and self.eat_kw("default"):
+                always = self.eat_kw("always")
+                changes.append(("default", self.parse_expr()))
+                changes.append(("default_always", always))
+            elif kind == "field" and self.eat_kw("readonly"):
+                changes.append(("readonly", True))
+            elif kind == "field" and self.eat_kw("flexible"):
+                changes.append(("flex", True))
+            elif kind == "event" and self.eat_kw("when"):
+                changes.append(("when", self.parse_expr()))
+            elif kind == "event" and self.eat_kw("then"):
+                if self.at_op("("):
+                    self.next()
+                    then = [self.parse_stmt()]
+                    while self.eat_op(","):
+                        then.append(self.parse_stmt())
+                    self.expect_op(")")
+                else:
+                    then = [self.parse_expr()]
+                changes.append(("then", then))
+            elif kind == "param" and self.eat_kw("value"):
+                changes.append(("value", self.parse_expr()))
+            elif kind == "user" and self.eat_kw("password"):
+                changes.append(("password", self.ident_or_str()))
+            elif kind == "user" and self.eat_kw("passhash"):
+                changes.append(("passhash", self.ident_or_str()))
+            elif kind == "user" and self.eat_kw("roles"):
+                roles = [self.ident().capitalize()]
+                while self.eat_op(","):
+                    roles.append(self.ident().capitalize())
+                changes.append(("roles", roles))
+            elif kind in ("field", "table", "function", "param", "api",
+                          "bucket") and self.eat_kw("permissions"):
+                if kind == "field":
+                    changes.append(("permissions", self._parse_permissions()))
+                else:
+                    changes.append(
+                        ("permissions", self._parse_permissions_value())
+                    )
+            elif kind == "bucket" and self.eat_kw("readonly"):
+                changes.append(("readonly", True))
+            elif kind == "api" and self.eat_kw("for"):
+                methods = [self.ident().lower()]
+                while self.eat_op(","):
+                    methods.append(self.ident().lower())
+                if self.eat_kw("drop"):
+                    self.expect_kw("then")
+                    changes.append(("api_drop_then", methods))
+                elif self.eat_kw("then"):
+                    changes.append(("api_then", (methods, self.parse_expr())))
+            elif kind == "analyzer" and self.eat_kw("tokenizers"):
+                toks = [self.ident().lower()]
+                while self.eat_op(","):
+                    toks.append(self.ident().lower())
+                changes.append(("tokenizers", toks))
+            elif kind == "analyzer" and self.eat_kw("filters"):
+                fs = [self._parse_filter()]
+                while self.eat_op(","):
+                    fs.append(self._parse_filter())
+                changes.append(("filters", fs))
+            elif kind == "event" and self.eat_kw("async"):
+                changes.append(("async", True))
+            elif kind == "event" and self.eat_kw("retry"):
+                changes.append(("retry", self.next().value))
+            elif kind == "event" and self.eat_kw("maxdepth"):
+                changes.append(("maxdepth", self.next().value))
+            elif kind == "field" and self.eat_kw("reference"):
+                changes.append(("reference", self._parse_reference()))
+            elif kind == "function" and self.at_op("("):
+                # ALTER FUNCTION fn::x(args) { body }
+                self.next()
+                args = []
+                while not self.at_op(")"):
+                    tp = self.next()
+                    self.expect_op(":")
+                    args.append((tp.value, self.parse_kind()))
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+                returns = None
+                if self.at_op("->"):
+                    self.next()
+                    returns = self.parse_kind()
+                changes.append(("args", args))
+                changes.append(("returns", returns))
+                changes.append(("block", self._parse_block()))
+            elif kind == "index" and self.eat_kw("prepare"):
+                self.expect_kw("remove")
+                changes.append(("prepare_remove", True))
+            elif kind in ("user", "access") and self.eat_kw("duration"):
+                dur = {}
+                while self.eat_kw("for"):
+                    which = self.ident().lower()
+                    if self.eat_kw("none"):
+                        dur[which] = None
+                    else:
+                        dur[which] = self.next().value
+                    if not self.eat_op(","):
+                        break
+                changes.append(("duration", dur))
+            else:
+                break
+        return AlterStmt(kind, name, tb, base, if_exists, changes)
 
     # -- kinds ---------------------------------------------------------------
     def parse_kind(self, no_union: bool = False) -> Kind:

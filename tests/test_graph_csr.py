@@ -1,0 +1,123 @@
+"""TPU CSR graph engine: device multi-hop parity with the host `~`-key path."""
+
+import numpy as np
+
+from surrealdb_tpu.val import RecordId
+
+
+def _build_graph(ds, n_nodes=40, seed=0):
+    rng = np.random.default_rng(seed)
+    stmts = [f"CREATE n:{i};" for i in range(n_nodes)]
+    edges = set()
+    for i in range(n_nodes):
+        for j in rng.integers(0, n_nodes, size=3):
+            if i != j:
+                edges.add((i, int(j)))
+    for a, b in sorted(edges):
+        stmts.append(f"RELATE n:{a}->e->n:{b};")
+    ds.execute("".join(stmts), ns="t", db="t")
+    return sorted(edges)
+
+
+def test_csr_single_hop_parity(ds):
+    edges = _build_graph(ds)
+    from surrealdb_tpu.exec.context import Ctx
+    from surrealdb_tpu.graph.csr import get_csr
+    from surrealdb_tpu.kvs.ds import Session
+
+    txn = ds.transaction(write=False)
+    ctx = Ctx(ds, Session(ns="t", db="t"), txn)
+    csr = get_csr(ds, ctx, "n", "e", "out")
+    # parity vs the host scan for every node
+    host = {}
+    for a, b in edges:
+        host.setdefault(a, set()).add(b)
+    for a in range(40):
+        got = set(csr.multi_hop([a], 1))
+        assert got == host.get(a, set()), f"node {a}"
+    txn.cancel()
+
+
+def test_csr_multi_hop_union(ds):
+    ds.execute(
+        "CREATE m:1; CREATE m:2; CREATE m:3; CREATE m:4;"
+        "RELATE m:1->me->m:2; RELATE m:2->me->m:3; RELATE m:3->me->m:4;",
+        ns="t", db="t",
+    )
+    from surrealdb_tpu.exec.context import Ctx
+    from surrealdb_tpu.graph.csr import get_csr
+    from surrealdb_tpu.kvs.ds import Session
+
+    txn = ds.transaction(write=False)
+    ctx = Ctx(ds, Session(ns="t", db="t"), txn)
+    csr = get_csr(ds, ctx, "m", "me", "out")
+    assert set(csr.multi_hop([1], 2)) == {3}
+    assert set(csr.multi_hop([1], 2, "union")) == {2, 3}
+    assert set(csr.multi_hop([1], 3)) == {4}
+    txn.cancel()
+
+
+def test_csr_rebuild_on_write(ds):
+    ds.execute("CREATE r:1; CREATE r:2; RELATE r:1->re->r:2", ns="t", db="t")
+    from surrealdb_tpu.exec.context import Ctx
+    from surrealdb_tpu.graph.csr import get_csr
+    from surrealdb_tpu.kvs.ds import Session
+
+    txn = ds.transaction(write=False)
+    ctx = Ctx(ds, Session(ns="t", db="t"), txn)
+    csr = get_csr(ds, ctx, "r", "re", "out")
+    assert set(csr.multi_hop([1], 1)) == {2}
+    txn.cancel()
+    ds.execute("CREATE r:3; RELATE r:1->re->r:3", ns="t", db="t")
+    txn = ds.transaction(write=False)
+    ctx = Ctx(ds, Session(ns="t", db="t"), txn)
+    csr = get_csr(ds, ctx, "r", "re", "out")
+    assert set(csr.multi_hop([1], 1)) == {2, 3}
+    txn.cancel()
+
+
+def test_recursion_csr_fast_path_matches_host(ds):
+    """Recursion BFS uses the CSR device hop over the threshold; results
+    must match the host walk (both are visited-set deduplicated)."""
+    import surrealdb_tpu.graph as G
+
+    _build_graph(ds, n_nodes=30, seed=2)
+    old = G.TPU_FRONTIER_THRESHOLD
+    try:
+        q = "RETURN array::sort(n:0.{..+collect}(->e->n))"
+        host = ds.query(q, ns="t", db="t")[0]
+        G.TPU_FRONTIER_THRESHOLD = 2
+        dev = ds.query(q, ns="t", db="t")[0]
+        assert sorted(r.render() for r in host) == sorted(
+            r.render() for r in dev
+        )
+        assert len(host) > 3
+    finally:
+        G.TPU_FRONTIER_THRESHOLD = old
+
+
+def test_vector_incremental_sync(ds):
+    """Writes after the first search apply via the op log, not a rebuild."""
+    ds.query("DEFINE INDEX ve ON vt FIELDS v HNSW DIMENSION 2")
+    for i in range(8):
+        ds.query(f"CREATE vt:{i} SET v = [{float(i)}, 0.0]")
+    rows = ds.query("SELECT id FROM vt WHERE v <|2,5|> [0.0, 0.0]")[0]
+    assert rows[0]["id"] == RecordId("vt", 0)
+    eng = next(iter(ds.vector_indexes.values()))
+    ver0 = eng.version
+    rebuilt = {"n": 0}
+    orig = eng._rebuild
+
+    def counting(ctx):
+        rebuilt["n"] += 1
+        return orig(ctx)
+
+    eng._rebuild = counting
+    ds.query("CREATE vt:100 SET v = [-1.0, 0.0]")
+    ds.query("DELETE vt:1")
+    rows = ds.query("SELECT id FROM vt WHERE v <|3,5|> [-1.0, 0.0]")[0]
+    ids = [r["id"] for r in rows]
+    assert ids[0] == RecordId("vt", 100)
+    assert RecordId("vt", 1) not in ids
+    assert rebuilt["n"] == 0, "expected incremental log apply, got rebuild"
+    assert eng.version > ver0
