@@ -108,11 +108,17 @@ def _expr_sql(node) -> str:
         from surrealdb_tpu.exec.coerce import kind_name
 
         ps = ", ".join(
-            f"${n}" + (f": {kind_name(k)}" if k is not None else "")
+            f"${n}: " + (kind_name(k) if k is not None else "any")
             for n, k in node.params
         )
         ret = f" -> {kind_name(node.returns)}" if node.returns else ""
-        return f"|{ps}|{ret} {_expr_sql(node.body)}"
+        body = node.body
+        if isinstance(body, Subquery):
+            from surrealdb_tpu.expr.ast import BlockExpr as _Blk
+
+            if isinstance(body.stmt, _Blk):
+                body = body.stmt
+        return f"|{ps}|{ret} {_expr_sql(body)}"
     if isinstance(node, IfElse):
         out = []
         for i, (cond, body) in enumerate(node.branches):

@@ -322,7 +322,7 @@ def expr_name(expr) -> str:
                 else:
                     out.append(p.name)
             elif isinstance(p, PAll):
-                out.append("[*]" if out else "*")
+                out.append(".*" if out else "*")
             elif isinstance(p, PIndex):
                 out.append(f"[{expr_name(p.expr)}]")
             elif isinstance(p, PLast):
@@ -910,9 +910,401 @@ def _fetch_value(v, ctx):
     return v
 
 
+def _explain_streaming(n: SelectStmt, ctx) -> str:
+    """Streaming-executor EXPLAIN string (reference exec/ operator tree
+    pretty-print, used under planner-strategy all-ro). EXPLAIN ANALYZE
+    executes and annotates {rows: N} per operator + a Total rows line."""
+    from surrealdb_tpu.exec.render_def import _expr_sql
+    from surrealdb_tpu.idx.planner import (
+        _choose_index,
+        _classify_preds,
+        _find_knn,
+        _find_matches,
+        _remove_node,
+        get_indexes_for,
+    )
+
+    analyze = n.explain == "analyze"
+
+    # resolve scan children (one per FROM target)
+    scans = []  # (label_fn, scan_rows)
+    total_scan_rows = 0
+    residual = n.cond
+    for expr in n.what:
+        v = _target_value(expr, ctx)
+        if isinstance(v, RecordId) and not isinstance(v.id, Range):
+            rows = len(list(_iterate_value(v, ctx))) if analyze else 0
+            scans.append(
+                (f"RecordIdScan [ctx: Db] [record_id: {v.render()}]", rows)
+            )
+            total_scan_rows += rows
+            continue
+        if not isinstance(v, Table):
+            rows = len(list(_iterate_value(v, ctx))) if analyze else 0
+            scans.append((f"ValueScan [ctx: Db]", rows))
+            total_scan_rows += rows
+            continue
+        tb = v.name
+        indexes = get_indexes_for(tb, ctx)
+        if n.with_index:
+            indexes = [i for i in indexes if i.name in n.with_index]
+        noindex = n.with_index == []
+        label = None
+        mts = _find_matches(n.cond) if n.cond is not None and not noindex else []
+        if mts:
+            mt = mts[0]
+            idef = next((d for d in indexes if d.fulltext is not None), None)
+            if idef is not None:
+                q = evaluate(mt.rhs, ctx)
+                label = f"FullTextScan [ctx: Db] [index: {idef.name}, query: {q}]"
+                residual = _remove_node(residual, mt)
+        if label is None and n.cond is not None and not noindex:
+            from surrealdb_tpu.idx.planner import _array_like_paths
+
+            eqs, ins, rngs = _classify_preds(
+                n.cond, _array_like_paths(tb, ctx)
+            )
+            chosen = _choose_index(indexes, eqs, ins, rngs) if (
+                eqs or ins or rngs
+            ) else None
+            if chosen is not None:
+                idef, nmatch, tail = chosen
+                vals = [evaluate(eqs[c], ctx) for c in idef.cols_str[:nmatch]]
+                if len(idef.cols_str) > 1 or tail is not None:
+                    acc = "[" + ", ".join(render(x) for x in vals) + "]"
+                else:
+                    acc = f"= {render(vals[0])}" if vals else "[]"
+                if tail is not None and tail[0] == "range":
+                    opmap = {">": "MoreThan", ">=": "MoreThanEqual",
+                             "<": "LessThan", "<=": "LessThanEqual"}
+                    for op, vx in tail[1]:
+                        acc += f" {opmap.get(op, op)} {render(evaluate(vx, ctx))}"
+                elif tail is not None and tail[0] == "in":
+                    acc += f" IN {render(evaluate(tail[1], ctx))}"
+                direction = "Forward"
+                if (
+                    n.order
+                    and n.order != "rand"
+                    and len(n.order) == 1
+                    and tail is not None
+                    and tail[0] == "range"
+                ):
+                    oexpr, odir, _oc, _on = n.order[0]
+                    from surrealdb_tpu.idx.planner import _field_path as _fp
+
+                    if (
+                        odir == "desc"
+                        and _fp(oexpr) == idef.cols_str[nmatch]
+                    ):
+                        direction = "Backward"
+                        n = _strip_order(n)
+                limattr = ""
+                if (
+                    direction == "Backward"
+                    and n.limit is not None
+                    and n.group is None
+                ):
+                    limattr = f", limit: {int(evaluate(n.limit, ctx))}"
+                label = (
+                    f"IndexScan [ctx: Db] [index: {idef.name}, access: {acc}, "
+                    f"direction: {direction}{limattr}]"
+                )
+                # residual: predicates not covered by the index
+                covered = set(idef.cols_str[:nmatch])
+                if tail is not None:
+                    covered.add(idef.cols_str[nmatch])
+                preds = []
+                from surrealdb_tpu.idx.planner import _split_ands, _field_path
+
+                _split_ands(n.cond, preds)
+                keep = []
+                for pred in preds:
+                    from surrealdb_tpu.expr.ast import Binary as _B
+
+                    pth = None
+                    enforceable = False
+                    if isinstance(pred, _B):
+                        pth = _field_path(pred.lhs) or _field_path(pred.rhs)
+                        enforceable = pred.op in (
+                            "=", "==", "<", "<=", ">", ">=", "∈"
+                        )
+                    if pth is None or pth not in covered or not enforceable:
+                        keep.append(pred)
+                residual = None
+                for pred in keep:
+                    from surrealdb_tpu.expr.ast import Binary as _B
+
+                    residual = (
+                        pred if residual is None
+                        else _B("&&", residual, pred)
+                    )
+        if label is None:
+            extra = ""
+            if n.cond is not None:
+                extra += f", predicate: {_expr_sql(n.cond)}"
+                residual = None
+            if (
+                n.limit is not None
+                and not n.order
+                and n.group is None
+            ):
+                extra += f", limit: {int(evaluate(n.limit, ctx))}"
+                if n.start is not None:
+                    extra += f", offset: {int(evaluate(n.start, ctx))}"
+            label = f"TableScan [ctx: Db] [table: {tb}, direction: Forward{extra}]"
+        if analyze:
+            # scans report their own emitted rows (pre-residual-filter);
+            # table scans with inlined predicates report post-filter
+            if label.startswith("TableScan") and n.cond is not None:
+                kept = 0
+                for src in _iterate_value(v, ctx, None, None):
+                    doc = src.doc if src.rid is not None else src.value
+                    cc = ctx.with_doc(doc, src.rid)
+                    if is_truthy(evaluate(n.cond, cc)):
+                        kept += 1
+                rows = kept
+            else:
+                rows = len(list(_iterate_value(v, ctx, n.cond, n)))
+        else:
+            rows = 0
+        scans.append((label, rows))
+        total_scan_rows += rows
+
+    # assemble the tree bottom-up
+    mid_lines = []
+    # run the select for row counts of upper operators
+    out_rows_n = 0
+    if analyze:
+        saved = n.explain
+        n.explain = None
+        try:
+            result = _s_select(n, ctx.child())
+        finally:
+            n.explain = saved
+        out_rows_n = len(result) if isinstance(result, list) else 1
+
+    root_lines = []
+    scan_lines = []  # (reldepth, text, rows)
+    if len(scans) > 1:
+        scan_lines.append((0, "Union [ctx: Db]", total_scan_rows))
+        for label, rows in scans:
+            scan_lines.append((1, label, rows))
+    else:
+        scan_lines.append((0, scans[0][0], scans[0][1]))
+    if residual is not None and not any(
+        t.lstrip().startswith("TableScan") for _d, t, _r in scan_lines
+    ):
+        scan_lines = [
+            (0, f"Filter [ctx: Db] [predicate: {_expr_sql(residual)}]",
+             out_rows_n)
+        ] + [(d + 1, t, r) for d, t, r in scan_lines]
+    if n.split:
+        names = ", ".join(expr_name(sp) for sp in n.split)
+        scan_lines = [
+            (0, f"Split [ctx: Db] [on: {names}]", out_rows_n)
+        ] + [(d + 1, t, r) for d, t, r in scan_lines]
+    # aggregation / projection root
+    if n.group is not None:
+        if n.group:
+            by = ", ".join(
+                (a or expr_name(e))
+                for e, a in n.exprs
+                if e != "*" and not _is_aggregate(e)
+            ) or ", ".join(expr_name(g) for g in n.group)
+            root_lines.append((f"Aggregate [ctx: Db] [by: {by}]", out_rows_n))
+        else:
+            # count-only GROUP ALL uses the dedicated count scans
+            only_count = (
+                len(n.exprs) == 1
+                and isinstance(n.exprs[0][0], FunctionCall)
+                and n.exprs[0][0].name.lower() == "count"
+                and not n.exprs[0][0].args
+            )
+            if only_count and len(n.what) == 1 and len(scans) == 1:
+                label, rows = scans[0]
+                tbname = label.split("table: ")[1].split(",")[0].rstrip(
+                    "]"
+                ) if "table: " in label else None
+                if label.startswith("TableScan") and n.cond is None:
+                    text = f"CountScan [ctx: Db] [source: {tbname}]"
+                    return _render_tree([(0, text, 1 if analyze else 0)],
+                                        analyze, 1)
+                if label.startswith("IndexScan"):
+                    tbn = _target_value(n.what[0], ctx).name
+                    cond_s = _expr_sql(n.cond) if n.cond is not None else ""
+                    text = (
+                        f"IndexCountScan [ctx: Db] [source: {tbn}, "
+                        f"condition: {cond_s}]"
+                    )
+                    return _render_tree([(0, text, 1 if analyze else 0)],
+                                        analyze, 1)
+            root_lines.append(
+                ("Aggregate [ctx: Db] [mode: GROUP ALL]", out_rows_n)
+            )
+    else:
+        if n.value is not None:
+            root_lines.append(
+                (f"ProjectValue [ctx: Db] [expr: {_expr_sql(n.value)}]",
+                 out_rows_n)
+            )
+        else:
+            only_rid_scans = scans and all(
+                t.startswith("RecordIdScan") for t, _r in scans
+            )
+            if only_rid_scans:
+                root_lines.append(("Project [ctx: Db]", out_rows_n))
+            else:
+                projs = ", ".join(
+                    "*" if e == "*" else (a or expr_name(e)) for e, a in n.exprs
+                )
+                root_lines.append(
+                    (f"SelectProject [ctx: Db] [projections: {projs}]",
+                     out_rows_n)
+                )
+                computed = [
+                    f"{a} = {_expr_sql(e)}"
+                    for e, a in n.exprs
+                    if e != "*" and a and not isinstance(e, Idiom)
+                ]
+                if computed:
+                    mid_lines.insert(
+                        0,
+                        (f"Compute [ctx: Db] [fields: {', '.join(computed)}]",
+                         out_rows_n),
+                    )
+    # order / limit layers: grouped sorts sit ABOVE the Aggregate; plain
+    # sorts sit under the projection
+    if n.order and n.order != "rand":
+        keys = ", ".join(
+            f"{expr_name(e)} {'DESC' if d == 'desc' else 'ASC'}"
+            for e, d, _c, _n2 in n.order
+        )
+        if n.group is not None:
+            if n.limit is not None:
+                lim = int(evaluate(n.limit, ctx))
+                root_lines.insert(
+                    0,
+                    (f"SortTopK [ctx: Db] [order_by: {keys}, limit: {lim}]",
+                     out_rows_n),
+                )
+            else:
+                root_lines.insert(
+                    0, (f"Sort [ctx: Db] [order_by: {keys}]", out_rows_n)
+                )
+        elif n.limit is not None:
+            lim = int(evaluate(n.limit, ctx))
+            mid_lines.append(
+                (f"Limit [ctx: Db] [limit: {lim}]", out_rows_n)
+            )
+            mid_lines.append(
+                (f"SortTopKByKey [ctx: Db] [sort_keys: {keys}, limit: {lim}]",
+                 out_rows_n)
+            )
+        else:
+            mid_lines.append(
+                (f"SortByKey [ctx: Db] [sort_keys: {keys}]", out_rows_n)
+            )
+    if n.limit is not None and n.group is not None:
+        lim = int(evaluate(n.limit, ctx))
+        root_lines.insert(0, (f"Limit [ctx: Db] [limit: {lim}]", out_rows_n))
+    stacked = [(i, t, r) for i, (t, r) in enumerate(root_lines + mid_lines)]
+    base = len(stacked)
+    ordered = stacked + [(base + d, t, r) for d, t, r in scan_lines]
+    return _render_tree(ordered, analyze, out_rows_n)
+
+
+def _strip_order(n):
+    import copy as _copy
+
+    n2 = _copy.copy(n)
+    n2.order = []
+    return n2
+
+
+def _render_tree(entries, analyze, total):
+    out = []
+    for depth, text, rows in entries:
+        line = ("    " * depth) + text
+        if analyze:
+            line += f" {{rows: {rows}}}"
+        out.append(line)
+    s = "\n".join(out) + "\n"
+    if analyze:
+        s += f"\nTotal rows: {total}"
+    return s
+
+
+def _s_explain_generic(n: ExplainStmt, ctx: Ctx):
+    """EXPLAIN of non-select statements: AST pretty-print (Rt context)."""
+    from surrealdb_tpu.exec.render_def import _expr_sql
+
+    lines = []
+
+    def walk_node(node, depth):
+        from surrealdb_tpu.expr.ast import (
+            BreakStmt as _Br,
+            ContinueStmt as _Co,
+            ForStmt as _For,
+            IfElse as _If,
+            LetStmt as _Let,
+            ReturnStmt as _Ret,
+            Subquery as _Sub,
+            ThrowStmt as _Th,
+        )
+
+        if isinstance(node, _Ret):
+            lines.append((depth, "Return [ctx: Rt]"))
+            walk_node(node.what, depth + 1)
+        elif isinstance(node, _Th):
+            lines.append(
+                (depth, f"Expr [ctx: Rt] [expr: THROW {_expr_sql(node.what)}]")
+            )
+        elif isinstance(node, _Br):
+            lines.append((depth, "Break [ctx: Rt]"))
+        elif isinstance(node, _Co):
+            lines.append((depth, "Continue [ctx: Rt]"))
+        elif isinstance(node, _Let):
+            lines.append((depth, f"Let [ctx: Rt] [param: ${node.name}]"))
+            walk_node(node.what, depth + 1)
+        elif isinstance(node, _For):
+            from surrealdb_tpu.expr.ast import BlockExpr as _Blk
+
+            nstmts = (
+                len(node.body.stmts) if isinstance(node.body, _Blk) else 1
+            )
+            lines.append((
+                depth,
+                f"Foreach [ctx: Rt] [param: {node.param}, statements: {nstmts}]",
+            ))
+        elif isinstance(node, _If):
+            attrs = f"branches: {len(node.branches)}"
+            if node.otherwise is not None:
+                attrs += ", has_else: true"
+            lines.append((depth, f"IfElse [ctx: Rt] [{attrs}]"))
+        elif isinstance(node, _Sub):
+            walk_node(node.stmt, depth)
+        else:
+            lines.append((depth, f"Expr [ctx: Rt] [expr: {_expr_sql(node)}]"))
+
+    walk_node(n.stmt, 0)
+    out = []
+    rows_suffix = " {rows: 0}" if n.analyze else ""
+    for depth, text in lines:
+        out.append(("    " * depth) + text + rows_suffix)
+    s_out = "\n".join(out) + "\n"
+    if n.analyze:
+        # bare expressions report one row; control-flow statements zero
+        is_bare = lines and lines[0][1].startswith("Expr ")
+        total = 1 if is_bare else 0
+        s_out += f"\nTotal rows: {total}"
+    return s_out
+
+
 def _explain_select(n: SelectStmt, ctx):
     """EXPLAIN — report the plan the iterator would use (dbs/plan.rs).
     EXPLAIN FULL also executes and reports fetch counts."""
+    if ctx.session.planner_strategy == "all-ro":
+        return _explain_streaming(n, ctx)
     from surrealdb_tpu.idx.planner import explain_plan
 
     out = []
@@ -2004,6 +2396,7 @@ _STMTS = {
     RemoveStmt: _s_remove,
     AlterTable: _s_alter,
     AlterStmt: _s_alter_other,
+    ExplainStmt: _s_explain_generic,
     RebuildIndex: _s_rebuild,
     InfoStmt: _s_info,
     LiveStmt: _s_live,

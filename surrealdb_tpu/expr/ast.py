@@ -650,6 +650,14 @@ class AlterTable(Node):
 
 
 @dataclass
+class ExplainStmt(Node):
+    """EXPLAIN [ANALYZE] <non-select statement/expression>."""
+
+    stmt: Any
+    analyze: bool = False
+
+
+@dataclass
 class AlterStmt(Node):
     """Generalized ALTER for non-table targets: a list of clause edits
     applied to the stored definition."""

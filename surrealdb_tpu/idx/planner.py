@@ -95,7 +95,22 @@ def get_indexes_for(tb, ctx):
 
 
 
-def _classify_preds(cond):
+def _array_like_paths(tb, ctx) -> set:
+    """Field paths declared array/set (their index entries are unnested, so
+    CONTAINS-family predicates can ride the index)."""
+    from surrealdb_tpu.exec.document import get_fields
+
+    out = set()
+    try:
+        for fd in get_fields(tb, ctx):
+            if fd.kind is not None and fd.kind.name in ("array", "set"):
+                out.add(fd.name_str)
+    except Exception:
+        pass
+    return out
+
+
+def _classify_preds(cond, array_paths=frozenset()):
     """WHERE-tree analysis shared by plan_scan and explain_plan: returns
     (eqs, ins, rngs) keyed by field path."""
     preds = []
@@ -115,12 +130,24 @@ def _classify_preds(cond):
         if lp is not None and rp is None:
             op = pred.op
             if op == "∋":
+                # CONTAINS only matches index entries when the column is
+                # array-shaped (unnested entries — via a .*/… path or a
+                # declared array/set field); string fields use substring
+                # semantics and can't ride the index
+                if not _array_shaped(lp, array_paths):
+                    continue
                 op = "="  # per-element entries, equality lookup
-            elif op in ("⊇", "containsany", "∈"):
+            elif op in ("⊇", "containsany"):
+                if not _array_shaped(lp, array_paths):
+                    continue
+                op = "in"
+            elif op == "∈":
                 op = "in"
             path, valexpr = lp, pred.rhs
         elif rp is not None and lp is None:
             if pred.op == "∈":
+                if not _array_shaped(rp, array_paths):
+                    continue
                 path, op, valexpr = rp, "=", pred.lhs
             else:
                 flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
@@ -134,6 +161,10 @@ def _classify_preds(cond):
         else:
             rngs.setdefault(path, []).append((op, valexpr))
     return eqs, ins, rngs
+
+
+def _array_shaped(path: str, array_paths) -> bool:
+    return ".*" in path or "…" in path or path in array_paths
 
 
 def _choose_index(indexes, eqs, ins, rngs):
@@ -194,7 +225,7 @@ def plan_scan(tb: str, cond, ctx, stmt):
         return plan_matches(tb, cond, mts, indexes, ctx, stmt)
 
     # ---- equality / range / contains on indexed columns --------------------
-    eqs, ins, rngs = _classify_preds(cond)
+    eqs, ins, rngs = _classify_preds(cond, _array_like_paths(tb, ctx))
     if not eqs and not rngs and not ins:
         return None
     chosen = _choose_index(indexes, eqs, ins, rngs)
@@ -472,7 +503,7 @@ def explain_plan(tb, cond, ctx, stmt):
                     }
         from surrealdb_tpu.exec.eval import evaluate
 
-        eqs, ins, rngs = _classify_preds(cond)
+        eqs, ins, rngs = _classify_preds(cond, _array_like_paths(tb, ctx))
         best = None
         chosen = _choose_index(indexes, eqs, ins, rngs)
         if chosen is not None:

@@ -24,6 +24,7 @@ class Session:
         self.auth_level = auth_level  # owner | editor | viewer | record | none
         self.rid = rid  # record-auth identity (RecordId)
         self.ac = ac  # access method name
+        self.planner_strategy = None  # None | "all-ro" | "compute-only"
         self.variables: dict[str, Any] = {}
 
     @property

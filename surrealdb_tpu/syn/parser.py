@@ -257,18 +257,19 @@ class Parser:
 
     # -- SELECT ---------------------------------------------------------------
     def _stmt_explain(self):
-        """EXPLAIN [FULL|ANALYZE] SELECT ... — statement-prefix form."""
+        """EXPLAIN [FULL|ANALYZE] <statement> — statement-prefix form."""
         self.next()
         mode = True
         if self.eat_kw("full"):
             mode = "full"
         elif self.eat_kw("analyze"):
             mode = "analyze"
-        if not self.at_kw("select"):
-            raise self.err("expected SELECT after EXPLAIN")
-        sel = self._stmt_select()
-        sel.explain = mode
-        return sel
+        if self.at_kw("select"):
+            sel = self._stmt_select()
+            sel.explain = mode
+            return sel
+        inner = self.parse_stmt()
+        return ExplainStmt(inner, mode == "analyze")
 
     def _stmt_select(self):
         self.next()
@@ -2469,6 +2470,9 @@ class Parser:
                        "else", "end"):
                 return False
             return True
+        if kw == "explain":
+            return t.kind in (L.PARAM, L.RECORD_STR, L.INT, L.STRING,
+                              L.FLOAT, L.DECIMAL)
         return t.kind in (L.PARAM, L.RECORD_STR, L.INT, L.STRING)
 
     def _parse_record_id(self, tb: str):

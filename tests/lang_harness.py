@@ -56,6 +56,8 @@ def parse_test_file(path: str) -> LangTest:
     t.ns = None if ns is False else (ns if isinstance(ns, str) else "test")
     t.db = None if db is False else (db if isinstance(db, str) else "test")
     t.imports = env.get("imports", [])
+    ps = env.get("planner-strategy")
+    t.planner = ps[0] if isinstance(ps, list) and ps else None
     return t
 
 
@@ -100,13 +102,17 @@ def run_lang_test(t: LangTest, ds=None):
 
     if ds is None:
         ds = Datastore("memory")
+    from surrealdb_tpu.kvs.ds import Session
+
+    sess = Session(ns=t.ns, db=t.db)
+    sess.planner_strategy = getattr(t, "planner", None)
     for imp in t.imports:
         ipath = os.path.join(os.path.dirname(t.path), imp)
         if not os.path.exists(ipath):
             ipath = os.path.join(TESTS_ROOT, imp)
         it = parse_test_file(ipath)
-        ds.execute(it.sql, ns=t.ns, db=t.db)
-    res = ds.execute(t.sql, ns=t.ns, db=t.db)
+        ds.execute(it.sql, session=sess)
+    res = ds.execute(t.sql, session=sess)
     if not t.results:
         return True, "no expectations"
     if len(res) != len(t.results):
