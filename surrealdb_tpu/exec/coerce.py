@@ -131,6 +131,16 @@ def coerce(v, kind: Kind):
         raise coerce_err(v, kind)
     if n == "literal":
         lit = kind.literal
+        from surrealdb_tpu.expr.ast import ArrayExpr as _AE
+
+        if isinstance(lit, _AE):
+            # array-shaped literal kind: elements are kinds/literals
+            if not isinstance(v, list) or len(v) != len(lit.items):
+                raise coerce_err(v, kind)
+            out = []
+            for x, spec in zip(v, lit.items):
+                out.append(coerce(x, _as_kind(spec)))
+            return out
         from surrealdb_tpu.exec.static_eval import static_value_maybe
 
         litv = static_value_maybe(lit)
@@ -316,6 +326,24 @@ def coerce(v, kind: Kind):
     raise SdbError(f"unknown kind {n!r}")
 
 
+def _as_kind(spec):
+    """A literal-kind element: already a Kind, or a literal value/AST."""
+    if isinstance(spec, Kind):
+        return spec
+    from surrealdb_tpu.expr.ast import Idiom as _Idiom, Literal as _Lit, PField as _PF
+
+    if isinstance(spec, _Idiom) and len(spec.parts) == 1 and isinstance(
+        spec.parts[0], _PF
+    ) and spec.parts[0].name.lower() in (
+        "any", "bool", "int", "float", "number", "string", "datetime",
+        "duration", "uuid", "object", "array", "bytes", "decimal",
+        "record", "geometry", "point", "set", "null", "none", "regex",
+        "range", "table",
+    ):
+        return Kind(spec.parts[0].name.lower())
+    return Kind("literal", literal=spec)
+
+
 def object_to_geometry(v: dict):
     t = v.get("type")
     if t == "GeometryCollection":
@@ -405,10 +433,9 @@ def cast(v, kind: Kind):
     elif n == "string":
         if isinstance(v, (bytes, bytearray)):
             return bytes(v).decode("utf-8", "replace")
-        if v is not NONE and v is not None:
-            from surrealdb_tpu.exec.operators import to_string
+        from surrealdb_tpu.exec.operators import to_string
 
-            return to_string(v)
+        return to_string(v)  # <string> NONE renders "NONE" (reference)
     elif n == "bool":
         if isinstance(v, str):
             if v.lower() == "true":
@@ -439,7 +466,13 @@ def cast(v, kind: Kind):
             from surrealdb_tpu.syn.parser import parse_record_literal
             from surrealdb_tpu.exec.static_eval import static_value
 
-            return static_value(parse_record_literal(v))
+            try:
+                rid2 = static_value(parse_record_literal(v))
+            except Exception:
+                raise cast_err(v, kind)
+            if kind.inner and rid2.tb not in kind.inner:
+                raise cast_err(v, kind)
+            return rid2
     elif n == "array":
         from surrealdb_tpu.val import SSet as _SSet
 
@@ -474,17 +507,26 @@ def cast(v, kind: Kind):
     elif n == "bytes":
         if isinstance(v, str):
             return v.encode("utf-8")
+        if isinstance(v, list) and all(
+            isinstance(x, int) and not isinstance(x, bool) and 0 <= x < 256
+            for x in v
+        ):
+            return bytes(v)
     elif n == "regex":
         if isinstance(v, str):
             return Regex(v)
     elif n == "geometry" or n == "point":
+        g = None
         if isinstance(v, dict):
             g = object_to_geometry(v)
-            if g is not None:
-                return g
-        if isinstance(v, (list, tuple)) and len(v) == 2 and all(
+        elif isinstance(v, (list, tuple)) and len(v) == 2 and all(
             isinstance(x, (int, float, Decimal)) and not isinstance(x, bool)
             for x in v
         ):
-            return Geometry("Point", (float(v[0]), float(v[1])))
+            g = Geometry("Point", (float(v[0]), float(v[1])))
+        if g is not None:
+            try:
+                return coerce(g, kind)
+            except SdbError:
+                raise cast_err(v, kind)
     raise cast_err(v, kind)

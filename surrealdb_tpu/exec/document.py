@@ -292,6 +292,8 @@ def apply_fields(
         path = [p.name if isinstance(p, PField) else "*" for p in fd.name]
         if path:
             defined_top.add(path[0])
+        if fd.computed is not None:
+            continue  # computed fields are read-time only (doc/compute.rs)
         for tgt_doc, old_doc in _field_targets(after, before, path[:-1]):
             last = path[-1]
             if last == "*":

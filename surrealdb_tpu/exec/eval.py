@@ -98,13 +98,34 @@ def apply_computed_fields(tb: str, doc, rid, ctx: Ctx):
     if not fds:
         return doc
     doc = dict(doc)
-    for fd in fds:
+    # computed fields may reference each other: iterate until stable
+    pending = list(fds)
+    for _pass in range(len(fds) + 1):
+        if not pending:
+            break
+        nxt = []
+        for fd in pending:
+            c = ctx.with_doc(doc, rid)
+            try:
+                v = evaluate(fd.computed, c)
+            except SdbError:
+                nxt.append(fd)
+                continue
+            if v is None or v is NONE:
+                # likely an unresolved dependency — retry in a later pass
+                nxt.append(fd)
+                continue
+            doc[fd.name_str] = v
+        if len(nxt) == len(pending):
+            break
+        pending = nxt
+    for fd in pending:
         c = ctx.with_doc(doc, rid)
         try:
             doc[fd.name_str] = evaluate(fd.computed, c)
         except SdbError:
             # a failing computed expression reads as NULL (reference
-            # computed-future semantics); internal errors still propagate
+            # computed-future semantics)
             doc[fd.name_str] = None
     return doc
 

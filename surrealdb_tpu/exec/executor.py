@@ -135,7 +135,8 @@ class Executor:
                 if not own_txn:
                     buffered.append(len(results) - 1)
             except (BreakException, ContinueException):
-                msg = "Break statement has been reached in an invalid position"
+                msg = ("Invalid control flow statement, break or continue statement "
+                       "found outside of loop.")
                 if own_txn:
                     cur.cancel()
                 results.append(QueryResult(error=msg))

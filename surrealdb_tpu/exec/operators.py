@@ -97,15 +97,11 @@ def add(a, b):
         return a + b
     if isinstance(a, list) and isinstance(b, list):
         return a + b
-    if isinstance(a, list):
-        return a + [b]
-    if isinstance(b, list):
-        return [a] + b
     if isinstance(a, dict) and isinstance(b, dict):
         out = dict(a)
         out.update(b)
         return out
-    raise SdbError(f"Cannot add {render(a)} and {render(b)}")
+    raise SdbError(f"Cannot perform addition with '{render(a)}' and '{render(b)}'")
 
 
 def sub(a, b):
@@ -124,8 +120,6 @@ def sub(a, b):
         return a - b
     if isinstance(a, list) and isinstance(b, list):
         return [x for x in a if not any(value_eq(x, y) for y in b)]
-    if isinstance(a, list):
-        return [x for x in a if not value_eq(x, b)]
     from surrealdb_tpu.val import SSet
 
     if isinstance(a, SSet):
@@ -133,14 +127,14 @@ def sub(a, b):
         return SSet(
             [x for x in a.items if not any(value_eq(x, y) for y in rem)]
         )
-    raise SdbError(f"Cannot subtract {render(b)} from {render(a)}")
+    raise SdbError(f"Cannot perform subtraction with '{render(a)}' and '{render(b)}'")
 
 
 def mul(a, b):
     if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
         a, b = _num2(a, b)
         return a * b
-    raise SdbError(f"Cannot multiply {render(a)} and {render(b)}")
+    raise SdbError(f"Cannot perform multiplication with '{render(a)}' and '{render(b)}'")
 
 
 def div(a, b):
@@ -149,13 +143,13 @@ def div(a, b):
         try:
             if isinstance(a, int) and isinstance(b, int):
                 if b == 0:
-                    return NONE
+                    return float("nan")  # reference: try_div.unwrap_or(NaN)
                 if a % b == 0:
                     return a // b
                 return a / b
             if isinstance(a, Decimal):
                 if b == 0:
-                    return NONE
+                    return float("nan")
                 return a / b
             if b == 0:
                 if a == 0:
@@ -164,7 +158,7 @@ def div(a, b):
             return a / b
         except (ZeroDivisionError, ArithmeticError):
             return NONE
-    raise SdbError(f"Cannot divide {render(a)} by {render(b)}")
+    raise SdbError(f"Cannot perform division with '{render(a)}' and '{render(b)}'")
 
 
 def rem(a, b):
@@ -172,7 +166,9 @@ def rem(a, b):
         a, b = _num2(a, b)
         try:
             if b == 0:
-                return NONE
+                raise SdbError(
+                    f"Cannot perform remainder with '{render(a)}' and '{render(b)}'"
+                )
             if isinstance(a, int) and isinstance(b, int):
                 # exact truncated remainder (Rust %): sign of the dividend
                 r = abs(a) % abs(b)
@@ -180,7 +176,7 @@ def rem(a, b):
             return math.fmod(a, b)
         except (ZeroDivisionError, ArithmeticError):
             return NONE
-    raise SdbError(f"Cannot modulo {render(a)} by {render(b)}")
+    raise SdbError(f"Cannot perform remainder with '{render(a)}' and '{render(b)}'")
 
 
 def pow_(a, b):
@@ -193,7 +189,7 @@ def pow_(a, b):
             return r
         except (OverflowError, ArithmeticError):
             return float("inf")
-    raise SdbError(f"Cannot raise {render(a)} to {render(b)}")
+    raise SdbError(f"Cannot perform power with '{render(a)}' and '{render(b)}'")
 
 
 def neg(a):
@@ -281,7 +277,10 @@ def contains(a, b) -> bool:
 
 
 def contains_all(a, b) -> bool:
-    if isinstance(a, (list, str, dict, Range)) and isinstance(b, list):
+    b = _elems(b)
+    from surrealdb_tpu.val import SSet as _S
+
+    if isinstance(a, (list, str, dict, Range, _S)) and isinstance(b, list):
         return all(contains(a, x) for x in b)
     if isinstance(a, Geometry) and isinstance(b, list):
         return all(isinstance(x, Geometry) and geo_contains(a, x) for x in b)
@@ -289,7 +288,10 @@ def contains_all(a, b) -> bool:
 
 
 def contains_any(a, b) -> bool:
-    if isinstance(a, (list, str, dict, Range)) and isinstance(b, list):
+    b = _elems(b)
+    from surrealdb_tpu.val import SSet as _S
+
+    if isinstance(a, (list, str, dict, Range, _S)) and isinstance(b, list):
         return any(contains(a, x) for x in b)
     if isinstance(a, Geometry) and isinstance(b, list):
         return any(isinstance(x, Geometry) and geo_contains(a, x) for x in b)
@@ -297,7 +299,10 @@ def contains_any(a, b) -> bool:
 
 
 def contains_none(a, b) -> bool:
-    if isinstance(a, (list, str, dict, Range)) and isinstance(b, list):
+    b = _elems(b)
+    from surrealdb_tpu.val import SSet as _S
+
+    if isinstance(a, (list, str, dict, Range, _S)) and isinstance(b, list):
         return not any(contains(a, x) for x in b)
     return True
 
@@ -308,19 +313,30 @@ def inside(a, b) -> bool:
     return contains(b, a)
 
 
+def _elems(a):
+    from surrealdb_tpu.val import SSet
+
+    if isinstance(a, SSet):
+        return a.items
+    return a
+
+
 def all_inside(a, b) -> bool:
+    a = _elems(a)
     if isinstance(a, list):
         return all(inside(x, b) for x in a)
     return inside(a, b)
 
 
 def any_inside(a, b) -> bool:
+    a = _elems(a)
     if isinstance(a, list):
         return any(inside(x, b) for x in a)
     return inside(a, b)
 
 
 def none_inside(a, b) -> bool:
+    a = _elems(a)
     if isinstance(a, list):
         return not any(inside(x, b) for x in a)
     return not inside(a, b)

@@ -56,6 +56,15 @@ def static_value(node):
         from surrealdb_tpu.exec.operators import binary_op
 
         return binary_op(node.op, static_value(node.lhs), static_value(node.rhs))
+    from surrealdb_tpu.expr.ast import FunctionCall as _FC
+
+    if isinstance(node, _FC) and node.name == "__point__":
+        from surrealdb_tpu.val import Geometry
+
+        return Geometry(
+            "Point",
+            (float(static_value(node.args[0])), float(static_value(node.args[1]))),
+        )
     raise SdbError(f"not a static value: {node!r}")
 
 

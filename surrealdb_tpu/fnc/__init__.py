@@ -409,10 +409,13 @@ from surrealdb_tpu.fnc import (  # noqa: E402,F401
     vector_fns,
 )
 
-# type::is_X(...) function-call aliases for the type::is::X predicates
+# underscore aliases: family::is_X / family::from_X mirror family::is::X /
+# family::from::X (both spellings exist in the reference surface)
 for _pname in list(FUNCS):
-    if _pname.startswith("type::is::"):
-        FUNCS[f"type::is_{_pname[10:]}"] = FUNCS[_pname]
+    if "::is::" in _pname:
+        FUNCS[_pname.replace("::is::", "::is_")] = FUNCS[_pname]
+    if "::from::" in _pname:
+        FUNCS[_pname.replace("::from::", "::from_")] = FUNCS[_pname]
 
 # arity table (reference fnc signatures; (lo, hi) with hi=None = unbounded)
 ARITY.update({

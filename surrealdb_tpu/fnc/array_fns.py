@@ -70,8 +70,10 @@ def _append(args, ctx):
 
 @register("array::at")
 def _at(args, ctx):
+    from surrealdb_tpu.fnc import _int
+
     a = _arr(args[0], "array::at", 1)
-    i = int(_num(args[1], "array::at", 2))
+    i = _int(args[1], "array::at", 2)
     if -len(a) <= i < len(a):
         return a[i]
     return NONE
@@ -155,9 +157,14 @@ def _distinct(args, ctx):
 def _fill(args, ctx):
     a = _arr(args[0], "array::fill", 1)[:]
     v = args[1]
+    n = len(a)
     beg = int(args[2]) if len(args) > 2 else 0
-    end = int(args[3]) if len(args) > 3 else len(a)
-    for i in range(max(beg, 0), min(end, len(a))):
+    end = int(args[3]) if len(args) > 3 else n
+    if beg < 0:
+        beg += n
+    if len(args) > 3 and end < 0:
+        end += n
+    for i in range(max(beg, 0), min(end, n)):
         a[i] = v
     return a
 

@@ -9,6 +9,20 @@ from surrealdb_tpu.fnc import _arr, _num, _str, register
 from surrealdb_tpu.val import NONE, Datetime, RecordId, Regex, Uuid
 
 
+@register("string::capitalize")
+def _capitalize(args, ctx):
+    s = _str(args[0], "string::capitalize", 1)
+    out = []
+    prev_ws = True
+    for ch in s:
+        if prev_ws and ch.islower():
+            out.append(ch.upper())
+        else:
+            out.append(ch)
+        prev_ws = ch.isspace()
+    return "".join(out)
+
+
 @register("string::concat")
 def _concat(args, ctx):
     from surrealdb_tpu.exec.operators import to_string

@@ -97,6 +97,24 @@ def _nano(args, ctx):
     return d.epoch_ns()
 
 
+def _set_component(args, which, fname):
+    d = _dtm(args[0], fname)
+    v = int(args[1])
+    try:
+        return Datetime(d.dt.replace(**{which: v}), d.ns_frac)
+    except ValueError:
+        raise SdbError(f"Unable to set datetime to {which} {v}")
+
+
+for _comp in ("year", "month", "day", "hour", "minute", "second"):
+    def _mk_set(comp):
+        @register(f"time::set_{comp}", arity=(2, 2))
+        def _f(args, ctx):
+            return _set_component(args, comp, f"time::set_{comp}")
+
+    _mk_set(_comp)
+
+
 @register("time::timezone")
 def _timezone(args, ctx):
     return "UTC"

@@ -718,7 +718,13 @@ def is_truthy(v) -> bool:
         return v.ns != 0
     if isinstance(v, (bytes, bytearray)):
         return len(v) > 0
-    return True
+    if isinstance(v, (Uuid, RecordId, Geometry, Datetime, Closure, SSet)):
+        # sets follow array truthiness; the rest are truthy by identity
+        if isinstance(v, SSet):
+            return len(v) > 0
+        return True
+    # everything else (Regex, Range, File, Table, ...) is not truthy
+    return False
 
 
 # ---------------------------------------------------------------------------
@@ -727,6 +733,14 @@ def is_truthy(v) -> bool:
 
 _IDENT_RX = _re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
 _DIGITS_RX = _re.compile(r"^[0-9]+$")
+
+
+def escape_object_key(s: str) -> str:
+    """Object keys: bare when alphanumeric (digits-only included), else
+    double-quoted (reference object key escaping)."""
+    if _re.match(r"^[A-Za-z0-9_]+$", s):
+        return s
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
 
 
 def escape_ident(s: str) -> str:
@@ -797,8 +811,10 @@ def render(v, pretty: bool = False, _depth: int = 0) -> str:
     if isinstance(v, dict):
         if not v:
             return "{  }"
+        # object keys render in sorted order (reference objects are BTreeMaps)
         items = ", ".join(
-            f"{escape_ident(k)}: {render(x, pretty, _depth + 1)}" for k, x in v.items()
+            f"{escape_object_key(k)}: {render(v[k], pretty, _depth + 1)}"
+            for k in sorted(v.keys())
         )
         return "{ " + items + " }"
     if isinstance(v, Geometry):
