@@ -77,6 +77,19 @@ class Datastore:
         self.path = path
         self.strict = strict
         if path in ("memory", "mem://", "mem"):
+            # the C++ memtable engine when the toolchain built it, else the
+            # pure-Python sorted map (same Transactable semantics)
+            from surrealdb_tpu.native import available
+
+            if available():
+                from surrealdb_tpu.kvs.native_mem import NativeMemBackend
+
+                self.backend = NativeMemBackend()
+            else:
+                from surrealdb_tpu.kvs.mem import MemBackend
+
+                self.backend = MemBackend()
+        elif path in ("pymem", "pymem://"):
             from surrealdb_tpu.kvs.mem import MemBackend
 
             self.backend = MemBackend()

@@ -1,0 +1,99 @@
+"""Native in-memory engine: the C++ memtable behind the Transactable
+contract (reference role: kvs/mem's native btree). Transactions keep a
+Python-side buffered writeset (same semantics as kvs/mem.MemTx) and commit
+atomically via the native batch op."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.kvs.api import Backend, BackendTx
+from surrealdb_tpu.native import NativeMemtable
+
+
+class NativeMemTx(BackendTx):
+    def __init__(self, store: "NativeMemBackend", write: bool):
+        self.store = store
+        self.write = write
+        self.writes: dict[bytes, Optional[bytes]] = {}
+        self.savepoints: list[dict] = []
+        self.done = False
+
+    def _check(self):
+        if self.done:
+            raise SdbError("transaction is finished")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        self._check()
+        if key in self.writes:
+            return self.writes[key]
+        return self.store.table.get(key)
+
+    def set(self, key: bytes, val: bytes) -> None:
+        self._check()
+        if not self.write:
+            raise SdbError("transaction is read-only")
+        self.writes[key] = bytes(val)
+
+    def delete(self, key: bytes) -> None:
+        self._check()
+        if not self.write:
+            raise SdbError("transaction is read-only")
+        self.writes[key] = None
+
+    def scan(self, beg, end, limit=None, reverse=False):
+        self._check()
+        if not self.writes:
+            yield from self.store.table.scan(beg, end, limit, reverse)
+            return
+        # merge the committed scan with the overlay
+        base = dict(self.store.table.scan(beg, end))
+        for k, v in self.writes.items():
+            if beg <= k < end:
+                if v is None:
+                    base.pop(k, None)
+                else:
+                    base[k] = v
+        keys = sorted(base, reverse=reverse)
+        n = 0
+        for k in keys:
+            yield k, base[k]
+            n += 1
+            if limit is not None and n >= limit:
+                return
+
+    def count(self, beg, end):
+        self._check()
+        if not self.writes:
+            return self.store.table.count_range(beg, end)
+        return sum(1 for _ in self.scan(beg, end))
+
+    def new_save_point(self):
+        self.savepoints.append(dict(self.writes))
+
+    def rollback_to_save_point(self):
+        if self.savepoints:
+            self.writes = self.savepoints.pop()
+
+    def release_last_save_point(self):
+        if self.savepoints:
+            self.savepoints.pop()
+
+    def commit(self):
+        self._check()
+        self.done = True
+        if self.writes:
+            self.store.table.apply_batch(self.writes.items())
+
+    def cancel(self):
+        self.done = True
+        self.writes.clear()
+
+
+class NativeMemBackend(Backend):
+    def __init__(self):
+        self.table = NativeMemtable()
+
+    def transaction(self, write: bool) -> NativeMemTx:
+        return NativeMemTx(self, write)

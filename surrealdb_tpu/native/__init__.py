@@ -1,0 +1,163 @@
+"""ctypes binding + lazy build of the native C++ memtable.
+
+The shared library is compiled once (g++ -O2) into the package directory and
+cached; loading falls back gracefully to None so the pure-Python engine
+keeps working on systems without a toolchain."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "memtable.cpp")
+_SO = os.path.join(_HERE, "_memtable.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load():
+    """The bound library, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        c_char_pp = ctypes.POINTER(ctypes.c_char_p)
+        i64 = ctypes.c_int64
+        i64p = ctypes.POINTER(i64)
+        lib.sdb_memtable_new.restype = ctypes.c_void_p
+        lib.sdb_memtable_free.argtypes = [ctypes.c_void_p]
+        lib.sdb_get.restype = ctypes.c_int
+        lib.sdb_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64,
+                                c_char_pp, i64p]
+        lib.sdb_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64,
+                                ctypes.c_char_p, i64]
+        lib.sdb_del.restype = ctypes.c_int
+        lib.sdb_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64]
+        lib.sdb_len.restype = i64
+        lib.sdb_len.argtypes = [ctypes.c_void_p]
+        lib.sdb_apply_batch.argtypes = [
+            ctypes.c_void_p, i64, c_char_pp, i64p, c_char_pp, i64p
+        ]
+        lib.sdb_scan_new.restype = ctypes.c_void_p
+        lib.sdb_scan_new.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64,
+                                     ctypes.c_char_p, i64, i64, ctypes.c_int]
+        lib.sdb_scan_next.restype = ctypes.c_int
+        lib.sdb_scan_next.argtypes = [ctypes.c_void_p, c_char_pp, i64p,
+                                      c_char_pp, i64p]
+        lib.sdb_scan_free.argtypes = [ctypes.c_void_p]
+        lib.sdb_count_range.restype = i64
+        lib.sdb_count_range.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        i64, ctypes.c_char_p, i64]
+        lib.sdb_delete_range.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         i64, ctypes.c_char_p, i64]
+        _lib = lib
+        return _lib
+
+
+class NativeMemtable:
+    """Thin OO wrapper over the C ABI."""
+
+    def __init__(self):
+        self.lib = load()
+        if self.lib is None:
+            raise RuntimeError("native memtable unavailable")
+        self.h = self.lib.sdb_memtable_new()
+
+    def __del__(self):
+        try:
+            if getattr(self, "h", None):
+                self.lib.sdb_memtable_free(self.h)
+                self.h = None
+        except Exception:
+            pass
+
+    def get(self, key: bytes):
+        out = ctypes.c_char_p()
+        n = ctypes.c_int64()
+        if self.lib.sdb_get(self.h, key, len(key), ctypes.byref(out),
+                            ctypes.byref(n)):
+            return ctypes.string_at(out, n.value)
+        return None
+
+    def set(self, key: bytes, val: bytes):
+        self.lib.sdb_set(self.h, key, len(key), val, len(val))
+
+    def delete(self, key: bytes):
+        self.lib.sdb_del(self.h, key, len(key))
+
+    def __len__(self):
+        return self.lib.sdb_len(self.h)
+
+    def apply_batch(self, items):
+        """items: iterable of (key, val|None). Applied atomically."""
+        items = list(items)
+        n = len(items)
+        if not n:
+            return
+        keys = (ctypes.c_char_p * n)(*[k for k, _v in items])
+        klens = (ctypes.c_int64 * n)(*[len(k) for k, _v in items])
+        vals = (ctypes.c_char_p * n)(
+            *[(v if v is not None else b"") for _k, v in items]
+        )
+        vlens = (ctypes.c_int64 * n)(
+            *[(len(v) if v is not None else -1) for _k, v in items]
+        )
+        self.lib.sdb_apply_batch(self.h, n, keys, klens, vals, vlens)
+
+    def scan(self, beg: bytes, end: bytes, limit=None, reverse=False):
+        it = self.lib.sdb_scan_new(
+            self.h, beg, len(beg), end, len(end),
+            -1 if limit is None else int(limit), 1 if reverse else 0,
+        )
+        try:
+            kp = ctypes.c_char_p()
+            kl = ctypes.c_int64()
+            vp = ctypes.c_char_p()
+            vl = ctypes.c_int64()
+            while self.lib.sdb_scan_next(
+                it, ctypes.byref(kp), ctypes.byref(kl), ctypes.byref(vp),
+                ctypes.byref(vl),
+            ):
+                yield (
+                    ctypes.string_at(kp, kl.value),
+                    ctypes.string_at(vp, vl.value),
+                )
+        finally:
+            self.lib.sdb_scan_free(it)
+
+    def count_range(self, beg: bytes, end: bytes) -> int:
+        return self.lib.sdb_count_range(self.h, beg, len(beg), end, len(end))
+
+    def delete_range(self, beg: bytes, end: bytes):
+        self.lib.sdb_delete_range(self.h, beg, len(beg), end, len(end))
+
+
+def available() -> bool:
+    return load() is not None

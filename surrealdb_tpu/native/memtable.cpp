@@ -1,0 +1,150 @@
+// Native ordered memtable (reference role: the in-proc engine that
+// surrealdb/core/src/kvs/mem fills with its Rust MVCC btree, and the C++
+// RocksDB layer fills for the persistent engine).
+//
+// An ordered byte-keyspace with snapshot-free reads, batch commit, and
+// range scans, exported with a C ABI for the ctypes binding in
+// surrealdb_tpu/native/__init__.py. The Python Transaction layer keeps its
+// buffered writeset; commit applies batches atomically under the store
+// mutex.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Memtable {
+    std::map<std::string, std::string> data;
+    std::mutex mu;
+};
+
+struct ScanIter {
+    // materialized snapshot of the range (keeps iteration stable without
+    // holding the store lock across Python callbacks)
+    std::vector<std::pair<std::string, std::string>> items;
+    size_t pos = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* sdb_memtable_new() { return new Memtable(); }
+
+void sdb_memtable_free(void* h) { delete static_cast<Memtable*>(h); }
+
+// single ops ---------------------------------------------------------------
+
+int sdb_get(void* h, const char* key, int64_t klen, const char** val,
+            int64_t* vlen) {
+    auto* m = static_cast<Memtable*>(h);
+    std::lock_guard<std::mutex> lock(m->mu);
+    auto it = m->data.find(std::string(key, klen));
+    if (it == m->data.end()) return 0;
+    *val = it->second.data();
+    *vlen = static_cast<int64_t>(it->second.size());
+    return 1;
+}
+
+void sdb_set(void* h, const char* key, int64_t klen, const char* val,
+             int64_t vlen) {
+    auto* m = static_cast<Memtable*>(h);
+    std::lock_guard<std::mutex> lock(m->mu);
+    m->data[std::string(key, klen)] = std::string(val, vlen);
+}
+
+int sdb_del(void* h, const char* key, int64_t klen) {
+    auto* m = static_cast<Memtable*>(h);
+    std::lock_guard<std::mutex> lock(m->mu);
+    return m->data.erase(std::string(key, klen)) ? 1 : 0;
+}
+
+int64_t sdb_len(void* h) {
+    auto* m = static_cast<Memtable*>(h);
+    std::lock_guard<std::mutex> lock(m->mu);
+    return static_cast<int64_t>(m->data.size());
+}
+
+// batch commit: interleaved (key, val) pairs; vlen < 0 marks a tombstone --
+
+void sdb_apply_batch(void* h, int64_t n, const char** keys,
+                     const int64_t* klens, const char** vals,
+                     const int64_t* vlens) {
+    auto* m = static_cast<Memtable*>(h);
+    std::lock_guard<std::mutex> lock(m->mu);
+    for (int64_t i = 0; i < n; i++) {
+        std::string k(keys[i], klens[i]);
+        if (vlens[i] < 0) {
+            m->data.erase(k);
+        } else {
+            m->data[k] = std::string(vals[i], vlens[i]);
+        }
+    }
+}
+
+// range scans --------------------------------------------------------------
+
+void* sdb_scan_new(void* h, const char* beg, int64_t blen, const char* end,
+                   int64_t elen, int64_t limit, int reverse) {
+    auto* m = static_cast<Memtable*>(h);
+    auto* it = new ScanIter();
+    std::string kb(beg, blen), ke(end, elen);
+    std::lock_guard<std::mutex> lock(m->mu);
+    auto lo = m->data.lower_bound(kb);
+    auto hi = m->data.lower_bound(ke);
+    if (!reverse) {
+        for (auto cur = lo; cur != hi; ++cur) {
+            it->items.emplace_back(cur->first, cur->second);
+            if (limit >= 0 &&
+                static_cast<int64_t>(it->items.size()) >= limit)
+                break;
+        }
+    } else {
+        for (auto cur = hi; cur != lo;) {
+            --cur;
+            it->items.emplace_back(cur->first, cur->second);
+            if (limit >= 0 &&
+                static_cast<int64_t>(it->items.size()) >= limit)
+                break;
+        }
+    }
+    return it;
+}
+
+int sdb_scan_next(void* hit, const char** key, int64_t* klen,
+                  const char** val, int64_t* vlen) {
+    auto* it = static_cast<ScanIter*>(hit);
+    if (it->pos >= it->items.size()) return 0;
+    auto& kv = it->items[it->pos++];
+    *key = kv.first.data();
+    *klen = static_cast<int64_t>(kv.first.size());
+    *val = kv.second.data();
+    *vlen = static_cast<int64_t>(kv.second.size());
+    return 1;
+}
+
+void sdb_scan_free(void* hit) { delete static_cast<ScanIter*>(hit); }
+
+int64_t sdb_count_range(void* h, const char* beg, int64_t blen,
+                        const char* end, int64_t elen) {
+    auto* m = static_cast<Memtable*>(h);
+    std::string kb(beg, blen), ke(end, elen);
+    std::lock_guard<std::mutex> lock(m->mu);
+    auto lo = m->data.lower_bound(kb);
+    auto hi = m->data.lower_bound(ke);
+    return static_cast<int64_t>(std::distance(lo, hi));
+}
+
+void sdb_delete_range(void* h, const char* beg, int64_t blen,
+                      const char* end, int64_t elen) {
+    auto* m = static_cast<Memtable*>(h);
+    std::string kb(beg, blen), ke(end, elen);
+    std::lock_guard<std::mutex> lock(m->mu);
+    m->data.erase(m->data.lower_bound(kb), m->data.lower_bound(ke));
+}
+
+}  // extern "C"

@@ -1,0 +1,89 @@
+"""Native C++ memtable engine: contract parity with the Python engine."""
+
+import pytest
+
+from surrealdb_tpu.native import available
+
+
+pytestmark = pytest.mark.skipif(not available(), reason="no g++ toolchain")
+
+
+def test_native_available():
+    assert available()
+
+
+def test_basic_ops():
+    from surrealdb_tpu.kvs.native_mem import NativeMemBackend
+
+    b = NativeMemBackend()
+    tx = b.transaction(write=True)
+    tx.set(b"a", b"1")
+    tx.set(b"b", b"2")
+    tx.set(b"c", b"3")
+    tx.delete(b"b")
+    assert tx.get(b"a") == b"1"
+    assert tx.get(b"b") is None
+    tx.commit()
+    tx = b.transaction(write=False)
+    assert [k for k, _ in tx.scan(b"a", b"z")] == [b"a", b"c"]
+    assert [k for k, _ in tx.scan(b"a", b"z", reverse=True)] == [b"c", b"a"]
+    assert tx.count(b"a", b"z") == 2
+    tx.cancel()
+
+
+def test_rollback_and_savepoints():
+    from surrealdb_tpu.kvs.native_mem import NativeMemBackend
+
+    b = NativeMemBackend()
+    tx = b.transaction(write=True)
+    tx.set(b"x", b"1")
+    tx.new_save_point()
+    tx.set(b"y", b"2")
+    tx.rollback_to_save_point()
+    tx.commit()
+    tx = b.transaction(write=False)
+    assert tx.get(b"x") == b"1"
+    assert tx.get(b"y") is None
+    tx.cancel()
+    # cancelled txns leave no trace
+    tx = b.transaction(write=True)
+    tx.set(b"z", b"9")
+    tx.cancel()
+    tx = b.transaction(write=False)
+    assert tx.get(b"z") is None
+    tx.cancel()
+
+
+def test_engine_parity_through_sql():
+    """Same SQL workload on both engines produces identical results."""
+    from surrealdb_tpu import Datastore
+
+    work = (
+        "DEFINE INDEX i ON t FIELDS n;"
+        "CREATE t:1 SET n = 3; CREATE t:2 SET n = 1; CREATE t:3 SET n = 2;"
+        "RELATE t:1->e->t:2;"
+        "UPDATE t:2 SET n = 10;"
+        "DELETE t:3;"
+    )
+    q = (
+        "SELECT * FROM t ORDER BY n;"
+        "SELECT id FROM t WHERE n = 10;"
+        "RETURN t:1->e->t;"
+        "SELECT count() FROM t GROUP ALL"
+    )
+    outs = []
+    for path in ("memory", "pymem"):
+        ds = Datastore(path)
+        ds.execute(work, ns="p", db="p")
+        outs.append([r.result for r in ds.execute(q, ns="p", db="p")])
+    from surrealdb_tpu.val import render
+
+    assert render(outs[0]) == render(outs[1])
+
+
+def test_datastore_uses_native_by_default():
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu.kvs.native_mem import NativeMemBackend
+
+    ds = Datastore("memory")
+    assert isinstance(ds.backend, NativeMemBackend)
