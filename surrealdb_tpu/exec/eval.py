@@ -760,6 +760,60 @@ def _collect_rids(val, ctx):
     return out
 
 
+def _destructure_has_rec(dez: PDestructure) -> bool:
+    for _name, sub in dez.fields:
+        if isinstance(sub, Idiom) and sub.parts and isinstance(
+            sub.parts[-1], PField
+        ) and sub.parts[-1].name == "@":
+            return True
+    return False
+
+
+def _recursive_destructure(val, dez: PDestructure, rmin, rmax, ctx, depth=0):
+    if isinstance(val, list):
+        return [
+            _recursive_destructure(x, dez, rmin, rmax, ctx, depth)
+            for x in val
+            if x is not NONE and x is not None
+        ]
+    node = val
+    doc = fetch_record(ctx, node) if isinstance(node, RecordId) else node
+    if not isinstance(doc, dict):
+        return NONE
+    out = {}
+    for name, sub in dez.fields:
+        if sub is None:
+            out[name] = doc.get(name, NONE)
+            continue
+        is_rec = (
+            isinstance(sub, Idiom)
+            and sub.parts
+            and isinstance(sub.parts[-1], PField)
+            and sub.parts[-1].name == "@"
+        )
+        if not is_rec:
+            c = ctx.with_doc(doc, node if isinstance(node, RecordId) else None)
+            out[name] = evaluate(sub, c)
+            continue
+        prefix = [p for p in sub.parts[:-1] if not isinstance(p, tuple)]
+        children = walk(
+            node if isinstance(node, RecordId) else doc, prefix, ctx
+        )
+        if children is NONE or children is None:
+            children = []
+        if not isinstance(children, list):
+            children = [children]
+        if depth + 1 >= rmax:
+            out[name] = []
+        else:
+            out[name] = [
+                _recursive_destructure(ch, dez, rmin, rmax, ctx, depth + 1)
+                for ch in children
+                if ch is not NONE and ch is not None
+            ]
+    return out
+
+
 def _apply_destructure(val, part: PDestructure, ctx):
     if isinstance(val, list):
         return [_apply_destructure(x, part, ctx) for x in val]
@@ -779,13 +833,31 @@ def _apply_destructure(val, part: PDestructure, ctx):
 
 def _apply_recurse(val, part: PRecurse, tail, ctx):
     """Bounded recursion `.{min..max[+instr]}(step)` (reference
-    exec/operators/recursion.rs). BFS over the step parts with a visited
-    set; instructions: collect / path / shortest=target / inclusive."""
+    exec/operators/recursion.rs).
+
+    - exact `{n}`: the frontier after exactly n steps (per-frontier dedup,
+      revisits across depths allowed — cycles can resurface nodes)
+    - range `{a..b}` default: first-seen union of the frontiers at depths
+      a..b (no global visited set; b bounds termination)
+    - +collect: BFS union with a visited set (safe for unbounded ranges)
+    - +path: DFS enumeration of full paths, cutting on in-path revisits
+      (the repeated node terminates and is included)
+    - +shortest=target: BFS shortest path; +inclusive prepends the subject
+    """
     from surrealdb_tpu.val import hashable
 
     rmin = part.min if part.min is not None else 1
     rmax = part.max if part.max is not None else 256
-    rmax = min(rmax, 256)
+    if part.min is not None and part.min < 1:
+        raise SdbError(f"Found {part.min} for bound but expected at least 1.")
+    if part.max is not None and part.max > 256:
+        raise SdbError(
+            f"Found {part.max} for bound but expected 256 at most."
+        )
+    if part.min is not None and part.min > 256:
+        raise SdbError(
+            f"Found {part.min} for bound but expected 256 at most."
+        )
     parts = part.parts if part.parts else tail
     if not parts:
         return NONE
@@ -801,10 +873,16 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
     mode = next(
         (n for n in names if n in ("collect", "path", "shortest")), None
     )
-
-    csr_pat = (
-        _csr_pair_pattern(parts[0], parts[1]) if len(parts) == 2 else None
-    )
+    step_is_graph = bool(parts) and isinstance(parts[0], PGraph)
+    # recursive destructure: `.{..}.{ name, sub: ->x->y.@ }` — the @ marks
+    # where the destructure repeats, building a nested tree
+    if (
+        mode is None
+        and len(parts) == 1
+        and isinstance(parts[0], PDestructure)
+        and _destructure_has_rec(parts[0])
+    ):
+        return _recursive_destructure(val, parts[0], rmin, rmax, ctx)
 
     def step(node):
         out = walk(node, parts, ctx)
@@ -822,27 +900,137 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
 
     start_items = val if isinstance(val, list) else [val]
     start_items = [x for x in start_items if x is not NONE and x is not None]
-    visited = {hashable(x) for x in start_items}
-    frontier = list(start_items)
-    parent: dict = {}
-    collected: list = []
-    depth = 0
     was_list = isinstance(val, list)
-    last_nonempty = frontier
-    last_depth = 0
 
+    # ---- path: DFS with in-path cycle cuts --------------------------------
+    if mode == "path":
+        paths = []
+
+        def dfs(node, acc, depth):
+            nonlocal was_list
+            if depth >= rmax:
+                if len(acc) >= rmin:
+                    paths.append(list(acc))
+                return
+            children, islist = step(node)
+            was_list = was_list or islist
+            if not children:
+                if len(acc) >= rmin:
+                    paths.append(list(acc))
+                return
+            inpath = {hashable(x) for x in acc}
+            inpath.add(hashable(node))
+            for ch in children:
+                if hashable(ch) in inpath:
+                    # cycle: emit the path closed by the repeated node
+                    if len(acc) + 1 >= rmin:
+                        paths.append(list(acc) + [ch])
+                    continue
+                dfs(ch, acc + [ch], depth + 1)
+
+        for sn in start_items:
+            base = [sn] if inclusive else []
+            dfs(sn, base, 0)
+        return paths
+
+    # ---- shortest: BFS with parent links ----------------------------------
+    if mode == "shortest":
+        visited = {hashable(x) for x in start_items}
+        parent: dict = {}
+        frontier = list(start_items)
+        last_frontier = []
+        depth = 0
+
+        def path_to(x, include_self=True):
+            p = [x] if include_self else []
+            cur = parent.get(hashable(x))
+            while cur is not None:
+                p.append(cur)
+                cur = parent.get(hashable(cur))
+            p.reverse()
+            return p
+
+        while depth < rmax and frontier:
+            nxt = []
+            for node in frontier:
+                children, islist = step(node)
+                was_list = was_list or islist
+                for ch in children:
+                    h = hashable(ch)
+                    if h in visited:
+                        continue
+                    visited.add(h)
+                    parent[h] = node
+                    nxt.append(ch)
+                    if target is not None and value_eq(ch, target):
+                        path = path_to(ch)
+                        if inclusive:
+                            path = start_items[:1] + path
+                        return path
+            depth += 1
+            frontier = nxt
+            if nxt:
+                last_frontier = nxt
+        if part.max is not None and last_frontier:
+            # bounded search that missed: the partial paths explored
+            out = []
+            for x in last_frontier:
+                p = path_to(x)
+                if inclusive:
+                    p = start_items[:1] + p
+                out.append(p)
+            return out
+        return NONE
+
+    # ---- collect: BFS union with visited set (the subject itself may be
+    # rediscovered through a cycle and collected) --------------------------
+    if mode == "collect":
+        visited = set()
+        collected = []
+        frontier = list(start_items)
+        depth = 0
+        while depth < rmax and frontier:
+            nxt = []
+            for node in frontier:
+                children, islist = step(node)
+                was_list = was_list or islist
+                for ch in children:
+                    h = hashable(ch)
+                    if h in visited:
+                        continue
+                    visited.add(h)
+                    nxt.append(ch)
+            depth += 1
+            if depth >= rmin:
+                collected.extend(nxt)
+            frontier = nxt
+        if inclusive:
+            collected = start_items + collected
+        return collected
+
+    # ---- default: frontier iteration, no global visited set ---------------
+    from surrealdb_tpu.graph import TPU_FRONTIER_THRESHOLD
+
+    csr_pat = (
+        _csr_pair_pattern(parts[0], parts[1]) if len(parts) == 2 else None
+    )
+    exact = part.min is not None and part.max == part.min
+    hard_limit = part.max is None
+    frontier = list(start_items)
+    union = []
+    union_seen = set()
+    last_nonempty = []
+    depth = 0
+    stalled = False
     while depth < rmax and frontier:
         nxt = []
-        from surrealdb_tpu.graph import TPU_FRONTIER_THRESHOLD
-
+        seen_frontier = set()
         if (
             csr_pat is not None
-            and mode != "shortest"
             and len(frontier) >= TPU_FRONTIER_THRESHOLD
             and all(isinstance(x, RecordId) for x in frontier)
             and {x.tb for x in frontier} == {csr_pat[1]}
         ):
-            # device hop: dedup matches the visited-set semantics here
             from surrealdb_tpu.graph.csr import get_csr
 
             edge_tb, node_tb, gdir = csr_pat
@@ -852,82 +1040,56 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
             for kk in keys:
                 ch = RecordId(node_tb, kk)
                 h = hashable(ch)
-                if h in visited:
-                    continue
-                visited.add(h)
-                nxt.append(ch)
-            depth += 1
-            if mode in ("collect", "path") and depth >= rmin:
-                collected.extend(nxt)
-            frontier = nxt
-            if nxt:
-                last_nonempty = nxt
-                last_depth = depth
-            continue
-        for node in frontier:
-            children, islist = step(node)
-            was_list = was_list or islist
-            for ch in children:
-                h = hashable(ch)
-                if h in visited:
-                    continue
-                visited.add(h)
-                parent[h] = node
-                nxt.append(ch)
-                if mode == "shortest" and target is not None and value_eq(
-                    ch, target
-                ):
-                    # rebuild the path start→target
-                    path = [ch]
-                    cur = node
-                    while cur is not None:
-                        path.append(cur)
-                        cur = parent.get(hashable(cur))
-                    path.reverse()
-                    if not inclusive:
-                        path = path[1:]
-                    return path
+                if h not in seen_frontier:
+                    seen_frontier.add(h)
+                    nxt.append(ch)
+        else:
+            for node in frontier:
+                children, islist = step(node)
+                was_list = was_list or islist
+                for ch in children:
+                    h = hashable(ch)
+                    if h not in seen_frontier:
+                        seen_frontier.add(h)
+                        nxt.append(ch)
         depth += 1
-        if mode in ("collect", "path") and depth >= rmin:
-            collected.extend(nxt)
-        frontier = nxt
         if nxt:
             last_nonempty = nxt
-            last_depth = depth
+        if depth >= rmin:
+            grew = False
+            for ch in nxt:
+                h = hashable(ch)
+                if h not in union_seen:
+                    union_seen.add(h)
+                    union.append(ch)
+                    grew = True
+            # unbounded ranges terminate once the union stops growing
+            if part.max is None and not grew and depth > rmin:
+                stalled = True
+        frontier = nxt
+        if exact and depth >= rmax:
+            break
+        if stalled:
+            break
+        if hard_limit and depth >= 256 and frontier:
+            raise SdbError("Exceeded the idiom recursion limit of 256.")
 
-    if mode == "shortest":
-        return NONE
-    if mode == "collect":
-        out = list(collected)
-        if inclusive:
-            out = start_items + out
-        return out
-    if mode == "path":
-        def path_to(x):
-            p = []
-            cur = x
-            while cur is not None:
-                p.append(cur)
-                cur = parent.get(hashable(cur))
-            p.reverse()
-            if not inclusive and len(p) > 1:
-                p = p[1:]
-            return p
-
-        return [path_to(x) for x in collected]
-    # default: the frontier at the final depth; must reach min depth
-    if part.min is not None and part.max == part.min:
-        # exact depth: the frontier after exactly that many steps
+    if exact:
         out = frontier if depth == rmax else []
         if not was_list:
             return out[0] if out else NONE
         return out
-    if last_depth < rmin:
+    if depth < rmin:
         return [] if was_list else NONE
-    out = last_nonempty if last_depth >= 1 else []
+    if part.max is None:
+        # fully unbounded `{..}`: walk to exhaustion, final frontier
+        out = last_nonempty
+        if not was_list:
+            return out[0] if out else NONE
+        return out
     if not was_list:
-        return out[0] if out else NONE
-    return out
+        return union[-1] if union else NONE
+    return union
 
 
 # ---------------------------------------------------------------------------

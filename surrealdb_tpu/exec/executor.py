@@ -121,9 +121,9 @@ class Executor:
                     pass  # session mutated in place
                 if own_txn:
                     cur.commit()
-                results.append(
-                    QueryResult(result=out, time_ns=time.perf_counter_ns() - t0)
-                )
+                dt = time.perf_counter_ns() - t0
+                self.ds.record_statement(True, dt, type(stmt).__name__)
+                results.append(QueryResult(result=out, time_ns=dt))
                 if not own_txn:
                     buffered.append(len(results) - 1)
             except ReturnException as r:
@@ -146,6 +146,9 @@ class Executor:
                 else:
                     cur.rollback_to_save_point()
                     failed = True
+                self.ds.record_statement(
+                    False, time.perf_counter_ns() - t0, type(stmt).__name__
+                )
                 results.append(QueryResult(error=str(e)))
                 if not own_txn:
                     buffered.append(len(results) - 1)

@@ -329,6 +329,11 @@ def expr_name(expr) -> str:
                 out.append("[$]")
             elif isinstance(p, PGraph):
                 arrow = {"out": "->", "in": "<-", "both": "<->", "ref": "<~"}[p.dir]
+                if p.expr is not None:
+                    from surrealdb_tpu.exec.render_def import _select_sql
+
+                    out.append(f"{arrow}({_select_sql(p.expr)})")
+                    continue
                 names = ", ".join(w[0] for w in p.what) if p.what else "?"
                 if len(p.what) == 1:
                     out.append(f"{arrow}{names}")
@@ -1375,6 +1380,18 @@ def _explain_write(n, ctx):
     return out
 
 
+def threading_active() -> int:
+    import threading
+
+    return threading.active_count()
+
+
+def _jax_ready() -> bool:
+    import sys
+
+    return "jax" in sys.modules
+
+
 def _collector_detail(n: SelectStmt):
     """Collector explain entry; GROUP queries report their aggregations."""
     if n.group is None:
@@ -2188,6 +2205,31 @@ def _s_info(n: InfoStmt, ctx: Ctx):
         render_user,
     )
 
+    if n.level == "system":
+        import os as _os
+
+        mem_kb = 0
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS"):
+                        mem_kb = int(line.split()[1])
+                        break
+        except OSError:
+            pass
+        import jax as _jax
+
+        return {
+            "available_parallelism": _os.cpu_count() or 1,
+            "cpu_usage": 0.0,
+            "load_average": list(_os.getloadavg()),
+            "memory_allocated": mem_kb * 1024,
+            "memory_usage": mem_kb * 1024,
+            "physical_cores": _os.cpu_count() or 1,
+            "threads": threading_active(),
+            "tpu_devices": len(_jax.devices()) if _jax_ready() else 0,
+            "metrics": dict(ctx.ds.metrics),
+        }
     if n.level == "root":
         out = {"accesses": {}, "namespaces": {}, "nodes": {}, "system": {},
                "users": {}}

@@ -110,10 +110,37 @@ class Datastore:
         self.changefeed_vs = 0  # monotonically increasing versionstamp
         self.graph_engine = None  # (ns,db,node_tb,edge_tb,dir) -> CsrGraph
         self.graph_versions = {}  # (ns,db,tb) -> write counter
+        # observability (reference: kvs::Metrics gauges + kvs/slowlog.rs)
+        import os as _os
+
+        self.metrics = {
+            "transactions": 0, "commits": 0, "cancels": 0,
+            "statements": 0, "statement_errors": 0, "slow_queries": 0,
+        }
+        try:
+            self.slow_log_threshold_ms = float(
+                _os.environ.get("SURREAL_SLOW_QUERY_THRESHOLD_MS", "0") or 0
+            )
+        except ValueError:
+            self.slow_log_threshold_ms = 0.0
+        self.slow_log: list = []  # (ms, sql-ish label) ring
+
 
     # -- transactions -------------------------------------------------------
     def transaction(self, write: bool = True) -> Transaction:
+        self.metrics["transactions"] += 1
         return Transaction(self.backend.transaction(write), write)
+
+    def record_statement(self, ok: bool, time_ns: int, label: str = ""):
+        self.metrics["statements"] += 1
+        if not ok:
+            self.metrics["statement_errors"] += 1
+        ms = time_ns / 1e6
+        if self.slow_log_threshold_ms and ms >= self.slow_log_threshold_ms:
+            self.metrics["slow_queries"] += 1
+            self.slow_log.append((round(ms, 3), label[:200]))
+            if len(self.slow_log) > 1000:
+                del self.slow_log[:500]
 
     # -- execution ----------------------------------------------------------
     def execute(

@@ -649,7 +649,9 @@ class Parser:
     def _stmt_info(self):
         self.next()
         self.expect_kw("for")
-        if self.eat_kw("root", "kv"):
+        if self.eat_kw("system", "sys"):
+            s = InfoStmt("system")
+        elif self.eat_kw("root", "kv"):
             s = InfoStmt("root")
         elif self.eat_kw("ns", "namespace"):
             s = InfoStmt("ns")
@@ -2076,10 +2078,18 @@ class Parser:
             target = None
             while self.eat_op(",") or self.eat_op("+"):
                 nm = self.ident().lower()
+                if nm not in ("collect", "path", "shortest", "inclusive"):
+                    raise self.err(f"unknown recursion instruction '{nm}'")
                 names.append(nm)
                 if self.eat_op("="):
+                    if nm != "shortest":
+                        raise self.err(
+                            "only the shortest instruction takes a target"
+                        )
                     # restricted: `a:5+inclusive` must not parse as addition
                     target = self._parse_unary()
+                elif nm == "shortest":
+                    raise self.err("shortest requires a =target")
             if names:
                 instruction = {"names": names, "target": target}
             self.expect_op("}")
