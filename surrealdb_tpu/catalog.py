@@ -134,6 +134,40 @@ class SequenceDef:
 
 
 @dataclass
+class ApiActionDef:
+    methods: list = field(default_factory=list)
+    middleware: list = field(default_factory=list)  # [(name, [arg exprs])]
+    permissions: Any = True
+    then: Any = None
+
+
+@dataclass
+class ApiDef:
+    path: str
+    actions: list = field(default_factory=list)  # ApiActionDef
+    fallback: Any = None
+    comment: Any = None
+
+
+@dataclass
+class ConfigDef:
+    what: str  # API | GRAPHQL
+    middleware: list = field(default_factory=list)
+    permissions: Any = True
+    tables: Any = "AUTO"
+    functions: Any = "NONE"
+
+
+@dataclass
+class BucketDef:
+    name: str
+    backend: Any = None
+    readonly: bool = False
+    permissions: Any = True
+    comment: Any = None
+
+
+@dataclass
 class SubscriptionDef:
     """A LIVE query subscription (catalog/subscription.rs)."""
 

@@ -609,5 +609,73 @@ def render_access(d) -> str:
     return f"DEFINE ACCESS {escape_ident(d.name)} ON {base} TYPE {d.kind.upper()}"
 
 
+def _middleware_sql(mw) -> str:
+    return ", ".join(
+        f"{name}({', '.join(_expr_sql(a) for a in args)})"
+        for name, args in mw
+    )
+
+
+def _perm_value_sql(p) -> str:
+    if p is True or p is None:
+        return "FULL"
+    if p is False:
+        return "NONE"
+    return f"WHERE {_expr_sql(p)}"
+
+
+def render_api(d) -> str:
+    from surrealdb_tpu.val import escape_string
+
+    out = f"DEFINE API {escape_string(d.path)}"
+    for a in d.actions:
+        out += " FOR " + ", ".join(a.methods)
+        if a.middleware:
+            out += f" MIDDLEWARE {_middleware_sql(a.middleware)}"
+        out += f" PERMISSIONS {_perm_value_sql(a.permissions)}"
+        if a.then is not None:
+            body = a.then
+            from surrealdb_tpu.expr.ast import (
+                BlockExpr as _Blk,
+                Subquery as _Sub,
+            )
+
+            if isinstance(body, _Sub) and isinstance(body.stmt, _Blk):
+                body = body.stmt
+            out += f" THEN {_expr_sql(body)}"
+    if d.comment:
+        out += f" COMMENT {_str_sql(d.comment)}"
+    return out
+
+
+def render_bucket(d) -> str:
+    out = f"DEFINE BUCKET {escape_ident(d.name)}"
+    if d.backend:
+        out += f" BACKEND {_str_sql(d.backend)}"
+    if d.readonly:
+        out += " READONLY"
+    out += f" PERMISSIONS {_perm_value_sql(d.permissions)}"
+    if d.comment:
+        out += f" COMMENT {_str_sql(d.comment)}"
+    return out
+
+
+def render_config(d) -> str:
+    if d.what == "API":
+        out = "API"
+        if d.middleware:
+            out += f" MIDDLEWARE {_middleware_sql(d.middleware)}"
+        out += f" PERMISSIONS {_perm_value_sql(d.permissions)}"
+        return out
+    if d.what == "GRAPHQL":
+        def part(v):
+            if isinstance(v, list):
+                return "INCLUDE " + ", ".join(v)
+            return str(v)
+
+        return f"GRAPHQL TABLES {part(d.tables)} FUNCTIONS {part(d.functions)}"
+    return d.what
+
+
 def render_sequence(d) -> str:
     return f"DEFINE SEQUENCE {escape_ident(d.name)} BATCH {d.batch} START {d.start}"

@@ -1908,6 +1908,69 @@ def _s_define_sequence(n: DefineSequence, ctx):
 
 
 def _s_define_config(n: DefineConfig, ctx):
+    from surrealdb_tpu.catalog import (
+        ApiActionDef,
+        ApiDef,
+        BucketDef,
+        ConfigDef,
+    )
+
+    _ensure_ns_db(ctx)
+    ns, db = ctx.need_ns_db()
+    if n.what == "API_DEF":
+        cfg = n.config
+        if not str(cfg["path"]).startswith("/"):
+            raise SdbError(
+                "The string could not be parsed into a path: Segment should start with /"
+            )
+        key = K.api_def(ns, db, cfg["path"])
+        if _exists_guard(ctx, key, cfg["path"], "api", n.if_not_exists,
+                         n.overwrite):
+            return NONE
+        actions = [
+            ApiActionDef(a["methods"], a["middleware"], a["permissions"],
+                         a["then"])
+            for a in cfg["actions"]
+        ]
+        comment = cfg.get("comment")
+        if comment is not None and not isinstance(comment, str):
+            comment = evaluate(comment, ctx)
+            if comment is NONE:
+                comment = None
+        ctx.txn.set_val(key, ApiDef(cfg["path"], actions, None, comment))
+        return NONE
+    if n.what == "BUCKET":
+        cfg = n.config
+        key = K.bucket_def(ns, db, cfg["name"])
+        if _exists_guard(ctx, key, cfg["name"], "bucket", n.if_not_exists,
+                         n.overwrite):
+            return NONE
+        comment = cfg.get("comment")
+        if comment is not None and not isinstance(comment, str):
+            comment = evaluate(comment, ctx)
+            if comment is NONE:
+                comment = None
+        ctx.txn.set_val(
+            key,
+            BucketDef(cfg["name"], cfg.get("backend"),
+                      cfg.get("readonly", False),
+                      cfg.get("permissions", True), comment),
+        )
+        return NONE
+    key = K.cfg_def(ns, db, n.what)
+    if _exists_guard(ctx, key, n.what, "config", n.if_not_exists, n.overwrite):
+        return NONE
+    cd = ConfigDef(n.what)
+    cfg = n.config
+    if "middleware" in cfg:
+        cd.middleware = cfg["middleware"]
+    if "permissions" in cfg:
+        cd.permissions = cfg["permissions"]
+    if "tables" in cfg:
+        cd.tables = cfg["tables"]
+    if "functions" in cfg:
+        cd.functions = cfg["functions"]
+    ctx.txn.set_val(key, cd)
     return NONE
 
 
@@ -2029,8 +2092,18 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
             return NONE
         ctx.txn.delete(key)
         return NONE
-    if kind in ("config", "api", "bucket", "module"):
-        # no stored definitions yet: IF EXISTS passes, bare form errors
+    if kind in ("config", "api", "bucket"):
+        keyf = {"config": K.cfg_def, "api": K.api_def,
+                "bucket": K.bucket_def}[kind]
+        nm = n.name.upper() if kind == "config" else n.name
+        key = keyf(ns, db, nm)
+        if ctx.txn.get(key) is None:
+            if n.if_exists:
+                return NONE
+            raise SdbError(f"The {kind} '{nm}' does not exist")
+        ctx.txn.delete(key)
+        return NONE
+    if kind == "module":
         if n.if_exists:
             return NONE
         raise SdbError(f"The {kind} '{n.name}' does not exist")
@@ -2088,14 +2161,52 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
                 return NONE
             raise SdbError(f"The database '{n.name}' does not exist")
         return NONE  # COMPACT is a maintenance hint; mem engine is compacted
-    if kind in ("system", "config", "api", "bucket", "model", "module"):
-        # settings / side-car definitions: accept silently when the target
-        # concept has no stored definition yet
-        if kind in ("api", "bucket") and not n.if_exists:
-            # we don't store these defs yet; nonexistent targets error
-            raise SdbError(
-                f"The {kind} '{n.name}' does not exist"
-            )
+    if kind in ("system", "config", "model", "module"):
+        return NONE
+    if kind in ("api", "bucket"):
+        keyf = K.api_def if kind == "api" else K.bucket_def
+        key = keyf(ns, db, n.name)
+        d = ctx.txn.get_val(key)
+        if d is None:
+            if n.if_exists:
+                return NONE
+            raise SdbError(f"The {kind} '{n.name}' does not exist")
+        for clause, value in n.changes:
+            if value == "__drop__":
+                if clause == "comment":
+                    d.comment = None
+                elif clause == "readonly":
+                    d.readonly = False
+                continue
+            if clause == "comment":
+                v = value
+                if not isinstance(v, (str, type(None))):
+                    v = evaluate(v, ctx)
+                    if v is NONE:
+                        v = None
+                d.comment = v
+            elif clause == "api_then":
+                methods, body = value
+                for a in d.actions:
+                    if set(a.methods) == set(methods):
+                        a.then = body
+                        break
+                else:
+                    from surrealdb_tpu.catalog import ApiActionDef
+
+                    d.actions.append(
+                        ApiActionDef(methods, [], True, body)
+                    )
+            elif clause == "api_drop_then":
+                methods = value
+                for a in list(d.actions):
+                    if set(a.methods) == set(methods):
+                        a.then = None
+                        if not a.middleware:
+                            d.actions.remove(a)
+            elif hasattr(d, clause):
+                setattr(d, clause, value)
+        ctx.txn.set_val(key, d)
         return NONE
     keymap = {
         "field": lambda: K.fd_def(ns, db, n.tb, n.name if isinstance(n.name, str) else _field_name_str(n.name)),
@@ -2278,6 +2389,20 @@ def _s_info(n: InfoStmt, ctx: Ctx):
         ):
             sd = st[0]
             out["sequences"][sd.name] = render_sequence(sd)
+        from surrealdb_tpu.exec.render_def import (
+            render_api,
+            render_bucket,
+            render_config,
+        )
+
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.api_prefix(ns, db))):
+            out["apis"][d.path] = render_api(d)
+        for _k, d in ctx.txn.scan_vals(
+            *K.prefix_range(K.bucket_prefix(ns, db))
+        ):
+            out["buckets"][d.name] = render_bucket(d)
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.cfg_prefix(ns, db))):
+            out["configs"][d.what] = render_config(d)
         return out
     if n.level == "table":
         from surrealdb_tpu.exec.render_def import (

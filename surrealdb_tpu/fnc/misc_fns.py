@@ -531,7 +531,49 @@ for _m in ("head", "get", "put", "post", "patch", "delete"):
 
 @register("api::invoke")
 def _api_invoke(args, ctx):
-    raise SdbError("DEFINE API invocation requires the server surface")
+    """Invoke a DEFINE API endpoint: matches the path, runs the method's
+    THEN handler with $request bound (reference core/src/api/)."""
+    from surrealdb_tpu import key as K2
+    from surrealdb_tpu.catalog import ApiDef
+    from surrealdb_tpu.exec.eval import evaluate
+    from surrealdb_tpu.err import ReturnException
+
+    path = _str(args[0], "api::invoke", 1)
+    opts = args[1] if len(args) > 1 and isinstance(args[1], dict) else {}
+    ns, db = ctx.need_ns_db()
+    d = ctx.txn.get_val(K2.api_def(ns, db, path))
+    if not isinstance(d, ApiDef):
+        raise SdbError(f"The api '{path}' does not exist")
+    method = str(opts.get("method", "get")).lower()
+    action = None
+    fallback = None
+    for a in d.actions:
+        if method in a.methods:
+            action = a
+            break
+        if "any" in a.methods:
+            fallback = a
+    action = action or fallback
+    if action is None or action.then is None:
+        return {"status": 404, "body": NONE, "headers": {}}
+    c = ctx.child()
+    c.vars["request"] = {
+        "method": method,
+        "path": path,
+        "body": opts.get("body", NONE),
+        "headers": opts.get("headers", {}),
+        "params": opts.get("params", {}),
+        "query": opts.get("query", {}),
+    }
+    try:
+        out = evaluate(action.then, c)
+    except ReturnException as r:
+        out = r.value
+    if isinstance(out, dict):
+        out.setdefault("status", 200)
+        out.setdefault("headers", {})
+        return out
+    return {"status": 200, "body": out, "headers": {}}
 
 
 @register("file::bucket")

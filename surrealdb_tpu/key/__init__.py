@@ -563,6 +563,30 @@ def seq_state(ns, db, name) -> bytes:  # sequence state
     return b"/!sq" + enc_str(ns) + enc_str(db) + enc_str(name)
 
 
+def api_def(ns, db, path) -> bytes:  # DEFINE API
+    return b"/!ap" + enc_str(ns) + enc_str(db) + enc_str(path)
+
+
+def api_prefix(ns, db) -> bytes:
+    return b"/!ap" + enc_str(ns) + enc_str(db)
+
+
+def cfg_def(ns, db, what) -> bytes:  # DEFINE CONFIG
+    return b"/!cg" + enc_str(ns) + enc_str(db) + enc_str(what)
+
+
+def cfg_prefix(ns, db) -> bytes:
+    return b"/!cg" + enc_str(ns) + enc_str(db)
+
+
+def bucket_def(ns, db, name) -> bytes:  # DEFINE BUCKET
+    return b"/!bk" + enc_str(ns) + enc_str(db) + enc_str(name)
+
+
+def bucket_prefix(ns, db) -> bytes:
+    return b"/!bk" + enc_str(ns) + enc_str(db)
+
+
 # --- index auxiliary state (vector / fulltext) -----------------------------
 
 

@@ -762,32 +762,55 @@ class Parser:
         if self.eat_kw("bucket"):
             ine, ow = self._def_flags()
             name = self.ident_or_str()
+            cfg = {"name": name, "backend": None, "readonly": False,
+                   "permissions": True, "comment": None}
             while True:
                 if self.eat_kw("backend"):
-                    self.ident_or_str()
+                    cfg["backend"] = self.ident_or_str()
                 elif self.eat_kw("readonly"):
-                    pass
+                    cfg["readonly"] = True
                 elif self.eat_kw("comment"):
-                    self._comment_value()
+                    cfg["comment"] = self._comment_value()
                 elif self.eat_kw("permissions"):
-                    self._parse_permissions_value()
+                    cfg["permissions"] = self._parse_permissions_value()
                 else:
                     break
-            return DefineConfig("BUCKET", {"name": name}, ine, ow)
+            return DefineConfig("BUCKET", cfg, ine, ow)
         if self.eat_kw("config"):
             ine, ow = self._def_flags()
             what = self.ident().upper()
             cfg = {}
-            # swallow the rest of the config clause permissively
-            depth = 0
-            while self.peek().kind != L.EOF:
-                if self.at_op(";") and depth == 0:
+            while True:
+                if self.eat_kw("middleware"):
+                    cfg["middleware"] = self._parse_middleware()
+                elif self.eat_kw("permissions"):
+                    cfg["permissions"] = self._parse_permissions_value()
+                elif self.eat_kw("auto"):
+                    cfg["tables"] = "AUTO"
+                elif self.eat_kw("none"):
+                    cfg["tables"] = "NONE"
+                elif self.eat_kw("tables"):
+                    if self.eat_kw("auto"):
+                        cfg["tables"] = "AUTO"
+                    elif self.eat_kw("none"):
+                        cfg["tables"] = "NONE"
+                    elif self.eat_kw("include"):
+                        inc = [self.ident()]
+                        while self.eat_op(","):
+                            inc.append(self.ident())
+                        cfg["tables"] = inc
+                elif self.eat_kw("functions"):
+                    if self.eat_kw("auto"):
+                        cfg["functions"] = "AUTO"
+                    elif self.eat_kw("none"):
+                        cfg["functions"] = "NONE"
+                    elif self.eat_kw("include"):
+                        inc = [self.ident()]
+                        while self.eat_op(","):
+                            inc.append(self.ident())
+                        cfg["functions"] = inc
+                else:
                     break
-                t = self.next()
-                if t.kind == L.OP and t.text in "([{":
-                    depth += 1
-                if t.kind == L.OP and t.text in ")]}":
-                    depth -= 1
             return DefineConfig(what, cfg, ine, ow)
         raise self.err("unknown DEFINE target")
 
@@ -895,48 +918,70 @@ class Parser:
                 ref["then"] = self.parse_expr()
         return ref
 
+    def _parse_middleware(self):
+        """MIDDLEWARE name::path(args) [, ...] -> [(name, [arg exprs])]"""
+        out = []
+        while True:
+            parts = [self.ident()]
+            while self.eat_op("::"):
+                parts.append(self.ident())
+            args = []
+            if self.at_op("("):
+                self.next()
+                while not self.at_op(")"):
+                    args.append(self.parse_expr())
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+            out.append(("::".join(parts), args))
+            if not self.eat_op(","):
+                break
+        return out
+
     def _parse_define_api(self):
         ine, ow = self._def_flags()
         path = self.ident_or_str()
         actions = []
+        comment = None
         while True:
             if self.eat_kw("for"):
                 methods = [self.ident().lower()]
                 while self.eat_op(","):
                     methods.append(self.ident().lower())
-                body = None
-                if self.eat_kw("then"):
-                    body = self.parse_expr()
-                actions.append({"methods": methods, "then": body})
-            elif self.eat_kw("then"):
-                actions.append({"methods": ["any"], "then": self.parse_expr()})
-            elif self.eat_kw("middleware"):
-                # swallow middleware spec: name(args) [, name(args)]*
+                action = {"methods": methods, "middleware": [],
+                          "permissions": True, "then": None}
                 while True:
-                    self.ident()
-                    while self.eat_op("::"):
-                        self.ident()
-                    if self.at_op("("):
-                        depth = 0
-                        while True:
-                            t = self.next()
-                            if t.kind == L.EOF:
-                                raise self.err("unterminated middleware arguments")
-                            if t.kind == L.OP and t.text == "(":
-                                depth += 1
-                            elif t.kind == L.OP and t.text == ")":
-                                depth -= 1
-                                if depth == 0:
-                                    break
-                    if not self.eat_op(","):
+                    if self.eat_kw("middleware"):
+                        action["middleware"] = self._parse_middleware()
+                    elif self.eat_kw("permissions"):
+                        action["permissions"] = self._parse_permissions_value()
+                    elif self.eat_kw("then"):
+                        action["then"] = self.parse_expr()
+                    else:
                         break
+                actions.append(action)
+            elif self.eat_kw("then"):
+                actions.append({"methods": ["any"], "middleware": [],
+                                "permissions": True,
+                                "then": self.parse_expr()})
+            elif self.eat_kw("middleware"):
+                actions.append({"methods": ["any"],
+                                "middleware": self._parse_middleware(),
+                                "permissions": True, "then": None})
             elif self.eat_kw("permissions"):
-                self._parse_permissions_value()
+                if actions:
+                    actions[-1]["permissions"] = self._parse_permissions_value()
+                else:
+                    self._parse_permissions_value()
             elif self.eat_kw("comment"):
-                self._comment_value()
+                comment = self._comment_value()
             else:
                 break
-        return DefineConfig("API", {"path": path, "actions": actions}, ine, ow)
+        return DefineConfig(
+            "API_DEF",
+            {"path": path, "actions": actions, "comment": comment},
+            ine, ow,
+        )
 
     def _field_name_parts(self):
         """Field name as idiom parts: a.b.c, a[*], a.*"""
