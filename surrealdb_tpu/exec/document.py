@@ -845,7 +845,12 @@ def update_views(rid, ctx: Ctx):
             ):
                 froms.append(w.parts[0].name)
         if rid.tb in froms:
-            rebuild_view(tdef, ctx)
+            # a broken view definition must not fail writes to its source
+            # table (reference recomputes views async in doc/table.rs)
+            try:
+                rebuild_view(tdef, ctx)
+            except SdbError:
+                pass
 
 
 def rebuild_view(tdef: TableDef, ctx: Ctx):
@@ -861,7 +866,7 @@ def rebuild_view(tdef: TableDef, ctx: Ctx):
     for i, row in enumerate(rows):
         if not isinstance(row, dict):
             continue
-        if group:
+        if group is not None and len(group) > 0:
             from surrealdb_tpu.exec.statements import expr_name
 
             gvals = []
@@ -869,6 +874,8 @@ def rebuild_view(tdef: TableDef, ctx: Ctx):
                 name = expr_name(g)
                 gvals.append(row.get(name, NONE))
             rid = RecordId(tdef.name, gvals if len(gvals) != 1 else [gvals[0]])
+        elif group is not None:
+            rid = RecordId(tdef.name, [])  # GROUP ALL key
         elif isinstance(row.get("id"), RecordId):
             rid = RecordId(tdef.name, row["id"].id)
         else:
@@ -943,7 +950,7 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
         )
     if tdef.kind == "normal" and edge is not None:
         raise SdbError(
-            f"Unable to write edge data to table `{rid.tb}` as it is not a relation table"
+            f"Found record: `{rid.render()}` which is not a relation, but expected a RELATION"
         )
     # permissions
     if not ctx.session.is_owner and ctx.session.auth_level not in ("editor",):
@@ -954,10 +961,7 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
             raise SdbError(
                 f"Not enough permissions to perform this action on table '{rid.tb}'"
             )
-    # field schema
-    after = apply_fields(rid.tb, tdef, before, after, ctx, rid, is_create)
-    after["id"] = rid
-    # edges stage (RELATE): enforce + write `~` keys + in/out fields
+    # edges populate in/out BEFORE field schema so typed in/out coerce
     if edge is not None:
         l, r = edge
         if tdef.enforced:
@@ -967,6 +971,11 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
                 raise SdbError(f"The record '{r.render()}' does not exist")
         after["in"] = l
         after["out"] = r
+    # field schema
+    after = apply_fields(rid.tb, tdef, before, after, ctx, rid, is_create)
+    after["id"] = rid
+    if edge is not None:
+        l, r = edge
         # the four graph keys (reference doc/edges.rs:14)
         ctx.txn.set(K.graph(ns, db, l.tb, l.id, K.DIR_OUT, rid.tb, rid.id), b"")
         ctx.txn.set(K.graph(ns, db, rid.tb, rid.id, K.DIR_IN, l.tb, l.id), b"")

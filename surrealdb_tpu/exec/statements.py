@@ -353,7 +353,7 @@ def expr_name(expr) -> str:
     if isinstance(expr, Literal):
         return render(expr.value)
     if isinstance(expr, Param):
-        return f"${expr.name}"
+        return expr.name
     if isinstance(expr, Binary):
         return f"{expr_name(expr.lhs)} {expr.op} {expr_name(expr.rhs)}"
     if isinstance(expr, Cast):
@@ -527,6 +527,10 @@ def _omit_parts(doc, parts):
                 ]
                 _omit_parts(doc, [PField(name)] + subparts)
     elif isinstance(part, PAll):
+        if len(parts) == 1:
+            if isinstance(doc, (dict, list)):
+                doc.clear()
+            return
         if isinstance(doc, dict):
             for v in doc.values():
                 _omit_parts(v, parts[1:])
@@ -558,7 +562,7 @@ def _project(src: Source, n: SelectStmt, ctx: Ctx):
         if alias:
             _set_out_field(out, alias, v)
         else:
-            segs = _idiom_segments(expr)
+            segs = _idiom_segments(expr, c)
             if segs is not None:
                 _set_nested_out(out, segs, v)
             else:
@@ -568,7 +572,7 @@ def _project(src: Source, n: SelectStmt, ctx: Ctx):
     return out
 
 
-def _idiom_segments(expr):
+def _idiom_segments(expr, ctx=None):
     """Nesting segments for an unaliased idiom projection (reference
     Value::set pluck semantics): field and graph parts nest; any other
     trailing part attaches at the last segment. None = not an idiom."""
@@ -585,8 +589,9 @@ def _idiom_segments(expr):
                 segs.append(f"{arrow}{names}")
             else:
                 segs.append(f"{arrow}({names})")
-        else:
-            break
+        # every other part kind (index, where, value, all, ...) is dropped
+        # from the output name, later field parts still nest (reference
+        # Idiom::simplify, expr/idiom/mod.rs:75 keeps Field/Start/Lookup)
     if not segs:
         return None
     return segs
@@ -641,6 +646,10 @@ def _apply_split(rows, sp, ctx):
         doc = src.doc if src.rid is not None else src.value
         c = ctx.with_doc(doc, src.rid)
         v = evaluate(sp, c)
+        from surrealdb_tpu.val import SSet as _SSet
+
+        if isinstance(v, _SSet):
+            v = list(v.items)
         if isinstance(v, list):
             for item in v:
                 nd = copy_value(doc) if isinstance(doc, dict) else {}
@@ -1344,10 +1353,13 @@ def _explain_select(n: SelectStmt, ctx):
             if n.limit is not None:
                 detail["CancelOnLimit"] = int(evaluate(n.limit, ctx))
             if n.start is not None:
-                detail["SkipStart"] = int(evaluate(n.start, ctx))
-            out.append(
-                {"detail": detail, "operation": "StartLimitStrategy"}
-            )
+                sv = int(evaluate(n.start, ctx))
+                if sv:
+                    detail["SkipStart"] = sv
+            if detail:
+                out.append(
+                    {"detail": detail, "operation": "StartLimitStrategy"}
+                )
         count = 0
         for expr in n.what:
             v = _target_value(expr, ctx)
@@ -1393,35 +1405,49 @@ def _jax_ready() -> bool:
 
 
 def _collector_detail(n: SelectStmt):
-    """Collector explain entry; GROUP queries report their aggregations."""
+    """Collector explain entry; GROUP queries report their aggregation
+    slots (reference Group collector: _aN aggregations over exprN argument
+    slots, _gN group expressions)."""
     if n.group is None:
         ctype = "MemoryOrdered" if n.order else "Memory"
         return {"detail": {"type": ctype}, "operation": "Collector"}
+    _AGG_NAMES = {
+        "count": "Count", "math::sum": "Sum", "math::mean": "Mean",
+        "math::min": "Min", "math::max": "Max", "time::min": "DatetimeMin",
+        "time::max": "DatetimeMax", "math::stddev": "StdDev",
+        "math::variance": "Variance",
+    }
     aggs = {}
     sel = {}
     group_exprs = {}
     agg_exprs = {}
-    i = 0
-    _AGG_NAMES = {
-        "count": "Count", "math::sum": "Sum", "math::mean": "Mean",
-        "math::min": "Min", "math::max": "Max", "time::min": "Min",
-        "time::max": "Max", "math::stddev": "StdDev",
-        "math::variance": "Variance",
-    }
+    expr_slots: dict = {}  # arg text -> exprN
+    ai = 0
+    gi = 0
     for expr, alias in n.exprs:
         if expr == "*":
             continue
         name = alias or expr_name(expr)
         if isinstance(expr, FunctionCall) and expr.name.lower() in _AGG_NAMES:
-            key = f"_a{i}"
-            i += 1
-            aggs[key] = _AGG_NAMES[expr.name.lower()]
+            key = f"_a{ai}"
+            ai += 1
+            base = _AGG_NAMES[expr.name.lower()]
             if expr.args:
-                agg_exprs[key] = expr_name(expr.args[0])
+                argtext = expr_name(expr.args[0])
+                slot = expr_slots.get(argtext)
+                if slot is None:
+                    slot = f"expr{len(expr_slots)}"
+                    expr_slots[argtext] = slot
+                    agg_exprs[slot] = argtext
+                aggs[key] = f"{base}({slot})"
+            else:
+                aggs[key] = base
             sel[name] = key
         else:
-            group_exprs[name] = expr_name(expr)
-            sel[name] = name
+            gkey = f"_g{gi}"
+            gi += 1
+            group_exprs[gkey] = expr_name(expr)
+            sel[name] = gkey
     return {
         "detail": {
             "Aggregate expressions": agg_exprs,
@@ -1713,11 +1739,17 @@ def _s_define_table(n: DefineTable, ctx):
 
         d = evaluate(n.changefeed, ctx)
         cf = d.ns if isinstance(d, Duration) else int(d)
+    # TYPE defaults: SCHEMAFULL implies NORMAL, otherwise ANY
+    # (reference DefineTableStatement); explicit TYPE always wins
+    if n.kind is None:
+        kind = "normal" if n.full else "any"
+    else:
+        kind = n.kind
     tdef = TableDef(
         name=n.name,
         drop=n.drop,
         full=n.full,
-        kind=n.kind if n.kind != "normal" or n.view is None else "normal",
+        kind=kind,
         relation_from=n.relation_from,
         relation_to=n.relation_to,
         enforced=n.enforced,
@@ -1727,6 +1759,21 @@ def _s_define_table(n: DefineTable, ctx):
         comment=n.comment,
     )
     ctx.txn.set_val(K.tb_def(ns, db, n.name), tdef)
+    if kind == "relation":
+        # relation tables implicitly define typed in/out fields
+        from surrealdb_tpu.catalog import FieldDef
+        from surrealdb_tpu.expr.ast import Kind as _Kind
+
+        for fname, tbs in (("in", n.relation_from), ("out", n.relation_to)):
+            fk = K.fd_def(ns, db, n.name, fname)
+            if ctx.txn.get(fk) is None or n.overwrite:
+                kk = _Kind("record", list(tbs) if tbs else [])
+                ctx.txn.set_val(
+                    fk,
+                    FieldDef(
+                        name=[PField(fname)], name_str=fname, kind=kk
+                    ),
+                )
     if n.view is not None:
         _materialize_view(tdef, ctx)
     return NONE
@@ -1734,10 +1781,14 @@ def _s_define_table(n: DefineTable, ctx):
 
 def _materialize_view(tdef: TableDef, ctx):
     """Populate a `DEFINE TABLE ... AS SELECT` view immediately (the
-    reference recomputes incrementally in doc/table.rs; we rebuild)."""
+    reference recomputes incrementally in doc/table.rs; we rebuild).
+    Build errors don't fail the DEFINE (reference builds async)."""
     from surrealdb_tpu.exec.document import rebuild_view
 
-    rebuild_view(tdef, ctx)
+    try:
+        rebuild_view(tdef, ctx)
+    except SdbError:
+        pass
 
 
 def _s_define_field(n: DefineField, ctx):

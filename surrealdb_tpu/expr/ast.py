@@ -497,7 +497,7 @@ class DefineTable(Node):
     permissions: Optional[dict] = None
     changefeed: Optional[Node] = None
     comment: Optional[str] = None
-    kind: str = "normal"  # normal | relation | any
+    kind: Optional[str] = None  # None=infer | normal | relation | any
     relation_from: list = field(default_factory=list)
     relation_to: list = field(default_factory=list)
     enforced: bool = False

@@ -10,12 +10,12 @@ from surrealdb_tpu.fnc import _arr, _num, register
 from surrealdb_tpu.val import NONE, sort_key
 
 
-def _nums(a, fname):
+def _nums(a, fname, keep=False):
     out = []
     for x in _arr(a, fname):
         if isinstance(x, bool) or not isinstance(x, (int, float, Decimal)):
             continue
-        out.append(float(x))
+        out.append(x if keep else float(x))
     return out
 
 

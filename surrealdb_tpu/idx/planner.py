@@ -506,6 +506,17 @@ def explain_plan(tb, cond, ctx, stmt):
         eqs, ins, rngs = _classify_preds(cond, _array_like_paths(tb, ctx))
         best = None
         chosen = _choose_index(indexes, eqs, ins, rngs)
+        count_only = False
+        if stmt is not None and getattr(stmt, "group", None) == [] and \
+                getattr(stmt, "exprs", None):
+            from surrealdb_tpu.expr.ast import FunctionCall as _FC2
+
+            count_only = (
+                len(stmt.exprs) == 1
+                and isinstance(stmt.exprs[0][0], _FC2)
+                and stmt.exprs[0][0].name.lower() == "count"
+                and not stmt.exprs[0][0].args
+            )
         if chosen is not None:
             idef, nmatch, tail = chosen
             vals = [evaluate(eqs[c], ctx) for c in idef.cols_str[:nmatch]]
@@ -521,6 +532,27 @@ def explain_plan(tb, cond, ctx, stmt):
             value = vals[0] if len(vals) == 1 else vals
             if op == "union" and len(vals) == 1:
                 value = vals[0]
+            if count_only and tail is not None and tail[0] == "range":
+                frm = {"inclusive": True, "value": NONE}
+                to = {"inclusive": False, "value": NONE}
+                for rop, rexpr in tail[1]:
+                    rv = evaluate(rexpr, ctx)
+                    if rop in (">", ">="):
+                        frm = {"inclusive": rop == ">=", "value": rv}
+                    else:
+                        to = {"inclusive": rop == "<=", "value": rv}
+                return {
+                    "detail": {
+                        "plan": {
+                            "direction": "forward",
+                            "from": frm,
+                            "index": idef.name,
+                            "to": to,
+                        },
+                        "table": tb,
+                    },
+                    "operation": "Iterate Index Count",
+                }
             return {
                 "detail": {
                     "plan": {
@@ -530,7 +562,8 @@ def explain_plan(tb, cond, ctx, stmt):
                     },
                     "table": tb,
                 },
-                "operation": "Iterate Index",
+                "operation": "Iterate Index Count" if count_only
+                else "Iterate Index",
             }
     return {
         "detail": {"direction": "forward", "table": tb},

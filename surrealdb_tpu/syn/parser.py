@@ -349,6 +349,8 @@ class Parser:
                     s.explain = True
             else:
                 break
+        if s.split and s.group is not None:
+            raise self.err("SPLIT cannot be combined with GROUP BY")
         return s
 
     def _select_fields(self):
