@@ -131,6 +131,7 @@ class SequenceDef:
     name: str
     batch: int = 1000
     start: int = 0
+    timeout: Any = None  # Duration
 
 
 @dataclass

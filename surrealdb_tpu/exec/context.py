@@ -16,7 +16,8 @@ from surrealdb_tpu.err import SdbError
 class Ctx:
     __slots__ = (
         "ds", "session", "txn", "vars", "doc", "doc_id", "parent_doc",
-        "executor", "ns", "db", "knn", "record_cache", "deadline", "depth",
+        "executor", "ns", "db", "knn", "record_cache", "deadline",
+        "timeout_dur", "depth",
         "perms_enabled", "version", "_cond_consumed", "_cf_seq",
     )
 
@@ -34,6 +35,7 @@ class Ctx:
         self.knn: Optional[dict] = None  # record-key -> distance (KnnContext)
         self.record_cache: dict = {}
         self.deadline: Optional[float] = None
+        self.timeout_dur = None
         self.depth = 0
         self.perms_enabled = False  # row-level permissions active
         self.version = None  # VERSION clause timestamp
@@ -55,6 +57,7 @@ class Ctx:
         c.knn = self.knn
         c.record_cache = self.record_cache
         c.deadline = self.deadline
+        c.timeout_dur = self.timeout_dur
         c.depth = self.depth + 1
         c.perms_enabled = self.perms_enabled
         c.version = self.version
@@ -75,7 +78,14 @@ class Ctx:
 
     def check_deadline(self):
         if self.deadline is not None and time.monotonic() > self.deadline:
-            raise SdbError("The query was not executed because it exceeded the timeout")
+            suffix = (
+                f": {self.timeout_dur.render()}"
+                if self.timeout_dur is not None else ""
+            )
+            raise SdbError(
+                "The query was not executed because it exceeded the "
+                f"timeout{suffix}"
+            )
 
     def need_ns_db(self):
         if not self.ns or not self.db:

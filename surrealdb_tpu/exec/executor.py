@@ -37,6 +37,7 @@ class Executor:
     def execute(self, stmts: list, vars: dict) -> list[QueryResult]:
         results: list[QueryResult] = []
         txn = None  # explicit transaction, if open
+        ensured_nsdb = False
         failed = False  # explicit txn poisoned
         buffered: list[int] = []  # result idxs inside current explicit txn
         shared_vars = dict(self.session.variables)
@@ -109,6 +110,12 @@ class Executor:
             cur = txn or self.ds.transaction(write=True)
             ctx = Ctx(self.ds, self.session, cur, executor=self)
             ctx.vars.update(shared_vars)
+            if self.session.ns and self.session.db and not ensured_nsdb:
+                # non-strict mode lazily registers the session ns/db in the
+                # catalog (reference kvs get_or_add_ns/db); once per run
+                from surrealdb_tpu.exec.statements import _ensure_ns_db
+
+                _ensure_ns_db(ctx)
             try:
                 cur.new_save_point()
                 out = eval_statement(stmt, ctx)
@@ -121,6 +128,7 @@ class Executor:
                     pass  # session mutated in place
                 if own_txn:
                     cur.commit()
+                ensured_nsdb = True
                 dt = time.perf_counter_ns() - t0
                 self.ds.record_statement(True, dt, type(stmt).__name__)
                 results.append(QueryResult(result=out, time_ns=dt))

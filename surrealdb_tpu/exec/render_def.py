@@ -537,6 +537,8 @@ def render_param(d) -> str:
     from surrealdb_tpu.val import render as vr
 
     out = f"DEFINE PARAM ${d.name} VALUE {vr(d.value)}"
+    if d.comment is not None:
+        out += f" COMMENT {_str_sql(d.comment)}"
     p = d.permissions
     if p is True or p is None:
         out += " PERMISSIONS FULL"
@@ -555,6 +557,8 @@ def render_function(d) -> str:
     if d.returns is not None:
         out += f" -> {kind_name(d.returns)}"
     out += f" {_expr_sql(d.block)}"
+    if d.comment is not None:
+        out += f" COMMENT {_str_sql(d.comment)}"
     p = d.permissions
     if p is True or p is None:
         out += " PERMISSIONS FULL"
@@ -599,14 +603,69 @@ def render_user(d) -> str:
     tok_s = tok.render() if isinstance(tok, Duration) else (tok or "NONE")
     ses_s = ses.render() if isinstance(ses, Duration) else (ses or "NONE")
     out += f" DURATION FOR TOKEN {tok_s}, FOR SESSION {ses_s}"
+    if d.comment:
+        out += f" COMMENT {_str_sql(d.comment)}"
     return out
 
 
 def render_access(d) -> str:
+    from surrealdb_tpu.val import Duration
+
     base = {"root": "ROOT", "ns": "NAMESPACE", "db": "DATABASE"}.get(
         d.base, d.base.upper()
     )
-    return f"DEFINE ACCESS {escape_ident(d.name)} ON {base} TYPE {d.kind.upper()}"
+    cfg = d.config or {}
+    out = f"DEFINE ACCESS {escape_ident(d.name)} ON {base} TYPE {d.kind.upper()}"
+    if d.kind == "record":
+        if cfg.get("signup") is not None:
+            out += f" SIGNUP {_expr_sql(cfg['signup'])}"
+        if cfg.get("signin") is not None:
+            out += f" SIGNIN {_expr_sql(cfg['signin'])}"
+        if cfg.get("alg") or cfg.get("key") or cfg.get("url"):
+            out += " WITH JWT" + _jwt_sql(cfg)
+    elif d.kind == "jwt":
+        out += _jwt_sql(cfg)
+    elif d.kind == "bearer" and cfg.get("for"):
+        out += f" FOR {cfg['for'].upper()}"
+    if cfg.get("authenticate") is not None:
+        out += f" AUTHENTICATE {_expr_sql(cfg['authenticate'])}"
+    # durations always printed (reference: exports stay forward compatible)
+    def _dur(v, dflt):
+        if v is None and dflt is not None:
+            v = dflt
+        if v is None:
+            return "NONE"
+        return v.render() if isinstance(v, Duration) else str(v)
+
+    dur = d.duration or {}
+    out += " DURATION"
+    if d.kind == "bearer":
+        out += f" FOR GRANT {_dur(dur.get('grant'), Duration.parse('30d'))},"
+    if d.kind in ("jwt", "record", "bearer"):
+        out += f" FOR TOKEN {_dur(dur.get('token'), Duration.parse('1h'))},"
+    out += f" FOR SESSION {_dur(dur.get('session'), None)}"
+    if d.comment:
+        out += f" COMMENT {_str_sql(d.comment)}"
+    return out
+
+
+def _jwt_sql(cfg) -> str:
+    """ALGORITHM/KEY clauses; symmetric verify keys and all issuer keys
+    render redacted (reference catalog/schema/access.rs redacted())."""
+    out = ""
+    if cfg.get("url"):
+        out += f" URL {_str_sql(cfg['url'])}"
+        return out
+    alg = (cfg.get("alg") or "HS512").upper()
+    sym = alg.startswith("HS")
+    key = "[REDACTED]" if sym else cfg.get("key", "")
+    out += f" ALGORITHM {alg} KEY {_str_sql(key)}"
+    issuer = cfg.get("issuer_key")
+    if issuer is None and sym and cfg.get("key") is not None:
+        issuer = cfg.get("key")
+    if issuer is not None:
+        out += " WITH ISSUER KEY '[REDACTED]'"
+    return out
 
 
 def _middleware_sql(mw) -> str:
@@ -628,7 +687,10 @@ def render_api(d) -> str:
     from surrealdb_tpu.val import escape_string
 
     out = f"DEFINE API {escape_string(d.path)}"
-    for a in d.actions:
+    from surrealdb_tpu.catalog import ApiActionDef
+
+    actions = d.actions or [ApiActionDef(methods=["any"])]
+    for a in actions:
         out += " FOR " + ", ".join(a.methods)
         if a.middleware:
             out += f" MIDDLEWARE {_middleware_sql(a.middleware)}"
@@ -678,4 +740,7 @@ def render_config(d) -> str:
 
 
 def render_sequence(d) -> str:
-    return f"DEFINE SEQUENCE {escape_ident(d.name)} BATCH {d.batch} START {d.start}"
+    out = f"DEFINE SEQUENCE {escape_ident(d.name)} BATCH {d.batch} START {d.start}"
+    if getattr(d, "timeout", None) is not None:
+        out += f" TIMEOUT {d.timeout.render()}"
+    return out

@@ -56,8 +56,71 @@ def eval_statement(node, ctx: Ctx):
     t = type(node)
     fn = _STMTS.get(t)
     if fn is not None:
+        if isinstance(node, (DefineNamespace, DefineDatabase, DefineTable,
+                             DefineField, DefineIndex, DefineEvent,
+                             DefineAnalyzer, DefineUser, DefineAccess,
+                             DefineSequence, DefineConfig, DefineParam,
+                             DefineFunction, RemoveStmt,
+                             InfoStmt, RebuildIndex)):
+            node = _ddl_resolve(node, ctx)
         return fn(node, ctx)
     return evaluate(node, ctx)
+
+
+def _ddl_resolve(n, ctx: Ctx):
+    """Materialize expression-valued DDL attributes — names, ON tables,
+    comments, durations — at execution time. Reference: parameterized
+    schema statements (language-tests/tests/language/parameterized/schema)
+    compute each name/comment Expr in the DefineStatement itself."""
+    import dataclasses
+
+    changes = {}
+    for a in ("name", "tb", "comment", "batch", "start", "target", "target2"):
+        v = getattr(n, a, None)
+        if not isinstance(v, Node):
+            continue
+        rv = evaluate(v, ctx)
+        if a == "comment":
+            changes[a] = None if rv is NONE else rv
+        elif a in ("batch", "start"):
+            if not isinstance(rv, int) or isinstance(rv, bool):
+                raise SdbError(f"Expected an int but found {render(rv)}")
+            changes[a] = rv
+        else:
+            if not isinstance(rv, str):
+                raise SdbError(
+                    f"Expected a string but found {render(rv)}"
+                )
+            changes[a] = rv
+    dur = getattr(n, "duration", None)
+    if isinstance(dur, dict) and any(isinstance(x, Node) for x in dur.values()):
+        changes["duration"] = {
+            k: (evaluate(x, ctx) if isinstance(x, Node) else x)
+            for k, x in dur.items()
+        }
+    cfg = getattr(n, "config", None)
+    if isinstance(cfg, dict):
+        newcfg = {
+            k: (evaluate(x, ctx) if isinstance(x, Node) and k in
+                ("key", "name", "backend", "issuer_key", "path", "comment",
+                 "namespace", "database")
+                else x)
+            for k, x in cfg.items()
+        }
+        if newcfg.get("comment") is NONE:
+            newcfg["comment"] = None
+        if newcfg != cfg:
+            changes["config"] = newcfg
+    if changes:
+        n = dataclasses.replace(n, **changes)
+    # a $param field name is a whole idiom string ("a.b") — parse it
+    if (isinstance(n, DefineField) or
+            (isinstance(n, RemoveStmt) and n.kind == "field")) and \
+            isinstance(n.name, str):
+        from surrealdb_tpu.syn.parser import Parser
+
+        n = dataclasses.replace(n, name=Parser(n.name)._field_name_parts())
+    return n
 
 
 # ---------------------------------------------------------------------------
@@ -369,13 +432,9 @@ def expr_name(expr) -> str:
 
 def _s_select(n: SelectStmt, ctx: Ctx):
     ctx.check_deadline()
-    c = ctx.child()
-    if n.timeout is not None:
-        from surrealdb_tpu.val import Duration
-
-        d = evaluate(n.timeout, ctx)
-        if isinstance(d, Duration):
-            c.deadline = time.monotonic() + d.to_seconds()
+    c = _timeout_ctx(n, ctx)
+    if c is ctx:
+        c = ctx.child()
     if n.explain:
         return _explain_select(n, c)
     # VERSION clause
@@ -425,13 +484,15 @@ def _select_pipeline(n: SelectStmt, rows, c):
     # SPLIT
     for sp in n.split:
         rows = _apply_split(rows, sp, c)
-    # OMIT applies to the records before grouping/projection
+    # OMIT applies to the records before grouping/projection; expand
+    # type::field()/type::fields() calls once, not per row
     if n.omit:
+        omits = _expand_omits(n.omit, c)
         for src in rows:
             doc = src.doc if src.rid is not None else src.value
             if isinstance(doc, dict):
                 doc = copy_value(doc)
-                for om in n.omit:
+                for om in omits:
                     _omit_path(doc, om, c)
                 if src.rid is not None:
                     src.doc = doc
@@ -492,6 +553,27 @@ def _select_pipeline(n: SelectStmt, rows, c):
 
 def _target_of(n, ctx):
     return None
+
+
+def _expand_omits(omit, ctx):
+    """Evaluate type::field()/type::fields() OMIT entries into idioms
+    once per statement (reference: parameterized/select.surql)."""
+    out = []
+    for om in omit:
+        if isinstance(om, FunctionCall) and om.name in (
+                "type::field", "type::fields"):
+            from surrealdb_tpu.syn.parser import Parser
+
+            v = evaluate(om.args[0], ctx) if om.args else NONE
+            names = v if om.name == "type::fields" else [v]
+            if not isinstance(names, list):
+                continue
+            for s in names:
+                if isinstance(s, str):
+                    out.append(Idiom(Parser(s)._field_name_parts()))
+        else:
+            out.append(om)
+    return out
 
 
 def _omit_path(doc, om, ctx=None):
@@ -1470,14 +1552,33 @@ def _only_wrap(results, only):
     raise SdbError("Expected a single result output when using the ONLY keyword")
 
 
+def _timeout_ctx(n, ctx: Ctx) -> Ctx:
+    """Child ctx with a deadline when the statement has TIMEOUT (expression-
+    valued; reference: parameterized/timeout.surql)."""
+    if getattr(n, "timeout", None) is None:
+        return ctx
+    from surrealdb_tpu.val import Duration
+
+    d = evaluate(n.timeout, ctx)
+    if not isinstance(d, Duration):
+        raise SdbError(f"Expected a duration but found {render(d)}")
+    c = ctx.child()
+    c.deadline = time.monotonic() + d.to_seconds()
+    c.timeout_dur = d
+    return c
+
+
 def _s_create(n: CreateStmt, ctx: Ctx):
     from surrealdb_tpu.exec.document import create_one
+    ctx = _timeout_ctx(n, ctx)
+    ctx.check_deadline()
 
     results = []
     for expr in n.what:
         v = _target_value(expr, ctx)
         targets = v if isinstance(v, list) else [v]
         for t in targets:
+            ctx.check_deadline()
             results.append(create_one(t, n.data, n.output, ctx))
     results = [r for r in results if r is not NONE or n.output is not None]
     if n.output is not None and n.output.kind == "none":
@@ -1486,6 +1587,8 @@ def _s_create(n: CreateStmt, ctx: Ctx):
 
 
 def _s_insert(n: InsertStmt, ctx: Ctx):
+    ctx = _timeout_ctx(n, ctx)
+    ctx.check_deadline()
     from surrealdb_tpu.exec.document import insert_one, relate_insert_one
 
     into = None
@@ -1511,6 +1614,7 @@ def _s_insert(n: InsertStmt, ctx: Ctx):
         data = evaluate(n.data, ctx)
         items = data if isinstance(data, list) else [data]
         for item in items:
+            ctx.check_deadline()
             if not isinstance(item, dict):
                 raise SdbError(f"Cannot INSERT {render(item)}")
             if n.relation:
@@ -1539,12 +1643,15 @@ def _resolve_write_source(src, ctx):
 
 
 def _s_update(n: UpdateStmt, ctx: Ctx):
+    ctx = _timeout_ctx(n, ctx)
+    ctx.check_deadline()
     from surrealdb_tpu.exec.document import update_one
 
     if n.explain:
         return _explain_write(n, ctx)
     results = []
     for src in iterate_targets(n.what, ctx, None, None):
+        ctx.check_deadline()
         src = _resolve_write_source(src, ctx)
         if src.rid is None:
             raise SdbError(f"Cannot UPDATE {render(src.value)}")
@@ -1562,6 +1669,8 @@ def _s_update(n: UpdateStmt, ctx: Ctx):
 
 
 def _s_upsert(n: UpsertStmt, ctx: Ctx):
+    ctx = _timeout_ctx(n, ctx)
+    ctx.check_deadline()
     from surrealdb_tpu.exec.document import create_one, update_one
 
     if n.explain:
@@ -1571,6 +1680,7 @@ def _s_upsert(n: UpsertStmt, ctx: Ctx):
         v = _target_value(expr, ctx)
         targets = v if isinstance(v, list) else [v]
         for t in targets:
+            ctx.check_deadline()
             if isinstance(t, RecordId) and not isinstance(t.id, Range):
                 doc = fetch_record(ctx, t)
                 if doc is NONE:
@@ -1622,12 +1732,15 @@ def _s_upsert(n: UpsertStmt, ctx: Ctx):
 
 
 def _s_delete(n: DeleteStmt, ctx: Ctx):
+    ctx = _timeout_ctx(n, ctx)
+    ctx.check_deadline()
     from surrealdb_tpu.exec.document import delete_one
 
     if n.explain:
         return _explain_write(n, ctx)
     results = []
     for src in iterate_targets(n.what, ctx, None, None):
+        ctx.check_deadline()
         src = _resolve_write_source(src, ctx)
         if src.rid is None:
             raise SdbError(f"Cannot DELETE {render(src.value)}")
@@ -1644,6 +1757,8 @@ def _s_delete(n: DeleteStmt, ctx: Ctx):
 
 
 def _s_relate(n: RelateStmt, ctx: Ctx):
+    ctx = _timeout_ctx(n, ctx)
+    ctx.check_deadline()
     from surrealdb_tpu.exec.document import relate_one
 
     kind_v = _target_value(n.kind, ctx)
@@ -1657,6 +1772,7 @@ def _s_relate(n: RelateStmt, ctx: Ctx):
     tos = tos if isinstance(tos, list) else [tos]
     results = []
     for f in froms:
+        ctx.check_deadline()
         for t in tos:
             fr = _as_rid(f)
             to = _as_rid(t)
@@ -1839,11 +1955,31 @@ def _s_define_index(n: DefineIndex, ctx):
         return NONE
     if n.overwrite and ctx.txn.get(kdef) is not None:
         _remove_index_data(ns, db, n.tb, n.name, ctx)
+    cols = []
+    for c in n.cols:
+        # type::field($f) / type::fields($fs) expand to idioms at define
+        # time (reference: parameterized/schema/index.surql)
+        if isinstance(c, FunctionCall) and c.name in (
+                "type::field", "type::fields"):
+            from surrealdb_tpu.syn.parser import Parser
+
+            v = evaluate(c.args[0], ctx) if c.args else NONE
+            names = v if c.name == "type::fields" else [v]
+            if not isinstance(names, list):
+                raise SdbError(
+                    f"Expected an array but found {render(names)}")
+            for s in names:
+                if not isinstance(s, str):
+                    raise SdbError(
+                        f"Expected a string but found {render(s)}")
+                cols.append(Idiom(Parser(s)._field_name_parts()))
+        else:
+            cols.append(c)
     idef = IndexDef(
         name=n.name,
         tb=n.tb,
-        cols=n.cols,
-        cols_str=[expr_name(c) for c in n.cols],
+        cols=cols,
+        cols_str=[expr_name(c) for c in cols],
         unique=n.unique,
         hnsw=n.hnsw,
         fulltext=n.fulltext,
@@ -1870,6 +2006,8 @@ def _remove_index_data(ns, db, tb, ix, ctx):
 def _s_define_event(n: DefineEvent, ctx):
     _ensure_ns_db(ctx)
     ns, db = ctx.need_ns_db()
+    if ctx.txn.get(K.tb_def(ns, db, n.tb)) is None:
+        ctx.txn.set_val(K.tb_def(ns, db, n.tb), TableDef(name=n.tb))
     kdef = K.ev_def(ns, db, n.tb, n.name)
     if _exists_guard(ctx, kdef, n.name, "event", n.if_not_exists, n.overwrite):
         return NONE
@@ -1953,7 +2091,14 @@ def _s_define_sequence(n: DefineSequence, ctx):
             return NONE
         if not n.overwrite:
             raise SdbError(f"The sequence '{n.name}' already exists")
-    sd = SequenceDef(n.name, n.batch, n.start)
+    tmo = None
+    if n.timeout is not None:
+        from surrealdb_tpu.val import Duration
+
+        tmo = evaluate(n.timeout, ctx)
+        if not isinstance(tmo, Duration):
+            raise SdbError(f"Expected a duration but found {render(tmo)}")
+    sd = SequenceDef(n.name, n.batch, n.start, tmo)
     ctx.txn.set_val(kdef, (sd, n.start))
     return NONE
 
@@ -1966,6 +2111,14 @@ def _s_define_config(n: DefineConfig, ctx):
         ConfigDef,
     )
 
+    if n.what == "DEFAULT":
+        # KV-level default session namespace/database (INFO FOR KV .defaults)
+        key = K.cfg_def("", "", "DEFAULT")
+        if _exists_guard(ctx, key, "DEFAULT", "config", n.if_not_exists,
+                         n.overwrite):
+            return NONE
+        ctx.txn.set_val(key, dict(n.config))
+        return NONE
     _ensure_ns_db(ctx)
     ns, db = ctx.need_ns_db()
     if n.what == "API_DEF":
@@ -1978,8 +2131,16 @@ def _s_define_config(n: DefineConfig, ctx):
         if _exists_guard(ctx, key, cfg["path"], "api", n.if_not_exists,
                          n.overwrite):
             return NONE
+        # middleware args are computed at define time (reference:
+        # parameterized/schema/api.surql renders fn::middleware('auth'))
+        def _mw(mw):
+            return [
+                (name, [Literal(evaluate(a, ctx)) for a in args])
+                for name, args in mw
+            ]
+
         actions = [
-            ApiActionDef(a["methods"], a["middleware"], a["permissions"],
+            ApiActionDef(a["methods"], _mw(a["middleware"]), a["permissions"],
                          a["then"])
             for a in cfg["actions"]
         ]
@@ -2182,7 +2343,13 @@ def _s_alter(n: AlterTable, ctx: Ctx):
     if n.permissions is not None:
         tdef.permissions = n.permissions
     if n.comment is not None:
-        tdef.comment = None if n.comment == "__drop__" else n.comment
+        if n.comment == "__drop__":
+            tdef.comment = None
+        else:
+            c = n.comment
+            if isinstance(c, Node):
+                c = evaluate(c, ctx)
+            tdef.comment = None if c is NONE else c
     if n.changefeed is not None:
         if n.changefeed == "__drop__":
             tdef.changefeed = None
@@ -2395,6 +2562,9 @@ def _s_info(n: InfoStmt, ctx: Ctx):
     if n.level == "root":
         out = {"accesses": {}, "namespaces": {}, "nodes": {}, "system": {},
                "users": {}}
+        dflt = ctx.txn.get_val(K.cfg_def("", "", "DEFAULT"))
+        if dflt is not None:
+            out["defaults"] = {k: v for k, v in sorted(dflt.items())}
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ns_prefix())):
             out["namespaces"][d.name] = render_ns(d)
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.us_prefix("root"))):

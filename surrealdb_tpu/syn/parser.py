@@ -101,6 +101,16 @@ class Parser:
             return t.value
         raise self.err("expected identifier or string")
 
+    def name_expr(self):
+        """A DDL name: identifier/string, or a $param resolved when the
+        statement executes (reference: parameterized schema statements,
+        language-tests/tests/language/parameterized/schema/)."""
+        t = self.peek()
+        if t.kind == L.PARAM:
+            self.next()
+            return Param(t.value)
+        return self.ident_or_str()
+
     # -- query / statements --------------------------------------------------
     def parse_query(self) -> list:
         stmts = []
@@ -662,16 +672,16 @@ class Parser:
             if self.eat_kw("version"):
                 s.version = self.parse_expr()
         elif self.eat_kw("table", "tb"):
-            s = InfoStmt("table", self.ident_or_str())
+            s = InfoStmt("table", self.name_expr())
         elif self.eat_kw("user"):
-            s = InfoStmt("user", self.ident_or_str())
+            s = InfoStmt("user", self.name_expr())
             if self.eat_kw("on"):
                 s.target2 = self.ident()
         elif self.eat_kw("index"):
-            name = self.ident_or_str()
+            name = self.name_expr()
             self.expect_kw("on")
             self.eat_kw("table")
-            s = InfoStmt("index", name, self.ident_or_str())
+            s = InfoStmt("index", name, self.name_expr())
         else:
             raise self.err("expected INFO target")
         if self.eat_kw("structure"):
@@ -693,13 +703,13 @@ class Parser:
         self.next()
         if self.eat_kw("namespace", "ns"):
             ine, ow = self._def_flags()
-            d = DefineNamespace(self.ident_or_str(), ine, ow)
+            d = DefineNamespace(self.name_expr(), ine, ow)
             if self.eat_kw("comment"):
-                d.comment = self.ident_or_str()
+                d.comment = self._comment_value()
             return d
         if self.eat_kw("database", "db"):
             ine, ow = self._def_flags()
-            d = DefineDatabase(self.ident_or_str(), ine, ow)
+            d = DefineDatabase(self.name_expr(), ine, ow)
             while True:
                 if self.eat_kw("strict"):
                     pass
@@ -733,7 +743,7 @@ class Parser:
                 if self.eat_kw("permissions"):
                     perms = self._parse_permissions_value()
                 elif self.eat_kw("comment"):
-                    comment = self.ident_or_str()
+                    comment = self._comment_value()
                 else:
                     break
             return DefineParam(t.value, value, ine, ow, perms, comment)
@@ -747,13 +757,17 @@ class Parser:
             return self._define_access()
         if self.eat_kw("sequence"):
             ine, ow = self._def_flags()
-            name = self.ident()
+            name = self.name_expr()
             d = DefineSequence(name, if_not_exists=ine, overwrite=ow)
             while True:
                 if self.eat_kw("batch"):
-                    d.batch = self._signed_int()
+                    d.batch = (Param(self.next().value)
+                               if self.peek().kind == L.PARAM
+                               else self._signed_int())
                 elif self.eat_kw("start"):
-                    d.start = self._signed_int()
+                    d.start = (Param(self.next().value)
+                               if self.peek().kind == L.PARAM
+                               else self._signed_int())
                 elif self.eat_kw("timeout"):
                     d.timeout = self.parse_expr()
                 else:
@@ -763,7 +777,7 @@ class Parser:
             return self._parse_define_api()
         if self.eat_kw("bucket"):
             ine, ow = self._def_flags()
-            name = self.ident_or_str()
+            name = self.name_expr()
             cfg = {"name": name, "backend": None, "readonly": False,
                    "permissions": True, "comment": None}
             while True:
@@ -782,6 +796,15 @@ class Parser:
             ine, ow = self._def_flags()
             what = self.ident().upper()
             cfg = {}
+            if what == "DEFAULT":
+                while True:
+                    if self.eat_kw("namespace", "ns"):
+                        cfg["namespace"] = self.name_expr()
+                    elif self.eat_kw("database", "db"):
+                        cfg["database"] = self.name_expr()
+                    else:
+                        break
+                return DefineConfig("DEFAULT", cfg, ine, ow)
             while True:
                 if self.eat_kw("middleware"):
                     cfg["middleware"] = self._parse_middleware()
@@ -818,7 +841,7 @@ class Parser:
 
     def _define_table(self):
         ine, ow = self._def_flags()
-        d = DefineTable(self.ident_or_str(), ine, ow)
+        d = DefineTable(self.name_expr(), ine, ow)
         while True:
             if self.eat_kw("drop"):
                 d.drop = True
@@ -862,17 +885,20 @@ class Parser:
             elif self.eat_kw("permissions"):
                 d.permissions = self._parse_permissions()
             elif self.eat_kw("comment"):
-                d.comment = self.ident_or_str()
+                d.comment = self._comment_value()
             else:
                 break
         return d
 
     def _define_field(self):
         ine, ow = self._def_flags()
-        name = self._field_name_parts()
+        if self.peek().kind == L.PARAM:
+            name = Param(self.next().value)
+        else:
+            name = self._field_name_parts()
         self.expect_kw("on")
         self.eat_kw("table")
-        tb = self.ident_or_str()
+        tb = self.name_expr()
         d = DefineField(name, tb, ine, ow)
         while True:
             if self.at_kw("flexible", "flexi", "flex"):
@@ -898,7 +924,7 @@ class Parser:
             elif self.eat_kw("reference"):
                 d.reference = self._parse_reference()
             elif self.eat_kw("comment"):
-                d.comment = self.ident_or_str()
+                d.comment = self._comment_value()
             else:
                 break
         return d
@@ -942,7 +968,7 @@ class Parser:
 
     def _parse_define_api(self):
         ine, ow = self._def_flags()
-        path = self.ident_or_str()
+        path = self.name_expr()
         actions = []
         comment = None
         while True:
@@ -1013,10 +1039,10 @@ class Parser:
 
     def _define_index(self):
         ine, ow = self._def_flags()
-        name = self.ident_or_str()
+        name = self.name_expr()
         self.expect_kw("on")
         self.eat_kw("table")
-        tb = self.ident_or_str()
+        tb = self.name_expr()
         d = DefineIndex(name, tb, [], ine, ow)
         if self.eat_kw("fields", "columns"):
             d.cols = self._idiom_list()
@@ -1087,7 +1113,7 @@ class Parser:
             elif self.eat_kw("concurrently"):
                 d.concurrently = True
             elif self.eat_kw("comment"):
-                d.comment = self.ident_or_str()
+                d.comment = self._comment_value()
             else:
                 break
         return d
@@ -1101,10 +1127,10 @@ class Parser:
 
     def _define_event(self):
         ine, ow = self._def_flags()
-        name = self.ident_or_str()
+        name = self.name_expr()
         self.expect_kw("on")
         self.eat_kw("table")
-        tb = self.ident_or_str()
+        tb = self.name_expr()
         when = None
         then = []
         comment = None
@@ -1129,7 +1155,7 @@ class Parser:
                     while self.eat_op(","):
                         then.append(self.parse_expr())
             elif self.eat_kw("comment"):
-                comment = self.ident_or_str()
+                comment = self._comment_value()
             else:
                 break
         return DefineEvent(name, tb, when, then, ine, ow, comment)
@@ -1166,14 +1192,14 @@ class Parser:
             if self.eat_kw("permissions"):
                 perms = self._parse_permissions_value()
             elif self.eat_kw("comment"):
-                comment = self.ident_or_str()
+                comment = self._comment_value()
             else:
                 break
         return DefineFunction(name, args, block, returns, ine, ow, perms, comment)
 
     def _define_analyzer(self):
         ine, ow = self._def_flags()
-        name = self.ident()
+        name = self.name_expr()
         d = DefineAnalyzer(name, if_not_exists=ine, overwrite=ow)
         while True:
             if self.eat_kw("tokenizers"):
@@ -1192,7 +1218,7 @@ class Parser:
                     parts = parts[1:]
                 d.function = "::".join(parts)
             elif self.eat_kw("comment"):
-                d.comment = self.ident_or_str()
+                d.comment = self._comment_value()
             else:
                 break
         return d
@@ -1220,7 +1246,7 @@ class Parser:
 
     def _define_user(self):
         ine, ow = self._def_flags()
-        name = self.ident_or_str()
+        name = self.name_expr()
         self.expect_kw("on")
         if self.eat_kw("root"):
             base = "root"
@@ -1248,21 +1274,20 @@ class Parser:
                         if self.eat_kw("none"):
                             dur[which] = None
                         else:
-                            dur[which] = self.next().value
-                        if not self.eat_op(","):
-                            break
+                            dur[which] = self.parse_expr()
+                        self.eat_op(",")
                     else:
                         break
                 d.duration = dur
             elif self.eat_kw("comment"):
-                d.comment = self.ident_or_str()
+                d.comment = self._comment_value()
             else:
                 break
         return d
 
     def _define_access(self):
         ine, ow = self._def_flags()
-        name = self.ident_or_str()
+        name = self.name_expr()
         self.expect_kw("on")
         if self.eat_kw("root"):
             base = "root"
@@ -1307,16 +1332,15 @@ class Parser:
                         if self.eat_kw("none"):
                             dur[which] = None
                         else:
-                            dur[which] = self.next().value
-                        if not self.eat_op(","):
-                            break
+                            dur[which] = self.parse_expr()
+                        self.eat_op(",")
                     else:
                         break
                 d.duration = dur
             elif self.eat_kw("authenticate"):
                 cfg["authenticate"] = self.parse_expr()
             elif self.eat_kw("comment"):
-                d.comment = self.ident_or_str()
+                d.comment = self._comment_value()
             else:
                 break
         return d
@@ -1327,12 +1351,16 @@ class Parser:
             if self.eat_kw("algorithm"):
                 cfg["alg"] = self.ident().upper()
             elif self.eat_kw("key"):
-                cfg["key"] = self.ident_or_str()
+                cfg["key"] = self.name_expr()
             elif self.eat_kw("url"):
                 cfg["url"] = self.ident_or_str()
             elif self.eat_kw("issuer"):
                 self.expect_kw("key")
-                cfg["issuer_key"] = self.ident_or_str()
+                cfg["issuer_key"] = self.name_expr()
+            elif self.eat_kw("with"):
+                self.expect_kw("issuer")
+                self.expect_kw("key")
+                cfg["issuer_key"] = self.name_expr()
             else:
                 break
         return cfg
@@ -1412,14 +1440,17 @@ class Parser:
             t = self.next()
             name = t.value
         elif kind == "field":
-            name = self._field_name_parts()
+            if self.peek().kind == L.PARAM:
+                name = Param(self.next().value)
+            else:
+                name = self._field_name_parts()
         else:
-            name = self.ident_or_str()
+            name = self.name_expr()
         s = RemoveStmt(kind, name, if_exists=if_exists)
         if kind in ("field", "index", "event") :
             self.expect_kw("on")
             self.eat_kw("table")
-            s.tb = self.ident_or_str()
+            s.tb = self.name_expr()
         if kind in ("user", "access") and self.eat_kw("on"):
             if self.eat_kw("root"):
                 s.base = "root"
@@ -1525,7 +1556,7 @@ class Parser:
             elif self.eat_kw("changefeed"):
                 d.changefeed = self.parse_expr()
             elif self.eat_kw("comment"):
-                d.comment = self.ident_or_str()
+                d.comment = self._comment_value()
             else:
                 break
         return d
@@ -1537,10 +1568,9 @@ class Parser:
 
     def _comment_value(self):
         t = self.peek()
-        if t.kind in (L.IDENT, L.STRING) and not self.at_kw("none"):
-            if t.kind == L.STRING:
-                self.next()
-                return t.value
+        if t.kind == L.STRING:
+            self.next()
+            return t.value
         return self.parse_expr()
 
     def _alter_other(self, kind: str):
