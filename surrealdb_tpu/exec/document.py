@@ -538,6 +538,11 @@ def index_update(rid: RecordId, before, after, ctx: Ctx):
         if idef.unique:
             for row in old_rows:
                 if all(x is NONE or x is None for x in row):
+                    # NONE rows live in the non-unique keyspace (duplicates
+                    # allowed; reference indexes None without the constraint)
+                    ctx.txn.delete(
+                        K.index(ns, db, rid.tb, idef.name, row, rid.id)
+                    )
                     continue
                 k = K.index_unique(ns, db, rid.tb, idef.name, row)
                 existing = ctx.txn.get_val(k)
@@ -545,7 +550,11 @@ def index_update(rid: RecordId, before, after, ctx: Ctx):
                     ctx.txn.delete(k)
             for row in new_rows:
                 if all(x is NONE or x is None for x in row):
-                    continue  # NONE values are not indexed in unique indexes
+                    ctx.txn.set_val(
+                        K.index(ns, db, rid.tb, idef.name, row, rid.id),
+                        rid,
+                    )
+                    continue
                 k = K.index_unique(ns, db, rid.tb, idef.name, row)
                 existing = ctx.txn.get_val(k)
                 if existing is not None and not value_eq(existing, rid):

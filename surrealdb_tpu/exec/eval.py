@@ -578,6 +578,15 @@ def _apply_field(val, name, ctx):
 
 
 def _apply_index(val, idx, ctx):
+    if isinstance(val, RecordId):
+        if isinstance(val.id, list) and isinstance(idx, (int, float)) \
+                and not isinstance(idx, bool):
+            # integer-indexing a record id with an array key drills into
+            # the key (planner/select_compound_index_array id[1] access)
+            val = val.id
+        else:
+            # other index kinds address the linked document
+            val = fetch_record(ctx, val)
     if isinstance(val, list):
         if isinstance(idx, bool):
             return NONE

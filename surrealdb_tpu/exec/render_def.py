@@ -46,9 +46,13 @@ def _expr_sql(node) -> str:
     if isinstance(node, Binary):
         op = {"&&": "AND", "||": "OR", "∈": "INSIDE", "∉": "NOT INSIDE",
               "∋": "CONTAINS", "∌": "CONTAINSNOT", "⊇": "CONTAINSALL",
-              "⊆": "ALLINSIDE"}.get(node.op, node.op)
+              "⊆": "ALLINSIDE", "containsany": "CONTAINSANY",
+              "containsnone": "CONTAINSNONE", "anyinside": "ANYINSIDE",
+              "noneinside": "NONEINSIDE"}.get(node.op, node.op)
         return f"{_expr_sql(node.lhs)} {op} {_expr_sql(node.rhs)}"
     if isinstance(node, Prefix):
+        if node.op == "!":
+            return f"! {_expr_sql(node.expr)}"
         return f"{node.op}{_expr_sql(node.expr)}"
     if isinstance(node, RegexLit):
         return f"/{node.pattern}/"

@@ -1021,6 +1021,24 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
     )
 
     analyze = n.explain == "analyze"
+    orig_n = n
+
+    # ORDER BY id is the natural scan order (reversed for DESC): the
+    # sort is elided and LIMIT/START push into the scan — only when the
+    # plan is a plain table scan (no predicate can pick an index)
+    scan_dir = "Forward"
+    single_target = len(n.what) == 1
+    if (
+        n.order
+        and n.order != "rand"
+        and len(n.order) == 1
+        and expr_name(n.order[0][0]) == "id"
+        and n.cond is None
+        and single_target
+    ):
+        if n.order[0][1] == "desc":
+            scan_dir = "Backward"
+        n = _strip_order(n)
 
     # resolve scan children (one per FROM target)
     scans = []  # (label_fn, scan_rows)
@@ -1041,6 +1059,7 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
             total_scan_rows += rows
             continue
         tb = v.name
+        pushed_limit = pushed_offset = None
         indexes = get_indexes_for(tb, ctx)
         if n.with_index:
             indexes = [i for i in indexes if i.name in n.with_index]
@@ -1058,15 +1077,107 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
             from surrealdb_tpu.idx.planner import _array_like_paths
 
             eqs, ins, rngs = _classify_preds(
-                n.cond, _array_like_paths(tb, ctx)
+                n.cond, _array_like_paths(tb, ctx), value_idioms=False
             )
             chosen = _choose_index(indexes, eqs, ins, rngs) if (
                 eqs or ins or rngs
             ) else None
+            union_branches = None
             if chosen is not None:
                 idef, nmatch, tail = chosen
+                if tail is not None and tail[0] == "in" and nmatch == 0:
+                    iv = evaluate(tail[1], ctx)
+                    iv = iv if isinstance(iv, list) else [iv]
+                    if len(iv) > 32:
+                        # large IN arrays fall back to a table scan
+                        # (reference: in_operator_large_array_fallback)
+                        chosen = None
+                    else:
+                        union_branches = (idef, iv)
+            if union_branches is not None and len(union_branches[1]) == 1:
+                idef, iv = union_branches
+                bv = iv[0]
+                label = (
+                    f"IndexScan [ctx: Db] [index: {idef.name}, "
+                    f"access: = {render(bv)}, direction: Forward]"
+                )
+                rows = (
+                    len(list(_iterate_value(v, ctx, n.cond, n)))
+                    if analyze else 0
+                )
+                scans.append((label, rows))
+                total_scan_rows += rows
+                continue
+            if union_branches is not None:
+                idef, iv = union_branches
+                branches = []
+                col = idef.cols_str[0]
+                base_path = col.replace("….", "").replace("…", "")
+                for bv in iv:
+                    brows = 0
+                    if analyze:
+                        from surrealdb_tpu.syn.parser import Parser as _P
+
+                        parts = _P(base_path)._field_name_parts()
+                        for src in _iterate_value(v, ctx):
+                            doc = src.doc if src.rid is not None else src.value
+                            cc = ctx.with_doc(doc, src.rid)
+                            cv = evaluate(Idiom(parts), cc)
+                            if isinstance(cv, list):
+                                flat = []
+                                for x in cv:
+                                    flat.extend(x if isinstance(x, list) else [x])
+                                if any(value_cmp(x, bv) == 0 for x in flat):
+                                    brows += 1
+                            elif value_cmp(cv, bv) == 0:
+                                brows += 1
+                    bacc = (
+                        f"[{render(bv)}]" if len(idef.cols_str) > 1
+                        else f"= {render(bv)}"
+                    )
+                    branches.append((
+                        f"IndexScan [ctx: Db] [index: {idef.name}, "
+                        f"access: {bacc}, direction: Forward]",
+                        brows,
+                    ))
+                urows = 0
+                if analyze:
+                    from surrealdb_tpu.syn.parser import Parser as _P
+
+                    parts = _P(base_path)._field_name_parts()
+                    for src in _iterate_value(v, ctx):
+                        doc = src.doc if src.rid is not None else src.value
+                        cc = ctx.with_doc(doc, src.rid)
+                        cv = evaluate(Idiom(parts), cc)
+                        flat = []
+                        if isinstance(cv, list):
+                            for x in cv:
+                                flat.extend(x if isinstance(x, list) else [x])
+                        else:
+                            flat = [cv]
+                        if any(
+                            value_cmp(x, bv) == 0 for bv in iv for x in flat
+                        ):
+                            urows += 1
+                scans.append((
+                    f"UnionIndexScan [ctx: Db] [table: {tb}, "
+                    f"branches: {len(branches)}]",
+                    urows, branches,
+                ))
+                total_scan_rows += urows
+                continue
+            if chosen is not None:
                 vals = [evaluate(eqs[c], ctx) for c in idef.cols_str[:nmatch]]
-                if len(idef.cols_str) > 1 or tail is not None:
+                if nmatch == 0 and tail is not None and tail[0] == "range":
+                    # single-column range: compact ">2000 <2020" form
+                    acc = " ".join(
+                        f"{op}{render(evaluate(vx, ctx))}"
+                        for op, vx in sorted(
+                            tail[1], key=lambda t: t[0] in ("<", "<=")
+                        )
+                    )
+                    tail = ("rng_done", tail[1])
+                elif len(idef.cols_str) > 1 or tail is not None:
                     acc = "[" + ", ".join(render(x) for x in vals) + "]"
                 else:
                     acc = f"= {render(vals[0])}" if vals else "[]"
@@ -1083,24 +1194,27 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                     and n.order != "rand"
                     and len(n.order) == 1
                     and tail is not None
-                    and tail[0] == "range"
+                    and tail[0] in ("range", "rng_done")
                 ):
                     oexpr, odir, _oc, _on = n.order[0]
                     from surrealdb_tpu.idx.planner import _field_path as _fp
 
-                    if (
-                        odir == "desc"
-                        and _fp(oexpr) == idef.cols_str[nmatch]
-                    ):
-                        direction = "Backward"
+                    if _fp(oexpr) == idef.cols_str[nmatch] \
+                            and single_target:
+                        if odir == "desc":
+                            direction = "Backward"
                         n = _strip_order(n)
                 limattr = ""
                 if (
-                    direction == "Backward"
-                    and n.limit is not None
+                    n.limit is not None
                     and n.group is None
+                    and (not n.order or n.order == [])
+                    and n.start is None
+                    and single_target
                 ):
-                    limattr = f", limit: {int(evaluate(n.limit, ctx))}"
+                    pushed_limit = int(evaluate(n.limit, ctx))
+                    limattr = f", limit: {pushed_limit}"
+                    n = _strip_limit(n)
                 label = (
                     f"IndexScan [ctx: Db] [index: {idef.name}, access: {acc}, "
                     f"direction: {direction}{limattr}]"
@@ -1134,6 +1248,38 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                         pred if residual is None
                         else _B("&&", residual, pred)
                     )
+        if (
+            label is None
+            and n.cond is None
+            and n.order
+            and n.order != "rand"
+            and len(n.order) == 1
+            and n.group is None
+            and n.start is None
+            and not noindex
+            and single_target
+        ):
+            # ORDER BY an indexed column: scan the index in order and
+            # push the limit into the scan (reference limit pushdown)
+            oexpr, odir, _oc, _on2 = n.order[0]
+            opath = expr_name(oexpr)
+            idef2 = next(
+                (d for d in indexes
+                 if d.cols_str and d.cols_str[0] == opath
+                 and d.fulltext is None and d.hnsw is None),
+                None,
+            )
+            if idef2 is not None:
+                direction = "Backward" if odir == "desc" else "Forward"
+                limattr = ""
+                if n.limit is not None:
+                    pushed_limit = int(evaluate(n.limit, ctx))
+                    limattr = f", limit: {pushed_limit}"
+                label = (
+                    f"IndexScan [ctx: Db] [index: {idef2.name}, access: "
+                    f", direction: {direction}{limattr}]"
+                )
+                n = _strip_limit(_strip_order(n))
         if label is None:
             extra = ""
             if n.cond is not None:
@@ -1144,10 +1290,15 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 and not n.order
                 and n.group is None
             ):
-                extra += f", limit: {int(evaluate(n.limit, ctx))}"
+                pushed_limit = int(evaluate(n.limit, ctx))
+                extra += f", limit: {pushed_limit}"
                 if n.start is not None:
-                    extra += f", offset: {int(evaluate(n.start, ctx))}"
-            label = f"TableScan [ctx: Db] [table: {tb}, direction: Forward{extra}]"
+                    pushed_offset = int(evaluate(n.start, ctx))
+                    extra += f", offset: {pushed_offset}"
+            label = (
+                f"TableScan [ctx: Db] [table: {tb}, "
+                f"direction: {scan_dir}{extra}]"
+            )
         if analyze:
             # scans report their own emitted rows (pre-residual-filter);
             # table scans with inlined predicates report post-filter
@@ -1161,6 +1312,10 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 rows = kept
             else:
                 rows = len(list(_iterate_value(v, ctx, n.cond, n)))
+            # a limit pushed into the scan caps the rows it emits
+            if pushed_limit is not None:
+                off = pushed_offset or 0
+                rows = max(0, min(pushed_limit, rows - off))
         else:
             rows = 0
         scans.append((label, rows))
@@ -1171,22 +1326,29 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
     # run the select for row counts of upper operators
     out_rows_n = 0
     if analyze:
-        saved = n.explain
-        n.explain = None
+        saved = orig_n.explain
+        orig_n.explain = None
         try:
-            result = _s_select(n, ctx.child())
+            result = _s_select(orig_n, ctx.child())
         finally:
-            n.explain = saved
+            orig_n.explain = saved
         out_rows_n = len(result) if isinstance(result, list) else 1
 
     root_lines = []
     scan_lines = []  # (reldepth, text, rows)
+
+    def _emit_scan(depth, entry):
+        scan_lines.append((depth, entry[0], entry[1]))
+        if len(entry) > 2 and entry[2]:
+            for bl, br in entry[2]:
+                scan_lines.append((depth + 1, bl, br))
+
     if len(scans) > 1:
         scan_lines.append((0, "Union [ctx: Db]", total_scan_rows))
-        for label, rows in scans:
-            scan_lines.append((1, label, rows))
+        for entry in scans:
+            _emit_scan(1, entry)
     else:
-        scan_lines.append((0, scans[0][0], scans[0][1]))
+        _emit_scan(0, scans[0])
     if residual is not None and not any(
         t.lstrip().startswith("TableScan") for _d, t, _r in scan_lines
     ):
@@ -1217,7 +1379,7 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 and not n.exprs[0][0].args
             )
             if only_count and len(n.what) == 1 and len(scans) == 1:
-                label, rows = scans[0]
+                label, rows = scans[0][0], scans[0][1]
                 tbname = label.split("table: ")[1].split(",")[0].rstrip(
                     "]"
                 ) if "table: " in label else None
@@ -1245,7 +1407,7 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
             )
         else:
             only_rid_scans = scans and all(
-                t.startswith("RecordIdScan") for t, _r in scans
+                entry[0].startswith("RecordIdScan") for entry in scans
             )
             if only_rid_scans:
                 root_lines.append(("Project [ctx: Db]", out_rows_n))
@@ -1303,6 +1465,11 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
     if n.limit is not None and n.group is not None:
         lim = int(evaluate(n.limit, ctx))
         root_lines.insert(0, (f"Limit [ctx: Db] [limit: {lim}]", out_rows_n))
+    if n.fetch:
+        fields = ", ".join(expr_name(f) for f in n.fetch)
+        root_lines.insert(
+            0, (f"Fetch [ctx: Db] [fields: {fields}]", out_rows_n)
+        )
     stacked = [(i, t, r) for i, (t, r) in enumerate(root_lines + mid_lines)]
     base = len(stacked)
     ordered = stacked + [(base + d, t, r) for d, t, r in scan_lines]
@@ -1314,6 +1481,14 @@ def _strip_order(n):
 
     n2 = _copy.copy(n)
     n2.order = []
+    return n2
+
+
+def _strip_limit(n):
+    import copy as _copy
+
+    n2 = _copy.copy(n)
+    n2.limit = None
     return n2
 
 
@@ -1404,6 +1579,7 @@ def _explain_select(n: SelectStmt, ctx):
     from surrealdb_tpu.idx.planner import explain_plan
 
     out = []
+    range_target = False
     for expr in n.what:
         v = _target_value(expr, ctx)
         if isinstance(v, Table):
@@ -1415,6 +1591,33 @@ def _explain_select(n: SelectStmt, ctx):
                         "operation": "Fallback",
                     }
                 )
+        elif isinstance(v, RecordId) and isinstance(v.id, Range):
+            rg = v.id
+            direction = "forward"
+            if (
+                n.order
+                and n.order != "rand"
+                and len(n.order) == 1
+                and n.order[0][1] == "desc"
+                and expr_name(n.order[0][0]) == "id"
+            ):
+                direction = "backward"
+            rs = (
+                f"[{render(rg.beg)}]"
+                + (".." if not rg.end_incl else "..=")
+                + f"[{render(rg.end)}]"
+            )
+            range_target = True
+            out.append(
+                {
+                    "detail": {
+                        "direction": direction,
+                        "range": rs,
+                        "table": v.tb,
+                    },
+                    "operation": "Iterate Range",
+                }
+            )
         else:
             out.append(
                 {
@@ -1422,7 +1625,7 @@ def _explain_select(n: SelectStmt, ctx):
                     "operation": "Iterate Value",
                 }
             )
-    out.append(_collector_detail(n))
+    out.append(_collector_detail(n, ctx))
     if n.explain == "full":
         out.append(
             {
@@ -1430,7 +1633,8 @@ def _explain_select(n: SelectStmt, ctx):
                 "operation": "RecordStrategy",
             }
         )
-        if n.start is not None or n.limit is not None:
+        if (n.start is not None or n.limit is not None) \
+                and not range_target:
             detail = {}
             if n.limit is not None:
                 detail["CancelOnLimit"] = int(evaluate(n.limit, ctx))
@@ -1451,6 +1655,19 @@ def _explain_select(n: SelectStmt, ctx):
             count = max(count - int(evaluate(n.start, ctx)), 0)
         if n.limit is not None:
             count = min(count, int(evaluate(n.limit, ctx)))
+        # an in-order (range-plan) index scan cancelled on limit streams
+        # straight from the index: the fetch stage reports 0
+        if any(
+            o.get("operation") == "StartLimitStrategy"
+            and "CancelOnLimit" in o.get("detail", {})
+            for o in out
+        ) and any(
+            o.get("operation") == "Iterate Index"
+            and isinstance(o.get("detail", {}).get("plan"), dict)
+            and "from" in o["detail"]["plan"]
+            for o in out
+        ):
+            count = 0
         out.append({"detail": {"count": count}, "operation": "Fetch"})
     return out
 
@@ -1486,11 +1703,20 @@ def _jax_ready() -> bool:
     return "jax" in sys.modules
 
 
-def _collector_detail(n: SelectStmt):
+def _collector_detail(n: SelectStmt, ctx=None):
     """Collector explain entry; GROUP queries report their aggregation
     slots (reference Group collector: _aN aggregations over exprN argument
     slots, _gN group expressions)."""
     if n.group is None:
+        if n.order and n.order != "rand" and n.limit is not None                 and ctx is not None:
+            # ordered + limited: the collector keeps start+limit rows
+            lim = int(evaluate(n.limit, ctx))
+            if n.start is not None:
+                lim += int(evaluate(n.start, ctx))
+            return {
+                "detail": {"limit": lim, "type": "MemoryOrderedLimit"},
+                "operation": "Collector",
+            }
         ctype = "MemoryOrdered" if n.order else "Memory"
         return {"detail": {"type": ctype}, "operation": "Collector"}
     _AGG_NAMES = {

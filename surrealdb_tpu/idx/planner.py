@@ -28,10 +28,17 @@ from surrealdb_tpu.err import SdbError
 
 
 def _field_path(expr):
-    from surrealdb_tpu.expr.ast import PAll, PFlatten
+    from surrealdb_tpu.expr.ast import PAll, PFlatten, PIndex
+
+    def _ok(p):
+        if isinstance(p, (PField, PAll, PFlatten)):
+            return True
+        # literal integer index parts (id[1]) are stable column paths
+        return isinstance(p, PIndex) and isinstance(p.expr, Literal) \
+            and isinstance(p.expr.value, int)
 
     if isinstance(expr, Idiom) and expr.parts and all(
-        isinstance(p, (PField, PAll, PFlatten)) for p in expr.parts
+        _ok(p) for p in expr.parts
     ) and isinstance(expr.parts[0], PField):
         from surrealdb_tpu.exec.statements import expr_name
 
@@ -110,9 +117,10 @@ def _array_like_paths(tb, ctx) -> set:
     return out
 
 
-def _classify_preds(cond, array_paths=frozenset()):
+def _classify_preds(cond, array_paths=frozenset(), value_idioms=True):
     """WHERE-tree analysis shared by plan_scan and explain_plan: returns
-    (eqs, ins, rngs) keyed by field path."""
+    (eqs, ins, rngs) keyed by field path. value_idioms=False (streaming
+    executor) rejects idiom-valued rhs like $obj.name entirely."""
     preds = []
     _split_ands(cond, preds)
     eqs: dict = {}
@@ -144,6 +152,14 @@ def _classify_preds(cond, array_paths=frozenset()):
             elif op == "∈":
                 op = "in"
             path, valexpr = lp, pred.rhs
+            # idiom-valued rhs: allowed only when it starts from a value
+            # (e.g. $obj.name) and the caller permits them (the legacy
+            # planner computes them; the streaming executor does not)
+            from surrealdb_tpu.expr.ast import Idiom as _Idiom
+
+            if isinstance(valexpr, _Idiom):
+                if not value_idioms or not _doc_free_idiom(valexpr):
+                    continue
         elif rp is not None and lp is None:
             if pred.op == "∈":
                 if not _array_shaped(rp, array_paths):
@@ -152,6 +168,11 @@ def _classify_preds(cond, array_paths=frozenset()):
             else:
                 flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
                 path, op, valexpr = rp, flip.get(pred.op, pred.op), pred.lhs
+            from surrealdb_tpu.expr.ast import Idiom as _Idiom
+
+            if isinstance(valexpr, _Idiom):
+                if not value_idioms or not _doc_free_idiom(valexpr):
+                    continue
         if path is None or path == "id":
             continue
         if op in ("=", "=="):
@@ -161,6 +182,17 @@ def _classify_preds(cond, array_paths=frozenset()):
         else:
             rngs.setdefault(path, []).append((op, valexpr))
     return eqs, ins, rngs
+
+
+def _doc_free_idiom(expr) -> bool:
+    """True when an idiom starts from a self-contained value (a param or
+    literal), so it can be computed once without a document."""
+    from surrealdb_tpu.expr.ast import ArrayExpr, ObjectExpr
+
+    p0 = expr.parts[0] if expr.parts else None
+    if not (isinstance(p0, tuple) and len(p0) == 2 and p0[0] == "start"):
+        return False
+    return isinstance(p0[1], (Param, Literal, ObjectExpr, ArrayExpr))
 
 
 def _array_shaped(path: str, array_paths) -> bool:
@@ -261,10 +293,26 @@ def _index_scan(tb, idef, eq_vals, tail, ctx):
             return None
         return Source(rid=rid, doc=doc)
 
+    nonuniq_base = K.index_prefix(ns, db, tb, idef.name)
+
     def _emit_range(beg, end):
         if unique:
             for _k, rid in ctx.txn.scan_vals(beg, end):
                 s = _fetch(rid)
+                if s:
+                    yield s
+            # all-NONE rows of unique indexes live in the non-unique
+            # keyspace (duplicates allowed); rebase the bounds there
+            nb = nonuniq_base + beg[len(base):]
+            if end.startswith(base):
+                ne = nonuniq_base + end[len(base):]
+            else:
+                # end was a whole-prefix bump: bump the rebased prefix
+                ne = K.prefix_range(nb)[1]
+            ncols = len(idef.cols_str)
+            for k in ctx.txn.keys(nb, ne):
+                _fields, idv = K.decode_index(k, ns, db, tb, idef.name, ncols)
+                s = _fetch(RecordId(tb, idv))
                 if s:
                     yield s
         else:
@@ -286,6 +334,10 @@ def _index_scan(tb, idef, eq_vals, tail, ctx):
                     s = _fetch(rid)
                     if s:
                         yield s
+                elif all(x is NONE or x is None for x in eq_vals):
+                    # all-NONE rows are stored without the unique
+                    # constraint; scan the rebased non-unique range
+                    yield from _emit_range(*K.prefix_range(prefix))
                 return
             yield from _emit_range(*K.prefix_range(prefix))
             return
@@ -523,7 +575,43 @@ def explain_plan(tb, cond, ctx, stmt):
             op = "="
             if tail is not None and tail[0] == "in":
                 op = "union"
-                vals = vals + [evaluate(tail[1], ctx)]
+                iv = evaluate(tail[1], ctx)
+                iv = iv if isinstance(iv, list) else [iv]
+                if nmatch:
+                    # composite: one [prefix..., v] branch per IN value
+                    vals = [list(vals) + [x] for x in iv]
+                else:
+                    vals = vals + [iv]
+            elif tail is not None and tail[0] == "range" and not nmatch \
+                    and not count_only:
+                frm = {"inclusive": False, "value": NONE}
+                to = {"inclusive": False, "value": NONE}
+                for rop2, rexpr2 in tail[1]:
+                    rv2 = evaluate(rexpr2, ctx)
+                    if rop2 in (">", ">="):
+                        frm = {"inclusive": rop2 == ">=", "value": rv2}
+                    else:
+                        to = {"inclusive": rop2 == "<=", "value": rv2}
+                direction = "forward"
+                order = getattr(stmt, "order", None) if stmt is not None                     else None
+                if order and order != "rand" and len(order) == 1:
+                    from surrealdb_tpu.exec.statements import expr_name
+
+                    oexpr, odir = order[0][0], order[0][1]
+                    if odir == "desc" and                             expr_name(oexpr) == idef.cols_str[0]:
+                        direction = "backward"
+                return {
+                    "detail": {
+                        "plan": {
+                            "direction": direction,
+                            "from": frm,
+                            "index": idef.name,
+                            "to": to,
+                        },
+                        "table": tb,
+                    },
+                    "operation": "Iterate Index",
+                }
             elif tail is not None:
                 op = {">": "MoreThan", ">=": "MoreThanOrEqual",
                       "<": "LessThan", "<=": "LessThanOrEqual"}.get(

@@ -300,6 +300,9 @@ class Parser:
         if self.eat_kw("with"):
             if self.eat_kw("noindex"):
                 s.with_index = []
+            elif self.eat_kw("no"):
+                self.expect_kw("index")
+                s.with_index = []
             else:
                 self.expect_kw("index")
                 s.with_index = [self.ident()]
