@@ -77,6 +77,9 @@ class EventDef:
     when: Any = None
     then: list = field(default_factory=list)
     comment: Optional[str] = None
+    async_: bool = False
+    retry: Any = None
+    maxdepth: Any = None
 
 
 @dataclass

@@ -37,6 +37,8 @@ def kind_name(kind: Kind) -> str:
     if kind.name == "object_literal":
         inner = ", ".join(f"{k}: {kind_name(kk)}" for k, kk in kind.inner)
         return "{ " + inner + " }"
+    if kind.name == "array_literal":
+        return "[" + ", ".join(kind_name(k) for k in kind.inner) + "]"
     if kind.name == "literal":
         from surrealdb_tpu.exec.static_eval import static_value_maybe
         from surrealdb_tpu.val import render
@@ -248,6 +250,10 @@ def coerce(v, kind: Kind):
         if isinstance(v, dict):
             return v
         raise coerce_err(v, kind)
+    if n == "array_literal":
+        if not isinstance(v, list) or len(v) != len(kind.inner):
+            raise coerce_err(v, kind)
+        return [coerce(x, kk) for x, kk in zip(v, kind.inner)]
     if n == "object_literal":
         if not isinstance(v, dict):
             raise coerce_err(v, kind)

@@ -340,7 +340,9 @@ def apply_fields(
                         f"Couldn't coerce value for field `{fd.name_str}` of `{rid.render() if rid else '?'}`: {e}"
                     )
             # ASSERT
-            if fd.assert_ is not None and cur is not NONE:
+            skip_assert = cur is NONE and fd.kind is not None and \
+                _kind_allows_none(fd.kind)
+            if fd.assert_ is not None and not skip_assert:
                 c.vars["value"] = cur
                 if not is_truthy(evaluate(fd.assert_, c)):
                     from surrealdb_tpu.exec.render_def import _expr_sql
@@ -454,6 +456,14 @@ def _field_targets(after, before, parent_path):
 # ---------------------------------------------------------------------------
 # index maintenance
 # ---------------------------------------------------------------------------
+
+
+def _kind_allows_none(k) -> bool:
+    if k.name in ("option", "any", "none"):
+        return True
+    if k.name == "either":
+        return any(_kind_allows_none(b) for b in k.inner)
+    return False
 
 
 def _index_values(idef, doc, ctx, rid):
@@ -755,7 +765,7 @@ def _single_index_add(idef, rid, doc, ctx):
 # ---------------------------------------------------------------------------
 
 
-def run_events(rid, before, after, action, ctx: Ctx):
+def run_events(rid, before, after, action, ctx: Ctx, input_doc=NONE):
     events = get_events(rid.tb, ctx)
     if not events:
         return
@@ -767,10 +777,28 @@ def run_events(rid, before, after, action, ctx: Ctx):
         c.vars["before"] = before if before is not NONE else NONE
         c.vars["after"] = after if after is not NONE else NONE
         c.vars["value"] = after if isinstance(after, dict) else before
+        c.vars["input"] = input_doc
         if ev.when is not None and not is_truthy(evaluate(ev.when, c)):
             continue
-        for stmt in ev.then:
-            eval_statement(stmt, c)
+        if getattr(ev, "async_", False):
+            # async events never fail the triggering write (reference
+            # doc/event.rs enqueues them out-of-band); retry up to RETRY
+            tries = 1 + int(getattr(ev, "retry", None) or 1)
+            for _try in range(tries):
+                try:
+                    for stmt in ev.then:
+                        eval_statement(stmt, c)
+                    break
+                except SdbError:
+                    continue
+            continue
+        try:
+            for stmt in ev.then:
+                eval_statement(stmt, c)
+        except SdbError as e:
+            raise SdbError(
+                f"Error while processing event {ev.name}: {e}"
+            )
 
 
 def write_changefeed(rid, before, after, action, ctx: Ctx):
@@ -947,6 +975,8 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
     """Shared store stages: schema, perms, write, edges, indexes, cf, events,
     lives, views, output."""
     ns, db = ctx.need_ns_db()
+    # the user-supplied document, before schema/VALUE clauses ($input)
+    input_doc = copy_value(after) if isinstance(after, dict) else NONE
     tdef = get_table(rid.tb, ctx)
     is_create = action == "CREATE"
     # relation-table checks
@@ -1003,7 +1033,7 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
     # changefeed
     write_changefeed(rid, before, after, action, ctx)
     # events
-    run_events(rid, before, after, action, ctx)
+    run_events(rid, before, after, action, ctx, input_doc)
     # live queries
     notify_lives(rid, before, after, action, ctx)
     # views

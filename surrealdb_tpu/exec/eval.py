@@ -115,19 +115,36 @@ def apply_computed_fields(tb: str, doc, rid, ctx: Ctx):
                 # likely an unresolved dependency — retry in a later pass
                 nxt.append(fd)
                 continue
-            doc[fd.name_str] = v
+            doc[fd.name_str] = _coerce_computed(fd, v, rid)
         if len(nxt) == len(pending):
             break
         pending = nxt
     for fd in pending:
         c = ctx.with_doc(doc, rid)
         try:
-            doc[fd.name_str] = evaluate(fd.computed, c)
+            v = evaluate(fd.computed, c)
         except SdbError:
             # a failing computed expression reads as NULL (reference
             # computed-future semantics)
             doc[fd.name_str] = None
+            continue
+        doc[fd.name_str] = _coerce_computed(fd, v, rid)
     return doc
+
+
+def _coerce_computed(fd, v, rid):
+    """A typed computed field coerces its value on read; failures carry
+    the standard field-coercion error."""
+    if fd.kind is None:
+        return v
+    try:
+        return coerce(v, fd.kind)
+    except SdbError as e:
+        rids = rid.render() if rid is not None else "?"
+        raise SdbError(
+            f"Couldn't coerce value for field `{fd.name_str}` of "
+            f"`{rids}`: {e}"
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -157,7 +174,7 @@ def _e_param(n, ctx):
     name = n.name
     if name in ctx.vars:
         return ctx.vars[name]
-    if name == "this":
+    if name in ("this", "self"):
         return ctx.doc if ctx.doc is not None else NONE
     if name == "parent":
         return ctx.parent_doc if ctx.parent_doc is not None else NONE

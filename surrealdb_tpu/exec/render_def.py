@@ -70,7 +70,18 @@ def _expr_sql(node) -> str:
     if isinstance(node, Idiom):
         from surrealdb_tpu.exec.statements import expr_name
 
-        return expr_name(node)
+        parts = node.parts
+        if parts and isinstance(parts[0], tuple) and parts[0][0] == "start":
+            head = _expr_sql(parts[0][1])
+            rest = (
+                expr_name(Idiom(list(parts[1:])), sql=True)
+                if len(parts) > 1 else ""
+            )
+            if not rest:
+                return head
+            sep = "" if rest.startswith(("[", "-", "<")) else "."
+            return head + sep + rest
+        return expr_name(node, sql=True)
     if isinstance(node, ArrayExpr):
         return "[" + ", ".join(_expr_sql(x) for x in node.items) + "]"
     if isinstance(node, ObjectExpr):
@@ -518,9 +529,21 @@ def index_structure(d) -> dict:
 
 
 def render_event(d, tb) -> str:
-    then = ", ".join(_expr_sql(t) for t in d.then)
+    def wrap(t):
+        x = _expr_sql(t)
+        return x if x.startswith(("(", "{")) else f"({x})"
+
+    then = ", ".join(wrap(t) for t in d.then)
+    attrs = ""
+    if getattr(d, "async_", False):
+        retry = getattr(d, "retry", None)
+        maxdepth = getattr(d, "maxdepth", None)
+        attrs = (
+            f" ASYNC RETRY {1 if retry is None else retry} "
+            f"MAXDEPTH {3 if maxdepth is None else maxdepth}"
+        )
     out = (
-        f"DEFINE EVENT {escape_ident(d.name)} ON {escape_ident(tb)} "
+        f"DEFINE EVENT {escape_ident(d.name)} ON {escape_ident(tb)}{attrs} "
         f"WHEN {_expr_sql(d.when) if d.when is not None else 'true'} THEN {then}"
     )
     if d.comment:

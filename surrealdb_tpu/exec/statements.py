@@ -372,18 +372,22 @@ def _is_aggregate(expr) -> bool:
     return False
 
 
-def expr_name(expr) -> str:
-    """Canonical output field name for an unaliased projection."""
+def expr_name(expr, sql=False) -> str:
+    """Canonical output field name for an unaliased projection. sql=True
+    renders for SQL output (reserved idents get backticks)."""
     if isinstance(expr, Idiom):
+        from surrealdb_tpu.val import escape_ident as _esc
+
         out = []
         for p in expr.parts:
             if isinstance(p, tuple):
-                out.append(expr_name(p[1]))
+                out.append(expr_name(p[1], sql))
             elif isinstance(p, PField):
+                name = _esc(p.name) if sql else p.name
                 if out:
-                    out.append("." + p.name)
+                    out.append("." + name)
                 else:
-                    out.append(p.name)
+                    out.append(name)
             elif isinstance(p, PAll):
                 out.append(".*" if out else "*")
             elif isinstance(p, PIndex):
@@ -398,7 +402,7 @@ def expr_name(expr) -> str:
                     out.append(f"{arrow}({_select_sql(p.expr)})")
                     continue
                 names = ", ".join(w[0] for w in p.what) if p.what else "?"
-                if len(p.what) == 1:
+                if len(p.what) <= 1:
                     out.append(f"{arrow}{names}")
                 else:
                     out.append(f"{arrow}({names})")
@@ -667,7 +671,7 @@ def _idiom_segments(expr, ctx=None):
         elif isinstance(p, PGraph):
             arrow = {"out": "->", "in": "<-", "both": "<->", "ref": "<~"}[p.dir]
             names = ", ".join(w[0] for w in p.what) if p.what else "?"
-            if len(p.what) == 1:
+            if len(p.what) <= 1:
                 segs.append(f"{arrow}{names}")
             else:
                 segs.append(f"{arrow}({names})")
@@ -2139,6 +2143,8 @@ def _s_define_field(n: DefineField, ctx):
     if ctx.txn.get(K.tb_def(ns, db, n.tb)) is None:
         ctx.txn.set_val(K.tb_def(ns, db, n.tb), TableDef(name=n.tb))
     name_str = _field_name_str(n.name)
+    _check_computed_field(n, name_str, ns, db, ctx)
+    _check_nested_kind(n, name_str, ns, db, ctx)
     kdef = K.fd_def(ns, db, n.tb, name_str)
     if _exists_guard(ctx, kdef, name_str, "field", n.if_not_exists, n.overwrite):
         return NONE
@@ -2161,13 +2167,259 @@ def _s_define_field(n: DefineField, ctx):
     return NONE
 
 
+def _check_nested_kind(n, name_str, ns, db, ctx):
+    """A nested field's TYPE must equal the kind its parent projects at
+    that segment (reference define/field.rs type-mismatch check)."""
+    from surrealdb_tpu.exec.coerce import kind_name
+    from surrealdb_tpu.expr.ast import Kind, PIndex as _PIdx
+
+    if n.kind is None or len(n.name) < 2:
+        return
+    pfd = None
+    split = None
+    for i in range(len(n.name) - 1, 0, -1):
+        cand = _field_name_str(n.name[:i])
+        fd = ctx.txn.get_val(K.fd_def(ns, db, n.tb, cand))
+        if fd is not None:
+            pfd, parent_str, split = fd, cand, i
+            break
+    if pfd is None or pfd.kind is None:
+        return
+
+    def as_seg(p):
+        if isinstance(p, PField):
+            return ("key", p.name)
+        if isinstance(p, PAll):
+            return ("all", None)
+        if isinstance(p, _PIdx):
+            return ("idx", p.expr.value
+                    if isinstance(p.expr, Literal) else None)
+        return None
+
+    segs = [as_seg(p) for p in n.name[split:]]
+    if any(x is None for x in segs):
+        return
+
+    ALLOW = object()
+    MISMATCH = object()
+
+    def proj(k, seg):
+        nm = k.name
+        if nm == "option":
+            return proj(k.inner[0], seg) if k.inner else ALLOW
+        if nm == "either":
+            outs = []
+            for b in k.inner:
+                r = proj(b, seg)
+                if r is MISMATCH:
+                    return MISMATCH
+                if r is ALLOW:
+                    continue
+                outs.extend(r if isinstance(r, list) else [r])
+            return outs or ALLOW
+        if nm == "any":
+            return ALLOW
+        if nm == "object" and not getattr(k, "inner", None):
+            # plain objects have keyed children only
+            return ALLOW if seg[0] in ("key", "all") else MISMATCH
+        if nm in ("array", "set"):
+            if seg[0] not in ("all", "idx"):
+                return MISMATCH
+            if not k.inner:
+                return ALLOW
+            return [k.inner[0]]
+        if nm == "array_literal":
+            if seg[0] == "idx":
+                i = seg[1]
+                if isinstance(i, int) and 0 <= i < len(k.inner):
+                    return [k.inner[i]]
+                return MISMATCH
+            if seg[0] == "all":
+                return list(k.inner)
+            return MISMATCH
+        if nm == "object_literal":
+            if seg[0] == "key":
+                for kk, kv in k.inner:
+                    if kk == seg[1]:
+                        return [kv]
+                return MISMATCH
+            if seg[0] == "all":
+                return [kv for _kk, kv in k.inner]
+            return MISMATCH
+        return ALLOW
+
+    kinds = [pfd.kind]
+    r = None
+    for seg in segs:
+        outs = []
+        for k in kinds:
+            rr = proj(k, seg)
+            if rr is MISMATCH:
+                outs = MISMATCH
+                break
+            if rr is ALLOW:
+                outs = ALLOW
+                break
+            outs.extend(rr)
+        r = outs
+        if r is ALLOW or r is MISMATCH:
+            break
+        kinds = r
+    if r is ALLOW:
+        return
+    if r is not MISMATCH:
+        # canonical union of projected kinds must equal the declared kind;
+        # option<K> and nested eithers flatten into the union
+        def leaves(k):
+            if k.name == "option" and k.inner:
+                yield from leaves(k.inner[0])
+            elif k.name == "either":
+                for b in k.inner:
+                    yield from leaves(b)
+            else:
+                yield kind_name(k)
+
+        names = list(dict.fromkeys(x for k in r for x in leaves(k)))
+        want = " | ".join(names)
+        have = " | ".join(
+            dict.fromkeys(x for x in leaves(n.kind))
+        )
+        if want == have:
+            return
+    raise SdbError(
+        f"Cannot set field `{name_str}` with type `{kind_name(n.kind)}` "
+        f"as it mismatched with field `{parent_str}` with type "
+        f"`{kind_name(pfd.kind)}`"
+    )
+
+
+def _check_computed_field(n, name_str, ns, db, ctx):
+    """COMPUTED field validation (reference expr/statements/define/field.rs):
+    clause exclusions, top-level-only, no indexes, and cycle detection."""
+    existing = {
+        fd.name_str: fd
+        for _k, fd in ctx.txn.scan_vals(
+            *K.prefix_range(K.fd_prefix(ns, db, n.tb))
+        )
+    }
+    if n.computed is None:
+        # defining a nested field under a computed parent is an error
+        if "." in name_str:
+            parent = name_str.split(".")[0]
+            pfd = existing.get(parent)
+            if pfd is not None and pfd.computed is not None:
+                raise SdbError(
+                    f"Cannot define nested field `{name_str}` as parent "
+                    f"field `{parent}` is a `COMPUTED` field."
+                )
+        return
+    if name_str == "id":
+        raise SdbError("Cannot use the `COMPUTED` keyword on the `id` field.")
+    for attr, kw in (("value", "VALUE"), ("assert_", "ASSERT"),
+                     ("default", "DEFAULT"), ("reference", "REFERENCE"),
+                     ("readonly", "READONLY")):
+        if getattr(n, attr, None):
+            raise SdbError(f"Cannot use the `{kw}` keyword with `COMPUTED`.")
+    if len(n.name) > 1:
+        raise SdbError(
+            f"Cannot define field `{name_str}` as `COMPUTED` fields must "
+            "be top-level."
+        )
+    for other in existing:
+        if other.startswith(name_str + ".") or other.startswith(
+                name_str + "["):
+            raise SdbError(
+                f"Cannot define field `{name_str}` as `COMPUTED` since a "
+                f"nested field `{other}` already exists."
+            )
+    # computed fields cannot be indexed
+    for _k, idef in ctx.txn.scan_vals(
+            *K.prefix_range(K.ix_prefix(ns, db, n.tb))):
+        for col in idef.cols_str:
+            if col == name_str or col.startswith(name_str + "."):
+                raise SdbError(
+                    f"Computed fields cannot be indexed. Index: "
+                    f"'{idef.name}' - Field: '{name_str}'"
+                )
+    # cycle detection over the computed-field dependency graph
+    deps = {
+        fname: sorted(_computed_deps(fd.computed))
+        for fname, fd in existing.items()
+        if fd.computed is not None and fname != name_str
+    }
+    deps[name_str] = sorted(_computed_deps(n.computed))
+
+    def dfs(cur, path, seen):
+        for d in deps.get(cur, []):
+            if d == name_str:
+                # canonical cycle: rotate to start at the smallest name
+                i = path.index(min(path))
+                cyc = path[i:] + path[:i]
+                raise SdbError(
+                    "Cyclic dependency detected among computed fields: "
+                    + " -> ".join(cyc + [cyc[0]])
+                )
+            if d in deps and d not in seen:
+                seen.add(d)
+                dfs(d, path + [d], seen)
+
+    dfs(name_str, [name_str], {name_str})
+
+
+def _computed_deps(expr) -> set:
+    """Field names referenced by a computed expression: bare idioms,
+    `this.x` / `$this.x`, and `this['x']` bracket access."""
+    out = set()
+
+    def visit(node):
+        if isinstance(node, Idiom) and node.parts:
+            p0 = node.parts[0]
+            if isinstance(p0, PField):
+                out.add(p0.name)
+            elif isinstance(p0, tuple) and len(p0) == 2 and p0[0] == "start":
+                base = p0[1]
+                if isinstance(base, Param) and base.name in ("this", "self"):
+                    rest = node.parts[1:]
+                    if rest:
+                        r0 = rest[0]
+                        if isinstance(r0, PField):
+                            out.add(r0.name)
+                        elif isinstance(r0, PIndex) and isinstance(
+                                r0.expr, Literal) and isinstance(
+                                r0.expr.value, str):
+                            out.add(r0.expr.value)
+            # bracket access on a bare field: a['b'] has PField head,
+            # already collected above
+        for f in getattr(node, "__dataclass_fields__", {}):
+            v = getattr(node, f)
+            if isinstance(v, Node):
+                visit(v)
+            elif isinstance(v, list):
+                for x in v:
+                    if isinstance(x, Node):
+                        visit(x)
+                    elif isinstance(x, tuple):
+                        for y in x:
+                            if isinstance(y, Node):
+                                visit(y)
+
+    if expr is not None:
+        visit(expr)
+    return out
+
+
 def _field_name_str(parts) -> str:
     out = []
     for p in parts:
         if isinstance(p, PField):
             out.append(("." if out else "") + p.name)
         elif isinstance(p, PAll):
-            out.append("[*]")
+            out.append(".*" if out else "*")
+        elif isinstance(p, PIndex):
+            from surrealdb_tpu.expr.ast import Literal as _L
+
+            if isinstance(p.expr, _L):
+                out.append(f"[{p.expr.value}]")
     return "".join(out)
 
 
@@ -2181,6 +2433,13 @@ def _s_define_index(n: DefineIndex, ctx):
         return NONE
     if n.overwrite and ctx.txn.get(kdef) is not None:
         _remove_index_data(ns, db, n.tb, n.name, ctx)
+    # computed fields cannot be indexed
+    computed_names = {
+        fd.name_str
+        for _k, fd in ctx.txn.scan_vals(
+            *K.prefix_range(K.fd_prefix(ns, db, n.tb)))
+        if fd.computed is not None
+    }
     cols = []
     for c in n.cols:
         # type::field($f) / type::fields($fs) expand to idioms at define
@@ -2201,6 +2460,14 @@ def _s_define_index(n: DefineIndex, ctx):
                 cols.append(Idiom(Parser(s)._field_name_parts()))
         else:
             cols.append(c)
+    for c in cols:
+        cname = expr_name(c)
+        head = cname.split(".")[0].split("[")[0]
+        if head in computed_names:
+            raise SdbError(
+                f"Computed fields cannot be indexed. Index: '{n.name}' - "
+                f"Field: '{head}'"
+            )
     idef = IndexDef(
         name=n.name,
         tb=n.tb,
@@ -2237,7 +2504,11 @@ def _s_define_event(n: DefineEvent, ctx):
     kdef = K.ev_def(ns, db, n.tb, n.name)
     if _exists_guard(ctx, kdef, n.name, "event", n.if_not_exists, n.overwrite):
         return NONE
-    ctx.txn.set_val(kdef, EventDef(n.name, n.when, n.then, n.comment))
+    ctx.txn.set_val(kdef, EventDef(
+        n.name, n.when, n.then, n.comment,
+        getattr(n, "async_", False), getattr(n, "retry", None),
+        getattr(n, "maxdepth", None),
+    ))
     return NONE
 
 

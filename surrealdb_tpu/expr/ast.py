@@ -546,6 +546,9 @@ class DefineEvent(Node):
     if_not_exists: bool = False
     overwrite: bool = False
     comment: Optional[str] = None
+    async_: bool = False
+    retry: Optional[int] = None
+    maxdepth: Optional[int] = None
 
 
 @dataclass

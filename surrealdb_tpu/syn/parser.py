@@ -907,6 +907,11 @@ class Parser:
             if self.at_kw("flexible", "flexi", "flex"):
                 if d.kind is None:
                     raise self.err("FLEXIBLE must be specified after TYPE")
+                if not self._kind_has_object(d.kind):
+                    raise self.err(
+                        "FLEXIBLE can only be used with types containing "
+                        "object"
+                    )
                 self.next()
                 d.flex = True
             elif self.eat_kw("type"):
@@ -923,7 +928,7 @@ class Parser:
                 d.default_always = self.eat_kw("always")
                 d.default = self.parse_expr()
             elif self.eat_kw("permissions"):
-                d.permissions = self._parse_permissions()
+                d.permissions = self._parse_permissions(no_delete=True)
             elif self.eat_kw("reference"):
                 d.reference = self._parse_reference()
             elif self.eat_kw("comment"):
@@ -1137,13 +1142,26 @@ class Parser:
         when = None
         then = []
         comment = None
+        async_ = False
+        retry = None
+        maxdepth = None
         while True:
             if self.eat_kw("async"):
-                pass
-            elif self.eat_kw("retry"):
+                async_ = True
+            elif self.at_kw("retry"):
+                if not async_:
+                    raise self.err("Unexpected token `RETRY`")
                 self.next()
-            elif self.eat_kw("maxdepth"):
+                if self.peek().kind != L.INT:
+                    raise self.err("expected an integer RETRY count")
+                retry = self.next().value
+            elif self.at_kw("maxdepth"):
+                if not async_:
+                    raise self.err("Unexpected token `MAXDEPTH`")
                 self.next()
+                if self.peek().kind != L.INT:
+                    raise self.err("expected an integer MAXDEPTH")
+                maxdepth = self.next().value
             elif self.eat_kw("when"):
                 when = self.parse_expr()
             elif self.eat_kw("then"):
@@ -1161,7 +1179,13 @@ class Parser:
                 comment = self._comment_value()
             else:
                 break
-        return DefineEvent(name, tb, when, then, ine, ow, comment)
+        if not then:
+            raise self.err("Expected at least one `THEN` statement")
+        d = DefineEvent(name, tb, when, then, ine, ow, comment)
+        d.async_ = async_
+        d.retry = retry
+        d.maxdepth = maxdepth
+        return d
 
     def _define_function(self):
         ine, ow = self._def_flags()
@@ -1368,7 +1392,17 @@ class Parser:
                 break
         return cfg
 
-    def _parse_permissions(self):
+    def _kind_has_object(self, k) -> bool:
+        if k is None:
+            return False
+        if k.name in ("object", "object_literal"):
+            return True
+        inner = getattr(k, "inner", None) or []
+        return any(
+            isinstance(x, Kind) and self._kind_has_object(x) for x in inner
+        )
+
+    def _parse_permissions(self, no_delete=False):
         if self.eat_kw("none"):
             return {"select": False, "create": False, "update": False, "delete": False}
         if self.eat_kw("full"):
@@ -1382,6 +1416,8 @@ class Parser:
                     stop = True
                     break
                 kinds.append(self.ident().lower())
+            if no_delete and "delete" in kinds:
+                raise self.err("Can't define permission DELETE for fields")
             if stop:
                 # `FOR select, FOR ...`: value defaults empty -> keep parsing
                 for k in kinds:
@@ -1791,8 +1827,15 @@ class Parser:
             self.expect_op("}")
             return Kind("object_literal", inner=fields)
         if t.kind == L.OP and t.text == "[":
-            arr = self._parse_array()
-            return Kind("literal", literal=arr)
+            # tuple kind: [kind, kind, ...] — fixed-position element kinds
+            self.next()
+            inner = []
+            while not self.at_op("]"):
+                inner.append(self.parse_kind())
+                if not self.eat_op(","):
+                    break
+            self.expect_op("]")
+            return Kind("array_literal", inner=inner)
         if t.kind != L.IDENT:
             raise self.err("expected type name")
         name = self.next().value.lower()

@@ -743,8 +743,20 @@ def escape_object_key(s: str) -> str:
     return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
 
 
+# identifiers that could be mistaken for keywords get backticks
+# (reference syn/lexer/keywords.rs RESERVED_KEYWORD)
+RESERVED_IDENTS = {
+    "ALTER", "BEGIN", "BREAK", "CANCEL", "COMMIT", "CONTINUE", "CREATE",
+    "DEFINE", "DELETE", "FOR", "IF", "INFO", "INSERT", "KILL", "LIVE",
+    "OPTION", "REBUILD", "RETURN", "RELATE", "REMOVE", "SELECT", "LET",
+    "SHOW", "SLEEP", "THROW", "UPDATE", "UPSERT", "USE", "DIFF", "RAND",
+    "NONE", "NULL", "AFTER", "BEFORE", "VALUE", "BY", "ALL", "TRUE",
+    "FALSE", "WHERE", "TABLE", "TB", "SEQUENCE", "FUNCTION",
+}
+
+
 def escape_ident(s: str) -> str:
-    if _IDENT_RX.match(s):
+    if _IDENT_RX.match(s) and s.upper() not in RESERVED_IDENTS:
         return s
     return "`" + s.replace("\\", "\\\\").replace("`", "\\`") + "`"
 
