@@ -357,6 +357,7 @@ _AGGREGATES = {
     "math::nearestrank", "time::min", "time::max", "array::group",
     "array::distinct", "array::flatten", "array::concat", "array::first",
     "array::last", "array::len", "array::max", "array::min", "array::sort",
+    "array::join",
 }
 
 
@@ -422,7 +423,16 @@ def expr_name(expr, sql=False) -> str:
     if isinstance(expr, Param):
         return expr.name
     if isinstance(expr, Binary):
-        return f"{expr_name(expr.lhs)} {expr.op} {expr_name(expr.rhs)}"
+        # compound names render nested calls with their arguments
+        # ("math::mean(v) + 1"), unlike bare top-level calls
+        def sub(e):
+            if isinstance(e, FunctionCall):
+                from surrealdb_tpu.exec.render_def import _expr_sql
+
+                return _expr_sql(e)
+            return expr_name(e, sql)
+
+        return f"{sub(expr.lhs)} {expr.op} {sub(expr.rhs)}"
     if isinstance(expr, Cast):
         return expr_name(expr.expr)
     if isinstance(expr, Subquery):
@@ -510,7 +520,30 @@ def _select_pipeline(n: SelectStmt, rows, c):
         aliases[alias or expr_name(expr)] = expr
     # GROUP BY
     if n.group is not None:
-        out_rows = _apply_group(rows, n, c, aliases)
+        if any(e == "*" for e, _a in n.exprs):
+            raise SdbError(
+                "Invalid query: Incorrect selector for aggregate "
+                "selection, expression `*` within in selector cannot "
+                "be aggregated in a group."
+            )
+        empty_row = True
+        if not rows and not c.session.is_owner and \
+                c.session.auth_level != "editor":
+            # a hard PERMISSIONS NONE table suppresses the GROUP ALL row
+            for w in n.what:
+                try:
+                    v = _target_value(w, c)
+                except SdbError:
+                    continue
+                tbn = v.name if isinstance(v, Table) else (
+                    v.tb if isinstance(v, RecordId) else None)
+                if tbn is None:
+                    continue
+                ns_, db_ = c.need_ns_db()
+                tdef = c.txn.get_val(K.tb_def(ns_, db_, tbn))
+                if tdef is not None and tdef.permissions is not None and                         tdef.permissions.get("select") is False:
+                    empty_row = False
+        out_rows = _apply_group(rows, n, c, aliases, empty_row)
         if n.order and n.order != "rand":
             out_rows = _apply_order(out_rows, n.order, c)
         elif n.order == "rand":
@@ -758,8 +791,27 @@ def _set_path(doc, segs, v):
     cur[segs[-1]] = v
 
 
-def _apply_group(rows, n: SelectStmt, ctx, aliases=None):
+def _count_only_stmt(n) -> bool:
+    return bool(n.exprs) and all(
+        _is_aggregate(e) for e, _a in n.exprs if e != "*"
+    ) and any(e != "*" for e, _a in n.exprs)
+
+
+def _apply_group(rows, n: SelectStmt, ctx, aliases=None, empty_row=True):
     from surrealdb_tpu.val import hashable
+
+    if not rows and n.group == []:
+        # GROUP ALL over no input: aggregates still emit one row
+        # (count: 0) unless the table was hard-denied by permissions
+        if empty_row and n.value is None and _count_only_stmt(n):
+            row = {}
+            for expr, alias in n.exprs:
+                if expr == "*":
+                    continue
+                name = alias if alias else expr_name(expr)
+                row[name] = _eval_aggregate(expr, [], ctx)
+            return [row]
+        return []
 
     groups: dict = {}
     order = []
@@ -798,8 +850,16 @@ def _apply_group(rows, n: SelectStmt, ctx, aliases=None):
             name = alias if alias else expr_name(expr)
             if _is_aggregate(expr):
                 v = _eval_aggregate(expr, members, ctx)
-            else:
+            elif any(expr == g for g in gb):
                 v = evaluate(expr, fc)
+            else:
+                # implicit array::group: the expression evaluates per
+                # member row and the results collect into an array
+                v = []
+                for m in members:
+                    d = m.doc if m.rid is not None else m.value
+                    mc = ctx.with_doc(d, m.rid)
+                    v.append(evaluate(expr, mc))
             _set_out_field(row, name, v)
         out.append(row)
     return out
@@ -821,6 +881,22 @@ def _eval_aggregate(expr, members, ctx):
             vals.append(evaluate(expr.args[0], c) if expr.args else NONE)
         if fname == "count":
             return sum(1 for v in vals if is_truthy(v))
+        if fname == "math::sum":
+            # the streaming Sum aggregate folds with Float 0.0 — but an
+            # empty accumulation reports Int 0 (reference aggregates)
+            from decimal import Decimal as _D
+
+            from surrealdb_tpu.fnc import FUNCS as _F
+
+            nums = [
+                x for x in vals
+                if isinstance(x, (int, float, _D))
+                and not isinstance(x, bool)
+            ]
+            if not nums:
+                return 0
+            v = _F["math::sum"]([nums], ctx)
+            return float(v) if isinstance(v, int) else v
         extra = []
         for a in expr.args[1:]:
             extra.append(evaluate(a, ctx))
@@ -1387,8 +1463,24 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 tbname = label.split("table: ")[1].split(",")[0].rstrip(
                     "]"
                 ) if "table: " in label else None
+                tv = _target_value(n.what[0], ctx)
+                if isinstance(tv, RecordId) and isinstance(tv.id, Range) \
+                        and n.cond is None:
+                    rg = tv.id
+                    rsrc = (
+                        f"{tv.tb}:{render(rg.beg)}"
+                        + ("..=" if rg.end_incl else "..")
+                        + render(rg.end)
+                    )
+                    text = f"CountScan [ctx: Db] [source: {rsrc}]"
+                    return _render_tree([(0, text, 1 if analyze else 0)],
+                                        analyze, 1)
                 if label.startswith("TableScan") and n.cond is None:
-                    text = f"CountScan [ctx: Db] [source: {tbname}]"
+                    from surrealdb_tpu.val import escape_ident as _esc2
+
+                    text = (
+                        f"CountScan [ctx: Db] [source: {_esc2(tbname)}]"
+                    )
                     return _render_tree([(0, text, 1 if analyze else 0)],
                                         analyze, 1)
                 if label.startswith("IndexScan"):
@@ -1606,12 +1698,15 @@ def _explain_select(n: SelectStmt, ctx):
                 and expr_name(n.order[0][0]) == "id"
             ):
                 direction = "backward"
-            rs = (
-                f"[{render(rg.beg)}]"
-                + (".." if not rg.end_incl else "..=")
-                + f"[{render(rg.end)}]"
-            )
+            rs = rg
             range_target = True
+            count_only_rng = (
+                n.group == []
+                and len(n.exprs) == 1
+                and isinstance(n.exprs[0][0], FunctionCall)
+                and n.exprs[0][0].name.lower() == "count"
+                and not n.exprs[0][0].args
+            )
             out.append(
                 {
                     "detail": {
@@ -1619,7 +1714,8 @@ def _explain_select(n: SelectStmt, ctx):
                         "range": rs,
                         "table": v.tb,
                     },
-                    "operation": "Iterate Range",
+                    "operation": "Iterate Range Count" if count_only_rng
+                    else "Iterate Range",
                 }
             )
         else:
@@ -1725,6 +1821,7 @@ def _collector_detail(n: SelectStmt, ctx=None):
         return {"detail": {"type": ctype}, "operation": "Collector"}
     _AGG_NAMES = {
         "count": "Count", "math::sum": "Sum", "math::mean": "Mean",
+        "__count_value__": "CountValue",
         "math::min": "Min", "math::max": "Max", "time::min": "DatetimeMin",
         "time::max": "DatetimeMax", "math::stddev": "StdDev",
         "math::variance": "Variance",
@@ -1745,6 +1842,8 @@ def _collector_detail(n: SelectStmt, ctx=None):
             ai += 1
             base = _AGG_NAMES[expr.name.lower()]
             if expr.args:
+                if expr.name.lower() == "count":
+                    base = "CountValue"
                 argtext = expr_name(expr.args[0])
                 slot = expr_slots.get(argtext)
                 if slot is None:

@@ -503,6 +503,20 @@ def explain_plan(tb, cond, ctx, stmt):
     with_index = getattr(stmt, "with_index", None) if stmt is not None else None
     if with_index == []:
         cond = None  # WITH NOINDEX: always a table scan
+    # a count-only GROUP ALL over a bare table counts keys, not documents
+    if cond is None and stmt is not None and             getattr(stmt, "group", None) == [] and             getattr(stmt, "exprs", None):
+        from surrealdb_tpu.expr.ast import FunctionCall as _FC3
+
+        if (
+            len(stmt.exprs) == 1
+            and isinstance(stmt.exprs[0][0], _FC3)
+            and stmt.exprs[0][0].name.lower() == "count"
+            and not stmt.exprs[0][0].args
+        ):
+            return {
+                "detail": {"direction": "forward", "table": tb},
+                "operation": "Iterate Table Count",
+            }
     if cond is not None:
         knn = _find_knn(cond)
         indexes = get_indexes_for(tb, ctx)
@@ -571,6 +585,32 @@ def explain_plan(tb, cond, ctx, stmt):
             )
         if chosen is not None:
             idef, nmatch, tail = chosen
+            if count_only:
+                # a count-only scan requires the index to cover the whole
+                # WHERE clause; residual predicates need real documents
+                covered = set(idef.cols_str[:nmatch])
+                if tail is not None:
+                    covered.add(idef.cols_str[nmatch])
+                preds = []
+                _split_ands(cond, preds)
+                classified = set(eqs) | set(ins) | set(rngs)
+                _IDXOPS = ("=", "==", "\u2208", "<", "<=", ">", ">=",
+                           "\u220b", "\u2287", "containsany")
+                for pred in preds:
+                    pth = None
+                    servable = False
+                    if isinstance(pred, Binary) and pred.op in _IDXOPS:
+                        lp2 = _field_path(pred.lhs)
+                        rp2 = _field_path(pred.rhs)
+                        # exactly one side is the column; the other side
+                        # must be a computable value
+                        if (lp2 is None) != (rp2 is None):
+                            pth = lp2 or rp2
+                            servable = True
+                    if not servable or pth not in covered or \
+                            pth not in classified:
+                        count_only = False
+                        break
             vals = [evaluate(eqs[c], ctx) for c in idef.cols_str[:nmatch]]
             op = "="
             if tail is not None and tail[0] == "in":

@@ -29,6 +29,7 @@ class LangTest:
     ns: str | None = "test"
     db: str | None = "test"
     imports: list = field(default_factory=list)
+    auth: dict | None = None
     wip: bool = False
 
 
@@ -56,6 +57,7 @@ def parse_test_file(path: str) -> LangTest:
     t.ns = None if ns is False else (ns if isinstance(ns, str) else "test")
     t.db = None if db is False else (db if isinstance(db, str) else "test")
     t.imports = env.get("imports", [])
+    t.auth = env.get("auth")
     ps = env.get("planner-strategy")
     t.planner = ps[0] if isinstance(ps, list) and ps else None
     return t
@@ -106,13 +108,26 @@ def run_lang_test(t: LangTest, ds=None):
 
     sess = Session(ns=t.ns, db=t.db)
     sess.planner_strategy = getattr(t, "planner", None)
+    auth = getattr(t, "auth", None)
+    run_sess = sess
+    if isinstance(auth, dict) and (auth.get("rid") or auth.get("access")):
+        # record-access session: imports still run as owner
+        run_sess = Session(
+            ns=auth.get("namespace", t.ns), db=auth.get("database", t.db),
+            auth_level="record", ac=auth.get("access"),
+        )
+        run_sess.planner_strategy = sess.planner_strategy
+        rid = auth.get("rid")
+        if rid:
+            rv = ds.execute(f"RETURN {rid}", ns=t.ns, db=t.db)
+            run_sess.rid = rv[0].result if rv and rv[0].ok else None
     for imp in t.imports:
         ipath = os.path.join(os.path.dirname(t.path), imp)
         if not os.path.exists(ipath):
             ipath = os.path.join(TESTS_ROOT, imp)
         it = parse_test_file(ipath)
         ds.execute(it.sql, session=sess)
-    res = ds.execute(t.sql, session=sess)
+    res = ds.execute(t.sql, session=run_sess)
     if not t.results:
         return True, "no expectations"
     if len(res) != len(t.results):
