@@ -17,7 +17,7 @@ class Ctx:
     __slots__ = (
         "ds", "session", "txn", "vars", "doc", "doc_id", "parent_doc",
         "executor", "ns", "db", "knn", "record_cache", "deadline",
-        "timeout_dur", "depth",
+        "timeout_dur", "write_version", "depth",
         "perms_enabled", "version", "_cond_consumed", "_cf_seq",
     )
 
@@ -36,6 +36,7 @@ class Ctx:
         self.record_cache: dict = {}
         self.deadline: Optional[float] = None
         self.timeout_dur = None
+        self.write_version = None  # CREATE/INSERT ... VERSION (epoch ns)
         self.depth = 0
         self.perms_enabled = False  # row-level permissions active
         self.version = None  # VERSION clause timestamp
@@ -58,6 +59,7 @@ class Ctx:
         c.record_cache = self.record_cache
         c.deadline = self.deadline
         c.timeout_dur = self.timeout_dur
+        c.write_version = self.write_version
         c.depth = self.depth + 1
         c.perms_enabled = self.perms_enabled
         c.version = self.version

@@ -1023,6 +1023,13 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
     # store (drop tables discard writes but still run the rest)
     if not tdef.drop:
         ctx.txn.set(K.record(ns, db, rid.tb, rid.id), serialize(after))
+        import time as _time
+
+        wts = ctx.write_version or _time.time_ns()
+        ctx.txn.set(
+            K.hist(ns, db, rid.tb, rid.id, wts),
+            serialize(after),
+        )
         ctx.record_cache[(rid.tb, K.enc_value(rid.id))] = after
     gk = (ns, db, rid.tb)
     ctx.ds.graph_versions[gk] = ctx.ds.graph_versions.get(gk, 0) + 1
@@ -1244,6 +1251,10 @@ def delete_one(rid: RecordId, before, output, ctx: Ctx):
     # referenced-record ON DELETE actions run before the record vanishes
     apply_ref_on_delete(rid, ctx)
     ctx.txn.delete(K.record(ns, db, rid.tb, rid.id))
+    import time as _time
+
+    # history tombstone: empty payload marks deletion-at-ts
+    ctx.txn.set(K.hist(ns, db, rid.tb, rid.id, _time.time_ns()), b"")
     ctx.record_cache.pop((rid.tb, K.enc_value(rid.id)), None)
     gk = (ns, db, rid.tb)
     ctx.ds.graph_versions[gk] = ctx.ds.graph_versions.get(gk, 0) + 1

@@ -54,9 +54,51 @@ def generate_record_key(kind: str = "__gen_rand__"):
     return "".join(_random.choices(_ID_CHARS, k=20))
 
 
+def version_ns(v) -> int:
+    """Normalize a VERSION clause value to epoch nanoseconds."""
+    from surrealdb_tpu.val import Datetime, render
+
+    if isinstance(v, Datetime):
+        return v.epoch_ns()
+    if isinstance(v, int) and not isinstance(v, bool):
+        return v
+    raise SdbError(f"Expected a datetime but found {render(v)}")
+
+
+def fetch_record_at(ctx: Ctx, rid: RecordId, ts: int):
+    """The record document as of `ts` (epoch ns) from the version history;
+    NONE when absent or deleted at that time."""
+    from surrealdb_tpu.kvs.api import deserialize
+
+    ns, db = ctx.need_ns_db()
+    best = None
+    for k, raw in ctx.txn.scan(
+        *K.prefix_range(K.hist_record_prefix(ns, db, rid.tb, rid.id))
+    ):
+        ets = int.from_bytes(k[-8:], "big")
+        if ets <= ts:
+            best = raw
+        else:
+            break
+    if best is None or best == b"":
+        return NONE
+    return deserialize(best)
+
+
 def fetch_record(ctx: Ctx, rid: RecordId):
     """Fetch a record document (NONE if missing); caches within a statement.
     Computed fields are evaluated on read (reference doc/compute.rs)."""
+    if ctx.version is not None:
+        ck = (rid.tb, K.enc_value(rid.id), ctx.version)
+        hit = ctx.record_cache.get(ck)
+        if hit is not None:
+            return hit
+        doc = fetch_record_at(ctx, rid, version_ns(ctx.version))
+        if isinstance(doc, dict):
+            ctx.record_cache[ck] = doc
+            doc = apply_computed_fields(rid.tb, doc, rid, ctx)
+        ctx.record_cache[ck] = doc
+        return doc
     ck = (rid.tb, K.enc_value(rid.id))
     hit = ctx.record_cache.get(ck)
     if hit is not None:
@@ -186,9 +228,13 @@ def _e_param(n, ctx):
         return ctx.vars.get("token", NONE)
     if name == "access":
         return ctx.session.ac if ctx.session.ac is not None else NONE
-    # DEFINE PARAM lookup
+    # DEFINE PARAM lookup (as-of under a VERSION clause)
     if ctx.ns and ctx.db:
-        pd = ctx.txn.get_val(K.pa_def(ctx.ns, ctx.db, name))
+        key = K.pa_def(ctx.ns, ctx.db, name)
+        if ctx.version is not None:
+            pd = ctx.txn.get_val_at(key, version_ns(ctx.version))
+        else:
+            pd = ctx.txn.get_val(key)
         if isinstance(pd, ParamDef):
             return pd.value
     return NONE

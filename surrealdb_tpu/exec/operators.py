@@ -117,6 +117,11 @@ def sub(a, b):
     if isinstance(a, Datetime) and isinstance(b, Datetime):
         return Duration(abs(a.epoch_ns() - b.epoch_ns()))
     if isinstance(a, Duration) and isinstance(b, Duration):
+        if b.ns > a.ns:
+            raise SdbError(
+                f'Failed to compute: "{a.render()} - {b.render()}", as '
+                "the operation results in a negative value."
+            )
         return a - b
     if isinstance(a, list) and isinstance(b, list):
         return [x for x in a if not any(value_eq(x, y) for y in b)]
@@ -183,9 +188,25 @@ def pow_(a, b):
     if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
         a, b = _num2(a, b)
         try:
+            if isinstance(a, int) and isinstance(b, int) and b > 0 \
+                    and abs(a) > 1 and b * (abs(a).bit_length() - 1) > 64:
+                # overflow is guaranteed: refuse before materializing a
+                # huge arbitrary-precision integer (reference checked_pow)
+                raise SdbError(
+                    f"Cannot raise the value '{render(a)}' with "
+                    f"'{render(b)}'"
+                )
             r = a ** b
             if isinstance(r, complex):
                 return float("nan")
+            if isinstance(a, int) and isinstance(b, int) and not (
+                -(1 << 63) <= r < (1 << 63)
+            ):
+                # reference i64 checked_pow
+                raise SdbError(
+                    f"Cannot raise the value '{render(a)}' with "
+                    f"'{render(b)}'"
+                )
             return r
         except (OverflowError, ArithmeticError):
             return float("inf")

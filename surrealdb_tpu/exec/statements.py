@@ -284,7 +284,7 @@ def _scan_table(tb: str, ctx, cond=None, stmt=None):
     from surrealdb_tpu.exec.eval import apply_computed_fields, computed_fields_of
     from surrealdb_tpu.idx.planner import plan_scan
 
-    plan = plan_scan(tb, cond, ctx, stmt)
+    plan = plan_scan(tb, cond, ctx, stmt) if ctx.version is None else None
     if plan is not None:
         yield from plan
         return
@@ -292,6 +292,26 @@ def _scan_table(tb: str, ctx, cond=None, stmt=None):
     from surrealdb_tpu.kvs.api import deserialize
 
     has_computed = bool(computed_fields_of(tb, ctx))
+    if ctx.version is not None:
+        # as-of scan over the version history: last entry <= ts per id
+        from surrealdb_tpu.exec.eval import version_ns
+
+        ts = version_ns(ctx.version)
+        hp = K.hist_prefix(ns, db, tb)
+        cur_id = None
+        best = None
+        for k, raw in ctx.txn.scan(*K.prefix_range(hp)):
+            ident = k[len(hp):-8]
+            ets = int.from_bytes(k[-8:], "big")
+            if ident != cur_id:
+                if cur_id is not None and best:
+                    yield _hist_source(tb, cur_id, best, has_computed, ctx)
+                cur_id, best = ident, None
+            if ets <= ts:
+                best = raw
+        if cur_id is not None and best:
+            yield _hist_source(tb, cur_id, best, has_computed, ctx)
+        return
     beg, end = K.prefix_range(K.record_prefix(ns, db, tb))
     for k, raw in ctx.txn.scan(beg, end):
         _ns, _db, _tb, idv = K.decode_record_id(k)
@@ -300,6 +320,21 @@ def _scan_table(tb: str, ctx, cond=None, stmt=None):
         if has_computed:
             doc = apply_computed_fields(tb, doc, rid, ctx)
         yield Source(rid=rid, doc=doc)
+
+
+def _hist_source(tb, ident_enc, raw, has_computed, ctx):
+    from surrealdb_tpu.exec.eval import apply_computed_fields
+    from surrealdb_tpu.kvs.api import deserialize
+
+    doc = deserialize(raw)
+    rid = doc.get("id") if isinstance(doc, dict) else None
+    if not isinstance(rid, RecordId):
+        from surrealdb_tpu.key import dec_value
+
+        rid = RecordId(tb, dec_value(ident_enc)[0])
+    if has_computed:
+        doc = apply_computed_fields(tb, doc, rid, ctx)
+    return Source(rid=rid, doc=doc)
 
 
 def _scan_record_range(v: RecordId, ctx):
@@ -1901,6 +1936,11 @@ def _s_create(n: CreateStmt, ctx: Ctx):
     from surrealdb_tpu.exec.document import create_one
     ctx = _timeout_ctx(n, ctx)
     ctx.check_deadline()
+    if getattr(n, "version", None) is not None:
+        from surrealdb_tpu.exec.eval import version_ns
+
+        ctx = ctx.child()
+        ctx.write_version = version_ns(evaluate(n.version, ctx))
 
     results = []
     for expr in n.what:
@@ -1918,6 +1958,11 @@ def _s_create(n: CreateStmt, ctx: Ctx):
 def _s_insert(n: InsertStmt, ctx: Ctx):
     ctx = _timeout_ctx(n, ctx)
     ctx.check_deadline()
+    if getattr(n, "version", None) is not None:
+        from surrealdb_tpu.exec.eval import version_ns
+
+        ctx = ctx.child()
+        ctx.write_version = version_ns(evaluate(n.version, ctx))
     from surrealdb_tpu.exec.document import insert_one, relate_insert_one
 
     into = None
@@ -3114,6 +3159,27 @@ def _s_rebuild(n: RebuildIndex, ctx: Ctx):
 # ---------------------------------------------------------------------------
 
 
+class _AtTxn:
+    """Read adapter serving catalog definitions as of a timestamp."""
+
+    def __init__(self, txn, ts: int):
+        self._txn = txn
+        self._ts = ts
+
+    def get_val(self, key):
+        return self._txn.get_val_at(key, self._ts)
+
+    def get(self, key):
+        v = self._txn.get_val_at(key, self._ts)
+        return None if v is None else b"\x01"
+
+    def scan_vals(self, beg, end, limit=None, reverse=False):
+        yield from self._txn.scan_vals_at(beg, end, self._ts)
+
+    def __getattr__(self, name):
+        return getattr(self._txn, name)
+
+
 def _s_info(n: InfoStmt, ctx: Ctx):
     from surrealdb_tpu.exec.render_def import (
         render_access,
@@ -3130,6 +3196,12 @@ def _s_info(n: InfoStmt, ctx: Ctx):
         render_user,
     )
 
+    if getattr(n, "version", None) is not None:
+        from surrealdb_tpu.exec.eval import version_ns
+
+        ts = version_ns(evaluate(n.version, ctx))
+        ctx = ctx.child()
+        ctx.txn = _AtTxn(ctx.txn, ts)
     if n.level == "system":
         import os as _os
 

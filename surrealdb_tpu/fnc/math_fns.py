@@ -19,6 +19,74 @@ def _nums(a, fname, keep=False):
     return out
 
 
+def _num_elems(a, fname):
+    """Array argument coerced to numbers; non-numeric elements error
+    (reference Vec<Number> argument coercion)."""
+    from surrealdb_tpu.val import render
+
+    out = []
+    for x in _arr(a, fname, 1):
+        if isinstance(x, bool) or not isinstance(x, (int, float, Decimal)):
+            raise SdbError(
+                f"Incorrect arguments for function {fname}(). Argument 1 "
+                f"was the wrong type. Expected `number` but found "
+                f"`{render(x)}` when coercing an element of `array<number>`"
+            )
+        out.append(x)
+    return out
+
+
+class _RustHeap:
+    """Rust std BinaryHeap layout emulation (push sift-up; pop moves the
+    last element to the root, walks the hole to the bottom along greatest
+    children, then sifts up) so into_vec order matches the reference."""
+
+    def __init__(self, gt):
+        self.a = []
+        self.gt = gt  # strict greater-than in heap order
+
+    def push(self, v):
+        a = self.a
+        a.append(v)
+        i = len(a) - 1
+        while i > 0:
+            p = (i - 1) // 2
+            if self.gt(a[i], a[p]):
+                a[i], a[p] = a[p], a[i]
+                i = p
+            else:
+                break
+
+    def pop(self):
+        a = self.a
+        if not a:
+            return None
+        top = a[0]
+        last = a.pop()
+        if not a:
+            return top
+        # hole starts at root and descends along greatest children
+        hole = 0
+        n = len(a)
+        while 2 * hole + 1 < n:
+            c = 2 * hole + 1
+            if c + 1 < n and self.gt(a[c + 1], a[c]):
+                c += 1
+            a[hole] = a[c]
+            hole = c
+        # place the displaced element and sift it up
+        i = hole
+        a[i] = last
+        while i > 0:
+            p = (i - 1) // 2
+            if self.gt(a[i], a[p]):
+                a[i], a[p] = a[p], a[i]
+                i = p
+            else:
+                break
+        return top
+
+
 def _unary(name, fn):
     @register(f"math::{name}")
     def _f(args, ctx, fn=fn, name=name):
@@ -31,7 +99,10 @@ def _unary(name, fn):
 
 def _abs_checked(v):
     if isinstance(v, int) and v == -(1 << 63):
-        raise SdbError("Cannot calculate the absolute value of this number")
+        raise SdbError(
+            'Failed to compute: "math::abs(-9223372036854775808)", as the '
+            "operation results in an arithmetic overflow."
+        )
     return abs(v)
 
 
@@ -43,11 +114,33 @@ _unary("atan", lambda v: math.atan(v))
 _unary("cos", lambda v: math.cos(v))
 _unary("cot", lambda v: 1 / math.tan(v))
 _unary("deg2rad", lambda v: math.radians(v))
-_unary("ln", lambda v: math.log(v))
-_unary("log10", lambda v: math.log10(v))
-_unary("log2", lambda v: math.log2(v))
+def _logf(fn):
+    def inner(v):
+        v = float(v)
+        if v == 0.0:
+            return float("-inf")
+        if v < 0.0:
+            return float("nan")
+        return fn(v)
+
+    return inner
+
+
+_unary("ln", _logf(math.log))
+_unary("log10", _logf(math.log10))
+_unary("log2", _logf(math.log2))
 _unary("rad2deg", lambda v: math.degrees(v))
-_unary("sign", lambda v: (v > 0) - (v < 0))
+def _signum(v):
+    # floats use f64::signum (reference Number::sign): +-0.0 keep their
+    # sign bit, NaN stays NaN
+    if isinstance(v, float):
+        if math.isnan(v):
+            return v
+        return math.copysign(1.0, v)
+    return (v > 0) - (v < 0)
+
+
+_unary("sign", _signum)
 _unary("sin", lambda v: math.sin(v))
 _unary("sqrt", lambda v: math.sqrt(v))
 _unary("tan", lambda v: math.tan(v))
@@ -60,7 +153,9 @@ def _ceil(args, ctx):
         return v
     if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
         return v
-    return float(math.ceil(v)) if isinstance(v, float) else math.ceil(v)
+    if isinstance(v, Decimal):
+        return v.to_integral_value(rounding="ROUND_CEILING")
+    return float(math.ceil(v))
 
 
 @register("math::floor")
@@ -70,7 +165,9 @@ def _floor(args, ctx):
         return v
     if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
         return v
-    return float(math.floor(v)) if isinstance(v, float) else math.floor(v)
+    if isinstance(v, Decimal):
+        return v.to_integral_value(rounding="ROUND_FLOOR")
+    return float(math.floor(v))
 
 
 @register("math::round")
@@ -101,6 +198,11 @@ def _clamp(args, ctx):
     v = _num(args[0], "math::clamp", 1)
     lo = _num(args[1], "math::clamp", 2)
     hi = _num(args[2], "math::clamp", 3)
+    if lo > hi:
+        raise SdbError(
+            "Incorrect arguments for function math::clamp(). Lowerbound "
+            "for clamp must be smaller than the upperbound"
+        )
     out = max(lo, min(hi, v))
     if isinstance(v, float) and not isinstance(out, float):
         return float(out)
@@ -130,6 +232,8 @@ def _lerpangle(args, ctx):
 def _log(args, ctx):
     v = float(_num(args[0], "math::log", 1))
     base = float(_num(args[1], "math::log", 2))
+    if v == 0.0:
+        return float("-inf")
     try:
         return math.log(v, base)
     except (ValueError, ZeroDivisionError):
@@ -145,14 +249,14 @@ def _pow(args, ctx):
 
 @register("math::max")
 def _mmax(args, ctx):
-    a = _arr(args[0], "math::max", 1)
-    return max(a, key=sort_key) if a else NONE
+    a = _num_elems(args[0], "math::max")
+    return max(a, key=sort_key) if a else float("-inf")
 
 
 @register("math::min")
 def _mmin(args, ctx):
-    a = _arr(args[0], "math::min", 1)
-    return min(a, key=sort_key) if a else NONE
+    a = _num_elems(args[0], "math::min")
+    return min(a, key=sort_key) if a else float("inf")
 
 
 @register("math::sum")
@@ -189,10 +293,10 @@ def _mean(args, ctx):
 def _median(args, ctx):
     ns = sorted(_nums(args[0], "math::median"))
     if not ns:
-        return float("nan")
+        return NONE
     n = len(ns)
     if n % 2:
-        return ns[n // 2]
+        return float(ns[n // 2])
     return (ns[n // 2 - 1] + ns[n // 2]) / 2
 
 
@@ -226,17 +330,19 @@ def _stddev(args, ctx):
 
 @register("math::spread")
 def _spread(args, ctx):
-    ns = _nums(args[0], "math::spread")
+    ns = _nums(args[0], "math::spread", keep=True)
     if not ns:
         return float("nan")
-    return max(ns) - min(ns)
+    from surrealdb_tpu.exec.operators import sub
+
+    return sub(max(ns), min(ns))
 
 
 @register("math::percentile")
 def _percentile(args, ctx):
     ns = sorted(_nums(args[0], "math::percentile"))
     p = float(_num(args[1], "math::percentile", 2))
-    if not ns:
+    if not ns or p < 0.0 or p > 100.0:
         return float("nan")
     if len(ns) == 1:
         return ns[0]
@@ -250,7 +356,7 @@ def _percentile(args, ctx):
 
 @register("math::nearestrank")
 def _nearestrank(args, ctx):
-    ns = sorted(_nums(args[0], "math::nearestrank"))
+    ns = sorted(_nums(args[0], "math::nearestrank", keep=True))
     p = float(_num(args[1], "math::nearestrank", 2))
     if not ns:
         return float("nan")
@@ -280,17 +386,28 @@ def _trimean(args, ctx):
 
 @register("math::top")
 def _top(args, ctx):
-    a = _arr(args[0], "math::top", 1)
     n = int(_num(args[1], "math::top", 2))
     if n < 1:
         raise SdbError("Incorrect arguments for function math::top(). The second argument must be an integer greater than 0.")
-    return sorted(a, key=sort_key)[-n:]
+    a = _num_elems(args[0], "math::top")
+    # min-heap of the k largest (Reverse ordering), reference heap layout
+    h = _RustHeap(lambda x, y: sort_key(x) < sort_key(y))
+    for i, v in enumerate(a):
+        h.push(v)
+        if i >= n:
+            h.pop()
+    return h.a
 
 
 @register("math::bottom")
 def _bottom(args, ctx):
-    a = _arr(args[0], "math::bottom", 1)
     n = int(_num(args[1], "math::bottom", 2))
     if n < 1:
         raise SdbError("Incorrect arguments for function math::bottom(). The second argument must be an integer greater than 0.")
-    return sorted(a, key=sort_key)[:n][::-1]
+    a = _num_elems(args[0], "math::bottom")
+    h = _RustHeap(lambda x, y: sort_key(x) > sort_key(y))
+    for i, v in enumerate(a):
+        h.push(v)
+        if i >= n:
+            h.pop()
+    return h.a

@@ -133,62 +133,168 @@ def password_compare(h: str, pw: str) -> bool:
 # -- parse --------------------------------------------------------------------
 
 
+def _email_parts(s):
+    """RFC-style address validation (reference addr crate): returns
+    (local, host) or None when the address is invalid."""
+    import re as _re
+
+    in_q = False
+    at = -1
+    for i, ch in enumerate(s):
+        if ch == '"':
+            in_q = not in_q
+        elif ch == "@" and not in_q:
+            at = i
+    if in_q or at <= 0 or at == len(s) - 1:
+        return None
+    local, dom = s[:at], s[at + 1:]
+    if local.startswith('"'):
+        if not (local.endswith('"') and len(local) >= 2):
+            return None
+    else:
+        t = local
+        if not t or t[0] == "." or t[-1] == "." or ".." in t:
+            return None
+        if not _re.fullmatch(r"[A-Za-z0-9.!#$%&'*+/=?^_`{|}~-]+", t):
+            return None
+    if dom.startswith("[") and dom.endswith("]"):
+        host = dom[1:-1]
+        # only IPv4 address literals are accepted
+        if not _re.fullmatch(
+            r"(25[0-5]|2[0-4]\d|1\d\d|[1-9]?\d)"
+            r"(\.(25[0-5]|2[0-4]\d|1\d\d|[1-9]?\d)){3}", host
+        ):
+            return None
+        return local, host
+    labels = dom.split(".")
+    for lb in labels:
+        if not lb or lb[0] == "-" or lb[-1] == "-":
+            return None
+        if not _re.fullmatch(r"[A-Za-z0-9-]+", lb):
+            return None
+    return local, dom
+
+
 @register("parse::email::host")
 def _email_host(args, ctx):
-    s = _str(args[0], "f", 1)
-    return s.rsplit("@", 1)[1] if "@" in s else NONE
+    parts = _email_parts(_str(args[0], "parse::email::host", 1))
+    return parts[1] if parts else NONE
 
 
 @register("parse::email::user")
 def _email_user(args, ctx):
-    s = _str(args[0], "f", 1)
-    return s.rsplit("@", 1)[0] if "@" in s else NONE
+    parts = _email_parts(_str(args[0], "parse::email::user", 1))
+    return parts[0] if parts else NONE
 
 
-def _url(args):
-    from urllib.parse import urlparse
+class _UrlNone:
+    """Unparseable URL: every component reads NONE."""
 
-    return urlparse(args[0])
+    hostname = None
+    fragment = ""
+    path = ""
+    query = ""
+    scheme = ""
+    port = None
+
+
+def _url(args, fname):
+    from urllib.parse import quote, urlparse
+
+    from surrealdb_tpu.val import render as _r
+
+    v = args[0]
+    if not isinstance(v, str):
+        raise SdbError(
+            f"Incorrect arguments for function {fname}(). Argument 1 was "
+            f"the wrong type. Expected `string` but found `{_r(v)}`"
+        )
+    try:
+        u = urlparse(v)
+    except ValueError:
+        return _UrlNone()
+    if not u.scheme or not (u.netloc or u.path):
+        return _UrlNone()
+
+    class _U:
+        hostname = u.hostname
+        fragment = u.fragment
+        scheme = u.scheme
+        # WHATWG: special schemes normalize an empty path to "/" and
+        # resolve . / .. segments
+        def _norm_path(pth):
+            if not pth:
+                return ""
+            out = []
+            segs = pth.split("/")
+            for i, seg in enumerate(segs):
+                if seg == ".":
+                    if i == len(segs) - 1:
+                        out.append("")
+                    continue
+                if seg == "..":
+                    if len(out) > 1:
+                        out.pop()
+                    if i == len(segs) - 1:
+                        out.append("")
+                    continue
+                out.append(seg)
+            return "/".join(out)
+
+        path = _norm_path(u.path) or (
+            "/" if u.scheme in ("http", "https", "ws", "wss", "ftp", "file")
+            else ""
+        )
+        # query serializes percent-encoded; existing %XX escapes are
+        # preserved (url crate form serialization)
+        query = quote(u.query, safe="=&,-._~!$*+;:@/?%")
+
+        try:
+            port = u.port
+        except ValueError:
+            port = None
+
+    return _U()
 
 
 @register("parse::url::domain")
 def _url_domain(args, ctx):
-    h = _url(args).hostname
+    h = _url(args, "parse::url::domain").hostname
     return h if h else NONE
 
 
 @register("parse::url::host")
 def _url_host(args, ctx):
-    h = _url(args).hostname
+    h = _url(args, "parse::url::host").hostname
     return h if h else NONE
 
 
 @register("parse::url::fragment")
 def _url_fragment(args, ctx):
-    f = _url(args).fragment
+    f = _url(args, "parse::url::fragment").fragment
     return f if f else NONE
 
 
 @register("parse::url::path")
 def _url_path(args, ctx):
-    return _url(args).path or NONE
+    return _url(args, "parse::url::path").path or NONE
 
 
 @register("parse::url::port")
 def _url_port(args, ctx):
-    p = _url(args).port
+    p = _url(args, "parse::url::port").port
     return p if p is not None else NONE
 
 
 @register("parse::url::query")
 def _url_query(args, ctx):
-    q = _url(args).query
+    q = _url(args, "parse::url::query").query
     return q if q else NONE
 
 
 @register("parse::url::scheme")
 def _url_scheme(args, ctx):
-    s = _url(args).scheme
+    s = _url(args, "parse::url::scheme").scheme
     return s if s else NONE
 
 
@@ -234,16 +340,43 @@ def _bytes_len(args, ctx):
 _EARTH_R = 6371008.8  # meters (mean earth radius)
 
 
-def _pt(v, fname):
+def _as_geom(v):
+    """GeoJSON-shaped objects coerce to geometries in geo:: functions."""
+    if isinstance(v, Geometry):
+        return v
+    if isinstance(v, dict) and isinstance(v.get("type"), str) and \
+            "coordinates" in v:
+        def tup(c):
+            if isinstance(c, list):
+                return tuple(tup(x) for x in c)
+            return c
+
+        return Geometry(v["type"], tup(v["coordinates"]))
+    return v
+
+
+def _pt(v, fname, argn=1):
+    from surrealdb_tpu.val import render
+
+    v = _as_geom(v)
     if isinstance(v, Geometry) and v.kind == "Point":
         return float(v.coords[0]), float(v.coords[1])
-    raise SdbError(f"Incorrect arguments for function {fname}(). Expected a point")
+    if isinstance(v, Geometry) or isinstance(v, dict):
+        return None  # a geometry, just not a point -> NONE result
+    raise SdbError(
+        f"Incorrect arguments for function {fname}(). Argument {argn} was "
+        f"the wrong type. Expected `geometry` but found `{render(v)}`"
+    )
 
 
 @register("geo::distance")
 def _geo_distance(args, ctx):
-    (lon1, lat1) = _pt(args[0], "geo::distance")
-    (lon2, lat2) = _pt(args[1], "geo::distance")
+    a = _pt(args[0], "geo::distance", 1)
+    b = _pt(args[1], "geo::distance", 2)
+    if a is None or b is None:
+        return NONE
+    (lon1, lat1) = a
+    (lon2, lat2) = b
     p1, p2 = math.radians(lat1), math.radians(lat2)
     dp = math.radians(lat2 - lat1)
     dl = math.radians(lon2 - lon1)
@@ -253,22 +386,57 @@ def _geo_distance(args, ctx):
 
 @register("geo::bearing")
 def _geo_bearing(args, ctx):
-    (lon1, lat1) = _pt(args[0], "geo::bearing")
-    (lon2, lat2) = _pt(args[1], "geo::bearing")
+    a = _pt(args[0], "geo::bearing", 1)
+    b = _pt(args[1], "geo::bearing", 2)
+    if a is None or b is None:
+        return NONE
+    (lon1, lat1) = a
+    (lon2, lat2) = b
     p1, p2 = math.radians(lat1), math.radians(lat2)
     dl = math.radians(lon2 - lon1)
     x = math.sin(dl) * math.cos(p2)
     y = math.cos(p1) * math.sin(p2) - math.sin(p1) * math.cos(p2) * math.cos(dl)
-    return (math.degrees(math.atan2(x, y)) + 360) % 360
+    return math.degrees(math.atan2(x, y))
+
+
+def _ring_centroid(ring):
+    """Polygon ring centroid: triangle fan translated to the first vertex
+    (geo crate Centroid — the translation keeps float bits identical)."""
+    pts = [(float(p[0]), float(p[1])) for p in ring]
+    if len(pts) > 1 and pts[0] == pts[-1]:
+        pts = pts[:-1]
+    if len(pts) < 3:
+        return None
+    x0, y0 = pts[0]
+    area = cx = cy = 0.0
+    for i in range(1, len(pts) - 1):
+        dx1, dy1 = pts[i][0] - x0, pts[i][1] - y0
+        dx2, dy2 = pts[i + 1][0] - x0, pts[i + 1][1] - y0
+        a = dx1 * dy2 - dx2 * dy1
+        area += a
+        cx += a * (dx1 + dx2)
+        cy += a * (dy1 + dy2)
+    if area == 0.0:
+        return None
+    return x0 + cx / (3.0 * area), y0 + cy / (3.0 * area)
 
 
 @register("geo::centroid")
 def _geo_centroid(args, ctx):
     from surrealdb_tpu.exec.operators import _points_of
 
-    v = args[0]
+    from surrealdb_tpu.val import render as _r
+
+    v = _as_geom(args[0])
     if not isinstance(v, Geometry):
-        raise SdbError("Incorrect arguments for function geo::centroid(). Expected a geometry")
+        raise SdbError(
+            "Incorrect arguments for function geo::centroid(). Argument 1 "
+            f"was the wrong type. Expected `geometry` but found `{_r(v)}`"
+        )
+    if v.kind == "Polygon" and v.coords:
+        c = _ring_centroid(v.coords[0])
+        if c is not None:
+            return Geometry("Point", c)
     pts = _points_of(v)
     if not pts:
         return NONE
@@ -279,21 +447,32 @@ def _geo_centroid(args, ctx):
 
 @register("geo::area")
 def _geo_area(args, ctx):
-    v = args[0]
+    from surrealdb_tpu.val import render as _r
+
+    v = _as_geom(args[0])
     if not isinstance(v, Geometry):
-        raise SdbError("Incorrect arguments for function geo::area(). Expected a geometry")
+        raise SdbError(
+            "Incorrect arguments for function geo::area(). Argument 1 was "
+            f"the wrong type. Expected `geometry` but found `{_r(v)}`"
+        )
 
     def ring_area(ring):
-        # spherical excess approximation via planar shoelace on lat/lon scaled
-        n = len(ring)
+        # chamberlain-duquette (geo crate): sum over vertices of
+        # rad(x_next - x_prev) * sin(rad(y)), WGS84 equatorial radius
+        pts = [(float(p[0]), float(p[1])) for p in ring]
+        if len(pts) > 1 and pts[0] == pts[-1]:
+            pts = pts[:-1]
+        n = len(pts)
+        if n < 3:
+            return 0.0
         s = 0.0
         for i in range(n):
-            x1, y1 = float(ring[i][0]), float(ring[i][1])
-            x2, y2 = float(ring[(i + 1) % n][0]), float(ring[(i + 1) % n][1])
-            s += math.radians(x2 - x1) * (
-                2 + math.sin(math.radians(y1)) + math.sin(math.radians(y2))
+            x_prev = pts[i - 1][0]
+            x_next = pts[(i + 1) % n][0]
+            s += math.radians(x_next - x_prev) * math.sin(
+                math.radians(pts[i][1])
             )
-        return abs(s) * _EARTH_R * _EARTH_R / 2
+        return abs(s) * 6378137.0 * 6378137.0 / 2
 
     if v.kind == "Polygon":
         area = ring_area(v.coords[0]) if v.coords else 0.0
@@ -312,8 +491,17 @@ _GH32 = "0123456789bcdefghjkmnpqrstuvwxyz"
 
 @register("geo::hash::encode")
 def _geohash_encode(args, ctx):
-    lon, lat = _pt(args[0], "geo::hash::encode")
+    a = _pt(args[0], "geo::hash::encode", 1)
+    if a is None:
+        return NONE
+    lon, lat = a
     precision = int(args[1]) if len(args) > 1 else 12
+    if not 1 <= precision <= 12:
+        raise SdbError(
+            "Incorrect arguments for function geo::hash::encode(). The "
+            "second argument must be an integer greater than 0 and less "
+            "than or equal to 12."
+        )
     lat_r, lon_r = [-90.0, 90.0], [-180.0, 180.0]
     bits, bit, ch = 0, 0, 0
     even = True
@@ -344,7 +532,9 @@ def _geohash_encode(args, ctx):
 
 @register("geo::hash::decode")
 def _geohash_decode(args, ctx):
-    s = _str(args[0], "geo::hash::decode", 1)
+    if not isinstance(args[0], str):
+        return NONE
+    s = args[0]
     lat_r, lon_r = [-90.0, 90.0], [-180.0, 180.0]
     even = True
     for c in s:

@@ -260,6 +260,16 @@ def _dur(v, fname) -> Duration:
     return v
 
 
+_U64 = 1 << 64
+_I64 = 1 << 63
+_MAX_DUR_NS = (_U64 - 1) * 1_000_000_000 + 999_999_999
+
+
+def _wrap_i64(v: int) -> int:
+    """Reference getters cast through `as i64`: two's-complement wrap."""
+    return ((v + _I64) % _U64) - _I64
+
+
 for _name, _unit in (
     ("nanos", 1), ("micros", 1_000), ("millis", 1_000_000),
     ("secs", 1_000_000_000), ("mins", 60 * 1_000_000_000),
@@ -269,10 +279,19 @@ for _name, _unit in (
     def _mk(unit, name):
         @register(f"duration::{name}")
         def _g(args, ctx):
-            return _dur(args[0], f"duration::{name}").ns // unit
+            return _wrap_i64(_dur(args[0], f"duration::{name}").ns // unit)
 
         @register(f"duration::from::{name}")
         def _h(args, ctx):
-            return Duration(int(args[0]) * unit)
+            # argument coerces through u64 (negative ints wrap); the
+            # resulting duration must fit u64 seconds
+            v = int(args[0]) % _U64
+            ns = v * unit
+            if ns > _MAX_DUR_NS:
+                raise SdbError(
+                    f'Failed to compute: "duration::from_{name}({v})", as '
+                    "the operation results in an arithmetic overflow."
+                )
+            return Duration(ns)
 
     _mk(_unit, _name)

@@ -298,6 +298,30 @@ def record_prefix(ns: str, db: str, tb: str) -> bytes:
     return _tb(ns, db, tb) + b"*"
 
 
+# --- record version history (VERSION clause time-travel) -------------------
+
+
+def hist(ns: str, db: str, tb: str, id, ts: int) -> bytes:
+    return _tb(ns, db, tb) + b"%" + enc_value(id) + ts.to_bytes(8, "big")
+
+
+def hist_record_prefix(ns: str, db: str, tb: str, id) -> bytes:
+    return _tb(ns, db, tb) + b"%" + enc_value(id)
+
+
+def hist_prefix(ns: str, db: str, tb: str) -> bytes:
+    return _tb(ns, db, tb) + b"%"
+
+
+def cat_hist(key: bytes, ts: int) -> bytes:
+    """History slot for a catalog definition key (INFO ... VERSION)."""
+    return b"/%" + key + ts.to_bytes(8, "big")
+
+
+def cat_hist_prefix(key: bytes) -> bytes:
+    return b"/%" + key
+
+
 def decode_record_id(key: bytes):
     """Decode `(ns, db, tb, id)` from a record key."""
     pos = 2

@@ -114,6 +114,12 @@ class Transaction:
 
     def delete(self, key: bytes) -> None:
         self.btx.delete(key)
+        if key.startswith(b"/!"):
+            import time
+
+            from surrealdb_tpu import key as K
+
+            self.btx.set(K.cat_hist(key, time.time_ns()), b"")
 
     def exists(self, key: bytes) -> bool:
         return self.btx.exists(key)
@@ -128,6 +134,14 @@ class Transaction:
         return self.btx.count(beg, end)
 
     def delete_range(self, beg, end):
+        if beg.startswith(b"/!"):
+            import time
+
+            from surrealdb_tpu import key as K
+
+            ts = time.time_ns()
+            for k in list(self.btx.keys(beg, end)):
+                self.btx.set(K.cat_hist(k, ts), b"")
         return self.btx.delete_range(beg, end)
 
     # typed ops ------------------------------------------------------------
@@ -137,10 +151,47 @@ class Transaction:
 
     def set_val(self, key: bytes, v) -> None:
         self.btx.set(key, serialize(v))
+        if key.startswith(b"/!"):
+            # catalog definitions keep history for INFO ... VERSION
+            import time
+
+            from surrealdb_tpu import key as K
+
+            self.btx.set(K.cat_hist(key, time.time_ns()), serialize(v))
 
     def scan_vals(self, beg, end, limit=None, reverse=False):
         for k, raw in self.btx.scan(beg, end, limit, reverse):
             yield k, deserialize(raw)
+
+    # versioned catalog reads (INFO ... VERSION) ---------------------------
+    def get_val_at(self, key: bytes, ts: int):
+        from surrealdb_tpu.key import cat_hist_prefix, prefix_range
+
+        best = None
+        for k, raw in self.btx.scan(*prefix_range(cat_hist_prefix(key))):
+            if int.from_bytes(k[-8:], "big") <= ts:
+                best = raw
+            else:
+                break
+        return None if best is None or best == b"" else deserialize(best)
+
+    def scan_vals_at(self, beg, end, ts: int):
+        from surrealdb_tpu.key import cat_hist_prefix
+
+        cur = None
+        best = None
+        for k, raw in self.btx.scan(
+            cat_hist_prefix(beg), cat_hist_prefix(end)
+        ):
+            okey = k[2:-8]
+            if okey != cur:
+                if cur is not None and best is not None and best != b"":
+                    yield cur, deserialize(best)
+                cur, best = okey, None
+            if int.from_bytes(k[-8:], "big") <= ts:
+                best = raw
+        if cur is not None and best is not None and best != b"":
+            yield cur, deserialize(best)
 
     # savepoints -----------------------------------------------------------
     def new_save_point(self):

@@ -687,6 +687,8 @@ class Parser:
             s = InfoStmt("index", name, self.name_expr())
         else:
             raise self.err("expected INFO target")
+        if self.eat_kw("version"):
+            s.version = self.parse_expr()
         if self.eat_kw("structure"):
             s.structure = True
         return s

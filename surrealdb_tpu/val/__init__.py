@@ -427,8 +427,15 @@ class Geometry:
 
     def render(self) -> str:
         if self.kind == "Point":
+            def c(v):
+                # geometry coordinates render without the float suffix
+                f = float(v)
+                if not math.isfinite(f):
+                    return repr(f)
+                return str(int(f)) if f == int(f) else repr(f)
+
             x, y = self.coords
-            return f"({render(float(x))}, {render(float(y))})"
+            return f"({c(x)}, {c(y)})"
         return render(self.to_object())
 
 
