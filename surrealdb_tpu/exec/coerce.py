@@ -87,12 +87,22 @@ def _type_name(v) -> str:
         return "datetime"
     if isinstance(v, Uuid):
         return "uuid"
+    from surrealdb_tpu.val import SSet as _SS
+
+    if isinstance(v, _SS):
+        return "set"
     if isinstance(v, list):
         return "array"
     if isinstance(v, dict):
         return "object"
     if isinstance(v, Geometry):
-        return "geometry"
+        sub = v.kind.lower()
+        sub = {
+            "geometrycollection": "collection",
+            "linestring": "line",
+            "multilinestring": "multiline",
+        }.get(sub, sub)
+        return f"geometry<{sub}>"
     if isinstance(v, (bytes, bytearray)):
         return "bytes"
     if isinstance(v, RecordId):
@@ -491,8 +501,10 @@ def cast(v, kind: Kind):
             try:
                 return list(v.iter_ints())
             except TypeError:
-                pass
-        return [v]
+                raise cast_err(v, kind)
+        if isinstance(v, (bytes, bytearray)):
+            return list(v)
+        raise cast_err(v, kind)
     elif n == "set":
         from surrealdb_tpu.val import SSet
 
@@ -500,16 +512,25 @@ def cast(v, kind: Kind):
             base = v.items
         elif isinstance(v, list):
             base = v
+        elif isinstance(v, (bytes, bytearray)):
+            base = list(v)
         elif isinstance(v, Range):
             try:
                 base = list(v.iter_ints())
             except TypeError:
-                base = [v]
+                raise cast_err(v, kind)
         else:
-            base = [v]
+            raise cast_err(v, kind)
         if kind.inner:
             base = [cast(x, kind.inner[0]) for x in base]
-        return SSet(base)
+        out = SSet(base)
+        if kind.size is not None and len(out.items) != int(kind.size):
+            inner_n = kind_name(kind.inner[0]) if kind.inner else "any"
+            raise SdbError(
+                f"Expected `set<{inner_n},{kind.size}>` but found a "
+                f"collection of length `{len(out.items)}`"
+            )
+        return out
     elif n == "bytes":
         if isinstance(v, str):
             return v.encode("utf-8")

@@ -184,7 +184,17 @@ def _set_path_value(doc, path, v, ctx):
                     _set_path_value(item, path[i + 1 :], v, ctx)
             return
         if isinstance(seg, tuple):
-            idx = int(evaluate(seg[1], ctx))
+            key = evaluate(seg[1], ctx)
+            if isinstance(key, str):
+                if isinstance(cur, dict):
+                    nxt = cur.get(key)
+                    if not isinstance(nxt, (dict, list)):
+                        nxt = {}
+                        cur[key] = nxt
+                    cur = nxt
+                    continue
+                return
+            idx = int(key)
             if isinstance(cur, list) and -len(cur) <= idx < len(cur):
                 cur = cur[idx]
                 continue
@@ -204,7 +214,12 @@ def _set_path_value(doc, path, v, ctx):
                 cur[i] = v
         return
     if isinstance(last, tuple):
-        idx = int(evaluate(last[1], ctx))
+        key = evaluate(last[1], ctx)
+        if isinstance(key, str):
+            if isinstance(cur, dict):
+                cur[key] = v
+            return
+        idx = int(key)
         if isinstance(cur, list) and -len(cur) <= idx < len(cur):
             cur[idx] = v
         return

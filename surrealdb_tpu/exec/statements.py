@@ -500,6 +500,7 @@ def _s_select(n: SelectStmt, ctx: Ctx):
             if not check_table_permission(src.rid.tb, "select", c, src.doc, src.rid):
                 continue
         rows.append(src)
+    n = _expand_field_projections(n, c)
     return _select_pipeline(n, rows, c)
 
 
@@ -625,6 +626,48 @@ def _select_pipeline(n: SelectStmt, rows, c):
 
 def _target_of(n, ctx):
     return None
+
+
+def _expand_field_projections(n, ctx):
+    """type::field()/type::fields() projections expand to the named
+    idioms at execution (reference: functions/type/field suite)."""
+    if n.value is not None or not n.exprs:
+        return n
+    hit = any(
+        isinstance(e, FunctionCall)
+        and e.name in ("type::field", "type::fields")
+        for e, _a in n.exprs if e != "*"
+    )
+    if not hit:
+        return n
+    from surrealdb_tpu.syn.parser import Parser
+    import copy as _copy
+
+    out = []
+    for e, a in n.exprs:
+        if not (isinstance(e, FunctionCall)
+                and e.name in ("type::field", "type::fields")):
+            out.append((e, a))
+            continue
+        v = evaluate(e.args[0], ctx) if e.args else NONE
+        names = v if e.name == "type::fields" else [v]
+        if not isinstance(names, list):
+            raise SdbError(
+                f"Incorrect arguments for function {e.name}(). Argument 1 "
+                f"was the wrong type. Expected `array` but found "
+                f"`{render(names)}`"
+            )
+        for nm in names:
+            if not isinstance(nm, str):
+                raise SdbError(
+                    f"Incorrect arguments for function {e.name}(). "
+                    f"Argument 1 was the wrong type. Expected `string` "
+                    f"but found `{render(nm)}`"
+                )
+            out.append((Idiom(Parser(nm)._field_name_parts()), a))
+    n2 = _copy.copy(n)
+    n2.exprs = out
+    return n2
 
 
 def _expand_omits(omit, ctx):

@@ -89,8 +89,12 @@ def _num(v, fname=None, idx=1):
 
 
 def _int(v, fname=None, idx=1):
+    from decimal import Decimal as _D
+
     if isinstance(v, bool) or not isinstance(v, int):
         if isinstance(v, float) and v.is_integer():
+            return int(v)
+        if isinstance(v, _D) and v == v.to_integral_value():
             return int(v)
         raise ArgError(idx, "int", v)
     return v
@@ -222,6 +226,8 @@ def method_call(val, name, args, ctx):
             candidates.append(f"{fam}::{name}")
             break
     candidates += [f"type::{name}", f"value::{name}", name]
+    if name == "type_of":
+        candidates.insert(0, "type::of")
     # .is_string() style -> type::is::string
     if name.startswith("is_"):
         candidates.insert(0, f"type::is::{name[3:]}")
@@ -312,8 +318,17 @@ def _rand_guid(args, ctx):
 
 @register("rand::int")
 def _rand_int(args, ctx):
+    if len(args) == 1:
+        raise SdbError(
+            "Incorrect arguments for function rand::int(). Expected 0 or "
+            "2 arguments"
+        )
     if len(args) == 2:
-        return _random.randint(int(args[0]), int(args[1]))
+        lo = _int(args[0], "rand::int", 1)
+        hi = _int(args[1], "rand::int", 2)
+        if lo > hi:
+            lo, hi = hi, lo
+        return _random.randint(lo, hi)
     return _random.randint(-(2**63), 2**63 - 1)
 
 
@@ -323,9 +338,13 @@ def _rand_string(args, ctx):
 
     chars = _s.ascii_letters + _s.digits
     if len(args) == 2:
-        n = _random.randint(int(args[0]), int(args[1]))
+        lo = _int(args[0], "rand::string", 1)
+        hi = _int(args[1], "rand::string", 2)
+        if lo > hi:
+            lo, hi = hi, lo
+        n = _random.randint(lo, hi)
     elif len(args) == 1:
-        n = int(args[0])
+        n = _int(args[0], "rand::string", 1)
     else:
         n = 32
     return "".join(_random.choices(chars, k=n))
@@ -335,14 +354,19 @@ def _rand_string(args, ctx):
 def _rand_time(args, ctx):
     import datetime as _dt
 
-    if len(args) == 2 and isinstance(args[0], Datetime):
-        lo, hi = args[0].epoch_ns() // 10**9, args[1].epoch_ns() // 10**9
-    elif len(args) == 2:
-        lo, hi = int(args[0]), int(args[1])
+    def secs(v, i):
+        if isinstance(v, Datetime):
+            return v.epoch_ns() // 10**9
+        return _int(v, "rand::time", i)
+
+    if len(args) == 2:
+        lo, hi = secs(args[0], 1), secs(args[1], 2)
+        if lo > hi:
+            lo, hi = hi, lo
     else:
         lo, hi = 0, 2**31 - 1
-    s = _random.randint(lo, hi)
-    return Datetime(_dt.datetime.fromtimestamp(s, _dt.timezone.utc))
+    s2 = _random.randint(lo, hi)
+    return Datetime(_dt.datetime.fromtimestamp(s2, _dt.timezone.utc))
 
 
 @register("rand::uuid")
@@ -355,8 +379,17 @@ def _rand_uuid4(args, ctx):
     return Uuid.new_v4()
 
 
-@register("rand::uuid::v7")
+@register("rand::uuid::v7", arity=(0, 1))
 def _rand_uuid7(args, ctx):
+    if args and isinstance(args[0], Datetime):
+        import os as _os
+        import uuid as _uuid
+
+        ts = args[0].epoch_ns() // 1_000_000
+        b = bytearray(ts.to_bytes(6, "big") + _os.urandom(10))
+        b[6] = (b[6] & 0x0F) | 0x70
+        b[8] = (b[8] & 0x3F) | 0x80
+        return Uuid(_uuid.UUID(bytes=bytes(b)))
     return Uuid.new_v7()
 
 
@@ -395,6 +428,18 @@ def _rand_id(args, ctx):
 def _rand_ulid(args, ctx):
     from surrealdb_tpu.exec.eval import generate_record_key
 
+    if args and isinstance(args[0], Datetime):
+        import os as _os
+
+        t = args[0].epoch_ns() // 1_000_000
+        rand = int.from_bytes(_os.urandom(10), "big")
+        alph = "0123456789ABCDEFGHJKMNPQRSTVWXYZ"
+        out = []
+        for shift in range(45, -5, -5):
+            out.append(alph[(t >> shift) & 31])
+        for shift in range(75, -5, -5):
+            out.append(alph[(rand >> shift) & 31])
+        return "".join(out)
     return generate_record_key("__gen_ulid__")
 
 
@@ -426,7 +471,7 @@ ARITY.update({
     "array::boolean_and": (2, 2), "array::boolean_or": (2, 2),
     "array::boolean_xor": (2, 2), "array::boolean_not": (1, 1),
     "array::clump": (2, 2), "array::combine": (2, 2),
-    "array::complement": (2, 2), "array::concat": (1, None),
+    "array::complement": (2, 2), "array::concat": (0, None),
     "array::difference": (2, 2), "array::distinct": (1, 1),
     "array::fill": (2, 4), "array::filter": (2, 2),
     "array::filter_index": (2, 2), "array::find": (2, 2),
@@ -438,7 +483,7 @@ ARITY.update({
     "array::logical_or": (2, 2), "array::logical_xor": (2, 2),
     "array::map": (2, 2), "array::matches": (2, 2), "array::max": (1, 1),
     "array::min": (1, 1), "array::pop": (1, 1), "array::prepend": (2, 2),
-    "array::push": (2, 2), "array::range": (2, 2), "array::reduce": (2, 2),
+    "array::push": (2, 2), "array::range": (1, 2), "array::reduce": (2, 2),
     "array::remove": (2, 2), "array::repeat": (2, 2),
     "array::reverse": (1, 1), "array::shuffle": (1, 1),
     "array::slice": (1, 3), "array::sort": (1, 2),
@@ -510,7 +555,7 @@ ARITY.update({
     "crypto::md5": (1, 1), "crypto::sha1": (1, 1), "crypto::sha256": (1, 1),
     "crypto::sha512": (1, 1),
     "parse::email::host": (1, 1), "parse::email::user": (1, 1),
-    "encoding::base64::encode": (1, 1), "encoding::base64::decode": (1, 1),
+    "encoding::base64::encode": (1, 2), "encoding::base64::decode": (1, 1),
     # rand
     "rand::bool": (0, 0), "rand::float": (0, 2), "rand::guid": (0, 2),
     "rand::int": (0, 2), "rand::string": (0, 2), "rand::time": (0, 2),

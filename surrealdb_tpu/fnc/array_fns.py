@@ -135,8 +135,8 @@ def _complement(args, ctx):
 @register("array::concat")
 def _concat(args, ctx):
     out = []
-    for a in args:
-        out.extend(_arr(a, "array::concat"))
+    for i, a in enumerate(args):
+        out.extend(_arr(a, "array::concat", i + 1))
     return out
 
 
@@ -259,7 +259,9 @@ def _insert(args, ctx):
     v = args[1]
     i = int(args[2]) if len(args) > 2 else len(a)
     if i < 0:
-        i += len(a) + 1
+        i += len(a)
+    if not 0 <= i <= len(a):
+        return a  # out-of-bounds insert is a no-op (reference)
     a.insert(i, v)
     return a
 
@@ -300,8 +302,8 @@ def _land(args, ctx):
     n = max(len(a), len(b))
     out = []
     for i in range(n):
-        x = a[i] if i < len(a) else NONE
-        y = b[i] if i < len(b) else NONE
+        x = a[i] if i < len(a) else None
+        y = b[i] if i < len(b) else None
         out.append(y if is_truthy(x) else x)
     return out
 
@@ -312,8 +314,8 @@ def _lor(args, ctx):
     n = max(len(a), len(b))
     out = []
     for i in range(n):
-        x = a[i] if i < len(a) else NONE
-        y = b[i] if i < len(b) else NONE
+        x = a[i] if i < len(a) else None
+        y = b[i] if i < len(b) else None
         out.append(x if is_truthy(x) else y)
     return out
 
@@ -323,16 +325,27 @@ def _lxor(args, ctx):
     a, b = _arr(args[0], "f", 1), _arr(args[1], "f", 2)
     n = max(len(a), len(b))
     out = []
+    # xor: exactly one truthy -> that value; both truthy -> false;
+    # both falsy -> the first operand's value; a missing side yields
+    # the other side's value (reference logical_xor)
     for i in range(n):
-        x = a[i] if i < len(a) else NONE
-        y = b[i] if i < len(b) else NONE
+        if i >= len(a):
+            y = b[i]
+            out.append(y if is_truthy(y) else None)
+            continue
+        if i >= len(b):
+            out.append(a[i])
+            continue
+        x, y = a[i], b[i]
         tx, ty = is_truthy(x), is_truthy(y)
         if tx and not ty:
             out.append(x)
         elif ty and not tx:
             out.append(y)
-        else:
+        elif tx and ty:
             out.append(False)
+        else:
+            out.append(x)
     return out
 
 
@@ -379,11 +392,26 @@ def _push(args, ctx):
 
 @register("array::range")
 def _range(args, ctx):
+    from surrealdb_tpu.val import Range as _Rng
+
+    if len(args) == 1 and isinstance(args[0], _Rng):
+        r = args[0]
+        beg = int(r.beg) + (0 if r.beg_incl else 1)
+        end = int(r.end) + (1 if r.end_incl else 0)
+        if end - beg > 1048576:
+            raise SdbError(
+                "Incorrect arguments for function array::range(). Output "
+                "must not exceed 1048576 bytes."
+            )
+        return list(range(beg, end))
     beg = int(_num(args[0], "array::range", 1))
-    n = int(_num(args[1], "array::range", 2))
-    if n < 0:
-        raise SdbError("Incorrect arguments for function array::range(). The second argument must be a non-negative integer")
-    return list(range(beg, beg + n))
+    end = int(_num(args[1], "array::range", 2))
+    if end - beg > 1048576:
+        raise SdbError(
+            "Incorrect arguments for function array::range(). Output "
+            "must not exceed 1048576 bytes."
+        )
+    return list(range(beg, end))
 
 
 @register("array::reduce")
@@ -394,7 +422,7 @@ def _reduce(args, ctx):
         return NONE
     acc = a[0]
     for i, x in enumerate(a[1:]):
-        acc = _call(clo, [acc, x, i + 1], ctx)
+        acc = _call(clo, [acc, x, i], ctx)
     return acc
 
 
@@ -410,7 +438,35 @@ def _remove(args, ctx):
 @register("array::repeat")
 def _repeat(args, ctx):
     n = int(_num(args[1], "array::repeat", 2))
+    if n < 0:
+        raise SdbError(
+            "Incorrect arguments for function array::repeat(). Expected "
+            "argument 2 to be a positive number"
+        )
+    if n > 1048576:
+        raise SdbError(
+            "Incorrect arguments for function array::repeat(). Output "
+            "must not exceed 1048576 bytes."
+        )
     return [args[0]] * n
+
+
+@register("array::sequence")
+def _sequence(args, ctx):
+    if len(args) > 1:
+        beg = int(_num(args[0], "array::sequence", 1))
+        cnt = int(_num(args[1], "array::sequence", 2))
+    else:
+        beg = 0
+        cnt = int(_num(args[0], "array::sequence", 1))
+    if cnt <= 0:
+        return []
+    if cnt > 1048576:
+        raise SdbError(
+            "Incorrect arguments for function array::sequence(). Output "
+            "must not exceed 1048576 bytes."
+        )
+    return list(range(beg, beg + cnt))
 
 
 @register("array::reverse")
@@ -431,12 +487,14 @@ def _slice(args, ctx):
     beg = int(args[1]) if len(args) > 1 else 0
     n = int(args[2]) if len(args) > 2 else None
     if beg < 0:
-        beg += len(a)
+        beg = max(len(a) + beg, 0)
+    if beg > len(a):
+        return []
     if n is None:
         return a[beg:]
     if n < 0:
         return a[beg : len(a) + n]
-    return a[beg : beg + n]
+    return a[beg:n]
 
 
 @register("array::sort")
@@ -461,19 +519,59 @@ def _sort_desc(args, ctx):
     return _sort([args[0], False], ctx)
 
 
+def _natural_key(s):
+    """Numeric-aware segmentation: '11' sorts after '2'."""
+    import re as _re
+
+    return [
+        (0, int(t)) if t.isdigit() else (1, t)
+        for t in _re.split(r"(\d+)", s)
+        if t != ""
+    ]
+
+
+def _lexical_fold(s):
+    """Case/accent-insensitive collation (lexical_sort crate)."""
+    import unicodedata
+
+    return "".join(
+        c for c in unicodedata.normalize("NFD", s.casefold())
+        if not unicodedata.combining(c)
+    )
+
+
+def _sort_variant(args, ctx, keyfn, name):
+    a = _arr(args[0], name, 1)[:]
+    asc = True
+    if len(args) > 1:
+        v = args[1]
+        if v is False or (isinstance(v, str) and v.lower() == "desc"):
+            asc = False
+    a.sort(
+        key=lambda x: (0, keyfn(x)) if isinstance(x, str)
+        else (1, sort_key(x)),
+        reverse=not asc,
+    )
+    return a
+
+
 @register("array::sort_natural")
 def _sort_natural(args, ctx):
-    return _sort(args, ctx)
+    return _sort_variant(args, ctx, _natural_key, "array::sort_natural")
 
 
 @register("array::sort_lexical")
 def _sort_lexical(args, ctx):
-    return _sort(args, ctx)
+    return _sort_variant(args, ctx, _lexical_fold, "array::sort_lexical")
 
 
 @register("array::sort_natural_lexical")
 def _sort_nl(args, ctx):
-    return _sort(args, ctx)
+    return _sort_variant(
+        args, ctx,
+        lambda x: _natural_key(_lexical_fold(x)),
+        "array::sort_natural_lexical",
+    )
 
 
 @register("array::swap")
@@ -481,12 +579,21 @@ def _swap(args, ctx):
     a = _arr(args[0], "array::swap", 1)[:]
     i, j = int(args[1]), int(args[2])
     n = len(a)
+    i0, j0 = i, j
     if i < 0:
         i += n
     if j < 0:
         j += n
-    if not (0 <= i < n and 0 <= j < n):
-        raise SdbError(f"Incorrect arguments for function array::swap(). Argument 1 is out of range")
+    if not 0 <= i < n:
+        raise SdbError(
+            "Incorrect arguments for function array::swap(). Argument 1 "
+            f"is out of range. Expected a number between -{n} and {n}"
+        )
+    if not 0 <= j < n:
+        raise SdbError(
+            "Incorrect arguments for function array::swap(). Argument 2 "
+            f"is out of range. Expected a number between -{n} and {n}"
+        )
     a[i], a[j] = a[j], a[i]
     return a
 
@@ -502,10 +609,9 @@ def _transpose(args, ctx):
         row = []
         for x in a:
             if isinstance(x, list):
-                if i < len(x):
-                    row.append(x[i])
-            elif i == 0:
-                row.append(x)
+                row.append(x[i] if i < len(x) else NONE)
+            else:
+                row.append(x if i == 0 else NONE)
         out.append(row)
     return out
 

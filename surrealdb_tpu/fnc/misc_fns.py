@@ -307,7 +307,216 @@ def _b64_encode(args, ctx):
 
     v = args[0]
     data = v if isinstance(v, (bytes, bytearray)) else _str(v, "f").encode()
-    return base64.b64encode(bytes(data)).decode().rstrip("=")
+    out = base64.b64encode(bytes(data)).decode()
+    padded = len(args) > 1 and args[1] is True
+    return out if padded else out.rstrip("=")
+
+
+def _to_jsonable(v):
+    from surrealdb_tpu.exec.operators import to_string
+    from surrealdb_tpu.val import SSet
+
+    if v is NONE or v is None:
+        return None
+    if isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, list):
+        return [_to_jsonable(x) for x in v]
+    if isinstance(v, SSet):
+        return [_to_jsonable(x) for x in v.items]
+    if isinstance(v, dict):
+        return {k: _to_jsonable(x) for k, x in v.items()}
+    return to_string(v)
+
+
+def _from_jsonable(v):
+    if v is None:
+        return None
+    if isinstance(v, list):
+        return [_from_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _from_jsonable(x) for k, x in v.items()}
+    return v
+
+
+@register("encoding::json::encode")
+def _json_encode(args, ctx):
+    import json
+
+    return json.dumps(
+        _to_jsonable(args[0]), separators=(",", ":"), ensure_ascii=False
+    )
+
+
+@register("encoding::json::decode")
+def _json_decode(args, ctx):
+    import json
+
+    s2 = _str(args[0], "encoding::json::decode", 1)
+    try:
+        return _from_jsonable(json.loads(s2))
+    except ValueError:
+        raise SdbError(
+            "Incorrect arguments for function encoding::json::decode(). "
+            "Invalid JSON"
+        )
+
+
+def _cbor_encode_val(v, out: bytearray):
+    import struct
+
+    from surrealdb_tpu.val import SSet
+
+    def head(major, n):
+        if n < 24:
+            out.append((major << 5) | n)
+        elif n < 0x100:
+            out.append((major << 5) | 24)
+            out.append(n)
+        elif n < 0x10000:
+            out.append((major << 5) | 25)
+            out.extend(n.to_bytes(2, "big"))
+        elif n < 0x100000000:
+            out.append((major << 5) | 26)
+            out.extend(n.to_bytes(4, "big"))
+        else:
+            out.append((major << 5) | 27)
+            out.extend(n.to_bytes(8, "big"))
+
+    if v is NONE:
+        # NONE is tagged null (tag 6); plain null is SQL NULL
+        out.append(0xC6)
+        out.append(0xF6)
+    elif v is None:
+        out.append(0xF6)
+    elif isinstance(v, bool):
+        out.append(0xF5 if v else 0xF4)
+    elif isinstance(v, int):
+        if v >= 0:
+            head(0, v)
+        else:
+            head(1, -1 - v)
+    elif isinstance(v, float):
+        out.append(0xFB)
+        out.extend(struct.pack(">d", v))
+    elif isinstance(v, str):
+        b = v.encode("utf-8")
+        head(3, len(b))
+        out.extend(b)
+    elif isinstance(v, (bytes, bytearray)):
+        head(2, len(v))
+        out.extend(v)
+    elif isinstance(v, (list, SSet)):
+        items = v.items if isinstance(v, SSet) else v
+        head(4, len(items))
+        for x in items:
+            _cbor_encode_val(x, out)
+    elif isinstance(v, dict):
+        head(5, len(v))
+        for k, x in v.items():
+            _cbor_encode_val(k, out)
+            _cbor_encode_val(x, out)
+    else:
+        from surrealdb_tpu.exec.operators import to_string
+
+        _cbor_encode_val(to_string(v), out)
+
+
+def _cbor_decode_val(b: bytes, pos: int):
+    import struct
+
+    ib = b[pos]
+    major, info = ib >> 5, ib & 0x1F
+    pos += 1
+    if info < 24:
+        n = info
+    elif info == 24:
+        n = b[pos]
+        pos += 1
+    elif info == 25:
+        n = int.from_bytes(b[pos:pos + 2], "big")
+        pos += 2
+    elif info == 26:
+        n = int.from_bytes(b[pos:pos + 4], "big")
+        pos += 4
+    elif info == 27:
+        n = int.from_bytes(b[pos:pos + 8], "big")
+        pos += 8
+    else:
+        # indefinite lengths / reserved additional-info are unsupported
+        raise SdbError(
+            "Incorrect arguments for function encoding::cbor::decode(). "
+            "Invalid CBOR input"
+        )
+    if major == 0:
+        return n, pos
+    if major == 1:
+        return -1 - n, pos
+    if major == 2:
+        return bytes(b[pos:pos + n]), pos + n
+    if major == 3:
+        return b[pos:pos + n].decode("utf-8"), pos + n
+    if major == 4:
+        out = []
+        for _ in range(n):
+            v, pos = _cbor_decode_val(b, pos)
+            out.append(v)
+        return out, pos
+    if major == 5:
+        out = {}
+        for _ in range(n):
+            k, pos = _cbor_decode_val(b, pos)
+            v, pos = _cbor_decode_val(b, pos)
+            out[k if isinstance(k, str) else str(k)] = v
+        return out, pos
+    if major == 6:
+        v, pos = _cbor_decode_val(b, pos)
+        if n == 6:
+            return NONE, pos
+        return v, pos
+    # major 7: simple / float
+    if info == 20:
+        return False, pos
+    if info == 21:
+        return True, pos
+    if info in (22, 23):
+        return None, pos
+    if info == 27:
+        return struct.unpack(">d", b[pos - 8:pos])[0], pos
+    if info == 26:
+        return struct.unpack(">f", b[pos - 4:pos])[0], pos
+    raise SdbError(
+        "Incorrect arguments for function encoding::cbor::decode(). "
+        "Invalid CBOR input"
+    )
+
+
+@register("encoding::cbor::encode")
+def _cbor_encode(args, ctx):
+    out = bytearray()
+    _cbor_encode_val(args[0], out)
+    return bytes(out)
+
+
+@register("encoding::cbor::decode")
+def _cbor_decode(args, ctx):
+    v = args[0]
+    if not isinstance(v, (bytes, bytearray)):
+        from surrealdb_tpu.val import render as _r
+
+        raise SdbError(
+            "Incorrect arguments for function encoding::cbor::decode(). "
+            f"Argument 1 was the wrong type. Expected `bytes` but found "
+            f"`{_r(v)}`"
+        )
+    try:
+        out, _pos = _cbor_decode_val(bytes(v), 0)
+        return out
+    except (IndexError, UnicodeDecodeError):
+        raise SdbError(
+            "Incorrect arguments for function encoding::cbor::decode(). "
+            "Invalid CBOR input"
+        )
 
 
 @register("encoding::base64::decode")

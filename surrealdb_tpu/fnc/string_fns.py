@@ -91,8 +91,15 @@ def _replace(args, ctx):
     old = args[1]
     new = _str(args[2], "string::replace", 3) if len(args) > 2 else ""
     if isinstance(old, Regex):
-        return old.rx.sub(new, s)
-    return s.replace(_str(old, "string::replace"), new)
+        out = old.rx.sub(new, s)
+    else:
+        out = s.replace(_str(old, "string::replace"), new)
+    if len(out.encode()) > 1048576 and len(out) > len(s):
+        raise SdbError(
+            "Incorrect arguments for function string::replace(). Output "
+            "must not exceed 1048576 bytes."
+        )
+    return out
 
 
 @register("string::reverse")
@@ -174,7 +181,7 @@ def _is(name, fn):
     @register(f"string::is::{name}")
     def _f(args, ctx, fn=fn):
         v = args[0]
-        if not isinstance(v, str):
+        if not isinstance(v, str) or v == "":
             return False
         return fn(v)
 

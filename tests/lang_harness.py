@@ -138,6 +138,8 @@ def run_lang_test(t: LangTest, ds=None):
     for i, (got, want) in enumerate(zip(res, t.results)):
         if isinstance(want, str):
             want = {"value": want}
+        if want.get("error") is False:
+            want = {k: v for k, v in want.items() if k != "error"}
         if "error" in want:
             err = want["error"]
             if got.error is None:
