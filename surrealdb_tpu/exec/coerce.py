@@ -492,11 +492,25 @@ def cast(v, kind: Kind):
     elif n == "array":
         from surrealdb_tpu.val import SSet as _SSet
 
+        def _len_check(out):
+            if kind.size is not None and len(out) != int(kind.size):
+                inner_n = kind_name(kind.inner[0]) if kind.inner else "any"
+                raise SdbError(
+                    f"Expected `array<{inner_n},{kind.size}>` but found a "
+                    f"collection of length `{len(out)}`"
+                )
+            return out
+
         if isinstance(v, list):
-            return [cast(x, kind.inner[0]) for x in v] if kind.inner else v
+            return _len_check(
+                [cast(x, kind.inner[0]) for x in v] if kind.inner else v
+            )
         if isinstance(v, _SSet):
             items = list(v.items)
-            return [cast(x, kind.inner[0]) for x in items] if kind.inner else items
+            return _len_check(
+                [cast(x, kind.inner[0]) for x in items]
+                if kind.inner else items
+            )
         if isinstance(v, Range):
             try:
                 return list(v.iter_ints())

@@ -61,7 +61,7 @@ def apply_data(doc: dict, data, ctx: Ctx, rid=None):
     if isinstance(data, (ContentData, ReplaceData)):
         v = evaluate(data.expr, ctx)
         if not isinstance(v, dict):
-            raise SdbError(f"Cannot use {render(v)} as CONTENT data")
+            raise SdbError(f"Cannot use {render(v)} in a CONTENT clause")
         out = copy_value(v)
         if "id" not in out and "id" in doc:
             out["id"] = doc["id"]
@@ -90,7 +90,11 @@ def apply_data(doc: dict, data, ctx: Ctx, rid=None):
             v = evaluate(expr, c)
             path = _idiom_path(target)
             if op == "=":
-                _set_path_value(out, path, v, ctx)
+                if v is NONE:
+                    # assigning NONE removes the field (reference SET)
+                    _del_path_value(out, path)
+                else:
+                    _set_path_value(out, path, v, ctx)
             elif op == "+=":
                 cur = _get_path_value(out, path)
                 _set_path_value(out, path, _add_assign(cur, v), ctx)

@@ -135,12 +135,25 @@ def _expr_sql(node) -> str:
                 body = body.stmt
         return f"|{ps}|{ret} {_expr_sql(body)}"
     if isinstance(node, IfElse):
+        bodies = [b for _c, b in node.branches]
+        if node.otherwise is not None:
+            bodies.append(node.otherwise)
+        blocky = all(
+            isinstance(b, BlockExpr)
+            or (isinstance(b, Subquery) and isinstance(b.stmt, BlockExpr))
+            for b in bodies
+        )
         out = []
         for i, (cond, body) in enumerate(node.branches):
             kw = "IF" if i == 0 else "ELSE IF"
-            out.append(f"{kw} {_expr_sql(cond)} {_expr_sql(body)}")
+            if blocky:
+                out.append(f"{kw} {_expr_sql(cond)} {_expr_sql(body)}")
+            else:
+                out.append(f"{kw} {_expr_sql(cond)} THEN {_expr_sql(body)}")
         if node.otherwise is not None:
             out.append(f"ELSE {_expr_sql(node.otherwise)}")
+        if not blocky:
+            out.append("END")
         return " ".join(out)
     if isinstance(node, Mock):
         if node.end is not None:
@@ -530,6 +543,10 @@ def index_structure(d) -> dict:
 
 def render_event(d, tb) -> str:
     def wrap(t):
+        from surrealdb_tpu.expr.ast import BlockExpr as _Blk, Subquery as _Sub
+
+        if isinstance(t, _Sub) and isinstance(t.stmt, _Blk):
+            t = t.stmt
         x = _expr_sql(t)
         return x if x.startswith(("(", "{")) else f"({x})"
 
@@ -665,12 +682,18 @@ def render_access(d) -> str:
         return v.render() if isinstance(v, Duration) else str(v)
 
     dur = d.duration or {}
+
+    def slot(name, dflt):
+        if name in dur:
+            return _dur(dur[name], None)
+        return _dur(None, dflt)
+
     out += " DURATION"
     if d.kind == "bearer":
-        out += f" FOR GRANT {_dur(dur.get('grant'), Duration.parse('30d'))},"
+        out += f" FOR GRANT {slot('grant', Duration.parse('30d'))},"
     if d.kind in ("jwt", "record", "bearer"):
-        out += f" FOR TOKEN {_dur(dur.get('token'), Duration.parse('1h'))},"
-    out += f" FOR SESSION {_dur(dur.get('session'), None)}"
+        out += f" FOR TOKEN {slot('token', Duration.parse('1h'))},"
+    out += f" FOR SESSION {slot('session', None)}"
     if d.comment:
         out += f" COMMENT {_str_sql(d.comment)}"
     return out
@@ -716,7 +739,9 @@ def render_api(d) -> str:
     out = f"DEFINE API {escape_string(d.path)}"
     from surrealdb_tpu.catalog import ApiActionDef
 
-    actions = d.actions or [ApiActionDef(methods=["any"])]
+    actions = list(d.actions or [])
+    if not any("any" in a.methods for a in actions):
+        actions.insert(0, ApiActionDef(methods=["any"]))
     for a in actions:
         out += " FOR " + ", ".join(a.methods)
         if a.middleware:
@@ -739,10 +764,10 @@ def render_api(d) -> str:
 
 def render_bucket(d) -> str:
     out = f"DEFINE BUCKET {escape_ident(d.name)}"
-    if d.backend:
-        out += f" BACKEND {_str_sql(d.backend)}"
     if d.readonly:
         out += " READONLY"
+    if d.backend:
+        out += f" BACKEND {_str_sql(d.backend)}"
     out += f" PERMISSIONS {_perm_value_sql(d.permissions)}"
     if d.comment:
         out += f" COMMENT {_str_sql(d.comment)}"

@@ -2101,10 +2101,7 @@ def _s_upsert(n: UpsertStmt, ctx: Ctx):
             if isinstance(t, RecordId) and not isinstance(t.id, Range):
                 doc = fetch_record(ctx, t)
                 if doc is NONE:
-                    if n.cond is not None:
-                        c = ctx.with_doc({}, t)
-                        if not is_truthy(evaluate(n.cond, c)):
-                            continue
+                    # a missing record is created regardless of WHERE
                     results.append(create_one(t, n.data, n.output, ctx, upsert=True))
                 else:
                     if n.cond is not None:
@@ -2124,7 +2121,7 @@ def _s_upsert(n: UpsertStmt, ctx: Ctx):
                     results.append(
                         update_one(src.rid, src.doc, n.data, n.output, ctx)
                     )
-                if not matched and n.cond is None:
+                if not matched:
                     results.append(
                         create_one(t, n.data, n.output, ctx, upsert=True)
                     )
@@ -2222,15 +2219,24 @@ def _ensure_ns_db(ctx: Ctx):
         ctx.txn.set_val(K.db_def(ns, db), DatabaseDef(db))
 
 
-def _exists_guard(ctx, key, name, kind, if_not_exists, overwrite):
+def _exists_guard(ctx, key, name, kind, if_not_exists, overwrite,
+                  msg=None):
     if ctx.txn.get(key) is not None:
         if if_not_exists:
             return True  # skip silently
         if not overwrite:
             raise SdbError(
-                f"The {kind} '{name}' already exists"
+                msg or f"The {kind} '{name}' already exists"
             )
     return False
+
+
+def _base_phrase(base, ctx):
+    if base == "root":
+        return "in the root"
+    if base == "ns":
+        return f"in the namespace '{ctx.session.ns}'"
+    return f"in the database '{ctx.session.db}'"
 
 
 def _s_define_ns(n: DefineNamespace, ctx):
@@ -2714,7 +2720,9 @@ def _s_define_function(n: DefineFunction, ctx):
     _ensure_ns_db(ctx)
     ns, db = ctx.need_ns_db()
     kdef = K.fc_def(ns, db, n.name)
-    if _exists_guard(ctx, kdef, n.name, "function", n.if_not_exists, n.overwrite):
+    if _exists_guard(ctx, kdef, n.name, "function", n.if_not_exists,
+                     n.overwrite,
+                     msg=f"The function 'fn::{n.name}' already exists"):
         return NONE
     ctx.txn.set_val(
         kdef,
@@ -2758,7 +2766,11 @@ def _s_define_access(n: DefineAccess, ctx):
     ns = ctx.session.ns if base in ("ns", "db") else None
     db = ctx.session.db if base == "db" else None
     kdef = K.ac_def(base, ns, db, n.name)
-    if _exists_guard(ctx, kdef, n.name, "access", n.if_not_exists, n.overwrite):
+    if _exists_guard(
+        ctx, kdef, n.name, "access", n.if_not_exists, n.overwrite,
+        msg=(f"The access method '{n.name}' already exists "
+             f"{_base_phrase(base, ctx)}"),
+    ):
         return NONE
     ctx.txn.set_val(
         kdef, AccessDef(n.name, base, n.kind, n.config, n.duration, n.comment)
@@ -2952,7 +2964,7 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
         return NONE
     if kind == "function":
         key = K.fc_def(ns, db, n.name)
-        if _guard(key, n.name):
+        if _guard(key, f"fn::{n.name}"):
             return NONE
         ctx.txn.delete(key)
         return NONE
@@ -2978,8 +2990,13 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
         base = n.base or "db"
         key = K.ac_def(base, ns if base in ("ns", "db") else None,
                        db if base == "db" else None, n.name)
-        if _guard(key, n.name):
-            return NONE
+        if ctx.txn.get(key) is None:
+            if n.if_exists:
+                return NONE
+            raise SdbError(
+                f"The access method '{n.name}' does not exist "
+                f"{_base_phrase(base, ctx)}"
+            )
         ctx.txn.delete(key)
         return NONE
     if kind == "sequence":
@@ -2996,6 +3013,10 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
         if ctx.txn.get(key) is None:
             if n.if_exists:
                 return NONE
+            if kind == "config":
+                raise SdbError(
+                    f"The config for {n.name.lower()} does not exist"
+                )
             raise SdbError(f"The {kind} '{nm}' does not exist")
         ctx.txn.delete(key)
         return NONE
@@ -3136,8 +3157,13 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
     if stored is None:
         if n.if_exists:
             return NONE
+        disp = n.name
+        if kind == "function":
+            disp = f"fn::{disp}"
+        elif kind == "param":
+            disp = f"${disp}"
         raise SdbError(
-            f"The {labels.get(kind, kind)} '{n.name}' does not exist"
+            f"The {labels.get(kind, kind)} '{disp}' does not exist"
         )
     d = stored[0] if kind == "sequence" else stored
     for clause, value in n.changes:

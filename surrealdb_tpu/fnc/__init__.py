@@ -341,13 +341,22 @@ def _rand_string(args, ctx):
         lo = _int(args[0], "rand::string", 1)
         hi = _int(args[1], "rand::string", 2)
         if lo > hi:
-            lo, hi = hi, lo
+            raise SdbError(
+                "Incorrect arguments for function rand::string(). "
+                "Lowerbound of number of characters must be less then "
+                "the upperbound."
+            )
         n = _random.randint(lo, hi)
     elif len(args) == 1:
         n = _int(args[0], "rand::string", 1)
     else:
         n = 32
-    return "".join(_random.choices(chars, k=n))
+    if n > 65536:
+        raise SdbError(
+            "Incorrect arguments for function rand::string(). Number of "
+            "characters must not exceed 65536."
+        )
+    return "".join(_random.choices(chars, k=max(n, 0)))
 
 
 @register("rand::time")
@@ -364,7 +373,8 @@ def _rand_time(args, ctx):
         if lo > hi:
             lo, hi = hi, lo
     else:
-        lo, hi = 0, 2**31 - 1
+        # reference default spans years 0000-9999
+        lo, hi = -62167219200, 253402300799
     s2 = _random.randint(lo, hi)
     return Datetime(_dt.datetime.fromtimestamp(s2, _dt.timezone.utc))
 

@@ -396,6 +396,15 @@ def _range(args, ctx):
 
     if len(args) == 1 and isinstance(args[0], _Rng):
         r = args[0]
+        if not isinstance(r.beg, int) or not isinstance(r.end, int) or \
+                isinstance(r.beg, bool) or isinstance(r.end, bool):
+            from surrealdb_tpu.val import render as _r2
+
+            raise SdbError(
+                "Incorrect arguments for function array::range(). "
+                "Argument 1 was the wrong type. Expected `range<int>` "
+                f"but found `{_r2(r)}`"
+            )
         beg = int(r.beg) + (0 if r.beg_incl else 1)
         end = int(r.end) + (1 if r.end_incl else 0)
         if end - beg > 1048576:
@@ -547,11 +556,19 @@ def _sort_variant(args, ctx, keyfn, name):
         v = args[1]
         if v is False or (isinstance(v, str) and v.lower() == "desc"):
             asc = False
-    a.sort(
-        key=lambda x: (0, keyfn(x)) if isinstance(x, str)
-        else (1, sort_key(x)),
-        reverse=not asc,
-    )
+    import functools
+
+    from surrealdb_tpu.val import value_cmp
+
+    def cmp(x, y):
+        # string pairs use the variant collation; any other pair falls
+        # back to value order (reference natural_cmp partial_cmp)
+        if isinstance(x, str) and isinstance(y, str):
+            kx, ky = keyfn(x), keyfn(y)
+            return -1 if kx < ky else (1 if kx > ky else 0)
+        return value_cmp(x, y)
+
+    a.sort(key=functools.cmp_to_key(cmp), reverse=not asc)
     return a
 
 

@@ -422,24 +422,41 @@ def _cbor_encode_val(v, out: bytearray):
         _cbor_encode_val(to_string(v), out)
 
 
+def _cbor_invalid():
+    return SdbError(
+        "Incorrect arguments for function encoding::cbor::decode(). "
+        "Invalid CBOR input"
+    )
+
+
 def _cbor_decode_val(b: bytes, pos: int):
     import struct
 
+    def take(k):
+        if pos + k > len(b):
+            raise _cbor_invalid()
+
+    if pos >= len(b):
+        raise _cbor_invalid()
     ib = b[pos]
     major, info = ib >> 5, ib & 0x1F
     pos += 1
     if info < 24:
         n = info
     elif info == 24:
+        take(1)
         n = b[pos]
         pos += 1
     elif info == 25:
+        take(2)
         n = int.from_bytes(b[pos:pos + 2], "big")
         pos += 2
     elif info == 26:
+        take(4)
         n = int.from_bytes(b[pos:pos + 4], "big")
         pos += 4
     elif info == 27:
+        take(8)
         n = int.from_bytes(b[pos:pos + 8], "big")
         pos += 8
     else:
@@ -453,8 +470,10 @@ def _cbor_decode_val(b: bytes, pos: int):
     if major == 1:
         return -1 - n, pos
     if major == 2:
+        take(n)
         return bytes(b[pos:pos + n]), pos + n
     if major == 3:
+        take(n)
         return b[pos:pos + n].decode("utf-8"), pos + n
     if major == 4:
         out = []

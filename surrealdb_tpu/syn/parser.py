@@ -234,10 +234,12 @@ class Parser:
             if self.eat_kw("then"):  # legacy syntax
                 body = self.parse_stmt()
                 branches.append((cond, body))
+                self.eat_op(";")
                 if self.eat_kw("else"):
                     if self.eat_kw("if"):
                         continue
                     otherwise = self.parse_stmt()
+                    self.eat_op(";")
                 self.eat_kw("end")
                 break
             body = self._parse_block()

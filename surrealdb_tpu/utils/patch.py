@@ -47,9 +47,25 @@ def diff(a, b, path="") -> list:
     return ops
 
 
+def _apply_str_change(payload: str) -> str:
+    """New-string side of a _str_change unified-diff payload."""
+    out = []
+    for line in payload.split("\n"):
+        if line.startswith("+"):
+            out.append(line[1:])
+    return "\n".join(out)
+
+
 def _str_change(a: str, b: str) -> str:
-    # unified-diff-ish single-line change payload (reference emits text diff)
-    return b
+    """Line-based unified diff payload (reference dmp-style text diff)."""
+    al = a.split("\n")
+    bl = b.split("\n")
+    out = [f"@@ -1,{len(al)} +1,{len(bl)} @@"]
+    for line in al:
+        out.append(f"-{line}")
+    for line in bl:
+        out.append(f"+{line}")
+    return "\n".join(out) + "\n"
 
 
 def _walk_to(doc, segs):
@@ -89,10 +105,14 @@ def apply_patch(doc, ops):
             else:
                 parent[last] = copy_value(op.get("value"))
         elif kind in ("replace", "change"):
+            val = op.get("value")
+            if kind == "change" and isinstance(val, str) and \
+                    val.startswith("@@"):
+                val = _apply_str_change(val)
             if isinstance(parent, list):
-                parent[int(last)] = copy_value(op.get("value"))
+                parent[int(last)] = copy_value(val)
             else:
-                parent[last] = copy_value(op.get("value"))
+                parent[last] = copy_value(val)
         elif kind == "remove":
             if isinstance(parent, list):
                 idx = int(last)
