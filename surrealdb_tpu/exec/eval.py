@@ -1013,6 +1013,8 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
         last_frontier = []
         depth = 0
 
+        start_keys = {hashable(x) for x in start_items}
+
         def path_to(x, include_self=True):
             p = [x] if include_self else []
             cur = parent.get(hashable(x))
@@ -1020,6 +1022,9 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
                 p.append(cur)
                 cur = parent.get(hashable(cur))
             p.reverse()
+            # the subject itself is not part of the path unless +inclusive
+            if p and hashable(p[0]) in start_keys:
+                p = p[1:]
             return p
 
         while depth < rmax and frontier:
@@ -1057,7 +1062,9 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
     # ---- collect: BFS union with visited set (the subject itself may be
     # rediscovered through a cycle and collected) --------------------------
     if mode == "collect":
-        visited = set()
+        visited = (
+            {hashable(x) for x in start_items} if inclusive else set()
+        )
         collected = []
         frontier = list(start_items)
         depth = 0
@@ -1153,15 +1160,12 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
         return out
     if depth < rmin:
         return [] if was_list else NONE
-    if part.max is None:
-        # fully unbounded `{..}`: walk to exhaustion, final frontier
-        out = last_nonempty
-        if not was_list:
-            return out[0] if out else NONE
-        return out
+    # ranges return the final (deepest non-empty) frontier — bounded and
+    # unbounded alike (reference: depth_range suite)
+    out = last_nonempty
     if not was_list:
-        return union[-1] if union else NONE
-    return union
+        return out[0] if out else NONE
+    return out
 
 
 # ---------------------------------------------------------------------------

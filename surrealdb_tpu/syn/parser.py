@@ -2281,14 +2281,40 @@ class Parser:
                     what.append((name, rng))
                 if not self.eat_op(","):
                     break
+            order = limit = start = None
             while True:
                 if self.eat_kw("where"):
                     cond = self.parse_expr()
                 elif self.eat_kw("as"):
                     alias = self._alias_idiom()
+                elif self.eat_kw("order"):
+                    self.eat_kw("by")
+                    order = [self._order_item()]
+                    while self.eat_op(","):
+                        order.append(self._order_item())
+                elif self.eat_kw("limit"):
+                    self.eat_kw("by")
+                    limit = self.parse_expr()
+                elif self.eat_kw("start"):
+                    self.eat_kw("at")
+                    start = self.parse_expr()
                 else:
                     break
             self.expect_op(")")
+            if order is not None or limit is not None or start is not None:
+                # clause shorthand lowers to a subquery over the edge table
+                sel = SelectStmt()
+                sel.exprs = [("*", None)]
+                sel.what = [
+                    Idiom([PField(nm)]) for nm, _rng in what
+                ]
+                sel.cond = cond
+                sel.order = order or []
+                sel.limit = limit
+                sel.start = start
+                g = PGraph(direction, [], None, alias)
+                g.expr = sel
+                return g
         else:
             name = self.ident_or_str()
             rng = None
