@@ -25,6 +25,9 @@ def main(argv=None):
     p_start.add_argument("--path", default="memory")
     p_start.add_argument("--user", default=None)
     p_start.add_argument("--pass", dest="passwd", default=None)
+    p_start.add_argument(
+        "--unauthenticated", action="store_true",
+        help="allow anonymous connections full access (dev mode)")
 
     p_sql = sub.add_parser("sql", help="interactive REPL")
     p_sql.add_argument("--path", default="memory")
@@ -96,7 +99,11 @@ def main(argv=None):
             ds.execute(
                 f"DEFINE USER {args.user} ON ROOT PASSWORD '{args.passwd}' ROLES OWNER"
             )
-        serve(ds, host or "127.0.0.1", int(port or 8000))
+        elif not args.unauthenticated:
+            print("no --user/--pass given and --unauthenticated not set: "
+                  "anonymous connections have no access")
+        serve(ds, host or "127.0.0.1", int(port or 8000),
+              unauthenticated=args.unauthenticated)
         return 0
 
     if args.cmd == "sql":

@@ -37,6 +37,15 @@ def _secret(ds) -> bytes:
     return sec
 
 
+def _level_from_roles(roles) -> str:
+    roles = {str(r).lower() for r in (roles or ())}
+    if "owner" in roles:
+        return "owner"
+    if "editor" in roles:
+        return "editor"
+    return "viewer"
+
+
 def issue_token(ds, claims: dict, ttl_s: int = 3600) -> str:
     header = {"alg": "HS256", "typ": "JWT"}
     now = int(time.time())
@@ -83,16 +92,15 @@ def signin(ds, session: Session, creds: dict) -> str:
                     continue
                 ud = txn.get_val(K.us_def(base, n, d, user))
                 if ud is not None and password_compare(ud.passhash, passwd or ""):
-                    session.auth_level = (
-                        "owner" if "Owner" in ud.roles else
-                        "editor" if "Editor" in ud.roles else "viewer"
-                    )
+                    session.auth_level = _level_from_roles(ud.roles)
                     if n:
                         session.ns = n
                     if d:
                         session.db = d
                     return issue_token(
-                        ds, {"ID": user, "base": base, "NS": n, "DB": d}
+                        ds,
+                        {"ID": user, "base": base, "NS": n, "DB": d,
+                         "roles": list(ud.roles)},
                     )
             raise SdbError(
                 "There was a problem with authentication"
@@ -170,9 +178,22 @@ def authenticate(ds, session: Session, token: str):
         session.rid = static_value(parse_record_literal(payload["ID"]))
     else:
         base = payload.get("base", "root")
-        session.auth_level = "owner" if base else "owner"
-        if payload.get("NS"):
-            session.ns = payload["NS"]
-        if payload.get("DB"):
-            session.db = payload["DB"]
+        n, d = payload.get("NS"), payload.get("DB")
+        if not payload.get("ID"):
+            raise SdbError("There was a problem with authentication")
+        # re-verify the system user still exists and derive the level from
+        # its *current* roles (reference re-resolves the user on every
+        # authenticate — a deleted or demoted user must not keep access)
+        txn = ds.transaction(write=False)
+        try:
+            ud = txn.get_val(K.us_def(base, n, d, payload.get("ID")))
+        finally:
+            txn.cancel()
+        if ud is None:
+            raise SdbError("There was a problem with authentication")
+        session.auth_level = _level_from_roles(ud.roles)
+        if n:
+            session.ns = n
+        if d:
+            session.db = d
     return NONE

@@ -18,7 +18,7 @@ from surrealdb_tpu.kvs.api import Transaction
 class Session:
     """Per-connection session (reference: dbs/session.rs)."""
 
-    def __init__(self, ns=None, db=None, auth_level="owner", rid=None, ac=None):
+    def __init__(self, ns=None, db=None, auth_level="none", rid=None, ac=None):
         self.ns = ns
         self.db = db
         self.auth_level = auth_level  # owner | editor | viewer | record | none
@@ -157,7 +157,9 @@ class Datastore:
 
         from surrealdb_tpu.err import ParseError
 
-        sess = session or Session(ns=ns, db=db)
+        # embedded convenience path: a caller holding the Datastore object
+        # has root access by construction (like the reference's local engine)
+        sess = session or Session(ns=ns, db=db, auth_level="owner")
         if ns is not None:
             sess.ns = ns
         if db is not None:

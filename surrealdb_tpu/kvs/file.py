@@ -2,20 +2,25 @@
 
 Fills the reference's rocksdb/surrealkv role (persistent embedded engine) in
 a dependency-free way: commits append pickled write-batches to a log; open
-replays snapshot + log into the in-memory sorted map; `compact()` rewrites
-the snapshot. Durability = fsync per commit.
+replays snapshot + log into the in-memory MVCC store; `compact()` rewrites
+the snapshot. Durability = fsync per commit, appended under the store lock
+after conflict validation so durability and visibility stay atomic.
+Transactions get the same snapshot isolation + write-write conflict
+detection as the mem engine (see kvs/mem.VersionedStore).
 """
 
 from __future__ import annotations
 
 import os
 import pickle
-import threading
-
-from sortedcontainers import SortedDict
 
 from surrealdb_tpu.kvs.api import Backend
-from surrealdb_tpu.kvs.mem import MemTx
+from surrealdb_tpu.kvs.mem import MemTx, VersionedStore
+
+# Rewrite the snapshot + truncate the WAL after this many committed batches
+# so crash recovery never replays an unbounded log (reference role: LSM
+# compaction in rocksdb/surrealkv).
+WAL_COMPACT_BATCHES = int(os.environ.get("SURREAL_WAL_COMPACT_BATCHES", 4096))
 
 
 class FileBackend(Backend):
@@ -24,15 +29,17 @@ class FileBackend(Backend):
         os.makedirs(path, exist_ok=True)
         self.snap_path = os.path.join(path, "snapshot.bin")
         self.wal_path = os.path.join(path, "wal.bin")
-        self.data: SortedDict = SortedDict()
-        self.lock = threading.RLock()
+        self.vs = VersionedStore()
+        self.lock = self.vs.lock
         self._load()
         self.wal = open(self.wal_path, "ab")
+        self._wal_batches = 0
 
     def _load(self):
         if os.path.exists(self.snap_path):
             with open(self.snap_path, "rb") as f:
-                self.data = SortedDict(pickle.load(f))
+                for k, v in pickle.load(f).items():
+                    self.vs.seed(k, v)
         if os.path.exists(self.wal_path):
             with open(self.wal_path, "rb") as f:
                 while True:
@@ -43,10 +50,7 @@ class FileBackend(Backend):
                     except Exception:
                         break  # torn tail write
                     for k, v in batch.items():
-                        if v is None:
-                            self.data.pop(k, None)
-                        else:
-                            self.data[k] = v
+                        self.vs.seed(k, v)
 
     def transaction(self, write: bool):
         return FileTx(self, write)
@@ -55,13 +59,14 @@ class FileBackend(Backend):
         with self.lock:
             tmp = self.snap_path + ".tmp"
             with open(tmp, "wb") as f:
-                pickle.dump(dict(self.data), f, protocol=5)
+                pickle.dump(dict(self.vs.latest_items()), f, protocol=5)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.snap_path)
             self.wal.close()
             open(self.wal_path, "wb").close()
             self.wal = open(self.wal_path, "ab")
+            self._wal_batches = 0
 
     def close(self):
         self.compact()
@@ -72,15 +77,18 @@ class FileTx(MemTx):
     def commit(self):
         self._check()
         self.done = True
-        if not self.writes:
-            return
         store: FileBackend = self.store
-        with store.lock:
+
+        def wal_append():
             pickle.dump(self.writes, store.wal, protocol=5)
             store.wal.flush()
             os.fsync(store.wal.fileno())
-            for k, v in self.writes.items():
-                if v is None:
-                    store.data.pop(k, None)
-                else:
-                    store.data[k] = v
+            store._wal_batches += 1
+
+        snap, self.snap = self.snap, None
+        if self.writes:
+            self.vs.commit(self.writes, snap, pre_apply=wal_append)
+            if store._wal_batches >= WAL_COMPACT_BATCHES:
+                store.compact()
+        else:
+            self.vs.release(snap)

@@ -1,7 +1,9 @@
-"""Native in-memory engine: the C++ memtable behind the Transactable
-contract (reference role: kvs/mem's native btree). Transactions keep a
-Python-side buffered writeset (same semantics as kvs/mem.MemTx) and commit
-atomically via the native batch op."""
+"""Native in-memory engine: the C++ MVCC memtable behind the Transactable
+contract (reference role: kvs/mem's native MVCC btree). Transactions pin a
+snapshot version at start (repeatable reads), keep a Python-side buffered
+writeset, and commit through the native batch op which validates
+write-write conflicts against versions committed after the snapshot — the
+same optimistic model as kvs/mem.MemTx."""
 
 from __future__ import annotations
 
@@ -9,6 +11,7 @@ from typing import Optional
 
 from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.kvs.api import Backend, BackendTx
+from surrealdb_tpu.kvs.mem import CONFLICT_MSG
 from surrealdb_tpu.native import NativeMemtable
 
 
@@ -16,6 +19,7 @@ class NativeMemTx(BackendTx):
     def __init__(self, store: "NativeMemBackend", write: bool):
         self.store = store
         self.write = write
+        self.snap = store.table.snapshot()
         self.writes: dict[bytes, Optional[bytes]] = {}
         self.savepoints: list[dict] = []
         self.done = False
@@ -24,11 +28,22 @@ class NativeMemTx(BackendTx):
         if self.done:
             raise SdbError("transaction is finished")
 
+    def _release(self):
+        if self.snap is not None:
+            self.store.table.release(self.snap)
+            self.snap = None
+
+    def __del__(self):
+        try:
+            self._release()
+        except Exception:
+            pass
+
     def get(self, key: bytes) -> Optional[bytes]:
         self._check()
         if key in self.writes:
             return self.writes[key]
-        return self.store.table.get(key)
+        return self.store.table.get_at(key, self.snap)
 
     def set(self, key: bytes, val: bytes) -> None:
         self._check()
@@ -45,10 +60,11 @@ class NativeMemTx(BackendTx):
     def scan(self, beg, end, limit=None, reverse=False):
         self._check()
         if not self.writes:
-            yield from self.store.table.scan(beg, end, limit, reverse)
+            yield from self.store.table.scan_at(beg, end, self.snap, limit,
+                                                reverse)
             return
-        # merge the committed scan with the overlay
-        base = dict(self.store.table.scan(beg, end))
+        # merge the snapshot scan with the overlay
+        base = dict(self.store.table.scan_at(beg, end, self.snap))
         for k, v in self.writes.items():
             if beg <= k < end:
                 if v is None:
@@ -66,7 +82,7 @@ class NativeMemTx(BackendTx):
     def count(self, beg, end):
         self._check()
         if not self.writes:
-            return self.store.table.count_range(beg, end)
+            return self.store.table.count_range_at(beg, end, self.snap)
         return sum(1 for _ in self.scan(beg, end))
 
     def new_save_point(self):
@@ -83,12 +99,17 @@ class NativeMemTx(BackendTx):
     def commit(self):
         self._check()
         self.done = True
-        if self.writes:
-            self.store.table.apply_batch(self.writes.items())
+        snap, self.snap = self.snap, None
+        # commit_batch validates conflicts and releases the snapshot under
+        # one mutex hold on the C++ side (see sdb_commit_batch)
+        ver = self.store.table.commit_batch(snap, self.writes.items())
+        if not ver:
+            raise SdbError(CONFLICT_MSG)
 
     def cancel(self):
         self.done = True
         self.writes.clear()
+        self._release()
 
 
 class NativeMemBackend(Backend):

@@ -1,8 +1,12 @@
-"""ctypes binding + lazy build of the native C++ memtable.
+"""ctypes binding + lazy build of the native C++ MVCC memtable.
 
 The shared library is compiled once (g++ -O2) into the package directory and
 cached; loading falls back gracefully to None so the pure-Python engine
-keeps working on systems without a toolchain."""
+keeps working on systems without a toolchain.
+
+Values read out of the store are copied into malloc'd buffers on the C++
+side under the store mutex and freed here via sdb_buf_free — so a
+concurrent commit can never invalidate a buffer while Python copies it."""
 
 from __future__ import annotations
 
@@ -51,38 +55,46 @@ def load():
         c_char_pp = ctypes.POINTER(ctypes.c_char_p)
         i64 = ctypes.c_int64
         i64p = ctypes.POINTER(i64)
+        u64 = ctypes.c_uint64
         lib.sdb_memtable_new.restype = ctypes.c_void_p
         lib.sdb_memtable_free.argtypes = [ctypes.c_void_p]
-        lib.sdb_get.restype = ctypes.c_int
-        lib.sdb_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64,
-                                c_char_pp, i64p]
-        lib.sdb_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64,
-                                ctypes.c_char_p, i64]
-        lib.sdb_del.restype = ctypes.c_int
-        lib.sdb_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64]
+        lib.sdb_buf_free.argtypes = [ctypes.c_void_p]
+        lib.sdb_snapshot.restype = u64
+        lib.sdb_snapshot.argtypes = [ctypes.c_void_p]
+        lib.sdb_snapshot_release.argtypes = [ctypes.c_void_p, u64]
+        lib.sdb_get_at.restype = ctypes.c_int
+        lib.sdb_get_at.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, i64, u64,
+            ctypes.POINTER(ctypes.c_void_p), i64p,
+        ]
         lib.sdb_len.restype = i64
         lib.sdb_len.argtypes = [ctypes.c_void_p]
-        lib.sdb_apply_batch.argtypes = [
-            ctypes.c_void_p, i64, c_char_pp, i64p, c_char_pp, i64p
+        lib.sdb_commit_batch.restype = u64
+        lib.sdb_commit_batch.argtypes = [
+            ctypes.c_void_p, u64, i64, c_char_pp, i64p, c_char_pp, i64p,
+            ctypes.c_int,
         ]
-        lib.sdb_scan_new.restype = ctypes.c_void_p
-        lib.sdb_scan_new.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64,
-                                     ctypes.c_char_p, i64, i64, ctypes.c_int]
+        lib.sdb_scan_new_at.restype = ctypes.c_void_p
+        lib.sdb_scan_new_at.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, i64, ctypes.c_char_p, i64,
+            u64, i64, ctypes.c_int,
+        ]
         lib.sdb_scan_next.restype = ctypes.c_int
         lib.sdb_scan_next.argtypes = [ctypes.c_void_p, c_char_pp, i64p,
                                       c_char_pp, i64p]
         lib.sdb_scan_free.argtypes = [ctypes.c_void_p]
-        lib.sdb_count_range.restype = i64
-        lib.sdb_count_range.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                        i64, ctypes.c_char_p, i64]
-        lib.sdb_delete_range.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                         i64, ctypes.c_char_p, i64]
+        lib.sdb_count_range_at.restype = i64
+        lib.sdb_count_range_at.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, i64, ctypes.c_char_p, i64,
+            u64,
+        ]
         _lib = lib
         return _lib
 
 
 class NativeMemtable:
-    """Thin OO wrapper over the C ABI."""
+    """Thin OO wrapper over the C ABI (MVCC: snapshot reads + optimistic
+    batch commit)."""
 
     def __init__(self):
         self.lib = load()
@@ -98,42 +110,32 @@ class NativeMemtable:
         except Exception:
             pass
 
-    def get(self, key: bytes):
-        out = ctypes.c_char_p()
+    # -- snapshots ----------------------------------------------------------
+    def snapshot(self) -> int:
+        return self.lib.sdb_snapshot(self.h)
+
+    def release(self, snap: int) -> None:
+        self.lib.sdb_snapshot_release(self.h, snap)
+
+    # -- reads --------------------------------------------------------------
+    def get_at(self, key: bytes, snap: int):
+        out = ctypes.c_void_p()
         n = ctypes.c_int64()
-        if self.lib.sdb_get(self.h, key, len(key), ctypes.byref(out),
-                            ctypes.byref(n)):
-            return ctypes.string_at(out, n.value)
+        if self.lib.sdb_get_at(self.h, key, len(key), snap,
+                               ctypes.byref(out), ctypes.byref(n)):
+            try:
+                return ctypes.string_at(out.value, n.value)
+            finally:
+                self.lib.sdb_buf_free(out)
         return None
-
-    def set(self, key: bytes, val: bytes):
-        self.lib.sdb_set(self.h, key, len(key), val, len(val))
-
-    def delete(self, key: bytes):
-        self.lib.sdb_del(self.h, key, len(key))
 
     def __len__(self):
         return self.lib.sdb_len(self.h)
 
-    def apply_batch(self, items):
-        """items: iterable of (key, val|None). Applied atomically."""
-        items = list(items)
-        n = len(items)
-        if not n:
-            return
-        keys = (ctypes.c_char_p * n)(*[k for k, _v in items])
-        klens = (ctypes.c_int64 * n)(*[len(k) for k, _v in items])
-        vals = (ctypes.c_char_p * n)(
-            *[(v if v is not None else b"") for _k, v in items]
-        )
-        vlens = (ctypes.c_int64 * n)(
-            *[(len(v) if v is not None else -1) for _k, v in items]
-        )
-        self.lib.sdb_apply_batch(self.h, n, keys, klens, vals, vlens)
-
-    def scan(self, beg: bytes, end: bytes, limit=None, reverse=False):
-        it = self.lib.sdb_scan_new(
-            self.h, beg, len(beg), end, len(end),
+    def scan_at(self, beg: bytes, end: bytes, snap: int, limit=None,
+                reverse=False):
+        it = self.lib.sdb_scan_new_at(
+            self.h, beg, len(beg), end, len(end), snap,
             -1 if limit is None else int(limit), 1 if reverse else 0,
         )
         try:
@@ -152,11 +154,33 @@ class NativeMemtable:
         finally:
             self.lib.sdb_scan_free(it)
 
-    def count_range(self, beg: bytes, end: bytes) -> int:
-        return self.lib.sdb_count_range(self.h, beg, len(beg), end, len(end))
+    def count_range_at(self, beg: bytes, end: bytes, snap: int) -> int:
+        return self.lib.sdb_count_range_at(self.h, beg, len(beg), end,
+                                           len(end), snap)
 
-    def delete_range(self, beg: bytes, end: bytes):
-        self.lib.sdb_delete_range(self.h, beg, len(beg), end, len(end))
+    # -- writes -------------------------------------------------------------
+    def commit_batch(self, snap: int, items, release_snap: bool = True) -> int:
+        """items: iterable of (key, val|None). Returns the new version, or
+        0 when a write-write conflict was detected (retryable). With
+        `release_snap` the committer's snapshot is released atomically with
+        the validation (single mutex hold on the C++ side)."""
+        items = list(items)
+        n = len(items)
+        if not n:
+            if release_snap:
+                self.release(snap)
+            return 1  # empty commit: nothing to validate or apply
+        keys = (ctypes.c_char_p * n)(*[k for k, _v in items])
+        klens = (ctypes.c_int64 * n)(*[len(k) for k, _v in items])
+        vals = (ctypes.c_char_p * n)(
+            *[(v if v is not None else b"") for _k, v in items]
+        )
+        vlens = (ctypes.c_int64 * n)(
+            *[(len(v) if v is not None else -1) for _k, v in items]
+        )
+        return self.lib.sdb_commit_batch(self.h, snap, n, keys, klens,
+                                         vals, vlens,
+                                         1 if release_snap else 0)
 
 
 def available() -> bool:

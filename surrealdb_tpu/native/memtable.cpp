@@ -1,24 +1,39 @@
-// Native ordered memtable (reference role: the in-proc engine that
-// surrealdb/core/src/kvs/mem fills with its Rust MVCC btree, and the C++
-// RocksDB layer fills for the persistent engine).
+// Native ordered MVCC memtable (reference role: the in-proc engine that
+// surrealdb/core/src/kvs/mem fills with its Rust MVCC btree).
 //
-// An ordered byte-keyspace with snapshot-free reads, batch commit, and
-// range scans, exported with a C ABI for the ctypes binding in
-// surrealdb_tpu/native/__init__.py. The Python Transaction layer keeps its
-// buffered writeset; commit applies batches atomically under the store
-// mutex.
+// An ordered byte-keyspace where every key holds a short version chain;
+// readers pin a snapshot version and resolve against it, writers commit
+// batches that are validated for write-write conflicts against versions
+// committed after their snapshot (optimistic, retryable — mirroring the
+// Python engine in surrealdb_tpu/kvs/mem.py). Exported with a C ABI for the
+// ctypes binding in surrealdb_tpu/native/__init__.py.
+//
+// All values returned to Python are copied into malloc'd buffers under the
+// store mutex (sdb_buf_free releases them) — no interior pointers escape,
+// so concurrent commits can never invalidate a buffer mid-read.
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
+#include <utility>
 #include <vector>
 
 namespace {
 
+struct Entry {
+    uint64_t ver;
+    bool tombstone;
+    std::string val;
+};
+
 struct Memtable {
-    std::map<std::string, std::string> data;
+    std::map<std::string, std::vector<Entry>> chains;
+    uint64_t version = 0;
+    std::multiset<uint64_t> active;
     std::mutex mu;
 };
 
@@ -29,6 +44,36 @@ struct ScanIter {
     size_t pos = 0;
 };
 
+const std::string* resolve(const std::vector<Entry>& chain, uint64_t snap) {
+    const std::string* out = nullptr;
+    for (const auto& e : chain) {
+        if (e.ver > snap) break;
+        out = e.tombstone ? nullptr : &e.val;
+    }
+    return out;
+}
+
+void prune(std::map<std::string, std::vector<Entry>>& chains,
+           std::map<std::string, std::vector<Entry>>::iterator it,
+           uint64_t min_active) {
+    auto& chain = it->second;
+    size_t keep_from = 0;
+    for (size_t i = 0; i < chain.size(); i++) {
+        if (chain[i].ver <= min_active)
+            keep_from = i;
+        else
+            break;
+    }
+    if (keep_from) chain.erase(chain.begin(), chain.begin() + keep_from);
+    if (chain.size() == 1 && chain[0].tombstone) chains.erase(it);
+}
+
+char* copy_out(const std::string& s) {
+    char* buf = static_cast<char*>(std::malloc(s.size() ? s.size() : 1));
+    std::memcpy(buf, s.data(), s.size());
+    return buf;
+}
+
 }  // namespace
 
 extern "C" {
@@ -37,68 +82,109 @@ void* sdb_memtable_new() { return new Memtable(); }
 
 void sdb_memtable_free(void* h) { delete static_cast<Memtable*>(h); }
 
-// single ops ---------------------------------------------------------------
+void sdb_buf_free(char* p) { std::free(p); }
 
-int sdb_get(void* h, const char* key, int64_t klen, const char** val,
-            int64_t* vlen) {
+// snapshots ----------------------------------------------------------------
+
+uint64_t sdb_snapshot(void* h) {
     auto* m = static_cast<Memtable*>(h);
     std::lock_guard<std::mutex> lock(m->mu);
-    auto it = m->data.find(std::string(key, klen));
-    if (it == m->data.end()) return 0;
-    *val = it->second.data();
-    *vlen = static_cast<int64_t>(it->second.size());
+    m->active.insert(m->version);
+    return m->version;
+}
+
+void sdb_snapshot_release(void* h, uint64_t snap) {
+    auto* m = static_cast<Memtable*>(h);
+    std::lock_guard<std::mutex> lock(m->mu);
+    auto it = m->active.find(snap);
+    if (it != m->active.end()) m->active.erase(it);
+}
+
+// reads --------------------------------------------------------------------
+
+int sdb_get_at(void* h, const char* key, int64_t klen, uint64_t snap,
+               char** val, int64_t* vlen) {
+    auto* m = static_cast<Memtable*>(h);
+    std::lock_guard<std::mutex> lock(m->mu);
+    auto it = m->chains.find(std::string(key, klen));
+    if (it == m->chains.end()) return 0;
+    const std::string* v = resolve(it->second, snap);
+    if (v == nullptr) return 0;
+    *val = copy_out(*v);
+    *vlen = static_cast<int64_t>(v->size());
     return 1;
-}
-
-void sdb_set(void* h, const char* key, int64_t klen, const char* val,
-             int64_t vlen) {
-    auto* m = static_cast<Memtable*>(h);
-    std::lock_guard<std::mutex> lock(m->mu);
-    m->data[std::string(key, klen)] = std::string(val, vlen);
-}
-
-int sdb_del(void* h, const char* key, int64_t klen) {
-    auto* m = static_cast<Memtable*>(h);
-    std::lock_guard<std::mutex> lock(m->mu);
-    return m->data.erase(std::string(key, klen)) ? 1 : 0;
 }
 
 int64_t sdb_len(void* h) {
     auto* m = static_cast<Memtable*>(h);
     std::lock_guard<std::mutex> lock(m->mu);
-    return static_cast<int64_t>(m->data.size());
+    int64_t n = 0;
+    for (auto& kv : m->chains)
+        if (!kv.second.empty() && !kv.second.back().tombstone) n++;
+    return n;
 }
 
-// batch commit: interleaved (key, val) pairs; vlen < 0 marks a tombstone --
+// commit: interleaved (key, val) pairs; vlen < 0 marks a tombstone.
+// Returns the new version, or 0 on write-write conflict (any written key
+// has a committed version newer than `snap`). With release_snap, the
+// committer's snapshot is removed from the active set under the SAME mutex
+// hold, after validation — releasing before validating would let a
+// concurrent delete prune a conflicting chain away and hide the conflict.
 
-void sdb_apply_batch(void* h, int64_t n, const char** keys,
-                     const int64_t* klens, const char** vals,
-                     const int64_t* vlens) {
+uint64_t sdb_commit_batch(void* h, uint64_t snap, int64_t n,
+                          const char** keys, const int64_t* klens,
+                          const char** vals, const int64_t* vlens,
+                          int release_snap) {
     auto* m = static_cast<Memtable*>(h);
     std::lock_guard<std::mutex> lock(m->mu);
+    bool conflict = false;
+    for (int64_t i = 0; i < n && !conflict; i++) {
+        auto it = m->chains.find(std::string(keys[i], klens[i]));
+        if (it != m->chains.end() && !it->second.empty() &&
+            it->second.back().ver > snap)
+            conflict = true;
+    }
+    if (release_snap) {
+        auto a = m->active.find(snap);
+        if (a != m->active.end()) m->active.erase(a);
+    }
+    if (conflict) return 0;
+    uint64_t ver = ++m->version;
+    uint64_t min_active = m->active.empty() ? ver : *m->active.begin();
     for (int64_t i = 0; i < n; i++) {
         std::string k(keys[i], klens[i]);
-        if (vlens[i] < 0) {
-            m->data.erase(k);
-        } else {
-            m->data[k] = std::string(vals[i], vlens[i]);
+        bool tomb = vlens[i] < 0;
+        auto it = m->chains.find(k);
+        if (it == m->chains.end()) {
+            if (tomb) continue;  // delete of a never-written key
+            it = m->chains.emplace(std::move(k), std::vector<Entry>{}).first;
         }
+        Entry e;
+        e.ver = ver;
+        e.tombstone = tomb;
+        if (!tomb) e.val.assign(vals[i], vlens[i]);
+        it->second.push_back(std::move(e));
+        prune(m->chains, it, min_active);
     }
+    return ver;
 }
 
 // range scans --------------------------------------------------------------
 
-void* sdb_scan_new(void* h, const char* beg, int64_t blen, const char* end,
-                   int64_t elen, int64_t limit, int reverse) {
+void* sdb_scan_new_at(void* h, const char* beg, int64_t blen, const char* end,
+                      int64_t elen, uint64_t snap, int64_t limit,
+                      int reverse) {
     auto* m = static_cast<Memtable*>(h);
     auto* it = new ScanIter();
     std::string kb(beg, blen), ke(end, elen);
     std::lock_guard<std::mutex> lock(m->mu);
-    auto lo = m->data.lower_bound(kb);
-    auto hi = m->data.lower_bound(ke);
+    auto lo = m->chains.lower_bound(kb);
+    auto hi = m->chains.lower_bound(ke);
     if (!reverse) {
         for (auto cur = lo; cur != hi; ++cur) {
-            it->items.emplace_back(cur->first, cur->second);
+            const std::string* v = resolve(cur->second, snap);
+            if (v == nullptr) continue;
+            it->items.emplace_back(cur->first, *v);
             if (limit >= 0 &&
                 static_cast<int64_t>(it->items.size()) >= limit)
                 break;
@@ -106,7 +192,9 @@ void* sdb_scan_new(void* h, const char* beg, int64_t blen, const char* end,
     } else {
         for (auto cur = hi; cur != lo;) {
             --cur;
-            it->items.emplace_back(cur->first, cur->second);
+            const std::string* v = resolve(cur->second, snap);
+            if (v == nullptr) continue;
+            it->items.emplace_back(cur->first, *v);
             if (limit >= 0 &&
                 static_cast<int64_t>(it->items.size()) >= limit)
                 break;
@@ -129,22 +217,17 @@ int sdb_scan_next(void* hit, const char** key, int64_t* klen,
 
 void sdb_scan_free(void* hit) { delete static_cast<ScanIter*>(hit); }
 
-int64_t sdb_count_range(void* h, const char* beg, int64_t blen,
-                        const char* end, int64_t elen) {
+int64_t sdb_count_range_at(void* h, const char* beg, int64_t blen,
+                           const char* end, int64_t elen, uint64_t snap) {
     auto* m = static_cast<Memtable*>(h);
     std::string kb(beg, blen), ke(end, elen);
     std::lock_guard<std::mutex> lock(m->mu);
-    auto lo = m->data.lower_bound(kb);
-    auto hi = m->data.lower_bound(ke);
-    return static_cast<int64_t>(std::distance(lo, hi));
-}
-
-void sdb_delete_range(void* h, const char* beg, int64_t blen,
-                      const char* end, int64_t elen) {
-    auto* m = static_cast<Memtable*>(h);
-    std::string kb(beg, blen), ke(end, elen);
-    std::lock_guard<std::mutex> lock(m->mu);
-    m->data.erase(m->data.lower_bound(kb), m->data.lower_bound(ke));
+    auto lo = m->chains.lower_bound(kb);
+    auto hi = m->chains.lower_bound(ke);
+    int64_t n = 0;
+    for (auto cur = lo; cur != hi; ++cur)
+        if (resolve(cur->second, snap) != nullptr) n++;
+    return n;
 }
 
 }  // extern "C"

@@ -21,9 +21,11 @@ class RpcSession:
     """One client connection's state (reference server/src/rpc/websocket.rs
     session handling)."""
 
-    def __init__(self, ds: Datastore):
+    def __init__(self, ds: Datastore, anon_level: str = "none"):
         self.ds = ds
-        self.session = Session()
+        # Network sessions start unauthenticated ("none") unless the server
+        # was explicitly started in unauthenticated dev mode.
+        self.session = Session(auth_level=anon_level)
         self.live_ids: set = set()
 
     # -- dispatch -----------------------------------------------------------

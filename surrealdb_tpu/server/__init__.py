@@ -13,7 +13,7 @@ import json
 import struct
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.kvs.ds import Datastore, Session
@@ -26,6 +26,10 @@ _WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 class SurrealHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     ds: Datastore = None  # set by make_server
+    # What an unauthenticated network session gets. Secure default is "none"
+    # (reference: anonymous sessions carry no grants); make_server's
+    # unauthenticated=True dev mode raises it to "owner".
+    anon_level = "none"
     server_obj = None
 
     def log_message(self, fmt, *args):
@@ -56,6 +60,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
         s = Session(
             ns=self.headers.get("surreal-ns") or self.headers.get("NS"),
             db=self.headers.get("surreal-db") or self.headers.get("DB"),
+            auth_level=self.anon_level,
         )
         auth = self.headers.get("Authorization") or ""
         if auth.startswith("Bearer "):
@@ -64,6 +69,16 @@ class SurrealHandler(BaseHTTPRequestHandler):
             try:
                 authenticate(self.ds, s, auth[7:])
             except SdbError:
+                s.auth_level = "none"
+        elif auth.startswith("Basic "):
+            from surrealdb_tpu.iam import signin
+
+            try:
+                raw = base64.b64decode(auth[6:]).decode()
+                user, _, passwd = raw.partition(":")
+                signin(self.ds, s,
+                       {"user": user, "pass": passwd, "NS": s.ns, "DB": s.db})
+            except (SdbError, ValueError):
                 s.auth_level = "none"
         return s
 
@@ -146,7 +161,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
             # HTTP one-shot RPC
             try:
                 req = json.loads(self._body() or b"{}")
-                rs = RpcSession(self.ds)
+                rs = RpcSession(self.ds, anon_level=self.anon_level)
                 rs.session = self._session()
                 out = rs.handle(req.get("method", ""), req.get("params") or [])
                 self._json(200, {"id": req.get("id"), "result": to_json(out)})
@@ -196,7 +211,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
 
     def _key_route(self, method: str):
         """REST CRUD: /key/:table[/:id] (reference ntw key routes)."""
-        parts = urlparse(self.path).path.split("/")[2:]
+        parts = [unquote(p) for p in urlparse(self.path).path.split("/")[2:]]
         qs = parse_qs(urlparse(self.path).query)
         sess = self._session()
         tb = parts[0] if parts else None
@@ -204,8 +219,15 @@ class SurrealHandler(BaseHTTPRequestHandler):
         if not tb:
             self._json(400, {"error": "Missing table"})
             return
-        target = f"{tb}:{rid}" if rid else tb
-        vars = {}
+        # Bind the path segments as parameters — never interpolate raw URL
+        # text into SurrealQL (reference builds these from parsed Thing
+        # values; crafted /key/:table/:id segments must not inject syntax).
+        vars = {"_tb": tb}
+        if rid is not None:
+            vars["_id"] = rid
+            target = "type::thing($_tb, $_id)"
+        else:
+            target = "type::table($_tb)"
         body = self._body()
         data = None
         if body:
@@ -214,10 +236,14 @@ class SurrealHandler(BaseHTTPRequestHandler):
             except ValueError:
                 self._json(400, {"error": "Invalid JSON body"})
                 return
+        try:
+            limit = int(qs.get("limit", ["100"])[0])
+            start = int(qs.get("start", ["0"])[0])
+        except ValueError:
+            self._json(400, {"error": "Invalid limit/start"})
+            return
         if method == "GET":
-            limit = qs.get("limit", ["100"])[0]
-            start = qs.get("start", ["0"])[0]
-            sql = f"SELECT * FROM {target} LIMIT {int(limit)} START {int(start)}"
+            sql = f"SELECT * FROM {target} LIMIT {limit} START {start}"
         elif method == "POST":
             vars["data"] = data or {}
             sql = f"CREATE {target} CONTENT $data"
@@ -284,7 +310,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
         return opcode, bytes(data)
 
     def _ws_serve(self):
-        rs = RpcSession(self.ds)
+        rs = RpcSession(self.ds, anon_level=self.anon_level)
         self._ws_lock = threading.Lock()
 
         # live-query notification forwarding
@@ -351,12 +377,16 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 pass
 
 
-def make_server(ds: Datastore, host="127.0.0.1", port=8000) -> ThreadingHTTPServer:
-    handler = type("BoundHandler", (SurrealHandler,), {"ds": ds})
+def make_server(ds: Datastore, host="127.0.0.1", port=8000,
+                unauthenticated=False) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (SurrealHandler,), {
+        "ds": ds,
+        "anon_level": "owner" if unauthenticated else "none",
+    })
     return ThreadingHTTPServer((host, port), handler)
 
 
-def serve(ds: Datastore, host="127.0.0.1", port=8000):
-    srv = make_server(ds, host, port)
+def serve(ds: Datastore, host="127.0.0.1", port=8000, unauthenticated=False):
+    srv = make_server(ds, host, port, unauthenticated=unauthenticated)
     print(f"surrealdb-tpu listening on http://{host}:{port}")
     srv.serve_forever()

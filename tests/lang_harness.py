@@ -106,7 +106,7 @@ def run_lang_test(t: LangTest, ds=None):
         ds = Datastore("memory")
     from surrealdb_tpu.kvs.ds import Session
 
-    sess = Session(ns=t.ns, db=t.db)
+    sess = Session(ns=t.ns, db=t.db, auth_level="owner")
     sess.planner_strategy = getattr(t, "planner", None)
     auth = getattr(t, "auth", None)
     run_sess = sess

@@ -1,0 +1,211 @@
+"""Transaction isolation: snapshot reads + optimistic write-write conflict
+detection on both mem engines, and WAL crash recovery on the file engine
+(reference: core/src/kvs/api.rs transaction semantics; ADVICE round 1)."""
+
+import os
+import pickle
+import threading
+
+import pytest
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.kvs.mem import CONFLICT_MSG, MemBackend
+
+
+def backends():
+    out = [MemBackend]
+    try:
+        from surrealdb_tpu.kvs.native_mem import NativeMemBackend
+        from surrealdb_tpu.native import available
+
+        if available():
+            out.append(NativeMemBackend)
+    except Exception:
+        pass
+    return out
+
+
+@pytest.fixture(params=backends(), ids=lambda b: b.__name__)
+def backend(request):
+    return request.param()
+
+
+def test_snapshot_isolation_repeatable_read(backend):
+    w = backend.transaction(write=True)
+    w.set(b"k", b"v1")
+    w.commit()
+
+    r = backend.transaction(write=False)
+    assert r.get(b"k") == b"v1"
+
+    w2 = backend.transaction(write=True)
+    w2.set(b"k", b"v2")
+    w2.set(b"new", b"n")
+    w2.commit()
+
+    # the reader still sees its snapshot — no non-repeatable reads,
+    # no phantom keys
+    assert r.get(b"k") == b"v1"
+    assert r.get(b"new") is None
+    assert [k for k, _ in r.scan(b"a", b"z")] == [b"k"]
+    r.cancel()
+
+    r2 = backend.transaction(write=False)
+    assert r2.get(b"k") == b"v2"
+    r2.cancel()
+
+
+def test_write_write_conflict_detected(backend):
+    seed = backend.transaction(write=True)
+    seed.set(b"acct", b"100")
+    seed.commit()
+
+    t1 = backend.transaction(write=True)
+    t2 = backend.transaction(write=True)
+    v1 = int(t1.get(b"acct"))
+    v2 = int(t2.get(b"acct"))
+    t1.set(b"acct", str(v1 + 10).encode())
+    t2.set(b"acct", str(v2 + 20).encode())
+    t1.commit()
+    with pytest.raises(SdbError, match="conflict"):
+        t2.commit()
+
+    r = backend.transaction(write=False)
+    assert r.get(b"acct") == b"110"  # no lost update
+    r.cancel()
+
+
+def test_disjoint_writers_both_commit(backend):
+    t1 = backend.transaction(write=True)
+    t2 = backend.transaction(write=True)
+    t1.set(b"a", b"1")
+    t2.set(b"b", b"2")
+    t1.commit()
+    t2.commit()
+    r = backend.transaction(write=False)
+    assert r.get(b"a") == b"1" and r.get(b"b") == b"2"
+    r.cancel()
+
+
+def test_concurrent_counter_no_lost_updates(backend):
+    """Hammer one counter from 8 threads with retry-on-conflict: the final
+    value must equal the number of successful increments."""
+    seed = backend.transaction(write=True)
+    seed.set(b"ctr", b"0")
+    seed.commit()
+
+    n_threads, n_incr = 8, 25
+    done = []
+
+    def worker():
+        ok = 0
+        while ok < n_incr:
+            tx = backend.transaction(write=True)
+            v = int(tx.get(b"ctr"))
+            tx.set(b"ctr", str(v + 1).encode())
+            try:
+                tx.commit()
+                ok += 1
+            except SdbError as e:
+                assert "conflict" in str(e)
+        done.append(ok)
+
+    ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+    r = backend.transaction(write=False)
+    assert int(r.get(b"ctr")) == n_threads * n_incr
+    r.cancel()
+
+
+def test_version_chain_pruning():
+    """Chains collapse once no snapshot needs old versions."""
+    b = MemBackend()
+    for i in range(50):
+        w = b.transaction(write=True)
+        w.set(b"hot", str(i).encode())
+        w.commit()
+    assert len(b.vs.chains[b"hot"]) == 1
+    # a pinned reader keeps its version alive
+    r = b.transaction(write=False)
+    for i in range(5):
+        w = b.transaction(write=True)
+        w.set(b"hot", f"x{i}".encode())
+        w.commit()
+    assert r.get(b"hot") == b"49"
+    r.cancel()
+
+
+def test_file_backend_crash_recovery(tmp_path):
+    """Kill-without-close: reopening replays the WAL; a torn tail batch is
+    dropped without losing earlier commits."""
+    from surrealdb_tpu.kvs.file import FileBackend
+
+    path = str(tmp_path / "db")
+    b = FileBackend(path)
+    for i in range(10):
+        w = b.transaction(write=True)
+        w.set(f"k{i}".encode(), str(i).encode())
+        w.commit()
+    # simulate a crash: no close()/compact(), then a torn partial record
+    b.wal.close()
+    with open(os.path.join(path, "wal.bin"), "ab") as f:
+        f.write(pickle.dumps({b"torn": b"x"}, protocol=5)[:7])
+
+    b2 = FileBackend(path)
+    r = b2.transaction(write=False)
+    for i in range(10):
+        assert r.get(f"k{i}".encode()) == str(i).encode()
+    assert r.get(b"torn") is None
+    r.cancel()
+    b2.close()
+
+
+def test_file_backend_conflict_and_durability(tmp_path):
+    from surrealdb_tpu.kvs.file import FileBackend
+
+    path = str(tmp_path / "db")
+    b = FileBackend(path)
+    t1 = b.transaction(write=True)
+    t2 = b.transaction(write=True)
+    t1.set(b"k", b"1")
+    t2.set(b"k", b"2")
+    t1.commit()
+    with pytest.raises(SdbError, match="conflict"):
+        t2.commit()
+    b.close()
+    b2 = FileBackend(path)
+    r = b2.transaction(write=False)
+    assert r.get(b"k") == b"1"
+    r.cancel()
+    b2.close()
+
+
+def test_conflict_message_is_retryable_text():
+    assert "retried" in CONFLICT_MSG
+
+
+def test_conflict_with_concurrent_delete(backend):
+    """A concurrent committed DELETE must conflict with a buffered write even
+    though pruning may erase the tombstone chain entirely (the
+    release-before-validate race found in review)."""
+    seed = backend.transaction(write=True)
+    seed.set(b"k", b"v0")
+    seed.commit()
+
+    t1 = backend.transaction(write=True)
+    assert t1.get(b"k") == b"v0"
+    t1.set(b"k", b"v1")
+
+    t2 = backend.transaction(write=True)
+    t2.delete(b"k")
+    t2.commit()
+
+    with pytest.raises(SdbError, match="conflict"):
+        t1.commit()
+    r = backend.transaction(write=False)
+    assert r.get(b"k") is None  # the delete won; no resurrected key
+    r.cancel()

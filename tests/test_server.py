@@ -20,6 +20,18 @@ from surrealdb_tpu.server import make_server
 @pytest.fixture(scope="module")
 def server():
     ds = Datastore("memory")
+    srv = make_server(ds, "127.0.0.1", 0, unauthenticated=True)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield ds, f"http://127.0.0.1:{port}", port
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def secure_server():
+    ds = Datastore("memory")
+    ds.execute("DEFINE USER root ON ROOT PASSWORD 'r00t' ROLES OWNER")
     srv = make_server(ds, "127.0.0.1", 0)
     port = srv.server_address[1]
     t = threading.Thread(target=srv.serve_forever, daemon=True)
@@ -208,3 +220,61 @@ def test_graphql(server):
     s, b = _req(base + "/graphql", "POST", body, hdrs)
     out = json.loads(b)
     assert out["data"]["gq"][0]["name"] == "x"
+
+
+def test_secure_anonymous_denied(secure_server):
+    """Anonymous sessions on a secured server get no grants (ADVICE:
+    unauthenticated clients must not default to owner)."""
+    _ds, base, _port = secure_server
+    hdrs = {"surreal-ns": "t", "surreal-db": "t"}
+    s, b = _req(base + "/sql", "POST", b"CREATE locked:1 SET x = 1", hdrs)
+    out = json.loads(b)
+    assert out[0]["status"] == "ERR"
+    # nothing was written
+    rows = _ds.query_one("SELECT * FROM locked", ns="t", db="t")
+    assert rows == []
+
+
+def test_secure_token_and_basic_auth(secure_server):
+    _ds, base, _port = secure_server
+    hdrs = {"surreal-ns": "t", "surreal-db": "t"}
+    # signin → bearer token works
+    body = json.dumps({"user": "root", "pass": "r00t"}).encode()
+    s, b = _req(base + "/signin", "POST", body)
+    token = json.loads(b)["token"]
+    auth_hdrs = dict(hdrs, Authorization=f"Bearer {token}")
+    s, b = _req(base + "/sql", "POST", b"CREATE sec:1 SET x = 2", auth_hdrs)
+    assert json.loads(b)[0]["status"] == "OK"
+    # basic auth works too
+    import base64 as b64
+    basic = b64.b64encode(b"root:r00t").decode()
+    basic_hdrs = dict(hdrs, Authorization=f"Basic {basic}")
+    s, b = _req(base + "/sql", "POST", b"SELECT * FROM sec", basic_hdrs)
+    out = json.loads(b)
+    assert out[0]["status"] == "OK" and out[0]["result"][0]["x"] == 2
+    # wrong basic credentials get nothing
+    bad = b64.b64encode(b"root:nope").decode()
+    bad_hdrs = dict(hdrs, Authorization=f"Basic {bad}")
+    s, b = _req(base + "/sql", "POST", b"SELECT * FROM sec", bad_hdrs)
+    out = json.loads(b)[0]
+    # failed basic auth falls back to an anonymous session: rows are
+    # permission-filtered away (reference returns empty, not an error)
+    assert out["result"] in ([], None) or out["status"] == "ERR"
+
+
+def test_key_route_injection_blocked(server):
+    """Path segments are bound as parameters, not spliced into SurrealQL."""
+    from urllib.parse import quote
+
+    _ds, base, _port = server
+    hdrs = {"surreal-ns": "t", "surreal-db": "t",
+            "Content-Type": "application/json"}
+    s, b = _req(base + "/key/safekey/one", "POST",
+                json.dumps({"v": 1}).encode(), hdrs)
+    assert s == 200 and json.loads(b)[0]["status"] == "OK"
+    # a crafted "table" segment must not execute as extra statements
+    evil = quote("safekey; REMOVE TABLE safekey", safe="")
+    s, b = _req(base + f"/key/{evil}", "GET", None, hdrs)
+    assert s == 200
+    s, b = _req(base + "/key/safekey", "GET", None, hdrs)
+    assert json.loads(b)[0]["result"][0]["v"] == 1
