@@ -19,6 +19,7 @@ class Ctx:
         "executor", "ns", "db", "knn", "record_cache", "deadline",
         "timeout_dur", "write_version", "depth",
         "perms_enabled", "version", "_cond_consumed", "_cf_seq",
+        "_brute_knn_k",
     )
 
     def __init__(self, ds, session, txn, executor=None):
@@ -42,6 +43,7 @@ class Ctx:
         self.version = None  # VERSION clause timestamp
         self._cond_consumed = False  # planner handled the WHERE clause
         self._cf_seq = 0
+        self._brute_knn_k = None  # brute KNN global k (multi-source trim)
 
     def child(self) -> "Ctx":
         c = Ctx.__new__(Ctx)
@@ -65,6 +67,7 @@ class Ctx:
         c.version = self.version
         c._cond_consumed = False
         c._cf_seq = 0
+        c._brute_knn_k = self._brute_knn_k
         if c.depth > 32:
             raise SdbError("Max computation depth exceeded")
         return c

@@ -500,6 +500,18 @@ def _s_select(n: SelectStmt, ctx: Ctx):
             if not check_table_permission(src.rid.tb, "select", c, src.doc, src.rid):
                 continue
         rows.append(src)
+    # brute-force KNN over multiple FROM sources: each table contributed its
+    # own top-k; the KnnTopK aggregate is global, so trim the union back to
+    # the k nearest (top-k of a union ⊆ union of per-source top-ks)
+    bk = getattr(c, "_brute_knn_k", None)
+    if bk is not None and c.knn and len(rows) > bk:
+        from surrealdb_tpu.idx.planner import hashable
+
+        rows.sort(
+            key=lambda s: c.knn.get(hashable(s.rid), float("inf"))
+            if s.rid is not None else float("inf")
+        )
+        rows = rows[:bk]
     n = _expand_field_projections(n, c)
     return _select_pipeline(n, rows, c)
 
@@ -1202,6 +1214,11 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
     scans = []  # (label_fn, scan_rows)
     total_scan_rows = 0
     residual = n.cond
+    # KNN in the WHERE tree: KnnScan (HNSW access path) or KnnTopK (the
+    # pipeline-breaking brute-force aggregate, exec/operators/knn_topk.rs)
+    knn = _find_knn(n.cond) if n.cond is not None else None
+    knn_residual = _remove_node(n.cond, knn) if knn is not None else None
+    knn_brute = None
     for expr in n.what:
         v = _target_value(expr, ctx)
         if isinstance(v, RecordId) and not isinstance(v.id, Range):
@@ -1223,6 +1240,60 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
             indexes = [i for i in indexes if i.name in n.with_index]
         noindex = n.with_index == []
         label = None
+        if knn is not None:
+            qv = evaluate(knn.rhs, ctx)
+            dim = len(qv) if isinstance(qv, list) else 0
+            idef_h = None
+            if not noindex and knn.dist is None:
+                from surrealdb_tpu.idx.planner import _field_path as _fpk
+
+                kpath = _fpk(knn.lhs)
+                idef_h = next(
+                    (d for d in indexes
+                     if d.hnsw is not None and d.cols_str
+                     and d.cols_str[0] == kpath),
+                    None,
+                )
+            if idef_h is not None:
+                rows = 0
+                if analyze:
+                    from surrealdb_tpu.idx.planner import plan_scan
+
+                    plan = plan_scan(tb, n.cond, ctx.child(), n)
+                    rows = sum(1 for _ in plan) if plan is not None else 0
+                label = (
+                    f"KnnScan [ctx: Db] [index: {idef_h.name}, k: {knn.k}, "
+                    f"ef: {knn.ef or 40}, dimension: {dim}]"
+                )
+                residual = knn_residual  # rendered as a Filter above
+                scans.append((label, rows))
+                total_scan_rows += rows
+                continue
+            knn_brute = (knn, dim)
+            if single_target and knn_residual is not None:
+                rows = 0
+                if analyze:
+                    for src in _iterate_value(v, ctx, None, None):
+                        doc = src.doc if src.rid is not None else src.value
+                        cc = ctx.with_doc(doc, src.rid)
+                        if is_truthy(evaluate(knn_residual, cc)):
+                            rows += 1
+                label = (
+                    f"TableScan [ctx: Db] [table: {tb}, direction: Forward, "
+                    f"predicate: {_expr_sql(knn_residual)}]"
+                )
+            else:
+                rows = (
+                    len(list(_iterate_value(v, ctx, None, None)))
+                    if analyze else 0
+                )
+                label = (
+                    f"TableScan [ctx: Db] [table: {tb}, direction: Forward]"
+                )
+            residual = None
+            scans.append((label, rows))
+            total_scan_rows += rows
+            continue
         mts = _find_matches(n.cond) if n.cond is not None and not noindex else []
         if mts:
             mt = mts[0]
@@ -1507,6 +1578,38 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
             _emit_scan(1, entry)
     else:
         _emit_scan(0, scans[0])
+    if knn_brute is not None:
+        knn_o, dim_o = knn_brute
+        dist_name = (knn_o.dist or "EUCLIDEAN").capitalize()
+        filt_line = None
+        if len(scans) > 1 and knn_residual is not None:
+            filt_rows = 0
+            if analyze:
+                for expr in n.what:
+                    vv = _target_value(expr, ctx)
+                    for src in _iterate_value(vv, ctx, None, None):
+                        doc = src.doc if src.rid is not None else src.value
+                        cc = ctx.with_doc(doc, src.rid)
+                        if is_truthy(evaluate(knn_residual, cc)):
+                            filt_rows += 1
+            filt_line = (
+                f"Filter [ctx: Db] [predicate: {_expr_sql(knn_residual)}]",
+                filt_rows,
+            )
+        else:
+            filt_rows = scans[0][1] if scans else 0
+        ktop_rows = min(knn_o.k, filt_rows) if analyze else 0
+        wrapped = [(
+            0,
+            f"KnnTopK [ctx: Db] [field: {expr_name(knn_o.lhs)}, "
+            f"k: {knn_o.k}, distance: {dist_name}, dimension: {dim_o}]",
+            ktop_rows,
+        )]
+        shift = 1
+        if filt_line is not None:
+            wrapped.append((1, filt_line[0], filt_line[1]))
+            shift = 2
+        scan_lines = wrapped + [(d + shift, t, r) for d, t, r in scan_lines]
     if residual is not None and not any(
         t.lstrip().startswith("TableScan") for _d, t, _r in scan_lines
     ):
@@ -1593,8 +1696,13 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                     (f"SelectProject [ctx: Db] [projections: {projs}]",
                      out_rows_n)
                 )
+                # function-call fields render with elided args (reference
+                # operator pretty-print: `vector::distance::knn(...)`)
                 computed = [
-                    f"{a} = {_expr_sql(e)}"
+                    f"{a} = " + (
+                        f"{e.name}(...)" if isinstance(e, FunctionCall)
+                        else _expr_sql(e)
+                    )
                     for e, a in n.exprs
                     if e != "*" and a and not isinstance(e, Idiom)
                 ]
@@ -1625,16 +1733,18 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 )
         elif n.limit is not None:
             lim = int(evaluate(n.limit, ctx))
-            mid_lines.append(
-                (f"Limit [ctx: Db] [limit: {lim}]", out_rows_n)
-            )
-            mid_lines.append(
+            # sorts sit directly under the projection, above Compute
+            mid_lines.insert(
+                0,
                 (f"SortTopKByKey [ctx: Db] [sort_keys: {keys}, limit: {lim}]",
                  out_rows_n)
             )
+            mid_lines.insert(
+                0, (f"Limit [ctx: Db] [limit: {lim}]", out_rows_n)
+            )
         else:
-            mid_lines.append(
-                (f"SortByKey [ctx: Db] [sort_keys: {keys}]", out_rows_n)
+            mid_lines.insert(
+                0, (f"SortByKey [ctx: Db] [sort_keys: {keys}]", out_rows_n)
             )
     if n.limit is not None and n.group is not None:
         lim = int(evaluate(n.limit, ctx))

@@ -238,16 +238,21 @@ def plan_scan(tb: str, cond, ctx, stmt):
     from surrealdb_tpu.exec.statements import Source
 
     with_index = getattr(stmt, "with_index", None) if stmt is not None else None
-    if with_index == []:  # WITH NOINDEX
-        return None
-    indexes = get_indexes_for(tb, ctx)
-    if with_index:
-        indexes = [i for i in indexes if i.name in with_index]
+    if with_index == []:  # WITH NOINDEX: no index access paths...
+        indexes = []
+    else:
+        indexes = get_indexes_for(tb, ctx)
+        if with_index:
+            indexes = [i for i in indexes if i.name in with_index]
 
     # ---- KNN --------------------------------------------------------------
+    # ...but brute-force KNN is a scan operator (KnnTopK), not an index, so
+    # it still applies under WITH NOINDEX (reference: exec/operators/knn_topk.rs)
     knn = _find_knn(cond)
     if knn is not None:
         return _plan_knn(tb, cond, knn, indexes, ctx, stmt)
+    if with_index == []:
+        return None
 
     # ---- MATCHES ----------------------------------------------------------
     mts = _find_matches(cond)
@@ -381,20 +386,29 @@ def _plan_knn(tb, cond, knn: Knn, indexes, ctx, stmt):
     qv = evaluate(knn.rhs, ctx)
     rest = _remove_node(cond, knn)
     results = None
-    if knn.dist is None and path is not None:
-        # indexed ANN (ef given or not — we search the index either way)
+    if path is not None:
+        # indexed ANN: `<|k,ef|>` / `<|k|>`, or `<|k,DIST|>` when DIST
+        # matches the index distance (reference routes those to HNSW too)
         for idef in indexes:
-            if idef.hnsw is not None and idef.cols_str and idef.cols_str[0] == path:
-                from surrealdb_tpu.idx.vector import get_vector_index
+            if idef.hnsw is None or not idef.cols_str or \
+                    idef.cols_str[0] != path:
+                continue
+            if knn.dist is not None and knn.dist.lower() != \
+                    idef.hnsw.get("distance", "euclidean"):
+                continue
+            from surrealdb_tpu.idx.vector import get_vector_index
 
-                eng = get_vector_index(idef, ctx)
-                results = eng.knn(
-                    qv, knn.k, ctx,
-                    ef=knn.ef,
-                    cond=rest,
-                    cond_ctx=ctx if rest is not None else None,
-                )
-                break
+            eng = get_vector_index(idef, ctx)
+            ef = knn.ef
+            if ef is None and knn.dist is not None:
+                ef = idef.hnsw.get("ef_construction", 150)
+            results = eng.knn(
+                qv, knn.k, ctx,
+                ef=ef,
+                cond=rest,
+                cond_ctx=ctx if rest is not None else None,
+            )
+            break
         if results is None and knn.ef is not None:
             raise SdbError(
                 f"There was no suitable index found for the provided KNN expression"
@@ -404,9 +418,13 @@ def _plan_knn(tb, cond, knn: Knn, indexes, ctx, stmt):
         # exec/operators/knn_topk.rs)
         results = _brute_knn(tb, knn, qv, rest, ctx)
         rest_after = rest
+        # the KnnTopK aggregate is global across all FROM sources: record k
+        # so the SELECT loop trims the union of per-table top-ks back to k
+        ctx._brute_knn_k = knn.k
     else:
         rest_after = None  # index path already applied the residual cond
-    ctx.knn = {}
+    if getattr(ctx, "knn", None) is None:
+        ctx.knn = {}
 
     def gen():
         from surrealdb_tpu.exec.eval import fetch_record
@@ -526,12 +544,26 @@ def explain_plan(tb, cond, ctx, stmt):
             path = _field_path(knn.lhs)
             for idef in indexes:
                 if idef.hnsw is not None and idef.cols_str and \
-                        idef.cols_str[0] == path and knn.dist is None:
+                        idef.cols_str[0] == path and (
+                            knn.dist is None
+                            or knn.dist.lower() == idef.hnsw.get(
+                                "distance", "euclidean")
+                        ):
+                    from surrealdb_tpu.exec.eval import evaluate
+
+                    try:
+                        qval = evaluate(knn.rhs, ctx)
+                    except Exception:
+                        qval = None
+                    ef = knn.ef
+                    if ef is None and knn.dist is not None:
+                        ef = idef.hnsw.get("ef_construction", 150)
                     return {
                         "detail": {
                             "plan": {
                                 "index": idef.name,
-                                "operator": f"<|{knn.k},{knn.ef or 40}|>",
+                                "operator": f"<|{knn.k},{ef or 40}|>",
+                                "value": qval,
                             },
                             "table": tb,
                         },
