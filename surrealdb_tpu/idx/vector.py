@@ -107,6 +107,10 @@ class TpuVectorIndex:
         self.valid = np.zeros(0, dtype=bool)  # tombstone mask
         self.device_vecs = None  # jax array (lazy)
         self.device_valid = None
+        # bf16 ranking store (the primary single-chip kernel): halves HBM
+        # traffic and rides the MXU; exact f32 rescoring happens host-side
+        self.device_rank = None
+        self.device_x2 = None  # f32 row norms² (euclidean ranking)
         self.mesh = None
 
     # -- cache sync ---------------------------------------------------------
@@ -174,6 +178,8 @@ class TpuVectorIndex:
             self.rids.extend(add_rids)
         self.device_vecs = None
         self.device_valid = None
+        self.device_rank = None
+        self.device_x2 = None
         return True
 
     def _rebuild(self, ctx):
@@ -199,6 +205,8 @@ class TpuVectorIndex:
         self.valid = np.ones(len(rids), dtype=bool)
         self.device_vecs = None
         self.device_valid = None
+        self.device_rank = None
+        self.device_x2 = None
         # trim the consumed op log when we can write (bounds log growth)
         if getattr(ctx.txn, "write", False):
             ver = ctx.txn.get_val(K.ix_state(ns, db, tb, ix, b"vn")) or 0
@@ -207,7 +215,7 @@ class TpuVectorIndex:
             ctx.txn.delete_range(beg, end)
 
     def _ensure_device(self):
-        if self.device_vecs is not None:
+        if self.device_vecs is not None or self.device_rank is not None:
             return
         import jax
         import jax.numpy as jnp
@@ -226,6 +234,26 @@ class TpuVectorIndex:
             self.device_valid = jax.device_put(
                 valid, NamedSharding(self.mesh, P("data"))
             )
+            return
+        if self.metric in ("euclidean", "cosine", "dot"):
+            # bf16 ranking store (primary kernel): half the HBM traffic,
+            # MXU matmuls; candidates get exact f32 rescoring on host
+            xs = self.vecs
+            if self.metric == "cosine":
+                norms = np.maximum(
+                    np.linalg.norm(xs, axis=1, keepdims=True), 1e-30
+                )
+                self.device_rank = jnp.asarray(xs / norms, dtype=jnp.bfloat16)
+                self.device_x2 = None
+            elif self.metric == "euclidean":
+                self.device_rank = jnp.asarray(xs, dtype=jnp.bfloat16)
+                self.device_x2 = jnp.asarray(
+                    (xs.astype(np.float64) ** 2).sum(axis=1).astype(np.float32)
+                )
+            else:
+                self.device_rank = jnp.asarray(xs, dtype=jnp.bfloat16)
+                self.device_x2 = None
+            self.device_valid = jnp.asarray(valid)
         else:
             self.device_vecs = jnp.asarray(self.vecs)
             self.device_valid = jnp.asarray(valid)
@@ -285,10 +313,18 @@ class TpuVectorIndex:
                 for i in idx
                 if np.isfinite(d[i])
             ]
+        pairs = self._device_knn_batch(qv[None, :], k)
+        return pairs[0]
+
+    def _device_knn_batch(self, qvs: np.ndarray, k: int):
+        """Batched device search: [B, D] queries -> per-query (rid, dist)
+        lists. The primary path ranks candidates on-device in bf16 and
+        rescores them exactly in f32 on host."""
         self._ensure_device()
         import jax.numpy as jnp
 
-        qs = jnp.asarray(qv[None, :])
+        n = len(self.rids)
+        qs = jnp.asarray(np.ascontiguousarray(qvs, dtype=np.float32))
         if self.mesh is not None:
             from surrealdb_tpu.parallel.mesh import sharded_knn
 
@@ -296,7 +332,36 @@ class TpuVectorIndex:
                 self.mesh, self.device_vecs, qs, self.device_valid, k,
                 self.metric, self.mink_p,
             )
-        elif n > BLOCK_ROWS:
+            dists = np.asarray(dists)
+            ids = np.asarray(ids)
+            return [
+                [
+                    (self.rids[int(i)], float(d))
+                    for d, i in zip(drow, irow)
+                    if 0 <= i < n and np.isfinite(d)
+                ]
+                for drow, irow in zip(dists, ids)
+            ]
+        if self.device_rank is not None:
+            from surrealdb_tpu.ops.topk import knn_rank_candidates
+
+            # oversample to absorb bf16 ranking error, then rescore exactly
+            kc = min(n, max(2 * k, k + 16))
+            ids = np.asarray(knn_rank_candidates(
+                self.device_rank, qs, kc, self.metric,
+                self.device_x2, self.device_valid,
+            ))
+            out = []
+            for b in range(ids.shape[0]):
+                cand = ids[b]
+                cand = cand[cand >= 0]
+                d = self._host_distances(qvs[b], self.vecs[cand])
+                order = np.argsort(d, kind="stable")[:k]
+                out.append([
+                    (self.rids[int(cand[i])], float(d[i])) for i in order
+                ])
+            return out
+        if n > BLOCK_ROWS:
             from surrealdb_tpu.ops.topk import knn_search_blocked
 
             dists, ids = knn_search_blocked(
@@ -310,17 +375,19 @@ class TpuVectorIndex:
                 self.device_vecs, qs, k, self.metric, self.mink_p,
                 self.device_valid,
             )
-        dists = np.asarray(dists[0])
-        ids = np.asarray(ids[0])
-        out = []
-        for d, i in zip(dists, ids):
-            if i < 0 or not np.isfinite(d) or i >= n:
-                continue
-            out.append((self.rids[int(i)], float(d)))
-        return out
+        dists = np.asarray(dists)
+        ids = np.asarray(ids)
+        return [
+            [
+                (self.rids[int(i)], float(d))
+                for d, i in zip(drow, irow)
+                if 0 <= i < n and np.isfinite(d)
+            ]
+            for drow, irow in zip(dists, ids)
+        ]
 
-    def _host_distances(self, qv):
-        xs = self.vecs
+    def _host_distances(self, qv, xs=None):
+        xs = self.vecs if xs is None else xs
         m = self.metric
         if m == "euclidean":
             return np.linalg.norm(xs - qv[None, :], axis=1)
