@@ -158,8 +158,12 @@ class ConfigDef:
     what: str  # API | GRAPHQL
     middleware: list = field(default_factory=list)
     permissions: Any = True
-    tables: Any = "AUTO"
+    # GRAPHQL: "AUTO" | "NONE" | ("INCLUDE"|"EXCLUDE", [names])
+    tables: Any = "NONE"
     functions: Any = "NONE"
+    depth: Any = None
+    complexity: Any = None
+    introspection: Any = None  # "AUTO" (default, unrendered) | "NONE"
 
 
 @dataclass

@@ -74,10 +74,13 @@ class Ctx:
 
     def with_doc(self, doc, doc_id=None) -> "Ctx":
         c = self.child()
-        c.parent_doc = self.doc
+        # $parent = the enclosing context's (possibly pinned) $this —
+        # fixed at the time the enclosing statement started, like $this
+        pin = self.vars.get("this", self.doc)
+        c.parent_doc = pin
         c.doc = doc
         c.doc_id = doc_id
-        c.vars["parent"] = self.doc
+        c.vars["parent"] = pin
         c.vars["this"] = doc
         return c
 

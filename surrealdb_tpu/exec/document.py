@@ -54,10 +54,24 @@ SKIP = _Skip()
 # ---------------------------------------------------------------------------
 
 
-def apply_data(doc: dict, data, ctx: Ctx, rid=None):
-    """Apply SET/UNSET/CONTENT/MERGE/REPLACE/PATCH to a doc (mutates copy)."""
+_THIS_DEFAULT = object()
+
+
+def apply_data(doc: dict, data, ctx: Ctx, rid=None, this_doc=_THIS_DEFAULT):
+    """Apply SET/UNSET/CONTENT/MERGE/REPLACE/PATCH to a doc (mutates copy).
+
+    `this_doc` pins what `$this` evaluates to during the data expressions:
+    the reference fixes $this at the state the record had when the
+    statement started (NONE for fresh creates) — it does NOT track the
+    assignments as they land (language/statements/define/param/this.surql).
+    """
     if data is None:
         return doc
+    if this_doc is _THIS_DEFAULT:
+        this_doc = doc
+    if not isinstance(data, SetData):
+        ctx = ctx.child()
+        ctx.vars["this"] = this_doc
     if isinstance(data, (ContentData, ReplaceData)):
         v = evaluate(data.expr, ctx)
         if not isinstance(v, dict):
@@ -86,6 +100,9 @@ def apply_data(doc: dict, data, ctx: Ctx, rid=None):
     if isinstance(data, SetData):
         out = copy_value(doc)
         c = ctx.with_doc(out, rid)
+        # bare-field references see assignments as they land (sequential
+        # SET), but $this stays pinned to the statement-start state
+        c.vars["this"] = this_doc
         for target, op, expr in data.items:
             v = evaluate(expr, c)
             path = _idiom_path(target)
@@ -125,9 +142,19 @@ def apply_data(doc: dict, data, ctx: Ctx, rid=None):
 
 def _add_assign(cur, v):
     if cur is NONE or cur is None:
+        # reference increment on an absent field: numbers stay scalar,
+        # anything else starts an array (SET citizens += person -> [person])
+        from decimal import Decimal
+
+        from surrealdb_tpu.val import Duration
+
         if isinstance(v, list):
             return v
-        return [v] if False else v
+        if isinstance(v, (int, float, Decimal, Duration)) and not isinstance(
+            v, bool
+        ):
+            return v
+        return [v]
     if isinstance(cur, list):
         return cur + (v if isinstance(v, list) else [v])
     from surrealdb_tpu.exec.operators import add
@@ -350,10 +377,14 @@ def apply_fields(
                     )
                 if old is not NONE:
                     cur = old
-            # TYPE coercion
+            # TYPE coercion — a definition on `id` constrains the record
+            # KEY, not the RecordId value itself (reference doc/field.rs)
             if fd.kind is not None:
                 try:
-                    cur = coerce(cur, fd.kind)
+                    if path == ["id"] and isinstance(cur, RecordId):
+                        coerce(cur.id, fd.kind)
+                    else:
+                        cur = coerce(cur, fd.kind)
                 except SdbError as e:
                     raise SdbError(
                         f"Couldn't coerce value for field `{fd.name_str}` of `{rid.render() if rid else '?'}`: {e}"
@@ -887,26 +918,50 @@ def notify_lives(rid, before, after, action, ctx: Ctx):
         ctx.ds.notify(Notification(sub.id, action, rid, payload))
 
 
-def update_views(rid, ctx: Ctx):
-    """Refresh materialized views that source from this table."""
+def view_source_tables(sel) -> list:
+    """Table names a view's SELECT reads from."""
+    froms = []
+    for w in getattr(sel, "what", []):
+        if isinstance(w, Idiom) and len(w.parts) == 1 and isinstance(
+            w.parts[0], PField
+        ):
+            froms.append(w.parts[0].name)
+    return froms
+
+
+def update_views(rid, before, after, action, ctx: Ctx):
+    """Refresh materialized views that source from this table: the
+    incremental aggregation engine (exec/views.py, reference doc/table.rs)
+    when the view shape supports it, else a scan-based rebuild."""
+    from surrealdb_tpu.exec import views as V
+
     ns, db = ctx.need_ns_db()
     for _k, tdef in ctx.txn.scan_vals(*K.prefix_range(K.tb_prefix(ns, db))):
         if tdef.view is None:
             continue
-        sel = tdef.view
-        froms = []
-        for w in getattr(sel, "what", []):
-            if isinstance(w, Idiom) and len(w.parts) == 1 and isinstance(
-                w.parts[0], PField
-            ):
-                froms.append(w.parts[0].name)
-        if rid.tb in froms:
-            # a broken view definition must not fail writes to its source
-            # table (reference recomputes views async in doc/table.rs)
+        froms = view_source_tables(tdef.view)
+        if rid.tb not in froms:
+            continue
+        try:
+            analysis = _view_analysis(tdef, ctx)
+        except V.Unsupported:
+            analysis = None
+        if analysis is not None:
+            # aggregate-argument type errors DO fail the source write
+            # (reference: "Argument 1 was the wrong type"); other errors
+            # in view machinery must not break source writes
+            V.process_view(tdef, analysis, rid, before, after, action, ctx)
+        else:
             try:
                 rebuild_view(tdef, ctx)
             except SdbError:
                 pass
+
+
+def _view_analysis(tdef, ctx):
+    from surrealdb_tpu.exec import views as V
+
+    return V.analyze_view(tdef.view)
 
 
 def rebuild_view(tdef: TableDef, ctx: Ctx):
@@ -915,6 +970,19 @@ def rebuild_view(tdef: TableDef, ctx: Ctx):
     ns, db = ctx.need_ns_db()
     # clear existing view rows
     ctx.txn.delete_range(*K.prefix_range(K.record_prefix(ns, db, tdef.name)))
+    # an aggregate view over zero source rows materializes NOTHING — the
+    # GROUP ALL row only appears once source writes contribute (reference
+    # doc/table.rs incremental model; view/removed.surql)
+    empty = True
+    for src in view_source_tables(tdef.view):
+        for _ in ctx.txn.scan(*K.prefix_range(K.record_prefix(ns, db, src)),
+                              limit=1):
+            empty = False
+            break
+        if not empty:
+            break
+    if empty:
+        return
     rows = _s_select(tdef.view, ctx.child())
     if not isinstance(rows, list):
         rows = [rows]
@@ -1063,7 +1131,7 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
     # live queries
     notify_lives(rid, before, after, action, ctx)
     # views
-    update_views(rid, ctx)
+    update_views(rid, before, after, action, ctx)
     return shape_output(output, before, after, rid, ctx)
 
 
@@ -1134,7 +1202,7 @@ def create_one(target, data, output, ctx: Ctx, upsert=False):
     else:
         raise SdbError(f"Cannot CREATE {render(target)}")
     seed = {"id": explicit} if explicit is not None else {}
-    doc = apply_data(seed, data, ctx, explicit)
+    doc = apply_data(seed, data, ctx, explicit, this_doc=NONE)
     nid = doc.get("id", NONE)
     if explicit is not None:
         if nid is not NONE and not _id_matches(nid, explicit):
@@ -1248,7 +1316,7 @@ def relate_insert_one(into, doc, ignore, output, ctx: Ctx):
 
 def update_one(rid: RecordId, before: dict, data, output, ctx: Ctx):
     c = ctx.with_doc(before, rid)
-    after = apply_data(before, data, c, rid)
+    after = apply_data(before, data, c, rid, this_doc=before)
     nid = after.get("id", NONE)
     if nid is not NONE and not _id_matches(nid, rid):
         raise SdbError(
@@ -1294,7 +1362,7 @@ def delete_one(rid: RecordId, before, output, ctx: Ctx):
     write_changefeed(rid, before, NONE, "DELETE", ctx)
     run_events(rid, before, NONE, "DELETE", ctx)
     notify_lives(rid, before, NONE, "DELETE", ctx)
-    update_views(rid, ctx)
+    update_views(rid, before, NONE, "DELETE", ctx)
     if output is None:
         return NONE
     return shape_output(output, before, NONE, rid, ctx)
@@ -1312,7 +1380,7 @@ def relate_one(kind, fr: RecordId, to: RecordId, data, output, ctx: Ctx, uniq=Fa
         rid = RecordId(tb, generate_record_key())
     else:
         raise SdbError(f"Cannot use {render(kind)} as a RELATE target")
-    doc = apply_data({"id": rid}, data, ctx, rid)
+    doc = apply_data({"id": rid}, data, ctx, rid, this_doc=NONE)
     nid = doc.get("id")
     if isinstance(nid, RecordId) and (nid.tb != rid.tb or not value_eq(nid.id, rid.id)):
         rid = nid

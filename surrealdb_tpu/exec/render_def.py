@@ -783,12 +783,44 @@ def render_config(d) -> str:
         return out
     if d.what == "GRAPHQL":
         def part(v):
+            if isinstance(v, tuple):
+                return f"{v[0]} " + ", ".join(v[1])
             if isinstance(v, list):
                 return "INCLUDE " + ", ".join(v)
             return str(v)
 
-        return f"GRAPHQL TABLES {part(d.tables)} FUNCTIONS {part(d.functions)}"
+        out = f"GRAPHQL TABLES {part(d.tables)} FUNCTIONS {part(d.functions)}"
+        if getattr(d, "depth", None) is not None:
+            out += f" DEPTH {d.depth}"
+        if getattr(d, "complexity", None) is not None:
+            out += f" COMPLEXITY {d.complexity}"
+        if getattr(d, "introspection", None) == "NONE":
+            out += " INTROSPECTION NONE"
+        return out
     return d.what
+
+
+def config_structure(d) -> dict:
+    """INFO FOR DB STRUCTURE entry for one config definition."""
+    from surrealdb_tpu.val import NONE as _NONE
+
+    def part(v):
+        if isinstance(v, tuple):
+            return {v[0].lower(): list(v[1])}
+        if v == "NONE":
+            return _NONE
+        return v
+
+    if d.what == "GRAPHQL":
+        out = {"tables": part(d.tables), "functions": part(d.functions)}
+        if getattr(d, "depth", None) is not None:
+            out["depth_limit"] = d.depth
+        if getattr(d, "complexity", None) is not None:
+            out["complexity_limit"] = d.complexity
+        if getattr(d, "introspection", None) == "NONE":
+            out["introspection"] = _NONE
+        return {"graphql": out}
+    return {d.what.lower(): {}}
 
 
 def render_sequence(d) -> str:

@@ -284,6 +284,12 @@ def _scan_table(tb: str, ctx, cond=None, stmt=None):
     from surrealdb_tpu.exec.eval import apply_computed_fields, computed_fields_of
     from surrealdb_tpu.idx.planner import plan_scan
 
+    # the reference errors when scanning a table that was never defined
+    # (language/statements/for/break_in_function.surql et al.)
+    _ns0, _db0 = ctx.need_ns_db()
+    if ctx.txn.get(K.tb_def(_ns0, _db0, tb)) is None:
+        raise SdbError(f"The table '{tb}' does not exist")
+
     plan = plan_scan(tb, cond, ctx, stmt) if ctx.version is None else None
     if plan is not None:
         yield from plan
@@ -1012,6 +1018,14 @@ def _eval_aggregate(expr, members, ctx):
             return vals[-1] if vals else NONE
         if fname == "array::len":
             return len(vals)
+        if fname in ("math::stddev", "math::variance") and len([
+            x for x in vals if not isinstance(x, bool)
+            and isinstance(x, (int, float))
+        ]) <= 1:
+            # the grouped aggregate reports 0 for a single-member group
+            # (reference catalog/aggregation.rs create_field_document),
+            # unlike the plain math:: function which yields NaN
+            return 0.0
         return FUNCS[fname]([vals] + extra, ctx)
     if isinstance(expr, Binary):
         return _binary_aggregate(expr, members, ctx)
@@ -2429,13 +2443,38 @@ def _s_define_table(n: DefineTable, ctx):
 
 
 def _materialize_view(tdef: TableDef, ctx):
-    """Populate a `DEFINE TABLE ... AS SELECT` view immediately (the
-    reference recomputes incrementally in doc/table.rs; we rebuild).
-    Build errors don't fail the DEFINE (reference builds async)."""
-    from surrealdb_tpu.exec.document import rebuild_view
+    """Populate a `DEFINE TABLE ... AS SELECT` view at definition time by
+    feeding every existing source record through the incremental engine
+    (reference doc/table.rs model — leaves per-group aggregation stats in
+    place for later writes). Build errors don't fail the DEFINE."""
+    from surrealdb_tpu.exec import views as V
+    from surrealdb_tpu.exec.document import rebuild_view, view_source_tables
+    from surrealdb_tpu.kvs.api import deserialize
 
     try:
-        rebuild_view(tdef, ctx)
+        analysis = V.analyze_view(tdef.view)
+    except V.Unsupported:
+        analysis = None
+    if analysis is None:
+        try:
+            rebuild_view(tdef, ctx)
+        except SdbError:
+            pass
+        return
+    ns, db = ctx.need_ns_db()
+    # clear any stale rows + stats for a redefinition
+    ctx.txn.delete_range(*K.prefix_range(K.record_prefix(ns, db, tdef.name)))
+    ctx.txn.delete_range(*K.prefix_range(K.view_meta(ns, db, tdef.name)))
+    try:
+        for src in view_source_tables(tdef.view):
+            beg, end = K.prefix_range(K.record_prefix(ns, db, src))
+            for k, raw in list(ctx.txn.scan(beg, end)):
+                doc = deserialize(raw)
+                rid = doc.get("id") if isinstance(doc, dict) else None
+                if not isinstance(rid, RecordId):
+                    _ns2, _db2, _tb2, idv = K.decode_record_id(k)
+                    rid = RecordId(src, idv)
+                V.process_view(tdef, analysis, rid, NONE, doc, "CREATE", ctx)
     except SdbError:
         pass
 
@@ -2976,7 +3015,8 @@ def _s_define_config(n: DefineConfig, ctx):
         )
         return NONE
     key = K.cfg_def(ns, db, n.what)
-    if _exists_guard(ctx, key, n.what, "config", n.if_not_exists, n.overwrite):
+    if _exists_guard(ctx, key, n.what, "config", n.if_not_exists, n.overwrite,
+                     msg=f"The config for {n.what.lower()} already exists"):
         return NONE
     cd = ConfigDef(n.what)
     cfg = n.config
@@ -2988,6 +3028,12 @@ def _s_define_config(n: DefineConfig, ctx):
         cd.tables = cfg["tables"]
     if "functions" in cfg:
         cd.functions = cfg["functions"]
+    if "depth" in cfg:
+        cd.depth = cfg["depth"]
+    if "complexity" in cfg:
+        cd.complexity = cfg["complexity"]
+    if "introspection" in cfg:
+        cd.introspection = cfg["introspection"]
     ctx.txn.set_val(key, cd)
     return NONE
 
@@ -3026,6 +3072,22 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
         key = K.tb_def(ns, db, n.name)
         if _guard(key, n.name):
             return NONE
+        # a table with dependent views cannot be removed (reference
+        # catalog guard; view/removed.surql, view/delete_view.surql)
+        from surrealdb_tpu.exec.document import view_source_tables
+
+        dependents = [
+            d.name
+            for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.tb_prefix(ns, db)))
+            if d.view is not None and d.name != n.name
+            and n.name in view_source_tables(d.view)
+        ]
+        if dependents:
+            raise SdbError(
+                f"Invalid query: Cannot delete table `{n.name}` on which a "
+                f"view is defined, table(s) `{'`, `'.join(dependents)}` are "
+                f"defined as a view on this table."
+            )
         ctx.txn.delete(key)
         for kk in (K.fd_prefix, K.ix_prefix, K.ev_prefix, K.lq_prefix):
             ctx.txn.delete_range(*K.prefix_range(kk(ns, db, n.name)))
@@ -3469,8 +3531,18 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             *K.prefix_range(K.bucket_prefix(ns, db))
         ):
             out["buckets"][d.name] = render_bucket(d)
+        _cfg_names = {"GRAPHQL": "GraphQL", "API": "API", "DEFAULT": "Default"}
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.cfg_prefix(ns, db))):
-            out["configs"][d.what] = render_config(d)
+            out["configs"][_cfg_names.get(d.what, d.what)] = render_config(d)
+        if n.structure:
+            from surrealdb_tpu.exec.render_def import config_structure
+
+            out["configs"] = [
+                config_structure(d)
+                for _k, d in ctx.txn.scan_vals(
+                    *K.prefix_range(K.cfg_prefix(ns, db))
+                )
+            ]
         return out
     if n.level == "table":
         from surrealdb_tpu.exec.render_def import (
@@ -3507,6 +3579,14 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             out["indexes"][d.name] = render_index(d)
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ev_prefix(ns, db, tb))):
             out["events"][d.name] = render_event(d, tb)
+        # views (foreign tables) whose FROM sources this table are listed
+        # under `tables` (reference catalog: table definitions carry their
+        # source link; INFO FOR TABLE shows dependent views)
+        from surrealdb_tpu.exec.document import view_source_tables
+
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.tb_prefix(ns, db))):
+            if d.view is not None and tb in view_source_tables(d.view):
+                out["tables"][d.name] = render_table(d)
         return out
     if n.level == "index":
         ns, db = ctx.need_ns_db()

@@ -283,10 +283,14 @@ def _product(args, ctx):
 
 @register("math::mean")
 def _mean(args, ctx):
-    ns = _nums(args[0], "math::mean")
+    ns = _nums(args[0], "math::mean", keep=True)
     if not ns:
         return float("nan")
-    return sum(ns) / len(ns)
+    # Number division semantics: int sum / int count stays int when exact
+    # (reference fnc/util/math/mean — view rolling means surface this)
+    from surrealdb_tpu.exec.operators import div
+
+    return div(sum(ns), len(ns))
 
 
 @register("math::median")

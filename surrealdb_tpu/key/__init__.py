@@ -623,3 +623,11 @@ def ix_state(ns, db, tb, ix, kind: bytes, suffix: bytes = b"") -> bytes:
 def prefix_range(prefix: bytes) -> tuple[bytes, bytes]:
     """(begin, end) byte range covering every key with this prefix."""
     return prefix, prefix + b"\xff\xff\xff\xff\xff\xff\xff\xff"
+
+
+def view_meta(ns, db, tb, keybytes: bytes = b"") -> bytes:
+    """Per-view-row aggregation metadata (reference: Record.metadata
+    aggregation_stats, doc/table.rs) — stored beside the view record.
+    Deliberately outside the `/!` catalog space so per-write metadata
+    updates don't generate catalog history entries."""
+    return b"/^vm" + enc_str(ns) + enc_str(db) + enc_str(tb) + keybytes

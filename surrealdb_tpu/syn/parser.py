@@ -812,35 +812,51 @@ class Parser:
                     else:
                         break
                 return DefineConfig("DEFAULT", cfg, ine, ow)
+            def _name_list():
+                inc = [self.ident()]
+                while self.eat_op(","):
+                    inc.append(self.ident())
+                return inc
+
             while True:
                 if self.eat_kw("middleware"):
                     cfg["middleware"] = self._parse_middleware()
                 elif self.eat_kw("permissions"):
                     cfg["permissions"] = self._parse_permissions_value()
                 elif self.eat_kw("auto"):
+                    # bare AUTO sets both tables and functions
                     cfg["tables"] = "AUTO"
+                    cfg["functions"] = "AUTO"
                 elif self.eat_kw("none"):
                     cfg["tables"] = "NONE"
+                    cfg["functions"] = "NONE"
                 elif self.eat_kw("tables"):
                     if self.eat_kw("auto"):
                         cfg["tables"] = "AUTO"
                     elif self.eat_kw("none"):
                         cfg["tables"] = "NONE"
                     elif self.eat_kw("include"):
-                        inc = [self.ident()]
-                        while self.eat_op(","):
-                            inc.append(self.ident())
-                        cfg["tables"] = inc
+                        cfg["tables"] = ("INCLUDE", _name_list())
+                    elif self.eat_kw("exclude"):
+                        cfg["tables"] = ("EXCLUDE", _name_list())
                 elif self.eat_kw("functions"):
                     if self.eat_kw("auto"):
                         cfg["functions"] = "AUTO"
                     elif self.eat_kw("none"):
                         cfg["functions"] = "NONE"
                     elif self.eat_kw("include"):
-                        inc = [self.ident()]
-                        while self.eat_op(","):
-                            inc.append(self.ident())
-                        cfg["functions"] = inc
+                        cfg["functions"] = ("INCLUDE", _name_list())
+                    elif self.eat_kw("exclude"):
+                        cfg["functions"] = ("EXCLUDE", _name_list())
+                elif self.eat_kw("depth"):
+                    cfg["depth"] = self.next().value
+                elif self.eat_kw("complexity"):
+                    cfg["complexity"] = self.next().value
+                elif self.eat_kw("introspection"):
+                    if self.eat_kw("auto"):
+                        cfg["introspection"] = "AUTO"
+                    elif self.eat_kw("none"):
+                        cfg["introspection"] = "NONE"
                 else:
                     break
             return DefineConfig(what, cfg, ine, ow)

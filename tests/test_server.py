@@ -231,8 +231,11 @@ def test_secure_anonymous_denied(secure_server):
     out = json.loads(b)
     assert out[0]["status"] == "ERR"
     # nothing was written
-    rows = _ds.query_one("SELECT * FROM locked", ns="t", db="t")
-    assert rows == []
+    out = _ds.execute("SELECT * FROM locked", ns="t", db="t")[0]
+    # nothing was written — the table was never created
+    assert out.result in ([], None) or (
+        out.error is not None and "does not exist" in out.error
+    )
 
 
 def test_secure_token_and_basic_auth(secure_server):

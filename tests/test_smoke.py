@@ -83,7 +83,10 @@ def test_transactions(ds):
     )
     errs = [r for r in res if not r.ok]
     assert errs
-    assert ds.query("SELECT * FROM a")[0] == []
+    # the rolled-back CREATE never defined the table, and the reference
+    # errors when selecting from an undefined table
+    out = ds.execute("SELECT * FROM a", ns="test", db="test")[0]
+    assert out.error is not None and "does not exist" in out.error
 
 
 def test_define_field_schema(q):
