@@ -260,7 +260,18 @@ def _e_array(n, ctx):
 
 
 def _e_object(n, ctx):
-    return {k: evaluate(v, ctx) for k, v in n.items}
+    out = {k: evaluate(v, ctx) for k, v in n.items}
+    # GeoJSON-shaped object literals become Geometry values (reference
+    # expr object computation auto-detects { type, coordinates })
+    if len(out) == 2 and "type" in out and (
+        "coordinates" in out or "geometries" in out
+    ):
+        from surrealdb_tpu.exec.coerce import object_to_geometry
+
+        g = object_to_geometry(out)
+        if g is not None:
+            return g
+    return out
 
 
 def _e_set(n, ctx):

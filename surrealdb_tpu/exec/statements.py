@@ -485,11 +485,60 @@ def expr_name(expr, sql=False) -> str:
     return "field"
 
 
+def _ast_params(node, out, _depth=0):
+    """Collect Param names referenced anywhere in an AST fragment."""
+    import dataclasses
+
+    from surrealdb_tpu.expr.ast import Param as _Param, Subquery as _Sub
+
+    if _depth > 40 or node is None:
+        return
+    if isinstance(node, _Param):
+        out.add(node.name)
+        return
+    if isinstance(node, _Sub) and isinstance(node.stmt, SelectStmt):
+        return  # SELECT subqueries get their own document context
+    if isinstance(node, (list, tuple)):
+        for x in node:
+            _ast_params(x, out, _depth + 1)
+        return
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        for f in dataclasses.fields(node):
+            _ast_params(getattr(node, f.name), out, _depth + 1)
+
+
+def _check_group_params(n):
+    """Grouped selects have no document for $this/$parent to refer to
+    (reference catalog/aggregation.rs AggregateExprCollector)."""
+    names: set = set()
+    for expr, _a in n.exprs:
+        if expr != "*":
+            _ast_params(expr, names)
+    if n.value is not None:
+        _ast_params(n.value, names)
+    if "this" in names or "self" in names:
+        raise SdbError(
+            "Invalid query: Found a `$this` parameter refering to the "
+            "document of a group by select statement\n"
+            "Select statements with a group by currently have no defined "
+            "document to refer to"
+        )
+    if "parent" in names:
+        raise SdbError(
+            "Invalid query: Found a `$parent` parameter refering to the "
+            "document of a GROUP select statement\n"
+            "Select statements with a GROUP BY or GROUP ALL currently have "
+            "no defined document to refer to"
+        )
+
+
 def _s_select(n: SelectStmt, ctx: Ctx):
     ctx.check_deadline()
     c = _timeout_ctx(n, ctx)
     if c is ctx:
         c = ctx.child()
+    if n.group is not None:
+        _check_group_params(n)
     if n.explain:
         return _explain_select(n, c)
     # VERSION clause
@@ -552,20 +601,6 @@ def _select_pipeline(n: SelectStmt, rows, c):
     # SPLIT
     for sp in n.split:
         rows = _apply_split(rows, sp, c)
-    # OMIT applies to the records before grouping/projection; expand
-    # type::field()/type::fields() calls once, not per row
-    if n.omit:
-        omits = _expand_omits(n.omit, c)
-        for src in rows:
-            doc = src.doc if src.rid is not None else src.value
-            if isinstance(doc, dict):
-                doc = copy_value(doc)
-                for om in omits:
-                    _omit_path(doc, om, c)
-                if src.rid is not None:
-                    src.doc = doc
-                else:
-                    src.value = doc
     # alias map: ORDER BY / GROUP BY may reference projection aliases
     aliases = {}
     for expr, alias in n.exprs:
@@ -617,17 +652,36 @@ def _select_pipeline(n: SelectStmt, rows, c):
         if n.limit is not None:
             rows = rows[: int(evaluate(n.limit, c))]
         out_rows = [_project(src, n, c) for src in rows]
+    # OMIT applies to the OUTPUT records (reference pluck stage): after
+    # grouping/projection, so omitted group keys still group and omitted
+    # projected fields disappear entirely
+    if n.omit:
+        omits = _expand_omits(n.omit, c)
+        pruned = []
+        for r in out_rows:
+            if isinstance(r, dict):
+                r = copy_value(r)
+                for om in omits:
+                    _omit_path(r, om, c)
+            pruned.append(r)
+        out_rows = pruned
     # FETCH
     if n.fetch:
         out_rows = [apply_fetch(r, n.fetch, c) for r in out_rows]
     if n.only:
-        # target-level check: FROM ONLY NONE / [] / [a, b] error outright;
-        # zero ROWS from a valid single target return NONE (reference
-        # select.rs empty-array case)
+        # target-level check: FROM ONLY NONE / [] / [a, b] error outright —
+        # but a LIMIT 1 caps the stream before the check (reference
+        # select.rs); zero ROWS from a valid single target return NONE
+        limited_to_one = (
+            n.limit is not None and int(evaluate(n.limit, c)) == 1
+        )
         if len(n.what) == 1:
             tv = _target_value(n.what[0], c)
-            if tv is NONE or tv is None or (
-                isinstance(tv, list) and len(tv) != 1
+            too_many = (
+                isinstance(tv, list) and len(tv) > 1 and not limited_to_one
+            )
+            if tv is NONE or tv is None or too_many or (
+                isinstance(tv, list) and len(tv) == 0
             ):
                 raise SdbError(
                     "Expected a single result output when using the ONLY keyword"
@@ -1093,6 +1147,10 @@ def _apply_order_sources(rows, order, ctx, aliases=None):
 
 
 def _order_cmp(v, w, collate, numeric):
+    if collate and isinstance(v, str) and isinstance(w, str):
+        from surrealdb_tpu.utils.translit import lexical_cmp
+
+        return lexical_cmp(v, w, numeric=numeric)
     if numeric and isinstance(v, str) and isinstance(w, str):
         import re
 
@@ -1110,9 +1168,6 @@ def _order_cmp(v, w, collate, numeric):
             if x != y:
                 return -1 if x < y else 1
         return (len(a) > len(b)) - (len(a) < len(b))
-    if collate and isinstance(v, str) and isinstance(w, str):
-        a, b = v.casefold(), w.casefold()
-        return (a > b) - (a < b)
     return value_cmp(v, w)
 
 

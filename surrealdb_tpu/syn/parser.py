@@ -75,7 +75,12 @@ class Parser:
 
     def at_kw(self, *words) -> bool:
         t = self.peek()
-        return t.kind == L.IDENT and t.value.lower() in words
+        # quoted identifiers (`value`, ⟨value⟩) are never keywords
+        return (
+            t.kind == L.IDENT
+            and t.value.lower() in words
+            and not t.text.startswith(("`", "⟨"))
+        )
 
     def eat_kw(self, *words) -> bool:
         if self.at_kw(*words):
@@ -538,6 +543,12 @@ class Parser:
                 into = Literal(Table(t.value))
             else:
                 into = self.parse_expr()
+        if self.at_op("(") and self._peek2_is_kw(
+            "select", "create", "update", "delete", "insert", "return"
+        ):
+            # INSERT INTO t (SELECT ...) — parenthesized subquery source
+            data = self.parse_expr()
+            return self._insert_finish(into, data, ignore, relation)
         if self.at_op("("):
             # INSERT INTO t (a, b) VALUES (1, 2), (3, 4)
             self.next()
@@ -557,6 +568,18 @@ class Parser:
             data = InsertRows(fields, rows)
         else:
             data = self.parse_expr()
+        return self._insert_finish(into, data, ignore, relation)
+
+    def _peek2_is_kw(self, *words) -> bool:
+        t = self.toks[self.i + 1] if self.i + 1 < len(self.toks) else None
+        return (
+            t is not None
+            and t.kind == L.IDENT
+            and t.value.lower() in words
+            and not t.text.startswith(("`", "⟨"))
+        )
+
+    def _insert_finish(self, into, data, ignore, relation):
         update = None
         if self.eat_kw("on"):
             self.expect_kw("duplicate")

@@ -1,0 +1,200 @@
+"""Unicode→ASCII transliteration for collation-aware ordering.
+
+The reference's `ORDER BY ... COLLATE` uses the lexicmp crate: each char is
+transliterated to ASCII (deunicode-style), the transliterations compare
+case-insensitively, and fully-equal keys fall back to codepoint order of
+the originals (core/src/val/value/compare.rs lexical_cmp /
+natural_lexical_cmp). This module provides the transliteration: NFKD
+accent-stripping for Latin, romanization tables for Greek/Cyrillic/Arabic/
+Thai, algorithmic Hangul-jamo and kana romanization, and a curated pinyin
+table for common CJK ideographs (deunicode renders ideographs capitalized
+with a trailing space). Unknown symbols (emoji etc.) transliterate to ""
+so their relative order falls back to codepoints.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from functools import lru_cache
+
+_SPECIAL = {
+    "ß": "ss", "ẞ": "SS", "æ": "ae", "Æ": "AE", "ø": "o", "Ø": "O",
+    "œ": "oe", "Œ": "OE", "þ": "th", "Þ": "Th", "ð": "d", "Ð": "D",
+    "đ": "d", "Đ": "D", "ħ": "h", "Ħ": "H", "ł": "l", "Ł": "L",
+    "ı": "i", "İ": "I", "ĳ": "ij", "Ĳ": "IJ", "ŉ": "'n", "ſ": "s",
+}
+
+_GREEK = {
+    "α": "a", "β": "b", "γ": "g", "δ": "d", "ε": "e", "ζ": "z",
+    "η": "e", "θ": "th", "ι": "i", "κ": "k", "λ": "l", "μ": "m",
+    "ν": "n", "ξ": "x", "ο": "o", "π": "p", "ρ": "r", "σ": "s",
+    "ς": "s", "τ": "t", "υ": "y", "φ": "ph", "χ": "ch", "ψ": "ps",
+    "ω": "o",
+}
+
+_CYRILLIC = {
+    "а": "a", "б": "b", "в": "v", "г": "g", "д": "d", "е": "e",
+    "ё": "e", "ж": "zh", "з": "z", "и": "i", "й": "i", "к": "k",
+    "л": "l", "м": "m", "н": "n", "о": "o", "п": "p", "р": "r",
+    "с": "s", "т": "t", "у": "u", "ф": "f", "х": "kh", "ц": "ts",
+    "ч": "ch", "ш": "sh", "щ": "shch", "ъ": "", "ы": "y", "ь": "",
+    "э": "e", "ю": "yu", "я": "ya", "є": "ye", "і": "i", "ї": "yi",
+    "ґ": "g", "ў": "u",
+}
+
+_ARABIC = {
+    "ا": "", "أ": "a", "إ": "i", "آ": "a", "ب": "b", "ت": "t",
+    "ث": "th", "ج": "j", "ح": "h", "خ": "kh", "د": "d", "ذ": "dh",
+    "ر": "r", "ز": "z", "س": "s", "ش": "sh", "ص": "s", "ض": "d",
+    "ط": "t", "ظ": "z", "ع": "'", "غ": "gh", "ف": "f", "ق": "q",
+    "ك": "k", "ل": "l", "م": "m", "ن": "n", "ه": "h", "و": "w",
+    "ي": "y", "ى": "a", "ء": "'", "ة": "h", "ئ": "'", "ؤ": "'",
+}
+
+_HEBREW = {
+    "א": "", "ב": "b", "ג": "g", "ד": "d", "ה": "h", "ו": "v",
+    "ז": "z", "ח": "ch", "ט": "t", "י": "y", "כ": "k", "ך": "k",
+    "ל": "l", "מ": "m", "ם": "m", "נ": "n", "ן": "n", "ס": "s",
+    "ע": "", "פ": "p", "ף": "p", "צ": "ts", "ץ": "ts", "ק": "q",
+    "ר": "r", "ש": "sh", "ת": "t",
+}
+
+_THAI = {
+    "ก": "k", "ข": "kh", "ฃ": "kh", "ค": "kh", "ฅ": "kh", "ฆ": "kh",
+    "ง": "ng", "จ": "ch", "ฉ": "ch", "ช": "ch", "ซ": "ch", "ฌ": "ch",
+    "ญ": "y", "ฎ": "d", "ฏ": "t", "ฐ": "th", "ฑ": "th", "ฒ": "th",
+    "ณ": "n", "ด": "d", "ต": "t", "ถ": "th", "ท": "th", "ธ": "th",
+    "น": "n", "บ": "b", "ป": "p", "ผ": "ph", "ฝ": "f", "พ": "ph",
+    "ฟ": "f", "ภ": "ph", "ม": "m", "ย": "y", "ร": "r", "ล": "l",
+    "ว": "w", "ศ": "s", "ษ": "s", "ส": "s", "ห": "h", "ฬ": "l",
+    "อ": "", "ฮ": "h", "ะ": "a", "ั": "a", "า": "a", "ำ": "am",
+    "ิ": "i", "ี": "i", "ึ": "ue", "ื": "ue", "ุ": "u", "ู": "u",
+    "เ": "e", "แ": "ae", "โ": "o", "ใ": "ai", "ไ": "ai", "ๅ": "",
+    "็": "", "่": "", "้": "", "๊": "", "๋": "", "์": "",
+}
+
+# Common CJK ideographs (deunicode style: capitalized pinyin + trailing
+# space). Curated, not exhaustive — unknown ideographs transliterate to ""
+# and fall back to codepoint order.
+_CJK = {
+    "中": "Zhong ", "文": "Wen ", "世": "Shi ", "界": "Jie ",
+    "你": "Ni ", "好": "Hao ", "国": "Guo ", "汉": "Han ",
+    "日": "Ri ", "本": "Ben ", "語": "Yu ", "语": "Yu ",
+    "人": "Ren ", "大": "Da ", "小": "Xiao ", "上": "Shang ",
+    "下": "Xia ", "天": "Tian ", "地": "Di ", "水": "Shui ",
+    "火": "Huo ", "山": "Shan ", "口": "Kou ", "心": "Xin ",
+    "学": "Xue ", "生": "Sheng ", "年": "Nian ", "月": "Yue ",
+    "子": "Zi ", "字": "Zi ", "时": "Shi ", "分": "Fen ",
+    "東": "Dong ", "京": "Jing ", "漢": "Han ", "愛": "Ai ",
+}
+
+_HANGUL_L = ["g", "kk", "n", "d", "tt", "r", "m", "b", "pp", "s", "ss",
+             "", "j", "jj", "ch", "k", "t", "p", "h"]
+_HANGUL_V = ["a", "ae", "ya", "yae", "eo", "e", "yeo", "ye", "o", "wa",
+             "wae", "oe", "yo", "u", "wo", "we", "wi", "yu", "eu", "ui",
+             "i"]
+_HANGUL_T = ["", "g", "kk", "gs", "n", "nj", "nh", "d", "l", "lg", "lm",
+             "lb", "ls", "lt", "lp", "lh", "m", "b", "bs", "s", "ss",
+             "ng", "j", "ch", "k", "t", "p", "h"]
+
+_KANA_BASE = {
+    "A": "a", "I": "i", "U": "u", "E": "e", "O": "o",
+    "KA": "ka", "KI": "ki", "KU": "ku", "KE": "ke", "KO": "ko",
+    "SA": "sa", "SI": "shi", "SU": "su", "SE": "se", "SO": "so",
+    "TA": "ta", "TI": "chi", "TU": "tsu", "TE": "te", "TO": "to",
+    "NA": "na", "NI": "ni", "NU": "nu", "NE": "ne", "NO": "no",
+    "HA": "ha", "HI": "hi", "HU": "fu", "HE": "he", "HO": "ho",
+    "MA": "ma", "MI": "mi", "MU": "mu", "ME": "me", "MO": "mo",
+    "YA": "ya", "YU": "yu", "YO": "yo",
+    "RA": "ra", "RI": "ri", "RU": "ru", "RE": "re", "RO": "ro",
+    "WA": "wa", "WI": "wi", "WE": "we", "WO": "wo", "N": "n",
+    "GA": "ga", "GI": "gi", "GU": "gu", "GE": "ge", "GO": "go",
+    "ZA": "za", "ZI": "ji", "ZU": "zu", "ZE": "ze", "ZO": "zo",
+    "DA": "da", "DI": "ji", "DU": "zu", "DE": "de", "DO": "do",
+    "BA": "ba", "BI": "bi", "BU": "bu", "BE": "be", "BO": "bo",
+    "PA": "pa", "PI": "pi", "PU": "pu", "PE": "pe", "PO": "po",
+    "VU": "vu",
+}
+
+
+@lru_cache(maxsize=8192)
+def translit_char(c: str) -> str:
+    """ASCII transliteration of one character ('' when unknown)."""
+    o = ord(c)
+    if o < 0x80:
+        return c
+    if c in _SPECIAL:
+        return _SPECIAL[c]
+    for table in (_GREEK, _CYRILLIC, _ARABIC, _HEBREW, _THAI, _CJK):
+        if c in table:
+            return table[c]
+    lower = c.lower()
+    if lower != c:
+        for table in (_GREEK, _CYRILLIC):
+            if lower in table:
+                return table[lower].upper()
+    # Hangul syllables: algorithmic jamo decomposition
+    if 0xAC00 <= o <= 0xD7A3:
+        i = o - 0xAC00
+        l, v, t = i // 588, (i % 588) // 28, i % 28
+        return _HANGUL_L[l] + _HANGUL_V[v] + _HANGUL_T[t]
+    # kana via character names
+    if 0x3040 <= o <= 0x30FF:
+        try:
+            name = unicodedata.name(c)
+        except ValueError:
+            return ""
+        parts = name.split()
+        if parts and parts[-1] in _KANA_BASE and "LETTER" in parts:
+            r = _KANA_BASE[parts[-1]]
+            return r.capitalize() if parts[0] == "KATAKANA" else r
+        return ""
+    # NFKD accent stripping (Latin-ish scripts)
+    decomp = unicodedata.normalize("NFKD", c)
+    stripped = "".join(x for x in decomp if not unicodedata.combining(x))
+    if stripped and all(ord(x) < 0x80 for x in stripped):
+        return stripped
+    return ""
+
+
+def translit(s: str) -> str:
+    return "".join(translit_char(c) for c in s)
+
+
+def _nat_split(s: str):
+    out = []
+    num = None
+    for c in s:
+        if c.isdigit():
+            num = (num or 0) * 10 + int(c)
+        else:
+            if num is not None:
+                out.append(num)
+                num = None
+            out.append(c)
+    if num is not None:
+        out.append(num)
+    return out
+
+
+def lexical_cmp(a: str, b: str, numeric: bool = False) -> int:
+    """lexicmp::lexical_cmp / natural_lexical_cmp: case-insensitive
+    comparison of transliterations; equal keys fall back to codepoint
+    order of the originals."""
+    ka = translit(a).lower()
+    kb = translit(b).lower()
+    if numeric:
+        pa, pb = _nat_split(ka), _nat_split(kb)
+        for x, y in zip(pa, pb):
+            if isinstance(x, int) != isinstance(y, int):
+                x, y = str(x), str(y)
+            if x != y:
+                return -1 if x < y else 1
+        if len(pa) != len(pb):
+            return -1 if len(pa) < len(pb) else 1
+    else:
+        if ka != kb:
+            return -1 if ka < kb else 1
+    if a == b:
+        return 0
+    return -1 if a < b else 1
