@@ -164,6 +164,9 @@ class ConfigDef:
     depth: Any = None
     complexity: Any = None
     introspection: Any = None  # "AUTO" (default, unrendered) | "NONE"
+    # DEFAULT config (session namespace/database)
+    namespace: Any = None
+    database: Any = None
 
 
 @dataclass

@@ -76,14 +76,14 @@ def apply_data(doc: dict, data, ctx: Ctx, rid=None, this_doc=_THIS_DEFAULT):
         v = evaluate(data.expr, ctx)
         if not isinstance(v, dict):
             raise SdbError(f"Cannot use {render(v)} in a CONTENT clause")
-        out = copy_value(v)
+        out = _prune_none(copy_value(v))
         if "id" not in out and "id" in doc:
             out["id"] = doc["id"]
         return out
     if isinstance(data, MergeData):
         v = evaluate(data.expr, ctx)
         if not isinstance(v, dict):
-            raise SdbError(f"Cannot use {render(v)} as MERGE data")
+            raise SdbError(f"Cannot use {render(v)} in a MERGE clause")
         out = copy_value(doc)
         _deep_merge(out, copy_value(v))
         if "id" in doc:
@@ -173,6 +173,16 @@ def _sub_assign(cur, v):
     from surrealdb_tpu.exec.operators import sub
 
     return sub(cur, v)
+
+
+def _prune_none(v):
+    """NONE entries never store in objects (reference Value semantics):
+    CONTENT { a: NONE } removes `a`, recursively."""
+    if isinstance(v, dict):
+        return {k: _prune_none(x) for k, x in v.items() if x is not NONE}
+    if isinstance(v, list):
+        return [_prune_none(x) for x in v]
+    return v
 
 
 def _deep_merge(dst: dict, src: dict):

@@ -742,6 +742,9 @@ def render_api(d) -> str:
     actions = list(d.actions or [])
     if not any("any" in a.methods for a in actions):
         actions.insert(0, ApiActionDef(methods=["any"]))
+    else:
+        # the fallback (FOR any) always renders first
+        actions.sort(key=lambda a: 0 if "any" in a.methods else 1)
     for a in actions:
         out += " FOR " + ", ".join(a.methods)
         if a.middleware:
@@ -796,6 +799,13 @@ def render_config(d) -> str:
             out += f" COMPLEXITY {d.complexity}"
         if getattr(d, "introspection", None) == "NONE":
             out += " INTROSPECTION NONE"
+        return out
+    if d.what == "DEFAULT":
+        out = "DEFAULT"
+        if getattr(d, "namespace", None):
+            out += f" NAMESPACE {d.namespace}"
+        if getattr(d, "database", None):
+            out += f" DATABASE {d.database}"
         return out
     return d.what
 

@@ -3062,9 +3062,15 @@ def _s_define_config(n: DefineConfig, ctx):
             comment = evaluate(comment, ctx)
             if comment is NONE:
                 comment = None
+        from surrealdb_tpu.buc import check_backend_allowed
+
+        backend = cfg.get("backend")
+        if backend is not None and not isinstance(backend, str):
+            backend = evaluate(backend, ctx)
+        check_backend_allowed(backend)
         ctx.txn.set_val(
             key,
-            BucketDef(cfg["name"], cfg.get("backend"),
+            BucketDef(cfg["name"], backend,
                       cfg.get("readonly", False),
                       cfg.get("permissions", True), comment),
         )
@@ -3236,7 +3242,12 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
         keyf = {"config": K.cfg_def, "api": K.api_def,
                 "bucket": K.bucket_def}[kind]
         nm = n.name.upper() if kind == "config" else n.name
-        key = keyf(ns, db, nm)
+        if kind == "config" and nm == "DEFAULT":
+            # DEFINE stores DEFAULT at root level; REMOVE checks there even
+            # when ALTER upserted a DB-level copy (reference behaviour)
+            key = K.cfg_def("", "", "DEFAULT")
+        else:
+            key = keyf(ns, db, nm)
         if ctx.txn.get(key) is None:
             if n.if_exists:
                 return NONE
@@ -3254,6 +3265,10 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
     raise SdbError(f"unknown REMOVE kind {kind}")
 
 
+def _supports_compaction(ctx) -> bool:
+    return hasattr(ctx.ds.backend, "compact")
+
+
 def _s_alter(n: AlterTable, ctx: Ctx):
     ns, db = ctx.need_ns_db()
     key = K.tb_def(ns, db, n.name)
@@ -3262,6 +3277,10 @@ def _s_alter(n: AlterTable, ctx: Ctx):
         if n.if_exists:
             return NONE
         raise SdbError(f"The table '{n.name}' does not exist")
+    if getattr(n, "compact", False) and not _supports_compaction(ctx):
+        raise SdbError(
+            "The storage layer does not support compaction requests."
+        )
     if n.full is not None:
         tdef.full = n.full
     if n.drop is not None:
@@ -3310,8 +3329,47 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
             if n.if_exists:
                 return NONE
             raise SdbError(f"The database '{n.name}' does not exist")
-        return NONE  # COMPACT is a maintenance hint; mem engine is compacted
-    if kind in ("system", "config", "model", "module"):
+        if ("compact", True) in (n.changes or []) and not _supports_compaction(ctx):
+            raise SdbError(
+                "The storage layer does not support compaction requests."
+            )
+        return NONE  # COMPACT is a maintenance hint elsewhere
+    if kind == "config":
+        spec = dict(n.changes).get("config_spec") or {}
+        what = n.name.upper()
+        if what == "DEFAULT":
+            # upsert behaviour, stored at DB level (unlike DEFINE, which
+            # stores at root — REMOVE CONFIG DEFAULT checks root and errors)
+            from surrealdb_tpu.catalog import ConfigDef
+
+            key = K.cfg_def(ns, db, "DEFAULT")
+            d = ctx.txn.get_val(key)
+            if not isinstance(d, ConfigDef):
+                d = ConfigDef("DEFAULT")
+            for k2 in ("namespace", "database"):
+                if k2 in spec:
+                    v = spec[k2]
+                    setattr(d, k2, v if isinstance(v, str) else evaluate(v, ctx))
+            ctx.txn.set_val(key, d)
+            return NONE
+        key = K.cfg_def(ns, db, what)
+        d = ctx.txn.get_val(key)
+        if d is None:
+            if n.if_exists:
+                return NONE
+            raise SdbError(f"The config for {what.lower()} does not exist")
+        for k2 in ("middleware", "permissions", "tables", "functions",
+                   "depth", "complexity", "introspection"):
+            if k2 in spec:
+                setattr(d, k2, spec[k2])
+        ctx.txn.set_val(key, d)
+        return NONE
+    if kind in ("system", "model", "module"):
+        if kind == "system" and ("compact", True) in (n.changes or []) \
+                and not _supports_compaction(ctx):
+            raise SdbError(
+                "The storage layer does not support compaction requests."
+            )
         return NONE
     if kind in ("api", "bucket"):
         keyf = K.api_def if kind == "api" else K.bucket_def
@@ -3389,6 +3447,16 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
             disp = f"fn::{disp}"
         elif kind == "param":
             disp = f"${disp}"
+        if kind == "access":
+            raise SdbError(
+                f"The access method '{disp}' does not exist "
+                f"{_base_phrase(n.base or 'db', ctx)}"
+            )
+        if kind == "user":
+            raise SdbError(
+                f"The user '{disp}' does not exist "
+                f"{_base_phrase(n.base or 'root', ctx)}"
+            )
         raise SdbError(
             f"The {labels.get(kind, kind)} '{disp}' does not exist"
         )
@@ -3403,6 +3471,10 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
                 d.assert_ = None
             elif clause == "type":
                 d.kind = None
+            elif clause == "async":
+                d.async_ = False
+                d.retry = None
+                d.maxdepth = None
             elif clause == "readonly":
                 d.readonly = False
             elif clause == "flexible":

@@ -642,6 +642,7 @@ class RemoveStmt(Node):
 class AlterTable(Node):
     name: str
     if_exists: bool = False
+    compact: bool = False
     full: Optional[bool] = None
     drop: Optional[bool] = None
     kind: Optional[str] = None

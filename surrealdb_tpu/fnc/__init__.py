@@ -200,7 +200,10 @@ def call_custom(name, args, ctx):
 
 from surrealdb_tpu.val import SSet as _SSet  # noqa: E402
 
+from surrealdb_tpu.val import File as _File  # noqa: E402
+
 _METHOD_FAMILIES = [
+    (_File, "file"),
     (_SSet, "set"),
     (list, "array"),
     (str, "string"),

@@ -42,8 +42,8 @@ def _blake3(args, ctx):
     return hashlib.blake2b(_str(args[0], "crypto::blake3", 1).encode()).hexdigest()
 
 
-# password hashing: pbkdf2 and scrypt are real; argon2/bcrypt use a
-# pbkdf2-backed phc format (no native argon2/bcrypt libs in this image)
+# password hashing: argon2id (via the argon2 package, like the reference's
+# user passhashes), pbkdf2 and scrypt; bcrypt falls back to pbkdf2
 
 
 def _pbkdf2_hash(pw: str, rounds=600_000) -> str:
@@ -98,14 +98,34 @@ def _scrypt_cmp(args, ctx):
     return _scrypt_compare(_str(args[0], "f", 1), _str(args[1], "f", 2))
 
 
+def _argon2_hash(pw: str) -> str:
+    from argon2 import PasswordHasher
+
+    return PasswordHasher().hash(pw)
+
+
+def _argon2_compare(h: str, pw: str) -> bool:
+    from argon2 import PasswordHasher
+    from argon2.exceptions import (
+        InvalidHashError,
+        VerificationError,
+        VerifyMismatchError,
+    )
+
+    try:
+        return PasswordHasher().verify(h, pw)
+    except (VerifyMismatchError, VerificationError, InvalidHashError):
+        return False
+
+
 @register("crypto::argon2::generate")
 def _argon2_gen(args, ctx):
-    return _pbkdf2_hash(_str(args[0], "f", 1))
+    return _argon2_hash(_str(args[0], "f", 1))
 
 
 @register("crypto::argon2::compare")
 def _argon2_cmp(args, ctx):
-    return _pbkdf2_compare(_str(args[0], "f", 1), _str(args[1], "f", 2))
+    return _argon2_compare(_str(args[0], "f", 1), _str(args[1], "f", 2))
 
 
 @register("crypto::bcrypt::generate")
@@ -119,10 +139,13 @@ def _bcrypt_cmp(args, ctx):
 
 
 def password_hash(pw: str) -> str:
-    return _pbkdf2_hash(pw, rounds=100_000)
+    # user passhashes are argon2id, like the reference (iam user defs)
+    return _argon2_hash(pw)
 
 
 def password_compare(h: str, pw: str) -> bool:
+    if h.startswith("$argon2"):
+        return _argon2_compare(h, pw)
     if h.startswith("$pbkdf2"):
         return _pbkdf2_compare(h, pw)
     if h.startswith("$scrypt"):
@@ -1012,3 +1035,176 @@ def _file_key(args, ctx):
     if isinstance(v, File):
         return v.key
     raise SdbError("Incorrect arguments for function file::key(). Expected a file")
+
+
+# -- file:: bucket operations (reference core/src/buc/ + fnc file ops) ------
+
+
+def _file_arg(args, fname):
+    from surrealdb_tpu.val import File
+
+    v = args[0] if args else NONE
+    if not isinstance(v, File):
+        raise SdbError(
+            f"Incorrect arguments for function file::{fname}(). Expected a file"
+        )
+    return v
+
+
+def _as_bytes(v, fname):
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    if isinstance(v, str):
+        return v.encode("utf-8")
+    if isinstance(v, list) and all(
+        isinstance(x, int) and not isinstance(x, bool) and 0 <= x < 256
+        for x in v
+    ):
+        return bytes(v)  # int arrays coerce to bytes (reference file ops)
+    raise SdbError(
+        f"Incorrect arguments for function file::{fname}(). "
+        f"Expected bytes or string data"
+    )
+
+
+@register("file::put")
+def _file_put(args, ctx):
+    from surrealdb_tpu.buc import get_bucket
+
+    f = _file_arg(args, "put")
+    get_bucket(f.bucket, ctx, for_write=True).put(
+        f.key, _as_bytes(args[1] if len(args) > 1 else NONE, "put")
+    )
+    return NONE
+
+
+@register("file::put_if_not_exists")
+def _file_put_ine(args, ctx):
+    from surrealdb_tpu.buc import get_bucket
+
+    f = _file_arg(args, "put_if_not_exists")
+    get_bucket(f.bucket, ctx, for_write=True).put_if_not_exists(
+        f.key, _as_bytes(args[1] if len(args) > 1 else NONE,
+                         "put_if_not_exists")
+    )
+    return NONE
+
+
+@register("file::get")
+def _file_get(args, ctx):
+    from surrealdb_tpu.buc import get_bucket
+
+    f = _file_arg(args, "get")
+    data = get_bucket(f.bucket, ctx).get(f.key)
+    return NONE if data is None else data
+
+
+@register("file::head")
+def _file_head(args, ctx):
+    from surrealdb_tpu.buc import get_bucket
+
+    f = _file_arg(args, "head")
+    meta = get_bucket(f.bucket, ctx).head(f.key)
+    return NONE if meta is None else meta
+
+
+@register("file::exists")
+def _file_exists(args, ctx):
+    from surrealdb_tpu.buc import get_bucket
+
+    f = _file_arg(args, "exists")
+    return get_bucket(f.bucket, ctx).exists(f.key)
+
+
+@register("file::delete")
+def _file_delete(args, ctx):
+    from surrealdb_tpu.buc import get_bucket
+
+    f = _file_arg(args, "delete")
+    get_bucket(f.bucket, ctx, for_write=True).delete(f.key)
+    return NONE
+
+
+def _dst_target(args, fname):
+    """Destination (bucket|None, key): a string stays in the source bucket,
+    a File may point into another bucket (cross-bucket copy/rename)."""
+    v = args[1] if len(args) > 1 else NONE
+    from surrealdb_tpu.val import File as _File
+
+    if isinstance(v, _File):
+        return v.bucket, v.key
+    if isinstance(v, str):
+        return None, (v if v.startswith("/") else "/" + v)
+    raise SdbError(
+        f"Incorrect arguments for function file::{fname}(). Expected a key"
+    )
+
+
+def _copy_like(ctx, f, args, fname, if_not_exists=False,
+               idempotent_missing=False, remove_src=False):
+    from surrealdb_tpu.buc import get_bucket
+
+    src = get_bucket(f.bucket, ctx, for_write=remove_src)
+    dbucket, dkey = _dst_target(args, fname)
+    if dbucket is None or dbucket == f.bucket:
+        if remove_src:
+            src.rename(f.key, dkey, if_not_exists=if_not_exists)
+        else:
+            src.copy(f.key, dkey, if_not_exists=if_not_exists,
+                     idempotent_missing=idempotent_missing)
+        return
+    dst = get_bucket(dbucket, ctx, for_write=True)
+    data = src.get(f.key)
+    if data is None:
+        if idempotent_missing:
+            return
+        src._missing_source(f.key)
+    if if_not_exists and dst.exists(dkey):
+        return
+    dst.put(dkey, data)
+    if remove_src:
+        src.delete(f.key)
+
+
+@register("file::copy")
+def _file_copy(args, ctx):
+    f = _file_arg(args, "copy")
+    _copy_like(ctx, f, args, "copy")
+    return NONE
+
+
+@register("file::copy_if_not_exists")
+def _file_copy_ine(args, ctx):
+    f = _file_arg(args, "copy_if_not_exists")
+    _copy_like(ctx, f, args, "copy_if_not_exists", if_not_exists=True,
+               idempotent_missing=True)
+    return NONE
+
+
+@register("file::rename")
+def _file_rename(args, ctx):
+    f = _file_arg(args, "rename")
+    _copy_like(ctx, f, args, "rename", remove_src=True)
+    return NONE
+
+
+@register("file::rename_if_not_exists")
+def _file_rename_ine(args, ctx):
+    f = _file_arg(args, "rename_if_not_exists")
+    _copy_like(ctx, f, args, "rename_if_not_exists", if_not_exists=True,
+               remove_src=True)
+    return NONE
+
+
+@register("file::list")
+def _file_list(args, ctx):
+    from surrealdb_tpu.buc import get_bucket
+
+    name = args[0] if args else NONE
+    if not isinstance(name, str):
+        raise SdbError(
+            "Incorrect arguments for function file::list(). Expected a "
+            "bucket name"
+        )
+    opts = args[1] if len(args) > 1 and isinstance(args[1], dict) else None
+    return get_bucket(name, ctx).list(opts)

@@ -825,65 +825,71 @@ class Parser:
         if self.eat_kw("config"):
             ine, ow = self._def_flags()
             what = self.ident().upper()
-            cfg = {}
-            if what == "DEFAULT":
-                while True:
-                    if self.eat_kw("namespace", "ns"):
-                        cfg["namespace"] = self.name_expr()
-                    elif self.eat_kw("database", "db"):
-                        cfg["database"] = self.name_expr()
-                    else:
-                        break
-                return DefineConfig("DEFAULT", cfg, ine, ow)
-            def _name_list():
-                inc = [self.ident()]
-                while self.eat_op(","):
-                    inc.append(self.ident())
-                return inc
-
-            while True:
-                if self.eat_kw("middleware"):
-                    cfg["middleware"] = self._parse_middleware()
-                elif self.eat_kw("permissions"):
-                    cfg["permissions"] = self._parse_permissions_value()
-                elif self.eat_kw("auto"):
-                    # bare AUTO sets both tables and functions
-                    cfg["tables"] = "AUTO"
-                    cfg["functions"] = "AUTO"
-                elif self.eat_kw("none"):
-                    cfg["tables"] = "NONE"
-                    cfg["functions"] = "NONE"
-                elif self.eat_kw("tables"):
-                    if self.eat_kw("auto"):
-                        cfg["tables"] = "AUTO"
-                    elif self.eat_kw("none"):
-                        cfg["tables"] = "NONE"
-                    elif self.eat_kw("include"):
-                        cfg["tables"] = ("INCLUDE", _name_list())
-                    elif self.eat_kw("exclude"):
-                        cfg["tables"] = ("EXCLUDE", _name_list())
-                elif self.eat_kw("functions"):
-                    if self.eat_kw("auto"):
-                        cfg["functions"] = "AUTO"
-                    elif self.eat_kw("none"):
-                        cfg["functions"] = "NONE"
-                    elif self.eat_kw("include"):
-                        cfg["functions"] = ("INCLUDE", _name_list())
-                    elif self.eat_kw("exclude"):
-                        cfg["functions"] = ("EXCLUDE", _name_list())
-                elif self.eat_kw("depth"):
-                    cfg["depth"] = self.next().value
-                elif self.eat_kw("complexity"):
-                    cfg["complexity"] = self.next().value
-                elif self.eat_kw("introspection"):
-                    if self.eat_kw("auto"):
-                        cfg["introspection"] = "AUTO"
-                    elif self.eat_kw("none"):
-                        cfg["introspection"] = "NONE"
-                else:
-                    break
+            cfg = self._config_spec(what)
             return DefineConfig(what, cfg, ine, ow)
         raise self.err("unknown DEFINE target")
+
+    def _config_spec(self, what):
+        """The clause grammar shared by DEFINE CONFIG and ALTER CONFIG."""
+        cfg = {}
+        if what == "DEFAULT":
+            while True:
+                if self.eat_kw("namespace", "ns"):
+                    cfg["namespace"] = self.name_expr()
+                elif self.eat_kw("database", "db"):
+                    cfg["database"] = self.name_expr()
+                else:
+                    break
+            return cfg
+
+        def _name_list():
+            inc = [self.ident()]
+            while self.eat_op(","):
+                inc.append(self.ident())
+            return inc
+
+        while True:
+            if self.eat_kw("middleware"):
+                cfg["middleware"] = self._parse_middleware()
+            elif self.eat_kw("permissions"):
+                cfg["permissions"] = self._parse_permissions_value()
+            elif self.eat_kw("auto"):
+                # bare AUTO sets both tables and functions
+                cfg["tables"] = "AUTO"
+                cfg["functions"] = "AUTO"
+            elif self.eat_kw("none"):
+                cfg["tables"] = "NONE"
+                cfg["functions"] = "NONE"
+            elif self.eat_kw("tables"):
+                if self.eat_kw("auto"):
+                    cfg["tables"] = "AUTO"
+                elif self.eat_kw("none"):
+                    cfg["tables"] = "NONE"
+                elif self.eat_kw("include"):
+                    cfg["tables"] = ("INCLUDE", _name_list())
+                elif self.eat_kw("exclude"):
+                    cfg["tables"] = ("EXCLUDE", _name_list())
+            elif self.eat_kw("functions"):
+                if self.eat_kw("auto"):
+                    cfg["functions"] = "AUTO"
+                elif self.eat_kw("none"):
+                    cfg["functions"] = "NONE"
+                elif self.eat_kw("include"):
+                    cfg["functions"] = ("INCLUDE", _name_list())
+                elif self.eat_kw("exclude"):
+                    cfg["functions"] = ("EXCLUDE", _name_list())
+            elif self.eat_kw("depth"):
+                cfg["depth"] = self.next().value
+            elif self.eat_kw("complexity"):
+                cfg["complexity"] = self.next().value
+            elif self.eat_kw("introspection"):
+                if self.eat_kw("auto"):
+                    cfg["introspection"] = "AUTO"
+                elif self.eat_kw("none"):
+                    cfg["introspection"] = "NONE"
+            else:
+                break
+        return cfg
 
     def _define_table(self):
         ine, ow = self._def_flags()
@@ -1613,7 +1619,7 @@ class Parser:
             elif self.eat_kw("drop"):
                 d.drop = True
             elif self.eat_kw("compact"):
-                pass
+                d.compact = True
             elif self.eat_kw("schemafull", "schemaful"):
                 d.full = True
             elif self.eat_kw("schemaless"):
@@ -1674,16 +1680,9 @@ class Parser:
             return AlterStmt("system", "system", None, None, if_exists, changes)
         if kind == "config":
             what = self.ident().upper()
-            depth = 0
-            while self.peek().kind != L.EOF:
-                if self.at_op(";") and depth == 0:
-                    break
-                t2 = self.next()
-                if t2.kind == L.OP and t2.text in "([{":
-                    depth += 1
-                if t2.kind == L.OP and t2.text in ")]}":
-                    depth -= 1
-            return AlterStmt("config", what, None, None, if_exists, [])
+            cfg = self._config_spec(what)
+            return AlterStmt("config", what, None, None, if_exists,
+                             [("config_spec", cfg)])
         if kind == "param":
             tp = self.peek()
             if tp.kind == L.PARAM:
@@ -1752,6 +1751,12 @@ class Parser:
                 else:
                     then = [self.parse_expr()]
                 changes.append(("then", then))
+            elif kind == "event" and self.eat_kw("async"):
+                changes.append(("async_", True))
+            elif kind == "event" and self.eat_kw("retry"):
+                changes.append(("retry", self._signed_int()))
+            elif kind == "event" and self.eat_kw("maxdepth"):
+                changes.append(("maxdepth", self._signed_int()))
             elif kind == "param" and self.eat_kw("value"):
                 changes.append(("value", self.parse_expr()))
             elif kind == "user" and self.eat_kw("password"):
