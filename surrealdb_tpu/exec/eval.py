@@ -305,11 +305,11 @@ def _e_range(n, ctx):
 def _e_binary(n, ctx):
     op = n.op
     if op == "&&":
+        # short-circuit, returning the deciding VALUE (0s && 2s -> 0s)
         lhs = evaluate(n.lhs, ctx)
         if not is_truthy(lhs):
-            return lhs if isinstance(lhs, bool) else False
-        rhs = evaluate(n.rhs, ctx)
-        return rhs if isinstance(rhs, bool) else is_truthy(rhs) and rhs or rhs
+            return lhs
+        return evaluate(n.rhs, ctx)
     if op == "||":
         lhs = evaluate(n.lhs, ctx)
         if is_truthy(lhs):
@@ -393,7 +393,7 @@ def _e_constant(n, ctx):
     if name == "duration::max":
         from surrealdb_tpu.val import Duration as D
 
-        return D((1 << 63) - 1)
+        return D(D.MAX_NS)
     # unknown bare path — treat as an idiom over the current doc? error.
     raise SdbError(f"unknown constant or function {name!r}")
 

@@ -94,6 +94,11 @@ def add(a, b):
     if isinstance(a, Duration) and isinstance(b, Datetime):
         return add(b, a)
     if isinstance(a, Duration) and isinstance(b, Duration):
+        if a.ns + b.ns > Duration.MAX_NS:
+            raise SdbError(
+                f'Failed to compute: "{a.render()} + {b.render()}", as the '
+                "operation results in an arithmetic overflow."
+            )
         return a + b
     if isinstance(a, list) and isinstance(b, list):
         return a + b
@@ -143,6 +148,16 @@ def mul(a, b):
 
 
 def div(a, b):
+    # duration division (reference val/duration.rs): dur / number scales;
+    # anything else involving durations is NaN
+    if isinstance(a, Duration) and isinstance(b, Duration):
+        return float("nan")
+    if isinstance(a, Duration) and isinstance(b, _NUM) and not isinstance(b, bool):
+        if b == 0:
+            return float("nan")
+        return Duration(int(a.ns // b))
+    if isinstance(b, Duration) and isinstance(a, _NUM) and not isinstance(a, bool):
+        return float("nan")
     if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
         a, b = _num2(a, b)
         try:

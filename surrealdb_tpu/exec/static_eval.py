@@ -28,7 +28,16 @@ def static_value(node):
     if isinstance(node, ArrayExpr):
         return [static_value(x) for x in node.items]
     if isinstance(node, ObjectExpr):
-        return {k: static_value(v) for k, v in node.items}
+        out = {k: static_value(v) for k, v in node.items}
+        if len(out) == 2 and "type" in out and (
+            "coordinates" in out or "geometries" in out
+        ):
+            from surrealdb_tpu.exec.coerce import object_to_geometry
+
+            g = object_to_geometry(out)
+            if g is not None:
+                return g
+        return out
     if isinstance(node, SetExpr):
         from surrealdb_tpu.val import SSet
 

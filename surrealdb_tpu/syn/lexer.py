@@ -330,6 +330,8 @@ def _lex_number(src, i, err):
                         break
                 if not got:
                     break
+            if total > Duration.MAX_NS:
+                err("duration exceeds maximum")
             return (DURATION, Duration(total)), j
     if j < n and src[j] == "." and j + 1 < n and src[j + 1].isdigit():
         is_float = True

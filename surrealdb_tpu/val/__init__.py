@@ -71,9 +71,13 @@ NONE = _NoneType()
 
 @total_ordering
 class Duration:
-    """A duration with nanosecond precision (reference: val/duration.rs)."""
+    """A duration with nanosecond precision (reference: val/duration.rs).
+    Max = u64::MAX seconds + 999_999_999 ns, like the reference's
+    std::time::Duration backing store."""
 
     __slots__ = ("ns",)
+
+    MAX_NS = 18446744073709551615 * 1_000_000_000 + 999_999_999
 
     UNITS = {
         "ns": 1,
@@ -129,7 +133,7 @@ class Duration:
             return "0ns"
         out = []
         rem = self.ns
-        for unit in ("y", "w", "d", "h", "m", "s", "ms", "us", "ns"):
+        for unit in ("y", "w", "d", "h", "m", "s", "ms", "µs", "ns"):
             size = self.UNITS[unit]
             if rem >= size:
                 n, rem = divmod(rem, size)
