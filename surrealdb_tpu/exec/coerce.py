@@ -375,8 +375,23 @@ def object_to_geometry(v: dict):
     coords = v.get("coordinates")
     if t in ("Point", "LineString", "Polygon", "MultiPoint",
              "MultiLineString", "MultiPolygon") and coords is not None:
-        return Geometry(t, _tupled(coords))
+        tc = _tupled(coords)
+        # polygon rings auto-close (reference geo semantics: the first
+        # point is appended when the ring is open)
+        if t == "Polygon":
+            tc = tuple(_close_ring(r) for r in tc)
+        elif t == "MultiPolygon":
+            tc = tuple(
+                tuple(_close_ring(r) for r in poly) for poly in tc
+            )
+        return Geometry(t, tc)
     return None
+
+
+def _close_ring(ring):
+    if isinstance(ring, tuple) and len(ring) >= 2 and ring[0] != ring[-1]:
+        return ring + (ring[0],)
+    return ring
 
 
 def _tupled(c):

@@ -1098,85 +1098,45 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
             collected = start_items + collected
         return collected
 
-    # ---- default: frontier iteration, no global visited set ---------------
-    from surrealdb_tpu.graph import TPU_FRONTIER_THRESHOLD
+    # ---- default: follow the path until bounds or dead end ---------------
+    # (reference recursion/default.rs: the path is applied to the WHOLE
+    # current value each step — map+flatten WITHOUT dedup — and only the
+    # final depth's value is returned; a dead end or a fixed point stops)
+    def clean(v):
+        if isinstance(v, list):
+            flat = []
+            for x in v:
+                if isinstance(x, list):
+                    flat.extend(
+                        y for y in x if y is not NONE and y is not None
+                    )
+                elif x is not NONE and x is not None:
+                    flat.append(x)
+            return flat
+        return v
 
-    csr_pat = (
-        _csr_pair_pattern(parts[0], parts[1]) if len(parts) == 2 else None
-    )
-    exact = part.min is not None and part.max == part.min
     hard_limit = part.max is None
-    frontier = list(start_items)
-    union = []
-    union_seen = set()
-    last_nonempty = []
+    current = val
     depth = 0
-    stalled = False
-    while depth < rmax and frontier:
-        nxt = []
-        seen_frontier = set()
-        if (
-            csr_pat is not None
-            and len(frontier) >= TPU_FRONTIER_THRESHOLD
-            and all(isinstance(x, RecordId) for x in frontier)
-            and {x.tb for x in frontier} == {csr_pat[1]}
-        ):
-            from surrealdb_tpu.graph.csr import get_csr
-
-            edge_tb, node_tb, gdir = csr_pat
-            csr = get_csr(ctx.ds, ctx, node_tb, edge_tb, gdir)
-            keys = csr.multi_hop([x.id for x in frontier], 1)
-            was_list = True
-            for kk in keys:
-                ch = RecordId(node_tb, kk)
-                h = hashable(ch)
-                if h not in seen_frontier:
-                    seen_frontier.add(h)
-                    nxt.append(ch)
-        else:
-            for node in frontier:
-                children, islist = step(node)
-                was_list = was_list or islist
-                for ch in children:
-                    h = hashable(ch)
-                    if h not in seen_frontier:
-                        seen_frontier.add(h)
-                        nxt.append(ch)
-        depth += 1
-        if nxt:
-            last_nonempty = nxt
-        if depth >= rmin:
-            grew = False
-            for ch in nxt:
-                h = hashable(ch)
-                if h not in union_seen:
-                    union_seen.add(h)
-                    union.append(ch)
-                    grew = True
-            # unbounded ranges terminate once the union stops growing
-            if part.max is None and not grew and depth > rmin:
-                stalled = True
-        frontier = nxt
-        if exact and depth >= rmax:
-            break
-        if stalled:
-            break
-        if hard_limit and depth >= 256 and frontier:
+    while depth < rmax:
+        ctx.check_deadline()
+        if hard_limit and depth >= 256:
             raise SdbError("Exceeded the idiom recursion limit of 256.")
-
-    if exact:
-        out = frontier if depth == rmax else []
-        if not was_list:
-            return out[0] if out else NONE
-        return out
-    if depth < rmin:
-        return [] if was_list else NONE
-    # ranges return the final (deepest non-empty) frontier — bounded and
-    # unbounded alike (reference: depth_range suite)
-    out = last_nonempty
-    if not was_list:
-        return out[0] if out else NONE
-    return out
+        nxt = clean(walk(current, list(parts), ctx))
+        depth += 1
+        final = nxt is NONE or nxt is None or (
+            isinstance(nxt, list) and not nxt
+        )
+        if final or value_eq(nxt, current):
+            # dead end or cycle fixed point: the previous value stands when
+            # we got past min_depth, else the dead-end value itself
+            if depth > rmin:
+                return current
+            return nxt
+        current = nxt
+    if depth >= rmin:
+        return current
+    return NONE
 
 
 # ---------------------------------------------------------------------------
