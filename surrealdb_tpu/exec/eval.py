@@ -205,6 +205,13 @@ def evaluate(node, ctx: Ctx):
     return fn(node, ctx)
 
 
+def _e_script(n, ctx):
+    from surrealdb_tpu.fnc.script import run_script
+
+    args = [evaluate(a, ctx) for a in n.args]
+    return run_script(n.source, args, ctx)
+
+
 def _e_literal(n, ctx):
     v = n.value
     if type(v) is list or type(v) is dict:
@@ -1144,6 +1151,7 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
 # ---------------------------------------------------------------------------
 
 _DISPATCH = {
+    ScriptExpr: _e_script,
     Literal: _e_literal,
     Param: _e_param,
     ArrayExpr: _e_array,

@@ -112,6 +112,14 @@ class Constant(Node):
 
 
 @dataclass
+class ScriptExpr(Node):
+    """function($a, $b) { raw js } — embedded script (fnc/script)."""
+
+    args: list  # SurrealQL arg expressions
+    source: str  # full raw text `function(...) { ... }`
+
+
+@dataclass
 class ClosureExpr(Node):
     params: list  # [(name, Kind|None)]
     body: Node

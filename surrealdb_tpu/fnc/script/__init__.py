@@ -1,0 +1,38 @@
+"""Embedded scripting runtime for `function() { ... }` blocks.
+
+The reference embeds QuickJS (core/src/fnc/script/mod.rs, rquickjs); this
+build ships a self-contained ECMAScript-subset interpreter (no external JS
+engine exists in the image) covering the scripted surface the language
+tests exercise: closures/arrow functions, template literals, spread,
+BigInt literals, exceptions, async/await (scripts run to completion
+synchronously, so await is value passthrough), the host `surrealdb`
+query/value API, and the Value bridge classes (Date/Duration/Record/Uuid/
+Uint8Array).
+"""
+
+from __future__ import annotations
+
+from surrealdb_tpu.err import SdbError
+
+
+def run_script(source: str, args, ctx):
+    """Execute a `function(...) { body }` script; returns a SurrealQL value.
+
+    `args`: evaluated SurrealQL argument values; `this` binds the current
+    document (reference fnc/script: script functions receive the doc ctx).
+    """
+    from surrealdb_tpu.fnc.script.interp import Interpreter, JSError
+
+    try:
+        interp = Interpreter(ctx)
+        return interp.run_function(source, args)
+    except JSError as e:
+        raise SdbError(
+            f"Problem with embedded script function. An exception occurred: {e.message}"
+        )
+    except RecursionError:
+        raise SdbError(
+            "Problem with embedded script function. An exception occurred: "
+            "Reached excessive computation depth due to functions, "
+            "subqueries, or computed values"
+        )

@@ -1,0 +1,1689 @@
+"""A small tree-walking ECMAScript-subset interpreter.
+
+Original design (tokenizer → Pratt parser → environment-chain evaluator);
+implements the slice of JS the reference's embedded scripts use. Scripts
+are synchronous here, so `await x` evaluates to x (the host query API
+returns values directly).
+"""
+
+from __future__ import annotations
+
+import math
+import re as _re
+from decimal import Decimal
+
+from surrealdb_tpu.val import (
+    NONE,
+    Datetime,
+    Duration,
+    Geometry,
+    RecordId,
+    Uuid,
+)
+
+
+class JSError(Exception):
+    def __init__(self, message):
+        super().__init__(message)
+        self.message = message
+
+
+class JSUndefined:
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "undefined"
+
+
+UNDEF = JSUndefined()
+
+
+class BigInt(int):
+    """A JS BigInt — distinct type so 1n !== 1 and values round-trip."""
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RX = _re.compile(
+    r"""
+    (?P<ws>[\s]+)
+  | (?P<comment>//[^\n]*|/\*.*?\*/)
+  | (?P<template>`(?:[^`\\]|\\.)*`)
+  | (?P<string>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
+  | (?P<bigint>\d+n)
+  | (?P<number>0[xX][0-9a-fA-F]+|\d+\.\d+(?:[eE][+-]?\d+)?|\.\d+|\d+(?:[eE][+-]?\d+)?)
+  | (?P<ident>[A-Za-z_$][A-Za-z0-9_$]*)
+  | (?P<punct>=>|\.\.\.|===|!==|==|!=|<=|>=|&&|\|\||\*\*|\+\+|--|\+=|-=|\*=|/=|%=|\?\.|[{}()\[\];,.<>+\-*/%!?:=&|^~])
+    """,
+    _re.X | _re.S,
+)
+
+_KEYWORDS = {
+    "function", "return", "if", "else", "for", "while", "do", "let",
+    "const", "var", "new", "typeof", "throw", "try", "catch", "finally",
+    "true", "false", "null", "undefined", "await", "async", "of", "in",
+    "break", "continue", "delete", "instanceof",
+}
+
+
+def tokenize(src: str):
+    toks = []
+    i = 0
+    n = len(src)
+    while i < n:
+        m = _TOKEN_RX.match(src, i)
+        if m is None:
+            raise JSError(f"Unexpected token at position {i}")
+        i = m.end()
+        kind = m.lastgroup
+        text = m.group()
+        if kind in ("ws", "comment"):
+            continue
+        toks.append((kind, text))
+    toks.append(("eof", ""))
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# parser — produces tuple-based AST nodes
+# ---------------------------------------------------------------------------
+
+
+class Parser:
+    def __init__(self, toks):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self, off=0):
+        return self.toks[min(self.i + off, len(self.toks) - 1)]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at(self, text):
+        return self.peek()[1] == text and self.peek()[0] in ("punct", "ident")
+
+    def eat(self, text):
+        if self.at(text):
+            self.next()
+            return True
+        return False
+
+    def expect(self, text):
+        if not self.eat(text):
+            raise JSError(f"Expected '{text}' but found '{self.peek()[1]}'")
+
+    # -- statements ---------------------------------------------------------
+    def parse_block(self):
+        self.expect("{")
+        stmts = []
+        while not self.at("}"):
+            stmts.append(self.parse_stmt())
+        self.expect("}")
+        return ("block", stmts)
+
+    def parse_stmt(self):
+        k, t = self.peek()
+        if t == "{":
+            return self.parse_block()
+        if t in ("let", "const", "var"):
+            self.next()
+            decls = []
+            while True:
+                name = self.next()[1]
+                init = None
+                if self.eat("="):
+                    init = self.parse_assign()
+                decls.append((name, init))
+                if not self.eat(","):
+                    break
+            self.eat(";")
+            return ("decl", decls)
+        if t == "return":
+            self.next()
+            if self.at(";") or self.at("}"):
+                self.eat(";")
+                return ("return", None)
+            e = self.parse_expr()
+            self.eat(";")
+            return ("return", e)
+        if t == "throw":
+            self.next()
+            e = self.parse_expr()
+            self.eat(";")
+            return ("throw", e)
+        if t == "if":
+            self.next()
+            self.expect("(")
+            cond = self.parse_expr()
+            self.expect(")")
+            then = self.parse_stmt()
+            other = None
+            if self.eat("else"):
+                other = self.parse_stmt()
+            return ("if", cond, then, other)
+        if t == "while":
+            self.next()
+            self.expect("(")
+            cond = self.parse_expr()
+            self.expect(")")
+            body = self.parse_stmt()
+            return ("while", cond, body)
+        if t == "for":
+            return self.parse_for()
+        if t == "try":
+            self.next()
+            block = self.parse_block()
+            param = None
+            handler = None
+            final = None
+            if self.eat("catch"):
+                if self.eat("("):
+                    param = self.next()[1]
+                    self.expect(")")
+                handler = self.parse_block()
+            if self.eat("finally"):
+                final = self.parse_block()
+            return ("try", block, param, handler, final)
+        if t == "break":
+            self.next()
+            self.eat(";")
+            return ("break",)
+        if t == "continue":
+            self.next()
+            self.eat(";")
+            return ("continue",)
+        if t == ";":
+            self.next()
+            return ("empty",)
+        e = self.parse_expr()
+        self.eat(";")
+        return ("expr", e)
+
+    def parse_for(self):
+        self.expect("for")
+        self.expect("(")
+        if self.peek()[1] in ("let", "const", "var") and \
+                self.peek(2)[1] == "of":
+            self.next()
+            name = self.next()[1]
+            self.expect("of")
+            it = self.parse_expr()
+            self.expect(")")
+            body = self.parse_stmt()
+            return ("forof", name, it, body)
+        init = None
+        if not self.at(";"):
+            init = self.parse_stmt()
+        else:
+            self.next()
+        cond = None
+        if not self.at(";"):
+            cond = self.parse_expr()
+        self.expect(";")
+        step = None
+        if not self.at(")"):
+            step = self.parse_expr()
+        self.expect(")")
+        body = self.parse_stmt()
+        return ("for", init, cond, step, body)
+
+    # -- expressions (Pratt) -------------------------------------------------
+    def parse_expr(self):
+        e = self.parse_assign()
+        while self.eat(","):
+            e2 = self.parse_assign()
+            e = ("seq", e, e2)
+        return e
+
+    def parse_assign(self):
+        # arrow functions: ident => ... | (a, b) => ...
+        save = self.i
+        arrow = self._try_arrow()
+        if arrow is not None:
+            return arrow
+        self.i = save
+        left = self.parse_ternary()
+        k, t = self.peek()
+        if t in ("=", "+=", "-=", "*=", "/=", "%="):
+            self.next()
+            right = self.parse_assign()
+            return ("assign", t, left, right)
+        return left
+
+    def _try_arrow(self):
+        params = None
+        k, t = self.peek()
+        if k == "ident" and t not in _KEYWORDS and self.peek(1)[1] == "=>":
+            params = [t]
+            self.next()
+        elif t == "(":
+            j = self.i
+            depth = 0
+            while j < len(self.toks):
+                tt = self.toks[j][1]
+                if tt == "(":
+                    depth += 1
+                elif tt == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                j += 1
+            if j + 1 < len(self.toks) and self.toks[j + 1][1] == "=>":
+                self.next()
+                params = []
+                while not self.at(")"):
+                    if self.eat("..."):
+                        params.append(("rest", self.next()[1]))
+                    else:
+                        params.append(self.next()[1])
+                    self.eat(",")
+                self.expect(")")
+            else:
+                return None
+        else:
+            return None
+        if params is None:
+            return None
+        self.expect("=>")
+        if self.at("{"):
+            body = self.parse_block()
+            return ("func", params, body, True)
+        body = self.parse_assign()
+        return ("func", params, ("return", body), True)
+
+    def parse_ternary(self):
+        cond = self.parse_binary(0)
+        if self.eat("?"):
+            a = self.parse_assign()
+            self.expect(":")
+            b = self.parse_assign()
+            return ("ternary", cond, a, b)
+        return cond
+
+    _BIN_PREC = {
+        "||": 1, "&&": 2, "|": 3, "^": 4, "&": 5,
+        "===": 6, "!==": 6, "==": 6, "!=": 6,
+        "<": 7, ">": 7, "<=": 7, ">=": 7, "instanceof": 7, "in": 7,
+        "+": 9, "-": 9, "*": 10, "/": 10, "%": 10, "**": 11,
+    }
+
+    def parse_binary(self, minp):
+        left = self.parse_unary()
+        while True:
+            t = self.peek()[1]
+            prec = self._BIN_PREC.get(t)
+            if prec is None or prec < minp:
+                return left
+            self.next()
+            right = self.parse_binary(prec + 1)
+            left = ("bin", t, left, right)
+
+    def parse_unary(self):
+        k, t = self.peek()
+        if t in ("!", "-", "+", "~", "typeof", "await", "delete"):
+            self.next()
+            return ("unary", t, self.parse_unary())
+        if t in ("++", "--"):
+            self.next()
+            tgt = self.parse_unary()
+            return ("update", t, tgt, True)
+        e = self.parse_postfix()
+        t = self.peek()[1]
+        if t in ("++", "--"):
+            self.next()
+            return ("update", t, e, False)
+        return e
+
+    def parse_postfix(self):
+        k, t = self.peek()
+        if t == "new":
+            self.next()
+            callee = self.parse_member_chain(self.parse_primary(), no_call=True)
+            args = []
+            if self.eat("("):
+                while not self.at(")"):
+                    args.append(self.parse_assign())
+                    self.eat(",")
+                self.expect(")")
+            e = ("new", callee, args)
+            return self.parse_member_chain(e)
+        return self.parse_member_chain(self.parse_primary())
+
+    def parse_member_chain(self, e, no_call=False):
+        while True:
+            t = self.peek()[1]
+            if t == ".":
+                self.next()
+                name = self.next()[1]
+                e = ("member", e, name, False)
+            elif t == "?.":
+                self.next()
+                name = self.next()[1]
+                e = ("member", e, name, True)
+            elif t == "[":
+                self.next()
+                idx = self.parse_expr()
+                self.expect("]")
+                e = ("index", e, idx)
+            elif t == "(" and not no_call:
+                self.next()
+                args = []
+                while not self.at(")"):
+                    if self.eat("..."):
+                        args.append(("spread", self.parse_assign()))
+                    else:
+                        args.append(self.parse_assign())
+                    self.eat(",")
+                self.expect(")")
+                e = ("call", e, args)
+            elif self.peek()[0] == "template":
+                # tagged templates unsupported; stop
+                return e
+            else:
+                return e
+
+    def parse_primary(self):
+        k, t = self.next()
+        if k == "number":
+            if t.startswith(("0x", "0X")):
+                return ("lit", int(t, 16))
+            if "." in t or "e" in t or "E" in t:
+                return ("lit", float(t))
+            return ("lit", int(t))
+        if k == "bigint":
+            return ("lit", BigInt(t[:-1]))
+        if k == "string":
+            return ("lit", _unescape(t[1:-1]))
+        if k == "template":
+            return self._template(t[1:-1])
+        if k == "ident":
+            if t == "true":
+                return ("lit", True)
+            if t == "false":
+                return ("lit", False)
+            if t == "null":
+                return ("lit", None)
+            if t == "undefined":
+                return ("lit", UNDEF)
+            if t == "function":
+                return self._function_expr()
+            if t == "async":
+                if self.peek()[1] == "function":
+                    self.next()
+                    return self._function_expr()
+                # async arrow
+                save = self.i
+                arrow = self._try_arrow()
+                if arrow is not None:
+                    return arrow
+                self.i = save
+                return ("var", t)
+            return ("var", t)
+        if t == "(":
+            e = self.parse_expr()
+            self.expect(")")
+            return e
+        if t == "[":
+            items = []
+            while not self.at("]"):
+                if self.eat("..."):
+                    items.append(("spread", self.parse_assign()))
+                else:
+                    items.append(self.parse_assign())
+                self.eat(",")
+            self.expect("]")
+            return ("array", items)
+        if t == "{":
+            props = []
+            while not self.at("}"):
+                if self.eat("..."):
+                    props.append(("spread", self.parse_assign()))
+                else:
+                    pk, pt = self.next()
+                    if pk == "string":
+                        key = _unescape(pt[1:-1])
+                    elif pk in ("number",):
+                        key = pt
+                    elif pt == "[":
+                        key = ("computed", self.parse_expr())
+                        self.expect("]")
+                    else:
+                        key = pt
+                    if self.eat(":"):
+                        props.append((key, self.parse_assign()))
+                    elif self.peek()[1] == "(":
+                        # method shorthand
+                        fn = self._method_shorthand()
+                        props.append((key, fn))
+                    else:
+                        props.append((key, ("var", key)))
+                self.eat(",")
+            self.expect("}")
+            return ("object", props)
+        raise JSError(f"Unexpected token '{t}'")
+
+    def _method_shorthand(self):
+        self.expect("(")
+        params = []
+        while not self.at(")"):
+            if self.eat("..."):
+                params.append(("rest", self.next()[1]))
+            else:
+                params.append(self.next()[1])
+            self.eat(",")
+        self.expect(")")
+        body = self.parse_block()
+        return ("func", params, body, False)
+
+    def _function_expr(self):
+        if self.peek()[0] == "ident" and self.peek()[1] not in _KEYWORDS \
+                and self.peek()[1] != "(":
+            self.next()  # optional name
+        return ("func", *self._fn_tail())
+
+    def _fn_tail(self):
+        self.expect("(")
+        params = []
+        while not self.at(")"):
+            if self.eat("..."):
+                params.append(("rest", self.next()[1]))
+            else:
+                params.append(self.next()[1])
+            self.eat(",")
+        self.expect(")")
+        body = self.parse_block()
+        return params, body, False
+
+    def _template(self, raw):
+        parts = []
+        i = 0
+        buf = []
+        while i < len(raw):
+            c = raw[i]
+            if c == "\\" and i + 1 < len(raw):
+                buf.append(_unescape(raw[i : i + 2]))
+                i += 2
+                continue
+            if c == "$" and i + 1 < len(raw) and raw[i + 1] == "{":
+                depth = 1
+                j = i + 2
+                while j < len(raw) and depth:
+                    if raw[j] == "{":
+                        depth += 1
+                    elif raw[j] == "}":
+                        depth -= 1
+                    j += 1
+                if buf:
+                    parts.append(("lit", "".join(buf)))
+                    buf = []
+                inner = raw[i + 2 : j - 1]
+                sub = Parser(tokenize(inner)).parse_expr()
+                parts.append(sub)
+                i = j
+                continue
+            buf.append(c)
+            i += 1
+        if buf:
+            parts.append(("lit", "".join(buf)))
+        return ("template", parts)
+
+
+def _unescape(s: str) -> str:
+    out = []
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            n = s[i + 1]
+            mapping = {"n": "\n", "t": "\t", "r": "\r", "\\": "\\",
+                       "'": "'", '"': '"', "`": "`", "0": "\0", "$": "$",
+                       "b": "\b", "f": "\f", "v": "\v", "/": "/"}
+            if n == "u" and i + 5 < len(s):
+                out.append(chr(int(s[i + 2 : i + 6], 16)))
+                i += 6
+                continue
+            out.append(mapping.get(n, n))
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# runtime values
+# ---------------------------------------------------------------------------
+
+
+class JSFunction:
+    def __init__(self, params, body, env, interp, is_arrow, this=None):
+        self.params = params
+        self.body = body
+        self.env = env
+        self.interp = interp
+        self.is_arrow = is_arrow
+        self.this = this
+
+    def call(self, this, args):
+        env = Env(self.env)
+        use_this = self.this if self.is_arrow else this
+        env.declare("this", use_this)
+        env.declare("arguments", list(args))
+        i = 0
+        for p in self.params:
+            if isinstance(p, tuple) and p[0] == "rest":
+                env.declare(p[1], list(args[i:]))
+                break
+            env.declare(p, args[i] if i < len(args) else UNDEF)
+            i += 1
+        try:
+            self.interp.exec_stmt(self.body, env)
+        except _Return as r:
+            return r.value
+        return UNDEF
+
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+class Env:
+    __slots__ = ("vars", "parent")
+
+    def __init__(self, parent=None):
+        self.vars = {}
+        self.parent = parent
+
+    def declare(self, name, value):
+        self.vars[name] = value
+
+    def get(self, name):
+        e = self
+        while e is not None:
+            if name in e.vars:
+                return e.vars[name]
+            e = e.parent
+        raise JSError(f"'{name}' is not defined")
+
+    def has(self, name):
+        e = self
+        while e is not None:
+            if name in e.vars:
+                return True
+            e = e.parent
+        return False
+
+    def set(self, name, value):
+        e = self
+        while e is not None:
+            if name in e.vars:
+                e.vars[name] = value
+                return
+            e = e.parent
+        self.vars[name] = value
+
+
+class JSErrorObj:
+    def __init__(self, message):
+        self.message = message
+
+
+# ---------------------------------------------------------------------------
+# interpreter
+# ---------------------------------------------------------------------------
+
+
+_MAX_OPS = 2_000_000
+
+
+class Interpreter:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.ops = 0
+
+    # -- entry ---------------------------------------------------------------
+    def run_function(self, source: str, args):
+        toks = tokenize(source.strip())
+        p = Parser(toks)
+        # strip leading `function` / `async function`
+        if p.peek()[1] == "async":
+            p.next()
+        p.expect("function")
+        params, body, _ = p._fn_tail()
+        genv = Env()
+        self._install_globals(genv)
+        fn = JSFunction(params, body, genv, self, False)
+        this = self._doc_this()
+        out = fn.call(this, [sql_to_js(a) for a in args])
+        return js_to_sql(out)
+
+    def _doc_this(self):
+        doc = self.ctx.doc
+        if doc is None or doc is NONE:
+            return UNDEF
+        return sql_to_js(doc)
+
+    # -- globals / host API --------------------------------------------------
+    def _install_globals(self, env):
+        env.declare("Math", _MATH)
+        env.declare("JSON", _JSON)
+        env.declare("Object", _OBJECT)
+        env.declare("Array", _ARRAY)
+        env.declare("Number", _NUMBER)
+        env.declare("String", _STRING)
+        env.declare("BigInt", ("native", lambda this, a: BigInt(int(a[0]))))
+        env.declare("NaN", float("nan"))
+        env.declare("Infinity", float("inf"))
+        env.declare("Error", ("class_error",))
+        env.declare("TypeError", ("class_error",))
+        env.declare("RangeError", ("class_error",))
+        env.declare("Date", ("class_date",))
+        env.declare("Duration", ("class_duration",))
+        env.declare("Record", ("class_record",))
+        env.declare("Uuid", ("class_uuid",))
+        env.declare("Uint8Array", ("class_u8",))
+        env.declare("parseInt", ("native", lambda this, a: int(float(a[0]))))
+        env.declare("parseFloat", ("native", lambda this, a: float(a[0])))
+        env.declare("Promise", {
+            "all": ("native", lambda this, a: list(a[0]) if a else []),
+            "resolve": ("native", lambda this, a: a[0] if a else UNDEF),
+        })
+        env.declare("surrealdb", {
+            "query": ("native", self._host_query),
+            "value": ("native", self._host_value),
+            "Query": ("class_query",),
+        })
+        # script-visible session params: every SurrealQL $var
+        for name, val in self.ctx.vars.items():
+            if isinstance(name, str) and name.isidentifier():
+                if not env.has(name):
+                    env.declare(name, sql_to_js(val))
+
+    def _host_query(self, this, args):
+        q = args[0] if args else ""
+        binds = {}
+        if isinstance(q, dict) and q.get("__query__") is not None:
+            binds.update(q.get("binds") or {})
+            q = q["__query__"]
+        if len(args) > 1 and isinstance(args[1], dict):
+            binds.update(args[1])
+        from surrealdb_tpu.syn import parse
+
+        c = self.ctx.child()
+        for k, v in binds.items():
+            c.vars[k] = js_to_sql(v)
+        from surrealdb_tpu.exec.statements import eval_statement
+
+        stmts = parse(str(q))
+        out = NONE
+        for st in stmts:
+            out = eval_statement(st, c)
+        return sql_to_js(out)
+
+    def _host_value(self, this, args):
+        from surrealdb_tpu.exec.eval import evaluate
+        from surrealdb_tpu.syn import parse_value_expr
+
+        src = str(args[0]) if args else ""
+        node = parse_value_expr(src)
+        return sql_to_js(evaluate(node, self.ctx))
+
+    # -- statements ----------------------------------------------------------
+    def exec_stmt(self, node, env):
+        self.ops += 1
+        if self.ops > _MAX_OPS:
+            raise JSError("Max script execution time exceeded")
+        tag = node[0]
+        if tag == "block":
+            benv = Env(env)
+            for st in node[1]:
+                self.exec_stmt(st, benv)
+        elif tag == "decl":
+            for name, init in node[1]:
+                env.declare(
+                    name, self.eval(init, env) if init is not None else UNDEF
+                )
+        elif tag == "return":
+            raise _Return(
+                self.eval(node[1], env) if node[1] is not None else UNDEF
+            )
+        elif tag == "throw":
+            v = self.eval(node[1], env)
+            if isinstance(v, JSErrorObj):
+                raise JSError(v.message)
+            raise JSError(js_display(v))
+        elif tag == "if":
+            if js_truthy(self.eval(node[1], env)):
+                self.exec_stmt(node[2], env)
+            elif node[3] is not None:
+                self.exec_stmt(node[3], env)
+        elif tag == "while":
+            while js_truthy(self.eval(node[1], env)):
+                try:
+                    self.exec_stmt(node[2], env)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+        elif tag == "for":
+            fenv = Env(env)
+            if node[1] is not None:
+                self.exec_stmt(node[1], fenv)
+            while node[2] is None or js_truthy(self.eval(node[2], fenv)):
+                try:
+                    self.exec_stmt(node[4], fenv)
+                except _Break:
+                    break
+                except _Continue:
+                    pass
+                if node[3] is not None:
+                    self.eval(node[3], fenv)
+        elif tag == "forof":
+            it = self.eval(node[2], env)
+            if isinstance(it, dict):
+                it = list(it.values())
+            if isinstance(it, str):
+                it = list(it)
+            for v in it or []:
+                fenv = Env(env)
+                fenv.declare(node[1], v)
+                try:
+                    self.exec_stmt(node[3], fenv)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+        elif tag == "try":
+            try:
+                self.exec_stmt(node[1], env)
+            except JSError as e:
+                if node[3] is not None:
+                    henv = Env(env)
+                    if node[2]:
+                        henv.declare(node[2], JSErrorObj(e.message))
+                    self.exec_stmt(node[3], henv)
+            finally:
+                if node[4] is not None:
+                    self.exec_stmt(node[4], env)
+        elif tag == "break":
+            raise _Break()
+        elif tag == "continue":
+            raise _Continue()
+        elif tag == "empty":
+            pass
+        elif tag == "expr":
+            self.eval(node[1], env)
+        else:
+            raise JSError(f"Unsupported statement {tag}")
+
+    # -- expressions ---------------------------------------------------------
+    def eval(self, node, env):
+        self.ops += 1
+        if self.ops > _MAX_OPS:
+            raise JSError("Max script execution time exceeded")
+        tag = node[0]
+        if tag == "lit":
+            return node[1]
+        if tag == "var":
+            return env.get(node[1])
+        if tag == "template":
+            out = []
+            for p in node[1]:
+                v = self.eval(p, env)
+                out.append(v if isinstance(v, str) else js_display(v))
+            return "".join(out)
+        if tag == "array":
+            out = []
+            for it in node[1]:
+                if it[0] == "spread":
+                    sv = self.eval(it[1], env)
+                    out.extend(sv if isinstance(sv, list) else list(sv))
+                else:
+                    out.append(self.eval(it, env))
+            return out
+        if tag == "object":
+            out = {}
+            for key, vexpr in node[1]:
+                if key == "spread":
+                    sv = self.eval(vexpr, env)
+                    if isinstance(sv, dict):
+                        out.update(sv)
+                    continue
+                if isinstance(key, tuple) and key[0] == "computed":
+                    key = js_display(self.eval(key[1], env))
+                out[key] = self.eval(vexpr, env)
+            return out
+        if tag == "func":
+            return JSFunction(
+                node[1], node[2], env, self, node[3],
+                this=env.get("this") if env.has("this") else UNDEF,
+            )
+        if tag == "seq":
+            self.eval(node[1], env)
+            return self.eval(node[2], env)
+        if tag == "ternary":
+            return (
+                self.eval(node[2], env)
+                if js_truthy(self.eval(node[1], env))
+                else self.eval(node[3], env)
+            )
+        if tag == "unary":
+            op = node[1]
+            if op == "await":
+                return self.eval(node[2], env)
+            if op == "typeof":
+                try:
+                    v = self.eval(node[2], env)
+                except JSError:
+                    return "undefined"
+                return js_typeof(v)
+            v = self.eval(node[2], env)
+            if op == "!":
+                return not js_truthy(v)
+            if op == "-":
+                if isinstance(v, BigInt):
+                    return BigInt(-int(v))
+                return -js_num(v)
+            if op == "+":
+                return js_num(v)
+            if op == "~":
+                return ~int(js_num(v))
+            if op == "delete":
+                return True
+            raise JSError(f"Unsupported unary {op}")
+        if tag == "update":
+            op, target, prefix = node[1], node[2], node[3]
+            cur = js_num(self.eval(target, env))
+            new = cur + 1 if op == "++" else cur - 1
+            self._assign_to(target, new, env)
+            return new if prefix else cur
+        if tag == "bin":
+            return self._binop(node[1], node[2], node[3], env)
+        if tag == "assign":
+            op = node[1]
+            if op == "=":
+                v = self.eval(node[3], env)
+            else:
+                cur = self.eval(node[2], env)
+                rhs = self.eval(node[3], env)
+                v = self._arith(op[0], cur, rhs)
+            self._assign_to(node[2], v, env)
+            return v
+        if tag == "member":
+            obj = self.eval(node[1], env)
+            if node[3] and (obj is UNDEF or obj is None):
+                return UNDEF
+            return self._member(obj, node[2])
+        if tag == "index":
+            obj = self.eval(node[1], env)
+            idx = self.eval(node[2], env)
+            return self._index(obj, idx)
+        if tag == "call":
+            return self._call(node, env)
+        if tag == "new":
+            return self._new(node, env)
+        if tag == "spread":
+            return self.eval(node[1], env)
+        raise JSError(f"Unsupported expression {tag}")
+
+    def _assign_to(self, target, value, env):
+        tag = target[0]
+        if tag == "var":
+            env.set(target[1], value)
+        elif tag == "member":
+            obj = self.eval(target[1], env)
+            if isinstance(obj, dict):
+                obj[target[2]] = value
+            else:
+                setattr(obj, target[2], value)
+        elif tag == "index":
+            obj = self.eval(target[1], env)
+            idx = self.eval(target[2], env)
+            if isinstance(obj, list):
+                i = int(js_num(idx))
+                while len(obj) <= i:
+                    obj.append(UNDEF)
+                obj[i] = value
+            elif isinstance(obj, dict):
+                obj[js_display(idx)] = value
+        else:
+            raise JSError("Invalid assignment target")
+
+    def _binop(self, op, le, re_, env):
+        if op == "&&":
+            lv = self.eval(le, env)
+            return self.eval(re_, env) if js_truthy(lv) else lv
+        if op == "||":
+            lv = self.eval(le, env)
+            return lv if js_truthy(lv) else self.eval(re_, env)
+        lv = self.eval(le, env)
+        rv = self.eval(re_, env)
+        if op in ("+", "-", "*", "/", "%", "**"):
+            return self._arith(op, lv, rv)
+        if op == "===":
+            return js_strict_eq(lv, rv)
+        if op == "!==":
+            return not js_strict_eq(lv, rv)
+        if op == "==":
+            return js_loose_eq(lv, rv)
+        if op == "!=":
+            return not js_loose_eq(lv, rv)
+        if op in ("<", ">", "<=", ">="):
+            if isinstance(lv, str) and isinstance(rv, str):
+                a, b = lv, rv
+            else:
+                a, b = js_num(lv), js_num(rv)
+            return {"<": a < b, ">": a > b, "<=": a <= b, ">=": a >= b}[op]
+        if op == "instanceof":
+            if isinstance(rv, tuple) and rv:
+                kind = rv[0]
+                if kind == "class_u8":
+                    return isinstance(lv, (bytes, bytearray))
+                if kind == "class_error":
+                    return isinstance(lv, JSErrorObj)
+                if kind == "class_date":
+                    return isinstance(lv, _HostValue) and isinstance(
+                        lv.value, Datetime
+                    )
+                if kind == "class_duration":
+                    return isinstance(lv, _HostValue) and isinstance(
+                        lv.value, Duration
+                    )
+                if kind == "class_record":
+                    return isinstance(lv, _HostValue) and isinstance(
+                        lv.value, RecordId
+                    )
+                if kind == "class_uuid":
+                    return isinstance(lv, _HostValue) and isinstance(
+                        lv.value, Uuid
+                    )
+            if rv is _ARRAY or (isinstance(rv, dict) and rv is _ARRAY):
+                return isinstance(lv, list)
+            return False
+        if op == "in":
+            return js_display(lv) in rv if isinstance(rv, dict) else False
+        if op in ("&", "|", "^"):
+            a, b = int(js_num(lv)), int(js_num(rv))
+            return {"&": a & b, "|": a | b, "^": a ^ b}[op]
+        raise JSError(f"Unsupported operator {op}")
+
+    def _arith(self, op, lv, rv):
+        if op == "+" and (isinstance(lv, str) or isinstance(rv, str)):
+            return (lv if isinstance(lv, str) else js_display(lv)) + (
+                rv if isinstance(rv, str) else js_display(rv)
+            )
+        if isinstance(lv, BigInt) and isinstance(rv, BigInt):
+            a, b = int(lv), int(rv)
+            out = {
+                "+": a + b, "-": a - b, "*": a * b,
+                "/": a // b if b else 0, "%": a % b if b else 0,
+                "**": a ** b,
+            }[op]
+            return BigInt(out)
+        a, b = js_num(lv), js_num(rv)
+        if op == "+":
+            r = a + b
+        elif op == "-":
+            r = a - b
+        elif op == "*":
+            r = a * b
+        elif op == "/":
+            if b == 0:
+                return float("nan") if a == 0 else math.copysign(
+                    float("inf"), a * (1 if b >= 0 else -1)
+                )
+            r = a / b
+        elif op == "%":
+            if b == 0:
+                return float("nan")
+            r = math.fmod(a, b)
+        elif op == "**":
+            r = a ** b
+        else:
+            raise JSError(f"Unsupported operator {op}")
+        if isinstance(a, int) and isinstance(b, int) and isinstance(r, int):
+            return r
+        if isinstance(r, float) and r.is_integer() and op != "/":
+            return r
+        return r
+
+    # -- member access / methods ---------------------------------------------
+    def _member(self, obj, name):
+        if isinstance(obj, dict):
+            if name in obj:
+                return obj[name]
+            meth = _object_method(obj, name)
+            if meth is not None:
+                return meth
+            return UNDEF
+        if isinstance(obj, list):
+            if name == "length":
+                return len(obj)
+            meth = _array_method(obj, name, self)
+            if meth is not None:
+                return meth
+            return UNDEF
+        if isinstance(obj, str):
+            if name == "length":
+                return len(obj)
+            meth = _string_method(obj, name)
+            if meth is not None:
+                return meth
+            return UNDEF
+        if isinstance(obj, (bytes, bytearray)):
+            if name == "length":
+                return len(obj)
+            return UNDEF
+        if isinstance(obj, JSErrorObj):
+            if name == "message":
+                return obj.message
+            return UNDEF
+        if isinstance(obj, _HostValue):
+            return obj.member(name)
+        if obj is UNDEF or obj is None:
+            raise JSError(
+                f"Cannot read properties of "
+                f"{'undefined' if obj is UNDEF else 'null'} "
+                f"(reading '{name}')"
+            )
+        return UNDEF
+
+    def _index(self, obj, idx):
+        if isinstance(obj, list):
+            i = int(js_num(idx))
+            if 0 <= i < len(obj):
+                return obj[i]
+            return UNDEF
+        if isinstance(obj, str):
+            i = int(js_num(idx))
+            if 0 <= i < len(obj):
+                return obj[i]
+            return UNDEF
+        if isinstance(obj, (bytes, bytearray)):
+            i = int(js_num(idx))
+            if 0 <= i < len(obj):
+                return obj[i]
+            return UNDEF
+        if isinstance(obj, dict):
+            return obj.get(js_display(idx), UNDEF)
+        return UNDEF
+
+    def _call(self, node, env):
+        callee = node[1]
+        args = []
+        for a in node[2]:
+            if a[0] == "spread":
+                sv = self.eval(a[1], env)
+                args.extend(sv if isinstance(sv, list) else list(sv))
+            else:
+                args.append(self.eval(a, env))
+        if callee[0] in ("member", "index"):
+            obj = self.eval(callee[1], env)
+            if callee[0] == "member":
+                if callee[3] and (obj is UNDEF or obj is None):
+                    return UNDEF
+                fn = self._member(obj, callee[2])
+            else:
+                fn = self._index(obj, self.eval(callee[2], env))
+            return self._invoke(fn, obj, args, callee)
+        fn = self.eval(callee, env)
+        return self._invoke(fn, UNDEF, args, callee)
+
+    def _invoke(self, fn, this, args, callee=None):
+        if isinstance(fn, JSFunction):
+            return fn.call(this, args)
+        if isinstance(fn, tuple) and fn and fn[0] == "native":
+            return fn[1](this, args)
+        if callable(fn) and not isinstance(fn, tuple):
+            return fn(this, args)
+        name = ""
+        if callee is not None and callee[0] == "member":
+            name = callee[2]
+        raise JSError(f"'{name or js_display(fn)}' is not a function")
+
+    def _new(self, node, env):
+        cls = self.eval(node[1], env)
+        args = [self.eval(a, env) for a in node[2]]
+        if isinstance(cls, tuple):
+            kind = cls[0]
+            if kind == "class_error":
+                return JSErrorObj(js_display(args[0]) if args else "")
+            if kind == "class_date":
+                if args and isinstance(args[0], str):
+                    return _HostValue(Datetime.parse(args[0]))
+                if args and isinstance(args[0], _HostValue) and isinstance(
+                    args[0].value, Datetime
+                ):
+                    return args[0]
+                return _HostValue(Datetime.now())
+            if kind == "class_duration":
+                return _HostValue(Duration.parse(str(args[0])))
+            if kind == "class_record":
+                tb = str(args[0])
+                key = js_to_sql(args[1]) if len(args) > 1 else None
+                return _HostValue(RecordId(tb, key))
+            if kind == "class_uuid":
+                return _HostValue(Uuid(str(args[0])))
+            if kind == "class_u8":
+                if args and isinstance(args[0], list):
+                    return bytes(int(js_num(x)) & 0xFF for x in args[0])
+                if args and isinstance(args[0], (int, float)):
+                    return bytes(int(args[0]))
+                return b""
+            if kind == "class_query":
+                return {
+                    "__query__": str(args[0]) if args else "",
+                    "binds": {},
+                    "bind": ("native", _query_bind),
+                }
+        if isinstance(cls, JSFunction):
+            this = {}
+            out = cls.call(this, args)
+            return out if isinstance(out, dict) else this
+        raise JSError("not a constructor")
+
+
+def _query_bind(this, args):
+    if isinstance(this, dict):
+        this.setdefault("binds", {})[js_display(args[0])] = (
+            args[1] if len(args) > 1 else UNDEF
+        )
+    return this
+
+
+class _HostValue:
+    """A SurrealQL value passed through JS untouched (Datetime, Duration,
+    RecordId, Uuid, Geometry...)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def member(self, name):
+        v = self.value
+        if isinstance(v, RecordId):
+            if name == "tb":
+                return v.tb
+            if name == "id":
+                return sql_to_js(v.id)
+        if isinstance(v, Datetime):
+            if name == "getTime":
+                return ("native", lambda this, a: v.epoch_ns() // 1_000_000)
+            if name == "toISOString":
+                return ("native", lambda this, a: v.render()[2:-1])
+        if name == "toString":
+            from surrealdb_tpu.val import render
+
+            return ("native", lambda this, a: render(v))
+        return UNDEF
+
+
+# ---------------------------------------------------------------------------
+# value bridge + helpers
+# ---------------------------------------------------------------------------
+
+
+def sql_to_js(v):
+    if v is NONE or v is None:
+        return None if v is None else UNDEF
+    if isinstance(v, Decimal):
+        return float(v)
+    if isinstance(v, Geometry):
+        # geometries surface as GeoJSON objects in scripts
+        return sql_to_js(_geo_obj(v))
+    if isinstance(v, (Datetime, Duration, RecordId, Uuid)):
+        return _HostValue(v)
+    if isinstance(v, list):
+        return [sql_to_js(x) for x in v]
+    if isinstance(v, dict):
+        return {k: sql_to_js(x) for k, x in v.items()}
+    from surrealdb_tpu.val import SSet
+
+    if isinstance(v, SSet):
+        return [sql_to_js(x) for x in v]
+    if isinstance(v, int) and not isinstance(v, bool) and (
+        v > 9007199254740991 or v < -9007199254740992
+    ):
+        return BigInt(v)
+    return v
+
+
+def _geo_obj(g):
+    o = g.to_object()
+    return o
+
+
+def js_to_sql(v):
+    if v is UNDEF:
+        return NONE
+    if v is None:
+        return None
+    if isinstance(v, _HostValue):
+        return v.value
+    if isinstance(v, JSFunction) or (isinstance(v, tuple) and v):
+        return NONE
+    if isinstance(v, BigInt):
+        return int(v)
+    if isinstance(v, float) and v.is_integer() and abs(v) < 2**53:
+        # JS numbers are doubles; integral results surface as ints
+        return int(v)
+    if isinstance(v, list):
+        return [js_to_sql(x) for x in v]
+    if isinstance(v, dict):
+        out = {}
+        for k, x in v.items():
+            if k in ("__query__", "binds", "bind"):
+                continue
+            if isinstance(x, (JSFunction, tuple)):
+                continue
+            out[k] = js_to_sql(x)
+        # GeoJSON-shaped objects become geometry, like eval's object path
+        if len(out) == 2 and "type" in out and (
+            "coordinates" in out or "geometries" in out
+        ):
+            from surrealdb_tpu.exec.coerce import object_to_geometry
+
+            g = object_to_geometry(out)
+            if g is not None:
+                return g
+        return out
+    if isinstance(v, JSErrorObj):
+        return str(v.message)
+    return v
+
+
+def js_truthy(v):
+    if v is UNDEF or v is None or v is False:
+        return False
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return v != 0 and v == v
+    if isinstance(v, str):
+        return len(v) > 0
+    return True
+
+
+def js_num(v):
+    if isinstance(v, bool):
+        return 1 if v else 0
+    if isinstance(v, (int, float)):
+        return v
+    if isinstance(v, str):
+        try:
+            return int(v) if v.strip().isdigit() else float(v)
+        except ValueError:
+            return float("nan")
+    if v is None:
+        return 0
+    return float("nan")
+
+
+def js_typeof(v):
+    if v is UNDEF:
+        return "undefined"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, BigInt):
+        return "bigint"
+    if isinstance(v, (int, float)):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, JSFunction) or (
+        isinstance(v, tuple) and v and v[0] == "native"
+    ):
+        return "function"
+    return "object"
+
+
+def js_strict_eq(a, b):
+    if isinstance(a, BigInt) != isinstance(b, BigInt):
+        return False
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    num = (int, float)
+    if isinstance(a, num) and isinstance(b, num):
+        return a == b
+    if type(a) is not type(b):
+        if a is UNDEF or b is UNDEF or a is None or b is None:
+            return a is b
+    if isinstance(a, (list, dict)):
+        return a is b
+    return a == b
+
+
+def js_loose_eq(a, b):
+    if a is UNDEF or a is None:
+        return b is UNDEF or b is None
+    num = (int, float)
+    if isinstance(a, num) and isinstance(b, str):
+        return a == js_num(b)
+    if isinstance(a, str) and isinstance(b, num):
+        return js_num(a) == b
+    return js_strict_eq(a, b)
+
+
+def js_display(v):
+    if v is UNDEF:
+        return "undefined"
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        if v != v:
+            return "NaN"
+        if v.is_integer() and abs(v) < 1e21:
+            return str(int(v))
+        return repr(v)
+    if isinstance(v, BigInt):
+        return str(int(v))
+    if isinstance(v, list):
+        return ",".join(js_display(x) for x in v)
+    if isinstance(v, dict):
+        return "[object Object]"
+    if isinstance(v, JSErrorObj):
+        return f"Error: {v.message}"
+    if isinstance(v, _HostValue):
+        from surrealdb_tpu.val import render
+
+        return render(v.value)
+    return str(v)
+
+
+# -- built-in namespaces -----------------------------------------------------
+
+
+def _n(fn):
+    return ("native", fn)
+
+
+_MATH = {
+    "round": _n(lambda t, a: int(math.floor(js_num(a[0]) + 0.5))),
+    "floor": _n(lambda t, a: int(math.floor(js_num(a[0])))),
+    "ceil": _n(lambda t, a: int(math.ceil(js_num(a[0])))),
+    "abs": _n(lambda t, a: abs(js_num(a[0]))),
+    "sqrt": _n(lambda t, a: math.sqrt(js_num(a[0]))),
+    "pow": _n(lambda t, a: js_num(a[0]) ** js_num(a[1])),
+    "min": _n(lambda t, a: min(js_num(x) for x in a)),
+    "max": _n(lambda t, a: max(js_num(x) for x in a)),
+    "trunc": _n(lambda t, a: int(js_num(a[0]))),
+    "random": _n(lambda t, a: __import__("random").random()),
+    "PI": math.pi,
+    "E": math.e,
+}
+
+
+def _json_stringify(t, a):
+    import json as _j
+
+    def conv(v):
+        if v is UNDEF:
+            return None
+        if isinstance(v, list):
+            return [conv(x) for x in v]
+        if isinstance(v, dict):
+            return {k: conv(x) for k, x in v.items()}
+        if isinstance(v, BigInt):
+            raise JSError("Do not know how to serialize a BigInt")
+        if isinstance(v, _HostValue):
+            return js_display(v)
+        return v
+
+    return _j.dumps(conv(a[0] if a else None))
+
+
+_JSON = {
+    "stringify": _n(_json_stringify),
+    "parse": _n(lambda t, a: __import__("json").loads(a[0])),
+}
+
+_OBJECT = {
+    "keys": _n(lambda t, a: list(a[0].keys()) if isinstance(a[0], dict) else []),
+    "values": _n(
+        lambda t, a: list(a[0].values()) if isinstance(a[0], dict) else []
+    ),
+    "entries": _n(
+        lambda t, a: [[k, v] for k, v in a[0].items()]
+        if isinstance(a[0], dict) else []
+    ),
+    "assign": _n(lambda t, a: _obj_assign(a)),
+    "fromEntries": _n(
+        lambda t, a: {js_display(k): v for k, v in (a[0] or [])}
+    ),
+}
+
+
+def _obj_assign(a):
+    out = a[0] if a and isinstance(a[0], dict) else {}
+    for src in a[1:]:
+        if isinstance(src, dict):
+            out.update(src)
+    return out
+
+
+_ARRAY = {
+    "isArray": _n(lambda t, a: isinstance(a[0] if a else None, list)),
+    "from": _n(lambda t, a: list(a[0]) if a else []),
+    "of": _n(lambda t, a: list(a)),
+}
+
+_NUMBER = {
+    "isInteger": _n(
+        lambda t, a: isinstance(a[0], int) and not isinstance(a[0], bool)
+        or (isinstance(a[0], float) and a[0].is_integer())
+    ),
+    "isFinite": _n(
+        lambda t, a: isinstance(a[0], (int, float))
+        and not isinstance(a[0], bool) and math.isfinite(a[0])
+    ),
+    "isNaN": _n(lambda t, a: isinstance(a[0], float) and a[0] != a[0]),
+    "parseFloat": _n(lambda t, a: float(a[0])),
+    "parseInt": _n(lambda t, a: int(float(a[0]))),
+    "MAX_SAFE_INTEGER": 9007199254740991,
+    "MIN_SAFE_INTEGER": -9007199254740991,
+}
+
+_STRING = {
+    "fromCharCode": _n(lambda t, a: "".join(chr(int(js_num(x))) for x in a)),
+}
+
+
+def _array_method(arr, name, interp):
+    def call(fn, *args):
+        return interp._invoke(fn, UNDEF, list(args))
+
+    if name == "map":
+        return _n(lambda t, a: [
+            call(a[0], v, i, arr) for i, v in enumerate(arr)
+        ])
+    if name == "filter":
+        return _n(lambda t, a: [
+            v for i, v in enumerate(arr) if js_truthy(call(a[0], v, i, arr))
+        ])
+    if name == "forEach":
+        def _fe(t, a):
+            for i, v in enumerate(arr):
+                call(a[0], v, i, arr)
+            return UNDEF
+        return _n(_fe)
+    if name == "join":
+        return _n(lambda t, a: (
+            js_display(a[0]) if a else ","
+        ).join(js_display(x) if not isinstance(x, str) else x for x in arr))
+    if name == "push":
+        def _push(t, a):
+            arr.extend(a)
+            return len(arr)
+        return _n(_push)
+    if name == "pop":
+        return _n(lambda t, a: arr.pop() if arr else UNDEF)
+    if name == "shift":
+        return _n(lambda t, a: arr.pop(0) if arr else UNDEF)
+    if name == "unshift":
+        def _unshift(t, a):
+            arr[:0] = a
+            return len(arr)
+        return _n(_unshift)
+    if name == "includes":
+        return _n(lambda t, a: any(js_strict_eq(x, a[0]) for x in arr))
+    if name == "indexOf":
+        def _io(t, a):
+            for i, x in enumerate(arr):
+                if js_strict_eq(x, a[0]):
+                    return i
+            return -1
+        return _n(_io)
+    if name == "find":
+        def _find(t, a):
+            for i, v in enumerate(arr):
+                if js_truthy(call(a[0], v, i, arr)):
+                    return v
+            return UNDEF
+        return _n(_find)
+    if name == "findIndex":
+        def _fi(t, a):
+            for i, v in enumerate(arr):
+                if js_truthy(call(a[0], v, i, arr)):
+                    return i
+            return -1
+        return _n(_fi)
+    if name == "some":
+        return _n(lambda t, a: any(
+            js_truthy(call(a[0], v, i, arr)) for i, v in enumerate(arr)
+        ))
+    if name == "every":
+        return _n(lambda t, a: all(
+            js_truthy(call(a[0], v, i, arr)) for i, v in enumerate(arr)
+        ))
+    if name == "reduce":
+        def _red(t, a):
+            acc = a[1] if len(a) > 1 else None
+            items = list(enumerate(arr))
+            if acc is None:
+                if not items:
+                    raise JSError("Reduce of empty array with no initial value")
+                acc = items[0][1]
+                items = items[1:]
+            for i, v in items:
+                acc = call(a[0], acc, v, i, arr)
+            return acc
+        return _n(_red)
+    if name == "slice":
+        def _slice(t, a):
+            s = int(js_num(a[0])) if a else 0
+            e = int(js_num(a[1])) if len(a) > 1 else len(arr)
+            return arr[s:e]
+        return _n(_slice)
+    if name == "concat":
+        def _concat(t, a):
+            out = list(arr)
+            for x in a:
+                out.extend(x if isinstance(x, list) else [x])
+            return out
+        return _n(_concat)
+    if name == "flat":
+        def _flat(t, a):
+            out = []
+            for x in arr:
+                out.extend(x if isinstance(x, list) else [x])
+            return out
+        return _n(_flat)
+    if name == "reverse":
+        def _rev(t, a):
+            arr.reverse()
+            return arr
+        return _n(_rev)
+    if name == "sort":
+        def _sort(t, a):
+            import functools
+
+            if a:
+                arr.sort(key=functools.cmp_to_key(
+                    lambda x, y: js_num(call(a[0], x, y)) or 0
+                ))
+            else:
+                arr.sort(key=js_display)
+            return arr
+        return _n(_sort)
+    return None
+
+
+def _string_method(s, name):
+    if name == "toUpperCase":
+        return _n(lambda t, a: s.upper())
+    if name == "toLowerCase":
+        return _n(lambda t, a: s.lower())
+    if name == "trim":
+        return _n(lambda t, a: s.strip())
+    if name == "split":
+        return _n(lambda t, a: s.split(a[0]) if a and a[0] != "" else list(s))
+    if name == "includes":
+        return _n(lambda t, a: (a[0] in s) if a else False)
+    if name == "startsWith":
+        return _n(lambda t, a: s.startswith(a[0]) if a else False)
+    if name == "endsWith":
+        return _n(lambda t, a: s.endswith(a[0]) if a else False)
+    if name == "indexOf":
+        return _n(lambda t, a: s.find(a[0]) if a else -1)
+    if name == "slice":
+        def _sl(t, a):
+            b = int(js_num(a[0])) if a else 0
+            e = int(js_num(a[1])) if len(a) > 1 else len(s)
+            return s[b:e]
+        return _n(_sl)
+    if name == "substring":
+        def _ss(t, a):
+            b = max(int(js_num(a[0])) if a else 0, 0)
+            e = max(int(js_num(a[1])) if len(a) > 1 else len(s), 0)
+            if b > e:
+                b, e = e, b
+            return s[b:e]
+        return _n(_ss)
+    if name == "replace":
+        return _n(lambda t, a: s.replace(a[0], a[1], 1))
+    if name == "replaceAll":
+        return _n(lambda t, a: s.replace(a[0], a[1]))
+    if name == "repeat":
+        return _n(lambda t, a: s * int(js_num(a[0])))
+    if name == "charCodeAt":
+        return _n(lambda t, a: ord(s[int(js_num(a[0])) if a else 0]))
+    if name == "charAt":
+        def _ca(t, a):
+            i = int(js_num(a[0])) if a else 0
+            return s[i] if 0 <= i < len(s) else ""
+        return _n(_ca)
+    if name == "padStart":
+        return _n(lambda t, a: s.rjust(
+            int(js_num(a[0])), a[1] if len(a) > 1 else " "
+        ))
+    if name == "concat":
+        return _n(lambda t, a: s + "".join(js_display(x) for x in a))
+    if name == "toString":
+        return _n(lambda t, a: s)
+    return None
+
+
+def _object_method(obj, name):
+    if name == "hasOwnProperty":
+        return _n(lambda t, a: js_display(a[0]) in obj if a else False)
+    if name == "toString":
+        return _n(lambda t, a: "[object Object]")
+    return None

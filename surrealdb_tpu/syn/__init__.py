@@ -19,3 +19,9 @@ def parse_value(text: str):
     from surrealdb_tpu.syn.parser import parse_value_literal
 
     return parse_value_literal(text)
+
+
+def parse_value_expr(text: str):
+    """Parse one SurrealQL expression into its AST (unevaluated) — used by
+    the script runtime's surrealdb.value() host call."""
+    return Parser(text).parse_expr()

@@ -23,6 +23,81 @@ FILE_STR = "FILE"
 REGEX = "REGEX"
 OP = "OP"
 EOF = "EOF"
+SCRIPT = "SCRIPT"
+
+
+def _scan_script(src, k, err):
+    """Raw-scan `($args) { body }` starting at the '(' — JS-aware string/
+    comment/brace matching. Returns the end index past the closing brace,
+    or None when this isn't a script function."""
+    n = len(src)
+    depth = 0
+    i = k
+    # argument list (SurrealQL params — simple paren matching with strings)
+    while i < n:
+        c = src[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                i += 1
+                break
+        elif c in "'\"":
+            q = c
+            i += 1
+            while i < n and src[i] != q:
+                if src[i] == "\\":
+                    i += 1
+                i += 1
+        i += 1
+    while i < n and src[i] in " \t\r\n":
+        i += 1
+    if i >= n or src[i] != "{":
+        return None
+    depth = 0
+    while i < n:
+        c = src[i]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+        elif c in "'\"`":
+            q = c
+            i += 1
+            while i < n:
+                if src[i] == "\\":
+                    i += 2
+                    continue
+                if src[i] == q:
+                    break
+                # template interpolation braces balance inside the outer
+                # depth count, so no special handling needed beyond strings
+                if q == "`" and src[i] == "$" and i + 1 < n and src[i + 1] == "{":
+                    d2 = 0
+                    while i < n:
+                        if src[i] == "{":
+                            d2 += 1
+                        elif src[i] == "}":
+                            d2 -= 1
+                            if d2 == 0:
+                                break
+                        elif src[i] == "\\":
+                            i += 1
+                        i += 1
+                i += 1
+        elif c == "/" and i + 1 < n and src[i + 1] == "/":
+            while i < n and src[i] != "\n":
+                i += 1
+        elif c == "/" and i + 1 < n and src[i + 1] == "*":
+            i += 2
+            while i + 1 < n and not (src[i] == "*" and src[i + 1] == "/"):
+                i += 1
+            i += 1
+        i += 1
+    err("unterminated script function body")
 
 _PUNCT3 = ("..=", "...", "?:=")
 _PUNCT2 = (
@@ -195,7 +270,21 @@ def tokenize(src: str) -> list[Token]:
             j = i
             while j < n and _is_ident(src[j]):
                 j += 1
-            push(IDENT, src[start:j], src[start:j], start)
+            word = src[start:j]
+            # `function (...) { raw js }` — embedded script: the body is a
+            # different language, captured raw (reference fnc/script)
+            if word == "function":
+                k = j
+                while k < n and src[k] in " \t\r\n":
+                    k += 1
+                if k < n and src[k] == "(":
+                    endp = _scan_script(src, k, err)
+                    if endp is not None:
+                        push(SCRIPT, src[start:endp], src[start:endp], start)
+                        col += endp - i
+                        i = endp
+                        continue
+            push(IDENT, word, word, start)
             col += j - i
             i = j
             continue

@@ -1932,6 +1932,28 @@ class Parser:
     def parse_expr(self):
         return self._parse_or()
 
+    def _script_expr(self, raw: str):
+        """A SCRIPT token: `function($a, $b) { js }` — parse the SurrealQL
+        arg expressions; the body stays raw for the script runtime."""
+        inner = raw[raw.index("(") + 1:]
+        depth = 1
+        args_src = ""
+        for i, ch in enumerate(inner):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    args_src = inner[:i]
+                    break
+        args = []
+        if args_src.strip():
+            sub = Parser(args_src)
+            args.append(sub.parse_expr())
+            while sub.eat_op(","):
+                args.append(sub.parse_expr())
+        return ScriptExpr(args, raw)
+
     def _parse_or(self):
         lhs = self._parse_and()
         while self.at_op("||") or self.at_kw("or"):
@@ -2404,6 +2426,9 @@ class Parser:
         if k == L.REGEX:
             self.next()
             return RegexLit(t.value)
+        if k == L.SCRIPT:
+            self.next()
+            return self._script_expr(t.value)
         if k == L.PARAM:
             self.next()
             return Param(t.value)
