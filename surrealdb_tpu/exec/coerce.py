@@ -30,12 +30,20 @@ from surrealdb_tpu.val import (
 def kind_name(kind: Kind) -> str:
     if kind.name == "either":
         return " | ".join(kind_name(k) for k in kind.inner)
+    if kind.name == "option":
+        # option<X> renders as `none | X` (reference kind display)
+        if kind.inner:
+            return f"none | {kind_name(kind.inner[0])}"
+        return "none"
     if kind.name == "record" and kind.inner:
         return f"record<{' | '.join(kind.inner)}>"
     if kind.name in ("table", "geometry") and kind.inner:
         return f"{kind.name}<{'|'.join(str(x) for x in kind.inner)}>"
     if kind.name == "object_literal":
-        inner = ", ".join(f"{k}: {kind_name(kk)}" for k, kk in kind.inner)
+        inner = ", ".join(
+            f"{k}: {kind_name(kk)}"
+            for k, kk in sorted(kind.inner, key=lambda p: p[0])
+        )
         return "{ " + inner + " }"
     if kind.name == "array_literal":
         return "[" + ", ".join(kind_name(k) for k in kind.inner) + "]"
@@ -273,7 +281,12 @@ def coerce(v, kind: Kind):
             if k not in declared:
                 raise coerce_err(v, kind)
         for k, kk in declared.items():
-            sub = coerce(v.get(k, NONE), kk)
+            try:
+                sub = coerce(v.get(k, NONE), kk)
+            except SdbError:
+                # sub-field mismatches report at the object level, with the
+                # full declared kind and the full offending value
+                raise coerce_err(v, kind)
             if sub is not NONE:
                 out[k] = sub
         return out

@@ -68,7 +68,7 @@ class Executor:
                                 )
                         results.append(
                             QueryResult(
-                                error="The query was not executed due to a failed transaction"
+                                error="Cannot COMMIT: the transaction was aborted due to a prior error"
                             )
                         )
                     else:
@@ -78,7 +78,7 @@ class Executor:
                 else:
                     results.append(
                         QueryResult(
-                            error="Cannot COMMIT without starting a transaction"
+                            error="Invalid statement: Cannot COMMIT without starting a transaction"
                         )
                     )
                 continue
@@ -94,17 +94,18 @@ class Executor:
                 else:
                     results.append(
                         QueryResult(
-                            error="Cannot CANCEL without starting a transaction"
+                            error="Invalid statement: Cannot CANCEL without starting a transaction"
                         )
                     )
                 continue
             if txn is not None and failed:
+                # statements after the failing one report the transaction as
+                # cancelled (the failure itself reported the real error)
                 results.append(
                     QueryResult(
-                        error="The query was not executed due to a failed transaction"
+                        error="The query was not executed due to a cancelled transaction"
                     )
                 )
-                buffered.append(len(results) - 1)
                 continue
             own_txn = txn is None
             cur = txn or self.ds.transaction(write=True)
