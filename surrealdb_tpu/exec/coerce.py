@@ -122,7 +122,7 @@ def _type_name(v) -> str:
     if isinstance(v, File):
         return "file"
     if isinstance(v, Table):
-        return "string"
+        return "table"
     return type(v).__name__
 
 

@@ -422,10 +422,18 @@ def call_closure(clo: Closure, args: list, ctx: Ctx):
         if pkind is not None:
             v = coerce(v, pkind)
         c.vars[pname] = v
+    from surrealdb_tpu.err import BreakException, ContinueException
+
     try:
         out = evaluate(clo.body, c)
     except ReturnException as r:
         out = r.value
+    except (BreakException, ContinueException):
+        # loop control cannot cross a function frame (reference ctrl flow)
+        raise SdbError(
+            "Invalid control flow statement, break or continue statement "
+            "found outside of loop."
+        )
     if clo.returns is not None:
         out = coerce(out, clo.returns)
     return out
@@ -886,20 +894,24 @@ def _recursive_destructure(val, dez: PDestructure, rmin, rmax, ctx, depth=0):
             out[name] = evaluate(sub, c)
             continue
         prefix = [p for p in sub.parts[:-1] if not isinstance(p, tuple)]
-        children = walk(
-            node if isinstance(node, RecordId) else doc, prefix, ctx
-        )
-        if children is NONE or children is None:
-            children = []
-        if not isinstance(children, list):
-            children = [children]
-        if depth + 1 >= rmax:
-            out[name] = []
+        raw = walk(node if isinstance(node, RecordId) else doc, prefix, ctx)
+        # the dead-end value keeps the step's own shape: a missing record
+        # link stays NONE, an empty graph step stays [] (reference
+        # recursive-destructure semantics)
+        if raw is NONE or raw is None:
+            out[name] = NONE
+            continue
+        children = raw if isinstance(raw, list) else [raw]
+        children = [c for c in children if c is not NONE and c is not None]
+        if not children:
+            out[name] = [] if isinstance(raw, list) else NONE
+        elif depth + 1 >= rmax:
+            # the depth bound emits the raw frontier ids
+            out[name] = children
         else:
             out[name] = [
                 _recursive_destructure(ch, dez, rmin, rmax, ctx, depth + 1)
                 for ch in children
-                if ch is not NONE and ch is not None
             ]
     return out
 

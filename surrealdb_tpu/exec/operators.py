@@ -130,6 +130,9 @@ def sub(a, b):
         return a - b
     if isinstance(a, list) and isinstance(b, list):
         return [x for x in a if not any(value_eq(x, y) for y in b)]
+    if isinstance(a, list):
+        # array - value removes matching elements (reference sub on arrays)
+        return [x for x in a if not value_eq(x, b)]
     from surrealdb_tpu.val import SSet
 
     if isinstance(a, SSet):

@@ -188,6 +188,15 @@ def call_custom(name, args, ctx):
         out = evaluate(fd.block, c)
     except ReturnException as r:
         out = r.value
+    except Exception as e:
+        from surrealdb_tpu.err import BreakException, ContinueException
+
+        if isinstance(e, (BreakException, ContinueException)):
+            raise SdbError(
+                "Invalid control flow statement, break or continue "
+                "statement found outside of loop."
+            )
+        raise
     if fd.returns is not None:
         try:
             out = coerce(out, fd.returns)
