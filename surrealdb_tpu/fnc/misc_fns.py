@@ -8,6 +8,8 @@ import hmac as _hmac
 import math
 import secrets
 
+from decimal import Decimal
+
 from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.fnc import _arr, _num, _str, register
 from surrealdb_tpu.val import NONE, Geometry, RecordId, render
@@ -924,42 +926,137 @@ def _search_analyze(args, ctx):
 
 @register("search::rrf")
 def _search_rrf(args, ctx):
-    """Reciprocal-rank fusion of result arrays (hybrid search)."""
-    lists = args[0]
-    k = int(args[1]) if len(args) > 1 else 60
-    limit = int(args[2]) if len(args) > 2 else None
+    """Reciprocal-rank fusion of result-object arrays keyed on `id`
+    (reference fnc search::rrf: merged fields + rrf_score)."""
+    lists = args[0] if args else []
+    limit = args[1] if len(args) > 1 else None
+    k = args[2] if len(args) > 2 else 60
+    if not isinstance(limit, int) or isinstance(limit, bool) or limit < 1:
+        raise SdbError(
+            "Incorrect arguments for function search::rrf(). "
+            "limit must be at least 1"
+        )
+    if not isinstance(k, (int, float)) or isinstance(k, bool) or k < 0:
+        raise SdbError(
+            "Incorrect arguments for function search::rrf(). "
+            "RRF constant must be at least 0"
+        )
     from surrealdb_tpu.val import hashable
 
     scores: dict = {}
-    vals: dict = {}
-    for lst in lists:
+    merged: dict = {}
+    order: list = []
+    for lst in lists or []:
+        if not isinstance(lst, list):
+            continue
         for rank, item in enumerate(lst):
-            h = hashable(item)
+            if not isinstance(item, dict):
+                continue
+            h = hashable(item.get("id", rank))
+            if h not in merged:
+                merged[h] = dict(item)
+                order.append(h)
+            else:
+                merged[h].update(item)
             scores[h] = scores.get(h, 0.0) + 1.0 / (k + rank + 1)
-            vals[h] = item
-    out = sorted(scores.items(), key=lambda kv: -kv[1])
-    res = [vals[h] for h, _s in out]
-    return res[:limit] if limit else res
+    out = sorted(order, key=lambda h: -scores[h])[: int(limit)]
+    res = []
+    for h in out:
+        row = merged[h]
+        row["rrf_score"] = scores[h]
+        res.append(row)
+    return res
 
 
 @register("search::linear")
 def _search_linear(args, ctx):
-    lists = args[0]
-    weights = args[1] if len(args) > 1 else [1.0] * len(lists)
-    limit = int(args[2]) if len(args) > 2 else None
+    """Weighted linear fusion with per-list score normalization
+    (reference fnc search::linear: minmax/zscore + linear_score)."""
+    lists = args[0] if args else []
+    weights = args[1] if len(args) > 1 else []
+    limit = args[2] if len(args) > 2 else None
+    norm = args[3] if len(args) > 3 else "minmax"
+    if not isinstance(limit, int) or isinstance(limit, bool) or limit < 1:
+        raise SdbError(
+            "Incorrect arguments for function search::linear(). "
+            "Limit must be at least 1"
+        )
+    if norm not in ("minmax", "zscore"):
+        raise SdbError(
+            "Incorrect arguments for function search::linear(). "
+            "Norm must be 'minmax' or 'zscore'"
+        )
+    if not isinstance(lists, list) or not isinstance(weights, list) or \
+            len(lists) != len(weights):
+        raise SdbError(
+            "Incorrect arguments for function search::linear(). "
+            "The results and the weights array should have the same length"
+        )
+    for i, w in enumerate(weights):
+        if isinstance(w, bool) or not isinstance(w, (int, float, Decimal)):
+            raise SdbError(
+                "Incorrect arguments for function search::linear(). "
+                f"Weight at index {i} must be a number"
+            )
     from surrealdb_tpu.val import hashable
 
     scores: dict = {}
-    vals: dict = {}
+    merged: dict = {}
+    order: list = []
     for w, lst in zip(weights, lists):
-        n = len(lst)
-        for rank, item in enumerate(lst):
-            h = hashable(item)
-            scores[h] = scores.get(h, 0.0) + float(w) * (n - rank) / max(n, 1)
-            vals[h] = item
-    out = sorted(scores.items(), key=lambda kv: -kv[1])
-    res = [vals[h] for h, _s in out]
-    return res[:limit] if limit else res
+        if not isinstance(lst, list) or not lst:
+            continue
+        # the score field is the single non-id numeric field per item;
+        # `distance` fields rank lower-is-better and normalize inverted
+        entries = []
+        field_name = None
+        for item in lst:
+            if not isinstance(item, dict):
+                continue
+            fname = next(
+                (kk for kk, vv in item.items()
+                 if kk != "id" and isinstance(vv, (int, float, Decimal))
+                 and not isinstance(vv, bool)),
+                None,
+            )
+            if fname is None:
+                continue
+            field_name = field_name or fname
+            entries.append((item, float(item[fname])))
+        if not entries:
+            continue
+        vals = [v for _it, v in entries]
+        invert = field_name == "distance"
+        if norm == "minmax":
+            lo, hi = min(vals), max(vals)
+            rng = hi - lo
+
+            def nrm(v):
+                x = (v - lo) / rng if rng else 0.0
+                return 1.0 - x if invert else x
+        else:
+            mean = sum(vals) / len(vals)
+            var = sum((v - mean) ** 2 for v in vals) / len(vals)
+            sd = var ** 0.5
+
+            def nrm(v):
+                z = (v - mean) / sd if sd else 0.0
+                return -z if invert else z
+        for item, v in entries:
+            h = hashable(item.get("id"))
+            if h not in merged:
+                merged[h] = dict(item)
+                order.append(h)
+            else:
+                merged[h].update(item)
+            scores[h] = scores.get(h, 0.0) + float(w) * nrm(v)
+    out = sorted(order, key=lambda h: -scores[h])[: int(limit)]
+    res = []
+    for h in out:
+        row = merged[h]
+        row["linear_score"] = scores[h]
+        res.append(row)
+    return res
 
 
 def _http_denied(args, ctx):

@@ -387,16 +387,20 @@ class TpuVectorIndex:
         ]
 
     def _host_distances(self, qv, xs=None):
-        xs = self.vecs if xs is None else xs
+        # f64 math: the reference computes distances in f64 regardless of
+        # the stored vector type (trees/vector.rs)
+        xs = (self.vecs if xs is None else xs).astype(np.float64)
+        qv = np.asarray(qv, dtype=np.float64)
         m = self.metric
         if m == "euclidean":
             return np.linalg.norm(xs - qv[None, :], axis=1)
         if m == "cosine":
-            xn = xs / np.maximum(
-                np.linalg.norm(xs, axis=1, keepdims=True), 1e-30
+            # 1 - dot/(|x||q|) in f64, matching the reference's rounding
+            dots = xs @ qv
+            denom = np.maximum(
+                np.linalg.norm(xs, axis=1) * np.linalg.norm(qv), 1e-300
             )
-            qn = qv / max(np.linalg.norm(qv), 1e-30)
-            return 1.0 - xn @ qn
+            return 1.0 - dots / denom
         if m == "manhattan":
             return np.abs(xs - qv[None, :]).sum(axis=1)
         if m == "chebyshev":

@@ -2369,8 +2369,8 @@ class Parser:
             self.expect_op(")")
             if order is not None or limit is not None or start is not None:
                 # clause shorthand lowers to a subquery over the edge table
-                sel = SelectStmt()
-                sel.exprs = [("*", None)]
+                sel = SelectStmt(exprs=[], what=[])
+                sel.value = Idiom([PField("id")])
                 sel.what = [
                     Idiom([PField(nm)]) for nm, _rng in what
                 ]
@@ -2408,7 +2408,10 @@ class Parser:
             return Literal(Datetime.parse(t.value))
         if k == L.UUID_STR:
             self.next()
-            return Literal(Uuid(t.value))
+            try:
+                return Literal(Uuid(t.value))
+            except ValueError:
+                raise self.err("invalid UUID literal")
         if k == L.BYTES_LIT:
             self.next()
             return Literal(t.value)
