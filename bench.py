@@ -57,7 +57,7 @@ def _setup_knn(ds, n, dim, metric):
     xs = rng.normal(size=(n, dim)).astype(np.float32)
     ds.query(
         f"DEFINE TABLE tbl; DEFINE INDEX ix ON tbl FIELDS emb HNSW "
-        f"DIMENSION {dim} DIST {metric.upper()}",
+        f"DIMENSION {dim} DIST {metric.upper()} TYPE F32",
         ns="b", db="b",
     )
     _bulk_vectors(ds, "b", "b", "tbl", "ix", xs, dim)
@@ -285,7 +285,7 @@ def bench_hybrid(quick=False):
     ds.query(
         "DEFINE ANALYZER simple TOKENIZERS class FILTERS lowercase;"
         "DEFINE INDEX ft ON doc FIELDS text FULLTEXT ANALYZER simple BM25;"
-        f"DEFINE INDEX hx ON doc FIELDS emb HNSW DIMENSION {dim} DIST COSINE",
+        f"DEFINE INDEX hx ON doc FIELDS emb HNSW DIMENSION {dim} DIST COSINE TYPE F32",
         ns="b", db="b",
     )
     rng = np.random.default_rng(23)

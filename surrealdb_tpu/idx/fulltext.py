@@ -35,7 +35,9 @@ def _tokenize(text: str, tokenizers: list) -> list[tuple[str, int, int]]:
                 for m in _re.finditer(r"\S+", s):
                     out.append((m.group(), base + m.start()))
             elif tk == "punct":
-                for m in _re.finditer(r"[^\s\W]+|\w+", s):
+                # punctuation chars are tokens of their own (they count
+                # toward BM25 doc length, like the reference tokenizer)
+                for m in _re.finditer(r"\w+|[^\w\s]", s):
                     out.append((m.group(), base + m.start()))
             elif tk == "class":
                 # split on unicode character-class changes (letter/digit/other)

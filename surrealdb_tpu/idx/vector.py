@@ -29,11 +29,18 @@ DEVICE_MIN_ROWS = 2048
 BLOCK_ROWS = 262144
 
 
-def _as_vector(v, dim, what):
+def _vec_dtype(params) -> type:
+    # the index vector type governs storage precision; the reference
+    # defaults to F64 (catalog HnswParams.vector_type)
+    vt = (params or {}).get("vector_type", "f64")
+    return np.float32 if str(vt).lower() in ("f32", "i16", "i32") else np.float64
+
+
+def _as_vector(v, dim, what, dtype=np.float64):
     if not isinstance(v, (list, tuple)):
         raise SdbError(f"Incorrect vector value for {what}")
     try:
-        arr = np.asarray(v, dtype=np.float32)
+        arr = np.asarray(v, dtype=dtype)
     except (TypeError, ValueError):
         raise SdbError(f"Incorrect vector value for {what}")
     if arr.ndim != 1 or arr.shape[0] != dim:
@@ -51,6 +58,7 @@ def vector_index_update(idef, rid: RecordId, before, after, ctx):
     col = idef.cols[0]
     from surrealdb_tpu.exec.eval import evaluate
 
+    dtype = _vec_dtype(idef.hnsw)
     key = K.ix_state(ns, db, rid.tb, idef.name, b"he", K.enc_value(rid.id))
     vkey = K.ix_state(ns, db, rid.tb, idef.name, b"vn")
     old_vec = None
@@ -62,7 +70,7 @@ def vector_index_update(idef, rid: RecordId, before, after, ctx):
     if isinstance(after, dict):
         v = evaluate(col, ctx.with_doc(after, rid))
         if v is not NONE and v is not None:
-            new_vec = _as_vector(v, dim, f"index {idef.name}")
+            new_vec = _as_vector(v, dim, f"index {idef.name}", dtype)
     if new_vec is None and old_vec is None:
         return
     # version allocation is process-atomic (ds.lock): concurrent writers
@@ -99,11 +107,12 @@ class TpuVectorIndex:
         self.metric, self.mink_p = normalize_metric(
             params.get("distance", "euclidean")
         )
+        self.dtype = _vec_dtype(params)
         self.lock = threading.RLock()
         self.version = -1
         self.rids: list = []  # row -> RecordId
         self.row_index: dict = {}  # enc(id) -> row
-        self.vecs = np.zeros((0, self.dim), dtype=np.float32)
+        self.vecs = np.zeros((0, self.dim), dtype=self.dtype)
         self.valid = np.zeros(0, dtype=bool)  # tombstone mask
         self.device_vecs = None  # jax array (lazy)
         self.device_valid = None
@@ -158,7 +167,7 @@ class TpuVectorIndex:
                 if row is not None and row < len(self.valid):
                     self.valid[row] = False
                 continue
-            vec = np.frombuffer(raw, dtype=np.float32)
+            vec = np.frombuffer(raw, dtype=self.dtype)
             if row is not None and row < len(self.vecs):
                 self.vecs[row] = vec
                 self.valid[row] = True
@@ -196,11 +205,11 @@ class TpuVectorIndex:
             idv, _pos = K.dec_value(k, plen)
             index[K.enc_value(idv)] = len(rids)
             rids.append(RecordId(tb, idv))
-            rows.append(np.frombuffer(deserialize(raw), dtype=np.float32))
+            rows.append(np.frombuffer(deserialize(raw), dtype=self.dtype))
         self.rids = rids
         self.row_index = index
         self.vecs = (
-            np.stack(rows) if rows else np.zeros((0, self.dim), np.float32)
+            np.stack(rows) if rows else np.zeros((0, self.dim), self.dtype)
         )
         self.valid = np.ones(len(rids), dtype=bool)
         self.device_vecs = None
@@ -267,7 +276,7 @@ class TpuVectorIndex:
         n = int(self.valid.sum())
         if n == 0:
             return []
-        qv = _as_vector(q, self.dim, "knn query")
+        qv = _as_vector(q, self.dim, "knn query", self.dtype)
         if cond is None:
             pairs = self._raw_knn(qv, min(k, n))
             return pairs[:k]
