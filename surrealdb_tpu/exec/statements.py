@@ -2037,11 +2037,19 @@ def _explain_select(n: SelectStmt, ctx):
 def _explain_write(n, ctx):
     from surrealdb_tpu.idx.planner import explain_plan
 
+    # UPSERT defers record creation (Iterable::Defer); other writes on a
+    # direct record id iterate the record (dbs/iterator.rs)
+    defer = type(n).__name__ == "UpsertStmt"
     out = []
     for expr in n.what:
         v = _target_value(expr, ctx)
         if isinstance(v, Table):
             out.append(explain_plan(v.name, n.cond, ctx, n))
+        elif isinstance(v, RecordId) and not isinstance(v.id, Range):
+            out.append({
+                "detail": {"record": v},
+                "operation": "Iterate Defer" if defer else "Iterate Record",
+            })
         else:
             out.append({"detail": {"type": "Value"}, "operation": "Iterate Value"})
     out.append({"detail": {"type": "Memory"}, "operation": "Collector"})

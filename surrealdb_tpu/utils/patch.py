@@ -102,6 +102,11 @@ def apply_patch(doc, ops):
                     parent.append(copy_value(op.get("value")))
                 else:
                     parent.insert(int(last), copy_value(op.get("value")))
+            elif isinstance(parent, dict) and isinstance(
+                parent.get(last), list
+            ):
+                # add onto an array field appends (reference patch on arrays)
+                parent[last].append(copy_value(op.get("value")))
             else:
                 parent[last] = copy_value(op.get("value"))
         elif kind in ("replace", "change"):
