@@ -1029,6 +1029,10 @@ def shape_output(output: OutputClause, before, after, rid, ctx: Ctx):
 
     if isinstance(after, dict) and rid is not None:
         after = apply_computed_fields(rid.tb, after, rid, ctx)
+    if rid is not None and not ctx.session.is_owner and \
+            ctx.session.auth_level != "editor":
+        after = reduce_fields(rid.tb, after, ctx)
+        before = reduce_fields(rid.tb, before, ctx)
     if output is None or output.kind == "after":
         return copy_value(after) if after is not NONE else NONE
     k = output.kind
@@ -1324,9 +1328,48 @@ def relate_insert_one(into, doc, ignore, output, ctx: Ctx):
     return _store_record(rid, NONE, doc, ctx, "CREATE", output, edge=(l, r))
 
 
+def reduce_fields(tb, doc, ctx, action="select"):
+    """Permission-reduced view of a document for non-owner sessions
+    (reference Document::current_reduced): fields whose permission for
+    `action` denies the session disappear from the view."""
+    if not isinstance(doc, dict):
+        return doc
+    if ctx.session.is_owner or ctx.session.auth_level == "editor":
+        return doc
+    out = None
+    for fd in get_fields(tb, ctx):
+        perms = getattr(fd, "permissions", None)
+        if not perms:
+            continue
+        p = perms.get(action, True)
+        if p is True:
+            continue
+        allowed = False
+        if p not in (False, None):
+            c = ctx.with_doc(doc, None)
+            try:
+                allowed = is_truthy(evaluate(p, c))
+            except SdbError:
+                allowed = False
+        if not allowed:
+            name = fd.name_str.split(".")[0].split("[")[0]
+            if out is None:
+                out = copy_value(doc)
+            out.pop(name, None)
+    return out if out is not None else doc
+
+
 def update_one(rid: RecordId, before: dict, data, output, ctx: Ctx):
-    c = ctx.with_doc(before, rid)
-    after = apply_data(before, data, c, rid, this_doc=before)
+    perms = not ctx.session.is_owner and ctx.session.auth_level != "editor"
+    visible = reduce_fields(rid.tb, before, ctx) if perms else before
+    c = ctx.with_doc(visible, rid)
+    after = apply_data(visible, data, c, rid, this_doc=visible)
+    if perms and isinstance(before, dict) and isinstance(after, dict):
+        # fields hidden from this session persist untouched unless the
+        # data clause explicitly wrote them
+        for k, v in before.items():
+            if k not in visible and k not in after:
+                after[k] = copy_value(v)
     nid = after.get("id", NONE)
     if nid is not NONE and not _id_matches(nid, rid):
         raise SdbError(

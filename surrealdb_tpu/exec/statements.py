@@ -554,6 +554,10 @@ def _s_select(n: SelectStmt, ctx: Ctx):
         if perms and src.rid is not None:
             if not check_table_permission(src.rid.tb, "select", c, src.doc, src.rid):
                 continue
+            from surrealdb_tpu.exec.document import reduce_fields
+
+            if isinstance(src.doc, dict):
+                src.doc = reduce_fields(src.rid.tb, src.doc, c)
         rows.append(src)
     # brute-force KNN over multiple FROM sources: each table contributed its
     # own top-k; the KnnTopK aggregate is global, so trim the union back to
