@@ -1376,6 +1376,11 @@ def update_one(rid: RecordId, before: dict, data, output, ctx: Ctx):
             f"Found {render(nid)} for the `id` field, but a specific record has been specified"
         )
     after["id"] = rid
+    # edges keep their endpoints: in/out are immutable through data clauses
+    if isinstance(before, dict) and isinstance(before.get("in"), RecordId) \
+            and isinstance(before.get("out"), RecordId):
+        after["in"] = before["in"]
+        after["out"] = before["out"]
     return _store_record(rid, before, after, ctx, "UPDATE", output)
 
 
@@ -1432,7 +1437,10 @@ def relate_one(kind, fr: RecordId, to: RecordId, data, output, ctx: Ctx, uniq=Fa
         tb = kind
         rid = RecordId(tb, generate_record_key())
     else:
-        raise SdbError(f"Cannot use {render(kind)} as a RELATE target")
+        raise SdbError(
+            f"Cannot execute RELATE statement where property 'id' "
+            f"is: {render(kind)}"
+        )
     doc = apply_data({"id": rid}, data, ctx, rid, this_doc=NONE)
     nid = doc.get("id")
     if isinstance(nid, RecordId) and (nid.tb != rid.tb or not value_eq(nid.id, rid.id)):

@@ -2379,21 +2379,25 @@ def _s_relate(n: RelateStmt, ctx: Ctx):
     for f in froms:
         ctx.check_deadline()
         for t in tos:
-            fr = _as_rid(f)
-            to = _as_rid(t)
+            fr = _as_rid(f, "in")
+            to = _as_rid(t, "id")
             results.append(relate_one(kind_v, fr, to, n.data, n.output, ctx, n.uniq))
-    results = [r for r in results if r is not NONE]
     if n.output is not None and n.output.kind == "none":
         return []
+    if n.output is None:
+        results = [r for r in results if r is not NONE]
     return _only_wrap(results, n.only)
 
 
-def _as_rid(v):
+def _as_rid(v, prop="in"):
     if isinstance(v, RecordId):
         return v
     if isinstance(v, dict) and isinstance(v.get("id"), RecordId):
         return v["id"]
-    raise SdbError(f"Cannot use {render(v)} as a record id in RELATE")
+    raise SdbError(
+        f"Cannot execute RELATE statement where property '{prop}' "
+        f"is: {render(v)}"
+    )
 
 
 # ---------------------------------------------------------------------------
