@@ -1,0 +1,38 @@
+"""Environment-variable configuration statics (reference: core/src/cnf/
+mod.rs `lazy_env_parse!` knobs — the same SURREAL_* names where the knob
+exists in this build)."""
+
+from __future__ import annotations
+
+import os
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# expression/statement nesting depth (ctx chain)
+MAX_COMPUTATION_DEPTH = env_int("SURREAL_MAX_COMPUTATION_DEPTH", 32)
+# .{..} idiom recursion hard limit
+IDIOM_RECURSION_LIMIT = env_int("SURREAL_IDIOM_RECURSION_LIMIT", 256)
+# embedded-script op budget
+SCRIPTING_MAX_OPS = env_int("SURREAL_SCRIPTING_MAX_OPS", 2_000_000)
+# write-side batching of the vector-index op log before a full repack
+INDEXING_BATCH_SIZE = env_int("SURREAL_INDEXING_BATCH_SIZE", 250)
+# device KNN thresholds
+KNN_DEVICE_MIN_ROWS = env_int("SURREAL_KNN_DEVICE_MIN_ROWS", 2048)
+KNN_BLOCK_ROWS = env_int("SURREAL_KNN_BLOCK_ROWS", 262144)
+# slow-query log threshold (ms); 0 disables
+SLOW_QUERY_THRESHOLD_MS = env_float("SURREAL_SLOW_QUERY_THRESHOLD_MS", 0.0)
+# file-engine WAL batches between snapshot compactions
+WAL_COMPACT_BATCHES = env_int("SURREAL_WAL_COMPACT_BATCHES", 4096)

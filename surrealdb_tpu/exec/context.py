@@ -68,7 +68,9 @@ class Ctx:
         c._cond_consumed = False
         c._cf_seq = 0
         c._brute_knn_k = self._brute_knn_k
-        if c.depth > 32:
+        from surrealdb_tpu import cnf
+
+        if c.depth > cnf.MAX_COMPUTATION_DEPTH:
             raise SdbError("Max computation depth exceeded")
         return c
 

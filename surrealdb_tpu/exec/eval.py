@@ -208,6 +208,9 @@ def evaluate(node, ctx: Ctx):
 def _e_script(n, ctx):
     from surrealdb_tpu.fnc.script import run_script
 
+    caps = getattr(ctx.ds, "capabilities", None)
+    if caps is not None and not caps.scripting:
+        raise SdbError("Scripting functions are not allowed")
     args = [evaluate(a, ctx) for a in n.args]
     return run_script(n.source, args, ctx)
 

@@ -140,6 +140,9 @@ def call_function(node, ctx):
     fn = FUNCS.get(name)
     if fn is None:
         raise SdbError(f"The function '{node.name}' does not exist")
+    caps = getattr(ctx.ds, "capabilities", None)
+    if caps is not None and not caps.allows_function(name):
+        raise SdbError(f"Function '{name}' is not allowed to be executed")
     args = [evaluate(a, ctx) for a in node.args]
     return invoke(name, fn, args, ctx)
 

@@ -12,7 +12,7 @@ from decimal import Decimal
 
 from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.fnc import _arr, _num, _str, register
-from surrealdb_tpu.val import NONE, Geometry, RecordId, render
+from surrealdb_tpu.val import NONE, Geometry, RecordId, render, to_json
 
 
 # -- crypto -------------------------------------------------------------------
@@ -1059,12 +1059,63 @@ def _search_linear(args, ctx):
     return res
 
 
-def _http_denied(args, ctx):
-    raise SdbError("Access to network target denied")
+def _http_call(method):
+    def call(args, ctx):
+        from urllib.parse import urlparse
+
+        url = _str(args[0], f"http::{method}", 1)
+        parsed = urlparse(url)
+        host = parsed.hostname or ""
+        target = f"{host}:{parsed.port}" if parsed.port else host
+        caps = getattr(ctx.ds, "capabilities", None)
+        # network access is deny-by-default (reference capability gate)
+        if caps is None or not caps.allows_net(target):
+            raise SdbError(
+                f"Access to network target '{target}' is not allowed"
+            )
+        import json as _json
+        import urllib.request
+
+        body = args[1] if len(args) > 1 else None
+        headers = args[2] if len(args) > 2 else {}
+        data = None
+        req_headers = dict(headers) if isinstance(headers, dict) else {}
+        if body is not None and body is not NONE and method in (
+            "put", "post", "patch"
+        ):
+            if isinstance(body, (dict, list)):
+                data = _json.dumps(to_json(body)).encode()
+                req_headers.setdefault("Content-Type", "application/json")
+            elif isinstance(body, bytes):
+                data = body
+            else:
+                data = str(body).encode()
+        req = urllib.request.Request(
+            url, method=method.upper(), data=data, headers=req_headers
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                raw = resp.read()
+                if method == "head":
+                    return NONE
+                ctype = resp.headers.get("Content-Type", "")
+                if "json" in ctype:
+                    try:
+                        return _json.loads(raw)
+                    except ValueError:
+                        pass
+                try:
+                    return raw.decode()
+                except UnicodeDecodeError:
+                    return raw
+        except Exception as e:
+            raise SdbError(f"There was an error processing a remote HTTP request: {e}")
+
+    return call
 
 
 for _m in ("head", "get", "put", "post", "patch", "delete"):
-    register(f"http::{_m}")(_http_denied)
+    register(f"http::{_m}")(_http_call(_m))
 
 
 @register("api::invoke")

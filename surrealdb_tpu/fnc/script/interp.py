@@ -652,7 +652,10 @@ class JSErrorObj:
 # ---------------------------------------------------------------------------
 
 
-_MAX_OPS = 2_000_000
+def _max_ops():
+    from surrealdb_tpu import cnf
+
+    return cnf.SCRIPTING_MAX_OPS
 
 
 class Interpreter:
@@ -750,7 +753,7 @@ class Interpreter:
     # -- statements ----------------------------------------------------------
     def exec_stmt(self, node, env):
         self.ops += 1
-        if self.ops > _MAX_OPS:
+        if self.ops > _max_ops():
             raise JSError("Max script execution time exceeded")
         tag = node[0]
         if tag == "block":
@@ -838,7 +841,7 @@ class Interpreter:
     # -- expressions ---------------------------------------------------------
     def eval(self, node, env):
         self.ops += 1
-        if self.ops > _MAX_OPS:
+        if self.ops > _max_ops():
             raise JSError("Max script execution time exceeded")
         tag = node[0]
         if tag == "lit":

@@ -23,10 +23,12 @@ from surrealdb_tpu import key as K
 from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.val import NONE, RecordId, is_truthy
 
+from surrealdb_tpu import cnf
+
 # device-search threshold: below this, numpy on host beats dispatch overhead
-DEVICE_MIN_ROWS = 2048
+DEVICE_MIN_ROWS = cnf.KNN_DEVICE_MIN_ROWS
 # blockwise scan threshold (rows) to bound [B, N] materialization
-BLOCK_ROWS = 262144
+BLOCK_ROWS = cnf.KNN_BLOCK_ROWS
 
 
 def _vec_dtype(params) -> type:

@@ -73,9 +73,13 @@ class Notification:
 
 
 class Datastore:
-    def __init__(self, path: str = "memory", strict: bool = False):
+    def __init__(self, path: str = "memory", strict: bool = False,
+                 capabilities=None):
+        from surrealdb_tpu.capabilities import Capabilities
+
         self.path = path
         self.strict = strict
+        self.capabilities = capabilities or Capabilities.from_env()
         if path in ("memory", "mem://", "mem"):
             # the C++ memtable engine when the toolchain built it, else the
             # pure-Python sorted map (same Transactable semantics)

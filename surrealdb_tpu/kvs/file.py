@@ -17,10 +17,12 @@ import pickle
 from surrealdb_tpu.kvs.api import Backend
 from surrealdb_tpu.kvs.mem import MemTx, VersionedStore
 
+from surrealdb_tpu import cnf
+
 # Rewrite the snapshot + truncate the WAL after this many committed batches
 # so crash recovery never replays an unbounded log (reference role: LSM
 # compaction in rocksdb/surrealkv).
-WAL_COMPACT_BATCHES = int(os.environ.get("SURREAL_WAL_COMPACT_BATCHES", 4096))
+WAL_COMPACT_BATCHES = cnf.WAL_COMPACT_BATCHES
 
 
 class FileBackend(Backend):

@@ -30,6 +30,9 @@ class RpcSession:
 
     # -- dispatch -----------------------------------------------------------
     def handle(self, method: str, params: list) -> Any:
+        caps = getattr(self.ds, "capabilities", None)
+        if caps is not None and not caps.allows_rpc(method):
+            raise RpcError(-32000, f"Method not allowed: {method}")
         m = getattr(self, f"rpc_{method.replace('::', '_')}", None)
         if m is None:
             raise RpcError(-32601, f"Method not found: {method}")

@@ -1,0 +1,109 @@
+"""Capability allow/deny matrices + SURREAL_* config knobs (reference
+dbs/capabilities.rs + cnf/mod.rs; VERDICT round-2 item 10)."""
+
+import pytest
+
+from surrealdb_tpu import Datastore
+from surrealdb_tpu.capabilities import Capabilities, Targets
+
+
+def test_function_deny_family():
+    caps = Capabilities(deny_funcs=Targets.parse("crypto"))
+    ds = Datastore("memory", capabilities=caps)
+    out = ds.execute("RETURN crypto::sha256('x')", ns="t", db="t")[0]
+    assert out.error == "Function 'crypto::sha256' is not allowed to be executed"
+    # other functions still run
+    assert ds.query_one("RETURN math::abs(-1)", ns="t", db="t") == 1
+
+
+def test_function_allowlist():
+    caps = Capabilities(allow_funcs=Targets.parse("math,string"))
+    ds = Datastore("memory", capabilities=caps)
+    assert ds.query_one("RETURN math::abs(-2)", ns="t", db="t") == 2
+    out = ds.execute("RETURN time::now()", ns="t", db="t")[0]
+    assert "not allowed" in (out.error or "")
+
+
+def test_http_denied_by_default():
+    ds = Datastore("memory")
+    out = ds.execute("RETURN http::get('http://127.0.0.1:1/x')", ns="t", db="t")[0]
+    assert out.error == "Access to network target '127.0.0.1:1' is not allowed"
+
+
+def test_http_allowable_by_config():
+    """http:: becomes allowable (deny-by-default preserved elsewhere)."""
+    import http.server
+    import threading
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b'{"ok": true}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        caps = Capabilities(allow_net=Targets.parse("127.0.0.1"))
+        ds = Datastore("memory", capabilities=caps)
+        out = ds.query_one(
+            f"RETURN http::get('http://127.0.0.1:{port}/x')", ns="t", db="t"
+        )
+        assert out == {"ok": True}
+        # a non-allowed host still denies
+        out2 = ds.execute("RETURN http::get('http://10.0.0.1/x')", ns="t", db="t")[0]
+        assert "is not allowed" in out2.error
+    finally:
+        srv.shutdown()
+
+
+def test_scripting_deniable():
+    caps = Capabilities(scripting=False)
+    ds = Datastore("memory", capabilities=caps)
+    out = ds.execute("RETURN function() { return 1; }", ns="t", db="t")[0]
+    assert out.error == "Scripting functions are not allowed"
+
+
+def test_rpc_method_deny():
+    from surrealdb_tpu.rpc import RpcError, RpcSession
+
+    caps = Capabilities(deny_rpc=Targets.parse("query"))
+    ds = Datastore("memory", capabilities=caps)
+    rs = RpcSession(ds, anon_level="owner")
+    with pytest.raises(RpcError, match="not allowed"):
+        rs.handle("query", ["RETURN 1"])
+    assert rs.handle("ping", []) is not None
+
+
+def test_caps_from_env():
+    caps = Capabilities.from_env({
+        "SURREAL_CAPS_DENY_FUNC": "http",
+        "SURREAL_CAPS_ALLOW_NET": "example.com",
+        "SURREAL_CAPS_ALLOW_SCRIPT": "false",
+    })
+    assert not caps.allows_function("http::get")
+    assert caps.allows_function("math::abs")
+    assert caps.allows_net("example.com:443")
+    assert not caps.allows_net("other.com")
+    assert not caps.scripting
+
+
+def test_cnf_env_knobs(monkeypatch):
+    import importlib
+
+    monkeypatch.setenv("SURREAL_MAX_COMPUTATION_DEPTH", "7")
+    import surrealdb_tpu.cnf as cnf
+
+    importlib.reload(cnf)
+    assert cnf.MAX_COMPUTATION_DEPTH == 7
+    monkeypatch.delenv("SURREAL_MAX_COMPUTATION_DEPTH")
+    importlib.reload(cnf)
+    assert cnf.MAX_COMPUTATION_DEPTH == 32
