@@ -25,9 +25,11 @@ import time
 import numpy as np
 
 
-def _bulk_vectors(ds, ns, db, tb, ix_name, xs, dim, metric="euclidean"):
+def _bulk_vectors(ds, ns, db, tb, ix_name, xs, dim, metric="euclidean",
+                  inline_emb=False):
     """Fast ingest: records + vector-index state through the KV layer (the
-    SQL INSERT path is not the thing under test here)."""
+    SQL INSERT path is not the thing under test here). `inline_emb` also
+    stores the vector in the document (needed only by the brute scan)."""
     from surrealdb_tpu import key as K
     from surrealdb_tpu.kvs.api import serialize
     from surrealdb_tpu.val import RecordId
@@ -38,8 +40,10 @@ def _bulk_vectors(ds, ns, db, tb, ix_name, xs, dim, metric="euclidean"):
         ver = 0
         for i in range(n):
             rid = RecordId(tb, i)
-            txn.set(K.record(ns, db, tb, i),
-                    serialize({"id": rid, "emb": xs[i].tolist()}))
+            doc = {"id": rid}
+            if inline_emb:
+                doc["emb"] = xs[i].tolist()
+            txn.set(K.record(ns, db, tb, i), serialize(doc))
             txn.set_val(
                 K.ix_state(ns, db, tb, ix_name, b"he", K.enc_value(i)),
                 xs[i].tobytes(),
@@ -196,7 +200,7 @@ def bench_brute(quick=False):
     rng = np.random.default_rng(17)
     xs = rng.normal(size=(n, dim)).astype(np.float32)
     ds.query("DEFINE TABLE tbl", ns="b", db="b")
-    _bulk_vectors(ds, "b", "b", "tbl", "__noix", xs, dim)
+    _bulk_vectors(ds, "b", "b", "tbl", "__noix", xs, dim, inline_emb=True)
     q = rng.normal(size=(dim,)).astype(np.float32)
     sql = ("SELECT id, vector::similarity::cosine(emb, $q) AS s FROM tbl "
            "ORDER BY s DESC LIMIT 10")

@@ -82,11 +82,30 @@ class Backend:
 # ---------------------------------------------------------------------------
 
 
+# Storage encoding: versioned, self-describing. Header byte 0x01 = the
+# CBOR value encoding (wire.py — portable, the format SDKs speak); header
+# 0x00 = pickle for internal structs that aren't plain values (catalog
+# definitions carry ASTs). Legacy headerless pickle (0x80...) still reads.
+
+
 def serialize(v) -> bytes:
-    return pickle.dumps(v, protocol=5)
+    from surrealdb_tpu.err import SdbError
+
+    try:
+        from surrealdb_tpu import wire
+
+        return b"\x01" + wire.encode(v)
+    except (SdbError, ValueError, KeyError, TypeError):
+        return b"\x00" + pickle.dumps(v, protocol=5)
 
 
 def deserialize(b: bytes):
+    if b[:1] == b"\x01":
+        from surrealdb_tpu import wire
+
+        return wire.decode(b[1:])
+    if b[:1] == b"\x00":
+        return pickle.loads(b[1:])
     return pickle.loads(b)
 
 

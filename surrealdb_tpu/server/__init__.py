@@ -158,19 +158,47 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 self._json(401, {"code": 401, "details": str(e)})
             return
         if path == "/rpc":
-            # HTTP one-shot RPC
+            # HTTP one-shot RPC with format negotiation (json | cbor)
+            ctype = (self.headers.get("Content-Type") or "").lower()
+            accept = (self.headers.get("Accept") or ctype).lower()
+            cbor_in = "cbor" in ctype
+            cbor_out = "cbor" in accept
+
+            def respond(payload):
+                if cbor_out:
+                    from surrealdb_tpu import wire
+
+                    body = wire.encode(payload)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/cbor")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(200, payload)
+
+            req = {}
             try:
-                req = json.loads(self._body() or b"{}")
+                raw = self._body() or b"{}"
+                if cbor_in:
+                    from surrealdb_tpu import wire
+
+                    req = wire.decode(raw)
+                else:
+                    req = json.loads(raw)
                 rs = RpcSession(self.ds, anon_level=self.anon_level)
                 rs.session = self._session()
                 out = rs.handle(req.get("method", ""), req.get("params") or [])
-                self._json(200, {"id": req.get("id"), "result": to_json(out)})
+                respond({
+                    "id": req.get("id"),
+                    "result": out if cbor_out else to_json(out),
+                })
             except RpcError as e:
-                self._json(200, {"id": req.get("id"),
-                                 "error": {"code": e.code, "message": str(e)}})
+                respond({"id": req.get("id"),
+                         "error": {"code": e.code, "message": str(e)}})
             except SdbError as e:
-                self._json(200, {"id": req.get("id"),
-                                 "error": {"code": -32000, "message": str(e)}})
+                respond({"id": req.get("id"),
+                         "error": {"code": -32000, "message": str(e)}})
             return
         if path.startswith("/key/"):
             self._key_route("POST")
