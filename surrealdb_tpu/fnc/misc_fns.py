@@ -1131,6 +1131,30 @@ def _api_invoke(args, ctx):
     opts = args[1] if len(args) > 1 and isinstance(args[1], dict) else {}
     ns, db = ctx.need_ns_db()
     d = ctx.txn.get_val(K2.api_def(ns, db, path))
+    path_params = {}
+    if not isinstance(d, ApiDef):
+        # segment matching: /user/:id style definitions (core/src/api path)
+        req = [seg for seg in path.split("/") if seg != ""]
+        for _k, cand in ctx.txn.scan_vals(
+            *K2.prefix_range(K2.api_prefix(ns, db))
+        ):
+            if not isinstance(cand, ApiDef):
+                continue
+            defsegs = [seg for seg in cand.path.split("/") if seg != ""]
+            if len(defsegs) != len(req):
+                continue
+            params = {}
+            ok = True
+            for dseg, rseg in zip(defsegs, req):
+                if dseg.startswith(":"):
+                    params[dseg[1:]] = rseg
+                elif dseg != rseg:
+                    ok = False
+                    break
+            if ok:
+                d = cand
+                path_params = params
+                break
     if not isinstance(d, ApiDef):
         raise SdbError(f"The api '{path}' does not exist")
     method = str(opts.get("method", "get")).lower()
@@ -1151,7 +1175,7 @@ def _api_invoke(args, ctx):
         "path": path,
         "body": opts.get("body", NONE),
         "headers": opts.get("headers", {}),
-        "params": opts.get("params", {}),
+        "params": {**path_params, **(opts.get("params") or {})},
         "query": opts.get("query", {}),
     }
     try:

@@ -93,9 +93,59 @@ class SurrealHandler(BaseHTTPRequestHandler):
             for r in res
         ]
 
+    def _api_route(self, method: str):
+        """Serve DEFINE API endpoints: /api/:ns/:db/<path> (reference
+        server ntw /api/* + core/src/api)."""
+        parsed = urlparse(self.path)
+        segs = [unquote(x) for x in parsed.path.split("/") if x != ""]
+        if len(segs) < 3:
+            self._json(404, {"error": "Not found"})
+            return
+        _, ns, db = segs[0], segs[1], segs[2]
+        apath = "/" + "/".join(segs[3:])
+        sess = self._session()
+        sess.ns, sess.db = ns, db
+        body = self._body()
+        data = None
+        if body:
+            try:
+                data = json.loads(body)
+            except ValueError:
+                data = body.decode(errors="replace")
+        query = {k: (v[0] if len(v) == 1 else v)
+                 for k, v in parse_qs(parsed.query).items()}
+        opts = {
+            "method": method.lower(),
+            "body": data,
+            "headers": {k.lower(): v for k, v in self.headers.items()},
+            "query": query,
+        }
+        res = self.ds.execute(
+            "RETURN api::invoke($p, $o)", session=sess,
+            vars={"p": apath, "o": opts},
+        )[0]
+        if res.error is not None:
+            self._json(404, {"error": res.error})
+            return
+        out = res.result if isinstance(res.result, dict) else {}
+        status = int(out.get("status", 200))
+        hdrs = out.get("headers") or {}
+        body_v = out.get("body")
+        payload = json.dumps(to_json(body_v)).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        for k, v in hdrs.items():
+            self.send_header(str(k), str(v))
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
     # -- routes -------------------------------------------------------------
     def do_GET(self):
         path = urlparse(self.path).path
+        if path.startswith("/api/"):
+            self._api_route("GET")
+            return
         if path in ("/status", "/health"):
             self._text(200, "")
             return
@@ -124,6 +174,9 @@ class SurrealHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path = urlparse(self.path).path
+        if path.startswith("/api/"):
+            self._api_route("POST")
+            return
         if path == "/sql":
             sess = self._session()
             sql = self._body().decode()
@@ -220,6 +273,9 @@ class SurrealHandler(BaseHTTPRequestHandler):
         self._json(404, {"error": "Not found"})
 
     def do_PUT(self):
+        if urlparse(self.path).path.startswith("/api/"):
+            self._api_route("PUT")
+            return
         if urlparse(self.path).path.startswith("/key/"):
             self._key_route("PUT")
             return

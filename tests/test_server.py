@@ -281,3 +281,17 @@ def test_key_route_injection_blocked(server):
     assert s == 200
     s, b = _req(base + "/key/safekey", "GET", None, hdrs)
     assert json.loads(b)[0]["result"][0]["v"] == 1
+
+
+def test_define_api_served(server):
+    """DEFINE API endpoints are served at /api/:ns/:db/<path>."""
+    _ds, base, _port = server
+    hdrs = {"surreal-ns": "t", "surreal-db": "t"}
+    _req(base + "/sql", "POST",
+         b'DEFINE API "/hello" FOR get THEN { RETURN { status: 200, body: { msg: "hi" } } };'
+         b'DEFINE API "/item/:id" FOR get THEN { RETURN { body: $request.params.id } };',
+         hdrs)
+    s, b = _req(base + "/api/t/t/hello", "GET", None, hdrs)
+    assert s == 200 and json.loads(b)["msg"] == "hi"
+    s, b = _req(base + "/api/t/t/item/42", "GET", None, hdrs)
+    assert s == 200 and json.loads(b) == "42"
