@@ -770,18 +770,27 @@ def apply_ref_on_delete(rid: RecordId, ctx: Ctx):
 
 
 def build_index(idef, ctx: Ctx):
-    """Index an existing table's records (DEFINE INDEX on populated table)."""
+    """Index an existing table's records (DEFINE INDEX on populated table).
+    Returns the number of records indexed and records the builder status
+    (reference kvs/index.rs IndexBuilder / BuildingStatus)."""
     ns, db = ctx.need_ns_db()
+    key = (ns, db, idef.tb, idef.name)
+    ctx.ds.index_builds[key] = {
+        "status": "indexing", "initial": 0, "pending": 0, "updated": 0,
+    }
+    count = 0
     beg, end = K.prefix_range(K.record_prefix(ns, db, idef.tb))
     for k, raw in list(ctx.txn.scan(beg, end)):
+        count += 1
         _ns, _db, _tb, idv = K.decode_record_id(k)
         rid = RecordId(idef.tb, idv)
         doc = deserialize(raw)
-        one = type(
-            "IDef", (), {}
-        )  # reuse index_update for a single index by temporary filtering
         # inline: perform same logic for just this idef
         _single_index_add(idef, rid, doc, ctx)
+    ctx.ds.index_builds[key] = {
+        "status": "ready", "initial": count, "pending": 0, "updated": 0,
+    }
+    return count
 
 
 def _single_index_add(idef, rid, doc, ctx):

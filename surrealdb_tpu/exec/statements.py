@@ -2893,12 +2893,50 @@ def _s_define_index(n: DefineIndex, ctx):
         comment=n.comment,
     )
     ctx.txn.set_val(kdef, idef)
-    # build over existing records (reference kvs/index.rs builds async;
-    # we build inline — same observable result)
     from surrealdb_tpu.exec.document import build_index
 
+    if getattr(n, "concurrently", False):
+        # background build (reference kvs/index.rs IndexBuilder): status
+        # moves started -> indexing -> ready, visible via INFO FOR INDEX
+        _spawn_index_build(ctx.ds, ns, db, idef)
+        return NONE
     build_index(idef, ctx)
     return NONE
+
+
+def _spawn_index_build(ds, ns, db, idef):
+    import threading
+
+    from surrealdb_tpu.exec.context import Ctx as _Ctx
+    from surrealdb_tpu.kvs.ds import Session as _Session
+
+    key = (ns, db, idef.tb, idef.name)
+    ds.index_builds[key] = {
+        "status": "started", "initial": 0, "pending": 0, "updated": 0,
+    }
+
+    def run():
+        from surrealdb_tpu.exec.document import build_index
+
+        for _attempt in range(5):
+            txn = ds.transaction(write=True)
+            c = _Ctx(ds, _Session(ns=ns, db=db, auth_level="owner"), txn)
+            try:
+                build_index(idef, c)
+                txn.commit()
+                return
+            except SdbError as e:
+                txn.cancel()
+                if "conflict" not in str(e):
+                    ds.index_builds[key] = {
+                        "status": "error", "error": str(e),
+                    }
+                    return
+        ds.index_builds[key] = {
+            "status": "error", "error": "too many conflicts",
+        }
+
+    threading.Thread(target=run, daemon=True).start()
 
 
 def _remove_index_data(ns, db, tb, ix, ctx):
@@ -3736,7 +3774,11 @@ def _s_info(n: InfoStmt, ctx: Ctx):
         idef = ctx.txn.get_val(K.ix_def(ns, db, n.target2, n.target))
         if idef is None:
             raise SdbError(f"The index '{n.target}' does not exist")
-        return {"building": {"status": "built"}}
+        st = ctx.ds.index_builds.get((ns, db, n.target2, n.target))
+        if st is None:
+            st = {"status": "ready", "initial": 0, "pending": 0,
+                  "updated": 0}
+        return {"building": dict(st)}
     if n.level == "user":
         base = "root"
         key = None

@@ -106,6 +106,7 @@ class Datastore:
         # cross-transaction caches / engines
         self.lock = threading.RLock()
         self.vector_indexes: dict = {}  # (ns,db,tb,ix) -> TpuVectorIndex
+        self.index_builds: dict = {}  # (ns,db,tb,ix) -> building status
         self.ft_indexes: dict = {}  # (ns,db,tb,ix) -> FullTextIndex
         self.live_queries: dict = {}  # uuid-str -> LiveQuery
         self.notifications: list[Notification] = []  # in-proc delivery queue
