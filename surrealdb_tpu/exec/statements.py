@@ -213,8 +213,8 @@ def _s_use(n: UseStmt, ctx):
         ctx.session.db = n.db
         ctx.db = n.db
     return {
-        "database": ctx.session.db,
-        "namespace": ctx.session.ns,
+        "database": ctx.session.db if ctx.session.db is not None else NONE,
+        "namespace": ctx.session.ns if ctx.session.ns is not None else NONE,
     }
 
 
@@ -3005,6 +3005,10 @@ def _s_define_user(n: DefineUser, ctx):
     from surrealdb_tpu.fnc.misc_fns import password_hash
 
     base = n.base
+    if base in ("ns", "db") and not ctx.session.ns:
+        raise SdbError("Specify a namespace to use")
+    if base == "db" and not ctx.session.db:
+        raise SdbError("Specify a database to use")
     ns = ctx.session.ns if base in ("ns", "db") else None
     db = ctx.session.db if base == "db" else None
     kdef = K.us_def(base, ns, db, n.name)
@@ -3515,6 +3519,22 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
             f"The {labels.get(kind, kind)} '{disp}' does not exist"
         )
     d = stored[0] if kind == "sequence" else stored
+    if kind == "sequence":
+        from surrealdb_tpu.val import Duration as _Dur
+
+        for i2, (clause, value) in enumerate(list(n.changes)):
+            if clause == "timeout" and value != "__drop__" and not isinstance(
+                value, _Dur
+            ):
+                v2 = evaluate(value, ctx)
+                if v2 is NONE or v2 is None:
+                    n.changes[i2] = (clause, "__drop__")
+                    continue
+                if not isinstance(v2, _Dur):
+                    raise SdbError(
+                        f"Expected a duration but found {render(v2)}"
+                    )
+                n.changes[i2] = (clause, v2)
     for clause, value in n.changes:
         if value == "__drop__":
             if clause == "comment":
@@ -3537,6 +3557,8 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
                 setattr(d, clause, [])
             elif clause == "duration":
                 d.duration = None
+            elif clause == "timeout":
+                d.timeout = None
             elif clause == "reference":
                 d.reference = None
             continue
@@ -3780,9 +3802,14 @@ def _s_info(n: InfoStmt, ctx: Ctx):
                   "updated": 0}
         return {"building": dict(st)}
     if n.level == "user":
-        base = "root"
+        explicit = None
+        if n.target2:
+            t2 = n.target2.lower()
+            explicit = {"db": "db", "database": "db", "ns": "ns",
+                        "namespace": "ns", "root": "root"}.get(t2)
+        bases = (explicit,) if explicit else ("db", "ns", "root")
         key = None
-        for b in ("db", "ns", "root"):
+        for b in bases:
             key_try = K.us_def(
                 b,
                 ctx.session.ns if b in ("ns", "db") else None,
@@ -3793,6 +3820,11 @@ def _s_info(n: InfoStmt, ctx: Ctx):
                 key = key_try
                 break
         if key is None:
+            if explicit:
+                raise SdbError(
+                    f"The user '{n.target}' does not exist "
+                    f"{_base_phrase(explicit, ctx)}"
+                )
             raise SdbError(f"The root user '{n.target}' does not exist")
         from surrealdb_tpu.exec.render_def import render_user
 
