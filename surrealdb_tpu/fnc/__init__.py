@@ -181,6 +181,25 @@ def call_custom(name, args, ctx):
     fd = ctx.txn.get_val(K.fc_def(ns, db, name))
     if not isinstance(fd, FunctionDef):
         raise SdbError(f"The function 'fn::{name}' does not exist")
+    # arity: trailing option<> params are optional (reference fnc custom)
+    total = len(fd.args)
+    required = total
+    for _pname, pkind in reversed(fd.args):
+        if pkind is not None and getattr(pkind, "name", None) == "option":
+            required -= 1
+        else:
+            break
+    if len(args) > total or len(args) < required:
+        if required == total:
+            expects = (
+                f"{total} argument" if total == 1 else f"{total} arguments"
+            )
+        else:
+            expects = f"{required} to {total} arguments"
+        raise SdbError(
+            f"Incorrect arguments for function fn::{name}(). "
+            f"The function expects {expects}."
+        )
     c = ctx.child()
     for i, (pname, pkind) in enumerate(fd.args):
         v = args[i] if i < len(args) else NONE

@@ -147,13 +147,30 @@ def get_analyzer(name, ctx) -> AnalyzerDef:
     return az
 
 
-def analyze(az: AnalyzerDef, text: str):
+def analyze(az: AnalyzerDef, text: str, ctx=None):
+    # FUNCTION analyzers preprocess the text through a custom function
+    # that must return a string (reference ft/analyzer mapper)
+    if getattr(az, "function", None) and ctx is not None:
+        from surrealdb_tpu.fnc import call_custom
+
+        name = az.function
+        if name.startswith("fn::"):
+            name = name[4:]
+        out = call_custom(name, [text], ctx)
+        if not isinstance(out, str):
+            from surrealdb_tpu.err import SdbError
+
+            raise SdbError(
+                f"There was a problem running the {name}() function. "
+                f"The function should return a string."
+            )
+        text = out
     return _apply_filters(_tokenize(text, az.tokenizers), az.filters)
 
 
 def analyze_text(az_name, text, ctx):
     az = get_analyzer(az_name, ctx)
-    return [tok[0] for tok in analyze(az, text)]
+    return [tok[0] for tok in analyze(az, text, ctx)]
 
 
 # ---------------------------------------------------------------------------
