@@ -367,6 +367,18 @@ def apply_fields(
             c.vars["value"] = cur
             c.vars["before"] = old
             c.vars["after"] = cur
+            # explicit input coerces to the declared type BEFORE the VALUE
+            # clause runs (reference doc/field.rs order: default_value.surql)
+            if cur is not NONE and fd.kind is not None:
+                try:
+                    cur = coerce(cur, fd.kind)
+                except SdbError as e:
+                    raise SdbError(
+                        f"Couldn't coerce value for field `{fd.name_str}` "
+                        f"of `{rid.render() if rid else '?'}`: {e}"
+                    )
+                c.vars["value"] = cur
+                c.vars["after"] = cur
             # DEFAULT
             if cur is NONE and fd.default is not None and (
                 is_create or fd.default_always

@@ -2551,6 +2551,16 @@ def _materialize_view(tdef: TableDef, ctx):
 
 
 def _s_define_field(n: DefineField, ctx):
+    if getattr(n, "flex", False):
+        ns0 = ctx.session.ns
+        db0 = ctx.session.db
+        if ns0 and db0:
+            td0 = ctx.txn.get_val(K.tb_def(ns0, db0, n.tb))
+            if td0 is not None and not td0.full:
+                raise SdbError(
+                    "An error occurred: FLEXIBLE can only be used in "
+                    "SCHEMAFULL tables"
+                )
     _ensure_ns_db(ctx)
     ns, db = ctx.need_ns_db()
     if ctx.txn.get(K.tb_def(ns, db, n.tb)) is None:
