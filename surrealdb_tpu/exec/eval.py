@@ -380,13 +380,22 @@ def _e_constant(n, ctx):
     name = n.name
     table = {
         "math::pi": m.pi, "math::e": m.e, "math::tau": m.tau,
-        "math::inf": m.inf, "math::neg_inf": -m.inf, "math::nan": m.nan,
-        "math::frac_1_pi": 1 / m.pi, "math::frac_1_sqrt_2": 1 / m.sqrt(2),
-        "math::frac_2_pi": 2 / m.pi, "math::frac_2_sqrt_pi": 2 / m.sqrt(m.pi),
-        "math::frac_pi_2": m.pi / 2, "math::frac_pi_3": m.pi / 3,
-        "math::frac_pi_4": m.pi / 4, "math::frac_pi_6": m.pi / 6,
-        "math::frac_pi_8": m.pi / 8, "math::ln_10": m.log(10),
-        "math::ln_2": m.log(2), "math::log10_2": m.log10(2),
+        "math::inf": m.inf, "math::infinity": m.inf,
+        "math::neg_inf": -m.inf, "math::neg_infinity": -m.inf,
+        "math::nan": m.nan,
+        # Rust std::f64::consts values (bit-exact, not recomputed)
+        "math::frac_1_pi": 0.3183098861837907,
+        "math::frac_1_sqrt_2": 0.7071067811865476,
+        "math::frac_2_pi": 0.6366197723675814,
+        "math::frac_2_sqrt_pi": 1.1283791670955126,
+        "math::frac_pi_2": 1.5707963267948966,
+        "math::frac_pi_3": 1.0471975511965979,
+        "math::frac_pi_4": 0.7853981633974483,
+        "math::frac_pi_6": 0.5235987755982989,
+        "math::frac_pi_8": 0.39269908169872414,
+        "math::ln_10": 2.302585092994046,
+        "math::ln_2": 0.6931471805599453,
+        "math::log10_2": 0.3010299956639812,
         "math::log10_e": m.log10(m.e), "math::log2_10": m.log2(10),
         "math::log2_e": m.log2(m.e), "math::sqrt_2": m.sqrt(2),
     }

@@ -106,7 +106,7 @@ def add(a, b):
         out = dict(a)
         out.update(b)
         return out
-    raise SdbError(f"Cannot perform addition with '{render(a)}' and '{render(b)}'")
+    raise SdbError(f"Cannot perform addition with '{_disp(a)}' and '{_disp(b)}'")
 
 
 def sub(a, b):
@@ -140,14 +140,14 @@ def sub(a, b):
         return SSet(
             [x for x in a.items if not any(value_eq(x, y) for y in rem)]
         )
-    raise SdbError(f"Cannot perform subtraction with '{render(a)}' and '{render(b)}'")
+    raise SdbError(f"Cannot perform subtraction with '{_disp(a)}' and '{_disp(b)}'")
 
 
 def mul(a, b):
     if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
         a, b = _num2(a, b)
         return a * b
-    raise SdbError(f"Cannot perform multiplication with '{render(a)}' and '{render(b)}'")
+    raise SdbError(f"Cannot perform multiplication with '{_disp(a)}' and '{_disp(b)}'")
 
 
 def div(a, b):
@@ -167,9 +167,9 @@ def div(a, b):
             if isinstance(a, int) and isinstance(b, int):
                 if b == 0:
                     return float("nan")  # reference: try_div.unwrap_or(NaN)
-                if a % b == 0:
-                    return a // b
-                return a / b
+                # reference try_div(Int, Int) = checked_div: truncating
+                q = abs(a) // abs(b)
+                return q if (a >= 0) == (b >= 0) else -q
             if isinstance(a, Decimal):
                 if b == 0:
                     return float("nan")
@@ -181,7 +181,26 @@ def div(a, b):
             return a / b
         except (ZeroDivisionError, ArithmeticError):
             return NONE
-    raise SdbError(f"Cannot perform division with '{render(a)}' and '{render(b)}'")
+    raise SdbError(f"Cannot perform division with '{_disp(a)}' and '{_disp(b)}'")
+
+
+def float_div(a, b):
+    """reference try_float_div: Int/Int stays Int when exact, else Float
+    (used by math::mean and aggregate means, NOT the `/` operator)."""
+    if isinstance(a, int) and not isinstance(a, bool) and \
+            isinstance(b, int) and not isinstance(b, bool):
+        if b == 0:
+            return float("nan")
+        if a % b == 0:
+            return a // b
+        return a / b
+    return div(a, b)
+
+
+def _disp(v):
+    """Operands in arithmetic error texts display raw strings without
+    quotes (reference Value Display, not ToSql)."""
+    return v if isinstance(v, str) else render(v)
 
 
 def rem(a, b):
@@ -190,7 +209,7 @@ def rem(a, b):
         try:
             if b == 0:
                 raise SdbError(
-                    f"Cannot perform remainder with '{render(a)}' and '{render(b)}'"
+                    f"Cannot perform remainder with '{_disp(a)}' and '{_disp(b)}'"
                 )
             if isinstance(a, int) and isinstance(b, int):
                 # exact truncated remainder (Rust %): sign of the dividend
@@ -199,7 +218,7 @@ def rem(a, b):
             return math.fmod(a, b)
         except (ZeroDivisionError, ArithmeticError):
             return NONE
-    raise SdbError(f"Cannot perform remainder with '{render(a)}' and '{render(b)}'")
+    raise SdbError(f"Cannot perform remainder with '{_disp(a)}' and '{_disp(b)}'")
 
 
 def pow_(a, b):
@@ -228,7 +247,7 @@ def pow_(a, b):
             return r
         except (OverflowError, ArithmeticError):
             return float("inf")
-    raise SdbError(f"Cannot perform power with '{render(a)}' and '{render(b)}'")
+    raise SdbError(f"Cannot perform power with '{_disp(a)}' and '{_disp(b)}'")
 
 
 def neg(a):

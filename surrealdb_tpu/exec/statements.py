@@ -1055,13 +1055,15 @@ def _eval_aggregate(expr, members, ctx):
         for a in expr.args[1:]:
             extra.append(evaluate(a, ctx))
         if fname == "array::group":
+            # the grouped aggregate collects + flattens WITHOUT dedup
+            # (reference Accumulate; array::distinct dedups explicitly)
             flat = []
             for v in vals:
                 if isinstance(v, list):
                     flat.extend(v)
                 else:
                     flat.append(v)
-            return FUNCS["array::distinct"]([flat], ctx)
+            return flat
         if fname in ("array::concat", "array::flatten"):
             flat = []
             for v in vals:

@@ -369,7 +369,7 @@ def stats_count(stats):
 
 def field_document(group_vals, stats) -> dict:
     """reference create_field_document: {_aN: value, _gN: group value}."""
-    from surrealdb_tpu.exec.operators import div, mul, sub
+    from surrealdb_tpu.exec.operators import div, float_div, mul, sub
 
     doc = {}
     for i, s in enumerate(stats):
@@ -383,7 +383,7 @@ def field_document(group_vals, stats) -> dict:
         elif k == "sum":
             v = s["sum"]
         elif k == "mean":
-            v = (div(s["sum"], s["count"]) if s["count"]
+            v = (float_div(s["sum"], s["count"]) if s["count"]
                  else float("nan"))
         elif k in ("stddev", "variance"):
             if s["count"] <= 1:

@@ -286,11 +286,11 @@ def _mean(args, ctx):
     ns = _nums(args[0], "math::mean", keep=True)
     if not ns:
         return float("nan")
-    # Number division semantics: int sum / int count stays int when exact
+    # try_float_div semantics: int sum / int count stays int when exact
     # (reference fnc/util/math/mean — view rolling means surface this)
-    from surrealdb_tpu.exec.operators import div
+    from surrealdb_tpu.exec.operators import float_div
 
-    return div(sum(ns), len(ns))
+    return float_div(sum(ns), len(ns))
 
 
 @register("math::median")

@@ -16,7 +16,7 @@ def test_expressions(q1):
     assert q1("RETURN 1 + 2 * 3") == 7
     assert q1("RETURN 'a' + 'b'") == "ab"
     assert q1("RETURN [1,2] + [3]") == [1, 2, 3]
-    assert q1("RETURN 9 / 2") == 4.5
+    assert q1("RETURN 9 / 2") == 4  # Int/Int try_div truncates (reference operate.rs div_int)
     assert q1("RETURN 10 % 3") == 1
     assert q1("RETURN 2 ** 10") == 1024
     assert q1("RETURN true AND false") is False
