@@ -564,11 +564,15 @@ def _get_field(doc, name, ctx):
 
 def walk(val, parts, ctx: Ctx, depth=0):
     i = -1
+    fanned = False  # a field step mapped over a list: later index parts
+    # keep mapping per element (idiom chain continuity)
     while i + 1 < len(parts):
         i += 1
         part = parts[i]
         t = type(part)
         if t is PField:
+            if isinstance(val, list):
+                fanned = True
             val = _apply_field(val, part.name, ctx)
         elif t is PAll:
             if isinstance(val, dict):
@@ -592,7 +596,10 @@ def walk(val, parts, ctx: Ctx, depth=0):
                 return NONE
         elif t is PIndex:
             idx = evaluate(part.expr, ctx)
-            val = _apply_index(val, idx, ctx)
+            if fanned and isinstance(val, list):
+                val = [_apply_index(x, idx, ctx) for x in val]
+            else:
+                val = _apply_index(val, idx, ctx)
         elif t is PLast:
             if isinstance(val, list):
                 val = val[-1] if val else NONE
@@ -718,10 +725,7 @@ def _apply_index(val, idx, ctx):
         doc = fetch_record(ctx, val)
         return _apply_index(doc, idx, ctx) if doc is not NONE else NONE
     if isinstance(val, str):
-        if isinstance(idx, (int, float)) and not isinstance(idx, bool):
-            i = int(idx)
-            if -len(val) <= i < len(val):
-                return val[i]
+        # strings are not indexable (reference idiom/recordid.surql)
         return NONE
     return NONE
 
