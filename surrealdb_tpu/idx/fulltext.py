@@ -419,8 +419,10 @@ def _ft_entry(ctx, ref):
 def search_score(ref, ctx):
     entry = _ft_entry(ctx, ref or 0)
     if entry is None or ctx.doc_id is None:
-        return NONE
-    return entry["scores"].get(hashable(ctx.doc_id), NONE)
+        # matched without an index scoring context: score is 0 (reference
+        # select_where_matches_without_complex_query)
+        return 0.0 if ctx.doc_id is not None else NONE
+    return entry["scores"].get(hashable(ctx.doc_id), 0.0)
 
 
 def search_highlight(args, ctx):
