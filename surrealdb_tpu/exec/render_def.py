@@ -442,8 +442,19 @@ def _table_kind_structure(d):
     return {"kind": d.kind.upper()}
 
 
+def _field_name_sql(name_str: str) -> str:
+    # escape each dot segment independently (`value`.sub stays quoted)
+    parts = []
+    for seg in name_str.split("."):
+        if seg in ("*", "") or seg.startswith("["):
+            parts.append(seg)
+        else:
+            parts.append(escape_ident(seg))
+    return ".".join(parts)
+
+
 def render_field(d, tb) -> str:
-    out = f"DEFINE FIELD {d.name_str} ON {escape_ident(tb)}"
+    out = f"DEFINE FIELD {_field_name_sql(d.name_str)} ON {escape_ident(tb)}"
     if d.kind is not None:
         out += f" TYPE {_kind_sql(d.kind)}"
         if d.flex:
@@ -520,7 +531,16 @@ def render_index(d) -> str:
             f" HNSW DIMENSION {h.get('dimension')} DIST {dist_s}"
             f" TYPE {h.get('vector_type', 'f64').upper()}"
             f" EFC {h.get('ef_construction', 150)} M {h.get('m', 12)}"
+            f" M0 {h.get('m0', 24)}"
         )
+        import math as _m
+
+        ml = h.get("ml")
+        if ml is None:
+            ml = 1.0 / _m.log(h.get("m", 12))
+        from surrealdb_tpu.val import render as _render
+
+        out += f" LM {_render(float(ml))}"
     if d.comment:
         out += f" COMMENT {_str_sql(d.comment)}"
     return out
