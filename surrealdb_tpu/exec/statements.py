@@ -1942,7 +1942,8 @@ def _explain_select(n: SelectStmt, ctx):
     for expr in n.what:
         v = _target_value(expr, ctx)
         if isinstance(v, Table):
-            out.append(explain_plan(v.name, n.cond, ctx, n))
+            plan_e = explain_plan(v.name, n.cond, ctx, n)
+            out.extend(plan_e if isinstance(plan_e, list) else [plan_e])
             if n.with_index == []:
                 out.append(
                     {
@@ -2050,7 +2051,8 @@ def _explain_write(n, ctx):
     for expr in n.what:
         v = _target_value(expr, ctx)
         if isinstance(v, Table):
-            out.append(explain_plan(v.name, n.cond, ctx, n))
+            plan_e = explain_plan(v.name, n.cond, ctx, n)
+            out.extend(plan_e if isinstance(plan_e, list) else [plan_e])
         elif isinstance(v, RecordId) and not isinstance(v.id, Range):
             out.append({
                 "detail": {"record": v},

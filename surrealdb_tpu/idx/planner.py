@@ -516,6 +516,22 @@ def _brute_knn(tb, knn: Knn, qv, rest, ctx):
     return [(rows[int(ii)], float(d[ii])) for ii in idx]
 
 
+def _unsupported_expr(cond):
+    """First planner-unsupported subexpression (unary ops) in an AND tree,
+    rendered compactly for the Fallback explain entry."""
+    from surrealdb_tpu.expr.ast import Prefix as _Pfx
+
+    preds = []
+    _split_ands(cond, preds)
+    for p in preds:
+        if isinstance(p, _Pfx):
+            from surrealdb_tpu.exec.render_def import _expr_sql
+
+            inner = _expr_sql(p.expr)
+            return f"{p.op}{inner}"
+    return None
+
+
 def explain_plan(tb, cond, ctx, stmt):
     """EXPLAIN output (reference dbs/plan.rs Explanation)."""
     with_index = getattr(stmt, "with_index", None) if stmt is not None else None
@@ -725,7 +741,17 @@ def explain_plan(tb, cond, ctx, stmt):
                 "operation": "Iterate Index Count" if count_only
                 else "Iterate Index",
             }
-    return {
+    base = {
         "detail": {"direction": "forward", "table": tb},
         "operation": "Iterate Table",
     }
+    if cond is not None:
+        reason = _unsupported_expr(cond)
+        if reason is not None:
+            # the planner analyzer bailed on an unsupported expression
+            # shape: the explain carries a Fallback entry (dbs/plan.rs)
+            return [base, {
+                "detail": {"reason": f"Unsupported expression: {reason}"},
+                "operation": "Fallback",
+            }]
+    return base
