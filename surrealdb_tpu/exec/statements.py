@@ -619,7 +619,11 @@ def _select_pipeline(n: SelectStmt, rows, c):
                 "selection, expression `*` within in selector cannot "
                 "be aggregated in a group."
             )
-        empty_row = True
+        # GROUP ALL over zero cond-matched rows: the legacy engine emits
+        # nothing; the streaming executor emits the count-0 row
+        empty_row = n.cond is None or (
+            getattr(c.session, "planner_strategy", None) == "all-ro"
+        )
         if not rows and not c.session.is_owner and \
                 c.session.auth_level != "editor":
             # a hard PERMISSIONS NONE table suppresses the GROUP ALL row
@@ -1739,7 +1743,9 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                     )
                     return _render_tree([(0, text, 1 if analyze else 0)],
                                         analyze, 1)
-                if label.startswith("IndexScan"):
+                if label.startswith("IndexScan") and residual is None:
+                    # a count scan needs the index to cover the WHOLE
+                    # predicate; residuals require real documents
                     tbn = _target_value(n.what[0], ctx).name
                     cond_s = _expr_sql(n.cond) if n.cond is not None else ""
                     text = (
@@ -1749,7 +1755,8 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                     return _render_tree([(0, text, 1 if analyze else 0)],
                                         analyze, 1)
             root_lines.append(
-                ("Aggregate [ctx: Db] [mode: GROUP ALL]", out_rows_n)
+                ("Aggregate [ctx: Db] [mode: GROUP ALL]",
+                 max(out_rows_n, 1))
             )
     else:
         if n.value is not None:
