@@ -1269,7 +1269,10 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
         get_indexes_for,
     )
 
-    analyze = n.explain == "analyze"
+    analyze = n.explain in ("analyze", "analyze-json", "postfix-full")
+    json_fmt = n.explain in (
+        "json", "analyze-json", "postfix", "postfix-full"
+    )
     orig_n = n
 
     # ORDER BY id is the natural scan order (reversed for DESC): the
@@ -1839,6 +1842,8 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
     stacked = [(i, t, r) for i, (t, r) in enumerate(root_lines + mid_lines)]
     base = len(stacked)
     ordered = stacked + [(base + d, t, r) for d, t, r in scan_lines]
+    if json_fmt:
+        return _tree_to_json(ordered, analyze, out_rows_n)
     return _render_tree(ordered, analyze, out_rows_n)
 
 
@@ -1856,6 +1861,63 @@ def _strip_limit(n):
     n2 = _copy.copy(n)
     n2.limit = None
     return n2
+
+
+import re as _re_mod
+
+
+def _tree_to_json(entries, analyze, total):
+    """Structured (FORMAT JSON) explain: {operator, context, attributes,
+    children[, metrics, total_rows]} (reference exec explain JSON)."""
+    rx = _re_mod.compile(
+        r"^(?P<op>\w+) \[ctx: (?P<ctx>\w+)\](?: \[(?P<attrs>.*)\])?$"
+    )
+
+    def parse(text):
+        m = rx.match(text)
+        if m is None:
+            return {"operator": text, "context": "Db", "attributes": {}}
+        attrs = {}
+        raw = m.group("attrs")
+        if raw:
+            for part in _re_mod.split(r", (?=[\w.]+: )", raw):
+                k, _, v = part.partition(": ")
+                attrs[k] = v
+        return {
+            "operator": m.group("op"),
+            "context": m.group("ctx"),
+            "attributes": attrs,
+        }
+
+    nodes = []
+    stack = []  # (depth, node)
+    root = None
+    for depth, text, rows in entries:
+        node = parse(text)
+        node["children"] = []
+        if analyze:
+            node["metrics"] = {"output_rows": rows}
+        while stack and stack[-1][0] >= depth:
+            stack.pop()
+        if stack:
+            stack[-1][1]["children"].append(node)
+        else:
+            root = node
+        stack.append((depth, node))
+        nodes.append(node)
+    if root is None:
+        root = {"operator": "Empty", "context": "Db", "attributes": {},
+                "children": []}
+    def prune(nd):
+        if not nd["children"]:
+            nd.pop("children", None)
+        else:
+            for ch in nd["children"]:
+                prune(ch)
+    prune(root)
+    if analyze:
+        root["total_rows"] = total
+    return root
 
 
 def _render_tree(entries, analyze, total):
@@ -1997,7 +2059,7 @@ def _explain_select(n: SelectStmt, ctx):
                 }
             )
     out.append(_collector_detail(n, ctx))
-    if n.explain == "full":
+    if n.explain in ("full", "postfix-full"):
         out.append(
             {
                 "detail": {"type": "KeysAndValues"},

@@ -281,9 +281,18 @@ class Parser:
             mode = "full"
         elif self.eat_kw("analyze"):
             mode = "analyze"
+        json_fmt = False
+        if self.eat_kw("format"):
+            self.expect_kw("json")
+            json_fmt = True
         if self.at_kw("select"):
             sel = self._stmt_select()
-            sel.explain = mode
+            if json_fmt:
+                sel.explain = (
+                    "analyze-json" if mode == "analyze" else "json"
+                )
+            else:
+                sel.explain = mode
             return sel
         inner = self.parse_stmt()
         return ExplainStmt(inner, mode == "analyze")
@@ -361,12 +370,14 @@ class Parser:
             elif self.eat_kw("tempfiles"):
                 s.tempfiles = True
             elif self.eat_kw("explain"):
+                # postfix EXPLAIN [FULL]: under the streaming strategy it
+                # rewrites to the JSON format (explain/select_explain_rewrite)
                 if self.eat_kw("full"):
-                    s.explain = "full"
+                    s.explain = "postfix-full"
                 elif self.eat_kw("analyze"):
                     s.explain = "analyze"
                 else:
-                    s.explain = True
+                    s.explain = "postfix"
             else:
                 break
         if s.split and s.group is not None:
