@@ -1959,9 +1959,9 @@ def _s_explain_generic(n: ExplainStmt, ctx: Ctx):
                 (depth, f"Expr [ctx: Rt] [expr: THROW {_expr_sql(node.what)}]")
             )
         elif isinstance(node, _Br):
-            lines.append((depth, "Break [ctx: Rt]"))
+            lines.append((depth, "Expr [ctx: Rt] [expr: BREAK]"))
         elif isinstance(node, _Co):
-            lines.append((depth, "Continue [ctx: Rt]"))
+            lines.append((depth, "Expr [ctx: Rt] [expr: CONTINUE]"))
         elif isinstance(node, _Let):
             lines.append((depth, f"Let [ctx: Rt] [param: ${node.name}]"))
             walk_node(node.what, depth + 1)
