@@ -266,6 +266,12 @@ class Parser:
         while not self.at_op("}"):
             stmts.append(self.parse_stmt())
             if not self.eat_op(";"):
+                # the reference's block parser accepts a new statement
+                # keyword as an implicit separator (fetch/objects.surql)
+                t = self.peek()
+                if t.kind == L.IDENT and t.value.lower() in _STMT_KEYWORDS \
+                        and not t.text.startswith(("`", "⟨")):
+                    continue
                 break
             while self.eat_op(";"):
                 pass
