@@ -2721,6 +2721,9 @@ def _check_nested_kind(n, name_str, ns, db, ctx):
         if nm in ("array", "set"):
             if seg[0] not in ("all", "idx"):
                 return MISMATCH
+            if seg[0] == "idx" and getattr(k, "size", None) is not None \
+                    and isinstance(seg[1], int) and seg[1] >= k.size:
+                return MISMATCH  # index beyond the declared array size
             if not k.inner:
                 return ALLOW
             return [k.inner[0]]
@@ -2744,6 +2747,8 @@ def _check_nested_kind(n, name_str, ns, db, ctx):
             return MISMATCH
         return ALLOW
 
+    if n.kind.name == "any":
+        return  # `any` children are always compatible
     kinds = [pfd.kind]
     r = None
     for seg in segs:
@@ -2776,6 +2781,8 @@ def _check_nested_kind(n, name_str, ns, db, ctx):
                 yield kind_name(k)
 
         names = list(dict.fromkeys(x for k in r for x in leaves(k)))
+        if "any" in names:
+            return  # parent projects `any` at this segment
         want = " | ".join(names)
         have = " | ".join(
             dict.fromkeys(x for x in leaves(n.kind))
