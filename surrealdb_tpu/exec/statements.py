@@ -3910,7 +3910,7 @@ def _s_info(n: InfoStmt, ctx: Ctx):
                 key = key_try
                 break
         if key is None:
-            if explicit:
+            if explicit and explicit != "root":
                 raise SdbError(
                     f"The user '{n.target}' does not exist "
                     f"{_base_phrase(explicit, ctx)}"

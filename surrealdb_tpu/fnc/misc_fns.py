@@ -1178,10 +1178,32 @@ def _api_invoke(args, ctx):
         "params": {**path_params, **(opts.get("params") or {})},
         "query": opts.get("query", {}),
     }
+    # middleware: api::timeout sets the handler deadline (core/src/api)
+    import time as _time
+
+    from surrealdb_tpu.val import Duration as _Dur
+
+    for mw in list(getattr(d, "middleware", []) or []) + list(
+        action.middleware or []
+    ):
+        mname, margs = mw
+        if mname in ("api::timeout", "timeout"):
+            tv = evaluate(margs[0], c) if margs else NONE
+            if isinstance(tv, _Dur):
+                c.deadline = _time.monotonic() + tv.ns / 1e9
     try:
         out = evaluate(action.then, c)
+        # a handler that finishes after its deadline still fails
+        if c.deadline is not None and _time.monotonic() > c.deadline:
+            return {"status": 500, "body": "deadline has elapsed",
+                    "headers": {}}
     except ReturnException as r:
         out = r.value
+    except SdbError as e:
+        if "exceeded the timeout" in str(e) or "deadline" in str(e):
+            return {"status": 500, "body": "deadline has elapsed",
+                    "headers": {}}
+        raise
     if isinstance(out, dict):
         out.setdefault("status", 200)
         out.setdefault("headers", {})
