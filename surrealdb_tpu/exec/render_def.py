@@ -644,9 +644,15 @@ def render_analyzer(d) -> str:
         for f in d.filters:
             if len(f) == 1:
                 fs.append(f[0].upper())
+            elif f[0].lower() == "mapper":
+                fs.append(f"MAPPER({_str_sql(str(f[1]))})")
+            elif f[0].lower() == "snowball":
+                fs.append(
+                    f"SNOWBALL({','.join(str(x).upper() for x in f[1:])})"
+                )
             else:
                 fs.append(f"{f[0].upper()}({','.join(str(x) for x in f[1:])})")
-        out += " FILTERS " + ",".join(fs)
+        out += " FILTERS " + ", ".join(fs)
     if d.comment:
         out += f" COMMENT {_str_sql(d.comment)}"
     return out
