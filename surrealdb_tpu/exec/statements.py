@@ -3828,7 +3828,10 @@ def _s_info(n: InfoStmt, ctx: Ctx):
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.cfg_prefix(ns, db))):
             out["configs"][_cfg_names.get(d.what, d.what)] = render_config(d)
         if n.structure:
-            from surrealdb_tpu.exec.render_def import config_structure
+            from surrealdb_tpu.exec.render_def import (
+                config_structure,
+                table_structure,
+            )
 
             out["configs"] = [
                 config_structure(d)
@@ -3836,6 +3839,29 @@ def _s_info(n: InfoStmt, ctx: Ctx):
                     *K.prefix_range(K.cfg_prefix(ns, db))
                 )
             ]
+            # STRUCTURE mode lists structured defs instead of SQL strings
+            out["tables"] = [
+                table_structure(d)
+                for _k, d in ctx.txn.scan_vals(
+                    *K.prefix_range(K.tb_prefix(ns, db))
+                )
+            ]
+            seqs = []
+            for _k, st in ctx.txn.scan_vals(
+                *K.prefix_range(b"/!sq" + K.enc_str(ns) + K.enc_str(db))
+            ):
+                sd = st[0]
+                seqs.append({
+                    "name": sd.name,
+                    "batch": str(sd.batch),
+                    "start": str(sd.start),
+                    "timeout": sd.timeout if sd.timeout is not None else NONE,
+                })
+            out["sequences"] = seqs
+            for k2 in ("accesses", "analyzers", "apis", "buckets",
+                       "functions", "models", "modules", "params", "users"):
+                if isinstance(out.get(k2), dict):
+                    out[k2] = list(out[k2].values())
         return out
     if n.level == "table":
         from surrealdb_tpu.exec.render_def import (
