@@ -605,6 +605,7 @@ def _select_pipeline(n: SelectStmt, rows, c):
     # SPLIT
     for sp in n.split:
         rows = _apply_split(rows, sp, c)
+
     # alias map: ORDER BY / GROUP BY may reference projection aliases
     aliases = {}
     for expr, alias in n.exprs:
@@ -659,11 +660,25 @@ def _select_pipeline(n: SelectStmt, rows, c):
             rows = rows[int(evaluate(n.start, c)) :]
         if n.limit is not None:
             rows = rows[: int(evaluate(n.limit, c))]
+        # VALUE selectors see omitted docs (the scalar output can't be
+        # pruned later); ORDER BY above still saw the full documents
+        if n.omit and n.value is not None:
+            omits_v = _expand_omits(n.omit, c)
+            for src in rows:
+                doc = src.doc if src.rid is not None else src.value
+                if isinstance(doc, dict):
+                    doc = copy_value(doc)
+                    for om in omits_v:
+                        _omit_path(doc, om, c)
+                    if src.rid is not None:
+                        src.doc = doc
+                    else:
+                        src.value = doc
         out_rows = [_project(src, n, c) for src in rows]
     # OMIT applies to the OUTPUT records (reference pluck stage): after
     # grouping/projection, so omitted group keys still group and omitted
     # projected fields disappear entirely
-    if n.omit:
+    if n.omit and n.value is None:
         omits = _expand_omits(n.omit, c)
         pruned = []
         for r in out_rows:
@@ -1040,8 +1055,6 @@ def _eval_aggregate(expr, members, ctx):
         if fname == "count":
             return sum(1 for v in vals if is_truthy(v))
         if fname == "math::sum":
-            # the streaming Sum aggregate folds with Float 0.0 — but an
-            # empty accumulation reports Int 0 (reference aggregates)
             from decimal import Decimal as _D
 
             from surrealdb_tpu.fnc import FUNCS as _F
@@ -1053,8 +1066,7 @@ def _eval_aggregate(expr, members, ctx):
             ]
             if not nums:
                 return 0
-            v = _F["math::sum"]([nums], ctx)
-            return float(v) if isinstance(v, int) else v
+            return _F["math::sum"]([nums], ctx)
         extra = []
         for a in expr.args[1:]:
             extra.append(evaluate(a, ctx))
@@ -1707,11 +1719,11 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
     # aggregation / projection root
     if n.group is not None:
         if n.group:
-            by = ", ".join(
+            by = ", ".join(expr_name(g) for g in n.group) or ", ".join(
                 (a or expr_name(e))
                 for e, a in n.exprs
                 if e != "*" and not _is_aggregate(e)
-            ) or ", ".join(expr_name(g) for g in n.group)
+            )
             root_lines.append((f"Aggregate [ctx: Db] [by: {by}]", out_rows_n))
         else:
             # count-only GROUP ALL uses the dedicated count scans
@@ -2068,10 +2080,24 @@ def _explain_select(n: SelectStmt, ctx):
         )
         if (n.start is not None or n.limit is not None) \
                 and not range_target:
+            # mirrors iterator.rs can_start_skip / can_cancel_on_limit:
+            # START pushes to storage only for a single unfiltered iterator
+            # (or an index that applies the WHERE itself) with no ORDER BY;
+            # LIMIT cancels early unless GROUP BY or un-indexed ORDER BY
+            index_backed = bool(out) and str(
+                out[0].get("operation", "")
+            ).startswith("Iterate Index")
+            can_skip = (
+                not n.group
+                and len(n.what) == 1
+                and (n.cond is None or index_backed)
+                and not n.order
+            )
+            can_cancel = not n.group and not n.order
             detail = {}
-            if n.limit is not None:
+            if n.limit is not None and can_cancel:
                 detail["CancelOnLimit"] = int(evaluate(n.limit, ctx))
-            if n.start is not None:
+            if n.start is not None and can_skip:
                 sv = int(evaluate(n.start, ctx))
                 if sv:
                     detail["SkipStart"] = sv
@@ -2168,13 +2194,32 @@ def _collector_detail(n: SelectStmt, ctx=None):
         "time::max": "DatetimeMax", "math::stddev": "StdDev",
         "math::variance": "Variance",
     }
+    from surrealdb_tpu.exec.render_def import _expr_sql
+
     aggs = {}
     sel = {}
     group_exprs = {}
     agg_exprs = {}
     expr_slots: dict = {}  # arg text -> exprN
     ai = 0
-    gi = 0
+    # group slots are numbered in GROUP BY clause order (catalog
+    # aggregation planner walks the GROUP BY list, not the projection)
+    group_slots: dict = {}  # select-field name -> _gN
+    non_agg: dict = {}  # select-field name -> expr
+    for expr, alias in n.exprs:
+        if expr == "*":
+            continue
+        if not (
+            isinstance(expr, FunctionCall) and expr.name.lower() in _AGG_NAMES
+        ):
+            non_agg[alias or expr_name(expr)] = expr
+    if isinstance(n.group, list):
+        for g in n.group:
+            gname = expr_name(g)
+            gkey = f"_g{len(group_slots)}"
+            group_slots[gname] = gkey
+            src = non_agg.get(gname, g)
+            group_exprs[gkey] = _expr_sql(src)
     for expr, alias in n.exprs:
         if expr == "*":
             continue
@@ -2197,9 +2242,11 @@ def _collector_detail(n: SelectStmt, ctx=None):
                 aggs[key] = base
             sel[name] = key
         else:
-            gkey = f"_g{gi}"
-            gi += 1
-            group_exprs[gkey] = expr_name(expr)
+            gkey = group_slots.get(name)
+            if gkey is None:
+                gkey = f"_g{len(group_slots)}"
+                group_slots[name] = gkey
+                group_exprs[gkey] = _expr_sql(expr)
             sel[name] = gkey
     return {
         "detail": {

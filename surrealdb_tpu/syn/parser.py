@@ -388,7 +388,62 @@ class Parser:
                 break
         if s.split and s.group is not None:
             raise self.err("SPLIT cannot be combined with GROUP BY")
+        self._check_clause_idioms(s)
         return s
+
+    def _check_clause_idioms(self, s):
+        """SPLIT/GROUP/ORDER idioms must appear in the selection (reference
+        syn/parser/stmt/parts.rs check_idiom; GROUP allows prefix matches,
+        ORDER on a VALUE selector runs on the full row)."""
+        from surrealdb_tpu.expr.ast import Idiom
+
+        if any(e == "*" for e, _a in s.exprs):
+            return
+
+        def _name(expr):
+            from surrealdb_tpu.exec.statements import expr_name
+
+            try:
+                return expr_name(expr)
+            except Exception:
+                return None
+
+        def _found(idiom, prefix_ok):
+            text = _name(idiom)
+            if text is None:
+                return True
+            if s.value is not None:
+                fields = [(s.value, None)]
+            else:
+                fields = s.exprs
+            for e, a in fields:
+                if a is not None and (a == text or (
+                        prefix_ok and a.startswith(text + "."))):
+                    return True
+                ft = _name(e)
+                if ft is None:
+                    continue
+                if ft == text or (prefix_ok and ft.startswith(text + ".")):
+                    return True
+            return False
+
+        for sp in s.split or []:
+            if not _found(sp, False):
+                raise ParseError(
+                    f"Missing split idiom `{_name(sp)}` in statement "
+                    "selection", 0, 0)
+        for g in s.group or []:
+            if isinstance(g, Idiom) or True:
+                if not _found(g, True):
+                    raise ParseError(
+                        f"Missing group idiom `{_name(g)}` in statement "
+                        "selection", 0, 0)
+        if isinstance(s.order, list) and s.value is None:
+            for item in s.order:
+                if not _found(item[0], False):
+                    raise ParseError(
+                        f"Missing order idiom `{_name(item[0])}` in "
+                        "statement selection", 0, 0)
 
     def _select_fields(self):
         fields = []
