@@ -485,8 +485,11 @@ def expr_name(expr, sql=False) -> str:
     return "field"
 
 
-def _ast_params(node, out, _depth=0):
-    """Collect Param names referenced anywhere in an AST fragment."""
+def _ast_params(node, out, _depth=0, _in_sub=False):
+    """Collect Param names referenced anywhere in an AST fragment. Inside a
+    SELECT subquery $this refers to the subquery's own document, but
+    $parent still points at the enclosing (grouped) document, so only
+    `parent` is collected there; deeper subqueries re-bind it."""
     import dataclasses
 
     from surrealdb_tpu.expr.ast import Param as _Param, Subquery as _Sub
@@ -494,17 +497,22 @@ def _ast_params(node, out, _depth=0):
     if _depth > 40 or node is None:
         return
     if isinstance(node, _Param):
-        out.add(node.name)
+        if not _in_sub:
+            out.add(node.name)
+        elif node.name == "parent":
+            out.add("parent")
         return
     if isinstance(node, _Sub) and isinstance(node.stmt, SelectStmt):
-        return  # SELECT subqueries get their own document context
+        if not _in_sub:
+            _ast_params(node.stmt, out, _depth + 1, True)
+        return
     if isinstance(node, (list, tuple)):
         for x in node:
-            _ast_params(x, out, _depth + 1)
+            _ast_params(x, out, _depth + 1, _in_sub)
         return
     if dataclasses.is_dataclass(node) and not isinstance(node, type):
         for f in dataclasses.fields(node):
-            _ast_params(getattr(node, f.name), out, _depth + 1)
+            _ast_params(getattr(node, f.name), out, _depth + 1, _in_sub)
 
 
 def _check_group_params(n):
@@ -1038,9 +1046,27 @@ def _apply_group(rows, n: SelectStmt, ctx, aliases=None, empty_row=True):
     return out
 
 
+# the reference's real streaming aggregates (catalog/aggregation.rs
+# AggregateExprCollector); other _AGGREGATES entries are ordinary functions
+# applied over an implicit Accumulate of their argument, so when their
+# argument itself contains an aggregate they act as plain outer calls
+_TRUE_AGGS = {
+    "count", "math::sum", "math::mean", "math::min", "math::max",
+    "math::stddev", "math::variance", "time::min", "time::max",
+    "array::group",
+}
+
+
 def _eval_aggregate(expr, members, ctx):
     """Evaluate an aggregate expression over a group of source rows."""
-    if isinstance(expr, FunctionCall) and expr.name.lower() in _AGGREGATES:
+    if (
+        isinstance(expr, FunctionCall)
+        and expr.name.lower() in _AGGREGATES
+        and not (
+            expr.name.lower() not in _TRUE_AGGS
+            and any(_is_aggregate(a) for a in expr.args)
+        )
+    ):
         fname = expr.name.lower()
         from surrealdb_tpu.fnc import FUNCS
 
@@ -1315,10 +1341,19 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
     knn_brute = None
     for expr in n.what:
         v = _target_value(expr, ctx)
-        if isinstance(v, RecordId) and not isinstance(v.id, Range):
+        if isinstance(v, RecordId):
             rows = len(list(_iterate_value(v, ctx))) if analyze else 0
+            if isinstance(v.id, Range):
+                rg = v.id
+                rid_s = (
+                    f"{v.tb}:{render(rg.beg)}"
+                    + ("..=" if rg.end_incl else "..")
+                    + render(rg.end)
+                )
+            else:
+                rid_s = v.render()
             scans.append(
-                (f"RecordIdScan [ctx: Db] [record_id: {v.render()}]", rows)
+                (f"RecordIdScan [ctx: Db] [record_id: {rid_s}]", rows)
             )
             total_scan_rows += rows
             continue
@@ -1796,12 +1831,12 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 # function-call fields render with elided args (reference
                 # operator pretty-print: `vector::distance::knn(...)`)
                 computed = [
-                    f"{a} = " + (
+                    f"{a or expr_name(e)} = " + (
                         f"{e.name}(...)" if isinstance(e, FunctionCall)
                         else _expr_sql(e)
                     )
                     for e, a in n.exprs
-                    if e != "*" and a and not isinstance(e, Idiom)
+                    if e != "*" and not isinstance(e, Idiom)
                 ]
                 if computed:
                     mid_lines.insert(
@@ -2046,12 +2081,19 @@ def _explain_select(n: SelectStmt, ctx):
             rs = rg
             range_target = True
             count_only_rng = (
-                n.group == []
+                n.cond is None
+                and not n.order
                 and len(n.exprs) == 1
                 and isinstance(n.exprs[0][0], FunctionCall)
                 and n.exprs[0][0].name.lower() == "count"
                 and not n.exprs[0][0].args
             )
+            if count_only_rng and n.group == []:
+                rng_op = "Iterate Range Count"
+            elif count_only_rng and n.group is None:
+                rng_op = "Iterate Range Keys"
+            else:
+                rng_op = "Iterate Range"
             out.append(
                 {
                     "detail": {
@@ -2059,8 +2101,7 @@ def _explain_select(n: SelectStmt, ctx):
                         "range": rs,
                         "table": v.tb,
                     },
-                    "operation": "Iterate Range Count" if count_only_rng
-                    else "Iterate Range",
+                    "operation": rng_op,
                 }
             )
         else:

@@ -535,10 +535,13 @@ def _unsupported_expr(cond):
 def explain_plan(tb, cond, ctx, stmt):
     """EXPLAIN output (reference dbs/plan.rs Explanation)."""
     with_index = getattr(stmt, "with_index", None) if stmt is not None else None
+    orig_cond = cond
     if with_index == []:
         cond = None  # WITH NOINDEX: always a table scan
-    # a count-only GROUP ALL over a bare table counts keys, not documents
-    if cond is None and stmt is not None and             getattr(stmt, "group", None) == [] and             getattr(stmt, "exprs", None):
+    # record strategy (idx/planner/mod.rs check_record_strategy): a
+    # count()-only selection over a bare table needs no document values —
+    # GROUP ALL counts keys (Count), ungrouped iterates keys (KeysOnly)
+    if orig_cond is None and stmt is not None and             not getattr(stmt, "order", None) and             getattr(stmt, "exprs", None):
         from surrealdb_tpu.expr.ast import FunctionCall as _FC3
 
         if (
@@ -547,10 +550,17 @@ def explain_plan(tb, cond, ctx, stmt):
             and stmt.exprs[0][0].name.lower() == "count"
             and not stmt.exprs[0][0].args
         ):
-            return {
-                "detail": {"direction": "forward", "table": tb},
-                "operation": "Iterate Table Count",
-            }
+            group = getattr(stmt, "group", None)
+            if group == []:
+                return {
+                    "detail": {"direction": "forward", "table": tb},
+                    "operation": "Iterate Table Count",
+                }
+            if group is None:
+                return {
+                    "detail": {"direction": "forward", "table": tb},
+                    "operation": "Iterate Table Keys",
+                }
     if cond is not None:
         knn = _find_knn(cond)
         indexes = get_indexes_for(tb, ctx)
