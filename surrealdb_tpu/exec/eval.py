@@ -686,6 +686,11 @@ def _apply_field(val, name, ctx):
 
 
 def _apply_index(val, idx, ctx):
+    from surrealdb_tpu.val import SSet as _SSet
+
+    if isinstance(val, _SSet):
+        # sets index positionally over their sorted items
+        val = list(val.items)
     if isinstance(val, RecordId):
         if isinstance(val.id, list) and isinstance(idx, (int, float)) \
                 and not isinstance(idx, bool):

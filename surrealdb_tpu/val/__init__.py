@@ -581,6 +581,49 @@ def _num_cmp(a, b) -> int:
         return 0
 
 
+_GEOM_RANK = {
+    "Point": 0, "LineString": 1, "Polygon": 2, "MultiPoint": 3,
+    "MultiLineString": 4, "MultiPolygon": 5, "GeometryCollection": 6,
+}
+
+
+def _geom_flat(g):
+    """Flattened (x, y) sequence (reference val/geometry.rs PartialOrd);
+    polygons chain interior rings before the exterior."""
+    k, c = g.kind, g.coords
+    if k == "Point":
+        return [tuple(c)]
+    if k in ("LineString", "MultiPoint"):
+        return [tuple(p) for p in c]
+    if k == "Polygon":
+        rings = list(c[1:]) + list(c[:1])
+        return [tuple(p) for ring in rings for p in ring]
+    if k == "MultiLineString":
+        return [tuple(p) for line in c for p in line]
+    if k == "MultiPolygon":
+        out = []
+        for poly in c:
+            rings = list(poly[1:]) + list(poly[:1])
+            out.extend(tuple(p) for ring in rings for p in ring)
+        return out
+    return []
+
+
+def _geometry_cmp(a, b) -> int:
+    ra, rb = _GEOM_RANK.get(a.kind, 7), _GEOM_RANK.get(b.kind, 7)
+    if ra != rb:
+        return -1 if ra < rb else 1
+    if a.kind == "GeometryCollection":
+        for x, y in zip(a.coords, b.coords):
+            c = _geometry_cmp(x, y)
+            if c:
+                return c
+        return (len(a.coords) > len(b.coords)) - (
+            len(a.coords) < len(b.coords))
+    fa, fb = _geom_flat(a), _geom_flat(b)
+    return (fa > fb) - (fa < fb)
+
+
 def value_cmp(a, b) -> int:
     """Total order over all values (reference val/mod.rs Ord)."""
     ra, rb = type_rank(a), type_rank(b)
@@ -618,8 +661,7 @@ def value_cmp(a, b) -> int:
                 return c
         return (len(ka) > len(kb)) - (len(ka) < len(kb))
     if ra == 11:
-        sa, sb = a.render(), b.render()
-        return (sa > sb) - (sa < sb)
+        return _geometry_cmp(a, b)
     if ra == 12:
         return (bytes(a) > bytes(b)) - (bytes(a) < bytes(b))
     if ra == 13:
