@@ -32,6 +32,15 @@ INDEXING_BATCH_SIZE = env_int("SURREAL_INDEXING_BATCH_SIZE", 250)
 # device KNN thresholds
 KNN_DEVICE_MIN_ROWS = env_int("SURREAL_KNN_DEVICE_MIN_ROWS", 2048)
 KNN_BLOCK_ROWS = env_int("SURREAL_KNN_BLOCK_ROWS", 262144)
+# query-batch chunk per lax.map step in the ranking kernel (MXU batch dim)
+KNN_QUERY_CHUNK = env_int("SURREAL_KNN_QUERY_CHUNK", 512)
+# peak [chunk, N] f32 score-matrix elements per ranking step (~2 GB HBM);
+# large stores shrink the per-step query chunk to stay under this
+KNN_SCORE_BUDGET_ELEMS = env_int(
+    "SURREAL_KNN_SCORE_BUDGET_ELEMS", 1 << 29
+)
+# parsed-statement cache entries (Datastore.execute)
+AST_CACHE_SIZE = env_int("SURREAL_AST_CACHE_SIZE", 512)
 # slow-query log threshold (ms); 0 disables
 SLOW_QUERY_THRESHOLD_MS = env_float("SURREAL_SLOW_QUERY_THRESHOLD_MS", 0.0)
 # file-engine WAL batches between snapshot compactions

@@ -378,6 +378,38 @@ def _index_scan(tb, idef, eq_vals, tail, ctx):
     return gen()
 
 
+def _knn_safe_expr(expr) -> bool:
+    if _field_path(expr) == "id":
+        return True
+    from surrealdb_tpu.expr.ast import FunctionCall
+
+    # knn-distance pseudo-functions read ctx.knn, not the document
+    return isinstance(expr, FunctionCall) and expr.name in (
+        "vector::distance::knn",
+    ) and not expr.args
+
+
+def _id_only_projection(stmt, ctx) -> bool:
+    """True when a KNN SELECT's output is derivable from the index result
+    alone (rids + distances): `SELECT id`, `SELECT VALUE id`, optionally
+    with vector::distance::knn(). Lets the scan skip per-row record
+    fetches — the dominant host cost for high-QPS KNN serving."""
+    from surrealdb_tpu.expr.ast import SelectStmt
+
+    if not isinstance(stmt, SelectStmt) or not ctx.session.is_owner:
+        return False
+    if (stmt.group is not None or stmt.split or stmt.fetch or stmt.omit
+            or stmt.version is not None or stmt.explain):
+        return False
+    if stmt.order:  # ORDER BY may reference arbitrary fields
+        return False
+    if stmt.value is not None:
+        return not stmt.exprs and _knn_safe_expr(stmt.value)
+    if not stmt.exprs:
+        return False
+    return all(_knn_safe_expr(e) for e, _a in stmt.exprs)
+
+
 def _plan_knn(tb, cond, knn: Knn, indexes, ctx, stmt):
     from surrealdb_tpu.exec.eval import evaluate, fetch_record
     from surrealdb_tpu.exec.statements import Source
@@ -429,6 +461,14 @@ def _plan_knn(tb, cond, knn: Knn, indexes, ctx, stmt):
     def gen():
         from surrealdb_tpu.exec.eval import fetch_record
 
+        if _id_only_projection(stmt, ctx):
+            # projection touches only `id` (plus knn-distance pseudo-
+            # functions): the index result IS the answer — skip the
+            # per-row record fetch entirely (keys-only KNN scan)
+            for rid, dist in results:
+                ctx.knn[hashable(rid)] = dist
+                yield Source(rid=rid, doc={"id": rid})
+            return
         for rid, dist in results:
             ctx.knn[hashable(rid)] = dist
             doc = fetch_record(ctx, rid)

@@ -97,6 +97,65 @@ def vector_index_update(idef, rid: RecordId, before, after, ctx):
     ctx.txn.set_val(vkey, ver)
 
 
+class _Coalescer:
+    """Self-clocking cross-query dynamic batcher.
+
+    The first searcher dispatches immediately (no added latency when
+    idle); searches arriving while a device call is in flight queue up
+    and ride the NEXT dispatch as one batched kernel call — so device
+    batch size grows with client concurrency, inference-server style.
+    This is how concurrent `SELECT … <|k|>` statements (e.g. from the
+    threaded HTTP/WS server) share MXU work instead of serializing
+    per-query dispatches. Reference contrast: hnsw/index.rs walks the
+    graph per query under an RwLock; here concurrency *increases*
+    device efficiency.
+    """
+
+    def __init__(self, index):
+        self.index = index
+        self.lock = threading.Lock()
+        self.run_lock = threading.Lock()
+        self.queue: list = []
+
+    def search(self, qv: np.ndarray, k: int):
+        ev = threading.Event()
+        slot = [None, None]  # result, exception
+        with self.lock:
+            self.queue.append((qv, k, slot, ev))
+        while True:
+            if ev.is_set():
+                break
+            if self.run_lock.acquire(blocking=False):
+                try:
+                    with self.lock:
+                        batch, self.queue = self.queue, []
+                    if batch:
+                        self._run(batch)
+                finally:
+                    self.run_lock.release()
+            else:
+                ev.wait(0.05)
+        if slot[1] is not None:
+            raise slot[1]
+        return slot[0]
+
+    def _run(self, batch):
+        index = self.index
+        try:
+            kmax = max(k for _q, k, _s, _e in batch)
+            qvs = np.stack([q for q, _k, _s, _e in batch])
+            with index.lock:  # exclude cache sync while the kernel reads
+                results = index._device_knn_batch(qvs, kmax)
+            for (_q, k, slot, ev), pairs in zip(batch, results):
+                slot[0] = pairs[:k]
+                ev.set()
+        except BaseException as e:
+            for _q, _k, slot, ev in batch:
+                if not ev.is_set():
+                    slot[1] = e
+                    ev.set()
+
+
 class TpuVectorIndex:
     """Per-(ns,db,tb,ix) device block cache + search engine."""
 
@@ -123,6 +182,7 @@ class TpuVectorIndex:
         self.device_rank = None
         self.device_x2 = None  # f32 row norms² (euclidean ranking)
         self.mesh = None
+        self.coalescer = _Coalescer(self)
 
     # -- cache sync ---------------------------------------------------------
     def sync(self, ctx):
@@ -324,8 +384,7 @@ class TpuVectorIndex:
                 for i in idx
                 if np.isfinite(d[i])
             ]
-        pairs = self._device_knn_batch(qv[None, :], k)
-        return pairs[0]
+        return self.coalescer.search(qv, k)
 
     def _device_knn_batch(self, qvs: np.ndarray, k: int):
         """Batched device search: [B, D] queries -> per-query (rid, dist)
@@ -354,18 +413,43 @@ class TpuVectorIndex:
                 for drow, irow in zip(dists, ids)
             ]
         if self.device_rank is not None:
-            from surrealdb_tpu.ops.topk import knn_rank_candidates
+            from surrealdb_tpu.ops.topk import knn_rank_approx
 
-            # oversample to absorb bf16 ranking error, then rescore exactly
+            # oversample to absorb bf16/approx-top-k ranking error, then
+            # rescore exactly in f32/f64 on host
+            # oversampling absorbs bf16/approx-top-k ranking error AND
+            # tombstoned rows ranked into the candidate set (sync() keeps
+            # fragmentation ≤ 25%, so 2k candidates leave ≥ 1.5k valid)
             kc = min(n, max(2 * k, k + 16))
-            ids = np.asarray(knn_rank_candidates(
-                self.device_rank, qs, kc, self.metric,
+            b_total = qs.shape[0]
+            # chunk queries into [R, chunk, D] so arbitrarily many queries
+            # ride ONE device dispatch (per-call latency amortization);
+            # pad the batch to a power of two so dynamic batch sizes from
+            # the coalescer hit a bounded set of compiled kernel shapes
+            bucket = 1
+            while bucket < b_total:
+                bucket *= 2
+            # power-of-two chunk (so it divides the bucket), capped by the
+            # config knob and by the [chunk, N] f32 score-matrix budget
+            cap = min(max(1, cnf.KNN_QUERY_CHUNK),
+                      max(1, cnf.KNN_SCORE_BUDGET_ELEMS // max(n, 1)))
+            chunk = 1
+            while chunk * 2 <= min(cap, bucket):
+                chunk *= 2
+            r = bucket // chunk
+            if bucket != b_total:
+                qs = jnp.pad(qs, ((0, bucket - b_total), (0, 0)))
+            ids = np.asarray(knn_rank_approx(
+                self.device_rank, qs.reshape(r, chunk, -1), kc, self.metric,
                 self.device_x2, self.device_valid,
-            ))
+            )).reshape(bucket, kc)[:b_total]
             out = []
-            for b in range(ids.shape[0]):
+            for b in range(b_total):
                 cand = ids[b]
-                cand = cand[cand >= 0]
+                # approx_max_k returns real row indices for inf-masked
+                # (tombstoned) rows — refilter against the live mask
+                cand = cand[(cand >= 0) & (cand < n)]
+                cand = cand[self.valid[cand]]
                 d = self._host_distances(qvs[b], self.vecs[cand])
                 order = np.argsort(d, kind="stable")[:k]
                 out.append([

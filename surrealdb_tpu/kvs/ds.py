@@ -11,6 +11,7 @@ import threading
 import time
 from typing import Any, Optional
 
+from surrealdb_tpu import cnf
 from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.kvs.api import Transaction
 
@@ -129,6 +130,12 @@ class Datastore:
         except ValueError:
             self.slow_log_threshold_ms = 0.0
         self.slow_log: list = []  # (ms, sql-ish label) ring
+        # parsed-statement cache: repeated query texts (the common client
+        # pattern — same SQL, different $vars) skip the parser entirely.
+        # ASTs are execution-state-free, so cached statement lists are
+        # shared across concurrent executors.
+        self._ast_cache: dict = {}
+        self._ast_cache_cap = cnf.AST_CACHE_SIZE
 
 
     # -- transactions -------------------------------------------------------
@@ -169,11 +176,17 @@ class Datastore:
             sess.ns = ns
         if db is not None:
             sess.db = db
-        try:
-            stmts = parse(sql)
-        except ParseError as e:
-            # a parse error fails the whole query (reference behaviour)
-            return [QueryResult(error=str(e))]
+        stmts = self._ast_cache.get(sql)
+        if stmts is None:
+            try:
+                stmts = parse(sql)
+            except ParseError as e:
+                # a parse error fails the whole query (reference behaviour)
+                return [QueryResult(error=str(e))]
+            with self.lock:
+                if len(self._ast_cache) >= self._ast_cache_cap:
+                    self._ast_cache.clear()
+                self._ast_cache[sql] = stmts
         ex = Executor(self, sess)
         return ex.execute(stmts, vars or {})
 

@@ -123,3 +123,68 @@ def test_knn_recall_exact():
         want = set(np.argsort(ref[b])[:10].tolist())
         got = set(i[b].tolist())
         assert len(want & got) >= 9  # allow 1 tie-break difference
+
+
+def test_knn_tombstones_not_returned():
+    """Deleted records must never surface from the approx ranking path,
+    even when tombstones dominate the store (the inf-masked rows still
+    have real indices in approx_max_k output)."""
+    import surrealdb_tpu.idx.vector as V
+    from surrealdb_tpu import Datastore
+
+    old = V.DEVICE_MIN_ROWS
+    V.DEVICE_MIN_ROWS = 16
+    try:
+        ds = Datastore("memory")
+        ds.query(
+            "DEFINE TABLE p; DEFINE INDEX ix ON p FIELDS v HNSW "
+            "DIMENSION 4 DIST EUCLIDEAN TYPE F32"
+        )
+        rng = np.random.default_rng(9)
+        vecs = rng.normal(size=(64, 4)).astype(np.float64)
+        for i in range(64):
+            v = vecs[i]
+            ds.query(
+                f"CREATE p:{i} SET v = [{v[0]}, {v[1]}, {v[2]}, {v[3]}]"
+            )
+        # warm the device cache, then delete most rows (stay under the
+        # sync() rebuild threshold so tombstones persist in the mask)
+        ds.query("SELECT id FROM p WHERE v <|3,20|> [0, 0, 0, 0]")
+        for i in range(4, 64):
+            ds.query(f"DELETE p:{i}")
+        rows = ds.query(
+            "SELECT id FROM p WHERE v <|8,20|> [0, 0, 0, 0]"
+        )[0]
+        ids = {r["id"].id for r in rows}
+        assert ids <= {0, 1, 2, 3}, ids
+        assert len(rows) <= 4
+    finally:
+        V.DEVICE_MIN_ROWS = old
+
+
+def test_knn_query_chunk_non_pow2(monkeypatch):
+    """A non-power-of-two SURREAL_KNN_QUERY_CHUNK must not break the
+    batched ranking path (chunk is clamped to a dividing power of two)."""
+    from surrealdb_tpu import cnf
+    import surrealdb_tpu.idx.vector as V
+
+    monkeypatch.setattr(cnf, "KNN_QUERY_CHUNK", 300)
+    monkeypatch.setattr(V, "DEVICE_MIN_ROWS", 16)
+    from surrealdb_tpu.idx.vector import TpuVectorIndex
+
+    ix = TpuVectorIndex("n", "d", "t", "i", {
+        "dimension": 8, "distance": "euclidean", "vector_type": "f32",
+    })
+    rng = np.random.default_rng(3)
+    ix.vecs = rng.normal(size=(512, 8)).astype(np.float32)
+    ix.valid = np.ones(512, dtype=bool)
+    from surrealdb_tpu.val import RecordId
+
+    ix.rids = [RecordId("t", i) for i in range(512)]
+    ix.version = 0
+    qs = rng.normal(size=(600, 8)).astype(np.float32)
+    out = ix._device_knn_batch(qs, 5)
+    assert len(out) == 600
+    d = ((ix.vecs - qs[0]) ** 2).sum(axis=1)
+    want = int(np.argmin(d))
+    assert out[0][0][0].id == want
