@@ -488,7 +488,8 @@ def _e_mock(n, ctx):
         for _ in range(n.beg):
             out.append(RecordId(n.tb, generate_record_key()))
     else:
-        for i in range(n.beg, n.end + 1):
+        stop = n.end + 1 if n.end_incl else n.end
+        for i in range(n.beg, stop):
             out.append(RecordId(n.tb, i))
     return out
 

@@ -1539,13 +1539,25 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                     acc = "[" + ", ".join(render(x) for x in vals) + "]"
                 else:
                     acc = f"= {render(vals[0])}" if vals else "[]"
+                # composite tails: only the FIRST range bound rides the
+                # index access; later bounds — and any IN tail after an
+                # eq prefix — drop to a residual Filter (the reference's
+                # streaming executor pushes a single compound range)
+                extra_bound_vxs = []
+                in_tail_residual = False
                 if tail is not None and tail[0] == "range":
+                    # composite access pushes exactly ONE bound (cond
+                    # order); every other bound filters above the scan
                     opmap = {">": "MoreThan", ">=": "MoreThanEqual",
                              "<": "LessThan", "<=": "LessThanEqual"}
-                    for op, vx in tail[1]:
-                        acc += f" {opmap.get(op, op)} {render(evaluate(vx, ctx))}"
+                    op, vx = tail[1][0]
+                    acc += f" {opmap.get(op, op)} {render(evaluate(vx, ctx))}"
+                    extra_bound_vxs = [vx2 for _o2, vx2 in tail[1][1:]]
                 elif tail is not None and tail[0] == "in":
-                    acc += f" IN {render(evaluate(tail[1], ctx))}"
+                    if nmatch:
+                        in_tail_residual = True
+                    else:
+                        acc += f" IN {render(evaluate(tail[1], ctx))}"
                 direction = "Forward"
                 if (
                     n.order
@@ -1579,7 +1591,7 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 )
                 # residual: predicates not covered by the index
                 covered = set(idef.cols_str[:nmatch])
-                if tail is not None:
+                if tail is not None and not in_tail_residual:
                     covered.add(idef.cols_str[nmatch])
                 preds = []
                 from surrealdb_tpu.idx.planner import _split_ands, _field_path
@@ -1591,12 +1603,20 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
 
                     pth = None
                     enforceable = False
+                    is_extra_bound = False
                     if isinstance(pred, _B):
                         pth = _field_path(pred.lhs) or _field_path(pred.rhs)
                         enforceable = pred.op in (
                             "=", "==", "<", "<=", ">", ">=", "∈"
                         )
-                    if pth is None or pth not in covered or not enforceable:
+                        # later range bounds on the tail column dropped
+                        # out of the access string — they filter above
+                        is_extra_bound = any(
+                            pred.rhs is vx or pred.lhs is vx
+                            for vx in extra_bound_vxs
+                        )
+                    if pth is None or pth not in covered or not enforceable \
+                            or is_extra_bound:
                         keep.append(pred)
                 residual = None
                 for pred in keep:
@@ -1865,14 +1885,19 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 )
         elif n.limit is not None:
             lim = int(evaluate(n.limit, ctx))
-            # sorts sit directly under the projection, above Compute
+            off = int(evaluate(n.start, ctx)) if n.start is not None else 0
+            # sorts sit directly under the projection, above Compute; the
+            # top-k keeps limit+offset rows, the Limit node drops the skip
             mid_lines.insert(
                 0,
-                (f"SortTopKByKey [ctx: Db] [sort_keys: {keys}, limit: {lim}]",
+                (f"SortTopKByKey [ctx: Db] [sort_keys: {keys}, "
+                 f"limit: {lim + off}]",
                  out_rows_n)
             )
+            limattr2 = f"limit: {lim}, offset: {off}" \
+                if n.start is not None else f"limit: {lim}"
             mid_lines.insert(
-                0, (f"Limit [ctx: Db] [limit: {lim}]", out_rows_n)
+                0, (f"Limit [ctx: Db] [{limattr2}]", out_rows_n)
             )
         else:
             mid_lines.insert(
@@ -2111,6 +2136,14 @@ def _explain_select(n: SelectStmt, ctx):
                     "operation": "Iterate Value",
                 }
             )
+    # an index range scan that consumed the ORDER BY (in-order / backward
+    # iteration) behaves order-free for the start/limit strategy
+    # (iterator.rs can_cancel_on_limit); the marker is internal-only
+    order_consumed = any([
+        o.get("detail", {}).pop("_order_consumed", False)
+        for o in out
+        if isinstance(o.get("detail"), dict)
+    ])  # list-comp: pop the marker from EVERY entry before any() looks
     out.append(_collector_detail(n, ctx))
     if n.explain in ("full", "postfix-full"):
         out.append(
@@ -2132,9 +2165,9 @@ def _explain_select(n: SelectStmt, ctx):
                 not n.group
                 and len(n.what) == 1
                 and (n.cond is None or index_backed)
-                and not n.order
+                and (not n.order or order_consumed)
             )
-            can_cancel = not n.group and not n.order
+            can_cancel = not n.group and (not n.order or order_consumed)
             detail = {}
             if n.limit is not None and can_cancel:
                 detail["CancelOnLimit"] = int(evaluate(n.limit, ctx))
@@ -2165,6 +2198,14 @@ def _explain_select(n: SelectStmt, ctx):
             o.get("operation") == "Iterate Index"
             and isinstance(o.get("detail", {}).get("plan"), dict)
             and "from" in o["detail"]["plan"]
+            for o in out
+        ):
+            count = 0
+        # a top-k collector (MemoryOrderedLimit) holds full rows — the
+        # fetch stage never re-reads records (reference: count always 0)
+        if any(
+            o.get("operation") == "Collector"
+            and o.get("detail", {}).get("type") == "MemoryOrderedLimit"
             for o in out
         ):
             count = 0

@@ -149,11 +149,13 @@ class RegexLit(Node):
 
 @dataclass
 class Mock(Node):
-    """|table:count| or |table:min..max| — generate mock records."""
+    """|table:count| or |table:min..max| — generate mock records.
+    `..` excludes the end id, `..=` includes it (reference TypedRange)."""
 
     tb: str
     beg: int
     end: Optional[int] = None
+    end_incl: bool = False
 
 
 # --- idioms -----------------------------------------------------------------

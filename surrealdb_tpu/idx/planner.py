@@ -28,10 +28,16 @@ from surrealdb_tpu.err import SdbError
 
 
 def _field_path(expr):
-    from surrealdb_tpu.expr.ast import PAll, PFlatten, PIndex
+    from surrealdb_tpu.expr.ast import PAll, PFlatten, PIndex, PMethod
 
     def _ok(p):
-        if isinstance(p, (PField, PAll, PFlatten)):
+        if isinstance(p, (PAll, PFlatten)):
+            return True
+        if isinstance(p, PField):
+            return True
+        # argument-free method parts (id.id().r) are deterministic
+        # per-document, so they name stable index column paths
+        if isinstance(p, PMethod) and not p.args:
             return True
         # literal integer index parts (id[1]) are stable column paths
         return isinstance(p, PIndex) and isinstance(p.expr, Literal) \
@@ -199,11 +205,24 @@ def _array_shaped(path: str, array_paths) -> bool:
     return ".*" in path or "…" in path or path in array_paths
 
 
-def _choose_index(indexes, eqs, ins, rngs):
-    """Pick the index matching the longest run of leading columns; returns
-    (idef, nmatch, tail) or None."""
+def _choose_index(indexes, eqs, ins, rngs, model="streaming"):
+    """Pick the best access path over the candidate indexes; returns
+    (idef, nmatch, tail) or None.
+
+    `model="streaming"` mirrors the reference's streaming planner
+    (exec/index/analysis.rs IndexCandidate::score): single-column
+    equality scores 1000 unique / 500 non-unique; a compound prefix
+    scores 400 + 50·prefix (+25 with a narrowing range); a pure range
+    scores 300 bounded / 200 half-bounded. Ties prefer the narrower
+    index (the reference appends single-column candidates after compound
+    ones and max_by_key keeps the last maximum), then the LATER-defined
+    index (max_by_key keeps the last of equal maxima).
+
+    `model="legacy"` mirrors the legacy tree planner (idx/planner/tree.rs):
+    the longest run of leading eq columns wins, an IN/range tail counts
+    extra, first-defined index wins ties."""
     best = None
-    for idef in indexes:
+    for pos, idef in enumerate(indexes):
         if idef.hnsw is not None or idef.fulltext is not None or idef.count:
             continue
         cols = idef.cols_str
@@ -222,9 +241,31 @@ def _choose_index(indexes, eqs, ins, rngs):
             break
         if nmatch == 0 and tail is None:
             continue
-        score = nmatch * 2 + (1 if tail else 0)
-        if best is None or score > best[0]:
-            best = (score, idef, nmatch, tail)
+        if model == "legacy":
+            key = (nmatch * 2 + (1 if tail else 0), 0, -pos)
+        elif nmatch == len(cols) and tail is None and len(cols) == 1:
+            key = (1000 if idef.unique else 500, -1, pos)
+        elif tail is not None and tail[0] == "in" and nmatch == 0:
+            # IN-expansion union is a FALLBACK path in the streaming
+            # planner (analysis.rs try_in_expansion): it only applies when
+            # no eq/range candidate exists, and prefers the narrowest
+            # index whose FIRST column is the IN column
+            key = (10, -len(cols), pos)
+        elif nmatch:
+            # compound access: prefix of equalities, optionally narrowed
+            # by a range on the next column (IN tails are NOT pushed by
+            # the streaming executor — prefix-only access)
+            score = 400 + 50 * nmatch + (
+                25 if tail is not None and tail[0] == "range" else 0
+            )
+            key = (score, -len(cols), pos)
+        else:
+            ops = {op for op, _vx in tail[1]}
+            lower = any(o in (">", ">=") for o in ops)
+            upper = any(o in ("<", "<=") for o in ops)
+            key = (300 if (lower and upper) else 200, -len(cols), pos)
+        if best is None or key > best[0]:
+            best = (key, idef, nmatch, tail)
     if best is None:
         return None
     return best[1], best[2], best[3]
@@ -270,7 +311,22 @@ def plan_scan(tb: str, cond, ctx, stmt):
         return None
     idef, nmatch, tail = chosen
     eq_vals = [evaluate(eqs[c], ctx) for c in idef.cols_str[:nmatch]]
-    return _index_scan(tb, idef, eq_vals, tail, ctx)
+    scan = _index_scan(tb, idef, eq_vals, tail, ctx)
+    order = getattr(stmt, "order", None) if stmt is not None else None
+    if order and order != "rand" and len(order) == 1 and \
+            order[0][1] == "desc":
+        from surrealdb_tpu.exec.statements import expr_name
+
+        if expr_name(order[0][0]) == idef.cols_str[0]:
+            # ORDER BY <first index column> DESC rides the reverse index
+            # iterator: emit in reverse key order so equal-key rows keep
+            # reverse-scan relative order (the later stable sort preserves
+            # it; reference ReverseOrder / backward range iterators)
+            def rev(inner=scan):
+                yield from reversed(list(inner))
+
+            return rev()
+    return scan
 
 
 def _index_scan(tb, idef, eq_vals, tail, ctx):
@@ -355,10 +411,14 @@ def _index_scan(tb, idef, eq_vals, tail, ctx):
                 pre = prefix + K.enc_value(v)
                 yield from _emit_range(*K.prefix_range(pre))
             return
-        # range bounds on the next column
+        # range bounds on the next column. Composite scans (eq prefix)
+        # push exactly ONE bound into the key range — the rest re-filter
+        # via the residual WHERE (mirrors the streaming IndexScan access);
+        # single-column scans combine all bounds as before.
+        bounds = payload[:1] if eq_vals else payload
         lo = hi = None
         lo_incl = hi_incl = True
-        for op, vx in payload:
+        for op, vx in bounds:
             v = evaluate(vx, ctx)
             if op in (">", ">="):
                 lo, lo_incl = v, op == ">="
@@ -669,7 +729,7 @@ def explain_plan(tb, cond, ctx, stmt):
 
         eqs, ins, rngs = _classify_preds(cond, _array_like_paths(tb, ctx))
         best = None
-        chosen = _choose_index(indexes, eqs, ins, rngs)
+        chosen = _choose_index(indexes, eqs, ins, rngs, model="legacy")
         count_only = False
         if stmt is not None and getattr(stmt, "group", None) == [] and \
                 getattr(stmt, "exprs", None):
@@ -731,20 +791,47 @@ def explain_plan(tb, cond, ctx, stmt):
                     else:
                         to = {"inclusive": rop2 == "<=", "value": rv2}
                 direction = "forward"
+                order_consumed = False
                 order = getattr(stmt, "order", None) if stmt is not None                     else None
                 if order and order != "rand" and len(order) == 1:
                     from surrealdb_tpu.exec.statements import expr_name
 
                     oexpr, odir = order[0][0], order[0][1]
-                    if odir == "desc" and                             expr_name(oexpr) == idef.cols_str[0]:
-                        direction = "backward"
+                    if expr_name(oexpr) == idef.cols_str[0]:
+                        # the scan streams in index order: ASC rides the
+                        # forward iterator, DESC the reverse iterator
+                        order_consumed = True
+                        if odir == "desc":
+                            direction = "backward"
+                detail = {
+                    "plan": {
+                        "direction": direction,
+                        "from": frm,
+                        "index": idef.name,
+                        "to": to,
+                    },
+                    "table": tb,
+                }
+                if order_consumed:
+                    detail["_order_consumed"] = True
+                return {
+                    "detail": detail,
+                    "operation": "Iterate Index",
+                }
+            elif tail is not None and tail[0] == "range" and nmatch and \
+                    not count_only:
+                # composite eq-prefix + range tail: the reference renders
+                # the prefix values and each range bound in cond order
+                # (exe/lookup compound plans)
                 return {
                     "detail": {
                         "plan": {
-                            "direction": direction,
-                            "from": frm,
                             "index": idef.name,
-                            "to": to,
+                            "prefix": vals,
+                            "ranges": [
+                                {"operator": rop, "value": evaluate(rexpr, ctx)}
+                                for rop, rexpr in tail[1]
+                            ],
                         },
                         "table": tb,
                     },
@@ -791,6 +878,38 @@ def explain_plan(tb, cond, ctx, stmt):
                 "operation": "Iterate Index Count" if count_only
                 else "Iterate Index",
             }
+    if cond is None and stmt is not None and with_index != []:
+        # no WHERE, but a single-key ORDER BY over an indexed column:
+        # stream the index in (reverse) order (reference Plan::SingleIndex
+        # with Order/ReverseOrder iterators)
+        order = getattr(stmt, "order", None)
+        if order and order != "rand" and len(order) == 1:
+            from surrealdb_tpu.exec.statements import expr_name
+
+            oexpr, odir = order[0][0], order[0][1]
+            opath = expr_name(oexpr)
+            idxs = get_indexes_for(tb, ctx)
+            if with_index:
+                idxs = [i for i in idxs if i.name in with_index]
+            idef3 = next(
+                (d for d in idxs
+                 if d.cols_str and d.cols_str[0] == opath
+                 and d.hnsw is None and d.fulltext is None and not d.count),
+                None,
+            )
+            if idef3 is not None:
+                return {
+                    "detail": {
+                        "plan": {
+                            "index": idef3.name,
+                            "operator": "ReverseOrder" if odir == "desc"
+                            else "Order",
+                        },
+                        "table": tb,
+                        "_order_consumed": True,
+                    },
+                    "operation": "Iterate Index",
+                }
     base = {
         "detail": {"direction": "forward", "table": tb},
         "operation": "Iterate Table",

@@ -97,6 +97,25 @@ def vector_index_update(idef, rid: RecordId, before, after, ctx):
     ctx.txn.set_val(vkey, ver)
 
 
+def _exact_mxu_distances(metric: str, xs, q):
+    """Exact f64 distances for the device-rankable metrics, shared by the
+    single-query host path and the batched rescore. `xs` is [..., D] and
+    `q` broadcasts against it; reduction is over the last axis. The
+    reference computes distances in f64 regardless of stored type
+    (trees/vector.rs)."""
+    if metric == "euclidean":
+        return np.linalg.norm(xs - q, axis=-1)
+    if metric == "cosine":
+        dots = (xs * q).sum(axis=-1)
+        denom = np.maximum(
+            np.linalg.norm(xs, axis=-1) * np.linalg.norm(q, axis=-1), 1e-300
+        )
+        return 1.0 - dots / denom
+    if metric == "dot":
+        return -(xs * q).sum(axis=-1)
+    raise SdbError(f"unsupported device metric {metric}")
+
+
 class _Coalescer:
     """Self-clocking cross-query dynamic batcher.
 
@@ -443,18 +462,25 @@ class TpuVectorIndex:
                 self.device_rank, qs.reshape(r, chunk, -1), kc, self.metric,
                 self.device_x2, self.device_valid,
             )).reshape(bucket, kc)[:b_total]
+            # exact f64 rescore, vectorized across the whole coalesced
+            # batch (one einsum for all queries, not a per-query loop).
+            # approx_max_k returns real row indices for inf-masked
+            # (tombstoned) rows — refilter against the live mask.
+            cand = np.clip(ids, 0, n - 1)
+            ok = (ids >= 0) & (ids < n) & self.valid[cand]
+            V = self.vecs[cand].astype(np.float64)  # [B, kc, D]
+            Q = qvs.astype(np.float64)
+            d = _exact_mxu_distances(self.metric, V, Q[:, None, :])
+            d = np.where(ok, d, np.inf)
+            order = np.argsort(d, axis=1, kind="stable")[:, :k]
             out = []
             for b in range(b_total):
-                cand = ids[b]
-                # approx_max_k returns real row indices for inf-masked
-                # (tombstoned) rows — refilter against the live mask
-                cand = cand[(cand >= 0) & (cand < n)]
-                cand = cand[self.valid[cand]]
-                d = self._host_distances(qvs[b], self.vecs[cand])
-                order = np.argsort(d, kind="stable")[:k]
-                out.append([
-                    (self.rids[int(cand[i])], float(d[i])) for i in order
-                ])
+                row = []
+                for i in order[b]:
+                    if not np.isfinite(d[b, i]):
+                        break
+                    row.append((self.rids[int(cand[b, i])], float(d[b, i])))
+                out.append(row)
             return out
         if n > BLOCK_ROWS:
             from surrealdb_tpu.ops.topk import knn_search_blocked
@@ -487,15 +513,8 @@ class TpuVectorIndex:
         xs = (self.vecs if xs is None else xs).astype(np.float64)
         qv = np.asarray(qv, dtype=np.float64)
         m = self.metric
-        if m == "euclidean":
-            return np.linalg.norm(xs - qv[None, :], axis=1)
-        if m == "cosine":
-            # 1 - dot/(|x||q|) in f64, matching the reference's rounding
-            dots = xs @ qv
-            denom = np.maximum(
-                np.linalg.norm(xs, axis=1) * np.linalg.norm(qv), 1e-300
-            )
-            return 1.0 - dots / denom
+        if m in ("euclidean", "cosine", "dot"):
+            return _exact_mxu_distances(m, xs, qv[None, :])
         if m == "manhattan":
             return np.abs(xs - qv[None, :]).sum(axis=1)
         if m == "chebyshev":
@@ -517,8 +536,6 @@ class TpuVectorIndex:
             mn = np.minimum(xs, qv[None, :]).sum(axis=1)
             mx = np.maximum(xs, qv[None, :]).sum(axis=1)
             return 1.0 - mn / np.maximum(mx, 1e-30)
-        if m == "dot":
-            return -(xs @ qv)
         raise SdbError(f"unsupported metric {m}")
 
 

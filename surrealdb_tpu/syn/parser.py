@@ -2652,11 +2652,13 @@ class Parser:
             self.expect_op(":")
             beg = self.next().value
             end = None
+            end_incl = False
             if self.at_op("..", "..="):
+                end_incl = self.peek().text == "..="
                 self.next()
                 end = self.next().value
             self.expect_op("|")
-            return Mock(tb, beg, end)
+            return Mock(tb, beg, end, end_incl)
         # closure
         self.next()
         params = []

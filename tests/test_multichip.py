@@ -15,6 +15,10 @@ import numpy as np
 
 def test_dryrun_multichip_subprocess():
     env = dict(os.environ)
+    # never dial the TPU relay from the subprocess (the axon sitecustomize
+    # connects at `import jax` when this is set — hangs if the tunnel is
+    # down, and the CPU mesh is what we're testing anyway)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
