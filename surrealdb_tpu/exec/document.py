@@ -371,7 +371,11 @@ def apply_fields(
             # clause runs (reference doc/field.rs order: default_value.surql)
             if cur is not NONE and fd.kind is not None:
                 try:
-                    cur = coerce(cur, fd.kind)
+                    if path == ["id"] and isinstance(cur, RecordId):
+                        # a definition on `id` constrains the record KEY
+                        coerce(cur.id, fd.kind)
+                    else:
+                        cur = coerce(cur, fd.kind)
                 except SdbError as e:
                     raise SdbError(
                         f"Couldn't coerce value for field `{fd.name_str}` "
@@ -469,7 +473,8 @@ def _check_schemafull(doc, prefix, defined, flex, fields, tb, rid):
         if path not in defined and not _has_descendant(path, defined):
             # literal object kinds cover their keys implicitly
             parent_kind = _field_kind_at(fields, prefix) if prefix else None
-            if parent_kind is not None and parent_kind.name == "literal":
+            if parent_kind is not None and parent_kind.name in (
+                    "literal", "object_literal", "array_literal"):
                 continue
             dotted = ".".join(path)
             raise SdbError(
@@ -643,7 +648,8 @@ def index_update(rid: RecordId, before, after, ctx: Ctx):
                     vals = row[0] if len(row) == 1 else row
                     raise SdbError(
                         f"Database index `{idef.name}` already contains "
-                        f"{render(vals)}, with record `{existing.render()}`"
+                        f"{render(_index_msg_value(vals))}, "
+                        f"with record `{existing.render()}`"
                     )
                 ctx.txn.set_val(k, rid)
         else:
@@ -833,7 +839,8 @@ def _single_index_add(idef, rid, doc, ctx):
                 vals = row[0] if len(row) == 1 else row
                 raise SdbError(
                     f"Database index `{idef.name}` already contains "
-                    f"{render(vals)}, with record `{existing.render()}`"
+                    f"{render(_index_msg_value(vals))}, "
+                    f"with record `{existing.render()}`"
                 )
             ctx.txn.set_val(k, rid)
     else:
@@ -1093,6 +1100,21 @@ def shape_output(output: OutputClause, before, after, rid, ctx: Ctx):
 # ---------------------------------------------------------------------------
 
 
+def _index_msg_value(v):
+    """Uniqueness-violation messages show the value as decoded from the
+    index key, which stores decimals in normalized form (0.0dec → 0dec)."""
+    import decimal as _dec
+
+    if isinstance(v, _dec.Decimal):
+        n = v.normalize()
+        if n.as_tuple().exponent > 0:
+            n = n.quantize(_dec.Decimal(1))
+        return n
+    if isinstance(v, (list, tuple)):
+        return [_index_msg_value(x) for x in v]
+    return v
+
+
 def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
     """Shared store stages: schema, perms, write, edges, indexes, cf, events,
     lives, views, output."""
@@ -1106,12 +1128,19 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
         not isinstance(after.get("in"), RecordId)
         or not isinstance(after.get("out"), RecordId)
     ):
+        expect = "RELATION"
+        if tdef.relation_from:
+            expect += " IN " + " | ".join(tdef.relation_from)
+        if tdef.relation_to:
+            expect += " OUT " + " | ".join(tdef.relation_to)
         raise SdbError(
-            f"Found record: `{rid.render()}` which is a relation, but you are attempting to create a normal record"
+            f"Found record: `{rid.render()}` which is not a relation, "
+            f"but expected a {expect}"
         )
     if tdef.kind == "normal" and edge is not None:
         raise SdbError(
-            f"Found record: `{rid.render()}` which is not a relation, but expected a RELATION"
+            f"Found record: `{rid.render()}` which is a relation, "
+            f"but expected a NORMAL"
         )
     # permissions
     if not ctx.session.is_owner and ctx.session.auth_level not in ("editor",):

@@ -150,6 +150,10 @@ def apply_computed_fields(tb: str, doc, rid, ctx: Ctx):
             c = ctx.with_doc(doc, rid)
             try:
                 v = evaluate(fd.computed, c)
+            except ReturnException as r:
+                # a block body may RETURN its value — that terminates the
+                # computed expression, not the enclosing statement
+                v = r.value
             except SdbError:
                 nxt.append(fd)
                 continue
@@ -165,6 +169,9 @@ def apply_computed_fields(tb: str, doc, rid, ctx: Ctx):
         c = ctx.with_doc(doc, rid)
         try:
             v = evaluate(fd.computed, c)
+        except ReturnException as r:
+            # RETURN ends the computed block, not the enclosing statement
+            v = r.value
         except SdbError:
             # a failing computed expression reads as NULL (reference
             # computed-future semantics)

@@ -1900,9 +1900,21 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 0, (f"Limit [ctx: Db] [{limattr2}]", out_rows_n)
             )
         else:
-            mid_lines.insert(
-                0, (f"SortByKey [ctx: Db] [sort_keys: {keys}]", out_rows_n)
+            # ORDER BY id ASC over a single forward table scan streams in
+            # key order already — the sort is elided (iterator order)
+            id_asc = (
+                len(n.order) == 1
+                and n.order[0][1] != "desc"
+                and expr_name(n.order[0][0]) == "id"
+                and len(scans) == 1
+                and scans[0][0].startswith("TableScan")
+                and "direction: Forward" in scans[0][0]
             )
+            if not id_asc:
+                mid_lines.insert(
+                    0,
+                    (f"SortByKey [ctx: Db] [sort_keys: {keys}]", out_rows_n)
+                )
     if n.limit is not None and n.group is not None:
         lim = int(evaluate(n.limit, ctx))
         root_lines.insert(0, (f"Limit [ctx: Db] [limit: {lim}]", out_rows_n))
@@ -2769,6 +2781,24 @@ def _s_define_field(n: DefineField, ctx):
         ctx.txn.set_val(K.tb_def(ns, db, n.tb), TableDef(name=n.tb))
     name_str = _field_name_str(n.name)
     _check_computed_field(n, name_str, ns, db, ctx)
+    if name_str == "id":
+        # reference define/field.rs validate_id_restrictions
+        for kw, present in (
+            ("VALUE", n.value is not None),
+            ("REFERENCE", getattr(n, "reference", None) is not None),
+            ("DEFAULT", n.default is not None),
+        ):
+            if present:
+                raise SdbError(
+                    f"Cannot use the `{kw}` keyword on the `id` field."
+                )
+        if n.kind is not None and not _id_kind_supported(n.kind):
+            from surrealdb_tpu.exec.coerce import kind_name as _kn
+
+            raise SdbError(
+                f"Cannot use the `{_kn(n.kind)}` type on the `id` field, "
+                f"as that's not a valid record id key."
+            )
     _check_nested_kind(n, name_str, ns, db, ctx)
     kdef = K.fd_def(ns, db, n.tb, name_str)
     if _exists_guard(ctx, kdef, name_str, "field", n.if_not_exists, n.overwrite):
@@ -2789,7 +2819,119 @@ def _s_define_field(n: DefineField, ctx):
         comment=n.comment,
     )
     ctx.txn.set_val(kdef, fd)
+    _process_recursive_definitions(n, fd, ns, db, ctx)
+    # on a relation table, the `in`/`out` field kinds ARE the relation's
+    # endpoint constraint — keep the table def's IN/OUT union in sync so
+    # INFO renders the live constraint (reference derives TYPE RELATION
+    # IN/OUT from the in/out field definitions)
+    if name_str in ("in", "out") and n.kind is not None:
+        td = ctx.txn.get_val(K.tb_def(ns, db, n.tb))
+        if td is not None and td.kind == "relation":
+            tbs = _record_kind_tables(n.kind)
+            if tbs is not None:
+                import copy as _copy
+
+                td = _copy.copy(td)
+                if name_str == "in":
+                    td.relation_from = tbs
+                else:
+                    td.relation_to = tbs
+                ctx.txn.set_val(K.tb_def(ns, db, n.tb), td)
     return NONE
+
+
+def _id_kind_supported(k) -> bool:
+    """Kinds usable as a record-id key (reference record_id/key.rs
+    kind_supported): any/number/int/string/uuid/array/set/object,
+    int/string/array/object literals, and eithers of those."""
+    nm = k.name
+    if nm in ("any", "number", "int", "string", "uuid", "array", "set",
+              "object"):
+        return True
+    if nm in ("array_literal", "object_literal"):
+        return True
+    if nm == "literal":
+        return isinstance(k.literal, (int, str)) and \
+            not isinstance(k.literal, bool)
+    if nm == "either":
+        return all(_id_kind_supported(b) for b in k.inner)
+    return False
+
+
+def _kind_inner_sub(k):
+    """Kind of a container's elements (reference Kind::inner_kind):
+    array/set expose their element kind; eithers union their branches'
+    element kinds (flattened); everything else has no subtype."""
+    from surrealdb_tpu.expr.ast import Kind
+
+    if not isinstance(k, Kind):
+        return None
+    if k.name in ("array", "set"):
+        return k.inner[0] if k.inner else Kind("any")
+    if k.name == "option":
+        # reference models option<T> as none | T — subtypes pass through
+        return _kind_inner_sub(k.inner[0]) if k.inner else None
+    if k.name == "either":
+        subs = [s for s in (_kind_inner_sub(b) for b in k.inner)
+                if s is not None]
+        if not subs:
+            return None
+        flat = []
+        for s in subs:
+            flat.extend(s.inner if s.name == "either" else [s])
+        return flat[0] if len(flat) == 1 else Kind("either", flat)
+    return None
+
+
+def _process_recursive_definitions(n, fd, ns, db, ctx):
+    """DEFINE FIELD f TYPE array<K> implicitly defines f.* TYPE K (and so
+    on down through nested containers); an existing subtype def keeps its
+    other clauses and gets its TYPE replaced. Reference:
+    define/field.rs process_recursive_definitions."""
+    from surrealdb_tpu.expr.ast import Kind, PAll
+
+    cur = _kind_inner_sub(fd.kind)
+    name_parts = list(fd.name)
+    depth = 0
+    while cur is not None and depth < 16:
+        if cur.name == "any":
+            # `array` with no element type already implies `.* TYPE any`
+            break
+        name_parts = name_parts + [PAll()]
+        nstr = _field_name_str(name_parts)
+        key = K.fd_def(ns, db, n.tb, nstr)
+        existing = ctx.txn.get_val(key)
+        if existing is not None:
+            import copy as _copy
+
+            sub = _copy.copy(existing)
+            sub.kind = cur
+        else:
+            sub = FieldDef(name=list(name_parts), name_str=nstr, kind=cur)
+        ctx.txn.set_val(key, sub)
+        cur = _kind_inner_sub(cur)
+        depth += 1
+
+
+def _record_kind_tables(kind):
+    """For record / record<a | b> kinds, the endpoint table list (empty =
+    any record); None when the kind isn't record-shaped."""
+    from surrealdb_tpu.expr.ast import Kind
+
+    if not isinstance(kind, Kind):
+        return None
+    if kind.name == "record":
+        # parser stores record<...> endpoint tables as plain ident strings
+        return [str(t) for t in (kind.inner or [])]
+    if kind.name == "either":
+        out = []
+        for b in kind.inner or []:
+            sub = _record_kind_tables(b)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return out
+    return None
 
 
 def _check_nested_kind(n, name_str, ns, db, ctx):
@@ -3100,6 +3242,12 @@ def _s_define_index(n: DefineIndex, ctx):
                 f"Computed fields cannot be indexed. Index: '{n.name}' - "
                 f"Field: '{head}'"
             )
+    td = ctx.txn.get_val(K.tb_def(ns, db, n.tb))
+    if td is not None and td.full:
+        # SCHEMAFULL: every indexed column must resolve to a defined
+        # field (or a path its parent's kind can contain)
+        for c in cols:
+            _check_index_field_exists(c, n.tb, ns, db, ctx)
     idef = IndexDef(
         name=n.name,
         tb=n.tb,
@@ -3121,6 +3269,46 @@ def _s_define_index(n: DefineIndex, ctx):
         return NONE
     build_index(idef, ctx)
     return NONE
+
+
+def _check_index_field_exists(col, tb, ns, db, ctx):
+    """On SCHEMAFULL tables an index column must name a defined field, or
+    have a defined top-level parent whose kind permits sub-field access
+    (object/any/array/set/object-or-array literals, eithers of those, or
+    no declared type). Reference: define/index.rs + kind.rs
+    allows_sub_fields."""
+    if not isinstance(col, Idiom):
+        return
+    path = expr_name(col)
+    if path == "id":
+        return
+    if ctx.txn.get_val(K.fd_def(ns, db, tb, path)) is not None:
+        return
+    head = col.parts[0] if col.parts else None
+    if isinstance(head, PField):
+        pfd = ctx.txn.get_val(K.fd_def(ns, db, tb, head.name))
+        if pfd is not None and (
+            pfd.kind is None or _kind_allows_sub_fields(pfd.kind)
+        ):
+            return
+    raise SdbError(f"The field '{path}' does not exist")
+
+
+def _kind_allows_sub_fields(k) -> bool:
+    nm = k.name
+    if nm in ("any", "object", "array", "set", "object_literal",
+              "array_literal"):
+        return True
+    if nm == "literal":
+        return isinstance(k.literal, (list, dict))
+    if nm == "option":
+        return all(_kind_allows_sub_fields(b) for b in k.inner) if k.inner \
+            else True
+    if nm == "either":
+        return all(
+            b.name == "none" or _kind_allows_sub_fields(b) for b in k.inner
+        )
+    return False
 
 
 def _spawn_index_build(ds, ns, db, idef):

@@ -181,11 +181,14 @@ def call_custom(name, args, ctx):
     fd = ctx.txn.get_val(K.fc_def(ns, db, name))
     if not isinstance(fd, FunctionDef):
         raise SdbError(f"The function 'fn::{name}' does not exist")
-    # arity: trailing option<> params are optional (reference fnc custom)
+    # arity: trailing option<>/any params are optional (reference fnc
+    # custom: custom_optional_args.surql — a middle optional still makes
+    # every later position mandatory)
     total = len(fd.args)
     required = total
     for _pname, pkind in reversed(fd.args):
-        if pkind is not None and getattr(pkind, "name", None) == "option":
+        if pkind is not None and getattr(pkind, "name", None) in (
+                "option", "any"):
             required -= 1
         else:
             break

@@ -298,7 +298,7 @@ class RecordId:
         return f"RecordId({self.render()})"
 
     def render(self) -> str:
-        return f"{escape_ident(self.tb)}:{render_record_id_key(self.id)}"
+        return f"{escape_rid_table(self.tb)}:{render_record_id_key(self.id)}"
 
 
 class Range:
@@ -810,6 +810,15 @@ RESERVED_IDENTS = {
 
 def escape_ident(s: str) -> str:
     if _IDENT_RX.match(s) and s.upper() not in RESERVED_IDENTS:
+        return s
+    return "`" + s.replace("\\", "\\\\").replace("`", "\\`") + "`"
+
+
+def escape_rid_table(s: str) -> str:
+    """Record-id table rendering (reference EscapeRid): escapes only
+    lexically-invalid idents — keywords stay bare since the `tb:key`
+    position is unambiguous."""
+    if _IDENT_RX.match(s):
         return s
     return "`" + s.replace("\\", "\\\\").replace("`", "\\`") + "`"
 

@@ -33,6 +33,30 @@ class LangTest:
     wip: bool = False
 
 
+_ENGINE_VERSION = (3, 0, 0)
+
+
+def _version_applies(constraint: str) -> bool:
+    """Minimal semver-constraint check against the emulated 3.0.0."""
+    import re as _re2
+
+    for part in constraint.split(","):
+        m = _re2.match(r"\s*(<=|>=|<|>|=|\^)?\s*(\d+)(?:\.(\d+))?(?:\.(\d+))?",
+                       part.strip())
+        if not m:
+            continue
+        op = m.group(1) or "="
+        v = (int(m.group(2)), int(m.group(3) or 0), int(m.group(4) or 0))
+        cur = _ENGINE_VERSION
+        ok = {
+            "<": cur < v, "<=": cur <= v, ">": cur > v, ">=": cur >= v,
+            "=": cur == v, "^": cur >= v and cur[0] == v[0],
+        }[op]
+        if not ok:
+            return False
+    return True
+
+
 def parse_test_file(path: str) -> LangTest:
     with open(path, encoding="utf-8") as f:
         text = f.read()
@@ -48,6 +72,11 @@ def parse_test_file(path: str) -> LangTest:
     t = LangTest(path=path, sql=text, config=config)
     t.run = test.get("run", True)
     t.wip = test.get("wip", False)
+    # version-gated tests (e.g. version = "<3.0.0") don't apply to the
+    # 3.x behavior this engine mirrors
+    ver = test.get("version")
+    if isinstance(ver, str) and not _version_applies(ver):
+        t.run = False
     results = test.get("results", [])
     if isinstance(results, dict):
         results = [results]
