@@ -350,18 +350,37 @@ def apply_fields(
             defined_top.add(path[0])
         if fd.computed is not None:
             continue  # computed fields are read-time only (doc/compute.rs)
+        targets = []
         for tgt_doc, old_doc in _field_targets(after, before, path[:-1]):
             last = path[-1]
             if last == "*":
-                continue
-            if not isinstance(tgt_doc, dict):
-                continue
-            cur = tgt_doc.get(last, NONE)
-            old = (
-                old_doc.get(last, NONE)
-                if isinstance(old_doc, dict)
-                else NONE
-            )
+                # a trailing `*` applies the definition to every child:
+                # object values for dicts, elements for arrays
+                if isinstance(tgt_doc, dict):
+                    targets.extend(
+                        (tgt_doc, old_doc, kk) for kk in list(tgt_doc)
+                    )
+                elif isinstance(tgt_doc, list):
+                    targets.extend(
+                        (tgt_doc, old_doc, i) for i in range(len(tgt_doc))
+                    )
+            elif isinstance(tgt_doc, dict):
+                targets.append((tgt_doc, old_doc, last))
+        for tgt_doc, old_doc, last in targets:
+            if isinstance(last, int):
+                cur = tgt_doc[last] if last < len(tgt_doc) else NONE
+                old = (
+                    old_doc[last]
+                    if isinstance(old_doc, list) and last < len(old_doc)
+                    else NONE
+                )
+            else:
+                cur = tgt_doc.get(last, NONE)
+                old = (
+                    old_doc.get(last, NONE)
+                    if isinstance(old_doc, dict)
+                    else NONE
+                )
             c = ctx.with_doc(after, rid)
             c.vars["input"] = cur
             c.vars["value"] = cur
@@ -426,7 +445,7 @@ def apply_fields(
                     raise SdbError(
                         f"Found {render(cur)} for field `{fd.name_str}`, with record `{rid.render()}`, but field must conform to: {_expr_sql(fd.assert_)}"
                     )
-            if cur is NONE:
+            if cur is NONE and isinstance(tgt_doc, dict):
                 tgt_doc.pop(last, None)
             else:
                 tgt_doc[last] = cur
@@ -471,10 +490,19 @@ def _check_schemafull(doc, prefix, defined, flex, fields, tb, rid):
         if _covered(path, flex):
             continue
         if path not in defined and not _has_descendant(path, defined):
-            # literal object kinds cover their keys implicitly
-            parent_kind = _field_kind_at(fields, prefix) if prefix else None
-            if parent_kind is not None and parent_kind.name in (
-                    "literal", "object_literal", "array_literal"):
+            # literal kinds cover their sub-paths implicitly — the nearest
+            # ANCESTOR with a declared kind decides (tuple literals like
+            # [int, { k: int }] never get implicit .* defs, so the check
+            # must look past undefined intermediate segments)
+            lit_covered = False
+            for j in range(len(path) - 1, 0, -1):
+                anc_kind = _field_kind_at(fields, path[:j])
+                if anc_kind is not None:
+                    lit_covered = anc_kind.name in (
+                        "literal", "object_literal", "array_literal"
+                    )
+                    break
+            if lit_covered:
                 continue
             dotted = ".".join(path)
             raise SdbError(

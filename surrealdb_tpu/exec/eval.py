@@ -579,6 +579,11 @@ def walk(val, parts, ctx: Ctx, depth=0):
         part = parts[i]
         t = type(part)
         if t is PField:
+            if part.name == "@":
+                raise SdbError(
+                    "Tried to use a `@` repeat recurse symbol in a "
+                    "position where it is not supported"
+                )
             if isinstance(val, list):
                 fanned = True
             val = _apply_field(val, part.name, ctx)
@@ -751,21 +756,25 @@ def _apply_method(val, part, ctx):
         if isinstance(val, Closure):
             return call_closure(val, args, ctx)
         raise SdbError(f"{type(val).__name__} is not a function")
-    # field holding a closure?
-    if isinstance(val, dict):
-        f = val.get(part.name)
-        if isinstance(f, Closure):
-            args = [evaluate(a, ctx) for a in part.args]
-            return call_closure(f, args, ctx)
-    if isinstance(val, RecordId):
-        doc = fetch_record(ctx, val)
-        if isinstance(doc, dict):
-            f = doc.get(part.name)
-            if isinstance(f, Closure):
-                args = [evaluate(a, ctx) for a in part.args]
-                return call_closure(f, args, ctx)
+    # field holding a closure? (built-in idiom methods take priority:
+    # `$obj.keys()` is object::keys even when `keys` is a closure field)
     args = [evaluate(a, ctx) for a in part.args]
-    return method_call(val, part.name, args, ctx)
+    try:
+        return method_call(val, part.name, args, ctx)
+    except SdbError as builtin_err:
+        if not str(builtin_err).startswith("The method '"):
+            raise  # the builtin exists but failed — report that
+        if isinstance(val, dict):
+            f = val.get(part.name)
+            if isinstance(f, Closure):
+                return call_closure(f, args, ctx)
+        if isinstance(val, RecordId):
+            doc = fetch_record(ctx, val)
+            if isinstance(doc, dict):
+                f = doc.get(part.name)
+                if isinstance(f, Closure):
+                    return call_closure(f, args, ctx)
+        raise builtin_err
 
 
 def _csr_pair_pattern(g1, g2):
@@ -887,22 +896,65 @@ def _collect_rids(val, ctx):
     return out
 
 
+def _at_marker_index(sub):
+    """Index of the `@` repeat marker in a destructure field idiom (parts
+    after it post-process the recursion result, e.g. `.chain(...)`)."""
+    if not isinstance(sub, Idiom):
+        return None
+    for j, p in enumerate(sub.parts):
+        if isinstance(p, PField) and p.name == "@":
+            return j
+    return None
+
+
+def _rec_inner_destructure(sub):
+    """(prefix_parts, inner PDestructure, post_parts) when `sub` routes
+    through a nested destructure that itself contains a recursion marker;
+    `post_parts` (e.g. a trailing projection) apply to the result."""
+    if not isinstance(sub, Idiom):
+        return None
+    for i, p in enumerate(sub.parts):
+        if isinstance(p, PDestructure) and _destructure_has_rec(p):
+            prefix = []
+            for q in sub.parts[:i]:
+                if isinstance(q, tuple) and len(q) == 2 and \
+                        q[0] == "start" and isinstance(q[1], Idiom):
+                    prefix.extend(q[1].parts)
+                elif not isinstance(q, tuple):
+                    prefix.append(q)
+            return prefix, p, list(sub.parts[i + 1:])
+    return None
+
+
 def _destructure_has_rec(dez: PDestructure) -> bool:
     for _name, sub in dez.fields:
-        if isinstance(sub, Idiom) and sub.parts and isinstance(
-            sub.parts[-1], PField
-        ) and sub.parts[-1].name == "@":
+        if _at_marker_index(sub) is not None:
             return True
+        if isinstance(sub, Idiom):
+            for p in sub.parts:
+                if isinstance(p, PDestructure) and _destructure_has_rec(p):
+                    return True
     return False
 
 
-def _recursive_destructure(val, dez: PDestructure, rmin, rmax, ctx, depth=0):
+_REC_ELIM = object()  # path-elimination marker: subtree can't reach rmax
+
+
+def _recursive_destructure(val, dez: PDestructure, rmin, rmax, ctx, depth=0,
+                           outer=None):
+    """`@`-marked destructure recursion; `outer` is the full plan the `@`
+    repeats (nested destructures re-enter it at the marker without
+    consuming a depth level). Branches that dead-end before the final
+    depth are eliminated — `a:1.{3}` drops links that stop at depth 2
+    (reference exec/operators/recursion.rs path elimination)."""
+    outer = outer if outer is not None else dez
     if isinstance(val, list):
-        return [
-            _recursive_destructure(x, dez, rmin, rmax, ctx, depth)
+        subs = [
+            _recursive_destructure(x, dez, rmin, rmax, ctx, depth, outer)
             for x in val
             if x is not NONE and x is not None
         ]
+        return [s for s in subs if s is not _REC_ELIM]
     node = val
     doc = fetch_record(ctx, node) if isinstance(node, RecordId) else node
     if not isinstance(doc, dict):
@@ -912,36 +964,54 @@ def _recursive_destructure(val, dez: PDestructure, rmin, rmax, ctx, depth=0):
         if sub is None:
             out[name] = doc.get(name, NONE)
             continue
-        is_rec = (
-            isinstance(sub, Idiom)
-            and sub.parts
-            and isinstance(sub.parts[-1], PField)
-            and sub.parts[-1].name == "@"
-        )
-        if not is_rec:
+        nested = _rec_inner_destructure(sub)
+        if nested is not None:
+            prefix, inner, post = nested
+            raw = walk(doc, prefix, ctx) if prefix else doc
+            v = _recursive_destructure(
+                raw, inner, rmin, rmax, ctx, depth, outer
+            )
+            if v is _REC_ELIM:
+                return _REC_ELIM
+            out[name] = walk(v, post, ctx) if post else v
+            continue
+        at_j = _at_marker_index(sub)
+        if at_j is None:
             c = ctx.with_doc(doc, node if isinstance(node, RecordId) else None)
             out[name] = evaluate(sub, c)
             continue
-        prefix = [p for p in sub.parts[:-1] if not isinstance(p, tuple)]
+        post_at = list(sub.parts[at_j + 1:])
+        prefix = [p for p in sub.parts[:at_j] if not isinstance(p, tuple)]
         raw = walk(node if isinstance(node, RecordId) else doc, prefix, ctx)
-        # the dead-end value keeps the step's own shape: a missing record
-        # link stays NONE, an empty graph step stays [] (reference
-        # recursive-destructure semantics)
+        # a dead end keeps the step's own shape at the FINAL depth (NONE
+        # link / empty graph step); before it, the branch is eliminated
+        def _post(v):
+            return walk(v, list(post_at), ctx) if post_at else v
+
         if raw is NONE or raw is None:
-            out[name] = NONE
+            if depth + 1 < rmin:
+                return _REC_ELIM
+            out[name] = _post(NONE)
             continue
         children = raw if isinstance(raw, list) else [raw]
         children = [c for c in children if c is not NONE and c is not None]
         if not children:
-            out[name] = [] if isinstance(raw, list) else NONE
+            if depth + 1 < rmin:
+                return _REC_ELIM
+            out[name] = _post([] if isinstance(raw, list) else NONE)
         elif depth + 1 >= rmax:
             # the depth bound emits the raw frontier ids
-            out[name] = children
+            out[name] = _post(children)
         else:
-            out[name] = [
-                _recursive_destructure(ch, dez, rmin, rmax, ctx, depth + 1)
+            subs = [
+                _recursive_destructure(ch, outer, rmin, rmax, ctx, depth + 1,
+                                       outer)
                 for ch in children
             ]
+            subs = [s for s in subs if s is not _REC_ELIM]
+            if not subs:
+                return _REC_ELIM
+            out[name] = _post(subs)
     return out
 
 
@@ -1008,12 +1078,46 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
     # recursive destructure: `.{..}.{ name, sub: ->x->y.@ }` — the @ marks
     # where the destructure repeats, building a nested tree
     if (
-        mode is None
-        and len(parts) == 1
+        len(parts) == 1
         and isinstance(parts[0], PDestructure)
         and _destructure_has_rec(parts[0])
     ):
-        return _recursive_destructure(val, parts[0], rmin, rmax, ctx)
+        if mode is not None:
+            raise SdbError(
+                "Cannot construct a recursion plan when an instruction "
+                "is provided"
+            )
+        res = _recursive_destructure(val, parts[0], rmin, rmax, ctx)
+        return NONE if res is _REC_ELIM else res
+    # a bare trailing `@` repeats the preceding path: `.{n}.contains.@`
+    # ≡ `.{n}(.contains)`; parts after the marker apply to the final value
+    at_idx = next(
+        (j for j, p in enumerate(parts)
+         if isinstance(p, PField) and p.name == "@"),
+        None,
+    )
+    post_at = None
+    if at_idx is not None:
+        if mode is not None:
+            raise SdbError(
+                "Cannot construct a recursion plan when an instruction "
+                "is provided"
+            )
+        post_at = list(parts[at_idx + 1:])
+        parts = list(parts[:at_idx])
+        if not parts:
+            raise SdbError(
+                "Tried to use a `@` repeat recurse symbol in a position "
+                "where it is not supported"
+            )
+
+        def _post(v):
+            return walk(v, post_at, ctx) if post_at else v
+
+        inner = PRecurse(
+            min=part.min, max=part.max, parts=parts, instruction=None
+        )
+        return _post(_apply_recurse(val, inner, [], ctx))
 
     def step(node):
         out = walk(node, parts, ctx)
@@ -1168,8 +1272,6 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
     depth = 0
     while depth < rmax:
         ctx.check_deadline()
-        if hard_limit and depth >= 256:
-            raise SdbError("Exceeded the idiom recursion limit of 256.")
         nxt = clean(walk(current, list(parts), ctx))
         depth += 1
         final = nxt is NONE or nxt is None or (
@@ -1182,6 +1284,9 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
                 return current
             return nxt
         current = nxt
+    if hard_limit:
+        # an open-ended `{n..}` that never dead-ended within 256 levels
+        raise SdbError("Exceeded the idiom recursion limit of 256.")
     if depth >= rmin:
         return current
     return NONE

@@ -881,6 +881,20 @@ def _nextval(args, ctx):
 # -- value / search / http stubs ---------------------------------------------
 
 
+@register("value::chain")
+def _vchain(args, ctx):
+    # value.chain(|$v| ...) — apply a closure to any value (fnc/value.rs)
+    from surrealdb_tpu.exec.eval import call_closure
+    from surrealdb_tpu.val import Closure
+
+    if len(args) != 2 or not isinstance(args[1], Closure):
+        raise SdbError(
+            "Incorrect arguments for function value::chain(). "
+            "Expected a closure"
+        )
+    return call_closure(args[1], [args[0]], ctx)
+
+
 @register("value::diff")
 def _vdiff(args, ctx):
     from surrealdb_tpu.utils.patch import diff

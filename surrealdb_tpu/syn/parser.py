@@ -2353,6 +2353,14 @@ class Parser:
                         )
                     # restricted: `a:5+inclusive` must not parse as addition
                     target = self._parse_unary()
+                    from surrealdb_tpu.expr.ast import (
+                        Param as _Pm, RecordIdLit as _RL,
+                    )
+
+                    if not isinstance(target, (_Pm, _RL)):
+                        raise self.err(
+                            "shortest target must be a record id or param"
+                        )
                 elif nm == "shortest":
                     raise self.err("shortest requires a =target")
             if names:
