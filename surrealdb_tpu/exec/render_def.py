@@ -23,6 +23,7 @@ def _expr_sql(node) -> str:
         IfElse,
         Knn,
         Literal,
+        Matches,
         Mock,
         ObjectExpr,
         Param,
@@ -56,6 +57,9 @@ def _expr_sql(node) -> str:
         return f"{node.op}{_expr_sql(node.expr)}"
     if isinstance(node, RegexLit):
         return f"/{node.pattern}/"
+    if isinstance(node, Matches):
+        op = f"@{node.ref}@" if node.ref is not None else "@@"
+        return f"{_expr_sql(node.lhs)} {op} {_expr_sql(node.rhs)}"
     if isinstance(node, Knn):
         if node.ef is not None:
             return f"{_expr_sql(node.lhs)} <|{node.k},{node.ef}|> {_expr_sql(node.rhs)}"

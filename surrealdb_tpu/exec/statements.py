@@ -1423,16 +1423,97 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
             scans.append((label, rows))
             total_scan_rows += rows
             continue
+        # a MATCHES candidate scores 800 (exec/index/analysis.rs:1281):
+        # it loses to a unique full-equality access (1000) but beats
+        # non-unique eq (500) and ranges — defer the choice until the
+        # eq/range candidates are scored below
         mts = _find_matches(n.cond) if n.cond is not None and not noindex else []
+        ft_cand = None
         if mts:
             mt = mts[0]
             idef = next((d for d in indexes if d.fulltext is not None), None)
             if idef is not None:
-                q = evaluate(mt.rhs, ctx)
-                label = f"FullTextScan [ctx: Db] [index: {idef.name}, query: {q}]"
-                residual = _remove_node(residual, mt)
+                ft_cand = (mt, idef)
         if label is None and n.cond is not None and not noindex:
-            from surrealdb_tpu.idx.planner import _array_like_paths
+            from surrealdb_tpu.idx.planner import (
+                _array_like_paths,
+                _ft_branch_scan,
+                or_union_branches,
+                union_branch_scan,
+            )
+
+            orb = or_union_branches(
+                tb, n.cond, indexes, ctx, value_idioms=False
+            )
+            if orb is not None:
+                from surrealdb_tpu.val import hashable
+
+                branch_lines = []
+                seen_u = set()
+                for br in orb:
+                    brows = 0
+                    if br["kind"] == "ft":
+                        q = evaluate(br["mt"].rhs, ctx)
+                        bl = (
+                            f"FullTextScan [ctx: Db] "
+                            f"[index: {br['idef'].name}, query: {q}]"
+                        )
+                    elif br["kind"] == "range":
+                        acc = " ".join(
+                            f"{op}{render(evaluate(vx, ctx))}"
+                            for op, vx in sorted(
+                                br["tail"][1],
+                                key=lambda t: t[0] in ("<", "<="),
+                            )
+                        )
+                        bl = (
+                            f"IndexScan [ctx: Db] [index: {br['idef'].name}, "
+                            f"access: {acc}, direction: Forward]"
+                        )
+                    elif br["kind"] == "in":
+                        iv = evaluate(br["tail"][1], ctx)
+                        iv = iv if isinstance(iv, list) else [iv]
+                        acc = (
+                            f"= {render(iv[0])}" if len(iv) == 1
+                            else f"IN {render(iv)}"
+                        )
+                        bl = (
+                            f"IndexScan [ctx: Db] [index: {br['idef'].name}, "
+                            f"access: {acc}, direction: Forward]"
+                        )
+                    else:
+                        idef_b = br["idef"]
+                        eq_vals = [
+                            evaluate(br["eqs"][c], ctx)
+                            for c in idef_b.cols_str[:br["nmatch"]]
+                        ]
+                        acc = (
+                            f"= {render(eq_vals[0])}"
+                            if len(eq_vals) == 1 and br["tail"] is None
+                            and len(idef_b.cols_str) == 1
+                            else "[" + ", ".join(
+                                render(x) for x in eq_vals) + "]"
+                        )
+                        bl = (
+                            f"IndexScan [ctx: Db] [index: {idef_b.name}, "
+                            f"access: {acc}, direction: Forward]"
+                        )
+                    if analyze:
+                        srcs = list(union_branch_scan(tb, br, ctx.child()))
+                        brows = len(srcs)
+                        for s in srcs:
+                            if s.rid is not None:
+                                seen_u.add(hashable(s.rid))
+                    branch_lines.append((bl, brows))
+                urows = len(seen_u) if analyze else 0
+                scans.append((
+                    f"UnionIndexScan [ctx: Db] [table: {tb}, "
+                    f"branches: {len(orb)}]",
+                    urows, branch_lines,
+                ))
+                total_scan_rows += urows
+                residual = n.cond
+                continue
 
             eqs, ins, rngs = _classify_preds(
                 n.cond, _array_like_paths(tb, ctx), value_idioms=False
@@ -1442,7 +1523,7 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
             ) else None
             union_branches = None
             if chosen is not None:
-                idef, nmatch, tail = chosen
+                idef, nmatch, tail, chosen_score = chosen
                 if tail is not None and tail[0] == "in" and nmatch == 0:
                     iv = evaluate(tail[1], ctx)
                     iv = iv if isinstance(iv, list) else [iv]
@@ -1452,6 +1533,28 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                         chosen = None
                     else:
                         union_branches = (idef, iv)
+            if ft_cand is not None and (
+                chosen is None or chosen[3] <= 800
+            ):
+                # the MATCHES access (800) outranks everything but a
+                # unique full-equality candidate
+                mt, idef_ft = ft_cand
+                q = evaluate(mt.rhs, ctx)
+                label = (
+                    f"FullTextScan [ctx: Db] [index: {idef_ft.name}, "
+                    f"query: {q}]"
+                )
+                residual = _remove_node(residual, mt)
+                # the scan line reports the raw full-text hit count; the
+                # residual Filter above it shows the post-filter rows
+                rows = 0
+                if analyze:
+                    rows = len(list(_ft_branch_scan(
+                        tb, {"mt": mt, "idef": idef_ft}, ctx.child()
+                    )))
+                scans.append((label, rows))
+                total_scan_rows += rows
+                continue
             if union_branches is not None and len(union_branches[1]) == 1:
                 idef, iv = union_branches
                 bv = iv[0]
@@ -1574,6 +1677,16 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                         if odir == "desc":
                             direction = "Backward"
                         n = _strip_order(n)
+                if (
+                    idef.unique
+                    and nmatch == len(idef.cols_str)
+                    and tail is None
+                    and n.order
+                    and n.order != "rand"
+                ):
+                    # a UNIQUE full-equality access yields at most one row:
+                    # the streaming planner elides the sort entirely
+                    n = _strip_order(n)
                 limattr = ""
                 if (
                     n.limit is not None
@@ -2194,7 +2307,15 @@ def _explain_select(n: SelectStmt, ctx):
         count = 0
         for expr in n.what:
             v = _target_value(expr, ctx)
-            for _src in _iterate_value(v, ctx, n.cond, n):
+            cctx = ctx.child()
+            for src in _iterate_value(v, cctx, n.cond, n):
+                # the fetch stage counts rows that reach the collector:
+                # post-WHERE (scan access paths may over-approximate)
+                if n.cond is not None and not cctx._cond_consumed:
+                    doc = src.doc if src.rid is not None else src.value
+                    cc = cctx.with_doc(doc, src.rid)
+                    if not is_truthy(evaluate(n.cond, cc)):
+                        continue
                 count += 1
         if n.start is not None:
             count = max(count - int(evaluate(n.start, ctx)), 0)

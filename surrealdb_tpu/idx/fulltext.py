@@ -390,16 +390,38 @@ def matches_operator(n, ctx):
     """Row-wise matches evaluation (post-planner membership, or ad-hoc)."""
     ft_ctx = ctx.vars.get("__ft__")
     ref = n.ref if n.ref is not None else 0
-    if ft_ctx is not None and ref in ft_ctx and ctx.doc_id is not None:
-        return hashable(ctx.doc_id) in ft_ctx[ref]["scores"]
-    # ad-hoc: analyze both sides with the default analyzer
+    if ft_ctx is not None and ctx.doc_id is not None:
+        # node-keyed entries disambiguate OR-union branches that share
+        # the default ref (planner _ft_branch_scan)
+        entry = ft_ctx.get(("node", id(n))) or ft_ctx.get(ref)
+        if entry is not None:
+            return hashable(ctx.doc_id) in entry["scores"]
+    # ad-hoc: analyze both sides — with the field's full-text analyzer
+    # when one is defined (so an index access path that outranked the
+    # MATCHES keeps the index's stemming/ngram semantics in the filter),
+    # else the default blank+lowercase analyzer
     from surrealdb_tpu.exec.eval import evaluate
 
     lhs = evaluate(n.lhs, ctx)
     rhs = evaluate(n.rhs, ctx)
     if not isinstance(lhs, str) or not isinstance(rhs, str):
         return False
-    az = AnalyzerDef("like", ["blank"], [("lowercase",)])
+    az = None
+    if ctx.doc_id is not None:
+        from surrealdb_tpu.idx.planner import _field_path, get_indexes_for
+
+        path = _field_path(n.lhs)
+        try:
+            for d in get_indexes_for(ctx.doc_id.tb, ctx):
+                if d.fulltext is not None and d.cols_str and (
+                    path is None or d.cols_str[0] == path
+                ):
+                    az = get_analyzer(d.fulltext.get("analyzer"), ctx)
+                    break
+        except Exception:
+            az = None
+    if az is None:
+        az = AnalyzerDef("like", ["blank"], [("lowercase",)])
     doc_terms = {tok[0] for tok in analyze(az, lhs)}
     q_terms = {tok[0] for tok in analyze(az, rhs)}
     if not q_terms:

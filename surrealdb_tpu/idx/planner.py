@@ -85,6 +85,196 @@ def _find_matches(cond):
     return out
 
 
+def _split_ors(cond, out):
+    if isinstance(cond, Binary) and cond.op == "||":
+        _split_ors(cond.lhs, out)
+        _split_ors(cond.rhs, out)
+    else:
+        out.append(cond)
+
+
+def _ft_index_for(d, indexes):
+    path = _field_path(d.lhs)
+    return next(
+        (x for x in indexes
+         if x.fulltext is not None and x.cols_str
+         and (path is None or x.cols_str[0] == path)),
+        None,
+    )
+
+
+def or_union_branches(tb, cond, indexes, ctx, value_idioms=True):
+    """Streaming multi-index OR (reference UnionIndexScan): when the WHERE
+    tree is a top-level OR and EVERY disjunct is servable by ONE index
+    access (eq/IN/range on an indexed column, or a full-text MATCHES),
+    return per-branch descriptors in cond order; else None — e.g. when
+    WITH INDEX excludes a branch's index, the whole query falls back to
+    a table scan."""
+    from surrealdb_tpu.expr.ast import Matches
+
+    if not (isinstance(cond, Binary) and cond.op == "||"):
+        return None
+    disj = []
+    _split_ors(cond, disj)
+    if len(disj) < 2:
+        return None
+    array_paths = _array_like_paths(tb, ctx)
+    branches = []
+    for d in disj:
+        if isinstance(d, Matches):
+            idef = _ft_index_for(d, indexes)
+            if idef is None:
+                return None
+            branches.append({"kind": "ft", "idef": idef, "mt": d})
+            continue
+        eqs, ins, rngs = _classify_preds(d, array_paths, value_idioms)
+        if not eqs and not ins and not rngs:
+            return None
+        chosen = _choose_index(indexes, eqs, ins, rngs)
+        if chosen is None:
+            return None
+        idef, nmatch, tail, _score = chosen
+        if tail is not None and tail[0] == "range" and nmatch == 0:
+            branches.append({"kind": "range", "idef": idef, "tail": tail})
+        elif tail is not None and tail[0] == "in" and nmatch == 0:
+            branches.append({"kind": "in", "idef": idef, "tail": tail})
+        else:
+            branches.append({
+                "kind": "idx", "idef": idef, "nmatch": nmatch,
+                "tail": tail, "eqs": eqs,
+            })
+    return branches
+
+
+def multi_index_leaves(tb, cond, indexes, ctx, value_idioms=True):
+    """Legacy multi-index analysis (reference tree.rs leaf walk +
+    Plan::MultiIndex, plan.rs:164-177): when the WHERE tree contains at
+    least one OR and EVERY leaf predicate is servable by an index access,
+    return one branch per leaf — non-range leaves first (DFS cond order),
+    then range leaves grouped by index (plan.rs renders
+    `non_range_indexes` then `ranges`); else None."""
+    from surrealdb_tpu.expr.ast import Matches
+
+    leaves = []
+    saw_or = [False]
+
+    def walk(node):
+        if isinstance(node, Binary) and node.op in ("&&", "||"):
+            if node.op == "||":
+                saw_or[0] = True
+            return walk(node.lhs) and walk(node.rhs)
+        leaves.append(node)
+        return True
+
+    if not walk(cond) or not saw_or[0] or len(leaves) < 2:
+        return None
+    array_paths = _array_like_paths(tb, ctx)
+    non_range = []
+    ranges = []
+    for leaf in leaves:
+        if isinstance(leaf, Matches):
+            idef = _ft_index_for(leaf, indexes)
+            if idef is None:
+                return None
+            non_range.append({"kind": "ft", "idef": idef, "mt": leaf})
+            continue
+        eqs, ins, rngs = _classify_preds(leaf, array_paths, value_idioms)
+        if len(eqs) + len(ins) + len(rngs) != 1:
+            return None
+        chosen = _choose_index(indexes, eqs, ins, rngs)
+        if chosen is None:
+            return None
+        idef, nmatch, tail, _score = chosen
+        if tail is not None and tail[0] == "range" and nmatch == 0:
+            ranges.append({"kind": "range", "idef": idef, "tail": tail})
+        elif tail is not None and tail[0] == "in" and nmatch == 0:
+            non_range.append({"kind": "in", "idef": idef, "tail": tail})
+        elif nmatch and tail is None:
+            non_range.append({
+                "kind": "idx", "idef": idef, "nmatch": nmatch,
+                "tail": None, "eqs": eqs,
+            })
+        else:
+            return None
+    # ranges grouped by index in first-seen order, leaf order within
+    seen_ix = []
+    for br in ranges:
+        if br["idef"].name not in seen_ix:
+            seen_ix.append(br["idef"].name)
+    ranges.sort(key=lambda br: seen_ix.index(br["idef"].name))
+    return non_range + ranges
+
+
+def _ft_branch_scan(tb, br, ctx):
+    """One full-text branch of a multi-index union: run the search,
+    publish the score/offset context (so the re-applied OR filter's
+    MATCHES evaluates by membership), and yield the hits."""
+    from surrealdb_tpu.exec.eval import evaluate, fetch_record
+    from surrealdb_tpu.exec.statements import Source
+    from surrealdb_tpu.idx.fulltext import ft_search
+
+    mt = br["mt"]
+    idef = br["idef"]
+    q = evaluate(mt.rhs, ctx)
+    hits, offsets = ft_search(idef, str(q), ctx, boolean=mt.boolean)
+    ft_ctx = dict(ctx.vars.get("__ft__") or {})
+    ctx.vars["__ft__"] = ft_ctx
+    ref = mt.ref if mt.ref is not None else 0
+    entry = {
+        "scores": {hashable(r): s for r, s in hits},
+        "offsets": offsets,
+        "idef": idef,
+        "query": str(q),
+    }
+    ft_ctx[ref] = entry
+    # per-node key: two OR branches may share the default ref 0 (the AND
+    # path rejects that as a duplicate, fulltext.py plan_matches); the
+    # re-applied filter's membership check must not see the other
+    # branch's hits, so matches_operator prefers this node-keyed entry
+    ft_ctx[("node", id(mt))] = entry
+    for rid, _s in hits:
+        doc = fetch_record(ctx, rid)
+        if doc is NONE:
+            continue
+        yield Source(rid=rid, doc=doc)
+
+
+def union_branch_scan(tb, br, ctx):
+    """Execute ONE multi-index union branch — the single dispatch point
+    shared by _union_scan and the streaming explain's row counting, so
+    explain output can't drift from what actually runs."""
+    from surrealdb_tpu.exec.eval import evaluate
+
+    if br["kind"] == "ft":
+        return _ft_branch_scan(tb, br, ctx)
+    if br["kind"] in ("range", "in"):
+        return _index_scan(tb, br["idef"], [], br["tail"], ctx)
+    idef = br["idef"]
+    eq_vals = [
+        evaluate(br["eqs"][c], ctx) for c in idef.cols_str[:br["nmatch"]]
+    ]
+    return _index_scan(tb, idef, eq_vals, br["tail"], ctx)
+
+
+def _union_scan(tb, branches, ctx):
+    """Concatenate per-branch index scans, deduping by record id. The
+    SELECT loop re-applies the full OR cond (cond NOT consumed), so each
+    branch may safely over-approximate its disjunct."""
+
+    def gen():
+        seen = set()
+        for br in branches:
+            for src in union_branch_scan(tb, br, ctx):
+                h = hashable(src.rid) if src.rid is not None else None
+                if h is not None and h in seen:
+                    continue
+                if h is not None:
+                    seen.add(h)
+                yield src
+
+    return gen()
+
+
 def _remove_node(cond, node):
     """Drop `node` from an AND-tree; returns remaining cond or None."""
     if cond is node:
@@ -246,11 +436,19 @@ def _choose_index(indexes, eqs, ins, rngs, model="streaming"):
         elif nmatch == len(cols) and tail is None and len(cols) == 1:
             key = (1000 if idef.unique else 500, -1, pos)
         elif tail is not None and tail[0] == "in" and nmatch == 0:
-            # IN-expansion union is a FALLBACK path in the streaming
-            # planner (analysis.rs try_in_expansion): it only applies when
-            # no eq/range candidate exists, and prefers the narrowest
-            # index whose FIRST column is the IN column
-            key = (10, -len(cols), pos)
+            from surrealdb_tpu.expr.ast import ArrayExpr as _AE
+
+            if isinstance(tail[1], _AE) and len(tail[1].items) == 1:
+                # `x IN [v]` collapses to an equality access and scores
+                # like one (the streaming planner's single-value
+                # rewrite) — beats a range candidate on another column
+                key = (1000 if idef.unique else 500, -len(cols), pos)
+            else:
+                # IN-expansion union is a FALLBACK path in the streaming
+                # planner (analysis.rs try_in_expansion): it only applies
+                # when no eq/range candidate exists, and prefers the
+                # narrowest index whose FIRST column is the IN column
+                key = (10, -len(cols), pos)
         elif nmatch:
             # compound access: prefix of equalities, optionally narrowed
             # by a range on the next column (IN tails are NOT pushed by
@@ -268,7 +466,7 @@ def _choose_index(indexes, eqs, ins, rngs, model="streaming"):
             best = (key, idef, nmatch, tail)
     if best is None:
         return None
-    return best[1], best[2], best[3]
+    return best[1], best[2], best[3], best[0][0]
 
 
 def plan_scan(tb: str, cond, ctx, stmt):
@@ -295,12 +493,37 @@ def plan_scan(tb: str, cond, ctx, stmt):
     if with_index == []:
         return None
 
+    # ---- multi-index OR (Plan::MultiIndex / UnionIndexScan) ---------------
+    # the access shape must match the engine being run: the streaming
+    # planner unions ONE access per top-level disjunct, the legacy tree
+    # planner unions EVERY indexable leaf (plan.rs Plan::MultiIndex)
+    if getattr(ctx.session, "planner_strategy", None) == "all-ro":
+        union = or_union_branches(tb, cond, indexes, ctx, value_idioms=False)
+    else:
+        union = multi_index_leaves(tb, cond, indexes, ctx)
+    if union is not None:
+        return _union_scan(tb, union, ctx)
+
     # ---- MATCHES ----------------------------------------------------------
     mts = _find_matches(cond)
     if mts:
-        from surrealdb_tpu.idx.fulltext import plan_matches
+        use_ft = True
+        if getattr(ctx.session, "planner_strategy", None) == "all-ro":
+            # the streaming planner scores the MATCHES access at 800
+            # (exec/index/analysis.rs:1281): a unique full-equality
+            # candidate outranks it and the MATCHES drops to the filter
+            eqs0, ins0, rngs0 = _classify_preds(
+                cond, _array_like_paths(tb, ctx), value_idioms=False
+            )
+            ch0 = _choose_index(indexes, eqs0, ins0, rngs0) if (
+                eqs0 or ins0 or rngs0
+            ) else None
+            if ch0 is not None and ch0[3] > 800:
+                use_ft = False
+        if use_ft:
+            from surrealdb_tpu.idx.fulltext import plan_matches
 
-        return plan_matches(tb, cond, mts, indexes, ctx, stmt)
+            return plan_matches(tb, cond, mts, indexes, ctx, stmt)
 
     # ---- equality / range / contains on indexed columns --------------------
     eqs, ins, rngs = _classify_preds(cond, _array_like_paths(tb, ctx))
@@ -309,7 +532,7 @@ def plan_scan(tb: str, cond, ctx, stmt):
     chosen = _choose_index(indexes, eqs, ins, rngs)
     if chosen is None:
         return None
-    idef, nmatch, tail = chosen
+    idef, nmatch, tail, _score = chosen
     eq_vals = [evaluate(eqs[c], ctx) for c in idef.cols_str[:nmatch]]
     scan = _index_scan(tb, idef, eq_vals, tail, ctx)
     order = getattr(stmt, "order", None) if stmt is not None else None
@@ -699,6 +922,65 @@ def explain_plan(tb, cond, ctx, stmt):
                 "detail": {"direction": "forward", "table": tb},
                 "operation": "Iterate Table",
             }
+        union = multi_index_leaves(tb, cond, indexes, ctx)
+        if union is not None:
+            from surrealdb_tpu.exec.eval import evaluate
+
+            entries = []
+            for br in union:
+                if br["kind"] == "range":
+                    frm = {"inclusive": False, "value": NONE}
+                    to = {"inclusive": False, "value": NONE}
+                    for rop, rexpr in br["tail"][1]:
+                        rv = evaluate(rexpr, ctx)
+                        if rop in (">", ">="):
+                            frm = {"inclusive": rop == ">=", "value": rv}
+                        else:
+                            to = {"inclusive": rop == "<=", "value": rv}
+                    entries.append({
+                        "detail": {
+                            "plan": {
+                                "direction": "forward",
+                                "from": frm,
+                                "index": br["idef"].name,
+                                "to": to,
+                            },
+                            "table": tb,
+                        },
+                        "operation": "Iterate Index",
+                    })
+                    continue
+                if br["kind"] == "ft":
+                    mt = br["mt"]
+                    op = f"@{mt.ref}@" if mt.ref is not None else "@@"
+                    try:
+                        val = evaluate(mt.rhs, ctx)
+                    except Exception:
+                        val = None
+                elif br["kind"] == "in":
+                    op = "union"
+                    iv = evaluate(br["tail"][1], ctx)
+                    val = iv if isinstance(iv, list) else [iv]
+                else:
+                    idef = br["idef"]
+                    op = "="
+                    vals = [
+                        evaluate(br["eqs"][c], ctx)
+                        for c in idef.cols_str[:br["nmatch"]]
+                    ]
+                    val = vals[0] if len(vals) == 1 else vals
+                entries.append({
+                    "detail": {
+                        "plan": {
+                            "index": br["idef"].name,
+                            "operator": op,
+                            "value": val,
+                        },
+                        "table": tb,
+                    },
+                    "operation": "Iterate Index",
+                })
+            return entries
         mts = _find_matches(cond)
         if mts:
             from surrealdb_tpu.exec.eval import evaluate
@@ -742,7 +1024,7 @@ def explain_plan(tb, cond, ctx, stmt):
                 and not stmt.exprs[0][0].args
             )
         if chosen is not None:
-            idef, nmatch, tail = chosen
+            idef, nmatch, tail, _score = chosen
             if count_only:
                 # a count-only scan requires the index to cover the whole
                 # WHERE clause; residual predicates need real documents

@@ -199,6 +199,8 @@ class TpuVectorIndex:
         # bf16 ranking store (the primary single-chip kernel): halves HBM
         # traffic and rides the MXU; exact f32 rescoring happens host-side
         self.device_rank = None
+        self.device_full = None  # f32 full store (device exact rescore)
+        self.device_norms = None  # f32 row norms (cosine rescore)
         self.device_x2 = None  # f32 row norms² (euclidean ranking)
         self.mesh = None
         self.coalescer = _Coalescer(self)
@@ -269,6 +271,8 @@ class TpuVectorIndex:
         self.device_vecs = None
         self.device_valid = None
         self.device_rank = None
+        self.device_full = None
+        self.device_norms = None
         self.device_x2 = None
         return True
 
@@ -296,6 +300,8 @@ class TpuVectorIndex:
         self.device_vecs = None
         self.device_valid = None
         self.device_rank = None
+        self.device_full = None
+        self.device_norms = None
         self.device_x2 = None
         # trim the consumed op log when we can write (bounds log growth)
         if getattr(ctx.txn, "write", False):
@@ -327,13 +333,19 @@ class TpuVectorIndex:
             return
         if self.metric in ("euclidean", "cosine", "dot"):
             # bf16 ranking store (primary kernel): half the HBM traffic,
-            # MXU matmuls; candidates get exact f32 rescoring on host
+            # MXU matmuls; candidates get exact f32 rescoring on device
+            # from the f32 full store (knn_rank_rescore stage 2)
             xs = self.vecs
+            self.device_full = jnp.asarray(xs, dtype=jnp.float32)
+            self.device_norms = None
             if self.metric == "cosine":
                 norms = np.maximum(
                     np.linalg.norm(xs, axis=1, keepdims=True), 1e-30
                 )
                 self.device_rank = jnp.asarray(xs / norms, dtype=jnp.bfloat16)
+                self.device_norms = jnp.asarray(
+                    norms[:, 0].astype(np.float32)
+                )
                 self.device_x2 = None
             elif self.metric == "euclidean":
                 self.device_rank = jnp.asarray(xs, dtype=jnp.bfloat16)
@@ -432,10 +444,8 @@ class TpuVectorIndex:
                 for drow, irow in zip(dists, ids)
             ]
         if self.device_rank is not None:
-            from surrealdb_tpu.ops.topk import knn_rank_approx
+            from surrealdb_tpu.ops.topk import knn_rank_rescore
 
-            # oversample to absorb bf16/approx-top-k ranking error, then
-            # rescore exactly in f32/f64 on host
             # oversampling absorbs bf16/approx-top-k ranking error AND
             # tombstoned rows ranked into the candidate set (sync() keeps
             # fragmentation ≤ 25%, so 2k candidates leave ≥ 1.5k valid)
@@ -458,28 +468,20 @@ class TpuVectorIndex:
             r = bucket // chunk
             if bucket != b_total:
                 qs = jnp.pad(qs, ((0, bucket - b_total), (0, 0)))
-            ids = np.asarray(knn_rank_approx(
-                self.device_rank, qs.reshape(r, chunk, -1), kc, self.metric,
-                self.device_x2, self.device_valid,
-            )).reshape(bucket, kc)[:b_total]
-            # exact f64 rescore, vectorized across the whole coalesced
-            # batch (one einsum for all queries, not a per-query loop).
-            # approx_max_k returns real row indices for inf-masked
-            # (tombstoned) rows — refilter against the live mask.
-            cand = np.clip(ids, 0, n - 1)
-            ok = (ids >= 0) & (ids < n) & self.valid[cand]
-            V = self.vecs[cand].astype(np.float64)  # [B, kc, D]
-            Q = qvs.astype(np.float64)
-            d = _exact_mxu_distances(self.metric, V, Q[:, None, :])
-            d = np.where(ok, d, np.inf)
-            order = np.argsort(d, axis=1, kind="stable")[:, :k]
+            dists, ids = knn_rank_rescore(
+                self.device_rank, self.device_full,
+                qs.reshape(r, chunk, -1), min(k, kc), kc, self.metric,
+                self.device_x2, self.device_norms, self.device_valid,
+            )
+            dists = np.asarray(dists).reshape(bucket, -1)[:b_total]
+            ids = np.asarray(ids).reshape(bucket, -1)[:b_total]
             out = []
             for b in range(b_total):
                 row = []
-                for i in order[b]:
-                    if not np.isfinite(d[b, i]):
-                        break
-                    row.append((self.rids[int(cand[b, i])], float(d[b, i])))
+                for d, i in zip(dists[b], ids[b]):
+                    if not np.isfinite(d) or not (0 <= i < n):
+                        continue
+                    row.append((self.rids[int(i)], float(d)))
                 out.append(row)
             return out
         if n > BLOCK_ROWS:

@@ -74,6 +74,73 @@ def knn_rank_approx(xs, qs_r, k: int, metric: str = "euclidean",
     return jax.lax.map(one, qs_r)
 
 
+@partial(jax.jit, static_argnames=("k", "kc", "metric", "recall_target"))
+def knn_rank_rescore(xs_rank, xs_full, qs_r, k: int, kc: int,
+                     metric: str = "euclidean", x2=None, norms=None,
+                     valid=None, recall_target: float = 0.95):
+    """Fused two-stage KNN for the MXU metrics — the primary single-chip
+    kernel. Stage 1 ranks the whole store with one bf16 matmul per query
+    chunk (f32 accumulation) + `lax.approx_max_k` (TPU PartialReduce),
+    keeping `kc` oversampled candidates. Stage 2 gathers the candidates'
+    f32 rows from `xs_full` and rescores them EXACTLY on device (f32
+    distances, exact `lax.top_k` over kc) — replacing the host-side numpy
+    rescore, which dominated end-to-end latency (~5.7s of a 5.9s call at
+    8192×1M×768 measured through the axon tunnel).
+
+    `qs_r` is [R, B, D] f32 query chunks; returns (dists [R,B,k] f32,
+    ids [R,B,k] i32). `x2`: f32 row norms² (euclidean ranking);
+    `norms`: f32 row norms (cosine rescore). Precision note: stage-2
+    distances are f32 (TPU-native), so device-path distances can differ
+    from the reference's f64 in low-order digits; stores below
+    KNN_DEVICE_MIN_ROWS take the host f64 path, which is what the
+    conformance oracle exercises. Reference hot loop replaced:
+    idx/trees/hnsw/layer.rs:184-223."""
+    n = xs_rank.shape[0]
+    if valid is None:
+        valid = jnp.ones((n,), dtype=bool)
+    if x2 is None:
+        x2 = jnp.zeros((n,), dtype=jnp.float32)
+    if norms is None:
+        norms = jnp.ones((n,), dtype=jnp.float32)
+
+    def one(qs):
+        qb = qs.astype(jnp.bfloat16)
+        dots = jnp.einsum(
+            "nd,bd->bn", xs_rank, qb, preferred_element_type=jnp.float32
+        )
+        if metric == "euclidean":
+            score = x2[None, :] - 2.0 * dots
+        else:  # cosine (pre-normalized rank rows) / dot
+            score = -dots
+        score = jnp.where(valid[None, :], score, jnp.inf)
+        _, cand = jax.lax.approx_max_k(
+            -score, kc, recall_target=recall_target
+        )
+        # stage 2: exact f32 rescore of the candidates, on device
+        rows = xs_full[cand]  # [B, kc, D] dynamic gather
+        if metric == "euclidean":
+            diff = rows - qs[:, None, :]
+            d = jnp.sqrt(jnp.maximum((diff * diff).sum(axis=-1), 0.0))
+        elif metric == "cosine":
+            dd = jnp.einsum(
+                "bkd,bd->bk", rows, qs, preferred_element_type=jnp.float32
+            )
+            qn = jnp.maximum(jnp.linalg.norm(qs, axis=-1), 1e-30)
+            d = 1.0 - dd / jnp.maximum(
+                norms[cand] * qn[:, None], 1e-30
+            )
+        else:  # dot
+            d = -jnp.einsum(
+                "bkd,bd->bk", rows, qs, preferred_element_type=jnp.float32
+            )
+        d = jnp.where(valid[cand], d, jnp.inf)
+        nd, sel = jax.lax.top_k(-d, k)
+        ids = jnp.take_along_axis(cand, sel, axis=1)
+        return -nd, ids
+
+    return jax.lax.map(one, qs_r)
+
+
 @partial(jax.jit, static_argnames=("k", "metric", "block"))
 def knn_search_blocked(xs, qs, k: int, metric: str = "euclidean",
                        p: float = 3.0, valid=None, block: int = 65536):
