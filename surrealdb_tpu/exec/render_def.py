@@ -533,7 +533,7 @@ def render_index(d) -> str:
         )
         out += (
             f" HNSW DIMENSION {h.get('dimension')} DIST {dist_s}"
-            f" TYPE {h.get('vector_type', 'f64').upper()}"
+            f" TYPE {h.get('vector_type', 'f32').upper()}"
             f" EFC {h.get('ef_construction', 150)} M {h.get('m', 12)}"
             f" M0 {h.get('m0', 24)}"
         )
@@ -545,6 +545,12 @@ def render_index(d) -> str:
         from surrealdb_tpu.val import render as _render
 
         out += f" LM {_render(float(ml))}"
+        if h.get("extend_candidates"):
+            out += " EXTEND_CANDIDATES"
+        if h.get("keep_pruned_connections"):
+            out += " KEEP_PRUNED_CONNECTIONS"
+        if h.get("use_hashed_vector"):
+            out += " HASHED_VECTOR"
     if d.comment:
         out += f" COMMENT {_str_sql(d.comment)}"
     return out

@@ -1014,61 +1014,74 @@ def _search_linear(args, ctx):
             )
     from surrealdb_tpu.val import hashable
 
-    scores: dict = {}
-    merged: dict = {}
+    # mirrors the reference's exact float op order (fnc/search.rs:380-537)
+    # so normalized scores match bit-for-bit: per-doc raw score is
+    # distance→1/(1+d) | ft_score | score | rank fallback 1/(1+count);
+    # params per list, then weighted combination over score>0 entries
+    n_lists = len(lists)
+    documents: dict = {}  # h -> [scores_per_list, merged_obj]
     order: list = []
-    for w, lst in zip(weights, lists):
-        if not isinstance(lst, list) or not lst:
+    count = 0
+    for list_idx, lst in enumerate(lists):
+        if not isinstance(lst, list):
             continue
-        # the score field is the single non-id numeric field per item;
-        # `distance` fields rank lower-is-better and normalize inverted
-        entries = []
-        field_name = None
         for item in lst:
-            if not isinstance(item, dict):
+            if not isinstance(item, dict) or "id" not in item:
                 continue
-            fname = next(
-                (kk for kk, vv in item.items()
-                 if kk != "id" and isinstance(vv, (int, float, Decimal))
-                 and not isinstance(vv, bool)),
-                None,
-            )
-            if fname is None:
-                continue
-            field_name = field_name or fname
-            entries.append((item, float(item[fname])))
-        if not entries:
-            continue
-        vals = [v for _it, v in entries]
-        invert = field_name == "distance"
-        if norm == "minmax":
-            lo, hi = min(vals), max(vals)
-            rng = hi - lo
-
-            def nrm(v):
-                x = (v - lo) / rng if rng else 0.0
-                return 1.0 - x if invert else x
-        else:
-            mean = sum(vals) / len(vals)
-            var = sum((v - mean) ** 2 for v in vals) / len(vals)
-            sd = var ** 0.5
-
-            def nrm(v):
-                z = (v - mean) / sd if sd else 0.0
-                return -z if invert else z
-        for item, v in entries:
+            d = item.get("distance")
+            fts = item.get("ft_score")
+            sc = item.get("score")
+            if isinstance(d, (int, float, Decimal)) and \
+                    not isinstance(d, bool):
+                score = 1.0 / (1.0 + float(d))
+            elif isinstance(fts, (int, float, Decimal)) and \
+                    not isinstance(fts, bool):
+                score = float(fts)
+            elif isinstance(sc, (int, float, Decimal)) and \
+                    not isinstance(sc, bool):
+                score = float(sc)
+            else:
+                score = 1.0 / (1.0 + count)
             h = hashable(item.get("id"))
-            if h not in merged:
-                merged[h] = dict(item)
+            if h not in documents:
+                documents[h] = [[0.0] * n_lists, dict(item)]
                 order.append(h)
             else:
-                merged[h].update(item)
-            scores[h] = scores.get(h, 0.0) + float(w) * nrm(v)
-    out = sorted(order, key=lambda h: -scores[h])[: int(limit)]
+                documents[h][1].update(item)
+            documents[h][0][list_idx] = score
+            count += 1
+    # per-list normalization params over scores > 0
+    params = []
+    for list_idx in range(n_lists):
+        vals = [doc[0][list_idx] for doc in documents.values()
+                if doc[0][list_idx] > 0.0]
+        if not vals:
+            params.append((0.0, 1.0))
+            continue
+        if norm == "minmax":
+            lo = min(vals)
+            rng = max(vals) - lo
+            params.append((lo, rng if rng > 0.0 else 1.0))
+        else:
+            mean = sum(vals) / len(vals)
+            var = sum((x - mean) ** 2 for x in vals) / len(vals)
+            sd = var ** 0.5
+            params.append((mean, sd if sd > 0.0 else 1.0))
+    combined: dict = {}
+    for h in order:
+        scores_l, _obj = documents[h]
+        total = 0.0
+        for list_idx, score in enumerate(scores_l):
+            if score > 0.0:
+                w = weights[list_idx] if list_idx < len(weights) else 1.0
+                a, b = params[list_idx]
+                total += float(w) * ((score - a) / b)
+        combined[h] = total
+    out = sorted(order, key=lambda h: -combined[h])[: int(limit)]
     res = []
     for h in out:
-        row = merged[h]
-        row["linear_score"] = scores[h]
+        row = documents[h][1]
+        row["linear_score"] = combined[h]
         res.append(row)
     return res
 

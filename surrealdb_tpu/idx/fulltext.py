@@ -94,10 +94,14 @@ def _stem(word: str) -> str:
     return word
 
 
-def _apply_filters(tokens, filters):
+def _apply_filters(tokens, filters, stage="index"):
     out = tokens
     for f in filters:
         name = f[0]
+        # ngram family generates index-time grams only; query text keeps
+        # its whole tokens (reference filter.rs is_stage FilteringStage)
+        if stage == "query" and name in ("ngram", "edgengram"):
+            continue
         nxt = []
         if name == "lowercase":
             nxt = [(t.lower(), a, b, oa, ob) for t, a, b, oa, ob in out]
@@ -147,7 +151,7 @@ def get_analyzer(name, ctx) -> AnalyzerDef:
     return az
 
 
-def analyze(az: AnalyzerDef, text: str, ctx=None):
+def analyze(az: AnalyzerDef, text: str, ctx=None, stage="index"):
     # FUNCTION analyzers preprocess the text through a custom function
     # that must return a string (reference ft/analyzer mapper)
     if getattr(az, "function", None) and ctx is not None:
@@ -165,7 +169,7 @@ def analyze(az: AnalyzerDef, text: str, ctx=None):
                 f"The function should return a string."
             )
         text = out
-    return _apply_filters(_tokenize(text, az.tokenizers), az.filters)
+    return _apply_filters(_tokenize(text, az.tokenizers), az.filters, stage)
 
 
 def analyze_text(az_name, text, ctx):
@@ -178,6 +182,22 @@ def analyze_text(az_name, text, ctx):
 # ---------------------------------------------------------------------------
 
 
+def _flatten_strings(v):
+    """All strings in a value, depth-first; objects iterate in sorted key
+    order (the reference's Object is a BTreeMap, so the analyzer visits
+    nested strings lexicographically by key)."""
+    if isinstance(v, str):
+        return [v]
+    out = []
+    if isinstance(v, list):
+        for x in v:
+            out.extend(_flatten_strings(x))
+    elif isinstance(v, dict):
+        for k in sorted(v):
+            out.extend(_flatten_strings(v[k]))
+    return out
+
+
 def _doc_terms(idef, doc, ctx, rid):
     from surrealdb_tpu.exec.eval import evaluate
 
@@ -187,11 +207,7 @@ def _doc_terms(idef, doc, ctx, rid):
     length = 0
     for col in idef.cols:
         v = evaluate(col, c)
-        texts = []
-        if isinstance(v, str):
-            texts = [v]
-        elif isinstance(v, list):
-            texts = [x for x in v if isinstance(x, str)]
+        texts = _flatten_strings(v)
         for vi, text in enumerate(texts):
             for t, a, b, oa, ob in analyze(az, text):
                 if not t:
@@ -264,7 +280,7 @@ def ft_search(idef, query: str, ctx, boolean: str = "AND"):
     ns, db = ctx.need_ns_db()
     tb, ix = idef.tb, idef.name
     az = get_analyzer(idef.fulltext.get("analyzer"), ctx)
-    terms = [tok[0] for tok in analyze(az, query) if tok[0]]
+    terms = [tok[0] for tok in analyze(az, query, stage="query") if tok[0]]
     if not terms:
         return [], {}
     import numpy as _np
@@ -311,6 +327,7 @@ def ft_search(idef, query: str, ctx, boolean: str = "AND"):
             for rk, sc in scores.items()
             if matched_all.get(rk) == want
         ]
+    hits = [(r, float(_np.float32(sc))) for r, sc in hits]
     hits.sort(key=lambda p: -p[1])
     return hits, offsets
 
@@ -346,7 +363,14 @@ def plan_matches(tb, cond, mts, indexes, ctx, stmt):
                 "Unable to perform the MATCHES operator without a full-text index"
             )
         q = evaluate(mt.rhs, ctx)
-        hits, offsets = ft_search(idef, str(q), ctx, boolean=mt.boolean)
+        pre = (ctx.vars.get("__ft__") or {}).get(("node", id(mt)))
+        if pre is not None and pre["idef"].name == idef.name \
+                and pre["query"] == str(q) and "hits" in pre:
+            # plan_scan pre-registered this node's search (planner
+            # _register_match_contexts) — reuse instead of re-searching
+            hits, offsets = pre["hits"], pre["offsets"]
+        else:
+            hits, offsets = ft_search(idef, str(q), ctx, boolean=mt.boolean)
         ref = mt.ref if mt.ref is not None else 0
         if ref in seen_refs:
             raise SdbError(f"Duplicated Match reference: {ref}")
@@ -364,7 +388,9 @@ def plan_matches(tb, cond, mts, indexes, ctx, stmt):
         rest = _remove_node(rest, mt)
     ordered = []
     seen = set()
-    for ref in sorted(ft_ctx):
+    # node-keyed tuple entries are aliases for filter evaluation; the
+    # ordered result union walks the numeric ref entries only
+    for ref in sorted(k for k in ft_ctx if isinstance(k, int)):
         entry = ft_ctx[ref]
         for h in entry["scores"]:
             if h in common and h not in seen:
@@ -423,7 +449,7 @@ def matches_operator(n, ctx):
     if az is None:
         az = AnalyzerDef("like", ["blank"], [("lowercase",)])
     doc_terms = {tok[0] for tok in analyze(az, lhs)}
-    q_terms = {tok[0] for tok in analyze(az, rhs)}
+    q_terms = {tok[0] for tok in analyze(az, rhs, stage="query")}
     if not q_terms:
         return False
     if getattr(n, "boolean", "AND") == "OR":
@@ -484,6 +510,12 @@ def search_highlight(args, ctx):
         out.append(t[last:])
         return "".join(out)
 
+    if isinstance(text, dict):
+        # object fields highlight their flattened strings (same value
+        # order the indexer used)
+        return [
+            mark(t, vi) for vi, t in enumerate(_flatten_strings(text))
+        ]
     if isinstance(text, list):
         return [mark(t, vi) for vi, t in enumerate(text)]
     return mark(text, 0)

@@ -128,9 +128,16 @@ def or_union_branches(tb, cond, indexes, ctx, value_idioms=True):
             branches.append({"kind": "ft", "idef": idef, "mt": d})
             continue
         eqs, ins, rngs = _classify_preds(d, array_paths, value_idioms)
-        if not eqs and not ins and not rngs:
-            return None
-        chosen = _choose_index(indexes, eqs, ins, rngs)
+        chosen = _choose_index(indexes, eqs, ins, rngs) if (
+            eqs or ins or rngs
+        ) else None
+        # a MATCHES inside the disjunct's AND tree is also a candidate
+        # access (scored 800, losing only to unique full-equality)
+        mts_d = _find_matches(d)
+        ft_idef = _ft_index_for(mts_d[0], indexes) if mts_d else None
+        if ft_idef is not None and (chosen is None or chosen[3] <= 800):
+            branches.append({"kind": "ft", "idef": ft_idef, "mt": mts_d[0]})
+            continue
         if chosen is None:
             return None
         idef, nmatch, tail, _score = chosen
@@ -216,7 +223,12 @@ def _ft_branch_scan(tb, br, ctx):
     mt = br["mt"]
     idef = br["idef"]
     q = evaluate(mt.rhs, ctx)
-    hits, offsets = ft_search(idef, str(q), ctx, boolean=mt.boolean)
+    pre = (ctx.vars.get("__ft__") or {}).get(("node", id(mt)))
+    if pre is not None and pre["idef"].name == idef.name \
+            and pre["query"] == str(q) and "hits" in pre:
+        hits, offsets = pre["hits"], pre["offsets"]
+    else:
+        hits, offsets = ft_search(idef, str(q), ctx, boolean=mt.boolean)
     ft_ctx = dict(ctx.vars.get("__ft__") or {})
     ctx.vars["__ft__"] = ft_ctx
     ref = mt.ref if mt.ref is not None else 0
@@ -469,8 +481,80 @@ def _choose_index(indexes, eqs, ins, rngs, model="streaming"):
     return best[1], best[2], best[3], best[0][0]
 
 
+def _register_match_contexts(tb, cond, ctx):
+    """The reference's QueryExecutor registers score/offset contexts for
+    every indexed MATCHES in the cond even when the plan falls back to a
+    table iterator (idx/planner/executor.rs QueryExecutor::new walks all
+    matches expressions) — so search::score(ref)/highlight work without
+    the full-text index driving the scan."""
+    from surrealdb_tpu.expr.ast import Matches
+
+    nodes = []
+
+    def rec(c):
+        if isinstance(c, Matches):
+            nodes.append(c)
+        elif isinstance(c, Binary) and c.op in ("&&", "||"):
+            rec(c.lhs)
+            rec(c.rhs)
+
+    rec(cond)
+    if not nodes:
+        return
+    from surrealdb_tpu.exec.eval import evaluate
+    from surrealdb_tpu.idx.fulltext import ft_search
+
+    indexes = get_indexes_for(tb, ctx)
+    ft_ctx = dict(ctx.vars.get("__ft__") or {})
+    registered: dict = {}
+    for mt in nodes:
+        idef = _ft_index_for(mt, indexes)
+        if idef is None:
+            continue  # no index: the filter evaluates it ad-hoc
+        q = str(evaluate(mt.rhs, ctx))
+        ref = mt.ref if mt.ref is not None else 0
+        prev = registered.get(ref)
+        if prev is not None:
+            if prev == (idef.name, q):
+                # same expression repeated: share the entry
+                ft_ctx[("node", id(mt))] = ft_ctx[ref]
+                continue
+            # colliding refs (e.g. two implicit @@ in one cond): the
+            # ref-keyed entry stays first-wins for the score functions;
+            # the node-keyed entry below keeps membership exact per node
+            # (plan_matches still rejects duplicates among AND-planned
+            # matches, matching the reference's executor error)
+        hits, offsets = ft_search(idef, q, ctx, boolean=mt.boolean)
+        entry = {
+            "scores": {hashable(r): s for r, s in hits},
+            "offsets": offsets,
+            "idef": idef,
+            "query": q,
+            "hits": hits,
+        }
+        if prev is None:
+            ft_ctx[ref] = entry
+            registered[ref] = (idef.name, q)
+        ft_ctx[("node", id(mt))] = entry
+    ctx.vars["__ft__"] = ft_ctx
+
+
 def plan_scan(tb: str, cond, ctx, stmt):
-    """Return a Source generator when an index path applies, else None."""
+    """Return a Source generator when an index path applies, else None
+    (table scan). Indexed MATCHES in the cond get their score contexts
+    registered regardless of which plan wins (the reference's
+    QueryExecutor does this for every matches expression), so
+    search::score/highlight work under table scans, eq-index scans,
+    and union branches alike."""
+    if cond is not None:
+        with_index = getattr(stmt, "with_index", None) \
+            if stmt is not None else None
+        if with_index != []:
+            _register_match_contexts(tb, cond, ctx)
+    return _plan_scan(tb, cond, ctx, stmt)
+
+
+def _plan_scan(tb: str, cond, ctx, stmt):
     if cond is None:
         return None
     from surrealdb_tpu.exec.eval import evaluate

@@ -32,9 +32,9 @@ BLOCK_ROWS = cnf.KNN_BLOCK_ROWS
 
 
 def _vec_dtype(params) -> type:
-    # the index vector type governs storage precision; the reference
-    # defaults to F64 (catalog HnswParams.vector_type)
-    vt = (params or {}).get("vector_type", "f64")
+    # the index vector type governs storage precision; the reference's
+    # parser defaults to F32 (syn define.rs:1107 VectorType::F32)
+    vt = (params or {}).get("vector_type", "f32")
     return np.float32 if str(vt).lower() in ("f32", "i16", "i32") else np.float64
 
 
@@ -510,11 +510,21 @@ class TpuVectorIndex:
         ]
 
     def _host_distances(self, qv, xs=None):
-        # f64 math: the reference computes distances in f64 regardless of
-        # the stored vector type (trees/vector.rs)
-        xs = (self.vecs if xs is None else xs).astype(np.float64)
-        qv = np.asarray(qv, dtype=np.float64)
+        # the reference accumulates in f64 for most metrics regardless of
+        # stored type (trees/vector.rs generic impls use to_float), but
+        # cosine has an F32 specialization (cosine_distance_f32): f32
+        # dot/norm sums combined in f64 — match it for TYPE F32 stores
+        raw = self.vecs if xs is None else xs
         m = self.metric
+        if m == "cosine" and raw.dtype == np.float32:
+            x32 = raw
+            q32 = np.asarray(qv, dtype=np.float32)
+            dots = (x32 * q32[None, :]).sum(axis=1).astype(np.float64)
+            na = np.sqrt((x32 * x32).sum(axis=1).astype(np.float64))
+            nb = np.sqrt(np.float64((q32 * q32).sum()))
+            return 1.0 - dots / np.maximum(na * nb, 1e-300)
+        xs = raw.astype(np.float64)
+        qv = np.asarray(qv, dtype=np.float64)
         if m in ("euclidean", "cosine", "dot"):
             return _exact_mxu_distances(m, xs, qv[None, :])
         if m == "manhattan":

@@ -1210,7 +1210,7 @@ class Parser:
                 d.fulltext = ft
             elif self.eat_kw("hnsw", "mtree"):
                 h = {
-                    "dimension": None, "distance": "euclidean", "vector_type": "f64",
+                    "dimension": None, "distance": "euclidean", "vector_type": "f32",
                     "m": 12, "m0": 24, "ml": None, "ef_construction": 150,
                     "extend_candidates": False, "keep_pruned_connections": False,
                     "capacity": 40,
@@ -1236,6 +1236,10 @@ class Parser:
                         h["extend_candidates"] = True
                     elif self.eat_kw("keep_pruned_connections"):
                         h["keep_pruned_connections"] = True
+                    elif self.eat_kw("hashed_vector"):
+                        # dedupe vectors by hash in the doc map
+                        # (reference define.rs t!("HASHED_VECTOR"))
+                        h["use_hashed_vector"] = True
                     else:
                         break
                 d.hnsw = h
