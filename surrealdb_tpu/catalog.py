@@ -69,6 +69,9 @@ class IndexDef:
     fulltext: Optional[dict] = None
     count: bool = False
     comment: Optional[str] = None
+    # ALTER INDEX ... PREPARE REMOVE: writes still maintain the index but
+    # the planner stops reading it (reference alter index decommission)
+    prepare_remove: bool = False
 
 
 @dataclass

@@ -1137,35 +1137,44 @@ def _apply_recurse(val, part: PRecurse, tail, ctx):
     start_items = [x for x in start_items if x is not NONE and x is not None]
     was_list = isinstance(val, list)
 
-    # ---- path: DFS with in-path cycle cuts --------------------------------
+    # ---- path: BFS with in-path cycle cuts --------------------------------
+    # paths emit in termination order — level by level (a dead end at
+    # depth 1 precedes every depth-3 path), discovery order within a
+    # level (reference recursion.rs path enumeration)
     if mode == "path":
+        # acc holds the CORE path (traversed nodes, excluding the
+        # +inclusive subject prefix) — the subject does not count toward
+        # cycle detection, so alice.{..3+path+inclusive} may pass back
+        # through alice and cut only on a core revisit
         paths = []
 
-        def dfs(node, acc, depth):
-            nonlocal was_list
-            if depth >= rmax:
-                if len(acc) >= rmin:
-                    paths.append(list(acc))
-                return
-            children, islist = step(node)
-            was_list = was_list or islist
-            if not children:
-                if len(acc) >= rmin:
-                    paths.append(list(acc))
-                return
-            inpath = {hashable(x) for x in acc}
-            inpath.add(hashable(node))
-            for ch in children:
-                if hashable(ch) in inpath:
-                    # cycle: emit the path closed by the repeated node
-                    if len(acc) + 1 >= rmin:
-                        paths.append(list(acc) + [ch])
-                    continue
-                dfs(ch, acc + [ch], depth + 1)
+        def emit(sn, core):
+            pre = [sn] if inclusive else []
+            if len(pre) + len(core) >= rmin:
+                paths.append(pre + core)
 
-        for sn in start_items:
-            base = [sn] if inclusive else []
-            dfs(sn, base, 0)
+        frontier = [(sn, sn, []) for sn in start_items]
+        depth = 0
+        while frontier:
+            nxt = []
+            for sn, node, acc in frontier:
+                if depth >= rmax:
+                    emit(sn, acc)
+                    continue
+                children, islist = step(node)
+                was_list = was_list or islist
+                if not children:
+                    emit(sn, acc)
+                    continue
+                inpath = {hashable(x) for x in acc}
+                for ch in children:
+                    if hashable(ch) in inpath:
+                        # cycle: emit the path closed by the repeat
+                        emit(sn, acc + [ch])
+                        continue
+                    nxt.append((sn, ch, acc + [ch]))
+            depth += 1
+            frontier = nxt
         return paths
 
     # ---- shortest: BFS with parent links ----------------------------------

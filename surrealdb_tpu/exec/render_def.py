@@ -568,6 +568,10 @@ def index_structure(d) -> dict:
         out["index"] = "HNSW"
     else:
         out["index"] = "IDX"
+    if getattr(d, "prepare_remove", False):
+        out["prepare_remove"] = True
+    if d.comment:
+        out["comment"] = d.comment
     return out
 
 

@@ -425,11 +425,45 @@ def expr_name(expr, sql=False) -> str:
             if isinstance(p, tuple):
                 out.append(expr_name(p[1], sql))
             elif isinstance(p, PField):
-                name = _esc(p.name) if sql else p.name
+                # `@` is the repeat-subject marker, never escaped
+                name = p.name if p.name == "@" else (
+                    _esc(p.name) if sql else p.name
+                )
                 if out:
                     out.append("." + name)
                 else:
                     out.append(name)
+            elif isinstance(p, PRecurse):
+                if p.min == p.max and p.min is not None:
+                    rng = str(p.min)
+                elif p.max is None:
+                    rng = ".." if p.min in (None, 1) else f"{p.min}.."
+                elif p.min in (None, 1):
+                    rng = f"..{p.max}"
+                else:
+                    rng = f"{p.min}..{p.max}"
+                ins = f"+{p.instruction}" if p.instruction else ""
+                txt = ("." if out else "") + "{" + rng + ins + "}"
+                inner = list(p.parts or [])
+                if inner and all(
+                    isinstance(x, PDestructure) for x in inner
+                ):
+                    txt += expr_name(Idiom(inner), sql)
+                elif inner:
+                    txt += "(" + expr_name(Idiom(inner), sql) + ")"
+                out.append(txt)
+            elif isinstance(p, PDestructure):
+                fields = []
+                for nm, wh in p.fields:
+                    if wh is None:
+                        fields.append(nm)
+                    else:
+                        sub_i = wh if isinstance(wh, Idiom) \
+                            else Idiom(list(wh))
+                        fields.append(f"{nm}: {expr_name(sub_i, sql)}")
+                out.append(
+                    ("." if out else "") + "{ " + ", ".join(fields) + " }"
+                )
             elif isinstance(p, PAll):
                 out.append(".*" if out else "*")
             elif isinstance(p, PIndex):
@@ -884,6 +918,11 @@ def _idiom_segments(expr, ctx=None):
             segs.append(p.name)
         elif isinstance(p, PGraph):
             arrow = {"out": "->", "in": "<-", "both": "<->", "ref": "<~"}[p.dir]
+            if getattr(p, "expr", None) is not None:
+                from surrealdb_tpu.exec.render_def import _select_sql
+
+                segs.append(f"{arrow}({_select_sql(p.expr)})")
+                continue
             names = ", ".join(w[0] for w in p.what) if p.what else "?"
             if len(p.what) <= 1:
                 segs.append(f"{arrow}{names}")
@@ -1340,6 +1379,31 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
     knn_residual = _remove_node(n.cond, knn) if knn is not None else None
     knn_brute = None
     for expr in n.what:
+        # subquery FROM sources nest their own full sub-plan (reference
+        # streaming planner: the inner SELECT is an operator subtree)
+        sub_sel = None
+        se = _unwrap_start(expr)
+        if isinstance(se, Subquery) and isinstance(se.stmt, SelectStmt):
+            sub_sel = se.stmt
+        if sub_sel is not None:
+            import copy as _copy
+
+            sub = _copy.copy(sub_sel)
+            # the sub-plan always renders as text (the outer call alone
+            # JSON-encodes); keep only the analyze dimension
+            sub.explain = "analyze" if analyze else "explain"
+            txt = _explain_streaming(sub, ctx.child())
+            sub_lines = [
+                l for l in txt.split("\n")
+                if l.strip() and not l.startswith("Total rows")
+            ]
+            rows = (
+                len(list(_iterate_value(_target_value(expr, ctx), ctx)))
+                if analyze else 0
+            )
+            scans.append(("__raw__", rows, sub_lines))
+            total_scan_rows += rows
+            continue
         v = _target_value(expr, ctx)
         if isinstance(v, RecordId):
             rows = len(list(_iterate_value(v, ctx))) if analyze else 0
@@ -1359,7 +1423,24 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
             continue
         if not isinstance(v, Table):
             rows = len(list(_iterate_value(v, ctx))) if analyze else 0
-            scans.append((f"ValueScan [ctx: Db]", rows))
+            from surrealdb_tpu.expr.ast import Cast as _Cst, \
+                RangeExpr as _Rng
+
+            src_e = _unwrap_start(expr)
+            if isinstance(src_e, _Cst) and isinstance(src_e.expr, _Rng):
+                # `..` is a binary operator in the reference grammar, so
+                # a cast-of-range renders `<array>  0 .. 5`
+                from surrealdb_tpu.exec.coerce import kind_name as _kn2
+
+                rg = src_e.expr
+                beg = _expr_sql(rg.beg) if rg.beg is not None else ""
+                end = _expr_sql(rg.end) if rg.end is not None else ""
+                src = f"<{_kn2(src_e.kind)}>  {beg} .. {end}"
+            else:
+                src = _expr_sql(src_e)
+            scans.append(
+                (f"SourceExpr [ctx: Db] [expr: {src}]", rows)
+            )
             total_scan_rows += rows
             continue
         tb = v.name
@@ -1773,7 +1854,10 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 n = _strip_limit(_strip_order(n))
         if label is None:
             extra = ""
-            if n.cond is not None:
+            if n.cond is not None and single_target:
+                # a single table scan absorbs the predicate; multi-source
+                # and subquery plans keep a Filter node above (reference
+                # explain/complex.surql)
                 extra += f", predicate: {_expr_sql(n.cond)}"
                 residual = None
             if (
@@ -1826,9 +1910,16 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
         out_rows_n = len(result) if isinstance(result, list) else 1
 
     root_lines = []
+    lookup_lines = []  # raw pre-indented graph field.lookup sub-trees
     scan_lines = []  # (reldepth, text, rows)
 
     def _emit_scan(depth, entry):
+        if entry[0] == "__raw__":
+            # a nested sub-plan: pre-rendered lines, re-indented at
+            # assembly relative to this slot
+            for line in entry[2]:
+                scan_lines.append((("raw", depth), line, 0))
+            return
         scan_lines.append((depth, entry[0], entry[1]))
         if len(entry) > 2 and entry[2]:
             for bl, br in entry[2]:
@@ -1871,19 +1962,21 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
         if filt_line is not None:
             wrapped.append((1, filt_line[0], filt_line[1]))
             shift = 2
-        scan_lines = wrapped + [(d + shift, t, r) for d, t, r in scan_lines]
-    if residual is not None and not any(
-        t.lstrip().startswith("TableScan") for _d, t, _r in scan_lines
-    ):
+        scan_lines = wrapped + [(_shift_depth(d, shift), t, r) for d, t, r in scan_lines]
+    if not single_target and n.cond is not None and knn_brute is None:
+        # multi-source plans always filter above the Union — a per-branch
+        # index access can't cover the other branches (explain/complex)
+        residual = n.cond
+    if residual is not None:
         scan_lines = [
             (0, f"Filter [ctx: Db] [predicate: {_expr_sql(residual)}]",
              out_rows_n)
-        ] + [(d + 1, t, r) for d, t, r in scan_lines]
+        ] + [(_shift_depth(d, 1), t, r) for d, t, r in scan_lines]
     if n.split:
         names = ", ".join(expr_name(sp) for sp in n.split)
         scan_lines = [
             (0, f"Split [ctx: Db] [on: {names}]", out_rows_n)
-        ] + [(d + 1, t, r) for d, t, r in scan_lines]
+        ] + [(_shift_depth(d, 1), t, r) for d, t, r in scan_lines]
     # aggregation / projection root
     if n.group is not None:
         if n.group:
@@ -1947,11 +2040,35 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 (f"ProjectValue [ctx: Db] [expr: {_expr_sql(n.value)}]",
                  out_rows_n)
             )
+            if isinstance(n.value, Idiom):
+                prec = next(
+                    (p for p in n.value.parts if isinstance(p, PRecurse)),
+                    None,
+                )
+                if prec is not None:
+                    pi = n.value.parts.index(prec)
+                    lookup_lines.append((
+                        "expr.recurse",
+                        _recurse_flat(prec, n.value.parts[pi + 1:]),
+                    ))
         else:
             only_rid_scans = scans and all(
                 entry[0].startswith("RecordIdScan") for entry in scans
             )
-            if only_rid_scans:
+            graph_projs = bool(n.exprs) and all(
+                e != "*" and isinstance(e, Idiom)
+                and any(isinstance(p, PGraph) for p in e.parts)
+                for e, _a in n.exprs
+            )
+            if graph_projs:
+                # graph-lookup projections: bare Project root with one
+                # `field.lookup:` sub-tree per projection
+                root_lines.append(("Project [ctx: Db]", out_rows_n))
+                for e, _a in n.exprs:
+                    flat = _graph_hops_flat(e.parts)
+                    if flat:
+                        lookup_lines.append(("field.lookup", flat))
+            elif only_rid_scans:
                 root_lines.append(("Project [ctx: Db]", out_rows_n))
             else:
                 projs = ", ".join(
@@ -1971,6 +2088,23 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                     for e, a in n.exprs
                     if e != "*" and not isinstance(e, Idiom)
                 ]
+                # recursion idioms compute through a Recurse sub-plan
+                for e, a in n.exprs:
+                    if e == "*" or not isinstance(e, Idiom):
+                        continue
+                    prec = next(
+                        (p for p in e.parts if isinstance(p, PRecurse)),
+                        None,
+                    )
+                    if prec is None:
+                        continue
+                    nm = a or expr_name(e)
+                    computed.append(f"{nm} = {expr_name(e, sql=True)}")
+                    pi = e.parts.index(prec)
+                    lookup_lines.append((
+                        f"{nm}.recurse",
+                        _recurse_flat(prec, e.parts[pi + 1:]),
+                    ))
                 if computed:
                     mid_lines.insert(
                         0,
@@ -2038,7 +2172,17 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
         )
     stacked = [(i, t, r) for i, (t, r) in enumerate(root_lines + mid_lines)]
     base = len(stacked)
-    ordered = stacked + [(base + d, t, r) for d, t, r in scan_lines]
+    raw = []
+    for label, flat in lookup_lines:
+        for line in _lookup_raw_lines(label, flat, max(base - 1, 0)):
+            raw.append((None, line, 0))
+    shifted = []
+    for d, t, r in scan_lines:
+        if isinstance(d, tuple):
+            shifted.append((None, "    " * (base + d[1]) + t, 0))
+        else:
+            shifted.append((base + d, t, r))
+    ordered = stacked + raw + shifted
     if json_fmt:
         return _tree_to_json(ordered, analyze, out_rows_n)
     return _render_tree(ordered, analyze, out_rows_n)
@@ -2066,6 +2210,16 @@ import re as _re_mod
 def _tree_to_json(entries, analyze, total):
     """Structured (FORMAT JSON) explain: {operator, context, attributes,
     children[, metrics, total_rows]} (reference exec explain JSON)."""
+    # raw pre-indented lookup lines (depth None) carry no tree position;
+    # recover depth from their indentation so the JSON nest stays sane
+    fixed = []
+    for d, t, r in entries:
+        if d is None:
+            stripped = t.lstrip(" ")
+            d = max((len(t) - len(stripped)) // 4, 0)
+            t = stripped
+        fixed.append((d, t, r))
+    entries = fixed
     rx = _re_mod.compile(
         r"^(?P<op>\w+) \[ctx: (?P<ctx>\w+)\](?: \[(?P<attrs>.*)\])?$"
     )
@@ -2117,9 +2271,28 @@ def _tree_to_json(entries, analyze, total):
     return root
 
 
+def _unwrap_start(e):
+    """Unwrap a single-part start-tuple idiom to its inner expression."""
+    if isinstance(e, Idiom) and len(e.parts) == 1 and \
+            isinstance(e.parts[0], tuple) and e.parts[0][0] == "start":
+        return e.parts[0][1]
+    return e
+
+
+def _shift_depth(d, k):
+    """Shift a scan-line depth by k; raw sub-plan lines carry tuple depths."""
+    if isinstance(d, tuple):
+        return (d[0], d[1] + k)
+    return d + k
+
+
 def _render_tree(entries, analyze, total):
     out = []
     for depth, text, rows in entries:
+        if depth is None:
+            # raw pre-indented line (graph lookup sub-trees)
+            out.append(text)
+            continue
         line = ("    " * depth) + text
         if analyze:
             line += f" {{rows: {rows}}}"
@@ -2128,6 +2301,96 @@ def _render_tree(entries, analyze, total):
     if analyze:
         s += f"\nTotal rows: {total}"
     return s
+
+
+def _graph_hops_flat(parts):
+    """Top-down node labels for a graph-lookup chain: hops render
+    outermost-last-hop-first, ending at CurrentValueSource (reference
+    exec/operators/scan/graph.rs GraphEdgeScan explain). Subquery hops
+    render their SELECT plan over a FullEdge-output scan."""
+    from surrealdb_tpu.exec.render_def import _expr_sql
+    from surrealdb_tpu.expr.ast import PGraph
+
+    arrows = {"out": "->", "in": "<-", "both": "<->", "ref": "<~"}
+    hops = [p for p in parts if isinstance(p, PGraph)]
+    if not hops:
+        return None
+    flat = []
+    for g in reversed(hops):
+        if getattr(g, "expr", None) is not None:
+            sel = g.expr
+            tbls = ", ".join(expr_name(w) for w in sel.what)
+            if sel.group:
+                by = ", ".join(expr_name(x) for x in sel.group)
+                flat.append(f"Aggregate [ctx: Db] [by: {by}]")
+            else:
+                projs = ", ".join(
+                    "*" if e == "*" else (a or expr_name(e))
+                    for e, a in sel.exprs
+                ) or "*"
+                flat.append(
+                    f"SelectProject [ctx: Db] [projections: {projs}]"
+                )
+            if sel.cond is not None:
+                flat.append(
+                    f"Filter [ctx: Db] [predicate: {_expr_sql(sel.cond)}]"
+                )
+            flat.append(
+                f"GraphEdgeScan [ctx: Db] [direction: {arrows[g.dir]}, "
+                f"tables: {tbls}, output: FullEdge]"
+            )
+        else:
+            tbls = ", ".join(w[0] for w in g.what) if g.what else "?"
+            flat.append(
+                f"GraphEdgeScan [ctx: Db] [direction: {arrows[g.dir]}, "
+                f"tables: {tbls}, output: TargetId]"
+            )
+    flat.append("CurrentValueSource [ctx: Rt]")
+    return flat
+
+
+def _recurse_flat(prec, following=()):
+    """Node labels for a `.{n}` recursion: a Recurse head, then the
+    repeated path's hop chain. A destructure body (inside the braces or
+    as the following part) is `pattern: tree` with no hop chain."""
+    from surrealdb_tpu.expr.ast import PDestructure as _PD
+
+    if prec.min == prec.max and prec.min is not None:
+        depth_s = str(prec.min)
+    elif prec.max is None:
+        depth_s = f"{1 if prec.min is None else prec.min}.."
+    else:
+        depth_s = f"{1 if prec.min is None else prec.min}..{prec.max}"
+    attrs = (
+        f"depth: {depth_s}, instruction: {prec.instruction or 'default'}"
+    )
+    inner = list(prec.parts or [])
+    nxt = following[0] if following else None
+    if any(isinstance(x, _PD) for x in inner) or (
+        not inner and isinstance(nxt, _PD)
+    ):
+        attrs += ", pattern: tree"
+        return [f"Recurse [ctx: Db] [{attrs}]"]
+    head = [f"Recurse [ctx: Db] [{attrs}]"]
+    hops = _graph_hops_flat(inner)
+    return head + (hops if hops else ["CurrentValueSource [ctx: Rt]"])
+
+
+def _lookup_raw_lines(label, flat, parent_depth):
+    """Render a `{label}: <tree>` block: the label line sits 2 spaces past
+    the parent's indent, nested nodes 4 more each."""
+    base = "    " * parent_depth + "  "
+    lines = [f"{base}{label}: {flat[0]}"]
+    for i, lab in enumerate(flat[1:], 1):
+        lines.append(base + "    " * i + lab)
+    return lines
+
+
+def _graph_lookup_lines(parts, label, parent_depth=0):
+    flat = _graph_hops_flat(parts)
+    if flat is None:
+        return None
+    return _lookup_raw_lines(label, flat, parent_depth)
 
 
 def _s_explain_generic(n: ExplainStmt, ctx: Ctx):
@@ -2179,6 +2442,39 @@ def _s_explain_generic(n: ExplainStmt, ctx: Ctx):
             lines.append((depth, f"IfElse [ctx: Rt] [{attrs}]"))
         elif isinstance(node, _Sub):
             walk_node(node.stmt, depth)
+        elif isinstance(node, SleepStmt):
+            dur = evaluate(node.duration, ctx)
+            lines.append((
+                depth,
+                f"Sleep [ctx: Rt] [duration: {render(dur)}]",
+            ))
+        elif isinstance(node, Idiom) and any(
+            isinstance(p, PGraph) for p in node.parts
+        ):
+            # graph-lookup idiom: the Expr line plus a nested lookup tree
+            from surrealdb_tpu.exec.render_def import _select_sql
+
+            arrows = {"out": "->", "in": "<-", "both": "<->", "ref": "<~"}
+            pieces = []
+            for p in node.parts:
+                if isinstance(p, tuple) and p[0] == "start":
+                    pieces.append(f"({_expr_sql(p[1])})")
+                elif isinstance(p, PGraph):
+                    if getattr(p, "expr", None) is not None:
+                        pieces.append(
+                            f"{arrows[p.dir]}({_select_sql(p.expr)})"
+                        )
+                    else:
+                        nm = ", ".join(w[0] for w in p.what) \
+                            if p.what else "?"
+                        pieces.append(f"{arrows[p.dir]}{nm}")
+                elif isinstance(p, PField):
+                    pieces.append(f".{p.name}")
+            lines.append(
+                (depth, f"Expr [ctx: Db] [expr: {''.join(pieces)}]")
+            )
+            for raw in _graph_lookup_lines(node.parts, "expr.lookup"):
+                lines.append((None, raw))
         else:
             lines.append((depth, f"Expr [ctx: Rt] [expr: {_expr_sql(node)}]"))
 
@@ -2186,6 +2482,9 @@ def _s_explain_generic(n: ExplainStmt, ctx: Ctx):
     out = []
     rows_suffix = " {rows: 0}" if n.analyze else ""
     for depth, text in lines:
+        if depth is None:
+            out.append(text)
+            continue
         out.append(("    " * depth) + text + rows_suffix)
     s_out = "\n".join(out) + "\n"
     if n.analyze:
@@ -2487,10 +2786,23 @@ def _only_wrap(results, only):
 
 def _timeout_ctx(n, ctx: Ctx) -> Ctx:
     """Child ctx with a deadline when the statement has TIMEOUT (expression-
-    valued; reference: parameterized/timeout.surql)."""
-    if getattr(n, "timeout", None) is None:
-        return ctx
+    valued; reference: parameterized/timeout.surql). Without one, the
+    global ALTER SYSTEM QUERY_TIMEOUT applies."""
     from surrealdb_tpu.val import Duration
+
+    if getattr(n, "timeout", None) is None:
+        if ctx.deadline is None:
+            try:
+                cfg = ctx.txn.get_val(K.sys_cfg()) or {}
+            except Exception:
+                cfg = {}
+            d = cfg.get("QUERY_TIMEOUT")
+            if isinstance(d, Duration):
+                c = ctx.child()
+                c.deadline = time.monotonic() + d.to_seconds()
+                c.timeout_dur = d
+                return c
+        return ctx
 
     d = evaluate(n.timeout, ctx)
     if not isinstance(d, Duration):
@@ -2885,6 +3197,24 @@ def _materialize_view(tdef: TableDef, ctx):
         pass
 
 
+def _kind_all_records(kind) -> bool:
+    """True when every leaf of the type is a record (REFERENCE is only
+    valid on record-typed fields; wrappers option/array/set pass through,
+    unions need every branch to be records)."""
+    if kind is None:
+        return False
+    nm = kind.name
+    if nm == "record":
+        return True
+    if nm in ("option", "array", "set"):
+        return all(
+            _kind_all_records(i) for i in (kind.inner or [])
+        ) and bool(kind.inner)
+    if nm == "either":
+        return all(_kind_all_records(i) for i in (kind.inner or []))
+    return False
+
+
 def _s_define_field(n: DefineField, ctx):
     if getattr(n, "flex", False):
         ns0 = ctx.session.ns
@@ -2902,6 +3232,22 @@ def _s_define_field(n: DefineField, ctx):
         ctx.txn.set_val(K.tb_def(ns, db, n.tb), TableDef(name=n.tb))
     name_str = _field_name_str(n.name)
     _check_computed_field(n, name_str, ns, db, ctx)
+    if getattr(n, "reference", None) is not None:
+        # reference define/field.rs REFERENCE validations
+        if "." in name_str or "[" in name_str:
+            raise SdbError(
+                f"Cannot use the `REFERENCE` keyword on nested field "
+                f"`{name_str}`. Specify a referencing field at the root "
+                f"level instead."
+            )
+        if n.kind is not None and not _kind_all_records(n.kind):
+            from surrealdb_tpu.exec.coerce import kind_name as _kn
+
+            raise SdbError(
+                f"Cannot use the `REFERENCE` keyword with "
+                f"`TYPE {_kn(n.kind)}`. Specify only a `record` type, or "
+                f"a type containing only records, instead."
+            )
     if name_str == "id":
         # reference define/field.rs validate_id_restrictions
         for kw, present in (
@@ -3951,11 +4297,31 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
         ctx.txn.set_val(key, d)
         return NONE
     if kind in ("system", "model", "module"):
-        if kind == "system" and ("compact", True) in (n.changes or []) \
-                and not _supports_compaction(ctx):
-            raise SdbError(
-                "The storage layer does not support compaction requests."
-            )
+        if kind == "system":
+            from surrealdb_tpu.val import Duration as _Dur
+
+            for clause, value in (n.changes or []):
+                if clause == "compact" and not _supports_compaction(ctx):
+                    raise SdbError(
+                        "The storage layer does not support compaction "
+                        "requests."
+                    )
+                if clause == "query_timeout":
+                    skey = K.sys_cfg()
+                    cfg = ctx.txn.get_val(skey) or {}
+                    if value == "__drop__":
+                        cfg.pop("QUERY_TIMEOUT", None)
+                    else:
+                        v = evaluate(value, ctx)
+                        if not isinstance(v, _Dur):
+                            raise SdbError(
+                                f"Expected a duration but found {render(v)}"
+                            )
+                        cfg["QUERY_TIMEOUT"] = v
+                    if cfg:
+                        ctx.txn.set_val(skey, cfg)
+                    else:
+                        ctx.txn.delete(skey)
         return NONE
     if kind in ("api", "bucket"):
         keyf = K.api_def if kind == "api" else K.bucket_def
@@ -3981,20 +4347,36 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
                 d.comment = v
             elif clause == "api_then":
                 methods, body = value
-                for a in d.actions:
-                    if set(a.methods) == set(methods):
-                        a.then = body
-                        break
-                else:
-                    from surrealdb_tpu.catalog import ApiActionDef
+                from surrealdb_tpu.catalog import ApiActionDef
 
-                    d.actions.append(
-                        ApiActionDef(methods, [], True, body)
-                    )
+                if methods == ["any"]:
+                    # the fallback updates in place (it renders first)
+                    for a in d.actions:
+                        if "any" in a.methods:
+                            a.then = body
+                            break
+                    else:
+                        d.actions.append(ApiActionDef(["any"], [], True, body))
+                else:
+                    # an updated method handler moves to the END of the
+                    # action list (the reference removes + re-pushes)
+                    for a in list(d.actions):
+                        if set(a.methods) == set(methods):
+                            d.actions.remove(a)
+                            a.then = body
+                            d.actions.append(a)
+                            break
+                    else:
+                        d.actions.append(ApiActionDef(methods, [], True, body))
             elif clause == "api_drop_then":
                 methods = value
                 for a in list(d.actions):
-                    if set(a.methods) == set(methods):
+                    if not any(m in a.methods for m in methods):
+                        continue
+                    # selective drop: surviving methods of a multi-method
+                    # group keep the handler under the remaining methods
+                    a.methods = [m for m in a.methods if m not in methods]
+                    if not a.methods:
                         a.then = None
                         if not a.middleware:
                             d.actions.remove(a)
@@ -4202,6 +4584,9 @@ def _s_info(n: InfoStmt, ctx: Ctx):
     if n.level == "root":
         out = {"accesses": {}, "namespaces": {}, "nodes": {}, "system": {},
                "users": {}}
+        syscfg = ctx.txn.get_val(K.sys_cfg())
+        if syscfg:
+            out["config"] = {k: v for k, v in sorted(syscfg.items())}
         dflt = ctx.txn.get_val(K.cfg_def("", "", "DEFAULT"))
         if dflt is not None:
             out["defaults"] = {k: v for k, v in sorted(dflt.items())}

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from surrealdb_tpu import key as K
 from surrealdb_tpu.expr.ast import PGraph
+from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.val import NONE, RecordId, is_truthy
 
 # frontier size at which multi-hop expansion moves to the CSR/TPU engine
@@ -42,7 +43,18 @@ def traverse_hop(rids: list, g: PGraph, ctx, ref_field=None) -> list:
     ns, db = ctx.need_ns_db()
     want = [w[0] for w in g.what] if g.what else None
     kfilt = _key_filter(g.what, ctx)
+    if ref_field is None:
+        ref_field = getattr(g, "ref_field", None)
     if g.dir == "ref":
+        if ref_field is None and any(
+            w[1] is not None for w in (g.what or [])
+        ):
+            # <~lookup:1..2 needs FIELD to bound the scan (reference:
+            # invalid-range-lookup)
+            raise SdbError(
+                "Cannot scan a specific range of record references "
+                "without a referencing field"
+            )
         out = []
         for rid in rids:
             if want:
@@ -64,21 +76,16 @@ def traverse_hop(rids: list, g: PGraph, ctx, ref_field=None) -> list:
                     if ref_field is not None and ff != ref_field:
                         continue
                     out.append(RecordId(ftb, fk))
-        # dedupe (a record may reference via several fields)
-        seen = set()
-        uniq = []
-        for r in out:
-            h = (r.tb, K.enc_value(r.id))
-            if h not in seen:
-                seen.add(h)
-                uniq.append(r)
-        out = uniq
+        # NO dedupe: a record referencing via several fields appears once
+        # per referencing field (reference via_referencing_field.surql)
         return _cond_filter(out, g, ctx)
+    # key order: IN (\x01) sorts before OUT (\x02), so a `<->` scan
+    # yields incoming edges first (reference Dir enum In < Out)
     dirs = []
-    if g.dir in ("out", "both"):
-        dirs.append(K.DIR_OUT)
     if g.dir in ("in", "both"):
         dirs.append(K.DIR_IN)
+    if g.dir in ("out", "both"):
+        dirs.append(K.DIR_OUT)
     out = []
     seen = set()
     for rid in rids:

@@ -303,9 +303,13 @@ def _remove_node(cond, node):
 
 
 def get_indexes_for(tb, ctx):
+    """Read-path index enumeration: PREPARE REMOVE decommissioned indexes
+    are invisible to the planner (writes still maintain them — the write
+    side scans the catalog directly, exec/document.py)."""
     ns, db = ctx.need_ns_db()
     return [
         d for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ix_prefix(ns, db, tb)))
+        if not getattr(d, "prepare_remove", False)
     ]
 
 
@@ -959,6 +963,25 @@ def explain_plan(tb, cond, ctx, stmt):
         ):
             group = getattr(stmt, "group", None)
             if group == []:
+                # a live COUNT index serves the whole-table count directly
+                # (reference count_exists_rewriter.rs; decommissioned
+                # PREPARE REMOVE indexes are skipped)
+                idxs0 = get_indexes_for(tb, ctx)
+                if with_index:
+                    idxs0 = [i for i in idxs0 if i.name in with_index]
+                cidx = next(
+                    (i for i in idxs0 if i.count
+                     and not getattr(i, "prepare_remove", False)),
+                    None,
+                )
+                if cidx is not None:
+                    return {
+                        "detail": {
+                            "plan": {"index": cidx.name, "operator": "Count"},
+                            "table": tb,
+                        },
+                        "operation": "Iterate Index Count",
+                    }
                 return {
                     "detail": {"direction": "forward", "table": tb},
                     "operation": "Iterate Table Count",

@@ -480,6 +480,11 @@ def changefeed_from(ns, db, versionstamp: int) -> bytes:
 # --- catalog ---------------------------------------------------------------
 
 
+def sys_cfg() -> bytes:
+    """Root system configuration (ALTER SYSTEM QUERY_TIMEOUT ...)."""
+    return b"/!sc"
+
+
 def ns_def(ns: str) -> bytes:
     return b"/!ns" + enc_str(ns)
 

@@ -1744,15 +1744,21 @@ class Parser:
             self.expect_kw("exists")
             if_exists = True
         if kind == "system":
-            # ALTER SYSTEM COMPACT / ALTER SYSTEM <setting> <value>
+            # reference grammar (syn alter.rs): exactly COMPACT, DROP
+            # QUERY_TIMEOUT, or QUERY_TIMEOUT <duration>
             changes = []
-            while self.peek().kind != L.EOF and not self.at_op(";"):
-                tname = self.next()
-                if self.at_op("="):
-                    self.next()
-                    changes.append((tname.value, self.parse_expr()))
-                else:
-                    changes.append((str(tname.value).lower(), True))
+            if self.eat_kw("compact"):
+                changes.append(("compact", True))
+            elif self.eat_kw("drop"):
+                self.expect_kw("query_timeout")
+                changes.append(("query_timeout", "__drop__"))
+            elif self.eat_kw("query_timeout"):
+                changes.append(("query_timeout", self.parse_expr()))
+            else:
+                raise self.err(
+                    "Unexpected token, expected `COMPACT`, `DROP` or "
+                    "`QUERY_TIMEOUT`"
+                )
             return AlterStmt("system", "system", None, None, if_exists, changes)
         if kind == "config":
             what = self.ident().upper()
@@ -1796,7 +1802,16 @@ class Parser:
         while True:
             if self.eat_kw("drop"):
                 clause = self.ident().lower()
-                changes.append((clause, "__drop__"))
+                if clause == "prepare":
+                    self.expect_kw("remove")
+                    changes.append(("prepare_remove", False))
+                else:
+                    changes.append((clause, "__drop__"))
+            elif kind == "index" and self.eat_kw("prepare"):
+                # ALTER INDEX ... PREPARE REMOVE: decommission — writes
+                # still maintain it, the planner stops reading it
+                self.expect_kw("remove")
+                changes.append(("prepare_remove", True))
             elif self.eat_kw("comment"):
                 changes.append(("comment", self._comment_value()))
             elif kind == "field" and self.eat_kw("type"):
@@ -1915,6 +1930,10 @@ class Parser:
                 changes.append(("duration", dur))
             else:
                 break
+        if kind == "index" and not changes:
+            raise self.err(
+                "Unexpected token, expected `PREPARE`, `DROP` or `COMMENT`"
+            )
         return AlterStmt(kind, name, tb, base, if_exists, changes)
 
     # -- kinds ---------------------------------------------------------------
@@ -2432,9 +2451,14 @@ class Parser:
                 if not self.eat_op(","):
                     break
             order = limit = start = None
+            ref_field = None
             while True:
                 if self.eat_kw("where"):
                     cond = self.parse_expr()
+                elif direction == "ref" and self.eat_kw("field"):
+                    # <~(table FIELD f): restrict to references made via
+                    # the named referencing field (reference refs lookup)
+                    ref_field = self.ident()
                 elif self.eat_kw("as"):
                     alias = self._alias_idiom()
                 elif self.eat_kw("order"):
@@ -2462,8 +2486,14 @@ class Parser:
                 sel.order = order or []
                 sel.limit = limit
                 sel.start = start
+                if ref_field is not None:
+                    sel.ref_field = ref_field
                 g = PGraph(direction, [], None, alias)
                 g.expr = sel
+                return g
+            if ref_field is not None:
+                g = PGraph(direction, what, cond, alias)
+                g.ref_field = ref_field
                 return g
         else:
             name = self.ident_or_str()
@@ -2851,7 +2881,10 @@ class Parser:
             incl = self.next().text == "..="
             end = None
             t2 = self.peek()
-            if t2.kind in (L.INT, L.IDENT, L.STRING, L.UUID_STR) or (
+            # an identifier end-key must be glued to the `..` — a detached
+            # word is the next clause (e.g. `<~(message:1>.. FIELD chat)`)
+            if (t2.kind == L.IDENT and not t2.ws_before) or \
+                    t2.kind in (L.INT, L.STRING, L.UUID_STR) or (
                 t2.kind == L.OP and t2.text in ("[", "{", "-")
             ):
                 end = self._record_key_expr()
