@@ -23,6 +23,7 @@ class DatabaseDef:
     name: str
     comment: Optional[str] = None
     changefeed: Optional[int] = None  # retention ns
+    strict: bool = False  # tables must be DEFINEd before use
 
 
 @dataclass

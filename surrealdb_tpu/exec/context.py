@@ -19,7 +19,7 @@ class Ctx:
         "executor", "ns", "db", "knn", "record_cache", "deadline",
         "timeout_dur", "write_version", "depth",
         "perms_enabled", "version", "_cond_consumed", "_cf_seq",
-        "_brute_knn_k",
+        "_brute_knn_k", "_strict_readonly",
     )
 
     def __init__(self, ds, session, txn, executor=None):
@@ -44,6 +44,7 @@ class Ctx:
         self._cond_consumed = False  # planner handled the WHERE clause
         self._cf_seq = 0
         self._brute_knn_k = None  # brute KNN global k (multi-source trim)
+        self._strict_readonly = False  # REPLACE: dropped readonly errors
 
     def child(self) -> "Ctx":
         c = Ctx.__new__(Ctx)
@@ -68,6 +69,7 @@ class Ctx:
         c._cond_consumed = False
         c._cf_seq = 0
         c._brute_knn_k = self._brute_knn_k
+        c._strict_readonly = self._strict_readonly
         from surrealdb_tpu import cnf
 
         if c.depth > cnf.MAX_COMPUTATION_DEPTH:

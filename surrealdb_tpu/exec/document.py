@@ -310,7 +310,10 @@ def get_table(tb: str, ctx: Ctx, create=True) -> TableDef:
     if tdef is None:
         if not create:
             raise SdbError(f"The table '{tb}' does not exist")
-        if ctx.ds.strict:
+        dbdef = ctx.txn.get_val(K.db_def(ns, db))
+        if ctx.ds.strict or (
+            dbdef is not None and getattr(dbdef, "strict", False)
+        ):
             raise SdbError(f"The table '{tb}' does not exist")
         from surrealdb_tpu.exec.statements import _ensure_ns_db
 
@@ -416,7 +419,11 @@ def apply_fields(
                 c.vars["after"] = cur
             # READONLY
             if fd.readonly and not is_create:
-                if cur is not NONE and old is not NONE and not value_eq(cur, old):
+                if old is not NONE and (
+                    (cur is not NONE and not value_eq(cur, old))
+                    or (cur is NONE
+                        and getattr(ctx, "_strict_readonly", False))
+                ):
                     raise SdbError(
                         f"Found changed value for field `{fd.name_str}`, with record `{rid.render()}`, but field is readonly"
                     )
@@ -1438,6 +1445,11 @@ def reduce_fields(tb, doc, ctx, action="select"):
 
 
 def update_one(rid: RecordId, before: dict, data, output, ctx: Ctx):
+    # REPLACE is strict about readonly fields: dropping one errors, while
+    # CONTENT/MERGE silently preserve them (upsert readonly tests)
+    if isinstance(data, ReplaceData):
+        ctx = ctx.child()
+        ctx._strict_readonly = True
     perms = not ctx.session.is_owner and ctx.session.auth_level != "editor"
     visible = reduce_fields(rid.tb, before, ctx) if perms else before
     c = ctx.with_doc(visible, rid)

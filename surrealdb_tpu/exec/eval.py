@@ -439,7 +439,16 @@ def call_closure(clo: Closure, args: list, ctx: Ctx):
     for i, (pname, pkind) in enumerate(clo.params):
         v = args[i] if i < len(args) else NONE
         if pkind is not None:
-            v = coerce(v, pkind)
+            try:
+                v = coerce(v, pkind)
+            except SdbError:
+                from surrealdb_tpu.exec.coerce import kind_name
+
+                raise SdbError(
+                    f"Incorrect arguments for function ANONYMOUS(). "
+                    f"Expected a value of type '{kind_name(pkind)}' for "
+                    f"argument ${pname}"
+                )
         c.vars[pname] = v
     from surrealdb_tpu.err import BreakException, ContinueException
 
@@ -454,7 +463,12 @@ def call_closure(clo: Closure, args: list, ctx: Ctx):
             "found outside of loop."
         )
     if clo.returns is not None:
-        out = coerce(out, clo.returns)
+        try:
+            out = coerce(out, clo.returns)
+        except SdbError as e:
+            raise SdbError(
+                f"Couldn't coerce return value from function `ANONYMOUS`: {e}"
+            )
     return out
 
 
@@ -774,6 +788,13 @@ def _apply_method(val, part, ctx):
                 f = doc.get(part.name)
                 if isinstance(f, Closure):
                     return call_closure(f, args, ctx)
+        if isinstance(val, dict):
+            # an object field that isn't a closure (or is absent): the
+            # reference phrases this as a failed method run
+            raise SdbError(
+                f"There was a problem running the {part.name}() function. "
+                f"no such method found for the object type"
+            )
         raise builtin_err
 
 

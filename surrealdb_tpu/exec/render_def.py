@@ -114,6 +114,8 @@ def _expr_sql(node) -> str:
     if isinstance(node, Subquery):
         return f"({_expr_sql(node.stmt)})"
     if isinstance(node, BlockExpr):
+        if not node.stmts:
+            return "{  }"
         if len(node.stmts) == 1:
             return "{ " + _expr_sql(node.stmts[0]) + " }"
         return "{ " + "; ".join(_expr_sql(s) for s in node.stmts) + "; }"

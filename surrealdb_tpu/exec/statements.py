@@ -2660,6 +2660,14 @@ def _explain_write(n, ctx):
     for expr in n.what:
         v = _target_value(expr, ctx)
         if isinstance(v, Table):
+            if defer and n.cond is None:
+                # bare-table UPSERT yields one new record — it never
+                # scans the table (Iterable::Yield)
+                out.append({
+                    "detail": {"table": v.name},
+                    "operation": "Iterate Yield",
+                })
+                continue
             plan_e = explain_plan(v.name, n.cond, ctx, n)
             out.extend(plan_e if isinstance(plan_e, list) else [plan_e])
         elif isinstance(v, RecordId) and not isinstance(v.id, Range):
@@ -2947,10 +2955,56 @@ def _s_upsert(n: UpsertStmt, ctx: Ctx):
                         if not is_truthy(evaluate(n.cond, c)):
                             continue
                     results.append(update_one(t, doc, n.data, n.output, ctx))
+            elif isinstance(t, Table) and n.cond is None:
+                # bare-table UPSERT is a Yield (reference Iterable::Yield):
+                # create ONE new record — unless a unique index already
+                # holds the new row's values, which redirects the write to
+                # that record (explicit-id UPSERT still errors instead)
+                from surrealdb_tpu.exec.document import (
+                    _find_unique_conflict,
+                    apply_data,
+                )
+
+                probe = apply_data({}, n.data, ctx.child(), None,
+                                   this_doc=NONE)
+                pid = probe.get("id")
+                if pid is not None and pid is not NONE:
+                    # data carries an explicit id: upsert THAT record
+                    from surrealdb_tpu.exec.document import record_id_key
+
+                    prid = pid if isinstance(pid, RecordId) \
+                        else RecordId(t.name, record_id_key(pid))
+                    doc = fetch_record(ctx, prid)
+                    if doc is NONE:
+                        results.append(create_one(
+                            prid, n.data, n.output, ctx, upsert=True
+                        ))
+                    else:
+                        results.append(
+                            update_one(prid, doc, n.data, n.output, ctx)
+                        )
+                    continue
+                existing_rid = _find_unique_conflict(t.name, probe, None, ctx)
+                if existing_rid is not None:
+                    doc = fetch_record(ctx, existing_rid)
+                    results.append(
+                        update_one(existing_rid, doc, n.data, n.output, ctx)
+                    )
+                else:
+                    results.append(
+                        create_one(t, n.data, n.output, ctx, upsert=True)
+                    )
             elif isinstance(t, Table):
-                # UPSERT table: update matching, create if none matched
+                # UPSERT table WHERE: update matching, create if none —
+                # an undefined table simply has no matches (no error)
                 matched = False
-                for src in _scan_table(t.name, ctx):
+                ns0, db0 = ctx.need_ns_db()
+                srcs = (
+                    _scan_table(t.name, ctx)
+                    if ctx.txn.get(K.tb_def(ns0, db0, t.name)) is not None
+                    else []
+                )
+                for src in srcs:
                     if n.cond is not None:
                         c = ctx.with_doc(src.doc, src.rid)
                         if not is_truthy(evaluate(n.cond, c)):
@@ -3104,7 +3158,10 @@ def _s_define_db(n: DefineDatabase, ctx):
 
         d = evaluate(n.changefeed, ctx)
         cf = d.ns if isinstance(d, Duration) else int(d)
-    ctx.txn.set_val(K.db_def(ns, n.name), DatabaseDef(n.name, n.comment, cf))
+    ctx.txn.set_val(
+        K.db_def(ns, n.name),
+        DatabaseDef(n.name, n.comment, cf, strict=getattr(n, "strict", False)),
+    )
     return NONE
 
 

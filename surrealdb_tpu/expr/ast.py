@@ -494,6 +494,7 @@ class DefineDatabase(Node):
     overwrite: bool = False
     comment: Optional[str] = None
     changefeed: Optional[Node] = None
+    strict: bool = False
 
 
 @dataclass

@@ -129,7 +129,10 @@ def call_function(node, ctx):
     if name.startswith("fn::"):
         return call_custom(node.name[4:], [evaluate(a, ctx) for a in node.args], ctx)
     if name.startswith("ml::"):
-        raise SdbError("ML model execution requires the surrealml sidecar (not configured)")
+        raise SdbError(
+            "Problem with machine learning computation. "
+            "Machine learning computation is not enabled."
+        )
     if name == "__future__":
         # futures evaluate lazily; this build evaluates at read time
         return evaluate(node.args[0], ctx)
@@ -305,6 +308,15 @@ def _count(args, ctx):
     v = args[0]
     if isinstance(v, list):
         return len(v)
+    from surrealdb_tpu.val import Range as _Rng, SSet as _SS
+
+    if isinstance(v, _SS):
+        return len(v)
+    if isinstance(v, _Rng):
+        try:
+            return len(list(v.iter_ints()))
+        except TypeError:
+            pass
     return 1 if is_truthy(v) else 0
 
 

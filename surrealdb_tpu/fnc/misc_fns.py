@@ -33,6 +33,21 @@ def _sha256(args, ctx):
     return hashlib.sha256(_str(args[0], "crypto::sha256", 1).encode()).hexdigest()
 
 
+@register("crypto::joaat")
+def _joaat(args, ctx):
+    """Jenkins one-at-a-time hash (u32 decimal, reference fnc/crypto)."""
+    data = _str(args[0], "crypto::joaat", 1).encode()
+    h = 0
+    for b in data:
+        h = (h + b) & 0xFFFFFFFF
+        h = (h + (h << 10)) & 0xFFFFFFFF
+        h ^= h >> 6
+    h = (h + (h << 3)) & 0xFFFFFFFF
+    h ^= h >> 11
+    h = (h + (h << 15)) & 0xFFFFFFFF
+    return str(h)
+
+
 @register("crypto::sha512")
 def _sha512(args, ctx):
     return hashlib.sha512(_str(args[0], "crypto::sha512", 1).encode()).hexdigest()
@@ -645,11 +660,15 @@ def _geo_bearing(args, ctx):
         return NONE
     (lon1, lat1) = a
     (lon2, lat2) = b
+    # geo crate Haversine::bearing op order: radians per coordinate,
+    # delta in radians, then rem_euclid(360) — the reference folds
+    # values > 180 back to the [-180, 180] range
     p1, p2 = math.radians(lat1), math.radians(lat2)
-    dl = math.radians(lon2 - lon1)
+    dl = math.radians(lon2) - math.radians(lon1)
     x = math.sin(dl) * math.cos(p2)
     y = math.cos(p1) * math.sin(p2) - math.sin(p1) * math.cos(p2) * math.cos(dl)
-    return math.degrees(math.atan2(x, y))
+    deg = math.degrees(math.atan2(x, y)) % 360.0
+    return deg - 360.0 if deg > 180.0 else deg
 
 
 def _ring_centroid(ring):

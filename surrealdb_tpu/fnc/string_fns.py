@@ -147,11 +147,20 @@ def _words(args, ctx):
     return _str(args[0], "string::words", 1).split()
 
 
+_HTML_ENC = {
+    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;",
+    "'": "&#39;", "`": "&#96;", "/": "&#47;", "=": "&#61;",
+    " ": "&#32;", "\n": "&#10;", "\r": "&#13;", "\t": "&#9;",
+}
+
+
 @register("string::html::encode")
 def _html_encode(args, ctx):
-    import html
-
-    return html.escape(_str(args[0], "f", 1))
+    # reference: ammonia::clean_text — named entities for markup chars,
+    # numeric references for separators/attribute-breaking chars
+    return "".join(
+        _HTML_ENC.get(c, c) for c in _str(args[0], "f", 1)
+    )
 
 
 @register("string::html::sanitize")
@@ -196,9 +205,20 @@ _is("semver", lambda s: bool(_SEMVER_RX.match(s)))
 _is("ulid", lambda s: bool(_ULID_RX.match(s)))
 _is("uuid", lambda s: bool(_UUID_RX.match(s)))
 _is("url", lambda s: bool(_re.match(r"^[a-zA-Z][a-zA-Z0-9+.-]*://[^\s]+$", s)))
-_is("domain", lambda s: bool(
-    _re.match(r"^([a-zA-Z0-9]([a-zA-Z0-9-]{0,61}[a-zA-Z0-9])?\.)+[a-zA-Z]{2,}$", s)
-))
+def _is_domain(s):
+    # internationalized labels validate through their punycode form
+    if not s.isascii():
+        try:
+            s = s.encode("idna").decode()
+        except UnicodeError:
+            return False
+    return bool(_re.match(
+        r"^([a-zA-Z0-9]([a-zA-Z0-9-]{0,61}[a-zA-Z0-9])?\.)+[a-zA-Z0-9-]{2,}$",
+        s,
+    ))
+
+
+_is("domain", _is_domain)
 _is("ip", lambda s: _is_ip(s))
 _is("ipv4", lambda s: _is_ipv4(s))
 _is("ipv6", lambda s: _is_ipv6(s))

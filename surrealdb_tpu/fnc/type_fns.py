@@ -207,9 +207,14 @@ def _type_of(args, ctx):
 # -- object:: -----------------------------------------------------------------
 
 
-def _obj(v, fname):
+def _obj(v, fname, idx=1):
     if not isinstance(v, dict):
-        raise SdbError(f"Incorrect arguments for function {fname}(). Expected an object")
+        from surrealdb_tpu.val import render
+
+        raise SdbError(
+            f"Incorrect arguments for function {fname}(). Argument {idx} "
+            f"was the wrong type. Expected `object` but found `{render(v)}`"
+        )
     return v
 
 
@@ -256,14 +261,55 @@ def _oextend(args, ctx):
 
 @register("object::remove")
 def _oremove(args, ctx):
+    from surrealdb_tpu.val import render
+
     out = dict(_obj(args[0], "object::remove"))
     keys = args[1] if isinstance(args[1], list) else [args[1]]
     for k in keys:
-        out.pop(str(k), None)
+        if not isinstance(k, str):
+            raise SdbError(
+                f"Incorrect arguments for function object::remove(). "
+                f"{render(k)!r} cannot be used as a key. "
+                f"Please use a string instead.".replace('"', "'")
+            )
+        out.pop(k, None)
     return out
 
 
 # -- record:: -----------------------------------------------------------------
+
+
+@register("record::is_edge")
+def _ris_edge(args, ctx):
+    from surrealdb_tpu.exec.eval import fetch_record
+    from surrealdb_tpu.val import NONE as _N
+
+    v = args[0]
+    if not isinstance(v, RecordId):
+        raise SdbError(
+            "Incorrect arguments for function record::is_edge(). "
+            "Expected a record"
+        )
+    doc = fetch_record(ctx, v)
+    return (
+        isinstance(doc, dict)
+        and isinstance(doc.get("in"), RecordId)
+        and isinstance(doc.get("out"), RecordId)
+    )
+
+
+@register("schema::table::exists")
+def _schema_tb_exists(args, ctx):
+    from surrealdb_tpu import key as K2
+
+    tb = args[0]
+    if not isinstance(tb, str):
+        raise SdbError(
+            "Incorrect arguments for function schema::table::exists(). "
+            "Expected a string"
+        )
+    ns, db = ctx.need_ns_db()
+    return ctx.txn.get(K2.tb_def(ns, db, tb)) is not None
 
 
 @register("record::exists")

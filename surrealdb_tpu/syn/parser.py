@@ -814,7 +814,7 @@ class Parser:
             d = DefineDatabase(self.name_expr(), ine, ow)
             while True:
                 if self.eat_kw("strict"):
-                    pass
+                    d.strict = True
                 elif self.eat_kw("comment"):
                     d.comment = self._comment_value()
                 elif self.eat_kw("changefeed"):

@@ -506,8 +506,19 @@ class Closure:
         return id(self)
 
     def render(self) -> str:
-        ps = ", ".join(f"${n}" for n, _k in self.params)
-        return f"|{ps}| ..."
+        from surrealdb_tpu.exec.coerce import kind_name
+        from surrealdb_tpu.exec.render_def import _expr_sql
+        from surrealdb_tpu.expr.ast import BlockExpr, Subquery
+
+        ps = ", ".join(
+            f"${n}: " + (kind_name(k) if k is not None else "any")
+            for n, k in self.params
+        )
+        ret = f" -> {kind_name(self.returns)}" if self.returns else ""
+        body = self.body
+        if isinstance(body, Subquery) and isinstance(body.stmt, BlockExpr):
+            body = body.stmt
+        return f"|{ps}|{ret} {_expr_sql(body)}"
 
 
 # ---------------------------------------------------------------------------

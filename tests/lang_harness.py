@@ -100,7 +100,7 @@ def parse_test_file(path: str) -> LangTest:
     return t
 
 
-def _exact_eq(a, b, skip_rid_keys=False, skip_dt=False) -> bool:
+def _exact_eq(a, b, skip_rid_keys=False, skip_dt=False, float_rough=False) -> bool:
     """Type-exact value equality (1 != 1f, unlike value_eq)."""
     from decimal import Decimal
 
@@ -124,16 +124,20 @@ def _exact_eq(a, b, skip_rid_keys=False, skip_dt=False) -> bool:
                 return True
         except (OverflowError, ValueError):
             pass
+        if float_rough and isinstance(a, float) and isinstance(b, float):
+            return math.isclose(a, b, rel_tol=1e-12, abs_tol=1e-15)
         return a == b
     if isinstance(a, RecordId) and skip_rid_keys:
         return a.tb == b.tb
     if isinstance(a, list):
         return len(a) == len(b) and all(
-            _exact_eq(x, y, skip_rid_keys, skip_dt) for x, y in zip(a, b)
+            _exact_eq(x, y, skip_rid_keys, skip_dt, float_rough)
+            for x, y in zip(a, b)
         )
     if isinstance(a, dict):
         return set(a) == set(b) and all(
-            _exact_eq(a[k], b[k], skip_rid_keys, skip_dt) for k in a
+            _exact_eq(a[k], b[k], skip_rid_keys, skip_dt, float_rough)
+            for k in a
         )
     return value_eq(a, b)
 
@@ -196,16 +200,23 @@ def run_lang_test(t: LangTest, ds=None):
             continue
         if "match" in want:
             # a SurrealQL expression evaluated with $result bound
+            # ($error for error-shaped matches)
             from surrealdb_tpu.val import is_truthy, render
 
-            if got.error is not None:
+            wants_error = "$error" in str(want["match"])
+            if got.error is not None and not wants_error:
                 return False, f"stmt {i}: error: {got.error}"
+            if wants_error and got.error is None:
+                return False, f"stmt {i}: expected error, got {got.result!r}"
             try:
                 mres = ds.execute(
                     f"RETURN {want['match']}",
                     ns=t.ns,
                     db=t.db,
-                    vars={"result": got.result},
+                    vars=(
+                        {"error": str(got.error)} if wants_error
+                        else {"result": got.result}
+                    ),
                 )[0]
                 ok_match = mres.ok and is_truthy(mres.result)
             except Exception as e:
@@ -227,7 +238,9 @@ def run_lang_test(t: LangTest, ds=None):
                 return False, f"stmt {i}: cannot parse expectation: {e}"
             skip_rid = bool(want.get("skip-record-id-key"))
             skip_dt = bool(want.get("skip-datetime"))
-            if not _exact_eq(got.result, expected, skip_rid, skip_dt):
+            f_rough = bool(want.get("float-roughly-eq"))
+            if not _exact_eq(got.result, expected, skip_rid, skip_dt,
+                             f_rough):
                 from surrealdb_tpu.val import render
 
                 return False, (
