@@ -316,49 +316,75 @@ class TpuVectorIndex:
         import jax
         import jax.numpy as jnp
 
-        n = len(self.rids)
         valid = self.valid.copy()
-        if jax.device_count() > 1:
-            from surrealdb_tpu.parallel.mesh import default_mesh, shard_rows
+        multi = jax.device_count() > 1
+        if self.metric not in ("euclidean", "cosine", "dot"):
+            # non-MXU metrics: exact distance kernel over the raw store
+            if multi:
+                from surrealdb_tpu.parallel.mesh import (
+                    default_mesh, shard_rows, shard_vec,
+                )
+
+                self.mesh = default_mesh()
+                self.device_vecs, pad = shard_rows(self.mesh, self.vecs)
+                self.device_valid = shard_vec(self.mesh, valid, pad)
+            else:
+                self.device_vecs = jnp.asarray(self.vecs)
+                self.device_valid = jnp.asarray(valid)
+            return
+        # MXU metrics, single- and multi-chip alike: f32 full store is
+        # the ONE host→device transfer; the bf16 ranking store (half the
+        # HBM traffic, MXU matmuls) and cosine's pre-normalized rows are
+        # derived from it ON DEVICE, so sharded and single-chip paths
+        # share the exact same prep. Per-row stats (x2 for euclidean
+        # ranking, norms for cosine rescore) are f64-accurate host
+        # computations. Stage 2 of the kernel rescores candidates from
+        # the f32 full store (ops/topk.py knn_rank_rescore /
+        # parallel/mesh.py sharded_rank_rescore).
+        xs = self.vecs
+        self.device_norms = None
+        self.device_x2 = None
+        x2 = norms = None
+        if self.metric == "euclidean":
+            x2 = (xs.astype(np.float64) ** 2).sum(axis=1).astype(np.float32)
+        elif self.metric == "cosine":
+            norms = np.maximum(
+                np.linalg.norm(xs.astype(np.float64), axis=1), 1e-30
+            ).astype(np.float32)
+        if multi:
+            from surrealdb_tpu.parallel.mesh import (
+                default_mesh, shard_rows, shard_vec,
+            )
 
             self.mesh = default_mesh()
-            self.device_vecs, pad = shard_rows(self.mesh, self.vecs)
-            if pad:
-                valid = np.pad(valid, (0, pad))
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            self.device_valid = jax.device_put(
-                valid, NamedSharding(self.mesh, P("data"))
+            self.device_full, pad = shard_rows(self.mesh, xs.astype(np.float32))
+            n = len(xs)
+            # always materialize both stats (zeros/ones when the metric
+            # doesn't use one): sharded defaults built per-query inside
+            # sharded_rank_rescore would eagerly allocate [N] on every call
+            self.device_x2 = shard_vec(
+                self.mesh, x2 if x2 is not None else np.zeros(n, np.float32),
+                pad,
             )
-            return
-        if self.metric in ("euclidean", "cosine", "dot"):
-            # bf16 ranking store (primary kernel): half the HBM traffic,
-            # MXU matmuls; candidates get exact f32 rescoring on device
-            # from the f32 full store (knn_rank_rescore stage 2)
-            xs = self.vecs
-            self.device_full = jnp.asarray(xs, dtype=jnp.float32)
-            self.device_norms = None
-            if self.metric == "cosine":
-                norms = np.maximum(
-                    np.linalg.norm(xs, axis=1, keepdims=True), 1e-30
-                )
-                self.device_rank = jnp.asarray(xs / norms, dtype=jnp.bfloat16)
-                self.device_norms = jnp.asarray(
-                    norms[:, 0].astype(np.float32)
-                )
-                self.device_x2 = None
-            elif self.metric == "euclidean":
-                self.device_rank = jnp.asarray(xs, dtype=jnp.bfloat16)
-                self.device_x2 = jnp.asarray(
-                    (xs.astype(np.float64) ** 2).sum(axis=1).astype(np.float32)
-                )
-            else:
-                self.device_rank = jnp.asarray(xs, dtype=jnp.bfloat16)
-                self.device_x2 = None
-            self.device_valid = jnp.asarray(valid)
+            self.device_norms = shard_vec(
+                self.mesh,
+                norms if norms is not None else np.ones(n, np.float32),
+                pad, 1.0,
+            )
+            self.device_valid = shard_vec(self.mesh, valid, pad)
         else:
-            self.device_vecs = jnp.asarray(self.vecs)
+            self.device_full = jnp.asarray(xs, dtype=jnp.float32)
+            if x2 is not None:
+                self.device_x2 = jnp.asarray(x2)
+            if norms is not None:
+                self.device_norms = jnp.asarray(norms)
             self.device_valid = jnp.asarray(valid)
+        if self.metric == "cosine":
+            self.device_rank = (
+                self.device_full / self.device_norms[:, None]
+            ).astype(jnp.bfloat16)
+        else:
+            self.device_rank = self.device_full.astype(jnp.bfloat16)
 
     # -- search -------------------------------------------------------------
     def knn(self, q, k: int, ctx, ef=None, cond=None, cond_ctx=None):
@@ -427,12 +453,46 @@ class TpuVectorIndex:
         n = len(self.rids)
         qs = jnp.asarray(np.ascontiguousarray(qvs, dtype=np.float32))
         if self.mesh is not None:
-            from surrealdb_tpu.parallel.mesh import sharded_knn
+            if self.device_rank is not None:
+                from surrealdb_tpu.parallel.mesh import sharded_rank_rescore
 
-            dists, ids = sharded_knn(
-                self.mesh, self.device_vecs, qs, self.device_valid, k,
-                self.metric, self.mink_p,
-            )
+                kc = max(2 * k, k + 16)
+                # same batching discipline as single-chip: fixed
+                # power-of-two query chunk (bounded set of compiled
+                # shard_map shapes under the coalescer's dynamic batch
+                # sizes), sized so the per-shard [chunk, N/shards] f32
+                # score matrix stays under the HBM budget
+                b_total = qs.shape[0]
+                nloc = self.device_rank.shape[0] // self.mesh.devices.size
+                cap = min(
+                    max(1, cnf.KNN_QUERY_CHUNK),
+                    max(1, cnf.KNN_SCORE_BUDGET_ELEMS // max(nloc, 1)),
+                )
+                chunk = 1
+                while chunk * 2 <= min(cap, b_total):
+                    chunk *= 2
+                d_parts = []
+                i_parts = []
+                for s in range(0, b_total, chunk):
+                    qc = np.asarray(qvs[s:s + chunk], dtype=np.float32)
+                    if qc.shape[0] < chunk:
+                        qc = np.pad(qc, ((0, chunk - qc.shape[0]), (0, 0)))
+                    dc, ic = sharded_rank_rescore(
+                        self.mesh, self.device_rank, self.device_full, qc,
+                        k, kc, self.metric, self.device_x2,
+                        self.device_norms, self.device_valid,
+                    )
+                    d_parts.append(np.asarray(dc))
+                    i_parts.append(np.asarray(ic))
+                dists = np.concatenate(d_parts)[:b_total]
+                ids = np.concatenate(i_parts)[:b_total]
+            else:
+                from surrealdb_tpu.parallel.mesh import sharded_knn
+
+                dists, ids = sharded_knn(
+                    self.mesh, self.device_vecs, qs, self.device_valid, k,
+                    self.metric, self.mink_p,
+                )
             dists = np.asarray(dists)
             ids = np.asarray(ids)
             return [

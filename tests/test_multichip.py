@@ -59,3 +59,77 @@ def test_sharded_knn_mesh():
     np.testing.assert_allclose(np.sort(d, axis=1), want_d, rtol=2e-3, atol=2e-3)
     for b in range(qs.shape[0]):
         assert set(i[b].tolist()) == set(want_i[b].tolist())
+
+
+def test_sharded_rank_rescore_kernel():
+    """Production two-stage sharded kernel (bf16 rank + local f32 rescore +
+    ICI candidate merge) matches exact numpy KNN."""
+    import jax
+    from surrealdb_tpu.parallel.mesh import (
+        default_mesh, shard_rows, shard_vec, sharded_rank_rescore,
+    )
+
+    rng = np.random.default_rng(7)
+    xs = rng.normal(size=(4096, 64)).astype(np.float32)
+    qs = rng.normal(size=(8, 64)).astype(np.float32)
+    mesh = default_mesh(jax.devices()[:8])
+    for metric in ("euclidean", "cosine"):
+        full, pad = shard_rows(mesh, xs)
+        if metric == "cosine":
+            norms = np.maximum(np.linalg.norm(xs, axis=1, keepdims=True), 1e-30)
+            rank, _ = shard_rows(mesh, (xs / norms).astype(np.float32))
+            rank = rank.astype("bfloat16")
+            x2 = None
+            nv = shard_vec(mesh, norms[:, 0].astype(np.float32), pad, 1.0)
+        else:
+            rank, _ = shard_rows(mesh, xs)
+            rank = rank.astype("bfloat16")
+            x2 = shard_vec(mesh, (xs.astype(np.float64) ** 2).sum(1).astype(np.float32), pad)
+            nv = None
+        valid = shard_vec(mesh, np.ones(xs.shape[0], bool), pad)
+        d, i = sharded_rank_rescore(mesh, rank, full, qs, 10, 40, metric, x2, nv, valid)
+        d, i = np.asarray(d), np.asarray(i)
+        if metric == "euclidean":
+            ref = np.linalg.norm(xs[None, :, :] - qs[:, None, :], axis=-1)
+        else:
+            xn = xs / np.maximum(np.linalg.norm(xs, axis=1, keepdims=True), 1e-30)
+            qn = qs / np.maximum(np.linalg.norm(qs, axis=1, keepdims=True), 1e-30)
+            ref = 1.0 - qn @ xn.T
+        want_i = np.argsort(ref, axis=1)[:, :10]
+        # recall@10 must be >= 0.95; exact distances for recalled ids
+        hits = sum(len(set(i[b]) & set(want_i[b])) for b in range(8))
+        assert hits / 80 >= 0.95, f"{metric} recall {hits/80}"
+        np.testing.assert_allclose(
+            np.sort(d, axis=1)[:, :8],
+            np.sort(ref, axis=1)[:, :8], rtol=5e-3, atol=5e-3)
+
+
+def test_tpu_vector_index_sharded_1m():
+    """TpuVectorIndex (the product path, not the raw kernel) engages the
+    sharded bf16 rank/rescore on a >=1M-row store over the 8-device mesh;
+    recall@10 >= 0.95 vs exact; tombstones excluded."""
+    import jax
+    from surrealdb_tpu.idx.vector import TpuVectorIndex
+    from surrealdb_tpu.val import RecordId
+
+    assert jax.device_count() >= 8
+    n, dim, k = 1_000_000, 32, 10
+    rng = np.random.default_rng(11)
+    xs = rng.normal(size=(n, dim)).astype(np.float32)
+    ix = TpuVectorIndex("t", "t", "pts", "ix", {"dimension": dim, "distance": "cosine", "vector_type": "f32"})
+    ix.vecs = xs
+    ix.valid = np.ones(n, dtype=bool)
+    ix.valid[::97] = False  # tombstones
+    ix.rids = [RecordId("pts", i) for i in range(n)]
+    ix.version = 0  # pretend synced
+    q = rng.normal(size=(dim,)).astype(np.float32)
+    pairs = ix._raw_knn(q, k)
+    assert ix.mesh is not None and ix.device_rank is not None, "sharded rank path not engaged"
+    assert len(pairs) == k
+    got = {r.id for r, _ in pairs}
+    assert not any(i % 97 == 0 for i in got), "tombstoned row returned"
+    xn = xs / np.maximum(np.linalg.norm(xs, axis=1, keepdims=True), 1e-30)
+    ref = 1.0 - xn @ (q / max(np.linalg.norm(q), 1e-30))
+    ref[~ix.valid] = np.inf
+    want = set(np.argsort(ref)[:k].tolist())
+    assert len(got & want) / k >= 0.95
