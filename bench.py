@@ -7,22 +7,68 @@ comparator measured on the same data: a numpy HNSW-style greedy-graph
 search for the KNN configs (the reference's own comparator class — its
 CPU HNSW), and a numpy adjacency walk for the graph config.
 
-Configs (BASELINE.md):
+Configs (BASELINE.md + the north-star 10M config):
   1. hnsw100k  DEFINE INDEX ... HNSW DIMENSION 128 + SELECT <|10|>  (100k)
   2. knn1m     1M x 768 cosine SELECT <|10,40|>                     (1M)
-  3. brute     vector::similarity::cosine scan, no index
-  4. graph3hop SELECT ->knows->person 3-hop over a RELATE graph
-  5. hybrid    BM25 @@ + HNSW rerank (search::rrf)
+  3. knn10m    10M x 768 cosine SELECT <|10|> — int8 rank store,
+               exact host rescore, recall vs exact ground truth (DEFAULT)
+  4. brute     vector::similarity::cosine scan, no index
+  5. graph3hop SELECT ->knows->person 3-hop over a RELATE graph
+  6. hybrid    BM25 @@ + HNSW rerank (search::rrf)
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+_PLATFORM = None
+
+
+def _probe_backend(attempts=4, wait_s=45, timeout_s=240) -> str:
+    """Bounded backend-init probe BEFORE any expensive ingest: the tunneled
+    TPU backend can hang (not just error) at init — round 2 lost all
+    measurements to exactly that (BENCH_r02 rc=1 after minutes of setup).
+    Probes in a subprocess (a hung init can't wedge the bench), retries a
+    few times, then fails FAST and LOUD. Returns the platform name."""
+    global _PLATFORM
+    if _PLATFORM is not None:
+        return _PLATFORM
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu" or os.environ.get(
+        "SURREAL_BENCH_SKIP_PROBE"
+    ):
+        _PLATFORM = "cpu"
+        return _PLATFORM
+    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    last = ""
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=timeout_s,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                _PLATFORM = r.stdout.split()[0]
+                print(f"bench: backend ready: {r.stdout.strip()}",
+                      file=sys.stderr, flush=True)
+                return _PLATFORM
+            last = (r.stderr or "no output").strip()[-500:]
+        except subprocess.TimeoutExpired:
+            last = f"backend init hung > {timeout_s}s"
+        print(f"bench: backend probe {i + 1}/{attempts} failed: {last}",
+              file=sys.stderr, flush=True)
+        if i + 1 < attempts:
+            time.sleep(wait_s)
+    print("bench: FATAL: accelerator backend never came up; no measurement "
+          "possible (set JAX_PLATFORMS=cpu for a CPU run)",
+          file=sys.stderr, flush=True)
+    sys.exit(3)
 
 
 def _bulk_vectors(ds, ns, db, tb, ix_name, xs, dim, metric="euclidean",
@@ -250,6 +296,119 @@ def bench_knn1m(quick=False):
     }
 
 
+def bench_knn10m(quick=False):
+    """North-star config (BASELINE.md): 10M×768 cosine KNN, k=10, SQL
+    search path, recall@10 vs exact f64 ground truth. At this scale the
+    index auto-selects the int8 ranking store + exact host rescore
+    (idx/vector.py: 6 B/elem for bf16+f32 ≈ 46 GB > HBM). Records live in
+    KV (the SELECT projects them); the 30 GB vector block feeds the index
+    store directly — the `he`-key ingest path is exercised by the other
+    configs and would only double host RAM here."""
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.idx.vector import TpuVectorIndex
+    from surrealdb_tpu.kvs.api import serialize
+    from surrealdb_tpu.val import RecordId
+
+    n = 100_000 if quick else 10_000_000
+    dim = 768
+    ds = Datastore("memory")
+    ds.query(
+        f"DEFINE TABLE tbl; DEFINE INDEX ix ON tbl FIELDS emb HNSW "
+        f"DIMENSION {dim} DIST COSINE TYPE F32",
+        ns="b", db="b",
+    )
+    rng = np.random.default_rng(31)
+    t0 = time.perf_counter()
+    xs = np.empty((n, dim), np.float32)
+    step = 1_000_000
+    for s in range(0, n, step):
+        e = min(s + step, n)
+        xs[s:e] = rng.normal(size=(e - s, dim)).astype(np.float32)
+    gen_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    txn = ds.transaction(write=True)
+    try:
+        for i in range(n):
+            txn.set(K.record("b", "b", "tbl", i),
+                    serialize({"id": RecordId("tbl", i)}))
+        txn.commit()
+    except BaseException:
+        txn.cancel()
+        raise
+    ingest_s = time.perf_counter() - t0
+
+    # seed the index store (device upload happens on first search)
+    ix = TpuVectorIndex("b", "b", "tbl", "ix",
+                        {"dimension": dim, "distance": "cosine",
+                         "vector_type": "f32"})
+    ix.vecs = xs
+    ix.valid = np.ones(n, dtype=bool)
+    ix.rids = [RecordId("tbl", i) for i in range(n)]
+    ix.version = 0
+    ds.vector_indexes[("b", "b", "tbl", "ix")] = ix
+
+    qs = rng.normal(size=(64, dim)).astype(np.float32)
+    sql = "SELECT id FROM tbl WHERE emb <|10|> $q"
+    t0 = time.perf_counter()
+    _run_queries(ds, sql, qs, 2)  # device build + compile
+    build_s = time.perf_counter() - t0
+    _run_queries(ds, sql, qs, 64, threads=64)  # warm batched shapes
+    qps = _run_queries(ds, sql, qs, 128 if quick else 1024, threads=64)
+
+    # recall vs exact ground truth: ONE pass over the store (chunk-outer,
+    # all queries batched per chunk; norms computed once per chunk)
+    nq = 4 if quick else 8
+    qn_mat = (qs[:nq] / np.maximum(
+        np.linalg.norm(qs[:nq], axis=1, keepdims=True), 1e-30
+    )).astype(np.float32)  # [nq, D]
+    best_d = np.full((nq, 10), np.inf)
+    best_i = np.zeros((nq, 10), np.int64)
+    for s in range(0, n, step):
+        blk = xs[s:s + step]
+        norms = np.maximum(np.linalg.norm(blk, axis=1), 1e-30)
+        d = 1.0 - (blk @ qn_mat.T).T / norms[None, :]  # [nq, chunk]
+        for qi in range(nq):
+            idx = np.argpartition(d[qi], 10)[:10]
+            cd = np.concatenate([best_d[qi], d[qi][idx]])
+            ci = np.concatenate([best_i[qi], idx + s])
+            keep = np.argpartition(cd, 10)[:10]
+            best_d[qi], best_i[qi] = cd[keep], ci[keep]
+    hits = 0
+    for qi in range(nq):
+        truth = set(best_i[qi].tolist())
+        rows = ds.query_one(sql, ns="b", db="b",
+                            vars={"q": qs[qi].tolist()})
+        got = {r["id"].id for r in rows}
+        hits += len(truth & got)
+    recall = hits / (10 * nq)
+
+    # CPU HNSW comparator (subsample — graph build cost bounds size)
+    bn = min(n, 20_000)
+    hnsw = _HostHnsw(xs[:bn])
+    t0 = time.perf_counter()
+    for i in range(32):
+        hnsw.search(qs[i % len(qs)], k=10, ef=80)
+    base_qps = 32 / (time.perf_counter() - t0)
+    size = f"{n // 1_000_000}m" if n >= 1_000_000 else f"{n // 1000}k"
+    return {
+        "metric": f"sql_knn_qps_{size}_{dim}d_cosine",
+        "value": round(qps, 2),
+        "unit": "qps",
+        "vs_baseline": round(qps / base_qps, 2),
+        "recall_at_10": round(recall, 4),
+        "cpu_hnsw_qps": round(base_qps, 2),
+        "cpu_hnsw_n": bn,
+        "rank_mode": ix.rank_mode,
+        "platform": _PLATFORM or "unprobed",
+        "gen_s": round(gen_s, 1),
+        "ingest_s": round(ingest_s, 1),
+        "device_build_s": round(build_s, 1),
+        "clients": 64,
+    }
+
+
 def bench_brute(quick=False):
     from surrealdb_tpu import Datastore
 
@@ -389,24 +548,47 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--all", action="store_true",
-                    help="run all five BASELINE configs")
-    ap.add_argument("--config", default="knn1m",
-                    choices=["hnsw100k", "knn1m", "brute", "graph3hop",
-                             "hybrid"])
+                    help="run all six configs (one JSON line each)")
+    ap.add_argument("--config", default=None,
+                    choices=["hnsw100k", "knn1m", "knn10m", "brute",
+                             "graph3hop", "hybrid"])
     args = ap.parse_args()
 
     fns = {
         "hnsw100k": bench_hnsw100k,
         "knn1m": bench_knn1m,
+        "knn10m": bench_knn10m,
         "brute": bench_brute,
         "graph3hop": bench_graph3hop,
         "hybrid": bench_hybrid,
     }
+    _probe_backend()
     if args.all:
         for name, fn in fns.items():
             print(json.dumps(fn(quick=args.quick)), flush=True)
         return 0
-    print(json.dumps(fns[args.config](quick=args.quick)))
+    if args.config:
+        print(json.dumps(fns[args.config](quick=args.quick)))
+        return 0
+    # Default (the driver's invocation): the BASELINE north-star — 10M×768
+    # KNN through the SQL path. A --quick smoke runs FIRST so a broken
+    # search path fails in ~a minute, not after a 30 GB ingest; if the 10M
+    # run itself dies (e.g. device OOM), fall back to the proven 1M config
+    # so the round still records a real measurement.
+    if args.quick:
+        print(json.dumps(bench_knn10m(quick=True)))
+        return 0
+    smoke = bench_knn1m(quick=True)
+    print(f"bench: smoke ok: {json.dumps(smoke)}", file=sys.stderr,
+          flush=True)
+    try:
+        res = bench_knn10m(quick=False)
+    except Exception as e:  # report, then fall back (Ctrl-C still exits)
+        print(f"bench: 10M config failed ({type(e).__name__}: {e}); "
+              f"falling back to 1M", file=sys.stderr, flush=True)
+        res = bench_knn1m(quick=False)
+        res["fallback_from"] = f"knn10m: {type(e).__name__}"
+    print(json.dumps(res))
     return 0
 
 

@@ -39,6 +39,15 @@ KNN_QUERY_CHUNK = env_int("SURREAL_KNN_QUERY_CHUNK", 512)
 KNN_SCORE_BUDGET_ELEMS = env_int(
     "SURREAL_KNN_SCORE_BUDGET_ELEMS", 1 << 29
 )
+# device HBM budget for the KNN stores (bytes). When bf16-rank + f32-full
+# (6 B/elem) would exceed it, the index switches to the int8 ranking store
+# (1 B/elem) + host-side exact rescore — the 10M×768 regime on a 16 GB v5e
+KNN_HBM_BUDGET_BYTES = env_int(
+    "SURREAL_KNN_HBM_BUDGET_BYTES", 12 << 30
+)
+# candidate oversampling multiple (×k) for the int8 ranking store; higher
+# absorbs quantization error before the exact host rescore
+KNN_INT8_OVERSAMPLE = env_int("SURREAL_KNN_INT8_OVERSAMPLE", 128)
 # parsed-statement cache entries (Datastore.execute)
 AST_CACHE_SIZE = env_int("SURREAL_AST_CACHE_SIZE", 512)
 # slow-query log threshold (ms); 0 disables

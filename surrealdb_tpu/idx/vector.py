@@ -97,6 +97,23 @@ def vector_index_update(idef, rid: RecordId, before, after, ctx):
     ctx.txn.set_val(vkey, ver)
 
 
+def _pow2_chunks(b_total: int, n: int, elems_budget: int):
+    """Power-of-two query bucket/chunk sizing shared by every ranking
+    branch: a bounded set of compiled kernel shapes under the coalescer's
+    dynamic batch sizes, with the [chunk, n] score matrix held under
+    `elems_budget` elements. Returns (bucket, chunk, rounds)."""
+    cap = min(
+        max(1, cnf.KNN_QUERY_CHUNK), max(1, elems_budget // max(n, 1))
+    )
+    bucket = 1
+    while bucket < b_total:
+        bucket *= 2
+    chunk = 1
+    while chunk * 2 <= min(cap, bucket):
+        chunk *= 2
+    return bucket, chunk, bucket // chunk
+
+
 def _exact_mxu_distances(metric: str, xs, q):
     """Exact f64 distances for the device-rankable metrics, shared by the
     single-query host path and the batched rescore. `xs` is [..., D] and
@@ -202,6 +219,8 @@ class TpuVectorIndex:
         self.device_full = None  # f32 full store (device exact rescore)
         self.device_norms = None  # f32 row norms (cosine rescore)
         self.device_x2 = None  # f32 row norms² (euclidean ranking)
+        self.device_arow = None  # f32 per-row dequant scale (int8 mode)
+        self.rank_mode = None  # "bf16" | "int8" | None (exact store)
         self.mesh = None
         self.coalescer = _Coalescer(self)
 
@@ -268,13 +287,19 @@ class TpuVectorIndex:
                 [self.valid, np.ones(len(add_rows), bool)]
             )
             self.rids.extend(add_rids)
+        self._drop_device()
+        return True
+
+    def _drop_device(self):
+        """Invalidate every device-resident cache (host arrays are truth)."""
         self.device_vecs = None
         self.device_valid = None
         self.device_rank = None
         self.device_full = None
         self.device_norms = None
         self.device_x2 = None
-        return True
+        self.device_arow = None
+        self.rank_mode = None
 
     def _rebuild(self, ctx):
         ns, db, tb, ix = self.key
@@ -297,12 +322,7 @@ class TpuVectorIndex:
             np.stack(rows) if rows else np.zeros((0, self.dim), self.dtype)
         )
         self.valid = np.ones(len(rids), dtype=bool)
-        self.device_vecs = None
-        self.device_valid = None
-        self.device_rank = None
-        self.device_full = None
-        self.device_norms = None
-        self.device_x2 = None
+        self._drop_device()
         # trim the consumed op log when we can write (bounds log growth)
         if getattr(ctx.txn, "write", False):
             ver = ctx.txn.get_val(K.ix_state(ns, db, tb, ix, b"vn")) or 0
@@ -351,6 +371,35 @@ class TpuVectorIndex:
             norms = np.maximum(
                 np.linalg.norm(xs.astype(np.float64), axis=1), 1e-30
             ).astype(np.float32)
+        n, dim = xs.shape
+        ndev = jax.device_count()
+        if (6 * n * dim) // max(ndev, 1) > cnf.KNN_HBM_BUDGET_BYTES:
+            # bf16 rank + f32 full (6 B/elem, per-chip share under a mesh)
+            # won't fit HBM (10M×768 ≈ 46 GB vs 16 GB on a v5e chip):
+            # int8 ranking store (1 B/elem) + EXACT host rescore of the
+            # oversampled candidates from the full-precision host rows.
+            # Not yet sharded — the int8 store lands on the default
+            # device even when a mesh is available (1/6 the footprint).
+            x8 = np.empty((n, dim), np.int8)
+            arow = np.empty(n, np.float32)
+            step = max(1, (256 << 20) // max(dim * 4, 1))
+            for s in range(0, n, step):
+                blk = xs[s:s + step].astype(np.float32)
+                if self.metric == "cosine":
+                    blk = blk / norms[s:s + step, None]
+                m = np.maximum(np.abs(blk).max(axis=1), 1e-30)
+                x8[s:s + step] = np.rint(
+                    blk * (127.0 / m)[:, None]
+                ).astype(np.int8)
+                arow[s:s + step] = m / 127.0
+            self.device_rank = jnp.asarray(x8)
+            self.device_arow = jnp.asarray(arow)
+            self.device_x2 = jnp.asarray(
+                x2 if x2 is not None else np.zeros(n, np.float32)
+            )
+            self.device_valid = jnp.asarray(valid)
+            self.rank_mode = "int8"
+            return
         if multi:
             from surrealdb_tpu.parallel.mesh import (
                 default_mesh, shard_rows, shard_vec,
@@ -385,6 +434,7 @@ class TpuVectorIndex:
             ).astype(jnp.bfloat16)
         else:
             self.device_rank = self.device_full.astype(jnp.bfloat16)
+        self.rank_mode = "bf16"
 
     # -- search -------------------------------------------------------------
     def knn(self, q, k: int, ctx, ef=None, cond=None, cond_ctx=None):
@@ -464,13 +514,9 @@ class TpuVectorIndex:
                 # score matrix stays under the HBM budget
                 b_total = qs.shape[0]
                 nloc = self.device_rank.shape[0] // self.mesh.devices.size
-                cap = min(
-                    max(1, cnf.KNN_QUERY_CHUNK),
-                    max(1, cnf.KNN_SCORE_BUDGET_ELEMS // max(nloc, 1)),
+                _, chunk, _ = _pow2_chunks(
+                    b_total, nloc, cnf.KNN_SCORE_BUDGET_ELEMS
                 )
-                chunk = 1
-                while chunk * 2 <= min(cap, b_total):
-                    chunk *= 2
                 d_parts = []
                 i_parts = []
                 for s in range(0, b_total, chunk):
@@ -503,6 +549,45 @@ class TpuVectorIndex:
                 ]
                 for drow, irow in zip(dists, ids)
             ]
+        if self.rank_mode == "int8":
+            from surrealdb_tpu.ops.topk import knn_rank_int8
+
+            kc = min(n, max(cnf.KNN_INT8_OVERSAMPLE * k, k + 16))
+            b_total = qs.shape[0]
+            # halve the score budget: the int8 kernel holds int32 dots AND
+            # the f32 score matrix at [chunk, N] concurrently
+            bucket, chunk, r = _pow2_chunks(
+                b_total, n, cnf.KNN_SCORE_BUDGET_ELEMS // 2
+            )
+            if bucket != b_total:
+                qs = jnp.pad(qs, ((0, bucket - b_total), (0, 0)))
+            cand = knn_rank_int8(
+                self.device_rank, self.device_arow, self.device_x2,
+                self.device_valid, qs.reshape(r, chunk, -1), kc, self.metric,
+            )
+            cand = np.asarray(cand).reshape(bucket, kc)[:b_total]
+            # exact host rescore from the full-precision rows (kc rows per
+            # query — tiny next to the store); per-query loop bounds the
+            # transient gather to [kc, D]
+            out = []
+            for b in range(b_total):
+                ids_b = cand[b]
+                ids_b = ids_b[(ids_b >= 0) & (ids_b < n)]
+                rows = self.vecs[ids_b]
+                d = self._host_distances(qvs[b], xs=rows)
+                d = np.where(self.valid[ids_b], d, np.inf)
+                k_eff = min(k, len(ids_b))
+                if k_eff == 0:
+                    out.append([])
+                    continue
+                sel = np.argpartition(d, k_eff - 1)[:k_eff]
+                sel = sel[np.argsort(d[sel], kind="stable")]
+                out.append([
+                    (self.rids[int(ids_b[j])], float(d[j]))
+                    for j in sel
+                    if np.isfinite(d[j])
+                ])
+            return out
         if self.device_rank is not None:
             from surrealdb_tpu.ops.topk import knn_rank_rescore
 
@@ -512,20 +597,10 @@ class TpuVectorIndex:
             kc = min(n, max(2 * k, k + 16))
             b_total = qs.shape[0]
             # chunk queries into [R, chunk, D] so arbitrarily many queries
-            # ride ONE device dispatch (per-call latency amortization);
-            # pad the batch to a power of two so dynamic batch sizes from
-            # the coalescer hit a bounded set of compiled kernel shapes
-            bucket = 1
-            while bucket < b_total:
-                bucket *= 2
-            # power-of-two chunk (so it divides the bucket), capped by the
-            # config knob and by the [chunk, N] f32 score-matrix budget
-            cap = min(max(1, cnf.KNN_QUERY_CHUNK),
-                      max(1, cnf.KNN_SCORE_BUDGET_ELEMS // max(n, 1)))
-            chunk = 1
-            while chunk * 2 <= min(cap, bucket):
-                chunk *= 2
-            r = bucket // chunk
+            # ride ONE device dispatch (per-call latency amortization)
+            bucket, chunk, r = _pow2_chunks(
+                b_total, n, cnf.KNN_SCORE_BUDGET_ELEMS
+            )
             if bucket != b_total:
                 qs = jnp.pad(qs, ((0, bucket - b_total), (0, 0)))
             dists, ids = knn_rank_rescore(

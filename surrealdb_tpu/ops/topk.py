@@ -141,6 +141,44 @@ def knn_rank_rescore(xs_rank, xs_full, qs_r, k: int, kc: int,
     return jax.lax.map(one, qs_r)
 
 
+@partial(jax.jit, static_argnames=("kc", "metric", "recall_target"))
+def knn_rank_int8(xs_q, arow, x2, valid, qs_r, kc: int,
+                  metric: str = "euclidean", recall_target: float = 0.95):
+    """Candidate-ranking kernel for stores too big for a bf16+f32 pair in
+    HBM (e.g. 10M×768 ≈ 46 GB at 6 B/elem vs 16 GB on a v5e chip): the
+    ranking store is per-row-scaled int8 (1 B/elem, 7.7 GB at 10M×768),
+    the matmul runs int8×int8→int32 on the MXU, and the EXACT rescore of
+    the returned candidates happens on the host from the f64/f32 source
+    rows (idx/vector.py), so device memory never holds a full-precision
+    copy.
+
+    `xs_q` [N, D] int8 where row r ≈ x_r / arow[r] (cosine mode quantizes
+    the pre-normalized rows); `arow` [N] f32 per-row dequant scale;
+    `x2` [N] f32 row norms² (euclidean) — pass zeros otherwise;
+    `qs_r` [R, B, D] f32 query chunks. Returns candidate ids [R, B, kc].
+    Reference hot loop replaced: idx/trees/hnsw/layer.rs:184-223."""
+
+    def one(qs):
+        sq = 127.0 / jnp.maximum(jnp.abs(qs).max(axis=1), 1e-30)  # [B]
+        q8 = jnp.round(qs * sq[:, None]).astype(jnp.int8)
+        dots = jnp.einsum(
+            "nd,bd->bn", xs_q, q8, preferred_element_type=jnp.int32
+        )
+        # dequantize: true dot ≈ dots * arow / sq
+        approx = dots.astype(jnp.float32) * (arow[None, :] / sq[:, None])
+        if metric == "euclidean":
+            score = x2[None, :] - 2.0 * approx
+        else:  # cosine (pre-normalized rows) / dot
+            score = -approx
+        score = jnp.where(valid[None, :], score, jnp.inf)
+        _, cand = jax.lax.approx_max_k(
+            -score, kc, recall_target=recall_target
+        )
+        return cand
+
+    return jax.lax.map(one, qs_r)
+
+
 @partial(jax.jit, static_argnames=("k", "metric", "block"))
 def knn_search_blocked(xs, qs, k: int, metric: str = "euclidean",
                        p: float = 3.0, valid=None, block: int = 65536):
