@@ -48,6 +48,9 @@ KNN_HBM_BUDGET_BYTES = env_int(
 # candidate oversampling multiple (×k) for the int8 ranking store; higher
 # absorbs quantization error before the exact host rescore
 KNN_INT8_OVERSAMPLE = env_int("SURREAL_KNN_INT8_OVERSAMPLE", 128)
+# content-keyed value-decode cache (bytes); identical stored bytes skip
+# CBOR re-decode on repeated scans. 0 disables.
+DECODE_CACHE_BYTES = env_int("SURREAL_DECODE_CACHE_BYTES", 256 << 20)
 # parsed-statement cache entries (Datastore.execute)
 AST_CACHE_SIZE = env_int("SURREAL_AST_CACHE_SIZE", 512)
 # slow-query log threshold (ms); 0 disables

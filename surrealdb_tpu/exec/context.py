@@ -19,7 +19,7 @@ class Ctx:
         "executor", "ns", "db", "knn", "record_cache", "deadline",
         "timeout_dur", "write_version", "depth",
         "perms_enabled", "version", "_cond_consumed", "_cf_seq",
-        "_brute_knn_k", "_strict_readonly",
+        "_brute_knn_k", "_strict_readonly", "_stream_cols",
     )
 
     def __init__(self, ds, session, txn, executor=None):
@@ -45,6 +45,7 @@ class Ctx:
         self._cf_seq = 0
         self._brute_knn_k = None  # brute KNN global k (multi-source trim)
         self._strict_readonly = False  # REPLACE: dropped readonly errors
+        self._stream_cols = None  # (ColumnCache, src) — exec/stream.py
 
     def child(self) -> "Ctx":
         c = Ctx.__new__(Ctx)
@@ -70,6 +71,7 @@ class Ctx:
         c._cf_seq = 0
         c._brute_knn_k = self._brute_knn_k
         c._strict_readonly = self._strict_readonly
+        c._stream_cols = self._stream_cols
         from surrealdb_tpu import cnf
 
         if c.depth > cnf.MAX_COMPUTATION_DEPTH:

@@ -425,6 +425,14 @@ def _e_constant(n, ctx):
 
 
 def _e_function(n, ctx):
+    sc = ctx._stream_cols
+    if sc is not None:
+        # streaming executor: this call may have been computed vectorized
+        # for the whole batch (exec/stream.py ColumnCache)
+        cols, src = sc
+        v = cols.get_row(n, src)
+        if v is not cols.MISS:
+            return v
     from surrealdb_tpu.fnc import call_function
 
     return call_function(n, ctx)

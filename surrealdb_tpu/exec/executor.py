@@ -35,6 +35,14 @@ class Executor:
         self.session = session
 
     def execute(self, stmts: list, vars: dict) -> list[QueryResult]:
+        tel = self.ds.telemetry
+        root = tel.start("query", statements=len(stmts))
+        try:
+            return self._execute(stmts, vars, tel)
+        finally:
+            tel.end(root)
+
+    def _execute(self, stmts: list, vars: dict, tel) -> list[QueryResult]:
         results: list[QueryResult] = []
         txn = None  # explicit transaction, if open
         ensured_nsdb = False
@@ -119,7 +127,11 @@ class Executor:
                 _ensure_ns_db(ctx)
             try:
                 cur.new_save_point()
-                out = eval_statement(stmt, ctx)
+                sp = tel.start(type(stmt).__name__)
+                try:
+                    out = eval_statement(stmt, ctx)
+                finally:
+                    tel.end(sp)
                 cur.release_last_save_point()
                 # persist session-level vars (LET/USE at top level)
                 if isinstance(stmt, (LetStmt,)):

@@ -228,14 +228,18 @@ def _s_option(n, ctx):
 
 
 class Source:
-    """One input row: a record (rid + doc) or a plain value."""
+    """One input row: a record (rid + doc) or a plain value. `_cols`
+    holds per-row vectorized-expression values (exec/stream.py
+    ColumnCache) — row-lifetime storage, so recycled object ids can't
+    alias rows."""
 
-    __slots__ = ("rid", "doc", "value")
+    __slots__ = ("rid", "doc", "value", "_cols")
 
     def __init__(self, rid=None, doc=None, value=NONE):
         self.rid = rid
         self.doc = doc
         self.value = value
+        self._cols = None
 
 
 def _target_value(expr, ctx):
@@ -586,6 +590,14 @@ def _s_select(n: SelectStmt, ctx: Ctx):
     # VERSION clause
     if n.version is not None:
         c.version = evaluate(n.version, ctx)
+    # streaming batched operator engine (execution engine A) for eligible
+    # plain-scan shapes; everything else stays on the legacy recursive
+    # path (reference plan_or_compute.rs legacy fallback)
+    from surrealdb_tpu.exec.stream import _UNSUPPORTED, try_stream_select
+
+    out = try_stream_select(n, c)
+    if out is not _UNSUPPORTED:
+        return out
     rows = []
     perms = not c.session.is_owner
     for src in iterate_targets(n.what, c, n.cond, n):
@@ -1351,6 +1363,22 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
         "json", "analyze-json", "postfix", "postfix-full"
     )
     orig_n = n
+    if (
+        analyze
+        and not json_fmt
+        and not getattr(
+            ctx.session, "redact_volatile_explain_attrs", False
+        )
+    ):
+        # stream-eligible statements ANALYZE through the real operator
+        # tree: measured rows/batches/elapsed per operator (reference
+        # exec/operators/explain.rs AnalyzePlan). The redacted
+        # (deterministic) form below serves the language-test harness.
+        from surrealdb_tpu.exec.stream import try_stream_analyze
+
+        real = try_stream_analyze(n, ctx)
+        if real is not None:
+            return real
 
     # ORDER BY id is the natural scan order (reversed for DESC): the
     # sort is elided and LIMIT/START push into the scan — only when the
@@ -4637,6 +4665,12 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             "threads": threading_active(),
             "tpu_devices": len(_jax.devices()) if _jax_ready() else 0,
             "metrics": dict(ctx.ds.metrics),
+            # slow-query log ring (kvs/slowlog.rs; threshold via
+            # SURREAL_SLOW_QUERY_THRESHOLD_MS)
+            "slow_queries": [
+                {"ms": ms, "statement": label}
+                for ms, label in ctx.ds.slow_log[-50:]
+            ],
         }
     if n.level == "root":
         out = {"accesses": {}, "namespaces": {}, "nodes": {}, "system": {},

@@ -26,6 +26,10 @@ class Session:
         self.rid = rid  # record-auth identity (RecordId)
         self.ac = ac  # access method name
         self.planner_strategy = None  # None | "all-ro" | "compute-only"
+        # EXPLAIN ANALYZE: omit volatile attrs (batches/elapsed) so output
+        # is deterministic — the language-test harness sets this
+        # (reference dbs/session.rs:44)
+        self.redact_volatile_explain_attrs = False
         self.variables: dict[str, Any] = {}
 
     @property
@@ -136,6 +140,9 @@ class Datastore:
         # shared across concurrent executors.
         self._ast_cache: dict = {}
         self._ast_cache_cap = cnf.AST_CACHE_SIZE
+        from surrealdb_tpu.telemetry import Telemetry
+
+        self.telemetry = Telemetry()
 
 
     # -- transactions -------------------------------------------------------

@@ -154,6 +154,15 @@ class SurrealHandler(BaseHTTPRequestHandler):
 
             self._text(200, f"surrealdb-tpu-{surrealdb_tpu.__version__}")
             return
+        if path == "/metrics":
+            # Prometheus text format (reference telemetry/metrics; pull
+            # instead of OTLP push — no egress in this build)
+            self._text(200, self.ds.telemetry.prometheus(self.ds),
+                       "text/plain; version=0.0.4")
+            return
+        if path == "/telemetry/traces":
+            self._json(200, self.ds.telemetry.recent_traces())
+            return
         if path == "/export":
             sess = self._session()
             from surrealdb_tpu.kvs.export import export_sql

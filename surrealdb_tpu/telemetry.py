@@ -1,0 +1,170 @@
+"""Telemetry: spans, Prometheus metrics, trace ring.
+
+Reference: server/src/telemetry/mod.rs:1-40 — tracing-subscriber +
+OpenTelemetry OTLP export of traces/metrics/logs, with datastore gauges
+from kvs::Metrics (ds.rs:150-167). This build has no network egress, so
+the same data is surfaced as pull endpoints instead of OTLP push:
+
+- `/metrics` (server): Prometheus text format — datastore counters,
+  query-duration histogram, HTTP/WS/RPC counters.
+- `/telemetry/traces` (server): recent per-query span trees as JSON.
+- `SURREAL_TELEMETRY_FILE`: optional JSONL span export (one span tree
+  per completed query) for offline ingestion.
+
+Spans are thread-local and cheap: `span(name)` context managers nest;
+each query's root span lands in a bounded ring buffer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+_BUCKETS_MS = (0.1, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
+               2500, 5000, 10000)
+
+
+class Span:
+    __slots__ = ("name", "start_ns", "dur_ns", "attrs", "children")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.start_ns = time.time_ns()
+        self.dur_ns = 0
+        self.attrs: dict = {}
+        self.children: list[Span] = []
+
+    def to_dict(self):
+        d = {
+            "name": self.name,
+            "start_ns": self.start_ns,
+            "dur_us": round(self.dur_ns / 1000, 1),
+        }
+        if self.attrs:
+            d["attrs"] = self.attrs
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+class Telemetry:
+    """Per-datastore telemetry hub (counters + histogram + trace ring)."""
+
+    def __init__(self, ring_size: int = 256):
+        self.lock = threading.Lock()
+        self.ring_size = ring_size
+        self.traces: list[dict] = []
+        self.counters: dict[str, int] = {}
+        # query duration histogram (cumulative bucket counts, Prometheus
+        # `le` semantics) + sum/count
+        self.hist = [0] * (len(_BUCKETS_MS) + 1)
+        self.hist_sum_ms = 0.0
+        self.hist_count = 0
+        self._local = threading.local()
+        self._export_path = os.environ.get("SURREAL_TELEMETRY_FILE") or None
+        self._export_lock = threading.Lock()
+
+    # -- counters -----------------------------------------------------------
+    def inc(self, name: str, by: int = 1):
+        with self.lock:
+            self.counters[name] = self.counters.get(name, 0) + by
+
+    # -- spans --------------------------------------------------------------
+    def start(self, name: str, **attrs) -> Span:
+        """Open a span nested under the thread's current span."""
+        s = Span(name)
+        s.attrs.update(attrs)
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = []
+            self._local.stack = stack
+        if stack:
+            stack[-1].children.append(s)
+        stack.append(s)
+        s.dur_ns = -time.perf_counter_ns()  # closed in end()
+        return s
+
+    def end(self, s: Span):
+        s.dur_ns += time.perf_counter_ns()
+        stack = getattr(self._local, "stack", None)
+        if stack and stack[-1] is s:
+            stack.pop()
+        if not stack:
+            self._finish_trace(s)
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Nested span context; completing the outermost span records the
+        trace into the ring (and the JSONL export, when configured)."""
+        s = self.start(name, **attrs)
+        try:
+            yield s
+        finally:
+            self.end(s)
+
+    def _finish_trace(self, s: Span):
+        ms = s.dur_ns / 1e6
+        with self.lock:
+            self.hist_count += 1
+            self.hist_sum_ms += ms
+            for i, edge in enumerate(_BUCKETS_MS):
+                if ms <= edge:
+                    self.hist[i] += 1
+                    break
+            else:
+                self.hist[-1] += 1
+            self.traces.append(s.to_dict())
+            if len(self.traces) > self.ring_size:
+                del self.traces[: self.ring_size // 2]
+        if self._export_path:
+            try:
+                with self._export_lock, open(self._export_path, "a") as f:
+                    f.write(json.dumps(s.to_dict()) + "\n")
+            except OSError:
+                pass
+
+    def recent_traces(self, limit: int = 64):
+        with self.lock:
+            return list(self.traces[-limit:])
+
+    # -- prometheus ---------------------------------------------------------
+    def prometheus(self, ds=None) -> str:
+        """Render Prometheus text-format metrics (server /metrics)."""
+        lines = []
+
+        def counter(name, value, help_=None):
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {value}")
+
+        with self.lock:
+            counters = dict(self.counters)
+            hist = list(self.hist)
+            hsum, hcount = self.hist_sum_ms, self.hist_count
+        if ds is not None:
+            for k, v in ds.metrics.items():
+                counter(f"surreal_ds_{k}_total", v,
+                        "datastore counter (kvs::Metrics analog)")
+            lines.append("# TYPE surreal_live_queries gauge")
+            lines.append(f"surreal_live_queries {len(ds.live_queries)}")
+            lines.append("# TYPE surreal_vector_indexes gauge")
+            lines.append(f"surreal_vector_indexes {len(ds.vector_indexes)}")
+        for k in sorted(counters):
+            counter(f"surreal_{k}_total", counters[k])
+        lines.append("# TYPE surreal_query_duration_ms histogram")
+        acc = 0
+        for i, edge in enumerate(_BUCKETS_MS):
+            acc += hist[i]
+            lines.append(
+                f'surreal_query_duration_ms_bucket{{le="{edge}"}} {acc}'
+            )
+        lines.append(
+            f'surreal_query_duration_ms_bucket{{le="+Inf"}} {hcount}'
+        )
+        lines.append(f"surreal_query_duration_ms_sum {round(hsum, 3)}")
+        lines.append(f"surreal_query_duration_ms_count {hcount}")
+        return "\n".join(lines) + "\n"

@@ -961,13 +961,30 @@ def to_json(v):
 
 
 def copy_value(v):
-    """Deep copy of a value (records are mutated in the doc pipeline)."""
-    if isinstance(v, list):
-        return [copy_value(x) for x in v]
+    """Deep copy of a value (records are mutated in the doc pipeline).
+    Exact-type fast paths: scalar elements copy by shallow list/dict copy
+    without a per-element call (numeric vectors are the hot shape)."""
+    t = type(v)
+    if t is list:
+        out = list(v)
+        for i, x in enumerate(out):
+            tx = type(x)
+            if tx is list or tx is dict or tx is SSet:
+                out[i] = copy_value(x)
+        return out
+    if t is dict:
+        out = dict(v)
+        for k, x in out.items():
+            tx = type(x)
+            if tx is list or tx is dict or tx is SSet:
+                out[k] = copy_value(x)
+        return out
     if isinstance(v, SSet):
         s = SSet.__new__(SSet)
         s.items = [copy_value(x) for x in v.items]
         return s
+    if isinstance(v, list):  # subclasses — generic path
+        return [copy_value(x) for x in v]
     if isinstance(v, dict):
         return {k: copy_value(x) for k, x in v.items()}
     return v

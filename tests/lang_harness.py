@@ -153,6 +153,8 @@ def run_lang_test(t: LangTest, ds=None):
 
     sess = Session(ns=t.ns, db=t.db, auth_level="owner")
     sess.planner_strategy = getattr(t, "planner", None)
+    # golden files pin deterministic ANALYZE output (rows only)
+    sess.redact_volatile_explain_attrs = True
     auth = getattr(t, "auth", None)
     run_sess = sess
     if isinstance(auth, dict) and (auth.get("rid") or auth.get("access")):
@@ -162,6 +164,7 @@ def run_lang_test(t: LangTest, ds=None):
             auth_level="record", ac=auth.get("access"),
         )
         run_sess.planner_strategy = sess.planner_strategy
+        run_sess.redact_volatile_explain_attrs = True
         rid = auth.get("rid")
         if rid:
             rv = ds.execute(f"RETURN {rid}", ns=t.ns, db=t.db)

@@ -1,0 +1,161 @@
+"""Streaming batched executor (exec/stream.py) + telemetry surfaces.
+
+Reference roles: core/src/exec/mod.rs (operator DAG), exec/metrics.rs
+(EXPLAIN ANALYZE counters), server/src/telemetry/ (metrics endpoints).
+"""
+
+import numpy as np
+
+
+def _stream_used(ds, sql, ns="test", db="test", vars=None):
+    """Runs sql and reports whether the streaming engine handled it."""
+    from surrealdb_tpu.exec import statements as st
+    from surrealdb_tpu.exec import stream
+
+    used = []
+    orig = stream.try_stream_select
+
+    def spy(n, ctx):
+        out = orig(n, ctx)
+        used.append(out is not stream._UNSUPPORTED)
+        return out
+
+    stream.try_stream_select = spy
+    st.try_stream_select = spy  # imported symbol inside _s_select body
+    try:
+        rows = ds.query(sql, ns=ns, db=db, vars=vars)
+    finally:
+        stream.try_stream_select = orig
+    return rows, (used and used[0])
+
+
+def test_stream_matches_legacy(q, ds):
+    q("CREATE p:1 SET n = 3, t = 'c'; CREATE p:2 SET n = 1, t = 'a'; "
+      "CREATE p:3 SET n = 2, t = 'b'")
+    for sql in [
+        "SELECT * FROM p",
+        "SELECT * FROM p WHERE n > 1",
+        "SELECT n, t FROM p ORDER BY n DESC",
+        "SELECT * FROM p ORDER BY t LIMIT 2",
+        "SELECT * FROM p ORDER BY n DESC LIMIT 1 START 1",
+        "SELECT * FROM p LIMIT 2 START 1",
+        "SELECT VALUE n FROM p ORDER BY n",
+        "SELECT * FROM p ORDER BY id",
+        "SELECT * FROM p ORDER BY id DESC",
+    ]:
+        rows, used = _stream_used(ds, sql)
+        assert used, f"streaming engine skipped: {sql}"
+        # legacy comparison: force compute-only strategy
+        from surrealdb_tpu.kvs.ds import Session
+
+        sess = Session(ns="test", db="test", auth_level="owner")
+        sess.planner_strategy = "compute-only"
+        legacy = [
+            r.unwrap() for r in ds.execute(sql, session=sess)
+        ]
+        assert rows == legacy, f"mismatch for {sql}"
+
+
+def test_stream_vectorized_projection(q, ds):
+    rng = np.random.default_rng(5)
+    q("DEFINE TABLE v")
+    xs = rng.normal(size=(50, 8))
+    q("FOR $i IN 0..50 { CREATE type::thing('v', $i) SET emb = $e[$i] }",
+      e=xs.tolist())
+    qv = rng.normal(size=(8,)).tolist()
+    sql = ("SELECT id, vector::similarity::cosine(emb, $q) AS s FROM v "
+           "ORDER BY s DESC LIMIT 5")
+    rows, used = _stream_used(ds, sql, vars={"q": qv})
+    rows = rows[-1]
+    assert used
+    xn = xs / np.linalg.norm(xs, axis=1, keepdims=True)
+    qn = np.asarray(qv) / np.linalg.norm(qv)
+    sims = xn @ qn
+    want = np.argsort(-sims)[:5]
+    got = [r["id"].id for r in rows]
+    assert got == [int(i) for i in want]
+    np.testing.assert_allclose(
+        [r["s"] for r in rows], np.sort(sims)[::-1][:5], rtol=1e-9
+    )
+
+
+def test_stream_fallback_shapes(q, ds):
+    """GROUP/graph/index statements still route to the legacy engine."""
+    q("CREATE g:1 SET n = 1; CREATE g:2 SET n = 1")
+    rows, used = _stream_used(ds, "SELECT n, count() AS c FROM g GROUP BY n")
+    assert not used
+    assert rows[0] == [{"n": 1, "c": 2}]
+
+
+def test_explain_analyze_real_metrics(ds):
+    """Unredacted EXPLAIN ANALYZE executes the operator tree and prints
+    measured rows/batches/elapsed; redacted form stays deterministic."""
+    ds.query("CREATE m:1 SET n = 5; CREATE m:2 SET n = 7", ns="t", db="t")
+    from surrealdb_tpu.kvs.ds import Session
+
+    sess = Session(ns="t", db="t", auth_level="owner")
+    sess.planner_strategy = "all-ro"
+    txt = [r.unwrap() for r in ds.execute(
+        "EXPLAIN ANALYZE SELECT * FROM m WHERE n > 6", session=sess
+    )][0]
+    assert "TableScan" in txt and "elapsed:" in txt and "batches: " in txt
+    assert "{rows: 1" in txt  # measured post-filter rows
+    assert txt.strip().endswith("Total rows: 1")
+    sess2 = Session(ns="t", db="t", auth_level="owner")
+    sess2.planner_strategy = "all-ro"
+    sess2.redact_volatile_explain_attrs = True
+    red = [r.unwrap() for r in ds.execute(
+        "EXPLAIN ANALYZE SELECT * FROM m WHERE n > 6", session=sess2
+    )][0]
+    assert "elapsed" not in red and "{rows: 1}" in red
+
+
+def test_telemetry_spans_and_prometheus(ds):
+    ds.query("CREATE s:1 SET x = 1; SELECT * FROM s", ns="t", db="t")
+    traces = ds.telemetry.recent_traces()
+    assert traces, "no traces recorded"
+    root = traces[-1]
+    assert root["name"] == "query" and root["dur_us"] > 0
+    assert any(c["name"] == "SelectStmt" for c in root.get("children", []))
+    text = ds.telemetry.prometheus(ds)
+    assert "surreal_ds_statements_total" in text
+    assert 'surreal_query_duration_ms_bucket{le="+Inf"}' in text
+    assert "surreal_live_queries 0" in text
+
+
+def test_stream_multibatch_vectorized_no_sort(q, ds):
+    """>2 batches with a vectorized projection and NO sort: computed
+    values must track each row (regression: recycled id(src) served a
+    previous batch's score)."""
+    import surrealdb_tpu.exec.stream as stream
+
+    old = stream.BATCH_SIZE
+    stream.BATCH_SIZE = 16
+    try:
+        rng = np.random.default_rng(9)
+        q("DEFINE TABLE vb")
+        xs = rng.normal(size=(100, 4))
+        q("FOR $i IN 0..100 { CREATE type::thing('vb', $i) SET emb = $e[$i] }",
+          e=xs.tolist())
+        qv = rng.normal(size=(4,)).tolist()
+        rows, used = _stream_used(
+            ds, "SELECT id, vector::similarity::cosine(emb, $q) AS s FROM vb",
+            vars={"q": qv})
+        rows = rows[-1]
+        assert used
+        xn = xs / np.linalg.norm(xs, axis=1, keepdims=True)
+        qn = np.asarray(qv) / np.linalg.norm(qv)
+        sims = {i: float(s) for i, s in enumerate(xn @ qn)}
+        for r in rows:
+            np.testing.assert_allclose(r["s"], sims[r["id"].id], rtol=1e-9)
+    finally:
+        stream.BATCH_SIZE = old
+
+
+def test_stream_order_by_aliased_id(q, ds):
+    """ORDER BY id where `id` aliases another expr must SORT, not elide
+    (legacy _resolve_alias semantics)."""
+    q("CREATE al:1 SET name = 'z'; CREATE al:2 SET name = 'a'; "
+      "CREATE al:3 SET name = 'm'")
+    rows, _used = _stream_used(ds, "SELECT name AS id FROM al ORDER BY id")
+    assert [r["id"] for r in rows[-1]] == ["a", "m", "z"]
