@@ -52,6 +52,11 @@ def main(argv=None):
     p_rdy = sub.add_parser("isready")
     p_rdy.add_argument("--conn", default="http://127.0.0.1:8000")
 
+    p_kv = sub.add_parser(
+        "kv", help="run the shared transactional KV service (cluster mode)"
+    )
+    p_kv.add_argument("--bind", default="127.0.0.1:8100")
+
     sub.add_parser("version")
 
     args = ap.parse_args(argv)
@@ -87,6 +92,13 @@ def main(argv=None):
             pass
         print("Not ready")
         return 1
+
+    if args.cmd == "kv":
+        from surrealdb_tpu.kvs.remote import serve_kv
+
+        host, _, port = args.bind.partition(":")
+        serve_kv(host, int(port), block=True)
+        return 0
 
     from surrealdb_tpu import Datastore
 

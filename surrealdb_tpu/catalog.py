@@ -196,3 +196,4 @@ class SubscriptionDef:
     session_vars: dict = field(default_factory=dict)
     auth_level: str = "owner"
     rid: Any = None
+    node: Any = None  # owning node id (dead-node GC, dbs/node.rs)

@@ -4017,6 +4017,7 @@ def _s_define_sequence(n: DefineSequence, ctx):
             raise SdbError(f"Expected a duration but found {render(tmo)}")
     sd = SequenceDef(n.name, n.batch, n.start, tmo)
     ctx.txn.set_val(kdef, (sd, n.start))
+    ctx.ds.sequences.pop((ns, db, n.name), None)  # drop stale local batch
     return NONE
 
 
@@ -4254,6 +4255,7 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
         if _guard(key, n.name):
             return NONE
         ctx.txn.delete(key)
+        ctx.ds.sequences.pop((ns, db, n.name), None)
         return NONE
     if kind in ("config", "api", "bucket"):
         keyf = {"config": K.cfg_def, "api": K.api_def,
@@ -4884,6 +4886,7 @@ def _s_live(n: LiveStmt, ctx: Ctx):
         session_vars=dict(ctx.vars),
         auth_level=ctx.session.auth_level,
         rid=ctx.session.rid,
+        node=ctx.ds.node_id,
     )
     ctx.txn.set_val(K.lq_def(ns, db, what.name, str(lid.u)), sub)
     ctx.ds.live_queries[str(lid.u)] = sub

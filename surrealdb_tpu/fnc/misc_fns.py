@@ -883,18 +883,50 @@ def _s_token(args, ctx):
 
 @register("sequence::nextval")
 def _nextval(args, ctx):
+    """Batch-allocated distributed sequences (kvs/sequences.rs:1-20):
+    each node transactionally claims a BATCH-sized id range from the KV
+    state row in its OWN transaction, then hands ids out locally — so
+    concurrent nodes contend once per batch, not once per id, and ids
+    survive the calling statement's rollback (reference semantics)."""
     from surrealdb_tpu import key as K
-    from surrealdb_tpu.catalog import SequenceDef
+    from surrealdb_tpu.kvs.mem import CONFLICT_MSG
 
     name = _str(args[0], "sequence::nextval", 1)
     ns, db = ctx.need_ns_db()
     kdef = K.seq_state(ns, db, name)
+    skey = (ns, db, name)
+    with ctx.ds.lock:
+        rng = ctx.ds.sequences.get(skey)
+        if rng is not None and rng[0] < rng[1]:
+            v = rng[0]
+            rng[0] += 1
+            return v
     st = ctx.txn.get_val(kdef)
     if st is None:
         raise SdbError(f"The sequence '{name}' does not exist")
-    sd, current = st
-    ctx.txn.set_val(kdef, (sd, current + 1))
-    return current
+    for _ in range(16):
+        txn = ctx.ds.transaction(write=True)
+        try:
+            st2 = txn.get_val(kdef)
+            if st2 is None:
+                # defined inside the caller's still-uncommitted txn:
+                # allocate through that txn (single-node bootstrap case)
+                txn.cancel()
+                sd, current = st
+                ctx.txn.set_val(kdef, (sd, current + 1))
+                return current
+            sd, current = st2
+            batch = max(int(getattr(sd, "batch", 1000) or 1), 1)
+            txn.set_val(kdef, (sd, current + batch))
+            txn.commit()
+            with ctx.ds.lock:
+                ctx.ds.sequences[skey] = [current + 1, current + batch]
+            return current
+        except SdbError as e:
+            txn.cancel()
+            if str(e) != CONFLICT_MSG:
+                raise
+    raise SdbError(f"sequence '{name}' allocation contention")
 
 
 # -- value / search / http stubs ---------------------------------------------

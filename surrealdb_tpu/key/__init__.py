@@ -592,6 +592,18 @@ def seq_state(ns, db, name) -> bytes:  # sequence state
     return b"/!sq" + enc_str(ns) + enc_str(db) + enc_str(name)
 
 
+def node(nid: str) -> bytes:  # cluster node registry (reference /${nd})
+    return b"/$nd" + enc_str(nid)
+
+
+def node_prefix() -> bytes:
+    return b"/$nd"
+
+
+def task_lease(name: str) -> bytes:  # cluster task lease (tasklease.rs:44)
+    return b"/$tl" + enc_str(name)
+
+
 def api_def(ns, db, path) -> bytes:  # DEFINE API
     return b"/!ap" + enc_str(ns) + enc_str(db) + enc_str(path)
 

@@ -138,8 +138,44 @@ def deserialize(b: bytes):
             return copy_value(v)
         return v
     if b[:1] == b"\x00":
-        return pickle.loads(b[1:])
-    return pickle.loads(b)
+        return _restricted_loads(b[1:])
+    return _restricted_loads(b)
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """The pickle fallback codec only ever stores this package's own
+    types (AST-bearing catalog structs) plus stdlib value types. In
+    cluster mode stored bytes arrive from OTHER nodes over the KV
+    service, so arbitrary-import unpickling would be a remote-code
+    channel — restrict global lookups to an allowlist."""
+
+    _ALLOWED_MODULES = ("surrealdb_tpu.",)
+    _ALLOWED_EXACT = {
+        ("builtins", "set"), ("builtins", "frozenset"),
+        ("builtins", "complex"), ("builtins", "bytearray"),
+        ("collections", "OrderedDict"), ("collections", "defaultdict"),
+        ("datetime", "datetime"), ("datetime", "timedelta"),
+        ("datetime", "timezone"), ("datetime", "date"), ("datetime", "time"),
+        ("decimal", "Decimal"), ("uuid", "UUID"), ("re", "_compile"),
+        ("numpy", "dtype"), ("numpy", "ndarray"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "_reconstruct"),
+    }
+
+    def find_class(self, module, name):
+        if module.startswith(self._ALLOWED_MODULES) or (
+            module, name
+        ) in self._ALLOWED_EXACT:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"stored value references disallowed type {module}.{name}"
+        )
+
+
+def _restricted_loads(b: bytes):
+    import io
+
+    return _RestrictedUnpickler(io.BytesIO(b)).load()
 
 
 class Transaction:

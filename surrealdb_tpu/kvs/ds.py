@@ -106,6 +106,12 @@ class Datastore:
             from surrealdb_tpu.kvs.file import FileBackend
 
             self.backend = FileBackend(path.split("://", 1)[1])
+        elif path.startswith("remote://"):
+            # distributed mode: stateless database node over a shared
+            # transactional KV service (reference kvs/tikv/mod.rs:32)
+            from surrealdb_tpu.kvs.remote import RemoteBackend
+
+            self.backend = RemoteBackend(path.split("://", 1)[1])
         else:
             raise SdbError(f"unknown datastore path: {path!r}")
         # cross-transaction caches / engines
@@ -143,6 +149,23 @@ class Datastore:
         from surrealdb_tpu.telemetry import Telemetry
 
         self.telemetry = Telemetry()
+        # cluster identity (reference dbs/node.rs); background loops start
+        # only for served/clustered instances via start_node_tasks()
+        from surrealdb_tpu.node import make_node_id
+
+        self.node_id = make_node_id()
+        self.node_tasks = None
+
+    def start_node_tasks(self, interval_s: float = 10.0,
+                         stale_s: float = 30.0):
+        """Start heartbeat + membership-check loops (reference
+        engine/tasks.rs:48-56). Idempotent."""
+        from surrealdb_tpu.node import NodeTasks
+
+        if self.node_tasks is None:
+            self.node_tasks = NodeTasks(self, interval_s, stale_s)
+            self.node_tasks.start()
+        return self.node_tasks
 
 
     # -- transactions -------------------------------------------------------
@@ -228,4 +251,6 @@ class Datastore:
             return (int(time.time() * 1000) << 20) | (self.changefeed_vs & 0xFFFFF)
 
     def close(self):
+        if self.node_tasks is not None:
+            self.node_tasks.stop()
         self.backend.close()

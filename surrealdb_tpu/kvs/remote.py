@@ -1,0 +1,414 @@
+"""Network KV engine: the distributed-storage role of the reference's
+TiKV backend (core/src/kvs/tikv/mod.rs:32-103) — stateless database
+nodes over a shared transactional KV service.
+
+One `surreal kv` server process owns the MVCC keyspace (the same
+VersionedStore the in-process engine uses: snapshot isolation +
+optimistic write-write validation). Database nodes connect with
+`Datastore("remote://host:port")`; a transaction pins a server snapshot,
+buffers writes locally (client-side overlay, like the reference's
+optimistic txns), and ships the whole writeset at commit for validation
+under the server's store lock. Wire format: 4-byte length-prefixed CBOR
+frames (wire.py) — no pickle on the wire protocol itself.
+
+Security model: the KV service is a CLUSTER-INTERNAL endpoint (the
+reference's TiKV gRPC port is the same); optional shared-secret auth
+(SURREAL_KV_SECRET / KvServer(secret=...)) rejects unauthenticated
+peers, and the value codec's pickle fallback is import-restricted
+(kvs/api.py) so stored bytes can't smuggle arbitrary code objects.
+
+Connection model: each transaction pins ONE pooled connection for its
+lifetime, so the server's per-connection snapshot accounting is exact —
+a dying client's pins are released on disconnect, and releases can never
+land on a different connection than the snap that created them.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import socketserver
+import struct
+import threading
+from collections import Counter
+from typing import Optional
+
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.kvs.api import Backend, BackendTx
+from surrealdb_tpu.kvs.mem import VersionedStore
+
+_HDR = struct.Struct(">I")
+MAX_FRAME = 256 << 20
+
+
+def _send_frame(sock, payload: bytes):
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("kv peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock) -> bytes:
+    (n,) = _HDR.unpack(_recv_exact(sock, 4))
+    if n > MAX_FRAME:
+        raise SdbError(f"kv frame too large: {n}")
+    return _recv_exact(sock, n)
+
+
+def _encode(msg) -> bytes:
+    from surrealdb_tpu import wire
+
+    return wire.encode(msg)
+
+
+def _decode(b: bytes):
+    from surrealdb_tpu import wire
+
+    return wire.decode(b)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class _KvHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        vs: VersionedStore = self.server.vs
+        self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # snapshots held by THIS connection, as a multiset: several txns
+        # pooled onto one connection can legitimately pin the same version
+        owned: Counter = Counter()
+        authed = not self.server.secret
+        try:
+            while True:
+                try:
+                    req = _decode(_recv_frame(self.request))
+                except ConnectionError:
+                    break
+                if not authed:
+                    if (isinstance(req, list) and len(req) == 2
+                            and req[0] == "auth"
+                            and req[1] == self.server.secret):
+                        authed = True
+                        _send_frame(self.request, _encode(["ok", None]))
+                        continue
+                    _send_frame(
+                        self.request, _encode(["err", "kv auth required"])
+                    )
+                    break
+                try:
+                    resp = self._dispatch(vs, req, owned)
+                except SdbError as e:
+                    resp = ["err", str(e)]
+                except Exception as e:  # internal — surface, keep serving
+                    resp = ["err", f"kv internal error: {e}"]
+                _send_frame(self.request, _encode(resp))
+        finally:
+            # a dying client must not pin MVCC chains forever
+            for snap, cnt in owned.items():
+                for _ in range(cnt):
+                    vs.release(snap)
+
+    def _dispatch(self, vs, req, owned):
+        op = req[0]
+        if op == "get":
+            return ["ok", vs.read(req[1], req[2])]
+        if op == "range":
+            _op, beg, end, snap, limit, reverse = req
+            items = vs.range_items(beg, end, snap, limit, bool(reverse))
+            return ["ok", [[k, v] for k, v in items]]
+        if op == "snap":
+            snap = vs.snapshot()
+            owned[snap] += 1
+            return ["ok", snap]
+        if op == "rel":
+            snap = req[1]
+            if owned[snap] > 0:
+                owned[snap] -= 1
+                if not owned[snap]:
+                    del owned[snap]
+                vs.release(snap)
+            return ["ok", None]
+        if op == "commit":
+            _op, pairs, snap = req
+            writes = {k: v for k, v in pairs}
+            # vs.commit releases the snapshot itself (success OR conflict),
+            # so drop our bookkeeping entry unconditionally
+            if owned[snap] > 0:
+                owned[snap] -= 1
+                if not owned[snap]:
+                    del owned[snap]
+            else:
+                raise SdbError("kv commit: unknown snapshot")
+            ver = vs.commit(writes, snap)  # raises SdbError on conflict
+            return ["ok", ver]
+        if op == "seed":
+            with vs.lock:
+                for k, v in req[1]:
+                    vs.seed(k, v)
+            return ["ok", None]
+        if op == "ping":
+            return ["ok", "pong"]
+        raise SdbError(f"unknown kv op {op!r}")
+
+
+class KvServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, secret: Optional[str] = None):
+        super().__init__(addr, _KvHandler)
+        self.vs = VersionedStore()
+        self.secret = secret
+
+
+def serve_kv(host="127.0.0.1", port=8100, block=True,
+             secret: Optional[str] = None) -> KvServer:
+    if secret is None:
+        secret = os.environ.get("SURREAL_KV_SECRET") or None
+    srv = KvServer((host, port), secret=secret)
+    if block:
+        print(f"surrealdb-tpu kv service on {host}:{port}"
+              + (" (authenticated)" if secret else ""))
+        srv.serve_forever()
+    else:
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class _Conn:
+    def __init__(self, addr, secret: Optional[str]):
+        self.sock = socket.create_connection(addr, timeout=30)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if secret:
+            self.call(["auth", secret])
+
+    def call(self, msg):
+        _send_frame(self.sock, _encode(msg))
+        resp = _decode(_recv_frame(self.sock))
+        if resp[0] == "err":
+            raise SdbError(resp[1])
+        return resp[1]
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _Pool:
+    """Connection pool. A transaction CHECKS OUT one connection for its
+    whole lifetime (snapshot accounting correctness); short one-shot ops
+    borrow + return per call."""
+
+    def __init__(self, addr, secret=None, size=64):
+        self.addr = addr
+        self.secret = secret
+        self.size = size
+        self.q: queue.LifoQueue = queue.LifoQueue()
+        self.count = 0
+        self.lock = threading.Lock()
+
+    def acquire(self) -> _Conn:
+        try:
+            return self.q.get_nowait()
+        except queue.Empty:
+            pass
+        with self.lock:
+            if self.count < self.size:
+                self.count += 1
+                try:
+                    return _Conn(self.addr, self.secret)
+                except OSError as e:
+                    self.count -= 1
+                    raise SdbError(f"kv service unreachable: {e}")
+        return self.q.get()
+
+    def release(self, c: _Conn):
+        self.q.put(c)
+
+    def drop(self, c: _Conn):
+        c.close()
+        with self.lock:
+            self.count -= 1
+
+    def call(self, msg):
+        c = self.acquire()
+        try:
+            out = c.call(msg)
+        except (ConnectionError, OSError) as e:
+            self.drop(c)
+            raise SdbError(f"kv connection lost: {e}")
+        except BaseException:
+            self.release(c)
+            raise
+        self.release(c)
+        return out
+
+
+class RemoteTx(BackendTx):
+    """Client transaction: server snapshot + local write overlay (mirror
+    of MemTx with reads over the wire). Holds one pooled connection for
+    its lifetime."""
+
+    def __init__(self, backend: "RemoteBackend", write: bool):
+        self.pool = backend.pool
+        self.write = write
+        self.conn: Optional[_Conn] = self.pool.acquire()
+        try:
+            self.snap = self.conn.call(["snap"])
+        except BaseException:
+            self._drop_conn()
+            raise
+        self.writes: dict[bytes, Optional[bytes]] = {}
+        self.savepoints: list[dict] = []
+        self.done = False
+
+    def _drop_conn(self):
+        if self.conn is not None:
+            self.pool.drop(self.conn)
+            self.conn = None
+
+    def _return_conn(self):
+        if self.conn is not None:
+            self.pool.release(self.conn)
+            self.conn = None
+
+    def _call(self, msg):
+        if self.conn is None:
+            raise SdbError("transaction connection lost")
+        try:
+            return self.conn.call(msg)
+        except (ConnectionError, OSError) as e:
+            self.done = True
+            self._drop_conn()  # server releases our pins on disconnect
+            raise SdbError(f"kv connection lost: {e}")
+
+    def _check(self):
+        if self.done:
+            raise SdbError("transaction is finished")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        self._check()
+        if key in self.writes:
+            return self.writes[key]
+        return self._call(["get", key, self.snap])
+
+    def set(self, key: bytes, val: bytes) -> None:
+        self._check()
+        if not self.write:
+            raise SdbError("transaction is read-only")
+        self.writes[key] = bytes(val)
+
+    def delete(self, key: bytes) -> None:
+        self._check()
+        if not self.write:
+            raise SdbError("transaction is read-only")
+        self.writes[key] = None
+
+    def scan(self, beg, end, limit=None, reverse=False):
+        self._check()
+        if not self.writes:
+            items = self._call(
+                ["range", beg, end, self.snap, limit, bool(reverse)]
+            )
+            for k, v in items:
+                yield k, v
+            return
+        # overlay present: fetch the FULL committed range (a server-side
+        # limit could truncate keys the overlay deletes/shadows), merge,
+        # then apply the limit — mirror of MemTx.scan
+        items = self._call(["range", beg, end, self.snap, None, False])
+        base = {k: v for k, v in items}
+        for k, v in self.writes.items():
+            if beg <= k < end:
+                if v is None:
+                    base.pop(k, None)
+                else:
+                    base[k] = v
+        keys = sorted(base, reverse=reverse)
+        n = 0
+        for k in keys:
+            yield k, base[k]
+            n += 1
+            if limit is not None and n >= limit:
+                return
+
+    def new_save_point(self):
+        self.savepoints.append(dict(self.writes))
+
+    def rollback_to_save_point(self):
+        if self.savepoints:
+            self.writes = self.savepoints.pop()
+
+    def release_last_save_point(self):
+        if self.savepoints:
+            self.savepoints.pop()
+
+    def commit(self):
+        self._check()
+        self.done = True
+        snap, self.snap = self.snap, None
+        try:
+            if self.writes:
+                self._call(
+                    ["commit", [[k, v] for k, v in self.writes.items()],
+                     snap]
+                )
+            else:
+                self._call(["rel", snap])
+        finally:
+            self._return_conn()
+
+    def cancel(self):
+        if self.done:
+            return
+        self.done = True
+        self.writes.clear()
+        snap, self.snap = self.snap, None
+        try:
+            if snap is not None and self.conn is not None:
+                self._call(["rel", snap])
+        except SdbError:
+            pass  # connection gone — server released pins on disconnect
+        finally:
+            self._return_conn()
+
+    def __del__(self):
+        if not self.done:
+            try:
+                self.cancel()
+            except Exception:
+                pass
+
+
+class RemoteBackend(Backend):
+    def __init__(self, addr: str, secret: Optional[str] = None):
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            raise SdbError(
+                f"remote:// address must be host:port, got {addr!r}"
+            )
+        if secret is None:
+            secret = os.environ.get("SURREAL_KV_SECRET") or None
+        self.pool = _Pool((host, int(port)), secret=secret)
+        self.lock = threading.RLock()
+        self.pool.call(["ping"])  # fail fast when the service is down
+
+    def transaction(self, write: bool) -> RemoteTx:
+        return RemoteTx(self, write)

@@ -481,5 +481,8 @@ def make_server(ds: Datastore, host="127.0.0.1", port=8000,
 
 def serve(ds: Datastore, host="127.0.0.1", port=8000, unauthenticated=False):
     srv = make_server(ds, host, port, unauthenticated=unauthenticated)
+    # served nodes join the cluster: heartbeat + membership GC loops
+    # (reference engine/tasks.rs); embedded datastores stay single-node
+    ds.start_node_tasks()
     print(f"surrealdb-tpu listening on http://{host}:{port}")
     srv.serve_forever()

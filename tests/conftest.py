@@ -22,8 +22,22 @@ import pytest  # noqa: E402
 
 @pytest.fixture()
 def ds():
+    """Datastore under test. SURREAL_TEST_BACKEND=remote runs every
+    fixture-based test against the distributed KV service (a fresh
+    server per test — the storage contract is what's being swapped,
+    reference SURVEY §4: distribution is tested through the storage
+    contract)."""
     from surrealdb_tpu import Datastore
 
+    if os.environ.get("SURREAL_TEST_BACKEND") == "remote":
+        from surrealdb_tpu.kvs.remote import serve_kv
+
+        srv = serve_kv("127.0.0.1", 0, block=False)
+        d = Datastore(f"remote://127.0.0.1:{srv.server_address[1]}")
+        yield d
+        d.close()
+        srv.shutdown()
+        return
     d = Datastore("memory")
     yield d
     d.close()
