@@ -555,6 +555,10 @@ def build_select_plan(n, ctx):
 
     lim = int(evaluate(n.limit, ctx)) if n.limit is not None else None
     off = int(evaluate(n.start, ctx)) if n.start is not None else 0
+    if (lim is not None and lim < 0) or off < 0:
+        # Legacy applies Python slice semantics to negative START/LIMIT;
+        # keep one behavior by routing those (rare) shapes to legacy.
+        return None
 
     pushed_limit = pushed_offset = None
     extra = ""

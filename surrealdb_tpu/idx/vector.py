@@ -300,6 +300,7 @@ class TpuVectorIndex:
         self.device_x2 = None
         self.device_arow = None
         self.rank_mode = None
+        self.mesh = None
 
     def _rebuild(self, ctx):
         ns, db, tb, ix = self.key

@@ -31,6 +31,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from collections import Counter
 from typing import Optional
 
@@ -237,7 +238,29 @@ class _Pool:
                 except OSError as e:
                     self.count -= 1
                     raise SdbError(f"kv service unreachable: {e}")
-        return self.q.get()
+        # Bounded wait: a statement can hold one pooled conn while
+        # allocating a sequence batch on a second — blocking forever here
+        # would deadlock the process at pool exhaustion. Wait in slices,
+        # re-checking capacity: drop() frees a slot without queueing.
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                return self.q.get(timeout=0.25)
+            except queue.Empty:
+                pass
+            with self.lock:
+                if self.count < self.size:
+                    self.count += 1
+                    try:
+                        return _Conn(self.addr, self.secret)
+                    except OSError as e:
+                        self.count -= 1
+                        raise SdbError(f"kv service unreachable: {e}")
+                in_use = self.count
+            if time.monotonic() >= deadline:
+                raise SdbError(
+                    f"kv connection pool exhausted ({in_use} in use; waited 30s)"
+                )
 
     def release(self, c: _Conn):
         self.q.put(c)

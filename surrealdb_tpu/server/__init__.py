@@ -156,17 +156,27 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return
         if path == "/metrics":
             # Prometheus text format (reference telemetry/metrics; pull
-            # instead of OTLP push — no egress in this build)
+            # instead of OTLP push — no egress in this build). Gated like
+            # other data routes: traces/counters leak query shapes.
+            if self._session().auth_level == "none":
+                self._json(401, {"error": "Not authenticated"})
+                return
             self._text(200, self.ds.telemetry.prometheus(self.ds),
                        "text/plain; version=0.0.4")
             return
         if path == "/telemetry/traces":
+            if self._session().auth_level == "none":
+                self._json(401, {"error": "Not authenticated"})
+                return
             self._json(200, self.ds.telemetry.recent_traces())
             return
         if path == "/export":
             sess = self._session()
             from surrealdb_tpu.kvs.export import export_sql
 
+            if sess.auth_level == "none":
+                self._json(401, {"error": "Not authenticated"})
+                return
             if not sess.ns or not sess.db:
                 self._json(400, {"error": "Specify ns and db headers"})
                 return

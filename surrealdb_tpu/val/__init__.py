@@ -968,15 +968,13 @@ def copy_value(v):
     if t is list:
         out = list(v)
         for i, x in enumerate(out):
-            tx = type(x)
-            if tx is list or tx is dict or tx is SSet:
+            if isinstance(x, (list, dict, SSet)):
                 out[i] = copy_value(x)
         return out
     if t is dict:
         out = dict(v)
         for k, x in out.items():
-            tx = type(x)
-            if tx is list or tx is dict or tx is SSet:
+            if isinstance(x, (list, dict, SSet)):
                 out[k] = copy_value(x)
         return out
     if isinstance(v, SSet):

@@ -133,3 +133,39 @@ def test_tpu_vector_index_sharded_1m():
     ref[~ix.valid] = np.inf
     want = set(np.argsort(ref)[:k].tolist())
     assert len(got & want) / k >= 0.95
+
+
+def test_sharded_to_int8_transition_requeries():
+    """Regression (ADVICE r3, high): a sharded bf16 store whose post-update
+    rebuild crosses KNN_HBM_BUDGET_BYTES must re-dispatch as int8 — stale
+    self.mesh used to route to sharded_rank_rescore with device_full=None."""
+    import jax
+    from surrealdb_tpu import cnf
+    from surrealdb_tpu.idx.vector import TpuVectorIndex
+    from surrealdb_tpu.val import RecordId
+
+    assert jax.device_count() >= 8
+    n, dim, k = 4096, 16, 5
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(n, dim)).astype(np.float32)
+    ix = TpuVectorIndex(
+        "t", "t", "pts", "ix",
+        {"dimension": dim, "distance": "cosine", "vector_type": "f32"},
+    )
+    ix.vecs = xs
+    ix.valid = np.ones(n, dtype=bool)
+    ix.rids = [RecordId("pts", i) for i in range(n)]
+    ix.version = 0
+    q = rng.normal(size=(dim,)).astype(np.float32)
+    first = ix._raw_knn(q, k)
+    assert ix.mesh is not None and ix.rank_mode == "bf16"
+    old = cnf.KNN_HBM_BUDGET_BYTES
+    cnf.KNN_HBM_BUDGET_BYTES = 6 * n * dim // 16  # force int8 on rebuild
+    try:
+        ix._drop_device()  # what update()/_rebuild() do
+        assert ix.mesh is None
+        second = ix._raw_knn(q, k)
+        assert ix.rank_mode == "int8"
+    finally:
+        cnf.KNN_HBM_BUDGET_BYTES = old
+    assert [r.id for r, _ in first] == [r.id for r, _ in second]
