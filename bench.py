@@ -65,10 +65,17 @@ def _probe_backend(attempts=4, wait_s=45, timeout_s=240) -> str:
               file=sys.stderr, flush=True)
         if i + 1 < attempts:
             time.sleep(wait_s)
-    print("bench: FATAL: accelerator backend never came up; no measurement "
-          "possible (set JAX_PLATFORMS=cpu for a CPU run)",
+    # Fail SOFT: a CPU-labeled measurement beats no measurement (rounds 2
+    # and 3 both recorded nothing because the tunneled backend was wedged
+    # at init). Re-exec with the accelerator path disabled — the JSON line
+    # carries platform=cpu so the number can't be mistaken for a TPU one.
+    print("bench: accelerator backend never came up; falling back to a "
+          "CPU-platform run (JSON line will say platform=cpu)",
           file=sys.stderr, flush=True)
-    sys.exit(3)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize dials the relay
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 def _bulk_vectors(ds, ns, db, tb, ix_name, xs, dim, metric="euclidean",
@@ -401,7 +408,6 @@ def bench_knn10m(quick=False):
         "cpu_hnsw_qps": round(base_qps, 2),
         "cpu_hnsw_n": bn,
         "rank_mode": ix.rank_mode,
-        "platform": _PLATFORM or "unprobed",
         "gen_s": round(gen_s, 1),
         "ingest_s": round(ingest_s, 1),
         "device_build_s": round(build_s, 1),
@@ -567,6 +573,10 @@ def main():
                              "graph3hop", "hybrid"])
     args = ap.parse_args()
 
+    def emit(res):
+        res.setdefault("platform", _PLATFORM or "unprobed")
+        print(json.dumps(res), flush=True)
+
     fns = {
         "hnsw100k": bench_hnsw100k,
         "knn1m": bench_knn1m,
@@ -578,10 +588,10 @@ def main():
     _probe_backend()
     if args.all:
         for name, fn in fns.items():
-            print(json.dumps(fn(quick=args.quick)), flush=True)
+            emit(fn(quick=args.quick))
         return 0
     if args.config:
-        print(json.dumps(fns[args.config](quick=args.quick)))
+        emit(fns[args.config](quick=args.quick))
         return 0
     # Default (the driver's invocation): the BASELINE north-star — 10M×768
     # KNN through the SQL path. A --quick smoke runs FIRST so a broken
@@ -589,7 +599,15 @@ def main():
     # run itself dies (e.g. device OOM), fall back to the proven 1M config
     # so the round still records a real measurement.
     if args.quick:
-        print(json.dumps(bench_knn10m(quick=True)))
+        emit(bench_knn10m(quick=True))
+        return 0
+    if _PLATFORM == "cpu":
+        # Wedged-tunnel fallback (or an explicit CPU run): the 10M×768
+        # ingest is a TPU-scale workload — record the 1M config instead so
+        # the round still gets a full, honestly-labeled measurement.
+        res = bench_knn1m(quick=False)
+        res["fallback_from"] = "knn10m: cpu platform"
+        emit(res)
         return 0
     smoke = bench_knn1m(quick=True)
     print(f"bench: smoke ok: {json.dumps(smoke)}", file=sys.stderr,
@@ -601,7 +619,7 @@ def main():
               f"falling back to 1M", file=sys.stderr, flush=True)
         res = bench_knn1m(quick=False)
         res["fallback_from"] = f"knn10m: {type(e).__name__}"
-    print(json.dumps(res))
+    emit(res)
     return 0
 
 

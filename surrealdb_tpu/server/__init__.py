@@ -371,17 +371,29 @@ class SurrealHandler(BaseHTTPRequestHandler):
         accept = base64.b64encode(
             hashlib.sha1((key + _WS_MAGIC).encode()).digest()
         ).decode()
+        # format negotiation rides the subprotocol header, like the
+        # reference (server/src/rpc: cbor | json; json when unstated)
+        offered = [
+            p.strip()
+            for p in (self.headers.get("Sec-WebSocket-Protocol") or "").split(",")
+            if p.strip()
+        ]
+        proto = next((p for p in offered if p in ("cbor", "json")), None)
         self.send_response(101, "Switching Protocols")
         self.send_header("Upgrade", "websocket")
         self.send_header("Connection", "Upgrade")
         self.send_header("Sec-WebSocket-Accept", accept)
+        if proto:
+            self.send_header("Sec-WebSocket-Protocol", proto)
         self.end_headers()
         self.close_connection = True
-        self._ws_serve()
+        self._ws_serve(fmt=proto or "json")
 
-    def _ws_send(self, payload: str):
-        data = payload.encode()
-        header = b"\x81"  # FIN + text
+    def _ws_send(self, payload):
+        if isinstance(payload, bytes):
+            data, header = payload, b"\x82"  # FIN + binary (cbor)
+        else:
+            data, header = payload.encode(), b"\x81"  # FIN + text
         n = len(data)
         if n < 126:
             header += struct.pack("!B", n)
@@ -412,20 +424,30 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 data[i] ^= mask[i % 4]
         return opcode, bytes(data)
 
-    def _ws_serve(self):
+    def _ws_serve(self, fmt: str = "json"):
         rs = RpcSession(self.ds, anon_level=self.anon_level)
         self._ws_lock = threading.Lock()
+        if fmt == "cbor":
+            from surrealdb_tpu import wire
+
+            pack = wire.encode
+            unpack = wire.decode
+            jsonify = lambda v: v  # cbor carries rich values natively
+        else:
+            pack = json.dumps
+            unpack = lambda data: json.loads(data.decode())
+            jsonify = to_json
 
         # live-query notification forwarding
         def on_notify(notification):
             if notification.live_id in rs.live_ids:
                 try:
-                    self._ws_send(json.dumps({
+                    self._ws_send(pack({
                         "result": {
                             "id": notification.live_id,
                             "action": notification.action,
-                            "record": to_json(notification.record),
-                            "result": to_json(notification.result),
+                            "record": jsonify(notification.record),
+                            "result": jsonify(notification.result),
                         }
                     }))
                 except OSError:
@@ -449,9 +471,14 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 if opcode not in (0x1, 0x2):
                     continue
                 try:
-                    req = json.loads(data.decode())
-                except ValueError:
-                    self._ws_send(json.dumps({
+                    req = unpack(data)
+                    if not isinstance(req, dict):
+                        raise ValueError("request must be an object")
+                except Exception:
+                    # a malformed frame (truncated cbor raises IndexError,
+                    # bad json ValueError, non-map top level …) must never
+                    # kill the session — answer with the parse error
+                    self._ws_send(pack({
                         "error": {"code": -32700, "message": "Parse error"}
                     }))
                     continue
@@ -460,16 +487,16 @@ class SurrealHandler(BaseHTTPRequestHandler):
                     out = rs.handle(
                         req.get("method", ""), req.get("params") or []
                     )
-                    self._ws_send(json.dumps(
-                        {"id": rid, "result": to_json(out)}
+                    self._ws_send(pack(
+                        {"id": rid, "result": jsonify(out)}
                     ))
                 except RpcError as e:
-                    self._ws_send(json.dumps({
+                    self._ws_send(pack({
                         "id": rid,
                         "error": {"code": e.code, "message": str(e)},
                     }))
                 except SdbError as e:
-                    self._ws_send(json.dumps({
+                    self._ws_send(pack({
                         "id": rid,
                         "error": {"code": -32000, "message": str(e)},
                     }))

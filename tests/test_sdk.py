@@ -1,0 +1,181 @@
+"""SDK engine matrix: the same method-API scenario driven through the
+embedded local engine, the WebSocket engine (cbor AND json subprotocols),
+and the one-shot HTTP engine — the reference runs its api_integration
+suite against local and remote engines the same way (surrealdb/tests/).
+"""
+
+import threading
+import time
+
+import pytest
+
+from surrealdb_tpu import Datastore
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.sdk import connect
+from surrealdb_tpu.server import make_server
+
+_PORT = 18210
+
+
+def _spawn_server(unauthenticated=True):
+    global _PORT
+    _PORT += 1
+    ds = Datastore("memory")
+    srv = make_server(ds, "127.0.0.1", _PORT, unauthenticated=unauthenticated)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return ds, srv, _PORT
+
+
+def _crud_scenario(db):
+    db.use("t", "t")
+    created = db.create("person:1", {"name": "ada", "age": 36})
+    assert created and created[0]["name"] == "ada"
+    db.create("person:2", {"name": "bob", "age": 41})
+    rows = db.select("person")
+    assert len(rows) == 2
+    up = db.update("person:1", {"name": "ada", "age": 37})
+    assert up[0]["age"] == 37
+    db.merge("person:2", {"city": "x"})
+    assert db.select("person:2")[0]["city"] == "x"
+    out = db.query("SELECT * FROM person WHERE age > $a ORDER BY age",
+                   {"a": 36})
+    assert out[0]["status"] == "OK"
+    res = out[0]["result"]
+    assert [r["age"] for r in res] == [37, 41]
+    db.relate("person:1", "knows", "person:2", {"since": 2020})
+    k = db.query("SELECT VALUE ->knows->person FROM ONLY person:1")
+    assert k[0]["status"] == "OK"
+    assert len(k[0]["result"]) == 1
+    assert db.run("string::uppercase", "abc") == "ABC"
+    gone = db.delete("person:2")
+    assert gone[0]["name"] == "bob"
+    assert len(db.select("person")) == 1
+    v = db.version()
+    assert "surrealdb-tpu" in v
+
+
+def test_local_engine_crud():
+    with connect("mem://") as db:
+        _crud_scenario(db)
+
+
+@pytest.mark.parametrize("fmt", ["cbor", "json"])
+def test_ws_engine_crud(fmt):
+    ds, srv, port = _spawn_server()
+    try:
+        with connect(f"ws://127.0.0.1:{port}", fmt=fmt) as db:
+            _crud_scenario(db)
+    finally:
+        srv.shutdown()
+
+
+def test_http_engine_crud():
+    ds, srv, port = _spawn_server()
+    try:
+        with connect(f"http://127.0.0.1:{port}") as db:
+            _crud_scenario(db)
+    finally:
+        srv.shutdown()
+
+
+def test_ws_live_push():
+    """LIVE over the ws engine: notifications arrive on the client socket
+    (reference: rpc/websocket.rs live forwarding + engine/remote/ws)."""
+    ds, srv, port = _spawn_server()
+    try:
+        with connect(f"ws://127.0.0.1:{port}") as db:
+            db.use("t", "t")
+            got = []
+            lid = db.live("person", lambda n: got.append(n))
+            assert lid
+            with connect(f"ws://127.0.0.1:{port}") as w:
+                w.use("t", "t")
+                w.create("person:9", {"name": "eve"})
+                w.update("person:9", {"name": "eve2"})
+                w.delete("person:9")
+            deadline = time.monotonic() + 5
+            while len(got) < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            actions = [n["action"] for n in got]
+            assert actions == ["CREATE", "UPDATE", "DELETE"], actions
+            db.kill(lid)
+            with connect(f"ws://127.0.0.1:{port}") as w:
+                w.use("t", "t")
+                w.create("person:10", {"name": "zed"})
+            time.sleep(0.3)
+            assert len(got) == 3  # killed: no further pushes
+    finally:
+        srv.shutdown()
+
+
+def test_local_live_push():
+    with connect("mem://") as db:
+        db.use("t", "t")
+        got = []
+        db.live("person", lambda n: got.append(n))
+        db.create("person:5", {"name": "lil"})
+        deadline = time.monotonic() + 3
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert got and got[0]["action"] == "CREATE"
+
+
+def test_http_engine_rejects_live():
+    ds, srv, port = _spawn_server()
+    try:
+        with connect(f"http://127.0.0.1:{port}") as db:
+            db.use("t", "t")
+            with pytest.raises(SdbError):
+                db.live("person", lambda n: None)
+    finally:
+        srv.shutdown()
+
+
+def test_ws_auth_flow():
+    """signin over ws against a secured server; anonymous writes refused."""
+    ds, srv, port = _spawn_server(unauthenticated=False)
+    ds.query("DEFINE USER admin ON ROOT PASSWORD 'pw' ROLES OWNER",
+             ns="t", db="t")
+    try:
+        with connect(f"ws://127.0.0.1:{port}") as db:
+            db.use("t", "t")
+            with pytest.raises(SdbError):
+                db.create("person:1", {"name": "x"})
+            tok = db.signin(user="admin", passwd="pw")
+            assert tok
+            assert db.create("person:1", {"name": "x"})
+            db.invalidate()
+            with pytest.raises(SdbError):
+                db.create("person:2", {"name": "y"})
+    finally:
+        srv.shutdown()
+
+
+def test_scheme_dispatch_file(tmp_path):
+    p = tmp_path / "db"
+    with connect(f"file://{p}") as db:
+        db.use("t", "t")
+        db.create("person:1", {"name": "p"})
+    with connect(f"file://{p}") as db:  # durable across reopen
+        db.use("t", "t")
+        assert db.select("person:1")[0]["name"] == "p"
+
+
+def test_scheme_dispatch_rejects_unknown():
+    with pytest.raises(SdbError):
+        connect("bogus://x")
+
+
+def test_ws_survives_malformed_frames():
+    """A garbled cbor frame must get a parse-error reply, not kill the
+    session (server side) or the reader thread (client side)."""
+    ds, srv, port = _spawn_server()
+    try:
+        with connect(f"ws://127.0.0.1:{port}") as db:
+            db.use("t", "t")
+            db.engine._send_frame(b"\x81", 0x2)  # truncated cbor array
+            db.engine._send_frame(b"\x01", 0x2)  # top-level non-map
+            assert db.version()  # session + reader both still alive
+    finally:
+        srv.shutdown()
