@@ -123,6 +123,10 @@ def _type_name(v) -> str:
         return "file"
     if isinstance(v, Table):
         return "table"
+    from surrealdb_tpu.val import Closure as _Clo
+
+    if isinstance(v, _Clo):
+        return "function"
     return type(v).__name__
 
 
@@ -423,10 +427,15 @@ def cast_err(v, kind: Kind):
 def cast(v, kind: Kind):
     """`<kind> value` — lenient conversion (reference expr/cast.rs)."""
     n = kind.name
-    try:
-        return coerce(v, kind)
-    except SdbError:
+    if n in ("set", "array") and kind.size is not None:
+        # sized casts demand the EXACT length (type/set.surql:
+        # <set<int,5>>[1,2,1] errors), unlike field coercion's upper bound
         pass
+    else:
+        try:
+            return coerce(v, kind)
+        except SdbError:
+            pass
     if n == "int":
         if isinstance(v, str):
             try:

@@ -19,7 +19,7 @@ class Ctx:
         "executor", "ns", "db", "knn", "record_cache", "deadline",
         "timeout_dur", "write_version", "depth",
         "perms_enabled", "version", "_cond_consumed", "_cf_seq",
-        "_brute_knn_k", "_strict_readonly", "_stream_cols",
+        "_brute_knn_k", "_strict_readonly", "_stream_cols", "_no_link_fetch",
     )
 
     def __init__(self, ds, session, txn, executor=None):
@@ -46,6 +46,9 @@ class Ctx:
         self._brute_knn_k = None  # brute KNN global k (multi-source trim)
         self._strict_readonly = False  # REPLACE: dropped readonly errors
         self._stream_cols = None  # (ColumnCache, src) — exec/stream.py
+        # ORDER BY keys evaluate pre-FETCH with no record-link traversal
+        # (reference: sort compares computed values without db access)
+        self._no_link_fetch = False
 
     def child(self) -> "Ctx":
         c = Ctx.__new__(Ctx)
@@ -72,6 +75,7 @@ class Ctx:
         c._brute_knn_k = self._brute_knn_k
         c._strict_readonly = self._strict_readonly
         c._stream_cols = self._stream_cols
+        c._no_link_fetch = self._no_link_fetch
         from surrealdb_tpu import cnf
 
         if c.depth > cnf.MAX_COMPUTATION_DEPTH:

@@ -148,15 +148,22 @@ def _add_assign(cur, v):
 
         from surrealdb_tpu.val import Duration
 
-        if isinstance(v, list):
+        from surrealdb_tpu.val import SSet
+
+        if isinstance(v, (list, SSet)):
             return v
         if isinstance(v, (int, float, Decimal, Duration)) and not isinstance(
             v, bool
         ):
             return v
         return [v]
+    from surrealdb_tpu.val import SSet
+
     if isinstance(cur, list):
-        return cur + (v if isinstance(v, list) else [v])
+        return cur + (list(v) if isinstance(v, (list, SSet)) else [v])
+    if isinstance(cur, SSet):
+        extra = list(v) if isinstance(v, (list, SSet)) else [v]
+        return SSet(cur.items + extra)
     from surrealdb_tpu.exec.operators import add
 
     return add(cur, v)
@@ -170,6 +177,14 @@ def _sub_assign(cur, v):
             return neg(v)
         except SdbError:
             return NONE
+    from surrealdb_tpu.val import SSet
+
+    # -= removes by VALUE on arrays/sets (unlike the binary `-` operator,
+    # which errors for scalar operands; set_array_common_behaviour.surql)
+    if isinstance(cur, list) and not isinstance(v, (list, SSet)):
+        return [x for x in cur if not value_eq(x, v)]
+    if isinstance(cur, SSet) and not isinstance(v, (list, SSet)):
+        return SSet([x for x in cur.items if not value_eq(x, v)])
     from surrealdb_tpu.exec.operators import sub
 
     return sub(cur, v)

@@ -88,6 +88,10 @@ def fetch_record_at(ctx: Ctx, rid: RecordId, ts: int):
 def fetch_record(ctx: Ctx, rid: RecordId):
     """Fetch a record document (NONE if missing); caches within a statement.
     Computed fields are evaluated on read (reference doc/compute.rs)."""
+    if ctx._no_link_fetch:
+        # ORDER BY keys compare pre-FETCH without record-link traversal
+        # (reference select/fetch/order_by.surql: city.name sorts as NONE)
+        return NONE
     if ctx.version is not None:
         ck = (rid.tb, K.enc_value(rid.id), ctx.version)
         hit = ctx.record_cache.get(ck)
@@ -413,9 +417,11 @@ def _e_constant(n, ctx):
 
         return Datetime(_dt.datetime.fromtimestamp(0, _dt.timezone.utc))
     if name == "time::minimum":
-        return Datetime.parse("-262143-01-01T00:00:00Z") if False else Datetime.parse("1000-01-01T00:00:00")
+        # chrono DateTime::<Utc>::MIN_UTC (val/datetime.rs MIN_UTC)
+        return Datetime.from_parts(-262143, 1, 1)
     if name == "time::maximum":
-        return Datetime.parse("9999-12-31T23:59:59")
+        # chrono DateTime::<Utc>::MAX_UTC
+        return Datetime.from_parts(262142, 12, 31, 23, 59, 59, 999_999_999)
     if name == "duration::max":
         from surrealdb_tpu.val import Duration as D
 
@@ -740,7 +746,9 @@ def _apply_index(val, idx, ctx):
             return NONE
         if isinstance(idx, (int, float)):
             i = int(idx)
-            if -len(val) <= i < len(val):
+            # no negative indexing (primitive/array/basic.surql: [-1] is
+            # NONE; the reference indexes with u64)
+            if 0 <= i < len(val):
                 return val[i]
             return NONE
         if isinstance(idx, Range):

@@ -76,8 +76,12 @@ def add(a, b):
     from surrealdb_tpu.val import SSet
 
     if isinstance(a, SSet):
-        extra = list(b) if isinstance(b, (SSet, list)) else [b]
-        return SSet(a.items + extra)
+        if not isinstance(b, (SSet, list)):
+            # {1,} + 1 errors like [1] + 1 (set_array_common_behaviour)
+            raise SdbError(
+                f"Cannot perform addition with '{_disp(a)}' and '{_disp(b)}'"
+            )
+        return SSet(a.items + list(b))
     if isinstance(b, SSet) and isinstance(a, list):
         return a + b.items
     if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
@@ -128,18 +132,16 @@ def sub(a, b):
                 "the operation results in a negative value."
             )
         return a - b
-    if isinstance(a, list) and isinstance(b, list):
-        return [x for x in a if not any(value_eq(x, y) for y in b)]
-    if isinstance(a, list):
-        # array - value removes matching elements (reference sub on arrays)
-        return [x for x in a if not value_eq(x, b)]
     from surrealdb_tpu.val import SSet
 
-    if isinstance(a, SSet):
-        rem = list(b) if isinstance(b, (SSet, list)) else [b]
+    if isinstance(a, list) and isinstance(b, (list, SSet)):
+        return [x for x in a if not any(value_eq(x, y) for y in b)]
+    if isinstance(a, SSet) and isinstance(b, (list, SSet)):
         return SSet(
-            [x for x in a.items if not any(value_eq(x, y) for y in rem)]
+            [x for x in a.items if not any(value_eq(x, y) for y in b)]
         )
+    # array/set - scalar is an ERROR in binary position (only the -=
+    # assignment removes by value; set_array_common_behaviour.surql)
     raise SdbError(f"Cannot perform subtraction with '{_disp(a)}' and '{_disp(b)}'")
 
 
@@ -147,6 +149,24 @@ def mul(a, b):
     if isinstance(a, _NUM) and not isinstance(a, bool) and isinstance(b, _NUM) and not isinstance(b, bool):
         a, b = _num2(a, b)
         return a * b
+    # duration scaling (reference val/duration.rs Mul<Number>): dur * n
+    # and n * dur; duration * duration is an error
+    if isinstance(b, Duration) and isinstance(a, _NUM) and not isinstance(a, bool):
+        a, b = b, a
+    if isinstance(a, Duration) and isinstance(b, _NUM) and not isinstance(b, bool):
+        prod = a.ns * b
+        if not isinstance(prod, int) and not math.isfinite(float(prod)):
+            raise SdbError(
+                f'Failed to compute: "{a.render()} * {_disp(b)}", as the '
+                "operation results in an arithmetic overflow."
+            )
+        ns = int(prod)
+        if ns > Duration.MAX_NS or ns < 0:
+            raise SdbError(
+                f'Failed to compute: "{a.render()} * {_disp(b)}", as the '
+                "operation results in an arithmetic overflow."
+            )
+        return Duration(ns)
     raise SdbError(f"Cannot perform multiplication with '{_disp(a)}' and '{_disp(b)}'")
 
 
@@ -181,7 +201,9 @@ def div(a, b):
             return a / b
         except (ZeroDivisionError, ArithmeticError):
             return NONE
-    raise SdbError(f"Cannot perform division with '{_disp(a)}' and '{_disp(b)}'")
+    # non-numeric division is NaN, not an error (primitive/array
+    # arithmic_operations.surql: [1,2,3] / 1 -> NaN)
+    return float("nan")
 
 
 def float_div(a, b):
@@ -247,15 +269,15 @@ def pow_(a, b):
             return r
         except (OverflowError, ArithmeticError):
             return float("inf")
-    raise SdbError(f"Cannot perform power with '{_disp(a)}' and '{_disp(b)}'")
+    raise SdbError(
+        f"Cannot raise the value '{_disp(a)}' with '{_disp(b)}'"
+    )
 
 
 def neg(a):
     if isinstance(a, _NUM) and not isinstance(a, bool):
         return -a
-    if isinstance(a, Duration):
-        return a
-    raise SdbError(f"Cannot negate {render(a)}")
+    raise SdbError(f"Cannot negate the value '{_disp(a)}'")
 
 
 # -- equality / fuzzy matching ----------------------------------------------

@@ -1228,18 +1228,26 @@ def _resolve_alias(expr, aliases):
 def _apply_order_sources(rows, order, ctx, aliases=None):
     """ORDER BY over source rows (pre-projection): aliases resolve to their
     expressions, everything else evaluates against the source doc."""
-    items = [
-        (_resolve_alias(expr, aliases), d, collate, numeric)
-        for expr, d, collate, numeric in order
-    ]
+    items = []
+    for expr, d, collate, numeric in order:
+        resolved = _resolve_alias(expr, aliases)
+        # ORDER keys mirror evaluation against the projected output: an
+        # alias re-computes its projection (traversal and all); a raw
+        # idiom walks the output row value-only — record links stay
+        # un-traversed (reference select/fetch/order_by.surql)
+        items.append((resolved, d, collate, numeric, resolved is not expr))
     keyed = []
     for src in rows:
         doc = src.doc if src.rid is not None else src.value
         cc = ctx.with_doc(doc, src.rid)
         cc.knn = ctx.knn
         keys = []
-        for expr, d, collate, numeric in items:
-            keys.append((evaluate(expr, cc), d, collate, numeric))
+        for expr, d, collate, numeric, was_alias in items:
+            cc._no_link_fetch = not was_alias
+            try:
+                keys.append((evaluate(expr, cc), d, collate, numeric))
+            finally:
+                cc._no_link_fetch = False
         keyed.append((_OrderKey(keys), src))
     keyed.sort(key=lambda kr: kr[0])
     return [r for _k, r in keyed]
@@ -1393,12 +1401,21 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
         and n.cond is None
         and single_target
     ):
-        if n.order[0][1] == "desc":
-            scan_dir = "Backward"
-        n = _strip_order(n)
+        # only a TABLE scan can absorb id-order into scan direction;
+        # RecordIdScan ranges keep the SortTopKByKey (reference
+        # reverse_iterator_range_new_executor)
+        try:
+            _tv = _target_value(n.what[0], ctx)
+        except SdbError:
+            _tv = None
+        if isinstance(_tv, Table):
+            if n.order[0][1] == "desc":
+                scan_dir = "Backward"
+            n = _strip_order(n)
 
     # resolve scan children (one per FROM target)
     scans = []  # (label_fn, scan_rows)
+    rid_range_scan = False
     total_scan_rows = 0
     residual = n.cond
     # KNN in the WHERE tree: KnnScan (HNSW access path) or KnnTopK (the
@@ -1436,6 +1453,7 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
         if isinstance(v, RecordId):
             rows = len(list(_iterate_value(v, ctx))) if analyze else 0
             if isinstance(v.id, Range):
+                rid_range_scan = True
                 rg = v.id
                 rid_s = (
                     f"{v.tb}:{render(rg.beg)}"
@@ -1885,8 +1903,10 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
             if n.cond is not None and single_target:
                 # a single table scan absorbs the predicate; multi-source
                 # and subquery plans keep a Filter node above (reference
-                # explain/complex.surql)
-                extra += f", predicate: {_expr_sql(n.cond)}"
+                # explain/complex.surql). Params render inlined: physical
+                # exprs hold evaluated constants.
+                from surrealdb_tpu.exec.stream import _inline_params
+                extra += f", predicate: {_expr_sql(_inline_params(n.cond, ctx))}"
                 residual = None
             if (
                 n.limit is not None
@@ -1996,9 +2016,25 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
         # index access can't cover the other branches (explain/complex)
         residual = n.cond
     if residual is not None:
+        # rows THROUGH the filter: equals the final row count except under
+        # grouping, where the aggregate collapses them (5581_select_count)
+        filt_rows = out_rows_n
+        if analyze and n.group is not None and single_target:
+            try:
+                v0 = _target_value(n.what[0], ctx)
+                cctx = ctx.child()
+                filt_rows = 0
+                for src in _iterate_value(v0, cctx, n.cond, n):
+                    doc = src.doc if src.rid is not None else src.value
+                    if n.cond is None or cctx._cond_consumed or is_truthy(
+                        evaluate(n.cond, cctx.with_doc(doc, src.rid))
+                    ):
+                        filt_rows += 1
+            except SdbError:
+                filt_rows = out_rows_n
         scan_lines = [
             (0, f"Filter [ctx: Db] [predicate: {_expr_sql(residual)}]",
-             out_rows_n)
+             filt_rows)
         ] + [(_shift_depth(d, 1), t, r) for d, t, r in scan_lines]
     if n.split:
         names = ", ".join(expr_name(sp) for sp in n.split)
@@ -2080,9 +2116,14 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                         _recurse_flat(prec, n.value.parts[pi + 1:]),
                     ))
         else:
+            # bare `Project` is the pass-through root over RecordIdScans
+            # (point lookups, keys-only counts); once an ORDER/LIMIT
+            # pipeline sits above the scan the reference renders the full
+            # SelectProject (explain/select_basic, count_range_keys_only
+            # vs reverse_iterator_range)
             only_rid_scans = scans and all(
                 entry[0].startswith("RecordIdScan") for entry in scans
-            )
+            ) and not (n.order and n.order != "rand") and n.limit is None
             graph_projs = bool(n.exprs) and all(
                 e != "*" and isinstance(e, Idiom)
                 and any(isinstance(p, PGraph) for p in e.parts)
@@ -4916,15 +4957,185 @@ def _s_show(n: ShowStmt, ctx: Ctx):
     return read_changes(n, ctx)
 
 
+_GRANT_POOL = (
+    "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz"
+)
+
+
+def _access_level(n, ctx):
+    """Resolve the statement's base (explicit ON, else the session's
+    selected base — reference Options::selected_base)."""
+    base = n.base
+    if base is None:
+        base = ("db" if ctx.session.db
+                else "ns" if ctx.session.ns else "root")
+    ns = ctx.session.ns if base in ("ns", "db") else None
+    db = ctx.session.db if base == "db" else None
+    if base == "db" and (not ns or not db):
+        ctx.need_ns_db()
+    if base == "ns" and not ns:
+        raise SdbError("Specify a namespace to use")
+    return base, ns, db
+
+
+def _access_nf(base, ctx, name):
+    if base == "root":
+        return f"The root access method '{name}' does not exist"
+    if base == "ns":
+        return (f"The access method '{name}' does not exist in the "
+                f"namespace '{ctx.session.ns}'")
+    return (f"The access method '{name}' does not exist in the "
+            f"database '{ctx.session.db}'")
+
+
+def _user_nf(base, ctx, name):
+    if base == "root":
+        return f"The root user '{name}' does not exist"
+    if base == "ns":
+        return (f"The user '{name}' does not exist in the "
+                f"namespace '{ctx.session.ns}'")
+    return (f"The user '{name}' does not exist in the "
+            f"database '{ctx.session.db}'")
+
+
+def _grant_object(g: dict, redact: bool) -> dict:
+    """SurrealQL object for an access grant (reference
+    expr/statements/access.rs access_object_from_grant)."""
+    grant = dict(g["grant"])
+    if redact and "key" in grant:
+        grant["key"] = "[REDACTED]"
+    return {
+        "id": g["id"],
+        "ac": g["ac"],
+        "type": g["type"],
+        "creation": g["creation"],
+        "expiration": g.get("expiration", NONE),
+        "revocation": g.get("revocation", NONE),
+        "subject": dict(g["subject"]),
+        "grant": grant,
+    }
+
+
 def _s_access(n, ctx):
+    from surrealdb_tpu.val import Datetime, Duration
+
     if n.op == "alter_sequence":
         ns, db = ctx.need_ns_db()
         if ctx.txn.get(K.seq_state(ns, db, n.name)) is None and not n.subject:
             raise SdbError(f"The sequence '{n.name}' does not exist")
         return NONE
-    raise SdbError(
-        "Access grant management (ACCESS GRANT/SHOW/REVOKE/PURGE) is not supported yet"
-    )
+    base, ns, db = _access_level(n, ctx)
+    adef = ctx.txn.get_val(K.ac_def(base, ns, db, n.name))
+    if adef is None:
+        raise SdbError(_access_nf(base, ctx, n.name))
+
+    if n.op == "grant":
+        if adef.kind != "bearer":
+            raise SdbError(
+                f"The functionality 'Grants for {adef.kind.upper()}' is "
+                f"not implemented"
+            )
+        kind, sv = n.subject
+        bearer_for = (adef.config or {}).get("for", "user")
+        if kind == "user":
+            if bearer_for != "user":
+                raise SdbError(
+                    "The access method cannot issue grants to the "
+                    "provided subject"
+                )
+            if ctx.txn.get(K.us_def(base, ns, db, sv)) is None:
+                raise SdbError(_user_nf(base, ctx, sv))
+            subject = {"user": sv}
+        else:
+            if bearer_for != "record":
+                raise SdbError(
+                    "The access method cannot issue grants to the "
+                    "provided subject"
+                )
+            rid = evaluate(sv, ctx)
+            subject = {"record": rid}
+        rng = _random.SystemRandom()
+        gid = rng.choice(_GRANT_POOL[10:]) + "".join(
+            rng.choice(_GRANT_POOL) for _ in range(11)
+        )
+        secret = "".join(rng.choice(_GRANT_POOL) for _ in range(24))
+        creation = Datetime.now()
+        dur = (adef.duration or {}).get("grant", Duration.parse("30d"))
+        if isinstance(dur, Duration):
+            import datetime as _dt
+
+            expiration = Datetime(
+                creation.dt + _dt.timedelta(seconds=dur.to_seconds())
+            )
+        else:
+            expiration = NONE
+        g = {
+            "id": gid,
+            "ac": n.name,
+            "type": "bearer",
+            "creation": creation,
+            "expiration": expiration,
+            "revocation": NONE,
+            "subject": subject,
+            "grant": {"id": gid, "key": f"surreal-bearer-{gid}-{secret}"},
+        }
+        ctx.txn.set_val(K.ac_grant(base, ns, db, n.name, gid), g)
+        # the ONE place the real key is returned (reference: grants are
+        # redacted everywhere after creation)
+        return _grant_object(g, redact=False)
+
+    beg, end = K.prefix_range(K.ac_grant_prefix(base, ns, db, n.name))
+
+    def _matching():
+        sel_kind, operand = n.selector or ("all", None)
+        for k, g in ctx.txn.scan_vals(beg, end):
+            if sel_kind == "grant" and g["id"] != operand:
+                continue
+            if sel_kind == "where":
+                doc = _grant_object(g, redact=True)
+                if not is_truthy(evaluate(operand, ctx.with_doc(doc, None))):
+                    continue
+            yield k, g
+
+    if n.op == "show":
+        return [_grant_object(g, redact=True) for _k, g in _matching()]
+
+    if n.op == "revoke":
+        out = []
+        now = Datetime.now()
+        for k, g in _matching():
+            if g.get("revocation") not in (None, NONE):
+                continue
+            g = dict(g)
+            g["revocation"] = now
+            ctx.txn.set_val(k, g)
+            out.append(_grant_object(g, redact=True))
+        return out
+
+    if n.op == "purge":
+        kinds, grace_e = n.purge or (set(), None)
+        grace = 0.0
+        if grace_e is not None:
+            gv = evaluate(grace_e, ctx)
+            if isinstance(gv, Duration):
+                grace = gv.to_seconds()
+        now = Datetime.now()
+        out = []
+        for k, g in _matching():
+            exp = g.get("expiration")
+            rev = g.get("revocation")
+            dead = False
+            gns = int(grace * 1e9)
+            if "expired" in kinds and isinstance(exp, Datetime):
+                dead = dead or now.epoch_ns() - exp.epoch_ns() >= gns
+            if "revoked" in kinds and isinstance(rev, Datetime):
+                dead = dead or now.epoch_ns() - rev.epoch_ns() >= gns
+            if dead:
+                ctx.txn.delete(k)
+                out.append(_grant_object(g, redact=True))
+        return out
+
+    raise SdbError(f"unknown ACCESS operation '{n.op}'")
 
 
 _STMTS = {

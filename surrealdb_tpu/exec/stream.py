@@ -179,20 +179,26 @@ def _order_key_fn(order, ctx, aliases, cols):
     from surrealdb_tpu.exec.eval import evaluate
     from surrealdb_tpu.exec.statements import _OrderKey, _resolve_alias
 
-    resolved = [
-        (_resolve_alias(e, aliases), d, c, num)
-        for e, d, c, num in order
-    ]
+    resolved = []
+    for e, d, c, num in order:
+        r = _resolve_alias(e, aliases)
+        # aliases re-compute their projection (traversal allowed); raw
+        # idioms sort value-only without record-link fetches
+        resolved.append((r, d, c, num, r is not e))
 
     def key(src):
         doc = src.doc if src.rid is not None else src.value
         cc = ctx.with_doc(doc, src.rid)
         cc.knn = ctx.knn
         keys = []
-        for e, d, collate, numeric in resolved:
+        for e, d, collate, numeric, was_alias in resolved:
             v = cols.get_row(e, src)
             if v is _COL_MISS:
-                v = evaluate(e, cc)
+                cc._no_link_fetch = not was_alias
+                try:
+                    v = evaluate(e, cc)
+                finally:
+                    cc._no_link_fetch = False
             keys.append((v, d, collate, numeric))
         return _OrderKey(keys)
 
@@ -473,6 +479,37 @@ class ProjectOp(Operator):
 # ---------------------------------------------------------------------------
 
 
+def _inline_params(e, ctx):
+    """Deep-copy an expression with $params replaced by their bound values
+    — the reference's streaming explain renders physical exprs, which hold
+    the evaluated constants, not the param names."""
+    import dataclasses
+
+    from surrealdb_tpu.exec.eval import evaluate
+    from surrealdb_tpu.expr.ast import Literal, Param
+
+    if isinstance(e, Param):
+        try:
+            return Literal(evaluate(e, ctx))
+        except SdbError:
+            return e
+    if dataclasses.is_dataclass(e) and not isinstance(e, type):
+        changes = {}
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            nv = _inline_params(v, ctx)
+            if nv is not v:
+                changes[f.name] = nv
+        return dataclasses.replace(e, **changes) if changes else e
+    if isinstance(e, list):
+        out = [_inline_params(x, ctx) for x in e]
+        return out if any(a is not b for a, b in zip(out, e)) else e
+    if isinstance(e, tuple):
+        out = tuple(_inline_params(x, ctx) for x in e)
+        return out if any(a is not b for a, b in zip(out, e)) else e
+    return e
+
+
 def build_select_plan(n, ctx):
     """Build the streaming operator tree for an eligible SELECT; returns
     None when the statement needs the legacy engine (index access paths,
@@ -563,7 +600,7 @@ def build_select_plan(n, ctx):
     pushed_limit = pushed_offset = None
     extra = ""
     if n.cond is not None:
-        extra += f", predicate: {_expr_sql(n.cond)}"
+        extra += f", predicate: {_expr_sql(_inline_params(n.cond, ctx))}"
     if not order and (lim is not None or off):
         pushed_limit = lim
         if lim is not None:

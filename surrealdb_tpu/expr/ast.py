@@ -724,9 +724,12 @@ class RebuildIndex(Node):
 
 @dataclass
 class AccessStmt(Node):
-    """ACCESS ... GRANT/SHOW/REVOKE/PURGE (bearer grants)."""
+    """ACCESS ... GRANT/SHOW/REVOKE/PURGE (bearer grants; reference
+    expr/statements/access.rs)."""
 
     name: str
     base: Optional[str]
     op: str
-    subject: Any = None
+    subject: Any = None  # grant: ("user", name) | ("record", expr)
+    selector: Any = None  # show/revoke: ("all"|"grant"|"where", operand)
+    purge: Any = None  # purge: (kinds-set, grace-duration-expr)

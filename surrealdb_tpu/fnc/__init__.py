@@ -257,15 +257,34 @@ _METHOD_FAMILIES = [
 ]
 
 
+_METHOD_ALIASES = {
+    # reference exec/function/method.rs register_alias
+    "every": "all", "includes": "any", "some": "any",
+    "index_of": "find_index",
+}
+
+
 def method_call(val, name, args, ctx):
     """value.method(args) — resolve to family::method(val, ...)."""
     name = name.lower()
+    name = _METHOD_ALIASES.get(name, name)
     candidates = []
     for typ, fam in _METHOD_FAMILIES:
         if isinstance(val, typ):
             candidates.append(f"{fam}::{name}")
+            if "_" in name:
+                # nested families: .distance_damerau_levenshtein() ->
+                # string::distance::damerau_levenshtein, .semver_inc_major()
+                # -> string::semver::inc::major (reference method
+                # registration maps leading '_'s to submodules)
+                candidates.append(f"{fam}::{name.replace('_', '::', 1)}")
+                candidates.append(f"{fam}::{name.replace('_', '::', 2)}")
             break
     candidates += [f"type::{name}", f"value::{name}", name]
+    if "_" in name:
+        # bare namespaced methods: .vector_add() -> vector::add
+        candidates.append(name.replace("_", "::", 1))
+        candidates.append(name.replace("_", "::", 2))
     if name == "type_of":
         candidates.insert(0, "type::of")
     # .is_string() style -> type::is::string
@@ -312,11 +331,8 @@ def _count(args, ctx):
 
     if isinstance(v, _SS):
         return len(v)
-    if isinstance(v, _Rng):
-        try:
-            return len(list(v.iter_ints()))
-        except TypeError:
-            pass
+    # every other value counts by truthiness — a Range is NOT expanded
+    # (reference fnc count.rs: only Array/Set have cardinality)
     return 1 if is_truthy(v) else 0
 
 

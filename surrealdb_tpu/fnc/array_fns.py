@@ -9,6 +9,7 @@ from surrealdb_tpu.fnc import _arr, _num, register
 from surrealdb_tpu.val import (
     NONE,
     Closure,
+    Range,
     is_truthy,
     sort_key,
     value_cmp,
@@ -493,6 +494,14 @@ def _shuffle(args, ctx):
 @register("array::slice")
 def _slice(args, ctx):
     a = _arr(args[0], "array::slice", 1)
+    if len(args) > 1 and isinstance(args[1], Range):
+        # range syntax: slice(a, 1..4) / slice(a, 1..=4)
+        rg = args[1]
+        beg = int(rg.beg) if rg.beg is not NONE and rg.beg is not None else 0
+        if rg.end is NONE or rg.end is None:
+            return a[beg:]
+        end = int(rg.end) + (1 if rg.end_incl else 0)
+        return a[beg:end]
     beg = int(args[1]) if len(args) > 1 else 0
     n = int(args[2]) if len(args) > 2 else None
     if beg < 0:
@@ -663,7 +672,7 @@ def _set(v, idx=1):
     return v
 
 
-def _set_wrap(arr_name, returns_set=True, set_args=(1,)):
+def _set_wrap(arr_name, returns_set=True, set_args=(1,), value_args=()):
     inner = _F[arr_name]
 
     def fn(args, ctx):
@@ -671,9 +680,11 @@ def _set_wrap(arr_name, returns_set=True, set_args=(1,)):
         for i in set_args:
             if i <= len(conv):
                 conv[i - 1] = list(_set(conv[i - 1], i))
-        # second set/array arguments are accepted as arrays too
+        # second set/array arguments are accepted as arrays too — except
+        # value positions (set::all's needle compares as a VALUE: a set
+        # element that IS a set must equal a set, not a list)
         for i, v in enumerate(conv):
-            if isinstance(v, SSet) and (i + 1) not in set_args:
+            if isinstance(v, SSet) and (i + 1) not in set_args                     and (i + 1) not in value_args:
                 conv[i] = list(v)
         out = inner(conv, ctx)
         if returns_set and isinstance(out, list):
@@ -684,12 +695,13 @@ def _set_wrap(arr_name, returns_set=True, set_args=(1,)):
 
 
 _SET_FNS = {
-    # name -> (array impl, returns_set)
-    "add": ("array::add", True), "all": ("array::all", False),
-    "any": ("array::any", False), "at": ("array::at", False),
+    # name -> (array impl, returns_set[, value-arg positions])
+    "add": ("array::add", True), "all": ("array::all", False, (2,)),
+    "any": ("array::any", False, (2,)), "at": ("array::at", False),
     "complement": ("array::complement", True),
     "difference": ("array::difference", True),
-    "filter": ("array::filter", True), "find": ("array::find", False),
+    "filter": ("array::filter", True),
+    "find": ("array::find", False, (2,)),
     "first": ("array::first", False), "flatten": ("array::flatten", True),
     "fold": ("array::fold", False), "intersect": ("array::intersect", True),
     "is_empty": ("array::is_empty", False), "join": ("array::join", False),
@@ -700,8 +712,10 @@ _SET_FNS = {
     "union": ("array::union", True),
 }
 
-for _n, (_impl, _ret) in _SET_FNS.items():
-    _F[f"set::{_n}"] = _set_wrap(_impl, _ret)
+for _n, _spec in _SET_FNS.items():
+    _impl, _ret = _spec[0], _spec[1]
+    _vargs = _spec[2] if len(_spec) > 2 else ()
+    _F[f"set::{_n}"] = _set_wrap(_impl, _ret, value_args=_vargs)
     if _impl in ARITY:
         ARITY[f"set::{_n}"] = ARITY[_impl]
 
@@ -723,10 +737,14 @@ _F["set::insert"] = _set_insert
 
 def _set_remove(args, ctx):
     """set::remove removes by VALUE (reference fnc/set.rs), unlike
-    array::remove's index semantics."""
+    array::remove's index semantics; an array/set argument removes each
+    of its members."""
     s = _set(args[0], 1)
     v = args[1]
-    return SSet([x for x in s.items if not value_eq(x, v)])
+    gone = list(v) if isinstance(v, (list, SSet)) else [v]
+    return SSet([
+        x for x in s.items if not any(value_eq(x, g) for g in gone)
+    ])
 
 
 _F["set::remove"] = _set_remove

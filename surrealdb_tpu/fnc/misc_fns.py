@@ -45,7 +45,7 @@ def _joaat(args, ctx):
     h = (h + (h << 3)) & 0xFFFFFFFF
     h ^= h >> 11
     h = (h + (h << 15)) & 0xFFFFFFFF
-    return str(h)
+    return h
 
 
 @register("crypto::sha512")
@@ -55,8 +55,9 @@ def _sha512(args, ctx):
 
 @register("crypto::blake3")
 def _blake3(args, ctx):
-    # stdlib has no blake3; blake2b is the closest available construction
-    return hashlib.blake2b(_str(args[0], "crypto::blake3", 1).encode()).hexdigest()
+    from surrealdb_tpu.utils.blake3 import blake3_hex
+
+    return blake3_hex(_str(args[0], "crypto::blake3", 1).encode())
 
 
 # password hashing: argon2id (via the argon2 package, like the reference's
@@ -599,7 +600,9 @@ def _b64e2(args, ctx):
 def _bytes_len(args, ctx):
     v = args[0]
     if not isinstance(v, (bytes, bytearray)):
-        raise SdbError("Incorrect arguments for function bytes::len(). Expected bytes")
+        from surrealdb_tpu.fnc import ArgError
+
+        raise ArgError(1, "bytes", v)
     return len(v)
 
 

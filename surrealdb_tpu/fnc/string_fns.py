@@ -351,6 +351,44 @@ def _dlev(args, ctx):
     return d[len(a) + 1][len(b) + 1]
 
 
+@register("string::distance::normalized_levenshtein")
+def _nlev(args, ctx):
+    a, b = _str(args[0], "f", 1), _str(args[1], "f", 2)
+    m = max(len(a), len(b))
+    return 1.0 - (_levenshtein(a, b) / m if m else 0.0)
+
+
+@register("string::distance::normalized_damerau_levenshtein")
+def _ndlev(args, ctx):
+    a, b = _str(args[0], "f", 1), _str(args[1], "f", 2)
+    m = max(len(a), len(b))
+    if not m:
+        return 1.0
+    return 1.0 - _dlev(args, ctx) / m
+
+
+@register("string::distance::osa_distance")
+def _osa(args, ctx):
+    """Optimal string alignment (restricted Damerau-Levenshtein,
+    strsim::osa_distance)."""
+    a, b = _str(args[0], "f", 1), _str(args[1], "f", 2)
+    la, lb = len(a), len(b)
+    d = [[0] * (lb + 1) for _ in range(la + 1)]
+    for i in range(la + 1):
+        d[i][0] = i
+    for j in range(lb + 1):
+        d[0][j] = j
+    for i in range(1, la + 1):
+        for j in range(1, lb + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            d[i][j] = min(d[i - 1][j] + 1, d[i][j - 1] + 1,
+                          d[i - 1][j - 1] + cost)
+            if i > 1 and j > 1 and a[i - 1] == b[j - 2] \
+                    and a[i - 2] == b[j - 1]:
+                d[i][j] = min(d[i][j], d[i - 2][j - 2] + 1)
+    return d[la][lb]
+
+
 @register("string::distance::hamming")
 def _hamming(args, ctx):
     a, b = _str(args[0], "f", 1), _str(args[1], "f", 2)
@@ -421,6 +459,24 @@ def _fuzzy_sim(args, ctx):
     if not _fuzzy(b.lower(), a.lower()):
         return 0
     return len(b)
+
+
+@register("string::similarity::sorensen_dice")
+def _sdice(args, ctx):
+    """Sørensen–Dice coefficient over character bigrams
+    (strsim::sorensen_dice)."""
+    a = _str(args[0], "f", 1).replace(" ", "")
+    b = _str(args[1], "f", 2).replace(" ", "")
+    if a == b:
+        return 1.0
+    if len(a) < 2 or len(b) < 2:
+        return 0.0
+    from collections import Counter
+
+    ba = Counter(a[i:i + 2] for i in range(len(a) - 1))
+    bb = Counter(b[i:i + 2] for i in range(len(b) - 1))
+    inter = sum((ba & bb).values())
+    return 2.0 * inter / (sum(ba.values()) + sum(bb.values()))
 
 
 @register("string::similarity::smithwaterman")

@@ -52,7 +52,7 @@ def _month(args, ctx):
 @register("time::year")
 def _year(args, ctx):
     d = _dtm(args[0], "time::year") if args else Datetime.now()
-    return d.dt.year
+    return d.year
 
 
 @register("time::wday")
@@ -100,8 +100,23 @@ def _nano(args, ctx):
 def _set_component(args, which, fname):
     d = _dtm(args[0], fname)
     v = int(args[1])
+    if which == "year":
+        # chrono's settable year range (MIN_UTC..=MAX_UTC years)
+        if not -262143 <= v <= 262142:
+            raise SdbError(f"Unable to set datetime to year {v}")
+        try:
+            return Datetime.from_parts(
+                v, d.dt.month, d.dt.day, d.dt.hour, d.dt.minute,
+                d.dt.second, d.ns_frac,
+            )
+        except ValueError:
+            raise SdbError(f"Unable to set datetime to year {v}")
+    if not 0 <= v < (1 << 32):
+        # reference converts through u32 before chrono sees the value
+        raise SdbError("out of range integral type conversion attempted")
     try:
-        return Datetime(d.dt.replace(**{which: v}), d.ns_frac)
+        return Datetime(d.dt.replace(**{which: v}), d.ns_frac,
+                        d.year_shift)
     except ValueError:
         raise SdbError(f"Unable to set datetime to {which} {v}")
 
@@ -113,6 +128,19 @@ for _comp in ("year", "month", "day", "hour", "minute", "second"):
             return _set_component(args, comp, f"time::set_{comp}")
 
     _mk_set(_comp)
+
+
+@register("time::set_nanosecond", arity=(2, 2))
+def _set_nanosecond(args, ctx):
+    """Replace the sub-second component (reference time.rs set_nanosecond:
+    whole-second part kept, fraction replaced by `nanos`)."""
+    d = _dtm(args[0], "time::set_nanosecond")
+    v = int(args[1])
+    if v < 0 or v >= (1 << 32):
+        raise SdbError("out of range integral type conversion attempted")
+    if v >= 1_000_000_000:
+        raise SdbError(f"Unable to set datetime to nanosecond {v}")
+    return Datetime(d.dt.replace(microsecond=0), v, d.year_shift)
 
 
 @register("time::timezone")
@@ -137,8 +165,14 @@ def _floor_to(d: Datetime, dur: Duration) -> Datetime:
         raise SdbError("Incorrect arguments for function time::floor(). Expected a positive duration")
     ns = d.epoch_ns()
     f = (ns // dur.ns) * dur.ns
+    # rebuild inside Python's year range, re-attaching the cycle shift
+    # (shifted years would otherwise crash fromtimestamp)
+    from surrealdb_tpu.val import _GREGORIAN_CYCLE_NS
+
+    f -= (d.year_shift // 400) * _GREGORIAN_CYCLE_NS
     secs, frac = divmod(f, 1_000_000_000)
-    return Datetime(_dt.datetime.fromtimestamp(secs, _dt.timezone.utc), frac)
+    return Datetime(_dt.datetime.fromtimestamp(secs, _dt.timezone.utc),
+                    frac, d.year_shift)
 
 
 @register("time::floor")
@@ -180,9 +214,9 @@ def _group(args, ctx):
     if unit not in units:
         raise SdbError("Incorrect arguments for function time::group(). Expected a unit")
     if unit == "year":
-        return Datetime(_dt.datetime(d.dt.year, 1, 1, tzinfo=_dt.timezone.utc))
+        return Datetime.from_parts(d.year, 1, 1)
     if unit == "month":
-        return Datetime(_dt.datetime(d.dt.year, d.dt.month, 1, tzinfo=_dt.timezone.utc))
+        return Datetime.from_parts(d.year, d.dt.month, 1)
     return _floor_to(d, Duration(units[unit]))
 
 
@@ -190,13 +224,19 @@ def _group(args, ctx):
 def _format(args, ctx):
     d = _dtm(args[0], "time::format")
     fmt = args[1]
+    if d.year_shift:
+        # logical-year directives can't ride the shifted proxy datetime
+        y = d.year
+        fmt = (fmt.replace("%Y", str(y))
+                  .replace("%y", f"{y % 100:02d}")
+                  .replace("%C", str(y // 100)))
     return d.dt.strftime(fmt)
 
 
 @register("time::is::leap_year")
 def _leap(args, ctx):
     d = _dtm(args[0], "time::is::leap_year") if args else Datetime.now()
-    y = d.dt.year
+    y = d.year
     return y % 4 == 0 and (y % 100 != 0 or y % 400 == 0)
 
 

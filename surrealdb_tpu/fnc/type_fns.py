@@ -285,10 +285,20 @@ def _ris_edge(args, ctx):
     from surrealdb_tpu.val import NONE as _N
 
     v = args[0]
+    if isinstance(v, str):
+        # string record ids coerce (reference fnc/record.rs is_edge takes
+        # a Thing conversion)
+        from surrealdb_tpu.exec.eval import evaluate
+        from surrealdb_tpu.syn.parser import parse_record_literal
+
+        try:
+            v = evaluate(parse_record_literal(v), ctx)
+        except (SdbError, ValueError):
+            v = None
     if not isinstance(v, RecordId):
         raise SdbError(
             "Incorrect arguments for function record::is_edge(). "
-            "Expected a record"
+            "Expected a record ID"
         )
     doc = fetch_record(ctx, v)
     return (

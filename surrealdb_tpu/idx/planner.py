@@ -329,6 +329,156 @@ def _array_like_paths(tb, ctx) -> set:
     return out
 
 
+def _find_link_join(tb, cond, indexes, ctx):
+    """Record-link index join (reference idx/planner/tree.rs remote-index
+    resolution; plan.rs renders `operator: 'join'` with a `joins` list):
+    a predicate `link.rest OP v` where the local table has a single-column
+    plain index on `link`, the field is a typed `record<rt>` link, and
+    `rt` serves `rest OP v` from one of its own indexes. Returns
+    {lidef, ridef, rt, op, vexpr, mt} or None."""
+    from surrealdb_tpu.exec.document import get_fields
+    from surrealdb_tpu.expr.ast import Matches
+
+    preds = []
+    _split_ands(cond, preds)
+    for pred in preds:
+        mt = None
+        if isinstance(pred, Matches):
+            lp = _field_path(pred.lhs)
+            op, vexpr, mt = "matches", pred.rhs, pred
+        elif isinstance(pred, Binary) and pred.op in ("=", "==", "∈"):
+            lp = _field_path(pred.lhs)
+            if lp is None or _field_path(pred.rhs) is not None:
+                continue
+            op = "in" if pred.op == "∈" else "="
+            vexpr = pred.rhs
+        else:
+            continue
+        if lp is None or "." not in lp or ".*" in lp or "…" in lp:
+            continue
+        first, _, rest = lp.partition(".")
+        lidef = next(
+            (i for i in indexes
+             if list(i.cols_str) == [first] and i.hnsw is None
+             and i.fulltext is None and not i.count),
+            None,
+        )
+        if lidef is None:
+            continue
+        try:
+            fd = next(
+                (f for f in get_fields(tb, ctx) if f.name_str == first), None
+            )
+        except SdbError:
+            continue
+        kind = getattr(fd, "kind", None)
+        if kind is None or kind.name != "record" or \
+                len(kind.inner or []) != 1:
+            continue
+        rt = kind.inner[0]
+        rindexes = get_indexes_for(rt, ctx)
+        if op == "matches":
+            ridef = next(
+                (x for x in rindexes
+                 if x.fulltext is not None and x.cols_str
+                 and x.cols_str[0] == rest),
+                None,
+            )
+        else:
+            ridef = next(
+                (x for x in rindexes
+                 if list(x.cols_str) == [rest] and x.hnsw is None
+                 and x.fulltext is None and not x.count),
+                None,
+            )
+        if ridef is None:
+            continue
+        return {"lidef": lidef, "ridef": ridef, "rt": rt, "op": op,
+                "vexpr": vexpr, "mt": mt}
+    return None
+
+
+def _link_join_scan(tb, jn, ctx):
+    """Execute a link join: remote index access -> remote record ids ->
+    local equality scans on the link index. The WHERE clause re-applies
+    row-wise afterwards (cond is NOT consumed)."""
+    from surrealdb_tpu.exec.eval import evaluate
+
+    def gen():
+        rt, ridef = jn["rt"], jn["ridef"]
+        if jn["op"] == "matches":
+            from surrealdb_tpu.idx.fulltext import ft_search
+
+            q = evaluate(jn["vexpr"], ctx)
+            hits, _offsets = ft_search(
+                ridef, str(q), ctx, boolean=jn["mt"].boolean
+            )
+            remote_ids = [r for r, _s in hits]
+        elif jn["op"] == "in":
+            vals = evaluate(jn["vexpr"], ctx)
+            vals = vals if isinstance(vals, list) else [vals]
+            remote_ids = [
+                s.rid
+                for v in vals
+                for s in _index_scan(rt, ridef, [v], None, ctx)
+            ]
+        else:
+            remote_ids = [
+                s.rid
+                for s in _index_scan(
+                    rt, ridef, [evaluate(jn["vexpr"], ctx)], None, ctx
+                )
+            ]
+        seen = set()
+        for rid in remote_ids:
+            h = hashable(rid)
+            if h in seen:
+                continue
+            seen.add(h)
+            yield from _index_scan(tb, jn["lidef"], [rid], None, ctx)
+
+    return gen()
+
+
+def _link_join_explain(tb, jn, ctx):
+    from surrealdb_tpu.exec.eval import evaluate
+
+    if jn["op"] == "matches":
+        mt = jn["mt"]
+        rop = f"@{mt.ref}@" if mt.ref is not None else "@@"
+        val = evaluate(jn["vexpr"], ctx)
+    elif jn["op"] == "in":
+        rop = "union"
+        val = evaluate(jn["vexpr"], ctx)
+    else:
+        rop = "="
+        val = evaluate(jn["vexpr"], ctx)
+    return {
+        "detail": {
+            "plan": {
+                "index": jn["lidef"].name,
+                "joins": [
+                    {"index": jn["ridef"].name, "operator": rop,
+                     "value": val}
+                ],
+                "operator": "join",
+            },
+            "table": tb,
+        },
+        "operation": "Iterate Index",
+    }
+
+
+def _is_array_value(e) -> bool:
+    """Plan-time is_array() check (reference tree.rs requires a computed
+    array before a union access applies)."""
+    from surrealdb_tpu.expr.ast import ArrayExpr, Literal
+
+    if isinstance(e, ArrayExpr):
+        return True
+    return isinstance(e, Literal) and isinstance(e.value, list)
+
+
 def _classify_preds(cond, array_paths=frozenset(), value_idioms=True):
     """WHERE-tree analysis shared by plan_scan and explain_plan: returns
     (eqs, ins, rngs) keyed by field path. value_idioms=False (streaming
@@ -342,7 +492,7 @@ def _classify_preds(cond, array_paths=frozenset(), value_idioms=True):
         if not isinstance(pred, Binary):
             continue
         if pred.op not in ("=", "==", "∈", "<", "<=", ">", ">=", "∋", "⊇",
-                           "containsany"):
+                           "containsany", "anyinside", "allinside"):
             continue
         lp = _field_path(pred.lhs)
         rp = _field_path(pred.rhs)
@@ -358,9 +508,18 @@ def _classify_preds(cond, array_paths=frozenset(), value_idioms=True):
                     continue
                 op = "="  # per-element entries, equality lookup
             elif op in ("⊇", "containsany"):
-                if not _array_shaped(lp, array_paths):
+                # CONTAINSANY/CONTAINSALL [..] become a union of
+                # per-element equality scans. Legacy tree planner: any
+                # array value qualifies (tree.rs:651-664). Streaming
+                # analyzer: only a `.*`-shaped column (Part::All) matches
+                # (analysis.rs idiom_matches_containment).
+                if not _is_array_value(pred.rhs):
+                    continue
+                if not value_idioms and not (".*" in lp or "…" in lp):
                     continue
                 op = "in"
+            elif op in ("anyinside", "allinside"):
+                continue  # value op field handled in the rhs-path case
             elif op == "∈":
                 op = "in"
             path, valexpr = lp, pred.rhs
@@ -377,6 +536,17 @@ def _classify_preds(cond, array_paths=frozenset(), value_idioms=True):
                 if not _array_shaped(rp, array_paths):
                     continue
                 path, op, valexpr = rp, "=", pred.lhs
+            elif pred.op in ("anyinside", "allinside"):
+                # [..] ANYINSIDE/ALLINSIDE field -> union access
+                # (reference tree.rs AnyInside|AllInside, IdiomPosition::Right;
+                # same per-planner gates as ContainAny)
+                if not _is_array_value(pred.lhs):
+                    continue
+                if not value_idioms and not (".*" in rp or "…" in rp):
+                    continue
+                path, op, valexpr = rp, "in", pred.lhs
+            elif pred.op in ("⊇", "containsany", "∋"):
+                continue  # field op value handled in the lhs-path case
             else:
                 flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
                 path, op, valexpr = rp, flip.get(pred.op, pred.op), pred.lhs
@@ -597,6 +767,18 @@ def _plan_scan(tb: str, cond, ctx, stmt):
     if mts:
         use_ft = True
         if getattr(ctx.session, "planner_strategy", None) == "all-ro":
+            # multi-part idioms (`t.name @@ …`) may traverse record links;
+            # MatchesOp only evaluates against the source table's fulltext
+            # index (reference exec/planner.rs:525-537 PlannerUnimplemented)
+            from surrealdb_tpu.expr.ast import Idiom as _Idiom
+
+            for m in mts:
+                if isinstance(m.lhs, _Idiom) and len(m.lhs.parts) > 1:
+                    raise SdbError(
+                        "Invalid query: New executor does not support: "
+                        "MATCHES with multi-part field path not yet "
+                        "supported in streaming executor"
+                    )
             # the streaming planner scores the MATCHES access at 800
             # (exec/index/analysis.rs:1281): a unique full-equality
             # candidate outranks it and the MATCHES drops to the filter
@@ -609,17 +791,36 @@ def _plan_scan(tb: str, cond, ctx, stmt):
             if ch0 is not None and ch0[3] > 800:
                 use_ft = False
         if use_ft:
+            # a MATCHES on a multi-part link path can't use a LOCAL ft
+            # index — try the remote-index join before plan_matches
+            # raises (single-part un-indexed matches keep the error)
+            if not all(_ft_index_for(m, indexes) for m in mts):
+                jn = _find_link_join(tb, cond, indexes, ctx) if getattr(
+                    ctx.session, "planner_strategy", None
+                ) != "all-ro" else None
+                if jn is not None:
+                    return _link_join_scan(tb, jn, ctx)
+                from surrealdb_tpu.expr.ast import Idiom as _Idiom2
+
+                if all(
+                    isinstance(m.lhs, _Idiom2) and len(m.lhs.parts) > 1
+                    for m in mts
+                ):
+                    return None  # link-path matches: row-wise ad hoc eval
             from surrealdb_tpu.idx.fulltext import plan_matches
 
             return plan_matches(tb, cond, mts, indexes, ctx, stmt)
 
     # ---- equality / range / contains on indexed columns --------------------
     eqs, ins, rngs = _classify_preds(cond, _array_like_paths(tb, ctx))
+    legacy = getattr(ctx.session, "planner_strategy", None) != "all-ro"
     if not eqs and not rngs and not ins:
-        return None
+        jn = _find_link_join(tb, cond, indexes, ctx) if legacy else None
+        return _link_join_scan(tb, jn, ctx) if jn is not None else None
     chosen = _choose_index(indexes, eqs, ins, rngs)
     if chosen is None:
-        return None
+        jn = _find_link_join(tb, cond, indexes, ctx) if legacy else None
+        return _link_join_scan(tb, jn, ctx) if jn is not None else None
     idef, nmatch, tail, _score = chosen
     eq_vals = [evaluate(eqs[c], ctx) for c in idef.cols_str[:nmatch]]
     scan = _index_scan(tb, idef, eq_vals, tail, ctx)
@@ -1119,6 +1320,10 @@ def explain_plan(tb, cond, ctx, stmt):
         eqs, ins, rngs = _classify_preds(cond, _array_like_paths(tb, ctx))
         best = None
         chosen = _choose_index(indexes, eqs, ins, rngs, model="legacy")
+        if chosen is None:
+            jn = _find_link_join(tb, cond, indexes, ctx)
+            if jn is not None:
+                return _link_join_explain(tb, jn, ctx)
         count_only = False
         if stmt is not None and getattr(stmt, "group", None) == [] and \
                 getattr(stmt, "exprs", None):

@@ -588,6 +588,16 @@ def ac_prefix(level: str, ns=None, db=None) -> bytes:
     return b"/!ac" + enc_str(level) + enc_str(ns or "") + enc_str(db or "")
 
 
+def ac_grant(level: str, ns, db, ac, gid: str) -> bytes:  # ACCESS grants
+    return (b"/!ag" + enc_str(level) + enc_str(ns or "") + enc_str(db or "")
+            + enc_str(ac) + enc_str(gid))
+
+
+def ac_grant_prefix(level: str, ns, db, ac) -> bytes:
+    return (b"/!ag" + enc_str(level) + enc_str(ns or "") + enc_str(db or "")
+            + enc_str(ac))
+
+
 def seq_state(ns, db, name) -> bytes:  # sequence state
     return b"/!sq" + enc_str(ns) + enc_str(db) + enc_str(name)
 

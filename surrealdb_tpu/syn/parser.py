@@ -753,9 +753,50 @@ class Parser:
         name = self.ident()
         base = None
         if self.eat_kw("on"):
-            base = self.ident()
-        op = self.ident().lower()
-        return AccessStmt(name, base, op)
+            if self.eat_kw("root"):
+                base = "root"
+            elif self.eat_kw("namespace", "ns"):
+                base = "ns"
+            elif self.eat_kw("database", "db"):
+                base = "db"
+            else:
+                raise self.err("expected ROOT, NAMESPACE or DATABASE")
+        if self.eat_kw("grant"):
+            self.expect_kw("for")
+            if self.eat_kw("user"):
+                subject = ("user", self.ident())
+            elif self.eat_kw("record"):
+                subject = ("record", self.parse_expr())
+            else:
+                raise self.err("expected USER or RECORD")
+            return AccessStmt(name, base, "grant", subject)
+        op = "show" if self.eat_kw("show") else (
+            "revoke" if self.eat_kw("revoke") else None
+        )
+        if op is not None:
+            if self.eat_kw("all"):
+                sel = ("all", None)
+            elif self.eat_kw("grant"):
+                sel = ("grant", self.ident_or_str())
+            elif self.eat_kw("where"):
+                sel = ("where", self.parse_expr())
+            else:
+                raise self.err("expected ALL, GRANT or WHERE")
+            return AccessStmt(name, base, op, selector=sel)
+        if self.eat_kw("purge"):
+            kinds = set()
+            while True:
+                if self.eat_kw("expired"):
+                    kinds.add("expired")
+                elif self.eat_kw("revoked"):
+                    kinds.add("revoked")
+                else:
+                    raise self.err("expected EXPIRED or REVOKED")
+                if not self.eat_op(","):
+                    break
+            grace = self.parse_expr() if self.eat_kw("for") else None
+            return AccessStmt(name, base, "purge", purge=(kinds, grace))
+        raise self.err("expected GRANT, SHOW, REVOKE or PURGE")
 
     # -- INFO -----------------------------------------------------------------
     def _stmt_info(self):
@@ -2219,6 +2260,11 @@ class Parser:
     def _parse_unary(self):
         if self.at_op("-"):
             self.next()
+            t = self.peek()
+            if t.kind == L.INT and t.value == (1 << 63):
+                # i64::MIN: the one magnitude only valid when negated
+                self.next()
+                return Literal(-(1 << 63))
             return Prefix("-", self._parse_unary())
         if self.at_op("!"):
             self.next()
@@ -2510,6 +2556,11 @@ class Parser:
         k = t.kind
         if k == L.INT or k == L.FLOAT or k == L.DECIMAL:
             self.next()
+            if k == L.INT and t.value > (1 << 63) - 1:
+                raise self.err(
+                    "Failed to parse number: number cannot fit within a "
+                    "64bit signed integer"
+                )
             return Literal(t.value)
         if k == L.DURATION:
             self.next()
@@ -2522,6 +2573,15 @@ class Parser:
             return Literal(Datetime.parse(t.value))
         if k == L.UUID_STR:
             self.next()
+            import re as _re2
+
+            # strict 8-4-4-4-12 shape: Python's uuid/int are lenient about
+            # '_' (digit separators), the reference's lexer is not
+            if not _re2.fullmatch(
+                r"[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-"
+                r"[0-9a-fA-F]{4}-[0-9a-fA-F]{12}", t.value
+            ):
+                raise self.err("invalid UUID literal")
             try:
                 return Literal(Uuid(t.value))
             except ValueError:
@@ -2671,7 +2731,14 @@ class Parser:
             if t.kind in (L.IDENT, L.STRING):
                 key = self.next().value
             elif t.kind == L.INT:
-                key = str(self.next().value)
+                # numeric keys keep their raw lexeme ({ 00: 5 } keys "00")
+                # but must still fit the reference's number type
+                if t.value > (1 << 63) - 1:
+                    raise self.err(
+                        "Failed to parse number: number cannot fit within "
+                        "a 64bit signed integer"
+                    )
+                key = self.next().text
             else:
                 raise self.err("expected object key")
             self.expect_op(":")

@@ -141,13 +141,22 @@ class Duration:
         return "".join(out)
 
 
+# one 400-year Gregorian cycle (days are identical across cycles, so
+# shifting by whole cycles preserves weekday, leap pattern, and calendar)
+_GREGORIAN_CYCLE_NS = 146_097 * 86_400 * 1_000_000_000
+
+
 @total_ordering
 class Datetime:
-    """UTC datetime with nanosecond precision."""
+    """UTC datetime with nanosecond precision. Years outside Python's
+    1..9999 (the reference's chrono supports ±262143) are carried via
+    `year_shift` — a multiple of 400 added to dt.year to obtain the
+    logical year; 400-year shifts keep the calendar identical."""
 
-    __slots__ = ("dt", "ns_frac")
+    __slots__ = ("dt", "ns_frac", "year_shift")
 
-    def __init__(self, dt: _dt.datetime, ns_frac: int | None = None):
+    def __init__(self, dt: _dt.datetime, ns_frac: int | None = None,
+                 year_shift: int = 0):
         if dt.tzinfo is None:
             dt = dt.replace(tzinfo=_dt.timezone.utc)
         else:
@@ -155,15 +164,38 @@ class Datetime:
         # ns_frac: full sub-second nanoseconds (supersedes dt.microsecond)
         self.ns_frac = dt.microsecond * 1000 if ns_frac is None else ns_frac
         self.dt = dt.replace(microsecond=0)
+        self.year_shift = year_shift
 
     @classmethod
     def now(cls) -> "Datetime":
         return cls(_dt.datetime.now(_dt.timezone.utc))
 
+    @staticmethod
+    def _shift_year(y: int):
+        """Map a logical year into Python's range; returns (year, shift)."""
+        if 1 <= y <= 9999:
+            return y, 0
+        # land in [2000, 2399] — same leap/weekday cycle
+        k = (2000 - y) // 400 if y < 2000 else -((y - 2399) // 400)
+        yp = y + 400 * k
+        if not 1 <= yp <= 9999:
+            yp = y % 400 + 2000
+            k = (yp - y) // 400
+        return yp, -400 * k
+
+    @classmethod
+    def from_parts(cls, y, mo, d, h=0, mi=0, s=0, ns=0, tzinfo=None) -> "Datetime":
+        yp, shift = cls._shift_year(y)
+        return cls(
+            _dt.datetime(yp, mo, d, h, mi, s,
+                         tzinfo=tzinfo or _dt.timezone.utc),
+            ns, shift,
+        )
+
     @classmethod
     def parse(cls, text: str) -> "Datetime":
         m = _re.match(
-            r"^(\d{4})-(\d{2})-(\d{2})"
+            r"^([+-]?\d{4,6})-(\d{2})-(\d{2})"
             r"(?:[Tt ](\d{2}):(\d{2}):(\d{2})(?:\.(\d{1,9}))?"
             r"(Z|z|[+-]\d{2}:\d{2})?)?$",
             text,
@@ -183,10 +215,15 @@ class Datetime:
             tzinfo = _dt.timezone(off)
         else:
             tzinfo = _dt.timezone.utc
-        return cls(_dt.datetime(y, mo, d, h, mi, s, tzinfo=tzinfo), ns)
+        return cls.from_parts(y, mo, d, h, mi, s, ns, tzinfo)
+
+    @property
+    def year(self) -> int:
+        return self.dt.year + self.year_shift
 
     def epoch_ns(self) -> int:
-        return int(self.dt.timestamp()) * 1_000_000_000 + self.ns_frac
+        base = int(self.dt.timestamp()) * 1_000_000_000 + self.ns_frac
+        return base + (self.year_shift // 400) * _GREGORIAN_CYCLE_NS
 
     def __eq__(self, other):
         return isinstance(other, Datetime) and self.epoch_ns() == other.epoch_ns()
@@ -201,7 +238,12 @@ class Datetime:
         return f"Datetime({self.render()})"
 
     def render(self) -> str:
-        base = self.dt.strftime("%Y-%m-%dT%H:%M:%S")
+        y = self.year
+        if 0 <= y <= 9999:
+            ys = f"{y:04d}"
+        else:
+            ys = f"{y:+05d}"  # chrono renders out-of-range years signed
+        base = ys + self.dt.strftime("-%m-%dT%H:%M:%S")
         if self.ns_frac:
             frac = f"{self.ns_frac:09d}".rstrip("0")
             # pad to 3/6/9 places like chrono's SecondsFormat::AutoSi
@@ -395,6 +437,10 @@ class SSet:
     def render(self) -> str:
         if not self.items:
             return "{,}"
+        if len(self.items) == 1:
+            # single-element sets keep the trailing comma (they would
+            # otherwise parse back as blocks/objects)
+            return "{" + render(self.items[0]) + ",}"
         return "{" + ", ".join(render(x) for x in self.items) + "}"
 
 
