@@ -29,6 +29,7 @@ class DatabaseDef:
 @dataclass
 class TableDef:
     name: str
+    table_id: int = 0  # catalog allocation id (INFO STRUCTURE `id`)
     drop: bool = False
     full: bool = False  # SCHEMAFULL
     kind: str = "any"  # any | normal | relation

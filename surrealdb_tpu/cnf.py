@@ -22,7 +22,7 @@ def env_float(name: str, default: float) -> float:
 
 
 # expression/statement nesting depth (ctx chain)
-MAX_COMPUTATION_DEPTH = env_int("SURREAL_MAX_COMPUTATION_DEPTH", 32)
+MAX_COMPUTATION_DEPTH = env_int("SURREAL_MAX_COMPUTATION_DEPTH", 120)
 # .{..} idiom recursion hard limit
 IDIOM_RECURSION_LIMIT = env_int("SURREAL_IDIOM_RECURSION_LIMIT", 256)
 # embedded-script op budget

@@ -143,8 +143,17 @@ def coerce(v, kind: Kind):
     if n == "any":
         return v
     if n == "option":
-        if v is NONE or v is None:
-            return NONE if v is NONE else v
+        if v is NONE:
+            return NONE
+        if v is None:
+            # NULL is NOT none: option<string> rejects it unless the
+            # inner kind admits null (language/types/field_none_null)
+            if kind.inner:
+                try:
+                    return coerce(v, kind.inner[0])
+                except SdbError:
+                    raise coerce_err(v, kind)
+            raise coerce_err(v, kind)
         return coerce(v, kind.inner[0]) if kind.inner else v
     if n == "either":
         for k in kind.inner:
@@ -550,11 +559,18 @@ def cast(v, kind: Kind):
             )
         if isinstance(v, Range):
             try:
-                return list(v.iter_ints())
+                items = list(v.iter_ints())
             except TypeError:
                 raise cast_err(v, kind)
+            return _len_check(
+                [cast(x, kind.inner[0]) for x in items]
+                if kind.inner else items
+            )
         if isinstance(v, (bytes, bytearray)):
-            return list(v)
+            return _len_check(
+                [cast(x, kind.inner[0]) for x in list(v)]
+                if kind.inner else list(v)
+            )
         raise cast_err(v, kind)
     elif n == "set":
         from surrealdb_tpu.val import SSet
@@ -569,9 +585,11 @@ def cast(v, kind: Kind):
             try:
                 base = list(v.iter_ints())
             except TypeError:
-                raise cast_err(v, kind)
+                raise cast_err(v, Kind("array"))
         else:
-            raise cast_err(v, kind)
+            # set casts convert through array first: failures name `array`
+            # (casting/decimal.surql)
+            raise cast_err(v, Kind("array"))
         if kind.inner:
             base = [cast(x, kind.inner[0]) for x in base]
         out = SSet(base)
@@ -606,5 +624,8 @@ def cast(v, kind: Kind):
             try:
                 return coerce(g, kind)
             except SdbError:
-                raise cast_err(v, kind)
+                raise cast_err(v, Kind("geometry"))
+        # geometry cast failures always name the bare kind (reference
+        # val/convert/cast.rs: the error drops the parameterization)
+        raise cast_err(v, Kind("geometry"))
     raise cast_err(v, kind)

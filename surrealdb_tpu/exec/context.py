@@ -19,7 +19,7 @@ class Ctx:
         "executor", "ns", "db", "knn", "record_cache", "deadline",
         "timeout_dur", "write_version", "depth",
         "perms_enabled", "version", "_cond_consumed", "_cf_seq",
-        "_brute_knn_k", "_strict_readonly", "_stream_cols", "_no_link_fetch",
+        "_brute_knn_k", "_strict_readonly", "_stream_cols", "_no_link_fetch", "_script_depth",
     )
 
     def __init__(self, ds, session, txn, executor=None):
@@ -49,6 +49,7 @@ class Ctx:
         # ORDER BY keys evaluate pre-FETCH with no record-link traversal
         # (reference: sort compares computed values without db access)
         self._no_link_fetch = False
+        self._script_depth = 0  # nested script frames (budget: 15)
 
     def child(self) -> "Ctx":
         c = Ctx.__new__(Ctx)
@@ -76,6 +77,7 @@ class Ctx:
         c._strict_readonly = self._strict_readonly
         c._stream_cols = self._stream_cols
         c._no_link_fetch = self._no_link_fetch
+        c._script_depth = self._script_depth
         from surrealdb_tpu import cnf
 
         if c.depth > cnf.MAX_COMPUTATION_DEPTH:

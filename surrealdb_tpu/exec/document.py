@@ -1109,6 +1109,25 @@ def shape_output(output: OutputClause, before, after, rid, ctx: Ctx):
         after = apply_computed_fields(rid.tb, after, rid, ctx)
     if rid is not None and not ctx.session.is_owner and \
             ctx.session.auth_level != "editor":
+        from surrealdb_tpu.exec.statements import check_table_permission
+
+        # statement output is a read: rows the session can't SELECT drop
+        # from the result set even when the write itself was allowed
+        # (delete/permissions/no_select.surql)
+        if isinstance(before, dict) and not check_table_permission(
+            rid.tb, "select", ctx, before, rid
+        ):
+            before = SKIP
+        if isinstance(after, dict) and not check_table_permission(
+            rid.tb, "select", ctx, after, rid
+        ):
+            after = SKIP
+        if (output is None or output.kind == "after") and after is SKIP:
+            return SKIP
+        if output is not None and output.kind == "before" and before is SKIP:
+            return SKIP
+        before = NONE if before is SKIP else before
+        after = NONE if after is SKIP else after
         after = reduce_fields(rid.tb, after, ctx)
         before = reduce_fields(rid.tb, before, ctx)
     if output is None or output.kind == "after":
@@ -1134,13 +1153,16 @@ def shape_output(output: OutputClause, before, after, rid, ctx: Ctx):
         c.vars["after"] = after
         if k == "value":
             return evaluate(output.fields[0][0], c)
+        from surrealdb_tpu.exec.statements import _dynamic_field_key
+
         out = {}
         for expr, alias in output.fields:
             if expr == "*":
                 if isinstance(doc, dict):
                     out.update(copy_value(doc))
                 continue
-            out[alias or expr_name(expr)] = evaluate(expr, c)
+            key = alias or _dynamic_field_key(expr, c) or expr_name(expr)
+            out[key] = evaluate(expr, c)
         return out
     return copy_value(after)
 
@@ -1495,9 +1517,9 @@ def delete_one(rid: RecordId, before, output, ctx: Ctx):
         from surrealdb_tpu.exec.statements import check_table_permission
 
         if not check_table_permission(rid.tb, "delete", ctx, before, rid):
-            raise SdbError(
-                f"Not enough permissions to perform this action on table '{rid.tb}'"
-            )
+            # a row whose WHERE-perm doesn't match silently drops out of
+            # the statement (reference doc/allow.rs: Ignore, not Error)
+            return SKIP
     # referenced-record ON DELETE actions run before the record vanishes
     apply_ref_on_delete(rid, ctx)
     ctx.txn.delete(K.record(ns, db, rid.tb, rid.id))
@@ -1550,6 +1572,10 @@ def relate_one(kind, fr: RecordId, to: RecordId, data, output, ctx: Ctx, uniq=Fa
     nid = doc.get("id")
     if isinstance(nid, RecordId) and (nid.tb != rid.tb or not value_eq(nid.id, rid.id)):
         rid = nid
+    elif nid is not None and nid is not NONE and not isinstance(nid, RecordId) \
+            and not value_eq(nid, rid.id):
+        # CONTENT { id: "foo" } keys the edge within its table (knows:foo)
+        rid = RecordId(tb, nid)
     doc["id"] = rid
     existing = fetch_record(ctx, rid)
     before = existing if existing is not NONE else NONE

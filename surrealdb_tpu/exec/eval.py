@@ -249,15 +249,20 @@ def _e_param(n, ctx):
         return ctx.vars.get("token", NONE)
     if name == "access":
         return ctx.session.ac if ctx.session.ac is not None else NONE
-    # DEFINE PARAM lookup (as-of under a VERSION clause)
-    if ctx.ns and ctx.db:
-        key = K.pa_def(ctx.ns, ctx.db, name)
-        if ctx.version is not None:
-            pd = ctx.txn.get_val_at(key, version_ns(ctx.version))
-        else:
-            pd = ctx.txn.get_val(key)
-        if isinstance(pd, ParamDef):
-            return pd.value
+    # DEFINE PARAM lookup (as-of under a VERSION clause) — requires a
+    # selected namespace+database (reference: unknown params error
+    # without one, language/param/param_no_namespace)
+    if not ctx.ns:
+        raise SdbError("Specify a namespace to use")
+    if not ctx.db:
+        raise SdbError("Specify a database to use")
+    key = K.pa_def(ctx.ns, ctx.db, name)
+    if ctx.version is not None:
+        pd = ctx.txn.get_val_at(key, version_ns(ctx.version))
+    else:
+        pd = ctx.txn.get_val(key)
+    if isinstance(pd, ParamDef):
+        return pd.value
     return NONE
 
 
@@ -519,13 +524,24 @@ def _e_regex(n, ctx):
 
 def _e_mock(n, ctx):
     out = []
-    if n.end is None:
+    if not getattr(n, "is_range", False) and n.end is None:
         for _ in range(n.beg):
             out.append(RecordId(n.tb, generate_record_key()))
+        return out
+    i64min, i64max = -(1 << 63), (1 << 63) - 1
+    beg = n.beg if n.beg is not None else i64min
+    if getattr(n, "beg_excl", False):
+        beg += 1
+    if n.end is None:
+        stop = i64max + 1  # open end spans to i64::MAX inclusive
     else:
         stop = n.end + 1 if n.end_incl else n.end
-        for i in range(n.beg, stop):
-            out.append(RecordId(n.tb, i))
+    count = max(stop - beg, 0)
+    # reference GENERATION_ALLOCATION_LIMIT: count * sizeof(Value) > 2^20
+    if count * 32 > (1 << 20):
+        raise SdbError("Mock range exceeds allocation limit")
+    for i in range(beg, stop):
+        out.append(RecordId(n.tb, i))
     return out
 
 
@@ -556,9 +572,15 @@ def _e_idiom(n, ctx):
         else:
             doc = ctx.doc
             if doc is None:
-                return NONE
-            val = _get_field(doc, name, ctx)
-            rest = parts[1:]
+                # no current document: the value is NONE, but later parts
+                # still evaluate for control-flow/side effects (BREAK
+                # inside an index expr must escape the loop —
+                # control_flow/loop/break_within_indexing_idiom)
+                val = NONE
+                rest = parts[1:]
+            else:
+                val = _get_field(doc, name, ctx)
+                rest = parts[1:]
     elif isinstance(first, PAll):
         val = ctx.doc
         rest = parts[1:]
@@ -602,6 +624,7 @@ def walk(val, parts, ctx: Ctx, depth=0):
     i = -1
     fanned = False  # a field step mapped over a list: later index parts
     # keep mapping per element (idiom chain continuity)
+    from_graph = False  # the current list is a hop frontier (stays flat)
     while i + 1 < len(parts):
         i += 1
         part = parts[i]
@@ -669,7 +692,13 @@ def walk(val, parts, ctx: Ctx, depth=0):
         elif t is PMethod:
             val = _apply_method(val, part, ctx)
         elif t is PGraph:
+            if isinstance(val, list) and not from_graph:
+                # a VALUE list (array start / filtered array) maps each
+                # element through the remaining chain — hop frontiers
+                # stay flat (language/idiom/graph_filter_flattened)
+                return [walk(x, parts[i:], ctx, depth + 1) for x in val]
             val = _apply_graph(val, part, ctx)
+            from_graph = True
             # graph results are lists; subsequent field parts map over them
         elif t is PFlatten:
             if isinstance(val, list):

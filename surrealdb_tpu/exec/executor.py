@@ -44,9 +44,11 @@ class Executor:
 
     def _execute(self, stmts: list, vars: dict, tel) -> list[QueryResult]:
         results: list[QueryResult] = []
+        self.import_mode = False  # OPTION IMPORT, scoped to this run
         txn = None  # explicit transaction, if open
         ensured_nsdb = False
         failed = False  # explicit txn poisoned
+        returned = False  # top-level RETURN inside the txn: skip to COMMIT
         buffered: list[int] = []  # result idxs inside current explicit txn
         shared_vars = dict(self.session.variables)
         shared_vars.update(vars)
@@ -56,6 +58,7 @@ class Executor:
                 if txn is None:
                     txn = self.ds.transaction(write=True)
                     failed = False
+                    returned = False
                     buffered = []
                     results.append(QueryResult(result=NONE))
                 else:
@@ -106,6 +109,11 @@ class Executor:
                         )
                     )
                 continue
+            if txn is not None and returned:
+                # a top-level RETURN ends the transaction's statement run:
+                # the rest (until COMMIT/CANCEL) neither executes nor
+                # reports (statements/return/breaks_nested_execution)
+                continue
             if txn is not None and failed:
                 # statements after the failing one report the transaction as
                 # cancelled (the failure itself reported the real error)
@@ -155,6 +163,7 @@ class Executor:
                 )
                 if not own_txn:
                     buffered.append(len(results) - 1)
+                    returned = True
             except (BreakException, ContinueException):
                 msg = ("Invalid control flow statement, break or continue statement "
                        "found outside of loop.")

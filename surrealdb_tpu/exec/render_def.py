@@ -418,6 +418,7 @@ def render_table(d) -> str:
 
 def table_structure(d) -> dict:
     out = {
+        "id": getattr(d, "table_id", 0),
         "name": d.name,
         "drop": d.drop,
         "schemafull": d.full,
@@ -584,6 +585,10 @@ def render_event(d, tb) -> str:
         if isinstance(t, _Sub) and isinstance(t.stmt, _Blk):
             t = t.stmt
         x = _expr_sql(t)
+        from surrealdb_tpu.expr.ast import Literal as _Lit
+
+        if isinstance(t, _Lit):
+            return x  # plain values render bare: THEN 'hello world'
         return x if x.startswith(("(", "{")) else f"({x})"
 
     then = ", ".join(wrap(t) for t in d.then)
@@ -872,6 +877,11 @@ def config_structure(d) -> dict:
         if getattr(d, "introspection", None) == "NONE":
             out["introspection"] = _NONE
         return {"graphql": out}
+    if d.what == "API":
+        perms = getattr(d, "config", None) or {}
+        return {"api": {
+            "permissions": perms.get("permissions", True),
+        }}
     return {d.what.lower(): {}}
 
 

@@ -129,6 +129,11 @@ def _ddl_resolve(n, ctx: Ctx):
 
 
 def _s_let(n: LetStmt, ctx):
+    if n.name in ("access", "auth", "token", "session"):
+        # reference cnf PROTECTED_PARAM_NAMES
+        raise SdbError(
+            f"'{n.name}' is a protected variable and cannot be set"
+        )
     v = evaluate(n.what, ctx)
     if n.kind is not None:
         try:
@@ -219,6 +224,12 @@ def _s_use(n: UseStmt, ctx):
 
 
 def _s_option(n, ctx):
+    if n.name.upper() == "IMPORT":
+        # OPTION IMPORT: subsequent DEFINEs overwrite by default (import
+        # streams re-define tables/fields; reference dbs/options.rs).
+        # Scoped to THIS query run (the executor), not the session.
+        if ctx.executor is not None:
+            ctx.executor.import_mode = bool(n.value)
     return NONE
 
 
@@ -476,6 +487,12 @@ def expr_name(expr, sql=False) -> str:
                 out.append("[$]")
             elif isinstance(p, PGraph):
                 arrow = {"out": "->", "in": "<-", "both": "<->", "ref": "<~"}[p.dir]
+                if p.alias is not None:
+                    aname = p.alias if isinstance(p.alias, str) \
+                        else expr_name(p.alias, sql)
+                    # ->(edge AS name): the step names the output field
+                    out.append(("." if out else "") + aname)
+                    continue
                 if p.expr is not None:
                     from surrealdb_tpu.exec.render_def import _select_sql
 
@@ -885,12 +902,31 @@ def _omit_parts(doc, parts):
                 _omit_parts(item, parts[1:])
 
 
+def _dynamic_field_key(expr, ctx):
+    """Unaliased `type::field($p)` projections key by the RESOLVED field
+    name (functions/type/field/..._variable_fields_projection)."""
+    if isinstance(expr, FunctionCall) and expr.name == "type::field" \
+            and expr.args:
+        try:
+            k = evaluate(expr.args[0], ctx)
+        except SdbError:
+            return None
+        if isinstance(k, str):
+            return k
+    return None
+
+
 def _project(src: Source, n: SelectStmt, ctx: Ctx):
     doc = src.doc if src.rid is not None else src.value
     c = ctx.with_doc(doc, src.rid)
     c.knn = ctx.knn
     if n.value is not None:
-        return evaluate(n.value, c)
+        try:
+            return evaluate(n.value, c)
+        except ReturnException as r:
+            # a RETURN inside the projection expr yields that row's value
+            # (reference catch_return at projection boundaries)
+            return r.value
     out = {}
     star = False
     for expr, alias in n.exprs:
@@ -908,6 +944,10 @@ def _project(src: Source, n: SelectStmt, ctx: Ctx):
         if alias:
             _set_out_field(out, alias, v)
         else:
+            dynk = _dynamic_field_key(expr, c)
+            if dynk is not None:
+                _set_out_field(out, dynk, v)
+                continue
             segs = _idiom_segments(expr, c)
             if segs is not None:
                 _set_nested_out(out, segs, v)
@@ -930,6 +970,11 @@ def _idiom_segments(expr, ctx=None):
             segs.append(p.name)
         elif isinstance(p, PGraph):
             arrow = {"out": "->", "in": "<-", "both": "<->", "ref": "<~"}[p.dir]
+            if getattr(p, "alias", None) is not None:
+                # ->(edge AS name) names the output segment
+                segs.append(p.alias if isinstance(p.alias, str)
+                            else expr_name(p.alias))
+                continue
             if getattr(p, "expr", None) is not None:
                 from surrealdb_tpu.exec.render_def import _select_sql
 
@@ -1308,16 +1353,64 @@ def _apply_order(rows, order, ctx):
 
 
 def apply_fetch(v, fetch_paths, ctx):
-    """FETCH: inline record links at given paths."""
+    """FETCH: inline record links at given paths. Params and
+    type::field/type::fields calls resolve to path strings first
+    (reference expr/fetch.rs compute)."""
     for p in fetch_paths:
-        v = _fetch_path(v, _path_parts(p), ctx)
+        for parts in _fetch_parts(p, ctx):
+            v = _fetch_path(v, parts, ctx)
     return v
 
 
-def _path_parts(p):
+def _fetch_parts(p, ctx):
+    """One FETCH item -> list of part-lists (type::fields yields many)."""
     if isinstance(p, Idiom):
-        return [x for x in p.parts]
-    return []
+        # a bare single-field idiom naming a string/array param resolves
+        # dynamically; plain idioms fetch statically
+        if len(p.parts) == 1 and isinstance(p.parts[0], tuple) and \
+                p.parts[0][0] == "start":
+            return _fetch_parts_value(evaluate(p.parts[0][1], ctx))
+        return [list(p.parts)]
+    if isinstance(p, Param):
+        return _fetch_parts_value(evaluate(p, ctx))
+    if isinstance(p, FunctionCall) and p.name in ("type::field",
+                                                  "type::fields"):
+        # the reference evaluates the ARGUMENTS (strings), then parses
+        # them as idioms — not the call itself (expr/fetch.rs:105-150)
+        arg = evaluate(p.args[0], ctx) if p.args else NONE
+        return _fetch_parts_value(arg)
+    if isinstance(p, Literal) and isinstance(p.value, str):
+        return _fetch_parts_value(p.value)
+    return _fetch_parts_value(evaluate(p, ctx))
+
+
+def _fetch_parts_value(val):
+    from surrealdb_tpu.val import render as _r
+
+    if isinstance(val, str):
+        from surrealdb_tpu.syn.parser import Parser
+
+        try:
+            idm = Parser(val).parse_expr()
+        except Exception:
+            idm = None
+        if not isinstance(idm, Idiom):
+            raise SdbError(
+                f"Found {_r(val)} on FETCH CLAUSE, but FETCH expects an "
+                f"idiom, a string or fields"
+            )
+        return [list(idm.parts)]
+    if isinstance(val, list):
+        out = []
+        for x in val:
+            out.extend(_fetch_parts_value(x))
+        return out
+    if isinstance(val, Idiom):
+        return [list(val.parts)]
+    raise SdbError(
+        f"Found {_r(val)} on FETCH CLAUSE, but FETCH expects an idiom, "
+        f"a string or fields"
+    )
 
 
 def _fetch_path(v, parts, ctx):
@@ -1845,10 +1938,14 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                     enforceable = False
                     is_extra_bound = False
                     if isinstance(pred, _B):
-                        pth = _field_path(pred.lhs) or _field_path(pred.rhs)
+                        lp0 = _field_path(pred.lhs)
+                        pth = lp0 or _field_path(pred.rhs)
+                        # containment accesses (value INSIDE field, field
+                        # CONTAINS v) scan candidate elements — the
+                        # predicate always re-filters above the scan
                         enforceable = pred.op in (
-                            "=", "==", "<", "<=", ">", ">=", "∈"
-                        )
+                            "=", "==", "<", "<=", ">", ">="
+                        ) or (pred.op == "∈" and lp0 is not None)
                         # later range bounds on the tail column dropped
                         # out of the access string — they filter above
                         is_extra_bound = any(
@@ -3126,7 +3223,12 @@ def _s_delete(n: DeleteStmt, ctx: Ctx):
             if not is_truthy(evaluate(n.cond, c)):
                 continue
         r = delete_one(src.rid, src.doc, n.output, ctx)
-        if n.output is not None and n.output.kind != "none":
+        from surrealdb_tpu.exec.document import SKIP as _SKIP
+
+        if n.output is not None and n.output.kind != "none" and \
+                r is not _SKIP:
+            # permission-skipped rows and select-gated outputs drop out;
+            # a legitimately-NONE RETURN VALUE stays
             results.append(r)
     return _only_wrap(results, n.only) if n.only else results
 
@@ -3189,7 +3291,7 @@ def _exists_guard(ctx, key, name, kind, if_not_exists, overwrite,
     if ctx.txn.get(key) is not None:
         if if_not_exists:
             return True  # skip silently
-        if not overwrite:
+        if not overwrite and not getattr(ctx.executor, "import_mode", False):
             raise SdbError(
                 msg or f"The {kind} '{name}' already exists"
             )
@@ -3252,8 +3354,19 @@ def _s_define_table(n: DefineTable, ctx):
         kind = "normal" if n.full else "any"
     else:
         kind = n.kind
+    # catalog table ids allocate monotonically per database (the
+    # reference's TableId; surfaced by INFO ... STRUCTURE) — REMOVEd
+    # tables never free their id
+    _idk = K.tb_idseq(ns, db)
+    existing = ctx.txn.get_val(K.tb_def(ns, db, n.name))
+    if existing is not None:
+        next_id = getattr(existing, "table_id", 0)  # redefinition keeps id
+    else:
+        next_id = ctx.txn.get_val(_idk) or 0
+        ctx.txn.set_val(_idk, next_id + 1)
     tdef = TableDef(
         name=n.name,
+        table_id=next_id,
         drop=n.drop,
         full=n.full,
         kind=kind,
@@ -4722,8 +4835,11 @@ def _s_info(n: InfoStmt, ctx: Ctx):
         if syscfg:
             out["config"] = {k: v for k, v in sorted(syscfg.items())}
         dflt = ctx.txn.get_val(K.cfg_def("", "", "DEFAULT"))
-        if dflt is not None:
-            out["defaults"] = {k: v for k, v in sorted(dflt.items())}
+        # always present: {} when no DEFAULT config (remove/config/default)
+        out["defaults"] = (
+            {k: v for k, v in sorted(dflt.items())} if dflt is not None
+            else {}
+        )
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ns_prefix())):
             out["namespaces"][d.name] = render_ns(d)
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.us_prefix("root"))):
@@ -5065,7 +5181,8 @@ def _s_access(n, ctx):
             import datetime as _dt
 
             expiration = Datetime(
-                creation.dt + _dt.timedelta(seconds=dur.to_seconds())
+                creation.dt + _dt.timedelta(seconds=dur.to_seconds()),
+                creation.ns_frac, creation.year_shift,
             )
         else:
             expiration = NONE
@@ -5180,3 +5297,22 @@ _STMTS = {
     ShowStmt: _s_show,
     AccessStmt: _s_access,
 }
+
+
+def _import_silences(fn):
+    """OPTION IMPORT: data statements run fully (indexes populate) but
+    report NONE, matching import-stream behavior (statements/option)."""
+
+    def wrapped(n, ctx):
+        out = fn(n, ctx)
+        if getattr(ctx.executor, "import_mode", False):
+            return NONE
+        return out
+
+    return wrapped
+
+
+for _t in (CreateStmt, InsertStmt, UpdateStmt, UpsertStmt, DeleteStmt,
+           RelateStmt):
+    _STMTS[_t] = _import_silences(_STMTS[_t])
+

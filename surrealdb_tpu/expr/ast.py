@@ -150,12 +150,15 @@ class RegexLit(Node):
 @dataclass
 class Mock(Node):
     """|table:count| or |table:min..max| — generate mock records.
-    `..` excludes the end id, `..=` includes it (reference TypedRange)."""
+    `..` excludes the end id, `..=` includes it; `>..` excludes the
+    begin; open bounds span the i64 range (reference TypedRange)."""
 
     tb: str
-    beg: int
+    beg: Optional[int]
     end: Optional[int] = None
     end_incl: bool = False
+    beg_excl: bool = False
+    is_range: bool = False
 
 
 # --- idioms -----------------------------------------------------------------

@@ -210,7 +210,13 @@ def call_custom(name, args, ctx):
     for i, (pname, pkind) in enumerate(fd.args):
         v = args[i] if i < len(args) else NONE
         if pkind is not None:
-            v = coerce(v, pkind)
+            try:
+                v = coerce(v, pkind)
+            except SdbError as e:
+                raise SdbError(
+                    f"Incorrect arguments for function fn::{name}(). "
+                    f"Failed to coerce argument `${pname}`: {e}"
+                )
         c.vars[pname] = v
     try:
         out = evaluate(fd.block, c)
@@ -607,7 +613,7 @@ ARITY.update({
     "type::bool": (1, 1), "type::datetime": (1, 1), "type::decimal": (1, 1),
     "type::duration": (1, 1), "type::float": (1, 1), "type::int": (1, 1),
     "type::number": (1, 1), "type::string": (1, 1), "type::table": (1, 1),
-    "type::thing": (1, 2), "type::record": (1, 2), "type::uuid": (1, 1),
+    "type::record": (1, 2), "type::uuid": (1, 1),
     "type::point": (1, 2), "type::field": (1, 1), "type::fields": (1, 1),
     "type::range": (1, 1), "type::array": (1, 1), "type::bytes": (1, 1),
     # vector

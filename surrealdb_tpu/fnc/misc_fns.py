@@ -907,7 +907,28 @@ def _nextval(args, ctx):
     st = ctx.txn.get_val(kdef)
     if st is None:
         raise SdbError(f"The sequence '{name}' does not exist")
+    tmo = getattr(st[0], "timeout", None)
+    deadline = None
+    if tmo is not None and getattr(tmo, "ns", None) is not None:
+        import time as _time
+
+        # batch allocation respects the sequence's TIMEOUT (reference
+        # kvs/sequences.rs; a 0ns timeout can never allocate)
+        if tmo.ns == 0:
+            raise SdbError(
+                "The query was not executed because it exceeded the "
+                f"timeout: {tmo.render()}"
+            )
+        deadline = _time.monotonic() + tmo.ns / 1e9
     for _ in range(16):
+        if deadline is not None:
+            import time as _time
+
+            if _time.monotonic() > deadline:
+                raise SdbError(
+                    "The query was not executed because it exceeded the "
+                    f"timeout: {tmo.render()}"
+                )
         txn = ctx.ds.transaction(write=True)
         try:
             st2 = txn.get_val(kdef)

@@ -23,6 +23,17 @@ def run_script(source: str, args, ctx):
     """
     from surrealdb_tpu.fnc.script.interp import Interpreter, JSError
 
+    # script recursion budget: the reference's 120-unit computation depth
+    # admits 15 nested script frames (language/script/massive_parallel);
+    # the counter is a Ctx field inherited by child contexts — not a
+    # user-visible variable
+    depth = ctx._script_depth
+    if depth >= 15:
+        raise SdbError(
+            "Reached excessive computation depth due to functions, "
+            "subqueries, or computed values"
+        )
+    ctx._script_depth = depth + 1
     try:
         interp = Interpreter(ctx)
         return interp.run_function(source, args)
@@ -30,9 +41,16 @@ def run_script(source: str, args, ctx):
         raise SdbError(
             f"Problem with embedded script function. An exception occurred: {e.message}"
         )
+    except SdbError as e:
+        # errors crossing a script boundary wrap once per frame
+        raise SdbError(
+            f"Problem with embedded script function. An exception occurred: {e}"
+        )
     except RecursionError:
         raise SdbError(
             "Problem with embedded script function. An exception occurred: "
             "Reached excessive computation depth due to functions, "
             "subqueries, or computed values"
         )
+    finally:
+        ctx._script_depth = depth

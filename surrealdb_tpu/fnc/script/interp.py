@@ -714,12 +714,46 @@ class Interpreter:
             "query": ("native", self._host_query),
             "value": ("native", self._host_value),
             "Query": ("class_query",),
+            "functions": self._functions_tree(),
         })
         # script-visible session params: every SurrealQL $var
         for name, val in self.ctx.vars.items():
             if isinstance(name, str) and name.isidentifier():
                 if not env.has(name):
                     env.declare(name, sql_to_js(val))
+
+    def _functions_tree(self):
+        """surrealdb.functions.<family>.<name>(...) — every registered SQL
+        function as a nested host object (reference fnc/script surrealdb
+        module bindings)."""
+        from surrealdb_tpu.fnc import FUNCS, invoke
+
+        def mk(fname, fn):
+            def call(this, args):
+                out = invoke(fname, fn, [js_to_sql(a) for a in args],
+                             self.ctx)
+                return sql_to_js(out)
+
+            return ("native", call)
+
+        tree: dict = {}
+        for fname, fn in FUNCS.items():
+            if fname.startswith("__"):
+                continue
+            segs = fname.split("::")
+            cur = tree
+            ok = True
+            for s in segs[:-1]:
+                nxt = cur.setdefault(s, {})
+                if not isinstance(nxt, dict):
+                    ok = False  # name collides with a leaf (e.g. count)
+                    break
+                cur = nxt
+            if ok and isinstance(cur, dict) and not isinstance(
+                cur.get(segs[-1]), dict
+            ):
+                cur[segs[-1]] = mk(fname, fn)
+        return tree
 
     def _host_query(self, this, args):
         q = args[0] if args else ""

@@ -200,7 +200,33 @@ _is("alpha", lambda s: bool(s) and s.isalpha())
 _is("ascii", lambda s: s.isascii())
 _is("hexadecimal", lambda s: bool(_HEX_RX.match(s)))
 _is("numeric", lambda s: bool(_NUMERIC_RX.match(s)))
-_is("email", lambda s: bool(_EMAIL_RX.match(s)))
+_ATEXT = set(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789"
+    "!#$%&'*+-/=?^_`{|}~"
+)
+
+
+def _is_email_addr(s: str) -> bool:
+    """RFC 5321 addr-spec shape (reference links the `addr` crate):
+    dot-atom local part, dot-atom domain or [address literal]."""
+    at = s.rfind("@")
+    if at <= 0 or at == len(s) - 1:
+        return False
+    local, domain = s[:at], s[at + 1:]
+    for seg in local.split("."):
+        if not seg or any(c not in _ATEXT for c in seg):
+            return False
+    if domain.startswith("[") and domain.endswith("]"):
+        return len(domain) > 2  # address literal (IPv6: / IPv4)
+    for seg in domain.split("."):
+        if not seg or seg.startswith("-") or seg.endswith("-"):
+            return False
+        if not all(c.isalnum() or c == "-" for c in seg):
+            return False
+    return True
+
+
+_is("email", _is_email_addr)
 _is("semver", lambda s: bool(_SEMVER_RX.match(s)))
 _is("ulid", lambda s: bool(_ULID_RX.match(s)))
 _is("uuid", lambda s: bool(_UUID_RX.match(s)))

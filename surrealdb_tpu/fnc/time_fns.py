@@ -220,10 +220,46 @@ def _group(args, ctx):
     return _floor_to(d, Duration(units[unit]))
 
 
+# chrono strftime specifiers (reference uses chrono::format; Python's
+# strftime silently passes unknown sequences through, chrono errors)
+_CHRONO_SPECS = set("YCyqmbBhdeaAwuUWGgVjDxFvHkIlPpMSfRTXrZzstn%c+")
+
+
+def _validate_chrono_fmt(fmt: str, fname: str):
+    i, n = 0, len(fmt)
+    while i < n:
+        if fmt[i] != "%":
+            i += 1
+            continue
+        i += 1
+        if i < n and fmt[i] in "-_0":  # padding modifiers
+            i += 1
+        if i < n and fmt[i] == ".":
+            i += 1
+            if i < n and fmt[i] in "369":
+                i += 1
+        elif i < n and fmt[i] in "369" and i + 1 < n and fmt[i + 1] == "f":
+            i += 1
+        if i < n and fmt[i] == ":":
+            while i < n and fmt[i] == ":":
+                i += 1
+            if i < n and fmt[i] == "z":
+                i += 1
+                continue
+            i -= 1
+        if i >= n or fmt[i] not in _CHRONO_SPECS:
+            raise SdbError(
+                f"Incorrect arguments for method {fname}(). `{fmt}` is "
+                f"not a valid time formatting string"
+            )
+        i += 1
+
+
 @register("time::format")
 def _format(args, ctx):
     d = _dtm(args[0], "time::format")
     fmt = args[1]
+    _validate_chrono_fmt(fmt, "time::format")
     if d.year_shift:
         # logical-year directives can't ride the shifted proxy datetime
         y = d.year

@@ -32,8 +32,24 @@ def _cast_to(name):
 
 
 for _n in ("bool", "bytes", "datetime", "decimal", "duration", "float", "int",
-           "number", "string", "uuid", "regex", "array", "set", "geometry"):
+           "number", "string", "uuid", "regex", "array", "geometry"):
     register(f"type::{_n}")(_cast_to(_n))
+
+
+@register("type::set")
+def _type_set(args, ctx):
+    from surrealdb_tpu.exec.coerce import cast
+
+    try:
+        return cast(args[0], Kind("set"))
+    except SdbError:
+        # the FUNCTION's failure names `set` (functions/type/set.surql),
+        # unlike the <set> cast which converts through `array`
+        from surrealdb_tpu.val import render
+
+        raise SdbError(
+            f"Could not cast into `set` using input `{render(args[0])}`"
+        )
 
 
 @register("type::string_lossy")
@@ -67,8 +83,9 @@ def _table(args, ctx):
     return Table(to_string(v))
 
 
-@register("type::thing")
 def _thing(args, ctx):
+    """2.x type::thing — kept callable for internal use; the parser
+    rejects the path with a `type::record` hint (path_hints suite)."""
     tb = args[0]
     tbname = tb.name if isinstance(tb, Table) else tb
     if isinstance(tb, RecordId) and len(args) == 1:

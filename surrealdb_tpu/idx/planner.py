@@ -557,6 +557,13 @@ def _classify_preds(cond, array_paths=frozenset(), value_idioms=True):
                     continue
         if path is None or path == "id":
             continue
+        if not value_idioms and (".*" in path or "…" in path) and \
+                pred.op in ("=", "==", "<", "<=", ">", ">="):
+            # the streaming analyzer's plain equality/range access needs a
+            # plain column idiom; Part::All columns serve only the
+            # CONTAINS/INSIDE per-element accesses
+            # (create_with_std_index_with_flattened_field)
+            continue
         if op in ("=", "=="):
             eqs.setdefault(path, valexpr)
         elif op == "in":

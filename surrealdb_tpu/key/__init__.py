@@ -598,6 +598,10 @@ def ac_grant_prefix(level: str, ns, db, ac) -> bytes:
             + enc_str(ac))
 
 
+def tb_idseq(ns, db) -> bytes:  # monotonic table-id allocator
+    return b"/!ti" + enc_str(ns) + enc_str(db)
+
+
 def seq_state(ns, db, name) -> bytes:  # sequence state
     return b"/!sq" + enc_str(ns) + enc_str(db) + enc_str(name)
 

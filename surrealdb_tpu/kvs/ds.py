@@ -30,6 +30,7 @@ class Session:
         # is deterministic — the language-test harness sets this
         # (reference dbs/session.rs:44)
         self.redact_volatile_explain_attrs = False
+        self.import_mode = False  # OPTION IMPORT: DEFINEs overwrite
         self.variables: dict[str, Any] = {}
 
     @property
@@ -209,7 +210,7 @@ class Datastore:
         stmts = self._ast_cache.get(sql)
         if stmts is None:
             try:
-                stmts = parse(sql)
+                stmts = parse(sql, capabilities=self.capabilities)
             except ParseError as e:
                 # a parse error fails the whole query (reference behaviour)
                 return [QueryResult(error=str(e))]

@@ -328,7 +328,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
         vars = {"_tb": tb}
         if rid is not None:
             vars["_id"] = rid
-            target = "type::thing($_tb, $_id)"
+            target = "type::record($_tb, $_id)"
         else:
             target = "type::table($_tb)"
         body = self._body()

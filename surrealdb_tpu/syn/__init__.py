@@ -9,9 +9,12 @@ single execution engine.
 from surrealdb_tpu.syn.parser import Parser
 
 
-def parse(text: str):
+def parse(text: str, capabilities=None):
     """Parse a SurrealQL query into a list of statements."""
-    return Parser(text).parse_query()
+    p = Parser(text)
+    if capabilities is not None:
+        p.capabilities = capabilities
+    return p.parse_query()
 
 
 def parse_value(text: str):

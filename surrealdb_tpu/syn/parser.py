@@ -38,6 +38,24 @@ _KIND_NAMES = {
 }
 
 
+def _edit_distance(a: str, b: str, cap: int = 1 << 30) -> int:
+    """Levenshtein distance with an early-exit cap (did-you-mean hints)."""
+    if abs(len(a) - len(b)) >= cap:
+        return cap
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        best = i
+        for j, cb in enumerate(b, 1):
+            v = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+            cur.append(v)
+            best = min(best, v)
+        if best >= cap:
+            return cap
+        prev = cur
+    return prev[-1]
+
+
 class Parser:
     def __init__(self, text: str):
         self.toks = L.tokenize(text)
@@ -747,6 +765,53 @@ class Parser:
         self.eat_kw("table")
         tb = self.ident()
         return RebuildIndex(name, tb, if_exists)
+
+    # deprecated 2.x paths that renamed in 3.x (reference path-hint table)
+    _DEPRECATED_FN = {
+        "type::thing": "type::record",
+        "rand::uuid::v4": "rand::uuid",
+        "meta::id": "record::id",
+        "meta::tb": "record::tb",
+    }
+
+    def _check_function_path(self, full: str):
+        """Built-in function paths validate at PARSE time with
+        did-you-mean hints (reference syn function-path checking);
+        fn::/mod::/ml::/api:: and internal markers stay dynamic."""
+        low = full.lower()
+        head = low.split("::", 1)[0]
+        if head in ("fn", "ml", "api") or low.startswith("__"):
+            return
+        if head == "mod":
+            caps = getattr(self, "capabilities", None)
+            allowed = caps is not None and caps.allows_experimental(
+                "surrealism"
+            )
+            if not allowed:
+                raise self.err(
+                    "Experimental capability `surrealism` is not enabled"
+                )
+            return
+        from surrealdb_tpu.fnc import ARITY, FUNCS
+
+        if low in FUNCS or low in ARITY:
+            return
+        hint = self._DEPRECATED_FN.get(low)
+        if hint is None:
+            best, bd = None, 1 << 30
+            for cand in FUNCS:
+                if "::" not in cand or cand.startswith("__"):
+                    continue
+                d = _edit_distance(low, cand, bd)
+                if d < bd:
+                    best, bd = cand, d
+            hint = best if best is not None and bd <= 3 else None
+        if hint is not None:
+            raise self.err(
+                f"Invalid function/constant path, did you maybe mean "
+                f"`{hint}`"
+            )
+        raise self.err("Invalid function/constant path")
 
     def _stmt_access(self):
         self.next()
@@ -2383,7 +2448,7 @@ class Parser:
             break
         if not parts:
             return base
-        if isinstance(base, Idiom):
+        if isinstance(base, Idiom) and not getattr(base, "_paren", False):
             base.parts.extend(parts)
             return base
         return Idiom([("start", base)] + parts)
@@ -2681,7 +2746,14 @@ class Parser:
             self.expect_op(")")
             return FunctionCall("__point__", [e, e2])
         self.expect_op(")")
-        return Subquery(e) if _is_stmt(e) else e
+        if _is_stmt(e):
+            return Subquery(e)
+        if isinstance(e, Idiom):
+            # `(a.b)[0]` indexes the parenthesized RESULT; mark the idiom
+            # closed so postfix parts don't splice into its chain
+            # (language/idiom/continuity.surql)
+            e._paren = True
+        return e
 
     def _parse_object_or_block_expr(self):
         # decide: object literal vs set literal vs block
@@ -2759,15 +2831,50 @@ class Parser:
             self.next()
             tb = self.ident()
             self.expect_op(":")
-            beg = self.next().value
-            end = None
-            end_incl = False
-            if self.at_op("..", "..="):
+            beg = end = None
+            beg_excl = end_incl = False
+            is_range = False
+            if self.peek().kind == L.INT or (
+                self.at_op("-") and self.peek(1).kind == L.INT
+            ):
+                neg = self.eat_op("-")
+                beg = self.next().value
+                if neg:
+                    beg = -beg
+            if self.at_op(">"):
+                self.next()
+                beg_excl = True
+                if self.at_op("..="):
+                    end_incl = True
+                    self.next()
+                else:
+                    self.expect_op("..")
+                is_range = True
+            elif self.at_op("..", "..="):
                 end_incl = self.peek().text == "..="
                 self.next()
+                is_range = True
+            else:
+                is_range = False
+            if is_range and (self.peek().kind == L.INT or (
+                self.at_op("-") and self.peek(1).kind == L.INT
+            )):
+                neg = self.eat_op("-")
                 end = self.next().value
+                if neg:
+                    end = -end
+            if is_range and self.at_op("..="):
+                # >..= combination: `1>..=4`
+                self.next()
+                end_incl = True
+                neg = self.eat_op("-")
+                end = self.next().value
+                if neg:
+                    end = -end
             self.expect_op("|")
-            return Mock(tb, beg, end, end_incl)
+            if not is_range and beg is None:
+                raise self.err("expected mock count or range")
+            return Mock(tb, beg, end, end_incl, beg_excl, is_range)
         # closure
         self.next()
         params = []
@@ -2847,6 +2954,7 @@ class Parser:
                     if not self.eat_op(","):
                         break
                 self.expect_op(")")
+                self._check_function_path(full)
                 return FunctionCall(full, args, version)
             if full.lower() in _CONSTANTS:
                 return Constant(full.lower())
@@ -2864,7 +2972,8 @@ class Parser:
         # record id literal:  tb:key
         if self.at_op(":") and not self.peek().ws_before:
             nxt = self.peek(1)
-            if nxt.kind in (L.INT, L.IDENT, L.UUID_STR, L.STRING) or (
+            if nxt.kind in (L.INT, L.IDENT, L.UUID_STR, L.STRING,
+                            L.DURATION) or (
                 nxt.kind == L.OP and nxt.text in ("[", "{", "-", "..", "..=", "⟨", "`")
             ):
                 self.next()  # ':'

@@ -106,4 +106,4 @@ def test_cnf_env_knobs(monkeypatch):
     assert cnf.MAX_COMPUTATION_DEPTH == 7
     monkeypatch.delenv("SURREAL_MAX_COMPUTATION_DEPTH")
     importlib.reload(cnf)
-    assert cnf.MAX_COMPUTATION_DEPTH == 32
+    assert cnf.MAX_COMPUTATION_DEPTH == 120  # reference default (cnf/mod.rs:40)

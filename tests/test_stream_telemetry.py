@@ -60,7 +60,7 @@ def test_stream_vectorized_projection(q, ds):
     rng = np.random.default_rng(5)
     q("DEFINE TABLE v")
     xs = rng.normal(size=(50, 8))
-    q("FOR $i IN 0..50 { CREATE type::thing('v', $i) SET emb = $e[$i] }",
+    q("FOR $i IN 0..50 { CREATE type::record('v', $i) SET emb = $e[$i] }",
       e=xs.tolist())
     qv = rng.normal(size=(8,)).tolist()
     sql = ("SELECT id, vector::similarity::cosine(emb, $q) AS s FROM v "
@@ -135,7 +135,7 @@ def test_stream_multibatch_vectorized_no_sort(q, ds):
         rng = np.random.default_rng(9)
         q("DEFINE TABLE vb")
         xs = rng.normal(size=(100, 4))
-        q("FOR $i IN 0..100 { CREATE type::thing('vb', $i) SET emb = $e[$i] }",
+        q("FOR $i IN 0..100 { CREATE type::record('vb', $i) SET emb = $e[$i] }",
           e=xs.tolist())
         qv = rng.normal(size=(4,)).tolist()
         rows, used = _stream_used(
