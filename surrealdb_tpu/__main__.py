@@ -57,6 +57,23 @@ def main(argv=None):
     )
     p_kv.add_argument("--bind", default="127.0.0.1:8100")
 
+    p_ml = sub.add_parser("ml", help="import/export ML models (.surml)")
+    ml_sub = p_ml.add_subparsers(dest="ml_cmd", required=True)
+    p_mli = ml_sub.add_parser("import")
+    p_mli.add_argument("--path", default="memory")
+    p_mli.add_argument("--ns", required=True)
+    p_mli.add_argument("--db", required=True)
+    p_mli.add_argument("--name", default=None)
+    p_mli.add_argument("--version", dest="model_version", default=None)
+    p_mli.add_argument("file")
+    p_mle = ml_sub.add_parser("export")
+    p_mle.add_argument("--path", default="memory")
+    p_mle.add_argument("--ns", required=True)
+    p_mle.add_argument("--db", required=True)
+    p_mle.add_argument("name")
+    p_mle.add_argument("model_version")
+    p_mle.add_argument("file", nargs="?", default="-")
+
     sub.add_parser("version")
 
     args = ap.parse_args(argv)
@@ -138,6 +155,29 @@ def main(argv=None):
                 else:
                     print(render(r.result))
         return 0
+
+    if args.cmd == "ml":
+        ds = Datastore(args.path)
+        if args.ml_cmd == "import":
+            from surrealdb_tpu.ml import import_model
+
+            data = open(args.file, "rb").read()
+            d = import_model(ds, args.ns, args.db, data,
+                             name=args.name, version=args.model_version)
+            print(f"imported ml::{d.name}<{d.version}> hash={d.hash}")
+            return 0
+        if args.ml_cmd == "export":
+            from surrealdb_tpu.ml import export_model
+
+            raw = export_model(ds, args.ns, args.db, args.name,
+                               args.model_version)
+            if args.file == "-":
+                import sys as _sys
+
+                _sys.stdout.buffer.write(raw)
+            else:
+                open(args.file, "wb").write(raw)
+            return 0
 
     if args.cmd == "export":
         from surrealdb_tpu.kvs.export import export_sql

@@ -135,6 +135,18 @@ class AccessDef:
 
 
 @dataclass
+class MlModelDef:
+    """A stored ML model (reference catalog MlModelDefinition +
+    surrealml hash-addressed storage)."""
+
+    name: str
+    version: str
+    comment: Optional[str] = None
+    permissions: Any = True
+    hash: str = ""
+
+
+@dataclass
 class SequenceDef:
     name: str
     batch: int = 1000

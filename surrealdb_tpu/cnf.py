@@ -57,3 +57,7 @@ AST_CACHE_SIZE = env_int("SURREAL_AST_CACHE_SIZE", 512)
 SLOW_QUERY_THRESHOLD_MS = env_float("SURREAL_SLOW_QUERY_THRESHOLD_MS", 0.0)
 # file-engine WAL batches between snapshot compactions
 WAL_COMPACT_BATCHES = env_int("SURREAL_WAL_COMPACT_BATCHES", 4096)
+
+# LSM engine (kvs/lsm.py — reference surrealkv role)
+LSM_MEMTABLE_BYTES = env_int("SURREAL_LSM_MEMTABLE_BYTES", 8 << 20)
+LSM_COMPACT_SEGMENTS = env_int("SURREAL_LSM_COMPACT_SEGMENTS", 6)

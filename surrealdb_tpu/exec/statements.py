@@ -4870,6 +4870,13 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             out["params"][d.name] = render_param(d)
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.fc_prefix(ns, db))):
             out["functions"][d.name] = render_function(d)
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ml_prefix(ns, db))):
+            label = f"{d.name}<{d.version}>"
+            txt = f"DEFINE MODEL ml::{d.name}<{d.version}>"
+            if d.comment:
+                txt += f" COMMENT '{d.comment}'"
+            txt += " PERMISSIONS FULL"
+            out["models"][label] = txt
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.az_prefix(ns, db))):
             out["analyzers"][d.name] = render_analyzer(d)
         for _k, d in ctx.txn.scan_vals(

@@ -129,9 +129,18 @@ def call_function(node, ctx):
     if name.startswith("fn::"):
         return call_custom(node.name[4:], [evaluate(a, ctx) for a in node.args], ctx)
     if name.startswith("ml::"):
-        raise SdbError(
-            "Problem with machine learning computation. "
-            "Machine learning computation is not enabled."
+        from surrealdb_tpu.ml import compute_model
+
+        version = getattr(node, "version", None)
+        if not version:
+            raise SdbError(
+                f"Incorrect arguments for function {name}(). "
+                f"A model version is required: {name}<1.0.0>(...)"
+            )
+        # model names are case-sensitive (unlike builtin fn paths)
+        return compute_model(
+            node.name[4:], version,
+            [evaluate(a, ctx) for a in node.args], ctx,
         )
     if name == "__future__":
         # futures evaluate lazily; this build evaluates at read time

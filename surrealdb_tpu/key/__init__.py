@@ -598,6 +598,20 @@ def ac_grant_prefix(level: str, ns, db, ac) -> bytes:
             + enc_str(ac))
 
 
+def ml_def(ns, db, name, version) -> bytes:  # ML model definition
+    return (b"/!ml" + enc_str(ns) + enc_str(db) + enc_str(name)
+            + enc_str(version))
+
+
+def ml_prefix(ns, db) -> bytes:
+    return b"/!ml" + enc_str(ns) + enc_str(db)
+
+
+def ml_blob(ns, db, name, version) -> bytes:  # ML model payload bytes
+    return (b"/!mb" + enc_str(ns) + enc_str(db) + enc_str(name)
+            + enc_str(version))
+
+
 def tb_idseq(ns, db) -> bytes:  # monotonic table-id allocator
     return b"/!ti" + enc_str(ns) + enc_str(db)
 

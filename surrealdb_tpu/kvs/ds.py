@@ -103,6 +103,10 @@ class Datastore:
             from surrealdb_tpu.kvs.mem import MemBackend
 
             self.backend = MemBackend()
+        elif path.startswith("lsm://"):
+            from surrealdb_tpu.kvs.lsm import LsmBackend
+
+            self.backend = LsmBackend(path[len("lsm://"):])
         elif path.startswith("file://") or path.startswith("skv://"):
             from surrealdb_tpu.kvs.file import FileBackend
 
@@ -123,6 +127,7 @@ class Datastore:
         self.live_queries: dict = {}  # uuid-str -> LiveQuery
         self.notifications: list[Notification] = []  # in-proc delivery queue
         self.notification_handlers: list = []  # callables(Notification)
+        self.ml_cache: dict = {}  # (ns,db,name,version,hash) -> SurmlFile
         self.sequences: dict = {}
         self.changefeed_vs = 0  # monotonically increasing versionstamp
         self.graph_engine = None  # (ns,db,node_tb,edge_tb,dir) -> CsrGraph

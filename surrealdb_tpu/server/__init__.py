@@ -186,6 +186,29 @@ class SurrealHandler(BaseHTTPRequestHandler):
         if path == "/rpc":
             self._ws_upgrade()
             return
+        if path.startswith("/ml/export/"):
+            # /ml/export/:name/:version (reference ntw /ml/*)
+            sess = self._session()
+            if sess.auth_level == "none":
+                self._json(401, {"error": "Not authenticated"})
+                return
+            segs = [unquote(x) for x in path.split("/") if x]
+            if len(segs) != 4 or not sess.ns or not sess.db:
+                self._json(400, {"error": "Expected /ml/export/:name/:version with ns/db headers"})
+                return
+            from surrealdb_tpu.ml import export_model
+
+            try:
+                raw = export_model(self.ds, sess.ns, sess.db, segs[2], segs[3])
+            except SdbError as e:
+                self._json(404, {"error": str(e)})
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+            return
         if path.startswith("/key/"):
             self._key_route("GET")
             return
@@ -203,6 +226,24 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 self._json(200, self._run_sql(sql, sess))
             except SdbError as e:
                 self._json(400, {"error": str(e)})
+            return
+        if path == "/ml/import":
+            sess = self._session()
+            if sess.auth_level == "none":
+                self._json(401, {"error": "Not authenticated"})
+                return
+            if not sess.ns or not sess.db:
+                self._json(400, {"error": "Specify ns and db headers"})
+                return
+            from surrealdb_tpu.ml import import_model
+
+            try:
+                d = import_model(self.ds, sess.ns, sess.db, self._body())
+            except SdbError as e:
+                self._json(400, {"error": str(e)})
+                return
+            self._json(200, {"name": d.name, "version": d.version,
+                             "hash": d.hash})
             return
         if path == "/import":
             sess = self._session()

@@ -1,0 +1,138 @@
+"""LSM engine: disk-resident segments, range scans from disk, crash
+recovery, snapshot isolation, compaction (reference surrealkv role,
+core/src/kvs/surrealkv/mod.rs)."""
+
+import os
+
+import pytest
+
+from surrealdb_tpu import Datastore
+from surrealdb_tpu import cnf
+from surrealdb_tpu.kvs.lsm import LsmBackend, SSTable
+
+
+def test_sstable_roundtrip(tmp_path):
+    p = str(tmp_path / "t.sst")
+    items = [(f"k{i:05d}".encode(), f"v{i}".encode() * 50)
+             for i in range(5000)]
+    SSTable.write(p, iter(items))
+    t = SSTable(p)
+    assert t.get(b"k00000") == (True, items[0][1])
+    assert t.get(b"k04999") == (True, items[4999][1])
+    assert t.get(b"nope") == (False, None)
+    got = list(t.iter_range(b"k00100", b"k00110"))
+    assert [k for k, _ in got] == [f"k{i:05d}".encode()
+                                   for i in range(100, 110)]
+    t.close()
+
+
+def test_lsm_flush_and_read_from_disk(tmp_path, monkeypatch):
+    monkeypatch.setattr(cnf, "LSM_MEMTABLE_BYTES", 4096)
+    be = LsmBackend(str(tmp_path / "db"))
+    tx = be.transaction(write=True)
+    for i in range(500):
+        tx.set(f"a{i:04d}".encode(), (f"val{i}" * 20).encode())
+    tx.commit()
+    assert be.tables, "memtable should have flushed to a segment"
+    assert not be.mem, "memtable empty after flush"
+    tx = be.transaction(write=False)
+    assert tx.get(b"a0042") == ("val42" * 20).encode()
+    rows = tx.scan(b"a0100", b"a0105")
+    assert [k for k, _ in rows] == [f"a{i:04d}".encode()
+                                    for i in range(100, 105)]
+    tx.cancel()
+    be.close()
+
+
+def test_lsm_crash_recovery(tmp_path):
+    path = str(tmp_path / "db")
+    be = LsmBackend(path)
+    tx = be.transaction(write=True)
+    tx.set(b"k1", b"v1")
+    tx.set(b"k2", b"v2")
+    tx.commit()
+    # simulate crash: no close/flush — the WAL carries the memtable
+    be2 = LsmBackend(path)
+    tx = be2.transaction(write=False)
+    assert tx.get(b"k1") == b"v1"
+    assert tx.get(b"k2") == b"v2"
+    tx.cancel()
+    be2.close()
+
+
+def test_lsm_tombstones_and_compaction(tmp_path, monkeypatch):
+    monkeypatch.setattr(cnf, "LSM_MEMTABLE_BYTES", 1024)
+    be = LsmBackend(str(tmp_path / "db"))
+    for batch in range(4):
+        tx = be.transaction(write=True)
+        for i in range(40):
+            tx.set(f"k{batch:02d}{i:03d}".encode(), b"x" * 64)
+        tx.commit()
+    tx = be.transaction(write=True)
+    tx.delete(b"k00000")
+    tx.commit()
+    tx = be.transaction(write=False)
+    assert tx.get(b"k00000") is None
+    n_before = len(tx.scan(b"k", b"l"))
+    tx.cancel()
+    be.compact()
+    assert len(be.tables) == 1
+    tx = be.transaction(write=False)
+    assert tx.get(b"k00000") is None
+    assert len(tx.scan(b"k", b"l")) == n_before
+    assert tx.get(b"k03039") == b"x" * 64
+    tx.cancel()
+    be.close()
+
+
+def test_lsm_snapshot_isolation_and_conflicts(tmp_path):
+    be = LsmBackend(str(tmp_path / "db"))
+    tx = be.transaction(write=True)
+    tx.set(b"k", b"one")
+    tx.commit()
+    r = be.transaction(write=False)  # snapshot before the update
+    w = be.transaction(write=True)
+    w.set(b"k", b"two")
+    w.commit()
+    assert r.get(b"k") == b"one", "snapshot sees pre-image"
+    assert [v for _k, v in r.scan(b"k", b"l")] == [b"one"]
+    r.cancel()
+    r2 = be.transaction(write=False)
+    assert r2.get(b"k") == b"two"
+    r2.cancel()
+    # write-write conflict
+    a = be.transaction(write=True)
+    b_ = be.transaction(write=True)
+    a.set(b"c", b"a")
+    b_.set(b"c", b"b")
+    a.commit()
+    with pytest.raises(RuntimeError):
+        b_.commit()
+    be.close()
+
+
+def test_lsm_through_datastore(tmp_path):
+    url = f"lsm://{tmp_path}/dbs"
+    ds = Datastore(url)
+    ds.query("DEFINE TABLE person; CREATE person:1 SET name = 'a'",
+             ns="t", db="t")
+    ds.close()
+    ds2 = Datastore(url)
+    rows = ds2.query("SELECT * FROM person", ns="t", db="t")[0]
+    assert rows[0]["name"] == "a"
+    ds2.close()
+
+
+def test_lsm_values_stay_on_disk(tmp_path, monkeypatch):
+    """RAM holds the memtable + metadata, not flushed values: after a
+    flush the backend keeps no value bytes for segment rows."""
+    monkeypatch.setattr(cnf, "LSM_MEMTABLE_BYTES", 2048)
+    be = LsmBackend(str(tmp_path / "db"))
+    big = os.urandom(1024)
+    for i in range(64):
+        tx = be.transaction(write=True)
+        tx.set(f"big{i:03d}".encode(), big)
+        tx.commit()
+    assert be.mem_bytes <= 4096
+    assert sum(1 for _ in be._iter_latest(b"big", b"bih")) == 64
+    be.close()

@@ -1,0 +1,171 @@
+"""ML side-car: surml container, ONNX-on-JAX execution, ml:: SQL calls,
+import/export routes and CLI (reference surrealml/ + expr/model.rs)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from surrealdb_tpu import Datastore
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.ml import SurmlFile, import_model, make_jax_model
+
+
+def _onnx_linear(w: np.ndarray, b: np.ndarray) -> bytes:
+    """Hand-encode a minimal ONNX ModelProto: y = x @ w + b."""
+
+    def varint(n):
+        out = b""
+        while True:
+            byte = n & 0x7F
+            n >>= 7
+            if n:
+                out += bytes([byte | 0x80])
+            else:
+                return out + bytes([byte])
+
+    def field(fno, wt, payload):
+        return varint((fno << 3) | wt) + (
+            varint(len(payload)) + payload if wt == 2 else payload
+        )
+
+    def tensor(name, arr):
+        msg = b""
+        for d in arr.shape:
+            msg += field(1, 0, varint(d))
+        msg += field(2, 0, varint(1))  # float32
+        msg += field(8, 2, name.encode())
+        msg += field(9, 2, arr.astype("<f4").tobytes())
+        return msg
+
+    def node(op, ins, outs):
+        msg = b""
+        for i in ins:
+            msg += field(1, 2, i.encode())
+        for o in outs:
+            msg += field(2, 2, o.encode())
+        msg += field(4, 2, op.encode())
+        return msg
+
+    def value_info(name):
+        return field(1, 2, name.encode())
+
+    graph = b""
+    graph += field(1, 2, node("MatMul", ["x", "w"], ["xw"]))
+    graph += field(1, 2, node("Add", ["xw", "b"], ["y"]))
+    graph += field(5, 2, tensor("w", w))
+    graph += field(5, 2, tensor("b", b))
+    graph += field(11, 2, value_info("x"))
+    graph += field(12, 2, value_info("y"))
+    return field(7, 2, graph)  # ModelProto.graph
+
+
+def test_onnx_parse_and_execute():
+    w = np.array([[2.0], [3.0]], np.float32)
+    b = np.array([1.0], np.float32)
+    f = SurmlFile.from_bytes(_onnx_linear(w, b))
+    out = f.raw_compute(np.array([1.0, 1.0], np.float32))
+    assert out == [pytest.approx(6.0)]
+
+
+def test_surml_roundtrip_and_normalisers():
+    f = make_jax_model(
+        "prices", "1.0.0", ["sqft", "floors"],
+        [(np.array([[0.5], [0.25]], np.float32), np.array([1.0], np.float32),
+          None)],
+        normalisers={"sqft": {"type": "linear_scaling", "min": 0.0,
+                              "max": 100.0}},
+    )
+    f2 = SurmlFile.from_bytes(f.to_bytes())
+    assert f2.header["name"] == "prices"
+    # sqft 50 scales to 0.5: 0.5*0.5 + 2*0.25 + 1 = 1.75
+    out = f2.buffered_compute({"sqft": 50.0, "floors": 2.0})
+    assert out == [pytest.approx(1.75)]
+
+
+def test_ml_sql_call_modes():
+    ds = Datastore("memory")
+    f = make_jax_model(
+        "m", "1.0.0", ["a", "b"],
+        [(np.array([[1.0], [2.0]], np.float32), None, None)],
+    )
+    import_model(ds, "t", "t", f.to_bytes())
+    q = lambda s: ds.query(s, ns="t", db="t")
+    # buffered (object) compute
+    assert q("RETURN ml::m<1.0.0>({ a: 3, b: 4 })")[0] == [pytest.approx(11.0)]
+    # raw (array) compute
+    assert q("RETURN ml::m<1.0.0>([3, 4])")[0] == [pytest.approx(11.0)]
+    # missing model
+    r = ds.execute("RETURN ml::gone<1.0.0>([1])", ns="t", db="t")[0]
+    assert "does not exist" in r.error
+    # INFO lists the model
+    info = q("INFO FOR DB")[0]
+    assert "m<1.0.0>" in info["models"]
+    assert info["models"]["m<1.0.0>"].startswith("DEFINE MODEL ml::m<1.0.0>")
+
+
+def test_ml_onnx_through_sql():
+    ds = Datastore("memory")
+    w = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    b = np.array([0.5, -0.5], np.float32)
+    import_model(ds, "t", "t", _onnx_linear(w, b), name="lin",
+                 version="2.0.0")
+    out = ds.query("RETURN ml::lin<2.0.0>([1, 1])", ns="t", db="t")[0]
+    assert out == [pytest.approx(4.5), pytest.approx(5.5)]
+
+
+def test_ml_http_import_export():
+    import threading
+    import urllib.request
+
+    from surrealdb_tpu.server import make_server
+
+    ds = Datastore("memory")
+    srv = make_server(ds, "127.0.0.1", 18350, unauthenticated=True)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        f = make_jax_model(
+            "web", "0.1.0", ["x"],
+            [(np.array([[2.0]], np.float32), None, None)],
+        )
+        req = urllib.request.Request(
+            "http://127.0.0.1:18350/ml/import", data=f.to_bytes(),
+            headers={"surreal-ns": "t", "surreal-db": "t"}, method="POST",
+        )
+        body = urllib.request.urlopen(req).read()
+        assert b"web" in body
+        assert ds.query("RETURN ml::web<0.1.0>([21])", ns="t", db="t")[0] \
+            == [pytest.approx(42.0)]
+        raw = urllib.request.urlopen(urllib.request.Request(
+            "http://127.0.0.1:18350/ml/export/web/0.1.0",
+            headers={"surreal-ns": "t", "surreal-db": "t"},
+        )).read()
+        assert SurmlFile.from_bytes(raw).header["name"] == "web"
+    finally:
+        srv.shutdown()
+
+
+def test_ml_version_required():
+    ds = Datastore("memory")
+    r = ds.execute("RETURN ml::m([1])", ns="t", db="t")[0]
+    assert "model version is required" in r.error
+
+
+def test_ml_corrupt_import_rejected():
+    ds = Datastore("memory")
+    with pytest.raises(SdbError):
+        import_model(ds, "t", "t", b"\x80\x80\x80", name="bad",
+                     version="1.0.0")
+    with pytest.raises(SdbError):
+        import_model(ds, "t", "t", b"SURMLTPU\x05", name="bad",
+                     version="1.0.0")
+
+
+def test_ml_case_sensitive_names():
+    ds = Datastore("memory")
+    f = make_jax_model("MyModel", "1.0.0", ["x"],
+                       [(np.array([[2.0]], np.float32), None, None)])
+    import_model(ds, "t", "t", f.to_bytes())
+    assert ds.query("RETURN ml::MyModel<1.0.0>([4])", ns="t", db="t")[0] \
+        == [pytest.approx(8.0)]
