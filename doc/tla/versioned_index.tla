@@ -1,0 +1,126 @@
+------------------------- MODULE versioned_index -------------------------
+(***************************************************************************)
+(* The versioned vector-index synchronisation protocol                     *)
+(* (surrealdb_tpu/idx/vector.py sync/_apply_log/_rebuild — the TPU-native *)
+(* redesign of the reference's two-phase HNSW pending queue, whose own    *)
+(* spec is the reference's doc/tla/versioned_index.tla).                  *)
+(*                                                                         *)
+(* Protocol under test:                                                    *)
+(*   - every committed write appends an op (set/del) to an ordered log    *)
+(*     `hl` and bumps the version counter `vn` in the same transaction    *)
+(*   - an index replica at version r catches up by applying log entries   *)
+(*     (r, vn] in order (apply_log), or by a full rebuild from the `he`   *)
+(*     element rows when the log was trimmed                              *)
+(*   - after a REBUILD the consumed log prefix is trimmed                 *)
+(*                                                                         *)
+(* Invariants checked:                                                     *)
+(*   Coherence    — a replica that has caught up to version v holds       *)
+(*                  exactly the state produced by the first v ops         *)
+(*   NoLostOps    — trimming never removes ops a lagging replica still    *)
+(*                  needs unless that replica rebuilds (the apply path    *)
+(*                  detects the gap and falls back to rebuild)            *)
+(*   Monotonic    — replica versions never move backwards                 *)
+(***************************************************************************)
+
+EXTENDS Integers, Sequences, FiniteSets, TLC
+
+CONSTANTS Keys,      \* record ids that can hold a vector
+          Vals,      \* abstract vector payloads
+          MaxOps,    \* bound on the number of committed writes
+          Replicas   \* index replica identifiers (device caches)
+
+VARIABLES log,       \* committed op log: sequence of <<kind, key, val>>
+          vn,        \* version counter = Len(log)
+          trimmed,   \* number of ops trimmed from the log head
+          rstate,    \* replica -> (key -> val | NoVal)
+          rver       \* replica -> applied version
+
+NoVal == CHOOSE v : v \notin Vals
+
+vars == <<log, vn, trimmed, rstate, rver>>
+
+(* The canonical state after the first n ops *)
+StateAt(n) ==
+  LET Apply(acc, i) ==
+        LET op == log[i] IN
+        IF op[1] = "set" THEN [acc EXCEPT ![op[2]] = op[3]]
+        ELSE [acc EXCEPT ![op[2]] = NoVal]
+      RECURSIVE Fold(_, _)
+      Fold(acc, i) == IF i > n THEN acc ELSE Fold(Apply(acc, i), i + 1)
+  IN Fold([k \in Keys |-> NoVal], 1)
+
+Init ==
+  /\ log = <<>>
+  /\ vn = 0
+  /\ trimmed = 0
+  /\ rstate = [r \in Replicas |-> [k \in Keys |-> NoVal]]
+  /\ rver = [r \in Replicas |-> 0]
+
+(* A write transaction commits: op appended + version bumped atomically *)
+Write(k, v) ==
+  /\ vn < MaxOps
+  /\ log' = Append(log, <<"set", k, v>>)
+  /\ vn' = vn + 1
+  /\ UNCHANGED <<trimmed, rstate, rver>>
+
+Delete(k) ==
+  /\ vn < MaxOps
+  /\ log' = Append(log, <<"del", k, NoVal>>)
+  /\ vn' = vn + 1
+  /\ UNCHANGED <<trimmed, rstate, rver>>
+
+(* apply_log: replica applies the suffix (rver[r], vn] IF the log still
+   holds it (i.e. nothing it needs was trimmed) *)
+CatchUp(r) ==
+  /\ rver[r] < vn
+  /\ trimmed <= rver[r]                    \* gap check (idx/vector.py:261)
+  /\ rstate' = [rstate EXCEPT ![r] = StateAt(vn)]
+  /\ rver' = [rver EXCEPT ![r] = vn]
+  /\ UNCHANGED <<log, vn, trimmed>>
+
+(* rebuild: full scan of the element rows — always available *)
+Rebuild(r) ==
+  /\ rstate' = [rstate EXCEPT ![r] = StateAt(vn)]
+  /\ rver' = [rver EXCEPT ![r] = vn]
+  /\ UNCHANGED <<log, vn, trimmed>>
+
+(* log trim after a rebuild: drop any prefix up to the SLOWEST replica's
+   version (the implementation trims to `vn` only when it just rebuilt,
+   which satisfies this because its own version is then vn) *)
+Trim ==
+  LET floor == CHOOSE m \in {rver[r] : r \in Replicas} :
+                 \A r \in Replicas : m <= rver[r]
+  IN /\ trimmed < floor
+     /\ trimmed' = floor
+     /\ UNCHANGED <<log, vn, rstate, rver>>
+
+Next ==
+  \/ \E k \in Keys, v \in Vals : Write(k, v)
+  \/ \E k \in Keys : Delete(k)
+  \/ \E r \in Replicas : CatchUp(r)
+  \/ \E r \in Replicas : Rebuild(r)
+  \/ Trim
+
+Spec == Init /\ [][Next]_vars
+
+----------------------------------------------------------------------------
+(* Invariants *)
+
+Coherence ==
+  \A r \in Replicas : rstate[r] = StateAt(rver[r])
+
+Monotonic ==
+  \A r \in Replicas : rver[r] <= vn
+
+NoLostOps ==
+  \* any replica behind the trim point can still converge via Rebuild;
+  \* CatchUp is correctly disabled for it
+  \A r \in Replicas :
+    (rver[r] < trimmed) => ~ENABLED CatchUp(r)
+
+TypeOK ==
+  /\ vn = Len(log)
+  /\ trimmed \in 0..vn
+  /\ \A r \in Replicas : rver[r] \in 0..vn
+
+=============================================================================

@@ -1,0 +1,46 @@
+"""Executor fuzzer (reference fuzz/fuzz_targets/fuzz_executor.rs): parsed
+statements EXECUTE against a scratch datastore; anything escaping as a
+non-SdbError (internal error leak, crash) is a finding.
+
+    python fuzz/fuzz_executor.py [iterations] [seed]
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+
+from fuzz.fuzz_sql_parser import SEEDS, mutate
+
+
+def run(iterations: int = 500, seed: int = 0) -> int:
+    from surrealdb_tpu import Datastore
+
+    rng = random.Random(seed)
+    ds = Datastore("memory")
+    crashes = 0
+    for i in range(iterations):
+        src = mutate(rng, rng.choice(SEEDS))
+        try:
+            results = ds.execute(src, ns="f", db="f")
+        except Exception as e:
+            crashes += 1
+            print(f"CRASH [{type(e).__name__}: {e}] executing:\n{src!r}\n")
+            continue
+        for r in results:
+            # internal errors surface prefixed — they are findings too,
+            # but non-fatal ones (the executor caught them); report loudly
+            if r.error and r.error.startswith("Internal error:"):
+                crashes += 1
+                print(f"INTERNAL [{r.error}] executing:\n{src!r}\n")
+        if i % 50 == 49:
+            ds = Datastore("memory")  # fresh state periodically
+    print(f"fuzz_executor: {iterations} inputs, {crashes} findings")
+    return crashes
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    its = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    sys.exit(1 if run(its, seed) else 0)

@@ -1,0 +1,102 @@
+"""Parser fuzzer (reference fuzz/fuzz_targets/fuzz_sql_parser.rs).
+
+Feeds mutated SurrealQL at the lexer/parser; ANY escape other than the
+typed ParseError/SdbError is a finding. Run standalone:
+
+    python fuzz/fuzz_sql_parser.py [iterations] [seed]
+
+The corpus mixes grammar-aware seeds (statements that exercise every
+statement family) with byte-level mutations (splice, truncate, repeat,
+random unicode) — the same havoc strategy libFuzzer applies to the
+reference's dictionary seeds.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+
+SEEDS = [
+    "SELECT * FROM person WHERE age > 18 ORDER BY name LIMIT 10 START 5",
+    "SELECT *, ->knows->person AS friends FROM person FETCH friends",
+    "CREATE person:1 SET name = 'a', tags += ['x'], emb = [1.0, 2.0]",
+    "UPSERT person MERGE { a: { b: [1, 2, { c: NONE }] } } RETURN DIFF",
+    "RELATE a:1->likes->b:2 CONTENT { since: d'2020-01-01T00:00:00Z' }",
+    "DEFINE TABLE t SCHEMAFULL PERMISSIONS FOR select WHERE user = $auth",
+    "DEFINE INDEX ix ON t FIELDS emb HNSW DIMENSION 128 DIST COSINE",
+    "DEFINE FIELD f ON t TYPE option<array<record<x>, 5>> DEFAULT []",
+    "DEFINE ACCESS a ON DATABASE TYPE BEARER FOR USER DURATION FOR GRANT 1d",
+    "LET $x = { a: 1, b: |p:1..3|, c: (1 + 2) * 3, d: [1..5] }",
+    "FOR $i IN 0..10 { IF $i % 2 == 0 { CONTINUE }; CREATE t SET n = $i }",
+    "SELECT count() FROM t GROUP ALL EXPLAIN ANALYZE",
+    "SELECT math::mean(v) AS m FROM t GROUP BY g SPLIT tags",
+    "RETURN function() { return [1,2].map(x => x * 2) }",
+    "SELECT * FROM t WHERE e <|10,40|> $q AND flag = true",
+    "INSERT INTO t (a, b) VALUES (1, 2), (3, 4) ON DUPLICATE KEY UPDATE a += 1",
+    "BEGIN; UPDATE a:1 SET n += 1; THROW 'x'; COMMIT",
+    "ACCESS api ON DATABASE GRANT FOR USER tobie",
+    "SHOW CHANGES FOR TABLE t SINCE 0 LIMIT 10",
+    "LIVE SELECT DIFF FROM person WHERE age > 18",
+]
+
+_INTERESTING = list("{}[]()<>|@$:;,.*-+=!?") + [
+    "SELECT", "WHERE", "NONE", "->", "<-", "..=", "::", "<|", "|>",
+    "é", "世", "\x00", "'", '"', "`", "⟨",
+]
+
+
+def mutate(rng: random.Random, s: str) -> str:
+    ops = rng.randrange(1, 5)
+    out = s
+    for _ in range(ops):
+        kind = rng.randrange(6)
+        if not out:
+            out = rng.choice(SEEDS)
+        pos = rng.randrange(len(out) + 1)
+        if kind == 0:  # insert interesting token
+            out = out[:pos] + rng.choice(_INTERESTING) + out[pos:]
+        elif kind == 1:  # delete a span
+            end = min(len(out), pos + rng.randrange(1, 8))
+            out = out[:pos] + out[end:]
+        elif kind == 2:  # splice from another seed
+            other = rng.choice(SEEDS)
+            a = rng.randrange(len(other) + 1)
+            out = out[:pos] + other[a:a + rng.randrange(1, 20)] + out[pos:]
+        elif kind == 3:  # duplicate a span
+            end = min(len(out), pos + rng.randrange(1, 12))
+            out = out[:pos] + out[pos:end] + out[pos:]
+        elif kind == 4:  # flip a char
+            if out:
+                i = rng.randrange(len(out))
+                out = out[:i] + chr((ord(out[i]) + rng.randrange(1, 128))
+                                    % 0x10000) + out[i + 1:]
+        else:  # truncate
+            out = out[:pos]
+    return out
+
+
+def run(iterations: int = 2000, seed: int = 0) -> int:
+    from surrealdb_tpu.err import ParseError, SdbError
+    from surrealdb_tpu.syn import parse
+
+    rng = random.Random(seed)
+    crashes = 0
+    for i in range(iterations):
+        src = mutate(rng, rng.choice(SEEDS))
+        try:
+            parse(src)
+        except (ParseError, SdbError):
+            pass
+        except RecursionError:
+            pass  # bounded by the interpreter; not a memory-safety issue
+        except Exception as e:
+            crashes += 1
+            print(f"CRASH [{type(e).__name__}: {e}] on input:\n{src!r}\n")
+    print(f"fuzz_sql_parser: {iterations} inputs, {crashes} crashes")
+    return crashes
+
+
+if __name__ == "__main__":
+    its = int(sys.argv[1]) if len(sys.argv) > 1 else 20000
+    seed = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    sys.exit(1 if run(its, seed) else 0)

@@ -25,6 +25,10 @@ def main(argv=None):
     p_start.add_argument("--path", default="memory")
     p_start.add_argument("--user", default=None)
     p_start.add_argument("--pass", dest="passwd", default=None)
+    p_start.add_argument("--web-crt", dest="web_crt", default=None,
+                         help="TLS certificate (PEM) for HTTPS")
+    p_start.add_argument("--web-key", dest="web_key", default=None,
+                         help="TLS private key (PEM)")
     p_start.add_argument(
         "--unauthenticated", action="store_true",
         help="allow anonymous connections full access (dev mode)")
@@ -56,6 +60,18 @@ def main(argv=None):
         "kv", help="run the shared transactional KV service (cluster mode)"
     )
     p_kv.add_argument("--bind", default="127.0.0.1:8100")
+
+    p_up = sub.add_parser(
+        "upgrade", help="migrate a store's on-disk format to this release"
+    )
+    p_up.add_argument("--path", required=True)
+
+    p_fix = sub.add_parser(
+        "fix", help="validate a store and rebuild derived state (indexes)"
+    )
+    p_fix.add_argument("--path", required=True)
+    p_fix.add_argument("--ns", default=None)
+    p_fix.add_argument("--db", default=None)
 
     p_ml = sub.add_parser("ml", help="import/export ML models (.surml)")
     ml_sub = p_ml.add_subparsers(dest="ml_cmd", required=True)
@@ -132,7 +148,8 @@ def main(argv=None):
             print("no --user/--pass given and --unauthenticated not set: "
                   "anonymous connections have no access")
         serve(ds, host or "127.0.0.1", int(port or 8000),
-              unauthenticated=args.unauthenticated)
+              unauthenticated=args.unauthenticated,
+              tls_cert=args.web_crt, tls_key=args.web_key)
         return 0
 
     if args.cmd == "sql":
@@ -154,6 +171,72 @@ def main(argv=None):
                     print(f"ERR: {r.error}")
                 else:
                     print(render(r.result))
+        return 0
+
+    if args.cmd == "upgrade":
+        from surrealdb_tpu import key as K
+
+        ds = Datastore(args.path)
+        txn = ds.transaction(write=True)
+        try:
+            cur = int((txn.get(K.storage_version()) or b"1").decode())
+            if cur == Datastore.STORAGE_VERSION:
+                txn.cancel()
+                print(f"storage already at version {cur}; nothing to do")
+            else:
+                # per-version migrations run here as formats evolve
+                txn.set(K.storage_version(),
+                        str(Datastore.STORAGE_VERSION).encode())
+                txn.commit()
+                print(f"upgraded storage {cur} -> {Datastore.STORAGE_VERSION}")
+        except BaseException:
+            txn.cancel()
+            raise
+        ds.close()
+        return 0
+
+    if args.cmd == "fix":
+        from surrealdb_tpu import key as K
+
+        ds = Datastore(args.path)
+        txn = ds.transaction(write=False)
+        try:
+            nss = [d.name for _k, d in
+                   txn.scan_vals(*K.prefix_range(K.ns_prefix()))]
+        finally:
+            txn.cancel()
+        fixed = 0
+        for ns in nss:
+            if args.ns and ns != args.ns:
+                continue
+            txn = ds.transaction(write=False)
+            try:
+                dbs = [d.name for _k, d in
+                       txn.scan_vals(*K.prefix_range(K.db_prefix(ns)))]
+            finally:
+                txn.cancel()
+            for db in dbs:
+                if args.db and db != args.db:
+                    continue
+                txn = ds.transaction(write=False)
+                try:
+                    pairs = [
+                        (tdef.name, idef.name)
+                        for _k, tdef in txn.scan_vals(
+                            *K.prefix_range(K.tb_prefix(ns, db)))
+                        for _k2, idef in txn.scan_vals(
+                            *K.prefix_range(K.ix_prefix(ns, db, tdef.name)))
+                    ]
+                finally:
+                    txn.cancel()
+                for tb, ix in pairs:
+                    r = ds.execute(f"REBUILD INDEX {ix} ON {tb}",
+                                   ns=ns, db=db)[0]
+                    status = "ok" if r.error is None else f"ERR {r.error}"
+                    print(f"rebuilt {ns}/{db}/{tb}.{ix}: {status}")
+                    fixed += 1
+        print(f"fix complete: {fixed} indexes rebuilt")
+        ds.close()
         return 0
 
     if args.cmd == "ml":

@@ -61,3 +61,76 @@ WAL_COMPACT_BATCHES = env_int("SURREAL_WAL_COMPACT_BATCHES", 4096)
 # LSM engine (kvs/lsm.py — reference surrealkv role)
 LSM_MEMTABLE_BYTES = env_int("SURREAL_LSM_MEMTABLE_BYTES", 8 << 20)
 LSM_COMPACT_SEGMENTS = env_int("SURREAL_LSM_COMPACT_SEGMENTS", 6)
+
+# memory kill-switch (reference core/src/mem + cnf MEMORY_THRESHOLD;
+# 0 disables, any other value floors at 1 MiB)
+MEMORY_THRESHOLD = env_int("SURREAL_MEMORY_THRESHOLD", 0)
+
+
+def env_str(name: str, default: str) -> str:
+    return os.environ.get(name, "") or default
+
+
+def env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name, "").lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    return default
+
+
+# -- execution limits (reference cnf/mod.rs names) ---------------------------
+# rows buffered per streaming operator batch (OPERATOR_BUFFER_SIZE)
+OPERATOR_BUFFER_SIZE = env_int("SURREAL_OPERATOR_BUFFER_SIZE", 1024)
+# concurrent tasks in fan-out sections (MAX_CONCURRENT_TASKS)
+MAX_CONCURRENT_TASKS = env_int("SURREAL_MAX_CONCURRENT_TASKS", 64)
+# statements per query text (guards pathological batches)
+MAX_STATEMENTS_PER_QUERY = env_int("SURREAL_MAX_STATEMENTS_PER_QUERY", 5000)
+# object/array nesting accepted by the parser (MAX_OBJECT_PARSING_DEPTH /
+# MAX_QUERY_PARSING_DEPTH)
+MAX_OBJECT_PARSING_DEPTH = env_int("SURREAL_MAX_OBJECT_PARSING_DEPTH", 100)
+MAX_QUERY_PARSING_DEPTH = env_int("SURREAL_MAX_QUERY_PARSING_DEPTH", 100)
+# generated-collection byte cap (GENERATION_ALLOCATION_LIMIT: 2^n bytes)
+GENERATION_ALLOCATION_LIMIT = 2 ** min(
+    env_int("SURREAL_GENERATION_ALLOCATION_LIMIT", 20), 28
+)
+# similarity/distance function input cap (FUNCTION_SIMILARITY_MAX_LENGTH)
+FUNCTION_SIMILARITY_MAX_LENGTH = env_int(
+    "SURREAL_FUNCTION_SIMILARITY_MAX_LENGTH", 100_000
+)
+# regex compile cache + size cap (REGEX_CACHE_SIZE / REGEX_SIZE_LIMIT)
+REGEX_CACHE_SIZE = env_int("SURREAL_REGEX_CACHE_SIZE", 1000)
+REGEX_SIZE_LIMIT = env_int("SURREAL_REGEX_SIZE_LIMIT", 10_485_760)
+
+# -- transactions / datastore ------------------------------------------------
+# max keys per external scan batch (MAX_BATCH_SIZE / EXPORT_BATCH_SIZE)
+MAX_BATCH_SIZE = env_int("SURREAL_MAX_BATCH_SIZE", 10_000)
+EXPORT_BATCH_SIZE = env_int("SURREAL_EXPORT_BATCH_SIZE", 1000)
+# transaction-level catalog/record cache entries (kvs/tx.rs caches)
+TRANSACTION_CACHE_SIZE = env_int("SURREAL_TRANSACTION_CACHE_SIZE", 10_000)
+# datastore-level cross-txn cache entries (DatastoreCache)
+DATASTORE_CACHE_SIZE = env_int("SURREAL_DATASTORE_CACHE_SIZE", 1000)
+# changefeed GC: retain at most this many versionstamped entries per table
+CHANGEFEED_GC_BATCH_SIZE = env_int("SURREAL_CHANGEFEED_GC_BATCH_SIZE", 1000)
+# node heartbeat cadence / liveness window (dbs/node.rs tasks)
+NODE_MEMBERSHIP_REFRESH_INTERVAL = env_int(
+    "SURREAL_NODE_MEMBERSHIP_REFRESH_INTERVAL", 3
+)
+NODE_MEMBERSHIP_CHECK_INTERVAL = env_int(
+    "SURREAL_NODE_MEMBERSHIP_CHECK_INTERVAL", 15
+)
+# WebSocket / HTTP body caps (server cnf)
+WEBSOCKET_MAX_MESSAGE_SIZE = env_int(
+    "SURREAL_WEBSOCKET_MAX_MESSAGE_SIZE", 128 << 20
+)
+HTTP_MAX_BODY_SIZE = env_int("SURREAL_HTTP_MAX_BODY_SIZE", 128 << 20)
+# runtime worker threads for the blocking pool (threadpool.rs role)
+RUNTIME_WORKER_THREADS = env_int("SURREAL_RUNTIME_WORKER_THREADS", 32)
+# bucket (object storage) folder allowlist / global readonly
+BUCKET_FOLDER_ALLOWLIST = env_str("SURREAL_BUCKET_FOLDER_ALLOWLIST", "")
+GLOBAL_BUCKET_ENFORCED = env_bool("SURREAL_GLOBAL_BUCKET_ENFORCED", False)
+# insecure-forward-access-errors (iam verify diagnostics)
+INSECURE_FORWARD_ACCESS_ERRORS = env_bool(
+    "SURREAL_INSECURE_FORWARD_ACCESS_ERRORS", False
+)

@@ -97,6 +97,12 @@ class Ctx:
         return c
 
     def check_deadline(self):
+        from surrealdb_tpu import cnf as _cnf
+
+        if _cnf.MEMORY_THRESHOLD:
+            from surrealdb_tpu.mem import check_threshold
+
+            check_threshold()
         if self.deadline is not None and time.monotonic() > self.deadline:
             suffix = (
                 f": {self.timeout_dur.render()}"

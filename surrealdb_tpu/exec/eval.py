@@ -537,8 +537,10 @@ def _e_mock(n, ctx):
     else:
         stop = n.end + 1 if n.end_incl else n.end
     count = max(stop - beg, 0)
-    # reference GENERATION_ALLOCATION_LIMIT: count * sizeof(Value) > 2^20
-    if count * 32 > (1 << 20):
+    # reference GENERATION_ALLOCATION_LIMIT: count * sizeof(Value) over cap
+    from surrealdb_tpu import cnf as _cnf
+
+    if count * 32 > _cnf.GENERATION_ALLOCATION_LIMIT:
         raise SdbError("Mock range exceeds allocation limit")
     for i in range(beg, stop):
         out.append(RecordId(n.tb, i))

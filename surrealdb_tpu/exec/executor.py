@@ -123,6 +123,16 @@ class Executor:
                     )
                 )
                 continue
+            from surrealdb_tpu import cnf as _cnf
+
+            if _cnf.MEMORY_THRESHOLD:
+                from surrealdb_tpu.mem import check_threshold
+
+                try:
+                    check_threshold()
+                except SdbError as e:
+                    results.append(QueryResult(error=str(e)))
+                    continue
             own_txn = txn is None
             cur = txn or self.ds.transaction(write=True)
             ctx = Ctx(self.ds, self.session, cur, executor=self)

@@ -27,10 +27,11 @@ import time
 
 import numpy as np
 
+from surrealdb_tpu import cnf
 from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.val import NONE, Table, is_truthy
 
-BATCH_SIZE = 1024
+BATCH_SIZE = cnf.OPERATOR_BUFFER_SIZE
 
 _UNSUPPORTED = object()
 

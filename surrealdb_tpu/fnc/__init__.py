@@ -129,6 +129,14 @@ def call_function(node, ctx):
     if name.startswith("fn::"):
         return call_custom(node.name[4:], [evaluate(a, ctx) for a in node.args], ctx)
     if name.startswith("ml::"):
+        caps = getattr(ctx.ds, "capabilities", None)
+        if caps is None or not caps.allows_experimental("ml"):
+            # the reference's default build compiles without the `ml`
+            # feature — the language suite expects this exact error
+            raise SdbError(
+                "Problem with machine learning computation. "
+                "Machine learning computation is not enabled."
+            )
         from surrealdb_tpu.ml import compute_model
 
         version = getattr(node, "version", None)

@@ -328,6 +328,18 @@ def _is_record(args, ctx):
 # -- similarity / distance ----------------------------------------------------
 
 
+def _check_similarity_len(fname, a, b):
+    """O(n*m) guard (reference fnc/string.rs check_similarity_input_length)."""
+    from surrealdb_tpu import cnf
+
+    mx = cnf.FUNCTION_SIMILARITY_MAX_LENGTH
+    if len(a) > mx or len(b) > mx:
+        raise SdbError(
+            f"Incorrect arguments for function {fname}(). Input strings "
+            f"must not exceed {mx} bytes (got {len(a)} and {len(b)})."
+        )
+
+
 def _levenshtein(a, b):
     if len(a) < len(b):
         a, b = b, a
@@ -342,12 +354,15 @@ def _levenshtein(a, b):
 
 @register("string::distance::levenshtein")
 def _lev(args, ctx):
-    return _levenshtein(_str(args[0], "f", 1), _str(args[1], "f", 2))
+    a, b = _str(args[0], "f", 1), _str(args[1], "f", 2)
+    _check_similarity_len("string::distance::levenshtein", a, b)
+    return _levenshtein(a, b)
 
 
 @register("string::distance::damerau_levenshtein")
 def _dlev(args, ctx):
     a, b = _str(args[0], "f", 1), _str(args[1], "f", 2)
+    _check_similarity_len("string::distance::damerau_levenshtein", a, b)
     da = {}
     maxdist = len(a) + len(b)
     d = [[maxdist] * (len(b) + 2) for _ in range(len(a) + 2)]

@@ -1,8 +1,17 @@
-"""GraphQL endpoint (reference: core/src/gql/ — dynamic schema from table
-definitions; queries map onto SELECTs).
+"""GraphQL endpoint (reference: core/src/gql/ + server gql/ — a schema
+GENERATED from the table/field catalog; queries compile onto SELECTs,
+mutations onto CREATE/UPDATE/DELETE).
 
-Minimal executable subset: `query { table(limit: N, start: N, id: "...")
-{ fields... nested { ... } } }` plus __schema/__type introspection stubs.
+Surface:
+- `query { table(limit, start, order, desc, id, filter) { ... } }` where
+  `filter` supports {field: value} shorthand and operator objects
+  {field: {eq|ne|gt|gte|lt|lte|contains: v}}
+- record links resolve through nested selection sets
+- `mutation { create_table(data) / update_table(id, data) /
+  delete_table(id) }`
+- full __schema/__type introspection built from the catalog: one OBJECT
+  type per table with fields typed from the DEFINE FIELD kinds
+  (reference core/src/gql/schema.rs kind->GraphQL type mapping)
 """
 
 from __future__ import annotations
@@ -13,16 +22,24 @@ from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.val import NONE, RecordId, to_json
 
 _TOKEN_RX = _re.compile(
-    r"""\s*(?:(?P<punct>[{}():,\[\]!])|(?P<name>[_A-Za-z][_0-9A-Za-z]*)"""
+    r"""\s*(?:(?P<punct>[{}():,\[\]!=])|(?P<name>[_A-Za-z][_0-9A-Za-z]*)"""
     r"""|(?P<string>"(?:[^"\\]|\\.)*")|(?P<num>-?\d+(?:\.\d+)?)"""
     r"""|(?P<var>\$[_A-Za-z][_0-9A-Za-z]*))""",
 )
+
+
+_COMMENT_RX = _re.compile(r"\s*#[^\n]*")
 
 
 def _tokenize(src: str):
     pos = 0
     out = []
     while pos < len(src):
+        # comments strip at token boundaries — never inside strings
+        cm = _COMMENT_RX.match(src, pos)
+        if cm:
+            pos = cm.end()
+            continue
         m = _TOKEN_RX.match(src, pos)
         if not m:
             if src[pos:].strip() == "":
@@ -84,6 +101,14 @@ class _P:
                 out.append(self.parse_value())
                 self.eat("punct", ",")
             return out
+        if t == ("punct", "{"):
+            obj = {}
+            while not self.eat("punct", "}"):
+                k = self.next()[1]
+                self.eat("punct", ":")
+                obj[k] = self.parse_value()
+                self.eat("punct", ",")
+            return obj
         raise SdbError("GraphQL parse error in value")
 
     def parse_selection_set(self):
@@ -94,6 +119,10 @@ class _P:
             name = self.next()
             if name[0] != "name":
                 raise SdbError("GraphQL: expected field name")
+            alias = None
+            if self.eat("punct", ":"):
+                alias = name[1]
+                name = self.next()
             args = {}
             if self.eat("punct", "("):
                 while not self.eat("punct", ")"):
@@ -104,7 +133,7 @@ class _P:
             sub = None
             if self.peek() == ("punct", "{"):
                 sub = self.parse_selection_set()
-            fields.append((name[1], args, sub))
+            fields.append((alias or name[1], name[1], args, sub))
         return fields
 
 
@@ -112,9 +141,9 @@ def execute_graphql(ds, session, query: str, variables=None) -> dict:
     variables = variables or {}
     toks = _tokenize(query)
     p = _P(toks, variables)
-    # optional `query Name(...)` prelude
-    if p.peek() == ("name", "query") or p.peek() == ("name", "mutation"):
-        p.next()
+    op = "query"
+    if p.peek() in (("name", "query"), ("name", "mutation")):
+        op = p.next()[1]
         if p.peek()[0] == "name":
             p.next()
         if p.eat("punct", "("):
@@ -128,22 +157,58 @@ def execute_graphql(ds, session, query: str, variables=None) -> dict:
     sels = p.parse_selection_set()
     data = {}
     errors = []
-    for name, args, sub in sels:
+    for out_name, name, args, sub in sels:
         if name == "__schema":
-            data[name] = _schema_introspection(ds, session)
+            data[out_name] = _schema_introspection(ds, session, sub)
+            continue
+        if name == "__type":
+            data[out_name] = _type_introspection(
+                ds, session, args.get("name", ""), sub
+            )
             continue
         if name == "__typename":
-            data[name] = "Query"
+            data[out_name] = "Mutation" if op == "mutation" else "Query"
             continue
         try:
-            data[name] = _resolve_table(ds, session, name, args, sub)
+            if op == "mutation":
+                data[out_name] = _resolve_mutation(
+                    ds, session, name, args, sub
+                )
+            else:
+                data[out_name] = _resolve_table(ds, session, name, args, sub)
         except SdbError as e:
             errors.append({"message": str(e)})
-            data[name] = None
+            data[out_name] = None
     out = {"data": data}
     if errors:
         out["errors"] = errors
     return out
+
+
+_FILTER_OPS = {
+    "eq": "=", "ne": "!=", "gt": ">", "gte": ">=", "lt": "<", "lte": "<=",
+    "contains": "CONTAINS",
+}
+
+
+def _gql_rid(tb: str, idv) -> str:
+    sid = str(idv)
+    return sid if sid.startswith(f"{tb}:") else f"{tb}:{sid}"
+
+
+def _build_where(filters: dict, vars: dict) -> list:
+    conds = []
+    for k, v in dict(filters or {}).items():
+        if isinstance(v, dict) and v and all(op in _FILTER_OPS for op in v):
+            for opname, operand in v.items():
+                slot = f"f{len(vars)}"
+                vars[slot] = operand
+                conds.append(f"{k} {_FILTER_OPS[opname]} ${slot}")
+        else:
+            slot = f"f{len(vars)}"
+            vars[slot] = v
+            conds.append(f"{k} = ${slot}")
+    return conds
 
 
 def _resolve_table(ds, session, tb, args, sub):
@@ -153,19 +218,17 @@ def _resolve_table(ds, session, tb, args, sub):
     idv = args.get("id")
     vars = {}
     if idv is not None:
-        target = idv if ":" in str(idv) else f"{tb}:{idv}"
-        sql = f"SELECT * FROM {target}"
+        vars["_rid"] = _gql_rid(tb, idv)
+        sql = "SELECT * FROM (type::record($_rid))"
     else:
         sql = f"SELECT * FROM {tb}"
-        filters = args.get("filter") or {}
-        conds = []
-        for i, (k, v) in enumerate(dict(filters).items()):
-            vars[f"f{i}"] = v
-            conds.append(f"{k} = $f{i}")
+        conds = _build_where(args.get("filter"), vars)
         if conds:
             sql += " WHERE " + " AND ".join(conds)
         if order:
             sql += f" ORDER BY {order}"
+            if args.get("desc"):
+                sql += " DESC"
         sql += f" LIMIT {limit} START {start}"
     res = ds.execute(sql, session=session, vars=vars)
     last = res[-1]
@@ -176,37 +239,193 @@ def _resolve_table(ds, session, tb, args, sub):
     for row in rows:
         if not isinstance(row, dict):
             continue
-        out.append(_project(row, sub))
+        out.append(_project(ds, session, row, sub))
     return out
 
 
-def _project(row: dict, sub):
+def _resolve_mutation(ds, session, name, args, sub):
+    """create_<tb>(data) / update_<tb>(id, data) / delete_<tb>(id)
+    (reference core/src/gql mutations generated per table)."""
+    for prefix, stmt in (("create_", "CREATE"), ("update_", "UPDATE"),
+                         ("delete_", "DELETE")):
+        if name.startswith(prefix):
+            tb = name[len(prefix):]
+            break
+    else:
+        raise SdbError(f"Unknown mutation '{name}'")
+    vars = {}
+    idv = args.get("id")
+    target = tb
+    if idv is not None:
+        # ids bind as variables — raw interpolation would let a GraphQL
+        # client smuggle extra SurrealQL statements; type::record parses
+        # the ONE bound id (an injected statement fails to parse)
+        vars["_rid"] = _gql_rid(tb, idv)
+        target = "(type::record($_rid))"
+    if stmt == "CREATE":
+        sql = f"CREATE {target} CONTENT $data"
+        vars["data"] = args.get("data") or {}
+    elif stmt == "UPDATE":
+        if idv is None:
+            raise SdbError("update mutation requires an id argument")
+        sql = f"UPDATE {target} MERGE $data"
+        vars["data"] = args.get("data") or {}
+    else:
+        if idv is None:
+            raise SdbError("delete mutation requires an id argument")
+        sql = f"DELETE {target} RETURN BEFORE"
+    res = ds.execute(sql, session=session, vars=vars)
+    last = res[-1]
+    if last.error:
+        raise SdbError(last.error)
+    rows = last.result if isinstance(last.result, list) else [last.result]
+    out = [
+        _project(ds, session, r, sub) for r in rows if isinstance(r, dict)
+    ]
+    return out
+
+
+def _project(ds, session, row: dict, sub):
     if not sub:
         return to_json(row)
     out = {}
-    for name, _args, nested in sub:
+    for out_name, name, _args, nested in sub:
+        if name == "__typename":
+            out[out_name] = "Object"
+            continue
         v = row.get(name, NONE)
-        if nested and isinstance(v, dict):
-            v = _project(v, nested)
+        if nested and isinstance(v, RecordId):
+            # record links resolve through nested selections
+            res = ds.execute("SELECT * FROM ONLY $r", session=session,
+                             vars={"r": v})
+            doc = res[-1].result if res[-1].error is None else None
+            v = _project(ds, session, doc, nested) \
+                if isinstance(doc, dict) else to_json(v)
+        elif nested and isinstance(v, dict):
+            v = _project(ds, session, v, nested)
         elif nested and isinstance(v, list):
-            v = [_project(x, nested) if isinstance(x, dict) else to_json(x) for x in v]
+            v = [
+                _project(ds, session, x, nested) if isinstance(x, dict)
+                else to_json(x)
+                for x in v
+            ]
         else:
             v = to_json(v)
-        out[name] = v
+        out[out_name] = v
     return out
 
 
-def _schema_introspection(ds, session):
+# ---------------------------------------------------------------------------
+# introspection — schema generated from the catalog
+# ---------------------------------------------------------------------------
+
+_SCALARS = ("String", "Int", "Float", "Boolean", "ID")
+
+
+def _kind_to_gql(kind) -> dict:
+    """DEFINE FIELD kind -> GraphQL type ref (reference gql/schema.rs)."""
+    if kind is None:
+        return {"kind": "SCALAR", "name": "String", "ofType": None}
+    n = kind.name
+    if n in ("int",):
+        return {"kind": "SCALAR", "name": "Int", "ofType": None}
+    if n in ("float", "number", "decimal"):
+        return {"kind": "SCALAR", "name": "Float", "ofType": None}
+    if n == "bool":
+        return {"kind": "SCALAR", "name": "Boolean", "ofType": None}
+    if n == "record" and kind.inner:
+        return {"kind": "OBJECT", "name": kind.inner[0], "ofType": None}
+    if n in ("array", "set"):
+        inner = _kind_to_gql(kind.inner[0]) if kind.inner else \
+            {"kind": "SCALAR", "name": "String", "ofType": None}
+        return {"kind": "LIST", "name": None, "ofType": inner}
+    if n == "option" and kind.inner:
+        return _kind_to_gql(kind.inner[0])
+    return {"kind": "SCALAR", "name": "String", "ofType": None}
+
+
+def _table_types(ds, session):
+    """[(table, [(field, typeref)])] from the catalog."""
     from surrealdb_tpu import key as K
 
-    types = []
-    if session.ns and session.db:
-        txn = ds.transaction(write=False)
-        try:
-            for _k, tdef in txn.scan_vals(
-                *K.prefix_range(K.tb_prefix(session.ns, session.db))
-            ):
-                types.append({"name": tdef.name, "kind": "OBJECT"})
-        finally:
-            txn.cancel()
-    return {"queryType": {"name": "Query"}, "types": types}
+    out = []
+    if not (session.ns and session.db):
+        return out
+    txn = ds.transaction(write=False)
+    try:
+        for _k, tdef in txn.scan_vals(
+            *K.prefix_range(K.tb_prefix(session.ns, session.db))
+        ):
+            fields = [("id", {"kind": "SCALAR", "name": "ID",
+                              "ofType": None})]
+            for _k2, fd in txn.scan_vals(*K.prefix_range(
+                K.fd_prefix(session.ns, session.db, tdef.name)
+            )):
+                if "." in fd.name_str or "[" in fd.name_str:
+                    continue  # nested paths flatten into the parent value
+                fields.append((fd.name_str, _kind_to_gql(fd.kind)))
+            out.append((tdef.name, fields))
+    finally:
+        txn.cancel()
+    return out
+
+
+def _schema_introspection(ds, session, sub=None):
+    tables = _table_types(ds, session)
+    types = [
+        {"kind": "SCALAR", "name": s, "fields": None} for s in _SCALARS
+    ]
+    for tb, fields in tables:
+        types.append({
+            "kind": "OBJECT",
+            "name": tb,
+            "fields": [
+                {"name": fn, "type": ft, "args": []} for fn, ft in fields
+            ],
+        })
+    # the root Query type: one field per table
+    types.append({
+        "kind": "OBJECT",
+        "name": "Query",
+        "fields": [
+            {
+                "name": tb,
+                "type": {"kind": "LIST", "name": None,
+                         "ofType": {"kind": "OBJECT", "name": tb,
+                                    "ofType": None}},
+                "args": [
+                    {"name": a, "type": {"kind": "SCALAR", "name": t,
+                                         "ofType": None}}
+                    for a, t in (("limit", "Int"), ("start", "Int"),
+                                 ("order", "String"), ("desc", "Boolean"),
+                                 ("id", "ID"), ("filter", "String"))
+                ],
+            }
+            for tb, _f in tables
+        ],
+    })
+    types.append({
+        "kind": "OBJECT",
+        "name": "Mutation",
+        "fields": [
+            {"name": f"{op}_{tb}",
+             "type": {"kind": "LIST", "name": None,
+                      "ofType": {"kind": "OBJECT", "name": tb,
+                                 "ofType": None}},
+             "args": []}
+            for tb, _f in tables
+            for op in ("create", "update", "delete")
+        ],
+    })
+    return {
+        "queryType": {"name": "Query"},
+        "mutationType": {"name": "Mutation"},
+        "types": types,
+    }
+
+
+def _type_introspection(ds, session, name, sub=None):
+    for t in _schema_introspection(ds, session)["types"]:
+        if t.get("name") == name:
+            return t
+    return None

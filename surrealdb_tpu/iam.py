@@ -165,7 +165,134 @@ def _record_access(ds, session, ns, db, ac, creds, mode) -> str:
     )
 
 
+_JWKS_TTL_S = 43200  # reference iam/jwks.rs caches fetched sets for 12h
+
+
+def _fetch_jwks(ds, url: str) -> list:
+    """Fetch + cache a JWKS document (reference core/src/iam/jwks.rs:
+    per-URL cache, capability-gated egress)."""
+    import time as _time
+    import urllib.request
+
+    cache = getattr(ds, "_jwks_cache", None)
+    if cache is None:
+        cache = ds._jwks_cache = {}
+    hit = cache.get(url)
+    if hit is not None and hit[0] > _time.monotonic():
+        return hit[1]
+    caps = getattr(ds, "capabilities", None)
+    if caps is not None:
+        from urllib.parse import urlparse as _up
+
+        host = _up(url).netloc
+        if not caps.allows_net(host):
+            raise SdbError(f"Access to network target '{host}' is not allowed")
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            doc = json.loads(r.read().decode())
+    except Exception as e:
+        raise SdbError(f"There was a problem fetching the JWKS: {e}")
+    keys = doc.get("keys") or []
+    cache[url] = (_time.monotonic() + _JWKS_TTL_S, keys)
+    return keys
+
+
+def _verify_with_access(ds, cfg: dict, token: str) -> dict:
+    """Verify a third-party JWT against a DEFINE ACCESS JWT config:
+    HS* via the configured symmetric key, RS* via a PEM key or a JWKS
+    endpoint (key selected by kid)."""
+    try:
+        h, p, s = token.split(".")
+        header = json.loads(_unb64(h))
+    except (ValueError, UnicodeDecodeError):
+        raise SdbError("There was a problem with authentication")
+    alg = (header.get("alg") or cfg.get("alg") or "HS256").upper()
+    cfg_alg = (cfg.get("alg") or "").upper()
+    if cfg_alg and alg != cfg_alg:
+        # the access method pins ONE algorithm; accepting the attacker-
+        # controlled header alg enables RS->HS confusion (signing with
+        # the public PEM as an HMAC secret)
+        raise SdbError("There was a problem with authentication")
+    if cfg.get("url") and not alg.startswith("RS"):
+        # JWKS-backed access verifies asymmetric tokens only
+        raise SdbError("There was a problem with authentication")
+    signing = f"{h}.{p}".encode()
+    sig = _unb64(s)
+    ok = False
+    if alg.startswith("HS"):
+        import hashlib
+
+        hname = {"HS256": "sha256", "HS384": "sha384",
+                 "HS512": "sha512"}.get(alg)
+        key = (cfg.get("key") or "").encode()
+        if hname and key:
+            want = hmac.new(key, signing, getattr(hashlib, hname)).digest()
+            ok = hmac.compare_digest(want, sig)
+    elif alg.startswith("RS"):
+        from surrealdb_tpu.utils.rsa import (
+            rsa_public_key_from_pem, verify_pkcs1_v15,
+        )
+
+        hname = {"RS256": "sha256", "RS384": "sha384",
+                 "RS512": "sha512"}.get(alg)
+        pairs = []
+        if cfg.get("url"):
+            kid = header.get("kid")
+            for jwk in _fetch_jwks(ds, cfg["url"]):
+                if jwk.get("kty") != "RSA":
+                    continue
+                if kid is not None and jwk.get("kid") not in (None, kid):
+                    continue
+                pairs.append((
+                    int.from_bytes(_unb64(jwk["n"]), "big"),
+                    int.from_bytes(_unb64(jwk["e"]), "big"),
+                ))
+        elif cfg.get("key"):
+            try:
+                pairs.append(rsa_public_key_from_pem(cfg["key"]))
+            except (ValueError, IndexError):
+                pass
+        ok = hname is not None and any(
+            verify_pkcs1_v15(n, e, signing, sig, hname) for n, e in pairs
+        )
+    if not ok:
+        raise SdbError("There was a problem with authentication")
+    payload = json.loads(_unb64(p))
+    if payload.get("exp", 0) and payload["exp"] < time.time():
+        raise SdbError("The token has expired")
+    return payload
+
+
 def authenticate(ds, session: Session, token: str):
+    # tokens naming an ACCESS method with its own verification config
+    # (JWT key/alg or JWKS URL) verify against that config, not the
+    # internal datastore secret (reference iam/verify.rs)
+    try:
+        _h, _p, _s = token.split(".")
+        peek = json.loads(_unb64(_p))
+    except (ValueError, UnicodeDecodeError):
+        raise SdbError("There was a problem with authentication")
+    ac, pns, pdb = peek.get("AC") or peek.get("ac"), \
+        peek.get("NS") or peek.get("ns"), peek.get("DB") or peek.get("db")
+    if ac and pns and pdb:
+        txn = ds.transaction(write=False)
+        try:
+            adef = txn.get_val(K.ac_def("db", pns, pdb, ac))
+        finally:
+            txn.cancel()
+        cfg = getattr(adef, "config", None) or {}
+        if adef is not None and (cfg.get("url") or cfg.get("alg") or
+                                 cfg.get("key")):
+            payload = _verify_with_access(ds, cfg, token)
+            session.ns, session.db, session.ac = pns, pdb, ac
+            rid = payload.get("ID") or payload.get("id")
+            if rid:
+                from surrealdb_tpu.exec.static_eval import static_value
+                from surrealdb_tpu.syn.parser import parse_record_literal
+
+                session.rid = static_value(parse_record_literal(str(rid)))
+            session.auth_level = "record"
+            return NONE
     payload = verify_token(ds, token)
     if payload.get("AC"):
         session.ns = payload.get("NS")

@@ -612,6 +612,10 @@ def ml_blob(ns, db, name, version) -> bytes:  # ML model payload bytes
             + enc_str(version))
 
 
+def storage_version() -> bytes:  # on-disk format marker (kvs/version/)
+    return b"/!vx"
+
+
 def tb_idseq(ns, db) -> bytes:  # monotonic table-id allocator
     return b"/!ti" + enc_str(ns) + enc_str(db)
 

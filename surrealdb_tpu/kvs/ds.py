@@ -129,7 +129,8 @@ class Datastore:
         self.notification_handlers: list = []  # callables(Notification)
         self.ml_cache: dict = {}  # (ns,db,name,version,hash) -> SurmlFile
         self.sequences: dict = {}
-        self.changefeed_vs = 0  # monotonically increasing versionstamp
+        self._hlc_wall = 0  # HLC: last physical millis issued
+        self._hlc_count = 0  # HLC: logical counter within the millisecond
         self.graph_engine = None  # (ns,db,node_tb,edge_tb,dir) -> CsrGraph
         self.graph_versions = {}  # (ns,db,tb) -> write counter
         # observability (reference: kvs::Metrics gauges + kvs/slowlog.rs)
@@ -161,6 +162,7 @@ class Datastore:
 
         self.node_id = make_node_id()
         self.node_tasks = None
+        self._stamp_storage_version()
 
     def start_node_tasks(self, interval_s: float = 10.0,
                          stale_s: float = 30.0):
@@ -219,6 +221,12 @@ class Datastore:
             except ParseError as e:
                 # a parse error fails the whole query (reference behaviour)
                 return [QueryResult(error=str(e))]
+            from surrealdb_tpu import cnf as _cnf
+
+            if len(stmts) > _cnf.MAX_STATEMENTS_PER_QUERY:
+                return [QueryResult(
+                    error="The query contains too many statements"
+                )]
             with self.lock:
                 if len(self._ast_cache) >= self._ast_cache_cap:
                     self._ast_cache.clear()
@@ -251,10 +259,53 @@ class Datastore:
             self.notifications = []
         return out
 
+    STORAGE_VERSION = 1  # on-disk format version (reference kvs/version/)
+
+    def _stamp_storage_version(self):
+        """Stamp new stores; refuse to open a FUTURE format (reference
+        version markers: `surreal upgrade` migrates, open never does)."""
+        from surrealdb_tpu import key as K
+
+        txn = self.transaction(write=True)
+        try:
+            cur = txn.get(K.storage_version())
+            if cur is None:
+                txn.set(K.storage_version(),
+                        str(self.STORAGE_VERSION).encode())
+                txn.commit()
+                return
+            txn.cancel()
+            have = int(cur.decode() or 1)
+            if have > self.STORAGE_VERSION:
+                raise SdbError(
+                    f"The storage version {have} is newer than this build "
+                    f"supports ({self.STORAGE_VERSION}); run a newer "
+                    f"release or `surreal fix`"
+                )
+        except SdbError:
+            raise
+        except BaseException:
+            txn.cancel()
+            raise
+
     def next_versionstamp(self) -> int:
+        """Hybrid logical clock versionstamp (reference kvs/clock.rs
+        HlcTimeStamp): [44-bit wall millis | 20-bit logical counter].
+        Monotonic even when the wall clock stalls or steps backwards —
+        the logical counter advances within a millisecond, and the
+        physical part never regresses below the last issued stamp."""
         with self.lock:
-            self.changefeed_vs += 1
-            return (int(time.time() * 1000) << 20) | (self.changefeed_vs & 0xFFFFF)
+            wall = int(time.time() * 1000)
+            if wall > self._hlc_wall:
+                self._hlc_wall = wall
+                self._hlc_count = 0
+            else:
+                self._hlc_count += 1
+                if self._hlc_count >= (1 << 20):
+                    # logical overflow within one ms: borrow a millisecond
+                    self._hlc_wall += 1
+                    self._hlc_count = 0
+            return (self._hlc_wall << 20) | self._hlc_count
 
     def close(self):
         if self.node_tasks is not None:

@@ -388,7 +388,16 @@ class LsmBackend(Backend):
             os.fsync(self.wal.fileno())
             self.seq += 1
             seq = self.seq
-            preserve = bool(self.active)
+            # pre-images only matter to OTHER active snapshots — exclude
+            # exactly ONE instance of the committer's own snap (another
+            # reader may hold an equal snapshot value and still needs the
+            # pre-image), so uncontended commits skip the per-key read
+            others = list(self.active)
+            try:
+                others.remove(snap)
+            except ValueError:
+                pass
+            preserve = bool(others)
             for k, v in writes.items():
                 if preserve:
                     _f, old = self._get_latest(k)

@@ -23,6 +23,10 @@ from surrealdb_tpu.val import to_json
 _WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
 
+class _BodyTooLarge(Exception):
+    pass
+
+
 class SurrealHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     ds: Datastore = None  # set by make_server
@@ -53,7 +57,11 @@ class SurrealHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _body(self) -> bytes:
+        from surrealdb_tpu import cnf
+
         n = int(self.headers.get("Content-Length") or 0)
+        if n > cnf.HTTP_MAX_BODY_SIZE:
+            raise _BodyTooLarge()
         return self.rfile.read(n) if n else b""
 
     def _session(self) -> Session:
@@ -141,7 +149,30 @@ class SurrealHandler(BaseHTTPRequestHandler):
         self.wfile.write(payload)
 
     # -- routes -------------------------------------------------------------
+    def _dispatch(self, fn):
+        try:
+            fn()
+        except _BodyTooLarge:
+            self._json(413, {
+                "error": "Request body exceeds the maximum allowed size"
+            })
+
     def do_GET(self):
+        self._dispatch(self._do_GET)
+
+    def do_POST(self):
+        self._dispatch(self._do_POST)
+
+    def do_PUT(self):
+        self._dispatch(self._do_PUT)
+
+    def do_PATCH(self):
+        self._dispatch(self._do_PATCH)
+
+    def do_DELETE(self):
+        self._dispatch(self._do_DELETE)
+
+    def _do_GET(self):
         path = urlparse(self.path).path
         if path.startswith("/api/"):
             self._api_route("GET")
@@ -214,7 +245,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return
         self._json(404, {"error": "Not found"})
 
-    def do_POST(self):
+    def _do_POST(self):
         path = urlparse(self.path).path
         if path.startswith("/api/"):
             self._api_route("POST")
@@ -332,7 +363,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return
         self._json(404, {"error": "Not found"})
 
-    def do_PUT(self):
+    def _do_PUT(self):
         if urlparse(self.path).path.startswith("/api/"):
             self._api_route("PUT")
             return
@@ -341,13 +372,13 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return
         self._json(404, {"error": "Not found"})
 
-    def do_PATCH(self):
+    def _do_PATCH(self):
         if urlparse(self.path).path.startswith("/key/"):
             self._key_route("PATCH")
             return
         self._json(404, {"error": "Not found"})
 
-    def do_DELETE(self):
+    def _do_DELETE(self):
         if urlparse(self.path).path.startswith("/key/"):
             self._key_route("DELETE")
             return
@@ -458,6 +489,10 @@ class SurrealHandler(BaseHTTPRequestHandler):
             n = struct.unpack("!H", self.rfile.read(2))[0]
         elif n == 127:
             n = struct.unpack("!Q", self.rfile.read(8))[0]
+        from surrealdb_tpu import cnf
+
+        if n > cnf.WEBSOCKET_MAX_MESSAGE_SIZE:
+            return None  # oversized frame: drop the connection
         mask = self.rfile.read(4) if masked else b"\x00" * 4
         data = bytearray(self.rfile.read(n))
         if masked:
@@ -549,18 +584,58 @@ class SurrealHandler(BaseHTTPRequestHandler):
 
 
 def make_server(ds: Datastore, host="127.0.0.1", port=8000,
-                unauthenticated=False) -> ThreadingHTTPServer:
+                unauthenticated=False, tls_cert=None,
+                tls_key=None) -> ThreadingHTTPServer:
     handler = type("BoundHandler", (SurrealHandler,), {
         "ds": ds,
         "anon_level": "owner" if unauthenticated else "none",
     })
-    return ThreadingHTTPServer((host, port), handler)
+    if not tls_cert:
+        return ThreadingHTTPServer((host, port), handler)
+    # TLS termination in-process (reference ntw: axum_server rustls from
+    # --web-crt/--web-key). The handshake runs in the per-connection
+    # handler thread — doing it inside accept() would let one stalled
+    # client block every new connection.
+    import ssl
+
+    sctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    sctx.load_cert_chain(tls_cert, tls_key)
+
+    class TlsServer(ThreadingHTTPServer):
+        def get_request(self):
+            sock, addr = self.socket.accept()
+            sock.settimeout(30)
+            return sctx.wrap_socket(
+                sock, server_side=True, do_handshake_on_connect=False
+            ), addr
+
+        def finish_request(self, request, client_address):
+            request.do_handshake()
+            request.settimeout(None)
+            super().finish_request(request, client_address)
+
+        def handle_error(self, request, client_address):
+            import ssl as _ssl
+
+            import sys as _sys
+
+            et = _sys.exc_info()[0]
+            if et is not None and issubclass(
+                et, (_ssl.SSLError, TimeoutError, OSError)
+            ):
+                return  # failed/stalled handshakes are routine noise
+            super().handle_error(request, client_address)
+
+    return TlsServer((host, port), handler)
 
 
-def serve(ds: Datastore, host="127.0.0.1", port=8000, unauthenticated=False):
-    srv = make_server(ds, host, port, unauthenticated=unauthenticated)
+def serve(ds: Datastore, host="127.0.0.1", port=8000, unauthenticated=False,
+          tls_cert=None, tls_key=None):
+    srv = make_server(ds, host, port, unauthenticated=unauthenticated,
+                      tls_cert=tls_cert, tls_key=tls_key)
     # served nodes join the cluster: heartbeat + membership GC loops
     # (reference engine/tasks.rs); embedded datastores stay single-node
     ds.start_node_tasks()
-    print(f"surrealdb-tpu listening on http://{host}:{port}")
+    scheme = "https" if tls_cert else "http"
+    print(f"surrealdb-tpu listening on {scheme}://{host}:{port}")
     srv.serve_forever()

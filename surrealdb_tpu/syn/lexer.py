@@ -137,6 +137,11 @@ def _is_ident(c: str) -> bool:
     return c.isalnum() or c == "_"
 
 
+def _is_ascii_digit(c: str) -> bool:
+    # unicode isdigit() accepts superscripts/fractions that int() rejects
+    return "0" <= c <= "9"
+
+
 def tokenize(src: str) -> list[Token]:
     toks: list[Token] = []
     i, n = 0, len(src)
@@ -256,7 +261,7 @@ def tokenize(src: str) -> list[Token]:
             i = j
             continue
         # numbers / durations
-        if c.isdigit():
+        if _is_ascii_digit(c):
             tok, j = _lex_number(src, i, err)
             toks.append(
                 Token(tok[0], src[start:j], tok[1], start, line, col, ws)
@@ -390,7 +395,7 @@ def _lex_string(src, i, quote, err):
 def _lex_number(src, i, err):
     n = len(src)
     j = i
-    while j < n and (src[j].isdigit() or src[j] == "_"):
+    while j < n and (_is_ascii_digit(src[j]) or src[j] == "_"):
         j += 1
     is_float = False
 
@@ -406,9 +411,9 @@ def _lex_number(src, i, err):
             # consume chained segments: 1h30m20s
             total = int(src[i:j].replace("_", "")) * Duration.UNITS[u]
             j += len(u)
-            while j < n and src[j].isdigit():
+            while j < n and _is_ascii_digit(src[j]):
                 k = j
-                while k < n and src[k].isdigit():
+                while k < n and _is_ascii_digit(src[k]):
                     k += 1
                 got = False
                 for u2 in ("ns", "us", "µs", "ms", "y", "w", "d", "h", "m", "s"):
@@ -422,20 +427,20 @@ def _lex_number(src, i, err):
             if total > Duration.MAX_NS:
                 err("duration exceeds maximum")
             return (DURATION, Duration(total)), j
-    if j < n and src[j] == "." and j + 1 < n and src[j + 1].isdigit():
+    if j < n and src[j] == "." and j + 1 < n and _is_ascii_digit(src[j + 1]):
         is_float = True
         j += 1
-        while j < n and (src[j].isdigit() or src[j] == "_"):
+        while j < n and (_is_ascii_digit(src[j]) or src[j] == "_"):
             j += 1
     if j < n and src[j] in "eE" and (
-        (j + 1 < n and src[j + 1].isdigit())
-        or (j + 2 < n and src[j + 1] in "+-" and src[j + 2].isdigit())
+        (j + 1 < n and _is_ascii_digit(src[j + 1]))
+        or (j + 2 < n and src[j + 1] in "+-" and _is_ascii_digit(src[j + 2]))
     ):
         is_float = True
         j += 1
         if src[j] in "+-":
             j += 1
-        while j < n and src[j].isdigit():
+        while j < n and _is_ascii_digit(src[j]):
             j += 1
     text = src[i:j].replace("_", "")
     if src.startswith("dec", j) and not (j + 3 < n and _is_ident(src[j + 3])):

@@ -2635,7 +2635,10 @@ class Parser:
             return Literal(t.value)
         if k == L.DATETIME_STR:
             self.next()
-            return Literal(Datetime.parse(t.value))
+            try:
+                return Literal(Datetime.parse(t.value))
+            except ValueError as e:
+                raise self.err(f"invalid datetime literal: {e}")
         if k == L.UUID_STR:
             self.next()
             import re as _re2
@@ -3143,11 +3146,16 @@ def _is_stmt(node) -> bool:
 
 
 def parse_record_literal(text: str):
-    """Parse the content of r'...' — a record id or record range."""
+    """Parse the content of r'...' — a record id or record range. The
+    WHOLE text must be the id (trailing garbage is an error, so values
+    routed through type::record can never smuggle extra syntax)."""
     p = Parser(text)
     tb = p.ident_or_str()
     p.expect_op(":")
-    return p._parse_record_id(tb)
+    out = p._parse_record_id(tb)
+    if p.peek().kind != L.EOF:
+        raise p.err("unexpected trailing characters in record id")
+    return out
 
 
 def parse_value_literal(text: str):

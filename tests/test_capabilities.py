@@ -107,3 +107,21 @@ def test_cnf_env_knobs(monkeypatch):
     monkeypatch.delenv("SURREAL_MAX_COMPUTATION_DEPTH")
     importlib.reload(cnf)
     assert cnf.MAX_COMPUTATION_DEPTH == 120  # reference default (cnf/mod.rs:40)
+
+
+def test_memory_threshold_kill_switch(monkeypatch):
+    """SURREAL_MEMORY_THRESHOLD aborts queries once process RSS exceeds it
+    (reference core/src/mem kill-switch)."""
+    from surrealdb_tpu import cnf, mem
+    from surrealdb_tpu import Datastore
+
+    ds = Datastore("memory")
+    assert ds.execute("RETURN 1", ns="t", db="t")[0].ok
+    monkeypatch.setattr(cnf, "MEMORY_THRESHOLD", 2 << 20)  # 2 MiB: always over
+    mem._last[0] = 0.0  # drop the RSS sample cache
+    r = ds.execute("RETURN 1", ns="t", db="t")[0]
+    assert r.error == mem.MEMORY_THRESHOLD_MSG
+    monkeypatch.setattr(cnf, "MEMORY_THRESHOLD", 0)
+    mem._last[0] = 0.0
+    assert ds.execute("RETURN 1", ns="t", db="t")[0].ok
+    assert mem.report()["process_rss_bytes"] > 0

@@ -1,0 +1,20 @@
+"""Bounded fuzz smoke (reference runs fuzz_targets under libFuzzer in CI;
+here a fixed-seed slice executes per test run so regressions that crash
+the parser/executor on malformed input surface immediately)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_parser_fuzz_slice():
+    from fuzz.fuzz_sql_parser import run
+
+    assert run(iterations=800, seed=42) == 0
+
+
+def test_executor_fuzz_slice():
+    from fuzz.fuzz_executor import run
+
+    assert run(iterations=150, seed=42) == 0

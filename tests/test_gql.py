@@ -1,0 +1,73 @@
+"""GraphQL: generated schema, queries with filters, mutations, link
+resolution (reference core/src/gql/ + server gql/)."""
+
+from surrealdb_tpu import Datastore
+from surrealdb_tpu.gql import execute_graphql
+from surrealdb_tpu.kvs.ds import Session
+
+
+def _ds():
+    ds = Datastore("memory")
+    q = lambda s: ds.query(s, ns="t", db="t")
+    q("DEFINE TABLE person SCHEMAFULL")
+    q("DEFINE FIELD name ON person TYPE string")
+    q("DEFINE FIELD age ON person TYPE int")
+    q("DEFINE FIELD city ON person TYPE option<record<city>>")
+    q("DEFINE TABLE city SCHEMAFULL; DEFINE FIELD name ON city TYPE string")
+    q("CREATE city:1 SET name = 'SF'")
+    q("CREATE person:1 SET name = 'Ada', age = 36, city = city:1")
+    q("CREATE person:2 SET name = 'Bob', age = 41")
+    return ds, Session(ns="t", db="t", auth_level="owner")
+
+
+def test_query_with_filter_ops():
+    ds, sess = _ds()
+    out = execute_graphql(
+        ds, sess,
+        'query { person(filter: {age: {gt: 40}}) { name age } }')
+    assert out["data"]["person"] == [{"name": "Bob", "age": 41}]
+    out = execute_graphql(
+        ds, sess, 'query { person(order: "age", desc: true) { name } }')
+    assert [p["name"] for p in out["data"]["person"]] == ["Bob", "Ada"]
+
+
+def test_record_link_resolution():
+    ds, sess = _ds()
+    out = execute_graphql(
+        ds, sess, 'query { person(id: "1") { name city { name } } }')
+    assert out["data"]["person"] == [
+        {"name": "Ada", "city": {"name": "SF"}}
+    ]
+
+
+def test_mutations():
+    ds, sess = _ds()
+    out = execute_graphql(
+        ds, sess,
+        'mutation { create_person(data: {name: "Eve", age: 29}) { name } }')
+    assert out["data"]["create_person"] == [{"name": "Eve"}]
+    out = execute_graphql(
+        ds, sess,
+        'mutation { update_person(id: "1", data: {age: 37}) { age } }')
+    assert out["data"]["update_person"] == [{"age": 37}]
+    out = execute_graphql(
+        ds, sess, 'mutation { delete_person(id: "2") { name } }')
+    assert out["data"]["delete_person"] == [{"name": "Bob"}]
+    rows = ds.query("SELECT count() FROM person GROUP ALL", ns="t", db="t")
+    assert rows[0][0]["count"] == 2
+
+
+def test_generated_introspection():
+    ds, sess = _ds()
+    out = execute_graphql(ds, sess, "query { __schema { types } }")
+    schema = out["data"]["__schema"]
+    names = {t["name"] for t in schema["types"]}
+    assert {"person", "city", "Query", "Mutation"} <= names
+    person = next(t for t in schema["types"] if t["name"] == "person")
+    ftypes = {f["name"]: f["type"] for f in person["fields"]}
+    assert ftypes["age"]["name"] == "Int"
+    assert ftypes["name"]["name"] == "String"
+    assert ftypes["city"] == {"kind": "OBJECT", "name": "city",
+                              "ofType": None}
+    tq = execute_graphql(ds, sess, '{ __type(name: "person") { name } }')
+    assert tq["data"]["__type"]["name"] == "person"

@@ -6,9 +6,17 @@ import struct
 import numpy as np
 import pytest
 
-from surrealdb_tpu import Datastore
+from surrealdb_tpu import Datastore as _Datastore
 from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.ml import SurmlFile, import_model, make_jax_model
+
+
+def Datastore(path):
+    """Test datastores run with the ml experimental capability enabled
+    (the reference gates ml:: behind its cargo feature)."""
+    ds = _Datastore(path)
+    ds.capabilities.allow_experimental.names.add("ml")
+    return ds
 
 
 def _onnx_linear(w: np.ndarray, b: np.ndarray) -> bytes:

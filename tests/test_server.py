@@ -295,3 +295,37 @@ def test_define_api_served(server):
     assert s == 200 and json.loads(b)["msg"] == "hi"
     s, b = _req(base + "/api/t/t/item/42", "GET", None, hdrs)
     assert s == 200 and json.loads(b) == "42"
+
+
+def test_tls_server(tmp_path):
+    """HTTPS serving via --web-crt/--web-key equivalents (reference ntw
+    rustls config)."""
+    import ssl
+    import subprocess
+    import threading
+    import urllib.request
+
+    crt, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", crt, "-days", "1", "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu.server import make_server
+
+    ds = Datastore("memory")
+    srv = make_server(ds, "127.0.0.1", 18441, unauthenticated=True,
+                      tls_cert=crt, tls_key=key)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        sctx = ssl.create_default_context()
+        sctx.check_hostname = False
+        sctx.verify_mode = ssl.CERT_NONE
+        body = urllib.request.urlopen(
+            "https://127.0.0.1:18441/version", context=sctx
+        ).read()
+        assert b"surrealdb-tpu" in body
+    finally:
+        srv.shutdown()
