@@ -461,8 +461,9 @@ def bench_graph3hop(quick=False):
     from surrealdb_tpu.kvs.api import serialize
     from surrealdb_tpu.val import RecordId
 
-    n_nodes = 20_000 if quick else 200_000
-    n_edges = 200_000 if quick else 2_000_000
+    # BASELINE config 4: 1M nodes / 10M edges (quick: 1/50 scale)
+    n_nodes = 20_000 if quick else 1_000_000
+    n_edges = 200_000 if quick else 10_000_000
     ds = Datastore("memory")
     ds.query("DEFINE TABLE person; DEFINE TABLE knows TYPE RELATION",
              ns="b", db="b")
@@ -503,17 +504,52 @@ def bench_graph3hop(quick=False):
     for _ in range(iters):
         out = ds.query_one(sql, ns="b", db="b")
     ms = (time.perf_counter() - t0) / iters * 1000
+
+    # honest CPU comparator: scipy-free numpy CSR adjacency + 3 sparse
+    # frontier expansions — the classic single-host way to run this
+    # traversal (the reference walks per-record KV range scans; a numpy
+    # CSR is the STRONGER baseline to beat)
+    order = np.argsort(src, kind="stable")
+    ss, dd = src[order], dst[order]
+    indptr = np.zeros(n_nodes + 1, np.int64)
+    np.add.at(indptr, ss + 1, 1)
+    indptr = np.cumsum(indptr)
+
+    def csr_3hop(start: int):
+        frontier = np.array([start], dtype=np.int64)
+        for _hop in range(3):
+            if not len(frontier):
+                break
+            parts = [
+                dd[indptr[v]:indptr[v + 1]] for v in frontier
+            ]
+            frontier = np.concatenate(parts) if parts else frontier[:0]
+        return frontier
+
+    csr_3hop(0)  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ref = csr_3hop(0)
+    base_ms = (time.perf_counter() - t0) / iters * 1000
+    reached = (
+        len(out[0]) if isinstance(out, list) and out
+        and isinstance(out[0], list) else
+        (len(out) if isinstance(out, list) else 1)
+    )
+    size = (f"{n_nodes // 1_000_000}m" if n_nodes >= 1_000_000
+            else f"{n_nodes // 1000}k")
+    esize = (f"{n_edges // 1_000_000}m" if n_edges >= 1_000_000
+             else f"{n_edges // 1000}k")
     return {
-        "metric": f"sql_graph_3hop_ms_{n_nodes//1000}k_nodes_{n_edges//1000}k_edges",
+        "metric": f"sql_graph_3hop_ms_{size}_nodes_{esize}_edges",
         "value": round(ms, 2),
         "unit": "ms",
-        "vs_baseline": 1.0,
+        # ratio > 1 means the SQL path beats the numpy CSR walk
+        "vs_baseline": round(base_ms / ms, 3) if ms else 0.0,
+        "cpu_csr_ms": round(base_ms, 2),
         "first_ms": round(first_ms, 2),
-        "reached": (
-            len(out[0]) if isinstance(out, list) and out
-            and isinstance(out[0], list) else
-            (len(out) if isinstance(out, list) else 1)
-        ),
+        "reached": reached,
+        "csr_reached": int(len(ref)),
     }
 
 

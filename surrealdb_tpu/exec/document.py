@@ -639,6 +639,26 @@ def _index_rows(vals, idef=None):
     return rows
 
 
+def _bump_graph_version(ctx, gk):
+    """Invalidate the CSR cache for a graph table — AFTER commit, so the
+    shared cache never advances past committed state (an uncommitted
+    RELATE must not stamp a committed-only rebuild as current)."""
+    def bump():
+        ds = ctx.ds
+        ds.graph_versions[gk] = ds.graph_versions.get(gk, 0) + 1
+
+    if hasattr(ctx.txn, "on_commit"):
+        # within this txn the CSR cache is stale for gk: the fast paths
+        # check this marker and fall back to per-record scans
+        dirty = getattr(ctx.txn, "_graph_dirty", None)
+        if dirty is None:
+            dirty = ctx.txn._graph_dirty = set()
+        dirty.add(gk)
+        ctx.txn.on_commit(bump)
+    else:
+        bump()
+
+
 def index_update(rid: RecordId, before, after, ctx: Ctx):
     """Remove old entries / add new for every index on the table
     (reference idx/index.rs IndexOperation)."""
@@ -1255,7 +1275,7 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
         )
         ctx.record_cache[(rid.tb, K.enc_value(rid.id))] = after
     gk = (ns, db, rid.tb)
-    ctx.ds.graph_versions[gk] = ctx.ds.graph_versions.get(gk, 0) + 1
+    _bump_graph_version(ctx, gk)
     # indexes
     index_update(rid, before, after, ctx)
     # record references (REFERENCE fields)
@@ -1529,7 +1549,7 @@ def delete_one(rid: RecordId, before, output, ctx: Ctx):
     ctx.txn.set(K.hist(ns, db, rid.tb, rid.id, _time.time_ns()), b"")
     ctx.record_cache.pop((rid.tb, K.enc_value(rid.id)), None)
     gk = (ns, db, rid.tb)
-    ctx.ds.graph_versions[gk] = ctx.ds.graph_versions.get(gk, 0) + 1
+    _bump_graph_version(ctx, gk)
     # purge graph edges; cascade delete edge records hanging off this node
     from surrealdb_tpu.graph import purge_edges
 

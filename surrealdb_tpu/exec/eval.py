@@ -699,6 +699,14 @@ def walk(val, parts, ctx: Ctx, depth=0):
                 # element through the remaining chain — hop frontiers
                 # stay flat (language/idiom/graph_filter_flattened)
                 return [walk(x, parts[i:], ctx, depth + 1) for x in val]
+            nxt = parts[i + 1] if i + 1 < len(parts) else None
+            if nxt is not None:
+                fast = _csr_bag_pair_hop(val, part, nxt, ctx)
+                if fast is not None:
+                    val = fast
+                    from_graph = True
+                    i += 1
+                    continue
             val = _apply_graph(val, part, ctx)
             from_graph = True
             # graph results are lists; subsequent field parts map over them
@@ -897,10 +905,53 @@ def _csr_pair_hop(val, g1, g2, ctx):
     src_tbs = {r.tb for r in rids}
     if src_tbs != {node_tb}:
         return None
+    ns0, db0 = ctx.need_ns_db()
+    if (ns0, db0, edge_tb) in getattr(ctx.txn, "_graph_dirty", ()):
+        return None  # uncommitted edge writes in this txn
     from surrealdb_tpu.graph.csr import get_csr
 
     csr = get_csr(ctx.ds, ctx, node_tb, edge_tb, g1.dir)
     keys = csr.multi_hop([r.id for r in rids], 1)
+    return [RecordId(node_tb, k) for k in keys]
+
+
+def _csr_bag_pair_hop(val, g1, g2, ctx):
+    """Host CSR fast path for plain `->edge->node` chain pairs with BAG
+    semantics. Engages when the adjacency cache is already valid, or the
+    frontier is large enough to amortize a build; returns None to fall
+    back to the per-record `~`-key scans."""
+    pat = _csr_pair_pattern(g1, g2)
+    if pat is None:
+        return None
+    edge_tb, node_tb, _dir = pat
+    rids = _collect_rids(val, ctx)
+    if not rids or any(r.tb != node_tb for r in rids):
+        return None
+    ns, db = ctx.need_ns_db()
+    gk0 = (ns, db, edge_tb)
+    if gk0 in getattr(ctx.txn, "_graph_dirty", ()):
+        # this txn holds uncommitted writes to the edge table — the
+        # shared CSR (committed state) would miss them
+        return None
+    # alignment guard: a chain that fell back mid-way can present
+    # (node, edge) in swapped roles — only pair when the first table is
+    # a declared RELATION (the bench/graph schema norm)
+    tdef = ctx.txn.get_val(K.tb_def(ns, db, edge_tb))
+    if tdef is None or getattr(tdef, "kind", None) != "relation":
+        return None
+    from surrealdb_tpu.graph.csr import peek_csr
+    csr = peek_csr(ctx.ds, ns, db, node_tb, edge_tb, g1.dir)
+    gk = (ns, db, edge_tb)
+    cur_ver = ctx.ds.graph_versions.get(gk, 0)
+    cache_valid = csr is not None and csr.version == cur_ver
+    if not cache_valid and len(rids) < 64:
+        return None  # a point lookup shouldn't pay a full edge scan
+    from surrealdb_tpu.graph.csr import get_csr
+
+    csr = get_csr(ctx.ds, ctx, node_tb, edge_tb, g1.dir)
+    if not len(csr.rows):
+        return None  # empty adjacency: per-record scans are authoritative
+    keys = csr.hop_bag([r.id for r in rids])
     return [RecordId(node_tb, k) for k in keys]
 
 

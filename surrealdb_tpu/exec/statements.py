@@ -4333,7 +4333,9 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
             if ixkey[:3] == (ns, db, n.name):
                 ctx.ds.ft_indexes.pop(ixkey, None)
         gk = (ns, db, n.name)
-        ctx.ds.graph_versions[gk] = ctx.ds.graph_versions.get(gk, 0) + 1
+        from surrealdb_tpu.exec.document import _bump_graph_version
+
+        _bump_graph_version(ctx, gk)
         if ctx.ds.graph_engine:
             for ck in list(ctx.ds.graph_engine):
                 if ck[2] == n.name or ck[3] == n.name:

@@ -30,6 +30,8 @@ class CsrGraph:
         self.cols = np.zeros(0, np.int32)  # [E] dest node idx per edge
         self.edge_ids: list = []  # [E] edge record keys (for edge output)
         self.device = None
+        self.indptr = None  # host CSR (sorted by row, stable)
+        self.sorted_cols = None
         self.lock = threading.RLock()
 
     def build(self, ctx):
@@ -84,6 +86,8 @@ class CsrGraph:
         self.cols = np.asarray(cols, np.int32)
         self.edge_ids = eids
         self.device = None
+        self.indptr = None
+        self.sorted_cols = None
 
     def _ensure_device(self):
         if self.device is None:
@@ -97,6 +101,36 @@ class CsrGraph:
 
     def n_nodes(self) -> int:
         return len(self.node_ids)
+
+    def _ensure_host(self):
+        """Host CSR: rows stable-sorted so each row's destinations keep
+        edge-scan (= edge-key) order — the order the per-record `~`-key
+        walk produces."""
+        if self.indptr is None:
+            order = np.argsort(self.rows, kind="stable")
+            self.sorted_cols = self.cols[order]
+            indptr = np.zeros(len(self.node_ids) + 1, np.int64)
+            np.add.at(indptr, self.rows + 1, 1)
+            self.indptr = np.cumsum(indptr)
+
+    def hop_bag(self, start_keys: list) -> list:
+        """One `->edge->node` pair hop with BAG semantics (duplicates and
+        per-source order preserved) — the host fast path for plain chain
+        traversals; frontiers are numpy gathers instead of per-record KV
+        scans (SURVEY §3.4 TPU target)."""
+        self._ensure_host()
+        parts = []
+        for idv in start_keys:
+            i = self.node_index.get(K.enc_value(idv))
+            if i is not None:
+                parts.append(
+                    self.sorted_cols[self.indptr[i]:self.indptr[i + 1]]
+                )
+        if not parts:
+            return []
+        cat = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        ids = self.node_ids
+        return [ids[int(j)] for j in cat]
 
     def multi_hop(self, start_keys: list, hops: int, collect_mode="frontier"):
         """Expand `hops` steps from the start nodes on device.
@@ -158,6 +192,13 @@ def _multi_hop_jit(rows, cols, start, n_nodes, hops, union):
         )
         _jit_cache[ck] = fn
     return fn(rows, cols, start, n_nodes, hops, union)
+
+
+def peek_csr(ds, ns, db, node_tb, edge_tb, direction):
+    """The cached CSR WITHOUT building (None if never built)."""
+    if ds.graph_engine is None:
+        return None
+    return ds.graph_engine.get((ns, db, node_tb, edge_tb, direction))
 
 
 def get_csr(ds, ctx, node_tb, edge_tb, direction) -> CsrGraph:

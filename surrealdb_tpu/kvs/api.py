@@ -292,12 +292,26 @@ class Transaction:
         self.btx.release_last_save_point()
 
     # lifecycle ------------------------------------------------------------
+    def on_commit(self, fn):
+        """Run `fn()` after a successful commit (datastore-level cache
+        invalidation must track COMMITTED state, not in-flight writes)."""
+        if not hasattr(self, "_commit_hooks"):
+            self._commit_hooks = []
+        self._commit_hooks.append(fn)
+
     def commit(self):
         if not self.closed:
             self.btx.commit()
             self.closed = True
+            for fn in getattr(self, "_commit_hooks", ()):  # post-commit
+                try:
+                    fn()
+                except Exception:
+                    pass
 
     def cancel(self):
         if not self.closed:
             self.btx.cancel()
             self.closed = True
+            if hasattr(self, "_commit_hooks"):
+                self._commit_hooks = []

@@ -122,6 +122,11 @@ class WsEngine:
 
             self._pack = wire.encode
             self._unpack = wire.decode
+        elif fmt == "flatbuffers":
+            from surrealdb_tpu import fb
+
+            self._pack = fb.encode
+            self._unpack = fb.decode
         else:
             self._pack = lambda v: json.dumps(v).encode()
             self._unpack = lambda b: json.loads(b.decode())
@@ -267,7 +272,7 @@ class WsEngine:
         try:
             self._send_frame(
                 self._pack({"id": rid, "method": method, "params": params}),
-                0x2 if self.fmt == "cbor" else 0x1,
+                0x2 if self.fmt in ("cbor", "flatbuffers") else 0x1,
             )
             if not slot[0].wait(self.timeout):
                 raise SdbError(f"rpc timeout: {method}")

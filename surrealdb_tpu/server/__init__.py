@@ -302,40 +302,61 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 self._json(401, {"code": 401, "details": str(e)})
             return
         if path == "/rpc":
-            # HTTP one-shot RPC with format negotiation (json | cbor)
+            # HTTP one-shot RPC with format negotiation
+            # (json | cbor | flatbuffers — reference api/mod.rs MIME list)
             ctype = (self.headers.get("Content-Type") or "").lower()
             accept = (self.headers.get("Accept") or ctype).lower()
-            cbor_in = "cbor" in ctype
-            cbor_out = "cbor" in accept
+            fmt_in = "cbor" if "cbor" in ctype else (
+                "fb" if "flatbuffers" in ctype else "json"
+            )
+            fmt_out = "cbor" if "cbor" in accept else (
+                "fb" if "flatbuffers" in accept else "json"
+            )
+            rich_out = fmt_out != "json"
 
             def respond(payload):
-                if cbor_out:
+                if fmt_out == "cbor":
                     from surrealdb_tpu import wire
 
                     body = wire.encode(payload)
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/cbor")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    mime = "application/cbor"
+                elif fmt_out == "fb":
+                    from surrealdb_tpu import fb
+
+                    body = fb.encode(payload)
+                    mime = fb.MIME
                 else:
                     self._json(200, payload)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", mime)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             req = {}
             try:
                 raw = self._body() or b"{}"
-                if cbor_in:
+                if fmt_in == "cbor":
                     from surrealdb_tpu import wire
 
-                    req = wire.decode(raw)
+                    decoded = wire.decode(raw)
+                elif fmt_in == "fb":
+                    from surrealdb_tpu import fb
+
+                    decoded = fb.decode(raw)
                 else:
-                    req = json.loads(raw)
+                    decoded = json.loads(raw)
+                if not isinstance(decoded, dict):
+                    # req stays {} so the error path can req.get("id")
+                    raise SdbError("rpc request must be an object")
+                req = decoded
                 rs = RpcSession(self.ds, anon_level=self.anon_level)
                 rs.session = self._session()
                 out = rs.handle(req.get("method", ""), req.get("params") or [])
                 respond({
                     "id": req.get("id"),
-                    "result": out if cbor_out else to_json(out),
+                    "result": out if rich_out else to_json(out),
                 })
             except RpcError as e:
                 respond({"id": req.get("id"),
@@ -450,7 +471,10 @@ class SurrealHandler(BaseHTTPRequestHandler):
             for p in (self.headers.get("Sec-WebSocket-Protocol") or "").split(",")
             if p.strip()
         ]
-        proto = next((p for p in offered if p in ("cbor", "json")), None)
+        proto = next(
+            (p for p in offered if p in ("cbor", "json", "flatbuffers")),
+            None,
+        )
         self.send_response(101, "Switching Protocols")
         self.send_header("Upgrade", "websocket")
         self.send_header("Connection", "Upgrade")
@@ -509,6 +533,12 @@ class SurrealHandler(BaseHTTPRequestHandler):
             pack = wire.encode
             unpack = wire.decode
             jsonify = lambda v: v  # cbor carries rich values natively
+        elif fmt == "flatbuffers":
+            from surrealdb_tpu import fb
+
+            pack = fb.encode
+            unpack = fb.decode
+            jsonify = lambda v: v
         else:
             pack = json.dumps
             unpack = lambda data: json.loads(data.decode())

@@ -1,0 +1,79 @@
+"""FlatBuffers wire format: value roundtrips + negotiated RPC over HTTP
+and WebSocket (reference surrealdb/types/src/flatbuffers/ + the
+application/vnd.surrealdb.flatbuffers MIME in core/src/api/mod.rs)."""
+
+import threading
+import urllib.request
+from decimal import Decimal
+
+import pytest
+
+from surrealdb_tpu import Datastore, fb
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.server import make_server
+from surrealdb_tpu.val import (NONE, Datetime, Duration, Range, RecordId,
+                               SSet, Table, Uuid, value_eq)
+
+
+@pytest.mark.parametrize("v", [
+    NONE, None, True, False, 42, -(1 << 62), 3.25, Decimal("1.50"),
+    "héllo 世界", b"\x00\xff", Table("person"), RecordId("person", 9),
+    RecordId("t", ["a", 1]), Uuid("019535d9-3df7-79fb-b466-fa907fa17f9e"),
+    Datetime.parse("2020-05-06T07:08:09.123456789Z"),
+    Duration.parse("1h30m"), [1, "two", [3.0, None]],
+    {"a": 1, "nested": {"b": [True]}}, SSet([1, 2]),
+    Range(1, 5, True, False), Range(1, 5, False, True),
+    Datetime.from_parts(250000, 1, 2, 3),
+    Datetime.from_parts(-1000, 6, 7),
+])
+def test_fb_roundtrip(v):
+    rt = fb.decode(fb.encode(v))
+    if v is None:
+        assert rt is None
+    else:
+        assert value_eq(rt, v), (v, rt)
+
+
+def test_fb_invalid_payload():
+    with pytest.raises(SdbError):
+        fb.decode(b"\x01")
+
+
+def test_fb_http_rpc():
+    ds = Datastore("memory")
+    srv = make_server(ds, "127.0.0.1", 18460, unauthenticated=True)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        req_body = fb.encode({"id": 1, "method": "query",
+                              "params": ["RETURN 40 + 2", {}]})
+        req = urllib.request.Request(
+            "http://127.0.0.1:18460/rpc", data=req_body,
+            headers={"Content-Type": fb.MIME, "Accept": fb.MIME,
+                     "surreal-ns": "t", "surreal-db": "t"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req) as r:
+            assert r.headers["Content-Type"] == fb.MIME
+            out = fb.decode(r.read())
+        assert out["result"][0]["result"] == 42
+    finally:
+        srv.shutdown()
+
+
+def test_fb_ws_engine():
+    from surrealdb_tpu.sdk import connect
+
+    ds = Datastore("memory")
+    srv = make_server(ds, "127.0.0.1", 18461, unauthenticated=True)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        with connect("ws://127.0.0.1:18461", fmt="flatbuffers") as db:
+            db.use("t", "t")
+            db.create("person:1", {"name": "ada", "n": 3})
+            rows = db.select("person")
+            assert rows[0]["name"] == "ada" and rows[0]["n"] == 3
+            assert isinstance(rows[0]["id"], RecordId)
+    finally:
+        srv.shutdown()

@@ -121,3 +121,82 @@ def test_vector_incremental_sync(ds):
     assert RecordId("vt", 1) not in ids
     assert rebuilt["n"] == 0, "expected incremental log apply, got rebuild"
     assert eng.version > ver0
+
+
+def test_csr_fast_path_in_txn_and_post_commit():
+    """Regression: the shared CSR cache tracks COMMITTED state — an
+    uncommitted RELATE must fall back to `~`-key scans in its own txn and
+    invalidate the cache only on commit."""
+    import numpy as np
+
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.kvs.api import serialize
+    from surrealdb_tpu.val import RecordId
+
+    ds = Datastore("memory")
+    ds.query("DEFINE TABLE person; DEFINE TABLE knows TYPE RELATION",
+             ns="b", db="b")
+    rng = np.random.default_rng(5)
+    txn = ds.transaction(write=True)
+    for i in range(300):
+        txn.set(K.record("b", "b", "person", i),
+                serialize({"id": RecordId("person", i)}))
+    e = 0
+    for s_ in range(100):
+        for d_ in rng.integers(0, 300, size=3):
+            txn.set(K.record("b", "b", "knows", e), serialize({
+                "id": RecordId("knows", e),
+                "in": RecordId("person", int(s_)),
+                "out": RecordId("person", int(d_)),
+            }))
+            txn.set(K.graph("b", "b", "person", int(s_), K.DIR_OUT,
+                            "knows", e), b"")
+            txn.set(K.graph("b", "b", "knows", e, K.DIR_IN, "person",
+                            int(s_)), b"")
+            txn.set(K.graph("b", "b", "knows", e, K.DIR_OUT, "person",
+                            int(d_)), b"")
+            txn.set(K.graph("b", "b", "person", int(d_), K.DIR_IN,
+                            "knows", e), b"")
+            e += 1
+    txn.commit()
+    sql = "SELECT VALUE ->knows->person->knows->person FROM person:0"
+    base = len(ds.query_one(sql, ns="b", db="b")[0])
+    ds.query_one(sql, ns="b", db="b")  # warm the CSR cache
+    res = ds.execute(
+        f"BEGIN; RELATE person:0->knows->person:1; {sql}; COMMIT",
+        ns="b", db="b",
+    )
+    assert res[2].error is None
+    intx = len(res[2].result[0])
+    after = len(ds.query_one(sql, ns="b", db="b")[0])
+    # the new person:0->1 edge adds person:1's fanout to the result
+    assert intx > base and after == intx
+
+
+def test_csr_fast_path_matches_slow_path():
+    """Bag semantics + ordering of the CSR pair hop equal the per-record
+    scan path exactly."""
+    import numpy as np
+
+    import surrealdb_tpu.exec.eval as E
+    from surrealdb_tpu import Datastore
+
+    ds = Datastore("memory")
+    q = lambda s: ds.query(s, ns="b", db="b")
+    q("DEFINE TABLE person; DEFINE TABLE knows TYPE RELATION")
+    rng = np.random.default_rng(3)
+    for i in range(40):
+        q(f"CREATE person:{i}")
+    for _ in range(300):
+        a, b = rng.integers(0, 40, size=2)
+        q(f"RELATE person:{int(a)}->knows->person:{int(b)}")
+    sql = "SELECT VALUE ->knows->person->knows->person FROM person:0"
+    fast = q(sql)[0]
+    orig = E._csr_bag_pair_hop
+    E._csr_bag_pair_hop = lambda *a, **k: None  # force per-record scans
+    try:
+        slow = q(sql)[0]
+    finally:
+        E._csr_bag_pair_hop = orig
+    assert fast == slow
