@@ -147,6 +147,17 @@ class MlModelDef:
 
 
 @dataclass
+class ModuleDef:
+    """A stored WASM module (reference DEFINE MODULE / .surli packages)."""
+
+    name: str
+    comment: Optional[str] = None
+    permissions: Any = True
+    hash: str = ""
+    exports: list = field(default_factory=list)
+
+
+@dataclass
 class SequenceDef:
     name: str
     batch: int = 1000

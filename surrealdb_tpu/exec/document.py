@@ -649,12 +649,14 @@ def _bump_graph_version(ctx, gk):
 
     if hasattr(ctx.txn, "on_commit"):
         # within this txn the CSR cache is stale for gk: the fast paths
-        # check this marker and fall back to per-record scans
+        # check this marker and fall back to per-record scans. One hook
+        # per distinct table — bulk writes register once.
         dirty = getattr(ctx.txn, "_graph_dirty", None)
         if dirty is None:
             dirty = ctx.txn._graph_dirty = set()
-        dirty.add(gk)
-        ctx.txn.on_commit(bump)
+        if gk not in dirty:
+            dirty.add(gk)
+            ctx.txn.on_commit(bump)
     else:
         bump()
 

@@ -59,6 +59,7 @@ def eval_statement(node, ctx: Ctx):
         if isinstance(node, (DefineNamespace, DefineDatabase, DefineTable,
                              DefineField, DefineIndex, DefineEvent,
                              DefineAnalyzer, DefineUser, DefineAccess,
+                             DefineModule,
                              DefineSequence, DefineConfig, DefineParam,
                              DefineFunction, RemoveStmt,
                              InfoStmt, RebuildIndex)):
@@ -4136,6 +4137,35 @@ def _s_define_user(n: DefineUser, ctx):
     return NONE
 
 
+def _s_define_module(n, ctx):
+    from surrealdb_tpu.surrealism import define_module
+
+    _ensure_ns_db(ctx)
+    data = evaluate(n.executable, ctx)
+    if isinstance(data, str):
+        try:
+            data = data.encode("latin-1")
+        except UnicodeEncodeError:
+            raise SdbError(
+                "DEFINE MODULE expects the module bytes — pass a <bytes> "
+                "value (the string form cannot carry binary payloads)"
+            )
+    if not isinstance(data, (bytes, bytearray)):
+        raise SdbError(
+            "DEFINE MODULE expects the module bytes (a <bytes> value)"
+        )
+    name = n.name
+    if name is None:
+        from surrealdb_tpu.surrealism import SurliModule
+
+        name = SurliModule.from_bytes(bytes(data)).header.get("name")
+        if not name:
+            raise SdbError("DEFINE MODULE requires a name (mod::name AS ...)")
+    define_module(name, bytes(data), ctx, comment=n.comment,
+                  if_not_exists=n.if_not_exists, overwrite=n.overwrite)
+    return NONE
+
+
 def _s_define_access(n: DefineAccess, ctx):
     base = n.base
     ns = ctx.session.ns if base in ("ns", "db") else None
@@ -4434,9 +4464,13 @@ def _s_remove(n: RemoveStmt, ctx: Ctx):
         ctx.txn.delete(key)
         return NONE
     if kind == "module":
-        if n.if_exists:
-            return NONE
-        raise SdbError(f"The {kind} '{n.name}' does not exist")
+        from surrealdb_tpu.surrealism import remove_module
+
+        nm = n.name
+        if nm.startswith("mod::"):
+            nm = nm[5:]
+        remove_module(nm, ctx, if_exists=n.if_exists)
+        return NONE
     raise SdbError(f"unknown REMOVE kind {kind}")
 
 
@@ -4872,6 +4906,12 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             out["params"][d.name] = render_param(d)
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.fc_prefix(ns, db))):
             out["functions"][d.name] = render_function(d)
+        for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.mod_prefix(ns, db))):
+            txt = f"DEFINE MODULE mod::{d.name} AS <module>"
+            if d.comment:
+                txt += f" COMMENT '{d.comment}'"
+            txt += " PERMISSIONS FULL"
+            out["modules"][d.name] = txt
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ml_prefix(ns, db))):
             label = f"{d.name}<{d.version}>"
             txt = f"DEFINE MODEL ml::{d.name}<{d.version}>"
@@ -5293,6 +5333,7 @@ _STMTS = {
     DefineAnalyzer: _s_define_analyzer,
     DefineUser: _s_define_user,
     DefineAccess: _s_define_access,
+    DefineModule: _s_define_module,
     DefineSequence: _s_define_sequence,
     DefineConfig: _s_define_config,
     RemoveStmt: _s_remove,

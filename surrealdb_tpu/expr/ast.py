@@ -612,6 +612,17 @@ class DefineUser(Node):
 
 
 @dataclass
+class DefineModule(Node):
+    """DEFINE MODULE [mod::name AS] <executable> (surrealism packages)."""
+
+    name: Optional[str]
+    executable: Any
+    comment: Optional[str] = None
+    if_not_exists: bool = False
+    overwrite: bool = False
+
+
+@dataclass
 class DefineAccess(Node):
     name: str
     base: str

@@ -89,6 +89,10 @@ def _encode_value(b, v):
     if isinstance(v, bool):
         return T_BOOL, _scalar_table(b, b.PrependBoolSlot, v)
     if isinstance(v, int):
+        if not -(1 << 63) <= v < (1 << 63):
+            raise SdbError(
+                "value out of range for the flatbuffers int64 encoding"
+            )
         return T_INT64, _scalar_table(b, b.PrependInt64Slot, v)
     if isinstance(v, float):
         return T_FLOAT64, _scalar_table(b, b.PrependFloat64Slot, v)
@@ -120,7 +124,7 @@ def _encode_value(b, v):
         return T_DATETIME, b.EndObject()
     if isinstance(v, Duration):
         b.StartObject(1)
-        b.PrependUint64Slot(0, v.ns, 0)
+        b.PrependUint64Slot(0, min(v.ns, (1 << 64) - 1), 0)
         return T_DURATION, b.EndObject()
     if isinstance(v, SSet):
         return T_SET, _encode_vector_table(b, list(v.items))

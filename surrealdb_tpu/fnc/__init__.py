@@ -128,6 +128,12 @@ def call_function(node, ctx):
     name = node.name.lower()
     if name.startswith("fn::"):
         return call_custom(node.name[4:], [evaluate(a, ctx) for a in node.args], ctx)
+    if name.startswith("mod::"):
+        from surrealdb_tpu.surrealism import call_module
+
+        return call_module(
+            node.name[5:], [evaluate(a, ctx) for a in node.args], ctx
+        )
     if name.startswith("ml::"):
         caps = getattr(ctx.ds, "capabilities", None)
         if caps is None or not caps.allows_experimental("ml"):

@@ -117,20 +117,22 @@ class CsrGraph:
         """One `->edge->node` pair hop with BAG semantics (duplicates and
         per-source order preserved) — the host fast path for plain chain
         traversals; frontiers are numpy gathers instead of per-record KV
-        scans (SURVEY §3.4 TPU target)."""
-        self._ensure_host()
-        parts = []
-        for idv in start_keys:
-            i = self.node_index.get(K.enc_value(idv))
-            if i is not None:
-                parts.append(
-                    self.sorted_cols[self.indptr[i]:self.indptr[i + 1]]
-                )
-        if not parts:
-            return []
-        cat = np.concatenate(parts) if len(parts) > 1 else parts[0]
-        ids = self.node_ids
-        return [ids[int(j)] for j in cat]
+        scans (SURVEY §3.4 TPU target). Runs under the graph lock: a
+        concurrent rebuild reassigns these arrays."""
+        with self.lock:
+            self._ensure_host()
+            parts = []
+            for idv in start_keys:
+                i = self.node_index.get(K.enc_value(idv))
+                if i is not None:
+                    parts.append(
+                        self.sorted_cols[self.indptr[i]:self.indptr[i + 1]]
+                    )
+            if not parts:
+                return []
+            cat = np.concatenate(parts) if len(parts) > 1 else parts[0]
+            ids = self.node_ids
+            return [ids[int(j)] for j in cat]
 
     def multi_hop(self, start_keys: list, hops: int, collect_mode="frontier"):
         """Expand `hops` steps from the start nodes on device.

@@ -616,6 +616,18 @@ def storage_version() -> bytes:  # on-disk format marker (kvs/version/)
     return b"/!vx"
 
 
+def mod_def(ns, db, name) -> bytes:  # DEFINE MODULE definition
+    return b"/!md" + enc_str(ns) + enc_str(db) + enc_str(name)
+
+
+def mod_prefix(ns, db) -> bytes:
+    return b"/!md" + enc_str(ns) + enc_str(db)
+
+
+def mod_blob(ns, db, name) -> bytes:  # module wasm payload
+    return b"/!mw" + enc_str(ns) + enc_str(db) + enc_str(name)
+
+
 def tb_idseq(ns, db) -> bytes:  # monotonic table-id allocator
     return b"/!ti" + enc_str(ns) + enc_str(db)
 

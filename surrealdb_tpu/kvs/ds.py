@@ -128,6 +128,7 @@ class Datastore:
         self.notifications: list[Notification] = []  # in-proc delivery queue
         self.notification_handlers: list = []  # callables(Notification)
         self.ml_cache: dict = {}  # (ns,db,name,version,hash) -> SurmlFile
+        self.module_cache: dict = {}  # (ns,db,name) -> (hash, wasm Instance)
         self.sequences: dict = {}
         self._hlc_wall = 0  # HLC: last physical millis issued
         self._hlc_count = 0  # HLC: logical counter within the millisecond

@@ -1,0 +1,199 @@
+"""surrealism — the WASM plugin subsystem (reference: surrealism/ guest
+SDK + wasmtime host runtime, core/src/surrealism/, gated behind
+`ExperimentalTarget::Surrealism` in dbs/capabilities.rs:123-126).
+
+Modules are stored per (ns, db) via `DEFINE MODULE mod::name AS <bytes>`
+and their exports run as `mod::name::fn(args)`. Execution uses the
+in-tree WASM MVP interpreter (surrealism/wasm.py) with fuel bounds in
+place of wasmtime's epoch timeouts, and host imports in place of the WIT
+host interface:
+
+    env.log(i64)              -> recorded on the datastore telemetry
+    env.mem_grow_hint(i32)    -> no-op (guest allocator hint)
+
+Value mapping at the boundary: SurrealQL ints/floats/bools map to the
+export's declared wasm param types (i32/i64/f32/f64); a single result
+maps back (i32/i64 -> int, f32/f64 -> float).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+from surrealdb_tpu.err import SdbError
+
+_SURLI_MAGIC = b"SURLITPU"
+
+
+class SurliModule:
+    """A packaged module: optional JSON header + wasm payload. Raw .wasm
+    bytes are accepted directly (fresh header)."""
+
+    def __init__(self, header: dict, wasm: bytes):
+        self.header = header
+        self.wasm = wasm
+
+    def to_bytes(self) -> bytes:
+        import json
+        import struct
+
+        h = json.dumps(self.header).encode()
+        return _SURLI_MAGIC + struct.pack("<I", len(h)) + h + self.wasm
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SurliModule":
+        import json
+        import struct
+
+        if data[:8] == _SURLI_MAGIC:
+            try:
+                (hlen,) = struct.unpack("<I", data[8:12])
+                header = json.loads(data[12:12 + hlen].decode())
+            except (struct.error, ValueError, UnicodeDecodeError) as e:
+                raise SdbError(f"invalid surli package: {e}")
+            return cls(header, data[12 + hlen:])
+        return cls({}, data)
+
+    @property
+    def hash(self) -> str:
+        return hashlib.sha256(self.to_bytes()).hexdigest()[:16]
+
+
+def _require_enabled(ctx):
+    caps = getattr(ctx.ds, "capabilities", None)
+    if caps is None or not caps.allows_experimental("surrealism"):
+        raise SdbError("Experimental capability `surrealism` is not enabled")
+
+
+def define_module(name: str, data: bytes, ctx, comment=None,
+                  if_not_exists=False, overwrite=False):
+    """Store a module (the DEFINE MODULE executor)."""
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.catalog import ModuleDef
+    from surrealdb_tpu.surrealism.wasm import Module, WasmTrap
+
+    _require_enabled(ctx)
+    ns, db = ctx.need_ns_db()
+    pkg = SurliModule.from_bytes(data)
+    try:
+        m = Module(pkg.wasm)  # validate NOW, not at first call
+    except (WasmTrap, IndexError, ValueError) as e:
+        raise SdbError(f"invalid module payload: {e}")
+    kdef = K.mod_def(ns, db, name)
+    if ctx.txn.get(kdef) is not None:
+        if if_not_exists:
+            return
+        if not overwrite and not getattr(ctx.executor, "import_mode",
+                                         False):
+            raise SdbError(f"The module 'mod::{name}' already exists")
+    exports = sorted(
+        n for n, (kind, _i) in m.exports.items() if kind == "func"
+    )
+    d = ModuleDef(name=name, comment=comment, hash=pkg.hash,
+                  exports=exports)
+    ctx.txn.set_val(kdef, d)
+    ctx.txn.set(K.mod_blob(ns, db, name), pkg.to_bytes())
+    # new definition invalidates any cached instance
+    ctx.ds.module_cache.pop((ns, db, name), None)
+
+
+def remove_module(name: str, ctx, if_exists=False):
+    from surrealdb_tpu import key as K
+
+    _require_enabled(ctx)
+    ns, db = ctx.need_ns_db()
+    if ctx.txn.get(K.mod_def(ns, db, name)) is None:
+        if if_exists:
+            return
+        raise SdbError(f"The module 'mod::{name}' does not exist")
+    ctx.txn.delete(K.mod_def(ns, db, name))
+    ctx.txn.delete(K.mod_blob(ns, db, name))
+    ctx.ds.module_cache.pop((ns, db, name), None)
+
+
+def _instance(name: str, ctx):
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.catalog import ModuleDef
+    from surrealdb_tpu.surrealism.wasm import Instance, Module
+
+    ns, db = ctx.need_ns_db()
+    mdef = ctx.txn.get_val(K.mod_def(ns, db, name))
+    if not isinstance(mdef, ModuleDef):
+        raise SdbError(f"The module 'mod::{name}' does not exist")
+    cache = ctx.ds.module_cache
+    hit = cache.get((ns, db, name))
+    if hit is not None and hit[0] == mdef.hash:
+        module = hit[1]
+    else:
+        raw = ctx.txn.get(K.mod_blob(ns, db, name))
+        if raw is None:
+            raise SdbError(f"The module 'mod::{name}' does not exist")
+        pkg = SurliModule.from_bytes(raw)
+        module = Module(pkg.wasm)
+        if len(cache) > 16:
+            cache.clear()
+        # cache only the immutable parsed Module (and its control-flow
+        # prescan); instances are mutable (memory/globals/fuel) and are
+        # created per call so concurrent threads and trapped calls can
+        # never see each other's state
+        cache[(ns, db, name)] = (mdef.hash, module)
+    tele = getattr(ctx.ds, "telemetry", None)
+
+    def host_log(v=0):
+        if tele is not None:
+            tele.counter("surrealism_log_calls")
+        return None
+
+    host = {
+        "env.log": host_log,
+        "env.mem_grow_hint": lambda v=0: None,
+    }
+    return Instance(module, host=host)
+
+
+def call_module(path: str, args: list, ctx):
+    """`mod::name::fn(args)` dispatch (reference core/src/surrealism
+    module function calls)."""
+    from decimal import Decimal
+
+    _require_enabled(ctx)
+    parts = path.split("::")
+    if len(parts) != 2:
+        raise SdbError(
+            f"Invalid module function path 'mod::{path}' — expected "
+            f"mod::module::function"
+        )
+    name, fn = parts
+    inst = _instance(name, ctx)
+    exp = inst.m.exports.get(fn)
+    if exp is None or exp[0] != "func":
+        raise SdbError(
+            f"The module 'mod::{name}' has no function '{fn}'"
+        )
+    ftype = inst._type_of(exp[1])
+    if len(args) != len(ftype.params):
+        raise SdbError(
+            f"Incorrect arguments for function mod::{path}(). The "
+            f"function expects {len(ftype.params)} arguments."
+        )
+    wargs = []
+    for a, vt in zip(args, ftype.params):
+        if isinstance(a, bool):
+            wargs.append(int(a))
+        elif isinstance(a, (int, float, Decimal)):
+            wargs.append(
+                float(a) if vt in (0x7D, 0x7C) else int(a)
+            )
+        else:
+            raise SdbError(
+                f"Incorrect arguments for function mod::{path}(). "
+                f"Module functions take numeric arguments."
+            )
+    out = inst.invoke_index(exp[1], wargs)
+    if not out:
+        from surrealdb_tpu.val import NONE
+
+        return NONE
+    v = out[0]
+    return float(v) if isinstance(v, float) else int(v)

@@ -1,0 +1,817 @@
+"""A self-contained WebAssembly MVP interpreter.
+
+The reference embeds wasmtime (surrealism/runtime/src/lib.rs) to run
+`.surli` guest modules. No WASM engine ships in this image, so the MVP
+instruction set is interpreted directly: binary module decoding (type/
+import/function/memory/global/export/code/data sections), a stack machine
+with structured control flow (block/loop/if, br/br_if/br_table), linear
+memory with load/store variants, i32/i64/f32/f64 arithmetic/comparison/
+conversion ops, and host imports. Execution is fuel-bounded — the
+reference uses wasmtime's epoch interruption for the same purpose.
+
+Out of scope (traps cleanly): SIMD, reference types, threads, multi-value
+block signatures beyond one result, floats NaN canonicalization details.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+from typing import Any, Callable, Optional
+
+from surrealdb_tpu.err import SdbError
+
+
+class WasmTrap(SdbError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# binary decoding
+# ---------------------------------------------------------------------------
+
+
+class _Reader:
+    __slots__ = ("b", "i")
+
+    def __init__(self, b: bytes, i: int = 0):
+        self.b = b
+        self.i = i
+
+    def u8(self) -> int:
+        v = self.b[self.i]
+        self.i += 1
+        return v
+
+    def bytes_(self, n: int) -> bytes:
+        v = self.b[self.i:self.i + n]
+        self.i += n
+        return v
+
+    def uleb(self) -> int:
+        out = shift = 0
+        while True:
+            byte = self.u8()
+            out |= (byte & 0x7F) << shift
+            if not byte & 0x80:
+                return out
+            shift += 7
+
+    def sleb(self, bits: int) -> int:
+        out = shift = 0
+        while True:
+            byte = self.u8()
+            out |= (byte & 0x7F) << shift
+            shift += 7
+            if not byte & 0x80:
+                if byte & 0x40 and shift < bits + 7:
+                    out |= -(1 << shift)
+                return out
+
+    def f32(self) -> float:
+        return struct.unpack("<f", self.bytes_(4))[0]
+
+    def f64(self) -> float:
+        return struct.unpack("<d", self.bytes_(8))[0]
+
+    def name(self) -> str:
+        return self.bytes_(self.uleb()).decode()
+
+    def eof(self) -> bool:
+        return self.i >= len(self.b)
+
+
+class FuncType:
+    __slots__ = ("params", "results")
+
+    def __init__(self, params, results):
+        self.params = params
+        self.results = results
+
+
+class Function:
+    __slots__ = ("type", "locals", "code", "name")
+
+    def __init__(self, type_, locals_, code, name=""):
+        self.type = type_
+        self.locals = locals_
+        self.code = code
+        self.name = name
+
+
+class Module:
+    def __init__(self, data: bytes):
+        if data[:4] != b"\x00asm":
+            raise WasmTrap("not a wasm module (bad magic)")
+        if struct.unpack("<I", data[4:8])[0] != 1:
+            raise WasmTrap("unsupported wasm version")
+        self.types: list[FuncType] = []
+        self.imports: list[tuple[str, str, int]] = []  # (mod, name, typeidx)
+        self.func_types: list[int] = []  # declared funcs' type indices
+        self.functions: list[Function] = []
+        self.exports: dict[str, tuple[str, int]] = {}
+        self.mem_min = 0
+        self.mem_max: Optional[int] = None
+        self.globals_init: list[tuple[int, Any, bool]] = []
+        self.data_segs: list[tuple[int, bytes]] = []
+        self.table_elems: dict[int, int] = {}
+        self.start: Optional[int] = None
+        self.jump_cache: dict = {}  # per-function pre-scanned control flow
+        self._decode(data)
+
+    def _decode(self, data: bytes):
+        r = _Reader(data, 8)
+        code_bodies: list[tuple[list, bytes]] = []
+        while not r.eof():
+            sec = r.u8()
+            size = r.uleb()
+            end = r.i + size
+            if sec == 1:  # type
+                for _ in range(r.uleb()):
+                    if r.u8() != 0x60:
+                        raise WasmTrap("bad functype")
+                    params = [r.u8() for _ in range(r.uleb())]
+                    results = [r.u8() for _ in range(r.uleb())]
+                    self.types.append(FuncType(params, results))
+            elif sec == 2:  # import
+                for _ in range(r.uleb()):
+                    mod, name = r.name(), r.name()
+                    kind = r.u8()
+                    if kind == 0:
+                        self.imports.append((mod, name, r.uleb()))
+                    elif kind == 2:  # memory import
+                        flags = r.u8()
+                        self.mem_min = r.uleb()
+                        if flags & 1:
+                            self.mem_max = r.uleb()
+                    else:
+                        raise WasmTrap(
+                            f"unsupported import kind {kind}"
+                        )
+            elif sec == 3:  # function
+                self.func_types = [r.uleb() for _ in range(r.uleb())]
+            elif sec == 4:  # table
+                for _ in range(r.uleb()):
+                    r.u8()  # elemtype
+                    flags = r.u8()
+                    r.uleb()
+                    if flags & 1:
+                        r.uleb()
+            elif sec == 5:  # memory
+                for _ in range(r.uleb()):
+                    flags = r.u8()
+                    self.mem_min = r.uleb()
+                    if flags & 1:
+                        self.mem_max = r.uleb()
+            elif sec == 6:  # global
+                for _ in range(r.uleb()):
+                    vt = r.u8()
+                    mut = r.u8()
+                    val = self._const_expr(r)
+                    self.globals_init.append((vt, val, bool(mut)))
+            elif sec == 7:  # export
+                for _ in range(r.uleb()):
+                    name = r.name()
+                    kind = r.u8()
+                    idx = r.uleb()
+                    kinds = {0: "func", 1: "table", 2: "mem", 3: "global"}
+                    self.exports[name] = (kinds.get(kind, "?"), idx)
+            elif sec == 8:  # start
+                self.start = r.uleb()
+            elif sec == 9:  # element
+                for _ in range(r.uleb()):
+                    flags = r.uleb()
+                    if flags != 0:
+                        raise WasmTrap("unsupported element segment")
+                    off = self._const_expr(r)
+                    for j in range(r.uleb()):
+                        self.table_elems[off + j] = r.uleb()
+            elif sec == 10:  # code
+                for _ in range(r.uleb()):
+                    bsize = r.uleb()
+                    bend = r.i + bsize
+                    locals_ = []
+                    for _ in range(r.uleb()):
+                        n = r.uleb()
+                        vt = r.u8()
+                        locals_.extend([vt] * n)
+                    code_bodies.append((locals_, r.bytes_(bend - r.i)))
+            elif sec == 11:  # data
+                for _ in range(r.uleb()):
+                    midx = r.uleb()
+                    if midx != 0:
+                        raise WasmTrap("multi-memory unsupported")
+                    off = self._const_expr(r)
+                    self.data_segs.append((off, r.bytes_(r.uleb())))
+            r.i = end
+        for i, (locals_, body) in enumerate(code_bodies):
+            t = self.types[self.func_types[i]]
+            self.functions.append(Function(t, locals_, body))
+
+    def _const_expr(self, r: _Reader):
+        op = r.u8()
+        if op == 0x41:
+            v = r.sleb(32)
+        elif op == 0x42:
+            v = r.sleb(64)
+        elif op == 0x43:
+            v = r.f32()
+        elif op == 0x44:
+            v = r.f64()
+        else:
+            raise WasmTrap(f"unsupported const opcode {op:#x}")
+        if r.u8() != 0x0B:
+            raise WasmTrap("expected end in const expr")
+        return v
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+_PAGE = 65536
+_M32 = (1 << 32) - 1
+_M64 = (1 << 64) - 1
+
+
+def _i32(v: int) -> int:
+    v &= _M32
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+def _i64(v: int) -> int:
+    v &= _M64
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+class _Label:
+    __slots__ = ("arity", "target", "stack_height", "is_loop")
+
+    def __init__(self, arity, target, stack_height, is_loop):
+        self.arity = arity
+        self.target = target
+        self.stack_height = stack_height
+        self.is_loop = is_loop
+
+
+class Instance:
+    """An instantiated module: memory, globals, host imports."""
+
+    def __init__(self, module: Module,
+                 host: Optional[dict[str, Callable]] = None,
+                 fuel: int = 50_000_000, max_pages: int = 256):
+        self.m = module
+        self.host = host or {}
+        self.fuel = fuel
+        self.max_pages = min(max_pages, module.mem_max or max_pages)
+        self.mem = bytearray(_PAGE * module.mem_min)
+        self.globals = [v for _t, v, _m in module.globals_init]
+        for off, seg in module.data_segs:
+            need = off + len(seg)
+            if need > len(self.mem):
+                self._grow_to(need)
+            self.mem[off:off + len(seg)] = seg
+        self.n_imports = len(module.imports)
+        if module.start is not None:
+            self.invoke_index(module.start, [])
+
+    # -- memory -------------------------------------------------------------
+    def _grow_to(self, need: int):
+        pages = (need + _PAGE - 1) // _PAGE
+        if pages > self.max_pages:
+            raise WasmTrap("out of bounds memory growth")
+        self.mem.extend(b"\x00" * (pages * _PAGE - len(self.mem)))
+
+    def _load(self, addr: int, n: int) -> bytes:
+        if addr < 0 or addr + n > len(self.mem):
+            raise WasmTrap("out of bounds memory access")
+        return bytes(self.mem[addr:addr + n])
+
+    def _store(self, addr: int, data: bytes):
+        if addr < 0 or addr + len(data) > len(self.mem):
+            raise WasmTrap("out of bounds memory access")
+        self.mem[addr:addr + len(data)] = data
+
+    # -- calls --------------------------------------------------------------
+    def invoke(self, name: str, args: list):
+        exp = self.m.exports.get(name)
+        if exp is None or exp[0] != "func":
+            raise WasmTrap(f"no exported function '{name}'")
+        return self.invoke_index(exp[1], args)
+
+    def invoke_index(self, fidx: int, args: list):
+        if fidx < self.n_imports:
+            mod, name, tidx = self.m.imports[fidx]
+            fn = self.host.get(f"{mod}.{name}")
+            if fn is None:
+                raise WasmTrap(f"missing host import {mod}.{name}")
+            out = fn(*args)
+            return [] if out is None else [out]
+        f = self.m.functions[fidx - self.n_imports]
+        frame_locals = list(args) + [
+            0.0 if vt in (0x7D, 0x7C) else 0 for vt in f.locals
+        ]
+        return self._exec(f, frame_locals)
+
+    # -- the interpreter loop ----------------------------------------------
+    def _exec(self, f: Function, locals_: list):
+        code = f.code
+        jumps = self._scan_jumps(f)
+        stack: list = []
+        labels: list[_Label] = [
+            _Label(len(f.type.results), len(code), 0, False)
+        ]
+        ip = 0
+        mem = self
+
+        def branch(depth: int):
+            nonlocal ip
+            lab = labels[-1 - depth]
+            vals = stack[len(stack) - lab.arity:] if lab.arity else []
+            del labels[len(labels) - depth - 1:]
+            del stack[lab.stack_height:]
+            stack.extend(vals)
+            if lab.is_loop:
+                labels.append(lab)
+                ip = lab.target
+            else:
+                ip = lab.target
+
+        while ip < len(code):
+            self.fuel -= 1
+            if self.fuel <= 0:
+                raise WasmTrap("fuel exhausted (module ran too long)")
+            op = code[ip]
+            ip += 1
+            if op == 0x00:  # unreachable
+                raise WasmTrap("unreachable executed")
+            elif op == 0x01:  # nop
+                pass
+            elif op in (0x02, 0x03):  # block / loop
+                bt, nip = jumps["bt"][ip - 1]
+                arity = 0 if bt == 0x40 else 1
+                end = jumps["end"][ip - 1]
+                if op == 0x03:  # loop: branch target is the loop head
+                    labels.append(_Label(0, ip - 1 + jumps["hdr"][ip - 1],
+                                         len(stack), True))
+                else:
+                    labels.append(_Label(arity, end, len(stack), False))
+                ip = nip
+            elif op == 0x04:  # if
+                bt, nip = jumps["bt"][ip - 1]
+                arity = 0 if bt == 0x40 else 1
+                end = jumps["end"][ip - 1]
+                els = jumps["else"].get(ip - 1)
+                cond = stack.pop()
+                if cond:
+                    labels.append(_Label(arity, end, len(stack), False))
+                    ip = nip
+                elif els is not None:
+                    labels.append(_Label(arity, end, len(stack), False))
+                    ip = els
+                else:
+                    ip = end  # no else-arm: skip past end, no label
+            elif op == 0x05:  # else — reached after the then-arm ran
+                lab = labels.pop()
+                ip = lab.target
+            elif op == 0x0B:  # end
+                if len(labels) > 1:
+                    lab = labels.pop()
+                    if lab.is_loop and lab.target >= ip:
+                        pass
+                else:
+                    break
+            elif op == 0x0C:  # br
+                branch(_Reader(code, ip).uleb())
+                continue
+            elif op == 0x0D:  # br_if
+                r = _Reader(code, ip)
+                depth = r.uleb()
+                ip = r.i
+                if stack.pop():
+                    branch(depth)
+                    continue
+            elif op == 0x0E:  # br_table
+                r = _Reader(code, ip)
+                n = r.uleb()
+                targets = [r.uleb() for _ in range(n)]
+                default = r.uleb()
+                ip = r.i
+                k = stack.pop()
+                branch(targets[k] if 0 <= k < n else default)
+                continue
+            elif op == 0x0F:  # return
+                res = stack[len(stack) - len(f.type.results):] \
+                    if f.type.results else []
+                return res
+            elif op == 0x10:  # call
+                r = _Reader(code, ip)
+                fidx = r.uleb()
+                ip = r.i
+                ft = self._type_of(fidx)
+                nargs = len(ft.params)
+                args = stack[len(stack) - nargs:] if nargs else []
+                del stack[len(stack) - nargs:]
+                stack.extend(self.invoke_index(fidx, args))
+            elif op == 0x11:  # call_indirect
+                r = _Reader(code, ip)
+                tidx = r.uleb()
+                r.uleb()  # table idx
+                ip = r.i
+                elem = stack.pop()
+                fidx = self.m.table_elems.get(elem)
+                if fidx is None:
+                    raise WasmTrap("undefined table element")
+                ft = self.m.types[tidx]
+                nargs = len(ft.params)
+                args = stack[len(stack) - nargs:] if nargs else []
+                del stack[len(stack) - nargs:]
+                stack.extend(self.invoke_index(fidx, args))
+            elif op == 0x1A:  # drop
+                stack.pop()
+            elif op == 0x1B:  # select
+                c = stack.pop()
+                b2 = stack.pop()
+                a2 = stack.pop()
+                stack.append(a2 if c else b2)
+            elif op == 0x20:  # local.get
+                r = _Reader(code, ip)
+                stack.append(locals_[r.uleb()])
+                ip = r.i
+            elif op == 0x21:  # local.set
+                r = _Reader(code, ip)
+                locals_[r.uleb()] = stack.pop()
+                ip = r.i
+            elif op == 0x22:  # local.tee
+                r = _Reader(code, ip)
+                locals_[r.uleb()] = stack[-1]
+                ip = r.i
+            elif op == 0x23:  # global.get
+                r = _Reader(code, ip)
+                stack.append(self.globals[r.uleb()])
+                ip = r.i
+            elif op == 0x24:  # global.set
+                r = _Reader(code, ip)
+                self.globals[r.uleb()] = stack.pop()
+                ip = r.i
+            elif 0x28 <= op <= 0x3E:  # loads/stores
+                r = _Reader(code, ip)
+                r.uleb()  # align
+                offset = r.uleb()
+                ip = r.i
+                if op <= 0x35:  # load
+                    addr = stack.pop() + offset
+                    spec = _LOADS[op]
+                    raw = self._load(addr, spec[0])
+                    stack.append(spec[1](raw))
+                else:  # store
+                    val = stack.pop()
+                    addr = stack.pop() + offset
+                    self._store(addr, _STORES[op](val))
+            elif op == 0x3F:  # memory.size
+                ip += 1
+                stack.append(len(self.mem) // _PAGE)
+            elif op == 0x40:  # memory.grow
+                ip += 1
+                delta = stack.pop()
+                cur = len(self.mem) // _PAGE
+                if cur + delta > self.max_pages:
+                    stack.append(-1)
+                else:
+                    self.mem.extend(b"\x00" * (delta * _PAGE))
+                    stack.append(cur)
+            elif op == 0x41:  # i32.const
+                r = _Reader(code, ip)
+                stack.append(_i32(r.sleb(32)))
+                ip = r.i
+            elif op == 0x42:  # i64.const
+                r = _Reader(code, ip)
+                stack.append(_i64(r.sleb(64)))
+                ip = r.i
+            elif op == 0x43:
+                stack.append(struct.unpack("<f", code[ip:ip + 4])[0])
+                ip += 4
+            elif op == 0x44:
+                stack.append(struct.unpack("<d", code[ip:ip + 8])[0])
+                ip += 8
+            elif op in _NUMOPS:
+                _NUMOPS[op](stack)
+            else:
+                raise WasmTrap(f"unsupported opcode {op:#x}")
+        return stack[len(stack) - len(f.type.results):] \
+            if f.type.results else []
+
+    def _type_of(self, fidx: int) -> FuncType:
+        if fidx < self.n_imports:
+            return self.m.types[self.m.imports[fidx][2]]
+        return self.m.types[self.m.func_types[fidx - self.n_imports]]
+
+    def _scan_jumps(self, f: Function) -> dict:
+        """Pre-scan a body: for each block/loop/if opcode position, the
+        matching end (position AFTER its end opcode), the else position,
+        and the instruction stream skip for the blocktype byte."""
+        key = id(f)
+        hit = self.m.jump_cache.get(key)
+        if hit is not None:
+            return hit
+        code = f.code
+        bt: dict[int, tuple] = {}
+        endm: dict[int, int] = {}
+        elsem: dict[int, int] = {}
+        hdr: dict[int, int] = {}
+        stack = []
+        i = 0
+        n = len(code)
+        while i < n:
+            op = code[i]
+            start = i
+            i += 1
+            if op in (0x02, 0x03, 0x04):
+                blocktype = code[i]
+                i += 1
+                bt[start] = (blocktype, i)
+                hdr[start] = i - start
+                stack.append(start)
+            elif op == 0x05:
+                if stack:
+                    elsem[stack[-1]] = i
+            elif op == 0x0B:
+                if stack:
+                    opener = stack.pop()
+                    endm[opener] = i
+            elif op in (0x0C, 0x0D, 0x10):
+                r = _Reader(code, i)
+                r.uleb()
+                i = r.i
+            elif op == 0x11:
+                r = _Reader(code, i)
+                r.uleb()
+                r.uleb()
+                i = r.i
+            elif op == 0x0E:
+                r = _Reader(code, i)
+                cnt = r.uleb()
+                for _ in range(cnt):
+                    r.uleb()
+                r.uleb()
+                i = r.i
+            elif 0x20 <= op <= 0x24:
+                r = _Reader(code, i)
+                r.uleb()
+                i = r.i
+            elif 0x28 <= op <= 0x3E:
+                r = _Reader(code, i)
+                r.uleb()
+                r.uleb()
+                i = r.i
+            elif op in (0x3F, 0x40):
+                i += 1
+            elif op == 0x41:
+                r = _Reader(code, i)
+                r.sleb(32)
+                i = r.i
+            elif op == 0x42:
+                r = _Reader(code, i)
+                r.sleb(64)
+                i = r.i
+            elif op == 0x43:
+                i += 4
+            elif op == 0x44:
+                i += 8
+        out = {"bt": bt, "end": endm, "else": elsem, "hdr": hdr}
+        self.m.jump_cache[key] = out
+        return out
+
+
+# load specs: opcode -> (nbytes, bytes->value)
+_LOADS = {
+    0x28: (4, lambda b: _i32(int.from_bytes(b, "little"))),
+    0x29: (8, lambda b: _i64(int.from_bytes(b, "little"))),
+    0x2A: (4, lambda b: struct.unpack("<f", b)[0]),
+    0x2B: (8, lambda b: struct.unpack("<d", b)[0]),
+    0x2C: (1, lambda b: _i32(b[0] - 256 if b[0] >= 128 else b[0])),
+    0x2D: (1, lambda b: b[0]),
+    0x2E: (2, lambda b: _i32(int.from_bytes(b, "little", signed=True))),
+    0x2F: (2, lambda b: int.from_bytes(b, "little")),
+    0x30: (1, lambda b: _i64(b[0] - 256 if b[0] >= 128 else b[0])),
+    0x31: (1, lambda b: b[0]),
+    0x32: (2, lambda b: _i64(int.from_bytes(b, "little", signed=True))),
+    0x33: (2, lambda b: int.from_bytes(b, "little")),
+    0x34: (4, lambda b: _i64(int.from_bytes(b, "little", signed=True))),
+    0x35: (4, lambda b: int.from_bytes(b, "little")),
+}
+
+_STORES = {
+    0x36: lambda v: (v & _M32).to_bytes(4, "little"),
+    0x37: lambda v: (v & _M64).to_bytes(8, "little"),
+    0x38: lambda v: struct.pack("<f", v),
+    0x39: lambda v: struct.pack("<d", v),
+    0x3A: lambda v: (v & 0xFF).to_bytes(1, "little"),
+    0x3B: lambda v: (v & 0xFFFF).to_bytes(2, "little"),
+    0x3C: lambda v: (v & 0xFF).to_bytes(1, "little"),
+    0x3D: lambda v: (v & 0xFFFF).to_bytes(2, "little"),
+    0x3E: lambda v: (v & _M32).to_bytes(4, "little"),
+}
+
+
+def _binop(fn):
+    def run(stack):
+        b = stack.pop()
+        a = stack.pop()
+        stack.append(fn(a, b))
+
+    return run
+
+
+def _unop(fn):
+    def run(stack):
+        stack.append(fn(stack.pop()))
+
+    return run
+
+
+def _divs(a, b):
+    if b == 0:
+        raise WasmTrap("integer divide by zero")
+    q = abs(a) // abs(b)
+    return q if (a >= 0) == (b >= 0) else -q
+
+
+def _rems(a, b):
+    if b == 0:
+        raise WasmTrap("integer divide by zero")
+    r = abs(a) % abs(b)
+    return r if a >= 0 else -r
+
+
+def _divu(a, b, mask):
+    if b == 0:
+        raise WasmTrap("integer divide by zero")
+    return (a & mask) // (b & mask)
+
+
+def _remu(a, b, mask):
+    if b == 0:
+        raise WasmTrap("integer divide by zero")
+    return (a & mask) % (b & mask)
+
+
+def _rotl(v, n, bits, mask):
+    n %= bits
+    v &= mask
+    return ((v << n) | (v >> (bits - n))) & mask
+
+
+def _clz(v, bits):
+    v &= (1 << bits) - 1
+    if v == 0:
+        return bits
+    return bits - v.bit_length()
+
+
+def _ctz(v, bits):
+    v &= (1 << bits) - 1
+    if v == 0:
+        return bits
+    return (v & -v).bit_length() - 1
+
+
+def _trunc(v):
+    if math.isnan(v) or math.isinf(v):
+        raise WasmTrap("invalid conversion to integer")
+    return math.trunc(v)
+
+
+_NUMOPS = {
+    # i32 compare
+    0x45: _unop(lambda a: int(a == 0)),
+    0x46: _binop(lambda a, b: int(_i32(a) == _i32(b))),
+    0x47: _binop(lambda a, b: int(_i32(a) != _i32(b))),
+    0x48: _binop(lambda a, b: int(_i32(a) < _i32(b))),
+    0x49: _binop(lambda a, b: int((a & _M32) < (b & _M32))),
+    0x4A: _binop(lambda a, b: int(_i32(a) > _i32(b))),
+    0x4B: _binop(lambda a, b: int((a & _M32) > (b & _M32))),
+    0x4C: _binop(lambda a, b: int(_i32(a) <= _i32(b))),
+    0x4D: _binop(lambda a, b: int((a & _M32) <= (b & _M32))),
+    0x4E: _binop(lambda a, b: int(_i32(a) >= _i32(b))),
+    0x4F: _binop(lambda a, b: int((a & _M32) >= (b & _M32))),
+    # i64 compare
+    0x50: _unop(lambda a: int(a == 0)),
+    0x51: _binop(lambda a, b: int(_i64(a) == _i64(b))),
+    0x52: _binop(lambda a, b: int(_i64(a) != _i64(b))),
+    0x53: _binop(lambda a, b: int(_i64(a) < _i64(b))),
+    0x54: _binop(lambda a, b: int((a & _M64) < (b & _M64))),
+    0x55: _binop(lambda a, b: int(_i64(a) > _i64(b))),
+    0x56: _binop(lambda a, b: int((a & _M64) > (b & _M64))),
+    0x57: _binop(lambda a, b: int(_i64(a) <= _i64(b))),
+    0x58: _binop(lambda a, b: int((a & _M64) <= (b & _M64))),
+    0x59: _binop(lambda a, b: int(_i64(a) >= _i64(b))),
+    0x5A: _binop(lambda a, b: int((a & _M64) >= (b & _M64))),
+    # f32/f64 compare (same python semantics)
+    0x5B: _binop(lambda a, b: int(a == b)),
+    0x5C: _binop(lambda a, b: int(a != b)),
+    0x5D: _binop(lambda a, b: int(a < b)),
+    0x5E: _binop(lambda a, b: int(a > b)),
+    0x5F: _binop(lambda a, b: int(a <= b)),
+    0x60: _binop(lambda a, b: int(a >= b)),
+    0x61: _binop(lambda a, b: int(a == b)),
+    0x62: _binop(lambda a, b: int(a != b)),
+    0x63: _binop(lambda a, b: int(a < b)),
+    0x64: _binop(lambda a, b: int(a > b)),
+    0x65: _binop(lambda a, b: int(a <= b)),
+    0x66: _binop(lambda a, b: int(a >= b)),
+    # i32 arithmetic
+    0x67: _unop(lambda a: _clz(a, 32)),
+    0x68: _unop(lambda a: _ctz(a, 32)),
+    0x69: _unop(lambda a: bin(a & _M32).count("1")),
+    0x6A: _binop(lambda a, b: _i32(a + b)),
+    0x6B: _binop(lambda a, b: _i32(a - b)),
+    0x6C: _binop(lambda a, b: _i32(a * b)),
+    0x6D: _binop(lambda a, b: _i32(_divs(_i32(a), _i32(b)))),
+    0x6E: _binop(lambda a, b: _i32(_divu(a, b, _M32))),
+    0x6F: _binop(lambda a, b: _i32(_rems(_i32(a), _i32(b)))),
+    0x70: _binop(lambda a, b: _i32(_remu(a, b, _M32))),
+    0x71: _binop(lambda a, b: _i32(a & b)),
+    0x72: _binop(lambda a, b: _i32(a | b)),
+    0x73: _binop(lambda a, b: _i32(a ^ b)),
+    0x74: _binop(lambda a, b: _i32((a & _M32) << (b % 32))),
+    0x75: _binop(lambda a, b: _i32(_i32(a) >> (b % 32))),
+    0x76: _binop(lambda a, b: _i32((a & _M32) >> (b % 32))),
+    0x77: _binop(lambda a, b: _i32(_rotl(a, b, 32, _M32))),
+    0x78: _binop(lambda a, b: _i32(_rotl(a, -b, 32, _M32))),
+    # i64 arithmetic
+    0x79: _unop(lambda a: _clz(a, 64)),
+    0x7A: _unop(lambda a: _ctz(a, 64)),
+    0x7B: _unop(lambda a: bin(a & _M64).count("1")),
+    0x7C: _binop(lambda a, b: _i64(a + b)),
+    0x7D: _binop(lambda a, b: _i64(a - b)),
+    0x7E: _binop(lambda a, b: _i64(a * b)),
+    0x7F: _binop(lambda a, b: _i64(_divs(_i64(a), _i64(b)))),
+    0x80: _binop(lambda a, b: _i64(_divu(a, b, _M64))),
+    0x81: _binop(lambda a, b: _i64(_rems(_i64(a), _i64(b)))),
+    0x82: _binop(lambda a, b: _i64(_remu(a, b, _M64))),
+    0x83: _binop(lambda a, b: _i64(a & b)),
+    0x84: _binop(lambda a, b: _i64(a | b)),
+    0x85: _binop(lambda a, b: _i64(a ^ b)),
+    0x86: _binop(lambda a, b: _i64((a & _M64) << (b % 64))),
+    0x87: _binop(lambda a, b: _i64(_i64(a) >> (b % 64))),
+    0x88: _binop(lambda a, b: _i64((a & _M64) >> (b % 64))),
+    0x89: _binop(lambda a, b: _i64(_rotl(a, b, 64, _M64))),
+    0x8A: _binop(lambda a, b: _i64(_rotl(a, -b, 64, _M64))),
+    # f32/f64 arithmetic (python floats throughout)
+    0x8B: _unop(abs), 0x8C: _unop(lambda a: -a),
+    0x8D: _unop(lambda a: float(math.ceil(a))),
+    0x8E: _unop(lambda a: float(math.floor(a))),
+    0x8F: _unop(lambda a: float(math.trunc(a))),
+    0x90: _unop(lambda a: float(round(a))),
+    0x91: _unop(math.sqrt),
+    0x92: _binop(lambda a, b: a + b), 0x93: _binop(lambda a, b: a - b),
+    0x94: _binop(lambda a, b: a * b),
+    0x95: _binop(lambda a, b: a / b if b else math.copysign(
+        math.inf, a) * math.copysign(1, b) if a else math.nan),
+    0x96: _binop(min), 0x97: _binop(max),
+    0x98: _binop(math.copysign),
+    0x99: _unop(abs), 0x9A: _unop(lambda a: -a),
+    0x9B: _unop(lambda a: float(math.ceil(a))),
+    0x9C: _unop(lambda a: float(math.floor(a))),
+    0x9D: _unop(lambda a: float(math.trunc(a))),
+    0x9E: _unop(lambda a: float(round(a))),
+    0x9F: _unop(math.sqrt),
+    0xA0: _binop(lambda a, b: a + b), 0xA1: _binop(lambda a, b: a - b),
+    0xA2: _binop(lambda a, b: a * b),
+    0xA3: _binop(lambda a, b: a / b if b else math.copysign(
+        math.inf, a) * math.copysign(1, b) if a else math.nan),
+    0xA4: _binop(min), 0xA5: _binop(max),
+    0xA6: _binop(math.copysign),
+    # conversions
+    0xA7: _unop(lambda a: _i32(a)),            # i32.wrap_i64
+    0xA8: _unop(lambda a: _i32(_trunc(a))),    # i32.trunc_f32_s
+    0xA9: _unop(lambda a: _i32(_trunc(a))),
+    0xAA: _unop(lambda a: _i32(_trunc(a))),
+    0xAB: _unop(lambda a: _i32(_trunc(a))),
+    0xAC: _unop(lambda a: _i64(_i32(a))),      # i64.extend_i32_s
+    0xAD: _unop(lambda a: a & _M32),           # i64.extend_i32_u
+    0xAE: _unop(lambda a: _i64(_trunc(a))),
+    0xAF: _unop(lambda a: _i64(_trunc(a))),
+    0xB0: _unop(lambda a: _i64(_trunc(a))),
+    0xB1: _unop(lambda a: _i64(_trunc(a))),
+    0xB2: _unop(lambda a: float(_i32(a))),     # f32.convert_i32_s
+    0xB3: _unop(lambda a: float(a & _M32)),
+    0xB4: _unop(lambda a: float(_i64(a))),
+    0xB5: _unop(lambda a: float(a & _M64)),
+    0xB6: _unop(lambda a: struct.unpack(
+        "<f", struct.pack("<f", a))[0]),        # f32.demote_f64
+    0xB7: _unop(lambda a: float(_i32(a))),
+    0xB8: _unop(lambda a: float(a & _M32)),
+    0xB9: _unop(lambda a: float(_i64(a))),
+    0xBA: _unop(lambda a: float(a & _M64)),
+    0xBB: _unop(lambda a: a),                  # f64.promote_f32
+    0xBC: _unop(lambda a: _i32(struct.unpack(
+        "<I", struct.pack("<f", a))[0])),       # i32.reinterpret_f32
+    0xBD: _unop(lambda a: _i64(struct.unpack(
+        "<Q", struct.pack("<d", a))[0])),
+    0xBE: _unop(lambda a: struct.unpack(
+        "<f", struct.pack("<I", a & _M32))[0]),
+    0xBF: _unop(lambda a: struct.unpack(
+        "<d", struct.pack("<Q", a & _M64))[0]),
+}

@@ -963,6 +963,8 @@ class Parser:
             return self._define_user()
         if self.eat_kw("access"):
             return self._define_access()
+        if self.eat_kw("module"):
+            return self._define_module()
         if self.eat_kw("sequence"):
             ine, ow = self._def_flags()
             name = self.name_expr()
@@ -1543,6 +1545,24 @@ class Parser:
                 break
         return d
 
+    def _define_module(self):
+        """DEFINE MODULE [IF NOT EXISTS|OVERWRITE] [mod::name AS] <bytes>
+        (reference sql/statements/define/module.rs)."""
+        ine, ow = self._def_flags()
+        name = None
+        t = self.peek()
+        if t.kind == L.IDENT and t.value.lower() == "mod" and \
+                self.peek(1).kind == L.OP and self.peek(1).text == "::":
+            self.next()
+            self.expect_op("::")
+            name = self.ident()
+            self.expect_kw("as")
+        execu = self.parse_expr()
+        comment = None
+        if self.eat_kw("comment"):
+            comment = self._comment_value()
+        return DefineModule(name, execu, comment, ine, ow)
+
     def _define_access(self):
         ine, ow = self._def_flags()
         name = self.name_expr()
@@ -1706,6 +1726,11 @@ class Parser:
             if self.at_op("("):  # optional trailing () in REMOVE FUNCTION
                 self.next()
                 self.expect_op(")")
+        elif kind == "module":
+            # REMOVE MODULE [mod::]name
+            name = self.ident()
+            if name.lower() == "mod" and self.eat_op("::"):
+                name = self.ident()
         elif kind == "param":
             t = self.next()
             name = t.value

@@ -1,0 +1,155 @@
+"""surrealism WASM plugin subsystem: the MVP interpreter, DEFINE MODULE,
+mod:: calls, capability gating (reference surrealism/ + wasmtime host;
+this build interprets WASM directly)."""
+
+import struct
+
+import pytest
+
+from surrealdb_tpu import Datastore as _Datastore
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.surrealism.wasm import Instance, Module, WasmTrap
+
+
+def Datastore(path="memory"):
+    ds = _Datastore(path)
+    ds.capabilities.allow_experimental.names.add("surrealism")
+    return ds
+
+
+# -- tiny wasm assembler -----------------------------------------------------
+
+def _uleb(n):
+    out = b""
+    while True:
+        b_ = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b_ | 0x80])
+        else:
+            return out + bytes([b_])
+
+
+def _sec(sid, payload):
+    return bytes([sid]) + _uleb(len(payload)) + payload
+
+
+def _vec(items):
+    return _uleb(len(items)) + b"".join(items)
+
+
+def _functype(params, results):
+    return b"\x60" + _vec(params) + _vec(results)
+
+
+def _export(name, kind, idx):
+    return _uleb(len(name)) + name.encode() + bytes([kind]) + _uleb(idx)
+
+
+def _code(body, locals_=b""):
+    payload = (locals_ or _vec([])) + body
+    return _uleb(len(payload)) + payload
+
+
+def build_math_module() -> bytes:
+    """exports: add(i64,i64)->i64, fib(i32)->i32, mulf(f64,f64)->f64,
+    loop_sum(i32)->i32 (1+2+..+n via a loop)."""
+    types = _sec(1, _vec([
+        _functype([b"\x7e", b"\x7e"], [b"\x7e"]),  # 0: (i64,i64)->i64
+        _functype([b"\x7f"], [b"\x7f"]),           # 1: (i32)->i32
+        _functype([b"\x7c", b"\x7c"], [b"\x7c"]),  # 2: (f64,f64)->f64
+    ]))
+    funcs = _sec(3, _vec([_uleb(0), _uleb(1), _uleb(2), _uleb(1)]))
+    exports = _sec(7, _vec([
+        _export("add", 0, 0), _export("fib", 0, 1),
+        _export("mulf", 0, 2), _export("loop_sum", 0, 3),
+    ]))
+    add = _code(b"\x20\x00\x20\x01\x7c\x0b")
+    fib = _code(
+        b"\x20\x00\x41\x02\x48"      # n < 2 ?
+        b"\x04\x7f\x20\x00"          # if -> n
+        b"\x05"
+        b"\x20\x00\x41\x01\x6b\x10\x01"  # fib(n-1)
+        b"\x20\x00\x41\x02\x6b\x10\x01"  # fib(n-2)
+        b"\x6a\x0b\x0b"
+    )
+    mulf = _code(b"\x20\x00\x20\x01\xa2\x0b")
+    # loop_sum: locals [i i32, acc i32]
+    loop_sum = _code(
+        b"\x02\x40"                  # block
+        b"\x03\x40"                  # loop
+        b"\x20\x01\x20\x00\x4a"      # i > n ?
+        b"\x0d\x01"                  # br_if 1 (exit block)
+        b"\x20\x02\x20\x01\x6a\x21\x02"  # acc += i
+        b"\x20\x01\x41\x01\x6a\x21\x01"  # i += 1
+        b"\x0c\x00"                  # br 0 (continue loop)
+        b"\x0b\x0b"                  # end loop, end block
+        b"\x20\x02\x0b",             # return acc
+        locals_=_vec([_uleb(2) + b"\x7f"]),
+    )
+    # adjust loop_sum: i starts at 1
+    loop_sum = _code(
+        b"\x41\x01\x21\x01"          # i = 1
+        b"\x02\x40\x03\x40"
+        b"\x20\x01\x20\x00\x4a"
+        b"\x0d\x01"
+        b"\x20\x02\x20\x01\x6a\x21\x02"
+        b"\x20\x01\x41\x01\x6a\x21\x01"
+        b"\x0c\x00\x0b\x0b"
+        b"\x20\x02\x0b",
+        locals_=_vec([_uleb(2) + b"\x7f"]),
+    )
+    code = _sec(10, _vec([add, fib, mulf, loop_sum]))
+    return b"\x00asm" + struct.pack("<I", 1) + types + funcs + exports + code
+
+
+def test_interpreter_core():
+    m = Module(build_math_module())
+    inst = Instance(m)
+    assert inst.invoke("add", [40, 2]) == [42]
+    assert inst.invoke("fib", [15]) == [610]
+    assert inst.invoke("mulf", [2.5, 4.0]) == [10.0]
+    assert inst.invoke("loop_sum", [100]) == [5050]
+
+
+def test_interpreter_fuel_bound():
+    m = Module(build_math_module())
+    inst = Instance(m, fuel=1000)
+    with pytest.raises(WasmTrap, match="fuel"):
+        inst.invoke("fib", [30])
+
+
+def test_define_module_and_call():
+    ds = Datastore()
+    wasm = build_math_module()
+    ds.execute("DEFINE MODULE mod::math AS $m", ns="t", db="t",
+               vars={"m": wasm})
+    q = lambda s: ds.query(s, ns="t", db="t")
+    assert q("RETURN mod::math::add(40, 2)")[0] == 42
+    assert q("RETURN mod::math::fib(10)")[0] == 55
+    assert q("RETURN mod::math::mulf(3.0, 0.5)")[0] == 1.5
+    assert q("RETURN mod::math::loop_sum(10)")[0] == 55
+    info = q("INFO FOR DB")[0]
+    assert "math" in info["modules"]
+    # unknown function / module errors
+    r = ds.execute("RETURN mod::math::nope(1)", ns="t", db="t")[0]
+    assert "no function" in r.error
+    r = ds.execute("RETURN mod::none::f(1)", ns="t", db="t")[0]
+    assert "does not exist" in r.error
+    # remove
+    ds.execute("REMOVE MODULE mod::math", ns="t", db="t")
+    r = ds.execute("RETURN mod::math::add(1, 2)", ns="t", db="t")[0]
+    assert "does not exist" in r.error
+
+
+def test_surrealism_capability_gate():
+    ds = _Datastore("memory")  # experimental NOT enabled
+    r = ds.execute("RETURN mod::math::add(1, 2)", ns="t", db="t")[0]
+    assert "surrealism" in r.error and "not enabled" in r.error
+
+
+def test_invalid_module_rejected():
+    ds = Datastore()
+    r = ds.execute("DEFINE MODULE mod::bad AS $m", ns="t", db="t",
+                   vars={"m": b"not wasm"})[0]
+    assert "invalid module payload" in r.error
