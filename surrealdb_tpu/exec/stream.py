@@ -107,6 +107,74 @@ class Operator:
 # ---------------------------------------------------------------------------
 
 
+def _vector_pred(cond):
+    """Compile an AND-tree of `field OP number` comparisons into
+    (fields, fn(cols)->mask) for numpy evaluation — the columnar fast
+    path the reference gets from its ValueBatch layout. Returns None for
+    anything richer (evaluated row-wise). Only applies to batches whose
+    values are ALL plain numbers: SurrealQL comparisons are type-ordered
+    (strings sort after numbers), so mixed batches fall back."""
+    from surrealdb_tpu.expr.ast import Binary, Idiom, Literal, PField
+
+    terms = []
+
+    def walk(c):
+        if isinstance(c, Binary) and c.op == "&&":
+            return walk(c.lhs) and walk(c.rhs)
+        if not isinstance(c, Binary) or c.op not in (
+            "<", "<=", ">", ">=", "=", "==", "!="
+        ):
+            return False
+        lhs, rhs = c.lhs, c.rhs
+        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+        op = c.op
+        if isinstance(rhs, Idiom) and isinstance(lhs, Literal):
+            lhs, rhs = rhs, lhs
+            op = flip.get(op, op)
+        if not (isinstance(lhs, Idiom) and len(lhs.parts) == 1
+                and isinstance(lhs.parts[0], PField)
+                and isinstance(rhs, Literal)
+                and isinstance(rhs.value, (int, float))
+                and not isinstance(rhs.value, bool)):
+            return False
+        import math as _math
+
+        rv = rhs.value
+        # NaN ordering and >2^53 int precision diverge from float64 —
+        # keep those on the exact row-wise comparator
+        if isinstance(rv, float) and _math.isnan(rv):
+            return False
+        if abs(rv) > (1 << 53):
+            return False
+        terms.append((lhs.parts[0].name, op, float(rv)))
+        return True
+
+    if cond is None or not walk(cond):
+        return None
+    fields = sorted({t[0] for t in terms})
+
+    def run(cols: dict):
+        mask = None
+        for fname, op, val in terms:
+            col = cols[fname]
+            if op in ("=", "=="):
+                m = col == val
+            elif op == "!=":
+                m = col != val
+            elif op == "<":
+                m = col < val
+            elif op == "<=":
+                m = col <= val
+            elif op == ">":
+                m = col > val
+            else:
+                m = col >= val
+            mask = m if mask is None else (mask & m)
+        return mask
+
+    return fields, run
+
+
 class TableScanOp(Operator):
     """Batched table scan with the predicate inlined (single-target scans
     absorb the WHERE — reference operators/scan/table.rs) and optional
@@ -139,6 +207,79 @@ class TableScanOp(Operator):
         reverse = self.direction == "Backward"
         skip = self.pushed_offset or 0
         remaining = self.pushed_limit
+        from surrealdb_tpu.exec.statements import Source
+
+        vec = _vector_pred(self.cond) if not has_computed else None
+
+        def row_pass(src):
+            cc = ctx.with_doc(src.doc, src.rid)
+            return is_truthy(evaluate(self.cond, cc))
+
+        if vec is not None:
+            # columnar filter: evaluate whole pending batches with numpy;
+            # rows whose values aren't plain numbers fall back row-wise
+            fields, run = vec
+            pend: list = []
+            batch = []
+            _num = (int, float)
+
+            import math as _math
+
+            def _plain_number(v):
+                # bools, NaN, and >2^53 ints diverge from float64 math
+                if isinstance(v, bool) or not isinstance(v, _num):
+                    return False
+                if isinstance(v, float):
+                    return not _math.isnan(v)
+                return abs(v) <= (1 << 53)
+
+            def flush():
+                nonlocal pend, skip, remaining, batch
+                cols = {}
+                ok_vec = True
+                for fname in fields:
+                    vals = [s_.doc.get(fname) if isinstance(s_.doc, dict)
+                            else None for s_ in pend]
+                    if not all(_plain_number(v) for v in vals):
+                        ok_vec = False
+                        break
+                    cols[fname] = np.asarray(vals, dtype=np.float64)
+                if ok_vec:
+                    mask = run(cols)
+                    passing = [s_ for s_, m in zip(pend, mask) if m]
+                else:
+                    passing = [s_ for s_ in pend if row_pass(s_)]
+                pend = []
+                for src in passing:
+                    if skip > 0:
+                        skip -= 1
+                        continue
+                    batch.append(src)
+                    if remaining is not None:
+                        remaining -= 1
+                        if remaining <= 0:
+                            return True
+                return False
+
+            done = False
+            for k, raw in ctx.txn.scan(beg, end, reverse=reverse):
+                ctx.check_deadline()
+                _ns, _db, _tb, idv = K.decode_record_id(k)
+                doc = deserialize(raw)
+                pend.append(Source(rid=RecordId(self.tb, idv), doc=doc))
+                if len(pend) >= BATCH_SIZE:
+                    done = flush()
+                    if batch:
+                        yield batch
+                        batch = []
+                    if done:
+                        break
+            if pend and not done:
+                flush()
+            if batch:
+                yield batch
+            return
+
         batch = []
         for k, raw in ctx.txn.scan(beg, end, reverse=reverse):
             ctx.check_deadline()
@@ -147,8 +288,6 @@ class TableScanOp(Operator):
             doc = deserialize(raw)
             if has_computed:
                 doc = apply_computed_fields(self.tb, doc, rid, ctx)
-            from surrealdb_tpu.exec.statements import Source
-
             src = Source(rid=rid, doc=doc)
             if self.cond is not None:
                 cc = ctx.with_doc(doc, rid)

@@ -52,35 +52,49 @@ def enc_bytes(b: bytes) -> bytes:
 
 
 def dec_str(buf: bytes, pos: int) -> tuple[str, int]:
-    out = bytearray()
+    # fast path: bytes.find runs at memchr speed; embedded \x00\x01
+    # escapes are rare (a zero byte inside a utf-8 string)
     n = len(buf)
-    while pos < n:
-        c = buf[pos]
-        if c == 0:
-            if pos + 1 < n and buf[pos + 1] == 1:
-                out.append(0)
-                pos += 2
-                continue
-            return out.decode("utf-8"), pos + 2
-        out.append(c)
-        pos += 1
-    raise ValueError("unterminated string in key")
+    out = None
+    cur = pos
+    while True:
+        i = buf.find(0, cur)
+        if i < 0:
+            raise ValueError("unterminated string in key")
+        if i + 1 < n and buf[i + 1] == 1:
+            if out is None:
+                out = bytearray(buf[pos:i])
+            else:
+                out += buf[cur:i]
+            out.append(0)
+            cur = i + 2
+            continue
+        if out is None:
+            return buf[pos:i].decode("utf-8"), i + 2
+        out += buf[cur:i]
+        return out.decode("utf-8"), i + 2
 
 
 def dec_bytes(buf: bytes, pos: int) -> tuple[bytes, int]:
-    out = bytearray()
     n = len(buf)
-    while pos < n:
-        c = buf[pos]
-        if c == 0:
-            if pos + 1 < n and buf[pos + 1] == 1:
-                out.append(0)
-                pos += 2
-                continue
-            return bytes(out), pos + 2
-        out.append(c)
-        pos += 1
-    raise ValueError("unterminated bytes in key")
+    out2 = None
+    cur = pos
+    while True:
+        i = buf.find(0, cur)
+        if i < 0:
+            raise ValueError("unterminated bytes in key")
+        if i + 1 < n and buf[i + 1] == 1:
+            if out2 is None:
+                out2 = bytearray(buf[pos:i])
+            else:
+                out2 += buf[cur:i]
+            out2.append(0)
+            cur = i + 2
+            continue
+        if out2 is None:
+            return bytes(buf[pos:i]), i + 2
+        out2 += buf[cur:i]
+        return bytes(out2), i + 2
 
 
 def enc_i64(v: int) -> bytes:
