@@ -334,9 +334,12 @@ def _scan_table(tb: str, ctx, cond=None, stmt=None):
         if cur_id is not None and best:
             yield _hist_source(tb, cur_id, best, has_computed, ctx)
         return
-    beg, end = K.prefix_range(K.record_prefix(ns, db, tb))
+    pre = K.record_prefix(ns, db, tb)
+    beg, end = K.prefix_range(pre)
+    plen = len(pre)
     for k, raw in ctx.txn.scan(beg, end):
-        _ns, _db, _tb, idv = K.decode_record_id(k)
+        # the prefix pins (ns, db, tb): only the id needs decoding
+        idv, _pos = K.dec_value(k, plen)
         rid = RecordId(tb, idv)
         doc = deserialize(raw)
         if has_computed:
@@ -376,8 +379,9 @@ def _scan_record_range(v: RecordId, ctx):
         end = K.record(ns, db, v.tb, rng.end)
         if rng.end_incl:
             end += b"\xff"
+    plen = len(K.record_prefix(ns, db, v.tb))
     for k, raw in ctx.txn.scan(beg, end):
-        _ns, _db, _tb, idv = K.decode_record_id(k)
+        idv, _pos = K.dec_value(k, plen)
         yield Source(rid=RecordId(v.tb, idv), doc=deserialize(raw))
 
 

@@ -203,7 +203,9 @@ class TableScanOp(Operator):
         if ctx.txn.get(K.tb_def(ns, db, self.tb)) is None:
             raise SdbError(f"The table '{self.tb}' does not exist")
         has_computed = bool(computed_fields_of(self.tb, ctx))
-        beg, end = K.prefix_range(K.record_prefix(ns, db, self.tb))
+        pre = K.record_prefix(ns, db, self.tb)
+        beg, end = K.prefix_range(pre)
+        plen = len(pre)
         reverse = self.direction == "Backward"
         skip = self.pushed_offset or 0
         remaining = self.pushed_limit
@@ -264,7 +266,8 @@ class TableScanOp(Operator):
             done = False
             for k, raw in ctx.txn.scan(beg, end, reverse=reverse):
                 ctx.check_deadline()
-                _ns, _db, _tb, idv = K.decode_record_id(k)
+                # the scan prefix pins (ns, db, tb): only the id decodes
+                idv, _pos = K.dec_value(k, plen)
                 doc = deserialize(raw)
                 pend.append(Source(rid=RecordId(self.tb, idv), doc=doc))
                 if len(pend) >= BATCH_SIZE:
@@ -283,7 +286,7 @@ class TableScanOp(Operator):
         batch = []
         for k, raw in ctx.txn.scan(beg, end, reverse=reverse):
             ctx.check_deadline()
-            _ns, _db, _tb, idv = K.decode_record_id(k)
+            idv, _pos = K.dec_value(k, plen)
             rid = RecordId(self.tb, idv)
             doc = deserialize(raw)
             if has_computed:

@@ -52,30 +52,13 @@ def enc_bytes(b: bytes) -> bytes:
 
 
 def dec_str(buf: bytes, pos: int) -> tuple[str, int]:
-    # fast path: bytes.find runs at memchr speed; embedded \x00\x01
-    # escapes are rare (a zero byte inside a utf-8 string)
-    n = len(buf)
-    out = None
-    cur = pos
-    while True:
-        i = buf.find(0, cur)
-        if i < 0:
-            raise ValueError("unterminated string in key")
-        if i + 1 < n and buf[i + 1] == 1:
-            if out is None:
-                out = bytearray(buf[pos:i])
-            else:
-                out += buf[cur:i]
-            out.append(0)
-            cur = i + 2
-            continue
-        if out is None:
-            return buf[pos:i].decode("utf-8"), i + 2
-        out += buf[cur:i]
-        return out.decode("utf-8"), i + 2
+    b, p = dec_bytes(buf, pos)
+    return b.decode("utf-8"), p
 
 
 def dec_bytes(buf: bytes, pos: int) -> tuple[bytes, int]:
+    # bytes.find runs at memchr speed; embedded \x00\x01 escapes are
+    # rare (a literal zero byte inside the value)
     n = len(buf)
     out2 = None
     cur = pos

@@ -50,8 +50,19 @@ def load():
                 return None
         try:
             lib = ctypes.CDLL(_SO)
+            lib.sdb_scan_batch  # symbol probe: stale prebuilt .so?
         except OSError:
             return None
+        except AttributeError:
+            # an old library without the batched ABI: rebuild once, else
+            # fall back to the pure-Python memtable
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+                lib.sdb_scan_batch
+            except (OSError, AttributeError):
+                return None
         c_char_pp = ctypes.POINTER(ctypes.c_char_p)
         i64 = ctypes.c_int64
         i64p = ctypes.POINTER(i64)
@@ -83,6 +94,10 @@ def load():
         lib.sdb_scan_next.argtypes = [ctypes.c_void_p, c_char_pp, i64p,
                                       c_char_pp, i64p]
         lib.sdb_scan_free.argtypes = [ctypes.c_void_p]
+        lib.sdb_scan_batch.restype = i64
+        lib.sdb_scan_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, i64, i64, i64p,
+        ]
         lib.sdb_count_range_at.restype = i64
         lib.sdb_count_range_at.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, i64, ctypes.c_char_p, i64,
@@ -139,18 +154,37 @@ class NativeMemtable:
             -1 if limit is None else int(limit), 1 if reverse else 0,
         )
         try:
-            kp = ctypes.c_char_p()
-            kl = ctypes.c_int64()
-            vp = ctypes.c_char_p()
-            vl = ctypes.c_int64()
-            while self.lib.sdb_scan_next(
-                it, ctypes.byref(kp), ctypes.byref(kl), ctypes.byref(vp),
-                ctypes.byref(vl),
-            ):
-                yield (
-                    ctypes.string_at(kp, kl.value),
-                    ctypes.string_at(vp, vl.value),
+            # batched drain: one FFI crossing per ~512 rows; frames are
+            # [u32 klen][u32 vlen][key][val] unpacked with memoryview
+            # slicing (the per-row sdb_scan_next path cost more in ctypes
+            # marshalling than the C++ side spent scanning)
+            cap = 1 << 20
+            buf = ctypes.create_string_buffer(cap)
+            used = ctypes.c_int64()
+            from_u32 = int.from_bytes
+            while True:
+                n = self.lib.sdb_scan_batch(
+                    it, buf, cap, 512, ctypes.byref(used)
                 )
+                if n == -1:  # one item larger than the buffer: grow
+                    cap *= 4
+                    buf = ctypes.create_string_buffer(cap)
+                    continue
+                if n <= 0:
+                    return
+                # copy only the used bytes (buf.raw would materialize the
+                # whole cap-sized buffer first)
+                mv = ctypes.string_at(buf, used.value)
+                off = 0
+                for _ in range(n):
+                    kl = from_u32(mv[off:off + 4], "little")
+                    vl = from_u32(mv[off + 4:off + 8], "little")
+                    off += 8
+                    k = mv[off:off + kl]
+                    off += kl
+                    v = mv[off:off + vl]
+                    off += vl
+                    yield k, v
         finally:
             self.lib.sdb_scan_free(it)
 

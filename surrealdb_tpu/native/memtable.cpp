@@ -217,6 +217,37 @@ int sdb_scan_next(void* hit, const char** key, int64_t* klen,
 
 void sdb_scan_free(void* hit) { delete static_cast<ScanIter*>(hit); }
 
+// Batched drain: pack up to max_items [u32 klen][u32 vlen][key][val]
+// frames into buf (cap bytes). Returns the number of items packed and
+// writes the used byte count — one FFI crossing per few hundred rows
+// instead of one per row.
+int64_t sdb_scan_batch(void* hit, char* buf, int64_t cap,
+                       int64_t max_items, int64_t* used) {
+    auto* it = static_cast<ScanIter*>(hit);
+    int64_t count = 0;
+    int64_t off = 0;
+    while (count < max_items && it->pos < it->items.size()) {
+        auto& kv = it->items[it->pos];
+        int64_t need = 8 + static_cast<int64_t>(kv.first.size()) +
+                       static_cast<int64_t>(kv.second.size());
+        if (off + need > cap) {
+            if (count == 0) return -1;  // buffer too small for one item
+            break;
+        }
+        uint32_t kl = static_cast<uint32_t>(kv.first.size());
+        uint32_t vl = static_cast<uint32_t>(kv.second.size());
+        std::memcpy(buf + off, &kl, 4);
+        std::memcpy(buf + off + 4, &vl, 4);
+        std::memcpy(buf + off + 8, kv.first.data(), kl);
+        std::memcpy(buf + off + 8 + kl, kv.second.data(), vl);
+        off += need;
+        it->pos++;
+        count++;
+    }
+    *used = off;
+    return count;
+}
+
 int64_t sdb_count_range_at(void* h, const char* beg, int64_t blen,
                            const char* end, int64_t elen, uint64_t snap) {
     auto* m = static_cast<Memtable*>(h);
