@@ -6,6 +6,7 @@ import pickle
 import threading
 from typing import Iterator, Optional
 
+from surrealdb_tpu import cnf
 from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.val import copy_value
 
@@ -189,20 +190,30 @@ class Transaction:
         self.btx = btx
         self.write = write
         self.closed = False
+        # per-transaction catalog cache (reference kvs/tx.rs CachePolicy):
+        # definition reads repeat constantly inside one statement loop;
+        # snapshot isolation makes the cache safe for the txn lifetime,
+        # and catalog writes through THIS txn invalidate their key
+        self._cat_cache: dict = {}
 
     # raw ops -------------------------------------------------------------
     def get(self, key: bytes) -> Optional[bytes]:
         return self.btx.get(key)
 
     def set(self, key: bytes, val: bytes) -> None:
+        if key[:2] == b"/!" and self._cat_cache:
+            self._cat_cache.pop(key, None)
         self.btx.set(key, val)
 
     def put(self, key: bytes, val: bytes) -> None:
+        if key[:2] == b"/!":
+            self._cat_cache.pop(key, None)
         self.btx.put(key, val)
 
     def delete(self, key: bytes) -> None:
         self.btx.delete(key)
         if key.startswith(b"/!"):
+            self._cat_cache.pop(key, None)
             import time
 
             from surrealdb_tpu import key as K
@@ -223,6 +234,7 @@ class Transaction:
 
     def delete_range(self, beg, end):
         if beg.startswith(b"/!"):
+            self._cat_cache.clear()
             import time
 
             from surrealdb_tpu import key as K
@@ -233,13 +245,30 @@ class Transaction:
         return self.btx.delete_range(beg, end)
 
     # typed ops ------------------------------------------------------------
+    _CAT_MISS = object()
+
     def get_val(self, key: bytes):
+        if key[:2] == b"/!":
+            import copy as _copy
+
+            hit = self._cat_cache.get(key, self._CAT_MISS)
+            if hit is not self._CAT_MISS:
+                # shallow copy preserves the fresh-object contract: ALTER
+                # handlers mutate attributes of the returned def before
+                # writing back — the cached pristine stays untouched
+                return _copy.copy(hit) if hit is not None else None
+            raw = self.btx.get(key)
+            v = None if raw is None else deserialize(raw)
+            if len(self._cat_cache) < cnf.TRANSACTION_CACHE_SIZE:
+                self._cat_cache[key] = v
+            return _copy.copy(v) if v is not None else None
         raw = self.btx.get(key)
         return None if raw is None else deserialize(raw)
 
     def set_val(self, key: bytes, v) -> None:
         self.btx.set(key, serialize(v))
         if key.startswith(b"/!"):
+            self._cat_cache.pop(key, None)
             # catalog definitions keep history for INFO ... VERSION
             import time
 
@@ -287,6 +316,8 @@ class Transaction:
 
     def rollback_to_save_point(self):
         self.btx.rollback_to_save_point()
+        # undone writes may include catalog keys cached above
+        self._cat_cache.clear()
 
     def release_last_save_point(self):
         self.btx.release_last_save_point()
