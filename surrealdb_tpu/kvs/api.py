@@ -253,15 +253,15 @@ class Transaction:
 
             hit = self._cat_cache.get(key, self._CAT_MISS)
             if hit is not self._CAT_MISS:
-                # shallow copy preserves the fresh-object contract: ALTER
-                # handlers mutate attributes of the returned def before
-                # writing back — the cached pristine stays untouched
-                return _copy.copy(hit) if hit is not None else None
+                # DEEP copy preserves the fresh-object contract — ALTER
+                # handlers mutate nested containers (d.actions.append)
+                # of the returned def before writing back
+                return _copy.deepcopy(hit) if hit is not None else None
             raw = self.btx.get(key)
             v = None if raw is None else deserialize(raw)
             if len(self._cat_cache) < cnf.TRANSACTION_CACHE_SIZE:
                 self._cat_cache[key] = v
-            return _copy.copy(v) if v is not None else None
+            return _copy.deepcopy(v) if v is not None else None
         raw = self.btx.get(key)
         return None if raw is None else deserialize(raw)
 
