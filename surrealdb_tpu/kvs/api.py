@@ -261,7 +261,8 @@ class Transaction:
             v = None if raw is None else deserialize(raw)
             if len(self._cat_cache) < cnf.TRANSACTION_CACHE_SIZE:
                 self._cat_cache[key] = v
-            return _copy.deepcopy(v) if v is not None else None
+                return _copy.deepcopy(v) if v is not None else None
+            return v  # not cached: the fresh object is already private
         raw = self.btx.get(key)
         return None if raw is None else deserialize(raw)
 
