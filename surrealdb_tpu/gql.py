@@ -185,6 +185,14 @@ def execute_graphql(ds, session, query: str, variables=None) -> dict:
     return out
 
 
+_IDENT_RE = _re.compile(r"^[_A-Za-z][_0-9A-Za-z]*$")
+
+
+def _require_ident(name) -> None:
+    if not isinstance(name, str) or not _IDENT_RE.match(name):
+        raise SdbError(f"Invalid field name '{name}'")
+
+
 _FILTER_OPS = {
     "eq": "=", "ne": "!=", "gt": ">", "gte": ">=", "lt": "<", "lte": "<=",
     "contains": "CONTAINS",
@@ -199,6 +207,7 @@ def _gql_rid(tb: str, idv) -> str:
 def _build_where(filters: dict, vars: dict) -> list:
     conds = []
     for k, v in dict(filters or {}).items():
+        _require_ident(k)
         if isinstance(v, dict) and v and all(op in _FILTER_OPS for op in v):
             for opname, operand in v.items():
                 slot = f"f{len(vars)}"
@@ -226,6 +235,9 @@ def _resolve_table(ds, session, tb, args, sub):
         if conds:
             sql += " WHERE " + " AND ".join(conds)
         if order:
+            # interpolated into the statement — restrict to a bare field
+            # identifier or SurrealQL injection rides in via this arg
+            _require_ident(order)
             sql += f" ORDER BY {order}"
             if args.get("desc"):
                 sql += " DESC"

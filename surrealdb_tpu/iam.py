@@ -46,13 +46,54 @@ def _level_from_roles(roles) -> str:
     return "viewer"
 
 
-def issue_token(ds, claims: dict, ttl_s: int = 3600) -> str:
-    header = {"alg": "HS256", "typ": "JWT"}
+_HS_HASHES = {"HS256": "sha256", "HS384": "sha384", "HS512": "sha512"}
+_RS_HASHES = {"RS256": "sha256", "RS384": "sha384", "RS512": "sha512"}
+
+
+def issue_token(ds, claims: dict, ttl_s: int = 3600, cfg: dict | None = None) -> str:
+    """Issue a JWT. With an access config carrying an issuer key (WITH JWT
+    ... [WITH ISSUER KEY]), sign with that key and the configured algorithm
+    so the access method can verify its own tokens (reference
+    core/src/iam/issue.rs); otherwise HS256 with the datastore secret."""
+    import hashlib
+
+    alg, key_bytes, rsa_nd = "HS256", _secret(ds), None
+    if cfg and (cfg.get("alg") or cfg.get("key") or cfg.get("issuer_key")):
+        calg = (cfg.get("alg") or "HS512").upper()
+        ikey = cfg.get("issuer_key")
+        if calg in _HS_HASHES:
+            k = ikey if ikey is not None else cfg.get("key")
+            if k is not None:
+                alg, key_bytes = calg, str(k).encode()
+        elif calg in _RS_HASHES:
+            if ikey is None:
+                # silently downgrading to the datastore secret would issue
+                # tokens third parties can never verify against the
+                # configured public key — fail loudly at issue time
+                raise SdbError(
+                    "An issuer key is required for asymmetric algorithms"
+                )
+            from surrealdb_tpu.utils.rsa import rsa_private_key_from_pem
+
+            try:
+                rsa_nd = rsa_private_key_from_pem(str(ikey))
+                alg = calg
+            except (ValueError, IndexError):
+                raise SdbError("There was a problem with authentication")
+    header = {"alg": alg, "typ": "JWT"}
     now = int(time.time())
     payload = {"iat": now, "exp": now + ttl_s, "iss": "surrealdb-tpu", **claims}
     h = _b64(json.dumps(header).encode())
     p = _b64(json.dumps(payload).encode())
-    sig = hmac.new(_secret(ds), f"{h}.{p}".encode(), sha256).digest()
+    signing = f"{h}.{p}".encode()
+    if rsa_nd is not None:
+        from surrealdb_tpu.utils.rsa import sign_pkcs1_v15
+
+        sig = sign_pkcs1_v15(rsa_nd[0], rsa_nd[1], signing, _RS_HASHES[alg])
+    else:
+        sig = hmac.new(
+            key_bytes, signing, getattr(hashlib, _HS_HASHES[alg])
+        ).digest()
     return f"{h}.{p}.{_b64(sig)}"
 
 
@@ -136,19 +177,7 @@ def _record_access(ds, session, ns, db, ac, creds, mode) -> str:
         if k not in ("NS", "DB", "AC", "ns", "db", "ac", "namespace",
                      "database", "access")
     }
-    sess = Session(ns=ns, db=db, auth_level="owner")
-    from surrealdb_tpu.exec.context import Ctx
-    from surrealdb_tpu.exec.eval import evaluate
-
-    txn = ds.transaction(write=True)
-    try:
-        ctx = Ctx(ds, sess, txn)
-        ctx.vars.update(vars)
-        out = evaluate(expr, ctx)
-        txn.commit()
-    except SdbError:
-        txn.cancel()
-        raise
+    out = _eval_clause(ds, ns, db, expr, vars)
     if isinstance(out, list):
         out = out[0] if out else NONE
     if isinstance(out, dict):
@@ -161,7 +190,8 @@ def _record_access(ds, session, ns, db, ac, creds, mode) -> str:
     session.auth_level = "record"
     session.rid = out
     return issue_token(
-        ds, {"ID": out.render(), "NS": ns, "DB": db, "AC": ac}
+        ds, {"ID": out.render(), "NS": ns, "DB": db, "AC": ac},
+        cfg=acc.config,
     )
 
 
@@ -206,15 +236,20 @@ def _verify_with_access(ds, cfg: dict, token: str) -> dict:
         header = json.loads(_unb64(h))
     except (ValueError, UnicodeDecodeError):
         raise SdbError("There was a problem with authentication")
-    alg = (header.get("alg") or cfg.get("alg") or "HS256").upper()
+    # The algorithm is pinned from the access config — NEVER from the
+    # attacker-controlled token header (RS->HS confusion: HMAC-signing
+    # with the public PEM as the secret). Unset ALGORITHM defaults to
+    # the reference's HS512; JWKS-backed access is asymmetric-only and
+    # the header alg must still match the config/JWK.
+    header_alg = (header.get("alg") or "").upper()
     cfg_alg = (cfg.get("alg") or "").upper()
-    if cfg_alg and alg != cfg_alg:
-        # the access method pins ONE algorithm; accepting the attacker-
-        # controlled header alg enables RS->HS confusion (signing with
-        # the public PEM as an HMAC secret)
-        raise SdbError("There was a problem with authentication")
-    if cfg.get("url") and not alg.startswith("RS"):
-        # JWKS-backed access verifies asymmetric tokens only
+    if cfg.get("url"):
+        alg = cfg_alg or header_alg
+        if not alg.startswith("RS") or (cfg_alg and header_alg != cfg_alg):
+            raise SdbError("There was a problem with authentication")
+    else:
+        alg = cfg_alg or "HS512"
+    if header_alg != alg:
         raise SdbError("There was a problem with authentication")
     signing = f"{h}.{p}".encode()
     sig = _unb64(s)
@@ -222,8 +257,7 @@ def _verify_with_access(ds, cfg: dict, token: str) -> dict:
     if alg.startswith("HS"):
         import hashlib
 
-        hname = {"HS256": "sha256", "HS384": "sha384",
-                 "HS512": "sha512"}.get(alg)
+        hname = _HS_HASHES.get(alg)
         key = (cfg.get("key") or "").encode()
         if hname and key:
             want = hmac.new(key, signing, getattr(hashlib, hname)).digest()
@@ -233,8 +267,7 @@ def _verify_with_access(ds, cfg: dict, token: str) -> dict:
             rsa_public_key_from_pem, verify_pkcs1_v15,
         )
 
-        hname = {"RS256": "sha256", "RS384": "sha384",
-                 "RS512": "sha512"}.get(alg)
+        hname = _RS_HASHES.get(alg)
         pairs = []
         if cfg.get("url"):
             kid = header.get("kid")
@@ -242,6 +275,8 @@ def _verify_with_access(ds, cfg: dict, token: str) -> dict:
                 if jwk.get("kty") != "RSA":
                     continue
                 if kid is not None and jwk.get("kid") not in (None, kid):
+                    continue
+                if jwk.get("alg") and str(jwk["alg"]).upper() != alg:
                     continue
                 pairs.append((
                     int.from_bytes(_unb64(jwk["n"]), "big"),
@@ -258,9 +293,65 @@ def _verify_with_access(ds, cfg: dict, token: str) -> dict:
     if not ok:
         raise SdbError("There was a problem with authentication")
     payload = json.loads(_unb64(p))
-    if payload.get("exp", 0) and payload["exp"] < time.time():
+    # reference jsonwebtoken requires exp by default and honours nbf
+    exp = payload.get("exp")
+    if not isinstance(exp, (int, float)) or isinstance(exp, bool):
+        raise SdbError("There was a problem with authentication")
+    if exp < time.time():
         raise SdbError("The token has expired")
+    nbf = payload.get("nbf")
+    if isinstance(nbf, (int, float)) and not isinstance(nbf, bool) \
+            and nbf > time.time():
+        raise SdbError("There was a problem with authentication")
     return payload
+
+
+def _eval_clause(ds, ns, db, expr, vars: dict):
+    """Evaluate an access-method clause (SIGNIN/SIGNUP/AUTHENTICATE) in
+    its own owner-level write transaction. Cancels on ANY failure so no
+    transaction leaks, commits otherwise."""
+    from surrealdb_tpu.exec.context import Ctx
+    from surrealdb_tpu.exec.eval import evaluate
+
+    from surrealdb_tpu.err import ReturnException
+
+    sess = Session(ns=ns, db=db, auth_level="owner")
+    txn = ds.transaction(write=True)
+    try:
+        ctx = Ctx(ds, sess, txn)
+        ctx.vars.update(vars)
+        try:
+            out = evaluate(expr, ctx)
+        except ReturnException as r:
+            out = r.value
+    except BaseException:
+        txn.cancel()
+        raise
+    txn.commit()
+    return out
+
+
+def _run_authenticate_clause(ds, ns, db, kind, cfg, payload, rid):
+    """Evaluate the access method's AUTHENTICATE clause (reference
+    core/src/iam/verify.rs): $token holds the verified claims; a thrown
+    error rejects the token. For record access the clause result becomes
+    the session rid and MUST be a record id — a gate clause that returns
+    none for a blocked user fails closed. Returns the final rid."""
+    expr = (cfg or {}).get("authenticate")
+    if expr is None:
+        return rid
+    out = _eval_clause(ds, ns, db, expr,
+                       {"token": dict(payload), "auth": rid or NONE})
+    if kind == "record":
+        # reference access.rs authenticate_record: the result must be a
+        # record id, which becomes the session rid
+        if not isinstance(out, RecordId):
+            raise SdbError("There was a problem with authentication")
+        return out
+    # reference access.rs authenticate_generic: any non-none result fails
+    if out is not NONE and out is not None:
+        raise SdbError("There was a problem with authentication")
+    return rid
 
 
 def authenticate(ds, session: Session, token: str):
@@ -283,26 +374,54 @@ def authenticate(ds, session: Session, token: str):
         cfg = getattr(adef, "config", None) or {}
         if adef is not None and (cfg.get("url") or cfg.get("alg") or
                                  cfg.get("key")):
-            payload = _verify_with_access(ds, cfg, token)
-            session.ns, session.db, session.ac = pns, pdb, ac
-            rid = payload.get("ID") or payload.get("id")
-            if rid:
+            try:
+                payload = _verify_with_access(ds, cfg, token)
+            except SdbError as e:
+                if getattr(adef, "kind", None) == "record" and \
+                        "problem with authentication" in str(e):
+                    # tokens issued by our own signin/signup for a record
+                    # access (datastore-secret signed) remain valid even
+                    # when the access also carries a verification config;
+                    # expiry / JWKS errors are NOT masked by the fallback
+                    payload = verify_token(ds, token)
+                else:
+                    raise
+            rid = None
+            raw = payload.get("ID") or payload.get("id")
+            if raw:
                 from surrealdb_tpu.exec.static_eval import static_value
                 from surrealdb_tpu.syn.parser import parse_record_literal
 
-                session.rid = static_value(parse_record_literal(str(rid)))
+                rid = static_value(parse_record_literal(str(raw)))
+            # the AUTHENTICATE clause runs BEFORE the session mutates: a
+            # rejection must not leave a long-lived RPC session upgraded
+            rid = _run_authenticate_clause(
+                ds, pns, pdb, getattr(adef, "kind", None), cfg, payload, rid
+            )
+            session.ns, session.db, session.ac = pns, pdb, ac
+            session.rid = rid
             session.auth_level = "record"
             return NONE
     payload = verify_token(ds, token)
     if payload.get("AC"):
-        session.ns = payload.get("NS")
-        session.db = payload.get("DB")
-        session.ac = payload.get("AC")
-        session.auth_level = "record"
         from surrealdb_tpu.exec.static_eval import static_value
         from surrealdb_tpu.syn.parser import parse_record_literal
 
-        session.rid = static_value(parse_record_literal(payload["ID"]))
+        pns, pdb, pac = payload.get("NS"), payload.get("DB"), payload["AC"]
+        rid = static_value(parse_record_literal(payload["ID"]))
+        txn = ds.transaction(write=False)
+        try:
+            adef = txn.get_val(K.ac_def("db", pns, pdb, pac))
+        finally:
+            txn.cancel()
+        if adef is not None:
+            rid = _run_authenticate_clause(
+                ds, pns, pdb, getattr(adef, "kind", None),
+                getattr(adef, "config", None), payload, rid,
+            )
+        session.ns, session.db, session.ac = pns, pdb, pac
+        session.rid = rid
+        session.auth_level = "record"
     else:
         base = payload.get("base", "root")
         n, d = payload.get("NS"), payload.get("DB")

@@ -23,6 +23,10 @@ from surrealdb_tpu.val import to_json
 _WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
 
+class _AuthFailed(Exception):
+    """Bearer token rejected — maps to HTTP 401."""
+
+
 class _BodyTooLarge(Exception):
     pass
 
@@ -74,10 +78,12 @@ class SurrealHandler(BaseHTTPRequestHandler):
         if auth.startswith("Bearer "):
             from surrealdb_tpu.iam import authenticate
 
+            # an invalid token is a hard 401, not a silent downgrade to
+            # an anonymous session (reference net/auth.rs)
             try:
                 authenticate(self.ds, s, auth[7:])
-            except SdbError:
-                s.auth_level = "none"
+            except SdbError as e:
+                raise _AuthFailed(str(e))
         elif auth.startswith("Basic "):
             from surrealdb_tpu.iam import signin
 
@@ -153,9 +159,16 @@ class SurrealHandler(BaseHTTPRequestHandler):
         try:
             fn()
         except _BodyTooLarge:
+            # the oversized body was never read — keep-alive would parse
+            # its bytes as the next request line, so drop the connection
+            self.close_connection = True
             self._json(413, {
                 "error": "Request body exceeds the maximum allowed size"
             })
+        except _AuthFailed as e:
+            self._json(401, {"error": str(e)})
+        except SdbError as e:
+            self._json(400, {"error": str(e)})
 
     def do_GET(self):
         self._dispatch(self._do_GET)

@@ -1,10 +1,10 @@
-"""RSASSA-PKCS1-v1_5 verification + minimal DER/PEM public-key parsing.
+"""RSASSA-PKCS1-v1_5 sign/verify + minimal DER/PEM key parsing.
 
 The reference verifies third-party JWTs (RS256/384/512) via the jsonwebtoken
-crate (core/src/iam/verify.rs); no crypto library ships in this image, so
-the verify primitive is implemented directly: sig^e mod n must equal the
-EMSA-PKCS1-v1_5 encoding of the token digest. Verification only — no
-signing, no private-key handling.
+crate (core/src/iam/verify.rs) and signs issued tokens with a configured
+issuer key (core/src/iam/issue.rs); no crypto library ships in this image,
+so both primitives are implemented directly: sig^e mod n must equal the
+EMSA-PKCS1-v1_5 encoding of the token digest, and signing is em^d mod n.
 """
 
 from __future__ import annotations
@@ -75,3 +75,48 @@ def rsa_public_key_from_pem(pem: str) -> tuple[int, int]:
 
     body = re.sub(r"-----[A-Z ]+-----|\s", "", pem)
     return rsa_public_key_from_der(base64.b64decode(body))
+
+
+def sign_pkcs1_v15(n: int, d: int, msg: bytes,
+                   hash_name: str = "sha256") -> bytes:
+    import hashlib as _hl
+
+    k = (n.bit_length() + 7) // 8
+    h = _hl.new(hash_name, msg).digest()
+    t = _DIGEST_INFO[hash_name] + h
+    if k < len(t) + 11:
+        raise ValueError("RSA modulus too small for digest")
+    em = b"\x00\x01" + b"\xff" * (k - len(t) - 3) + b"\x00" + t
+    return pow(int.from_bytes(em, "big"), d, n).to_bytes(k, "big")
+
+
+def rsa_private_key_from_der(der: bytes) -> tuple[int, int]:
+    """(n, d) from PKCS#1 RSAPrivateKey or PKCS#8 PrivateKeyInfo."""
+    tag, body, _ = _der_read(der, 0)
+    if tag != 0x30:
+        raise ValueError("not a DER sequence")
+    tag1, first, nxt = _der_read(body, 0)
+    if tag1 != 0x02:
+        raise ValueError("not a private key")
+    if len(first) <= 1 and nxt < len(body):
+        # could be PKCS#1 (version, n, e, d, ...) or PKCS#8
+        # (version, AlgorithmIdentifier, OCTET STRING)
+        tag2, second, nxt2 = _der_read(body, nxt)
+        if tag2 == 0x30:
+            # PKCS#8: unwrap the OCTET STRING holding RSAPrivateKey
+            _t, octets, _ = _der_read(body, nxt2)
+            return rsa_private_key_from_der(octets)
+        # PKCS#1: second element is n
+        nb = second
+        _t, _eb, j = _der_read(body, nxt2)
+        _t, db, _ = _der_read(body, j)
+        return int.from_bytes(nb, "big"), int.from_bytes(db, "big")
+    raise ValueError("unrecognised private key structure")
+
+
+def rsa_private_key_from_pem(pem: str) -> tuple[int, int]:
+    import base64
+    import re
+
+    body = re.sub(r"-----[A-Z ]+-----|\s", "", pem)
+    return rsa_private_key_from_der(base64.b64decode(body))

@@ -71,3 +71,19 @@ def test_generated_introspection():
                               "ofType": None}
     tq = execute_graphql(ds, sess, '{ __type(name: "person") { name } }')
     assert tq["data"]["__type"]["name"] == "person"
+
+
+def test_order_arg_injection_blocked():
+    # ADVICE r4 (high): `order` was interpolated raw into the SELECT,
+    # letting any GraphQL caller run arbitrary statements
+    ds, sess = _ds()
+    evil = "name LIMIT 1 START 0; REMOVE TABLE person; SELECT name FROM person"
+    out = execute_graphql(
+        ds, sess,
+        'query Q($o: String) { person(order: $o) { name } }',
+        variables={"o": evil},
+    )
+    assert out.get("errors"), "injection must be rejected"
+    # table still exists and ordering by a legit field works
+    out = execute_graphql(ds, sess, '{ person(order: "age") { name } }')
+    assert [r["name"] for r in out["data"]["person"]] == ["Ada", "Bob"]

@@ -167,3 +167,113 @@ def test_expired_external_token(rsa):
     sig = hmac.new(b"k", f"{h}.{p}".encode(), hashlib.sha256).digest()
     with pytest.raises(SdbError, match="expired"):
         authenticate(ds, Session(), f"{h}.{p}.{_b64(sig)}")
+
+
+def test_alg_confusion_blocked(rsa):
+    # ADVICE r5 (high): with ALGORITHM unset, the attacker-controlled
+    # header alg must NOT be trusted — an HS token HMAC-signed with the
+    # public PEM text as the secret must be rejected
+    n, e, d = rsa
+    import base64 as _b
+
+    der_n = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    # minimal PKCS#1 public DER wrapped as PEM
+    def _der_int(x):
+        b = x.to_bytes((x.bit_length() + 7) // 8 or 1, "big")
+        if b[0] & 0x80:
+            b = b"\x00" + b
+        return bytes([0x02, len(b)]) + b
+    seq = _der_int(n) + _der_int(e)
+    der = bytes([0x30, 0x82]) + len(seq).to_bytes(2, "big") + seq
+    pem = ("-----BEGIN RSA PUBLIC KEY-----\n"
+           + _b.encodebytes(der).decode()
+           + "-----END RSA PUBLIC KEY-----\n")
+    ds = Datastore("memory")
+    ds.query(
+        f"DEFINE ACCESS ext ON DATABASE TYPE JWT KEY '{pem}'",
+        ns="t", db="t")
+    for alg, hn in (("HS256", hashlib.sha256), ("HS384", hashlib.sha384)):
+        h = _b64(json.dumps({"alg": alg}).encode())
+        p = _b64(json.dumps({"AC": "ext", "NS": "t", "DB": "t",
+                             "ID": "user:1",
+                             "exp": time.time() + 60}).encode())
+        sig = hmac.new(pem.encode(), f"{h}.{p}".encode(), hn).digest()
+        with pytest.raises(SdbError):
+            authenticate(ds, Session(), f"{h}.{p}.{_b64(sig)}")
+    # the config pins HS512 by default (reference default) — a legit
+    # HS512 token with the configured key text still verifies
+    h = _b64(json.dumps({"alg": "HS512"}).encode())
+    p = _b64(json.dumps({"AC": "ext", "NS": "t", "DB": "t", "ID": "user:2",
+                         "exp": time.time() + 60}).encode())
+    sig = hmac.new(pem.encode(), f"{h}.{p}".encode(), hashlib.sha512).digest()
+    sess = Session()
+    authenticate(ds, sess, f"{h}.{p}.{_b64(sig)}")
+    assert sess.auth_level == "record"
+
+
+def test_record_access_with_jwt_roundtrips():
+    # ADVICE r5 (medium): signup tokens for a record access WITH JWT must
+    # be verifiable by authenticate (signed with the configured key)
+    from surrealdb_tpu.iam import signup
+
+    ds = Datastore("memory")
+    ds.query(
+        "DEFINE ACCESS acc ON DATABASE TYPE RECORD "
+        "SIGNUP (CREATE user SET email = $email) "
+        "SIGNIN (SELECT * FROM user WHERE email = $email) "
+        "WITH JWT ALGORITHM HS256 KEY 'issuerkey'",
+        ns="t", db="t")
+    tok = signup(ds, Session(), {"NS": "t", "DB": "t", "AC": "acc",
+                                 "email": "a"})
+    # token is signed with the configured key, not the datastore secret
+    h, p, s = tok.split(".")
+    assert json.loads(base64.urlsafe_b64decode(h + "==")).get("alg") == "HS256"
+    want = hmac.new(b"issuerkey", f"{h}.{p}".encode(), hashlib.sha256).digest()
+    assert hmac.compare_digest(want, base64.urlsafe_b64decode(s + "=="))
+    sess = Session()
+    authenticate(ds, sess, tok)
+    assert sess.auth_level == "record" and sess.ac == "acc"
+
+
+def test_external_token_requires_exp_and_honours_nbf():
+    ds = Datastore("memory")
+    ds.query(
+        "DEFINE ACCESS p ON DATABASE TYPE JWT ALGORITHM HS256 KEY 'k'",
+        ns="t", db="t")
+
+    def tok(payload):
+        h = _b64(json.dumps({"alg": "HS256"}).encode())
+        p = _b64(json.dumps(payload).encode())
+        sig = hmac.new(b"k", f"{h}.{p}".encode(), hashlib.sha256).digest()
+        return f"{h}.{p}.{_b64(sig)}"
+
+    base = {"AC": "p", "NS": "t", "DB": "t", "ID": "u:1"}
+    with pytest.raises(SdbError):  # no exp at all
+        authenticate(ds, Session(), tok(base))
+    with pytest.raises(SdbError):  # not valid yet
+        authenticate(ds, Session(),
+                     tok({**base, "exp": time.time() + 60,
+                          "nbf": time.time() + 30}))
+    authenticate(ds, Session(),
+                 tok({**base, "exp": time.time() + 60,
+                      "nbf": time.time() - 30}))
+
+
+def test_authenticate_clause_runs():
+    ds = Datastore("memory")
+    ds.query(
+        "DEFINE ACCESS g ON DATABASE TYPE JWT ALGORITHM HS256 KEY 'k' "
+        "AUTHENTICATE { IF $token.deny { THROW 'denied' } }",
+        ns="t", db="t")
+
+    def tok(payload):
+        h = _b64(json.dumps({"alg": "HS256"}).encode())
+        p = _b64(json.dumps(payload).encode())
+        sig = hmac.new(b"k", f"{h}.{p}".encode(), hashlib.sha256).digest()
+        return f"{h}.{p}.{_b64(sig)}"
+
+    base = {"AC": "g", "NS": "t", "DB": "t", "ID": "u:1",
+            "exp": time.time() + 60}
+    authenticate(ds, Session(), tok(base))
+    with pytest.raises(SdbError, match="denied"):
+        authenticate(ds, Session(), tok({**base, "deny": True}))
