@@ -454,6 +454,10 @@ def _e_closure(n, ctx):
 
 
 def call_closure(clo: Closure, args: list, ctx: Ctx):
+    py = getattr(clo, "py", None)
+    if py is not None:
+        # host-implemented closure (e.g. the API middleware $next)
+        return py(args, ctx)
     c = ctx.child()
     for i, (pname, pkind) in enumerate(clo.params):
         v = args[i] if i < len(args) else NONE

@@ -4228,11 +4228,10 @@ def _s_define_config(n: DefineConfig, ctx):
     _ensure_ns_db(ctx)
     ns, db = ctx.need_ns_db()
     if n.what == "API_DEF":
+        from surrealdb_tpu.api import validate_define_path
+
         cfg = n.config
-        if not str(cfg["path"]).startswith("/"):
-            raise SdbError(
-                "The string could not be parsed into a path: Segment should start with /"
-            )
+        validate_define_path(str(cfg["path"]))
         key = K.api_def(ns, db, cfg["path"])
         if _exists_guard(ctx, key, cfg["path"], "api", n.if_not_exists,
                          n.overwrite):

@@ -1222,95 +1222,13 @@ for _m in ("head", "get", "put", "post", "patch", "delete"):
 
 @register("api::invoke")
 def _api_invoke(args, ctx):
-    """Invoke a DEFINE API endpoint: matches the path, runs the method's
-    THEN handler with $request bound (reference core/src/api/)."""
-    from surrealdb_tpu import key as K2
-    from surrealdb_tpu.catalog import ApiDef
-    from surrealdb_tpu.exec.eval import evaluate
-    from surrealdb_tpu.err import ReturnException
+    """Invoke a DEFINE API endpoint through the full middleware engine
+    (reference core/src/api/mod.rs)."""
+    from surrealdb_tpu.api import invoke as _invoke
 
     path = _str(args[0], "api::invoke", 1)
     opts = args[1] if len(args) > 1 and isinstance(args[1], dict) else {}
-    ns, db = ctx.need_ns_db()
-    d = ctx.txn.get_val(K2.api_def(ns, db, path))
-    path_params = {}
-    if not isinstance(d, ApiDef):
-        # segment matching: /user/:id style definitions (core/src/api path)
-        req = [seg for seg in path.split("/") if seg != ""]
-        for _k, cand in ctx.txn.scan_vals(
-            *K2.prefix_range(K2.api_prefix(ns, db))
-        ):
-            if not isinstance(cand, ApiDef):
-                continue
-            defsegs = [seg for seg in cand.path.split("/") if seg != ""]
-            if len(defsegs) != len(req):
-                continue
-            params = {}
-            ok = True
-            for dseg, rseg in zip(defsegs, req):
-                if dseg.startswith(":"):
-                    params[dseg[1:]] = rseg
-                elif dseg != rseg:
-                    ok = False
-                    break
-            if ok:
-                d = cand
-                path_params = params
-                break
-    if not isinstance(d, ApiDef):
-        raise SdbError(f"The api '{path}' does not exist")
-    method = str(opts.get("method", "get")).lower()
-    action = None
-    fallback = None
-    for a in d.actions:
-        if method in a.methods:
-            action = a
-            break
-        if "any" in a.methods:
-            fallback = a
-    action = action or fallback
-    if action is None or action.then is None:
-        return {"status": 404, "body": NONE, "headers": {}}
-    c = ctx.child()
-    c.vars["request"] = {
-        "method": method,
-        "path": path,
-        "body": opts.get("body", NONE),
-        "headers": opts.get("headers", {}),
-        "params": {**path_params, **(opts.get("params") or {})},
-        "query": opts.get("query", {}),
-    }
-    # middleware: api::timeout sets the handler deadline (core/src/api)
-    import time as _time
-
-    from surrealdb_tpu.val import Duration as _Dur
-
-    for mw in list(getattr(d, "middleware", []) or []) + list(
-        action.middleware or []
-    ):
-        mname, margs = mw
-        if mname in ("api::timeout", "timeout"):
-            tv = evaluate(margs[0], c) if margs else NONE
-            if isinstance(tv, _Dur):
-                c.deadline = _time.monotonic() + tv.ns / 1e9
-    try:
-        out = evaluate(action.then, c)
-        # a handler that finishes after its deadline still fails
-        if c.deadline is not None and _time.monotonic() > c.deadline:
-            return {"status": 500, "body": "deadline has elapsed",
-                    "headers": {}}
-    except ReturnException as r:
-        out = r.value
-    except SdbError as e:
-        if "exceeded the timeout" in str(e) or "deadline" in str(e):
-            return {"status": 500, "body": "deadline has elapsed",
-                    "headers": {}}
-        raise
-    if isinstance(out, dict):
-        out.setdefault("status", 200)
-        out.setdefault("headers", {})
-        return out
-    return {"status": 200, "body": out, "headers": {}}
+    return _invoke(ctx, path, opts)
 
 
 @register("file::bucket")

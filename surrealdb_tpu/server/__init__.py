@@ -119,21 +119,18 @@ class SurrealHandler(BaseHTTPRequestHandler):
         apath = "/" + "/".join(segs[3:])
         sess = self._session()
         sess.ns, sess.db = ns, db
+        # the engine's body middleware (api::req::body) expects the raw
+        # bytes — parsing here would break every strategy
         body = self._body()
-        data = None
-        if body:
-            try:
-                data = json.loads(body)
-            except ValueError:
-                data = body.decode(errors="replace")
         query = {k: (v[0] if len(v) == 1 else v)
                  for k, v in parse_qs(parsed.query).items()}
         opts = {
             "method": method.lower(),
-            "body": data,
             "headers": {k.lower(): v for k, v in self.headers.items()},
             "query": query,
         }
+        if body:
+            opts["body"] = body
         res = self.ds.execute(
             "RETURN api::invoke($p, $o)", session=sess,
             vars={"p": apath, "o": opts},
@@ -143,13 +140,20 @@ class SurrealHandler(BaseHTTPRequestHandler):
             return
         out = res.result if isinstance(res.result, dict) else {}
         status = int(out.get("status", 200))
-        hdrs = out.get("headers") or {}
+        hdrs = {str(k).lower(): str(v)
+                for k, v in (out.get("headers") or {}).items()}
         body_v = out.get("body")
-        payload = json.dumps(to_json(body_v)).encode()
+        if isinstance(body_v, (bytes, bytearray)):
+            payload = bytes(body_v)  # already serialized by api::res::body
+        elif isinstance(body_v, str):
+            payload = body_v.encode()
+            hdrs.setdefault("content-type", "text/plain")
+        else:
+            payload = json.dumps(to_json(body_v)).encode()
+            hdrs.setdefault("content-type", "application/json")
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
         for k, v in hdrs.items():
-            self.send_header(str(k), str(v))
+            self.send_header(k, v)
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)

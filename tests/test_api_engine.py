@@ -1,0 +1,101 @@
+"""DEFINE API middleware engine (reference core/src/api/mod.rs: chain
+order, body strategies, response shaping, permissions, path routing)."""
+
+from surrealdb_tpu import Datastore
+from surrealdb_tpu.kvs.ds import Session
+
+
+def _ds():
+    return Datastore("memory")
+
+
+def q(ds, sql, sess=None):
+    if sess is None:
+        return ds.execute(sql, ns="t", db="t")
+    return ds.execute(sql, session=sess)
+
+
+def test_path_specificity_and_params():
+    ds = _ds()
+    q(ds, """
+      DEFINE API "/u/fixed" FOR get THEN { { body: { m: 'fixed' } } };
+      DEFINE API "/u/:id<number>" FOR get THEN { { body: { id: $request.params.id } } };
+      DEFINE API "/u/*rest" FOR get THEN { { body: { rest: $request.params.rest } } };
+    """)
+    r = q(ds, 'RETURN api::invoke("/u/fixed")')[0].result
+    assert r["body"] == {"m": "fixed"}
+    r = q(ds, 'RETURN api::invoke("/u/42")')[0].result
+    assert r["body"] == {"id": 42}
+    r = q(ds, 'RETURN api::invoke("/u/a/b")')[0].result
+    assert r["body"] == {"rest": ["a", "b"]}
+    r = q(ds, 'RETURN api::invoke("/nope")')[0].result
+    assert r == {"status": 404, "body": "Not found", "headers": {}}
+
+
+def test_middleware_chain_order_and_custom_next():
+    ds = _ds()
+    q(ds, """
+      DEFINE FUNCTION fn::tag($req: object, $next: function, $t: string) {
+        LET $req = $req + { body: ($req.body ?? {}) + {
+          order: array::append($req.body.order ?? [], $t) } };
+        RETURN $next($req);
+      };
+      DEFINE CONFIG API MIDDLEWARE fn::tag('db');
+      DEFINE API "/o"
+        FOR any MIDDLEWARE fn::tag('any')
+          THEN { { body: { order: $request.body.order } } }
+        FOR get MIDDLEWARE fn::tag('get')
+          THEN { { body: { order: $request.body.order } } };
+    """)
+    r = q(ds, 'RETURN api::invoke("/o")')[0].result
+    assert r["body"]["order"] == ["db", "any", "get"]
+    r = q(ds, 'RETURN api::invoke("/o", { method: "put" })')[0].result
+    assert r["body"]["order"] == ["db", "any"]
+
+
+def test_builtin_middleware_body_and_status():
+    ds = _ds()
+    q(ds, """
+      DEFINE API "/j" FOR post MIDDLEWARE api::req::body('json')
+        THEN { { body: { got: $request.body } } };
+      DEFINE API "/s" FOR get
+        MIDDLEWARE api::res::status(404), api::res::header('x-a', 'b')
+        THEN { { status: 200, body: {} } };
+    """)
+    r = q(ds, "RETURN api::invoke('/j', { method: 'post', "
+              "headers: { 'content-type': 'application/json' }, "
+              "body: <bytes>'{\"a\": 1}' })")[0].result
+    assert r["body"] == {"got": {"a": 1}}
+    r = q(ds, 'RETURN api::invoke("/s")')[0].result
+    assert r["status"] == 404 and r["headers"]["x-a"] == "b"
+    # invalid status from middleware -> shaped 400
+    q(ds, """DEFINE API "/bad" FOR get MIDDLEWARE api::res::status(99)
+             THEN { { body: {} } };""")
+    r = q(ds, 'RETURN api::invoke("/bad")')[0].result
+    assert r["status"] == 400 and "Invalid HTTP status code: 99" in r["body"]
+
+
+def test_permissions_for_record_sessions():
+    ds = _ds()
+    q(ds, """
+      DEFINE API "/deny" FOR get PERMISSIONS NONE THEN { { body: {} } };
+      DEFINE API "/allow" FOR get PERMISSIONS FULL
+        THEN { { body: { ok: true } } };
+    """)
+    sess = Session(ns="t", db="t", auth_level="record")
+    r = q(ds, 'RETURN api::invoke("/deny")', sess)[0].result
+    assert r["status"] == 403
+    r = q(ds, 'RETURN api::invoke("/allow")', sess)[0].result
+    assert r["status"] == 200 and r["body"] == {"ok": True}
+
+
+def test_throwing_middleware_is_500_none():
+    ds = _ds()
+    q(ds, """
+      DEFINE FUNCTION fn::boom($req: object, $next: function) { THROW 'x' };
+      DEFINE API "/b" FOR get MIDDLEWARE fn::boom() THEN { { body: {} } };
+    """)
+    from surrealdb_tpu.val import NONE
+
+    r = q(ds, 'RETURN api::invoke("/b")')[0].result
+    assert r["status"] == 500 and r["body"] is NONE

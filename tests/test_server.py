@@ -294,7 +294,9 @@ def test_define_api_served(server):
     s, b = _req(base + "/api/t/t/hello", "GET", None, hdrs)
     assert s == 200 and json.loads(b)["msg"] == "hi"
     s, b = _req(base + "/api/t/t/item/42", "GET", None, hdrs)
-    assert s == 200 and json.loads(b) == "42"
+    # string bodies are written raw as text/plain (serialized bodies come
+    # from api::res::body middleware)
+    assert s == 200 and b == b"42"
 
 
 def test_tls_server(tmp_path):
