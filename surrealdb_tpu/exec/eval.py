@@ -246,6 +246,9 @@ def _e_param(n, ctx):
     if name == "auth":
         return ctx.session.rid if ctx.session.rid is not None else NONE
     if name == "token":
+        tk = getattr(ctx.session, "token", None)
+        if tk is not None:
+            return tk
         return ctx.vars.get("token", NONE)
     if name == "access":
         return ctx.session.ac if ctx.session.ac is not None else NONE
@@ -269,15 +272,15 @@ def _e_param(n, ctx):
 def _session_value(ctx):
     s = ctx.session
     return {
-        "ac": s.ac if s.ac else None,
-        "db": s.db,
-        "exp": None,
-        "id": None,
-        "ip": None,
-        "ns": s.ns,
-        "or": None,
-        "rd": s.rid if s.rid else None,
-        "tk": None,
+        "ac": s.ac if s.ac else NONE,
+        "db": s.db if s.db else NONE,
+        "exp": NONE,
+        "id": NONE,
+        "ip": NONE,
+        "ns": s.ns if s.ns else NONE,
+        "or": NONE,
+        "rd": s.rid if s.rid else NONE,
+        "tk": getattr(s, "token", None) or NONE,
     }
 
 

@@ -4174,15 +4174,39 @@ def _s_define_access(n: DefineAccess, ctx):
     base = n.base
     ns = ctx.session.ns if base in ("ns", "db") else None
     db = ctx.session.db if base == "db" else None
+    # materialize expression-valued config (KEY $key etc.) and validate
+    # the algorithm surface (reference access_type.rs)
+    cfg = dict(n.config)
+    for a in ("key", "issuer_key", "url"):
+        v = cfg.get(a)
+        if isinstance(v, Node):
+            rv = evaluate(v, ctx)
+            cfg[a] = None if rv is NONE else rv
     kdef = K.ac_def(base, ns, db, n.name)
     if _exists_guard(
         ctx, kdef, n.name, "access", n.if_not_exists, n.overwrite,
         msg=(f"The access method '{n.name}' already exists "
              f"{_base_phrase(base, ctx)}"),
     ):
+        # IF NOT EXISTS short-circuits before algorithm validation
         return NONE
+    alg = (cfg.get("alg") or "").upper()
+    ialg = (cfg.get("issuer_alg") or "").upper()
+    if "ES512" in (alg, ialg):
+        raise SdbError(
+            "The ES512 algorithm is not currently supported. "
+            "Please use ES384 or another supported algorithm"
+        )
+    if alg.startswith("HS") and cfg.get("issuer_key") is not None \
+            and cfg.get("key") is not None \
+            and cfg["issuer_key"] != cfg["key"]:
+        raise SdbError(
+            f"Invalid query: Symmetric algorithm {alg} requires the same "
+            "key for signing and verification. Use the same key value for "
+            "both KEY and WITH ISSUER KEY clauses, or omit WITH ISSUER KEY."
+        )
     ctx.txn.set_val(
-        kdef, AccessDef(n.name, base, n.kind, n.config, n.duration, n.comment)
+        kdef, AccessDef(n.name, base, n.kind, cfg, n.duration, n.comment)
     )
     return NONE
 

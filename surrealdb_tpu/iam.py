@@ -50,7 +50,8 @@ _HS_HASHES = {"HS256": "sha256", "HS384": "sha384", "HS512": "sha512"}
 _RS_HASHES = {"RS256": "sha256", "RS384": "sha384", "RS512": "sha512"}
 
 
-def issue_token(ds, claims: dict, ttl_s: int = 3600, cfg: dict | None = None) -> str:
+def issue_token(ds, claims: dict, ttl_s: int = 3600, cfg: dict | None = None,
+                session: Session | None = None) -> str:
     """Issue a JWT. With an access config carrying an issuer key (WITH JWT
     ... [WITH ISSUER KEY]), sign with that key and the configured algorithm
     so the access method can verify its own tokens (reference
@@ -82,7 +83,10 @@ def issue_token(ds, claims: dict, ttl_s: int = 3600, cfg: dict | None = None) ->
                 raise SdbError("There was a problem with authentication")
     header = {"alg": alg, "typ": "JWT"}
     now = int(time.time())
-    payload = {"iat": now, "exp": now + ttl_s, "iss": "surrealdb-tpu", **claims}
+    payload = {"iat": now, "exp": now + ttl_s, "iss": "SurrealDB", **claims}
+    if session is not None:
+        # the verified claims back the $token / $session.tk variables
+        session.token = dict(payload)
     h = _b64(json.dumps(header).encode())
     p = _b64(json.dumps(payload).encode())
     signing = f"{h}.{p}".encode()
@@ -142,6 +146,7 @@ def signin(ds, session: Session, creds: dict) -> str:
                         ds,
                         {"ID": user, "base": base, "NS": n, "DB": d,
                          "roles": list(ud.roles)},
+                        session=session,
                     )
             raise SdbError(
                 "There was a problem with authentication"
@@ -189,9 +194,14 @@ def _record_access(ds, session, ns, db, ac, creds, mode) -> str:
     session.ac = ac
     session.auth_level = "record"
     session.rid = out
+    ttl = 3600
+    dur = getattr(acc, "duration", None) or {}
+    tok_d = dur.get("token") if isinstance(dur, dict) else None
+    if tok_d is not None and hasattr(tok_d, "to_seconds"):
+        ttl = int(tok_d.to_seconds())
     return issue_token(
         ds, {"ID": out.render(), "NS": ns, "DB": db, "AC": ac},
-        cfg=acc.config,
+        ttl_s=ttl, cfg=acc.config, session=session,
     )
 
 
@@ -401,6 +411,7 @@ def authenticate(ds, session: Session, token: str):
             session.ns, session.db, session.ac = pns, pdb, ac
             session.rid = rid
             session.auth_level = "record"
+            session.token = dict(payload)
             return NONE
     payload = verify_token(ds, token)
     if payload.get("AC"):
@@ -422,6 +433,7 @@ def authenticate(ds, session: Session, token: str):
         session.ns, session.db, session.ac = pns, pdb, pac
         session.rid = rid
         session.auth_level = "record"
+        session.token = dict(payload)
     else:
         base = payload.get("base", "root")
         n, d = payload.get("NS"), payload.get("DB")
@@ -438,6 +450,7 @@ def authenticate(ds, session: Session, token: str):
         if ud is None:
             raise SdbError("There was a problem with authentication")
         session.auth_level = _level_from_roles(ud.roles)
+        session.token = dict(payload)
         if n:
             session.ns = n
         if d:

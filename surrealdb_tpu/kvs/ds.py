@@ -25,6 +25,7 @@ class Session:
         self.auth_level = auth_level  # owner | editor | viewer | record | none
         self.rid = rid  # record-auth identity (RecordId)
         self.ac = ac  # access method name
+        self.token = None  # verified JWT claims ($token / $session.tk)
         self.planner_strategy = None  # None | "all-ro" | "compute-only"
         # EXPLAIN ANALYZE: omit volatile attrs (batches/elapsed) so output
         # is deterministic — the language-test harness sets this

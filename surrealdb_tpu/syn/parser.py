@@ -1633,15 +1633,29 @@ class Parser:
             elif self.eat_kw("url"):
                 cfg["url"] = self.ident_or_str()
             elif self.eat_kw("issuer"):
-                self.expect_kw("key")
-                cfg["issuer_key"] = self.name_expr()
+                self._parse_issuer_spec(cfg)
             elif self.eat_kw("with"):
                 self.expect_kw("issuer")
-                self.expect_kw("key")
-                cfg["issuer_key"] = self.name_expr()
+                self._parse_issuer_spec(cfg)
             else:
                 break
         return cfg
+
+    def _parse_issuer_spec(self, cfg):
+        """ISSUER [ALGORITHM alg] [KEY key] (reference access_type.rs
+        issuer grammar)."""
+        found = False
+        while True:
+            if self.eat_kw("algorithm"):
+                cfg["issuer_alg"] = self.ident().upper()
+                found = True
+            elif self.eat_kw("key"):
+                cfg["issuer_key"] = self.name_expr()
+                found = True
+            else:
+                break
+        if not found:
+            raise self.err("expected ALGORITHM or KEY after ISSUER")
 
     def _kind_has_object(self, k) -> bool:
         if k is None:

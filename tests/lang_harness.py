@@ -87,6 +87,8 @@ def parse_test_file(path: str) -> LangTest:
     t.db = None if db is False else (db if isinstance(db, str) else "test")
     t.imports = env.get("imports", [])
     t.auth = env.get("auth")
+    t.signin = env.get("signin")
+    t.signup = env.get("signup")
     ps = env.get("planner-strategy")
     t.planner = ps[0] if isinstance(ps, list) and ps else None
     # tests pinned to a persistent backend (e.g. rocksdb compaction) can't
@@ -175,6 +177,43 @@ def run_lang_test(t: LangTest, ds=None):
             ipath = os.path.join(TESTS_ROOT, imp)
         it = parse_test_file(ipath)
         ds.execute(it.sql, session=sess)
+    # [env] signin / signup: authenticate through the real iam flow and
+    # run the test under the resulting session (reference harness does
+    # the same over the SDK)
+    creds_src = getattr(t, "signup", None) or getattr(t, "signin", None)
+    if isinstance(creds_src, str) and creds_src.strip():
+        from surrealdb_tpu.iam import signin as _si, signup as _su
+
+        cres = ds.execute(f"RETURN {creds_src}", ns=t.ns, db=t.db)[0]
+        if cres.error:
+            return False, f"cannot parse signin/signup creds: {cres.error}"
+        creds = {str(k): v for k, v in (cres.result or {}).items()}
+        run_sess = Session(ns=t.ns, db=t.db, auth_level="none")
+        run_sess.planner_strategy = sess.planner_strategy
+        run_sess.redact_volatile_explain_attrs = True
+        # expected signup/signin failures: [test.results] signup-error
+        err_key = "signup-error" if getattr(t, "signup", None) \
+            else "signin-error"
+        expected_err = None
+        if t.results and isinstance(t.results[0], dict) \
+                and err_key in t.results[0]:
+            expected_err = t.results[0][err_key]
+        try:
+            if getattr(t, "signup", None):
+                _su(ds, run_sess, creds)
+            else:
+                _si(ds, run_sess, creds)
+        except Exception as e:
+            if expected_err is not None:
+                if str(e).strip() == str(expected_err).strip():
+                    return True, "ok"
+                return False, (
+                    f"{err_key} mismatch:\n  want: {expected_err}\n"
+                    f"  got:  {e}"
+                )
+            raise
+        if expected_err is not None:
+            return False, f"expected {err_key} but auth succeeded"
     res = ds.execute(t.sql, session=run_sess)
     if not t.results:
         return True, "no expectations"
