@@ -70,6 +70,7 @@ class IndexDef:
     hnsw: Optional[dict] = None
     fulltext: Optional[dict] = None
     count: bool = False
+    count_cond: Any = None  # COUNT WHERE expr AST
     comment: Optional[str] = None
     # ALTER INDEX ... PREPARE REMOVE: writes still maintain the index but
     # the planner stops reading it (reference alter index decommission)

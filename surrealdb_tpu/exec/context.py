@@ -114,7 +114,8 @@ class Ctx:
             )
 
     def need_ns_db(self):
-        if not self.ns or not self.db:
+        # empty-string names are legal (`USE NS ```) — only None is unset
+        if self.ns is None or self.db is None:
             raise SdbError(
                 "Specify a namespace and database to use"
             )

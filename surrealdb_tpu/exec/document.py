@@ -599,6 +599,24 @@ def _index_values(idef, doc, ctx, rid):
     return vals
 
 
+def _count_cond_matches(idef, doc, ctx, rid) -> bool:
+    """COUNT index membership: the row exists and, for a conditional
+    count index (COUNT WHERE expr), the condition is truthy on the doc."""
+    if not isinstance(doc, dict):
+        return False
+    cond = getattr(idef, "count_cond", None)
+    if cond is None:
+        return True
+    from surrealdb_tpu.err import SdbError
+    from surrealdb_tpu.exec.eval import evaluate
+    from surrealdb_tpu.val import is_truthy
+
+    try:
+        return is_truthy(evaluate(cond, ctx.with_doc(doc, rid)))
+    except SdbError:
+        return False
+
+
 def _index_rows(vals, idef=None):
     """Index-entry combinator (reference idx/index.rs Indexable/Combinator):
     array columns unnest per-element UNLESS the column idiom ends with `…`
@@ -689,8 +707,9 @@ def index_update(rid: RecordId, before, after, ctx: Ctx):
         if idef.count:
             key = K.ix_state(ns, db, rid.tb, idef.name, b"ct")
             cur = ctx.txn.get_val(key) or 0
-            delta = (1 if isinstance(after, dict) else 0) - (
-                1 if isinstance(before, dict) else 0
+            delta = (
+                (1 if _count_cond_matches(idef, after, ctx, rid) else 0)
+                - (1 if _count_cond_matches(idef, before, ctx, rid) else 0)
             )
             ctx.txn.set_val(key, cur + delta)
             continue
@@ -896,6 +915,8 @@ def _single_index_add(idef, rid, doc, ctx):
         fulltext_index_update(idef, rid, NONE, doc, ctx)
         return
     if idef.count:
+        if not _count_cond_matches(idef, doc, ctx, rid):
+            return
         key = K.ix_state(ns, db, rid.tb, idef.name, b"ct")
         cur = ctx.txn.get_val(key) or 0
         ctx.txn.set_val(key, cur + 1)
@@ -1236,15 +1257,6 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
             f"Found record: `{rid.render()}` which is a relation, "
             f"but expected a NORMAL"
         )
-    # permissions
-    if not ctx.session.is_owner and ctx.session.auth_level not in ("editor",):
-        from surrealdb_tpu.exec.statements import check_table_permission
-
-        act = "create" if is_create else "update"
-        if not check_table_permission(rid.tb, act, ctx, after, rid):
-            raise SdbError(
-                f"Not enough permissions to perform this action on table '{rid.tb}'"
-            )
     # edges populate in/out BEFORE field schema so typed in/out coerce
     if edge is not None:
         l, r = edge
@@ -1258,6 +1270,23 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
     # field schema
     after = apply_fields(rid.tb, tdef, before, after, ctx, rid, is_create)
     after["id"] = rid
+    # anonymous / read-only system sessions fail the statement-level IAM
+    # check outright (reference Options::is_allowed, Action::Edit)
+    if ctx.session.auth_level in ("none", "viewer"):
+        raise SdbError(
+            "IAM error: Not enough permissions to perform this action"
+        )
+    # table permissions run AFTER field processing (reference
+    # doc/create.rs pipeline: check_permissions_table follows
+    # process_table_fields) so DEFAULT/VALUE-computed fields participate;
+    # a denied write silently drops the record (doc/check.rs
+    # IgnoreError::Ignore), writing nothing
+    if not ctx.session.is_owner and ctx.session.auth_level not in ("editor",):
+        from surrealdb_tpu.exec.statements import check_table_permission
+
+        act = "create" if is_create else "update"
+        if not check_table_permission(rid.tb, act, ctx, after, rid):
+            return SKIP
     if edge is not None:
         l, r = edge
         # the four graph keys (reference doc/edges.rs:14)
@@ -1535,6 +1564,10 @@ def update_one(rid: RecordId, before: dict, data, output, ctx: Ctx):
 
 def delete_one(rid: RecordId, before, output, ctx: Ctx):
     ns, db = ctx.need_ns_db()
+    if ctx.session.auth_level in ("none", "viewer"):
+        raise SdbError(
+            "IAM error: Not enough permissions to perform this action"
+        )
     if not ctx.session.is_owner and ctx.session.auth_level not in ("editor",):
         from surrealdb_tpu.exec.statements import check_table_permission
 

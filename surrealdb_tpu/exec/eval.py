@@ -273,11 +273,11 @@ def _session_value(ctx):
     s = ctx.session
     return {
         "ac": s.ac if s.ac else NONE,
-        "db": s.db if s.db else NONE,
+        "db": s.db if s.db is not None else NONE,
         "exp": NONE,
         "id": NONE,
         "ip": NONE,
-        "ns": s.ns if s.ns else NONE,
+        "ns": s.ns if s.ns is not None else NONE,
         "or": NONE,
         "rd": s.rid if s.rid else NONE,
         "tk": getattr(s, "token", None) or NONE,
@@ -501,7 +501,15 @@ def call_closure(clo: Closure, args: list, ctx: Ctx):
 def _e_subquery(n, ctx):
     from surrealdb_tpu.exec import statements as st
 
-    return st.eval_statement(n.stmt, ctx.child())
+    c = ctx.child()
+    # inside a subquery $parent is the enclosing statement's $this — the
+    # doc the subquery expression is being computed against (reference
+    # doc/compute: parent binding travels with the subquery frame)
+    pin = ctx.vars.get("this", ctx.doc)
+    if pin is not None:
+        c.parent_doc = pin
+        c.vars["parent"] = pin
+    return st.eval_statement(n.stmt, c)
 
 
 def _e_block(n, ctx):

@@ -276,6 +276,9 @@ def pow_(a, b):
 
 def neg(a):
     if isinstance(a, _NUM) and not isinstance(a, bool):
+        if isinstance(a, int) and -a > (1 << 63) - 1:
+            # i64 overflow: -(i64::MIN) is unrepresentable
+            raise SdbError(f"Cannot negate the value '{_disp(a)}'")
         return -a
     raise SdbError(f"Cannot negate the value '{_disp(a)}'")
 

@@ -453,10 +453,24 @@ def _field_name_sql(name_str: str) -> str:
     # escape each dot segment independently (`value`.sub stays quoted)
     parts = []
     for seg in name_str.split("."):
-        if seg in ("*", "") or seg.startswith("["):
+        if seg == "*" or seg.startswith("["):
             parts.append(seg)
         else:
             parts.append(escape_ident(seg))
+    return ".".join(parts)
+
+
+def field_name_key(name_str: str) -> str:
+    """INFO map key for a field: quote only lexically-invalid segments
+    (keywords stay bare — reference EscapeKey, not EscapeIdent)."""
+    from surrealdb_tpu.val import escape_rid_table
+
+    parts = []
+    for seg in name_str.split("."):
+        if seg == "*" or seg.startswith("["):
+            parts.append(seg)
+        else:
+            parts.append(escape_rid_table(seg))
     return ".".join(parts)
 
 
@@ -521,6 +535,8 @@ def render_index(d) -> str:
         out += " UNIQUE"
     if d.count:
         out += " COUNT"
+        if getattr(d, "count_cond", None) is not None:
+            out += f" WHERE {_expr_sql(d.count_cond)}"
     if d.fulltext is not None:
         ft = d.fulltext
         out += f" FULLTEXT ANALYZER {ft.get('analyzer')}"
@@ -760,8 +776,13 @@ def _jwt_sql(cfg) -> str:
     issuer = cfg.get("issuer_key")
     if issuer is None and sym and cfg.get("key") is not None:
         issuer = cfg.get("key")
-    if issuer is not None:
-        out += " WITH ISSUER KEY '[REDACTED]'"
+    ialg = (cfg.get("issuer_alg") or "").upper()
+    if issuer is not None or ialg:
+        out += " WITH ISSUER"
+        if ialg:
+            out += f" ALGORITHM {ialg}"
+        if issuer is not None:
+            out += " KEY '[REDACTED]'"
     return out
 
 

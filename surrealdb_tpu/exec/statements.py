@@ -212,10 +212,11 @@ def _s_sleep(n: SleepStmt, ctx):
 
 
 def _s_use(n: UseStmt, ctx):
-    if n.ns:
+    # empty-string namespaces/databases are legal (`USE NS ```)
+    if n.ns is not None:
         ctx.session.ns = n.ns
         ctx.ns = n.ns
-    if n.db:
+    if n.db is not None:
         ctx.session.db = n.db
         ctx.db = n.db
     return {
@@ -688,6 +689,8 @@ def _select_pipeline(n: SelectStmt, rows, c):
         if expr == "*":
             continue
         aliases[alias or expr_name(expr)] = expr
+    if n.value is not None and getattr(n, "value_alias", None):
+        aliases[n.value_alias] = n.value
     # GROUP BY
     if n.group is not None:
         if any(e == "*" for e, _a in n.exprs):
@@ -1071,6 +1074,13 @@ def _set_path(doc, segs, v):
             cur[s] = nxt
         cur = nxt
     cur[segs[-1]] = v
+
+
+def _drop_skipped(results):
+    """Filter permission-skipped writes (document.SKIP sentinel)."""
+    from surrealdb_tpu.exec.document import SKIP
+
+    return [r for r in results if r is not SKIP]
 
 
 def _count_only_stmt(n) -> bool:
@@ -3009,6 +3019,7 @@ def _s_create(n: CreateStmt, ctx: Ctx):
         for t in targets:
             ctx.check_deadline()
             results.append(create_one(t, n.data, n.output, ctx))
+    results = _drop_skipped(results)
     results = [r for r in results if r is not NONE or n.output is not None]
     if n.output is not None and n.output.kind == "none":
         return _only_wrap([], n.only) if n.only else []
@@ -3059,9 +3070,7 @@ def _s_insert(n: InsertStmt, ctx: Ctx):
                 results.append(
                     insert_one(into, item, n.ignore, n.update, n.output, ctx)
                 )
-    from surrealdb_tpu.exec.document import SKIP
-
-    results = [r for r in results if r is not SKIP]
+    results = _drop_skipped(results)
     if n.output is not None and n.output.kind == "none":
         return []
     return results
@@ -3096,6 +3105,7 @@ def _s_update(n: UpdateStmt, ctx: Ctx):
             if not is_truthy(evaluate(n.cond, c)):
                 continue
         results.append(update_one(src.rid, src.doc, n.data, n.output, ctx))
+    results = _drop_skipped(results)
     results = [r for r in results if r is not NONE or n.output is None]
     if n.output is not None and n.output.kind == "none":
         return _only_wrap([], False) if not n.only else NONE
@@ -3202,6 +3212,7 @@ def _s_upsert(n: UpsertStmt, ctx: Ctx):
                         results.append(
                             update_one(src.rid, src.doc, n.data, n.output, ctx)
                         )
+    results = _drop_skipped(results)
     results = [r for r in results if r is not NONE or n.output is None]
     if n.output is not None and n.output.kind == "none":
         return []
@@ -3228,13 +3239,11 @@ def _s_delete(n: DeleteStmt, ctx: Ctx):
             if not is_truthy(evaluate(n.cond, c)):
                 continue
         r = delete_one(src.rid, src.doc, n.output, ctx)
-        from surrealdb_tpu.exec.document import SKIP as _SKIP
-
-        if n.output is not None and n.output.kind != "none" and \
-                r is not _SKIP:
+        if n.output is not None and n.output.kind != "none":
             # permission-skipped rows and select-gated outputs drop out;
             # a legitimately-NONE RETURN VALUE stays
             results.append(r)
+    results = _drop_skipped(results)
     return _only_wrap(results, n.only) if n.only else results
 
 
@@ -3258,11 +3267,14 @@ def _s_relate(n: RelateStmt, ctx: Ctx):
         for t in tos:
             fr = _as_rid(f, "in")
             to = _as_rid(t, "id")
-            results.append(relate_one(kind_v, fr, to, n.data, n.output, ctx, n.uniq))
+            results.append(
+                relate_one(kind_v, fr, to, n.data, n.output, ctx, n.uniq)
+            )
     if n.output is not None and n.output.kind == "none":
         return []
     if n.output is None:
         results = [r for r in results if r is not NONE]
+    results = _drop_skipped(results)
     return _only_wrap(results, n.only)
 
 
@@ -3968,6 +3980,7 @@ def _s_define_index(n: DefineIndex, ctx):
         hnsw=n.hnsw,
         fulltext=n.fulltext,
         count=n.count,
+        count_cond=getattr(n, "count_cond", None),
         comment=n.comment,
     )
     ctx.txn.set_val(kdef, idef)
@@ -5042,7 +5055,9 @@ def _s_info(n: InfoStmt, ctx: Ctx):
         out = {"events": {}, "fields": {}, "indexes": {}, "lives": {},
                "tables": {}}
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.fd_prefix(ns, db, tb))):
-            out["fields"][d.name_str] = render_field(d, tb)
+            from surrealdb_tpu.exec.render_def import field_name_key
+
+            out["fields"][field_name_key(d.name_str)] = render_field(d, tb)
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ix_prefix(ns, db, tb))):
             out["indexes"][d.name] = render_index(d)
         for _k, d in ctx.txn.scan_vals(*K.prefix_range(K.ev_prefix(ns, db, tb))):

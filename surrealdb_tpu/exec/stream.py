@@ -712,6 +712,8 @@ def build_select_plan(n, ctx):
     for expr, alias in n.exprs:
         if expr != "*":
             aliases[alias or expr_name(expr)] = expr
+    if n.value is not None and getattr(n, "value_alias", None):
+        aliases[n.value_alias] = n.value
 
     order = list(n.order) if n.order and n.order != "rand" else []
     # ORDER BY id over a plain scan streams in key order already (the

@@ -352,6 +352,7 @@ class SelectStmt(Node):
     exprs: list  # [(expr, alias:str|None)] ; [] + value_expr for VALUE
     what: list  # from targets (exprs)
     value: Optional[Node] = None  # SELECT VALUE expr
+    value_alias: Optional[str] = None  # SELECT VALUE expr AS alias
     omit: list = field(default_factory=list)
     only: bool = False
     with_index: Optional[list] = None  # WITH INDEX a,b | NOINDEX -> []
@@ -547,6 +548,7 @@ class DefineIndex(Node):
     hnsw: Optional[dict] = None  # HnswParams (catalog/schema/index.rs:352)
     fulltext: Optional[dict] = None  # {analyzer, bm25(k1,b), highlights}
     count: bool = False
+    count_cond: Optional[Node] = None  # COUNT WHERE <expr>
     concurrently: bool = False
     comment: Optional[str] = None
 

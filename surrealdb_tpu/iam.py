@@ -59,8 +59,11 @@ def issue_token(ds, claims: dict, ttl_s: int = 3600, cfg: dict | None = None,
     import hashlib
 
     alg, key_bytes, rsa_nd = "HS256", _secret(ds), None
-    if cfg and (cfg.get("alg") or cfg.get("key") or cfg.get("issuer_key")):
-        calg = (cfg.get("alg") or "HS512").upper()
+    if cfg and (cfg.get("alg") or cfg.get("key") or cfg.get("issuer_key")
+                or cfg.get("issuer_alg")):
+        # WITH ISSUER ALGORITHM pins the signing algorithm; otherwise the
+        # verification algorithm doubles as the issuing one
+        calg = (cfg.get("issuer_alg") or cfg.get("alg") or "HS512").upper()
         ikey = cfg.get("issuer_key")
         if calg in _HS_HASHES:
             k = ikey if ikey is not None else cfg.get("key")

@@ -877,12 +877,10 @@ def _index_scan(tb, idef, eq_vals, tail, ctx):
 
     def _emit_range(beg, end):
         if unique:
-            for _k, rid in ctx.txn.scan_vals(beg, end):
-                s = _fetch(rid)
-                if s:
-                    yield s
             # all-NONE rows of unique indexes live in the non-unique
-            # keyspace (duplicates allowed); rebase the bounds there
+            # keyspace (duplicates allowed); rebase the bounds there.
+            # NONE sorts below every value, so those rows come FIRST in
+            # index order (reference range scans interleave by key).
             nb = nonuniq_base + beg[len(base):]
             if end.startswith(base):
                 ne = nonuniq_base + end[len(base):]
@@ -893,6 +891,10 @@ def _index_scan(tb, idef, eq_vals, tail, ctx):
             for k in ctx.txn.keys(nb, ne):
                 _fields, idv = K.decode_index(k, ns, db, tb, idef.name, ncols)
                 s = _fetch(RecordId(tb, idv))
+                if s:
+                    yield s
+            for _k, rid in ctx.txn.scan_vals(beg, end):
+                s = _fetch(rid)
                 if s:
                     yield s
         else:
@@ -1179,6 +1181,7 @@ def explain_plan(tb, cond, ctx, stmt):
                     idxs0 = [i for i in idxs0 if i.name in with_index]
                 cidx = next(
                     (i for i in idxs0 if i.count
+                     and getattr(i, "count_cond", None) is None
                      and not getattr(i, "prepare_remove", False)),
                     None,
                 )

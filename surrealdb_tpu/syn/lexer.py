@@ -197,38 +197,26 @@ def tokenize(src: str) -> list[Token]:
             col += j - i
             i = j
             continue
+        # $`escaped param` / $⟨escaped param⟩
+        if c == "$" and i + 1 < n and src[i + 1] in "`⟨":
+            close = "`" if src[i + 1] == "`" else "⟩"
+            name, j = _lex_quoted_ident(src, i + 1, close, err)
+            push(PARAM, src[start:j], name, start)
+            col += j - i
+            i = j
+            continue
         # backtick / angle-bracket quoted identifiers
         if c == "`":
-            j = i + 1
-            buf = []
-            while j < n and src[j] != "`":
-                if src[j] == "\\" and j + 1 < n:
-                    buf.append(src[j + 1])
-                    j += 2
-                else:
-                    buf.append(src[j])
-                    j += 1
-            if j >= n:
-                err("unterminated ` identifier")
-            push(IDENT, src[start : j + 1], "".join(buf), start)
-            col += j + 1 - i
-            i = j + 1
+            val, j = _lex_quoted_ident(src, i, "`", err)
+            push(IDENT, src[start:j], val, start)
+            col += j - i
+            i = j
             continue
         if c == "⟨":
-            j = i + 1
-            buf = []
-            while j < n and src[j] != "⟩":
-                if src[j] == "\\" and j + 1 < n:
-                    buf.append(src[j + 1])
-                    j += 2
-                else:
-                    buf.append(src[j])
-                    j += 1
-            if j >= n:
-                err("unterminated ⟨ identifier")
-            push(IDENT, src[start : j + 1], "".join(buf), start)
-            col += j + 1 - i
-            i = j + 1
+            val, j = _lex_quoted_ident(src, i, "⟩", err)
+            push(IDENT, src[start:j], val, start)
+            col += j - i
+            i = j
             continue
         # prefixed strings: s' d' u' r' b" f"
         if c in "sdurbf" and i + 1 < n and src[i + 1] in "'\"":
@@ -348,6 +336,45 @@ def tokenize(src: str) -> list[Token]:
     return toks
 
 
+def _lex_quoted_ident(src, i, close, err):
+    """Lex a `backtick` / ⟨angle⟩ identifier starting at src[i] (the
+    opening delimiter); escape sequences match the reference ident lexer
+    (\\0 \\t \\n \\f \\r \\b and literal escapes). Returns (name, end)."""
+    j = i + 1
+    n = len(src)
+    buf = []
+    esc = {"0": "\0", "t": "\t", "n": "\n", "f": "\f", "r": "\r",
+           "b": "\b"}
+    hexd = "0123456789abcdefABCDEF"
+    while j < n and src[j] != close:
+        if src[j] == "\\" and j + 1 < n:
+            e = src[j + 1]
+            if e == "u":
+                # \u{X..X} or \uXXXX, as in strings
+                if j + 2 < n and src[j + 2] == "{":
+                    k = src.find("}", j + 3)
+                    if k < 0 or not all(c in hexd for c in src[j + 3 : k]) \
+                            or not src[j + 3 : k]:
+                        err("Invalid escape sequence in identifier")
+                    buf.append(chr(int(src[j + 3 : k], 16)))
+                    j = k + 1
+                    continue
+                hexs = src[j + 2 : j + 6]
+                if len(hexs) < 4 or any(c not in hexd for c in hexs):
+                    err("Invalid escape sequence in identifier")
+                buf.append(chr(int(hexs, 16)))
+                j += 6
+                continue
+            buf.append(esc.get(e, e))
+            j += 2
+        else:
+            buf.append(src[j])
+            j += 1
+    if j >= n:
+        err(f"unterminated {close} identifier")
+    return "".join(buf), j + 1
+
+
 def _lex_string(src, i, quote, err):
     """Lex a quoted string starting at src[i]==quote; return (value, end)."""
     j = i + 1
@@ -370,19 +397,66 @@ def _lex_string(src, i, quote, err):
             elif e == "0":
                 buf.append("\0")
             elif e == "u":
-                # \u{XXXX} or \uXXXX
+                # \u{X..XXXXXX} (1-6 hex) or \uXXXX (exactly 4 hex,
+                # surrogate pairs combined) — invalid digits, overlong
+                # braces, and lone surrogates are parse errors like the
+                # reference lexer
+                hexd = "0123456789abcdefABCDEF"
                 if j + 2 < n and src[j + 2] == "{":
-                    k = src.find("}", j + 3)
-                    if k < 0:
-                        err("bad unicode escape")
-                    buf.append(chr(int(src[j + 3 : k], 16)))
+                    k = j + 3
+                    while k < n and src[k] != "}":
+                        if src[k] not in hexd:
+                            err(
+                                "Invalid escape sequence, expected `}` or "
+                                "hexadecimal character"
+                            )
+                        if k - (j + 3) >= 6:
+                            err(
+                                "Invalid escape sequence, expected `}` "
+                                "character. Too many hex-digits"
+                            )
+                        k += 1
+                    if k >= n or k == j + 3:
+                        err("Invalid escape sequence, expected "
+                            "hexadecimal character")
+                    cp = int(src[j + 3 : k], 16)
+                    if cp > 0x10FFFF or 0xD800 <= cp <= 0xDFFF:
+                        err("Invalid escape sequence, not a valid "
+                            "unicode codepoint")
+                    buf.append(chr(cp))
                     j = k + 1
                     continue
-                buf.append(chr(int(src[j + 2 : j + 6], 16)))
+                hexs = src[j + 2 : j + 6]
+                if len(hexs) < 4 or any(c not in hexd for c in hexs):
+                    err(
+                        "String contains invalid escape sequence, "
+                        "expected a hexadecimal character"
+                    )
+                cp = int(hexs, 16)
                 j += 6
+                if 0xD800 <= cp <= 0xDBFF:
+                    # high surrogate: a \uDC00-\uDFFF low half must follow
+                    lo = None
+                    if src[j : j + 2] == "\\u":
+                        lhex = src[j + 2 : j + 6]
+                        if len(lhex) == 4 and all(c in hexd for c in lhex):
+                            lv = int(lhex, 16)
+                            if 0xDC00 <= lv <= 0xDFFF:
+                                lo = lv
+                    if lo is None:
+                        err("String contains invalid escape sequence, "
+                            "missing trailing surrogate")
+                    cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00)
+                    j += 6
+                elif 0xDC00 <= cp <= 0xDFFF:
+                    err("String contains invalid escape sequence, "
+                        "unexpected trailing surrogate")
+                buf.append(chr(cp))
                 continue
-            else:
+            elif e in ("\\", "/", "'", '"', "`"):
                 buf.append(e)
+            else:
+                err("Invalid escape sequence")
             j += 2
             continue
         if ch == quote:

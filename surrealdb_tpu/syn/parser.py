@@ -140,7 +140,7 @@ class Parser:
         while self.eat_op(";"):
             pass
         while self.peek().kind != L.EOF:
-            stmts.append(self.parse_stmt())
+            stmts.append(self.parse_stmt(stmt_pos=True))
             if self.peek().kind == L.EOF:
                 break
             if not self.eat_op(";"):
@@ -149,13 +149,22 @@ class Parser:
                 pass
         return stmts
 
-    def parse_stmt(self):
+    def parse_stmt(self, stmt_pos=False):
         t = self.peek()
         if t.kind == L.IDENT:
             kw = t.value.lower()
             m = getattr(self, f"_stmt_{kw}", None)
             if m is not None and kw in _STMT_KEYWORDS:
                 return m()
+        if stmt_pos and t.kind == L.PARAM and self.peek(1).kind == L.OP \
+                and self.peek(1).text == "=":
+            # 1.x-style `$a = 1` assignment statements are removed; only
+            # flagged in true statement positions (query top level and
+            # `{}` blocks) — `IF x THEN $a = 1` stays an equality check
+            raise self.err(
+                "Parameter declarations without `let` are deprecated. "
+                "Replace with `let $a = ...` to keep the previous behavior"
+            )
         return self.parse_expr()
 
     # -- simple statements ---------------------------------------------------
@@ -282,7 +291,7 @@ class Parser:
         while self.eat_op(";"):
             pass
         while not self.at_op("}"):
-            stmts.append(self.parse_stmt())
+            stmts.append(self.parse_stmt(stmt_pos=True))
             if not self.eat_op(";"):
                 # the reference's block parser accepts a new statement
                 # keyword as an implicit separator (fetch/objects.surql)
@@ -327,7 +336,7 @@ class Parser:
         if self.eat_kw("value"):
             s.value = self.parse_expr()
             if self.eat_kw("as"):
-                self._alias_idiom()
+                s.value_alias = self._alias_idiom()
         else:
             s.exprs = self._select_fields()
         if self.eat_kw("omit"):
@@ -1288,6 +1297,9 @@ class Parser:
                 d.unique = True
             elif self.eat_kw("count"):
                 d.count = True
+                if self.eat_kw("where"):
+                    # conditional count index (COUNT WHERE cond)
+                    d.count_cond = self.parse_expr()
             elif self.eat_kw("search", "fulltext"):
                 ft = {"analyzer": None, "bm25": (1.2, 0.75), "highlights": False}
                 while True:
@@ -1357,6 +1369,20 @@ class Parser:
                 d.comment = self._comment_value()
             else:
                 break
+        # reference define.rs index validation (parse-time)
+        if d.count and d.cols:
+            raise self.err(
+                "Count indexes do not index fields - remove the FIELDS "
+                "clause"
+            )
+        if not d.cols and not d.count:
+            raise self.err(
+                "Expected at least one column - Use FIELDS to define columns"
+            )
+        if getattr(d, "fulltext", None) and len(d.cols) > 1:
+            raise self.err(
+                "Fulltext indexes can only index a single field"
+            )
         return d
 
     def _parse_distance(self):
@@ -2368,7 +2394,13 @@ class Parser:
             if t.kind == L.INT and t.value == (1 << 63):
                 # i64::MIN: the one magnitude only valid when negated
                 self.next()
-                return Literal(-(1 << 63))
+                return self._parse_postfix(Literal(-(1 << 63)))
+            if t.kind in (L.INT, L.FLOAT) and not t.ws_before:
+                # `-13` lexes as a negative literal, so postfix binds the
+                # negated value: -13.abs() == 13 (reference lexer folds the
+                # sign into the number token)
+                self.next()
+                return self._parse_postfix(Literal(-t.value))
             return Prefix("-", self._parse_unary())
         if self.at_op("!"):
             self.next()
@@ -2699,10 +2731,24 @@ class Parser:
         if k == L.FILE_STR:
             self.next()
             v = t.value
-            if ":" in v:
-                bucket, key = v.split(":", 1)
-            else:
-                bucket, key = v, ""
+            # bucket grammar: alnum/_/-/. then `:/` (reference file lexer)
+            if ":" not in v:
+                raise self.err(
+                    "Unexpected end of file string, missing bucket "
+                    "seperator `:/`"
+                )
+            bucket, key = v.split(":", 1)
+            for ch in bucket:
+                if not (ch.isalnum() or ch in "_-."):
+                    raise self.err(
+                        f"Unexpected character `{ch}`, file strings "
+                        "buckets only allow alpha numeric characters and "
+                        "`_`, `-`, and `.`"
+                    )
+            if not key.startswith("/"):
+                raise self.err(
+                    f"Unexpected character `{key[:1] or ''}`, expected `/`"
+                )
             return Literal(File(bucket, key))
         if k == L.RECORD_STR:
             self.next()

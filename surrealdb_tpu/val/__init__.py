@@ -196,7 +196,7 @@ class Datetime:
     def parse(cls, text: str) -> "Datetime":
         m = _re.match(
             r"^([+-]?\d{4,6})-(\d{2})-(\d{2})"
-            r"(?:[Tt ](\d{2}):(\d{2}):(\d{2})(?:\.(\d{1,9}))?"
+            r"(?:[Tt ](\d{2}):(\d{2}):(\d{2})(?:\.(\d+))?"
             r"(Z|z|[+-]\d{2}:\d{2})?)?$",
             text,
         )
@@ -206,8 +206,18 @@ class Datetime:
         h = int(m[4] or 0)
         mi = int(m[5] or 0)
         s = int(m[6] or 0)
-        frac = (m[7] or "").ljust(9, "0")
-        ns = int(frac) if frac else 0
+        digits = m[7] or ""
+        if len(digits) <= 9:
+            ns = int(digits.ljust(9, "0")) if digits else 0
+        else:
+            # sub-nanosecond digits round half-up (chrono parse behavior)
+            ns = int(digits[:9])
+            if digits[9] >= "5":
+                ns += 1
+        extra_s = 0
+        if ns >= 1_000_000_000:
+            ns -= 1_000_000_000
+            extra_s = 1
         tz = m[8]
         if tz and tz not in ("Z", "z"):
             sign = 1 if tz[0] == "+" else -1
@@ -215,7 +225,11 @@ class Datetime:
             tzinfo = _dt.timezone(off)
         else:
             tzinfo = _dt.timezone.utc
-        return cls.from_parts(y, mo, d, h, mi, s, ns, tzinfo)
+        out = cls.from_parts(y, mo, d, h, mi, s, ns, tzinfo)
+        if extra_s:
+            out = cls(out.dt + _dt.timedelta(seconds=1), out.ns_frac,
+                      out.year_shift)
+        return out
 
     @property
     def year(self) -> int:
@@ -865,10 +879,20 @@ RESERVED_IDENTS = {
 }
 
 
+def _escape_ident_body(s: str) -> str:
+    # control characters render as backslash sequences inside backticks
+    # (reference EscapeIdent)
+    return (
+        s.replace("\\", "\\\\").replace("`", "\\`").replace("\0", "\\0")
+        .replace("\t", "\\t").replace("\n", "\\n").replace("\f", "\\f")
+        .replace("\r", "\\r")
+    )
+
+
 def escape_ident(s: str) -> str:
     if _IDENT_RX.match(s) and s.upper() not in RESERVED_IDENTS:
         return s
-    return "`" + s.replace("\\", "\\\\").replace("`", "\\`") + "`"
+    return "`" + _escape_ident_body(s) + "`"
 
 
 def escape_rid_table(s: str) -> str:
@@ -877,7 +901,7 @@ def escape_rid_table(s: str) -> str:
     position is unambiguous."""
     if _IDENT_RX.match(s):
         return s
-    return "`" + s.replace("\\", "\\\\").replace("`", "\\`") + "`"
+    return "`" + _escape_ident_body(s) + "`"
 
 
 def render_record_id_key(id) -> str:
