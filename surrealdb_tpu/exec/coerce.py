@@ -259,8 +259,14 @@ def coerce(v, kind: Kind):
             raise coerce_err(v, kind)
         if kind.inner:
             v = [coerce(x, kind.inner[0]) for x in v]
-        if kind.size is not None and len(v) > kind.size:
-            raise coerce_err(v, kind)
+        if kind.size is not None and len(v) != kind.size:
+            # sized collections demand the exact length (reference
+            # coerce.rs: array<T, N> is a fixed size, issue 5677)
+            inner_n = kind_name(kind.inner[0]) if kind.inner else "any"
+            raise SdbError(
+                f"Expected `array<{inner_n},{kind.size}>` but found a "
+                f"collection of length `{len(v)}`"
+            )
         return v
     if n == "set":
         from surrealdb_tpu.val import SSet
@@ -274,8 +280,12 @@ def coerce(v, kind: Kind):
         if kind.inner:
             items = [coerce(x, kind.inner[0]) for x in items]
         out = SSet(items)
-        if kind.size is not None and len(out) > kind.size:
-            raise coerce_err(v, kind)
+        if kind.size is not None and len(out) != kind.size:
+            inner_n = kind_name(kind.inner[0]) if kind.inner else "any"
+            raise SdbError(
+                f"Expected `set<{inner_n},{kind.size}>` but found a "
+                f"collection of length `{len(out)}`"
+            )
         return out
     if n == "object":
         if isinstance(v, dict):

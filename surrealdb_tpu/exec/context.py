@@ -18,7 +18,7 @@ class Ctx:
         "ds", "session", "txn", "vars", "doc", "doc_id", "parent_doc",
         "executor", "ns", "db", "knn", "record_cache", "deadline",
         "timeout_dur", "write_version", "depth",
-        "perms_enabled", "version", "_cond_consumed", "_cf_seq",
+        "perms_enabled", "version", "_cond_consumed", "_cf_seq", "_in_perm_check",
         "_brute_knn_k", "_strict_readonly", "_stream_cols", "_no_link_fetch", "_script_depth",
     )
 
@@ -40,6 +40,7 @@ class Ctx:
         self.write_version = None  # CREATE/INSERT ... VERSION (epoch ns)
         self.depth = 0
         self.perms_enabled = False  # row-level permissions active
+        self._in_perm_check = False  # evaluating a PERMISSIONS clause
         self.version = None  # VERSION clause timestamp
         self._cond_consumed = False  # planner handled the WHERE clause
         self._cf_seq = 0
@@ -75,6 +76,7 @@ class Ctx:
         c._cf_seq = 0
         c._brute_knn_k = self._brute_knn_k
         c._strict_readonly = self._strict_readonly
+        c._in_perm_check = self._in_perm_check
         c._stream_cols = self._stream_cols
         c._no_link_fetch = self._no_link_fetch
         c._script_depth = self._script_depth

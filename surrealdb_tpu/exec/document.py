@@ -715,7 +715,7 @@ def index_update(rid: RecordId, before, after, ctx: Ctx):
             continue
         if idef.unique:
             for row in old_rows:
-                if all(x is NONE or x is None for x in row):
+                if any(x is NONE or x is None for x in row):
                     # NONE rows live in the non-unique keyspace (duplicates
                     # allowed; reference indexes None without the constraint)
                     ctx.txn.delete(
@@ -727,7 +727,7 @@ def index_update(rid: RecordId, before, after, ctx: Ctx):
                 if existing is not None and value_eq(existing, rid):
                     ctx.txn.delete(k)
             for row in new_rows:
-                if all(x is NONE or x is None for x in row):
+                if any(x is NONE or x is None for x in row):
                     ctx.txn.set_val(
                         K.index(ns, db, rid.tb, idef.name, row, rid.id),
                         rid,
@@ -924,7 +924,12 @@ def _single_index_add(idef, rid, doc, ctx):
     rows = _index_rows(_index_values(idef, doc, ctx, rid), idef)
     if idef.unique:
         for row in rows:
-            if all(x is NONE or x is None for x in row):
+            if any(x is NONE or x is None for x in row):
+                # rows with a NONE column skip the unique constraint (SQL
+                # NULL semantics, issue 3290) but stay range-scannable
+                ctx.txn.set_val(
+                    K.index(ns, db, rid.tb, idef.name, row, rid.id), rid
+                )
                 continue
             k = K.index_unique(ns, db, rid.tb, idef.name, row)
             existing = ctx.txn.get_val(k)
@@ -1420,7 +1425,7 @@ def _find_unique_conflict(tb, doc, rid, ctx):
             continue
         rows = _index_rows(_index_values(idef, doc, ctx, rid), idef)
         for row in rows:
-            if all(x is NONE or x is None for x in row):
+            if any(x is NONE or x is None for x in row):
                 continue
             existing = ctx.txn.get_val(K.index_unique(ns, db, tb, idef.name, row))
             if existing is not None and not value_eq(existing, rid):

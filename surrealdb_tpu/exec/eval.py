@@ -62,6 +62,12 @@ def version_ns(v) -> int:
         return v.epoch_ns()
     if isinstance(v, int) and not isinstance(v, bool):
         return v
+    if isinstance(v, str):
+        # string datetimes coerce (reference VERSION computes to datetime)
+        try:
+            return Datetime.parse(v).epoch_ns()
+        except ValueError:
+            pass
     raise SdbError(f"Expected a datetime but found {render(v)}")
 
 
@@ -899,6 +905,8 @@ def _csr_pair_hop(val, g1, g2, ctx):
 
     if not isinstance(g2, _PG):
         return None
+    if ctx.version is not None:
+        return None  # CSR caches HEAD state; VERSION reads use key scans
     for g in (g1, g2):
         if (
             g.cond is not None
@@ -938,6 +946,8 @@ def _csr_bag_pair_hop(val, g1, g2, ctx):
     pat = _csr_pair_pattern(g1, g2)
     if pat is None:
         return None
+    if ctx.version is not None:
+        return None  # CSR caches HEAD state; VERSION reads use key scans
     edge_tb, node_tb, _dir = pat
     rids = _collect_rids(val, ctx)
     if not rids or any(r.tb != node_tb for r in rids):

@@ -396,6 +396,11 @@ def check_table_permission(tb: str, action: str, ctx: Ctx, doc=None, rid=None):
     truthy if the action is allowed for the session on this doc."""
     if ctx.session.is_owner or ctx.session.auth_level in ("editor",):
         return True
+    if ctx._in_perm_check:
+        # permission clauses evaluate with permissions disabled
+        # (reference opt.new_with_perms(false)) — cyclic record links in
+        # a predicate subquery must not recurse into more checks
+        return True
     ns, db = ctx.need_ns_db()
     tdef = ctx.txn.get_val(K.tb_def(ns, db, tb))
     if tdef is None or tdef.permissions is None:
@@ -404,6 +409,7 @@ def check_table_permission(tb: str, action: str, ctx: Ctx, doc=None, rid=None):
     if p is True or p is False:
         return p
     c = ctx.with_doc(doc, rid)
+    c._in_perm_check = True
     return is_truthy(evaluate(p, c))
 
 
@@ -612,7 +618,30 @@ def _s_select(n: SelectStmt, ctx: Ctx):
         return _explain_select(n, c)
     # VERSION clause
     if n.version is not None:
+        from surrealdb_tpu.expr.ast import Subquery as _Subq
+
+        if any(isinstance(w, _Subq) for w in n.what):
+            raise SdbError(
+                "Invalid query: VERSION clause cannot be used with a "
+                "subquery source. Place the VERSION clause inside the "
+                "subquery instead."
+            )
         c.version = evaluate(n.version, ctx)
+        from surrealdb_tpu.exec.eval import version_ns as _vns
+
+        vts = _vns(c.version)
+        for w in n.what:
+            # only bare-ident targets name a table statically; anything
+            # else must NOT be evaluated here (it runs again in
+            # iterate_targets — double side effects)
+            tbn = None
+            if isinstance(w, Idiom) and len(w.parts) == 1 and \
+                    isinstance(w.parts[0], PField):
+                tbn = w.parts[0].name
+            if tbn is not None:
+                ns_v, db_v = c.need_ns_db()
+                if c.txn.get_val_at(K.tb_def(ns_v, db_v, tbn), vts) is None:
+                    raise SdbError(f"The table '{tbn}' does not exist")
     # streaming batched operator engine (execution engine A) for eligible
     # plain-scan shapes; everything else stays on the legacy recursive
     # path (reference plan_or_compute.rs legacy fallback)
@@ -1272,14 +1301,14 @@ def _binary_aggregate(expr, members, ctx):
 
 
 def _resolve_alias(expr, aliases):
-    """A bare-field ORDER/GROUP item naming a projection alias resolves to
-    the aliased expression."""
+    """A field-path ORDER/GROUP item naming a projection alias (including
+    nested aliases like `AS b.c`) resolves to the aliased expression."""
     if not aliases:
         return expr
-    if isinstance(expr, Idiom) and len(expr.parts) == 1 and isinstance(
-        expr.parts[0], PField
+    if isinstance(expr, Idiom) and expr.parts and all(
+        isinstance(p, PField) for p in expr.parts
     ):
-        name = expr.parts[0].name
+        name = ".".join(p.name for p in expr.parts)
         if name in aliases and aliases[name] is not expr:
             return aliases[name]
     return expr
@@ -1927,12 +1956,16 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                     n.limit is not None
                     and n.group is None
                     and (not n.order or n.order == [])
-                    and n.start is None
                     and single_target
                 ):
                     pushed_limit = int(evaluate(n.limit, ctx))
                     limattr = f", limit: {pushed_limit}"
                     n = _strip_limit(n)
+                    if n.start is not None:
+                        # START pushes with LIMIT (reference limit/offset
+                        # pushdown into the index scan)
+                        limattr += f", offset: {int(evaluate(n.start, ctx))}"
+                        n = _strip_start(n)
                 label = (
                     f"IndexScan [ctx: Db] [index: {idef.name}, access: {acc}, "
                     f"direction: {direction}{limattr}]"
@@ -2010,6 +2043,21 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                     f", direction: {direction}{limattr}]"
                 )
                 n = _strip_limit(_strip_order(n))
+        if label is None and n.cond is not None and single_target:
+            # point lookup: a conjunct `id = <record>` scans one record
+            # (reference RecordIdScan)
+            prid = _id_eq_rid(n.cond, tb)
+            if prid is not None:
+                from surrealdb_tpu.exec.stream import _inline_params
+
+                pred_s = _expr_sql(
+                    _elide_count_args(_inline_params(n.cond, ctx))
+                )
+                label = (
+                    f"RecordIdScan [ctx: Db] [record_id: {prid.render()}, "
+                    f"predicate: {pred_s}]"
+                )
+                residual = None
         if label is None:
             extra = ""
             if n.cond is not None and single_target:
@@ -2018,7 +2066,7 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 # explain/complex.surql). Params render inlined: physical
                 # exprs hold evaluated constants.
                 from surrealdb_tpu.exec.stream import _inline_params
-                extra += f", predicate: {_expr_sql(_inline_params(n.cond, ctx))}"
+                extra += f", predicate: {_expr_sql(_elide_count_args(_inline_params(n.cond, ctx)))}"
                 residual = None
             if (
                 n.limit is not None
@@ -2234,7 +2282,8 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
             # SelectProject (explain/select_basic, count_range_keys_only
             # vs reverse_iterator_range)
             only_rid_scans = scans and all(
-                entry[0].startswith("RecordIdScan") for entry in scans
+                entry[0].startswith("RecordIdScan")
+                and "predicate:" not in entry[0] for entry in scans
             ) and not (n.order and n.order != "rand") and n.limit is None
             graph_projs = bool(n.exprs) and all(
                 e != "*" and isinstance(e, Idiom)
@@ -2369,6 +2418,63 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
     return _render_tree(ordered, analyze, out_rows_n)
 
 
+def _id_eq_rid(cond, tb):
+    """A top-level AND conjunct `id = <record>` / `<record> = id` (or ==)
+    naming the scanned table -> the RecordId, else None (RecordIdScan)."""
+    from surrealdb_tpu.expr.ast import Binary as _B, Literal as _L
+
+    preds = []
+    from surrealdb_tpu.idx.planner import _split_ands
+
+    _split_ands(cond, preds)
+    for p in preds:
+        if not (isinstance(p, _B) and p.op in ("=", "==")):
+            continue
+        for lhs, rhs in ((p.lhs, p.rhs), (p.rhs, p.lhs)):
+            if isinstance(lhs, Idiom) and len(lhs.parts) == 1 and \
+                    isinstance(lhs.parts[0], PField) and \
+                    lhs.parts[0].name == "id":
+                v = None
+                if isinstance(rhs, _L) and isinstance(rhs.value, RecordId):
+                    v = rhs.value
+                else:
+                    from surrealdb_tpu.expr.ast import RecordIdLit as _RL
+
+                    if isinstance(rhs, _RL):
+                        try:
+                            from surrealdb_tpu.exec.static_eval import (
+                                static_value,
+                            )
+
+                            v = static_value(rhs)
+                        except Exception:
+                            v = None
+                if isinstance(v, RecordId) and v.tb == tb and \
+                        not isinstance(v.id, Range):
+                    return v
+    return None
+
+
+def _elide_count_args(node):
+    """Predicate labels render count(->edge) as count(...) (reference
+    count-exists rewriter plan text)."""
+    import copy as _copy
+
+    from surrealdb_tpu.expr.ast import Binary as _B, Constant as _C
+    from surrealdb_tpu.expr.ast import FunctionCall as _FC
+
+    if isinstance(node, _FC) and node.name.lower() == "count" and node.args:
+        n2 = _copy.copy(node)
+        n2.args = [_C("...")]
+        return n2
+    if isinstance(node, _B):
+        n2 = _copy.copy(node)
+        n2.lhs = _elide_count_args(node.lhs)
+        n2.rhs = _elide_count_args(node.rhs)
+        return n2
+    return node
+
+
 def _strip_order(n):
     import copy as _copy
 
@@ -2382,6 +2488,14 @@ def _strip_limit(n):
 
     n2 = _copy.copy(n)
     n2.limit = None
+    return n2
+
+
+def _strip_start(n):
+    import copy as _copy
+
+    n2 = _copy.copy(n)
+    n2.start = None
     return n2
 
 
@@ -4132,10 +4246,20 @@ def _s_define_analyzer(n: DefineAnalyzer, ctx):
     return NONE
 
 
+_BASE_RANK = {"root": 0, "ns": 1, "db": 2}
+
+
 def _s_define_user(n: DefineUser, ctx):
     from surrealdb_tpu.fnc.misc_fns import password_hash
 
     base = n.base
+    # a principal can only manage users at or below its own base
+    # (reference Options::is_allowed level check / fn auth_limit)
+    sess_base = getattr(ctx.session, "auth_base", "root")
+    if _BASE_RANK.get(base, 2) < _BASE_RANK.get(sess_base, 0):
+        raise SdbError(
+            "IAM error: Not enough permissions to perform this action"
+        )
     if base in ("ns", "db") and not ctx.session.ns:
         raise SdbError("Specify a namespace to use")
     if base == "db" and not ctx.session.db:
@@ -5398,7 +5522,8 @@ def _import_silences(fn):
     def wrapped(n, ctx):
         out = fn(n, ctx)
         if getattr(ctx.executor, "import_mode", False):
-            return NONE
+            # the statement's natural empty shape: ONLY -> NONE, else []
+            return NONE if getattr(n, "only", False) else []
         return out
 
     return wrapped

@@ -745,7 +745,9 @@ def build_select_plan(n, ctx):
     pushed_limit = pushed_offset = None
     extra = ""
     if n.cond is not None:
-        extra += f", predicate: {_expr_sql(_inline_params(n.cond, ctx))}"
+        from surrealdb_tpu.exec.statements import _elide_count_args
+
+        extra += f", predicate: {_expr_sql(_elide_count_args(_inline_params(n.cond, ctx)))}"
     if not order and (lim is not None or off):
         pushed_limit = lim
         if lim is not None:

@@ -207,6 +207,26 @@ def call_custom(name, args, ctx):
     fd = ctx.txn.get_val(K.fc_def(ns, db, name))
     if not isinstance(fd, FunctionDef):
         raise SdbError(f"The function 'fn::{name}' does not exist")
+    # PERMISSIONS gate record/anonymous sessions (reference fnc/mod.rs
+    # checks the function permission before invocation)
+    if getattr(ctx.session, "auth_level", "owner") in ("record", "none"):
+        perm = getattr(fd, "permissions", True)
+        # no PERMISSIONS clause defaults to FULL (reference define/function)
+        allowed = perm is True or perm is None
+        if perm not in (True, False, None):
+            from surrealdb_tpu.val import is_truthy
+
+            # the clause evaluates with row permissions disabled, like
+            # table PERMISSIONS (reference new_with_perms(false)); real
+            # evaluation errors propagate rather than read as denials
+            c0 = ctx.child()
+            c0.vars["auth"] = getattr(ctx.session, "rid", None) or NONE
+            c0._in_perm_check = True
+            allowed = is_truthy(evaluate(perm, c0))
+        if not allowed:
+            raise SdbError(
+                f"You don't have permission to run the fn::{name} function"
+            )
     # arity: trailing option<>/any params are optional (reference fnc
     # custom: custom_optional_args.surql — a middle optional still makes
     # every later position mandatory)

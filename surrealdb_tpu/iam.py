@@ -141,6 +141,7 @@ def signin(ds, session: Session, creds: dict) -> str:
                 ud = txn.get_val(K.us_def(base, n, d, user))
                 if ud is not None and password_compare(ud.passhash, passwd or ""):
                     session.auth_level = _level_from_roles(ud.roles)
+                    session.auth_base = base
                     if n:
                         session.ns = n
                     if d:
@@ -453,6 +454,7 @@ def authenticate(ds, session: Session, token: str):
         if ud is None:
             raise SdbError("There was a problem with authentication")
         session.auth_level = _level_from_roles(ud.roles)
+        session.auth_base = payload.get("base", "root")
         session.token = dict(payload)
         if n:
             session.ns = n

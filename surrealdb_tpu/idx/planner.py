@@ -916,7 +916,7 @@ def _index_scan(tb, idef, eq_vals, tail, ctx):
                     s = _fetch(rid)
                     if s:
                         yield s
-                elif all(x is NONE or x is None for x in eq_vals):
+                elif any(x is NONE or x is None for x in eq_vals):
                     # all-NONE rows are stored without the unique
                     # constraint; scan the rebased non-unique range
                     yield from _emit_range(*K.prefix_range(prefix))

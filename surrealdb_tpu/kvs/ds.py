@@ -26,6 +26,10 @@ class Session:
         self.rid = rid  # record-auth identity (RecordId)
         self.ac = ac  # access method name
         self.token = None  # verified JWT claims ($token / $session.tk)
+        # the base the authenticated principal is scoped to: root | ns |
+        # db. DDL at a broader base than this fails the IAM check
+        # (reference Options auth level / auth_limit)
+        self.auth_base = "root"
         self.planner_strategy = None  # None | "all-ro" | "compute-only"
         # EXPLAIN ANALYZE: omit volatile attrs (batches/elapsed) so output
         # is deterministic — the language-test harness sets this

@@ -21,14 +21,21 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("filter", nargs="?", default=None)
-    ap.add_argument("--subdir", default="language")
+    # the default gate covers EVERY ported suite so none regress silently
+    # (VERDICT r4 item 3); pass --subdir language etc. to narrow
+    ap.add_argument("--subdir", default="all")
     ap.add_argument("--failures", type=int, default=0)
     ap.add_argument("-v", action="store_true")
     args = ap.parse_args()
 
     from lang_harness import discover, parse_test_file, run_lang_test
 
-    files = discover(args.subdir, args.filter)
+    if args.subdir == "all":
+        files = []
+        for sd in ("language", "api", "access", "parsing", "reproductions"):
+            files.extend(discover(sd, args.filter))
+    else:
+        files = discover(args.subdir, args.filter)
     passed = failed = errored = skipped = 0
     fail_list = []
     by_dir: dict = {}
