@@ -722,11 +722,23 @@ def walk(val, parts, ctx: Ctx, depth=0):
                 return [walk(x, parts[i:], ctx, depth + 1) for x in val]
             nxt = parts[i + 1] if i + 1 < len(parts) else None
             if nxt is not None:
-                fast = _csr_bag_pair_hop(val, part, nxt, ctx)
+                # fold a run of identical `->edge->node` pairs into ONE
+                # index-space multi-hop (frontiers never materialize
+                # between hops — the raw-CSR schedule)
+                pat = _csr_pair_pattern(part, nxt)
+                hops = 1
+                if pat is not None:
+                    j = i + 2
+                    while j + 1 < len(parts) and _csr_pair_pattern(
+                        parts[j], parts[j + 1]
+                    ) == pat:
+                        hops += 1
+                        j += 2
+                fast = _csr_bag_pair_hop(val, part, nxt, ctx, hops)
                 if fast is not None:
                     val = fast
                     from_graph = True
-                    i += 1
+                    i += 2 * hops - 1
                     continue
             val = _apply_graph(val, part, ctx)
             from_graph = True
@@ -938,7 +950,7 @@ def _csr_pair_hop(val, g1, g2, ctx):
     return [RecordId(node_tb, k) for k in keys]
 
 
-def _csr_bag_pair_hop(val, g1, g2, ctx):
+def _csr_bag_pair_hop(val, g1, g2, ctx, hops=1):
     """Host CSR fast path for plain `->edge->node` chain pairs with BAG
     semantics. Engages when the adjacency cache is already valid, or the
     frontier is large enough to amortize a build; returns None to fall
@@ -961,7 +973,7 @@ def _csr_bag_pair_hop(val, g1, g2, ctx):
     # alignment guard: a chain that fell back mid-way can present
     # (node, edge) in swapped roles — only pair when the first table is
     # a declared RELATION (the bench/graph schema norm)
-    tdef = ctx.txn.get_val(K.tb_def(ns, db, edge_tb))
+    tdef = ctx.txn.peek_val(K.tb_def(ns, db, edge_tb))
     if tdef is None or getattr(tdef, "kind", None) != "relation":
         return None
     from surrealdb_tpu.graph.csr import peek_csr
@@ -976,8 +988,8 @@ def _csr_bag_pair_hop(val, g1, g2, ctx):
     csr = get_csr(ctx.ds, ctx, node_tb, edge_tb, g1.dir)
     if not len(csr.rows):
         return None  # empty adjacency: per-record scans are authoritative
-    keys = csr.hop_bag([r.id for r in rids])
-    return [RecordId(node_tb, k) for k in keys]
+    idxs = csr.hop_bag_idx([r.id for r in rids], hops)
+    return csr.materialize_rids(idxs, node_tb)
 
 
 def _apply_graph(val, g: PGraph, ctx: Ctx):

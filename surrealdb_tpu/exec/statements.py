@@ -4645,7 +4645,7 @@ def _supports_compaction(ctx) -> bool:
 def _s_alter(n: AlterTable, ctx: Ctx):
     ns, db = ctx.need_ns_db()
     key = K.tb_def(ns, db, n.name)
-    tdef = ctx.txn.get_val(key)
+    tdef = ctx.txn.take_val(key)
     if tdef is None:
         if n.if_exists:
             return NONE
@@ -4716,7 +4716,7 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
             from surrealdb_tpu.catalog import ConfigDef
 
             key = K.cfg_def(ns, db, "DEFAULT")
-            d = ctx.txn.get_val(key)
+            d = ctx.txn.take_val(key)
             if not isinstance(d, ConfigDef):
                 d = ConfigDef("DEFAULT")
             for k2 in ("namespace", "database"):
@@ -4726,7 +4726,7 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
             ctx.txn.set_val(key, d)
             return NONE
         key = K.cfg_def(ns, db, what)
-        d = ctx.txn.get_val(key)
+        d = ctx.txn.take_val(key)
         if d is None:
             if n.if_exists:
                 return NONE
@@ -4749,7 +4749,7 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
                     )
                 if clause == "query_timeout":
                     skey = K.sys_cfg()
-                    cfg = ctx.txn.get_val(skey) or {}
+                    cfg = ctx.txn.take_val(skey) or {}
                     if value == "__drop__":
                         cfg.pop("QUERY_TIMEOUT", None)
                     else:
@@ -4767,7 +4767,7 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
     if kind in ("api", "bucket"):
         keyf = K.api_def if kind == "api" else K.bucket_def
         key = keyf(ns, db, n.name)
-        d = ctx.txn.get_val(key)
+        d = ctx.txn.take_val(key)
         if d is None:
             if n.if_exists:
                 return NONE
@@ -4847,7 +4847,7 @@ def _s_alter_other(n: AlterStmt, ctx: Ctx):
         "sequence": lambda: K.seq_state(ns, db, n.name),
     }
     key = keymap[kind]()
-    stored = ctx.txn.get_val(key)
+    stored = ctx.txn.take_val(key)
     if stored is None:
         if n.if_exists:
             return NONE

@@ -368,6 +368,81 @@ class SortOp(Operator):
             yield rows[s:s + BATCH_SIZE]
 
 
+class VecTopKScanOp(Operator):
+    """Columnar brute-force vector top-k: ORDER BY a recognized vector
+    expression with LIMIT over a full table scan rides the persistent
+    column store (col.py + the native C++ extraction kernel) — score the
+    whole table in one numpy call, then materialize ONLY the winning
+    rows. The winners' projected scores recompute per-row in f64 from
+    the fetched documents, so output values are bit-identical to the
+    row-at-a-time engine; only the ranking runs on the f32 column.
+    Reference role: exec/operators/knn_topk.rs (KnnTopK scan operator)."""
+
+    def __init__(self, tb, spec, keep, skip, desc, label):
+        super().__init__()
+        self.tb = tb
+        self.spec = spec  # (kind, parts, qvec, expr)
+        self.keep = keep
+        self.skip = skip
+        self.desc = desc
+        self.label = label
+
+    def _execute(self, ctx):
+        from surrealdb_tpu import key as K
+        from surrealdb_tpu.col import get_vector_column
+        from surrealdb_tpu.exec.eval import fetch_record
+        from surrealdb_tpu.exec.statements import Source
+        from surrealdb_tpu.val import RecordId
+
+        ns, db = ctx.need_ns_db()
+        if ctx.txn.get(K.tb_def(ns, db, self.tb)) is None:
+            raise SdbError(f"The table '{self.tb}' does not exist")
+        kind, parts, qv, _expr = self.spec
+        col = get_vector_column(ctx, self.tb, parts[0], qv.shape[0])
+        if col is None or col.bad_ids:
+            # dirty overlay or non-conforming rows: the planner guards
+            # against engaging here, but races resolve to the safe path
+            raise _FallbackToLegacy()
+        m = col.mat
+        qf = qv.astype(np.float32)
+        if kind == "cos_sim":
+            dots = m @ qf
+            denom = np.linalg.norm(m, axis=1) * np.linalg.norm(qf)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                scores = dots / denom
+        elif kind == "eucl":
+            scores = np.linalg.norm(m - qf[None, :], axis=1)
+        elif kind == "manh":
+            scores = np.abs(m - qf[None, :]).sum(axis=1)
+        else:  # dot
+            scores = m @ qf
+        n_rows = scores.shape[0]
+        k = min(self.keep, n_rows)
+        key = -scores if self.desc else scores
+        if k < n_rows:
+            part = np.argpartition(key, k - 1)[:k]
+            order = part[np.argsort(key[part], kind="stable")]
+        else:
+            order = np.argsort(key, kind="stable")
+        order = order[self.skip:]
+        batch = []
+        for i in order:
+            rid = RecordId(self.tb, col.ids[int(i)])
+            doc = fetch_record(ctx, rid)
+            if doc is NONE:
+                continue
+            batch.append(Source(rid=rid, doc=doc))
+            if len(batch) >= BATCH_SIZE:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class _FallbackToLegacy(Exception):
+    """Raised mid-plan when a columnar fast path can't serve the txn."""
+
+
 class SortTopKOp(Operator):
     """Order + limit as a bounded top-k (SortTopKByKey + Limit): keeps
     limit+offset rows via a heap instead of sorting the whole input —
@@ -755,11 +830,40 @@ def build_select_plan(n, ctx):
         if off:
             pushed_offset = off
             extra += f", offset: {off}"
-    scan_label = (
-        f"TableScan [ctx: Db] [table: {tb}, direction: {scan_dir}{extra}]"
-    )
-    node = TableScanOp(tb, n.cond, pushed_limit, pushed_offset, scan_dir,
-                       scan_label, cols)
+    # columnar vector top-k: ORDER BY <vec-fn alias> LIMIT k over a bare
+    # scan scores the whole table from the column store in one shot
+    node = None
+    if (
+        n.cond is None
+        and lim is not None
+        and len(order) == 1
+        and not order[0][2]  # no COLLATE
+        and not order[0][3]  # no NUMERIC
+    ):
+        from surrealdb_tpu.exec.statements import _resolve_alias
+
+        oexpr = _resolve_alias(order[0][0], aliases)
+        spec = cols.specs.get(id(oexpr))
+        if spec is not None and len(spec[1]) == 1:
+            from surrealdb_tpu.col import get_vector_column
+
+            col = get_vector_column(ctx, tb, spec[1][0], spec[2].shape[0])
+            if col is not None and not col.bad_ids:
+                desc = order[0][1] == "desc"
+                node = VecTopKScanOp(
+                    tb, spec, lim + off, off, desc,
+                    f"VecTopKScan [ctx: Db] [table: {tb}, "
+                    f"expr: {spec[0]}, limit: {lim + off}]",
+                )
+                order = []
+
+    if node is None:
+        scan_label = (
+            f"TableScan [ctx: Db] [table: {tb}, direction: "
+            f"{scan_dir}{extra}]"
+        )
+        node = TableScanOp(tb, n.cond, pushed_limit, pushed_offset,
+                           scan_dir, scan_label, cols)
 
     if order:
         keys = ", ".join(
@@ -814,8 +918,13 @@ def try_stream_select(n, ctx):
     if plan is None:
         return _UNSUPPORTED
     out = []
-    for b in plan.execute(ctx):
-        out.extend(b)
+    try:
+        for b in plan.execute(ctx):
+            out.extend(b)
+    except _FallbackToLegacy:
+        # a columnar fast path couldn't serve this txn after all (raised
+        # before any batch is emitted)
+        return _UNSUPPORTED
     return out
 
 

@@ -88,6 +88,7 @@ class CsrGraph:
         self.device = None
         self.indptr = None
         self.sorted_cols = None
+        self._node_rids = None  # node identity changed: drop the rid cache
 
     def _ensure_device(self):
         if self.device is None:
@@ -112,6 +113,60 @@ class CsrGraph:
             indptr = np.zeros(len(self.node_ids) + 1, np.int64)
             np.add.at(indptr, self.rows + 1, 1)
             self.indptr = np.cumsum(indptr)
+
+    def hop_bag_idx(self, start_keys: list, hops: int):
+        """`hops` consecutive `->edge->node` pair hops with BAG semantics,
+        entirely in index space — frontiers never materialize id values
+        between hops. Returns a numpy array of node indexes."""
+        with self.lock:
+            self._ensure_host()
+            fr = []
+            for idv in start_keys:
+                i = self.node_index.get(K.enc_value(idv))
+                if i is not None:
+                    fr.append(i)
+            fr = np.asarray(fr, np.int64)
+            for _ in range(hops):
+                if not len(fr):
+                    break
+                if len(fr) == 1:
+                    i = int(fr[0])
+                    fr = self.sorted_cols[
+                        self.indptr[i]:self.indptr[i + 1]
+                    ].astype(np.int64, copy=False)
+                    continue
+                # vectorized multi-source gather: repeat each source's
+                # slice via cumulative offsets (no per-vertex Python loop)
+                starts = self.indptr[fr]
+                ends = self.indptr[fr + 1]
+                counts = (ends - starts).astype(np.int64)
+                total = int(counts.sum())
+                if total == 0:
+                    fr = fr[:0]
+                    continue
+                # index trick: positions 0..total-1 mapped to per-source
+                # offsets
+                offs = np.repeat(starts, counts)
+                base = np.repeat(np.cumsum(counts) - counts, counts)
+                pos = np.arange(total, dtype=np.int64) - base + offs
+                fr = self.sorted_cols[pos].astype(np.int64, copy=False)
+            return fr
+
+    def materialize_rids(self, idxs, node_tb: str) -> list:
+        """Node indexes -> RecordId list via a once-built shared cache
+        (RecordIds are immutable — handing out the same objects is safe
+        and skips per-row construction)."""
+        with self.lock:
+            rids = getattr(self, "_node_rids", None)
+            if rids is None or len(rids) != len(self.node_ids):
+                from surrealdb_tpu.val import RecordId as _R
+
+                rids = self._node_rids = [
+                    _R(node_tb, v) for v in self.node_ids
+                ]
+        if hasattr(idxs, "tolist"):
+            idxs = idxs.tolist()  # bulk int conversion beats per-element
+        return [rids[j] for j in idxs]
 
     def hop_bag(self, start_keys: list) -> list:
         """One `->edge->node` pair hop with BAG semantics (duplicates and

@@ -190,30 +190,47 @@ class Transaction:
         self.btx = btx
         self.write = write
         self.closed = False
+        # datastore-level shared catalog cache (local backends only): a
+        # pristine decoded-def dict valid for one catalog version; any
+        # committed catalog write bumps the version and clears it
+        self._shared_cat = None  # (version:int, dict) | None
+        self._ds = None
+        self._wrote_catalog = False
+        self._cat_overlay: set = set()  # /! keys written in THIS txn
         # per-transaction catalog cache (reference kvs/tx.rs CachePolicy):
         # definition reads repeat constantly inside one statement loop;
         # snapshot isolation makes the cache safe for the txn lifetime,
         # and catalog writes through THIS txn invalidate their key
         self._cat_cache: dict = {}
+        self._cat_copies: dict = {}  # per-txn memoized fresh copies
 
     # raw ops -------------------------------------------------------------
     def get(self, key: bytes) -> Optional[bytes]:
         return self.btx.get(key)
 
     def set(self, key: bytes, val: bytes) -> None:
-        if key[:2] == b"/!" and self._cat_cache:
+        if key[:2] == b"/!":
             self._cat_cache.pop(key, None)
+            self._cat_copies.pop(key, None)
+            self._wrote_catalog = True
+            self._cat_overlay.add(key)
         self.btx.set(key, val)
 
     def put(self, key: bytes, val: bytes) -> None:
         if key[:2] == b"/!":
             self._cat_cache.pop(key, None)
+            self._cat_copies.pop(key, None)
+            self._wrote_catalog = True
+            self._cat_overlay.add(key)
         self.btx.put(key, val)
 
     def delete(self, key: bytes) -> None:
         self.btx.delete(key)
         if key.startswith(b"/!"):
             self._cat_cache.pop(key, None)
+            self._cat_copies.pop(key, None)
+            self._wrote_catalog = True
+            self._cat_overlay.add(key)
             import time
 
             from surrealdb_tpu import key as K
@@ -235,6 +252,9 @@ class Transaction:
     def delete_range(self, beg, end):
         if beg.startswith(b"/!"):
             self._cat_cache.clear()
+            self._cat_copies.clear()
+            self._wrote_catalog = True
+            self._cat_overlay.add(b"*")
             import time
 
             from surrealdb_tpu import key as K
@@ -253,12 +273,36 @@ class Transaction:
 
             hit = self._cat_cache.get(key, self._CAT_MISS)
             if hit is not self._CAT_MISS:
+                if hit is None:
+                    return None
                 # DEEP copy preserves the fresh-object contract — ALTER
                 # handlers mutate nested containers (d.actions.append)
-                # of the returned def before writing back
-                return _copy.deepcopy(hit) if hit is not None else None
+                # of the returned def before writing back. The copy is
+                # memoized per transaction: within one txn every reader
+                # sees the same object (a txn observes its own catalog
+                # consistently), so the deepcopy cost is paid once per
+                # key per txn, not once per read.
+                c = self._cat_copies.get(key)
+                if c is None:
+                    c = self._cat_copies[key] = _copy.deepcopy(hit)
+                return c
+            shared = self._shared_cat
+            if shared is not None and key not in self._cat_overlay \
+                    and b"*" not in self._cat_overlay:
+                sv = shared[1].get(key, self._CAT_MISS)
+                if sv is not self._CAT_MISS:
+                    if sv is None:
+                        return None
+                    c = self._cat_copies.get(key)
+                    if c is None:
+                        c = self._cat_copies[key] = _copy.deepcopy(sv)
+                    return c
             raw = self.btx.get(key)
             v = None if raw is None else deserialize(raw)
+            if shared is not None and key not in self._cat_overlay \
+                    and b"*" not in self._cat_overlay \
+                    and len(shared[1]) < cnf.TRANSACTION_CACHE_SIZE:
+                shared[1][key] = v
             if len(self._cat_cache) < cnf.TRANSACTION_CACHE_SIZE:
                 self._cat_cache[key] = v
                 return _copy.deepcopy(v) if v is not None else None
@@ -266,10 +310,40 @@ class Transaction:
         raw = self.btx.get(key)
         return None if raw is None else deserialize(raw)
 
+    def take_val(self, key: bytes):
+        """A PRIVATE fresh copy for mutate-then-write-back flows (ALTER
+        handlers): never left in the per-txn memo, so an aborted mutation
+        can't leak phantom state into later reads of the same txn."""
+        self._cat_copies.pop(key, None)
+        v = self.get_val(key)
+        self._cat_copies.pop(key, None)
+        return v
+
+    def peek_val(self, key: bytes):
+        """Read-only catalog lookup: returns the SHARED decoded def
+        without the fresh-copy contract — callers must not mutate.
+        Serves the hottest guard-style reads (table kind checks, field
+        lists) without paying a deepcopy per transaction."""
+        if key[:2] == b"/!":
+            if key not in self._cat_overlay and \
+                    b"*" not in self._cat_overlay:
+                hit = self._cat_cache.get(key, self._CAT_MISS)
+                if hit is not self._CAT_MISS:
+                    return hit
+                shared = self._shared_cat
+                if shared is not None:
+                    sv = shared[1].get(key, self._CAT_MISS)
+                    if sv is not self._CAT_MISS:
+                        return sv
+        return self.get_val(key)
+
     def set_val(self, key: bytes, v) -> None:
         self.btx.set(key, serialize(v))
         if key.startswith(b"/!"):
             self._cat_cache.pop(key, None)
+            self._cat_copies.pop(key, None)
+            self._wrote_catalog = True
+            self._cat_overlay.add(key)
             # catalog definitions keep history for INFO ... VERSION
             import time
 
@@ -333,8 +407,21 @@ class Transaction:
 
     def commit(self):
         if not self.closed:
-            self.btx.commit()
-            self.closed = True
+            if self._wrote_catalog and self._ds is not None:
+                # the backend publish and the shared-cache bump happen
+                # under ONE lock hold, and Datastore.transaction() takes
+                # the same lock to grab the shared dict — no window where
+                # a new txn pairs a post-commit snapshot with the
+                # pre-commit catalog cache
+                ds = self._ds
+                with ds.lock:
+                    self.btx.commit()
+                    self.closed = True
+                    ds._catalog_ver += 1
+                    ds._catalog_shared = (ds._catalog_ver, {})
+            else:
+                self.btx.commit()
+                self.closed = True
             for fn in getattr(self, "_commit_hooks", ()):  # post-commit
                 try:
                     fn()

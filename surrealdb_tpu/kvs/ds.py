@@ -168,6 +168,14 @@ class Datastore:
 
         self.node_id = make_node_id()
         self.node_tasks = None
+        # shared decoded-catalog cache (version, dict); local backends
+        # only — a remote keyspace can change under us without a local
+        # commit, so remote datastores skip it
+        self._catalog_ver = 0
+        self._catalog_shared = (0, {})
+        from surrealdb_tpu.kvs.remote import RemoteBackend as _RB
+
+        self._local_catalog_cache = not isinstance(self.backend, _RB)
         self._stamp_storage_version()
 
     def start_node_tasks(self, interval_s: float = 10.0,
@@ -185,6 +193,12 @@ class Datastore:
     # -- transactions -------------------------------------------------------
     def transaction(self, write: bool = True) -> Transaction:
         self.metrics["transactions"] += 1
+        if self._local_catalog_cache:
+            with self.lock:
+                t = Transaction(self.backend.transaction(write), write)
+                t._ds = self
+                t._shared_cat = self._catalog_shared
+            return t
         return Transaction(self.backend.transaction(write), write)
 
     def record_statement(self, ok: bool, time_ns: int, label: str = ""):

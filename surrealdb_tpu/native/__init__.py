@@ -50,17 +50,17 @@ def load():
                 return None
         try:
             lib = ctypes.CDLL(_SO)
-            lib.sdb_scan_batch  # symbol probe: stale prebuilt .so?
+            lib.sdb_scan_extract_f32  # symbol probe: stale prebuilt .so?
         except OSError:
             return None
         except AttributeError:
-            # an old library without the batched ABI: rebuild once, else
+            # an old library without the current ABI: rebuild once, else
             # fall back to the pure-Python memtable
             if not _build():
                 return None
             try:
                 lib = ctypes.CDLL(_SO)
-                lib.sdb_scan_batch
+                lib.sdb_scan_extract_f32
             except (OSError, AttributeError):
                 return None
         c_char_pp = ctypes.POINTER(ctypes.c_char_p)
@@ -102,6 +102,14 @@ def load():
         lib.sdb_count_range_at.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, i64, ctypes.c_char_p, i64,
             u64,
+        ]
+        lib.sdb_scan_extract_f32.restype = i64
+        lib.sdb_scan_extract_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, i64, ctypes.c_char_p,
+            i64, u64, ctypes.c_char_p, i64, i64, i64,
+            ctypes.POINTER(ctypes.c_float), i64,
+            ctypes.c_char_p, i64, i64p,
+            ctypes.c_char_p, i64, i64p, i64p,
         ]
         _lib = lib
         return _lib
@@ -158,7 +166,7 @@ class NativeMemtable:
             # [u32 klen][u32 vlen][key][val] unpacked with memoryview
             # slicing (the per-row sdb_scan_next path cost more in ctypes
             # marshalling than the C++ side spent scanning)
-            cap = 1 << 20
+            cap = 1 << 16
             buf = ctypes.create_string_buffer(cap)
             used = ctypes.c_int64()
             from_u32 = int.from_bytes
@@ -191,6 +199,60 @@ class NativeMemtable:
     def count_range_at(self, beg: bytes, end: bytes, snap: int) -> int:
         return self.lib.sdb_count_range_at(self.h, beg, len(beg), end,
                                            len(end), snap)
+
+    def scan_extract_f32(self, beg: bytes, end: bytes, snap: int,
+                         fname: bytes, dim: int, skip_prefix: int,
+                         est_rows: int):
+        """Columnar scan: extract `fname` as an (n, dim) float32 matrix +
+        key suffixes; rows that don't conform come back as raw suffixes.
+        Returns (matrix, [key_suffix bytes], [bad_key_suffix bytes])."""
+        import numpy as _np
+
+        max_rows = max(est_rows, 1024)
+        keycap = max_rows * 40 + 1024
+        badcap = keycap
+        while True:
+            mat = _np.empty((max_rows, dim), _np.float32)
+            keybuf = ctypes.create_string_buffer(keycap)
+            badbuf = ctypes.create_string_buffer(badcap)
+            keyused = ctypes.c_int64()
+            badused = ctypes.c_int64()
+            badcount = ctypes.c_int64()
+            n = self.lib.sdb_scan_extract_f32(
+                self.h, beg, len(beg), end, len(end), snap,
+                fname, len(fname), dim, skip_prefix,
+                mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                max_rows,
+                keybuf, keycap, ctypes.byref(keyused),
+                badbuf, badcap, ctypes.byref(badused),
+                ctypes.byref(badcount),
+            )
+            if n == -1:
+                keycap *= 4
+                badcap *= 4
+                continue
+            if n == -2:
+                # matrix full mid-scan: size to the true row count
+                max_rows = self.count_range_at(beg, end, snap) + 1024
+                keycap = max(keycap, max_rows * 40 + 1024)
+                badcap = keycap
+                continue
+            break
+
+        def _frames(raw: bytes, count_hint=None):
+            out = []
+            off = 0
+            total = len(raw)
+            while off < total:
+                ln = int.from_bytes(raw[off:off + 4], "little")
+                off += 4
+                out.append(raw[off:off + ln])
+                off += ln
+            return out
+
+        keys = _frames(ctypes.string_at(keybuf, keyused.value))
+        bad = _frames(ctypes.string_at(badbuf, badused.value))
+        return mat[:n], keys, bad
 
     # -- writes -------------------------------------------------------------
     def commit_batch(self, snap: int, items, release_snap: bool = True) -> int:

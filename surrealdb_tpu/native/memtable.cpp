@@ -262,3 +262,192 @@ int64_t sdb_count_range_at(void* h, const char* beg, int64_t blen,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Columnar field extraction (reference role: the compiled scan kernels in
+// core/src/exec/operators/scan — decode rows natively instead of in the
+// host language). Scans [beg,end) at a snapshot, CBOR-decodes each value
+// just enough to pull ONE top-level field as a fixed-dim float vector, and
+// returns a packed float32 matrix plus the matching key suffixes. Rows
+// whose field is missing/ragged/non-numeric are returned as raw key frames
+// for the interpreter fallback.
+
+namespace {
+
+// minimal CBOR walker for the wire.py subset (definite lengths only)
+struct CborCur {
+    const uint8_t* p;
+    const uint8_t* end;
+    bool ok = true;
+
+    uint64_t head(uint8_t* major) {
+        if (p >= end) { ok = false; return 0; }
+        uint8_t ib = *p++;
+        *major = ib >> 5;
+        uint8_t info = ib & 0x1f;
+        if (info < 24) return info;
+        int n = info == 24 ? 1 : info == 25 ? 2 : info == 26 ? 4
+                : info == 27 ? 8 : -1;
+        if (n < 0 || p + n > end) { ok = false; return 0; }
+        uint64_t v = 0;
+        for (int i = 0; i < n; i++) v = (v << 8) | *p++;
+        return v;
+    }
+
+    void skip() {
+        uint8_t major;
+        uint64_t arg = head(&major);
+        if (!ok) return;
+        switch (major) {
+            case 0: case 1: return;                 // ints
+            case 2: case 3:                          // bytes / text
+                if (p + arg > end) { ok = false; return; }
+                p += arg;
+                return;
+            case 4:                                  // array
+                for (uint64_t i = 0; i < arg && ok; i++) skip();
+                return;
+            case 5:                                  // map
+                for (uint64_t i = 0; i < arg && ok; i++) { skip(); skip(); }
+                return;
+            case 6:                                  // tag: one item
+                skip();
+                return;
+            case 7:
+                // simple values carry no payload beyond the head except
+                // f16/f32/f64 which head() already consumed as the arg
+                return;
+            default:
+                ok = false;
+        }
+    }
+
+    // floats/ints decode to double; everything else fails
+    bool number(double* out) {
+        if (p >= end) return false;
+        uint8_t ib = *p;
+        uint8_t major = ib >> 5;
+        if (major == 0) { uint8_t m; *out = (double)head(&m); return ok; }
+        if (major == 1) {
+            uint8_t m;
+            uint64_t v = head(&m);
+            *out = -1.0 - (double)v;
+            return ok;
+        }
+        if (ib == 0xfb) {                            // float64
+            if (p + 9 > end) return false;
+            p++;
+            uint64_t bits = 0;
+            for (int i = 0; i < 8; i++) bits = (bits << 8) | *p++;
+            double d;
+            std::memcpy(&d, &bits, 8);
+            *out = d;
+            return true;
+        }
+        if (ib == 0xfa) {                            // float32
+            if (p + 5 > end) return false;
+            p++;
+            uint32_t bits = 0;
+            for (int i = 0; i < 4; i++) bits = (bits << 8) | *p++;
+            float f;
+            std::memcpy(&f, &bits, 4);
+            *out = (double)f;
+            return true;
+        }
+        return false;
+    }
+};
+
+// Extract doc[fname] as a dim-length numeric array into out[0..dim).
+// val must be the serialized record payload ('\x01' + CBOR map).
+bool extract_field_vec(const std::string& val, const char* fname,
+                       int64_t fnlen, int64_t dim, float* out) {
+    if (val.size() < 2 || (uint8_t)val[0] != 0x01) return false;
+    CborCur c{reinterpret_cast<const uint8_t*>(val.data()) + 1,
+              reinterpret_cast<const uint8_t*>(val.data()) + val.size()};
+    uint8_t major;
+    uint64_t npairs = c.head(&major);
+    if (!c.ok || major != 5) return false;
+    for (uint64_t i = 0; i < npairs && c.ok; i++) {
+        uint8_t km;
+        uint64_t klen = c.head(&km);
+        if (!c.ok || km != 3) return false;  // keys are text strings
+        const uint8_t* kp = c.p;
+        if (c.p + klen > c.end) return false;
+        c.p += klen;
+        bool match = (int64_t)klen == fnlen &&
+                     std::memcmp(kp, fname, fnlen) == 0;
+        if (!match) {
+            c.skip();
+            continue;
+        }
+        uint8_t vm;
+        uint64_t alen = c.head(&vm);
+        if (!c.ok || vm != 4 || (int64_t)alen != dim) return false;
+        for (int64_t j = 0; j < dim; j++) {
+            double d;
+            if (!c.number(&d)) return false;
+            out[j] = (float)d;
+        }
+        return true;
+    }
+    return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of rows extracted into `mat` (row-major rows*dim
+// float32) with their key suffixes (bytes after `skip_prefix`) packed as
+// [u32 len][bytes] frames into keybuf. Rows that fail extraction pack
+// their key suffixes into badbuf the same way (badcount written).
+// A return of -1 means a buffer was too small — caller grows and retries.
+int64_t sdb_scan_extract_f32(void* h, const char* beg, int64_t blen,
+                             const char* end, int64_t elen, uint64_t snap,
+                             const char* fname, int64_t fnlen, int64_t dim,
+                             int64_t skip_prefix,
+                             float* mat, int64_t max_rows,
+                             char* keybuf, int64_t keycap, int64_t* keyused,
+                             char* badbuf, int64_t badcap, int64_t* badused,
+                             int64_t* badcount) {
+    auto* m = static_cast<Memtable*>(h);
+    std::string kb(beg, blen), ke(end, elen);
+    std::lock_guard<std::mutex> lock(m->mu);
+    auto lo = m->chains.lower_bound(kb);
+    auto hi = m->chains.lower_bound(ke);
+    int64_t rows = 0;
+    int64_t koff = 0, boff = 0, bad = 0;
+    for (auto cur = lo; cur != hi; ++cur) {
+        const std::string* v = resolve(cur->second, snap);
+        if (v == nullptr) continue;
+        const std::string& key = cur->first;
+        int64_t sfx = (int64_t)key.size() - skip_prefix;
+        if (sfx < 0) sfx = 0;
+        const char* sp = key.data() + (key.size() - sfx);
+        if (rows >= max_rows) return -2;  // matrix full: caller grows
+        if (extract_field_vec(*v, fname, fnlen, dim, mat + rows * dim)) {
+            int64_t need = 4 + sfx;
+            if (koff + need > keycap) return -1;
+            uint32_t sl = (uint32_t)sfx;
+            std::memcpy(keybuf + koff, &sl, 4);
+            std::memcpy(keybuf + koff + 4, sp, sfx);
+            koff += need;
+            rows++;
+        } else {
+            int64_t need = 4 + sfx;
+            if (boff + need > badcap) return -1;
+            uint32_t sl = (uint32_t)sfx;
+            std::memcpy(badbuf + boff, &sl, 4);
+            std::memcpy(badbuf + boff + 4, sp, sfx);
+            boff += need;
+            bad++;
+        }
+    }
+    *keyused = koff;
+    *badused = boff;
+    *badcount = bad;
+    return rows;
+}
+
+}  // extern "C"
