@@ -18,9 +18,6 @@ import numpy as np
 
 from surrealdb_tpu import key as K
 
-_MISS = object()
-
-
 class VectorColumn:
     __slots__ = ("version", "ids", "mat", "bad_ids")
 

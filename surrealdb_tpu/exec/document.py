@@ -657,13 +657,46 @@ def _index_rows(vals, idef=None):
     return rows
 
 
+_EDGE_POISON = object()
+
+
+def _log_edge_op(ctx, gk, op):
+    """Classify this txn's adjacency effect on an edge table for the CSR
+    op-log: a ("add", edge_id, in_id, out_id) tuple, None for "no
+    adjacency change", or _EDGE_POISON for changes only a rebuild can
+    absorb (deletes, in/out rewrites)."""
+    ops = getattr(ctx.txn, "_edge_ops", None)
+    if ops is None:
+        ops = ctx.txn._edge_ops = {}
+    cur = ops.get(gk)
+    if op is _EDGE_POISON:
+        ops[gk] = _EDGE_POISON
+        return
+    if cur is _EDGE_POISON:
+        return
+    if cur is None:
+        cur = ops[gk] = []
+    if op is not None:
+        cur.append(op)
+
+
 def _bump_graph_version(ctx, gk):
     """Invalidate the CSR cache for a graph table — AFTER commit, so the
     shared cache never advances past committed state (an uncommitted
     RELATE must not stamp a committed-only rebuild as current)."""
     def bump():
+        from surrealdb_tpu.graph.csr import oplog_push
+
         ds = ctx.ds
-        ds.graph_versions[gk] = ds.graph_versions.get(gk, 0) + 1
+        newv = ds.graph_versions.get(gk, 0) + 1
+        ds.graph_versions[gk] = newv
+        ops = getattr(ctx.txn, "_edge_ops", {}).get(gk)
+        # unclassified writes (or poison) force the next reader to
+        # rebuild; classified adds replay incrementally
+        oplog_push(
+            ds, gk, newv,
+            None if ops is None or ops is _EDGE_POISON else list(ops),
+        )
 
     if hasattr(ctx.txn, "on_commit"):
         # within this txn the CSR cache is stale for gk: the fast paths
@@ -1311,6 +1344,21 @@ def _store_record(rid, before, after, ctx: Ctx, action, output, edge=None):
         )
         ctx.record_cache[(rid.tb, K.enc_value(rid.id))] = after
     gk = (ns, db, rid.tb)
+    if tdef.kind == "relation":
+        lv, rv = after.get("in"), after.get("out")
+        if is_create and isinstance(lv, RecordId) and isinstance(
+            rv, RecordId
+        ):
+            _log_edge_op(
+                ctx, gk,
+                ("add", rid.id, lv.tb, lv.id, rv.tb, rv.id),
+            )
+        elif isinstance(before, dict) and value_eq(
+            before.get("in"), lv
+        ) and value_eq(before.get("out"), rv):
+            _log_edge_op(ctx, gk, None)  # edge payload change only
+        else:
+            _log_edge_op(ctx, gk, _EDGE_POISON)
     _bump_graph_version(ctx, gk)
     # indexes
     index_update(rid, before, after, ctx)
@@ -1597,6 +1645,8 @@ def delete_one(rid: RecordId, before, output, ctx: Ctx):
     is_edge = isinstance(before, dict) and isinstance(
         before.get("in"), RecordId
     ) and isinstance(before.get("out"), RecordId)
+    if is_edge:
+        _log_edge_op(ctx, (ns, db, rid.tb), _EDGE_POISON)
     if not is_edge:
         for erid in edges:
             edoc = fetch_record(ctx, erid)

@@ -33,6 +33,7 @@ class CsrGraph:
         self.indptr = None  # host CSR (sorted by row, stable)
         self.sorted_cols = None
         self.lock = threading.RLock()
+        self._built = False  # a full build has populated the arrays
 
     def build(self, ctx):
         """Scan the edge table's records (in/out fields) into CSR arrays.
@@ -89,6 +90,7 @@ class CsrGraph:
         self.indptr = None
         self.sorted_cols = None
         self._node_rids = None  # node identity changed: drop the rid cache
+        self._built = True
 
     def _ensure_device(self):
         if self.device is None:
@@ -113,6 +115,60 @@ class CsrGraph:
             indptr = np.zeros(len(self.node_ids) + 1, np.int64)
             np.add.at(indptr, self.rows + 1, 1)
             self.indptr = np.cumsum(indptr)
+
+    def _idx_of(self, idv):
+        h = K.enc_value(idv)
+        i = self.node_index.get(h)
+        if i is None:
+            i = len(self.node_ids)
+            self.node_index[h] = i
+            self.node_ids.append(idv)
+            if getattr(self, "_node_rids", None) is not None:
+                self._node_rids.append(RecordId(self.key[2], idv))
+        return i
+
+    def replay(self, ops) -> bool:
+        """Apply committed edge-op deltas (("add", edge_id, in_id,
+        out_id)) instead of rescanning the edge table — the vector
+        index's op-log sync pattern. Only appends are replayable; any
+        other op returns False and the caller full-rebuilds. Derived
+        structures (host sort, device blocks, rid cache lengths) refresh
+        lazily; the numpy re-sort is orders of magnitude cheaper than
+        re-deserializing every edge record from the KV."""
+        node_tb = self.key[2]
+        edge_tb = self.key[3]
+        direction = self.key[4]
+        new_rows, new_cols, new_eids = [], [], []
+        for op in ops:
+            if not (isinstance(op, tuple) and op[0] == "add"):
+                return False
+            _tag, eid, in_tb, in_id, out_tb, out_id = op
+            if in_tb != node_tb or out_tb != node_tb:
+                # an edge whose endpoints live in other tables is
+                # invisible to THIS CSR — exactly build()'s filter
+                continue
+            erid = RecordId(edge_tb, eid)
+            if direction in ("out", "both"):
+                new_rows.append(self._idx_of(in_id))
+                new_cols.append(self._idx_of(out_id))
+                new_eids.append(erid)
+            if direction in ("in", "both"):
+                new_rows.append(self._idx_of(out_id))
+                new_cols.append(self._idx_of(in_id))
+                new_eids.append(erid)
+        if not new_rows:
+            return True
+        self.rows = np.concatenate(
+            [self.rows, np.asarray(new_rows, np.int32)]
+        )
+        self.cols = np.concatenate(
+            [self.cols, np.asarray(new_cols, np.int32)]
+        )
+        self.edge_ids.extend(new_eids)
+        self.device = None
+        self.indptr = None
+        self.sorted_cols = None
+        return True
 
     def hop_bag_idx(self, start_keys: list, hops: int):
         """`hops` consecutive `->edge->node` pair hops with BAG semantics,
@@ -258,6 +314,50 @@ def peek_csr(ds, ns, db, node_tb, edge_tb, direction):
     return ds.graph_engine.get((ns, db, node_tb, edge_tb, direction))
 
 
+def oplog_push(ds, gk, version: int, ops):
+    """Record one committed transaction's edge ops for `gk` at `version`
+    (ops None = unreplayable change). A None entry would poison every
+    later slice window anyway, so it simply CLEARS the log — plain-table
+    writes (which always push None) therefore never accumulate anything.
+    Bounded: overflow trims the oldest entries, re-creating the
+    full-rebuild gap naturally."""
+    log = getattr(ds, "_edge_oplog", None)
+    if log is None:
+        log = ds._edge_oplog = {}
+    if ops is None:
+        log[gk] = []
+        return
+    lst = log.setdefault(gk, [])
+    lst.append((version, ops))
+    totals = getattr(ds, "_edge_oplog_totals", None)
+    if totals is None:
+        totals = ds._edge_oplog_totals = {}
+    total = totals.get(gk, 0) + len(ops)
+    while len(lst) > 1 and total > 100_000:
+        _v, o = lst.pop(0)
+        total -= len(o)
+    totals[gk] = total
+
+
+def oplog_slice(ds, gk, from_ver: int, to_ver: int):
+    """All ops for versions (from_ver, to_ver], or None when the log has
+    gaps or unreplayable entries in that window."""
+    log = getattr(ds, "_edge_oplog", {}).get(gk)
+    if not log:
+        return None
+    out = []
+    seen = set()
+    for v, ops in log:
+        if from_ver < v <= to_ver:
+            if ops is None:
+                return None
+            seen.add(v)
+            out.extend(ops)
+    if len(seen) != to_ver - from_ver:
+        return None  # a version in the window left no ops (trimmed/gap)
+    return out
+
+
 def get_csr(ds, ctx, node_tb, edge_tb, direction) -> CsrGraph:
     """Datastore-cached CSR; rebuilt when the edge table changes (tracked
     via a bump counter on writes — device blocks are a cache over KV)."""
@@ -272,6 +372,11 @@ def get_csr(ds, ctx, node_tb, edge_tb, direction) -> CsrGraph:
     ver = ds.graph_versions.get((ns, db, edge_tb), 0)
     with g.lock:
         if g.version != ver:
-            g.build(ctx)
+            ops = (
+                oplog_slice(ds, (ns, db, edge_tb), g.version, ver)
+                if g._built and ver > g.version else None
+            )
+            if ops is None or not g.replay(ops):
+                g.build(ctx)
             g.version = ver
     return g

@@ -239,7 +239,7 @@ class NativeMemtable:
                 continue
             break
 
-        def _frames(raw: bytes, count_hint=None):
+        def _frames(raw: bytes):
             out = []
             off = 0
             total = len(raw)

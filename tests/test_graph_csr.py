@@ -200,3 +200,79 @@ def test_csr_fast_path_matches_slow_path():
     finally:
         E._csr_bag_pair_hop = orig
     assert fast == slow
+
+
+def test_incremental_replay_after_relate():
+    """A committed RELATE on a warm CSR replays from the edge op-log —
+    no full edge-table rescan (VERDICT r4 item 5)."""
+    import numpy as np
+
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.graph import csr as csrmod
+    from surrealdb_tpu.kvs.api import serialize
+    from surrealdb_tpu.val import RecordId
+
+    ds = Datastore("memory")
+    ds.query("DEFINE TABLE person; DEFINE TABLE knows TYPE RELATION",
+             ns="g", db="g")
+    n, e = 500, 3000
+    rng = np.random.default_rng(3)
+    src, dst = rng.integers(0, n, e), rng.integers(0, n, e)
+    txn = ds.transaction(write=True)
+    try:
+        for i in range(n):
+            txn.set(K.record("g", "g", "person", i),
+                    serialize({"id": RecordId("person", i)}))
+        for j in range(e):
+            s, d = int(src[j]), int(dst[j])
+            txn.set(K.record("g", "g", "knows", j), serialize({
+                "id": RecordId("knows", j), "in": RecordId("person", s),
+                "out": RecordId("person", d)}))
+            txn.set(K.graph("g", "g", "person", s, K.DIR_OUT, "knows", j),
+                    b"")
+            txn.set(K.graph("g", "g", "knows", j, K.DIR_IN, "person", s),
+                    b"")
+            txn.set(K.graph("g", "g", "knows", j, K.DIR_OUT, "person", d),
+                    b"")
+            txn.set(K.graph("g", "g", "person", d, K.DIR_IN, "knows", j),
+                    b"")
+        txn.commit()
+    except BaseException:
+        txn.cancel()
+        raise
+    sql = ("SELECT VALUE ->knows->person->knows->person->knows->person "
+           "FROM person:0")
+    out1 = ds.query_one(sql, ns="g", db="g")  # builds the CSR
+
+    builds = []
+    orig_build = csrmod.CsrGraph.build
+
+    def counting_build(self, ctx):
+        builds.append(self.key)
+        return orig_build(self, ctx)
+
+    csrmod.CsrGraph.build = counting_build
+    try:
+        ds.query_one("RELATE person:0->knows->person:1", ns="g", db="g")
+        out2 = ds.query_one(sql, ns="g", db="g")
+        assert builds == [], f"full rebuild ran: {builds}"
+    finally:
+        csrmod.CsrGraph.build = orig_build
+    # the new edge participates in the traversal
+    flat2 = out2[0] if out2 and isinstance(out2[0], list) else out2
+    flat1 = out1[0] if out1 and isinstance(out1[0], list) else out1
+    assert len(flat2) > len(flat1)
+    # a DELETE is not replayable: the op-log entry poisons the window,
+    # so the CSR never serves stale adjacency — small-frontier queries
+    # fall back to authoritative per-record scans until a big query pays
+    # the rebuild
+    ds.query_one("DELETE knows:0", ns="g", db="g")
+    from surrealdb_tpu.graph.csr import oplog_slice
+
+    gk = ("g", "g", "knows")
+    ver = ds.graph_versions[gk]
+    assert oplog_slice(ds, gk, ver - 1, ver) is None
+    out3 = ds.query_one(sql, ns="g", db="g")
+    flat3 = out3[0] if out3 and isinstance(out3[0], list) else out3
+    assert len(flat3) <= len(flat2)
