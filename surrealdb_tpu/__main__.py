@@ -60,6 +60,9 @@ def main(argv=None):
         "kv", help="run the shared transactional KV service (cluster mode)"
     )
     p_kv.add_argument("--bind", default="127.0.0.1:8100")
+    p_kv.add_argument("--data-dir", default=None,
+                      help="persist the keyspace (WAL + snapshot); "
+                           "restarts recover committed state")
 
     p_up = sub.add_parser(
         "upgrade", help="migrate a store's on-disk format to this release"
@@ -130,7 +133,8 @@ def main(argv=None):
         from surrealdb_tpu.kvs.remote import serve_kv
 
         host, _, port = args.bind.partition(":")
-        serve_kv(host, int(port), block=True)
+        serve_kv(host, int(port), block=True,
+                 data_dir=getattr(args, "data_dir", None))
         return 0
 
     from surrealdb_tpu import Datastore

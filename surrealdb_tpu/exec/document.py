@@ -688,15 +688,19 @@ def _bump_graph_version(ctx, gk):
         from surrealdb_tpu.graph.csr import oplog_push
 
         ds = ctx.ds
-        newv = ds.graph_versions.get(gk, 0) + 1
-        ds.graph_versions[gk] = newv
         ops = getattr(ctx.txn, "_edge_ops", {}).get(gk)
-        # unclassified writes (or poison) force the next reader to
-        # rebuild; classified adds replay incrementally
-        oplog_push(
-            ds, gk, newv,
-            None if ops is None or ops is _EDGE_POISON else list(ops),
-        )
+        # version allocation and the op-log push are ONE atomic step:
+        # concurrent commits must not share a version number or a CSR
+        # replay could permanently skip one txn's edges
+        with ds.lock:
+            newv = ds.graph_versions.get(gk, 0) + 1
+            ds.graph_versions[gk] = newv
+            # unclassified writes (or poison) force the next reader to
+            # rebuild; classified adds replay incrementally
+            oplog_push(
+                ds, gk, newv,
+                None if ops is None or ops is _EDGE_POISON else list(ops),
+            )
 
     if hasattr(ctx.txn, "on_commit"):
         # within this txn the CSR cache is stale for gk: the fast paths

@@ -326,6 +326,9 @@ def oplog_push(ds, gk, version: int, ops):
         log = ds._edge_oplog = {}
     if ops is None:
         log[gk] = []
+        totals = getattr(ds, "_edge_oplog_totals", None)
+        if totals is not None:
+            totals[gk] = 0
         return
     lst = log.setdefault(gk, [])
     lst.append((version, ops))

@@ -150,12 +150,18 @@ class _KvHandler(socketserver.BaseRequestHandler):
                     del owned[snap]
             else:
                 raise SdbError("kv commit: unknown snapshot")
-            ver = vs.commit(writes, snap)  # raises SdbError on conflict
+            # the apply and the WAL append happen under ONE lock hold so
+            # recovery replays commits in exactly the order they applied
+            with self.server.wal_lock:
+                ver = vs.commit(writes, snap)  # SdbError on conflict
+                self.server.log_commit(writes)
             return ["ok", ver]
         if op == "seed":
-            with vs.lock:
-                for k, v in req[1]:
-                    vs.seed(k, v)
+            with self.server.wal_lock:
+                with vs.lock:
+                    for k, v in req[1]:
+                        vs.seed(k, v)
+                self.server.log_commit({k: v for k, v in req[1]})
             return ["ok", None]
         if op == "ping":
             return ["ok", "pong"]
@@ -166,17 +172,133 @@ class KvServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, addr, secret: Optional[str] = None):
+    # WAL compaction threshold: beyond this the recovery path rewrites
+    # the snapshot file and truncates the log
+    WAL_COMPACT_BYTES = 64 << 20
+
+    def __init__(self, addr, secret: Optional[str] = None,
+                 data_dir: Optional[str] = None, fsync: bool = True):
         super().__init__(addr, _KvHandler)
         self.vs = VersionedStore()
         self.secret = secret
+        self.data_dir = data_dir
+        self.fsync = fsync
+        self.wal = None
+        self.wal_lock = threading.RLock()
+        if data_dir:
+            self._recover()
+
+    # -- durability (reference role: TiKV's raft-log + snapshot
+    # persistence, core/src/kvs/tikv/mod.rs:32-103 durability contract;
+    # single-owner redo log here) --------------------------------------
+
+    def _snap_path(self):
+        return os.path.join(self.data_dir, "snapshot.kv")
+
+    def _wal_path(self):
+        return os.path.join(self.data_dir, "wal.log")
+
+    @staticmethod
+    def _read_frames(path):
+        """Yield decoded frames; stops cleanly at a torn tail."""
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(4)
+                if len(hdr) < 4:
+                    return
+                (n,) = _HDR.unpack(hdr)
+                body = f.read(n)
+                if len(body) < n:
+                    return  # torn write from a crash — ignore the tail
+                yield _decode(body)
+
+    def _recover(self):
+        os.makedirs(self.data_dir, exist_ok=True)
+        sp, wp = self._snap_path(), self._wal_path()
+        with self.vs.lock:
+            if os.path.exists(sp):
+                for pairs in self._read_frames(sp):
+                    for k, v in pairs:
+                        self.vs.seed(bytes(k), bytes(v))
+            replayed = 0
+            if os.path.exists(wp):
+                for pairs in self._read_frames(wp):
+                    snap = self.vs.snapshot()
+                    writes = {
+                        bytes(k): (None if v is None else bytes(v))
+                        for k, v in pairs
+                    }
+                    self.vs.commit(writes, snap)
+                    replayed += 1
+        # fold the replayed log into the snapshot so restarts stay O(data)
+        if replayed or (
+            os.path.exists(wp)
+            and os.path.getsize(wp) > self.WAL_COMPACT_BYTES
+        ):
+            self._compact()
+        self.wal = open(wp, "ab")
+
+    def _compact(self):
+        """Write the live keyspace to snapshot.kv and truncate the WAL."""
+        sp, wp = self._snap_path(), self._wal_path()
+        tmp = sp + ".tmp"
+        with self.vs.lock:
+            snap = self.vs.snapshot()
+        try:
+            with open(tmp, "wb") as f:
+                batch = []
+                for k, v in self.vs.range_items(b"", b"\xff" * 9, snap,
+                                                None, False):
+                    batch.append([k, v])
+                    if len(batch) >= 512:
+                        fr = _encode(batch)
+                        f.write(_HDR.pack(len(fr)) + fr)
+                        batch = []
+                if batch:
+                    fr = _encode(batch)
+                    f.write(_HDR.pack(len(fr)) + fr)
+                f.flush()
+                os.fsync(f.fileno())
+        finally:
+            self.vs.release(snap)
+        os.replace(tmp, sp)
+        # the rename must be durable BEFORE the WAL truncates — otherwise
+        # a crash could pair the OLD snapshot with an EMPTY log
+        dfd = os.open(self.data_dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        if self.wal is not None:
+            self.wal.close()
+        self.wal = open(wp, "wb")
+        self.wal.flush()
+        os.fsync(self.wal.fileno())
+
+    def log_commit(self, writes: dict):
+        """Append one committed writeset to the WAL — called BEFORE the
+        client sees the ok, so an acknowledged commit survives a crash."""
+        if self.wal is None:
+            return
+        fr = _encode([[k, v] for k, v in writes.items()])
+        with self.wal_lock:
+            self.wal.write(_HDR.pack(len(fr)) + fr)
+            self.wal.flush()
+            if self.fsync:
+                os.fsync(self.wal.fileno())
+            if self.wal.tell() > self.WAL_COMPACT_BYTES:
+                self._compact()
 
 
 def serve_kv(host="127.0.0.1", port=8100, block=True,
-             secret: Optional[str] = None) -> KvServer:
+             secret: Optional[str] = None,
+             data_dir: Optional[str] = None, fsync: bool = True) -> KvServer:
     if secret is None:
         secret = os.environ.get("SURREAL_KV_SECRET") or None
-    srv = KvServer((host, port), secret=secret)
+    if data_dir is None:
+        data_dir = os.environ.get("SURREAL_KV_DATA_DIR") or None
+    srv = KvServer((host, port), secret=secret, data_dir=data_dir,
+                   fsync=fsync)
     if block:
         print(f"surrealdb-tpu kv service on {host}:{port}"
               + (" (authenticated)" if secret else ""))
@@ -262,6 +384,18 @@ class _Pool:
                     f"kv connection pool exhausted ({in_use} in use; waited 30s)"
                 )
 
+    def fresh(self) -> _Conn:
+        """A brand-new connection (replacing one just drop()ed) — pooled
+        connections can all be stale after a server restart."""
+        with self.lock:
+            self.count += 1
+        try:
+            return _Conn(self.addr, self.secret)
+        except OSError as e:
+            with self.lock:
+                self.count -= 1
+            raise SdbError(f"kv service unreachable: {e}")
+
     def release(self, c: _Conn):
         self.q.put(c)
 
@@ -270,12 +404,23 @@ class _Pool:
         with self.lock:
             self.count -= 1
 
-    def call(self, msg):
+    def call(self, msg, _retried=False):
         c = self.acquire()
         try:
             out = c.call(msg)
         except (ConnectionError, OSError) as e:
             self.drop(c)
+            if not _retried:
+                # a pooled connection can be stale after a server
+                # restart — retry ONCE on a genuinely fresh connection
+                c2 = self.fresh()
+                try:
+                    out = c2.call(msg)
+                except (ConnectionError, OSError) as e2:
+                    self.drop(c2)
+                    raise SdbError(f"kv connection lost: {e2}")
+                self.release(c2)
+                return out
             raise SdbError(f"kv connection lost: {e}")
         except BaseException:
             self.release(c)
@@ -295,6 +440,15 @@ class RemoteTx(BackendTx):
         self.conn: Optional[_Conn] = self.pool.acquire()
         try:
             self.snap = self.conn.call(["snap"])
+        except (ConnectionError, OSError):
+            # stale pooled connection (server restarted): one fresh try
+            self._drop_conn()
+            self.conn = self.pool.fresh()
+            try:
+                self.snap = self.conn.call(["snap"])
+            except BaseException:
+                self._drop_conn()
+                raise
         except BaseException:
             self._drop_conn()
             raise

@@ -135,3 +135,135 @@ def test_batch_allocated_sequences(cluster):
     assert a == sorted(a) and b == sorted(b)
     assert not (set(a) & set(b)), "nodes handed out overlapping ids"
     assert min(a + b) == 0
+
+
+def test_kv_crash_restart_recovery(tmp_path):
+    """SIGKILL the KV-service process mid-flight: committed state
+    survives (WAL + snapshot), the dead service surfaces a clean error,
+    and the same client reconnects after restart (VERDICT r4 item 6)."""
+    import os
+    import signal
+    import socket as _socket
+    import subprocess
+    import sys
+    import time
+
+    import pytest
+
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu.err import SdbError
+
+    d = str(tmp_path / "kv")
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    def spawn():
+        p = subprocess.Popen(
+            [sys.executable, "-m", "surrealdb_tpu", "kv",
+             "--bind", f"127.0.0.1:{port}", "--data-dir", d],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        for _ in range(100):
+            try:
+                _socket.create_connection(("127.0.0.1", port),
+                                          timeout=0.2).close()
+                return p
+            except OSError:
+                time.sleep(0.1)
+        raise RuntimeError("kv service did not come up")
+
+    proc = spawn()
+    try:
+        ds = Datastore(f"remote://127.0.0.1:{port}")
+        ds.query("DEFINE INDEX ia ON t FIELDS a; "
+                 "CREATE t:1 SET a = 1; CREATE t:2 SET a = 2",
+                 ns="x", db="x")
+        # hard crash — no shutdown hooks run; only the WAL survives
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+        with pytest.raises(SdbError):
+            ds.query("SELECT * FROM t", ns="x", db="x")
+        proc = spawn()
+        rows = ds.query("SELECT * FROM t ORDER BY id", ns="x", db="x")[-1]
+        assert [r["a"] for r in rows] == [1, 2]
+        # the index survived too (catalog + index keys recovered)
+        rows = ds.query("SELECT * FROM t WHERE a = 2", ns="x", db="x")[-1]
+        assert len(rows) == 1 and rows[0]["a"] == 2
+        # writes keep working and survive ANOTHER crash/restart cycle
+        ds.query("CREATE t:3 SET a = 3", ns="x", db="x")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+        proc = spawn()
+        rows = ds.query("SELECT * FROM t ORDER BY id", ns="x", db="x")[-1]
+        assert len(rows) == 3
+        assert os.path.exists(os.path.join(d, "wal.log"))
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_kv_contention_many_clients(tmp_path):
+    """32 concurrent writers with multi-row writesets: every increment
+    lands exactly once (optimistic validation under contention)."""
+    import threading
+
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu.err import SdbError
+    from surrealdb_tpu.kvs.remote import serve_kv
+
+    srv = serve_kv("127.0.0.1", 0, block=False,
+                   data_dir=str(tmp_path / "kv2"), fsync=False)
+    port = srv.server_address[1]
+    ds0 = Datastore(f"remote://127.0.0.1:{port}")
+    ds0.query("CREATE counter:1 SET n = 0", ns="x", db="x")
+    # pre-create per-worker rows so the hot row is the only conflict
+    ds0.query("FOR $i IN 0..32 { CREATE type::record('w:' + <string>$i) "
+              "SET fill = [] }", ns="x", db="x")
+    N_WORKERS, N_OPS = 32, 5
+    errs = []
+
+    def worker(wid):
+        ds = Datastore(f"remote://127.0.0.1:{port}")
+        for op in range(N_OPS):
+            # retry loop: optimistic conflicts are expected under
+            # contention — the client retries like the reference SDK
+            import random
+            import time as _t
+
+            for _attempt in range(120):
+                if _attempt:
+                    # jittered backoff: a no-sleep retry storm livelocks
+                    # 32 optimistic writers on one hot row
+                    _t.sleep(random.random() * 0.03 * min(_attempt, 10))
+                try:
+                    ds.query(
+                        # a multi-statement txn with a fat writeset: bump
+                        # the shared counter AND rewrite this worker's row
+                        "BEGIN; UPDATE counter:1 SET n += 1; "
+                        f"UPDATE w:{wid} SET fill = [" +
+                        ",".join(str(x) for x in range(50)) +
+                        "]; COMMIT;",
+                        ns="x", db="x")
+                    break
+                except SdbError as e:
+                    if "conflict" not in str(e).lower():
+                        errs.append(str(e))
+                        return
+            else:
+                errs.append(f"worker {wid}: retries exhausted")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(N_WORKERS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    try:
+        assert not errs, errs[:5]
+        n = ds0.query("SELECT VALUE n FROM ONLY counter:1", ns="x", db="x")[-1]
+        assert n == N_WORKERS * N_OPS, n
+    finally:
+        srv.shutdown()
+        srv.server_close()
