@@ -692,6 +692,48 @@ class ProjectOp(Operator):
         return out
 
 
+class AggregateOp(Operator):
+    """GROUP BY / GROUP ALL over the scanned rows (reference
+    exec/operators/aggregate.rs). A barrier by nature: drains the child,
+    groups via the shared grouping engine, then emits the final grouped
+    rows (ORDER/START/LIMIT apply to the grouped output)."""
+
+    def __init__(self, child, stmt, aliases, label):
+        super().__init__(child)
+        self.stmt = stmt
+        self.aliases = aliases
+        self.label = label
+
+    def _execute(self, ctx):
+        from surrealdb_tpu.exec.eval import evaluate
+        from surrealdb_tpu.exec.statements import (
+            _apply_group, _apply_order,
+        )
+
+        n = self.stmt
+        rows = []
+        for b in self.children[0].execute(ctx):
+            rows.extend(b)
+        empty_row = n.cond is None or (
+            getattr(ctx.session, "planner_strategy", None) == "all-ro"
+        )
+        out = _apply_group(rows, n, ctx, self.aliases, empty_row)
+        if n.order == "rand":
+            import random as _r
+
+            _r.shuffle(out)
+        elif n.order:
+            out = _apply_order(out, n.order, ctx)
+        if n.start is not None:
+            out = out[int(evaluate(n.start, ctx)):]
+        if n.limit is not None:
+            out = out[:int(evaluate(n.limit, ctx))]
+        for i in range(0, len(out), BATCH_SIZE):
+            yield out[i:i + BATCH_SIZE]
+        if not out:
+            yield []
+
+
 # ---------------------------------------------------------------------------
 # plan building / routing
 # ---------------------------------------------------------------------------
@@ -745,11 +787,13 @@ def build_select_plan(n, ctx):
         return None
     if (
         n.version is not None or ctx.version is not None
-        or n.group is not None or n.split or n.fetch or n.omit or n.only
+        or n.split or n.fetch or n.omit or n.only
         or n.order == "rand" or len(n.what) != 1
         or not ctx.session.is_owner or ctx.perms_enabled
     ):
         return None
+    if n.group is not None and any(e == "*" for e, _a in n.exprs):
+        return None  # `*` in a grouped selection errors on the legacy path
     try:
         v = _target_value(n.what[0], ctx)
     except SdbError:
@@ -789,6 +833,31 @@ def build_select_plan(n, ctx):
             aliases[alias or expr_name(expr)] = expr
     if n.value is not None and getattr(n, "value_alias", None):
         aliases[n.value_alias] = n.value
+
+    if n.group is not None:
+        if not n.group:
+            # GROUP ALL rides the legacy count/aggregate fast paths
+            # (key-only count scans beat draining every row here)
+            return None
+        extra = ""
+        if n.cond is not None:
+            from surrealdb_tpu.exec.statements import _elide_count_args
+
+            extra += (
+                ", predicate: "
+                + _expr_sql(_elide_count_args(_inline_params(n.cond, ctx)))
+            )
+        scan = TableScanOp(
+            tb, n.cond, None, None, "Forward",
+            f"TableScan [ctx: Db] [table: {tb}, direction: Forward{extra}]",
+            cols,
+        )
+        by = ", ".join(expr_name(g) for g in n.group) or ", ".join(
+            (a or expr_name(e)) for e, a in n.exprs if e != "*"
+        )
+        return AggregateOp(
+            scan, n, aliases, f"Aggregate [ctx: Db] [by: {by}]"
+        )
 
     order = list(n.order) if n.order and n.order != "rand" else []
     # ORDER BY id over a plain scan streams in key order already (the

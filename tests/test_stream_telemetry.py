@@ -80,11 +80,15 @@ def test_stream_vectorized_projection(q, ds):
 
 
 def test_stream_fallback_shapes(q, ds):
-    """GROUP/graph/index statements still route to the legacy engine."""
+    """GROUP BY streams through AggregateOp; GROUP ALL keeps the legacy
+    key-only count fast paths."""
     q("CREATE g:1 SET n = 1; CREATE g:2 SET n = 1")
     rows, used = _stream_used(ds, "SELECT n, count() AS c FROM g GROUP BY n")
-    assert not used
+    assert used
     assert rows[0] == [{"n": 1, "c": 2}]
+    rows, used = _stream_used(ds, "SELECT count() AS c FROM g GROUP ALL")
+    assert not used
+    assert rows[0] == [{"c": 2}]
 
 
 def test_explain_analyze_real_metrics(ds):
