@@ -134,3 +134,6 @@ GLOBAL_BUCKET_ENFORCED = env_bool("SURREAL_GLOBAL_BUCKET_ENFORCED", False)
 INSECURE_FORWARD_ACCESS_ERRORS = env_bool(
     "SURREAL_INSECURE_FORWARD_ACCESS_ERRORS", False
 )
+# surrealism host imports: allow modules to run SurrealQL via the
+# `sdb.sql` host function (runs under the calling session's permissions)
+SURREALISM_HOST_SQL = env_bool("SURREAL_SURREALISM_HOST_SQL", True)

@@ -112,6 +112,11 @@ def remove_module(name: str, ctx, if_exists=False):
     ctx.ds.module_cache.pop((ns, db, name), None)
 
 
+MAX_KV_KEY_BYTES = 1024     # reference runtime kv.rs MAX_KV_KEY_BYTES
+MAX_KV_ENTRIES = 10_000     # bounded per-module store
+_SENTINEL = object()
+
+
 def _instance(name: str, ctx):
     from surrealdb_tpu import key as K
     from surrealdb_tpu.catalog import ModuleDef
@@ -145,11 +150,92 @@ def _instance(name: str, ctx):
             tele.counter("surrealism_log_calls")
         return None
 
+    # per-module in-memory KV store (reference runtime/src/kv.rs
+    # BTreeMapStore: module-scoped, volatile, bounded)
+    stores = getattr(ctx.ds, "_surrealism_kv", None)
+    if stores is None:
+        stores = ctx.ds._surrealism_kv = {}
+    kv = stores.setdefault((ns, db, name), {})
+
+    cell = {}  # late-bound Instance (host closures need its memory)
+
+    def _text(ptr, ln):
+        return cell["inst"]._load(int(ptr), int(ln)).decode(
+            "utf-8", "replace"
+        )
+
+    def _write_out(data: bytes, outptr, outcap) -> int:
+        """Size-probe protocol: ALWAYS returns the required byte count;
+        writes into guest memory only when it fits outcap."""
+        if len(data) <= int(outcap):
+            cell["inst"]._store(int(outptr), data)
+        return len(data)
+
+    def kv_set(kptr, klen, vptr, vlen):
+        from surrealdb_tpu import wire
+
+        if int(klen) > MAX_KV_KEY_BYTES or len(kv) >= MAX_KV_ENTRIES:
+            return -1
+        key = _text(kptr, klen)
+        kv[key] = wire.decode(cell["inst"]._load(int(vptr), int(vlen)))
+        return 0
+
+    def kv_get(kptr, klen, outptr, outcap):
+        from surrealdb_tpu import wire
+
+        key = _text(kptr, klen)
+        if key not in kv:
+            return -1
+        return _write_out(wire.encode(kv[key]), outptr, outcap)
+
+    def kv_del(kptr, klen):
+        return 1 if kv.pop(_text(kptr, klen), _SENTINEL) is not _SENTINEL \
+            else 0
+
+    def kv_exists(kptr, klen):
+        return 1 if _text(kptr, klen) in kv else 0
+
+    def host_sql(qptr, qlen, outptr, outcap):
+        """Run SurrealQL under the CALLING session (permissions apply);
+        the final statement's result returns CBOR-encoded. Reference
+        runtime host.rs `sql` import. Runs in its own transaction —
+        committed state, like the reference's datastore-level call."""
+        from surrealdb_tpu import cnf, wire
+
+        if not getattr(cnf, "SURREALISM_HOST_SQL", True):
+            raise SdbError(
+                "Module host `sql` import is not allowed"
+            )
+        res = ctx.ds.execute(_text(qptr, qlen), session=ctx.session)
+        last = res[-1]
+        if last.error is not None:
+            raise SdbError(f"mod sql: {last.error}")
+        return _write_out(wire.encode(last.result), outptr, outcap)
+
+    def host_stdout(ptr, ln):
+        if tele is not None:
+            tele.counter("surrealism_stdout_bytes", int(ln))
+        buf = getattr(ctx.ds, "_surrealism_stdout", None)
+        if buf is None:
+            buf = ctx.ds._surrealism_stdout = []
+        buf.append(_text(ptr, ln))
+        if len(buf) > 256:
+            del buf[:128]
+        return None
+
     host = {
         "env.log": host_log,
         "env.mem_grow_hint": lambda v=0: None,
+        "env.stdout": host_stdout,
+        "sdb.kv_set": kv_set,
+        "sdb.kv_get": kv_get,
+        "sdb.kv_del": kv_del,
+        "sdb.kv_exists": kv_exists,
+        "sdb.sql": host_sql,
     }
-    return Instance(module, host=host)
+    inst = Instance(module, host=host)
+    cell["inst"] = inst
+    return inst
 
 
 def call_module(path: str, args: list, ctx):

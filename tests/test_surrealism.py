@@ -153,3 +153,96 @@ def test_invalid_module_rejected():
     r = ds.execute("DEFINE MODULE mod::bad AS $m", ns="t", db="t",
                    vars={"m": b"not wasm"})[0]
     assert "invalid module payload" in r.error
+
+
+def _import_entry(mod, name, tidx):
+    m, n = mod.encode(), name.encode()
+    return (_uleb(len(m)) + m + _uleb(len(n)) + n + b"\x00" + _uleb(tidx))
+
+
+def build_host_module() -> bytes:
+    """Module importing sdb.kv_set/kv_get/sql + env.stdout; exports:
+      kv_roundtrip(vlen) -> kv_set("k", mem[64:64+vlen]); kv_get("k", 256)
+      run_sql(qlen) -> sql(mem[512:512+qlen], out=1024 cap 2048)
+    Memory layout: 0..8 = key "k" (data), 64.. = caller-provided value
+    bytes, 256.. = kv_get out, 512.. = query text, 1024.. = sql out."""
+    i32 = b"\x7f"
+    types = _vec([
+        _functype([i32] * 4, [i32]),   # 0: (i32 x4) -> i32
+        _functype([i32] * 2, [i32]),   # 1: (i32 x2) -> i32
+        _functype([i32], [i32]),       # 2: (i32) -> i32
+    ])
+    imports = _vec([
+        _import_entry("sdb", "kv_set", 0),
+        _import_entry("sdb", "kv_get", 0),
+        _import_entry("sdb", "sql", 0),
+    ])
+    funcs = _vec([_uleb(2), _uleb(2)])  # two local funcs, type 2
+    mems = _vec([b"\x00" + _uleb(1)])
+    exports = _vec([
+        _export("kv_roundtrip", 0, 3),
+        _export("run_sql", 0, 4),
+        _export("memory", 2, 0),
+    ])
+    # kv_roundtrip(vlen): sdb.kv_set(0,1, 64,vlen); return sdb.kv_get(0,1, 256,256)
+    body1 = (
+        b"\x41\x00" b"\x41\x01" b"\x41\xc0\x00" b"\x20\x00"  # 0,1,64,vlen
+        b"\x10\x00"  # call kv_set
+        b"\x1a"      # drop
+        b"\x41\x00" b"\x41\x01" b"\x41\x80\x02" b"\x41\x80\x02"  # 0,1,256,256
+        b"\x10\x01"  # call kv_get
+        b"\x0b"
+    )
+    # run_sql(qlen): return sdb.sql(512, qlen, 1024, 2048)
+    body2 = (
+        b"\x41\x80\x04" b"\x20\x00" b"\x41\x80\x08" b"\x41\x80\x10"
+        b"\x10\x02"
+        b"\x0b"
+    )
+    datas = _vec([b"\x00" + b"\x41\x00\x0b" + _uleb(1) + b"k"])
+    return (b"\x00asm\x01\x00\x00\x00"
+            + _sec(1, types) + _sec(2, imports) + _sec(3, funcs)
+            + _sec(5, mems) + _sec(7, exports)
+            + _sec(10, _vec([_code(body1), _code(body2)]))
+            + _sec(11, datas))
+
+
+def test_host_kv_and_sql_imports():
+    """Modules read/write the per-module KV store and run SurrealQL
+    through host imports (reference runtime host.rs sql + kv.rs store)."""
+    from surrealdb_tpu import wire
+    from surrealdb_tpu.surrealism import _instance
+    from surrealdb_tpu.exec.context import Ctx
+    from surrealdb_tpu.kvs.ds import Session
+    from surrealdb_tpu.surrealism import define_module
+
+    ds = Datastore()
+    ds.query("CREATE seedrec:1 SET v = 41", ns="t", db="t")
+    sess = Session(ns="t", db="t", auth_level="owner")
+    txn = ds.transaction(write=True)
+    ctx = Ctx(ds, sess, txn)
+    define_module("hostmod", build_host_module(), ctx)
+    txn.commit()
+
+    txn = ds.transaction(write=True)
+    ctx = Ctx(ds, sess, txn)
+    inst = _instance("hostmod", ctx)
+    # seed the value bytes (CBOR int 7) at offset 64
+    enc = wire.encode(7)
+    inst._store(64, enc)
+    n = inst.invoke("kv_roundtrip", [len(enc)])[0]
+    assert n == len(enc)
+    assert wire.decode(inst._load(256, n)) == 7
+    # module-scoped store is visible across instances
+    assert ds._surrealism_kv[("t", "t", "hostmod")]["k"] == 7
+
+    # sql import: write a record, then read it back through a query
+    q = b"UPDATE seedrec:1 SET v = v + 1 RETURN VALUE v"
+    inst._store(512, q)
+    n = inst.invoke("run_sql", [len(q)])[0]
+    out = wire.decode(inst._load(1024, n))
+    assert out == [42]
+    txn.cancel()
+    # the write went through the real pipeline (own txn, committed)
+    assert ds.query("SELECT VALUE v FROM ONLY seedrec:1",
+                    ns="t", db="t")[-1] == 42
