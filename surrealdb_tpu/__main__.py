@@ -180,7 +180,7 @@ def main(argv=None):
     if args.cmd == "upgrade":
         from surrealdb_tpu import key as K
 
-        ds = Datastore(args.path)
+        ds = Datastore(args.path, check_version=False)
         txn = ds.transaction(write=True)
         try:
             cur = int((txn.get(K.storage_version()) or b"1").decode())

@@ -449,6 +449,22 @@ def _table_kind_structure(d):
     return {"kind": d.kind.upper()}
 
 
+def _field_seg_sql(seg: str, keyish: bool) -> str:
+    """One dot-segment of a field name. Bracket suffixes ([1], [*]) and a
+    trailing flatten ellipsis stay OUTSIDE the ident escaping (reference
+    renders `index[1]` and `flatten…` bare)."""
+    import re as _re3
+
+    from surrealdb_tpu.val import escape_rid_table
+
+    m = _re3.match(r"^(.*?)((?:\[[^\]]*\])*)(\u2026?)$", seg)
+    base, brackets, flat = m.group(1), m.group(2), m.group(3)
+    if base == "*" or (base == "" and (brackets or flat)):
+        return seg
+    esc = escape_rid_table(base) if keyish else escape_ident(base)
+    return esc + brackets + flat
+
+
 def _field_name_sql(name_str: str) -> str:
     # escape each dot segment independently (`value`.sub stays quoted)
     parts = []
@@ -456,7 +472,7 @@ def _field_name_sql(name_str: str) -> str:
         if seg == "*" or seg.startswith("["):
             parts.append(seg)
         else:
-            parts.append(escape_ident(seg))
+            parts.append(_field_seg_sql(seg, keyish=False))
     return ".".join(parts)
 
 
@@ -470,7 +486,7 @@ def field_name_key(name_str: str) -> str:
         if seg == "*" or seg.startswith("["):
             parts.append(seg)
         else:
-            parts.append(escape_rid_table(seg))
+            parts.append(_field_seg_sql(seg, keyish=True))
     return ".".join(parts)
 
 
@@ -492,7 +508,13 @@ def render_field(d, tb) -> str:
     if d.assert_ is not None:
         out += f" ASSERT {_expr_sql(d.assert_)}"
     if d.computed is not None:
-        out += f" COMPUTED {_expr_sql(d.computed)}"
+        comp = d.computed
+        from surrealdb_tpu.expr.ast import BlockExpr as _Blk2
+        from surrealdb_tpu.expr.ast import Subquery as _Sub2
+
+        if isinstance(comp, _Sub2) and isinstance(comp.stmt, _Blk2):
+            comp = comp.stmt  # COMPUTED { a } renders without parens
+        out += f" COMPUTED {_expr_sql(comp)}"
     if d.reference is not None:
         out += " REFERENCE ON DELETE " + d.reference.get(
             "on_delete", "ignore"
@@ -601,10 +623,10 @@ def render_event(d, tb) -> str:
         if isinstance(t, _Sub) and isinstance(t.stmt, _Blk):
             t = t.stmt
         x = _expr_sql(t)
-        from surrealdb_tpu.expr.ast import Literal as _Lit
+        from surrealdb_tpu.expr.ast import Idiom as _Idm, Literal as _Lit
 
-        if isinstance(t, _Lit):
-            return x  # plain values render bare: THEN 'hello world'
+        if isinstance(t, (_Lit, _Idm)):
+            return x  # plain values/idioms render bare: THEN bla
         return x if x.startswith(("(", "{")) else f"({x})"
 
     then = ", ".join(wrap(t) for t in d.then)
@@ -657,7 +679,10 @@ def render_function(d) -> str:
     out = f"DEFINE FUNCTION fn::{d.name}({args})"
     if d.returns is not None:
         out += f" -> {kind_name(d.returns)}"
-    out += f" {_expr_sql(d.block)}"
+    body = _expr_sql(d.block)
+    if body == "{  }":
+        body = "{;}"  # reference renders an empty function body as {;}
+    out += f" {body}"
     if d.comment is not None:
         out += f" COMMENT {_str_sql(d.comment)}"
     p = d.permissions

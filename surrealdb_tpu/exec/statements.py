@@ -4031,6 +4031,8 @@ def _field_name_str(parts) -> str:
 
             if isinstance(p.expr, _L):
                 out.append(f"[{p.expr.value}]")
+        elif isinstance(p, PFlatten):
+            out.append("\u2026")  # `field...` renders with an ellipsis
     return "".join(out)
 
 

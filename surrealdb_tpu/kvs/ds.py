@@ -85,7 +85,7 @@ class Notification:
 
 class Datastore:
     def __init__(self, path: str = "memory", strict: bool = False,
-                 capabilities=None):
+                 capabilities=None, check_version: bool = True):
         from surrealdb_tpu.capabilities import Capabilities
 
         self.path = path
@@ -176,7 +176,7 @@ class Datastore:
         from surrealdb_tpu.kvs.remote import RemoteBackend as _RB
 
         self._local_catalog_cache = not isinstance(self.backend, _RB)
-        self._stamp_storage_version()
+        self._stamp_storage_version(check_version)
 
     def start_node_tasks(self, interval_s: float = 10.0,
                          stale_s: float = 30.0):
@@ -281,9 +281,10 @@ class Datastore:
 
     STORAGE_VERSION = 1  # on-disk format version (reference kvs/version/)
 
-    def _stamp_storage_version(self):
-        """Stamp new stores; refuse to open a FUTURE format (reference
-        version markers: `surreal upgrade` migrates, open never does)."""
+    def _stamp_storage_version(self, check: bool = True):
+        """Stamp new stores; refuse to open any OTHER format version
+        (reference version markers: `surreal upgrade` migrates forward,
+        a plain open never does, and a FUTURE format never opens)."""
         from surrealdb_tpu import key as K
 
         txn = self.transaction(write=True)
@@ -295,12 +296,20 @@ class Datastore:
                 txn.commit()
                 return
             txn.cancel()
+            if not check:
+                return  # the upgrade/fix CLI opens old stores to migrate
             have = int(cur.decode() or 1)
             if have > self.STORAGE_VERSION:
                 raise SdbError(
                     f"The storage version {have} is newer than this build "
                     f"supports ({self.STORAGE_VERSION}); run a newer "
                     f"release or `surreal fix`"
+                )
+            if have < self.STORAGE_VERSION:
+                raise SdbError(
+                    f"The storage version {have} is older than this build "
+                    f"({self.STORAGE_VERSION}); run `surreal upgrade` to "
+                    f"migrate the data"
                 )
         except SdbError:
             raise

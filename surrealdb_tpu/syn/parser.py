@@ -954,15 +954,19 @@ class Parser:
             self.next()
             perms = None
             comment = None
-            self.expect_kw("value")
-            value = self.parse_expr()
+            value = None
             while True:
-                if self.eat_kw("permissions"):
+                if self.eat_kw("value"):
+                    value = self.parse_expr()
+                elif self.eat_kw("permissions"):
                     perms = self._parse_permissions_value()
                 elif self.eat_kw("comment"):
                     comment = self._comment_value()
                 else:
                     break
+            if value is None:
+                # VALUE is optional (upgrade/define/param): defaults NONE
+                value = Literal(NONE)
             return DefineParam(t.value, value, ine, ow, perms, comment)
         if self.eat_kw("function", "fn"):
             return self._define_function()
@@ -1258,10 +1262,13 @@ class Parser:
         )
 
     def _field_name_parts(self):
-        """Field name as idiom parts: a.b.c, a[*], a.*"""
+        """Field name as idiom parts: a.b.c, a[*], a.*, a..."""
         parts = [PField(self.ident_or_str())]
         while True:
-            if self.at_op(".") :
+            if self.at_op("..."):
+                self.next()
+                parts.append(PFlatten())
+            elif self.at_op(".") :
                 self.next()
                 if self.at_op("*"):
                     self.next()

@@ -1,0 +1,139 @@
+"""Upgrade/compat coverage (reference tests/database_upgrade.rs:8 +
+language-tests/tests/upgrade): datasets written by one process must be
+readable after reopening the store, the storage-version marker gates
+opens, and `surreal upgrade`/`fix` migrate old markers."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+
+UPGRADE_ROOT = "/root/reference/language-tests/tests/upgrade"
+
+
+def _upgrade_files():
+    out = []
+    if not os.path.isdir(UPGRADE_ROOT):
+        return out
+    for dirpath, _dirs, files in os.walk(UPGRADE_ROOT):
+        for fn in sorted(files):
+            if fn.endswith(".surql") and not fn.endswith("_import.surql"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+@pytest.mark.parametrize(
+    "path", _upgrade_files(),
+    ids=lambda p: os.path.relpath(p, UPGRADE_ROOT) if isinstance(p, str)
+    else p,
+)
+def test_upgrade_suite_disk_roundtrip(path, tmp_path):
+    """The reference harness writes each import with an OLD binary and
+    reads with the new one; here the same storage-format contract is
+    exercised as a full disk round-trip: import into an on-disk store,
+    close it, reopen a FRESH datastore over the same files, and check
+    the expectations."""
+    from lang_harness import _exact_eq, parse_test_file
+
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu.kvs.ds import Session
+    from surrealdb_tpu.syn import parse_value
+
+    t = parse_test_file(path)
+    if not t.run or t.wip:
+        pytest.skip("not runnable")
+    if t.config.get("test", {}).get("importing-version"):
+        # version-specific migration semantics need a real old binary
+        pytest.skip("requires importing from an older release")
+    store = f"lsm://{tmp_path}/store"
+    ds = Datastore(store)
+    sess = Session(ns=t.ns, db=t.db, auth_level="owner")
+    for imp in t.imports:
+        ipath = os.path.join(os.path.dirname(t.path), imp)
+        if not os.path.exists(ipath):
+            ipath = os.path.join(
+                os.path.dirname(UPGRADE_ROOT), imp
+            )
+        it = parse_test_file(ipath)
+        for r in ds.execute(it.sql, session=sess):
+            assert r.error is None, f"import failed: {r.error}"
+    ds.backend.close() if hasattr(ds.backend, "close") else None
+    del ds
+
+    ds2 = Datastore(store)
+    sess2 = Session(ns=t.ns, db=t.db, auth_level="owner")
+    sess2.redact_volatile_explain_attrs = True
+    res = ds2.execute(t.sql, session=sess2)
+    assert len(res) == len(t.results), (
+        f"statement count mismatch: {len(res)} vs {len(t.results)}"
+    )
+    for i, (got, want) in enumerate(zip(res, t.results)):
+        if isinstance(want, str):
+            want = {"value": want}
+        if "error" in want and want["error"] is not False:
+            assert got.error is not None, f"stmt {i}: expected error"
+            continue
+        if want.get("skip"):
+            continue
+        if "match" in want:
+            continue  # match exprs need the full harness; value checks
+        if "value" in want:
+            assert got.error is None, f"stmt {i}: {got.error}"
+            expected = parse_value(want["value"])
+            assert _exact_eq(
+                got.result, expected,
+                bool(want.get("skip-record-id-key")),
+                bool(want.get("skip-datetime")),
+                bool(want.get("float-roughly-eq")),
+            ), f"stmt {i}: got {got.result!r}"
+
+
+def test_version_marker_gates_and_upgrades(tmp_path):
+    """Old markers migrate via `surreal upgrade`; a FUTURE marker refuses
+    to open (reference kvs/version downgrade protection)."""
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu.err import SdbError
+
+    store = f"lsm://{tmp_path}/s1"
+    ds = Datastore(store)
+    ds.query("CREATE t:1 SET a = 1", ns="x", db="x")
+    del ds
+
+    # rewrite the marker to an OLD version: plain open refuses, the
+    # upgrade CLI migrates, then data reads fine
+    ds = Datastore(store, check_version=False)
+    txn = ds.transaction(write=True)
+    from surrealdb_tpu import key as K
+
+    txn.set(K.storage_version(), b"0")
+    txn.commit()
+    del ds
+    with pytest.raises(SdbError, match="upgrade"):
+        Datastore(store)
+    out = subprocess.run(
+        [sys.executable, "-m", "surrealdb_tpu", "upgrade", "--path", store],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    ds = Datastore(store)
+    assert ds.query("SELECT VALUE a FROM ONLY t:1", ns="x", db="x")[-1] == 1
+    del ds
+
+    # future marker: refuse (no silent downgrade corruption)
+    store2 = f"lsm://{tmp_path}/s2"
+    ds = Datastore(store2)
+    ds.query("CREATE t:1 SET a = 1", ns="x", db="x")
+    ds = Datastore(store2, check_version=False)
+    txn = ds.transaction(write=True)
+    from surrealdb_tpu import key as K
+
+    txn.set(K.storage_version(), str(Datastore.STORAGE_VERSION + 1).encode())
+    txn.commit()
+    del ds
+    with pytest.raises(SdbError):
+        Datastore(store2)
