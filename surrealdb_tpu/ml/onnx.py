@@ -222,6 +222,71 @@ def _softmax(x, axis):
     return e / jnp.sum(e, axis=axis, keepdims=True)
 
 
+def _spatial_pads(a, nsp: int):
+    """ONNX pads [b1..bn, e1..en] -> [(b1,e1)...]; SAME_UPPER handled by
+    the caller via explicit output shapes when auto_pad is set."""
+    pads = a.get("pads")
+    if pads is None:
+        return [(0, 0)] * nsp
+    return [(int(pads[i]), int(pads[i + nsp])) for i in range(nsp)]
+
+
+def _conv(ins, a):
+    """ONNX Conv on NCHW/NCW layouts via lax.conv_general_dilated (the
+    MXU-friendly convolution primitive; reference links ONNX Runtime)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    x, w = ins[0], ins[1]
+    nsp = x.ndim - 2
+    strides = [int(s) for s in a.get("strides", [1] * nsp)]
+    dil = [int(d) for d in a.get("dilations", [1] * nsp)]
+    group = int(a.get("group", 1))
+    if a.get("auto_pad") in ("SAME_UPPER", "SAME_LOWER"):
+        padding = "SAME"
+    else:
+        padding = _spatial_pads(a, nsp)
+    dims = ("NCHW", "OIHW", "NCHW") if nsp == 2 else ("NCH", "OIH", "NCH")
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dil, feature_group_count=group,
+        dimension_numbers=dims,
+    )
+    if len(ins) > 2 and ins[2] is not None:
+        b = ins[2]
+        shp = [1] * out.ndim
+        shp[1] = b.shape[0]
+        out = out + b.reshape(shp)
+    return out
+
+
+def _pool(x, a, op):
+    """ONNX MaxPool/AveragePool via lax.reduce_window (count_include_pad=0
+    semantics for the average: divide by the number of REAL elements)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    nsp = x.ndim - 2
+    ks = [int(k) for k in a.get("kernel_shape", [1] * nsp)]
+    strides = [int(s) for s in a.get("strides", [1] * nsp)]
+    pads = _spatial_pads(a, nsp)
+    window = (1, 1) + tuple(ks)
+    wstr = (1, 1) + tuple(strides)
+    wpad = ((0, 0), (0, 0)) + tuple(pads)
+    if op == "MaxPool":
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max, window, wstr, wpad
+        )
+    sums = lax.reduce_window(x, 0.0, lax.add, window, wstr, wpad)
+    if not a.get("count_include_pad") and any(
+        p != (0, 0) for p in pads
+    ):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, wstr, wpad)
+        return sums / counts
+    return sums / float(np.prod(ks))
+
+
 def run_graph(g: OnnxGraph, feed: dict[str, np.ndarray]) -> list:
     """Execute the graph; returns the output arrays (numpy)."""
     import jax.numpy as jnp
@@ -302,6 +367,53 @@ def run_graph(g: OnnxGraph, feed: dict[str, np.ndarray]) -> list:
         elif op == "ReduceSum":
             out = jnp.sum(ins[0], axis=tuple(a.get("axes", [])) or None,
                           keepdims=bool(a.get("keepdims", 1)))
+        elif op == "Transpose":
+            perm = a.get("perm")
+            out = jnp.transpose(ins[0], axes=perm)
+        elif op == "Gather":
+            idx = jnp.asarray(ins[1], jnp.int32)
+            out = jnp.take(ins[0], idx, axis=a.get("axis", 0))
+        elif op == "Squeeze":
+            axes = a.get("axes")
+            if axes is None and len(ins) > 1 and ins[1] is not None:
+                axes = [int(x) for x in np.asarray(ins[1]).tolist()]
+            out = (
+                jnp.squeeze(ins[0], axis=tuple(axes)) if axes
+                else jnp.squeeze(ins[0])
+            )
+        elif op == "Unsqueeze":
+            axes = a.get("axes")
+            if axes is None and len(ins) > 1 and ins[1] is not None:
+                axes = [int(x) for x in np.asarray(ins[1]).tolist()]
+            out = ins[0]
+            for ax in sorted(axes or [0]):
+                out = jnp.expand_dims(out, int(ax))
+        elif op == "Shape":
+            out = jnp.asarray(ins[0].shape, jnp.int64)
+        elif op == "BatchNormalization":
+            x, scale, bias, mean, var = ins[:5]
+            eps = a.get("epsilon", 1e-5)
+            # stats broadcast over the channel axis (axis 1)
+            shp = [1] * x.ndim
+            shp[1] = x.shape[1]
+            out = (
+                (x - mean.reshape(shp))
+                / jnp.sqrt(var.reshape(shp) + eps)
+                * scale.reshape(shp)
+                + bias.reshape(shp)
+            )
+        elif op == "Conv":
+            out = _conv(ins, a)
+        elif op in ("MaxPool", "AveragePool"):
+            out = _pool(ins[0], a, op)
+        elif op == "GlobalAveragePool":
+            out = jnp.mean(
+                ins[0], axis=tuple(range(2, ins[0].ndim)), keepdims=True
+            )
+        elif op == "GlobalMaxPool":
+            out = jnp.max(
+                ins[0], axis=tuple(range(2, ins[0].ndim)), keepdims=True
+            )
         else:
             raise SdbError(f"ONNX operator '{op}' is not supported")
         env[node.outputs[0]] = out
