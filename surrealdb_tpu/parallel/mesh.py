@@ -157,3 +157,149 @@ def sharded_rank_rescore(mesh: Mesh, xs_rank, xs_full, qs, k: int, kc: int,
     )
     fn = _rank_rescore_jit(mesh, k, kc, metric, recall_target)
     return fn(xs_rank, xs_full, x2, norms, valid, qs_rep)
+
+
+# ---------------------------------------------------------------------------
+# multi-host (DCN) meshes
+# ---------------------------------------------------------------------------
+
+DCN_AXIS = "dcn"
+
+
+def multihost_mesh(devices=None, hosts: int | None = None) -> Mesh:
+    """Two-axis (dcn, data) mesh for multi-host deployments: the host
+    axis rides DCN, the per-host device axis rides ICI (SURVEY §2.13
+    TPU-equivalents; "How to Scale Your Model" hybrid-mesh recipe).
+
+    Under real multi-process JAX, devices group by process via
+    `mesh_utils.create_hybrid_device_mesh` so each mesh row is one
+    host's ICI domain. In a single process (the dryrun validator),
+    `hosts` splits the local devices into simulated host groups — the
+    collective STRUCTURE (ICI-stage merge, then DCN-stage merge) is
+    identical, only the transport differs."""
+    devices = list(devices if devices is not None else jax.devices())
+    nproc = jax.process_count()
+    if hosts is None:
+        hosts = nproc
+    if hosts <= 1:
+        return Mesh(np.asarray(devices).reshape(1, -1),
+                    (DCN_AXIS, DATA_AXIS))
+    if nproc > 1 and hosts == nproc:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (len(devices) // hosts,), (hosts,), devices=devices,
+        )
+        return Mesh(arr.reshape(hosts, -1), (DCN_AXIS, DATA_AXIS))
+    if len(devices) % hosts:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {hosts} hosts"
+        )
+    return Mesh(np.asarray(devices).reshape(hosts, -1),
+                (DCN_AXIS, DATA_AXIS))
+
+
+def shard_rows_hier(mesh: Mesh, arr):
+    """Row-shard a [N, D] array over BOTH mesh axes (host-major)."""
+    n_shards = mesh.devices.size
+    pad = (-arr.shape[0]) % n_shards
+    if pad:
+        arr = np.pad(arr, ((0, pad), (0, 0)))
+    return jax.device_put(
+        arr, NamedSharding(mesh, P((DCN_AXIS, DATA_AXIS), None))
+    ), pad
+
+
+def shard_vec_hier(mesh: Mesh, arr, pad: int, fill=0):
+    if pad:
+        arr = np.pad(arr, (0, pad), constant_values=fill)
+    return jax.device_put(
+        arr, NamedSharding(mesh, P((DCN_AXIS, DATA_AXIS)))
+    )
+
+
+def _rank_rescore_shard_hier(xr, xf, x2, norms, valid, qs, k: int, kc: int,
+                             metric: str, recall_target: float):
+    """Hierarchical merge: candidates all_gather + top-k over the ICI
+    axis first (intra-host), then only the per-host [B, k] winners cross
+    the DCN axis for the final merge — the expensive inter-host hop
+    carries k candidates per host, not kc x devices."""
+    ici_sz = jax.lax.axis_size(DATA_AXIS)
+    base = (
+        jax.lax.axis_index(DCN_AXIS) * ici_sz
+        + jax.lax.axis_index(DATA_AXIS)
+    ) * xr.shape[0]
+    qb = qs.astype(jnp.bfloat16)
+    dots = jnp.einsum("nd,bd->bn", xr, qb, preferred_element_type=jnp.float32)
+    if metric == "euclidean":
+        score = x2[None, :] - 2.0 * dots
+    else:
+        score = -dots
+    score = jnp.where(valid[None, :], score, jnp.inf)
+    _, cand = jax.lax.approx_max_k(-score, kc, recall_target=recall_target)
+    rows = xf[cand]
+    if metric == "euclidean":
+        diff = rows - qs[:, None, :]
+        d = jnp.sqrt(jnp.maximum((diff * diff).sum(axis=-1), 0.0))
+    elif metric == "cosine":
+        dd = jnp.einsum("bkd,bd->bk", rows, qs,
+                        preferred_element_type=jnp.float32)
+        qn = jnp.maximum(jnp.linalg.norm(qs, axis=-1), 1e-30)
+        d = 1.0 - dd / jnp.maximum(norms[cand] * qn[:, None], 1e-30)
+    else:
+        d = -jnp.einsum("bkd,bd->bk", rows, qs,
+                        preferred_element_type=jnp.float32)
+    d = jnp.where(valid[cand], d, jnp.inf)
+    gids = (cand + base).astype(jnp.int32)
+    # stage 1: intra-host (ICI) merge
+    d_ici = jax.lax.all_gather(d, DATA_AXIS, axis=1, tiled=True)
+    i_ici = jax.lax.all_gather(gids, DATA_AXIS, axis=1, tiled=True)
+    nd, sel = jax.lax.top_k(-d_ici, min(k, d_ici.shape[1]))
+    d_host = -nd
+    i_host = jnp.take_along_axis(i_ici, sel, axis=1)
+    # stage 2: inter-host (DCN) merge — [B, k] per host only
+    d_all = jax.lax.all_gather(d_host, DCN_AXIS, axis=1, tiled=True)
+    i_all = jax.lax.all_gather(i_host, DCN_AXIS, axis=1, tiled=True)
+    nd2, sel2 = jax.lax.top_k(-d_all, k)
+    return -nd2, jnp.take_along_axis(i_all, sel2, axis=1)
+
+
+@lru_cache(maxsize=256)
+def _rank_rescore_hier_jit(mesh: Mesh, k: int, kc: int, metric: str,
+                           recall_target: float):
+    spec_rows = P((DCN_AXIS, DATA_AXIS), None)
+    spec_vec = P((DCN_AXIS, DATA_AXIS))
+    return jax.jit(
+        jax.shard_map(
+            partial(_rank_rescore_shard_hier, k=k, kc=kc, metric=metric,
+                    recall_target=recall_target),
+            mesh=mesh,
+            in_specs=(spec_rows, spec_rows, spec_vec, spec_vec, spec_vec,
+                      P(None, None)),
+            out_specs=(P(None, None), P(None, None)),
+            check_vma=False,
+        )
+    )
+
+
+def sharded_rank_rescore_hier(mesh: Mesh, xs_rank, xs_full, qs, k: int,
+                              kc: int, metric: str = "euclidean", x2=None,
+                              norms=None, valid=None,
+                              recall_target: float = 0.95):
+    """Two-stage sharded KNN over a (dcn, data) hybrid mesh. Inputs are
+    row-sharded over both axes (shard_rows_hier); outputs replicate."""
+    nloc = xs_rank.shape[0] // mesh.devices.size
+    if x2 is None:
+        x2 = jnp.zeros((xs_rank.shape[0],), dtype=jnp.float32)
+    if norms is None:
+        norms = jnp.ones((xs_rank.shape[0],), dtype=jnp.float32)
+    if valid is None:
+        valid = jnp.ones((xs_rank.shape[0],), dtype=bool)
+    kc = min(kc, nloc)
+    k = min(k, kc * mesh.devices.shape[1])
+    qs_rep = jax.device_put(
+        np.ascontiguousarray(qs, dtype=np.float32),
+        NamedSharding(mesh, P(None, None)),
+    )
+    fn = _rank_rescore_hier_jit(mesh, k, kc, metric, recall_target)
+    return fn(xs_rank, xs_full, x2, norms, valid, qs_rep)
