@@ -155,9 +155,26 @@ def execute_graphql(ds, session, query: str, variables=None) -> dict:
                 elif t == ("punct", ")"):
                     depth -= 1
     sels = p.parse_selection_set()
+    # DEFINE CONFIG GRAPHQL DEPTH/COMPLEXITY limits (reference core/src/gql
+    # schema guards): depth counts selection nesting, complexity counts
+    # every field selection
+    cfg = _gql_config(ds, session)
+    limits_err = _check_limits(sels, cfg)
+    if limits_err is not None:
+        return {"data": None, "errors": [{"message": limits_err}]}
     data = {}
     errors = []
     for out_name, name, args, sub in sels:
+        fname = _function_field(cfg, name, ds, session)
+        if fname is not None:
+            try:
+                data[out_name] = _resolve_function(
+                    ds, session, fname, args
+                )
+            except SdbError as e:
+                errors.append({"message": str(e)})
+                data[out_name] = None
+            continue
         if name == "__schema":
             data[out_name] = _schema_introspection(ds, session, sub)
             continue
@@ -197,6 +214,115 @@ _FILTER_OPS = {
     "eq": "=", "ne": "!=", "gt": ">", "gte": ">=", "lt": "<", "lte": "<=",
     "contains": "CONTAINS",
 }
+
+
+def _gql_config(ds, session):
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.catalog import ConfigDef
+
+    if not (session.ns and session.db):
+        return None
+    txn = ds.transaction(write=False)
+    try:
+        d = txn.get_val(K.cfg_def(session.ns, session.db, "GRAPHQL"))
+    finally:
+        txn.cancel()
+    return d if isinstance(d, ConfigDef) else None
+
+
+def _measure(sels, depth=1):
+    """(max_depth, field_count) of a parsed selection tree."""
+    count = 0
+    deepest = depth
+    for _o, _n, _a, sub in sels:
+        count += 1
+        if sub:
+            d2, c2 = _measure(sub, depth + 1)
+            deepest = max(deepest, d2)
+            count += c2
+    return deepest, count
+
+
+def _check_limits(sels, cfg):
+    if cfg is None:
+        return None
+    depth, count = _measure(sels)
+    if cfg.depth is not None and depth > int(cfg.depth):
+        return (
+            f"Query is nested too deep: depth {depth} exceeds the "
+            f"configured maximum of {int(cfg.depth)}"
+        )
+    if cfg.complexity is not None and count > int(cfg.complexity):
+        return (
+            f"Query is too complex: {count} fields exceed the configured "
+            f"maximum of {int(cfg.complexity)}"
+        )
+    return None
+
+
+def _function_field(cfg, name: str, ds, session):
+    """GraphQL field -> fn:: function name when the GRAPHQL config
+    exposes functions (AUTO or INCLUDE list; `::` maps to `_`). Fields
+    that don't name an EXISTING function fall through to the table
+    resolver — a table called my_table must not shadow-miss."""
+    if cfg is None:
+        return None
+    mode = cfg.functions
+    if mode in (None, "NONE"):
+        return None
+
+    def _exists(fn):
+        from surrealdb_tpu import key as K
+        from surrealdb_tpu.catalog import FunctionDef
+
+        txn = ds.transaction(write=False)
+        try:
+            return isinstance(
+                txn.get_val(K.fc_def(session.ns, session.db, fn)),
+                FunctionDef,
+            )
+        finally:
+            txn.cancel()
+
+    candidates = [name]
+    if "_" in name:
+        candidates.append(name.replace("_", "::"))
+    for fname in candidates:
+        if mode == "AUTO":
+            if _exists(fname):
+                return fname
+            continue
+        if isinstance(mode, tuple):
+            kind, names = mode
+            listed = fname in names
+            if (kind == "INCLUDE") == listed and _exists(fname):
+                return fname
+    return None
+
+
+def _resolve_function(ds, session, fname: str, args: dict):
+    """Run fn::name with named GraphQL args bound positionally (catalog
+    argument order)."""
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.catalog import FunctionDef
+    from surrealdb_tpu.exec.context import Ctx
+    from surrealdb_tpu.fnc import call_custom
+
+    txn = ds.transaction(write=True)
+    try:
+        fd = txn.get_val(K.fc_def(session.ns, session.db, fname))
+        if not isinstance(fd, FunctionDef):
+            raise SdbError(f"Unknown query field '{fname}'")
+        ordered = [args.get(pname, None) for pname, _k in fd.args]
+        while ordered and ordered[-1] is None:
+            ordered.pop()
+        ctx = Ctx(ds, session, txn)
+        out = call_custom(fname, ordered, ctx)
+        txn.commit()
+    except BaseException:
+        txn.cancel()
+        raise
+    return to_json(out)
 
 
 def _gql_rid(tb: str, idv) -> str:

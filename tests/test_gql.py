@@ -87,3 +87,25 @@ def test_order_arg_injection_blocked():
     # table still exists and ordering by a legit field works
     out = execute_graphql(ds, sess, '{ person(order: "age") { name } }')
     assert [r["name"] for r in out["data"]["person"]] == ["Ada", "Bob"]
+
+
+def test_depth_complexity_limits_and_function_fields():
+    """DEFINE CONFIG GRAPHQL DEPTH/COMPLEXITY guard queries; FUNCTIONS
+    AUTO exposes fn:: functions as query fields (reference core/src/gql
+    schema config)."""
+    ds, sess = _ds()
+    ds.query("DEFINE FUNCTION fn::double($x: number) { RETURN $x * 2 }",
+             ns="t", db="t")
+    ds.query("DEFINE CONFIG GRAPHQL TABLES AUTO FUNCTIONS AUTO "
+             "DEPTH 3 COMPLEXITY 10", ns="t", db="t")
+    out = execute_graphql(ds, sess, "{ double(x: 21) }")
+    assert out["data"]["double"] == 42
+    # tables still resolve (functions must not shadow them)
+    out = execute_graphql(ds, sess, '{ person(order: "age") { name } }')
+    assert [r["name"] for r in out["data"]["person"]] == ["Ada", "Bob"]
+    deep = ("{ person { city { " + "x { " * 4 + "y" + " }" * 4 + " } } }")
+    out = execute_graphql(ds, sess, deep)
+    assert "nested too deep" in out["errors"][0]["message"]
+    wide = "{ " + " ".join(f"a{i}: person {{ name }}" for i in range(9)) + " }"
+    out = execute_graphql(ds, sess, wide)
+    assert "too complex" in out["errors"][0]["message"]
