@@ -2058,6 +2058,19 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                     f"predicate: {pred_s}]"
                 )
                 residual = None
+        if label is None and ctx.doc is not None and single_target:
+            # scans inside a per-document context (computed fields, field
+            # clauses) re-plan per evaluation: the reference labels them
+            # DynamicScan with params UN-inlined (they're row-dynamic)
+            extra = ""
+            if n.cond is not None:
+                extra += f", predicate: {_expr_sql(n.cond)}"
+                residual = None
+            if n.limit is not None and not n.order and n.group is None:
+                extra += f", limit: {int(evaluate(n.limit, ctx))}"
+                if n.start is not None:
+                    extra += f", offset: {int(evaluate(n.start, ctx))}"
+            label = f"DynamicScan [ctx: Db] [source: {tb}{extra}]"
         if label is None:
             extra = ""
             if n.cond is not None and single_target:
@@ -2301,8 +2314,21 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
             elif only_rid_scans:
                 root_lines.append(("Project [ctx: Db]", out_rows_n))
             else:
+                def _proj_name(e, a):
+                    if a:
+                        return a
+                    # destructure projections list the BASE field; the
+                    # destructure itself runs in a Compute node
+                    if isinstance(e, Idiom):
+                        cut = next(
+                            (ix for ix, p in enumerate(e.parts)
+                             if isinstance(p, PDestructure)), None)
+                        if cut:
+                            return expr_name(Idiom(list(e.parts[:cut])))
+                    return expr_name(e)
+
                 projs = ", ".join(
-                    "*" if e == "*" else (a or expr_name(e)) for e, a in n.exprs
+                    "*" if e == "*" else _proj_name(e, a) for e, a in n.exprs
                 )
                 root_lines.append(
                     (f"SelectProject [ctx: Db] [projections: {projs}]",
@@ -2318,6 +2344,17 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                     for e, a in n.exprs
                     if e != "*" and not isinstance(e, Idiom)
                 ]
+                for e, a in n.exprs:
+                    if e == "*" or not isinstance(e, Idiom):
+                        continue
+                    if any(isinstance(p, PDestructure) for p in e.parts) \
+                            and not any(
+                                isinstance(p, PRecurse) for p in e.parts
+                            ):
+                        computed.append(
+                            f"{_proj_name(e, a)} = "
+                            f"{expr_name(e, sql=True)}"
+                        )
                 # recursion idioms compute through a Recurse sub-plan
                 for e, a in n.exprs:
                     if e == "*" or not isinstance(e, Idiom):
@@ -2529,11 +2566,17 @@ def _tree_to_json(entries, analyze, total):
             for part in _re_mod.split(r", (?=[\w.]+: )", raw):
                 k, _, v = part.partition(": ")
                 attrs[k] = v
-        return {
+        out = {
             "operator": m.group("op"),
             "context": m.group("ctx"),
             "attributes": attrs,
         }
+        if m.group("op") == "Filter" and "predicate" in attrs:
+            # reference Filter nodes also carry an expressions list
+            out["expressions"] = [
+                {"role": "predicate", "sql": attrs["predicate"]}
+            ]
+        return out
 
     nodes = []
     stack = []  # (depth, node)

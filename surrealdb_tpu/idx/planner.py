@@ -326,6 +326,15 @@ def _array_like_paths(tb, ctx) -> set:
                 out.add(fd.name_str)
     except Exception:
         pass
+    try:
+        for idef in get_indexes_for(tb, ctx):
+            for col in idef.cols_str:
+                if col.endswith("[*]"):
+                    out.add(col[:-3])
+                elif col.endswith(".*"):
+                    out.add(col[:-2])
+    except Exception:
+        pass
     return out
 
 
@@ -497,16 +506,19 @@ def _classify_preds(cond, array_paths=frozenset(), value_idioms=True):
         lp = _field_path(pred.lhs)
         rp = _field_path(pred.rhs)
         path = op = valexpr = None
+        contain_alias = False
         if lp is not None and rp is None:
             op = pred.op
             if op == "∋":
                 # CONTAINS only matches index entries when the column is
-                # array-shaped (unnested entries — via a .*/… path or a
-                # declared array/set field); string fields use substring
-                # semantics and can't ride the index
+                # array-shaped (unnested entries — via a .*/… path, a
+                # declared array/set field, or an explicit `col[*]` index
+                # column); string fields use substring semantics and
+                # can't ride the index
                 if not _array_shaped(lp, array_paths):
                     continue
                 op = "="  # per-element entries, equality lookup
+                contain_alias = True
             elif op in ("⊇", "containsany"):
                 # CONTAINSANY/CONTAINSALL [..] become a union of
                 # per-element equality scans. Legacy tree planner: any
@@ -536,6 +548,7 @@ def _classify_preds(cond, array_paths=frozenset(), value_idioms=True):
                 if not _array_shaped(rp, array_paths):
                     continue
                 path, op, valexpr = rp, "=", pred.lhs
+                contain_alias = True
             elif pred.op in ("anyinside", "allinside"):
                 # [..] ANYINSIDE/ALLINSIDE field -> union access
                 # (reference tree.rs AnyInside|AllInside, IdiomPosition::Right;
@@ -566,6 +579,11 @@ def _classify_preds(cond, array_paths=frozenset(), value_idioms=True):
             continue
         if op in ("=", "=="):
             eqs.setdefault(path, valexpr)
+            if contain_alias:
+                # `DEFINE INDEX ... FIELDS col[*]` / `col.*` columns hold
+                # the unnested entries a containment access scans
+                eqs.setdefault(path + "[*]", valexpr)
+                eqs.setdefault(path + ".*", valexpr)
         elif op == "in":
             ins.setdefault(path, valexpr)
         else:
