@@ -276,7 +276,21 @@ def fulltext_index_update(idef, rid: RecordId, before, after, ctx):
 
 
 def ft_search(idef, query: str, ctx, boolean: str = "AND"):
-    """Returns ordered [(rid, score)] plus per-term match offsets."""
+    """Returns ordered [(rid, score)] plus per-term match offsets.
+
+    Memoized per statement (ctx.record_cache): the planner's match-
+    context registration, the access-path analysis, and the scan itself
+    all ask for the same search — one execution serves all three."""
+    ck = ("__ft__", idef.tb, idef.name, query, boolean)
+    hit = ctx.record_cache.get(ck)
+    if hit is not None:
+        return hit
+    out = _ft_search_impl(idef, query, ctx, boolean)
+    ctx.record_cache[ck] = out
+    return out
+
+
+def _ft_search_impl(idef, query: str, ctx, boolean: str = "AND"):
     ns, db = ctx.need_ns_db()
     tb, ix = idef.tb, idef.name
     az = get_analyzer(idef.fulltext.get("analyzer"), ctx)
