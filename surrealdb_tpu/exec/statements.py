@@ -1779,8 +1779,9 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 residual = n.cond
                 continue
 
+            cond_plan = _resolve_type_fields(n.cond, ctx)
             eqs, ins, rngs = _classify_preds(
-                n.cond, _array_like_paths(tb, ctx), value_idioms=False
+                cond_plan, _array_like_paths(tb, ctx), value_idioms=False
             )
             chosen = _choose_index(indexes, eqs, ins, rngs) if (
                 eqs or ins or rngs
@@ -2206,7 +2207,8 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
             except SdbError:
                 filt_rows = out_rows_n
         scan_lines = [
-            (0, f"Filter [ctx: Db] [predicate: {_expr_sql(residual)}]",
+            (0, "Filter [ctx: Db] [predicate: "
+             f"{_expr_sql(_label_cond(residual, ctx))}]",
              filt_rows)
         ] + [(_shift_depth(d, 1), t, r) for d, t, r in scan_lines]
     if n.split:
@@ -2510,6 +2512,78 @@ def _elide_count_args(node):
         n2.rhs = _elide_count_args(node.rhs)
         return n2
     return node
+
+
+def _resolve_type_fields(node, ctx):
+    """Plan-time rewrite: `type::field(<doc-free expr>)` becomes the named
+    column idiom so access-path analysis can match indexes (reference
+    resolves parameterized OData-style columns at plan time)."""
+    import copy as _copy
+
+    from surrealdb_tpu.expr.ast import Binary as _B
+    from surrealdb_tpu.expr.ast import FunctionCall as _FC
+    from surrealdb_tpu.idx.planner import _doc_free_idiom  # noqa: F401
+
+    def const_str(e):
+        from surrealdb_tpu.expr.ast import Literal as _L
+
+        if isinstance(e, _L) and isinstance(e.value, str):
+            return e.value
+        if isinstance(e, Param):
+            try:
+                val = evaluate(e, ctx)
+            except SdbError:
+                return None
+            return val if isinstance(val, str) else None
+        return None
+
+    def rec(e):
+        if isinstance(e, _FC) and e.name.lower() == "type::field" \
+                and len(e.args) == 1:
+            s = const_str(e.args[0])
+            if s:
+                return Idiom([PField(p) for p in s.split(".")])
+        if isinstance(e, _B):
+            e2 = _copy.copy(e)
+            e2.lhs = rec(e.lhs)
+            e2.rhs = rec(e.rhs)
+            return e2
+        return e
+
+    return rec(node)
+
+
+def _label_cond(node, ctx):
+    """Filter-label rendering: function args elide (count(...),
+    type::field(...)) and doc-free IN/INSIDE arrays fold to their
+    evaluated values."""
+    import copy as _copy
+
+    from surrealdb_tpu.expr.ast import ArrayExpr as _AE
+    from surrealdb_tpu.expr.ast import Binary as _B, Constant as _C
+    from surrealdb_tpu.expr.ast import FunctionCall as _FC
+    from surrealdb_tpu.expr.ast import Literal as _L
+
+    def rec(e):
+        if isinstance(e, _FC) and e.args and e.name.lower() in (
+            "count", "type::field", "type::fields"
+        ):
+            e2 = _copy.copy(e)
+            e2.args = [_C("...")]
+            return e2
+        if isinstance(e, _B):
+            e2 = _copy.copy(e)
+            e2.lhs = rec(e.lhs)
+            e2.rhs = rec(e.rhs)
+            if e2.op in ("∈", "IN") and isinstance(e.rhs, _AE):
+                try:
+                    e2.rhs = _L(evaluate(e.rhs, ctx))
+                except SdbError:
+                    pass
+            return e2
+        return e
+
+    return rec(node)
 
 
 def _strip_order(n):
