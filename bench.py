@@ -29,15 +29,28 @@ import time
 import numpy as np
 
 _PLATFORM = None
+# why a run degraded to CPU (watchdog timeout, init error, ...); set
+# locally or inherited through the env across the cpu re-exec so the
+# emitted JSON line records the cause instead of silently reading as a
+# deliberate CPU measurement
+_FALLBACK_REASON = os.environ.get("SURREAL_BENCH_FALLBACK_REASON") or None
 
 
-def _probe_backend(attempts=4, wait_s=45, timeout_s=240) -> str:
+def _init_timeout_s() -> float:
+    from surrealdb_tpu import cnf
+
+    return cnf.BACKEND_INIT_TIMEOUT_S
+
+
+def _probe_backend(attempts=4, wait_s=45, timeout_s=None) -> str:
     """Bounded backend-init probe BEFORE any expensive ingest: the tunneled
     TPU backend can hang (not just error) at init — round 2 lost all
     measurements to exactly that (BENCH_r02 rc=1 after minutes of setup).
     Probes in a subprocess (a hung init can't wedge the bench), retries a
     few times, then fails FAST and LOUD. Returns the platform name."""
     global _PLATFORM
+    if timeout_s is None:
+        timeout_s = _init_timeout_s()
     if _PLATFORM is not None:
         return _PLATFORM
     if os.environ.get("JAX_PLATFORMS", "") == "cpu" or os.environ.get(
@@ -62,7 +75,7 @@ def _probe_backend(attempts=4, wait_s=45, timeout_s=240) -> str:
         except Exception as e:
             print(f"bench: in-process init failed: {e}",
                   file=sys.stderr, flush=True)
-            _reexec_cpu()
+            _reexec_cpu(f"in-process backend init failed: {e}")
     code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
     last = ""
     for i in range(attempts):
@@ -90,12 +103,20 @@ def _probe_backend(attempts=4, wait_s=45, timeout_s=240) -> str:
     print("bench: accelerator backend never came up; falling back to a "
           "CPU-platform run (JSON line will say platform=cpu)",
           file=sys.stderr, flush=True)
-    _reexec_cpu()
+    _reexec_cpu(
+        f"backend init watchdog: {attempts} probes failed "
+        f"(timeout {timeout_s:.0f}s each; last: {last})"
+    )
 
 
-def _reexec_cpu():
+def _reexec_cpu(reason=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    if reason:
+        # survives the exec so the emitted JSON records WHY this run is
+        # a CPU fallback (four rounds of measurements were lost to a
+        # silent hang here — the reason must be in the artifact)
+        env["SURREAL_BENCH_FALLBACK_REASON"] = str(reason)[:500]
     env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize dials the relay
     env.pop("SURREAL_BENCH_INPROC_INIT", None)
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
@@ -679,6 +700,8 @@ def main():
 
     def emit(res):
         res.setdefault("platform", _PLATFORM or "unprobed")
+        if _FALLBACK_REASON:
+            res.setdefault("fallback_reason", _FALLBACK_REASON)
         print(json.dumps(res), flush=True)
 
     fns = {

@@ -63,6 +63,26 @@ def main(argv=None):
     p_kv.add_argument("--data-dir", default=None,
                       help="persist the keyspace (WAL + snapshot); "
                            "restarts recover committed state")
+    p_kv.add_argument("--role", choices=("primary", "replica"),
+                      default="primary",
+                      help="replica processes apply the primary's commit "
+                           "log and stand by for lease-based promotion")
+    p_kv.add_argument("--peers", default=None,
+                      help="comma-separated host:port of EVERY replica-set "
+                           "member (including this one), in promotion-rank "
+                           "order; enables replication + failover")
+    p_kv.add_argument("--peer-index", type=int, default=None,
+                      help="this server's index in --peers (inferred from "
+                           "--bind when omitted)")
+    p_kv.add_argument("--failover-timeout", type=float, default=None,
+                      help="seconds without replication traffic before a "
+                           "replica starts the promotion protocol")
+    p_kv.add_argument("--lease-ttl", type=float, default=None,
+                      help="primary lease TTL in seconds")
+    p_kv.add_argument("--no-fsync", action="store_true",
+                      help="skip fsync on WAL appends (replication still "
+                           "guards acked writes; lose the single-node "
+                           "power-failure guarantee)")
 
     p_up = sub.add_parser(
         "upgrade", help="migrate a store's on-disk format to this release"
@@ -133,8 +153,15 @@ def main(argv=None):
         from surrealdb_tpu.kvs.remote import serve_kv
 
         host, _, port = args.bind.partition(":")
+        peers = ([p.strip() for p in args.peers.split(",") if p.strip()]
+                 if args.peers else None)
         serve_kv(host, int(port), block=True,
-                 data_dir=getattr(args, "data_dir", None))
+                 data_dir=getattr(args, "data_dir", None),
+                 fsync=not args.no_fsync,
+                 role=args.role, peers=peers,
+                 self_index=args.peer_index,
+                 failover_timeout_s=args.failover_timeout,
+                 lease_ttl_s=args.lease_ttl)
         return 0
 
     from surrealdb_tpu import Datastore

@@ -66,6 +66,30 @@ LSM_COMPACT_SEGMENTS = env_int("SURREAL_LSM_COMPACT_SEGMENTS", 6)
 # 0 disables, any other value floors at 1 MiB)
 MEMORY_THRESHOLD = env_int("SURREAL_MEMORY_THRESHOLD", 0)
 
+# -- remote KV client: retry / backoff / failover (kvs/remote.py) ------------
+# total deadline for one logical KV operation across retries+failover
+KV_RETRY_DEADLINE_S = env_float("SURREAL_KV_RETRY_DEADLINE_S", 15.0)
+# exponential-backoff schedule: base * 2^attempt, capped at max, with
+# full jitter in [1-KV_RETRY_JITTER, 1] of the computed delay
+KV_RETRY_BASE_MS = env_float("SURREAL_KV_RETRY_BASE_MS", 25.0)
+KV_RETRY_MAX_MS = env_float("SURREAL_KV_RETRY_MAX_MS", 1000.0)
+KV_RETRY_JITTER = env_float("SURREAL_KV_RETRY_JITTER", 0.5)
+# per-call socket timeout (a partition must not stall a client forever)
+KV_OP_TIMEOUT_S = env_float("SURREAL_KV_OP_TIMEOUT_S", 30.0)
+KV_CONNECT_TIMEOUT_S = env_float("SURREAL_KV_CONNECT_TIMEOUT_S", 5.0)
+
+# -- remote KV service: replication / failover (kvs/remote.py, node.py) ------
+# primary-lease TTL; the primary renews at TTL/3 through the replicated
+# keyspace, so replicas observe liveness via the lease row itself
+KV_LEASE_TTL_S = env_float("SURREAL_KV_LEASE_TTL_S", 6.0)
+# how long a replica waits without replication traffic before it starts
+# the promotion protocol (lease check -> peer survey -> self-promote)
+KV_FAILOVER_TIMEOUT_S = env_float("SURREAL_KV_FAILOVER_TIMEOUT_S", 8.0)
+
+# -- accelerator backend init watchdog (bench.py / __graft_entry__.py) -------
+# device discovery that exceeds this degrades to CPU instead of hanging
+BACKEND_INIT_TIMEOUT_S = env_float("SURREAL_BACKEND_INIT_TIMEOUT_S", 240.0)
+
 
 def env_str(name: str, default: str) -> str:
     return os.environ.get(name, "") or default

@@ -5,6 +5,14 @@ class SdbError(Exception):
     """Base error; message is what the RPC surface returns."""
 
 
+class RetryableKvError(SdbError):
+    """Transport-level KV failure: the transaction did not observe torn
+    state and may be retried from the top. For an in-flight commit the
+    outcome is UNKNOWN (the server may have applied it before the
+    connection died) — retries must be idempotent at the application
+    level, exactly like the reference's retryable TiKV errors."""
+
+
 class ParseError(SdbError):
     def __init__(self, msg, line=None, col=None):
         if line is not None:

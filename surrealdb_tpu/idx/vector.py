@@ -149,28 +149,35 @@ class _Coalescer:
 
     def __init__(self, index):
         self.index = index
-        self.lock = threading.Lock()
-        self.run_lock = threading.Lock()
+        self.cond = threading.Condition()
         self.queue: list = []
+        self.running = False
 
     def search(self, qv: np.ndarray, k: int):
-        ev = threading.Event()
-        slot = [None, None]  # result, exception
-        with self.lock:
-            self.queue.append((qv, k, slot, ev))
-        while True:
-            if ev.is_set():
-                break
-            if self.run_lock.acquire(blocking=False):
-                try:
-                    with self.lock:
-                        batch, self.queue = self.queue, []
-                    if batch:
-                        self._run(batch)
-                finally:
-                    self.run_lock.release()
-            else:
-                ev.wait(0.05)
+        # slot: [result, exception, done]. Waiters are signalled by the
+        # dispatching thread at batch completion (cond.notify_all) — no
+        # polling interval, queued queries wake immediately.
+        slot = [None, None, False]
+        with self.cond:
+            self.queue.append((qv, k, slot))
+            while not slot[2] and self.running:
+                self.cond.wait()
+            if not slot[2]:
+                # no dispatch in flight: THIS thread becomes the
+                # dispatcher for everything queued so far
+                batch, self.queue = self.queue, []
+                self.running = True
+        if slot[2]:
+            # our query rode a previous dispatch
+            if slot[1] is not None:
+                raise slot[1]
+            return slot[0]
+        try:
+            self._run(batch)
+        finally:
+            with self.cond:
+                self.running = False
+                self.cond.notify_all()
         if slot[1] is not None:
             raise slot[1]
         return slot[0]
@@ -178,18 +185,18 @@ class _Coalescer:
     def _run(self, batch):
         index = self.index
         try:
-            kmax = max(k for _q, k, _s, _e in batch)
-            qvs = np.stack([q for q, _k, _s, _e in batch])
+            kmax = max(k for _q, k, _s in batch)
+            qvs = np.stack([q for q, _k, _s in batch])
             with index.lock:  # exclude cache sync while the kernel reads
                 results = index._device_knn_batch(qvs, kmax)
-            for (_q, k, slot, ev), pairs in zip(batch, results):
+            for (_q, k, slot), pairs in zip(batch, results):
                 slot[0] = pairs[:k]
-                ev.set()
+                slot[2] = True
         except BaseException as e:
-            for _q, _k, slot, ev in batch:
-                if not ev.is_set():
+            for _q, _k, slot in batch:
+                if not slot[2]:
                     slot[1] = e
-                    ev.set()
+                    slot[2] = True
 
 
 class TpuVectorIndex:

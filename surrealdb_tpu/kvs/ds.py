@@ -88,9 +88,14 @@ class Datastore:
                  capabilities=None, check_version: bool = True):
         from surrealdb_tpu.capabilities import Capabilities
 
+        from surrealdb_tpu.telemetry import Telemetry
+
         self.path = path
         self.strict = strict
         self.capabilities = capabilities or Capabilities.from_env()
+        # created before the backend: the remote engine records its
+        # retry/failover counters here
+        self.telemetry = Telemetry()
         if path in ("memory", "mem://", "mem"):
             # the C++ memtable engine when the toolchain built it, else the
             # pure-Python sorted map (same Transactable semantics)
@@ -118,10 +123,13 @@ class Datastore:
             self.backend = FileBackend(path.split("://", 1)[1])
         elif path.startswith("remote://"):
             # distributed mode: stateless database node over a shared
-            # transactional KV service (reference kvs/tikv/mod.rs:32)
+            # transactional KV service (reference kvs/tikv/mod.rs:32);
+            # a comma-separated address list names a replica set — the
+            # client follows primary failovers automatically
             from surrealdb_tpu.kvs.remote import RemoteBackend
 
-            self.backend = RemoteBackend(path.split("://", 1)[1])
+            self.backend = RemoteBackend(path.split("://", 1)[1],
+                                         telemetry=self.telemetry)
         else:
             raise SdbError(f"unknown datastore path: {path!r}")
         # cross-transaction caches / engines
@@ -159,9 +167,6 @@ class Datastore:
         # shared across concurrent executors.
         self._ast_cache: dict = {}
         self._ast_cache_cap = cnf.AST_CACHE_SIZE
-        from surrealdb_tpu.telemetry import Telemetry
-
-        self.telemetry = Telemetry()
         # cluster identity (reference dbs/node.rs); background loops start
         # only for served/clustered instances via start_node_tasks()
         from surrealdb_tpu.node import make_node_id

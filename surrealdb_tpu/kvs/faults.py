@@ -1,0 +1,241 @@
+"""Fault-injection proxy for the KV wire protocol.
+
+A frame-aware TCP proxy that sits between a KV client and server (or
+between a primary and a replica) and injects the failure modes a
+distributed deployment actually sees: dropped frames, added latency,
+duplicated frames, full partitions, and a deterministic
+kill-on-Nth-commit hook for acked-write-loss tests.
+
+Because it operates on whole length-prefixed CBOR frames (the unit the
+protocol retries around), every injected fault is one the retry policy
+in kvs/remote.py must classify and survive — this is the test double
+for the network, not a packet mangler.
+
+Usage:
+
+    proxy = FaultProxy(("127.0.0.1", kv_port)); proxy.start()
+    ds = Datastore(f"remote://127.0.0.1:{proxy.port}")
+    proxy.set(drop_next=2)          # swallow the next 2 request frames
+    proxy.set(delay_s=0.2)          # 200ms added to every request
+    proxy.set(duplicate=True)       # send every request frame twice
+    proxy.partition()               # black-hole both directions
+    proxy.heal()
+    proxy.set(kill_on_commit=(3, cb))  # cb() fires on the 3rd commit,
+                                       # which is NOT forwarded
+    proxy.stop()
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Optional
+
+_HDR = struct.Struct(">I")
+
+
+def _recv_frame_raw(sock) -> Optional[bytes]:
+    """One length-prefixed frame INCLUDING its header, or None on EOF."""
+    buf = bytearray()
+    while len(buf) < 4:
+        chunk = sock.recv(4 - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    (n,) = _HDR.unpack(bytes(buf[:4]))
+    body = bytearray()
+    while len(body) < n:
+        chunk = sock.recv(min(65536, n - len(body)))
+        if not chunk:
+            return None
+        body.extend(chunk)
+    return bytes(buf) + bytes(body)
+
+
+class FaultProxy:
+    """Frame-level TCP proxy with injectable faults.
+
+    Faults apply to client->server (request) frames; responses are
+    forwarded untouched except under `partition`, which black-holes
+    both directions. All knobs are thread-safe and take effect for
+    frames observed after the `set()` call."""
+
+    def __init__(self, upstream: tuple[str, int],
+                 listen: tuple[str, int] = ("127.0.0.1", 0)):
+        self.upstream = upstream
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(listen)
+        self._lsock.listen(32)
+        self.port = self._lsock.getsockname()[1]
+        self.addr = f"127.0.0.1:{self.port}"
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._conns: list[socket.socket] = []
+        self._thread: Optional[threading.Thread] = None
+        # fault knobs
+        self.drop_next = 0  # swallow the next N request frames
+        self.drop_prob = 0.0  # swallow each request frame with prob p
+        self.delay_s = 0.0  # added latency per request frame
+        self.duplicate = False  # forward each request frame twice
+        self.partitioned = False  # black-hole both directions
+        self.kill_on_commit: Optional[tuple[int, Callable[[], None]]] = None
+        self.commits_seen = 0
+        self.frames_forwarded = 0
+        self.frames_dropped = 0
+        self._rng = random.Random(0xFA17)
+
+    # -- control ------------------------------------------------------------
+    def start(self) -> "FaultProxy":
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="kv-fault-proxy")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stopped.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        self._close_conns()
+
+    def set(self, **knobs):
+        """Update fault knobs: drop_next, drop_prob, delay_s, duplicate,
+        kill_on_commit=(n, callback)."""
+        with self._lock:
+            for k, v in knobs.items():
+                if not hasattr(self, k):
+                    raise AttributeError(f"unknown fault knob {k!r}")
+                setattr(self, k, v)
+
+    def partition(self):
+        """Black-hole the link: existing frames stop flowing in BOTH
+        directions (connections stay open — the nastier failure mode,
+        since the peer sees silence, not a reset)."""
+        with self._lock:
+            self.partitioned = True
+
+    def heal(self):
+        with self._lock:
+            self.partitioned = False
+
+    def sever(self):
+        """Hard-close every proxied connection (connection-reset mode)."""
+        self._close_conns()
+
+    def _close_conns(self):
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- data path ----------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                cli, _ = self._lsock.accept()
+            except OSError:
+                return
+            try:
+                up = socket.create_connection(self.upstream, timeout=5)
+            except OSError:
+                cli.close()
+                continue
+            with self._lock:
+                self._conns.extend((cli, up))
+            threading.Thread(target=self._pump, args=(cli, up, True),
+                             daemon=True).start()
+            threading.Thread(target=self._pump, args=(up, cli, False),
+                             daemon=True).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              is_request: bool):
+        try:
+            while not self._stopped.is_set():
+                frame = _recv_frame_raw(src)
+                if frame is None:
+                    break
+                if not self._forward(frame, dst, is_request):
+                    break
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def _classify(self, frame: bytes) -> Optional[str]:
+        """Best-effort op name of a request frame (CBOR decode)."""
+        try:
+            from surrealdb_tpu import wire
+
+            msg = wire.decode(frame[4:])
+            if isinstance(msg, list) and msg and isinstance(msg[0], str):
+                return msg[0]
+        except Exception:
+            pass
+        return None
+
+    def _forward(self, frame: bytes, dst: socket.socket,
+                 is_request: bool) -> bool:
+        # partition: silently swallow traffic in both directions
+        with self._lock:
+            if self.partitioned:
+                self.frames_dropped += 1
+                return True
+        if not is_request:
+            try:
+                dst.sendall(frame)
+            except OSError:
+                return False
+            return True
+        op = self._classify(frame)
+        with self._lock:
+            if op == "commit" and self.kill_on_commit is not None:
+                self.commits_seen += 1
+                n, cb = self.kill_on_commit
+                if self.commits_seen >= n:
+                    self.kill_on_commit = None
+                    fire = cb
+                else:
+                    fire = None
+            else:
+                fire = None
+            if fire is None:
+                if self.drop_next > 0:
+                    self.drop_next -= 1
+                    self.frames_dropped += 1
+                    return True
+                if self.drop_prob and self._rng.random() < self.drop_prob:
+                    self.frames_dropped += 1
+                    return True
+            delay = self.delay_s
+            dup = self.duplicate
+        if fire is not None:
+            # the Nth commit: invoke the kill hook and DROP the frame —
+            # the client must never see an ack for it
+            try:
+                fire()
+            finally:
+                self.frames_dropped += 1
+            return False  # and tear the connection down
+        if delay:
+            time.sleep(delay)
+        try:
+            dst.sendall(frame)
+            if dup:
+                dst.sendall(frame)
+        except OSError:
+            return False
+        with self._lock:
+            self.frames_forwarded += 1
+        return True

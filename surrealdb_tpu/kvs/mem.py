@@ -17,7 +17,10 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-from sortedcontainers import SortedDict, SortedList
+try:
+    from sortedcontainers import SortedDict, SortedList
+except ImportError:  # container lacks the dep — pure-Python fallback
+    from surrealdb_tpu.utils.sortedcompat import SortedDict, SortedList
 
 from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.kvs.api import Backend, BackendTx

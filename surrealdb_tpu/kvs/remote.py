@@ -2,40 +2,68 @@
 TiKV backend (core/src/kvs/tikv/mod.rs:32-103) — stateless database
 nodes over a shared transactional KV service.
 
-One `surreal kv` server process owns the MVCC keyspace (the same
+One `surreal kv` PRIMARY process owns the MVCC keyspace (the same
 VersionedStore the in-process engine uses: snapshot isolation +
 optimistic write-write validation). Database nodes connect with
-`Datastore("remote://host:port")`; a transaction pins a server snapshot,
-buffers writes locally (client-side overlay, like the reference's
-optimistic txns), and ships the whole writeset at commit for validation
-under the server's store lock. Wire format: 4-byte length-prefixed CBOR
-frames (wire.py) — no pickle on the wire protocol itself.
+`Datastore("remote://host:port[,host:port...]")`; a transaction pins a
+server snapshot, buffers writes locally (client-side overlay, like the
+reference's optimistic txns), and ships the whole writeset at commit for
+validation under the server's store lock. Wire format: 4-byte
+length-prefixed CBOR frames (wire.py) — no pickle on the wire protocol
+itself.
+
+Replication & failover (reference role: TiKV's Raft log shipping +
+lease-based leadership, PAPER.md §2.1): the primary ships every
+committed writeset — synchronously, before the client sees the ok — to
+each ATTACHED replica as a sequenced `repl_apply` frame over the same
+protocol; replicas apply in order (duplicates are dropped by sequence
+number, gaps force a full resync) and serve as warm standbys. Primary
+liveness is a lease row (node.py KV_PRIMARY_LEASE) renewed through the
+replicated keyspace itself, so replicas observe it like any other row.
+When replication traffic stops past the failover timeout, a replica
+checks the (replicated) lease, surveys its peers, defers to any
+lower-ranked live replica, and otherwise promotes itself via the
+single-winner lease acquire — then starts replicating to the remaining
+peers. Clients rediscover the promoted primary automatically via
+`status` probes inside a deadline-aware retry policy (bounded
+exponential backoff + jitter, connect/reset retried, logical errors
+surfaced immediately).
+
+Durability contract: a write acknowledged to a client is (a) in the
+primary's WAL and (b) applied on every replica that was attached at
+commit time. Killing the primary therefore loses no acknowledged write
+as long as one attached replica survives to be promoted.
 
 Security model: the KV service is a CLUSTER-INTERNAL endpoint (the
 reference's TiKV gRPC port is the same); optional shared-secret auth
 (SURREAL_KV_SECRET / KvServer(secret=...)) rejects unauthenticated
-peers, and the value codec's pickle fallback is import-restricted
-(kvs/api.py) so stored bytes can't smuggle arbitrary code objects.
+peers — replication links authenticate with the same secret — and the
+value codec's pickle fallback is import-restricted (kvs/api.py) so
+stored bytes can't smuggle arbitrary code objects.
 
 Connection model: each transaction pins ONE pooled connection for its
 lifetime, so the server's per-connection snapshot accounting is exact —
 a dying client's pins are released on disconnect, and releases can never
-land on a different connection than the snap that created them.
+land on a different connection than the snap that created them. Across
+a failover, read-only transactions transparently re-pin a snapshot on
+the new primary; write transactions abort with a RetryableKvError.
 """
 
 from __future__ import annotations
 
 import os
 import queue
+import random
 import socket
 import socketserver
 import struct
 import threading
 import time
 from collections import Counter
-from typing import Optional
+from typing import Callable, Optional
 
-from surrealdb_tpu.err import SdbError
+from surrealdb_tpu import cnf
+from surrealdb_tpu.err import RetryableKvError, SdbError
 from surrealdb_tpu.kvs.api import Backend, BackendTx
 from surrealdb_tpu.kvs.mem import VersionedStore
 
@@ -76,6 +104,99 @@ def _decode(b: bytes):
     return wire.decode(b)
 
 
+def _parse_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise SdbError(f"kv address must be host:port, got {addr!r}")
+    return host, int(port)
+
+
+# ---------------------------------------------------------------------------
+# retry policy (client side)
+# ---------------------------------------------------------------------------
+
+
+def is_retryable(e: BaseException) -> bool:
+    """Transport-level errors are retryable; logical errors (conflicts,
+    auth, type errors) must surface immediately — resending a commit the
+    server REJECTED can never succeed, and resending one it ACCEPTED
+    would double-apply."""
+    if isinstance(e, RetryableKvError):
+        return True
+    if isinstance(e, SdbError):
+        m = str(e)
+        return ("kv not primary" in m or "kv connection lost" in m
+                or "kv service unreachable" in m)
+    if isinstance(e, (ConnectionError, socket.timeout, TimeoutError)):
+        return True
+    if isinstance(e, OSError):
+        return True
+    return False
+
+
+class RetryPolicy:
+    """Deadline-aware bounded exponential backoff with jitter.
+
+    Delay for attempt i is `base * 2^i` capped at `max`, scaled by a
+    uniform jitter factor in [1 - jitter, 1]; the final sleep is trimmed
+    so the total time under `run()` never exceeds `deadline_s` by more
+    than one attempt's duration. Clock/sleep/rng are injectable for
+    deterministic tests."""
+
+    def __init__(self, deadline_s: Optional[float] = None,
+                 base_ms: Optional[float] = None,
+                 max_ms: Optional[float] = None,
+                 jitter: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: Callable[[], float] = random.random):
+        self.deadline_s = (cnf.KV_RETRY_DEADLINE_S if deadline_s is None
+                           else deadline_s)
+        self.base_ms = cnf.KV_RETRY_BASE_MS if base_ms is None else base_ms
+        self.max_ms = cnf.KV_RETRY_MAX_MS if max_ms is None else max_ms
+        j = cnf.KV_RETRY_JITTER if jitter is None else jitter
+        self.jitter = min(max(j, 0.0), 1.0)
+        self.clock = clock
+        self.sleep = sleep
+        self.rng = rng
+
+    def backoff_bounds(self, attempt: int) -> tuple[float, float]:
+        """(min, max) sleep in seconds for a given attempt index."""
+        d = min(self.max_ms, self.base_ms * (2 ** min(attempt, 32))) / 1000.0
+        return d * (1.0 - self.jitter), d
+
+    def backoff(self, attempt: int) -> float:
+        lo, hi = self.backoff_bounds(attempt)
+        return lo + (hi - lo) * self.rng()
+
+    def run(self, fn, telemetry=None):
+        """Call `fn` until it succeeds, a non-retryable error surfaces,
+        or the deadline expires (raises RetryableKvError chaining the
+        last transport error)."""
+        start = self.clock()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except BaseException as e:
+                if not is_retryable(e):
+                    raise
+                elapsed = self.clock() - start
+                remaining = self.deadline_s - elapsed
+                if remaining <= 0:
+                    if telemetry is not None:
+                        telemetry.inc("kv_deadline_exhausted")
+                    raise RetryableKvError(
+                        f"kv operation failed after {attempt + 1} attempts "
+                        f"over {elapsed:.2f}s (deadline {self.deadline_s}s): "
+                        f"{e}"
+                    ) from e
+                if telemetry is not None:
+                    telemetry.inc("kv_retries")
+                self.sleep(min(self.backoff(attempt), remaining))
+                attempt += 1
+
+
 # ---------------------------------------------------------------------------
 # server
 # ---------------------------------------------------------------------------
@@ -85,6 +206,8 @@ class _KvHandler(socketserver.BaseRequestHandler):
     def handle(self):
         vs: VersionedStore = self.server.vs
         self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self.server.conn_lock:
+            self.server.active_conns.add(self.request)
         # snapshots held by THIS connection, as a multiset: several txns
         # pooled onto one connection can legitimately pin the same version
         owned: Counter = Counter()
@@ -114,12 +237,15 @@ class _KvHandler(socketserver.BaseRequestHandler):
                     resp = ["err", f"kv internal error: {e}"]
                 _send_frame(self.request, _encode(resp))
         finally:
+            with self.server.conn_lock:
+                self.server.active_conns.discard(self.request)
             # a dying client must not pin MVCC chains forever
             for snap, cnt in owned.items():
                 for _ in range(cnt):
                     vs.release(snap)
 
     def _dispatch(self, vs, req, owned):
+        srv: KvServer = self.server
         op = req[0]
         if op == "get":
             return ["ok", vs.read(req[1], req[2])]
@@ -141,6 +267,8 @@ class _KvHandler(socketserver.BaseRequestHandler):
             return ["ok", None]
         if op == "commit":
             _op, pairs, snap = req
+            if srv.role != "primary":
+                raise SdbError(srv.not_primary_msg())
             writes = {k: v for k, v in pairs}
             # vs.commit releases the snapshot itself (success OR conflict),
             # so drop our bookkeeping entry unconditionally
@@ -150,22 +278,150 @@ class _KvHandler(socketserver.BaseRequestHandler):
                     del owned[snap]
             else:
                 raise SdbError("kv commit: unknown snapshot")
-            # the apply and the WAL append happen under ONE lock hold so
-            # recovery replays commits in exactly the order they applied
-            with self.server.wal_lock:
+            # apply, WAL append, and replica ship happen under ONE lock
+            # hold: recovery replays commits in exactly apply order, and
+            # an acked write is on every attached replica
+            with srv.wal_lock:
                 ver = vs.commit(writes, snap)  # SdbError on conflict
-                self.server.log_commit(writes)
+                srv.log_commit(writes)
+                srv._ship(writes)
             return ["ok", ver]
         if op == "seed":
-            with self.server.wal_lock:
+            if srv.role != "primary":
+                raise SdbError(srv.not_primary_msg())
+            with srv.wal_lock:
                 with vs.lock:
                     for k, v in req[1]:
                         vs.seed(k, v)
-                self.server.log_commit({k: v for k, v in req[1]})
+                writes = {k: v for k, v in req[1]}
+                srv.log_commit(writes)
+                srv._ship(writes)
             return ["ok", None]
         if op == "ping":
             return ["ok", "pong"]
+        if op == "status":
+            return ["ok", srv.status()]
+        if op == "promote":
+            srv.promote(reason="admin")
+            return ["ok", "primary"]
+        if op == "repl_hello":
+            _op, pid, paddr, seq = req
+            return ["ok", srv.repl_hello(pid, paddr, seq)]
+        if op == "repl_apply":
+            _op, pid, seq, pairs = req
+            return ["ok", srv.repl_apply(pid, seq, pairs)]
+        if op == "repl_sync":
+            _op, pid, seq, items = req
+            return ["ok", srv.repl_sync(pid, seq, items)]
+        if op == "repl_ping":
+            _op, pid = req
+            if srv.role == "replica" and pid == srv.repl_primary_id:
+                srv.note_repl_traffic()
+            return ["ok", srv.applied_seq]
         raise SdbError(f"unknown kv op {op!r}")
+
+
+class _ReplLink:
+    """Primary-side link to ONE replica. `send()` runs on the committing
+    thread under the server's wal_lock (synchronous ship, in commit
+    order); a background thread owns (re)attachment including the full
+    resync, plus the idle heartbeat that keeps the replica's failover
+    timer quiet between commits."""
+
+    def __init__(self, server: "KvServer", addr_str: str):
+        self.server = server
+        self.addr_str = addr_str
+        self.addr = _parse_addr(addr_str)
+        self.conn: Optional[_Conn] = None
+        self.attached = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"kv-repl-{addr_str}"
+        )
+        self._thread.start()
+
+    def _loop(self):
+        delay = 0.05
+        while not self._stop.is_set():
+            if self.attached:
+                try:
+                    with self.server.wal_lock:
+                        if self.attached and self.conn is not None:
+                            self.conn.call(
+                                ["repl_ping", self.server.node_id]
+                            )
+                except Exception:
+                    self._detach()
+                self._stop.wait(self.server.ping_interval_s)
+                continue
+            try:
+                self._attach()
+                delay = 0.05
+            except Exception:
+                self._stop.wait(delay)
+                delay = min(delay * 2, 2.0)
+
+    def _attach(self):
+        c = _Conn(self.addr, self.server.secret,
+                  timeout=cnf.KV_CONNECT_TIMEOUT_S)
+        try:
+            # the handshake + cutover run under wal_lock so the replica's
+            # adopted seq and the shipped stream can't interleave
+            with self.server.wal_lock:
+                have = c.call([
+                    "repl_hello", self.server.node_id,
+                    self.server.advertise, self.server.repl_seq,
+                ])
+                if have != self.server.repl_seq:
+                    items = self.server.vs.latest_items()
+                    c.call([
+                        "repl_sync", self.server.node_id,
+                        self.server.repl_seq,
+                        [[k, v] for k, v in items],
+                    ])
+                    self.server.counters["repl_resyncs"] += 1
+                self.conn = c
+                self.attached = True
+        except BaseException:
+            c.close()
+            raise
+
+    def send(self, seq: int, pairs) -> bool:
+        # caller holds wal_lock
+        if not self.attached or self.conn is None:
+            return False
+        try:
+            self.conn.call(["repl_apply", self.server.node_id, seq, pairs])
+            return True
+        except Exception:
+            self._detach()
+            return False
+
+    def _detach(self):
+        self.attached = False
+        c, self.conn = self.conn, None
+        if c is not None:
+            c.close()
+
+    def stop(self):
+        self._stop.set()
+        self._detach()
+
+
+class _Replicator:
+    def __init__(self, server: "KvServer", peer_addrs: list[str]):
+        self.links = [_ReplLink(server, a) for a in peer_addrs]
+
+    def ship(self, seq: int, pairs):
+        for link in self.links:
+            link.send(seq, pairs)
+
+    def attached_count(self) -> int:
+        return sum(1 for link in self.links if link.attached)
+
+    def stop(self):
+        for link in self.links:
+            link.stop()
 
 
 class KvServer(socketserver.ThreadingTCPServer):
@@ -177,16 +433,332 @@ class KvServer(socketserver.ThreadingTCPServer):
     WAL_COMPACT_BYTES = 64 << 20
 
     def __init__(self, addr, secret: Optional[str] = None,
-                 data_dir: Optional[str] = None, fsync: bool = True):
+                 data_dir: Optional[str] = None, fsync: bool = True,
+                 role: str = "primary", peers: Optional[list[str]] = None,
+                 self_index: Optional[int] = None,
+                 auto_failover: bool = True,
+                 failover_timeout_s: Optional[float] = None,
+                 lease_ttl_s: Optional[float] = None):
         super().__init__(addr, _KvHandler)
+        import uuid as _uuid
+
         self.vs = VersionedStore()
         self.secret = secret
         self.data_dir = data_dir
         self.fsync = fsync
         self.wal = None
         self.wal_lock = threading.RLock()
+        # -- cluster identity / replication state --
+        self.node_id = str(_uuid.uuid4())
+        self.role = role
+        self.peers: list[str] = []
+        self.self_index: Optional[int] = None
+        host, port = self.server_address[:2]
+        self.advertise = f"{host}:{port}"
+        self.primary_addr: Optional[str] = None  # replica's best guess
+        self.repl: Optional[_Replicator] = None
+        self.repl_seq = 0  # primary: last shipped sequence number
+        self.applied_seq = 0  # replica: last applied sequence number
+        self.repl_primary_id: Optional[str] = None
+        self.last_repl = time.monotonic()  # boot grace for the monitor
+        self.failover_timeout_s = (cnf.KV_FAILOVER_TIMEOUT_S
+                                   if failover_timeout_s is None
+                                   else failover_timeout_s)
+        self.lease_ttl_s = (cnf.KV_LEASE_TTL_S if lease_ttl_s is None
+                            else lease_ttl_s)
+        self.ping_interval_s = max(0.05, self.failover_timeout_s / 3.0)
+        self.counters: Counter = Counter()
+        self._renew_stop: Optional[threading.Event] = None
+        self._monitor_stop: Optional[threading.Event] = None
+        self.conn_lock = threading.Lock()
+        self.active_conns: set = set()
         if data_dir:
             self._recover()
+        if peers is not None:
+            self.configure_cluster(peers, self_index, role=role,
+                                   auto_failover=auto_failover)
+
+    # -- cluster wiring ------------------------------------------------------
+
+    def configure_cluster(self, peers: list[str],
+                          self_index: Optional[int] = None,
+                          role: Optional[str] = None,
+                          auto_failover: bool = True):
+        """Attach this server to a replica set. `peers` lists every
+        member (including this one) as host:port in PROMOTION-RANK order:
+        on primary death the lowest-ranked live replica promotes. Safe to
+        call after construction (tests bind port 0 first)."""
+        self.peers = list(peers)
+        if self_index is None:
+            try:
+                self_index = self.peers.index(self.advertise)
+            except ValueError:
+                raise SdbError(
+                    f"kv peers {peers!r} do not include this server "
+                    f"({self.advertise}); pass self_index explicitly"
+                )
+        self.self_index = self_index
+        self.advertise = self.peers[self_index]
+        if role is not None:
+            self.role = role
+        others = [a for i, a in enumerate(self.peers) if i != self_index]
+        if self.role == "primary":
+            self.primary_addr = self.advertise
+            if others and self.repl is None:
+                self.repl = _Replicator(self, others)
+            self._start_renewal()
+        elif auto_failover:
+            self._start_monitor()
+
+    def not_primary_msg(self) -> str:
+        hint = self.primary_addr or "unknown"
+        return f"kv not primary (role={self.role}, primary={hint})"
+
+    def note_repl_traffic(self):
+        self.last_repl = time.monotonic()
+
+    def status(self) -> dict:
+        # counter writers are unsynchronized; a key insert during the
+        # copy raises RuntimeError — retry the snapshot, don't error the
+        # status op (a failed probe reads as a dead peer to surveys)
+        counters: dict = {}
+        for _ in range(3):
+            try:
+                counters = {k: int(v) for k, v in self.counters.items()}
+                break
+            except RuntimeError:
+                continue
+        return {
+            "role": self.role,
+            "node_id": self.node_id,
+            "version": self.vs.version,
+            "repl_seq": self.repl_seq,
+            "applied_seq": self.applied_seq,
+            "primary": (self.advertise if self.role == "primary"
+                        else self.primary_addr),
+            "attached_replicas": (self.repl.attached_count()
+                                  if self.repl else 0),
+            "counters": counters,
+        }
+
+    # -- replication (replica side) -----------------------------------------
+
+    def repl_hello(self, primary_id: str, primary_addr: str, seq: int):
+        with self.wal_lock:
+            if self.role != "replica":
+                raise SdbError(f"kv not replica (role={self.role})")
+            self.primary_addr = primary_addr
+            self.note_repl_traffic()
+            if primary_id != self.repl_primary_id:
+                # new primary lineage: our applied state is of unknown
+                # provenance — demand a full resync
+                self.repl_primary_id = primary_id
+                self.applied_seq = -1
+            return self.applied_seq
+
+    def repl_apply(self, primary_id: str, seq: int, pairs):
+        with self.wal_lock:
+            if self.role != "replica":
+                raise SdbError(f"kv not replica (role={self.role})")
+            if primary_id != self.repl_primary_id:
+                raise SdbError("kv repl: unknown primary (hello required)")
+            self.note_repl_traffic()
+            if seq <= self.applied_seq:
+                # duplicate frame (retransmit / fault injection): the
+                # sequence number makes application idempotent
+                self.counters["repl_dups"] += 1
+                return self.applied_seq
+            if seq != self.applied_seq + 1:
+                raise SdbError(
+                    f"kv repl gap: have {self.applied_seq}, got {seq}"
+                )
+            writes = {
+                bytes(k): (None if v is None else bytes(v))
+                for k, v in pairs
+            }
+            self.vs.commit(writes, self.vs.snapshot())
+            self.log_commit(writes)
+            self.applied_seq = seq
+            self.counters["repl_applied"] += 1
+            return self.applied_seq
+
+    def repl_sync(self, primary_id: str, seq: int, items):
+        with self.wal_lock:
+            if self.role != "replica":
+                raise SdbError(f"kv not replica (role={self.role})")
+            if primary_id != self.repl_primary_id:
+                raise SdbError("kv repl: unknown primary (hello required)")
+            self.note_repl_traffic()
+            new = {bytes(k): bytes(v) for k, v in items}
+            with self.vs.lock:
+                existing = list(self.vs.chains)
+            # express the state transfer as one MVCC commit (tombstones
+            # for keys the primary no longer has) so concurrent replica
+            # reads keep their snapshots
+            writes: dict = {k: None for k in existing if k not in new}
+            writes.update(new)
+            if writes:
+                self.vs.commit(writes, self.vs.snapshot())
+                self.log_commit(writes)
+            self.applied_seq = seq
+            self.counters["repl_synced"] += 1
+            return self.applied_seq
+
+    # -- replication (primary side) -----------------------------------------
+
+    def _ship(self, writes: dict):
+        """Ship one committed writeset to every attached replica.
+        Caller holds wal_lock; ships are strictly in commit order."""
+        if self.repl is None:
+            return
+        self.repl_seq += 1
+        pairs = [[k, v] for k, v in writes.items()]
+        self.repl.ship(self.repl_seq, pairs)
+        self.counters["repl_shipped"] += 1
+
+    def _start_renewal(self):
+        if self._renew_stop is not None or not self.peers:
+            return
+        self._renew_stop = threading.Event()
+        threading.Thread(target=self._renew_loop, daemon=True,
+                         name="kv-lease-renew").start()
+
+    def _renew_loop(self):
+        from surrealdb_tpu import key as K
+        from surrealdb_tpu.kvs.api import serialize
+        from surrealdb_tpu.node import KV_PRIMARY_LEASE
+
+        interval = max(0.05, self.lease_ttl_s / 3.0)
+        stop = self._renew_stop
+        key = K.task_lease(KV_PRIMARY_LEASE)
+        while True:
+            try:
+                with self.wal_lock:
+                    if self.role != "primary":
+                        return
+                    val = serialize(
+                        (self.node_id, time.time() + self.lease_ttl_s)
+                    )
+                    try:
+                        self.vs.commit({key: val}, self.vs.snapshot())
+                    except SdbError:
+                        continue  # raced a client write of the lease row
+                    self.log_commit({key: val})
+                    self._ship({key: val})
+                    self.counters["lease_renewals"] += 1
+            except Exception:
+                pass  # renewal must never die; next tick retries
+            if stop.wait(interval):
+                return
+
+    def _start_monitor(self):
+        if self._monitor_stop is not None:
+            return
+        self._monitor_stop = threading.Event()
+        threading.Thread(target=self._monitor_loop, daemon=True,
+                         name="kv-failover-monitor").start()
+
+    def _monitor_loop(self):
+        from surrealdb_tpu.node import (
+            KV_PRIMARY_LEASE, store_lease_acquire, store_lease_read,
+        )
+
+        interval = max(0.05, self.failover_timeout_s / 4.0)
+        stop = self._monitor_stop
+        while not stop.wait(interval):
+            try:
+                if self.role != "replica":
+                    return
+                if self.repl_primary_id is None:
+                    # never attached to ANY primary: this store has no
+                    # lineage, so self-promotion at boot would mint a
+                    # second (empty) primary if the real one is merely
+                    # slow to start — wait until a primary has owned us
+                    # at least once
+                    continue
+                idle = time.monotonic() - self.last_repl
+                if idle < self.failover_timeout_s:
+                    continue
+                # lease gate: the old primary's lease row replicated into
+                # OUR keyspace — promotion waits until it expires
+                row = store_lease_read(self.vs, KV_PRIMARY_LEASE)
+                if row is not None and row[0] != self.node_id \
+                        and row[1] > time.time():
+                    continue
+                # peer survey: follow an existing primary; defer to any
+                # live lower-ranked replica (deterministic successor
+                # order keeps the winner unique even without quorum)
+                found = None
+                lower_alive = False
+                for i, a in enumerate(self.peers):
+                    if i == self.self_index:
+                        continue
+                    st = _status_of(_parse_addr(a), self.secret)
+                    if st is None:
+                        continue
+                    if st.get("role") == "primary":
+                        found = a
+                        break
+                    if st.get("role") == "replica" and i < self.self_index:
+                        lower_alive = True
+                if found is not None:
+                    self.primary_addr = found
+                    self.note_repl_traffic()  # it will hello us shortly
+                    continue
+                if lower_alive:
+                    continue
+                if store_lease_acquire(self.vs, KV_PRIMARY_LEASE,
+                                       self.node_id, self.lease_ttl_s):
+                    self.promote(reason="lease")
+                    return
+            except Exception:
+                pass  # monitor must never die; next tick retries
+
+    def promote(self, reason: str = "admin"):
+        """Become the primary: accept writes, replicate to the remaining
+        peers, renew the primary lease. Idempotent."""
+        with self.wal_lock:
+            if self.role == "primary":
+                return
+            self.role = "primary"
+            self.repl_seq = 0  # new lineage — peers will full-resync
+            self.primary_addr = self.advertise
+            self.counters["promotions"] += 1
+            self.counters[f"promotions_{reason}"] += 1
+            if self._monitor_stop is not None:
+                self._monitor_stop.set()
+            others = [a for i, a in enumerate(self.peers)
+                      if i != self.self_index]
+            if others and self.repl is None:
+                self.repl = _Replicator(self, others)
+            self._start_renewal()
+
+    def server_close(self):
+        for ev in (self._renew_stop, self._monitor_stop):
+            if ev is not None:
+                ev.set()
+        if self.repl is not None:
+            self.repl.stop()
+        super().server_close()
+
+    def kill(self):
+        """Test helper: simulate hard process death in-process — stop
+        the accept loop, halt every background thread, and sever every
+        live connection mid-frame. The WAL is left exactly as a SIGKILL
+        would leave it (no flush, no orderly shutdown)."""
+        self.shutdown()
+        self.server_close()
+        with self.conn_lock:
+            conns, self.active_conns = list(self.active_conns), set()
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
     # -- durability (reference role: TiKV's raft-log + snapshot
     # persistence, core/src/kvs/tikv/mod.rs:32-103 durability contract;
@@ -292,15 +864,23 @@ class KvServer(socketserver.ThreadingTCPServer):
 
 def serve_kv(host="127.0.0.1", port=8100, block=True,
              secret: Optional[str] = None,
-             data_dir: Optional[str] = None, fsync: bool = True) -> KvServer:
+             data_dir: Optional[str] = None, fsync: bool = True,
+             role: str = "primary", peers: Optional[list[str]] = None,
+             self_index: Optional[int] = None,
+             failover_timeout_s: Optional[float] = None,
+             lease_ttl_s: Optional[float] = None) -> KvServer:
     if secret is None:
         secret = os.environ.get("SURREAL_KV_SECRET") or None
     if data_dir is None:
         data_dir = os.environ.get("SURREAL_KV_DATA_DIR") or None
     srv = KvServer((host, port), secret=secret, data_dir=data_dir,
-                   fsync=fsync)
+                   fsync=fsync, role=role, peers=peers,
+                   self_index=self_index,
+                   failover_timeout_s=failover_timeout_s,
+                   lease_ttl_s=lease_ttl_s)
     if block:
         print(f"surrealdb-tpu kv service on {host}:{port}"
+              + f" ({srv.role})"
               + (" (authenticated)" if secret else ""))
         srv.serve_forever()
     else:
@@ -314,9 +894,21 @@ def serve_kv(host="127.0.0.1", port=8100, block=True,
 
 
 class _Conn:
-    def __init__(self, addr, secret: Optional[str]):
-        self.sock = socket.create_connection(addr, timeout=30)
+    def __init__(self, addr, secret: Optional[str],
+                 timeout: Optional[float] = None,
+                 connect_timeout: Optional[float] = None):
+        op_timeout = cnf.KV_OP_TIMEOUT_S if timeout is None else timeout
+        # connect under the (short) connect timeout — a SYN-black-holed
+        # peer must not eat the whole op timeout before discovery can
+        # even run — then widen to the op timeout for the data path
+        self.sock = socket.create_connection(
+            addr,
+            timeout=op_timeout if connect_timeout is None
+            else connect_timeout,
+        )
+        self.sock.settimeout(op_timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.epoch = -1  # pool failover epoch tag
         if secret:
             self.call(["auth", secret])
 
@@ -334,32 +926,172 @@ class _Conn:
             pass
 
 
-class _Pool:
-    """Connection pool. A transaction CHECKS OUT one connection for its
-    whole lifetime (snapshot accounting correctness); short one-shot ops
-    borrow + return per call."""
+def _status_of(addr, secret, timeout: float = 1.0) -> Optional[dict]:
+    """Probe one server's status; None when unreachable/unresponsive."""
+    try:
+        c = _Conn(addr, secret, timeout=timeout)
+    except (OSError, SdbError):
+        return None
+    try:
+        st = c.call(["status"])
+        return st if isinstance(st, dict) else None
+    except Exception:
+        return None
+    finally:
+        c.close()
 
-    def __init__(self, addr, secret=None, size=64):
-        self.addr = addr
+
+def _is_not_primary(e: BaseException) -> bool:
+    return "kv not primary" in str(e)
+
+
+class _Pool:
+    """Failover-aware connection pool. A transaction CHECKS OUT one
+    connection for its whole lifetime (snapshot accounting correctness);
+    short one-shot ops borrow + return per call.
+
+    The pool tracks the believed-primary index into `addrs`; when a
+    connection dies or a server answers "kv not primary", the pool is
+    marked suspect and the next acquire runs a status sweep to locate
+    the promoted primary. A primary change bumps the pool epoch, which
+    poisons every pooled connection to the old primary."""
+
+    def __init__(self, addrs, secret=None, size=64,
+                 policy: Optional[RetryPolicy] = None, telemetry=None,
+                 op_timeout: Optional[float] = None,
+                 connect_timeout: Optional[float] = None):
+        if isinstance(addrs, tuple):
+            addrs = [addrs]
+        self.addrs: list[tuple[str, int]] = list(addrs)
         self.secret = secret
         self.size = size
+        self.policy = policy or RetryPolicy()
+        self.telemetry = telemetry
+        self.op_timeout = (cnf.KV_OP_TIMEOUT_S if op_timeout is None
+                           else op_timeout)
+        self.connect_timeout = (cnf.KV_CONNECT_TIMEOUT_S
+                                if connect_timeout is None
+                                else connect_timeout)
         self.q: queue.LifoQueue = queue.LifoQueue()
         self.count = 0
         self.lock = threading.Lock()
+        self.primary_i = 0
+        self.epoch = 0
+        self._suspect = False
+        self.discover_lock = threading.Lock()
+
+    # -- telemetry ----------------------------------------------------------
+    def _inc(self, name: str):
+        if self.telemetry is not None:
+            self.telemetry.inc(name)
+
+    # -- failover -----------------------------------------------------------
+    def _mark_suspect(self):
+        # with a single configured address there is nothing to discover:
+        # the reconnect itself is the probe (and the status round-trip
+        # would only add latency to every transient drop)
+        if len(self.addrs) > 1:
+            self._suspect = True
+
+    def _set_primary(self, i: int):
+        with self.lock:
+            if i != self.primary_i:
+                self.primary_i = i
+                self.epoch += 1  # old-primary conns are poison now
+                self._inc("kv_failovers")
+            self._suspect = False
+
+    def _addr_index(self, addr_str) -> Optional[int]:
+        if not addr_str or not isinstance(addr_str, str):
+            return None
+        try:
+            a = _parse_addr(addr_str)
+        except SdbError:
+            return None
+        try:
+            return self.addrs.index(a)
+        except ValueError:
+            return None
+
+    def _locate_primary(self):
+        """One status sweep over the configured addresses; follows a
+        replica's primary hint. Raises RetryableKvError when no primary
+        answers (the caller's retry policy supplies the backoff)."""
+        with self.discover_lock:
+            if not self._suspect:
+                return  # another thread already re-located the primary
+            n = len(self.addrs)
+            for step in range(n):
+                i = (self.primary_i + step) % n
+                st = _status_of(self.addrs[i], self.secret,
+                                timeout=self.connect_timeout)
+                if st is None:
+                    continue
+                if st.get("role") == "primary":
+                    self._set_primary(i)
+                    return
+                j = self._addr_index(st.get("primary"))
+                if j is not None and j != i:
+                    st2 = _status_of(self.addrs[j], self.secret,
+                                     timeout=self.connect_timeout)
+                    if st2 is not None and st2.get("role") == "primary":
+                        self._set_primary(j)
+                        return
+            raise RetryableKvError(
+                "kv service unreachable: no primary among "
+                + ",".join(f"{h}:{p}" for h, p in self.addrs)
+            )
+
+    # -- checkout/return ----------------------------------------------------
+    def _fail(self, c: Optional[_Conn], e) -> RetryableKvError:
+        """Shared transport-failure cleanup for a checked-out conn:
+        drop it, mark the pool suspect, build the error to raise."""
+        if c is not None:
+            self.drop(c)
+        self._mark_suspect()
+        return RetryableKvError(f"kv connection lost: {e}")
+
+    def _new_conn(self) -> _Conn:
+        # snapshot (addr, epoch) together: reading them at different
+        # times could tag a connection to the OLD primary with the NEW
+        # epoch, letting it slip past the epoch poisoning
+        with self.lock:
+            addr = self.addrs[self.primary_i]
+            epoch = self.epoch
+        try:
+            c = _Conn(addr, self.secret, timeout=self.op_timeout,
+                      connect_timeout=self.connect_timeout)
+        except OSError as e:
+            with self.lock:
+                self.count -= 1
+            self._mark_suspect()
+            raise RetryableKvError(f"kv service unreachable: {e}")
+        except BaseException:
+            with self.lock:
+                self.count -= 1
+            raise
+        c.epoch = epoch
+        return c
 
     def acquire(self) -> _Conn:
-        try:
-            return self.q.get_nowait()
-        except queue.Empty:
-            pass
+        while True:
+            try:
+                c = self.q.get_nowait()
+            except queue.Empty:
+                break
+            if c.epoch == self.epoch:
+                return c
+            self.drop(c)  # pooled conn to a demoted/old primary
+        if self._suspect:
+            self._locate_primary()  # raises RetryableKvError when down
         with self.lock:
             if self.count < self.size:
                 self.count += 1
-                try:
-                    return _Conn(self.addr, self.secret)
-                except OSError as e:
-                    self.count -= 1
-                    raise SdbError(f"kv service unreachable: {e}")
+                create = True
+            else:
+                create = False
+        if create:
+            return self._new_conn()
         # Bounded wait: a statement can hold one pooled conn while
         # allocating a sequence batch on a second — blocking forever here
         # would deadlock the process at pool exhaustion. Wait in slices,
@@ -367,36 +1099,31 @@ class _Pool:
         deadline = time.monotonic() + 30.0
         while True:
             try:
-                return self.q.get(timeout=0.25)
+                c = self.q.get(timeout=0.25)
+                if c.epoch == self.epoch:
+                    return c
+                self.drop(c)
             except queue.Empty:
                 pass
             with self.lock:
                 if self.count < self.size:
                     self.count += 1
-                    try:
-                        return _Conn(self.addr, self.secret)
-                    except OSError as e:
-                        self.count -= 1
-                        raise SdbError(f"kv service unreachable: {e}")
+                    create = True
+                else:
+                    create = False
                 in_use = self.count
+            if create:
+                return self._new_conn()
             if time.monotonic() >= deadline:
                 raise SdbError(
-                    f"kv connection pool exhausted ({in_use} in use; waited 30s)"
+                    f"kv connection pool exhausted ({in_use} in use; "
+                    f"waited 30s)"
                 )
 
-    def fresh(self) -> _Conn:
-        """A brand-new connection (replacing one just drop()ed) — pooled
-        connections can all be stale after a server restart."""
-        with self.lock:
-            self.count += 1
-        try:
-            return _Conn(self.addr, self.secret)
-        except OSError as e:
-            with self.lock:
-                self.count -= 1
-            raise SdbError(f"kv service unreachable: {e}")
-
     def release(self, c: _Conn):
+        if c.epoch != self.epoch:
+            self.drop(c)
+            return
         self.q.put(c)
 
     def drop(self, c: _Conn):
@@ -404,57 +1131,84 @@ class _Pool:
         with self.lock:
             self.count -= 1
 
-    def call(self, msg, _retried=False):
+    def close(self):
+        while True:
+            try:
+                c = self.q.get_nowait()
+            except queue.Empty:
+                return
+            self.drop(c)
+
+    # -- one-shot ops with retry/failover -----------------------------------
+    def _call_once(self, msg):
         c = self.acquire()
         try:
             out = c.call(msg)
         except (ConnectionError, OSError) as e:
-            self.drop(c)
-            if not _retried:
-                # a pooled connection can be stale after a server
-                # restart — retry ONCE on a genuinely fresh connection
-                c2 = self.fresh()
-                try:
-                    out = c2.call(msg)
-                except (ConnectionError, OSError) as e2:
-                    self.drop(c2)
-                    raise SdbError(f"kv connection lost: {e2}")
-                self.release(c2)
-                return out
-            raise SdbError(f"kv connection lost: {e}")
+            raise self._fail(c, e)
+        except SdbError as e:
+            if _is_not_primary(e):
+                raise self._fail(c, e)
+            self.release(c)
+            raise
         except BaseException:
             self.release(c)
             raise
         self.release(c)
         return out
 
+    def call(self, msg, policy: Optional[RetryPolicy] = None):
+        return (policy or self.policy).run(
+            lambda: self._call_once(msg), telemetry=self.telemetry
+        )
+
+    def lease_snapshot(self) -> tuple[_Conn, int]:
+        """Check out a connection AND pin a snapshot on it, retrying
+        through failover: a transaction starts against whichever server
+        is primary when the policy converges."""
+
+        def once():
+            c = self.acquire()
+            try:
+                snap = c.call(["snap"])
+            except (ConnectionError, OSError) as e:
+                raise self._fail(c, e)
+            except SdbError as e:
+                if _is_not_primary(e):
+                    raise self._fail(c, e)
+                self.release(c)
+                raise
+            except BaseException:
+                self.release(c)
+                raise
+            return c, snap
+
+        return self.policy.run(once, telemetry=self.telemetry)
+
 
 class RemoteTx(BackendTx):
     """Client transaction: server snapshot + local write overlay (mirror
     of MemTx with reads over the wire). Holds one pooled connection for
-    its lifetime."""
+    its lifetime. Read-only transactions survive a primary failover by
+    re-pinning a fresh snapshot on the new primary (documented weakening:
+    the snapshot moves forward across the failover); write transactions
+    abort with a RetryableKvError."""
 
     def __init__(self, backend: "RemoteBackend", write: bool):
-        self.pool = backend.pool
-        self.write = write
-        self.conn: Optional[_Conn] = self.pool.acquire()
-        try:
-            self.snap = self.conn.call(["snap"])
-        except (ConnectionError, OSError):
-            # stale pooled connection (server restarted): one fresh try
-            self._drop_conn()
-            self.conn = self.pool.fresh()
-            try:
-                self.snap = self.conn.call(["snap"])
-            except BaseException:
-                self._drop_conn()
-                raise
-        except BaseException:
-            self._drop_conn()
-            raise
+        # `done` first: if construction dies below, __del__ must not
+        # trip on a half-built object (GC-time AttributeError)
+        self.done = False
         self.writes: dict[bytes, Optional[bytes]] = {}
         self.savepoints: list[dict] = []
-        self.done = False
+        self.conn: Optional[_Conn] = None
+        self.snap = None
+        self.pool = backend.pool
+        self.write = write
+        try:
+            self.conn, self.snap = self.pool.lease_snapshot()
+        except BaseException:
+            self.done = True
+            raise
 
     def _drop_conn(self):
         if self.conn is not None:
@@ -466,15 +1220,38 @@ class RemoteTx(BackendTx):
             self.pool.release(self.conn)
             self.conn = None
 
-    def _call(self, msg):
+    def _repin(self):
+        """Re-pin this read-only transaction on the current primary."""
+        self.pool._inc("kv_txn_failovers")
+        self.conn, self.snap = self.pool.lease_snapshot()
+
+    def _call(self, build):
+        """Run `build(snap)` against the pinned connection. On transport
+        failure: writers abort retryably (their overlay is client-side,
+        but the snapshot lineage is gone); readers fail over to the new
+        primary transparently."""
         if self.conn is None:
-            raise SdbError("transaction connection lost")
+            raise RetryableKvError("transaction connection lost")
         try:
-            return self.conn.call(msg)
-        except (ConnectionError, OSError) as e:
-            self.done = True
-            self._drop_conn()  # server releases our pins on disconnect
-            raise SdbError(f"kv connection lost: {e}")
+            return self.conn.call(build(self.snap))
+        except (ConnectionError, OSError, SdbError) as e:
+            transport = not isinstance(e, SdbError) or _is_not_primary(e)
+            if not transport:
+                raise
+            c, self.conn = self.conn, None
+            err = self.pool._fail(c, e)
+            if self.write:
+                self.done = True
+                raise RetryableKvError(
+                    f"write transaction aborted and can be retried: {err}"
+                )
+            self._repin()
+            try:
+                return self.conn.call(build(self.snap))
+            except (ConnectionError, OSError) as e2:
+                self.done = True
+                c, self.conn = self.conn, None
+                raise self.pool._fail(c, e2)
 
     def _check(self):
         if self.done:
@@ -484,7 +1261,7 @@ class RemoteTx(BackendTx):
         self._check()
         if key in self.writes:
             return self.writes[key]
-        return self._call(["get", key, self.snap])
+        return self._call(lambda snap: ["get", key, snap])
 
     def set(self, key: bytes, val: bytes) -> None:
         self._check()
@@ -502,7 +1279,7 @@ class RemoteTx(BackendTx):
         self._check()
         if not self.writes:
             items = self._call(
-                ["range", beg, end, self.snap, limit, bool(reverse)]
+                lambda snap: ["range", beg, end, snap, limit, bool(reverse)]
             )
             for k, v in items:
                 yield k, v
@@ -510,7 +1287,8 @@ class RemoteTx(BackendTx):
         # overlay present: fetch the FULL committed range (a server-side
         # limit could truncate keys the overlay deletes/shadows), merge,
         # then apply the limit — mirror of MemTx.scan
-        items = self._call(["range", beg, end, self.snap, None, False])
+        items = self._call(lambda snap: ["range", beg, end, snap, None,
+                                         False])
         base = {k: v for k, v in items}
         for k, v in self.writes.items():
             if beg <= k < end:
@@ -541,16 +1319,47 @@ class RemoteTx(BackendTx):
         self._check()
         self.done = True
         snap, self.snap = self.snap, None
+        if not self.writes:
+            try:
+                if self.conn is not None:
+                    self.conn.call(["rel", snap])
+            except (ConnectionError, OSError):
+                self._drop_conn()  # server released pins on disconnect
+                self.pool._mark_suspect()
+            finally:
+                self._return_conn()
+            return
+        if self.conn is None:
+            raise RetryableKvError(
+                "kv connection lost before commit; transaction aborted "
+                "and can be retried"
+            )
         try:
-            if self.writes:
-                self._call(
-                    ["commit", [[k, v] for k, v in self.writes.items()],
-                     snap]
+            self.conn.call(
+                ["commit", [[k, v] for k, v in self.writes.items()], snap]
+            )
+        except (ConnectionError, OSError) as e:
+            c, self.conn = self.conn, None
+            self.pool._fail(c, e)
+            raise RetryableKvError(
+                f"kv connection lost during commit; OUTCOME UNKNOWN — "
+                f"retry only with idempotent writes: {e}"
+            )
+        except SdbError as e:
+            if _is_not_primary(e):
+                c, self.conn = self.conn, None
+                self.pool._fail(c, e)
+                raise RetryableKvError(
+                    f"kv primary changed; transaction aborted and can be "
+                    f"retried: {e}"
                 )
-            else:
-                self._call(["rel", snap])
-        finally:
             self._return_conn()
+            raise
+        except BaseException:
+            # even a KeyboardInterrupt must not leak the pool slot
+            self._return_conn()
+            raise
+        self._return_conn()
 
     def cancel(self):
         if self.done:
@@ -560,9 +1369,9 @@ class RemoteTx(BackendTx):
         snap, self.snap = self.snap, None
         try:
             if snap is not None and self.conn is not None:
-                self._call(["rel", snap])
-        except SdbError:
-            pass  # connection gone — server released pins on disconnect
+                self.conn.call(["rel", snap])
+        except (SdbError, ConnectionError, OSError):
+            self._drop_conn()  # connection gone — server released pins
         finally:
             self._return_conn()
 
@@ -575,17 +1384,41 @@ class RemoteTx(BackendTx):
 
 
 class RemoteBackend(Backend):
-    def __init__(self, addr: str, secret: Optional[str] = None):
-        host, _, port = addr.rpartition(":")
-        if not host or not port.isdigit():
+    """Client backend over one KV primary plus optional replicas.
+
+    `addr` is `host:port` or a comma-separated replica-set list
+    (`h1:p1,h2:p2,...`); the pool discovers which member is primary and
+    follows promotions automatically."""
+
+    def __init__(self, addr: str, secret: Optional[str] = None,
+                 telemetry=None, policy: Optional[RetryPolicy] = None,
+                 op_timeout: Optional[float] = None,
+                 connect_timeout: Optional[float] = None):
+        addrs = [_parse_addr(a.strip())
+                 for a in addr.split(",") if a.strip()]
+        if not addrs:
             raise SdbError(
-                f"remote:// address must be host:port, got {addr!r}"
+                f"remote:// address must be host:port[,host:port...], "
+                f"got {addr!r}"
             )
         if secret is None:
             secret = os.environ.get("SURREAL_KV_SECRET") or None
-        self.pool = _Pool((host, int(port)), secret=secret)
+        self.pool = _Pool(addrs, secret=secret, policy=policy,
+                          telemetry=telemetry, op_timeout=op_timeout,
+                          connect_timeout=connect_timeout)
         self.lock = threading.RLock()
-        self.pool.call(["ping"])  # fail fast when the service is down
+        # fail fast (bounded by the connect timeout, not the full retry
+        # deadline) when no service member is reachable at construction
+        boot = RetryPolicy(
+            deadline_s=min(self.pool.policy.deadline_s,
+                           self.pool.connect_timeout),
+            base_ms=self.pool.policy.base_ms,
+            max_ms=self.pool.policy.max_ms,
+        )
+        self.pool.call(["ping"], policy=boot)
 
     def transaction(self, write: bool) -> RemoteTx:
         return RemoteTx(self, write)
+
+    def close(self) -> None:
+        self.pool.close()

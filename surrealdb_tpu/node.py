@@ -49,6 +49,70 @@ class TaskLease:
             return False
 
 
+# -- store-level leases (KV-service failover) -------------------------------
+# The KV primary/replica layer (kvs/remote.py) rides the SAME lease rows
+# as TaskLease, but operates on a raw VersionedStore: the KV service IS
+# the coordination substrate, so its own election can't go through a
+# Datastore client. Row format is identical — (holder, expiry) under
+# K.task_lease(name) — which means DB-level observers can read the KV
+# primary lease with ordinary transactions.
+
+KV_PRIMARY_LEASE = "kv-primary"
+
+
+def store_lease_read(vs, name: str):
+    """Read (holder, expiry) for a lease row straight off a
+    VersionedStore, or None when absent."""
+    from surrealdb_tpu.kvs.api import deserialize
+
+    snap = vs.snapshot()
+    try:
+        raw = vs.read(K.task_lease(name), snap)
+    finally:
+        vs.release(snap)
+    if raw is None:
+        return None
+    try:
+        row = deserialize(raw)
+        return (row[0], float(row[1]))
+    except Exception:
+        return None
+
+
+def store_lease_acquire(vs, name: str, holder: str, ttl_s: float) -> bool:
+    """Single-winner lease acquire/renew over a raw VersionedStore:
+    wins only when the row is absent, expired, or already ours; an
+    optimistic commit conflict means another contender won the race.
+    Same semantics as TaskLease.try_acquire, one layer down."""
+    from surrealdb_tpu.kvs.api import deserialize, serialize
+
+    now = time.time()
+    key = K.task_lease(name)
+    snap = vs.snapshot()
+    committing = False
+    try:
+        raw = vs.read(key, snap)
+        if raw is not None:
+            try:
+                row = deserialize(raw)
+                cur_holder, expiry = row[0], float(row[1])
+            except Exception:
+                cur_holder, expiry = None, 0.0  # corrupt row: claimable
+            if cur_holder is not None and cur_holder != holder \
+                    and expiry > now:
+                return False
+        # commit() releases the snapshot itself, success OR conflict —
+        # releasing again could drop another txn's pin at the same version
+        committing = True
+        vs.commit({key: serialize((holder, now + ttl_s))}, snap)
+        return True
+    except SdbError:
+        return False
+    finally:
+        if not committing:
+            vs.release(snap)
+
+
 def heartbeat(ds) -> None:
     """Write this node's registry row (id -> last-seen timestamp)."""
     txn = ds.transaction(write=True)

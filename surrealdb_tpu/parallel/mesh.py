@@ -20,7 +20,26 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax moved shard_map to the top level in 0.5.x; on the 0.4.x line it
+# lives under jax.experimental and spells check_vma as check_rep —
+# resolve once, same callable either way
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
 DATA_AXIS = "data"
+
+
+def _axis_size(name):
+    # jax.lax.axis_size is 0.5.x+; psum(1, axis) is the portable spelling
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(name)
+    return jax.lax.psum(1, name)
 
 
 def default_mesh(devices=None) -> Mesh:
@@ -119,7 +138,7 @@ def _rank_rescore_jit(mesh: Mesh, k: int, kc: int, metric: str,
     # jit(shard_map(partial(...))) per call defeats jit's trace cache and
     # pays full XLA compile on every query batch (~150x on the hot path)
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             partial(_rank_rescore_shard, k=k, kc=kc, metric=metric,
                     recall_target=recall_target),
             mesh=mesh,
@@ -224,7 +243,7 @@ def _rank_rescore_shard_hier(xr, xf, x2, norms, valid, qs, k: int, kc: int,
     axis first (intra-host), then only the per-host [B, k] winners cross
     the DCN axis for the final merge — the expensive inter-host hop
     carries k candidates per host, not kc x devices."""
-    ici_sz = jax.lax.axis_size(DATA_AXIS)
+    ici_sz = _axis_size(DATA_AXIS)
     base = (
         jax.lax.axis_index(DCN_AXIS) * ici_sz
         + jax.lax.axis_index(DATA_AXIS)
@@ -270,7 +289,7 @@ def _rank_rescore_hier_jit(mesh: Mesh, k: int, kc: int, metric: str,
     spec_rows = P((DCN_AXIS, DATA_AXIS), None)
     spec_vec = P((DCN_AXIS, DATA_AXIS))
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             partial(_rank_rescore_shard_hier, k=k, kc=kc, metric=metric,
                     recall_target=recall_target),
             mesh=mesh,

@@ -68,9 +68,19 @@ class Telemetry:
         self._export_lock = threading.Lock()
 
     # -- counters -----------------------------------------------------------
+    # The remote-KV client records its resilience counters here:
+    # kv_retries (transport retries), kv_failovers (primary changes
+    # observed), kv_txn_failovers (read-only txns transparently
+    # re-pinned), kv_deadline_exhausted (ops that ran out their retry
+    # deadline). All surface through `prometheus()` as
+    # surreal_<name>_total.
     def inc(self, name: str, by: int = 1):
         with self.lock:
             self.counters[name] = self.counters.get(name, 0) + by
+
+    def get(self, name: str) -> int:
+        with self.lock:
+            return self.counters.get(name, 0)
 
     # -- spans --------------------------------------------------------------
     def start(self, name: str, **attrs) -> Span:

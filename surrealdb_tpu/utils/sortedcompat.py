@@ -1,0 +1,134 @@
+"""Pure-Python stand-ins for the `sortedcontainers` types the KV layer
+uses (SortedDict/SortedList). The real package is preferred when
+installed (kvs/mem.py imports it first); this fallback keeps the MVCC
+engine working in containers that don't ship the dependency.
+
+Only the surface the storage engine touches is implemented: key-ordered
+iteration, `irange` with inclusive bounds, and min-lookup on SortedList.
+`irange` snapshots the key segment, so callers may mutate during
+iteration (stricter than sortedcontainers, never weaker).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Iterator, Optional
+
+_MISSING = object()
+
+
+class SortedList:
+    """Ascending multiset backed by bisect over a plain list."""
+
+    def __init__(self, iterable=()):
+        self._l = sorted(iterable)
+
+    def add(self, value) -> None:
+        bisect.insort(self._l, value)
+
+    def remove(self, value) -> None:
+        i = bisect.bisect_left(self._l, value)
+        if i < len(self._l) and self._l[i] == value:
+            del self._l[i]
+        else:
+            raise ValueError(f"{value!r} not in list")
+
+    def __getitem__(self, i):
+        return self._l[i]
+
+    def __len__(self) -> int:
+        return len(self._l)
+
+    def __iter__(self) -> Iterator:
+        return iter(self._l)
+
+    def __repr__(self) -> str:
+        return f"SortedList({self._l!r})"
+
+
+class SortedDict:
+    """Dict with a bisect-maintained sorted key index."""
+
+    def __init__(self, *args, **kwargs):
+        self._d = dict(*args, **kwargs)
+        self._keys = sorted(self._d)
+
+    def __setitem__(self, key, value) -> None:
+        if key not in self._d:
+            bisect.insort(self._keys, key)
+        self._d[key] = value
+
+    def __delitem__(self, key) -> None:
+        del self._d[key]
+        i = bisect.bisect_left(self._keys, key)
+        del self._keys[i]
+
+    def __getitem__(self, key):
+        return self._d[key]
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __iter__(self) -> Iterator:
+        return iter(self._keys)
+
+    def get(self, key, default=None):
+        return self._d.get(key, default)
+
+    def pop(self, key, default=_MISSING):
+        if key in self._d:
+            v = self._d.pop(key)
+            i = bisect.bisect_left(self._keys, key)
+            del self._keys[i]
+            return v
+        if default is _MISSING:
+            raise KeyError(key)
+        return default
+
+    def setdefault(self, key, default=None):
+        if key not in self._d:
+            self[key] = default
+        return self._d[key]
+
+    def clear(self) -> None:
+        self._d.clear()
+        self._keys.clear()
+
+    def keys(self):
+        return list(self._keys)
+
+    def values(self):
+        return [self._d[k] for k in self._keys]
+
+    def items(self):
+        return [(k, self._d[k]) for k in self._keys]
+
+    def irange(
+        self,
+        minimum=None,
+        maximum=None,
+        inclusive: tuple[bool, bool] = (True, True),
+        reverse: bool = False,
+    ) -> Iterator:
+        if minimum is None:
+            lo = 0
+        elif inclusive[0]:
+            lo = bisect.bisect_left(self._keys, minimum)
+        else:
+            lo = bisect.bisect_right(self._keys, minimum)
+        if maximum is None:
+            hi = len(self._keys)
+        elif inclusive[1]:
+            hi = bisect.bisect_right(self._keys, maximum)
+        else:
+            hi = bisect.bisect_left(self._keys, maximum)
+        seg = self._keys[lo:hi]
+        if reverse:
+            seg.reverse()
+        return iter(seg)
+
+    def __repr__(self) -> str:
+        return f"SortedDict({self._d!r})"

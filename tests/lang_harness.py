@@ -10,7 +10,14 @@ from __future__ import annotations
 
 import os
 import re
-import tomllib
+
+try:
+    import tomllib  # py3.11+
+except ModuleNotFoundError:
+    try:
+        import tomli as tomllib  # py3.10 backport, when installed
+    except ModuleNotFoundError:
+        tomllib = None  # no TOML parser: tests parse nothing and skip
 from dataclasses import dataclass, field
 
 TESTS_ROOT = "/root/reference/language-tests/tests"
@@ -66,6 +73,11 @@ def parse_test_file(path: str) -> LangTest:
         toml_src += m.group(1)
     for lm in _LINE_RX.finditer(text):
         toml_src += lm.group(1) + "\n"
+    if tomllib is None and toml_src.strip():
+        raise RuntimeError(
+            "no TOML parser available (python<3.11 without tomli): "
+            "cannot parse language-test config"
+        )
     config = tomllib.loads(toml_src) if toml_src.strip() else {}
     test = config.get("test", {})
     env = config.get("env", {})

@@ -1,0 +1,127 @@
+"""The cross-query batcher (idx/vector.py _Coalescer) after the
+event-signalled rewrite: queued queries must wake at batch completion
+(no 50ms polling interval), batches must coalesce, and errors must
+propagate to every rider."""
+
+import threading
+import time
+
+import numpy as np
+
+from surrealdb_tpu.idx.vector import _Coalescer
+
+
+class _FakeIndex:
+    """Just enough surface for _Coalescer: a lock and a batch kernel."""
+
+    def __init__(self, batch_fn=None):
+        self.lock = threading.RLock()
+        self.calls = []  # batch sizes, in dispatch order
+        self.gate = None  # when set, the FIRST call blocks on it
+        self._batch_fn = batch_fn
+
+    def _device_knn_batch(self, qvs, kmax):
+        first = not self.calls
+        self.calls.append(qvs.shape[0])
+        if self.gate is not None and first:
+            assert self.gate.wait(5.0), "test gate never opened"
+        if self._batch_fn is not None:
+            return self._batch_fn(qvs, kmax)
+        return [[(0.0, int(q[0]))] * kmax for q in qvs]
+
+
+def _search(co, val, out, idx):
+    out[idx] = co.search(np.array([val, 0.0]), 1)
+
+
+def test_first_searcher_dispatches_immediately():
+    ix = _FakeIndex()
+    co = _Coalescer(ix)
+    t0 = time.monotonic()
+    res = co.search(np.array([7.0, 0.0]), 1)
+    assert time.monotonic() - t0 < 1.0
+    assert res == [(0.0, 7)]
+    assert ix.calls == [1]
+
+
+def test_queued_query_wakes_subpolling_interval():
+    """A query that arrives while a dispatch is in flight must complete
+    within the old 50ms polling interval of the in-flight batch
+    finishing — i.e. the dispatcher signals completion, nobody polls."""
+    ix = _FakeIndex()
+    ix.gate = threading.Event()
+    co = _Coalescer(ix)
+    out = {}
+    a = threading.Thread(target=_search, args=(co, 1.0, out, "a"))
+    a.start()
+    # wait until A's dispatch is genuinely in flight (inside the kernel)
+    deadline = time.monotonic() + 5.0
+    while not ix.calls and time.monotonic() < deadline:
+        time.sleep(0.001)
+    assert ix.calls, "first dispatch never started"
+    b = threading.Thread(target=_search, args=(co, 2.0, out, "b"))
+    b.start()
+    # give B a moment to enqueue behind the in-flight batch
+    time.sleep(0.05)
+    t_open = time.monotonic()
+    ix.gate.set()  # batch A completes now
+    b.join(timeout=5.0)
+    woke = time.monotonic() - t_open
+    a.join(timeout=5.0)
+    assert not b.is_alive()
+    assert out["a"] == [(0.0, 1)] and out["b"] == [(0.0, 2)]
+    # B rode the dispatch right after A's batch: total time from A's
+    # completion to B's result must be well under the old 50ms poll
+    assert woke < 0.05, f"queued query woke in {woke * 1000:.1f}ms"
+
+
+def test_concurrent_queries_coalesce_into_one_batch():
+    ix = _FakeIndex()
+    ix.gate = threading.Event()
+    co = _Coalescer(ix)
+    out = {}
+    a = threading.Thread(target=_search, args=(co, 1.0, out, "a"))
+    a.start()
+    deadline = time.monotonic() + 5.0
+    while not ix.calls and time.monotonic() < deadline:
+        time.sleep(0.001)
+    riders = [
+        threading.Thread(target=_search, args=(co, float(i), out, i))
+        for i in range(2, 6)
+    ]
+    for t in riders:
+        t.start()
+    time.sleep(0.05)  # let every rider enqueue behind the open batch
+    ix.gate.set()
+    for t in riders:
+        t.join(timeout=5.0)
+    a.join(timeout=5.0)
+    assert len(out) == 5
+    # the four riders shared ONE follow-up dispatch (batch of 4), they
+    # did not serialize into four kernel calls
+    assert ix.calls[0] == 1
+    assert max(ix.calls[1:]) == 4, f"riders did not coalesce: {ix.calls}"
+
+
+def test_batch_error_propagates_to_every_rider():
+    def boom(qvs, kmax):
+        raise RuntimeError("kernel exploded")
+
+    ix = _FakeIndex(batch_fn=boom)
+    co = _Coalescer(ix)
+    errs = {}
+
+    def go(i):
+        try:
+            co.search(np.array([float(i), 0.0]), 1)
+            errs[i] = None
+        except RuntimeError as e:
+            errs[i] = str(e)
+
+    ts = [threading.Thread(target=go, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=5.0)
+    assert len(errs) == 3
+    assert all(v == "kernel exploded" for v in errs.values()), errs

@@ -1,0 +1,241 @@
+"""Fault-injection coverage: the FaultProxy harness (kvs/faults.py)
+driving the retry/backoff/failover paths in kvs/remote.py — dropped
+frames, injected latency, partitions, duplicated replication frames,
+and the kill-on-Nth-commit hook. All in-process (KvServer.kill()
+simulates hard death by severing live connections)."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from surrealdb_tpu.err import RetryableKvError, SdbError
+from surrealdb_tpu.kvs.faults import FaultProxy
+from surrealdb_tpu.kvs.remote import (
+    RemoteBackend,
+    RetryPolicy,
+    _decode,
+    _encode,
+    _recv_frame,
+    _send_frame,
+    serve_kv,
+)
+from surrealdb_tpu.telemetry import Telemetry
+
+
+def _mk_server(**kw):
+    srv = serve_kv("127.0.0.1", 0, block=False, **kw)
+    return srv, f"127.0.0.1:{srv.server_address[1]}"
+
+
+def _stop(srv):
+    try:
+        srv.shutdown()
+        srv.server_close()
+    except Exception:
+        pass
+
+
+def _wait_attached(primary, n=1, timeout=5.0):
+    """Setup helper: wait for replication links to attach (readiness,
+    not recovery detection)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if primary.status()["attached_replicas"] >= n:
+            return
+        time.sleep(0.01)
+    raise AssertionError("replica never attached")
+
+
+def test_dropped_frames_are_retried_transparently():
+    srv, _addr = _mk_server()
+    proxy = FaultProxy(srv.server_address[:2]).start()
+    tel = Telemetry()
+    be = None
+    try:
+        be = RemoteBackend(
+            proxy.addr, telemetry=tel, op_timeout=0.5,
+            policy=RetryPolicy(deadline_s=5, base_ms=20, max_ms=100),
+        )
+        proxy.set(drop_next=2)
+        assert be.pool.call(["ping"]) == "pong"
+        assert tel.get("kv_retries") >= 1, "drops must surface as retries"
+        assert proxy.frames_dropped >= 2
+    finally:
+        if be is not None:
+            be.close()
+        proxy.stop()
+        _stop(srv)
+
+
+def test_delayed_frames_complete_without_retry():
+    srv, _addr = _mk_server()
+    proxy = FaultProxy(srv.server_address[:2]).start()
+    tel = Telemetry()
+    be = None
+    try:
+        be = RemoteBackend(
+            proxy.addr, telemetry=tel, op_timeout=2.0,
+            policy=RetryPolicy(deadline_s=5, base_ms=20, max_ms=100),
+        )
+        proxy.set(delay_s=0.25)
+        t0 = time.monotonic()
+        assert be.pool.call(["ping"]) == "pong"
+        assert time.monotonic() - t0 >= 0.25
+        assert tel.get("kv_retries") == 0, "delay under timeout: no retry"
+    finally:
+        if be is not None:
+            be.close()
+        proxy.stop()
+        _stop(srv)
+
+
+def test_partition_raises_retryable_within_deadline():
+    """A black-holed link (silence, not reset) must surface as a
+    retryable error bounded by the policy deadline — never an unbounded
+    stall."""
+    srv, _addr = _mk_server()
+    proxy = FaultProxy(srv.server_address[:2]).start()
+    be = None
+    try:
+        be = RemoteBackend(
+            proxy.addr, op_timeout=0.3, connect_timeout=0.3,
+            policy=RetryPolicy(deadline_s=1.5, base_ms=20, max_ms=100),
+        )
+        proxy.partition()
+        t0 = time.monotonic()
+        with pytest.raises(RetryableKvError, match="deadline"):
+            be.pool.call(["ping"])
+        elapsed = time.monotonic() - t0
+        assert 1.0 <= elapsed < 6.0, f"stall not deadline-bounded: {elapsed}"
+        # the link heals -> the same pool recovers without a new backend
+        proxy.heal()
+        assert be.pool.call(["ping"]) == "pong"
+    finally:
+        if be is not None:
+            be.close()
+        proxy.stop()
+        _stop(srv)
+
+
+def test_duplicated_repl_frames_apply_once():
+    """The proxy duplicates every request frame toward a replica; the
+    sequence-numbered replication protocol must apply each writeset
+    exactly once."""
+    rep, _addr = _mk_server(role="replica")
+    proxy = FaultProxy(rep.server_address[:2]).start()
+    proxy.set(duplicate=True)
+    sock = socket.create_connection(("127.0.0.1", proxy.port), timeout=5)
+    try:
+        def call(msg, nresp):
+            _send_frame(sock, _encode(msg))
+            return [_decode(_recv_frame(sock)) for _ in range(nresp)]
+
+        # every request below arrives twice at the replica
+        outs = call(["repl_hello", "prim-1", "127.0.0.1:1", 0], nresp=2)
+        assert outs[0][0] == "ok" and outs[1][0] == "ok"
+        outs = call(["repl_sync", "prim-1", 0, [[b"k1", b"v1"]]], nresp=2)
+        assert outs[0] == ["ok", 0] and outs[1] == ["ok", 0]
+        outs = call(["repl_apply", "prim-1", 1, [[b"a", b"1"]]], nresp=2)
+        assert outs[0] == ["ok", 1]
+        assert outs[1] == ["ok", 1], "duplicate must be acked, not applied"
+        assert rep.applied_seq == 1
+        assert rep.counters["repl_dups"] == 1
+        snap = rep.vs.snapshot()
+        try:
+            assert rep.vs.read(b"a", snap) == b"1"
+            assert rep.vs.read(b"k1", snap) == b"v1"
+        finally:
+            rep.vs.release(snap)
+    finally:
+        sock.close()
+        proxy.stop()
+        _stop(rep)
+
+
+def test_kill_on_nth_commit_never_acks_the_killed_commit():
+    """The Nth commit kills the server before the frame is forwarded:
+    the client must see a retryable failure (not an ack), and every
+    PREVIOUSLY acked commit must still be in the store."""
+    srv, _addr = _mk_server()
+    proxy = FaultProxy(srv.server_address[:2]).start()
+    killed = threading.Event()
+
+    def kill():
+        killed.set()
+        srv.kill()
+
+    be = None
+    try:
+        be = RemoteBackend(
+            proxy.addr, op_timeout=0.5, connect_timeout=0.3,
+            policy=RetryPolicy(deadline_s=1.0, base_ms=20, max_ms=100),
+        )
+        proxy.set(kill_on_commit=(2, kill))
+        t1 = be.transaction(True)
+        t1.set(b"acked", b"1")
+        t1.commit()  # commit #1: forwarded + acked
+        t2 = be.transaction(True)
+        t2.set(b"lost", b"2")
+        with pytest.raises(RetryableKvError):
+            t2.commit()  # commit #2: kills the primary, never acked
+        assert killed.is_set()
+        assert proxy.commits_seen == 2
+        # the acked write survived in the killed server's store
+        snap = srv.vs.snapshot()
+        try:
+            assert srv.vs.read(b"acked", snap) == b"1"
+        finally:
+            srv.vs.release(snap)
+    finally:
+        if be is not None:
+            be.close()
+        proxy.stop()
+        _stop(srv)
+
+
+def test_readonly_txn_fails_over_transparently_writes_abort_retryable():
+    """Kill the primary under open transactions: the read-only txn
+    re-pins on the promoted replica and keeps answering; the write txn
+    aborts with a retryable error (its snapshot lineage died)."""
+    p, pa = _mk_server(failover_timeout_s=30, lease_ttl_s=5)
+    r, ra = _mk_server(role="replica", failover_timeout_s=30, lease_ttl_s=5)
+    peers = [pa, ra]
+    p.configure_cluster(peers, 0, role="primary")
+    r.configure_cluster(peers, 1, role="replica", auto_failover=False)
+    tel = Telemetry()
+    be = None
+    try:
+        be = RemoteBackend(
+            f"{pa},{ra}", telemetry=tel, connect_timeout=0.5,
+            policy=RetryPolicy(deadline_s=5, base_ms=20, max_ms=100),
+        )
+        _wait_attached(p)
+        wt = be.transaction(True)
+        wt.set(b"k", b"v")
+        wt.commit()  # acked => synchronously on the attached replica
+        rt = be.transaction(False)
+        assert rt.get(b"k") == b"v"
+        wt2 = be.transaction(True)
+        wt2.set(b"k2", b"v2")
+        p.kill()
+        r.promote()  # deterministic promotion (lease path covered in
+        # tests/test_distributed.py with real SIGKILL + auto-failover)
+        assert rt.get(b"k") == b"v", "read-only txn must fail over"
+        assert tel.get("kv_txn_failovers") >= 1
+        with pytest.raises(RetryableKvError):
+            wt2.commit()
+        # fresh write txns land on the promoted primary
+        wt3 = be.transaction(True)
+        wt3.set(b"k3", b"v3")
+        wt3.commit()
+        rt2 = be.transaction(False)
+        assert rt2.get(b"k3") == b"v3"
+        rt2.cancel()
+        rt.cancel()
+    finally:
+        if be is not None:
+            be.close()
+        _stop(p)
+        _stop(r)

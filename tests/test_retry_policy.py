@@ -1,0 +1,165 @@
+"""Unit coverage for the remote-KV retry policy (kvs/remote.py):
+backoff schedule bounds, jitter range, deadline expiry, error
+classification — plus the RemoteTx construction-failure GC regression
+(a half-built transaction must not raise at collection time)."""
+
+import gc
+import socket
+import sys
+
+import pytest
+
+from surrealdb_tpu.err import RetryableKvError, SdbError
+from surrealdb_tpu.kvs.remote import RetryPolicy, is_retryable
+
+
+def _fake_timeline():
+    """(clock, sleep, sleeps): a deterministic clock advanced by sleep."""
+    t = [0.0]
+    sleeps = []
+
+    def clock():
+        return t[0]
+
+    def sleep(d):
+        sleeps.append(d)
+        t[0] += d
+
+    return clock, sleep, sleeps
+
+
+def test_backoff_schedule_bounds():
+    pol = RetryPolicy(deadline_s=60, base_ms=25, max_ms=1000, jitter=0.5,
+                      rng=lambda: 1.0)  # rng=1.0 -> always the upper bound
+    assert pol.backoff(0) == pytest.approx(0.025)
+    assert pol.backoff(1) == pytest.approx(0.05)
+    assert pol.backoff(3) == pytest.approx(0.2)
+    # capped at max_ms from attempt 6 onwards (25 * 2^6 = 1600 > 1000)
+    assert pol.backoff(6) == pytest.approx(1.0)
+    assert pol.backoff(40) == pytest.approx(1.0)  # huge attempt: no overflow
+    # the schedule is monotone non-decreasing at its upper bound
+    uppers = [pol.backoff_bounds(i)[1] for i in range(12)]
+    assert uppers == sorted(uppers)
+
+
+def test_jitter_range_and_spread():
+    pol = RetryPolicy(deadline_s=60, base_ms=100, max_ms=1000, jitter=0.5)
+    lo, hi = pol.backoff_bounds(2)
+    assert lo == pytest.approx(0.2) and hi == pytest.approx(0.4)
+    samples = [pol.backoff(2) for _ in range(300)]
+    assert all(lo <= s <= hi for s in samples)
+    # jitter actually jitters (not a constant schedule)
+    assert max(samples) - min(samples) > (hi - lo) * 0.3
+
+
+def test_zero_jitter_is_deterministic():
+    pol = RetryPolicy(deadline_s=60, base_ms=100, max_ms=1000, jitter=0.0)
+    assert pol.backoff(3) == pol.backoff(3) == pytest.approx(0.8)
+
+
+def test_deadline_expiry_raises_within_deadline():
+    clock, sleep, sleeps = _fake_timeline()
+    pol = RetryPolicy(deadline_s=2.0, base_ms=100, max_ms=10_000,
+                      jitter=0.0, clock=clock, sleep=sleep)
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        raise ConnectionResetError("injected reset")
+
+    with pytest.raises(RetryableKvError) as ei:
+        pol.run(fn)
+    # the final sleep is trimmed: total slept time never exceeds the
+    # deadline, and the raise happens at <= deadline on the fake clock
+    assert sum(sleeps) <= 2.0 + 1e-9
+    assert clock() <= 2.0 + 1e-9
+    assert calls[0] >= 3  # it genuinely retried before giving up
+    assert "deadline" in str(ei.value)
+    assert isinstance(ei.value.__cause__, ConnectionResetError)
+
+
+def test_non_retryable_surfaces_immediately():
+    clock, sleep, sleeps = _fake_timeline()
+    pol = RetryPolicy(deadline_s=60, clock=clock, sleep=sleep)
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        raise SdbError(
+            "Failed to commit transaction due to a read or write conflict"
+        )
+
+    with pytest.raises(SdbError, match="conflict"):
+        pol.run(fn)
+    assert calls[0] == 1, "logical errors must not be retried"
+    assert sleeps == []
+
+
+def test_success_after_transient_failures():
+    clock, sleep, sleeps = _fake_timeline()
+    pol = RetryPolicy(deadline_s=10, base_ms=50, max_ms=200, jitter=0.0,
+                      clock=clock, sleep=sleep)
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        if calls[0] < 4:
+            raise ConnectionError("flap")
+        return "ok"
+
+    assert pol.run(fn) == "ok"
+    assert calls[0] == 4
+    assert sleeps == [0.05, 0.1, 0.2]
+
+
+def test_error_classification():
+    assert is_retryable(ConnectionResetError("x"))
+    assert is_retryable(ConnectionRefusedError("x"))
+    assert is_retryable(socket.timeout("x"))
+    assert is_retryable(TimeoutError("x"))
+    assert is_retryable(OSError(104, "reset"))
+    assert is_retryable(RetryableKvError("anything"))
+    assert is_retryable(SdbError("kv not primary (role=replica)"))
+    assert is_retryable(SdbError("kv connection lost: peer closed"))
+    assert is_retryable(SdbError("kv service unreachable: refused"))
+    # logical/server errors are NOT transport-retryable
+    assert not is_retryable(SdbError(
+        "Failed to commit transaction due to a read or write conflict"
+    ))
+    assert not is_retryable(SdbError("kv auth required"))
+    assert not is_retryable(ValueError("x"))
+
+
+def test_remote_tx_init_failure_no_unraisable():
+    """Regression: when RemoteTx.__init__ dies (dead address), the
+    half-built object must not emit `AttributeError: ... no attribute
+    'done'` from __del__ at GC time."""
+    from surrealdb_tpu.kvs.remote import RemoteTx, _Pool
+
+    # a port with nothing listening
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    pool = _Pool([("127.0.0.1", port)],
+                 policy=RetryPolicy(deadline_s=0.2, base_ms=10, max_ms=20))
+
+    class _FakeBackend:
+        pass
+
+    backend = _FakeBackend()
+    backend.pool = pool
+
+    captured = []
+    old_hook = sys.unraisablehook
+    sys.unraisablehook = lambda u: captured.append(
+        f"{u.exc_type.__name__}: {u.exc_value}"
+    )
+    try:
+        with pytest.raises(SdbError):
+            RemoteTx(backend, write=True)
+        gc.collect()
+    finally:
+        sys.unraisablehook = old_hook
+    assert not captured, f"unraisable exception(s) during GC: {captured}"

@@ -75,6 +75,22 @@ def main():
     print(f"\n== conformance: {passed}/{total} "
           f"({100.0 * passed / max(total, 1):.1f}%) "
           f"[skipped {skipped}, harness errors {errored}]")
+    # the upgrade/ subtree is exercised by tests/test_upgrade.py (a full
+    # disk round-trip per file, which this in-process gate can't model) —
+    # report its size here so a regression in that suite is visible in
+    # the gate output instead of only in the pytest run
+    up_root = "/root/reference/language-tests/tests/upgrade"
+    if os.path.isdir(up_root):
+        up_count = sum(
+            1 for _dp, _dirs, files in os.walk(up_root)
+            for fn in files
+            if fn.endswith(".surql") and not fn.endswith("_import.surql")
+        )
+        print(f"== upgrade subtree (separate gate): {up_count} .surql "
+              f"files — run `pytest tests/test_upgrade.py` for pass/fail")
+    else:
+        print("== upgrade subtree (separate gate): reference tree not "
+              "present; tests/test_upgrade.py skips")
     worst = sorted(by_dir.items(), key=lambda kv: -kv[1][1])[:15]
     for d, (p, f) in worst:
         if f:
