@@ -32,6 +32,21 @@ def main(argv=None):
     p_start.add_argument(
         "--unauthenticated", action="store_true",
         help="allow anonymous connections full access (dev mode)")
+    p_start.add_argument("--max-inflight", type=int, default=None,
+                         help="concurrent queries executing at once "
+                              "(admission-control worker slots; 0 "
+                              "disables admission control)")
+    p_start.add_argument("--queue-depth", type=int, default=None,
+                         help="requests allowed to wait for a worker "
+                              "slot before the server sheds with 503")
+    p_start.add_argument("--default-timeout", default=None,
+                         help="server-side default query timeout "
+                              "(e.g. 5s, 500ms) applied when the client "
+                              "sends no X-Surreal-Timeout")
+    p_start.add_argument("--drain-timeout", default=None,
+                         help="SIGTERM drain budget (e.g. 10s): finish "
+                              "in-flight queries this long, then cancel "
+                              "and exit")
 
     p_sql = sub.add_parser("sql", help="interactive REPL")
     p_sql.add_argument("--path", default="memory")
@@ -167,7 +182,7 @@ def main(argv=None):
     from surrealdb_tpu import Datastore
 
     if args.cmd == "start":
-        from surrealdb_tpu.server import serve
+        from surrealdb_tpu.server import parse_timeout, serve
 
         host, _, port = args.bind.partition(":")
         ds = Datastore(args.path)
@@ -178,9 +193,17 @@ def main(argv=None):
         elif not args.unauthenticated:
             print("no --user/--pass given and --unauthenticated not set: "
                   "anonymous connections have no access")
+        default_timeout_s = (parse_timeout(args.default_timeout)
+                             if args.default_timeout else None)
+        drain_timeout_s = (parse_timeout(args.drain_timeout)
+                           if args.drain_timeout else None)
         serve(ds, host or "127.0.0.1", int(port or 8000),
               unauthenticated=args.unauthenticated,
-              tls_cert=args.web_crt, tls_key=args.web_key)
+              tls_cert=args.web_crt, tls_key=args.web_key,
+              max_inflight=args.max_inflight,
+              queue_depth=args.queue_depth,
+              default_timeout_s=default_timeout_s,
+              drain_timeout_s=drain_timeout_s)
         return 0
 
     if args.cmd == "sql":

@@ -90,6 +90,19 @@ KV_FAILOVER_TIMEOUT_S = env_float("SURREAL_KV_FAILOVER_TIMEOUT_S", 8.0)
 # device discovery that exceeds this degrades to CPU instead of hanging
 BACKEND_INIT_TIMEOUT_S = env_float("SURREAL_BACKEND_INIT_TIMEOUT_S", 240.0)
 
+# -- admission control / query lifecycle (server/admission.py, inflight.py) --
+# concurrent queries executing at once (the worker-slot budget); the CLI
+# --max-inflight flag overrides. 0 disables admission control entirely.
+HTTP_MAX_INFLIGHT = env_int("SURREAL_HTTP_MAX_INFLIGHT", 64)
+# requests allowed to WAIT for a slot; one past this sheds with a 503
+HTTP_QUEUE_DEPTH = env_int("SURREAL_HTTP_QUEUE_DEPTH", 128)
+# server-side default query timeout seeding ExecContext.deadline when the
+# client sends no X-Surreal-Timeout / rpc timeout field (0 = unbounded)
+HTTP_DEFAULT_TIMEOUT_S = env_float("SURREAL_HTTP_DEFAULT_TIMEOUT_S", 0.0)
+# SIGTERM drain budget: stop admitting, let in-flight work finish this
+# long, then cancel whatever remains and exit
+DRAIN_TIMEOUT_S = env_float("SURREAL_DRAIN_TIMEOUT_S", 10.0)
+
 
 def env_str(name: str, default: str) -> str:
     return os.environ.get(name, "") or default

@@ -13,6 +13,28 @@ class RetryableKvError(SdbError):
     level, exactly like the reference's retryable TiKV errors."""
 
 
+class QueryTimeout(SdbError):
+    """The query ran past its deadline (statement TIMEOUT, the edge
+    X-Surreal-Timeout budget, or the server default). The message keeps
+    the reference wording so conformance goldens match."""
+
+
+class QueryCancelled(SdbError):
+    """The query was cooperatively cancelled: KILL <query-id>, client
+    disconnect, or server drain. Retryable from the client's view."""
+
+
+class ShedError(SdbError):
+    """Admission control rejected the request before execution (queue
+    full, deadline unreachable, or the server is draining). Maps to
+    HTTP 503 + Retry-After; the work was never started, so a retry is
+    always safe."""
+
+    def __init__(self, msg, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 class ParseError(SdbError):
     def __init__(self, msg, line=None, col=None):
         if line is not None:

@@ -20,6 +20,7 @@ class Ctx:
         "timeout_dur", "write_version", "depth",
         "perms_enabled", "version", "_cond_consumed", "_cf_seq", "_in_perm_check",
         "_brute_knn_k", "_strict_readonly", "_stream_cols", "_no_link_fetch", "_script_depth",
+        "cancel", "inflight",
     )
 
     def __init__(self, ds, session, txn, executor=None):
@@ -51,6 +52,11 @@ class Ctx:
         # (reference: sort compares computed values without db access)
         self._no_link_fetch = False
         self._script_depth = 0  # nested script frames (budget: 15)
+        # cooperative cancellation: a threading.Event set by KILL
+        # <query-id>, client disconnect, or server drain; checked at
+        # every check_deadline() site alongside the deadline itself
+        self.cancel = None
+        self.inflight = None  # the owning QueryHandle (inflight.py)
 
     def child(self) -> "Ctx":
         c = Ctx.__new__(Ctx)
@@ -80,6 +86,8 @@ class Ctx:
         c._stream_cols = self._stream_cols
         c._no_link_fetch = self._no_link_fetch
         c._script_depth = self._script_depth
+        c.cancel = self.cancel
+        c.inflight = self.inflight
         from surrealdb_tpu import cnf
 
         if c.depth > cnf.MAX_COMPUTATION_DEPTH:
@@ -105,12 +113,22 @@ class Ctx:
             from surrealdb_tpu.mem import check_threshold
 
             check_threshold()
+        if self.cancel is not None and self.cancel.is_set():
+            from surrealdb_tpu.err import QueryCancelled
+
+            if self.inflight is not None:
+                self.inflight.mark_cancelled()
+            raise QueryCancelled("The query was cancelled")
         if self.deadline is not None and time.monotonic() > self.deadline:
+            from surrealdb_tpu.err import QueryTimeout
+
             suffix = (
                 f": {self.timeout_dur.render()}"
                 if self.timeout_dur is not None else ""
             )
-            raise SdbError(
+            if self.inflight is not None:
+                self.inflight.mark_timed_out()
+            raise QueryTimeout(
                 "The query was not executed because it exceeded the "
                 f"timeout{suffix}"
             )

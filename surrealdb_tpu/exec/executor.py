@@ -43,8 +43,13 @@ class Executor:
             tel.end(root)
 
     def _execute(self, stmts: list, vars: dict, tel) -> list[QueryResult]:
+        from surrealdb_tpu import inflight as _inflight
+
         results: list[QueryResult] = []
         self.import_mode = False  # OPTION IMPORT, scoped to this run
+        # the edge deadline + cancel flag ride the thread's QueryHandle
+        # (kvs/ds.py execute registers it); every statement ctx inherits
+        handle = _inflight.current()
         txn = None  # explicit transaction, if open
         ensured_nsdb = False
         failed = False  # explicit txn poisoned
@@ -133,17 +138,53 @@ class Executor:
                 except SdbError as e:
                     results.append(QueryResult(error=str(e)))
                     continue
+            if handle is not None and handle.cancel.is_set():
+                # a KILL / disconnect / drain cancels the REMAINING
+                # statements too — they never start, and an open explicit
+                # transaction is poisoned exactly as if the cancel had
+                # landed DURING a statement (COMMIT must not persist a
+                # half-done transaction the client was told was cancelled)
+                handle.mark_cancelled()
+                failed = txn is not None or failed
+                results.append(QueryResult(error="The query was cancelled"))
+                continue
+            if handle is not None and handle.deadline is not None and \
+                    time.monotonic() > handle.deadline:
+                handle.mark_timed_out()
+                failed = txn is not None or failed
+                results.append(QueryResult(
+                    error="The query was not executed because it "
+                          "exceeded the timeout"
+                ))
+                continue
             own_txn = txn is None
-            cur = txn or self.ds.transaction(write=True)
-            ctx = Ctx(self.ds, self.session, cur, executor=self)
-            ctx.vars.update(shared_vars)
-            if self.session.ns and self.session.db and not ensured_nsdb:
-                # non-strict mode lazily registers the session ns/db in the
-                # catalog (reference kvs get_or_add_ns/db); once per run
-                from surrealdb_tpu.exec.statements import _ensure_ns_db
-
-                _ensure_ns_db(ctx)
             try:
+                cur = txn or self.ds.transaction(write=True)
+            except SdbError as e:
+                # a transaction that cannot OPEN (remote KV unreachable /
+                # retry deadline exhausted) is a per-statement error, not
+                # a crashed query: the worker thread must be reclaimed
+                # and the client must see the typed message
+                self.ds.record_statement(
+                    False, time.perf_counter_ns() - t0, type(stmt).__name__
+                )
+                results.append(QueryResult(error=str(e)))
+                continue
+            ctx = Ctx(self.ds, self.session, cur, executor=self)
+            if handle is not None:
+                ctx.deadline = handle.deadline
+                ctx.cancel = handle.cancel
+                ctx.inflight = handle
+            ctx.vars.update(shared_vars)
+            try:
+                if self.session.ns and self.session.db and not ensured_nsdb:
+                    # non-strict mode lazily registers the session ns/db in
+                    # the catalog (reference kvs get_or_add_ns/db); once per
+                    # run — inside the error envelope: a partitioned KV
+                    # must surface as a statement error, not a crash
+                    from surrealdb_tpu.exec.statements import _ensure_ns_db
+
+                    _ensure_ns_db(ctx)
                 cur.new_save_point()
                 sp = tel.start(type(stmt).__name__)
                 try:

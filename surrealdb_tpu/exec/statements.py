@@ -207,7 +207,15 @@ def _s_sleep(n: SleepStmt, ctx):
 
     d = evaluate(n.duration, ctx)
     if isinstance(d, Duration):
-        time.sleep(min(d.to_seconds(), 30))
+        # sliced so KILL / deadline expiry interrupts within ~50ms
+        # instead of parking the worker for the whole duration
+        end = time.monotonic() + min(d.to_seconds(), 30)
+        while True:
+            ctx.check_deadline()
+            left = end - time.monotonic()
+            if left <= 0:
+                break
+            time.sleep(min(left, 0.05))
     return NONE
 
 
@@ -1706,8 +1714,11 @@ def _explain_streaming(n: SelectStmt, ctx) -> str:
                 union_branch_scan,
             )
 
+            # plan-time `type::field($param)` resolution applies to the
+            # union analysis too (schemaless parameterized scans)
             orb = or_union_branches(
-                tb, n.cond, indexes, ctx, value_idioms=False
+                tb, _resolve_type_fields(n.cond, ctx), indexes, ctx,
+                value_idioms=False,
             )
             if orb is not None:
                 from surrealdb_tpu.val import hashable
@@ -3228,7 +3239,12 @@ def _timeout_ctx(n, ctx: Ctx) -> Ctx:
     if not isinstance(d, Duration):
         raise SdbError(f"Expected a duration but found {render(d)}")
     c = ctx.child()
-    c.deadline = time.monotonic() + d.to_seconds()
+    # a statement TIMEOUT can only SHRINK the budget: the edge deadline
+    # (X-Surreal-Timeout / server default) stays binding underneath it
+    stmt_dl = time.monotonic() + d.to_seconds()
+    if ctx.deadline is not None and ctx.deadline < stmt_dl:
+        return c
+    c.deadline = stmt_dl
     c.timeout_dur = d
     return c
 
@@ -5146,6 +5162,9 @@ def _s_info(n: InfoStmt, ctx: Ctx):
                 {"ms": ms, "statement": label}
                 for ms, label in ctx.ds.slow_log[-50:]
             ],
+            # in-flight (non-LIVE) query registry: each id is a valid
+            # KILL <query-id> target (inflight.py)
+            "queries": ctx.ds.inflight.snapshot(),
         }
     if n.level == "root":
         out = {"accesses": {}, "namespaces": {}, "nodes": {}, "system": {},
@@ -5394,6 +5413,12 @@ def _s_kill(n: KillStmt, ctx: Ctx):
         raise SdbError("KILL requires a live query uuid")
     sub = ctx.ds.live_queries.pop(lid, None)
     if sub is None:
+        # not a LIVE query: try the in-flight (normal) query registry —
+        # KILL <query-id> sets the cooperative cancel flag and the
+        # target fails with "The query was cancelled" at its next
+        # check_deadline site
+        if ctx.ds.inflight.kill(lid):
+            return NONE
         raise SdbError(
             f"Can not execute KILL statement using id '{render(v)}'"
         )

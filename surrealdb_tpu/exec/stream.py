@@ -427,6 +427,7 @@ class VecTopKScanOp(Operator):
         order = order[self.skip:]
         batch = []
         for i in order:
+            ctx.check_deadline()
             rid = RecordId(self.tb, col.ids[int(i)])
             doc = fetch_record(ctx, rid)
             if doc is NONE:

@@ -317,6 +317,15 @@ def _resolve_function(ds, session, fname: str, args: dict):
         while ordered and ordered[-1] is None:
             ordered.pop()
         ctx = Ctx(ds, session, txn)
+        # GraphQL function calls honour the edge deadline/cancel budget
+        # like any other query path (inflight.py)
+        from surrealdb_tpu.inflight import current as _q_current
+
+        h = _q_current()
+        if h is not None:
+            ctx.deadline = h.deadline
+            ctx.cancel = h.cancel
+            ctx.inflight = h
         out = call_custom(fname, ordered, ctx)
         txn.commit()
     except BaseException:

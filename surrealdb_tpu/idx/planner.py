@@ -757,7 +757,14 @@ def _plan_scan(tb: str, cond, ctx, stmt):
     if cond is None:
         return None
     from surrealdb_tpu.exec.eval import evaluate
-    from surrealdb_tpu.exec.statements import Source
+    from surrealdb_tpu.exec.statements import Source, _resolve_type_fields
+
+    # plan-time rewrite: `type::field($param)` with a statically-known
+    # argument becomes the named column idiom, so parameterized
+    # (schemaless OData-style) predicates match index access paths; the
+    # rewrite is semantics-preserving, so downstream residual filters
+    # may evaluate either tree
+    cond = _resolve_type_fields(cond, ctx)
 
     with_index = getattr(stmt, "with_index", None) if stmt is not None else None
     if with_index == []:  # WITH NOINDEX: no index access paths...
@@ -784,6 +791,11 @@ def _plan_scan(tb: str, cond, ctx, stmt):
         union = or_union_branches(tb, cond, indexes, ctx, value_idioms=False)
     else:
         union = multi_index_leaves(tb, cond, indexes, ctx)
+        if union is None:
+            # OR-with-AND-tails: not a leaf union, but one access per
+            # disjunct still beats a table scan — branches safely
+            # over-approximate (the full cond filters above the union)
+            union = or_union_branches(tb, cond, indexes, ctx)
     if union is not None:
         return _union_scan(tb, union, ctx)
 
@@ -1221,6 +1233,9 @@ def explain_plan(tb, cond, ctx, stmt):
                     "operation": "Iterate Table Keys",
                 }
     if cond is not None:
+        from surrealdb_tpu.exec.statements import _resolve_type_fields
+
+        cond = _resolve_type_fields(cond, ctx)
         knn = _find_knn(cond)
         indexes = get_indexes_for(tb, ctx)
         if with_index:
@@ -1317,6 +1332,64 @@ def explain_plan(tb, cond, ctx, stmt):
                     "operation": "Iterate Index",
                 })
             return entries
+        # a top-level OR whose disjuncts each carry an AND tail is not a
+        # leaf union (multi_index_leaves rejects it) but still unions one
+        # access per disjunct — render it as a single UnionIndexScan
+        # plan object (reference exec/operators/scan/union.rs JSON)
+        orb = or_union_branches(tb, cond, indexes, ctx)
+        if orb is not None:
+            from surrealdb_tpu.exec.eval import evaluate
+
+            plans = []
+            for br in orb:
+                if br["kind"] == "range":
+                    frm = {"inclusive": False, "value": NONE}
+                    to = {"inclusive": False, "value": NONE}
+                    for rop, rexpr in br["tail"][1]:
+                        rv = evaluate(rexpr, ctx)
+                        if rop in (">", ">="):
+                            frm = {"inclusive": rop == ">=", "value": rv}
+                        else:
+                            to = {"inclusive": rop == "<=", "value": rv}
+                    plans.append({
+                        "direction": "forward", "from": frm,
+                        "index": br["idef"].name, "to": to,
+                    })
+                    continue
+                if br["kind"] == "ft":
+                    mt = br["mt"]
+                    op = f"@{mt.ref}@" if mt.ref is not None else "@@"
+                    try:
+                        val = evaluate(mt.rhs, ctx)
+                    except Exception:
+                        val = None
+                elif br["kind"] == "in":
+                    op = "union"
+                    iv = evaluate(br["tail"][1], ctx)
+                    val = iv if isinstance(iv, list) else [iv]
+                else:
+                    idef = br["idef"]
+                    op = "="
+                    vals = [
+                        evaluate(br["eqs"][c], ctx)
+                        for c in idef.cols_str[:br["nmatch"]]
+                    ]
+                    val = vals[0] if len(vals) == 1 else vals
+                plans.append({
+                    "index": br["idef"].name,
+                    "operator": op,
+                    "value": val,
+                })
+            return {
+                "detail": {
+                    "plan": {
+                        "operator": "UnionIndexScan",
+                        "branches": plans,
+                    },
+                    "table": tb,
+                },
+                "operation": "Iterate Index Union",
+            }
         mts = _find_matches(cond)
         if mts:
             from surrealdb_tpu.exec.eval import evaluate

@@ -156,12 +156,58 @@ class _Coalescer:
     def search(self, qv: np.ndarray, k: int):
         # slot: [result, exception, done]. Waiters are signalled by the
         # dispatching thread at batch completion (cond.notify_all) — no
-        # polling interval, queued queries wake immediately.
+        # polling interval, queued queries wake immediately. The wait is
+        # capped by the calling query's remaining deadline (inflight
+        # thread-local): a nearly-expired query must not park behind a
+        # long batch it can no longer use.
+        from surrealdb_tpu.err import QueryCancelled, QueryTimeout
+        from surrealdb_tpu.inflight import cancelled as _q_cancelled
+        from surrealdb_tpu.inflight import current as _q_current
+        from surrealdb_tpu.inflight import remaining as _q_remaining
+
         slot = [None, None, False]
+        entry = (qv, k, slot)
         with self.cond:
-            self.queue.append((qv, k, slot))
+            self.queue.append(entry)
             while not slot[2] and self.running:
-                self.cond.wait()
+                if _q_cancelled():
+                    # KILL / disconnect / drain while parked: withdraw
+                    # and unwind — nothing signals this condition on
+                    # cancel, so the wait below is sliced at 50ms
+                    try:
+                        self.queue.remove(entry)
+                    except ValueError:
+                        pass
+                    h = _q_current()
+                    if h is not None:
+                        h.mark_cancelled()
+                    raise QueryCancelled("The query was cancelled")
+                budget = _q_remaining()
+                if budget is not None and budget <= 0:
+                    # expired while queued: withdraw if the batch hasn't
+                    # picked us up; either way stop waiting — a late
+                    # result written into the slot is simply discarded
+                    try:
+                        self.queue.remove(entry)
+                    except ValueError:
+                        pass
+                    h = _q_current()
+                    if h is not None:
+                        h.mark_timed_out()
+                    raise QueryTimeout(
+                        "The query was not executed because it "
+                        "exceeded the timeout"
+                    )
+                # completion still wakes riders immediately via
+                # notify_all; the 50ms slice exists only so a KILL is
+                # noticed while parked (nothing signals the condition on
+                # cancel). Riders outside any query context keep the
+                # pure event-driven wait.
+                if _q_current() is not None:
+                    self.cond.wait(0.05 if budget is None
+                                   else min(budget, 0.05))
+                else:
+                    self.cond.wait()
             if not slot[2]:
                 # no dispatch in flight: THIS thread becomes the
                 # dispatcher for everything queued so far

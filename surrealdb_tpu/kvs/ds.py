@@ -173,6 +173,11 @@ class Datastore:
 
         self.node_id = make_node_id()
         self.node_tasks = None
+        # in-flight (non-LIVE) query registry: KILL <query-id>, INFO FOR
+        # SYSTEM exposure, drain-time cancellation (inflight.py)
+        from surrealdb_tpu.inflight import InflightRegistry
+
+        self.inflight = InflightRegistry(self.telemetry)
         # shared decoded-catalog cache (version, dict); local backends
         # only — a remote keyspace can change under us without a local
         # commit, so remote datastores skip it
@@ -225,11 +230,21 @@ class Datastore:
         db: Optional[str] = None,
         vars: Optional[dict] = None,
         session: Optional[Session] = None,
+        deadline: Optional[float] = None,
+        handle=None,
     ) -> list[QueryResult]:
-        """Parse and run a SurrealQL query; one QueryResult per statement."""
+        """Parse and run a SurrealQL query; one QueryResult per statement.
+
+        `deadline` is an absolute `time.monotonic()` point seeding every
+        statement's ExecContext (the edge X-Surreal-Timeout budget);
+        `handle` is a pre-opened `QueryHandle` when the caller needs to
+        cancel from outside (server disconnect watch). A nested execute
+        on the same thread (api::invoke, surrealism host sql) inherits
+        the enclosing query's handle instead of registering a new one."""
         from surrealdb_tpu.exec.executor import Executor
         from surrealdb_tpu.syn import parse
 
+        from surrealdb_tpu import inflight as _inflight
         from surrealdb_tpu.err import ParseError
 
         # embedded convenience path: a caller holding the Datastore object
@@ -256,8 +271,26 @@ class Datastore:
                 if len(self._ast_cache) >= self._ast_cache_cap:
                     self._ast_cache.clear()
                 self._ast_cache[sql] = stmts
-        ex = Executor(self, sess)
-        return ex.execute(stmts, vars or {})
+        own = None
+        if handle is None:
+            cur = _inflight.current()
+            if cur is not None:
+                handle = cur  # nested execute: ride the enclosing query
+                if cur.edge:
+                    cur.refine(sess.ns, sess.db, sql)
+            else:
+                own = handle = self.inflight.open(
+                    sess.ns, sess.db, sql, deadline
+                )
+        elif deadline is not None and handle.deadline is None:
+            handle.deadline = deadline
+        try:
+            with _inflight.activate(handle):
+                ex = Executor(self, sess)
+                return ex.execute(stmts, vars or {})
+        finally:
+            if own is not None:
+                self.inflight.close(own)
 
     def query(self, sql: str, ns="test", db="test", vars=None):
         """Convenience: execute and unwrap every statement's result."""

@@ -169,10 +169,27 @@ class RetryPolicy:
         lo, hi = self.backoff_bounds(attempt)
         return lo + (hi - lo) * self.rng()
 
+    def effective_deadline_s(self) -> float:
+        """The policy deadline capped by the calling QUERY's remaining
+        budget (inflight thread-local): KV retries must never outlive
+        the query that issued them — a nearly-expired query fails fast
+        instead of burning its last millisecond on backoff sleeps."""
+        from surrealdb_tpu.inflight import remaining as _q_remaining
+
+        q = _q_remaining()
+        if q is None:
+            return self.deadline_s
+        return min(self.deadline_s, max(q, 0.0))
+
     def run(self, fn, telemetry=None):
         """Call `fn` until it succeeds, a non-retryable error surfaces,
         or the deadline expires (raises RetryableKvError chaining the
-        last transport error)."""
+        last transport error). The effective deadline is
+        min(policy deadline, calling query's remaining budget), and a
+        cancelled query stops retrying immediately."""
+        from surrealdb_tpu.inflight import cancelled as _q_cancelled
+
+        deadline_s = self.effective_deadline_s()
         start = self.clock()
         attempt = 0
         while True:
@@ -182,13 +199,13 @@ class RetryPolicy:
                 if not is_retryable(e):
                     raise
                 elapsed = self.clock() - start
-                remaining = self.deadline_s - elapsed
-                if remaining <= 0:
+                remaining = deadline_s - elapsed
+                if remaining <= 0 or _q_cancelled():
                     if telemetry is not None:
                         telemetry.inc("kv_deadline_exhausted")
                     raise RetryableKvError(
                         f"kv operation failed after {attempt + 1} attempts "
-                        f"over {elapsed:.2f}s (deadline {self.deadline_s}s): "
+                        f"over {elapsed:.2f}s (deadline {deadline_s}s): "
                         f"{e}"
                     ) from e
                 if telemetry is not None:

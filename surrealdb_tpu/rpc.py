@@ -27,20 +27,30 @@ class RpcSession:
         # was explicitly started in unauthenticated dev mode.
         self.session = Session(auth_level=anon_level)
         self.live_ids: set = set()
+        # absolute monotonic deadline for the CURRENT request (the rpc
+        # `timeout` field / X-Surreal-Timeout header); every ds.execute
+        # issued while dispatching it inherits the budget
+        self.deadline: Optional[float] = None
 
     # -- dispatch -----------------------------------------------------------
-    def handle(self, method: str, params: list) -> Any:
+    def handle(self, method: str, params: list,
+               deadline: Optional[float] = None) -> Any:
         caps = getattr(self.ds, "capabilities", None)
         if caps is not None and not caps.allows_rpc(method):
             raise RpcError(-32000, f"Method not allowed: {method}")
         m = getattr(self, f"rpc_{method.replace('::', '_')}", None)
         if m is None:
             raise RpcError(-32601, f"Method not found: {method}")
-        return m(params)
+        self.deadline = deadline
+        try:
+            return m(params)
+        finally:
+            self.deadline = None
 
     def _query(self, sql, vars=None):
         return self.ds.execute(
-            sql, session=self.session, vars=vars or {}
+            sql, session=self.session, vars=vars or {},
+            deadline=self.deadline,
         )
 
     def _one(self, sql, vars=None):

@@ -10,17 +10,51 @@ from __future__ import annotations
 import base64
 import hashlib
 import json
+import select
+import socket
 import struct
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, unquote, urlparse
 
-from surrealdb_tpu.err import SdbError
+from surrealdb_tpu import inflight as _inflight
+from surrealdb_tpu.err import SdbError, ShedError
 from surrealdb_tpu.kvs.ds import Datastore, Session
 from surrealdb_tpu.rpc import RpcError, RpcSession
 from surrealdb_tpu.val import to_json
 
 _WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+# routes that must stay responsive under overload: liveness probes and
+# the observability surface bypass admission control entirely
+_UNGATED_PATHS = ("/status", "/health", "/version", "/metrics",
+                  "/telemetry/traces")
+
+
+def parse_timeout(raw) -> float:
+    """Parse an X-Surreal-Timeout header / rpc `timeout` field into
+    seconds: a bare number is seconds; `500ms`/`2s`/`1m` durations are
+    accepted. Raises SdbError on garbage (a client that asked for a
+    budget and mistyped it must not silently run unbounded)."""
+    if isinstance(raw, (int, float)) and not isinstance(raw, bool):
+        v = float(raw)
+    else:
+        s = str(raw).strip().lower()
+        try:
+            if s.endswith("ms"):
+                v = float(s[:-2]) / 1000.0
+            elif s.endswith("s"):
+                v = float(s[:-1])
+            elif s.endswith("m"):
+                v = float(s[:-1]) * 60.0
+            else:
+                v = float(s)
+        except ValueError:
+            raise SdbError(f"Invalid timeout value: {raw!r}")
+    if v <= 0:
+        raise SdbError(f"Invalid timeout value: {raw!r}")
+    return v
 
 
 class _AuthFailed(Exception):
@@ -39,6 +73,8 @@ class SurrealHandler(BaseHTTPRequestHandler):
     # unauthenticated=True dev mode raises it to "owner".
     anon_level = "none"
     server_obj = None
+    admission = None  # AdmissionController (None = unbounded dev mode)
+    default_timeout_s = 0.0  # server default query budget (0 = none)
 
     def log_message(self, fmt, *args):
         pass
@@ -158,10 +194,84 @@ class SurrealHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
+    # -- admission / deadline / cancellation --------------------------------
+    def _deadline(self):
+        """Absolute monotonic deadline for this request: the client's
+        X-Surreal-Timeout header, else the server default (0 = none)."""
+        raw = self.headers.get("X-Surreal-Timeout") \
+            or self.headers.get("surreal-timeout")
+        if raw:
+            return time.monotonic() + parse_timeout(raw)
+        if self.default_timeout_s:
+            return time.monotonic() + self.default_timeout_s
+        return None
+
+    def _shed_response(self, e: ShedError):
+        body = json.dumps({
+            "error": str(e), "code": 503,
+            "retry_after_ms": int(e.retry_after_s * 1000),
+        }).encode()
+        self.send_response(503)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Retry-After",
+                         str(max(1, int(e.retry_after_s + 0.999))))
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _conn_dropped(self) -> bool:
+        """True when the client socket is at EOF (peer went away). TLS
+        sockets reject MSG_PEEK (ValueError) — treat those as alive:
+        no disconnect watch, the deadline still bounds the work.
+
+        Deliberate semantic: a half-close (client shutdown(SHUT_WR)
+        after sending the request) also reads as EOF and cancels the
+        query — the common reverse-proxy/server posture (nginx treats
+        client aborts the same way). Clients that half-close and still
+        expect a response must send a deadline instead."""
+        try:
+            r, _w, _x = select.select([self.connection], [], [], 0)
+            if not r:
+                return False
+            return self.connection.recv(1, socket.MSG_PEEK) == b""
+        except ValueError:
+            return False  # SSLSocket: flags unsupported
+        except OSError:
+            return True
+
+    def _run_watched(self, fn, handle):
+        """Run `fn` in a worker thread while THIS thread watches the
+        client socket: a disconnect flips the query's cancel flag, so an
+        abandoned request releases its worker slot within one
+        check_deadline interval instead of running to completion."""
+        done = threading.Event()
+        out: dict = {}
+
+        def run():
+            try:
+                with _inflight.activate(handle):
+                    fn()
+            except BaseException as e:  # re-raised on the dispatch thread
+                out["exc"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="surreal-query-worker")
+        t.start()
+        try:
+            while not done.wait(0.05):
+                if not handle.cancel.is_set() and self._conn_dropped():
+                    handle.cancel.set()
+        finally:
+            done.wait()
+        if "exc" in out:
+            raise out["exc"]
+
     # -- routes -------------------------------------------------------------
     def _dispatch(self, fn):
         try:
-            fn()
+            self._dispatch_gated(fn)
         except _BodyTooLarge:
             # the oversized body was never read — keep-alive would parse
             # its bytes as the next request line, so drop the connection
@@ -171,8 +281,35 @@ class SurrealHandler(BaseHTTPRequestHandler):
             })
         except _AuthFailed as e:
             self._json(401, {"error": str(e)})
+        except ShedError as e:
+            self._shed_response(e)
         except SdbError as e:
             self._json(400, {"error": str(e)})
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away mid-response: nothing left to tell it
+            self.close_connection = True
+
+    def _dispatch_gated(self, fn):
+        path = urlparse(self.path).path
+        # liveness/observability bypass; the WS upgrade admits per
+        # REQUEST inside its read loop, not per connection
+        if (self.admission is None or path in _UNGATED_PATHS
+                or (path == "/rpc" and self.command == "GET")):
+            fn()
+            return
+        deadline = self._deadline()
+        ticket = self.admission.admit(deadline)
+        handle = self.ds.inflight.open(
+            self.headers.get("surreal-ns") or self.headers.get("NS"),
+            self.headers.get("surreal-db") or self.headers.get("DB"),
+            f"{self.command} {path}", deadline,
+        )
+        handle.edge = True  # first ds.execute refines to the real SQL
+        try:
+            self._run_watched(fn, handle)
+        finally:
+            self.ds.inflight.close(handle)
+            ticket.release()
 
     def do_GET(self):
         self._dispatch(self._do_GET)
@@ -607,12 +744,46 @@ class SurrealHandler(BaseHTTPRequestHandler):
                     continue
                 rid = req.get("id")
                 try:
-                    out = rs.handle(
-                        req.get("method", ""), req.get("params") or []
+                    # per-REQUEST admission + deadline: one connection
+                    # cannot monopolize worker slots between queries,
+                    # and the rpc `timeout` field mirrors the HTTP
+                    # X-Surreal-Timeout header
+                    deadline = None
+                    if req.get("timeout") is not None:
+                        deadline = (time.monotonic()
+                                    + parse_timeout(req["timeout"]))
+                    elif self.default_timeout_s:
+                        deadline = (time.monotonic()
+                                    + self.default_timeout_s)
+                    ticket = (self.admission.admit(deadline)
+                              if self.admission is not None else None)
+                    handle = self.ds.inflight.open(
+                        rs.session.ns, rs.session.db,
+                        f"rpc {req.get('method', '')}", deadline,
                     )
+                    handle.edge = True
+                    try:
+                        with _inflight.activate(handle):
+                            out = rs.handle(
+                                req.get("method", ""),
+                                req.get("params") or [],
+                                deadline=deadline,
+                            )
+                    finally:
+                        self.ds.inflight.close(handle)
+                        if ticket is not None:
+                            ticket.release()
                     self._ws_send(pack(
                         {"id": rid, "result": jsonify(out)}
                     ))
+                except ShedError as e:
+                    self._ws_send(pack({
+                        "id": rid,
+                        "error": {
+                            "code": 503, "message": str(e),
+                            "retry_after_ms": int(e.retry_after_s * 1000),
+                        },
+                    }))
                 except RpcError as e:
                     self._ws_send(pack({
                         "id": rid,
@@ -632,13 +803,39 @@ class SurrealHandler(BaseHTTPRequestHandler):
 
 def make_server(ds: Datastore, host="127.0.0.1", port=8000,
                 unauthenticated=False, tls_cert=None,
-                tls_key=None) -> ThreadingHTTPServer:
+                tls_key=None, max_inflight=None, queue_depth=None,
+                default_timeout_s=None) -> ThreadingHTTPServer:
+    from surrealdb_tpu import cnf
+    from surrealdb_tpu.server.admission import AdmissionController
+
+    if max_inflight is None:
+        max_inflight = cnf.HTTP_MAX_INFLIGHT
+    if queue_depth is None:
+        queue_depth = cnf.HTTP_QUEUE_DEPTH
+    if default_timeout_s is None:
+        default_timeout_s = cnf.HTTP_DEFAULT_TIMEOUT_S
+    admission = (
+        AdmissionController(max_inflight, queue_depth,
+                            telemetry=ds.telemetry)
+        if max_inflight and max_inflight > 0 else None
+    )
     handler = type("BoundHandler", (SurrealHandler,), {
         "ds": ds,
         "anon_level": "owner" if unauthenticated else "none",
+        "admission": admission,
+        "default_timeout_s": default_timeout_s or 0.0,
     })
+    # a deep accept backlog lets a connection burst reach admission
+    # control (typed 503 + Retry-After) instead of dying as kernel RSTs
+    # at the default listen(5)
+    class _HttpServer(ThreadingHTTPServer):
+        request_queue_size = 128
+        daemon_threads = True
+
     if not tls_cert:
-        return ThreadingHTTPServer((host, port), handler)
+        srv = _HttpServer((host, port), handler)
+        srv.admission = admission
+        return srv
     # TLS termination in-process (reference ntw: axum_server rustls from
     # --web-crt/--web-key). The handshake runs in the per-connection
     # handler thread — doing it inside accept() would let one stalled
@@ -648,7 +845,7 @@ def make_server(ds: Datastore, host="127.0.0.1", port=8000,
     sctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     sctx.load_cert_chain(tls_cert, tls_key)
 
-    class TlsServer(ThreadingHTTPServer):
+    class TlsServer(_HttpServer):
         def get_request(self):
             sock, addr = self.socket.accept()
             sock.settimeout(30)
@@ -673,13 +870,58 @@ def make_server(ds: Datastore, host="127.0.0.1", port=8000,
                 return  # failed/stalled handshakes are routine noise
             super().handle_error(request, client_address)
 
-    return TlsServer((host, port), handler)
+    srv = TlsServer((host, port), handler)
+    srv.admission = admission
+    return srv
+
+
+def drain_and_shutdown(srv, ds: Datastore, drain_timeout_s: float) -> bool:
+    """Graceful drain (the SIGTERM path): stop admitting — every new
+    request sheds with a retryable 503 — wait up to `drain_timeout_s`
+    for in-flight work, cooperatively cancel whatever remains, then stop
+    the accept loop. Returns True when everything finished inside the
+    budget (no cancellation needed)."""
+    admission = getattr(srv, "admission", None)
+    clean = True
+    if admission is not None:
+        clean = admission.drain(drain_timeout_s)
+    if not clean or admission is None:
+        ds.inflight.cancel_all()
+        # cancelled queries notice at their next check_deadline site;
+        # give them one beat to unwind before the socket goes away
+        end = time.monotonic() + 2.0
+        while ds.inflight.count() > 0 and time.monotonic() < end:
+            time.sleep(0.02)
+    srv.shutdown()
+    return clean
 
 
 def serve(ds: Datastore, host="127.0.0.1", port=8000, unauthenticated=False,
-          tls_cert=None, tls_key=None):
+          tls_cert=None, tls_key=None, max_inflight=None, queue_depth=None,
+          default_timeout_s=None, drain_timeout_s=None):
+    from surrealdb_tpu import cnf
+
     srv = make_server(ds, host, port, unauthenticated=unauthenticated,
-                      tls_cert=tls_cert, tls_key=tls_key)
+                      tls_cert=tls_cert, tls_key=tls_key,
+                      max_inflight=max_inflight, queue_depth=queue_depth,
+                      default_timeout_s=default_timeout_s)
+    if drain_timeout_s is None:
+        drain_timeout_s = cnf.DRAIN_TIMEOUT_S
+    # SIGTERM → graceful drain. shutdown() must run off the serving
+    # thread (it blocks until serve_forever returns), so the handler
+    # hands the drain to a helper thread and serve_forever unwinds.
+    import signal
+
+    def on_sigterm(_sig, _frm):
+        threading.Thread(
+            target=drain_and_shutdown, args=(srv, ds, drain_timeout_s),
+            daemon=True, name="surreal-drain",
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, on_sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded serve): no signal hook
     # served nodes join the cluster: heartbeat + membership GC loops
     # (reference engine/tasks.rs); embedded datastores stay single-node
     ds.start_node_tasks()

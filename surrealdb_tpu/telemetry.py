@@ -66,6 +66,13 @@ class Telemetry:
         self._local = threading.local()
         self._export_path = os.environ.get("SURREAL_TELEMETRY_FILE") or None
         self._export_lock = threading.Lock()
+        # gauges: name -> zero-arg callable sampled at scrape time (the
+        # admission controller and in-flight registry register theirs)
+        self.gauges: dict = {}
+
+    def register_gauge(self, name: str, fn):
+        with self.lock:
+            self.gauges[name] = fn
 
     # -- counters -----------------------------------------------------------
     # The remote-KV client records its resilience counters here:
@@ -155,6 +162,7 @@ class Telemetry:
             counters = dict(self.counters)
             hist = list(self.hist)
             hsum, hcount = self.hist_sum_ms, self.hist_count
+            gauges = dict(self.gauges)
         if ds is not None:
             for k, v in ds.metrics.items():
                 counter(f"surreal_ds_{k}_total", v,
@@ -165,6 +173,13 @@ class Telemetry:
             lines.append(f"surreal_vector_indexes {len(ds.vector_indexes)}")
         for k in sorted(counters):
             counter(f"surreal_{k}_total", counters[k])
+        for k in sorted(gauges):
+            try:
+                v = gauges[k]()
+            except Exception:
+                continue  # a dying provider must not poison the scrape
+            lines.append(f"# TYPE surreal_{k} gauge")
+            lines.append(f"surreal_{k} {v}")
         lines.append("# TYPE surreal_query_duration_ms histogram")
         acc = 0
         for i, edge in enumerate(_BUCKETS_MS):

@@ -20,6 +20,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running soak/chaos tests (tier-1 skips)"
+    )
+
+
 @pytest.fixture()
 def ds():
     """Datastore under test. SURREAL_TEST_BACKEND=remote runs every

@@ -125,3 +125,51 @@ def test_batch_error_propagates_to_every_rider():
         t.join(timeout=5.0)
     assert len(errs) == 3
     assert all(v == "kernel exploded" for v in errs.values()), errs
+
+
+def test_queued_waiter_respects_query_deadline():
+    """A query whose budget expires while parked behind an in-flight
+    dispatch must raise the timeout error promptly — not wait for the
+    batch — and withdraw its queue entry (edge-to-device deadline
+    propagation)."""
+    import pytest
+
+    from surrealdb_tpu import inflight
+    from surrealdb_tpu.err import QueryTimeout
+
+    ix = _FakeIndex()
+    ix.gate = threading.Event()  # first dispatch blocks until opened
+    co = _Coalescer(ix)
+    out = {}
+    t1 = threading.Thread(target=_search, args=(co, 1.0, out, "a"),
+                          daemon=True)
+    t1.start()
+    while not ix.calls:
+        time.sleep(0.005)  # first dispatch is now in flight (blocked)
+
+    reg = inflight.InflightRegistry()
+    h = reg.open("t", "t", "knn", deadline=time.monotonic() + 0.15)
+    err = {}
+
+    def rider():
+        with inflight.activate(h):
+            try:
+                co.search(np.array([2.0, 0.0]), 1)
+            except QueryTimeout as e:
+                err["e"] = e
+                err["t"] = time.monotonic()
+
+    t0 = time.monotonic()
+    t2 = threading.Thread(target=rider, daemon=True)
+    t2.start()
+    t2.join(timeout=3.0)
+    assert not t2.is_alive(), "expired rider still parked behind batch"
+    assert "e" in err, "rider should have timed out"
+    assert err["t"] - t0 < 1.0
+    assert h.timed_out
+    with co.cond:
+        assert not co.queue, "timed-out rider left its queue entry"
+    ix.gate.set()
+    t1.join(timeout=3.0)
+    assert out["a"] is not None
+    reg.close(h)

@@ -1,0 +1,599 @@
+"""Overload-protection coverage: admission control (bounded pool +
+bounded queue + typed 503 shedding), edge-to-KV deadlines
+(X-Surreal-Timeout / rpc timeout field), cooperative cancellation
+(KILL <query-id>, client disconnect), SIGTERM drain, the telemetry
+surface for all of it, and a KV-partition chaos test riding
+kvs/faults.py. The 64-client soak is marked slow."""
+
+import json
+import os
+import base64
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from surrealdb_tpu import Datastore
+from surrealdb_tpu.err import ShedError
+from surrealdb_tpu.server import (
+    drain_and_shutdown,
+    make_server,
+    parse_timeout,
+)
+from surrealdb_tpu.server.admission import AdmissionController
+
+NSDB = {"surreal-ns": "t", "surreal-db": "t"}
+
+
+@pytest.fixture()
+def small_server():
+    """2 worker slots + 1 queue slot: sheds at 4 concurrent requests."""
+    ds = Datastore("memory")
+    srv = make_server(ds, "127.0.0.1", 0, unauthenticated=True,
+                      max_inflight=2, queue_depth=1)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield ds, srv, f"http://127.0.0.1:{port}", port
+    try:
+        srv.shutdown()
+    except Exception:
+        pass
+
+
+def _post(base, path, body, headers=None, timeout=15):
+    req = urllib.request.Request(base + path, method="POST",
+                                 data=body.encode())
+    for k, v in {**NSDB, **(headers or {})}.items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _get(base, path, timeout=10):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+# -- admission controller unit ----------------------------------------------
+
+def test_admission_bounds_and_typed_shed():
+    ac = AdmissionController(max_inflight=2, queue_depth=1)
+    t1 = ac.admit()
+    t2 = ac.admit()
+    # the queue has one seat: a third waiter parks, a fourth sheds
+    seated = threading.Event()
+    got = []
+
+    def waiter():
+        seated.set()
+        tk = ac.admit()
+        got.append(tk)
+        tk.release()
+
+    w = threading.Thread(target=waiter, daemon=True)
+    w.start()
+    seated.wait()
+    time.sleep(0.05)  # let the waiter reach the queue
+    with pytest.raises(ShedError) as ei:
+        ac.admit()
+    assert ei.value.retry_after_s > 0
+    t1.release()
+    w.join(timeout=2)
+    assert not w.is_alive() and got, "queued waiter must get the freed slot"
+    t2.release()
+    got and got[0]
+
+
+def test_admission_deadline_unreachable_sheds_immediately():
+    ac = AdmissionController(max_inflight=1, queue_depth=8)
+    ac._ewma_s = 1.0  # recent queries take ~1s
+    tk = ac.admit()
+    # a queued request with 50ms of budget cannot cover a ~1s wait:
+    # it must shed NOW, not after burning its deadline in the queue
+    t0 = time.monotonic()
+    with pytest.raises(ShedError):
+        ac.admit(deadline=time.monotonic() + 0.05)
+    assert time.monotonic() - t0 < 0.05, "deadline shed must be immediate"
+    tk.release()
+
+
+def test_admission_drain_sheds_and_waits():
+    ac = AdmissionController(max_inflight=2, queue_depth=4)
+    tk = ac.admit()
+
+    def finish():
+        time.sleep(0.15)
+        tk.release()
+
+    threading.Thread(target=finish, daemon=True).start()
+    t0 = time.monotonic()
+    assert ac.drain(5.0) is True
+    assert 0.1 < time.monotonic() - t0 < 2.0
+    with pytest.raises(ShedError):
+        ac.admit()
+
+
+def test_parse_timeout_forms():
+    assert parse_timeout("500ms") == pytest.approx(0.5)
+    assert parse_timeout("2s") == pytest.approx(2.0)
+    assert parse_timeout("1m") == pytest.approx(60.0)
+    assert parse_timeout(1.5) == pytest.approx(1.5)
+    assert parse_timeout("0.25") == pytest.approx(0.25)
+    for bad in ("junk", "-1s", "0", True):
+        with pytest.raises(Exception):
+            parse_timeout(bad)
+
+
+# -- HTTP edge ----------------------------------------------------------------
+
+def test_burst_sheds_typed_503_never_500(small_server):
+    _ds, _srv, base, _port = small_server
+    results = []
+
+    def one():
+        results.append(_post(base, "/sql", "SLEEP 500ms"))
+
+    ts = [threading.Thread(target=one) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=20)
+    codes = sorted(s for s, _ in results)
+    assert 500 not in codes
+    assert codes.count(200) >= 2, codes
+    assert 503 in codes, codes
+    shed = json.loads(next(b for s, b in results if s == 503))
+    assert shed["code"] == 503 and shed["retry_after_ms"] >= 0
+    # health stays responsive while the pool is saturated
+    assert _get(base, "/health")[0] == 200
+
+
+def test_edge_timeout_header_bounds_query(small_server):
+    _ds, _srv, base, _port = small_server
+    t0 = time.monotonic()
+    st, body = _post(base, "/sql", "SLEEP 10s",
+                     {"X-Surreal-Timeout": "200ms"})
+    dt = time.monotonic() - t0
+    assert st == 200
+    out = json.loads(body)
+    assert out[0]["status"] == "ERR"
+    assert "exceeded the timeout" in out[0]["result"]
+    assert dt < 2.0, f"timeout took {dt:.2f}s for a 200ms budget"
+
+
+def test_edge_timeout_invalid_header_is_400(small_server):
+    _ds, _srv, base, _port = small_server
+    st, body = _post(base, "/sql", "RETURN 1",
+                     {"X-Surreal-Timeout": "tomorrow"})
+    assert st == 400
+    assert b"Invalid timeout" in body
+
+
+def test_statement_timeout_cannot_extend_edge_budget(small_server):
+    ds, _srv, base, _port = small_server
+    ds.execute("CREATE |ext:1..40| SET x = 1", ns="t", db="t")
+    ds.execute("DEFINE FUNCTION fn::slower() { SLEEP 40ms; RETURN true; }",
+               ns="t", db="t")
+    t0 = time.monotonic()
+    st, body = _post(
+        base, "/sql",
+        "SELECT * FROM ext WHERE fn::slower() TIMEOUT 1m;",
+        {"X-Surreal-Timeout": "200ms"},
+    )
+    dt = time.monotonic() - t0
+    out = json.loads(body)
+    assert out[0]["status"] == "ERR"
+    assert "timeout" in out[0]["result"]
+    assert dt < 2.0
+
+
+def test_kill_inflight_select_within_250ms(small_server):
+    ds, _srv, base, _port = small_server
+    ds.execute("CREATE |victim:1..40| SET x = 1", ns="t", db="t")
+    ds.execute("DEFINE FUNCTION fn::slow() { SLEEP 40ms; RETURN true; }",
+               ns="t", db="t")
+    out = {}
+
+    def run():
+        out["r"] = _post(base, "/sql",
+                         "SELECT * FROM victim WHERE fn::slow()")
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    # wait for the query to register
+    deadline = time.monotonic() + 5
+    qid = None
+    while time.monotonic() < deadline and qid is None:
+        snap = ds.inflight.snapshot()
+        for q in snap:
+            if "victim" in q["statement"]:
+                qid = q["id"]
+        time.sleep(0.01)
+    assert qid, "in-flight SELECT never registered"
+    t0 = time.monotonic()
+    st, body = _post(base, "/sql", f"KILL '{qid}'")
+    assert st == 200
+    t.join(timeout=5)
+    dt = time.monotonic() - t0
+    assert not t.is_alive()
+    res = json.loads(out["r"][1])
+    assert res[0]["status"] == "ERR"
+    assert "cancelled" in res[0]["result"]
+    assert dt < 0.25, f"kill took {dt * 1000:.0f}ms"
+    assert ds.telemetry.get("queries_killed") >= 1
+
+
+def test_client_disconnect_cancels_inflight(small_server):
+    ds, _srv, base, port = small_server
+    body = b"SLEEP 30s"
+    raw = (
+        f"POST /sql HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+        f"surreal-ns: t\r\nsurreal-db: t\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode() + body
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.sendall(raw)
+    # wait until it registers, then vanish
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not any(
+        "SLEEP" in q["statement"] for q in ds.inflight.snapshot()
+    ):
+        time.sleep(0.01)
+    assert any("SLEEP" in q["statement"] for q in ds.inflight.snapshot())
+    s.close()
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and ds.inflight.count() > 0:
+        time.sleep(0.02)
+    assert ds.inflight.count() == 0, \
+        "disconnected client's query still running"
+    assert ds.telemetry.get("queries_killed") >= 1
+
+
+def test_metrics_surface(small_server):
+    _ds, _srv, base, _port = small_server
+    _post(base, "/sql", "RETURN 1")
+    _post(base, "/sql", "SLEEP 10s", {"X-Surreal-Timeout": "50ms"})
+    st, m = _get(base, "/metrics")
+    text = m.decode()
+    for needle in (
+        "surreal_queries_admitted_total",
+        "surreal_queries_timed_out_total",
+        "surreal_inflight_queries",
+        "surreal_admission_queue_depth",
+        "surreal_admission_active",
+    ):
+        assert needle in text, f"missing {needle}\n{text}"
+    assert "# TYPE surreal_inflight_queries gauge" in text
+
+
+def test_sigterm_drain_finishes_inflight_then_stops():
+    ds = Datastore("memory")
+    srv = make_server(ds, "127.0.0.1", 0, unauthenticated=True,
+                      max_inflight=4, queue_depth=4)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+    results = []
+
+    def one():
+        results.append(_post(base, "/sql", "SLEEP 400ms"))
+
+    t = threading.Thread(target=one, daemon=True)
+    t.start()
+    time.sleep(0.1)  # in-flight
+    t0 = time.monotonic()
+    clean = drain_and_shutdown(srv, ds, 10.0)
+    dt = time.monotonic() - t0
+    assert clean is True
+    assert dt < 5.0
+    t.join(timeout=5)
+    # the in-flight query completed normally during the drain window
+    st, body = results[0]
+    assert st == 200 and json.loads(body)[0]["status"] == "OK"
+
+
+def test_drain_budget_cancels_stragglers():
+    ds = Datastore("memory")
+    srv = make_server(ds, "127.0.0.1", 0, unauthenticated=True,
+                      max_inflight=4, queue_depth=4)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+    results = []
+
+    def one():
+        results.append(_post(base, "/sql", "SLEEP 30s"))
+
+    t = threading.Thread(target=one, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    t0 = time.monotonic()
+    clean = drain_and_shutdown(srv, ds, 0.2)
+    dt = time.monotonic() - t0
+    assert clean is False, "a 30s query cannot drain in 200ms"
+    assert dt < 5.0
+    t.join(timeout=5)
+    assert not t.is_alive()
+    st, body = results[0]
+    out = json.loads(body)
+    assert out[0]["status"] == "ERR" and "cancelled" in out[0]["result"]
+
+
+# -- WebSocket edge -----------------------------------------------------------
+
+class _Ws:
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=10)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall(
+            (f"GET /rpc HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+             f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"Sec-WebSocket-Key: {key}\r\n"
+             f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += self.sock.recv(4096)
+        assert b"101" in resp.split(b"\r\n")[0]
+        self._id = 0
+
+    def call(self, method, params, **extra):
+        self._id += 1
+        payload = json.dumps({"id": self._id, "method": method,
+                              "params": params, **extra}).encode()
+        mask = os.urandom(4)
+        masked = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+        n = len(payload)
+        if n < 126:
+            hdr = b"\x81" + struct.pack("!B", 0x80 | n)
+        else:
+            hdr = b"\x81" + struct.pack("!BH", 0x80 | 126, n)
+        self.sock.sendall(hdr + mask + masked)
+        while True:
+            msg = self._recv()
+            if msg.get("id") == self._id:
+                return msg
+
+    def _recv(self):
+        def read(n):
+            out = b""
+            while len(out) < n:
+                chunk = self.sock.recv(n - len(out))
+                if not chunk:
+                    raise ConnectionError("closed")
+                out += chunk
+            return out
+
+        _b1, b2 = read(2)
+        n = b2 & 0x7F
+        if n == 126:
+            n = struct.unpack("!H", read(2))[0]
+        elif n == 127:
+            n = struct.unpack("!Q", read(8))[0]
+        return json.loads(read(n).decode())
+
+    def close(self):
+        self.sock.close()
+
+
+def test_ws_rpc_timeout_field(small_server):
+    _ds, _srv, _base, port = small_server
+    ws = _Ws(port)
+    try:
+        assert "result" in ws.call("use", ["t", "t"])
+        t0 = time.monotonic()
+        out = ws.call("query", ["SLEEP 10s"], timeout="200ms")
+        dt = time.monotonic() - t0
+        assert dt < 2.0
+        rows = out["result"]
+        assert rows[0]["status"] == "ERR"
+        assert "exceeded the timeout" in rows[0]["result"]
+    finally:
+        ws.close()
+
+
+# -- chaos: KV partition mid-query -------------------------------------------
+
+def test_kv_partition_fails_typed_before_deadline(monkeypatch):
+    from surrealdb_tpu import cnf
+    from surrealdb_tpu.kvs.faults import FaultProxy
+    from surrealdb_tpu.kvs.remote import serve_kv
+
+    monkeypatch.setattr(cnf, "KV_OP_TIMEOUT_S", 0.3)
+    monkeypatch.setattr(cnf, "KV_RETRY_DEADLINE_S", 10.0)
+    srv = serve_kv("127.0.0.1", 0, block=False)
+    proxy = FaultProxy(srv.server_address[:2]).start()
+    ds = None
+    try:
+        ds = Datastore(f"remote://{proxy.addr}")
+        ds.execute("CREATE |p:1..20| SET x = 1", ns="t", db="t")
+        proxy.partition()
+        out = {}
+
+        def run():
+            t0 = time.monotonic()
+            out["r"] = ds.execute("SELECT * FROM p", ns="t", db="t",
+                                  deadline=time.monotonic() + 1.5)
+            out["dt"] = time.monotonic() - t0
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), "partitioned query never returned"
+        err = out["r"][0].error
+        assert err is not None
+        # typed retryable failure (RetryableKvError surface) — the KV
+        # retry loop gave up inside the QUERY deadline, not the 10s
+        # policy deadline
+        assert "kv" in err.lower(), err
+        assert out["dt"] < 4.0, f"took {out['dt']:.1f}s for a 1.5s budget"
+        assert ds.inflight.count() == 0, "query thread not reclaimed"
+    finally:
+        proxy.heal()
+        if ds is not None:
+            try:
+                ds.close()
+            except Exception:
+                pass
+        proxy.stop()
+        try:
+            srv.shutdown()
+            srv.server_close()
+        except Exception:
+            pass
+
+
+# -- static pass --------------------------------------------------------------
+
+def test_robustness_static_pass_clean():
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_robustness", os.path.join(root, "tools",
+                                         "check_robustness.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    findings = mod.scan(root)
+    assert findings == [], "\n".join(findings)
+
+
+# -- soak (marked slow) -------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_64_clients_4_workers_sheds_never_500s():
+    ds = Datastore("memory")
+    srv = make_server(ds, "127.0.0.1", 0, unauthenticated=True,
+                      max_inflight=4, queue_depth=8)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+    ds.execute("CREATE |soak:1..200| SET x = rand::int(0, 100)",
+               ns="t", db="t")
+    results = []
+    lock = threading.Lock()
+
+    def client(i):
+        for _ in range(4):
+            st, body = _post(
+                base, "/sql",
+                "SELECT * FROM soak WHERE x >= 0; SLEEP 30ms;",
+                {"X-Surreal-Timeout": "10s"},
+            )
+            with lock:
+                results.append((st, body))
+
+    ts = [threading.Thread(target=client, args=(i,)) for i in range(64)]
+    n_threads_before = threading.active_count()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert all(not t.is_alive() for t in ts)
+    codes = [s for s, _ in results]
+    assert len(codes) == 64 * 4
+    assert 500 not in codes, "internal errors under burst"
+    assert codes.count(200) >= 16, "admitted queries must complete"
+    assert 503 in codes, "a 64-vs-4 burst must shed"
+    # every shed is typed
+    for st, body in results:
+        if st == 503:
+            d = json.loads(body)
+            assert d["code"] == 503 and "retry_after_ms" in d
+    # the server stays responsive and thread growth is bounded
+    assert _get(base, "/health")[0] == 200
+    st, body = _post(base, "/sql", "RETURN 1")
+    assert st == 200
+    time.sleep(0.5)
+    growth = threading.active_count() - n_threads_before
+    assert growth < 24, f"thread leak: {growth} residual threads"
+    assert ds.inflight.count() == 0
+    srv.shutdown()
+
+
+# -- review regressions -------------------------------------------------------
+
+def test_cancel_at_statement_boundary_poisons_explicit_txn():
+    """A cancel observed BETWEEN statements of an explicit transaction
+    must poison it: COMMIT must not persist the half-done work the
+    client was told was cancelled."""
+    import time as _time
+
+    from surrealdb_tpu import inflight
+
+    ds = Datastore("memory")
+    h = ds.inflight.open("t", "t", "txn", None)
+    h.cancel.set()  # cancel lands before the 2nd statement starts
+    with inflight.activate(h):
+        res = ds.execute(
+            "BEGIN; CREATE a:1; CREATE a:2; COMMIT;",
+            ns="t", db="t", handle=h,
+        )
+    ds.inflight.close(h)
+    errs = [r.error for r in res]
+    assert any(e and "cancelled" in e for e in errs), errs
+    # nothing committed: the table was never created (or is empty)
+    chk = ds.execute("SELECT * FROM a", ns="t", db="t")[0]
+    assert chk.error is not None or chk.result == [], \
+        f"half-committed rows survived a cancel: {chk.result}"
+
+
+def test_coalescer_rider_unblocks_on_kill_without_deadline():
+    """A KILLed query with NO deadline parked behind an in-flight
+    device dispatch must unwind promptly (50ms cancel slice)."""
+    import numpy as np
+
+    from surrealdb_tpu import inflight
+    from surrealdb_tpu.err import QueryCancelled
+    from surrealdb_tpu.idx.vector import _Coalescer
+
+    class _Ix:
+        def __init__(self):
+            self.lock = threading.RLock()
+            self.calls = []
+            self.gate = threading.Event()
+
+        def _device_knn_batch(self, qvs, kmax):
+            first = not self.calls
+            self.calls.append(qvs.shape[0])
+            if first:
+                assert self.gate.wait(5.0)
+            return [[(0.0, 0)] * kmax for _ in qvs]
+
+    ix = _Ix()
+    co = _Coalescer(ix)
+    out = {}
+    t1 = threading.Thread(
+        target=lambda: out.update(a=co.search(np.zeros(2), 1)),
+        daemon=True)
+    t1.start()
+    while not ix.calls:
+        time.sleep(0.005)
+    reg = __import__("surrealdb_tpu.inflight", fromlist=["x"])
+    h = reg.InflightRegistry().open("t", "t", "knn", None)  # no deadline
+    err = {}
+
+    def rider():
+        with inflight.activate(h):
+            try:
+                co.search(np.ones(2), 1)
+            except QueryCancelled as e:
+                err["e"] = e
+
+    t2 = threading.Thread(target=rider, daemon=True)
+    t2.start()
+    time.sleep(0.1)
+    h.cancel.set()
+    t2.join(timeout=2.0)
+    assert not t2.is_alive(), "killed rider still parked behind dispatch"
+    assert "e" in err and h.cancelled
+    ix.gate.set()
+    t1.join(timeout=3.0)

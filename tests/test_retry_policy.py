@@ -163,3 +163,72 @@ def test_remote_tx_init_failure_no_unraisable():
     finally:
         sys.unraisablehook = old_hook
     assert not captured, f"unraisable exception(s) during GC: {captured}"
+
+
+# -- query-deadline capping (edge-to-KV deadline propagation) ----------------
+
+def test_query_deadline_caps_retry_deadline():
+    """A RetryPolicy running INSIDE a query must not outlive the query:
+    min(policy deadline, query remaining budget)."""
+    import time
+
+    from surrealdb_tpu import inflight
+
+    reg = inflight.InflightRegistry()
+    h = reg.open("t", "t", "SELECT 1", deadline=time.monotonic() + 0.15)
+    policy = RetryPolicy(deadline_s=30.0, base_ms=5, max_ms=10, jitter=0.0)
+
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ConnectionError("kv down")
+
+    t0 = time.monotonic()
+    with inflight.activate(h):
+        assert policy.effective_deadline_s() <= 0.15
+        with pytest.raises(RetryableKvError):
+            policy.run(fn)
+    dt = time.monotonic() - t0
+    reg.close(h)
+    assert dt < 2.0, f"retries ran {dt:.2f}s past a 150ms query budget"
+    assert len(calls) >= 2, "should have retried at least once"
+
+
+def test_no_query_context_uses_policy_deadline():
+    clock, sleep, sleeps = _fake_timeline()
+    policy = RetryPolicy(deadline_s=3.0, base_ms=100, max_ms=100,
+                         jitter=0.0, clock=clock, sleep=sleep)
+    assert policy.effective_deadline_s() == 3.0
+
+    def fn():
+        raise ConnectionError("down")
+
+    with pytest.raises(RetryableKvError):
+        policy.run(fn)
+    assert sum(sleeps) == pytest.approx(3.0)
+
+
+def test_cancelled_query_stops_kv_retries():
+    import time
+
+    from surrealdb_tpu import inflight
+
+    reg = inflight.InflightRegistry()
+    h = reg.open("t", "t", "SELECT 1", deadline=time.monotonic() + 30.0)
+    policy = RetryPolicy(deadline_s=30.0, base_ms=5, max_ms=10, jitter=0.0)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) == 2:
+            h.cancel.set()  # KILL arrives mid-backoff
+        raise ConnectionError("kv down")
+
+    t0 = time.monotonic()
+    with inflight.activate(h):
+        with pytest.raises(RetryableKvError):
+            policy.run(fn)
+    reg.close(h)
+    assert time.monotonic() - t0 < 2.0
+    assert len(calls) <= 4, "a cancelled query must stop retrying"

@@ -1,0 +1,149 @@
+"""Static robustness pass (run standalone or from the conformance gate).
+
+Enforces the overload-protection invariants that code review keeps
+re-litigating:
+
+1. **No bare `except:`** anywhere in `surrealdb_tpu/` — a bare handler
+   swallows KeyboardInterrupt/SystemExit and, worse, the cooperative
+   QueryCancelled/QueryTimeout signals the robustness layer depends on.
+2. **No non-daemon `Thread(...)`** without an explicit join path — a
+   forgotten non-daemon thread blocks process exit and defeats SIGTERM
+   drain. `daemon=True`, or a `# robust: joined` pragma on the call
+   line for threads with a managed join, satisfies the check.
+3. **No `check_deadline`-free streaming operators** — every `*Op` class
+   in `exec/stream.py` whose `_execute` loops must either call
+   `ctx.check_deadline()` itself or drain a child's `.execute(ctx)`
+   (which propagates to a deadline-checking scan). Otherwise a new
+   operator silently reopens the unbounded-loop hole.
+
+Usage:  python tools/check_robustness.py [root]
+Exit status 1 when any finding survives.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+PRAGMA = "# robust:"
+
+
+def _pragma(lines: list[str], lineno: int) -> bool:
+    """True when the 1-based source line carries a `# robust:` waiver."""
+    if 1 <= lineno <= len(lines):
+        return PRAGMA in lines[lineno - 1]
+    return False
+
+
+def _is_thread_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == "Thread"
+    if isinstance(f, ast.Attribute):
+        return f.attr == "Thread"
+    return False
+
+
+def _calls_attr(tree: ast.AST, attr: str) -> bool:
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == attr:
+            return True
+    return False
+
+
+def check_file(path: str, rel: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: syntax error: {e.msg}"]
+    findings = []
+    for node in ast.walk(tree):
+        # 1. bare except
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            if not _pragma(lines, node.lineno):
+                findings.append(
+                    f"{rel}:{node.lineno}: bare `except:` swallows "
+                    f"cancellation — name the exception types"
+                )
+        # 2. non-daemon Thread without a join pragma
+        if isinstance(node, ast.Call) and _is_thread_call(node):
+            daemon = next(
+                (kw for kw in node.keywords if kw.arg == "daemon"), None
+            )
+            is_daemon = (
+                daemon is not None
+                and isinstance(daemon.value, ast.Constant)
+                and daemon.value.value is True
+            )
+            if not is_daemon and not _pragma(lines, node.lineno):
+                findings.append(
+                    f"{rel}:{node.lineno}: non-daemon Thread() without "
+                    f"`daemon=True` or a `# robust: joined` pragma — "
+                    f"blocks SIGTERM drain"
+                )
+    # 3. streaming operators must stay deadline-checked
+    if rel.endswith(os.path.join("exec", "stream.py")):
+        for node in ast.iter_child_nodes(tree):
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name.endswith("Op")):
+                continue
+            ex = next(
+                (n for n in node.body
+                 if isinstance(n, ast.FunctionDef)
+                 and n.name == "_execute"),
+                None,
+            )
+            if ex is None:
+                continue
+            has_loop = any(
+                isinstance(n, (ast.For, ast.While)) for n in ast.walk(ex)
+            )
+            if not has_loop:
+                continue
+            ok = _calls_attr(ex, "check_deadline") or _calls_attr(
+                ex, "execute"
+            )
+            if not ok and not _pragma(lines, node.lineno):
+                findings.append(
+                    f"{rel}:{node.lineno}: streaming operator "
+                    f"{node.name}._execute loops without "
+                    f"ctx.check_deadline() or a child .execute(ctx) — "
+                    f"unbounded under KILL/timeout"
+                )
+    return findings
+
+
+def scan(root: str) -> list[str]:
+    pkg = os.path.join(root, "surrealdb_tpu")
+    findings: list[str] = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, fn)
+            findings.extend(check_file(p, os.path.relpath(p, root)))
+    return findings
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."
+    )
+    findings = scan(root)
+    for f in findings:
+        print(f"ROBUSTNESS {f}")
+    if findings:
+        print(f"robustness check: {len(findings)} finding(s)")
+        return 1
+    print("robustness check: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

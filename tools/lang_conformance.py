@@ -99,7 +99,15 @@ def main():
         print("\n== first failures ==")
         for rel, detail in fail_list[: args.failures]:
             print(f"-- {rel}\n   {detail.splitlines()[0][:200]}")
-    return 0
+    # static robustness pass rides the conformance gate so a bare
+    # except / non-daemon thread / unchecked streaming loop fails the
+    # same command every pre-commit run already uses
+    import check_robustness
+
+    rc = check_robustness.main([os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."
+    )])
+    return rc
 
 
 if __name__ == "__main__":
