@@ -99,6 +99,37 @@ def main(argv=None):
                            "guards acked writes; lose the single-node "
                            "power-failure guarantee)")
 
+    p_adm = sub.add_parser(
+        "kv-admin",
+        help="administer a range-sharded KV cluster (init/split/topology)",
+    )
+    adm = p_adm.add_subparsers(dest="adm_cmd", required=True)
+    a_init = adm.add_parser(
+        "init", help="bootstrap shard topology onto running KV groups"
+    )
+    a_init.add_argument(
+        "--groups", required=True,
+        help="';'-separated replication groups in shard order, each a "
+             "','-separated host:port list; group 0 is the meta shard")
+    a_init.add_argument(
+        "--shard-ranges", default="",
+        help="','-separated split keys (N-1 keys for N groups), UTF-8; "
+             "prefix a key with hex: for raw bytes")
+    a_split = adm.add_parser(
+        "split", help="split the range containing KEY at KEY onto a "
+                      "new (running, empty) group")
+    a_split.add_argument("key",
+                         help="split key (UTF-8; hex: prefix for raw "
+                              "bytes)")
+    a_split.add_argument("--meta", required=True,
+                         help="meta-shard addresses host:port[,host:port]")
+    a_split.add_argument("--to", required=True,
+                         help="','-separated addresses of the group "
+                              "taking the upper range")
+    a_top = adm.add_parser("topology", help="print the current shard map")
+    a_top.add_argument("--meta", required=True,
+                       help="meta-shard addresses host:port[,host:port]")
+
     p_up = sub.add_parser(
         "upgrade", help="migrate a store's on-disk format to this release"
     )
@@ -178,6 +209,38 @@ def main(argv=None):
                  failover_timeout_s=args.failover_timeout,
                  lease_ttl_s=args.lease_ttl)
         return 0
+
+    if args.cmd == "kv-admin":
+        from surrealdb_tpu.kvs import shard as shard_admin
+
+        def _key(s: str) -> bytes:
+            if s.startswith("hex:"):
+                return bytes.fromhex(s[4:])
+            return s.encode("utf-8")
+
+        def _print_map(m):
+            print(f"shard map epoch {m.epoch}: {len(m.shards)} range(s)")
+            for s in m.shards:
+                hi = "inf" if s.end is None else repr(s.end)
+                print(f"  [{s.beg!r}, {hi}) epoch={s.epoch} "
+                      f"group={','.join(s.addrs)}")
+
+        if args.adm_cmd == "init":
+            groups = [[a.strip() for a in g.split(",") if a.strip()]
+                      for g in args.groups.split(";") if g.strip()]
+            splits = [_key(s) for s in args.shard_ranges.split(",")
+                      if s]
+            m = shard_admin.init_topology(groups, splits)
+            _print_map(m)
+            return 0
+        if args.adm_cmd == "split":
+            to = [a.strip() for a in args.to.split(",") if a.strip()]
+            m = shard_admin.split_shard(args.meta, _key(args.key), to)
+            _print_map(m)
+            return 0
+        if args.adm_cmd == "topology":
+            _print_map(shard_admin.read_topology(args.meta))
+            return 0
 
     from surrealdb_tpu import Datastore
 

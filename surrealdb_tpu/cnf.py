@@ -86,6 +86,23 @@ KV_LEASE_TTL_S = env_float("SURREAL_KV_LEASE_TTL_S", 6.0)
 # the promotion protocol (lease check -> peer survey -> self-promote)
 KV_FAILOVER_TIMEOUT_S = env_float("SURREAL_KV_FAILOVER_TIMEOUT_S", 8.0)
 
+# -- range sharding / cross-shard 2PC (kvs/shard.py, kvs/remote.py) ----------
+# versionstamps for a sharded store come in windows leased from the meta
+# shard (PD-style TSO): one meta round-trip hands out this many stamps.
+# A leased window EXPIRES after the TTL: an idle node discards its
+# remainder and re-leases, which bounds how stale a stamp can be
+# relative to other nodes' commits (a changefeed cursor that advanced
+# past an abandoned window must not see older stamps appear later).
+KV_TSO_WINDOW = env_int("SURREAL_KV_TSO_WINDOW", 512)
+KV_TSO_WINDOW_TTL_S = env_float("SURREAL_KV_TSO_WINDOW_TTL_S", 5.0)
+# a staged prepare whose coordinator has been silent this long is an
+# orphan: the participant resolves it through the meta commit log,
+# claiming abort if no decision was recorded
+KV_2PC_ORPHAN_GRACE_S = env_float("SURREAL_KV_2PC_ORPHAN_GRACE_S", 5.0)
+KV_2PC_RESOLVE_INTERVAL_S = env_float(
+    "SURREAL_KV_2PC_RESOLVE_INTERVAL_S", 0.5
+)
+
 # -- accelerator backend init watchdog (bench.py / __graft_entry__.py) -------
 # device discovery that exceeds this degrades to CPU instead of hanging
 BACKEND_INIT_TIMEOUT_S = env_float("SURREAL_BACKEND_INIT_TIMEOUT_S", 240.0)

@@ -5146,7 +5146,21 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             pass
         import jax as _jax
 
-        return {
+        # shard topology (kvs/shard.py): ranges, epochs, primaries —
+        # None/absent on unsharded stores. topology() serves the
+        # last-known map without network I/O, so this can't stall INFO;
+        # SdbError only covers the never-initialised-map edge.
+        from surrealdb_tpu.err import (
+            QueryCancelled as _QC, QueryTimeout as _QT,
+        )
+
+        try:
+            shard_topo = ctx.ds.backend.topology()
+        except (_QC, _QT):
+            raise  # cancellation must never be absorbed by INFO
+        except SdbError:
+            shard_topo = None
+        out = {
             "available_parallelism": _os.cpu_count() or 1,
             "cpu_usage": 0.0,
             "load_average": list(_os.getloadavg()),
@@ -5166,6 +5180,9 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             # KILL <query-id> target (inflight.py)
             "queries": ctx.ds.inflight.snapshot(),
         }
+        if shard_topo is not None:
+            out["shards"] = shard_topo
+        return out
     if n.level == "root":
         out = {"accesses": {}, "namespaces": {}, "nodes": {}, "system": {},
                "users": {}}

@@ -76,6 +76,12 @@ class Backend:
     def transaction(self, write: bool) -> BackendTx:
         raise NotImplementedError
 
+    def topology(self):
+        """Shard topology of this backend, or None for an unsharded
+        store. The range-sharded router (kvs/shard.py) overrides this;
+        INFO FOR SYSTEM and the /kv/topology route surface it."""
+        return None
+
     def close(self) -> None:
         pass
 

@@ -130,6 +130,14 @@ class Datastore:
 
             self.backend = RemoteBackend(path.split("://", 1)[1],
                                          telemetry=self.telemetry)
+        elif path.startswith("shard://"):
+            # range-sharded distributed mode: the address list names the
+            # META group (shard 0); the shard map is read from there and
+            # reads/commits route by key range (kvs/shard.py)
+            from surrealdb_tpu.kvs.shard import ShardedBackend
+
+            self.backend = ShardedBackend(path.split("://", 1)[1],
+                                          telemetry=self.telemetry)
         else:
             raise SdbError(f"unknown datastore path: {path!r}")
         # cross-transaction caches / engines
@@ -184,8 +192,15 @@ class Datastore:
         self._catalog_ver = 0
         self._catalog_shared = (0, {})
         from surrealdb_tpu.kvs.remote import RemoteBackend as _RB
+        from surrealdb_tpu.kvs.shard import ShardedBackend as _SB
 
-        self._local_catalog_cache = not isinstance(self.backend, _RB)
+        self._local_catalog_cache = not isinstance(self.backend, (_RB, _SB))
+        # TSO window state (sharded stores lease versionstamp windows
+        # from the meta shard instead of running a local HLC); windows
+        # expire so an idle node can't stamp far in the logical past
+        self._tso_next = 0
+        self._tso_end = 0
+        self._tso_expiry = 0.0
         self._stamp_storage_version(check_version)
 
     def start_node_tasks(self, interval_s: float = 10.0,
@@ -360,7 +375,42 @@ class Datastore:
         HlcTimeStamp): [44-bit wall millis | 20-bit logical counter].
         Monotonic even when the wall clock stalls or steps backwards —
         the logical counter advances within a millisecond, and the
-        physical part never regresses below the last issued stamp."""
+        physical part never regresses below the last issued stamp.
+
+        Sharded stores instead draw from a sequence window leased from
+        the meta shard (PD-style TSO, kvs/shard.py): per-node HLCs
+        could interleave inconsistently across shards, but windows off
+        one counter keep `SHOW CHANGES` ordering globally consistent.
+        Window starts embed wall millis in the same [44|20] layout, so
+        stamps stay comparable to datetime-derived bounds."""
+        tso = getattr(self.backend, "tso_window", None)
+        if tso is not None:
+            now = time.monotonic()
+            with self.lock:
+                if self._tso_next < self._tso_end \
+                        and now < self._tso_expiry:
+                    v = self._tso_next
+                    self._tso_next += 1
+                    return v
+                # an expired window is abandoned, not drained: a
+                # changefeed cursor may already have advanced past it,
+                # and stamps issued behind the cursor would be silently
+                # skipped by SHOW CHANGES consumers — staleness is
+                # bounded by the window TTL
+                self._tso_end = 0
+            # refill outside ds.lock: one meta round-trip per window
+            start, end = tso(cnf.KV_TSO_WINDOW)
+            with self.lock:
+                if self._tso_next >= self._tso_end:
+                    # windows are disjoint and strictly increasing, so
+                    # adopting a fresh one never regresses; a racing
+                    # refill that lost simply wastes its window
+                    self._tso_next, self._tso_end = start, end
+                    self._tso_expiry = (time.monotonic()
+                                        + cnf.KV_TSO_WINDOW_TTL_S)
+                v = self._tso_next
+                self._tso_next += 1
+                return v
         with self.lock:
             wall = int(time.time() * 1000)
             if wall > self._hlc_wall:

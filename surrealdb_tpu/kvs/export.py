@@ -3,7 +3,13 @@
 
 Export emits a re-runnable SurrealQL script: OPTION header, DEFINE statements
 from the catalog (canonical render_def text), then INSERT statements per
-table in record order."""
+table in record order.
+
+Every read goes through the datastore's `Backend` transaction, so on a
+range-sharded store (kvs/shard.py) each `scan_vals` is a cross-shard
+ordered scan: ranges are visited in key order and stitched, which keeps
+the dump byte-identical to an unsharded export of the same data
+(tests/test_shard.py::test_export_sharded_matches_unsharded)."""
 
 from __future__ import annotations
 

@@ -95,6 +95,14 @@ class VersionedStore:
                     break
             return out
 
+    def read_latest(self, key: bytes) -> Optional[bytes]:
+        """Newest committed value for one key (no snapshot pin) — serves
+        the sharding metadata reads (shard map / commit-log decisions)
+        where the caller wants the latest state, not a snapshot."""
+        with self.lock:
+            chain = self.chains.get(key)
+            return None if chain is None else chain[-1][1]
+
     def latest_items(self):
         """(key, value) pairs of the newest committed state (for snapshots/
         compaction/export). Tombstoned keys are skipped."""

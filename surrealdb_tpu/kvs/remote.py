@@ -41,6 +41,19 @@ peers — replication links authenticate with the same secret — and the
 value codec's pickle fallback is import-restricted (kvs/api.py) so
 stored bytes can't smuggle arbitrary code objects.
 
+Sharding (kvs/shard.py rides this module): a KvServer can be fenced to
+one key range of a range-sharded keyspace (`shard_set`, persisted and
+replicated as an internal \x00!shardcfg row). Ops on keys outside the
+assigned range answer "kv wrong shard epoch" so a stale client refreshes
+its shard map. Cross-shard transactions use the 2PC participant ops
+(`prepare`/`decide`): a prepare stages the writeset as ONE ordinary MVCC
+commit of a \x00!prep/<txid> record — WAL durability and synchronous
+replica ship come for free — and write-locks the staged keys until the
+decision. The coordinator's decision lives in a first-writer-wins
+commit-log row on the meta shard (`txn_mark`); a participant whose
+coordinator went quiet resolves through that record, claiming abort when
+none exists.
+
 Connection model: each transaction pins ONE pooled connection for its
 lifetime, so the server's per-connection snapshot accounting is exact —
 a dying client's pins are released on disconnect, and releases can never
@@ -65,10 +78,22 @@ from typing import Callable, Optional
 from surrealdb_tpu import cnf
 from surrealdb_tpu.err import RetryableKvError, SdbError
 from surrealdb_tpu.kvs.api import Backend, BackendTx
-from surrealdb_tpu.kvs.mem import VersionedStore
+from surrealdb_tpu.kvs.mem import CONFLICT_MSG, VersionedStore
 
 _HDR = struct.Struct(">I")
 MAX_FRAME = 256 << 20
+
+# -- sharding metadata keyspace (kvs/shard.py rides these) ------------------
+# Internal keys live under the \x00 prefix: every user-visible key this
+# package generates starts with "/" (key/__init__.py), so the internal
+# namespace sorts before all data, never collides, and is exempt from
+# shard-range enforcement (a prepare record must live on its participant
+# shard regardless of that shard's assigned range).
+SHARD_CFG_KEY = b"\x00!shardcfg"  # this server's (beg, end, epoch)
+SHARD_MAP_KEY = b"\x00!shardmap"  # cluster shard map (meta shard only)
+PREP_PREFIX = b"\x00!prep/"  # staged 2PC writesets, one per txid
+TXNLOG_PREFIX = b"\x00!txnlog/"  # coordinator decisions (meta shard)
+INF_END = b"\xff" * 9  # "end of keyspace" sentinel (matches compaction)
 
 
 def _send_frame(sock, payload: bytes):
@@ -125,8 +150,14 @@ def is_retryable(e: BaseException) -> bool:
         return True
     if isinstance(e, SdbError):
         m = str(e)
+        # "wrong shard epoch" / "shard unavailable" are topology errors:
+        # retryable, and the router marks its shard map stale the moment
+        # one arrives — reads refresh + re-route inline, an aborted
+        # write transaction's retry starts against the refreshed map
         return ("kv not primary" in m or "kv connection lost" in m
-                or "kv service unreachable" in m)
+                or "kv service unreachable" in m
+                or "kv wrong shard epoch" in m
+                or "kv shard unavailable" in m)
     if isinstance(e, (ConnectionError, socket.timeout, TimeoutError)):
         return True
     if isinstance(e, OSError):
@@ -181,12 +212,22 @@ class RetryPolicy:
             return self.deadline_s
         return min(self.deadline_s, max(q, 0.0))
 
-    def run(self, fn, telemetry=None):
+    def run(self, fn, telemetry=None, on_retry=None):
         """Call `fn` until it succeeds, a non-retryable error surfaces,
         or the deadline expires (raises RetryableKvError chaining the
         last transport error). The effective deadline is
         min(policy deadline, calling query's remaining budget), and a
-        cancelled query stops retrying immediately."""
+        cancelled query stops retrying immediately.
+
+        `on_retry(e, attempt)` runs before each retry; returning True
+        skips the backoff sleep for that attempt. It exists for callers
+        whose retried operation can be FIXED between attempts (e.g.
+        refreshing a stale shard map on "wrong shard epoch") — such
+        errors are topology, not congestion, so the corrected attempt
+        should go out immediately instead of burning the caller's
+        deadline inside an exponential backoff. (The shard router's
+        in-transaction paths refresh inline instead: a consumed
+        snapshot can't be retried at this level.)"""
         from surrealdb_tpu.inflight import cancelled as _q_cancelled
 
         deadline_s = self.effective_deadline_s()
@@ -210,7 +251,14 @@ class RetryPolicy:
                     ) from e
                 if telemetry is not None:
                     telemetry.inc("kv_retries")
-                self.sleep(min(self.backoff(attempt), remaining))
+                skip_backoff = False
+                if on_retry is not None:
+                    try:
+                        skip_backoff = bool(on_retry(e, attempt))
+                    except BaseException:
+                        pass  # a failed refresh falls back to backoff
+                if not skip_backoff:
+                    self.sleep(min(self.backoff(attempt), remaining))
                 attempt += 1
 
 
@@ -265,9 +313,24 @@ class _KvHandler(socketserver.BaseRequestHandler):
         srv: KvServer = self.server
         op = req[0]
         if op == "get":
+            srv.shard_check_keys((req[1],))
             return ["ok", vs.read(req[1], req[2])]
+        if op == "get_latest":
+            # latest committed value, no snapshot pin: shard-map and
+            # commit-log reads want current metadata, not a snapshot
+            return ["ok", vs.read_latest(req[1])]
         if op == "range":
             _op, beg, end, snap, limit, reverse = req
+            srv.shard_check_range(beg, end)
+            if beg[:1] != b"\x00":
+                # internal \x00-prefixed metadata (shard cfg, staged
+                # prepares, commit log, TSO) is invisible to data scans:
+                # an unsharded store has no such rows, and a sharded one
+                # must scan byte-identically to it. The whole reserved
+                # namespace sorts first, so clamping beg excludes it
+                # exactly (limits stay precise in both directions).
+                beg = max(beg, b"\x01")
+                end = max(end, beg)
             items = vs.range_items(beg, end, snap, limit, bool(reverse))
             return ["ok", [[k, v] for k, v in items]]
         if op == "snap":
@@ -299,10 +362,95 @@ class _KvHandler(socketserver.BaseRequestHandler):
             # hold: recovery replays commits in exactly apply order, and
             # an acked write is on every attached replica
             with srv.wal_lock:
+                try:
+                    srv.shard_check_keys(writes)
+                    srv.check_locks(writes)
+                except SdbError:
+                    vs.release(snap)  # vs.commit would have released it
+                    raise
                 ver = vs.commit(writes, snap)  # SdbError on conflict
                 srv.log_commit(writes)
                 srv._ship(writes)
             return ["ok", ver]
+        if op == "prepare":
+            # 2PC phase 1: validate + stage this participant's writeset
+            _op, txid, pairs, snap, meta_addrs = req
+            if srv.role != "primary":
+                raise SdbError(srv.not_primary_msg())
+            writes = {
+                bytes(k): (None if v is None else bytes(v))
+                for k, v in pairs
+            }
+            # prepare consumes the snapshot exactly like commit does
+            if owned[snap] > 0:
+                owned[snap] -= 1
+                if not owned[snap]:
+                    del owned[snap]
+            else:
+                raise SdbError("kv prepare: unknown snapshot")
+            srv.prepare_txn(str(txid), writes, snap, list(meta_addrs))
+            return ["ok", None]
+        if op == "decide":
+            # 2PC phase 2 (or abort): apply/drop a staged writeset
+            _op, txid, decision = req
+            if srv.role != "primary":
+                raise SdbError(srv.not_primary_msg())
+            if decision not in ("commit", "abort"):
+                raise SdbError(f"kv decide: bad decision {decision!r}")
+            return ["ok", srv.decide_txn(str(txid), decision)]
+        if op == "txn_mark":
+            # commit-log decision record (meta shard): first writer wins,
+            # everyone else learns the recorded decision
+            _op, txid, want = req
+            if srv.role != "primary":
+                raise SdbError(srv.not_primary_msg())
+            if want not in ("commit", "abort"):
+                raise SdbError(f"kv txn_mark: bad decision {want!r}")
+            return ["ok", srv.txn_mark(str(txid), want)]
+        if op == "shard_set":
+            _op, beg, end, epoch = req
+            if srv.role != "primary":
+                raise SdbError(srv.not_primary_msg())
+            srv.shard_set(
+                bytes(beg), None if end is None else bytes(end), int(epoch)
+            )
+            return ["ok", None]
+        if op == "shard_items":
+            # admin (split copy): latest items in a range, IGNORING the
+            # shard bounds — the fenced-off slice is exactly what moves.
+            # Paged: the response stops at `limit` items or ~8MB of
+            # values, whichever first; the caller continues from the
+            # last returned key until an empty page. One giant slice
+            # must never have to fit in a single MAX_FRAME response.
+            _op, beg, end = req[:3]
+            limit = req[3] if len(req) > 3 else None
+            # internal \x00 rows never move with a slice; clamping keeps
+            # `limit` exact (the reserved namespace sorts first)
+            beg = max(bytes(beg), b"\x01")
+            snap2 = vs.snapshot()
+            try:
+                items = vs.range_items(
+                    beg, INF_END if end is None else bytes(end),
+                    snap2, limit, False,
+                )
+            finally:
+                vs.release(snap2)
+            out, total = [], 0
+            for k, v in items:
+                out.append([k, v])
+                total += len(k) + len(v)
+                if total >= (8 << 20):
+                    break
+            return ["ok", out]
+        if op == "shard_purge":
+            # admin (post-split GC): tombstone the moved, now-unroutable
+            # slice on the source group
+            _op, beg, end = req
+            if srv.role != "primary":
+                raise SdbError(srv.not_primary_msg())
+            return ["ok", srv.shard_purge(
+                bytes(beg), None if end is None else bytes(end)
+            )]
         if op == "seed":
             if srv.role != "primary":
                 raise SdbError(srv.not_primary_msg())
@@ -489,8 +637,17 @@ class KvServer(socketserver.ThreadingTCPServer):
         self._monitor_stop: Optional[threading.Event] = None
         self.conn_lock = threading.Lock()
         self.active_conns: set = set()
+        # -- sharding / 2PC state (kvs/shard.py) --
+        # shard = (beg, end|None, epoch); None = unsharded, serve all keys
+        self.shard: Optional[tuple] = None
+        self.staged: dict = {}  # txid -> {key: val|None} (prepared)
+        self.staged_meta: dict = {}  # txid -> (meta_addrs, staged_at_mono)
+        self.locks: dict = {}  # key -> txid holding a prepared write
+        self.orphan_grace_s = cnf.KV_2PC_ORPHAN_GRACE_S
+        self._resolver_stop: Optional[threading.Event] = None
         if data_dir:
             self._recover()
+        self._load_shard_state()
         if peers is not None:
             self.configure_cluster(peers, self_index, role=role,
                                    auto_failover=auto_failover)
@@ -555,8 +712,250 @@ class KvServer(socketserver.ThreadingTCPServer):
                         else self.primary_addr),
             "attached_replicas": (self.repl.attached_count()
                                   if self.repl else 0),
+            "shard": (None if self.shard is None
+                      else [self.shard[0], self.shard[1], self.shard[2]]),
+            "staged_txns": len(self.staged),
             "counters": counters,
         }
+
+    # -- sharding: range enforcement + 2PC participant ----------------------
+
+    def wrong_shard_msg(self) -> str:
+        beg, end, epoch = self.shard
+        return (f"kv wrong shard epoch: this group serves "
+                f"[{beg!r}, {'inf' if end is None else repr(end)}) at "
+                f"epoch {epoch}; refresh the shard map")
+
+    def shard_check_keys(self, keys) -> None:
+        """Reject keys outside this server's assigned range (a client
+        routing with a stale shard map). Internal \\x00-prefixed keys are
+        exempt: prepare records / commit-log rows / the shard map itself
+        must land wherever their role requires."""
+        if self.shard is None:
+            return
+        beg, end, _epoch = self.shard
+        for k in keys:
+            if k[:1] == b"\x00":
+                continue
+            if k < beg or (end is not None and k >= end):
+                raise SdbError(self.wrong_shard_msg())
+
+    def shard_check_range(self, beg: bytes, end: bytes) -> None:
+        if self.shard is None or beg[:1] == b"\x00":
+            return
+        sbeg, send, _epoch = self.shard
+        if beg < sbeg or (send is not None and end > send):
+            raise SdbError(self.wrong_shard_msg())
+
+    def check_locks(self, writes) -> None:
+        """A key staged by an in-flight 2PC prepare is write-locked until
+        its decision lands: conflicting optimistic commits abort
+        retryably (by then the resolver or coordinator has decided)."""
+        if self.locks and any(k in self.locks for k in writes):
+            raise SdbError(CONFLICT_MSG)
+
+    def prepare_txn(self, txid: str, writes: dict, snap: int,
+                    meta_addrs: list) -> None:
+        """Phase 1: validate the writeset at `snap` (same optimistic
+        check as commit), then stage it as one MVCC commit of a single
+        \\x00!prep/<txid> record — WAL append and synchronous replica
+        ship ride the normal commit path, so a staged prepare survives
+        primary failover exactly like an acked write."""
+        prep_key = PREP_PREFIX + txid.encode()
+        blob = _encode([txid, [[k, v] for k, v in writes.items()],
+                        list(meta_addrs), time.time()])
+        with self.wal_lock:
+            with self.vs.lock:
+                try:
+                    self.shard_check_keys(writes)
+                    for k in writes:
+                        if self.locks.get(k, txid) != txid:
+                            raise SdbError(CONFLICT_MSG)
+                        chain = self.vs.chains.get(k)
+                        if chain is not None and chain[-1][0] > snap:
+                            raise SdbError(CONFLICT_MSG)
+                except SdbError:
+                    self.vs.release(snap)
+                    raise
+                self.vs.commit({prep_key: blob}, snap)
+            self.staged[txid] = writes
+            self.staged_meta[txid] = (list(meta_addrs), time.monotonic())
+            for k in writes:
+                self.locks[k] = txid
+            self.log_commit({prep_key: blob})
+            self._ship({prep_key: blob})
+            self.counters["twopc_prepares"] += 1
+        self._start_resolver()
+
+    def decide_txn(self, txid: str, decision: str) -> str:
+        """Phase 2: apply (commit) or drop (abort) a staged writeset and
+        release its locks. Idempotent: an unknown txid means the
+        decision already landed here (returns "unknown")."""
+        prep_key = PREP_PREFIX + txid.encode()
+        with self.wal_lock:
+            writes = self.staged.pop(txid, None)
+            self.staged_meta.pop(txid, None)
+            if writes is None:
+                return "unknown"
+            for k in writes:
+                if self.locks.get(k) == txid:
+                    del self.locks[k]
+            full: dict = {prep_key: None}
+            if decision == "commit":
+                full.update(writes)
+            # fresh snapshot: locked keys could not have advanced (locks
+            # block commits AND prepares), so this never conflicts
+            snap = self.vs.snapshot()
+            self.vs.commit(full, snap)
+            self.log_commit(full)
+            self._ship(full)
+            self.counters[f"twopc_{decision}s"] += 1
+            return decision
+
+    def txn_mark(self, txid: str, want: str) -> str:
+        """Commit-log decision record (meta shard): write `want` only if
+        no decision exists yet; return the decision that actually stands.
+        This single first-writer-wins row is what makes the coordinator's
+        commit and a participant's orphan-abort mutually exclusive."""
+        key = TXNLOG_PREFIX + txid.encode()
+        with self.wal_lock:
+            cur = self.vs.read_latest(key)
+            if cur is not None:
+                return bytes(cur).decode()
+            val = want.encode()
+            snap = self.vs.snapshot()
+            self.vs.commit({key: val}, snap)
+            self.log_commit({key: val})
+            self._ship({key: val})
+            self.counters["txn_marks"] += 1
+            return want
+
+    def shard_set(self, beg: bytes, end: Optional[bytes],
+                  epoch: int) -> None:
+        """Assign/replace this group's served range behind an epoch
+        fence. Persisted + replicated as a \\x00!shardcfg row so a
+        promoted replica keeps enforcing the same bounds."""
+        with self.wal_lock:
+            for k in self.locks:
+                if k < beg or (end is not None and k >= end):
+                    raise SdbError(
+                        "kv shard set: a staged 2pc transaction holds "
+                        "keys outside the new range; retry once it "
+                        "resolves"
+                    )
+            blob = _encode([beg, end, int(epoch)])
+            snap = self.vs.snapshot()
+            self.vs.commit({SHARD_CFG_KEY: blob}, snap)
+            self.shard = (bytes(beg),
+                          None if end is None else bytes(end), int(epoch))
+            self.log_commit({SHARD_CFG_KEY: blob})
+            self._ship({SHARD_CFG_KEY: blob})
+            self.counters["shard_sets"] += 1
+
+    def shard_purge(self, beg: bytes, end: Optional[bytes]) -> int:
+        """Tombstone every key in [beg, end) — post-split GC of the
+        moved slice on the source group. Internal keys are kept."""
+        hi = INF_END if end is None else end
+        with self.wal_lock:
+            snap = self.vs.snapshot()
+            try:
+                items = self.vs.range_items(beg, hi, snap, None, False)
+            finally:
+                self.vs.release(snap)
+            writes = {k: None for k, _v in items if k[:1] != b"\x00"}
+            if not writes:
+                return 0
+            snap = self.vs.snapshot()
+            self.vs.commit(writes, snap)
+            self.log_commit(writes)
+            self._ship(writes)
+            return len(writes)
+
+    def _load_shard_state(self) -> None:
+        """Adopt the persisted shard config and rebuild the staged-2PC
+        table + lock set from \\x00!prep/ records. Runs at construction
+        (post-recovery) and again on promotion — a promoted replica has
+        the prep records in its keyspace (they replicated like any
+        commit) but not the primary's in-memory tables."""
+        raw = self.vs.read_latest(SHARD_CFG_KEY)
+        if raw is not None:
+            beg, end, epoch = _decode(bytes(raw))
+            self.shard = (bytes(beg),
+                          None if end is None else bytes(end), int(epoch))
+        snap = self.vs.snapshot()
+        try:
+            items = self.vs.range_items(
+                PREP_PREFIX, PREP_PREFIX + b"\xff", snap, None, False
+            )
+        finally:
+            self.vs.release(snap)
+        for _k, blob in items:
+            txid, pairs, meta, _ts = _decode(bytes(blob))
+            writes = {
+                bytes(k): (None if v is None else bytes(v))
+                for k, v in pairs
+            }
+            self.staged[txid] = writes
+            # age from now: recovery time shouldn't insta-orphan a txn
+            # whose coordinator is still deciding
+            self.staged_meta[txid] = (list(meta), time.monotonic())
+            for k in writes:
+                self.locks[k] = txid
+        if self.staged and self.role == "primary":
+            self._start_resolver()
+
+    # -- 2PC orphan resolver -------------------------------------------------
+
+    def _start_resolver(self):
+        if self._resolver_stop is not None:
+            return
+        self._resolver_stop = threading.Event()
+        threading.Thread(target=self._resolver_loop, daemon=True,
+                         name="kv-2pc-resolver").start()
+
+    def _resolver_loop(self):
+        """Drive staged prepares whose coordinator went quiet to the
+        decision recorded in the meta shard's commit log. Claims ABORT
+        with first-writer-wins semantics when no record exists — a
+        coordinator that died before logging its decision can never
+        commit afterwards, so every participant converges on abort."""
+        stop = self._resolver_stop
+        while not stop.wait(cnf.KV_2PC_RESOLVE_INTERVAL_S):
+            try:
+                if self.role != "primary":
+                    continue
+                now = time.monotonic()
+                with self.wal_lock:
+                    orphans = [
+                        (txid, list(meta))
+                        for txid, (meta, ts) in self.staged_meta.items()
+                        if now - ts >= self.orphan_grace_s
+                    ]
+                for txid, meta in orphans:
+                    decision = self._resolve_decision(txid, meta)
+                    if decision in ("commit", "abort"):
+                        self.decide_txn(txid, decision)
+                        self.counters["twopc_resolved"] += 1
+            except Exception:
+                # resolver must never die; next tick retries
+                self.counters["twopc_resolver_errors"] += 1
+
+    def _resolve_decision(self, txid: str, meta_addrs: list):
+        """Ask the meta shard for the recorded decision, claiming abort
+        if none exists. Network I/O — never called under wal_lock."""
+        for a in meta_addrs:
+            try:
+                c = _Conn(_parse_addr(a), self.secret,
+                          timeout=cnf.KV_CONNECT_TIMEOUT_S)
+            except (OSError, SdbError):
+                continue
+            try:
+                return c.call(["txn_mark", txid, "abort"])
+            except (OSError, SdbError):
+                continue  # replica / unreachable: try the next member
+            finally:
+                c.close()
+        return None
 
     # -- replication (replica side) -----------------------------------------
 
@@ -749,9 +1148,17 @@ class KvServer(socketserver.ThreadingTCPServer):
             if others and self.repl is None:
                 self.repl = _Replicator(self, others)
             self._start_renewal()
+            # adopt the replicated shard config and staged-2PC state:
+            # prep records arrived as ordinary writesets, the in-memory
+            # lock/stage tables did not
+            self.staged.clear()
+            self.staged_meta.clear()
+            self.locks.clear()
+            self._load_shard_state()
 
     def server_close(self):
-        for ev in (self._renew_stop, self._monitor_stop):
+        for ev in (self._renew_stop, self._monitor_stop,
+                   self._resolver_stop):
             if ev is not None:
                 ev.set()
         if self.repl is not None:
@@ -960,6 +1367,10 @@ def _status_of(addr, secret, timeout: float = 1.0) -> Optional[dict]:
 
 def _is_not_primary(e: BaseException) -> bool:
     return "kv not primary" in str(e)
+
+
+def _is_wrong_shard(e: BaseException) -> bool:
+    return "kv wrong shard epoch" in str(e)
 
 
 class _Pool:
@@ -1374,6 +1785,52 @@ class RemoteTx(BackendTx):
             raise
         except BaseException:
             # even a KeyboardInterrupt must not leak the pool slot
+            self._return_conn()
+            raise
+        self._return_conn()
+
+    def prepare_2pc(self, txid: str, meta_addrs: list) -> None:
+        """Phase 1 of a cross-shard commit (kvs/shard.py coordinator):
+        ship the buffered writeset for validation + staging on this
+        shard's primary. Consumes the snapshot exactly like commit; the
+        sub-transaction is finished client-side afterwards — its fate is
+        sealed by the coordinator's commit-log record and delivered via
+        one-shot ["decide"] calls (which follow failovers)."""
+        self._check()
+        self.done = True
+        snap, self.snap = self.snap, None
+        if self.conn is None:
+            raise RetryableKvError(
+                "kv connection lost before prepare; transaction aborted "
+                "and can be retried"
+            )
+        try:
+            self.conn.call([
+                "prepare", txid,
+                [[k, v] for k, v in self.writes.items()], snap,
+                list(meta_addrs),
+            ])
+        except (ConnectionError, OSError) as e:
+            # outcome unknown: the prepare may have staged server-side.
+            # The coordinator claims an ABORT record before giving up,
+            # so an orphaned stage converges to abort via the resolver.
+            c, self.conn = self.conn, None
+            self.pool._fail(c, e)
+            raise RetryableKvError(
+                f"kv connection lost during prepare; transaction "
+                f"aborted and can be retried: {e}"
+            )
+        except SdbError as e:
+            if _is_not_primary(e):
+                c, self.conn = self.conn, None
+                self.pool._fail(c, e)
+                raise RetryableKvError(
+                    f"kv primary changed during prepare; transaction "
+                    f"aborted and can be retried: {e}"
+                )
+            self._return_conn()
+            raise  # conflict / wrong shard: surface to the coordinator
+        except BaseException:
             self._return_conn()
             raise
         self._return_conn()

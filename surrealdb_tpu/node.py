@@ -113,6 +113,49 @@ def store_lease_acquire(vs, name: str, holder: str, ttl_s: float) -> bool:
             vs.release(snap)
 
 
+# -- TSO sequence windows (sharded versionstamps) ---------------------------
+# A sharded datastore can't run per-node HLC versionstamps: two nodes'
+# clocks would interleave inconsistently across shards and break SHOW
+# CHANGES ordering. Instead every node leases a WINDOW of stamps from a
+# single counter row on the meta shard (PD-style TSO, reference role:
+# PD's timestamp oracle). Window starts embed wall-clock millis in the
+# same [44-bit ms | 20-bit counter] layout as the HLC, so stamps remain
+# comparable to datetime-derived changefeed bounds.
+
+KV_TSO_KEY = b"\x00!tso"  # meta-shard counter row: last handed-out stamp
+
+
+def lease_tso_window(txn_factory, n: int, retries: int = 32):
+    """Allocate `n` globally-unique, strictly-increasing versionstamps
+    via one optimistic read-bump-commit on the meta shard. Returns
+    [start, end) — windows never overlap, and a window start never
+    regresses below wall-clock millis << 20. Conflicts (other nodes
+    refilling concurrently) retry bounded; transport errors surface
+    through the caller's retry policy."""
+    last_err = None
+    for _attempt in range(retries):
+        txn = txn_factory()
+        try:
+            raw = txn.get(KV_TSO_KEY)
+            last = int(raw.decode()) if raw else 0
+            start = max(int(time.time() * 1000) << 20, last + 1)
+            txn.set(KV_TSO_KEY, str(start + n).encode())
+            txn.commit()
+            return start, start + n
+        except SdbError as e:
+            try:
+                txn.cancel()
+            except SdbError:
+                pass
+            if "conflict" not in str(e).lower():
+                raise
+            last_err = e
+    raise SdbError(
+        f"kv tso: window lease lost {retries} optimistic races; "
+        f"last error: {last_err}"
+    )
+
+
 def heartbeat(ds) -> None:
     """Write this node's registry row (id -> last-seen timestamp)."""
     txn = ds.transaction(write=True)

@@ -355,6 +355,20 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 return
             self._json(200, self.ds.telemetry.recent_traces())
             return
+        if path == "/kv/topology":
+            # shard topology (ranges, epochs, primaries) of a sharded
+            # store; {} when the backend is unsharded. Gated like
+            # /metrics: topology leaks deployment shape.
+            if self._session().auth_level == "none":
+                self._json(401, {"error": "Not authenticated"})
+                return
+            try:
+                topo = self.ds.backend.topology()
+            except SdbError as e:
+                self._json(503, {"error": str(e)})
+                return
+            self._json(200, topo if topo is not None else {})
+            return
         if path == "/export":
             sess = self._session()
             from surrealdb_tpu.kvs.export import export_sql

@@ -74,13 +74,23 @@ class Telemetry:
         with self.lock:
             self.gauges[name] = fn
 
+    def unregister_gauge(self, name: str):
+        """Drop a gauge provider (a closed sharded backend must not
+        leave a dangling closure behind for the next scrape)."""
+        with self.lock:
+            self.gauges.pop(name, None)
+
     # -- counters -----------------------------------------------------------
     # The remote-KV client records its resilience counters here:
     # kv_retries (transport retries), kv_failovers (primary changes
     # observed), kv_txn_failovers (read-only txns transparently
     # re-pinned), kv_deadline_exhausted (ops that ran out their retry
-    # deadline). All surface through `prometheus()` as
-    # surreal_<name>_total.
+    # deadline). The shard router adds kv_shard_map_refreshes (stale-map
+    # recoveries), kv_2pc_commits / kv_2pc_aborts (cross-shard
+    # transaction outcomes), kv_2pc_decide_deferred (phase-2 deliveries
+    # left to a participant's resolver), plus gauges kv_shards /
+    # kv_shard_map_epoch. All surface through `prometheus()` as
+    # surreal_<name>_total (counters) / surreal_<name> (gauges).
     def inc(self, name: str, by: int = 1):
         with self.lock:
             self.counters[name] = self.counters.get(name, 0) + by

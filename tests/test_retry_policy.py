@@ -122,12 +122,81 @@ def test_error_classification():
     assert is_retryable(SdbError("kv not primary (role=replica)"))
     assert is_retryable(SdbError("kv connection lost: peer closed"))
     assert is_retryable(SdbError("kv service unreachable: refused"))
+    # topology errors (range sharding) are retryable: the router
+    # refreshes its shard map and re-routes
+    assert is_retryable(SdbError(
+        "kv wrong shard epoch: this group serves [b'', b'm') at epoch 2"
+    ))
+    assert is_retryable(RetryableKvError(
+        "kv shard unavailable (127.0.0.1:1): unreachable"
+    ))
     # logical/server errors are NOT transport-retryable
     assert not is_retryable(SdbError(
         "Failed to commit transaction due to a read or write conflict"
     ))
     assert not is_retryable(SdbError("kv auth required"))
     assert not is_retryable(ValueError("x"))
+
+
+def test_on_retry_hook_skips_backoff():
+    """A stale shard map is topology, not congestion: when the on_retry
+    hook reports it handled the error (map refreshed), the next attempt
+    goes out immediately — no backoff sleep burning the query budget."""
+    clock, sleep, sleeps = _fake_timeline()
+    pol = RetryPolicy(deadline_s=10, base_ms=100, max_ms=400, jitter=0.0,
+                      clock=clock, sleep=sleep)
+    calls, seen = [0], []
+
+    def fn():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise SdbError("kv wrong shard epoch: refresh the shard map")
+        return "ok"
+
+    def on_retry(e, attempt):
+        seen.append((str(e), attempt))
+        return "wrong shard" in str(e)  # refreshed: skip the backoff
+
+    assert pol.run(fn, on_retry=on_retry) == "ok"
+    assert calls[0] == 3
+    assert sleeps == [], "wrong-shard retries must not sleep"
+    assert [a for _m, a in seen] == [0, 1]
+
+
+def test_on_retry_hook_false_keeps_backoff():
+    clock, sleep, sleeps = _fake_timeline()
+    pol = RetryPolicy(deadline_s=10, base_ms=50, max_ms=200, jitter=0.0,
+                      clock=clock, sleep=sleep)
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise ConnectionError("flap")
+        return "ok"
+
+    assert pol.run(fn, on_retry=lambda e, a: False) == "ok"
+    assert sleeps == [0.05, 0.1]
+
+
+def test_on_retry_hook_exception_falls_back_to_backoff():
+    """A failing refresh hook must not break the retry loop."""
+    clock, sleep, sleeps = _fake_timeline()
+    pol = RetryPolicy(deadline_s=10, base_ms=50, max_ms=200, jitter=0.0,
+                      clock=clock, sleep=sleep)
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        if calls[0] < 2:
+            raise ConnectionError("flap")
+        return "ok"
+
+    def bad_hook(e, attempt):
+        raise RuntimeError("refresh blew up")
+
+    assert pol.run(fn, on_retry=bad_hook) == "ok"
+    assert sleeps == [0.05]
 
 
 def test_remote_tx_init_failure_no_unraisable():

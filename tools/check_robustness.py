@@ -15,6 +15,12 @@ re-litigating:
    `ctx.check_deadline()` itself or drain a child's `.execute(ctx)`
    (which propagates to a deadline-checking scan). Otherwise a new
    operator silently reopens the unbounded-loop hole.
+4. **No silent swallows in 2PC decision paths** — in `kvs/shard.py` and
+   `kvs/remote.py`, an `except` whose body is a bare `pass` inside any
+   function named like a decision step (commit/prepare/decide/resolve/
+   mark/split) hides a stuck or diverging two-phase commit. Record a
+   telemetry counter, re-raise, or carry a `# robust:` pragma stating
+   why the swallow is safe.
 
 Usage:  python tools/check_robustness.py [root]
 Exit status 1 when any finding survives.
@@ -24,9 +30,14 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 
 PRAGMA = "# robust:"
+
+# files + function-name shape that rule 4 (2PC decision paths) covers
+_TWOPC_FILES = ("surrealdb_tpu/kvs/shard.py", "surrealdb_tpu/kvs/remote.py")
+_DECISION_FN = re.compile(r"commit|prepare|decide|resolve|mark|split")
 
 
 def _pragma(lines: list[str], lineno: int) -> bool:
@@ -86,6 +97,23 @@ def check_file(path: str, rel: str) -> list[str]:
                     f"`daemon=True` or a `# robust: joined` pragma — "
                     f"blocks SIGTERM drain"
                 )
+    # 4. silent except-pass in 2PC decision paths
+    if rel.replace(os.sep, "/") in _TWOPC_FILES:
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _DECISION_FN.search(fn.name):
+                continue
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.ExceptHandler)
+                        and len(node.body) == 1
+                        and isinstance(node.body[0], ast.Pass)
+                        and not _pragma(lines, node.lineno)):
+                    findings.append(
+                        f"{rel}:{node.lineno}: silent `except: pass` in "
+                        f"2PC decision path {fn.name} — count it, "
+                        f"re-raise, or add a `# robust:` pragma"
+                    )
     # 3. streaming operators must stay deadline-checked
     if rel.endswith(os.path.join("exec", "stream.py")):
         for node in ast.iter_child_nodes(tree):

@@ -107,6 +107,16 @@ def main():
     rc = check_robustness.main([os.path.join(
         os.path.dirname(os.path.abspath(__file__)), ".."
     )])
+    # 2-shard smoke: the full SQL surface must keep working over a
+    # range-sharded store (routing, cross-shard 2PC, scan stitching)
+    from shard_harness import two_shard_smoke
+
+    err = two_shard_smoke()
+    if err is None:
+        print("== 2-shard smoke: OK")
+    else:
+        print(f"== 2-shard smoke: FAIL — {err}")
+        rc = rc or 1
     return rc
 
 
