@@ -22,7 +22,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -57,17 +56,24 @@ def _probe_backend(attempts=4, wait_s=45, timeout_s=None) -> str:
         "SURREAL_BENCH_SKIP_PROBE"
     ):
         # SKIP_PROBE keeps its historical meaning: assume cpu, touch no
-        # accelerator state
+        # accelerator state. A CPU-platform bench also defaults device
+        # ops to inline: offloading numpy-speed kernels to a subprocess
+        # would only measure IPC (the supervised-runner numbers belong
+        # to accelerator runs)
+        os.environ.setdefault("SURREAL_DEVICE", "inline")
         _PLATFORM = "cpu"
         return _PLATFORM
     if os.environ.get("SURREAL_BENCH_INPROC_INIT"):
         # EXPERT KNOB for single-client relays where a subprocess probe
-        # would steal the only tunnel slot: init jax in-process. The
-        # caller owns the hang risk (wrap in an external timeout); init
-        # ERRORS still fall through to the cpu re-exec.
+        # would steal the only tunnel slot: init jax in-process and run
+        # device ops inline (no runner subprocess — it would dial the
+        # relay a second time). The caller owns the hang risk (wrap in
+        # an external timeout); init ERRORS still fall through to the
+        # cpu re-exec.
         try:
             import jax
 
+            os.environ["SURREAL_DEVICE"] = "inline"
             _PLATFORM = jax.devices()[0].platform
             print(f"bench: backend (in-process): {_PLATFORM} x"
                   f"{len(jax.devices())}", file=sys.stderr, flush=True)
@@ -76,22 +82,24 @@ def _probe_backend(attempts=4, wait_s=45, timeout_s=None) -> str:
             print(f"bench: in-process init failed: {e}",
                   file=sys.stderr, flush=True)
             _reexec_cpu(f"in-process backend init failed: {e}")
-    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    # The probe IS the serving architecture now: spawn the supervised
+    # DeviceRunner under its init watchdog. On success the warmed
+    # supervisor is installed as the process singleton, so the benched
+    # SQL queries dispatch to the very runner the probe validated.
+    from surrealdb_tpu.device import DeviceSupervisor, set_supervisor
+
     last = ""
     for i in range(attempts):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", code], capture_output=True,
-                text=True, timeout=timeout_s,
-            )
-            if r.returncode == 0 and r.stdout.strip():
-                _PLATFORM = r.stdout.split()[0]
-                print(f"bench: backend ready: {r.stdout.strip()}",
-                      file=sys.stderr, flush=True)
-                return _PLATFORM
-            last = (r.stderr or "no output").strip()[-500:]
-        except subprocess.TimeoutExpired:
-            last = f"backend init hung > {timeout_s}s"
+        sup = DeviceSupervisor(mode="auto", init_timeout_s=timeout_s)
+        if sup.wait_ready(timeout_s + 10):
+            _PLATFORM = sup.platform
+            set_supervisor(sup)
+            print(f"bench: backend ready: {_PLATFORM} x"
+                  f"{sup.device_count} (supervised runner pid "
+                  f"{sup.runner_pid()})", file=sys.stderr, flush=True)
+            return _PLATFORM
+        last = (sup.last_error or "backend init failed")[-500:]
+        sup.shutdown()
         print(f"bench: backend probe {i + 1}/{attempts} failed: {last}",
               file=sys.stderr, flush=True)
         if i + 1 < attempts:
@@ -112,6 +120,7 @@ def _probe_backend(attempts=4, wait_s=45, timeout_s=None) -> str:
 def _reexec_cpu(reason=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("SURREAL_DEVICE", "inline")  # see _probe_backend
     if reason:
         # survives the exec so the emitted JSON records WHY this run is
         # a CPU fallback (four rounds of measurements were lost to a
@@ -700,6 +709,21 @@ def main():
 
     def emit(res):
         res.setdefault("platform", _PLATFORM or "unprobed")
+        # device-supervisor health snapshot: the benched queries ran
+        # through the supervised runner (SURREAL_DEVICE=auto default),
+        # so its state says whether this number measured the device
+        # path or a degraded host fallback — and why
+        try:
+            from surrealdb_tpu.device import get_supervisor
+
+            st = get_supervisor().status()
+            res.setdefault("backend_state", st["state"])
+            if st["state"] != "ready" and st.get("last_error"):
+                res.setdefault("fallback_reason", st["last_error"])
+            if st.get("fallbacks"):
+                res.setdefault("device_fallbacks", st["fallbacks"])
+        except Exception:
+            pass
         if _FALLBACK_REASON:
             res.setdefault("fallback_reason", _FALLBACK_REASON)
         print(json.dumps(res), flush=True)

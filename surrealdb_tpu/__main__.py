@@ -43,6 +43,14 @@ def main(argv=None):
                          help="server-side default query timeout "
                               "(e.g. 5s, 500ms) applied when the client "
                               "sends no X-Surreal-Timeout")
+    p_start.add_argument(
+        "--device", default=None,
+        choices=("off", "auto", "require", "inline"),
+        help="accelerator execution mode (SURREAL_DEVICE): off = host "
+             "paths only, auto = supervised DeviceRunner subprocess "
+             "with degrade-and-recover (default), require = device "
+             "failures surface as query errors, inline = in-process "
+             "(debug; forfeits fault isolation)")
     p_start.add_argument("--drain-timeout", default=None,
                          help="SIGTERM drain budget (e.g. 10s): finish "
                               "in-flight queries this long, then cancel "
@@ -247,6 +255,12 @@ def main(argv=None):
     if args.cmd == "start":
         from surrealdb_tpu.server import parse_timeout, serve
 
+        if args.device:
+            # before the first get_supervisor(): the singleton reads
+            # SURREAL_DEVICE at construction
+            import os as _os
+
+            _os.environ["SURREAL_DEVICE"] = args.device
         host, _, port = args.bind.partition(":")
         ds = Datastore(args.path)
         if args.user and args.passwd:

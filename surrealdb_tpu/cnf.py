@@ -21,6 +21,19 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+def env_str(name: str, default: str) -> str:
+    return os.environ.get(name, "") or default
+
+
+def env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name, "").lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    return default
+
+
 # expression/statement nesting depth (ctx chain)
 MAX_COMPUTATION_DEPTH = env_int("SURREAL_MAX_COMPUTATION_DEPTH", 120)
 # .{..} idiom recursion hard limit
@@ -103,9 +116,28 @@ KV_2PC_RESOLVE_INTERVAL_S = env_float(
     "SURREAL_KV_2PC_RESOLVE_INTERVAL_S", 0.5
 )
 
-# -- accelerator backend init watchdog (bench.py / __graft_entry__.py) -------
+# -- accelerator backend init watchdog (bench.py / __graft_entry__.py,
+# generalized to serving by the device supervisor's init watchdog) -----------
 # device discovery that exceeds this degrades to CPU instead of hanging
 BACKEND_INIT_TIMEOUT_S = env_float("SURREAL_BACKEND_INIT_TIMEOUT_S", 240.0)
+
+# -- device execution supervisor (device/supervisor.py) ----------------------
+# off: host paths only. auto (default): supervised DeviceRunner
+# subprocess, degrade-and-recover. require: device failures surface as
+# query errors instead of silently degrading. inline: run device ops
+# in-process (debug/tests — forfeits fault isolation).
+DEVICE_MODE = env_str("SURREAL_DEVICE", "auto")
+# per-dispatch deadline; a dispatch that exhausts the FULL window is a
+# wedge (runner SIGKILLed + circuit opens). Also capped per call by the
+# query's remaining budget (inflight.remaining()).
+DEVICE_DISPATCH_TIMEOUT_S = env_float("SURREAL_DEVICE_DISPATCH_TIMEOUT_S",
+                                      10.0)
+# block-cache ship deadline (whole stores cross the socketpair)
+DEVICE_LOAD_TIMEOUT_S = env_float("SURREAL_DEVICE_LOAD_TIMEOUT_S", 120.0)
+# degraded-state background re-probe cadence + promotion hysteresis
+# (consecutive healthy probes required before traffic returns)
+DEVICE_PROBE_INTERVAL_S = env_float("SURREAL_DEVICE_PROBE_INTERVAL_S", 5.0)
+DEVICE_PROMOTE_SUCCESSES = env_int("SURREAL_DEVICE_PROMOTE_SUCCESSES", 2)
 
 # -- admission control / query lifecycle (server/admission.py, inflight.py) --
 # concurrent queries executing at once (the worker-slot budget); the CLI
@@ -119,19 +151,6 @@ HTTP_DEFAULT_TIMEOUT_S = env_float("SURREAL_HTTP_DEFAULT_TIMEOUT_S", 0.0)
 # SIGTERM drain budget: stop admitting, let in-flight work finish this
 # long, then cancel whatever remains and exit
 DRAIN_TIMEOUT_S = env_float("SURREAL_DRAIN_TIMEOUT_S", 10.0)
-
-
-def env_str(name: str, default: str) -> str:
-    return os.environ.get(name, "") or default
-
-
-def env_bool(name: str, default: bool) -> bool:
-    v = os.environ.get(name, "").lower()
-    if v in ("1", "true", "yes", "on"):
-        return True
-    if v in ("0", "false", "no", "off"):
-        return False
-    return default
 
 
 # -- execution limits (reference cnf/mod.rs names) ---------------------------

@@ -1,0 +1,43 @@
+"""Fault-isolated accelerator execution.
+
+The paper's north star dispatches the vector/graph hot paths to a JAX
+process holding embedding blocks in HBM — a *separate process*. This
+package is that boundary:
+
+- `runner.py` — the DeviceRunner subprocess: owns ALL JAX/TPU state
+  (init, mesh, vector block caches, CSR adjacency blocks) behind a
+  length-prefixed RPC over a socketpair. f32/int32 buffers ship raw.
+- `supervisor.py` — the `DeviceSupervisor` in the serving process:
+  health-checked dispatch with an init watchdog, per-dispatch deadlines
+  capped by the query's remaining budget, wedge detection,
+  kill-and-restart on crash/hang, and a circuit breaker that degrades
+  to the host paths with hysteresis-based background re-probe.
+
+Crash-only discipline (Candea & Fox): the runner holds NOTHING the
+serving process can't rebuild — every device block is a cache over KV
+truth, so recovery is always "kill it and re-ship". A query thread
+never imports jax (enforced by tools/check_robustness.py rule 5); a
+wedged TPU init can therefore stall a subprocess, never a query.
+"""
+
+from __future__ import annotations
+
+from surrealdb_tpu.device.supervisor import (
+    DeviceOpError,
+    DeviceSupervisor,
+    DeviceUnavailable,
+    attach_telemetry,
+    get_supervisor,
+    reset_supervisor,
+    set_supervisor,
+)
+
+__all__ = [
+    "DeviceOpError",
+    "DeviceSupervisor",
+    "DeviceUnavailable",
+    "attach_telemetry",
+    "get_supervisor",
+    "reset_supervisor",
+    "set_supervisor",
+]

@@ -1,0 +1,72 @@
+"""Runner-side CSR graph blocks: the JAX half of graph/csr.py.
+
+The serving process ships rows/cols edge arrays once per cache epoch;
+a multi-hop expansion arrives as a start-node mask and leaves as the
+reached-node mask — frontiers never materialize id values between hops
+(jax.lax.scan over gather + scatter-or)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _multi_hop_impl(rows, cols, start, n_nodes, hops, union):
+    import jax
+    import jax.numpy as jnp
+
+    def hop(frontier, _):
+        contrib = frontier[rows].astype(jnp.int32)
+        nxt = jnp.zeros(n_nodes, jnp.int32).at[cols].add(contrib) > 0
+        return nxt, nxt
+
+    frontier, layers = jax.lax.scan(hop, start, None, length=hops)
+    if union:
+        return layers.any(axis=0)
+    return frontier
+
+
+_jit_cache: dict = {}
+
+
+def _multi_hop_jit(rows, cols, start, n_nodes, hops, union):
+    import jax
+
+    ck = (n_nodes, hops, union, rows.shape[0])
+    fn = _jit_cache.get(ck)
+    if fn is None:
+        fn = jax.jit(_multi_hop_impl, static_argnums=(3, 4, 5))
+        _jit_cache[ck] = fn
+    return fn(rows, cols, start, n_nodes, hops, union)
+
+
+class CsrStore:
+    """Device-resident adjacency for ONE graph cache epoch."""
+
+    def __init__(self, key: str, rows: np.ndarray, cols: np.ndarray,
+                 n_nodes: int):
+        self.key = key
+        self.n_nodes = int(n_nodes)
+        self.rows = rows
+        self.cols = cols
+        self.device = None
+
+    def nbytes(self) -> int:
+        return int(self.rows.nbytes + self.cols.nbytes)
+
+    def _ensure(self):
+        if self.device is None:
+            import jax.numpy as jnp
+
+            self.device = (jnp.asarray(self.rows), jnp.asarray(self.cols))
+        return self.device
+
+    def multi_hop(self, start: np.ndarray, hops: int,
+                  union: bool) -> np.ndarray:
+        import jax.numpy as jnp
+
+        rows_d, cols_d = self._ensure()
+        out = _multi_hop_jit(
+            rows_d, cols_d, jnp.asarray(start.astype(bool)),
+            self.n_nodes, int(hops), bool(union),
+        )
+        return np.asarray(out).astype(np.uint8)

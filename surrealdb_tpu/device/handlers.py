@@ -1,0 +1,158 @@
+"""Device op dispatch table, shared by the DeviceRunner subprocess and
+the `SURREAL_DEVICE=inline` debug mode.
+
+Every handler is `(meta, bufs) -> (tag, meta_out, bufs_out)`; raising
+maps to an `("err", ...)` reply. The store caches are bounded LRU — an
+evicted store simply answers "stale" on its next use and the serving
+side re-ships (device blocks are a cache over KV truth)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+# bounded block caches: enough for every live index in a busy node, and
+# an eviction is only a re-ship (never an error)
+MAX_VEC_STORES = 64
+MAX_CSR_STORES = 64
+
+
+class DeviceHost:
+    """Per-runner registry of vector + CSR block caches."""
+
+    def __init__(self):
+        self.vec: OrderedDict = OrderedDict()  # key -> (tag, VecStore)
+        self.csr: OrderedDict = OrderedDict()  # key -> (tag, CsrStore)
+        # multipart vec loads in flight: key -> (meta, vecs, valid).
+        # Big stores (the 10M×768 regime is ~30 GB of f32 rows) ship as
+        # begin/part.../end so no single frame has to hold the store.
+        self._staging: dict = {}
+
+    # -- ops ----------------------------------------------------------------
+    def handle(self, op: str, meta: dict, bufs: list):
+        fn = getattr(self, f"op_{op}", None)
+        if fn is None:
+            raise ValueError(f"unknown device op {op!r}")
+        return fn(meta, bufs)
+
+    def op_ping(self, meta, bufs):
+        return "ok", {}, []
+
+    def op_status(self, meta, bufs):
+        import jax
+
+        devs = jax.devices()
+        return "ok", {
+            "platform": devs[0].platform if devs else "none",
+            "device_count": len(devs),
+            "vec_blocks": len(self.vec),
+            "csr_blocks": len(self.csr),
+            "vec_bytes": sum(s.nbytes() for _t, s in self.vec.values()),
+            "csr_bytes": sum(s.nbytes() for _t, s in self.csr.values()),
+        }, []
+
+    def op_vec_load(self, meta, bufs):
+        from surrealdb_tpu.device.vecstore import VecStore
+
+        key = meta["key"]
+        vecs, valid = bufs
+        st = VecStore(key, vecs, valid, meta["metric"],
+                      meta.get("mink_p", 3.0), meta["cfg"])
+        st.ensure()
+        self.vec.pop(key, None)
+        self.vec[key] = (list(meta["tag"]), st)
+        while len(self.vec) > MAX_VEC_STORES:
+            self.vec.popitem(last=False)
+        return "ok", {"rank_mode": st.rank_mode}, []
+
+    def op_vec_load_begin(self, meta, bufs):
+        key = meta["key"]
+        n, dim = meta["shape"]
+        vecs = np.empty((int(n), int(dim)), dtype=np.dtype(meta["dtype"]))
+        (valid,) = bufs
+        self._staging[key] = (dict(meta), vecs, valid)
+        return "ok", {}, []
+
+    def op_vec_load_part(self, meta, bufs):
+        ent = self._staging.get(meta["key"])
+        if ent is None:
+            return "stale", {}, []
+        _m, vecs, _valid = ent
+        off = int(meta["off"])
+        (chunk,) = bufs
+        vecs[off:off + chunk.shape[0]] = chunk
+        return "ok", {}, []
+
+    def op_vec_load_end(self, meta, bufs):
+        from surrealdb_tpu.device.vecstore import VecStore
+
+        key = meta["key"]
+        ent = self._staging.pop(key, None)
+        if ent is None:
+            return "stale", {}, []
+        lmeta, vecs, valid = ent
+        st = VecStore(key, vecs, valid, lmeta["metric"],
+                      lmeta.get("mink_p", 3.0), lmeta["cfg"])
+        st.ensure()
+        self.vec.pop(key, None)
+        self.vec[key] = (list(meta["tag"]), st)
+        while len(self.vec) > MAX_VEC_STORES:
+            self.vec.popitem(last=False)
+        return "ok", {"rank_mode": st.rank_mode}, []
+
+    def op_vec_drop(self, meta, bufs):
+        self.vec.pop(meta["key"], None)
+        self._staging.pop(meta["key"], None)
+        return "ok", {}, []
+
+    def op_vec_knn(self, meta, bufs):
+        ent = self.vec.get(meta["key"])
+        if ent is None or ent[0] != list(meta["tag"]):
+            return "stale", {}, []
+        self.vec.move_to_end(meta["key"])
+        out_meta, out_bufs = ent[1].knn(bufs[0], int(meta["k"]))
+        return "ok", out_meta, out_bufs
+
+    def op_csr_load(self, meta, bufs):
+        from surrealdb_tpu.device.csrstore import CsrStore
+
+        key = meta["key"]
+        rows, cols = bufs
+        st = CsrStore(key, rows, cols, int(meta["n_nodes"]))
+        self.csr.pop(key, None)
+        self.csr[key] = (list(meta["tag"]), st)
+        while len(self.csr) > MAX_CSR_STORES:
+            self.csr.popitem(last=False)
+        return "ok", {}, []
+
+    def op_csr_drop(self, meta, bufs):
+        self.csr.pop(meta["key"], None)
+        return "ok", {}, []
+
+    def op_csr_hop(self, meta, bufs):
+        ent = self.csr.get(meta["key"])
+        if ent is None or ent[0] != list(meta["tag"]):
+            return "stale", {}, []
+        self.csr.move_to_end(meta["key"])
+        mask = ent[1].multi_hop(
+            bufs[0], int(meta["hops"]), bool(meta["union"])
+        )
+        return "ok", {}, [mask]
+
+    def op_brute_knn(self, meta, bufs):
+        """One-shot exact KNN over ephemeral rows (planner brute path —
+        nothing cached; xs ships with the call)."""
+        import jax.numpy as jnp
+
+        from surrealdb_tpu.ops.topk import knn_search
+
+        xs, qs = bufs
+        d, i = knn_search(
+            jnp.asarray(xs), jnp.asarray(qs), int(meta["k"]),
+            meta["metric"], float(meta.get("p", 3.0)),
+        )
+        return "ok", {}, [
+            np.ascontiguousarray(np.asarray(d), np.float32),
+            np.ascontiguousarray(np.asarray(i), np.int32),
+        ]

@@ -1,0 +1,97 @@
+"""DeviceRunner subprocess entry point.
+
+Spawned by the DeviceSupervisor with one end of a socketpair. Owns ALL
+JAX state: backend init happens HERE (never on a serving thread), so a
+wedged TPU tunnel stalls this process while the supervisor's init
+watchdog times out and the serving path degrades to host execution.
+
+Protocol (device/proto.py frames):
+  runner -> supervisor on boot:  ("ready", {platform, device_count})
+  supervisor -> runner:          (op, {seq, ...}, bufs)
+  runner -> supervisor:          ("ok"|"stale"|"err", {seq, ...}, bufs)
+
+The loop is deliberately single-threaded and crash-only: any internal
+corruption is allowed to kill the process — the supervisor restarts it
+and the serving side re-ships block caches from KV truth."""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import sys
+import traceback
+
+
+def serve(sock) -> None:
+    """Init jax, announce readiness, serve ops until EOF/shutdown."""
+    from surrealdb_tpu.device import proto
+
+    try:
+        import jax
+
+        devs = jax.devices()
+        platform = devs[0].platform if devs else "none"
+        ndev = len(devs)
+    except BaseException as e:  # init failed: report, then die
+        try:
+            proto.send_msg(sock, "init_error", {"error": str(e)[:500]})
+        except OSError:
+            pass
+        raise
+    from surrealdb_tpu.device.handlers import DeviceHost
+
+    host = DeviceHost()
+    proto.send_msg(sock, "ready",
+                   {"platform": platform, "device_count": ndev})
+    while True:
+        try:
+            op, meta, bufs = proto.recv_msg(sock)
+        except ConnectionError:
+            return  # supervisor went away: die with it
+        if op == "shutdown":
+            try:
+                proto.send_msg(sock, "ok", {"seq": meta.get("seq")})
+            except OSError:
+                pass
+            return
+        seq = meta.get("seq")
+        try:
+            tag, out_meta, out_bufs = host.handle(op, meta, bufs)
+            out_meta = dict(out_meta)
+            out_meta["seq"] = seq
+            proto.send_msg(sock, tag, out_meta, out_bufs)
+        except ConnectionError:
+            return
+        except BaseException as e:
+            err = f"{e.__class__.__name__}: {e}"
+            tb = traceback.format_exc(limit=6)
+            try:
+                proto.send_msg(
+                    sock, "err",
+                    {"seq": seq, "error": err[:500], "trace": tb[-2000:]},
+                )
+            except OSError:
+                return
+
+
+def main(fd: int) -> None:
+    # the supervisor owns this process's lifetime; a Ctrl-C aimed at the
+    # server must not race the supervisor's orderly shutdown
+    try:
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+    except (ValueError, OSError):
+        pass
+    sock = socket.socket(fileno=fd)
+    try:
+        serve(sock)
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.getcwd())
+    main(int(sys.argv[1]))

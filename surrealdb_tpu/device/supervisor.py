@@ -1,0 +1,779 @@
+"""DeviceSupervisor: health-checked dispatch to the DeviceRunner.
+
+State machine (doc/operations.md "Device supervision"):
+
+    off ──(mode=off)───────────────────────────────► stays off
+    cold ──first use──► probing ──ready frame──► ready
+    ready ──crash / dispatch timeout──► degraded
+    degraded ──probe streak ≥ promote threshold──► ready
+
+While degraded (or still cold/probing) every dispatch raises
+`DeviceUnavailable` and the callers serve from the host paths (numpy
+KNN, host CSR) — the circuit breaker. A background probe thread
+respawns and pings the runner every `SURREAL_DEVICE_PROBE_INTERVAL_S`;
+promotion back to ready requires `SURREAL_DEVICE_PROMOTE_SUCCESSES`
+consecutive healthy probes (hysteresis — one lucky ping after a crash
+loop must not flap traffic back onto a sick device).
+
+Deadlines ("The Tail at Scale"): every dispatch waits at most
+min(op timeout, calling query's remaining budget) — the inflight
+thread-local from PR 2 — so a wedged device can never hold a query past
+its deadline. A wait that exhausts the FULL op timeout is a wedge: the
+runner is SIGKILLed and the state degrades; a wait cut short by a small
+query budget merely orphans that one request (the runner may be healthy
+and mid-kernel — killing it would thrash under tight deadlines).
+
+Modes (`SURREAL_DEVICE`): `off` (host paths only), `auto` (default:
+supervised subprocess, degrade-and-recover), `require` (failures
+surface as query errors instead of silently degrading — benchmarking
+the flagship path), `inline` (no subprocess; ops run in-process —
+debug/tests only, forfeits isolation).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+from surrealdb_tpu import cnf
+from surrealdb_tpu.err import SdbError
+
+_STATES = ("off", "cold", "probing", "ready", "degraded")
+
+
+class DeviceUnavailable(Exception):
+    """Internal degrade signal: the device can't serve this dispatch —
+    fall back to the host path. Never surfaces to a client."""
+
+
+class DeviceOpError(Exception):
+    """The runner rejected ONE op (bad input, kernel error). Not a
+    health event: callers degrade that query to host without tripping
+    the circuit breaker."""
+
+
+class DeviceSupervisor:
+    def __init__(self, mode: Optional[str] = None,
+                 dispatch_timeout_s: Optional[float] = None,
+                 load_timeout_s: Optional[float] = None,
+                 init_timeout_s: Optional[float] = None,
+                 probe_interval_s: Optional[float] = None,
+                 promote_successes: Optional[int] = None):
+        # env is re-read at construction (not import) so tests and
+        # embedded servers can configure per-instance
+        self.mode = (mode or os.environ.get("SURREAL_DEVICE", "")
+                     or cnf.DEVICE_MODE).lower()
+        if self.mode not in ("off", "auto", "require", "inline"):
+            raise SdbError(f"SURREAL_DEVICE must be off|auto|require|"
+                           f"inline, got {self.mode!r}")
+        self.dispatch_timeout_s = (
+            cnf.env_float("SURREAL_DEVICE_DISPATCH_TIMEOUT_S",
+                          cnf.DEVICE_DISPATCH_TIMEOUT_S)
+            if dispatch_timeout_s is None else dispatch_timeout_s)
+        self.load_timeout_s = (
+            cnf.env_float("SURREAL_DEVICE_LOAD_TIMEOUT_S",
+                          cnf.DEVICE_LOAD_TIMEOUT_S)
+            if load_timeout_s is None else load_timeout_s)
+        # init watchdog: SURREAL_BACKEND_INIT_TIMEOUT_S generalized from
+        # bench-only to serving (SURREAL_DEVICE_INIT_TIMEOUT_S overrides)
+        self.init_timeout_s = (
+            cnf.env_float("SURREAL_DEVICE_INIT_TIMEOUT_S",
+                          cnf.BACKEND_INIT_TIMEOUT_S)
+            if init_timeout_s is None else init_timeout_s)
+        self.probe_interval_s = (
+            cnf.env_float("SURREAL_DEVICE_PROBE_INTERVAL_S",
+                          cnf.DEVICE_PROBE_INTERVAL_S)
+            if probe_interval_s is None else probe_interval_s)
+        self.promote_successes = (
+            cnf.env_int("SURREAL_DEVICE_PROMOTE_SUCCESSES",
+                        cnf.DEVICE_PROMOTE_SUCCESSES)
+            if promote_successes is None else promote_successes)
+        self.state = "off" if self.mode == "off" else "cold"
+        self.platform: Optional[str] = None
+        self.device_count = 0
+        self.last_error: Optional[str] = None
+        self.counters = {
+            "device_spawns": 0, "device_restarts": 0,
+            "device_dispatch_timeouts": 0, "device_dispatch_errors": 0,
+            "device_fallbacks": 0,
+        }
+        self._lock = threading.RLock()
+        self._ready = threading.Event()
+        self._gen = 0
+        self._proc: Optional[subprocess.Popen] = None
+        self._sock: Optional[socket.socket] = None
+        self._send_q: Optional[queue.Queue] = None
+        self._pending: dict = {}  # seq -> [Event, reply|None]
+        self._seq = 0
+        self._loaded: dict = {}  # cache key -> tag (current runner gen)
+        self._probe_thread: Optional[threading.Thread] = None
+        self._spawn_thread: Optional[threading.Thread] = None
+        # (proc, sock) of a runner still in its init handshake — tracked
+        # so shutdown() can kill a MID-INIT runner (it may hold the
+        # exclusive accelerator for up to init_timeout_s otherwise)
+        self._spawning: Optional[tuple] = None
+        self._stop = threading.Event()
+        self._inline_host = None
+        if self.mode == "inline":
+            self.state = "ready"
+            self._ready.set()
+
+    # -- public surface ------------------------------------------------------
+
+    def fast_path(self) -> bool:
+        """True when callers should route this dispatch to the device.
+        A cold supervisor kicks off the async spawn and answers False —
+        the first queries serve from host while the runner initializes
+        (degrade-and-recover, never block a query on jax init)."""
+        if self.mode == "off" or self._stop.is_set():
+            return False
+        if self.mode in ("inline", "require"):
+            return True
+        if self.state == "ready":
+            return True
+        if self.state == "cold":
+            self.ensure_started()
+        return False
+
+    def unavailable(self, reason: str):
+        """The exception a CALLER should raise when it gives up on the
+        device (cache thrashing, repeated stale replies): SdbError in
+        require mode — the query must fail loudly, not silently serve
+        host results — else the internal degrade signal."""
+        if self.mode == "require":
+            return SdbError(
+                "device required (SURREAL_DEVICE=require) but "
+                f"unavailable: {reason}"
+            )
+        return DeviceUnavailable(reason)
+
+    def note_fallback(self):
+        """A caller served from the host path because the device was
+        unavailable (counted once per degraded dispatch)."""
+        if self.mode != "off":
+            self.counters["device_fallbacks"] += 1
+
+    def ensure_started(self):
+        """Kick the async first spawn (idempotent, never blocks)."""
+        if self.mode in ("off", "inline") or self._stop.is_set():
+            return
+        with self._lock:
+            if self.state != "cold" or self._spawn_thread is not None:
+                return
+            self.state = "probing"
+            stop = self._stop
+            t = threading.Thread(target=self._first_spawn, args=(stop,),
+                                 daemon=True, name="device-spawn")
+            self._spawn_thread = t
+        t.start()
+
+    def wait_ready(self, timeout_s: float) -> bool:
+        """Block until the runner is serving (bench/boot prewarm).
+        Returns False EARLY when init fails (state degraded) — a
+        fast-erroring backend must fail fast and loud, not eat the
+        whole watchdog window while the probe loop respawns it."""
+        if self.mode == "off":
+            return False
+        self.ensure_started()
+        end = time.monotonic() + timeout_s
+        while True:
+            left = end - time.monotonic()
+            if left <= 0:
+                return self._ready.is_set()
+            if self._ready.wait(min(left, 0.05)):
+                return True
+            if self.state == "degraded":
+                return False
+
+    def call(self, op: str, meta: dict, bufs=(),
+             timeout_s: Optional[float] = None):
+        """One dispatch -> (tag, meta, bufs). Raises DeviceUnavailable
+        (degrade to host), DeviceOpError (this op failed), or SdbError
+        (mode=require and the device can't serve)."""
+        if self.mode == "off" or self._stop.is_set():
+            raise DeviceUnavailable("device disabled")
+        if self.mode == "inline":
+            return self._call_inline(op, meta, bufs)
+        base = self.dispatch_timeout_s if timeout_s is None else timeout_s
+        if not self._ready.is_set():
+            self.ensure_started()
+            if self.mode == "require":
+                # hard-SLA posture: wait at most one dispatch window
+                # (capped by the query budget) for readiness, then FAIL
+                # the query — warm with wait_ready() at boot instead.
+                # Deliberately the DISPATCH window even for loads: this
+                # is a health gate, not an op.
+                budget = _query_remaining()
+                wait = self.dispatch_timeout_s if budget is None \
+                    else min(self.dispatch_timeout_s, max(budget, 0.0))
+                if not self._ready.wait(wait):
+                    raise SdbError(
+                        "device required (SURREAL_DEVICE=require) but "
+                        f"unavailable: state={self.state}, "
+                        f"last error: {self.last_error}"
+                    )
+            else:
+                raise DeviceUnavailable(f"device {self.state}")
+        try:
+            return self._call_live(op, meta, bufs, base)
+        except DeviceUnavailable:
+            if self.mode == "require":
+                raise SdbError(
+                    "device required (SURREAL_DEVICE=require) but "
+                    f"dispatch failed: {self.last_error}"
+                )
+            raise
+        except DeviceOpError as e:
+            if self.mode == "require":
+                # an op failure must surface too: require means the
+                # device path IS the contract, not a fast path
+                raise SdbError(f"device op failed "
+                               f"(SURREAL_DEVICE=require): {e}")
+            raise
+
+    # -- cache bookkeeping ---------------------------------------------------
+
+    # single-frame ship cap: bigger stores go begin/part.../end so no
+    # frame (and no transient copy) has to hold the whole store
+    LOAD_PART_BYTES = 256 << 20
+
+    def ensure_loaded(self, key: str, tag, loader):
+        """Ship a block cache unless (key, tag) is already resident on
+        the CURRENT runner. `loader() -> (op, meta, bufs)` materializes
+        the payload only when a ship is actually needed."""
+        tag = list(tag)
+        with self._lock:
+            if self._loaded.get(key) == tag:
+                return
+        op, meta, bufs = loader()
+        meta = dict(meta)
+        meta["key"] = key
+        meta["tag"] = tag
+        if (op == "vec_load"
+                and bufs[0].nbytes > self.LOAD_PART_BYTES):
+            self._multipart_vec_load(key, tag, meta, bufs[0], bufs[1])
+        else:
+            self.call(op, meta, bufs, timeout_s=self.load_timeout_s)
+        with self._lock:
+            self._loaded[key] = tag
+
+    def _multipart_vec_load(self, key, tag, meta, vecs, valid):
+        begin = dict(meta)
+        begin["shape"] = list(vecs.shape)
+        begin["dtype"] = vecs.dtype.str
+        self.call("vec_load_begin", begin, [valid],
+                  timeout_s=self.load_timeout_s)
+        row_bytes = max(1, vecs.shape[1] * vecs.dtype.itemsize)
+        step = max(1, self.LOAD_PART_BYTES // row_bytes)
+        for off in range(0, vecs.shape[0], step):
+            t, _m, _b = self.call(
+                "vec_load_part", {"key": key, "off": off},
+                [vecs[off:off + step]], timeout_s=self.load_timeout_s,
+            )
+            if t == "stale":  # runner restarted mid-ship
+                raise self.unavailable("runner lost mid-load")
+        t, _m, _b = self.call("vec_load_end", {"key": key, "tag": tag},
+                              timeout_s=self.load_timeout_s)
+        if t == "stale":
+            raise self.unavailable("runner lost mid-load")
+
+    def forget(self, key: str):
+        with self._lock:
+            self._loaded.pop(key, None)
+
+    # -- introspection -------------------------------------------------------
+
+    def status(self) -> dict:
+        if self.mode == "inline" and self.platform is None \
+                and "jax" in sys.modules:
+            # no new import (inline forfeits isolation anyway): mirror
+            # an already-initialized in-process jax for INFO/metrics
+            try:
+                devs = sys.modules["jax"].devices()
+                self.platform = devs[0].platform if devs else "none"
+                self.device_count = len(devs)
+            except Exception:
+                pass
+        with self._lock:
+            loaded = list(self._loaded)
+        out = {
+            "state": self.state,
+            "mode": self.mode,
+            "platform": self.platform,
+            "device_count": self.device_count,
+            "restarts": self.counters["device_restarts"],
+            "dispatch_timeouts": self.counters["device_dispatch_timeouts"],
+            "dispatch_errors": self.counters["device_dispatch_errors"],
+            "fallbacks": self.counters["device_fallbacks"],
+            "last_error": self.last_error,
+            "vec_blocks": sum(1 for k in loaded if k.startswith("vec/")),
+            "csr_blocks": sum(1 for k in loaded if k.startswith("csr/")),
+        }
+        if self.mode == "inline" and self._inline_host is not None:
+            out["vec_blocks"] = len(self._inline_host.vec)
+            out["csr_blocks"] = len(self._inline_host.csr)
+        return out
+
+    def runner_pid(self) -> Optional[int]:
+        p = self._proc
+        return p.pid if p is not None else None
+
+    def shutdown(self):
+        """Stop the runner and every background thread (server drain).
+        The supervisor itself returns to `cold`: a later dispatch may
+        legitimately respawn (embedded/test processes share the
+        singleton across server lifecycles)."""
+        with self._lock:
+            self._stop.set()
+            # background threads captured the OLD stop event; a fresh
+            # one re-arms the supervisor for future use
+            self._stop = threading.Event()
+            proc, self._proc = self._proc, None
+            sock, self._sock = self._sock, None
+            spawning, self._spawning = self._spawning, None
+            # stale threads exit on their captured token; dropping the
+            # refs lets a later degradation start fresh ones
+            self._probe_thread = None
+            self._spawn_thread = None
+            self._ready.clear()
+            self._send_q = None
+            self._gen += 1  # orphan any surviving send/recv loops
+            if self.state != "off":
+                self.state = "cold"
+            self._fail_pending("device supervisor shut down")
+            self._loaded.clear()
+            self._inline_host = None
+        _close_sock(sock)
+        if spawning is not None:
+            # a runner still in its init handshake holds the (exclusive)
+            # accelerator: kill it too, and close its socket so the
+            # spawn thread's handshake recv unwinds immediately
+            _reap(spawning[0])
+            _close_sock(spawning[1])
+        if proc is not None:
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except (subprocess.TimeoutExpired, OSError):
+                pass
+
+    # -- inline mode ---------------------------------------------------------
+
+    def _call_inline(self, op, meta, bufs):
+        from surrealdb_tpu.device.handlers import DeviceHost
+
+        with self._lock:
+            if self._inline_host is None:
+                self._inline_host = DeviceHost()
+            host = self._inline_host
+        try:
+            tag, out_meta, out_bufs = host.handle(op, dict(meta),
+                                                 list(bufs))
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            self.counters["device_dispatch_errors"] += 1
+            raise DeviceOpError(f"{e.__class__.__name__}: {e}") from e
+        if self.platform is None and op != "status":
+            # lazily mirror platform info for status()/INFO
+            try:
+                _t, st, _b = host.handle("status", {}, [])
+                self.platform = st.get("platform")
+                self.device_count = st.get("device_count", 0)
+            except BaseException:
+                pass
+        return tag, out_meta, out_bufs
+
+    def inline_store(self, key: str):
+        """Test/debug hook: the in-process VecStore/CsrStore behind a
+        cache key (inline mode only; None when absent)."""
+        host = self._inline_host
+        if host is None:
+            return None
+        ent = host.vec.get(key) or host.csr.get(key)
+        return ent[1] if ent is not None else None
+
+    # -- subprocess lifecycle ------------------------------------------------
+
+    def _spawn_runner(self, stop) -> bool:
+        """Spawn + handshake one runner under the init watchdog.
+        Returns True when the runner answered ready. `stop` is the
+        lifecycle token captured by the calling thread — a shutdown
+        re-arms the supervisor with a fresh token, so a stale spawn
+        must abort instead of registering a zombie runner."""
+        import surrealdb_tpu
+
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(surrealdb_tpu.__file__))
+        )
+        parent, child = socket.socketpair()
+        code = (
+            "import sys; sys.path.insert(0, sys.argv[2]); "
+            "from surrealdb_tpu.device.runner import main; "
+            "main(int(sys.argv[1]))"
+        )
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-c", code, str(child.fileno()),
+                 pkg_root],
+                pass_fds=(child.fileno(),),
+            )
+        except OSError as e:
+            _close_sock(parent)
+            child.close()
+            self.last_error = f"spawn failed: {e}"
+            return False
+        # plain close (no shutdown): the child inherited this fd — a
+        # SHUT_RDWR here would sever ITS end of the shared socket
+        child.close()
+        self.counters["device_spawns"] += 1
+        with self._lock:
+            if stop.is_set() or stop is not self._stop:
+                _reap(proc)
+                _close_sock(parent)
+                return False
+            self._spawning = (proc, parent)
+        from surrealdb_tpu.device import proto
+
+        parent.settimeout(self.init_timeout_s)
+        try:
+            tag, meta, _bufs = proto.recv_msg(parent)
+        except socket.timeout:
+            self.last_error = (
+                f"init watchdog: backend init exceeded "
+                f"{self.init_timeout_s:.0f}s"
+            )
+            self._abort_spawn(proc, parent)
+            return False
+        except (ConnectionError, OSError) as e:
+            self.last_error = f"runner died during init: {e}"
+            self._abort_spawn(proc, parent)
+            return False
+        if tag != "ready":
+            self.last_error = (
+                f"backend init failed: {meta.get('error', tag)}"
+            )
+            self._abort_spawn(proc, parent)
+            return False
+        parent.settimeout(None)
+        with self._lock:
+            self._spawning = None
+            if stop.is_set() or stop is not self._stop:
+                _reap(proc)
+                _close_sock(parent)
+                return False
+            self._gen += 1
+            gen = self._gen
+            self._proc = proc
+            self._sock = parent
+            self._loaded.clear()
+            self.platform = meta.get("platform")
+            self.device_count = int(meta.get("device_count", 0))
+            self._send_q = queue.Queue()
+        threading.Thread(target=self._send_loop, args=(parent, gen),
+                         daemon=True, name="device-send").start()
+        threading.Thread(target=self._recv_loop, args=(parent, gen),
+                         daemon=True, name="device-recv").start()
+        return True
+
+    def _abort_spawn(self, proc, sock):
+        with self._lock:
+            self._spawning = None
+        _reap(proc)
+        _close_sock(sock)
+
+    def _first_spawn(self, stop):
+        ok = self._spawn_runner(stop)
+        with self._lock:
+            if self._spawn_thread is threading.current_thread():
+                self._spawn_thread = None
+            if stop.is_set() or stop is not self._stop:
+                return
+            if ok:
+                self.state = "ready"
+                self._ready.set()
+                return
+        self._mark_degraded(self.last_error or "init failed",
+                            kill=False)
+
+    def _mark_degraded(self, reason: str, kill: bool = True):
+        """Circuit-break: kill the runner (crash-only restart discipline
+        — its cache is rebuilt from KV truth on re-ship), fail every
+        in-flight dispatch, and start the background re-probe."""
+        with self._lock:
+            if self._stop.is_set() or self.state == "off":
+                return
+            if self.state != "degraded":
+                # only the TRANSITION records the cause: the socket
+                # teardown that follows a wedge-kill must not overwrite
+                # the wedge as "runner died"
+                self.last_error = reason
+            was_ready = self.state == "ready"
+            self.state = "degraded"
+            self._ready.clear()
+            proc, self._proc = self._proc, None
+            sock, self._sock = self._sock, None
+            self._send_q = None
+            self._loaded.clear()
+            self._fail_pending(reason)
+            start_probe = self._probe_thread is None
+            if start_probe:
+                self._probe_thread = threading.Thread(
+                    target=self._probe_loop, args=(self._stop,),
+                    daemon=True, name="device-probe",
+                )
+        _ = was_ready
+        _close_sock(sock)
+        if kill:
+            _reap(proc)
+        if start_probe:
+            self._probe_thread.start()
+
+    def _fail_pending(self, reason: str):
+        # caller holds the lock
+        for slot in self._pending.values():
+            slot[1] = ("err", {"error": reason, "_unavail": True}, [])
+            slot[0].set()
+        self._pending.clear()
+
+    def _probe_loop(self, stop):
+        """Background re-probe with hysteresis: a recovered device is
+        re-promoted without a server restart."""
+        streak = 0
+        while not stop.wait(self.probe_interval_s):
+            with self._lock:
+                if self.state != "degraded" or stop is not self._stop:
+                    break
+                have_runner = self._proc is not None
+            try:
+                if not have_runner:
+                    if not self._spawn_runner(stop):
+                        streak = 0
+                        continue
+                    self.counters["device_restarts"] += 1
+                t, _m, _b = self._call_live("ping", {}, (),
+                                            self.dispatch_timeout_s,
+                                            health_check=True)
+                if t != "ok":
+                    raise DeviceUnavailable(str(_m))
+                streak += 1
+            except (DeviceUnavailable, DeviceOpError) as e:
+                streak = 0
+                with self._lock:
+                    proc, self._proc = self._proc, None
+                    sock, self._sock = self._sock, None
+                    self._send_q = None
+                    self._loaded.clear()
+                # keep last_error = the original degradation cause (or
+                # the spawn failure _spawn_runner just recorded)
+                _close_sock(sock)
+                _reap(proc)
+                continue
+            if streak >= max(1, self.promote_successes):
+                with self._lock:
+                    if self.state == "degraded":
+                        self.state = "ready"
+                        self._ready.set()
+                break
+        with self._lock:
+            if self._probe_thread is threading.current_thread():
+                self._probe_thread = None
+            # re-arm if we raced a fresh degradation
+            if (self.state == "degraded" and stop is self._stop
+                    and not stop.is_set()
+                    and self._probe_thread is None):
+                self._probe_thread = threading.Thread(
+                    target=self._probe_loop, args=(stop,),
+                    daemon=True, name="device-probe",
+                )
+                self._probe_thread.start()
+
+    # -- live dispatch -------------------------------------------------------
+
+    def _call_live(self, op, meta, bufs, base_timeout,
+                   health_check=False):
+        budget = None if health_check else _query_remaining()
+        eff = base_timeout if budget is None \
+            else min(base_timeout, max(budget, 0.0))
+        if eff <= 0:
+            raise DeviceUnavailable("query budget exhausted")
+        with self._lock:
+            if not health_check and self.state != "ready":
+                raise DeviceUnavailable(f"device {self.state}")
+            sock = self._sock
+            sq = self._send_q
+            if sock is None or sq is None:
+                raise DeviceUnavailable("no runner")
+            self._seq += 1
+            seq = self._seq
+            ev = threading.Event()
+            slot = [ev, None]
+            self._pending[seq] = slot
+        meta = dict(meta)
+        meta["seq"] = seq
+        sq.put((op, meta, bufs))
+        end = time.monotonic() + eff
+        cancelled = False
+        while not ev.is_set():
+            left = end - time.monotonic()
+            if left <= 0:
+                break
+            ev.wait(min(left, 0.05))
+            if not health_check and _query_cancelled():
+                cancelled = True
+                break
+        if not ev.is_set():
+            with self._lock:
+                self._pending.pop(seq, None)
+            if cancelled:
+                raise DeviceUnavailable("query cancelled mid-dispatch")
+            self.counters["device_dispatch_timeouts"] += 1
+            if eff >= base_timeout - 1e-9:
+                # the FULL op window elapsed: wedged runner — kill and
+                # degrade (a short-budget query merely orphans its call)
+                self._mark_degraded(
+                    f"dispatch timeout: {op} exceeded {base_timeout}s "
+                    f"(runner wedged)"
+                )
+            raise DeviceUnavailable(f"dispatch timed out ({op})")
+        tag, rmeta, rbufs = slot[1]
+        if tag == "err":
+            if rmeta.get("_unavail"):
+                raise DeviceUnavailable(rmeta.get("error", "runner died"))
+            self.counters["device_dispatch_errors"] += 1
+            raise DeviceOpError(rmeta.get("error", "device op failed"))
+        return tag, rmeta, rbufs
+
+    def _send_loop(self, sock, gen):
+        from surrealdb_tpu.device import proto
+
+        while True:
+            with self._lock:
+                sq = self._send_q if gen == self._gen else None
+            if sq is None:
+                return
+            try:
+                item = sq.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            try:
+                proto.send_msg(sock, *item)
+            except (OSError, ValueError) as e:
+                if self._is_current(gen):
+                    self._mark_degraded(f"runner link lost (send): {e}")
+                return
+
+    def _recv_loop(self, sock, gen):
+        from surrealdb_tpu.device import proto
+
+        while True:
+            try:
+                tag, meta, bufs = proto.recv_msg(sock)
+            except (ConnectionError, OSError) as e:
+                if self._is_current(gen):
+                    self._mark_degraded(f"runner died: {e}")
+                return
+            seq = meta.get("seq")
+            with self._lock:
+                slot = self._pending.pop(seq, None)
+            if slot is not None:
+                slot[1] = (tag, meta, bufs)
+                slot[0].set()
+
+    def _is_current(self, gen) -> bool:
+        with self._lock:
+            return gen == self._gen and not self._stop.is_set() \
+                and self.state in ("ready", "degraded", "probing")
+
+
+def _query_remaining():
+    from surrealdb_tpu.inflight import remaining
+
+    return remaining()
+
+
+def _query_cancelled() -> bool:
+    from surrealdb_tpu.inflight import cancelled
+
+    return cancelled()
+
+
+def _reap(proc):
+    """SIGKILL + reap a runner without blocking the caller (a zombie
+    per restart would accumulate in long-lived serving processes)."""
+    if proc is None:
+        return
+    try:
+        proc.kill()
+    except OSError:
+        pass
+    threading.Thread(target=proc.wait, daemon=True,
+                     name="device-reap").start()
+
+
+def _close_sock(sock):
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+# -- process-wide singleton --------------------------------------------------
+# Device HBM is a process-wide resource: every Datastore in the process
+# shares ONE supervised runner. Tests swap instances via set_supervisor.
+
+_SUP: Optional[DeviceSupervisor] = None
+_SUP_LOCK = threading.Lock()
+
+
+def get_supervisor() -> DeviceSupervisor:
+    global _SUP
+    with _SUP_LOCK:
+        if _SUP is None:
+            _SUP = DeviceSupervisor()
+        return _SUP
+
+
+def set_supervisor(sup: Optional[DeviceSupervisor]):
+    """Install a supervisor instance; returns the previous one (tests
+    restore it). Does NOT shut the old one down."""
+    global _SUP
+    with _SUP_LOCK:
+        old, _SUP = _SUP, sup
+        return old
+
+
+def reset_supervisor():
+    """Shut down and drop the singleton (next get_ re-reads env)."""
+    global _SUP
+    with _SUP_LOCK:
+        old, _SUP = _SUP, None
+    if old is not None:
+        old.shutdown()
+
+
+def attach_telemetry(telemetry):
+    """Register the device gauges on a datastore's telemetry hub. The
+    closures read the CURRENT singleton so a swapped supervisor keeps
+    reporting."""
+    telemetry.register_gauge(
+        "device_degraded",
+        lambda: 1 if get_supervisor().state == "degraded" else 0,
+    )
+    for name in ("device_restarts", "device_dispatch_timeouts",
+                 "device_fallbacks"):
+        telemetry.register_gauge(
+            name, lambda n=name: get_supervisor().counters[n]
+        )

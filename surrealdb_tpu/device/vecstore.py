@@ -1,0 +1,274 @@
+"""Runner-side vector block store: the JAX/TPU half of TpuVectorIndex.
+
+Everything here runs inside the DeviceRunner subprocess (or, in
+`SURREAL_DEVICE=inline` debug/test mode, in-process). The serving
+process ships raw `[N, D]` rows + validity mask once per cache epoch;
+queries arrive as `[B, D]` f32 batches and leave as `[B, k]`
+(dist, row-id) tiles — RecordId mapping and the int8 path's exact host
+rescore stay on the serving side, which holds the full-precision rows.
+
+The kernel selection mirrors the pre-supervisor design exactly
+(bf16 rank + f32 rescore single-chip, sharded rank/rescore on a mesh,
+int8 ranking store above the HBM budget, exact kernels for non-MXU
+metrics); budgets arrive in `cfg` per dispatch so the serving process's
+configuration governs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _pow2_chunks(b_total: int, n: int, query_chunk: int,
+                 elems_budget: int):
+    """Power-of-two query bucket/chunk sizing shared by every ranking
+    branch: a bounded set of compiled kernel shapes under dynamic batch
+    sizes, with the [chunk, n] score matrix held under `elems_budget`
+    elements. Returns (bucket, chunk, rounds)."""
+    cap = min(max(1, query_chunk), max(1, elems_budget // max(n, 1)))
+    bucket = 1
+    while bucket < b_total:
+        bucket *= 2
+    chunk = 1
+    while chunk * 2 <= min(cap, bucket):
+        chunk *= 2
+    return bucket, chunk, bucket // chunk
+
+
+class VecStore:
+    """Device-resident blocks for ONE vector index cache epoch."""
+
+    def __init__(self, key: str, vecs: np.ndarray, valid: np.ndarray,
+                 metric: str, mink_p: float, cfg: dict):
+        self.key = key
+        self.vecs = vecs
+        self.valid = valid.astype(bool)
+        self.metric = metric
+        self.mink_p = float(mink_p)
+        self.cfg = dict(cfg)
+        self.device_vecs = None
+        self.device_valid = None
+        self.device_rank = None
+        self.device_full = None
+        self.device_norms = None
+        self.device_x2 = None
+        self.device_arow = None
+        self.rank_mode = None  # "bf16" | "int8" | None (exact store)
+        self.mesh = None
+
+    def nbytes(self) -> int:
+        return int(self.vecs.nbytes)
+
+    def ensure(self):
+        if self.device_vecs is not None or self.device_rank is not None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        valid = self.valid.copy()
+        multi = jax.device_count() > 1
+        if self.metric not in ("euclidean", "cosine", "dot"):
+            # non-MXU metrics: exact distance kernel over the raw store
+            if multi:
+                from surrealdb_tpu.parallel.mesh import (
+                    default_mesh, shard_rows, shard_vec,
+                )
+
+                self.mesh = default_mesh()
+                self.device_vecs, pad = shard_rows(self.mesh, self.vecs)
+                self.device_valid = shard_vec(self.mesh, valid, pad)
+            else:
+                self.device_vecs = jnp.asarray(self.vecs)
+                self.device_valid = jnp.asarray(valid)
+            return
+        # MXU metrics, single- and multi-chip alike: f32 full store is
+        # the ONE host→device transfer; the bf16 ranking store and
+        # cosine's pre-normalized rows are derived from it ON DEVICE.
+        # Per-row stats (x2 for euclidean ranking, norms for cosine
+        # rescore) are f64-accurate host computations.
+        xs = self.vecs
+        self.device_norms = None
+        self.device_x2 = None
+        x2 = norms = None
+        if self.metric == "euclidean":
+            x2 = (xs.astype(np.float64) ** 2).sum(axis=1).astype(np.float32)
+        elif self.metric == "cosine":
+            norms = np.maximum(
+                np.linalg.norm(xs.astype(np.float64), axis=1), 1e-30
+            ).astype(np.float32)
+        n, dim = xs.shape
+        ndev = jax.device_count()
+        if (6 * n * dim) // max(ndev, 1) > self.cfg["hbm_budget"]:
+            # bf16 rank + f32 full (6 B/elem, per-chip share under a
+            # mesh) won't fit HBM: int8 ranking store (1 B/elem); the
+            # EXACT rescore of the oversampled candidates happens on the
+            # serving side from its full-precision rows.
+            x8 = np.empty((n, dim), np.int8)
+            arow = np.empty(n, np.float32)
+            step = max(1, (256 << 20) // max(dim * 4, 1))
+            for s in range(0, n, step):
+                blk = xs[s:s + step].astype(np.float32)
+                if self.metric == "cosine":
+                    blk = blk / norms[s:s + step, None]
+                m = np.maximum(np.abs(blk).max(axis=1), 1e-30)
+                x8[s:s + step] = np.rint(
+                    blk * (127.0 / m)[:, None]
+                ).astype(np.int8)
+                arow[s:s + step] = m / 127.0
+            self.device_rank = jnp.asarray(x8)
+            self.device_arow = jnp.asarray(arow)
+            self.device_x2 = jnp.asarray(
+                x2 if x2 is not None else np.zeros(n, np.float32)
+            )
+            self.device_valid = jnp.asarray(valid)
+            self.rank_mode = "int8"
+            return
+        if multi:
+            from surrealdb_tpu.parallel.mesh import (
+                default_mesh, shard_rows, shard_vec,
+            )
+
+            self.mesh = default_mesh()
+            self.device_full, pad = shard_rows(
+                self.mesh, xs.astype(np.float32)
+            )
+            # always materialize both stats (zeros/ones when the metric
+            # doesn't use one): sharded defaults built per-query inside
+            # sharded_rank_rescore would eagerly allocate [N] per call
+            self.device_x2 = shard_vec(
+                self.mesh,
+                x2 if x2 is not None else np.zeros(n, np.float32), pad,
+            )
+            self.device_norms = shard_vec(
+                self.mesh,
+                norms if norms is not None else np.ones(n, np.float32),
+                pad, 1.0,
+            )
+            self.device_valid = shard_vec(self.mesh, valid, pad)
+        else:
+            self.device_full = jnp.asarray(xs, dtype=jnp.float32)
+            if x2 is not None:
+                self.device_x2 = jnp.asarray(x2)
+            if norms is not None:
+                self.device_norms = jnp.asarray(norms)
+            self.device_valid = jnp.asarray(valid)
+        if self.metric == "cosine":
+            self.device_rank = (
+                self.device_full / self.device_norms[:, None]
+            ).astype(jnp.bfloat16)
+        else:
+            self.device_rank = self.device_full.astype(jnp.bfloat16)
+        self.rank_mode = "bf16"
+
+    def knn(self, qvs: np.ndarray, k: int):
+        """Batched device search: [B, D] f32 queries -> (meta, bufs).
+
+        mode "pairs": bufs = [dists f32 [B, k'], ids i32 [B, k']] —
+        final results (invalid slots carry inf / out-of-range ids).
+        mode "cand": bufs = [cand i32 [B, kc]] — int8 ranking
+        candidates for the serving side's exact host rescore."""
+        self.ensure()
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        n = self.vecs.shape[0]
+        qs = jnp.asarray(np.ascontiguousarray(qvs, dtype=np.float32))
+        if self.mesh is not None:
+            if self.device_rank is not None:
+                from surrealdb_tpu.parallel.mesh import sharded_rank_rescore
+
+                kc = max(2 * k, k + 16)
+                b_total = qs.shape[0]
+                nloc = self.device_rank.shape[0] // self.mesh.devices.size
+                _, chunk, _ = _pow2_chunks(
+                    b_total, nloc, cfg["query_chunk"], cfg["score_budget"]
+                )
+                d_parts = []
+                i_parts = []
+                for s in range(0, b_total, chunk):
+                    qc = np.asarray(qvs[s:s + chunk], dtype=np.float32)
+                    if qc.shape[0] < chunk:
+                        qc = np.pad(qc, ((0, chunk - qc.shape[0]), (0, 0)))
+                    dc, ic = sharded_rank_rescore(
+                        self.mesh, self.device_rank, self.device_full, qc,
+                        k, kc, self.metric, self.device_x2,
+                        self.device_norms, self.device_valid,
+                    )
+                    d_parts.append(np.asarray(dc))
+                    i_parts.append(np.asarray(ic))
+                dists = np.concatenate(d_parts)[:b_total]
+                ids = np.concatenate(i_parts)[:b_total]
+            else:
+                from surrealdb_tpu.parallel.mesh import sharded_knn
+
+                dists, ids = sharded_knn(
+                    self.mesh, self.device_vecs, qs, self.device_valid, k,
+                    self.metric, self.mink_p,
+                )
+            return self._pairs(dists, ids)
+        if self.rank_mode == "int8":
+            from surrealdb_tpu.ops.topk import knn_rank_int8
+
+            kc = min(n, max(cfg["int8_oversample"] * k, k + 16))
+            b_total = qs.shape[0]
+            # halve the score budget: the int8 kernel holds int32 dots
+            # AND the f32 score matrix at [chunk, N] concurrently
+            bucket, chunk, r = _pow2_chunks(
+                b_total, n, cfg["query_chunk"], cfg["score_budget"] // 2
+            )
+            if bucket != b_total:
+                qs = jnp.pad(qs, ((0, bucket - b_total), (0, 0)))
+            cand = knn_rank_int8(
+                self.device_rank, self.device_arow, self.device_x2,
+                self.device_valid, qs.reshape(r, chunk, -1), kc,
+                self.metric,
+            )
+            cand = np.asarray(cand).reshape(bucket, kc)[:b_total]
+            return (
+                {"mode": "cand", "rank_mode": self.rank_mode, "kc": kc},
+                [np.ascontiguousarray(cand, np.int32)],
+            )
+        if self.device_rank is not None:
+            from surrealdb_tpu.ops.topk import knn_rank_rescore
+
+            # oversampling absorbs bf16/approx-top-k ranking error AND
+            # tombstoned rows ranked into the candidate set
+            kc = min(n, max(2 * k, k + 16))
+            b_total = qs.shape[0]
+            bucket, chunk, r = _pow2_chunks(
+                b_total, n, cfg["query_chunk"], cfg["score_budget"]
+            )
+            if bucket != b_total:
+                qs = jnp.pad(qs, ((0, bucket - b_total), (0, 0)))
+            dists, ids = knn_rank_rescore(
+                self.device_rank, self.device_full,
+                qs.reshape(r, chunk, -1), min(k, kc), kc, self.metric,
+                self.device_x2, self.device_norms, self.device_valid,
+            )
+            dists = np.asarray(dists).reshape(bucket, -1)[:b_total]
+            ids = np.asarray(ids).reshape(bucket, -1)[:b_total]
+            return self._pairs(dists, ids)
+        if n > cfg["block_rows"]:
+            from surrealdb_tpu.ops.topk import knn_search_blocked
+
+            dists, ids = knn_search_blocked(
+                self.device_vecs, qs, k, self.metric, self.mink_p,
+                self.device_valid,
+            )
+        else:
+            from surrealdb_tpu.ops.topk import knn_search
+
+            dists, ids = knn_search(
+                self.device_vecs, qs, k, self.metric, self.mink_p,
+                self.device_valid,
+            )
+        return self._pairs(dists, ids)
+
+    def _pairs(self, dists, ids):
+        return (
+            {"mode": "pairs", "rank_mode": self.rank_mode},
+            [
+                np.ascontiguousarray(np.asarray(dists), np.float32),
+                np.ascontiguousarray(np.asarray(ids), np.int32),
+            ],
+        )

@@ -3110,12 +3110,6 @@ def threading_active() -> int:
     return threading.active_count()
 
 
-def _jax_ready() -> bool:
-    import sys
-
-    return "jax" in sys.modules
-
-
 def _collector_detail(n: SelectStmt, ctx=None):
     """Collector explain entry; GROUP queries report their aggregation
     slots (reference Group collector: _aN aggregations over exprN argument
@@ -5144,7 +5138,12 @@ def _s_info(n: InfoStmt, ctx: Ctx):
                         break
         except OSError:
             pass
-        import jax as _jax
+        # device state from the supervisor — never `import jax` on a
+        # query thread (check_robustness rule 5): the runner subprocess
+        # owns the backend, INFO reads its health snapshot
+        from surrealdb_tpu.device import get_supervisor
+
+        dev = get_supervisor().status()
 
         # shard topology (kvs/shard.py): ranges, epochs, primaries —
         # None/absent on unsharded stores. topology() serves the
@@ -5168,7 +5167,12 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             "memory_usage": mem_kb * 1024,
             "physical_cores": _os.cpu_count() or 1,
             "threads": threading_active(),
-            "tpu_devices": len(_jax.devices()) if _jax_ready() else 0,
+            "tpu_devices": (dev.get("device_count", 0)
+                            if dev.get("state") == "ready" else 0),
+            # device supervisor health: state (cold/probing/ready/
+            # degraded), restart/timeout counters, last error, resident
+            # block-cache counts — the serving-side view of the runner
+            "device": dev,
             "metrics": dict(ctx.ds.metrics),
             # slow-query log ring (kvs/slowlog.rs; threshold via
             # SURREAL_SLOW_QUERY_THRESHOLD_MS)

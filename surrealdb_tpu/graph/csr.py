@@ -6,11 +6,17 @@ large frontiers: node→node adjacency through an edge table is packed once
 into CSR arrays resident on device; a hop is two gathers + a scatter-or
 (`frontier[rows] → scatter_add over indices`), a multi-hop is a lax.scan —
 no host↔device traffic until the final frontier readback.
+
+Fault isolation: this module never imports jax. Device hop expansion
+dispatches to the supervised DeviceRunner (surrealdb_tpu.device) and
+degrades to an equivalent numpy multi-hop whenever the device is cold,
+degraded, or disabled — graph queries always complete on host.
 """
 
 from __future__ import annotations
 
 import threading
+import uuid
 
 import numpy as np
 
@@ -29,7 +35,11 @@ class CsrGraph:
         self.rows = np.zeros(0, np.int32)  # [E] source node idx per edge
         self.cols = np.zeros(0, np.int32)  # [E] dest node idx per edge
         self.edge_ids: list = []  # [E] edge record keys (for edge output)
-        self.device = None
+        # device blocks live in the supervised DeviceRunner, addressed
+        # by (cache key, [epoch]); build/replay bump the epoch so the
+        # runner's copy goes stale and re-ships on the next hop
+        self._dev_key = f"csr/{uuid.uuid4().hex[:16]}"
+        self._dev_epoch = 0
         self.indptr = None  # host CSR (sorted by row, stable)
         self.sorted_cols = None
         self.lock = threading.RLock()
@@ -86,21 +96,11 @@ class CsrGraph:
         self.rows = np.asarray(rows, np.int32)
         self.cols = np.asarray(cols, np.int32)
         self.edge_ids = eids
-        self.device = None
+        self._dev_epoch += 1
         self.indptr = None
         self.sorted_cols = None
         self._node_rids = None  # node identity changed: drop the rid cache
         self._built = True
-
-    def _ensure_device(self):
-        if self.device is None:
-            import jax.numpy as jnp
-
-            self.device = (
-                jnp.asarray(self.rows),
-                jnp.asarray(self.cols),
-            )
-        return self.device
 
     def n_nodes(self) -> int:
         return len(self.node_ids)
@@ -165,7 +165,7 @@ class CsrGraph:
             [self.cols, np.asarray(new_cols, np.int32)]
         )
         self.edge_ids.extend(new_eids)
-        self.device = None
+        self._dev_epoch += 1
         self.indptr = None
         self.sorted_cols = None
         return True
@@ -246,7 +246,9 @@ class CsrGraph:
             return [ids[int(j)] for j in cat]
 
     def multi_hop(self, start_keys: list, hops: int, collect_mode="frontier"):
-        """Expand `hops` steps from the start nodes on device.
+        """Expand `hops` steps from the start nodes — on device through
+        the supervisor when it's serving, else the equivalent numpy
+        multi-hop (byte-identical results either way).
 
         collect_mode 'frontier': nodes reachable in exactly `hops` steps
         (frontier semantics, revisits allowed through the visited mask);
@@ -264,47 +266,68 @@ class CsrGraph:
                 found_any = True
         if not found_any:
             return []
-        import jax
-        import jax.numpy as jnp
-
-        rows_d, cols_d = self._ensure_device()
-        out = _multi_hop_jit(
-            rows_d, cols_d, jnp.asarray(start), n, hops,
-            collect_mode == "union",
-        )
-        mask = np.asarray(out)
+        union = collect_mode == "union"
+        mask = self._device_multi_hop(start, hops, union)
+        if mask is None:
+            mask = self._host_multi_hop(start, hops, union)
         return [self.node_ids[i] for i in np.nonzero(mask)[0]]
 
-
-def _multi_hop_impl(rows, cols, start, n_nodes, hops, union):
-    import jax
-    import jax.numpy as jnp
-
-    def hop(frontier, _):
-        contrib = frontier[rows].astype(jnp.int32)
-        nxt = jnp.zeros(n_nodes, jnp.int32).at[cols].add(contrib) > 0
-        return nxt, nxt
-
-    frontier, layers = jax.lax.scan(hop, start, None, length=hops)
-    if union:
-        return layers.any(axis=0)
-    return frontier
-
-
-_jit_cache: dict = {}
-
-
-def _multi_hop_jit(rows, cols, start, n_nodes, hops, union):
-    import jax
-
-    ck = (n_nodes, hops, union, rows.shape[0])
-    fn = _jit_cache.get(ck)
-    if fn is None:
-        fn = jax.jit(
-            _multi_hop_impl, static_argnums=(3, 4, 5)
+    def _device_multi_hop(self, start, hops: int, union: bool):
+        """Hop expansion via the supervised runner; None = degrade to
+        host (cold/degraded/disabled device, dispatch failure)."""
+        from surrealdb_tpu.device import (
+            DeviceOpError, DeviceUnavailable, get_supervisor,
         )
-        _jit_cache[ck] = fn
-    return fn(rows, cols, start, n_nodes, hops, union)
+
+        sup = get_supervisor()
+        if not sup.fast_path():
+            sup.note_fallback()  # same accounting as the vector path
+            return None
+        tag = [int(self._dev_epoch)]
+
+        def loader():
+            return "csr_load", {"n_nodes": self.n_nodes()}, [
+                np.ascontiguousarray(self.rows),
+                np.ascontiguousarray(self.cols),
+            ]
+
+        try:
+            for _attempt in (0, 1):
+                sup.ensure_loaded(self._dev_key, tag, loader)
+                t, _meta, bufs = sup.call(
+                    "csr_hop",
+                    {"key": self._dev_key, "tag": tag,
+                     "hops": int(hops), "union": bool(union)},
+                    [start.astype(np.uint8)],
+                )
+                if t == "stale":
+                    sup.forget(self._dev_key)
+                    continue
+                return bufs[0].astype(bool)
+            # two stale rounds: give up on the device for this hop
+            # (SdbError in require mode — surfaces to the query)
+            raise sup.unavailable("csr cache thrashing")
+        except (DeviceUnavailable, DeviceOpError):
+            sup.note_fallback()
+        return None
+
+    def _host_multi_hop(self, start, hops: int, union: bool):
+        """Numpy fallback with the device kernel's exact semantics:
+        per hop, destination mask = scatter-or of cols where the source
+        row is in the frontier."""
+        rows, cols = self.rows, self.cols
+        frontier = start
+        acc = np.zeros_like(start) if union else None
+        for _ in range(hops):
+            nxt = np.zeros_like(frontier)
+            if len(rows):
+                nxt[cols[frontier[rows]]] = True
+            frontier = nxt
+            if union:
+                acc |= nxt
+            elif not frontier.any():
+                break
+        return acc if union else frontier
 
 
 def peek_csr(ds, ns, db, node_tb, edge_tb, direction):

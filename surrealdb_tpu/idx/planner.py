@@ -1112,7 +1112,7 @@ def _brute_knn(tb, knn: Knn, qv, rest, ctx):
     (replaces KnnTopK's bounded max-heap with jax top_k)."""
     from surrealdb_tpu.exec.eval import evaluate
     from surrealdb_tpu.exec.statements import _scan_table
-    from surrealdb_tpu.ops.distance import normalize_metric
+    from surrealdb_tpu.ops.metrics import normalize_metric
     from surrealdb_tpu.val import is_truthy
 
     metric, p = normalize_metric(knn.dist or "euclidean")
@@ -1145,14 +1145,28 @@ def _brute_knn(tb, knn: Knn, qv, rest, ctx):
     q = np.asarray(qv, dtype=np.float32)
     n = len(rows)
     if n >= 4096:
-        from surrealdb_tpu.ops.topk import knn_search
-        import jax.numpy as jnp
+        # big unindexed scans rank on device via the supervisor (the
+        # rows are ephemeral — shipped with the call, nothing cached);
+        # any device trouble degrades to the exact numpy path below
+        from surrealdb_tpu.device import (
+            DeviceOpError, DeviceUnavailable, get_supervisor,
+        )
 
-        d, i = knn_search(jnp.asarray(xs), jnp.asarray(q[None, :]),
-                          min(knn.k, n), metric, p)
-        d = np.asarray(d[0])
-        i = np.asarray(i[0])
-        return [(rows[int(ii)], float(dd)) for dd, ii in zip(d, i) if ii >= 0]
+        sup = get_supervisor()
+        if sup.fast_path():
+            try:
+                _t, _m, bufs = sup.call(
+                    "brute_knn",
+                    {"k": min(knn.k, n), "metric": metric, "p": p},
+                    [xs, q[None, :].astype(np.float32)],
+                )
+                d, i = bufs[0][0], bufs[1][0]
+                return [(rows[int(ii)], float(dd))
+                        for dd, ii in zip(d, i) if ii >= 0]
+            except (DeviceUnavailable, DeviceOpError):
+                sup.note_fallback()
+        else:
+            sup.note_fallback()  # same accounting as the vector path
     # host path
     from surrealdb_tpu.idx.vector import TpuVectorIndex
 

@@ -11,11 +11,19 @@ Consistency model mirrors hnsw/index.rs's two-phase design: the KV `he` keys
 truth; the device block cache is an overlay rebuilt/extended when a search
 observes a newer KV version — "device blocks are a cache rebuilt from KV"
 (SURVEY.md §5 checkpoint/resume).
+
+Fault isolation: this module NEVER imports jax. Device execution goes
+through the supervised DeviceRunner subprocess (surrealdb_tpu.device):
+the search path ships raw row blocks + query batches over the
+supervisor's RPC, and degrades to the exact numpy host path whenever
+the device is cold, degraded, or out of budget — a wedged TPU can stall
+the runner process, never a query worker thread.
 """
 
 from __future__ import annotations
 
 import threading
+import uuid
 
 import numpy as np
 
@@ -95,23 +103,6 @@ def vector_index_update(idef, rid: RecordId, before, after, ctx):
         ctx.txn.delete(key)
         ctx.txn.set_val(log_key, ("del", rid.id, None))
     ctx.txn.set_val(vkey, ver)
-
-
-def _pow2_chunks(b_total: int, n: int, elems_budget: int):
-    """Power-of-two query bucket/chunk sizing shared by every ranking
-    branch: a bounded set of compiled kernel shapes under the coalescer's
-    dynamic batch sizes, with the [chunk, n] score matrix held under
-    `elems_budget` elements. Returns (bucket, chunk, rounds)."""
-    cap = min(
-        max(1, cnf.KNN_QUERY_CHUNK), max(1, elems_budget // max(n, 1))
-    )
-    bucket = 1
-    while bucket < b_total:
-        bucket *= 2
-    chunk = 1
-    while chunk * 2 <= min(cap, bucket):
-        chunk *= 2
-    return bucket, chunk, bucket // chunk
 
 
 def _exact_mxu_distances(metric: str, xs, q):
@@ -238,11 +229,34 @@ class _Coalescer:
             for (_q, k, slot), pairs in zip(batch, results):
                 slot[0] = pairs[:k]
                 slot[2] = True
+            return
         except BaseException as e:
-            for _q, _k, slot in batch:
-                if not slot[2]:
-                    slot[1] = e
-                    slot[2] = True
+            from surrealdb_tpu.device import (
+                DeviceOpError, DeviceUnavailable, get_supervisor,
+            )
+
+            if not isinstance(e, (DeviceUnavailable, DeviceOpError)):
+                # a shared non-device failure (OOM, bug): attribute it
+                # to every rider still waiting — nothing to degrade to
+                for _q, _k, slot in batch:
+                    if not slot[2]:
+                        slot[1] = e
+                        slot[2] = True
+                return
+            get_supervisor().note_fallback()
+        # Degrade-and-recover: the device couldn't serve this batch, so
+        # every rider is answered from the exact numpy host path — each
+        # computed (and attributed) INDIVIDUALLY, so one rider's failure
+        # can never poison the rest of the batch.
+        for q, k, slot in batch:
+            if slot[2]:
+                continue
+            try:
+                with index.lock:
+                    slot[0] = index._host_knn_single(q, k)
+            except BaseException as e2:
+                slot[1] = e2
+            slot[2] = True
 
 
 class TpuVectorIndex:
@@ -252,7 +266,7 @@ class TpuVectorIndex:
         self.key = (ns, db, tb, ix)
         self.params = params
         self.dim = params["dimension"]
-        from surrealdb_tpu.ops.distance import normalize_metric
+        from surrealdb_tpu.ops.metrics import normalize_metric
 
         self.metric, self.mink_p = normalize_metric(
             params.get("distance", "euclidean")
@@ -264,17 +278,12 @@ class TpuVectorIndex:
         self.row_index: dict = {}  # enc(id) -> row
         self.vecs = np.zeros((0, self.dim), dtype=self.dtype)
         self.valid = np.zeros(0, dtype=bool)  # tombstone mask
-        self.device_vecs = None  # jax array (lazy)
-        self.device_valid = None
-        # bf16 ranking store (the primary single-chip kernel): halves HBM
-        # traffic and rides the MXU; exact f32 rescoring happens host-side
-        self.device_rank = None
-        self.device_full = None  # f32 full store (device exact rescore)
-        self.device_norms = None  # f32 row norms (cosine rescore)
-        self.device_x2 = None  # f32 row norms² (euclidean ranking)
-        self.device_arow = None  # f32 per-row dequant scale (int8 mode)
-        self.rank_mode = None  # "bf16" | "int8" | None (exact store)
-        self.mesh = None
+        # device blocks live in the supervised DeviceRunner, addressed
+        # by (cache key, [version, epoch]); a runner restart or an epoch
+        # bump re-ships them from the host arrays (KV truth)
+        self._dev_key = f"vec/{uuid.uuid4().hex[:16]}"
+        self._dev_epoch = 0
+        self.rank_mode = None  # last runner-reported ranking mode
         self.coalescer = _Coalescer(self)
 
     # -- cache sync ---------------------------------------------------------
@@ -344,16 +353,11 @@ class TpuVectorIndex:
         return True
 
     def _drop_device(self):
-        """Invalidate every device-resident cache (host arrays are truth)."""
-        self.device_vecs = None
-        self.device_valid = None
-        self.device_rank = None
-        self.device_full = None
-        self.device_norms = None
-        self.device_x2 = None
-        self.device_arow = None
+        """Invalidate the device-resident cache (host arrays are truth):
+        bumping the epoch makes the runner's copy stale, so the next
+        dispatch re-ships the blocks."""
+        self._dev_epoch += 1
         self.rank_mode = None
-        self.mesh = None
 
     def _rebuild(self, ctx):
         ns, db, tb, ix = self.key
@@ -383,112 +387,6 @@ class TpuVectorIndex:
             beg = K.ix_state(ns, db, tb, ix, b"hl", K.enc_u64(0))
             end = K.ix_state(ns, db, tb, ix, b"hl", K.enc_u64(ver)) + b"\x00"
             ctx.txn.delete_range(beg, end)
-
-    def _ensure_device(self):
-        if self.device_vecs is not None or self.device_rank is not None:
-            return
-        import jax
-        import jax.numpy as jnp
-
-        valid = self.valid.copy()
-        multi = jax.device_count() > 1
-        if self.metric not in ("euclidean", "cosine", "dot"):
-            # non-MXU metrics: exact distance kernel over the raw store
-            if multi:
-                from surrealdb_tpu.parallel.mesh import (
-                    default_mesh, shard_rows, shard_vec,
-                )
-
-                self.mesh = default_mesh()
-                self.device_vecs, pad = shard_rows(self.mesh, self.vecs)
-                self.device_valid = shard_vec(self.mesh, valid, pad)
-            else:
-                self.device_vecs = jnp.asarray(self.vecs)
-                self.device_valid = jnp.asarray(valid)
-            return
-        # MXU metrics, single- and multi-chip alike: f32 full store is
-        # the ONE host→device transfer; the bf16 ranking store (half the
-        # HBM traffic, MXU matmuls) and cosine's pre-normalized rows are
-        # derived from it ON DEVICE, so sharded and single-chip paths
-        # share the exact same prep. Per-row stats (x2 for euclidean
-        # ranking, norms for cosine rescore) are f64-accurate host
-        # computations. Stage 2 of the kernel rescores candidates from
-        # the f32 full store (ops/topk.py knn_rank_rescore /
-        # parallel/mesh.py sharded_rank_rescore).
-        xs = self.vecs
-        self.device_norms = None
-        self.device_x2 = None
-        x2 = norms = None
-        if self.metric == "euclidean":
-            x2 = (xs.astype(np.float64) ** 2).sum(axis=1).astype(np.float32)
-        elif self.metric == "cosine":
-            norms = np.maximum(
-                np.linalg.norm(xs.astype(np.float64), axis=1), 1e-30
-            ).astype(np.float32)
-        n, dim = xs.shape
-        ndev = jax.device_count()
-        if (6 * n * dim) // max(ndev, 1) > cnf.KNN_HBM_BUDGET_BYTES:
-            # bf16 rank + f32 full (6 B/elem, per-chip share under a mesh)
-            # won't fit HBM (10M×768 ≈ 46 GB vs 16 GB on a v5e chip):
-            # int8 ranking store (1 B/elem) + EXACT host rescore of the
-            # oversampled candidates from the full-precision host rows.
-            # Not yet sharded — the int8 store lands on the default
-            # device even when a mesh is available (1/6 the footprint).
-            x8 = np.empty((n, dim), np.int8)
-            arow = np.empty(n, np.float32)
-            step = max(1, (256 << 20) // max(dim * 4, 1))
-            for s in range(0, n, step):
-                blk = xs[s:s + step].astype(np.float32)
-                if self.metric == "cosine":
-                    blk = blk / norms[s:s + step, None]
-                m = np.maximum(np.abs(blk).max(axis=1), 1e-30)
-                x8[s:s + step] = np.rint(
-                    blk * (127.0 / m)[:, None]
-                ).astype(np.int8)
-                arow[s:s + step] = m / 127.0
-            self.device_rank = jnp.asarray(x8)
-            self.device_arow = jnp.asarray(arow)
-            self.device_x2 = jnp.asarray(
-                x2 if x2 is not None else np.zeros(n, np.float32)
-            )
-            self.device_valid = jnp.asarray(valid)
-            self.rank_mode = "int8"
-            return
-        if multi:
-            from surrealdb_tpu.parallel.mesh import (
-                default_mesh, shard_rows, shard_vec,
-            )
-
-            self.mesh = default_mesh()
-            self.device_full, pad = shard_rows(self.mesh, xs.astype(np.float32))
-            n = len(xs)
-            # always materialize both stats (zeros/ones when the metric
-            # doesn't use one): sharded defaults built per-query inside
-            # sharded_rank_rescore would eagerly allocate [N] on every call
-            self.device_x2 = shard_vec(
-                self.mesh, x2 if x2 is not None else np.zeros(n, np.float32),
-                pad,
-            )
-            self.device_norms = shard_vec(
-                self.mesh,
-                norms if norms is not None else np.ones(n, np.float32),
-                pad, 1.0,
-            )
-            self.device_valid = shard_vec(self.mesh, valid, pad)
-        else:
-            self.device_full = jnp.asarray(xs, dtype=jnp.float32)
-            if x2 is not None:
-                self.device_x2 = jnp.asarray(x2)
-            if norms is not None:
-                self.device_norms = jnp.asarray(norms)
-            self.device_valid = jnp.asarray(valid)
-        if self.metric == "cosine":
-            self.device_rank = (
-                self.device_full / self.device_norms[:, None]
-            ).astype(jnp.bfloat16)
-        else:
-            self.device_rank = self.device_full.astype(jnp.bfloat16)
-        self.rank_mode = "bf16"
 
     # -- search -------------------------------------------------------------
     def knn(self, q, k: int, ctx, ef=None, cond=None, cond_ctx=None):
@@ -533,98 +431,95 @@ class TpuVectorIndex:
         return is_truthy(evaluate(cond, c))
 
     def _raw_knn(self, qv: np.ndarray, k: int):
+        from surrealdb_tpu.device import get_supervisor
+
         n = len(self.rids)
         if n < DEVICE_MIN_ROWS:
-            d = self._host_distances(qv)
-            d = np.where(self.valid, d, np.inf)
-            k_eff = min(k, n)
-            idx = np.argpartition(d, k_eff - 1)[:k_eff]
-            idx = idx[np.argsort(d[idx], kind="stable")]
-            return [
-                (self.rids[i], float(d[i]))
-                for i in idx
-                if np.isfinite(d[i])
-            ]
+            return self._host_knn_single(qv, k)
+        if not get_supervisor().fast_path():
+            # circuit open / device cold / disabled: serve exact from
+            # host immediately — no coalescer wait, no device dispatch
+            get_supervisor().note_fallback()
+            return self._host_knn_single(qv, k)
         return self.coalescer.search(qv, k)
 
-    def _device_knn_batch(self, qvs: np.ndarray, k: int):
-        """Batched device search: [B, D] queries -> per-query (rid, dist)
-        lists. The primary path ranks candidates on-device in bf16 and
-        rescores them exactly in f32 on host."""
-        self._ensure_device()
-        import jax.numpy as jnp
-
+    def _host_knn_single(self, qv: np.ndarray, k: int):
+        """Exact numpy top-k over the host arrays — the degraded path
+        and the small-store fast path (identical results to device)."""
         n = len(self.rids)
-        qs = jnp.asarray(np.ascontiguousarray(qvs, dtype=np.float32))
-        if self.mesh is not None:
-            if self.device_rank is not None:
-                from surrealdb_tpu.parallel.mesh import sharded_rank_rescore
+        if n == 0:
+            return []
+        d = self._host_distances(qv)
+        d = np.where(self.valid, d, np.inf)
+        k_eff = min(k, n)
+        idx = np.argpartition(d, k_eff - 1)[:k_eff]
+        idx = idx[np.argsort(d[idx], kind="stable")]
+        return [
+            (self.rids[i], float(d[i]))
+            for i in idx
+            if np.isfinite(d[i])
+        ]
 
-                kc = max(2 * k, k + 16)
-                # same batching discipline as single-chip: fixed
-                # power-of-two query chunk (bounded set of compiled
-                # shard_map shapes under the coalescer's dynamic batch
-                # sizes), sized so the per-shard [chunk, N/shards] f32
-                # score matrix stays under the HBM budget
-                b_total = qs.shape[0]
-                nloc = self.device_rank.shape[0] // self.mesh.devices.size
-                _, chunk, _ = _pow2_chunks(
-                    b_total, nloc, cnf.KNN_SCORE_BUDGET_ELEMS
-                )
-                d_parts = []
-                i_parts = []
-                for s in range(0, b_total, chunk):
-                    qc = np.asarray(qvs[s:s + chunk], dtype=np.float32)
-                    if qc.shape[0] < chunk:
-                        qc = np.pad(qc, ((0, chunk - qc.shape[0]), (0, 0)))
-                    dc, ic = sharded_rank_rescore(
-                        self.mesh, self.device_rank, self.device_full, qc,
-                        k, kc, self.metric, self.device_x2,
-                        self.device_norms, self.device_valid,
-                    )
-                    d_parts.append(np.asarray(dc))
-                    i_parts.append(np.asarray(ic))
-                dists = np.concatenate(d_parts)[:b_total]
-                ids = np.concatenate(i_parts)[:b_total]
-            else:
-                from surrealdb_tpu.parallel.mesh import sharded_knn
+    def _device_cfg(self) -> dict:
+        """Kernel budgets shipped per dispatch (read at call time so the
+        serving process's configuration governs the runner)."""
+        return {
+            "hbm_budget": cnf.KNN_HBM_BUDGET_BYTES,
+            "score_budget": cnf.KNN_SCORE_BUDGET_ELEMS,
+            "query_chunk": cnf.KNN_QUERY_CHUNK,
+            "int8_oversample": cnf.KNN_INT8_OVERSAMPLE,
+            "block_rows": BLOCK_ROWS,
+        }
 
-                dists, ids = sharded_knn(
-                    self.mesh, self.device_vecs, qs, self.device_valid, k,
-                    self.metric, self.mink_p,
-                )
-            dists = np.asarray(dists)
-            ids = np.asarray(ids)
-            return [
-                [
-                    (self.rids[int(i)], float(d))
-                    for d, i in zip(drow, irow)
-                    if 0 <= i < n and np.isfinite(d)
-                ]
-                for drow, irow in zip(dists, ids)
+    def _device_knn_batch(self, qvs: np.ndarray, k: int):
+        """Batched search through the device supervisor: [B, D] queries
+        -> per-query (rid, dist) lists. The runner ranks (bf16/int8/
+        sharded) and rescores where it holds f32 rows; the int8 path
+        returns candidates that are EXACTLY rescored here from the
+        full-precision host rows. Raises DeviceUnavailable for the
+        coalescer to degrade to the host path."""
+        from surrealdb_tpu.device import DeviceUnavailable, get_supervisor
+
+        sup = get_supervisor()
+        n = len(self.rids)
+        tag = [int(self.version), int(self._dev_epoch)]
+
+        def loader():
+            return "vec_load", {
+                "metric": self.metric,
+                "mink_p": self.mink_p,
+                "cfg": self._device_cfg(),
+            }, [
+                np.ascontiguousarray(self.vecs),
+                np.ascontiguousarray(self.valid.astype(np.uint8)),
             ]
-        if self.rank_mode == "int8":
-            from surrealdb_tpu.ops.topk import knn_rank_int8
 
-            kc = min(n, max(cnf.KNN_INT8_OVERSAMPLE * k, k + 16))
-            b_total = qs.shape[0]
-            # halve the score budget: the int8 kernel holds int32 dots AND
-            # the f32 score matrix at [chunk, N] concurrently
-            bucket, chunk, r = _pow2_chunks(
-                b_total, n, cnf.KNN_SCORE_BUDGET_ELEMS // 2
+        qs32 = np.ascontiguousarray(qvs, dtype=np.float32)
+        meta = bufs = None
+        for _attempt in (0, 1):
+            sup.ensure_loaded(self._dev_key, tag, loader)
+            t, meta, bufs = sup.call(
+                "vec_knn",
+                {"key": self._dev_key, "tag": tag, "k": int(k)},
+                [qs32],
             )
-            if bucket != b_total:
-                qs = jnp.pad(qs, ((0, bucket - b_total), (0, 0)))
-            cand = knn_rank_int8(
-                self.device_rank, self.device_arow, self.device_x2,
-                self.device_valid, qs.reshape(r, chunk, -1), kc, self.metric,
-            )
-            cand = np.asarray(cand).reshape(bucket, kc)[:b_total]
-            # exact host rescore from the full-precision rows (kc rows per
-            # query — tiny next to the store); per-query loop bounds the
-            # transient gather to [kc, D]
+            if t == "stale":
+                # runner evicted/restarted between load and query
+                sup.forget(self._dev_key)
+                continue
+            break
+        else:
+            # sup.unavailable: SdbError in require mode (the query must
+            # fail loudly), DeviceUnavailable (degrade to host) in auto
+            raise sup.unavailable("vec cache thrashing")
+        self.rank_mode = meta.get("rank_mode")
+        if meta.get("mode") == "cand":
+            # int8 ranking candidates: exact host rescore from the
+            # full-precision rows (kc rows per query — tiny next to the
+            # store); per-query loop bounds the gather to [kc, D]
+            cand = bufs[0]
             out = []
-            for b in range(b_total):
+            for b in range(cand.shape[0]):
                 ids_b = cand[b]
                 ids_b = ids_b[(ids_b >= 0) & (ids_b < n)]
                 rows = self.vecs[ids_b]
@@ -642,53 +537,7 @@ class TpuVectorIndex:
                     if np.isfinite(d[j])
                 ])
             return out
-        if self.device_rank is not None:
-            from surrealdb_tpu.ops.topk import knn_rank_rescore
-
-            # oversampling absorbs bf16/approx-top-k ranking error AND
-            # tombstoned rows ranked into the candidate set (sync() keeps
-            # fragmentation ≤ 25%, so 2k candidates leave ≥ 1.5k valid)
-            kc = min(n, max(2 * k, k + 16))
-            b_total = qs.shape[0]
-            # chunk queries into [R, chunk, D] so arbitrarily many queries
-            # ride ONE device dispatch (per-call latency amortization)
-            bucket, chunk, r = _pow2_chunks(
-                b_total, n, cnf.KNN_SCORE_BUDGET_ELEMS
-            )
-            if bucket != b_total:
-                qs = jnp.pad(qs, ((0, bucket - b_total), (0, 0)))
-            dists, ids = knn_rank_rescore(
-                self.device_rank, self.device_full,
-                qs.reshape(r, chunk, -1), min(k, kc), kc, self.metric,
-                self.device_x2, self.device_norms, self.device_valid,
-            )
-            dists = np.asarray(dists).reshape(bucket, -1)[:b_total]
-            ids = np.asarray(ids).reshape(bucket, -1)[:b_total]
-            out = []
-            for b in range(b_total):
-                row = []
-                for d, i in zip(dists[b], ids[b]):
-                    if not np.isfinite(d) or not (0 <= i < n):
-                        continue
-                    row.append((self.rids[int(i)], float(d)))
-                out.append(row)
-            return out
-        if n > BLOCK_ROWS:
-            from surrealdb_tpu.ops.topk import knn_search_blocked
-
-            dists, ids = knn_search_blocked(
-                self.device_vecs, qs, k, self.metric, self.mink_p,
-                self.device_valid,
-            )
-        else:
-            from surrealdb_tpu.ops.topk import knn_search
-
-            dists, ids = knn_search(
-                self.device_vecs, qs, k, self.metric, self.mink_p,
-                self.device_valid,
-            )
-        dists = np.asarray(dists)
-        ids = np.asarray(ids)
+        dists, ids = bufs
         return [
             [
                 (self.rids[int(i)], float(d))

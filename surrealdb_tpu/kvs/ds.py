@@ -186,6 +186,12 @@ class Datastore:
         from surrealdb_tpu.inflight import InflightRegistry
 
         self.inflight = InflightRegistry(self.telemetry)
+        # device supervisor health gauges (device_degraded,
+        # device_restarts, ...) — the supervisor itself is process-wide
+        # and lazy; registering gauges spawns nothing
+        from surrealdb_tpu.device import attach_telemetry
+
+        attach_telemetry(self.telemetry)
         # shared decoded-catalog cache (version, dict); local backends
         # only — a remote keyspace can change under us without a local
         # commit, so remote datastores skip it

@@ -18,15 +18,21 @@ Usage:
     proxy.set(drop_next=2)          # swallow the next 2 request frames
     proxy.set(delay_s=0.2)          # 200ms added to every request
     proxy.set(duplicate=True)       # send every request frame twice
+    proxy.set(corrupt_next=1)       # bit-flip the next request frame's
+                                    # body (checksum-detectable garbage)
     proxy.partition()               # black-hole both directions
     proxy.heal()
     proxy.set(kill_on_commit=(3, cb))  # cb() fires on the 3rd commit,
                                        # which is NOT forwarded
     proxy.stop()
+
+On-disk corruption (WAL/snapshot CRC tests) uses `flip_file_byte`:
+XOR one byte in place, exactly what a bad sector / torn DMA does.
 """
 
 from __future__ import annotations
 
+import os
 import random
 import socket
 import struct
@@ -35,6 +41,24 @@ import time
 from typing import Callable, Optional
 
 _HDR = struct.Struct(">I")
+
+
+def flip_file_byte(path: str, offset: int, xor: int = 0xFF) -> int:
+    """XOR one byte of a file in place (negative offset = from EOF).
+    Returns the absolute offset flipped. The on-disk analog of the
+    proxy's corrupt-frame fault — used to plant WAL/snapshot corruption
+    that recovery must DETECT (crc), never silently apply."""
+    size = os.path.getsize(path)
+    if offset < 0:
+        offset += size
+    if not 0 <= offset < size:
+        raise ValueError(f"offset {offset} outside file of {size} bytes")
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ (xor & 0xFF)]))
+    return offset
 
 
 def _recv_frame_raw(sock) -> Optional[bytes]:
@@ -81,6 +105,9 @@ class FaultProxy:
         self.drop_prob = 0.0  # swallow each request frame with prob p
         self.delay_s = 0.0  # added latency per request frame
         self.duplicate = False  # forward each request frame twice
+        self.corrupt_next = 0  # bit-flip the next N request frame bodies
+        self.corrupt_ops = None  # limit corruption to these ops (tuple)
+        self.frames_corrupted = 0
         self.partitioned = False  # black-hole both directions
         self.kill_on_commit: Optional[tuple[int, Callable[[], None]]] = None
         self.commits_seen = 0
@@ -218,8 +245,23 @@ class FaultProxy:
                 if self.drop_prob and self._rng.random() < self.drop_prob:
                     self.frames_dropped += 1
                     return True
+            corrupt = False
+            if (fire is None and self.corrupt_next > 0 and len(frame) > 8
+                    and (self.corrupt_ops is None
+                         or op in self.corrupt_ops)):
+                self.corrupt_next -= 1
+                self.frames_corrupted += 1
+                corrupt = True
             delay = self.delay_s
             dup = self.duplicate
+        if corrupt:
+            # flip one bit deep in the body, header untouched: the frame
+            # still parses as a frame but its payload is garbage —
+            # exactly the fault a payload checksum (and nothing weaker)
+            # catches
+            body = bytearray(frame)
+            body[4 + (len(frame) - 4) * 3 // 4] ^= 0x01
+            frame = bytes(body)
         if fire is not None:
             # the Nth commit: invoke the kill hook and DROP the frame —
             # the client must never see an ack for it

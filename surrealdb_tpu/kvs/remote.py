@@ -70,8 +70,10 @@ import random
 import socket
 import socketserver
 import struct
+import sys
 import threading
 import time
+import zlib
 from collections import Counter
 from typing import Callable, Optional
 
@@ -82,6 +84,15 @@ from surrealdb_tpu.kvs.mem import CONFLICT_MSG, VersionedStore
 
 _HDR = struct.Struct(">I")
 MAX_FRAME = 256 << 20
+
+# on-disk durability format (WAL + snapshot): files open with an 8-byte
+# magic, then frames of `u32 body_len | u32 crc32(body) | body`. A crc
+# mismatch is treated exactly like a torn tail — replay stops there,
+# the file truncates to the last good frame, and wal_crc_errors counts
+# it — so disk corruption is never silently applied. Files without the
+# magic are legacy (pre-CRC) logs: read without verification once, then
+# compacted to the checksummed format.
+_LOG_MAGIC = b"SKVCRC01"
 
 # -- sharding metadata keyspace (kvs/shard.py rides these) ------------------
 # Internal keys live under the \x00 prefix: every user-visible key this
@@ -127,6 +138,13 @@ def _decode(b: bytes):
     from surrealdb_tpu import wire
 
     return wire.decode(b)
+
+
+def _frame_crc(body: bytes) -> bytes:
+    """One checksummed log frame: u32 len | u32 crc32(body) | body."""
+    return _HDR.pack(len(body)) + _HDR.pack(
+        zlib.crc32(body) & 0xFFFFFFFF
+    ) + body
 
 
 def _parse_addr(addr: str) -> tuple[str, int]:
@@ -473,7 +491,13 @@ class _KvHandler(socketserver.BaseRequestHandler):
             _op, pid, paddr, seq = req
             return ["ok", srv.repl_hello(pid, paddr, seq)]
         if op == "repl_apply":
-            _op, pid, seq, pairs = req
+            if len(req) == 5:
+                # blob+crc form: the replica verifies byte integrity
+                # BEFORE apply (see KvServer.repl_apply)
+                _op, pid, seq, blob, crc = req
+                return ["ok", srv.repl_apply(pid, seq, None,
+                                             bytes(blob), int(crc))]
+            _op, pid, seq, pairs = req  # legacy unchecked form
             return ["ok", srv.repl_apply(pid, seq, pairs)]
         if op == "repl_sync":
             _op, pid, seq, items = req
@@ -551,12 +575,16 @@ class _ReplLink:
             c.close()
             raise
 
-    def send(self, seq: int, pairs) -> bool:
-        # caller holds wal_lock
+    def send(self, seq: int, blob: bytes, crc: int) -> bool:
+        # caller holds wal_lock. The writeset ships as one encoded blob
+        # + crc32 so the replica can verify byte integrity BEFORE apply
+        # (a corrupted frame detaches the link; reattach full-resyncs).
         if not self.attached or self.conn is None:
             return False
         try:
-            self.conn.call(["repl_apply", self.server.node_id, seq, pairs])
+            self.conn.call(
+                ["repl_apply", self.server.node_id, seq, blob, crc]
+            )
             return True
         except Exception:
             self._detach()
@@ -577,9 +605,9 @@ class _Replicator:
     def __init__(self, server: "KvServer", peer_addrs: list[str]):
         self.links = [_ReplLink(server, a) for a in peer_addrs]
 
-    def ship(self, seq: int, pairs):
+    def ship(self, seq: int, blob: bytes, crc: int):
         for link in self.links:
-            link.send(seq, pairs)
+            link.send(seq, blob, crc)
 
     def attached_count(self) -> int:
         return sum(1 for link in self.links if link.attached)
@@ -972,7 +1000,19 @@ class KvServer(socketserver.ThreadingTCPServer):
                 self.applied_seq = -1
             return self.applied_seq
 
-    def repl_apply(self, primary_id: str, seq: int, pairs):
+    def repl_apply(self, primary_id: str, seq: int, pairs,
+                   blob: Optional[bytes] = None,
+                   crc: Optional[int] = None):
+        if blob is not None:
+            # verify BEFORE taking locks or touching state: a corrupted
+            # frame must never be applied (the sender's link detaches on
+            # the error and reattachment full-resyncs)
+            if zlib.crc32(blob) & 0xFFFFFFFF != crc:
+                self.counters["repl_crc_errors"] += 1
+                raise SdbError(
+                    f"kv repl: frame crc mismatch at seq {seq}"
+                )
+            pairs = _decode(blob)
         with self.wal_lock:
             if self.role != "replica":
                 raise SdbError(f"kv not replica (role={self.role})")
@@ -1028,8 +1068,8 @@ class KvServer(socketserver.ThreadingTCPServer):
         if self.repl is None:
             return
         self.repl_seq += 1
-        pairs = [[k, v] for k, v in writes.items()]
-        self.repl.ship(self.repl_seq, pairs)
+        blob = _encode([[k, v] for k, v in writes.items()])
+        self.repl.ship(self.repl_seq, blob, zlib.crc32(blob) & 0xFFFFFFFF)
         self.counters["repl_shipped"] += 1
 
     def _start_renewal(self):
@@ -1194,45 +1234,98 @@ class KvServer(socketserver.ThreadingTCPServer):
     def _wal_path(self):
         return os.path.join(self.data_dir, "wal.log")
 
-    @staticmethod
-    def _read_frames(path):
-        """Yield decoded frames; stops cleanly at a torn tail."""
+    def _scan_log(self, path, what: str, apply):
+        """Stream verified frames of a WAL/snapshot file into `apply`
+        (one decoded frame at a time — a multi-GB log must never be
+        materialized as a list on top of the store it seeds).
+
+        Stops at a torn tail OR a crc mismatch (counted as
+        wal_crc_errors and warned — corruption must never be applied
+        silently). Returns (legacy, clean_end): `clean_end` is the byte
+        offset after the last verified frame (the truncation point for
+        replay recovery); `legacy` marks a pre-CRC file (read
+        unverified once, compacted right after)."""
         with open(path, "rb") as f:
+            head = f.read(len(_LOG_MAGIC))
+            legacy = head != _LOG_MAGIC
+            if legacy:
+                f.seek(0)
+            clean = f.tell()
             while True:
-                hdr = f.read(4)
-                if len(hdr) < 4:
-                    return
-                (n,) = _HDR.unpack(hdr)
+                hdr = f.read(4 if legacy else 8)
+                if len(hdr) < (4 if legacy else 8):
+                    break
+                (n,) = _HDR.unpack(hdr[:4])
                 body = f.read(n)
                 if len(body) < n:
-                    return  # torn write from a crash — ignore the tail
-                yield _decode(body)
+                    break  # torn write from a crash — ignore the tail
+                if not legacy:
+                    (want,) = _HDR.unpack(hdr[4:8])
+                    if zlib.crc32(body) & 0xFFFFFFFF != want:
+                        self.counters["wal_crc_errors"] += 1
+                        print(
+                            f"kv: {what} crc mismatch at offset {clean} "
+                            f"of {path} — truncating (torn-tail "
+                            f"semantics; later records are lost)",
+                            file=sys.stderr, flush=True,
+                        )
+                        break
+                try:
+                    frame = _decode(body)
+                except Exception:
+                    # undecodable bytes that passed crc can only be a
+                    # legacy-format torn record: stop, don't apply
+                    self.counters["wal_crc_errors"] += 1
+                    break
+                apply(frame)
+                clean = f.tell()
+        return legacy, clean
 
     def _recover(self):
         os.makedirs(self.data_dir, exist_ok=True)
         sp, wp = self._snap_path(), self._wal_path()
+        legacy_any = False
+        wal_dirty = False
+        snap_dirty = False
+        replayed = 0
         with self.vs.lock:
             if os.path.exists(sp):
-                for pairs in self._read_frames(sp):
+                def seed(pairs):
                     for k, v in pairs:
                         self.vs.seed(bytes(k), bytes(v))
-            replayed = 0
+
+                legacy, clean = self._scan_log(sp, "snapshot", seed)
+                legacy_any |= legacy
+                # a corrupt snapshot tail must be folded away NOW, or
+                # every restart re-hits (and re-warns about) the same
+                # bad frame as if fresh corruption kept appearing
+                snap_dirty = clean < os.path.getsize(sp)
             if os.path.exists(wp):
-                for pairs in self._read_frames(wp):
-                    snap = self.vs.snapshot()
+                def commit(pairs):
+                    nonlocal replayed
                     writes = {
                         bytes(k): (None if v is None else bytes(v))
                         for k, v in pairs
                     }
-                    self.vs.commit(writes, snap)
+                    self.vs.commit(writes, self.vs.snapshot())
                     replayed += 1
-        # fold the replayed log into the snapshot so restarts stay O(data)
-        if replayed or (
+
+                legacy, clean = self._scan_log(wp, "wal", commit)
+                legacy_any |= legacy
+                wal_dirty = clean < os.path.getsize(wp)
+        # fold the replayed log into the snapshot so restarts stay
+        # O(data); also rewrites torn/corrupt tails and upgrades legacy
+        # (pre-CRC) files to the checksummed format
+        if replayed or legacy_any or wal_dirty or snap_dirty or (
             os.path.exists(wp)
             and os.path.getsize(wp) > self.WAL_COMPACT_BYTES
         ):
             self._compact()
-        self.wal = open(wp, "ab")
+        else:
+            self.wal = open(wp, "ab")
+            if self.wal.tell() == 0:
+                self.wal.write(_LOG_MAGIC)
+                self.wal.flush()
 
     def _compact(self):
         """Write the live keyspace to snapshot.kv and truncate the WAL."""
@@ -1242,17 +1335,16 @@ class KvServer(socketserver.ThreadingTCPServer):
             snap = self.vs.snapshot()
         try:
             with open(tmp, "wb") as f:
+                f.write(_LOG_MAGIC)
                 batch = []
                 for k, v in self.vs.range_items(b"", b"\xff" * 9, snap,
                                                 None, False):
                     batch.append([k, v])
                     if len(batch) >= 512:
-                        fr = _encode(batch)
-                        f.write(_HDR.pack(len(fr)) + fr)
+                        f.write(_frame_crc(_encode(batch)))
                         batch = []
                 if batch:
-                    fr = _encode(batch)
-                    f.write(_HDR.pack(len(fr)) + fr)
+                    f.write(_frame_crc(_encode(batch)))
                 f.flush()
                 os.fsync(f.fileno())
         finally:
@@ -1268,17 +1360,19 @@ class KvServer(socketserver.ThreadingTCPServer):
         if self.wal is not None:
             self.wal.close()
         self.wal = open(wp, "wb")
+        self.wal.write(_LOG_MAGIC)
         self.wal.flush()
         os.fsync(self.wal.fileno())
 
     def log_commit(self, writes: dict):
-        """Append one committed writeset to the WAL — called BEFORE the
-        client sees the ok, so an acknowledged commit survives a crash."""
+        """Append one committed writeset to the WAL (with its crc32) —
+        called BEFORE the client sees the ok, so an acknowledged commit
+        survives a crash and corruption is detected at replay."""
         if self.wal is None:
             return
-        fr = _encode([[k, v] for k, v in writes.items()])
+        fr = _frame_crc(_encode([[k, v] for k, v in writes.items()]))
         with self.wal_lock:
-            self.wal.write(_HDR.pack(len(fr)) + fr)
+            self.wal.write(fr)
             self.wal.flush()
             if self.fsync:
                 os.fsync(self.wal.fileno())

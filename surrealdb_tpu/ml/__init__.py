@@ -134,29 +134,31 @@ class SurmlFile:
 def _jax_forward(payload: bytes, x: np.ndarray):
     """JAX-native payload: npz with `spec` (JSON list of layers) and the
     named weight arrays. Layers: {"op": "dense", "w": key, "b": key?,
-    "act": "relu"|"sigmoid"|"tanh"|"softmax"|None}."""
-    import jax.numpy as jnp
+    "act": "relu"|"sigmoid"|"tanh"|"softmax"|None}.
 
+    Executed in f32 numpy: these are tiny MLP heads, and model predict
+    runs on query worker threads where jax imports are forbidden
+    (check_robustness rule 5) — the math is identical."""
     z = np.load(io.BytesIO(payload), allow_pickle=False)
     spec = json.loads(bytes(z["spec"]).decode())
-    h = jnp.asarray(x, dtype=jnp.float32)
+    h = np.asarray(x, dtype=np.float32)
     for layer in spec:
         if layer["op"] == "dense":
-            w = jnp.asarray(z[layer["w"]])
+            w = np.asarray(z[layer["w"]], dtype=np.float32)
             h = h @ w
             if layer.get("b"):
-                h = h + jnp.asarray(z[layer["b"]])
+                h = h + np.asarray(z[layer["b"]], dtype=np.float32)
             act = layer.get("act")
             if act == "relu":
-                h = jnp.maximum(h, 0)
+                h = np.maximum(h, 0)
             elif act == "sigmoid":
-                h = 1.0 / (1.0 + jnp.exp(-h))
+                h = 1.0 / (1.0 + np.exp(-h))
             elif act == "tanh":
-                h = jnp.tanh(h)
+                h = np.tanh(h)
             elif act == "softmax":
-                m = jnp.max(h, axis=-1, keepdims=True)
-                e = jnp.exp(h - m)
-                h = e / jnp.sum(e, axis=-1, keepdims=True)
+                m = np.max(h, axis=-1, keepdims=True)
+                e = np.exp(h - m)
+                h = e / np.sum(e, axis=-1, keepdims=True)
         else:
             raise SdbError(f"unknown jax layer op '{layer['op']}'")
     return np.asarray(h)

@@ -157,13 +157,31 @@ def lease_tso_window(txn_factory, n: int, retries: int = 32):
 
 
 def heartbeat(ds) -> None:
-    """Write this node's registry row (id -> last-seen timestamp)."""
+    """Write this node's registry row: (last-seen ts, device state).
+    The device state rides the heartbeat so cluster-level monitoring
+    sees which nodes are serving accelerated paths and which have
+    degraded to host execution (device/supervisor.py states). Legacy
+    bare-float rows are still read by membership_check."""
+    from surrealdb_tpu.device import get_supervisor
+
     txn = ds.transaction(write=True)
     try:
-        txn.set_val(K.node(ds.node_id), time.time())
+        txn.set_val(
+            K.node(ds.node_id), (time.time(), get_supervisor().state)
+        )
         txn.commit()
     except SdbError:
         txn.cancel()
+
+
+def _hb_ts(row) -> float:
+    """Heartbeat timestamp from a registry row (tuple or legacy float)."""
+    if isinstance(row, (tuple, list)) and row:
+        return float(row[0])
+    try:
+        return float(row)
+    except (TypeError, ValueError):
+        return 0.0
 
 
 def membership_check(ds, stale_s: float = 30.0) -> list[str]:
@@ -179,7 +197,7 @@ def membership_check(ds, stale_s: float = 30.0) -> list[str]:
         dead = []
         for k, seen in txn.scan_vals(*K.prefix_range(K.node_prefix())):
             nid, _ = K.dec_str(k, len(K.node_prefix()))
-            if nid != ds.node_id and now - seen > stale_s:
+            if nid != ds.node_id and now - _hb_ts(seen) > stale_s:
                 dead.append(nid)
                 txn.delete(k)
         if dead:

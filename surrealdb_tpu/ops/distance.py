@@ -14,16 +14,21 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-# metric ids kept stable for jit static args
-EUCLIDEAN = "euclidean"
-COSINE = "cosine"
-MANHATTAN = "manhattan"
-CHEBYSHEV = "chebyshev"
-HAMMING = "hamming"
-MINKOWSKI = "minkowski"
-DOT = "dot"
-JACCARD = "jaccard"
-PEARSON = "pearson"
+# metric ids kept stable for jit static args; the names (and
+# normalize_metric) live in the jax-free ops/metrics.py so query-path
+# code can import them without touching this kernel module
+from surrealdb_tpu.ops.metrics import (  # noqa: F401 (re-export)
+    CHEBYSHEV,
+    COSINE,
+    DOT,
+    EUCLIDEAN,
+    HAMMING,
+    JACCARD,
+    MANHATTAN,
+    MINKOWSKI,
+    PEARSON,
+    normalize_metric,
+)
 
 
 @partial(jax.jit, static_argnames=("metric",))
@@ -69,21 +74,3 @@ def distance_matrix(xs, qs, metric: str = EUCLIDEAN, p: float = 3.0):
     raise ValueError(f"unknown metric {metric!r}")
 
 
-def normalize_metric(dist) -> tuple[str, float]:
-    """Catalog distance spec -> (metric id, minkowski order)."""
-    if isinstance(dist, tuple) and dist[0] == "minkowski":
-        return MINKOWSKI, float(dist[1])
-    name = str(dist).lower()
-    table = {
-        "euclidean": EUCLIDEAN,
-        "cosine": COSINE,
-        "manhattan": MANHATTAN,
-        "chebyshev": CHEBYSHEV,
-        "hamming": HAMMING,
-        "jaccard": JACCARD,
-        "pearson": PEARSON,
-        "dot": DOT,
-    }
-    if name not in table:
-        raise ValueError(f"unsupported distance {dist!r}")
-    return table[name], 3.0

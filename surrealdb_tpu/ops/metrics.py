@@ -1,0 +1,38 @@
+"""Metric-name normalization, importable from query-execution code.
+
+Kept jax-free on purpose: `ops/distance.py` (the kernel module) imports
+jax at module level, so query-path code (idx/vector.py, idx/planner.py)
+must resolve metric specs through THIS module — tools/check_robustness.py
+rule 5 forbids jax imports outside the device/kernel tree."""
+
+from __future__ import annotations
+
+EUCLIDEAN = "euclidean"
+COSINE = "cosine"
+MANHATTAN = "manhattan"
+CHEBYSHEV = "chebyshev"
+HAMMING = "hamming"
+MINKOWSKI = "minkowski"
+DOT = "dot"
+JACCARD = "jaccard"
+PEARSON = "pearson"
+
+
+def normalize_metric(dist) -> tuple[str, float]:
+    """Catalog distance spec -> (metric id, minkowski order)."""
+    if isinstance(dist, tuple) and dist[0] == "minkowski":
+        return MINKOWSKI, float(dist[1])
+    name = str(dist).lower()
+    table = {
+        "euclidean": EUCLIDEAN,
+        "cosine": COSINE,
+        "manhattan": MANHATTAN,
+        "chebyshev": CHEBYSHEV,
+        "hamming": HAMMING,
+        "jaccard": JACCARD,
+        "pearson": PEARSON,
+        "dot": DOT,
+    }
+    if name not in table:
+        raise ValueError(f"unsupported distance {dist!r}")
+    return table[name], 3.0

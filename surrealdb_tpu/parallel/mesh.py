@@ -1,5 +1,10 @@
 """Sharded KNN over a device mesh.
 
+RUNNER-SIDE ONLY: this module imports jax at module level, so it may
+only be imported from the DeviceRunner subprocess (surrealdb_tpu.device
+— which builds the mesh during vec_load), bench/tooling, or tests —
+never from query-execution code (tools/check_robustness.py rule 5).
+
 Vectors live row-sharded across devices ("data" axis). The production
 multi-chip kernel is the SAME two-stage design as single-chip
 (ops/topk.py knn_rank_rescore): each shard ranks its local rows with one

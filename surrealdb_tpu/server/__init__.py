@@ -907,6 +907,11 @@ def drain_and_shutdown(srv, ds: Datastore, drain_timeout_s: float) -> bool:
         while ds.inflight.count() > 0 and time.monotonic() < end:
             time.sleep(0.02)
     srv.shutdown()
+    # the DeviceRunner holds nothing durable (its caches rebuild from
+    # KV truth) — kill it with the server instead of leaving an orphan
+    from surrealdb_tpu.device import get_supervisor
+
+    get_supervisor().shutdown()
     return clean
 
 
@@ -939,6 +944,13 @@ def serve(ds: Datastore, host="127.0.0.1", port=8000, unauthenticated=False,
     # served nodes join the cluster: heartbeat + membership GC loops
     # (reference engine/tasks.rs); embedded datastores stay single-node
     ds.start_node_tasks()
+    # prewarm the device runner at boot (async): jax/TPU init happens in
+    # the supervised subprocess under the init watchdog while the server
+    # is already accepting — early queries serve from host, traffic
+    # moves to the device when the runner reports ready
+    from surrealdb_tpu.device import get_supervisor
+
+    get_supervisor().ensure_started()
     scheme = "https" if tls_cert else "http"
     print(f"surrealdb-tpu listening on {scheme}://{host}:{port}")
     srv.serve_forever()

@@ -5,6 +5,11 @@ exercised without TPU hardware (the driver dry-runs multichip the same way).
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# device ops run in-process by default under pytest: the suite already
+# initializes jax on CPU, and inline mode keeps cnf/jax monkeypatching
+# effective for the kernel-selection tests. The chaos suite
+# (test_device_chaos.py) installs real subprocess supervisors itself.
+os.environ.setdefault("SURREAL_DEVICE", "inline")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

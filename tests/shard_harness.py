@@ -90,3 +90,60 @@ def two_shard_smoke():
                 ds.close()
     except Exception as e:  # surface, don't crash the gate
         return f"2-shard smoke: {e.__class__.__name__}: {e}"
+
+
+def device_degraded_smoke():
+    """Gate smoke for the degrade-and-recover contract: a 2-shard store
+    whose device supervisor is DEGRADED (circuit open, as after a
+    runner crash) must serve KNN and graph traversals correctly from
+    the host paths, count the fallbacks, and report the state through
+    INFO FOR SYSTEM. Returns None on success, else an error string."""
+    import surrealdb_tpu.idx.vector as V
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu.device import DeviceSupervisor, set_supervisor
+
+    sup = DeviceSupervisor(mode="auto", probe_interval_s=3600.0)
+    sup._mark_degraded("forced by conformance smoke")
+    old_sup = set_supervisor(sup)
+    old_min = V.DEVICE_MIN_ROWS
+    V.DEVICE_MIN_ROWS = 16
+    try:
+        with sharded_cluster([b"/*n"]) as (_groups, meta_addr):
+            ds = Datastore(f"shard://{meta_addr}")
+            try:
+                stmts = ["DEFINE TABLE pts; DEFINE INDEX ix ON pts "
+                         "FIELDS emb HNSW DIMENSION 4 TYPE F32;"]
+                for i in range(48):
+                    stmts.append(
+                        f"CREATE pts:{i} SET emb = "
+                        f"[{i}.0, {i % 7}.0, 0.0, 1.0];"
+                    )
+                stmts.append("RELATE pts:0->e->pts:1; "
+                             "RELATE pts:1->e->pts:2;")
+                ds.query("".join(stmts), ns="z", db="z")
+                got = ds.query(
+                    "SELECT VALUE id FROM pts WHERE emb <|3,8|> "
+                    "[9.0, 2.0, 0.0, 1.0]", ns="z", db="z")[0]
+                if not got or got[0].id != 9:
+                    return f"device-degraded smoke: wrong KNN: {got!r}"
+                hops = ds.query("SELECT VALUE ->e->pts FROM ONLY pts:0",
+                                ns="z", db="z")[0]
+                if [r.id for r in hops] != [1]:
+                    return f"device-degraded smoke: wrong hop: {hops!r}"
+                info = ds.query("INFO FOR SYSTEM", ns="z", db="z")[0]
+                dev = info.get("device") or {}
+                if dev.get("state") != "degraded":
+                    return (f"device-degraded smoke: INFO device state "
+                            f"{dev.get('state')!r}, want 'degraded'")
+                if sup.counters["device_fallbacks"] < 1:
+                    return ("device-degraded smoke: host fallback "
+                            "not counted")
+                return None
+            finally:
+                ds.close()
+    except Exception as e:  # surface, don't crash the gate
+        return f"device-degraded smoke: {e.__class__.__name__}: {e}"
+    finally:
+        V.DEVICE_MIN_ROWS = old_min
+        set_supervisor(old_sup)
+        sup.shutdown()

@@ -124,7 +124,14 @@ def test_tpu_vector_index_sharded_1m():
     ix.version = 0  # pretend synced
     q = rng.normal(size=(dim,)).astype(np.float32)
     pairs = ix._raw_knn(q, k)
-    assert ix.mesh is not None and ix.device_rank is not None, "sharded rank path not engaged"
+    # device blocks live runner-side now: introspect through the inline
+    # supervisor's store (conftest pins SURREAL_DEVICE=inline)
+    from surrealdb_tpu.device import get_supervisor
+
+    st = get_supervisor().inline_store(ix._dev_key)
+    assert st is not None and st.mesh is not None \
+        and st.device_rank is not None, "sharded rank path not engaged"
+    assert ix.rank_mode == "bf16"
     assert len(pairs) == k
     got = {r.id for r, _ in pairs}
     assert not any(i % 97 == 0 for i in got), "tombstoned row returned"
@@ -158,14 +165,18 @@ def test_sharded_to_int8_transition_requeries():
     ix.version = 0
     q = rng.normal(size=(dim,)).astype(np.float32)
     first = ix._raw_knn(q, k)
-    assert ix.mesh is not None and ix.rank_mode == "bf16"
+    from surrealdb_tpu.device import get_supervisor
+
+    assert get_supervisor().inline_store(ix._dev_key).mesh is not None
+    assert ix.rank_mode == "bf16"
     old = cnf.KNN_HBM_BUDGET_BYTES
     cnf.KNN_HBM_BUDGET_BYTES = 6 * n * dim // 16  # force int8 on rebuild
     try:
         ix._drop_device()  # what update()/_rebuild() do
-        assert ix.mesh is None
+        assert ix.rank_mode is None  # cache epoch bumped: re-ship next
         second = ix._raw_knn(q, k)
         assert ix.rank_mode == "int8"
+        assert get_supervisor().inline_store(ix._dev_key).mesh is None
     finally:
         cnf.KNN_HBM_BUDGET_BYTES = old
     assert [r.id for r, _ in first] == [r.id for r, _ in second]

@@ -21,6 +21,17 @@ re-litigating:
    mark/split) hides a stuck or diverging two-phase commit. Record a
    telemetry counter, re-raise, or carry a `# robust:` pragma stating
    why the swallow is safe.
+5. **No `import jax` reachable from a query worker thread** — jax may
+   only be imported under `surrealdb_tpu/device/` (the supervised
+   runner that owns all accelerator state), `surrealdb_tpu/parallel/`
+   and `surrealdb_tpu/ops/` (the kernel library, imported exclusively
+   runner-side — query code resolves metric names via the jax-free
+   `ops/metrics.py`), and `surrealdb_tpu/ml/onnx.py` (the ONNX model
+   runtime, a documented exception pending its own runner dispatch).
+   Anywhere else — the executor, planners, indexes, graph engine,
+   server — an `import jax` puts backend init (which has wedged whole
+   rounds, ROUND5_NOTES) on a live query thread. Bench/tooling outside
+   `surrealdb_tpu/` is not scanned.
 
 Usage:  python tools/check_robustness.py [root]
 Exit status 1 when any finding survives.
@@ -38,6 +49,25 @@ PRAGMA = "# robust:"
 # files + function-name shape that rule 4 (2PC decision paths) covers
 _TWOPC_FILES = ("surrealdb_tpu/kvs/shard.py", "surrealdb_tpu/kvs/remote.py")
 _DECISION_FN = re.compile(r"commit|prepare|decide|resolve|mark|split")
+
+# rule 5: the only places inside the package allowed to import jax —
+# the supervised runner tree and the kernel library it dispatches to
+_JAX_ALLOWED = (
+    "surrealdb_tpu/device/",
+    "surrealdb_tpu/parallel/",
+    "surrealdb_tpu/ops/",
+    "surrealdb_tpu/ml/onnx.py",
+)
+
+
+def _imports_jax(node) -> bool:
+    if isinstance(node, ast.Import):
+        return any(a.name == "jax" or a.name.startswith("jax.")
+                   for a in node.names)
+    if isinstance(node, ast.ImportFrom):
+        m = node.module or ""
+        return m == "jax" or m.startswith("jax.")
+    return False
 
 
 def _pragma(lines: list[str], lineno: int) -> bool:
@@ -73,7 +103,21 @@ def check_file(path: str, rel: str) -> list[str]:
     except SyntaxError as e:
         return [f"{rel}:{e.lineno}: syntax error: {e.msg}"]
     findings = []
+    rel_fwd = rel.replace(os.sep, "/")
+    jax_ok = any(
+        rel_fwd.startswith(p) or rel_fwd == p.rstrip("/")
+        for p in _JAX_ALLOWED
+    )
     for node in ast.walk(tree):
+        # 5. jax import outside the device/kernel tree
+        if not jax_ok and _imports_jax(node) \
+                and not _pragma(lines, node.lineno):
+            findings.append(
+                f"{rel}:{node.lineno}: `import jax` outside "
+                f"{'|'.join(_JAX_ALLOWED)} — backend init must never "
+                f"run on a query worker thread (dispatch via "
+                f"surrealdb_tpu.device instead)"
+            )
         # 1. bare except
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             if not _pragma(lines, node.lineno):

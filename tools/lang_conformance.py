@@ -16,6 +16,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# run device ops in-process: the gate is single-shot and CPU-pinned, a
+# supervised runner subprocess would only add spawn latency (the
+# degraded-path smoke below installs its own supervisor)
+os.environ.setdefault("SURREAL_DEVICE", "inline")
 
 
 def main():
@@ -109,13 +113,22 @@ def main():
     )])
     # 2-shard smoke: the full SQL surface must keep working over a
     # range-sharded store (routing, cross-shard 2PC, scan stitching)
-    from shard_harness import two_shard_smoke
+    from shard_harness import device_degraded_smoke, two_shard_smoke
 
     err = two_shard_smoke()
     if err is None:
         print("== 2-shard smoke: OK")
     else:
         print(f"== 2-shard smoke: FAIL — {err}")
+        rc = rc or 1
+    # device-degraded smoke: with the accelerator circuit OPEN (as
+    # after a runner crash), KNN + graph queries over the sharded store
+    # must serve correctly from host paths and report the state
+    err = device_degraded_smoke()
+    if err is None:
+        print("== device-degraded smoke: OK")
+    else:
+        print(f"== device-degraded smoke: FAIL — {err}")
         rc = rc or 1
     return rc
 
