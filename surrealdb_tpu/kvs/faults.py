@@ -21,6 +21,9 @@ Usage:
     proxy.set(corrupt_next=1)       # bit-flip the next request frame's
                                     # body (checksum-detectable garbage)
     proxy.partition()               # black-hole both directions
+    proxy.partition("to_server")    # asymmetric: requests vanish,
+                                    # responses still flow
+    proxy.partition("to_client")    # asymmetric: responses vanish
     proxy.heal()
     proxy.set(kill_on_commit=(3, cb))  # cb() fires on the 3rd commit,
                                        # which is NOT forwarded
@@ -108,7 +111,11 @@ class FaultProxy:
         self.corrupt_next = 0  # bit-flip the next N request frame bodies
         self.corrupt_ops = None  # limit corruption to these ops (tuple)
         self.frames_corrupted = 0
-        self.partitioned = False  # black-hole both directions
+        # directions currently black-holed: subset of
+        # {"to_server", "to_client"} — the asymmetric-partition
+        # vocabulary shared with the simulator's transport.
+        # `partitioned` (both directions cut) derives from it.
+        self.partition_dirs: set = set()
         self.kill_on_commit: Optional[tuple[int, Callable[[], None]]] = None
         self.commits_seen = 0
         self.frames_forwarded = 0
@@ -139,16 +146,43 @@ class FaultProxy:
                     raise AttributeError(f"unknown fault knob {k!r}")
                 setattr(self, k, v)
 
-    def partition(self):
-        """Black-hole the link: existing frames stop flowing in BOTH
-        directions (connections stay open — the nastier failure mode,
-        since the peer sees silence, not a reset)."""
-        with self._lock:
-            self.partitioned = True
+    def partition(self, direction: str = "both"):
+        """Black-hole the link (connections stay open — the nastier
+        failure mode, since the peer sees silence, not a reset).
 
-    def heal(self):
+        `direction` selects what vanishes: "both" (default, the classic
+        symmetric partition), "to_server" (requests are swallowed but
+        responses already in flight still arrive), or "to_client"
+        (requests reach the server — which ACTS on them — but every
+        response disappears: the ack-loss failure mode)."""
+        if direction not in ("both", "to_server", "to_client"):
+            raise ValueError(f"unknown partition direction {direction!r}")
         with self._lock:
-            self.partitioned = False
+            if direction == "both":
+                self.partition_dirs = {"to_server", "to_client"}
+            else:
+                self.partition_dirs.add(direction)
+
+    def heal(self, direction: str = "both"):
+        """Lift a partition (by default all of it; pass a single
+        direction to heal an asymmetric cut one way at a time)."""
+        with self._lock:
+            if direction == "both":
+                self.partition_dirs = set()
+            else:
+                self.partition_dirs.discard(direction)
+
+    @property
+    def partitioned(self) -> bool:
+        """True when BOTH directions are cut — a derived view so the
+        two representations can never fall out of sync."""
+        return self.partition_dirs == {"to_server", "to_client"}
+
+    @partitioned.setter
+    def partitioned(self, v: bool):
+        # `set(partitioned=True)` keeps working as the symmetric cut
+        self.partition_dirs = ({"to_server", "to_client"} if v
+                               else set())
 
     def sever(self):
         """Hard-close every proxied connection (connection-reset mode)."""
@@ -214,9 +248,10 @@ class FaultProxy:
 
     def _forward(self, frame: bytes, dst: socket.socket,
                  is_request: bool) -> bool:
-        # partition: silently swallow traffic in both directions
+        # partition: silently swallow traffic in the cut direction(s)
         with self._lock:
-            if self.partitioned:
+            cut = ("to_server" if is_request else "to_client")
+            if cut in self.partition_dirs:
                 self.frames_dropped += 1
                 return True
         if not is_request:

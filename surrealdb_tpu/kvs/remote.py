@@ -72,18 +72,25 @@ import socketserver
 import struct
 import sys
 import threading
-import time
 import zlib
 from collections import Counter
 from typing import Callable, Optional
 
 from surrealdb_tpu import cnf
 from surrealdb_tpu.err import RetryableKvError, SdbError
+from surrealdb_tpu.kvs import net
 from surrealdb_tpu.kvs.api import Backend, BackendTx
 from surrealdb_tpu.kvs.mem import CONFLICT_MSG, VersionedStore
+from surrealdb_tpu.kvs.net import (
+    MAX_FRAME,  # noqa: F401 — re-export; net.recv_frame enforces it
+    STOP,
+    _Conn,
+    parse_addr as _parse_addr,
+    recv_frame as _recv_frame,
+    send_frame as _send_frame,
+)
 
 _HDR = struct.Struct(">I")
-MAX_FRAME = 256 << 20
 
 # on-disk durability format (WAL + snapshot): files open with an 8-byte
 # magic, then frames of `u32 body_len | u32 crc32(body) | body`. A crc
@@ -104,28 +111,30 @@ SHARD_CFG_KEY = b"\x00!shardcfg"  # this server's (beg, end, epoch)
 SHARD_MAP_KEY = b"\x00!shardmap"  # cluster shard map (meta shard only)
 PREP_PREFIX = b"\x00!prep/"  # staged 2PC writesets, one per txid
 TXNLOG_PREFIX = b"\x00!txnlog/"  # coordinator decisions (meta shard)
+# durable freshness credential: [lineage_node_id, seq, era], stamped by
+# the primary into every replicated writeset. `era` increments at every
+# promotion/boot-as-primary, `seq` is the replication sequence — so
+# (era, seq) totally orders replicas by how much acked history they
+# hold, and the order SURVIVES restarts (the row recovers from the
+# WAL). Elections use it to never promote a stale replica over a
+# fresher live one — the in-memory applied_seq resets on reboot and
+# must not be trusted for that.
+REPL_STATE_KEY = b"\x00!replstate"
 INF_END = b"\xff" * 9  # "end of keyspace" sentinel (matches compaction)
 
 
-def _send_frame(sock, payload: bytes):
-    sock.sendall(_HDR.pack(len(payload)) + payload)
-
-
-def _recv_exact(sock, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("kv peer closed")
-        buf.extend(chunk)
-    return bytes(buf)
-
-
-def _recv_frame(sock) -> bytes:
-    (n,) = _HDR.unpack(_recv_exact(sock, 4))
-    if n > MAX_FRAME:
-        raise SdbError(f"kv frame too large: {n}")
-    return _recv_exact(sock, n)
+def _repl_rank(raw) -> tuple[int, int]:
+    """(era, seq) promotion rank from a replstate row (decoded list or
+    raw bytes); (-1, -1) when absent/corrupt."""
+    try:
+        if raw is None:
+            return (-1, -1)
+        if isinstance(raw, (bytes, bytearray, memoryview)):
+            raw = _decode(bytes(raw))
+        _lineage, seq, era = raw
+        return (int(era), int(seq))
+    except Exception:
+        return (-1, -1)
 
 
 def _encode(msg) -> bytes:
@@ -147,13 +156,6 @@ def _frame_crc(body: bytes) -> bytes:
     ) + body
 
 
-def _parse_addr(addr: str) -> tuple[str, int]:
-    host, _, port = addr.rpartition(":")
-    if not host or not port.isdigit():
-        raise SdbError(f"kv address must be host:port, got {addr!r}")
-    return host, int(port)
-
-
 # ---------------------------------------------------------------------------
 # retry policy (client side)
 # ---------------------------------------------------------------------------
@@ -172,10 +174,14 @@ def is_retryable(e: BaseException) -> bool:
         # retryable, and the router marks its shard map stale the moment
         # one arrives — reads refresh + re-route inline, an aborted
         # write transaction's retry starts against the refreshed map
+        # "not replicated": the primary refused to ack because no
+        # replica was attached to receive the write — retryable, and the
+        # retry rides the same rediscovery path as a failover
         return ("kv not primary" in m or "kv connection lost" in m
                 or "kv service unreachable" in m
                 or "kv wrong shard epoch" in m
-                or "kv shard unavailable" in m)
+                or "kv shard unavailable" in m
+                or "not replicated" in m)
     if isinstance(e, (ConnectionError, socket.timeout, TimeoutError)):
         return True
     if isinstance(e, OSError):
@@ -190,14 +196,15 @@ class RetryPolicy:
     uniform jitter factor in [1 - jitter, 1]; the final sleep is trimmed
     so the total time under `run()` never exceeds `deadline_s` by more
     than one attempt's duration. Clock/sleep/rng are injectable for
-    deterministic tests."""
+    deterministic tests (and the simulator); the defaults read the
+    ambient seam clock (kvs/net.py)."""
 
     def __init__(self, deadline_s: Optional[float] = None,
                  base_ms: Optional[float] = None,
                  max_ms: Optional[float] = None,
                  jitter: Optional[float] = None,
-                 clock: Callable[[], float] = time.monotonic,
-                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Optional[Callable[[], float]] = None,
+                 sleep: Optional[Callable[[float], None]] = None,
                  rng: Callable[[], float] = random.random):
         self.deadline_s = (cnf.KV_RETRY_DEADLINE_S if deadline_s is None
                            else deadline_s)
@@ -205,8 +212,8 @@ class RetryPolicy:
         self.max_ms = cnf.KV_RETRY_MAX_MS if max_ms is None else max_ms
         j = cnf.KV_RETRY_JITTER if jitter is None else jitter
         self.jitter = min(max(j, 0.0), 1.0)
-        self.clock = clock
-        self.sleep = sleep
+        self.clock = net.mono if clock is None else clock
+        self.sleep = net.sleep_s if sleep is None else sleep
         self.rng = rng
 
     def backoff_bounds(self, attempt: int) -> tuple[float, float]:
@@ -285,51 +292,90 @@ class RetryPolicy:
 # ---------------------------------------------------------------------------
 
 
-class _KvHandler(socketserver.BaseRequestHandler):
-    def handle(self):
-        vs: VersionedStore = self.server.vs
-        self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        with self.server.conn_lock:
-            self.server.active_conns.add(self.request)
+class _ConnState:
+    """Per-connection protocol state, transport-independent: the real
+    socket handler and the simulator's in-process connection both carry
+    one of these through `KvEngine.handle_frame`."""
+
+    __slots__ = ("owned", "authed")
+
+    def __init__(self, authed: bool):
         # snapshots held by THIS connection, as a multiset: several txns
         # pooled onto one connection can legitimately pin the same version
-        owned: Counter = Counter()
-        authed = not self.server.secret
+        self.owned: Counter = Counter()
+        self.authed = authed
+
+
+class _KvHandler(socketserver.BaseRequestHandler):
+    """Thin socket loop: framing + connection bookkeeping. All protocol
+    logic lives in KvEngine so the simulator shares it verbatim."""
+
+    def handle(self):
+        srv: KvServer = self.server
+        self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with srv.conn_lock:
+            srv.active_conns.add(self.request)
+        cstate = srv.new_conn_state()
         try:
             while True:
                 try:
                     req = _decode(_recv_frame(self.request))
                 except ConnectionError:
                     break
-                if not authed:
-                    if (isinstance(req, list) and len(req) == 2
-                            and req[0] == "auth"
-                            and req[1] == self.server.secret):
-                        authed = True
-                        _send_frame(self.request, _encode(["ok", None]))
-                        continue
-                    _send_frame(
-                        self.request, _encode(["err", "kv auth required"])
-                    )
-                    break
-                try:
-                    resp = self._dispatch(vs, req, owned)
-                except SdbError as e:
-                    resp = ["err", str(e)]
-                except Exception as e:  # internal — surface, keep serving
-                    resp = ["err", f"kv internal error: {e}"]
+                resp, close = srv.handle_frame(req, cstate)
                 _send_frame(self.request, _encode(resp))
+                if close:
+                    break
         finally:
-            with self.server.conn_lock:
-                self.server.active_conns.discard(self.request)
-            # a dying client must not pin MVCC chains forever
-            for snap, cnt in owned.items():
-                for _ in range(cnt):
-                    vs.release(snap)
+            with srv.conn_lock:
+                srv.active_conns.discard(self.request)
+            srv.conn_closed(cstate)
+
+
+class _EngineDispatch:
+    """Protocol dispatch half of the engine (split out only to keep the
+    class bodies reviewable; KvEngine inherits it)."""
+
+    def new_conn_state(self) -> _ConnState:
+        return _ConnState(not self.secret)
+
+    def conn_closed(self, cstate: _ConnState) -> None:
+        # a dying client must not pin MVCC chains forever
+        for snap, cnt in cstate.owned.items():
+            for _ in range(cnt):
+                self.vs.release(snap)
+
+    def handle_frame(self, req, cstate: _ConnState):
+        """One request frame -> (response, close_connection)."""
+        if not cstate.authed:
+            if (isinstance(req, list) and len(req) == 2
+                    and req[0] == "auth"
+                    and req[1] == self.secret):
+                cstate.authed = True
+                return ["ok", None], False
+            return ["err", "kv auth required"], True
+        try:
+            resp = self._dispatch(self.vs, req, cstate.owned)
+        except SdbError as e:
+            resp = ["err", str(e)]
+        except Exception as e:  # internal — surface, keep serving
+            resp = ["err", f"kv internal error: {e}"]
+        return resp, False
+
+    # ops every client read path goes through: they must be served by
+    # the PRIMARY. A replica answering them would hand a freshly
+    # connected client stale snapshots forever — the pool only
+    # rediscovers on failure, and the deterministic simulator caught
+    # exactly that as acked writes "missing" from a final scan served
+    # by a demoted stale replica. (`rel` stays open: releasing a pin
+    # taken while this node WAS primary must work after a demotion.)
+    _PRIMARY_READS = ("get", "get_latest", "range", "snap", "shard_items")
 
     def _dispatch(self, vs, req, owned):
-        srv: KvServer = self.server
+        srv = self
         op = req[0]
+        if op in srv._PRIMARY_READS and srv.role != "primary":
+            raise SdbError(srv.not_primary_msg())
         if op == "get":
             srv.shard_check_keys((req[1],))
             return ["ok", vs.read(req[1], req[2])]
@@ -381,14 +427,26 @@ class _KvHandler(socketserver.BaseRequestHandler):
             # an acked write is on every attached replica
             with srv.wal_lock:
                 try:
+                    srv._require_primary()
+                    srv._require_replicated()
                     srv.shard_check_keys(writes)
                     srv.check_locks(writes)
                 except SdbError:
                     vs.release(snap)  # vs.commit would have released it
                     raise
                 ver = vs.commit(writes, snap)  # SdbError on conflict
-                srv.log_commit(writes)
-                srv._ship(writes)
+                delivered = srv._publish(writes)
+                # durability gate, post-ship half: the ack promises the
+                # write is on every replica attached at commit time —
+                # if every link died mid-ship, refuse the ack (the write
+                # IS local + WAL'd, so the client must treat the
+                # outcome as unknown and retry idempotently)
+                if delivered == 0 and srv._needs_replica():
+                    raise SdbError(
+                        "kv commit not replicated (no replica attached); "
+                        "outcome uncertain — retry only with idempotent "
+                        "writes"
+                    )
             return ["ok", ver]
         if op == "prepare":
             # 2PC phase 1: validate + stage this participant's writeset
@@ -473,12 +531,13 @@ class _KvHandler(socketserver.BaseRequestHandler):
             if srv.role != "primary":
                 raise SdbError(srv.not_primary_msg())
             with srv.wal_lock:
+                srv._require_primary()
+                srv._require_replicated()
                 with vs.lock:
                     for k, v in req[1]:
                         vs.seed(k, v)
                 writes = {k: v for k, v in req[1]}
-                srv.log_commit(writes)
-                srv._ship(writes)
+                srv._publish(writes)
             return ["ok", None]
         if op == "ping":
             return ["ok", "pong"]
@@ -517,42 +576,43 @@ class _ReplLink:
     resync, plus the idle heartbeat that keeps the replica's failover
     timer quiet between commits."""
 
-    def __init__(self, server: "KvServer", addr_str: str):
+    def __init__(self, server: "KvEngine", addr_str: str):
         self.server = server
         self.addr_str = addr_str
         self.addr = _parse_addr(addr_str)
-        self.conn: Optional[_Conn] = None
+        self.conn = None
         self.attached = False
-        self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name=f"kv-repl-{addr_str}"
+        self._backoff = 0.05
+        self._handle = server.runtime.every(
+            server.ping_interval_s, self._tick,
+            name=f"kv-repl-{addr_str}", immediate=True,
         )
-        self._thread.start()
 
-    def _loop(self):
-        delay = 0.05
-        while not self._stop.is_set():
-            if self.attached:
-                try:
-                    with self.server.wal_lock:
-                        if self.attached and self.conn is not None:
-                            self.conn.call(
-                                ["repl_ping", self.server.node_id]
-                            )
-                except Exception:
-                    self._detach()
-                self._stop.wait(self.server.ping_interval_s)
-                continue
+    def _tick(self):
+        if self.attached:
             try:
-                self._attach()
-                delay = 0.05
+                with self.server.wal_lock:
+                    if self.attached and self.conn is not None:
+                        self.conn.call(
+                            ["repl_ping", self.server.node_id]
+                        )
             except Exception:
-                self._stop.wait(delay)
-                delay = min(delay * 2, 2.0)
+                self._detach()
+            return self.server.ping_interval_s
+        try:
+            self._attach()
+            self._backoff = 0.05
+            return self.server.ping_interval_s
+        except Exception:
+            delay = self._backoff
+            self._backoff = min(delay * 2, 2.0)
+            return delay
 
     def _attach(self):
-        c = _Conn(self.addr, self.server.secret,
-                  timeout=cnf.KV_CONNECT_TIMEOUT_S)
+        c = self.server.transport.connect(
+            self.addr, self.server.secret,
+            timeout=self.server.connect_timeout_s,
+        )
         try:
             # the handshake + cutover run under wal_lock so the replica's
             # adopted seq and the shipped stream can't interleave
@@ -571,6 +631,9 @@ class _ReplLink:
                     self.server.counters["repl_resyncs"] += 1
                 self.conn = c
                 self.attached = True
+                # durability gate arming: from here on an ack requires
+                # at least one attached replica (see _require_replicated)
+                self.server.ever_attached = True
         except BaseException:
             c.close()
             raise
@@ -597,17 +660,19 @@ class _ReplLink:
             c.close()
 
     def stop(self):
-        self._stop.set()
+        self._handle.cancel()
         self._detach()
 
 
 class _Replicator:
-    def __init__(self, server: "KvServer", peer_addrs: list[str]):
+    def __init__(self, server: "KvEngine", peer_addrs: list[str]):
         self.links = [_ReplLink(server, a) for a in peer_addrs]
 
-    def ship(self, seq: int, blob: bytes, crc: int):
-        for link in self.links:
-            link.send(seq, blob, crc)
+    def ship(self, seq: int, blob: bytes, crc: int) -> int:
+        """Returns how many replicas acked the frame."""
+        return sum(
+            1 for link in self.links if link.send(seq, blob, crc)
+        )
 
     def attached_count(self) -> int:
         return sum(1 for link in self.links if link.attached)
@@ -617,54 +682,82 @@ class _Replicator:
             link.stop()
 
 
-class KvServer(socketserver.ThreadingTCPServer):
-    allow_reuse_address = True
-    daemon_threads = True
+class KvEngine(_EngineDispatch):
+    """Transport-independent KV server: MVCC state, WAL durability,
+    replication, lease failover, sharding, and 2PC — everything except
+    sockets and threads, which arrive through the kvs/net.py seam
+    (`clock`, `runtime`, `transport`). `KvServer` mounts this engine on
+    a real ThreadingTCPServer; the deterministic simulator
+    (surrealdb_tpu/sim/) mounts the SAME engine on a virtual-time
+    scheduler and an in-process message-scheduling transport."""
 
     # WAL compaction threshold: beyond this the recovery path rewrites
     # the snapshot file and truncates the log
     WAL_COMPACT_BYTES = 64 << 20
 
-    def __init__(self, addr, secret: Optional[str] = None,
-                 data_dir: Optional[str] = None, fsync: bool = True,
-                 role: str = "primary", peers: Optional[list[str]] = None,
-                 self_index: Optional[int] = None,
-                 auto_failover: bool = True,
-                 failover_timeout_s: Optional[float] = None,
-                 lease_ttl_s: Optional[float] = None):
-        super().__init__(addr, _KvHandler)
+    def _engine_init(self, advertise: str, secret: Optional[str] = None,
+                     data_dir: Optional[str] = None, fsync: bool = True,
+                     role: str = "primary",
+                     peers: Optional[list[str]] = None,
+                     self_index: Optional[int] = None,
+                     auto_failover: bool = True,
+                     failover_timeout_s: Optional[float] = None,
+                     lease_ttl_s: Optional[float] = None,
+                     clock: Optional[net.Clock] = None,
+                     runtime: Optional[net.Runtime] = None,
+                     transport: Optional[net.Transport] = None,
+                     node_id: Optional[str] = None,
+                     trace=None,
+                     join_existing: bool = False):
         import uuid as _uuid
 
+        self.clock = clock or net.ambient_clock()
+        self.runtime = runtime or net.REAL_RUNTIME
+        self.transport = transport or net.REAL_TRANSPORT
+        self.trace = trace  # callable(dict) | None — simulator event tap
         self.vs = VersionedStore()
         self.secret = secret
         self.data_dir = data_dir
         self.fsync = fsync
         self.wal = None
-        self.wal_lock = threading.RLock()
+        self.wal_lock = self.runtime.rlock()
         # -- cluster identity / replication state --
-        self.node_id = str(_uuid.uuid4())
+        self.node_id = node_id or str(_uuid.uuid4())
         self.role = role
         self.peers: list[str] = []
         self.self_index: Optional[int] = None
-        host, port = self.server_address[:2]
-        self.advertise = f"{host}:{port}"
+        self.advertise = advertise
         self.primary_addr: Optional[str] = None  # replica's best guess
         self.repl: Optional[_Replicator] = None
         self.repl_seq = 0  # primary: last shipped sequence number
         self.applied_seq = 0  # replica: last applied sequence number
         self.repl_primary_id: Optional[str] = None
-        self.last_repl = time.monotonic()  # boot grace for the monitor
+        self.last_repl = self.clock.monotonic()  # boot grace (monitor)
         self.failover_timeout_s = (cnf.KV_FAILOVER_TIMEOUT_S
                                    if failover_timeout_s is None
                                    else failover_timeout_s)
         self.lease_ttl_s = (cnf.KV_LEASE_TTL_S if lease_ttl_s is None
                             else lease_ttl_s)
         self.ping_interval_s = max(0.05, self.failover_timeout_s / 3.0)
+        self.connect_timeout_s = cnf.KV_CONNECT_TIMEOUT_S
+        self.resolve_interval_s = cnf.KV_2PC_RESOLVE_INTERVAL_S
+        self.auto_failover = auto_failover
+        # durability gate: once a replica has attached, acks require at
+        # least one attached replica, and an expired un-renewable lease
+        # steps this primary down (split-brain bound)
+        self.ever_attached = False
+        self.lease_valid_until = self.clock.wall() + self.lease_ttl_s
+        # election cooldown after a step-down: the demoted node is
+        # usually rank-tied with (and lower-indexed than) its peers, so
+        # without a pause it wins every re-election straight back into
+        # whatever made it step down — a primary flip-flop that starves
+        # the healthy replica forever (found by the one-way-partition
+        # regression test)
+        self.election_pause_until = 0.0
         self.counters: Counter = Counter()
-        self._renew_stop: Optional[threading.Event] = None
-        self._monitor_stop: Optional[threading.Event] = None
-        self.conn_lock = threading.Lock()
-        self.active_conns: set = set()
+        self._renew_handle: Optional[net.LoopHandle] = None
+        self._monitor_handle: Optional[net.LoopHandle] = None
+        self._resolver_handle: Optional[net.LoopHandle] = None
         # -- sharding / 2PC state (kvs/shard.py) --
         # shard = (beg, end|None, epoch); None = unsharded, serve all keys
         self.shard: Optional[tuple] = None
@@ -672,24 +765,42 @@ class KvServer(socketserver.ThreadingTCPServer):
         self.staged_meta: dict = {}  # txid -> (meta_addrs, staged_at_mono)
         self.locks: dict = {}  # key -> txid holding a prepared write
         self.orphan_grace_s = cnf.KV_2PC_ORPHAN_GRACE_S
-        self._resolver_stop: Optional[threading.Event] = None
         if data_dir:
             self._recover()
         self._load_shard_state()
+        # primacy era/lineage (stamped into every replicated writeset
+        # via _publish): a fresh primacy starts past every era this
+        # store has ever seen
+        self.era = _repl_rank(self.vs.read_latest(REPL_STATE_KEY))[0] + 1
+        self.lineage_id = self.node_id
         if peers is not None:
             self.configure_cluster(peers, self_index, role=role,
-                                   auto_failover=auto_failover)
+                                   auto_failover=auto_failover,
+                                   join_existing=join_existing)
+
+    def _trace(self, ev: str, **fields):
+        if self.trace is not None:
+            fields.update(ev=ev, node=self.node_id, addr=self.advertise,
+                          t=round(self.clock.monotonic(), 6))
+            self.trace(fields)
 
     # -- cluster wiring ------------------------------------------------------
 
     def configure_cluster(self, peers: list[str],
                           self_index: Optional[int] = None,
                           role: Optional[str] = None,
-                          auto_failover: bool = True):
+                          auto_failover: bool = True,
+                          join_existing: bool = False):
         """Attach this server to a replica set. `peers` lists every
         member (including this one) as host:port in PROMOTION-RANK order:
         on primary death the lowest-ranked live replica promotes. Safe to
-        call after construction (tests bind port 0 first)."""
+        call after construction (tests bind port 0 first).
+
+        With `join_existing`, a configured-primary probes its peers
+        first and joins as a REPLICA when one of them already serves as
+        primary — the restart-after-crash path: rebooting a failed
+        primary with its stale config must not mint a second primary
+        next to the replica that promoted in the meantime."""
         self.peers = list(peers)
         if self_index is None:
             try:
@@ -703,9 +814,38 @@ class KvServer(socketserver.ThreadingTCPServer):
         self.advertise = self.peers[self_index]
         if role is not None:
             self.role = role
+        self.auto_failover = auto_failover
         others = [a for i, a in enumerate(self.peers) if i != self_index]
+        if self.role == "primary" and join_existing and others:
+            for a in others:
+                st = self.transport.status_of(
+                    _parse_addr(a), self.secret,
+                    timeout=self.connect_timeout_s,
+                )
+                if st is not None and st.get("role") == "primary":
+                    self.role = "replica"
+                    self.primary_addr = a
+                    self.note_repl_traffic()
+                    self._trace("join_as_replica", primary=a)
+                    break
         if self.role == "primary":
             self.primary_addr = self.advertise
+            self.lease_valid_until = self.clock.wall() + self.lease_ttl_s
+            # quorum-capable groups (3+) arm the durability gate from
+            # the first moment: nothing is acked — data write, 2PC
+            # stage, or commit-log record — until a replica holds it.
+            # 2-member groups keep the PR-1 availability contract (a
+            # promoted survivor serves alone).
+            self.ever_attached = len(self.peers) >= 3
+            with self.wal_lock:
+                # fresh primacy: advance past every era this store has
+                # seen and make the credential durable immediately
+                self.era = _repl_rank(
+                    self.vs.read_latest(REPL_STATE_KEY)
+                )[0] + 1
+                self.lineage_id = self.node_id
+                if others:
+                    self._publish({})
             if others and self.repl is None:
                 self.repl = _Replicator(self, others)
             self._start_renewal()
@@ -716,8 +856,39 @@ class KvServer(socketserver.ThreadingTCPServer):
         hint = self.primary_addr or "unknown"
         return f"kv not primary (role={self.role}, primary={hint})"
 
+    def _needs_replica(self) -> bool:
+        """True when the durability gate is armed: this primary has
+        peers, has had a replica attached at least once this
+        incarnation, but has none attached right now."""
+        return bool(
+            len(self.peers) > 1 and self.ever_attached
+            and (self.repl is None or self.repl.attached_count() == 0)
+        )
+
+    def _require_primary(self) -> None:
+        """Role re-check under wal_lock. The dispatch-level role check
+        runs BEFORE the lock is acquired, and a demotion can land in
+        between — the deterministic simulator found exactly that
+        interleaving staging a 2PC prepare on a just-demoted node,
+        where the stage is later wiped by the resync from the new
+        primary (a half-applied cross-shard commit)."""
+        if self.role != "primary":
+            raise SdbError(self.not_primary_msg())
+
+    def _require_replicated(self) -> None:
+        """Durability gate, entry half: once this primary has ever had a
+        replica attached, an acked write must reach at least one replica
+        — with every link down, refuse (retryably) instead of acking a
+        write that would be wiped by a resync after a peer promotes."""
+        if self._needs_replica():
+            self.counters["writes_unreplicated_refused"] += 1
+            raise SdbError(
+                "kv write not replicated (no replica attached); "
+                "retrying via rediscovery"
+            )
+
     def note_repl_traffic(self):
-        self.last_repl = time.monotonic()
+        self.last_repl = self.clock.monotonic()
 
     def status(self) -> dict:
         # counter writers are unsynchronized; a key insert during the
@@ -730,12 +901,35 @@ class KvServer(socketserver.ThreadingTCPServer):
                 break
             except RuntimeError:
                 continue
+        from surrealdb_tpu.node import KV_PRIMARY_LEASE, store_lease_read
+
+        # the lease row + lineage ride the status reply so a candidate
+        # replica's promotion survey can (a) respect a lease it no
+        # longer has a fresh copy of and (b) defer to a peer replica
+        # that applied more of the dead primary's stream
+        try:
+            lease = store_lease_read(self.vs, KV_PRIMARY_LEASE)
+        except Exception:
+            lease = None
+        rs = self.vs.read_latest(REPL_STATE_KEY)
+        if rs is not None:
+            try:
+                rs = _decode(bytes(rs))
+            except Exception:
+                rs = None
         return {
             "role": self.role,
             "node_id": self.node_id,
+            "electable": bool(
+                self.role == "replica"
+                and self.clock.monotonic() >= self.election_pause_until
+            ),
             "version": self.vs.version,
             "repl_seq": self.repl_seq,
             "applied_seq": self.applied_seq,
+            "repl_primary_id": self.repl_primary_id,
+            "repl_state": rs,  # durable [lineage, seq, era] credential
+            "lease": None if lease is None else [lease[0], lease[1]],
             "primary": (self.advertise if self.role == "primary"
                         else self.primary_addr),
             "attached_replicas": (self.repl.attached_count()
@@ -791,10 +985,12 @@ class KvServer(socketserver.ThreadingTCPServer):
         primary failover exactly like an acked write."""
         prep_key = PREP_PREFIX + txid.encode()
         blob = _encode([txid, [[k, v] for k, v in writes.items()],
-                        list(meta_addrs), time.time()])
+                        list(meta_addrs), self.clock.wall()])
         with self.wal_lock:
             with self.vs.lock:
                 try:
+                    self._require_primary()
+                    self._require_replicated()
                     self.shard_check_keys(writes)
                     for k in writes:
                         if self.locks.get(k, txid) != txid:
@@ -807,11 +1003,21 @@ class KvServer(socketserver.ThreadingTCPServer):
                     raise
                 self.vs.commit({prep_key: blob}, snap)
             self.staged[txid] = writes
-            self.staged_meta[txid] = (list(meta_addrs), time.monotonic())
+            self.staged_meta[txid] = (list(meta_addrs),
+                                      self.clock.monotonic())
             for k in writes:
                 self.locks[k] = txid
-            self.log_commit({prep_key: blob})
-            self._ship({prep_key: blob})
+            delivered = self._publish({prep_key: blob})
+            if delivered == 0 and self._needs_replica():
+                # an unreplicated stage would vanish when a peer
+                # promotes: a coordinator that then logged COMMIT would
+                # half-apply the transaction. Undo the stage locally and
+                # refuse — the coordinator claims its abort record.
+                self.decide_txn(txid, "abort")
+                raise SdbError(
+                    "kv prepare not replicated (no replica attached); "
+                    "transaction aborted and can be retried"
+                )
             self.counters["twopc_prepares"] += 1
         self._start_resolver()
 
@@ -821,6 +1027,7 @@ class KvServer(socketserver.ThreadingTCPServer):
         decision already landed here (returns "unknown")."""
         prep_key = PREP_PREFIX + txid.encode()
         with self.wal_lock:
+            self._require_primary()
             writes = self.staged.pop(txid, None)
             self.staged_meta.pop(txid, None)
             if writes is None:
@@ -835,8 +1042,7 @@ class KvServer(socketserver.ThreadingTCPServer):
             # block commits AND prepares), so this never conflicts
             snap = self.vs.snapshot()
             self.vs.commit(full, snap)
-            self.log_commit(full)
-            self._ship(full)
+            self._publish(full)
             self.counters[f"twopc_{decision}s"] += 1
             return decision
 
@@ -847,14 +1053,36 @@ class KvServer(socketserver.ThreadingTCPServer):
         commit and a participant's orphan-abort mutually exclusive."""
         key = TXNLOG_PREFIX + txid.encode()
         with self.wal_lock:
+            self._require_primary()
             cur = self.vs.read_latest(key)
             if cur is not None:
+                # first-writer-wins early return — but the caller may
+                # only ACT on a decision that is held by a replica: a
+                # retry after a refused first write must not slip the
+                # record past the durability gate (the record would die
+                # with this node and a participant's resolver would
+                # claim the opposite decision)
+                if self._needs_replica():
+                    raise SdbError(
+                        "kv txn_mark not replicated (no replica "
+                        "attached); retry reads the recorded decision"
+                    )
                 return bytes(cur).decode()
             val = want.encode()
             snap = self.vs.snapshot()
             self.vs.commit({key: val}, snap)
-            self.log_commit({key: val})
-            self._ship({key: val})
+            delivered = self._publish({key: val})
+            if delivered == 0 and self._needs_replica():
+                # the decision record is THE commit point — an
+                # unreplicated one could be lost to a meta failover
+                # while the coordinator acts on it. Leave the local row
+                # (first-writer-wins keeps retries convergent) but
+                # refuse the ack so the caller re-reads the standing
+                # decision through rediscovery.
+                raise SdbError(
+                    "kv txn_mark not replicated (no replica attached); "
+                    "retry reads the recorded decision"
+                )
             self.counters["txn_marks"] += 1
             return want
 
@@ -864,6 +1092,8 @@ class KvServer(socketserver.ThreadingTCPServer):
         fence. Persisted + replicated as a \\x00!shardcfg row so a
         promoted replica keeps enforcing the same bounds."""
         with self.wal_lock:
+            self._require_primary()
+            self._require_replicated()
             for k in self.locks:
                 if k < beg or (end is not None and k >= end):
                     raise SdbError(
@@ -876,8 +1106,7 @@ class KvServer(socketserver.ThreadingTCPServer):
             self.vs.commit({SHARD_CFG_KEY: blob}, snap)
             self.shard = (bytes(beg),
                           None if end is None else bytes(end), int(epoch))
-            self.log_commit({SHARD_CFG_KEY: blob})
-            self._ship({SHARD_CFG_KEY: blob})
+            self._publish({SHARD_CFG_KEY: blob})
             self.counters["shard_sets"] += 1
 
     def shard_purge(self, beg: bytes, end: Optional[bytes]) -> int:
@@ -885,6 +1114,8 @@ class KvServer(socketserver.ThreadingTCPServer):
         moved slice on the source group. Internal keys are kept."""
         hi = INF_END if end is None else end
         with self.wal_lock:
+            self._require_primary()
+            self._require_replicated()
             snap = self.vs.snapshot()
             try:
                 items = self.vs.range_items(beg, hi, snap, None, False)
@@ -895,8 +1126,7 @@ class KvServer(socketserver.ThreadingTCPServer):
                 return 0
             snap = self.vs.snapshot()
             self.vs.commit(writes, snap)
-            self.log_commit(writes)
-            self._ship(writes)
+            self._publish(writes)
             return len(writes)
 
     def _load_shard_state(self) -> None:
@@ -926,7 +1156,7 @@ class KvServer(socketserver.ThreadingTCPServer):
             self.staged[txid] = writes
             # age from now: recovery time shouldn't insta-orphan a txn
             # whose coordinator is still deciding
-            self.staged_meta[txid] = (list(meta), time.monotonic())
+            self.staged_meta[txid] = (list(meta), self.clock.monotonic())
             for k in writes:
                 self.locks[k] = txid
         if self.staged and self.role == "primary":
@@ -935,46 +1165,47 @@ class KvServer(socketserver.ThreadingTCPServer):
     # -- 2PC orphan resolver -------------------------------------------------
 
     def _start_resolver(self):
-        if self._resolver_stop is not None:
+        if self._resolver_handle is not None:
             return
-        self._resolver_stop = threading.Event()
-        threading.Thread(target=self._resolver_loop, daemon=True,
-                         name="kv-2pc-resolver").start()
+        self._resolver_handle = self.runtime.every(
+            self.resolve_interval_s, self._resolver_tick,
+            name="kv-2pc-resolver",
+        )
 
-    def _resolver_loop(self):
+    def _resolver_tick(self):
         """Drive staged prepares whose coordinator went quiet to the
         decision recorded in the meta shard's commit log. Claims ABORT
         with first-writer-wins semantics when no record exists — a
         coordinator that died before logging its decision can never
         commit afterwards, so every participant converges on abort."""
-        stop = self._resolver_stop
-        while not stop.wait(cnf.KV_2PC_RESOLVE_INTERVAL_S):
-            try:
-                if self.role != "primary":
-                    continue
-                now = time.monotonic()
-                with self.wal_lock:
-                    orphans = [
-                        (txid, list(meta))
-                        for txid, (meta, ts) in self.staged_meta.items()
-                        if now - ts >= self.orphan_grace_s
-                    ]
-                for txid, meta in orphans:
-                    decision = self._resolve_decision(txid, meta)
-                    if decision in ("commit", "abort"):
-                        self.decide_txn(txid, decision)
-                        self.counters["twopc_resolved"] += 1
-            except Exception:
-                # resolver must never die; next tick retries
-                self.counters["twopc_resolver_errors"] += 1
+        try:
+            if self.role != "primary":
+                return
+            now = self.clock.monotonic()
+            with self.wal_lock:
+                orphans = [
+                    (txid, list(meta))
+                    for txid, (meta, ts) in self.staged_meta.items()
+                    if now - ts >= self.orphan_grace_s
+                ]
+            for txid, meta in orphans:
+                decision = self._resolve_decision(txid, meta)
+                if decision in ("commit", "abort"):
+                    self.decide_txn(txid, decision)
+                    self.counters["twopc_resolved"] += 1
+        except Exception:
+            # resolver must never die; next tick retries
+            self.counters["twopc_resolver_errors"] += 1
 
     def _resolve_decision(self, txid: str, meta_addrs: list):
         """Ask the meta shard for the recorded decision, claiming abort
         if none exists. Network I/O — never called under wal_lock."""
         for a in meta_addrs:
             try:
-                c = _Conn(_parse_addr(a), self.secret,
-                          timeout=cnf.KV_CONNECT_TIMEOUT_S)
+                c = self.transport.connect(
+                    _parse_addr(a), self.secret,
+                    timeout=self.connect_timeout_s,
+                )
             except (OSError, SdbError):
                 continue
             try:
@@ -1034,6 +1265,7 @@ class KvServer(socketserver.ThreadingTCPServer):
             }
             self.vs.commit(writes, self.vs.snapshot())
             self.log_commit(writes)
+            self._note_prep_writes(writes)
             self.applied_seq = seq
             self.counters["repl_applied"] += 1
             return self.applied_seq
@@ -1056,119 +1288,225 @@ class KvServer(socketserver.ThreadingTCPServer):
             if writes:
                 self.vs.commit(writes, self.vs.snapshot())
                 self.log_commit(writes)
+            # full state transfer: rebuild the staged-2PC mirror
+            # wholesale from the transferred prep rows
+            self.staged.clear()
+            self.staged_meta.clear()
+            self.locks.clear()
+            self._note_prep_writes(new)
             self.applied_seq = seq
             self.counters["repl_synced"] += 1
             return self.applied_seq
 
+    def _note_prep_writes(self, writes: dict):
+        """Mirror replicated 2PC stage state in memory as prep rows
+        stream in, so a replica's staged/locks tables track its
+        keyspace continuously instead of only at promotion-time reload
+        (a stale mirror would report phantom staged transactions)."""
+        for k, v in writes.items():
+            if not k.startswith(PREP_PREFIX):
+                continue
+            txid = k[len(PREP_PREFIX):].decode()
+            if v is None:
+                w = self.staged.pop(txid, None)
+                self.staged_meta.pop(txid, None)
+                for kk in (w or ()):
+                    if self.locks.get(kk) == txid:
+                        del self.locks[kk]
+                continue
+            try:
+                _txid, pairs, meta, _ts = _decode(bytes(v))
+            except Exception:
+                continue  # robust: an undecodable row is reload's job
+            w = {
+                bytes(a): (None if b is None else bytes(b))
+                for a, b in pairs
+            }
+            self.staged[txid] = w
+            self.staged_meta[txid] = (list(meta), self.clock.monotonic())
+            for kk in w:
+                self.locks[kk] = txid
+
     # -- replication (primary side) -----------------------------------------
 
-    def _ship(self, writes: dict):
+    def _ship(self, writes: dict) -> int:
         """Ship one committed writeset to every attached replica.
-        Caller holds wal_lock; ships are strictly in commit order."""
+        Caller holds wal_lock; ships are strictly in commit order.
+        Returns how many replicas acked the frame."""
         if self.repl is None:
-            return
+            return 0
         self.repl_seq += 1
         blob = _encode([[k, v] for k, v in writes.items()])
-        self.repl.ship(self.repl_seq, blob, zlib.crc32(blob) & 0xFFFFFFFF)
+        delivered = self.repl.ship(self.repl_seq, blob,
+                                   zlib.crc32(blob) & 0xFFFFFFFF)
         self.counters["repl_shipped"] += 1
+        return delivered
+
+    def _publish(self, writes: dict) -> int:
+        """Primary-side durability + replication choke point: stamp the
+        durable freshness credential into the writeset, append ONE WAL
+        frame, ship to the replicas (which therefore adopt the same
+        credential atomically with the data). Caller holds wal_lock and
+        has already applied `writes` to the MVCC store. Returns the
+        replica ack count."""
+        if len(self.peers) <= 1:
+            # unclustered: nothing to rank against, keep frames lean
+            self.log_commit(writes)
+            return self._ship(writes)
+        full = dict(writes)
+        blob = _encode([self.lineage_id, self.repl_seq + 1, self.era])
+        # fresh snapshot: the internal row can never conflict
+        self.vs.commit({REPL_STATE_KEY: blob}, self.vs.snapshot())
+        full[REPL_STATE_KEY] = blob
+        self.log_commit(full)
+        return self._ship(full)
 
     def _start_renewal(self):
-        if self._renew_stop is not None or not self.peers:
+        if self._renew_handle is not None or not self.peers:
             return
-        self._renew_stop = threading.Event()
-        threading.Thread(target=self._renew_loop, daemon=True,
-                         name="kv-lease-renew").start()
+        self._renew_handle = self.runtime.every(
+            max(0.05, self.lease_ttl_s / 3.0), self._renew_tick,
+            name="kv-lease-renew", immediate=True,
+        )
 
-    def _renew_loop(self):
+    def _renew_tick(self):
         from surrealdb_tpu import key as K
         from surrealdb_tpu.kvs.api import serialize
         from surrealdb_tpu.node import KV_PRIMARY_LEASE
 
-        interval = max(0.05, self.lease_ttl_s / 3.0)
-        stop = self._renew_stop
         key = K.task_lease(KV_PRIMARY_LEASE)
-        while True:
-            try:
-                with self.wal_lock:
-                    if self.role != "primary":
-                        return
-                    val = serialize(
-                        (self.node_id, time.time() + self.lease_ttl_s)
-                    )
-                    try:
-                        self.vs.commit({key: val}, self.vs.snapshot())
-                    except SdbError:
-                        continue  # raced a client write of the lease row
-                    self.log_commit({key: val})
-                    self._ship({key: val})
-                    self.counters["lease_renewals"] += 1
-            except Exception:
-                pass  # renewal must never die; next tick retries
-            if stop.wait(interval):
-                return
+        try:
+            with self.wal_lock:
+                if self.role != "primary":
+                    self._renew_handle = None
+                    return STOP
+                now_w = self.clock.wall()
+                # step-down: we once had a replica attached, none are
+                # reachable now, and the last renewal any replica can
+                # have seen has expired — a peer may legitimately hold
+                # the lease already, so continuing to serve writes here
+                # is split-brain. Demote; the monitor takes over.
+                if (self._needs_replica()
+                        and now_w >= self.lease_valid_until):
+                    self.demote(reason="lease_expired")
+                    self._renew_handle = None
+                    return STOP
+                val = serialize(
+                    (self.node_id, now_w + self.lease_ttl_s)
+                )
+                try:
+                    self.vs.commit({key: val}, self.vs.snapshot())
+                except SdbError:
+                    return None  # raced a client write of the lease row
+                delivered = self._publish({key: val})
+                # the lease is only as fresh as the last renewal a
+                # replica ACKED — an unshipped renewal extends nothing
+                if delivered > 0 or len(self.peers) <= 1 \
+                        or not self.ever_attached:
+                    self.lease_valid_until = now_w + self.lease_ttl_s
+                self.counters["lease_renewals"] += 1
+        except Exception:
+            pass  # renewal must never die; next tick retries
 
     def _start_monitor(self):
-        if self._monitor_stop is not None:
+        if self._monitor_handle is not None:
             return
-        self._monitor_stop = threading.Event()
-        threading.Thread(target=self._monitor_loop, daemon=True,
-                         name="kv-failover-monitor").start()
+        self._monitor_handle = self.runtime.every(
+            max(0.05, self.failover_timeout_s / 4.0), self._monitor_tick,
+            name="kv-failover-monitor",
+        )
 
-    def _monitor_loop(self):
+    def _monitor_tick(self):
         from surrealdb_tpu.node import (
             KV_PRIMARY_LEASE, store_lease_acquire, store_lease_read,
         )
 
-        interval = max(0.05, self.failover_timeout_s / 4.0)
-        stop = self._monitor_stop
-        while not stop.wait(interval):
-            try:
-                if self.role != "replica":
-                    return
-                if self.repl_primary_id is None:
-                    # never attached to ANY primary: this store has no
-                    # lineage, so self-promotion at boot would mint a
-                    # second (empty) primary if the real one is merely
-                    # slow to start — wait until a primary has owned us
-                    # at least once
+        try:
+            if self.role != "replica":
+                self._monitor_handle = None
+                return STOP
+            my_rank = _repl_rank(self.vs.read_latest(REPL_STATE_KEY))
+            if self.repl_primary_id is None and my_rank == (-1, -1):
+                # never attached to ANY primary AND no recovered
+                # credential: this store has no lineage, so
+                # self-promotion at boot would mint a second (empty)
+                # primary if the real one is merely slow to start. (A
+                # rebooted member that recovered data from its WAL has
+                # a credential and may stand for election.)
+                return
+            idle = self.clock.monotonic() - self.last_repl
+            if idle < self.failover_timeout_s:
+                return
+            if self.clock.monotonic() < self.election_pause_until:
+                return  # fresh step-down: let a peer win this round
+            # lease gate: the old primary's lease row replicated into
+            # OUR keyspace — promotion waits until it expires
+            now_w = self.clock.wall()
+            row = store_lease_read(self.vs, KV_PRIMARY_LEASE)
+            if row is not None and row[0] != self.node_id \
+                    and row[1] > now_w:
+                return
+            # peer survey: follow an existing primary; respect a FRESHER
+            # copy of the lease a reachable peer still holds (this
+            # replica may have detached long before the primary died —
+            # its own lease copy going stale proves nothing); defer to
+            # any live replica with a higher durable (era, seq)
+            # credential — promoting a stale replica over a fresher
+            # live one would resync the fresher one's acked writes
+            # away — breaking ties by rank; and require a member quorum
+            # for groups of 3+ so two mutually-partitioned replicas
+            # can't both claim the lease.
+            found = None
+            defer = False
+            lease_held = False
+            live = 1  # self
+            for i, a in enumerate(self.peers):
+                if i == self.self_index:
                     continue
-                idle = time.monotonic() - self.last_repl
-                if idle < self.failover_timeout_s:
+                st = self.transport.status_of(
+                    _parse_addr(a), self.secret,
+                    timeout=self.connect_timeout_s,
+                )
+                if st is None:
                     continue
-                # lease gate: the old primary's lease row replicated into
-                # OUR keyspace — promotion waits until it expires
-                row = store_lease_read(self.vs, KV_PRIMARY_LEASE)
-                if row is not None and row[0] != self.node_id \
-                        and row[1] > time.time():
-                    continue
-                # peer survey: follow an existing primary; defer to any
-                # live lower-ranked replica (deterministic successor
-                # order keeps the winner unique even without quorum)
-                found = None
-                lower_alive = False
-                for i, a in enumerate(self.peers):
-                    if i == self.self_index:
-                        continue
-                    st = _status_of(_parse_addr(a), self.secret)
-                    if st is None:
-                        continue
-                    if st.get("role") == "primary":
-                        found = a
-                        break
-                    if st.get("role") == "replica" and i < self.self_index:
-                        lower_alive = True
-                if found is not None:
-                    self.primary_addr = found
-                    self.note_repl_traffic()  # it will hello us shortly
-                    continue
-                if lower_alive:
-                    continue
-                if store_lease_acquire(self.vs, KV_PRIMARY_LEASE,
-                                       self.node_id, self.lease_ttl_s):
-                    self.promote(reason="lease")
-                    return
-            except Exception:
-                pass  # monitor must never die; next tick retries
+                live += 1
+                if st.get("role") == "primary":
+                    found = a
+                    break
+                lr = st.get("lease")
+                if (lr and lr[0] != self.node_id
+                        and float(lr[1]) > now_w):
+                    lease_held = True
+                if st.get("role") == "replica":
+                    peer_rank = _repl_rank(st.get("repl_state"))
+                    if peer_rank > my_rank:
+                        # strictly fresher — defer even to a paused
+                        # peer (its pause expires; promoting a staler
+                        # store now could resync acked history away)
+                        defer = True
+                    elif (peer_rank == my_rank and i < self.self_index
+                            and st.get("electable", True)):
+                        # rank tie breaks by index, but never in favor
+                        # of a peer sitting out its post-step-down
+                        # cooldown — that deference would deadlock the
+                        # election into a primary flip-flop
+                        defer = True
+            if found is not None:
+                self.primary_addr = found
+                self.note_repl_traffic()  # it will hello us shortly
+                return
+            if lease_held or defer:
+                return
+            if len(self.peers) >= 3 and live <= len(self.peers) // 2:
+                self.counters["promotion_quorum_blocked"] += 1
+                return
+            if store_lease_acquire(self.vs, KV_PRIMARY_LEASE,
+                                   self.node_id, self.lease_ttl_s):
+                self.promote(reason="lease")
+                self._monitor_handle = None
+                return STOP
+        except Exception:
+            pass  # monitor must never die; next tick retries
 
     def promote(self, reason: str = "admin"):
         """Become the primary: accept writes, replicate to the remaining
@@ -1181,8 +1519,20 @@ class KvServer(socketserver.ThreadingTCPServer):
             self.primary_addr = self.advertise
             self.counters["promotions"] += 1
             self.counters[f"promotions_{reason}"] += 1
-            if self._monitor_stop is not None:
-                self._monitor_stop.set()
+            # durability gate: quorum-capable groups arm it immediately
+            # (an elected primary acks nothing until a replica holds
+            # it); 2-member groups serve alone per the PR-1 contract
+            self.ever_attached = len(self.peers) >= 3
+            self.lease_valid_until = self.clock.wall() + self.lease_ttl_s
+            # new primacy era, durable before the first write is served
+            self.era = _repl_rank(
+                self.vs.read_latest(REPL_STATE_KEY)
+            )[0] + 1
+            self.lineage_id = self.node_id
+            self._publish({})
+            if self._monitor_handle is not None:
+                self._monitor_handle.cancel()
+                self._monitor_handle = None
             others = [a for i, a in enumerate(self.peers)
                       if i != self.self_index]
             if others and self.repl is None:
@@ -1195,34 +1545,56 @@ class KvServer(socketserver.ThreadingTCPServer):
             self.staged_meta.clear()
             self.locks.clear()
             self._load_shard_state()
+            self._trace("promote", reason=reason)
 
-    def server_close(self):
-        for ev in (self._renew_stop, self._monitor_stop,
-                   self._resolver_stop):
-            if ev is not None:
-                ev.set()
+    def demote(self, reason: str = "admin"):
+        """Step down to replica: stop accepting writes, drop the
+        replication links, forget the lineage (the next primary's hello
+        forces a full resync), and rejoin the failover monitor.
+        Idempotent. The step-down path (`_renew_tick`) invokes this when
+        the primary's lease expired without any replica acking a
+        renewal — past that point a peer may hold the lease, so serving
+        writes here would be split-brain."""
+        with self.wal_lock:
+            if self.role != "primary":
+                return
+            self.role = "replica"
+            self.counters["demotions"] += 1
+            self.counters[f"demotions_{reason}"] += 1
+            self.primary_addr = None
+            if self.repl is not None:
+                self.repl.stop()
+                self.repl = None
+            self.repl_seq = 0
+            self.repl_primary_id = None  # next hello = full resync
+            self.applied_seq = -1
+            self.ever_attached = False
+            self.note_repl_traffic()  # boot-grace the failover timer
+            # stand aside for one full failover window: let a healthy
+            # peer win the next election instead of re-promoting into
+            # the same partition
+            self.election_pause_until = (
+                self.clock.monotonic()
+                + self.failover_timeout_s + self.lease_ttl_s
+            )
+            if self._renew_handle is not None:
+                self._renew_handle.cancel()
+                self._renew_handle = None
+            self._trace("demote", reason=reason)
+        if self.auto_failover:
+            self._start_monitor()
+
+    def engine_close(self):
+        """Stop every background loop and replication link."""
+        for h in (self._renew_handle, self._monitor_handle,
+                  self._resolver_handle):
+            if h is not None:
+                h.cancel()
+        self._renew_handle = None
+        self._monitor_handle = None
+        self._resolver_handle = None
         if self.repl is not None:
             self.repl.stop()
-        super().server_close()
-
-    def kill(self):
-        """Test helper: simulate hard process death in-process — stop
-        the accept loop, halt every background thread, and sever every
-        live connection mid-frame. The WAL is left exactly as a SIGKILL
-        would leave it (no flush, no orderly shutdown)."""
-        self.shutdown()
-        self.server_close()
-        with self.conn_lock:
-            conns, self.active_conns = list(self.active_conns), set()
-        for s in conns:
-            try:
-                s.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                s.close()
-            except OSError:
-                pass
 
     # -- durability (reference role: TiKV's raft-log + snapshot
     # persistence, core/src/kvs/tikv/mod.rs:32-103 durability contract;
@@ -1379,6 +1751,78 @@ class KvServer(socketserver.ThreadingTCPServer):
             if self.wal.tell() > self.WAL_COMPACT_BYTES:
                 self._compact()
 
+    def crash_close(self):
+        """Simulated hard death: drop file handles without an orderly
+        shutdown (per-commit flushes already reached the OS, matching
+        what a SIGKILL leaves on disk) and halt the background loops.
+        In-memory state is simply discarded by the caller."""
+        self.engine_close()
+        if self.wal is not None:
+            try:
+                self.wal.close()
+            except OSError:
+                pass
+            self.wal = None
+
+
+class StandaloneKvEngine(KvEngine):
+    """A KvEngine with no socket server attached — the deterministic
+    simulator's node: the sim transport delivers decoded request frames
+    straight into `handle_frame` from virtual-time scheduler tasks."""
+
+    def __init__(self, advertise: str, **kw):
+        self._engine_init(advertise, **kw)
+
+
+class KvServer(socketserver.ThreadingTCPServer, KvEngine):
+    """The real KV service: KvEngine mounted on a ThreadingTCPServer."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, secret: Optional[str] = None,
+                 data_dir: Optional[str] = None, fsync: bool = True,
+                 role: str = "primary", peers: Optional[list[str]] = None,
+                 self_index: Optional[int] = None,
+                 auto_failover: bool = True,
+                 failover_timeout_s: Optional[float] = None,
+                 lease_ttl_s: Optional[float] = None,
+                 join_existing: bool = False):
+        socketserver.ThreadingTCPServer.__init__(self, addr, _KvHandler)
+        self.conn_lock = threading.Lock()
+        self.active_conns: set = set()
+        host, port = self.server_address[:2]
+        self._engine_init(
+            f"{host}:{port}", secret=secret, data_dir=data_dir,
+            fsync=fsync, role=role, peers=peers, self_index=self_index,
+            auto_failover=auto_failover,
+            failover_timeout_s=failover_timeout_s,
+            lease_ttl_s=lease_ttl_s, join_existing=join_existing,
+        )
+
+    def server_close(self):
+        self.engine_close()
+        socketserver.ThreadingTCPServer.server_close(self)
+
+    def kill(self):
+        """Test helper: simulate hard process death in-process — stop
+        the accept loop, halt every background thread, and sever every
+        live connection mid-frame. The WAL is left exactly as a SIGKILL
+        would leave it (no flush, no orderly shutdown)."""
+        self.shutdown()
+        self.server_close()
+        with self.conn_lock:
+            conns, self.active_conns = list(self.active_conns), set()
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
 
 def serve_kv(host="127.0.0.1", port=8100, block=True,
              secret: Optional[str] = None,
@@ -1411,52 +1855,11 @@ def serve_kv(host="127.0.0.1", port=8100, block=True,
 # ---------------------------------------------------------------------------
 
 
-class _Conn:
-    def __init__(self, addr, secret: Optional[str],
-                 timeout: Optional[float] = None,
-                 connect_timeout: Optional[float] = None):
-        op_timeout = cnf.KV_OP_TIMEOUT_S if timeout is None else timeout
-        # connect under the (short) connect timeout — a SYN-black-holed
-        # peer must not eat the whole op timeout before discovery can
-        # even run — then widen to the op timeout for the data path
-        self.sock = socket.create_connection(
-            addr,
-            timeout=op_timeout if connect_timeout is None
-            else connect_timeout,
-        )
-        self.sock.settimeout(op_timeout)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.epoch = -1  # pool failover epoch tag
-        if secret:
-            self.call(["auth", secret])
-
-    def call(self, msg):
-        _send_frame(self.sock, _encode(msg))
-        resp = _decode(_recv_frame(self.sock))
-        if resp[0] == "err":
-            raise SdbError(resp[1])
-        return resp[1]
-
-    def close(self):
-        try:
-            self.sock.close()
-        except OSError:
-            pass
-
-
 def _status_of(addr, secret, timeout: float = 1.0) -> Optional[dict]:
-    """Probe one server's status; None when unreachable/unresponsive."""
-    try:
-        c = _Conn(addr, secret, timeout=timeout)
-    except (OSError, SdbError):
-        return None
-    try:
-        st = c.call(["status"])
-        return st if isinstance(st, dict) else None
-    except Exception:
-        return None
-    finally:
-        c.close()
+    """Probe one server's status; None when unreachable/unresponsive.
+    (Real-transport convenience wrapper; seam-aware callers go through
+    their Transport's `status_of`.)"""
+    return net.REAL_TRANSPORT.status_of(addr, secret, timeout=timeout)
 
 
 def _is_not_primary(e: BaseException) -> bool:
@@ -1481,13 +1884,15 @@ class _Pool:
     def __init__(self, addrs, secret=None, size=64,
                  policy: Optional[RetryPolicy] = None, telemetry=None,
                  op_timeout: Optional[float] = None,
-                 connect_timeout: Optional[float] = None):
+                 connect_timeout: Optional[float] = None,
+                 transport: Optional[net.Transport] = None):
         if isinstance(addrs, tuple):
             addrs = [addrs]
         self.addrs: list[tuple[str, int]] = list(addrs)
         self.secret = secret
         self.size = size
         self.policy = policy or RetryPolicy()
+        self.transport = transport or net.REAL_TRANSPORT
         self.telemetry = telemetry
         self.op_timeout = (cnf.KV_OP_TIMEOUT_S if op_timeout is None
                            else op_timeout)
@@ -1500,7 +1905,9 @@ class _Pool:
         self.primary_i = 0
         self.epoch = 0
         self._suspect = False
-        self.discover_lock = threading.Lock()
+        # held across status probes — must come from the transport so
+        # the simulator can park a task that blocks on it
+        self.discover_lock = self.transport.make_lock()
 
     # -- telemetry ----------------------------------------------------------
     def _inc(self, name: str):
@@ -1545,8 +1952,10 @@ class _Pool:
             n = len(self.addrs)
             for step in range(n):
                 i = (self.primary_i + step) % n
-                st = _status_of(self.addrs[i], self.secret,
-                                timeout=self.connect_timeout)
+                st = self.transport.status_of(
+                    self.addrs[i], self.secret,
+                    timeout=self.connect_timeout,
+                )
                 if st is None:
                     continue
                 if st.get("role") == "primary":
@@ -1554,8 +1963,10 @@ class _Pool:
                     return
                 j = self._addr_index(st.get("primary"))
                 if j is not None and j != i:
-                    st2 = _status_of(self.addrs[j], self.secret,
-                                     timeout=self.connect_timeout)
+                    st2 = self.transport.status_of(
+                        self.addrs[j], self.secret,
+                        timeout=self.connect_timeout,
+                    )
                     if st2 is not None and st2.get("role") == "primary":
                         self._set_primary(j)
                         return
@@ -1581,8 +1992,10 @@ class _Pool:
             addr = self.addrs[self.primary_i]
             epoch = self.epoch
         try:
-            c = _Conn(addr, self.secret, timeout=self.op_timeout,
-                      connect_timeout=self.connect_timeout)
+            c = self.transport.connect(
+                addr, self.secret, timeout=self.op_timeout,
+                connect_timeout=self.connect_timeout,
+            )
         except OSError as e:
             with self.lock:
                 self.count -= 1
@@ -1618,10 +2031,12 @@ class _Pool:
         # allocating a sequence batch on a second — blocking forever here
         # would deadlock the process at pool exhaustion. Wait in slices,
         # re-checking capacity: drop() frees a slot without queueing.
-        deadline = time.monotonic() + 30.0
+        deadline = self.policy.clock() + 30.0
         while True:
             try:
-                c = self.q.get(timeout=0.25)
+                # seam-owned wait: event-driven q.get for real sockets,
+                # virtual-time parking under the simulator
+                c = self.transport.queue_get(self.q, 0.25)
                 if c.epoch == self.epoch:
                     return c
                 self.drop(c)
@@ -1636,7 +2051,7 @@ class _Pool:
                 in_use = self.count
             if create:
                 return self._new_conn()
-            if time.monotonic() >= deadline:
+            if self.policy.clock() >= deadline:
                 raise SdbError(
                     f"kv connection pool exhausted ({in_use} in use; "
                     f"waited 30s)"
@@ -1875,6 +2290,15 @@ class RemoteTx(BackendTx):
                     f"kv primary changed; transaction aborted and can be "
                     f"retried: {e}"
                 )
+            if "not replicated" in str(e):
+                # the primary applied the write but refused the ack
+                # (durability gate: no replica attached to receive it)
+                self._return_conn()
+                self.pool._mark_suspect()
+                raise RetryableKvError(
+                    f"kv commit unreplicated; OUTCOME UNKNOWN — retry "
+                    f"only with idempotent writes: {e}"
+                )
             self._return_conn()
             raise
         except BaseException:
@@ -1961,7 +2385,8 @@ class RemoteBackend(Backend):
     def __init__(self, addr: str, secret: Optional[str] = None,
                  telemetry=None, policy: Optional[RetryPolicy] = None,
                  op_timeout: Optional[float] = None,
-                 connect_timeout: Optional[float] = None):
+                 connect_timeout: Optional[float] = None,
+                 transport: Optional[net.Transport] = None):
         addrs = [_parse_addr(a.strip())
                  for a in addr.split(",") if a.strip()]
         if not addrs:
@@ -1973,15 +2398,20 @@ class RemoteBackend(Backend):
             secret = os.environ.get("SURREAL_KV_SECRET") or None
         self.pool = _Pool(addrs, secret=secret, policy=policy,
                           telemetry=telemetry, op_timeout=op_timeout,
-                          connect_timeout=connect_timeout)
+                          connect_timeout=connect_timeout,
+                          transport=transport)
         self.lock = threading.RLock()
         # fail fast (bounded by the connect timeout, not the full retry
-        # deadline) when no service member is reachable at construction
+        # deadline) when no service member is reachable at construction.
+        # Inherits clock/sleep/rng so simulated runs stay virtual-time.
         boot = RetryPolicy(
             deadline_s=min(self.pool.policy.deadline_s,
                            self.pool.connect_timeout),
             base_ms=self.pool.policy.base_ms,
             max_ms=self.pool.policy.max_ms,
+            clock=self.pool.policy.clock,
+            sleep=self.pool.policy.sleep,
+            rng=self.pool.policy.rng,
         )
         self.pool.call(["ping"], policy=boot)
 

@@ -67,6 +67,7 @@ from typing import Iterator, Optional
 
 from surrealdb_tpu import cnf
 from surrealdb_tpu.err import RetryableKvError, SdbError
+from surrealdb_tpu.kvs import net
 from surrealdb_tpu.kvs.api import Backend, BackendTx
 from surrealdb_tpu.kvs.remote import (
     RemoteBackend,
@@ -358,7 +359,7 @@ class ShardTx(BackendTx):
 
     def _commit_2pc(self, writers):
         backend = self.backend
-        txid = uuid.uuid4().hex
+        txid = backend.new_txid()
         meta_addrs = list(backend.meta_addrs)
         prepared: list = []
         try:
@@ -454,7 +455,9 @@ class ShardedBackend(Backend):
     def __init__(self, addr: str, secret: Optional[str] = None,
                  telemetry=None, policy: Optional[RetryPolicy] = None,
                  op_timeout: Optional[float] = None,
-                 connect_timeout: Optional[float] = None):
+                 connect_timeout: Optional[float] = None,
+                 transport: Optional[net.Transport] = None,
+                 txid_factory=None):
         import os as _os
 
         if secret is None:
@@ -464,13 +467,21 @@ class ShardedBackend(Backend):
         self.policy = policy or RetryPolicy()
         self.op_timeout = op_timeout
         self.connect_timeout = connect_timeout
+        self.transport = transport
+        # injectable for the deterministic simulator (uuid4 would make
+        # two runs of the same seed diverge); None = real uuid4 hex
+        self.txid_factory = txid_factory
         self.lock = threading.RLock()
         self._groups: dict = {}  # tuple(addrs) -> RemoteBackend
         self._map: Optional[ShardMap] = None
         self._stale = True
+        # per-client record of every shard-map epoch adopted, in order —
+        # the simulator's epoch-monotonicity invariant reads this
+        self.epoch_history: list[int] = []
         self.meta = RemoteBackend(addr, secret=secret, telemetry=telemetry,
                                   policy=policy, op_timeout=op_timeout,
-                                  connect_timeout=connect_timeout)
+                                  connect_timeout=connect_timeout,
+                                  transport=transport)
         self.meta_addrs = tuple(
             f"{h}:{p}" for h, p in self.meta.pool.addrs
         )
@@ -520,8 +531,15 @@ class ShardedBackend(Backend):
                 self._map = m
             self._stale = False
             m = self._map
+            if len(self.epoch_history) < 65536:
+                self.epoch_history.append(m.epoch)
         self.count("kv_shard_map_refreshes")
         return m
+
+    def new_txid(self) -> str:
+        if self.txid_factory is not None:
+            return self.txid_factory()
+        return uuid.uuid4().hex
 
     def topology(self):
         """Shard topology for INFO FOR SYSTEM / the /kv/topology route.
@@ -570,6 +588,7 @@ class ShardedBackend(Backend):
                     telemetry=self.telemetry, policy=self.policy,
                     op_timeout=self.op_timeout,
                     connect_timeout=self.connect_timeout,
+                    transport=self.transport,
                 )
             except RetryableKvError as e:
                 raise RetryableKvError(
@@ -638,16 +657,20 @@ class ShardedBackend(Backend):
 # ---------------------------------------------------------------------------
 
 
-def _group_pool(addrs, secret=None) -> _Pool:
+def _group_pool(addrs, secret=None, transport=None,
+                policy: Optional[RetryPolicy] = None) -> _Pool:
     import os as _os
 
     if secret is None:
         secret = _os.environ.get("SURREAL_KV_SECRET") or None
-    return _Pool([_parse_addr(a) for a in addrs], secret=secret)
+    return _Pool([_parse_addr(a) for a in addrs], secret=secret,
+                 transport=transport, policy=policy)
 
 
-def _write_map(meta_addrs, m: ShardMap, secret=None):
-    be = RemoteBackend(",".join(meta_addrs), secret=secret)
+def _write_map(meta_addrs, m: ShardMap, secret=None, transport=None,
+               policy: Optional[RetryPolicy] = None):
+    be = RemoteBackend(",".join(meta_addrs), secret=secret,
+                       transport=transport, policy=policy)
     try:
         tx = be.transaction(True)
         tx.set(SHARD_MAP_KEY, m.encode())
@@ -656,9 +679,11 @@ def _write_map(meta_addrs, m: ShardMap, secret=None):
         be.close()
 
 
-def read_topology(meta_addr: str, secret: Optional[str] = None) -> ShardMap:
+def read_topology(meta_addr: str, secret: Optional[str] = None,
+                  transport=None,
+                  policy: Optional[RetryPolicy] = None) -> ShardMap:
     addrs = [a.strip() for a in meta_addr.split(",") if a.strip()]
-    pool = _group_pool(addrs, secret)
+    pool = _group_pool(addrs, secret, transport=transport, policy=policy)
     try:
         raw = pool.call(["get_latest", SHARD_MAP_KEY])
     finally:
@@ -671,7 +696,8 @@ def read_topology(meta_addr: str, secret: Optional[str] = None) -> ShardMap:
 
 
 def init_topology(groups: list, split_keys: list,
-                  secret: Optional[str] = None) -> ShardMap:
+                  secret: Optional[str] = None, transport=None,
+                  policy: Optional[RetryPolicy] = None) -> ShardMap:
     """Bootstrap a sharded cluster: fence every group to its range and
     publish the initial map on the meta group (group 0).
 
@@ -689,26 +715,28 @@ def init_topology(groups: list, split_keys: list,
     epoch = 1
     shards = []
     for i, g in enumerate(groups):
-        pool = _group_pool(g, secret)
+        pool = _group_pool(g, secret, transport=transport, policy=policy)
         try:
             pool.call(["shard_set", bounds[i], bounds[i + 1], epoch])
         finally:
             pool.close()
         shards.append(Shard(bounds[i], bounds[i + 1], tuple(g), epoch))
     m = ShardMap(epoch, shards)
-    _write_map(groups[0], m, secret)
+    _write_map(groups[0], m, secret, transport=transport, policy=policy)
     return m
 
 
 def split_shard(meta_addr: str, key: bytes, new_group: list,
-                secret: Optional[str] = None) -> ShardMap:
+                secret: Optional[str] = None, transport=None,
+                policy: Optional[RetryPolicy] = None) -> ShardMap:
     """Split the range containing `key` at `key`: the upper half moves
     to `new_group` (a running, empty replication group) behind an epoch
     fence. Safe to re-run after a partial failure — every step is
     idempotent up to the map publish, and the source purge only runs
     after the new map is durable."""
     meta_addrs = [a.strip() for a in meta_addr.split(",") if a.strip()]
-    m = read_topology(meta_addr, secret)
+    m = read_topology(meta_addr, secret, transport=transport,
+                      policy=policy)
     i = m.locate(key)
     src = m.shards[i]
     if key <= src.beg or (src.end is not None and key >= src.end):
@@ -717,8 +745,10 @@ def split_shard(meta_addr: str, key: bytes, new_group: list,
             f"[{src.beg!r}, {src.end!r})"
         )
     new_epoch = m.epoch + 1
-    src_pool = _group_pool(src.addrs, secret)
-    dst_pool = _group_pool(new_group, secret)
+    src_pool = _group_pool(src.addrs, secret, transport=transport,
+                           policy=policy)
+    dst_pool = _group_pool(new_group, secret, transport=transport,
+                           policy=policy)
     try:
         # 1. fence: the source stops serving [key, end) immediately
         src_pool.call(["shard_set", src.beg, key, new_epoch])
@@ -741,7 +771,8 @@ def split_shard(meta_addr: str, key: bytes, new_group: list,
         shards.insert(i + 1, Shard(key, src.end, tuple(new_group),
                                    new_epoch))
         out = ShardMap(new_epoch, shards)
-        _write_map(meta_addrs, out, secret)
+        _write_map(meta_addrs, out, secret, transport=transport,
+                   policy=policy)
         # 5. GC the moved slice on the source (safe: map is durable)
         src_pool.call(["shard_purge", key, src.end])
         return out

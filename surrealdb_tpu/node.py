@@ -11,10 +11,10 @@ no node-to-node RPC, exactly like the reference.
 from __future__ import annotations
 
 import threading
-import time
 import uuid
 
 from surrealdb_tpu import key as K
+from surrealdb_tpu.kvs import net
 from surrealdb_tpu.err import SdbError
 
 
@@ -32,7 +32,7 @@ class TaskLease:
     def try_acquire(self) -> bool:
         txn = self.ds.transaction(write=True)
         try:
-            now = time.time()
+            now = net.wall()
             row = txn.get_val(K.task_lease(self.name))
             if row is not None:
                 holder, expiry = row
@@ -86,7 +86,7 @@ def store_lease_acquire(vs, name: str, holder: str, ttl_s: float) -> bool:
     Same semantics as TaskLease.try_acquire, one layer down."""
     from surrealdb_tpu.kvs.api import deserialize, serialize
 
-    now = time.time()
+    now = net.wall()
     key = K.task_lease(name)
     snap = vs.snapshot()
     committing = False
@@ -138,7 +138,7 @@ def lease_tso_window(txn_factory, n: int, retries: int = 32):
         try:
             raw = txn.get(KV_TSO_KEY)
             last = int(raw.decode()) if raw else 0
-            start = max(int(time.time() * 1000) << 20, last + 1)
+            start = max(int(net.wall() * 1000) << 20, last + 1)
             txn.set(KV_TSO_KEY, str(start + n).encode())
             txn.commit()
             return start, start + n
@@ -167,7 +167,7 @@ def heartbeat(ds) -> None:
     txn = ds.transaction(write=True)
     try:
         txn.set_val(
-            K.node(ds.node_id), (time.time(), get_supervisor().state)
+            K.node(ds.node_id), (net.wall(), get_supervisor().state)
         )
         txn.commit()
     except SdbError:
@@ -191,7 +191,7 @@ def membership_check(ds, stale_s: float = 30.0) -> list[str]:
     lease = TaskLease(ds, "membership_check", ttl_s=stale_s / 2)
     if not lease.try_acquire():
         return []
-    now = time.time()
+    now = net.wall()
     txn = ds.transaction(write=True)
     try:
         dead = []

@@ -1,0 +1,15 @@
+"""Deterministic cluster simulation (FoundationDB-style) for the
+distributed KV stack: virtual time, seeded message scheduling, node
+crash/restart with durable state, and invariant checkers — all over the
+REAL kvs/remote.py engine via the kvs/net.py seam.
+
+Entry points:
+    from surrealdb_tpu.sim import run_sim, SimConfig
+    res = run_sim(seed=42)
+    assert res.ok, res.violations
+
+`tools/sim_explore.py` sweeps seeds and replays failures verbatim.
+"""
+
+from surrealdb_tpu.sim.cluster import SimConfig  # noqa: F401
+from surrealdb_tpu.sim.harness import SimResult, run_sim  # noqa: F401

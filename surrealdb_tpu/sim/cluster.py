@@ -1,0 +1,279 @@
+"""Simulated cluster: the REAL KvEngine (kvs/remote.py) per node —
+recovery, replication, leases, sharding, 2PC, all of it — mounted on
+the virtual-time kernel and the simulated transport.
+
+A node crash discards the engine object (all in-memory state: MVCC
+chains, stage/lock tables, link state) and kills its tasks, but keeps
+the node's data_dir — restart constructs a fresh engine that recovers
+from the WAL/snapshot exactly like a real process reboot. Each
+incarnation gets a fresh deterministic node_id, so lineage-change
+detection (full resync on new primary identity) is exercised for real.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from surrealdb_tpu import wire
+from surrealdb_tpu.err import RetryableKvError, SdbError
+from surrealdb_tpu.kvs.remote import RetryPolicy, StandaloneKvEngine
+from surrealdb_tpu.kvs.shard import ShardedBackend, init_topology
+from surrealdb_tpu.sim.net import SimNet
+from surrealdb_tpu.sim.scheduler import Kernel, SimClock, SimRuntime
+
+
+class SimConfig:
+    """Knobs for one simulated cluster run. Defaults give the
+    acceptance-criteria shape: meta group + 3 data shards, each a
+    primary + 2 replicas, 8 simulated clients."""
+
+    def __init__(self, **kw):
+        self.groups = 4          # group 0 = meta + lowest range
+        self.members = 3         # 1 primary + 2 replicas per group
+        self.spare_groups = 1    # empty groups provisioned as split targets
+        self.clients = 8
+        self.ops_per_client = 22
+        self.lease_ttl_s = 1.5
+        self.failover_timeout_s = 2.0
+        self.op_timeout_s = 3.0
+        self.connect_timeout_s = 0.6
+        self.retry_deadline_s = 12.0
+        self.orphan_grace_s = 2.0
+        self.resolve_interval_s = 0.4
+        self.latency = (0.0003, 0.004)
+        # fault schedule (driver): mean gap between injections, and
+        # which fault families are enabled
+        self.fault_gap_s = 2.0
+        self.max_chaos_s = 60.0  # stop injecting past this virtual time
+        self.crashes = True
+        self.partitions = True
+        self.delay_bursts = True
+        self.drop_windows = True
+        self.splits = 1          # max splits attempted per run
+        self.scripted_faults = None  # [(t, fn_name, args...)] overrides
+        self.quiesce_s = 45.0    # convergence budget after the workload
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise TypeError(f"unknown SimConfig knob {k!r}")
+            setattr(self, k, v)
+
+
+class SimNode:
+    """One simulated KV process (engine + its tasks + its data_dir)."""
+
+    def __init__(self, cluster: "SimCluster", host: str, port: int,
+                 group: int, index: int):
+        self.cluster = cluster
+        self.host = host
+        self.port = port
+        self.group = group
+        self.index = index
+        self.advertise = f"{host}:{port}"
+        self.data_dir = os.path.join(cluster.data_root, host)
+        self.up = False
+        self.engine: Optional[StandaloneKvEngine] = None
+        self.runtime: Optional[SimRuntime] = None
+        self.incarnation = 0
+        self.conns: list = []
+        self.handler_tasks: list = []
+        cluster.net.register(host, self)
+
+    # -- net callbacks ------------------------------------------------------
+
+    def accept(self, ch):
+        self.conns.append(ch)
+        t = self.cluster.kernel.spawn(
+            f"{self.host}:conn{len(self.conns)}",
+            lambda: self._serve(ch), daemon=True,
+        )
+        self.handler_tasks.append(t)
+
+    def _serve(self, ch):
+        engine = self.engine
+        if engine is None:
+            ch.teardown("down")
+            return
+        cstate = engine.new_conn_state()
+        try:
+            while True:
+                try:
+                    cid, blob = ch.server.recv()
+                except ConnectionError:
+                    break
+                if self.engine is not engine:  # crashed + restarted
+                    break
+                resp, close = engine.handle_frame(wire.decode(blob),
+                                                  cstate)
+                ch.server.send_resp(cid, resp)
+                if close:
+                    break
+        finally:
+            engine.conn_closed(cstate)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, role: str, join_existing: bool = False):
+        cluster = self.cluster
+        cfg = cluster.cfg
+        self.incarnation += 1
+        self.runtime = SimRuntime(cluster.kernel, self.host)
+        eng = StandaloneKvEngine(
+            self.advertise,
+            data_dir=self.data_dir,
+            fsync=False,
+            role=role,
+            clock=cluster.clock,
+            runtime=self.runtime,
+            transport=cluster.net.transport(self.host),
+            node_id=f"{self.host}#{self.incarnation}",
+            trace=cluster.kernel.log_engine,
+            failover_timeout_s=cfg.failover_timeout_s,
+            lease_ttl_s=cfg.lease_ttl_s,
+        )
+        eng.orphan_grace_s = cfg.orphan_grace_s
+        eng.resolve_interval_s = cfg.resolve_interval_s
+        eng.connect_timeout_s = cfg.connect_timeout_s
+        self.engine = eng
+        self.up = True
+        # configure AFTER `up` so join_existing probes can reach peers
+        eng.configure_cluster(self.cluster.peers_of(self.group),
+                              self_index=self.index, role=role,
+                              join_existing=join_existing)
+        if eng.role == "primary":
+            cluster.kernel.log_engine({
+                "ev": "boot_primary", "node": eng.node_id,
+                "addr": self.advertise,
+                "t": round(cluster.kernel.now, 6),
+            })
+        cluster.kernel.log("start", node=self.host, role=eng.role,
+                           inc=self.incarnation)
+
+    def crash(self):
+        if not self.up:
+            return
+        self.up = False
+        eng, self.engine = self.engine, None
+        self.cluster.kernel.log_engine({
+            "ev": "crash", "addr": self.advertise,
+            "t": round(self.cluster.kernel.now, 6),
+        })
+        if eng is not None:
+            eng.crash_close()
+        if self.runtime is not None:
+            self.runtime.kill_all()
+        for t in self.handler_tasks:
+            self.cluster.kernel.kill(t)
+        self.handler_tasks = []
+        for ch in self.conns:
+            ch.teardown("crash")
+        self.conns = []
+
+    def restart(self):
+        """Reboot after a crash: rejoin as a replica when any sibling is
+        up (the operator's restart script probes before choosing a
+        role), as the configured primary otherwise."""
+        siblings_up = any(
+            n.up for n in self.cluster.group_nodes(self.group)
+            if n is not self
+        )
+        role = "replica" if siblings_up else (
+            "primary" if self.index == 0 else "replica"
+        )
+        self.start(role, join_existing=True)
+
+
+class SimCluster:
+    def __init__(self, kernel: Kernel, cfg: SimConfig, data_root: str):
+        self.kernel = kernel
+        self.cfg = cfg
+        self.data_root = data_root
+        self.clock = SimClock(kernel)
+        self.net = SimNet(kernel, latency=cfg.latency)
+        kernel.engine_events = []
+
+        def _etrace(d):
+            kernel.engine_events.append(dict(d))
+            kernel.log("engine", **d)
+
+        kernel.log_engine = _etrace
+        self.nodes: list[SimNode] = []
+        total_groups = cfg.groups + cfg.spare_groups
+        for g in range(total_groups):
+            for m in range(cfg.members):
+                self.nodes.append(SimNode(
+                    self, host=f"g{g}m{m}", port=7000 + g * 10 + m,
+                    group=g, index=m,
+                ))
+        self._txid_counter = 0
+        self.split_keys: list[bytes] = []
+        self.meta_addr = ",".join(self.peers_of(0))
+
+    # -- topology helpers ---------------------------------------------------
+
+    def group_nodes(self, g: int) -> list[SimNode]:
+        return [n for n in self.nodes if n.group == g]
+
+    def peers_of(self, g: int) -> list[str]:
+        return [n.advertise for n in self.group_nodes(g)]
+
+    def primary_of(self, g: int) -> Optional[SimNode]:
+        for n in self.group_nodes(g):
+            if n.up and n.engine is not None \
+                    and n.engine.role == "primary":
+                return n
+        return None
+
+    def next_txid(self) -> str:
+        self._txid_counter += 1
+        return f"simtx{self._txid_counter:06d}"
+
+    def policy(self, deadline: Optional[float] = None) -> RetryPolicy:
+        return RetryPolicy(
+            deadline_s=self.cfg.retry_deadline_s if deadline is None
+            else deadline,
+            base_ms=40.0, max_ms=400.0, jitter=0.5,
+            clock=self.clock.monotonic, sleep=self.clock.sleep,
+            rng=self.kernel.rng.random,
+        )
+
+    # -- boot ---------------------------------------------------------------
+
+    def boot(self):
+        cfg = self.cfg
+        for n in self.nodes:
+            n.start("primary" if n.index == 0 else "replica")
+        # initial shard map: group 0 = meta + lowest range; spare
+        # groups stay unassigned (split targets)
+        bounds = [b"/b", b"/k/4", b"/y"][:cfg.groups - 1]
+        self.split_keys = bounds
+        groups = [self.peers_of(g) for g in range(cfg.groups)]
+        init_topology(groups, bounds,
+                      transport=self.net.transport("admin"),
+                      policy=self.policy())
+        self.kernel.log("topology_init", groups=cfg.groups)
+
+    # -- clients ------------------------------------------------------------
+
+    def client_backend(self, name: str) -> ShardedBackend:
+        last: BaseException = SdbError("unreachable")
+        for _ in range(40):
+            try:
+                return ShardedBackend(
+                    self.meta_addr,
+                    policy=self.policy(),
+                    op_timeout=self.cfg.op_timeout_s,
+                    connect_timeout=self.cfg.connect_timeout_s,
+                    transport=self.net.transport(name),
+                    txid_factory=self.next_txid,
+                )
+            except (RetryableKvError, SdbError, OSError) as e:
+                last = e
+                self.kernel.sleep(0.4)
+        raise SdbError(f"sim client backend never came up: {last}")
+
+    # -- final-state access (checkers) --------------------------------------
+
+    def all_up_engines(self):
+        return [n.engine for n in self.nodes
+                if n.up and n.engine is not None]

@@ -1,0 +1,499 @@
+"""run_sim(seed): one deterministic cluster simulation end to end.
+
+Builds the cluster (meta + data shards, each a replicated group of real
+KvEngines), runs N client workloads (single-shard writes, cross-shard
+2PC pairs, coordinator-crash injections, scans, TSO leases) against a
+seeded fault schedule (node crash/restart, symmetric and asymmetric
+partitions, latency bursts, silent frame drops, an online shard split),
+then heals everything, waits for convergence, and evaluates the
+invariant checkers. Returns a SimResult whose `trace_digest` and
+`store_digest` are bit-identical across runs of the same seed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import shutil
+import tempfile
+from typing import Optional
+
+from surrealdb_tpu.err import RetryableKvError, SdbError
+from surrealdb_tpu.kvs import net as kvnet
+from surrealdb_tpu.kvs.shard import _SimulatedCrash, split_shard
+from surrealdb_tpu.sim import invariants as inv
+from surrealdb_tpu.sim.cluster import SimCluster, SimConfig
+from surrealdb_tpu.sim.scheduler import Kernel, SimClock
+
+_AMBIG = "OUTCOME UNKNOWN"
+
+
+class SimResult:
+    def __init__(self):
+        self.seed = None
+        self.violations: list[str] = []
+        self.errors: list[str] = []
+        self.trace: list[str] = []
+        self.trace_digest = ""
+        self.store_digest = ""
+        self.virtual_s = 0.0
+        self.stats: dict = {}
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.errors
+
+    def summary(self) -> str:
+        state = "OK" if self.ok else "FAIL"
+        return (f"seed={self.seed} {state} virtual={self.virtual_s:.1f}s "
+                f"events={self.stats.get('events', 0)} "
+                f"acked={self.stats.get('acked', 0)} "
+                f"ambiguous={self.stats.get('ambiguous', 0)} "
+                f"trace={self.trace_digest[:12]} "
+                f"store={self.store_digest[:12]}")
+
+
+class _ClientLog:
+    def __init__(self, name):
+        self.name = name
+        self.singles: list[dict] = []
+        self.pairs: list[dict] = []
+        self.crashes: list[dict] = []
+        self.tso: list[tuple] = []
+        self.epochs: list[int] = []
+        self.inline_violations: list[str] = []
+
+
+def _classify(e: BaseException) -> str:
+    return "maybe" if _AMBIG in str(e) else "none"
+
+
+def _run_write(kernel, backend, writes: dict, attempts=10) -> str:
+    """Run one writeset to a certain outcome if possible. Returns
+    'acked' | 'maybe' | 'none'."""
+    ambiguous = False
+    for _ in range(attempts):
+        tx = None
+        try:
+            tx = backend.transaction(True)
+            for k, v in writes.items():
+                tx.set(k, v)
+            tx.commit()
+            return "acked"
+        except (RetryableKvError, SdbError, OSError) as e:
+            if tx is not None and not tx.done:
+                try:
+                    tx.cancel()
+                except (SdbError, OSError):
+                    pass
+            if _classify(e) == "maybe":
+                ambiguous = True
+            kernel.sleep(0.25)
+    return "maybe" if ambiguous else "none"
+
+
+def _workload(kernel, cluster, log: _ClientLog, ci: int, cfg: SimConfig):
+    rng = kernel.rng  # shared seeded stream; order is deterministic
+    backend = cluster.client_backend(log.name)
+    for j in range(cfg.ops_per_client):
+        r = rng.random()
+        if r < 0.55:
+            key = f"/k/{ci}/{j:03d}".encode()
+            val = f"{ci}:{j}".encode()
+            status = _run_write(kernel, backend, {key: val})
+            log.singles.append(
+                {"key": key, "val": val, "status": status}
+            )
+            if status == "acked" and rng.random() < 0.4:
+                try:
+                    tx = backend.transaction(False)
+                    got = tx.get(key)
+                    tx.commit()
+                    if got != val:
+                        log.inline_violations.append(
+                            f"READ-YOUR-WRITE: {key!r} acked {val!r} "
+                            f"but read {got!r}"
+                        )
+                except (RetryableKvError, SdbError, OSError):
+                    pass  # read unavailability is not a violation
+        elif r < 0.75:
+            ka = f"/a/{ci}/{j:03d}".encode()
+            kb = f"/z/{ci}/{j:03d}".encode()
+            val = f"{ci}:{j}".encode()
+            status = _run_write(kernel, backend, {ka: val, kb: val})
+            log.pairs.append(
+                {"ka": ka, "kb": kb, "val": val, "status": status}
+            )
+        elif r < 0.85:
+            # coordinator crash injection at a chosen 2PC point
+            ka = f"/b/{ci}/{j:03d}".encode()
+            kb = f"/y/{ci}/{j:03d}".encode()
+            val = f"{ci}:{j}".encode()
+            mode = ("after_prepare" if rng.random() < 0.5
+                    else "after_mark")
+            outcome = "none"
+            try:
+                tx = backend.transaction(True)
+                tx.set(ka, val)
+                tx.set(kb, val)
+                tx._crash_point = mode
+                tx.commit()
+                outcome = "commit"  # single-shard fast path (no 2PC)
+            except _SimulatedCrash:
+                outcome = "commit" if mode == "after_mark" else "abort"
+            except (RetryableKvError, SdbError, OSError) as e:
+                outcome = "maybe" if _AMBIG in str(e) else "abort"
+            if outcome != "none":
+                log.crashes.append({"ka": ka, "kb": kb, "val": val,
+                                    "mode": mode, "outcome": outcome})
+        elif r < 0.95:
+            try:
+                tx = backend.transaction(False)
+                items = list(tx.scan(b"/", b"0", limit=40))
+                tx.commit()
+                keys = [k for k, _v in items]
+                if keys != sorted(keys):
+                    log.inline_violations.append(
+                        f"SCAN ORDER violated at client {ci} op {j}"
+                    )
+            except (RetryableKvError, SdbError, OSError):
+                pass
+        else:
+            try:
+                log.tso.append(backend.tso_window(8))
+            except (RetryableKvError, SdbError, OSError):
+                pass
+        # pacing spreads the workload across the fault schedule so most
+        # ops overlap a crash/partition window somewhere in the cluster
+        kernel.sleep(0.15 + rng.random() * 0.85)
+    # the epochs THIS client adopted while the chaos ran — the
+    # monotonicity invariant is about these, not the checker's
+    # post-quiesce view
+    log.epochs = list(backend.epoch_history)
+    backend.close()
+
+
+class _Driver:
+    """Seeded fault scheduler: one task injecting faults on a quantized
+    clock until the workloads finish, then healing everything."""
+
+    def __init__(self, kernel: Kernel, cluster: SimCluster,
+                 cfg: SimConfig):
+        self.k = kernel
+        self.cluster = cluster
+        self.cfg = cfg
+        self.stop = False
+        self.pending_restart: list = []  # (due_t, node)
+        self.pending_heal: list = []  # (due_t, a, b) / (due_t, knob)
+        self.splits_done = 0
+        self.split_pending: Optional[tuple] = None
+
+    def hosts(self):
+        return [n.host for n in self.cluster.nodes]
+
+    def _maybe_fault(self):
+        k, cfg, cl = self.k, self.cfg, self.cluster
+        rng = k.rng
+        choices = []
+        if cfg.crashes:
+            choices += ["crash"] * 3
+        if cfg.partitions:
+            choices += ["partition"] * 3
+        if cfg.delay_bursts:
+            choices.append("delay")
+        if cfg.drop_windows:
+            choices.append("drop")
+        if self.splits_done < cfg.splits and cfg.spare_groups:
+            choices.append("split")
+        if not choices:
+            return
+        action = rng.choice(choices)
+        if action == "crash":
+            # only crash inside a fully-up group: the durability
+            # contract itself assumes one surviving attached replica
+            cands = [n for n in cl.nodes
+                     if n.up and all(s.up for s in
+                                     cl.group_nodes(n.group))]
+            if not cands:
+                return
+            n = rng.choice(cands)
+            n.crash()
+            self.pending_restart.append(
+                (k.now + 1.0 + rng.random() * 6.0, n)
+            )
+        elif action == "partition":
+            hosts = self.hosts() + [f"c{i}" for i in
+                                    range(cfg.clients)]
+            a, b = rng.sample(hosts, 2)
+            direction = rng.choice(["both", "a2b", "b2a"])
+            cl.net.partition(a, b, direction)
+            self.pending_heal.append(
+                (k.now + 0.5 + rng.random() * 4.0, a, b)
+            )
+        elif action == "delay":
+            cl.net.extra_delay = 0.02 + rng.random() * 0.2
+            self.pending_heal.append((k.now + rng.random() * 2.0,
+                                      "delay", None))
+        elif action == "drop":
+            cl.net.drop_prob = 0.02 + rng.random() * 0.08
+            cl.net.dup_prob = 0.1
+            self.pending_heal.append((k.now + rng.random() * 2.0,
+                                      "drop", None))
+        elif action == "split":
+            self.splits_done += 1
+            spare = cl.peers_of(cfg.groups)  # first spare group
+            self.split_pending = (b"/k/6", spare)
+            k.spawn("admin:split", self._run_split, daemon=True)
+
+    def _run_split(self):
+        key, spare = self.split_pending
+        try:
+            split_shard(self.cluster.meta_addr, key, spare,
+                        transport=self.cluster.net.transport("admin"),
+                        policy=self.cluster.policy())
+            self.k.log("split_done", key=key)
+            self.split_pending = None
+        except (RetryableKvError, SdbError, OSError) as e:
+            self.k.log("split_failed", err=str(e)[:80])
+
+    def finish_split(self):
+        """Quiesce-time completion of a split that died mid-flight —
+        split_shard is idempotent up to the map publish, and a re-run
+        against an already-published map reports 'not strictly
+        inside'."""
+        if self.split_pending is None:
+            return
+        key, spare = self.split_pending
+        for _ in range(3):
+            try:
+                split_shard(self.cluster.meta_addr, key, spare,
+                            transport=self.cluster.net.transport(
+                                "admin"),
+                            policy=self.cluster.policy())
+                self.split_pending = None
+                return
+            except SdbError as e:
+                if "not strictly inside" in str(e):
+                    self.split_pending = None  # already published
+                    return
+                self.k.sleep(2.0)
+            except (RetryableKvError, OSError):
+                self.k.sleep(2.0)
+
+    def _tick_pending(self, heal_all=False):
+        k, cl = self.k, self.cluster
+        due = [p for p in self.pending_restart
+               if heal_all or p[0] <= k.now]
+        for p in due:
+            self.pending_restart.remove(p)
+            p[1].restart()
+        due = [p for p in self.pending_heal
+               if heal_all or p[0] <= k.now]
+        for p in due:
+            self.pending_heal.remove(p)
+            if p[1] == "delay":
+                cl.net.extra_delay = 0.0
+            elif p[1] == "drop":
+                cl.net.drop_prob = 0.0
+                cl.net.dup_prob = 0.0
+            else:
+                cl.net.heal(p[1], p[2])
+
+    def run(self):
+        k, cfg = self.k, self.cfg
+        gap = 0.0
+        while not self.stop:
+            k.sleep(0.25)
+            if self.stop:  # a fault injected after stop would race the
+                return     # harness's quiesce-time knob resets
+            self._tick_pending()
+            gap += 0.25
+            if cfg.scripted_faults is not None:
+                continue  # scripted runs inject via the script only
+            if k.now > cfg.max_chaos_s:
+                continue  # stop injecting; a sick cluster must converge
+            if gap >= cfg.fault_gap_s * (0.5 + k.rng.random()):
+                gap = 0.0
+                self._maybe_fault()
+
+    def run_scripted(self):
+        """Execute cfg.scripted_faults: [(t, fn, args...)] where fn is
+        'crash'/'restart'/'partition'/'heal' — deterministic schedules
+        for regression seeds."""
+        k, cl = self.k, self.cluster
+        byname = {n.host: n for n in cl.nodes}
+        for entry in sorted(self.cfg.scripted_faults):
+            t, fn, args = entry[0], entry[1], entry[2:]
+            if t > k.now:
+                k.sleep(t - k.now)
+            if fn == "crash":
+                byname[args[0]].crash()
+            elif fn == "restart":
+                byname[args[0]].restart()
+            elif fn == "partition":
+                cl.net.partition(*args)
+            elif fn == "heal":
+                cl.net.heal(*args) if args else cl.net.heal()
+
+
+def run_sim(seed: int, cfg: Optional[SimConfig] = None,
+            data_root: Optional[str] = None,
+            mutate=None) -> SimResult:
+    """One full deterministic run. `mutate(cluster)` is a test hook that
+    runs after boot — mutation tests break a protocol invariant there
+    and assert the checkers catch it."""
+    cfg = cfg or SimConfig()
+    res = SimResult()
+    res.seed = seed
+    kernel = Kernel(seed)
+    tmp = data_root or tempfile.mkdtemp(prefix=f"simkv-{seed}-")
+    cluster = SimCluster(kernel, cfg, tmp)
+    logs = [_ClientLog(f"c{i}") for i in range(cfg.clients)]
+    final_scan: dict = {}
+    scan_ok: list = []
+    epoch_histories: dict = {}
+    engines_snapshot: list = []
+    store_digest: list = []
+
+    def main():
+        cluster.boot()
+        if mutate is not None:
+            mutate(cluster)
+        driver = _Driver(kernel, cluster, cfg)
+        tasks = [
+            kernel.spawn(f"c{i}", (lambda i=i: _workload(
+                kernel, cluster, logs[i], i, cfg)))
+            for i in range(cfg.clients)
+        ]
+        if cfg.scripted_faults is not None:
+            dtask = kernel.spawn("driver", driver.run_scripted,
+                                 daemon=True)
+        else:
+            dtask = kernel.spawn("driver", driver.run, daemon=True)
+        kernel.join(tasks)
+        driver.stop = True
+        kernel.join([dtask])  # knob resets must outlive the last tick
+        # ---- quiesce: heal the world, restart the dead --------------
+        cluster.net.heal()
+        cluster.net.drop_prob = 0.0
+        cluster.net.dup_prob = 0.0
+        cluster.net.extra_delay = 0.0
+        driver._tick_pending(heal_all=True)
+        for n in cluster.nodes:
+            if not n.up:
+                n.restart()
+        driver.finish_split()
+        deadline = kernel.now + cfg.quiesce_s
+        total_groups = cfg.groups + cfg.spare_groups
+        while kernel.now < deadline:
+            prim_ok = all(
+                sum(1 for n in cluster.group_nodes(g)
+                    if n.up and n.engine is not None
+                    and n.engine.role == "primary") == 1
+                for g in range(total_groups)
+            )
+            staged_ok = all(not e.staged
+                            for e in cluster.all_up_engines())
+            if prim_ok and staged_ok:
+                break
+            kernel.sleep(1.0)
+        else:
+            res.violations.append(
+                "NO CONVERGENCE within quiesce budget: "
+                + ";".join(
+                    f"g{g}:" + ",".join(
+                        f"{n.host}={n.engine.role if n.engine else '-'}"
+                        for n in cluster.group_nodes(g) if n.up)
+                    for g in range(total_groups))
+            )
+        # settle one lease interval so role flaps finish
+        kernel.sleep(cfg.lease_ttl_s)
+        # ---- final client-visible scan ------------------------------
+        checker = cluster.client_backend("checker")
+        scan_ok.clear()
+        for _ in range(5):
+            try:
+                tx = checker.transaction(False)
+                # workload keyspace only: "/$tl..." lease rows and other
+                # infra live below "/a" and are not part of the oracle
+                for key, v in tx.scan(b"/a", b"/\x7b"):
+                    final_scan[bytes(key)] = bytes(v)
+                tx.commit()
+                scan_ok.append(True)
+                break
+            except (RetryableKvError, SdbError, OSError):
+                final_scan.clear()
+                kernel.sleep(1.0)
+        for lg in logs:
+            epoch_histories[lg.name] = lg.epochs
+        epoch_histories["checker"] = list(checker.epoch_history)
+        checker.close()
+        # ---- digests + engine snapshot ------------------------------
+        h = hashlib.sha256()
+        for g in range(total_groups):
+            p = cluster.primary_of(g)
+            if p is None or p.engine is None:
+                h.update(f"group{g}:noprimary".encode())
+                continue
+            h.update(f"group{g}".encode())
+            for k_, v_ in sorted(p.engine.vs.latest_items()):
+                h.update(k_)
+                h.update(b"=")
+                h.update(v_)
+                h.update(b";")
+        store_digest.append(h.hexdigest())
+        engines_snapshot.extend(cluster.all_up_engines())
+        kernel.shutdown()
+
+    try:
+        # ambient seam clock → virtual time for the whole run: node.py's
+        # free functions (lease rows, TSO stamps) read it
+        with kvnet.use_clock(SimClock(kernel)):
+            kernel.run(main)
+    finally:
+        if data_root is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    # ---- evaluate invariants (outside the kernel) -----------------------
+    with kvnet.use_clock(kvnet.REAL_CLOCK):
+        singles = [r for lg in logs for r in lg.singles]
+        pairs = [r for lg in logs for r in lg.pairs]
+        crashes = [r for lg in logs for r in lg.crashes]
+        windows = [w for lg in logs for w in lg.tso]
+        res.violations += [v for lg in logs for v in lg.inline_violations]
+        if scan_ok:
+            res.violations += inv.check_acked_writes(singles, final_scan)
+            res.violations += inv.check_atomic_pairs(pairs, final_scan)
+            res.violations += inv.check_crashpoints(crashes, final_scan)
+            res.violations += inv.check_scan_oracle(
+                singles, pairs, crashes, final_scan
+            )
+        else:
+            res.violations.append(
+                "FINAL SCAN FAILED: cluster unreadable after quiesce"
+            )
+        res.violations += inv.check_tso(windows)
+        res.violations += inv.check_epoch_monotonic(epoch_histories)
+        node_group = {n.advertise: n.group for n in cluster.nodes}
+        res.violations += inv.check_lease_safety(
+            getattr(kernel, "engine_events", []), node_group
+        )
+        res.violations += inv.check_staged_leak(engines_snapshot)
+    res.errors = list(kernel.errors)
+    res.trace = kernel.trace
+    res.trace_digest = hashlib.sha256(
+        "\n".join(kernel.trace).encode()
+    ).hexdigest()
+    res.store_digest = store_digest[0] if store_digest else ""
+    res.virtual_s = kernel.now
+    res.stats = {
+        "events": kernel.events,
+        "frames": cluster.net.frames,
+        "dropped": cluster.net.dropped,
+        "acked": sum(1 for r in singles + pairs
+                     if r["status"] == "acked"),
+        "ambiguous": sum(1 for r in singles + pairs
+                         if r["status"] == "maybe"),
+        "crash_injections": len(crashes),
+        "tso_windows": len(windows),
+    }
+    return res

@@ -1,0 +1,201 @@
+"""Invariant checkers for simulated cluster runs.
+
+Each checker returns a list of violation strings (empty = pass). The
+harness (sim/harness.py) collects the artifacts while the run executes:
+per-client operation logs with ack/ambiguity classification, TSO
+windows, shard-map epoch observations, engine role-transition events,
+and the final converged keyspace read through an ordinary client.
+
+Soundness of the write oracle: every workload key is written at most
+once (key embeds client + op index) with a value that is a pure
+function of (client, op) — so a retried attempt writes the identical
+bytes and the acceptable final states collapse to:
+
+- acked        -> the value MUST be present,
+- ambiguous    -> present-with-that-value or absent, both legal,
+- never-tried  -> absent.
+"""
+
+from __future__ import annotations
+
+
+def check_acked_writes(singles: list, final: dict) -> list[str]:
+    """Zero acked-write loss + no phantom values."""
+    out = []
+    for rec in singles:
+        key, val, status = rec["key"], rec["val"], rec["status"]
+        got = final.get(key)
+        if status == "acked":
+            if got != val:
+                out.append(
+                    f"ACKED WRITE LOST: {key!r} expected {val!r}, "
+                    f"found {got!r}"
+                )
+        elif status == "maybe":
+            if got not in (None, val):
+                out.append(
+                    f"PHANTOM VALUE: {key!r} holds {got!r}, only "
+                    f"{val!r}/absent possible"
+                )
+        else:  # never acked, never ambiguous
+            if got is not None:
+                out.append(
+                    f"PHANTOM VALUE: {key!r} holds {got!r} but the "
+                    f"write was never attempted to completion"
+                )
+    return out
+
+
+def check_atomic_pairs(pairs: list, final: dict) -> list[str]:
+    """Cross-shard 2PC atomicity: a pair's two keys (on different
+    shards) are either both present with the same value or both
+    absent — never half-applied."""
+    out = []
+    for rec in pairs:
+        ga, gb = final.get(rec["ka"]), final.get(rec["kb"])
+        if (ga is None) != (gb is None) or (ga is not None
+                                            and ga != gb):
+            out.append(
+                f"2PC ATOMICITY: pair {rec['ka']!r}/{rec['kb']!r} "
+                f"half-applied: {ga!r} vs {gb!r} (status "
+                f"{rec['status']})"
+            )
+        if rec["status"] == "acked" and ga != rec["val"]:
+            out.append(
+                f"ACKED 2PC LOST: {rec['ka']!r}/{rec['kb']!r} expected "
+                f"{rec['val']!r}, found {ga!r}/{gb!r}"
+            )
+    return out
+
+
+def check_crashpoints(crashes: list, final: dict) -> list[str]:
+    """Coordinator-crash recovery: a coordinator that died AFTER the
+    commit-log record must converge to commit everywhere; one that died
+    between prepare and the record must converge to abort."""
+    out = []
+    for rec in crashes:
+        ga, gb = final.get(rec["ka"]), final.get(rec["kb"])
+        if rec["outcome"] == "commit":
+            if ga != rec["val"] or gb != rec["val"]:
+                out.append(
+                    f"2PC CRASH(after_mark) NOT COMMITTED: "
+                    f"{rec['ka']!r}={ga!r} {rec['kb']!r}={gb!r}"
+                )
+        elif rec["outcome"] == "abort":
+            if ga is not None or gb is not None:
+                out.append(
+                    f"2PC CRASH(after_prepare) NOT ABORTED: "
+                    f"{rec['ka']!r}={ga!r} {rec['kb']!r}={gb!r}"
+                )
+        else:  # maybe: consistency only
+            if (ga is None) != (gb is None) or ga != gb:
+                out.append(
+                    f"2PC CRASH(maybe) INCONSISTENT: "
+                    f"{rec['ka']!r}={ga!r} {rec['kb']!r}={gb!r}"
+                )
+    return out
+
+
+def check_scan_oracle(singles, pairs, crashes, final: dict) -> list[str]:
+    """The converged keyspace contains nothing but explainable keys —
+    byte-identical to what a fault-free oracle store would hold, up to
+    the recorded ambiguity set."""
+    expl = {}
+    for rec in singles:
+        expl[rec["key"]] = rec
+    for rec in pairs:
+        expl[rec["ka"]] = rec
+        expl[rec["kb"]] = rec
+    for rec in crashes:
+        expl[rec["ka"]] = rec
+        expl[rec["kb"]] = rec
+    out = []
+    for k in final:
+        if k not in expl:
+            out.append(f"UNEXPLAINED KEY in final scan: {k!r}")
+    keys = list(final)
+    if keys != sorted(keys):
+        out.append("FINAL SCAN NOT IN KEY ORDER")
+    return out
+
+
+def check_tso(windows: list) -> list[str]:
+    """TSO windows are globally disjoint and well-formed."""
+    out = []
+    seen = sorted(windows)
+    for (a1, b1), (a2, b2) in zip(seen, seen[1:]):
+        if a2 < b1:
+            out.append(
+                f"TSO OVERLAP: [{a1},{b1}) intersects [{a2},{b2})"
+            )
+    for a, b in seen:
+        if b <= a:
+            out.append(f"TSO EMPTY/INVERTED window [{a},{b})")
+    return out
+
+
+def check_epoch_monotonic(histories: dict) -> list[str]:
+    """Every client's adopted shard-map epoch sequence is nondecreasing
+    (a regression would mean a split was un-published)."""
+    out = []
+    for name, hist in histories.items():
+        for a, b in zip(hist, hist[1:]):
+            if b < a:
+                out.append(
+                    f"SHARD-MAP EPOCH REGRESSION at client {name}: "
+                    f"{a} -> {b}"
+                )
+                break
+    return out
+
+
+def check_lease_safety(events: list, node_group: dict) -> list[str]:
+    """Never two primaries of one replication group at the same virtual
+    time. Built from engine role-transition events: a node is primary
+    from boot_primary/promote until demote/crash."""
+    opens: dict = {}  # (group, addr) -> open time
+    intervals: dict = {}  # group -> list[(t0, t1, addr)]
+    for ev in events:
+        addr = ev.get("addr")
+        g = node_group.get(addr)
+        if g is None:
+            continue
+        kind = ev.get("ev")
+        t = float(ev.get("t", 0.0))
+        key = (g, addr)
+        if kind in ("boot_primary", "promote"):
+            opens.setdefault(key, t)
+        elif kind in ("demote", "crash") and key in opens:
+            t0 = opens.pop(key)
+            intervals.setdefault(g, []).append((t0, t, addr))
+    for (g, addr), t0 in opens.items():
+        intervals.setdefault(g, []).append((t0, float("inf"), addr))
+    out = []
+    for g, ivs in intervals.items():
+        ivs.sort()
+        for (a0, a1, na), (b0, b1, nb) in zip(ivs, ivs[1:]):
+            if na != nb and b0 < a1:  # strict overlap (touch is legal)
+                out.append(
+                    f"LEASE SAFETY: group {g} had two primaries "
+                    f"{na} [{a0:.3f},{a1:.3f}) and {nb} "
+                    f"[{b0:.3f},{b1:.3f})"
+                )
+    return out
+
+
+def check_staged_leak(engines) -> list[str]:
+    """After convergence no 2PC stage survives: every prepared
+    transaction reached a decision."""
+    out = []
+    for eng in engines:
+        if eng.staged:
+            out.append(
+                f"2PC STAGE LEAK on {eng.advertise}: "
+                f"{sorted(eng.staged)[:4]}"
+            )
+        if eng.locks:
+            out.append(
+                f"2PC LOCK LEAK on {eng.advertise}: "
+                f"{sorted(eng.locks)[:4]}"
+            )
+    return out

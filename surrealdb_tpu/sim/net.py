@@ -1,0 +1,288 @@
+"""Simulated transport: in-process message-scheduled networking that
+implements the kvs/net.py `Transport` contract over the virtual-time
+kernel.
+
+Semantics mirror the real TCP framing layer at the granularity the
+protocol cares about:
+
+- per-connection, per-direction FIFO (TCP ordering) — but latency is
+  drawn per message from the seeded PRNG, so frames on DIFFERENT
+  connections reorder freely;
+- a dropped request or response is SILENT (the caller times out, the
+  classic ambiguous-outcome fault);
+- duplicated frames are delivered twice (restricted to the replication
+  ops, like the real FaultProxy usage — duplicating a client `commit`
+  frame would be a fault no TCP stack can produce);
+- partitions black-hole a (src, dst) HOST pair per direction — the
+  same asymmetric vocabulary kvs/faults.py exposes for real sockets;
+- a crashed node refuses new connections and every established channel
+  to it raises ConnectionError, while frames already handed to a live
+  peer stay delivered.
+
+Every frame still round-trips through wire.encode/decode so no object
+aliasing can leak between "processes".
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+from collections import deque
+from typing import Optional
+
+from surrealdb_tpu import wire
+from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.kvs import net as kvnet
+from surrealdb_tpu.sim.scheduler import Kernel, SimLock
+
+
+class _SrvConn:
+    """Server side of one simulated connection."""
+
+    __slots__ = ("channel", "inbox", "waiter", "closed")
+
+    def __init__(self, channel):
+        self.channel = channel
+        self.inbox: deque = deque()
+        self.waiter = None
+        self.closed = False
+
+    def recv(self):
+        k = self.channel.net.k
+        while True:
+            with k.mu:
+                if self.inbox:
+                    return self.inbox.popleft()
+                if self.closed:
+                    raise ConnectionError("sim conn closed")
+                self.waiter = k.current_task()
+            k.block()
+
+    def send_resp(self, cid: int, resp):
+        self.channel.net._send(self.channel, "resp", cid,
+                               wire.encode(resp), resp_op=None)
+
+
+class SimChannel:
+    """Client side of one simulated connection (the `_Conn` analog the
+    pool checks out: `call` / `close` / writable `epoch`)."""
+
+    def __init__(self, sim_net: "SimNet", src: str, dst: str,
+                 op_timeout: float):
+        self.net = sim_net
+        self.src = src
+        self.dst = dst
+        self.op_timeout = op_timeout
+        self.epoch = -1
+        self.closed = False
+        self._cid = 0
+        self.responses: dict = {}
+        self.waiter = None
+        self.last_arr = {"req": 0.0, "resp": 0.0}
+        self.server = _SrvConn(self)
+
+    def call(self, msg):
+        k = self.net.k
+        t = k.current_task()
+        if t is None:
+            raise ConnectionError("sim conn used outside a sim task")
+        if self.closed:
+            raise ConnectionError("sim conn closed")
+        self._cid += 1
+        cid = self._cid
+        op = msg[0] if isinstance(msg, list) and msg else None
+        self.net._send(self, "req", cid, wire.encode(msg), resp_op=op)
+        deadline = k.now + self.op_timeout
+        while True:
+            with k.mu:
+                if cid in self.responses:
+                    blob = self.responses.pop(cid)
+                    break
+                if self.closed:
+                    raise ConnectionError("sim conn reset")
+                self.waiter = t
+            remaining = deadline - k.now
+            if remaining <= 0:
+                # a timed-out connection is desynced, like a real
+                # socket — poison it so the pool drops it
+                self.teardown("timeout")
+                raise _socket.timeout(f"sim op timeout ({op})")
+            k.block(timeout=remaining)
+        resp = wire.decode(blob)
+        if resp[0] == "err":
+            raise SdbError(resp[1])
+        return resp[1]
+
+    def close(self):
+        self.teardown("close")
+
+    def teardown(self, why: str):
+        k = self.net.k
+        with k.mu:
+            if self.closed and self.server.closed:
+                return
+            self.closed = True
+            self.server.closed = True
+            if self.server.waiter is not None:
+                k._wake_locked(self.server.waiter, "closed")
+                self.server.waiter = None
+            if self.waiter is not None:
+                k._wake_locked(self.waiter, "closed")
+                self.waiter = None
+
+
+class SimTransport(kvnet.Transport):
+    """One endpooint's view of the simulated network (identified by
+    `host` for the partition matrix)."""
+
+    def __init__(self, sim_net: "SimNet", host: str):
+        self.net = sim_net
+        self.host = host
+
+    def connect(self, addr, secret=None, timeout=None,
+                connect_timeout=None):
+        return self.net.connect(self.host, addr, secret=secret,
+                                timeout=timeout,
+                                connect_timeout=connect_timeout)
+
+    def make_lock(self):
+        return SimLock(self.net.k)
+
+    def queue_get(self, q, timeout: float):
+        # park in virtual time between polls: a real q.get would hold
+        # the scheduler baton and freeze the whole simulation
+        import queue as _queue
+
+        try:
+            return q.get_nowait()
+        except _queue.Empty:
+            self.net.k.sleep(timeout)
+            return q.get_nowait()  # Empty again propagates to caller
+
+
+class SimNet:
+    """Registry of simulated nodes + the fault schedule knobs."""
+
+    #: ops the duplicate fault may target (replication stream only —
+    #: mirrors how the real FaultProxy's duplicate knob is used)
+    DUP_OPS = ("repl_apply", "repl_ping", "repl_hello")
+
+    def __init__(self, kernel: Kernel, latency: tuple = (0.0003, 0.004)):
+        self.k = kernel
+        self.nodes: dict = {}  # host -> node object (.up, .accept(chan))
+        self.cut: set = set()  # (src_host, dst_host) blocked directions
+        self.latency = latency
+        self.extra_delay = 0.0  # latency-burst fault knob
+        self.drop_prob = 0.0  # silent per-frame drop fault knob
+        self.dup_prob = 0.0  # duplicate fault knob (DUP_OPS only)
+        self.frames = 0
+        self.dropped = 0
+
+    # -- topology control ---------------------------------------------------
+
+    def register(self, host: str, node):
+        self.nodes[host] = node
+
+    def partition(self, a: str, b: str, direction: str = "both"):
+        """Cut delivery between hosts a and b: 'both', 'a2b' (frames
+        from a to b vanish), or 'b2a'."""
+        if direction in ("both", "a2b"):
+            self.cut.add((a, b))
+        if direction in ("both", "b2a"):
+            self.cut.add((b, a))
+        self.k.log("partition", a=a, b=b, dir=direction)
+
+    def heal(self, a: Optional[str] = None, b: Optional[str] = None):
+        if a is None:
+            self.cut.clear()
+            self.k.log("heal_all")
+            return
+        for pair in [(a, b), (b, a)]:
+            self.cut.discard(pair)
+        self.k.log("heal", a=a, b=b)
+
+    def blocked(self, src: str, dst: str) -> bool:
+        return (src, dst) in self.cut
+
+    def transport(self, host: str) -> SimTransport:
+        return SimTransport(self, host)
+
+    # -- connections --------------------------------------------------------
+
+    def connect(self, src_host: str, addr, secret=None, timeout=None,
+                connect_timeout=None):
+        from surrealdb_tpu import cnf
+
+        host = addr[0] if isinstance(addr, tuple) else str(addr)
+        op_timeout = cnf.KV_OP_TIMEOUT_S if timeout is None else timeout
+        cto = (op_timeout if connect_timeout is None else connect_timeout)
+        node = self.nodes.get(host)
+        if node is None or not node.up:
+            raise ConnectionRefusedError(f"sim connect refused: {host}")
+        if self.blocked(src_host, host) or self.blocked(host, src_host):
+            # black hole: the SYN (or the SYNACK) vanishes
+            self.k.sleep(cto)
+            raise _socket.timeout(f"sim connect timeout: {host}")
+        self.k.sleep(self._delay())
+        ch = SimChannel(self, src_host, host, op_timeout)
+        node.accept(ch)
+        if secret:
+            ch.call(["auth", secret])
+        return ch
+
+    # -- frame scheduling ---------------------------------------------------
+
+    def _delay(self) -> float:
+        lo, hi = self.latency
+        return self.k.rng.uniform(lo, hi) + self.extra_delay
+
+    def _send(self, ch: SimChannel, direction: str, cid: int,
+              blob: bytes, resp_op):
+        k = self.k
+        src, dst = ((ch.src, ch.dst) if direction == "req"
+                    else (ch.dst, ch.src))
+        self.frames += 1
+        if self.blocked(src, dst):
+            self.dropped += 1
+            k.log("drop_cut", src=src, dst=dst, op=resp_op, cid=cid)
+            return
+        if self.drop_prob and k.rng.random() < self.drop_prob:
+            self.dropped += 1
+            k.log("drop_rand", src=src, dst=dst, op=resp_op, cid=cid)
+            return
+        copies = 1
+        if (self.dup_prob and resp_op in self.DUP_OPS
+                and k.rng.random() < self.dup_prob):
+            copies = 2
+        for c in range(copies):
+            delay = self._delay()
+            arr = max(k.now + delay, ch.last_arr[direction] + 1e-9)
+            ch.last_arr[direction] = arr
+            k.log("send", src=src, dst=dst, op=resp_op, cid=cid,
+                  dir=direction, copy=c, at=round(arr, 6))
+            k.post(arr - k.now,
+                   self._mk_deliver(ch, direction, cid, blob, src, dst))
+
+    def _mk_deliver(self, ch, direction, cid, blob, src, dst):
+        def deliver():
+            # runs inside the scheduler step: mutate + wake only
+            if self.blocked(src, dst):
+                self.dropped += 1
+                return
+            if direction == "req":
+                conn = ch.server
+                node = self.nodes.get(ch.dst)
+                if conn.closed or node is None or not node.up:
+                    return
+                conn.inbox.append((cid, blob))
+                if conn.waiter is not None:
+                    self.k._wake_locked(conn.waiter)
+                    conn.waiter = None
+            else:
+                if ch.closed or cid in ch.responses:
+                    return  # dup response or dead client side
+                ch.responses[cid] = blob
+                if ch.waiter is not None:
+                    self.k._wake_locked(ch.waiter)
+                    ch.waiter = None
+
+        return deliver

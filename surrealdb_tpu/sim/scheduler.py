@@ -1,0 +1,442 @@
+"""Deterministic virtual-time scheduler for the cluster simulator.
+
+FoundationDB-style discipline adapted to blocking Python code: every
+actor in a simulated cluster (client workload, server connection
+handler, background tick) is a real OS thread, but a single BATON —
+handed off explicitly at seam points — guarantees that exactly one of
+them executes at any moment. OS thread scheduling therefore cannot
+influence execution order: the interleaving is chosen entirely by this
+kernel from a seeded PRNG plus a virtual-time timer heap, which makes a
+whole multi-node run a pure function of its seed.
+
+Blocking points are exactly the seam operations from kvs/net.py:
+`Clock.sleep`, lock acquisition (`SimLock`), and message send/receive in
+the simulated transport (sim/net.py). Virtual time never passes while
+code runs; it JUMPS to the next timer when every task is blocked — a
+60-virtual-second failover test executes in milliseconds.
+
+Task death: `kill()` marks a task and wakes it; the task raises
+`SimKilled` (a BaseException, so ordinary `except Exception` recovery
+code cannot swallow it) at its next seam point — exactly the semantics
+of a process dying between atomic steps.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+from surrealdb_tpu.kvs import net
+
+
+class SimKilled(BaseException):
+    """Raised inside a task when its simulated process dies."""
+
+
+class SimError(Exception):
+    """The simulation itself failed (deadlock, event budget, watchdog)."""
+
+
+class _Task:
+    __slots__ = ("kernel", "name", "fn", "daemon", "thread", "evt",
+                 "state", "killed", "woke", "wake_seq", "joiners")
+
+    def __init__(self, kernel: "Kernel", name: str, fn, daemon: bool):
+        self.kernel = kernel
+        self.name = name
+        self.fn = fn
+        self.daemon = daemon
+        self.evt = threading.Event()
+        self.state = "ready"  # ready | running | blocked | done
+        self.killed = False
+        self.woke: Optional[str] = None
+        self.wake_seq = 0
+        self.joiners: list = []
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name=f"sim:{name}"
+        )
+
+    def _run(self):
+        self.evt.wait()
+        self.evt.clear()
+        k = self.kernel
+        k._local.task = self
+        try:
+            if not self.killed:
+                self.fn()
+        except SimKilled:
+            pass
+        except BaseException as e:  # robust: recorded as a sim failure
+            k._task_crashed(self, e)
+        finally:
+            k._task_done(self)
+
+    def __repr__(self):
+        return f"<SimTask {self.name} {self.state}>"
+
+
+class Kernel:
+    """The deterministic scheduler: tasks + virtual-time timer heap +
+    seeded PRNG + event trace."""
+
+    def __init__(self, seed: int, start_wall: float = 1_700_000_000.0,
+                 max_events: int = 4_000_000):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.now = 0.0
+        self.start_wall = start_wall
+        self.mu = threading.Lock()
+        self.heap: list = []  # (virtual_time, seq, thunk)
+        self._seq = 0
+        self.ready: list = []
+        self.tasks: list = []
+        self.current: Optional[_Task] = None
+        self.done = threading.Event()
+        self.errors: list[str] = []
+        self.trace: list[str] = []
+        self.events = 0
+        self.max_events = max_events
+        self._shutdown = False
+        self._local = threading.local()
+
+    # -- trace --------------------------------------------------------------
+
+    def log(self, kind: str, **fields):
+        parts = " ".join(f"{k}={fields[k]}" for k in sorted(fields))
+        self.trace.append(f"{self.now:012.6f} {kind} {parts}")
+
+    # -- introspection ------------------------------------------------------
+
+    def current_task(self) -> Optional[_Task]:
+        return getattr(self._local, "task", None)
+
+    # -- timers -------------------------------------------------------------
+
+    def post(self, delay: float, thunk: Callable[[], None]):
+        """Schedule `thunk` to run at now+delay. Thunks execute inside
+        the scheduling step — they may only mutate kernel state and
+        ready/wake tasks, never run user code."""
+        with self.mu:
+            self._post_locked(delay, thunk)
+
+    def _post_locked(self, delay: float, thunk):
+        self._seq += 1
+        heapq.heappush(
+            self.heap, (self.now + max(delay, 0.0), self._seq, thunk)
+        )
+
+    # -- task lifecycle -----------------------------------------------------
+
+    def spawn(self, name: str, fn, daemon: bool = False) -> _Task:
+        t = _Task(self, name, fn, daemon)
+        with self.mu:
+            self.tasks.append(t)
+        t.thread.start()
+        with self.mu:
+            self.ready.append(t)
+        return t
+
+    def kill(self, task: _Task):
+        with self.mu:
+            self._kill_locked(task)
+
+    def _kill_locked(self, task: _Task):
+        if task.state == "done" or task.killed:
+            return
+        task.killed = True
+        if task.state == "blocked":
+            task.state = "ready"
+            task.woke = "killed"
+            self.ready.append(task)
+
+    def join(self, tasks):
+        """Block the current task until every task in `tasks` is done."""
+        me = self.current_task()
+        for t in tasks:
+            while t.state != "done":
+                with self.mu:
+                    if t.state == "done":
+                        break
+                    t.joiners.append(me)
+                self.block()
+
+    def _task_crashed(self, task: _Task, e: BaseException):
+        self.errors.append(
+            f"task {task.name} died: {e.__class__.__name__}: {e}"
+        )
+
+    def _task_done(self, task: _Task):
+        handoff = None
+        with self.mu:
+            task.state = "done"
+            for j in task.joiners:
+                self._wake_locked(j, "join")
+            task.joiners = []
+            if task is self.current:
+                self.current = None
+                handoff = self._next_locked()
+        if handoff is not None:
+            handoff.evt.set()
+
+    # -- scheduling core ----------------------------------------------------
+
+    def _wake_locked(self, task: _Task, tag: str = "wake"):
+        if task.state == "blocked":
+            task.state = "ready"
+            task.woke = tag
+            self.ready.append(task)
+
+    def wake(self, task: _Task, tag: str = "wake"):
+        with self.mu:
+            self._wake_locked(task, tag)
+
+    def _fail_locked(self, msg: str):
+        self.errors.append(msg)
+        self._shutdown = True
+        for x in self.tasks:
+            if x.state in ("ready", "running"):
+                x.killed = True
+            elif x.state == "blocked":
+                x.killed = True
+                x.state = "ready"
+                x.woke = "killed"
+                self.ready.append(x)
+
+    def _next_locked(self) -> Optional[_Task]:
+        """Pick the next task to run; advances virtual time and executes
+        due timer thunks while nothing is ready. Returns None only when
+        the whole simulation has drained."""
+        while True:
+            self.events += 1
+            if self.events > self.max_events and not self._shutdown:
+                self._fail_locked("sim event budget exceeded")
+            if self.ready:
+                i = (self.rng.randrange(len(self.ready))
+                     if len(self.ready) > 1 else 0)
+                nxt = self.ready.pop(i)
+                if nxt.state != "ready":  # defensively skip stale entries
+                    continue
+                nxt.state = "running"
+                self.current = nxt
+                return nxt
+            if self.heap:
+                t, _s, thunk = heapq.heappop(self.heap)
+                if t > self.now:
+                    self.now = t
+                thunk()
+                continue
+            blocked = [x for x in self.tasks if x.state == "blocked"]
+            if blocked and not self._shutdown:
+                self._fail_locked(
+                    "sim deadlock: blocked="
+                    + ",".join(x.name for x in blocked[:8])
+                )
+                continue
+            if blocked:
+                # shutdown drain: blocked tasks remain (killed ones
+                # resolve via ready); force-wake to unwind
+                for x in blocked:
+                    self._kill_locked(x)
+                continue
+            self.current = None
+            self.done.set()
+            return None
+
+    def block(self, timeout: Optional[float] = None) -> str:
+        """Suspend the current task; returns the wake tag ('wake',
+        'timeout', 'join'). Raises SimKilled when the task's simulated
+        process died while it was parked."""
+        t = self.current_task()
+        if t is None:
+            # non-task context (finalizers, stray threads): behave like
+            # a dead connection rather than corrupting the schedule
+            raise ConnectionError("sim: blocking call outside a sim task")
+        if t.killed:
+            raise SimKilled()
+        with self.mu:
+            t.state = "blocked"
+            t.wake_seq += 1
+            seq = t.wake_seq
+
+            if timeout is not None:
+                def timer(task=t, s=seq):
+                    if task.state == "blocked" and task.wake_seq == s:
+                        task.state = "ready"
+                        task.woke = "timeout"
+                        self.ready.append(task)
+
+                self._post_locked(timeout, timer)
+            handoff = self._next_locked()
+        if handoff is not None:
+            handoff.evt.set()
+        t.evt.wait()
+        t.evt.clear()
+        if t.killed:
+            raise SimKilled()
+        return t.woke or "wake"
+
+    def sleep(self, delay: float):
+        self.block(timeout=max(delay, 0.0))
+
+    def shutdown(self):
+        """Kill every task except the caller (the run's epilogue)."""
+        me = self.current_task()
+        with self.mu:
+            self._shutdown = True
+            for x in self.tasks:
+                if x is me or x.state == "done":
+                    continue
+                self._kill_locked(x)
+
+    def run(self, main_fn, real_timeout_s: float = 300.0):
+        """Execute `main_fn` as the root task; returns when the whole
+        simulation drains. `real_timeout_s` is a WALL-clock watchdog
+        against kernel bugs (virtual time is unlimited)."""
+        self.spawn("main", main_fn, daemon=False)
+        with self.mu:
+            handoff = self._next_locked()
+        if handoff is not None:
+            handoff.evt.set()
+        if not self.done.wait(real_timeout_s):
+            self.errors.append("sim real-time watchdog expired")
+            raise SimError("sim wall-clock watchdog expired "
+                           f"(virtual now={self.now:.3f})")
+
+
+class SimLock:
+    """Reentrant lock whose waiters park in the kernel — replaces
+    threading.RLock wherever a lock may be held across a blocking seam
+    call (the engine's wal_lock, the pool's discovery lock)."""
+
+    def __init__(self, kernel: Kernel):
+        self.k = kernel
+        self.owner: Optional[_Task] = None
+        self.depth = 0
+        self.waiters: deque = deque()
+
+    def acquire(self):
+        k = self.k
+        t = k.current_task()
+        if t is None:
+            raise RuntimeError("sim lock acquired outside a sim task")
+        while True:
+            with k.mu:
+                if self.owner is None or self.owner is t:
+                    self.owner = t
+                    self.depth += 1
+                    return True
+                self.waiters.append(t)
+            k.block()
+            with k.mu:
+                if self.owner is t:  # release() handed it to us
+                    return True
+                # spurious wake (e.g. woken then lock re-taken): retry
+
+    def release(self):
+        k = self.k
+        t = k.current_task()
+        with k.mu:
+            if self.owner is not t:
+                raise RuntimeError("sim lock released by non-owner")
+            self.depth -= 1
+            if self.depth:
+                return
+            while self.waiters:
+                w = self.waiters.popleft()
+                if w.state == "blocked" and not w.killed:
+                    self.owner = w
+                    self.depth = 1
+                    k._wake_locked(w, "lock")
+                    return
+            self.owner = None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class SimClock(net.Clock):
+    """Virtual time: monotonic == kernel.now; wall == a fixed epoch +
+    kernel.now (so lease expiries / TSO stamps are deterministic)."""
+
+    def __init__(self, kernel: Kernel):
+        self.k = kernel
+
+    def monotonic(self) -> float:
+        return self.k.now
+
+    def wall(self) -> float:
+        return self.k.start_wall + self.k.now
+
+    def sleep(self, s: float) -> None:
+        self.k.sleep(s)
+
+
+class _SimLoopHandle(net.LoopHandle):
+    def __init__(self):
+        self.cancelled = False
+        self.task = None
+
+    def cancel(self):
+        self.cancelled = True
+        if self.task is not None:
+            t = self.task
+            k = t.kernel
+            me = k.current_task()
+            if t is not me:  # a loop cancelling itself just runs out
+                k.kill(t)
+
+
+class SimRuntime(net.Runtime):
+    """Background loops as kernel tasks; locks as SimLocks. One
+    SimRuntime per simulated node so a crash can kill exactly that
+    node's loops."""
+
+    def __init__(self, kernel: Kernel, owner: str):
+        self.k = kernel
+        self.owner = owner
+        self.tasks: list = []
+
+    def every(self, interval_s, tick, name="tick", immediate=False):
+        h = _SimLoopHandle()
+
+        def loop():
+            delay = 0.0 if immediate else interval_s
+            while not h.cancelled:
+                if delay:
+                    self.k.sleep(delay)
+                if h.cancelled:
+                    return
+                try:
+                    out = tick()
+                except Exception:
+                    out = None  # mirror RealRuntime: ticks self-guard
+                if out is net.STOP:
+                    return
+                delay = out if isinstance(out, (int, float)) \
+                    else interval_s
+
+        t = self.k.spawn(f"{self.owner}:{name}", loop, daemon=True)
+        h.task = t
+        self.tasks.append(t)
+        return h
+
+    def spawn(self, fn, name="task"):
+        self.tasks.append(
+            self.k.spawn(f"{self.owner}:{name}", fn, daemon=True)
+        )
+
+    def rlock(self):
+        return SimLock(self.k)
+
+    def kill_all(self):
+        for t in self.tasks:
+            self.k.kill(t)
+        self.tasks = []

@@ -239,3 +239,100 @@ def test_readonly_txn_fails_over_transparently_writes_abort_retryable():
             be.close()
         _stop(p)
         _stop(r)
+
+
+def test_asymmetric_partition_to_client_ack_loss():
+    """One-way partition, response side only: requests still REACH the
+    server (which acts on them) but every response vanishes — the
+    ack-loss failure mode. The client must classify it retryably within
+    its deadline, the server must hold the un-acked write, and healing
+    the one direction restores service."""
+    srv, _addr = _mk_server()
+    proxy = FaultProxy(srv.server_address[:2]).start()
+    be = None
+    try:
+        be = RemoteBackend(
+            f"127.0.0.1:{proxy.port}", op_timeout=0.5,
+            policy=RetryPolicy(deadline_s=1.2, base_ms=20, max_ms=60),
+        )
+        proxy.partition("to_client")
+        t0 = time.monotonic()
+        with pytest.raises(RetryableKvError):
+            tx = be.transaction(True)
+            tx.set(b"ghost", b"1")
+            tx.commit()
+        assert time.monotonic() - t0 < 6.0
+        # the request side flowed: the server applied SOMETHING the
+        # client was never told about (an un-acked write may exist —
+        # that is exactly the ambiguity the retry contract documents)
+        proxy.heal("to_client")
+        assert not proxy.partition_dirs
+        tx = be.transaction(True)
+        tx.set(b"solid", b"1")
+        tx.commit()
+        tx = be.transaction(False)
+        assert tx.get(b"solid") == b"1"
+        tx.cancel()
+    finally:
+        if be is not None:
+            be.close()
+        proxy.stop()
+        _stop(srv)
+
+
+def test_one_way_partition_heals_after_lease_failover():
+    """Satellite regression: a ONE-WAY cut on the replication link (the
+    primary's frames reach the replica, the replica's acks vanish) must
+    end in a clean failover: the primary — unable to confirm any
+    replication — refuses writes, steps down when its lease runs out,
+    the replica promotes through the lease, and after healing the old
+    primary rejoins as a replica of the new lineage with zero acked
+    writes lost."""
+    p, pa = _mk_server(failover_timeout_s=1.0, lease_ttl_s=0.8)
+    r, ra = _mk_server(role="replica", failover_timeout_s=1.0,
+                       lease_ttl_s=0.8)
+    # the primary ships to the replica THROUGH the proxy
+    proxy = FaultProxy(r.server_address[:2]).start()
+    p.connect_timeout_s = 0.4  # bound each blocked repl send
+    r.connect_timeout_s = 0.4
+    peers = [pa, proxy.addr]
+    p.configure_cluster(peers, 0, role="primary")
+    r.configure_cluster(peers, 1, role="replica")
+    be = None
+    try:
+        _wait_attached(p)
+        be = RemoteBackend(
+            ",".join([pa, ra]), op_timeout=1.0,
+            policy=RetryPolicy(deadline_s=10, base_ms=25, max_ms=200),
+        )
+        tx = be.transaction(True)
+        tx.set(b"before", b"1")
+        tx.commit()  # acked => replicated
+        proxy.partition("to_client")  # replica's acks vanish
+        # the primary loses its links, stops acking, and steps down;
+        # the replica then promotes via the lease
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if r.role == "primary" and p.role == "replica":
+                break
+            time.sleep(0.05)
+        assert r.role == "primary", (r.role, p.role, dict(p.counters))
+        assert p.role == "replica", (r.role, p.role, dict(p.counters))
+        assert p.counters.get("demotions_lease_expired", 0) >= 1
+        assert r.counters.get("promotions_lease", 0) >= 1
+        proxy.heal()
+        # the new primary attaches the old one directly; writes flow
+        tx = be.transaction(True)
+        tx.set(b"after", b"1")
+        tx.commit()
+        tx = be.transaction(False)
+        assert tx.get(b"before") == b"1", "acked pre-cut write lost"
+        assert tx.get(b"after") == b"1"
+        tx.cancel()
+        assert [p.role, r.role].count("primary") == 1
+    finally:
+        if be is not None:
+            be.close()
+        proxy.stop()
+        _stop(p)
+        _stop(r)

@@ -21,7 +21,16 @@ re-litigating:
    mark/split) hides a stuck or diverging two-phase commit. Record a
    telemetry counter, re-raise, or carry a `# robust:` pragma stating
    why the swallow is safe.
-5. **No `import jax` reachable from a query worker thread** — jax may
+5. **No raw clock/socket calls in the distributed stack** (rule 6,
+   listed here out of order) — `kvs/remote.py`, `kvs/shard.py`, and
+   `node.py` must take every wall-clock read, sleep, and socket through
+   the simulation seam (`kvs/net.py`: Clock/Runtime/Transport). A raw
+   `time.time()` / `time.sleep()` / `socket.socket(` /
+   `socket.create_connection(` in those files silently escapes the
+   deterministic simulator — the fault schedule can no longer reorder
+   or virtualize it, so whole interleavings become untestable. The
+   seam module itself is the allowlisted real implementation.
+6. **No `import jax` reachable from a query worker thread** — jax may
    only be imported under `surrealdb_tpu/device/` (the supervised
    runner that owns all accelerator state), `surrealdb_tpu/parallel/`
    and `surrealdb_tpu/ops/` (the kernel library, imported exclusively
@@ -49,6 +58,23 @@ PRAGMA = "# robust:"
 # files + function-name shape that rule 4 (2PC decision paths) covers
 _TWOPC_FILES = ("surrealdb_tpu/kvs/shard.py", "surrealdb_tpu/kvs/remote.py")
 _DECISION_FN = re.compile(r"commit|prepare|decide|resolve|mark|split")
+
+# rule 6: the distributed stack goes through the kvs/net.py seam for
+# every clock read, sleep, and socket — raw calls escape the
+# deterministic simulator. (kvs/net.py IS the real implementation and
+# is therefore not scanned.)
+_SEAM_FILES = (
+    "surrealdb_tpu/kvs/remote.py",
+    "surrealdb_tpu/kvs/shard.py",
+    "surrealdb_tpu/node.py",
+)
+_SEAM_FORBIDDEN = {
+    ("time", "time"),
+    ("time", "monotonic"),
+    ("time", "sleep"),
+    ("socket", "socket"),
+    ("socket", "create_connection"),
+}
 
 # rule 5: the only places inside the package allowed to import jax —
 # the supervised runner tree and the kernel library it dispatches to
@@ -140,6 +166,23 @@ def check_file(path: str, rel: str) -> list[str]:
                     f"{rel}:{node.lineno}: non-daemon Thread() without "
                     f"`daemon=True` or a `# robust: joined` pragma — "
                     f"blocks SIGTERM drain"
+                )
+    # 6. raw clock/socket calls outside the simulation seam
+    if rel_fwd in _SEAM_FILES:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)):
+                continue
+            if (f.value.id, f.attr) in _SEAM_FORBIDDEN \
+                    and not _pragma(lines, node.lineno):
+                findings.append(
+                    f"{rel}:{node.lineno}: raw `{f.value.id}.{f.attr}()`"
+                    f" outside the kvs/net.py seam — route it through "
+                    f"Clock/Runtime/Transport or the deterministic "
+                    f"simulator cannot virtualize it"
                 )
     # 4. silent except-pass in 2PC decision paths
     if rel.replace(os.sep, "/") in _TWOPC_FILES:
