@@ -61,6 +61,14 @@ KNN_HBM_BUDGET_BYTES = env_int(
 # candidate oversampling multiple (×k) for the int8 ranking store; higher
 # absorbs quantization error before the exact host rescore
 KNN_INT8_OVERSAMPLE = env_int("SURREAL_KNN_INT8_OVERSAMPLE", 128)
+# scoring-path routing for the cross-query batcher (idx/vector.py):
+#   auto   — dispatch to the device runner on real accelerators; when the
+#            "device" IS the host CPU (platform cpu), score from the
+#            batched BLAS host path instead (offloading numpy-speed
+#            kernels through jax only adds dispatch overhead)
+#   device — always dispatch to the device when it is serving
+#   host   — always score on the host (batched)
+KNN_HOST_BATCH = env_str("SURREAL_KNN_HOST_BATCH", "auto")
 # content-keyed value-decode cache (bytes); identical stored bytes skip
 # CBOR re-decode on repeated scans. 0 disables.
 DECODE_CACHE_BYTES = env_int("SURREAL_DECODE_CACHE_BYTES", 256 << 20)
@@ -138,6 +146,27 @@ DEVICE_LOAD_TIMEOUT_S = env_float("SURREAL_DEVICE_LOAD_TIMEOUT_S", 120.0)
 # (consecutive healthy probes required before traffic returns)
 DEVICE_PROBE_INTERVAL_S = env_float("SURREAL_DEVICE_PROBE_INTERVAL_S", 5.0)
 DEVICE_PROMOTE_SUCCESSES = env_int("SURREAL_DEVICE_PROMOTE_SUCCESSES", 2)
+# cross-query batcher dispatch pipelining (device/batcher.py): up to
+# PIPELINE dispatches in flight at once — a second batch may launch
+# while the first is inside its kernel (GIL released), keeping the
+# scoring kernel busy while query threads run their Python halves.
+# The overlapped dispatch only launches once PIPELINE_MIN riders are
+# queued, so light traffic keeps the strict one-batch-at-a-time
+# coalescing (maximum batch growth, no dribble dispatches).
+DEVICE_BATCH_PIPELINE = env_int("SURREAL_DEVICE_BATCH_PIPELINE", 2)
+DEVICE_BATCH_PIPELINE_MIN = env_int("SURREAL_DEVICE_BATCH_PIPELINE_MIN",
+                                    32)
+# persistent XLA compilation cache (device/compile_cache.py): compiled
+# kernels survive runner restarts and degrade→re-promote cycles.
+# "" resolves to <datastore dir>/.xla-cache for disk-backed stores,
+# else ~/.cache/surrealdb-tpu/xla; "off" disables.
+DEVICE_COMPILE_CACHE_DIR = env_str("SURREAL_DEVICE_COMPILE_CACHE_DIR", "")
+# power-of-two query-bucket ladder pre-warmed right after a vec store
+# ships to the runner ("" disables). With the persistent compile cache
+# warm these are near-free; cold, they front-load the XLA compiles so
+# serving traffic never pays one mid-query.
+DEVICE_PREWARM_BUCKETS = env_str("SURREAL_DEVICE_PREWARM_BUCKETS",
+                                 "1,8,64")
 
 # -- admission control / query lifecycle (server/admission.py, inflight.py) --
 # concurrent queries executing at once (the worker-slot budget); the CLI

@@ -1,0 +1,117 @@
+"""Persistent XLA compilation cache for the device runner.
+
+The supervisor's crash/degrade/restart discipline (PR 4) made the
+runner crash-only — but every restart paid cold XLA compiles for every
+kernel shape before serving at full speed. Initializing
+`jax.experimental.compilation_cache` (SNIPPETS.md [1]/[3]:
+`cc.initialize_cache`) persists compiled executables to disk, so a
+respawned runner (and a degrade→re-promote cycle) resumes at full
+speed: the in-process "miss" becomes a cache-file load.
+
+Directory resolution (first match wins):
+  1. `SURREAL_DEVICE_COMPILE_CACHE_DIR` — `off` disables entirely;
+  2. a process default registered by a disk-backed Datastore
+     (`<datastore dir>/.xla-cache` — the cache lives with the data);
+  3. `~/.cache/surrealdb-tpu/xla`.
+
+This module never imports jax at module level (the serving process
+imports it for dir resolution; only the runner/inline host calls
+`initialize()`, which is where jax is already live).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from surrealdb_tpu import cnf
+
+_DEFAULT_DIR: Optional[str] = None
+_INITIALIZED: Optional[dict] = None
+
+
+def set_default_dir(path: Optional[str]):
+    """Register the datastore-derived default cache dir (a disk-backed
+    Datastore calls this with <its dir>/.xla-cache). Explicit env
+    configuration still wins."""
+    global _DEFAULT_DIR
+    _DEFAULT_DIR = path
+
+
+def configured_dir() -> Optional[str]:
+    """An EXPLICITLY configured dir (env knob or registered datastore
+    default) — no home fallback. None when unconfigured or off."""
+    configured = cnf.env_str("SURREAL_DEVICE_COMPILE_CACHE_DIR",
+                             cnf.DEVICE_COMPILE_CACHE_DIR)
+    if configured:
+        return None if configured.lower() == "off" else configured
+    return _DEFAULT_DIR
+
+
+def resolve_dir() -> Optional[str]:
+    """The cache directory this process would use; None = disabled.
+    Like `configured_dir` but with the home-dir fallback the dedicated
+    runner subprocess uses when nothing was configured."""
+    configured = cnf.env_str("SURREAL_DEVICE_COMPILE_CACHE_DIR",
+                             cnf.DEVICE_COMPILE_CACHE_DIR)
+    if configured and configured.lower() == "off":
+        return None
+    return (configured_dir()
+            or os.path.join(os.path.expanduser("~"), ".cache",
+                            "surrealdb-tpu", "xla"))
+
+
+def initialize(path: Optional[str] = None) -> dict:
+    """Point jax's persistent compilation cache at the resolved dir.
+    Idempotent; returns {"dir": ..., "entries": N} on success or
+    {"disabled": reason}. Never raises — a broken cache dir must cost
+    speed, not serving."""
+    global _INITIALIZED
+    if _INITIALIZED is not None:
+        return _INITIALIZED
+    d = path or resolve_dir()
+    if d is None:
+        _INITIALIZED = {"disabled": "configured off"}
+        return _INITIALIZED
+    try:
+        os.makedirs(d, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", d)
+        # small serving kernels compile in well under the default 1s
+        # floor — cache everything, the bucket ladder bounds the count
+        for knob, val in (
+            ("jax_persistent_cache_min_compile_time_secs", 0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ):
+            try:
+                jax.config.update(knob, val)
+            except Exception:
+                pass  # knob not present on this jax version
+        try:
+            # jax latches its cache handle at the first compile: a
+            # process that already compiled something without a dir
+            # (inline mode after serving traffic) must drop the latch
+            # or the new dir is silently ignored
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+        try:
+            entries = sum(1 for _ in os.scandir(d))
+        except OSError:
+            entries = 0
+        _INITIALIZED = {"dir": d, "entries": entries}
+    except Exception as e:
+        _INITIALIZED = {"disabled": f"{e.__class__.__name__}: {e}"}
+    return _INITIALIZED
+
+
+def reset_for_tests():
+    """Drop the idempotence latch (the restart-survival test
+    re-initializes against a fresh tmpdir)."""
+    global _INITIALIZED
+    _INITIALIZED = None

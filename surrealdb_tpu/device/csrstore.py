@@ -1,9 +1,10 @@
 """Runner-side CSR graph blocks: the JAX half of graph/csr.py.
 
 The serving process ships rows/cols edge arrays once per cache epoch;
-a multi-hop expansion arrives as a start-node mask and leaves as the
-reached-node mask — frontiers never materialize id values between hops
-(jax.lax.scan over gather + scatter-or)."""
+a multi-hop expansion arrives as a [B, n] batch of start-node masks
+(the cross-query batcher stacks concurrent traversals) and leaves as
+the reached-node masks — frontiers never materialize id values between
+hops (jax.lax.scan over gather + scatter-or)."""
 
 from __future__ import annotations
 
@@ -11,12 +12,17 @@ import numpy as np
 
 
 def _multi_hop_impl(rows, cols, start, n_nodes, hops, union):
+    # start: [B, n_nodes] bool — every rider's frontier advances in the
+    # same gather + scatter-or, batched along the leading axis
     import jax
     import jax.numpy as jnp
 
     def hop(frontier, _):
-        contrib = frontier[rows].astype(jnp.int32)
-        nxt = jnp.zeros(n_nodes, jnp.int32).at[cols].add(contrib) > 0
+        contrib = frontier[:, rows].astype(jnp.int32)  # [B, E]
+        nxt = (
+            jnp.zeros(frontier.shape, jnp.int32).at[:, cols].add(contrib)
+            > 0
+        )
         return nxt, nxt
 
     frontier, layers = jax.lax.scan(hop, start, None, length=hops)
@@ -31,11 +37,18 @@ _jit_cache: dict = {}
 def _multi_hop_jit(rows, cols, start, n_nodes, hops, union):
     import jax
 
-    ck = (n_nodes, hops, union, rows.shape[0])
+    ck = (n_nodes, hops, union, rows.shape[0], start.shape[0])
     fn = _jit_cache.get(ck)
     if fn is None:
+        from surrealdb_tpu.device.kernelstats import note_compile
+
+        note_compile("csr_multi_hop")
         fn = jax.jit(_multi_hop_impl, static_argnums=(3, 4, 5))
         _jit_cache[ck] = fn
+    else:
+        from surrealdb_tpu.device.kernelstats import note_hit
+
+        note_hit("csr_multi_hop")
     return fn(rows, cols, start, n_nodes, hops, union)
 
 
@@ -62,11 +75,26 @@ class CsrStore:
 
     def multi_hop(self, start: np.ndarray, hops: int,
                   union: bool) -> np.ndarray:
+        """[B, n] (or legacy [n]) start masks -> same-shaped reached
+        masks. Batch sizes round up to a power of two so the compiled
+        kernel shapes stay a bounded ladder under dynamic batching."""
         import jax.numpy as jnp
 
         rows_d, cols_d = self._ensure()
+        single = start.ndim == 1
+        masks = start[None, :] if single else start
+        b = masks.shape[0]
+        bucket = 1
+        while bucket < b:
+            bucket *= 2
+        if bucket != b:
+            masks = np.concatenate(
+                [masks, np.zeros((bucket - b, masks.shape[1]),
+                                 masks.dtype)]
+            )
         out = _multi_hop_jit(
-            rows_d, cols_d, jnp.asarray(start.astype(bool)),
+            rows_d, cols_d, jnp.asarray(masks.astype(bool)),
             self.n_nodes, int(hops), bool(union),
         )
-        return np.asarray(out).astype(np.uint8)
+        out = np.asarray(out)[:b].astype(np.uint8)
+        return out[0] if single else out

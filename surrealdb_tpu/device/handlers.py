@@ -22,6 +22,15 @@ class DeviceHost:
     """Per-runner registry of vector + CSR block caches."""
 
     def __init__(self):
+        # inline mode shares the serving process's jax: only point it at
+        # a persistent compile cache when one was explicitly configured
+        # (env knob or a disk-backed datastore default) — the home-dir
+        # fallback is for the dedicated runner subprocess only
+        from surrealdb_tpu.device import compile_cache
+
+        d = compile_cache.configured_dir()
+        if d is not None:
+            compile_cache.initialize(d)
         self.vec: OrderedDict = OrderedDict()  # key -> (tag, VecStore)
         self.csr: OrderedDict = OrderedDict()  # key -> (tag, CsrStore)
         # multipart vec loads in flight: key -> (meta, vecs, valid).
@@ -42,6 +51,8 @@ class DeviceHost:
     def op_status(self, meta, bufs):
         import jax
 
+        from surrealdb_tpu.device import compile_cache, kernelstats
+
         devs = jax.devices()
         return "ok", {
             "platform": devs[0].platform if devs else "none",
@@ -50,6 +61,9 @@ class DeviceHost:
             "csr_blocks": len(self.csr),
             "vec_bytes": sum(s.nbytes() for _t, s in self.vec.values()),
             "csr_bytes": sum(s.nbytes() for _t, s in self.csr.values()),
+            "compile_cache": compile_cache.initialize()
+            if compile_cache.configured_dir() else {"disabled": "unset"},
+            "cc": kernelstats.snapshot(),
         }, []
 
     def op_vec_load(self, meta, bufs):
@@ -113,6 +127,30 @@ class DeviceHost:
         self.vec.move_to_end(meta["key"])
         out_meta, out_bufs = ent[1].knn(bufs[0], int(meta["k"]))
         return "ok", out_meta, out_bufs
+
+    def op_vec_prewarm(self, meta, bufs):
+        """Compile the power-of-two query-bucket ladder for a loaded
+        store AHEAD of traffic (runner start / store re-ship), so
+        serving queries never pay an XLA compile mid-query. With the
+        persistent compile cache warm this is a handful of disk loads."""
+        ent = self.vec.get(meta["key"])
+        if ent is None or ent[0] != list(meta["tag"]):
+            return "stale", {}, []
+        st = ent[1]
+        dim = st.vecs.shape[1]
+        k = int(meta.get("k", 10))
+        warmed = []
+        for b in meta.get("buckets", (1,)):
+            b = int(b)
+            if b < 1:
+                continue
+            qs = np.zeros((b, dim), np.float32)
+            try:
+                st.knn(qs, k)
+                warmed.append(b)
+            except Exception:
+                break  # best-effort: prewarm must never fail serving
+        return "ok", {"warmed": warmed}, []
 
     def op_csr_load(self, meta, bufs):
         from surrealdb_tpu.device.csrstore import CsrStore

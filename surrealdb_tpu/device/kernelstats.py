@@ -1,0 +1,58 @@
+"""Kernel compile-shape accounting for the device runner.
+
+A "miss" is a dispatch that had to compile a new (kernel, shape)
+combination in this process; a "hit" reuses an already-compiled
+executable. With the persistent compilation cache warm
+(device/compile_cache.py), a miss costs a disk load instead of a full
+XLA compile — the counters say how well the power-of-two bucket ladder
+is bounding the compiled-shape set, and whether serving traffic is
+paying compiles mid-query. Surfaced as `device_compile_cache_hits` /
+`device_compile_cache_misses` through the supervisor's telemetry and
+`INFO FOR SYSTEM`.
+
+Lock-free on purpose: a lost increment under a thread race skews a
+gauge by one sample (same discipline as telemetry.StageStat).
+"""
+
+from __future__ import annotations
+
+COUNTS = {"hits": 0, "misses": 0}
+_SEEN: set = set()
+
+
+def note_compile(kernel: str):
+    COUNTS["misses"] += 1
+
+
+def note_hit(kernel: str):
+    COUNTS["hits"] += 1
+
+
+# store shapes change every sync epoch under write load, so the seen-set
+# must be bounded in a long-running server; overflow clears it (the next
+# dispatches re-count as misses — a blip in a gauge, not a leak)
+_SEEN_MAX = 4096
+
+
+def note_shape(kernel: str, shape_key) -> bool:
+    """Record a dispatch against (kernel, shape_key); returns True when
+    this shape was already compiled in this process (a hit)."""
+    key = (kernel, shape_key)
+    if key in _SEEN:
+        COUNTS["hits"] += 1
+        return True
+    if len(_SEEN) >= _SEEN_MAX:
+        _SEEN.clear()
+    _SEEN.add(key)
+    COUNTS["misses"] += 1
+    return False
+
+
+def snapshot() -> dict:
+    return dict(COUNTS)
+
+
+def reset():
+    COUNTS["hits"] = 0
+    COUNTS["misses"] = 0
+    _SEEN.clear()

@@ -28,6 +28,13 @@ def serve(sock) -> None:
     from surrealdb_tpu.device import proto
 
     try:
+        # persistent compilation cache FIRST: a respawned runner (the
+        # supervisor's crash/degrade/restart cycle) must reload its
+        # compiled kernels from disk instead of paying cold XLA
+        # compiles before serving at full speed
+        from surrealdb_tpu.device.compile_cache import initialize
+
+        cache_info = initialize()
         import jax
 
         devs = jax.devices()
@@ -39,11 +46,13 @@ def serve(sock) -> None:
         except OSError:
             pass
         raise
+    from surrealdb_tpu.device import kernelstats
     from surrealdb_tpu.device.handlers import DeviceHost
 
     host = DeviceHost()
     proto.send_msg(sock, "ready",
-                   {"platform": platform, "device_count": ndev})
+                   {"platform": platform, "device_count": ndev,
+                    "compile_cache": cache_info})
     while True:
         try:
             op, meta, bufs = proto.recv_msg(sock)
@@ -60,6 +69,10 @@ def serve(sock) -> None:
             tag, out_meta, out_bufs = host.handle(op, meta, bufs)
             out_meta = dict(out_meta)
             out_meta["seq"] = seq
+            # compile-shape counters piggyback on every reply so the
+            # supervisor's gauges track the subprocess without a
+            # dedicated RPC per scrape
+            out_meta["cc"] = kernelstats.snapshot()
             proto.send_msg(sock, tag, out_meta, out_bufs)
         except ConnectionError:
             return

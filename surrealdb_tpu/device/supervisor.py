@@ -101,8 +101,12 @@ class DeviceSupervisor:
         self.counters = {
             "device_spawns": 0, "device_restarts": 0,
             "device_dispatch_timeouts": 0, "device_dispatch_errors": 0,
-            "device_fallbacks": 0,
+            "device_fallbacks": 0, "device_host_routed": 0,
         }
+        # last-known runner-side kernel compile counters (piggybacked on
+        # every reply) + the runner's persistent-compile-cache info
+        self.compile_counts = {"hits": 0, "misses": 0}
+        self.compile_cache_info: Optional[dict] = None
         self._lock = threading.RLock()
         self._ready = threading.Event()
         self._gen = 0
@@ -195,11 +199,19 @@ class DeviceSupervisor:
              timeout_s: Optional[float] = None):
         """One dispatch -> (tag, meta, bufs). Raises DeviceUnavailable
         (degrade to host), DeviceOpError (this op failed), or SdbError
-        (mode=require and the device can't serve)."""
+        (mode=require and the device can't serve). Wall time lands in
+        the `device_rpc` stage stat."""
+        from surrealdb_tpu.telemetry import stage_record
+
         if self.mode == "off" or self._stop.is_set():
             raise DeviceUnavailable("device disabled")
         if self.mode == "inline":
-            return self._call_inline(op, meta, bufs)
+            t0 = time.perf_counter_ns()
+            try:
+                return self._call_inline(op, meta, bufs)
+            finally:
+                stage_record("device_rpc",
+                             time.perf_counter_ns() - t0)
         base = self.dispatch_timeout_s if timeout_s is None else timeout_s
         if not self._ready.is_set():
             self.ensure_started()
@@ -221,7 +233,12 @@ class DeviceSupervisor:
             else:
                 raise DeviceUnavailable(f"device {self.state}")
         try:
-            return self._call_live(op, meta, bufs, base)
+            t0 = time.perf_counter_ns()
+            try:
+                return self._call_live(op, meta, bufs, base)
+            finally:
+                stage_record("device_rpc",
+                             time.perf_counter_ns() - t0)
         except DeviceUnavailable:
             if self.mode == "require":
                 raise SdbError(
@@ -262,6 +279,8 @@ class DeviceSupervisor:
             self.call(op, meta, bufs, timeout_s=self.load_timeout_s)
         with self._lock:
             self._loaded[key] = tag
+        if op in ("vec_load",) and self.mode != "inline":
+            self._prewarm_async(key, tag)
 
     def _multipart_vec_load(self, key, tag, meta, vecs, valid):
         begin = dict(meta)
@@ -282,6 +301,40 @@ class DeviceSupervisor:
                               timeout_s=self.load_timeout_s)
         if t == "stale":
             raise self.unavailable("runner lost mid-load")
+
+    def _prewarm_async(self, key: str, tag):
+        """Fire-and-forget compile of the power-of-two query-bucket
+        ladder for a freshly shipped store (SURREAL_DEVICE_PREWARM_
+        BUCKETS). Runs on a daemon thread so the shipping query isn't
+        held; with the persistent compile cache warm it's near-free.
+        Best-effort by contract — any failure only costs warmth."""
+        raw = cnf.env_str("SURREAL_DEVICE_PREWARM_BUCKETS",
+                          cnf.DEVICE_PREWARM_BUCKETS)
+        try:
+            buckets = [int(x) for x in raw.split(",") if x.strip()]
+        except ValueError:
+            buckets = []
+        if not buckets:
+            return
+
+        def warm():
+            # one bucket per dispatch, smallest first: each call stays
+            # well inside the load window, so a slow compile can never
+            # be misclassified as a wedged runner
+            for b in sorted(set(buckets)):
+                try:
+                    t, _m, _b = self.call(
+                        "vec_prewarm",
+                        {"key": key, "tag": list(tag), "buckets": [b]},
+                        timeout_s=self.load_timeout_s,
+                    )
+                except Exception:
+                    return
+                if t != "ok":
+                    return
+
+        threading.Thread(target=warm, daemon=True,
+                         name="device-prewarm").start()
 
     def forget(self, key: str):
         with self._lock:
@@ -311,14 +364,30 @@ class DeviceSupervisor:
             "dispatch_timeouts": self.counters["device_dispatch_timeouts"],
             "dispatch_errors": self.counters["device_dispatch_errors"],
             "fallbacks": self.counters["device_fallbacks"],
+            "host_routed": self.counters.get("device_host_routed", 0),
             "last_error": self.last_error,
             "vec_blocks": sum(1 for k in loaded if k.startswith("vec/")),
             "csr_blocks": sum(1 for k in loaded if k.startswith("csr/")),
+            "compile_cache": self.compile_counts_now(),
         }
+        if self.compile_cache_info is not None:
+            out["compile_cache_dir"] = self.compile_cache_info
+        from surrealdb_tpu.device.batcher import BATCH_STATS
+
+        out["batching"] = BATCH_STATS.to_dict()
         if self.mode == "inline" and self._inline_host is not None:
             out["vec_blocks"] = len(self._inline_host.vec)
             out["csr_blocks"] = len(self._inline_host.csr)
         return out
+
+    def compile_counts_now(self) -> dict:
+        """Kernel compile hit/miss counters: in-process (inline mode)
+        or the last runner-piggybacked snapshot (subprocess)."""
+        if self.mode == "inline":
+            from surrealdb_tpu.device import kernelstats
+
+            return kernelstats.snapshot()
+        return dict(self.compile_counts)
 
     def runner_pid(self) -> Optional[int]:
         p = self._proc
@@ -418,11 +487,21 @@ class DeviceSupervisor:
             "from surrealdb_tpu.device.runner import main; "
             "main(int(sys.argv[1]))"
         )
+        env = dict(os.environ)
+        if not env.get("SURREAL_DEVICE_COMPILE_CACHE_DIR"):
+            # hand the runner the resolved persistent-cache dir (the
+            # datastore-registered default lives in THIS process)
+            from surrealdb_tpu.device.compile_cache import resolve_dir
+
+            d = resolve_dir()
+            if d is not None:
+                env["SURREAL_DEVICE_COMPILE_CACHE_DIR"] = d
         try:
             proc = subprocess.Popen(
                 [sys.executable, "-c", code, str(child.fileno()),
                  pkg_root],
                 pass_fds=(child.fileno(),),
+                env=env,
             )
         except OSError as e:
             _close_sock(parent)
@@ -475,6 +554,8 @@ class DeviceSupervisor:
             self._loaded.clear()
             self.platform = meta.get("platform")
             self.device_count = int(meta.get("device_count", 0))
+            if meta.get("compile_cache") is not None:
+                self.compile_cache_info = meta["compile_cache"]
             self._send_q = queue.Queue()
         threading.Thread(target=self._send_loop, args=(parent, gen),
                          daemon=True, name="device-send").start()
@@ -679,6 +760,9 @@ class DeviceSupervisor:
                 if self._is_current(gen):
                     self._mark_degraded(f"runner died: {e}")
                 return
+            cc = meta.get("cc")
+            if isinstance(cc, dict):
+                self.compile_counts = cc
             seq = meta.get("seq")
             with self._lock:
                 slot = self._pending.pop(seq, None)
@@ -773,7 +857,35 @@ def attach_telemetry(telemetry):
         lambda: 1 if get_supervisor().state == "degraded" else 0,
     )
     for name in ("device_restarts", "device_dispatch_timeouts",
-                 "device_fallbacks"):
+                 "device_fallbacks", "device_host_routed"):
         telemetry.register_gauge(
-            name, lambda n=name: get_supervisor().counters[n]
+            name, lambda n=name: get_supervisor().counters.get(n, 0)
         )
+    # cross-query batching efficiency (device/batcher.py): dispatch-size
+    # last/avg/max say whether concurrency is actually coalescing
+    from surrealdb_tpu.device.batcher import BATCH_STATS
+
+    telemetry.register_gauge(
+        "device_batch_size_last", lambda: BATCH_STATS.last
+    )
+    telemetry.register_gauge(
+        "device_batch_size_max", lambda: BATCH_STATS.max
+    )
+    telemetry.register_gauge(
+        "device_batch_size_avg",
+        lambda: round(BATCH_STATS.riders / max(BATCH_STATS.dispatches, 1),
+                      2),
+    )
+    telemetry.register_gauge(
+        "device_batch_dispatches", lambda: BATCH_STATS.dispatches
+    )
+    # kernel compile-shape accounting: misses = compiles paid in this
+    # process (cheap disk loads when the persistent cache is warm)
+    telemetry.register_gauge(
+        "device_compile_cache_hits",
+        lambda: get_supervisor().compile_counts_now()["hits"],
+    )
+    telemetry.register_gauge(
+        "device_compile_cache_misses",
+        lambda: get_supervisor().compile_counts_now()["misses"],
+    )

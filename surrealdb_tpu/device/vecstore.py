@@ -170,6 +170,8 @@ class VecStore:
         self.ensure()
         import jax.numpy as jnp
 
+        from surrealdb_tpu.device.kernelstats import note_shape
+
         cfg = self.cfg
         n = self.vecs.shape[0]
         qs = jnp.asarray(np.ascontiguousarray(qvs, dtype=np.float32))
@@ -183,6 +185,8 @@ class VecStore:
                 _, chunk, _ = _pow2_chunks(
                     b_total, nloc, cfg["query_chunk"], cfg["score_budget"]
                 )
+                note_shape("sharded_rank_rescore",
+                           (self.vecs.shape, chunk, k, kc, self.metric))
                 d_parts = []
                 i_parts = []
                 for s in range(0, b_total, chunk):
@@ -201,6 +205,8 @@ class VecStore:
             else:
                 from surrealdb_tpu.parallel.mesh import sharded_knn
 
+                note_shape("sharded_knn",
+                           (self.vecs.shape, qs.shape[0], k, self.metric))
                 dists, ids = sharded_knn(
                     self.mesh, self.device_vecs, qs, self.device_valid, k,
                     self.metric, self.mink_p,
@@ -216,6 +222,8 @@ class VecStore:
             bucket, chunk, r = _pow2_chunks(
                 b_total, n, cfg["query_chunk"], cfg["score_budget"] // 2
             )
+            note_shape("knn_rank_int8",
+                       (self.vecs.shape, chunk, kc, self.metric))
             if bucket != b_total:
                 qs = jnp.pad(qs, ((0, bucket - b_total), (0, 0)))
             cand = knn_rank_int8(
@@ -238,6 +246,9 @@ class VecStore:
             bucket, chunk, r = _pow2_chunks(
                 b_total, n, cfg["query_chunk"], cfg["score_budget"]
             )
+            note_shape("knn_rank_rescore",
+                       (self.vecs.shape, chunk, min(k, kc), kc,
+                        self.metric))
             if bucket != b_total:
                 qs = jnp.pad(qs, ((0, bucket - b_total), (0, 0)))
             dists, ids = knn_rank_rescore(
@@ -251,6 +262,8 @@ class VecStore:
         if n > cfg["block_rows"]:
             from surrealdb_tpu.ops.topk import knn_search_blocked
 
+            note_shape("knn_search_blocked",
+                       (self.vecs.shape, qs.shape[0], k, self.metric))
             dists, ids = knn_search_blocked(
                 self.device_vecs, qs, k, self.metric, self.mink_p,
                 self.device_valid,
@@ -258,6 +271,8 @@ class VecStore:
         else:
             from surrealdb_tpu.ops.topk import knn_search
 
+            note_shape("knn_search",
+                       (self.vecs.shape, qs.shape[0], k, self.metric))
             dists, ids = knn_search(
                 self.device_vecs, qs, k, self.metric, self.mink_p,
                 self.device_valid,

@@ -43,7 +43,10 @@ class Executor:
             tel.end(root)
 
     def _execute(self, stmts: list, vars: dict, tel) -> list[QueryResult]:
+        from surrealdb_tpu import cnf as _cnf
         from surrealdb_tpu import inflight as _inflight
+        from surrealdb_tpu.exec.statements import _ensure_ns_db
+        from surrealdb_tpu.telemetry import stage_record
 
         results: list[QueryResult] = []
         self.import_mode = False  # OPTION IMPORT, scoped to this run
@@ -128,8 +131,6 @@ class Executor:
                     )
                 )
                 continue
-            from surrealdb_tpu import cnf as _cnf
-
             if _cnf.MEMORY_THRESHOLD:
                 from surrealdb_tpu.mem import check_threshold
 
@@ -159,7 +160,13 @@ class Executor:
                 continue
             own_txn = txn is None
             try:
-                cur = txn or self.ds.transaction(write=True)
+                if own_txn:
+                    t_txn = time.perf_counter_ns()
+                    cur = self.ds.transaction(write=True)
+                    stage_record("txn_open",
+                                 time.perf_counter_ns() - t_txn)
+                else:
+                    cur = txn
             except SdbError as e:
                 # a transaction that cannot OPEN (remote KV unreachable /
                 # retry deadline exhausted) is a per-statement error, not
@@ -182,16 +189,24 @@ class Executor:
                     # the catalog (reference kvs get_or_add_ns/db); once per
                     # run — inside the error envelope: a partitioned KV
                     # must surface as a statement error, not a crash
-                    from surrealdb_tpu.exec.statements import _ensure_ns_db
-
                     _ensure_ns_db(ctx)
-                cur.new_save_point()
+                if not own_txn:
+                    # savepoints only matter inside an explicit
+                    # transaction (a failing statement rolls back to the
+                    # last one); an auto-commit statement cancels its
+                    # whole transaction on error, so the happy path
+                    # skips the create/release pair entirely
+                    cur.new_save_point()
                 sp = tel.start(type(stmt).__name__)
+                t_eval = time.perf_counter_ns()
                 try:
                     out = eval_statement(stmt, ctx)
                 finally:
+                    eval_ns = time.perf_counter_ns() - t_eval
+                    stage_record("stmt_eval", eval_ns)
                     tel.end(sp)
-                cur.release_last_save_point()
+                if not own_txn:
+                    cur.release_last_save_point()
                 # persist session-level vars (LET/USE at top level)
                 if isinstance(stmt, (LetStmt,)):
                     shared_vars = dict(ctx.vars)
@@ -202,6 +217,9 @@ class Executor:
                     cur.commit()
                 ensured_nsdb = True
                 dt = time.perf_counter_ns() - t0
+                # envelope = statement machinery around the evaluation
+                # (txn plumbing, cancel/deadline gates, result wrap)
+                stage_record("stmt_envelope", max(dt - eval_ns, 0))
                 self.ds.record_statement(True, dt, type(stmt).__name__)
                 results.append(QueryResult(result=out, time_ns=dt))
                 if not own_txn:

@@ -5142,6 +5142,9 @@ def _s_info(n: InfoStmt, ctx: Ctx):
         # query thread (check_robustness rule 5): the runner subprocess
         # owns the backend, INFO reads its health snapshot
         from surrealdb_tpu.device import get_supervisor
+        from surrealdb_tpu.telemetry import (
+            stage_snapshot as _stage_snapshot,
+        )
 
         dev = get_supervisor().status()
 
@@ -5183,6 +5186,9 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             # in-flight (non-LIVE) query registry: each id is a valid
             # KILL <query-id> target (inflight.py)
             "queries": ctx.ds.inflight.snapshot(),
+            # per-stage query timing (PR-6 overhead strip) — the same
+            # table tools/profile_query.py prints and /metrics exports
+            "stages": _stage_snapshot(),
         }
         if shard_topo is not None:
             out["shards"] = shard_topo

@@ -44,6 +44,7 @@ class CsrGraph:
         self.sorted_cols = None
         self.lock = threading.RLock()
         self._built = False  # a full build has populated the arrays
+        self._batcher = None  # lazy cross-query hop batcher
 
     def build(self, ctx):
         """Scan the edge table's records (in/out fields) into CSR arrays.
@@ -267,22 +268,39 @@ class CsrGraph:
         if not found_any:
             return []
         union = collect_mode == "union"
-        mask = self._device_multi_hop(start, hops, union)
-        if mask is None:
-            mask = self._host_multi_hop(start, hops, union)
+        mask = self._hop_batched(start, hops, union)
         return [self.node_ids[i] for i in np.nonzero(mask)[0]]
 
-    def _device_multi_hop(self, start, hops: int, union: bool):
-        """Hop expansion via the supervised runner; None = degrade to
-        host (cold/degraded/disabled device, dispatch failure)."""
-        from surrealdb_tpu.device import (
-            DeviceOpError, DeviceUnavailable, get_supervisor,
-        )
+    def _hop_batched(self, start, hops: int, union: bool):
+        """Run one hop expansion through the cross-query batcher:
+        concurrent traversals coalesce into one stacked-mask device
+        call per (hops, union) shape; device trouble degrades each
+        rider individually to the numpy multi-hop."""
+        b = self._batcher
+        if b is None:
+            from surrealdb_tpu.device import (
+                DeviceOpError, DeviceUnavailable,
+            )
+            from surrealdb_tpu.device.batcher import DeviceBatcher
+
+            b = DeviceBatcher(
+                dispatch=self._hop_dispatch,
+                fallback=self._hop_fallback,
+                retryable=(DeviceUnavailable, DeviceOpError),
+            )
+            self._batcher = b
+        return b.submit((start, hops, union))
+
+    def _hop_dispatch(self, payloads):
+        """Batched hop expansion via the supervised runner: riders with
+        the same (hops, union) shape share ONE [B, n] kernel call.
+        Raises DeviceUnavailable/DeviceOpError for the batcher's
+        per-rider host degrade."""
+        from surrealdb_tpu.device import get_supervisor
 
         sup = get_supervisor()
         if not sup.fast_path():
-            sup.note_fallback()  # same accounting as the vector path
-            return None
+            raise sup.unavailable(f"device {sup.state}")
         tag = [int(self._dev_epoch)]
 
         def loader():
@@ -291,25 +309,50 @@ class CsrGraph:
                 np.ascontiguousarray(self.cols),
             ]
 
-        try:
+        groups: dict = {}
+        for i, (start, hops, union) in enumerate(payloads):
+            # mask length rides the group key: a rider that built its
+            # mask against an older CSR epoch (concurrent rebuild) must
+            # not shape-break its batchmates' np.stack — it dispatches
+            # alone and fails (or degrades) on its own
+            groups.setdefault(
+                (int(hops), bool(union), len(start)), []
+            ).append(i)
+        out = [None] * len(payloads)
+        for (hops, union, _nlen), idxs in groups.items():
+            stacked = np.stack(
+                [payloads[i][0] for i in idxs]
+            ).astype(np.uint8)
             for _attempt in (0, 1):
                 sup.ensure_loaded(self._dev_key, tag, loader)
                 t, _meta, bufs = sup.call(
                     "csr_hop",
                     {"key": self._dev_key, "tag": tag,
-                     "hops": int(hops), "union": bool(union)},
-                    [start.astype(np.uint8)],
+                     "hops": hops, "union": union},
+                    [stacked],
                 )
                 if t == "stale":
                     sup.forget(self._dev_key)
                     continue
-                return bufs[0].astype(bool)
-            # two stale rounds: give up on the device for this hop
-            # (SdbError in require mode — surfaces to the query)
-            raise sup.unavailable("csr cache thrashing")
-        except (DeviceUnavailable, DeviceOpError):
-            sup.note_fallback()
-        return None
+                break
+            else:
+                # two stale rounds: give up on the device for this
+                # batch (SdbError in require mode — surfaces loudly)
+                raise sup.unavailable("csr cache thrashing")
+            masks = bufs[0].astype(bool)
+            if masks.ndim == 1:
+                masks = masks[None, :]
+            for j, i in enumerate(idxs):
+                out[i] = masks[j]
+        return out
+
+    def _hop_fallback(self, payload):
+        """Per-rider degrade: count one fallback per query (the old
+        single-dispatch accounting) and answer from the numpy path."""
+        from surrealdb_tpu.device import get_supervisor
+
+        get_supervisor().note_fallback()
+        return self._host_multi_hop(*payload)
 
     def _host_multi_hop(self, start, hops: int, union: bool):
         """Numpy fallback with the device kernel's exact semantics:

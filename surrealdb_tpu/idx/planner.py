@@ -745,12 +745,22 @@ def plan_scan(tb: str, cond, ctx, stmt):
     QueryExecutor does this for every matches expression), so
     search::score/highlight work under table scans, eq-index scans,
     and union branches alike."""
+    import time as _time
+
+    from surrealdb_tpu.telemetry import stage_record
+
+    t0 = _time.perf_counter_ns()
     if cond is not None:
         with_index = getattr(stmt, "with_index", None) \
             if stmt is not None else None
         if with_index != []:
             _register_match_contexts(tb, cond, ctx)
-    return _plan_scan(tb, cond, ctx, stmt)
+    try:
+        return _plan_scan(tb, cond, ctx, stmt)
+    finally:
+        # note: a KNN plan executes its index search eagerly in here,
+        # so `plan` CONTAINS `index_knn` — the profile tool subtracts
+        stage_record("plan", _time.perf_counter_ns() - t0)
 
 
 def _plan_scan(tb: str, cond, ctx, stmt):

@@ -28,7 +28,9 @@ import uuid
 import numpy as np
 
 from surrealdb_tpu import key as K
+from surrealdb_tpu.device.batcher import DeviceBatcher
 from surrealdb_tpu.err import SdbError
+from surrealdb_tpu.utils.rwlock import RWLock
 from surrealdb_tpu.val import NONE, RecordId, is_truthy
 
 from surrealdb_tpu import cnf
@@ -124,8 +126,8 @@ def _exact_mxu_distances(metric: str, xs, q):
     raise SdbError(f"unsupported device metric {metric}")
 
 
-class _Coalescer:
-    """Self-clocking cross-query dynamic batcher.
+class _Coalescer(DeviceBatcher):
+    """Self-clocking cross-query dynamic batcher over one vector index.
 
     The first searcher dispatches immediately (no added latency when
     idle); searches arriving while a device call is in flight queue up
@@ -136,127 +138,66 @@ class _Coalescer:
     per-query dispatches. Reference contrast: hnsw/index.rs walks the
     graph per query under an RwLock; here concurrency *increases*
     device efficiency.
-    """
+
+    The batching mechanics (pipelined dispatch, deadline withdrawal,
+    per-rider attribution) live in `device/batcher.py`; this class
+    binds them to one index's engine entry: batch kernel =
+    `index.knn_batch` (device or batched host, routed by platform),
+    first fallback = the SAME batched host kernel, last-resort
+    fallback = per-rider host single search (one poisoned rider can
+    never fail its batchmates)."""
 
     def __init__(self, index):
+        from surrealdb_tpu.device import DeviceOpError, DeviceUnavailable
+
         self.index = index
-        self.cond = threading.Condition()
-        self.queue: list = []
-        self.running = False
+        super().__init__(
+            dispatch=self._dispatch,
+            fallback_batch=self._fallback_batch,
+            fallback=self._fallback_one,
+            retryable=(DeviceUnavailable, DeviceOpError),
+        )
 
     def search(self, qv: np.ndarray, k: int):
-        # slot: [result, exception, done]. Waiters are signalled by the
-        # dispatching thread at batch completion (cond.notify_all) — no
-        # polling interval, queued queries wake immediately. The wait is
-        # capped by the calling query's remaining deadline (inflight
-        # thread-local): a nearly-expired query must not park behind a
-        # long batch it can no longer use.
-        from surrealdb_tpu.err import QueryCancelled, QueryTimeout
-        from surrealdb_tpu.inflight import cancelled as _q_cancelled
-        from surrealdb_tpu.inflight import current as _q_current
-        from surrealdb_tpu.inflight import remaining as _q_remaining
+        return self.submit((qv, k))
 
-        slot = [None, None, False]
-        entry = (qv, k, slot)
-        with self.cond:
-            self.queue.append(entry)
-            while not slot[2] and self.running:
-                if _q_cancelled():
-                    # KILL / disconnect / drain while parked: withdraw
-                    # and unwind — nothing signals this condition on
-                    # cancel, so the wait below is sliced at 50ms
-                    try:
-                        self.queue.remove(entry)
-                    except ValueError:
-                        pass
-                    h = _q_current()
-                    if h is not None:
-                        h.mark_cancelled()
-                    raise QueryCancelled("The query was cancelled")
-                budget = _q_remaining()
-                if budget is not None and budget <= 0:
-                    # expired while queued: withdraw if the batch hasn't
-                    # picked us up; either way stop waiting — a late
-                    # result written into the slot is simply discarded
-                    try:
-                        self.queue.remove(entry)
-                    except ValueError:
-                        pass
-                    h = _q_current()
-                    if h is not None:
-                        h.mark_timed_out()
-                    raise QueryTimeout(
-                        "The query was not executed because it "
-                        "exceeded the timeout"
-                    )
-                # completion still wakes riders immediately via
-                # notify_all; the 50ms slice exists only so a KILL is
-                # noticed while parked (nothing signals the condition on
-                # cancel). Riders outside any query context keep the
-                # pure event-driven wait.
-                if _q_current() is not None:
-                    self.cond.wait(0.05 if budget is None
-                                   else min(budget, 0.05))
-                else:
-                    self.cond.wait()
-            if not slot[2]:
-                # no dispatch in flight: THIS thread becomes the
-                # dispatcher for everything queued so far
-                batch, self.queue = self.queue, []
-                self.running = True
-        if slot[2]:
-            # our query rode a previous dispatch
-            if slot[1] is not None:
-                raise slot[1]
-            return slot[0]
-        try:
-            self._run(batch)
-        finally:
-            with self.cond:
-                self.running = False
-                self.cond.notify_all()
-        if slot[1] is not None:
-            raise slot[1]
-        return slot[0]
+    def _read_lock(self):
+        # TpuVectorIndex carries a reader-writer lock so pipelined
+        # dispatches can score concurrently while cache sync stays
+        # exclusive; test doubles may only have the legacy RLock
+        rw = getattr(self.index, "rw", None)
+        if rw is not None:
+            return rw.read()
+        return self.index.lock
 
-    def _run(self, batch):
-        index = self.index
-        try:
-            kmax = max(k for _q, k, _s in batch)
-            qvs = np.stack([q for q, _k, _s in batch])
-            with index.lock:  # exclude cache sync while the kernel reads
-                results = index._device_knn_batch(qvs, kmax)
-            for (_q, k, slot), pairs in zip(batch, results):
-                slot[0] = pairs[:k]
-                slot[2] = True
-            return
-        except BaseException as e:
-            from surrealdb_tpu.device import (
-                DeviceOpError, DeviceUnavailable, get_supervisor,
-            )
+    def _dispatch(self, payloads):
+        kmax = max(k for _q, k in payloads)
+        qvs = np.stack([q for q, _k in payloads])
+        # the routed engine entry when the index has one; test doubles
+        # expose only the raw device kernel
+        fn = getattr(self.index, "knn_batch", None) \
+            or self.index._device_knn_batch
+        with self._read_lock():
+            results = fn(qvs, kmax)
+        return [pairs[:k] for (_q, k), pairs in zip(payloads, results)]
 
-            if not isinstance(e, (DeviceUnavailable, DeviceOpError)):
-                # a shared non-device failure (OOM, bug): attribute it
-                # to every rider still waiting — nothing to degrade to
-                for _q, _k, slot in batch:
-                    if not slot[2]:
-                        slot[1] = e
-                        slot[2] = True
-                return
-            get_supervisor().note_fallback()
-        # Degrade-and-recover: the device couldn't serve this batch, so
-        # every rider is answered from the exact numpy host path — each
-        # computed (and attributed) INDIVIDUALLY, so one rider's failure
-        # can never poison the rest of the batch.
-        for q, k, slot in batch:
-            if slot[2]:
-                continue
-            try:
-                with index.lock:
-                    slot[0] = index._host_knn_single(q, k)
-            except BaseException as e2:
-                slot[1] = e2
-            slot[2] = True
+    def _fallback_batch(self, payloads):
+        # the device couldn't serve this batch: answer the WHOLE batch
+        # from one batched exact host kernel (a [B, N] BLAS pass still
+        # beats B single passes — the degraded path batches too)
+        from surrealdb_tpu.device import get_supervisor
+
+        get_supervisor().note_fallback()
+        kmax = max(k for _q, k in payloads)
+        qvs = np.stack([q for q, _k in payloads])
+        with self._read_lock():
+            results = self.index._host_knn_multi(qvs, kmax)
+        return [pairs[:k] for (_q, k), pairs in zip(payloads, results)]
+
+    def _fallback_one(self, payload):
+        q, k = payload
+        with self._read_lock():
+            return self.index._host_knn_single(q, k)
 
 
 class TpuVectorIndex:
@@ -273,6 +214,9 @@ class TpuVectorIndex:
         )
         self.dtype = _vec_dtype(params)
         self.lock = threading.RLock()
+        # reader-writer lock over the host arrays: pipelined dispatches
+        # score concurrently under read; cache sync mutates under write
+        self.rw = RWLock()
         self.version = -1
         self.rids: list = []  # row -> RecordId
         self.row_index: dict = {}  # enc(id) -> row
@@ -284,6 +228,9 @@ class TpuVectorIndex:
         self._dev_key = f"vec/{uuid.uuid4().hex[:16]}"
         self._dev_epoch = 0
         self.rank_mode = None  # last runner-reported ranking mode
+        # per-epoch host scoring stats (row norms / squared norms) for
+        # the batched BLAS host path; rebuilt lazily after cache sync
+        self._host_stats = None
         self.coalescer = _Coalescer(self)
 
     # -- cache sync ---------------------------------------------------------
@@ -297,7 +244,7 @@ class TpuVectorIndex:
         ver = ctx.txn.get_val(vkey) or 0
         if ver == self.version:
             return
-        with self.lock:
+        with self.lock, self.rw.write():
             if ver == self.version:
                 return
             gap = ver - self.version
@@ -355,9 +302,11 @@ class TpuVectorIndex:
     def _drop_device(self):
         """Invalidate the device-resident cache (host arrays are truth):
         bumping the epoch makes the runner's copy stale, so the next
-        dispatch re-ships the blocks."""
+        dispatch re-ships the blocks. The host scoring stats are derived
+        from the same arrays and invalidate with it."""
         self._dev_epoch += 1
         self.rank_mode = None
+        self._host_stats = None
 
     def _rebuild(self, ctx):
         ns, db, tb, ix = self.key
@@ -393,6 +342,20 @@ class TpuVectorIndex:
         """Top-k nearest records. `cond`: optional per-record predicate —
         handled by oversample + host truthiness check + refill
         (SURVEY.md hard-parts: cond-filtered KNN)."""
+        import time as _time
+
+        from surrealdb_tpu.telemetry import stage_record
+
+        t0 = _time.perf_counter_ns()
+        try:
+            return self._knn(q, k, ctx, ef=ef, cond=cond,
+                             cond_ctx=cond_ctx)
+        finally:
+            # wall time inside the index: cache sync + batcher wait +
+            # kernel (device RPC time shows separately as device_rpc)
+            stage_record("index_knn", _time.perf_counter_ns() - t0)
+
+    def _knn(self, q, k: int, ctx, ef=None, cond=None, cond_ctx=None):
         self.sync(ctx)
         n = int(self.valid.sum())
         if n == 0:
@@ -431,34 +394,181 @@ class TpuVectorIndex:
         return is_truthy(evaluate(cond, c))
 
     def _raw_knn(self, qv: np.ndarray, k: int):
-        from surrealdb_tpu.device import get_supervisor
-
         n = len(self.rids)
         if n < DEVICE_MIN_ROWS:
+            # tiny store: a single exact pass beats any batching overhead
             return self._host_knn_single(qv, k)
-        if not get_supervisor().fast_path():
-            # circuit open / device cold / disabled: serve exact from
-            # host immediately — no coalescer wait, no device dispatch
-            get_supervisor().note_fallback()
-            return self._host_knn_single(qv, k)
+        # Everything else rides the cross-query batcher — including the
+        # degraded/CPU-only paths, which coalesce into one batched host
+        # kernel instead of N single passes (PR 6: the batcher must win
+        # on CPU-only boxes too).
         return self.coalescer.search(qv, k)
+
+    def _use_device(self) -> bool:
+        """Routing policy for the scoring engine (SURREAL_KNN_HOST_BATCH):
+        dispatch to the device runner on real accelerators; when the
+        "device" IS this host's CPU, the batched BLAS host path wins —
+        offloading numpy-speed kernels through jax only adds dispatch
+        overhead. `device` forces the old always-dispatch behavior,
+        `host` forces host scoring."""
+        from surrealdb_tpu.device import get_supervisor
+
+        mode = cnf.KNN_HOST_BATCH
+        if mode == "host":
+            return False
+        sup = get_supervisor()
+        if not sup.fast_path():
+            if sup.mode != "off":
+                # device wanted but cold/degraded/disabled: host serves
+                sup.note_fallback()
+            return False
+        if mode == "device":
+            return True
+        if sup.platform == "cpu":
+            # the "accelerator" is this host's own CPU (inline debug
+            # mode or a CPU-platform runner): one BLAS pass here beats
+            # shipping numpy-speed work through jax/IPC
+            sup.counters["device_host_routed"] = (
+                sup.counters.get("device_host_routed", 0) + 1
+            )
+            return False
+        return True
+
+    def knn_batch(self, qvs: np.ndarray, k: int):
+        """The raw batched engine entry: [B, D] queries -> per-query
+        (rid, dist) lists, routed to the device runner or the batched
+        exact host kernel by `_use_device`. This is the path the
+        cross-query batcher dispatches AND what bench.py measures as
+        `index_engine_qps` — the serving stack above it is pure tax.
+        Device trouble raises DeviceUnavailable/DeviceOpError for the
+        batcher's per-rider degrade ladder."""
+        if self._use_device():
+            return self._device_knn_batch(qvs, k)
+        return self._host_knn_multi(qvs, k)
 
     def _host_knn_single(self, qv: np.ndarray, k: int):
         """Exact numpy top-k over the host arrays — the degraded path
-        and the small-store fast path (identical results to device)."""
+        and the small-store fast path (identical results to device).
+        Delegates to the batched kernel so sequential and batched
+        results are byte-identical by construction."""
+        return self._host_knn_multi(
+            np.asarray(qv)[None, :], k
+        )[0]
+
+    def _host_knn_multi(self, qvs: np.ndarray, k: int):
+        """Batched exact host KNN: [B, D] queries -> per-query
+        (rid, dist) lists. Large stores with MXU metrics run the same
+        two-stage discipline as the device kernels — ONE gemm ranking
+        pass over the whole store in store precision, then an exact
+        distance-ladder rescore of the oversampled candidates — so the
+        [B, N] block is touched once, in f32, and every reported
+        distance comes from the same per-metric ladder the legacy host
+        path used. Small stores and exotic metrics keep the legacy
+        per-query ladder bit-for-bit (the conformance oracle's path)."""
         n = len(self.rids)
         if n == 0:
-            return []
-        d = self._host_distances(qv)
-        d = np.where(self.valid, d, np.inf)
+            return [[] for _ in range(len(qvs))]
+        if n < DEVICE_MIN_ROWS or self.metric not in (
+            "euclidean", "cosine", "dot"
+        ):
+            return self._host_knn_multi_exact(qvs, k)
+        return self._host_knn_multi_blas(qvs, k)
+
+    def _host_knn_multi_exact(self, qvs: np.ndarray, k: int):
+        """Legacy full-ladder search, one query at a time — byte-
+        identical to the pre-batcher `_host_knn_single`."""
+        n = len(self.rids)
         k_eff = min(k, n)
-        idx = np.argpartition(d, k_eff - 1)[:k_eff]
-        idx = idx[np.argsort(d[idx], kind="stable")]
-        return [
-            (self.rids[i], float(d[i]))
-            for i in idx
-            if np.isfinite(d[i])
-        ]
+        out = []
+        for qv in qvs:
+            d = self._host_distances(qv)
+            d = np.where(self.valid, d, np.inf)
+            idx = np.argpartition(d, k_eff - 1)[:k_eff]
+            idx = idx[np.argsort(d[idx], kind="stable")]
+            out.append([
+                (self.rids[i], float(d[i]))
+                for i in idx
+                if np.isfinite(d[i])
+            ])
+        return out
+
+    def _host_stats_cached(self):
+        """Per-epoch ranking stats for the BLAS path: f32 squared row
+        norms (euclidean scores), f32 inverse row norms (cosine
+        scores), and the invalid-row index list (None when the store
+        has no tombstones — the common case skips the mask pass).
+        Computed blockwise; never materializes an [N, D] copy."""
+        st = self._host_stats
+        if st is not None:
+            return st
+        xs = self.vecs
+        n = xs.shape[0]
+        x2 = np.empty(n, np.float64)
+        step = max(1, (64 << 20) // max(xs.shape[1] * 8, 1))
+        for s in range(0, n, step):
+            blk = xs[s:s + step].astype(np.float64)
+            x2[s:s + step] = (blk * blk).sum(axis=1)
+        inv_norms = (
+            1.0 / np.maximum(np.sqrt(x2), 1e-300)
+        ).astype(np.float32)
+        invalid = None
+        if not self.valid.all():
+            invalid = np.nonzero(~self.valid)[0]
+        st = (x2.astype(np.float32), inv_norms, invalid)
+        self._host_stats = st
+        return st
+
+    def _host_knn_multi_blas(self, qvs: np.ndarray, k: int):
+        """Stage 1: rank every query against the whole store with one
+        gemm per chunk (store precision; per-row results are bitwise
+        stable across batch sizes >= 2, single queries pad to 2 rows —
+        so batched and sequential searches return identical bytes).
+        Stage 2: exact rescore of the kc oversampled candidates through
+        `_host_distances` — the reported distances use the SAME ladder
+        (and the same f32-cosine specialization) as the legacy path."""
+        xs = self.vecs
+        n = xs.shape[0]
+        m = self.metric
+        x2_32, inv_norms32, invalid = self._host_stats_cached()
+        k_eff = min(k, n)
+        kc = min(n, max(2 * k, k + 16))
+        # bound the [chunk, N] f32 score block
+        step = max(1, (cnf.KNN_SCORE_BUDGET_ELEMS // 2) // max(n, 1))
+        out = []
+        for s in range(0, len(qvs), step):
+            qc = qvs[s:s + step]
+            qb = np.ascontiguousarray(np.asarray(qc, dtype=xs.dtype))
+            pad1 = qb.shape[0] == 1
+            if pad1:
+                # gemv and gemm round differently; a 2-row gemm keeps
+                # single-query results bit-identical to batched ones
+                qb = np.concatenate([qb, qb], axis=0)
+            dots = qb @ xs.T  # [B, N] store precision
+            if pad1:
+                dots = dots[:1]
+            if m == "euclidean":
+                score = x2_32[None, :] - 2.0 * dots
+            elif m == "cosine":
+                score = dots * inv_norms32[None, :]
+                np.negative(score, out=score)
+            else:  # dot
+                score = -dots
+            if invalid is not None and len(invalid):
+                score[:, invalid] = np.inf
+            cand = np.argpartition(score, kc - 1, axis=1)[:, :kc]
+            for b in range(cand.shape[0]):
+                ids_b = cand[b]
+                rows = xs[ids_b]
+                d = self._host_distances(qc[b], xs=rows)
+                d = np.where(self.valid[ids_b], d, np.inf)
+                sel = np.argpartition(d, min(k_eff, kc) - 1)[:k_eff]
+                sel = sel[np.argsort(d[sel], kind="stable")]
+                out.append([
+                    (self.rids[int(ids_b[j])], float(d[j]))
+                    for j in sel
+                    if np.isfinite(d[j])
+                ])
+        return out
 
     def _device_cfg(self) -> dict:
         """Kernel budgets shipped per dispatch (read at call time so the

@@ -33,15 +33,58 @@ from typing import Optional
 _tls = threading.local()
 
 
+class CancelEvent(threading.Event):
+    """A cancel flag that can WAKE sleepers parked on other primitives.
+
+    The cross-query batcher parks riders on a Condition that nothing
+    signals on KILL/disconnect/drain — they used to poll the flag every
+    50ms, which at high concurrency is thousands of wakeups per second
+    of pure GIL churn. A waker registered here fires inside `set()`, so
+    a parked rider is notified the instant the flag flips and can wait
+    event-driven otherwise. Fired wakers must be cheap and non-raising
+    (they run on the killer's thread)."""
+
+    def __init__(self):
+        super().__init__()
+        self._wakers: list = []
+
+    def add_waker(self, fn):
+        self._wakers.append(fn)
+
+    def remove_waker(self, fn):
+        try:
+            self._wakers.remove(fn)
+        except ValueError:
+            pass
+
+    def set(self):
+        super().set()
+        for fn in list(self._wakers):
+            try:
+                fn()
+            except Exception:
+                pass
+
+
 class QueryHandle:
-    """One registered query's lifecycle state."""
+    """One registered query's lifecycle state. Instances are POOLED by
+    the registry (the serving hot path opens one per query — the
+    allocation, uuid, and Event construction were measurable tax), so
+    all lifecycle state must be reset in `_reset`."""
 
     __slots__ = ("id", "ns", "db", "_digest", "started", "deadline",
                  "cancel", "timed_out", "cancelled", "sql_head", "edge",
                  "registry")
 
     def __init__(self, ns, db, sql: str, deadline: Optional[float] = None):
-        self.id = str(uuid.uuid4())
+        self.cancel = CancelEvent()
+        self.registry: Optional["InflightRegistry"] = None
+        self._reset(str(uuid.uuid4()), ns, db, sql, deadline)
+
+    def _reset(self, qid: str, ns, db, sql: str,
+               deadline: Optional[float]):
+        self.cancel._wakers.clear()  # no waker may outlive its query
+        self.id = qid
         self.ns = ns
         self.db = db
         sql = sql or ""
@@ -53,13 +96,11 @@ class QueryHandle:
         self.started = time.time()
         # monotonic-clock absolute deadline (None = unbounded)
         self.deadline = deadline
-        self.cancel = threading.Event()
         self.timed_out = False  # set by the site that raised QueryTimeout
         self.cancelled = False  # set by the site that raised QueryCancelled
         # an edge-opened handle (server route, pre-SQL): the first
         # ds.execute underneath refines digest/ns/db to the real query
         self.edge = False
-        self.registry: Optional["InflightRegistry"] = None
 
     @property
     def digest(self) -> str:
@@ -164,10 +205,20 @@ class InflightRegistry:
     Exposed via `INFO FOR SYSTEM` (the `queries` list) and the
     `inflight_queries` gauge; `KILL <query-id>` resolves against it."""
 
+    # pooled handles kept per registry; caps allocation churn without
+    # pinning memory on burst peaks
+    POOL_MAX = 256
+
     def __init__(self, telemetry=None):
         self.lock = threading.Lock()
         self.queries: dict[str, QueryHandle] = {}
         self.telemetry = telemetry
+        # registry-scoped id space: one uuid prefix + a counter beats a
+        # fresh uuid4 per query, stays globally unique, and KILL-by-id
+        # still resolves (string equality)
+        self._id_prefix = f"q{uuid.uuid4().hex[:12]}-"
+        self._id_seq = 0
+        self._pool: list[QueryHandle] = []
         if telemetry is not None:
             telemetry.register_gauge("inflight_queries", self.count)
 
@@ -177,23 +228,41 @@ class InflightRegistry:
 
     def open(self, ns, db, sql: str,
              deadline: Optional[float] = None) -> QueryHandle:
-        h = QueryHandle(ns, db, sql, deadline)
-        h.registry = self
         with self.lock:
-            self.queries[h.id] = h
+            self._id_seq += 1
+            qid = f"{self._id_prefix}{self._id_seq}"
+            h = self._pool.pop() if self._pool else None
+            if h is not None:
+                h._reset(qid, ns, db, sql, deadline)
+            else:
+                h = QueryHandle.__new__(QueryHandle)
+                h.cancel = CancelEvent()
+                h._reset(qid, ns, db, sql, deadline)
+                h.registry = self
+            self.queries[qid] = h
         return h
 
     def close(self, handle: QueryHandle):
         with self.lock:
             self.queries.pop(handle.id, None)
+            # recycle only a handle nobody can still legitimately
+            # cancel: kill()/cancel_all() flip the flag UNDER this
+            # lock, so a clean flag here means no set can race the
+            # reuse; a tripped handle is simply dropped
+            if (len(self._pool) < self.POOL_MAX
+                    and not handle.cancel.is_set()
+                    and not handle.timed_out):
+                self._pool.append(handle)
 
     def kill(self, qid: str) -> bool:
-        """Set the cancel flag on a running query. True when found."""
+        """Set the cancel flag on a running query. True when found.
+        The set happens under the registry lock so it can never land on
+        a handle that close() already recycled."""
         with self.lock:
             h = self.queries.get(qid)
-        if h is None:
-            return False
-        h.cancel.set()
+            if h is None:
+                return False
+            h.cancel.set()
         return True
 
     def cancel_all(self):
@@ -201,8 +270,8 @@ class InflightRegistry:
         queries notice at their next check_deadline site)."""
         with self.lock:
             handles = list(self.queries.values())
-        for h in handles:
-            h.cancel.set()
+            for h in handles:
+                h.cancel.set()
         return len(handles)
 
     def snapshot(self) -> list[dict]:

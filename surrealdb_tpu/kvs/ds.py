@@ -117,10 +117,12 @@ class Datastore:
             from surrealdb_tpu.kvs.lsm import LsmBackend
 
             self.backend = LsmBackend(path[len("lsm://"):])
+            self._register_compile_cache_dir(path[len("lsm://"):])
         elif path.startswith("file://") or path.startswith("skv://"):
             from surrealdb_tpu.kvs.file import FileBackend
 
             self.backend = FileBackend(path.split("://", 1)[1])
+            self._register_compile_cache_dir(path.split("://", 1)[1])
         elif path.startswith("remote://"):
             # distributed mode: stateless database node over a shared
             # transactional KV service (reference kvs/tikv/mod.rs:32);
@@ -209,6 +211,20 @@ class Datastore:
         self._tso_expiry = 0.0
         self._stamp_storage_version(check_version)
 
+    @staticmethod
+    def _register_compile_cache_dir(store_path: str):
+        """Disk-backed stores anchor the device runner's persistent
+        XLA compile cache next to the data (unless the env knob picked
+        somewhere explicit) — compiled kernels then survive server AND
+        runner restarts together."""
+        import os as _os
+
+        from surrealdb_tpu.device import compile_cache
+
+        base = store_path if _os.path.isdir(store_path) \
+            else _os.path.dirname(_os.path.abspath(store_path))
+        compile_cache.set_default_dir(_os.path.join(base, ".xla-cache"))
+
     def start_node_tasks(self, interval_s: float = 10.0,
                          stale_s: float = 30.0):
         """Start heartbeat + membership-check loops (reference
@@ -277,8 +293,11 @@ class Datastore:
             sess.db = db
         stmts = self._ast_cache.get(sql)
         if stmts is None:
+            from surrealdb_tpu.telemetry import stage_record
+            t_parse = time.perf_counter_ns()
             try:
                 stmts = parse(sql, capabilities=self.capabilities)
+                stage_record("parse", time.perf_counter_ns() - t_parse)
             except ParseError as e:
                 # a parse error fails the whole query (reference behaviour)
                 return [QueryResult(error=str(e))]

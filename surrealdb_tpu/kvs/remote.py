@@ -252,39 +252,56 @@ class RetryPolicy:
         should go out immediately instead of burning the caller's
         deadline inside an exponential backoff. (The shard router's
         in-transaction paths refresh inline instead: a consumed
-        snapshot can't be retried at this level.)"""
+        snapshot can't be retried at this level.)
+
+        Happy-path fast path: a first attempt that succeeds against a
+        healthy link pays NONE of the retry machinery — no clock read,
+        no deadline math, no inflight-budget lookup. The full policy
+        engages only once the first attempt fails retryably (the retry
+        deadline then counts from the first failure, which only ever
+        GRANTS a sliver more budget than counting from entry)."""
+        try:
+            return fn()
+        except BaseException as e:
+            if not is_retryable(e):
+                raise
+            first_exc = e
         from surrealdb_tpu.inflight import cancelled as _q_cancelled
 
         deadline_s = self.effective_deadline_s()
         start = self.clock()
         attempt = 0
+        exc: BaseException = first_exc
         while True:
+            # `exc` holds the latest retryable failure (attempt index
+            # `attempt`): check budget, back off, try again
+            elapsed = self.clock() - start
+            remaining = deadline_s - elapsed
+            if remaining <= 0 or _q_cancelled():
+                if telemetry is not None:
+                    telemetry.inc("kv_deadline_exhausted")
+                raise RetryableKvError(
+                    f"kv operation failed after {attempt + 1} attempts "
+                    f"over {elapsed:.2f}s (deadline {deadline_s}s): "
+                    f"{exc}"
+                ) from exc
+            if telemetry is not None:
+                telemetry.inc("kv_retries")
+            skip_backoff = False
+            if on_retry is not None:
+                try:
+                    skip_backoff = bool(on_retry(exc, attempt))
+                except BaseException:
+                    pass  # a failed refresh falls back to backoff
+            if not skip_backoff:
+                self.sleep(min(self.backoff(attempt), remaining))
+            attempt += 1
             try:
                 return fn()
             except BaseException as e:
                 if not is_retryable(e):
                     raise
-                elapsed = self.clock() - start
-                remaining = deadline_s - elapsed
-                if remaining <= 0 or _q_cancelled():
-                    if telemetry is not None:
-                        telemetry.inc("kv_deadline_exhausted")
-                    raise RetryableKvError(
-                        f"kv operation failed after {attempt + 1} attempts "
-                        f"over {elapsed:.2f}s (deadline {deadline_s}s): "
-                        f"{e}"
-                    ) from e
-                if telemetry is not None:
-                    telemetry.inc("kv_retries")
-                skip_backoff = False
-                if on_retry is not None:
-                    try:
-                        skip_backoff = bool(on_retry(e, attempt))
-                    except BaseException:
-                        pass  # a failed refresh falls back to backoff
-                if not skip_backoff:
-                    self.sleep(min(self.backoff(attempt), remaining))
-                attempt += 1
+                exc = e
 
 
 # ---------------------------------------------------------------------------

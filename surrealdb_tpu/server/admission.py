@@ -43,6 +43,7 @@ class AdmissionController:
         self.cond = threading.Condition()
         self.active = 0
         self.waiting = 0
+        self.admitted = 0
         self.draining = False
         # EWMA of recent service times (seconds) for queue-wait estimates;
         # seeded small so an idle server never sheds on the estimate alone
@@ -53,6 +54,11 @@ class AdmissionController:
             )
             telemetry.register_gauge(
                 "admission_active", lambda: self.active
+            )
+            # admitted is counted under the admission condition the hot
+            # path already holds — no telemetry-lock hit per query
+            telemetry.register_counter(
+                "queries_admitted", lambda: self.admitted
             )
 
     # -- helpers ------------------------------------------------------------
@@ -73,12 +79,19 @@ class AdmissionController:
     def admit(self, deadline=None) -> "_Ticket":
         """Block until a worker slot is free (within the queue bound and
         the caller's deadline) or raise ShedError. Returns a ticket whose
-        release() MUST run when the request finishes."""
+        release() MUST run when the request finishes. Queue time lands
+        in the `admission_wait` stage stat."""
+        from surrealdb_tpu.telemetry import stage_record
+
+        t0 = time.perf_counter_ns()
         with self.cond:
             if self.draining:
                 self._shed("draining", 1.0)
             if self.active < self.max_inflight and self.waiting == 0:
                 self.active += 1
+                self.admitted += 1
+                stage_record("admission_wait",
+                             time.perf_counter_ns() - t0)
                 return _Ticket(self)
             if self.waiting >= self.queue_depth:
                 self._shed(
@@ -100,6 +113,9 @@ class AdmissionController:
                         self._shed("draining", 1.0)
                     if self.active < self.max_inflight:
                         self.active += 1
+                        self.admitted += 1
+                        stage_record("admission_wait",
+                                     time.perf_counter_ns() - t0)
                         return _Ticket(self)
                     timeout = None
                     if deadline is not None:
@@ -143,8 +159,6 @@ class _Ticket:
         self.ctrl = ctrl
         self.t0 = time.monotonic()
         self._done = False
-        if ctrl.telemetry is not None:
-            ctrl.telemetry.inc("queries_admitted")
 
     def release(self):
         if not self._done:

@@ -27,6 +27,70 @@ _BUCKETS_MS = (0.1, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
                2500, 5000, 10000)
 
 
+class StageStat:
+    """One query stage's accumulated timing. Updates are deliberately
+    lock-free: under the GIL a lost increment during a race skews a
+    metric by one sample, which is acceptable for observability — a
+    per-stage lock would put two atomic ops on every query's hot path
+    for data nobody reads at that granularity."""
+
+    __slots__ = ("count", "total_ns", "max_ns", "last_ns")
+
+    def __init__(self):
+        self.count = 0
+        self.total_ns = 0
+        self.max_ns = 0
+        self.last_ns = 0
+
+    def add(self, ns: int):
+        self.count += 1
+        self.total_ns += ns
+        self.last_ns = ns
+        if ns > self.max_ns:
+            self.max_ns = ns
+
+    def to_dict(self) -> dict:
+        c = self.count
+        return {
+            "count": c,
+            "total_ms": round(self.total_ns / 1e6, 3),
+            "avg_us": round(self.total_ns / max(c, 1) / 1e3, 1),
+            "max_us": round(self.max_ns / 1e3, 1),
+            "last_us": round(self.last_ns / 1e3, 1),
+        }
+
+
+# Per-stage query timing (the PR-6 overhead strip's measurement hook):
+# process-wide so the serving edge (admission), the datastore (parse,
+# txn open), the executor (envelope, eval) and the device layer
+# (batcher wait, supervisor RPC) all land in ONE table regardless of
+# which Datastore/Telemetry instance they hang off. Stages surface in
+# /metrics, `INFO FOR SYSTEM` and tools/profile_query.py.
+_STAGES: dict[str, StageStat] = {}
+
+
+def stage_record(name: str, ns: int):
+    """Record `ns` nanoseconds spent in query stage `name`."""
+    st = _STAGES.get(name)
+    if st is None:
+        # dict set is atomic under the GIL; a racing first-record for
+        # the same stage leaves one winner and loses one sample
+        st = _STAGES.setdefault(name, StageStat())
+    st.add(ns)
+
+
+def stage_snapshot() -> dict:
+    """{stage: {count, total_ms, avg_us, max_us, last_us}} sorted by
+    total time descending."""
+    items = sorted(_STAGES.items(), key=lambda kv: -kv[1].total_ns)
+    return {k: v.to_dict() for k, v in items}
+
+
+def stage_reset():
+    """Clear stage stats (tools/profile_query.py between runs)."""
+    _STAGES.clear()
+
+
 class Span:
     __slots__ = ("name", "start_ns", "dur_ns", "attrs", "children")
 
@@ -56,7 +120,7 @@ class Telemetry:
     def __init__(self, ring_size: int = 256):
         self.lock = threading.Lock()
         self.ring_size = ring_size
-        self.traces: list[dict] = []
+        self.traces: list[Span] = []  # rendered lazily by recent_traces
         self.counters: dict[str, int] = {}
         # query duration histogram (cumulative bucket counts, Prometheus
         # `le` semantics) + sum/count
@@ -69,10 +133,20 @@ class Telemetry:
         # gauges: name -> zero-arg callable sampled at scrape time (the
         # admission controller and in-flight registry register theirs)
         self.gauges: dict = {}
+        # counter providers: like gauges but rendered as counters
+        self.counter_providers: dict = {}
 
     def register_gauge(self, name: str, fn):
         with self.lock:
             self.gauges[name] = fn
+
+    def register_counter(self, name: str, fn):
+        """A monotonically increasing counter whose value lives with its
+        owner (sampled at scrape, rendered as `surreal_<name>_total`).
+        Lets hot paths count under a lock they already hold instead of
+        taking the telemetry lock per event."""
+        with self.lock:
+            self.counter_providers[name] = fn
 
     def unregister_gauge(self, name: str):
         """Drop a gauge provider (a closed sharded backend must not
@@ -97,7 +171,14 @@ class Telemetry:
 
     def get(self, name: str) -> int:
         with self.lock:
-            return self.counters.get(name, 0)
+            v = self.counters.get(name, 0)
+            fn = self.counter_providers.get(name)
+        if fn is not None:
+            try:
+                v += fn()
+            except Exception:
+                pass
+        return v
 
     # -- spans --------------------------------------------------------------
     def start(self, name: str, **attrs) -> Span:
@@ -143,7 +224,12 @@ class Telemetry:
                     break
             else:
                 self.hist[-1] += 1
-            self.traces.append(s.to_dict())
+            # ring holds the finished Span OBJECTS; the dict/json render
+            # happens lazily at read time (recent_traces) — serializing
+            # every query's span tree was measurable dict churn on the
+            # serving hot path and the ring overwrites most of them
+            # unread anyway
+            self.traces.append(s)
             if len(self.traces) > self.ring_size:
                 del self.traces[: self.ring_size // 2]
         if self._export_path:
@@ -155,7 +241,8 @@ class Telemetry:
 
     def recent_traces(self, limit: int = 64):
         with self.lock:
-            return list(self.traces[-limit:])
+            spans = list(self.traces[-limit:])
+        return [s.to_dict() for s in spans]
 
     # -- prometheus ---------------------------------------------------------
     def prometheus(self, ds=None) -> str:
@@ -173,6 +260,13 @@ class Telemetry:
             hist = list(self.hist)
             hsum, hcount = self.hist_sum_ms, self.hist_count
             gauges = dict(self.gauges)
+            cprov = dict(self.counter_providers)
+        for k, fn in sorted(cprov.items()):
+            try:
+                counters.setdefault(k, 0)
+                counters[k] += fn()
+            except Exception:
+                continue
         if ds is not None:
             for k, v in ds.metrics.items():
                 counter(f"surreal_ds_{k}_total", v,
@@ -190,6 +284,20 @@ class Telemetry:
                 continue  # a dying provider must not poison the scrape
             lines.append(f"# TYPE surreal_{k} gauge")
             lines.append(f"surreal_{k} {v}")
+        lines.append("# TYPE surreal_query_stage_us summary")
+        for sname, st in stage_snapshot().items():
+            lines.append(
+                f'surreal_query_stage_us{{stage="{sname}",stat="avg"}} '
+                f'{st["avg_us"]}'
+            )
+            lines.append(
+                f'surreal_query_stage_us{{stage="{sname}",stat="max"}} '
+                f'{st["max_us"]}'
+            )
+            lines.append(
+                f'surreal_query_stage_count{{stage="{sname}"}} '
+                f'{st["count"]}'
+            )
         lines.append("# TYPE surreal_query_duration_ms histogram")
         acc = 0
         for i, edge in enumerate(_BUCKETS_MS):

@@ -10,6 +10,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # effective for the kernel-selection tests. The chaos suite
 # (test_device_chaos.py) installs real subprocess supervisors itself.
 os.environ.setdefault("SURREAL_DEVICE", "inline")
+# keep the device kernels under test: the production router
+# (SURREAL_KNN_HOST_BATCH=auto) would host-route every dispatch on the
+# suite's CPU-platform inline supervisor, and the kernel-selection /
+# multichip / chaos suites exist to exercise the device path. The
+# batcher suite overrides per-test to cover the host routing.
+os.environ.setdefault("SURREAL_KNN_HOST_BATCH", "device")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -22,6 +22,79 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("SURREAL_DEVICE", "inline")
 
 
+def perf_smoke(ratio_floor: float = 0.8) -> "str | None":
+    """Serving-tax gate (PR 6): a small-N sql_knn vs index_engine
+    comparison on the conformance box. The served SQL KNN path (cross-
+    query batcher over the routed engine) must hold at least
+    `ratio_floor` of the raw engine's big-batch throughput — the 5×
+    serving-stack regression of BENCH_r05 can never silently regrow.
+    Returns None on pass, an error string on fail. Best-of-two to
+    absorb CI timer jitter."""
+    import time
+
+    import numpy as np
+
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.kvs.api import serialize
+    from surrealdb_tpu.val import RecordId
+
+    n, dim, clients, iters = 8192, 64, 32, 256
+    ds = Datastore("memory")
+    ds.query(
+        f"DEFINE TABLE tbl; DEFINE INDEX ix ON tbl FIELDS emb HNSW "
+        f"DIMENSION {dim} DIST COSINE TYPE F32", ns="b", db="b",
+    )
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(n, dim)).astype(np.float32)
+    txn = ds.transaction(write=True)
+    try:
+        for i in range(n):
+            txn.set(K.record("b", "b", "tbl", i),
+                    serialize({"id": RecordId("tbl", i)}))
+            txn.set_val(
+                K.ix_state("b", "b", "tbl", "ix", b"he", K.enc_value(i)),
+                xs[i].tobytes(),
+            )
+        txn.set_val(K.ix_state("b", "b", "tbl", "ix", b"vn"), n)
+        txn.commit()
+    except BaseException:
+        txn.cancel()
+        raise
+    qs = rng.normal(size=(32, dim)).astype(np.float32)
+    qlists = [q.tolist() for q in qs]
+    sql = "SELECT id FROM tbl WHERE emb <|10|> $q"
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    def sql_qps() -> float:
+        def one(i):
+            ds.execute(sql, ns="b", db="b",
+                       vars={"q": qlists[i % len(qlists)]})
+
+        with ThreadPoolExecutor(clients) as ex:
+            t0 = time.perf_counter()
+            list(ex.map(one, range(iters)))
+            return iters / (time.perf_counter() - t0)
+
+    sql_qps()  # warm: sync + stat caches + compiled shapes
+    ix = ds.vector_indexes[("b", "b", "tbl", "ix")]
+    big = np.repeat(qs, 16, axis=0)  # 512-query engine batch
+    ix.knn_batch(big, 10)
+    t0 = time.perf_counter()
+    ix.knn_batch(big, 10)
+    engine = len(big) / (time.perf_counter() - t0)
+    served = max(sql_qps(), sql_qps())
+    if served >= ratio_floor * engine:
+        print(f"== perf smoke: OK — sql_knn {served:.0f} qps vs "
+              f"index_engine {engine:.0f} qps "
+              f"({served / max(engine, 1e-9):.2f}x, floor "
+              f"{ratio_floor}x)")
+        return None
+    return (f"sql_knn {served:.0f} qps < {ratio_floor} x index_engine "
+            f"{engine:.0f} qps — serving tax regrew")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("filter", nargs="?", default=None)
@@ -129,6 +202,12 @@ def main():
         print("== device-degraded smoke: OK")
     else:
         print(f"== device-degraded smoke: FAIL — {err}")
+        rc = rc or 1
+    # perf smoke: the serving tax over the raw index engine is gated
+    # (sql_knn >= 0.8 x index_engine on this box, small N)
+    err = perf_smoke()
+    if err is not None:
+        print(f"== perf smoke: FAIL — {err}")
         rc = rc or 1
     return rc
 

@@ -1,0 +1,131 @@
+"""One-shot per-stage timing dump for a query (PR 6 overhead strip).
+
+Runs a SurrealQL query N times against a synthetic KNN datastore (or a
+caller-supplied SQL against a fresh memory store) and prints the
+per-stage timing table the serving stack records (telemetry stage
+stats), plus batching and compile-cache counters — the measurement
+hook future PRs use to keep the serving tax visible.
+
+    python tools/profile_query.py                      # default KNN shape
+    python tools/profile_query.py --n 100000 --dim 768 --iters 256 \
+        --threads 64
+    python tools/profile_query.py --sql "RETURN 1" --iters 1000
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SURREAL_DEVICE", "inline")
+
+import numpy as np  # noqa: E402
+
+
+def build_knn_ds(n: int, dim: int):
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.kvs.api import serialize
+    from surrealdb_tpu.val import RecordId
+
+    ds = Datastore("memory")
+    ds.query(
+        f"DEFINE TABLE tbl; DEFINE INDEX ix ON tbl FIELDS emb HNSW "
+        f"DIMENSION {dim} DIST COSINE TYPE F32", ns="b", db="b",
+    )
+    rng = np.random.default_rng(7)
+    xs = rng.normal(size=(n, dim)).astype(np.float32)
+    txn = ds.transaction(write=True)
+    try:
+        for i in range(n):
+            txn.set(K.record("b", "b", "tbl", i),
+                    serialize({"id": RecordId("tbl", i)}))
+            txn.set_val(
+                K.ix_state("b", "b", "tbl", "ix", b"he", K.enc_value(i)),
+                xs[i].tobytes(),
+            )
+        txn.set_val(K.ix_state("b", "b", "tbl", "ix", b"vn"), n)
+        txn.commit()
+    except BaseException:
+        txn.cancel()
+        raise
+    return ds, xs
+
+
+def run(ds, sql: str, vars_list, iters: int, threads: int) -> float:
+    def one(i):
+        v = vars_list[i % len(vars_list)] if vars_list else None
+        ds.execute(sql, ns="b", db="b", vars=v)
+
+    if threads <= 1:
+        t0 = time.perf_counter()
+        for i in range(iters):
+            one(i)
+        return iters / (time.perf_counter() - t0)
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(threads) as ex:
+        t0 = time.perf_counter()
+        list(ex.map(one, range(iters)))
+        return iters / (time.perf_counter() - t0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sql", default=None,
+                    help="profile this SQL instead of the KNN shape")
+    ap.add_argument("--n", type=int, default=50_000)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=256)
+    ap.add_argument("--threads", type=int, default=64)
+    ap.add_argument("--warm", type=int, default=64)
+    args = ap.parse_args()
+
+    from surrealdb_tpu import telemetry as T
+    from surrealdb_tpu.device.batcher import BATCH_STATS
+    from surrealdb_tpu.device import get_supervisor
+
+    if args.sql:
+        from surrealdb_tpu import Datastore
+
+        ds = Datastore("memory")
+        sql, vars_list = args.sql, []
+    else:
+        ds, xs = build_knn_ds(args.n, args.dim)
+        rng = np.random.default_rng(11)
+        qs = rng.normal(size=(32, args.dim)).astype(np.float32)
+        vars_list = [{"q": q.tolist()} for q in qs]
+        sql = "SELECT id FROM tbl WHERE emb <|10|> $q"
+
+    run(ds, sql, vars_list, max(args.warm, 1), args.threads)  # warm
+    T.stage_reset()
+    d0 = BATCH_STATS.to_dict()
+    qps = run(ds, sql, vars_list, args.iters, args.threads)
+
+    print(f"\n{args.iters} × {sql!r}  "
+          f"[{args.threads} client(s)] -> {qps:.1f} qps\n")
+    stages = T.stage_snapshot()
+    if stages:
+        w = max(len(k) for k in stages) + 2
+        print(f"{'stage':<{w}}{'count':>8}{'total ms':>12}"
+              f"{'avg µs':>10}{'max µs':>12}")
+        for name, st in stages.items():
+            print(f"{name:<{w}}{st['count']:>8}{st['total_ms']:>12}"
+                  f"{st['avg_us']:>10}{st['max_us']:>12}")
+    d1 = BATCH_STATS.to_dict()
+    nd = d1["dispatches"] - d0["dispatches"]
+    nr = d1["riders"] - d0["riders"]
+    print(f"\nbatching: {nd} dispatches, {nr} riders "
+          f"(avg batch {nr / max(nd, 1):.1f}, max seen {d1['max']})")
+    cc = get_supervisor().compile_counts_now()
+    print(f"compile shapes: {cc['hits']} hits / {cc['misses']} misses")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
