@@ -61,6 +61,59 @@ KNN_HBM_BUDGET_BYTES = env_int(
 # candidate oversampling multiple (×k) for the int8 ranking store; higher
 # absorbs quantization error before the exact host rescore
 KNN_INT8_OVERSAMPLE = env_int("SURREAL_KNN_INT8_OVERSAMPLE", 128)
+# -- quantized graph-ANN index (idx/cagra.py, device/annstore.py) ------------
+# auto: stores at/above ANN_MIN_ROWS with an MXU metric build a CAGRA-
+# style fixed-degree graph in the background and route <|k|> searches
+# through int8 greedy descent + exact re-rank once it is ready (brute
+# force serves until then). off: never. force: build for any store
+# above a small floor (tests/benches).
+KNN_ANN_MODE = env_str("SURREAL_KNN_ANN", "auto")
+KNN_ANN_MIN_ROWS = env_int("SURREAL_KNN_ANN_MIN_ROWS", 200_000)
+# fixed out-degree of the search graph ([N, D_out] int32)
+KNN_ANN_DEGREE = env_int("SURREAL_KNN_ANN_DEGREE", 32)
+# greedy-descent frontier width (itopk); rounded up to a power of two
+# and never below the re-rank candidate count
+KNN_ANN_SEARCH_WIDTH = env_int("SURREAL_KNN_ANN_SEARCH_WIDTH", 64)
+# fixed descent iterations / nodes expanded per iteration (static
+# shapes: the compiled kernel ladder stays bounded)
+KNN_ANN_ITERS = env_int("SURREAL_KNN_ANN_ITERS", 24)
+KNN_ANN_EXPAND = env_int("SURREAL_KNN_ANN_EXPAND", 2)
+# exact re-rank oversampling: kc = max(OVERSAMPLE * k, 32) candidates
+# leave the descent and are re-scored from the f32 host rows
+KNN_ANN_OVERSAMPLE = env_int("SURREAL_KNN_ANN_OVERSAMPLE", 4)
+# routing-probe floor (strided rows brute-scored to seed the descent);
+# covers clusters the fixed graph entries can't route to — one
+# [B, probe] gemm per batch, ≪ a brute scan while probe ≪ N
+KNN_ANN_PROBE = env_int("SURREAL_KNN_ANN_PROBE", 4096)
+# ...and its size as a fraction of N: a FIXED probe's cluster-miss rate
+# grows with the store (a cluster of s rows is missed with p≈e^(-P·s/N),
+# so at constant P and cluster size, recall decays as N grows —
+# measured 0.97 at 100k → 0.80 at 250k with P=4096). A constant
+# FRACTION pins the per-cluster expectation: P = N/24 keeps the miss
+# rate ≈ e^(-4) for 100-row clusters at any N, at ~4% of a brute
+# scan's per-query cost.
+KNN_ANN_PROBE_FRAC = env_float("SURREAL_KNN_ANN_PROBE_FRAC", 1 / 24)
+# k above which the planner keeps brute force (descent width economics)
+KNN_ANN_MAX_K = env_int("SURREAL_KNN_ANN_MAX_K", 64)
+# build knobs: RP-partition leaf size (exact kNN within a leaf), number
+# of trees merged, NN-descent refine rounds (-1 = auto: 1 round up to
+# 200k rows, 0 above — the gather traffic dominates at multi-million N)
+KNN_ANN_LEAF = env_int("SURREAL_KNN_ANN_LEAF", 512)
+KNN_ANN_TREES = env_int("SURREAL_KNN_ANN_TREES", 2)
+KNN_ANN_REFINE = env_int("SURREAL_KNN_ANN_REFINE", -1)
+# int8 quantization clip quantile (density-aware: per-row scale from
+# this |x| quantile instead of the max, so one outlier coordinate
+# cannot crush the row's resolution). Default 1.0 = exact max: on
+# near-gaussian rows (normalized embeddings) a sub-max clip SATURATES
+# the largest coordinates, and that bias costs more recall than the
+# resolution buys (measured: cosine recall@10 0.86 → 1.00 at kc=4k).
+# Lower it only for stores with genuine heavy-tailed outlier dims.
+KNN_ANN_CLIP_Q = env_float("SURREAL_KNN_ANN_CLIP_Q", 1.0)
+# appended-tail tolerance: rows written after the graph was built are
+# brute-ranked and merged into the re-rank set; past this fraction the
+# graph is considered stale and a rebuild is scheduled
+KNN_ANN_TAIL_FRAC = env_float("SURREAL_KNN_ANN_TAIL_FRAC", 0.25)
+
 # scoring-path routing for the cross-query batcher (idx/vector.py):
 #   auto   — dispatch to the device runner on real accelerators; when the
 #            "device" IS the host CPU (platform cpu), score from the
@@ -167,6 +220,10 @@ DEVICE_COMPILE_CACHE_DIR = env_str("SURREAL_DEVICE_COMPILE_CACHE_DIR", "")
 # serving traffic never pays one mid-query.
 DEVICE_PREWARM_BUCKETS = env_str("SURREAL_DEVICE_PREWARM_BUCKETS",
                                  "1,8,64")
+# hop depths pre-compiled after a CSR graph ships (same rationale as
+# the bucket ladder: the first multi-hop after a ship/restart must not
+# pay an XLA compile mid-query); "" disables
+DEVICE_PREWARM_HOPS = env_str("SURREAL_DEVICE_PREWARM_HOPS", "1,2,3")
 
 # -- admission control / query lifecycle (server/admission.py, inflight.py) --
 # concurrent queries executing at once (the worker-slot budget); the CLI

@@ -16,6 +16,7 @@ import numpy as np
 # an eviction is only a re-ship (never an error)
 MAX_VEC_STORES = 64
 MAX_CSR_STORES = 64
+MAX_ANN_STORES = 16
 
 
 class DeviceHost:
@@ -33,10 +34,14 @@ class DeviceHost:
             compile_cache.initialize(d)
         self.vec: OrderedDict = OrderedDict()  # key -> (tag, VecStore)
         self.csr: OrderedDict = OrderedDict()  # key -> (tag, CsrStore)
+        self.ann: OrderedDict = OrderedDict()  # key -> (tag, AnnStore)
         # multipart vec loads in flight: key -> (meta, vecs, valid).
         # Big stores (the 10M×768 regime is ~30 GB of f32 rows) ship as
         # begin/part.../end so no single frame has to hold the store.
         self._staging: dict = {}
+        # multipart ANN loads: key -> (meta, {name: array}); the int8
+        # rows and the graph ship as independently chunked buffers
+        self._ann_staging: dict = {}
 
     # -- ops ----------------------------------------------------------------
     def handle(self, op: str, meta: dict, bufs: list):
@@ -59,8 +64,10 @@ class DeviceHost:
             "device_count": len(devs),
             "vec_blocks": len(self.vec),
             "csr_blocks": len(self.csr),
+            "ann_blocks": len(self.ann),
             "vec_bytes": sum(s.nbytes() for _t, s in self.vec.values()),
             "csr_bytes": sum(s.nbytes() for _t, s in self.csr.values()),
+            "ann_bytes": sum(s.nbytes() for _t, s in self.ann.values()),
             "compile_cache": compile_cache.initialize()
             if compile_cache.configured_dir() else {"disabled": "unset"},
             "cc": kernelstats.snapshot(),
@@ -128,29 +135,112 @@ class DeviceHost:
         out_meta, out_bufs = ent[1].knn(bufs[0], int(meta["k"]))
         return "ok", out_meta, out_bufs
 
-    def op_vec_prewarm(self, meta, bufs):
-        """Compile the power-of-two query-bucket ladder for a loaded
-        store AHEAD of traffic (runner start / store re-ship), so
-        serving queries never pay an XLA compile mid-query. With the
-        persistent compile cache warm this is a handful of disk loads."""
-        ent = self.vec.get(meta["key"])
+    def _prewarm_shapes(self, cache, meta, field, warm_one):
+        """Shared prewarm skeleton: compile one kernel shape per listed
+        step for a loaded block AHEAD of traffic (runner start / store
+        re-ship), so serving queries never pay an XLA compile mid-query.
+        With the persistent compile cache warm this is a handful of
+        disk loads. Best-effort by contract — a failed shape stops the
+        ladder but never fails serving; a dropped/re-tagged block is
+        `stale`."""
+        ent = cache.get(meta["key"])
         if ent is None or ent[0] != list(meta["tag"]):
             return "stale", {}, []
-        st = ent[1]
-        dim = st.vecs.shape[1]
-        k = int(meta.get("k", 10))
         warmed = []
-        for b in meta.get("buckets", (1,)):
-            b = int(b)
-            if b < 1:
+        for v in meta.get(field, (1,)):
+            v = int(v)
+            if v < 1:
                 continue
-            qs = np.zeros((b, dim), np.float32)
             try:
-                st.knn(qs, k)
-                warmed.append(b)
+                warm_one(ent[1], v)
+                warmed.append(v)
             except Exception:
-                break  # best-effort: prewarm must never fail serving
+                break
         return "ok", {"warmed": warmed}, []
+
+    def op_vec_prewarm(self, meta, bufs):
+        """Power-of-two query-bucket ladder for a vector store."""
+        k = int(meta.get("k", 10))
+
+        def warm(st, b):
+            st.knn(np.zeros((b, st.vecs.shape[1]), np.float32), k)
+
+        return self._prewarm_shapes(self.vec, meta, "buckets", warm)
+
+    # -- quantized graph-ANN blocks (device/annstore.py) --------------------
+
+    def _ann_install(self, key, tag, meta, graph, x8, arow, x2q):
+        from surrealdb_tpu.device.annstore import AnnStore
+
+        st = AnnStore(key, graph, x8, arow, x2q, meta["metric"],
+                      meta.get("cfg") or {})
+        st._ensure()
+        self.ann.pop(key, None)
+        self.ann[key] = (list(tag), st)
+        while len(self.ann) > MAX_ANN_STORES:
+            self.ann.popitem(last=False)
+        return "ok", {}, []
+
+    def op_ann_load(self, meta, bufs):
+        graph, x8, arow, x2q = bufs
+        return self._ann_install(meta["key"], meta["tag"], meta,
+                                 graph, x8, arow, x2q)
+
+    def op_ann_load_begin(self, meta, bufs):
+        key = meta["key"]
+        arow, x2q = bufs
+        n = arow.shape[0]
+        bufs_by_name = {
+            "graph": np.empty((n, int(meta["d_out"])), np.int32),
+            "x8": np.empty((n, int(meta["dim"])), np.int8),
+            "arow": arow,
+            "x2q": x2q,
+        }
+        self._ann_staging[key] = (dict(meta), bufs_by_name)
+        return "ok", {}, []
+
+    def op_ann_load_part(self, meta, bufs):
+        ent = self._ann_staging.get(meta["key"])
+        if ent is None:
+            return "stale", {}, []
+        target = ent[1][meta["buf"]]
+        off = int(meta["off"])
+        (chunk,) = bufs
+        target[off:off + chunk.shape[0]] = chunk
+        return "ok", {}, []
+
+    def op_ann_load_end(self, meta, bufs):
+        key = meta["key"]
+        ent = self._ann_staging.pop(key, None)
+        if ent is None:
+            return "stale", {}, []
+        lmeta, by_name = ent
+        return self._ann_install(
+            key, meta["tag"], lmeta, by_name["graph"], by_name["x8"],
+            by_name["arow"], by_name["x2q"],
+        )
+
+    def op_ann_drop(self, meta, bufs):
+        self.ann.pop(meta["key"], None)
+        self._ann_staging.pop(meta["key"], None)
+        return "ok", {}, []
+
+    def op_ann_search(self, meta, bufs):
+        ent = self.ann.get(meta["key"])
+        if ent is None or ent[0] != list(meta["tag"]):
+            return "stale", {}, []
+        self.ann.move_to_end(meta["key"])
+        cand = ent[1].search(bufs[0], int(meta["kc"]))
+        return "ok", {"mode": "cand"}, [cand]
+
+    def op_ann_prewarm(self, meta, bufs):
+        """Query-bucket ladder for an ANN index's descent kernel."""
+        kc = int(meta.get("kc", 40))
+
+        def warm(st, b):
+            st.search(np.zeros((b, st.x8.shape[1]), np.float32), kc)
+
+        return self._prewarm_shapes(self.ann, meta, "buckets", warm)
 
     def op_csr_load(self, meta, bufs):
         from surrealdb_tpu.device.csrstore import CsrStore
@@ -177,6 +267,19 @@ class DeviceHost:
             bufs[0], int(meta["hops"]), bool(meta["union"])
         )
         return "ok", {}, [mask]
+
+    def op_csr_prewarm(self, meta, bufs):
+        """Hop-depth ladder for a CSR graph: the first `->edge->`
+        expansion after a ship/restart must not pay an XLA compile
+        mid-query (the sql_graph_3hop bench measured 11.4 s of
+        first-query tax)."""
+
+        def warm(st, hops):
+            start = np.zeros((1, st.n_nodes), np.uint8)
+            for union in (False, True):
+                st.multi_hop(start, hops, union)
+
+        return self._prewarm_shapes(self.csr, meta, "hops", warm)
 
     def op_brute_knn(self, meta, bufs):
         """One-shot exact KNN over ephemeral rows (planner brute path —

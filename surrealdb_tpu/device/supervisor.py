@@ -275,12 +275,18 @@ class DeviceSupervisor:
         if (op == "vec_load"
                 and bufs[0].nbytes > self.LOAD_PART_BYTES):
             self._multipart_vec_load(key, tag, meta, bufs[0], bufs[1])
+        elif (op == "ann_load"
+                and sum(b.nbytes for b in bufs) > self.LOAD_PART_BYTES):
+            self._multipart_ann_load(key, tag, meta, bufs)
         else:
             self.call(op, meta, bufs, timeout_s=self.load_timeout_s)
         with self._lock:
             self._loaded[key] = tag
-        if op in ("vec_load",) and self.mode != "inline":
-            self._prewarm_async(key, tag)
+        if self.mode != "inline":
+            kind = {"vec_load": "vec", "ann_load": "ann",
+                    "csr_load": "csr"}.get(op)
+            if kind is not None:
+                self._prewarm_async(key, tag, kind)
 
     def _multipart_vec_load(self, key, tag, meta, vecs, valid):
         begin = dict(meta)
@@ -302,30 +308,67 @@ class DeviceSupervisor:
         if t == "stale":
             raise self.unavailable("runner lost mid-load")
 
-    def _prewarm_async(self, key: str, tag):
-        """Fire-and-forget compile of the power-of-two query-bucket
-        ladder for a freshly shipped store (SURREAL_DEVICE_PREWARM_
-        BUCKETS). Runs on a daemon thread so the shipping query isn't
-        held; with the persistent compile cache warm it's near-free.
-        Best-effort by contract — any failure only costs warmth."""
-        raw = cnf.env_str("SURREAL_DEVICE_PREWARM_BUCKETS",
-                          cnf.DEVICE_PREWARM_BUCKETS)
+    def _multipart_ann_load(self, key, tag, meta, bufs):
+        """Chunked ship of a quantized ANN index: begin carries the
+        small per-row arrays + shapes, the graph and the int8 rows
+        stream as named row-chunked parts (a 10M×768 index is ~9 GB —
+        no single frame, and no transient copy, holds it whole)."""
+        graph, x8, arow, x2q = bufs
+        begin = dict(meta)
+        begin["d_out"] = int(graph.shape[1])
+        begin["dim"] = int(x8.shape[1])
+        self.call("ann_load_begin", begin, [arow, x2q],
+                  timeout_s=self.load_timeout_s)
+        for name, arr in (("graph", graph), ("x8", x8)):
+            row_bytes = max(1, arr.shape[1] * arr.dtype.itemsize)
+            step = max(1, self.LOAD_PART_BYTES // row_bytes)
+            for off in range(0, arr.shape[0], step):
+                t, _m, _b = self.call(
+                    "ann_load_part",
+                    {"key": key, "buf": name, "off": off},
+                    [arr[off:off + step]],
+                    timeout_s=self.load_timeout_s,
+                )
+                if t == "stale":  # runner restarted mid-ship
+                    raise self.unavailable("runner lost mid-load")
+        t, _m, _b = self.call("ann_load_end", {"key": key, "tag": tag},
+                              timeout_s=self.load_timeout_s)
+        if t == "stale":
+            raise self.unavailable("runner lost mid-load")
+
+    def _prewarm_async(self, key: str, tag, kind: str = "vec"):
+        """Fire-and-forget compile of the kernel ladder for a freshly
+        shipped store: the power-of-two query-bucket ladder for vector
+        and ANN blocks (SURREAL_DEVICE_PREWARM_BUCKETS), the hop-depth
+        ladder for CSR graphs (SURREAL_DEVICE_PREWARM_HOPS). Runs on a
+        daemon thread so the shipping query isn't held; with the
+        persistent compile cache warm it's near-free. Best-effort by
+        contract — any failure only costs warmth."""
+        if kind == "csr":
+            op, field = "csr_prewarm", "hops"
+            raw = cnf.env_str("SURREAL_DEVICE_PREWARM_HOPS",
+                              cnf.DEVICE_PREWARM_HOPS)
+        else:
+            op = "ann_prewarm" if kind == "ann" else "vec_prewarm"
+            field = "buckets"
+            raw = cnf.env_str("SURREAL_DEVICE_PREWARM_BUCKETS",
+                              cnf.DEVICE_PREWARM_BUCKETS)
         try:
-            buckets = [int(x) for x in raw.split(",") if x.strip()]
+            steps = [int(x) for x in raw.split(",") if x.strip()]
         except ValueError:
-            buckets = []
-        if not buckets:
+            steps = []
+        if not steps:
             return
 
         def warm():
-            # one bucket per dispatch, smallest first: each call stays
+            # one shape per dispatch, smallest first: each call stays
             # well inside the load window, so a slow compile can never
             # be misclassified as a wedged runner
-            for b in sorted(set(buckets)):
+            for b in sorted(set(steps)):
                 try:
                     t, _m, _b = self.call(
-                        "vec_prewarm",
-                        {"key": key, "tag": list(tag), "buckets": [b]},
+                        op,
+                        {"key": key, "tag": list(tag), field: [b]},
                         timeout_s=self.load_timeout_s,
                     )
                 except Exception:
@@ -368,6 +411,7 @@ class DeviceSupervisor:
             "last_error": self.last_error,
             "vec_blocks": sum(1 for k in loaded if k.startswith("vec/")),
             "csr_blocks": sum(1 for k in loaded if k.startswith("csr/")),
+            "ann_blocks": sum(1 for k in loaded if k.startswith("ann/")),
             "compile_cache": self.compile_counts_now(),
         }
         if self.compile_cache_info is not None:
@@ -378,6 +422,7 @@ class DeviceSupervisor:
         if self.mode == "inline" and self._inline_host is not None:
             out["vec_blocks"] = len(self._inline_host.vec)
             out["csr_blocks"] = len(self._inline_host.csr)
+            out["ann_blocks"] = len(self._inline_host.ann)
         return out
 
     def compile_counts_now(self) -> dict:

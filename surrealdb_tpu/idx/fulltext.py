@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import math
 import re as _re
+import time
 
 from surrealdb_tpu import key as K
 from surrealdb_tpu.catalog import AnalyzerDef
@@ -230,6 +231,13 @@ def _stats_key(ns, db, tb, ix):
     return K.ix_state(ns, db, tb, ix, b"bs")
 
 
+def _ver_key(ns, db, tb, ix):
+    # monotone write counter: the search-result cache's invalidation
+    # token (read through the caller's txn, so an uncommitted write in
+    # the SAME txn already misses the cache)
+    return K.ix_state(ns, db, tb, ix, b"bv")
+
+
 def fulltext_index_update(idef, rid: RecordId, before, after, ctx):
     ns, db = ctx.need_ns_db()
     tb = rid.tb
@@ -268,6 +276,14 @@ def fulltext_index_update(idef, rid: RecordId, before, after, ctx):
         stats["docs"] += 1
         stats["total_len"] += new_len
     ctx.txn.set_val(_stats_key(ns, db, tb, ix), stats)
+    cur = ctx.txn.get_val(_ver_key(ns, db, tb, ix))
+    if cur is None:
+        # generation base, not 0: REMOVE INDEX + DEFINE INDEX wipes this
+        # key, and a plain counter could climb back to a previously
+        # cached value — a wall-clock base makes versions from different
+        # index generations disjoint, on every node that shares the KV
+        cur = time.time_ns()
+    ctx.txn.set_val(_ver_key(ns, db, tb, ix), cur + 1)
 
 
 # ---------------------------------------------------------------------------
@@ -275,17 +291,112 @@ def fulltext_index_update(idef, rid: RecordId, before, after, ctx):
 # ---------------------------------------------------------------------------
 
 
-def ft_search(idef, query: str, ctx, boolean: str = "AND"):
-    """Returns ordered [(rid, score)] plus per-term match offsets.
+class FtResult:
+    """One search's shared, read-only result: hits/offsets plus lazily
+    derived lookup structures (score map, rid map, ordered rid list)
+    that the match planner and the score pseudo-functions reuse —
+    consumers MUST NOT mutate any of these."""
 
-    Memoized per statement (ctx.record_cache): the planner's match-
-    context registration, the access-path analysis, and the scan itself
-    all ask for the same search — one execution serves all three."""
+    __slots__ = ("hits", "offsets", "_scores", "_rid_map", "_ordered")
+
+    def __init__(self, hits, offsets):
+        self.hits = hits
+        self.offsets = offsets
+        self._scores = None
+        self._rid_map = None
+        self._ordered = None
+
+    @property
+    def scores(self) -> dict:
+        s = self._scores
+        if s is None:
+            s = self._scores = {hashable(r): sc for r, sc in self.hits}
+        return s
+
+    @property
+    def rid_map(self) -> dict:
+        m = self._rid_map
+        if m is None:
+            m = self._rid_map = {hashable(r): r for r, _s in self.hits}
+        return m
+
+    @property
+    def ordered(self) -> list:
+        o = self._ordered
+        if o is None:
+            o = self._ordered = [r for r, _s in self.hits]
+        return o
+
+
+def ft_result(idef, query: str, ctx, boolean: str = "AND") -> FtResult:
+    """The memoized search. Two levels: per statement
+    (ctx.record_cache) — the planner's match-context registration, the
+    access-path analysis, and the scan itself all ask for the same
+    search, one execution serves all three; and per datastore, keyed by
+    the index's write-version counter plus the index definition's
+    scoring fingerprint — repeated identical queries (the hybrid-RRF
+    serving shape) skip the posting walk entirely until the next index
+    write."""
     ck = ("__ft__", idef.tb, idef.name, query, boolean)
     hit = ctx.record_cache.get(ck)
     if hit is not None:
         return hit
-    out = _ft_search_impl(idef, query, ctx, boolean)
+    ns, db = ctx.need_ns_db()
+    tb, ix = idef.tb, idef.name
+    ver = ctx.txn.get_val(_ver_key(ns, db, tb, ix)) or 0
+    cache = getattr(ctx.ds, "_ft_cache", None)
+    if cache is None:
+        cache = ctx.ds._ft_cache = {}
+    ftp = idef.fulltext or {}
+    # fingerprint the analyzer DEFINITION, not its name: DEFINE
+    # ANALYZER ... OVERWRITE changes tokenization without touching the
+    # index write-version, and a name-keyed entry would serve the old
+    # generation's hits
+    az = get_analyzer(ftp.get("analyzer"), ctx)
+    az_fp = (tuple(az.tokenizers or ()),
+             tuple(tuple(f) if isinstance(f, (list, tuple)) else f
+                   for f in (az.filters or ())),
+             az.function)
+    fp = (az_fp, tuple(ftp.get("bm25") or ()),
+          tuple(idef.cols_str or ()))
+    gk = (ns, db, tb, ix, query, boolean, fp)
+    ent = cache.get(gk)
+    if ent is not None and ent[0] == ver:
+        res = ent[1]
+    else:
+        res = FtResult(*_ft_search_impl(idef, query, ctx, boolean))
+        # never populate from a write txn: its uncommitted view must not
+        # become visible to committed readers under a version it might
+        # never commit (reads are safe — this txn's own index writes
+        # bumped `ver`, so they can't hit a stale entry)
+        if not getattr(ctx.txn, "write", False):
+            if len(cache) >= 512:
+                cache.clear()
+            cache[gk] = (ver, res)
+    ctx.record_cache[ck] = res
+    return res
+
+
+def ft_search(idef, query: str, ctx, boolean: str = "AND"):
+    """Compatibility surface: ordered [(rid, score)] + match offsets."""
+    res = ft_result(idef, query, ctx, boolean)
+    return res.hits, res.offsets
+
+
+def _doc_lengths(ctx, ns, db, tb, ix) -> dict:
+    """enc(rid_id) -> BM25 doc length for the whole index, loaded with
+    ONE prefix scan and memoized per statement (ctx.record_cache). The
+    old per-(term, doc) `get_val` pattern dominated hybrid-query
+    latency: a 300-match posting paid 300 key encodes + tree lookups
+    per query."""
+    ck = ("__ftdl__", tb, ix)
+    hit = ctx.record_cache.get(ck)
+    if hit is not None:
+        return hit
+    pre = K.ix_state(ns, db, tb, ix, b"bl")
+    beg, end = K.prefix_range(pre)
+    plen = len(pre)
+    out = {bytes(k[plen:]): v for k, v in ctx.txn.scan_vals(beg, end)}
     ctx.record_cache[ck] = out
     return out
 
@@ -307,12 +418,39 @@ def _ft_search_impl(idef, query: str, ctx, boolean: str = "AND"):
     }
     n_docs = max(stats["docs"], 1)
     avg_len = stats["total_len"] / n_docs if n_docs else 1.0
+    # peek: the posting maps are read-only here, and the fresh-copy
+    # contract of get_val costs a full copy of every entry per query
+    posts = {
+        t: ctx.txn.peek_val(_post_key(ns, db, tb, ix, t)) or {}
+        for t in dict.fromkeys(terms)
+    }
+    total_matches = sum(len(p) for p in posts.values())
+    if total_matches >= 512 or total_matches * 8 >= n_docs:
+        # broad result set: ONE prefix scan of the doc-length keyspace
+        # amortizes across the matches
+        dls = _doc_lengths(ctx, ns, db, tb, ix)
+
+        def dl_get(ridk, rid_id):
+            return dls.get(ridk) or 0
+    else:
+        # selective query (rare terms on a big index): O(matches)
+        # point reads beat an O(n_docs) scan
+        _dl_memo: dict = {}
+
+        def dl_get(ridk, rid_id):
+            v = _dl_memo.get(ridk)
+            if v is None:
+                v = _dl_memo[ridk] = (
+                    ctx.txn.get_val(_len_key(ns, db, tb, ix, rid_id))
+                    or 0
+                )
+            return v
+
     scores: dict = {}
     rids: dict = {}
     offsets: dict = {}
     matched_all: dict = {}
-    for t in dict.fromkeys(terms):
-        post = ctx.txn.get_val(_post_key(ns, db, tb, ix, t)) or {}
+    for t, post in posts.items():
         df = len(post)
         if df == 0:
             continue
@@ -320,7 +458,7 @@ def _ft_search_impl(idef, query: str, ctx, boolean: str = "AND"):
         # lower-bounded tf' = 1 + ln(tf)
         idf = max(math.log((n_docs - df + 0.5) / (df + 0.5)), 0.0)
         for ridk, (tf, offs, rid_id) in post.items():
-            dl = ctx.txn.get_val(_len_key(ns, db, tb, ix, rid_id)) or 0
+            dl = dl_get(ridk, rid_id)
             if idf == 0.0 or tf <= 0:
                 s = 0.0
             else:
@@ -360,8 +498,7 @@ def plan_matches(tb, cond, mts, indexes, ctx, stmt):
     ft_ctx = dict(ctx.vars.get("__ft__") or {})
     ctx.vars["__ft__"] = ft_ctx
     seen_refs = set()
-    common = None
-    rid_objs = {}
+    results = []
     rest = cond
     for mt in mts:
         path = _field_path(mt.lhs)
@@ -379,37 +516,70 @@ def plan_matches(tb, cond, mts, indexes, ctx, stmt):
         q = evaluate(mt.rhs, ctx)
         pre = (ctx.vars.get("__ft__") or {}).get(("node", id(mt)))
         if pre is not None and pre["idef"].name == idef.name \
-                and pre["query"] == str(q) and "hits" in pre:
+                and pre["query"] == str(q) and pre.get("res") is not None:
             # plan_scan pre-registered this node's search (planner
             # _register_match_contexts) — reuse instead of re-searching
-            hits, offsets = pre["hits"], pre["offsets"]
+            res = pre["res"]
         else:
-            hits, offsets = ft_search(idef, str(q), ctx, boolean=mt.boolean)
+            res = ft_result(idef, str(q), ctx, boolean=mt.boolean)
         ref = mt.ref if mt.ref is not None else 0
         if ref in seen_refs:
             raise SdbError(f"Duplicated Match reference: {ref}")
         seen_refs.add(ref)
         ft_ctx[ref] = {
-            "scores": {hashable(r): s for r, s in hits},
-            "offsets": offsets,
+            "scores": res.scores,
+            "offsets": res.offsets,
             "idef": idef,
             "query": str(q),
+            "res": res,
         }
-        keys = {hashable(r) for r, _s in hits}
-        for r, _s in hits:
-            rid_objs.setdefault(hashable(r), r)
-        common = keys if common is None else (common & keys)
+        results.append(res)
         rest = _remove_node(rest, mt)
-    ordered = []
-    seen = set()
-    # node-keyed tuple entries are aliases for filter evaluation; the
-    # ordered result union walks the numeric ref entries only
-    for ref in sorted(k for k in ft_ctx if isinstance(k, int)):
-        entry = ft_ctx[ref]
-        for h in entry["scores"]:
-            if h in common and h not in seen:
-                seen.add(h)
-                ordered.append(rid_objs[h])
+    if len(results) == 1:
+        # the common case pays zero set/dict building: the shared
+        # result's ordered rid list IS the scan order (score-desc)
+        ordered = results[0].ordered
+    else:
+        common = None
+        for res in results:
+            common = (set(res.scores.keys()) if common is None
+                      else common & res.scores.keys())
+        ordered = []
+        seen = set()
+        # h ∈ common ⇒ present in every current result, so rid objects
+        # always resolve through the first result's map
+        rid_map = results[0].rid_map
+        # node-keyed tuple entries are aliases for filter evaluation;
+        # the ordered result union walks the numeric ref entries only
+        for ref in sorted(k for k in ft_ctx if isinstance(k, int)):
+            entry = ft_ctx[ref]
+            for h in entry["scores"]:
+                if h in common and h not in seen:
+                    seen.add(h)
+                    ordered.append(rid_map[h])
+
+    if rest is None and _score_only_projection(stmt, ctx):
+        # projection (and ORDER BY) touch only `id` + search::* pseudo-
+        # functions, which read the match context, not the document:
+        # skip the per-row record fetch entirely (keys-only FT scan —
+        # the dominant host cost of the hybrid RRF shape, where a
+        # 300-match leg paid 300 record fetches per query)
+        lim = _ft_order_limit(stmt, mts, ctx)
+        if lim is not None:
+            # ORDER BY <that score> DESC LIMIT n over a single MATCHES
+            # re-sorts the order the search already produced (hits are
+            # score-descending, the scores dict preserves it): truncate
+            # BEFORE projection so only n rows pay the pipeline, not
+            # every match. The pipeline still sorts/limits the survivors
+            # (a stable no-op).
+            ordered = ordered[:lim]
+
+        def gen_keys():
+            for rid in ordered:
+                yield Source(rid=rid, doc={"id": rid})
+
+        ctx._cond_consumed = True
+        return gen_keys()
 
     def gen():
         for rid in ordered:
@@ -424,6 +594,73 @@ def plan_matches(tb, cond, mts, indexes, ctx, stmt):
 
     ctx._cond_consumed = True
     return gen()
+
+
+def _ft_order_limit(stmt, mts, ctx):
+    """LIMIT value when `ORDER BY <score> DESC LIMIT n` (no START) can
+    be absorbed into the single-MATCHES scan order, else None. Valid
+    only when the one ORDER key is search::score(ref) — directly or via
+    its projection alias — for the statement's single match predicate:
+    the scan already yields score-descending rows, so the sort is a
+    stable no-op and the limit can truncate before projection."""
+    from surrealdb_tpu.exec.eval import evaluate
+    from surrealdb_tpu.exec.statements import expr_name
+    from surrealdb_tpu.expr.ast import FunctionCall
+
+    if (stmt is None or len(mts) != 1 or getattr(stmt, "start", None)
+            is not None or getattr(stmt, "limit", None) is None):
+        return None
+    order = getattr(stmt, "order", None)
+    if not order or order == "rand" or len(order) != 1:
+        return None
+    oexpr, d, collate, numeric = order[0]
+    if d != "desc" or collate or numeric:
+        return None
+    target = oexpr
+    if not isinstance(target, FunctionCall):
+        # resolve a projection alias to its expression
+        name = expr_name(oexpr)
+        target = None
+        for e, a in (stmt.exprs or []):
+            if e != "*" and (a or expr_name(e)) == name:
+                target = e
+                break
+        if stmt.value is not None and getattr(stmt, "value_alias", None) \
+                == name:
+            target = stmt.value
+    if not (isinstance(target, FunctionCall)
+            and target.name == "search::score"):
+        return None
+    try:
+        ref = int(evaluate(target.args[0], ctx)) if target.args else 0
+    except (SdbError, TypeError, ValueError, IndexError):
+        return None
+    if ref != (mts[0].ref if mts[0].ref is not None else 0):
+        return None
+    try:
+        lim = evaluate(stmt.limit, ctx)
+        lim = int(lim)
+    except (SdbError, TypeError, ValueError):
+        return None
+    return lim if lim >= 0 else None
+
+
+def _ft_safe_expr(expr) -> bool:
+    """Projections derivable from the match context alone: `id` and the
+    search::score pseudo-function (reads ctx __ft__, not the doc)."""
+    from surrealdb_tpu.expr.ast import FunctionCall
+    from surrealdb_tpu.idx.planner import _field_path
+
+    if _field_path(expr) == "id":
+        return True
+    return isinstance(expr, FunctionCall) and expr.name == "search::score"
+
+
+def _score_only_projection(stmt, ctx) -> bool:
+    from surrealdb_tpu.idx.planner import _pseudo_only_projection
+
+    return _pseudo_only_projection(stmt, ctx, _ft_safe_expr,
+                                   allow_order=True)
 
 
 def matches_operator(n, ctx):

@@ -218,25 +218,27 @@ def _ft_branch_scan(tb, br, ctx):
     MATCHES evaluates by membership), and yield the hits."""
     from surrealdb_tpu.exec.eval import evaluate, fetch_record
     from surrealdb_tpu.exec.statements import Source
-    from surrealdb_tpu.idx.fulltext import ft_search
+    from surrealdb_tpu.idx.fulltext import ft_result
 
     mt = br["mt"]
     idef = br["idef"]
     q = evaluate(mt.rhs, ctx)
     pre = (ctx.vars.get("__ft__") or {}).get(("node", id(mt)))
     if pre is not None and pre["idef"].name == idef.name \
-            and pre["query"] == str(q) and "hits" in pre:
-        hits, offsets = pre["hits"], pre["offsets"]
+            and pre["query"] == str(q) and pre.get("res") is not None:
+        res = pre["res"]
     else:
-        hits, offsets = ft_search(idef, str(q), ctx, boolean=mt.boolean)
+        res = ft_result(idef, str(q), ctx, boolean=mt.boolean)
+    hits = res.hits
     ft_ctx = dict(ctx.vars.get("__ft__") or {})
     ctx.vars["__ft__"] = ft_ctx
     ref = mt.ref if mt.ref is not None else 0
     entry = {
-        "scores": {hashable(r): s for r, s in hits},
-        "offsets": offsets,
+        "scores": res.scores,
+        "offsets": res.offsets,
         "idef": idef,
         "query": str(q),
+        "res": res,
     }
     ft_ctx[ref] = entry
     # per-node key: two OR branches may share the default ref 0 (the AND
@@ -701,7 +703,7 @@ def _register_match_contexts(tb, cond, ctx):
     if not nodes:
         return
     from surrealdb_tpu.exec.eval import evaluate
-    from surrealdb_tpu.idx.fulltext import ft_search
+    from surrealdb_tpu.idx.fulltext import ft_result
 
     indexes = get_indexes_for(tb, ctx)
     ft_ctx = dict(ctx.vars.get("__ft__") or {})
@@ -723,13 +725,13 @@ def _register_match_contexts(tb, cond, ctx):
             # the node-keyed entry below keeps membership exact per node
             # (plan_matches still rejects duplicates among AND-planned
             # matches, matching the reference's executor error)
-        hits, offsets = ft_search(idef, q, ctx, boolean=mt.boolean)
+        res = ft_result(idef, q, ctx, boolean=mt.boolean)
         entry = {
-            "scores": {hashable(r): s for r, s in hits},
-            "offsets": offsets,
+            "scores": res.scores,
+            "offsets": res.offsets,
             "idef": idef,
             "query": q,
-            "hits": hits,
+            "res": res,
         }
         if prev is None:
             ft_ctx[ref] = entry
@@ -1010,11 +1012,14 @@ def _knn_safe_expr(expr) -> bool:
     ) and not expr.args
 
 
-def _id_only_projection(stmt, ctx) -> bool:
-    """True when a KNN SELECT's output is derivable from the index result
-    alone (rids + distances): `SELECT id`, `SELECT VALUE id`, optionally
-    with vector::distance::knn(). Lets the scan skip per-row record
-    fetches — the dominant host cost for high-QPS KNN serving."""
+def _pseudo_only_projection(stmt, ctx, safe_expr, allow_order=False) -> bool:
+    """True when a SELECT's output is derivable from an index result
+    alone (rids + per-rid pseudo-function contexts): every projection is
+    `id` or a `safe_expr` pseudo-function. Lets the scan skip per-row
+    record fetches — the dominant host cost for high-QPS index serving.
+    With `allow_order`, ORDER BY keys may be safe expressions or
+    projection aliases (aliases re-evaluate their — safe — expressions
+    against the keys-only row, exec/statements._apply_order_sources)."""
     from surrealdb_tpu.expr.ast import SelectStmt
 
     if not isinstance(stmt, SelectStmt) or not ctx.session.is_owner:
@@ -1023,12 +1028,30 @@ def _id_only_projection(stmt, ctx) -> bool:
             or stmt.version is not None or stmt.explain):
         return False
     if stmt.order:  # ORDER BY may reference arbitrary fields
-        return False
+        if not allow_order or stmt.order == "rand":
+            return False
+        from surrealdb_tpu.exec.statements import expr_name
+
+        aliases = set()
+        for e, a in (stmt.exprs or []):
+            if e != "*":
+                aliases.add(a or expr_name(e))
+        for item in stmt.order:
+            oexpr = item[0]
+            if safe_expr(oexpr) or expr_name(oexpr) in aliases:
+                continue
+            return False
     if stmt.value is not None:
-        return not stmt.exprs and _knn_safe_expr(stmt.value)
+        return not stmt.exprs and safe_expr(stmt.value)
     if not stmt.exprs:
         return False
-    return all(_knn_safe_expr(e) for e, _a in stmt.exprs)
+    return all(safe_expr(e) for e, _a in stmt.exprs)
+
+
+def _id_only_projection(stmt, ctx) -> bool:
+    """The KNN shape of `_pseudo_only_projection`: `SELECT id` /
+    `SELECT VALUE id`, optionally with vector::distance::knn()."""
+    return _pseudo_only_projection(stmt, ctx, _knn_safe_expr)
 
 
 def _plan_knn(tb, cond, knn: Knn, indexes, ctx, stmt):
@@ -1282,15 +1305,21 @@ def explain_plan(tb, cond, ctx, stmt):
                     ef = knn.ef
                     if ef is None and knn.dist is not None:
                         ef = idef.hnsw.get("ef_construction", 150)
+                    from surrealdb_tpu.idx.vector import get_vector_index
+
+                    eng = get_vector_index(idef, ctx)
+                    plan = {
+                        "index": idef.name,
+                        "operator": f"<|{knn.k},{ef or 40}|>",
+                        "value": qval,
+                    }
+                    if eng._ann_route(knn.k) is not None:
+                        # the size/metric gate routed this store to the
+                        # quantized graph index (int8 descent + exact
+                        # re-rank) instead of the brute scan
+                        plan["ann"] = "graph"
                     return {
-                        "detail": {
-                            "plan": {
-                                "index": idef.name,
-                                "operator": f"<|{knn.k},{ef or 40}|>",
-                                "value": qval,
-                            },
-                            "table": tb,
-                        },
+                        "detail": {"plan": plan, "table": tb},
                         "operation": "Iterate Index",
                     }
             return {

@@ -231,6 +231,27 @@ class TpuVectorIndex:
         # per-epoch host scoring stats (row norms / squared norms) for
         # the batched BLAS host path; rebuilt lazily after cache sync
         self._host_stats = None
+        # quantized graph-ANN overlay (idx/cagra.py): built from a host
+        # snapshot for stores past cnf.KNN_ANN_MIN_ROWS, searched by
+        # int8 greedy descent + exact re-rank. The flat graph + int8
+        # arrays ship to the runner under their own (key, tag) blocks.
+        self._ann = None           # built cagra.AnnIndex
+        self._ann_state = "idle"   # idle | building | ready
+        # rows overwritten since the graph snapshot, stamped with the
+        # mutation counter at overwrite time: a build only un-dirties
+        # rows whose stamp predates its snapshot (a row overwritten
+        # AGAIN mid-build keeps brute-merging)
+        self._ann_dirty: dict = {}
+        self._ann_mut = 0          # overwrite stamp counter
+        # tombstones since the snapshot: deletions poison graph slots
+        # (the re-rank filters them), so they count toward staleness
+        # like appends/overwrites do
+        self._ann_dead = 0
+        self._ann_dead_base = 0
+        self._ann_gen = 0          # bumped on full repack (row remap)
+        self._ann_seq = 0          # device block tag for shipped builds
+        self._ann_lock = threading.Lock()
+        self._ann_dev_key = f"ann/{uuid.uuid4().hex[:16]}"
         self.coalescer = _Coalescer(self)
 
     # -- cache sync ---------------------------------------------------------
@@ -238,7 +259,15 @@ class TpuVectorIndex:
         """Bring the device block cache up to the KV truth: small gaps apply
         the op log incrementally (append + tombstone); big gaps or heavy
         fragmentation trigger a full repack (the reference's two-phase
-        pending/compaction design, hnsw/index.rs)."""
+        pending/compaction design, hnsw/index.rs). A store that crossed
+        the ANN threshold (or whose graph went stale) kicks a background
+        graph build afterwards — brute force serves until it lands."""
+        try:
+            self._sync_impl(ctx)
+        finally:
+            self._maybe_build_ann()
+
+    def _sync_impl(self, ctx):
         ns, db, tb, ix = self.key
         vkey = K.ix_state(ns, db, tb, ix, b"vn")
         ver = ctx.txn.get_val(vkey) or 0
@@ -271,21 +300,45 @@ class TpuVectorIndex:
             return False  # log incomplete (e.g. trimmed) — rebuild instead
         add_rows = []
         add_rids = []
+        add_valid = []
         for _k, (op, idv, raw) in entries:
             h = K.enc_value(idv)
             row = self.row_index.get(h)
             if op == "del":
-                if row is not None and row < len(self.valid):
+                if row is None:
+                    continue
+                if row < len(self.valid):
+                    if self.valid[row]:
+                        self._ann_dead += 1
                     self.valid[row] = False
+                else:
+                    # the row was appended EARLIER IN THIS BATCH and is
+                    # still in the pending buffers — dropping the
+                    # tombstone here would resurrect it forever
+                    ai = row - len(self.rids)
+                    if 0 <= ai < len(add_valid):
+                        add_valid[ai] = False
                 continue
             vec = np.frombuffer(raw, dtype=self.dtype)
             if row is not None and row < len(self.vecs):
                 self.vecs[row] = vec
                 self.valid[row] = True
+                # the ANN graph/int8 snapshot no longer matches this
+                # row: brute-merge it at query time until a rebuild
+                self._ann_mut += 1
+                self._ann_dirty[row] = self._ann_mut
+            elif row is not None:
+                # overwrite of a same-batch append: update the pending
+                # buffer in place (a second append would leave a stale
+                # duplicate row permanently valid)
+                ai = row - len(self.rids)
+                add_rows[ai] = vec
+                add_valid[ai] = True
             else:
                 self.row_index[h] = len(self.rids) + len(add_rids)
                 add_rids.append(RecordId(tb, idv))
                 add_rows.append(vec)
+                add_valid.append(True)
         if add_rows:
             self.vecs = (
                 np.vstack([self.vecs, np.stack(add_rows)])
@@ -293,7 +346,7 @@ class TpuVectorIndex:
                 else np.stack(add_rows)
             )
             self.valid = np.concatenate(
-                [self.valid, np.ones(len(add_rows), bool)]
+                [self.valid, np.asarray(add_valid, bool)]
             )
             self.rids.extend(add_rids)
         self._drop_device()
@@ -330,12 +383,282 @@ class TpuVectorIndex:
         )
         self.valid = np.ones(len(rids), dtype=bool)
         self._drop_device()
+        # a repack remaps row ids: the ANN snapshot (graph ids, dirty
+        # rows, any build in flight) is void — discard and re-trigger
+        with self._ann_lock:
+            self._ann = None
+            self._ann_dirty = {}
+            self._ann_dead = 0
+            self._ann_dead_base = 0
+            self._ann_gen += 1
+            if self._ann_state == "ready":
+                self._ann_state = "idle"
         # trim the consumed op log when we can write (bounds log growth)
         if getattr(ctx.txn, "write", False):
             ver = ctx.txn.get_val(K.ix_state(ns, db, tb, ix, b"vn")) or 0
             beg = K.ix_state(ns, db, tb, ix, b"hl", K.enc_u64(0))
             end = K.ix_state(ns, db, tb, ix, b"hl", K.enc_u64(ver)) + b"\x00"
             ctx.txn.delete_range(beg, end)
+
+    # -- quantized graph-ANN overlay (idx/cagra.py) -------------------------
+
+    def _ann_floor(self):
+        """Row floor above which a graph build is scheduled, or None
+        when the ANN path is disabled for this index (mode off, or a
+        metric the MXU scoring recipe doesn't cover)."""
+        mode = cnf.KNN_ANN_MODE
+        if mode == "off" or self.metric not in (
+            "euclidean", "cosine", "dot"
+        ):
+            return None
+        if mode == "force":
+            return 256
+        return cnf.KNN_ANN_MIN_ROWS
+
+    def _ann_stale(self, ann, n) -> bool:
+        """Appended-tail + overwritten-row fraction past which the
+        graph is rebuilt. Until the rebuild lands those rows are
+        brute-ranked and merged per query, so results stay exact-
+        re-ranked either way — staleness is a throughput concern."""
+        drift = (n - ann.built_n) + len(self._ann_dirty) \
+            + max(self._ann_dead - self._ann_dead_base, 0)
+        return drift / max(n, 1) > cnf.KNN_ANN_TAIL_FRAC
+
+    def _maybe_build_ann(self):
+        floor = self._ann_floor()
+        if floor is None:
+            return
+        n = len(self.rids)
+        if n < floor:
+            return
+        ann = self._ann
+        if ann is not None and not self._ann_stale(ann, n):
+            return
+        with self._ann_lock:
+            if self._ann_state == "building":
+                return
+            self._ann_state = "building"
+        threading.Thread(target=self._build_ann, daemon=True,
+                         name="ann-build").start()
+
+    def ensure_ann(self) -> bool:
+        """Synchronous build entry (bench/tests): returns True when a
+        ready, non-stale graph serves searches of this store."""
+        import time as _time
+
+        floor = self._ann_floor()
+        n = len(self.rids)
+        if floor is None or n < floor:
+            return False
+        while True:
+            ann = self._ann
+            if ann is not None and not self._ann_stale(ann, n):
+                return True
+            with self._ann_lock:
+                if self._ann_state != "building":
+                    self._ann_state = "building"
+                    break
+            _time.sleep(0.05)  # a background build is running: wait
+        self._build_ann()
+        ann = self._ann
+        # honest answer: a failed rebuild leaves the old (stale) graph
+        # serving, which is NOT the fresh build this entry promises
+        return ann is not None and not self._ann_stale(ann, len(self.rids))
+
+    def _build_ann(self):
+        """Build the CAGRA graph + int8 arrays from a host snapshot.
+        Runs WITHOUT the index lock held through the build: the host
+        arrays are append-stable (the log applier grows them by
+        reallocation, so a captured reference keeps its length), and a
+        concurrent in-place overwrite lands in `_ann_dirty`, whose rows
+        are brute-merged at query time — a torn snapshot can never
+        surface a wrong distance, only a slightly worse candidate set.
+        A full repack bumps `_ann_gen`; a build that raced one is
+        discarded."""
+        from surrealdb_tpu.idx import cagra
+
+        with self.rw.read():
+            gen = self._ann_gen
+            xs = self.vecs
+            version, epoch = self.version, self._dev_epoch
+            mut_cut = self._ann_mut
+            dead0 = self._ann_dead
+        try:
+            ann = cagra.build_index(xs, self.metric, version, epoch)
+        except Exception:
+            with self._ann_lock:
+                self._ann_state = "idle"
+            return
+        with self._ann_lock:
+            if self._ann_gen != gen:
+                self._ann_state = "idle"  # repack raced: discard
+                return
+            self._ann = ann
+            self._ann_seq += 1
+            # rows dirtied BEFORE the snapshot hold their new values in
+            # xs (writers exclude the capture via the rw lock, so the
+            # build covered them); rows stamped after — overwritten
+            # DURING the build, possibly half-captured — stay dirty and
+            # keep brute-merging
+            self._ann_dirty = {
+                r: g for r, g in self._ann_dirty.items() if g > mut_cut
+            }
+            # deletions known at snapshot time are as absorbed as an
+            # ANN rebuild can make them (the rows leave the arrays only
+            # at the next full repack) — stop counting them as drift
+            self._ann_dead_base = dead0
+            self._ann_state = "ready"
+
+    def _ann_route(self, k: int):
+        """The ready AnnIndex when a k-NN search of `k` should ride the
+        graph path, else None (brute force — bit-for-bit the legacy
+        results). A stale-but-built graph keeps serving while its
+        replacement builds; the tail merge keeps results exact."""
+        if cnf.KNN_ANN_MODE == "off" or k > cnf.KNN_ANN_MAX_K:
+            return None
+        return self._ann
+
+    def _ann_search_cfg(self) -> dict:
+        w = max(int(cnf.KNN_ANN_SEARCH_WIDTH), 1)
+        width = 1
+        while width < w:
+            width *= 2  # pow2: descent kernel shapes stay a ladder
+        return {
+            "width": width,
+            "iters": max(int(cnf.KNN_ANN_ITERS), 1),
+            "expand": max(int(cnf.KNN_ANN_EXPAND), 1),
+        }
+
+    def _ann_device_search(self, ann, qs32: np.ndarray, kc: int):
+        """Descent candidates from the runner's AnnStore blocks; ships
+        the build snapshot on first use / after a runner restart via
+        the same (key, tag) protocol as the vector blocks — PR-4
+        crash/reship and the post-ship prewarm apply unchanged."""
+        from surrealdb_tpu.device import get_supervisor
+
+        sup = get_supervisor()
+        tag = [int(self._ann_seq), int(ann.built_version),
+               int(ann.built_epoch)]
+
+        def loader():
+            return "ann_load", {
+                "metric": ann.metric,
+                "cfg": self._ann_search_cfg(),
+            }, [
+                np.ascontiguousarray(ann.graph),
+                np.ascontiguousarray(ann.x8),
+                np.ascontiguousarray(ann.arow),
+                np.ascontiguousarray(ann.x2),
+            ]
+
+        for _attempt in (0, 1):
+            sup.ensure_loaded(self._ann_dev_key, tag, loader)
+            t, _meta, bufs = sup.call(
+                "ann_search",
+                {"key": self._ann_dev_key, "tag": tag, "kc": int(kc)},
+                [qs32],
+            )
+            if t == "stale":
+                sup.forget(self._ann_dev_key)
+                continue
+            break
+        else:
+            raise sup.unavailable("ann cache thrashing")
+        return bufs[0]
+
+    def _ann_extra_topk(self, ann, qvs, k: int, n: int):
+        """Per-query top-k ids over rows the graph snapshot can't see
+        (appended tail + overwritten rows), exact-scored; None when the
+        snapshot covers the store. Bounded by KNN_ANN_TAIL_FRAC — past
+        it `_ann_stale` schedules a rebuild."""
+        dirty = [r for r in list(self._ann_dirty) if r < ann.built_n]
+        if n <= ann.built_n and not dirty:
+            return None
+        extra = np.arange(ann.built_n, n, dtype=np.int64)
+        if dirty:
+            extra = np.concatenate(
+                [np.asarray(sorted(dirty), np.int64), extra]
+            )
+        # tombstoned rows must not crowd valid ones out of the top-k
+        # (the final re-rank would drop them, silently shrinking the
+        # exact tail coverage)
+        extra = extra[self.valid[extra]]
+        if not len(extra):
+            return None
+        rows = self.vecs[extra]
+        k_eff = min(k, len(extra))
+        out = []
+        for qv in qvs:
+            d = self._host_distances(qv, xs=rows)
+            if k_eff < len(extra):
+                sel = np.argpartition(d, k_eff - 1)[:k_eff]
+            else:
+                sel = np.arange(len(extra))
+            out.append(extra[sel])
+        return out
+
+    def _ann_knn_batch(self, ann, qvs: np.ndarray, k: int):
+        """Graph-ANN search: int8 greedy descent (the runner's jax
+        kernel, or its numpy mirror when the device is cold/degraded/
+        host-routed) proposes an oversampled candidate set per query;
+        rows outside the build snapshot are brute-ranked and merged;
+        the final top-k comes from the exact `_host_distances` ladder
+        over the union — every reported distance is exact, and the
+        quantized descent only decides which kc candidates get
+        considered (the AQR-style multi-stage re-rank)."""
+        from surrealdb_tpu.device import DeviceOpError, DeviceUnavailable
+        from surrealdb_tpu.idx import cagra
+
+        n = len(self.rids)
+        b = len(qvs)
+        kc = min(ann.built_n, max(cnf.KNN_ANN_OVERSAMPLE * k, 32))
+        qs32 = np.ascontiguousarray(np.asarray(qvs, np.float32))
+        cand = None
+        if self._use_device():
+            try:
+                cand = self._ann_device_search(ann, qs32, kc)
+            except (DeviceUnavailable, DeviceOpError):
+                cand = None  # degrade to the numpy descent below
+        if cand is None:
+            cfg = self._ann_search_cfg()
+            width = min(max(cfg["width"], kc), ann.built_n)
+            fn, probe_fn = cagra.int8_score_fn(ann, qs32)
+            cand = cagra.descend(
+                ann.graph, ann.built_n, fn, b, width, cfg["iters"],
+                min(cfg["expand"], width), kc, probe_fn=probe_fn,
+            )
+        extra_top = self._ann_extra_topk(ann, qvs, k, n)
+        out = []
+        for i in range(b):
+            ids_b = cand[i].astype(np.int64)
+            ids_b = ids_b[(ids_b >= 0) & (ids_b < n)]
+            if extra_top is not None:
+                ids_b = np.concatenate([ids_b, extra_top[i]])
+            ids_b = np.unique(ids_b)
+            d = self._host_distances(qvs[i], xs=self.vecs[ids_b])
+            d = np.where(self.valid[ids_b], d, np.inf)
+            k_eff = min(k, len(ids_b))
+            if k_eff == 0:
+                out.append([])
+                continue
+            sel = np.argpartition(d, k_eff - 1)[:k_eff]
+            sel = sel[np.argsort(d[sel], kind="stable")]
+            res_i = [
+                (self.rids[int(ids_b[j])], float(d[j]))
+                for j in sel
+                if np.isfinite(d[j])
+            ]
+            if len(res_i) < k:
+                # tombstone-dense neighborhood (e.g. a fully deleted
+                # cluster): graph candidates can underfill k while the
+                # store still holds enough valid rows — answer that
+                # query exactly rather than short (rare path; the
+                # staleness counter is already scheduling a rebuild
+                # when deletions accumulate)
+                if len(res_i) < min(k, int(self.valid.sum())):
+                    res_i = self._host_knn_single(qvs[i], k)
+            out.append(res_i)
+        return out
 
     # -- search -------------------------------------------------------------
     def knn(self, q, k: int, ctx, ef=None, cond=None, cond_ctx=None):
@@ -436,12 +759,19 @@ class TpuVectorIndex:
 
     def knn_batch(self, qvs: np.ndarray, k: int):
         """The raw batched engine entry: [B, D] queries -> per-query
-        (rid, dist) lists, routed to the device runner or the batched
-        exact host kernel by `_use_device`. This is the path the
-        cross-query batcher dispatches AND what bench.py measures as
+        (rid, dist) lists. A store with a built CAGRA graph routes
+        through int8 descent + exact re-rank (`_ann_knn_batch`);
+        everything else goes to the device runner or the batched exact
+        host kernel by `_use_device`. This is the path the cross-query
+        batcher dispatches AND what bench.py measures as
         `index_engine_qps` — the serving stack above it is pure tax.
         Device trouble raises DeviceUnavailable/DeviceOpError for the
-        batcher's per-rider degrade ladder."""
+        batcher's per-rider degrade ladder (the ANN path degrades
+        internally to its numpy descent instead — falling back to a
+        brute scan would forfeit the graph's 10× at the worst moment)."""
+        ann = self._ann_route(k)
+        if ann is not None:
+            return self._ann_knn_batch(ann, qvs, k)
         if self._use_device():
             return self._device_knn_batch(qvs, k)
         return self._host_knn_multi(qvs, k)

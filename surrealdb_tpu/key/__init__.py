@@ -350,6 +350,12 @@ def graph(ns, db, tb, id, direction: bytes, ft: str, fk) -> bytes:
     )
 
 
+def graph_tb_prefix(ns, db, tb) -> bytes:
+    """All graph (`~`) keys of every record in `tb` — one scan covers a
+    whole table's adjacency (CSR builds read keys, not edge docs)."""
+    return _tb(ns, db, tb) + b"~"
+
+
 def graph_node_prefix(ns, db, tb, id) -> bytes:
     return _tb(ns, db, tb) + b"~" + enc_value(id)
 

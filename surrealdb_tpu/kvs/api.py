@@ -114,6 +114,35 @@ _dec_cache_lock = threading.Lock()
 _DEC_MISS = object()  # stored NULL decodes to None — need a real sentinel
 
 
+def _decode_cached(b: bytes):
+    """Pristine decode of a wire-framed value through the decode cache:
+    returns (value, shared). `shared` means the value is (now) the
+    cache's pristine copy and MUST NOT be mutated by the caller."""
+    global _dec_cache_bytes
+    v = _dec_cache.get(b, _DEC_MISS)
+    if v is not _DEC_MISS:
+        return v, True
+    from surrealdb_tpu import wire
+
+    v = wire.decode(b[1:])
+    from surrealdb_tpu import cnf
+
+    cap = cnf.DECODE_CACHE_BYTES
+    if cap and len(b) <= (1 << 20):
+        # decoded Python values are ~8× their CBOR encoding resident;
+        # charge that multiple against the cap so the knob bounds RSS
+        charge = len(b) * 8
+        with _dec_cache_lock:
+            if b not in _dec_cache:
+                if _dec_cache_bytes + charge > cap:
+                    _dec_cache.clear()
+                    _dec_cache_bytes = 0
+                _dec_cache[bytes(b)] = v
+                _dec_cache_bytes += charge
+        return v, True
+    return v, False
+
+
 def deserialize(b: bytes):
     if b[:1] == b"\x01":
         # content-keyed decode cache: identical bytes always decode to the
@@ -121,32 +150,22 @@ def deserialize(b: bytes):
         # cached value stays pristine — callers get a deep copy (the doc
         # pipeline mutates records), which is ~25× cheaper than re-decoding
         # (repeated analytic scans re-read the same values every query).
-        global _dec_cache_bytes
-        v = _dec_cache.get(b, _DEC_MISS)
-        if v is not _DEC_MISS:
-            return copy_value(v)
-        from surrealdb_tpu import wire
-
-        v = wire.decode(b[1:])
-        from surrealdb_tpu import cnf
-
-        cap = cnf.DECODE_CACHE_BYTES
-        if cap and len(b) <= (1 << 20):
-            # decoded Python values are ~8× their CBOR encoding resident;
-            # charge that multiple against the cap so the knob bounds RSS
-            charge = len(b) * 8
-            with _dec_cache_lock:
-                if b not in _dec_cache:
-                    if _dec_cache_bytes + charge > cap:
-                        _dec_cache.clear()
-                        _dec_cache_bytes = 0
-                    _dec_cache[bytes(b)] = v
-                    _dec_cache_bytes += charge
-            return copy_value(v)
-        return v
+        v, shared = _decode_cached(b)
+        return copy_value(v) if shared else v
     if b[:1] == b"\x00":
         return _restricted_loads(b[1:])
     return _restricted_loads(b)
+
+
+def deserialize_shared(b: bytes):
+    """Decode WITHOUT the fresh-copy contract: returns the decode
+    cache's shared value when available — callers MUST NOT mutate the
+    result. Read-only hot paths (full-text posting reads, which pay a
+    300-entry copy_value per query through `deserialize`) use this via
+    `Txn.peek_val`."""
+    if b[:1] == b"\x01":
+        return _decode_cached(b)[0]  # no fresh-copy tax either way
+    return deserialize(b)
 
 
 class _RestrictedUnpickler(pickle.Unpickler):
@@ -341,7 +360,9 @@ class Transaction:
                     sv = shared[1].get(key, self._CAT_MISS)
                     if sv is not self._CAT_MISS:
                         return sv
-        return self.get_val(key)
+            return self.get_val(key)
+        raw = self.btx.get(key)
+        return None if raw is None else deserialize_shared(raw)
 
     def set_val(self, key: bytes, v) -> None:
         self.btx.set(key, serialize(v))

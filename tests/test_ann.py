@@ -1,0 +1,361 @@
+"""Quantized graph-ANN index (idx/cagra.py + device/annstore.py):
+
+- int8 quantization round-trip units (zero vectors, constant dims,
+  outlier magnitudes, density-aware clipping);
+- graph construction invariants (shape, id range, degenerate stores);
+- the recall property the index is gated on: int8 descent + exact
+  re-rank vs brute-force f32 ground truth, recall@10 >= 0.95 across
+  cosine/euclidean/dot at 50k/128d (100k/768d under -m slow);
+- descent determinism (same store, same epoch => same top-k);
+- live-store exactness: rows appended or overwritten after the graph
+  snapshot are brute-merged per query (exact immediately), and drift
+  past KNN_ANN_TAIL_FRAC triggers a rebuild.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from surrealdb_tpu import cnf
+from surrealdb_tpu.idx import cagra
+from surrealdb_tpu.val import RecordId
+
+
+def _mk_index(xs, metric):
+    from surrealdb_tpu.idx.vector import TpuVectorIndex
+
+    n, dim = xs.shape
+    ix = TpuVectorIndex("t", "t", "pts", "ix", {
+        "dimension": dim, "distance": metric, "vector_type": "f32",
+    })
+    ix.vecs = xs
+    ix.valid = np.ones(n, dtype=bool)
+    ix.rids = [RecordId("pts", i) for i in range(n)]
+    ix.version = 0
+    return ix
+
+
+# -- int8 quantization round-trip -------------------------------------------
+
+def test_quantize_roundtrip_error_bound():
+    """At clip_q=1.0 (exact per-row max) every coordinate round-trips
+    within half a quantization step of its row scale."""
+    rng = np.random.default_rng(2)
+    xs = rng.normal(size=(64, 32)).astype(np.float32)
+    x8, arow = cagra.quantize_int8(xs, "euclidean", clip_q=1.0)
+    rt = cagra.dequantize(x8, arow)
+    assert np.all(np.abs(rt - xs) <= arow[:, None] * 0.5 + 1e-6)
+    # the max coordinate hits full scale: resolution is never wasted
+    assert np.all(np.abs(x8).max(axis=1) == 127)
+
+
+def test_quantize_zero_vector():
+    """All-zero rows must quantize without NaN/inf and round-trip to
+    exactly zero (the scale floors at a tiny epsilon, never 0)."""
+    xs = np.zeros((3, 8), np.float32)
+    xs[2, :] = [0, 0, 0, 0, 1, -1, 2, -2]
+    for metric in ("euclidean", "cosine"):
+        x8, arow = cagra.quantize_int8(xs, metric, clip_q=1.0)
+        assert np.all(np.isfinite(arow)) and np.all(arow > 0)
+        assert not x8[:2].any()
+        assert not cagra.dequantize(x8, arow)[:2].any()
+
+
+def test_quantize_constant_dims():
+    """A constant row is exactly representable: every coordinate sits
+    on full scale, and the round-trip is bit-exact."""
+    xs = np.full((2, 16), 3.5, np.float32)
+    xs[1] *= -1
+    x8, arow = cagra.quantize_int8(xs, "euclidean", clip_q=1.0)
+    assert np.all(np.abs(x8) == 127)
+    assert np.allclose(cagra.dequantize(x8, arow), xs, rtol=1e-6)
+
+
+def test_quantize_outlier_clip_preserves_resolution():
+    """Density-aware clipping: with one huge coordinate, a sub-max
+    clip quantile keeps the scale near the data's bulk — the outlier
+    saturates, but the other coordinates keep far more resolution than
+    max-scaling (which crushes them all toward zero)."""
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(8, 64)).astype(np.float32)
+    xs[:, 0] = 1000.0  # the outlier dim
+    xq8, qa = cagra.quantize_int8(xs, "euclidean", clip_q=0.9)
+    xm8, ma = cagra.quantize_int8(xs, "euclidean", clip_q=1.0)
+    assert np.all(qa < ma)  # quantile scale is finer than max scale
+    assert np.all(xq8[:, 0] == 127)  # outlier saturates at the clip
+    bulk = np.s_[:, 1:]
+    err_q = np.abs(cagra.dequantize(xq8, qa)[bulk] - xs[bulk])
+    err_m = np.abs(cagra.dequantize(xm8, ma)[bulk] - xs[bulk])
+    assert err_q.mean() < err_m.mean() / 4
+
+
+def test_quantize_sparse_row_quantile_fallback():
+    """A row where the clip quantile lands on 0 (sparse: mostly zeros,
+    a few large coords) must fall back to max-scaling — the row still
+    resolves instead of dividing by zero."""
+    xs = np.zeros((2, 32), np.float32)
+    xs[0, 3] = 5.0
+    xs[1, [1, 7]] = [-2.0, 8.0]
+    x8, arow = cagra.quantize_int8(xs, "euclidean", clip_q=0.5)
+    assert np.all(np.isfinite(arow)) and np.all(arow > 0)
+    assert x8[0, 3] == 127 and x8[1, 7] == 127
+    assert np.allclose(cagra.dequantize(x8, arow), xs, atol=0.05)
+
+
+def test_quantize_cosine_prenormalizes():
+    """Cosine quantizes the pre-normalized rows: the dequantized rows
+    are unit vectors up to quantization error."""
+    rng = np.random.default_rng(4)
+    xs = (rng.normal(size=(32, 24)) * 10).astype(np.float32)
+    x8, arow = cagra.quantize_int8(xs, "cosine", clip_q=1.0)
+    norms = np.linalg.norm(cagra.dequantize(x8, arow), axis=1)
+    assert np.all(np.abs(norms - 1.0) < 0.05)
+
+
+# -- graph construction ------------------------------------------------------
+
+def test_build_graph_shape_and_id_range():
+    rng = np.random.default_rng(5)
+    xs = rng.normal(size=(2000, 16)).astype(np.float32)
+    g = cagra.build_graph(xs, "euclidean", d_out=16)
+    assert g.shape == (2000, 16) and g.dtype == np.int32
+    assert g.min() >= 0 and g.max() < 2000
+    # every node keeps real (non-self) out-edges after the merge
+    self_col = np.arange(2000)[:, None]
+    assert np.all((g != self_col).sum(axis=1) >= 1)
+
+
+def test_build_graph_tiny_store_pads_self_loops():
+    """Stores smaller than the out-degree pad with self-loops, which
+    the descent treats as already-visited — never an error."""
+    rng = np.random.default_rng(6)
+    xs = rng.normal(size=(5, 8)).astype(np.float32)
+    g = cagra.build_graph(xs, "cosine", d_out=32)
+    assert g.shape == (5, 32)
+    assert g.min() >= 0 and g.max() < 5
+
+
+def test_build_graph_constant_rows():
+    """All-identical rows give degenerate projections at every split;
+    the random-halves fallback must still terminate and produce a
+    valid graph."""
+    xs = np.ones((300, 8), np.float32)
+    g = cagra.build_graph(xs, "euclidean", d_out=8)
+    assert g.shape == (300, 8)
+    assert g.min() >= 0 and g.max() < 300
+
+
+# -- recall property (the acceptance gate) -----------------------------------
+#
+# Embedding-shaped data: clustered points with queries drawn NEAR the
+# data. Pure i.i.d. gaussian at high dim is adversarial for EVERY
+# graph-ANN (distance concentration: even an exact kNN graph caps near
+# 0.84 recall there) and looks like no real embedding distribution;
+# recall targets are only meaningful on data with low intrinsic
+# dimension, which is what the clustered generator provides.
+
+N_RECALL, DIM_RECALL, NQ = 50_000, 128, 32
+
+
+def clustered(n, dim, nc, std, seed):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(nc, dim)).astype(np.float32)
+    xs = (centers[rng.integers(0, nc, n)]
+          + std * rng.normal(size=(n, dim))).astype(np.float32)
+    qs = (xs[rng.integers(0, n, NQ)]
+          + 0.5 * std * rng.normal(size=(NQ, dim))).astype(np.float32)
+    return xs, qs
+
+
+def _recall_at_10(res, brute):
+    hits = sum(
+        len({r.id for r, _d in a} & {r.id for r, _d in b})
+        for a, b in zip(res, brute)
+    )
+    return hits / (10 * len(brute))
+
+
+@pytest.fixture(scope="module", params=["cosine", "euclidean", "dot"])
+def built_50k(request):
+    """One 50k/128d store per metric: exact brute ground truth taken
+    with the ANN disabled, then the graph built synchronously."""
+    metric = request.param
+    xs, qs = clustered(N_RECALL, DIM_RECALL, 500, 0.15, 17)
+    ix = _mk_index(xs, metric)
+    old = cnf.KNN_ANN_MODE
+    cnf.KNN_ANN_MODE = "off"
+    try:
+        brute = ix.knn_batch(qs, 10)
+        cnf.KNN_ANN_MODE = "force"
+        assert ix.ensure_ann(), "graph build did not land"
+        yield ix, qs, brute, metric
+    finally:
+        cnf.KNN_ANN_MODE = old
+
+
+def test_recall_device_descent(built_50k):
+    """int8 device-kernel descent + exact f32 re-rank vs brute-force
+    ground truth: recall@10 >= 0.95 (measured 1.0 at these knobs)."""
+    ix, qs, brute, metric = built_50k
+    r = _recall_at_10(ix.knn_batch(qs, 10), brute)
+    assert r >= 0.95, f"{metric}: device-descent recall@10 {r:.4f}"
+
+
+def test_recall_numpy_mirror(built_50k, monkeypatch):
+    """The degraded/CPU path (numpy mirror of the descent kernel over
+    the same int8 arrays) holds the same recall floor."""
+    ix, qs, brute, metric = built_50k
+    monkeypatch.setattr(ix, "_use_device", lambda: False)
+    r = _recall_at_10(ix.knn_batch(qs, 10), brute)
+    assert r >= 0.95, f"{metric}: numpy-descent recall@10 {r:.4f}"
+
+
+def test_descent_deterministic(built_50k):
+    """Same store, same build => identical (rid, dist) lists on every
+    search — the property the crash/reship byte-stability test rides."""
+    ix, qs, _brute, _metric = built_50k
+    assert ix.knn_batch(qs, 10) == ix.knn_batch(qs, 10)
+
+
+@pytest.mark.slow
+def test_recall_100k_768_cosine():
+    """The embedding-shaped scale point from the issue: 100k x 768
+    cosine, recall@10 >= 0.95 (build is ~1-2 min on one CPU core)."""
+    xs, qs = clustered(100_000, 768, 800, 0.15, 19)
+    ix = _mk_index(xs, "cosine")
+    old = cnf.KNN_ANN_MODE
+    cnf.KNN_ANN_MODE = "off"
+    try:
+        brute = ix.knn_batch(qs, 10)
+        cnf.KNN_ANN_MODE = "force"
+        assert ix.ensure_ann()
+        r = _recall_at_10(ix.knn_batch(qs, 10), brute)
+        assert r >= 0.95, f"100k/768 cosine recall@10 {r:.4f}"
+    finally:
+        cnf.KNN_ANN_MODE = old
+
+
+# -- live-store exactness ----------------------------------------------------
+
+@pytest.fixture()
+def ann_ds(monkeypatch):
+    """A real Datastore with a 300-row indexed table and the graph
+    force-built — the serving-shaped fixture for tail/dirty tests."""
+    from surrealdb_tpu import Datastore
+
+    monkeypatch.setattr(cnf, "KNN_ANN_MODE", "force")
+    ds = Datastore("memory")
+    rng = np.random.default_rng(23)
+    vs = rng.normal(size=(300, 8)).astype(np.float32)
+    ds.query(
+        "DEFINE TABLE t; DEFINE INDEX ix ON t FIELDS v HNSW "
+        "DIMENSION 8 DIST EUCLIDEAN TYPE F32"
+    )
+    ds.query("".join(
+        f"CREATE t:{i} SET v = [{', '.join(f'{x:.5f}' for x in v)}];"
+        for i, v in enumerate(vs)
+    ))
+    q = vs[7]
+    sql = ("SELECT id FROM t WHERE v <|3,10|> "
+           f"[{', '.join(f'{x:.5f}' for x in q)}]")
+    ds.query(sql)  # instantiate the engine
+    ix = next(iter(ds.vector_indexes.values()))
+    assert ix.ensure_ann()
+    yield ds, ix, q, sql
+    ds.close()
+
+
+def test_appended_rows_exact_immediately(ann_ds):
+    """A row created AFTER the graph snapshot must be findable on the
+    very next query (brute-merged tail), not after a rebuild."""
+    ds, ix, q, sql = ann_ds
+    built_n = ix._ann.built_n
+    vals = ", ".join(f"{x:.5f}" for x in q)
+    ds.query(f"CREATE t:999 SET v = [{vals}];")
+    rows = ds.query(sql)[0]
+    assert rows[0]["id"].id == 999  # exact row at the query point
+    assert ix._ann.built_n == built_n  # no rebuild was needed
+
+
+def test_overwritten_rows_exact_immediately(ann_ds):
+    """A row UPDATEd after the snapshot goes dirty: the stale graph
+    copy must never serve its old distance."""
+    ds, ix, q, sql = ann_ds
+    vals = ", ".join(f"{x:.5f}" for x in q)
+    ds.query(f"UPDATE t:50 SET v = [{vals}];")
+    rows = ds.query(sql)[0]
+    assert {r["id"].id for r in rows[:2]} == {7, 50}
+    assert ix._ann_dirty  # the overwrite was tracked
+
+
+def test_drift_past_tail_frac_rebuilds(ann_ds):
+    """Appending past KNN_ANN_TAIL_FRAC makes the snapshot stale: the
+    next sync schedules a rebuild and ensure_ann lands a graph that
+    covers the new rows."""
+    ds, ix, q, sql = ann_ds
+    rng = np.random.default_rng(29)
+    ds.query("".join(
+        f"CREATE t:{1000 + i} SET v = "
+        f"[{', '.join(f'{x:.5f}' for x in v)}];"
+        for i, v in enumerate(
+            rng.normal(size=(200, 8)).astype(np.float32)
+        )
+    ))
+    ds.query(sql)  # sync sees the drift and kicks the rebuild
+    assert ix.ensure_ann()
+    assert ix._ann.built_n == 500
+    rows = ds.query(sql)[0]
+    assert rows[0]["id"].id == 7
+
+
+def test_same_batch_create_delete_tombstone(ann_ds):
+    """CREATE + DELETE landing in one sync batch must not resurrect the
+    row: the delete targets a row still in the pending append buffer
+    (regression: the tombstone was silently dropped and the row stayed
+    valid forever, served by brute and graph paths alike)."""
+    ds, ix, q, sql = ann_ds
+    vals = ", ".join(f"{x:.5f}" for x in q)
+    # no query (= no sync) between these: one log batch
+    ds.query(f"CREATE t:800 SET v = [{vals}];"
+             f"CREATE t:801 SET v = [{vals}];"
+             f"DELETE t:800;")
+    got = [r["id"].id for r in ds.query(sql)[0]]
+    assert 801 in got and 800 not in got, got
+
+
+def test_same_batch_append_then_overwrite(ann_ds):
+    """CREATE + UPDATE of the same record in one sync batch must keep
+    ONE row holding the final value (regression: the overwrite was
+    treated as a second append, leaving a stale duplicate forever)."""
+    ds, ix, q, sql = ann_ds
+    vals = ", ".join(f"{x:.5f}" for x in q)
+    n0 = len(ix.rids)
+    ds.query(f"CREATE t:810 SET v = [9.0, 9.0, 9.0, 9.0, 9.0, 9.0, 9.0, 9.0];"
+             f"UPDATE t:810 SET v = [{vals}];")
+    got = [r["id"].id for r in ds.query(sql)[0]]
+    assert 810 in got[:2], got
+    assert len(ix.rids) == n0 + 1  # one row, not a duplicate pair
+
+
+def test_mass_deletion_stays_exact_and_goes_stale(ann_ds, monkeypatch):
+    """Deleting a dense neighborhood must neither shrink results below
+    k (the graph's candidates there are all tombstones — the per-query
+    exact fallback serves) nor hide from the staleness accounting
+    (deletions count as drift like appends/overwrites do). Kept below
+    the 25% fragmentation repack threshold so the ANN-side mechanism —
+    not the repack — is what's exercised."""
+    ds, ix, q, sql = ann_ds
+    monkeypatch.setattr(cnf, "KNN_ANN_TAIL_FRAC", 0.1)
+    # delete the 60 rows nearest the query: a tombstone-dense region
+    d = ((ix.vecs - q) ** 2).sum(axis=1)
+    victims = {int(v) for v in np.argsort(d)[1:61]}  # keep t:7 itself
+    ds.query("".join(f"DELETE t:{v};" for v in sorted(victims)))
+    rows = ds.query(sql)[0]
+    got = [r["id"].id for r in rows]
+    assert len(got) == 3, got          # never short of k
+    assert got[0] == 7
+    assert not set(got) & victims      # no resurrections
+    ann = ix._ann
+    assert ann is not None and ix._ann_stale(ann, len(ix.rids))
+    assert ix.ensure_ann()             # the drift-scheduled rebuild lands

@@ -275,3 +275,69 @@ def test_require_mode_surfaces_device_loss(chaos_ds):
     finally:
         set_supervisor(old)
         sup.shutdown()
+
+
+def test_ann_reship_after_sigkill_midload(sub_sup, chaos_ds, monkeypatch):
+    """Quantized-ANN crash/reship: the CAGRA blocks (graph + int8 rows)
+    ship via the same (key, tag) protocol as the vector store, so a
+    runner SIGKILL — including one landing MID-multipart-load — must
+    (a) never error a query (the numpy descent mirror serves), and
+    (b) reship from host truth on recovery with IDENTICAL results:
+    same build epoch => same top-k, byte-stable across the cycle."""
+    from surrealdb_tpu import cnf as _cnf
+
+    ds, vecs = chaos_ds
+    monkeypatch.setattr(_cnf, "KNN_ANN_MODE", "force")
+    # a candidate set of 100/300 makes the device (int8 query) and the
+    # numpy-mirror (f32 query) descents agree on the exact top-5 with
+    # margin: the invariant under test is the reship cycle, not the
+    # quantization edge
+    monkeypatch.setattr(_cnf, "KNN_ANN_OVERSAMPLE", 20)
+    # crash detection here is recv-EOF, not the watchdog: leave room
+    # for the first descent-kernel compile (the 1s chaos window reads
+    # a cold XLA compile as a wedge and kills the runner itself)
+    sub_sup.dispatch_timeout_s = 15.0
+    sql = _knn_sql(vecs[0])
+    ds.query(sql)  # instantiate the index engine
+    ix = next(iter(ds.vector_indexes.values()))
+    assert ix.ensure_ann()  # host-side graph build (device-independent)
+    assert sub_sup.wait_ready(120), sub_sup.status()
+    # every ANN ship streams as many small parts: the crash window below
+    # reliably lands inside the part stream
+    monkeypatch.setattr(sub_sup, "LOAD_PART_BYTES", 2048, raising=False)
+
+    expect = [r["id"] for r in ds.query(sql)[0]]  # ships + searches
+    assert len(expect) == 5
+    assert [r["id"] for r in ds.query(sql)[0]] == expect  # deterministic
+
+    # arm: the next ANN part stream loses its runner mid-ship
+    orig_call = sub_sup.call
+    kills = []
+
+    def chaos_call(op, meta, bufs=(), **kw):
+        if op == "ann_load_part" and not kills:
+            kills.append(sub_sup.runner_pid())
+            os.kill(kills[0], signal.SIGKILL)
+        return orig_call(op, meta, bufs, **kw)
+
+    monkeypatch.setattr(sub_sup, "call", chaos_call)
+    os.kill(sub_sup.runner_pid(), signal.SIGKILL)  # drop the loaded blocks
+    # every query during the outage serves from the numpy descent — and
+    # the exact re-rank makes the answer identical either way
+    deadline = time.monotonic() + 30.0
+    while not kills and time.monotonic() < deadline:
+        assert [r["id"] for r in ds.query(sql)[0]] == expect
+        time.sleep(0.05)
+    assert kills, "reship never re-attempted while armed"
+
+    # disarm; the next recovery completes the ship and serves on-device
+    monkeypatch.setattr(sub_sup, "call", orig_call)
+    assert _wait_state(sub_sup, "ready", 30.0), sub_sup.status()
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        assert [r["id"] for r in ds.query(sql)[0]] == expect
+        if sub_sup.status().get("ann_blocks"):
+            break
+        time.sleep(0.05)
+    assert [r["id"] for r in ds.query(sql)[0]] == expect
+    assert sub_sup.counters["device_restarts"] >= 1

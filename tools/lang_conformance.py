@@ -95,6 +95,72 @@ def perf_smoke(ratio_floor: float = 0.8) -> "str | None":
             f"{engine:.0f} qps — serving tax regrew")
 
 
+def ann_smoke(recall_floor: float = 0.95) -> "str | None":
+    """Quantized graph-ANN gate (PR 7): on a 100k×256 embedding-shaped
+    (clustered) store, the CAGRA int8-descent + exact-re-rank path must
+    hold recall@10 >= `recall_floor` against brute-force ground truth
+    AND must not be slower than the brute path it replaces. The ≥10×
+    claim lives in the bench configs (the ratio grows with N — measured
+    ~1.7× here, 18× at 250k×768); the gate pins the floor a regression
+    would cross first. Returns None on pass, an error string on fail."""
+    import time
+
+    import numpy as np
+
+    from surrealdb_tpu import cnf
+    from surrealdb_tpu.idx.vector import TpuVectorIndex
+    from surrealdb_tpu.val import RecordId
+
+    n, dim, nc = 100_000, 256, 1000
+    rng = np.random.default_rng(7)
+    centers = rng.normal(size=(nc, dim)).astype(np.float32)
+    xs = (centers[rng.integers(0, nc, n)]
+          + 0.15 * rng.normal(size=(n, dim))).astype(np.float32)
+    qs = (xs[rng.integers(0, n, 64)]
+          + 0.075 * rng.normal(size=(64, dim))).astype(np.float32)
+    ix = TpuVectorIndex("b", "b", "annsmoke", "ix", {
+        "dimension": dim, "distance": "cosine", "vector_type": "f32",
+    })
+    ix.vecs = xs
+    ix.valid = np.ones(n, dtype=bool)
+    ix.rids = [RecordId("annsmoke", i) for i in range(n)]
+    ix.version = 0
+    big = np.repeat(qs, 8, axis=0)
+    old_mode, old_refine = cnf.KNN_ANN_MODE, cnf.KNN_ANN_REFINE
+    cnf.KNN_ANN_MODE, cnf.KNN_ANN_REFINE = "off", 0
+    try:
+        ix.knn_batch(big, 10)  # warm: ship + compile
+        t0 = time.perf_counter()
+        brute_res = ix.knn_batch(big, 10)
+        brute = len(big) / (time.perf_counter() - t0)
+        cnf.KNN_ANN_MODE = "force"
+        if not ix.ensure_ann():
+            return "ann smoke: graph build did not land"
+        ix.knn_batch(big, 10)  # warm: ship + compile the descent ladder
+        t0 = time.perf_counter()
+        ann_res = ix.knn_batch(big, 10)
+        ann = len(big) / (time.perf_counter() - t0)
+    finally:
+        cnf.KNN_ANN_MODE, cnf.KNN_ANN_REFINE = old_mode, old_refine
+    hits = sum(
+        len({r.id for r, _d in a} & {r.id for r, _d in b})
+        for a, b in zip(ann_res, brute_res)
+    )
+    recall = hits / (10 * len(big))
+    if recall < recall_floor:
+        return (f"cagra recall@10 {recall:.4f} < {recall_floor} vs "
+                f"brute-force ground truth")
+    if ann < brute:
+        return (f"cagra {ann:.0f} qps slower than brute-force "
+                f"{brute:.0f} qps at n={n} — the graph path lost its "
+                f"reason to exist")
+    print(f"== ann smoke: OK — recall@10 {recall:.4f}, cagra "
+          f"{ann:.0f} qps vs brute {brute:.0f} qps "
+          f"({ann / max(brute, 1e-9):.2f}x, build "
+          f"{ix._ann.build_s:.1f}s)")
+    return None
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("filter", nargs="?", default=None)
@@ -208,6 +274,13 @@ def main():
     err = perf_smoke()
     if err is not None:
         print(f"== perf smoke: FAIL — {err}")
+        rc = rc or 1
+    # ann smoke: the quantized graph index must keep recall@10 >= 0.95
+    # vs brute-force ground truth and must never be slower than the
+    # brute path it gates in for
+    err = ann_smoke()
+    if err is not None:
+        print(f"== ann smoke: FAIL — {err}")
         rc = rc or 1
     return rc
 
