@@ -884,6 +884,349 @@ def bench_hybrid(quick=False):
     }
 
 
+# ---------------------------------------------------------------------------
+# live-query fan-out soak (real sockets; the push-traffic load story)
+# ---------------------------------------------------------------------------
+
+
+class _SoakWs:
+    """Minimal RFC6455 json client for the soak: blocking handshake +
+    rpc calls; notification collection happens externally through a
+    shared selector loop reading `sock` via `feed()`."""
+
+    def __init__(self, port, rcvbuf=None):
+        import socket as S
+
+        self.sock = S.socket(S.AF_INET, S.SOCK_STREAM)
+        if rcvbuf:
+            self.sock.setsockopt(S.SOL_SOCKET, S.SO_RCVBUF, rcvbuf)
+        self.sock.settimeout(30)
+        self.sock.connect(("127.0.0.1", port))
+        key = "c29ha3Nlc3Npb25rZXk93d=="
+        self.sock.sendall(
+            (f"GET /rpc HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+             f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"Sec-WebSocket-Key: {key}\r\n"
+             f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("handshake failed")
+            resp += chunk
+        self.buf = bytearray(resp.split(b"\r\n\r\n", 1)[1])
+        self._id = 0
+
+    def call(self, method, params):
+        self._id += 1
+        payload = json.dumps({"id": self._id, "method": method,
+                              "params": params}).encode()
+        mask = b"\x11\x22\x33\x44"
+        masked = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+        n = len(payload)
+        if n < 126:
+            hdr = b"\x81" + bytes([0x80 | n])
+        else:
+            import struct as st
+
+            hdr = b"\x81" + st.pack("!BH", 0x80 | 126, n)
+        self.sock.sendall(hdr + mask + masked)
+        while True:
+            msg = self._read_msg()
+            if msg.get("id") == self._id:
+                return msg
+
+    def _read_msg(self):
+        while True:
+            msgs = _soak_parse(self.buf)
+            if msgs:
+                if msgs[0] is None:  # server close frame
+                    raise ConnectionError("closed by server")
+                return msgs[0]
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("closed")
+            self.buf += chunk
+
+    def feed(self) -> list:
+        """Non-blocking drain for the collector: recv once, return the
+        complete messages parsed out of the buffer."""
+        try:
+            chunk = self.sock.recv(262144)
+        except (BlockingIOError, InterruptedError):
+            return []
+        except OSError:
+            return [None]  # connection gone
+        if not chunk:
+            return [None]
+        self.buf += chunk
+        return _soak_parse(self.buf, limit=0)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _soak_parse(buf: bytearray, limit: int = 1) -> list:
+    """Parse complete server frames out of `buf` in place; returns
+    decoded json messages (close frames decode to None)."""
+    import struct as st
+
+    out = []
+    while buf and (limit == 0 or len(out) < limit):
+        if len(buf) < 2:
+            break
+        b1, b2 = buf[0], buf[1]
+        n = b2 & 0x7F
+        off = 2
+        if n == 126:
+            if len(buf) < 4:
+                break
+            n = st.unpack_from("!H", buf, 2)[0]
+            off = 4
+        elif n == 127:
+            if len(buf) < 10:
+                break
+            n = st.unpack_from("!Q", buf, 2)[0]
+            off = 10
+        if len(buf) < off + n:
+            break
+        data = bytes(buf[off:off + n])
+        del buf[:off + n]
+        opcode = b1 & 0x0F
+        if opcode == 0x8:
+            out.append(None)
+            break
+        if opcode not in (0x1, 0x2):
+            continue
+        try:
+            out.append(json.loads(data.decode()))
+        except ValueError:
+            continue
+    return out
+
+
+def live_soak(sessions=64, frozen=2, writers=4, writes=400,
+              depth=None, policy=None, reconnects=0, payload_pad=0,
+              table="soak", settle_s=8.0):
+    """The live-fanout soak: `sessions` real WebSocket sessions each
+    holding one LIVE SELECT on a shared table, `writers` threads
+    streaming CREATEs through the datastore, `frozen` sessions that
+    never read their socket (tiny SO_RCVBUF so TCP backpressure bites),
+    and an optional mid-stream reconnect storm. One collector thread
+    drains every live socket through a selector (scales to thousands
+    of sessions without a thread per client).
+
+    Returns the metrics dict the `live_fanout` BENCH family and the
+    conformance-gate smoke both consume."""
+    import selectors
+    import threading
+
+    from surrealdb_tpu import Datastore, cnf
+    from surrealdb_tpu.server import make_server
+
+    old_depth, old_policy = cnf.LIVE_QUEUE_DEPTH, cnf.LIVE_OVERFLOW_POLICY
+    if depth is not None:
+        cnf.LIVE_QUEUE_DEPTH = depth
+    if policy is not None:
+        cnf.LIVE_OVERFLOW_POLICY = policy
+    ds = Datastore("memory")
+    srv = make_server(ds, "127.0.0.1", 0, unauthenticated=True,
+                      max_inflight=0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    pad = "x" * payload_pad if payload_pad else ""
+    res: dict = {}
+    try:
+        ds.execute(f"DEFINE TABLE {table}", ns="s", db="s")
+
+        # -- baseline write qps: zero subscribers ------------------------
+        # per-phase base keeps `s` globally unique AND monotonic per
+        # (phase, writer) stream: the order detector keys on
+        # s // 1_000_000, so a later phase restarting at j=0 must not
+        # compare against an earlier phase's high-water mark
+        phase = [0]
+
+        def run_writes(tag, count):
+            phase[0] += 1
+            base = phase[0] * 100_000_000
+            done = []
+
+            def w(wi):
+                for j in range(count // writers):
+                    ds.execute(
+                        f"CREATE {table}:{tag}{wi}x{j} SET ts = $ts, "
+                        f"s = $s, p = $p",
+                        ns="s", db="s",
+                        vars={"ts": time.time(),
+                              "s": base + wi * 1_000_000 + j, "p": pad},
+                    )
+                done.append(wi)
+
+            ts = [threading.Thread(target=w, args=(i,), daemon=True)
+                  for i in range(writers)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t0
+            return (count // writers) * writers / dt
+
+        base_qps = run_writes("b", writes)
+
+        # -- subscribe the fleet ----------------------------------------
+        live, cold = [], []
+        for i in range(sessions):
+            is_frozen = i < frozen
+            c = _SoakWs(port, rcvbuf=4096 if is_frozen else None)
+            c.call("use", ["s", "s"])
+            out = c.call("live", [table])
+            c.lid = out.get("result")
+            c.si = i
+            (cold if is_frozen else live).append(c)
+        stats = {"delivered": 0, "overflow": 0, "error": 0,
+                 "order_violations": 0, "lat": [], "closed": 0,
+                 "per_session": {}}
+        stop = threading.Event()
+
+        def collect():
+            sel = selectors.DefaultSelector()
+            for c in live:
+                c.sock.setblocking(False)
+                sel.register(c.sock, selectors.EVENT_READ, c)
+            last_seq: dict = {}
+            while not stop.is_set():
+                for key, _ev in sel.select(timeout=0.2):
+                    c = key.data
+                    for msg in c.feed():
+                        if msg is None:
+                            try:
+                                sel.unregister(c.sock)
+                            except KeyError:
+                                pass
+                            stats["closed"] += 1
+                            break
+                        if msg.get("id") is not None:
+                            continue
+                        note = msg.get("result") or {}
+                        act = note.get("action")
+                        if act == "OVERFLOW":
+                            stats["overflow"] += 1
+                            continue
+                        if act == "ERROR":
+                            stats["error"] += 1
+                            continue
+                        row = note.get("result") or {}
+                        ts = row.get("ts")
+                        if isinstance(ts, (int, float)):
+                            stats["lat"].append(time.time() - ts)
+                        s = row.get("s")
+                        prev = last_seq.get((c.si, s is not None
+                                             and s // 1_000_000))
+                        if prev is not None and s is not None \
+                                and s <= prev:
+                            stats["order_violations"] += 1
+                        if s is not None:
+                            last_seq[(c.si, s // 1_000_000)] = s
+                        stats["delivered"] += 1
+                        ps = stats["per_session"]
+                        ps[c.si] = ps.get(c.si, 0) + 1
+
+        col = threading.Thread(target=collect, daemon=True)
+        col.start()
+
+        # -- fan-out run: writes streaming into the subscribed fleet ----
+        t0 = time.perf_counter()
+        fan_qps = run_writes("f", writes)
+        if reconnects:
+            # reconnect storm mid-stream: drop + resubscribe
+            storm = live[:reconnects]
+            for c in storm:
+                c.close()
+            run_writes("g", max(writes // 2, writers))
+            for c in storm:
+                nc = _SoakWs(port)
+                nc.call("use", ["s", "s"])
+                nc.call("live", [table])
+                nc.close()
+        # let deliveries settle, then stop collecting
+        target = len(live) * (writes // writers) * writers
+        end = time.monotonic() + settle_s
+        while time.monotonic() < end \
+                and stats["delivered"] < target:
+            time.sleep(0.05)
+        wall = time.perf_counter() - t0
+        stop.set()
+        col.join(timeout=5)
+
+        lats = sorted(stats["lat"])
+
+        def pct(p):
+            return round(
+                lats[min(int(len(lats) * p), len(lats) - 1)] * 1000, 2
+            ) if lats else None
+
+        # disconnect-GC at scale: closing every session without KILL
+        # must empty the subscription registry (the leak satellite)
+        for c in live + cold:
+            c.close()
+        gc_end = time.monotonic() + 10.0
+        while len(ds.live_queries) and time.monotonic() < gc_end:
+            time.sleep(0.05)
+        tel = ds.telemetry
+        res = {
+            "config": "live_fanout",
+            "metric": f"live_fanout_qps_{sessions}sessions",
+            "value": round(stats["delivered"] / wall, 1),
+            "unit": "notifications/s",
+            "sessions": sessions,
+            "frozen": frozen,
+            "writes": (writes // writers) * writers,
+            "delivered": stats["delivered"],
+            "delivery_p50_ms": pct(0.50),
+            "delivery_p99_ms": pct(0.99),
+            "write_qps_base": round(base_qps, 1),
+            "write_qps_fanout": round(fan_qps, 1),
+            "decoupling_ratio": round(fan_qps / base_qps, 3)
+            if base_qps else 0.0,
+            "order_violations": stats["order_violations"],
+            "overflow_notes": stats["overflow"],
+            "overflows": tel.get("live_overflows"),
+            "overflow_disconnects": tel.get("live_overflow_disconnects"),
+            "notifications_dropped": tel.get("notifications_dropped"),
+            "live_sessions_end": len(ds.live_queries),
+            "per_session_complete": sum(
+                1 for v in stats["per_session"].values()
+                if v >= (writes // writers) * writers
+            ),
+            "reconnects": reconnects,
+        }
+    finally:
+        cnf.LIVE_QUEUE_DEPTH, cnf.LIVE_OVERFLOW_POLICY = \
+            old_depth, old_policy
+        srv.shutdown()
+        ds.close()
+    return res
+
+
+def bench_live_fanout(quick=False):
+    """BENCH family `live_fanout`: fan-out qps + delivery p50/p99 +
+    overflow/shed counts at production shape — thousands of WS sessions
+    full-size, with frozen consumers and a reconnect storm."""
+    if quick:
+        return live_soak(sessions=64, frozen=2, writers=4, writes=400,
+                         payload_pad=256)
+    sessions = int(os.environ.get("SURREAL_BENCH_LIVE_SESSIONS", "1000"))
+    return live_soak(sessions=sessions, frozen=max(sessions // 50, 2),
+                     writers=8,
+                     writes=max(240, 200_000 // max(sessions, 1)),
+                     payload_pad=256,
+                     reconnects=max(sessions // 10, 4), settle_s=20.0)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -891,7 +1234,8 @@ def main():
                     help="run all six configs (one JSON line each)")
     ap.add_argument("--config", default=None,
                     choices=["hnsw100k", "knn1m", "knn10m", "ann10m",
-                             "brute", "graph3hop", "hybrid"])
+                             "brute", "graph3hop", "hybrid",
+                             "live_fanout"])
     args = ap.parse_args()
 
     def emit(res):
@@ -933,6 +1277,7 @@ def main():
         "brute": bench_brute,
         "graph3hop": bench_graph3hop,
         "hybrid": bench_hybrid,
+        "live_fanout": bench_live_fanout,
     }
     _probe_backend()
     if args.all:
@@ -950,6 +1295,7 @@ def main():
     if args.quick:
         emit(bench_knn10m(quick=True))
         emit(bench_ann10m(quick=True))
+        emit(bench_live_fanout(quick=True))
         return 0
     if _PLATFORM == "cpu":
         # Wedged-tunnel fallback (or an explicit CPU run): the 10M×768
@@ -962,6 +1308,12 @@ def main():
         # labels itself — the round still records the graph-index
         # metric family
         emit(bench_ann10m(quick=False))
+        try:
+            emit(bench_live_fanout(quick=False))
+        except Exception as e:
+            print(f"bench: live_fanout config failed "
+                  f"({type(e).__name__}: {e})", file=sys.stderr,
+                  flush=True)
         return 0
     smoke = bench_knn1m(quick=True)
     print(f"bench: smoke ok: {json.dumps(smoke)}", file=sys.stderr,

@@ -239,6 +239,47 @@ HTTP_DEFAULT_TIMEOUT_S = env_float("SURREAL_HTTP_DEFAULT_TIMEOUT_S", 0.0)
 DRAIN_TIMEOUT_S = env_float("SURREAL_DRAIN_TIMEOUT_S", 10.0)
 
 
+# -- live-query fan-out (server/fanout.py) -----------------------------------
+# per-session bounded outbound notification queue: the writer thread
+# drains it toward the client socket; a full queue triggers the
+# overflow policy instead of ever blocking a committing writer
+LIVE_QUEUE_DEPTH = env_int("SURREAL_LIVE_QUEUE_DEPTH", 256)
+# what happens to a slow consumer whose queue overflows:
+#   notify     — drop the queued backlog, count it, and push one typed
+#                OVERFLOW notification per bound live id (the client
+#                knows it lost a window and can re-read)
+#   disconnect — force-close the laggard's connection (the client's
+#                reconnect logic owns recovery)
+LIVE_OVERFLOW_POLICY = env_str("SURREAL_LIVE_OVERFLOW", "notify")
+# post-commit dispatch workers doing live-query matching (condition +
+# projection evaluation). Events are sharded by (ns,db,tb) so one
+# subscription always observes its table's commits in order.
+LIVE_DISPATCH_WORKERS = env_int("SURREAL_LIVE_DISPATCH_WORKERS", 2)
+# commit batches a dispatch worker may have queued before the hub
+# declares push overload: the backlog is dropped and every subscription
+# on the affected tables gets a typed OVERFLOW notification (bounded
+# memory under a notification storm, honestly reported)
+LIVE_DISPATCH_BACKLOG = env_int("SURREAL_LIVE_DISPATCH_BACKLOG", 4096)
+# notifications coalesced into one socket write by a session's writer
+# thread (burst batching: N frames, one sendall)
+LIVE_DELIVERY_BATCH = env_int("SURREAL_LIVE_DELIVERY_BATCH", 64)
+# dead-session sweep cadence (rides the kvs/net.py Runtime seam): GC
+# live queries whose session died without KILL
+LIVE_SWEEP_INTERVAL_S = env_float("SURREAL_LIVE_SWEEP_INTERVAL_S", 30.0)
+# embedded in-process notification buffer cap (Datastore.notifications —
+# drained by drain_notifications(); without a consumer it must not grow
+# without bound). Drops are counted; first drop warns once.
+NOTIFY_BUFFER_CAP = env_int("SURREAL_NOTIFY_BUFFER_CAP", 10_000)
+
+# -- changefeed GC (cf.py, scheduled by the serving path) --------------------
+# fallback retention for tables/databases whose CHANGEFEED clause
+# carries no duration this build can read (seconds); per-table clauses
+# always win. 0 disables the sweep entirely.
+CHANGEFEED_RETENTION_S = env_float("SURREAL_CHANGEFEED_RETENTION_S",
+                                   3 * 86400.0)
+CHANGEFEED_GC_INTERVAL_S = env_float("SURREAL_CHANGEFEED_GC_INTERVAL_S",
+                                     300.0)
+
 # -- execution limits (reference cnf/mod.rs names) ---------------------------
 # rows buffered per streaming operator batch (OPERATOR_BUFFER_SIZE)
 OPERATOR_BUFFER_SIZE = env_int("SURREAL_OPERATOR_BUFFER_SIZE", 1024)

@@ -1046,49 +1046,32 @@ def write_changefeed(rid, before, after, action, ctx: Ctx):
 
 
 def notify_lives(rid, before, after, action, ctx: Ctx):
-    """Live-query matching (doc/lives.rs:29 process_table_lives)."""
+    """Live-query CAPTURE (doc/lives.rs:29 process_table_lives).
+
+    The commit path does NO matching anymore: when the subscription
+    registry has entries for this (ns, db, tb) — one indexed dict
+    lookup — the mutation is snapshotted into the transaction's
+    `_live_events` buffer. The executor publishes the buffer to the
+    fan-out dispatch workers only after the transaction COMMITS
+    (server/fanout.py); condition/projection evaluation, payload
+    shaping, and delivery all happen post-commit, off this thread.
+    A rolled-back statement's events are truncated with its savepoint,
+    and a cancelled transaction publishes nothing."""
     ns, db = ctx.need_ns_db()
-    subs = [
-        s
-        for s in ctx.ds.live_queries.values()
-        if s.ns == ns and s.db == db and s.tb == rid.tb
-    ]
-    if not subs:
+    if not ctx.ds.live_queries.count_for(ns, db, rid.tb):
         return
-    from surrealdb_tpu.kvs.ds import Notification
+    from surrealdb_tpu.server.fanout import LiveEvent
 
-    doc = after if action != "DELETE" else before
-    for sub in subs:
-        c = ctx.with_doc(doc, rid)
-        c.vars.update(sub.session_vars)
-        c.vars["before"] = before
-        c.vars["after"] = after
-        c.vars["event"] = action
-        if sub.cond is not None and not is_truthy(evaluate(sub.cond, c)):
-            continue
-        if sub.expr == "diff":
-            from surrealdb_tpu.utils.patch import diff
-
-            payload = diff(
-                before if isinstance(before, dict) else {},
-                after if isinstance(after, dict) else {},
-            )
-        elif isinstance(sub.expr, list):
-            if len(sub.expr) == 1 and sub.expr[0][0] == "*":
-                payload = copy_value(doc)
-            else:
-                from surrealdb_tpu.exec.statements import expr_name
-
-                payload = {}
-                for expr, alias in sub.expr:
-                    if expr == "*":
-                        if isinstance(doc, dict):
-                            payload.update(copy_value(doc))
-                        continue
-                    payload[alias or expr_name(expr)] = evaluate(expr, c)
-        else:
-            payload = copy_value(doc)
-        ctx.ds.notify(Notification(sub.id, action, rid, payload))
+    txn = ctx.txn
+    buf = getattr(txn, "_live_events", None)
+    if buf is None:
+        buf = txn._live_events = []
+    # snapshot: the executor may mutate these dicts after this statement
+    # (same-txn overwrites share doc objects via the record cache)
+    buf.append(LiveEvent(
+        ns, db, rid.tb, rid,
+        copy_value(before), copy_value(after), action,
+    ))
 
 
 def view_source_tables(sel) -> list:

@@ -5189,6 +5189,10 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             # per-stage query timing (PR-6 overhead strip) — the same
             # table tools/profile_query.py prints and /metrics exports
             "stages": _stage_snapshot(),
+            # live-query fan-out spine health (server/fanout.py):
+            # sessions, dispatch backlog, overflow/drop tallies
+            "live": dict(ctx.ds.fanout.stats(),
+                         subscriptions=len(ctx.ds.live_queries)),
         }
         if shard_topo is not None:
             out["shards"] = shard_topo
@@ -5427,6 +5431,13 @@ def _s_live(n: LiveStmt, ctx: Ctx):
     )
     ctx.txn.set_val(K.lq_def(ns, db, what.name, str(lid.u)), sub)
     ctx.ds.live_queries[str(lid.u)] = sub
+    # route to the session's outbox IN THE SAME STEP as registration:
+    # binding later (rpc layer, after the statement returns) leaves a
+    # window where a dispatch worker matches the sub but finds no
+    # route and silently drops the notification
+    ob = getattr(ctx.session, "live_outbox", None)
+    if ob is not None:
+        ctx.ds.fanout.bind(str(lid.u), ob)
     return lid
 
 
@@ -5439,6 +5450,11 @@ def _s_kill(n: KillStmt, ctx: Ctx):
     else:
         raise SdbError("KILL requires a live query uuid")
     sub = ctx.ds.live_queries.pop(lid, None)
+    if sub is not None:
+        # stop routing BEFORE deleting the row: a dispatch worker that
+        # already matched this lid may still hold a notification, but
+        # nothing new is enqueued to the session after KILL returns
+        ctx.ds.fanout.unbind(lid)
     if sub is None:
         # not a LIVE query: try the in-flight (normal) query registry —
         # KILL <query-id> sets the cooperative cancel flag and the

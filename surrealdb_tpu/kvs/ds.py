@@ -147,9 +147,18 @@ class Datastore:
         self.vector_indexes: dict = {}  # (ns,db,tb,ix) -> TpuVectorIndex
         self.index_builds: dict = {}  # (ns,db,tb,ix) -> building status
         self.ft_indexes: dict = {}  # (ns,db,tb,ix) -> FullTextIndex
-        self.live_queries: dict = {}  # uuid-str -> LiveQuery
-        self.notifications: list[Notification] = []  # in-proc delivery queue
+        # live subscriptions, indexed by (ns,db,tb) — the write path
+        # gates on count_for() instead of scanning every subscription
+        from surrealdb_tpu.server.fanout import FanoutHub, \
+            SubscriptionRegistry
+
+        self.live_queries = SubscriptionRegistry()
+        self.notifications: list[Notification] = []  # in-proc, bounded
         self.notification_handlers: list = []  # callables(Notification)
+        # the notification fan-out spine: post-commit dispatch workers +
+        # per-session bounded outboxes (threads spawn lazily on first
+        # publish — embedded datastores that never LIVE pay nothing)
+        self.fanout = FanoutHub(self)
         self.ml_cache: dict = {}  # (ns,db,name,version,hash) -> SurmlFile
         self.module_cache: dict = {}  # (ns,db,name) -> (hash, wasm Instance)
         self.sequences: dict = {}
@@ -342,20 +351,65 @@ class Datastore:
 
     # -- notifications ------------------------------------------------------
     def notify(self, notification: Notification):
-        with self.lock:
-            self.notifications.append(notification)
-            handlers = list(self.notification_handlers)
-        for h in handlers:
-            try:
-                h(notification)
-            except Exception:
-                pass
+        """Enqueue-only delivery: the fan-out hub appends to the bounded
+        in-process buffer, invokes embedded handlers (errors counted,
+        never swallowed silently), and routes to the bound session
+        outbox. No socket I/O, no unbounded growth, and nothing here
+        runs on a committing writer's thread — the doc pipeline captures
+        events and the post-commit dispatch workers call this."""
+        self.fanout.deliver(notification)
 
     def drain_notifications(self) -> list[Notification]:
+        # barrier: anything already committed must be matched and
+        # routed before the drain returns (the embedded consumer's
+        # read-your-own-writes contract survives async dispatch)
+        self.fanout.flush()
         with self.lock:
             out = self.notifications
             self.notifications = []
         return out
+
+    def gc_session_lives(self, lids) -> int:
+        """Drop a dead session's live queries: registry entries, outbox
+        routes, and the persisted `!lq` catalog rows (the reference GCs
+        these from engine/tasks.rs:49-51; without it a session that died
+        without KILL pays match cost on every write forever)."""
+        lids = [str(x) for x in lids]
+        subs = []
+        for lid in lids:
+            self.fanout.unbind(lid)
+            sub = self.live_queries.pop(lid, None)
+            if sub is not None:
+                subs.append((lid, sub))
+        if not subs:
+            return 0
+        from surrealdb_tpu import key as K
+
+        try:
+            txn = self.transaction(write=True)
+        except SdbError:
+            # KV unavailable: the registry is clean, rows sweep later
+            self.telemetry.inc("live_gc_collected", len(subs))
+            return len(subs)
+        committed = False
+        try:
+            for lid, sub in subs:
+                txn.delete(K.lq_def(sub.ns, sub.db, sub.tb, lid))
+            txn.commit()
+            committed = True
+        except SdbError:
+            pass  # rows survive until the next sweep
+        finally:
+            # ANY non-commit exit must release the write transaction —
+            # the periodic sweep swallows errors, so a leaked handle
+            # would recur every interval
+            if not committed:
+                try:
+                    txn.cancel()
+                except SdbError:
+                    pass
+        self.telemetry.inc("live_gc_collected", len(subs))
+        return len(subs)
 
     STORAGE_VERSION = 1  # on-disk format version (reference kvs/version/)
 
@@ -452,4 +506,5 @@ class Datastore:
     def close(self):
         if self.node_tasks is not None:
             self.node_tasks.stop()
+        self.fanout.close_all()
         self.backend.close()

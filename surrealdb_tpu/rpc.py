@@ -197,14 +197,20 @@ class RpcSession:
         diff = bool(params[1]) if len(params) > 1 else False
         expr = "DIFF" if diff else "*"
         lid = self._one(f"LIVE SELECT {expr} FROM {what}")
-        self.live_ids.add(str(lid.u))
+        key = str(lid.u)
+        self.live_ids.add(key)
+        # routing was bound by the LIVE statement itself (atomically
+        # with registration, via session.live_outbox) — nothing to do
+        # here beyond remembering the id for session-close GC
         return lid
 
     def rpc_kill(self, params):
         if not params:
             raise RpcError(-32602, "Invalid params")
         out = self._one("KILL $id", {"id": params[0]})
-        self.live_ids.discard(str(params[0]))
+        # uuid-or-str param: the KILL statement itself already unbound
+        # the fan-out route; here only the session-close GC set shrinks
+        self.live_ids.discard(str(getattr(params[0], "u", params[0])))
         return out
 
     def rpc_signin(self, params):

@@ -68,6 +68,10 @@ class LocalEngine:
         # the embedding process owns the datastore: root session
         self.rs = RpcSession(self.ds, anon_level="owner")
         self._live_cbs: dict = {}
+        # embedded delivery: callbacks run on the fan-out hub's dispatch
+        # workers (post-commit), NOT on the writing thread — a slow
+        # callback delays notifications, never commits. Exceptions are
+        # counted (notify_handler_errors), not swallowed silently.
         self.ds.notification_handlers.append(self._on_notify)
 
     def _on_notify(self, n):
@@ -508,7 +512,22 @@ class Surreal:
     def live(self, table: str, callback: Callable[[dict], None],
              diff: bool = False) -> str:
         """Start LIVE SELECT on `table`; `callback(notification)` fires on
-        every matching mutation until `kill(live_id)`."""
+        every matching mutation until `kill(live_id)`.
+
+        Delivery contract (server/fanout.py): notifications arrive in
+        commit order, exactly once — delivered asynchronously from a
+        bounded per-session queue, so a slow callback/socket never
+        stalls the writers producing the mutations. Two typed actions
+        beyond CREATE/UPDATE/DELETE can arrive:
+
+        - ``OVERFLOW``: this session fell behind and the server dropped
+          its queued backlog (``result`` carries ``{"dropped": n}``);
+          re-read the table to resynchronize. Under the server's
+          ``disconnect`` overflow policy the connection is closed
+          instead and no OVERFLOW is sent.
+        - ``ERROR``: the subscription's WHERE/projection raised during
+          matching; the server killed it (``result`` is the message).
+        """
         live_id = _live_key(self.engine.call("live", [table, diff]))
         self.engine.register_live(live_id, callback)
         return live_id

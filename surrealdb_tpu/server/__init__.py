@@ -653,7 +653,10 @@ class SurrealHandler(BaseHTTPRequestHandler):
         self.close_connection = True
         self._ws_serve(fmt=proto or "json")
 
-    def _ws_send(self, payload):
+    @staticmethod
+    def _ws_frame(payload) -> bytes:
+        """One complete RFC6455 server frame for `payload` (bytes →
+        binary opcode, str → text)."""
         if isinstance(payload, bytes):
             data, header = payload, b"\x82"  # FIN + binary (cbor)
         else:
@@ -665,8 +668,11 @@ class SurrealHandler(BaseHTTPRequestHandler):
             header += struct.pack("!BH", 126, n)
         else:
             header += struct.pack("!BQ", 127, n)
+        return header + data
+
+    def _ws_send(self, payload):
         with self._ws_lock:
-            self.connection.sendall(header + data)
+            self.connection.sendall(self._ws_frame(payload))
 
     def _ws_recv(self):
         """Read one frame; returns (opcode, payload) or None on close."""
@@ -712,22 +718,44 @@ class SurrealHandler(BaseHTTPRequestHandler):
             unpack = lambda data: json.loads(data.decode())
             jsonify = to_json
 
-        # live-query notification forwarding
-        def on_notify(notification):
-            if notification.live_id in rs.live_ids:
-                try:
-                    self._ws_send(pack({
-                        "result": {
-                            "id": notification.live_id,
-                            "action": notification.action,
-                            "record": jsonify(notification.record),
-                            "result": jsonify(notification.result),
-                        }
-                    }))
-                except OSError:
-                    pass
+        # live-query notification push: the session actor is read/write
+        # split (reference rpc/websocket.rs:47) — THIS thread only reads
+        # requests; notifications flow through a bounded per-session
+        # outbox drained by a dedicated writer thread, so a consumer
+        # whose TCP window is full stalls only its own writer, never a
+        # committing transaction or another session
+        def send_notes(notes):
+            frames = bytearray()
+            for n in notes:
+                frames += self._ws_frame(pack({
+                    "result": {
+                        "id": n.live_id,
+                        "action": n.action,
+                        "record": jsonify(n.record),
+                        "result": jsonify(n.result),
+                    }
+                }))
+            # burst coalescing: one sendall for the whole batch
+            with self._ws_lock:
+                self.connection.sendall(bytes(frames))
 
-        self.ds.notification_handlers.append(on_notify)
+        def force_close():
+            # overflow policy "disconnect": kick the laggard — the read
+            # loop unblocks with EOF and the finally-block GC runs
+            try:
+                self.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+        outbox = self.ds.fanout.register_session(
+            send_notes, close_conn=force_close,
+            label=f"{self.client_address[0]}:{self.client_address[1]}"
+            if self.client_address else "",
+        )
+        # the LIVE statement itself binds lid→outbox atomically with
+        # subscription registration (exec/statements.py _s_live) —
+        # binding only at the rpc layer would race dispatch
+        rs.session.live_outbox = outbox
         try:
             while True:
                 frame = self._ws_recv()
@@ -809,10 +837,13 @@ class SurrealHandler(BaseHTTPRequestHandler):
                         "error": {"code": -32000, "message": str(e)},
                     }))
         finally:
-            try:
-                self.ds.notification_handlers.remove(on_notify)
-            except ValueError:
-                pass
+            # session teardown: stop routing, then GC this session's
+            # live queries (registry entries + persisted !lq rows) — a
+            # session that dies without KILL must not keep paying match
+            # cost on every write forever
+            self.ds.fanout.unregister_session(outbox)
+            if rs.live_ids:
+                self.ds.gc_session_lives(rs.live_ids)
 
 
 def make_server(ds: Datastore, host="127.0.0.1", port=8000,
@@ -906,6 +937,14 @@ def drain_and_shutdown(srv, ds: Datastore, drain_timeout_s: float) -> bool:
         end = time.monotonic() + 2.0
         while ds.inflight.count() > 0 and time.monotonic() < end:
             time.sleep(0.02)
+    # push-path drain: flush committed-but-undispatched notifications,
+    # give session writers a beat to deliver their queues, then close —
+    # the CancelEvent wakers wake parked writers immediately
+    ds.fanout.drain(timeout=min(drain_timeout_s, 5.0))
+    ds.fanout.close_all()
+    cf_gc = getattr(srv, "cf_gc_handle", None)
+    if cf_gc is not None:
+        cf_gc.cancel()
     srv.shutdown()
     # the DeviceRunner holds nothing durable (its caches rebuild from
     # KV truth) — kill it with the server instead of leaving an orphan
@@ -944,6 +983,22 @@ def serve(ds: Datastore, host="127.0.0.1", port=8000, unauthenticated=False,
     # served nodes join the cluster: heartbeat + membership GC loops
     # (reference engine/tasks.rs); embedded datastores stay single-node
     ds.start_node_tasks()
+    # changefeed GC rides the Runtime seam as a served-node background
+    # task (reference engine/tasks.rs:48-56 — it existed but nothing
+    # ever scheduled it); single cluster winner via TaskLease inside
+    from surrealdb_tpu import cf as _cf
+    from surrealdb_tpu.kvs import net as _net
+
+    if cnf.CHANGEFEED_RETENTION_S > 0:
+        def _cf_tick():
+            # drop the purge count: a numeric tick return overrides the
+            # loop's next delay (Runtime.every contract)
+            _cf.changefeed_gc_tick(ds)
+
+        srv.cf_gc_handle = _net.REAL_RUNTIME.every(
+            cnf.CHANGEFEED_GC_INTERVAL_S, _cf_tick,
+            name="surreal-cf-gc",
+        )
     # prewarm the device runner at boot (async): jax/TPU init happens in
     # the supervised subprocess under the init watchdog while the server
     # is already accepting — early queries serve from host, traffic

@@ -335,6 +335,317 @@ class _Driver:
                 cl.net.heal(*args) if args else cl.net.heal()
 
 
+class LiveSimConfig:
+    """Knobs for the live-query fan-out simulation (run_live_sim)."""
+
+    def __init__(self, sessions=4, writers=3, tables=2,
+                 ops_per_writer=30, queue_depth=4, freeze_prob=0.12,
+                 crash_prob=0.06, poison=True):
+        self.sessions = sessions
+        self.writers = writers
+        self.tables = tables
+        self.ops_per_writer = ops_per_writer
+        self.queue_depth = queue_depth  # tiny: overflow must trigger
+        self.freeze_prob = freeze_prob  # consumer stalls mid-stream
+        self.crash_prob = crash_prob  # session dies + reconnects
+        self.poison = poison  # include an eval-error subscription
+
+
+# (sql condition, ground-truth predicate over the event's doc); None
+# predicate marks the poison cond — it must ERROR at eval time, never
+# match, and never fail the write
+_LIVE_CONDS = {
+    "all": ("", lambda doc: True),
+    "big": (" WHERE v >= 5", lambda doc: isinstance(doc, dict)
+            and doc.get("v", 0) >= 5),
+    "poison": (" WHERE string::len(v) > 0", None),
+}
+
+
+def _note_key(action: str, rid_str: str, payload) -> str:
+    if action == "UPDATE":
+        s = payload.get("s") if isinstance(payload, dict) else None
+        return f"U:{rid_str}:{s}"
+    return f"{action[0]}:{rid_str}"
+
+
+def run_live_sim(seed: int,
+                 cfg: Optional[LiveSimConfig] = None) -> SimResult:
+    """Deterministic fan-out simulation over the REAL engine: writers
+    commit through Datastore.execute, live subscriptions register
+    through LIVE SELECT, and the fan-out hub runs in manual mode — its
+    dispatch and per-session delivery pumps are kernel tasks whose
+    interleaving (plus consumer freezes, session crash/reconnects, and
+    queue overflows at a tiny depth) is chosen by the seeded scheduler.
+    The delivery invariant (sim/invariants.py check_live_delivery) then
+    holds the protocol to: every committed matching write delivered
+    exactly once in commit order, or the session explicitly flagged
+    overflowed."""
+    from surrealdb_tpu.kvs.ds import Datastore
+
+    cfg = cfg or LiveSimConfig()
+    res = SimResult()
+    res.seed = seed
+    kernel = Kernel(seed)
+    ds = Datastore("pymem")
+    hub = ds.fanout
+    hub.manual = True  # no threads: the kernel owns all execution
+    # per-table commit-order oracle: {"key", "match": {cond: bool}}
+    event_log: dict = {t: [] for t in range(cfg.tables)}
+    seq = [0]
+    writers_done = [False]
+    stop_all = [False]
+    subs_final: list[dict] = []  # evaluated after quiesce
+    poison_subs = [0]
+
+    def _tb(t):
+        return f"lt{t}"
+
+    def _log_event(t, action, rid_str, doc):
+        key = _note_key(action, rid_str, doc)
+        match = {}
+        for cname, (_sql, pred) in _LIVE_CONDS.items():
+            match[cname] = bool(pred(doc)) if pred is not None else False
+        event_log[t].append({"key": key, "match": match})
+        kernel.log("commit", tb=_tb(t), key=key)
+
+    def _writer(w):
+        rng = kernel.rng
+        own: list = []  # rids alive, as (rid_str, t)
+        for j in range(cfg.ops_per_writer):
+            t = rng.randrange(cfg.tables)
+            tb = _tb(t)
+            r = rng.random()
+            seq[0] += 1
+            s = seq[0]
+            v = rng.randrange(10)
+            if r < 0.55 or not own:
+                rid = f"{tb}:w{w}x{j}"
+                out = ds.execute(
+                    f"CREATE {rid} SET v = {v}, s = {s}",
+                    ns="t", db="t",
+                )
+                if out[-1].error is None:
+                    _log_event(t, "CREATE", rid, {"v": v, "s": s})
+                    own.append((rid, t))
+                else:
+                    res.errors.append(f"write failed: {out[-1].error}")
+            elif r < 0.75:
+                rid, rt = own[rng.randrange(len(own))]
+                out = ds.execute(
+                    f"UPDATE {rid} SET v = {v}, s = {s}",
+                    ns="t", db="t",
+                )
+                if out[-1].error is None:
+                    _log_event(rt, "UPDATE", rid, {"v": v, "s": s})
+            elif r < 0.85:
+                i = rng.randrange(len(own))
+                rid, rt = own.pop(i)
+                # ground truth for DELETE: doc is the BEFORE value;
+                # read it before deleting
+                pre = ds.execute(f"SELECT * FROM {rid}",
+                                 ns="t", db="t")[-1].result
+                out = ds.execute(f"DELETE {rid}", ns="t", db="t")
+                if out[-1].error is None:
+                    doc = pre[0] if pre else {}
+                    _log_event(rt, "DELETE", rid, doc)
+            elif r < 0.93:
+                # cancelled transaction: its events MUST NOT deliver
+                ds.execute(
+                    f"BEGIN; CREATE {tb}:x{w}c{j} SET v = {v}, "
+                    f"s = {s}; CANCEL;",
+                    ns="t", db="t",
+                )
+            else:
+                # failed explicit transaction: savepoint-truncated
+                # events MUST NOT deliver either
+                ds.execute(
+                    f"BEGIN; CREATE {tb}:x{w}f{j} SET v = {v}, "
+                    f"s = {s}; THROW 'boom'; COMMIT;",
+                    ns="t", db="t",
+                )
+            kernel.sleep(0.02 + rng.random() * 0.2)
+
+    def _dispatcher():
+        rng = kernel.rng
+        while not stop_all[0]:
+            hub.pump_dispatch(1 + rng.randrange(3))
+            kernel.sleep(0.01 + rng.random() * 0.08)
+
+    def _session(si):
+        rng = kernel.rng
+        epoch = 0
+        while True:
+            epoch += 1
+            delivered: dict = {}  # lid -> list
+
+            def recv(notes, delivered=delivered):
+                for n in notes:
+                    lid = str(n.live_id)
+                    log = delivered.setdefault(lid, [])
+                    if n.action == "OVERFLOW":
+                        log.append(("overflow",
+                                    n.result.get("dropped")))
+                        kernel.log("overflow", session=si)
+                    elif n.action == "ERROR":
+                        log.append(("error", str(n.result)[:40]))
+                        kernel.log("poisoned", session=si)
+                    else:
+                        r = n.record
+                        rid_str = f"{r.tb}:{r.id}"
+                        key = _note_key(n.action, rid_str, n.result)
+                        log.append(("note", key))
+                        kernel.log("deliver", session=si, key=key)
+
+            ob = hub.register_session(recv, label=f"s{si}",
+                                      depth=cfg.queue_depth)
+            my_subs = []
+            conds = ["all", "big"]
+            if cfg.poison and si == 0 and epoch == 1:
+                conds = ["all", "poison"]
+                poison_subs[0] += 1
+            for ci, cname in enumerate(conds):
+                t = (si + ci) % cfg.tables
+                sql_cond, _pred = _LIVE_CONDS[cname]
+                out = ds.execute(
+                    f"LIVE SELECT * FROM {_tb(t)}{sql_cond}",
+                    ns="t", db="t",
+                )
+                lid = str(out[-1].result.u)
+                hub.bind(lid, ob)
+                rec = {"label": f"s{si}e{epoch}/{_tb(t)}/{cname}",
+                       "lid": lid, "t": t, "cond": cname,
+                       "start": len(event_log[t]), "end": None,
+                       "delivered": delivered, "complete": False}
+                my_subs.append(rec)
+                subs_final.append(rec)
+            crashed = False
+            while not stop_all[0]:
+                r = rng.random()
+                if r < cfg.freeze_prob:
+                    kernel.sleep(1.5 + rng.random() * 3.0)  # frozen
+                elif r < cfg.freeze_prob + cfg.crash_prob \
+                        and not writers_done[0]:
+                    crashed = True
+                    break
+                else:
+                    ob.pump()
+                    kernel.sleep(0.03 + rng.random() * 0.15)
+            if crashed:
+                # die without KILL: the server-close path GCs us
+                for rec in my_subs:
+                    rec["end"] = len(event_log[rec["t"]])
+                hub.unregister_session(ob)
+                ds.gc_session_lives([rec["lid"] for rec in my_subs])
+                kernel.log("session_crash", session=si)
+                kernel.sleep(0.5 + rng.random() * 2.0)
+                continue  # reconnect: new epoch, new subscriptions
+            # quiesce: drain everything still queued for us, then close
+            # like a graceful session would (unroute + GC our subs)
+            while ob.pump():
+                pass
+            for rec in my_subs:
+                rec["end"] = len(event_log[rec["t"]])
+                rec["complete"] = True
+            hub.unregister_session(ob)
+            ds.gc_session_lives([rec["lid"] for rec in my_subs])
+            return
+
+    def main():
+        wtasks = [kernel.spawn(f"w{w}", (lambda w=w: _writer(w)))
+                  for w in range(cfg.writers)]
+        stasks = [kernel.spawn(f"s{si}", (lambda si=si: _session(si)))
+                  for si in range(cfg.sessions)]
+        dtask = kernel.spawn("dispatch", _dispatcher, daemon=True)
+        kernel.join(wtasks)
+        writers_done[0] = True
+        # drain dispatch fully so every committed event is routed
+        while hub.pump_dispatch(16):
+            pass
+        stop_all[0] = True
+        kernel.join(stasks)
+        kernel.join([dtask])
+        kernel.shutdown()
+
+    with kvnet.use_clock(SimClock(kernel)):
+        kernel.run(main)
+
+    # ---- evaluate the delivery invariant (outside the kernel) -----------
+    with kvnet.use_clock(kvnet.REAL_CLOCK):
+        delivered_total = 0
+        overflow_total = 0
+        for rec in subs_final:
+            log = rec["delivered"].get(rec["lid"], [])
+            delivered_total += sum(1 for x in log if x[0] == "note")
+            overflow_total += sum(1 for x in log if x[0] == "overflow")
+            if rec["cond"] == "poison":
+                # must be poisoned, not matched: any real note is a
+                # failure of the typed-poison contract; a sub that
+                # SURVIVED to quiesce with events in its window must
+                # have been poisoned (ERROR note + counter)
+                if any(x[0] == "note" for x in log):
+                    res.violations.append(
+                        f"POISONED SUB DELIVERED {rec['label']}: {log!r}"
+                    )
+                window = event_log[rec["t"]][rec["start"]:rec["end"]]
+                if rec["complete"] and window \
+                        and not any(x[0] == "error" for x in log):
+                    res.violations.append(
+                        f"POISON SUB NOT POISONED {rec['label']}: "
+                        f"{len(window)} events evaluated, no typed "
+                        f"ERROR delivered"
+                    )
+                continue
+            expected = [
+                e["key"]
+                for e in event_log[rec["t"]][rec["start"]:rec["end"]]
+                if e["match"][rec["cond"]]
+            ]
+            res.violations += inv.check_live_delivery(
+                rec["label"], expected, log,
+                complete=rec["complete"],
+            )
+        poisoned_count = ds.telemetry.get("live_eval_errors")
+        delivered_errors = sum(
+            1 for rec in subs_final
+            for x in rec["delivered"].get(rec["lid"], [])
+            if x[0] == "error"
+        )
+        if delivered_errors and not poisoned_count:
+            res.violations.append(
+                "POISON DELIVERED BUT NEVER COUNTED: "
+                "live_eval_errors is 0"
+            )
+        if ds.live_queries:
+            res.violations.append(
+                f"LIVE REGISTRY LEAK: {len(ds.live_queries)} "
+                f"subscriptions survive quiesce"
+            )
+        ds.close()
+    res.errors += list(kernel.errors)
+    res.trace = kernel.trace
+    res.trace_digest = hashlib.sha256(
+        "\n".join(kernel.trace).encode()
+    ).hexdigest()
+    h = hashlib.sha256()
+    for rec in sorted(subs_final, key=lambda r: r["label"]):
+        h.update(rec["label"].encode())
+        for item in rec["delivered"].get(rec["lid"], []):
+            h.update(repr((item[0], item[1] if len(item) > 1 else None))
+                     .encode())
+    res.store_digest = h.hexdigest()
+    res.virtual_s = kernel.now
+    res.stats = {
+        "events": kernel.events,
+        "commits": sum(len(v) for v in event_log.values()),
+        "delivered": delivered_total,
+        "overflows": overflow_total,
+        "poisoned": poison_subs[0],
+        "subs": len(subs_final),
+    }
+    return res
+
+
 def run_sim(seed: int, cfg: Optional[SimConfig] = None,
             data_root: Optional[str] = None,
             mutate=None) -> SimResult:

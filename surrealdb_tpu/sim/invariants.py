@@ -183,6 +183,82 @@ def check_lease_safety(events: list, node_group: dict) -> list[str]:
     return out
 
 
+def check_live_delivery(label: str, expected: list, delivered: list,
+                        complete: bool = True) -> list[str]:
+    """Live-query delivery invariant (server/fanout.py): every committed
+    matching write is delivered EXACTLY ONCE in COMMIT ORDER, or the
+    subscription is explicitly told it overflowed.
+
+    `expected` is the committed matching event keys in commit order
+    (keys unique). `delivered` is what the session observed for one
+    subscription: ("note", key) | ("overflow", dropped) | ("error",
+    msg) items in arrival order. An OVERFLOW licenses exactly one
+    forward gap (the dropped backlog); an ERROR (poisoned
+    subscription) ends the stream. With `complete` (session survived
+    to quiesce and drained), the stream must reach the end of
+    `expected` unless an overflow or error explains the missing tail.
+    """
+    out = []
+    index = {}
+    for i, k in enumerate(expected):
+        if k in index:
+            out.append(f"LIVE ORACLE BROKEN {label}: duplicate "
+                       f"expected key {k!r}")
+        index[k] = i
+    pos = 0  # next expected index
+    gap_ok = False
+    seen: set = set()
+    errored = False
+    for item in delivered:
+        kind = item[0]
+        if errored:
+            out.append(
+                f"LIVE DELIVERY {label}: {item!r} arrived after the "
+                f"subscription was poisoned (typed ERROR must be last)"
+            )
+            break
+        if kind == "overflow":
+            gap_ok = True
+            continue
+        if kind == "error":
+            errored = True
+            continue
+        key = item[1]
+        i = index.get(key)
+        if i is None:
+            out.append(
+                f"LIVE PHANTOM {label}: delivered {key!r} was never a "
+                f"committed matching write"
+            )
+            continue
+        if key in seen:
+            out.append(f"LIVE DUPLICATE {label}: {key!r} delivered "
+                       f"twice")
+            continue
+        if i < pos:
+            out.append(
+                f"LIVE OUT OF ORDER {label}: {key!r} (commit index "
+                f"{i}) arrived after index {pos - 1}"
+            )
+            continue
+        if i > pos and not gap_ok:
+            out.append(
+                f"LIVE GAP {label}: jumped from commit index {pos} to "
+                f"{i} with no OVERFLOW notice — "
+                f"{expected[pos:i][:4]!r} silently lost"
+            )
+        seen.add(key)
+        pos = i + 1
+        gap_ok = False
+    if complete and not errored and pos < len(expected) and not gap_ok:
+        out.append(
+            f"LIVE UNDELIVERED TAIL {label}: {len(expected) - pos} "
+            f"committed matching writes never delivered and no "
+            f"OVERFLOW notice (first: {expected[pos]!r})"
+        )
+    return out
+
+
 def check_staged_leak(engines) -> list[str]:
     """After convergence no 2PC stage survives: every prepared
     transaction reached a decision."""

@@ -42,6 +42,20 @@ re-litigating:
    rounds, ROUND5_NOTES) on a live query thread. Bench/tooling outside
    `surrealdb_tpu/` is not scanned.
 
+7. **No blocking delivery on the commit path** — the live-query fan-out
+   contract (server/fanout.py): `Datastore.notify` and the doc-pipeline
+   lives stage (`exec/document.py::notify_lives`) must never invoke a
+   notification handler or touch a socket while holding `ds.lock` /
+   `self.lock`, and must never contain a socket send at all — one
+   stalled consumer's full TCP buffer must not stall a committing
+   writer. Enforced structurally: inside those functions (plus the
+   hub's `deliver`, which `notify` delegates to), a `with ...lock:`
+   block may only call a small allowlist of queue/bookkeeping methods;
+   any other call (handler invocation `h(...)`, `.sendall`, `.send`,
+   `._ws_send`, telemetry, logging) under the lock is a finding, as is
+   a send-like call anywhere in the function. The functions' existence
+   is also asserted so a rename cannot silently retire the rule.
+
 Usage:  python tools/check_robustness.py [root]
 Exit status 1 when any finding survives.
 """
@@ -75,6 +89,21 @@ _SEAM_FORBIDDEN = {
     ("socket", "socket"),
     ("socket", "create_connection"),
 }
+
+# rule 7: the notify/capture/deliver functions the fan-out contract
+# covers, per file. Each must exist (a rename silently retiring the
+# rule is itself a finding).
+_NOTIFY_FNS = {
+    "surrealdb_tpu/kvs/ds.py": ("notify",),
+    "surrealdb_tpu/exec/document.py": ("notify_lives",),
+    "surrealdb_tpu/server/fanout.py": ("deliver",),
+}
+# attribute calls allowed inside a `with ...lock:` block of a rule-7
+# function: queue/bookkeeping only
+_NOTIFY_LOCK_OK = {"append", "pop", "popleft", "get", "clear",
+                   "count_for", "add", "discard"}
+# send-like attribute calls forbidden ANYWHERE in a rule-7 function
+_SEND_ATTRS = {"sendall", "send", "_ws_send", "sendto", "write"}
 
 # rule 5: the only places inside the package allowed to import jax —
 # the supervised runner tree and the kernel library it dispatches to
@@ -118,6 +147,75 @@ def _calls_attr(tree: ast.AST, attr: str) -> bool:
                 and n.func.attr == attr:
             return True
     return False
+
+
+_NOTIFY_BUILTIN_OK = {"len", "list", "bytes", "isinstance", "getattr",
+                      "str", "dict", "set", "sorted"}
+
+
+def _is_lock_ctx(item: ast.withitem) -> bool:
+    """True when a with-item looks like a lock/condition acquisition
+    (`with self.lock:`, `with ds.lock:`, `with self.cond:`)."""
+    e = item.context_expr
+    if isinstance(e, ast.Attribute):
+        return "lock" in e.attr or "cond" in e.attr
+    if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute):
+        return "lock" in e.func.attr
+    return False
+
+
+def _check_notify_fns(tree, rel, lines, fn_names) -> list[str]:
+    """Rule 7: inside the named functions, no send-like call anywhere,
+    and under a `with ...lock:` block only allowlisted queue ops."""
+    found = set()
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef) \
+                or node.name not in fn_names:
+            continue
+        found.add(node.name)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _SEND_ATTRS \
+                    and not _pragma(lines, sub.lineno):
+                findings.append(
+                    f"{rel}:{sub.lineno}: `{sub.func.attr}(` inside "
+                    f"{node.name} — socket I/O is never allowed on the "
+                    f"notify/capture path (route through a session "
+                    f"outbox writer)"
+                )
+            if not isinstance(sub, ast.With):
+                continue
+            if not any(_is_lock_ctx(it) for it in sub.items):
+                continue
+            for inner in ast.walk(sub):
+                if inner is sub or not isinstance(inner, ast.Call):
+                    continue
+                f = inner.func
+                ok = (
+                    (isinstance(f, ast.Attribute)
+                     and f.attr in _NOTIFY_LOCK_OK)
+                    or (isinstance(f, ast.Name)
+                        and f.id in _NOTIFY_BUILTIN_OK)
+                )
+                if not ok and not _pragma(lines, inner.lineno):
+                    label = (f.attr if isinstance(f, ast.Attribute)
+                             else getattr(f, "id", "<call>"))
+                    findings.append(
+                        f"{rel}:{inner.lineno}: call `{label}(` under "
+                        f"a lock inside {node.name} — handler "
+                        f"invocation / blocking work while holding the "
+                        f"datastore lock stalls every writer (rule 7)"
+                    )
+    for name in fn_names:
+        if name not in found:
+            findings.append(
+                f"{rel}:1: rule-7 function `{name}` not found — the "
+                f"fan-out delivery contract is no longer being checked "
+                f"(update _NOTIFY_FNS after a rename)"
+            )
+    return findings
 
 
 def check_file(path: str, rel: str) -> list[str]:
@@ -201,6 +299,11 @@ def check_file(path: str, rel: str) -> list[str]:
                         f"2PC decision path {fn.name} — count it, "
                         f"re-raise, or add a `# robust:` pragma"
                     )
+    # 7. non-blocking delivery contract for the fan-out functions
+    if rel_fwd in _NOTIFY_FNS:
+        findings.extend(
+            _check_notify_fns(tree, rel, lines, _NOTIFY_FNS[rel_fwd])
+        )
     # 3. streaming operators must stay deadline-checked
     if rel.endswith(os.path.join("exec", "stream.py")):
         for node in ast.iter_child_nodes(tree):

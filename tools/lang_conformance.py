@@ -161,6 +161,44 @@ def ann_smoke(recall_floor: float = 0.95) -> "str | None":
     return None
 
 
+def live_smoke() -> "str | None":
+    """Live fan-out gate (the push-path overload spine): a small
+    real-socket soak — 8 WS sessions (one frozen mid-stream), writers
+    streaming CREATEs — must deliver every committed write to every
+    live session exactly once in commit order, keep write throughput
+    decoupled from the frozen consumer, and GC every subscription when
+    the sessions disconnect without KILL. Returns None on pass."""
+    from bench import live_soak
+
+    r = live_soak(sessions=8, frozen=1, writers=2, writes=200,
+                  depth=64, settle_s=12.0)
+    n_live = r["sessions"] - r["frozen"]
+    if r["per_session_complete"] != n_live:
+        return (f"only {r['per_session_complete']}/{n_live} live "
+                f"sessions received every committed write "
+                f"(delivered={r['delivered']})")
+    if r["order_violations"]:
+        return (f"{r['order_violations']} commit-order violations in "
+                f"delivered notifications")
+    if r["live_sessions_end"]:
+        return (f"{r['live_sessions_end']} live queries leaked after "
+                f"every session disconnected without KILL")
+    # the hard ±10% decoupling assertion (single frozen subscriber, no
+    # fan-out CPU share) lives in tests/test_live_fanout.py; here the
+    # fleet shares one CI core with 7 live consumers, so the gate only
+    # pins "writers make real progress while a consumer is frozen"
+    if r["decoupling_ratio"] < 0.35:
+        return (f"write throughput collapsed under fan-out: "
+                f"{r['write_qps_fanout']} qps vs "
+                f"{r['write_qps_base']} qps baseline "
+                f"(ratio {r['decoupling_ratio']})")
+    print(f"== live smoke: OK — {r['value']} notif/s to "
+          f"{n_live} sessions, p50 {r['delivery_p50_ms']}ms p99 "
+          f"{r['delivery_p99_ms']}ms, decoupling "
+          f"{r['decoupling_ratio']}x, 0 leaks")
+    return None
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("filter", nargs="?", default=None)
@@ -281,6 +319,13 @@ def main():
     err = ann_smoke()
     if err is not None:
         print(f"== ann smoke: FAIL — {err}")
+        rc = rc or 1
+    # live smoke: the fan-out spine's small real-socket config —
+    # exactly-once commit-order delivery, frozen-consumer decoupling,
+    # disconnect GC
+    err = live_smoke()
+    if err is not None:
+        print(f"== live smoke: FAIL — {err}")
         rc = rc or 1
     return rc
 
