@@ -1227,6 +1227,283 @@ def bench_live_fanout(quick=False):
                      reconnects=max(sessions // 10, 4), settle_s=20.0)
 
 
+def _spawn_kv_proc(port, role, peers, data_dir,
+                   failover_timeout=1.0, lease_ttl=0.8):
+    """One replica-set member as a real subprocess — SIGKILL mid-run is
+    a genuine hard death, not a simulated one."""
+    import socket as _socket
+    import subprocess
+
+    p = subprocess.Popen(
+        [sys.executable, "-m", "surrealdb_tpu", "kv",
+         "--bind", f"127.0.0.1:{port}", "--role", role,
+         "--peers", ",".join(peers),
+         "--failover-timeout", str(failover_timeout),
+         "--lease-ttl", str(lease_ttl),
+         "--data-dir", data_dir, "--no-fsync"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "SURREAL_DEVICE": "off"},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    for _ in range(150):
+        try:
+            _socket.create_connection(("127.0.0.1", port),
+                                      timeout=0.2).close()
+            return p
+        except OSError:
+            time.sleep(0.1)
+    p.kill()
+    raise RuntimeError(f"kv {role} on :{port} did not come up")
+
+
+def _free_port():
+    import socket as _socket
+
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _bulk_vectors_sharded(ds, ns, db, tb, ix_name, xs, chunk=512):
+    """Chunked ingest through the ROUTING client (records + index
+    state + version bumps); chunks keep per-commit writesets sane on a
+    sharded store (cross-shard chunks run real 2PC)."""
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.kvs.api import serialize
+    from surrealdb_tpu.val import RecordId
+
+    n = xs.shape[0]
+    for s in range(0, n, chunk):
+        txn = ds.transaction(write=True)
+        try:
+            for i in range(s, min(s + chunk, n)):
+                txn.set(K.record(ns, db, tb, i),
+                        serialize({"id": RecordId(tb, i)}))
+                txn.set_val(
+                    K.ix_state(ns, db, tb, ix_name, b"he",
+                               K.enc_value(i)),
+                    xs[i].tobytes(),
+                )
+            txn.set_val(K.ix_state(ns, db, tb, ix_name, b"vn"),
+                        min(s + chunk, n))
+            txn.commit()
+        except BaseException:
+            txn.cancel()
+            raise
+
+
+def bench_knn_sharded(quick=False, groups=2):
+    """BENCH family `knn_sharded`: scatter-gather KNN over a REAL
+    multi-group sharded cluster — every group a primary+replica pair of
+    subprocess KV servers, the element keyspace cut so each group owns
+    a slice of the index rows (idx/shardvec.py). Clustered data.
+
+    Emits: aggregate + per-shard fan-out qps, merge recall@10 vs the
+    single-node oracle, p50/p99 latency, and the failover story —
+    one element-shard primary SIGKILLed mid-run must yield ZERO wrong
+    answers (only typed partial/retried ones, SURREAL_KNN_PARTIAL=
+    partial) with recovery to full answers after the replica promotes.
+    Baseline: the SAME data served by one single-node remote KV (the
+    PR-1 deployment sharding replaces); gate aggregate_qps >= 1x it."""
+    import shutil
+    import signal
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from surrealdb_tpu import Datastore, cnf
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.kvs.shard import init_topology
+
+    n = 20_000 if quick else 60_000
+    dim = 64
+    k = 10
+    nq = 16
+    q_phase = 240 if quick else 600
+    threads = 8
+    xs, rng = _clustered_rows(n, dim, 64, 0.15, 31)
+    qs = xs[rng.integers(0, n, nq)] + 0.05 * rng.normal(
+        size=(nq, dim)
+    ).astype(np.float32)
+    # exact ground truth (the single-node oracle's answers)
+    xn = xs.astype(np.float64)
+    truth = []
+    for q in qs:
+        d = np.linalg.norm(xn - q.astype(np.float64)[None, :], axis=1)
+        truth.append([int(i) for i in np.argsort(d, kind="stable")[:k]])
+    hek = lambda i: K.ix_state("b", "b", "tbl", "ix", b"he",  # noqa: E731
+                               K.enc_value(i))
+    cuts = [hek(n * g // groups) for g in range(1, groups)]
+    tmp = tempfile.mkdtemp(prefix="bench-knnsh-")
+    procs = []
+    group_addrs = []
+    sql = f"SELECT id FROM tbl WHERE emb <|{k}|> $q"
+
+    def _define(ds):
+        ds.query(
+            f"DEFINE TABLE tbl; DEFINE INDEX ix ON tbl FIELDS emb "
+            f"HNSW DIMENSION {dim} DIST EUCLIDEAN TYPE F32",
+            ns="b", db="b",
+        )
+
+    def _drive(ds, n_queries, lats=None, outcomes=None):
+        def one(i):
+            t0 = time.perf_counter()
+            r = ds.execute(sql, ns="b", db="b",
+                           vars={"q": qs[i % nq].tolist()})[-1]
+            dt = time.perf_counter() - t0
+            if lats is not None:
+                lats.append(dt)
+            if outcomes is None:
+                return
+            if r.error is not None:
+                outcomes.append(("error", i % nq))
+            elif r.partial:
+                outcomes.append(("partial", i % nq))
+            else:
+                got = [row["id"].id for row in r.result]
+                outcomes.append((
+                    "full" if got == truth[i % nq] else "wrong",
+                    i % nq,
+                ))
+
+        with ThreadPoolExecutor(threads) as ex:
+            t0 = time.perf_counter()
+            list(ex.map(one, range(n_queries)))
+            return n_queries / (time.perf_counter() - t0)
+
+    saved_partial = cnf.KNN_PARTIAL
+    saved_budget = cnf.KNN_SHARD_TIMEOUT_S
+    try:
+        # ---- boot the cluster: `groups` primary+replica pairs -------
+        for g in range(groups):
+            ports = [_free_port(), _free_port()]
+            addrs = [f"127.0.0.1:{p}" for p in ports]
+            procs.append(_spawn_kv_proc(
+                ports[0], "primary", addrs, f"{tmp}/g{g}p"))
+            procs.append(_spawn_kv_proc(
+                ports[1], "replica", addrs, f"{tmp}/g{g}r"))
+            group_addrs.append(addrs)
+        init_topology(group_addrs, cuts)
+        ds = Datastore(f"shard://{','.join(group_addrs[0])}")
+        _define(ds)
+        t0 = time.perf_counter()
+        _bulk_vectors_sharded(ds, "b", "b", "tbl", "ix", xs)
+        ingest_s = time.perf_counter() - t0
+        # ---- steady state: fan-out qps + recall ---------------------
+        cnf.KNN_PARTIAL = "partial"
+        cnf.KNN_SHARD_TIMEOUT_S = 2.0
+        _drive(ds, threads * 2)  # warm: sync parts, pin pools
+        fan0 = ds.telemetry.get("knn_shard_fanout")
+        lats: list = []
+        outcomes: list = []
+        qps = _drive(ds, q_phase, lats, outcomes)
+        fanout_qps = (ds.telemetry.get("knn_shard_fanout") - fan0) \
+            * qps / max(q_phase, 1)
+        assert all(o == "full" for o, _ in outcomes), \
+            "steady state must answer fully"
+        hits = sum(
+            len(set(truth[iq]) & set(
+                row["id"].id for row in ds.execute(
+                    sql, ns="b", db="b", vars={"q": qs[iq].tolist()}
+                )[-1].result
+            )) for iq in range(nq)
+        )
+        recall = hits / (k * nq)
+        # ---- SIGKILL one element-shard primary mid-run --------------
+        victim = procs[2]  # group 1's primary (an element-range group)
+        kill_lats: list = []
+        kill_outcomes: list = []
+
+        def killer():
+            time.sleep(0.4)
+            victim.send_signal(signal.SIGKILL)
+            victim.wait()
+
+        import threading as _threading
+
+        kt = _threading.Thread(target=killer)
+        kt.start()
+        _drive(ds, q_phase, kill_lats, kill_outcomes)
+        kt.join()
+        wrong = sum(1 for o, _ in kill_outcomes if o == "wrong")
+        partials = sum(1 for o, _ in kill_outcomes if o == "partial")
+        errs = sum(1 for o, _ in kill_outcomes if o == "error")
+        # ---- recovery: full answers must resume post-failover -------
+        recovered = False
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            r = ds.execute(sql, ns="b", db="b",
+                           vars={"q": qs[0].tolist()})[-1]
+            if r.error is None and not r.partial \
+                    and [row["id"].id for row in r.result] == truth[0]:
+                recovered = True
+                break
+            time.sleep(0.3)
+        shard_info = ds.query("INFO FOR SYSTEM",
+                              ns="b", db="b")[0].get("knn")
+        hedged = ds.telemetry.get("knn_hedged_dispatches")
+        n_partial_res = ds.telemetry.get("knn_partial_results")
+        ds.close()
+        # ---- single-node oracle: ONE remote KV group, same stack ----
+        port = _free_port()
+        procs.append(_spawn_kv_proc(
+            port, "primary", [f"127.0.0.1:{port}"], f"{tmp}/single"))
+        ds1 = Datastore(f"remote://127.0.0.1:{port}")
+        _define(ds1)
+        _bulk_vectors_sharded(ds1, "b", "b", "tbl", "ix", xs)
+        _drive(ds1, threads * 2)
+        single_qps = _drive(ds1, q_phase)
+        ds1.close()
+        lat_ms = sorted(x * 1000 for x in lats)
+        klat_ms = sorted(x * 1000 for x in kill_lats)
+
+        def _pct(a, p):
+            return round(a[min(int(len(a) * p), len(a) - 1)], 2) \
+                if a else None
+
+        return {
+            "metric": f"knn_sharded_{groups}g_{n//1000}k_{dim}d",
+            "shard_groups": groups,
+            "rows": n,
+            # 1-core honesty: each query pays one extra sub-txn
+            # lifecycle per additional shard its reads touch, and the
+            # halved per-part gemms land on the SAME core — parity
+            # with single-node needs >= 2 cores (the per-part searches
+            # and KV servers then genuinely parallelize)
+            "cores": os.cpu_count() or 1,
+            "qps": round(qps, 2),
+            "fanout_qps": round(fanout_qps, 2),
+            "single_node_qps": round(single_qps, 2),
+            "vs_single_node": round(qps / max(single_qps, 1e-9), 3),
+            "recall_at_10": round(recall, 4),
+            "p50_ms": _pct(lat_ms, 0.50),
+            "p99_ms": _pct(lat_ms, 0.99),
+            "kill_p50_ms": _pct(klat_ms, 0.50),
+            "kill_p99_ms": _pct(klat_ms, 0.99),
+            "kill_wrong_answers": wrong,
+            "kill_partial_answers": partials,
+            "kill_error_answers": errs,
+            "knn_partial_results": n_partial_res,
+            "knn_hedged_dispatches": hedged,
+            "recovered_full_answers": recovered,
+            "index_shards": (len(shard_info[0]["shards"])
+                             if shard_info else None),
+            "ingest_s": round(ingest_s, 1),
+            "clients": threads,
+            "queries": q_phase * 2,
+        }
+    finally:
+        cnf.KNN_PARTIAL = saved_partial
+        cnf.KNN_SHARD_TIMEOUT_S = saved_budget
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=5)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1235,7 +1512,9 @@ def main():
     ap.add_argument("--config", default=None,
                     choices=["hnsw100k", "knn1m", "knn10m", "ann10m",
                              "brute", "graph3hop", "hybrid",
-                             "live_fanout"])
+                             "live_fanout", "knn_sharded"])
+    ap.add_argument("--groups", type=int, default=2,
+                    help="shard groups for --config knn_sharded (2/4)")
     args = ap.parse_args()
 
     def emit(res):
@@ -1278,11 +1557,19 @@ def main():
         "graph3hop": bench_graph3hop,
         "hybrid": bench_hybrid,
         "live_fanout": bench_live_fanout,
+        "knn_sharded": bench_knn_sharded,
     }
     _probe_backend()
     if args.all:
         for name, fn in fns.items():
-            emit(fn(quick=args.quick))
+            if name == "knn_sharded":
+                emit(fn(quick=args.quick, groups=2))
+                emit(fn(quick=args.quick, groups=4))
+            else:
+                emit(fn(quick=args.quick))
+        return 0
+    if args.config == "knn_sharded":
+        emit(bench_knn_sharded(quick=args.quick, groups=args.groups))
         return 0
     if args.config:
         emit(fns[args.config](quick=args.quick))
@@ -1296,6 +1583,12 @@ def main():
         emit(bench_knn10m(quick=True))
         emit(bench_ann10m(quick=True))
         emit(bench_live_fanout(quick=True))
+        try:
+            emit(bench_knn_sharded(quick=True, groups=2))
+        except Exception as e:
+            print(f"bench: knn_sharded config failed "
+                  f"({type(e).__name__}: {e})", file=sys.stderr,
+                  flush=True)
         return 0
     if _PLATFORM == "cpu":
         # Wedged-tunnel fallback (or an explicit CPU run): the 10M×768
@@ -1314,6 +1607,13 @@ def main():
             print(f"bench: live_fanout config failed "
                   f"({type(e).__name__}: {e})", file=sys.stderr,
                   flush=True)
+        for g in (2, 4):
+            try:
+                emit(bench_knn_sharded(quick=False, groups=g))
+            except Exception as e:
+                print(f"bench: knn_sharded {g}g config failed "
+                      f"({type(e).__name__}: {e})", file=sys.stderr,
+                      flush=True)
         return 0
     smoke = bench_knn1m(quick=True)
     print(f"bench: smoke ok: {json.dumps(smoke)}", file=sys.stderr,

@@ -122,6 +122,41 @@ KNN_ANN_TAIL_FRAC = env_float("SURREAL_KNN_ANN_TAIL_FRAC", 0.25)
 #   device — always dispatch to the device when it is serving
 #   host   — always score on the host (batched)
 KNN_HOST_BATCH = env_str("SURREAL_KNN_HOST_BATCH", "auto")
+
+# -- shard-partitioned vector serving (idx/shardvec.py) ---------------------
+# partial-result policy when a shard cannot serve its slice of a KNN
+# query within budget:
+#   error   — the query fails with a typed error naming the shard (safe
+#             default: an application that never opted in can never act
+#             on a silently incomplete candidate set)
+#   partial — answer from the healthy shards, flagged in the response
+#             (QueryResult.partial names every missing shard) and
+#             counted (knn_partial_results) — never silently wrong
+KNN_PARTIAL = env_str("SURREAL_KNN_PARTIAL", "error")
+# per-shard budget (seconds) carved from the query's remaining inflight
+# deadline for one scatter attempt (sync + per-shard search); a sick
+# shard can burn at most this much of the query, not the whole budget
+KNN_SHARD_TIMEOUT_S = env_float("SURREAL_KNN_SHARD_TIMEOUT_S", 1.5)
+# bounded hedged retry: after the first scatter round, every failed
+# shard gets at most this many re-dispatches (through the group's
+# failover-following pool, against a refreshed shard map) before the
+# partial policy applies. 0 disables hedging.
+KNN_SHARD_HEDGES = env_int("SURREAL_KNN_SHARD_HEDGES", 1)
+# per-shard fetch multiplier: each shard answers ceil(k * oversample)
+# candidates. Exact (brute) parts need only 1.0 for an exact global
+# top-k; raising it buys recall when a part serves from its CAGRA
+# graph (see doc/operations.md "Distributed vector serving")
+KNN_SHARD_OVERSAMPLE = env_float("SURREAL_KNN_SHARD_OVERSAMPLE", 1.0)
+# scatter execution:
+#   auto    — per-shard SYNC attempts fan out across worker threads on
+#             real transports (they park on remote I/O, so threads
+#             genuinely overlap), sequential under an injected
+#             transport (the deterministic simulator owns all
+#             interleaving); local per-part searches stay sequential
+#             (GIL-bound: a straight loop beats thread fan-out)
+#   threads — also fan local searches out (many-core hosts)
+#   seq     — everything sequential
+KNN_SCATTER = env_str("SURREAL_KNN_SCATTER", "auto")
 # content-keyed value-decode cache (bytes); identical stored bytes skip
 # CBOR re-decode on repeated scans. 0 disables.
 DECODE_CACHE_BYTES = env_int("SURREAL_DECODE_CACHE_BYTES", 256 << 20)

@@ -35,6 +35,19 @@ class ShedError(SdbError):
         self.retry_after_s = retry_after_s
 
 
+class KnnShardUnavailable(SdbError):
+    """A scatter-gather KNN query could not get an answer from every
+    index shard within its per-shard budgets (SURREAL_KNN_PARTIAL=error
+    policy). `shards` names the missing shard(s) — range + replica
+    addresses — so the client and the operator both see WHICH slice of
+    the index the answer would have been blind to. Retryable: the shard
+    group may be mid-failover."""
+
+    def __init__(self, msg, shards=()):
+        super().__init__(msg)
+        self.shards = list(shards)
+
+
 class ParseError(SdbError):
     def __init__(self, msg, line=None, col=None):
         if line is not None:

@@ -214,6 +214,9 @@ class Executor:
                 ctx.cancel = handle.cancel
                 ctx.inflight = handle
             ctx.vars.update(shared_vars)
+            # per-statement mailbox for the sharded KNN partial flag
+            # (idx/shardvec.py writes it; the QueryResult carries it)
+            self._knn_partial = None
             try:
                 if self.session.ns and self.session.db and not ensured_nsdb:
                     # non-strict mode lazily registers the session ns/db in
@@ -252,7 +255,15 @@ class Executor:
                 # (txn plumbing, cancel/deadline gates, result wrap)
                 stage_record("stmt_envelope", max(dt - eval_ns, 0))
                 self.ds.record_statement(True, dt, type(stmt).__name__)
-                results.append(QueryResult(result=out, time_ns=dt))
+                qr = QueryResult(result=out, time_ns=dt)
+                if getattr(self, "_knn_partial", None):
+                    # a sharded KNN answered without these index shards
+                    # (SURREAL_KNN_PARTIAL=partial): the flag rides the
+                    # statement result so no client can mistake a
+                    # partial candidate set for a complete one
+                    qr.partial = {"missing_shards": self._knn_partial}
+                    self._knn_partial = None
+                results.append(qr)
                 if not own_txn:
                     buffered.append(len(results) - 1)
             except ReturnException as r:

@@ -5196,6 +5196,21 @@ def _s_info(n: InfoStmt, ctx: Ctx):
         }
         if shard_topo is not None:
             out["shards"] = shard_topo
+        # shard-partitioned vector serving (idx/shardvec.py): per-shard
+        # index residency — rows, host bytes, ANN state, sync version,
+        # replica addresses — so an operator can see which slice of
+        # which index each shard group is serving
+        knn_status = []
+        for ixkey, eng in list(ctx.ds.vector_indexes.items()):
+            status_fn = getattr(eng, "shards_status", None)
+            if status_fn is None:
+                continue
+            knn_status.append({
+                "index": ".".join(str(x) for x in ixkey),
+                "shards": status_fn(),
+            })
+        if knn_status:
+            out["knn"] = knn_status
         return out
     if n.level == "root":
         out = {"accesses": {}, "namespaces": {}, "nodes": {}, "system": {},

@@ -1318,6 +1318,14 @@ def explain_plan(tb, cond, ctx, stmt):
                         # quantized graph index (int8 descent + exact
                         # re-rank) instead of the brute scan
                         plan["ann"] = "graph"
+                    refresh = getattr(eng, "refresh_parts", None)
+                    if refresh is not None:
+                        # sharded store: the search scatter-gathers
+                        # across this many index shards (idx/shardvec)
+                        try:
+                            plan["shards"] = len(refresh())
+                        except SdbError:
+                            pass  # map unreadable: plan stays useful
                     return {
                         "detail": {"plan": plan, "table": tb},
                         "operation": "Iterate Index",

@@ -87,14 +87,16 @@ def vector_index_update(idef, rid: RecordId, before, after, ctx):
         return
     # version allocation is process-atomic (ds.lock): concurrent writers
     # can't collide on a log slot; a cancelled txn burns a version, which
-    # sync() detects as a log gap and resolves with a rebuild
+    # sync() detects as a log gap and resolves with a rebuild. The KV
+    # read happens BEFORE the lock — on a sharded store it is a remote
+    # round trip, and ds.lock must never be held across one.
+    stored = ctx.txn.get_val(vkey) or 0
     with ctx.ds.lock:
         counters = getattr(ctx.ds, "_ix_versions", None)
         if counters is None:
             counters = {}
             ctx.ds._ix_versions = counters
         ckey = (ns, db, rid.tb, idef.name)
-        stored = ctx.txn.get_val(vkey) or 0
         ver = max(counters.get(ckey, 0), stored) + 1
         counters[ckey] = ver
     log_key = K.ix_state(ns, db, rid.tb, idef.name, b"hl", K.enc_u64(ver))
@@ -203,10 +205,22 @@ class _Coalescer(DeviceBatcher):
 class TpuVectorIndex:
     """Per-(ns,db,tb,ix) device block cache + search engine."""
 
-    def __init__(self, ns, db, tb, ix, params: dict):
+    def __init__(self, ns, db, tb, ix, params: dict, key_range=None,
+                 label: str = ""):
         self.key = (ns, db, tb, ix)
         self.params = params
         self.dim = params["dimension"]
+        # optional [lo, hi) clamp over the `he` element keyspace: a
+        # shard-partitioned index (idx/shardvec.py) builds one engine
+        # per shard range, each covering only its slice of the rows
+        self.key_range = (
+            None if key_range is None
+            else (bytes(key_range[0]), bytes(key_range[1]))
+        )
+        self.label = label  # display name for residency/partial reports
+        # directory for persisted CAGRA build artifacts (set by
+        # get_vector_index from the datastore; None = never persist)
+        self.snapshot_dir = None
         from surrealdb_tpu.ops.metrics import normalize_metric
 
         self.metric, self.mink_p = normalize_metric(
@@ -298,10 +312,19 @@ class TpuVectorIndex:
         entries = list(ctx.txn.scan_vals(beg, end))
         if len(entries) != to_ver - from_ver:
             return False  # log incomplete (e.g. trimmed) — rebuild instead
+        self._apply_entries([e for _k, e in entries])
+        return True
+
+    def _apply_entries(self, entries):
+        """Apply pre-fetched op-log entries [(op, idv, raw), ...] to the
+        host arrays. Pure in-memory — the caller holds the index locks
+        and has already fetched the log slice (the shard router fetches
+        ONCE and fans the ops out to its parts by key range)."""
+        tb = self.key[2]
         add_rows = []
         add_rids = []
         add_valid = []
-        for _k, (op, idv, raw) in entries:
+        for op, idv, raw in entries:
             h = K.enc_value(idv)
             row = self.row_index.get(h)
             if op == "del":
@@ -350,7 +373,6 @@ class TpuVectorIndex:
             )
             self.rids.extend(add_rids)
         self._drop_device()
-        return True
 
     def _drop_device(self):
         """Invalidate the device-resident cache (host arrays are truth):
@@ -361,10 +383,24 @@ class TpuVectorIndex:
         self.rank_mode = None
         self._host_stats = None
 
-    def _rebuild(self, ctx):
+    def _he_range(self) -> tuple[bytes, bytes, bytes]:
+        """(prefix, begin, end) of this engine's element keyspace —
+        clamped to `key_range` for a shard part."""
         ns, db, tb, ix = self.key
         pre = K.ix_state(ns, db, tb, ix, b"he")
         beg, end = K.prefix_range(pre)
+        if self.key_range is not None:
+            beg = max(beg, self.key_range[0])
+            end = min(end, self.key_range[1])
+        return pre, beg, end
+
+    def _scan_rows(self, ctx):
+        """Read this engine's rows from KV truth (range-clamped). Pure
+        I/O — takes NO index locks, so the scatter paths can park on a
+        remote scan without wedging concurrent searchers; the caller
+        installs the snapshot afterwards under the write lock."""
+        pre, beg, end = self._he_range()
+        tb = self.key[2]
         rids = []
         rows = []
         index = {}
@@ -376,6 +412,10 @@ class TpuVectorIndex:
             index[K.enc_value(idv)] = len(rids)
             rids.append(RecordId(tb, idv))
             rows.append(np.frombuffer(deserialize(raw), dtype=self.dtype))
+        return rids, rows, index
+
+    def _install_rows(self, rids, rows, index):
+        """Install a freshly scanned snapshot (caller holds the locks)."""
         self.rids = rids
         self.row_index = index
         self.vecs = (
@@ -393,12 +433,94 @@ class TpuVectorIndex:
             self._ann_gen += 1
             if self._ann_state == "ready":
                 self._ann_state = "idle"
-        # trim the consumed op log when we can write (bounds log growth)
-        if getattr(ctx.txn, "write", False):
+
+    def _rebuild(self, ctx):
+        ns, db, tb, ix = self.key
+        self._install_rows(*self._scan_rows(ctx))
+        # trim the consumed op log when we can write (bounds log growth);
+        # shard parts never trim — the router owns the shared log
+        if self.key_range is None and getattr(ctx.txn, "write", False):
             ver = ctx.txn.get_val(K.ix_state(ns, db, tb, ix, b"vn")) or 0
             beg = K.ix_state(ns, db, tb, ix, b"hl", K.enc_u64(0))
             end = K.ix_state(ns, db, tb, ix, b"hl", K.enc_u64(ver)) + b"\x00"
             ctx.txn.delete_range(beg, end)
+
+    # -- shard-part serving (driven by idx/shardvec.py) ---------------------
+
+    def part_sync(self, ctx, ver: int, entries):
+        """Bring ONE shard part up to global mutation version `ver`.
+
+        The router read `vn` once and fetched the shared op log once;
+        `entries` is this part's share — ascending `(gver, op, idv,
+        raw)` tuples — or None when the log cannot cover the gap (full
+        range rebuild). Lock discipline differs from the unsharded
+        `sync`: all KV I/O (the rebuild scan) runs OUTSIDE the index
+        locks, so a scatter attempt parked on a sick shard's scan never
+        wedges searchers of the healthy parts; installs re-check the
+        version under the lock, so two racing syncs converge instead of
+        regressing."""
+        if ver <= self.version:
+            return
+        if entries is not None and self.version >= 0:
+            frag = 0.0
+            with self.lock, self.rw.write():
+                if ver > self.version:
+                    self._apply_entries([
+                        (op, idv, raw) for g, op, idv, raw in entries
+                        if g > self.version
+                    ])
+                    self.version = ver
+                if len(self.valid):
+                    frag = 1.0 - (self.valid.sum() / len(self.valid))
+            if frag <= 0.25:
+                self._maybe_build_ann()
+                return
+        rids, rows, index = self._scan_rows(ctx)  # KV I/O: no locks held
+        with self.lock, self.rw.write():
+            if ver >= self.version:
+                self._install_rows(rids, rows, index)
+                self.version = ver
+        self._maybe_build_ann()
+
+    def search_topk(self, qv: np.ndarray, k: int):
+        """Per-part scatter entry: top-k over this part's (already
+        synced) rows — exact, or CAGRA descent + exact re-rank when the
+        part grew past the ANN floor. Pure compute: by the lock
+        discipline above it can never block on a remote shard.
+
+        Routing: device-bound parts ride the cross-query coalescer
+        (concurrent queries share one batched kernel per part block);
+        host-routed parts call the batched engine entry directly —
+        paying the coalescer's condition dance per part per query
+        measurably loses to one BLAS pass on CPU-routed stores."""
+        n = int(self.valid.sum()) if len(self.valid) else 0
+        if n == 0:
+            return []
+        k = min(k, n)
+        if len(self.rids) < DEVICE_MIN_ROWS:
+            # tiny part: the exact host ladder, bit-for-bit the
+            # unsharded small-store path
+            with self.rw.read():
+                return self._host_knn_single(qv, k)
+        if self._use_device():
+            return self.coalescer.search(qv, k)
+        with self.rw.read():
+            return self.knn_batch(np.asarray(qv)[None, :], k)[0]
+
+    def residency(self) -> dict:
+        """Index-serving residency for INFO FOR SYSTEM / /metrics."""
+        out = {
+            "rows": int(self.valid.sum()) if len(self.valid) else 0,
+            "bytes": int(self.vecs.nbytes),
+            "version": int(self.version),
+            "ann": self._ann_state,
+        }
+        ann = self._ann
+        if ann is not None:
+            out["ann_bytes"] = ann.nbytes()
+        if self.label:
+            out["range"] = self.label
+        return out
 
     # -- quantized graph-ANN overlay (idx/cagra.py) -------------------------
 
@@ -474,25 +596,37 @@ class TpuVectorIndex:
         are brute-merged at query time — a torn snapshot can never
         surface a wrong distance, only a slightly worse candidate set.
         A full repack bumps `_ann_gen`; a build that raced one is
-        discarded."""
+        discarded.
+
+        With a `snapshot_dir`, a persisted artifact whose mutation
+        stamp (the `vn` version) AND row-identity digest match the
+        current snapshot loads in seconds instead of redoing the build;
+        a fresh build persists on the way out (idx/cagra.py
+        save_index/load_index, SKVCRC01 frame idiom)."""
         from surrealdb_tpu.idx import cagra
 
         with self.rw.read():
             gen = self._ann_gen
             xs = self.vecs
+            rids = self.rids
             version, epoch = self.version, self._dev_epoch
             mut_cut = self._ann_mut
             dead0 = self._ann_dead
-        try:
-            ann = cagra.build_index(xs, self.metric, version, epoch)
-        except Exception:
-            with self._ann_lock:
-                self._ann_state = "idle"
-            return
+        ann = self._load_ann_snapshot(xs, rids, version)
+        loaded = ann is not None
+        if ann is None:
+            try:
+                ann = cagra.build_index(xs, self.metric, version, epoch)
+            except Exception:
+                with self._ann_lock:
+                    self._ann_state = "idle"
+                return
+        installed = False
         with self._ann_lock:
             if self._ann_gen != gen:
                 self._ann_state = "idle"  # repack raced: discard
                 return
+            installed = True
             self._ann = ann
             self._ann_seq += 1
             # rows dirtied BEFORE the snapshot hold their new values in
@@ -508,6 +642,93 @@ class TpuVectorIndex:
             # at the next full repack) — stop counting them as drift
             self._ann_dead_base = dead0
             self._ann_state = "ready"
+        if installed and not loaded:
+            self._save_ann_snapshot(ann, xs, rids)
+
+    # -- persisted build artifacts ------------------------------------------
+
+    def _ann_snap_path(self):
+        if not self.snapshot_dir:
+            return None
+        import hashlib
+        import os
+
+        ns, db, tb, ix = self.key
+        # filename: readable stem + a collision-proof tag (names may
+        # contain bytes a filesystem rejects; parts add their range)
+        ident = repr((ns, db, tb, ix, self.label))
+        tag = hashlib.sha256(ident.encode()).hexdigest()[:16]
+        stem = "".join(
+            c if c.isalnum() else "_" for c in f"{ns}.{db}.{tb}.{ix}"
+        )[:48]
+        return os.path.join(self.snapshot_dir, f"{stem}-{tag}.annsnap")
+
+    @staticmethod
+    def _row_digest(rids, n: int) -> str:
+        """Row-identity digest over the first `n` rows IN ORDER: graph
+        node ids are row numbers, so a reloaded artifact is only valid
+        when the numbering — not just the row set — matches."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for r in rids[:n]:
+            h.update(K.enc_value(r.id))
+            h.update(b";")
+        return h.hexdigest()
+
+    def _load_ann_snapshot(self, xs, rids, version):
+        path = self._ann_snap_path()
+        if path is None or not len(xs):
+            return None
+        import os
+        import sys
+
+        from surrealdb_tpu.idx import cagra
+
+        try:
+            ann, meta = cagra.load_index(path)
+        except OSError:
+            return None  # no snapshot (or unreadable dir): just build
+        except Exception as e:
+            # corrupt/torn snapshot: warn + rebuild, NEVER serve it
+            print(
+                f"[surrealdb-tpu] ann snapshot {path} rejected "
+                f"({e}); rebuilding from rows",
+                file=sys.stderr, flush=True,
+            )
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+        if (ann.metric != self.metric
+                or ann.built_n != len(xs)
+                or ann.built_version != int(version)
+                or meta.get("dim") != int(xs.shape[1])
+                or meta.get("rows") != self._row_digest(rids, len(xs))):
+            return None  # stale stamp: rows changed since the save
+        return ann
+
+    def _save_ann_snapshot(self, ann, xs, rids):
+        path = self._ann_snap_path()
+        if path is None:
+            return
+        import os
+        import sys
+
+        from surrealdb_tpu.idx import cagra
+
+        try:
+            os.makedirs(self.snapshot_dir, exist_ok=True)
+            cagra.save_index(ann, path, extra={
+                "dim": int(xs.shape[1]),
+                "rows": self._row_digest(rids, ann.built_n),
+            })
+        except OSError as e:
+            print(
+                f"[surrealdb-tpu] ann snapshot save failed ({path}): "
+                f"{e}", file=sys.stderr, flush=True,
+            )
 
     def _ann_route(self, k: int):
         """The ready AnnIndex when a k-NN search of `k` should ride the
@@ -1029,11 +1250,25 @@ class TpuVectorIndex:
         raise SdbError(f"unsupported metric {m}")
 
 
-def get_vector_index(idef, ctx) -> TpuVectorIndex:
+def get_vector_index(idef, ctx):
+    """The serving engine for one vector index: a node-local
+    TpuVectorIndex, or — on a range-sharded store — the scatter-gather
+    router (idx/shardvec.py) that partitions the index along the shard
+    map and merges per-shard top-k."""
     ns, db = ctx.need_ns_db()
     key = (ns, db, idef.tb, idef.name)
     eng = ctx.ds.vector_indexes.get(key)
     if eng is None:
-        eng = TpuVectorIndex(ns, db, idef.tb, idef.name, idef.hnsw)
+        from surrealdb_tpu.kvs.shard import ShardedBackend
+
+        if isinstance(ctx.ds.backend, ShardedBackend):
+            from surrealdb_tpu.idx.shardvec import ShardedVectorIndex
+
+            eng = ShardedVectorIndex(ns, db, idef.tb, idef.name,
+                                     idef.hnsw, ctx.ds.backend,
+                                     telemetry=ctx.ds.telemetry)
+        else:
+            eng = TpuVectorIndex(ns, db, idef.tb, idef.name, idef.hnsw)
+        eng.snapshot_dir = getattr(ctx.ds, "ann_snapshot_dir", None)
         ctx.ds.vector_indexes[key] = eng
     return eng

@@ -46,12 +46,17 @@ class Session:
 class QueryResult:
     """One statement's outcome."""
 
-    __slots__ = ("result", "error", "time_ns")
+    __slots__ = ("result", "error", "time_ns", "partial")
 
     def __init__(self, result=None, error: Optional[str] = None, time_ns: int = 0):
         self.result = result
         self.error = error
         self.time_ns = time_ns
+        # typed partial-result marker (SURREAL_KNN_PARTIAL=partial): a
+        # scatter-gather KNN answered without one or more index shards.
+        # None = complete; else {"missing_shards": [names]} — a partial
+        # answer is always FLAGGED, never silently short (idx/shardvec)
+        self.partial = None
 
     @property
     def ok(self):
@@ -85,7 +90,8 @@ class Notification:
 
 class Datastore:
     def __init__(self, path: str = "memory", strict: bool = False,
-                 capabilities=None, check_version: bool = True):
+                 capabilities=None, check_version: bool = True,
+                 backend=None):
         from surrealdb_tpu.capabilities import Capabilities
 
         from surrealdb_tpu.telemetry import Telemetry
@@ -96,7 +102,15 @@ class Datastore:
         # created before the backend: the remote engine records its
         # retry/failover counters here
         self.telemetry = Telemetry()
-        if path in ("memory", "mem://", "mem"):
+        # directory for persisted CAGRA artifacts (disk stores set it;
+        # idx/vector.py reload-or-rebuild keys off the mutation stamp)
+        self.ann_snapshot_dir = None
+        if backend is not None:
+            # pre-built backend injection: the deterministic simulator
+            # mounts a real Datastore on a ShardedBackend whose
+            # transport/clock are the sim seams (sim/harness.py)
+            self.backend = backend
+        elif path in ("memory", "mem://", "mem"):
             # the C++ memtable engine when the toolchain built it, else the
             # pure-Python sorted map (same Transactable semantics)
             from surrealdb_tpu.native import available
@@ -203,6 +217,16 @@ class Datastore:
         from surrealdb_tpu.device import attach_telemetry
 
         attach_telemetry(self.telemetry)
+        # index-serving shard count across all sharded vector indexes
+        # (0 on unsharded stores; pairs with the knn_shard_fanout /
+        # knn_partial_results / knn_hedged_dispatches counters)
+        self.telemetry.register_gauge(
+            "knn_index_shards",
+            lambda: sum(
+                len(getattr(eng, "parts", ()) or ())
+                for eng in list(self.vector_indexes.values())
+            ),
+        )
         # shared decoded-catalog cache (version, dict); local backends
         # only — a remote keyspace can change under us without a local
         # commit, so remote datastores skip it
@@ -220,12 +244,13 @@ class Datastore:
         self._tso_expiry = 0.0
         self._stamp_storage_version(check_version)
 
-    @staticmethod
-    def _register_compile_cache_dir(store_path: str):
+    def _register_compile_cache_dir(self, store_path: str):
         """Disk-backed stores anchor the device runner's persistent
         XLA compile cache next to the data (unless the env knob picked
         somewhere explicit) — compiled kernels then survive server AND
-        runner restarts together."""
+        runner restarts together. The persisted-ANN artifact dir
+        (idx/cagra.py save_index) anchors beside it for the same
+        reason: a restart reloads a 1M-row graph build in seconds."""
         import os as _os
 
         from surrealdb_tpu.device import compile_cache
@@ -233,6 +258,7 @@ class Datastore:
         base = store_path if _os.path.isdir(store_path) \
             else _os.path.dirname(_os.path.abspath(store_path))
         compile_cache.set_default_dir(_os.path.join(base, ".xla-cache"))
+        self.ann_snapshot_dir = _os.path.join(base, ".ann-cache")
 
     def start_node_tasks(self, interval_s: float = 10.0,
                          stale_s: float = 30.0):

@@ -210,6 +210,14 @@ class ShardTx(BackendTx):
     def _any_writes(self) -> bool:
         return any(sub.writes for sub in self._subs.values())
 
+    def prepin(self, key: bytes) -> None:
+        """Open (pin) the sub-transaction owning `key` NOW. The scatter
+        paths (idx/shardvec.py) pre-pin every involved shard from the
+        coordinating thread before fanning reads out to workers —
+        lazy `_sub` creation must never race across threads."""
+        self._check()
+        self._sub(self._map.locate(key))
+
     def _wrong_shard_read(self, i: int):
         """A read bounced off a moved range: refresh the map and
         re-route. Only safe while NO shard holds writes. Every open

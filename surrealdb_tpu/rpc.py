@@ -103,14 +103,20 @@ class RpcSession:
         sql = params[0]
         vars = params[1] if len(params) > 1 else {}
         res = self._query(sql, vars)
-        return [
-            {
+        out = []
+        for r in res:
+            row = {
                 "status": "OK" if r.ok else "ERR",
                 "result": r.result if r.ok else r.error,
                 "time": f"{r.time_ns / 1e6:.3f}ms",
             }
-            for r in res
-        ]
+            if getattr(r, "partial", None):
+                # typed partial KNN answer (SURREAL_KNN_PARTIAL=
+                # partial): an RPC client must never mistake a
+                # shard-incomplete candidate set for a complete one
+                row["partial"] = r.partial
+            out.append(row)
+        return out
 
     def rpc_select(self, params):
         what = _thing(params[0])

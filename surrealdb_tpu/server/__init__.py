@@ -134,14 +134,19 @@ class SurrealHandler(BaseHTTPRequestHandler):
 
     def _run_sql(self, sql: str, sess: Session, vars=None):
         res = self.ds.execute(sql, session=sess, vars=vars or {})
-        return [
-            {
+        out = []
+        for r in res:
+            row = {
                 "status": "OK" if r.ok else "ERR",
                 "result": to_json(r.result) if r.ok else r.error,
                 "time": f"{r.time_ns / 1e6:.3f}ms",
             }
-            for r in res
-        ]
+            if getattr(r, "partial", None):
+                # typed partial KNN answer (SURREAL_KNN_PARTIAL=partial):
+                # the client must be able to see WHICH shards are missing
+                row["partial"] = r.partial
+            out.append(row)
+        return out
 
     def _api_route(self, method: str):
         """Serve DEFINE API endpoints: /api/:ns/:db/<path> (reference

@@ -12,4 +12,9 @@ Entry points:
 """
 
 from surrealdb_tpu.sim.cluster import SimConfig  # noqa: F401
-from surrealdb_tpu.sim.harness import SimResult, run_sim  # noqa: F401
+from surrealdb_tpu.sim.harness import (  # noqa: F401
+    KnnSimConfig,
+    SimResult,
+    run_knn_sim,
+    run_sim,
+)

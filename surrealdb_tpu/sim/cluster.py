@@ -53,6 +53,12 @@ class SimConfig:
         self.splits = 1          # max splits attempted per run
         self.scripted_faults = None  # [(t, fn_name, args...)] overrides
         self.quiesce_s = 45.0    # convergence budget after the workload
+        # topology overrides (the KNN index-serving sim cuts the
+        # keyspace INSIDE an index's element range so shard boundaries
+        # really partition the rows): boundary keys for groups 1..n-1,
+        # and the key the driver's online split fires at
+        self.shard_bounds = None  # None = the classic /b /k/4 /y cuts
+        self.split_key = b"/k/6"
         for k, v in kw.items():
             if not hasattr(self, k):
                 raise TypeError(f"unknown SimConfig knob {k!r}")
@@ -245,7 +251,9 @@ class SimCluster:
             n.start("primary" if n.index == 0 else "replica")
         # initial shard map: group 0 = meta + lowest range; spare
         # groups stay unassigned (split targets)
-        bounds = [b"/b", b"/k/4", b"/y"][:cfg.groups - 1]
+        bounds = [bytes(b) for b in (
+            cfg.shard_bounds or [b"/b", b"/k/4", b"/y"]
+        )][:cfg.groups - 1]
         self.split_keys = bounds
         groups = [self.peers_of(g) for g in range(cfg.groups)]
         init_topology(groups, bounds,

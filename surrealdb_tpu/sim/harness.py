@@ -241,7 +241,7 @@ class _Driver:
         elif action == "split":
             self.splits_done += 1
             spare = cl.peers_of(cfg.groups)  # first spare group
-            self.split_pending = (b"/k/6", spare)
+            self.split_pending = (cfg.split_key, spare)
             k.spawn("admin:split", self._run_split, daemon=True)
 
     def _run_split(self):
@@ -642,6 +642,312 @@ def run_live_sim(seed: int,
         "overflows": overflow_total,
         "poisoned": poison_subs[0],
         "subs": len(subs_final),
+    }
+    return res
+
+
+class KnnSimConfig(SimConfig):
+    """Knobs for the index-serving (scatter-gather KNN) simulation.
+
+    The shard bounds are cut INSIDE the vector index's element
+    keyspace, so the shard map genuinely partitions the rows: group 0
+    holds the catalog + the low slice, middle groups hold element
+    slices, the last group holds the op log/version keys + records.
+    The driver's online split fires inside a middle element slice —
+    index blocks migrate behind the epoch fence mid-run."""
+
+    writers = 3       # CREATE/DELETE tasks
+    knn_clients = 3   # SELECT ... <|k|> tasks
+    write_ops = 12    # ops per writer
+    knn_ops = 8       # queries per client
+    dim = 6
+    k = 4
+    cut_ids = (64, 144)  # element-range boundary row ids
+    split_id = 176       # the online split lands between these rows
+
+
+def _knn_vec(tag: int, dim: int) -> list:
+    """Deterministic vector for row/query `tag` — a pure function of
+    the integer, so the invariant checker recomputes it without
+    replaying the run."""
+    import math
+
+    return [round(math.sin(tag * 7.3 + d * 1.7), 6) for d in range(dim)]
+
+
+def run_knn_sim(seed: int,
+                cfg: Optional[KnnSimConfig] = None) -> SimResult:
+    """Deterministic index-serving simulation: a REAL Datastore (SQL
+    executor, planner, sharded vector router) mounted on a
+    ShardedBackend whose transport/clock are the sim seams, with KNN
+    queries racing writes, online shard splits, primary kills, and
+    asymmetric partitions from the seeded driver. The partial policy
+    runs in `partial` mode; `check_knn_delivery` then holds every
+    answer to: non-partial == brute-force oracle over acked rows
+    (exact distances, no silent loss), partial == typed + names the
+    missing shard. After quiesce, a FRESH serving node (rebuilding all
+    index state from KV truth, like a promoted replica) must answer
+    non-partially and byte-equal to the brute oracle over the final
+    rows."""
+    from surrealdb_tpu import cnf
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.kvs.ds import Datastore
+
+    cfg = cfg or KnnSimConfig()
+    if cfg.shard_bounds is None:
+        hek = lambda i: K.ix_state("t", "t", "v", "ix", b"he",  # noqa: E731
+                                   K.enc_value(i))
+        bounds = [hek(cfg.cut_ids[0]), hek(cfg.cut_ids[1]),
+                  K.ix_state("t", "t", "v", "ix", b"hl")]
+        cfg.shard_bounds = bounds[:cfg.groups - 1]
+        cfg.split_key = hek(cfg.split_id)
+    cfg.clients = 1  # partition fault targets: the one SQL client host
+    res = SimResult()
+    res.seed = seed
+    kernel = Kernel(seed)
+    cluster = SimCluster(kernel, cfg, tempfile.mkdtemp(
+        prefix=f"simknn-{seed}-"
+    ))
+    tmp = cluster.data_root
+    rows: dict = {}      # id -> {"vec", "t0", "t1", "status", del_*}
+    queries: list = []   # invariant records
+    final_fail: list = []
+    saved = (cnf.KNN_PARTIAL, cnf.KNN_SHARD_TIMEOUT_S,
+             cnf.KNN_SHARD_HEDGES)
+    cnf.KNN_PARTIAL = "partial"
+    cnf.KNN_SHARD_TIMEOUT_S = 2.0   # virtual seconds (seam clock)
+    cnf.KNN_SHARD_HEDGES = 1
+
+    def _sql(ds, sql, vars=None):
+        try:
+            out = ds.execute(sql, ns="t", db="t", vars=vars or {})
+            return out[-1]
+        except (RetryableKvError, SdbError, OSError) as e:
+            from surrealdb_tpu.kvs.ds import QueryResult
+
+            return QueryResult(error=str(e))
+
+    def _write(ds, sql, vars=None, idempotent_exists=False,
+               attempts=8):
+        """Run one write statement to a certain outcome if possible:
+        'acked' | 'maybe' | 'none' (mirrors the KV sim's _run_write)."""
+        ambiguous = False
+        for _ in range(attempts):
+            r = _sql(ds, sql, vars)
+            if r.error is None:
+                return "acked"
+            if idempotent_exists and "already exists" in r.error:
+                return "acked"  # a prior ambiguous attempt landed
+            if _AMBIG in r.error:
+                ambiguous = True
+            kernel.sleep(0.3)
+        return "maybe" if ambiguous else "none"
+
+    def _writer(ds, w):
+        rng = kernel.rng
+        own: list = []
+        for j in range(cfg.write_ops):
+            rid = j * 16 + w
+            if rng.random() < 0.85 or not own:
+                vec = _knn_vec(rid, cfg.dim)
+                rec = {"vec": vec, "t0": kernel.now, "t1": None,
+                       "status": "none"}
+                rows[rid] = rec
+                st = _write(ds, f"CREATE v:{rid} SET emb = $v",
+                            {"v": vec}, idempotent_exists=True)
+                rec["t1"] = kernel.now
+                rec["status"] = st
+                if st == "acked":
+                    own.append(rid)
+                kernel.log("knn_write", id=rid, status=st)
+            else:
+                did = own.pop(rng.randrange(len(own)))
+                rec = rows[did]
+                rec["del_t0"] = kernel.now
+                st = _write(ds, f"DELETE v:{did}")
+                rec["del_t1"] = kernel.now
+                rec["del_status"] = st
+                kernel.log("knn_delete", id=did, status=st)
+            kernel.sleep(0.2 + rng.random() * 0.9)
+
+    def _knn_client(ds, ci):
+        rng = kernel.rng
+        for j in range(cfg.knn_ops):
+            q = _knn_vec(1_000_000 + ci * 1000 + j, cfg.dim)
+            t0 = kernel.now
+            r = _sql(
+                ds,
+                f"SELECT id, vector::distance::knn() AS d FROM v "
+                f"WHERE emb <|{cfg.k}|> $q",
+                {"q": q},
+            )
+            rec = {
+                "label": f"q{ci}.{j}", "q": q, "k": cfg.k,
+                "t0": t0, "t1": kernel.now,
+                "result": [], "partial": None, "error": None,
+            }
+            if r.error is not None:
+                rec["error"] = r.error[:160]
+            else:
+                rec["result"] = [
+                    (int(row["id"].id), float(row["d"]))
+                    for row in (r.result or [])
+                ]
+                if r.partial:
+                    rec["partial"] = list(r.partial["missing_shards"])
+            queries.append(rec)
+            kernel.log(
+                "knn_query", client=ci, j=j, n=len(rec["result"]),
+                partial=bool(rec["partial"]), err=bool(rec["error"]),
+            )
+            kernel.sleep(0.3 + rng.random() * 1.2)
+
+    def _final_check():
+        """Post-quiesce: a fresh serving node must answer non-partially
+        and equal the brute oracle over its own committed rows."""
+        be = cluster.client_backend("c0")
+        ds = Datastore(backend=be)
+        try:
+            ok = False
+            for _ in range(6):
+                scan = _sql(ds, "SELECT id, emb FROM v")
+                q = _knn_vec(2_000_000, cfg.dim)
+                knn = _sql(
+                    ds,
+                    f"SELECT id, vector::distance::knn() AS d FROM v "
+                    f"WHERE emb <|{cfg.k}|> $q",
+                    {"q": q},
+                )
+                if scan.error is not None or knn.error is not None:
+                    kernel.sleep(2.0)
+                    continue
+                if knn.partial:
+                    final_fail.append(
+                        f"FINAL KNN STILL PARTIAL after quiesce: "
+                        f"{knn.partial!r}"
+                    )
+                    return
+                want = sorted(
+                    ((inv._knn_dist(row["emb"], q), int(row["id"].id))
+                     for row in scan.result),
+                )[:cfg.k]
+                got = [(float(row["d"]), int(row["id"].id))
+                       for row in knn.result]
+                if [w[1] for w in want] != [g[1] for g in got] or any(
+                    abs(w[0] - g[0]) > 1e-9 for w, g in zip(want, got)
+                ):
+                    final_fail.append(
+                        f"FINAL KNN != BRUTE ORACLE: got {got!r}, "
+                        f"want {want!r}"
+                    )
+                    return
+                ok = True
+                break
+            if not ok:
+                final_fail.append(
+                    "FINAL KNN UNSERVABLE after quiesce"
+                )
+        finally:
+            ds.close()
+
+    def main():
+        cluster.boot()
+        be = cluster.client_backend("c0")
+        ds = Datastore(backend=be)
+        driver = _Driver(kernel, cluster, cfg)
+        try:
+            r = _sql(ds, "DEFINE TABLE v; DEFINE INDEX ix ON v FIELDS "
+                         f"emb HNSW DIMENSION {cfg.dim} DIST EUCLIDEAN "
+                         "TYPE F32")
+            if r.error is not None:
+                res.errors.append(f"DDL failed: {r.error}")
+                kernel.shutdown()
+                return
+            # seed rows across all three element slices before chaos
+            for j in range(12):
+                rid = j * 16 + 15
+                vec = _knn_vec(rid, cfg.dim)
+                rows[rid] = {"vec": vec, "t0": kernel.now, "t1": None,
+                             "status": "none"}
+                st = _write(ds, f"CREATE v:{rid} SET emb = $v",
+                            {"v": vec}, idempotent_exists=True)
+                rows[rid]["t1"] = kernel.now
+                rows[rid]["status"] = st
+            tasks = [
+                kernel.spawn(f"w{w}", (lambda w=w: _writer(ds, w)))
+                for w in range(cfg.writers)
+            ] + [
+                kernel.spawn(f"q{c}", (lambda c=c: _knn_client(ds, c)))
+                for c in range(cfg.knn_clients)
+            ]
+            dtask = kernel.spawn("driver", driver.run, daemon=True)
+            kernel.join(tasks)
+            driver.stop = True
+            kernel.join([dtask])
+            # quiesce: heal, restart the dead, finish the split
+            cluster.net.heal()
+            cluster.net.drop_prob = 0.0
+            cluster.net.dup_prob = 0.0
+            cluster.net.extra_delay = 0.0
+            driver._tick_pending(heal_all=True)
+            for n in cluster.nodes:
+                if not n.up:
+                    n.restart()
+            driver.finish_split()
+            total_groups = cfg.groups + cfg.spare_groups
+            deadline = kernel.now + cfg.quiesce_s
+            while kernel.now < deadline:
+                prim_ok = all(
+                    sum(1 for n in cluster.group_nodes(g)
+                        if n.up and n.engine is not None
+                        and n.engine.role == "primary") == 1
+                    for g in range(total_groups)
+                )
+                if prim_ok and all(not e.staged
+                                   for e in cluster.all_up_engines()):
+                    break
+                kernel.sleep(1.0)
+            kernel.sleep(cfg.lease_ttl_s)
+            _final_check()
+        finally:
+            ds.close()
+            kernel.shutdown()
+
+    try:
+        with kvnet.use_clock(SimClock(kernel)):
+            kernel.run(main)
+    finally:
+        cnf.KNN_PARTIAL, cnf.KNN_SHARD_TIMEOUT_S, \
+            cnf.KNN_SHARD_HEDGES = saved
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # ---- evaluate invariants (outside the kernel) -----------------------
+    with kvnet.use_clock(kvnet.REAL_CLOCK):
+        res.violations += inv.check_knn_delivery(queries, rows)
+        res.violations += final_fail
+    res.errors += list(kernel.errors)
+    res.trace = kernel.trace
+    res.trace_digest = hashlib.sha256(
+        "\n".join(kernel.trace).encode()
+    ).hexdigest()
+    h = hashlib.sha256()
+    for qr in queries:
+        h.update(qr["label"].encode())
+        h.update(repr(qr["result"]).encode())
+        h.update(repr(qr["partial"]).encode())
+        h.update(repr(bool(qr["error"])).encode())
+    res.store_digest = h.hexdigest()
+    res.virtual_s = kernel.now
+    res.stats = {
+        "events": kernel.events,
+        "frames": cluster.net.frames,
+        "writes": len(rows),
+        "acked": sum(1 for r in rows.values()
+                     if r["status"] == "acked"),
+        "queries": len(queries),
+        "answered": sum(1 for q in queries if not q["error"]),
+        "partial": sum(1 for q in queries if q["partial"]),
+        "errors": sum(1 for q in queries if q["error"]),
     }
     return res
 

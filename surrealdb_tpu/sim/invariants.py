@@ -259,6 +259,125 @@ def check_live_delivery(label: str, expected: list, delivered: list,
     return out
 
 
+def _knn_dist(vec, qv) -> float:
+    """The engine's exact euclidean for a TYPE F32 store: rows and
+    query held as f32, distance accumulated in f64 (idx/vector.py
+    `_host_distances`) — the checker recomputes the SAME arithmetic."""
+    import numpy as np
+
+    v = np.asarray(vec, np.float32).astype(np.float64)
+    q = np.asarray(qv, np.float32).astype(np.float64)
+    return float(np.linalg.norm(v - q))
+
+
+def check_knn_delivery(queries: list, rows: dict) -> list[str]:
+    """Scatter-gather KNN delivery invariant (idx/shardvec.py): every
+    NON-PARTIAL answer equals the brute-force oracle over acked rows;
+    partial answers are explicitly typed and name the missing shard.
+
+    `rows` maps record id -> {"vec", "t0"/"t1" (create attempt
+    window), "status" (acked|maybe|none), "del_t0"/"del_t1"/
+    "del_status" when a delete was attempted}. The oracle tolerates
+    racing writes the only sound way: a row acked BEFORE the query
+    began MUST be visible; anything whose attempt overlapped the query
+    MAY be; a row whose delete acked before the query began MUST NOT
+    be. Within that envelope the answer must be a true top-k with
+    exact distances — there is no "slightly wrong" allowed, only
+    typed partial/error outcomes.
+    """
+    out = []
+    eps = 1e-9
+    for qr in queries:
+        label = qr["label"]
+        if qr.get("error"):
+            continue  # typed failure under faults: allowed, counted
+        t0, t1, k = qr["t0"], qr["t1"], qr["k"]
+        must, may, forbidden = set(), set(), set()
+        for rid, rec in rows.items():
+            if rec["status"] == "none" and rec.get("del_status") is None:
+                continue
+            attempted = rec["status"] in ("acked", "maybe")
+            if rec.get("del_status") is not None \
+                    and rec["del_status"] == "acked" \
+                    and rec["del_t1"] <= t0:
+                forbidden.add(rid)
+                continue
+            deleted_maybe = (
+                rec.get("del_status") is not None
+                and rec["del_t0"] <= t1
+            )
+            if rec["status"] == "acked" and rec["t1"] <= t0 \
+                    and not deleted_maybe:
+                must.add(rid)
+            elif attempted:
+                may.add(rid)
+        ids = [i for i, _d in qr["result"]]
+        dists = [d for _i, d in qr["result"]]
+        if len(set(ids)) != len(ids):
+            out.append(f"KNN DUPLICATE ROWS {label}: {ids!r}")
+            continue
+        if any(b < a - eps for a, b in zip(dists, dists[1:])):
+            out.append(f"KNN ORDER VIOLATED {label}: {dists!r}")
+        bad = False
+        for rid, d in qr["result"]:
+            if rid in forbidden:
+                out.append(
+                    f"KNN DELETED ROW SERVED {label}: {rid} (delete "
+                    f"acked before the query began)"
+                )
+                bad = True
+                continue
+            rec = rows.get(rid)
+            if rec is None or (rid not in must and rid not in may):
+                out.append(
+                    f"KNN PHANTOM ROW {label}: {rid} was never an "
+                    f"attempted write"
+                )
+                bad = True
+                continue
+            want = _knn_dist(rec["vec"], qr["q"])
+            if abs(want - d) > eps * max(1.0, abs(want)):
+                out.append(
+                    f"KNN WRONG DISTANCE {label}: {rid} reported "
+                    f"{d!r}, exact {want!r}"
+                )
+                bad = True
+        if bad:
+            continue
+        if qr.get("partial"):
+            # typed partial answer: must NAME the missing shard(s);
+            # completeness is explicitly not promised
+            if not all(isinstance(s, str) and s.strip()
+                       for s in qr["partial"]):
+                out.append(
+                    f"KNN PARTIAL UNNAMED {label}: {qr['partial']!r} "
+                    f"does not name the missing shard"
+                )
+            continue
+        # non-partial: a true top-k over some S with must ⊆ S ⊆
+        # must ∪ may — no acked row may be silently invisible
+        returned = set(ids)
+        if len(ids) < k:
+            lost = must - returned
+            if lost:
+                out.append(
+                    f"KNN SILENT LOSS {label}: answer has {len(ids)} "
+                    f"< k={k} rows yet acked rows missing: "
+                    f"{sorted(lost)[:4]!r}"
+                )
+        else:
+            dmax = dists[-1]
+            for rid in must - returned:
+                want = _knn_dist(rows[rid]["vec"], qr["q"])
+                if want < dmax - eps:
+                    out.append(
+                        f"KNN SILENT LOSS {label}: acked row {rid} at "
+                        f"distance {want!r} beaten by reported k-th "
+                        f"{dmax!r} but absent (no partial flag)"
+                    )
+    return out
+
+
 def check_staged_leak(engines) -> list[str]:
     """After convergence no 2PC stage survives: every prepared
     transaction reached a decision."""

@@ -147,3 +147,102 @@ def device_degraded_smoke():
         V.DEVICE_MIN_ROWS = old_min
         set_supervisor(old_sup)
         sup.shutdown()
+
+
+def sharded_knn_smoke():
+    """Gate smoke for shard-partitioned vector serving (idx/shardvec):
+    a KNN index cut ACROSS two element ranges must scatter-gather to
+    byte-identical results vs an unsharded oracle, re-partition behind
+    a live split's epoch fence with answers unchanged, and report
+    per-shard residency through INFO FOR SYSTEM. Returns None on
+    success, else an error string."""
+    import numpy as np
+
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.kvs.api import serialize
+    from surrealdb_tpu.kvs.remote import serve_kv
+    from surrealdb_tpu.kvs.shard import split_shard
+    from surrealdb_tpu.val import RecordId
+
+    def hek(i):
+        return K.ix_state("z", "z", "pts", "ix", b"he", K.enc_value(i))
+
+    rng = np.random.default_rng(9)
+    n, dim, k = 300, 12, 7
+    xs = rng.normal(size=(n, dim)).astype(np.float32)
+    qs = rng.normal(size=(4, dim)).astype(np.float32)
+    sql = ("SELECT id, vector::distance::knn() AS d FROM pts "
+           "WHERE emb <|%d|> $q" % k)
+
+    def fill(ds):
+        ds.query(
+            f"DEFINE TABLE pts; DEFINE INDEX ix ON pts FIELDS emb "
+            f"HNSW DIMENSION {dim} DIST EUCLIDEAN TYPE F32",
+            ns="z", db="z",
+        )
+        txn = ds.transaction(write=True)
+        for i in range(n):
+            txn.set(K.record("z", "z", "pts", i),
+                    serialize({"id": RecordId("pts", i)}))
+            txn.set_val(hek(i), xs[i].tobytes())
+        txn.set_val(K.ix_state("z", "z", "pts", "ix", b"vn"), n)
+        txn.commit()
+
+    def answers(ds):
+        out = []
+        for q in qs:
+            r = ds.execute(sql, ns="z", db="z",
+                           vars={"q": q.tolist()})[-1]
+            if r.error is not None:
+                raise RuntimeError(r.error)
+            if r.partial is not None:
+                raise RuntimeError(f"unexpected partial: {r.partial}")
+            out.append([(str(x["id"]), x["d"]) for x in r.result])
+        return out
+
+    spare = None
+    try:
+        ref = Datastore("pymem")
+        fill(ref)
+        want = answers(ref)
+        ref.close()
+        spare = serve_kv("127.0.0.1", 0, block=False)
+        spare_addr = f"127.0.0.1:{spare.server_address[1]}"
+        with sharded_cluster([hek(n // 2)]) as (_groups, meta_addr):
+            ds = Datastore(f"shard://{meta_addr}")
+            try:
+                fill(ds)
+                if answers(ds) != want:
+                    return ("sharded-knn smoke: scatter-gather != "
+                            "unsharded oracle")
+                eng = ds.vector_indexes[("z", "z", "pts", "ix")]
+                if len(eng.parts) != 2:
+                    return (f"sharded-knn smoke: {len(eng.parts)} "
+                            f"parts, want 2")
+                # live split through the upper element slice: the next
+                # queries must re-partition and stay byte-identical
+                split_shard(meta_addr, hek(3 * n // 4), [spare_addr])
+                if answers(ds) != want:
+                    return ("sharded-knn smoke: answers changed "
+                            "across a live split")
+                if len(eng.parts) != 3:
+                    return (f"sharded-knn smoke: {len(eng.parts)} "
+                            f"parts after split, want 3")
+                info = ds.query("INFO FOR SYSTEM", ns="z", db="z")[0]
+                shards = (info.get("knn") or [{}])[0].get("shards", [])
+                if sum(s.get("rows", 0) for s in shards) != n:
+                    return (f"sharded-knn smoke: residency reports "
+                            f"{shards!r}")
+                if ds.telemetry.get("knn_shard_fanout") < 8:
+                    return "sharded-knn smoke: fan-out not counted"
+                return None
+            finally:
+                ds.close()
+    except Exception as e:  # surface, don't crash the gate
+        return f"sharded-knn smoke: {e.__class__.__name__}: {e}"
+    finally:
+        if spare is not None:
+            with contextlib.suppress(Exception):
+                spare.shutdown()
+                spare.server_close()

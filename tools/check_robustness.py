@@ -56,6 +56,17 @@ re-litigating:
    a send-like call anywhere in the function. The functions' existence
    is also asserted so a rename cannot silently retire the rule.
 
+8. **Scatter-gather KNN stays deadline-checked and lock-clean** — the
+   shard-partitioned vector router (idx/shardvec.py): `scatter_gather`
+   and `merge_topk` must call `check_deadline()` (a KILL/timeout must
+   land between per-shard dispatches, not after the whole fan-out),
+   and none of the scatter/merge/sync functions may hold a lock across
+   a remote dispatch — a `with ...lock:` block inside them may only
+   touch allowlisted bookkeeping, because a shard-map lock held across
+   a dispatch to a sick shard serializes every other query on the
+   node. The functions' existence is asserted, so a rename cannot
+   silently retire the rule (same discipline as rules 6-7).
+
 Usage:  python tools/check_robustness.py [root]
 Exit status 1 when any finding survives.
 """
@@ -104,6 +115,19 @@ _NOTIFY_LOCK_OK = {"append", "pop", "popleft", "get", "clear",
                    "count_for", "add", "discard"}
 # send-like attribute calls forbidden ANYWHERE in a rule-7 function
 _SEND_ATTRS = {"sendall", "send", "_ws_send", "sendto", "write"}
+
+# rule 8: the scatter-gather KNN serving paths, per file. The first
+# tuple must call check_deadline(); the union must exist AND keep
+# every `with ...lock:` block free of non-bookkeeping calls.
+_KNN_FILE = "surrealdb_tpu/idx/shardvec.py"
+_KNN_DEADLINE_FNS = ("scatter_gather", "merge_topk")
+_KNN_LOCK_FNS = ("scatter_gather", "merge_topk", "_scatter_round",
+                 "_sync_part", "refresh_parts")
+# attribute calls allowed under a lock in a rule-8 function: partition
+# bookkeeping only — anything else (pool.call, sync, scan, search)
+# could block on a remote shard while serializing every other query
+_KNN_LOCK_OK = {"append", "pop", "get", "add", "discard", "span",
+                "items", "values", "keys", "_repartition"}
 
 # rule 5: the only places inside the package allowed to import jax —
 # the supervised runner tree and the kernel library it dispatches to
@@ -218,6 +242,59 @@ def _check_notify_fns(tree, rel, lines, fn_names) -> list[str]:
     return findings
 
 
+def _check_knn_fns(tree, rel, lines) -> list[str]:
+    """Rule 8: the scatter/merge/sync functions exist, the fan-out and
+    merge entries check the query deadline, and no rule-8 function
+    holds a lock across anything but partition bookkeeping."""
+    wanted = set(_KNN_DEADLINE_FNS) | set(_KNN_LOCK_FNS)
+    found = set()
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef) \
+                or node.name not in wanted:
+            continue
+        found.add(node.name)
+        if node.name in _KNN_DEADLINE_FNS \
+                and not _calls_attr(node, "check_deadline") \
+                and not _pragma(lines, node.lineno):
+            findings.append(
+                f"{rel}:{node.lineno}: {node.name} never calls "
+                f"check_deadline() — a KILL/timeout must be able to "
+                f"land between per-shard dispatches (rule 8)"
+            )
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.With):
+                continue
+            if not any(_is_lock_ctx(it) for it in sub.items):
+                continue
+            for inner in ast.walk(sub):
+                if inner is sub or not isinstance(inner, ast.Call):
+                    continue
+                f = inner.func
+                ok = (
+                    (isinstance(f, ast.Attribute)
+                     and f.attr in _KNN_LOCK_OK)
+                    or (isinstance(f, ast.Name)
+                        and f.id in _NOTIFY_BUILTIN_OK)
+                )
+                if not ok and not _pragma(lines, inner.lineno):
+                    label = (f.attr if isinstance(f, ast.Attribute)
+                             else getattr(f, "id", "<call>"))
+                    findings.append(
+                        f"{rel}:{inner.lineno}: call `{label}(` under "
+                        f"a lock inside {node.name} — a shard-map "
+                        f"lock held across a remote dispatch "
+                        f"serializes every query on the node (rule 8)"
+                    )
+    for name in sorted(wanted - found):
+        findings.append(
+            f"{rel}:1: rule-8 function `{name}` not found — the "
+            f"scatter-gather KNN contract is no longer being checked "
+            f"(update the rule-8 tables after a rename)"
+        )
+    return findings
+
+
 def check_file(path: str, rel: str) -> list[str]:
     with open(path, encoding="utf-8") as f:
         src = f.read()
@@ -304,6 +381,9 @@ def check_file(path: str, rel: str) -> list[str]:
         findings.extend(
             _check_notify_fns(tree, rel, lines, _NOTIFY_FNS[rel_fwd])
         )
+    # 8. scatter-gather KNN serving contract
+    if rel_fwd == _KNN_FILE:
+        findings.extend(_check_knn_fns(tree, rel, lines))
     # 3. streaming operators must stay deadline-checked
     if rel.endswith(os.path.join("exec", "stream.py")):
         for node in ast.iter_child_nodes(tree):

@@ -290,13 +290,26 @@ def main():
     )])
     # 2-shard smoke: the full SQL surface must keep working over a
     # range-sharded store (routing, cross-shard 2PC, scan stitching)
-    from shard_harness import device_degraded_smoke, two_shard_smoke
+    from shard_harness import (
+        device_degraded_smoke,
+        sharded_knn_smoke,
+        two_shard_smoke,
+    )
 
     err = two_shard_smoke()
     if err is None:
         print("== 2-shard smoke: OK")
     else:
         print(f"== 2-shard smoke: FAIL — {err}")
+        rc = rc or 1
+    # sharded-KNN smoke: scatter-gather vector serving over a split
+    # element keyspace must merge byte-identical to the unsharded
+    # oracle, survive a live shard split, and report residency
+    err = sharded_knn_smoke()
+    if err is None:
+        print("== sharded-knn smoke: OK")
+    else:
+        print(f"== sharded-knn smoke: FAIL — {err}")
         rc = rc or 1
     # device-degraded smoke: with the accelerator circuit OPEN (as
     # after a runner crash), KNN + graph queries over the sharded store
