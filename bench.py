@@ -1292,6 +1292,68 @@ def _bulk_vectors_sharded(ds, ns, db, tb, ix_name, xs, chunk=512):
             raise
 
 
+def bench_mem_pressure(quick=False):
+    """BENCH family `mem_pressure`: the churn workload
+    (tools/mem_churn.py — vector writes/deletes, KNN + FT queries,
+    background CAGRA builds, a live subscription) run twice in fresh
+    subprocesses: unconstrained, then under SURREAL_MEM_BUDGET_MB
+    clamped to ~half the unconstrained accounted peak. Emits both
+    runs' qps/RSS/eviction counters plus `answers_identical` — the
+    trajectory catches two regressions at once: unbounded growth
+    (accounted/peak RSS trend) and pressure-induced wrongness
+    (answers_identical must stay true with evictions > 0)."""
+    import subprocess
+
+    rows, ops = (6000, 220) if quick else (12000, 400)
+
+    def run(budget_mb):
+        env = dict(os.environ)
+        env.update({
+            "SURREAL_DEVICE": "off",
+            "SURREAL_KNN_ANN": "force",
+            # builds run (and evict) but serving stays exact, so the
+            # answers digest is deterministic by construction
+            "SURREAL_KNN_ANN_MAX_K": "0",
+        })
+        env.pop("SURREAL_MEM_BUDGET_MB", None)
+        if budget_mb:
+            env["SURREAL_MEM_BUDGET_MB"] = str(budget_mb)
+        p = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "mem_churn.py"),
+             "--rows", str(rows), "--ops", str(ops)],
+            capture_output=True, text=True, timeout=3600, env=env,
+        )
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"mem churn died (budget={budget_mb}MB): "
+                f"{p.stderr[-400:]}"
+            )
+        return json.loads(p.stdout.strip().splitlines()[-1])
+
+    base = run(0)
+    budget = max(1, int(base["accounted_peak_mb"] / 2))
+    press = run(budget)
+    return {
+        "config": "mem_pressure",
+        "rows": rows,
+        "ops": ops,
+        "budget_mb": budget,
+        "qps_unpressured": base["qps"],
+        "qps_pressured": press["qps"],
+        "peak_rss_mb_unpressured": base["peak_rss_mb"],
+        "peak_rss_mb_pressured": press["peak_rss_mb"],
+        "accounted_peak_mb_unpressured": base["accounted_peak_mb"],
+        "accounted_peak_mb_pressured": press["accounted_peak_mb"],
+        "evictions": press["evictions"],
+        "ft_cache_evictions": press["ft_cache_evictions"],
+        "answers_identical": (press["answers_digest"]
+                              == base["answers_digest"]),
+        "oom": press["oom"] or base["oom"],
+    }
+
+
 def bench_knn_sharded(quick=False, groups=2):
     """BENCH family `knn_sharded`: scatter-gather KNN over a REAL
     multi-group sharded cluster — every group a primary+replica pair of
@@ -1512,13 +1574,35 @@ def main():
     ap.add_argument("--config", default=None,
                     choices=["hnsw100k", "knn1m", "knn10m", "ann10m",
                              "brute", "graph3hop", "hybrid",
-                             "live_fanout", "knn_sharded"])
+                             "live_fanout", "knn_sharded",
+                             "mem_pressure"])
     ap.add_argument("--groups", type=int, default=2,
                     help="shard groups for --config knn_sharded (2/4)")
     args = ap.parse_args()
 
     def emit(res):
         res.setdefault("platform", _PLATFORM or "unprobed")
+        # resource-governance trajectory: every line carries the
+        # process high-water RSS, the accountant's view of derived
+        # state, and any eviction counters that moved — a future
+        # unbounded-growth regression shows up as a peak_rss_mb /
+        # accounted_mb trend long before it OOMs a real node
+        try:
+            import resource as _rusage
+
+            from surrealdb_tpu import resource as _resource
+
+            res.setdefault("peak_rss_mb", round(
+                _rusage.getrusage(_rusage.RUSAGE_SELF).ru_maxrss
+                / 1024.0, 1))
+            snap = _resource.get_accountant().snapshot()
+            res.setdefault("accounted_mb", round(
+                snap["accounted_bytes"] / (1 << 20), 3))
+            evs = {k: v for k, v in snap["counters"].items() if v}
+            if evs:
+                res.setdefault("mem_counters", evs)
+        except Exception:
+            pass
         # device-supervisor health snapshot: the benched queries ran
         # through the supervised runner (SURREAL_DEVICE=auto default),
         # so its state says whether this number measured the device
@@ -1558,6 +1642,7 @@ def main():
         "hybrid": bench_hybrid,
         "live_fanout": bench_live_fanout,
         "knn_sharded": bench_knn_sharded,
+        "mem_pressure": bench_mem_pressure,
     }
     _probe_backend()
     if args.all:
@@ -1589,6 +1674,12 @@ def main():
             print(f"bench: knn_sharded config failed "
                   f"({type(e).__name__}: {e})", file=sys.stderr,
                   flush=True)
+        try:
+            emit(bench_mem_pressure(quick=True))
+        except Exception as e:
+            print(f"bench: mem_pressure config failed "
+                  f"({type(e).__name__}: {e})", file=sys.stderr,
+                  flush=True)
         return 0
     if _PLATFORM == "cpu":
         # Wedged-tunnel fallback (or an explicit CPU run): the 10M×768
@@ -1614,6 +1705,12 @@ def main():
                 print(f"bench: knn_sharded {g}g config failed "
                       f"({type(e).__name__}: {e})", file=sys.stderr,
                       flush=True)
+        try:
+            emit(bench_mem_pressure(quick=False))
+        except Exception as e:
+            print(f"bench: mem_pressure config failed "
+                  f"({type(e).__name__}: {e})", file=sys.stderr,
+                  flush=True)
         return 0
     smoke = bench_knn1m(quick=True)
     print(f"bench: smoke ok: {json.dumps(smoke)}", file=sys.stderr,

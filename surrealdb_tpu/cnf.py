@@ -175,6 +175,32 @@ LSM_COMPACT_SEGMENTS = env_int("SURREAL_LSM_COMPACT_SEGMENTS", 6)
 # 0 disables, any other value floors at 1 MiB)
 MEMORY_THRESHOLD = env_int("SURREAL_MEMORY_THRESHOLD", 0)
 
+# -- node-wide resource governance (resource.py) -----------------------------
+# node budget for accounted derived state (vector stores, ANN graphs,
+# FT cache, CSR blocks, outboxes, ...). 0 = auto: MEM_BUDGET_FRAC of
+# the cgroup/host memory limit. Crossing budget*MEM_SOFT_FRAC triggers
+# priority-ordered eviction; crossing the budget (hard watermark)
+# sheds new admissions with a typed 503 and pauses allocation-heavy
+# builds at their chunk boundaries. These are read at accountant
+# construction / set_budget time (env_... at call), not import time.
+MEM_BUDGET_MB = env_int("SURREAL_MEM_BUDGET_MB", 0)
+MEM_BUDGET_FRAC = env_float("SURREAL_MEM_BUDGET_FRAC", 0.5)
+MEM_SOFT_FRAC = env_float("SURREAL_MEM_SOFT_FRAC", 0.8)
+# bounded wait at a build chunk boundary while the node stays over the
+# hard watermark (0 = evict-and-continue; keeps the simulator clockless)
+MEM_PAUSE_S = env_float("SURREAL_MEM_PAUSE_S", 0.0)
+# full-text result cache bounds (idx/fulltext.py FtResult entries):
+# entry count + estimated bytes, LRU-evicted (ft_cache_evictions)
+FT_CACHE_ENTRIES = env_int("SURREAL_FT_CACHE_ENTRIES", 512)
+FT_CACHE_BYTES = env_int("SURREAL_FT_CACHE_BYTES", 64 << 20)
+# device-runner store budget (device/handlers.py): total device-resident
+# bytes across vec/ann/csr block caches + multipart staging. 0 disables
+# byte budgeting (the per-kind LRU entry caps still bound the caches).
+# An admission evicts LRU stores first (eviction = re-ship, never an
+# error); a store that cannot fit even an empty runner is REFUSED with
+# a typed DeviceOutOfMemory and serves from host paths instead.
+DEVICE_MEM_BUDGET_MB = env_int("SURREAL_DEVICE_MEM_BUDGET_MB", 0)
+
 # -- remote KV client: retry / backoff / failover (kvs/remote.py) ------------
 # total deadline for one logical KV operation across retries+failover
 KV_RETRY_DEADLINE_S = env_float("SURREAL_KV_RETRY_DEADLINE_S", 15.0)

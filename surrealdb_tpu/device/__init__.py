@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from surrealdb_tpu.device.supervisor import (
     DeviceOpError,
+    DeviceOutOfMemory,
     DeviceSupervisor,
     DeviceUnavailable,
     attach_telemetry,
@@ -34,6 +35,7 @@ from surrealdb_tpu.device.supervisor import (
 
 __all__ = [
     "DeviceOpError",
+    "DeviceOutOfMemory",
     "DeviceSupervisor",
     "DeviceUnavailable",
     "attach_telemetry",

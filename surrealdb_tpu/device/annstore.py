@@ -132,6 +132,28 @@ class AnnStore:
         return int(self.graph.nbytes + self.x8.nbytes
                    + self.arow.nbytes + self.x2q.nbytes)
 
+    def device_nbytes(self) -> int:
+        """Device-resident bytes once installed: the four shipped
+        arrays plus the precomputed probe-row slices (`_ensure`, whose
+        probe length IS probe_count — no array materialized here: this
+        runs on every budget-admission pass). Used by the runner's
+        byte budget (DeviceHost._admit)."""
+        from surrealdb_tpu.idx.cagra import probe_count
+
+        n, dim = self.x8.shape
+        w = max(int(self.cfg.get("width", 64)), 1)
+        return self.nbytes() + probe_count(n, w) * (dim + 12)
+
+    @staticmethod
+    def estimate_device_bytes(n: int, dim: int, d_out: int) -> int:
+        """Admission estimate from the begin-frame shapes (before the
+        staging buffers are allocated): graph int32 + x8 rows + the
+        f32 per-row arrays; probe slices add at most ~N/24 rows."""
+        n = max(int(n), 0)
+        probe = min(n, max(4096, n // 8))
+        return n * (4 * max(int(d_out), 1) + max(int(dim), 1) + 8) \
+            + probe * (max(int(dim), 1) + 12)
+
     def _ensure(self):
         if self.device is None:
             import jax.numpy as jnp

@@ -66,6 +66,11 @@ class CsrStore:
     def nbytes(self) -> int:
         return int(self.rows.nbytes + self.cols.nbytes)
 
+    def device_nbytes(self) -> int:
+        """Device-resident bytes once ensured (the two edge arrays
+        move to the device as-is). Runner byte-budget ledger."""
+        return self.nbytes()
+
     def _ensure(self):
         if self.device is None:
             import jax.numpy as jnp

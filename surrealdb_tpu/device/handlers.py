@@ -12,11 +12,29 @@ from collections import OrderedDict
 
 import numpy as np
 
+from surrealdb_tpu import cnf
+
 # bounded block caches: enough for every live index in a busy node, and
 # an eviction is only a re-ship (never an error)
 MAX_VEC_STORES = 64
 MAX_CSR_STORES = 64
 MAX_ANN_STORES = 16
+
+
+class DeviceBudgetError(RuntimeError):
+    """A ship would exceed the runner's device-memory byte budget even
+    after evicting every other store: this ONE store cannot be served.
+    The runner stays healthy; the reply carries `oom: true` and the
+    supervisor raises a typed `DeviceOutOfMemory`, degrading that store
+    to the host paths (never wedging or killing the runner)."""
+
+
+def _vec_estimate(n: int, dim: int, itemsize: int, meta: dict) -> int:
+    from surrealdb_tpu.device.vecstore import VecStore
+
+    return VecStore.estimate_device_bytes(
+        n, dim, itemsize, meta["metric"], meta["cfg"]
+    )
 
 
 class DeviceHost:
@@ -42,6 +60,91 @@ class DeviceHost:
         # multipart ANN loads: key -> (meta, {name: array}); the int8
         # rows and the graph ship as independently chunked buffers
         self._ann_staging: dict = {}
+        # device-memory byte budget (SURREAL_DEVICE_MEM_BUDGET_MB;
+        # 0 = entry-count caps only). Every resident store accounts its
+        # estimated device bytes; a ship admits by evicting LRU stores
+        # first (eviction = re-ship on next use, never an error) and is
+        # REFUSED with DeviceBudgetError only when the single store
+        # cannot fit an otherwise-empty runner.
+        self.budget_bytes = cnf.env_int(
+            "SURREAL_DEVICE_MEM_BUDGET_MB", cnf.DEVICE_MEM_BUDGET_MB
+        ) << 20
+        self.oom_refusals = 0
+        self.budget_evictions = 0
+        # multipart install reservations: key -> final install bytes
+        # admitted at *_load_begin but not yet resident. Counted by
+        # mem_used() so a CONCURRENT ship admitted between one store's
+        # begin and end cannot overcommit the budget; released when
+        # the staged store installs (or its staging is dropped).
+        self._reserved: dict = {}
+
+    # -- device-memory budget ------------------------------------------------
+
+    def mem_used(self) -> int:
+        """Estimated device-resident bytes across the block caches
+        plus multipart staging buffers (host-side in the runner, but
+        they become device arrays at load_end — admitted up front)."""
+        total = 0
+        for cache in (self.vec, self.csr, self.ann):
+            for _tag, st in cache.values():
+                total += st.device_nbytes()
+        for _m, vecs, valid in self._staging.values():
+            total += int(vecs.nbytes) + int(valid.nbytes)
+        for _m, by_name in self._ann_staging.values():
+            total += sum(int(a.nbytes) for a in by_name.values())
+        total += sum(self._reserved.values())
+        return total
+
+    def _evict_key(self, key: str):
+        """Drop any resident copy of `key` ahead of its replacement
+        ship: a re-shipped store must never be refused because its own
+        OUTDATED copy is counted against (and protected from) the
+        budget."""
+        for cache in (self.vec, self.csr, self.ann):
+            cache.pop(key, None)
+
+    def _admit(self, incoming: int, keep_key: str = ""):
+        """Make room for `incoming` estimated bytes or raise
+        DeviceBudgetError. Victims pop oldest-first within each cache
+        (the per-kind OrderedDicts are LRU — every use move_to_end's),
+        in fixed kind order csr → vec → ann: ascending re-ship cost,
+        since an evicted store only ever answers `stale` and gets
+        re-shipped from KV truth. `keep_key` (the incoming store,
+        whose old copy `_evict_key` already dropped) is never a
+        victim."""
+        if self.budget_bytes <= 0:
+            return
+        if keep_key:
+            # the old copy is outdated (tag mismatch would answer
+            # `stale` regardless): free it instead of letting it count
+            # against — and be protected from — its own replacement
+            self._evict_key(keep_key)
+        if incoming > self.budget_bytes:
+            self.oom_refusals += 1
+            raise DeviceBudgetError(
+                f"store needs ~{incoming >> 20} MiB but the device "
+                f"budget is {self.budget_bytes >> 20} MiB "
+                f"(SURREAL_DEVICE_MEM_BUDGET_MB)"
+            )
+        while self.mem_used() + incoming > self.budget_bytes:
+            victim = None
+            for cache in (self.csr, self.vec, self.ann):
+                for key in cache:
+                    if key != keep_key:
+                        victim = (cache, key)
+                        break
+                if victim is not None:
+                    break
+            if victim is None:
+                self.oom_refusals += 1
+                raise DeviceBudgetError(
+                    f"store needs ~{incoming >> 20} MiB; "
+                    f"{self.mem_used() >> 20} MiB resident is "
+                    f"unevictable (staging) under the "
+                    f"{self.budget_bytes >> 20} MiB budget"
+                )
+            victim[0].pop(victim[1], None)
+            self.budget_evictions += 1
 
     # -- ops ----------------------------------------------------------------
     def handle(self, op: str, meta: dict, bufs: list):
@@ -68,6 +171,10 @@ class DeviceHost:
             "vec_bytes": sum(s.nbytes() for _t, s in self.vec.values()),
             "csr_bytes": sum(s.nbytes() for _t, s in self.csr.values()),
             "ann_bytes": sum(s.nbytes() for _t, s in self.ann.values()),
+            "mem_used": self.mem_used(),
+            "mem_budget": self.budget_bytes,
+            "oom_refusals": self.oom_refusals,
+            "budget_evictions": self.budget_evictions,
             "compile_cache": compile_cache.initialize()
             if compile_cache.configured_dir() else {"disabled": "unset"},
             "cc": kernelstats.snapshot(),
@@ -78,6 +185,10 @@ class DeviceHost:
 
         key = meta["key"]
         vecs, valid = bufs
+        self._admit(VecStore.estimate_device_bytes(
+            vecs.shape[0], vecs.shape[1], vecs.dtype.itemsize,
+            meta["metric"], meta["cfg"],
+        ), keep_key=key)
         st = VecStore(key, vecs, valid, meta["metric"],
                       meta.get("mink_p", 3.0), meta["cfg"])
         st.ensure()
@@ -90,7 +201,22 @@ class DeviceHost:
     def op_vec_load_begin(self, meta, bufs):
         key = meta["key"]
         n, dim = meta["shape"]
-        vecs = np.empty((int(n), int(dim)), dtype=np.dtype(meta["dtype"]))
+        dtype = np.dtype(meta["dtype"])
+        # admit staging + the final device arrays up front, BEFORE the
+        # big allocation: both are alive while load_end ensures the
+        # store, a refusal must land while the runner is still cheap
+        # to answer from, and the install share stays RESERVED (so a
+        # concurrent ship admitted mid-stream cannot overcommit) until
+        # load_end installs the store
+        est = _vec_estimate(int(n), int(dim), dtype.itemsize, meta)
+        self._admit(
+            int(n) * int(dim) * dtype.itemsize + int(n) + est,
+            keep_key=key,
+        )
+        self._reserved.pop(key, None)
+        if self.budget_bytes > 0:
+            self._reserved[key] = est
+        vecs = np.empty((int(n), int(dim)), dtype=dtype)
         (valid,) = bufs
         self._staging[key] = (dict(meta), vecs, valid)
         return "ok", {}, []
@@ -110,6 +236,7 @@ class DeviceHost:
 
         key = meta["key"]
         ent = self._staging.pop(key, None)
+        self._reserved.pop(key, None)  # the install replaces it below
         if ent is None:
             return "stale", {}, []
         lmeta, vecs, valid = ent
@@ -125,6 +252,7 @@ class DeviceHost:
     def op_vec_drop(self, meta, bufs):
         self.vec.pop(meta["key"], None)
         self._staging.pop(meta["key"], None)
+        self._reserved.pop(meta["key"], None)
         return "ok", {}, []
 
     def op_vec_knn(self, meta, bufs):
@@ -172,6 +300,9 @@ class DeviceHost:
     def _ann_install(self, key, tag, meta, graph, x8, arow, x2q):
         from surrealdb_tpu.device.annstore import AnnStore
 
+        self._admit(AnnStore.estimate_device_bytes(
+            x8.shape[0], x8.shape[1], graph.shape[1]
+        ), keep_key=key)
         st = AnnStore(key, graph, x8, arow, x2q, meta["metric"],
                       meta.get("cfg") or {})
         st._ensure()
@@ -187,9 +318,21 @@ class DeviceHost:
                                  graph, x8, arow, x2q)
 
     def op_ann_load_begin(self, meta, bufs):
+        from surrealdb_tpu.device.annstore import AnnStore
+
         key = meta["key"]
         arow, x2q = bufs
         n = arow.shape[0]
+        # staging + installed arrays coexist briefly at load_end; the
+        # install share stays reserved until then so concurrent ships
+        # cannot overcommit between begin and end
+        est = AnnStore.estimate_device_bytes(
+            n, int(meta["dim"]), int(meta["d_out"])
+        )
+        self._admit(2 * est, keep_key=key)
+        self._reserved.pop(key, None)
+        if self.budget_bytes > 0:
+            self._reserved[key] = est
         bufs_by_name = {
             "graph": np.empty((n, int(meta["d_out"])), np.int32),
             "x8": np.empty((n, int(meta["dim"])), np.int8),
@@ -212,6 +355,7 @@ class DeviceHost:
     def op_ann_load_end(self, meta, bufs):
         key = meta["key"]
         ent = self._ann_staging.pop(key, None)
+        self._reserved.pop(key, None)  # _ann_install re-admits below
         if ent is None:
             return "stale", {}, []
         lmeta, by_name = ent
@@ -223,6 +367,7 @@ class DeviceHost:
     def op_ann_drop(self, meta, bufs):
         self.ann.pop(meta["key"], None)
         self._ann_staging.pop(meta["key"], None)
+        self._reserved.pop(meta["key"], None)
         return "ok", {}, []
 
     def op_ann_search(self, meta, bufs):
@@ -247,6 +392,7 @@ class DeviceHost:
 
         key = meta["key"]
         rows, cols = bufs
+        self._admit(int(rows.nbytes) + int(cols.nbytes), keep_key=key)
         st = CsrStore(key, rows, cols, int(meta["n_nodes"]))
         self.csr.pop(key, None)
         self.csr[key] = (list(meta["tag"]), st)

@@ -47,7 +47,7 @@ def serve(sock) -> None:
             pass
         raise
     from surrealdb_tpu.device import kernelstats
-    from surrealdb_tpu.device.handlers import DeviceHost
+    from surrealdb_tpu.device.handlers import DeviceBudgetError, DeviceHost
 
     host = DeviceHost()
     proto.send_msg(sock, "ready",
@@ -79,11 +79,14 @@ def serve(sock) -> None:
         except BaseException as e:
             err = f"{e.__class__.__name__}: {e}"
             tb = traceback.format_exc(limit=6)
+            reply = {"seq": seq, "error": err[:500], "trace": tb[-2000:]}
+            if isinstance(e, DeviceBudgetError):
+                # typed refusal, not a health event: the supervisor
+                # raises DeviceOutOfMemory and degrades THIS store to
+                # host paths; the runner keeps serving everything else
+                reply["oom"] = True
             try:
-                proto.send_msg(
-                    sock, "err",
-                    {"seq": seq, "error": err[:500], "trace": tb[-2000:]},
-                )
+                proto.send_msg(sock, "err", reply)
             except OSError:
                 return
 

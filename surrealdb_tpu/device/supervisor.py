@@ -58,6 +58,17 @@ class DeviceOpError(Exception):
     the circuit breaker."""
 
 
+class DeviceOutOfMemory(DeviceUnavailable):
+    """The runner REFUSED a store ship that cannot fit its device
+    byte budget (SURREAL_DEVICE_MEM_BUDGET_MB) even after evicting
+    every other store. Subclass of DeviceUnavailable so every existing
+    degrade ladder already answers from the host paths; the supervisor
+    additionally remembers the (key, tag) so later dispatches for that
+    store fail fast to host instead of re-shipping gigabytes at the
+    runner just to be refused again. The runner stays healthy for
+    every other store — a refusal is never a circuit-breaker event."""
+
+
 class DeviceSupervisor:
     def __init__(self, mode: Optional[str] = None,
                  dispatch_timeout_s: Optional[float] = None,
@@ -102,7 +113,13 @@ class DeviceSupervisor:
             "device_spawns": 0, "device_restarts": 0,
             "device_dispatch_timeouts": 0, "device_dispatch_errors": 0,
             "device_fallbacks": 0, "device_host_routed": 0,
+            "device_oom_refusals": 0,
         }
+        # stores the runner refused under its byte budget: key -> tag.
+        # ensure_loaded fails these fast (typed DeviceOutOfMemory →
+        # host paths) until the store's tag changes (a rebuilt, smaller
+        # store deserves a fresh attempt).
+        self._oom_keys: dict = {}
         # last-known runner-side kernel compile counters (piggybacked on
         # every reply) + the runner's persistent-compile-cache info
         self.compile_counts = {"hits": 0, "misses": 0}
@@ -268,10 +285,28 @@ class DeviceSupervisor:
         with self._lock:
             if self._loaded.get(key) == tag:
                 return
+            if self._oom_keys.get(key) == tag:
+                # the runner already refused this exact store under its
+                # byte budget: fail fast instead of re-shipping it just
+                # to be refused again — to the host paths in auto mode,
+                # as a loud typed error under require
+                if self.mode == "require":
+                    raise SdbError(
+                        f"device required (SURREAL_DEVICE=require) but "
+                        f"store {key} exceeds the device byte budget"
+                    )
+                raise DeviceOutOfMemory(
+                    f"store {key} over device budget (cached refusal)"
+                )
         op, meta, bufs = loader()
         meta = dict(meta)
         meta["key"] = key
         meta["tag"] = tag
+        # refusal bookkeeping (counter + the per-(key, tag) fail-fast
+        # cache) happens in _call_live/_call_inline where the oom reply
+        # is DETECTED — require mode rewraps the exception as SdbError
+        # before it would reach a handler here, and the recording must
+        # survive that
         if (op == "vec_load"
                 and bufs[0].nbytes > self.LOAD_PART_BYTES):
             self._multipart_vec_load(key, tag, meta, bufs[0], bufs[1])
@@ -282,6 +317,7 @@ class DeviceSupervisor:
             self.call(op, meta, bufs, timeout_s=self.load_timeout_s)
         with self._lock:
             self._loaded[key] = tag
+            self._oom_keys.pop(key, None)
         if self.mode != "inline":
             kind = {"vec_load": "vec", "ann_load": "ann",
                     "csr_load": "csr"}.get(op)
@@ -382,6 +418,7 @@ class DeviceSupervisor:
     def forget(self, key: str):
         with self._lock:
             self._loaded.pop(key, None)
+            self._oom_keys.pop(key, None)
 
     # -- introspection -------------------------------------------------------
 
@@ -408,6 +445,7 @@ class DeviceSupervisor:
             "dispatch_errors": self.counters["device_dispatch_errors"],
             "fallbacks": self.counters["device_fallbacks"],
             "host_routed": self.counters.get("device_host_routed", 0),
+            "oom_refusals": self.counters.get("device_oom_refusals", 0),
             "last_error": self.last_error,
             "vec_blocks": sum(1 for k in loaded if k.startswith("vec/")),
             "csr_blocks": sum(1 for k in loaded if k.startswith("csr/")),
@@ -462,6 +500,7 @@ class DeviceSupervisor:
                 self.state = "cold"
             self._fail_pending("device supervisor shut down")
             self._loaded.clear()
+            self._oom_keys.clear()
             self._inline_host = None
         _close_sock(sock)
         if spawning is not None:
@@ -492,6 +531,11 @@ class DeviceSupervisor:
         except (KeyboardInterrupt, SystemExit):
             raise
         except BaseException as e:
+            from surrealdb_tpu.device.handlers import DeviceBudgetError
+
+            if isinstance(e, DeviceBudgetError):
+                self._note_oom(meta)
+                raise DeviceOutOfMemory(str(e)) from e
             self.counters["device_dispatch_errors"] += 1
             raise DeviceOpError(f"{e.__class__.__name__}: {e}") from e
         if self.platform is None and op != "status":
@@ -772,9 +816,27 @@ class DeviceSupervisor:
         if tag == "err":
             if rmeta.get("_unavail"):
                 raise DeviceUnavailable(rmeta.get("error", "runner died"))
+            if rmeta.get("oom"):
+                # typed budget refusal from the runner: degrade this
+                # store to host, never the circuit breaker
+                self._note_oom(meta)
+                raise DeviceOutOfMemory(
+                    rmeta.get("error", "device store over budget")
+                )
             self.counters["device_dispatch_errors"] += 1
             raise DeviceOpError(rmeta.get("error", "device op failed"))
         return tag, rmeta, rbufs
+
+    def _note_oom(self, meta: dict):
+        """Record a budget refusal for the store named in `meta` —
+        counter + the per-(key, tag) fail-fast cache ensure_loaded
+        consults, recorded HERE so it happens in every mode (require
+        rewraps the exception before callers could record it)."""
+        self.counters["device_oom_refusals"] += 1
+        key, tag = meta.get("key"), meta.get("tag")
+        if key and tag is not None:
+            with self._lock:
+                self._oom_keys[key] = list(tag)
 
     def _send_loop(self, sock, gen):
         from surrealdb_tpu.device import proto
@@ -902,7 +964,8 @@ def attach_telemetry(telemetry):
         lambda: 1 if get_supervisor().state == "degraded" else 0,
     )
     for name in ("device_restarts", "device_dispatch_timeouts",
-                 "device_fallbacks", "device_host_routed"):
+                 "device_fallbacks", "device_host_routed",
+                 "device_oom_refusals"):
         telemetry.register_gauge(
             name, lambda n=name: get_supervisor().counters.get(n, 0)
         )

@@ -19,6 +19,21 @@ from __future__ import annotations
 import numpy as np
 
 
+def _device_count() -> int:
+    """Real device count when jax is up (it always is runner-side —
+    init precedes serving; inline mode imports it on first ensure),
+    else 1. Kept lazy so constructing a store never triggers init."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 1
+    try:
+        return max(jax.device_count(), 1)
+    except Exception:
+        return 1
+
+
 def _pow2_chunks(b_total: int, n: int, query_chunk: int,
                  elems_budget: int):
     """Power-of-two query bucket/chunk sizing shared by every ranking
@@ -58,6 +73,40 @@ class VecStore:
 
     def nbytes(self) -> int:
         return int(self.vecs.nbytes)
+
+    @staticmethod
+    def estimate_device_bytes(n: int, dim: int, itemsize: int,
+                              metric: str, cfg: dict,
+                              ndev: int = 0) -> int:
+        """Device-resident bytes this store will pin once ensured —
+        mirrors `ensure()`'s kernel-selection branches (including the
+        per-chip HBM share that picks bf16-vs-int8) so the runner's
+        byte budget can ADMIT OR REFUSE a ship before allocating
+        anything (DeviceHost._admit). `ndev` 0 resolves the real
+        device count — passing 1 on a mesh would both pick the wrong
+        kernel branch and overstate the per-chip share."""
+        if ndev <= 0:
+            ndev = _device_count()
+        n = max(int(n), 0)
+        dim = max(int(dim), 1)
+        if metric not in ("euclidean", "cosine", "dot"):
+            # exact store: the raw rows + the validity mask
+            return (n * dim * itemsize) // max(ndev, 1) + n
+        if (6 * n * dim) // max(ndev, 1) > cfg.get("hbm_budget",
+                                                   1 << 62):
+            # int8 ranking store: rows (1 B/elem) + arow/x2 + valid
+            return n * dim + 9 * n
+        # bf16 rank + f32 full (6 B/elem) + per-row stats + valid
+        return (6 * n * dim) // max(ndev, 1) + 9 * n
+
+    def device_nbytes(self) -> int:
+        """Estimated device-resident bytes for the budget ledger (the
+        host mirror in `self.vecs` is serving-process memory, already
+        accounted there)."""
+        n, dim = self.vecs.shape
+        return self.estimate_device_bytes(
+            n, dim, self.vecs.dtype.itemsize, self.metric, self.cfg
+        )
 
     def ensure(self):
         if self.device_vecs is not None or self.device_rank is not None:

@@ -35,6 +35,20 @@ class ShedError(SdbError):
         self.retry_after_s = retry_after_s
 
 
+class StorageFullError(SdbError):
+    """The storage engine could not make a write durable (ENOSPC, a
+    failed fsync) and the node has entered typed READ-ONLY mode: reads
+    and replication keep serving from the already-durable state, every
+    write fails with this error until space is freed and recovery
+    succeeds (kvs/file.py `try_recover`). The write was not applied to
+    the running node, so retrying after the operator frees space is
+    safe — with one caveat the message calls out when it applies: if
+    the refused bytes could not be truncated from the WAL AND the node
+    crashes before recovery, replay may apply them (the same OUTCOME
+    UNKNOWN contract as an in-flight remote commit), so retries must
+    be idempotent at the application level."""
+
+
 class KnnShardUnavailable(SdbError):
     """A scatter-gather KNN query could not get an answer from every
     index shard within its per-shard budgets (SURREAL_KNN_PARTIAL=error

@@ -5146,6 +5146,11 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             stage_snapshot as _stage_snapshot,
         )
 
+        def _mem_snapshot():
+            from surrealdb_tpu.resource import get_accountant
+
+            return get_accountant().snapshot()
+
         dev = get_supervisor().status()
 
         # shard topology (kvs/shard.py): ranges, epochs, primaries —
@@ -5193,6 +5198,10 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             # sessions, dispatch backlog, overflow/drop tallies
             "live": dict(ctx.ds.fanout.stats(),
                          subscriptions=len(ctx.ds.live_queries)),
+            # node-wide resource governance (resource.py): accounted
+            # derived-state bytes vs the soft/hard watermarks, the
+            # per-kind breakdown, and eviction/shed/throttle counters
+            "mem": _mem_snapshot(),
         }
         if shard_topo is not None:
             out["shards"] = shard_topo

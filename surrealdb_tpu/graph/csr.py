@@ -59,6 +59,18 @@ class CsrGraph:
         self._built = False  # a full build has populated the arrays
         self._batcher = None  # lazy cross-query hop batcher
 
+    def nbytes(self) -> int:
+        """Host bytes this cached graph holds (resource accounting:
+        the datastore's `csr` account sums this across engines)."""
+        total = int(self.rows.nbytes) + int(self.cols.nbytes)
+        if self.indptr is not None:
+            total += int(self.indptr.nbytes)
+        if self.sorted_cols is not None:
+            total += int(self.sorted_cols.nbytes)
+        # node/edge id lists: rough per-entry object cost
+        total += 64 * (len(self.node_ids) + len(self.edge_ids))
+        return total
+
     def build(self, ctx):
         """Pack the edge table's adjacency into CSR arrays. Primary
         source: the `~` graph keys of the EDGE table — per edge record,

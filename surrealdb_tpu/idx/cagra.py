@@ -255,9 +255,17 @@ def build_graph(xs: np.ndarray, metric: str = "euclidean",
     keep = 2 * d_out
     best_i = np.full((n, keep), -1, np.int64)
     best_d = np.full((n, keep), np.inf, np.float32)
+    from surrealdb_tpu import resource
+
     for _t in range(max(trees, 1)):
+        # chunk-boundary pause point (resource governance): under hard
+        # memory pressure the build evicts colder node state — or
+        # waits, when SURREAL_MEM_PAUSE_S is set — before allocating
+        # the next tree pass's scratch
+        resource.throttle("ann_build")
         _leaf_pass(space, best_i, best_d, keep, max(leaf, d_out + 1), rng)
     for _r in range(max(refine, 0)):
+        resource.throttle("ann_build")
         _refine_pass(space, best_i, best_d, keep, d_out, rng)
     # forward edges in rank order (CAGRA "reordering": rank = closeness
     # position, which the merge below prefers over raw distance)
@@ -427,6 +435,9 @@ def build_index(xs: np.ndarray, metric: str, version: int, epoch: int,
     n = xs.shape[0]
     x2, norms = row_stats(xs)
     graph = build_graph(xs, metric, seed=seed, x2=x2, norms=norms, **kw)
+    from surrealdb_tpu import resource
+
+    resource.throttle("ann_build")  # before the int8 store allocates
     x8, arow = quantize_int8(xs, metric, norms=norms)
     if metric == "euclidean":
         # squared norms of the DEQUANTIZED rows: the int8 descent

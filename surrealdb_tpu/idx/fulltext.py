@@ -327,6 +327,36 @@ class FtResult:
             o = self._ordered = [r for r, _s in self.hits]
         return o
 
+    def cost_bytes(self) -> int:
+        """Cheap cache-cost estimate (no object-graph traversal): each
+        hit carries a rid + score + map slots across the three derived
+        views; each offset tuple is a handful of small ints."""
+        n_offs = sum(len(v) for v in self.offsets.values()) \
+            if self.offsets else 0
+        return 256 + 160 * len(self.hits) + 96 * n_offs
+
+
+def _txn_wrote(txn, key: bytes) -> bool:
+    """Whether this transaction's OWN write set touches `key`.
+
+    Every FT index mutation writes the `bv` version key in the same
+    call that writes the postings (fulltext_index_update), so an
+    untouched `bv` proves the txn's view of this index is the
+    committed snapshot — safe to share through the datastore cache. An
+    engine whose write buffer we cannot see answers True
+    (conservative: never populate from an unknowable view)."""
+    btx = getattr(txn, "btx", None)
+    w = getattr(btx, "writes", None)
+    if w is not None:
+        return key in w
+    subs = getattr(btx, "_subs", None)  # ShardTx: per-shard buffers
+    if subs is not None:
+        try:
+            return any(key in sub.writes for sub in subs.values())
+        except AttributeError:
+            return True
+    return True
+
 
 def ft_result(idef, query: str, ctx, boolean: str = "AND") -> FtResult:
     """The memoized search. Two levels: per statement
@@ -346,7 +376,19 @@ def ft_result(idef, query: str, ctx, boolean: str = "AND") -> FtResult:
     ver = ctx.txn.get_val(_ver_key(ns, db, tb, ix)) or 0
     cache = getattr(ctx.ds, "_ft_cache", None)
     if cache is None:
-        cache = ctx.ds._ft_cache = {}
+        # bounded LRU (entry count + byte cap): on a hot mixed
+        # read/write table every write bumps `bv`, so an unbounded map
+        # keyed by (query, version) grows one dead entry per write
+        # forever. Normally created (and registered with the memory
+        # accountant) by Datastore.__init__; this is the duck-typed-ds
+        # fallback.
+        from surrealdb_tpu.resource import BudgetedLRU
+
+        from surrealdb_tpu import cnf as _cnf
+
+        cache = ctx.ds._ft_cache = BudgetedLRU(
+            _cnf.FT_CACHE_ENTRIES, _cnf.FT_CACHE_BYTES
+        )
     ftp = idef.fulltext or {}
     # fingerprint the analyzer DEFINITION, not its name: DEFINE
     # ANALYZER ... OVERWRITE changes tokenization without touching the
@@ -365,14 +407,17 @@ def ft_result(idef, query: str, ctx, boolean: str = "AND") -> FtResult:
         res = ent[1]
     else:
         res = FtResult(*_ft_search_impl(idef, query, ctx, boolean))
-        # never populate from a write txn: its uncommitted view must not
-        # become visible to committed readers under a version it might
-        # never commit (reads are safe — this txn's own index writes
-        # bumped `ver`, so they can't hit a stale entry)
-        if not getattr(ctx.txn, "write", False):
-            if len(cache) >= 512:
-                cache.clear()
-            cache[gk] = (ver, res)
+        # never populate an UNCOMMITTED view: a write txn that touched
+        # this index read `ver` from its own write set — a version it
+        # might never commit, which a later committed writer could
+        # alias. A write txn that did NOT touch the index saw exactly
+        # the committed snapshot at `ver` (every index mutation bumps
+        # `bv` in the same call as its postings), so its result is as
+        # shareable as a read txn's — which matters, because the
+        # embedded executor runs every statement in a write txn.
+        if not getattr(ctx.txn, "write", False) \
+                or not _txn_wrote(ctx.txn, _ver_key(ns, db, tb, ix)):
+            cache.put(gk, (ver, res), cost=res.cost_bytes())
     ctx.record_cache[ck] = res
     return res
 

@@ -285,6 +285,12 @@ class ShardedVectorIndex:
             stage_record("index_knn", _time.perf_counter_ns() - t0)
 
     def _knn(self, q, k: int, ctx, cond=None, cond_ctx=None):
+        # pressure checkpoint before the scatter (no router/part locks
+        # held here — rule 8): part engines register their own vec/ann
+        # accounts, so eviction degrades a cold part to rebuild-on-touch
+        from surrealdb_tpu import resource as _resource
+
+        _resource.checkpoint()
         qv = _as_vector(q, self.dim, "knn query", self.dtype)
         over = max(float(cnf.KNN_SHARD_OVERSAMPLE), 1.0)
         fetch0 = max(k, int(np.ceil(k * over)))

@@ -28,6 +28,7 @@ import uuid
 import numpy as np
 
 from surrealdb_tpu import key as K
+from surrealdb_tpu import resource
 from surrealdb_tpu.device.batcher import DeviceBatcher
 from surrealdb_tpu.err import SdbError
 from surrealdb_tpu.utils.rwlock import RWLock
@@ -267,6 +268,102 @@ class TpuVectorIndex:
         self._ann_lock = threading.Lock()
         self._ann_dev_key = f"ann/{uuid.uuid4().hex[:16]}"
         self.coalescer = _Coalescer(self)
+        # queries in flight on this engine (between sync and the end of
+        # their scoring pass): a pinned engine's host arrays are not
+        # evictable — freeing state out from under an active search
+        # would silently change its answer, the one degradation the
+        # governance layer must never produce
+        self._pins = 0
+        # resource governance: every byte this engine derives from KV
+        # truth is a tracked, evictable account — the host rows
+        # (rebuild = one range scan on the next sync), the CAGRA
+        # build (rebuild in the background / reload from a persisted
+        # artifact; brute force serves meanwhile), and the per-epoch
+        # rank stats (a trivial recompute). Bound methods: the
+        # accountant holds them weakly, so a discarded engine is
+        # pruned, never pinned.
+        acct_label = f"{tb}.{ix}" + (f"[{label}]" if label else "")
+        # shard-part engines (key_range set) are TRACKED but their host
+        # rows are not byte-evictable: the scatter router syncs and
+        # searches a part in separate steps, and a background eviction
+        # between them could merge a silently short answer — the one
+        # wrongness this layer forbids. Their ann/rank-stats overlays
+        # (safe to drop mid-flight) stay evictable; the unsharded
+        # engine keeps full evictability behind the pin guard.
+        self._mem_vec = resource.register(
+            "vec", acct_label, self._vec_mem_bytes,
+            evict=self._mem_evict_vec if key_range is None else None,
+            owner=self,
+        )
+        self._mem_ann = resource.register(
+            "ann", acct_label, self._ann_mem_bytes,
+            evict=self._mem_evict_ann, owner=self,
+        )
+        self._mem_stats = resource.register(
+            "rank_stats", acct_label, self._stats_mem_bytes,
+            evict=self._mem_evict_stats, owner=self,
+        )
+
+    # -- resource accounting ------------------------------------------------
+
+    def _vec_mem_bytes(self) -> int:
+        return int(self.vecs.nbytes) + int(self.valid.nbytes)
+
+    def _ann_mem_bytes(self) -> int:
+        ann = self._ann
+        return int(ann.nbytes()) if ann is not None else 0
+
+    def _stats_mem_bytes(self) -> int:
+        st = self._host_stats
+        if st is None:
+            return 0
+        return sum(int(a.nbytes) for a in st
+                   if a is not None and hasattr(a, "nbytes"))
+
+    def _mem_evict_stats(self):
+        # per-epoch scoring stats: recomputed lazily by the next BLAS
+        # ranking pass — the cheapest possible degrade
+        self._host_stats = None
+
+    def _mem_evict_ann(self):
+        # drop the built graph; brute force serves (exactly) until the
+        # background build — possibly a fast artifact reload — returns.
+        # The dirty-row map survives: an in-flight query that captured
+        # the old AnnIndex still needs it for its exact tail merge, and
+        # row numbers stay valid until a repack.
+        with self._ann_lock:
+            self._ann = None
+            self._ann_gen += 1  # voids a build racing this eviction
+            if self._ann_state == "ready":
+                self._ann_state = "idle"
+
+    def _mem_evict_vec(self):
+        # degrade the host arrays to rebuild-on-touch: version -1 makes
+        # the next sync() re-scan this engine's KV range (the exact
+        # PR-9 fresh-node discipline); the ANN snapshot's row numbering
+        # dies with the arrays. PINNED engines are skipped: a query
+        # between its sync() and its read-locked scoring pass must
+        # never observe the arrays vanish — eviction degrades speed,
+        # NEVER answers. Called only from checkpoint sites that hold
+        # none of this engine's locks.
+        with self.lock:
+            if self._pins > 0:
+                return  # actively serving: not evictable right now
+            with self.rw.write():
+                self.version = -1
+                self.rids = []
+                self.row_index = {}
+                self.vecs = np.zeros((0, self.dim), dtype=self.dtype)
+                self.valid = np.zeros(0, dtype=bool)
+                self._drop_device()
+                with self._ann_lock:
+                    self._ann = None
+                    self._ann_dirty = {}
+                    self._ann_dead = 0
+                    self._ann_dead_base = 0
+                    self._ann_gen += 1
+                    if self._ann_state == "ready":
+                        self._ann_state = "idle"
 
     # -- cache sync ---------------------------------------------------------
     def sync(self, ctx):
@@ -276,9 +373,20 @@ class TpuVectorIndex:
         pending/compaction design, hnsw/index.rs). A store that crossed
         the ANN threshold (or whose graph went stale) kicks a background
         graph build afterwards — brute force serves until it lands."""
+        # pressure checkpoint BEFORE taking any index lock: past the
+        # soft watermark this may evict cold accounts (possibly this
+        # engine's own — the rebuild below then runs from KV truth)
+        self._mem_vec.touch()
+        resource.checkpoint()
+        ver0 = self.version
         try:
             self._sync_impl(ctx)
         finally:
+            if self.version != ver0:
+                # the sync grew state (log apply / rebuild): settle
+                # with a fresh poll, same step-jump rationale as the
+                # ANN install
+                resource.checkpoint(fresh=True)
             self._maybe_build_ann()
 
     def _sync_impl(self, ctx):
@@ -412,6 +520,10 @@ class TpuVectorIndex:
             index[K.enc_value(idv)] = len(rids)
             rids.append(RecordId(tb, idv))
             rows.append(np.frombuffer(deserialize(raw), dtype=self.dtype))
+            if len(rids) % 65536 == 0:
+                # chunk-boundary pause point: a rebuild under memory
+                # pressure evicts colder state before allocating more
+                resource.throttle("index_rebuild")
         return rids, rows, index
 
     def _install_rows(self, rids, rows, index):
@@ -493,19 +605,25 @@ class TpuVectorIndex:
         host-routed parts call the batched engine entry directly —
         paying the coalescer's condition dance per part per query
         measurably loses to one BLAS pass on CPU-routed stores."""
-        n = int(self.valid.sum()) if len(self.valid) else 0
-        if n == 0:
-            return []
-        k = min(k, n)
-        if len(self.rids) < DEVICE_MIN_ROWS:
-            # tiny part: the exact host ladder, bit-for-bit the
-            # unsharded small-store path
+        with self.lock:
+            self._pins += 1  # pin: eviction must not race this search
+        try:
+            n = int(self.valid.sum()) if len(self.valid) else 0
+            if n == 0:
+                return []
+            k = min(k, n)
+            if len(self.rids) < DEVICE_MIN_ROWS:
+                # tiny part: the exact host ladder, bit-for-bit the
+                # unsharded small-store path
+                with self.rw.read():
+                    return self._host_knn_single(qv, k)
+            if self._use_device():
+                return self.coalescer.search(qv, k)
             with self.rw.read():
-                return self._host_knn_single(qv, k)
-        if self._use_device():
-            return self.coalescer.search(qv, k)
-        with self.rw.read():
-            return self.knn_batch(np.asarray(qv)[None, :], k)[0]
+                return self.knn_batch(np.asarray(qv)[None, :], k)[0]
+        finally:
+            with self.lock:
+                self._pins -= 1
 
     def residency(self) -> dict:
         """Index-serving residency for INFO FOR SYSTEM / /metrics."""
@@ -642,6 +760,12 @@ class TpuVectorIndex:
             # at the next full repack) — stop counting them as drift
             self._ann_dead_base = dead0
             self._ann_state = "ready"
+        if installed:
+            self._mem_ann.touch()
+            # the install just grew accounted bytes by a step: settle
+            # pressure NOW with a fresh poll — the gated hot-path
+            # checkpoint could reuse a stale low reading
+            resource.checkpoint(fresh=True)
         if installed and not loaded:
             self._save_ann_snapshot(ann, xs, rids)
 
@@ -891,10 +1015,14 @@ class TpuVectorIndex:
         from surrealdb_tpu.telemetry import stage_record
 
         t0 = _time.perf_counter_ns()
+        with self.lock:
+            self._pins += 1  # pin: eviction must not race this query
         try:
             return self._knn(q, k, ctx, ef=ef, cond=cond,
                              cond_ctx=cond_ctx)
         finally:
+            with self.lock:
+                self._pins -= 1
             # wall time inside the index: cache sync + batcher wait +
             # kernel (device RPC time shows separately as device_rpc)
             stage_record("index_knn", _time.perf_counter_ns() - t0)

@@ -173,6 +173,14 @@ class Datastore:
         # per-session bounded outboxes (threads spawn lazily on first
         # publish — embedded datastores that never LIVE pay nothing)
         self.fanout = FanoutHub(self)
+        # full-text result cache: bounded LRU (entry + byte caps) — a
+        # hot mixed read/write table must not grow one dead entry per
+        # write-version forever. Registered with the memory accountant
+        # below; evictions surface as ft_cache_evictions.
+        from surrealdb_tpu.resource import BudgetedLRU
+
+        self._ft_cache = BudgetedLRU(cnf.FT_CACHE_ENTRIES,
+                                     cnf.FT_CACHE_BYTES)
         self.ml_cache: dict = {}  # (ns,db,name,version,hash) -> SurmlFile
         self.module_cache: dict = {}  # (ns,db,name) -> (hash, wasm Instance)
         self.sequences: dict = {}
@@ -217,6 +225,25 @@ class Datastore:
         from surrealdb_tpu.device import attach_telemetry
 
         attach_telemetry(self.telemetry)
+        # node-wide memory governance: register this datastore's
+        # derived-state accounts (vector engines register their own as
+        # they are created) and surface the accountant through this
+        # hub's gauges/counters. Accounts hold the datastore weakly —
+        # a closed/discarded ds is pruned, never pinned.
+        from surrealdb_tpu import resource as _resource
+
+        _resource.attach_telemetry(self.telemetry)
+        self._mem_ft = _resource.register(
+            "ft", "ft-cache", self._ft_cache_bytes,
+            evict=self._ft_cache_evict, owner=self,
+        )
+        self._mem_csr = _resource.register(
+            "csr", "csr-blocks", self._csr_mem_bytes,
+            evict=self._csr_mem_evict, owner=self,
+        )
+        self.telemetry.register_counter(
+            "ft_cache_evictions", lambda: self._ft_cache.evictions
+        )
         # index-serving shard count across all sharded vector indexes
         # (0 on unsharded stores; pairs with the knn_shard_fanout /
         # knn_partial_results / knn_hedged_dispatches counters)
@@ -243,6 +270,38 @@ class Datastore:
         self._tso_end = 0
         self._tso_expiry = 0.0
         self._stamp_storage_version(check_version)
+
+    # -- resource accounting (resource.py) -----------------------------------
+
+    def _ft_cache_bytes(self) -> int:
+        return int(self._ft_cache.nbytes)
+
+    def _ft_cache_evict(self):
+        # drop the coldest half: the next identical search re-runs the
+        # posting walk (pure cache, KV truth untouched)
+        self._ft_cache.shrink(0.5)
+
+    def _csr_mem_bytes(self) -> int:
+        ge = self.graph_engine
+        total = 0
+        if ge:
+            for g in list(ge.values()):
+                nb = getattr(g, "nbytes", None)
+                if nb is not None:
+                    total += int(nb())
+        totals = getattr(self, "_edge_oplog_totals", None)
+        if totals:
+            # ~3 small objects per logged edge op
+            total += sum(totals.values()) * 96
+        return total
+
+    def _csr_mem_evict(self):
+        # CSR adjacency + the edge op log are caches over the `~` graph
+        # keys: dropping them degrades the next traversal to a rebuild
+        # scan (get_csr), exactly like a version bump would
+        self.graph_engine = {} if self.graph_engine is not None else None
+        self._edge_oplog = {}
+        self._edge_oplog_totals = {}
 
     def _register_compile_cache_dir(self, store_path: str):
         """Disk-backed stores anchor the device runner's persistent
@@ -533,4 +592,6 @@ class Datastore:
         if self.node_tasks is not None:
             self.node_tasks.stop()
         self.fanout.close_all()
+        self._mem_ft.close()
+        self._mem_csr.close()
         self.backend.close()

@@ -31,10 +31,15 @@ Usage:
 
 On-disk corruption (WAL/snapshot CRC tests) uses `flip_file_byte`:
 XOR one byte in place, exactly what a bad sector / torn DMA does.
+Disk exhaustion uses `inject_enospc`: the FileBackend's fsync seams
+start raising ENOSPC after N more batches, exactly what a full volume
+does mid-append — the engine must degrade to typed read-only, never
+crash (kvs/file.py).
 """
 
 from __future__ import annotations
 
+import errno
 import os
 import random
 import socket
@@ -44,6 +49,43 @@ import time
 from typing import Callable, Optional
 
 _HDR = struct.Struct(">I")
+
+
+def inject_enospc(backend, after: int = 0, snapshots: bool = True):
+    """Make a FileBackend's durability seams fail with ENOSPC.
+
+    `after` WAL appends still succeed; every later `_sync_wal` (and,
+    with `snapshots`, every `_sync_snapshot` — the compaction path)
+    raises `OSError(ENOSPC)`, the exact failure a full volume injects
+    between a successful write() and its fsync. Returns a `heal()`
+    callable restoring the real seams (the "operator freed space"
+    event; pair with `backend.try_recover()`)."""
+    real_sync_wal = backend._sync_wal
+    real_sync_snap = backend._sync_snapshot
+    state = {"left": int(after)}
+
+    def _full(*_a):
+        raise OSError(errno.ENOSPC, "No space left on device")
+
+    def sync_wal():
+        if state["left"] <= 0:
+            _full()
+        state["left"] -= 1
+        real_sync_wal()
+
+    def sync_snapshot(f):
+        if snapshots and state["left"] <= 0:
+            _full()
+        real_sync_snap(f)
+
+    backend._sync_wal = sync_wal
+    backend._sync_snapshot = sync_snapshot
+
+    def heal():
+        backend._sync_wal = real_sync_wal
+        backend._sync_snapshot = real_sync_snap
+
+    return heal
 
 
 def flip_file_byte(path: str, offset: int, xor: int = 0xFF) -> int:

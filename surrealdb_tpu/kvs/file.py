@@ -7,6 +7,16 @@ the snapshot. Durability = fsync per commit, appended under the store lock
 after conflict validation so durability and visibility stay atomic.
 Transactions get the same snapshot isolation + write-write conflict
 detection as the mem engine (see kvs/mem.VersionedStore).
+
+Disk-full discipline: an ENOSPC / failed fsync on the WAL (or a failed
+snapshot rewrite) must never crash the node mid-append or, worse,
+acknowledge a write that is not durable. The engine instead enters
+typed READ-ONLY mode: the failing commit raises `StorageFullError`
+BEFORE its writes become visible (the WAL append runs pre-apply under
+the store lock, and a torn tail is ignored at replay), reads and
+replication keep serving, and `try_recover()` re-opens writes once a
+compaction succeeds again. The fsync paths are seam methods so
+`kvs/faults.py` can inject ENOSPC deterministically.
 """
 
 from __future__ import annotations
@@ -14,6 +24,7 @@ from __future__ import annotations
 import os
 import pickle
 
+from surrealdb_tpu.err import StorageFullError
 from surrealdb_tpu.kvs.api import Backend
 from surrealdb_tpu.kvs.mem import MemTx, VersionedStore
 
@@ -36,6 +47,9 @@ class FileBackend(Backend):
         self._load()
         self.wal = open(self.wal_path, "ab")
         self._wal_batches = 0
+        # typed read-only mode: the reason string of the storage error
+        # that tripped it, or None when writes are healthy
+        self.read_only: str | None = None
 
     def _load(self):
         if os.path.exists(self.snap_path):
@@ -57,40 +71,132 @@ class FileBackend(Backend):
     def transaction(self, write: bool):
         return FileTx(self, write)
 
+    # -- durability seams (kvs/faults.py ENOSPC injection wraps these) ------
+    def _sync_wal(self):
+        self.wal.flush()
+        os.fsync(self.wal.fileno())
+
+    def _sync_snapshot(self, f):
+        f.flush()
+        os.fsync(f.fileno())
+
+    def _enter_read_only(self, err: BaseException):
+        """Flip to typed read-only mode (idempotent: the FIRST failure
+        names the cause)."""
+        if self.read_only is None:
+            self.read_only = f"{type(err).__name__}: {err}"
+
+    def try_recover(self) -> bool:
+        """Attempt to leave read-only mode: a successful snapshot
+        rewrite (which also truncates the possibly-torn WAL tail)
+        proves the volume can hold the data again. Safe to call at any
+        time; returns True when writes are healthy."""
+        if self.read_only is None:
+            return True
+        try:
+            # reopen the WAL first: the handle may be positioned after
+            # a torn, unsynced tail write
+            self.wal.close()
+            self.wal = open(self.wal_path, "ab")
+            self.compact()
+        except (StorageFullError, OSError):
+            return False
+        self.read_only = None
+        return True
+
     def compact(self):
         with self.lock:
             tmp = self.snap_path + ".tmp"
-            with open(tmp, "wb") as f:
-                pickle.dump(dict(self.vs.latest_items()), f, protocol=5)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.snap_path)
-            self.wal.close()
-            open(self.wal_path, "wb").close()
-            self.wal = open(self.wal_path, "ab")
-            self._wal_batches = 0
+            try:
+                with open(tmp, "wb") as f:
+                    pickle.dump(dict(self.vs.latest_items()), f,
+                                protocol=5)
+                    self._sync_snapshot(f)
+                os.replace(tmp, self.snap_path)
+                self.wal.close()
+                open(self.wal_path, "wb").close()
+                self.wal = open(self.wal_path, "ab")
+                self._wal_batches = 0
+            except OSError as e:
+                # a failed rewrite leaves the OLD snapshot + WAL intact
+                # (tmp + rename): nothing durable was lost — enter
+                # read-only and surface the typed error
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                self._enter_read_only(e)
+                raise StorageFullError(
+                    f"snapshot compaction failed ({e}); the node is "
+                    f"read-only until space is freed (try_recover)"
+                ) from e
 
     def close(self):
-        self.compact()
+        try:
+            if self.read_only is None:
+                self.compact()
+        except StorageFullError:
+            pass  # already durable in the WAL; close what we hold
         self.wal.close()
 
 
 class FileTx(MemTx):
     def commit(self):
         self._check()
-        self.done = True
         store: FileBackend = self.store
+        if store.read_only is not None and self.writes:
+            # typed read-only mode: fail the write BEFORE it becomes
+            # visible; reads (and the replication log, which serves
+            # from durable state) keep working
+            self.done = True
+            self._release()
+            raise StorageFullError(
+                f"storage is read-only ({store.read_only}); writes "
+                f"fail until space is freed and recovery succeeds"
+            )
+        self.done = True
 
         def wal_append():
-            pickle.dump(self.writes, store.wal, protocol=5)
-            store.wal.flush()
-            os.fsync(store.wal.fileno())
+            pos = store.wal.tell()
+            try:
+                pickle.dump(self.writes, store.wal, protocol=5)
+                store._sync_wal()
+            except OSError as e:
+                # the batch was REFUSED: truncate back so a crash
+                # before recovery cannot replay bytes that may have
+                # reached the disk ahead of the failed fsync
+                ambiguous = False
+                try:
+                    store.wal.truncate(pos)
+                    store.wal.seek(pos)
+                except OSError:
+                    # the refused record may survive COMPLETE in the
+                    # WAL: if the node crashes before try_recover()'s
+                    # compaction truncates it, replay will apply it —
+                    # the same OUTCOME UNKNOWN contract an in-flight
+                    # remote commit has (err.RetryableKvError). Say so.
+                    ambiguous = True
+                store._enter_read_only(e)
+                raise StorageFullError(
+                    f"WAL append failed ({e}); the node is read-only "
+                    f"until space is freed (try_recover)"
+                    + (". OUTCOME UNKNOWN after a crash: the refused "
+                       "batch could not be truncated from the WAL and "
+                       "may be replayed — recover before restarting"
+                       if ambiguous else "")
+                ) from e
             store._wal_batches += 1
 
         snap, self.snap = self.snap, None
         if self.writes:
             self.vs.commit(self.writes, snap, pre_apply=wal_append)
             if store._wal_batches >= WAL_COMPACT_BATCHES:
-                store.compact()
+                try:
+                    store.compact()
+                except StorageFullError:
+                    # THIS commit is already durable in the WAL; the
+                    # failed compaction only flipped read-only mode for
+                    # future writes
+                    pass
         else:
             self.vs.release(snap)

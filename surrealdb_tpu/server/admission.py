@@ -84,6 +84,19 @@ class AdmissionController:
         from surrealdb_tpu.telemetry import stage_record
 
         t0 = time.perf_counter_ns()
+        # node-wide memory governance (resource.py): over the HARD
+        # watermark — after an eviction pass failed to bring accounted
+        # bytes back under it — new work sheds with the same typed 503
+        # as a full queue. The check runs outside self.cond: admit_ok
+        # may run eviction callbacks that take holder locks, and
+        # nothing here touches admission state.
+        from surrealdb_tpu import resource
+
+        if not resource.get_accountant().admit_ok():
+            if self.telemetry is not None:
+                self.telemetry.inc("queries_shed_memory")
+            self._shed("memory pressure: accounted bytes over the "
+                       "hard watermark", 1.0)
         with self.cond:
             if self.draining:
                 self._shed("draining", 1.0)

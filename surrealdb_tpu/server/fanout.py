@@ -431,6 +431,53 @@ class FanoutHub:
             self.telemetry.register_counter(
                 "notify_handler_errors", lambda: self._handler_errors
             )
+        # resource governance: the dispatch backlog + session outboxes
+        # are tracked push-path state. Their "eviction" is the typed
+        # slow-consumer overflow policy (never silent), which is why
+        # the `push` kind sits LAST in the eviction priority order —
+        # every rebuildable cache goes first.
+        from surrealdb_tpu import resource as _resource
+
+        self._mem_acct = _resource.register(
+            "push", "live-fanout", self._mem_bytes,
+            evict=self._mem_evict, owner=self,
+        )
+
+    # -- resource accounting ------------------------------------------------
+
+    # estimated bytes per queued notification/event: payload dicts are
+    # user-shaped, so this is an accounting constant, not a measurement
+    NOTE_EST_BYTES = 512
+    # estimated events per undispatched table-group (capture batches
+    # are one transaction's writes; deep groups are rare)
+    GROUP_EST_EVENTS = 8
+
+    def _mem_bytes(self) -> int:
+        # LOCK-FREE estimate: this runs inside every accountant
+        # usage() poll — admission, sync checkpoints, /metrics — and
+        # must never contend the dispatch lock or walk backlog event
+        # lists. len(deque) and the int read are GIL-atomic; the list
+        # snapshot tolerates racing (un)registration.
+        queued = 0
+        for s in tuple(self._sessions):
+            queued += len(s.q)
+        backlog_groups = max(self._outstanding, 0)
+        return (queued + backlog_groups * self.GROUP_EST_EVENTS) \
+            * self.NOTE_EST_BYTES
+
+    def _mem_evict(self):
+        """Accountant pressure: apply the overflow policy to the
+        sessions holding the deepest queues (typed OVERFLOW per bound
+        live id / disconnect — the client always learns it lost a
+        window). The dispatch backlog keeps its own cap."""
+        with self._qlock:
+            sessions = sorted(
+                (s for s in self._sessions if not s.closed),
+                key=lambda s: -s.queue_len(),
+            )
+        for ob in sessions[:max(1, len(sessions) // 2)]:
+            if ob.queue_len() > 0:
+                ob.force_overflow()
 
     # -- publish (called post-commit by the executor) -----------------------
     def publish(self, events: list):
@@ -863,6 +910,7 @@ class FanoutHub:
         if self._sweep_handle is not None:
             self._sweep_handle.cancel()
             self._sweep_handle = None
+        self._mem_acct.close()
 
     def stats(self) -> dict:
         with self._qlock:

@@ -952,6 +952,337 @@ def run_knn_sim(seed: int,
     return res
 
 
+class MemSimConfig:
+    """Seeded memory-pressure scenario over the REAL engine: writers,
+    KNN clients, explicit ANN builds, and live fan-out race on one
+    node while the driver clamps the memory budget mid-run."""
+
+    def __init__(self, writers=2, knn_clients=2, write_ops=12,
+                 knn_ops=8, dim=8, k=4, seed_rows=32, sessions=1,
+                 clamp_after_s=8.0, grace_s=3.0):
+        self.writers = writers
+        self.knn_clients = knn_clients
+        self.write_ops = write_ops
+        self.knn_ops = knn_ops
+        self.dim = dim
+        self.k = k
+        self.seed_rows = seed_rows
+        self.sessions = sessions
+        self.clamp_after_s = clamp_after_s  # virtual s before the clamp
+        self.grace_s = grace_s  # checkpoint window the invariant allows
+
+
+def run_mem_sim(seed: int, cfg: Optional[MemSimConfig] = None,
+                mutate=None) -> SimResult:
+    """Deterministic resource-governance simulation: a real Datastore
+    (pymem backend, manual fan-out hub) under the seeded kernel runs
+    writers, KNN clients, explicit CAGRA builds, and a live session
+    while the driver clamps the node budget mid-run to a value that
+    forces eviction (sized off the live vector account, so the ANN
+    graph + rank stats must go while the host rows still fit). The
+    invariants then hold the run to: accounted bytes never exceed the
+    hard watermark at any post-grace sample, eviction counters moved
+    (mechanism engaged, not headroom), every KNN answer is the exact
+    brute oracle over acked rows (check_knn_delivery — eviction may
+    cost a rebuild, never a silently wrong answer), and a final
+    evict-EVERYTHING clamp followed by a query proves evicted state is
+    rebuilt exactly from KV truth. `mutate(acct)` runs before the
+    workload — the mutation test disables eviction there and asserts
+    the invariant bites."""
+    from surrealdb_tpu import cnf, resource
+    from surrealdb_tpu.kvs.ds import Datastore
+
+    cfg = cfg or MemSimConfig()
+    res = SimResult()
+    res.seed = seed
+    kernel = Kernel(seed)
+    acct = resource.MemoryAccountant(budget_bytes=256 << 20)
+    old_acct = resource.set_accountant(acct)
+    saved_ann_mode = cnf.KNN_ANN_MODE
+    # auto/force ANN would spawn real daemon build threads from sync();
+    # the sim drives builds EXPLICITLY from a kernel task instead, so
+    # the seeded scheduler owns every interleaving
+    cnf.KNN_ANN_MODE = "off"
+    if mutate is not None:
+        mutate(acct)
+    ds = Datastore("pymem")
+    hub = ds.fanout
+    hub.manual = True
+    rows: dict = {}
+    queries: list = []
+    samples: list = []
+    final_fail: list = []
+    delivered = [0]
+    clamp_t = [None]
+    stop_all = [False]
+
+    def _vec(tag):
+        return _knn_vec(tag, cfg.dim)
+
+    def _sql(ds_, sql, vars=None):
+        try:
+            return ds_.execute(sql, ns="t", db="t", vars=vars or {})[-1]
+        except (RetryableKvError, SdbError, OSError) as e:
+            from surrealdb_tpu.kvs.ds import QueryResult
+
+            return QueryResult(error=str(e))
+
+    def _engine():
+        engs = list(ds.vector_indexes.values())
+        return engs[0] if engs else None
+
+    def _writer(w):
+        rng = kernel.rng
+        own: list = []
+        for j in range(cfg.write_ops):
+            rid = 1000 + j * 16 + w
+            if rng.random() < 0.8 or not own:
+                vec = _vec(rid)
+                rec = {"vec": vec, "t0": kernel.now, "t1": None,
+                       "status": "none"}
+                rows[rid] = rec
+                r = _sql(ds, f"CREATE v:{rid} SET emb = $v", {"v": vec})
+                rec["t1"] = kernel.now
+                rec["status"] = "acked" if r.error is None else "none"
+                if rec["status"] == "acked":
+                    own.append(rid)
+                kernel.log("mem_write", id=rid, status=rec["status"])
+            else:
+                did = own.pop(rng.randrange(len(own)))
+                rec = rows[did]
+                rec["del_t0"] = kernel.now
+                r = _sql(ds, f"DELETE v:{did}")
+                rec["del_t1"] = kernel.now
+                rec["del_status"] = ("acked" if r.error is None
+                                     else "none")
+                kernel.log("mem_delete", id=did)
+            kernel.sleep(0.3 + rng.random() * 0.8)
+
+    def _knn_client(ci):
+        rng = kernel.rng
+        for j in range(cfg.knn_ops):
+            q = _vec(5_000_000 + ci * 1000 + j)
+            t0 = kernel.now
+            r = _sql(
+                ds,
+                f"SELECT id, vector::distance::knn() AS d FROM v "
+                f"WHERE emb <|{cfg.k}|> $q",
+                {"q": q},
+            )
+            rec = {"label": f"q{ci}.{j}", "q": q, "k": cfg.k,
+                   "t0": t0, "t1": kernel.now, "result": [],
+                   "partial": None, "error": None}
+            if r.error is not None:
+                rec["error"] = r.error[:160]
+            else:
+                rec["result"] = [(int(row["id"].id), float(row["d"]))
+                                 for row in (r.result or [])]
+            queries.append(rec)
+            kernel.log("mem_knn", client=ci, j=j,
+                       n=len(rec["result"]), err=bool(rec["error"]))
+            kernel.sleep(0.4 + rng.random() * 1.2)
+
+    def _builder():
+        # explicit CAGRA builds racing the clamp: allocation-heavy
+        # work whose product (the ann account) is priority-evicted
+        rng = kernel.rng
+        for _ in range(6):
+            if stop_all[0]:
+                return
+            eng = _engine()
+            if eng is not None and len(eng.rids) >= 8:
+                go = False
+                with eng._ann_lock:
+                    if eng._ann_state != "building":
+                        eng._ann_state = "building"
+                        go = True
+                if go:
+                    eng._build_ann()
+                    kernel.log("mem_ann_build",
+                               n=len(eng.rids),
+                               state=eng._ann_state)
+            kernel.sleep(1.5 + rng.random() * 1.5)
+
+    def _dispatcher():
+        rng = kernel.rng
+        while not stop_all[0]:
+            hub.pump_dispatch(1 + rng.randrange(3))
+            kernel.sleep(0.05 + rng.random() * 0.2)
+
+    def _session(si):
+        rng = kernel.rng
+
+        def recv(notes):
+            delivered[0] += len(notes)
+
+        ob = hub.register_session(recv, label=f"m{si}", depth=8)
+        out = ds.execute("LIVE SELECT * FROM v", ns="t", db="t")
+        lid = str(out[-1].result.u)
+        hub.bind(lid, ob)
+        while not stop_all[0]:
+            ob.pump()
+            kernel.sleep(0.1 + rng.random() * 0.3)
+        while ob.pump():
+            pass
+        hub.unregister_session(ob)
+        ds.gc_session_lives([lid])
+
+    def _sampler():
+        while not stop_all[0]:
+            samples.append({
+                "t": kernel.now,
+                "usage": acct.usage(),
+                "hard": acct.hard_bytes,
+                "evictions": acct.counters["mem_evictions"],
+            })
+            kernel.sleep(0.5)
+
+    def _driver():
+        kernel.sleep(cfg.clamp_after_s)
+        eng = _engine()
+        vec_b = eng._vec_mem_bytes() if eng is not None else 4096
+        # clamp sized so the host rows still fit under the soft
+        # watermark while rows+graph+stats do NOT: eviction must fire
+        # and must pick the cheap accounts first
+        clamp = int(vec_b * 2 + 2048)
+        acct.set_budget(clamp)
+        clamp_t[0] = kernel.now
+        kernel.log("mem_clamp", budget=clamp)
+        acct.maybe_evict()
+
+    def _final_check():
+        # evict EVERYTHING (budget 1 byte), then prove the node
+        # rebuilds exactly from KV truth: a fresh query must equal the
+        # brute oracle over the final committed rows
+        acct.set_budget(1)
+        acct.maybe_evict()
+        eng = _engine()
+        if eng is not None and len(eng.vecs):
+            final_fail.append(
+                f"FULL EVICTION LEFT {len(eng.vecs)} host rows resident"
+            )
+        acct.set_budget(256 << 20)
+        scan = _sql(ds, "SELECT id, emb FROM v")
+        q = _vec(9_000_000)
+        knn = _sql(
+            ds,
+            f"SELECT id, vector::distance::knn() AS d FROM v "
+            f"WHERE emb <|{cfg.k}|> $q",
+            {"q": q},
+        )
+        if scan.error is not None or knn.error is not None:
+            final_fail.append(
+                f"FINAL QUERY FAILED after full eviction: "
+                f"{scan.error or knn.error}"
+            )
+            return
+        want = sorted(
+            ((inv._knn_dist(row["emb"], q), int(row["id"].id))
+             for row in scan.result),
+        )[:cfg.k]
+        got = [(float(row["d"]), int(row["id"].id))
+               for row in knn.result]
+        if [w[1] for w in want] != [g[1] for g in got] or any(
+            abs(w[0] - g[0]) > 1e-9 for w, g in zip(want, got)
+        ):
+            final_fail.append(
+                f"POST-EVICTION KNN != BRUTE ORACLE: got {got!r}, "
+                f"want {want!r} (evicted state not rebuilt exactly)"
+            )
+
+    def main():
+        r = _sql(ds, f"DEFINE TABLE v; DEFINE INDEX ix ON v FIELDS "
+                     f"emb HNSW DIMENSION {cfg.dim} DIST EUCLIDEAN "
+                     f"TYPE F32")
+        if r.error is not None:
+            res.errors.append(f"DDL failed: {r.error}")
+            kernel.shutdown()
+            return
+        for j in range(cfg.seed_rows):
+            rid = j
+            vec = _vec(rid)
+            rows[rid] = {"vec": vec, "t0": kernel.now, "t1": None,
+                         "status": "none"}
+            rr = _sql(ds, f"CREATE v:{rid} SET emb = $v", {"v": vec})
+            rows[rid]["t1"] = kernel.now
+            rows[rid]["status"] = "acked" if rr.error is None \
+                else "none"
+        # warm the engine (created on first KNN) before the chaos
+        _sql(ds, f"SELECT id FROM v WHERE emb <|1|> $q",
+             {"q": _vec(42)})
+        tasks = (
+            [kernel.spawn(f"w{w}", (lambda w=w: _writer(w)))
+             for w in range(cfg.writers)]
+            + [kernel.spawn(f"q{c}", (lambda c=c: _knn_client(c)))
+               for c in range(cfg.knn_clients)]
+            + [kernel.spawn("ann", _builder)]
+        )
+        for si in range(cfg.sessions):
+            kernel.spawn(f"s{si}", (lambda si=si: _session(si)),
+                         daemon=True)
+        kernel.spawn("dispatch", _dispatcher, daemon=True)
+        kernel.spawn("sampler", _sampler, daemon=True)
+        kernel.spawn("driver", _driver, daemon=True)
+        kernel.join(tasks)
+        # let the clamp land even on runs where the workload outpaced
+        # the driver, and give the sampler post-clamp windows
+        while clamp_t[0] is None:
+            kernel.sleep(0.5)
+        kernel.sleep(cfg.grace_s + 2.0)
+        stop_all[0] = True
+        while hub.pump_dispatch(64):
+            pass
+        _final_check()
+        kernel.shutdown()
+
+    try:
+        with kvnet.use_clock(SimClock(kernel)):
+            kernel.run(main)
+    finally:
+        cnf.KNN_ANN_MODE = saved_ann_mode
+        resource.set_accountant(old_acct)
+        try:
+            ds.close()
+        except (SdbError, OSError):
+            pass
+
+    with kvnet.use_clock(kvnet.REAL_CLOCK):
+        res.violations += inv.check_knn_delivery(queries, rows)
+        if clamp_t[0] is not None:
+            res.violations += inv.check_mem_governance(
+                samples, clamp_t[0], cfg.grace_s
+            )
+        else:
+            res.violations.append("MEM SIM BROKEN: clamp never landed")
+        res.violations += final_fail
+    res.errors += list(kernel.errors)
+    res.trace = kernel.trace
+    res.trace_digest = hashlib.sha256(
+        "\n".join(kernel.trace).encode()
+    ).hexdigest()
+    h = hashlib.sha256()
+    for qr in queries:
+        h.update(qr["label"].encode())
+        h.update(repr(qr["result"]).encode())
+        h.update(repr(bool(qr["error"])).encode())
+    for s in samples:
+        h.update(repr((s["usage"], s["hard"], s["evictions"])).encode())
+    res.store_digest = h.hexdigest()
+    res.virtual_s = kernel.now
+    res.stats = {
+        "events": kernel.events,
+        "writes": len(rows),
+        "acked": sum(1 for r in rows.values()
+                     if r["status"] == "acked"),
+        "queries": len(queries),
+        "evictions": acct.counters["mem_evictions"],
+        "evicted_bytes": acct.counters["mem_evicted_bytes"],
+        "delivered": delivered[0],
+        "samples": len(samples),
+    }
+    return res
+
+
 def run_sim(seed: int, cfg: Optional[SimConfig] = None,
             data_root: Optional[str] = None,
             mutate=None) -> SimResult:

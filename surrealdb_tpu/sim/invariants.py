@@ -378,6 +378,41 @@ def check_knn_delivery(queries: list, rows: dict) -> list[str]:
     return out
 
 
+def check_mem_governance(samples: list, clamp_t: float,
+                         grace_s: float = 3.0) -> list[str]:
+    """Resource-governance invariant (resource.py, run_mem_sim): after
+    the budget clamp — past a short grace window for the next
+    checkpoint to land — accounted bytes never exceed the hard
+    watermark at any quiescent sample, and the eviction machinery
+    demonstrably ENGAGED (counters moved), so a green run proves the
+    mechanism, not just headroom. The mutation test (evict_disabled)
+    must make this fail: with eviction off, accounted usage stays
+    above hard and every post-grace sample violates."""
+    out = []
+    post = [s for s in samples if s["t"] >= clamp_t + grace_s]
+    if not post:
+        out.append(
+            f"MEM SIM BROKEN: no samples after clamp at t={clamp_t:.1f}"
+            f"+{grace_s:.1f}s grace — the invariant observed nothing"
+        )
+        return out
+    for s in post:
+        if s["usage"] > s["hard"]:
+            out.append(
+                f"MEM OVER HARD WATERMARK at t={s['t']:.1f}: accounted "
+                f"{s['usage']} bytes > hard {s['hard']} (eviction "
+                f"failed to reclaim)"
+            )
+    pre_ev = samples[0]["evictions"]
+    if post[-1]["evictions"] <= pre_ev:
+        out.append(
+            f"MEM EVICTION NEVER ENGAGED: counters stayed at {pre_ev} "
+            f"across the clamp — the run proved headroom, not the "
+            f"mechanism"
+        )
+    return out
+
+
 def check_staged_leak(engines) -> list[str]:
     """After convergence no 2PC stage survives: every prepared
     transaction reached a decision."""

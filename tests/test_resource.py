@@ -1,0 +1,600 @@
+"""Node-wide resource governance (resource.py and its registrants).
+
+Covers the accountant core (budget resolution, watermarks, priority
+eviction, weakref pruning), the bounded caches (FtResult LRU), typed
+degradation under pressure (admission shed, vector-engine evict →
+exact rebuild, pin protection, fan-out overflow, device-budget
+refusal), the ENOSPC read-only discipline (kvs/file.py + faults.py
+injection), the deterministic pressure simulation (run_mem_sim +
+mutation test), and the real-process pressure soak (tools/mem_churn.py
+in subprocesses under SURREAL_MEM_BUDGET_MB: bounded RSS, zero OOM,
+evictions engaged, answers byte-identical to an unpressured baseline).
+"""
+
+import gc
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from surrealdb_tpu import resource
+from surrealdb_tpu.resource import BudgetedLRU, MemoryAccountant
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def acct():
+    """A fresh accountant installed as the process singleton."""
+    a = MemoryAccountant(budget_bytes=1 << 30)
+    old = resource.set_accountant(a)
+    yield a
+    resource.set_accountant(old)
+
+
+class _Holder:
+    """Minimal evictable state holder for accountant units."""
+
+    def __init__(self, nbytes):
+        self.n = nbytes
+        self.evicted = 0
+
+    def size(self):
+        return self.n
+
+    def evict(self):
+        self.evicted += 1
+        self.n = 0
+
+
+# ---------------------------------------------------------------------------
+# accountant core
+# ---------------------------------------------------------------------------
+
+
+def test_budget_resolution_env(monkeypatch):
+    monkeypatch.setenv("SURREAL_MEM_BUDGET_MB", "64")
+    a = MemoryAccountant()
+    assert a.budget_bytes == 64 << 20
+    assert a.hard_bytes == 64 << 20
+    assert 0 < a.soft_bytes < a.hard_bytes
+    monkeypatch.delenv("SURREAL_MEM_BUDGET_MB")
+    b = MemoryAccountant()  # auto: fraction of the cgroup/host limit
+    assert b.budget_bytes > 1 << 20
+
+
+def test_usage_and_watermarks(acct):
+    h = _Holder(100)
+    acct.register("vec", "t", h.size, evict=h.evict, owner=h)
+    acct.set_budget(1000)
+    assert acct.usage() == 100
+    assert not acct.over_soft()
+    h.n = 900
+    assert acct.over_soft()  # soft = 800
+    assert not acct.over_hard()
+    h.n = 1100
+    assert acct.over_hard()
+    snap = acct.snapshot()
+    assert snap["by_kind"]["vec"] == 1100
+    assert snap["accounted_bytes"] == 1100
+
+
+def test_eviction_priority_order(acct):
+    order = []
+    holders = {}
+    for kind in ("vec", "rank_stats", "ann", "ft"):
+        h = _Holder(1000)
+        ev = h.evict
+
+        def evict(h=h, kind=kind, ev=ev):
+            order.append(kind)
+            ev()
+
+        holders[kind] = h
+        acct.register(kind, kind, h.size, evict=evict, owner=h)
+    acct.set_budget(100)  # everything must go
+    acct.maybe_evict()
+    # cheap rebuilds first, big rebuilds later (resource.EVICT_ORDER)
+    assert order == ["rank_stats", "ft", "ann", "vec"]
+    assert acct.counters["mem_evictions"] == 4
+    assert acct.counters["mem_evicted_bytes"] == 4000
+
+
+def test_eviction_stops_at_soft_watermark(acct):
+    hs = [_Holder(400) for _ in range(4)]
+    for i, h in enumerate(hs):
+        acct.register("ft", f"h{i}", h.size, evict=h.evict, owner=h)
+    acct.set_budget(1500)  # soft = 1200, usage 1600
+    acct.maybe_evict()
+    # one eviction (400 freed -> 1200 == soft) is enough
+    assert sum(h.evicted for h in hs) == 1
+    assert acct.usage() <= acct.soft_bytes
+
+
+def test_eviction_terminates_without_progress(acct):
+    h = _Holder(5000)
+    h.evict = lambda: None  # frees nothing
+    acct.register("vec", "stuck", h.size, evict=h.evict, owner=h)
+    acct.set_budget(100)
+    acct.maybe_evict()  # must return, not spin
+    assert acct.over_hard()
+
+
+def test_dead_owner_pruned(acct):
+    h = _Holder(700)
+    acct.register("ann", "dying", h.size, evict=h.evict, owner=h)
+    assert acct.usage() == 700
+    del h
+    gc.collect()
+    assert acct.usage() == 0
+    assert acct.snapshot()["by_kind"] == {}
+
+
+def test_admit_ok_evicts_then_sheds(acct):
+    good = _Holder(2000)
+    acct.register("ft", "reclaimable", good.size, evict=good.evict,
+                  owner=good)
+    acct.set_budget(1000)
+    # over hard but reclaimable: eviction saves the admission
+    assert acct.admit_ok()
+    assert good.evicted == 1
+    stuck = _Holder(5000)
+    acct.register("vec", "pinned", stuck.size, owner=stuck)  # no evict
+    assert not acct.admit_ok()
+    assert acct.counters["mem_shed"] >= 1
+
+
+def test_admission_controller_sheds_typed(acct):
+    from surrealdb_tpu.err import ShedError
+    from surrealdb_tpu.server.admission import AdmissionController
+    from surrealdb_tpu.telemetry import Telemetry
+
+    tel = Telemetry()
+    ctrl = AdmissionController(4, 4, telemetry=tel)
+    t = ctrl.admit()
+    t.release()  # healthy node admits
+    stuck = _Holder(500)
+    acct.register("vec", "unreclaimable", stuck.size, owner=stuck)
+    acct.set_budget(100)
+    with pytest.raises(ShedError) as ei:
+        ctrl.admit()
+    assert "memory pressure" in str(ei.value)
+    assert tel.get("queries_shed_memory") == 1
+    # pressure released -> admissions flow again
+    acct.set_budget(1 << 30)
+    ctrl.admit().release()
+
+
+def test_throttle_counts_and_evicts(acct):
+    h = _Holder(4000)
+    acct.register("ann", "build", h.size, evict=h.evict, owner=h)
+    acct.set_budget(1000)
+    acct.throttle("test")
+    assert h.evicted == 1
+    assert acct.counters["mem_throttles"] == 1
+    acct.throttle("test")  # under hard now: no-op
+    assert acct.counters["mem_throttles"] == 1
+
+
+# ---------------------------------------------------------------------------
+# BudgetedLRU + the FtResult cache satellite
+# ---------------------------------------------------------------------------
+
+
+def test_budgeted_lru_entry_cap():
+    c = BudgetedLRU(max_entries=3, max_bytes=1 << 20)
+    for i in range(5):
+        c.put(i, f"v{i}", cost=10)
+    assert len(c) == 3
+    assert c.evictions == 2
+    assert c.get(0) is None and c.get(4) == "v4"
+
+
+def test_budgeted_lru_byte_cap_and_recency():
+    c = BudgetedLRU(max_entries=100, max_bytes=100)
+    c.put("a", 1, cost=40)
+    c.put("b", 2, cost=40)
+    assert c.get("a") == 1  # touch: b becomes the LRU entry
+    c.put("c", 3, cost=40)
+    assert c.get("b") is None and c.get("a") == 1 and c.get("c") == 3
+    assert c.nbytes <= 100
+    freed = c.shrink(0.5)
+    assert freed > 0 and len(c) == 1
+
+
+def test_ft_cache_bounded_on_hot_mixed_table(ds):
+    ds.query(
+        "DEFINE ANALYZER simple TOKENIZERS blank FILTERS lowercase;"
+        "DEFINE INDEX ft ON doc FIELDS body FULLTEXT ANALYZER simple "
+        "BM25;"
+    )
+    cap = ds._ft_cache.max_entries
+    ds._ft_cache.max_entries = 8  # tiny cap: eviction must engage
+    try:
+        for i in range(40):
+            ds.query(f"CREATE doc:{i} SET body = 'word{i} common'")
+            out = ds.query_one(
+                f"SELECT id FROM doc WHERE body @@ 'word{i}'"
+            )
+            assert out  # correctness never degrades
+        assert len(ds._ft_cache) <= 8
+        assert ds._ft_cache.evictions > 0
+        assert ds.telemetry.get("ft_cache_evictions") > 0
+    finally:
+        ds._ft_cache.max_entries = cap
+
+
+# ---------------------------------------------------------------------------
+# vector engine: evict -> exact rebuild, pin protection
+# ---------------------------------------------------------------------------
+
+
+def _seed_vectors(ds, n=32, dim=8):
+    ds.query("DEFINE TABLE v; DEFINE INDEX ix ON v FIELDS emb HNSW "
+             f"DIMENSION {dim} DIST EUCLIDEAN TYPE F32")
+    rng = np.random.default_rng(5)
+    for i in range(n):
+        ds.query("CREATE v:" + str(i) + " SET emb = $v", vars={
+            "v": [round(float(x), 6) for x in rng.standard_normal(dim)]
+        })
+    return ("SELECT id, vector::distance::knn() AS d FROM v "
+            "WHERE emb <|5|> $q",
+            {"q": [0.1] * dim})
+
+
+def test_vector_engine_evict_rebuilds_exactly(ds, acct):
+    sql, vars_ = _seed_vectors(ds)
+    baseline = ds.query_one(sql, vars=vars_)
+    eng = list(ds.vector_indexes.values())[0]
+    assert len(eng.vecs) > 0
+    before = acct.counters["mem_evictions"]
+    acct.set_budget(1)  # everything must go (no query in flight)
+    acct.maybe_evict()
+    assert acct.counters["mem_evictions"] > before
+    assert len(eng.vecs) == 0 and eng.version == -1
+    acct.set_budget(1 << 30)
+    again = ds.query_one(sql, vars=vars_)  # rebuild-on-touch from KV
+    assert again == baseline
+    assert len(eng.vecs) > 0
+
+
+def test_pinned_engine_not_evictable(ds, acct):
+    _seed_vectors(ds)
+    ds.query_one("SELECT id FROM v WHERE emb <|1|> $q",
+                 vars={"q": [0.0] * 8})
+    eng = list(ds.vector_indexes.values())[0]
+    with eng.lock:
+        eng._pins += 1
+    try:
+        acct.set_budget(1)
+        acct.maybe_evict()
+        assert len(eng.vecs) > 0  # pinned: host rows stayed resident
+    finally:
+        with eng.lock:
+            eng._pins -= 1
+        acct.set_budget(1 << 30)
+
+
+# ---------------------------------------------------------------------------
+# fan-out push pressure: typed overflow, never silent
+# ---------------------------------------------------------------------------
+
+
+def test_fanout_push_eviction_applies_overflow_policy(ds):
+    from surrealdb_tpu.kvs.ds import Notification
+
+    hub = ds.fanout
+    notes = []
+    ob = hub.register_session(lambda b: notes.extend(b),
+                              label="t", depth=64)
+    hub.bind("lid-1", ob)
+    for i in range(10):
+        ob.enqueue(Notification("lid-1", "CREATE", None, {"i": i}))
+    assert hub._mem_bytes() >= 10 * hub.NOTE_EST_BYTES
+    hub._mem_evict()
+    assert ob.overflows == 1 and ob.dropped == 10
+    # the client is TOLD it lost a window: one typed OVERFLOW per lid
+    ob.pump()
+    assert any(n.action == "OVERFLOW" for n in notes)
+
+
+# ---------------------------------------------------------------------------
+# ENOSPC: typed read-only mode (kvs/file.py + faults injection)
+# ---------------------------------------------------------------------------
+
+
+def test_enospc_wal_enters_typed_read_only():
+    from surrealdb_tpu.err import StorageFullError
+    from surrealdb_tpu.kvs.faults import inject_enospc
+    from surrealdb_tpu.kvs.file import FileBackend
+
+    d = tempfile.mkdtemp()
+    b = FileBackend(d)
+    tx = b.transaction(True)
+    tx.set(b"a", b"1")
+    tx.commit()
+    heal = inject_enospc(b)
+    tx = b.transaction(True)
+    tx.set(b"c", b"3")
+    with pytest.raises(StorageFullError):
+        tx.commit()
+    assert b.read_only is not None
+    # reads keep serving; the refused write is invisible
+    tx = b.transaction(False)
+    assert tx.get(b"a") == b"1" and tx.get(b"c") is None
+    tx.cancel()
+    # later writes fail fast with the same typed error
+    tx = b.transaction(True)
+    tx.set(b"d", b"4")
+    with pytest.raises(StorageFullError):
+        tx.commit()
+    # space freed -> recovery -> writes flow again
+    heal()
+    assert b.try_recover()
+    tx = b.transaction(True)
+    tx.set(b"e", b"5")
+    tx.commit()
+    b.close()
+    # reopen: durable state holds exactly the acked writes
+    b2 = FileBackend(d)
+    tx = b2.transaction(False)
+    assert tx.get(b"a") == b"1"
+    assert tx.get(b"c") is None and tx.get(b"d") is None
+    assert tx.get(b"e") == b"5"
+    tx.cancel()
+    b2.close()
+
+
+def test_enospc_snapshot_compaction_read_only():
+    from surrealdb_tpu.err import StorageFullError
+    from surrealdb_tpu.kvs.faults import inject_enospc
+    from surrealdb_tpu.kvs.file import FileBackend
+
+    d = tempfile.mkdtemp()
+    b = FileBackend(d)
+    tx = b.transaction(True)
+    tx.set(b"a", b"1")
+    tx.commit()
+    heal = inject_enospc(b, after=0, snapshots=True)
+    # isolate the fault to the snapshot path: compact must fail typed
+    # and leave the old snapshot + WAL intact
+    b._sync_wal = lambda: None
+    with pytest.raises(StorageFullError):
+        b.compact()
+    assert b.read_only is not None
+    tx = b.transaction(False)
+    assert tx.get(b"a") == b"1"
+    tx.cancel()
+    heal()
+    assert b.try_recover()
+    b.close()
+
+
+def test_ann_artifact_save_enospc_is_graceful(tmp_path, capsys):
+    # the persisted-CAGRA save path must warn and carry on (the build
+    # still serves from memory), never crash the build thread
+    from surrealdb_tpu.idx.vector import TpuVectorIndex
+
+    eng = TpuVectorIndex("n", "d", "t", "i",
+                         {"dimension": 4, "distance": "euclidean",
+                          "vector_type": "f32"})
+    blocker = tmp_path / "block"
+    blocker.write_text("not a directory")
+    eng.snapshot_dir = str(blocker / "sub")  # mkdir will fail
+
+    class _FakeAnn:
+        built_n = 0
+
+    eng._save_ann_snapshot(_FakeAnn(), np.zeros((0, 4), np.float32), [])
+    assert "ann snapshot save failed" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# device-runner byte budget: typed refusal, LRU re-ship, host degrade
+# ---------------------------------------------------------------------------
+
+
+_DEV_CFG = {"hbm_budget": 1 << 40, "score_budget": 1 << 29,
+            "query_chunk": 512, "int8_oversample": 8,
+            "block_rows": 1 << 20}
+
+
+def _vec_loader(n, dim, key):
+    rng = np.random.default_rng(3)
+    vecs = np.ascontiguousarray(
+        rng.standard_normal((n, dim)).astype(np.float32)
+    )
+    valid = np.ones(n, np.uint8)
+
+    def loader():
+        return "vec_load", {
+            "metric": "euclidean", "mink_p": 3.0, "cfg": _DEV_CFG,
+        }, [vecs, valid]
+
+    return loader, vecs
+
+
+def _vec_est_mb(n, dim=8):
+    """The runner's own per-store estimate (device-count dependent —
+    the test suite pins an 8-device virtual mesh, real boxes differ),
+    so budgets derive from the SAME arithmetic the admission uses."""
+    from surrealdb_tpu.device.vecstore import VecStore
+
+    return VecStore.estimate_device_bytes(
+        n, dim, 4, "euclidean", _DEV_CFG
+    ) / (1 << 20)
+
+
+def test_device_budget_refusal_degrades_store(monkeypatch):
+    from surrealdb_tpu.device import DeviceOutOfMemory
+    from surrealdb_tpu.device.supervisor import DeviceSupervisor
+
+    # budget: fits the 40k store comfortably, refuses the 5x store
+    budget = max(1, int(_vec_est_mb(40000) * 1.5 + 1))
+    monkeypatch.setenv("SURREAL_DEVICE_MEM_BUDGET_MB", str(budget))
+    sup = DeviceSupervisor(mode="inline")
+    try:
+        big_loader, _ = _vec_loader(200000, 8, "vec/big")
+        with pytest.raises(DeviceOutOfMemory):
+            sup.ensure_loaded("vec/big", [1, 0], big_loader)
+        assert sup.counters["device_oom_refusals"] == 1
+        # cached refusal: the next attempt fails fast (no re-ship)
+        calls = []
+
+        def noisy_loader():
+            calls.append(1)
+            return big_loader()
+
+        with pytest.raises(DeviceOutOfMemory):
+            sup.ensure_loaded("vec/big", [1, 0], noisy_loader)
+        assert calls == []
+        # the runner stays healthy for stores that fit
+        small_loader, small = _vec_loader(256, 8, "vec/small")
+        sup.ensure_loaded("vec/small", [1, 0], small_loader)
+        t, _m, bufs = sup.call("vec_knn", {
+            "key": "vec/small", "tag": [1, 0], "k": 3
+        }, [np.zeros((1, 8), np.float32)])
+        assert t == "ok"
+        # a CHANGED tag (rebuilt, smaller store) earns a fresh attempt
+        tiny_loader, _ = _vec_loader(128, 8, "vec/big")
+        sup.ensure_loaded("vec/big", [2, 0], tiny_loader)
+    finally:
+        sup.shutdown()
+
+
+def test_device_budget_lru_eviction_reships(monkeypatch):
+    from surrealdb_tpu.device.handlers import DeviceHost
+
+    # budget: one store fits, two do not — the second ship must evict
+    budget = max(1, int(_vec_est_mb(40000) * 1.5 + 1))
+    monkeypatch.setenv("SURREAL_DEVICE_MEM_BUDGET_MB", str(budget))
+    host = DeviceHost()
+    _l1, v1 = _vec_loader(40000, 8, "a")
+    _l2, v2 = _vec_loader(40000, 8, "b")
+    cfg = _DEV_CFG
+    meta = {"key": "a", "tag": [1], "metric": "euclidean", "cfg": cfg}
+    host.op_vec_load(dict(meta), [v1, np.ones(40000, np.uint8)])
+    meta["key"] = "b"
+    host.op_vec_load(dict(meta), [v2, np.ones(40000, np.uint8)])
+    assert host.budget_evictions >= 1  # store "a" was LRU-evicted
+    t, _m, _b = host.op_vec_knn(
+        {"key": "a", "tag": [1], "k": 3},
+        [np.zeros((1, 8), np.float32)],
+    )
+    assert t == "stale"  # eviction = re-ship on next use, no error
+    t, _m, _b = host.op_vec_knn(
+        {"key": "b", "tag": [1], "k": 3},
+        [np.zeros((1, 8), np.float32)],
+    )
+    assert t == "ok"
+
+
+# ---------------------------------------------------------------------------
+# deterministic pressure simulation
+# ---------------------------------------------------------------------------
+
+MEM_SIM_CORPUS = (0, 3, 7)
+
+
+@pytest.mark.parametrize("seed", MEM_SIM_CORPUS)
+def test_mem_sim_seed_corpus(seed):
+    from surrealdb_tpu.sim.harness import run_mem_sim
+
+    r = run_mem_sim(seed)
+    assert r.ok, (f"seed {seed}: violations={r.violations[:4]} "
+                  f"errors={r.errors[:2]}")
+    assert r.stats["evictions"] > 0  # the mechanism, not headroom
+    assert r.stats["queries"] > 0
+
+
+def test_mem_sim_bit_reproducible():
+    from surrealdb_tpu.sim.harness import run_mem_sim
+
+    a, b = run_mem_sim(11), run_mem_sim(11)
+    assert a.trace_digest == b.trace_digest
+    assert a.store_digest == b.store_digest
+
+
+def test_mem_sim_mutation_disabled_eviction_caught():
+    from surrealdb_tpu.sim.harness import run_mem_sim
+
+    r = run_mem_sim(11, mutate=lambda a:
+                    setattr(a, "evict_disabled", True))
+    assert not r.ok
+    assert any("OVER HARD WATERMARK" in v or "NEVER ENGAGED" in v
+               for v in r.violations)
+
+
+@pytest.mark.slow
+def test_mem_sim_sweep_40seeds():
+    from surrealdb_tpu.sim.harness import run_mem_sim
+
+    bad = []
+    for seed in range(40):
+        r = run_mem_sim(seed)
+        if not r.ok:
+            bad.append((seed, r.violations[:2], r.errors[:1]))
+    assert not bad, f"failing seeds: {bad}"
+
+
+# ---------------------------------------------------------------------------
+# real-process pressure soak (tools/mem_churn.py)
+# ---------------------------------------------------------------------------
+
+
+def _churn(budget_mb, rows, ops, timeout=600):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SURREAL_DEVICE": "off",
+        # builds run (and get evicted) but serving stays on the exact
+        # path, so answers are deterministic by construction
+        "SURREAL_KNN_ANN": "force",
+        "SURREAL_KNN_ANN_MAX_K": "0",
+    })
+    env.pop("SURREAL_MEM_BUDGET_MB", None)
+    if budget_mb:
+        env["SURREAL_MEM_BUDGET_MB"] = str(budget_mb)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mem_churn.py"),
+         "--rows", str(rows), "--ops", str(ops)],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO,
+    )
+    assert p.returncode == 0, f"churn died (OOM?): {p.stderr[-800:]}"
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def test_pressure_soak_bounded_rss_zero_oom_identical_answers():
+    rows, ops = 6000, 220
+    base = _churn(0, rows, ops)
+    assert not base["oom"] and base["accounted_peak_mb"] > 1.0
+    # budget ~half the unconstrained accounted peak: pressure is real
+    budget = max(1, int(base["accounted_peak_mb"] / 2))
+    press = _churn(budget, rows, ops)
+    assert not press["oom"]
+    # the mechanism engaged — this run proved eviction, not headroom
+    assert press["evictions"].get("mem_evictions", 0) > 0
+    # every answer byte-identical to the unpressured baseline
+    assert press["answers_digest"] == base["answers_digest"]
+    # RSS bounded: pressure must not GROW the process footprint
+    # (generous slack absorbs allocator noise between runs)
+    assert press["peak_rss_mb"] <= base["peak_rss_mb"] + 192
+    # accounted usage respected the clamped watermark at sample points
+    assert press["hard_mb"] == budget
+
+
+@pytest.mark.slow
+def test_pressure_soak_large_churn():
+    rows, ops = 12000, 350
+    base = _churn(0, rows, ops, timeout=1800)
+    budget = max(1, int(base["accounted_peak_mb"] / 2))
+    press = _churn(budget, rows, ops, timeout=1800)
+    assert not press["oom"]
+    assert press["evictions"].get("mem_evictions", 0) > 0
+    assert press["answers_digest"] == base["answers_digest"]
+    assert press["peak_rss_mb"] <= base["peak_rss_mb"] + 256

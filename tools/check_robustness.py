@@ -67,6 +67,20 @@ re-litigating:
    node. The functions' existence is asserted, so a rename cannot
    silently retire the rule (same discipline as rules 6-7).
 
+9. **Every in-memory cache is accounted** — under `surrealdb_tpu/idx/`,
+   `surrealdb_tpu/device/`, and `server/fanout.py`, any module-level or
+   `__init__`-assigned dict/list/set/OrderedDict/deque container must
+   either be covered by a memory-accountant registration
+   (`resource.register` — the engine/hub registers size+evict
+   callbacks for the state those containers hold) or sit on the
+   explicit allowlist below with its reason. New unlisted containers
+   are findings: PR 10 exists because nine PRs of unaccounted caches
+   added up to an OOM kill. Rename-proof like rules 6-8: the
+   registration functions themselves (resource.py `register`, the
+   per-holder `_mem_*` size/evict methods, the device host's
+   `_admit`/`mem_used`) are existence-asserted, so refactoring one
+   away without updating the tables is itself a finding.
+
 Usage:  python tools/check_robustness.py [root]
 Exit status 1 when any finding survives.
 """
@@ -128,6 +142,73 @@ _KNN_LOCK_FNS = ("scatter_gather", "merge_topk", "_scatter_round",
 # could block on a remote shard while serializing every other query
 _KNN_LOCK_OK = {"append", "pop", "get", "add", "discard", "span",
                 "items", "values", "keys", "_repartition"}
+
+# rule 9: memory-accounting coverage. Scanned trees + the per-file
+# functions whose existence proves the registration is still wired
+# (resource.py is the accountant; the others are registrants).
+_MEM_SCAN_PREFIXES = ("surrealdb_tpu/idx/", "surrealdb_tpu/device/")
+_MEM_SCAN_FILES = ("surrealdb_tpu/server/fanout.py",)
+_MEM_REGISTRATION_FNS = {
+    "surrealdb_tpu/resource.py": ("register", "maybe_evict",
+                                  "checkpoint", "throttle"),
+    "surrealdb_tpu/idx/vector.py": ("_vec_mem_bytes", "_ann_mem_bytes",
+                                    "_stats_mem_bytes",
+                                    "_mem_evict_vec"),
+    "surrealdb_tpu/server/fanout.py": ("_mem_bytes", "_mem_evict"),
+    "surrealdb_tpu/device/handlers.py": ("_admit", "mem_used"),
+    "surrealdb_tpu/kvs/ds.py": ("_ft_cache_bytes", "_csr_mem_bytes",
+                                "_csr_mem_evict"),
+}
+_CONTAINER_CALLS = {"dict", "list", "set", "OrderedDict", "deque",
+                    "defaultdict"}
+# (file, container name) pairs exempt from rule 9, grouped by WHY.
+# Fail-closed: renaming a container drops it off this list and the
+# checker flags it until someone re-argues its coverage.
+_MEM_ALLOW = {
+    # -- covered by a registered account (a _mem_* / mem_used size fn
+    #    sums the bytes these containers reach; eviction drops them) ----
+    ("surrealdb_tpu/idx/vector.py", "rids"),        # vec account
+    ("surrealdb_tpu/idx/vector.py", "row_index"),   # vec account
+    ("surrealdb_tpu/idx/vector.py", "_ann_dirty"),  # ann account
+    ("surrealdb_tpu/idx/shardvec.py", "parts"),  # part engines each
+    # register their own vec/ann/rank_stats accounts
+    ("surrealdb_tpu/device/handlers.py", "vec"),      # _admit budget
+    ("surrealdb_tpu/device/handlers.py", "csr"),
+    ("surrealdb_tpu/device/handlers.py", "ann"),
+    ("surrealdb_tpu/device/handlers.py", "_staging"),
+    ("surrealdb_tpu/device/handlers.py", "_ann_staging"),
+    ("surrealdb_tpu/device/handlers.py", "_reserved"),  # mem_used sums
+    # it; entries live only between *_load_begin and *_load_end
+    ("surrealdb_tpu/server/fanout.py", "q"),        # push account +
+    ("surrealdb_tpu/server/fanout.py", "_queues"),  # LIVE_QUEUE_DEPTH /
+    # LIVE_DISPATCH_BACKLOG caps with typed overflow shedding
+    # -- bounded by construction (fixed caps / O(config) entries) --------
+    ("surrealdb_tpu/device/annstore.py", "_jit_cache"),  # shape ladder
+    ("surrealdb_tpu/device/csrstore.py", "_jit_cache"),  # shape ladder
+    ("surrealdb_tpu/device/kernelstats.py", "COUNTS"),   # per-op ints
+    ("surrealdb_tpu/device/kernelstats.py", "_SEEN"),    # shape keys
+    ("surrealdb_tpu/device/supervisor.py", "compile_counts"),  # 2 ints
+    ("surrealdb_tpu/device/supervisor.py", "counters"),  # fixed keys
+    ("surrealdb_tpu/device/supervisor.py", "_pending"),  # in-flight
+    # dispatches, bounded by callers + failed wholesale on degrade
+    ("surrealdb_tpu/device/supervisor.py", "_loaded"),   # key -> tag,
+    ("surrealdb_tpu/device/supervisor.py", "_oom_keys"),  # one entry
+    # per live store (the runner caps stores at MAX_*_STORES)
+    ("surrealdb_tpu/device/batcher.py", "queue"),  # deadline-withdrawn
+    # riders; drained every dispatch
+    ("surrealdb_tpu/server/fanout.py", "_warned"),   # one per distinct
+    # warn key (static set of call sites)
+    ("surrealdb_tpu/server/fanout.py", "_subs"),      # registry: one
+    ("surrealdb_tpu/server/fanout.py", "_by_table"),  # entry per live
+    ("surrealdb_tpu/server/fanout.py", "lids"),       # query, GC'd by
+    ("surrealdb_tpu/server/fanout.py", "_routes"),    # KILL/session
+    ("surrealdb_tpu/server/fanout.py", "_sessions"),  # close/sweep
+    ("surrealdb_tpu/server/fanout.py", "_wconds"),    # nworkers conds
+    # -- static configuration, not derived state -------------------------
+    ("surrealdb_tpu/idx/fulltext.py", "_STOP_SUFFIXES"),
+    ("surrealdb_tpu/device/annstore.py", "cfg"),  # dict(cfg) copy
+    ("surrealdb_tpu/device/vecstore.py", "cfg"),
+}
 
 # rule 5: the only places inside the package allowed to import jax —
 # the supervised runner tree and the kernel library it dispatches to
@@ -295,6 +376,93 @@ def _check_knn_fns(tree, rel, lines) -> list[str]:
     return findings
 
 
+def _is_container_value(v) -> bool:
+    if isinstance(v, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                      ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(v, ast.Call):
+        f = v.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None
+        )
+        return name in _CONTAINER_CALLS
+    return False
+
+
+def _check_mem_accounting(tree, rel, lines) -> list[str]:
+    """Rule 9: every module-level / __init__-held mutable container in
+    the scanned trees is either allowlisted (with its coverage reason)
+    or a finding — unaccounted caches are how a node OOMs."""
+    findings = []
+    rel_fwd = rel.replace(os.sep, "/")
+
+    def flag(name, lineno):
+        if name.startswith("__") and name.endswith("__"):
+            return  # module dunders (__all__) are not caches
+        if (rel_fwd, name) in _MEM_ALLOW or _pragma(lines, lineno):
+            return
+        findings.append(
+            f"{rel}:{lineno}: container `{name}` in {rel_fwd} is "
+            f"neither registered with the memory accountant "
+            f"(resource.register size/evict coverage) nor on the "
+            f"rule-9 allowlist — unaccounted derived state is how the "
+            f"node OOMs instead of degrading"
+        )
+
+    for node in ast.iter_child_nodes(tree):
+        # module-level containers
+        if isinstance(node, ast.Assign) and _is_container_value(
+                node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    flag(t.id, node.lineno)
+        elif isinstance(node, ast.AnnAssign) \
+                and node.value is not None \
+                and _is_container_value(node.value) \
+                and isinstance(node.target, ast.Name):
+            flag(node.target.id, node.lineno)
+        # instance containers created in __init__
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for fn in node.body:
+            if not (isinstance(fn, ast.FunctionDef)
+                    and fn.name == "__init__"):
+                continue
+            for sub in ast.walk(fn):
+                tgt = val = None
+                if isinstance(sub, ast.Assign):
+                    val = sub.value
+                    tgt = sub.targets[0] if len(sub.targets) == 1 \
+                        else None
+                elif isinstance(sub, ast.AnnAssign):
+                    val, tgt = sub.value, sub.target
+                if val is None or not _is_container_value(val):
+                    continue
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    flag(tgt.attr, sub.lineno)
+    return findings
+
+
+def _check_mem_registration_fns(tree, rel) -> list[str]:
+    """Rule 9 teeth: the accountant + registrant functions must still
+    exist — a rename/refactor that drops one silently retires the
+    coverage the allowlist assumes."""
+    rel_fwd = rel.replace(os.sep, "/")
+    wanted = _MEM_REGISTRATION_FNS.get(rel_fwd)
+    if not wanted:
+        return []
+    have = {n.name for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef)}
+    return [
+        f"{rel}:1: rule-9 registration function `{name}` not found — "
+        f"memory-accounting coverage is no longer wired (update the "
+        f"rule-9 tables after a rename)"
+        for name in wanted if name not in have
+    ]
+
+
 def check_file(path: str, rel: str) -> list[str]:
     with open(path, encoding="utf-8") as f:
         src = f.read()
@@ -384,6 +552,11 @@ def check_file(path: str, rel: str) -> list[str]:
     # 8. scatter-gather KNN serving contract
     if rel_fwd == _KNN_FILE:
         findings.extend(_check_knn_fns(tree, rel, lines))
+    # 9. memory-accounting coverage
+    if any(rel_fwd.startswith(p) for p in _MEM_SCAN_PREFIXES) \
+            or rel_fwd in _MEM_SCAN_FILES:
+        findings.extend(_check_mem_accounting(tree, rel, lines))
+    findings.extend(_check_mem_registration_fns(tree, rel))
     # 3. streaming operators must stay deadline-checked
     if rel.endswith(os.path.join("exec", "stream.py")):
         for node in ast.iter_child_nodes(tree):

@@ -1,0 +1,170 @@
+"""Memory-pressure churn driver (test_resource soak + bench mem_pressure).
+
+Runs a mixed workload — vector writes/overwrites/deletes, KNN queries,
+full-text searches, background CAGRA builds, and a live subscription —
+against one in-process Datastore, under whatever memory budget
+`SURREAL_MEM_BUDGET_MB` imposes, and prints ONE JSON line:
+
+    {"rows": ..., "ops": ..., "qps": ..., "answers_digest": ...,
+     "peak_rss_mb": ..., "accounted_peak_mb": ..., "hard_mb": ...,
+     "evictions": {...}, "ft_cache_evictions": ..., "oom": false}
+
+The KNN answers are digested (ids + exact distances, in order) so a
+pressured run can be proven BYTE-IDENTICAL to an unpressured baseline:
+eviction may cost rebuilds, never a different answer. Callers keep the
+queries on the exact scoring path (`SURREAL_KNN_ANN_MAX_K=0` routes
+every search brute/BLAS while ANN builds still run and get evicted) so
+the digest is deterministic by construction.
+
+Exit code 0 + the JSON line IS the zero-OOM proof: a kernel OOM kill
+or a worker death never reaches the print.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import resource as _res
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+
+def run_churn(rows: int, dim: int, ops: int, k: int = 8,
+              seed: int = 7) -> dict:
+    import numpy as np
+
+    from surrealdb_tpu import resource
+    from surrealdb_tpu.kvs.ds import Datastore
+
+    rng = np.random.default_rng(seed)
+    acct = resource.get_accountant()
+    ds = Datastore("pymem")
+    ds.query(
+        f"DEFINE TABLE v; "
+        f"DEFINE ANALYZER simple TOKENIZERS blank FILTERS lowercase; "
+        f"DEFINE INDEX ix ON v FIELDS emb HNSW DIMENSION {dim} "
+        f"DIST EUCLIDEAN TYPE F32; "
+        f"DEFINE INDEX ft ON v FIELDS txt FULLTEXT ANALYZER simple "
+        f"BM25;"
+    )
+    words = ["alpha", "beta", "gamma", "delta", "omega", "sigma",
+             "theta", "kappa"]
+
+    def vec(tag: int) -> list:
+        # deterministic, clustered-ish rows (pure function of tag)
+        g = np.random.default_rng(tag * 1000003 + 17)
+        return [round(float(x), 6) for x in g.standard_normal(dim)]
+
+    # bulk ingest (batched INSERT: one executor pass per 500 rows)
+    batch = []
+    for i in range(rows):
+        batch.append({
+            "id": i, "emb": vec(i),
+            "txt": f"{words[i % 8]} {words[(i // 8) % 8]} row{i}",
+        })
+        if len(batch) >= 500 or i == rows - 1:
+            ds.query("INSERT INTO v $batch", vars={"batch": [
+                {"id": b["id"], "emb": b["emb"], "txt": b["txt"]}
+                for b in batch
+            ]})
+            batch = []
+
+    # live subscription: the push path rides along under pressure
+    delivered = [0]
+    hub = ds.fanout
+
+    def recv(notes):
+        delivered[0] += len(notes)
+
+    ob = hub.register_session(recv, label="churn")
+    live = ds.query_one("LIVE SELECT * FROM v")
+    lid = str(getattr(live, "u", live))
+    hub.bind(lid, ob)
+
+    digest = hashlib.sha256()
+    peak_acct = 0
+    t0 = time.perf_counter()
+    queries = 0
+    for j in range(ops):
+        r = rng.random()
+        if r < 0.35:
+            rid = int(rng.integers(0, rows))
+            ds.query(f"UPDATE v:{rid} SET emb = $v, txt = $t", vars={
+                "v": vec(rows + j),
+                "t": f"{words[j % 8]} churn{j}",
+            })
+        elif r < 0.45:
+            rid = rows + 100000 + j
+            ds.query(f"CREATE v:{rid} SET emb = $v, txt = 'fresh row'",
+                     vars={"v": vec(rid)})
+        elif r < 0.5:
+            rid = int(rng.integers(0, rows))
+            ds.query(f"DELETE v:{rid}")
+        elif r < 0.85:
+            q = vec(9_000_000 + j)
+            out = ds.query_one(
+                f"SELECT id, vector::distance::knn() AS d FROM v "
+                f"WHERE emb <|{k}|> $q", vars={"q": q},
+            )
+            for row in out or []:
+                digest.update(str(row["id"]).encode())
+                digest.update(repr(round(row["d"], 9)).encode())
+            queries += 1
+        else:
+            w = words[j % 8]
+            out = ds.query_one(
+                "SELECT id, search::score(0) AS s FROM v "
+                "WHERE txt @0@ $w ORDER BY s DESC LIMIT 5",
+                vars={"w": w},
+            )
+            for row in out or []:
+                digest.update(str(row["id"]).encode())
+            queries += 1
+        if j % 8 == 0:
+            peak_acct = max(peak_acct, acct.usage())
+    elapsed = time.perf_counter() - t0
+    peak_acct = max(peak_acct, acct.usage())
+    ds.fanout.flush()
+    hub.unregister_session(ob)
+    ru = _res.getrusage(_res.RUSAGE_SELF)
+    out = {
+        "rows": rows,
+        "ops": ops,
+        "qps": round(queries / max(elapsed, 1e-9), 1),
+        "answers_digest": digest.hexdigest(),
+        "peak_rss_mb": round(ru.ru_maxrss / 1024.0, 1),
+        "accounted_peak_mb": round(peak_acct / (1 << 20), 3),
+        "hard_mb": round(acct.hard_bytes / (1 << 20), 3),
+        "budget_mb": round(acct.budget_bytes / (1 << 20), 3),
+        "evictions": {
+            kk: vv for kk, vv in sorted(acct.counters.items()) if vv
+        },
+        "ft_cache_evictions": ds._ft_cache.evictions,
+        "live_delivered": delivered[0],
+        "oom": False,
+    }
+    ds.close()
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=8000)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--ops", type=int, default=400)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+    res = run_churn(args.rows, args.dim, args.ops, k=args.k,
+                    seed=args.seed)
+    print(json.dumps(res), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
