@@ -1566,6 +1566,144 @@ def bench_knn_sharded(quick=False, groups=2):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_follower_reads(quick=False):
+    """BENCH family `follower_reads`: closed-timestamp bounded-staleness
+    read serving on replicas (kvs/remote.py) over a REAL 3-member
+    replica group of subprocess KV servers.
+
+    Measures read qps primary-only (the PR-5 baseline: every read on
+    one node) vs follower-enabled (READ AT semantics: replicas prove
+    the bound and serve), the per-node serve distribution, and the
+    correctness gate: every answer for the write-once keyset must be
+    exact — zero stale answers. On a 1-core container the CLIENT
+    process is the GIL-bound side, so the honest number here is the
+    measured fan-out (reads actually leaving the primary) plus the qps
+    delta; the >=1.8x/replica scaling gate needs cores for the three
+    server processes + client threads to run in parallel (same caveat
+    as PR 9's sharded numbers)."""
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from surrealdb_tpu.kvs.remote import (
+        RemoteBackend, RetryPolicy, _status_of,
+    )
+
+    n_keys = 2000
+    n_queries = 3000 if quick else 12000
+    threads = 8
+    gets_per_query = 4
+    tmp = tempfile.mkdtemp(prefix="bench-follower-")
+    ports = [_free_port() for _ in range(3)]
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    procs = []
+    be = None
+    try:
+        for i, port in enumerate(ports):
+            procs.append(_spawn_kv_proc(
+                port, "primary" if i == 0 else "replica", peers,
+                os.path.join(tmp, f"m{i}"),
+                failover_timeout=5.0, lease_ttl=4.0,
+            ))
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            st = _status_of(("127.0.0.1", ports[0]), None)
+            if st and st.get("attached_replicas") == 2:
+                break
+            time.sleep(0.2)
+        be = RemoteBackend(",".join(peers),
+                           policy=RetryPolicy(deadline_s=20.0))
+        expect = {}
+        for base in range(0, n_keys, 256):
+            tx = be.transaction(True)
+            for i in range(base, min(base + 256, n_keys)):
+                k = f"/k/{i:06d}".encode()
+                expect[k] = f"v{i}".encode()
+                tx.set(k, expect[k])
+            tx.commit()
+        keys = sorted(expect)
+        wrong = [0]
+
+        def drive(staleness, count=None):
+            count = n_queries if count is None else count
+
+            def one(q):
+                tx = be.transaction(False, max_staleness=staleness)
+                for j in range(gets_per_query):
+                    k = keys[(q * 7 + j * 131) % n_keys]
+                    if tx.get(k) != expect[k]:
+                        wrong[0] += 1
+                tx.commit()
+
+            with ThreadPoolExecutor(threads) as ex:
+                t0 = time.perf_counter()
+                list(ex.map(one, range(count)))
+                return count / (time.perf_counter() - t0)
+
+        def served_counters():
+            out = {}
+            for port in ports:
+                st = _status_of(("127.0.0.1", port), None) or {}
+                out[f"127.0.0.1:{port}"] = (
+                    st.get("counters", {}).get(
+                        "follower_reads_served", 0
+                    ),
+                    st.get("role"),
+                )
+            return out
+
+        # warmup OUTSIDE the measurement (connections, page cache) so
+        # the baseline is not cold-start-inflated in the follower
+        # path's favor, then the primary-only baseline (exact reads)
+        drive(None, count=max(n_queries // 8, 200))
+        drive(30.0, count=max(n_queries // 8, 200))
+        drive_exact_qps = drive(None)
+        base_counters = served_counters()
+        follower_qps = drive(30.0)
+        after_counters = served_counters()
+        per_node = {
+            a: after_counters[a][0] - base_counters[a][0]
+            for a in after_counters
+        }
+        replica_serves = sum(
+            v for a, v in per_node.items()
+            if after_counters[a][1] == "replica"
+        )
+        total_reads = n_queries
+        return {
+            "metric": "kv_follower_read_qps_3node",
+            "value": round(follower_qps, 1),
+            "unit": "qps",
+            "primary_only_qps": round(drive_exact_qps, 1),
+            "scaling_x": round(follower_qps / max(drive_exact_qps,
+                                                  1e-9), 2),
+            "replica_served_frac": round(
+                replica_serves / max(total_reads, 1), 3
+            ),
+            "per_node_served": {a: v for a, v in per_node.items()},
+            "stale_answers": wrong[0],
+            "cores": os.cpu_count(),
+            "clients": threads,
+            "keys": n_keys,
+            "queries": n_queries,
+            "note": (
+                "client process is GIL-bound on few-core hosts; the "
+                "fan-out fraction is the honest scaling signal there "
+                "(servers are separate processes)"
+            ),
+        }
+    finally:
+        if be is not None:
+            be.close()
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=5)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1575,7 +1713,7 @@ def main():
                     choices=["hnsw100k", "knn1m", "knn10m", "ann10m",
                              "brute", "graph3hop", "hybrid",
                              "live_fanout", "knn_sharded",
-                             "mem_pressure"])
+                             "mem_pressure", "follower_reads"])
     ap.add_argument("--groups", type=int, default=2,
                     help="shard groups for --config knn_sharded (2/4)")
     args = ap.parse_args()
@@ -1643,6 +1781,7 @@ def main():
         "live_fanout": bench_live_fanout,
         "knn_sharded": bench_knn_sharded,
         "mem_pressure": bench_mem_pressure,
+        "follower_reads": bench_follower_reads,
     }
     _probe_backend()
     if args.all:

@@ -221,6 +221,19 @@ KV_LEASE_TTL_S = env_float("SURREAL_KV_LEASE_TTL_S", 6.0)
 # the promotion protocol (lease check -> peer survey -> self-promote)
 KV_FAILOVER_TIMEOUT_S = env_float("SURREAL_KV_FAILOVER_TIMEOUT_S", 8.0)
 
+# -- follower reads: closed-timestamp bounded staleness (kvs/remote.py) ------
+# a read-only transaction carrying a max_staleness bound (READ AT in
+# SQL) may be served by a REPLICA that can prove the requested
+# timestamp is closed: the primary publishes a monotone closed
+# timestamp in every repl frame and on the heartbeat cadence, so a
+# replica's lag is bounded even when writes pause. 0/None-bounded
+# (default, exact) reads stay primary-served and byte-identical.
+KV_FOLLOWER_READS = env_str("SURREAL_KV_FOLLOWER_READS", "on")
+# mutation-test hook (sim/harness.py): True bypasses the replica-side
+# closed-timestamp proof so the DST follower-read invariant can prove
+# it BITES — never set outside a mutation test.
+KV_FOLLOWER_PROOF_DISABLED = False
+
 # -- range sharding / cross-shard 2PC (kvs/shard.py, kvs/remote.py) ----------
 # versionstamps for a sharded store come in windows leased from the meta
 # shard (PD-style TSO): one meta round-trip hands out this many stamps.

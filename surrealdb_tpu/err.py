@@ -49,6 +49,15 @@ class StorageFullError(SdbError):
     be idempotent at the application level."""
 
 
+class FollowerTooStale(RetryableKvError):
+    """A bounded-staleness follower read could not be served: no replica
+    could prove the requested timestamp closed under the session's
+    (closed_ts, era) floor, and the primary fallback failed too. The
+    read observed NOTHING (the proof runs before any snapshot is
+    pinned), so a retry — which rides primary rediscovery — is always
+    safe. Stale data is never silently served in place of this error."""
+
+
 class KnnShardUnavailable(SdbError):
     """A scatter-gather KNN query could not get an answer from every
     index shard within its per-shard budgets (SURREAL_KNN_PARTIAL=error

@@ -34,6 +34,45 @@ class Executor:
         self.ds = ds
         self.session = session
 
+    def _read_staleness(self, stmt, shared_vars):
+        """Bounded-staleness opt-in for ONE auto-transaction statement:
+        a SELECT's `READ AT <duration>` clause, else the session-level
+        `max_staleness` default. Returns seconds or None (exact read —
+        the default, byte-identical to the primary-pinned path)."""
+        from surrealdb_tpu.expr.ast import SelectStmt
+
+        if not isinstance(stmt, SelectStmt):
+            return None
+        expr = getattr(stmt, "read_at", None)
+        if expr is None:
+            return self.session.max_staleness
+        from surrealdb_tpu.exec.eval import evaluate
+        from surrealdb_tpu.val import Duration, render
+
+        # READ AT is resolved BEFORE the transaction opens (it decides
+        # which kind to open), so it evaluates txn-free: literals and
+        # $params only, like the reference's statement-level options —
+        # anything that needs the store (a subquery, an idiom) is a
+        # TYPED error, not an internal crash on the missing txn
+        ctx = Ctx(self.ds, self.session, None, executor=self)
+        ctx.vars.update(shared_vars)
+        try:
+            d = evaluate(expr, ctx)
+        except SdbError:
+            raise
+        except Exception:
+            raise SdbError(
+                "READ AT expects a literal duration or $param "
+                "(subqueries and record access are not allowed here)"
+            )
+        if isinstance(d, Duration):
+            return max(d.to_seconds(), 0.0)
+        if isinstance(d, (int, float)) and not isinstance(d, bool):
+            return max(float(d), 0.0)
+        raise SdbError(
+            f"READ AT expects a duration but found {render(d)}"
+        )
+
     def _commit_and_publish(self, txn):
         """Commit, then hand the transaction's captured live events to
         the fan-out dispatch workers (server/fanout.py). A transaction
@@ -193,10 +232,26 @@ class Executor:
             try:
                 if own_txn:
                     t_txn = time.perf_counter_ns()
-                    cur = self.ds.transaction(write=True)
+                    # READ AT / session max_staleness: the statement
+                    # runs READ-ONLY and may be served by a replica
+                    # that proves the bound (closed-timestamp follower
+                    # reads, kvs/remote.py). Exact statements take the
+                    # unchanged write=True path.
+                    stale_s = self._read_staleness(stmt, shared_vars)
+                    if stale_s is not None:
+                        cur = self.ds.transaction(
+                            write=False, max_staleness=stale_s
+                        )
+                    else:
+                        cur = self.ds.transaction(write=True)
                     stage_record("txn_open",
                                  time.perf_counter_ns() - t_txn)
                 else:
+                    if getattr(stmt, "read_at", None) is not None:
+                        raise SdbError(
+                            "READ AT cannot be used inside an "
+                            "explicit transaction"
+                        )
                     cur = txn
             except SdbError as e:
                 # a transaction that cannot OPEN (remote KV unreachable /
@@ -222,8 +277,20 @@ class Executor:
                     # non-strict mode lazily registers the session ns/db in
                     # the catalog (reference kvs get_or_add_ns/db); once per
                     # run — inside the error envelope: a partitioned KV
-                    # must surface as a statement error, not a crash
-                    _ensure_ns_db(ctx)
+                    # must surface as a statement error, not a crash.
+                    # A follower-read statement holds a READ-ONLY txn,
+                    # so the one-time registration commits separately.
+                    if not getattr(cur, "write", True):
+                        wtx = self.ds.transaction(write=True)
+                        try:
+                            _ensure_ns_db(Ctx(self.ds, self.session,
+                                              wtx, executor=self))
+                            wtx.commit()
+                        except BaseException:
+                            wtx.cancel()
+                            raise
+                    else:
+                        _ensure_ns_db(ctx)
                 if not own_txn:
                     # savepoints only matter inside an explicit
                     # transaction (a failing statement rolls back to the

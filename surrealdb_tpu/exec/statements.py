@@ -5167,6 +5167,29 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             raise  # cancellation must never be absorbed by INFO
         except SdbError:
             shard_topo = None
+        # follower-read serving state (kvs/remote.py closed-timestamp
+        # protocol): per-group closed_ts/lag/era observations plus the
+        # session floor and the served/rejected/fallback counters —
+        # cache-only, same no-network discipline as topology()
+        repl_info = None
+        repl_fn = getattr(ctx.ds.backend, "replication_info", None)
+        if repl_fn is not None:
+            try:
+                repl_info = {
+                    "groups": repl_fn(),
+                    "counters": {
+                        k: ctx.ds.telemetry.get(k) for k in (
+                            "follower_reads_served",
+                            "follower_read_fallbacks",
+                        )
+                    },
+                    "closed_ts_lag_s":
+                        ctx.ds.backend.replication_lag_s(),
+                }
+            except (_QC, _QT):
+                raise
+            except SdbError:
+                repl_info = None
         out = {
             "available_parallelism": _os.cpu_count() or 1,
             "cpu_usage": 0.0,
@@ -5205,6 +5228,8 @@ def _s_info(n: InfoStmt, ctx: Ctx):
         }
         if shard_topo is not None:
             out["shards"] = shard_topo
+        if repl_info is not None:
+            out["replication"] = repl_info
         # shard-partitioned vector serving (idx/shardvec.py): per-shard
         # index residency — rows, host bytes, ANN state, sync version,
         # replica addresses — so an operator can see which slice of

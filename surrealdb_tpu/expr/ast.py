@@ -369,6 +369,10 @@ class SelectStmt(Node):
     tempfiles: bool = False
     explain: Optional[bool] = None  # True=EXPLAIN, 'full'=EXPLAIN FULL
     ref_field: Optional[str] = None  # FIELD clause inside <~(SELECT ...)
+    # READ AT <duration>: bounded-staleness follower read — the
+    # statement runs read-only and may be served by a replica that can
+    # prove it is at most this stale (kvs/remote.py closed timestamps)
+    read_at: Optional[Node] = None
 
 
 @dataclass

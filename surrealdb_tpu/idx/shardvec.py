@@ -40,6 +40,13 @@ The robustness spine is the point (built like PRs 1-5, failure-first):
 - **Crash/promotion recovery for free.** Parts sync from KV truth
   through the routing client, so a promoted replica repopulates
   index-serving state exactly like PR-4 crash/reship.
+- **Follower reads ride through.** Every KV read here goes through the
+  query's transaction (`ctx.txn`), so a `SELECT ... <|k|> ... READ AT
+  <bound>` statement scatter-gathers over each group's REPLICAS via
+  the closed-timestamp proof (kvs/remote.py): the freshness `vn` read,
+  the op-log fetch, and every part's range sync all serve from a
+  provably-bounded-stale snapshot, and KNN read capacity scales with
+  replicas instead of serializing on each group's primary.
 
 Lock discipline (tools/check_robustness.py rule 8): `scatter_gather`
 and `merge_topk` check the query deadline, and NO lock is held across

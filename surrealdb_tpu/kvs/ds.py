@@ -36,6 +36,9 @@ class Session:
         # (reference dbs/session.rs:44)
         self.redact_volatile_explain_attrs = False
         self.import_mode = False  # OPTION IMPORT: DEFINEs overwrite
+        # session-level follower-read default (seconds): SELECTs without
+        # an explicit READ AT bound inherit it; None = exact reads
+        self.max_staleness: Optional[float] = None
         self.variables: dict[str, Any] = {}
 
     @property
@@ -263,6 +266,13 @@ class Datastore:
         from surrealdb_tpu.kvs.shard import ShardedBackend as _SB
 
         self._local_catalog_cache = not isinstance(self.backend, (_RB, _SB))
+        if not self._local_catalog_cache:
+            # follower-read observability: worst observed closed-ts lag
+            # across replica-set members (-1 until a follower read runs)
+            self.telemetry.register_gauge(
+                "repl_closed_ts_lag_s",
+                lambda: round(self.backend.replication_lag_s(), 3),
+            )
         # TSO window state (sharded stores lease versionstamp windows
         # from the meta shard instead of running a local HLC); windows
         # expire so an idle node can't stamp far in the logical past
@@ -332,8 +342,22 @@ class Datastore:
 
 
     # -- transactions -------------------------------------------------------
-    def transaction(self, write: bool = True) -> Transaction:
+    def transaction(self, write: bool = True,
+                    max_staleness: Optional[float] = None) -> Transaction:
+        """Open a transaction. `max_staleness` (seconds, read-only
+        transactions only) opts into closed-timestamp follower reads on
+        replicated backends: the read may be served by a replica that
+        can PROVE it is at most that stale. Local backends serve latest
+        — trivially within any bound — and never see the parameter.
+        The default (None) is byte-identical to the exact path."""
         self.metrics["transactions"] += 1
+        if max_staleness is not None and not write \
+                and getattr(self.backend, "supports_staleness", False):
+            return Transaction(
+                self.backend.transaction(write,
+                                         max_staleness=max_staleness),
+                write,
+            )
         if self._local_catalog_cache:
             with self.lock:
                 t = Transaction(self.backend.transaction(write), write)

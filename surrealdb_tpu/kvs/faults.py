@@ -17,6 +17,12 @@ Usage:
     ds = Datastore(f"remote://127.0.0.1:{proxy.port}")
     proxy.set(drop_next=2)          # swallow the next 2 request frames
     proxy.set(delay_s=0.2)          # 200ms added to every request
+    proxy.set(delay_repl_s=0.5)     # delay ONLY replication frames
+                                    # (repl_apply/repl_sync/repl_ping):
+                                    # opens a controlled closed-
+                                    # timestamp lag window for
+                                    # follower-read tests without
+                                    # partitioning the whole link
     proxy.set(duplicate=True)       # send every request frame twice
     proxy.set(corrupt_next=1)       # bit-flip the next request frame's
                                     # body (checksum-detectable garbage)
@@ -149,6 +155,9 @@ class FaultProxy:
         self.drop_next = 0  # swallow the next N request frames
         self.drop_prob = 0.0  # swallow each request frame with prob p
         self.delay_s = 0.0  # added latency per request frame
+        # repl-frame-only delay: lag the replication stream (and so the
+        # replica's closed timestamp) while client ops flow untouched
+        self.delay_repl_s = 0.0
         self.duplicate = False  # forward each request frame twice
         self.corrupt_next = 0  # bit-flip the next N request frame bodies
         self.corrupt_ops = None  # limit corruption to these ops (tuple)
@@ -330,6 +339,9 @@ class FaultProxy:
                 self.frames_corrupted += 1
                 corrupt = True
             delay = self.delay_s
+            if self.delay_repl_s and op in ("repl_apply", "repl_sync",
+                                            "repl_ping"):
+                delay = max(delay, self.delay_repl_s)
             dup = self.duplicate
         if corrupt:
             # flip one bit deep in the body, header untouched: the frame

@@ -34,6 +34,26 @@ primary's WAL and (b) applied on every replica that was attached at
 commit time. Killing the primary therefore loses no acknowledged write
 as long as one attached replica survives to be promoted.
 
+Follower reads (closed-timestamp bounded staleness): replicas may serve
+READ-ONLY transactions that carry an explicit `max_staleness` bound.
+The primary stamps a monotone CLOSED TIMESTAMP (its wall clock at ship
+time, under wal_lock — every commit it has ever acked is in a frame at
+or below that stamp) into every `repl_apply`/`repl_sync` frame and into
+the `repl_ping` heartbeat, so a replica's closed timestamp keeps
+advancing on the heartbeat cadence even when writes pause, and its lag
+is bounded by one ping interval on a healthy link. A replica serves a
+follower read iff it can PROVE the requested timestamp is closed:
+`closed_ts >= max(wall - max_staleness, session floor)` and its durable
+era (`\x00!replstate`) is at least the session's observed era — a
+partitioned, era-stale, or lagging replica fails the proof with the
+typed retryable "kv follower too stale" error (never silent stale
+data), and the client pool falls back to another replica and then the
+primary. Session monotonicity: every follower pin returns the serving
+node's (closed_ts, era); the pool folds them into a high-water floor
+that all later follower pins must meet, so one session's reads never
+travel backwards in time. Exact reads (no staleness bound — the
+default) never touch any of this and stay primary-served.
+
 Security model: the KV service is a CLUSTER-INTERNAL endpoint (the
 reference's TiKV gRPC port is the same); optional shared-secret auth
 (SURREAL_KV_SECRET / KvServer(secret=...)) rejects unauthenticated
@@ -67,6 +87,7 @@ from __future__ import annotations
 import os
 import queue
 import random
+import re
 import socket
 import socketserver
 import struct
@@ -177,10 +198,15 @@ def is_retryable(e: BaseException) -> bool:
         # "not replicated": the primary refused to ack because no
         # replica was attached to receive the write — retryable, and the
         # retry rides the same rediscovery path as a failover
+        # "follower too stale": the replica refused to serve a
+        # bounded-staleness read it could not prove closed — retryable,
+        # and the pool's fallback ladder (other replica -> primary)
+        # normally absorbs it before it ever reaches this classifier
         return ("kv not primary" in m or "kv connection lost" in m
                 or "kv service unreachable" in m
                 or "kv wrong shard epoch" in m
                 or "kv shard unavailable" in m
+                or "kv follower too stale" in m
                 or "not replicated" in m)
     if isinstance(e, (ConnectionError, socket.timeout, TimeoutError)):
         return True
@@ -314,13 +340,17 @@ class _ConnState:
     socket handler and the simulator's in-process connection both carry
     one of these through `KvEngine.handle_frame`."""
 
-    __slots__ = ("owned", "authed")
+    __slots__ = ("owned", "authed", "fsnaps")
 
     def __init__(self, authed: bool):
         # snapshots held by THIS connection, as a multiset: several txns
         # pooled onto one connection can legitimately pin the same version
         self.owned: Counter = Counter()
         self.authed = authed
+        # snapshots pinned through the follower-read proof
+        # (snap_follower): the ONLY snaps a replica will serve
+        # get/range against — an exact-read snap never lands here
+        self.fsnaps: set = set()
 
 
 class _KvHandler(socketserver.BaseRequestHandler):
@@ -372,7 +402,7 @@ class _EngineDispatch:
                 return ["ok", None], False
             return ["err", "kv auth required"], True
         try:
-            resp = self._dispatch(self.vs, req, cstate.owned)
+            resp = self._dispatch(self.vs, req, cstate)
         except SdbError as e:
             resp = ["err", str(e)]
         except Exception as e:  # internal — surface, keep serving
@@ -386,12 +416,30 @@ class _EngineDispatch:
     # exactly that as acked writes "missing" from a final scan served
     # by a demoted stale replica. (`rel` stays open: releasing a pin
     # taken while this node WAS primary must work after a demotion.)
+    # The ONE sanctioned exception is a follower read: get/range
+    # against a snapshot that was pinned through the closed-timestamp
+    # proof (`snap_follower`) — see _follower_read_allowed, whose
+    # scope tools/check_robustness.py rule 10 pins fail-closed.
     _PRIMARY_READS = ("get", "get_latest", "range", "snap", "shard_items")
 
-    def _dispatch(self, vs, req, owned):
+    def _follower_read_allowed(self, op, req, cstate) -> bool:
+        """True when a non-primary node may serve this read: only
+        `get`/`range`, and only against a snapshot this connection
+        pinned through the follower-read proof (cstate.fsnaps). A bare
+        `snap`, `get_latest`, or `shard_items` is NEVER follower-served
+        — those are the stale-forever holes PR 5 closed."""
+        if op == "get":
+            return req[2] in cstate.fsnaps
+        if op == "range":
+            return req[3] in cstate.fsnaps
+        return False
+
+    def _dispatch(self, vs, req, cstate):
         srv = self
+        owned = cstate.owned
         op = req[0]
-        if op in srv._PRIMARY_READS and srv.role != "primary":
+        if op in srv._PRIMARY_READS and srv.role != "primary" \
+                and not srv._follower_read_allowed(op, req, cstate):
             raise SdbError(srv.not_primary_msg())
         if op == "get":
             srv.shard_check_keys((req[1],))
@@ -418,12 +466,28 @@ class _EngineDispatch:
             snap = vs.snapshot()
             owned[snap] += 1
             return ["ok", snap]
+        if op == "snap_follower":
+            # bounded-staleness read pin: prove the requested timestamp
+            # is closed under this node's era, then pin. Proof + pin
+            # run under wal_lock so a resync/era bump cannot slide in
+            # between and hand back a floor older than the pinned state.
+            _op, req_ts, min_closed, min_era = req[:4]
+            min_epoch = int(req[4]) if len(req) > 4 else 0
+            with srv.wal_lock:
+                closed, era = srv.follower_read_proof(
+                    req_ts, min_closed, min_era, min_epoch
+                )
+                snap = vs.snapshot()
+            owned[snap] += 1
+            cstate.fsnaps.add(snap)
+            return ["ok", [snap, closed, era]]
         if op == "rel":
             snap = req[1]
             if owned[snap] > 0:
                 owned[snap] -= 1
                 if not owned[snap]:
                     del owned[snap]
+                    cstate.fsnaps.discard(snap)
                 vs.release(snap)
             return ["ok", None]
         if op == "commit":
@@ -567,21 +631,35 @@ class _EngineDispatch:
             _op, pid, paddr, seq = req
             return ["ok", srv.repl_hello(pid, paddr, seq)]
         if op == "repl_apply":
-            if len(req) == 5:
+            if len(req) >= 5:
                 # blob+crc form: the replica verifies byte integrity
-                # BEFORE apply (see KvServer.repl_apply)
-                _op, pid, seq, blob, crc = req
+                # BEFORE apply (see KvServer.repl_apply). A 6th element
+                # carries the frame's closed timestamp.
+                _op, pid, seq, blob, crc = req[:5]
+                closed = float(req[5]) if len(req) > 5 else None
                 return ["ok", srv.repl_apply(pid, seq, None,
-                                             bytes(blob), int(crc))]
+                                             bytes(blob), int(crc),
+                                             closed=closed)]
             _op, pid, seq, pairs = req  # legacy unchecked form
             return ["ok", srv.repl_apply(pid, seq, pairs)]
         if op == "repl_sync":
-            _op, pid, seq, items = req
-            return ["ok", srv.repl_sync(pid, seq, items)]
+            _op, pid, seq, items = req[:4]
+            closed = float(req[4]) if len(req) > 4 else None
+            return ["ok", srv.repl_sync(pid, seq, items, closed=closed)]
         if op == "repl_ping":
-            _op, pid = req
+            _op, pid = req[:2]
             if srv.role == "replica" and pid == srv.repl_primary_id:
                 srv.note_repl_traffic()
+                # heartbeat closed-timestamp: adopt only when this
+                # replica has applied EVERYTHING the primary shipped —
+                # with frames still in flight the stamp closes a prefix
+                # we do not hold yet
+                if len(req) >= 4 and int(req[2]) == srv.applied_seq:
+                    with srv.wal_lock:
+                        if pid == srv.repl_primary_id \
+                                and int(req[2]) == srv.applied_seq:
+                            srv.closed_ts = max(srv.closed_ts,
+                                                float(req[3]))
             return ["ok", srv.applied_seq]
         raise SdbError(f"unknown kv op {op!r}")
 
@@ -610,8 +688,15 @@ class _ReplLink:
             try:
                 with self.server.wal_lock:
                     if self.attached and self.conn is not None:
+                        # heartbeat carries (repl_seq, closed): under
+                        # wal_lock every commit this primary ever acked
+                        # is in a frame <= repl_seq, so "now" is closed
+                        # — the replica's staleness stays bounded by
+                        # one ping interval even when writes pause
                         self.conn.call(
-                            ["repl_ping", self.server.node_id]
+                            ["repl_ping", self.server.node_id,
+                             self.server.repl_seq,
+                             self.server.advance_closed()]
                         )
             except Exception:
                 self._detach()
@@ -644,6 +729,7 @@ class _ReplLink:
                         "repl_sync", self.server.node_id,
                         self.server.repl_seq,
                         [[k, v] for k, v in items],
+                        self.server.advance_closed(),
                     ])
                     self.server.counters["repl_resyncs"] += 1
                 self.conn = c
@@ -655,15 +741,18 @@ class _ReplLink:
             c.close()
             raise
 
-    def send(self, seq: int, blob: bytes, crc: int) -> bool:
+    def send(self, seq: int, blob: bytes, crc: int,
+             closed: float) -> bool:
         # caller holds wal_lock. The writeset ships as one encoded blob
         # + crc32 so the replica can verify byte integrity BEFORE apply
         # (a corrupted frame detaches the link; reattach full-resyncs).
+        # `closed` is the frame's closed timestamp (see _publish).
         if not self.attached or self.conn is None:
             return False
         try:
             self.conn.call(
-                ["repl_apply", self.server.node_id, seq, blob, crc]
+                ["repl_apply", self.server.node_id, seq, blob, crc,
+                 closed]
             )
             return True
         except Exception:
@@ -685,10 +774,11 @@ class _Replicator:
     def __init__(self, server: "KvEngine", peer_addrs: list[str]):
         self.links = [_ReplLink(server, a) for a in peer_addrs]
 
-    def ship(self, seq: int, blob: bytes, crc: int) -> int:
+    def ship(self, seq: int, blob: bytes, crc: int,
+             closed: float) -> int:
         """Returns how many replicas acked the frame."""
         return sum(
-            1 for link in self.links if link.send(seq, blob, crc)
+            1 for link in self.links if link.send(seq, blob, crc, closed)
         )
 
     def attached_count(self) -> int:
@@ -748,6 +838,11 @@ class KvEngine(_EngineDispatch):
         self.repl: Optional[_Replicator] = None
         self.repl_seq = 0  # primary: last shipped sequence number
         self.applied_seq = 0  # replica: last applied sequence number
+        # closed timestamp (wall domain): primary = last stamp it
+        # published; replica = highest stamp adopted from the stream.
+        # Volatile by design — a rebooted replica serves NO follower
+        # read until the live stream re-proves a closed prefix.
+        self.closed_ts = 0.0
         self.repl_primary_id: Optional[str] = None
         self.last_repl = self.clock.monotonic()  # boot grace (monitor)
         self.failover_timeout_s = (cnf.KV_FAILOVER_TIMEOUT_S
@@ -907,6 +1002,68 @@ class KvEngine(_EngineDispatch):
     def note_repl_traffic(self):
         self.last_repl = self.clock.monotonic()
 
+    # -- follower reads: closed-timestamp publication + proof ---------------
+
+    def advance_closed(self) -> float:
+        """Primary side, caller holds wal_lock: advance and return the
+        published closed timestamp. Commits are serialized under
+        wal_lock and shipped before their ack, so at this instant every
+        write this primary has ever acknowledged lives in a frame at or
+        below the current repl_seq — 'now' is closed. Monotone-maxed so
+        a wall-clock step backwards can never regress the stamp."""
+        self.closed_ts = max(self.closed_ts, self.clock.wall())
+        return self.closed_ts
+
+    def follower_read_proof(self, req_ts, min_closed, min_era,
+                            min_epoch: int = 0):
+        """The closed-timestamp proof gating EVERY follower-served read
+        (tools/check_robustness.py rule 10): return (closed_ts, era)
+        when this node can serve a read-only snapshot at `req_ts`, else
+        raise the typed retryable "kv follower too stale" error.
+
+        - On the PRIMARY the proof is trivial: it owns the log, so its
+          state is closed through 'now' (the fallback path lands here).
+        - On a replica: `closed_ts >= max(req_ts, min_closed)` proves
+          the requested prefix was fully applied; the durable era
+          (\\x00!replstate) must reach `min_era` — a replica still on a
+          superseded lineage may hold rolled-back writes and miss acked
+          ones, so it must never serve a session that already observed
+          the newer era; and the replicated shard-config epoch must
+          reach the CLIENT's routing epoch `min_epoch` — a slice moved
+          onto this group by a split arrives as `seed` frames stamped
+          at COPY time, not at the rows' original ack times, so only a
+          replica that has applied the epoch fence (which ships after
+          the copy, in frame order) provably holds the migrated rows.
+        Floors come back to the client, which folds them into the
+        session high-water mark (monotone reads).
+        """
+        from surrealdb_tpu import cnf as _cnf
+
+        if self.role == "primary":
+            return self.advance_closed(), self.era
+        era = _repl_rank(self.vs.read_latest(REPL_STATE_KEY))[0]
+        want = max(float(req_ts), float(min_closed or 0.0))
+        if _cnf.KV_FOLLOWER_PROOF_DISABLED:
+            # mutation-test hook: LIE that the prefix is closed — the
+            # DST follower-read invariant must catch what this serves
+            return max(self.closed_ts, want), max(era, int(min_era or 0))
+        epoch_ok = (int(min_epoch or 0) <= 0
+                    or (self.shard is not None
+                        and int(self.shard[2]) >= int(min_epoch)))
+        if self.closed_ts < want or era < int(min_era or 0) \
+                or not epoch_ok:
+            self.counters["follower_reads_rejected_stale"] += 1
+            raise SdbError(
+                f"kv follower too stale: closed={self.closed_ts:.6f} "
+                f"era={era} epoch="
+                f"{None if self.shard is None else self.shard[2]} "
+                f"cannot prove requested={float(req_ts):.6f} "
+                f"floor=({float(min_closed or 0.0):.6f}, "
+                f"{int(min_era or 0)}, epoch>={int(min_epoch or 0)})"
+            )
+        self.counters["follower_reads_served"] += 1
+        return self.closed_ts, era
+
     def status(self) -> dict:
         # counter writers are unsynchronized; a key insert during the
         # copy raises RuntimeError — retry the snapshot, don't error the
@@ -944,6 +1101,15 @@ class KvEngine(_EngineDispatch):
             "version": self.vs.version,
             "repl_seq": self.repl_seq,
             "applied_seq": self.applied_seq,
+            # follower-read serving state: the closed timestamp this
+            # node can prove, its lag behind 'now', and whether a
+            # bounded-staleness read could be served here at all
+            "closed_ts": self.closed_ts,
+            "closed_lag_s": (0.0 if self.role == "primary"
+                             else max(self.clock.wall()
+                                      - self.closed_ts, 0.0)),
+            "follower_serving": bool(self.role == "replica"
+                                     and self.closed_ts > 0.0),
             "repl_primary_id": self.repl_primary_id,
             "repl_state": rs,  # durable [lineage, seq, era] credential
             "lease": None if lease is None else [lease[0], lease[1]],
@@ -1250,7 +1416,8 @@ class KvEngine(_EngineDispatch):
 
     def repl_apply(self, primary_id: str, seq: int, pairs,
                    blob: Optional[bytes] = None,
-                   crc: Optional[int] = None):
+                   crc: Optional[int] = None,
+                   closed: Optional[float] = None):
         if blob is not None:
             # verify BEFORE taking locks or touching state: a corrupted
             # frame must never be applied (the sender's link detaches on
@@ -1283,11 +1450,17 @@ class KvEngine(_EngineDispatch):
             self.vs.commit(writes, self.vs.snapshot())
             self.log_commit(writes)
             self._note_prep_writes(writes)
+            self._note_shard_cfg(writes)
             self.applied_seq = seq
+            if closed is not None:
+                # the frame's stamp closes everything up to THIS seq,
+                # which is now fully applied
+                self.closed_ts = max(self.closed_ts, closed)
             self.counters["repl_applied"] += 1
             return self.applied_seq
 
-    def repl_sync(self, primary_id: str, seq: int, items):
+    def repl_sync(self, primary_id: str, seq: int, items,
+                  closed: Optional[float] = None):
         with self.wal_lock:
             if self.role != "replica":
                 raise SdbError(f"kv not replica (role={self.role})")
@@ -1311,9 +1484,29 @@ class KvEngine(_EngineDispatch):
             self.staged_meta.clear()
             self.locks.clear()
             self._note_prep_writes(new)
+            self._note_shard_cfg(new)
             self.applied_seq = seq
+            if closed is not None:
+                self.closed_ts = max(self.closed_ts, closed)
             self.counters["repl_synced"] += 1
             return self.applied_seq
+
+    def _note_shard_cfg(self, writes: dict):
+        """Adopt a replicated shard-config row into the in-memory fence
+        as it streams in. Before follower reads this could wait for
+        promotion (_load_shard_state) — a replica never served reads.
+        Now the REPLICA enforces range fencing and proves the epoch in
+        the follower-read proof, so its fence must track its keyspace
+        continuously."""
+        raw = writes.get(SHARD_CFG_KEY)
+        if raw is None:
+            return
+        try:
+            beg, end, epoch = _decode(bytes(raw))
+        except Exception:
+            return  # robust: an undecodable row is reload's job
+        self.shard = (bytes(beg),
+                      None if end is None else bytes(end), int(epoch))
 
     def _note_prep_writes(self, writes: dict):
         """Mirror replicated 2PC stage state in memory as prep rows
@@ -1355,7 +1548,8 @@ class KvEngine(_EngineDispatch):
         self.repl_seq += 1
         blob = _encode([[k, v] for k, v in writes.items()])
         delivered = self.repl.ship(self.repl_seq, blob,
-                                   zlib.crc32(blob) & 0xFFFFFFFF)
+                                   zlib.crc32(blob) & 0xFFFFFFFF,
+                                   self.advance_closed())
         self.counters["repl_shipped"] += 1
         return delivered
 
@@ -1887,6 +2081,15 @@ def _is_wrong_shard(e: BaseException) -> bool:
     return "kv wrong shard epoch" in str(e)
 
 
+def _is_follower_stale(e: BaseException) -> bool:
+    return "kv follower too stale" in str(e)
+
+
+def follower_reads_enabled() -> bool:
+    return str(cnf.KV_FOLLOWER_READS).lower() not in ("off", "0",
+                                                      "false", "no")
+
+
 class _Pool:
     """Failover-aware connection pool. A transaction CHECKS OUT one
     connection for its whole lifetime (snapshot accounting correctness);
@@ -1925,6 +2128,19 @@ class _Pool:
         # held across status probes — must come from the transport so
         # the simulator can park a task that blocks on it
         self.discover_lock = self.transport.make_lock()
+        # -- follower reads (closed-timestamp bounded staleness) ----------
+        # session-monotonic floor: the highest (closed_ts, era) any
+        # follower pin through this pool has observed. Every later pin
+        # must prove at least this much — one session's bounded-stale
+        # reads never travel backwards in time, even across replicas
+        # and elections.
+        self.follower_floor: tuple[float, int] = (0.0, 0)
+        self._f_rr = 0  # deterministic replica rotation cursor
+        self._f_conns: dict = {}  # addr index -> [idle follower conns]
+        # last follower-serving observation per member address — INFO
+        # FOR SYSTEM's replication section reads this CACHE, never the
+        # network (a sick cluster must not stall a diagnostic)
+        self.repl_observed: dict = {}
 
     # -- telemetry ----------------------------------------------------------
     def _inc(self, name: str):
@@ -2086,12 +2302,182 @@ class _Pool:
             self.count -= 1
 
     def close(self):
+        with self.lock:
+            fconns, self._f_conns = self._f_conns, {}
+        for conns in fconns.values():
+            for c in conns:
+                c.close()
         while True:
             try:
                 c = self.q.get_nowait()
             except queue.Empty:
                 return
             self.drop(c)
+
+    # -- follower reads (bounded-staleness checkout) -------------------------
+    # Follower connections live OUTSIDE the primary pool's accounting:
+    # they are keyed by member index, never counted against `size`, and
+    # never epoch-poisoned (a failover does not invalidate a replica
+    # conn — the proof decides serve/reject, not the topology guess).
+
+    #: an observation older than this is treated as unknown, so a
+    #: replica that once looked stale gets re-probed instead of being
+    #: starved forever off an aging cache entry
+    FOLLOWER_OBS_TTL_S = 2.0
+
+    def _follower_candidates(self) -> list[int]:
+        """Member indexes to try for a follower pin — freshest-first by
+        the observation cache (a replica whose last observed closed_ts
+        is below the session floor would only burn a round trip on a
+        guaranteed rejection), unknown/aged members optimistically
+        first so they get probed, rotation breaking ties so load still
+        spreads. The primary is the FALLBACK, tried separately through
+        the normal pool."""
+        with self.lock:
+            p = self.primary_i
+            n = len(self.addrs)
+            start = self._f_rr
+            self._f_rr += 1
+            obs = {a: v["closed_ts"] for a, v in
+                   self.repl_observed.items()
+                   if net.wall() - v["at"] <= self.FOLLOWER_OBS_TTL_S}
+        reps = [i for i in range(n) if i != p]
+        if not reps:
+            return []
+        k = start % len(reps)
+        reps = reps[k:] + reps[:k]
+
+        def freshness(i):
+            h, pt = self.addrs[i]
+            # unknown/aged = +inf: optimistic, try it and learn
+            return obs.get(f"{h}:{pt}", float("inf"))
+
+        return sorted(reps, key=freshness, reverse=True)
+
+    def _f_acquire(self, i: int):
+        with self.lock:
+            conns = self._f_conns.get(i)
+            if conns:
+                return conns.pop()
+        c = self.transport.connect(
+            self.addrs[i], self.secret, timeout=self.op_timeout,
+            connect_timeout=self.connect_timeout,
+        )
+        c.follower_i = i
+        return c
+
+    def follower_release(self, c):
+        with self.lock:
+            conns = self._f_conns.setdefault(c.follower_i, [])
+            if len(conns) < 8:
+                conns.append(c)
+                return
+        c.close()
+
+    def follower_drop(self, c):
+        c.close()
+
+    def _note_observation(self, i: int, closed: float, era: int):
+        """Record a member's (closed, era) in the observation cache —
+        candidate ordering + INFO FOR SYSTEM read it. Never touches
+        the session floor (a REJECTION tells us about the member, not
+        about anything this session has observed)."""
+        h, p = self.addrs[i]
+        with self.lock:
+            self.repl_observed[f"{h}:{p}"] = {
+                "closed_ts": float(closed), "era": int(era),
+                "at": net.wall(),
+            }
+
+    def _note_follower(self, i: int, closed: float, era: int):
+        with self.lock:
+            self.follower_floor = (
+                max(self.follower_floor[0], closed),
+                max(self.follower_floor[1], era),
+            )
+        self._note_observation(i, closed, era)
+
+    def lease_follower_snapshot(self, staleness_s: float,
+                                min_epoch: int = 0):
+        """Check out a connection AND pin a bounded-staleness read-only
+        snapshot: each replica in rotation is asked to PROVE the
+        requested timestamp closed under the session's (closed, era)
+        floor (`snap_follower`); a second replica is the hedge against
+        the first being slow/stale; the primary — whose proof is
+        trivial — is the final fallback, through the normal
+        failover-following pool. Returns (conn, snap, closed, follower).
+        Raises FollowerTooStale when NOBODY could serve: stale data is
+        never silently substituted."""
+        from surrealdb_tpu.err import FollowerTooStale
+
+        def once():
+            req_ts = max(net.wall() - float(staleness_s), 0.0)
+            with self.lock:
+                floor_c, floor_e = self.follower_floor
+            for i in self._follower_candidates():
+                try:
+                    c = self._f_acquire(i)
+                except (OSError, SdbError):
+                    continue  # unreachable member: next candidate
+                try:
+                    snap, closed, era = c.call(
+                        ["snap_follower", req_ts, floor_c, floor_e,
+                         int(min_epoch)]
+                    )
+                except (ConnectionError, OSError):
+                    self.follower_drop(c)
+                    continue
+                except SdbError as e:
+                    # too stale / mid-promotion / auth: the CONN is
+                    # healthy (the server answered) — keep it, move on.
+                    # A stale rejection names the member's closed_ts:
+                    # feed it to the candidate ordering so the next pin
+                    # does not burn a round trip on the same rejection.
+                    if _is_follower_stale(e):
+                        m = re.search(r"closed=([0-9.]+) era=(-?\d+)",
+                                      str(e))
+                        if m is not None:
+                            self._note_observation(
+                                i, float(m.group(1)), int(m.group(2))
+                            )
+                    self.follower_release(c)
+                    continue
+                self._note_follower(i, float(closed), int(era))
+                self._inc("follower_reads_served")
+                return c, int(snap), float(closed), True
+            # primary fallback (trivial proof; floor still enforced)
+            self._inc("follower_read_fallbacks")
+            c = self.acquire()
+            try:
+                snap, closed, era = c.call(
+                    ["snap_follower", req_ts, floor_c, floor_e,
+                     int(min_epoch)]
+                )
+            except (ConnectionError, OSError) as e:
+                raise self._fail(c, e)
+            except SdbError as e:
+                if _is_not_primary(e):
+                    raise self._fail(c, e)
+                self.release(c)
+                if _is_follower_stale(e):
+                    # believed-primary is a stale replica: rediscover
+                    self._mark_suspect()
+                    raise FollowerTooStale(str(e))
+                raise
+            # the fallback read OBSERVES the primary's prefix: fold its
+            # (closed, era) into the session floor like any follower
+            # pin, or a later replica pin could legally serve a prefix
+            # OLDER than what this session just saw (non-monotone) —
+            # and an old-lineage replica could outlive an era bump the
+            # session already observed
+            with self.lock:
+                self.follower_floor = (
+                    max(self.follower_floor[0], float(closed)),
+                    max(self.follower_floor[1], int(era)),
+                )
+            return c, int(snap), float(closed), False
+
+        return self.policy.run(once, telemetry=self.telemetry)
 
     # -- one-shot ops with retry/failover -----------------------------------
     def _call_once(self, msg):
@@ -2148,7 +2534,9 @@ class RemoteTx(BackendTx):
     the snapshot moves forward across the failover); write transactions
     abort with a RetryableKvError."""
 
-    def __init__(self, backend: "RemoteBackend", write: bool):
+    def __init__(self, backend: "RemoteBackend", write: bool,
+                 max_staleness: Optional[float] = None,
+                 min_shard_epoch: int = 0):
         # `done` first: if construction dies below, __del__ must not
         # trip on a half-built object (GC-time AttributeError)
         self.done = False
@@ -2158,26 +2546,66 @@ class RemoteTx(BackendTx):
         self.snap = None
         self.pool = backend.pool
         self.write = write
+        # bounded-staleness follower read: read-only only, and only
+        # when the pool actually has replicas to offload onto. The
+        # default (None) takes EXACTLY the old primary-pinned path.
+        self.staleness = None if write else max_staleness
+        self.min_shard_epoch = int(min_shard_epoch or 0)
+        self.follower = False
+        self.closed_ts: Optional[float] = None
         try:
-            self.conn, self.snap = self.pool.lease_snapshot()
+            if self.staleness is not None \
+                    and len(self.pool.addrs) > 1 \
+                    and follower_reads_enabled():
+                (self.conn, self.snap, self.closed_ts,
+                 self.follower) = self.pool.lease_follower_snapshot(
+                    self.staleness, self.min_shard_epoch
+                )
+            else:
+                self.conn, self.snap = self.pool.lease_snapshot()
         except BaseException:
             self.done = True
             raise
 
     def _drop_conn(self):
         if self.conn is not None:
-            self.pool.drop(self.conn)
+            if getattr(self.conn, "follower_i", None) is not None:
+                self.pool.follower_drop(self.conn)
+            else:
+                self.pool.drop(self.conn)
             self.conn = None
 
     def _return_conn(self):
         if self.conn is not None:
-            self.pool.release(self.conn)
+            if getattr(self.conn, "follower_i", None) is not None:
+                self.pool.follower_release(self.conn)
+            else:
+                self.pool.release(self.conn)
             self.conn = None
 
+    def _fail_conn(self, c, e) -> RetryableKvError:
+        """Transport-failure cleanup routing: a follower conn's death
+        says nothing about the primary (no suspect mark, no pool-slot
+        accounting); a pool conn takes the normal failover path."""
+        if getattr(c, "follower_i", None) is not None:
+            self.pool.follower_drop(c)
+            return RetryableKvError(f"kv connection lost: {e}")
+        return self.pool._fail(c, e)
+
     def _repin(self):
-        """Re-pin this read-only transaction on the current primary."""
+        """Re-pin this read-only transaction: follower transactions
+        re-prove on the next candidate under the session floor (the
+        snapshot only ever moves FORWARD); exact reads re-pin on the
+        current primary."""
         self.pool._inc("kv_txn_failovers")
-        self.conn, self.snap = self.pool.lease_snapshot()
+        if self.staleness is not None and len(self.pool.addrs) > 1 \
+                and follower_reads_enabled():
+            (self.conn, self.snap, self.closed_ts,
+             self.follower) = self.pool.lease_follower_snapshot(
+                self.staleness, self.min_shard_epoch
+            )
+        else:
+            self.conn, self.snap = self.pool.lease_snapshot()
 
     def _call(self, build):
         """Run `build(snap)` against the pinned connection. On transport
@@ -2193,7 +2621,7 @@ class RemoteTx(BackendTx):
             if not transport:
                 raise
             c, self.conn = self.conn, None
-            err = self.pool._fail(c, e)
+            err = self._fail_conn(c, e)
             if self.write:
                 self.done = True
                 raise RetryableKvError(
@@ -2205,7 +2633,7 @@ class RemoteTx(BackendTx):
             except (ConnectionError, OSError) as e2:
                 self.done = True
                 c, self.conn = self.conn, None
-                raise self.pool._fail(c, e2)
+                raise self._fail_conn(c, e2)
 
     def _check(self):
         if self.done:
@@ -2278,8 +2706,11 @@ class RemoteTx(BackendTx):
                 if self.conn is not None:
                     self.conn.call(["rel", snap])
             except (ConnectionError, OSError):
+                was_follower = getattr(self.conn, "follower_i",
+                                       None) is not None
                 self._drop_conn()  # server released pins on disconnect
-                self.pool._mark_suspect()
+                if not was_follower:
+                    self.pool._mark_suspect()
             finally:
                 self._return_conn()
             return
@@ -2432,8 +2863,50 @@ class RemoteBackend(Backend):
         )
         self.pool.call(["ping"], policy=boot)
 
-    def transaction(self, write: bool) -> RemoteTx:
-        return RemoteTx(self, write)
+    #: Datastore checks this before forwarding a READ AT /
+    #: max_staleness bound — local backends serve latest (trivially
+    #: within any bound) and never see the parameter
+    supports_staleness = True
+
+    def transaction(self, write: bool,
+                    max_staleness: Optional[float] = None,
+                    min_shard_epoch: int = 0) -> RemoteTx:
+        return RemoteTx(self, write, max_staleness=max_staleness,
+                        min_shard_epoch=min_shard_epoch)
+
+    def replication_info(self) -> dict:
+        """Follower-read serving state for INFO FOR SYSTEM's
+        `replication` section — served from the pool's OBSERVATION
+        CACHE (each follower pin records the serving node's closed_ts
+        and era), never from fresh network I/O: this is the diagnostic
+        you read when the cluster is sick."""
+        p = self.pool
+        with p.lock:
+            floor_c, floor_e = p.follower_floor
+            observed = {a: dict(v) for a, v in p.repl_observed.items()}
+            primary = p.addrs[p.primary_i]
+        now = net.wall()
+        for v in observed.values():
+            v["observed_age_s"] = round(now - v.pop("at"), 3)
+            v["closed_lag_s"] = round(max(now - v["closed_ts"], 0.0), 3)
+            v["follower_serving"] = True
+        return {
+            "addrs": [f"{h}:{pt}" for h, pt in p.addrs],
+            "primary": f"{primary[0]}:{primary[1]}",
+            "floor_closed_ts": floor_c,
+            "floor_era": floor_e,
+            "observed": observed,
+        }
+
+    def replication_lag_s(self) -> float:
+        """Worst observed closed-timestamp lag across members (gauge
+        `repl_closed_ts_lag_s`); -1.0 before any follower read."""
+        p = self.pool
+        with p.lock:
+            obs = [v["closed_ts"] for v in p.repl_observed.values()]
+        if not obs:
+            return -1.0
+        return max(net.wall() - min(obs), 0.0)
 
     def close(self) -> None:
         self.pool.close()

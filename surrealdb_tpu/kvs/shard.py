@@ -179,10 +179,16 @@ class ShardTx(BackendTx):
     once a shard holds buffered writes, topology churn aborts the
     transaction retryably — the retry runs against the fresh map."""
 
-    def __init__(self, backend: "ShardedBackend", write: bool):
+    def __init__(self, backend: "ShardedBackend", write: bool,
+                 max_staleness: Optional[float] = None):
         self.done = False
         self.backend = backend
         self.write = write
+        # bounded-staleness follower reads: every per-shard
+        # sub-transaction inherits the bound, so a cross-shard scan or
+        # a scatter-gather KNN fans out over each GROUP's replicas
+        # instead of serializing on each group's primary
+        self.max_staleness = None if write else max_staleness
         self._map = backend.shard_map()
         self._subs: dict = {}  # shard index -> RemoteTx
         self._sp_depth = 0
@@ -197,8 +203,15 @@ class ShardTx(BackendTx):
     def _sub(self, i: int):
         tx = self._subs.get(i)
         if tx is None:
-            gb = self.backend.group_backend(self._map.shards[i].addrs)
-            tx = gb.transaction(self.write)
+            s = self._map.shards[i]
+            gb = self.backend.group_backend(s.addrs)
+            # the routing epoch rides into the follower-read proof: a
+            # replica that has not applied this epoch's fence (and
+            # therefore may be missing a split's seeded slice) must
+            # reject rather than serve a hole
+            tx = gb.transaction(self.write,
+                                max_staleness=self.max_staleness,
+                                min_shard_epoch=s.epoch)
             # sub-transactions opened mid-statement must carry the same
             # savepoint depth as their siblings, or a statement-level
             # rollback would silently keep their writes
@@ -645,8 +658,37 @@ class ShardedBackend(Backend):
 
     # -- Backend contract ---------------------------------------------------
 
-    def transaction(self, write: bool) -> ShardTx:
-        return ShardTx(self, write)
+    supports_staleness = True
+
+    def transaction(self, write: bool,
+                    max_staleness: Optional[float] = None) -> ShardTx:
+        return ShardTx(self, write, max_staleness=max_staleness)
+
+    def replication_info(self) -> dict:
+        """Per-group follower-read serving state (INFO FOR SYSTEM
+        `replication` section): the meta group's plus every touched
+        group's observation cache, keyed by the group's range label.
+        Cache-only — no network I/O (same discipline as topology())."""
+        groups = {"meta": self.meta.replication_info()}
+        m = self._map
+        with self.lock:
+            touched = dict(self._groups)
+        if m is not None:
+            for s in m.shards:
+                gb = touched.get(s.addrs)
+                if gb is None or gb is self.meta:
+                    continue
+                hi = "inf" if s.end is None else repr(s.end)
+                groups[f"[{s.beg!r},{hi})"] = gb.replication_info()
+        return groups
+
+    def replication_lag_s(self) -> float:
+        with self.lock:
+            gbs = list(self._groups.values())
+        lags = [gb.replication_lag_s() for gb in {id(g): g
+                for g in gbs + [self.meta]}.values()]
+        lags = [g for g in lags if g >= 0.0]
+        return max(lags) if lags else -1.0
 
     def close(self) -> None:
         if self.telemetry is not None:

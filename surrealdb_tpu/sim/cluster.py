@@ -59,6 +59,9 @@ class SimConfig:
         # and the key the driver's online split fires at
         self.shard_bounds = None  # None = the classic /b /k/4 /y cuts
         self.split_key = b"/k/6"
+        # staleness bounds the follower-read workload draws from; the
+        # smallest one forces rejections whenever a replica lags
+        self.follower_staleness = (0.5, 2.0, 8.0)
         for k, v in kw.items():
             if not hasattr(self, k):
                 raise TypeError(f"unknown SimConfig knob {k!r}")

@@ -37,6 +37,9 @@ class SimResult:
         self.store_digest = ""
         self.virtual_s = 0.0
         self.stats: dict = {}
+        # follower-read observations in per-session order (bit-repro
+        # tests compare these across runs of one seed)
+        self.follower_log: list = []
 
     @property
     def ok(self) -> bool:
@@ -60,6 +63,7 @@ class _ClientLog:
         self.crashes: list[dict] = []
         self.tso: list[tuple] = []
         self.epochs: list[int] = []
+        self.freads: list[dict] = []  # follower-read observations
         self.inline_violations: list[str] = []
 
 
@@ -96,12 +100,14 @@ def _workload(kernel, cluster, log: _ClientLog, ci: int, cfg: SimConfig):
     backend = cluster.client_backend(log.name)
     for j in range(cfg.ops_per_client):
         r = rng.random()
-        if r < 0.55:
+        if r < 0.50:
             key = f"/k/{ci}/{j:03d}".encode()
             val = f"{ci}:{j}".encode()
+            t0 = kernel.now
             status = _run_write(kernel, backend, {key: val})
             log.singles.append(
-                {"key": key, "val": val, "status": status}
+                {"key": key, "val": val, "status": status,
+                 "t0": t0, "t1": kernel.now}
             )
             if status == "acked" and rng.random() < 0.4:
                 try:
@@ -115,7 +121,7 @@ def _workload(kernel, cluster, log: _ClientLog, ci: int, cfg: SimConfig):
                         )
                 except (RetryableKvError, SdbError, OSError):
                     pass  # read unavailability is not a violation
-        elif r < 0.75:
+        elif r < 0.68:
             ka = f"/a/{ci}/{j:03d}".encode()
             kb = f"/z/{ci}/{j:03d}".encode()
             val = f"{ci}:{j}".encode()
@@ -123,7 +129,42 @@ def _workload(kernel, cluster, log: _ClientLog, ci: int, cfg: SimConfig):
             log.pairs.append(
                 {"ka": ka, "kb": kb, "val": val, "status": status}
             )
-        elif r < 0.85:
+        elif r < 0.80 and log.singles:
+            # bounded-staleness follower read: the replica must PROVE
+            # the bound closed or reject typed (the pool falls back to
+            # the primary) — never silently stale. Observations feed
+            # check_follower_reads after quiesce.
+            stale_s = cfg.follower_staleness[
+                rng.randrange(len(cfg.follower_staleness))
+            ]
+            picks = [log.singles[rng.randrange(len(log.singles))]
+                     for _ in range(2)]
+            t0 = kernel.now
+            tx = None
+            try:
+                tx = backend.transaction(False, max_staleness=stale_s)
+                for rec in picks:
+                    got = tx.get(rec["key"])
+                    log.freads.append({
+                        "session": log.name, "key": rec["key"],
+                        "got": None if got is None else bytes(got),
+                        "staleness": stale_s,
+                        # conservative: the pin happens AFTER t0, so
+                        # the true requested point is >= this
+                        "requested_ts": t0 - stale_s,
+                        "t0": t0, "t1": kernel.now,
+                    })
+                tx.commit()
+            except (RetryableKvError, SdbError, OSError):
+                # read unavailability is not a violation — silently
+                # WRONG answers are, and those are what the checker
+                # hunts in the recorded observations
+                if tx is not None and not tx.done:
+                    try:
+                        tx.cancel()
+                    except (SdbError, OSError):
+                        pass
+        elif r < 0.93:
             # coordinator crash injection at a chosen 2PC point
             ka = f"/b/{ci}/{j:03d}".encode()
             kb = f"/y/{ci}/{j:03d}".encode()
@@ -1283,6 +1324,102 @@ def run_mem_sim(seed: int, cfg: Optional[MemSimConfig] = None,
     return res
 
 
+def run_follower_lag_sim(seed: int,
+                         proof_disabled: bool = False) -> SimResult:
+    """Scripted follower-read staleness scenario (deterministic, one
+    replica group): partition replica g0m1 from the primary, keep
+    writing acked keys through the surviving replica, let the acked
+    writes OUTLIVE the staleness bound, then force the client's next
+    follower pin to try the partitioned replica first.
+
+    With the proof ON the frozen replica cannot show a closed
+    timestamp past the bound, rejects typed, and the pool falls
+    forward to the healthy replica — every observation exact. With
+    `proof_disabled` (the mutation: cnf.KV_FOLLOWER_PROOF_DISABLED
+    bypasses the closed-timestamp check) the frozen replica serves its
+    stale prefix and `check_follower_reads` MUST flag the answer —
+    proving the invariant has teeth, not just that the happy path is
+    green."""
+    from surrealdb_tpu import cnf as _cnf
+
+    cfg = SimConfig(groups=1, members=3, spare_groups=0, clients=1,
+                    splits=0)
+    res = SimResult()
+    res.seed = seed
+    kernel = Kernel(seed)
+    cluster = SimCluster(kernel, cfg,
+                         tempfile.mkdtemp(prefix=f"simfr-{seed}-"))
+    singles: list = []
+    freads: list = []
+    counters: dict = {}
+    saved = _cnf.KV_FOLLOWER_PROOF_DISABLED
+    _cnf.KV_FOLLOWER_PROOF_DISABLED = bool(proof_disabled)
+
+    def main():
+        cluster.boot()
+        be = cluster.client_backend("c0")
+
+        def write(key, val):
+            t0 = kernel.now
+            st = _run_write(kernel, be, {key: val})
+            singles.append({"key": key, "val": val, "status": st,
+                            "t0": t0, "t1": kernel.now})
+
+        write(b"/k/old", b"v-old")
+        kernel.sleep(2.0)
+        cluster.net.partition("g0m0", "g0m1")
+        kernel.sleep(0.5)
+        write(b"/k/new", b"v-new")  # acked via the surviving replica
+        kernel.sleep(6.0)  # the ack now predates the staleness bound
+        gb = be.group_backend(tuple(cluster.peers_of(0)))
+        gb.pool._f_rr = 0  # next pin tries the FROZEN replica first
+        stale_s = 4.0
+        t0 = kernel.now
+        tx = be.transaction(False, max_staleness=stale_s)
+        for key in (b"/k/old", b"/k/new"):
+            got = tx.get(key)
+            freads.append({
+                "session": "c0", "key": key,
+                "got": None if got is None else bytes(got),
+                "staleness": stale_s, "requested_ts": t0 - stale_s,
+                "t0": t0, "t1": kernel.now,
+            })
+        tx.commit()
+        for n in cluster.group_nodes(0):
+            if n.engine is not None:
+                counters[n.host] = dict(n.engine.counters)
+        be.close()
+        kernel.shutdown()
+
+    try:
+        with kvnet.use_clock(SimClock(kernel)):
+            kernel.run(main)
+    finally:
+        _cnf.KV_FOLLOWER_PROOF_DISABLED = saved
+        shutil.rmtree(cluster.data_root, ignore_errors=True)
+    with kvnet.use_clock(kvnet.REAL_CLOCK):
+        res.violations += inv.check_follower_reads(freads, singles)
+    res.errors += list(kernel.errors)
+    res.trace = kernel.trace
+    res.trace_digest = hashlib.sha256(
+        "\n".join(kernel.trace).encode()
+    ).hexdigest()
+    res.follower_log = [
+        (fr["session"], fr["key"], fr["got"],
+         round(fr["requested_ts"], 6)) for fr in freads
+    ]
+    res.virtual_s = kernel.now
+    res.stats = {
+        "events": kernel.events,
+        "freads": len(freads),
+        "served_by": {h: c.get("follower_reads_served", 0)
+                      for h, c in counters.items()},
+        "rejected_by": {h: c.get("follower_reads_rejected_stale", 0)
+                        for h, c in counters.items()},
+    }
+    return res
+
+
 def run_sim(seed: int, cfg: Optional[SimConfig] = None,
             data_root: Optional[str] = None,
             mutate=None) -> SimResult:
@@ -1408,6 +1545,17 @@ def run_sim(seed: int, cfg: Optional[SimConfig] = None,
         crashes = [r for lg in logs for r in lg.crashes]
         windows = [w for lg in logs for w in lg.tso]
         res.violations += [v for lg in logs for v in lg.inline_violations]
+        # follower-read invariant: per-session observation order is
+        # what monotonicity is defined over, so check per client log
+        for lg in logs:
+            res.violations += inv.check_follower_reads(
+                lg.freads, lg.singles
+            )
+            res.follower_log += [
+                (lg.name, fr["key"], fr["got"],
+                 round(fr["requested_ts"], 6))
+                for fr in lg.freads
+            ]
         if scan_ok:
             res.violations += inv.check_acked_writes(singles, final_scan)
             res.violations += inv.check_atomic_pairs(pairs, final_scan)
@@ -1443,5 +1591,21 @@ def run_sim(seed: int, cfg: Optional[SimConfig] = None,
                          if r["status"] == "maybe"),
         "crash_injections": len(crashes),
         "tso_windows": len(windows),
+        "follower_reads": sum(len(lg.freads) for lg in logs),
+        "follower_hits": sum(
+            1 for lg in logs for fr in lg.freads
+            if fr["got"] is not None
+        ),
+        # server-side view (surviving engines only — a restart resets
+        # counters): proves replicas actually served and the proof
+        # actually rejected, not just that the fallback path worked
+        "follower_served": sum(
+            e.counters.get("follower_reads_served", 0)
+            for e in engines_snapshot
+        ),
+        "follower_rejected": sum(
+            e.counters.get("follower_reads_rejected_stale", 0)
+            for e in engines_snapshot
+        ),
     }
     return res

@@ -413,6 +413,83 @@ def check_mem_governance(samples: list, clamp_t: float,
     return out
 
 
+def check_follower_reads(freads: list, singles: list) -> list[str]:
+    """Closed-timestamp follower-read invariant (kvs/remote.py):
+
+    Every follower-served read observation must be explainable by the
+    write-once oracle, within its staleness bound, and monotone per
+    session:
+
+    - **no unacked or rolled-back write observed**: a value may only be
+      the key's oracle value, and only for keys whose write was at
+      least attempted to completion (status acked/maybe) — observing a
+      value for a status="none" key means a replica served state the
+      cluster rolled back;
+    - **staleness bound honored (virtual time)**: a read requested at
+      timestamp R (= its start minus max_staleness — conservative: the
+      actual pin happens later, so the true requested point is >= R)
+      must see every single-key write whose ack COMPLETED at or before
+      R; missing one means a replica served a prefix staler than the
+      bound it proved;
+    - **monotone reads per session**: once a session has observed a key
+      present, no later read in that session may see it absent (keys
+      are write-once, so present -> absent is the only possible
+      regression). Flagged for acked keys — a "maybe" write is allowed
+      to be present-or-absent in the final state, and an election may
+      legitimately resolve it either way mid-run.
+
+    `freads` records are dicts with session/key/got/requested_ts in
+    per-session observation order; `singles` is the same write oracle
+    check_acked_writes consumes.
+    """
+    out = []
+    oracle = {rec["key"]: rec for rec in singles}
+    seen_present: dict = {}  # (session, key) -> first-seen index
+    for idx, fr in enumerate(freads):
+        key, got = fr["key"], fr["got"]
+        rec = oracle.get(key)
+        if rec is None:
+            if got is not None:
+                out.append(
+                    f"FOLLOWER PHANTOM {fr['session']}: {key!r} holds "
+                    f"{got!r} but was never a workload write"
+                )
+            continue
+        if got is not None and got != rec["val"]:
+            out.append(
+                f"FOLLOWER CORRUPT VALUE {fr['session']}: {key!r} read "
+                f"{got!r}, oracle value {rec['val']!r}"
+            )
+            continue
+        if got is not None and rec["status"] == "none":
+            out.append(
+                f"FOLLOWER ROLLED-BACK WRITE SERVED {fr['session']}: "
+                f"{key!r}={got!r} but the write never completed "
+                f"(status=none)"
+            )
+            continue
+        sk = (fr["session"], key)
+        if got is None:
+            if rec["status"] == "acked" \
+                    and rec.get("t1") is not None \
+                    and rec["t1"] <= fr["requested_ts"]:
+                out.append(
+                    f"FOLLOWER STALE BEYOND BOUND {fr['session']}: "
+                    f"{key!r} acked at t={rec['t1']:.3f} invisible to "
+                    f"a read requesting t>={fr['requested_ts']:.3f} "
+                    f"(max_staleness={fr.get('staleness')})"
+                )
+            if sk in seen_present and rec["status"] == "acked":
+                out.append(
+                    f"FOLLOWER NON-MONOTONE SESSION {fr['session']}: "
+                    f"{key!r} seen present at obs #{seen_present[sk]} "
+                    f"then absent at obs #{idx}"
+                )
+        else:
+            seen_present.setdefault(sk, idx)
+    return out
+
+
 def check_staged_leak(engines) -> list[str]:
     """After convergence no 2PC stage survives: every prepared
     transaction reached a decision."""

@@ -400,6 +400,12 @@ class Parser:
                 s.timeout = self.parse_expr()
             elif self.eat_kw("parallel"):
                 s.parallel = True
+            elif self.at_kw("read") and self.peek(1).kind == L.IDENT \
+                    and str(self.peek(1).value).lower() == "at":
+                # READ AT <duration>: bounded-staleness follower read
+                self.next()
+                self.next()
+                s.read_at = self.parse_expr()
             elif self.eat_kw("tempfiles"):
                 s.tempfiles = True
             elif self.eat_kw("explain"):

@@ -1,0 +1,754 @@
+"""Follower reads: closed-timestamp bounded-staleness read serving.
+
+The contract under test (kvs/remote.py):
+
+- a read-only transaction with `max_staleness` may be served by a
+  REPLICA, but only through the closed-timestamp proof
+  (`snap_follower` -> `follower_read_proof`): the replica must prove
+  `closed_ts >= max(now - max_staleness, session floor)` under the
+  session's era/epoch floors, or reject with the typed retryable
+  "kv follower too stale" — never silent stale data;
+- the primary publishes the closed timestamp in every repl frame AND
+  on the heartbeat cadence, so replica lag stays bounded when writes
+  pause — and a repl-frame-only delay (kvs/faults.py delay_repl_s)
+  opens a controlled lag window without partitioning the link;
+- sessions read monotonically: the pool folds every follower pin's
+  (closed, era) into a high-water floor all later pins must meet;
+- exact reads (no bound — the default) never touch any of this.
+"""
+
+import threading
+import time
+
+import pytest
+
+from surrealdb_tpu import cnf
+from surrealdb_tpu.err import FollowerTooStale, RetryableKvError, SdbError
+from surrealdb_tpu.kvs.remote import (
+    REPL_STATE_KEY,
+    RemoteBackend,
+    RetryPolicy,
+    StandaloneKvEngine,
+    _encode,
+    _status_of,
+    is_retryable,
+    serve_kv,
+)
+
+
+def _free_port():
+    import socket as _socket
+
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _boot_group(n=3, failover_timeout_s=2.0, lease_ttl_s=1.5):
+    ports = [_free_port() for _ in range(n)]
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    srvs = []
+    for i, p in enumerate(ports):
+        srvs.append(serve_kv(
+            "127.0.0.1", p, block=False,
+            role="primary" if i == 0 else "replica",
+            peers=peers, self_index=i,
+            failover_timeout_s=failover_timeout_s,
+            lease_ttl_s=lease_ttl_s,
+        ))
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        st = _status_of(("127.0.0.1", ports[0]), None)
+        if st and st.get("attached_replicas") == n - 1:
+            break
+        time.sleep(0.1)
+    else:
+        raise RuntimeError("replicas never attached")
+    return srvs, peers
+
+
+def _stop(srvs):
+    for s in srvs:
+        try:
+            s.shutdown()
+            s.server_close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# proof unit tests (engine-level, no sockets)
+# ---------------------------------------------------------------------------
+
+
+def _replica_engine():
+    eng = StandaloneKvEngine("test:0", role="replica",
+                             auto_failover=False)
+    # durable era credential: era 3
+    eng.vs.commit({REPL_STATE_KEY: _encode(["lin", 7, 3])},
+                  eng.vs.snapshot())
+    eng.closed_ts = 100.0
+    return eng
+
+
+def test_proof_accepts_closed_prefix():
+    eng = _replica_engine()
+    closed, era = eng.follower_read_proof(99.0, 0.0, 0)
+    assert closed == 100.0 and era == 3
+    assert eng.counters["follower_reads_served"] == 1
+
+
+def test_proof_rejects_unclosed_timestamp():
+    eng = _replica_engine()
+    with pytest.raises(SdbError, match="kv follower too stale"):
+        eng.follower_read_proof(100.5, 0.0, 0)
+    assert eng.counters["follower_reads_rejected_stale"] == 1
+
+
+def test_proof_enforces_session_monotonic_floor():
+    """min_closed is the monotone-reads-per-session unit: a replica
+    whose closed_ts satisfies the REQUESTED bound must still reject
+    when the session has already observed a fresher prefix."""
+    eng = _replica_engine()
+    # requested ts 50 alone would pass (closed=100) ...
+    assert eng.follower_read_proof(50.0, 0.0, 0)[0] == 100.0
+    # ... but a session floor past this replica's closed must reject
+    with pytest.raises(SdbError, match="kv follower too stale"):
+        eng.follower_read_proof(50.0, 100.5, 0)
+
+
+def test_proof_enforces_era_floor():
+    eng = _replica_engine()
+    assert eng.follower_read_proof(50.0, 0.0, 3)[1] == 3
+    with pytest.raises(SdbError, match="kv follower too stale"):
+        eng.follower_read_proof(50.0, 0.0, 4)
+
+
+def test_proof_enforces_shard_epoch_floor():
+    """A replica that has not applied the client's routing epoch may be
+    missing a split's seeded slice — it must reject, however fresh its
+    closed timestamp looks."""
+    eng = _replica_engine()
+    with pytest.raises(SdbError, match="kv follower too stale"):
+        eng.follower_read_proof(50.0, 0.0, 0, min_epoch=2)
+    eng.shard = (b"", None, 2)
+    assert eng.follower_read_proof(50.0, 0.0, 0, min_epoch=2)
+
+
+def test_proof_trivial_on_primary():
+    eng = StandaloneKvEngine("test:1", role="primary",
+                             auto_failover=False)
+    closed, _era = eng.follower_read_proof(0.0, 0.0, 0)
+    assert closed > 0.0  # 'now' — the primary owns the log
+
+
+def test_dispatch_refuses_unproven_replica_reads():
+    """A replica serves get/range ONLY against a proof-pinned snapshot;
+    bare snap/get_latest stay primary-only (the PR-5 holes)."""
+    eng = _replica_engine()
+    eng.vs.commit({b"/k/1": b"v1"}, eng.vs.snapshot())
+    cstate = eng.new_conn_state()
+    # bare snap: refused
+    resp, _ = eng.handle_frame(["snap"], cstate)
+    assert resp[0] == "err" and "not primary" in resp[1]
+    resp, _ = eng.handle_frame(["get_latest", b"/k/1"], cstate)
+    assert resp[0] == "err" and "not primary" in resp[1]
+    # proven pin: served
+    resp, _ = eng.handle_frame(["snap_follower", 99.0, 0.0, 0],
+                               cstate)
+    assert resp[0] == "ok"
+    snap, closed, era = resp[1]
+    assert closed == 100.0 and era == 3
+    resp, _ = eng.handle_frame(["get", b"/k/1", snap], cstate)
+    assert resp == ["ok", b"v1"], resp
+    resp, _ = eng.handle_frame(
+        ["range", b"/k/", b"/k/\xff", snap, None, False], cstate
+    )
+    assert resp[0] == "ok" and len(resp[1]) == 1
+    # a get against a snap that never passed the proof: refused
+    resp, _ = eng.handle_frame(["get", b"/k/1", snap + 999], cstate)
+    assert resp[0] == "err" and "not primary" in resp[1]
+    # releasing the pin retires its follower registration
+    resp, _ = eng.handle_frame(["rel", snap], cstate)
+    assert resp[0] == "ok"
+    resp, _ = eng.handle_frame(["get", b"/k/1", snap], cstate)
+    assert resp[0] == "err" and "not primary" in resp[1]
+
+
+def test_follower_stale_is_retryable():
+    assert is_retryable(SdbError("kv follower too stale: closed=1"))
+    assert is_retryable(FollowerTooStale("nobody could serve"))
+
+
+# ---------------------------------------------------------------------------
+# real sockets: serving, lag windows, monotone sessions, failover
+# ---------------------------------------------------------------------------
+
+
+def test_follower_reads_serve_from_replicas():
+    srvs, peers = _boot_group(3)
+    be = None
+    try:
+        be = RemoteBackend(",".join(peers))
+        tx = be.transaction(True)
+        for i in range(10):
+            tx.set(f"/k/{i}".encode(), f"v{i}".encode())
+        tx.commit()
+        # exact reads: primary-only, untouched by the follower path
+        tx = be.transaction(False)
+        assert tx.get(b"/k/3") == b"v3"
+        tx.commit()
+        assert sum(s.counters.get("follower_reads_served", 0)
+                   for s in srvs) == 0
+        # bounded-staleness reads: replicas serve, values exact
+        for i in range(6):
+            tx = be.transaction(False, max_staleness=30.0)
+            assert tx.follower, "replica should have served this"
+            assert tx.closed_ts and tx.closed_ts > 0
+            assert tx.get(f"/k/{i}".encode()) == f"v{i}".encode()
+            assert len(list(tx.scan(b"/k/", b"/k/\xff"))) == 10
+            tx.commit()
+        served = {s.advertise: s.counters.get("follower_reads_served", 0)
+                  for s in srvs if s.role == "replica"}
+        assert sum(served.values()) == 6, served
+        # rotation spread the load over BOTH replicas
+        assert all(v > 0 for v in served.values()), served
+        assert srvs[0].counters.get("follower_reads_served", 0) == 0
+        info = be.replication_info()
+        assert info["floor_closed_ts"] > 0
+        assert len(info["observed"]) == 2
+        assert be.replication_lag_s() >= 0.0
+    finally:
+        if be is not None:
+            be.close()
+        _stop(srvs)
+
+
+def test_follower_reads_disabled_knob(monkeypatch):
+    monkeypatch.setattr(cnf, "KV_FOLLOWER_READS", "off")
+    srvs, peers = _boot_group(3)
+    be = None
+    try:
+        be = RemoteBackend(",".join(peers))
+        tx = be.transaction(True)
+        tx.set(b"/k/0", b"v0")
+        tx.commit()
+        tx = be.transaction(False, max_staleness=30.0)
+        assert not tx.follower
+        assert tx.get(b"/k/0") == b"v0"
+        tx.commit()
+        assert sum(s.counters.get("follower_reads_served", 0)
+                   for s in srvs) == 0
+    finally:
+        if be is not None:
+            be.close()
+        _stop(srvs)
+
+
+def _boot_proxied_replica(tmp_path=None):
+    """primary + one replica whose advertised address runs through a
+    FaultProxy — delay_repl_s then lags ONLY the replication stream."""
+    from surrealdb_tpu.kvs.faults import FaultProxy
+
+    p0, pr = _free_port(), _free_port()
+    proxy = FaultProxy(("127.0.0.1", pr)).start()
+    peers = [f"127.0.0.1:{p0}", proxy.addr]
+    prim = serve_kv("127.0.0.1", p0, block=False, role="primary",
+                    peers=peers, self_index=0,
+                    failover_timeout_s=30.0, lease_ttl_s=10.0)
+    repl = serve_kv("127.0.0.1", pr, block=False, role="replica",
+                    peers=peers, self_index=1,
+                    failover_timeout_s=30.0, lease_ttl_s=10.0)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        st = _status_of(("127.0.0.1", p0), None)
+        if st and st.get("attached_replicas") == 1:
+            break
+        time.sleep(0.1)
+    else:
+        proxy.stop()
+        raise RuntimeError("proxied replica never attached")
+    return prim, repl, proxy, peers
+
+
+def test_delay_repl_opens_closed_ts_lag_window():
+    """Regression for the repl-frame-only delay fault: with
+    delay_repl_s the replica's closed timestamp lags while client
+    traffic flows, so a tight staleness bound REJECTS (typed, counted,
+    primary answers via fallback) and a loose one still serves; healing
+    the delay closes the window again."""
+    prim, repl, proxy, peers = _boot_group_proxy = _boot_proxied_replica()
+    be = None
+    try:
+        be = RemoteBackend(",".join(peers),
+                           policy=RetryPolicy(deadline_s=10.0))
+        tx = be.transaction(True)
+        tx.set(b"/k/a", b"va")
+        tx.commit()
+        # healthy link: replica serves even a tight bound (heartbeats
+        # run every failover_timeout/3 = 10s... too slow — frames from
+        # the commit above carried a fresh stamp)
+        tx = be.transaction(False, max_staleness=30.0)
+        assert tx.follower and tx.get(b"/k/a") == b"va"
+        tx.commit()
+        base_rej = repl.counters.get("follower_reads_rejected_stale", 0)
+        # open the lag window: ONLY repl frames are delayed
+        proxy.set(delay_repl_s=1.5)
+        time.sleep(0.2)
+        tx = be.transaction(True)
+        tx.set(b"/k/b", b"vb")
+        tx.commit()  # ack waits on the delayed synchronous ship
+        # tight bound: the replica cannot prove it -> typed reject,
+        # fallback serves the CORRECT value from the primary
+        tx = be.transaction(False, max_staleness=0.2)
+        assert not tx.follower, "stale replica must not have served"
+        assert tx.get(b"/k/b") == b"vb"
+        tx.commit()
+        assert repl.counters.get("follower_reads_rejected_stale", 0) \
+            > base_rej
+        # THIS session observed the primary's fresh prefix via the
+        # fallback, so its floor now outruns the lagging replica — but
+        # a NEW session (floor zero) with a loose bound may legally be
+        # served by the laggard
+        be2 = RemoteBackend(",".join(peers),
+                            policy=RetryPolicy(deadline_s=10.0))
+        try:
+            tx = be2.transaction(False, max_staleness=60.0)
+            assert tx.follower
+            assert tx.get(b"/k/a") == b"va"
+            tx.commit()
+        finally:
+            be2.close()
+        # heal: the stream catches up and tight bounds serve again
+        proxy.set(delay_repl_s=0.0)
+        deadline = time.monotonic() + 10.0
+        ok = False
+        while time.monotonic() < deadline:
+            tx = be.transaction(False, max_staleness=1.0)
+            got = tx.get(b"/k/b")
+            was_follower = tx.follower
+            tx.commit()
+            assert got == b"vb"
+            if was_follower:
+                ok = True
+                break
+            time.sleep(0.3)
+        assert ok, "replica never resumed serving after heal"
+    finally:
+        if be is not None:
+            be.close()
+        proxy.stop()
+        _stop([prim, repl])
+
+
+def test_session_floor_blocks_older_replica():
+    """Monotone reads per session across replicas: after a pin on a
+    fresh replica, a FROZEN replica that could satisfy the raw
+    staleness bound must still reject (session floor), so the session
+    never reads backwards in time — while a brand-new session (floor
+    zero) may legally read the frozen replica's older prefix."""
+    srvs, peers = _boot_group(3, failover_timeout_s=30.0,
+                              lease_ttl_s=10.0)
+    be = be2 = None
+    try:
+        be = RemoteBackend(",".join(peers),
+                           policy=RetryPolicy(deadline_s=10.0))
+        tx = be.transaction(True)
+        tx.set(b"/k/x", b"vx")
+        tx.commit()
+        time.sleep(0.2)
+        # freeze replica 2: sever its repl link for good — its closed
+        # timestamp stops advancing, but it still serves connections
+        link = next(ln for ln in srvs[0].repl.links
+                    if ln.addr_str == peers[2])
+        link.stop()
+        time.sleep(0.3)
+        tx = be.transaction(True)
+        tx.set(b"/k/y", b"vy")  # ships to replica 1 only
+        tx.commit()
+        time.sleep(0.2)
+        pool = be.pool
+        # pin on the FRESH replica (index 1): floor rises past the
+        # frozen replica's closed timestamp
+        pool._f_rr = 0  # candidates [1, 2]
+        c, snap, closed, follower = pool.lease_follower_snapshot(60.0)
+        assert follower and c.follower_i == 1
+        assert c.call(["get", b"/k/y", snap]) == b"vy"
+        c.call(["rel", snap])
+        pool.follower_release(c)
+        floor_before = pool.follower_floor[0]
+        assert floor_before >= srvs[2].closed_ts
+        # steer at the FROZEN replica: the raw 60s bound passes on it,
+        # but the session floor forces a typed rejection and the lease
+        # comes back from a node that can prove the floor
+        base_rej = srvs[2].counters.get(
+            "follower_reads_rejected_stale", 0
+        )
+        pool._f_rr = 1  # candidates [2, 1]
+        c, snap, closed2, follower = pool.lease_follower_snapshot(60.0)
+        assert closed2 >= floor_before, "session went back in time"
+        assert getattr(c, "follower_i", None) != 2
+        assert c.call(["get", b"/k/y", snap]) == b"vy"
+        assert srvs[2].counters.get(
+            "follower_reads_rejected_stale", 0
+        ) > base_rej, "frozen replica never saw the floor rejection"
+        c.call(["rel", snap])
+        if follower:
+            pool.follower_release(c)
+        else:
+            pool.release(c)
+        # contrast: a NEW session (floor zero) may legally serve from
+        # the frozen replica — and legally misses /k/y (bounded-stale,
+        # typed, within its requested 60s bound)
+        be2 = RemoteBackend(",".join(peers),
+                            policy=RetryPolicy(deadline_s=10.0))
+        be2.pool._f_rr = 1  # candidates [2, 1]
+        c, snap, _closed, follower = \
+            be2.pool.lease_follower_snapshot(60.0)
+        assert follower and c.follower_i == 2
+        assert c.call(["get", b"/k/x", snap]) == b"vx"
+        assert c.call(["get", b"/k/y", snap]) is None
+        c.call(["rel", snap])
+        be2.pool.follower_release(c)
+    finally:
+        if be is not None:
+            be.close()
+        if be2 is not None:
+            be2.close()
+        _stop(srvs)
+
+
+def test_follower_serving_through_primary_sigkill():
+    """The failover acceptance shape: follower reads keep serving WHILE
+    the primary is dead (every value exact — acked writes are on every
+    attached replica), and after the new primary heals the group, new
+    writes are follower-readable: zero stale answers end to end."""
+    srvs, peers = _boot_group(3, failover_timeout_s=1.0,
+                              lease_ttl_s=0.8)
+    be = None
+    try:
+        be = RemoteBackend(
+            ",".join(peers),
+            policy=RetryPolicy(deadline_s=15.0, base_ms=25,
+                               max_ms=400),
+        )
+        expect = {}
+        tx = be.transaction(True)
+        for i in range(16):
+            k = f"/k/pre{i}".encode()
+            expect[k] = f"v{i}".encode()
+            tx.set(k, expect[k])
+        tx.commit()
+        # hard-kill the primary mid-service
+        srvs[0].kill()
+        t_kill = time.monotonic()
+        outage_serves = 0
+        while time.monotonic() - t_kill < 4.0:
+            tx = None
+            try:
+                tx = be.transaction(False, max_staleness=60.0)
+                for k, v in expect.items():
+                    got = tx.get(k)
+                    assert got == v, (
+                        f"stale/lost answer during outage: {k} -> {got}"
+                    )
+                if tx.follower:
+                    outage_serves += 1
+                tx.commit()
+            except (RetryableKvError, SdbError, OSError):
+                if tx is not None and not tx.done:
+                    tx.cancel()
+            time.sleep(0.1)
+        assert outage_serves > 0, (
+            "no follower read served during the failover window"
+        )
+        # wait for promotion, then prove fresh writes follower-read
+        deadline = time.monotonic() + 15.0
+        new_primary = None
+        while time.monotonic() < deadline:
+            for s in srvs[1:]:
+                if s.role == "primary":
+                    new_primary = s
+            if new_primary:
+                break
+            time.sleep(0.2)
+        assert new_primary is not None, "no replica promoted"
+        tx = None
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                tx = be.transaction(True)
+                tx.set(b"/k/post", b"vpost")
+                tx.commit()
+                break
+            except (RetryableKvError, SdbError, OSError):
+                if tx is not None and not tx.done:
+                    tx.cancel()
+                time.sleep(0.2)
+        # zero stale answers after heal: the healed group serves the
+        # post-failover write within a tight bound (follower or
+        # fallback — either way the VALUE must be exact)
+        deadline = time.monotonic() + 10.0
+        seen = None
+        while time.monotonic() < deadline:
+            try:
+                tx = be.transaction(False, max_staleness=2.0)
+                seen = tx.get(b"/k/post")
+                tx.commit()
+                if seen == b"vpost":
+                    break
+            except (RetryableKvError, SdbError, OSError):
+                pass
+            time.sleep(0.2)
+        assert seen == b"vpost"
+    finally:
+        if be is not None:
+            be.close()
+        _stop(srvs)
+
+
+# ---------------------------------------------------------------------------
+# SQL surface: READ AT + INFO FOR SYSTEM
+# ---------------------------------------------------------------------------
+
+
+def test_read_at_sql_over_replica_set():
+    from surrealdb_tpu import Datastore
+
+    srvs, peers = _boot_group(3)
+    ds = None
+    try:
+        ds = Datastore(f"remote://{','.join(peers)}")
+        ds.query("CREATE t:1 SET v = 1; CREATE t:2 SET v = 2",
+                 ns="a", db="b")
+        rows = ds.query("SELECT v FROM t ORDER BY v READ AT 30s",
+                        ns="a", db="b")[0]
+        assert rows == [{"v": 1}, {"v": 2}]
+        assert sum(s.counters.get("follower_reads_served", 0)
+                   for s in srvs) > 0
+        # client-side telemetry + INFO FOR SYSTEM replication section
+        assert ds.telemetry.get("follower_reads_served") > 0
+        info = ds.query("INFO FOR SYSTEM", ns="a", db="b")[0]
+        repl = info["replication"]
+        assert repl["counters"]["follower_reads_served"] > 0
+        # remote:// is one group: the backend's info IS the group map
+        assert repl["groups"]["floor_closed_ts"] > 0
+        assert len(repl["groups"]["observed"]) >= 1
+        # exact reads stay byte-identical and primary-served
+        base = sum(s.counters.get("follower_reads_served", 0)
+                   for s in srvs)
+        rows2 = ds.query("SELECT v FROM t ORDER BY v", ns="a", db="b")[0]
+        assert rows2 == rows
+        assert sum(s.counters.get("follower_reads_served", 0)
+                   for s in srvs) == base
+    finally:
+        if ds is not None:
+            ds.close()
+        _stop(srvs)
+
+
+def test_read_at_rejected_inside_explicit_txn():
+    from surrealdb_tpu import Datastore
+
+    ds = Datastore("pymem")
+    try:
+        out = ds.execute(
+            "BEGIN; SELECT * FROM t READ AT 5s; COMMIT;",
+            ns="a", db="b",
+        )
+        errs = [r.error for r in out if r.error]
+        assert any("READ AT" in e for e in errs), out
+    finally:
+        ds.close()
+
+
+def test_read_at_requires_duration():
+    from surrealdb_tpu import Datastore
+
+    ds = Datastore("pymem")
+    try:
+        out = ds.execute("SELECT * FROM t READ AT 'soon'",
+                         ns="a", db="b")
+        assert out[-1].error is not None
+        assert "duration" in out[-1].error
+    finally:
+        ds.close()
+
+
+def test_session_default_staleness():
+    """Session-level max_staleness applies to SELECTs that carry no
+    explicit READ AT (the SDK/server knob)."""
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu.kvs.ds import Session
+
+    srvs, peers = _boot_group(3)
+    ds = None
+    try:
+        ds = Datastore(f"remote://{','.join(peers)}")
+        ds.query("CREATE t:1 SET v = 7", ns="a", db="b")
+        sess = Session(ns="a", db="b", auth_level="owner")
+        sess.max_staleness = 30.0
+        out = ds.execute("SELECT v FROM t", session=sess)
+        assert out[-1].error is None
+        assert out[-1].result == [{"v": 7}]
+        assert sum(s.counters.get("follower_reads_served", 0)
+                   for s in srvs) > 0
+    finally:
+        if ds is not None:
+            ds.close()
+        _stop(srvs)
+
+
+def test_sharded_knn_and_scan_follower_reads():
+    """The read-scaling unlock end to end: on a replicated SHARDED
+    cluster, a `READ AT` KNN scatter-gather and a cross-shard scan are
+    served through the groups' REPLICAS, byte-identical to the exact
+    primary-served answers."""
+    import numpy as np
+
+    from surrealdb_tpu import Datastore
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.kvs.api import serialize
+    from surrealdb_tpu.val import RecordId
+    from tests.shard_harness import sharded_cluster
+
+    def hek(i):
+        return K.ix_state("z", "z", "pts", "ix", b"he", K.enc_value(i))
+
+    rng = np.random.default_rng(5)
+    n, dim, k = 120, 8, 5
+    xs = rng.normal(size=(n, dim)).astype(np.float32)
+    q = rng.normal(size=dim).astype(np.float32)
+    with sharded_cluster([hek(n // 2)], members_per_group=3) as \
+            (server_groups, meta_addr):
+        ds = Datastore(f"shard://{meta_addr}")
+        try:
+            ds.query(
+                f"DEFINE TABLE pts; DEFINE INDEX ix ON pts FIELDS emb "
+                f"HNSW DIMENSION {dim} DIST EUCLIDEAN TYPE F32",
+                ns="z", db="z",
+            )
+            txn = ds.transaction(write=True)
+            for i in range(n):
+                txn.set(K.record("z", "z", "pts", i),
+                        serialize({"id": RecordId("pts", i)}))
+                txn.set_val(hek(i), xs[i].tobytes())
+            txn.set_val(K.ix_state("z", "z", "pts", "ix", b"vn"), n)
+            txn.commit()
+            sql = ("SELECT id, vector::distance::knn() AS d FROM pts "
+                   f"WHERE emb <|{k}|> $q")
+            exact = ds.execute(sql, ns="z", db="z",
+                               vars={"q": q.tolist()})[-1]
+            assert exact.error is None
+            want = [(str(r["id"]), r["d"]) for r in exact.result]
+            base = sum(s.counters.get("follower_reads_served", 0)
+                       for grp in server_groups for s in grp)
+            stale = ds.execute(sql + " READ AT 60s", ns="z", db="z",
+                               vars={"q": q.tolist()})[-1]
+            assert stale.error is None, stale.error
+            assert stale.partial is None
+            got = [(str(r["id"]), r["d"]) for r in stale.result]
+            assert got == want, "follower-served KNN diverged"
+            served = sum(s.counters.get("follower_reads_served", 0)
+                         for grp in server_groups for s in grp) - base
+            assert served > 0, "no replica served the READ AT KNN"
+            # cross-shard scan through replicas, byte-identical too
+            rows = ds.query("SELECT VALUE id FROM pts ORDER BY id "
+                            "LIMIT 10 READ AT 60s", ns="z", db="z")[0]
+            rows2 = ds.query("SELECT VALUE id FROM pts ORDER BY id "
+                             "LIMIT 10", ns="z", db="z")[0]
+            assert rows == rows2
+        finally:
+            ds.close()
+
+
+def test_replica_adopts_replicated_shard_config():
+    """Regression for the bug the follower-read sim work exposed:
+    replicas applied the replicated \\x00!shardcfg ROW but never
+    adopted it into the in-memory fence (`engine.shard`) — that only
+    happened at construction or promotion. A serving replica therefore
+    (a) failed every epoch proof (epoch=None) and (b) never
+    range-fenced follower reads. The stream must update the fence
+    continuously, exactly like the staged-2PC mirror."""
+    srvs, peers = _boot_group(3)
+    be = None
+    try:
+        be = RemoteBackend(",".join(peers))
+        be.pool.call(["shard_set", b"", b"/m", 7])
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if all(s.shard == (b"", b"/m", 7) for s in srvs):
+                break
+            time.sleep(0.05)
+        for s in srvs:
+            assert s.shard == (b"", b"/m", 7), (
+                f"{s.advertise} ({s.role}) never adopted the "
+                f"replicated shard config: {s.shard!r}"
+            )
+        # and the follower proof can now prove the routing epoch
+        tx = be.transaction(True)
+        tx.set(b"/k/1", b"v1")
+        tx.commit()
+        tx = be.transaction(False, max_staleness=30.0)
+        assert tx.follower
+        assert tx.get(b"/k/1") == b"v1"
+        tx.commit()
+    finally:
+        if be is not None:
+            be.close()
+        _stop(srvs)
+
+
+def test_primary_fallback_raises_session_floor():
+    """Review regression: a bounded-stale read served via the PRIMARY
+    fallback still OBSERVES that prefix — the session floor must rise,
+    or a later replica pin could legally serve an older prefix
+    (non-monotone within one session)."""
+    srvs, peers = _boot_group(3)
+    be = None
+    try:
+        be = RemoteBackend(",".join(peers))
+        tx = be.transaction(True)
+        tx.set(b"/k/f", b"vf")
+        tx.commit()
+        pool = be.pool
+        # freeze BOTH replicas' proofs by severing their repl links:
+        # every candidate rejects once the floor/bound outgrow their
+        # frozen closed, so the pin falls back to the primary
+        for ln in list(srvs[0].repl.links):
+            ln.stop()
+        time.sleep(0.2)
+        tx = be.transaction(True)
+        tx.set(b"/k/g", b"vg")  # unreplicated... needs a replica!
+        # 3-member group: the durability gate refuses unreplicated
+        # writes — cancel, the floor test only needs a fallback READ
+        tx.cancel()
+        floor0 = pool.follower_floor[0]
+        c, snap, closed, follower = pool.lease_follower_snapshot(0.0)
+        # staleness 0: requested == now, no replica can prove it
+        assert not follower, "a frozen replica should not have served"
+        assert pool.follower_floor[0] >= closed > floor0
+        c.call(["rel", snap])
+        pool.release(c)
+    finally:
+        if be is not None:
+            be.close()
+        _stop(srvs)
+
+
+def test_read_at_subquery_is_typed_error():
+    """Review regression: READ AT evaluates txn-free — a subquery
+    argument must be a TYPED statement error, not an internal
+    AttributeError escaping the envelope."""
+    from surrealdb_tpu import Datastore
+
+    ds = Datastore("pymem")
+    try:
+        ds.query("CREATE p:1 SET x = 1", ns="a", db="b")
+        out = ds.execute("SELECT * FROM p READ AT (SELECT x FROM p)",
+                         ns="a", db="b")
+        assert out[-1].error is not None
+        assert "Internal error" not in out[-1].error, out[-1].error
+        assert "READ AT" in out[-1].error or "duration" in out[-1].error
+    finally:
+        ds.close()

@@ -137,6 +137,54 @@ def test_asymmetric_partition_heals_in_sim():
     assert "ev=promote" in "\n".join(res.trace)
 
 
+def test_follower_reads_exercised_and_bit_reproducible():
+    """The follower-read workload runs inside the chaos sim (replicas
+    actually serve), and the observation log is a pure function of the
+    seed — any staleness violation is replayable."""
+    a = run_sim(7)
+    assert a.ok, (a.violations[:4], a.errors[:2])
+    assert a.stats["follower_reads"] > 0
+    assert a.stats["follower_served"] > 0, (
+        "no replica ever served a follower read — the sweep is "
+        "proving the fallback path, not the protocol"
+    )
+    b = run_sim(7)
+    assert a.follower_log == b.follower_log
+    assert a.trace_digest == b.trace_digest
+
+
+def test_follower_lag_scenario_rejects_stale_replica():
+    """Scripted closed-timestamp scenario: a replica partitioned from
+    the primary cannot prove the bound once acked writes outlive it —
+    it rejects typed, the healthy replica serves, every observation is
+    exact."""
+    from surrealdb_tpu.sim.harness import run_follower_lag_sim
+
+    res = run_follower_lag_sim(31337)
+    assert res.ok, (res.violations[:4], res.errors[:2])
+    assert res.stats["rejected_by"]["g0m1"] > 0, (
+        "the frozen replica never rejected — the proof was not "
+        "exercised"
+    )
+    assert res.stats["served_by"]["g0m1"] == 0
+    assert res.stats["served_by"]["g0m2"] > 0
+    got = {k: g for _s, k, g, _r in res.follower_log}
+    assert got == {b"/k/old": b"v-old", b"/k/new": b"v-new"}
+
+
+def test_follower_proof_mutation_caught_by_invariant():
+    """Mutation test: disable the closed-timestamp check
+    (cnf.KV_FOLLOWER_PROOF_DISABLED) — the frozen replica now serves
+    its stale prefix and check_follower_reads MUST flag the
+    beyond-bound answer. Proves the invariant has teeth."""
+    from surrealdb_tpu.sim.harness import run_follower_lag_sim
+
+    res = run_follower_lag_sim(31337, proof_disabled=True)
+    assert not res.ok, "the disabled proof went undetected"
+    assert any("FOLLOWER STALE BEYOND BOUND" in v
+               for v in res.violations), res.violations[:4]
+
+
 @pytest.mark.slow
 def test_randomized_sweep_200_seeds():
     """The broad sweep: 200 random seeds of full-config chaos, every

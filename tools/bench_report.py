@@ -1,0 +1,106 @@
+"""Roll bench JSON lines up into the per-round BENCH_r0N.json artifact.
+
+The r1-r5 rounds each left a `BENCH_r0N.json` ({n, cmd, rc, tail,
+parsed}) so the perf trajectory is machine-readable next to the repo;
+r6-r10 only emitted `.jsonl` lines (or prose in CHANGES.md). This tool
+restores the artifact: it gathers bench metric lines — from existing
+.jsonl files, from stdin, or by RUNNING bench.py with the given args —
+and writes `BENCH_r{N}.json` in the same shape as the early rounds.
+
+Usage:
+    python tools/bench_report.py --round 11 --run "--config knn1m --quick"
+    python tools/bench_report.py --round 11 --input BENCH_CPU_QUICK_r5.jsonl
+    python bench.py --quick | python tools/bench_report.py --round 11 --stdin
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+
+def _parse_lines(lines):
+    """Bench metric lines are single-line JSON objects with a `metric`
+    key; everything else (probe chatter, tracebacks) goes to `tail`."""
+    parsed, tail = [], []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                tail.append(line)
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                parsed.append(obj)
+                continue
+        tail.append(line)
+    return parsed, tail
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, required=True,
+                    help="round number N -> writes BENCH_r{N:02d}.json")
+    ap.add_argument("--input", action="append", default=[],
+                    help=".jsonl file(s) of bench metric lines")
+    ap.add_argument("--stdin", action="store_true",
+                    help="read metric lines from stdin")
+    ap.add_argument("--run", default=None,
+                    help="arguments to run `python bench.py <args>` "
+                         "with, capturing its metric lines")
+    ap.add_argument("--out-dir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."))
+    args = ap.parse_args(argv)
+
+    parsed, tail = [], []
+    cmds = []
+    rc = 0
+    for path in args.input:
+        with open(path, encoding="utf-8") as f:
+            p, t = _parse_lines(f)
+        parsed += p
+        tail += t
+        cmds.append(f"cat {path}")
+    if args.stdin:
+        p, t = _parse_lines(sys.stdin)
+        parsed += p
+        tail += t
+        cmds.append("stdin")
+    if args.run is not None:
+        cmd = [sys.executable, "bench.py"] + args.run.split()
+        cmds.append(" ".join(cmd))
+        proc = subprocess.run(
+            cmd, cwd=args.out_dir, capture_output=True, text=True,
+        )
+        rc = proc.returncode
+        p, t = _parse_lines(proc.stdout.splitlines())
+        parsed += p
+        tail += t + [ln for ln in proc.stderr.splitlines()[-10:] if ln]
+    if not parsed and not tail:
+        print("bench_report: no input (use --input/--stdin/--run)",
+              file=sys.stderr)
+        return 2
+    out = {
+        "n": args.round,
+        "cmd": " && ".join(cmds),
+        "rc": rc,
+        "tail": "\n".join(tail[-30:]),
+        "parsed": parsed,
+    }
+    dest = os.path.join(args.out_dir, f"BENCH_r{args.round:02d}.json")
+    with open(dest, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(f"bench_report: wrote {os.path.normpath(dest)} "
+          f"({len(parsed)} metric line(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

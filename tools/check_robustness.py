@@ -81,6 +81,19 @@ re-litigating:
    `_admit`/`mem_used`) are existence-asserted, so refactoring one
    away without updating the tables is itself a finding.
 
+10. **Every replica-side read-serving path goes through the
+   closed-timestamp proof** — follower reads (`kvs/remote.py`): the
+   proof (`follower_read_proof`) and the gate that scopes which ops a
+   non-primary may serve (`_follower_read_allowed`) must exist
+   (existence-asserted + rename-proof, like rules 6-9), `_dispatch`
+   must call BOTH (the snap pin runs the proof; the read gate guards
+   the primary-reads fence), `_follower_read_allowed` must reference
+   the proof-registered snapshot set (`fsnaps`) and may only ever
+   admit `get`/`range` — adding `snap`, `get_latest`, or
+   `shard_items` to the follower-served set is exactly the
+   stale-snapshots-forever hole PR 5 closed, and trips the checker
+   until someone re-argues it with a pragma.
+
 Usage:  python tools/check_robustness.py [root]
 Exit status 1 when any finding survives.
 """
@@ -209,6 +222,14 @@ _MEM_ALLOW = {
     ("surrealdb_tpu/device/annstore.py", "cfg"),  # dict(cfg) copy
     ("surrealdb_tpu/device/vecstore.py", "cfg"),
 }
+
+# rule 10: the follower-read proof contract (kvs/remote.py). The named
+# functions must exist, _dispatch must invoke both, and the read gate
+# may only ever admit these ops to the follower-served path.
+_FOLLOWER_FILE = "surrealdb_tpu/kvs/remote.py"
+_FOLLOWER_FNS = ("follower_read_proof", "_follower_read_allowed",
+                 "_dispatch")
+_FOLLOWER_OPS_OK = {"get", "range"}
 
 # rule 5: the only places inside the package allowed to import jax —
 # the supervised runner tree and the kernel library it dispatches to
@@ -373,6 +394,60 @@ def _check_knn_fns(tree, rel, lines) -> list[str]:
             f"scatter-gather KNN contract is no longer being checked "
             f"(update the rule-8 tables after a rename)"
         )
+    return findings
+
+
+def _check_follower_fns(tree, rel, lines) -> list[str]:
+    """Rule 10: the closed-timestamp follower-read contract. The proof
+    and the read gate exist, _dispatch calls both, the gate checks the
+    proof-registered snapshot set, and only get/range may ever be
+    admitted to the follower-served path."""
+    findings = []
+    fns = {n.name: n for n in ast.walk(tree)
+           if isinstance(n, ast.FunctionDef)}
+    for name in _FOLLOWER_FNS:
+        if name not in fns:
+            findings.append(
+                f"{rel}:1: rule-10 function `{name}` not found — the "
+                f"follower-read proof contract is no longer being "
+                f"checked (update the rule-10 table after a rename)"
+            )
+    gate = fns.get("_follower_read_allowed")
+    if gate is not None:
+        for sub in ast.walk(gate):
+            if not isinstance(sub, ast.Compare):
+                continue
+            for n2 in ast.walk(sub):
+                if isinstance(n2, ast.Constant) \
+                        and isinstance(n2.value, str) \
+                        and n2.value not in _FOLLOWER_OPS_OK \
+                        and not _pragma(lines, n2.lineno):
+                    findings.append(
+                        f"{rel}:{n2.lineno}: op {n2.value!r} admitted "
+                        f"to the follower-served read path — only "
+                        f"get/range may serve against a proof-pinned "
+                        f"snapshot (rule 10: a follower-served `snap`/"
+                        f"`get_latest` is the stale-forever hole PR 5 "
+                        f"closed)"
+                    )
+        if not any(isinstance(n2, ast.Attribute) and n2.attr == "fsnaps"
+                   for n2 in ast.walk(gate)):
+            findings.append(
+                f"{rel}:{gate.lineno}: _follower_read_allowed no "
+                f"longer checks the proof-registered snapshot set "
+                f"(fsnaps) — a replica would serve reads against "
+                f"snapshots that never passed the closed-timestamp "
+                f"proof (rule 10)"
+            )
+    disp = fns.get("_dispatch")
+    if disp is not None:
+        for req in ("_follower_read_allowed", "follower_read_proof"):
+            if not _calls_attr(disp, req):
+                findings.append(
+                    f"{rel}:{disp.lineno}: _dispatch never calls "
+                    f"`{req}()` — replica-side reads are being served "
+                    f"outside the closed-timestamp proof (rule 10)"
+                )
     return findings
 
 
@@ -552,6 +627,9 @@ def check_file(path: str, rel: str) -> list[str]:
     # 8. scatter-gather KNN serving contract
     if rel_fwd == _KNN_FILE:
         findings.extend(_check_knn_fns(tree, rel, lines))
+    # 10. follower reads stay behind the closed-timestamp proof
+    if rel_fwd == _FOLLOWER_FILE:
+        findings.extend(_check_follower_fns(tree, rel, lines))
     # 9. memory-accounting coverage
     if any(rel_fwd.startswith(p) for p in _MEM_SCAN_PREFIXES) \
             or rel_fwd in _MEM_SCAN_FILES:
