@@ -619,6 +619,7 @@ class TpuVectorIndex:
                     return self._host_knn_single(qv, k)
             if self._use_device():
                 return self.coalescer.search(qv, k)
+            # lint: lock-held(read-side hold is the array-swap guard vs sync's rw.write; a device dispatch inside is bounded by the supervisor call timeout + degrade circuit, and eviction is already pin-gated)
             with self.rw.read():
                 return self.knn_batch(np.asarray(qv)[None, :], k)[0]
         finally:

@@ -62,6 +62,7 @@ def dec_bytes(buf: bytes, pos: int) -> tuple[bytes, int]:
     n = len(buf)
     out2 = None
     cur = pos
+    # lint: deadline(cursor-bounded codec loop: find advances cur monotonically over an in-memory buffer or raises)
     while True:
         i = buf.find(0, cur)
         if i < 0:
@@ -235,18 +236,21 @@ def dec_value(buf: bytes, pos: int = 0):
         return Uuid(_uuid.UUID(bytes=buf[pos : pos + 16])), pos + 16
     if tag == TAG_ARRAY:
         out = []
+        # lint: deadline(cursor-bounded codec loop: each dec_* advances pos over an in-memory buffer or raises on corrupt input)
         while buf[pos] != TAG_END:
             v, pos = dec_value(buf, pos)
             out.append(v)
         return out, pos + 1
     if tag == TAG_SET:
         out = []
+        # lint: deadline(cursor-bounded codec loop: each dec_* advances pos over an in-memory buffer or raises on corrupt input)
         while buf[pos] != TAG_END:
             v, pos = dec_value(buf, pos)
             out.append(v)
         return SSet(out), pos + 1
     if tag == TAG_OBJECT:
         out = {}
+        # lint: deadline(cursor-bounded codec loop: each dec_* advances pos over an in-memory buffer or raises on corrupt input)
         while buf[pos] != TAG_END:
             k, pos = dec_str(buf, pos)
             v, pos = dec_value(buf, pos)

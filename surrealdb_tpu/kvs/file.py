@@ -111,6 +111,7 @@ class FileBackend(Backend):
                 with open(tmp, "wb") as f:
                     pickle.dump(dict(self.vs.latest_items()), f,
                                 protocol=5)
+                    # lint: lock-held(checkpoint durability: the snapshot must be fsynced before the WAL it truncates is dropped, all under the commit lock that orders them)
                     self._sync_snapshot(f)
                 os.replace(tmp, self.snap_path)
                 self.wal.close()

@@ -385,6 +385,7 @@ class LsmBackend(Backend):
 
             pickle.dump(writes, self.wal, protocol=5)
             self.wal.flush()
+            # lint: lock-held(ack-after-fsync: the frame must be durable under the same lock that orders commits, or a crash could ack a reordered log)
             os.fsync(self.wal.fileno())
             self.seq += 1
             seq = self.seq

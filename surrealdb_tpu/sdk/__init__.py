@@ -191,6 +191,7 @@ class WsEngine:
         else:
             header += struct.pack("!BQ", 0x80 | 127, n)
         data = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        # lint: lock-held(this lock's only job is serializing frame writes on the client socket; no shared engine state is guarded by it)
         with self._lock:
             self.sock.sendall(header + mask + data)
 

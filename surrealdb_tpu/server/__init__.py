@@ -676,6 +676,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
         return header + data
 
     def _ws_send(self, payload):
+        # lint: lock-held(per-connection write mutex: it exists only to keep WS frames whole on this socket; nothing else waits on it)
         with self._ws_lock:
             self.connection.sendall(self._ws_frame(payload))
 
@@ -741,6 +742,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
                     }
                 }))
             # burst coalescing: one sendall for the whole batch
+            # lint: lock-held(per-connection write mutex: frame atomicity on this socket only)
             with self._ws_lock:
                 self.connection.sendall(bytes(frames))
 
@@ -770,6 +772,7 @@ class SurrealHandler(BaseHTTPRequestHandler):
                 if opcode == 0x8:  # close
                     break
                 if opcode == 0x9:  # ping -> pong
+                    # lint: lock-held(per-connection write mutex: frame atomicity on this socket only)
                     with self._ws_lock:
                         self.connection.sendall(
                             b"\x8a" + struct.pack("!B", len(data)) + data

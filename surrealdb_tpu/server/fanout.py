@@ -560,7 +560,10 @@ class FanoutHub:
         q = self._queues[i]
         wcond = self._wconds[i]
         while True:
-            with self._qcond:
+            # hold the condition we wait on — wcond wraps the shared
+            # _qlock, so this is the same mutual exclusion as _qcond,
+            # and the wait visibly releases the lock it holds
+            with wcond:
                 while not q and not self._stopped:
                     wcond.wait()
                 if self._stopped and not q:

@@ -412,6 +412,7 @@ class SSet:
         for x in items or []:
             lo, hi = 0, len(out)
             # binary insert by value order, skipping duplicates
+            # lint: deadline(binary search: hi-lo halves every iteration)
             while lo < hi:
                 mid = (lo + hi) // 2
                 c = value_cmp(out[mid], x)

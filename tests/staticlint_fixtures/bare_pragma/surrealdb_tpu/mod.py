@@ -1,0 +1,20 @@
+"""Fixture: waiver pragmas that carry no reason — each is a finding."""
+
+
+def swallow():
+    try:
+        return 1 / 0
+    except ZeroDivisionError:  # robust:
+        return None
+
+
+def loop():
+    i = 0
+    # lint: deadline()
+    while i >= 0:
+        i += 1
+
+
+def typo():
+    # lint: lock-held missing-parens
+    return 3

@@ -1,0 +1,15 @@
+"""Fixture: a streaming operator whose _execute loops deadline-free.
+
+Both the legacy rule (stream-deadline) and the whole-program deadline
+propagation must flag it.
+"""
+
+
+class DrainOp:
+    def _execute(self, ctx):
+        rows = []
+        while True:
+            batch = self.child.pull()
+            if not batch:
+                return rows
+            rows.extend(batch)

@@ -1,0 +1,37 @@
+"""Fixture: a two-lock order cycle, half of it interprocedural.
+
+`fwd` takes A.lock then (through a call) B.lock; `rev` takes B.lock
+then A.lock directly. The lock-order graph must contain the cycle
+A.lock -> B.lock -> A.lock and report it with both witnesses.
+"""
+
+import threading
+
+
+class A:
+    def __init__(self):
+        self.lock = threading.Lock()
+
+
+class B:
+    def __init__(self):
+        self.lock = threading.Lock()
+
+
+class Pair:
+    def __init__(self):
+        self.a = A()
+        self.b = B()
+
+    def _grab_b(self):
+        with self.b.lock:
+            return 1
+
+    def fwd(self):
+        with self.a.lock:
+            return self._grab_b()   # A held, B acquired in the callee
+
+    def rev(self):
+        with self.b.lock:
+            with self.a.lock:       # B held, A acquired inline
+                return 2

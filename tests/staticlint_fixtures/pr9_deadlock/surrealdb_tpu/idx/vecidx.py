@@ -1,0 +1,21 @@
+"""Fixture: the exact PR-9 deadlock shape.
+
+`vector_index_update` holds `ds.lock` across a remote `vn` read — the
+parked-writer deadlock the DST sim needed a lucky fault schedule to
+reach. The blocking-under-lock analysis must flag the `tx.get` call:
+it resolves to RemoteTx.get, which reaches `sock.recv`.
+"""
+
+from surrealdb_tpu.kvs.remotekv import RemoteTx
+
+
+class TpuVectorIndex:
+    def __init__(self, ds, sock):
+        self.ds = ds
+        self.tx = RemoteTx(sock)
+
+    def vector_index_update(self, rid, vec):
+        with self.ds.lock:
+            vn = self.tx.get(b"vn")  # remote KV read under ds.lock
+            self.rows = {rid: (vn, vec)}
+            return vn

@@ -1,0 +1,14 @@
+"""Fixture: a minimal remote-KV client whose read blocks on a socket.
+
+Mirrors the RemoteTx shape so the blocking-propagation summaries mark
+`RemoteTx.get` as reaching a socket recv.
+"""
+
+
+class RemoteTx:
+    def __init__(self, sock):
+        self.sock = sock
+
+    def get(self, key):
+        self.sock.sendall(b"get " + key)
+        return self.sock.recv(65536)

@@ -1,0 +1,5 @@
+"""Fixture: a clean module — the interesting part is the baseline."""
+
+
+def nothing_to_see():
+    return 42

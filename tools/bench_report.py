@@ -82,7 +82,7 @@ def main(argv=None) -> int:
         p, t = _parse_lines(proc.stdout.splitlines())
         parsed += p
         tail += t + [ln for ln in proc.stderr.splitlines()[-10:] if ln]
-    if not parsed and not tail:
+    if not cmds:
         print("bench_report: no input (use --input/--stdin/--run)",
               file=sys.stderr)
         return 2
@@ -93,6 +93,10 @@ def main(argv=None) -> int:
         "tail": "\n".join(tail[-30:]),
         "parsed": parsed,
     }
+    if not parsed:
+        # an empty round (bench produced no fresh metric lines) still
+        # writes its artifact so the BENCH_r0N series stays contiguous
+        out["no_new_lines"] = True
     dest = os.path.join(args.out_dir, f"BENCH_r{args.round:02d}.json")
     with open(dest, "w", encoding="utf-8") as f:
         json.dump(out, f, indent=2)

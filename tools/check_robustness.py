@@ -1,696 +1,70 @@
-"""Static robustness pass (run standalone or from the conformance gate).
+"""Static robustness pass — compatibility shim over tools/staticlint.
 
-Enforces the overload-protection invariants that code review keeps
-re-litigating:
+The ten per-file rules this script used to implement (bare except,
+non-daemon threads, streaming-operator deadlines, 2PC swallows, the
+kvs/net.py seam, jax-import containment, the fan-out delivery contract,
+scatter-gather KNN discipline, memory-accounting coverage, and the
+follower-read proof) now live in `tools/staticlint/legacy.py`, running
+on a single shared parse per file. On top of them staticlint adds the
+whole-program analyses (lock-order graph, blocking-under-lock,
+deadline propagation) and the fail-closed baseline + pragma audit.
 
-1. **No bare `except:`** anywhere in `surrealdb_tpu/` — a bare handler
-   swallows KeyboardInterrupt/SystemExit and, worse, the cooperative
-   QueryCancelled/QueryTimeout signals the robustness layer depends on.
-2. **No non-daemon `Thread(...)`** without an explicit join path — a
-   forgotten non-daemon thread blocks process exit and defeats SIGTERM
-   drain. `daemon=True`, or a `# robust: joined` pragma on the call
-   line for threads with a managed join, satisfies the check.
-3. **No `check_deadline`-free streaming operators** — every `*Op` class
-   in `exec/stream.py` whose `_execute` loops must either call
-   `ctx.check_deadline()` itself or drain a child's `.execute(ctx)`
-   (which propagates to a deadline-checking scan). Otherwise a new
-   operator silently reopens the unbounded-loop hole.
-4. **No silent swallows in 2PC decision paths** — in `kvs/shard.py` and
-   `kvs/remote.py`, an `except` whose body is a bare `pass` inside any
-   function named like a decision step (commit/prepare/decide/resolve/
-   mark/split) hides a stuck or diverging two-phase commit. Record a
-   telemetry counter, re-raise, or carry a `# robust:` pragma stating
-   why the swallow is safe.
-5. **No raw clock/socket calls in the distributed stack** (rule 6,
-   listed here out of order) — `kvs/remote.py`, `kvs/shard.py`, and
-   `node.py` must take every wall-clock read, sleep, and socket through
-   the simulation seam (`kvs/net.py`: Clock/Runtime/Transport). A raw
-   `time.time()` / `time.sleep()` / `socket.socket(` /
-   `socket.create_connection(` in those files silently escapes the
-   deterministic simulator — the fault schedule can no longer reorder
-   or virtualize it, so whole interleavings become untestable. The
-   seam module itself is the allowlisted real implementation.
-6. **No `import jax` reachable from a query worker thread** — jax may
-   only be imported under `surrealdb_tpu/device/` (the supervised
-   runner that owns all accelerator state), `surrealdb_tpu/parallel/`
-   and `surrealdb_tpu/ops/` (the kernel library, imported exclusively
-   runner-side — query code resolves metric names via the jax-free
-   `ops/metrics.py`), and `surrealdb_tpu/ml/onnx.py` (the ONNX model
-   runtime, a documented exception pending its own runner dispatch).
-   Anywhere else — the executor, planners, indexes, graph engine,
-   server — an `import jax` puts backend init (which has wedged whole
-   rounds, ROUND5_NOTES) on a live query thread. Bench/tooling outside
-   `surrealdb_tpu/` is not scanned.
+This shim preserves the historical surface so the conformance gate and
+the pytest wiring don't churn:
 
-7. **No blocking delivery on the commit path** — the live-query fan-out
-   contract (server/fanout.py): `Datastore.notify` and the doc-pipeline
-   lives stage (`exec/document.py::notify_lives`) must never invoke a
-   notification handler or touch a socket while holding `ds.lock` /
-   `self.lock`, and must never contain a socket send at all — one
-   stalled consumer's full TCP buffer must not stall a committing
-   writer. Enforced structurally: inside those functions (plus the
-   hub's `deliver`, which `notify` delegates to), a `with ...lock:`
-   block may only call a small allowlist of queue/bookkeeping methods;
-   any other call (handler invocation `h(...)`, `.sendall`, `.send`,
-   `._ws_send`, telemetry, logging) under the lock is a finding, as is
-   a send-like call anywhere in the function. The functions' existence
-   is also asserted so a rename cannot silently retire the rule.
-
-8. **Scatter-gather KNN stays deadline-checked and lock-clean** — the
-   shard-partitioned vector router (idx/shardvec.py): `scatter_gather`
-   and `merge_topk` must call `check_deadline()` (a KILL/timeout must
-   land between per-shard dispatches, not after the whole fan-out),
-   and none of the scatter/merge/sync functions may hold a lock across
-   a remote dispatch — a `with ...lock:` block inside them may only
-   touch allowlisted bookkeeping, because a shard-map lock held across
-   a dispatch to a sick shard serializes every other query on the
-   node. The functions' existence is asserted, so a rename cannot
-   silently retire the rule (same discipline as rules 6-7).
-
-9. **Every in-memory cache is accounted** — under `surrealdb_tpu/idx/`,
-   `surrealdb_tpu/device/`, and `server/fanout.py`, any module-level or
-   `__init__`-assigned dict/list/set/OrderedDict/deque container must
-   either be covered by a memory-accountant registration
-   (`resource.register` — the engine/hub registers size+evict
-   callbacks for the state those containers hold) or sit on the
-   explicit allowlist below with its reason. New unlisted containers
-   are findings: PR 10 exists because nine PRs of unaccounted caches
-   added up to an OOM kill. Rename-proof like rules 6-8: the
-   registration functions themselves (resource.py `register`, the
-   per-holder `_mem_*` size/evict methods, the device host's
-   `_admit`/`mem_used`) are existence-asserted, so refactoring one
-   away without updating the tables is itself a finding.
-
-10. **Every replica-side read-serving path goes through the
-   closed-timestamp proof** — follower reads (`kvs/remote.py`): the
-   proof (`follower_read_proof`) and the gate that scopes which ops a
-   non-primary may serve (`_follower_read_allowed`) must exist
-   (existence-asserted + rename-proof, like rules 6-9), `_dispatch`
-   must call BOTH (the snap pin runs the proof; the read gate guards
-   the primary-reads fence), `_follower_read_allowed` must reference
-   the proof-registered snapshot set (`fsnaps`) and may only ever
-   admit `get`/`range` — adding `snap`, `get_latest`, or
-   `shard_items` to the follower-served set is exactly the
-   stale-snapshots-forever hole PR 5 closed, and trips the checker
-   until someone re-argues it with a pragma.
+    check_file(path, rel) -> list[str]   # legacy per-file rules only
+    scan(root)            -> list[str]   # the FULL gate (all analyses,
+                                         # baseline applied)
+    main([root])          -> int         # prints findings, 1 on red
 
 Usage:  python tools/check_robustness.py [root]
-Exit status 1 when any finding survives.
+        python tools/staticlint [root] [--json]   # the full CLI
+Exit status 1 when any finding survives the baseline.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
-PRAGMA = "# robust:"
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
 
-# files + function-name shape that rule 4 (2PC decision paths) covers
-_TWOPC_FILES = ("surrealdb_tpu/kvs/shard.py", "surrealdb_tpu/kvs/remote.py")
-_DECISION_FN = re.compile(r"commit|prepare|decide|resolve|mark|split")
+import staticlint  # noqa: E402
 
-# rule 6: the distributed stack goes through the kvs/net.py seam for
-# every clock read, sleep, and socket — raw calls escape the
-# deterministic simulator. (kvs/net.py IS the real implementation and
-# is therefore not scanned.)
-_SEAM_FILES = (
-    "surrealdb_tpu/kvs/remote.py",
-    "surrealdb_tpu/kvs/shard.py",
-    "surrealdb_tpu/node.py",
-)
-_SEAM_FORBIDDEN = {
-    ("time", "time"),
-    ("time", "monotonic"),
-    ("time", "sleep"),
-    ("socket", "socket"),
-    ("socket", "create_connection"),
-}
-
-# rule 7: the notify/capture/deliver functions the fan-out contract
-# covers, per file. Each must exist (a rename silently retiring the
-# rule is itself a finding).
-_NOTIFY_FNS = {
-    "surrealdb_tpu/kvs/ds.py": ("notify",),
-    "surrealdb_tpu/exec/document.py": ("notify_lives",),
-    "surrealdb_tpu/server/fanout.py": ("deliver",),
-}
-# attribute calls allowed inside a `with ...lock:` block of a rule-7
-# function: queue/bookkeeping only
-_NOTIFY_LOCK_OK = {"append", "pop", "popleft", "get", "clear",
-                   "count_for", "add", "discard"}
-# send-like attribute calls forbidden ANYWHERE in a rule-7 function
-_SEND_ATTRS = {"sendall", "send", "_ws_send", "sendto", "write"}
-
-# rule 8: the scatter-gather KNN serving paths, per file. The first
-# tuple must call check_deadline(); the union must exist AND keep
-# every `with ...lock:` block free of non-bookkeeping calls.
-_KNN_FILE = "surrealdb_tpu/idx/shardvec.py"
-_KNN_DEADLINE_FNS = ("scatter_gather", "merge_topk")
-_KNN_LOCK_FNS = ("scatter_gather", "merge_topk", "_scatter_round",
-                 "_sync_part", "refresh_parts")
-# attribute calls allowed under a lock in a rule-8 function: partition
-# bookkeeping only — anything else (pool.call, sync, scan, search)
-# could block on a remote shard while serializing every other query
-_KNN_LOCK_OK = {"append", "pop", "get", "add", "discard", "span",
-                "items", "values", "keys", "_repartition"}
-
-# rule 9: memory-accounting coverage. Scanned trees + the per-file
-# functions whose existence proves the registration is still wired
-# (resource.py is the accountant; the others are registrants).
-_MEM_SCAN_PREFIXES = ("surrealdb_tpu/idx/", "surrealdb_tpu/device/")
-_MEM_SCAN_FILES = ("surrealdb_tpu/server/fanout.py",)
-_MEM_REGISTRATION_FNS = {
-    "surrealdb_tpu/resource.py": ("register", "maybe_evict",
-                                  "checkpoint", "throttle"),
-    "surrealdb_tpu/idx/vector.py": ("_vec_mem_bytes", "_ann_mem_bytes",
-                                    "_stats_mem_bytes",
-                                    "_mem_evict_vec"),
-    "surrealdb_tpu/server/fanout.py": ("_mem_bytes", "_mem_evict"),
-    "surrealdb_tpu/device/handlers.py": ("_admit", "mem_used"),
-    "surrealdb_tpu/kvs/ds.py": ("_ft_cache_bytes", "_csr_mem_bytes",
-                                "_csr_mem_evict"),
-}
-_CONTAINER_CALLS = {"dict", "list", "set", "OrderedDict", "deque",
-                    "defaultdict"}
-# (file, container name) pairs exempt from rule 9, grouped by WHY.
-# Fail-closed: renaming a container drops it off this list and the
-# checker flags it until someone re-argues its coverage.
-_MEM_ALLOW = {
-    # -- covered by a registered account (a _mem_* / mem_used size fn
-    #    sums the bytes these containers reach; eviction drops them) ----
-    ("surrealdb_tpu/idx/vector.py", "rids"),        # vec account
-    ("surrealdb_tpu/idx/vector.py", "row_index"),   # vec account
-    ("surrealdb_tpu/idx/vector.py", "_ann_dirty"),  # ann account
-    ("surrealdb_tpu/idx/shardvec.py", "parts"),  # part engines each
-    # register their own vec/ann/rank_stats accounts
-    ("surrealdb_tpu/device/handlers.py", "vec"),      # _admit budget
-    ("surrealdb_tpu/device/handlers.py", "csr"),
-    ("surrealdb_tpu/device/handlers.py", "ann"),
-    ("surrealdb_tpu/device/handlers.py", "_staging"),
-    ("surrealdb_tpu/device/handlers.py", "_ann_staging"),
-    ("surrealdb_tpu/device/handlers.py", "_reserved"),  # mem_used sums
-    # it; entries live only between *_load_begin and *_load_end
-    ("surrealdb_tpu/server/fanout.py", "q"),        # push account +
-    ("surrealdb_tpu/server/fanout.py", "_queues"),  # LIVE_QUEUE_DEPTH /
-    # LIVE_DISPATCH_BACKLOG caps with typed overflow shedding
-    # -- bounded by construction (fixed caps / O(config) entries) --------
-    ("surrealdb_tpu/device/annstore.py", "_jit_cache"),  # shape ladder
-    ("surrealdb_tpu/device/csrstore.py", "_jit_cache"),  # shape ladder
-    ("surrealdb_tpu/device/kernelstats.py", "COUNTS"),   # per-op ints
-    ("surrealdb_tpu/device/kernelstats.py", "_SEEN"),    # shape keys
-    ("surrealdb_tpu/device/supervisor.py", "compile_counts"),  # 2 ints
-    ("surrealdb_tpu/device/supervisor.py", "counters"),  # fixed keys
-    ("surrealdb_tpu/device/supervisor.py", "_pending"),  # in-flight
-    # dispatches, bounded by callers + failed wholesale on degrade
-    ("surrealdb_tpu/device/supervisor.py", "_loaded"),   # key -> tag,
-    ("surrealdb_tpu/device/supervisor.py", "_oom_keys"),  # one entry
-    # per live store (the runner caps stores at MAX_*_STORES)
-    ("surrealdb_tpu/device/batcher.py", "queue"),  # deadline-withdrawn
-    # riders; drained every dispatch
-    ("surrealdb_tpu/server/fanout.py", "_warned"),   # one per distinct
-    # warn key (static set of call sites)
-    ("surrealdb_tpu/server/fanout.py", "_subs"),      # registry: one
-    ("surrealdb_tpu/server/fanout.py", "_by_table"),  # entry per live
-    ("surrealdb_tpu/server/fanout.py", "lids"),       # query, GC'd by
-    ("surrealdb_tpu/server/fanout.py", "_routes"),    # KILL/session
-    ("surrealdb_tpu/server/fanout.py", "_sessions"),  # close/sweep
-    ("surrealdb_tpu/server/fanout.py", "_wconds"),    # nworkers conds
-    # -- static configuration, not derived state -------------------------
-    ("surrealdb_tpu/idx/fulltext.py", "_STOP_SUFFIXES"),
-    ("surrealdb_tpu/device/annstore.py", "cfg"),  # dict(cfg) copy
-    ("surrealdb_tpu/device/vecstore.py", "cfg"),
-}
-
-# rule 10: the follower-read proof contract (kvs/remote.py). The named
-# functions must exist, _dispatch must invoke both, and the read gate
-# may only ever admit these ops to the follower-served path.
-_FOLLOWER_FILE = "surrealdb_tpu/kvs/remote.py"
-_FOLLOWER_FNS = ("follower_read_proof", "_follower_read_allowed",
-                 "_dispatch")
-_FOLLOWER_OPS_OK = {"get", "range"}
-
-# rule 5: the only places inside the package allowed to import jax —
-# the supervised runner tree and the kernel library it dispatches to
-_JAX_ALLOWED = (
-    "surrealdb_tpu/device/",
-    "surrealdb_tpu/parallel/",
-    "surrealdb_tpu/ops/",
-    "surrealdb_tpu/ml/onnx.py",
-)
-
-
-def _imports_jax(node) -> bool:
-    if isinstance(node, ast.Import):
-        return any(a.name == "jax" or a.name.startswith("jax.")
-                   for a in node.names)
-    if isinstance(node, ast.ImportFrom):
-        m = node.module or ""
-        return m == "jax" or m.startswith("jax.")
-    return False
-
-
-def _pragma(lines: list[str], lineno: int) -> bool:
-    """True when the 1-based source line carries a `# robust:` waiver."""
-    if 1 <= lineno <= len(lines):
-        return PRAGMA in lines[lineno - 1]
-    return False
-
-
-def _is_thread_call(node: ast.Call) -> bool:
-    f = node.func
-    if isinstance(f, ast.Name):
-        return f.id == "Thread"
-    if isinstance(f, ast.Attribute):
-        return f.attr == "Thread"
-    return False
-
-
-def _calls_attr(tree: ast.AST, attr: str) -> bool:
-    for n in ast.walk(tree):
-        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
-                and n.func.attr == attr:
-            return True
-    return False
-
-
-_NOTIFY_BUILTIN_OK = {"len", "list", "bytes", "isinstance", "getattr",
-                      "str", "dict", "set", "sorted"}
-
-
-def _is_lock_ctx(item: ast.withitem) -> bool:
-    """True when a with-item looks like a lock/condition acquisition
-    (`with self.lock:`, `with ds.lock:`, `with self.cond:`)."""
-    e = item.context_expr
-    if isinstance(e, ast.Attribute):
-        return "lock" in e.attr or "cond" in e.attr
-    if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute):
-        return "lock" in e.func.attr
-    return False
-
-
-def _check_notify_fns(tree, rel, lines, fn_names) -> list[str]:
-    """Rule 7: inside the named functions, no send-like call anywhere,
-    and under a `with ...lock:` block only allowlisted queue ops."""
-    found = set()
-    findings = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.FunctionDef) \
-                or node.name not in fn_names:
-            continue
-        found.add(node.name)
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.Call) \
-                    and isinstance(sub.func, ast.Attribute) \
-                    and sub.func.attr in _SEND_ATTRS \
-                    and not _pragma(lines, sub.lineno):
-                findings.append(
-                    f"{rel}:{sub.lineno}: `{sub.func.attr}(` inside "
-                    f"{node.name} — socket I/O is never allowed on the "
-                    f"notify/capture path (route through a session "
-                    f"outbox writer)"
-                )
-            if not isinstance(sub, ast.With):
-                continue
-            if not any(_is_lock_ctx(it) for it in sub.items):
-                continue
-            for inner in ast.walk(sub):
-                if inner is sub or not isinstance(inner, ast.Call):
-                    continue
-                f = inner.func
-                ok = (
-                    (isinstance(f, ast.Attribute)
-                     and f.attr in _NOTIFY_LOCK_OK)
-                    or (isinstance(f, ast.Name)
-                        and f.id in _NOTIFY_BUILTIN_OK)
-                )
-                if not ok and not _pragma(lines, inner.lineno):
-                    label = (f.attr if isinstance(f, ast.Attribute)
-                             else getattr(f, "id", "<call>"))
-                    findings.append(
-                        f"{rel}:{inner.lineno}: call `{label}(` under "
-                        f"a lock inside {node.name} — handler "
-                        f"invocation / blocking work while holding the "
-                        f"datastore lock stalls every writer (rule 7)"
-                    )
-    for name in fn_names:
-        if name not in found:
-            findings.append(
-                f"{rel}:1: rule-7 function `{name}` not found — the "
-                f"fan-out delivery contract is no longer being checked "
-                f"(update _NOTIFY_FNS after a rename)"
-            )
-    return findings
-
-
-def _check_knn_fns(tree, rel, lines) -> list[str]:
-    """Rule 8: the scatter/merge/sync functions exist, the fan-out and
-    merge entries check the query deadline, and no rule-8 function
-    holds a lock across anything but partition bookkeeping."""
-    wanted = set(_KNN_DEADLINE_FNS) | set(_KNN_LOCK_FNS)
-    found = set()
-    findings = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.FunctionDef) \
-                or node.name not in wanted:
-            continue
-        found.add(node.name)
-        if node.name in _KNN_DEADLINE_FNS \
-                and not _calls_attr(node, "check_deadline") \
-                and not _pragma(lines, node.lineno):
-            findings.append(
-                f"{rel}:{node.lineno}: {node.name} never calls "
-                f"check_deadline() — a KILL/timeout must be able to "
-                f"land between per-shard dispatches (rule 8)"
-            )
-        for sub in ast.walk(node):
-            if not isinstance(sub, ast.With):
-                continue
-            if not any(_is_lock_ctx(it) for it in sub.items):
-                continue
-            for inner in ast.walk(sub):
-                if inner is sub or not isinstance(inner, ast.Call):
-                    continue
-                f = inner.func
-                ok = (
-                    (isinstance(f, ast.Attribute)
-                     and f.attr in _KNN_LOCK_OK)
-                    or (isinstance(f, ast.Name)
-                        and f.id in _NOTIFY_BUILTIN_OK)
-                )
-                if not ok and not _pragma(lines, inner.lineno):
-                    label = (f.attr if isinstance(f, ast.Attribute)
-                             else getattr(f, "id", "<call>"))
-                    findings.append(
-                        f"{rel}:{inner.lineno}: call `{label}(` under "
-                        f"a lock inside {node.name} — a shard-map "
-                        f"lock held across a remote dispatch "
-                        f"serializes every query on the node (rule 8)"
-                    )
-    for name in sorted(wanted - found):
-        findings.append(
-            f"{rel}:1: rule-8 function `{name}` not found — the "
-            f"scatter-gather KNN contract is no longer being checked "
-            f"(update the rule-8 tables after a rename)"
-        )
-    return findings
-
-
-def _check_follower_fns(tree, rel, lines) -> list[str]:
-    """Rule 10: the closed-timestamp follower-read contract. The proof
-    and the read gate exist, _dispatch calls both, the gate checks the
-    proof-registered snapshot set, and only get/range may ever be
-    admitted to the follower-served path."""
-    findings = []
-    fns = {n.name: n for n in ast.walk(tree)
-           if isinstance(n, ast.FunctionDef)}
-    for name in _FOLLOWER_FNS:
-        if name not in fns:
-            findings.append(
-                f"{rel}:1: rule-10 function `{name}` not found — the "
-                f"follower-read proof contract is no longer being "
-                f"checked (update the rule-10 table after a rename)"
-            )
-    gate = fns.get("_follower_read_allowed")
-    if gate is not None:
-        for sub in ast.walk(gate):
-            if not isinstance(sub, ast.Compare):
-                continue
-            for n2 in ast.walk(sub):
-                if isinstance(n2, ast.Constant) \
-                        and isinstance(n2.value, str) \
-                        and n2.value not in _FOLLOWER_OPS_OK \
-                        and not _pragma(lines, n2.lineno):
-                    findings.append(
-                        f"{rel}:{n2.lineno}: op {n2.value!r} admitted "
-                        f"to the follower-served read path — only "
-                        f"get/range may serve against a proof-pinned "
-                        f"snapshot (rule 10: a follower-served `snap`/"
-                        f"`get_latest` is the stale-forever hole PR 5 "
-                        f"closed)"
-                    )
-        if not any(isinstance(n2, ast.Attribute) and n2.attr == "fsnaps"
-                   for n2 in ast.walk(gate)):
-            findings.append(
-                f"{rel}:{gate.lineno}: _follower_read_allowed no "
-                f"longer checks the proof-registered snapshot set "
-                f"(fsnaps) — a replica would serve reads against "
-                f"snapshots that never passed the closed-timestamp "
-                f"proof (rule 10)"
-            )
-    disp = fns.get("_dispatch")
-    if disp is not None:
-        for req in ("_follower_read_allowed", "follower_read_proof"):
-            if not _calls_attr(disp, req):
-                findings.append(
-                    f"{rel}:{disp.lineno}: _dispatch never calls "
-                    f"`{req}()` — replica-side reads are being served "
-                    f"outside the closed-timestamp proof (rule 10)"
-                )
-    return findings
-
-
-def _is_container_value(v) -> bool:
-    if isinstance(v, (ast.Dict, ast.List, ast.Set, ast.DictComp,
-                      ast.ListComp, ast.SetComp)):
-        return True
-    if isinstance(v, ast.Call):
-        f = v.func
-        name = f.id if isinstance(f, ast.Name) else (
-            f.attr if isinstance(f, ast.Attribute) else None
-        )
-        return name in _CONTAINER_CALLS
-    return False
-
-
-def _check_mem_accounting(tree, rel, lines) -> list[str]:
-    """Rule 9: every module-level / __init__-held mutable container in
-    the scanned trees is either allowlisted (with its coverage reason)
-    or a finding — unaccounted caches are how a node OOMs."""
-    findings = []
-    rel_fwd = rel.replace(os.sep, "/")
-
-    def flag(name, lineno):
-        if name.startswith("__") and name.endswith("__"):
-            return  # module dunders (__all__) are not caches
-        if (rel_fwd, name) in _MEM_ALLOW or _pragma(lines, lineno):
-            return
-        findings.append(
-            f"{rel}:{lineno}: container `{name}` in {rel_fwd} is "
-            f"neither registered with the memory accountant "
-            f"(resource.register size/evict coverage) nor on the "
-            f"rule-9 allowlist — unaccounted derived state is how the "
-            f"node OOMs instead of degrading"
-        )
-
-    for node in ast.iter_child_nodes(tree):
-        # module-level containers
-        if isinstance(node, ast.Assign) and _is_container_value(
-                node.value):
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    flag(t.id, node.lineno)
-        elif isinstance(node, ast.AnnAssign) \
-                and node.value is not None \
-                and _is_container_value(node.value) \
-                and isinstance(node.target, ast.Name):
-            flag(node.target.id, node.lineno)
-        # instance containers created in __init__
-        if not isinstance(node, ast.ClassDef):
-            continue
-        for fn in node.body:
-            if not (isinstance(fn, ast.FunctionDef)
-                    and fn.name == "__init__"):
-                continue
-            for sub in ast.walk(fn):
-                tgt = val = None
-                if isinstance(sub, ast.Assign):
-                    val = sub.value
-                    tgt = sub.targets[0] if len(sub.targets) == 1 \
-                        else None
-                elif isinstance(sub, ast.AnnAssign):
-                    val, tgt = sub.value, sub.target
-                if val is None or not _is_container_value(val):
-                    continue
-                if isinstance(tgt, ast.Attribute) \
-                        and isinstance(tgt.value, ast.Name) \
-                        and tgt.value.id == "self":
-                    flag(tgt.attr, sub.lineno)
-    return findings
-
-
-def _check_mem_registration_fns(tree, rel) -> list[str]:
-    """Rule 9 teeth: the accountant + registrant functions must still
-    exist — a rename/refactor that drops one silently retires the
-    coverage the allowlist assumes."""
-    rel_fwd = rel.replace(os.sep, "/")
-    wanted = _MEM_REGISTRATION_FNS.get(rel_fwd)
-    if not wanted:
-        return []
-    have = {n.name for n in ast.walk(tree)
-            if isinstance(n, ast.FunctionDef)}
-    return [
-        f"{rel}:1: rule-9 registration function `{name}` not found — "
-        f"memory-accounting coverage is no longer wired (update the "
-        f"rule-9 tables after a rename)"
-        for name in wanted if name not in have
-    ]
+PRAGMA = "# robust:"  # legacy constant, still the line-waiver marker
 
 
 def check_file(path: str, rel: str) -> list[str]:
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    lines = src.splitlines()
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [f"{rel}:{e.lineno}: syntax error: {e.msg}"]
-    findings = []
-    rel_fwd = rel.replace(os.sep, "/")
-    jax_ok = any(
-        rel_fwd.startswith(p) or rel_fwd == p.rstrip("/")
-        for p in _JAX_ALLOWED
-    )
-    for node in ast.walk(tree):
-        # 5. jax import outside the device/kernel tree
-        if not jax_ok and _imports_jax(node) \
-                and not _pragma(lines, node.lineno):
-            findings.append(
-                f"{rel}:{node.lineno}: `import jax` outside "
-                f"{'|'.join(_JAX_ALLOWED)} — backend init must never "
-                f"run on a query worker thread (dispatch via "
-                f"surrealdb_tpu.device instead)"
-            )
-        # 1. bare except
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            if not _pragma(lines, node.lineno):
-                findings.append(
-                    f"{rel}:{node.lineno}: bare `except:` swallows "
-                    f"cancellation — name the exception types"
-                )
-        # 2. non-daemon Thread without a join pragma
-        if isinstance(node, ast.Call) and _is_thread_call(node):
-            daemon = next(
-                (kw for kw in node.keywords if kw.arg == "daemon"), None
-            )
-            is_daemon = (
-                daemon is not None
-                and isinstance(daemon.value, ast.Constant)
-                and daemon.value.value is True
-            )
-            if not is_daemon and not _pragma(lines, node.lineno):
-                findings.append(
-                    f"{rel}:{node.lineno}: non-daemon Thread() without "
-                    f"`daemon=True` or a `# robust: joined` pragma — "
-                    f"blocks SIGTERM drain"
-                )
-    # 6. raw clock/socket calls outside the simulation seam
-    if rel_fwd in _SEAM_FILES:
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if not (isinstance(f, ast.Attribute)
-                    and isinstance(f.value, ast.Name)):
-                continue
-            if (f.value.id, f.attr) in _SEAM_FORBIDDEN \
-                    and not _pragma(lines, node.lineno):
-                findings.append(
-                    f"{rel}:{node.lineno}: raw `{f.value.id}.{f.attr}()`"
-                    f" outside the kvs/net.py seam — route it through "
-                    f"Clock/Runtime/Transport or the deterministic "
-                    f"simulator cannot virtualize it"
-                )
-    # 4. silent except-pass in 2PC decision paths
-    if rel.replace(os.sep, "/") in _TWOPC_FILES:
-        for fn in ast.walk(tree):
-            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if not _DECISION_FN.search(fn.name):
-                continue
-            for node in ast.walk(fn):
-                if (isinstance(node, ast.ExceptHandler)
-                        and len(node.body) == 1
-                        and isinstance(node.body[0], ast.Pass)
-                        and not _pragma(lines, node.lineno)):
-                    findings.append(
-                        f"{rel}:{node.lineno}: silent `except: pass` in "
-                        f"2PC decision path {fn.name} — count it, "
-                        f"re-raise, or add a `# robust:` pragma"
-                    )
-    # 7. non-blocking delivery contract for the fan-out functions
-    if rel_fwd in _NOTIFY_FNS:
-        findings.extend(
-            _check_notify_fns(tree, rel, lines, _NOTIFY_FNS[rel_fwd])
-        )
-    # 8. scatter-gather KNN serving contract
-    if rel_fwd == _KNN_FILE:
-        findings.extend(_check_knn_fns(tree, rel, lines))
-    # 10. follower reads stay behind the closed-timestamp proof
-    if rel_fwd == _FOLLOWER_FILE:
-        findings.extend(_check_follower_fns(tree, rel, lines))
-    # 9. memory-accounting coverage
-    if any(rel_fwd.startswith(p) for p in _MEM_SCAN_PREFIXES) \
-            or rel_fwd in _MEM_SCAN_FILES:
-        findings.extend(_check_mem_accounting(tree, rel, lines))
-    findings.extend(_check_mem_registration_fns(tree, rel))
-    # 3. streaming operators must stay deadline-checked
-    if rel.endswith(os.path.join("exec", "stream.py")):
-        for node in ast.iter_child_nodes(tree):
-            if not (isinstance(node, ast.ClassDef)
-                    and node.name.endswith("Op")):
-                continue
-            ex = next(
-                (n for n in node.body
-                 if isinstance(n, ast.FunctionDef)
-                 and n.name == "_execute"),
-                None,
-            )
-            if ex is None:
-                continue
-            has_loop = any(
-                isinstance(n, (ast.For, ast.While)) for n in ast.walk(ex)
-            )
-            if not has_loop:
-                continue
-            ok = _calls_attr(ex, "check_deadline") or _calls_attr(
-                ex, "execute"
-            )
-            if not ok and not _pragma(lines, node.lineno):
-                findings.append(
-                    f"{rel}:{node.lineno}: streaming operator "
-                    f"{node.name}._execute loops without "
-                    f"ctx.check_deadline() or a child .execute(ctx) — "
-                    f"unbounded under KILL/timeout"
-                )
-    return findings
+    """Per-file legacy rules against one file (historical surface —
+    the whole-program analyses need the full tree and live in scan)."""
+    return [f.text() for f in staticlint.check_file_legacy(path, rel)]
 
 
 def scan(root: str) -> list[str]:
-    pkg = os.path.join(root, "surrealdb_tpu")
-    findings: list[str] = []
-    for dirpath, _dirs, files in os.walk(pkg):
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            p = os.path.join(dirpath, fn)
-            findings.extend(check_file(p, os.path.relpath(p, root)))
-    return findings
+    """The full staticlint pass: legacy rules + lock-order +
+    blocking-under-lock + deadline propagation + pragma audit, with
+    the baseline applied. Returns surviving finding texts."""
+    return [f"[{f.rule}] {f.text()}"
+            for f in staticlint.run(os.path.abspath(root)).findings]
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    root = argv[0] if argv else os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), ".."
-    )
-    findings = scan(root)
-    for f in findings:
-        print(f"ROBUSTNESS {f}")
-    if findings:
-        print(f"robustness check: {len(findings)} finding(s)")
+    root = argv[0] if argv else os.path.join(_TOOLS, "..")
+    rep = staticlint.run(os.path.abspath(root))
+    for f in rep.findings:
+        print(f"ROBUSTNESS [{f.rule}] {f.text()}")
+    timing = " ".join(
+        f"{k}={v * 1000:.0f}ms" for k, v in rep.timings.items())
+    if rep.findings:
+        print(f"robustness check: {len(rep.findings)} finding(s) "
+              f"[{rep.baselined} baselined] in {rep.total_s:.2f}s "
+              f"({timing})")
         return 1
-    print("robustness check: clean")
+    print(f"robustness check: clean [{rep.baselined} baselined] in "
+          f"{rep.total_s:.2f}s ({timing})")
     return 0
 
 

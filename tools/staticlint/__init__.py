@@ -1,0 +1,162 @@
+"""staticlint — whole-program concurrency lint for surrealdb_tpu.
+
+One parse per file, one shared call graph, and on top of them:
+
+- the ten legacy robustness rules (legacy.py), semantics unchanged,
+- `lock-order`: the lock-order graph and its cycles (locks.py),
+- `lock-held`: blocking operations reachable under a held lock,
+- `deadline`: deadline propagation through the serving cone
+  (deadline.py),
+- `pragma`: the waiver-vocabulary audit (a pragma without a reason is
+  a finding),
+- `baseline`: fail-closed triage ledger (baseline.py).
+
+Entry point: `run(root)` -> Report. The conformance gate and the
+`check_robustness.py` compatibility shim both go through it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .baseline import apply_baseline, load_baseline
+from .callgraph import CallGraph
+from .core import Finding, Project
+from .deadline import deadline_findings
+from .legacy import check_file as check_file_legacy_findings
+from .legacy import check_fileinfo
+from .locks import (LockModel, blocking_summaries,
+                    blocking_under_lock_findings, lock_order_findings,
+                    seed_integrity_findings)
+from .pragmas import pragma_findings
+
+__all__ = ["run", "Report", "Finding", "Project", "check_file_legacy"]
+
+
+class Report:
+    def __init__(self):
+        self.findings: list[Finding] = []   # surviving (gate-failing)
+        self.baselined = 0
+        self.timings: dict[str, float] = {}
+        self.files = 0
+        self.parse_count = 0
+        self.total_s = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def texts(self) -> list[str]:
+        return [f.text() for f in self.findings]
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "files": self.files,
+            "parse_count": self.parse_count,
+            "baselined": self.baselined,
+            "finding_count": len(self.findings),
+            "findings": [f.to_json() for f in self.findings],
+            "timings_s": {k: round(v, 4)
+                          for k, v in self.timings.items()},
+            "total_s": round(self.total_s, 4),
+        }
+
+
+def default_baseline_path(root: str) -> str:
+    return os.path.join(root, "tools", "staticlint", "baseline.toml")
+
+
+def run(root: str, pkg: str = "surrealdb_tpu",
+        baseline_path: str | None = None) -> Report:
+    t_all = time.perf_counter()
+    rep = Report()
+    if baseline_path is None:
+        baseline_path = default_baseline_path(root)
+
+    t0 = time.perf_counter()
+    project = Project(root, pkg=pkg)
+    rep.timings["parse+index"] = time.perf_counter() - t0
+    rep.files = len(project.files)
+    rep.parse_count = project.parse_count
+
+    findings: list[Finding] = list(project.parse_errors)
+
+    t0 = time.perf_counter()
+    graph = CallGraph(project)
+    rep.timings["callgraph"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    model = LockModel(project, graph)
+    rep.timings["lockmodel"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for fi in project.files.values():
+        findings.extend(check_fileinfo(fi))
+    rep.timings["legacy-rules"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    findings.extend(lock_order_findings(project, graph, model))
+    rep.timings["lock-order"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    can_block = blocking_summaries(project, graph, model)
+    findings.extend(seed_integrity_findings(project))
+    findings.extend(
+        blocking_under_lock_findings(project, graph, model, can_block))
+    rep.timings["lock-held"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    findings.extend(deadline_findings(project, graph, can_block))
+    rep.timings["deadline"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    findings.extend(pragma_findings(project))
+    rep.timings["pragma"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    entries, bl_findings = load_baseline(baseline_path)
+    survivors, stale, matched = apply_baseline(findings, entries)
+    rep.baselined = matched
+    rep.findings = survivors + stale + bl_findings
+    rep.timings["baseline"] = time.perf_counter() - t0
+
+    rep.findings.sort(key=lambda f: (f.rel, f.lineno, f.rule))
+    rep.total_s = time.perf_counter() - t_all
+    return rep
+
+
+def check_file_legacy(path: str, rel: str) -> list[Finding]:
+    """Single-file legacy-rule scan (check_robustness compat)."""
+    return check_file_legacy_findings(path, rel)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="staticlint",
+        description="whole-program concurrency lint for surrealdb_tpu")
+    ap.add_argument("root", nargs="?", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings + per-rule timings")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: "
+                         "<root>/tools/staticlint/baseline.toml)")
+    args = ap.parse_args(argv)
+    rep = run(os.path.abspath(args.root), baseline_path=args.baseline)
+    if args.json:
+        print(json.dumps(rep.to_json(), indent=2))
+    else:
+        for f in rep.findings:
+            print(f"STATICLINT [{f.rule}] {f.text()}")
+        timing = " ".join(
+            f"{k}={v * 1000:.0f}ms" for k, v in rep.timings.items())
+        print(f"staticlint: {len(rep.findings)} finding(s), "
+              f"{rep.baselined} baselined, {rep.files} files "
+              f"({rep.parse_count} parses), {rep.total_s:.2f}s "
+              f"[{timing}]")
+    return 1 if rep.findings else 0

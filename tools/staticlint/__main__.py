@@ -1,0 +1,14 @@
+"""`python tools/staticlint [root] [--json]` entry point."""
+
+import os
+import sys
+
+if __package__ in (None, ""):
+    # invoked as `python tools/staticlint` — make the package importable
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from staticlint import main
+else:
+    from . import main
+
+sys.exit(main())

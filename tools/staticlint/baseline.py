@@ -1,0 +1,184 @@
+"""Fail-closed finding baseline.
+
+`baseline.toml` holds the audited survivors of the initial whole-tree
+triage: findings that are understood and accepted, each with a written
+reason. Matching is by stable identity — rule, file, function, detail
+(fnmatch globs allowed) — never by line number, so ordinary edits
+don't churn the file.
+
+Fail-closed means the baseline can only shrink honestly: an entry that
+matches nothing in the current scan is itself a finding (`baseline`
+rule) until someone deletes it, and an entry without a reason is
+rejected outright. Deleting or renaming a baselined function therefore
+turns the gate red — exactly like the legacy rules' rename-proof
+existence assertions.
+
+The file format is the array-of-tables TOML subset below (parsed with
+tomllib when available, by the fallback mini-parser otherwise — the
+container images don't all ship tomllib):
+
+    [[suppress]]
+    rule   = "lock-held"
+    file   = "surrealdb_tpu/idx/vector.py"
+    func   = "TpuVectorIndex._mem_evict_vec"
+    detail = "forget@*"
+    reason = "why this survivor is safe"
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+
+from .core import Finding
+
+_KEYVAL = re.compile(r"^([A-Za-z_][\w-]*)\s*=\s*(.+)$")
+
+
+class BaselineEntry:
+    __slots__ = ("rule", "file", "func", "detail", "reason",
+                 "lineno", "matched")
+
+    def __init__(self, d: dict, lineno: int):
+        self.rule = d.get("rule", "*")
+        self.file = d.get("file", "*")
+        self.func = d.get("func", "*")
+        self.detail = d.get("detail", "*")
+        self.reason = (d.get("reason") or "").strip()
+        self.lineno = lineno
+        self.matched = 0
+
+    def matches(self, f: Finding) -> bool:
+        return (fnmatch.fnmatch(f.rule, self.rule)
+                and fnmatch.fnmatch(f.rel, self.file)
+                and fnmatch.fnmatch(f.func or "", self.func)
+                and fnmatch.fnmatch(f.detail or f.message, self.detail))
+
+    def ident(self) -> str:
+        return (f"{self.rule}:{self.file}:{self.func}:{self.detail}")
+
+
+def _parse_value(raw: str):
+    raw = raw.strip()
+    # strip trailing comment outside quotes
+    if raw.startswith('"'):
+        m = re.match(r'^"((?:[^"\\]|\\.)*)"', raw)
+        if m:
+            return m.group(1).replace('\\"', '"').replace("\\\\", "\\")
+        raise ValueError(f"unterminated string: {raw!r}")
+    if raw.startswith("'"):
+        m = re.match(r"^'([^']*)'", raw)
+        if m:
+            return m.group(1)
+        raise ValueError(f"unterminated string: {raw!r}")
+    raw = raw.split("#", 1)[0].strip()
+    if raw in ("true", "false"):
+        return raw == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        return raw
+
+
+def parse_toml_subset(text: str) -> list[tuple[dict, int]]:
+    """[[suppress]] tables of scalar key = value pairs, with comments.
+    Returns (table dict, lineno of its header) pairs."""
+    tables: list[tuple[dict, int]] = []
+    current: dict | None = None
+    for i, line in enumerate(text.splitlines(), start=1):
+        s = line.strip()
+        if not s or s.startswith("#"):
+            continue
+        if s.startswith("[["):
+            name = s.strip("[]").strip()
+            if name != "suppress":
+                raise ValueError(
+                    f"baseline line {i}: unknown table [[{name}]] — "
+                    f"only [[suppress]] entries are allowed")
+            current = {}
+            tables.append((current, i))
+            continue
+        m = _KEYVAL.match(s)
+        if m is None:
+            raise ValueError(f"baseline line {i}: unparsable: {s!r}")
+        if current is None:
+            raise ValueError(
+                f"baseline line {i}: key outside a [[suppress]] table")
+        current[m.group(1)] = _parse_value(m.group(2))
+    return tables
+
+
+def load_baseline(path: str) -> tuple[list[BaselineEntry], list[Finding]]:
+    """Parse the baseline file. Malformed entries (no reason, bad
+    syntax) are findings, not warnings."""
+    rel = "tools/staticlint/baseline.toml"
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except FileNotFoundError:
+        return [], []
+    try:
+        import tomllib  # noqa: F401 — shape-check with the real parser
+        data = tomllib.loads(text)
+        raw = data.get("suppress", [])
+        # recover linenos from the subset parser for messages
+        try:
+            linenos = [ln for _t, ln in parse_toml_subset(text)]
+        except ValueError:
+            linenos = []
+        linenos += [0] * max(0, len(raw) - len(linenos))
+        tables = list(zip(raw, linenos))
+    except ModuleNotFoundError:
+        try:
+            tables = parse_toml_subset(text)
+        except ValueError as e:
+            return [], [Finding("baseline", rel, 1, str(e),
+                                detail="syntax")]
+    except Exception as e:  # tomllib parse error
+        return [], [Finding("baseline", rel, 1,
+                            f"baseline does not parse: {e}",
+                            detail="syntax")]
+    entries = []
+    findings = []
+    for d, ln in tables:
+        e = BaselineEntry(d, ln)
+        if not e.reason:
+            findings.append(Finding(
+                "baseline", rel, ln,
+                f"baseline entry {e.ident()} has no reason — every "
+                f"accepted finding must say why it is safe",
+                detail=f"noreason:{e.ident()}"))
+            continue
+        entries.append(e)
+    return entries, findings
+
+
+def apply_baseline(findings: list[Finding],
+                   entries: list[BaselineEntry]) -> tuple[
+                       list[Finding], list[Finding], int]:
+    """Returns (surviving findings, stale-entry findings, matched)."""
+    rel = "tools/staticlint/baseline.toml"
+    out = []
+    matched = 0
+    for f in findings:
+        hit = None
+        for e in entries:
+            if e.matches(f):
+                hit = e
+                break
+        if hit is None:
+            out.append(f)
+        else:
+            hit.matched += 1
+            matched += 1
+    stale = [
+        Finding(
+            "baseline", rel, e.lineno,
+            f"stale baseline entry {e.ident()} matches no current "
+            f"finding — the code it waived moved or was fixed; delete "
+            f"the entry (fail-closed: a baseline may only shrink "
+            f"honestly)",
+            detail=f"stale:{e.ident()}")
+        for e in entries if e.matched == 0
+    ]
+    return out, stale, matched

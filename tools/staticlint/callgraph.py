@@ -1,0 +1,244 @@
+"""Best-effort intra-package call graph.
+
+Resolution is deliberately conservative — an edge exists only when the
+target is determined by one of:
+
+- a bare name that is a module-level function (same module or imported
+  via `from x import f`),
+- `self.m()` resolved through the enclosing class and its bases,
+- `self.attr.m()` / `obj.m()` where the receiver's class is known from
+  `self.attr = Class(...)` in `__init__`, a local `obj = Class(...)`
+  assignment, a module-level instance, or the receiver-name convention
+  table (`ds` is always the Datastore, etc.),
+- `Class(...)` constructor calls (edge to `Class.__init__`),
+- `mod.f()` where `mod` is an imported module in the scanned package.
+
+Unresolved attribute calls are kept (name + node) so the blocking
+analysis can match them against the primitive tables; they never
+produce false edges.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FuncNode, Project, expr_chain
+
+# receiver-name conventions the tree uses pervasively for objects that
+# are passed as parameters (so no constructor assignment is visible)
+CONVENTION_TYPES = {
+    "ds": "Datastore",
+    "txn": "Tx",
+    "hub": "FanoutHub",
+    "sup": "DeviceSupervisor",
+    "pool": "_Pool",
+}
+
+
+class CallSite:
+    __slots__ = ("node", "target", "attr", "lineno")
+
+    def __init__(self, node: ast.Call, target: tuple | None,
+                 attr: str | None):
+        self.node = node
+        self.target = target      # (rel, qual) or None
+        self.attr = attr          # trailing name for unresolved calls
+        self.lineno = node.lineno
+
+
+def _local_types(fn: FuncNode, project: Project) -> dict[str, str]:
+    """name -> class name, from `x = Class(...)` assignments and
+    `x = self.attr` aliases inside the function."""
+    out: dict[str, str] = {}
+    cls = _class_node(fn, project)
+    for sub in ast.walk(fn.node):
+        if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+            continue
+        t = sub.targets[0]
+        if not isinstance(t, ast.Name):
+            continue
+        v = sub.value
+        if isinstance(v, ast.Call):
+            f = v.func
+            name = None
+            if isinstance(f, ast.Name):
+                name = f.id
+            elif isinstance(f, ast.Attribute):
+                name = f.attr
+            if name and project.resolve_class(name, fn.rel) is not None:
+                out[t.id] = name
+        elif isinstance(v, ast.Attribute) and isinstance(
+                v.value, ast.Name) and v.value.id == "self" and cls:
+            ty = cls.attr_types.get(v.attr)
+            if ty:
+                out[t.id] = ty
+    return out
+
+
+def _class_node(fn: FuncNode, project: Project):
+    if fn.cls is None:
+        return None
+    return project.class_at.get((fn.rel, fn.cls))
+
+
+def receiver_type(chain: list[str], fn: FuncNode, project: Project,
+                  local_types: dict[str, str] | None = None) -> str | None:
+    """Class name of the object a ['self','attr'] / ['name'] chain
+    denotes, or None."""
+    if not chain:
+        return None
+    local_types = local_types or {}
+    cls = _class_node(fn, project)
+    if chain[0] == "self":
+        if len(chain) == 1:
+            return fn.cls
+        if cls is not None:
+            ty = cls.attr_types.get(chain[1])
+            if ty and len(chain) == 2:
+                return ty
+            if ty and len(chain) == 3:
+                cn2 = project.resolve_class(ty, fn.rel)
+                if cn2 is not None:
+                    return cn2.attr_types.get(chain[2])
+        return None
+    name = chain[0]
+    ty = local_types.get(name)
+    if ty is None:
+        ty = project.module_types.get((fn.rel, name))
+    if ty is None:
+        ty = CONVENTION_TYPES.get(name)
+    if ty is None:
+        return None
+    if len(chain) == 1:
+        return ty
+    cn = project.resolve_class(ty, fn.rel)
+    if cn is not None and len(chain) == 2:
+        return cn.attr_types.get(chain[1])
+    return None
+
+
+def resolve_call(call: ast.Call, fn: FuncNode, project: Project,
+                 local_types: dict[str, str]) -> CallSite:
+    f = call.func
+    # bare name -----------------------------------------------------------
+    if isinstance(f, ast.Name):
+        name = f.id
+        # nested def in the same enclosing function
+        nested = project.funcs.get((fn.rel, f"{fn.qual}.{name}"))
+        if nested is not None:
+            return CallSite(call, nested.key, None)
+        mf = project.module_funcs.get((fn.rel, name))
+        if mf is not None:
+            return CallSite(call, mf.key, None)
+        imp = project.imports.get(fn.rel, {}).get(name)
+        if imp and imp[1] != "*module*":
+            mf = project.module_funcs.get(imp)
+            if mf is not None:
+                return CallSite(call, mf.key, None)
+        cn = project.resolve_class(name, fn.rel)
+        if cn is not None:
+            init = cn.methods.get("__init__")
+            if init is not None:
+                return CallSite(call, init.key, None)
+            return CallSite(call, None, None)
+        return CallSite(call, None, name)
+    # attribute call ------------------------------------------------------
+    if isinstance(f, ast.Attribute):
+        meth = f.attr
+        chain = expr_chain(f.value)
+        if chain is not None:
+            # module attribute: time.sleep / net.send_frame
+            if len(chain) == 1:
+                imp = project.imports.get(fn.rel, {}).get(chain[0])
+                if imp and imp[1] == "*module*":
+                    mf = project.module_funcs.get((imp[0], meth))
+                    if mf is not None:
+                        return CallSite(call, mf.key, None)
+            if chain[0] == "self" and len(chain) == 1 and fn.cls:
+                m = project.method_of(fn.cls, meth, fn.rel)
+                if m is not None:
+                    return CallSite(call, m.key, None)
+                return CallSite(call, None, meth)
+            ty = receiver_type(chain, fn, project, local_types)
+            if ty is not None:
+                m = project.method_of(ty, meth, fn.rel)
+                if m is not None:
+                    return CallSite(call, m.key, None)
+        return CallSite(call, None, meth)
+    return CallSite(call, None, None)
+
+
+class CallGraph:
+    """callsites per function + the resolved edge set."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.sites: dict[tuple, list[CallSite]] = {}
+        self.edges: dict[tuple, set[tuple]] = {}
+        # nested defs are indexed as their own FuncNodes — don't
+        # attribute their call sites to the enclosing function too
+        nested_of: dict[tuple, set] = {}
+        for (rel, qual), f2 in project.funcs.items():
+            if "." not in qual:
+                continue
+            parent = (rel, qual.rsplit(".", 1)[0])
+            if parent in project.funcs:
+                nested_of.setdefault(parent, set()).add(f2.node)
+        for key, fn in project.funcs.items():
+            local_types = _local_types(fn, project)
+            sites = []
+            own = set()
+            nested_nodes = nested_of.get(key, set())
+            for sub in _walk_skipping(fn.node, nested_nodes):
+                if isinstance(sub, ast.Call):
+                    cs = resolve_call(sub, fn, project, local_types)
+                    sites.append(cs)
+                    if cs.target is not None:
+                        own.add(cs.target)
+            self.sites[key] = sites
+            self.edges[key] = own
+            fn.callees = own
+
+    def transitive(self, seeds: dict[tuple, int],
+                   max_depth: int) -> dict[tuple, int]:
+        """Min call-distance (<= max_depth) from any function to a seed,
+        propagating UP the graph (caller inherits seed+1)."""
+        dist = dict(seeds)
+        changed = True
+        while changed:
+            changed = False
+            for caller, callees in self.edges.items():
+                best = dist.get(caller)
+                for c in callees:
+                    d = dist.get(c)
+                    if d is None or d + 1 > max_depth:
+                        continue
+                    if best is None or d + 1 < best:
+                        best = d + 1
+                        changed = True
+                if best is not None and dist.get(caller) != best:
+                    dist[caller] = best
+        return dist
+
+    def reachable_from(self, roots: set[tuple]) -> set[tuple]:
+        seen = set()
+        queue = [r for r in roots if r in self.edges]
+        while queue:
+            k = queue.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            queue.extend(self.edges.get(k, ()))
+        return seen
+
+
+def _walk_skipping(root, skip_nodes):
+    """ast.walk that does not descend into the given nested defs."""
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if c in skip_nodes:
+                continue
+            stack.append(c)

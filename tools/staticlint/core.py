@@ -1,0 +1,358 @@
+"""staticlint core: the parse-once project model every analysis shares.
+
+`check_robustness.py` re-walked and re-parsed the tree once per rule
+family; this module parses each file exactly once (`Project.parse_count`
+is asserted equal to the file count by the tier-1 wrapper), builds the
+shared symbol tables (classes, methods, module functions, instance-attr
+types, lock declarations, imports), and hands every analysis the same
+`FileInfo`/`FuncNode` objects.
+
+Findings carry a *stable identity* (`rule`, `rel`, `func`, `detail`) on
+top of the human message, so the baseline can match them across line
+drift — see baseline.py for the fail-closed matching rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+LEGACY_PRAGMA = "# robust:"
+# `# lint: <rule>(<reason>)` — the rule-scoped waiver vocabulary.
+LINT_PRAGMA_RE = re.compile(r"#\s*lint:\s*([\w*-]+)\s*\(([^)]*)\)")
+# a `# lint:` marker that does NOT parse as rule(reason) is itself a
+# finding (pragma audit) — catch the token loosely here
+LINT_TOKEN_RE = re.compile(r"#\s*lint:")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore", "RWLock", "SimLock",
+               # seam factories: runtime.rlock(), runtime.lock()
+               "rlock", "lock"}
+
+
+@dataclass
+class Finding:
+    rule: str
+    rel: str          # forward-slash relative path
+    lineno: int
+    message: str
+    func: str = ""    # enclosing function qualname ("Class.method")
+    detail: str = ""  # stable token for baseline matching
+
+    def text(self) -> str:
+        return f"{self.rel}:{self.lineno}: {self.message}"
+
+    def key(self) -> tuple:
+        return (self.rule, self.rel, self.func,
+                self.detail or self.message)
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "file": self.rel,
+                "line": self.lineno, "func": self.func,
+                "detail": self.detail, "message": self.message}
+
+
+class FileInfo:
+    """One parsed source file: text, lines, AST, pragma maps."""
+
+    __slots__ = ("path", "rel", "src", "lines", "tree",
+                 "lint_pragmas", "robust_lines")
+
+    def __init__(self, path: str, rel: str, src: str, tree: ast.AST):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = tree
+        # lineno -> set of rule names waived by `# lint: rule(reason)`
+        self.lint_pragmas: dict[int, set[str]] = {}
+        # linenos carrying a `# robust:` waiver (legacy rules)
+        self.robust_lines: set[int] = set()
+        for i, line in enumerate(self.lines, start=1):
+            if LEGACY_PRAGMA in line:
+                self.robust_lines.add(i)
+            for m in LINT_PRAGMA_RE.finditer(line):
+                self.lint_pragmas.setdefault(i, set()).add(m.group(1))
+
+    def has_robust(self, lineno: int) -> bool:
+        return lineno in self.robust_lines
+
+    def has_lint(self, lineno: int, rule: str) -> bool:
+        """A `# lint: rule(reason)` waives its own line and the line
+        directly below it (so a pragma can sit above a long `with`/
+        `while` statement instead of stretching it past 79 cols)."""
+        for ln in (lineno, lineno - 1):
+            rules = self.lint_pragmas.get(ln)
+            if rules and (rule in rules or "*" in rules):
+                return True
+        return False
+
+    def waived(self, lineno: int, rule: str) -> bool:
+        """True when either pragma vocabulary waives `rule` on `lineno`."""
+        return self.has_robust(lineno) or self.has_lint(lineno, rule)
+
+
+@dataclass
+class FuncNode:
+    rel: str
+    qual: str                     # "Class.method" or "func" or "f.inner"
+    node: object                  # ast.FunctionDef / AsyncFunctionDef
+    cls: str | None               # enclosing class name (innermost)
+    file: FileInfo
+    callees: set = field(default_factory=set)   # keys (rel, qual)
+
+    @property
+    def key(self) -> tuple:
+        return (self.rel, self.qual)
+
+    @property
+    def lineno(self) -> int:
+        return self.node.lineno
+
+
+@dataclass
+class ClassNode:
+    rel: str
+    name: str
+    node: object
+    bases: list[str] = field(default_factory=list)
+    methods: dict = field(default_factory=dict)     # name -> FuncNode
+    attr_types: dict = field(default_factory=dict)  # attr -> class name
+    lock_attrs: dict = field(default_factory=dict)  # attr -> ctor name
+    cond_over: dict = field(default_factory=dict)   # cond attr -> lock attr
+
+
+def _ctor_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def expr_chain(e) -> list[str] | None:
+    """['self','ds','lock'] for self.ds.lock; trailing calls keep a
+    '()' suffix: ['self','rw','read()'] for self.rw.read(). None when
+    the expression has a non-name component (subscript, call args...)."""
+    if isinstance(e, ast.Name):
+        return [e.id]
+    if isinstance(e, ast.Attribute):
+        base = expr_chain(e.value)
+        return base + [e.attr] if base is not None else None
+    if isinstance(e, ast.Call):
+        base = expr_chain(e.func)
+        if base is None:
+            return None
+        return base[:-1] + [base[-1] + "()"]
+    return None
+
+
+class Project:
+    """Every .py under <root>/<pkg>, parsed once, fully indexed."""
+
+    def __init__(self, root: str, pkg: str = "surrealdb_tpu"):
+        self.root = os.path.abspath(root)
+        self.pkg = pkg
+        self.files: dict[str, FileInfo] = {}       # rel -> FileInfo
+        self.parse_errors: list[Finding] = []
+        self.parse_count = 0
+        self.classes: dict[str, list[ClassNode]] = {}   # name -> nodes
+        self.class_at: dict[tuple, ClassNode] = {}      # (rel,name)
+        self.funcs: dict[tuple, FuncNode] = {}          # (rel,qual)
+        self.module_funcs: dict[tuple, FuncNode] = {}   # (rel,name)
+        self.module_locks: dict[tuple, str] = {}        # (rel,name)->ctor
+        self.module_types: dict[tuple, str] = {}        # (rel,name)->cls
+        # per-module import map: rel -> {local name: (target_rel, name)}
+        self.imports: dict[str, dict[str, tuple]] = {}
+        # declarer index: lock attr name -> set of class names
+        self.lock_declarers: dict[str, set[str]] = {}
+        self._load()
+        self._index()
+
+    # -- loading -----------------------------------------------------------
+
+    def _load(self) -> None:
+        pkg_dir = os.path.join(self.root, self.pkg)
+        for dirpath, _dirs, names in os.walk(pkg_dir):
+            for fn in sorted(names):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+                self.parse_count += 1
+                try:
+                    tree = ast.parse(src)
+                except SyntaxError as e:
+                    self.parse_errors.append(Finding(
+                        "parse", rel, e.lineno or 1,
+                        f"syntax error: {e.msg}", detail="syntax"))
+                    continue
+                self.files[rel] = FileInfo(path, rel, src, tree)
+
+    # -- indexing ----------------------------------------------------------
+
+    def _module_rel(self, dotted: str) -> str | None:
+        """surrealdb_tpu.kvs.remote -> surrealdb_tpu/kvs/remote.py"""
+        parts = dotted.split(".")
+        cand = "/".join(parts) + ".py"
+        if cand in self.files:
+            return cand
+        cand = "/".join(parts) + "/__init__.py"
+        if cand in self.files:
+            return cand
+        return None
+
+    def _index_imports(self, rel: str, fi: FileInfo) -> None:
+        imap: dict[str, tuple] = {}
+        pkg_parts = rel.split("/")[:-1]  # directory of this module
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    dotted = ".".join(
+                        base + (node.module.split(".") if node.module
+                                else []))
+                else:
+                    dotted = node.module or ""
+                target = self._module_rel(dotted)
+                if target is None:
+                    continue
+                for a in node.names:
+                    imap[a.asname or a.name] = (target, a.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    target = self._module_rel(a.name)
+                    if target is not None:
+                        imap[a.asname or a.name.split(".")[0]] = (
+                            target, "*module*")
+        self.imports[rel] = imap
+
+    def _index(self) -> None:
+        for rel, fi in self.files.items():
+            self._index_imports(rel, fi)
+            for node in fi.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._index_class(rel, fi, node)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    fn = FuncNode(rel, node.name, node, None, fi)
+                    self.funcs[fn.key] = fn
+                    self.module_funcs[(rel, node.name)] = fn
+                    self._index_nested(rel, fi, node, node.name, None)
+                elif isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Call):
+                    ctor = _ctor_name(node.value)
+                    for t in node.targets:
+                        if not isinstance(t, ast.Name):
+                            continue
+                        if ctor in _LOCK_CTORS:
+                            self.module_locks[(rel, t.id)] = ctor
+                        elif ctor and ctor in self.classes:
+                            self.module_types[(rel, t.id)] = ctor
+        # second pass: module-level instances of classes defined later
+        for rel, fi in self.files.items():
+            for node in fi.tree.body:
+                if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Call):
+                    ctor = _ctor_name(node.value)
+                    if ctor in self.classes:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self.module_types[(rel, t.id)] = ctor
+
+    def _index_nested(self, rel, fi, fn_node, prefix, cls) -> None:
+        for sub in ast.walk(fn_node):
+            if sub is fn_node or not isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qual = f"{prefix}.{sub.name}"
+            nested = FuncNode(rel, qual, sub, cls, fi)
+            self.funcs.setdefault(nested.key, nested)
+
+    def _index_class(self, rel: str, fi: FileInfo,
+                     node: ast.ClassDef) -> None:
+        cn = ClassNode(rel, node.name, node)
+        for b in node.bases:
+            ch = expr_chain(b)
+            if ch:
+                cn.bases.append(ch[-1])
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{node.name}.{item.name}"
+                fn = FuncNode(rel, qual, item, node.name, fi)
+                self.funcs[fn.key] = fn
+                cn.methods[item.name] = fn
+                self._index_nested(rel, fi, item, qual, node.name)
+                self._harvest_attrs(cn, item)
+        self.classes.setdefault(node.name, []).append(cn)
+        self.class_at[(rel, node.name)] = cn
+        for attr in cn.lock_attrs:
+            self.lock_declarers.setdefault(attr, set()).add(node.name)
+
+    def _harvest_attrs(self, cn: ClassNode, fn) -> None:
+        """Record `self.x = Ctor(...)` instance-attr types, lock
+        declarations, and Condition-over-lock pairings."""
+        for sub in ast.walk(fn):
+            if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (sub.targets if isinstance(sub, ast.Assign)
+                       else [sub.target])
+            val = sub.value
+            if val is None or not isinstance(val, ast.Call):
+                continue
+            ctor = _ctor_name(val)
+            for t in targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                if ctor in _LOCK_CTORS:
+                    cn.lock_attrs[t.attr] = ctor
+                    if ctor == "Condition" and val.args:
+                        inner = expr_chain(val.args[0])
+                        if inner and inner[0] == "self" and len(inner) == 2:
+                            cn.cond_over[t.attr] = inner[1]
+                elif ctor:
+                    cn.attr_types.setdefault(t.attr, ctor)
+
+    # -- lookups shared by the analyses ------------------------------------
+
+    def resolve_class(self, name: str, rel: str) -> ClassNode | None:
+        """Class by name, preferring same module, then import map, then
+        a unique global declaration."""
+        cn = self.class_at.get((rel, name))
+        if cn is not None:
+            return cn
+        imp = self.imports.get(rel, {}).get(name)
+        if imp and imp[1] != "*module*":
+            cn = self.class_at.get((imp[0], imp[1]))
+            if cn is not None:
+                return cn
+        cands = self.classes.get(name, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def method_of(self, cls_name: str, meth: str,
+                  rel: str) -> FuncNode | None:
+        """Resolve Class.meth following bases by name (bounded)."""
+        seen = set()
+        queue = [cls_name]
+        while queue:
+            name = queue.pop(0)
+            if name in seen:
+                continue
+            seen.add(name)
+            cn = self.resolve_class(name, rel)
+            if cn is None:
+                continue
+            fn = cn.methods.get(meth)
+            if fn is not None:
+                return fn
+            queue.extend(cn.bases)
+        return None

@@ -1,0 +1,200 @@
+"""Deadline-propagation analysis.
+
+Generalizes legacy rule 3 (exec/stream.py operators must stay
+deadline-checked) to the whole serving cone: every function reachable
+from the executor / scatter-gather / fan-out entry points is scanned
+for loops that can run long without a cancellation point.
+
+A loop is a *candidate* when it is a `while` loop, or a `for` loop
+whose body contains a call that can block (per the blocking-primitive
+summaries) — a for-loop over an in-memory list doing pure compute
+terminates on its own and is not flagged.
+
+A candidate passes when its body (or its loop condition) reaches a
+cancellation point:
+
+- a `check_deadline()` call (any receiver),
+- a call to a function that itself calls `check_deadline()` within
+  CHECK_DEPTH call-graph hops (the legacy "drains a child's
+  `.execute(ctx)`" allowance, generalized),
+- an unresolved `.execute(` call (the streaming-operator drain shape),
+- a budget-bounded primitive: iteration over `range(<constant>)`, or a
+  condition consulting a deadline/budget (`remaining()`, `deadline`,
+  `is_set()`, `mark_timed_out`...),
+- a `# lint: deadline(<reason>)` pragma on the loop line, or a
+  baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import CallGraph, _walk_skipping
+from .core import Finding, Project
+
+CHECK_DEPTH = 3
+
+# entry points of the serving cone: (rel, qual glob)
+import fnmatch
+
+DEFAULT_ENTRIES = (
+    ("surrealdb_tpu/exec/executor.py", "Executor.*"),
+    ("surrealdb_tpu/exec/stream.py", "*Op._execute"),
+    ("surrealdb_tpu/exec/stream.py", "try_stream_*"),
+    ("surrealdb_tpu/idx/shardvec.py", "scatter_gather"),
+    ("surrealdb_tpu/idx/shardvec.py", "merge_topk"),
+    ("surrealdb_tpu/idx/shardvec.py", "ShardedVectorIndex.knn"),
+    ("surrealdb_tpu/server/fanout.py", "FanoutHub.publish"),
+    ("surrealdb_tpu/server/fanout.py", "FanoutHub.deliver"),
+)
+
+# names whose presence in a while-condition marks it budget-bounded
+_BUDGET_COND_TOKENS = ("deadline", "remaining", "budget", "is_set",
+                      "timed_out", "cancelled", "retries", "attempt")
+_CHECK_ATTRS = {"check_deadline"}
+_DRAIN_ATTRS = {"execute", "check_deadline"}
+
+
+def _loop_condition_bounded(loop) -> bool:
+    if isinstance(loop, ast.While):
+        test = loop.test
+        if isinstance(test, ast.Constant):
+            return False  # while True
+        for n in ast.walk(test):
+            name = None
+            if isinstance(n, ast.Name):
+                name = n.id
+            elif isinstance(n, ast.Attribute):
+                name = n.attr
+            if name and any(t in name.lower()
+                            for t in _BUDGET_COND_TOKENS):
+                return True
+            # `while i < len(buf):` — a cursor bounded by in-memory
+            # data; the parser/codec loops terminate by construction
+            if isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Name) and n.func.id == "len":
+                return True
+        return False
+    if isinstance(loop, ast.For):
+        it = loop.iter
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id in ("range", "enumerate", "zip",
+                                   "reversed", "sorted"):
+            return True
+        return False
+    return False
+
+
+def deadline_findings(project: Project, graph: CallGraph,
+                      can_block: dict,
+                      entries=DEFAULT_ENTRIES) -> list[Finding]:
+    roots = set()
+    for key, fn in project.funcs.items():
+        for rel_pat, qual_pat in entries:
+            if fnmatch.fnmatch(key[0], rel_pat) and \
+                    fnmatch.fnmatch(key[1], qual_pat):
+                roots.add(key)
+    reachable = graph.reachable_from(roots)
+    # closures of reachable functions run in the same serving context
+    # even when they're only ever passed as callbacks (no direct call
+    # edge) — e.g. the scatter worker handed to the dispatch pool
+    for key in list(project.funcs):
+        rel, qual = key
+        while "." in qual:
+            qual = qual.rsplit(".", 1)[0]
+            if (rel, qual) in reachable:
+                reachable.add(key)
+                break
+
+    # functions that themselves check the deadline, propagated down
+    checks = {}
+    for key, sites in graph.sites.items():
+        for cs in sites:
+            node = cs.node
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if attr in _CHECK_ATTRS:
+                checks[key] = 0
+                break
+    checks = graph.transitive(checks, CHECK_DEPTH)
+
+    # nested defs are their own FuncNodes — a closure's loop must be
+    # attributed to the closure only, or one loop double-reports under
+    # two identities and the baseline can never cover both
+    nested_of: dict[tuple, set] = {}
+    for (rel, qual), f2 in project.funcs.items():
+        if "." not in qual:
+            continue
+        parent = (rel, qual.rsplit(".", 1)[0])
+        if parent in project.funcs:
+            nested_of.setdefault(parent, set()).add(f2.node)
+
+    findings = []
+    for key in sorted(reachable):
+        fn = project.funcs.get(key)
+        if fn is None:
+            continue
+        fi = fn.file
+        site_by_node = {cs.node: cs
+                        for cs in graph.sites.get(key, ())}
+        loops = [n for n in _walk_skipping(fn.node,
+                                           nested_of.get(key, set()))
+                 if isinstance(n, (ast.While, ast.For))]
+        # source order, so the while#N/for#N details are stable
+        loops.sort(key=lambda n: (n.lineno, n.col_offset))
+        counters: dict[str, int] = {}
+        for loop in loops:
+            kind = "while" if isinstance(loop, ast.While) else "for"
+            counters[kind] = counters.get(kind, 0) + 1
+            detail = f"{kind}#{counters[kind]}"
+            body_calls = [n for n in ast.walk(loop)
+                          if isinstance(n, ast.Call)]
+            blocking_body = any(
+                (site_by_node.get(c) is not None
+                 and site_by_node[c].target in can_block)
+                or _body_primitive_blocks(c)
+                for c in body_calls)
+            if kind == "for" and not blocking_body:
+                continue
+            if _loop_condition_bounded(loop):
+                continue
+            ok = False
+            for c in body_calls:
+                f = c.func
+                attr = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None)
+                if attr in _DRAIN_ATTRS:
+                    ok = True
+                    break
+                cs = site_by_node.get(c)
+                if cs is not None and cs.target in checks:
+                    ok = True
+                    break
+            if ok:
+                continue
+            if fi.waived(loop.lineno, "deadline"):
+                continue
+            why = ("loops forever-capable (`while`)" if kind == "while"
+                   else "iterates with a blocking call per step")
+            findings.append(Finding(
+                "deadline", fn.rel, loop.lineno,
+                f"{kind}-loop in `{fn.qual}` (reachable from the "
+                f"serving entry points) {why} without reaching "
+                f"check_deadline()/a budget-bounded primitive — a "
+                f"KILL/timeout cannot land; add a check or waive with "
+                f"`# lint: deadline(<reason>)`",
+                func=fn.qual, detail=detail,
+            ))
+    return findings
+
+
+_PRIM_BLOCK = {"sleep", "recv", "recv_exact", "recv_frame", "send_frame",
+               "sendall", "wait", "accept", "connect"}
+
+
+def _body_primitive_blocks(call: ast.Call) -> bool:
+    f = call.func
+    attr = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return attr in _PRIM_BLOCK

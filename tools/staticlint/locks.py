@@ -1,0 +1,703 @@
+"""Lock-order graph and blocking-under-lock analyses.
+
+Lock identity
+-------------
+A lock is identified by (owner class, attribute) when the owner can be
+resolved — `self.lock` in a `TpuVectorIndex` method is
+``TpuVectorIndex.lock``; `ds.lock` resolves through the receiver-name
+conventions to ``Datastore.lock``. A module-level lock is
+``<module>:<name>``. When the owner cannot be resolved the identity
+degrades to ``?<base>.<attr>`` — still usable for blocking-under-lock
+(any held lock counts) but kept OUT of cycle detection, where a merged
+unknown would manufacture false cycles.
+
+A Condition constructed over an explicit lock (``Condition(self._qlock)``)
+aliases to the underlying lock's identity, so `with self._qcond:` and
+`with self._qlock:` are one node.
+
+Order edges
+-----------
+Edge A -> B when B is acquired while A is held, along intraprocedural
+paths AND interprocedural ones: if f holds A and calls g, every lock g
+(transitively, depth-bounded) acquires is ordered after A. Cycles in
+the resulting digraph are reported once each, with the full witness
+path (who held what where, through which calls).
+
+Blocking-under-lock
+-------------------
+A call is blocking when it hits a primitive table (socket send/recv,
+`.wait`/`.join`/`sleep`, fsync) or resolves into a function that
+transitively (depth-bounded) reaches one — the KV/remote/device
+dispatch entry points are seeded explicitly so their whole caller
+cone counts. Any blocking call while >= 1 lock is held is a finding
+unless waived by `# lint: lock-held(<reason>)` on the call or `with`
+line, or matched by the baseline.
+
+Waiting on the very condition you hold is exempt (Condition.wait
+releases its own lock); every OTHER held lock still counts.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import CallGraph, _local_types, _walk_skipping
+from .core import Finding, FuncNode, Project, expr_chain
+
+# attribute names that are blocking wherever they appear
+BLOCK_ATTRS = {
+    "sendall": "socket send", "sendto": "socket send",
+    "recv": "socket recv", "recv_into": "socket recv",
+    "connect": "socket connect", "accept": "socket accept",
+    "makefile": "socket I/O", "getaddrinfo": "DNS lookup",
+    "fsync": "file fsync", "flush_and_sync": "file fsync",
+}
+# blocking but with shape heuristics (see _call_blocks)
+BLOCK_ATTRS_SOFT = {
+    "send": "socket/pipe send",
+    "wait": "Event/Condition wait",
+    "wait_ready": "runner handshake wait",
+    "join": "thread join",
+    "sleep": "sleep",
+}
+BLOCK_NAMES = {"sleep": "sleep", "fsync": "file fsync",
+               "sleep_s": "seam sleep", "select": "select()"}
+# function seeds: these are THE blocking entry points of the tree —
+# remote KV dispatch, retry loops, frame I/O, device dispatch. Their
+# transitive caller cone is what "can reach a blocking operation" means.
+BLOCK_FUNC_SEEDS = {
+    ("surrealdb_tpu/kvs/net.py", "send_frame"): "frame send",
+    ("surrealdb_tpu/kvs/net.py", "recv_frame"): "frame recv",
+    ("surrealdb_tpu/kvs/net.py", "recv_exact"): "frame recv",
+    ("surrealdb_tpu/kvs/net.py", "sleep_s"): "seam sleep",
+    ("surrealdb_tpu/kvs/remote.py", "RetryPolicy.run"): "KV retry loop",
+    ("surrealdb_tpu/kvs/remote.py", "_Pool.call"): "remote KV call",
+    ("surrealdb_tpu/kvs/remote.py", "_status_of"): "KV status probe",
+    ("surrealdb_tpu/device/supervisor.py",
+     "DeviceSupervisor.call"): "device dispatch",
+    ("surrealdb_tpu/device/supervisor.py",
+     "DeviceSupervisor.ensure_loaded"): "device store load",
+    ("surrealdb_tpu/device/supervisor.py",
+     "DeviceSupervisor.wait_ready"): "runner handshake",
+}
+# attr-name fallbacks for receivers the callgraph can't type: a `.run(`
+# on something named like a retry policy, a `.call(` on a pool/sup
+BLOCK_ATTR_RECEIVERS = {
+    ("run", ("retry", "policy")): "retry-policy run",
+    ("call", ("pool", "sup", "supervisor")): "remote/device call",
+}
+PROPAGATE_DEPTH = 4
+ACQUIRE_DEPTH = 3
+
+_LOCKISH_LAST = ("lock", "cond", "mu", "mutex")
+
+
+def _is_lockish_name(name: str) -> bool:
+    low = name.lower()
+    if low in ("mu", "rw", "mutex"):
+        return True
+    for seg in low.split("_"):
+        # "clock"/"use_clock" must NOT read as lock-ish
+        if seg.endswith("lock") and not seg.endswith("clock"):
+            return True
+        if seg.endswith("cond"):
+            return True
+    return False
+
+
+class Acquisition:
+    __slots__ = ("lock_id", "chain", "lineno", "with_lineno", "resolved")
+
+    def __init__(self, lock_id, chain, lineno, with_lineno, resolved):
+        self.lock_id = lock_id
+        self.chain = chain            # printable source chain
+        self.lineno = lineno
+        self.with_lineno = with_lineno
+        self.resolved = resolved      # owner class known?
+
+
+class LockModel:
+    """Shared per-function lock walk: acquisitions, order edges, and
+    call-sites annotated with the locks held at that moment."""
+
+    def __init__(self, project: Project, graph: CallGraph):
+        self.project = project
+        self.graph = graph
+        # per function: list[Acquisition]
+        self.acquires: dict[tuple, list[Acquisition]] = {}
+        # per function: [(CallSite, tuple[Acquisition, ...] held)]
+        self.calls_held: dict[tuple, list] = {}
+        # intraprocedural order edges: (a, b) -> witness dict
+        self.edges: dict[tuple, dict] = {}
+        # re-acquisitions of a held NON-reentrant lock: instant
+        # self-deadlock. [(fn key, lock_id, lineno, held Acquisition)]
+        self.self_reacquire: list = []
+        # lock_id -> ctor kind, where declared ("Lock"/"lock" are the
+        # non-reentrant kinds; RLock/Condition/etc. reenter safely)
+        self.kinds: dict[str, str] = {}
+        for cls_list in project.classes.values():
+            for cn in cls_list:
+                for attr, ctor in cn.lock_attrs.items():
+                    self.kinds[f"{cn.name}.{attr}"] = ctor
+        for (rel, name), ctor in project.module_locks.items():
+            self.kinds[f"{rel}:{name}"] = ctor
+        self._nested_of: dict[tuple, set] = {}
+        for (rel, qual), f2 in project.funcs.items():
+            if "." not in qual:
+                continue
+            parent = (rel, qual.rsplit(".", 1)[0])
+            if parent in project.funcs:
+                self._nested_of.setdefault(parent, set()).add(f2.node)
+        for key, fn in project.funcs.items():
+            self._walk_fn(key, fn)
+
+    # -- identity ----------------------------------------------------------
+
+    def lock_identity(self, expr, fn: FuncNode,
+                      local_types) -> Acquisition | None:
+        chain = expr_chain(expr)
+        if chain is None:
+            return None
+        # with self.rw.read(): / with rt.lock(): — a factory/view call
+        called = chain[-1].endswith("()")
+        parts = list(chain)
+        if called:
+            leaf = parts[-1][:-2]
+            if leaf in ("read", "write"):
+                parts = parts[:-1]          # the RWLock itself
+            elif _is_lockish_name(leaf):
+                parts = parts[:-1] + [leaf]  # rt.lock() -> rt.lock
+            else:
+                return None
+        attr = parts[-1]
+        if not _is_lockish_name(attr):
+            return None
+        chain_str = ".".join(chain)
+        lineno = expr.lineno
+        from .callgraph import receiver_type
+        # module-level lock: bare Name
+        if len(parts) == 1:
+            if (fn.rel, attr) in self.project.module_locks:
+                return Acquisition(f"{fn.rel}:{attr}", chain_str,
+                                   lineno, lineno, True)
+            imp = self.project.imports.get(fn.rel, {}).get(attr)
+            if imp and (imp[0], imp[1]) in self.project.module_locks:
+                return Acquisition(f"{imp[0]}:{imp[1]}", chain_str,
+                                   lineno, lineno, True)
+            return Acquisition(f"?{fn.rel}:{attr}", chain_str,
+                               lineno, lineno, False)
+        owner = receiver_type(parts[:-1], fn, self.project, local_types)
+        if owner is not None:
+            # attribute inherited from a base: identity belongs to the
+            # DECLARING class, or two subclasses' acquisitions of the
+            # same lock would be two graph nodes and cycles could hide
+            owner, cn = self._declaring_class(owner, attr, fn.rel)
+            if cn is not None:
+                real = cn.cond_over.get(attr, attr)
+                return Acquisition(f"{owner}.{real}", chain_str,
+                                   lineno, lineno, True)
+            return Acquisition(f"{owner}.{attr}", chain_str,
+                               lineno, lineno, True)
+        # sole declarer in the project?
+        declarers = self.project.lock_declarers.get(attr, set())
+        if len(declarers) == 1:
+            owner = next(iter(declarers))
+            cn = self.project.resolve_class(owner, fn.rel)
+            real = cn.cond_over.get(attr, attr) if cn else attr
+            return Acquisition(f"{owner}.{real}", chain_str,
+                               lineno, lineno, True)
+        base = parts[0] if parts[0] != "self" else f"{fn.cls}?"
+        return Acquisition(f"?{base}.{attr}", chain_str,
+                           lineno, lineno, False)
+
+    def _declaring_class(self, cls_name: str, attr: str, rel: str):
+        """Walk the base-class chain (by name, bounded by the project)
+        to the class that actually declares the lock attribute."""
+        seen = set()
+        queue = [cls_name]
+        while queue:
+            name = queue.pop(0)
+            if name in seen:
+                continue
+            seen.add(name)
+            cn = self.project.resolve_class(name, rel)
+            if cn is None:
+                continue
+            if attr in cn.lock_attrs or attr in cn.cond_over:
+                return name, cn
+            queue.extend(cn.bases)
+        return cls_name, self.project.resolve_class(cls_name, rel)
+
+    # -- per-function walk -------------------------------------------------
+
+    def _walk_fn(self, key, fn: FuncNode) -> None:
+        local_types = _local_types(fn, self.project)
+        acqs: list[Acquisition] = []
+        calls: list = []
+        sites = {cs.node: cs for cs in self.graph.sites.get(key, ())}
+        nested = self._nested_of.get(key, set())
+
+        def visit(node, held):
+            if node in nested:
+                return
+            if isinstance(node, ast.With) or isinstance(
+                    node, ast.AsyncWith):
+                new = []
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Call) and sub in sites:
+                            calls.append((sites[sub], tuple(held)))
+                    a = self.lock_identity(item.context_expr, fn,
+                                           local_types)
+                    if a is not None:
+                        a.with_lineno = node.lineno
+                        new.append(a)
+                for a in new:
+                    acqs.append(a)
+                    for h in held:
+                        if h.lock_id != a.lock_id:
+                            self._edge(h, a, fn)
+                        elif self._non_reentrant(a.lock_id):
+                            self.self_reacquire.append(
+                                (key, a.lock_id, a.lineno, h))
+                inner = list(held) + new
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Call) and node in sites:
+                calls.append((sites[node], tuple(held)))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.node.body:
+            visit(stmt, [])
+        self.acquires[key] = acqs
+        self.calls_held[key] = calls
+
+    def _non_reentrant(self, lock_id: str) -> bool:
+        """True only when the lock's DECLARED kind is a plain Lock
+        (threading.Lock / the seam's runtime.lock()). Unknown kinds
+        stay quiet — flagging an RLock or a different instance of the
+        same attr would be noise, not signal."""
+        return self.kinds.get(lock_id) in ("Lock", "lock")
+
+    def _edge(self, a: Acquisition, b: Acquisition, fn: FuncNode,
+              via: str = "") -> None:
+        k = (a.lock_id, b.lock_id)
+        if k not in self.edges:
+            self.edges[k] = {
+                "rel": fn.rel, "func": fn.qual,
+                "lineno": b.lineno, "held_at": a.with_lineno,
+                "held_chain": a.chain, "acq_chain": b.chain,
+                "via": via,
+            }
+
+    # -- interprocedural acquisition summaries -----------------------------
+
+    def transitive_acquires(self) -> dict[tuple, dict]:
+        """func key -> {lock_id: (depth, path str)} bounded depth.
+        Only resolved (owner-known) locks propagate — an unknown-owner
+        lock in a callee is not the same object for ordering purposes."""
+        base: dict[tuple, dict] = {}
+        for key, acqs in self.acquires.items():
+            fn = self.project.funcs[key]
+            d = {}
+            for a in acqs:
+                if a.resolved:
+                    d.setdefault(
+                        a.lock_id,
+                        (0, f"{fn.rel}:{a.lineno} {fn.qual} takes "
+                            f"`{a.chain}`"))
+            base[key] = d
+        out = {k: dict(v) for k, v in base.items()}
+        for _round in range(ACQUIRE_DEPTH):
+            changed = False
+            for key in out:
+                fnq = self.project.funcs[key].qual
+                for callee in self.graph.edges.get(key, ()):
+                    for lid, (dep, path) in out.get(callee, {}).items():
+                        if dep + 1 > ACQUIRE_DEPTH:
+                            continue
+                        cur = out[key].get(lid)
+                        if cur is None or dep + 1 < cur[0]:
+                            out[key][lid] = (
+                                dep + 1,
+                                f"{fnq} -> {path}")
+                            changed = True
+            if not changed:
+                break
+        return out
+
+
+# -- analyses --------------------------------------------------------------
+
+
+def lock_order_findings(project: Project, graph: CallGraph,
+                        model: LockModel) -> list[Finding]:
+    findings = []
+    # self-deadlock: re-taking a held non-reentrant Lock, inline
+    for key, lid, lineno, h in model.self_reacquire:
+        fn = project.funcs[key]
+        fi = fn.file
+        if fi.waived(lineno, "lock-order") \
+                or fi.waived(h.with_lineno, "lock-order"):
+            continue
+        findings.append(Finding(
+            "lock-order", fn.rel, lineno,
+            f"re-acquisition of non-reentrant `{lid}` already held "
+            f"from line {h.with_lineno} in {fn.qual} — threading.Lock "
+            f"does not reenter; this deadlocks on first execution",
+            func=fn.qual, detail=f"self:{lid}"))
+    edges = dict(model.edges)
+    # interprocedural edges: f holds A and calls g => A -> acquires*(g)
+    summaries = model.transitive_acquires()
+    for key, calls in model.calls_held.items():
+        fn = project.funcs[key]
+        fi = fn.file
+        for cs, held in calls:
+            if not held or cs.target is None:
+                continue
+            callee_q = project.funcs[cs.target].qual \
+                if cs.target in project.funcs else cs.target[1]
+            for lid, (dep, path) in summaries.get(cs.target, {}).items():
+                for h in held:
+                    if not h.resolved:
+                        continue
+                    if h.lock_id == lid:
+                        # callee re-takes the held lock: deadlock when
+                        # the lock kind does not reenter
+                        if model._non_reentrant(lid) and not (
+                                fi.waived(cs.lineno, "lock-order")
+                                or fi.waived(h.with_lineno,
+                                             "lock-order")):
+                            findings.append(Finding(
+                                "lock-order", fn.rel, cs.lineno,
+                                f"call `{callee_q}()` re-acquires "
+                                f"non-reentrant `{lid}` already held "
+                                f"from line {h.with_lineno} in "
+                                f"{fn.qual} ({path}) — threading.Lock "
+                                f"does not reenter; this deadlocks on "
+                                f"first execution",
+                                func=fn.qual, detail=f"self:{lid}"))
+                        continue
+                    k = (h.lock_id, lid)
+                    if k not in edges:
+                        edges[k] = {
+                            "rel": fn.rel, "func": fn.qual,
+                            "lineno": cs.lineno,
+                            "held_at": h.with_lineno,
+                            "held_chain": h.chain,
+                            "acq_chain": lid,
+                            "via": (f"call `{callee_q}()` at "
+                                    f"{fn.rel}:{cs.lineno} -> {path}"),
+                        }
+    # cycle detection over resolved-identity nodes only
+    adj: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        if a.startswith("?") or b.startswith("?"):
+            continue
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+    seen_cycles = set()
+    for cycle in _find_cycles(adj):
+        cyc_key = "->".join(sorted(set(cycle)))
+        if cyc_key in seen_cycles:
+            continue
+        seen_cycles.add(cyc_key)
+        steps = []
+        anchor = None
+        for i in range(len(cycle)):
+            a, b = cycle[i], cycle[(i + 1) % len(cycle)]
+            w = edges.get((a, b))
+            if w is None:
+                continue
+            if anchor is None:
+                anchor = w
+            via = f" via {w['via']}" if w["via"] else ""
+            steps.append(
+                f"{a} -> {b} [{w['rel']}:{w['lineno']} in "
+                f"{w['func']}, holding `{w['held_chain']}` from line "
+                f"{w['held_at']}{via}]")
+        if anchor is None:
+            continue
+        fi = project.files.get(anchor["rel"])
+        if fi is not None and (
+                fi.waived(anchor["lineno"], "lock-order")
+                or fi.waived(anchor["held_at"], "lock-order")):
+            continue
+        findings.append(Finding(
+            "lock-order", anchor["rel"], anchor["lineno"],
+            "lock-order cycle (potential deadlock): "
+            + "; ".join(steps),
+            func=anchor["func"],
+            detail=cyc_key,
+        ))
+    return findings
+
+
+def _find_cycles(adj: dict[str, set[str]]) -> list[list[str]]:
+    """One representative cycle per SCC with size > 1. Self-loops never
+    reach this graph: same-lock re-acquisition is reported separately
+    (non-reentrant kinds only) before edges are built."""
+    index = {}
+    low = {}
+    stack: list[str] = []
+    on = set()
+    sccs = []
+    counter = [0]
+
+    def strong(v):
+        work = [(v, iter(adj.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(adj.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(comp)
+
+    for v in list(adj):
+        if v not in index:
+            strong(v)
+    cycles = []
+    for comp in sccs:
+        comp_set = set(comp)
+        # walk a cycle inside the SCC starting anywhere
+        start = comp[0]
+        path = [start]
+        seen = {start}
+        cur = start
+        while True:
+            nxt = next((w for w in adj.get(cur, ())
+                        if w in comp_set), None)
+            if nxt is None:
+                break
+            if nxt == start:
+                cycles.append(path)
+                break
+            if nxt in seen:
+                i = path.index(nxt)
+                cycles.append(path[i:])
+                break
+            path.append(nxt)
+            seen.add(nxt)
+            cur = nxt
+    return cycles
+
+
+def _call_blocks(cs, fn: FuncNode, held, project: Project,
+                 can_block: dict, model: LockModel) -> str | None:
+    """Reason string when the call site blocks while `held` matters."""
+    node = cs.node
+    if cs.target is not None:
+        info = can_block.get(cs.target)
+        if info is not None:
+            return info
+        return None
+    attr = cs.attr
+    if attr is None:
+        return None
+    f = node.func
+    if attr in BLOCK_ATTRS:
+        return BLOCK_ATTRS[attr]
+    if attr in BLOCK_NAMES and isinstance(f, ast.Name):
+        return BLOCK_NAMES[attr]
+    if attr not in BLOCK_ATTRS_SOFT:
+        # receiver-name fallbacks
+        if isinstance(f, ast.Attribute):
+            ch = expr_chain(f.value) or []
+            base = ".".join(ch).lower()
+            for (a, hints), why in BLOCK_ATTR_RECEIVERS.items():
+                if attr == a and any(h in base for h in hints):
+                    return why
+        return None
+    # shape heuristics ----------------------------------------------------
+    if attr == "sleep":
+        return "sleep"
+    if attr == "send":
+        # generator/coroutine .send(value) is in-process compute, not
+        # I/O — only flag receivers that read as a socket/pipe/link
+        # (legacy rule 7 keeps its stricter any-.send ban on the
+        # notify path, where a generator send has no business either)
+        if isinstance(f, ast.Attribute):
+            ch = expr_chain(f.value) or []
+            base = (ch[-1] if ch else "").lower()
+            if any(hint in base for hint in
+                   ("sock", "conn", "link", "pipe", "ws", "chan",
+                    "peer", "transport", "client", "stream")):
+                return "socket/pipe send"
+        return None
+    if attr == "wait" or attr == "wait_ready":
+        if held is None:
+            return "Event/Condition wait"   # summary mode: it blocks
+        if not isinstance(f, ast.Attribute):
+            return "wait"
+        ch = expr_chain(f.value)
+        chain_str = ".".join(ch) if ch else ""
+        # waiting on the condition you hold releases it — exempt that
+        # lock; if ANY other lock is held the wait still blocks them
+        others = [h for h in held if h.chain != chain_str
+                  and not _cond_alias(h, chain_str, fn, project)]
+        if not others:
+            return None
+        return "Event/Condition wait"
+    if attr == "join":
+        args = node.args
+        if len(args) == 1 and not node.keywords:
+            a0 = args[0]
+            if isinstance(a0, (ast.GeneratorExp, ast.ListComp)):
+                return None  # "".join(x for ...) — string join
+            if isinstance(a0, ast.Constant) and isinstance(
+                    a0.value, (int, float)):
+                return "thread join"
+            # sep.join(iterable) vs t.join(timeout): undecidable —
+            # stay quiet unless the receiver is a known str constant
+            if isinstance(f, ast.Attribute) and isinstance(
+                    f.value, ast.Constant):
+                return None
+            return None
+        if isinstance(f, ast.Attribute) and isinstance(
+                f.value, ast.Constant):
+            return None
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            return "thread join"
+        if not args and not node.keywords:
+            return "thread join"
+        return None
+    return None
+
+
+def _cond_alias(h, chain_str: str, fn: FuncNode,
+                project: Project) -> bool:
+    """held `self._qlock` vs wait on `self._qcond` whose Condition
+    wraps that lock — one object."""
+    if not chain_str:
+        return False
+    parts = chain_str.split(".")
+    if len(parts) != 2 or parts[0] != "self" or fn.cls is None:
+        return False
+    cn = project.class_at.get((fn.rel, fn.cls))
+    if cn is None:
+        return False
+    under = cn.cond_over.get(parts[1])
+    return under is not None and h.chain == f"self.{under}"
+
+
+def seed_integrity_findings(project: Project) -> list[Finding]:
+    """Rename-proof teeth for the blocking-seed table (same discipline
+    as legacy rules 7-10): when a seed's FILE is part of the scanned
+    tree but the function is gone, the whole caller cone silently
+    stops counting as blocking — that is a finding, not a shrug.
+    Fixture trees that don't ship the file are unaffected."""
+    out = []
+    for (rel, qual), why in sorted(BLOCK_FUNC_SEEDS.items()):
+        if rel in project.files and (rel, qual) not in project.funcs:
+            out.append(Finding(
+                "lock-held", rel, 1,
+                f"blocking-seed function `{qual}` not found — the "
+                f"blocking-under-lock analysis no longer knows this "
+                f"{why} entry point blocks (update BLOCK_FUNC_SEEDS "
+                f"after a rename)",
+                func=qual, detail=f"missing-seed:{qual}"))
+    return out
+
+
+def blocking_summaries(project: Project, graph: CallGraph,
+                       model: LockModel) -> dict[tuple, str]:
+    """func key -> human chain describing how it reaches a blocking
+    primitive (bounded depth)."""
+    seeds: dict[tuple, str] = {}
+    for key, why in BLOCK_FUNC_SEEDS.items():
+        if key in project.funcs:
+            seeds[key] = why
+    for key, sites in graph.sites.items():
+        fn = project.funcs[key]
+        if key in seeds:
+            continue
+        for cs in sites:
+            if cs.target is not None:
+                continue
+            why = _call_blocks(cs, fn, None, project, {}, model)
+            if why is not None:
+                seeds.setdefault(
+                    key, f"{why} at {fn.rel}:{cs.lineno}")
+                break
+    out = dict(seeds)
+    for _ in range(PROPAGATE_DEPTH):
+        changed = False
+        for caller, callees in graph.edges.items():
+            if caller in out:
+                continue
+            for c in callees:
+                if c in out:
+                    cq = project.funcs[c].qual if c in project.funcs \
+                        else c[1]
+                    out[caller] = f"`{cq}` -> {out[c]}"
+                    changed = True
+                    break
+        if not changed:
+            break
+    return out
+
+
+def blocking_under_lock_findings(project: Project, graph: CallGraph,
+                                 model: LockModel,
+                                 can_block: dict) -> list[Finding]:
+    findings = []
+    for key, calls in model.calls_held.items():
+        fn = project.funcs[key]
+        fi = fn.file
+        for cs, held in calls:
+            if not held:
+                continue
+            why = _call_blocks(cs, fn, held, project, can_block, model)
+            if why is None:
+                continue
+            # self-seed: calling a blocking seed *is* the finding, but a
+            # function's own body being a seed doesn't flag its callees
+            label = (project.funcs[cs.target].qual
+                     if cs.target in project.funcs else
+                     (cs.attr or "<call>"))
+            waive_lines = [cs.lineno] + [h.with_lineno for h in held]
+            if any(fi.waived(ln, "lock-held") for ln in waive_lines):
+                continue
+            locks = ", ".join(
+                f"`{h.chain}` ({h.lock_id})" for h in held)
+            findings.append(Finding(
+                "lock-held", fn.rel, cs.lineno,
+                f"`{label}(` can block ({why}) while holding {locks} "
+                f"— a stalled peer/IO wedges every thread queued on "
+                f"the lock; move the call outside the critical "
+                f"section or waive with `# lint: lock-held(<reason>)`",
+                func=fn.qual,
+                detail=f"{label}@" + "+".join(
+                    sorted(h.lock_id for h in held)),
+            ))
+    return findings

@@ -1,0 +1,65 @@
+"""Waiver-pragma audit.
+
+Two vocabularies exist in the tree:
+
+- `# robust: <reason>` — the historical line waiver for the legacy
+  rules. The reason text is mandatory: a bare `# robust:` is a silent,
+  unreviewable hole and fails the gate.
+- `# lint: <rule>(<reason>)` — the rule-scoped waiver for the
+  whole-program analyses (`lock-held`, `lock-order`, `deadline`, or
+  `*`). The parenthesized reason is mandatory and must be non-empty;
+  a `# lint:` marker that doesn't parse as `rule(reason)` is also a
+  finding, so a typo can't silently waive nothing.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .core import LINT_PRAGMA_RE, LINT_TOKEN_RE, Finding, Project
+
+_ROBUST_RE = re.compile(r"#\s*robust:\s*(.*)$")
+
+KNOWN_LINT_RULES = {"lock-held", "lock-order", "deadline", "*",
+                    "notify", "knn", "mem-account", "follower",
+                    "seam", "bare-except", "thread-daemon",
+                    "stream-deadline", "twopc-swallow", "jax-import"}
+
+
+def pragma_findings(project: Project) -> list[Finding]:
+    findings = []
+    for rel, fi in project.files.items():
+        for i, line in enumerate(fi.lines, start=1):
+            m = _ROBUST_RE.search(line)
+            if m is not None and not m.group(1).strip():
+                findings.append(Finding(
+                    "pragma", rel, i,
+                    "bare `# robust:` pragma without a reason — a "
+                    "waiver must say why the finding is safe",
+                    detail=f"bare-robust@{i}"))
+            if LINT_TOKEN_RE.search(line):
+                ms = list(LINT_PRAGMA_RE.finditer(line))
+                if not ms:
+                    findings.append(Finding(
+                        "pragma", rel, i,
+                        "`# lint:` marker does not parse as "
+                        "`rule(reason)` — a malformed pragma waives "
+                        "nothing; write `# lint: lock-held(<reason>)`",
+                        detail=f"malformed-lint@{i}"))
+                for m2 in ms:
+                    rule, reason = m2.group(1), m2.group(2).strip()
+                    if not reason:
+                        findings.append(Finding(
+                            "pragma", rel, i,
+                            f"`# lint: {rule}()` has an empty reason "
+                            f"— a waiver must say why the finding is "
+                            f"safe",
+                            detail=f"noreason-lint@{i}"))
+                    if rule not in KNOWN_LINT_RULES:
+                        findings.append(Finding(
+                            "pragma", rel, i,
+                            f"`# lint: {rule}(...)` names an unknown "
+                            f"rule — known: "
+                            f"{', '.join(sorted(KNOWN_LINT_RULES))}",
+                            detail=f"unknown-lint@{i}"))
+    return findings
