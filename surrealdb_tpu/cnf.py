@@ -357,6 +357,16 @@ CHANGEFEED_GC_INTERVAL_S = env_float("SURREAL_CHANGEFEED_GC_INTERVAL_S",
 # -- execution limits (reference cnf/mod.rs names) ---------------------------
 # rows buffered per streaming operator batch (OPERATOR_BUFFER_SIZE)
 OPERATOR_BUFFER_SIZE = env_int("SURREAL_OPERATOR_BUFFER_SIZE", 1024)
+# columnar executor (exec/batch.py + exec/vops.py): "auto" engages the
+# vectorized predicate/aggregate kernels and the version-keyed table
+# column store; "off" forces every row through the scalar evaluator —
+# the conformance fallback-correctness gate diffs the two paths
+COLUMNAR = env_str("SURREAL_COLUMNAR", "auto")
+# seeded RNG for ORDER BY RAND / array::shuffle-style statement paths:
+# 0 = OS entropy (production default); a non-zero seed makes sim/bench
+# runs reproducible (the RNG is datastore-scoped, never `random`'s
+# process-global instance)
+RAND_SEED = env_int("SURREAL_RAND_SEED", 0)
 # concurrent tasks in fan-out sections (MAX_CONCURRENT_TASKS)
 MAX_CONCURRENT_TASKS = env_int("SURREAL_MAX_CONCURRENT_TASKS", 64)
 # statements per query text (guards pathological batches)

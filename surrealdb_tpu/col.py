@@ -19,13 +19,26 @@ import numpy as np
 from surrealdb_tpu import key as K
 
 class VectorColumn:
-    __slots__ = ("version", "ids", "mat", "bad_ids")
+    __slots__ = ("version", "ids", "mat", "bad_ids", "ids_enc",
+                 "_norms")
 
-    def __init__(self, version, ids, mat, bad_ids):
+    def __init__(self, version, ids, mat, bad_ids, ids_enc=None):
         self.version = version
         self.ids = ids          # decoded record-id keys, row-aligned
         self.mat = mat          # (n, dim) float32
         self.bad_ids = bad_ids  # record ids whose field didn't conform
+        # encoded id key suffixes (key order) — the row-alignment token
+        # shared with exec/batch.py TableColumns for fused filtered KNN
+        self.ids_enc = ids_enc
+        self._norms = None
+
+    def norms(self):
+        """Per-row L2 norms, computed once per version — the cosine
+        scoring path's dominant recompute (bit-identical: the cached
+        array IS np.linalg.norm(mat, axis=1))."""
+        if self._norms is None:
+            self._norms = np.linalg.norm(self.mat, axis=1)
+        return self._norms
 
 
 def _cache(ds) -> dict:
@@ -42,15 +55,16 @@ def get_vector_column(ctx, tb: str, field: str, dim: int):
     ns, db = ctx.need_ns_db()
     gk = (ns, db, tb)
     # uncommitted writes to this table in the current txn would be
-    # invisible to the committed-state column
+    # invisible to the committed-state column; fail CLOSED on write
+    # buffers we cannot see (ShardTx per-shard subs, unknown engines)
     if gk in getattr(ctx.txn, "_graph_dirty", ()):
         return None
-    btx = getattr(ctx.txn, "btx", None)
     pre = K.record_prefix(ns, db, tb)
     beg, end = K.prefix_range(pre)
-    if btx is not None and getattr(btx, "writes", None):
-        if any(beg <= k < end for k in btx.writes):
-            return None
+    from surrealdb_tpu.exec.batch import txn_range_clean
+
+    if not txn_range_clean(ctx.txn, beg, end):
+        return None
     # version is read BEFORE the build's fresh transaction opens: the
     # built state can only be newer than the stamp, so a concurrent
     # commit in between costs one rebuild next query — never staleness
@@ -88,11 +102,11 @@ def _build(ctx, txn, tb, field, dim, beg, end, pre):
         )
         ids = [K.dec_value(s)[0] for s in key_sfx]
         bad = [K.dec_value(s)[0] for s in bad_sfx]
-        return VectorColumn(0, ids, mat, bad)
+        return VectorColumn(0, ids, mat, bad, ids_enc=list(key_sfx))
     # portable fallback: Python scan + decode (still cached by version)
     from surrealdb_tpu.kvs.api import deserialize
 
-    ids, rows, bad = [], [], []
+    ids, rows, bad, ids_enc = [], [], [], []
     for k, raw in txn.scan(beg, end):
         doc = deserialize(raw)
         v = doc.get(field) if isinstance(doc, dict) else None
@@ -104,6 +118,7 @@ def _build(ctx, txn, tb, field, dim, beg, end, pre):
                 ok = False
         if ok and arr.ndim == 1 and arr.dtype.kind in ("i", "f"):
             ids.append(K.dec_value(k[len(pre):])[0])
+            ids_enc.append(k[len(pre):])
             rows.append(arr)
         else:
             bad.append(K.dec_value(k[len(pre):])[0])
@@ -111,4 +126,4 @@ def _build(ctx, txn, tb, field, dim, beg, end, pre):
         np.stack(rows).astype(np.float32)
         if rows else np.empty((0, dim), np.float32)
     )
-    return VectorColumn(0, ids, mat, bad)
+    return VectorColumn(0, ids, mat, bad, ids_enc=ids_enc)

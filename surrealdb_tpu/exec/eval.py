@@ -338,6 +338,15 @@ def _e_range(n, ctx):
 
 
 def _e_binary(n, ctx):
+    sc = ctx._stream_cols
+    if sc is not None:
+        # streaming executor: arithmetic/comparison projections may have
+        # been computed vectorized for the whole batch (exec/stream.py
+        # ColumnCache vspecs); exotic rows miss and evaluate normally
+        cols, src = sc
+        v = cols.get_row(n, src)
+        if v is not cols.MISS:
+            return v
     op = n.op
     if op == "&&":
         # short-circuit, returning the deciding VALUE (0s && 2s -> 0s)

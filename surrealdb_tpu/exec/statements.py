@@ -705,6 +705,26 @@ def select_over_sources(n: SelectStmt, sources, ctx: Ctx):
     return _select_pipeline(n, rows, c)
 
 
+def _eval_limits(n, ctx):
+    """Evaluate LIMIT/START exactly once: (ok, keep, lim, off). keep is
+    the top-k bound (LIMIT+START, both non-negative) or None; lim/off
+    are the evaluated ints to slice with (only valid when ok). On an
+    evaluation error ok=False — the slicing below re-evaluates and
+    raises at the legacy position (after the sort). Volatile LIMIT
+    expressions must not evaluate twice: the sliced values are the SAME
+    ints the heap was bounded with."""
+    try:
+        lim = int(evaluate(n.limit, ctx)) if n.limit is not None else None
+        off = int(evaluate(n.start, ctx)) if n.start is not None else None
+    except Exception:
+        return False, None, None, None
+    keep = None
+    if lim is not None and lim >= 0 and (off or 0) >= 0:
+        # negative slices keep python slice semantics (no heap)
+        keep = lim + (off or 0)
+    return True, keep, lim, off
+
+
 def _select_pipeline(n: SelectStmt, rows, c):
     # WHERE (if planner didn't consume it, re-filter — planner marks via attr)
     if n.cond is not None and not getattr(c, "_cond_consumed", False):
@@ -758,24 +778,29 @@ def _select_pipeline(n: SelectStmt, rows, c):
                 if tdef is not None and tdef.permissions is not None and                         tdef.permissions.get("select") is False:
                     empty_row = False
         out_rows = _apply_group(rows, n, c, aliases, empty_row)
+        lok, keep, lim, off = _eval_limits(n, c)
         if n.order and n.order != "rand":
-            out_rows = _apply_order(out_rows, n.order, c)
+            out_rows = _apply_order(out_rows, n.order, c, keep=keep)
         elif n.order == "rand":
-            _random.shuffle(out_rows)
+            _stmt_rng(c).shuffle(out_rows)
         if n.start is not None:
-            out_rows = out_rows[int(evaluate(n.start, c)) :]
+            out_rows = out_rows[
+                off if lok else int(evaluate(n.start, c)) :]
         if n.limit is not None:
-            out_rows = out_rows[: int(evaluate(n.limit, c))]
+            out_rows = out_rows[
+                : lim if lok else int(evaluate(n.limit, c))]
     else:
         # ORDER BY on the underlying rows (aliases resolve to their exprs)
+        lok, keep, lim, off = _eval_limits(n, c)
         if n.order == "rand":
-            _random.shuffle(rows)
+            _stmt_rng(c).shuffle(rows)
         elif n.order:
-            rows = _apply_order_sources(rows, n.order, c, aliases)
+            rows = _apply_order_sources(rows, n.order, c, aliases,
+                                        keep=keep)
         if n.start is not None:
-            rows = rows[int(evaluate(n.start, c)) :]
+            rows = rows[off if lok else int(evaluate(n.start, c)) :]
         if n.limit is not None:
-            rows = rows[: int(evaluate(n.limit, c))]
+            rows = rows[: lim if lok else int(evaluate(n.limit, c))]
         # VALUE selectors see omitted docs (the scalar output can't be
         # pruned later); ORDER BY above still saw the full documents
         if n.omit and n.value is not None:
@@ -1322,9 +1347,28 @@ def _resolve_alias(expr, aliases):
     return expr
 
 
-def _apply_order_sources(rows, order, ctx, aliases=None):
+def _stmt_rng(ctx):
+    """Statement-level RNG (ORDER BY RAND): datastore-scoped and
+    optionally seeded (SURREAL_RAND_SEED) so deterministic-sim and
+    bench runs stay reproducible — never the process-global `random`
+    instance another subsystem might be consuming."""
+    rng = getattr(ctx.ds, "rng", None)
+    if rng is None:
+        from surrealdb_tpu import cnf
+
+        rng = _random.Random(cnf.RAND_SEED or None)
+        try:
+            ctx.ds.rng = rng
+        except AttributeError:
+            pass
+    return rng
+
+
+def _apply_order_sources(rows, order, ctx, aliases=None, keep=None):
     """ORDER BY over source rows (pre-projection): aliases resolve to their
-    expressions, everything else evaluates against the source doc."""
+    expressions, everything else evaluates against the source doc.
+    `keep` (LIMIT+START known non-negative) bounds the sort to a top-k
+    heap instead of sorting every row."""
     items = []
     for expr, d, collate, numeric in order:
         resolved = _resolve_alias(expr, aliases)
@@ -1346,6 +1390,12 @@ def _apply_order_sources(rows, order, ctx, aliases=None):
             finally:
                 cc._no_link_fetch = False
         keyed.append((_OrderKey(keys), src))
+    if keep is not None and keep < len(keyed):
+        import heapq
+
+        # nsmallest is stable (documented equivalent of sorted()[:n])
+        keyed = heapq.nsmallest(keep, keyed, key=lambda kr: kr[0])
+        return [r for _k, r in keyed]
     keyed.sort(key=lambda kr: kr[0])
     return [r for _k, r in keyed]
 
@@ -1391,7 +1441,7 @@ class _OrderKey:
         return False
 
 
-def _apply_order(rows, order, ctx):
+def _apply_order(rows, order, ctx, keep=None):
     keyed = []
     for r in rows:
         c = ctx.with_doc(r, None)
@@ -1400,6 +1450,13 @@ def _apply_order(rows, order, ctx):
             expr, d, collate, numeric = item
             keys.append((evaluate(expr, c), d, collate, numeric))
         keyed.append((_OrderKey(keys), r))
+    if keep is not None and keep < len(keyed):
+        import heapq
+
+        # bounded top-k: LIMIT (+START) keeps keep rows — an O(n log k)
+        # heap instead of the full O(n log n) sort-then-slice
+        keyed = heapq.nsmallest(keep, keyed, key=lambda kr: kr[0])
+        return [r for _k, r in keyed]
     keyed.sort(key=lambda kr: kr[0])
     return [r for _k, r in keyed]
 
@@ -5151,6 +5208,16 @@ def _s_info(n: InfoStmt, ctx: Ctx):
 
             return get_accountant().snapshot()
 
+        def _columnar_snapshot(ds):
+            from surrealdb_tpu.exec.batch import counters, store_nbytes
+
+            out = dict(counters(ds))
+            out["colstore_bytes"] = store_nbytes(ds)
+            out["colstore_tables"] = len(
+                getattr(ds, "_table_columns", {})
+            )
+            return out
+
         dev = get_supervisor().status()
 
         # shard topology (kvs/shard.py): ranges, epochs, primaries —
@@ -5225,6 +5292,10 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             # derived-state bytes vs the soft/hard watermarks, the
             # per-kind breakdown, and eviction/shed/throttle counters
             "mem": _mem_snapshot(),
+            # columnar executor health (exec/batch.py + exec/vops.py):
+            # vectorized vs fallback rows, aggregate tier hits, column
+            # store builds/hits/bytes, fused-KNN and pushdown tallies
+            "columnar": _columnar_snapshot(ctx.ds),
         }
         if shard_topo is not None:
             out["shards"] = shard_topo

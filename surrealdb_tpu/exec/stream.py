@@ -37,13 +37,18 @@ _UNSUPPORTED = object()
 
 
 class OpMetrics:
-    __slots__ = ("rows", "batches", "ns", "enabled")
+    __slots__ = ("rows", "batches", "ns", "enabled", "vrows", "frows")
 
     def __init__(self):
         self.rows = 0
         self.batches = 0
         self.ns = 0
         self.enabled = False
+        # columnar accounting: rows served by the vectorized kernels vs
+        # rows that took the scalar-fallback path (EXPLAIN ANALYZE shows
+        # both so a fallback regression is visible per operator)
+        self.vrows = 0
+        self.frows = 0
 
 
 def _fmt_elapsed(ns: int) -> str:
@@ -107,72 +112,10 @@ class Operator:
 # ---------------------------------------------------------------------------
 
 
-def _vector_pred(cond):
-    """Compile an AND-tree of `field OP number` comparisons into
-    (fields, fn(cols)->mask) for numpy evaluation — the columnar fast
-    path the reference gets from its ValueBatch layout. Returns None for
-    anything richer (evaluated row-wise). Only applies to batches whose
-    values are ALL plain numbers: SurrealQL comparisons are type-ordered
-    (strings sort after numbers), so mixed batches fall back."""
-    from surrealdb_tpu.expr.ast import Binary, Idiom, Literal, PField
-
-    terms = []
-
-    def walk(c):
-        if isinstance(c, Binary) and c.op == "&&":
-            return walk(c.lhs) and walk(c.rhs)
-        if not isinstance(c, Binary) or c.op not in (
-            "<", "<=", ">", ">=", "=", "==", "!="
-        ):
-            return False
-        lhs, rhs = c.lhs, c.rhs
-        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
-        op = c.op
-        if isinstance(rhs, Idiom) and isinstance(lhs, Literal):
-            lhs, rhs = rhs, lhs
-            op = flip.get(op, op)
-        if not (isinstance(lhs, Idiom) and len(lhs.parts) == 1
-                and isinstance(lhs.parts[0], PField)
-                and isinstance(rhs, Literal)
-                and isinstance(rhs.value, (int, float))
-                and not isinstance(rhs.value, bool)):
-            return False
-        import math as _math
-
-        rv = rhs.value
-        # NaN ordering and >2^53 int precision diverge from float64 —
-        # keep those on the exact row-wise comparator
-        if isinstance(rv, float) and _math.isnan(rv):
-            return False
-        if abs(rv) > (1 << 53):
-            return False
-        terms.append((lhs.parts[0].name, op, float(rv)))
-        return True
-
-    if cond is None or not walk(cond):
-        return None
-    fields = sorted({t[0] for t in terms})
-
-    def run(cols: dict):
-        mask = None
-        for fname, op, val in terms:
-            col = cols[fname]
-            if op in ("=", "=="):
-                m = col == val
-            elif op == "!=":
-                m = col != val
-            elif op == "<":
-                m = col < val
-            elif op == "<=":
-                m = col <= val
-            elif op == ">":
-                m = col > val
-            else:
-                m = col >= val
-            mask = m if mask is None else (mask & m)
-        return mask
-
-    return fields, run
+# NOTE: the old `_vector_pred` numeric-AND-tree compiler grew into the
+# general columnar expression compiler in exec/vops.py (comparison /
+# boolean / arithmetic / IN over classified typed columns with per-row
+# exotic fallback); TableScanOp routes every predicate through it.
 
 
 class TableScanOp(Operator):
@@ -211,46 +154,40 @@ class TableScanOp(Operator):
         remaining = self.pushed_limit
         from surrealdb_tpu.exec.statements import Source
 
-        vec = _vector_pred(self.cond) if not has_computed else None
+        vec = None
+        if self.cond is not None and not has_computed:
+            from surrealdb_tpu.exec import vops
+
+            vec = vops.compile_predicate(self.cond, ctx)
 
         def row_pass(src):
             cc = ctx.with_doc(src.doc, src.rid)
             return is_truthy(evaluate(self.cond, cc))
 
         if vec is not None:
-            # columnar filter: evaluate whole pending batches with numpy;
-            # rows whose values aren't plain numbers fall back row-wise
-            fields, run = vec
+            # columnar filter: evaluate whole pending batches through
+            # the vops kernels; rows the kernels classify exotic fall
+            # back row-wise (bit-identical values, identical errors)
+            from surrealdb_tpu.exec.batch import BatchCols, _count
+
             pend: list = []
             batch = []
-            _num = (int, float)
-
-            import math as _math
-
-            def _plain_number(v):
-                # bools, NaN, and >2^53 ints diverge from float64 math
-                if isinstance(v, bool) or not isinstance(v, _num):
-                    return False
-                if isinstance(v, float):
-                    return not _math.isnan(v)
-                return abs(v) <= (1 << 53)
 
             def flush():
                 nonlocal pend, skip, remaining, batch
-                cols = {}
-                ok_vec = True
-                for fname in fields:
-                    vals = [s_.doc.get(fname) if isinstance(s_.doc, dict)
-                            else None for s_ in pend]
-                    if not all(_plain_number(v) for v in vals):
-                        ok_vec = False
-                        break
-                    cols[fname] = np.asarray(vals, dtype=np.float64)
-                if ok_vec:
-                    mask = run(cols)
-                    passing = [s_ for s_, m in zip(pend, mask) if m]
-                else:
-                    passing = [s_ for s_ in pend if row_pass(s_)]
+                mask, fb = vec.masks(BatchCols(pend), ctx)
+                nfb = int(fb.sum())
+                m = self.metrics
+                m.vrows += len(pend) - nfb
+                m.frows += nfb
+                _count(ctx.ds, "batches_vectorized")
+                _count(ctx.ds, "rows_vectorized", len(pend) - nfb)
+                if nfb:
+                    _count(ctx.ds, "rows_fallback", nfb)
+                passing = [
+                    s_ for s_, ok, f in zip(pend, mask, fb)
+                    if (row_pass(s_) if f else ok)
+                ]
                 pend = []
                 for src in passing:
                     if skip > 0:
@@ -407,7 +344,7 @@ class VecTopKScanOp(Operator):
         qf = qv.astype(np.float32)
         if kind == "cos_sim":
             dots = m @ qf
-            denom = np.linalg.norm(m, axis=1) * np.linalg.norm(qf)
+            denom = col.norms() * np.linalg.norm(qf)
             with np.errstate(divide="ignore", invalid="ignore"):
                 scores = dots / denom
         elif kind == "eucl":
@@ -547,19 +484,34 @@ class ColumnCache:
 
     def __init__(self):
         self.specs = {}  # id(expr) -> (kind, field_parts, qvec, expr)
+        self.vspecs = {}  # id(expr) -> (vops node, expr) scalar kernels
         # computed values live ON each Source (src._cols[id(expr)]): their
         # lifetime is the row's lifetime — a persistent {id(src): value}
         # map would serve stale values when CPython recycles a freed
         # Source's address for a later batch's row
 
     def register(self, expr, ctx):
-        from surrealdb_tpu.expr.ast import FunctionCall, Idiom, Param, \
-            PField
+        from surrealdb_tpu.expr.ast import Binary, FunctionCall, Idiom, \
+            Param, PField
         from surrealdb_tpu.exec.eval import evaluate
 
-        if id(expr) in self.specs:
+        if id(expr) in self.specs or id(expr) in self.vspecs:
             return True
         if not isinstance(expr, FunctionCall):
+            # scalar projection kernels: arithmetic / comparison / IN
+            # trees whose VALUE (not just truthiness) is exact — one
+            # vops call per batch serves projections and sort keys
+            # (logic ops return operand values, so roots stay scalar)
+            from surrealdb_tpu.exec import vops
+
+            if isinstance(expr, Binary) and (
+                expr.op in vops._CMP_OPS or expr.op in vops._ARITH_OPS
+                or expr.op in ("∈", "∉")
+            ):
+                node = vops.compile_expr(expr, ctx)
+                if node is not None and not isinstance(node, vops._Field):
+                    self.vspecs[id(expr)] = (node, expr)
+                    return True
             return False
         kind = _VEC_FNS.get(expr.name.lower())
         if kind is None or len(expr.args) != 2:
@@ -588,6 +540,28 @@ class ColumnCache:
         return True
 
     def prime(self, batch, ctx):
+        if self.vspecs:
+            from surrealdb_tpu.exec import vops
+            from surrealdb_tpu.exec.batch import RANK_EXOTIC, BatchCols
+
+            for sid, (node, _expr) in self.vspecs.items():
+                todo = [
+                    src for src in batch
+                    if getattr(src, "_cols", None) is None
+                    or sid not in src._cols
+                ]
+                if not todo:
+                    continue
+                col = node.eval(BatchCols(todo), ctx)
+                if col is None:
+                    continue  # runtime bail: rows evaluate row-wise
+                for i, src in enumerate(todo):
+                    if col.rank[i] == RANK_EXOTIC:
+                        continue  # scalar fallback (exact error/value)
+                    cols = getattr(src, "_cols", None)
+                    if cols is None:
+                        cols = src._cols = {}
+                    cols[sid] = vops.col_value_at(col, i)
         if not self.specs:
             return
         for sid, (kind, parts, qv, expr) in self.specs.items():
@@ -706,29 +680,56 @@ class AggregateOp(Operator):
         self.label = label
 
     def _execute(self, ctx):
+        from surrealdb_tpu.exec import vops
         from surrealdb_tpu.exec.eval import evaluate
         from surrealdb_tpu.exec.statements import (
-            _apply_group, _apply_order,
+            _apply_group, _apply_order, _stmt_rng,
         )
 
         n = self.stmt
-        rows = []
-        for b in self.children[0].execute(ctx):
-            rows.extend(b)
-        empty_row = n.cond is None or (
-            getattr(ctx.session, "planner_strategy", None) == "all-ro"
-        )
-        out = _apply_group(rows, n, ctx, self.aliases, empty_row)
-        if n.order == "rand":
-            import random as _r
+        out = None
+        scan = self.children[0]
+        if (
+            not self.metrics.enabled
+            and isinstance(scan, TableScanOp)
+            and scan.pushed_limit is None
+            and not scan.pushed_offset
+            and scan.direction == "Forward"
+        ):
+            # whole-table tier: filter + group + aggregate straight off
+            # the version-keyed column store — no Source rows at all.
+            # (EXPLAIN ANALYZE keeps the streaming tier so per-operator
+            # row counts stay real.)
+            out = vops.columnar_group_select(n, scan.tb, ctx,
+                                             self.aliases)
+        if out is None:
+            rows = []
+            for b in scan.execute(ctx):
+                ctx.check_deadline()
+                rows.extend(b)
+            self.metrics.vrows += len(rows)
+            out = vops.group_sources(rows, n, ctx, self.aliases)
+            if out is None:
+                self.metrics.vrows = 0
+                self.metrics.frows += len(rows)
+                empty_row = n.cond is None or (
+                    getattr(ctx.session, "planner_strategy", None)
+                    == "all-ro"
+                )
+                out = _apply_group(rows, n, ctx, self.aliases, empty_row)
+        from surrealdb_tpu.exec.statements import _eval_limits
 
-            _r.shuffle(out)
+        # LIMIT/START evaluate ONCE: the heap bound and the slice must
+        # see the same ints (volatile LIMIT expressions)
+        lok, keep, lim, off = _eval_limits(n, ctx)
+        if n.order == "rand":
+            _stmt_rng(ctx).shuffle(out)
         elif n.order:
-            out = _apply_order(out, n.order, ctx)
+            out = _apply_order(out, n.order, ctx, keep=keep)
         if n.start is not None:
-            out = out[int(evaluate(n.start, ctx)):]
+            out = out[off if lok else int(evaluate(n.start, ctx)):]
         if n.limit is not None:
-            out = out[:int(evaluate(n.limit, ctx))]
+            out = out[:lim if lok else int(evaluate(n.limit, ctx))]
         for i in range(0, len(out), BATCH_SIZE):
             yield out[i:i + BATCH_SIZE]
         if not out:
@@ -1016,9 +1017,15 @@ def try_stream_analyze(n, ctx):
         total += len(b)
     lines = []
     for depth, label, m in plan.lines():
+        extra = ""
+        if m.vrows or m.frows:
+            # columnar accounting: rows the vectorized kernels served
+            # vs rows that took the scalar fallback (exec/vops.py)
+            extra = f"vectorized: {m.vrows}, fallback: {m.frows}, "
         lines.append(
             "    " * depth + label
             + f" {{rows: {m.rows}, batches: {m.batches}, "
-              f"elapsed: {_fmt_elapsed(m.ns)}}}"
+            + extra
+            + f"elapsed: {_fmt_elapsed(m.ns)}}}"
         )
     return "\n".join(lines) + f"\n\nTotal rows: {total}"

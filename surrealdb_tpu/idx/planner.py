@@ -22,7 +22,8 @@ from surrealdb_tpu.expr.ast import (
     PField,
     RangeExpr,
 )
-from surrealdb_tpu.val import NONE, Range, RecordId, hashable, value_eq
+from surrealdb_tpu.val import NONE, Range, RecordId, hashable, value_cmp, \
+    value_eq
 
 from surrealdb_tpu.err import SdbError
 
@@ -861,7 +862,8 @@ def _plan_scan(tb: str, cond, ctx, stmt):
             return plan_matches(tb, cond, mts, indexes, ctx, stmt)
 
     # ---- equality / range / contains on indexed columns --------------------
-    eqs, ins, rngs = _classify_preds(cond, _array_like_paths(tb, ctx))
+    array_paths = _array_like_paths(tb, ctx)
+    eqs, ins, rngs = _classify_preds(cond, array_paths)
     legacy = getattr(ctx.session, "planner_strategy", None) != "all-ro"
     if not eqs and not rngs and not ins:
         jn = _find_link_join(tb, cond, indexes, ctx) if legacy else None
@@ -872,7 +874,9 @@ def _plan_scan(tb: str, cond, ctx, stmt):
         return _link_join_scan(tb, jn, ctx) if jn is not None else None
     idef, nmatch, tail, _score = chosen
     eq_vals = [evaluate(eqs[c], ctx) for c in idef.cols_str[:nmatch]]
-    scan = _index_scan(tb, idef, eq_vals, tail, ctx)
+    prefilter = _index_prefilter(idef, nmatch, tail, eqs, ins, rngs, ctx,
+                                 array_paths)
+    scan = _index_scan(tb, idef, eq_vals, tail, ctx, prefilter=prefilter)
     order = getattr(stmt, "order", None) if stmt is not None else None
     if order and order != "rand" and len(order) == 1 and \
             order[0][1] == "desc":
@@ -890,9 +894,79 @@ def _plan_scan(tb: str, cond, ctx, stmt):
     return scan
 
 
-def _index_scan(tb, idef, eq_vals, tail, ctx):
+def _index_prefilter(idef, nmatch, tail, eqs, ins, rngs, ctx,
+                     array_paths=frozenset()):
+    """Sargable residual predicates on the index's OWN columns, compiled
+    to (col_pos, test(decoded_value)) pairs — evaluated on the decoded
+    index-key fields BEFORE the record fetch/deserialization, so rows
+    the WHERE clause would drop anyway never pay the document decode.
+    Purely an access-path optimization: the residual cond still
+    re-applies row-wise above the scan (never consumed), so this may
+    only skip rows the index key itself proves non-matching."""
+    from surrealdb_tpu.exec.eval import evaluate
+
+    tail_col = idef.cols_str[nmatch] if (
+        tail is not None and nmatch < len(idef.cols_str)
+    ) else None
+    tests = []
+    for pos, col in enumerate(idef.cols_str):
+        if pos < nmatch or "*" in col or \
+                _array_shaped(col, array_paths):
+            # consumed by the eq prefix, or an array/set column whose
+            # index entries are UNNESTED per-element values — a whole-
+            # array predicate must never test against single elements
+            continue
+        preds = []
+        if col in eqs and col != tail_col:
+            v = evaluate(eqs[col], ctx)
+            preds.append(lambda f, v=v: value_eq(f, v))
+        if col in rngs:
+            bounds = rngs[col]
+            if col == tail_col and tail is not None and tail[0] == "range":
+                # composite scans push exactly ONE bound into the key
+                # range (_index_scan bounds=payload[:1]); the rest of
+                # the same column's bounds prefilter here
+                pushed = tail[1][:1] if nmatch else tail[1]
+                bounds = [b for b in bounds if b not in pushed]
+            for op, vx in bounds:
+                v = evaluate(vx, ctx)
+                if op == "<":
+                    preds.append(lambda f, v=v: value_cmp(f, v) < 0)
+                elif op == "<=":
+                    preds.append(lambda f, v=v: value_cmp(f, v) <= 0)
+                elif op == ">":
+                    preds.append(lambda f, v=v: value_cmp(f, v) > 0)
+                elif op == ">=":
+                    preds.append(lambda f, v=v: value_cmp(f, v) >= 0)
+        if col in ins and col != tail_col:
+            vals = evaluate(ins[col], ctx)
+            vals = vals if isinstance(vals, list) else [vals]
+            preds.append(
+                lambda f, vals=vals: any(value_eq(f, x) for x in vals)
+            )
+        for p in preds:
+            tests.append((pos, p))
+    return tests or None
+
+
+def _dec_unique_fields(k: bytes, base: bytes, ncols: int):
+    """Decode the field values of a unique-index entry key (fields only,
+    no trailing rid); None on any decode wrinkle."""
+    try:
+        pos = len(base)
+        fields = []
+        for _ in range(ncols):
+            f, pos = K.dec_value(k, pos)
+            fields.append(f)
+        return fields
+    except Exception:
+        return None
+
+
+def _index_scan(tb, idef, eq_vals, tail, ctx, prefilter=None):
     """Scan an index: equality prefix on leading columns, then an optional
-    range / IN-list on the next column."""
+    range / IN-list on the next column. `prefilter` tests decoded key
+    fields before the record fetch (sargable-residual pushdown)."""
     from surrealdb_tpu.exec.eval import evaluate, fetch_record
     from surrealdb_tpu.exec.statements import Source
 
@@ -915,9 +989,26 @@ def _index_scan(tb, idef, eq_vals, tail, ctx):
             return None
         return Source(rid=rid, doc=doc)
 
+    def _fields_pass(fields) -> bool:
+        if prefilter is None:
+            return True
+        for pos, test in prefilter:
+            if pos >= len(fields):
+                continue
+            try:
+                if not test(fields[pos]):
+                    from surrealdb_tpu.exec.batch import _count
+
+                    _count(ctx.ds, "pushdown_rows_pruned")
+                    return False
+            except Exception:
+                return True  # never drop a row on a comparator wrinkle
+        return True
+
     nonuniq_base = K.index_prefix(ns, db, tb, idef.name)
 
     def _emit_range(beg, end):
+        ncols = len(idef.cols_str)
         if unique:
             # all-NONE rows of unique indexes live in the non-unique
             # keyspace (duplicates allowed); rebase the bounds there.
@@ -929,20 +1020,28 @@ def _index_scan(tb, idef, eq_vals, tail, ctx):
             else:
                 # end was a whole-prefix bump: bump the rebased prefix
                 ne = K.prefix_range(nb)[1]
-            ncols = len(idef.cols_str)
             for k in ctx.txn.keys(nb, ne):
                 _fields, idv = K.decode_index(k, ns, db, tb, idef.name, ncols)
+                if not _fields_pass(_fields):
+                    continue
                 s = _fetch(RecordId(tb, idv))
                 if s:
                     yield s
             for _k, rid in ctx.txn.scan_vals(beg, end):
+                # unique entries key by field values under a different
+                # prefix; the prefilter reads them via the shared codec
+                if prefilter is not None:
+                    _fields = _dec_unique_fields(_k, base, ncols)
+                    if _fields is not None and not _fields_pass(_fields):
+                        continue
                 s = _fetch(rid)
                 if s:
                     yield s
         else:
-            ncols = len(idef.cols_str)
             for k in ctx.txn.keys(beg, end):
                 _fields, idv = K.decode_index(k, ns, db, tb, idef.name, ncols)
+                if not _fields_pass(_fields):
+                    continue
                 s = _fetch(RecordId(tb, idv))
                 if s:
                     yield s
@@ -1149,6 +1248,16 @@ def _brute_knn(tb, knn: Knn, qv, rest, ctx):
     from surrealdb_tpu.val import is_truthy
 
     metric, p = normalize_metric(knn.dist or "euclidean")
+    # fused columnar path: the residual predicate evaluates vectorized
+    # over the table column store and only surviving candidates ship —
+    # (mask, qvec, k) — through the cross-query batcher (exec/vops.py);
+    # any wrinkle (exotic rows, overlay, non-conforming vectors) keeps
+    # the exact row-at-a-time scan below
+    from surrealdb_tpu.exec.vops import fused_brute_knn
+
+    fused = fused_brute_knn(tb, knn, qv, rest, ctx)
+    if fused is not None:
+        return fused
     path_expr = knn.lhs
     rows = []
     vecs = []

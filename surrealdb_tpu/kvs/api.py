@@ -157,6 +157,28 @@ def deserialize(b: bytes):
     return _restricted_loads(b)
 
 
+def deserialize_fields(b: bytes, wanted):
+    """Project `wanted` top-level fields out of a stored record without
+    materializing the rest (exec/batch.py columnar extraction). Exact:
+    any shape the partial decoder can't serve — pickle-framed rows,
+    non-map top values — takes the full shared decode instead. The
+    returned dict/values are SHARED with nothing (partial path) or with
+    the decode cache (fallback path): callers must not mutate them."""
+    if b[:1] == b"\x01" and b not in _dec_cache:
+        from surrealdb_tpu import wire
+
+        try:
+            out = wire.decode_fields(b[1:], wanted)
+        except Exception:
+            out = None
+        if out is not None:
+            return out
+    v = deserialize_shared(b)
+    if not isinstance(v, dict):
+        return None
+    return v
+
+
 def deserialize_shared(b: bytes):
     """Decode WITHOUT the fresh-copy contract: returns the decode
     cache's shared value when available — callers MUST NOT mutate the

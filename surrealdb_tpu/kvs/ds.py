@@ -244,6 +244,31 @@ class Datastore:
             "csr", "csr-blocks", self._csr_mem_bytes,
             evict=self._csr_mem_evict, owner=self,
         )
+        # columnar executor state: the version-keyed scalar column store
+        # (exec/batch.py) plus the brute-scan vector columns (col.py) —
+        # both pure caches over the record keyspace, eviction = drop +
+        # rebuild-on-touch
+        self._table_columns: dict = {}
+        self._vector_columns: dict = {}
+        self._mem_col = _resource.register(
+            "col", "column-store", self._col_mem_bytes,
+            evict=self._col_mem_evict, owner=self,
+        )
+        # statement-scoped RNG (ORDER BY RAND): seeded via
+        # SURREAL_RAND_SEED for reproducible sim/bench runs
+        import random as _rnd
+
+        self.rng = _rnd.Random(cnf.RAND_SEED or None)
+        from surrealdb_tpu.exec.batch import counters as _col_counters
+
+        self._columnar_counters = _col_counters(self)
+        for _ck in ("rows_vectorized", "rows_fallback", "colstore_hits",
+                    "colstore_builds", "fused_knn_queries",
+                    "pushdown_rows_pruned"):
+            self.telemetry.register_counter(
+                f"columnar_{_ck}",
+                lambda k=_ck: self._columnar_counters.get(k, 0)
+            )
         self.telemetry.register_counter(
             "ft_cache_evictions", lambda: self._ft_cache.evictions
         )
@@ -304,6 +329,24 @@ class Datastore:
             # ~3 small objects per logged edge op
             total += sum(totals.values()) * 96
         return total
+
+    def _col_mem_bytes(self) -> int:
+        from surrealdb_tpu.exec.batch import store_nbytes
+
+        total = store_nbytes(self)
+        for col in list(getattr(self, "_vector_columns", {}).values()):
+            mat = getattr(col, "mat", None)
+            if mat is not None:
+                total += int(mat.nbytes)
+            norms = getattr(col, "_norms", None)
+            if norms is not None:
+                total += int(norms.nbytes)
+        return total
+
+    def _col_mem_evict(self):
+        from surrealdb_tpu.exec.batch import store_evict
+
+        store_evict(self)
 
     def _csr_mem_evict(self):
         # CSR adjacency + the edge op log are caches over the `~` graph

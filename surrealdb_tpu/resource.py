@@ -52,7 +52,11 @@ from surrealdb_tpu import cnf
 # sync; live-query outboxes come LAST because their "eviction" is the
 # slow-consumer overflow policy — a typed, client-visible loss window,
 # never silent, but still worse than re-deriving a cache.
-EVICT_ORDER = ("rank_stats", "ft", "csr", "oplog", "ann", "vec", "push")
+# `col` (the analytics column store, exec/batch.py) sits beside ft:
+# dropping it costs the next analytics query one partial-decode rebuild
+# scan, nothing else
+EVICT_ORDER = ("rank_stats", "ft", "col", "csr", "oplog", "ann", "vec",
+               "push")
 
 
 def host_limit_bytes() -> int:

@@ -353,3 +353,72 @@ def decode(data: bytes):
     if d.i != len(data):
         raise SdbError("trailing bytes after CBOR value")
     return v
+
+
+# ---------------------------------------------------------------------------
+# partial decode — project named top-level fields without materializing
+# the rest of the record (exec/batch.py column extraction: an analytics
+# scan over wide documents decodes only the columns it needs)
+# ---------------------------------------------------------------------------
+
+
+def _skip(d: _Dec):
+    """Advance the cursor past one encoded value without building it."""
+    ib = d.u8()
+    major, info = ib >> 5, ib & 0x1F
+    if major in (0, 1):
+        d.arg(info)
+        return
+    if major in (2, 3):
+        d.take(d.arg(info))
+        return
+    if major == 4:
+        for _ in range(d.arg(info)):
+            _skip(d)
+        return
+    if major == 5:
+        for _ in range(d.arg(info)):
+            _skip(d)
+            _skip(d)
+        return
+    if major == 6:
+        d.arg(info)
+        _skip(d)
+        return
+    # major 7: simple values / floats — fail closed exactly where the
+    # full decoder would (info 24 and 28+ are rejected by value() too),
+    # never desynchronize the cursor on foreign bytes
+    if info == 25:
+        d.take(2)
+    elif info == 26:
+        d.take(4)
+    elif info == 27:
+        d.take(8)
+    elif info == 24 or info >= 28:
+        raise SdbError(f"unsupported CBOR simple value {info}")
+
+
+def decode_fields(data: bytes, wanted) -> "dict | None":
+    """Decode only the `wanted` top-level keys of an encoded map; values
+    of other keys are length-skipped, never materialized. Returns None
+    when the top-level value is not a plain map (tagged/object-like
+    records fall back to a full decode at the caller)."""
+    d = _Dec(data)
+    ib = d.u8()
+    major, info = ib >> 5, ib & 0x1F
+    if major != 5:
+        return None
+    out = {}
+    remaining = len(wanted)
+    for _ in range(d.arg(info)):
+        kb = d.u8()
+        kmajor, kinfo = kb >> 5, kb & 0x1F
+        if kmajor != 3:
+            return None  # non-string key: not a record-shaped map
+        k = d.take(d.arg(kinfo)).decode("utf-8")
+        if remaining and k in wanted and k not in out:
+            out[k] = d.value()
+            remaining -= 1
+        else:
+            _skip(d)
+    return out

@@ -161,6 +161,86 @@ def ann_smoke(recall_floor: float = 0.95) -> "str | None":
     return None
 
 
+def analytics_smoke(ratio_floor: float = 5.0) -> "str | None":
+    """Columnar-executor gate (PR 14): a small-N filtered aggregation +
+    GROUP BY must (1) run >= `ratio_floor`x faster through the columnar
+    tiers than through the row-at-a-time interpreter and (2) answer
+    byte-identically — including a forced-scalar run (SURREAL_COLUMNAR
+    =off) that proves every vectorized kernel has a correct fallback.
+    Returns None on pass, an error string on fail."""
+    import time
+
+    from surrealdb_tpu import Datastore, cnf
+    from surrealdb_tpu.kvs.ds import Session
+    from surrealdb_tpu.val import render
+
+    import bench as _bench
+
+    n = 30_000
+    ds = Datastore("memory")
+    ds.query("DEFINE TABLE sales", ns="b", db="b")
+    _bench._bulk_analytics_rows(ds, "b", "b", "sales", n, seed=11)
+    queries = [
+        "SELECT cat, count() AS c, math::sum(qty) AS units, "
+        "math::mean(price) AS avg FROM sales "
+        "WHERE price < 300 AND qty > 5 GROUP BY cat",
+        "SELECT region, count() AS c, math::min(price) AS lo, "
+        "math::max(price) AS hi FROM sales GROUP BY region "
+        "ORDER BY c DESC LIMIT 3",
+        "SELECT cat, region, math::sum(price * qty) AS rev "
+        "FROM sales WHERE region IN ['eu', 'us'] GROUP BY cat, region",
+    ]
+
+    def run(sql, iters, columnar):
+        sess = Session(ns="b", db="b", auth_level="owner")
+        if not columnar:
+            sess.planner_strategy = "compute-only"
+        prev = cnf.COLUMNAR
+        cnf.COLUMNAR = "auto" if columnar else "off"
+        try:
+            out = None
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = ds.execute(sql, session=sess)[-1].unwrap()
+            return iters / (time.perf_counter() - t0), out
+        finally:
+            cnf.COLUMNAR = prev
+
+    worst = None
+    for sql in queries:
+        run(sql, 1, True)  # warm: column-store build
+        col_qps, col_out = run(sql, 4, True)
+        interp_qps, interp_out = run(sql, 1, False)
+        if render(col_out) != render(interp_out):
+            return (f"columnar answer diverged from the forced-scalar "
+                    f"interpreter on: {sql[:80]}")
+        ratio = col_qps / max(interp_qps, 1e-9)
+        if worst is None or ratio < worst[0]:
+            worst = (ratio, col_qps, interp_qps)
+    # fallback-correctness: the streaming tier with the scalar path
+    # forced must also diff clean (exercises the per-row fallback seam
+    # rather than skipping the streaming executor entirely)
+    sess = Session(ns="b", db="b", auth_level="owner")
+    prev = cnf.COLUMNAR
+    cnf.COLUMNAR = "off"
+    try:
+        off_out = ds.execute(queries[0], session=sess)[-1].unwrap()
+    finally:
+        cnf.COLUMNAR = prev
+    on_out = ds.execute(queries[0], session=sess)[-1].unwrap()
+    if render(off_out) != render(on_out):
+        return "SURREAL_COLUMNAR=off diverged on the streaming executor"
+    ratio, col_qps, interp_qps = worst
+    if ratio < ratio_floor:
+        return (f"columnar {col_qps:.1f} qps only {ratio:.1f}x the "
+                f"interpreter ({interp_qps:.2f} qps); floor "
+                f"{ratio_floor}x")
+    print(f"== analytics smoke: OK — columnar {col_qps:.1f} qps, "
+          f"{ratio:.1f}x interpreter (floor {ratio_floor}x), "
+          f"answers identical incl. forced-scalar")
+    return None
+
+
 def live_smoke() -> "str | None":
     """Live fan-out gate (the push-path overload spine): a small
     real-socket soak — 8 WS sessions (one frozen mid-stream), writers
@@ -325,6 +405,13 @@ def main():
     err = perf_smoke()
     if err is not None:
         print(f"== perf smoke: FAIL — {err}")
+        rc = rc or 1
+    # analytics smoke: the columnar executor must hold >= 5x over the
+    # row-at-a-time interpreter on the small-N filtered-agg config AND
+    # diff byte-identical against the forced-scalar path
+    err = analytics_smoke()
+    if err is not None:
+        print(f"== analytics smoke: FAIL — {err}")
         rc = rc or 1
     # ann smoke: the quantized graph index must keep recall@10 >= 0.95
     # vs brute-force ground truth and must never be slower than the

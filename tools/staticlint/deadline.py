@@ -41,6 +41,15 @@ DEFAULT_ENTRIES = (
     ("surrealdb_tpu/exec/executor.py", "Executor.*"),
     ("surrealdb_tpu/exec/stream.py", "*Op._execute"),
     ("surrealdb_tpu/exec/stream.py", "try_stream_*"),
+    # columnar executor (PR 14): per-batch kernel loops and the
+    # whole-table column-store build/aggregate paths must reach
+    # check_deadline or a budget-bounded primitive
+    ("surrealdb_tpu/exec/vops.py", "group_core"),
+    ("surrealdb_tpu/exec/vops.py", "columnar_group_select"),
+    ("surrealdb_tpu/exec/vops.py", "group_sources"),
+    ("surrealdb_tpu/exec/vops.py", "fused_brute_knn"),
+    ("surrealdb_tpu/exec/batch.py", "_build_table_columns"),
+    ("surrealdb_tpu/exec/batch.py", "get_table_columns"),
     ("surrealdb_tpu/idx/shardvec.py", "scatter_gather"),
     ("surrealdb_tpu/idx/shardvec.py", "merge_topk"),
     ("surrealdb_tpu/idx/shardvec.py", "ShardedVectorIndex.knn"),

@@ -53,7 +53,13 @@ _KNN_LOCK_OK = {"append", "pop", "get", "add", "discard", "span",
                 "items", "values", "keys", "_repartition"}
 
 _MEM_SCAN_PREFIXES = ("surrealdb_tpu/idx/", "surrealdb_tpu/device/")
-_MEM_SCAN_FILES = ("surrealdb_tpu/server/fanout.py",)
+# PR 14: the columnar executor's module state (column-store caches,
+# counters) is covered too — its caches must register with the
+# accountant (kvs/ds.py `col` account) or sit on the explicit allowlist
+_MEM_SCAN_FILES = ("surrealdb_tpu/server/fanout.py",
+                   "surrealdb_tpu/exec/batch.py",
+                   "surrealdb_tpu/exec/vops.py",
+                   "surrealdb_tpu/col.py")
 _MEM_REGISTRATION_FNS = {
     "surrealdb_tpu/resource.py": ("register", "maybe_evict",
                                   "checkpoint", "throttle"),
@@ -63,7 +69,9 @@ _MEM_REGISTRATION_FNS = {
     "surrealdb_tpu/server/fanout.py": ("_mem_bytes", "_mem_evict"),
     "surrealdb_tpu/device/handlers.py": ("_admit", "mem_used"),
     "surrealdb_tpu/kvs/ds.py": ("_ft_cache_bytes", "_csr_mem_bytes",
-                                "_csr_mem_evict"),
+                                "_csr_mem_evict", "_col_mem_bytes",
+                                "_col_mem_evict"),
+    "surrealdb_tpu/exec/batch.py": ("store_nbytes", "store_evict"),
 }
 _CONTAINER_CALLS = {"dict", "list", "set", "OrderedDict", "deque",
                     "defaultdict"}
@@ -100,6 +108,10 @@ _MEM_ALLOW = {
     ("surrealdb_tpu/idx/fulltext.py", "_STOP_SUFFIXES"),
     ("surrealdb_tpu/device/annstore.py", "cfg"),
     ("surrealdb_tpu/device/vecstore.py", "cfg"),
+    # batch-lifetime column cache: dies with its BatchCols (one
+    # streaming batch); the persistent store is the accountant-covered
+    # `col` account on kvs/ds.py
+    ("surrealdb_tpu/exec/batch.py", "_cols"),
 }
 
 _FOLLOWER_FILE = "surrealdb_tpu/kvs/remote.py"
