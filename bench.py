@@ -385,6 +385,231 @@ def bench_knn1m(quick=False):
     }
 
 
+def _churn_ops(ds, ns, db, tb, ix_name, ver, adds, dels, live):
+    """Commit one mixed insert/delete batch through the KV layer the
+    way the write path does it (he state + hl op log + vn version), so
+    the serving engine consumes it through its incremental log
+    applier — the exact continuous-ingest shape under test."""
+    from surrealdb_tpu import key as K
+    from surrealdb_tpu.kvs.api import serialize
+    from surrealdb_tpu.val import RecordId
+
+    txn = ds.transaction(write=True)
+    try:
+        for i, v in adds:
+            txn.set(K.record(ns, db, tb, i),
+                    serialize({"id": RecordId(tb, i)}))
+            txn.set_val(
+                K.ix_state(ns, db, tb, ix_name, b"he", K.enc_value(i)),
+                v.tobytes(),
+            )
+            ver += 1
+            txn.set_val(
+                K.ix_state(ns, db, tb, ix_name, b"hl", K.enc_u64(ver)),
+                ("set", i, v.tobytes()),
+            )
+            live[i] = v
+        for i in dels:
+            txn.delete(K.record(ns, db, tb, i))
+            txn.delete(
+                K.ix_state(ns, db, tb, ix_name, b"he", K.enc_value(i))
+            )
+            ver += 1
+            txn.set_val(
+                K.ix_state(ns, db, tb, ix_name, b"hl", K.enc_u64(ver)),
+                ("del", i, None),
+            )
+            live.pop(i, None)
+        txn.set_val(K.ix_state(ns, db, tb, ix_name, b"vn"), ver)
+        txn.commit()
+    except BaseException:
+        txn.cancel()
+        raise
+    return ver
+
+
+def _churn_run(n0, dim, rounds, add, dele, nq, seed=15):
+    """One sustained insert/delete/query churn run against a fresh
+    datastore under the CURRENT cnf knobs. Returns per-round query
+    latencies, ingest-to-searchable latencies (commit → the new row
+    answering a query), and recall@10 checks vs the f64 brute oracle
+    over the live rows."""
+    from surrealdb_tpu import Datastore
+
+    ds = Datastore("memory")
+    try:
+        rng = np.random.default_rng(seed)
+        # embedding-shaped (clustered) data, like the ann smoke: real
+        # vector workloads have low intrinsic dimension — unclustered
+        # uniform gaussians are the known-pathological case for ANY
+        # graph-ANN index (neighbors near-equidistant) and would bench
+        # the data, not the index
+        nc = max(n0 // 200, 64)
+        centers = rng.normal(size=(nc, dim)).astype(np.float32)
+
+        def mkvecs(count):
+            return (centers[rng.integers(0, nc, count)]
+                    + 0.15 * rng.normal(size=(count, dim))
+                    ).astype(np.float32)
+
+        ds.query(
+            f"DEFINE TABLE tbl; DEFINE INDEX ix ON tbl FIELDS emb "
+            f"HNSW DIMENSION {dim} DIST EUCLIDEAN TYPE F32",
+            ns="b", db="b",
+        )
+        live: dict = {}
+        ver = _churn_ops(ds, "b", "b", "tbl", "ix", 0,
+                         list(enumerate(mkvecs(n0))), [], live)
+        sql = "SELECT id FROM tbl WHERE emb <|10|> $q"
+
+        def q_ids(qv, k=10):
+            rows = ds.query_one(
+                sql if k == 10
+                else f"SELECT id FROM tbl WHERE emb <|{k}|> $q",
+                ns="b", db="b", vars={"q": qv.tolist()},
+            )
+            return [r["id"].id for r in rows]
+
+        q_ids(mkvecs(1)[0])  # engage/sync
+        # both modes start from a BUILT index (the steady-state churn
+        # comparison, not the cold-build race): segmented drains its
+        # first seal, legacy lands its whole-store graph
+        ds.vector_indexes[("b", "b", "tbl", "ix")].ensure_ann()
+        nid = n0
+        lat_ms, ingest_ms, recalls = [], [], []
+        for r in range(rounds):
+            adds = [(nid + j, v) for j, v in enumerate(mkvecs(add))]
+            nid += add
+            pool = np.asarray(sorted(live))
+            dels = [int(i) for i in rng.choice(
+                pool, size=min(dele, len(pool) - 1), replace=False
+            )]
+            ver = _churn_ops(ds, "b", "b", "tbl", "ix", ver, adds,
+                             dels, live)
+            probe_id, probe_vec = adds[-1]
+            t0 = time.perf_counter()
+            got = q_ids(probe_vec, 1)
+            ingest_ms.append((time.perf_counter() - t0) * 1e3)
+            assert got == [probe_id], (
+                f"round {r}: committed row not searchable ({got})"
+            )
+            round_lat = []
+            for qv in mkvecs(nq):
+                t0 = time.perf_counter()
+                q_ids(qv)
+                round_lat.append((time.perf_counter() - t0) * 1e3)
+            lat_ms.append(round_lat)
+            if r % 4 == 3 or r == rounds - 1:
+                ids = np.asarray(sorted(live))
+                mat = np.stack([live[i] for i in ids]).astype(
+                    np.float64
+                )
+                hits = tot = 0
+                for qv in mkvecs(8):
+                    d = ((mat - qv.astype(np.float64)) ** 2).sum(axis=1)
+                    truth = set(
+                        ids[np.argsort(d, kind="stable")[:10]].tolist()
+                    )
+                    hits += len(truth & set(q_ids(qv)))
+                    tot += 10
+                recalls.append(hits / tot)
+        eng = ds.vector_indexes[("b", "b", "tbl", "ix")]
+        seg_status = seg_stats = None
+        if getattr(eng, "_segs", None) is not None \
+                and eng._segs.active():
+            eng._segs.drain()  # settle in-flight background builds
+            st = eng._segs.status()
+            seg_status = {k: st[k] for k in
+                          ("segments", "ready", "tail_rows")}
+            seg_stats = {k: v for k, v in st["stats"].items() if v}
+        return {
+            "lat_ms": lat_ms, "ingest_ms": ingest_ms,
+            "recalls": recalls, "rows_end": len(live),
+            "seg_status": seg_status, "seg_stats": seg_stats,
+            "full_rebuilds": eng.ann_full_rebuilds,
+        }
+    finally:
+        ds.close()
+
+
+def _pct(vals, p):
+    vals = sorted(vals)
+    return vals[min(int(p * (len(vals) - 1)), len(vals) - 1)]
+
+
+def bench_knn_churn(quick=False):
+    """Sustained mixed insert/delete/query churn (ROADMAP item 3 gate):
+    the segmented LSM-style index must hold recall@10 >= 0.95 with a
+    FLAT query p99 across the run and bounded ingest-to-searchable
+    latency, while the pre-PR single-graph path — run on the same
+    churn at the same scale — pays the rebuild treadmill (counted via
+    ann_full_rebuilds) and a growing brute-merged tail."""
+    from surrealdb_tpu import cnf
+
+    if quick:
+        n0, dim, rounds, add, dele, nq = 90_000, 48, 12, 4096, 1024, 12
+        seal = 16_384
+    else:
+        n0, dim, rounds, add, dele, nq = 1_000_000, 768, 8, 32_768, \
+            8_192, 12
+        seal = 131_072
+    saved = (cnf.KNN_SEG_MODE, cnf.KNN_SEG_ROWS, cnf.KNN_ANN_MODE)
+    try:
+        # segmented run (counters read ENGINE-scoped from the run)
+        cnf.KNN_SEG_MODE, cnf.KNN_SEG_ROWS = "force", seal
+        cnf.KNN_ANN_MODE = "force"
+        seg = _churn_run(n0, dim, rounds, add, dele, nq)
+        # pre-PR contrast: the whole-store graph with the drift
+        # threshold, same churn (quick scale keeps the bench bounded)
+        cnf.KNN_SEG_MODE = "off"
+        ln0, ldim = (n0, dim) if quick else (90_000, 48)
+        lrounds = rounds if quick else 12
+        legacy = _churn_run(ln0, ldim, lrounds,
+                            add if quick else 4096,
+                            dele if quick else 1024, nq)
+        legacy_rebuilds = legacy["full_rebuilds"]
+    finally:
+        cnf.KNN_SEG_MODE, cnf.KNN_SEG_ROWS, cnf.KNN_ANN_MODE = saved
+
+    def phase(lats, frac0, frac1):
+        flat = [x for rl in lats[int(len(lats) * frac0):
+                                 max(int(len(lats) * frac1), 1)]
+                for x in rl]
+        return flat or [0.0]
+
+    first = phase(seg["lat_ms"], 0.0, 1 / 3)
+    last = phase(seg["lat_ms"], 2 / 3, 1.0)
+    lfirst = phase(legacy["lat_ms"], 0.0, 1 / 3)
+    llast = phase(legacy["lat_ms"], 2 / 3, 1.0)
+    all_lat = [x for rl in seg["lat_ms"] for x in rl]
+    return {
+        "metric": f"knn_churn_{n0 // 1000}k_{dim}d",
+        "value": round(1000.0 / max(_pct(all_lat, 0.5), 1e-9), 2),
+        "unit": "qps",
+        "recall_at_10_min": round(min(seg["recalls"]), 4),
+        "p50_ms": round(_pct(all_lat, 0.5), 2),
+        "p99_ms": round(_pct(all_lat, 0.99), 2),
+        "p99_ms_first_third": round(_pct(first, 0.99), 2),
+        "p99_ms_last_third": round(_pct(last, 0.99), 2),
+        "ingest_to_searchable_ms_p95": round(
+            _pct(seg["ingest_ms"], 0.95), 2),
+        "ingest_to_searchable_ms_max": round(max(seg["ingest_ms"]), 2),
+        "rows_end": seg["rows_end"],
+        "segments": seg["seg_status"],
+        "seg_counters": seg["seg_stats"],
+        "ann_full_rebuilds": seg["full_rebuilds"],
+        "legacy_contrast": {
+            "scale": f"{ln0 // 1000}k_{ldim}d",
+            "ann_full_rebuilds": legacy_rebuilds,
+            "recall_at_10_min": round(min(legacy["recalls"]), 4),
+            "p99_ms_first_third": round(_pct(lfirst, 0.99), 2),
+            "p99_ms_last_third": round(_pct(llast, 0.99), 2),
+            "ingest_to_searchable_ms_p95": round(
+                _pct(legacy["ingest_ms"], 0.95), 2),
+        },
+    }
+
+
 def bench_knn10m(quick=False):
     """North-star config (BASELINE.md): 10M×768 cosine KNN, k=10, SQL
     search path, recall@10 vs exact f64 ground truth. At this scale the
@@ -1923,7 +2148,7 @@ def main():
                              "brute", "graph3hop", "hybrid",
                              "live_fanout", "knn_sharded",
                              "mem_pressure", "follower_reads",
-                             "analytics"])
+                             "analytics", "knn_churn"])
     ap.add_argument("--groups", type=int, default=2,
                     help="shard groups for --config knn_sharded (2/4)")
     args = ap.parse_args()
@@ -1993,6 +2218,7 @@ def main():
         "mem_pressure": bench_mem_pressure,
         "follower_reads": bench_follower_reads,
         "analytics": bench_analytics,
+        "knn_churn": bench_knn_churn,
     }
     _probe_backend()
     if args.all:

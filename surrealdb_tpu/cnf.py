@@ -114,6 +114,33 @@ KNN_ANN_CLIP_Q = env_float("SURREAL_KNN_ANN_CLIP_Q", 1.0)
 # graph is considered stale and a rebuild is scheduled
 KNN_ANN_TAIL_FRAC = env_float("SURREAL_KNN_ANN_TAIL_FRAC", 0.25)
 
+# -- segmented LSM-style ANN (idx/segments.py) -------------------------------
+# Sealed-segment serving for continuous ingest: writes land in a small
+# mutable exact segment, a seal policy freezes it, background jobs
+# build per-segment CAGRA graphs and tier-merge small segments into
+# larger ones — the whole-index rebuild treadmill (KNN_ANN_TAIL_FRAC)
+# never runs. auto: engage once the store crosses KNN_SEG_MIN_ROWS
+# (the legacy single-graph path serves smaller stores unchanged).
+# off: never. force: engage at a tiny floor (tests/benches).
+KNN_SEG_MODE = env_str("SURREAL_KNN_SEG", "auto")
+KNN_SEG_MIN_ROWS = env_int("SURREAL_KNN_SEG_MIN_ROWS", 400_000)
+# seal policy for the mutable tail: row count, byte size, or age (the
+# age seal is clockless by default — 0 disables it — so the
+# deterministic sim replays; it is checked at sync cadence, no timers)
+KNN_SEG_ROWS = env_int("SURREAL_KNN_SEG_ROWS", 131_072)
+KNN_SEG_BYTES = env_int("SURREAL_KNN_SEG_BYTES", 512 << 20)
+KNN_SEG_AGE_S = env_float("SURREAL_KNN_SEG_AGE_S", 0.0)
+# tiered merge policy: when this many adjacent sealed segments share a
+# size tier (tier t covers [SEG_ROWS * FANOUT^t, SEG_ROWS *
+# FANOUT^(t+1)) live rows), a background job compacts them into one —
+# LSM geometric tiers, so per-row (re)build work stays O(log n) and
+# merge compaction is where tombstoned rows finally leave a graph
+KNN_SEG_FANOUT = env_int("SURREAL_KNN_SEG_FANOUT", 4)
+# per-segment tombstone/overwrite fraction past which the SEGMENT's
+# graph is rebuilt (compacting its dead rows out) — segment-local
+# staleness replaces the global drift threshold entirely
+KNN_SEG_TOMB_FRAC = env_float("SURREAL_KNN_SEG_TOMB_FRAC", 0.5)
+
 # scoring-path routing for the cross-query batcher (idx/vector.py):
 #   auto   — dispatch to the device runner on real accelerators; when the
 #            "device" IS the host CPU (platform cpu), score from the

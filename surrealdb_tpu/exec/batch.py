@@ -376,6 +376,7 @@ COUNTER_KEYS = (
     "agg_groups",
     "agg_columnar",
     "agg_streamed",
+    "order_lexsort",
     "fused_knn_queries",
     "pushdown_rows_pruned",
 )

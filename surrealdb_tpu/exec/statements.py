@@ -1377,6 +1377,18 @@ def _apply_order_sources(rows, order, ctx, aliases=None, keep=None):
         # idiom walks the output row value-only — record links stay
         # un-traversed (reference select/fetch/order_by.surql)
         items.append((resolved, d, collate, numeric, resolved is not expr))
+    # colstore-backed sort: clean scalar key columns go through one
+    # np.lexsort instead of the row-at-a-time key extractor; any
+    # exotic row / uncompilable key / COLLATE|NUMERIC flag bails to
+    # the exact scalar path below (exec/vops.py fallback rules)
+    from surrealdb_tpu.exec.vops import lexsort_sources
+
+    fast = lexsort_sources(
+        rows, [(e, d, c, nu) for e, d, c, nu, _a in items], ctx,
+        keep=keep,
+    )
+    if fast is not None:
+        return fast
     keyed = []
     for src in rows:
         doc = src.doc if src.rid is not None else src.value
@@ -1439,6 +1451,19 @@ class _OrderKey:
             if c:
                 return (c < 0) if d == "asc" else (c > 0)
         return False
+
+    def __eq__(self, other):
+        # heapq.nsmallest decorates with (key, index) tuples: without a
+        # real __eq__, tied keys never fall through to the index and
+        # tie order becomes heap-arbitrary — diverging from the stable
+        # sorted()[:n] this class promises (and from the vectorized
+        # lexsort path, which is stable by construction)
+        for (v, _d, collate, numeric), (w, _, _, _) in zip(
+            self.keys, other.keys
+        ):
+            if _order_cmp(v, w, collate, numeric):
+                return False
+        return True
 
 
 def _apply_order(rows, order, ctx, keep=None):

@@ -285,6 +285,21 @@ def _order_key_fn(order, ctx, aliases, cols):
     return key
 
 
+def _lexsort_try(rows, order, aliases, ctx, keep=None):
+    """Colstore-backed sort for the streaming operators: clean scalar
+    key columns go through one np.lexsort (exec/vops.py) instead of
+    the row-at-a-time key extractor; None → the exact scalar sort
+    (exotic rows, uncompilable keys, COLLATE/NUMERIC, tiny inputs)."""
+    from surrealdb_tpu.exec.statements import _resolve_alias
+    from surrealdb_tpu.exec.vops import lexsort_sources
+
+    items = [
+        (_resolve_alias(e, aliases), d, c, num)
+        for e, d, c, num in order
+    ]
+    return lexsort_sources(rows, items, ctx, keep=keep)
+
+
 class SortOp(Operator):
     """Pipeline-breaking full sort (SortByKey)."""
 
@@ -300,7 +315,14 @@ class SortOp(Operator):
         for b in self.children[0].execute(ctx):
             self.cols.prime(b, ctx)
             rows.extend(b)
-        rows.sort(key=_order_key_fn(self.order, ctx, self.aliases, self.cols))
+        fast = _lexsort_try(rows, self.order, self.aliases, ctx)
+        if fast is not None:
+            rows = fast
+        else:
+            rows.sort(
+                key=_order_key_fn(self.order, ctx, self.aliases,
+                                  self.cols)
+            )
         for s in range(0, len(rows), BATCH_SIZE):
             yield rows[s:s + BATCH_SIZE]
 
@@ -403,12 +425,16 @@ class SortTopKOp(Operator):
         self.limit_metrics.enabled = True
 
     def _execute(self, ctx):
-        key = _order_key_fn(self.order, ctx, self.aliases, self.cols)
         rows = []
         for b in self.children[0].execute(ctx):
             self.cols.prime(b, ctx)
             rows.extend(b)
-        top = heapq.nsmallest(self.keep, rows, key=key)
+        top = _lexsort_try(rows, self.order, self.aliases, ctx,
+                           keep=self.keep)
+        if top is None:
+            key = _order_key_fn(self.order, ctx, self.aliases,
+                                self.cols)
+            top = heapq.nsmallest(self.keep, rows, key=key)
         out = top[self.skip:]
         # the Limit node above the top-k drops the offset rows
         self.limit_metrics.rows += len(out)

@@ -1098,6 +1098,82 @@ def columnar_group_select(n_stmt, tb, ctx, aliases):
 
 
 # ---------------------------------------------------------------------------
+# vectorized ORDER BY (colstore-backed lexsort)
+# ---------------------------------------------------------------------------
+
+
+def _order_codes(col):
+    """Dense per-row sort codes for one ORDER BY key column, exactly
+    mirroring `value_cmp` over the vectorizable ranks: type rank first
+    (NONE < NULL < bool < number < string), then the typed comparator
+    inside the rank (numeric compare for bool/number — int 1 ties
+    float 1.0; Python string order for strings). Equal-comparing rows
+    share a code, so later keys and sort stability decide them —
+    byte-identical to the scalar `_OrderKey` path."""
+    n = col.n
+    rank = col.rank.astype(np.int64)
+    val = col.num.copy()
+    smask = col.rank == RANK_STR
+    if smask.any():
+        sv = col.strs[smask].tolist()
+        uniq = {s: i for i, s in enumerate(sorted(set(sv)))}
+        val[np.flatnonzero(smask)] = [float(uniq[s]) for s in sv]
+    order = np.lexsort((val, rank))
+    sr = rank[order]
+    svv = val[order]
+    new = np.ones(n, bool)
+    new[1:] = (sr[1:] != sr[:-1]) | (svv[1:] != svv[:-1])
+    codes = np.empty(n, np.int64)
+    codes[order] = np.cumsum(new) - 1
+    return codes
+
+
+def lexsort_sources(rows, items, ctx, keep=None):
+    """Colstore-backed ORDER BY over drained Source rows: when every
+    key is a clean scalar column (compilable expression, no exotic
+    rows, no COLLATE/NUMERIC), sort via np.lexsort over dense codes
+    instead of the row-at-a-time key extractor. Returns the reordered
+    (and `keep`-bounded) row list, or None → the exact scalar path
+    (same fallback rules as every kernel in this module: bail, never
+    guess). `items` are `(resolved_expr, dir, collate, numeric)`.
+    Small row sets stay scalar — below the floor the per-column setup
+    costs more than the row loop it replaces."""
+    if not _enabled() or len(rows) < 64:
+        return None
+    from surrealdb_tpu.exec.batch import BatchCols
+
+    for _expr, _d, collate, numeric in items:
+        if collate or numeric:
+            return None  # collation/numeric string order: scalar path
+    nodes = []
+    for expr, _d, _c, _n in items:
+        node = compile_expr(expr, ctx)
+        if node is None:
+            return None
+        nodes.append(node)
+    colset = BatchCols(rows)
+    keys = []
+    for node, (_e, d, _c, _n) in zip(nodes, items):
+        col = node.eval(colset, ctx)
+        if col is None or (col.rank == RANK_EXOTIC).any():
+            # exotic rows (links, datetimes, NaN, >2^53 ints, nested
+            # values, missing docs) need the scalar comparator
+            return None
+        codes = _order_codes(col)
+        keys.append(codes if d == "asc" else -codes)
+    # np.lexsort is stable and sorts by the LAST key first — reverse so
+    # the first ORDER BY key is primary; equal full-keys keep original
+    # row order, exactly like the stable scalar sort (and like
+    # heapq.nsmallest on the keep-bounded path)
+    order = np.lexsort(tuple(reversed(keys)))
+    if keep is not None and keep < len(order):
+        order = order[:keep]
+    _count(ctx.ds, "order_lexsort")
+    _count(ctx.ds, "rows_vectorized", len(rows))
+    return [rows[int(i)] for i in order]
+
+
+# ---------------------------------------------------------------------------
 # fused filtered-KNN (hybrid vector + predicate queries)
 # ---------------------------------------------------------------------------
 

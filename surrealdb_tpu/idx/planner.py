@@ -1422,11 +1422,15 @@ def explain_plan(tb, cond, ctx, stmt):
                         "operator": f"<|{knn.k},{ef or 40}|>",
                         "value": qval,
                     }
-                    if eng._ann_route(knn.k) is not None:
-                        # the size/metric gate routed this store to the
-                        # quantized graph index (int8 descent + exact
-                        # re-rank) instead of the brute scan
-                        plan["ann"] = "graph"
+                    ann_plan = eng.ann_plan(knn.k)
+                    if ann_plan is not None:
+                        # the size/metric gate routed this store off
+                        # the brute scan: "graph" = whole-store CAGRA
+                        # (int8 descent + exact re-rank), "segmented" =
+                        # LSM-style sealed-segment fan-out with
+                        # per-segment graphs (idx/segments.py); the
+                        # segment/ready counts surface the lifecycle
+                        plan.update(ann_plan)
                     refresh = getattr(eng, "refresh_parts", None)
                     if refresh is not None:
                         # sharded store: the search scatter-gathers

@@ -7,8 +7,13 @@ gets its own part engine — a `TpuVectorIndex` clamped to that range.
 Every part owns its slice end to end: host arrays rebuilt from ITS
 range, device blocks shipped to the runner under the existing
 `(key, tag)` protocol, a CAGRA graph once the part crosses the ANN
-floor. Index size and query fan-out both scale with shard count
-(ROADMAP open item 3, the SHINE direction).
+floor — and, past the segmentation floor, its own LSM-style sealed
+segments (idx/segments.py): segment fan-out nests INSIDE the shard
+scatter-gather, so a part under continuous ingest seals/merges in the
+background while the router's exact k-way merge stays exact (each
+part's list is exact over its rows whether it came from one graph, a
+segment fan-out, or a brute scan). Index size and query fan-out both
+scale with shard count (ROADMAP open item 3, the SHINE direction).
 
 A query scatter-gathers: one `vn` read establishes the freshness
 point, the shared op log is fetched ONCE and routed to stale parts by
@@ -268,6 +273,32 @@ class ShardedVectorIndex:
             r = p.engine._ann_route(k)
             if r is not None:
                 return r
+        return None
+
+    def ann_plan(self, k: int):
+        """EXPLAIN surface across the parts: segmented wins over the
+        legacy graph marker when any part fans over sealed segments
+        (each part engine runs its own seal/build/merge lifecycle —
+        segment fan-out nests inside the shard scatter-gather)."""
+        with self.lock:
+            parts = list(self.parts)
+        plan = None
+        seg_total = ready_total = 0
+        for p in parts:
+            pp = p.engine.ann_plan(k)
+            if pp is None:
+                continue
+            if pp.get("ann") == "segmented":
+                seg_total += pp.get("segments", 0)
+                ready_total += pp.get("ready", 0)
+                plan = "segmented"
+            elif plan is None:
+                plan = "graph"
+        if plan == "segmented":
+            return {"ann": "segmented", "segments": seg_total,
+                    "ready": ready_total}
+        if plan == "graph":
+            return {"ann": "graph"}
         return None
 
     def ensure_ann(self) -> bool:

@@ -267,6 +267,15 @@ class TpuVectorIndex:
         self._ann_seq = 0          # device block tag for shipped builds
         self._ann_lock = threading.Lock()
         self._ann_dev_key = f"ann/{uuid.uuid4().hex[:16]}"
+        # segmented LSM-style serving (idx/segments.py): lazily created
+        # once the store crosses the segmentation floor; None until
+        # then (small stores keep the legacy single-graph overlay)
+        self._segs = None
+        # whole-index ANN rebuilds THIS engine scheduled (the legacy
+        # drift treadmill); engine-scoped so churn gates can assert 0
+        # without cross-datastore pollution (a module-level aggregate
+        # lives in idx/segments.py)
+        self.ann_full_rebuilds = 0
         self.coalescer = _Coalescer(self)
         # queries in flight on this engine (between sync and the end of
         # their scoring pass): a pinned engine's host arrays are not
@@ -364,6 +373,8 @@ class TpuVectorIndex:
                     self._ann_gen += 1
                     if self._ann_state == "ready":
                         self._ann_state = "idle"
+                if self._segs is not None:
+                    self._segs.reset()
 
     # -- cache sync ---------------------------------------------------------
     def sync(self, ctx):
@@ -387,7 +398,7 @@ class TpuVectorIndex:
                 # with a fresh poll, same step-jump rationale as the
                 # ANN install
                 resource.checkpoint(fresh=True)
-            self._maybe_build_ann()
+            self._maybe_maintain()
 
     def _sync_impl(self, ctx):
         ns, db, tb, ix = self.key
@@ -536,7 +547,8 @@ class TpuVectorIndex:
         self.valid = np.ones(len(rids), dtype=bool)
         self._drop_device()
         # a repack remaps row ids: the ANN snapshot (graph ids, dirty
-        # rows, any build in flight) is void — discard and re-trigger
+        # rows, any build in flight) is void — discard and re-trigger;
+        # the segment table (spans of the old numbering) dies with it
         with self._ann_lock:
             self._ann = None
             self._ann_dirty = {}
@@ -545,6 +557,8 @@ class TpuVectorIndex:
             self._ann_gen += 1
             if self._ann_state == "ready":
                 self._ann_state = "idle"
+        if self._segs is not None:
+            self._segs.reset()
 
     def _rebuild(self, ctx):
         ns, db, tb, ix = self.key
@@ -585,14 +599,14 @@ class TpuVectorIndex:
                 if len(self.valid):
                     frag = 1.0 - (self.valid.sum() / len(self.valid))
             if frag <= 0.25:
-                self._maybe_build_ann()
+                self._maybe_maintain()
                 return
         rids, rows, index = self._scan_rows(ctx)  # KV I/O: no locks held
         with self.lock, self.rw.write():
             if ver >= self.version:
                 self._install_rows(rids, rows, index)
                 self.version = ver
-        self._maybe_build_ann()
+        self._maybe_maintain()
 
     def search_topk(self, qv: np.ndarray, k: int):
         """Per-part scatter entry: top-k over this part's (already
@@ -637,9 +651,49 @@ class TpuVectorIndex:
         ann = self._ann
         if ann is not None:
             out["ann_bytes"] = ann.nbytes()
+        segs = self._segs
+        if segs is not None and segs.active():
+            st = segs.status()
+            out["ann"] = "segmented"
+            out["segments"] = st["segments"]
+            out["segments_ready"] = st["ready"]
+            out["tail_rows"] = st["tail_rows"]
         if self.label:
             out["range"] = self.label
         return out
+
+    # -- segmented LSM-style serving (idx/segments.py) ----------------------
+
+    def _segments(self):
+        """The segment coordinator, created on first touch."""
+        if self._segs is None:
+            from surrealdb_tpu.idx.segments import SegmentedAnn
+
+            with self.lock:
+                if self._segs is None:
+                    self._segs = SegmentedAnn(self)
+        return self._segs
+
+    def _seg_engaged(self) -> bool:
+        """True when segmented serving governs this engine (mode +
+        metric + size gates, idx/segments.py policy)."""
+        segs = self._segs
+        if segs is not None:
+            return segs.engaged()
+        from surrealdb_tpu import cnf as _cnf
+
+        if str(_cnf.KNN_SEG_MODE).lower() == "off":
+            return False
+        return self._segments().engaged()
+
+    def _maybe_maintain(self):
+        """Post-sync index maintenance: segmented engines seal / build
+        / merge in the background (idx/segments.py); everything else
+        keeps the legacy whole-store graph schedule."""
+        if self._seg_engaged():
+            self._segments().maybe_maintain()
+            return
+        self._maybe_build_ann()
 
     # -- quantized graph-ANN overlay (idx/cagra.py) -------------------------
 
@@ -679,14 +733,26 @@ class TpuVectorIndex:
             if self._ann_state == "building":
                 return
             self._ann_state = "building"
+        if ann is not None:
+            # drift past KNN_ANN_TAIL_FRAC is re-deriving the WHOLE
+            # graph — the rebuild treadmill the segmented path
+            # (idx/segments.py) exists to eliminate; counted so the
+            # knn_churn gate can assert it never happens there
+            from surrealdb_tpu.idx import segments as _segments
+
+            self.ann_full_rebuilds += 1
+            _segments.count("ann_full_rebuilds")
         threading.Thread(target=self._build_ann, daemon=True,
                          name="ann-build").start()
 
     def ensure_ann(self) -> bool:
         """Synchronous build entry (bench/tests): returns True when a
-        ready, non-stale graph serves searches of this store."""
+        ready, non-stale graph (or, on a segmented engine, a fully
+        built segment set) serves searches of this store."""
         import time as _time
 
+        if self._seg_engaged():
+            return self._segments().drain()
         floor = self._ann_floor()
         n = len(self.rids)
         if floor is None or n < floor:
@@ -697,6 +763,11 @@ class TpuVectorIndex:
                 return True
             with self._ann_lock:
                 if self._ann_state != "building":
+                    if ann is not None:
+                        from surrealdb_tpu.idx import segments as _sg
+
+                        self.ann_full_rebuilds += 1
+                        _sg.count("ann_full_rebuilds")
                     self._ann_state = "building"
                     break
             _time.sleep(0.05)  # a background build is running: wait
@@ -864,6 +935,36 @@ class TpuVectorIndex:
             return None
         return self._ann
 
+    def _seg_route(self, k: int):
+        """The segment coordinator when a k-NN search of `k` should fan
+        over sealed segments, else None. Same k gate as the graph
+        route; exact-only segment sets still fan out (each span scans
+        exactly — the merge stays byte-identical to brute)."""
+        if k > cnf.KNN_ANN_MAX_K:
+            return None
+        segs = self._segs
+        if segs is not None and segs.active():
+            return segs
+        return None
+
+    def ann_plan(self, k: int):
+        """EXPLAIN surface: how a k-NN of `k` over this engine is
+        served — None (brute scan), {"ann": "graph"} (legacy
+        whole-store graph), or {"ann": "segmented", ...} with the
+        segment fan-out shape."""
+        segs = self._seg_route(k)
+        if segs is not None:
+            st = segs.status()
+            return {
+                "ann": "segmented",
+                "segments": st["segments"],
+                "ready": st["ready"],
+                "tail_rows": st["tail_rows"],
+            }
+        if self._ann_route(k) is not None:
+            return {"ann": "graph"}
+        return None
+
     def _ann_search_cfg(self) -> dict:
         w = max(int(cnf.KNN_ANN_SEARCH_WIDTH), 1)
         width = 1
@@ -875,16 +976,23 @@ class TpuVectorIndex:
             "expand": max(int(cnf.KNN_ANN_EXPAND), 1),
         }
 
-    def _ann_device_search(self, ann, qs32: np.ndarray, kc: int):
+    def _ann_device_search(self, ann, qs32: np.ndarray, kc: int,
+                           dev_key=None, tag=None):
         """Descent candidates from the runner's AnnStore blocks; ships
         the build snapshot on first use / after a runner restart via
         the same (key, tag) protocol as the vector blocks — PR-4
-        crash/reship and the post-ship prewarm apply unchanged."""
+        crash/reship and the post-ship prewarm apply unchanged.
+        Segmented engines pass a per-SEGMENT `dev_key`/`tag`
+        (idx/segments.py), making every sealed segment an independently
+        shippable/evictable runner block."""
         from surrealdb_tpu.device import get_supervisor
 
         sup = get_supervisor()
-        tag = [int(self._ann_seq), int(ann.built_version),
-               int(ann.built_epoch)]
+        if dev_key is None:
+            dev_key = self._ann_dev_key
+        if tag is None:
+            tag = [int(self._ann_seq), int(ann.built_version),
+                   int(ann.built_epoch)]
 
         def loader():
             return "ann_load", {
@@ -898,14 +1006,14 @@ class TpuVectorIndex:
             ]
 
         for _attempt in (0, 1):
-            sup.ensure_loaded(self._ann_dev_key, tag, loader)
+            sup.ensure_loaded(dev_key, tag, loader)
             t, _meta, bufs = sup.call(
                 "ann_search",
-                {"key": self._ann_dev_key, "tag": tag, "kc": int(kc)},
+                {"key": dev_key, "tag": tag, "kc": int(kc)},
                 [qs32],
             )
             if t == "stale":
-                sup.forget(self._ann_dev_key)
+                sup.forget(dev_key)
                 continue
             break
         else:
@@ -1119,6 +1227,9 @@ class TpuVectorIndex:
         batcher's per-rider degrade ladder (the ANN path degrades
         internally to its numpy descent instead — falling back to a
         brute scan would forfeit the graph's 10× at the worst moment)."""
+        segs = self._seg_route(k)
+        if segs is not None:
+            return segs.knn_batch(qvs, k)
         ann = self._ann_route(k)
         if ann is not None:
             return self._ann_knn_batch(ann, qvs, k)

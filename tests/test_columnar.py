@@ -437,3 +437,45 @@ def test_memory_accountant_covers_colstore(ds):
     )
     snap = resource.get_accountant().snapshot()
     assert snap["by_kind"].get("col", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# colstore-backed ORDER BY (PR 15): lexsort vs the scalar key extractor
+# ---------------------------------------------------------------------------
+
+
+def test_order_by_lexsort_dual_execution(ds):
+    """ORDER BY over clean scalar columns rides np.lexsort; the answer
+    (including tie order, LIMIT/START bounds, DESC, multi-key, NONE and
+    mixed-rank rows) must render identically to the scalar comparator —
+    and exotic key columns (arrays, >2^53 ints, Decimals) must bail to
+    the scalar path rather than guess."""
+    queries = [
+        "SELECT i, f FROM rows ORDER BY i",
+        "SELECT i, f FROM rows ORDER BY i DESC, f ASC",
+        "SELECT i, s FROM rows ORDER BY s, i DESC LIMIT 25",
+        "SELECT f, b FROM rows ORDER BY b DESC, f LIMIT 11 START 4",
+        "SELECT i AS rank, f FROM rows ORDER BY rank DESC LIMIT 9",
+        # mixed-rank key column (int/float/str/bool/NULL/array rows):
+        # array rows are exotic → whole sort falls back, still identical
+        "SELECT m, i FROM rows ORDER BY m, i LIMIT 30",
+        # exotic keys: >2^53 ints and Decimals route scalar
+        "SELECT big, i FROM rows ORDER BY big DESC, i LIMIT 15",
+        "SELECT s, i FROM rows WHERE i > 0 ORDER BY s DESC, i",
+    ]
+    for sql in queries:
+        _assert_same(ds, sql)
+
+
+def test_order_by_lexsort_counter_and_fallback(ds):
+    from surrealdb_tpu.exec.batch import counters
+
+    before = counters(ds)["order_lexsort"]
+    cnf.COLUMNAR = "auto"
+    ds.query_one("SELECT i, f FROM rows ORDER BY i DESC LIMIT 20",
+                 ns="t", db="t")
+    assert counters(ds)["order_lexsort"] == before + 1
+    # an exotic key column must NOT count (scalar fallback served it)
+    ds.query_one("SELECT m, i FROM rows ORDER BY m LIMIT 20",
+                 ns="t", db="t")
+    assert counters(ds)["order_lexsort"] == before + 1

@@ -277,6 +277,40 @@ def test_knn_sim_silent_loss_mutation_caught(monkeypatch):
     assert caught >= 1, "silently dropped shards were not detected"
 
 
+@pytest.mark.parametrize("seed", [0, 4, 14])
+def test_knn_sim_with_segments_enabled(monkeypatch, seed):
+    """The KNN delivery invariants hold with segmented ANN serving
+    forced on every part engine (PR 15): seals, background builds and
+    merges race the chaos schedule, and every non-partial answer must
+    still equal the brute oracle. Oversampling is pinned high enough
+    that graph-served segments re-rank their whole span exactly — the
+    checker demands exactness, and the point here is the segment
+    MACHINERY (fan-out, merge_topk, dirty rows, splices) under faults,
+    not descent recall."""
+    from surrealdb_tpu import cnf
+    from surrealdb_tpu.idx import segments, vector
+    from surrealdb_tpu.sim import run_knn_sim
+
+    monkeypatch.setattr(cnf, "KNN_SEG_MODE", "force")
+    monkeypatch.setattr(cnf, "KNN_SEG_ROWS", 16)
+    monkeypatch.setattr(cnf, "KNN_ANN_MODE", "force")
+    monkeypatch.setattr(cnf, "KNN_ANN_OVERSAMPLE", 4096)
+    monkeypatch.setattr(cnf, "KNN_HOST_BATCH", "host")
+    # route even tiny part searches through knn_batch (the segment
+    # fan-out entry) instead of the small-store single-pass shortcut
+    monkeypatch.setattr(vector, "DEVICE_MIN_ROWS", 8)
+    segments.reset_counters()
+    res = run_knn_sim(seed)
+    assert res.ok, (
+        f"seed {seed} with segments: violations={res.violations[:4]} "
+        f"errors={res.errors[:2]}"
+    )
+    assert res.stats["answered"] > 0
+    c = segments.counters()
+    assert c["seg_seals"] >= 1, "segments never engaged — vacuous run"
+    assert c["ann_full_rebuilds"] == 0
+
+
 @pytest.mark.slow
 def test_knn_sim_sweep_60_seeds():
     """Acceptance sweep: >=60 seeds of index-serving chaos — splits,

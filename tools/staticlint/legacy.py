@@ -66,6 +66,11 @@ _MEM_REGISTRATION_FNS = {
     "surrealdb_tpu/idx/vector.py": ("_vec_mem_bytes", "_ann_mem_bytes",
                                     "_stats_mem_bytes",
                                     "_mem_evict_vec"),
+    # PR 15: every sealed segment's graph is an ann-class account —
+    # size/evict coverage plus the lifecycle entries that keep the
+    # table consistent with the accountant (rename-proof)
+    "surrealdb_tpu/idx/segments.py": ("_ann_bytes", "_evict_graph",
+                                      "maybe_maintain", "reset"),
     "surrealdb_tpu/server/fanout.py": ("_mem_bytes", "_mem_evict"),
     "surrealdb_tpu/device/handlers.py": ("_admit", "mem_used"),
     "surrealdb_tpu/kvs/ds.py": ("_ft_cache_bytes", "_csr_mem_bytes",
