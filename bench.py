@@ -2138,6 +2138,145 @@ def bench_follower_reads(quick=False):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_knn_mesh(quick=False):
+    """BENCH family `knn_mesh`: the DeviceRunner mesh execution layer
+    (device/mesh.py) across virtual device counts 1/2/4/8 — the same
+    clustered store and queries served by a FRESH supervised runner per
+    count. The runner subprocess inherits XLA_FLAGS, so every count is
+    a real n-device jax process (virtual CPU devices — the mesh
+    collectives compiled are the TPU deployment's);
+    SURREAL_DEVICE_MESH=force row-shards the store across the full
+    mesh, so count 1 is the legacy single-device kernel baseline.
+
+    Emits per count: vec_knn qps, recall@10 vs f64 ground truth, merge
+    overhead vs the 1-device run, and the runner-REPORTED mesh width
+    (`mesh_ndev` — sharded_kernel_ran is only true when a reply said
+    so, never inferred). tools/bench_report.py --multichip rolls this
+    line into MULTICHIP_r0N.json."""
+    import re
+
+    from surrealdb_tpu import cnf
+    from surrealdb_tpu.device.supervisor import DeviceSupervisor
+
+    n = 20_000 if quick else 60_000
+    dim = 64
+    k = 10
+    nq = 16
+    dispatches = 40 if quick else 160
+    xs, rng = _clustered_rows(n, dim, 64, 0.15, 31)
+    qs = xs[rng.integers(0, n, nq)] + 0.05 * rng.normal(
+        size=(nq, dim)
+    ).astype(np.float32)
+    xn = xs.astype(np.float64)
+    truth = []
+    for q in qs:
+        d = np.linalg.norm(xn - q.astype(np.float64)[None, :], axis=1)
+        truth.append(set(
+            int(i) for i in np.argsort(d, kind="stable")[:k]
+        ))
+    valid = np.ones(n, np.uint8)
+    cfg = {
+        "hbm_budget": cnf.KNN_HBM_BUDGET_BYTES,
+        "score_budget": cnf.KNN_SCORE_BUDGET_ELEMS,
+        "query_chunk": cnf.KNN_QUERY_CHUNK,
+        "int8_oversample": cnf.KNN_INT8_OVERSAMPLE,
+        "block_rows": 1 << 20,
+    }
+
+    def loader():
+        return "vec_load", {
+            "metric": "euclidean", "mink_p": 3.0, "cfg": dict(cfg),
+        }, [xs, valid]
+
+    def run_count(nd):
+        saved = {key: os.environ.get(key) for key in
+                 ("XLA_FLAGS", "SURREAL_DEVICE_MESH", "JAX_PLATFORMS")}
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={nd}"
+        ).strip()
+        os.environ["SURREAL_DEVICE_MESH"] = "force"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sup = DeviceSupervisor(mode="auto", dispatch_timeout_s=60.0)
+        try:
+            if not sup.wait_ready(300):
+                return {"device_count": nd, "error":
+                        sup.last_error or "runner never became ready"}
+            sup.ensure_loaded("vec/knn-mesh", [1, 0], loader)
+            meta = None
+
+            def query():
+                t, m, bufs = sup.call(
+                    "vec_knn",
+                    {"key": "vec/knn-mesh", "tag": [1, 0], "k": k},
+                    [qs],
+                )
+                assert t == "ok", m.get("error")
+                return m, bufs
+
+            meta, bufs = query()  # warm: pays the mesh kernel compile
+            t0 = time.perf_counter()
+            for _ in range(dispatches):
+                meta, bufs = query()
+            dt = time.perf_counter() - t0
+            if meta.get("mode") == "cand":
+                # int8 candidates: exact host rescore, the serving path
+                cand = bufs[0]
+                got = []
+                for b in range(nq):
+                    ids_b = cand[b][(cand[b] >= 0) & (cand[b] < n)]
+                    d = np.linalg.norm(
+                        xn[ids_b] - qs[b].astype(np.float64)[None, :],
+                        axis=1,
+                    )
+                    sel = np.argsort(d, kind="stable")[:k]
+                    got.append(set(int(i) for i in ids_b[sel]))
+            else:
+                got = [set(int(i) for i in row) for row in bufs[1]]
+            hits = sum(len(g & t) for g, t in zip(got, truth))
+            return {
+                "device_count": nd,
+                "mesh_ndev": int(meta.get("mesh_ndev", 1) or 1),
+                "rank_mode": meta.get("rank_mode"),
+                "sharded_kernel_ran":
+                    int(meta.get("mesh_ndev", 1) or 1) >= 2,
+                "qps": round(dispatches * nq / dt, 1),
+                "recall_at_10": round(hits / (k * nq), 4),
+            }
+        finally:
+            sup.shutdown()
+            for key, v in saved.items():
+                if v is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = v
+
+    counts = []
+    for nd in (1, 2, 4, 8):
+        counts.append(run_count(nd))
+    base = next((c.get("qps") for c in counts
+                 if c.get("device_count") == 1 and c.get("qps")), None)
+    for c in counts:
+        if base and c.get("qps"):
+            # virtual devices timeshare the same cores, so this is the
+            # mesh partition/merge TAX (positive), not a speedup claim
+            c["merge_overhead"] = round(base / c["qps"] - 1.0, 4)
+    sharded = [c for c in counts if c.get("sharded_kernel_ran")]
+    return {
+        "metric": "knn_mesh",
+        "n": n, "dim": dim, "k": k, "queries_per_dispatch": nq,
+        "counts": counts,
+        "sharded_kernel_ran": bool(sharded),
+        "n_devices_used": max(
+            (c["mesh_ndev"] for c in sharded), default=1),
+        "mesh_shape": [max((c["mesh_ndev"] for c in sharded),
+                           default=1)],
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -2148,7 +2287,7 @@ def main():
                              "brute", "graph3hop", "hybrid",
                              "live_fanout", "knn_sharded",
                              "mem_pressure", "follower_reads",
-                             "analytics", "knn_churn"])
+                             "analytics", "knn_churn", "knn_mesh"])
     ap.add_argument("--groups", type=int, default=2,
                     help="shard groups for --config knn_sharded (2/4)")
     args = ap.parse_args()
@@ -2219,6 +2358,7 @@ def main():
         "follower_reads": bench_follower_reads,
         "analytics": bench_analytics,
         "knn_churn": bench_knn_churn,
+        "knn_mesh": bench_knn_mesh,
     }
     _probe_backend()
     if args.all:

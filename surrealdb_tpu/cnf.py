@@ -289,6 +289,14 @@ BACKEND_INIT_TIMEOUT_S = env_float("SURREAL_BACKEND_INIT_TIMEOUT_S", 240.0)
 # query errors instead of silently degrading. inline: run device ops
 # in-process (debug/tests — forfeits fault isolation).
 DEVICE_MODE = env_str("SURREAL_DEVICE", "auto")
+# mesh execution (device/mesh.py): row-shard vec/ANN/CSR blocks across
+# jax.devices() with on-mesh partial top-k + exact merge. auto
+# (default): shard only when a store's single-device share busts the
+# per-device byte budget. off: legacy single-device stores. force:
+# always shard across the full mesh. An integer caps the mesh width.
+# Read per-call (os.environ first) so tests/bench can flip it without
+# a cnf reload.
+DEVICE_MESH = env_str("SURREAL_DEVICE_MESH", "auto")
 # per-dispatch deadline; a dispatch that exhausts the FULL window is a
 # wedge (runner SIGKILLed + circuit opens). Also capped per call by the
 # query's remaining budget (inflight.remaining()).

@@ -26,8 +26,14 @@ import numpy as np
 _jit_cache: dict = {}
 
 
-def _descent_impl(graph, x8, arow, x2q, x8p, arowp, x2qp, probe_ids,
-                  qs, metric, width, iters, expand, kc):
+def _descent_scored(graph, x8, arow, x2q, x8p, arowp, x2qp, probe_ids,
+                    qs, metric, width, iters, expand, kc):
+    """Descent core returning BOTH [B, kc] ids and their int8 scores.
+
+    The scored variant exists for the mesh execution layer
+    (device/mesh.py): per-device partial descents over row shards merge
+    on (score, global-id), so the shard kernel needs the distances the
+    single-device kernel throws away."""
     import jax
     import jax.numpy as jnp
 
@@ -89,11 +95,19 @@ def _descent_impl(graph, x8, arow, x2q, x8p, arowp, x2qp, probe_ids,
     ids, dist, _e = jax.lax.fori_loop(
         0, iters, body, (ids, dist, expanded)
     )
-    _v, order = jax.lax.top_k(-dist, kc)
-    return jnp.take_along_axis(ids, order, axis=1).astype(jnp.int32)
+    neg, order = jax.lax.top_k(-dist, kc)
+    return jnp.take_along_axis(ids, order, axis=1).astype(jnp.int32), -neg
 
 
-def _descent_jit(args, static):
+def _descent_impl(graph, x8, arow, x2q, x8p, arowp, x2qp, probe_ids,
+                  qs, metric, width, iters, expand, kc):
+    ids, _dist = _descent_scored(graph, x8, arow, x2q, x8p, arowp, x2qp,
+                                 probe_ids, qs, metric, width, iters,
+                                 expand, kc)
+    return ids
+
+
+def _descent_jit(args, static, scored: bool = False):
     import jax
 
     from surrealdb_tpu.device.kernelstats import note_compile, note_hit
@@ -102,11 +116,12 @@ def _descent_jit(args, static):
         args[1].shape[0], args[1].shape[1], args[0].shape[1],
         args[4].shape[0], args[8].shape[0],
     )
-    ck = (n, dim, d_out, p, b) + static
+    ck = (n, dim, d_out, p, b, scored) + static
     fn = _jit_cache.get(ck)
     if fn is None:
         note_compile("ann_descent")
-        fn = jax.jit(_descent_impl, static_argnums=(9, 10, 11, 12, 13))
+        fn = jax.jit(_descent_scored if scored else _descent_impl,
+                     static_argnums=(9, 10, 11, 12, 13))
         _jit_cache[ck] = fn
     else:
         note_hit("ann_descent")

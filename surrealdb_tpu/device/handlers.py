@@ -29,7 +29,16 @@ class DeviceBudgetError(RuntimeError):
     to the host paths (never wedging or killing the runner)."""
 
 
-def _vec_estimate(n: int, dim: int, itemsize: int, meta: dict) -> int:
+def _vec_estimate(n: int, dim: int, itemsize: int, meta: dict,
+                  ndev: int = 0) -> int:
+    """Install estimate: the mesh store's TOTAL bytes when the load is
+    placed on a mesh (`ndev` >= 1), else the legacy VecStore formula."""
+    if ndev:
+        from surrealdb_tpu.device.mesh import MeshVecStore
+
+        return MeshVecStore.estimate_device_bytes(
+            n, dim, itemsize, meta["metric"], meta["cfg"], ndev
+        )
     from surrealdb_tpu.device.vecstore import VecStore
 
     return VecStore.estimate_device_bytes(
@@ -61,21 +70,27 @@ class DeviceHost:
         # rows and the graph ship as independently chunked buffers
         self._ann_staging: dict = {}
         # device-memory byte budget (SURREAL_DEVICE_MEM_BUDGET_MB;
-        # 0 = entry-count caps only). Every resident store accounts its
-        # estimated device bytes; a ship admits by evicting LRU stores
-        # first (eviction = re-ship on next use, never an error) and is
-        # REFUSED with DeviceBudgetError only when the single store
-        # cannot fit an otherwise-empty runner.
+        # 0 = entry-count caps only), interpreted PER DEVICE: every
+        # resident store accounts its estimated device-0 share
+        # (estimate / mesh_ndev — unsharded stores sit whole on device
+        # 0, the max-loaded device of a mesh). A ship admits by
+        # evicting LRU stores first (eviction = re-ship on next use,
+        # never an error) and is REFUSED with DeviceBudgetError only
+        # when the single store's per-device share cannot fit an
+        # otherwise-empty runner — placement (device/mesh.pick_ndev)
+        # first widens the mesh so a store that fits on 8 devices but
+        # not 1 SHARDS instead of refusing.
         self.budget_bytes = cnf.env_int(
             "SURREAL_DEVICE_MEM_BUDGET_MB", cnf.DEVICE_MEM_BUDGET_MB
         ) << 20
         self.oom_refusals = 0
         self.budget_evictions = 0
-        # multipart install reservations: key -> final install bytes
-        # admitted at *_load_begin but not yet resident. Counted by
-        # mem_used() so a CONCURRENT ship admitted between one store's
-        # begin and end cannot overcommit the budget; released when
-        # the staged store installs (or its staging is dropped).
+        # multipart install reservations: key -> final install SHARE
+        # (device-0 bytes) admitted at *_load_begin but not yet
+        # resident. Counted by mem_used()/mem_used_device0() so a
+        # CONCURRENT ship admitted between one store's begin and end
+        # cannot overcommit the budget; released when the staged store
+        # installs (or its staging is dropped).
         self._reserved: dict = {}
 
     # -- device-memory budget ------------------------------------------------
@@ -95,6 +110,69 @@ class DeviceHost:
         total += sum(self._reserved.values())
         return total
 
+    def mem_used_device0(self) -> int:
+        """Estimated bytes on the MAX-LOADED device: sharded stores
+        contribute their per-device share, unsharded stores (and
+        staging buffers + reservations) their whole estimate — the
+        quantity the per-device budget admits against."""
+        total = 0
+        for cache in (self.vec, self.csr, self.ann):
+            for _tag, st in cache.values():
+                ndev = max(int(getattr(st, "mesh_ndev", 1) or 1), 1)
+                total += -(-st.device_nbytes() // ndev)
+        for _m, vecs, valid in self._staging.values():
+            total += int(vecs.nbytes) + int(valid.nbytes)
+        for _m, by_name in self._ann_staging.values():
+            total += sum(int(a.nbytes) for a in by_name.values())
+        total += sum(self._reserved.values())
+        return total
+
+    def _place_vec(self, n: int, dim: int, itemsize: int,
+                   meta: dict) -> int:
+        """Mesh width for a vec install: 0 = legacy single/self-sharded
+        store (mesh off, one device, or a store that fits one device's
+        budget), else the budget-aware pow2 count from
+        device/mesh.pick_ndev."""
+        from surrealdb_tpu.device import mesh as devmesh
+
+        if devmesh.mesh_size() <= 1:
+            return 0
+        from surrealdb_tpu.device.mesh import MeshVecStore
+
+        nd = devmesh.pick_ndev(
+            lambda d: MeshVecStore.estimate_device_bytes(
+                n, dim, itemsize, meta["metric"], meta["cfg"], d),
+            self.budget_bytes, n_rows=max(n, 1),
+        )
+        return nd if nd > 1 else 0
+
+    def _place_ann(self, n: int, dim: int, d_out: int) -> int:
+        from surrealdb_tpu.device import mesh as devmesh
+
+        if devmesh.mesh_size() <= 1:
+            return 0
+        from surrealdb_tpu.device.mesh import MeshAnnStore
+
+        nd = devmesh.pick_ndev(
+            lambda d: MeshAnnStore.estimate_device_bytes(n, dim, d_out,
+                                                         d),
+            self.budget_bytes, n_rows=max(n, 1),
+        )
+        return nd if nd > 1 else 0
+
+    def _place_csr(self, n_edges: int) -> int:
+        from surrealdb_tpu.device import mesh as devmesh
+
+        if devmesh.mesh_size() <= 1:
+            return 0
+        from surrealdb_tpu.device.mesh import MeshCsrStore
+
+        nd = devmesh.pick_ndev(
+            lambda d: MeshCsrStore.estimate_device_bytes(n_edges, d),
+            self.budget_bytes, n_rows=max(n_edges, 1),
+        )
+        return nd if nd > 1 else 0
+
     def _evict_key(self, key: str):
         """Drop any resident copy of `key` ahead of its replacement
         ship: a re-shipped store must never be refused because its own
@@ -103,8 +181,16 @@ class DeviceHost:
         for cache in (self.vec, self.csr, self.ann):
             cache.pop(key, None)
 
-    def _admit(self, incoming: int, keep_key: str = ""):
-        """Make room for `incoming` estimated bytes or raise
+    def _admit(self, incoming: int, keep_key: str = "", ndev: int = 1):
+        """Admit `incoming` total estimated bytes sharded over `ndev`
+        devices: the per-device budget sees `ceil(incoming/ndev)` —
+        at ndev=1 (unsharded) exactly the old whole-estimate rule."""
+        self._admit_share(
+            -(-int(incoming) // max(int(ndev), 1)), keep_key
+        )
+
+    def _admit_share(self, share: int, keep_key: str = ""):
+        """Make room for `share` estimated device-0 bytes or raise
         DeviceBudgetError. Victims pop oldest-first within each cache
         (the per-kind OrderedDicts are LRU — every use move_to_end's),
         in fixed kind order csr → vec → ann: ascending re-ship cost,
@@ -119,14 +205,14 @@ class DeviceHost:
             # `stale` regardless): free it instead of letting it count
             # against — and be protected from — its own replacement
             self._evict_key(keep_key)
-        if incoming > self.budget_bytes:
+        if share > self.budget_bytes:
             self.oom_refusals += 1
             raise DeviceBudgetError(
-                f"store needs ~{incoming >> 20} MiB but the device "
-                f"budget is {self.budget_bytes >> 20} MiB "
+                f"store needs ~{share >> 20} MiB per device but the "
+                f"device budget is {self.budget_bytes >> 20} MiB "
                 f"(SURREAL_DEVICE_MEM_BUDGET_MB)"
             )
-        while self.mem_used() + incoming > self.budget_bytes:
+        while self.mem_used_device0() + share > self.budget_bytes:
             victim = None
             for cache in (self.csr, self.vec, self.ann):
                 for key in cache:
@@ -138,8 +224,8 @@ class DeviceHost:
             if victim is None:
                 self.oom_refusals += 1
                 raise DeviceBudgetError(
-                    f"store needs ~{incoming >> 20} MiB; "
-                    f"{self.mem_used() >> 20} MiB resident is "
+                    f"store needs ~{share >> 20} MiB per device; "
+                    f"{self.mem_used_device0() >> 20} MiB resident is "
                     f"unevictable (staging) under the "
                     f"{self.budget_bytes >> 20} MiB budget"
                 )
@@ -160,11 +246,21 @@ class DeviceHost:
         import jax
 
         from surrealdb_tpu.device import compile_cache, kernelstats
+        from surrealdb_tpu.device import mesh as devmesh
+
+        def _sharded(cache):
+            return sum(1 for _t, s in cache.values()
+                       if getattr(s, "mesh_ndev", 1) > 1)
 
         devs = jax.devices()
         return "ok", {
             "platform": devs[0].platform if devs else "none",
             "device_count": len(devs),
+            "mesh": dict(devmesh.describe(),
+                         sharded_vec=_sharded(self.vec),
+                         sharded_ann=_sharded(self.ann),
+                         sharded_csr=_sharded(self.csr)),
+            "mem_used_device0": self.mem_used_device0(),
             "vec_blocks": len(self.vec),
             "csr_blocks": len(self.csr),
             "ann_blocks": len(self.ann),
@@ -181,22 +277,38 @@ class DeviceHost:
         }, []
 
     def op_vec_load(self, meta, bufs):
-        from surrealdb_tpu.device.vecstore import VecStore
-
         key = meta["key"]
         vecs, valid = bufs
-        self._admit(VecStore.estimate_device_bytes(
-            vecs.shape[0], vecs.shape[1], vecs.dtype.itemsize,
-            meta["metric"], meta["cfg"],
-        ), keep_key=key)
-        st = VecStore(key, vecs, valid, meta["metric"],
-                      meta.get("mink_p", 3.0), meta["cfg"])
+        ndev = self._place_vec(vecs.shape[0], vecs.shape[1],
+                               vecs.dtype.itemsize, meta)
+        self._admit(
+            _vec_estimate(vecs.shape[0], vecs.shape[1],
+                          vecs.dtype.itemsize, meta, ndev),
+            keep_key=key, ndev=max(ndev, 1),
+        )
+        st = self._vec_store(key, vecs, valid, meta, ndev)
         st.ensure()
         self.vec.pop(key, None)
         self.vec[key] = (list(meta["tag"]), st)
         while len(self.vec) > MAX_VEC_STORES:
             self.vec.popitem(last=False)
-        return "ok", {"rank_mode": st.rank_mode}, []
+        return "ok", {"rank_mode": st.rank_mode,
+                      "mesh_ndev": getattr(st, "mesh_ndev", 1)}, []
+
+    @staticmethod
+    def _vec_store(key, vecs, valid, meta, ndev: int):
+        """Placed construction: a MeshVecStore on a mesh runner, the
+        legacy VecStore otherwise (mesh off / one device)."""
+        if ndev:
+            from surrealdb_tpu.device.mesh import MeshVecStore
+
+            return MeshVecStore(key, vecs, valid, meta["metric"],
+                                meta.get("mink_p", 3.0), meta["cfg"],
+                                ndev)
+        from surrealdb_tpu.device.vecstore import VecStore
+
+        return VecStore(key, vecs, valid, meta["metric"],
+                        meta.get("mink_p", 3.0), meta["cfg"])
 
     def op_vec_load_begin(self, meta, bufs):
         key = meta["key"]
@@ -208,17 +320,24 @@ class DeviceHost:
         # to answer from, and the install share stays RESERVED (so a
         # concurrent ship admitted mid-stream cannot overcommit) until
         # load_end installs the store
-        est = _vec_estimate(int(n), int(dim), dtype.itemsize, meta)
-        self._admit(
-            int(n) * int(dim) * dtype.itemsize + int(n) + est,
+        ndev = self._place_vec(int(n), int(dim), dtype.itemsize, meta)
+        est = _vec_estimate(int(n), int(dim), dtype.itemsize, meta,
+                            ndev)
+        share = -(-est // max(ndev, 1))
+        # staging is a host-side buffer: it occupies the runner whole,
+        # the install share is what lands per device
+        self._admit_share(
+            int(n) * int(dim) * dtype.itemsize + int(n) + share,
             keep_key=key,
         )
         self._reserved.pop(key, None)
         if self.budget_bytes > 0:
-            self._reserved[key] = est
+            self._reserved[key] = share
         vecs = np.empty((int(n), int(dim)), dtype=dtype)
         (valid,) = bufs
-        self._staging[key] = (dict(meta), vecs, valid)
+        lmeta = dict(meta)
+        lmeta["_mesh_ndev"] = ndev
+        self._staging[key] = (lmeta, vecs, valid)
         return "ok", {}, []
 
     def op_vec_load_part(self, meta, bufs):
@@ -232,22 +351,21 @@ class DeviceHost:
         return "ok", {}, []
 
     def op_vec_load_end(self, meta, bufs):
-        from surrealdb_tpu.device.vecstore import VecStore
-
         key = meta["key"]
         ent = self._staging.pop(key, None)
         self._reserved.pop(key, None)  # the install replaces it below
         if ent is None:
             return "stale", {}, []
         lmeta, vecs, valid = ent
-        st = VecStore(key, vecs, valid, lmeta["metric"],
-                      lmeta.get("mink_p", 3.0), lmeta["cfg"])
+        st = self._vec_store(key, vecs, valid, lmeta,
+                             int(lmeta.get("_mesh_ndev", 0)))
         st.ensure()
         self.vec.pop(key, None)
         self.vec[key] = (list(meta["tag"]), st)
         while len(self.vec) > MAX_VEC_STORES:
             self.vec.popitem(last=False)
-        return "ok", {"rank_mode": st.rank_mode}, []
+        return "ok", {"rank_mode": st.rank_mode,
+                      "mesh_ndev": getattr(st, "mesh_ndev", 1)}, []
 
     def op_vec_drop(self, meta, bufs):
         self.vec.pop(meta["key"], None)
@@ -261,6 +379,7 @@ class DeviceHost:
             return "stale", {}, []
         self.vec.move_to_end(meta["key"])
         out_meta, out_bufs = ent[1].knn(bufs[0], int(meta["k"]))
+        out_meta.setdefault("mesh_ndev", getattr(ent[1], "mesh_ndev", 1))
         return "ok", out_meta, out_bufs
 
     def _prewarm_shapes(self, cache, meta, field, warm_one):
@@ -298,19 +417,30 @@ class DeviceHost:
     # -- quantized graph-ANN blocks (device/annstore.py) --------------------
 
     def _ann_install(self, key, tag, meta, graph, x8, arow, x2q):
-        from surrealdb_tpu.device.annstore import AnnStore
+        ndev = self._place_ann(x8.shape[0], x8.shape[1], graph.shape[1])
+        if ndev:
+            from surrealdb_tpu.device.mesh import MeshAnnStore
 
-        self._admit(AnnStore.estimate_device_bytes(
-            x8.shape[0], x8.shape[1], graph.shape[1]
-        ), keep_key=key)
-        st = AnnStore(key, graph, x8, arow, x2q, meta["metric"],
-                      meta.get("cfg") or {})
+            self._admit(MeshAnnStore.estimate_device_bytes(
+                x8.shape[0], x8.shape[1], graph.shape[1], ndev
+            ), keep_key=key, ndev=ndev)
+            st = MeshAnnStore(key, graph, x8, arow, x2q,
+                              meta["metric"], meta.get("cfg") or {},
+                              ndev)
+        else:
+            from surrealdb_tpu.device.annstore import AnnStore
+
+            self._admit(AnnStore.estimate_device_bytes(
+                x8.shape[0], x8.shape[1], graph.shape[1]
+            ), keep_key=key)
+            st = AnnStore(key, graph, x8, arow, x2q, meta["metric"],
+                          meta.get("cfg") or {})
         st._ensure()
         self.ann.pop(key, None)
         self.ann[key] = (list(tag), st)
         while len(self.ann) > MAX_ANN_STORES:
             self.ann.popitem(last=False)
-        return "ok", {}, []
+        return "ok", {"mesh_ndev": getattr(st, "mesh_ndev", 1)}, []
 
     def op_ann_load(self, meta, bufs):
         graph, x8, arow, x2q = bufs
@@ -326,13 +456,17 @@ class DeviceHost:
         # staging + installed arrays coexist briefly at load_end; the
         # install share stays reserved until then so concurrent ships
         # cannot overcommit between begin and end
+        ndev = self._place_ann(n, int(meta["dim"]), int(meta["d_out"]))
         est = AnnStore.estimate_device_bytes(
             n, int(meta["dim"]), int(meta["d_out"])
         )
-        self._admit(2 * est, keep_key=key)
+        share = -(-est // max(ndev, 1))
+        # host staging (≈ est) occupies the runner whole; the install
+        # share is per device once _ann_install places the mesh store
+        self._admit_share(est + share, keep_key=key)
         self._reserved.pop(key, None)
         if self.budget_bytes > 0:
-            self._reserved[key] = est
+            self._reserved[key] = share
         bufs_by_name = {
             "graph": np.empty((n, int(meta["d_out"])), np.int32),
             "x8": np.empty((n, int(meta["dim"])), np.int8),
@@ -376,7 +510,9 @@ class DeviceHost:
             return "stale", {}, []
         self.ann.move_to_end(meta["key"])
         cand = ent[1].search(bufs[0], int(meta["kc"]))
-        return "ok", {"mode": "cand"}, [cand]
+        return "ok", {"mode": "cand",
+                      "mesh_ndev": getattr(ent[1], "mesh_ndev", 1)}, \
+            [cand]
 
     def op_ann_prewarm(self, meta, bufs):
         """Query-bucket ladder for an ANN index's descent kernel."""
@@ -388,12 +524,23 @@ class DeviceHost:
         return self._prewarm_shapes(self.ann, meta, "buckets", warm)
 
     def op_csr_load(self, meta, bufs):
-        from surrealdb_tpu.device.csrstore import CsrStore
-
         key = meta["key"]
         rows, cols = bufs
-        self._admit(int(rows.nbytes) + int(cols.nbytes), keep_key=key)
-        st = CsrStore(key, rows, cols, int(meta["n_nodes"]))
+        ndev = self._place_csr(rows.shape[0])
+        if ndev:
+            from surrealdb_tpu.device.mesh import MeshCsrStore
+
+            self._admit(MeshCsrStore.estimate_device_bytes(
+                rows.shape[0], ndev
+            ), keep_key=key, ndev=ndev)
+            st = MeshCsrStore(key, rows, cols, int(meta["n_nodes"]),
+                              ndev)
+        else:
+            from surrealdb_tpu.device.csrstore import CsrStore
+
+            self._admit(int(rows.nbytes) + int(cols.nbytes),
+                        keep_key=key)
+            st = CsrStore(key, rows, cols, int(meta["n_nodes"]))
         self.csr.pop(key, None)
         self.csr[key] = (list(meta["tag"]), st)
         while len(self.csr) > MAX_CSR_STORES:
@@ -412,7 +559,8 @@ class DeviceHost:
         mask = ent[1].multi_hop(
             bufs[0], int(meta["hops"]), bool(meta["union"])
         )
-        return "ok", {}, [mask]
+        return "ok", {"mesh_ndev": getattr(ent[1], "mesh_ndev", 1)}, \
+            [mask]
 
     def op_csr_prewarm(self, meta, bufs):
         """Hop-depth ladder for a CSR graph: the first `->edge->`

@@ -16,8 +16,11 @@ gauge by one sample (same discipline as telemetry.StageStat).
 
 from __future__ import annotations
 
-COUNTS = {"hits": 0, "misses": 0}
+COUNTS = {"hits": 0, "misses": 0, "sharded": 0}
 _SEEN: set = set()
+# widest mesh any sharded dispatch actually ran on in this process —
+# the runner-side truth behind the MULTICHIP probe's n_devices_used
+MESH_LAST = {"ndev": 0}
 
 
 def note_compile(kernel: str):
@@ -26,6 +29,15 @@ def note_compile(kernel: str):
 
 def note_hit(kernel: str):
     COUNTS["hits"] += 1
+
+
+def note_sharded(kernel: str, ndev: int):
+    """Record a mesh dispatch (device/mesh.py kernels) of width
+    `ndev`; width-1 meshes don't count as sharded execution."""
+    if ndev > 1:
+        COUNTS["sharded"] += 1
+        if ndev > MESH_LAST["ndev"]:
+            MESH_LAST["ndev"] = ndev
 
 
 # store shapes change every sync epoch under write load, so the seen-set
@@ -49,10 +61,14 @@ def note_shape(kernel: str, shape_key) -> bool:
 
 
 def snapshot() -> dict:
-    return dict(COUNTS)
+    out = dict(COUNTS)
+    out["mesh_ndev"] = MESH_LAST["ndev"]
+    return out
 
 
 def reset():
     COUNTS["hits"] = 0
     COUNTS["misses"] = 0
+    COUNTS["sharded"] = 0
+    MESH_LAST["ndev"] = 0
     _SEEN.clear()

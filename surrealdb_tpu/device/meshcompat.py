@@ -1,0 +1,42 @@
+"""jax old/new-API compat gate for mesh execution.
+
+RUNNER-SIDE ONLY: this module imports jax at module level, so it may
+only be imported from the DeviceRunner subprocess, bench/tooling, or
+tests — never from query-execution code (tools/check_robustness.py
+rule 5). device/mesh.py imports it lazily, inside kernel builders.
+
+jax moved `shard_map` to the top level in 0.5.x; on the 0.4.x line
+(this container ships 0.4.37) it lives under `jax.experimental` and
+spells `check_vma` as `check_rep`. Similarly `jax.lax.axis_size` is
+0.5.x+ — `psum(1, axis)` is the portable spelling. Both gates are
+resolved once here so the mesh subsystem (device/mesh.py) and the
+legacy sharded kernels (parallel/mesh.py) agree on one callable.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
+
+if hasattr(jax, "shard_map"):
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
+
+def axis_size(name):
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(name)
+    return jax.lax.psum(1, name)
+
+
+def make_mesh(devices, axis: str) -> Mesh:
+    """1-D device mesh over `devices` with a single named axis."""
+    return Mesh(np.asarray(devices), (axis,))

@@ -12,6 +12,14 @@ arrays — f32/int32 query/result tensors never pay a CBOR round-trip,
 which is the whole point of the socketpair (the 10M-row int8 store is
 ~7.6 GB; encoding it as CBOR arrays would double memory and burn
 minutes).
+
+Mesh execution (device/mesh.py) rides the same frames — ships stay
+FULL arrays (the runner row-shards at install, so crash/reship needs
+no shard bookkeeping on the serving side). It only adds meta fields:
+the ready frame carries `mesh` (topology describe()), load/search
+replies carry `mesh_ndev` (devices actually serving that store; 1 =
+legacy single-device). Unknown meta keys are ignored by older peers,
+so no frame-format version bump is needed.
 """
 
 from __future__ import annotations

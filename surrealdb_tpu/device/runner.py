@@ -6,7 +6,8 @@ wedged TPU tunnel stalls this process while the supervisor's init
 watchdog times out and the serving path degrades to host execution.
 
 Protocol (device/proto.py frames):
-  runner -> supervisor on boot:  ("ready", {platform, device_count})
+  runner -> supervisor on boot:  ("ready", {platform, device_count,
+                                            compile_cache, mesh})
   supervisor -> runner:          (op, {seq, ...}, bufs)
   runner -> supervisor:          ("ok"|"stale"|"err", {seq, ...}, bufs)
 
@@ -40,6 +41,9 @@ def serve(sock) -> None:
         devs = jax.devices()
         platform = devs[0].platform if devs else "none"
         ndev = len(devs)
+        from surrealdb_tpu.device import mesh as devmesh
+
+        mesh_info = devmesh.describe()
     except BaseException as e:  # init failed: report, then die
         try:
             proto.send_msg(sock, "init_error", {"error": str(e)[:500]})
@@ -52,7 +56,7 @@ def serve(sock) -> None:
     host = DeviceHost()
     proto.send_msg(sock, "ready",
                    {"platform": platform, "device_count": ndev,
-                    "compile_cache": cache_info})
+                    "compile_cache": cache_info, "mesh": mesh_info})
     while True:
         try:
             op, meta, bufs = proto.recv_msg(sock)

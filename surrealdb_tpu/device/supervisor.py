@@ -124,6 +124,9 @@ class DeviceSupervisor:
         # every reply) + the runner's persistent-compile-cache info
         self.compile_counts = {"hits": 0, "misses": 0}
         self.compile_cache_info: Optional[dict] = None
+        # mesh topology from the runner's ready frame (device/mesh.py
+        # describe()); inline mode derives it lazily in status()
+        self.mesh_info: Optional[dict] = None
         self._lock = threading.RLock()
         self._ready = threading.Event()
         self._gen = 0
@@ -433,6 +436,14 @@ class DeviceSupervisor:
                 self.device_count = len(devs)
             except Exception:
                 pass
+        if self.mesh_info is None and self.mode == "inline" \
+                and "jax" in sys.modules:
+            try:
+                from surrealdb_tpu.device import mesh as devmesh
+
+                self.mesh_info = devmesh.describe()
+            except Exception:
+                pass
         with self._lock:
             loaded = list(self._loaded)
         out = {
@@ -454,6 +465,8 @@ class DeviceSupervisor:
         }
         if self.compile_cache_info is not None:
             out["compile_cache_dir"] = self.compile_cache_info
+        if self.mesh_info is not None:
+            out["mesh"] = dict(self.mesh_info)
         from surrealdb_tpu.device.batcher import BATCH_STATS
 
         out["batching"] = BATCH_STATS.to_dict()
@@ -645,6 +658,8 @@ class DeviceSupervisor:
             self.device_count = int(meta.get("device_count", 0))
             if meta.get("compile_cache") is not None:
                 self.compile_cache_info = meta["compile_cache"]
+            if meta.get("mesh") is not None:
+                self.mesh_info = meta["mesh"]
             self._send_q = queue.Queue()
         threading.Thread(target=self._send_loop, args=(parent, gen),
                          daemon=True, name="device-send").start()

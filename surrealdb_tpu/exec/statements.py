@@ -5326,19 +5326,23 @@ def _s_info(n: InfoStmt, ctx: Ctx):
             out["shards"] = shard_topo
         if repl_info is not None:
             out["replication"] = repl_info
-        # shard-partitioned vector serving (idx/shardvec.py): per-shard
-        # index residency — rows, host bytes, ANN state, sync version,
-        # replica addresses — so an operator can see which slice of
-        # which index each shard group is serving
+        # vector index residency — rows, host bytes, ANN state, sync
+        # version, mesh width (device_sharded, device/mesh.py), and for
+        # shard-partitioned serving (idx/shardvec.py) the per-shard
+        # slices + replica addresses — so an operator can see which
+        # slice of which index is serving where
         knn_status = []
         for ixkey, eng in list(ctx.ds.vector_indexes.items()):
+            ent = {"index": ".".join(str(x) for x in ixkey)}
             status_fn = getattr(eng, "shards_status", None)
-            if status_fn is None:
-                continue
-            knn_status.append({
-                "index": ".".join(str(x) for x in ixkey),
-                "shards": status_fn(),
-            })
+            if status_fn is not None:
+                ent["shards"] = status_fn()
+            else:
+                res_fn = getattr(eng, "residency", None)
+                if res_fn is None:
+                    continue
+                ent["residency"] = res_fn()
+            knn_status.append(ent)
         if knn_status:
             out["knn"] = knn_status
         return out

@@ -684,13 +684,19 @@ class SegmentedAnn:
             segs = list(self.segs)
         n = len(self.engine.rids)
         hi = segs[-1].hi if segs else 0
-        return {
+        out = {
             "segments": len(segs),
             "ready": sum(1 for s in segs if s.state == "ready"),
             "tail_rows": max(n - hi, 0),
             "stats": dict(self.stats),
             "spans": [s.status() for s in segs],
         }
+        # segment descents ride the engine's ann blocks, so the mesh
+        # width the runner reported for them is the segment truth too
+        nd = int(getattr(self.engine, "_dev_mesh_ann", 0) or 0)
+        if nd > 1:
+            out["device_sharded"] = nd
+        return out
 
     # -- search fan-out -----------------------------------------------------
 

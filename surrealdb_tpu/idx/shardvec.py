@@ -254,7 +254,9 @@ class ShardedVectorIndex:
 
     def shards_status(self) -> list[dict]:
         """Per-shard index residency (INFO FOR SYSTEM / /metrics):
-        rows, host bytes, ANN state, sync version, replica addresses."""
+        rows, host bytes, ANN state, sync version, replica addresses.
+        `device_sharded` (device/mesh.py mesh width) rides through each
+        part's engine residency when its blocks served on >1 device."""
         with self.lock:
             parts = list(self.parts)
         out = []

@@ -243,6 +243,10 @@ class TpuVectorIndex:
         self._dev_key = f"vec/{uuid.uuid4().hex[:16]}"
         self._dev_epoch = 0
         self.rank_mode = None  # last runner-reported ranking mode
+        # widest mesh the runner reported serving this engine's blocks
+        # on (device/mesh.py; 1 or 0 = legacy single-device stores)
+        self._dev_mesh = 0
+        self._dev_mesh_ann = 0
         # per-epoch host scoring stats (row norms / squared norms) for
         # the batched BLAS host path; rebuilt lazily after cache sync
         self._host_stats = None
@@ -651,6 +655,11 @@ class TpuVectorIndex:
         ann = self._ann
         if ann is not None:
             out["ann_bytes"] = ann.nbytes()
+        mesh_nd = max(int(self._dev_mesh), int(self._dev_mesh_ann))
+        if mesh_nd > 1:
+            # devices this engine's runner blocks actually served on
+            # (device/mesh.py row-sharding); absent = single-device
+            out["device_sharded"] = mesh_nd
         segs = self._segs
         if segs is not None and segs.active():
             st = segs.status()
@@ -1007,7 +1016,7 @@ class TpuVectorIndex:
 
         for _attempt in (0, 1):
             sup.ensure_loaded(dev_key, tag, loader)
-            t, _meta, bufs = sup.call(
+            t, meta, bufs = sup.call(
                 "ann_search",
                 {"key": dev_key, "tag": tag, "kc": int(kc)},
                 [qs32],
@@ -1018,6 +1027,9 @@ class TpuVectorIndex:
             break
         else:
             raise sup.unavailable("ann cache thrashing")
+        nd = int(meta.get("mesh_ndev", 1) or 1)
+        if nd > self._dev_mesh_ann:
+            self._dev_mesh_ann = nd
         return bufs[0]
 
     def _ann_extra_topk(self, ann, qvs, k: int, n: int):
@@ -1414,6 +1426,9 @@ class TpuVectorIndex:
             # fail loudly), DeviceUnavailable (degrade to host) in auto
             raise sup.unavailable("vec cache thrashing")
         self.rank_mode = meta.get("rank_mode")
+        nd = int(meta.get("mesh_ndev", 1) or 1)
+        if nd > self._dev_mesh:
+            self._dev_mesh = nd
         if meta.get("mode") == "cand":
             # int8 ranking candidates: exact host rescore from the
             # full-precision rows (kc rows per query — tiny next to the

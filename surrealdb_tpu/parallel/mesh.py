@@ -25,26 +25,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# jax moved shard_map to the top level in 0.5.x; on the 0.4.x line it
-# lives under jax.experimental and spells check_vma as check_rep —
-# resolve once, same callable either way
-if hasattr(jax, "shard_map"):
-    _shard_map = jax.shard_map
-else:  # pragma: no cover - depends on installed jax
-    from jax.experimental.shard_map import shard_map as _exp_shard_map
-
-    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
-        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_rep=check_vma)
+# the 0.4.x/0.5.x shard_map + axis_size gate lives in device/meshcompat
+# so this module and the mesh execution subsystem (device/mesh.py)
+# resolve the same callables
+from surrealdb_tpu.device.meshcompat import (
+    axis_size as _axis_size,
+    shard_map as _shard_map,
+)
 
 DATA_AXIS = "data"
-
-
-def _axis_size(name):
-    # jax.lax.axis_size is 0.5.x+; psum(1, axis) is the portable spelling
-    if hasattr(jax.lax, "axis_size"):
-        return jax.lax.axis_size(name)
-    return jax.lax.psum(1, name)
 
 
 def default_mesh(devices=None) -> Mesh:

@@ -246,3 +246,121 @@ def sharded_knn_smoke():
             with contextlib.suppress(Exception):
                 spare.shutdown()
                 spare.server_close()
+
+
+def mesh_smoke():
+    """Gate smoke for the mesh execution layer (device/mesh.py), in two
+    halves. (1) A forced-8-virtual-device SUBPROCESS runs the full
+    property suite: sharded brute/ANN-descent/CSR answers byte-identical
+    to single-device across pow2 counts + random splits, plus the
+    per-device budget placement proof (over-budget store serves sharded,
+    1-device probe refuses). (2) The SERVING stack: an 8-device runner
+    under SURREAL_DEVICE_MESH=force must answer KNN identically to the
+    host, and surface mesh residency through INFO FOR SYSTEM (`device`
+    topology + `knn` engine residency). Returns None on success."""
+    import json
+    import os
+    import re
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (
+        re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               env.get("XLA_FLAGS", "")).strip()
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    r = subprocess.run(
+        [sys.executable, "-m", "surrealdb_tpu.device.mesh",
+         "--devices", "8", "--budget-check"],
+        capture_output=True, text=True, timeout=480, env=env,
+    )
+    if r.returncode != 0:
+        tail = (r.stdout.strip().splitlines() or ["<no output>"])[-1]
+        return f"mesh smoke: selfcheck rc={r.returncode}: {tail[:300]}"
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    if not (rep.get("ok") and rep.get("sharded_kernel_ran")
+            and rep.get("n_devices", 0) >= 2):
+        return f"mesh smoke: selfcheck report not ok: {rep}"
+
+    import surrealdb_tpu.idx.vector as V
+    from surrealdb_tpu import Datastore, cnf
+    from surrealdb_tpu.device import DeviceSupervisor, set_supervisor
+
+    saved = {k: os.environ.get(k) for k in
+             ("XLA_FLAGS", "SURREAL_DEVICE_MESH", "JAX_PLATFORMS")}
+    os.environ["XLA_FLAGS"] = env["XLA_FLAGS"]
+    os.environ["SURREAL_DEVICE_MESH"] = "force"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    old_min = V.DEVICE_MIN_ROWS
+    V.DEVICE_MIN_ROWS = 32
+    # the virtual mesh runner IS a cpu-platform runner: the auto
+    # routing policy would host-route every dispatch past it
+    old_hb = cnf.KNN_HOST_BATCH
+    cnf.KNN_HOST_BATCH = "device"
+    sup = DeviceSupervisor(mode="auto", dispatch_timeout_s=15.0,
+                           init_timeout_s=120.0)
+    old_sup = set_supervisor(sup)
+    try:
+        rng = np.random.default_rng(5)
+        n, dim, k = 300, 8, 5
+        xs = rng.normal(size=(n, dim)).astype(np.float32)
+        ds = Datastore("memory")
+        try:
+            stmts = [f"DEFINE TABLE p; DEFINE INDEX ix ON p FIELDS v "
+                     f"HNSW DIMENSION {dim} DIST EUCLIDEAN TYPE F32;"]
+            for i in range(n):
+                vals = ", ".join(f"{x:.6f}" for x in xs[i])
+                stmts.append(f"CREATE p:{i} SET v = [{vals}];")
+            ds.query("".join(stmts), ns="z", db="z")
+            q = ", ".join(f"{x:.6f}" for x in xs[7])
+            sql = f"SELECT VALUE id FROM p WHERE v <|{k},20|> [{q}]"
+            # host truth first (device off), then the mesh must match
+            off = DeviceSupervisor(mode="off")
+            set_supervisor(off)
+            want = [r_.id for r_ in ds.query(sql, ns="z", db="z")[0]]
+            set_supervisor(sup)
+            if not sup.wait_ready(120):
+                return f"mesh smoke: runner never ready: {sup.last_error}"
+            eng = next(iter(ds.vector_indexes.values()))
+            import time as _time
+
+            deadline = _time.monotonic() + 30.0
+            while _time.monotonic() < deadline:
+                got = [r_.id for r_ in ds.query(sql, ns="z", db="z")[0]]
+                if got != want:
+                    return (f"mesh smoke: sharded KNN diverged: "
+                            f"{got} != {want}")
+                if eng._dev_mesh >= 2:
+                    break
+                _time.sleep(0.05)
+            else:
+                return (f"mesh smoke: sharded serving never engaged: "
+                        f"{eng.residency()}")
+            info = ds.query("INFO FOR SYSTEM", ns="z", db="z")[0]
+            dev_mesh = (info.get("device") or {}).get("mesh") or {}
+            if dev_mesh.get("n_devices", 0) < 2:
+                return (f"mesh smoke: INFO device.mesh "
+                        f"{dev_mesh!r}, want n_devices >= 2")
+            knn = info.get("knn") or []
+            res = knn[0].get("residency", {}) if knn else {}
+            if res.get("device_sharded", 0) < 2:
+                return (f"mesh smoke: INFO knn residency {knn!r}, "
+                        f"want device_sharded >= 2")
+            return None
+        finally:
+            ds.close()
+    except Exception as e:  # surface, don't crash the gate
+        return f"mesh smoke: {e.__class__.__name__}: {e}"
+    finally:
+        V.DEVICE_MIN_ROWS = old_min
+        cnf.KNN_HOST_BATCH = old_hb
+        set_supervisor(old_sup)
+        sup.shutdown()
+        for key, v in saved.items():
+            if v is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = v

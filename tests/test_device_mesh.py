@@ -1,0 +1,214 @@
+"""Mesh execution layer (device/mesh.py): sharded == single-device
+byte-identity, per-device budget placement, and crash/reship chaos.
+
+The property suite runs in a SUBPROCESS with 8 virtual CPU devices
+(XLA_FLAGS=--xla_force_host_platform_device_count=8 must be set before
+jax initializes, which this pytest process cannot guarantee). It sweeps
+every pow2 device count and random contiguous row splits, asserting the
+sharded brute / int8-descent / CSR multi-hop answers are byte-identical
+to the single-device kernels, then proves the per-device budget rule:
+a store over one device's budget serves SHARDED here and is REFUSED by
+a 1-device probe.
+
+The chaos test runs the full serving stack: an 8-virtual-device runner
+with SURREAL_DEVICE_MESH=force, SIGKILLed mid-sharded-dispatch under
+concurrent clients — the host fallback must serve identical answers,
+and the re-spawned runner must reship and serve sharded again.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+DIM = 8
+N_VECS = 300
+N_CLIENTS = 16
+
+
+def test_mesh_selfcheck_and_budget_subprocess():
+    """Property + placement proof across device counts 1/2/4/8."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (
+        re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               env.get("XLA_FLAGS", "")).strip()
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    r = subprocess.run(
+        [sys.executable, "-m", "surrealdb_tpu.device.mesh",
+         "--devices", "8", "--budget-check"],
+        capture_output=True, text=True, timeout=480, env=env,
+    )
+    assert r.returncode == 0, f"selfcheck failed:\n{r.stdout}\n{r.stderr}"
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["ok"], rep
+    assert rep["n_devices"] >= 2, rep
+    assert rep["counts"] == [1, 2, 4, 8], rep
+    assert rep["sharded_kernel_ran"], rep
+    # every kernel family byte-identical across counts + random splits
+    for check in ("vec_exact_euclidean", "vec_exact_manhattan",
+                  "vec_int8", "ann_descent_vs_seq", "csr_hop1",
+                  "csr_hop3u"):
+        assert rep["checks"][check], (check, rep)
+    # placement: over-budget store sharded here, refused on 1 device
+    assert rep["budget"]["sharded_served"], rep["budget"]
+    assert rep["budget"]["mesh_ndev"] >= 2, rep["budget"]
+    assert rep["budget"]["single_device_refused"], rep["budget"]
+
+
+@pytest.fixture()
+def mesh_env(monkeypatch):
+    """Force an 8-virtual-device mesh runner: the env is inherited by
+    the supervisor's runner subprocess (jax initializes THERE)."""
+    import surrealdb_tpu.idx.vector as V
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", "")).strip()
+    monkeypatch.setenv(
+        "XLA_FLAGS",
+        (flags + " --xla_force_host_platform_device_count=8").strip(),
+    )
+    monkeypatch.setenv("SURREAL_DEVICE_MESH", "force")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(V, "DEVICE_MIN_ROWS", 32)
+
+
+@pytest.fixture()
+def mesh_sup(mesh_env):
+    from surrealdb_tpu.device import DeviceSupervisor, set_supervisor
+
+    sup = DeviceSupervisor(
+        mode="auto", dispatch_timeout_s=15.0, load_timeout_s=30.0,
+        init_timeout_s=120.0, probe_interval_s=0.2, promote_successes=1,
+    )
+    old = set_supervisor(sup)
+    try:
+        yield sup
+    finally:
+        set_supervisor(old)
+        sup.shutdown()
+
+
+@pytest.fixture()
+def mesh_ds():
+    from surrealdb_tpu import Datastore
+
+    ds = Datastore("memory")
+    rng = np.random.default_rng(71)
+    ds.query(
+        f"DEFINE TABLE p; DEFINE INDEX ix ON p FIELDS v HNSW "
+        f"DIMENSION {DIM} DIST EUCLIDEAN TYPE F32"
+    )
+    vecs = rng.normal(size=(N_VECS, DIM)).astype(np.float32)
+    stmts = []
+    for i, v in enumerate(vecs):
+        vals = ", ".join(f"{x:.6f}" for x in v)
+        stmts.append(f"CREATE p:{i} SET v = [{vals}];")
+    ds.query("".join(stmts))
+    yield ds, vecs
+    ds.close()
+
+
+def _knn_sql(qv) -> str:
+    vals = ", ".join(f"{x:.6f}" for x in qv)
+    return f"SELECT id FROM p WHERE v <|5,20|> [{vals}]"
+
+
+def _host_truth(ds, queries):
+    from surrealdb_tpu.device import DeviceSupervisor, set_supervisor
+
+    off = DeviceSupervisor(mode="off")
+    prev = set_supervisor(off)
+    try:
+        return [
+            [r["id"] for r in ds.query(_knn_sql(q))[0]] for q in queries
+        ]
+    finally:
+        set_supervisor(prev)
+
+
+def _engine(ds):
+    return next(iter(ds.vector_indexes.values()))
+
+
+def _wait_mesh_serving(ds, queries, expect, timeout=30.0):
+    """Query until the engine records a sharded reply (mesh_ndev >= 2
+    piggybacked on vec_knn), asserting correctness throughout."""
+    eng = _engine(ds)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for qi, q in enumerate(queries):
+            assert [r["id"] for r in ds.query(_knn_sql(q))[0]] \
+                == expect[qi]
+        if eng._dev_mesh >= 2:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_sigkill_mid_sharded_dispatch(mesh_sup, mesh_ds):
+    """SIGKILL the mesh runner under concurrent sharded-KNN load:
+    zero errors, host answers identical, reship restores MESH serving
+    (not just any serving) after recovery."""
+    ds, vecs = mesh_ds
+    queries = vecs[:8]
+    expect = _host_truth(ds, queries)
+    assert mesh_sup.wait_ready(120), mesh_sup.status()
+    assert _wait_mesh_serving(ds, queries, expect), (
+        f"sharded serving never engaged: {_engine(ds).residency()}"
+    )
+    eng = _engine(ds)
+    assert eng.residency().get("device_sharded", 0) >= 2
+    # supervisor-level topology from the runner's ready frame
+    mesh_info = mesh_sup.status().get("mesh") or {}
+    assert mesh_info.get("n_devices", 0) >= 2, mesh_sup.status()
+
+    errors, mismatches = [], []
+    stop_at = time.monotonic() + 3.0
+
+    def client(ci):
+        qi = ci % len(queries)
+        while time.monotonic() < stop_at:
+            try:
+                got = [r["id"]
+                       for r in ds.query(_knn_sql(queries[qi]))[0]]
+                if got != expect[qi]:
+                    mismatches.append((ci, got))
+            except Exception as e:  # noqa: BLE001 — assertion IS "no errors"
+                errors.append((ci, repr(e)))
+                return
+
+    threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+               for ci in range(N_CLIENTS)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    pid = mesh_sup.runner_pid()
+    assert pid is not None
+    os.kill(pid, signal.SIGKILL)  # crash mid-sharded-dispatch
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, f"queries errored during crash: {errors[:5]}"
+    assert not mismatches, f"fallback diverged: {mismatches[:5]}"
+
+    # recovery: a fresh runner reships the blocks and serves SHARDED
+    # again — reset the monotonic high-water mark so the assertion
+    # can only be satisfied by a post-restart sharded reply
+    eng._dev_mesh = 0
+    deadline = time.monotonic() + 60.0
+    while mesh_sup.state != "ready" and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert mesh_sup.state == "ready", mesh_sup.status()
+    assert _wait_mesh_serving(ds, queries, expect), (
+        f"mesh serving never recovered: {mesh_sup.status()}"
+    )
+    assert mesh_sup.counters["device_restarts"] >= 1

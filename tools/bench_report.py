@@ -56,6 +56,11 @@ def main(argv=None) -> int:
                          "with, capturing its metric lines")
     ap.add_argument("--out-dir", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), ".."))
+    ap.add_argument("--multichip", action="store_true",
+                    help="write MULTICHIP_r{N}.json instead, deriving "
+                         "the sharded-kernel fields from knn_mesh "
+                         "metric lines (honest: false unless a runner "
+                         "reply actually said mesh_ndev >= 2)")
     args = ap.parse_args(argv)
 
     parsed, tail = [], []
@@ -86,6 +91,39 @@ def main(argv=None) -> int:
         print("bench_report: no input (use --input/--stdin/--run)",
               file=sys.stderr)
         return 2
+    if args.multichip:
+        # the MULTICHIP artifact series (r1-r5: dryrun pass/fail only).
+        # From r6 on it carries a REAL sharded-kernel measurement: the
+        # knn_mesh bench's per-device-count sweep, with the honest
+        # fields the probe false-green fix introduced — every value
+        # comes from runner replies, never from "the mesh exists"
+        mesh = [p for p in parsed if p.get("metric") == "knn_mesh"]
+        agg = mesh[-1] if mesh else {}
+        counts = agg.get("counts", [])
+        out = {
+            "n_devices": max(
+                (c.get("device_count", 0) for c in counts), default=0),
+            "rc": rc,
+            "ok": rc == 0 and bool(agg.get("sharded_kernel_ran")),
+            "skipped": not mesh,
+            "tail": "\n".join(tail[-30:]),
+            "sharded_kernel_ran": bool(agg.get("sharded_kernel_ran")),
+            "n_devices_used": int(agg.get("n_devices_used", 0) or 0),
+            "mesh_shape": agg.get("mesh_shape", [0]),
+            "parsed": parsed,
+        }
+        reason = next(
+            (c["error"] for c in counts if c.get("error")), None)
+        if reason or not mesh:
+            out["fallback_reason"] = reason or "no knn_mesh lines"
+        dest = os.path.join(
+            args.out_dir, f"MULTICHIP_r{args.round:02d}.json")
+        with open(dest, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        print(f"bench_report: wrote {os.path.normpath(dest)} "
+              f"(sharded_kernel_ran={out['sharded_kernel_ran']})")
+        return 0 if out["ok"] else 1
     out = {
         "n": args.round,
         "cmd": " && ".join(cmds),

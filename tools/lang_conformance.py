@@ -560,6 +560,7 @@ def main():
     # range-sharded store (routing, cross-shard 2PC, scan stitching)
     from shard_harness import (
         device_degraded_smoke,
+        mesh_smoke,
         sharded_knn_smoke,
         two_shard_smoke,
     )
@@ -587,6 +588,16 @@ def main():
         print("== device-degraded smoke: OK")
     else:
         print(f"== device-degraded smoke: FAIL — {err}")
+        rc = rc or 1
+    # mesh smoke: forced 8-virtual-device property suite (sharded ==
+    # single-device byte-diff + per-device budget placement), then the
+    # serving stack under SURREAL_DEVICE_MESH=force with mesh residency
+    # surfaced through INFO FOR SYSTEM `knn`/`device`
+    err = mesh_smoke()
+    if err is None:
+        print("== mesh smoke: OK")
+    else:
+        print(f"== mesh smoke: FAIL — {err}")
         rc = rc or 1
     # perf smoke: the serving tax over the raw index engine is gated
     # (sql_knn >= 0.8 x index_engine on this box, small N)

@@ -72,7 +72,14 @@ _MEM_REGISTRATION_FNS = {
     "surrealdb_tpu/idx/segments.py": ("_ann_bytes", "_evict_graph",
                                       "maybe_maintain", "reset"),
     "surrealdb_tpu/server/fanout.py": ("_mem_bytes", "_mem_evict"),
-    "surrealdb_tpu/device/handlers.py": ("_admit", "mem_used"),
+    "surrealdb_tpu/device/handlers.py": ("_admit", "_admit_share",
+                                         "mem_used",
+                                         "mem_used_device0"),
+    # mesh execution layer: every per-device block table must expose
+    # its install-time estimate + resident-bytes coverage, and the
+    # budget-aware placement rule itself is rename-proofed
+    "surrealdb_tpu/device/mesh.py": ("estimate_device_bytes",
+                                     "device_nbytes", "pick_ndev"),
     "surrealdb_tpu/kvs/ds.py": ("_ft_cache_bytes", "_csr_mem_bytes",
                                 "_csr_mem_evict", "_col_mem_bytes",
                                 "_col_mem_evict"),
@@ -97,6 +104,7 @@ _MEM_ALLOW = {
     ("surrealdb_tpu/device/csrstore.py", "_jit_cache"),
     ("surrealdb_tpu/device/kernelstats.py", "COUNTS"),
     ("surrealdb_tpu/device/kernelstats.py", "_SEEN"),
+    ("surrealdb_tpu/device/kernelstats.py", "MESH_LAST"),
     ("surrealdb_tpu/device/supervisor.py", "compile_counts"),
     ("surrealdb_tpu/device/supervisor.py", "counters"),
     ("surrealdb_tpu/device/supervisor.py", "_pending"),
